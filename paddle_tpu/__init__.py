@@ -1,0 +1,79 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+A ground-up re-design of the reference framework's capabilities
+(KevinKDA-Resources/Paddle, surveyed in SURVEY.md) for TPU hardware:
+
+- eager Tensors ride jax.Array / XLA's async runtime (no hand-written
+  allocator/stream stack — that is the hardware-native runtime here),
+- autograd records jax.vjp pullbacks (no per-op gradient kernel zoo),
+- the blessed performance path is whole-graph compilation (`paddle_tpu.jit`),
+- distributed training is SPMD over a `jax.sharding.Mesh` with XLA
+  collectives on ICI/DCN (no NCCL, no comm-id bootstrap),
+- hot kernels (attention, fused FFN) are Pallas.
+
+The public API mirrors the reference's `paddle.*` surface so users can
+switch with minimal churn.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .core.tensor import Tensor, to_tensor
+from .core.dtype import (
+    bool_,
+    uint8,
+    int8,
+    int16,
+    int32,
+    int64,
+    float16,
+    bfloat16,
+    float32,
+    float64,
+    complex64,
+    complex128,
+)
+from .core.random import seed
+from .core import random as _rng
+
+from .ops import *  # noqa: F401,F403
+from .ops import __all__ as _ops_all
+
+from .autograd import no_grad, enable_grad, grad, set_grad_enabled, is_grad_enabled
+from . import autograd
+from . import ops
+
+__all__ = ["Tensor", "to_tensor", "seed", "no_grad", "grad"] + list(_ops_all)
+
+# Subsystems (populated progressively; import order matters — nn/optimizer
+# build on ops).
+from . import framework  # noqa: E402
+from . import device  # noqa: E402
+from . import nn  # noqa: E402
+from . import optimizer  # noqa: E402
+from . import io  # noqa: E402
+from . import amp  # noqa: E402
+from . import jit  # noqa: E402
+from . import static  # noqa: E402
+from . import metric  # noqa: E402
+from . import vision  # noqa: E402
+from . import distributed  # noqa: E402
+from . import incubate  # noqa: E402
+from . import utils  # noqa: E402
+from . import profiler  # noqa: E402
+from . import linalg  # noqa: E402
+
+from .framework.io_ import save, load  # noqa: E402
+from .framework.core_ import (  # noqa: E402
+    set_default_dtype,
+    get_default_dtype,
+    set_flags,
+    get_flags,
+)
+from .device import set_device, get_device  # noqa: E402
+
+disable_static = static.disable_static
+enable_static = static.enable_static
+in_dynamic_mode = static.in_dynamic_mode
+
+__all__ += ["save", "load", "set_default_dtype", "get_default_dtype", "set_device", "get_device"]

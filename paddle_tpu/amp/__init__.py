@@ -1,0 +1,200 @@
+"""Automatic mixed precision (reference: python/paddle/amp/ —
+auto_cast O1 white/black lists, GradScaler dynamic loss scaling).
+
+TPU-native stance: bf16 is the blessed dtype — wide exponent means GradScaler
+is a no-op by default (`enable=False` semantics preserved for fp16 parity);
+auto_cast('bfloat16') casts op inputs at the dispatch layer via a thread-local
+autocast state consulted by nn.functional's heavy ops.
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dtype import convert_dtype
+from ..autograd import tape
+
+__all__ = ["auto_cast", "amp_guard", "GradScaler", "decorate", "is_auto_cast_enabled", "get_amp_dtype"]
+
+# O1 lists mirrored from the reference (python/paddle/amp/auto_cast.py:28-92)
+WHITE_LIST = {"matmul", "linear", "conv2d", "conv1d", "conv3d", "einsum", "flash_attention", "mm", "bmm"}
+BLACK_LIST = {
+    "exp", "square", "log", "mean", "sum", "cos_sim", "softmax",
+    "softmax_with_cross_entropy", "sigmoid_cross_entropy_with_logits",
+    "cross_entropy", "layer_norm", "batch_norm", "group_norm",
+}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+
+
+_state = _AmpState()
+
+
+def is_auto_cast_enabled():
+    return _state.enabled
+
+
+def get_amp_dtype():
+    return _state.dtype
+
+
+def maybe_cast_in(name, arrays):
+    """Called by the dispatch layer for white-listed ops under O1."""
+    if not _state.enabled:
+        return arrays
+    if _state.level == "O2" or name in WHITE_LIST:
+        return [
+            a.astype(_state.dtype) if hasattr(a, "dtype") and a.dtype == jnp.float32 else a
+            for a in arrays
+        ]
+    if name in BLACK_LIST:
+        return [
+            a.astype(jnp.float32) if hasattr(a, "dtype") and a.dtype in (jnp.bfloat16, jnp.float16) else a
+            for a in arrays
+        ]
+    return arrays
+
+
+@contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    prev = (_state.enabled, _state.dtype, _state.level)
+    added_w = set(custom_white_list or [])
+    added_b = set(custom_black_list or [])
+    WHITE_LIST.update(added_w)
+    BLACK_LIST.update(added_b)
+    _state.enabled = enable
+    _state.dtype = jnp.bfloat16 if convert_dtype(dtype) == convert_dtype("bfloat16") else jnp.float16
+    _state.level = level
+    try:
+        yield
+    finally:
+        _state.enabled, _state.dtype, _state.level = prev
+        WHITE_LIST.difference_update(added_w)
+        BLACK_LIST.difference_update(added_b)
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2: cast model params to bf16/fp16; optimizers keep fp32 master weights
+    (multi_precision is on by default in paddle_tpu.optimizer)."""
+    dt = convert_dtype(dtype)
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=dt)
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (reference: python/paddle/amp/grad_scaler.py:38 —
+    check_finite_and_unscale + update_loss_scaling ops fused here into the
+    unscale step)."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0**15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameter_list:
+            if p.grad is None:
+                continue
+            g = p.grad._data.astype(jnp.float32) * inv
+            finite = bool(jnp.all(jnp.isfinite(g)))
+            if not finite:
+                found = True
+            p.grad._data = g
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        if not self._found_inf:
+            self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            self._found_inf = False
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        from ..ops.creation import full
+
+        return full([1], self._scale)
+
+    def state_dict(self):
+        return {
+            "scale": self._scale,
+            "incr_ratio": self._incr_ratio,
+            "decr_ratio": self._decr_ratio,
+            "incr_count": self._good_steps,
+            "decr_count": self._bad_steps,
+        }
+
+    def load_state_dict(self, state_dict):
+        self._scale = state_dict.get("scale", self._scale)
+        self._good_steps = state_dict.get("incr_count", 0)
+        self._bad_steps = state_dict.get("decr_count", 0)

@@ -1,0 +1,23 @@
+"""Autograd public API (reference: python/paddle/autograd/)."""
+from .tape import (
+    backward,
+    grad,
+    no_grad,
+    enable_grad,
+    is_grad_enabled,
+    set_grad_enabled,
+)
+from .py_layer import PyLayer, PyLayerContext
+from . import functional
+
+__all__ = [
+    "backward",
+    "grad",
+    "no_grad",
+    "enable_grad",
+    "is_grad_enabled",
+    "set_grad_enabled",
+    "PyLayer",
+    "PyLayerContext",
+    "functional",
+]

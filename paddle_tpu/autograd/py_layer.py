@@ -1,0 +1,87 @@
+"""Custom autograd function (reference: paddle.autograd.PyLayer,
+python/paddle/autograd/py_layer.py:244 + pybind/eager_py_layer.cc).
+
+The TPU-native twist: forward/backward run through the same eager op layer,
+and the recorded Node simply calls the user's static backward. Used by
+recompute and MoE exactly like the reference.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..autograd import tape
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = []
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    def saved_tensor(self):
+        return list(self._saved)
+
+    # paddle alias
+    saved_tensors = property(lambda self: list(self._saved))
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        with tape.no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+
+        multi = isinstance(outputs, (tuple, list))
+        out_list = list(outputs) if multi else [outputs]
+        out_tensors = [o for o in out_list if isinstance(o, Tensor)]
+
+        needs = [not t.stop_gradient for t in tensor_inputs]
+        if tape.is_grad_enabled() and any(needs):
+
+            def vjp_fn(cts):
+                if not isinstance(cts, (tuple, list)):
+                    cts = (cts,)
+                grad_in = [Tensor(c) for c in cts]
+                with tape.no_grad():
+                    res = cls.backward(ctx, *grad_in)
+                if not isinstance(res, (tuple, list)):
+                    res = (res,)
+                out = []
+                i = 0
+                for a in tensor_inputs:
+                    if i < len(res):
+                        g = res[i]
+                        out.append(g._data if isinstance(g, Tensor) else g)
+                    else:
+                        out.append(None)
+                    i += 1
+                return tuple(out)
+
+            # fresh output tensors so recording doesn't alias forward's internals
+            wrapped = [Tensor(t._data) for t in out_tensors]
+            tape.record(vjp_fn, tensor_inputs, needs, wrapped, name=cls.__name__)
+            it = iter(wrapped)
+            out_list = [next(it) if isinstance(o, Tensor) else o for o in out_list]
+
+        return tuple(out_list) if multi else out_list[0]
+
+
+class LegacyPyLayer(PyLayer):
+    pass

@@ -1,0 +1,310 @@
+"""Eager autograd engine.
+
+TPU-native re-design of the reference's eager autograd
+(paddle/fluid/eager/backward.cc:383 `egr::Backward`,
+grad_node_info.h:168 `GradNodeBase`): instead of per-op hand-written C++
+GradNodes generated from YAML, every eager op records a single `Node` holding
+the `jax.vjp` pullback of its (pure, jittable) forward function. Backward is
+the same reverse-topological cotangent walk, but each node's backward *is* an
+XLA-compiled pullback — there is no per-op gradient kernel library to
+maintain, because jax.vjp derives it from the forward definition.
+
+Gradient accumulation for leaves mirrors the reference's
+GradTensorHolder/accumulation nodes (paddle/fluid/eager/grad_tensor_holder.h).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "Node",
+    "no_grad",
+    "enable_grad",
+    "is_grad_enabled",
+    "set_grad_enabled",
+    "record",
+    "backward",
+    "grad",
+]
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_state = _GradState()
+
+
+def is_grad_enabled() -> bool:
+    return _state.enabled
+
+
+def set_grad_enabled(mode: bool):
+    _state.enabled = bool(mode)
+
+
+class _GradModeGuard:
+    def __init__(self, mode: bool):
+        self._mode = mode
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = _state.enabled
+        _state.enabled = self._mode
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with _GradModeGuard(self._mode):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+def no_grad():
+    """Context manager / decorator disabling tape recording (paddle.no_grad)."""
+    return _GradModeGuard(False)
+
+
+def enable_grad():
+    return _GradModeGuard(True)
+
+
+class Node:
+    """One autograd-graph node: the vjp pullback of a single eager op.
+
+    Analog of a generated GradNode subclass in the reference (eager_gen.py
+    templates) — but generic over any jax-traceable forward.
+    """
+
+    __slots__ = (
+        "vjp_fn",
+        "inputs",
+        "input_needs_grad",
+        "out_avals",
+        "n_outs",
+        "name",
+        "__weakref__",
+    )
+
+    def __init__(self, vjp_fn, inputs, input_needs_grad, out_avals, name=""):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs  # list of input Tensors (kept alive for leaf accumulation)
+        self.input_needs_grad = input_needs_grad
+        self.out_avals = out_avals  # list of (shape, dtype) for each output
+        self.n_outs = len(out_avals)
+        self.name = name
+
+    def __repr__(self):
+        return f"<GradNode {self.name} n_outs={self.n_outs}>"
+
+
+def record(vjp_fn, inputs, input_needs_grad, outputs, name=""):
+    """Attach a Node to `outputs` (Tensors) produced from `inputs` (Tensors)."""
+    out_avals = [(o.shape, o.dtype) for o in outputs]
+    node = Node(vjp_fn, list(inputs), list(input_needs_grad), out_avals, name)
+    for i, o in enumerate(outputs):
+        o._grad_node = node
+        o._out_index = i
+        o.stop_gradient = False
+    return node
+
+
+def _topo_order(root_nodes: Sequence[Node]) -> List[Node]:
+    """Reverse-topological order over the node DAG (iterative DFS postorder)."""
+    visited = set()
+    order: List[Node] = []
+    stack: List[tuple] = [(n, False) for n in root_nodes]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        stack.append((node, True))
+        for t in node.inputs:
+            n = getattr(t, "_grad_node", None)
+            if n is not None and id(n) not in visited:
+                stack.append((n, False))
+    order.reverse()  # roots first → walk producers after consumers
+    return order
+
+
+def _accum(slot, value):
+    return value if slot is None else slot + value
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False, capture=None):
+    """Reverse-mode walk accumulating `.grad` on leaf tensors.
+
+    Mirrors egr::Backward (reference backward.cc:383): seed cotangents on the
+    root outputs, walk nodes in reverse topological order, run each node's
+    pullback, scatter cotangents to producer nodes or leaf tensors.
+
+    `capture`: optional dict id(tensor)→tensor (GeneralGrad mode, used by
+    paddle.grad). When given, cotangents arriving at captured tensors (leaf
+    OR intermediate) are collected into the returned dict and leaf `.grad`
+    fields are NOT touched.
+    """
+    from ..core.tensor import Tensor
+
+    captured = {} if capture is not None else None
+
+    def _take(t, ct):
+        key = id(t)
+        captured[key] = ct if key not in captured else captured[key] + ct
+
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+
+    # cotangents[(id(node), out_idx)] = accumulated cotangent array
+    cotangents = {}
+    roots = []
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient:
+            continue
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {t.shape}"
+                )
+            seed = jnp.ones(t.shape, t.dtype)
+        else:
+            seed = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+        node = getattr(t, "_grad_node", None)
+        if capture is not None and id(t) in capture:
+            _take(t, seed)
+            if node is None:
+                continue
+        elif node is None:
+            # Root is itself a leaf.
+            if capture is None:
+                t._accumulate_grad(seed)
+            continue
+        key = (id(node), t._out_index)
+        cotangents[key] = _accum(cotangents.get(key), seed)
+        roots.append(node)
+
+    order = _topo_order(roots)
+    node_by_id = {id(n): n for n in order}
+
+    for node in order:
+        cts = []
+        any_ct = False
+        for i, (shape, dtype) in enumerate(node.out_avals):
+            ct = cotangents.pop((id(node), i), None)
+            if ct is None:
+                ct = jnp.zeros(shape, dtype)
+            else:
+                any_ct = True
+            cts.append(ct)
+        if not any_ct:
+            continue
+        in_cts = node.vjp_fn(tuple(cts) if node.n_outs > 1 else cts[0])
+        for t, needs, ct in zip(node.inputs, node.input_needs_grad, in_cts):
+            if not needs or ct is None:
+                continue
+            if capture is not None and id(t) in capture:
+                _take(t, ct)
+            producer = getattr(t, "_grad_node", None)
+            if producer is not None and id(producer) in node_by_id:
+                key = (id(producer), t._out_index)
+                cotangents[key] = _accum(cotangents.get(key), ct)
+            elif producer is None and not t.stop_gradient and capture is None:
+                t._accumulate_grad(ct)
+        if not retain_graph:
+            node.vjp_fn = _used_up
+
+    # Free graph references so intermediate activations can be collected.
+    if not retain_graph:
+        for t in tensors:
+            _release_graph(t)
+    return captured
+
+
+def _used_up(*_):
+    raise RuntimeError(
+        "Trying to backward through the graph a second time. "
+        "Pass retain_graph=True if you need to."
+    )
+
+
+def _release_graph(root):
+    node = getattr(root, "_grad_node", None)
+    stack = [node] if node is not None else []
+    seen = set()
+    while stack:
+        n = stack.pop()
+        if n is None or id(n) in seen:
+            continue
+        seen.add(id(n))
+        for t in n.inputs:
+            p = getattr(t, "_grad_node", None)
+            if p is not None:
+                stack.append(p)
+            t._grad_node = None
+        n.inputs = []
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=False,
+    create_graph=False,
+    allow_unused=False,
+):
+    """paddle.grad equivalent (reference: egr::GeneralGrad, general_grad.h).
+
+    Computes grads of `outputs` w.r.t. `inputs` without touching `.grad`
+    fields. create_graph=True (higher-order) is supported by re-running the
+    forward functionally under jax.grad — see autograd/functional.py; here we
+    implement the common first-order case via a capture-based accumulation
+    pass that never touches `.grad` fields.
+    """
+    from ..core.tensor import Tensor  # noqa: F401 (used for wrapping results)
+
+    if not isinstance(outputs, (list, tuple)):
+        outputs = [outputs]
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True: use paddle_tpu.autograd.functional.vjp/jacobian "
+            "(functional transforms are the TPU-native higher-order path)"
+        )
+
+    # GeneralGrad mode: cotangents are captured for exactly `inputs` (leaf or
+    # intermediate); no tensor's `.grad` field is touched.
+    capture = {id(t): t for t in inputs}
+    captured = backward(outputs, grad_outputs, retain_graph=retain_graph, capture=capture)
+    results = []
+    for t in inputs:
+        ct = captured.get(id(t))
+        if ct is None and not allow_unused:
+            raise RuntimeError(
+                "One of the differentiated tensors appears to not have "
+                "been used in the graph. Set allow_unused=True if this is "
+                "the desired behavior."
+            )
+        results.append(None if ct is None else Tensor(ct))
+    return results

@@ -1,0 +1,89 @@
+"""Eager op dispatch.
+
+The TPU-native replacement for the reference's entire dispatch stack
+(_C_ops → pybind eager_op_function.cc → *_ad_func → phi::KernelFactory →
+kernel launch; SURVEY §3.1). Every framework op is defined once as a pure
+jax-traceable function; `apply` runs it eagerly (XLA compiles + caches per
+shape/dtype, playing the role of the reference's KernelKey-indexed kernel
+cache) and, when any input requires grad, records a tape Node holding the
+jax.vjp pullback — this single generic path replaces the YAML→codegen'd
+per-op forward/GradNode pairs (eager_gen.py).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from .tensor import Tensor
+from ..autograd import tape
+
+__all__ = ["apply", "defop", "unwrap", "wrap"]
+
+
+def unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def wrap(x, stop_gradient=True):
+    return Tensor(x, stop_gradient=stop_gradient)
+
+
+def apply(fn: Callable, *tensor_args, n_outs=None, name=None, **static_kwargs):
+    """Run `fn(*arrays, **static_kwargs)` eagerly with autograd recording.
+
+    tensor_args: Tensors (or array-likes) — the differentiable positional args.
+    static_kwargs: non-differentiable attrs (ints, strings, shapes...).
+    Returns Tensor or tuple of Tensors mirroring fn's output structure.
+    """
+    ts = [t if isinstance(t, Tensor) else Tensor(jnp.asarray(t)) for t in tensor_args]
+    arrays = [t._data for t in ts]
+    if static_kwargs:
+        fn_c = functools.partial(fn, **static_kwargs)
+    else:
+        fn_c = fn
+
+    needs = [
+        (not t.stop_gradient) and jnp.issubdtype(t._data.dtype, jnp.inexact)
+        for t in ts
+    ]
+    trace_grad = tape.is_grad_enabled() and any(needs)
+
+    if trace_grad:
+        out, vjp_fn = jax.vjp(fn_c, *arrays)
+    else:
+        out = fn_c(*arrays)
+
+    multi = isinstance(out, (tuple, list))
+    outs = list(out) if multi else [out]
+    out_ts = [Tensor(o) for o in outs]
+
+    if trace_grad:
+        tape.record(vjp_fn, ts, needs, out_ts, name=name or getattr(fn, "__name__", "op"))
+
+    return tuple(out_ts) if multi else out_ts[0]
+
+
+def defop(n_tensor_args=None, name=None):
+    """Decorator: turn a pure jax function into an eager framework op.
+
+    The wrapped function takes Tensors first, then static keyword attrs:
+
+        @defop()
+        def relu(x):
+            return jnp.maximum(x, 0)
+    """
+
+    def deco(fn):
+        op_name = name or fn.__name__
+
+        @functools.wraps(fn)
+        def op(*args, **kwargs):
+            return apply(fn, *args, name=op_name, **kwargs)
+
+        op._jax_fn = fn
+        return op
+
+    return deco

@@ -1,0 +1,61 @@
+"""Global RNG state.
+
+Reference keeps per-generator state (python/paddle/fluid/framework.py seed,
+mp-rank RNGStatesTracker fleet/layers/mpu/random.py:35). TPU-native design:
+a counter-split jax PRNG key stack. `next_key()` works both eagerly (concrete
+key) and inside a jit trace (a traced base key pushed by the compiler path),
+so dropout/random ops are usable under whole-graph compilation without
+baking constants.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["seed", "next_key", "get_state", "set_state", "key_scope"]
+
+
+class _RngState(threading.local):
+    def __init__(self):
+        self.stack = [jax.random.PRNGKey(0)]
+
+
+_state = _RngState()
+
+
+def seed(s: int):
+    """paddle.seed equivalent: reset the root key."""
+    _state.stack[-1] = jax.random.PRNGKey(int(s))
+    return s
+
+
+def next_key():
+    cur = _state.stack[-1]
+    new, sub = jax.random.split(cur)
+    _state.stack[-1] = new
+    return sub
+
+
+def get_state():
+    return _state.stack[-1]
+
+
+def set_state(key):
+    _state.stack[-1] = key
+
+
+class key_scope:
+    """Push a (possibly traced) base key — used by jit tracing and by the
+    mp-rank RNG tracker (parallel/random.py)."""
+
+    def __init__(self, key):
+        self._key = key
+
+    def __enter__(self):
+        _state.stack.append(self._key)
+        return self
+
+    def __exit__(self, *exc):
+        _state.stack.pop()
+        return False

@@ -1,0 +1,227 @@
+"""Eager Tensor.
+
+TPU-native analog of `paddle.Tensor` (reference: phi::DenseTensor
+paddle/phi/core/dense_tensor.h:38 + pybind eager_method.cc). The device
+buffer, layout, sharding and async execution are all delegated to a
+`jax.Array` — XLA's runtime already provides what the reference builds by
+hand in paddle/fluid/memory/ (stream-safe allocation, async dispatch) — so
+this class only adds the *framework* state: stop_gradient, .grad, the
+autograd node pointer, and the method surface.
+
+Methods are attached by `paddle_tpu.ops` at import time (same pattern as the
+reference's `python/paddle/tensor/__init__.py` monkey-patching).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .dtype import convert_dtype, is_floating_point
+
+__all__ = ["Tensor", "to_tensor"]
+
+
+class Tensor:
+    __slots__ = (
+        "_data",
+        "stop_gradient",
+        "grad",
+        "_grad_node",
+        "_out_index",
+        "name",
+        "persistable",
+        "_hooks",
+        "__weakref__",
+    )
+
+    def __init__(self, data, stop_gradient=True, name=None):
+        self._data = data  # jax.Array (or tracer under jit)
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._grad_node = None
+        self._out_index = 0
+        self.name = name
+        self.persistable = False
+        self._hooks = None
+
+    # -- basic metadata ----------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def place(self):
+        try:
+            devs = self._data.devices()
+            return next(iter(devs))
+        except Exception:
+            return None
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    def numel(self):
+        return self.size
+
+    def dim(self):
+        return self.ndim
+
+    # -- host transfer -----------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self):
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        arr = np.asarray(self._data)
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        return bool(self.item())
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self.shape[0]
+
+    # -- autograd ----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        from ..autograd.tape import backward as _backward
+
+        _backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def _accumulate_grad(self, ct):
+        if self._hooks:
+            from ..autograd.tape import no_grad
+
+            with no_grad():
+                t = Tensor(ct)
+                for hook in list(self._hooks.values()):
+                    out = hook(t)
+                    if out is not None:
+                        t = out
+                ct = t._data
+        if self.grad is None:
+            self.grad = Tensor(ct)
+        else:
+            self.grad = Tensor(self.grad._data + ct)
+
+    def clear_grad(self):
+        self.grad = None
+
+    def clear_gradient(self, set_to_zero=False):
+        if set_to_zero and self.grad is not None:
+            self.grad = Tensor(jnp.zeros_like(self._data))
+        else:
+            self.grad = None
+
+    def detach(self):
+        t = Tensor(self._data, stop_gradient=True, name=self.name)
+        return t
+
+    def register_hook(self, hook):
+        """Gradient hook (reference: egr hooks / Tensor.register_hook)."""
+        if self._hooks is None:
+            self._hooks = {}
+        hid = max(self._hooks, default=-1) + 1
+        self._hooks[hid] = hook
+
+        class _Removable:
+            def __init__(self, owner, key):
+                self._owner, self._key = owner, key
+
+            def remove(self):
+                self._owner._hooks.pop(self._key, None)
+
+        return _Removable(self, hid)
+
+    # -- mutation (eager-only; used by optimizers / Layer.to) --------------
+    def _set_data(self, arr):
+        self._data = arr
+
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            arr = value._data
+        else:
+            arr = jnp.asarray(value)
+        if tuple(arr.shape) != self.shape:
+            raise ValueError(
+                f"set_value shape mismatch: {tuple(arr.shape)} vs {self.shape}"
+            )
+        self._data = arr.astype(self.dtype)
+
+    def copy_(self, other):
+        self.set_value(other)
+        return self
+
+    # -- misc --------------------------------------------------------------
+    def clone(self):
+        from ..ops import assign
+
+        return assign(self)
+
+    def pin_memory(self):
+        return self
+
+    def cpu(self):
+        return Tensor(jax.device_get(self._data), stop_gradient=self.stop_gradient)
+
+    def block_until_ready(self):
+        jax.block_until_ready(self._data)
+        return self
+
+    def __repr__(self):
+        grad_info = "" if self.stop_gradient else ", stop_gradient=False"
+        return (
+            f"Tensor(shape={list(self.shape)}, dtype={self.dtype}{grad_info},\n"
+            f"       {np.asarray(self._data)!r})"
+        )
+
+    def __hash__(self):
+        return id(self)
+
+    # NOTE: rich comparison / arithmetic operators are attached by
+    # paddle_tpu.ops at import time.
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor equivalent."""
+    dtype = convert_dtype(dtype)
+    if isinstance(data, Tensor):
+        arr = data._data
+        if dtype is not None and arr.dtype != dtype:
+            arr = arr.astype(dtype)
+        return Tensor(arr, stop_gradient=stop_gradient)
+    if isinstance(data, (jnp.ndarray, jax.Array)):
+        arr = data
+    else:
+        arr = np.asarray(data)
+        # Follow the reference's default dtype policy: python floats → fp32.
+        if dtype is None and arr.dtype == np.float64:
+            arr = arr.astype(np.float32)
+    arr = jnp.asarray(arr, dtype=dtype)
+    return Tensor(arr, stop_gradient=stop_gradient)

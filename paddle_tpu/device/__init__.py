@@ -1,0 +1,127 @@
+"""Device management (reference: python/paddle/device/).
+
+The reference juggles Places/DeviceContexts; here devices are jax devices
+and `set_device` selects the default placement. On TPU there is no
+per-stream API to expose — XLA's async runtime owns scheduling — so the
+cuda-stream surface maps to no-ops with documented semantics.
+"""
+from __future__ import annotations
+
+import jax
+
+_current = None
+
+
+def get_all_devices():
+    return jax.devices()
+
+
+def device_count():
+    return jax.device_count()
+
+
+def local_device_count():
+    return jax.local_device_count()
+
+
+def set_device(device: str):
+    """Accepts 'tpu', 'tpu:0', 'cpu', 'gpu:0' (mapped to available backends)."""
+    global _current
+    name = device.split(":")[0]
+    idx = int(device.split(":")[1]) if ":" in device else 0
+    platforms = {d.platform for d in jax.devices()}
+    # 'gpu' requests map onto the accelerator actually present (axon/tpu).
+    if name in ("tpu", "gpu", "xpu", "npu", "mlu", "custom_cpu"):
+        accel = [d for d in jax.devices() if d.platform != "cpu"]
+        pool = accel or jax.devices()
+    elif name == "cpu":
+        try:
+            pool = jax.devices("cpu")
+        except RuntimeError:
+            pool = jax.devices()
+    else:
+        raise ValueError(f"unknown device {device!r}")
+    _current = pool[min(idx, len(pool) - 1)]
+    try:
+        jax.config.update("jax_default_device", _current)
+    except Exception:
+        pass
+    return _current
+
+
+def get_device():
+    if _current is None:
+        d = jax.devices()[0]
+    else:
+        d = _current
+    plat = "tpu" if d.platform not in ("cpu",) else "cpu"
+    return f"{plat}:{d.id}"
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_tpu():
+    return any(d.platform != "cpu" for d in jax.devices())
+
+
+def is_compiled_with_custom_device(name="tpu"):
+    return is_compiled_with_tpu()
+
+
+class Stream:
+    """API-compat stream object. XLA orders work internally; recording an
+    event maps to a `block_until_ready` fence when synchronized."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def synchronize(self):
+        synchronize()
+
+
+def synchronize(device=None):
+    """Block until all queued work is done (reference:
+    paddle.device.cuda.synchronize)."""
+    import jax.numpy as jnp
+
+    jnp.zeros(()).block_until_ready()
+
+
+cuda = type(
+    "cuda_ns",
+    (),
+    {
+        "Stream": Stream,
+        "Event": Event,
+        "synchronize": staticmethod(synchronize),
+        "device_count": staticmethod(device_count),
+        "max_memory_allocated": staticmethod(lambda device=None: _mem_stat("peak_bytes_in_use")),
+        "memory_allocated": staticmethod(lambda device=None: _mem_stat("bytes_in_use")),
+        "empty_cache": staticmethod(lambda: None),
+    },
+)()
+
+
+def _mem_stat(key):
+    try:
+        stats = jax.devices()[0].memory_stats()
+        return int(stats.get(key, 0)) if stats else 0
+    except Exception:
+        return 0

@@ -1,0 +1,89 @@
+"""paddle_tpu.distributed (reference: python/paddle/distributed/).
+
+TPU-native design (SURVEY §5.8): collectives are XLA HLO ops compiled onto
+ICI/DCN via a device Mesh — there is no NCCL, no comm-id bootstrap, no
+ProcessGroup streams. The reference's 4-axis HybridCommunicateGroup
+topology maps to named mesh axes ("dp","sharding","pp","mp" + "sp"/"ep");
+see paddle_tpu.distributed.fleet and paddle_tpu.parallel.
+
+Single-controller model: one python process drives all local chips (and
+multi-host via jax.distributed). `rank`/`world_size` therefore describe
+*data-parallel shards of the mesh*, not OS processes, except under
+multi-host launch where they are per-host.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from . import collective as _collective_mod
+from .collective import (
+    all_reduce, all_gather, all_gather_object, reduce, broadcast, scatter,
+    reduce_scatter, alltoall, alltoall_single, all_to_all, send, recv, barrier,
+    ReduceOp, new_group, get_group, wait,
+)
+from .parallel_env import (
+    init_parallel_env, get_rank, get_world_size, ParallelEnv, is_initialized,
+    destroy_process_group, parallel_mode,
+)
+from . import fleet
+from . import checkpoint
+from .launch_mod import spawn, launch
+
+__all__ = [
+    "init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
+    "is_initialized", "destroy_process_group", "all_reduce", "all_gather",
+    "all_gather_object", "reduce", "broadcast", "scatter", "reduce_scatter",
+    "alltoall", "alltoall_single", "all_to_all", "send", "recv", "barrier",
+    "ReduceOp", "new_group", "get_group", "wait", "fleet", "spawn", "launch",
+    "checkpoint", "DataParallel",
+]
+
+
+class DataParallel:
+    """Dygraph DP wrapper (reference: paddle.DataParallel →
+    EagerReducer bucketed allreduce, reducer.cc:523).
+
+    TPU-native semantics: under the compiled train step, gradients are
+    reduced by XLA (SPMD partitioner inserts the all-reduce over the 'dp'
+    axis and its latency-hiding scheduler overlaps it with the backward —
+    the role of the reducer's bucketing/overlap machinery). In pure-eager
+    multi-device mode this wrapper averages grads via psum at step
+    boundaries (see paddle_tpu.parallel.engine.DataParallelEngine).
+    """
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_layers"], name)
+
+    def __call__(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def no_sync(self):
+        from contextlib import nullcontext
+
+        return nullcontext()
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, *args, **kwargs):
+        return self._layers.set_state_dict(*args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass

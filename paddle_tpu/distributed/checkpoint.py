@@ -1,0 +1,86 @@
+"""Distributed / sharded checkpointing (reference: auto_parallel
+dist_saver.py + converter.py mesh-reshard, sharding
+save_group_sharded_model; SURVEY §5.4).
+
+TPU-native: orbax handles sharded array save/restore; restoring onto a
+different mesh reshards automatically from the on-disk global view — the
+capability the reference implements by hand in converter.py.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import jax
+
+from ..core.tensor import Tensor
+
+__all__ = ["save_state_dict", "load_state_dict", "save_sharded", "load_sharded"]
+
+
+def _ckptr():
+    import orbax.checkpoint as ocp
+
+    return ocp
+
+
+def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0):
+    """Save a (possibly sharded-array) state dict; jax.Array shardings are
+    recorded so any-mesh restore works."""
+    ocp = _ckptr()
+    path = os.path.abspath(path)
+    arrays = {
+        k: (v._data if isinstance(v, Tensor) else v) for k, v in state_dict.items()
+    }
+    ckpt = ocp.StandardCheckpointer()
+    ckpt.save(path, arrays, force=True)
+    ckpt.wait_until_finished()
+
+
+def load_state_dict(path, shardings=None, process_group=None):
+    """Restore; pass `shardings` (name → jax.sharding.Sharding or
+    ShapeDtypeStruct) to place arrays directly onto a (new) mesh."""
+    ocp = _ckptr()
+    path = os.path.abspath(path)
+    ckpt = ocp.StandardCheckpointer()
+    restored = ckpt.restore(path, target=shardings) if shardings is not None else ckpt.restore(path)
+    return {k: Tensor(v) for k, v in restored.items()}
+
+
+def save_sharded(model, optimizer, path, extra=None):
+    state = {}
+    for name, p in model.named_parameters():
+        state[f"model.{name}"] = p._data
+    for name, b in model.named_buffers():
+        state[f"buffer.{name}"] = b._data
+    if optimizer is not None:
+        names = optimizer._param_names()
+        for key, slots in optimizer._states.items():
+            for sname, arr in slots.items():
+                state[f"opt.{names[key]}.{sname}"] = arr
+        for key, arr in optimizer._master_weights.items():
+            state[f"opt.{names[key]}.master"] = arr
+    save_state_dict(state, path)
+
+
+def load_sharded(model, optimizer, path):
+    restored = load_state_dict(path)
+    pmap = dict(model.named_parameters())
+    bmap = dict(model.named_buffers())
+    opt_names = {} if optimizer is None else {v: k for k, v in optimizer._param_names().items()}
+    for k, v in restored.items():
+        arr = v._data
+        if k.startswith("model."):
+            pmap[k[len("model."):]]._data = arr
+        elif k.startswith("buffer."):
+            bmap[k[len("buffer."):]]._data = arr
+        elif k.startswith("opt.") and optimizer is not None:
+            body = k[len("opt."):]
+            pname, sname = body.rsplit(".", 1)
+            key = opt_names.get(pname)
+            if key is None:
+                continue
+            if sname == "master":
+                optimizer._master_weights[key] = arr
+            else:
+                optimizer._states.setdefault(key, {})[sname] = arr

@@ -1,0 +1,335 @@
+"""Collective communication API (reference:
+python/paddle/distributed/communication/ + collective.py — dygraph
+ProcessGroup calls / static c_* ops).
+
+TPU-native: a collective is an XLA HLO op over a named mesh axis. These
+functions are dual-mode:
+
+- inside an SPMD region (paddle_tpu.parallel shard context, where tensors
+  are per-shard views and a mesh axis name is active) they lower to
+  jax.lax.psum / all_gather / ppermute / all_to_all — compiled onto ICI;
+- outside (plain eager, single controller) they operate on the global
+  tensor, which for world_size==1 is the identity semantics the reference's
+  tests use for the trivial group.
+
+Groups are named mesh axes, not socket-bootstrapped NCCL communicators
+(device_ext.h xccl hooks have no analog here — XLA owns the transport).
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply
+
+__all__ = [
+    "ReduceOp", "Group", "new_group", "get_group", "all_reduce", "all_gather",
+    "all_gather_object", "reduce", "broadcast", "scatter", "reduce_scatter",
+    "alltoall", "alltoall_single", "all_to_all", "send", "recv", "barrier",
+    "wait", "get_backend", "p2p_permute",
+]
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+class _AxisContext(threading.local):
+    def __init__(self):
+        self.axes: List[str] = []
+
+
+_axis_ctx = _AxisContext()
+
+
+class axis_scope:
+    """Entered by paddle_tpu.parallel when running code under shard_map with
+    a live mesh axis; collective calls then lower to lax ops."""
+
+    def __init__(self, axis_name):
+        self.axis_name = axis_name
+
+    def __enter__(self):
+        _axis_ctx.axes.append(self.axis_name)
+        return self
+
+    def __exit__(self, *exc):
+        _axis_ctx.axes.pop()
+        return False
+
+
+def _current_axis():
+    return _axis_ctx.axes[-1] if _axis_ctx.axes else None
+
+
+class Group:
+    def __init__(self, rank, nranks, id=0, ranks=None, axis_name=None):
+        self.rank = rank
+        self.nranks = nranks
+        self.id = id
+        self.ranks = ranks or list(range(nranks))
+        self.axis_name = axis_name  # mesh axis this group rides on
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def __repr__(self):
+        return f"Group(rank={self.rank}, nranks={self.nranks}, axis={self.axis_name})"
+
+
+_groups = {}
+_group_counter = [0]
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis_name=None):
+    from .parallel_env import get_rank, get_world_size
+
+    if ranks is None:
+        ranks = list(range(get_world_size()))
+    _group_counter[0] += 1
+    gid = _group_counter[0]
+    my = get_rank()
+    g = Group(
+        rank=ranks.index(my) if my in ranks else -1,
+        nranks=len(ranks),
+        id=gid,
+        ranks=list(ranks),
+        axis_name=axis_name,
+    )
+    _groups[gid] = g
+    return g
+
+
+def get_group(id=0):
+    return _groups.get(id)
+
+
+def get_backend(group=None):
+    return "xla"
+
+
+def _axis_for(group):
+    if group is not None and group.axis_name is not None:
+        return group.axis_name
+    return _current_axis()
+
+
+def _world(group):
+    from .parallel_env import get_world_size
+
+    return group.nranks if group is not None else get_world_size()
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    axis = _axis_for(group)
+    if axis is not None:
+        fns = {
+            ReduceOp.SUM: jax.lax.psum,
+            ReduceOp.MAX: jax.lax.pmax,
+            ReduceOp.MIN: jax.lax.pmin,
+            ReduceOp.AVG: jax.lax.pmean,
+        }
+        out = apply(lambda a: fns[op](a, axis), tensor, name="all_reduce")
+        tensor._data = out._data
+        tensor._grad_node = out._grad_node
+        tensor._out_index = out._out_index
+        tensor.stop_gradient = tensor.stop_gradient and out.stop_gradient
+        return tensor
+    if _world(group) == 1:
+        return tensor
+    raise RuntimeError(
+        "eager cross-host all_reduce outside an SPMD region is not supported "
+        "on TPU — run inside paddle_tpu.parallel or a compiled step"
+    )
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    ax = _axis_for(group)
+    if ax is not None:
+        out = apply(
+            lambda a: jax.lax.all_gather(a, ax, tiled=False), tensor, name="all_gather"
+        )
+        n = out.shape[0]
+        from ..ops.manipulation import unbind
+
+        parts = unbind(out, 0)
+        if isinstance(tensor_list, list):
+            tensor_list.clear()
+            tensor_list.extend(parts)
+        return parts
+    if _world(group) == 1:
+        if isinstance(tensor_list, list):
+            tensor_list.clear()
+            tensor_list.append(tensor)
+        return [tensor]
+    raise RuntimeError("eager all_gather requires an SPMD region on TPU")
+
+
+def all_gather_object(object_list, obj, group=None):
+    if _world(group) == 1:
+        object_list.clear()
+        object_list.append(obj)
+        return
+    raise RuntimeError("all_gather_object requires single-host or SPMD region")
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # On a mesh, reduce == all_reduce (result replicated; dst distinction is
+    # meaningless for SPMD where every shard computes).
+    return all_reduce(tensor, op=op, group=group)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    ax = _axis_for(group)
+    if ax is not None:
+        def fn(a):
+            # select src's value on every member: gather then index (XLA
+            # lowers this to a broadcast from src over the axis)
+            gathered = jax.lax.all_gather(a, ax, tiled=False)
+            return gathered[src]
+
+        out = apply(fn, tensor, name="broadcast")
+        tensor._data = out._data
+        return tensor
+    if _world(group) == 1:
+        return tensor
+    raise RuntimeError("eager broadcast requires an SPMD region on TPU")
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if _world(group) == 1:
+        if tensor_list:
+            tensor._data = tensor_list[0]._data
+        return tensor
+    ax = _axis_for(group)
+    if ax is not None:
+        from ..ops.manipulation import stack
+
+        stacked = stack(tensor_list, 0)
+
+        def fn(a):
+            idx = jax.lax.axis_index(ax)
+            return jax.lax.dynamic_index_in_dim(a, idx, 0, keepdims=False)
+
+        out = apply(fn, stacked, name="scatter")
+        tensor._data = out._data
+        return tensor
+    raise RuntimeError("eager scatter requires an SPMD region on TPU")
+
+
+def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None, sync_op=True):
+    ax = _axis_for(group)
+    if ax is not None:
+        from ..ops.manipulation import concat
+
+        inp = concat(tensor_list, 0) if tensor_list else tensor
+
+        def fn(a):
+            return jax.lax.psum_scatter(a, ax, scatter_dimension=0, tiled=True)
+
+        out = apply(fn, inp, name="reduce_scatter")
+        tensor._data = out._data
+        return tensor
+    if _world(group) == 1:
+        if tensor_list:
+            tensor._data = tensor_list[0]._data
+        return tensor
+    raise RuntimeError("eager reduce_scatter requires an SPMD region on TPU")
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    ax = _axis_for(group)
+    if ax is not None:
+        from ..ops.manipulation import stack, unbind
+
+        stacked = stack(in_tensor_list, 0)
+        out = apply(
+            lambda a: jax.lax.all_to_all(a, ax, split_axis=0, concat_axis=0, tiled=True),
+            stacked,
+            name="alltoall",
+        )
+        parts = unbind(out, 0)
+        if isinstance(out_tensor_list, list):
+            out_tensor_list.clear()
+            out_tensor_list.extend(parts)
+        return parts
+    if _world(group) == 1:
+        if isinstance(out_tensor_list, list):
+            out_tensor_list.clear()
+            out_tensor_list.extend(in_tensor_list)
+        return list(in_tensor_list)
+    raise RuntimeError("eager alltoall requires an SPMD region on TPU")
+
+
+def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    ax = _axis_for(group)
+    if ax is not None:
+        out = apply(
+            lambda a: jax.lax.all_to_all(a, ax, split_axis=0, concat_axis=0, tiled=True),
+            in_tensor,
+            name="alltoall_single",
+        )
+        if out_tensor is not None:
+            out_tensor._data = out._data
+            return out_tensor
+        return out
+    if _world(group) == 1:
+        if out_tensor is not None:
+            out_tensor._data = in_tensor._data
+            return out_tensor
+        return in_tensor
+    raise RuntimeError("eager alltoall requires an SPMD region on TPU")
+
+
+all_to_all = alltoall
+
+
+def p2p_permute(tensor, perm, group=None):
+    """Static-permutation p2p (the SPMD form of send/recv pairs): `perm` is a
+    list of (src_rank, dst_rank) int pairs — exactly XLA collective-permute.
+    This is what pipeline-parallel stage hops compile to on ICI
+    (reference analog: send_v2/recv_v2 NCCL p2p, SURVEY §3.4)."""
+    ax = _axis_for(group)
+    if ax is None:
+        raise RuntimeError("p2p_permute requires an SPMD region (mesh axis)")
+    return apply(
+        lambda a: jax.lax.ppermute(a, ax, [(int(s), int(d)) for s, d in perm]),
+        tensor,
+        name="p2p_permute",
+    )
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "SPMD p2p is a static collective-permute: use "
+        "paddle_tpu.distributed.p2p_permute(t, perm) with explicit "
+        "(src,dst) pairs, or the pipeline schedules in paddle_tpu.parallel "
+        "which emit it for you. Per-rank imperative send/recv only exists in "
+        "multi-process runtimes (reference send_v2/recv_v2 over NCCL)."
+    )
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    send(tensor, src, group)
+
+
+def barrier(group=None):
+    jnp.zeros(()).block_until_ready()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    tensor.block_until_ready()

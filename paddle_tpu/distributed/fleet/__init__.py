@@ -1,0 +1,29 @@
+"""fleet — hybrid-parallel orchestration (reference:
+python/paddle/distributed/fleet/fleet.py:101,169,1044 + base/topology.py).
+
+TPU-native: `fleet.init` builds the 5-axis device Mesh instead of NCCL
+groups; `distributed_model`/`distributed_optimizer` return wrappers whose
+train_batch/step compile to ONE SPMD program over that mesh.
+"""
+from .base import (
+    DistributedStrategy, HybridCommunicateGroup, PaddleCloudRoleMaker,
+    UserDefinedRoleMaker,
+)
+from .fleet_api import (
+    init, distributed_model, distributed_optimizer, get_hybrid_communicate_group,
+    worker_index, worker_num, is_first_worker, barrier_worker, get_mesh,
+)
+from . import utils
+from .meta_parallel import (
+    TensorParallel, PipelineParallel, ShardingParallel, PipelineLayer, LayerDesc,
+    SharedLayerDesc,
+)
+
+__all__ = [
+    "init", "distributed_model", "distributed_optimizer",
+    "get_hybrid_communicate_group", "DistributedStrategy",
+    "HybridCommunicateGroup", "worker_index", "worker_num", "is_first_worker",
+    "barrier_worker", "utils", "TensorParallel", "PipelineParallel",
+    "ShardingParallel", "PipelineLayer", "LayerDesc", "SharedLayerDesc",
+    "get_mesh",
+]

@@ -1,0 +1,174 @@
+"""fleet base: DistributedStrategy + topology (reference:
+fleet/base/distributed_strategy.py:111 ⇄ distributed_strategy.proto,
+base/topology.py:56 CommunicateTopology/HybridCommunicateGroup)."""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+from ...parallel.mesh import get_mesh, axis_size
+from ..collective import Group, new_group
+
+__all__ = [
+    "DistributedStrategy", "HybridCommunicateGroup", "PaddleCloudRoleMaker",
+    "UserDefinedRoleMaker",
+]
+
+
+class DistributedStrategy:
+    """Strategy knobs (the subset of the reference's 243-field proto that is
+    meaningful on TPU; accelerator-specific fields like nccl_comm_num are
+    accepted and ignored for script compatibility)."""
+
+    def __init__(self):
+        self.hybrid_configs: Dict = {
+            "dp_degree": 1,
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sharding_degree": 1,
+            "sp_degree": 1,
+        }
+        self.pipeline_configs: Dict = {"accumulate_steps": 1, "micro_batch_size": 1}
+        self.sharding_configs: Dict = {"stage": 1, "offload": False}
+        self.amp = False
+        self.amp_configs: Dict = {}
+        self.recompute = False
+        self.recompute_configs: Dict = {}
+        self.gradient_merge = False
+        self.gradient_merge_configs: Dict = {"k_steps": 1}
+        self.lamb = False
+        self.lars = False
+        self.dgc = False
+        self.localsgd = False
+        self.heter_ccl_mode = False
+        self.find_unused_parameters = False
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1
+        self.tensor_parallel_configs: Dict = {}
+        self.gradient_scale_configs: Dict = {"scale_strategy": "avg"}
+        self.without_graph_optimization = False
+
+    def __setattr__(self, k, v):
+        object.__setattr__(self, k, v)
+
+    def __repr__(self):
+        return f"DistributedStrategy(hybrid={self.hybrid_configs})"
+
+
+class HybridCommunicateGroup:
+    """Axis-group view of the mesh (reference: topology.py:139).
+
+    rank/group queries answer in terms of the CURRENT process's position:
+    with the single-controller TPU runtime every axis is local, so the
+    "rank in group" notion maps to shard indices used by samplers and
+    per-stage logic.
+    """
+
+    def __init__(self, strategy: DistributedStrategy):
+        hc = strategy.hybrid_configs
+        self._dp_degree = hc.get("dp_degree", 1)
+        self._mp_degree = hc.get("mp_degree", 1)
+        self._pp_degree = hc.get("pp_degree", 1)
+        self._sharding_degree = hc.get("sharding_degree", 1)
+        self._sp_degree = hc.get("sp_degree", 1)
+        self.nranks = (
+            self._dp_degree * self._mp_degree * self._pp_degree
+            * self._sharding_degree * self._sp_degree
+        )
+        self.global_rank = 0
+        self._dp_group = new_group(list(range(self._dp_degree)), axis_name="dp")
+        self._mp_group = new_group(list(range(self._mp_degree)), axis_name="mp")
+        self._pp_group = new_group(list(range(self._pp_degree)), axis_name="pp")
+        self._sharding_group = new_group(list(range(self._sharding_degree)), axis_name="sharding")
+        self._sp_group = new_group(list(range(self._sp_degree)), axis_name="sp")
+
+    # topology info
+    def get_hybrid_group_names(self):
+        return ["data", "sharding", "pipe", "sep", "model"]
+
+    def get_dp_parallel_rank(self):
+        return 0
+
+    def get_mp_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_pp_parallel_rank(self):
+        return 0
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    def get_global_rank(self):
+        return self.global_rank
+
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sep_parallel_world_size(self):
+        return self._sp_degree
+
+    def get_data_parallel_group(self):
+        return self._dp_group
+
+    def get_model_parallel_group(self):
+        return self._mp_group
+
+    def get_pipe_parallel_group(self):
+        return self._pp_group
+
+    def get_sharding_parallel_group(self):
+        return self._sharding_group
+
+    def get_sep_parallel_group(self):
+        return self._sp_group
+
+    def get_check_parallel_group(self, *a, **k):
+        return self._mp_group
+
+    def get_data_parallel_group_src_rank(self):
+        return 0
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    def is_first_stage(self):
+        return True
+
+    def is_last_stage(self):
+        return True
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return stage_id
+
+
+class PaddleCloudRoleMaker:
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = is_collective
+
+    def worker_index(self):
+        return int(os.environ.get("PADDLE_TRAINER_ID", 0))
+
+    def worker_num(self):
+        return int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    pass

@@ -1,0 +1,84 @@
+"""fleet user API (reference: fleet/fleet.py:101 init, :169/model.py:30
+distributed_model, :1044 distributed_optimizer)."""
+from __future__ import annotations
+
+from typing import Optional
+
+from ...parallel.mesh import init_mesh, get_mesh as _get_mesh
+from .base import DistributedStrategy, HybridCommunicateGroup, PaddleCloudRoleMaker
+from .meta_parallel import TensorParallel, PipelineParallel, ShardingParallel, PipelineLayer
+from .hybrid_optimizer import HybridParallelOptimizer
+
+__all__ = [
+    "init", "distributed_model", "distributed_optimizer",
+    "get_hybrid_communicate_group", "worker_index", "worker_num",
+    "is_first_worker", "barrier_worker", "get_mesh",
+]
+
+_fleet_state = {"strategy": None, "hcg": None, "initialized": False}
+
+
+def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+    strategy = strategy or DistributedStrategy()
+    hc = strategy.hybrid_configs
+    init_mesh(
+        dp=hc.get("dp_degree", 1),
+        mp=hc.get("mp_degree", 1),
+        pp=hc.get("pp_degree", 1),
+        sharding=hc.get("sharding_degree", 1),
+        sp=hc.get("sp_degree", 1),
+    )
+    _fleet_state["strategy"] = strategy
+    _fleet_state["hcg"] = HybridCommunicateGroup(strategy)
+    _fleet_state["initialized"] = True
+    return None
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _fleet_state["hcg"]
+
+
+def get_mesh():
+    return _get_mesh()
+
+
+def _strategy() -> DistributedStrategy:
+    return _fleet_state["strategy"] or DistributedStrategy()
+
+
+def distributed_model(model):
+    """Wrap per active strategy (reference fleet/model.py:30 chooses
+    PipelineParallel | TensorParallel | ShardingParallel | DataParallel)."""
+    strategy = _strategy()
+    hc = strategy.hybrid_configs
+    if isinstance(model, PipelineLayer) or hc.get("pp_degree", 1) > 1:
+        return PipelineParallel(model, strategy=strategy)
+    if hc.get("mp_degree", 1) > 1:
+        return TensorParallel(model, strategy=strategy)
+    if hc.get("sharding_degree", 1) > 1:
+        return ShardingParallel(model, strategy=strategy)
+    from .. import DataParallel
+
+    return DataParallel(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return HybridParallelOptimizer(optimizer, _fleet_state["hcg"], strategy or _strategy())
+
+
+def worker_index():
+    return PaddleCloudRoleMaker().worker_index()
+
+
+def worker_num():
+    return PaddleCloudRoleMaker().worker_num()
+
+
+def is_first_worker():
+    return worker_index() == 0
+
+
+def barrier_worker():
+    from ..collective import barrier
+
+    barrier()

@@ -1,0 +1,124 @@
+"""HybridParallelOptimizer (reference:
+fleet/meta_parallel/dygraph_optimizer/hybrid_parallel_optimizer.py:186 —
+cross-group grad clip + mp/pp grad sync + inner optimizer).
+
+TPU-native: grad synchronization is GSPMD's job inside the compiled step;
+what remains is (1) ZeRO weight-update sharding of optimizer slots along the
+'sharding' axis and (2) API parity. Slot sharding: each optimizer state
+array is placed with its parameter's sharding PLUS the 'sharding' axis on
+the first divisible dim — the XLA-side formulation of ZeRO stage-1 (the
+reference's DygraphShardingOptimizer partitions the param list by rank
+instead; same memory effect, no gather/release hooks needed).
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ...parallel.mesh import get_mesh, axis_size
+from ...parallel.api import param_sharding
+
+__all__ = ["HybridParallelOptimizer", "DygraphShardingOptimizer"]
+
+
+def _shard_slot_sharding(param, mesh):
+    """Sharding for an optimizer slot of `param`: param's own spec with the
+    'sharding' axis prepended on the first dim it divides and that isn't
+    already sharded."""
+    base = getattr(param, "_sharding_axes", None) or (None,) * len(param.shape)
+    deg = axis_size("sharding")
+    spec = list(base)
+    if deg > 1:
+        for i, (dim, ax) in enumerate(zip(param.shape, base)):
+            if ax is None and dim % deg == 0:
+                spec[i] = "sharding"
+                break
+            if isinstance(ax, str) and dim % (deg * axis_size(ax)) == 0:
+                spec[i] = (ax, "sharding")
+                break
+    cleaned = [
+        None if a is None else a
+        for a in spec
+    ]
+    return NamedSharding(mesh, PartitionSpec(*cleaned))
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+        self._placed = False
+
+    def _place_states(self):
+        """Device_put params + slots with their SPMD shardings (ZeRO stage-1
+        weight-update sharding included)."""
+        mesh = get_mesh()
+        opt = self._inner_opt
+        for p in opt._parameter_list:
+            try:
+                p._data = jax.device_put(p._data, param_sharding(p))
+            except Exception:
+                pass
+            opt._ensure_state(p)
+            slot_sh = _shard_slot_sharding(p, mesh)
+            key = id(p)
+            for sname, arr in opt._states[key].items():
+                try:
+                    opt._states[key][sname] = jax.device_put(arr, slot_sh)
+                except Exception:
+                    pass
+            if key in opt._master_weights:
+                try:
+                    opt._master_weights[key] = jax.device_put(
+                        opt._master_weights[key], slot_sh
+                    )
+                except Exception:
+                    pass
+        self._placed = True
+
+    def step(self):
+        if not self._placed:
+            self._place_states()
+        self._inner_opt.step()
+
+    def clear_grad(self, *a, **k):
+        self._inner_opt.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, **kwargs):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, state):
+        return self._inner_opt.set_state_dict(state)
+
+    def get_lr(self):
+        return self._inner_opt.get_lr()
+
+    def set_lr(self, v):
+        return self._inner_opt.set_lr(v)
+
+    @property
+    def _learning_rate(self):
+        return self._inner_opt._learning_rate
+
+    @property
+    def _parameter_list(self):
+        return self._inner_opt._parameter_list
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_inner_opt"], name)
+
+
+class DygraphShardingOptimizer(HybridParallelOptimizer):
+    """ZeRO stage-1 API name parity (reference:
+    dygraph_optimizer/dygraph_sharding_optimizer.py:29)."""
+
+    def __init__(self, optimizer, hcg=None, strategy=None, **kwargs):
+        super().__init__(optimizer, hcg, strategy)

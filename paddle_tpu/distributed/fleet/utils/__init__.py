@@ -1,0 +1,11 @@
+"""fleet.utils (reference: fleet/utils/ — recompute, hybrid_parallel_util)."""
+from .recompute import recompute, recompute_sequential
+
+__all__ = ["recompute", "recompute_sequential", "fused_allreduce_gradients"]
+
+
+def fused_allreduce_gradients(parameter_list, hcg=None):
+    """Reference: fleet/utils/hybrid_parallel_util.py:206 — fused dp-group
+    allreduce of grads. Under SPMD compilation XLA already reduced them;
+    eager single-process is a no-op. Kept for script parity."""
+    return None

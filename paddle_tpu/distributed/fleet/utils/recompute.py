@@ -1,0 +1,68 @@
+"""Activation recompute (reference: fleet/recompute/recompute.py — PyLayer
+that RNG-checkpoints and re-runs forward in backward).
+
+TPU-native: jax.checkpoint (rematerialization) on the pure forward — the
+compiler re-forms the forward inside the backward, with RNG handled by the
+counter-split key (deterministic replay by construction).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor
+from ....core.dispatch import apply
+from ....core import random as _rng
+from ....autograd import tape
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def recompute(function, *args, **kwargs):
+    preserve_rng_state = kwargs.pop("preserve_rng_state", True)
+    use_reentrant = kwargs.pop("use_reentrant", True)
+
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    other_args = [(i, a) for i, a in enumerate(args) if not isinstance(a, Tensor)]
+    t_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    base_key = _rng.next_key() if preserve_rng_state else _rng.get_state()
+
+    def pure(*arrays):
+        rebuilt = list(args)
+        for i, arr in zip(t_idx, arrays):
+            rebuilt[i] = Tensor(arr)
+        with _rng.key_scope(base_key):
+            with tape.no_grad():
+                out = function(*rebuilt, **kwargs)
+        if isinstance(out, (tuple, list)):
+            return tuple(o._data if isinstance(o, Tensor) else o for o in out)
+        return out._data if isinstance(out, Tensor) else out
+
+    ckpt = jax.checkpoint(pure)
+    return apply(ckpt, *tensor_args, name="recompute")
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    if not isinstance(functions, (list, tuple)):
+        functions = list(functions)
+    n = len(functions)
+    seg_size = max(n // max(segments, 1), 1)
+    x = args[0] if len(args) == 1 else args
+
+    def run_segment(fs):
+        def seg_fn(inp):
+            out = inp
+            for f in fs:
+                out = f(out)
+            return out
+
+        return seg_fn
+
+    out = x
+    i = 0
+    while i < n:
+        fs = functions[i : i + seg_size]
+        out = recompute(run_segment(fs), out)
+        i += seg_size
+    return out

@@ -1,0 +1,71 @@
+"""Process launch (reference: python/paddle/distributed/launch/main.py:18 —
+per-GPU process spawn + KV-store rendezvous; spawn.py).
+
+TPU-native: a single controller process drives all local chips, so
+single-host "launch" is just running the script. Multi-host TPU pods run one
+process per host; `launch` starts them with PADDLE_* env set so
+init_parallel_env wires jax.distributed. Elastic/etcd modes are
+reference capabilities carried by the ElasticManager analog in
+paddle_tpu.distributed.elastic (later round on real multi-host).
+"""
+from __future__ import annotations
+
+import os
+import runpy
+import subprocess
+import sys
+
+__all__ = ["launch", "spawn", "run_commandline"]
+
+
+def spawn(func, args=(), nprocs=1, join=True, daemon=False, **options):
+    """Reference API parity. On TPU a single process owns every local chip,
+    so nprocs>1 local spawn is a CPU-emulation/debug path: we run
+    sequentially with PADDLE_TRAINER_ID set (parity tests use world_size 1
+    semantics; real scale-out is multi-host `launch`)."""
+    if nprocs in (1, -1, None):
+        os.environ.setdefault("PADDLE_TRAINER_ID", "0")
+        os.environ.setdefault("PADDLE_TRAINERS_NUM", "1")
+        func(*args)
+        return
+    raise NotImplementedError(
+        "local multi-process spawn has no TPU analog (one controller drives "
+        "all chips); use the Mesh APIs (paddle_tpu.parallel) for multi-chip "
+        "and distributed.launch for multi-host"
+    )
+
+
+def launch(training_script, args=(), hosts=None, nproc_per_node=1, master=None):
+    """Start one worker per host (DCN scale-out bring-up)."""
+    if not hosts or len(hosts) <= 1:
+        env = dict(os.environ, PADDLE_TRAINER_ID="0", PADDLE_TRAINERS_NUM="1")
+        return subprocess.call([sys.executable, training_script, *args], env=env)
+    procs = []
+    master = master or hosts[0]
+    for i, h in enumerate(hosts):
+        env = dict(
+            os.environ,
+            PADDLE_TRAINER_ID=str(i),
+            PADDLE_TRAINERS_NUM=str(len(hosts)),
+            PADDLE_MASTER=master,
+        )
+        cmd = ["ssh", h, sys.executable, training_script, *args] if h != "localhost" else [sys.executable, training_script, *args]
+        procs.append(subprocess.Popen(cmd, env=env))
+    rc = 0
+    for p in procs:
+        rc |= p.wait()
+    return rc
+
+
+def run_commandline():
+    """`python -m paddle_tpu.distributed.launch script.py` entry."""
+    argv = sys.argv[1:]
+    if not argv:
+        print("usage: python -m paddle_tpu.distributed.launch script.py [args...]")
+        return 1
+    script, *rest = argv
+    sys.argv = [script, *rest]
+    os.environ.setdefault("PADDLE_TRAINER_ID", "0")
+    os.environ.setdefault("PADDLE_TRAINERS_NUM", "1")
+    runpy.run_path(script, run_name="__main__")
+    return 0

@@ -1,0 +1,82 @@
+"""Bootstrap / environment (reference: python/paddle/distributed/parallel.py:108
+init_parallel_env — TCPStore + ProcessGroup creation).
+
+TPU-native: jax.distributed.initialize handles multi-host rendezvous via the
+coordinator address (the TCPStore analog lives inside the JAX runtime);
+single-host multi-chip needs no bootstrap at all.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+_initialized = False
+
+
+class ParallelEnv:
+    def __init__(self):
+        self.rank = get_rank()
+        self.world_size = get_world_size()
+        self.device_id = int(os.environ.get("FLAGS_selected_tpus", "0").split(",")[0] or 0)
+
+    @property
+    def local_rank(self):
+        return self.rank
+
+    @property
+    def nranks(self):
+        return self.world_size
+
+    @property
+    def dev_id(self):
+        return self.device_id
+
+
+def get_rank(group=None):
+    if group is not None:
+        return group.rank
+    return int(os.environ.get("PADDLE_TRAINER_ID", jax.process_index()))
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    env = os.environ.get("PADDLE_TRAINERS_NUM")
+    if env is not None:
+        return int(env)
+    return jax.process_count()
+
+
+def is_initialized():
+    return _initialized
+
+
+def init_parallel_env():
+    """Multi-host: initialize the jax distributed runtime from launch env
+    vars (PADDLE_* set by paddle_tpu.distributed.launch or user env).
+    Single-host: records initialization; all chips are already visible."""
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    coord = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
+    nproc = os.environ.get("PADDLE_TRAINERS_NUM")
+    if coord and nproc and int(nproc) > 1:
+        port = os.environ.get("MASTER_PORT", "8476")
+        addr = coord if ":" in coord else f"{coord}:{port}"
+        jax.distributed.initialize(
+            coordinator_address=addr,
+            num_processes=int(nproc),
+            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
+        )
+    _initialized = True
+    return ParallelEnv()
+
+
+def destroy_process_group(group=None):
+    global _initialized
+    _initialized = False
+
+
+def parallel_mode():
+    return _initialized

@@ -1,0 +1,21 @@
+"""Framework-level state (reference: python/paddle/framework/)."""
+from .core_ import (
+    set_default_dtype,
+    get_default_dtype,
+    set_flags,
+    get_flags,
+    get_rng_state,
+    set_rng_state,
+)
+from .io_ import save, load
+
+__all__ = [
+    "set_default_dtype",
+    "get_default_dtype",
+    "set_flags",
+    "get_flags",
+    "save",
+    "load",
+    "get_rng_state",
+    "set_rng_state",
+]

@@ -1,0 +1,77 @@
+"""Global framework state: default dtype + flags.
+
+Reference: gflags-based FLAGS_* registry (paddle/phi/core/flags.cc, 87
+exported flags; python paddle.set_flags via
+pybind/global_value_getter_setter.cc). TPU-native: a plain validated dict —
+flags that controlled CUDA allocator/cudnn behavior have no analog (XLA owns
+them); the surviving ones gate framework behavior (nan/inf checks, deterministic
+ops, log level).
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+from ..core.dtype import convert_dtype
+
+_state = threading.local()
+
+_DEFAULT_FLAGS = {
+    "FLAGS_check_nan_inf": False,
+    "FLAGS_cudnn_deterministic": False,  # kept for API compat; maps to XLA determinism
+    "FLAGS_embedding_deterministic": False,
+    "FLAGS_use_autotune": True,
+    "FLAGS_allocator_strategy": "xla",  # informational on TPU
+    "FLAGS_log_level": int(os.environ.get("PTPU_LOG_LEVEL", "0")),
+}
+
+_flags = dict(_DEFAULT_FLAGS)
+for _k in list(_flags):
+    if _k in os.environ:
+        _v = os.environ[_k]
+        _flags[_k] = type(_DEFAULT_FLAGS[_k])(
+            _v if not isinstance(_DEFAULT_FLAGS[_k], bool) else _v not in ("0", "false", "False")
+        )
+
+_default_dtype = np.dtype("float32")
+
+
+def set_default_dtype(dtype):
+    global _default_dtype
+    d = convert_dtype(dtype)
+    if d not in (np.dtype("float32"), np.dtype("float64"), np.dtype("float16"), convert_dtype("bfloat16")):
+        raise TypeError(f"default dtype must be floating, got {dtype}")
+    _default_dtype = d
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def set_flags(flags: dict):
+    for k, v in flags.items():
+        _flags[k] = v
+
+
+def get_flags(keys):
+    if isinstance(keys, str):
+        keys = [keys]
+    return {k: _flags.get(k) for k in keys}
+
+
+def get_flag(key, default=None):
+    return _flags.get(key, default)
+
+
+def get_rng_state():
+    from ..core import random as _r
+
+    return _r.get_state()
+
+
+def set_rng_state(state):
+    from ..core import random as _r
+
+    _r.set_state(state)

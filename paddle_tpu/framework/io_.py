@@ -1,0 +1,67 @@
+"""Serialization: paddle.save / paddle.load equivalent.
+
+Reference: python/paddle/framework/io.py:637,879 — pickled nested structures
+of tensors. TPU-native format: np.savez-compatible pickle of nested dicts
+with numpy leaves (bfloat16 stored via ml_dtypes views so round-trip is
+exact). Sharded / mesh-reshardable checkpoints live in
+paddle_tpu.distributed.checkpoint (orbax-backed).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+_MAGIC = b"PTPU1"
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        arr = np.asarray(obj._data)
+        if arr.dtype == jnp.bfloat16.dtype:
+            return {"__tensor_bf16__": arr.view(np.uint16)}
+        return {"__tensor__": arr}
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        packed = [_pack(v) for v in obj]
+        return packed if isinstance(obj, list) else {"__tuple__": packed}
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, dict):
+        if "__tensor__" in obj and len(obj) == 1:
+            arr = obj["__tensor__"]
+            return arr if return_numpy else Tensor(jnp.asarray(arr))
+        if "__tensor_bf16__" in obj and len(obj) == 1:
+            arr = obj["__tensor_bf16__"].view(jnp.bfloat16.dtype)
+            return np.asarray(arr) if return_numpy else Tensor(jnp.asarray(arr))
+        if "__tuple__" in obj and len(obj) == 1:
+            return tuple(_unpack(v, return_numpy) for v in obj["__tuple__"])
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_unpack(v, return_numpy) for v in obj]
+    return obj
+
+
+def save(obj, path, protocol=4):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **kwargs):
+    with open(path, "rb") as f:
+        magic = f.read(len(_MAGIC))
+        if magic != _MAGIC:
+            f.seek(0)
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy=return_numpy)

@@ -1,0 +1,6 @@
+"""paddle_tpu.incubate (reference: python/paddle/incubate/ — fused layers,
+MoE, autograd functional; populated across rounds)."""
+from . import nn
+from . import autograd
+
+__all__ = ["nn", "autograd"]

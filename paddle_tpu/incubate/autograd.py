@@ -1,0 +1,8 @@
+"""incubate.autograd (reference: python/paddle/incubate/autograd — prim
+vjp/jvp API). TPU-native: jax transforms ARE the primitive system."""
+from ..autograd.functional import vjp, jvp, jacobian, hessian
+
+Jacobian = jacobian
+Hessian = hessian
+
+__all__ = ["vjp", "jvp", "jacobian", "hessian", "Jacobian", "Hessian"]

@@ -1,0 +1,82 @@
+"""incubate.nn fused layers (reference: python/paddle/incubate/nn/ —
+FusedMultiHeadAttention, FusedFeedForward backed by fused_attention_op.cu /
+fused_feedforward_op.cu). TPU-native: flash attention (Pallas) + XLA-fused
+FFN."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...nn.layer import Layer
+from ...nn.common import Linear, Dropout
+from ...nn.norm import LayerNorm
+from ...nn import functional as F
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward"]
+
+
+class FusedMultiHeadAttention(Layer):
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False, qkv_weight_attr=None,
+                 qkv_bias_attr=None, linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None, ln_scale_attr=None,
+                 ln_bias_attr=None, epsilon=1e-5, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.qkv = Linear(embed_dim, 3 * embed_dim, qkv_weight_attr, qkv_bias_attr)
+        self.out_proj = Linear(embed_dim, embed_dim, linear_weight_attr, linear_bias_attr)
+        self.pre_ln = LayerNorm(embed_dim, epsilon)
+        self.post_ln = LayerNorm(embed_dim, epsilon)
+        self.attn_dropout_rate = attn_dropout_rate
+        self.dropout = Dropout(dropout_rate)
+
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        from ...ops.manipulation import reshape, split
+
+        residual = query
+        x = self.pre_ln(query) if self.normalize_before else query
+        b, s, _ = x.shape
+        qkv = self.qkv(x)
+        q, k, v = split(qkv, 3, axis=-1)
+        q = reshape(q, [b, s, self.num_heads, self.head_dim])
+        k = reshape(k, [b, s, self.num_heads, self.head_dim])
+        v = reshape(v, [b, s, self.num_heads, self.head_dim])
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, dropout_p=self.attn_dropout_rate,
+            training=self.training,
+        )
+        out = reshape(out, [b, s, self.embed_dim])
+        out = self.dropout(self.out_proj(out))
+        out = residual + out
+        if not self.normalize_before:
+            out = self.post_ln(out)
+        return out
+
+
+class FusedFeedForward(Layer):
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1, epsilon=1e-5,
+                 activation="relu", act_dropout_rate=None, normalize_before=False,
+                 linear1_weight_attr=None, linear1_bias_attr=None,
+                 linear2_weight_attr=None, linear2_bias_attr=None,
+                 ln1_scale_attr=None, ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.linear1 = Linear(d_model, dim_feedforward, linear1_weight_attr, linear1_bias_attr)
+        self.linear2 = Linear(dim_feedforward, d_model, linear2_weight_attr, linear2_bias_attr)
+        self.ln = LayerNorm(d_model, epsilon)
+        self.dropout1 = Dropout(act_dropout_rate if act_dropout_rate is not None else dropout_rate)
+        self.dropout2 = Dropout(dropout_rate)
+        self.activation = activation
+
+    def forward(self, src, cache=None):
+        residual = src
+        x = self.ln(src) if self.normalize_before else src
+        x = self.linear2(self.dropout1(getattr(F, self.activation)(self.linear1(x))))
+        x = residual + self.dropout2(x)
+        if not self.normalize_before:
+            x = self.ln(x)
+        return x

@@ -1,0 +1,344 @@
+"""Whole-graph compilation (reference: paddle.jit.to_static,
+python/paddle/jit/api.py:222 + dy2static/program_translator.py).
+
+TPU-native re-design: the reference needs ~20 AST transformers to lift
+dygraph python into a ProgramDesc. Here the eager engine itself is
+jax-traceable — ops dispatch to pure jax functions, autograd records vjp
+closures, the optimizer update is a pure pytree function — so "to static"
+is simply: run the SAME eager python under a jax trace with all framework
+state (params, buffers, optimizer slots, RNG key, lr) lifted to function
+inputs/outputs. One XLA program per (input shapes) — the analog of the
+reference's PartialProgramLayer + InterpreterCore, with buffer donation
+standing in for its memory-reuse passes.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import random as _rng
+from ..autograd import tape
+from ..nn.layer import Layer
+
+__all__ = ["to_static", "compile", "CompiledFunction", "save", "load", "TranslatedLayer", "not_to_static", "ignore_module"]
+
+
+def _collect_layers(args) -> List[Layer]:
+    out = []
+    for a in args:
+        if isinstance(a, Layer):
+            out.append(a)
+    return out
+
+
+class _StateSpec:
+    """All mutable framework state a compiled program threads through
+    (the analog of the reference Program's persistable vars)."""
+
+    def __init__(self, models=(), optimizers=()):
+        self.models = list(models)
+        self.optimizers = list(optimizers)
+
+    def slots(self):
+        """list of (name, get_fn, set_fn) for every mutable array slot."""
+        out = []
+        for mi, m in enumerate(self.models):
+            for name, p in m.named_parameters():
+                out.append((f"m{mi}.{name}", p))
+            for name, b in m.named_buffers():
+                out.append((f"m{mi}.buf.{name}", b))
+        for oi, opt in enumerate(self.optimizers):
+            # Ensure slot accumulators exist before tracing (concrete zeros).
+            for p in opt._parameter_list:
+                opt._ensure_state(p)
+            for key, slot_dict in opt._states.items():
+                for sname in slot_dict:
+                    out.append((f"o{oi}.{key}.{sname}", (opt, key, sname)))
+            for key in opt._master_weights:
+                out.append((f"o{oi}.{key}.master", (opt, key, "__master__")))
+        return out
+
+    def read(self):
+        vals = []
+        for name, slot in self.slots():
+            if isinstance(slot, Tensor):
+                vals.append(slot._data)
+            else:
+                opt, key, sname = slot
+                if sname == "__master__":
+                    vals.append(opt._master_weights[key])
+                else:
+                    vals.append(opt._states[key][sname])
+        return vals
+
+    def write(self, vals):
+        for (name, slot), v in zip(self.slots(), vals):
+            if isinstance(slot, Tensor):
+                slot._data = v
+            else:
+                opt, key, sname = slot
+                if sname == "__master__":
+                    opt._master_weights[key] = v
+                else:
+                    opt._states[key][sname] = v
+
+
+def _tree_to_arrays(obj):
+    if isinstance(obj, Tensor):
+        return obj._data
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_tree_to_arrays(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _tree_to_arrays(v) for k, v in obj.items()}
+    return obj
+
+
+def _tree_to_tensors(obj):
+    if isinstance(obj, (jnp.ndarray, jax.Array)) or hasattr(obj, "aval"):
+        return Tensor(obj)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_tree_to_tensors(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _tree_to_tensors(v) for k, v in obj.items()}
+    return obj
+
+
+def _wrap_inputs(obj):
+    """arrays → Tensors for feeding the python fn during trace."""
+    return _tree_to_tensors(obj)
+
+
+class CompiledFunction:
+    """A compiled (and state-threading) callable.
+
+    in_shardings/out state handling:
+      state_in  = current framework state arrays (donated)
+      host_in   = per-call host scalars (lr, step) per optimizer
+      key       = RNG key (split per call)
+    """
+
+    def __init__(self, fn, models=(), optimizers=(), donate=True,
+                 train=True, sharding_fn=None, static_argnums=()):
+        self._fn = fn
+        self._spec = _StateSpec(models, optimizers)
+        self._donate = donate
+        self._train = train
+        self._sharding_fn = sharding_fn
+        self._compiled = None
+        self._last_lowered = None
+
+    def _build(self):
+        spec = self._spec
+        fn = self._fn
+        train = self._train
+
+        def pure(state_vals, host_vals, key, args, kwargs):
+            spec_slots_backup = spec.read()
+            overrides = []
+            try:
+                spec.write(state_vals)
+                for oi, opt in enumerate(spec.optimizers):
+                    opt._lr_override = host_vals[2 * oi]
+                    opt._step_override = host_vals[2 * oi + 1]
+                    overrides.append(opt)
+                with _rng.key_scope(key):
+                    with tape.enable_grad() if train else tape.no_grad():
+                        t_args = _wrap_inputs(args)
+                        t_kwargs = _wrap_inputs(kwargs)
+                        out = fn(*t_args, **t_kwargs)
+                new_state = spec.read()
+                out_arrays = _tree_to_arrays(out)
+                return out_arrays, new_state
+            finally:
+                for opt in overrides:
+                    opt._lr_override = None
+                    opt._step_override = None
+                spec.write(spec_slots_backup)
+
+        donate = (0,) if self._donate else ()
+        self._compiled = jax.jit(pure, donate_argnums=donate)
+
+    def __call__(self, *args, **kwargs):
+        if self._compiled is None:
+            self._build()
+        state_vals = self._spec.read()
+        host_vals = []
+        for opt in self._spec.optimizers:
+            opt._step_count += 1
+            host_vals.append(jnp.asarray(opt.get_lr(), jnp.float32))
+            host_vals.append(jnp.asarray(opt._step_count, jnp.int32))
+        key = _rng.next_key()
+        a_args = _tree_to_arrays(args)
+        a_kwargs = _tree_to_arrays(kwargs)
+        out_arrays, new_state = self._compiled(state_vals, host_vals, key, a_args, a_kwargs)
+        self._spec.write(new_state)
+        # clear stale grads: the compiled step owns the whole update
+        for opt in self._spec.optimizers:
+            for p in opt._parameter_list:
+                p.grad = None
+        return _tree_to_tensors(out_arrays)
+
+    # -- introspection/AOT -------------------------------------------------
+    def lower(self, *args, **kwargs):
+        if self._compiled is None:
+            self._build()
+        state_vals = self._spec.read()
+        host_vals = []
+        for opt in self._spec.optimizers:
+            host_vals.append(jnp.asarray(opt.get_lr(), jnp.float32))
+            host_vals.append(jnp.asarray(opt._step_count, jnp.int32))
+        key = _rng.get_state()
+        return self._compiled.lower(
+            state_vals, host_vals, key, _tree_to_arrays(args), _tree_to_arrays(kwargs)
+        )
+
+
+def compile(fn=None, models=(), optimizers=(), donate=True, train=True):
+    """Compile a whole train/eval step. The blessed TPU path:
+
+        step = paddle_tpu.jit.compile(train_step, models=[model], optimizers=[opt])
+        loss = step(x, y)          # ONE XLA program: fwd+bwd+optimizer
+    """
+    if fn is None:
+        return functools.partial(compile, models=models, optimizers=optimizers,
+                                 donate=donate, train=train)
+    if isinstance(models, Layer):
+        models = [models]
+    return CompiledFunction(fn, models, optimizers, donate, train)
+
+
+class StaticFunction:
+    """to_static-wrapped Layer.forward (inference/forward-only compile;
+    caches one executable per input signature like the reference's
+    StaticFunction per-input-spec cache)."""
+
+    def __init__(self, layer_or_fn, input_spec=None):
+        if isinstance(layer_or_fn, Layer):
+            self._layer = layer_or_fn
+            self._fn = layer_or_fn.forward
+        else:
+            self._layer = None
+            self._fn = layer_or_fn
+        self._input_spec = input_spec
+        self._compiled = None
+
+    def _models(self):
+        return [self._layer] if self._layer is not None else []
+
+    def __call__(self, *args, **kwargs):
+        if self._compiled is None:
+            self._compiled = CompiledFunction(
+                self._fn, models=self._models(), optimizers=(),
+                donate=False, train=False,
+            )
+        return self._compiled(*args, **kwargs)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):
+    """Decorator/wrapper: compile a Layer's forward (or a function) into one
+    XLA program. For full train-step compilation (fwd+bwd+opt) use
+    paddle_tpu.jit.compile."""
+
+    def decorate(obj):
+        if isinstance(obj, Layer):
+            st = StaticFunction(obj, input_spec)
+            obj._static_forward = st
+            obj.forward_original = obj.forward
+            # route __call__ through the compiled path
+            obj.forward = lambda *a, **kw: st(*a, **kw)
+            return obj
+        return StaticFunction(obj, input_spec)
+
+    if function is None:
+        return decorate
+    return decorate(function)
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+def ignore_module(modules):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# AOT save/load (reference: jit.save → TranslatedLayer + AnalysisPredictor)
+# ---------------------------------------------------------------------------
+
+def save(layer, path, input_spec=None, **config):
+    """Serialize a Layer's forward as a portable XLA AOT artifact
+    (jax.export StableHLO bytes) + weights. Reference analog:
+    paddle.jit.save producing model+pdiparams loadable by inference
+    (SURVEY §3.6)."""
+    import pickle
+    from jax import export as jax_export
+
+    if input_spec is None:
+        raise ValueError("input_spec (example Tensors or ShapeDtype tuples) required")
+    example = []
+    for s in input_spec:
+        if isinstance(s, Tensor):
+            example.append(jax.ShapeDtypeStruct(s.shape, s.dtype))
+        elif isinstance(s, (tuple, list)):
+            shape, dtype = s
+            example.append(jax.ShapeDtypeStruct(tuple(shape), np.dtype(dtype)))
+        else:
+            example.append(s)
+
+    params, bufs = layer.state_arrays()
+    layer.eval()
+
+    def fwd(params, bufs, *xs):
+        backup_p, backup_b = layer.state_arrays()
+        try:
+            layer.load_state_arrays(params, bufs)
+            with tape.no_grad():
+                out = layer(*[Tensor(x) for x in xs])
+            return _tree_to_arrays(out)
+        finally:
+            layer.load_state_arrays(backup_p, backup_b)
+
+    jitted = jax.jit(fwd)
+    exported = jax_export.export(jitted)(params, bufs, *example)
+    blob = {
+        "stablehlo": exported.serialize(),
+        "params": {k: np.asarray(v) for k, v in params.items()},
+        "buffers": {k: np.asarray(v) for k, v in bufs.items()},
+    }
+    with open(path + ".ptpu" if not path.endswith(".ptpu") else path, "wb") as f:
+        pickle.dump(blob, f, protocol=4)
+
+
+class TranslatedLayer(Layer):
+    """Deserialized AOT program (reference: jit/translated_layer.py)."""
+
+    def __init__(self, exported, params, buffers):
+        super().__init__()
+        self._exported = exported
+        self._params_np = params
+        self._buffers_np = buffers
+
+    def forward(self, *xs):
+        arrs = [x._data if isinstance(x, Tensor) else jnp.asarray(x) for x in xs]
+        params = {k: jnp.asarray(v) for k, v in self._params_np.items()}
+        bufs = {k: jnp.asarray(v) for k, v in self._buffers_np.items()}
+        out = self._exported.call(params, bufs, *arrs)
+        return _tree_to_tensors(out)
+
+
+def load(path, **config):
+    import pickle
+    from jax import export as jax_export
+
+    fname = path + ".ptpu" if not path.endswith(".ptpu") else path
+    with open(fname, "rb") as f:
+        blob = pickle.load(f)
+    exported = jax_export.deserialize(blob["stablehlo"])
+    return TranslatedLayer(exported, blob["params"], blob["buffers"])

@@ -1,0 +1,186 @@
+"""paddle.linalg equivalent (reference: python/paddle/tensor/linalg.py —
+cusolver/lapack kernels replaced by XLA's decompositions)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .core.tensor import Tensor
+from .core.dispatch import apply
+
+__all__ = [
+    "matmul", "norm", "cond", "det", "slogdet", "inv", "pinv", "solve",
+    "cholesky", "cholesky_solve", "triangular_solve", "qr", "svd", "eig",
+    "eigh", "eigvals", "eigvalsh", "matrix_power", "matrix_rank", "lstsq",
+    "lu", "multi_dot", "corrcoef", "cov", "householder_product",
+]
+
+from .ops.math import matmul  # noqa: F401
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def fn(a):
+        if p is None or p == "fro":
+            if axis is None:
+                return jnp.sqrt(jnp.sum(jnp.square(a)))
+            return jnp.linalg.norm(a, ord=None, axis=_ax(axis), keepdims=keepdim)
+        if p == float("inf") or p == float("-inf") or isinstance(p, (int, float)):
+            if axis is None:
+                flat = a.reshape(-1)
+                return jnp.linalg.norm(flat, ord=p, keepdims=False)
+            return jnp.linalg.norm(a, ord=p, axis=_ax(axis), keepdims=keepdim)
+        return jnp.linalg.norm(a, ord=p, axis=_ax(axis), keepdims=keepdim)
+
+    return apply(fn, x, name="norm")
+
+
+def _ax(axis):
+    if isinstance(axis, (list, tuple)):
+        return tuple(axis)
+    return axis
+
+
+def cond(x, p=None, name=None):
+    return Tensor(jnp.linalg.cond(x._data, p=p))
+
+
+def det(x, name=None):
+    return apply(jnp.linalg.det, x, name="det")
+
+
+def slogdet(x, name=None):
+    def fn(a):
+        sign, logabs = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logabs])
+
+    return apply(fn, x, name="slogdet")
+
+
+def inv(x, name=None):
+    return apply(jnp.linalg.inv, x, name="inv")
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply(
+        lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), x, name="pinv"
+    )
+
+
+def solve(x, y, name=None):
+    return apply(jnp.linalg.solve, x, y, name="solve")
+
+
+def cholesky(x, upper=False, name=None):
+    def fn(a):
+        l = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+
+    return apply(fn, x, name="cholesky")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def fn(b, l):
+        lm = jnp.swapaxes(l, -1, -2) if upper else l
+        z = jax.scipy.linalg.solve_triangular(lm, b, lower=True)
+        return jax.scipy.linalg.solve_triangular(jnp.swapaxes(lm, -1, -2), z, lower=False)
+
+    return apply(fn, x, y, name="cholesky_solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def fn(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular,
+        )
+
+    return apply(fn, x, y, name="triangular_solve")
+
+
+def qr(x, mode="reduced", name=None):
+    def fn(a):
+        q, r = jnp.linalg.qr(a, mode=mode)
+        return q, r
+
+    return apply(fn, x, name="qr")
+
+
+def svd(x, full_matrices=False, name=None):
+    def fn(a):
+        u, s, vh = jnp.linalg.svd(a, full_matrices=full_matrices)
+        return u, s, jnp.swapaxes(vh, -1, -2)
+
+    return apply(fn, x, name="svd")
+
+
+def eig(x, name=None):
+    import numpy as np
+
+    a = np.asarray(x._data)
+    w, v = np.linalg.eig(a)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L", name=None):
+    def fn(a):
+        w, v = jnp.linalg.eigh(a, UPLO=UPLO)
+        return w, v
+
+    return apply(fn, x, name="eigh")
+
+
+def eigvals(x, name=None):
+    import numpy as np
+
+    return Tensor(jnp.asarray(np.linalg.eigvals(np.asarray(x._data))))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply(lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), x, name="eigvalsh")
+
+
+def matrix_power(x, n, name=None):
+    return apply(lambda a: jnp.linalg.matrix_power(a, n), x, name="matrix_power")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return Tensor(jnp.linalg.matrix_rank(x._data, rtol=tol))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x._data, y._data, rcond=rcond)
+    return Tensor(sol), Tensor(res), Tensor(rank), Tensor(sv)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    lu_, piv = jax.scipy.linalg.lu_factor(x._data)
+    outs = (Tensor(lu_), Tensor(piv.astype(jnp.int32) + 1))
+    if get_infos:
+        return outs + (Tensor(jnp.zeros((), jnp.int32)),)
+    return outs
+
+
+def multi_dot(tensors, name=None):
+    return apply(lambda *ts: jnp.linalg.multi_dot(ts), *tensors, name="multi_dot")
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply(lambda a: jnp.corrcoef(a, rowvar=rowvar), x, name="corrcoef")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply(
+        lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0), x, name="cov"
+    )
+
+
+def householder_product(x, tau, name=None):
+    def fn(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        q = jnp.eye(m, dtype=a.dtype)
+        for i in range(n):
+            v = jnp.concatenate([jnp.zeros(i, a.dtype), jnp.ones(1, a.dtype), a[i + 1:, i]])
+            q = q - t[i] * (q @ v[:, None]) @ v[None, :]
+        return q[:, :n]
+
+    return apply(fn, x, tau, name="householder_product")

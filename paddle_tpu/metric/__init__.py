@@ -1,0 +1,164 @@
+"""Metrics (reference: python/paddle/metric/metrics.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]
+
+
+class Metric:
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        return self.__class__.__name__.lower()
+
+    def compute(self, *args):
+        return args
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name=None):
+        self.topk = topk if isinstance(topk, (list, tuple)) else (topk,)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def compute(self, pred, label, *args):
+        pred_np = np.asarray(pred._data) if isinstance(pred, Tensor) else np.asarray(pred)
+        label_np = np.asarray(label._data) if isinstance(label, Tensor) else np.asarray(label)
+        if label_np.ndim == pred_np.ndim:
+            label_np = label_np.squeeze(-1)
+        topk_idx = np.argsort(-pred_np, axis=-1)[..., : self.maxk]
+        correct = topk_idx == label_np[..., None]
+        return Tensor(__import__("jax.numpy", fromlist=["asarray"]).asarray(correct))
+
+    def update(self, correct, *args):
+        c = np.asarray(correct._data) if isinstance(correct, Tensor) else np.asarray(correct)
+        n = c.shape[0] if c.ndim else 1
+        accs = []
+        for i, k in enumerate(self.topk):
+            num = float(c[..., :k].sum())
+            self.total[i] += num
+            self.count[i] += n
+            accs.append(num / max(n, 1))
+        return accs[0] if len(accs) == 1 else accs
+
+    def accumulate(self):
+        res = [t / max(c, 1) for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        return self._name
+
+
+class Precision(Metric):
+    def __init__(self, name=None):
+        self._name = name or "precision"
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        p = np.asarray(preds._data if isinstance(preds, Tensor) else preds)
+        l = np.asarray(labels._data if isinstance(labels, Tensor) else labels)
+        pred_cls = (p > 0.5).astype(np.int64).reshape(-1)
+        l = l.reshape(-1)
+        self.tp += int(((pred_cls == 1) & (l == 1)).sum())
+        self.fp += int(((pred_cls == 1) & (l == 0)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    def __init__(self, name=None):
+        self._name = name or "recall"
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        p = np.asarray(preds._data if isinstance(preds, Tensor) else preds)
+        l = np.asarray(labels._data if isinstance(labels, Tensor) else labels)
+        pred_cls = (p > 0.5).astype(np.int64).reshape(-1)
+        l = l.reshape(-1)
+        self.tp += int(((pred_cls == 1) & (l == 1)).sum())
+        self.fn += int(((pred_cls == 0) & (l == 1)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    def __init__(self, curve="ROC", num_thresholds=4095, name=None):
+        self._name = name or "auc"
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1)
+        self._stat_neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        p = np.asarray(preds._data if isinstance(preds, Tensor) else preds)
+        l = np.asarray(labels._data if isinstance(labels, Tensor) else labels).reshape(-1)
+        if p.ndim == 2:
+            p = p[:, 1]
+        idx = np.minimum((p * self.num_thresholds).astype(np.int64), self.num_thresholds)
+        for i, lab in zip(idx, l):
+            if lab:
+                self._stat_pos[i] += 1
+            else:
+                self._stat_neg[i] += 1
+
+    def accumulate(self):
+        tot_pos = self._stat_pos.sum()
+        tot_neg = self._stat_neg.sum()
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        # trapezoid over thresholds from high to low
+        tp = np.cumsum(self._stat_pos[::-1])
+        fp = np.cumsum(self._stat_neg[::-1])
+        tpr = tp / tot_pos
+        fpr = fp / tot_neg
+        return float(np.trapz(tpr, fpr))
+
+    def name(self):
+        return self._name
+
+
+def accuracy(input, label, k=1):
+    import jax.numpy as jnp
+
+    p = input._data
+    l = label._data
+    if l.ndim == p.ndim:
+        l = l.squeeze(-1)
+    topk = jnp.argsort(-p, axis=-1)[..., :k]
+    correct = jnp.any(topk == l[..., None], axis=-1)
+    return Tensor(jnp.mean(correct.astype(jnp.float32)))

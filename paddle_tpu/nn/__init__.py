@@ -1,0 +1,29 @@
+"""paddle_tpu.nn — layer library (reference: python/paddle/nn/)."""
+from .layer import Layer, Parameter, ParamAttr
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .activation import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+from .container import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .transformer import *  # noqa: F401,F403
+from .rnn import *  # noqa: F401,F403
+from . import functional
+from . import initializer
+from .utils_ import clip_grad_norm_, clip_grad_value_, parameters_to_vector, vector_to_parameters
+
+from . import common, conv, norm, activation, pooling, container, loss, transformer, rnn
+
+__all__ = (
+    ["Layer", "Parameter", "ParamAttr", "functional", "initializer"]
+    + list(common.__all__)
+    + list(conv.__all__)
+    + list(norm.__all__)
+    + list(activation.__all__)
+    + list(pooling.__all__)
+    + list(container.__all__)
+    + list(loss.__all__)
+    + list(transformer.__all__)
+    + list(rnn.__all__)
+)

@@ -1,0 +1,80 @@
+"""Activation layers (reference: python/paddle/nn/layer/activation.py)."""
+from __future__ import annotations
+
+from .layer import Layer
+from . import functional as F
+from .initializer import Constant
+
+__all__ = [
+    "ReLU", "ReLU6", "GELU", "Sigmoid", "Tanh", "Softmax", "LogSoftmax",
+    "LeakyReLU", "ELU", "SELU", "CELU", "Silu", "Swish", "Mish", "Hardswish",
+    "Hardsigmoid", "Hardtanh", "Hardshrink", "Softshrink", "Tanhshrink",
+    "Softplus", "Softsign", "PReLU", "RReLU", "GLU", "Maxout",
+    "ThresholdedReLU", "LogSigmoid",
+]
+
+
+def _simple(fn_name, **fixed):
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            self._kwargs = {**fixed}
+            # positional args map onto the functional's signature in order
+            import inspect
+
+            fn = getattr(F, fn_name)
+            sig = list(inspect.signature(fn).parameters)[1:]
+            for name, val in zip(sig, args):
+                self._kwargs[name] = val
+            for k, v in kwargs.items():
+                if k != "name":
+                    self._kwargs[k] = v
+
+        def forward(self, x):
+            return getattr(F, fn_name)(x, **self._kwargs)
+
+    _Act.__name__ = fn_name
+    return _Act
+
+
+ReLU = _simple("relu")
+ReLU6 = _simple("relu6")
+GELU = _simple("gelu")
+Sigmoid = _simple("sigmoid")
+Tanh = _simple("tanh")
+Softmax = _simple("softmax")
+LogSoftmax = _simple("log_softmax")
+LeakyReLU = _simple("leaky_relu")
+ELU = _simple("elu")
+SELU = _simple("selu")
+CELU = _simple("celu")
+Silu = _simple("silu")
+Swish = _simple("swish")
+Mish = _simple("mish")
+Hardswish = _simple("hardswish")
+Hardsigmoid = _simple("hardsigmoid")
+Hardtanh = _simple("hardtanh")
+Hardshrink = _simple("hardshrink")
+Softshrink = _simple("softshrink")
+Tanhshrink = _simple("tanhshrink")
+Softplus = _simple("softplus")
+Softsign = _simple("softsign")
+RReLU = _simple("rrelu")
+GLU = _simple("glu")
+Maxout = _simple("maxout")
+ThresholdedReLU = _simple("thresholded_relu")
+LogSigmoid = _simple("log_sigmoid")
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            shape=[num_parameters], attr=weight_attr,
+            default_initializer=Constant(init),
+        )
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, data_format=self._data_format)

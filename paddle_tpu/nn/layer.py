@@ -1,0 +1,409 @@
+"""Layer base class (reference: paddle.nn.Layer,
+python/paddle/fluid/dygraph/layers.py — hooks, state_dict, sublayers, to()).
+
+Design note: parameters are eager Tensors (jax.Array-backed). The whole
+layer tree is also viewable as a pytree of arrays (`state_arrays`), which is
+what the jit/distributed paths capture for whole-graph compilation — the
+eager object tree and the functional pytree are two views of one state.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dtype import convert_dtype, is_floating_point
+from ..core import random as _rng
+from ..framework.core_ import get_default_dtype
+from .initializer import XavierNormal, Constant, Initializer
+
+__all__ = ["Layer", "Parameter", "ParamAttr"]
+
+
+class Parameter(Tensor):
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "is_distributed", "_sharding_axes")
+
+    def __init__(self, data, trainable=True, name=None):
+        super().__init__(data, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.persistable = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.is_distributed = False
+        # Per-axis logical mesh axes for SPMD placement (parallel/ fills this).
+        self._sharding_axes = None
+
+    def __repr__(self):
+        return (
+            f"Parameter(name={self.name}, shape={list(self.shape)}, "
+            f"dtype={self.dtype}, trainable={self.trainable})\n"
+            f"       {np.asarray(self._data)!r}"
+        )
+
+
+class ParamAttr:
+    """Mirror of paddle.ParamAttr (subset: name / initializer / lr / trainable)."""
+
+    def __init__(
+        self,
+        name=None,
+        initializer=None,
+        learning_rate=1.0,
+        regularizer=None,
+        trainable=True,
+        need_clip=True,
+    ):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if isinstance(attr, Initializer):
+            return ParamAttr(initializer=attr)
+        if attr is False:
+            return False
+        raise TypeError(f"invalid ParamAttr: {attr!r}")
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks, hook_id):
+        self._hooks = hooks
+        self._hook_id = hook_id
+
+    def remove(self):
+        self._hooks.pop(self._hook_id, None)
+
+
+_name_counter = collections.defaultdict(int)
+
+
+def _unique_name(prefix):
+    n = _name_counter[prefix]
+    _name_counter[prefix] += 1
+    return f"{prefix}_{n}"
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self._parameters: Dict[str, Parameter] = collections.OrderedDict()
+        self._sub_layers: Dict[str, "Layer"] = collections.OrderedDict()
+        self._buffers: Dict[str, Tensor] = collections.OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self.training = True
+        self._dtype = convert_dtype(dtype) or get_default_dtype()
+        self._full_name = _unique_name(
+            name_scope or self.__class__.__name__.lower()
+        )
+        self._forward_pre_hooks: Dict[int, Callable] = collections.OrderedDict()
+        self._forward_post_hooks: Dict[int, Callable] = collections.OrderedDict()
+        self._casted_dtype = None
+
+    # -- construction ------------------------------------------------------
+    def create_parameter(
+        self,
+        shape,
+        attr=None,
+        dtype=None,
+        is_bias=False,
+        default_initializer=None,
+    ):
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        dtype = convert_dtype(dtype) or self._dtype
+        init = attr.initializer or default_initializer or (
+            Constant(0.0) if is_bias else XavierNormal()
+        )
+        data = init(tuple(int(s) for s in shape), dtype)
+        p = Parameter(data, trainable=attr.trainable, name=attr.name or _unique_name(self._full_name + ".w"))
+        p.optimize_attr["learning_rate"] = attr.learning_rate
+        p.regularizer = attr.regularizer
+        return p
+
+    def add_parameter(self, name, parameter):
+        if parameter is not None and not isinstance(parameter, Parameter):
+            raise TypeError("add_parameter expects a Parameter")
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    # -- attribute magic ---------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ first")
+            params[name] = value
+            for d in (layers, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            object.__setattr__(self, name, value)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call Layer.__init__ first")
+            layers[name] = value
+            object.__setattr__(self, name, value)
+        else:
+            if params is not None and name in params and value is None:
+                params[name] = None
+            if buffers is not None and name in buffers and isinstance(value, Tensor):
+                buffers[name] = value
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        # only called when normal lookup fails
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{self.__class__.__name__}' object has no attribute '{name}'"
+        )
+
+    # -- traversal ---------------------------------------------------------
+    def named_sublayers(self, prefix="", include_self=False, layers_set=None):
+        if layers_set is None:
+            layers_set = set()
+        if include_self and id(self) not in layers_set:
+            layers_set.add(id(self))
+            yield prefix, self
+        for name, layer in self._sub_layers.items():
+            if layer is None:
+                continue
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield from layer.named_sublayers(
+                prefix=sub_prefix, include_self=True, layers_set=layers_set
+            )
+
+    def sublayers(self, include_self=False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def children(self):
+        return iter(l for l in self._sub_layers.values() if l is not None)
+
+    def named_children(self):
+        return iter(
+            (n, l) for n, l in self._sub_layers.items() if l is not None
+        )
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        seen = set()
+        stack = [(prefix, self)]
+        visited = set()
+
+        def walk(pfx, layer):
+            if id(layer) in visited:
+                return
+            visited.add(id(layer))
+            for name, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (f"{pfx}.{name}" if pfx else name), p
+            if include_sublayers:
+                for name, sub in layer._sub_layers.items():
+                    if sub is None:
+                        continue
+                    sub_pfx = f"{pfx}.{name}" if pfx else name
+                    yield from walk(sub_pfx, sub)
+
+        yield from walk(prefix, self)
+
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def _named_buffers_with_owner(self, prefix="", include_sublayers=True):
+        visited = set()
+
+        def walk(pfx, layer):
+            if id(layer) in visited:
+                return
+            visited.add(id(layer))
+            for name, b in layer._buffers.items():
+                if b is None:
+                    continue
+                yield (f"{pfx}.{name}" if pfx else name), b, layer, name
+            if include_sublayers:
+                for name, sub in layer._sub_layers.items():
+                    if sub is None:
+                        continue
+                    yield from walk(f"{pfx}.{name}" if pfx else name, sub)
+
+        yield from walk(prefix, self)
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        for full, b, _, _ in self._named_buffers_with_owner(prefix, include_sublayers):
+            yield full, b
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    # -- modes -------------------------------------------------------------
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    def apply(self, fn):
+        for l in self.sublayers(include_self=True):
+            fn(l)
+        return self
+
+    # -- state dict --------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True, use_hook=True):
+        dest = destination if destination is not None else collections.OrderedDict()
+        for name, p in self.named_parameters(include_sublayers=include_sublayers):
+            dest[name] = p
+        for name, b, owner, leaf in self._named_buffers_with_owner(
+            include_sublayers=include_sublayers
+        ):
+            # persistability is per owning layer (a sublayer's transient
+            # buffer must not leak into checkpoints)
+            if leaf in owner._non_persistable_buffer_names:
+                continue
+            dest[name] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for name, t in own.items():
+            if name in state_dict:
+                v = state_dict[name]
+                arr = v._data if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+                if tuple(arr.shape) != t.shape:
+                    raise ValueError(
+                        f"shape mismatch for {name}: ckpt {tuple(arr.shape)} vs model {t.shape}"
+                    )
+                # copy — never alias the source's buffer (a compiled step may
+                # donate this model's state arrays; aliasing would invalidate
+                # the checkpoint donor's tensors)
+                t._data = jnp.array(arr, dtype=t.dtype, copy=True)
+            else:
+                missing.append(name)
+        for k in state_dict:
+            if k not in own:
+                unexpected.append(k)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # -- dtype / device ----------------------------------------------------
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            dt = convert_dtype(dtype)
+            for t in list(self.parameters()) + list(self.buffers()):
+                if is_floating_point(t.dtype):
+                    t._data = t._data.astype(dt)
+            for l in self.sublayers(include_self=True):
+                l._dtype = dt
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    def half(self):
+        return self.to(dtype="float16")
+
+    # -- hooks -------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        hid = len(self._forward_pre_hooks)
+        self._forward_pre_hooks[hid] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, hid)
+
+    def register_forward_post_hook(self, hook):
+        hid = len(self._forward_post_hooks)
+        self._forward_post_hooks[hid] = hook
+        return HookRemoveHelper(self._forward_post_hooks, hid)
+
+    # -- call --------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            res = hook(self, inputs, outputs)
+            if res is not None:
+                outputs = res
+        return outputs
+
+    # -- misc --------------------------------------------------------------
+    def full_name(self):
+        return self._full_name
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = [self.__class__.__name__ + "(" + extra]
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).split("\n")
+            lines.append(f"  ({name}): " + sub_repr[0])
+            lines.extend("  " + l for l in sub_repr[1:])
+        lines.append(")")
+        return "\n".join(lines) if len(lines) > 2 else lines[0] + ")"
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    # -- pytree view for jit / SPMD ---------------------------------------
+    def state_arrays(self) -> Tuple[Dict[str, "jnp.ndarray"], Dict[str, "jnp.ndarray"]]:
+        """(params, buffers) as flat name→array dicts — the functional view
+        captured by paddle_tpu.jit and the parallel engine."""
+        params = {n: p._data for n, p in self.named_parameters()}
+        bufs = {n: b._data for n, b in self.named_buffers()}
+        return params, bufs
+
+    def load_state_arrays(self, params=None, buffers=None):
+        if params:
+            lookup = dict(self.named_parameters())
+            for n, a in params.items():
+                lookup[n]._data = a
+        if buffers:
+            lookup = dict(self.named_buffers())
+            for n, a in buffers.items():
+                lookup[n]._data = a

@@ -1,0 +1,355 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py).
+
+TPU-native: the time loop is a `jax.lax.scan` inside ONE eager op, so the
+whole sequence compiles to a single XLA while-loop (the reference runs a
+python loop over cudnn cell kernels; scan is the compiler-friendly form).
+Layout: batch-first [B, T, C] by default, matching the reference.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply
+from .layer import Layer
+from .initializer import Uniform
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "SimpleRNN", "LSTM", "GRU", "RNN", "BiRNN"]
+
+
+def _std_uniform(hidden):
+    k = 1.0 / math.sqrt(hidden)
+    return Uniform(-k, k)
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch, hidden_size):
+        from ..ops.creation import zeros
+
+        return zeros([batch, hidden_size])
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        init = _std_uniform(hidden_size)
+        self.weight_ih = self.create_parameter([hidden_size, input_size], weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size], weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([hidden_size], bias_ih_attr, is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([hidden_size], bias_hh_attr, is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs.shape[0], self.hidden_size)
+        act = jnp.tanh if self.activation == "tanh" else (lambda a: jnp.maximum(a, 0))
+
+        def fn(x, h, wi, wh, bi, bh):
+            out = act(x @ wi.T + bi + h @ wh.T + bh)
+            return out
+
+        h = apply(fn, inputs, states, self.weight_ih, self.weight_hh,
+                  self.bias_ih, self.bias_hh, name="rnn_cell")
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        init = _std_uniform(hidden_size)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size], weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size], weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([4 * hidden_size], bias_ih_attr, is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([4 * hidden_size], bias_hh_attr, is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs.shape[0], self.hidden_size)
+            c = self.get_initial_states(inputs.shape[0], self.hidden_size)
+        else:
+            h, c = states
+
+        def fn(x, h0, c0, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + h0 @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c1 = f * c0 + i * g
+            h1 = o * jnp.tanh(c1)
+            return h1, c1
+
+        h1, c1 = apply(fn, inputs, h, c, self.weight_ih, self.weight_hh,
+                       self.bias_ih, self.bias_hh, name="lstm_cell")
+        return h1, (h1, c1)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        init = _std_uniform(hidden_size)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size], weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size], weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([3 * hidden_size], bias_ih_attr, is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([3 * hidden_size], bias_hh_attr, is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs.shape[0], self.hidden_size)
+
+        def fn(x, h0, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h0 @ wh.T + bh
+            ir, iz, ic = jnp.split(gi, 3, axis=-1)
+            hr, hz, hc = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            c = jnp.tanh(ic + r * hc)
+            return (1 - z) * c + z * h0
+
+        h = apply(fn, inputs, states, self.weight_ih, self.weight_hh,
+                  self.bias_ih, self.bias_hh, name="gru_cell")
+        return h, h
+
+
+def _lstm_scan(x, h0, c0, wi, wh, bi, bh, reverse=False):
+    # x: [B,T,I] → outputs [B,T,H]
+    xs = jnp.swapaxes(x, 0, 1)  # [T,B,I]
+    if reverse:
+        xs = jnp.flip(xs, 0)
+
+    def step(carry, xt):
+        h, c = carry
+        gates = xt @ wi.T + bi + h @ wh.T + bh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c1 = f * c + i * g
+        h1 = o * jnp.tanh(c1)
+        return (h1, c1), h1
+
+    (hT, cT), ys = jax.lax.scan(step, (h0, c0), xs)
+    if reverse:
+        ys = jnp.flip(ys, 0)
+    return jnp.swapaxes(ys, 0, 1), hT, cT
+
+
+def _gru_scan(x, h0, wi, wh, bi, bh, reverse=False):
+    xs = jnp.swapaxes(x, 0, 1)
+    if reverse:
+        xs = jnp.flip(xs, 0)
+
+    def step(h, xt):
+        gi = xt @ wi.T + bi
+        gh = h @ wh.T + bh
+        ir, iz, ic = jnp.split(gi, 3, axis=-1)
+        hr, hz, hc = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(ir + hr)
+        z = jax.nn.sigmoid(iz + hz)
+        c = jnp.tanh(ic + r * hc)
+        h1 = (1 - z) * c + z * h
+        return h1, h1
+
+    hT, ys = jax.lax.scan(step, h0, xs)
+    if reverse:
+        ys = jnp.flip(ys, 0)
+    return jnp.swapaxes(ys, 0, 1), hT
+
+
+def _rnn_scan(x, h0, wi, wh, bi, bh, activation="tanh", reverse=False):
+    xs = jnp.swapaxes(x, 0, 1)
+    if reverse:
+        xs = jnp.flip(xs, 0)
+    act = jnp.tanh if activation == "tanh" else (lambda a: jnp.maximum(a, 0))
+
+    def step(h, xt):
+        h1 = act(xt @ wi.T + bi + h @ wh.T + bh)
+        return h1, h1
+
+    hT, ys = jax.lax.scan(step, h0, xs)
+    if reverse:
+        ys = jnp.flip(ys, 0)
+    return jnp.swapaxes(ys, 0, 1), hT
+
+
+class _RNNBase(Layer):
+    MODE = "lstm"
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        self.bidirect = 2 if direction in ("bidirect", "bidirectional") else 1
+        gate_mult = {"lstm": 4, "gru": 3, "rnn": 1}[self.MODE]
+        init = _std_uniform(hidden_size)
+        self._all_weights = []
+        for layer in range(num_layers):
+            for d in range(self.bidirect):
+                in_sz = input_size if layer == 0 else hidden_size * self.bidirect
+                suffix = f"_reverse" if d == 1 else ""
+                wi = self.create_parameter([gate_mult * hidden_size, in_sz], weight_ih_attr, default_initializer=init)
+                wh = self.create_parameter([gate_mult * hidden_size, hidden_size], weight_hh_attr, default_initializer=init)
+                bi = self.create_parameter([gate_mult * hidden_size], bias_ih_attr, is_bias=True, default_initializer=init)
+                bh = self.create_parameter([gate_mult * hidden_size], bias_hh_attr, is_bias=True, default_initializer=init)
+                self.add_parameter(f"weight_ih_l{layer}{suffix}", wi)
+                self.add_parameter(f"weight_hh_l{layer}{suffix}", wh)
+                self.add_parameter(f"bias_ih_l{layer}{suffix}", bi)
+                self.add_parameter(f"bias_hh_l{layer}{suffix}", bh)
+                self._all_weights.append((wi, wh, bi, bh))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ..ops.creation import zeros
+        from ..ops.manipulation import stack
+
+        x = inputs
+        if self.time_major:
+            from ..ops.manipulation import transpose
+
+            x = transpose(x, [1, 0, 2])
+        b = x.shape[0]
+        nstate = self.num_layers * self.bidirect
+        if initial_states is None:
+            if self.MODE == "lstm":
+                h0 = zeros([nstate, b, self.hidden_size])
+                c0 = zeros([nstate, b, self.hidden_size])
+                initial_states = (h0, c0)
+            else:
+                initial_states = zeros([nstate, b, self.hidden_size])
+
+        mode = self.MODE
+        activation = self.activation
+
+        if mode == "lstm":
+            h0_t, c0_t = initial_states
+        else:
+            h0_t = initial_states
+            c0_t = None
+
+        # one eager op for the whole (multi-layer, bidirectional) RNN
+        weights_flat = [w for tup in self._all_weights for w in tup]
+        num_layers, bidirect, hidden = self.num_layers, self.bidirect, self.hidden_size
+        dropout = self.dropout if self.training else 0.0
+        drop_keys = None
+        if dropout > 0 and num_layers > 1:
+            from ..core import random as _rng
+
+            drop_keys = [_rng.next_key() for _ in range(num_layers - 1)]
+
+        def fn(xa, h0a, *rest):
+            if mode == "lstm":
+                c0a = rest[0]
+                ws = rest[1:]
+            else:
+                c0a = None
+                ws = rest
+            out = xa
+            hTs, cTs = [], []
+            for layer in range(num_layers):
+                outs_d = []
+                for d in range(bidirect):
+                    sidx = layer * bidirect + d
+                    wi, wh, bi, bh = ws[4 * sidx : 4 * sidx + 4]
+                    rev = d == 1
+                    if mode == "lstm":
+                        y, hT, cT = _lstm_scan(out, h0a[sidx], c0a[sidx], wi, wh, bi, bh, rev)
+                        cTs.append(cT)
+                    elif mode == "gru":
+                        y, hT = _gru_scan(out, h0a[sidx], wi, wh, bi, bh, rev)
+                    else:
+                        y, hT = _rnn_scan(out, h0a[sidx], wi, wh, bi, bh, activation, rev)
+                    outs_d.append(y)
+                    hTs.append(hT)
+                out = outs_d[0] if bidirect == 1 else jnp.concatenate(outs_d, axis=-1)
+                if drop_keys is not None and layer < num_layers - 1:
+                    keep = jax.random.bernoulli(drop_keys[layer], 1 - dropout, out.shape)
+                    out = jnp.where(keep, out / (1 - dropout), 0.0).astype(out.dtype)
+            hN = jnp.stack(hTs, 0)
+            if mode == "lstm":
+                return out, hN, jnp.stack(cTs, 0)
+            return out, hN
+
+        if mode == "lstm":
+            out, hN, cN = apply(fn, x, h0_t, c0_t, *weights_flat, name=mode)
+            final = (hN, cN)
+        else:
+            out, hN = apply(fn, x, h0_t, *weights_flat, name=mode)
+            final = hN
+        if self.time_major:
+            from ..ops.manipulation import transpose
+
+            out = transpose(out, [1, 0, 2])
+        return out, final
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "rnn"
+
+
+class LSTM(_RNNBase):
+    MODE = "lstm"
+
+
+class GRU(_RNNBase):
+    MODE = "gru"
+
+
+class RNN(Layer):
+    """Wraps a cell into a scan over time (reference: paddle.nn.RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ..ops.manipulation import stack, transpose
+
+        x = inputs
+        if self.time_major:
+            x = transpose(x, [1, 0, 2])
+        T = x.shape[1]
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        states = initial_states
+        outs = [None] * T
+        for t in steps:
+            y, states = self.cell(x[:, t], states)
+            outs[t] = y
+        out = stack(outs, axis=1)
+        if self.time_major:
+            out = transpose(out, [1, 0, 2])
+        return out, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ..ops.manipulation import concat
+
+        s_fw, s_bw = (initial_states if initial_states is not None else (None, None))
+        out_fw, st_fw = self.rnn_fw(inputs, s_fw)
+        out_bw, st_bw = self.rnn_bw(inputs, s_bw)
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)

@@ -1,0 +1,208 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py,
+random.py — lowered here directly to jnp/jax.random instead of phi kernels).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, to_tensor
+from ..core.dtype import convert_dtype
+from ..core import random as _random
+
+__all__ = [
+    "zeros",
+    "ones",
+    "full",
+    "empty",
+    "zeros_like",
+    "ones_like",
+    "full_like",
+    "empty_like",
+    "arange",
+    "linspace",
+    "eye",
+    "diag",
+    "tril",
+    "triu",
+    "rand",
+    "randn",
+    "randint",
+    "uniform",
+    "normal",
+    "randperm",
+    "bernoulli",
+    "multinomial",
+    "assign",
+    "clone",
+    "meshgrid",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def zeros(shape, dtype="float32"):
+    return Tensor(jnp.zeros(_shape(shape), convert_dtype(dtype)))
+
+
+def ones(shape, dtype="float32"):
+    return Tensor(jnp.ones(_shape(shape), convert_dtype(dtype)))
+
+
+def full(shape, fill_value, dtype="float32"):
+    return Tensor(jnp.full(_shape(shape), fill_value, convert_dtype(dtype)))
+
+
+def empty(shape, dtype="float32"):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None):
+    return Tensor(jnp.zeros_like(x._data, dtype=convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None):
+    return Tensor(jnp.ones_like(x._data, dtype=convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None):
+    return Tensor(jnp.full_like(x._data, fill_value, dtype=convert_dtype(dtype)))
+
+
+def empty_like(x, dtype=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    for v in (start, end, step):
+        if isinstance(v, Tensor):
+            raise TypeError("arange bounds must be python scalars")
+    dtype = convert_dtype(dtype)
+    if dtype is None:
+        py = (start, end, step)
+        dtype = (
+            convert_dtype("float32")
+            if any(isinstance(v, float) for v in py)
+            else convert_dtype("int64")
+        )
+    return Tensor(jnp.arange(start, end, step, dtype=dtype))
+
+
+def linspace(start, stop, num, dtype="float32"):
+    return Tensor(jnp.linspace(start, stop, int(num), dtype=convert_dtype(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype="float32"):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=convert_dtype(dtype)))
+
+
+def diag(x, offset=0):
+    return Tensor(jnp.diag(x._data, k=offset))
+
+
+def tril(x, diagonal=0):
+    from ..core.dispatch import apply
+
+    return apply(lambda a: jnp.tril(a, diagonal), x, name="tril")
+
+
+def triu(x, diagonal=0):
+    from ..core.dispatch import apply
+
+    return apply(lambda a: jnp.triu(a, diagonal), x, name="triu")
+
+
+# -- random -----------------------------------------------------------------
+
+
+def rand(shape, dtype="float32"):
+    return uniform(shape, dtype=dtype, min=0.0, max=1.0)
+
+
+def randn(shape, dtype="float32"):
+    dtype = convert_dtype(dtype)
+    return Tensor(jax.random.normal(_random.next_key(), _shape(shape), dtype))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64"):
+    if high is None:
+        low, high = 0, low
+    return Tensor(
+        jax.random.randint(
+            _random.next_key(), _shape(shape), low, high, convert_dtype(dtype)
+        )
+    )
+
+
+def uniform(shape, dtype="float32", min=-1.0, max=1.0, seed=0):
+    dtype = convert_dtype(dtype)
+    key = jax.random.PRNGKey(seed) if seed else _random.next_key()
+    return Tensor(
+        jax.random.uniform(key, _shape(shape), dtype, minval=min, maxval=max)
+    )
+
+
+def normal(mean=0.0, std=1.0, shape=(1,)):
+    base = jax.random.normal(_random.next_key(), _shape(shape), jnp.float32)
+    return Tensor(base * std + mean)
+
+
+def randperm(n, dtype="int64"):
+    return Tensor(
+        jax.random.permutation(_random.next_key(), n).astype(convert_dtype(dtype))
+    )
+
+
+def bernoulli(x):
+    p = x._data
+    return Tensor(
+        jax.random.bernoulli(_random.next_key(), p, p.shape).astype(p.dtype)
+    )
+
+
+def multinomial(x, num_samples=1, replacement=False):
+    probs = x._data
+    logits = jnp.log(jnp.maximum(probs, 1e-30))
+    if replacement:
+        out = jax.random.categorical(
+            _random.next_key(), logits, axis=-1, shape=(*logits.shape[:-1], num_samples)
+        )
+    else:
+        # Gumbel top-k trick for sampling without replacement.
+        g = jax.random.gumbel(_random.next_key(), logits.shape, logits.dtype)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(jnp.int64))
+
+
+def assign(x, output=None):
+    from ..core.dispatch import apply
+
+    if not isinstance(x, Tensor):
+        x = to_tensor(x)
+    out = apply(lambda a: a + 0, x, name="assign")
+    if output is not None:
+        output._data = out._data
+        output._grad_node = out._grad_node
+        output._out_index = out._out_index
+        output.stop_gradient = out.stop_gradient
+        return output
+    return out
+
+
+def clone(x):
+    return assign(x)
+
+
+def meshgrid(*args):
+    arrays = [a._data for a in args]
+    outs = jnp.meshgrid(*arrays, indexing="ij")
+    return [Tensor(o) for o in outs]

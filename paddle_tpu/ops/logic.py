@@ -1,0 +1,79 @@
+"""Comparison / logical ops (reference: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply
+
+__all__ = [
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "equal_all", "logical_and", "logical_or", "logical_xor",
+    "logical_not", "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "all", "any", "isclose", "allclose", "is_empty",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _cmp(fn, name):
+    def op(x, y, name_=None):
+        x, y = _t(x), _t(y)
+        return Tensor(fn(x._data, y._data))
+
+    op.__name__ = name
+    return op
+
+
+equal = _cmp(lambda a, b: a == b, "equal")
+not_equal = _cmp(lambda a, b: a != b, "not_equal")
+greater_than = _cmp(lambda a, b: a > b, "greater_than")
+greater_equal = _cmp(lambda a, b: a >= b, "greater_equal")
+less_than = _cmp(lambda a, b: a < b, "less_than")
+less_equal = _cmp(lambda a, b: a <= b, "less_equal")
+logical_and = _cmp(jnp.logical_and, "logical_and")
+logical_or = _cmp(jnp.logical_or, "logical_or")
+logical_xor = _cmp(jnp.logical_xor, "logical_xor")
+bitwise_and = _cmp(jnp.bitwise_and, "bitwise_and")
+bitwise_or = _cmp(jnp.bitwise_or, "bitwise_or")
+bitwise_xor = _cmp(jnp.bitwise_xor, "bitwise_xor")
+
+
+def logical_not(x, name=None):
+    return Tensor(jnp.logical_not(x._data))
+
+
+def bitwise_not(x, name=None):
+    return Tensor(jnp.bitwise_not(x._data))
+
+
+def equal_all(x, y, name=None):
+    return Tensor(jnp.array_equal(x._data, y._data))
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return Tensor(jnp.all(x._data, axis=ax, keepdims=keepdim))
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return Tensor(jnp.any(x._data, axis=ax, keepdims=keepdim))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(
+        jnp.isclose(x._data, _t(y)._data, rtol=rtol, atol=atol, equal_nan=equal_nan)
+    )
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(
+        jnp.allclose(x._data, _t(y)._data, rtol=rtol, atol=atol, equal_nan=equal_nan)
+    )
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))

@@ -1,0 +1,484 @@
+"""Shape/layout manipulation + indexing ops (reference:
+python/paddle/tensor/manipulation.py, search.py)."""
+from __future__ import annotations
+
+import builtins
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply
+from ..core.dtype import convert_dtype
+
+__all__ = [
+    "reshape", "transpose", "concat", "stack", "split", "chunk",
+    "squeeze", "unsqueeze", "flatten", "cast", "slice",
+    "gather", "gather_nd", "scatter", "scatter_nd_add", "index_select",
+    "index_sample", "take_along_axis", "put_along_axis",
+    "tile", "expand", "expand_as", "broadcast_to", "repeat_interleave",
+    "flip", "roll", "rot90", "moveaxis", "swapaxes",
+    "argmax", "argmin", "argsort", "sort", "topk", "where", "nonzero",
+    "masked_select", "masked_fill", "unique", "one_hot",
+    "unbind", "numel", "shard_index", "strided_slice", "as_real", "as_complex",
+    "tensordot", "cross", "searchsorted", "bincount", "unfold",
+]
+
+
+def reshape(x, shape, name=None):
+    shape = tuple(int(s) if not isinstance(s, Tensor) else int(s.item()) for s in shape)
+    return apply(lambda a: jnp.reshape(a, shape), x, name="reshape")
+
+
+def transpose(x, perm, name=None):
+    perm = tuple(int(p) for p in perm)
+    return apply(lambda a: jnp.transpose(a, perm), x, name="transpose")
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply(lambda a: jnp.moveaxis(a, source, destination), x, name="moveaxis")
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply(lambda a: jnp.swapaxes(a, axis0, axis1), x, name="swapaxes")
+
+
+def concat(x, axis=0, name=None):
+    axis = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply(lambda *xs: jnp.concatenate(xs, axis=axis), *x, name="concat")
+
+
+def stack(x, axis=0, name=None):
+    return apply(lambda *xs: jnp.stack(xs, axis=axis), *x, name="stack")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(axis)
+    n = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        if n % num_or_sections != 0:
+            raise ValueError(
+                f"split: dim {axis} size {n} is not divisible by {num_or_sections}"
+            )
+        sizes = [n // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s) for s in num_or_sections]
+        neg = [i for i, s in enumerate(sizes) if s < 0]
+        if neg:
+            sizes[neg[0]] = n - builtins.sum(s for s in sizes if s >= 0)
+    offsets = np.cumsum([0] + sizes[:-1])
+
+    def fn(a):
+        return tuple(
+            jax.lax.slice_in_dim(a, int(o), int(o + s), axis=axis)
+            for o, s in zip(offsets, sizes)
+        )
+
+    return list(apply(fn, x, name="split"))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis=axis)
+
+
+def unbind(x, axis=0):
+    n = x.shape[axis]
+
+    def fn(a):
+        return tuple(
+            jnp.squeeze(jax.lax.slice_in_dim(a, i, i + 1, axis=axis), axis=axis)
+            for i in range(n)
+        )
+
+    return list(apply(fn, x, name="unbind"))
+
+
+def squeeze(x, axis=None, name=None):
+    def fn(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(a_ % a.ndim for a_ in axes)
+        axes = tuple(ax for ax in axes if a.shape[ax] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+
+    return apply(fn, x, name="squeeze")
+
+
+def unsqueeze(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = tuple(int(a) for a in axes)
+
+    def fn(a):
+        out = a
+        for ax in axes:
+            out = jnp.expand_dims(out, ax)
+        return out
+
+    return apply(fn, x, name="unsqueeze")
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    nd = x.ndim
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+
+    def fn(a):
+        shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
+        return jnp.reshape(a, shape)
+
+    return apply(fn, x, name="flatten")
+
+
+def cast(x, dtype):
+    dt = convert_dtype(dtype)
+    return apply(lambda a: a.astype(dt), x, name="cast")
+
+
+def slice(x, axes, starts, ends):
+    def fn(a):
+        out = a
+        for ax, st, en in zip(axes, starts, ends):
+            st = int(st) if not isinstance(st, Tensor) else int(st.item())
+            en = int(en) if not isinstance(en, Tensor) else int(en.item())
+            dim = a.shape[ax]
+            st = builtins.max(st + dim, 0) if st < 0 else builtins.min(st, dim)
+            en = builtins.max(en + dim, 0) if en < 0 else builtins.min(en, dim)
+            out = jax.lax.slice_in_dim(out, st, en, axis=ax)
+        return out
+
+    return apply(fn, x, name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    def fn(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[ax] = builtins.slice(st, en, sd)
+        return a[tuple(idx)]
+
+    return apply(fn, x, name="strided_slice")
+
+
+def _idx_arr(index):
+    return index._data if isinstance(index, Tensor) else jnp.asarray(index)
+
+
+def gather(x, index, axis=0, name=None):
+    idx = _idx_arr(index)
+    return apply(lambda a: jnp.take(a, idx, axis=axis), x, name="gather")
+
+
+def gather_nd(x, index, name=None):
+    idx = _idx_arr(index)
+
+    def fn(a):
+        comps = tuple(idx[..., i] for i in range(idx.shape[-1]))
+        return a[comps]
+
+    return apply(fn, x, name="gather_nd")
+
+
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis=axis)
+
+
+def index_sample(x, index):
+    idx = _idx_arr(index)
+    return apply(
+        lambda a: jnp.take_along_axis(a, idx, axis=1), x, name="index_sample"
+    )
+
+
+def take_along_axis(arr, indices, axis):
+    idx = _idx_arr(indices)
+    return apply(
+        lambda a: jnp.take_along_axis(a, idx, axis=axis), arr, name="take_along_axis"
+    )
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign"):
+    idx = _idx_arr(indices)
+    mode = {"assign": "set", "add": "add", "mul": "multiply"}[reduce]
+
+    def fn(a, v):
+        v = jnp.broadcast_to(v, idx.shape).astype(a.dtype)
+        updater = getattr(jnp, "put_along_axis", None)
+        # Build explicit advanced indices (works for any rank).
+        comps = []
+        for d in range(a.ndim):
+            if d == axis % a.ndim:
+                comps.append(idx)
+            else:
+                shape = [1] * idx.ndim
+                shape[d] = idx.shape[d]
+                comps.append(jnp.broadcast_to(jnp.arange(idx.shape[d]).reshape(shape), idx.shape))
+        at = a.at[tuple(comps)]
+        return getattr(at, mode)(v)
+
+    if not isinstance(values, Tensor):
+        values = Tensor(jnp.asarray(values))
+    return apply(fn, arr, values, name="put_along_axis")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    idx = _idx_arr(index).reshape(-1)
+
+    def fn(a, u):
+        if overwrite:
+            return a.at[idx].set(u)
+        return a.at[idx].add(u)
+
+    return apply(fn, x, updates, name="scatter")
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    idx = _idx_arr(index)
+
+    def fn(a, u):
+        comps = tuple(idx[..., i] for i in range(idx.shape[-1]))
+        return a.at[comps].add(u)
+
+    return apply(fn, x, updates, name="scatter_nd_add")
+
+
+def tile(x, repeat_times, name=None):
+    reps = tuple(int(r) for r in repeat_times)
+    return apply(lambda a: jnp.tile(a, reps), x, name="tile")
+
+
+def expand(x, shape, name=None):
+    shape = tuple(int(s) for s in shape)
+
+    def fn(a):
+        tgt = list(shape)
+        # -1 means keep original dim
+        off = len(tgt) - a.ndim
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                tgt[i] = a.shape[i - off]
+        return jnp.broadcast_to(a, tuple(tgt))
+
+    return apply(fn, x, name="expand")
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    r = repeats._data if isinstance(repeats, Tensor) else repeats
+    return apply(
+        lambda a: jnp.repeat(a, r, axis=axis), x, name="repeat_interleave"
+    )
+
+
+def flip(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return apply(lambda a: jnp.flip(a, axis=tuple(axes)), x, name="flip")
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply(lambda a: jnp.roll(a, shifts, axis=axis), x, name="roll")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x, name="rot90")
+
+
+# -- search / sort ----------------------------------------------------------
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def fn(a):
+        out = jnp.argmax(a, axis=axis, keepdims=keepdim if axis is not None else False)
+        return out.astype(convert_dtype(dtype))
+
+    return Tensor(fn(x._data))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def fn(a):
+        out = jnp.argmin(a, axis=axis, keepdims=keepdim if axis is not None else False)
+        return out.astype(convert_dtype(dtype))
+
+    return Tensor(fn(x._data))
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    a = x._data
+    out = jnp.argsort(-a if descending else a, axis=axis)
+    return Tensor(out.astype(jnp.int64))
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    def fn(a):
+        out = jnp.sort(a, axis=axis)
+        return jnp.flip(out, axis=axis) if descending else out
+
+    return apply(fn, x, name="sort")
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    ax = axis % x.ndim
+
+    def fn(a):
+        moved = jnp.moveaxis(a, ax, -1)
+        vals, idx = jax.lax.top_k(moved if largest else -moved, k)
+        if not largest:
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax)
+
+    vals, idx = apply(fn, x, name="topk")
+    idx = Tensor(idx._data.astype(jnp.int64))
+    return vals, idx
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition)
+    cond = condition._data if isinstance(condition, Tensor) else jnp.asarray(condition)
+    if not isinstance(x, Tensor):
+        x = Tensor(jnp.asarray(x))
+    if not isinstance(y, Tensor):
+        y = Tensor(jnp.asarray(y))
+    return apply(lambda a, b: jnp.where(cond, a, b), x, y, name="where")
+
+
+def nonzero(x, as_tuple=False):
+    arr = np.asarray(x._data)
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(n[:, None]).astype(jnp.int64)) for n in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1)).astype(jnp.int64))
+
+
+def masked_select(x, mask, name=None):
+    # Data-dependent output shape: host-side op (not jittable) — reference has
+    # the same dynamic-shape property (masked_select kernel).
+    arr = np.asarray(x._data)
+    m = np.asarray(mask._data if isinstance(mask, Tensor) else mask)
+    return Tensor(jnp.asarray(arr[np.broadcast_to(m, arr.shape)]))
+
+
+def masked_fill(x, mask, value, name=None):
+    m = mask._data if isinstance(mask, Tensor) else jnp.asarray(mask)
+    if isinstance(value, Tensor):
+        return apply(
+            lambda a, v: jnp.where(m, v.astype(a.dtype), a), x, value, name="masked_fill"
+        )
+    return apply(lambda a: jnp.where(m, value, a), x, name="masked_fill")
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None):
+    arr = np.asarray(x._data)
+    res = np.unique(
+        arr,
+        return_index=return_index,
+        return_inverse=return_inverse,
+        return_counts=return_counts,
+        axis=axis,
+    )
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    outs = [Tensor(jnp.asarray(r.astype(np.int64) if r.dtype == np.intp else r)) for r in res]
+    return tuple(outs)
+
+
+def one_hot(x, num_classes, name=None):
+    idx = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.nn.one_hot(idx, num_classes, dtype=jnp.float32))
+
+
+def numel(x):
+    return Tensor(jnp.asarray(x.size, dtype=jnp.int64))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """Vocab-shard remap (reference: shard_index_op — used by
+    VocabParallelEmbedding)."""
+    size = index_num // nshards
+
+    def fn(a):
+        lo, hi = shard_id * size, (shard_id + 1) * size
+        in_range = (a >= lo) & (a < hi)
+        return jnp.where(in_range, a - lo, ignore_value)
+
+    return apply(fn, input, name="shard_index")
+
+
+def as_real(x):
+    def fn(a):
+        return jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1)
+
+    return apply(fn, x, name="as_real")
+
+
+def as_complex(x):
+    return apply(
+        lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x, name="as_complex"
+    )
+
+
+def tensordot(x, y, axes=2, name=None):
+    return apply(lambda a, b: jnp.tensordot(a, b, axes=axes), x, y, name="tensordot")
+
+
+def cross(x, y, axis=9, name=None):
+    ax = axis if axis != 9 else None
+
+    def fn(a, b):
+        if ax is None:
+            # first axis with dim 3 (paddle semantics)
+            axis_ = next(i for i, s in enumerate(a.shape) if s == 3)
+        else:
+            axis_ = ax
+        return jnp.cross(a, b, axis=axis_)
+
+    return apply(fn, x, y, name="cross")
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence._data, values._data, side=side)
+    return Tensor(out.astype(jnp.int32 if out_int32 else jnp.int64))
+
+
+def bincount(x, weights=None, minlength=0):
+    w = weights._data if isinstance(weights, Tensor) else weights
+    arr = np.asarray(x._data)
+    length = builtins.max(minlength, int(arr.max()) + 1 if arr.size else 0)
+    out = jnp.bincount(x._data, weights=w, length=length)
+    return Tensor(out)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference: unfold_op) — NCHW."""
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+
+    def fn(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, ((0, 0), (0, 0), (pd[0], pd[2]), (pd[1], pd[3])))
+        oh = (a.shape[2] - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (a.shape[3] - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        patches = []
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                di, dj = i * dl[0], j * dl[1]
+                patches.append(
+                    a[:, :, di : di + oh * st[0] : st[0], dj : dj + ow * st[1] : st[1]]
+                )
+        out = jnp.stack(patches, axis=2)  # N, C, K*K, OH, OW
+        return out.reshape(n, c * ks[0] * ks[1], oh * ow)
+
+    return apply(fn, x, name="unfold")

@@ -1,0 +1,285 @@
+"""Math ops (reference: python/paddle/tensor/math.py + phi kernels
+paddle/phi/kernels/{cpu,gpu}/*_kernel.cc — here each op is one pure jnp
+function; XLA provides the fused CPU/TPU kernels)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply
+from ..core.dtype import convert_dtype
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "remainder",
+    "pow", "matmul", "bmm", "dot", "mm", "inner", "outer",
+    "sum", "mean", "max", "min", "prod", "amax", "amin",
+    "abs", "neg", "exp", "expm1", "log", "log2", "log10", "log1p",
+    "sqrt", "rsqrt", "square", "reciprocal", "sign",
+    "sin", "cos", "tan", "asin", "acos", "atan", "atan2",
+    "sinh", "cosh", "tanh", "asinh", "acosh", "atanh",
+    "floor", "ceil", "round", "trunc", "frac",
+    "maximum", "minimum", "fmax", "fmin",
+    "clip", "cumsum", "cumprod", "logsumexp", "logcumsumexp",
+    "isnan", "isinf", "isfinite", "nan_to_num",
+    "erf", "erfinv", "lgamma", "digamma",
+    "stanh", "rad2deg", "deg2rad",
+    "addmm", "einsum", "kron", "trace", "diagonal",
+    "mod", "lerp", "hypot", "gcd", "lcm",
+]
+
+
+def _t(x):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(jnp.asarray(x, dtype=jnp.float32 if isinstance(x, float) else None))
+
+
+def _binary(fn, name):
+    def op(x, y, name_=None):
+        return apply(fn, _t(x), _t(y), name=name)
+
+    op.__name__ = name
+    return op
+
+
+def _unary(fn, name):
+    def op(x, name_=None):
+        return apply(fn, x, name=name)
+
+    op.__name__ = name
+    return op
+
+
+add = _binary(lambda a, b: a + b, "add")
+subtract = _binary(lambda a, b: a - b, "subtract")
+multiply = _binary(lambda a, b: a * b, "multiply")
+divide = _binary(lambda a, b: a / b, "divide")
+floor_divide = _binary(lambda a, b: jnp.floor_divide(a, b), "floor_divide")
+remainder = _binary(lambda a, b: jnp.remainder(a, b), "remainder")
+mod = remainder
+pow = _binary(lambda a, b: jnp.power(a, b), "pow")
+maximum = _binary(jnp.maximum, "maximum")
+minimum = _binary(jnp.minimum, "minimum")
+fmax = _binary(jnp.fmax, "fmax")
+fmin = _binary(jnp.fmin, "fmin")
+atan2 = _binary(jnp.arctan2, "atan2")
+hypot = _binary(jnp.hypot, "hypot")
+
+abs = _unary(jnp.abs, "abs")
+neg = _unary(jnp.negative, "neg")
+exp = _unary(jnp.exp, "exp")
+expm1 = _unary(jnp.expm1, "expm1")
+log = _unary(jnp.log, "log")
+log2 = _unary(jnp.log2, "log2")
+log10 = _unary(jnp.log10, "log10")
+log1p = _unary(jnp.log1p, "log1p")
+sqrt = _unary(jnp.sqrt, "sqrt")
+rsqrt = _unary(jax.lax.rsqrt, "rsqrt")
+square = _unary(jnp.square, "square")
+reciprocal = _unary(jnp.reciprocal, "reciprocal")
+sign = _unary(jnp.sign, "sign")
+sin = _unary(jnp.sin, "sin")
+cos = _unary(jnp.cos, "cos")
+tan = _unary(jnp.tan, "tan")
+asin = _unary(jnp.arcsin, "asin")
+acos = _unary(jnp.arccos, "acos")
+atan = _unary(jnp.arctan, "atan")
+sinh = _unary(jnp.sinh, "sinh")
+cosh = _unary(jnp.cosh, "cosh")
+tanh = _unary(jnp.tanh, "tanh")
+asinh = _unary(jnp.arcsinh, "asinh")
+acosh = _unary(jnp.arccosh, "acosh")
+atanh = _unary(jnp.arctanh, "atanh")
+floor = _unary(jnp.floor, "floor")
+ceil = _unary(jnp.ceil, "ceil")
+round = _unary(jnp.round, "round")
+trunc = _unary(jnp.trunc, "trunc")
+frac = _unary(lambda a: a - jnp.trunc(a), "frac")
+erf = _unary(jax.scipy.special.erf, "erf")
+erfinv = _unary(jax.scipy.special.erfinv, "erfinv")
+lgamma = _unary(jax.scipy.special.gammaln, "lgamma")
+digamma = _unary(jax.scipy.special.digamma, "digamma")
+rad2deg = _unary(jnp.rad2deg, "rad2deg")
+deg2rad = _unary(jnp.deg2rad, "deg2rad")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return apply(lambda a: scale_b * jnp.tanh(a * scale_a), x, name="stanh")
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return apply(fn, x, y, name="matmul")
+
+
+mm = matmul
+bmm = matmul
+
+
+def dot(x, y, name=None):
+    return apply(lambda a, b: jnp.sum(a * b, axis=-1), x, y, name="dot")
+
+
+def inner(x, y):
+    return apply(jnp.inner, x, y, name="inner")
+
+
+def outer(x, y):
+    return apply(lambda a, b: jnp.outer(a, b), x, y, name="outer")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return apply(
+        lambda i, a, b: beta * i + alpha * (a @ b), input, x, y, name="addmm"
+    )
+
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _reduce(fn, name, int_promote=False):
+    def op(x, axis=None, keepdim=False, name_=None, dtype=None):
+        ax = _norm_axis(axis)
+
+        def f(a):
+            out = fn(a, axis=ax, keepdims=keepdim)
+            if dtype is not None:
+                out = out.astype(convert_dtype(dtype))
+            elif int_promote and jnp.issubdtype(a.dtype, jnp.integer):
+                out = out.astype(jnp.int64)
+            return out
+
+        return apply(f, x, name=name)
+
+    op.__name__ = name
+    return op
+
+
+sum = _reduce(jnp.sum, "sum", int_promote=True)
+mean = _reduce(jnp.mean, "mean")
+prod = _reduce(jnp.prod, "prod", int_promote=True)
+amax = _reduce(jnp.max, "amax")
+amin = _reduce(jnp.min, "amin")
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return amax(x, axis=axis, keepdim=keepdim)
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return amin(x, axis=axis, keepdim=keepdim)
+
+
+def clip(x, min=None, max=None, name=None):
+    return apply(lambda a: jnp.clip(a, min, max), x, name="clip")
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    def f(a):
+        if axis is None:
+            a = a.reshape(-1)
+            out = jnp.cumsum(a)
+        else:
+            out = jnp.cumsum(a, axis=axis)
+        if dtype is not None:
+            out = out.astype(convert_dtype(dtype))
+        return out
+
+    return apply(f, x, name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    def f(a):
+        out = jnp.cumprod(a, axis=dim)
+        if dtype is not None:
+            out = out.astype(convert_dtype(dtype))
+        return out
+
+    return apply(f, x, name="cumprod")
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply(
+        lambda a: jax.scipy.special.logsumexp(a, axis=ax, keepdims=keepdim),
+        x,
+        name="logsumexp",
+    )
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def f(a):
+        if axis is None:
+            a = a.reshape(-1)
+            ax = 0
+        else:
+            ax = axis
+        return jax.lax.associative_scan(jnp.logaddexp, a, axis=ax)
+
+    return apply(f, x, name="logcumsumexp")
+
+
+isnan = _unary(jnp.isnan, "isnan")
+isinf = _unary(jnp.isinf, "isinf")
+isfinite = _unary(jnp.isfinite, "isfinite")
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply(
+        lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf),
+        x,
+        name="nan_to_num",
+    )
+
+
+def einsum(equation, *operands):
+    return apply(
+        lambda *ops: jnp.einsum(equation, *ops), *operands, name="einsum"
+    )
+
+
+def kron(x, y):
+    return apply(jnp.kron, x, y, name="kron")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(
+        lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2),
+        x,
+        name="trace",
+    )
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(
+        lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2),
+        x,
+        name="diagonal",
+    )
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return apply(lambda a, b, w: a + w * (b - a), x, y, weight, name="lerp")
+    return apply(lambda a, b: a + weight * (b - a), x, y, name="lerp")
+
+
+def gcd(x, y):
+    return apply(jnp.gcd, x, y, name="gcd")
+
+
+def lcm(x, y):
+    return apply(jnp.lcm, x, y, name="lcm")

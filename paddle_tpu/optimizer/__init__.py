@@ -1,0 +1,13 @@
+"""paddle_tpu.optimizer (reference: python/paddle/optimizer/)."""
+from .optimizer import (
+    Optimizer, SGD, Momentum, Adam, AdamW, Adamax, Adagrad, Adadelta,
+    RMSProp, Lamb, Lars,
+)
+from . import lr
+from .clip import ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm
+
+__all__ = [
+    "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad",
+    "Adadelta", "RMSProp", "Lamb", "Lars", "lr",
+    "ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm",
+]

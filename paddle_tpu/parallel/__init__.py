@@ -1,0 +1,39 @@
+"""paddle_tpu.parallel — SPMD mesh engine.
+
+The TPU-native replacement for the reference's entire multi-process
+parallelism stack (HybridCommunicateGroup topology.py, ProcessGroupNCCL,
+EagerReducer, mp_ops c_* collectives, pipeline p2p — SURVEY §2.4): one
+device Mesh with named axes
+
+    dp       data parallel        (batch dim)
+    sharding ZeRO weight-update sharding (optimizer state dim 0)
+    pp       pipeline parallel    (stacked-layer scan + collective-permute)
+    mp       tensor parallel      (hidden/head dims)
+    sp       sequence/context parallel (sequence dim; ring attention)
+    ep       expert parallel      (MoE expert dim, rides mp/dp axes)
+
+Parameters carry per-dim logical axes (`Parameter._sharding_axes`); the
+compiled train step (paddle_tpu.jit + this engine) turns them into
+jax.sharding.NamedSharding placements and XLA GSPMD inserts all
+collectives over ICI/DCN.
+"""
+from .mesh import (
+    init_mesh, get_mesh, set_mesh, mesh_axes, axis_size, has_axis, MeshGuard,
+)
+from .api import (
+    shard_parameter, shard_tensor, sharding_of, param_sharding, constraint,
+    replicated,
+)
+from .mp_layers import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelCrossEntropy, mp_allreduce, mp_identity,
+)
+from .random_ import RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed
+
+__all__ = [
+    "init_mesh", "get_mesh", "set_mesh", "mesh_axes", "axis_size", "has_axis",
+    "MeshGuard", "shard_parameter", "shard_tensor", "sharding_of",
+    "param_sharding", "constraint", "replicated", "ColumnParallelLinear",
+    "RowParallelLinear", "VocabParallelEmbedding", "ParallelCrossEntropy",
+    "RNGStatesTracker", "get_rng_state_tracker", "model_parallel_random_seed",
+]

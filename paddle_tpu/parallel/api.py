@@ -1,0 +1,87 @@
+"""Sharding annotation API.
+
+Reference analog: auto_parallel shard_tensor + dist_attr (ProcessMesh,
+dims_mapping — completion.py propagates them through the graph). Here the
+same information is (a) `Parameter._sharding_axes` consumed when building
+the compiled step's in_shardings, and (b) in-graph
+`with_sharding_constraint` hints; propagation is XLA GSPMD's job, not a
+hand-written Completer.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply
+from .mesh import get_mesh, axis_size
+
+__all__ = [
+    "shard_parameter", "shard_tensor", "sharding_of", "param_sharding",
+    "constraint", "replicated",
+]
+
+
+def _filter_spec(axes):
+    """Drop axes of size 1 so single-degree configs stay replicated."""
+    out = []
+    for a in axes:
+        if a is None:
+            out.append(None)
+        elif isinstance(a, (tuple, list)):
+            kept = tuple(x for x in a if axis_size(x) > 1)
+            out.append(kept if kept else None)
+        else:
+            out.append(a if axis_size(a) > 1 else None)
+    return tuple(out)
+
+
+def shard_parameter(param, axes: Sequence[Optional[str]]):
+    """Annotate a Parameter with per-dim mesh axes, e.g. (None, 'mp')."""
+    if len(axes) != len(param.shape):
+        raise ValueError(f"axes {axes} rank != param rank {len(param.shape)}")
+    param._sharding_axes = tuple(axes)
+    return param
+
+
+def param_sharding(param):
+    """NamedSharding for a Parameter (replicated if unannotated)."""
+    mesh = get_mesh()
+    axes = getattr(param, "_sharding_axes", None)
+    if axes is None:
+        return NamedSharding(mesh, PartitionSpec())
+    return NamedSharding(mesh, PartitionSpec(*_filter_spec(axes)))
+
+
+def sharding_of(*axes):
+    return NamedSharding(get_mesh(), PartitionSpec(*_filter_spec(axes)))
+
+
+def replicated():
+    return NamedSharding(get_mesh(), PartitionSpec())
+
+
+def shard_tensor(x, axes, mesh=None):
+    """Place (or re-place) a Tensor onto the mesh with the given per-dim axes.
+    Eager: jax.device_put; inside a trace: a sharding constraint."""
+    sh = sharding_of(*axes)
+    if isinstance(x, Tensor):
+        arr = x._data
+        if hasattr(arr, "aval") and not isinstance(arr, jax.Array):
+            return constraint(x, axes)
+        try:
+            x._data = jax.device_put(arr, sh)
+        except Exception:
+            x._data = jax.lax.with_sharding_constraint(arr, sh)
+        return x
+    return jax.device_put(x, sh)
+
+
+def constraint(x, axes):
+    """In-graph sharding hint (GSPMD boundary) — differentiable."""
+    sh = sharding_of(*axes)
+    return apply(
+        lambda a: jax.lax.with_sharding_constraint(a, sh), x, name="sharding_constraint"
+    )

@@ -1,0 +1,58 @@
+"""Model-parallel RNG state tracker (reference:
+fleet/layers/mpu/random.py:35 RNGStatesTracker — distinct dropout seeds
+inside vs outside tensor-parallel regions so replicated activations get
+identical masks while mp-sharded ones get per-shard masks)."""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+from ..core import random as _rng
+
+__all__ = ["RNGStatesTracker", "get_rng_state_tracker", "model_parallel_random_seed"]
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states = {}
+
+    def reset(self):
+        self.states = {}
+
+    def add(self, name, seed):
+        if name in self.states:
+            raise ValueError(f"rng state {name} already exists")
+        self.states[name] = jax.random.PRNGKey(int(seed))
+
+    def rng_state(self, name="model_parallel_rng"):
+        from contextlib import contextmanager
+
+        if name not in self.states:
+            raise ValueError(f"rng state {name} not added")
+
+        @contextmanager
+        def guard():
+            with _rng.key_scope(self.states[name]):
+                try:
+                    yield
+                finally:
+                    self.states[name] = _rng.get_state()
+
+        return guard()
+
+
+_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    import random as pyrandom
+
+    seed = seed or (1024 + pyrandom.randint(0, 100000))
+    _TRACKER.reset()
+    _TRACKER.add("global_seed", seed)
+    _TRACKER.add("model_parallel_rng", seed + 1)

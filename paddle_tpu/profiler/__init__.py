@@ -1,0 +1,211 @@
+"""Profiler (reference: python/paddle/profiler/profiler.py:344 +
+paddle/fluid/platform/profiler/ HostTracer/CudaTracer).
+
+TPU-native: host spans use a lightweight in-process tracer (chrome-trace
+exportable, the HostTracer analog); device side delegates to jax.profiler
+(XLA xplane capture, viewable in TensorBoard/Perfetto — the CUPTI analog).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+
+__all__ = [
+    "Profiler", "RecordEvent", "ProfilerTarget", "ProfilerState",
+    "make_scheduler", "export_chrome_tracing", "load_profiler_result",
+]
+
+
+class ProfilerTarget:
+    CPU = "cpu"
+    GPU = "gpu"
+    CUSTOM_DEVICE = "tpu"
+    TPU = "tpu"
+
+
+class ProfilerState:
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class _HostTracer(threading.local):
+    def __init__(self):
+        self.events = []
+        self.enabled = False
+
+
+_tracer = _HostTracer()
+
+
+class RecordEvent:
+    """Host span annotation (reference: platform::RecordEvent,
+    profiler/event_tracing.h:49). Also emits a jax TraceAnnotation so spans
+    appear in xplane captures."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._jax_ctx = None
+        self._t0 = None
+
+    def begin(self):
+        self._t0 = time.perf_counter_ns()
+        try:
+            import jax.profiler
+
+            self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
+            self._jax_ctx.__enter__()
+        except Exception:
+            self._jax_ctx = None
+
+    def end(self):
+        if self._jax_ctx is not None:
+            self._jax_ctx.__exit__(None, None, None)
+        if _tracer.enabled and self._t0 is not None:
+            _tracer.events.append(
+                {
+                    "name": self.name,
+                    "ph": "X",
+                    "ts": self._t0 / 1000.0,
+                    "dur": (time.perf_counter_ns() - self._t0) / 1000.0,
+                    "pid": os.getpid(),
+                    "tid": threading.get_ident() % 1_000_000,
+                }
+            )
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    def scheduler(step):
+        step -= skip_first
+        if step < 0:
+            return ProfilerState.CLOSED
+        cycle = closed + ready + record
+        if repeat and step >= repeat * cycle:
+            return ProfilerState.CLOSED
+        pos = step % cycle if cycle else 0
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    def handler(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        fname = os.path.join(
+            dir_name, f"{worker_name or 'paddle_tpu'}_{int(time.time())}.json"
+        )
+        prof._export_chrome(fname)
+        return fname
+
+    return handler
+
+
+class Profiler:
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False):
+        self._scheduler = scheduler if callable(scheduler) else None
+        if isinstance(scheduler, (tuple, list)):
+            lo, hi = scheduler
+            self._scheduler = make_scheduler(closed=lo, ready=0, record=hi - lo, repeat=1)
+        self._on_trace_ready = on_trace_ready
+        self._step = 0
+        self._xla_dir = None
+        self._timer_only = timer_only
+        self._step_times = []
+        self._last_step_t = None
+
+    def start(self):
+        _tracer.enabled = True
+        _tracer.events = []
+        self._last_step_t = time.perf_counter()
+        if not self._timer_only:
+            try:
+                import jax.profiler
+
+                self._xla_dir = os.environ.get("PTPU_PROF_DIR", "/tmp/ptpu_profile")
+                jax.profiler.start_trace(self._xla_dir)
+            except Exception:
+                self._xla_dir = None
+
+    def stop(self):
+        _tracer.enabled = False
+        if self._xla_dir is not None:
+            try:
+                import jax.profiler
+
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._xla_dir = None
+        if self._on_trace_ready is not None:
+            self._on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._last_step_t is not None:
+            self._step_times.append((now - self._last_step_t, num_samples))
+        self._last_step_t = now
+        self._step += 1
+
+    def step_info(self, unit="samples"):
+        if not self._step_times:
+            return ""
+        import numpy as np
+
+        times = np.array([t for t, _ in self._step_times])
+        msg = f"avg step {times.mean()*1000:.2f} ms"
+        samples = [n for _, n in self._step_times if n]
+        if samples:
+            ips = np.array(samples) / times[-len(samples):]
+            msg += f", ips {ips.mean():.1f} {unit}/s"
+        return msg
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False, time_unit="ms"):
+        by_name = {}
+        for e in _tracer.events:
+            agg = by_name.setdefault(e["name"], [0.0, 0])
+            agg[0] += e["dur"] / 1000.0
+            agg[1] += 1
+        lines = [f"{'name':40s} {'calls':>8s} {'total_ms':>12s}"]
+        for name, (tot, n) in sorted(by_name.items(), key=lambda kv: -kv[1][0]):
+            lines.append(f"{name[:40]:40s} {n:8d} {tot:12.3f}")
+        return "\n".join(lines)
+
+    def _export_chrome(self, fname):
+        with open(fname, "w") as f:
+            json.dump({"traceEvents": _tracer.events}, f)
+
+    def export(self, path, format="json"):
+        self._export_chrome(path)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def load_profiler_result(path):
+    with open(path) as f:
+        return json.load(f)

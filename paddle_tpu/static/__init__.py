@@ -1,0 +1,153 @@
+"""Static-graph API surface (reference: python/paddle/static/ — Program,
+Executor, program_guard).
+
+TPU-native position: XLA whole-graph compilation (paddle_tpu.jit) IS the
+static engine; this module provides the Program/Executor-shaped API on top
+of traced python functions so reference-style static training scripts have
+a migration target. Round-1 scope: InputSpec, mode flags, and a
+Program/Executor emulation driven by jit-compiled callables.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core.dtype import convert_dtype
+
+__all__ = [
+    "InputSpec", "enable_static", "disable_static", "in_dynamic_mode",
+    "Program", "program_guard", "default_main_program", "default_startup_program",
+    "Executor", "data", "name_scope", "gradients",
+]
+
+_static_mode = False
+
+
+def enable_static():
+    global _static_mode
+    _static_mode = True
+
+
+def disable_static():
+    global _static_mode
+    _static_mode = False
+
+
+def in_dynamic_mode():
+    return not _static_mode
+
+
+def in_static_mode():
+    return _static_mode
+
+
+class InputSpec:
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=False):
+        self.shape = tuple(-1 if s is None else int(s) for s in shape)
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype, name)
+
+    @classmethod
+    def from_numpy(cls, ndarray, name=None):
+        return cls(ndarray.shape, ndarray.dtype, name)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+class Program:
+    """Deferred-execution program: a recorded python callable + feed/fetch
+    names (the ProgramDesc analog; ops are jax-traced at Executor.run)."""
+
+    def __init__(self):
+        self._build_fns = []  # list of (fn producing fetch dict)
+        self.random_seed = 0
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program():
+    return _main_program
+
+
+def default_startup_program():
+    return _startup_program
+
+
+class program_guard:
+    def __init__(self, main_program, startup_program=None):
+        self.main = main_program
+
+    def __enter__(self):
+        global _main_program
+        self._prev = _main_program
+        _main_program = self.main
+        return self
+
+    def __exit__(self, *exc):
+        global _main_program
+        _main_program = self._prev
+        return False
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Static placeholder — in the TPU design this is just an InputSpec the
+    Executor matches feeds against."""
+    return InputSpec(shape, dtype, name)
+
+
+class name_scope:
+    def __init__(self, prefix=None):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    from ..autograd import tape as _tape
+
+    ts = targets if isinstance(targets, (list, tuple)) else [targets]
+    xs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    return _tape.grad(ts, xs, grad_outputs=target_gradients, retain_graph=True, allow_unused=True)
+
+
+class Executor:
+    """Executor API shim (reference: python/paddle/fluid/executor.py:898).
+    run(feed=..., fetch_list=...) executes python-recorded programs; with the
+    jit path being the blessed one, this exists for API-parity scripts."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, return_numpy=True):
+        if callable(program):
+            out = program(**(feed or {}))
+        elif fetch_list and all(callable(f) for f in fetch_list):
+            out = [f(**(feed or {})) for f in fetch_list]
+        else:
+            raise NotImplementedError(
+                "Graph-building static mode is provided via paddle_tpu.jit "
+                "(compile your step function); Executor.run accepts callables."
+            )
+        if not isinstance(out, (list, tuple)):
+            out = [out]
+        if return_numpy:
+            return [np.asarray(o._data) if isinstance(o, Tensor) else o for o in out]
+        return list(out)

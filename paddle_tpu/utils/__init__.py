@@ -1,0 +1,57 @@
+"""Utilities (reference: python/paddle/utils/)."""
+from __future__ import annotations
+
+import functools
+import importlib
+import warnings
+
+__all__ = ["deprecated", "try_import", "run_check", "unique_name"]
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            warnings.warn(
+                f"{fn.__name__} is deprecated since {since}: {reason}. "
+                f"Use {update_to} instead.",
+                DeprecationWarning,
+            )
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def try_import(module_name, err_msg=None):
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(err_msg or f"Cannot import {module_name}.")
+
+
+def run_check():
+    """Smoke-check the TPU runtime (reference: paddle.utils.run_check)."""
+    import jax
+    import jax.numpy as jnp
+
+    devs = jax.devices()
+    x = jnp.ones((128, 128))
+    y = (x @ x).block_until_ready()
+    print(f"paddle_tpu works! devices={devs}, matmul checksum={float(y.sum()):.1f}")
+
+
+class _UniqueNameGenerator:
+    def __init__(self):
+        import collections
+
+        self._counters = collections.defaultdict(int)
+
+    def generate(self, prefix="tmp"):
+        n = self._counters[prefix]
+        self._counters[prefix] += 1
+        return f"{prefix}_{n}"
+
+
+unique_name = _UniqueNameGenerator()

@@ -1,0 +1,7 @@
+"""paddle_tpu.vision (reference: python/paddle/vision/)."""
+from . import datasets
+from . import transforms
+from . import models
+from . import ops
+
+__all__ = ["datasets", "transforms", "models", "ops"]

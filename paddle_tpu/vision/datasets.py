@@ -1,0 +1,192 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/).
+
+Zero-egress environment: datasets load from local files when present
+(standard IDX/cifar pickle formats under ~/.cache/paddle_tpu/ or an explicit
+path); otherwise they fall back to a deterministic synthetic sample with the
+right shapes/label space (clearly flagged via `.synthetic`) so examples and
+tests run anywhere.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder", "ImageFolder"]
+
+_CACHE = os.path.expanduser(os.environ.get("PTPU_DATA_HOME", "~/.cache/paddle_tpu"))
+
+
+def _synthetic_images(n, shape, num_classes, seed):
+    rng = np.random.RandomState(seed)
+    images = (rng.rand(n, *shape) * 80).astype(np.uint8)
+    labels = rng.randint(0, num_classes, size=(n,)).astype(np.int64)
+    # strongly separable classes (a bright band at a class-specific row) so
+    # tiny models can overfit quickly in tests
+    h = shape[0]
+    band = max(h // num_classes, 1)
+    for i in range(n):
+        c = int(labels[i])
+        r0 = (c * band) % (h - band + 1)
+        images[i, r0 : r0 + band, ...] = 230
+    return images, labels
+
+
+class MNIST(Dataset):
+    NUM_CLASSES = 10
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend="cv2", size=None):
+        self.mode = mode
+        self.transform = transform
+        self.synthetic = False
+        n_default = 60000 if mode == "train" else 10000
+        images = labels = None
+        img_p = image_path or os.path.join(
+            _CACHE, "mnist", f"{'train' if mode == 'train' else 't10k'}-images-idx3-ubyte.gz"
+        )
+        lbl_p = label_path or os.path.join(
+            _CACHE, "mnist", f"{'train' if mode == 'train' else 't10k'}-labels-idx1-ubyte.gz"
+        )
+        if os.path.exists(img_p) and os.path.exists(lbl_p):
+            images = self._read_idx_images(img_p)
+            labels = self._read_idx_labels(lbl_p)
+        else:
+            self.synthetic = True
+            n = size or min(n_default, 2048)
+            images, labels = _synthetic_images(n, (28, 28), 10, seed=42 if mode == "train" else 7)
+        self.images = images
+        self.labels = labels
+
+    @staticmethod
+    def _read_idx_images(path):
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            data = np.frombuffer(f.read(), dtype=np.uint8)
+        return data.reshape(n, rows, cols)
+
+    @staticmethod
+    def _read_idx_labels(path):
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rb") as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            data = np.frombuffer(f.read(), dtype=np.uint8)
+        return data.astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)[None, :, :] / 255.0
+        label = np.array([self.labels[idx]], dtype=np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    NUM_CLASSES = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend="cv2", size=None):
+        self.mode = mode
+        self.transform = transform
+        self.synthetic = True
+        n = size or 1024
+        self.images, self.labels = _synthetic_images(
+            n, (32, 32, 3), self.NUM_CLASSES, seed=13 if mode == "train" else 17
+        )
+        if data_file and os.path.exists(data_file):
+            import pickle
+            import tarfile
+
+            with tarfile.open(data_file) as tf:
+                imgs, lbls = [], []
+                names = (
+                    [f"data_batch_{i}" for i in range(1, 6)] if mode == "train" else ["test_batch"]
+                )
+                for m in tf.getmembers():
+                    base = os.path.basename(m.name)
+                    if base in names:
+                        d = pickle.load(tf.extractfile(m), encoding="bytes")
+                        imgs.append(d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+                        lbls.extend(d.get(b"labels", d.get(b"fine_labels")))
+                if imgs:
+                    self.images = np.concatenate(imgs)
+                    self.labels = np.asarray(lbls, np.int64)
+                    self.synthetic = False
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32).transpose(2, 0, 1) / 255.0
+        label = np.array([self.labels[idx]], dtype=np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    NUM_CLASSES = 100
+
+
+class DatasetFolder(Dataset):
+    """Image-folder dataset (reference: paddle.vision.DatasetFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None, is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        exts = extensions or (".npy",)
+        classes = sorted(
+            d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
+        )
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                if fname.lower().endswith(exts):
+                    self.samples.append((os.path.join(cdir, fname), self.class_to_idx[c]))
+        self.loader = loader or (lambda p: np.load(p))
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(DatasetFolder):
+    def __init__(self, root, loader=None, extensions=None, transform=None, is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        exts = extensions or (".npy",)
+        self.samples = [
+            os.path.join(root, f)
+            for f in sorted(os.listdir(root))
+            if f.lower().endswith(exts)
+        ]
+        self.loader = loader or (lambda p: np.load(p))
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)

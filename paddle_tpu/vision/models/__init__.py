@@ -1,0 +1,13 @@
+"""Vision model zoo (reference: python/paddle/vision/models/)."""
+from .lenet import LeNet
+from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152, BasicBlock, BottleneckBlock
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
+from .mobilenet import MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2
+from .alexnet import AlexNet, alexnet
+
+__all__ = [
+    "LeNet", "ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
+    "resnet152", "BasicBlock", "BottleneckBlock", "VGG", "vgg11", "vgg13",
+    "vgg16", "vgg19", "MobileNetV1", "MobileNetV2", "mobilenet_v1",
+    "mobilenet_v2", "AlexNet", "alexnet",
+]

@@ -1,0 +1,193 @@
+"""Vision ops (reference: python/paddle/vision/ops.py — roi_align, nms,
+deform_conv, yolo helpers; SURVEY §8.11). Round-1 scope: the geometry ops
+used by detection heads; specialized CUDA kernels (deform_conv) land later."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply
+
+__all__ = ["nms", "box_iou", "roi_align", "roi_pool", "box_coder", "prior_box"]
+
+
+def box_iou(boxes1, boxes2):
+    def fn(b1, b2):
+        area1 = (b1[:, 2] - b1[:, 0]) * (b1[:, 3] - b1[:, 1])
+        area2 = (b2[:, 2] - b2[:, 0]) * (b2[:, 3] - b2[:, 1])
+        lt = jnp.maximum(b1[:, None, :2], b2[None, :, :2])
+        rb = jnp.minimum(b1[:, None, 2:], b2[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0, None)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / (area1[:, None] + area2[None, :] - inter + 1e-10)
+
+    return apply(fn, boxes1, boxes2, name="box_iou")
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None, categories=None, top_k=None):
+    """Host-side NMS (dynamic output shape — same as reference nms_op CPU)."""
+    b = np.asarray(boxes._data)
+    s = np.asarray(scores._data) if scores is not None else np.arange(len(b))[::-1].astype(np.float32)
+    order = np.argsort(-s)
+    keep = []
+    suppressed = np.zeros(len(b), bool)
+    areas = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    for _i in order:
+        if suppressed[_i]:
+            continue
+        keep.append(_i)
+        xx1 = np.maximum(b[_i, 0], b[:, 0])
+        yy1 = np.maximum(b[_i, 1], b[:, 1])
+        xx2 = np.minimum(b[_i, 2], b[:, 2])
+        yy2 = np.minimum(b[_i, 3], b[:, 3])
+        w = np.clip(xx2 - xx1, 0, None)
+        h = np.clip(yy2 - yy1, 0, None)
+        inter = w * h
+        iou = inter / (areas[_i] + areas - inter + 1e-10)
+        suppressed |= iou > iou_threshold
+        suppressed[_i] = True
+    keep = np.asarray(keep, np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(keep))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    boxes_arr = boxes._data if isinstance(boxes, Tensor) else jnp.asarray(boxes)
+    bn = np.asarray(boxes_num._data if isinstance(boxes_num, Tensor) else boxes_num)
+    batch_idx = np.repeat(np.arange(len(bn)), bn)
+
+    def fn(feat):
+        n, c, h, w = feat.shape
+        offset = 0.5 if aligned else 0.0
+
+        def one_roi(bi, box):
+            x1, y1, x2, y2 = box * spatial_scale - offset
+            bw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+            bh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+            ys = y1 + (jnp.arange(oh) + 0.5) * bh / oh
+            xs = x1 + (jnp.arange(ow) + 0.5) * bw / ow
+            yy, xx = jnp.meshgrid(ys, xs, indexing="ij")
+            y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, h - 1)
+            x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, w - 1)
+            y1i = jnp.clip(y0 + 1, 0, h - 1)
+            x1i = jnp.clip(x0 + 1, 0, w - 1)
+            wy = jnp.clip(yy - y0, 0, 1)
+            wx = jnp.clip(xx - x0, 0, 1)
+            img = feat[bi]
+            out = (
+                img[:, y0, x0] * (1 - wy) * (1 - wx)
+                + img[:, y0, x1i] * (1 - wy) * wx
+                + img[:, y1i, x0] * wy * (1 - wx)
+                + img[:, y1i, x1i] * wy * wx
+            )
+            return out
+
+        outs = [one_roi(int(batch_idx[i]), boxes_arr[i]) for i in range(boxes_arr.shape[0])]
+        return jnp.stack(outs) if outs else jnp.zeros((0, c, oh, ow), feat.dtype)
+
+    return apply(fn, x, name="roi_align")
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    boxes_arr = np.asarray(boxes._data if isinstance(boxes, Tensor) else boxes)
+    bn = np.asarray(boxes_num._data if isinstance(boxes_num, Tensor) else boxes_num)
+    batch_idx = np.repeat(np.arange(len(bn)), bn)
+
+    def fn(feat):
+        n, c, h, w = feat.shape
+        outs = []
+        for i in range(boxes_arr.shape[0]):
+            x1, y1, x2, y2 = np.round(boxes_arr[i] * spatial_scale).astype(int)
+            x2, y2 = max(x2, x1 + 1), max(y2, y1 + 1)
+            img = feat[int(batch_idx[i]), :, max(y1, 0):min(y2, h), max(x1, 0):min(x2, w)]
+            # adaptive max pool to (oh, ow)
+            hh, ww = img.shape[1], img.shape[2]
+            rows = np.linspace(0, hh, oh + 1).astype(int)
+            cols = np.linspace(0, ww, ow + 1).astype(int)
+            pooled = jnp.stack([
+                jnp.stack([
+                    jnp.max(img[:, rows[r]:max(rows[r + 1], rows[r] + 1),
+                                cols[s]:max(cols[s + 1], cols[s] + 1)], axis=(1, 2))
+                    for s in range(ow)
+                ], axis=-1)
+                for r in range(oh)
+            ], axis=-2)
+            outs.append(pooled)
+        return jnp.stack(outs) if outs else jnp.zeros((0, c, oh, ow), feat.dtype)
+
+    return apply(fn, x, name="roi_pool")
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0, name=None):
+    def fn(pb, tb):
+        pw = pb[:, 2] - pb[:, 0] + (0 if box_normalized else 1)
+        ph = pb[:, 3] - pb[:, 1] + (0 if box_normalized else 1)
+        px = pb[:, 0] + pw * 0.5
+        py = pb[:, 1] + ph * 0.5
+        var = (
+            prior_box_var._data
+            if isinstance(prior_box_var, Tensor)
+            else jnp.asarray(prior_box_var if prior_box_var is not None else [1.0, 1.0, 1.0, 1.0])
+        )
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + (0 if box_normalized else 1)
+            th = tb[:, 3] - tb[:, 1] + (0 if box_normalized else 1)
+            tx = tb[:, 0] + tw * 0.5
+            ty = tb[:, 1] + th * 0.5
+            out = jnp.stack([
+                (tx - px) / pw, (ty - py) / ph,
+                jnp.log(tw / pw), jnp.log(th / ph),
+            ], axis=-1)
+            return out / var.reshape(1, 4) if var.ndim <= 1 else out / var
+        # decode
+        dv = tb.reshape(tb.shape[0], -1, 4)
+        v = var.reshape(1, 1, 4) if var.ndim <= 1 else var.reshape(var.shape[0], 1, 4)
+        dv = dv * v
+        ox = dv[..., 0] * pw[:, None] + px[:, None]
+        oy = dv[..., 1] * ph[:, None] + py[:, None]
+        ow_ = jnp.exp(dv[..., 2]) * pw[:, None]
+        oh_ = jnp.exp(dv[..., 3]) * ph[:, None]
+        return jnp.stack([ox - ow_ / 2, oy - oh_ / 2, ox + ow_ / 2, oy + oh_ / 2], axis=-1).squeeze(1)
+
+    return apply(fn, prior_box, target_box, name="box_coder")
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False, name=None):
+    h, w = input.shape[2], input.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+    step_h = steps[1] or ih / h
+    step_w = steps[0] or iw / w
+    ars = list(aspect_ratios)
+    if flip:
+        ars += [1.0 / a for a in aspect_ratios if a != 1.0]
+    boxes = []
+    for i in range(h):
+        for j in range(w):
+            cx = (j + offset) * step_w
+            cy = (i + offset) * step_h
+            for k, ms in enumerate(min_sizes):
+                for a in ars:
+                    bw = ms * np.sqrt(a) / 2
+                    bh = ms / np.sqrt(a) / 2
+                    boxes.append([(cx - bw) / iw, (cy - bh) / ih, (cx + bw) / iw, (cy + bh) / ih])
+                if max_sizes:
+                    s = np.sqrt(ms * max_sizes[k])
+                    boxes.append([(cx - s / 2) / iw, (cy - s / 2) / ih, (cx + s / 2) / iw, (cy + s / 2) / ih])
+    arr = np.asarray(boxes, np.float32).reshape(h, w, -1, 4)
+    if clip:
+        arr = np.clip(arr, 0, 1)
+    var = np.broadcast_to(np.asarray(variance, np.float32), arr.shape).copy()
+    return Tensor(jnp.asarray(arr)), Tensor(jnp.asarray(var))

@@ -1,0 +1,230 @@
+"""Vision transforms (reference: python/paddle/vision/transforms/) —
+numpy-based, CHW float arrays."""
+from __future__ import annotations
+
+import numbers
+import random as pyrandom
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "Compose", "ToTensor", "Normalize", "Resize", "RandomCrop", "CenterCrop",
+    "RandomHorizontalFlip", "RandomVerticalFlip", "Transpose", "Pad",
+    "RandomRotation", "BrightnessTransform", "ContrastTransform",
+    "to_tensor", "normalize", "resize", "hflip", "vflip",
+]
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+def _chw(img):
+    img = np.asarray(img)
+    if img.ndim == 2:
+        return img[None]
+    if img.ndim == 3 and img.shape[-1] in (1, 3, 4) and img.shape[0] not in (1, 3, 4):
+        return img.transpose(2, 0, 1)
+    return img
+
+
+def to_tensor(img, data_format="CHW"):
+    arr = _chw(img).astype(np.float32)
+    if arr.max() > 1.5:
+        arr = arr / 255.0
+    return arr
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return to_tensor(img, self.data_format)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    arr = np.asarray(img, np.float32)
+    mean = np.asarray(mean, np.float32).reshape(-1, 1, 1)
+    std = np.asarray(std, np.float32).reshape(-1, 1, 1)
+    return (arr - mean) / std
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False, keys=None):
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean, self.std = mean, std
+
+    def __call__(self, img):
+        arr = np.asarray(img, np.float32)
+        c = arr.shape[0]
+        mean = np.asarray(self.mean[:c] if len(self.mean) >= c else self.mean * c, np.float32).reshape(-1, 1, 1)
+        std = np.asarray(self.std[:c] if len(self.std) >= c else self.std * c, np.float32).reshape(-1, 1, 1)
+        return (arr - mean) / std
+
+
+def resize(img, size, interpolation="bilinear"):
+    arr = _chw(np.asarray(img)).astype(np.float32)
+    c, h, w = arr.shape
+    if isinstance(size, int):
+        if h < w:
+            oh, ow = size, int(size * w / h)
+        else:
+            oh, ow = int(size * h / w), size
+    else:
+        oh, ow = size
+    ys = np.linspace(0, h - 1, oh)
+    xs = np.linspace(0, w - 1, ow)
+    y0 = np.floor(ys).astype(int)
+    x0 = np.floor(xs).astype(int)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[None, :, None]
+    wx = (xs - x0)[None, None, :]
+    a = arr[:, y0][:, :, x0]
+    b = arr[:, y0][:, :, x1]
+    cta = arr[:, y1][:, :, x0]
+    d = arr[:, y1][:, :, x1]
+    return a * (1 - wy) * (1 - wx) + b * (1 - wy) * wx + cta * wy * (1 - wx) + d * wy * wx
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = size
+
+    def __call__(self, img):
+        return resize(img, self.size)
+
+
+class RandomCrop:
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0, padding_mode="constant", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def __call__(self, img):
+        arr = _chw(np.asarray(img))
+        if self.padding:
+            p = self.padding if isinstance(self.padding, (list, tuple)) else [self.padding] * 4
+            arr = np.pad(arr, ((0, 0), (p[1], p[3]), (p[0], p[2])))
+        c, h, w = arr.shape
+        th, tw = self.size
+        i = pyrandom.randint(0, max(h - th, 0))
+        j = pyrandom.randint(0, max(w - tw, 0))
+        return arr[:, i : i + th, j : j + tw]
+
+
+class CenterCrop:
+    def __init__(self, size, keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        arr = _chw(np.asarray(img))
+        c, h, w = arr.shape
+        th, tw = self.size
+        i = max((h - th) // 2, 0)
+        j = max((w - tw) // 2, 0)
+        return arr[:, i : i + th, j : j + tw]
+
+
+def hflip(img):
+    return np.asarray(img)[..., ::-1].copy()
+
+
+def vflip(img):
+    arr = np.asarray(img)
+    return arr[..., ::-1, :].copy()
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        if pyrandom.random() < self.prob:
+            return hflip(img)
+        return np.asarray(img)
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        if pyrandom.random() < self.prob:
+            return vflip(img)
+        return np.asarray(img)
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def __call__(self, img):
+        return np.asarray(img).transpose(self.order)
+
+
+class Pad:
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        self.padding = padding if isinstance(padding, (list, tuple)) else [padding] * 4
+        self.fill = fill
+
+    def __call__(self, img):
+        arr = _chw(np.asarray(img))
+        p = self.padding
+        if len(p) == 2:
+            p = [p[0], p[1], p[0], p[1]]
+        return np.pad(arr, ((0, 0), (p[1], p[3]), (p[0], p[2])), constant_values=self.fill)
+
+
+class RandomRotation:
+    def __init__(self, degrees, interpolation="nearest", expand=False, center=None, fill=0, keys=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+
+    def __call__(self, img):
+        import math
+
+        arr = _chw(np.asarray(img)).astype(np.float32)
+        angle = math.radians(pyrandom.uniform(*self.degrees))
+        c, h, w = arr.shape
+        cy, cx = (h - 1) / 2, (w - 1) / 2
+        yy, xx = np.mgrid[0:h, 0:w]
+        ys = cy + (yy - cy) * math.cos(angle) - (xx - cx) * math.sin(angle)
+        xs = cx + (yy - cy) * math.sin(angle) + (xx - cx) * math.cos(angle)
+        yi = np.clip(np.round(ys).astype(int), 0, h - 1)
+        xi = np.clip(np.round(xs).astype(int), 0, w - 1)
+        valid = (ys >= 0) & (ys < h) & (xs >= 0) & (xs < w)
+        out = arr[:, yi, xi] * valid[None]
+        return out
+
+
+class BrightnessTransform:
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def __call__(self, img):
+        f = 1 + pyrandom.uniform(-self.value, self.value)
+        return np.asarray(img, np.float32) * f
+
+
+class ContrastTransform:
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def __call__(self, img):
+        arr = np.asarray(img, np.float32)
+        f = 1 + pyrandom.uniform(-self.value, self.value)
+        mean = arr.mean()
+        return (arr - mean) * f + mean

@@ -1,0 +1,34 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh
+(SURVEY §4 takeaway (b): single-host multi-process parity tests → here,
+XLA CPU multi-device stands in for a TPU pod).
+
+Must run before jax initializes its backend: the axon site hook pins
+JAX_PLATFORMS=axon, so we override through jax.config.
+"""
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import warnings
+
+import numpy as np
+import pytest
+
+warnings.filterwarnings(
+    "ignore", message=".*dtype int64 requested.*", category=UserWarning
+)
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as paddle
+
+    paddle.seed(1234)
+    np.random.seed(1234)
+    yield

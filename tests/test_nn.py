@@ -1,0 +1,286 @@
+"""Layer library tests (reference analog: python API/layer tests,
+SURVEY §4.2)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear_shapes_and_params():
+    layer = nn.Linear(8, 4)
+    x = paddle.randn([2, 8])
+    y = layer(x)
+    assert y.shape == (2, 4)
+    params = layer.parameters()
+    assert len(params) == 2
+    assert params[0].shape == (8, 4)
+    np.testing.assert_allclose(
+        y.numpy(), x.numpy() @ params[0].numpy() + params[1].numpy(), rtol=1e-5
+    )
+
+
+def test_layer_state_dict_roundtrip():
+    m1 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    m2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    m2.set_state_dict(m1.state_dict())
+    x = paddle.randn([3, 4])
+    np.testing.assert_allclose(m1(x).numpy(), m2(x).numpy(), rtol=1e-6)
+
+
+def test_named_parameters_unique():
+    m = nn.Sequential(nn.Linear(2, 2), nn.Linear(2, 2))
+    names = [n for n, _ in m.named_parameters()]
+    assert len(names) == len(set(names)) == 4
+
+
+def test_conv2d_matches_manual():
+    conv = nn.Conv2D(1, 1, 3, padding=1, bias_attr=False)
+    x = paddle.ones([1, 1, 5, 5])
+    y = conv(x)
+    assert y.shape == (1, 1, 5, 5)
+    # center pixel = sum of kernel
+    k = conv.weight.numpy()
+    assert abs(y.numpy()[0, 0, 2, 2] - k.sum()) < 1e-5
+
+
+def test_conv2d_stride_groups():
+    conv = nn.Conv2D(4, 8, 3, stride=2, padding=1, groups=2)
+    x = paddle.randn([2, 4, 8, 8])
+    assert conv(x).shape == (2, 8, 4, 4)
+
+
+def test_conv_transpose():
+    deconv = nn.Conv2DTranspose(3, 6, 4, stride=2, padding=1)
+    x = paddle.randn([1, 3, 8, 8])
+    assert deconv(x).shape == (1, 6, 16, 16)
+
+
+def test_pooling():
+    x = paddle.randn([2, 3, 8, 8])
+    assert F.max_pool2d(x, 2, 2).shape == (2, 3, 4, 4)
+    assert F.avg_pool2d(x, 2, 2).shape == (2, 3, 4, 4)
+    assert F.adaptive_avg_pool2d(x, 1).shape == (2, 3, 1, 1)
+    np.testing.assert_allclose(
+        F.adaptive_avg_pool2d(x, 1).numpy().squeeze(),
+        x.numpy().mean(axis=(2, 3)),
+        rtol=1e-5,
+    )
+
+
+def test_batch_norm_train_eval():
+    bn = nn.BatchNorm2D(3)
+    x = paddle.randn([4, 3, 5, 5]) * 2 + 1
+    bn.train()
+    y = bn(x)
+    # normalized output: near zero mean, unit var per channel
+    yn = y.numpy()
+    assert abs(yn.mean()) < 1e-4
+    assert abs(yn.std() - 1) < 1e-2
+    # running stats moved toward batch stats
+    assert abs(bn._mean.numpy().mean()) > 1e-4
+    bn.eval()
+    y2 = bn(x)
+    assert y2.shape == x.shape
+
+
+def test_layer_norm_matches_numpy():
+    ln = nn.LayerNorm(6)
+    x = paddle.randn([2, 3, 6])
+    y = ln(x).numpy()
+    xn = x.numpy()
+    ref = (xn - xn.mean(-1, keepdims=True)) / np.sqrt(xn.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_rms_norm():
+    rms = nn.RMSNorm(8)
+    x = paddle.randn([2, 8])
+    y = rms(x).numpy()
+    xn = x.numpy()
+    ref = xn / np.sqrt((xn**2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    idx = paddle.to_tensor([[1, 2], [3, 4]])
+    y = emb(idx)
+    assert y.shape == (2, 2, 4)
+    np.testing.assert_allclose(y.numpy()[0, 0], emb.weight.numpy()[1], rtol=1e-6)
+
+
+def test_dropout_modes():
+    d = nn.Dropout(0.5)
+    x = paddle.ones([1000])
+    d.train()
+    y = d(x)
+    kept = (y.numpy() != 0).mean()
+    assert 0.3 < kept < 0.7
+    # upscale preserves expectation
+    assert abs(y.numpy().mean() - 1.0) < 0.15
+    d.eval()
+    np.testing.assert_allclose(d(x).numpy(), x.numpy())
+
+
+def test_activations_shapes():
+    x = paddle.randn([4, 4])
+    for layer in [nn.ReLU(), nn.GELU(), nn.Sigmoid(), nn.Tanh(), nn.Silu(),
+                  nn.LeakyReLU(), nn.Softmax(), nn.Hardswish(), nn.ELU(),
+                  nn.Softplus(), nn.Mish()]:
+        assert layer(x).shape == (4, 4)
+
+
+def test_cross_entropy_matches_numpy():
+    logits = paddle.randn([5, 7])
+    labels = paddle.to_tensor(np.random.randint(0, 7, (5,)))
+    loss = F.cross_entropy(logits, labels).item()
+    ln = logits.numpy()
+    p = np.exp(ln - ln.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = -np.log(p[np.arange(5), labels.numpy()]).mean()
+    assert abs(loss - ref) < 1e-5
+
+
+def test_cross_entropy_ignore_index():
+    logits = paddle.randn([4, 3])
+    labels = paddle.to_tensor([0, 1, -100, 2])
+    loss = F.cross_entropy(logits, labels, ignore_index=-100).item()
+    ln = logits.numpy()
+    p = np.exp(ln - ln.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = -np.log(p[[0, 1, 3], [0, 1, 2]]).mean()
+    assert abs(loss - ref) < 1e-5
+
+
+def test_soft_label_and_smoothing():
+    logits = paddle.randn([3, 4])
+    soft = paddle.to_tensor(np.full((3, 4), 0.25, np.float32))
+    loss = F.cross_entropy(logits, soft, soft_label=True).item()
+    assert np.isfinite(loss)
+    labels = paddle.to_tensor([0, 1, 2])
+    l2 = F.cross_entropy(logits, labels, label_smoothing=0.1).item()
+    assert np.isfinite(l2)
+
+
+def test_mse_bce():
+    a = paddle.to_tensor([0.5, 0.5])
+    b = paddle.to_tensor([1.0, 0.0])
+    assert abs(F.mse_loss(a, b).item() - 0.25) < 1e-6
+    bce = F.binary_cross_entropy(a, b).item()
+    assert abs(bce + np.log(0.5)) < 1e-5
+
+
+def test_mha_self_attention():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.randn([2, 6, 16])
+    y = mha(x)
+    assert y.shape == (2, 6, 16)
+
+
+def test_mha_causal_mask_equivalence():
+    # bool mask keep=True lower triangle == is_causal path
+    q = paddle.randn([1, 4, 2, 8])
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas_ops import mha_reference
+
+    causal = mha_reference(q._data, q._data, q._data, None, True)
+    mask = jnp.tril(jnp.ones((4, 4), bool))[None, None]
+    masked = mha_reference(q._data, q._data, q._data, mask, False)
+    np.testing.assert_allclose(np.asarray(causal), np.asarray(masked), rtol=1e-5)
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(d_model=16, nhead=4, dim_feedforward=32, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, 2)
+    x = paddle.randn([2, 5, 16])
+    y = enc(x)
+    assert y.shape == (2, 5, 16)
+    # encoder layers must not share parameters
+    p = enc.layers[0].linear1.weight
+    q = enc.layers[1].linear1.weight
+    assert p is not q
+
+
+def test_transformer_full():
+    model = nn.Transformer(d_model=16, nhead=4, num_encoder_layers=1,
+                           num_decoder_layers=1, dim_feedforward=32, dropout=0.0)
+    src = paddle.randn([2, 6, 16])
+    tgt = paddle.randn([2, 4, 16])
+    out = model(src, tgt)
+    assert out.shape == (2, 4, 16)
+
+
+def test_lstm_layer():
+    lstm = nn.LSTM(8, 16, num_layers=2)
+    x = paddle.randn([3, 5, 8])
+    y, (h, c) = lstm(x)
+    assert y.shape == (3, 5, 16)
+    assert h.shape == (2, 3, 16)
+    assert c.shape == (2, 3, 16)
+
+
+def test_gru_bidirectional():
+    gru = nn.GRU(4, 6, direction="bidirect")
+    x = paddle.randn([2, 7, 4])
+    y, h = gru(x)
+    assert y.shape == (2, 7, 12)
+    assert h.shape == (2, 2, 6)
+
+
+def test_rnn_gradients_flow():
+    lstm = nn.LSTM(4, 4)
+    x = paddle.randn([2, 3, 4])
+    y, _ = lstm(x)
+    y.sum().backward()
+    for p in lstm.parameters():
+        assert p.grad is not None
+
+
+def test_sequential_and_layerlist():
+    s = nn.Sequential(("a", nn.Linear(2, 2)), ("b", nn.ReLU()))
+    assert s(paddle.randn([1, 2])).shape == (1, 2)
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 4
+    assert len(nn.Sequential(nn.Linear(2, 2), nn.ReLU())) == 2
+
+
+def test_hooks():
+    layer = nn.Linear(2, 2)
+    calls = []
+    h = layer.register_forward_post_hook(lambda l, i, o: calls.append(1))
+    layer(paddle.randn([1, 2]))
+    assert calls == [1]
+    h.remove()
+    layer(paddle.randn([1, 2]))
+    assert calls == [1]
+
+
+def test_layer_to_dtype():
+    m = nn.Linear(2, 2)
+    m.to(dtype="bfloat16")
+    assert str(m.weight.dtype) == "bfloat16"
+    y = m(paddle.randn([1, 2]).astype("bfloat16"))
+    assert str(y.dtype) == "bfloat16"
+
+
+def test_clip_grad_norm():
+    m = nn.Linear(4, 4)
+    x = paddle.randn([8, 4])
+    (m(x) * 100).sum().backward()
+    from paddle_tpu.nn import clip_grad_norm_
+
+    total = clip_grad_norm_(m.parameters(), 1.0)
+    g2 = sum((p.grad.numpy() ** 2).sum() for p in m.parameters())
+    assert abs(np.sqrt(g2) - 1.0) < 1e-4
+
+
+def test_pad_interpolate():
+    x = paddle.randn([1, 2, 4, 4])
+    assert F.pad(x, [1, 1, 2, 2]).shape == (1, 2, 8, 6)
+    assert F.interpolate(x, scale_factor=2, mode="nearest").shape == (1, 2, 8, 8)
+    assert F.interpolate(x, size=[2, 2], mode="bilinear").shape == (1, 2, 2, 2)
+    assert F.pixel_shuffle(paddle.randn([1, 4, 2, 2]), 2).shape == (1, 1, 4, 4)

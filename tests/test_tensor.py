@@ -1,0 +1,171 @@
+"""Tensor surface tests (reference analog: tensor method unit tests under
+python/paddle/fluid/tests/unittests/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_basic():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == (2, 2)
+    assert str(t.dtype) == "float32"
+    np.testing.assert_allclose(t.numpy(), [[1, 2], [3, 4]])
+
+
+def test_dtype_conversion():
+    t = paddle.to_tensor([1, 2, 3])
+    assert t.dtype == np.int64 or str(t.dtype) == "int32"
+    f = t.astype("float32")
+    assert str(f.dtype) == "float32"
+    b = f.astype(paddle.bfloat16)
+    assert str(b.dtype) == "bfloat16"
+
+
+def test_arithmetic_operators():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    b = paddle.to_tensor([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((a + b).numpy(), [5, 7, 9])
+    np.testing.assert_allclose((a - b).numpy(), [-3, -3, -3])
+    np.testing.assert_allclose((a * b).numpy(), [4, 10, 18])
+    np.testing.assert_allclose((b / a).numpy(), [4, 2.5, 2])
+    np.testing.assert_allclose((a ** 2).numpy(), [1, 4, 9])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2, -3])
+    np.testing.assert_allclose((1.0 + a).numpy(), [2, 3, 4])
+
+
+def test_comparison_and_logical():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    b = paddle.to_tensor([3.0, 2.0, 1.0])
+    assert (a < b).numpy().tolist() == [True, False, False]
+    assert (a == b).numpy().tolist() == [False, True, False]
+    assert paddle.logical_and(a > 1, b > 1).numpy().tolist() == [False, True, False]
+
+
+def test_indexing():
+    x = paddle.arange(12, dtype="float32").reshape([3, 4])
+    assert x[0].shape == (4,)
+    assert x[0, 1].item() == 1.0
+    assert x[:, 1:3].shape == (3, 2)
+    assert x[-1, -1].item() == 11.0
+    idx = paddle.to_tensor([0, 2])
+    assert x[idx].shape == (2, 4)
+
+
+def test_setitem():
+    x = paddle.zeros([3, 3])
+    x[1] = 5.0
+    assert x.numpy()[1].tolist() == [5, 5, 5]
+    x[0, 0] = -1.0
+    assert x[0, 0].item() == -1.0
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).shape == (2, 3)
+    assert paddle.ones([4]).numpy().sum() == 4
+    assert paddle.full([2], 7).numpy().tolist() == [7, 7]
+    assert paddle.arange(5).numpy().tolist() == [0, 1, 2, 3, 4]
+    assert paddle.linspace(0, 1, 5).shape == (5,)
+    assert paddle.eye(3).numpy().trace() == 3
+    z = paddle.zeros_like(paddle.ones([2, 2]))
+    assert z.numpy().sum() == 0
+
+
+def test_random_ops_seeded():
+    paddle.seed(7)
+    a = paddle.randn([4, 4]).numpy()
+    paddle.seed(7)
+    b = paddle.randn([4, 4]).numpy()
+    np.testing.assert_allclose(a, b)
+    u = paddle.uniform([100], min=0.0, max=1.0).numpy()
+    assert (u >= 0).all() and (u <= 1).all()
+    p = paddle.randperm(10).numpy()
+    assert sorted(p.tolist()) == list(range(10))
+
+
+def test_manipulation():
+    x = paddle.arange(24, dtype="float32").reshape([2, 3, 4])
+    assert paddle.transpose(x, [2, 0, 1]).shape == (4, 2, 3)
+    assert paddle.flatten(x, 1).shape == (2, 12)
+    assert paddle.unsqueeze(x, 0).shape == (1, 2, 3, 4)
+    assert paddle.squeeze(paddle.ones([1, 3, 1]), None).shape == (3,)
+    parts = paddle.split(x, 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 1, 4)
+    c = paddle.concat([x, x], axis=0)
+    assert c.shape == (4, 3, 4)
+    s = paddle.stack([x, x], axis=0)
+    assert s.shape == (2, 2, 3, 4)
+
+
+def test_reduction():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert x.sum().item() == 10
+    assert x.mean().item() == 2.5
+    assert paddle.max(x).item() == 4
+    assert paddle.sum(x, axis=0).numpy().tolist() == [4, 6]
+    assert paddle.sum(x, axis=1, keepdim=True).shape == (2, 1)
+    assert paddle.prod(x).item() == 24
+
+
+def test_search_sort():
+    x = paddle.to_tensor([[3.0, 1.0, 2.0]])
+    assert paddle.argmax(x, axis=-1).item() == 0
+    assert paddle.argmin(x, axis=-1).item() == 1
+    vals, idx = paddle.topk(x, 2)
+    assert vals.numpy().tolist() == [[3, 2]]
+    assert idx.numpy().tolist() == [[0, 2]]
+    s = paddle.sort(x, axis=-1)
+    assert s.numpy().tolist() == [[1, 2, 3]]
+
+
+def test_where_gather_scatter():
+    cond = paddle.to_tensor([True, False, True])
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    b = paddle.to_tensor([9.0, 8.0, 7.0])
+    assert paddle.where(cond, a, b).numpy().tolist() == [1, 8, 3]
+    g = paddle.gather(a, paddle.to_tensor([2, 0]))
+    assert g.numpy().tolist() == [3, 1]
+    sc = paddle.scatter(a, paddle.to_tensor([0]), paddle.to_tensor([5.0]))
+    assert sc.numpy().tolist() == [5, 2, 3]
+
+
+def test_einsum_matmul():
+    a = paddle.randn([2, 3])
+    b = paddle.randn([3, 4])
+    np.testing.assert_allclose(
+        paddle.einsum("ij,jk->ik", a, b).numpy(),
+        paddle.matmul(a, b).numpy(),
+        rtol=1e-5,
+    )
+    np.testing.assert_allclose(
+        paddle.matmul(a, b, transpose_y=False).numpy(), a.numpy() @ b.numpy(), rtol=1e-5
+    )
+
+
+def test_cast_clip_misc():
+    x = paddle.to_tensor([-2.0, 0.5, 3.0])
+    assert paddle.clip(x, 0.0, 1.0).numpy().tolist() == [0, 0.5, 1]
+    assert paddle.abs(x).numpy().tolist() == [2, 0.5, 3]
+    np.testing.assert_allclose(paddle.exp(paddle.zeros([2])).numpy(), [1, 1])
+    assert not bool(paddle.isnan(x).numpy().any())
+
+
+def test_save_load(tmp_path):
+    obj = {"w": paddle.randn([3, 3]), "step": 7, "nested": [paddle.ones([2])]}
+    p = str(tmp_path / "ckpt.pd")
+    paddle.save(obj, p)
+    loaded = paddle.load(p)
+    np.testing.assert_allclose(loaded["w"].numpy(), obj["w"].numpy())
+    assert loaded["step"] == 7
+    np.testing.assert_allclose(loaded["nested"][0].numpy(), [1, 1])
+
+
+def test_save_load_bf16(tmp_path):
+    obj = paddle.randn([4]).astype("bfloat16")
+    p = str(tmp_path / "b.pd")
+    paddle.save({"x": obj}, p)
+    loaded = paddle.load(p)
+    assert str(loaded["x"].dtype) == "bfloat16"
+    np.testing.assert_allclose(
+        loaded["x"].astype("float32").numpy(), obj.astype("float32").numpy()
+    )
