@@ -1,0 +1,24 @@
+"""paddle_tpu.models — flagship model families.
+
+Reference analog: the model zoo the reference ecosystem trains (GPT via
+fleet hybrid-parallel is the north-star config in BASELINE.md; vision
+models live in paddle_tpu.vision.models mirroring python/paddle/vision/models/).
+"""
+from .gpt import (
+    GPTConfig,
+    GPTModel,
+    GPTForCausalLM,
+    GPTPretrainingCriterion,
+    gpt_test_config,
+    gpt2_124m_config,
+    gpt3_1p3b_config,
+    gpt3_6p7b_config,
+)
+from .bert import BertConfig, BertModel, BertForSequenceClassification
+
+__all__ = [
+    "GPTConfig", "GPTModel", "GPTForCausalLM", "GPTPretrainingCriterion",
+    "gpt_test_config", "gpt2_124m_config", "gpt3_1p3b_config",
+    "gpt3_6p7b_config",
+    "BertConfig", "BertModel", "BertForSequenceClassification",
+]

@@ -1,0 +1,107 @@
+"""BERT encoder family (BASELINE.md config 3: BERT-base fine-tune).
+
+Reference analog: transformer encoder stacks built from paddle.nn
+(python/paddle/nn/layer/transformer.py) + fused attention/FFN ops
+(paddle/fluid/operators/fused/fused_attention_op.cu, fused_feedforward_op.cu).
+Built here on paddle_tpu.nn.TransformerEncoder — attention runs through the
+same Pallas flash path as GPT.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from ..nn import functional as F
+from ..nn.common import Linear, Dropout, Embedding
+from ..nn.norm import LayerNorm
+from ..nn.transformer import TransformerEncoder, TransformerEncoderLayer
+
+__all__ = [
+    "BertConfig", "BertModel", "BertForSequenceClassification",
+    "bert_base_config",
+]
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    layer_norm_eps: float = 1e-12
+
+
+def bert_base_config(**kw):
+    return BertConfig(**kw)
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.word_embeddings = Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = Embedding(cfg.max_position_embeddings, cfg.hidden_size)
+        self.token_type_embeddings = Embedding(cfg.type_vocab_size, cfg.hidden_size)
+        self.layer_norm = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        seq = input_ids.shape[-1]
+        if position_ids is None:
+            position_ids = Tensor(jnp.arange(seq, dtype=jnp.int32))
+        if token_type_ids is None:
+            token_type_ids = Tensor(jnp.zeros(input_ids.shape, jnp.int32))
+        x = (
+            self.word_embeddings(input_ids)
+            + self.position_embeddings(position_ids)
+            + self.token_type_embeddings(token_type_ids)
+        )
+        return self.dropout(self.layer_norm(x))
+
+
+class BertPooler(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.dense = Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, x):
+        return F.tanh(self.dense(x[:, 0]))
+
+
+class BertModel(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        enc_layer = TransformerEncoderLayer(
+            cfg.hidden_size, cfg.num_attention_heads, cfg.intermediate_size,
+            dropout=cfg.hidden_dropout_prob, activation="gelu",
+            attn_dropout=cfg.attention_probs_dropout_prob,
+            act_dropout=cfg.hidden_dropout_prob,
+        )
+        self.encoder = TransformerEncoder(enc_layer, cfg.num_hidden_layers)
+        self.pooler = BertPooler(cfg)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None, attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        x = self.encoder(x, src_mask=attention_mask)
+        return x, self.pooler(x)
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, cfg: BertConfig, num_classes=2):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+        self.classifier = Linear(cfg.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None, attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, position_ids, attention_mask)
+        return self.classifier(self.dropout(pooled))

@@ -1,0 +1,310 @@
+"""GPT decoder family — the flagship pretraining model (BASELINE.md configs
+4/5: GPT-3 1.3B DP, GPT-3 6.7B TP+PP+sharding).
+
+Reference analog: the fleet hybrid-parallel GPT built from
+fleet/layers/mpu/mp_layers.py (VocabParallelEmbedding / ColumnParallelLinear /
+RowParallelLinear / ParallelCrossEntropy) + fused attention
+(paddle/fluid/operators/fused/fused_attention_op.cu) + fused FFN
+(fused_feedforward_op.cu) + fused_multi_transformer_op.cu.
+
+TPU-native design:
+- weights carry mesh-axis annotations ('mp' on hidden/head dims); GSPMD
+  inserts the tensor-parallel collectives the reference codes as c_* ops,
+- attention is the Pallas flash kernel (ops/pallas_ops.py) — blockwise,
+  never materializing the [s, s] score matrix,
+- sequence dim of activations is annotated 'sp' (sequence parallel) so
+  LN/residual/FFN work is sharded over sequence; attention gathers heads
+  instead (Ulysses-style all-to-all, derived by GSPMD from the layout
+  switch seq-sharded -> head-sharded),
+- everything is bf16-first with fp32 master weights in the optimizer.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply
+from ..nn.layer import Layer
+from ..nn import functional as F
+from ..nn.initializer import Normal
+from ..nn.norm import LayerNorm
+from ..nn.common import Linear, Dropout, Embedding
+from ..ops.pallas_ops import flash_attention
+from ..parallel import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelCrossEntropy, constraint, shard_parameter,
+)
+
+__all__ = [
+    "GPTConfig", "GPTModel", "GPTForCausalLM", "GPTPretrainingCriterion",
+    "gpt_test_config", "gpt2_124m_config", "gpt3_1p3b_config",
+    "gpt3_6p7b_config",
+]
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    hidden_dropout_prob: float = 0.0
+    attention_dropout_prob: float = 0.0
+    initializer_range: float = 0.02
+    layer_norm_epsilon: float = 1e-5
+    use_flash_attention: bool = True
+    # sequence-parallel activation annotation (no-op when sp axis is 1)
+    sequence_parallel: bool = True
+    # MoE: replace the dense FFN with a mixture of experts every n blocks
+    moe_every_n: int = 0
+    moe_num_experts: int = 0
+    moe_top_k: int = 2
+
+
+def gpt_test_config(**kw):
+    """Tiny config for tests/dryruns."""
+    d = dict(vocab_size=128, hidden_size=64, num_hidden_layers=2,
+             num_attention_heads=4, intermediate_size=128,
+             max_position_embeddings=64, sequence_parallel=True)
+    d.update(kw)
+    return GPTConfig(**d)
+
+
+def gpt2_124m_config(**kw):
+    d = dict(vocab_size=50304, hidden_size=768, num_hidden_layers=12,
+             num_attention_heads=12, intermediate_size=3072,
+             max_position_embeddings=1024)
+    d.update(kw)
+    return GPTConfig(**d)
+
+
+def gpt3_1p3b_config(**kw):
+    d = dict(vocab_size=50304, hidden_size=2048, num_hidden_layers=24,
+             num_attention_heads=16, intermediate_size=8192,
+             max_position_embeddings=2048)
+    d.update(kw)
+    return GPTConfig(**d)
+
+
+def gpt3_6p7b_config(**kw):
+    d = dict(vocab_size=50304, hidden_size=4096, num_hidden_layers=32,
+             num_attention_heads=32, intermediate_size=16384,
+             max_position_embeddings=2048)
+    d.update(kw)
+    return GPTConfig(**d)
+
+
+def _act_spec(cfg, ndim=3):
+    """Activation sharding spec [batch, seq, hidden...]: dp on batch, sp on
+    sequence when enabled."""
+    seq_axis = "sp" if cfg.sequence_parallel else None
+    return ["dp", seq_axis] + [None] * (ndim - 2)
+
+
+class GPTEmbeddings(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.word_embeddings = VocabParallelEmbedding(
+            cfg.vocab_size, cfg.hidden_size,
+        )
+        init = Normal(std=cfg.initializer_range)
+        self.word_embeddings.weight.set_value(
+            init(self.word_embeddings.weight.shape, "float32")
+        )
+        self.position_embeddings = Embedding(
+            cfg.max_position_embeddings, cfg.hidden_size,
+        )
+        self.position_embeddings.weight.set_value(
+            init(self.position_embeddings.weight.shape, "float32")
+        )
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids, position_ids=None):
+        x = self.word_embeddings(input_ids)
+        if position_ids is None:
+            seq = input_ids.shape[-1]
+            position_ids = Tensor(jnp.arange(seq, dtype=jnp.int32))
+        x = x + self.position_embeddings(position_ids)
+        x = self.dropout(x)
+        return constraint(x, _act_spec(self.cfg))
+
+
+class GPTAttention(Layer):
+    """Fused causal self-attention (reference: fused_attention_op.cu +
+    mp_layers QKV column-parallel / out-proj row-parallel split)."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.num_heads = cfg.num_attention_heads
+        self.head_dim = cfg.hidden_size // cfg.num_attention_heads
+        self.qkv_proj = ColumnParallelLinear(
+            cfg.hidden_size, 3 * cfg.hidden_size, gather_output=False,
+        )
+        self.out_proj = RowParallelLinear(
+            cfg.hidden_size, cfg.hidden_size, input_is_parallel=True,
+        )
+        self.attn_drop = cfg.attention_dropout_prob
+
+    def forward(self, x):
+        b, s, h = x.shape
+        qkv = self.qkv_proj(x)                       # [b, s, 3h] mp-sharded last dim
+        qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
+        # heads carry the mp shard; seq gathers (sp -> heads layout switch)
+        qkv = constraint(qkv, ["dp", None, None, "mp", None])
+        q, k, v = qkv.unbind(axis=2)
+        o = flash_attention(
+            q, k, v, is_causal=True,
+            dropout_p=self.attn_drop, training=self.training,
+        )                                            # [b, s, heads, dim]
+        o = constraint(o, ["dp", None, "mp", None])
+        o = o.reshape([b, s, h])
+        return self.out_proj(o)
+
+
+class GPTMLP(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.fc_in = ColumnParallelLinear(
+            cfg.hidden_size, cfg.intermediate_size, gather_output=False,
+        )
+        self.fc_out = RowParallelLinear(
+            cfg.intermediate_size, cfg.hidden_size, input_is_parallel=True,
+        )
+
+    def forward(self, x):
+        return self.fc_out(F.gelu(self.fc_in(x), approximate=True))
+
+
+class GPTMoEMLP(Layer):
+    """Mixture-of-experts FFN (reference:
+    incubate/distributed/models/moe/moe_layer.py:260 — gate -> global_scatter
+    alltoall -> experts -> global_gather).
+
+    TPU-native: experts live in ONE stacked weight with the expert dim
+    annotated 'ep'; token dispatch is a dense einsum against the gate's
+    one-hot combine weights, and GSPMD derives the all-to-all from the
+    (tokens sharded over dp/sp) x (experts sharded over ep) contraction.
+    """
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.num_experts = cfg.moe_num_experts
+        self.top_k = cfg.moe_top_k
+        h, m = cfg.hidden_size, cfg.intermediate_size
+        self.gate = Linear(h, self.num_experts)
+        self.w_in = self.create_parameter(
+            shape=[self.num_experts, h, m],
+            default_initializer=Normal(std=cfg.initializer_range),
+        )
+        self.w_out = self.create_parameter(
+            shape=[self.num_experts, m, h],
+            default_initializer=Normal(std=cfg.initializer_range),
+        )
+        shard_parameter(self.w_in, ("ep", None, "mp"))
+        shard_parameter(self.w_out, ("ep", "mp", None))
+
+    def forward(self, x):
+        b, s, h = x.shape
+        logits = self.gate(x)                        # [b, s, E]
+
+        def moe(xa, gl, w_in, w_out):
+            probs = jax.nn.softmax(gl.astype(jnp.float32), axis=-1)
+            topv, topi = jax.lax.top_k(probs, self.top_k)
+            topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+            # dense combine weights [b, s, E]
+            comb = jnp.sum(
+                jax.nn.one_hot(topi, self.num_experts, dtype=probs.dtype)
+                * topv[..., None], axis=-2,
+            )
+            # dispatch: every expert sees all tokens, weighted (dense MoE —
+            # compile-friendly; capacity-based sparse dispatch is a Pallas
+            # follow-up). einsum contracts derive ep all-to-alls under GSPMD.
+            hidden = jnp.einsum("bsh,ehm->ebsm", xa, w_in)
+            hidden = jax.nn.gelu(hidden)
+            out = jnp.einsum("ebsm,emh->ebsh", hidden, w_out)
+            out = jnp.einsum("ebsh,bse->bsh", out, comb.astype(out.dtype))
+            return out
+
+        return apply(moe, x, logits, self.w_in, self.w_out, name="moe_mlp")
+
+
+class GPTBlock(Layer):
+    def __init__(self, cfg: GPTConfig, layer_idx: int = 0):
+        super().__init__()
+        self.cfg = cfg
+        self.ln_1 = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+        self.attn = GPTAttention(cfg)
+        self.ln_2 = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+        use_moe = (
+            cfg.moe_every_n > 0
+            and cfg.moe_num_experts > 1
+            and (layer_idx + 1) % cfg.moe_every_n == 0
+        )
+        self.mlp = GPTMoEMLP(cfg) if use_moe else GPTMLP(cfg)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, x):
+        spec = _act_spec(self.cfg)
+        x = x + self.dropout(self.attn(self.ln_1(constraint(x, spec))))
+        x = x + self.dropout(self.mlp(self.ln_2(constraint(x, spec))))
+        return constraint(x, spec)
+
+
+class GPTModel(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = GPTEmbeddings(cfg)
+        self.h = [GPTBlock(cfg, i) for i in range(cfg.num_hidden_layers)]
+        for i, blk in enumerate(self.h):
+            self.add_sublayer(f"h_{i}", blk)
+        self.ln_f = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+
+    def forward(self, input_ids, position_ids=None):
+        x = self.embeddings(input_ids, position_ids)
+        for blk in self.h:
+            x = blk(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(Layer):
+    """LM head ties the (vocab-parallel) embedding weight."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.gpt = GPTModel(cfg)
+
+    def forward(self, input_ids, position_ids=None):
+        x = self.gpt(input_ids, position_ids)
+        w = self.gpt.embeddings.word_embeddings.weight
+        logits = apply(
+            lambda a, wt: jnp.einsum("bsh,vh->bsv", a, wt), x, w,
+            name="lm_head",
+        )
+        # logits vocab dim carries the mp shard (parallel cross-entropy eats it)
+        return constraint(logits, ["dp", "sp" if self.cfg.sequence_parallel else None, "mp"])
+
+
+class GPTPretrainingCriterion(Layer):
+    """Vocab-parallel cross entropy (reference:
+    c_softmax_with_cross_entropy_op.cu)."""
+
+    def __init__(self, cfg: Optional[GPTConfig] = None):
+        super().__init__()
+        self.ce = ParallelCrossEntropy()
+
+    def forward(self, logits, labels, loss_mask=None):
+        loss = self.ce(logits, labels)
+        if loss_mask is not None:
+            loss = loss * loss_mask
+            return loss.sum() / loss_mask.sum().clip(min=1.0)
+        return loss.mean()

@@ -22,7 +22,7 @@ from .mesh import (
 )
 from .api import (
     shard_parameter, shard_tensor, sharding_of, param_sharding, constraint,
-    replicated,
+    replicated, place_model,
 )
 from .mp_layers import (
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
@@ -33,7 +33,8 @@ from .random_ import RNGStatesTracker, get_rng_state_tracker, model_parallel_ran
 __all__ = [
     "init_mesh", "get_mesh", "set_mesh", "mesh_axes", "axis_size", "has_axis",
     "MeshGuard", "shard_parameter", "shard_tensor", "sharding_of",
-    "param_sharding", "constraint", "replicated", "ColumnParallelLinear",
+    "param_sharding", "constraint", "replicated", "place_model",
+    "ColumnParallelLinear",
     "RowParallelLinear", "VocabParallelEmbedding", "ParallelCrossEntropy",
     "RNGStatesTracker", "get_rng_state_tracker", "model_parallel_random_seed",
 ]

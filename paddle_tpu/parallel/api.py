@@ -20,7 +20,7 @@ from .mesh import get_mesh, axis_size
 
 __all__ = [
     "shard_parameter", "shard_tensor", "sharding_of", "param_sharding",
-    "constraint", "replicated",
+    "constraint", "replicated", "place_model",
 ]
 
 
@@ -79,9 +79,37 @@ def shard_tensor(x, axes, mesh=None):
     return jax.device_put(x, sh)
 
 
+def place_model(model):
+    """Device_put every parameter/buffer of a Layer onto the mesh per its
+    annotation (replicated when unannotated). The TPU-native analog of the
+    reference's per-group param broadcast at distributed_model() time
+    (meta_parallel/tensor_parallel.py:27)."""
+    for p in model.parameters():
+        p._data = jax.device_put(p._data, param_sharding(p))
+    for b in model.buffers():
+        b._data = jax.device_put(b._data, param_sharding(b))
+    return model
+
+
+def _divisible_spec(axes, shape):
+    """Drop axes whose degree doesn't divide the dim (GSPMD requires even
+    splits; undivisible dims stay replicated, e.g. tiny eager batches)."""
+    out = []
+    for a, d in zip(axes, shape):
+        if a is None:
+            out.append(None)
+            continue
+        parts = a if isinstance(a, (tuple, list)) else (a,)
+        deg = 1
+        for p in parts:
+            deg *= axis_size(p)
+        out.append(a if d % deg == 0 else None)
+    return tuple(out)
+
+
 def constraint(x, axes):
     """In-graph sharding hint (GSPMD boundary) — differentiable."""
-    sh = sharding_of(*axes)
+    sh = sharding_of(*_divisible_spec(axes, x.shape))
     return apply(
         lambda a: jax.lax.with_sharding_constraint(a, sh), x, name="sharding_constraint"
     )

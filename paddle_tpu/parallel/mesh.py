@@ -18,14 +18,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 _state = threading.local()
 
-AXIS_ORDER = ("dp", "sharding", "pp", "mp", "sp")
+AXIS_ORDER = ("dp", "sharding", "pp", "ep", "sp", "mp")
 
 
 def _current():
     return getattr(_state, "mesh", None)
 
 
-def init_mesh(dp=1, mp=1, pp=1, sharding=1, sp=1, devices=None) -> Mesh:
+def init_mesh(dp=1, mp=1, pp=1, sharding=1, sp=1, ep=1, devices=None) -> Mesh:
     """Build + install the global hybrid-parallel mesh.
 
     Axis order puts dp outermost and mp innermost so tensor-parallel
@@ -33,14 +33,14 @@ def init_mesh(dp=1, mp=1, pp=1, sharding=1, sp=1, devices=None) -> Mesh:
     [data, pipe, sharding, model] for the same reason — topology.py:56).
     """
     devices = list(devices if devices is not None else jax.devices())
-    need = dp * mp * pp * sharding * sp
+    need = dp * mp * pp * sharding * sp * ep
     if need > len(devices):
         raise ValueError(
-            f"mesh {dp}x{sharding}x{pp}x{sp}x{mp}={need} exceeds {len(devices)} devices"
+            f"mesh {dp}x{sharding}x{pp}x{ep}x{sp}x{mp}={need} exceeds {len(devices)} devices"
         )
     devices = devices[:need]
-    arr = np.array(devices).reshape(dp, sharding, pp, sp, mp)
-    mesh = Mesh(arr, ("dp", "sharding", "pp", "sp", "mp"))
+    arr = np.array(devices).reshape(dp, sharding, pp, ep, sp, mp)
+    mesh = Mesh(arr, ("dp", "sharding", "pp", "ep", "sp", "mp"))
     _state.mesh = mesh
     return mesh
 
@@ -53,8 +53,8 @@ def get_mesh() -> Optional[Mesh]:
     m = _current()
     if m is None:
         # default: trivial 1-axis mesh over all devices on 'dp'
-        devs = np.array(jax.devices()).reshape(-1, 1, 1, 1, 1)
-        m = Mesh(devs, ("dp", "sharding", "pp", "sp", "mp"))
+        devs = np.array(jax.devices()).reshape(-1, 1, 1, 1, 1, 1)
+        m = Mesh(devs, ("dp", "sharding", "pp", "ep", "sp", "mp"))
         _state.mesh = m
     return m
 
