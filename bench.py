@@ -1,0 +1,82 @@
+"""Headline benchmark: GPT pretrain step throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no in-tree numbers (BASELINE.md), so vs_baseline
+normalizes against a 40%-MFU run of the same model on this chip's peak —
+40% MFU is what a well-tuned A100+NCCL GPT config typically sustains, i.e.
+vs_baseline >= 1.0 means "at or above A100-class utilization" on the
+north-star metric (tokens/sec/chip at fixed model).
+"""
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    on_tpu = any(d.platform == "tpu" for d in jax.devices()) or any(
+        "axon" in str(d).lower() or "tpu" in str(d).lower() for d in jax.devices()
+    )
+
+    import paddle_tpu as paddle
+    from paddle_tpu import jit, optimizer, parallel
+    from paddle_tpu.models import (
+        GPTForCausalLM, GPTPretrainingCriterion, gpt2_124m_config,
+        gpt_test_config,
+    )
+
+    if on_tpu:
+        cfg = gpt2_124m_config(stacked_blocks=True, max_position_embeddings=1024)
+        batch, seq, steps, warmup = 8, 1024, 10, 3
+    else:  # CPU smoke fallback so the bench always emits a line
+        cfg = gpt_test_config(num_hidden_layers=2, stacked_blocks=True)
+        batch, seq, steps, warmup = 4, 32, 3, 1
+
+    paddle.seed(0)
+    parallel.init_mesh()
+    model = parallel.place_model(GPTForCausalLM(cfg))
+    crit = GPTPretrainingCriterion(cfg)
+    opt = optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters())
+
+    def step(x, y):
+        loss = crit(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    compiled = jit.compile(step, models=[model], optimizers=[opt])
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)).astype("int32"))
+    lab = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)).astype("int32"))
+
+    for _ in range(warmup):
+        loss = compiled(ids, lab)
+    _ = float(loss)  # sync
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = compiled(ids, lab)
+    _ = float(loss)  # sync
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+
+    # 40%-MFU baseline on this chip for this model (6*N FLOPs/token fwd+bwd)
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    flops_per_token = 6.0 * n_params
+    peak_flops = 197e12 if on_tpu else 5e9  # v5e bf16 peak; nominal CPU
+    baseline_tokens_per_sec = 0.40 * peak_flops / flops_per_token
+    print(json.dumps({
+        "metric": "gpt_124m_pretrain_tokens_per_sec_per_chip" if on_tpu
+        else "gpt_tiny_pretrain_tokens_per_sec_cpu_smoke",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/sec",
+        "vs_baseline": round(tokens_per_sec / baseline_tokens_per_sec, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

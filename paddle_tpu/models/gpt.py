@@ -30,7 +30,7 @@ from ..core.tensor import Tensor
 from ..core.dispatch import apply
 from ..nn.layer import Layer
 from ..nn import functional as F
-from ..nn.initializer import Normal
+from ..nn.initializer import Normal, Constant
 from ..nn.norm import LayerNorm
 from ..nn.common import Linear, Dropout, Embedding
 from ..ops.pallas_ops import flash_attention
@@ -41,8 +41,8 @@ from ..parallel import (
 
 __all__ = [
     "GPTConfig", "GPTModel", "GPTForCausalLM", "GPTPretrainingCriterion",
-    "gpt_test_config", "gpt2_124m_config", "gpt3_1p3b_config",
-    "gpt3_6p7b_config",
+    "GPTStackedBlocks", "gpt_test_config", "gpt2_124m_config",
+    "gpt3_1p3b_config", "gpt3_6p7b_config",
 ]
 
 
@@ -65,6 +65,10 @@ class GPTConfig:
     moe_every_n: int = 0
     moe_num_experts: int = 0
     moe_top_k: int = 2
+    # stacked blocks: one [L, ...] weight per tensor, scan/pipeline executed
+    # (enables pp>1; also O(1)-in-depth compile time)
+    stacked_blocks: bool = False
+    pp_num_microbatches: int = 0  # 0 -> pp degree
 
 
 def gpt_test_config(**kw):
@@ -236,6 +240,90 @@ class GPTMoEMLP(Layer):
         return apply(moe, x, logits, self.w_in, self.w_out, name="moe_mlp")
 
 
+class GPTStackedBlocks(Layer):
+    """All L transformer blocks as stacked [L, ...] weights, executed by
+    lax.scan (pp=1) or the GPipe collective-permute pipeline (pp>1) — see
+    parallel/pipeline.py. The TPU-native form of the reference's
+    PipelineLayer segmentation (pp_layers.py:209): stage assignment is the
+    'pp' shard of the leading dim, not host-side LayerDesc partitioning."""
+
+    PARAM_AXES = {
+        "ln1_w": ("pp", None), "ln1_b": ("pp", None),
+        "qkv_w": ("pp", None, "mp"), "qkv_b": ("pp", "mp"),
+        "out_w": ("pp", "mp", None), "out_b": ("pp", None),
+        "ln2_w": ("pp", None), "ln2_b": ("pp", None),
+        "fc_in_w": ("pp", None, "mp"), "fc_in_b": ("pp", "mp"),
+        "fc_out_w": ("pp", "mp", None), "fc_out_b": ("pp", None),
+    }
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        if cfg.hidden_dropout_prob or cfg.attention_dropout_prob:
+            raise ValueError("stacked_blocks path does not support dropout yet")
+        if cfg.moe_every_n > 0:
+            raise ValueError(
+                "stacked_blocks path does not support MoE; use stacked_blocks=False"
+            )
+        self.cfg = cfg
+        L, H, I = cfg.num_hidden_layers, cfg.hidden_size, cfg.intermediate_size
+        init = Normal(std=cfg.initializer_range)
+        shapes = {
+            "ln1_w": [L, H], "ln1_b": [L, H],
+            "qkv_w": [L, H, 3 * H], "qkv_b": [L, 3 * H],
+            "out_w": [L, H, H], "out_b": [L, H],
+            "ln2_w": [L, H], "ln2_b": [L, H],
+            "fc_in_w": [L, H, I], "fc_in_b": [L, I],
+            "fc_out_w": [L, I, H], "fc_out_b": [L, H],
+        }
+        for name, shape in shapes.items():
+            if name.endswith("_b") or name.startswith("ln"):
+                fill = 1.0 if name in ("ln1_w", "ln2_w") else 0.0
+                p = self.create_parameter(
+                    shape=shape, default_initializer=Constant(fill)
+                )
+            else:
+                p = self.create_parameter(shape=shape, default_initializer=init)
+            shard_parameter(p, self.PARAM_AXES[name])
+            setattr(self, name, p)
+        self._names = list(shapes)
+
+    def forward(self, x):
+        from ..parallel.pipeline import pipeline_apply
+        from ..ops.pallas_ops import flash_attention_arrays
+
+        cfg = self.cfg
+        nh, hd = cfg.num_attention_heads, cfg.hidden_size // cfg.num_attention_heads
+        eps = cfg.layer_norm_epsilon
+        names = self._names
+        n_micro = cfg.pp_num_microbatches or None
+
+        def ln(h, w, b):
+            h32 = h.astype(jnp.float32)
+            mu = h32.mean(-1, keepdims=True)
+            var = ((h32 - mu) ** 2).mean(-1, keepdims=True)
+            return ((h32 - mu) * jax.lax.rsqrt(var + eps)).astype(h.dtype) * w + b
+
+        def block(p, h):
+            mb, s, H = h.shape
+            hn = ln(h, p["ln1_w"], p["ln1_b"])
+            qkv = hn @ p["qkv_w"] + p["qkv_b"]
+            qkv = qkv.reshape(mb, s, 3, nh, hd)
+            o = flash_attention_arrays(
+                qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], is_causal=True
+            )
+            h = h + o.reshape(mb, s, H) @ p["out_w"] + p["out_b"]
+            hn = ln(h, p["ln2_w"], p["ln2_b"])
+            m = jax.nn.gelu(hn @ p["fc_in_w"] + p["fc_in_b"], approximate=True)
+            return h + m @ p["fc_out_w"] + p["fc_out_b"]
+
+        def fn(a, *flat):
+            params = dict(zip(names, flat))
+            return pipeline_apply(block, params, a, n_microbatches=n_micro)
+
+        tensors = [getattr(self, n) for n in names]
+        return apply(fn, x, *tensors, name="gpt_stacked_blocks")
+
+
 class GPTBlock(Layer):
     def __init__(self, cfg: GPTConfig, layer_idx: int = 0):
         super().__init__()
@@ -263,15 +351,22 @@ class GPTModel(Layer):
         super().__init__()
         self.cfg = cfg
         self.embeddings = GPTEmbeddings(cfg)
-        self.h = [GPTBlock(cfg, i) for i in range(cfg.num_hidden_layers)]
-        for i, blk in enumerate(self.h):
-            self.add_sublayer(f"h_{i}", blk)
+        if cfg.stacked_blocks:
+            self.blocks = GPTStackedBlocks(cfg)
+            self.h = []
+        else:
+            self.h = [GPTBlock(cfg, i) for i in range(cfg.num_hidden_layers)]
+            for i, blk in enumerate(self.h):
+                self.add_sublayer(f"h_{i}", blk)
         self.ln_f = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
 
     def forward(self, input_ids, position_ids=None):
         x = self.embeddings(input_ids, position_ids)
-        for blk in self.h:
-            x = blk(x)
+        if self.cfg.stacked_blocks:
+            x = self.blocks(x)
+        else:
+            for blk in self.h:
+                x = blk(x)
         return self.ln_f(x)
 
 
