@@ -32,6 +32,8 @@ from .parallel_env import (
 )
 from . import fleet
 from . import checkpoint
+from . import sharding
+from .sharding import group_sharded_parallel, save_group_sharded_model
 from .launch_mod import spawn, launch
 
 __all__ = [
@@ -40,7 +42,8 @@ __all__ = [
     "all_gather_object", "reduce", "broadcast", "scatter", "reduce_scatter",
     "alltoall", "alltoall_single", "all_to_all", "send", "recv", "barrier",
     "ReduceOp", "new_group", "get_group", "wait", "fleet", "spawn", "launch",
-    "checkpoint", "DataParallel",
+    "checkpoint", "DataParallel", "sharding", "group_sharded_parallel",
+    "save_group_sharded_model",
 ]
 
 

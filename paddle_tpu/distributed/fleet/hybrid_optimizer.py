@@ -21,26 +21,39 @@ from ...parallel.api import param_sharding
 __all__ = ["HybridParallelOptimizer", "DygraphShardingOptimizer"]
 
 
-def _shard_slot_sharding(param, mesh):
-    """Sharding for an optimizer slot of `param`: param's own spec with the
-    'sharding' axis prepended on the first dim it divides and that isn't
-    already sharded."""
-    base = getattr(param, "_sharding_axes", None) or (None,) * len(param.shape)
-    deg = axis_size("sharding")
+def shard_spec_with(base, shape, axis="sharding"):
+    """Compose `axis` into a per-dim spec: split the first dim whose size the
+    axis degree divides (stacking onto an existing single-axis annotation
+    when needed). Returns `base` unchanged if `axis` already appears, the
+    degree is 1, or no dim divides. The one dim-picker shared by slot
+    placement, anonymous state placement, and stage-3 param sharding."""
+    base = tuple(base) if base else (None,) * len(shape)
+    deg = axis_size(axis)
+    already = any(
+        a == axis or (isinstance(a, (tuple, list)) and axis in a) for a in base
+    )
+    if deg <= 1 or already:
+        return base
     spec = list(base)
-    if deg > 1:
-        for i, (dim, ax) in enumerate(zip(param.shape, base)):
-            if ax is None and dim % deg == 0:
-                spec[i] = "sharding"
-                break
-            if isinstance(ax, str) and dim % (deg * axis_size(ax)) == 0:
-                spec[i] = (ax, "sharding")
-                break
-    cleaned = [
-        None if a is None else a
-        for a in spec
-    ]
-    return NamedSharding(mesh, PartitionSpec(*cleaned))
+    for i, (dim, ax) in enumerate(zip(shape, base)):
+        if dim <= 0:
+            continue
+        if ax is None and dim % deg == 0:
+            spec[i] = axis
+            break
+        if isinstance(ax, str) and dim % (deg * axis_size(ax)) == 0:
+            spec[i] = (ax, axis)
+            break
+    return tuple(spec)
+
+
+def _shard_slot_sharding(param, mesh, axis="sharding"):
+    """Sharding for an optimizer slot of `param`: param's own spec with the
+    sharding axis composed onto the first dim it divides."""
+    base = getattr(param, "_sharding_axes", None)
+    return NamedSharding(
+        mesh, PartitionSpec(*shard_spec_with(base, param.shape, axis))
+    )
 
 
 class HybridParallelOptimizer:
@@ -114,6 +127,15 @@ class HybridParallelOptimizer:
 
     def __getattr__(self, name):
         return getattr(self.__dict__["_inner_opt"], name)
+
+    def __setattr__(self, name, value):
+        # jit.compile installs traced lr/step overrides on whatever object it
+        # was handed; forward them to the inner optimizer, whose step() reads
+        # them — otherwise they'd land on the wrapper and be ignored.
+        if name in ("_lr_override", "_step_override") and "_inner_opt" in self.__dict__:
+            setattr(self.__dict__["_inner_opt"], name, value)
+        else:
+            object.__setattr__(self, name, value)
 
 
 class DygraphShardingOptimizer(HybridParallelOptimizer):

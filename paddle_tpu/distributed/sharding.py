@@ -1,0 +1,171 @@
+"""Group-sharded (ZeRO 1/2/3) data parallelism, TPU-native.
+
+Reference analog: python/paddle/distributed/sharding/group_sharded.py
+(`group_sharded_parallel`, save util :179) and the dygraph stage
+implementations under fleet/meta_parallel/sharding/
+(GroupShardedOptimizerStage2, GroupShardedStage2/3) plus
+DygraphShardingOptimizer (dygraph_optimizer/dygraph_sharding_optimizer.py:29).
+
+TPU-native re-design (SURVEY §7 "hard parts"): the reference's hook-driven
+gather/release machinery does not translate — XLA compiles the whole train
+step, so ZeRO becomes *weight-update sharding*: we place optimizer slot
+state (stage 1), gradients (stage 2), and parameters (stage 3) with a
+NamedSharding split on the 'sharding' mesh axis, and GSPMD inserts the
+reduce-scatter (grads → sharded update) and all-gather (params → forward)
+collectives on ICI automatically. No per-param hooks, no buckets — the
+XLA latency-hiding scheduler overlaps the collectives with compute, which
+is the role the reference's bucketing/overlap code played.
+
+Levels (same strings as the reference):
+  "os"     — optimizer-state sharding (stage 1)
+  "os_g"   — + gradient sharding     (stage 2)
+  "p_g_os" — + parameter sharding    (stage 3)
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..core.tensor import Tensor
+from ..parallel.mesh import get_mesh, axis_size
+from ..parallel.api import param_sharding
+from .fleet.hybrid_optimizer import (
+    _shard_slot_sharding,
+    shard_spec_with,
+    DygraphShardingOptimizer,
+)
+
+__all__ = [
+    "group_sharded_parallel",
+    "save_group_sharded_model",
+    "ShardingPlacer",
+    "DygraphShardingOptimizer",
+]
+
+
+class ShardingPlacer:
+    """Places an optimizer slot/master/grad array with the owning param's
+    sharding spec PLUS the 'sharding' axis on the first divisible free dim
+    (fleet/hybrid_optimizer.py:_shard_slot_sharding — composes with an
+    existing tensor-parallel annotation instead of dropping it). Installed
+    on an Optimizer as `_state_placer`; `Optimizer._ensure_state` and
+    `set_state_dict` run every slot/master array through it."""
+
+    def __init__(self, axis: str = "sharding"):
+        self.axis = axis
+
+    def __call__(self, arr, param=None):
+        if param is not None and len(param.shape) == len(arr.shape):
+            sh = _shard_slot_sharding(param, get_mesh(), self.axis)
+        else:
+            spec = shard_spec_with(None, arr.shape, self.axis)
+            sh = NamedSharding(get_mesh(), PartitionSpec(*spec))
+        try:
+            return jax.device_put(arr, sh)
+        except Exception:
+            return arr
+
+
+def _shard_params_stage3(model, axis: str = "sharding"):
+    """Annotate + place every parameter split on `axis` (dim chosen by
+    divisibility; composes with an existing tensor-parallel annotation by
+    picking a different dim)."""
+    for p in model.parameters():
+        if not p.shape:
+            continue
+        spec = shard_spec_with(p._sharding_axes, p.shape, axis)
+        if spec != tuple(p._sharding_axes or (None,) * len(p.shape)):
+            p._sharding_axes = spec
+        p._data = jax.device_put(p._data, param_sharding(p))
+    return model
+
+
+def group_sharded_parallel(
+    model,
+    optimizer,
+    level: str,
+    scaler=None,
+    group=None,
+    offload: bool = False,
+    sync_buffers: bool = False,
+    buffer_max_size: int = 2 ** 23,
+    segment_size: int = 2 ** 20,
+    sync_comm: bool = False,
+    dp_group=None,
+    exclude_layer=None,
+):
+    """Shard a model + optimizer over the 'sharding' mesh axis.
+
+    Mirrors the reference API (group_sharded.py): returns
+    (model, optimizer, scaler). `offload`/buffer sizes are accepted for
+    API parity; XLA owns memory scheduling on TPU so they are no-ops.
+    """
+    if level not in ("os", "os_g", "p_g_os"):
+        raise ValueError(f"level must be one of os | os_g | p_g_os, got {level!r}")
+
+    # Accept fleet wrappers (HybridParallelOptimizer / DygraphShardingOptimizer)
+    # — the placer must land on the inner Optimizer whose step() reads it.
+    optimizer = getattr(optimizer, "_inner_opt", optimizer)
+
+    if axis_size("sharding") <= 1:
+        import warnings
+
+        warnings.warn(
+            "group_sharded_parallel: mesh has no 'sharding' axis of size > 1 "
+            "(init_mesh(sharding=N) first) — everything stays replicated and "
+            "ZeRO saves no memory.",
+            stacklevel=2,
+        )
+
+    placer = ShardingPlacer("sharding")
+    optimizer._state_placer = placer
+    # Re-place any states that already exist.
+    param_of = {id(p): p for p in optimizer._parameter_list}
+    for key, slots in optimizer._states.items():
+        optimizer._states[key] = {
+            k: placer(v, param_of.get(key)) for k, v in slots.items()
+        }
+    for key, arr in optimizer._master_weights.items():
+        optimizer._master_weights[key] = placer(arr, param_of.get(key))
+
+    if level in ("os_g", "p_g_os"):
+        optimizer._shard_grads = placer
+
+    if level == "p_g_os":
+        _shard_params_stage3(model, "sharding")
+
+    if sync_buffers:
+        # Buffers replicate across the mesh (device_put with no partition).
+        mesh = get_mesh()
+        rep = NamedSharding(mesh, PartitionSpec())
+        for b in model.buffers():
+            b._data = jax.device_put(b._data, rep)
+
+    return model, optimizer, scaler
+
+
+def save_group_sharded_model(model, output: str, optimizer=None):
+    """Gather the sharded model (and optimizer) to host and save
+    (reference: group_sharded.py:179 — rank-0 consolidated save)."""
+    import os
+
+    from ..framework.io_ import save as _save
+
+    if output.endswith((".pdmodel", ".pdopt", ".pdparams")):
+        raise ValueError("output should be a directory, not a file path")
+    os.makedirs(output, exist_ok=True)
+    # np.asarray on a sharded jax.Array performs the all-gather to host.
+    state = {k: Tensor(np.asarray(v._data)) for k, v in model.state_dict().items()}
+    _save(state, os.path.join(output, "model.pdparams"))
+    if optimizer is not None:
+        ostate = {}
+        for k, v in optimizer.state_dict().items():
+            ostate[k] = Tensor(np.asarray(v._data)) if isinstance(v, Tensor) else v
+        _save(ostate, os.path.join(output, "model.pdopt"))
+
+
+# DygraphShardingOptimizer is fleet's class (re-exported above): one
+# implementation, hybrid-aware, shared by both entry points.

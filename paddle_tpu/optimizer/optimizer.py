@@ -60,6 +60,12 @@ class Optimizer:
         # program instead of baked constants.
         self._lr_override = None
         self._step_override = None
+        # ZeRO weight-update sharding (distributed.sharding): when set, every
+        # slot/master array is placed split over the 'sharding' mesh axis, and
+        # `_shard_grads` places incoming grads likewise (stage 2) so XLA
+        # reduce-scatters instead of all-reducing.
+        self._state_placer = None
+        self._shard_grads = None
 
     # -- lr ---------------------------------------------------------------
     def get_lr(self) -> float:
@@ -94,6 +100,14 @@ class Optimizer:
             self._states[key] = self._state_spec(
                 self._master_weights.get(key, arr)
             )
+            if self._state_placer is not None:
+                if key in self._master_weights:
+                    self._master_weights[key] = self._state_placer(
+                        self._master_weights[key], p
+                    )
+                self._states[key] = {
+                    k: self._state_placer(v, p) for k, v in self._states[key].items()
+                }
         return self._states[key]
 
     # -- the jitted whole-pytree update -----------------------------------
@@ -132,6 +146,13 @@ class Optimizer:
         if not params:
             return
         grads = [p.grad._data for p in params]
+        if self._shard_grads is not None and not any(
+            isinstance(g, jax.core.Tracer) for g in grads
+        ):
+            # Stage-2 eager path: place grads sharded before the update. Under
+            # jit tracing this is skipped — GSPMD derives the reduce-scatter
+            # from the sharded state placement alone.
+            grads = [self._shard_grads(g, p) for g, p in zip(grads, params)]
         if self._grad_clip is not None:
             grads = self._grad_clip.apply(grads)
         states = [self._ensure_state(p) for p in params]
@@ -183,6 +204,7 @@ class Optimizer:
             self._lr_scheduler.set_state_dict(state["LR_Scheduler"])
         for p in self._parameter_list:
             self._ensure_state(p)
+        param_of = {id(p): p for p in self._parameter_list}
         for k, v in state.items():
             if k in ("LR_Scheduler", "@step"):
                 continue
@@ -191,6 +213,10 @@ class Optimizer:
             if key is None:
                 continue
             arr = v._data if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+            if self._state_placer is not None:
+                # Keep resumed state ZeRO-sharded — loading it replicated
+                # would momentarily hold the full state per device.
+                arr = self._state_placer(arr, param_of.get(key))
             if sname == "master_weight":
                 self._master_weights[key] = arr
             else:
