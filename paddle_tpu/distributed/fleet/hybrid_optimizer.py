@@ -128,11 +128,14 @@ class HybridParallelOptimizer:
     def __getattr__(self, name):
         return getattr(self.__dict__["_inner_opt"], name)
 
+    _OWN_ATTRS = frozenset({"_inner_opt", "_hcg", "_strategy", "_placed"})
+
     def __setattr__(self, name, value):
-        # jit.compile installs traced lr/step overrides on whatever object it
-        # was handed; forward them to the inner optimizer, whose step() reads
-        # them — otherwise they'd land on the wrapper and be ignored.
-        if name in ("_lr_override", "_step_override") and "_inner_opt" in self.__dict__:
+        # Reads proxy to the inner optimizer (__getattr__), so writes must
+        # too — otherwise jit.compile's `opt._step_count += 1` or traced
+        # lr/step overrides land on the wrapper while step()/state_dict()
+        # read the inner's stale values.
+        if name not in self._OWN_ATTRS and "_inner_opt" in self.__dict__:
             setattr(self.__dict__["_inner_opt"], name, value)
         else:
             object.__setattr__(self, name, value)

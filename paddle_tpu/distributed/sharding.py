@@ -57,6 +57,8 @@ class ShardingPlacer:
     def __init__(self, axis: str = "sharding"):
         self.axis = axis
 
+    _warned = False
+
     def __call__(self, arr, param=None):
         if param is not None and len(param.shape) == len(arr.shape):
             sh = _shard_slot_sharding(param, get_mesh(), self.axis)
@@ -65,7 +67,18 @@ class ShardingPlacer:
             sh = NamedSharding(get_mesh(), PartitionSpec(*spec))
         try:
             return jax.device_put(arr, sh)
-        except Exception:
+        except Exception as e:
+            # Leave the array unplaced but say so once — silent fallback here
+            # means ZeRO is off and the user finds out as an OOM at scale.
+            if not ShardingPlacer._warned:
+                ShardingPlacer._warned = True
+                import warnings
+
+                warnings.warn(
+                    f"ShardingPlacer: device_put failed ({e!r}); optimizer "
+                    "state stays replicated (no ZeRO memory savings).",
+                    stacklevel=2,
+                )
             return arr
 
 
@@ -107,7 +120,9 @@ def group_sharded_parallel(
         raise ValueError(f"level must be one of os | os_g | p_g_os, got {level!r}")
 
     # Accept fleet wrappers (HybridParallelOptimizer / DygraphShardingOptimizer)
-    # — the placer must land on the inner Optimizer whose step() reads it.
+    # — the placer must land on the inner Optimizer whose step() reads it,
+    # but the caller keeps (and gets back) the object they passed in.
+    outer_optimizer = optimizer
     optimizer = getattr(optimizer, "_inner_opt", optimizer)
 
     if axis_size("sharding") <= 1:
@@ -144,7 +159,7 @@ def group_sharded_parallel(
         for b in model.buffers():
             b._data = jax.device_put(b._data, rep)
 
-    return model, optimizer, scaler
+    return model, outer_optimizer, scaler
 
 
 def save_group_sharded_model(model, output: str, optimizer=None):

@@ -61,6 +61,10 @@ class GPTConfig:
     use_flash_attention: bool = True
     # sequence-parallel activation annotation (no-op when sp axis is 1)
     sequence_parallel: bool = True
+    # context parallelism: keep the sequence sharded over 'sp' THROUGH
+    # attention via ring attention (parallel/ring.py) instead of gathering
+    # to full-sequence flash attention. The long-context path.
+    context_parallel: bool = False
     # MoE: replace the dense FFN with a mixture of experts every n blocks
     moe_every_n: int = 0
     moe_num_experts: int = 0
@@ -156,19 +160,44 @@ class GPTAttention(Layer):
             cfg.hidden_size, cfg.hidden_size, input_is_parallel=True,
         )
         self.attn_drop = cfg.attention_dropout_prob
+        if cfg.context_parallel and cfg.attention_dropout_prob:
+            import warnings
+
+            warnings.warn(
+                "context_parallel falls back to full-sequence attention while "
+                "attention dropout is active in training mode — long-context "
+                "memory savings are lost. Set attention_dropout_prob=0 to keep "
+                "the ring path.",
+                stacklevel=3,
+            )
 
     def forward(self, x):
+        from ..parallel.mesh import axis_size
+        from ..parallel.ring import ring_attention
+
         b, s, h = x.shape
         qkv = self.qkv_proj(x)                       # [b, s, 3h] mp-sharded last dim
         qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
-        # heads carry the mp shard; seq gathers (sp -> heads layout switch)
-        qkv = constraint(qkv, ["dp", None, None, "mp", None])
-        q, k, v = qkv.unbind(axis=2)
-        o = flash_attention(
-            q, k, v, is_causal=True,
-            dropout_p=self.attn_drop, training=self.training,
-        )                                            # [b, s, heads, dim]
-        o = constraint(o, ["dp", None, "mp", None])
+        use_ring = (
+            self.cfg.context_parallel
+            and axis_size("sp") > 1
+            and not (self.attn_drop and self.training)
+        )
+        if use_ring:
+            # context parallel: seq stays sharded over sp through attention
+            qkv = constraint(qkv, ["dp", "sp", None, "mp", None])
+            q, k, v = qkv.unbind(axis=2)
+            o = ring_attention(q, k, v, is_causal=True)
+            o = constraint(o, ["dp", "sp", "mp", None])
+        else:
+            # heads carry the mp shard; seq gathers (sp -> heads layout switch)
+            qkv = constraint(qkv, ["dp", None, None, "mp", None])
+            q, k, v = qkv.unbind(axis=2)
+            o = flash_attention(
+                q, k, v, is_causal=True,
+                dropout_p=self.attn_drop, training=self.training,
+            )                                        # [b, s, heads, dim]
+            o = constraint(o, ["dp", None, "mp", None])
         o = o.reshape([b, s, h])
         return self.out_proj(o)
 
@@ -288,7 +317,9 @@ class GPTStackedBlocks(Layer):
         self._names = list(shapes)
 
     def forward(self, x):
+        from ..parallel.mesh import axis_size
         from ..parallel.pipeline import pipeline_apply
+        from ..parallel.ring import ring_attention_arrays
         from ..ops.pallas_ops import flash_attention_arrays
 
         cfg = self.cfg
@@ -296,6 +327,12 @@ class GPTStackedBlocks(Layer):
         eps = cfg.layer_norm_epsilon
         names = self._names
         n_micro = cfg.pp_num_microbatches or None
+        # ring attention composes with the pp shard_map only when pp is
+        # degenerate (nested manual axes); pipeline stages fall back to
+        # full-sequence flash attention.
+        use_ring = (
+            cfg.context_parallel and axis_size("sp") > 1 and axis_size("pp") <= 1
+        )
 
         def ln(h, w, b):
             h32 = h.astype(jnp.float32)
@@ -308,9 +345,8 @@ class GPTStackedBlocks(Layer):
             hn = ln(h, p["ln1_w"], p["ln1_b"])
             qkv = hn @ p["qkv_w"] + p["qkv_b"]
             qkv = qkv.reshape(mb, s, 3, nh, hd)
-            o = flash_attention_arrays(
-                qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], is_causal=True
-            )
+            attn = ring_attention_arrays if use_ring else flash_attention_arrays
+            o = attn(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], is_causal=True)
             h = h + o.reshape(mb, s, H) @ p["out_w"] + p["out_b"]
             hn = ln(h, p["ln2_w"], p["ln2_b"])
             m = jax.nn.gelu(hn @ p["fc_in_w"] + p["fc_in_b"], approximate=True)

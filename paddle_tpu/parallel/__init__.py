@@ -29,6 +29,7 @@ from .mp_layers import (
     ParallelCrossEntropy, mp_allreduce, mp_identity,
 )
 from .random_ import RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed
+from .ring import ring_attention, ring_attention_arrays
 
 __all__ = [
     "init_mesh", "get_mesh", "set_mesh", "mesh_axes", "axis_size", "has_axis",
@@ -37,4 +38,5 @@ __all__ = [
     "ColumnParallelLinear",
     "RowParallelLinear", "VocabParallelEmbedding", "ParallelCrossEntropy",
     "RNGStatesTracker", "get_rng_state_tracker", "model_parallel_random_seed",
+    "ring_attention", "ring_attention_arrays",
 ]

@@ -1,0 +1,115 @@
+"""Ring attention — sequence/context parallelism over the 'sp' mesh axis.
+
+Capability gap the reference snapshot leaves open (SURVEY §5.7: no ring
+attention / context parallel / Ulysses anywhere; long sequences are handled
+only by recompute). Built natively here because long-context GPT pretrain
+is table stakes for the north-star config: the sequence stays sharded
+through attention, and K/V blocks rotate around the 'sp' ring via
+`lax.ppermute` (one ICI hop per step) while each device accumulates its
+queries' output with an online (flash-style) softmax. Peak memory per chip
+is O(S/n · S/n) attention scores instead of O(S · S), and compute/comm
+overlap rides XLA's latency-hiding scheduler.
+
+Layouts match ops/pallas_ops.py: q, k, v are [B, S, H, D].
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from .mesh import get_mesh, axis_size
+
+__all__ = ["ring_attention", "ring_attention_arrays"]
+
+
+def _ring_attn_local(q, k, v, *, axis_name, causal, scale):
+    """Per-shard body (inside shard_map): q/k/v hold the local sequence
+    chunk [B, Sq, H, D]; returns the local output chunk."""
+    n = jax.lax.psum(1, axis_name)  # static: axis size
+    my = jax.lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    qpos = my * sq + jnp.arange(sq)
+    qf = q.astype(jnp.float32) * scale
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def attend(o, m, l, k_blk, v_blk, i):
+        """Online-softmax accumulate the block that originated at ring
+        position (my - i) % n."""
+        src = (my - i) % n
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32))
+        if causal:
+            kpos = src * sq + jnp.arange(sq)
+            s = jnp.where(kpos[None, None, None, :] > qpos[None, None, :, None],
+                          -jnp.inf, s)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        o_new = o * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32)
+        )
+        return o_new, m_new, l_new
+
+    o0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    # step 0 visits the device's own (diagonal) block, which under a causal
+    # mask has unmasked entries — so m turns finite before any fully masked
+    # future block arrives and exp(-inf - finite) stays 0, not NaN.
+    m0 = jnp.full((b, h, sq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    o, m, l = attend(o0, m0, l0, k, v, 0)
+    if n > 1:
+        # permute-at-top so the ring does n-1 rotations, not n (the block a
+        # final rotation would produce is never attended).
+        def step(carry, i):
+            o, m, l, k_blk, v_blk = carry
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+            o, m, l = attend(o, m, l, k_blk, v_blk, i)
+            return (o, m, l, k_blk, v_blk), None
+
+        (o, m, l, _, _), _ = jax.lax.scan(step, (o, m, l, k, v), jnp.arange(1, n))
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def ring_attention_arrays(q, k, v, is_causal=True, scale=None, axis="sp"):
+    """Array-level ring attention: [B,S,H,D] with S sharded over `axis`.
+
+    Falls back to the single-shard flash path when the axis is degenerate.
+    """
+    from ..ops.pallas_ops import flash_attention_arrays
+
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    n = axis_size(axis)
+    if n <= 1:
+        return flash_attention_arrays(q, k, v, None, is_causal, scale)
+    if q.shape[1] % n != 0:
+        return flash_attention_arrays(q, k, v, None, is_causal, scale)
+
+    mesh = get_mesh()
+    # Only 'sp' is manual; batch/head dims stay in GSPMD-auto mode so dp/mp
+    # sharding (and an enclosing pp pipeline) keep composing.
+    spec = P(None, axis, None, None)
+    body = partial(_ring_attn_local, axis_name=axis, causal=is_causal, scale=scale)
+    fn = jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        axis_names=frozenset({axis}), check_vma=False,
+    )
+    return fn(q, k, v)
+
+
+def ring_attention(query, key, value, is_causal=True, scale=None, axis="sp", name=None):
+    """Tensor-level context-parallel attention (the long-context answer:
+    seq stays sharded over 'sp' end to end — no all-gather of activations)."""
+
+    def fn(q, k, v):
+        return ring_attention_arrays(q, k, v, is_causal, scale, axis)
+
+    return apply(fn, query, key, value, name="ring_attention")
