@@ -62,6 +62,8 @@ from . import incubate  # noqa: E402
 from . import utils  # noqa: E402
 from . import profiler  # noqa: E402
 from . import linalg  # noqa: E402
+from . import hapi  # noqa: E402
+from .hapi import Model, summary  # noqa: E402
 
 from .framework.io_ import save, load  # noqa: E402
 from .framework.core_ import (  # noqa: E402
@@ -76,4 +78,4 @@ disable_static = static.disable_static
 enable_static = static.enable_static
 in_dynamic_mode = static.in_dynamic_mode
 
-__all__ += ["save", "load", "set_default_dtype", "get_default_dtype", "set_device", "get_device"]
+__all__ += ["save", "load", "set_default_dtype", "get_default_dtype", "set_device", "get_device", "Model", "summary"]

@@ -1,0 +1,5 @@
+"""High-level training API (reference: python/paddle/hapi/)."""
+from .model import Model, summary
+from . import callbacks
+
+__all__ = ["Model", "summary", "callbacks"]

@@ -1,0 +1,349 @@
+"""High-level `Model` API (reference: python/paddle/hapi/model.py —
+Model:1004, fit:1696, evaluate/predict, save/load, summary).
+
+TPU-native notes: the reference switches between a dygraph adapter and a
+static-graph adapter; here eager execution *is* jax under the hood and the
+performance path is whole-graph jit (`paddle_tpu.jit.compile`), which
+`prepare(..., jit_compile=True)` turns on for train/eval batches.
+"""
+from __future__ import annotations
+
+import os
+import warnings
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..framework.io_ import save as _save, load as _load
+from ..io import DataLoader, Dataset
+from ..metric import Metric
+from ..nn.layer import Layer
+from .callbacks import config_callbacks
+
+__all__ = ["Model", "summary"]
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+def _to_tensor(x):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(np.asarray(x))
+
+
+class Model:
+    """Layer wrapper with train/eval/predict loops and callback hooks."""
+
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._inputs = _to_list(inputs)
+        self._labels = _to_list(labels)
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._jit_compile = False
+        self._compiled_train = None
+        self._compiled_eval = None
+        self.stop_training = False
+
+    # -- configuration -----------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None, jit_compile=False):
+        self._optimizer = optimizer
+        if loss is not None and not isinstance(loss, Layer) and not callable(loss):
+            raise TypeError("loss must be a Layer or a callable")
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric {m!r} is not a paddle_tpu.metric.Metric")
+        if amp_configs is not None:
+            warnings.warn("amp_configs: use amp.auto_cast/GradScaler directly; ignored here")
+        self._jit_compile = jit_compile
+        self._compiled_train = None
+        self._compiled_eval = None
+
+    def parameters(self, include_sublayers=True):
+        return self.network.parameters(include_sublayers=include_sublayers)
+
+    # -- single-batch ops --------------------------------------------------
+    def _compute_loss(self, outputs, labels):
+        outputs = _to_list(outputs)
+        labels = _to_list(labels)
+        if self._loss is None:
+            raise RuntimeError("loss not set; call prepare(loss=...) first")
+        return self._loss(*(outputs + labels))
+
+    def _metric_update(self, outputs, labels):
+        outputs = _to_list(outputs)
+        labels = _to_list(labels)
+        results = {}
+        for m in self._metrics:
+            computed = m.compute(*(outputs + labels))
+            if not isinstance(computed, (list, tuple)):
+                computed = [computed]
+            r = m.update(*computed)
+            results[m.name()] = r
+        return results
+
+    def _train_step(self, *data):
+        n_in = len(data) - 1 if len(data) > 1 else 1
+        if self._labels:
+            n_in = len(data) - len(self._labels)
+        inputs, labels = list(data[:n_in]), list(data[n_in:])
+        outputs = self.network(*inputs)
+        loss = self._compute_loss(outputs, labels)
+        loss.backward()
+        self._optimizer.step()
+        self._optimizer.clear_grad()
+        return loss, outputs, labels
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = [_to_tensor(x) for x in _to_list(inputs)]
+        labels = [_to_tensor(x) for x in _to_list(labels)]
+        data = inputs + labels
+        if self._jit_compile:
+            if self._compiled_train is None:
+                from .. import jit
+
+                self._compiled_train = jit.compile(
+                    self._train_step_fn_for_jit(len(inputs)),
+                    models=(self.network,),
+                    optimizers=(self._optimizer,),
+                )
+            loss = self._compiled_train(*data)
+            outputs = None
+        else:
+            loss, outputs, labels = self._train_step(*data)
+        logs = {"loss": float(loss.item() if isinstance(loss, Tensor) else loss)}
+        if outputs is not None and self._metrics:
+            logs.update(self._metric_update(outputs, labels))
+        return logs
+
+    def _train_step_fn_for_jit(self, n_in):
+        def step(*data):
+            inputs, labels = list(data[:n_in]), list(data[n_in:])
+            outputs = self.network(*inputs)
+            loss = self._compute_loss(outputs, labels)
+            loss.backward()
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+            return loss
+
+        return step
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        from ..autograd import no_grad
+
+        inputs = [_to_tensor(x) for x in _to_list(inputs)]
+        labels = [_to_tensor(x) for x in _to_list(labels)]
+        with no_grad():
+            outputs = self.network(*inputs)
+            logs = {}
+            if self._loss is not None and labels:
+                loss = self._compute_loss(outputs, labels)
+                logs["loss"] = float(loss.item())
+            logs.update(self._metric_update(outputs, labels))
+        return logs
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        from ..autograd import no_grad
+
+        inputs = [_to_tensor(x) for x in _to_list(inputs)]
+        with no_grad():
+            outputs = self.network(*inputs)
+        return [o.numpy() for o in _to_list(outputs)]
+
+    # -- loops -------------------------------------------------------------
+    def _make_loader(self, data, batch_size, shuffle, num_workers, drop_last=False):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              num_workers=num_workers, drop_last=drop_last)
+        return data  # any iterable of batches
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+        assert train_data is not None, "train_data must be given"
+        train_loader = self._make_loader(train_data, batch_size, shuffle,
+                                         num_workers, drop_last)
+        eval_loader = self._make_loader(eval_data, batch_size, False, num_workers)
+        steps = None
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            pass
+        metric_names = ["loss"] + [m.name() for m in self._metrics]
+        cbks = config_callbacks(
+            callbacks, model=self, epochs=epochs, steps=steps,
+            log_freq=log_freq, verbose=verbose, save_freq=save_freq,
+            save_dir=save_dir, metrics=metric_names,
+        )
+        self.stop_training = False
+        cbks.on_train_begin()
+        history = []
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                cbks.on_train_batch_begin(step)
+                batch = _to_list(batch)
+                logs = self.train_batch(batch[:-1] or batch, batch[-1:] if len(batch) > 1 else None)
+                cbks.on_train_batch_end(step, logs)
+                if self.stop_training:
+                    break
+            for m in self._metrics:
+                logs[m.name()] = m.accumulate()
+            cbks.on_epoch_end(epoch, logs)
+            history.append(dict(logs))
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self._run_eval(eval_loader, cbks)
+                history[-1].update({f"eval_{k}": v for k, v in eval_logs.items()})
+            if self.stop_training:
+                break
+        cbks.on_train_end(logs if history else {})
+        return history
+
+    def _run_eval(self, loader, cbks):
+        steps = None
+        try:
+            steps = len(loader)
+        except TypeError:
+            pass
+        for m in self._metrics:
+            m.reset()
+        cbks.on_eval_begin({"steps": steps})
+        logs = {}
+        losses = []
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step)
+            batch = _to_list(batch)
+            logs = self.eval_batch(batch[:-1] or batch, batch[-1:] if len(batch) > 1 else None)
+            if "loss" in logs:
+                losses.append(logs["loss"])
+            cbks.on_eval_batch_end(step, logs)
+        for m in self._metrics:
+            logs[m.name()] = m.accumulate()
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        cbks.on_eval_end(logs)
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None):
+        loader = self._make_loader(eval_data, batch_size, False, num_workers)
+        cbks = config_callbacks(callbacks, model=self, log_freq=log_freq,
+                                verbose=verbose,
+                                metrics=["loss"] + [m.name() for m in self._metrics])
+        return self._run_eval(loader, cbks)
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = self._make_loader(test_data, batch_size, False, num_workers)
+        cbks = config_callbacks(callbacks, model=self, verbose=verbose, metrics=[])
+        cbks.on_predict_begin()
+        outputs = []
+        for step, batch in enumerate(loader):
+            cbks.on_predict_batch_begin(step)
+            batch = _to_list(batch)
+            # datasets that yield (input, label) pairs: feed inputs only
+            if len(batch) > 1 and self._loss is not None:
+                batch = batch[:-1]
+            out = self.predict_batch(batch)
+            outputs.append(out)
+            cbks.on_predict_batch_end(step, {})
+        cbks.on_predict_end()
+        # transpose to per-output lists
+        n_out = len(outputs[0]) if outputs else 0
+        result = [[o[i] for o in outputs] for i in range(n_out)]
+        if stack_outputs:
+            result = [np.concatenate(r, axis=0) for r in result]
+        return result
+
+    # -- persistence -------------------------------------------------------
+    def save(self, path, training=True):
+        dirname = os.path.dirname(path)
+        if dirname:
+            os.makedirs(dirname, exist_ok=True)
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        params = _load(path + ".pdparams")
+        self.network.set_state_dict(params)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and os.path.exists(opt_path):
+            self._optimizer.set_state_dict(_load(opt_path))
+
+    def summary(self, input_size=None, dtype=None):
+        return summary(self.network, input_size, dtypes=dtype)
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):
+    """Layer-tree summary with parameter counts and (when an input is given)
+    per-layer output shapes (reference: python/paddle/hapi/model_summary.py)."""
+    rows = []
+    hooks = []
+    shapes = {}
+
+    def make_hook(key):
+        def hook(layer, inputs, outputs):
+            out = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
+            if isinstance(out, Tensor):
+                shapes[key] = list(out.shape)
+
+        return hook
+
+    named = list(net.named_sublayers(include_self=True))
+    if input is None and input_size is not None:
+        sizes = input_size if isinstance(input_size, list) else [input_size]
+        dts = dtypes if isinstance(dtypes, (list, tuple)) else [dtypes] * len(sizes)
+        input = [Tensor(np.zeros(s, dtype=np.dtype(d or "float32"))) for s, d in zip(sizes, dts)]
+        input = input[0] if len(input) == 1 else input
+    if input is not None:
+        for key, layer in named:
+            hooks.append(layer.register_forward_post_hook(make_hook(key)))
+        from ..autograd import no_grad
+
+        with no_grad():
+            net(*(_to_list(input)))
+        for h in hooks:
+            h.remove()
+
+    total, trainable = 0, 0
+    for key, layer in named:
+        own = [p for _, p in layer.named_parameters(include_sublayers=False)]
+        n = sum(int(np.prod(p.shape)) for p in own)
+        rows.append((key or net.__class__.__name__, layer.__class__.__name__,
+                     shapes.get(key), n))
+    for p in net.parameters():
+        n = int(np.prod(p.shape))
+        total += n
+        if getattr(p, "trainable", True):
+            trainable += n
+
+    lines = [f"{'Layer':40s} {'Type':24s} {'Output Shape':20s} {'Param #':>10s}"]
+    lines.append("-" * 98)
+    for name, cls, shape, n in rows:
+        lines.append(f"{name:40s} {cls:24s} {str(shape or '-'):20s} {n:>10d}")
+    lines.append("-" * 98)
+    lines.append(f"Total params: {total}")
+    lines.append(f"Trainable params: {trainable}")
+    lines.append(f"Non-trainable params: {total - trainable}")
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}

@@ -15,6 +15,7 @@ Layouts match ops/pallas_ops.py: q, k, v are [B, S, H, D].
 from __future__ import annotations
 
 import math
+import warnings
 from functools import partial
 
 import jax
@@ -22,7 +23,6 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..core.dispatch import apply
-from ..core.tensor import Tensor
 from .mesh import get_mesh, axis_size
 
 __all__ = ["ring_attention", "ring_attention_arrays"]
@@ -38,6 +38,9 @@ def _ring_attn_local(q, k, v, *, axis_name, causal, scale):
     qf = q.astype(jnp.float32) * scale
     perm = [(j, (j + 1) % n) for j in range(n)]
 
+    # TODO(perf): causal masking leaves blocks from src > my fully masked;
+    # a zig-zag layout (device holds chunks i and 2n-1-i) would balance the
+    # ring and recover ~2x attention throughput at large n.
     def attend(o, m, l, k_blk, v_blk, i):
         """Online-softmax accumulate the block that originated at ring
         position (my - i) % n."""
@@ -91,6 +94,11 @@ def ring_attention_arrays(q, k, v, is_causal=True, scale=None, axis="sp"):
     if n <= 1:
         return flash_attention_arrays(q, k, v, None, is_causal, scale)
     if q.shape[1] % n != 0:
+        warnings.warn(
+            f"ring_attention: seq len {q.shape[1]} not divisible by {axis} axis "
+            f"size {n}; falling back to full-sequence attention (peak memory "
+            f"O(S^2) per chip instead of O((S/n)^2))."
+        )
         return flash_attention_arrays(q, k, v, None, is_causal, scale)
 
     mesh = get_mesh()
@@ -112,4 +120,4 @@ def ring_attention(query, key, value, is_causal=True, scale=None, axis="sp", nam
     def fn(q, k, v):
         return ring_attention_arrays(q, k, v, is_causal, scale, axis)
 
-    return apply(fn, query, key, value, name="ring_attention")
+    return apply(fn, query, key, value, name=name or "ring_attention")

@@ -1,0 +1,105 @@
+"""hapi Model fit/evaluate/predict + callbacks (reference:
+python/paddle/hapi/model.py:1004,1696; callbacks.py:551,716)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.hapi.callbacks import EarlyStopping, VisualDL
+from paddle_tpu.io import TensorDataset
+from paddle_tpu.metric import Accuracy
+
+
+def _toy_data(n=64, d=8, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, d).astype("float32")
+    w = rng.randn(d, classes).astype("float32")
+    y = np.argmax(x @ w, axis=1).astype("int64")[:, None]
+    return paddle.to_tensor(x), paddle.to_tensor(y)
+
+
+def _model(d=8, classes=4):
+    return nn.Sequential(nn.Linear(d, 32), nn.ReLU(), nn.Linear(32, classes))
+
+
+def test_fit_evaluate_predict(tmp_path):
+    paddle.seed(0)
+    x, y = _toy_data()
+    ds = TensorDataset([x, y])
+    net = _model()
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=opt.Adam(learning_rate=0.01, parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(),
+        metrics=Accuracy(),
+    )
+    hist = model.fit(ds, epochs=8, batch_size=16, verbose=0,
+                     save_dir=str(tmp_path / "ckpt"))
+    assert hist[-1]["loss"] < hist[0]["loss"]
+    ev = model.evaluate(ds, batch_size=16, verbose=0)
+    assert ev["acc"] > 0.5
+    preds = model.predict(ds, batch_size=16, stack_outputs=True, verbose=0)
+    assert preds[0].shape == (64, 4)
+    # checkpoints were written
+    assert os.path.exists(str(tmp_path / "ckpt" / "final.pdparams"))
+
+
+def test_save_load_roundtrip(tmp_path):
+    paddle.seed(1)
+    x, y = _toy_data(seed=1)
+    net = _model()
+    model = paddle.Model(net)
+    model.prepare(optimizer=opt.SGD(0.1, parameters=net.parameters()),
+                  loss=nn.CrossEntropyLoss())
+    model.train_batch([x], [y])
+    path = str(tmp_path / "m")
+    model.save(path)
+
+    net2 = _model()
+    model2 = paddle.Model(net2)
+    model2.prepare(optimizer=opt.SGD(0.1, parameters=net2.parameters()),
+                   loss=nn.CrossEntropyLoss())
+    model2.load(path)
+    a = net(x).numpy()
+    b = net2(x).numpy()
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_early_stopping():
+    paddle.seed(2)
+    x, y = _toy_data(seed=2)
+    ds = TensorDataset([x, y])
+    net = _model()
+    model = paddle.Model(net)
+    # lr=0 -> eval loss plateaus from epoch 1, patience=0 stops immediately
+    model.prepare(optimizer=opt.SGD(0.0, parameters=net.parameters()),
+                  loss=nn.CrossEntropyLoss(), metrics=Accuracy())
+    es = EarlyStopping(monitor="loss", patience=0, verbose=0, save_best_model=False)
+    hist = model.fit(ds, eval_data=ds, epochs=50, batch_size=32, verbose=0,
+                     callbacks=[es])
+    assert len(hist) <= 3  # stopped early
+
+
+def test_visualdl_scalars(tmp_path):
+    paddle.seed(3)
+    x, y = _toy_data(seed=3)
+    ds = TensorDataset([x, y])
+    net = _model()
+    model = paddle.Model(net)
+    model.prepare(optimizer=opt.SGD(0.05, parameters=net.parameters()),
+                  loss=nn.CrossEntropyLoss())
+    logdir = str(tmp_path / "vdl")
+    model.fit(ds, epochs=2, batch_size=32, verbose=0,
+              callbacks=[VisualDL(logdir)])
+    content = open(os.path.join(logdir, "scalars.tsv")).read()
+    assert "train/loss" in content
+
+
+def test_summary():
+    net = _model()
+    info = paddle.summary(net, input_size=(2, 8))
+    assert info["total_params"] == 8 * 32 + 32 + 32 * 4 + 4
+    assert info["trainable_params"] == info["total_params"]

@@ -49,15 +49,17 @@ def test_ring_grads_match(sp_mesh):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a), rtol=5e-5, atol=5e-5)
 
 
-def test_gpt_context_parallel_training_parity(sp_mesh):
+@pytest.mark.parametrize("stacked", [False, True])
+def test_gpt_context_parallel_training_parity(sp_mesh, stacked):
     """A GPT trained with context_parallel=True follows the same loss curve
-    as the gather-based sequence-parallel path."""
+    as the gather-based sequence-parallel path (both the per-layer and the
+    scan-over-stacked-blocks topologies)."""
     from paddle_tpu import jit, optimizer
     from paddle_tpu.models import GPTForCausalLM, GPTPretrainingCriterion, gpt_test_config
 
     def run(cp):
         paddle.seed(11)
-        cfg = gpt_test_config(context_parallel=cp)
+        cfg = gpt_test_config(context_parallel=cp, stacked_blocks=stacked)
         model = parallel.place_model(GPTForCausalLM(cfg))
         crit = GPTPretrainingCriterion(cfg)
         opt = optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
