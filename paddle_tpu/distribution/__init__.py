@@ -1,0 +1,49 @@
+"""Probability distributions (reference: python/paddle/distribution/ —
+Normal/Uniform/Categorical/Beta/Dirichlet/Multinomial/Laplace/LogNormal/
+Gumbel, Transform zoo, TransformedDistribution, Independent, kl registry).
+
+TPU-native: samplers are counter-based jax.random draws from the global key
+stack (core/random.py), so sampling composes with jit and the per-mp-rank
+RNG tracker the same way dropout does.
+"""
+from .base import Distribution, ExponentialFamily
+from .continuous import (
+    Beta,
+    Dirichlet,
+    Exponential,
+    Gumbel,
+    Laplace,
+    LogNormal,
+    Normal,
+    Uniform,
+)
+from .discrete import Bernoulli, Categorical, Multinomial
+from .kl import kl_divergence, register_kl
+from .transform import (
+    AbsTransform,
+    AffineTransform,
+    ChainTransform,
+    ExpTransform,
+    Independent,
+    IndependentTransform,
+    PowerTransform,
+    ReshapeTransform,
+    SigmoidTransform,
+    SoftmaxTransform,
+    StackTransform,
+    StickBreakingTransform,
+    TanhTransform,
+    Transform,
+    TransformedDistribution,
+)
+
+__all__ = [
+    "Distribution", "ExponentialFamily", "Normal", "Uniform", "Beta",
+    "Dirichlet", "Laplace", "LogNormal", "Gumbel", "Exponential",
+    "Categorical", "Multinomial", "Bernoulli", "kl_divergence", "register_kl",
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+    "TransformedDistribution", "Independent",
+]

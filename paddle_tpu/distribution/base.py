@@ -1,0 +1,121 @@
+"""Distribution base classes (reference: python/paddle/distribution/
+distribution.py, exponential_family.py)."""
+from __future__ import annotations
+
+import numbers
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core import random as _rng
+
+__all__ = ["Distribution", "ExponentialFamily"]
+
+
+def _to_arr(x, dtype=None):
+    if isinstance(x, Tensor):
+        a = x._data
+    elif isinstance(x, (jnp.ndarray, jax.Array)):
+        a = x
+    else:
+        a = jnp.asarray(np.asarray(x))
+    if a.dtype == jnp.float64:
+        a = a.astype(jnp.float32)
+    if jnp.issubdtype(a.dtype, jnp.integer) and dtype is None:
+        a = a.astype(jnp.float32)
+    if dtype is not None:
+        a = a.astype(dtype)
+    return a
+
+
+def _shape(sample_shape):
+    if sample_shape is None:
+        return ()
+    if isinstance(sample_shape, numbers.Integral):
+        return (int(sample_shape),)
+    return tuple(int(s) for s in sample_shape)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    @property
+    def stddev(self):
+        return Tensor(jnp.sqrt(self.variance._data))
+
+    def sample(self, shape=()):
+        """Non-differentiable sample (detached)."""
+        s = self.rsample(shape)
+        s.stop_gradient = True
+        return s
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return Tensor(jnp.exp(self.log_prob(value)._data))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)
+
+    def _kl_closed_form(self, other):
+        """Closed-form KL(self || other), or None when no closed form
+        applies (the kl module then falls back to registry / Monte-Carlo)."""
+        return None
+
+    def _extend_shape(self, sample_shape):
+        return _shape(sample_shape) + self.batch_shape + self.event_shape
+
+
+class ExponentialFamily(Distribution):
+    """Exponential-family base: entropy via Bregman divergence of the
+    log-normalizer (reference trick: autodiff through `_log_normalizer`)."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0
+
+    def entropy(self):
+        nparams = [p for p in self._natural_parameters]
+        lg, grads = jax.value_and_grad(
+            lambda *ps: jnp.sum(self._log_normalizer(*ps)), argnums=tuple(range(len(nparams)))
+        )(*nparams)
+        ent = self._log_normalizer(*nparams) - self._mean_carrier_measure
+        for p, g in zip(nparams, grads):
+            ent = ent - p * g
+        return Tensor(ent)

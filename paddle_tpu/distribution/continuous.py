@@ -1,0 +1,303 @@
+"""Continuous distributions (reference: python/paddle/distribution/
+normal.py, uniform.py, beta.py, dirichlet.py, laplace.py, lognormal.py,
+gumbel.py)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import random as _rng
+from .base import Distribution, ExponentialFamily, _to_arr, _shape
+
+__all__ = ["Normal", "Uniform", "Beta", "Dirichlet", "Laplace", "LogNormal",
+           "Gumbel", "Exponential"]
+
+
+class Normal(ExponentialFamily):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _to_arr(loc)
+        self.scale = _to_arr(scale)
+        self.loc, self.scale = jnp.broadcast_arrays(self.loc, self.scale)
+        super().__init__(batch_shape=self.loc.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.loc)
+
+    @property
+    def variance(self):
+        return Tensor(self.scale**2)
+
+    def rsample(self, shape=()):
+        shape = self._extend_shape(shape)
+        eps = jax.random.normal(_rng.next_key(), shape, self.loc.dtype)
+        return Tensor(self.loc + self.scale * eps)
+
+    def log_prob(self, value):
+        v = _to_arr(value)
+        var = self.scale**2
+        return Tensor(
+            -((v - self.loc) ** 2) / (2 * var)
+            - jnp.log(self.scale)
+            - 0.5 * math.log(2 * math.pi)
+        )
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+                      + jnp.zeros_like(self.loc))
+
+    def cdf(self, value):
+        v = _to_arr(value)
+        return Tensor(0.5 * (1 + jax.scipy.special.erf(
+            (v - self.loc) / (self.scale * math.sqrt(2)))))
+
+    def icdf(self, value):
+        v = _to_arr(value)
+        return Tensor(self.loc + self.scale * math.sqrt(2)
+                      * jax.scipy.special.erfinv(2 * v - 1))
+
+    def _kl_closed_form(self, other):
+        if isinstance(other, Normal):
+            var_ratio = (self.scale / other.scale) ** 2
+            t1 = ((self.loc - other.loc) / other.scale) ** 2
+            return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+        return None
+
+
+class LogNormal(Normal):
+    def rsample(self, shape=()):
+        return Tensor(jnp.exp(super().rsample(shape)._data))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.exp(self.loc + self.scale**2 / 2))
+
+    @property
+    def variance(self):
+        s2 = self.scale**2
+        return Tensor((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2))
+
+    def log_prob(self, value):
+        v = _to_arr(value)
+        return Tensor(super().log_prob(Tensor(jnp.log(v)))._data - jnp.log(v))
+
+    def entropy(self):
+        return Tensor(super().entropy()._data + self.loc)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _to_arr(low)
+        self.high = _to_arr(high)
+        self.low, self.high = jnp.broadcast_arrays(self.low, self.high)
+        super().__init__(batch_shape=self.low.shape)
+
+    @property
+    def mean(self):
+        return Tensor((self.low + self.high) / 2)
+
+    @property
+    def variance(self):
+        return Tensor((self.high - self.low) ** 2 / 12)
+
+    def rsample(self, shape=()):
+        shape = self._extend_shape(shape)
+        u = jax.random.uniform(_rng.next_key(), shape, self.low.dtype)
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        v = _to_arr(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return Tensor(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low))
+
+
+class Beta(ExponentialFamily):
+    def __init__(self, alpha, beta):
+        self.alpha = _to_arr(alpha)
+        self.beta = _to_arr(beta)
+        self.alpha, self.beta = jnp.broadcast_arrays(self.alpha, self.beta)
+        super().__init__(batch_shape=self.alpha.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return Tensor(self.alpha * self.beta / (s**2 * (s + 1)))
+
+    def rsample(self, shape=()):
+        shape = self._extend_shape(shape)
+        return Tensor(jax.random.beta(_rng.next_key(), self.alpha, self.beta, shape))
+
+    def log_prob(self, value):
+        v = _to_arr(value)
+        lbeta = (jax.scipy.special.gammaln(self.alpha)
+                 + jax.scipy.special.gammaln(self.beta)
+                 - jax.scipy.special.gammaln(self.alpha + self.beta))
+        return Tensor((self.alpha - 1) * jnp.log(v)
+                      + (self.beta - 1) * jnp.log1p(-v) - lbeta)
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        dg = jax.scipy.special.digamma
+        lbeta = (jax.scipy.special.gammaln(a) + jax.scipy.special.gammaln(b)
+                 - jax.scipy.special.gammaln(a + b))
+        return Tensor(lbeta - (a - 1) * dg(a) - (b - 1) * dg(b)
+                      + (a + b - 2) * dg(a + b))
+
+
+class Dirichlet(ExponentialFamily):
+    def __init__(self, concentration):
+        self.concentration = _to_arr(concentration)
+        super().__init__(batch_shape=self.concentration.shape[:-1],
+                         event_shape=self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration
+                      / jnp.sum(self.concentration, -1, keepdims=True))
+
+    @property
+    def variance(self):
+        a0 = jnp.sum(self.concentration, -1, keepdims=True)
+        m = self.concentration / a0
+        return Tensor(m * (1 - m) / (a0 + 1))
+
+    def rsample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        return Tensor(jax.random.dirichlet(_rng.next_key(), self.concentration, shape))
+
+    def log_prob(self, value):
+        v = _to_arr(value)
+        a = self.concentration
+        return Tensor(jnp.sum((a - 1) * jnp.log(v), -1)
+                      + jax.scipy.special.gammaln(jnp.sum(a, -1))
+                      - jnp.sum(jax.scipy.special.gammaln(a), -1))
+
+    def entropy(self):
+        a = self.concentration
+        a0 = jnp.sum(a, -1)
+        k = a.shape[-1]
+        dg = jax.scipy.special.digamma
+        lnB = jnp.sum(jax.scipy.special.gammaln(a), -1) - jax.scipy.special.gammaln(a0)
+        return Tensor(lnB + (a0 - k) * dg(a0) - jnp.sum((a - 1) * dg(a), -1))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _to_arr(loc)
+        self.scale = _to_arr(scale)
+        self.loc, self.scale = jnp.broadcast_arrays(self.loc, self.scale)
+        super().__init__(batch_shape=self.loc.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.loc)
+
+    @property
+    def variance(self):
+        return Tensor(2 * self.scale**2)
+
+    @property
+    def stddev(self):
+        return Tensor(math.sqrt(2) * self.scale)
+
+    def rsample(self, shape=()):
+        shape = self._extend_shape(shape)
+        return Tensor(self.loc + self.scale
+                      * jax.random.laplace(_rng.next_key(), shape, self.loc.dtype))
+
+    def log_prob(self, value):
+        v = _to_arr(value)
+        return Tensor(-jnp.abs(v - self.loc) / self.scale
+                      - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return Tensor(1 + jnp.log(2 * self.scale) + jnp.zeros_like(self.loc))
+
+    def cdf(self, value):
+        v = _to_arr(value)
+        z = (v - self.loc) / self.scale
+        return Tensor(0.5 - 0.5 * jnp.sign(z) * jnp.expm1(-jnp.abs(z)))
+
+    def icdf(self, value):
+        v = _to_arr(value)
+        t = v - 0.5
+        return Tensor(self.loc - self.scale * jnp.sign(t) * jnp.log1p(-2 * jnp.abs(t)))
+
+    def _kl_closed_form(self, other):
+        # KL(L(u1,b1)||L(u2,b2)) = log(b2/b1) + |u1-u2|/b2 + (b1/b2)e^{-|u1-u2|/b1} - 1
+        if isinstance(other, Laplace):
+            adiff = jnp.abs(self.loc - other.loc)
+            return Tensor(jnp.log(other.scale / self.scale)
+                          + adiff / other.scale
+                          + (self.scale / other.scale) * jnp.exp(-adiff / self.scale)
+                          - 1)
+        return None
+
+
+class Gumbel(Distribution):
+    _EULER = 0.57721566490153286060
+
+    def __init__(self, loc, scale):
+        self.loc = _to_arr(loc)
+        self.scale = _to_arr(scale)
+        self.loc, self.scale = jnp.broadcast_arrays(self.loc, self.scale)
+        super().__init__(batch_shape=self.loc.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.loc + self.scale * self._EULER)
+
+    @property
+    def variance(self):
+        return Tensor((math.pi**2 / 6) * self.scale**2)
+
+    def rsample(self, shape=()):
+        shape = self._extend_shape(shape)
+        g = jax.random.gumbel(_rng.next_key(), shape, self.loc.dtype)
+        return Tensor(self.loc + self.scale * g)
+
+    def log_prob(self, value):
+        v = _to_arr(value)
+        z = (v - self.loc) / self.scale
+        return Tensor(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.scale) + 1 + self._EULER
+                      + jnp.zeros_like(self.loc))
+
+
+class Exponential(ExponentialFamily):
+    def __init__(self, rate):
+        self.rate = _to_arr(rate)
+        super().__init__(batch_shape=self.rate.shape)
+
+    @property
+    def mean(self):
+        return Tensor(1 / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(1 / self.rate**2)
+
+    def rsample(self, shape=()):
+        shape = self._extend_shape(shape)
+        return Tensor(jax.random.exponential(_rng.next_key(), shape,
+                                             self.rate.dtype) / self.rate)
+
+    def log_prob(self, value):
+        v = _to_arr(value)
+        return Tensor(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return Tensor(1 - jnp.log(self.rate))

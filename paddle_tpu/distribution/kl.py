@@ -1,0 +1,49 @@
+"""KL divergence dispatch (reference: python/paddle/distribution/kl.py —
+kl_divergence + register_kl double-dispatch registry)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import random as _rng
+from .base import Distribution
+
+__all__ = ["kl_divergence", "register_kl"]
+
+_KL_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    def decorator(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+
+    return decorator
+
+
+def _lookup(type_p, type_q):
+    best = None
+    best_score = None
+    for (cp, cq), fn in _KL_REGISTRY.items():
+        if issubclass(type_p, cp) and issubclass(type_q, cq):
+            score = (len(type_p.__mro__) - type_p.__mro__.index(cp),
+                     len(type_q.__mro__) - type_q.__mro__.index(cq))
+            if best_score is None or score < best_score:
+                best, best_score = fn, score
+    return best
+
+
+def kl_divergence(p: Distribution, q: Distribution, num_samples=None):
+    """KL(p || q). Exact when a registered closed form or a distribution's
+    own `kl_divergence` applies; otherwise a Monte-Carlo estimate."""
+    fn = _lookup(type(p), type(q))
+    if fn is not None:
+        return fn(p, q)
+    closed = p._kl_closed_form(q)
+    if closed is not None:
+        return closed
+    # Monte-Carlo fallback: E_p[log p(x) - log q(x)], one batched draw
+    n = num_samples or 64
+    x = p.sample([n])
+    diff = p.log_prob(x)._data - q.log_prob(x)._data
+    return Tensor(jnp.mean(diff, axis=0))

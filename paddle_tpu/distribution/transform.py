@@ -1,0 +1,324 @@
+"""Bijective transforms + TransformedDistribution + Independent
+(reference: python/paddle/distribution/transform.py (~1.1k LoC),
+transformed_distribution.py, independent.py)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .base import Distribution, _to_arr, _shape
+
+__all__ = [
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+    "TransformedDistribution", "Independent",
+]
+
+
+class Transform:
+    """Invertible transform with log|det J| bookkeeping."""
+
+    _event_rank = 0  # rank of the event the jacobian acts on
+
+    def forward(self, x):
+        return Tensor(self._forward(_to_arr(x)))
+
+    def inverse(self, y):
+        return Tensor(self._inverse(_to_arr(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return Tensor(self._forward_log_det_jacobian(_to_arr(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        y = _to_arr(y)
+        return Tensor(-self._forward_log_det_jacobian(self._inverse(y)))
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AbsTransform(Transform):
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y  # principal branch
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _to_arr(loc)
+        self.scale = _to_arr(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _to_arr(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    _event_rank = 1
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, -1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+
+class StickBreakingTransform(Transform):
+    _event_rank = 1
+
+    def _forward(self, x):
+        offset = x.shape[-1] + 1 - jnp.arange(1, x.shape[-1] + 1)
+        z = jax.nn.sigmoid(x - jnp.log(offset.astype(x.dtype)))
+        zcum = jnp.cumprod(1 - z, -1)
+        pad = jnp.ones(x.shape[:-1] + (1,), x.dtype)
+        return jnp.concatenate([z, pad], -1) * jnp.concatenate([pad, zcum], -1)
+
+    def _inverse(self, y):
+        ycum = jnp.cumsum(y[..., :-1], -1)
+        rest = 1 - jnp.concatenate(
+            [jnp.zeros(y.shape[:-1] + (1,), y.dtype), ycum[..., :-1]], -1)
+        z = y[..., :-1] / rest
+        offset = y.shape[-1] - jnp.arange(1, y.shape[-1])
+        return jnp.log(z) - jnp.log1p(-z) + jnp.log(offset.astype(y.dtype))
+
+    def _forward_log_det_jacobian(self, x):
+        y = self._forward(x)
+        offset = x.shape[-1] + 1 - jnp.arange(1, x.shape[-1] + 1)
+        z = jax.nn.sigmoid(x - jnp.log(offset.astype(x.dtype)))
+        return jnp.sum(jnp.log(z) + jnp.log1p(-z)
+                       + jnp.log(jnp.maximum(
+                           1 - jnp.cumsum(y[..., :-1], -1) + y[..., :-1], 1e-30)),
+                       -1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+
+    def _forward(self, x):
+        batch = x.shape[: x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[: y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        batch = x.shape[: x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, x.dtype)
+
+
+class IndependentTransform(Transform):
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        ld = self.base._forward_log_det_jacobian(x)
+        return jnp.sum(ld, axis=tuple(range(-self.rank, 0)))
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t._forward_log_det_jacobian(x)
+            x = t._forward(x)
+        return total
+
+
+class StackTransform(Transform):
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def _split(self, x):
+        return [jnp.squeeze(s, self.axis)
+                for s in jnp.split(x, len(self.transforms), self.axis)]
+
+    def _forward(self, x):
+        return jnp.stack([t._forward(s) for t, s in
+                          zip(self.transforms, self._split(x))], self.axis)
+
+    def _inverse(self, y):
+        return jnp.stack([t._inverse(s) for t, s in
+                          zip(self.transforms, self._split(y))], self.axis)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.stack([t._forward_log_det_jacobian(s) for t, s in
+                          zip(self.transforms, self._split(x))], self.axis)
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base, transforms):
+        self.base = base
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.transforms = list(transforms)
+        chain = ChainTransform(self.transforms)
+        shape = base.batch_shape + base.event_shape
+        out = chain.forward_shape(shape)
+        super().__init__(batch_shape=out if not base.event_shape else out[:-1],
+                         event_shape=() if not base.event_shape else out[-1:])
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)._data
+        for t in self.transforms:
+            x = t._forward(x)
+        return Tensor(x)
+
+    def sample(self, shape=()):
+        s = self.rsample(shape)
+        s.stop_gradient = True
+        return s
+
+    def log_prob(self, value):
+        y = _to_arr(value)
+        lp = 0.0
+        for t in reversed(self.transforms):
+            x = t._inverse(y)
+            ld = t._forward_log_det_jacobian(x)
+            er = getattr(t, "_event_rank", 0)
+            if er and ld.ndim > er:
+                pass  # jacobian already reduced over the event
+            lp = lp - ld
+            y = x
+        base_lp = self.base.log_prob(Tensor(y))._data
+        extra = len(self.base.event_shape)
+        if extra == 0 and hasattr(lp, "ndim") and getattr(lp, "ndim", 0) > base_lp.ndim:
+            lp = jnp.sum(lp, axis=tuple(range(base_lp.ndim, lp.ndim)))
+        return Tensor(base_lp + lp)
+
+
+class Independent(Distribution):
+    """Reinterprets trailing batch dims as event dims (log_prob sums them)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        if self.rank > len(base.batch_shape):
+            raise ValueError("reinterpreted_batch_rank exceeds batch rank")
+        split = len(base.batch_shape) - self.rank
+        super().__init__(batch_shape=base.batch_shape[:split],
+                         event_shape=base.batch_shape[split:] + base.event_shape)
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)._data
+        reduce_axes = tuple(range(-self.rank, 0)) if self.rank else ()
+        return Tensor(jnp.sum(lp, axis=reduce_axes) if reduce_axes else lp)
+
+    def entropy(self):
+        e = self.base.entropy()._data
+        reduce_axes = tuple(range(-self.rank, 0)) if self.rank else ()
+        return Tensor(jnp.sum(e, axis=reduce_axes) if reduce_axes else e)

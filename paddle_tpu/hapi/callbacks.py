@@ -220,7 +220,10 @@ class EarlyStopping(Callback):
 
     def on_train_begin(self, logs=None):
         self.wait_epoch = 0
-        self.best_value = np.inf if self.monitor_op == np.less else -np.inf
+        if self.baseline is not None:
+            self.best_value = self.baseline
+        else:
+            self.best_value = np.inf if self.monitor_op == np.less else -np.inf
         self.best_weights = None
 
     def on_eval_end(self, logs=None):

@@ -93,11 +93,21 @@ class Model:
             results[m.name()] = r
         return results
 
-    def _train_step(self, *data):
-        n_in = len(data) - 1 if len(data) > 1 else 1
+    def _split_batch(self, batch):
+        """Single source of truth for the inputs/labels split of a loader
+        batch: the `labels` spec wins; otherwise a model prepared with a
+        loss treats the last element as the label."""
+        batch = _to_list(batch)
         if self._labels:
-            n_in = len(data) - len(self._labels)
-        inputs, labels = list(data[:n_in]), list(data[n_in:])
+            n_lab = min(len(self._labels), len(batch) - 1)
+        elif self._loss is not None and len(batch) > 1:
+            n_lab = 1
+        else:
+            n_lab = 0
+        n_in = len(batch) - n_lab
+        return batch[:n_in], batch[n_in:]
+
+    def _train_step(self, inputs, labels):
         outputs = self.network(*inputs)
         loss = self._compute_loss(outputs, labels)
         loss.backward()
@@ -109,8 +119,13 @@ class Model:
         self.network.train()
         inputs = [_to_tensor(x) for x in _to_list(inputs)]
         labels = [_to_tensor(x) for x in _to_list(labels)]
-        data = inputs + labels
         if self._jit_compile:
+            if self._metrics and not getattr(self, "_warned_jit_metrics", False):
+                warnings.warn(
+                    "metrics are not updated on the jit_compile train path "
+                    "(only loss is returned); evaluate() still computes them"
+                )
+                self._warned_jit_metrics = True
             if self._compiled_train is None:
                 from .. import jit
 
@@ -119,10 +134,10 @@ class Model:
                     models=(self.network,),
                     optimizers=(self._optimizer,),
                 )
-            loss = self._compiled_train(*data)
+            loss = self._compiled_train(*(inputs + labels))
             outputs = None
         else:
-            loss, outputs, labels = self._train_step(*data)
+            loss, outputs, labels = self._train_step(inputs, labels)
         logs = {"loss": float(loss.item() if isinstance(loss, Tensor) else loss)}
         if outputs is not None and self._metrics:
             logs.update(self._metric_update(outputs, labels))
@@ -201,8 +216,8 @@ class Model:
             logs = {}
             for step, batch in enumerate(train_loader):
                 cbks.on_train_batch_begin(step)
-                batch = _to_list(batch)
-                logs = self.train_batch(batch[:-1] or batch, batch[-1:] if len(batch) > 1 else None)
+                ins, labs = self._split_batch(batch)
+                logs = self.train_batch(ins, labs or None)
                 cbks.on_train_batch_end(step, logs)
                 if self.stop_training:
                     break
@@ -231,8 +246,8 @@ class Model:
         losses = []
         for step, batch in enumerate(loader):
             cbks.on_eval_batch_begin(step)
-            batch = _to_list(batch)
-            logs = self.eval_batch(batch[:-1] or batch, batch[-1:] if len(batch) > 1 else None)
+            ins, labs = self._split_batch(batch)
+            logs = self.eval_batch(ins, labs or None)
             if "loss" in logs:
                 losses.append(logs["loss"])
             cbks.on_eval_batch_end(step, logs)
@@ -259,11 +274,13 @@ class Model:
         outputs = []
         for step, batch in enumerate(loader):
             cbks.on_predict_batch_begin(step)
-            batch = _to_list(batch)
-            # datasets that yield (input, label) pairs: feed inputs only
-            if len(batch) > 1 and self._loss is not None:
-                batch = batch[:-1]
-            out = self.predict_batch(batch)
+            # datasets that yield (input, label) pairs: feed inputs only.
+            # With no loss/labels spec there is nothing to split on — an
+            # unprepared model on a labeled dataset needs an inputs spec.
+            ins, _ = self._split_batch(batch)
+            if self._inputs:
+                ins = ins[: len(self._inputs)]
+            out = self.predict_batch(ins)
             outputs.append(out)
             cbks.on_predict_batch_end(step, {})
         cbks.on_predict_end()
@@ -285,6 +302,13 @@ class Model:
 
     def load(self, path, skip_mismatch=False, reset_optimizer=False):
         params = _load(path + ".pdparams")
+        if skip_mismatch:
+            own = self.network.state_dict()
+            dropped = [k for k, v in params.items()
+                       if k not in own or tuple(own[k].shape) != tuple(v.shape)]
+            for k in dropped:
+                warnings.warn(f"load(skip_mismatch=True): skipping {k}")
+                params.pop(k)
         self.network.set_state_dict(params)
         opt_path = path + ".pdopt"
         if not reset_optimizer and self._optimizer is not None and os.path.exists(opt_path):

@@ -1,0 +1,200 @@
+"""Distribution API — moments/log_prob/entropy/KL validated against
+scipy.stats-style closed forms computed in numpy (reference test model:
+python/paddle/fluid/tests/unittests/distribution/)."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+def test_normal_basics():
+    paddle.seed(0)
+    n = D.Normal(loc=1.0, scale=2.0)
+    assert abs(float(n.mean) - 1.0) < 1e-6
+    assert abs(float(n.variance) - 4.0) < 1e-6
+    x = n.sample([20000])
+    assert abs(float(x.numpy().mean()) - 1.0) < 0.1
+    assert abs(float(x.numpy().std()) - 2.0) < 0.1
+    lp = float(n.log_prob(paddle.to_tensor(1.0)))
+    ref = -math.log(2.0) - 0.5 * math.log(2 * math.pi)
+    assert abs(lp - ref) < 1e-5
+    ent = float(n.entropy())
+    assert abs(ent - (0.5 + 0.5 * math.log(2 * math.pi) + math.log(2.0))) < 1e-5
+    # cdf/icdf roundtrip
+    u = float(n.cdf(paddle.to_tensor(2.5)))
+    assert abs(float(n.icdf(paddle.to_tensor(u))) - 2.5) < 1e-3
+
+
+def test_normal_kl_closed_form():
+    p = D.Normal(0.0, 1.0)
+    q = D.Normal(1.0, 2.0)
+    kl = float(D.kl_divergence(p, q))
+    ref = math.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+    assert abs(kl - ref) < 1e-5
+
+
+def test_uniform():
+    paddle.seed(1)
+    u = D.Uniform(low=-1.0, high=3.0)
+    assert abs(float(u.mean) - 1.0) < 1e-6
+    assert abs(float(u.entropy()) - math.log(4.0)) < 1e-6
+    s = u.sample([10000]).numpy()
+    assert s.min() >= -1.0 and s.max() < 3.0
+    assert float(u.log_prob(paddle.to_tensor(5.0))) == -np.inf
+
+
+def test_beta_dirichlet():
+    b = D.Beta(2.0, 3.0)
+    assert abs(float(b.mean) - 0.4) < 1e-6
+    # Beta(2,3) pdf at 0.5: x(1-x)^2 / B(2,3), B(2,3)=1/12
+    ref = 0.5 * 0.25 * 12
+    assert abs(float(b.prob(paddle.to_tensor(0.5))) - ref) < 1e-4
+
+    d = D.Dirichlet(np.array([1.0, 2.0, 3.0], "float32"))
+    np.testing.assert_allclose(d.mean.numpy(), [1 / 6, 2 / 6, 3 / 6], rtol=1e-5)
+    paddle.seed(3)
+    s = d.sample([2000]).numpy()
+    np.testing.assert_allclose(s.sum(-1), np.ones(2000), rtol=1e-4)
+    np.testing.assert_allclose(s.mean(0), [1 / 6, 2 / 6, 3 / 6], atol=0.03)
+
+
+def test_categorical():
+    paddle.seed(4)
+    c = D.Categorical(np.array([1.0, 2.0, 1.0], "float32"))
+    s = c.sample([30000]).numpy()
+    freqs = np.bincount(s, minlength=3) / len(s)
+    np.testing.assert_allclose(freqs, [0.25, 0.5, 0.25], atol=0.02)
+    assert abs(float(c.probs(paddle.to_tensor(np.int32(1)))) - 0.5) < 1e-6
+    ent_ref = -(0.25 * math.log(0.25) * 2 + 0.5 * math.log(0.5))
+    assert abs(float(c.entropy()) - ent_ref) < 1e-5
+    c2 = D.Categorical(np.array([1.0, 1.0, 2.0], "float32"))
+    kl = float(D.kl_divergence(c, c2))
+    ref = sum(p * math.log(p / q) for p, q in zip([.25, .5, .25], [.25, .25, .5]))
+    assert abs(kl - ref) < 1e-5
+
+
+def test_multinomial_bernoulli():
+    paddle.seed(5)
+    m = D.Multinomial(10, np.array([0.2, 0.3, 0.5], "float32"))
+    s = m.sample([500]).numpy()
+    assert s.shape == (500, 3)
+    np.testing.assert_allclose(s.sum(-1), np.full(500, 10.0), rtol=1e-6)
+    np.testing.assert_allclose(s.mean(0), [2.0, 3.0, 5.0], atol=0.4)
+    # log_prob of an exact count vector
+    lp = float(m.log_prob(paddle.to_tensor(np.array([2.0, 3.0, 5.0], "float32"))))
+    from scipy.stats import multinomial as sp_m  # scipy ships with the image
+
+    ref = sp_m.logpmf([2, 3, 5], 10, [0.2, 0.3, 0.5])
+    assert abs(lp - ref) < 1e-4
+
+    be = D.Bernoulli(np.array([0.3], "float32"))
+    s = be.sample([20000]).numpy()
+    assert abs(s.mean() - 0.3) < 0.02
+    assert abs(float(be.entropy()[0]) -
+               -(0.3 * math.log(0.3) + 0.7 * math.log(0.7))) < 1e-4
+
+
+def test_laplace_gumbel_exponential_lognormal():
+    paddle.seed(6)
+    la = D.Laplace(0.0, 1.5)
+    assert abs(float(la.variance) - 2 * 1.5**2) < 1e-5
+    x = la.sample([20000]).numpy()
+    assert abs(x.mean()) < 0.1
+    kl = float(D.kl_divergence(D.Laplace(0.0, 1.0), D.Laplace(1.0, 2.0)))
+    ref = math.log(2.0) + 1 / 2 + (1 / 2) * math.exp(-1.0) - 1
+    assert abs(kl - ref) < 1e-5
+
+    g = D.Gumbel(1.0, 2.0)
+    assert abs(float(g.mean) - (1.0 + 2.0 * 0.5772156649)) < 1e-4
+    x = g.sample([20000]).numpy()
+    assert abs(x.mean() - float(g.mean)) < 0.15
+
+    e = D.Exponential(2.0)
+    assert abs(float(e.mean) - 0.5) < 1e-6
+    x = e.sample([20000]).numpy()
+    assert abs(x.mean() - 0.5) < 0.05
+
+    ln = D.LogNormal(0.0, 0.5)
+    x = ln.sample([40000]).numpy()
+    assert abs(x.mean() - math.exp(0.125)) < 0.05
+
+
+def test_rsample_differentiable():
+    """rsample is reparameterized: d E[x]/d loc == 1."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core import random as rng
+
+    def f(loc):
+        with rng.key_scope(jax.random.PRNGKey(7)):
+            n = D.Normal(loc, 1.0)
+            return jnp.mean(n.rsample([64])._data)
+
+    g = jax.grad(f)(jnp.float32(0.3))
+    assert abs(float(g) - 1.0) < 1e-5
+
+
+def test_transforms_and_transformed_distribution():
+    paddle.seed(8)
+    t = D.AffineTransform(1.0, 2.0)
+    x = paddle.to_tensor(np.array([0.5], "float32"))
+    y = t.forward(x)
+    np.testing.assert_allclose(y.numpy(), [2.0])
+    np.testing.assert_allclose(t.inverse(y).numpy(), [0.5])
+    np.testing.assert_allclose(t.forward_log_det_jacobian(x).numpy(),
+                               [math.log(2.0)])
+
+    # LogNormal as TransformedDistribution(Normal, Exp) — log_prob parity
+    base = D.Normal(0.0, 0.5)
+    td = D.TransformedDistribution(base, [D.ExpTransform()])
+    ln = D.LogNormal(0.0, 0.5)
+    v = paddle.to_tensor(np.array(1.7, "float32"))
+    assert abs(float(td.log_prob(v)) - float(ln.log_prob(v))) < 1e-5
+
+    # tanh-squashed gaussian log_prob consistency via change of variables
+    tanh = D.TanhTransform()
+    tds = D.TransformedDistribution(D.Normal(0.0, 1.0), [tanh])
+    raw = 0.3
+    v = math.tanh(raw)
+    ref = (float(D.Normal(0.0, 1.0).log_prob(paddle.to_tensor(raw)))
+           - math.log(1 - v**2))
+    assert abs(float(tds.log_prob(paddle.to_tensor(np.float32(v)))) - ref) < 1e-4
+
+    # sigmoid/chain roundtrip
+    chain = D.ChainTransform([D.AffineTransform(0.0, 3.0), D.SigmoidTransform()])
+    x = paddle.to_tensor(np.array([0.2, -1.0], "float32"))
+    rt = chain.inverse(chain.forward(x))
+    np.testing.assert_allclose(rt.numpy(), x.numpy(), rtol=1e-5)
+
+    # stickbreaking maps R^k -> simplex^{k+1}
+    sb = D.StickBreakingTransform()
+    x = paddle.to_tensor(np.array([0.1, -0.4, 1.2], "float32"))
+    y = sb.forward(x)
+    assert y.shape[-1] == 4
+    assert abs(float(y.numpy().sum()) - 1.0) < 1e-5
+    np.testing.assert_allclose(sb.inverse(y).numpy(), x.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_independent():
+    base = D.Normal(np.zeros((3, 2), "float32"), np.ones((3, 2), "float32"))
+    ind = D.Independent(base, 1)
+    assert ind.batch_shape == (3,)
+    assert ind.event_shape == (2,)
+    v = paddle.to_tensor(np.zeros((3, 2), "float32"))
+    lp = ind.log_prob(v)
+    assert lp.shape == (3,)
+    ref = 2 * float(D.Normal(0.0, 1.0).log_prob(paddle.to_tensor(0.0)))
+    np.testing.assert_allclose(lp.numpy(), np.full(3, ref), rtol=1e-5)
+
+
+def test_kl_monte_carlo_fallback():
+    paddle.seed(9)
+    p = D.Normal(0.0, 1.0)
+    q = D.Laplace(0.0, 1.0)
+    kl = float(D.kl_divergence(p, q, num_samples=4000))
+    # KL(N(0,1)||Laplace(0,1)) = E[|x|] + log2 - 0.5*log(2*pi) - 0.5
+    ref = math.sqrt(2 / math.pi) + math.log(2) - 0.5 * math.log(2 * math.pi) - 0.5
+    assert abs(kl - ref) < 0.08
