@@ -1,0 +1,110 @@
+"""Discrete Fourier transforms (reference: python/paddle/fft.py — pocketfft
+/cuFFT backed there; here jnp.fft lowers to XLA's FFT HLO, which runs on the
+TPU's native FFT path, so no custom kernels are needed)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import apply
+from .core.tensor import Tensor
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2",
+    "fftn", "ifftn", "rfftn", "irfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+_NORMS = ("backward", "ortho", "forward")
+
+
+def _check_norm(norm):
+    if norm not in _NORMS:
+        raise ValueError(f"norm must be one of {_NORMS}, got {norm!r}")
+    return norm
+
+
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    _check_norm(norm)
+    return apply(lambda a: jnp.fft.fft(a, n=n, axis=axis, norm=norm), x, name="fft")
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    _check_norm(norm)
+    return apply(lambda a: jnp.fft.ifft(a, n=n, axis=axis, norm=norm), x, name="ifft")
+
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    _check_norm(norm)
+    return apply(lambda a: jnp.fft.rfft(a, n=n, axis=axis, norm=norm), x, name="rfft")
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    _check_norm(norm)
+    return apply(lambda a: jnp.fft.irfft(a, n=n, axis=axis, norm=norm), x, name="irfft")
+
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    _check_norm(norm)
+    return apply(lambda a: jnp.fft.hfft(a, n=n, axis=axis, norm=norm), x, name="hfft")
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    _check_norm(norm)
+    return apply(lambda a: jnp.fft.ihfft(a, n=n, axis=axis, norm=norm), x, name="ihfft")
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    _check_norm(norm)
+    return apply(lambda a: jnp.fft.fft2(a, s=s, axes=axes, norm=norm), x, name="fft2")
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    _check_norm(norm)
+    return apply(lambda a: jnp.fft.ifft2(a, s=s, axes=axes, norm=norm), x, name="ifft2")
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    _check_norm(norm)
+    return apply(lambda a: jnp.fft.rfft2(a, s=s, axes=axes, norm=norm), x, name="rfft2")
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    _check_norm(norm)
+    return apply(lambda a: jnp.fft.irfft2(a, s=s, axes=axes, norm=norm), x, name="irfft2")
+
+
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    _check_norm(norm)
+    return apply(lambda a: jnp.fft.fftn(a, s=s, axes=axes, norm=norm), x, name="fftn")
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    _check_norm(norm)
+    return apply(lambda a: jnp.fft.ifftn(a, s=s, axes=axes, norm=norm), x, name="ifftn")
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    _check_norm(norm)
+    return apply(lambda a: jnp.fft.rfftn(a, s=s, axes=axes, norm=norm), x, name="rfftn")
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    _check_norm(norm)
+    return apply(lambda a: jnp.fft.irfftn(a, s=s, axes=axes, norm=norm), x, name="irfftn")
+
+
+def fftfreq(n, d=1.0, dtype="float32", name=None):
+    return Tensor(jnp.fft.fftfreq(n, d).astype(dtype))
+
+
+def rfftfreq(n, d=1.0, dtype="float32", name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d).astype(dtype))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply(lambda a: jnp.fft.fftshift(a, axes=axes), x, name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply(lambda a: jnp.fft.ifftshift(a, axes=axes), x, name="ifftshift")

@@ -24,6 +24,7 @@ __all__ = [
     "clip", "cumsum", "cumprod", "logsumexp", "logcumsumexp",
     "isnan", "isinf", "isfinite", "nan_to_num",
     "erf", "erfinv", "lgamma", "digamma",
+    "conj", "real", "imag", "angle",
     "stanh", "rad2deg", "deg2rad",
     "addmm", "einsum", "kron", "trace", "diagonal",
     "mod", "lerp", "hypot", "gcd", "lcm",
@@ -99,6 +100,10 @@ trunc = _unary(jnp.trunc, "trunc")
 frac = _unary(lambda a: a - jnp.trunc(a), "frac")
 erf = _unary(jax.scipy.special.erf, "erf")
 erfinv = _unary(jax.scipy.special.erfinv, "erfinv")
+conj = _unary(jnp.conj, "conj")
+real = _unary(jnp.real, "real")
+imag = _unary(jnp.imag, "imag")
+angle = _unary(jnp.angle, "angle")
 lgamma = _unary(jax.scipy.special.gammaln, "lgamma")
 digamma = _unary(jax.scipy.special.digamma, "digamma")
 rad2deg = _unary(jnp.rad2deg, "rad2deg")

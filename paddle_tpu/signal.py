@@ -1,0 +1,157 @@
+"""Short-time Fourier transforms (reference: python/paddle/signal.py —
+stft/istft over the frame/overlap_add ops in paddle/phi/kernels/funcs/fft*).
+
+TPU-native: framing is a strided gather that XLA fuses with the batched FFT;
+no dedicated frame/overlap_add kernels."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import apply, unwrap
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]
+
+
+def _frame_index(n, frame_length, hop_length):
+    """[n_frames, frame_length] gather indices; validates length."""
+    if n < frame_length:
+        raise ValueError(
+            f"frame_length ({frame_length}) should not be greater than the "
+            f"sequence length ({n})")
+    n_frames = 1 + (n - frame_length) // hop_length
+    return (jnp.arange(n_frames)[:, None] * hop_length
+            + jnp.arange(frame_length)[None, :])
+
+
+def _frames_arr(a, frame_length, hop_length):
+    """[..., T] -> [..., n_frames, frame_length]."""
+    idx = _frame_index(a.shape[-1], frame_length, hop_length)
+    return a[..., idx]
+
+
+def _overlap_add_arr(frames, hop_length):
+    """[..., n_frames, frame_length] -> [..., T] scatter-add."""
+    n_frames, frame_length = frames.shape[-2], frames.shape[-1]
+    out_len = (n_frames - 1) * hop_length + frame_length
+    idx = _frame_index(out_len, frame_length, hop_length)
+    out = jnp.zeros(frames.shape[:-2] + (out_len,), frames.dtype)
+    return out.at[..., idx].add(frames)
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice x into overlapping frames along `axis` (frame dim is added
+    before the frame axis: [..., frame_length, n_frames] for axis=-1,
+    [n_frames, frame_length, ...] is transposed to [frame_length, n_frames,
+    ...] for axis=0 — reference signal.frame contract)."""
+
+    def fn(a):
+        if axis not in (-1, a.ndim - 1, 0):
+            raise ValueError("frame: axis must be the first or last axis")
+        last = axis in (-1, a.ndim - 1)
+        if not last:
+            a = jnp.moveaxis(a, 0, -1)
+        out = jnp.swapaxes(_frames_arr(a, frame_length, hop_length), -1, -2)
+        if not last:
+            out = jnp.moveaxis(out, (-2, -1), (0, 1))
+        return out
+
+    return apply(fn, x, name="frame")
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame: add overlapping frames ([..., frame_length,
+    n_frames] when axis=-1)."""
+
+    def fn(a):
+        last = axis in (-1, a.ndim - 1)
+        if not last and axis != 0:
+            raise ValueError("overlap_add: axis must be the first or last axis")
+        if not last:
+            a = jnp.moveaxis(a, (0, 1), (-2, -1))
+        out = _overlap_add_arr(jnp.swapaxes(a, -1, -2), hop_length)
+        if not last:
+            out = jnp.moveaxis(out, -1, 0)
+        return out
+
+    return apply(fn, x, name="overlap_add")
+
+
+def _full_window(w, win_length, n_fft, dtype):
+    """Validate window length and center-pad it to n_fft."""
+    if w is None:
+        w = jnp.ones((win_length,), dtype)
+    if w.shape != (win_length,):
+        raise ValueError(f"window must have shape ({win_length},), got {w.shape}")
+    if win_length > n_fft:
+        raise ValueError(f"win_length ({win_length}) > n_fft ({n_fft})")
+    if win_length < n_fft:
+        lp = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lp, n_fft - win_length - lp))
+    return w.astype(dtype)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+         pad_mode="reflect", normalized=False, onesided=True, name=None):
+    """[B, T] or [T] → complex [B, n_fft//2+1, n_frames] (onesided)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    def fn(a, *wargs):
+        squeeze = a.ndim == 1
+        if squeeze:
+            a = a[None]
+        w = _full_window(wargs[0] if wargs else None, win_length, n_fft, a.dtype)
+        if center:
+            a = jnp.pad(a, ((0, 0), (n_fft // 2, n_fft // 2)), mode=pad_mode)
+        frames = _frames_arr(a, n_fft, hop_length) * w[None, None, :]
+        spec = (jnp.fft.rfft(frames, axis=-1) if onesided
+                else jnp.fft.fft(frames, axis=-1))
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        spec = jnp.swapaxes(spec, -1, -2)  # [B, freq, n_frames]
+        return spec[0] if squeeze else spec
+
+    args = (x,) if window is None else (x, window)
+    return apply(fn, *args, name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+          normalized=False, onesided=True, length=None, return_complex=False,
+          name=None):
+    """Inverse STFT with the standard window-envelope normalization."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if return_complex and onesided:
+        raise ValueError(
+            "istft: return_complex=True requires onesided=False (a onesided "
+            "spectrum encodes a real signal)")
+
+    def fn(a, *wargs):
+        squeeze = a.ndim == 2
+        if squeeze:
+            a = a[None]
+        w = _full_window(wargs[0] if wargs else None, win_length, n_fft,
+                         jnp.float32)
+        spec = jnp.swapaxes(a, -1, -2)  # [B, n_frames, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(spec, axis=-1)
+            frames = frames if return_complex else frames.real
+        frames = frames * w[None, None, :]
+        out = _overlap_add_arr(frames, hop_length)
+        # window-envelope normalization (sum of squared windows per sample)
+        n_frames = frames.shape[1]
+        env = _overlap_add_arr(
+            jnp.broadcast_to(w**2, (n_frames, n_fft)), hop_length)
+        out = out / jnp.maximum(env, 1e-11)[None]
+        if center:
+            out = out[:, n_fft // 2: out.shape[-1] - n_fft // 2]
+        if length is not None:
+            out = out[:, :length]
+        return out[0] if squeeze else out
+
+    args = (x,) if window is None else (x, window)
+    return apply(fn, *args, name="istft")

@@ -1,0 +1,151 @@
+"""Declarative op-test harness — the TPU analog of the reference's OpTest
+(python/paddle/fluid/tests/unittests/op_test.py:327): a subclass declares
+`inputs` (numpy), `attrs`, the framework `op`, and a numpy `ref`;
+`check_output` compares op vs ref on the default device, and `check_grad`
+compares analytic autograd gradients against central finite differences
+(reference: get_numeric_gradient at op_test.py:134, tolerances :2127-2129).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+def _to_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class OpTest:
+    """Subclass contract:
+
+    - `op`: staticmethod taking input Tensors positionally (declaration
+      order of `inputs`) plus `attrs` as keyword args.
+    - `ref`: staticmethod numpy reference with the same signature.
+    - `inputs`: dict name -> numpy array (insertion order = positional order).
+    - `attrs`: dict of python attrs (optional).
+    - `grad_inputs`: names to gradient-check (default: all float inputs).
+    - `rtol`/`atol`: output tolerances; `max_relative_error` for grads
+      (reference default 0.005); `numeric_delta` FD step.
+    """
+
+    op = None
+    ref = None
+    attrs: dict = {}
+    grad_inputs = None
+    rtol = 1e-5
+    atol = 1e-6
+    max_relative_error = 5e-3
+    numeric_delta = 1e-3
+
+    def setup(self):
+        """Subclasses populate self.inputs here (fresh per test)."""
+        raise NotImplementedError
+
+    # -- machinery ---------------------------------------------------------
+    def _tensors(self, stop_gradient=True):
+        return {
+            k: paddle.to_tensor(v.copy(), stop_gradient=stop_gradient
+                                if np.issubdtype(v.dtype, np.floating) else True)
+            for k, v in self.inputs.items()
+        }
+
+    def _run_op(self, tensors):
+        out = type(self).op(*tensors.values(), **self.attrs)
+        return _to_list(out)
+
+    def _run_ref(self):
+        out = type(self).ref(*[v.copy() for v in self.inputs.values()], **self.attrs)
+        return _to_list(out)
+
+    def check_output(self, rtol=None, atol=None):
+        self.setup()
+        got = self._run_op(self._tensors())
+        want = self._run_ref()
+        assert len(got) == len(want), f"{len(got)} outputs vs {len(want)} in ref"
+        for g, w in zip(got, want):
+            g = np.asarray(g.numpy()) if isinstance(g, Tensor) else np.asarray(g)
+            w = np.asarray(w)
+            # widen without discarding imaginary parts of complex outputs
+            up = np.complex128 if (np.iscomplexobj(g) or np.iscomplexobj(w)) else np.float64
+            np.testing.assert_allclose(
+                g.astype(up), w.astype(up),
+                rtol=rtol or self.rtol, atol=atol or self.atol,
+                err_msg=f"{type(self).__name__} output mismatch",
+            )
+
+    def _loss_weights(self, outs):
+        rng = np.random.RandomState(0)
+        ws = []
+        for o in outs:
+            arr = o.numpy() if isinstance(o, Tensor) else np.asarray(o)
+            ws.append(rng.uniform(0.1, 1.0, arr.shape).astype(np.float64))
+        return ws
+
+    def _scalar_loss(self, outs, ws):
+        total = 0.0
+        for o, w in zip(outs, ws):
+            if isinstance(o, Tensor) and np.issubdtype(o.numpy().dtype, np.floating):
+                total = total + (o * paddle.to_tensor(w.astype(o.numpy().dtype))).sum()
+        return total
+
+    def check_grad(self, inputs_to_check=None, max_relative_error=None,
+                   numeric_delta=None):
+        self.setup()
+        delta = numeric_delta or self.numeric_delta
+        tol = max_relative_error or self.max_relative_error
+        names = inputs_to_check or self.grad_inputs or [
+            k for k, v in self.inputs.items()
+            if np.issubdtype(v.dtype, np.floating)
+        ]
+        tensors = self._tensors(stop_gradient=False)
+        outs = self._run_op(tensors)
+        ws = self._loss_weights(outs)
+        loss = self._scalar_loss(outs, ws)
+        loss.backward()
+
+        def numpy_loss(arrays):
+            outs = type(self).ref(*arrays, **self.attrs)
+            total = 0.0
+            for o, w in zip(_to_list(outs), ws):
+                o = np.asarray(o)
+                if np.issubdtype(o.dtype, np.floating):
+                    total += float(np.sum(o.astype(np.float64) * w))
+            return total
+
+        base = [v.copy().astype(np.float64) if np.issubdtype(v.dtype, np.floating)
+                else v.copy() for v in self.inputs.values()]
+        keys = list(self.inputs.keys())
+        for name in names:
+            analytic = tensors[name].grad
+            assert analytic is not None, f"no grad flowed to input {name!r}"
+            analytic = np.asarray(analytic.numpy(), np.float64)
+            idx = keys.index(name)
+            numeric = np.zeros_like(base[idx], dtype=np.float64)
+            flat_n = numeric.reshape(-1)
+            for i in range(flat_n.size):
+                # FD runs the numpy ref in float64 — casting the perturbed
+                # inputs down to the op dtype would quantize the delta away
+                hi = [a.copy() for a in base]
+                lo = [a.copy() for a in base]
+                hi[idx].reshape(-1)[i] += delta
+                lo[idx].reshape(-1)[i] -= delta
+                flat_n[i] = (numpy_loss(hi) - numpy_loss(lo)) / (2 * delta)
+            # reference formula (op_test.py): |a - n| / max(|n|, 1e-2)
+            denom = np.maximum(np.abs(numeric), 1e-2)
+            rel = np.abs(analytic - numeric) / denom
+            assert rel.max() < tol, (
+                f"{type(self).__name__}.{name}: max rel grad err {rel.max():.4g} "
+                f"(tol {tol}); analytic {analytic.reshape(-1)[:4]} vs "
+                f"numeric {numeric.reshape(-1)[:4]}"
+            )
+
+    # -- pytest entry points (auto-run for every subclass) ----------------
+    def test_check_output(self):
+        self.check_output()
+
+    def test_check_grad(self):
+        if type(self).ref is None:
+            return
+        self.check_grad()

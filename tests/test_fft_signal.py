@@ -1,0 +1,69 @@
+"""fft + signal parity vs numpy (reference: python/paddle/fft.py,
+signal.py; test model unittests/test_fft*.py, test_signal.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fft, signal
+
+
+def test_fft_roundtrip_and_parity():
+    r = np.random.RandomState(0)
+    x = r.randn(4, 16).astype("float32")
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(fft.fft(t).numpy(), np.fft.fft(x), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(fft.ifft(fft.fft(t)).numpy().real, x, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(fft.rfft(t).numpy(), np.fft.rfft(x), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(fft.irfft(fft.rfft(t)).numpy(), x, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(fft.fft2(t).numpy(), np.fft.fft2(x), rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(
+        fft.fftn(t, norm="ortho").numpy(), np.fft.fftn(x, norm="ortho"),
+        rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(fft.fftshift(t).numpy(), np.fft.fftshift(x), rtol=1e-6)
+    np.testing.assert_allclose(fft.fftfreq(16, 0.5).numpy(),
+                               np.fft.fftfreq(16, 0.5).astype("float32"), rtol=1e-6)
+    with pytest.raises(ValueError):
+        fft.fft(t, norm="bogus")
+
+
+def test_fft_grad():
+    """rfft/irfft roundtrip is linear — grad of ||irfft(rfft(x))||^2 is 2x."""
+    x = paddle.to_tensor(np.random.RandomState(1).randn(8).astype("float32"),
+                         stop_gradient=False)
+    y = fft.irfft(fft.rfft(x))
+    loss = (y * y).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2 * x.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_frame_overlap_add_roundtrip():
+    r = np.random.RandomState(2)
+    x = r.randn(2, 20).astype("float32")
+    f = signal.frame(paddle.to_tensor(x), frame_length=8, hop_length=4)
+    assert f.shape == (2, 8, 4)  # [B, frame_length, n_frames]
+    # non-overlapping frames reconstruct exactly
+    f2 = signal.frame(paddle.to_tensor(x), frame_length=4, hop_length=4)
+    rec = signal.overlap_add(f2, hop_length=4)
+    np.testing.assert_allclose(rec.numpy(), x, rtol=1e-6)
+
+
+def test_stft_istft_roundtrip():
+    r = np.random.RandomState(3)
+    x = r.randn(2, 256).astype("float32")
+    w = np.hanning(64).astype("float32")
+    spec = signal.stft(paddle.to_tensor(x), n_fft=64, hop_length=16,
+                       window=paddle.to_tensor(w))
+    assert spec.shape == (2, 33, 256 // 16 + 1)
+    rec = signal.istft(spec, n_fft=64, hop_length=16,
+                       window=paddle.to_tensor(w), length=256)
+    np.testing.assert_allclose(rec.numpy(), x, rtol=1e-3, atol=1e-4)
+
+
+def test_stft_matches_manual_dft():
+    r = np.random.RandomState(4)
+    x = r.randn(128).astype("float32")
+    spec = signal.stft(paddle.to_tensor(x), n_fft=32, hop_length=32,
+                       center=False).numpy()
+    # frame 0 is x[0:32] — compare against direct rfft
+    np.testing.assert_allclose(spec[:, 0], np.fft.rfft(x[:32]), rtol=1e-4,
+                               atol=1e-4)

@@ -1,0 +1,410 @@
+"""Declarative op unit tests over the OpTest harness (reference model:
+~700 OpTest subclasses under unittests/test_*_op.py; this suite covers the
+core op families — math, reduction, manipulation, nn — with numeric-grad
+checks against numpy references)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+from op_test import OpTest
+
+
+def _rs(seed=0):
+    return np.random.RandomState(seed)
+
+
+class TestMatmul(OpTest):
+    op = staticmethod(paddle.matmul)
+    ref = staticmethod(lambda x, y: x @ y)
+
+    def setup(self):
+        r = _rs(1)
+        self.inputs = {"x": r.randn(3, 4).astype("float32"),
+                       "y": r.randn(4, 5).astype("float32")}
+
+
+class TestMatmulBatchedTranspose(OpTest):
+    op = staticmethod(lambda x, y: paddle.matmul(x, y, transpose_y=True))
+    ref = staticmethod(lambda x, y: x @ np.swapaxes(y, -1, -2))
+
+    def setup(self):
+        r = _rs(2)
+        self.inputs = {"x": r.randn(2, 3, 4).astype("float32"),
+                       "y": r.randn(2, 6, 4).astype("float32")}
+
+
+class TestAddBroadcast(OpTest):
+    op = staticmethod(paddle.add)
+    ref = staticmethod(np.add)
+
+    def setup(self):
+        r = _rs(3)
+        self.inputs = {"x": r.randn(4, 1, 5).astype("float32"),
+                       "y": r.randn(3, 5).astype("float32")}
+
+
+class TestSubMulDivChain(OpTest):
+    op = staticmethod(lambda x, y: (x - y) * y / (x * x + 1.0))
+    ref = staticmethod(lambda x, y: (x - y) * y / (x * x + 1.0))
+
+    def setup(self):
+        r = _rs(4)
+        self.inputs = {"x": r.randn(3, 4).astype("float32"),
+                       "y": r.randn(3, 4).astype("float32")}
+
+
+class TestExp(OpTest):
+    op = staticmethod(paddle.exp)
+    ref = staticmethod(np.exp)
+
+    def setup(self):
+        self.inputs = {"x": _rs(5).uniform(-2, 2, (3, 4)).astype("float32")}
+
+
+class TestLog(OpTest):
+    op = staticmethod(paddle.log)
+    ref = staticmethod(np.log)
+
+    def setup(self):
+        self.inputs = {"x": _rs(6).uniform(0.1, 3, (3, 4)).astype("float32")}
+
+
+class TestTanh(OpTest):
+    op = staticmethod(paddle.tanh)
+    ref = staticmethod(np.tanh)
+
+    def setup(self):
+        self.inputs = {"x": _rs(7).randn(3, 4).astype("float32")}
+
+
+class TestSigmoid(OpTest):
+    op = staticmethod(F.sigmoid)
+    ref = staticmethod(lambda x: 1 / (1 + np.exp(-x)))
+
+    def setup(self):
+        self.inputs = {"x": _rs(8).randn(3, 4).astype("float32")}
+
+
+class TestRsqrt(OpTest):
+    op = staticmethod(paddle.rsqrt)
+    ref = staticmethod(lambda x: 1 / np.sqrt(x))
+
+    def setup(self):
+        self.inputs = {"x": _rs(9).uniform(0.5, 4, (3, 4)).astype("float32")}
+
+
+class TestGelu(OpTest):
+    op = staticmethod(F.gelu)
+    rtol = 1e-4
+
+    @staticmethod
+    def ref(x):
+        from scipy.special import erf
+
+        return 0.5 * x * (1 + erf(x / np.sqrt(2)))
+
+    def setup(self):
+        self.inputs = {"x": _rs(10).randn(3, 4).astype("float32")}
+
+
+class TestLeakyRelu(OpTest):
+    op = staticmethod(lambda x: F.leaky_relu(x, negative_slope=0.1))
+    ref = staticmethod(lambda x: np.where(x > 0, x, 0.1 * x))
+
+    def setup(self):
+        # keep values away from the kink where FD is ill-defined
+        x = _rs(11).randn(3, 4).astype("float32")
+        x[np.abs(x) < 0.1] += 0.3
+        self.inputs = {"x": x}
+
+
+class TestSoftmaxAxis(OpTest):
+    op = staticmethod(lambda x: F.softmax(x, axis=1))
+    max_relative_error = 1e-2
+
+    @staticmethod
+    def ref(x):
+        e = np.exp(x - x.max(1, keepdims=True))
+        return e / e.sum(1, keepdims=True)
+
+    def setup(self):
+        self.inputs = {"x": _rs(12).randn(2, 5, 3).astype("float32")}
+
+
+class TestLogSoftmax(OpTest):
+    op = staticmethod(lambda x: F.log_softmax(x, axis=-1))
+    max_relative_error = 1e-2
+
+    @staticmethod
+    def ref(x):
+        m = x.max(-1, keepdims=True)
+        return x - m - np.log(np.exp(x - m).sum(-1, keepdims=True))
+
+    def setup(self):
+        self.inputs = {"x": _rs(13).randn(4, 6).astype("float32")}
+
+
+class TestReduceSumAxisKeepdim(OpTest):
+    op = staticmethod(lambda x: paddle.sum(x, axis=1, keepdim=True))
+    ref = staticmethod(lambda x: x.sum(1, keepdims=True))
+
+    def setup(self):
+        self.inputs = {"x": _rs(14).randn(3, 4, 2).astype("float32")}
+
+
+class TestReduceMean(OpTest):
+    op = staticmethod(lambda x: paddle.mean(x, axis=[0, 2]))
+    ref = staticmethod(lambda x: x.mean((0, 2)))
+
+    def setup(self):
+        self.inputs = {"x": _rs(15).randn(3, 4, 2).astype("float32")}
+
+
+class TestMaxReduce(OpTest):
+    op = staticmethod(lambda x: paddle.max(x, axis=-1))
+    ref = staticmethod(lambda x: x.max(-1))
+
+    def setup(self):
+        # distinct values so the max subgradient is unique
+        x = np.arange(24, dtype="float32").reshape(2, 3, 4)
+        self.inputs = {"x": _rs(16).permutation(x.ravel()).reshape(2, 3, 4)}
+
+
+class TestLogsumexp(OpTest):
+    op = staticmethod(lambda x: paddle.logsumexp(x, axis=-1))
+    max_relative_error = 1e-2
+
+    @staticmethod
+    def ref(x):
+        m = x.max(-1, keepdims=True)
+        return (m + np.log(np.exp(x - m).sum(-1, keepdims=True))).squeeze(-1)
+
+    def setup(self):
+        self.inputs = {"x": _rs(17).randn(3, 5).astype("float32")}
+
+
+class TestTransposeReshape(OpTest):
+    op = staticmethod(lambda x: paddle.reshape(paddle.transpose(x, [1, 0, 2]), [4, 6]))
+    ref = staticmethod(lambda x: x.transpose(1, 0, 2).reshape(4, 6))
+
+    def setup(self):
+        self.inputs = {"x": _rs(18).randn(2, 4, 3).astype("float32")}
+
+
+class TestConcat(OpTest):
+    op = staticmethod(lambda x, y: paddle.concat([x, y], axis=1))
+    ref = staticmethod(lambda x, y: np.concatenate([x, y], 1))
+
+    def setup(self):
+        r = _rs(19)
+        self.inputs = {"x": r.randn(2, 3).astype("float32"),
+                       "y": r.randn(2, 2).astype("float32")}
+
+
+class TestSplit(OpTest):
+    op = staticmethod(lambda x: paddle.split(x, 3, axis=1))
+    ref = staticmethod(lambda x: np.split(x, 3, 1))
+
+    def setup(self):
+        self.inputs = {"x": _rs(20).randn(2, 6).astype("float32")}
+
+
+class TestStackUnsqueeze(OpTest):
+    op = staticmethod(lambda x, y: paddle.stack([x, y], axis=1))
+    ref = staticmethod(lambda x, y: np.stack([x, y], 1))
+
+    def setup(self):
+        r = _rs(21)
+        self.inputs = {"x": r.randn(3, 2).astype("float32"),
+                       "y": r.randn(3, 2).astype("float32")}
+
+
+class TestGather(OpTest):
+    op = staticmethod(lambda x, idx: paddle.gather(x, idx, axis=0))
+    ref = staticmethod(lambda x, idx: x[idx])
+
+    def setup(self):
+        self.inputs = {"x": _rs(22).randn(5, 3).astype("float32"),
+                       "idx": np.array([0, 2, 2, 4], "int32")}
+
+
+class TestIndexSelectPad(OpTest):
+    op = staticmethod(lambda x: F.pad(x, [1, 1, 0, 2], mode="constant", value=0.5))
+
+    @staticmethod
+    def ref(x):
+        # len(pad) == 2*ndim pads from the FIRST dim (paddle semantics)
+        return np.pad(x, [(1, 1), (0, 2)], constant_values=0.5)
+
+    def setup(self):
+        self.inputs = {"x": _rs(23).randn(2, 3).astype("float32")}
+
+
+class TestWhereClip(OpTest):
+    op = staticmethod(lambda x: paddle.clip(paddle.where(x > 0, x, x * 0.5), -0.8, 0.8))
+    ref = staticmethod(lambda x: np.clip(np.where(x > 0, x, x * 0.5), -0.8, 0.8))
+
+    def setup(self):
+        x = _rs(24).randn(3, 4).astype("float32")
+        x[np.abs(np.abs(x) - 0.8) < 0.05] = 0.0  # keep off the clip kink
+        x[np.abs(x) < 0.02] = 0.5
+        self.inputs = {"x": x}
+
+
+class TestCumsum(OpTest):
+    op = staticmethod(lambda x: paddle.cumsum(x, axis=1))
+    ref = staticmethod(lambda x: np.cumsum(x, 1))
+
+    def setup(self):
+        self.inputs = {"x": _rs(25).randn(2, 5).astype("float32")}
+
+
+class TestEinsum(OpTest):
+    op = staticmethod(lambda x, y: paddle.einsum("bij,bjk->bik", x, y))
+    ref = staticmethod(lambda x, y: np.einsum("bij,bjk->bik", x, y))
+
+    def setup(self):
+        r = _rs(26)
+        self.inputs = {"x": r.randn(2, 3, 4).astype("float32"),
+                       "y": r.randn(2, 4, 2).astype("float32")}
+
+
+class TestLayerNorm(OpTest):
+    op = staticmethod(lambda x, w, b: F.layer_norm(x, 6, weight=w, bias=b))
+    rtol = 1e-4
+    max_relative_error = 1e-2
+
+    @staticmethod
+    def ref(x, w, b):
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        return (x - mu) / np.sqrt(var + 1e-5) * w + b
+
+    def setup(self):
+        r = _rs(27)
+        self.inputs = {"x": r.randn(4, 6).astype("float32"),
+                       "w": r.uniform(0.5, 1.5, 6).astype("float32"),
+                       "b": r.randn(6).astype("float32")}
+
+
+class TestEmbedding(OpTest):
+    op = staticmethod(lambda ids, w: F.embedding(ids, w))
+    ref = staticmethod(lambda ids, w: w[ids])
+
+    def setup(self):
+        r = _rs(28)
+        self.inputs = {"ids": np.array([[0, 2], [1, 3]], "int32"),
+                       "w": r.randn(5, 4).astype("float32")}
+
+
+class TestCrossEntropy(OpTest):
+    op = staticmethod(lambda logits, lab: F.cross_entropy(logits, lab))
+    max_relative_error = 1e-2
+
+    @staticmethod
+    def ref(logits, lab):
+        m = logits.max(-1, keepdims=True)
+        lse = m + np.log(np.exp(logits - m).sum(-1, keepdims=True))
+        lp = logits - lse
+        return -lp[np.arange(len(lab)), lab].mean()
+
+    def setup(self):
+        r = _rs(29)
+        self.inputs = {"logits": r.randn(6, 5).astype("float32"),
+                       "lab": np.array([0, 1, 4, 2, 3, 3], "int64")}
+
+
+class TestConv2d(OpTest):
+    op = staticmethod(lambda x, w, b: F.conv2d(x, w, b, stride=1, padding=1))
+    rtol = 1e-4
+    max_relative_error = 1e-2
+
+    @staticmethod
+    def ref(x, w, b):
+        n, c, h, wd = x.shape
+        o, _, kh, kw = w.shape
+        xp = np.pad(x, [(0, 0), (0, 0), (1, 1), (1, 1)])
+        out = np.zeros((n, o, h, wd), x.dtype)
+        for i in range(h):
+            for j in range(wd):
+                patch = xp[:, :, i:i + kh, j:j + kw]
+                out[:, :, i, j] = np.einsum("nchw,ochw->no", patch, w)
+        return out + b[None, :, None, None]
+
+    def setup(self):
+        r = _rs(30)
+        self.inputs = {"x": r.randn(2, 3, 5, 5).astype("float32"),
+                       "w": r.randn(4, 3, 3, 3).astype("float32") * 0.5,
+                       "b": r.randn(4).astype("float32")}
+
+
+class TestMaxPool2d(OpTest):
+    op = staticmethod(lambda x: F.max_pool2d(x, kernel_size=2, stride=2))
+
+    @staticmethod
+    def ref(x):
+        n, c, h, w = x.shape
+        return x.reshape(n, c, h // 2, 2, w // 2, 2).max((3, 5))
+
+    def setup(self):
+        # unique values -> unique argmax -> clean subgradient
+        x = _rs(31).permutation(np.arange(2 * 2 * 4 * 4, dtype="float32"))
+        self.inputs = {"x": (x / 10).reshape(2, 2, 4, 4)}
+
+
+class TestAvgPool2d(OpTest):
+    op = staticmethod(lambda x: F.avg_pool2d(x, kernel_size=2, stride=2))
+
+    @staticmethod
+    def ref(x):
+        n, c, h, w = x.shape
+        return x.reshape(n, c, h // 2, 2, w // 2, 2).mean((3, 5))
+
+    def setup(self):
+        self.inputs = {"x": _rs(32).randn(2, 2, 4, 4).astype("float32")}
+
+
+class TestBmmOuter(OpTest):
+    op = staticmethod(lambda x, y: paddle.bmm(x, y))
+    ref = staticmethod(lambda x, y: np.matmul(x, y))
+
+    def setup(self):
+        r = _rs(33)
+        self.inputs = {"x": r.randn(3, 2, 4).astype("float32"),
+                       "y": r.randn(3, 4, 2).astype("float32")}
+
+
+class TestTopkValues(OpTest):
+    """topk: values compare + grad flows through values only."""
+
+    op = staticmethod(lambda x: paddle.topk(x, k=2, axis=-1))
+
+    @staticmethod
+    def ref(x):
+        idx = np.argsort(-x, -1)[..., :2]
+        return np.take_along_axis(x, idx, -1), idx.astype("int64")
+
+    def setup(self):
+        x = _rs(34).permutation(np.arange(12, dtype="float32")).reshape(3, 4)
+        self.inputs = {"x": x / 3.0}
+
+    def test_check_output(self):
+        self.setup()
+        got = self._run_op(self._tensors())
+        want = self._run_ref()
+        np.testing.assert_allclose(got[0].numpy(), want[0], rtol=1e-5)
+        np.testing.assert_array_equal(got[1].numpy(), want[1])
+
+
+class TestSquareMeanChain(OpTest):
+    """Composite expression exercising fused elementwise+reduce."""
+
+    op = staticmethod(lambda x, y: ((x * y + paddle.exp(-x)) ** 2).mean())
+    ref = staticmethod(lambda x, y: np.mean((x * y + np.exp(-x)) ** 2))
+
+    def setup(self):
+        r = _rs(35)
+        self.inputs = {"x": r.randn(4, 3).astype("float32"),
+                       "y": r.randn(4, 3).astype("float32")}
