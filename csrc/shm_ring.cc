@@ -1,0 +1,288 @@
+// Shared-memory ring buffer for DataLoader worker→trainer batch transport —
+// the TPU framework's analog of the reference's shared-memory LoDTensor
+// transport (python/paddle/fluid/dataloader/worker.py + the
+// _shared_memory/mmap allocator in paddle/fluid/memory/allocation/
+// mmap_allocator.cc). Workers serialize numpy batches straight into a
+// POSIX shm ring; the trainer pops without a pickle copy through a pipe.
+//
+// Layout in the shm segment:
+//   [ Header | data bytes ... ]  single-producer/single-consumer per ring
+//   (the loader gives each worker its own ring and round-robins pops).
+// Messages are 8-byte-length-prefixed byte blobs, contiguous, wrapping at
+// the end of the buffer only between messages (a message larger than the
+// remaining tail is written after a WRAP marker).
+//
+// Sync: process-shared pthread mutex + condvars in the header.
+//
+// C ABI (ctypes; see paddle_tpu/io/shm.py):
+//   shm_ring_create(name, capacity) -> handle or <0
+//   shm_ring_attach(name)           -> handle or <0
+//   shm_ring_close(h, unlink)
+//   shm_ring_push(h, data, len, timeout_ms) -> 0, -1 timeout, -2 error
+//   shm_ring_pop_len(h, timeout_ms) -> next msg len, -1 timeout, -2 error
+//   shm_ring_pop(h, buf, cap)       -> msg len (consumes), <0 error
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+
+namespace {
+
+constexpr uint64_t kWrapMarker = ~0ull;
+
+struct Header {
+  uint64_t magic;
+  uint64_t capacity;   // data bytes
+  uint64_t head;       // read offset into data
+  uint64_t tail;       // write offset into data
+  uint64_t count;      // messages in flight
+  pthread_mutex_t mu;
+  pthread_cond_t not_empty;
+  pthread_cond_t not_full;
+};
+
+constexpr uint64_t kMagic = 0x70617474726e6721ull;
+
+struct Ring {
+  Header* hdr;
+  uint8_t* data;
+  size_t map_len;
+  std::string name;
+};
+
+std::mutex g_mu;
+std::map<int64_t, Ring*> g_rings;
+int64_t g_next = 1;
+
+uint64_t avail_space(const Header* h) {
+  // one byte kept free to distinguish full from empty
+  return (h->head + h->capacity - h->tail - 1) % h->capacity;
+}
+
+uint64_t contiguous_tail(const Header* h) { return h->capacity - h->tail; }
+
+timespec deadline_after(int timeout_ms) {
+  timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  ts.tv_sec += timeout_ms / 1000;
+  ts.tv_nsec += (timeout_ms % 1000) * 1000000L;
+  if (ts.tv_nsec >= 1000000000L) {
+    ts.tv_sec += 1;
+    ts.tv_nsec -= 1000000000L;
+  }
+  return ts;
+}
+
+// Wait until signaled or the (absolute) deadline passes. The caller loops
+// on its predicate, so a spurious/late wakeup is re-checked there — the
+// deadline bounds the TOTAL wait, not each wakeup.
+bool timed_wait(pthread_cond_t* cv, pthread_mutex_t* mu, int timeout_ms,
+                const timespec* deadline) {
+  if (timeout_ms <= 0) {
+    pthread_cond_wait(cv, mu);
+    return true;
+  }
+  return pthread_cond_timedwait(cv, mu, deadline) != ETIMEDOUT;
+}
+
+int64_t register_ring(Ring* r) {
+  std::lock_guard<std::mutex> g(g_mu);
+  int64_t h = g_next++;
+  g_rings[h] = r;
+  return h;
+}
+
+Ring* get(int64_t h) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto it = g_rings.find(h);
+  return it == g_rings.end() ? nullptr : it->second;
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t shm_ring_create(const char* name, int64_t capacity) {
+  size_t map_len = sizeof(Header) + static_cast<size_t>(capacity);
+  ::shm_unlink(name);
+  int fd = ::shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return -errno;
+  if (::ftruncate(fd, static_cast<off_t>(map_len)) != 0) {
+    ::close(fd);
+    ::shm_unlink(name);
+    return -errno;
+  }
+  void* mem = ::mmap(nullptr, map_len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (mem == MAP_FAILED) {
+    ::shm_unlink(name);
+    return -errno;
+  }
+  auto* hdr = static_cast<Header*>(mem);
+  std::memset(hdr, 0, sizeof(Header));
+  hdr->capacity = static_cast<uint64_t>(capacity);
+
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+#ifdef PTHREAD_MUTEX_ROBUST
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+#endif
+  pthread_mutex_init(&hdr->mu, &ma);
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_cond_init(&hdr->not_empty, &ca);
+  pthread_cond_init(&hdr->not_full, &ca);
+  hdr->magic = kMagic;
+
+  auto* r = new Ring{hdr, reinterpret_cast<uint8_t*>(hdr + 1), map_len, name};
+  return register_ring(r);
+}
+
+int64_t shm_ring_attach(const char* name) {
+  int fd = ::shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return -errno;
+  struct stat st;
+  if (::fstat(fd, &st) != 0) {
+    ::close(fd);
+    return -errno;
+  }
+  void* mem = ::mmap(nullptr, static_cast<size_t>(st.st_size),
+                     PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (mem == MAP_FAILED) return -errno;
+  auto* hdr = static_cast<Header*>(mem);
+  if (hdr->magic != kMagic) {
+    ::munmap(mem, static_cast<size_t>(st.st_size));
+    return -1000;
+  }
+  auto* r = new Ring{hdr, reinterpret_cast<uint8_t*>(hdr + 1),
+                     static_cast<size_t>(st.st_size), name};
+  return register_ring(r);
+}
+
+void shm_ring_close(int64_t h, int unlink) {
+  Ring* r = nullptr;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    auto it = g_rings.find(h);
+    if (it == g_rings.end()) return;
+    r = it->second;
+    g_rings.erase(it);
+  }
+  ::munmap(r->hdr, r->map_len);
+  if (unlink) ::shm_unlink(r->name.c_str());
+  delete r;
+}
+
+int shm_ring_push(int64_t h, const uint8_t* data, int64_t len, int timeout_ms) {
+  Ring* r = get(h);
+  if (!r) return -2;
+  Header* hd = r->hdr;
+  uint64_t need = 8 + static_cast<uint64_t>(len);
+  if (need + 8 >= hd->capacity) return -3;  // message can never fit
+  timespec deadline = deadline_after(timeout_ms);
+  bool timed_out = false;
+  pthread_mutex_lock(&hd->mu);
+  while (true) {
+    // empty ring: rewind to offset 0 so a large message never deadlocks on
+    // wasted wrap space (the tail skip counts against capacity otherwise)
+    if (hd->count == 0) hd->head = hd->tail = 0;
+    // ensure a contiguous region: if the 8-byte length prefix or the
+    // payload can't fit before the end, write a wrap marker and start over
+    uint64_t space = avail_space(hd);
+    uint64_t tail_room = contiguous_tail(hd);
+    bool wraps = tail_room < 8 || tail_room < need;
+    uint64_t required = wraps ? tail_room + need : need;
+    if (space >= required) {
+      if (wraps) {
+        if (tail_room >= 8)
+          std::memcpy(r->data + hd->tail, &kWrapMarker, 8);
+        hd->tail = 0;
+      }
+      uint64_t n = static_cast<uint64_t>(len);
+      std::memcpy(r->data + hd->tail, &n, 8);
+      std::memcpy(r->data + hd->tail + 8, data, static_cast<size_t>(len));
+      hd->tail = (hd->tail + need) % hd->capacity;
+      hd->count += 1;
+      pthread_cond_signal(&hd->not_empty);
+      pthread_mutex_unlock(&hd->mu);
+      return 0;
+    }
+    if (timed_out) {  // deadline hit and the predicate recheck above failed
+      pthread_mutex_unlock(&hd->mu);
+      return -1;
+    }
+    timed_out = !timed_wait(&hd->not_full, &hd->mu, timeout_ms, &deadline);
+  }
+}
+
+static void skip_wrap(Ring* r) {
+  Header* hd = r->hdr;
+  uint64_t tail_room = hd->capacity - hd->head;
+  if (tail_room < 8) {
+    hd->head = 0;
+    return;
+  }
+  uint64_t marker;
+  std::memcpy(&marker, r->data + hd->head, 8);
+  if (marker == kWrapMarker) hd->head = 0;
+}
+
+int64_t shm_ring_pop_len(int64_t h, int timeout_ms) {
+  Ring* r = get(h);
+  if (!r) return -2;
+  Header* hd = r->hdr;
+  timespec deadline = deadline_after(timeout_ms);
+  bool timed_out = false;
+  pthread_mutex_lock(&hd->mu);
+  while (hd->count == 0) {
+    if (timed_out) {
+      pthread_mutex_unlock(&hd->mu);
+      return -1;
+    }
+    timed_out = !timed_wait(&hd->not_empty, &hd->mu, timeout_ms, &deadline);
+  }
+  skip_wrap(r);
+  uint64_t n;
+  std::memcpy(&n, r->data + hd->head, 8);
+  pthread_mutex_unlock(&hd->mu);
+  return static_cast<int64_t>(n);
+}
+
+int64_t shm_ring_pop(int64_t h, uint8_t* buf, int64_t cap) {
+  Ring* r = get(h);
+  if (!r) return -2;
+  Header* hd = r->hdr;
+  pthread_mutex_lock(&hd->mu);
+  if (hd->count == 0) {
+    pthread_mutex_unlock(&hd->mu);
+    return -1;
+  }
+  skip_wrap(r);
+  uint64_t n;
+  std::memcpy(&n, r->data + hd->head, 8);
+  if (static_cast<int64_t>(n) > cap) {
+    pthread_mutex_unlock(&hd->mu);
+    return -3;
+  }
+  std::memcpy(buf, r->data + hd->head + 8, n);
+  hd->head = (hd->head + 8 + n) % hd->capacity;
+  hd->count -= 1;
+  pthread_cond_signal(&hd->not_full);
+  pthread_mutex_unlock(&hd->mu);
+  return static_cast<int64_t>(n);
+}
+
+}  // extern "C"

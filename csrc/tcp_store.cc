@@ -1,0 +1,423 @@
+// TCP key-value store for multi-host job bring-up — the TPU framework's
+// analog of the reference's rendezvous store
+// (paddle/phi/core/distributed/store/tcp_store.cc: MasterDaemon serving
+// set/get/add/wait over length-prefixed TCP messages). On TPU pods the
+// collectives themselves need no bootstrap (XLA compiles them onto ICI),
+// so this store only coordinates host-side orchestration: rank assignment,
+// barrier, checkpoint handoff, elastic membership.
+//
+// C ABI (ctypes-consumed; see paddle_tpu/distributed/store.py):
+//   pts_server_start(port)            -> server handle (>0) or -errno
+//   pts_server_stop(handle)
+//   pts_connect(host, port, timeout_ms) -> client handle (>0) or -errno
+//   pts_close(handle)
+//   pts_set(h, key, data, len)        -> 0 / -1
+//   pts_get(h, key, buf, cap, timeout_ms) -> value len, -1 timeout, -2 error
+//   pts_add(h, key, amount, out)      -> 0 / -1   (atomic counter)
+//   pts_wait(h, key, timeout_ms)      -> 0 / -1
+//   pts_delete_key(h, key)            -> 1 deleted, 0 missing, -1 error
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+enum class Cmd : uint8_t { SET = 0, GET = 1, ADD = 2, WAIT = 3, DEL = 4, PING = 5 };
+
+// -- framing helpers --------------------------------------------------------
+bool read_full(int fd, void* buf, size_t n) {
+  auto* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  auto* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_u32(int fd, uint32_t v) { return write_full(fd, &v, 4); }
+bool read_u32(int fd, uint32_t* v) { return read_full(fd, v, 4); }
+
+bool write_blob(int fd, const std::string& s) {
+  return write_u32(fd, static_cast<uint32_t>(s.size())) &&
+         (s.empty() || write_full(fd, s.data(), s.size()));
+}
+
+bool read_blob(int fd, std::string* s) {
+  uint32_t n;
+  if (!read_u32(fd, &n)) return false;
+  s->resize(n);
+  return n == 0 || read_full(fd, &(*s)[0], n);
+}
+
+// -- server -----------------------------------------------------------------
+class StoreServer {
+ public:
+  explicit StoreServer(int listen_fd) : listen_fd_(listen_fd), running_(true) {
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+  }
+
+  ~StoreServer() { Stop(); }
+
+  void Stop() {
+    bool expected = true;
+    if (!running_.compare_exchange_strong(expected, false)) return;
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+    if (accept_thread_.joinable()) accept_thread_.join();
+    std::map<uint64_t, std::thread> workers;
+    {
+      std::lock_guard<std::mutex> g(threads_mu_);
+      workers.swap(client_threads_);
+    }
+    {
+      std::lock_guard<std::mutex> g(fds_mu_);
+      for (int fd : client_fds_) ::shutdown(fd, SHUT_RDWR);
+    }
+    cv_.notify_all();
+    for (auto& [id, t] : workers)
+      if (t.joinable()) t.join();
+  }
+
+ private:
+  void AcceptLoop() {
+    while (running_) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) {
+        if (!running_) break;
+        continue;
+      }
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      {
+        std::lock_guard<std::mutex> g(fds_mu_);
+        client_fds_.push_back(fd);
+      }
+      Reap();  // join Serve threads of disconnected clients
+      std::lock_guard<std::mutex> g(threads_mu_);
+      uint64_t id = next_thread_id_++;
+      client_threads_.emplace(id, std::thread([this, fd, id] {
+        Serve(fd);
+        std::lock_guard<std::mutex> g2(threads_mu_);
+        finished_.push_back(id);
+      }));
+    }
+  }
+
+  void Reap() {
+    std::vector<std::thread> done;
+    {
+      std::lock_guard<std::mutex> g(threads_mu_);
+      for (uint64_t id : finished_) {
+        auto it = client_threads_.find(id);
+        if (it != client_threads_.end()) {
+          done.push_back(std::move(it->second));
+          client_threads_.erase(it);
+        }
+      }
+      finished_.clear();
+    }
+    for (auto& t : done)
+      if (t.joinable()) t.join();
+  }
+
+  void Serve(int fd) {
+    while (running_) {
+      uint8_t cmd;
+      if (!read_full(fd, &cmd, 1)) break;
+      std::string key;
+      if (!read_blob(fd, &key)) break;
+      switch (static_cast<Cmd>(cmd)) {
+        case Cmd::SET: {
+          std::string val;
+          if (!read_blob(fd, &val)) return;
+          {
+            std::lock_guard<std::mutex> g(mu_);
+            data_[key] = std::move(val);
+          }
+          cv_.notify_all();
+          if (!write_u32(fd, 0)) return;
+          break;
+        }
+        case Cmd::GET: {
+          uint32_t timeout_ms;
+          if (!read_u32(fd, &timeout_ms)) return;
+          std::string out;
+          bool found = WaitFor(key, timeout_ms, &out);
+          if (!write_u32(fd, found ? 1 : 0)) return;
+          if (found && !write_blob(fd, out)) return;
+          break;
+        }
+        case Cmd::ADD: {
+          int64_t amount;
+          if (!read_full(fd, &amount, 8)) return;
+          int64_t result;
+          {
+            std::lock_guard<std::mutex> g(mu_);
+            int64_t cur = 0;
+            auto it = data_.find(key);
+            if (it != data_.end() && it->second.size() == 8)
+              std::memcpy(&cur, it->second.data(), 8);
+            result = cur + amount;
+            std::string v(8, '\0');
+            std::memcpy(&v[0], &result, 8);
+            data_[key] = std::move(v);
+          }
+          cv_.notify_all();
+          if (!write_full(fd, &result, 8)) return;
+          break;
+        }
+        case Cmd::WAIT: {
+          uint32_t timeout_ms;
+          if (!read_u32(fd, &timeout_ms)) return;
+          std::string ignored;
+          bool found = WaitFor(key, timeout_ms, &ignored);
+          if (!write_u32(fd, found ? 1 : 0)) return;
+          break;
+        }
+        case Cmd::DEL: {
+          uint32_t n;
+          {
+            std::lock_guard<std::mutex> g(mu_);
+            n = static_cast<uint32_t>(data_.erase(key));
+          }
+          if (!write_u32(fd, n)) return;
+          break;
+        }
+        case Cmd::PING: {
+          if (!write_u32(fd, 0xA11CE)) return;
+          break;
+        }
+      }
+    }
+    ::close(fd);
+  }
+
+  bool WaitFor(const std::string& key, uint32_t timeout_ms, std::string* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto ready = [&] { return data_.count(key) > 0; };
+    // wait in short slices so Stop() (which flips running_) never blocks
+    // behind a long client timeout; timeout_ms == 0 waits forever
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms);
+    while (running_ && !ready()) {
+      if (timeout_ms != 0 && std::chrono::steady_clock::now() >= deadline) break;
+      cv_.wait_for(lk, std::chrono::milliseconds(200));
+    }
+    if (!ready()) return false;
+    *out = data_[key];
+    return true;
+  }
+
+  int listen_fd_;
+  std::atomic<bool> running_;
+  std::thread accept_thread_;
+  std::mutex threads_mu_;
+  std::map<uint64_t, std::thread> client_threads_;
+  std::vector<uint64_t> finished_;
+  uint64_t next_thread_id_ = 0;
+  std::mutex fds_mu_;
+  std::vector<int> client_fds_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<std::string, std::string> data_;
+};
+
+struct Client {
+  int fd;
+  std::mutex mu;  // one request/response in flight per client
+};
+
+std::mutex g_handles_mu;
+std::map<int64_t, StoreServer*> g_servers;
+std::map<int64_t, Client*> g_clients;
+int64_t g_next_handle = 1;
+
+Client* GetClient(int64_t h) {
+  std::lock_guard<std::mutex> g(g_handles_mu);
+  auto it = g_clients.find(h);
+  return it == g_clients.end() ? nullptr : it->second;
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t pts_server_start(int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      ::listen(fd, 128) < 0) {
+    ::close(fd);
+    return -2;
+  }
+  auto* server = new StoreServer(fd);
+  std::lock_guard<std::mutex> g(g_handles_mu);
+  int64_t h = g_next_handle++;
+  g_servers[h] = server;
+  return h;
+}
+
+void pts_server_stop(int64_t h) {
+  StoreServer* s = nullptr;
+  {
+    std::lock_guard<std::mutex> g(g_handles_mu);
+    auto it = g_servers.find(h);
+    if (it == g_servers.end()) return;
+    s = it->second;
+    g_servers.erase(it);
+  }
+  delete s;
+}
+
+int64_t pts_connect(const char* host, int port, int timeout_ms) {
+  addrinfo hints{}, *res = nullptr;
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  std::string port_s = std::to_string(port);
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms > 0 ? timeout_ms : 30000);
+  while (true) {
+    if (::getaddrinfo(host, port_s.c_str(), &hints, &res) == 0) {
+      int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+      if (fd >= 0 && ::connect(fd, res->ai_addr, res->ai_addrlen) == 0) {
+        ::freeaddrinfo(res);
+        int one = 1;
+        ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        auto* c = new Client{fd, {}};
+        std::lock_guard<std::mutex> g(g_handles_mu);
+        int64_t h = g_next_handle++;
+        g_clients[h] = c;
+        return h;
+      }
+      if (fd >= 0) ::close(fd);
+      ::freeaddrinfo(res);
+      res = nullptr;
+    }
+    if (std::chrono::steady_clock::now() > deadline) return -1;
+    std::this_thread::sleep_for(std::chrono::milliseconds(100));
+  }
+}
+
+void pts_close(int64_t h) {
+  Client* c = nullptr;
+  {
+    std::lock_guard<std::mutex> g(g_handles_mu);
+    auto it = g_clients.find(h);
+    if (it == g_clients.end()) return;
+    c = it->second;
+    g_clients.erase(it);
+  }
+  ::close(c->fd);
+  delete c;
+}
+
+int pts_set(int64_t h, const char* key, const uint8_t* data, int64_t len) {
+  Client* c = GetClient(h);
+  if (!c) return -1;
+  std::lock_guard<std::mutex> g(c->mu);
+  uint8_t cmd = static_cast<uint8_t>(Cmd::SET);
+  std::string k(key), v(reinterpret_cast<const char*>(data),
+                        static_cast<size_t>(len));
+  uint32_t ack;
+  if (!write_full(c->fd, &cmd, 1) || !write_blob(c->fd, k) ||
+      !write_blob(c->fd, v) || !read_u32(c->fd, &ack))
+    return -1;
+  return 0;
+}
+
+int64_t pts_get(int64_t h, const char* key, uint8_t* buf, int64_t cap,
+                int timeout_ms) {
+  Client* c = GetClient(h);
+  if (!c) return -2;
+  std::lock_guard<std::mutex> g(c->mu);
+  uint8_t cmd = static_cast<uint8_t>(Cmd::GET);
+  std::string k(key);
+  if (!write_full(c->fd, &cmd, 1) || !write_blob(c->fd, k) ||
+      !write_u32(c->fd, static_cast<uint32_t>(timeout_ms)))
+    return -2;
+  uint32_t found;
+  if (!read_u32(c->fd, &found)) return -2;
+  if (!found) return -1;
+  std::string v;
+  if (!read_blob(c->fd, &v)) return -2;
+  int64_t n = static_cast<int64_t>(v.size());
+  if (n > cap) return -3;
+  std::memcpy(buf, v.data(), v.size());
+  return n;
+}
+
+int pts_add(int64_t h, const char* key, int64_t amount, int64_t* out) {
+  Client* c = GetClient(h);
+  if (!c) return -1;
+  std::lock_guard<std::mutex> g(c->mu);
+  uint8_t cmd = static_cast<uint8_t>(Cmd::ADD);
+  std::string k(key);
+  if (!write_full(c->fd, &cmd, 1) || !write_blob(c->fd, k) ||
+      !write_full(c->fd, &amount, 8) || !read_full(c->fd, out, 8))
+    return -1;
+  return 0;
+}
+
+int pts_wait(int64_t h, const char* key, int timeout_ms) {
+  Client* c = GetClient(h);
+  if (!c) return -1;
+  std::lock_guard<std::mutex> g(c->mu);
+  uint8_t cmd = static_cast<uint8_t>(Cmd::WAIT);
+  std::string k(key);
+  uint32_t found;
+  if (!write_full(c->fd, &cmd, 1) || !write_blob(c->fd, k) ||
+      !write_u32(c->fd, static_cast<uint32_t>(timeout_ms)) ||
+      !read_u32(c->fd, &found))
+    return -1;
+  return found ? 0 : -1;
+}
+
+int pts_delete_key(int64_t h, const char* key) {
+  Client* c = GetClient(h);
+  if (!c) return -1;
+  std::lock_guard<std::mutex> g(c->mu);
+  uint8_t cmd = static_cast<uint8_t>(Cmd::DEL);
+  std::string k(key);
+  uint32_t n;
+  if (!write_full(c->fd, &cmd, 1) || !write_blob(c->fd, k) ||
+      !read_u32(c->fd, &n))
+    return -1;
+  return static_cast<int>(n);
+}
+
+}  // extern "C"

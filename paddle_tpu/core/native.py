@@ -1,0 +1,93 @@
+"""Loader for the native runtime library (csrc/ — TCP store, shm ring).
+
+The reference ships its runtime as one big pybind'd C++ tree; here the
+native pieces are a small C-ABI shared library consumed via ctypes, built
+lazily on first use (`make` in csrc/) and cached. Components degrade to
+pure-Python fallbacks when no toolchain is available, so the framework
+stays importable everywhere.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "csrc")
+_LIB_PATH = os.path.join(_CSRC, "build", "libpaddle_tpu_native.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build():
+    subprocess.run(
+        ["make", "-s", "-C", _CSRC],
+        check=True,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        timeout=300,
+    )
+
+
+def load():
+    """Return the loaded native library, building it if needed; None when
+    unavailable (no sources / no toolchain)."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            if not os.path.exists(_LIB_PATH):
+                if not os.path.isdir(_CSRC):
+                    return None
+                _build()
+            lib = ctypes.CDLL(_LIB_PATH)
+        except (OSError, subprocess.SubprocessError):
+            return None
+
+        # -- tcp store --
+        lib.pts_server_start.restype = ctypes.c_int64
+        lib.pts_server_start.argtypes = [ctypes.c_int]
+        lib.pts_server_stop.argtypes = [ctypes.c_int64]
+        lib.pts_connect.restype = ctypes.c_int64
+        lib.pts_connect.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+        lib.pts_close.argtypes = [ctypes.c_int64]
+        lib.pts_set.restype = ctypes.c_int
+        lib.pts_set.argtypes = [ctypes.c_int64, ctypes.c_char_p,
+                                ctypes.c_char_p, ctypes.c_int64]
+        lib.pts_get.restype = ctypes.c_int64
+        lib.pts_get.argtypes = [ctypes.c_int64, ctypes.c_char_p,
+                                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int]
+        lib.pts_add.restype = ctypes.c_int
+        lib.pts_add.argtypes = [ctypes.c_int64, ctypes.c_char_p,
+                                ctypes.c_int64,
+                                ctypes.POINTER(ctypes.c_int64)]
+        lib.pts_wait.restype = ctypes.c_int
+        lib.pts_wait.argtypes = [ctypes.c_int64, ctypes.c_char_p, ctypes.c_int]
+        lib.pts_delete_key.restype = ctypes.c_int
+        lib.pts_delete_key.argtypes = [ctypes.c_int64, ctypes.c_char_p]
+
+        # -- shm ring --
+        lib.shm_ring_create.restype = ctypes.c_int64
+        lib.shm_ring_create.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.shm_ring_attach.restype = ctypes.c_int64
+        lib.shm_ring_attach.argtypes = [ctypes.c_char_p]
+        lib.shm_ring_close.argtypes = [ctypes.c_int64, ctypes.c_int]
+        lib.shm_ring_push.restype = ctypes.c_int
+        lib.shm_ring_push.argtypes = [ctypes.c_int64, ctypes.c_char_p,
+                                      ctypes.c_int64, ctypes.c_int]
+        lib.shm_ring_pop_len.restype = ctypes.c_int64
+        lib.shm_ring_pop_len.argtypes = [ctypes.c_int64, ctypes.c_int]
+        lib.shm_ring_pop.restype = ctypes.c_int64
+        lib.shm_ring_pop.argtypes = [ctypes.c_int64, ctypes.c_void_p,
+                                     ctypes.c_int64]
+        _lib = lib
+        return _lib
+
+
+def available():
+    return load() is not None

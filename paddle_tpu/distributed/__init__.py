@@ -35,6 +35,7 @@ from . import checkpoint
 from . import sharding
 from .sharding import group_sharded_parallel, save_group_sharded_model
 from .launch_mod import spawn, launch
+from .store import TCPStore
 
 __all__ = [
     "init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
@@ -43,7 +44,7 @@ __all__ = [
     "alltoall", "alltoall_single", "all_to_all", "send", "recv", "barrier",
     "ReduceOp", "new_group", "get_group", "wait", "fleet", "spawn", "launch",
     "checkpoint", "DataParallel", "sharding", "group_sharded_parallel",
-    "save_group_sharded_model",
+    "save_group_sharded_model", "TCPStore",
 ]
 
 

@@ -1,0 +1,299 @@
+"""Rendezvous key-value store (reference:
+paddle/phi/core/distributed/store/tcp_store.{h,cc} — MasterDaemon + client,
+bound as core.TCPStore and used by init_parallel_env at
+python/paddle/distributed/parallel.py:279).
+
+TPU-native role: XLA collectives need no comm-id bootstrap, so the store
+only coordinates host-side orchestration — rank assignment, barriers,
+elastic membership, checkpoint handoff. Backed by the native C++ server
+(csrc/tcp_store.cc) when the toolchain is available, else a pure-Python
+socket server with the same wire behavior.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Optional
+
+from ..core import native
+
+__all__ = ["TCPStore"]
+
+
+# ---------------------------------------------------------------------------
+# Pure-Python fallback server (same semantics as csrc/tcp_store.cc)
+# ---------------------------------------------------------------------------
+class _PyStoreState:
+    def __init__(self):
+        self.data = {}
+        self.cv = threading.Condition()
+
+
+class _PyHandler(socketserver.BaseRequestHandler):
+    def _read(self, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = self.request.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError
+            buf += chunk
+        return buf
+
+    def _read_blob(self):
+        (n,) = struct.unpack("<I", self._read(4))
+        return self._read(n) if n else b""
+
+    def _write_blob(self, b):
+        self.request.sendall(struct.pack("<I", len(b)) + b)
+
+    def handle(self):
+        st = self.server.state
+        try:
+            while True:
+                cmd = self._read(1)[0]
+                key = self._read_blob().decode()
+                if cmd == 0:  # SET
+                    val = self._read_blob()
+                    with st.cv:
+                        st.data[key] = val
+                        st.cv.notify_all()
+                    self.request.sendall(struct.pack("<I", 0))
+                elif cmd in (1, 3):  # GET / WAIT
+                    (timeout_ms,) = struct.unpack("<I", self._read(4))
+                    deadline = None if timeout_ms == 0 else time.time() + timeout_ms / 1e3
+                    with st.cv:
+                        while key not in st.data:
+                            remain = None if deadline is None else deadline - time.time()
+                            if remain is not None and remain <= 0:
+                                break
+                            st.cv.wait(remain if remain is not None else 0.2)
+                        found = key in st.data
+                        val = st.data.get(key)
+                    self.request.sendall(struct.pack("<I", 1 if found else 0))
+                    if found and cmd == 1:
+                        self._write_blob(val)
+                elif cmd == 2:  # ADD
+                    (amount,) = struct.unpack("<q", self._read(8))
+                    with st.cv:
+                        cur = struct.unpack("<q", st.data.get(key, b"\0" * 8))[0]
+                        cur += amount
+                        st.data[key] = struct.pack("<q", cur)
+                        st.cv.notify_all()
+                    self.request.sendall(struct.pack("<q", cur))
+                elif cmd == 4:  # DEL
+                    with st.cv:
+                        n = 1 if st.data.pop(key, None) is not None else 0
+                    self.request.sendall(struct.pack("<I", n))
+                elif cmd == 5:  # PING
+                    self.request.sendall(struct.pack("<I", 0xA11CE))
+        except (ConnectionError, OSError):
+            return
+
+
+class _PyServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class _PyClient:
+    def __init__(self, host, port, timeout_s):
+        deadline = time.time() + timeout_s
+        while True:
+            try:
+                self.sock = socket.create_connection((host, port), timeout=5)
+                self.sock.settimeout(None)
+                self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise TimeoutError(f"cannot reach store at {host}:{port}")
+                time.sleep(0.1)
+        self.lock = threading.Lock()
+
+    def _read(self, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("store connection closed")
+            buf += chunk
+        return buf
+
+    def _req(self, cmd, key, payload=b""):
+        kb = key.encode()
+        self.sock.sendall(bytes([cmd]) + struct.pack("<I", len(kb)) + kb + payload)
+
+    def set(self, key, value):
+        with self.lock:
+            self._req(0, key, struct.pack("<I", len(value)) + value)
+            self._read(4)
+
+    def get(self, key, timeout_ms):
+        with self.lock:
+            self._req(1, key, struct.pack("<I", timeout_ms))
+            (found,) = struct.unpack("<I", self._read(4))
+            if not found:
+                return None
+            (n,) = struct.unpack("<I", self._read(4))
+            return self._read(n) if n else b""
+
+    def add(self, key, amount):
+        with self.lock:
+            self._req(2, key, struct.pack("<q", amount))
+            return struct.unpack("<q", self._read(8))[0]
+
+    def wait_key(self, key, timeout_ms):
+        with self.lock:
+            self._req(3, key, struct.pack("<I", timeout_ms))
+            (found,) = struct.unpack("<I", self._read(4))
+            return bool(found)
+
+    def delete(self, key):
+        with self.lock:
+            self._req(4, key)
+            return struct.unpack("<I", self._read(4))[0]
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+class TCPStore:
+    """paddle-style TCPStore: rank 0 (is_master=True) also hosts the server.
+
+    Values are bytes; `set`/`get` pickle arbitrary objects when
+    `raw=False` convenience wrappers are used.
+    """
+
+    GET_TIMEOUT_MS = 120_000
+
+    def __init__(self, host: str, port: int, is_master: bool = False,
+                 world_size: int = 1, timeout: float = 30.0):
+        self.host = host
+        self.port = port
+        self.is_master = is_master
+        self.world_size = world_size  # default participant count for barrier()
+        self._native = native.load()
+        self._srv = None
+        self._py_srv = None
+        if is_master:
+            if self._native is not None:
+                h = self._native.pts_server_start(port)
+                if h > 0:
+                    self._srv = h
+                else:
+                    raise OSError(f"TCPStore server failed on port {port} ({h})")
+            else:
+                self._py_srv = _PyServer((host if host else "0.0.0.0", port),
+                                         _PyHandler)
+                self._py_srv.state = _PyStoreState()
+                threading.Thread(target=self._py_srv.serve_forever,
+                                 daemon=True).start()
+        if self._native is not None:
+            self._cli = self._native.pts_connect(
+                (host or "127.0.0.1").encode(), port, int(timeout * 1000))
+            if self._cli <= 0:
+                raise TimeoutError(f"cannot reach store at {host}:{port}")
+            self._py_cli = None
+        else:
+            self._py_cli = _PyClient(host or "127.0.0.1", port, timeout)
+            self._cli = None
+
+    # -- raw bytes API ------------------------------------------------------
+    def set(self, key: str, value) -> None:
+        data = value if isinstance(value, (bytes, bytearray)) else pickle.dumps(value)
+        if self._py_cli is not None:
+            self._py_cli.set(key, bytes(data))
+        else:
+            rc = self._native.pts_set(self._cli, key.encode(), bytes(data), len(data))
+            if rc != 0:
+                raise ConnectionError("store set failed")
+
+    def get(self, key: str, timeout_ms: Optional[int] = None) -> bytes:
+        timeout_ms = self.GET_TIMEOUT_MS if timeout_ms is None else timeout_ms
+        if self._py_cli is not None:
+            out = self._py_cli.get(key, timeout_ms)
+            if out is None:
+                raise TimeoutError(f"store get({key!r}) timed out")
+            return out
+        cap = 1 << 20
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = self._native.pts_get(self._cli, key.encode(), buf, cap, timeout_ms)
+            if n == -3:
+                cap *= 16
+                continue
+            if n == -1:
+                raise TimeoutError(f"store get({key!r}) timed out")
+            if n < 0:
+                raise ConnectionError("store get failed")
+            return buf.raw[:n]
+
+    def get_obj(self, key: str, timeout_ms: Optional[int] = None):
+        return pickle.loads(self.get(key, timeout_ms))
+
+    def add(self, key: str, amount: int = 1) -> int:
+        if self._py_cli is not None:
+            return self._py_cli.add(key, amount)
+        out = ctypes.c_int64()
+        rc = self._native.pts_add(self._cli, key.encode(), amount, ctypes.byref(out))
+        if rc != 0:
+            raise ConnectionError("store add failed")
+        return out.value
+
+    def wait(self, keys, timeout_ms: Optional[int] = None) -> None:
+        timeout_ms = self.GET_TIMEOUT_MS if timeout_ms is None else timeout_ms
+        keys = [keys] if isinstance(keys, str) else list(keys)
+        for k in keys:
+            if self._py_cli is not None:
+                if not self._py_cli.wait_key(k, timeout_ms):
+                    raise TimeoutError(f"store wait({k!r}) timed out")
+            else:
+                if self._native.pts_wait(self._cli, k.encode(), timeout_ms) != 0:
+                    raise TimeoutError(f"store wait({k!r}) timed out")
+
+    def delete_key(self, key: str) -> bool:
+        if self._py_cli is not None:
+            return bool(self._py_cli.delete(key))
+        return self._native.pts_delete_key(self._cli, key.encode()) > 0
+
+    def barrier(self, name: str, world_size: Optional[int] = None,
+                timeout_ms: Optional[int] = None):
+        """Count-up barrier: all `world_size` participants block until the
+        counter for `name` reaches world_size (defaults to the store's
+        world_size)."""
+        world_size = world_size if world_size is not None else self.world_size
+        arrived = self.add(f"__barrier__/{name}", 1)
+        if arrived == world_size:
+            self.set(f"__barrier__/{name}/done", b"1")
+        self.wait(f"__barrier__/{name}/done", timeout_ms)
+
+    def close(self):
+        if self._py_cli is not None:
+            self._py_cli.close()
+        elif self._cli:
+            self._native.pts_close(self._cli)
+            self._cli = None
+        if self._srv:
+            self._native.pts_server_stop(self._srv)
+            self._srv = None
+        if self._py_srv is not None:
+            self._py_srv.shutdown()
+            self._py_srv = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

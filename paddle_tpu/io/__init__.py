@@ -294,16 +294,54 @@ def _to_tensor_tree(obj):
     return obj
 
 
+def _shm_available():
+    try:
+        from . import shm
+
+        return shm.available()
+    except Exception:
+        return False
+
+
+def _worker_loop(dataset, collate_fn, my_batches, ring_name, worker_id,
+                 num_workers, worker_init_fn):
+    """Runs in a forked child: build assigned batches, push via shm ring."""
+    global _worker_info
+    from . import shm
+
+    q = shm.ShmQueue.__new__(shm.ShmQueue)._init_attach(ring_name)
+    _worker_info = _WorkerInfo(worker_id, num_workers, dataset)
+    try:
+        if worker_init_fn is not None:
+            worker_init_fn(worker_id)
+        for indices in my_batches:
+            q.put(collate_fn([dataset[i] for i in indices]), timeout_ms=0)
+    except BaseException:
+        import traceback
+
+        try:
+            q.put(("__PTPU_ERR__", traceback.format_exc()), timeout_ms=5000)
+        except Exception:
+            pass
+    finally:
+        q.close(unlink=False)
+
+
 class DataLoader:
     def __init__(self, dataset, feed_list=None, places=None, return_list=True,
                  batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
                  collate_fn=None, num_workers=0, use_buffer_reader=True,
                  prefetch_factor=2, use_shared_memory=True, timeout=0,
-                 worker_init_fn=None, persistent_workers=False):
+                 worker_init_fn=None, persistent_workers=False,
+                 shm_capacity=64 << 20):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
+        self.use_shared_memory = use_shared_memory
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout  # seconds per batch; 0 = no limit
+        self.shm_capacity = shm_capacity  # per-worker ring bytes
         self._iterable_mode = isinstance(dataset, IterableDataset)
         self.batch_size = batch_size
         self.drop_last = drop_last
@@ -321,6 +359,71 @@ class DataLoader:
         if self._iterable_mode:
             raise TypeError("length of IterableDataset loader undefined")
         return len(self.batch_sampler)
+
+    def _iter_multiprocess(self):
+        """True multiprocess workers over the native shm ring transport
+        (reference: dataloader_iter.py:369 _DataLoaderIterMultiProcess +
+        shared-memory LoDTensor transport). Worker w handles batches
+        w, w+W, w+2W, ...; the main process pops round-robin, preserving
+        batch order; the bounded ring provides backpressure."""
+        import multiprocessing as mp
+
+        from . import shm
+
+        W = self.num_workers
+        batches = list(self.batch_sampler)
+        queues = [shm.ShmQueue(capacity_bytes=self.shm_capacity) for _ in range(W)]
+        ctx = mp.get_context("fork")
+        procs = []
+        for w in range(W):
+            p = ctx.Process(
+                target=_worker_loop,
+                args=(self.dataset, self.collate_fn, batches[w::W],
+                      queues[w].name, w, W, self.worker_init_fn),
+                daemon=True,
+            )
+            p.start()
+            procs.append(p)
+        # timeout==0 means unbounded; poll in short slices either way so a
+        # worker killed without pushing its error sentinel (e.g. OOM-kill)
+        # is detected by liveness instead of hanging the trainer
+        deadline_ms = int(self.timeout * 1000) if self.timeout else None
+        poll_ms = 2000
+        try:
+            for i in range(len(batches)):
+                w = i % W
+                waited = 0
+                while True:
+                    try:
+                        item = queues[w].get(timeout_ms=poll_ms)
+                        break
+                    except TimeoutError:
+                        waited += poll_ms
+                        if not procs[w].is_alive():
+                            # worker may have pushed its last batch right
+                            # before exiting — drain once before declaring
+                            # it dead
+                            try:
+                                item = queues[w].get(timeout_ms=100)
+                                break
+                            except TimeoutError:
+                                raise RuntimeError(
+                                    f"DataLoader worker {w} exited unexpectedly "
+                                    f"(exitcode {procs[w].exitcode})") from None
+                        if deadline_ms is not None and waited >= deadline_ms:
+                            raise
+                if (isinstance(item, tuple) and len(item) == 2
+                        and isinstance(item[0], str) and item[0] == "__PTPU_ERR__"):
+                    raise RuntimeError(f"DataLoader worker {w} failed:\n{item[1]}")
+                yield _to_tensor_tree(item)
+        finally:
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+            for p in procs:
+                p.join(timeout=5)
+            for q in queues:
+                q.close()
 
     def _iter_batches_np(self):
         if self._iterable_mode:
@@ -341,8 +444,12 @@ class DataLoader:
             for batch in self._iter_batches_np():
                 yield _to_tensor_tree(batch)
             return
+        if (self.use_shared_memory and not self._iterable_mode
+                and _shm_available()):
+            yield from self._iter_multiprocess()
+            return
         # background-thread prefetch pipeline (overlaps host batch assembly
-        # with device compute; true multiprocess workers are a later round)
+        # with device compute; shm multiprocess path above when available)
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_factor * max(self.num_workers, 1))
         sentinel = object()
         error = []

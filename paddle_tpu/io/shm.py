@@ -1,0 +1,148 @@
+"""Shared-memory batch transport for multiprocess DataLoader workers
+(reference: the mmap/shared-memory LoDTensor path in
+python/paddle/fluid/dataloader/worker.py + paddle/fluid/memory/allocation/
+mmap_allocator.cc). Workers serialize numpy batches into a native shm ring
+(csrc/shm_ring.cc) instead of pickling through a pipe; the trainer pops
+zero-copy into numpy."""
+from __future__ import annotations
+
+import ctypes
+import io
+import os
+import pickle
+import uuid
+
+import numpy as np
+
+from ..core import native
+
+__all__ = ["ShmQueue", "available"]
+
+
+def available():
+    return native.load() is not None
+
+
+def _pack(arrays):
+    """Serialize a pytree of numpy arrays compactly: header pickle with
+    dtype/shape + raw buffers appended (avoids pickle's array copy)."""
+    flat = []
+
+    def enc(x):
+        if isinstance(x, np.ndarray):
+            flat.append(np.ascontiguousarray(x))
+            return ("__nd__", len(flat) - 1, x.dtype.str, x.shape)
+        if isinstance(x, (list, tuple)):
+            return type(x)(enc(v) for v in x)
+        if isinstance(x, dict):
+            return {k: enc(v) for k, v in x.items()}
+        return x
+
+    tree = enc(arrays)
+    head = pickle.dumps(tree)
+    parts = [len(head).to_bytes(8, "little"), head]
+    for a in flat:
+        b = a.tobytes()  # NOTE: one copy; a.data would pin the array
+        parts.append(len(b).to_bytes(8, "little"))
+        parts.append(b)
+    return b"".join(parts)
+
+
+def _unpack(buf):
+    hlen = int.from_bytes(buf[:8], "little")
+    tree = pickle.loads(buf[8:8 + hlen])
+    off = 8 + hlen
+    buffers = []
+    while off < len(buf):
+        n = int.from_bytes(buf[off:off + 8], "little")
+        off += 8
+        buffers.append(buf[off:off + n])
+        off += n
+
+    def dec(x):
+        if isinstance(x, tuple) and len(x) == 4 and x[0] == "__nd__":
+            _, i, dt, shape = x
+            return np.frombuffer(buffers[i], dtype=np.dtype(dt)).reshape(shape)
+        if isinstance(x, (list, tuple)):
+            return type(x)(dec(v) for v in x)
+        if isinstance(x, dict):
+            return {k: dec(v) for k, v in x.items()}
+        return x
+
+    return dec(tree)
+
+
+class ShmQueue:
+    """Single-producer/single-consumer shm message queue for one worker."""
+
+    def __init__(self, capacity_bytes=64 << 20, name=None, create=True):
+        lib = native.load()
+        if lib is None:
+            raise RuntimeError("native shm ring unavailable")
+        self._lib = lib
+        self.name = name or f"/ptpu_{os.getpid()}_{uuid.uuid4().hex[:8]}"
+        if create:
+            h = lib.shm_ring_create(self.name.encode(), capacity_bytes)
+        else:
+            h = lib.shm_ring_attach(self.name.encode())
+        if h <= 0:
+            raise OSError(f"shm ring {'create' if create else 'attach'} "
+                          f"failed ({h}) for {self.name}")
+        self._h = h
+        self._owner = create
+
+    def attach(self):
+        """Handle for a child process (re-attach by name)."""
+        return ShmQueue.__new__(ShmQueue)._init_attach(self.name)
+
+    def _init_attach(self, name):
+        self._lib = native.load()
+        self.name = name
+        h = self._lib.shm_ring_attach(name.encode())
+        if h <= 0:
+            raise OSError(f"shm ring attach failed ({h}) for {name}")
+        self._h = h
+        self._owner = False
+        return self
+
+    def put(self, obj, timeout_ms=0):
+        data = _pack(obj)
+        rc = self._lib.shm_ring_push(self._h, data, len(data), timeout_ms)
+        if rc == -1:
+            raise TimeoutError("shm push timed out")
+        if rc == -3:
+            raise ValueError(
+                f"batch of {len(data)} bytes exceeds ring capacity; raise "
+                f"DataLoader(shm_capacity=...)")
+        if rc != 0:
+            raise OSError(f"shm push failed ({rc})")
+
+    def get(self, timeout_ms=0):
+        n = self._lib.shm_ring_pop_len(self._h, timeout_ms)
+        if n == -1:
+            raise TimeoutError("shm pop timed out")
+        if n < 0:
+            raise OSError(f"shm pop failed ({n})")
+        buf = ctypes.create_string_buffer(int(n))
+        got = self._lib.shm_ring_pop(self._h, buf, n)
+        if got < 0:
+            raise OSError(f"shm pop failed ({got})")
+        return _unpack(memoryview(buf)[:got])
+
+    def close(self, unlink=None):
+        if getattr(self, "_h", None):
+            self._lib.shm_ring_close(
+                self._h, 1 if (self._owner if unlink is None else unlink) else 0)
+            self._h = None
+
+    def __getstate__(self):
+        return {"name": self.name}
+
+    def __setstate__(self, state):
+        self._init_attach(state["name"])
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

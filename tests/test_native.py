@@ -1,0 +1,183 @@
+"""Native C++ runtime components: TCP store rendezvous + shm ring transport
+(reference: phi/core/distributed/store/tcp_store.cc tests and the
+mmap-allocator dataloader transport)."""
+import multiprocessing as mp
+import os
+import pickle
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core import native
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.io import shm
+
+NATIVE = native.available()
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_native_builds():
+    """The toolchain is baked into the image — the native lib must build."""
+    assert NATIVE, "native library failed to build"
+
+
+@pytest.mark.parametrize("force_py", [False, True])
+def test_store_set_get_add_wait(force_py, monkeypatch):
+    if force_py:
+        monkeypatch.setattr(native, "load", lambda: None)
+    port = _free_port()
+    master = TCPStore("127.0.0.1", port, is_master=True)
+    client = TCPStore("127.0.0.1", port, is_master=False)
+    try:
+        master.set("alpha", b"hello")
+        assert client.get("alpha") == b"hello"
+        client.set("obj", {"rank": 3})
+        assert master.get_obj("obj") == {"rank": 3}
+        assert client.add("ctr", 5) == 5
+        assert master.add("ctr", 2) == 7
+        with pytest.raises(TimeoutError):
+            client.get("missing", timeout_ms=200)
+        master.set("late", b"x")
+        client.wait(["alpha", "late"], timeout_ms=2000)
+        assert client.delete_key("alpha") is True
+        assert client.delete_key("alpha") is False
+    finally:
+        client.close()
+        master.close()
+
+
+def _store_worker(port, rank, results_q):
+    store = TCPStore("127.0.0.1", port, is_master=False)
+    my_rank = store.add("rank_counter", 1) - 1
+    store.set(f"rank/{my_rank}", str(os.getpid()).encode())
+    store.barrier("start", 3, timeout_ms=20000)
+    peers = [int(store.get(f"rank/{r}").decode()) for r in range(3)]
+    results_q.put((rank, my_rank, peers))
+    store.close()
+
+
+@pytest.mark.skipif(not NATIVE, reason="needs native lib")
+def test_store_multiprocess_rendezvous():
+    """3 processes rendezvous: unique ranks + barrier + peer discovery."""
+    port = _free_port()
+    master = TCPStore("127.0.0.1", port, is_master=True)
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_store_worker, args=(port, i, q)) for i in range(3)]
+    for p in procs:
+        p.start()
+    results = [q.get(timeout=60) for _ in range(3)]
+    for p in procs:
+        p.join(timeout=30)
+        assert p.exitcode == 0
+    ranks = sorted(r[1] for r in results)
+    assert ranks == [0, 1, 2]
+    pid_sets = {tuple(sorted(r[2])) for r in results}
+    assert len(pid_sets) == 1  # everyone discovered the same peer set
+    master.close()
+
+
+@pytest.mark.skipif(not NATIVE, reason="needs native lib")
+def test_shm_queue_roundtrip():
+    q = shm.ShmQueue(capacity_bytes=1 << 20)
+    try:
+        batch = {"x": np.arange(12, dtype=np.float32).reshape(3, 4),
+                 "y": np.array([1, 2, 3], np.int64),
+                 "meta": ("epoch", 7)}
+        q.put(batch)
+        out = q.get(timeout_ms=1000)
+        np.testing.assert_array_equal(out["x"], batch["x"])
+        np.testing.assert_array_equal(out["y"], batch["y"])
+        assert out["meta"] == ("epoch", 7)
+    finally:
+        q.close()
+
+
+@pytest.mark.skipif(not NATIVE, reason="needs native lib")
+def test_shm_queue_wraparound():
+    """Many pushes/pops larger than half the ring exercise the wrap path."""
+    q = shm.ShmQueue(capacity_bytes=1 << 16)
+    try:
+        r = np.random.RandomState(0)
+        for i in range(50):
+            a = r.randn(r.randint(100, 2000)).astype("float32")
+            q.put(a)
+            out = q.get(timeout_ms=1000)
+            np.testing.assert_array_equal(out, a)
+    finally:
+        q.close()
+
+
+def _shm_producer(name, n):
+    q = shm.ShmQueue.__new__(shm.ShmQueue)._init_attach(name)
+    for i in range(n):
+        q.put({"i": np.full((64, 64), i, np.float32)}, timeout_ms=10000)
+    q.close(unlink=False)
+
+
+@pytest.mark.skipif(not NATIVE, reason="needs native lib")
+def test_shm_queue_cross_process():
+    q = shm.ShmQueue(capacity_bytes=1 << 20)
+    ctx = mp.get_context("spawn")
+    p = ctx.Process(target=_shm_producer, args=(q.name, 20))
+    p.start()
+    try:
+        for i in range(20):
+            out = q.get(timeout_ms=30000)
+            assert float(out["i"][0, 0]) == i
+    finally:
+        p.join(timeout=30)
+        q.close()
+    assert p.exitcode == 0
+
+
+@pytest.mark.skipif(not NATIVE, reason="needs native lib")
+def test_shm_queue_blocking_backpressure():
+    """Ring smaller than the payload stream: producer blocks until consumer
+    drains (backpressure, not data loss)."""
+    q = shm.ShmQueue(capacity_bytes=1 << 15)
+    ctx = mp.get_context("spawn")
+    p = ctx.Process(target=_shm_producer, args=(q.name, 8))  # 16KB each > ring/2
+    p.start()
+    got = []
+    try:
+        for _ in range(8):
+            time.sleep(0.05)
+            got.append(float(q.get(timeout_ms=30000)["i"][0, 0]))
+    finally:
+        p.join(timeout=30)
+        q.close()
+    assert got == [float(i) for i in range(8)]
+    assert p.exitcode == 0
+
+
+@pytest.mark.skipif(not NATIVE, reason="needs native lib")
+def test_dataloader_multiprocess_shm():
+    """DataLoader(num_workers=2) runs real worker processes over shm rings
+    and preserves batch order."""
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class Squares(Dataset):
+        def __len__(self):
+            return 40
+
+        def __getitem__(self, i):
+            return np.full((8,), i * i, np.float32), np.int64(i)
+
+    loader = DataLoader(Squares(), batch_size=4, shuffle=False,
+                        num_workers=2, drop_last=False)
+    seen = []
+    for x, y in loader:
+        assert x.shape == (4, 8)
+        seen.extend(int(v) for v in y.numpy())
+    assert seen == list(range(40))
