@@ -25,6 +25,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
@@ -150,6 +151,16 @@ class StoreServer {
   }
 
   void Serve(int fd) {
+    ServeLoop(fd);  // returns on disconnect/protocol error
+    {
+      std::lock_guard<std::mutex> g(fds_mu_);
+      client_fds_.erase(std::remove(client_fds_.begin(), client_fds_.end(), fd),
+                        client_fds_.end());
+    }
+    ::close(fd);
+  }
+
+  void ServeLoop(int fd) {
     while (running_) {
       uint8_t cmd;
       if (!read_full(fd, &cmd, 1)) break;
@@ -218,7 +229,6 @@ class StoreServer {
         }
       }
     }
-    ::close(fd);
   }
 
   bool WaitFor(const std::string& key, uint32_t timeout_ms, std::string* out) {
@@ -271,7 +281,7 @@ Client* GetClient(int64_t h) {
 
 extern "C" {
 
-int64_t pts_server_start(int port) {
+int64_t pts_server_start(const char* host, int port) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return -1;
   int one = 1;
@@ -279,6 +289,8 @@ int64_t pts_server_start(int port) {
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  if (host != nullptr && host[0] != '\0')
+    ::inet_pton(AF_INET, host, &addr.sin_addr);
   addr.sin_port = htons(static_cast<uint16_t>(port));
   if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
       ::listen(fd, 128) < 0) {

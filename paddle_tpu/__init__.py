@@ -67,6 +67,8 @@ from .hapi import Model, summary  # noqa: E402
 from . import distribution  # noqa: E402
 from . import fft  # noqa: E402
 from . import signal  # noqa: E402
+from . import sparse  # noqa: E402
+from . import quantization  # noqa: E402
 
 from .framework.io_ import save, load  # noqa: E402
 from .framework.core_ import (  # noqa: E402

@@ -51,7 +51,7 @@ def load():
 
         # -- tcp store --
         lib.pts_server_start.restype = ctypes.c_int64
-        lib.pts_server_start.argtypes = [ctypes.c_int]
+        lib.pts_server_start.argtypes = [ctypes.c_char_p, ctypes.c_int]
         lib.pts_server_stop.argtypes = [ctypes.c_int64]
         lib.pts_connect.restype = ctypes.c_int64
         lib.pts_connect.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]

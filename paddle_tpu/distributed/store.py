@@ -184,12 +184,13 @@ class TCPStore:
         self.port = port
         self.is_master = is_master
         self.world_size = world_size  # default participant count for barrier()
+        self._barrier_rounds = {}
         self._native = native.load()
         self._srv = None
         self._py_srv = None
         if is_master:
             if self._native is not None:
-                h = self._native.pts_server_start(port)
+                h = self._native.pts_server_start((host or "").encode(), port)
                 if h > 0:
                     self._srv = h
                 else:
@@ -270,14 +271,18 @@ class TCPStore:
 
     def barrier(self, name: str, world_size: Optional[int] = None,
                 timeout_ms: Optional[int] = None):
-        """Count-up barrier: all `world_size` participants block until the
-        counter for `name` reaches world_size (defaults to the store's
-        world_size)."""
+        """Reusable count-up barrier: all `world_size` participants block
+        until the counter reaches world_size. Each call with the same name
+        is a new round (locally tracked round id keys the counter), and the
+        release check is >= so a stray over-count can't hang everyone."""
         world_size = world_size if world_size is not None else self.world_size
-        arrived = self.add(f"__barrier__/{name}", 1)
-        if arrived == world_size:
-            self.set(f"__barrier__/{name}/done", b"1")
-        self.wait(f"__barrier__/{name}/done", timeout_ms)
+        rnd = self._barrier_rounds.get(name, 0)
+        self._barrier_rounds[name] = rnd + 1
+        key = f"__barrier__/{name}/{rnd}"
+        arrived = self.add(key, 1)
+        if arrived >= world_size:
+            self.set(f"{key}/done", b"1")
+        self.wait(f"{key}/done", timeout_ms)
 
     def close(self):
         if self._py_cli is not None:

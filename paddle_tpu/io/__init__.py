@@ -309,7 +309,7 @@ def _worker_loop(dataset, collate_fn, my_batches, ring_name, worker_id,
     global _worker_info
     from . import shm
 
-    q = shm.ShmQueue.__new__(shm.ShmQueue)._init_attach(ring_name)
+    q = shm.ShmQueue.attach(ring_name)
     _worker_info = _WorkerInfo(worker_id, num_workers, dataset)
     try:
         if worker_init_fn is not None:
@@ -359,6 +359,26 @@ class DataLoader:
         if self._iterable_mode:
             raise TypeError("length of IterableDataset loader undefined")
         return len(self.batch_sampler)
+
+    def _dataset_yields_tensors(self):
+        """Forked workers must not touch device arrays (XLA runtime state is
+        not fork-safe) — datasets returning framework Tensors stay on the
+        thread-prefetch path."""
+        try:
+            sample = self.dataset[0]
+        except Exception:
+            return False
+
+        def has_tensor(x):
+            if isinstance(x, Tensor):
+                return True
+            if isinstance(x, (list, tuple)):
+                return any(has_tensor(v) for v in x)
+            if isinstance(x, dict):
+                return any(has_tensor(v) for v in x.values())
+            return False
+
+        return has_tensor(sample)
 
     def _iter_multiprocess(self):
         """True multiprocess workers over the native shm ring transport
@@ -445,7 +465,7 @@ class DataLoader:
                 yield _to_tensor_tree(batch)
             return
         if (self.use_shared_memory and not self._iterable_mode
-                and _shm_available()):
+                and _shm_available() and not self._dataset_yields_tensors()):
             yield from self._iter_multiprocess()
             return
         # background-thread prefetch pipeline (overlaps host batch assembly

@@ -91,9 +91,10 @@ class ShmQueue:
         self._h = h
         self._owner = create
 
-    def attach(self):
-        """Handle for a child process (re-attach by name)."""
-        return ShmQueue.__new__(ShmQueue)._init_attach(self.name)
+    @classmethod
+    def attach(cls, name):
+        """Re-attach to an existing ring by name (child-process side)."""
+        return cls(name=name, create=False)
 
     def _init_attach(self, name):
         self._lib = native.load()

@@ -1,0 +1,363 @@
+"""Quantization framework (reference: python/paddle/quantization/ —
+QuantConfig (config.py), QAT (qat.py:22), PTQ (ptq.py), quanters
+(quanters/abs_max.py FakeQuanterWithAbsMaxObserver), observers; legacy
+imperative QAT at python/paddle/fluid/contrib/slim).
+
+TPU-native notes: fake-quant is expressed with a straight-through
+estimator built from plain ops (round + STE via stop-gradient), so QAT
+trains inside the same whole-graph jit as everything else; int8 inference
+folds scales into the weights (XLA int8 matmuls feed the MXU directly).
+"""
+from __future__ import annotations
+
+import copy
+import warnings
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply
+from ..nn.layer import Layer
+from ..nn.common import Linear
+from ..nn.conv import Conv2D
+from .. import nn as _nn
+import paddle_tpu.nn.functional as F
+
+__all__ = [
+    "QuantConfig", "QAT", "PTQ",
+    "FakeQuanterWithAbsMaxObserver", "WeightAbsMaxQuanter", "AbsmaxObserver",
+    "PassthroughWeightObserver", "QuantedLinear", "QuantedConv2D",
+    "quantize_linear", "dequantize_linear",
+]
+
+
+# ---------------------------------------------------------------------------
+# low-level fake-quant ops
+# ---------------------------------------------------------------------------
+def _fake_quant_ste(x, scale, bit_length=8):
+    """Quantize-dequantize with straight-through gradient:
+    y = x + stop_grad(qdq(x) - x)."""
+    qmax = float(2 ** (bit_length - 1) - 1)
+
+    def fn(a, s):
+        s = jnp.maximum(s, 1e-9)
+        q = jnp.clip(jnp.round(a / s * qmax), -qmax, qmax)
+        dq = q * s / qmax
+        # straight-through: forward dq, backward identity wrt a
+        return a + jax.lax.stop_gradient(dq - a)
+
+    return apply(fn, x, scale, name="fake_quant")
+
+
+def quantize_linear(x, scale, zero_point=0, bit_length=8, name=None):
+    qmax = float(2 ** (bit_length - 1) - 1)
+    return apply(
+        lambda a, s: jnp.clip(jnp.round(a / jnp.maximum(s, 1e-9) * qmax) + zero_point,
+                              -qmax - 1, qmax).astype(jnp.int8),
+        x, scale, name="quantize_linear")
+
+
+def dequantize_linear(x, scale, zero_point=0, bit_length=8, name=None):
+    qmax = float(2 ** (bit_length - 1) - 1)
+    return apply(
+        lambda a, s: (a.astype(jnp.float32) - zero_point) * s / qmax,
+        x, scale, name="dequantize_linear")
+
+
+# ---------------------------------------------------------------------------
+# quanters / observers
+# ---------------------------------------------------------------------------
+class BaseQuanter(Layer):
+    bit_length = 8
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        return Tensor(jnp.zeros_like(self.scales()._data))
+
+
+class FakeQuanterWithAbsMaxObserver(BaseQuanter):
+    """QAT activation quanter: EMA of abs-max as scale + STE fake quant
+    (reference: quanters/abs_max.py, moving_rate default 0.9)."""
+
+    def __init__(self, moving_rate=0.9, bit_length=8, dtype="float32", name=None):
+        super().__init__()
+        self.moving_rate = moving_rate
+        self.bit_length = bit_length
+        self.register_buffer("_scale", Tensor(jnp.ones((), jnp.float32)))
+        self.register_buffer("_state", Tensor(jnp.zeros((), jnp.float32)))
+
+    def forward(self, x):
+        if self.training:
+            # pure-jnp buffer update (same pattern as batch_norm running
+            # stats): traces cleanly under whole-graph jit, no host sync
+            m = self.moving_rate
+            cur = jnp.maximum(jnp.max(jnp.abs(x._data)).astype(jnp.float32), 1e-9)
+            prev = self._scale._data
+            first = self._state._data < 0.5
+            self._scale._data = jnp.where(first, cur, m * prev + (1 - m) * cur)
+            self._state._data = self._state._data + 1
+        return _fake_quant_ste(x, self._scale, self.bit_length)
+
+    def scales(self):
+        return self._scale
+
+
+class WeightAbsMaxQuanter(BaseQuanter):
+    """Per-tensor abs-max weight quanter (recomputed each forward from the
+    live weight — weights change every optimizer step under QAT)."""
+
+    def __init__(self, bit_length=8, name=None):
+        super().__init__()
+        self.bit_length = bit_length
+        self.register_buffer("_scale", Tensor(jnp.ones((), jnp.float32)))
+
+    def forward(self, w):
+        scale = apply(lambda a: jnp.maximum(jnp.max(jnp.abs(a)), 1e-9), w,
+                      name="abs_max")
+        self._scale._data = jax_stop(scale._data)
+        return _fake_quant_ste(w, scale, self.bit_length)
+
+    def scales(self):
+        return self._scale
+
+
+def jax_stop(a):
+    return jax.lax.stop_gradient(a)
+
+
+class PassthroughWeightObserver(BaseQuanter):
+    """PTQ weight observer: records abs-max but leaves the weight
+    untouched during calibration (quantization happens at convert)."""
+
+    def __init__(self, bit_length=8):
+        super().__init__()
+        self.bit_length = bit_length
+        self.register_buffer("_scale", Tensor(jnp.ones((), jnp.float32)))
+
+    def forward(self, w):
+        self._scale._data = jnp.asarray(
+            float(np.max(np.abs(np.asarray(w._data))) or 1e-9), jnp.float32)
+        return w
+
+    def scales(self):
+        return self._scale
+
+
+class AbsmaxObserver(BaseQuanter):
+    """PTQ observer: running abs-max over calibration batches (reference:
+    observers/abs_max.py)."""
+
+    def __init__(self, quant_bits=8, name=None):
+        super().__init__()
+        self.bit_length = quant_bits
+        self.register_buffer("_max", Tensor(jnp.zeros((), jnp.float32)))
+
+    def forward(self, x):
+        cur = float(np.max(np.abs(np.asarray(x._data))) or 0.0)
+        self._max._data = jnp.asarray(max(float(self._max._data), cur), jnp.float32)
+        return x  # observers pass activations through unchanged
+
+    def scales(self):
+        return self._max
+
+
+# ---------------------------------------------------------------------------
+# config
+# ---------------------------------------------------------------------------
+class _SingleConfig:
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+
+
+class QuantConfig:
+    """Maps layers to (activation quanter factory, weight quanter factory)
+    (reference: quantization/config.py — default + per-type + per-layer)."""
+
+    def __init__(self, activation=None, weight=None):
+        self._default = _SingleConfig(activation, weight)
+        self._type_configs = {}
+        self._layer_configs = {}
+
+    def add_type_config(self, layer_types, activation=None, weight=None):
+        if not isinstance(layer_types, (list, tuple)):
+            layer_types = [layer_types]
+        for t in layer_types:
+            self._type_configs[t] = _SingleConfig(activation, weight)
+
+    def add_layer_config(self, layers, activation=None, weight=None):
+        if not isinstance(layers, (list, tuple)):
+            layers = [layers]
+        for l in layers:
+            self._layer_configs[id(l)] = _SingleConfig(activation, weight)
+
+    def _config_for(self, layer):
+        if id(layer) in self._layer_configs:
+            return self._layer_configs[id(layer)]
+        for t, cfg in self._type_configs.items():
+            if isinstance(layer, t):
+                return cfg
+        return self._default
+
+
+def _make(factory):
+    if factory is None:
+        return None
+    return factory() if callable(factory) and not isinstance(factory, Layer) else factory
+
+
+# ---------------------------------------------------------------------------
+# quantized layer wrappers
+# ---------------------------------------------------------------------------
+class QuantedLinear(Layer):
+    def __init__(self, layer: Linear, act_quanter=None, weight_quanter=None):
+        super().__init__()
+        self.inner = layer
+        self.activation_quanter = act_quanter
+        self.weight_quanter = weight_quanter or WeightAbsMaxQuanter()
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.weight_quanter(self.inner.weight)
+        return F.linear(x, w, self.inner.bias)
+
+
+class QuantedConv2D(Layer):
+    def __init__(self, layer: Conv2D, act_quanter=None, weight_quanter=None):
+        super().__init__()
+        self.inner = layer
+        self.activation_quanter = act_quanter
+        self.weight_quanter = weight_quanter or WeightAbsMaxQuanter()
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.weight_quanter(self.inner.weight)
+        l = self.inner
+        return F.conv2d(x, w, l.bias, stride=l._stride, padding=l._padding,
+                        dilation=l._dilation, groups=l._groups)
+
+
+_QUANTABLE = {Linear: QuantedLinear, Conv2D: QuantedConv2D}
+
+
+def _swap_layers(model, make_wrapper):
+    for name, sub in list(model._sub_layers.items()):
+        wrapped = make_wrapper(sub)
+        if wrapped is not None:
+            model._sub_layers[name] = wrapped
+        else:
+            _swap_layers(sub, make_wrapper)
+    return model
+
+
+# ---------------------------------------------------------------------------
+# QAT / PTQ drivers
+# ---------------------------------------------------------------------------
+class QAT:
+    """Quantization-aware training: swap quantable layers for fake-quant
+    wrappers (reference: quantization/qat.py:22)."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: Layer, inplace=False):
+        if not inplace:
+            model = copy.deepcopy(model)
+
+        def wrapper(layer):
+            for base, qcls in _QUANTABLE.items():
+                if isinstance(layer, base):
+                    cfg = self.config._config_for(layer)
+                    act = _make(cfg.activation)
+                    wq = _make(cfg.weight) or WeightAbsMaxQuanter()
+                    return qcls(layer, act, wq)
+            return None
+
+        return _swap_layers(model, wrapper)
+
+    def convert(self, model: Layer, inplace=False):
+        """Fold fake quant into static scales for inference: weights are
+        quantize-dequantized once with the final scales, activation
+        quanters become fixed-scale qdq."""
+        if not inplace:
+            model = copy.deepcopy(model)
+
+        def fold(layer):
+            if isinstance(layer, (QuantedLinear, QuantedConv2D)):
+                inner = layer.inner
+                w = layer.weight_quanter(inner.weight)
+                inner.weight._data = jax_stop(w._data)
+                # the learned activation scale becomes a fixed-scale qdq
+                aq = layer.activation_quanter
+                if aq is not None and float(aq.scales()._data) > 0:
+                    return _FixedQDQ(inner, Tensor(aq.scales()._data),
+                                     aq.bit_length)
+                return inner
+            return None
+
+        return _swap_layers(model, fold)
+
+
+class PTQ:
+    """Post-training quantization: insert observers, calibrate with
+    forward passes, convert to fixed-scale qdq (reference: ptq.py)."""
+
+    def __init__(self, config: QuantConfig = None):
+        self.config = config or QuantConfig(
+            activation=AbsmaxObserver, weight=None)
+
+    def quantize(self, model: Layer, inplace=False):
+        if not inplace:
+            model = copy.deepcopy(model)
+
+        def wrapper(layer):
+            for base, qcls in _QUANTABLE.items():
+                if isinstance(layer, base):
+                    cfg = self.config._config_for(layer)
+                    act = _make(cfg.activation) or AbsmaxObserver()
+                    return qcls(layer, act, PassthroughWeightObserver())
+            return None
+
+        model = _swap_layers(model, wrapper)
+        model.eval()
+        return model
+
+    def convert(self, model: Layer, inplace=False):
+        if not inplace:
+            model = copy.deepcopy(model)
+
+        def fold(layer):
+            if isinstance(layer, (QuantedLinear, QuantedConv2D)):
+                inner = layer.inner
+                # quantize-dequantize the weight once with the final scale
+                w = WeightAbsMaxQuanter(layer.weight_quanter.bit_length)(
+                    inner.weight)
+                inner.weight._data = jax_stop(w._data)
+                obs = layer.activation_quanter
+                if isinstance(obs, AbsmaxObserver) and float(obs.scales()._data) > 0:
+                    scale = Tensor(obs.scales()._data)
+                    bits = obs.bit_length
+                    return _FixedQDQ(inner, scale, bits)
+                return inner
+            return None
+
+        return _swap_layers(model, fold)
+
+
+class _FixedQDQ(Layer):
+    """Inference wrapper: fixed-scale activation qdq before the layer."""
+
+    def __init__(self, inner, scale, bits):
+        super().__init__()
+        self.inner = inner
+        self.register_buffer("_scale", scale)
+        self._bits = bits
+
+    def forward(self, x):
+        return self.inner(_fake_quant_ste(x, self._scale, self._bits))

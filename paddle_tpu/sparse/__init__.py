@@ -1,0 +1,442 @@
+"""Sparse tensors (reference: python/paddle/sparse/ — SparseCooTensor /
+SparseCsrTensor over paddle/phi/core/sparse_coo_tensor.h and
+kernels/sparse/; API: creation, unary/binary ops, matmul, sparse nn).
+
+TPU-native design: a sparse tensor is a pytree of dense arrays —
+COO: (indices [ndim, nnz], values [nnz, ...]); CSR: (crows, cols, values).
+nnz is static per tensor (XLA needs static shapes), ops are expressed as
+gather / scatter-add / segment ops which XLA maps onto the TPU's vector
+unit, and values stay differentiable framework Tensors so autograd flows
+through sparse ops exactly like dense ones.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply, unwrap
+from .. import ops as _ops
+
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+    "sparse_csr_tensor", "is_sparse_coo", "is_sparse_csr", "is_sparse",
+    "to_dense",
+    "add", "subtract", "multiply", "divide", "matmul", "masked_matmul",
+    "relu", "abs", "sin", "tanh", "sqrt", "square", "pow", "neg", "cast",
+    "transpose", "coalesce", "sum",
+    "nn",
+]
+
+
+def _as_tensor(x, dtype=None):
+    if isinstance(x, Tensor):
+        return x if dtype is None else x.astype(dtype)
+    arr = jnp.asarray(np.asarray(x))
+    if dtype is not None:
+        arr = arr.astype(dtype)
+    return Tensor(arr)
+
+
+class SparseCooTensor:
+    """COO sparse tensor: indices [sparse_dim, nnz] (int), values
+    [nnz, *dense_dims]."""
+
+    def __init__(self, indices: Tensor, values: Tensor, shape, coalesced=False):
+        self._indices = _as_tensor(indices, "int32")
+        self._values = values if isinstance(values, Tensor) else _as_tensor(values)
+        self._shape = tuple(int(s) for s in shape)
+        self._coalesced = coalesced
+        sd, nnz = self._indices.shape
+        if self._values.shape[0] != nnz:
+            raise ValueError(
+                f"values count {self._values.shape[0]} != indices nnz {nnz}")
+        if sd + (len(self._values.shape) - 1) != len(self._shape):
+            raise ValueError("indices sparse_dim + values dense dims != ndim")
+
+    # -- properties mirroring the reference Tensor surface ------------------
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def stop_gradient(self):
+        return self._values.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self._values.stop_gradient = v
+
+    @property
+    def grad(self):
+        return self._values.grad
+
+    def indices(self):
+        return self._indices
+
+    def values(self):
+        return self._values
+
+    def nnz(self):
+        return self._indices.shape[1]
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    # -- conversion ---------------------------------------------------------
+    def to_dense(self):
+        idx = tuple(self._indices._data[d] for d in range(self._indices.shape[0]))
+
+        def fn(v):
+            out = jnp.zeros(self._shape, v.dtype)
+            return out.at[idx].add(v)
+
+        return apply(fn, self._values, name="sparse_to_dense")
+
+    def to_sparse_csr(self):
+        if len(self._shape) != 2:
+            raise ValueError("to_sparse_csr: only 2-D tensors")
+        coo = self.coalesce()
+        rows = np.asarray(coo._indices._data[0])
+        cols = np.asarray(coo._indices._data[1])
+        m = self._shape[0]
+        crows = np.zeros(m + 1, np.int32)
+        np.add.at(crows, rows + 1, 1)
+        crows = np.cumsum(crows).astype(np.int32)
+        return SparseCsrTensor(crows, cols, coo._values, self._shape)
+
+    def coalesce(self):
+        """Merge duplicate coordinates (sums values). Host-side index
+        dedup (indices are static metadata); value merge stays on device
+        and differentiable."""
+        if self._coalesced:
+            return self
+        idx = np.asarray(self._indices._data)
+        flat = np.ravel_multi_index(idx, self._shape[: idx.shape[0]])
+        uniq, inv = np.unique(flat, return_inverse=True)
+        if len(uniq) == flat.size and (flat[:-1] <= flat[1:]).all():
+            return SparseCooTensor(self._indices, self._values, self._shape,
+                                   coalesced=True)
+        new_idx = np.stack(np.unravel_index(uniq, self._shape[: idx.shape[0]]))
+        seg = jnp.asarray(inv)
+        n_out = len(uniq)
+
+        def fn(v):
+            import jax
+
+            return jax.ops.segment_sum(v, seg, num_segments=n_out)
+
+        vals = apply(fn, self._values, name="sparse_coalesce")
+        return SparseCooTensor(Tensor(jnp.asarray(new_idx, jnp.int32)), vals,
+                               self._shape, coalesced=True)
+
+    def backward(self, *a, **kw):
+        return self._values.backward(*a, **kw)
+
+    def numpy(self):
+        return np.asarray(self.to_dense()._data)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+    # -- operators ----------------------------------------------------------
+    def __add__(self, other):
+        return add(self, other)
+
+    def __sub__(self, other):
+        return subtract(self, other)
+
+    def __mul__(self, other):
+        return multiply(self, other)
+
+    def __truediv__(self, other):
+        return divide(self, other)
+
+    def __neg__(self):
+        return neg(self)
+
+    def __matmul__(self, other):
+        return matmul(self, other)
+
+
+class SparseCsrTensor:
+    """CSR sparse matrix: crows [m+1], cols [nnz], values [nnz]."""
+
+    def __init__(self, crows, cols, values, shape):
+        self._crows = _as_tensor(crows, "int32")
+        self._cols = _as_tensor(cols, "int32")
+        self._values = values if isinstance(values, Tensor) else _as_tensor(values)
+        self._shape = tuple(int(s) for s in shape)
+        if len(self._shape) != 2:
+            raise ValueError("SparseCsrTensor supports 2-D matrices")
+        if self._crows.shape[0] != self._shape[0] + 1:
+            raise ValueError("crows must have shape [m+1]")
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    def crows(self):
+        return self._crows
+
+    def cols(self):
+        return self._cols
+
+    def values(self):
+        return self._values
+
+    def nnz(self):
+        return self._cols.shape[0]
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def _row_indices(self):
+        crows = np.asarray(self._crows._data)
+        return np.repeat(np.arange(self._shape[0]), np.diff(crows))
+
+    def to_sparse_coo(self, sparse_dim=2):
+        rows = self._row_indices()
+        idx = np.stack([rows, np.asarray(self._cols._data)])
+        return SparseCooTensor(Tensor(jnp.asarray(idx, jnp.int32)),
+                               self._values, self._shape, coalesced=True)
+
+    def to_dense(self):
+        return self.to_sparse_coo().to_dense()
+
+    def numpy(self):
+        return np.asarray(self.to_dense()._data)
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+    def __matmul__(self, other):
+        return matmul(self, other)
+
+
+# ---------------------------------------------------------------------------
+# creation
+# ---------------------------------------------------------------------------
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    indices_t = _as_tensor(indices, "int32")
+    values_t = _as_tensor(values, dtype)
+    if shape is None:
+        idx = np.asarray(indices_t._data)
+        shape = tuple(int(m) + 1 for m in idx.max(axis=1)) + tuple(
+            values_t.shape[1:])
+    out = SparseCooTensor(indices_t, values_t, shape)
+    out._values.stop_gradient = stop_gradient
+    return out
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True):
+    out = SparseCsrTensor(crows, cols, _as_tensor(values, dtype), shape)
+    out._values.stop_gradient = stop_gradient
+    return out
+
+
+def is_sparse_coo(x):
+    return isinstance(x, SparseCooTensor)
+
+
+def is_sparse_csr(x):
+    return isinstance(x, SparseCsrTensor)
+
+
+def is_sparse(x):
+    return isinstance(x, (SparseCooTensor, SparseCsrTensor))
+
+
+def to_dense(x):
+    return x.to_dense() if is_sparse(x) else x
+
+
+# ---------------------------------------------------------------------------
+# unary ops (value-wise; zero-preserving like the reference's sparse unary)
+# ---------------------------------------------------------------------------
+def _unary(fn_name, jfn):
+    def op(x, name=None):
+        if not is_sparse(x):
+            raise TypeError(f"sparse.{fn_name} expects a sparse tensor")
+        vals = apply(jfn, x.values(), name=f"sparse_{fn_name}")
+        if is_sparse_coo(x):
+            return SparseCooTensor(x.indices(), vals, x.shape, x._coalesced)
+        return SparseCsrTensor(x.crows(), x.cols(), vals, x.shape)
+
+    op.__name__ = fn_name
+    return op
+
+
+relu = _unary("relu", lambda v: jnp.maximum(v, 0))
+abs = _unary("abs", jnp.abs)
+sin = _unary("sin", jnp.sin)
+tanh = _unary("tanh", jnp.tanh)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+neg = _unary("neg", jnp.negative)
+
+
+def pow(x, factor, name=None):
+    return _unary("pow", lambda v: jnp.power(v, factor))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    vals = x.values() if value_dtype is None else x.values().astype(value_dtype)
+    if is_sparse_coo(x):
+        idx = x.indices() if index_dtype is None else x.indices().astype(index_dtype)
+        return SparseCooTensor(idx, vals, x.shape, x._coalesced)
+    crows = x.crows() if index_dtype is None else x.crows().astype(index_dtype)
+    cols = x.cols() if index_dtype is None else x.cols().astype(index_dtype)
+    return SparseCsrTensor(crows, cols, vals, x.shape)
+
+
+# ---------------------------------------------------------------------------
+# binary ops — union of sparsity patterns (host-side static merge)
+# ---------------------------------------------------------------------------
+def _binary_coo(x, y, merge):
+    if x.shape != y.shape:
+        raise ValueError(f"shape mismatch {x.shape} vs {y.shape}")
+    xc, yc = x.coalesce(), y.coalesce()
+    xi = np.asarray(xc.indices()._data)
+    yi = np.asarray(yc.indices()._data)
+    sd = xi.shape[0]
+    xflat = np.ravel_multi_index(xi, x.shape[:sd])
+    yflat = np.ravel_multi_index(yi, y.shape[:sd])
+    union = np.union1d(xflat, yflat)
+    xpos = np.searchsorted(union, xflat)
+    ypos = np.searchsorted(union, yflat)
+    n = len(union)
+    xseg, yseg = jnp.asarray(xpos), jnp.asarray(ypos)
+
+    def fn(xv, yv):
+        dense_dims = xv.shape[1:]
+        xs = jnp.zeros((n,) + dense_dims, xv.dtype).at[xseg].set(xv)
+        ys = jnp.zeros((n,) + dense_dims, yv.dtype).at[yseg].set(yv)
+        return merge(xs, ys)
+
+    vals = apply(fn, xc.values(), yc.values(), name="sparse_binary")
+    new_idx = np.stack(np.unravel_index(union, x.shape[:sd]))
+    return SparseCooTensor(Tensor(jnp.asarray(new_idx, jnp.int32)), vals,
+                           x.shape, coalesced=True)
+
+
+def _maybe_csr(fn):
+    def op(x, y, name=None):
+        to_csr = is_sparse_csr(x)
+        if to_csr:
+            x = x.to_sparse_coo()
+        if is_sparse_csr(y):
+            y = y.to_sparse_coo()
+        out = fn(x, y)
+        return out.to_sparse_csr() if to_csr else out
+
+    return op
+
+
+@_maybe_csr
+def add(x, y):
+    return _binary_coo(x, y, lambda a, b: a + b)
+
+
+@_maybe_csr
+def subtract(x, y):
+    return _binary_coo(x, y, lambda a, b: a - b)
+
+
+@_maybe_csr
+def multiply(x, y):
+    return _binary_coo(x, y, lambda a, b: a * b)
+
+
+@_maybe_csr
+def divide(x, y):
+    return _binary_coo(x, y, lambda a, b: a / b)
+
+
+# ---------------------------------------------------------------------------
+# matmul: sparse @ dense → dense (gather + scatter-add; MXU-friendly since
+# the inner product over gathered rows is a dense fused multiply-add)
+# ---------------------------------------------------------------------------
+def matmul(x, y, name=None):
+    if is_sparse_csr(x):
+        x = x.to_sparse_coo()
+    if not is_sparse_coo(x):
+        raise TypeError("sparse.matmul: x must be sparse")
+    if is_sparse(y):
+        y = y.to_dense()
+    if len(x.shape) != 2:
+        raise ValueError("sparse.matmul supports 2-D sparse x")
+    rows = x.indices()._data[0]
+    cols = x.indices()._data[1]
+    m = x.shape[0]
+
+    def fn(v, d):
+        contrib = v[:, None] * jnp.take(d, cols, axis=0)  # [nnz, n]
+        out = jnp.zeros((m, d.shape[1]), contrib.dtype)
+        return out.at[rows].add(contrib)
+
+    y_t = y if isinstance(y, Tensor) else _as_tensor(y)
+    return apply(fn, x.values(), y_t, name="sparse_matmul")
+
+
+def masked_matmul(x, y, mask, name=None):
+    """dense @ dense evaluated only at `mask`'s nonzero coordinates
+    (reference: paddle.sparse.masked_matmul, the SDDMM primitive)."""
+    if not is_sparse_coo(mask) and not is_sparse_csr(mask):
+        raise TypeError("mask must be sparse")
+    coo = mask if is_sparse_coo(mask) else mask.to_sparse_coo()
+    rows = coo.indices()._data[0]
+    cols = coo.indices()._data[1]
+
+    def fn(a, b):
+        return jnp.einsum("nk,nk->n", jnp.take(a, rows, axis=0),
+                          jnp.take(b.T, cols, axis=0))
+
+    x_t = x if isinstance(x, Tensor) else _as_tensor(x)
+    y_t = y if isinstance(y, Tensor) else _as_tensor(y)
+    vals = apply(fn, x_t, y_t, name="masked_matmul")
+    out = SparseCooTensor(coo.indices(), vals, (x_t.shape[0], y_t.shape[1]),
+                          coalesced=True)
+    return out if is_sparse_coo(mask) else out.to_sparse_csr()
+
+
+def transpose(x, perm, name=None):
+    if is_sparse_csr(x):
+        return transpose(x.to_sparse_coo(), perm).to_sparse_csr()
+    idx = x.indices()._data[jnp.asarray(perm)]
+    shape = tuple(x.shape[p] for p in perm)
+    return SparseCooTensor(Tensor(idx), x.values(), shape)
+
+
+def coalesce(x, name=None):
+    return x.coalesce()
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    """Sum over all elements (axis=None) or a sparse axis → dense Tensor."""
+    if axis is None:
+        v = x.values()
+        out = _ops.sum(v)
+        return out.astype(dtype) if dtype else out
+    return _ops.sum(x.to_dense(), axis=axis, keepdim=keepdim)
+
+
+from . import nn  # noqa: E402  (depends on the ops above)

@@ -1,0 +1,78 @@
+"""Sparse functional ops (reference: python/paddle/sparse/nn/functional/ —
+relu, softmax, attention)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply
+
+__all__ = ["relu", "softmax", "attention"]
+
+
+def relu(x, name=None):
+    from . import relu as _relu
+
+    return _relu(x)
+
+
+def softmax(x, axis=-1, name=None):
+    """Row-wise softmax over the nonzeros of a 2-D sparse matrix (CSR or
+    COO): softmax within each row's stored entries."""
+    from . import SparseCooTensor, SparseCsrTensor, is_sparse_csr
+
+    if axis != -1:
+        raise ValueError("sparse softmax supports axis=-1")
+    was_csr = is_sparse_csr(x)
+    coo = x.to_sparse_coo() if was_csr else x.coalesce()
+    rows = coo.indices()._data[0]
+    m = coo.shape[0]
+
+    def fn(v):
+        rmax = jax.ops.segment_max(v, rows, num_segments=m)
+        e = jnp.exp(v - rmax[rows])
+        denom = jax.ops.segment_sum(e, rows, num_segments=m)
+        return e / denom[rows]
+
+    vals = apply(fn, coo.values(), name="sparse_softmax")
+    out = SparseCooTensor(coo.indices(), vals, coo.shape, coalesced=True)
+    return out.to_sparse_csr() if was_csr else out
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse-mask attention (reference: sparse/nn/functional/transformer.py
+    attention over CSR masks): scores are computed ONLY at sparse_mask's
+    nonzero coordinates (SDDMM), softmaxed per row, then multiplied back
+    (SpMM). q/k/v: [B, H, S, D]; sparse_mask: 2-D [S, S] pattern shared
+    across batch/heads."""
+    from . import SparseCooTensor, is_sparse
+
+    if not is_sparse(sparse_mask):
+        raise TypeError("sparse_mask must be a sparse tensor")
+    coo = sparse_mask if sparse_mask.is_sparse_coo() else sparse_mask.to_sparse_coo()
+    rows = coo.indices()._data[0]
+    cols = coo.indices()._data[1]
+    S = coo.shape[0]
+
+    def fn(q, k, v):
+        d = q.shape[-1]
+        scale = 1.0 / np.sqrt(d)
+        qr = jnp.take(q, rows, axis=2)          # [B, H, nnz, D]
+        kc = jnp.take(k, cols, axis=2)
+        scores = jnp.einsum("bhnd,bhnd->bhn", qr, kc) * scale
+        rmax = jax.ops.segment_max(jnp.moveaxis(scores, -1, 0), rows,
+                                   num_segments=S)  # [S, B, H]
+        e = jnp.exp(scores - jnp.moveaxis(rmax[rows], 0, -1))
+        denom = jax.ops.segment_sum(jnp.moveaxis(e, -1, 0), rows,
+                                    num_segments=S)
+        p = e / jnp.moveaxis(denom[rows], 0, -1)  # [B, H, nnz]
+        vc = jnp.take(v, cols, axis=2)            # [B, H, nnz, D]
+        contrib = p[..., None] * vc
+        out = jax.ops.segment_sum(jnp.moveaxis(contrib, 2, 0), rows,
+                                  num_segments=S)  # [S, B, H, D]
+        return jnp.moveaxis(out, 0, 2)
+
+    return apply(fn, query, key, value, name="sparse_attention")

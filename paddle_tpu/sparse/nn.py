@@ -1,0 +1,80 @@
+"""Sparse nn layers (reference: python/paddle/sparse/nn/ — ReLU, BatchNorm,
+activation layers, sparse attention; conv3d point-cloud kernels are the
+reference's CUDA specialty and are represented here by the same API over
+gather/scatter primitives)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply
+from ..nn.layer import Layer
+from ..nn.initializer import Constant
+from . import functional  # noqa: F401  (re-export surface)
+
+__all__ = ["ReLU", "Softmax", "BatchNorm"]
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        from . import relu
+
+        return relu(x)
+
+
+class Softmax(Layer):
+    """Row-wise softmax over a 2-D sparse matrix's nonzeros (reference:
+    sparse/nn/layer/activation.py Softmax, CSR-only there too)."""
+
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        if axis != -1:
+            raise ValueError("sparse Softmax supports axis=-1")
+
+    def forward(self, x):
+        return functional.softmax(x)
+
+
+class BatchNorm(Layer):
+    """BatchNorm over the dense trailing channel of a COO tensor
+    (values [nnz, C] — normalizes the nonzero set, reference
+    sparse/nn/layer/norm.py BatchNorm)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 name=None):
+        super().__init__()
+        self.num_features = num_features
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.weight = self.create_parameter(
+            [num_features], attr=weight_attr, default_initializer=Constant(1.0))
+        self.bias = self.create_parameter(
+            [num_features], attr=bias_attr, is_bias=True)
+        self.register_buffer("_mean", Tensor(jnp.zeros(num_features)))
+        self.register_buffer("_variance", Tensor(jnp.ones(num_features)))
+
+    def forward(self, x):
+        from . import SparseCooTensor
+
+        vals = x.values()
+        if self.training:
+            def stats(v):
+                mu = jnp.mean(v, axis=0)
+                var = jnp.var(v, axis=0)
+                return mu, var
+
+            mu_t, var_t = apply(stats, vals, n_outs=2, name="sparse_bn_stats")
+            m = self.momentum
+            self._mean._data = m * self._mean._data + (1 - m) * mu_t._data
+            self._variance._data = m * self._variance._data + (1 - m) * var_t._data
+        else:
+            mu_t, var_t = self._mean, self._variance
+        eps = self.epsilon
+
+        def norm_fn(v, mu, var, w, b):
+            return (v - mu) / jnp.sqrt(var + eps) * w + b
+
+        out = apply(norm_fn, vals, mu_t, var_t, self.weight, self.bias,
+                    name="sparse_bn")
+        return SparseCooTensor(x.indices(), out, x.shape, x._coalesced)

@@ -119,7 +119,7 @@ def test_shm_queue_wraparound():
 
 
 def _shm_producer(name, n):
-    q = shm.ShmQueue.__new__(shm.ShmQueue)._init_attach(name)
+    q = shm.ShmQueue.attach(name)
     for i in range(n):
         q.put({"i": np.full((64, 64), i, np.float32)}, timeout_ms=10000)
     q.close(unlink=False)

@@ -1,0 +1,100 @@
+"""QAT/PTQ quantization (reference: python/paddle/quantization/qat.py,
+ptq.py, quanters/abs_max.py; test model unittests/quantization suites)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu import quantization as Q
+
+
+def _net():
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+
+def _data(seed=0, n=64):
+    r = np.random.RandomState(seed)
+    x = r.randn(n, 8).astype("float32")
+    w = r.randn(8, 4).astype("float32")
+    y = np.argmax(x @ w, 1).astype("int64")
+    return paddle.to_tensor(x), paddle.to_tensor(y)
+
+
+def test_fake_quant_ste_grad_is_identity():
+    x = paddle.to_tensor(np.linspace(-1, 1, 16).astype("float32"),
+                         stop_gradient=False)
+    scale = paddle.to_tensor(np.float32(1.0))
+    y = Q._fake_quant_ste(x, scale, bit_length=8)
+    # forward is quantized (few unique values), backward is identity
+    assert len(np.unique(np.round(y.numpy(), 5))) <= 255
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.ones(16), rtol=1e-6)
+
+
+def test_quantize_dequantize_roundtrip():
+    x = paddle.to_tensor(np.array([-0.9, -0.2, 0.0, 0.4, 0.9], "float32"))
+    scale = paddle.to_tensor(np.float32(0.9))
+    q = Q.quantize_linear(x, scale)
+    assert q.numpy().dtype == np.int8
+    dq = Q.dequantize_linear(q, scale)
+    np.testing.assert_allclose(dq.numpy(), x.numpy(), atol=0.9 / 127 + 1e-6)
+
+
+def test_qat_quantize_swaps_and_trains():
+    paddle.seed(0)
+    model = _net()
+    cfg = Q.QuantConfig(activation=Q.FakeQuanterWithAbsMaxObserver,
+                        weight=Q.WeightAbsMaxQuanter)
+    qat = Q.QAT(cfg)
+    qmodel = qat.quantize(model)
+    # quantable layers swapped
+    kinds = [type(l).__name__ for _, l in qmodel.named_sublayers()]
+    assert kinds.count("QuantedLinear") == 2
+    # trains: loss decreases through fake quant + STE
+    x, y = _data()
+    optim = opt.Adam(5e-3, parameters=qmodel.parameters())
+    losses = []
+    for _ in range(30):
+        loss = paddle.nn.functional.cross_entropy(qmodel(x), y)
+        loss.backward()
+        optim.step()
+        optim.clear_grad()
+        losses.append(float(loss.item()))
+    assert losses[-1] < losses[0] * 0.8
+
+    # convert folds fake quant into the weights
+    deployed = qat.convert(qmodel)
+    kinds = [type(l).__name__ for _, l in deployed.named_sublayers()]
+    assert "QuantedLinear" not in kinds
+    out_q = qmodel(x).numpy()
+    out_d = deployed(x).numpy()
+    # deployed output close to QAT-sim output (same weight qdq, no act quant)
+    assert np.mean(np.abs(out_q - out_d)) < 0.2
+
+
+def test_ptq_calibrate_convert():
+    paddle.seed(1)
+    model = _net()
+    x, _ = _data(seed=2)
+    ref = model(x).numpy()
+    ptq = Q.PTQ()
+    qmodel = ptq.quantize(model)
+    # calibration passes observe activations without changing them
+    cal = qmodel(x).numpy()
+    np.testing.assert_allclose(cal, ref, rtol=1e-5, atol=1e-6)
+    deployed = ptq.convert(qmodel)
+    out = deployed(x).numpy()
+    # int8 qdq error stays small relative to activations
+    denom = np.maximum(np.abs(ref).max(), 1e-6)
+    assert np.abs(out - ref).max() / denom < 0.1
+
+
+def test_quant_config_type_and_layer_overrides():
+    model = _net()
+    lin0 = model[0]
+    cfg = Q.QuantConfig(activation=None, weight=None)
+    cfg.add_type_config(nn.Linear, activation=Q.FakeQuanterWithAbsMaxObserver)
+    assert cfg._config_for(lin0).activation is Q.FakeQuanterWithAbsMaxObserver
+    cfg.add_layer_config(lin0, activation=None)
+    assert cfg._config_for(lin0).activation is None

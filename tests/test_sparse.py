@@ -1,0 +1,171 @@
+"""paddle.sparse parity: COO/CSR creation, conversion, ops, autograd,
+sparse attention (reference: python/paddle/sparse + unittests/test_sparse_*)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+from paddle_tpu.core.tensor import Tensor
+
+
+def _coo_example():
+    indices = np.array([[0, 0, 1, 2], [1, 3, 2, 0]], "int32")
+    values = np.array([1.0, 2.0, 3.0, 4.0], "float32")
+    dense = np.zeros((3, 4), "float32")
+    dense[indices[0], indices[1]] = values
+    return indices, values, dense
+
+
+def test_coo_create_to_dense_roundtrip():
+    indices, values, dense = _coo_example()
+    sp = sparse.sparse_coo_tensor(indices, values, (3, 4))
+    assert sp.is_sparse_coo() and not sp.is_sparse_csr()
+    assert sp.nnz() == 4
+    np.testing.assert_allclose(sp.to_dense().numpy(), dense)
+    # shape inference when omitted
+    sp2 = sparse.sparse_coo_tensor(indices, values)
+    assert sp2.shape == [3, 4]
+
+
+def test_csr_create_and_convert():
+    indices, values, dense = _coo_example()
+    coo = sparse.sparse_coo_tensor(indices, values, (3, 4))
+    csr = coo.to_sparse_csr()
+    assert csr.is_sparse_csr()
+    np.testing.assert_array_equal(csr.crows().numpy(), [0, 2, 3, 4])
+    np.testing.assert_allclose(csr.to_dense().numpy(), dense)
+    back = csr.to_sparse_coo()
+    np.testing.assert_allclose(back.to_dense().numpy(), dense)
+
+    csr2 = sparse.sparse_csr_tensor([0, 2, 3, 4], [1, 3, 2, 0],
+                                    [1.0, 2.0, 3.0, 4.0], (3, 4))
+    np.testing.assert_allclose(csr2.to_dense().numpy(), dense)
+
+
+def test_coalesce_merges_duplicates():
+    indices = np.array([[0, 0, 0], [1, 1, 2]], "int32")
+    sp = sparse.sparse_coo_tensor(indices, [1.0, 5.0, 2.0], (2, 3))
+    co = sp.coalesce()
+    assert co.nnz() == 2
+    dense = np.zeros((2, 3), "float32")
+    dense[0, 1], dense[0, 2] = 6.0, 2.0
+    np.testing.assert_allclose(co.to_dense().numpy(), dense)
+
+
+def test_unary_ops():
+    indices, values, dense = _coo_example()
+    sp = sparse.sparse_coo_tensor(indices, values - 2.5, (3, 4))
+    out = sparse.relu(sp)
+    np.testing.assert_allclose(out.to_dense().numpy(),
+                               np.maximum(sp.to_dense().numpy(), 0))
+    np.testing.assert_allclose(sparse.square(sp).values().numpy(),
+                               (values - 2.5) ** 2)
+    # csr path
+    csr = sp.to_sparse_csr()
+    np.testing.assert_allclose(sparse.abs(csr).to_dense().numpy(),
+                               np.abs(csr.to_dense().numpy()), atol=1e-6)
+
+
+def test_binary_ops_union_pattern():
+    a = sparse.sparse_coo_tensor([[0, 1], [0, 1]], [1.0, 2.0], (2, 2))
+    b = sparse.sparse_coo_tensor([[0, 1], [1, 1]], [10.0, 20.0], (2, 2))
+    out = sparse.add(a, b)
+    np.testing.assert_allclose(out.to_dense().numpy(),
+                               a.to_dense().numpy() + b.to_dense().numpy())
+    out = sparse.multiply(a, b)
+    np.testing.assert_allclose(out.to_dense().numpy(),
+                               a.to_dense().numpy() * b.to_dense().numpy())
+    out = a - b
+    np.testing.assert_allclose(out.to_dense().numpy(),
+                               a.to_dense().numpy() - b.to_dense().numpy())
+
+
+def test_matmul_and_grad():
+    indices, values, dense = _coo_example()
+    vt = Tensor(np.asarray(values), stop_gradient=False)
+    sp = sparse.SparseCooTensor(Tensor(np.asarray(indices)), vt, (3, 4))
+    d = paddle.to_tensor(np.random.RandomState(0).randn(4, 5).astype("float32"),
+                         stop_gradient=False)
+    out = sparse.matmul(sp, d)
+    np.testing.assert_allclose(out.numpy(), dense @ d.numpy(), rtol=1e-5)
+    loss = (out * out).sum()
+    loss.backward()
+    # grads flow to both sparse values and the dense operand
+    g_dense = 2 * (dense @ d.numpy())
+    np.testing.assert_allclose(d.grad.numpy(), dense.T @ g_dense, rtol=1e-4)
+    assert vt.grad is not None and np.isfinite(vt.grad.numpy()).all()
+
+
+def test_masked_matmul():
+    r = np.random.RandomState(1)
+    a = r.randn(4, 6).astype("float32")
+    b = r.randn(6, 4).astype("float32")
+    mask = sparse.sparse_coo_tensor([[0, 1, 3], [0, 2, 3]], [1.0, 1.0, 1.0], (4, 4))
+    out = sparse.masked_matmul(paddle.to_tensor(a), paddle.to_tensor(b), mask)
+    full = a @ b
+    want = np.zeros((4, 4), "float32")
+    for i, j in zip([0, 1, 3], [0, 2, 3]):
+        want[i, j] = full[i, j]
+    np.testing.assert_allclose(out.to_dense().numpy(), want, rtol=1e-5)
+
+
+def test_sparse_softmax():
+    indices, values, dense = _coo_example()
+    sp = sparse.sparse_coo_tensor(indices, values, (3, 4))
+    sm = sparse.nn.functional.softmax(sp)
+    out = sm.to_dense().numpy()
+    # row 0 has entries (1,2): softmax([1,2]); rows 1,2 single-entry -> 1.0
+    e = np.exp(np.array([1.0, 2.0]) - 2.0)
+    np.testing.assert_allclose(out[0, [1, 3]], e / e.sum(), rtol=1e-5)
+    assert out[1, 2] == pytest.approx(1.0)
+    assert out[2, 0] == pytest.approx(1.0)
+
+
+def test_sparse_attention_matches_masked_dense():
+    r = np.random.RandomState(2)
+    B, H, S, D = 2, 2, 8, 4
+    q = r.randn(B, H, S, D).astype("float32")
+    k = r.randn(B, H, S, D).astype("float32")
+    v = r.randn(B, H, S, D).astype("float32")
+    # banded mask incl. diagonal
+    rows, cols = [], []
+    for i in range(S):
+        for j in range(max(0, i - 1), min(S, i + 2)):
+            rows.append(i)
+            cols.append(j)
+    mask = sparse.sparse_coo_tensor(np.array([rows, cols]), np.ones(len(rows), "float32"), (S, S))
+    out = sparse.nn.functional.attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v), mask)
+    # dense reference with -inf outside the band
+    mnp = np.full((S, S), -np.inf, "float32")
+    mnp[rows, cols] = 0.0
+    scores = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D) + mnp
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    want = np.einsum("bhqk,bhkd->bhqd", p, v)
+    np.testing.assert_allclose(out.numpy(), want, rtol=1e-4, atol=1e-5)
+
+
+def test_transpose_and_sum():
+    indices, values, dense = _coo_example()
+    sp = sparse.sparse_coo_tensor(indices, values, (3, 4))
+    tr = sparse.transpose(sp, [1, 0])
+    np.testing.assert_allclose(tr.to_dense().numpy(), dense.T)
+    assert float(sparse.sum(sp)) == pytest.approx(dense.sum())
+    np.testing.assert_allclose(sparse.sum(sp, axis=0).numpy(), dense.sum(0))
+
+
+def test_sparse_bn_and_relu_layers():
+    paddle.seed(0)
+    idx = np.array([[0, 1, 2, 3]], "int32")
+    vals = np.random.RandomState(3).randn(4, 6).astype("float32")
+    sp = sparse.sparse_coo_tensor(idx, vals, (4, 6))
+    bn = sparse.nn.BatchNorm(6)
+    bn.train()
+    out = bn(sp)
+    got = out.values().numpy()
+    ref = (vals - vals.mean(0)) / np.sqrt(vals.var(0) + 1e-5)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+    relu_l = sparse.nn.ReLU()
+    np.testing.assert_allclose(relu_l(sp).values().numpy(),
+                               np.maximum(vals, 0))
