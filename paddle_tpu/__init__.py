@@ -69,6 +69,10 @@ from . import fft  # noqa: E402
 from . import signal  # noqa: E402
 from . import sparse  # noqa: E402
 from . import quantization  # noqa: E402
+from . import geometric  # noqa: E402
+from . import text  # noqa: E402
+from . import audio  # noqa: E402
+from . import inference  # noqa: E402
 
 from .framework.io_ import save, load  # noqa: E402
 from .framework.core_ import (  # noqa: E402

@@ -1,0 +1,13 @@
+"""Audio feature extraction (reference: python/paddle/audio/ —
+functional/functional.py hz_to_mel/compute_fbank_matrix/create_dct,
+features/layers.py Spectrogram/MelSpectrogram/LogMelSpectrogram/MFCC,
+functional/window.py get_window).
+
+TPU-native: features are Layers whose forward is stft (XLA FFT HLO) +
+matmul against precomputed filterbanks — everything fuses into one
+compiled program."""
+from . import functional
+from .features import Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC
+
+__all__ = ["functional", "Spectrogram", "MelSpectrogram",
+           "LogMelSpectrogram", "MFCC"]

@@ -63,7 +63,33 @@ def apply(fn: Callable, *tensor_args, n_outs=None, name=None, **static_kwargs):
     if trace_grad:
         tape.record(vjp_fn, ts, needs, out_ts, name=name or getattr(fn, "__name__", "op"))
 
+    if _nan_check_enabled():
+        _check_nan_inf(outs, name or getattr(fn, "__name__", "op"))
+
     return tuple(out_ts) if multi else out_ts[0]
+
+
+def _nan_check_enabled():
+    from ..framework import core_
+
+    return bool(core_._flags.get("FLAGS_check_nan_inf", False))
+
+
+def _check_nan_inf(outs, op_name):
+    """FLAGS_check_nan_inf analog (reference: operator.cc:1608 +
+    eager/nan_inf_utils.cc — per-op output scan). Eager-only: inside a jit
+    trace outputs are tracers and the scan is skipped (the reference's
+    static-graph checker is likewise a debug mode)."""
+    for i, o in enumerate(outs):
+        if isinstance(o, jax.core.Tracer):
+            return
+        if jnp.issubdtype(o.dtype, jnp.inexact):
+            bad = int(jnp.sum(~jnp.isfinite(o)))
+            if bad:
+                raise FloatingPointError(
+                    f"Operator {op_name!r} output {i} contains {bad} "
+                    f"NaN/Inf values (shape {tuple(o.shape)}, dtype {o.dtype}); "
+                    f"FLAGS_check_nan_inf is enabled")
 
 
 def defop(n_tensor_args=None, name=None):

@@ -14,6 +14,8 @@ from .fleet_api import (
     worker_index, worker_num, is_first_worker, barrier_worker, get_mesh,
 )
 from . import utils
+from . import elastic
+from .elastic import ElasticManager, ElasticStatus
 from .meta_parallel import (
     TensorParallel, PipelineParallel, ShardingParallel, PipelineLayer, LayerDesc,
     SharedLayerDesc,

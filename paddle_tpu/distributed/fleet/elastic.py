@@ -1,0 +1,179 @@
+"""Elastic training manager (reference:
+python/paddle/distributed/fleet/elastic/manager.py:126 ElasticManager —
+etcd node registration, TTL heartbeats, membership watch, rank rewrite +
+trainer relaunch; exit codes :30-31).
+
+TPU-native: the KV backend is the framework's own TCPStore (native C++
+server) instead of etcd; on a TPU pod the chips of one host are a single
+process, so membership is per-host. The manager only decides — the launch
+controller (launch_mod) enacts relaunches."""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import List, Optional
+
+from ..store import TCPStore
+
+__all__ = ["ElasticStatus", "ElasticManager", "ELASTIC_EXIT_CODE"]
+
+ELASTIC_EXIT_CODE = 101  # reference: manager.py:30
+ELASTIC_AUTO_PARALLEL_EXIT_CODE = 102
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"      # membership stable
+    RESTART = "restart"  # membership changed within [min, max] — relaunch
+    EXIT = "exit"      # below min nodes for too long
+
+
+def _parse_np(np_spec) -> tuple:
+    """'4' → (4, 4); '2:4' → (2, 4) (reference PADDLE_ELASTIC_NP)."""
+    if isinstance(np_spec, int):
+        return np_spec, np_spec
+    s = str(np_spec)
+    if ":" in s:
+        lo, hi = s.split(":")
+        return int(lo), int(hi)
+    return int(s), int(s)
+
+
+class ElasticManager:
+    PREFIX = "__elastic__"
+
+    def __init__(self, store: Optional[TCPStore] = None, node_id: str = None,
+                 np_spec=None, heartbeat_interval: float = 1.0,
+                 ttl: float = 4.0, host: str = None, port: int = None,
+                 is_master: bool = False):
+        np_spec = np_spec if np_spec is not None else os.environ.get(
+            "PADDLE_ELASTIC_NP", "1")
+        self.np_min, self.np_max = _parse_np(np_spec)
+        self.enable = self.np_min >= 1 and (store is not None or host is not None
+                                            or "PADDLE_ELASTIC_SERVER" in os.environ)
+        self.node_id = node_id or f"{os.environ.get('POD_IP', 'node')}-{os.getpid()}"
+        self.heartbeat_interval = heartbeat_interval
+        self.ttl = ttl
+        if store is None and self.enable:
+            if host is None:
+                server = os.environ["PADDLE_ELASTIC_SERVER"]
+                host, port = server.rsplit(":", 1)
+                port = int(port)
+            store = TCPStore(host, port, is_master=is_master)
+        self.store = store
+        self._stop = threading.Event()
+        self._hb_thread = None
+        self._known: List[str] = []
+
+    # -- registration / heartbeats -----------------------------------------
+    def register(self):
+        """Add this node to the registry and start TTL heartbeats
+        (reference: etcd lease + registration)."""
+        if not self.enable:
+            return
+        self._ensure_registered()
+        self._beat()
+        self._hb_thread = threading.Thread(target=self._hb_loop, daemon=True)
+        self._hb_thread.start()
+
+    def _ensure_registered(self):
+        if self.node_id not in self._read_registry():
+            idx = self.store.add(f"{self.PREFIX}/registry_count", 1) - 1
+            self.store.set(f"{self.PREFIX}/registry/{idx}",
+                           self.node_id.encode())
+
+    def _beat(self):
+        self.store.set(f"{self.PREFIX}/node/{self.node_id}",
+                       repr(time.time()).encode())
+
+    def _hb_loop(self):
+        while not self._stop.is_set():
+            try:
+                self._beat()
+            except Exception:
+                pass
+            self._stop.wait(self.heartbeat_interval)
+
+    # -- membership ---------------------------------------------------------
+    def alive_nodes(self) -> List[str]:
+        """Nodes whose heartbeat is within the TTL window. The registry is
+        an atomic-counter-indexed append-only log (store.add allocates the
+        slot, so concurrent registrations can't lose updates)."""
+        self._ensure_registered()
+        known = self._read_registry()
+        now = time.time()
+        alive = []
+        for nid in known:
+            if not nid:
+                continue
+            try:
+                ts = float(self.store.get(f"{self.PREFIX}/node/{nid}",
+                                          timeout_ms=200).decode())
+                if now - ts <= self.ttl:
+                    alive.append(nid)
+            except (TimeoutError, ValueError):
+                continue
+        return sorted(alive)
+
+    def _read_registry(self) -> List[str]:
+        try:
+            count = self.store.add(f"{self.PREFIX}/registry_count", 0)
+        except ConnectionError:
+            return []
+        ids = []
+        for i in range(count):
+            try:
+                ids.append(self.store.get(f"{self.PREFIX}/registry/{i}",
+                                          timeout_ms=500).decode())
+            except TimeoutError:
+                continue
+        return sorted(set(ids))
+
+    def watch(self) -> str:
+        """One membership evaluation (reference: manager.py watch loop)."""
+        if not self.enable:
+            return ElasticStatus.COMPLETED
+        alive = self.alive_nodes()
+        n = len(alive)
+        if not self._known:
+            self._known = alive
+        if n >= self.np_min:
+            self._below_since = None  # healthy again: fresh grace next dip
+        if n < self.np_min:
+            return ElasticStatus.EXIT if self._below_min_since() else ElasticStatus.HOLD
+        if alive != self._known and self.np_min <= n <= self.np_max:
+            self._known = alive
+            return ElasticStatus.RESTART
+        return ElasticStatus.HOLD
+
+    _below_since = None
+
+    def _below_min_since(self, grace=30.0):
+        now = time.time()
+        if self._below_since is None:
+            self._below_since = now
+            return False
+        return (now - self._below_since) > grace
+
+    def rank_env_for(self, alive: Optional[List[str]] = None):
+        """New rank assignment after a membership change (reference:
+        manager.py rewrites PADDLE_TRAINER_ENDPOINTS/TRAINER_ID)."""
+        alive = alive if alive is not None else self.alive_nodes()
+        rank = alive.index(self.node_id) if self.node_id in alive else -1
+        return {
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(len(alive)),
+            "PADDLE_ELASTIC_NODES": ",".join(alive),
+        }
+
+    def exit(self, completed=True):
+        self._stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2)
+        if self.enable:
+            try:
+                self.store.delete_key(f"{self.PREFIX}/node/{self.node_id}")
+            except Exception:
+                pass

@@ -1,0 +1,191 @@
+"""Graph learning ops (reference: python/paddle/geometric/ —
+message_passing/send_recv.py send_u_recv:… send_ue_recv, math.py
+segment_*, reindex.py, sampling/neighbors.py over phi graph_* kernels).
+
+TPU-native: message passing is gather + segment reduction — XLA lowers
+segment_sum onto the TPU vector unit; neighbor sampling / reindex are
+host-side index preprocessing (static shapes feed the device)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply, unwrap
+
+__all__ = [
+    "send_u_recv", "send_ue_recv", "send_uv",
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "reindex_graph", "sample_neighbors",
+]
+
+_REDUCES = {
+    "sum": jax.ops.segment_sum,
+    "mean": None,  # composed below
+    "max": jax.ops.segment_max,
+    "min": jax.ops.segment_min,
+}
+
+_MESSAGES = {
+    "add": lambda a, b: a + b,
+    "sub": lambda a, b: a - b,
+    "mul": lambda a, b: a * b,
+    "div": lambda a, b: a / b,
+}
+
+
+def _reduce(msg, dst, n, reduce_op):
+    if reduce_op == "mean":
+        s = jax.ops.segment_sum(msg, dst, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones(dst.shape, msg.dtype), dst,
+                                  num_segments=n)
+        shape = (n,) + (1,) * (msg.ndim - 1)
+        return s / jnp.maximum(cnt.reshape(shape), 1)
+    out = _REDUCES[reduce_op](msg, dst, num_segments=n)
+    if reduce_op in ("max", "min"):
+        # empty segments produce +-inf; the reference zeroes them
+        out = jnp.where(jnp.isfinite(out), out, 0)
+    return out
+
+
+def _n_out(x, dst_index, out_size):
+    if out_size is not None:
+        return int(out_size)
+    return x.shape[0]
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src], reduce into dst buckets (reference: send_u_recv)."""
+    if reduce_op not in ("sum", "mean", "max", "min"):
+        raise ValueError(f"unsupported reduce_op {reduce_op!r}")
+    src = unwrap(src_index).astype(jnp.int32)
+    dst = unwrap(dst_index).astype(jnp.int32)
+    n = _n_out(x, dst_index, out_size)
+
+    def fn(a):
+        return _reduce(jnp.take(a, src, axis=0), dst, n, reduce_op)
+
+    return apply(fn, x, name="send_u_recv")
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Message = x[src] (op) edge_feature y, reduced into dst buckets."""
+    if message_op not in _MESSAGES:
+        raise ValueError(f"unsupported message_op {message_op!r}")
+    if reduce_op not in ("sum", "mean", "max", "min"):
+        raise ValueError(f"unsupported reduce_op {reduce_op!r}")
+    src = unwrap(src_index).astype(jnp.int32)
+    dst = unwrap(dst_index).astype(jnp.int32)
+    n = _n_out(x, dst_index, out_size)
+    mfn = _MESSAGES[message_op]
+
+    def fn(a, e):
+        msg = mfn(jnp.take(a, src, axis=0), e)
+        return _reduce(msg, dst, n, reduce_op)
+
+    return apply(fn, x, y, name="send_ue_recv")
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message x[src] (op) y[dst] (reference: send_uv)."""
+    if message_op not in _MESSAGES:
+        raise ValueError(f"unsupported message_op {message_op!r}")
+    src = unwrap(src_index).astype(jnp.int32)
+    dst = unwrap(dst_index).astype(jnp.int32)
+    mfn = _MESSAGES[message_op]
+
+    def fn(a, b):
+        return mfn(jnp.take(a, src, axis=0), jnp.take(b, dst, axis=0))
+
+    return apply(fn, x, y, name="send_uv")
+
+
+def _segment(x, segment_ids, reduce_op, num_segments=None):
+    seg = unwrap(segment_ids).astype(jnp.int32)
+    if num_segments is not None:
+        n = int(num_segments)
+    elif isinstance(seg, jax.core.Tracer):
+        raise ValueError(
+            f"segment_{reduce_op}: under jit the segment count cannot be "
+            f"derived from traced segment_ids — pass num_segments=...")
+    else:
+        n = int(np.asarray(seg).max()) + 1 if seg.size else 0
+
+    def fn(a):
+        return _reduce(a, seg, n, reduce_op)
+
+    return apply(fn, x, name=f"segment_{reduce_op}")
+
+
+def segment_sum(x, segment_ids, num_segments=None, name=None):
+    return _segment(x, segment_ids, "sum", num_segments)
+
+
+def segment_mean(x, segment_ids, num_segments=None, name=None):
+    return _segment(x, segment_ids, "mean", num_segments)
+
+
+def segment_max(x, segment_ids, num_segments=None, name=None):
+    return _segment(x, segment_ids, "max", num_segments)
+
+
+def segment_min(x, segment_ids, num_segments=None, name=None):
+    return _segment(x, segment_ids, "min", num_segments)
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Compact global ids to local ids (reference: geometric/reindex.py):
+    returns (reindexed_src, reindexed_dst, out_nodes) where out_nodes is
+    [x ∪ neighbors] deduped with x first, and edges (src=neighbors,
+    dst=repeat(x, count)) rewritten to local ids. Host-side index prep."""
+    x_np = np.asarray(unwrap(x))
+    nb_np = np.asarray(unwrap(neighbors))
+    cnt_np = np.asarray(unwrap(count))
+    seen = dict.fromkeys(x_np.tolist())
+    for v in nb_np.tolist():
+        seen.setdefault(v, None)
+    out_nodes = np.fromiter(seen.keys(), dtype=x_np.dtype)
+    lookup = {v: i for i, v in enumerate(out_nodes.tolist())}
+    src_local = np.asarray([lookup[v] for v in nb_np.tolist()], np.int32)
+    dst_global = np.repeat(x_np, cnt_np)
+    dst_local = np.asarray([lookup[v] for v in dst_global.tolist()], np.int32)
+    return (Tensor(jnp.asarray(src_local)), Tensor(jnp.asarray(dst_local)),
+            Tensor(jnp.asarray(out_nodes)))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                     eids=None, return_eids=False, perm_buffer=None,
+                     name=None):
+    """Uniform neighbor sampling from CSC (row, colptr) for input_nodes
+    (reference: geometric/sampling/neighbors.py). Host-side; returns
+    (neighbors, counts) [+ eids]."""
+    from ..core import random as _rng
+
+    row_np = np.asarray(unwrap(row))
+    colptr_np = np.asarray(unwrap(colptr))
+    nodes_np = np.asarray(unwrap(input_nodes))
+    eids_np = np.asarray(unwrap(eids)) if eids is not None else None
+    key = _rng.next_key()
+    seed = int(jax.random.randint(key, (), 0, 2**31 - 1))
+    rng = np.random.RandomState(seed)
+    out_nb, out_cnt, out_eids = [], [], []
+    for v in nodes_np.tolist():
+        beg, end = int(colptr_np[v]), int(colptr_np[v + 1])
+        deg = end - beg
+        if sample_size < 0 or deg <= sample_size:
+            pick = np.arange(beg, end)
+        else:
+            pick = beg + rng.choice(deg, size=sample_size, replace=False)
+        out_nb.append(row_np[pick])
+        out_cnt.append(len(pick))
+        if eids_np is not None:
+            out_eids.append(eids_np[pick])
+    nb = np.concatenate(out_nb) if out_nb else np.zeros(0, row_np.dtype)
+    res = (Tensor(jnp.asarray(nb)), Tensor(jnp.asarray(np.asarray(out_cnt, np.int32))))
+    if return_eids and eids_np is not None:
+        res += (Tensor(jnp.asarray(np.concatenate(out_eids))),)
+    return res

@@ -1,0 +1,234 @@
+"""Inference API (reference: paddle/fluid/inference/ — AnalysisConfig +
+AnalysisPredictor (api/analysis_predictor.h:95): load a saved model, run an
+optimization pass pipeline, execute with zero-copy input/output handles;
+TensorRT subgraphs for deployment).
+
+TPU-native design: the "analysis pass pipeline + TensorRT engine" role is
+played by XLA itself — `save_inference_model` traces the layer into a
+StableHLO module via jax.export and serializes it next to the weights;
+`create_predictor` deserializes and AOT-compiles it once. Input/output
+handles mirror the reference's Tensor handle API (copy_from_cpu /
+copy_to_cpu)."""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import export as jax_export
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from ..static import InputSpec
+
+__all__ = [
+    "Config", "Predictor", "create_predictor",
+    "save_inference_model", "load_inference_model",
+]
+
+_MODEL_SUFFIX = ".pdmodel"
+_PARAMS_SUFFIX = ".pdiparams"
+
+
+def save_inference_model(path_prefix: str, layer: Layer, input_spec=None,
+                         example_inputs=None):
+    """Trace `layer.forward` on the given specs and serialize:
+    <prefix>.pdmodel = serialized StableHLO (jax.export), <prefix>.pdiparams
+    = weights (reference: paddle.static.save_inference_model / jit.save)."""
+    layer.eval()
+    params, buffers = layer.state_arrays()
+
+    if example_inputs is not None:
+        specs = [jax.ShapeDtypeStruct(np.asarray(x._data if isinstance(x, Tensor) else x).shape,
+                                      np.asarray(x._data if isinstance(x, Tensor) else x).dtype)
+                 for x in example_inputs]
+    else:
+        if input_spec is None:
+            raise ValueError("pass input_spec or example_inputs")
+        specs = []
+        sym_count = 0
+        scope = jax_export.SymbolicScope()
+        for s in input_spec:
+            shape, dtype = (s.shape, np.dtype(s.dtype)) if isinstance(s, InputSpec) \
+                else (tuple(s), np.dtype("float32"))
+            dims = []
+            for d in shape:
+                if d is None or (isinstance(d, int) and d < 0):
+                    # dynamic dim -> real symbolic dimension in the export
+                    dims.append(jax_export.symbolic_shape(
+                        f"_dyn{sym_count}", scope=scope)[0])
+                    sym_count += 1
+                else:
+                    dims.append(int(d))
+            specs.append(jax.ShapeDtypeStruct(tuple(dims), dtype))
+
+    from ..autograd import no_grad
+
+    def fn(params, buffers, *inputs):
+        backup = layer.state_arrays()
+        try:
+            layer.load_state_arrays(params, buffers)
+            with no_grad():
+                out = layer(*[Tensor(x) for x in inputs])
+            if isinstance(out, (list, tuple)):
+                return tuple(o._data if isinstance(o, Tensor) else o for o in out)
+            return out._data if isinstance(out, Tensor) else out
+        finally:
+            layer.load_state_arrays(*backup)
+
+    exported = jax_export.export(jax.jit(fn))(
+        jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params),
+        jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), buffers),
+        *specs,
+    )
+    dirname = os.path.dirname(path_prefix)
+    if dirname:
+        os.makedirs(dirname, exist_ok=True)
+    with open(path_prefix + _MODEL_SUFFIX, "wb") as f:
+        f.write(exported.serialize())
+    with open(path_prefix + _PARAMS_SUFFIX, "wb") as f:
+        pickle.dump(
+            {
+                "params": {k: np.asarray(v) for k, v in params.items()},
+                "buffers": {k: np.asarray(v) for k, v in buffers.items()},
+                "n_inputs": len(specs),
+            },
+            f,
+        )
+    return path_prefix
+
+
+def load_inference_model(path_prefix: str, params_file: str = None):
+    """Returns (exported_fn, params, buffers, n_inputs)."""
+    with open(path_prefix + _MODEL_SUFFIX, "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    with open(params_file or (path_prefix + _PARAMS_SUFFIX), "rb") as f:
+        blob = pickle.load(f)
+    return exported, blob["params"], blob["buffers"], blob["n_inputs"]
+
+
+class Config:
+    """AnalysisConfig analog (subset: model paths + device + toggles that
+    map to XLA; unknown toggles are accepted and recorded)."""
+
+    def __init__(self, model_dir=None, prog_file=None, params_file=None):
+        if model_dir and not prog_file:
+            # directory layout: <dir>/inference.pdmodel etc.
+            for name in ("inference", "model", "__model__"):
+                if os.path.exists(os.path.join(model_dir, name + _MODEL_SUFFIX)):
+                    prog_file = os.path.join(model_dir, name + _MODEL_SUFFIX)
+                    params_file = os.path.join(model_dir, name + _PARAMS_SUFFIX)
+                    break
+        self._prefix = None
+        self._params_file = params_file
+        if prog_file:
+            self._prefix = prog_file[: -len(_MODEL_SUFFIX)] if prog_file.endswith(_MODEL_SUFFIX) else prog_file
+        self._device = "tpu"
+        self._memory_pool_init_size_mb = 0
+        self._enable_log = True
+        self._flags = {}
+
+    def set_prog_file(self, path):
+        self._prefix = path[: -len(_MODEL_SUFFIX)] if path.endswith(_MODEL_SUFFIX) else path
+
+    def set_model(self, prog_or_dir, params_file=None):
+        """Bind a model without clobbering other settings; an explicit
+        params_file overrides the <prefix>.pdiparams convention."""
+        if os.path.isdir(prog_or_dir):
+            for name in ("inference", "model", "__model__"):
+                cand = os.path.join(prog_or_dir, name + _MODEL_SUFFIX)
+                if os.path.exists(cand):
+                    self.set_prog_file(cand)
+                    break
+        else:
+            self.set_prog_file(prog_or_dir)
+        if params_file:
+            self._params_file = params_file
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device = "tpu"  # device selection is jax-level; accepted for parity
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_memory_optim(self):
+        self._flags["memory_optim"] = True  # XLA does buffer reuse natively
+
+    def switch_ir_optim(self, on=True):
+        self._flags["ir_optim"] = on  # XLA fusion always on
+
+    def disable_glog_info(self):
+        self._enable_log = False
+
+    def enable_tensorrt_engine(self, **kwargs):
+        # TRT's role = AOT-compiled XLA executable; accepted for API parity
+        self._flags["trt"] = kwargs
+
+    def model_dir(self):
+        return self._prefix
+
+
+class _IOHandle:
+    """Zero-copy-style tensor handle (reference: paddle_infer Tensor —
+    copy_from_cpu / copy_to_cpu / shape)."""
+
+    def __init__(self):
+        self._value = None
+
+    def copy_from_cpu(self, arr):
+        self._value = jnp.asarray(np.ascontiguousarray(arr))
+
+    def reshape(self, shape):
+        pass  # shapes come from the bound array
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+    def shape(self):
+        return list(np.asarray(self._value).shape)
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        if config._prefix is None:
+            raise ValueError("Config has no model path")
+        self._exported, params, buffers, n_inputs = load_inference_model(
+            config._prefix, config._params_file)
+        self._params = jax.tree.map(jnp.asarray, params)
+        self._buffers = jax.tree.map(jnp.asarray, buffers)
+        self._n_inputs = n_inputs
+        self._inputs = [_IOHandle() for _ in range(n_inputs)]
+        self._outputs = []
+
+    def get_input_names(self):
+        return [f"input_{i}" for i in range(self._n_inputs)]
+
+    def get_input_handle(self, name):
+        return self._inputs[int(name.rsplit("_", 1)[1]) if isinstance(name, str) else name]
+
+    def run(self, inputs=None):
+        """Either bind handles then run(), or pass arrays directly —
+        returns list of numpy outputs either way."""
+        if inputs is not None:
+            for h, a in zip(self._inputs, inputs):
+                h.copy_from_cpu(np.asarray(a._data) if isinstance(a, Tensor) else a)
+        args = [h._value for h in self._inputs]
+        out = self._exported.call(self._params, self._buffers, *args)
+        outs = list(out) if isinstance(out, (tuple, list)) else [out]
+        self._outputs = outs
+        return [np.asarray(o) for o in outs]
+
+    def get_output_names(self):
+        return [f"output_{i}" for i in range(len(self._outputs) or 1)]
+
+    def get_output_handle(self, name):
+        h = _IOHandle()
+        idx = int(name.rsplit("_", 1)[1]) if isinstance(name, str) else name
+        h._value = self._outputs[idx]
+        return h
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

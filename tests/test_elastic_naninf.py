@@ -1,0 +1,116 @@
+"""Elastic manager + NaN/Inf checker (reference: fleet/elastic/manager.py;
+FLAGS_check_nan_inf at operator.cc:1608)."""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import native
+from paddle_tpu.distributed.fleet import ElasticManager, ElasticStatus
+from paddle_tpu.distributed.store import TCPStore
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_nan_inf_checker():
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+        y = paddle.to_tensor(np.array([0.0, 1.0], "float32"))
+        _ = x * y  # fine
+        with pytest.raises(FloatingPointError, match="NaN/Inf"):
+            _ = x / y  # 1/0 = inf
+        with pytest.raises(FloatingPointError, match="log"):
+            _ = paddle.log(paddle.to_tensor(np.array([-1.0], "float32")))
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+    # disabled again: no raise
+    _ = x / y
+
+
+def test_nan_check_does_not_break_jit():
+    from paddle_tpu import jit
+    import paddle_tpu.nn as nn
+
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        lin = nn.Linear(4, 2)
+
+        def f(x):
+            return lin(x).sum()
+
+        compiled = jit.compile(f, models=[lin], train=False)
+        out = compiled(paddle.to_tensor(np.ones((2, 4), "float32")))
+        assert np.isfinite(float(out.item()))
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_elastic_membership_and_restart():
+    port = _free_port()
+    master_store = TCPStore("127.0.0.1", port, is_master=True)
+    try:
+        m1 = ElasticManager(store=TCPStore("127.0.0.1", port), node_id="a",
+                            np_spec="1:3", heartbeat_interval=0.2, ttl=1.0)
+        m1.enable = True
+        m2 = ElasticManager(store=TCPStore("127.0.0.1", port), node_id="b",
+                            np_spec="1:3", heartbeat_interval=0.2, ttl=1.0)
+        m2.enable = True
+        m1.register()
+        m2.register()
+        time.sleep(0.4)
+        alive = m1.alive_nodes()
+        assert alive == ["a", "b"]
+        assert m1.watch() == ElasticStatus.HOLD
+
+        env = m1.rank_env_for(alive)
+        assert env["PADDLE_TRAINER_ID"] == "0"
+        assert m2.rank_env_for(alive)["PADDLE_TRAINER_ID"] == "1"
+        assert env["PADDLE_TRAINERS_NUM"] == "2"
+
+        # scale-in: node b stops heartbeating -> membership change -> RESTART
+        m2.exit()
+        deadline = time.time() + 5
+        status = ElasticStatus.HOLD
+        while time.time() < deadline:
+            status = m1.watch()
+            if status == ElasticStatus.RESTART:
+                break
+            time.sleep(0.3)
+        assert status == ElasticStatus.RESTART
+        assert m1.alive_nodes() == ["a"]
+
+        # scale-out: node c joins -> RESTART again
+        m3 = ElasticManager(store=TCPStore("127.0.0.1", port), node_id="c",
+                            np_spec="1:3", heartbeat_interval=0.2, ttl=1.0)
+        m3.enable = True
+        m3.register()
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            status = m1.watch()
+            if status == ElasticStatus.RESTART:
+                break
+            time.sleep(0.3)
+        assert status == ElasticStatus.RESTART
+        assert m1.alive_nodes() == ["a", "c"]
+        m1.exit()
+        m3.exit()
+    finally:
+        master_store.close()
+
+
+def test_np_spec_parsing():
+    m = ElasticManager(store=None, np_spec="2:4")
+    assert (m.np_min, m.np_max) == (2, 4)
+    m = ElasticManager(store=None, np_spec=3)
+    assert (m.np_min, m.np_max) == (3, 3)
+    assert not ElasticManager(store=None, np_spec="1").enable

@@ -1,0 +1,80 @@
+"""Inference predictor over serialized StableHLO (reference:
+inference/api/analysis_predictor.h AnalysisPredictor; Config/Predictor
+python surface paddle.inference)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import inference
+from paddle_tpu.static import InputSpec
+
+
+def _model():
+    paddle.seed(7)
+    return nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4))
+
+
+def test_save_load_predict_parity(tmp_path):
+    net = _model()
+    x = np.random.RandomState(0).randn(3, 8).astype("float32")
+    want = net(paddle.to_tensor(x)).numpy()
+
+    prefix = str(tmp_path / "deploy" / "inference")
+    inference.save_inference_model(prefix, net,
+                                   input_spec=[InputSpec([None, 8], "float32")])
+    assert os.path.exists(prefix + ".pdmodel")
+    assert os.path.exists(prefix + ".pdiparams")
+
+    config = inference.Config(model_dir=str(tmp_path / "deploy"))
+    predictor = inference.create_predictor(config)
+    # handle-style API
+    names = predictor.get_input_names()
+    assert names == ["input_0"]
+    h = predictor.get_input_handle(names[0])
+    # spec batch None -> symbolic dim: any batch size works
+    h.copy_from_cpu(x)
+    out = predictor.run()
+    np.testing.assert_allclose(out[0], want, rtol=1e-5, atol=1e-6)
+    oh = predictor.get_output_handle("output_0")
+    np.testing.assert_allclose(oh.copy_to_cpu(), want, rtol=1e-5, atol=1e-6)
+
+
+def test_example_inputs_full_batch(tmp_path):
+    net = _model()
+    x = np.random.RandomState(1).randn(5, 8).astype("float32")
+    want = net(paddle.to_tensor(x)).numpy()
+    prefix = str(tmp_path / "m")
+    inference.save_inference_model(prefix, net,
+                                   example_inputs=[paddle.to_tensor(x)])
+    predictor = inference.create_predictor(inference.Config(prog_file=prefix + ".pdmodel"))
+    out = predictor.run([x])
+    np.testing.assert_allclose(out[0], want, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_survives_weight_mutation(tmp_path):
+    """The serialized model is frozen: mutating the live layer afterwards
+    must not change predictor outputs (deployment semantics)."""
+    net = _model()
+    x = np.random.RandomState(2).randn(2, 8).astype("float32")
+    want = net(paddle.to_tensor(x)).numpy()
+    prefix = str(tmp_path / "m")
+    inference.save_inference_model(prefix, net, example_inputs=[paddle.to_tensor(x)])
+    # mutate
+    for p in net.parameters():
+        p._data = p._data * 0
+    predictor = inference.create_predictor(inference.Config(prog_file=prefix + ".pdmodel"))
+    out = predictor.run([x])
+    np.testing.assert_allclose(out[0], want, rtol=1e-5, atol=1e-6)
+
+
+def test_config_toggles_accepted(tmp_path):
+    cfg = inference.Config()
+    cfg.enable_memory_optim()
+    cfg.switch_ir_optim(True)
+    cfg.enable_tensorrt_engine(max_batch_size=8)
+    cfg.disable_glog_info()
+    with pytest.raises(ValueError):
+        inference.create_predictor(cfg)  # no model bound

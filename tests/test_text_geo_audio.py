@@ -1,0 +1,199 @@
+"""geometric + text + audio modules (reference: python/paddle/geometric,
+text/viterbi_decode, audio/features)."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import audio, geometric as G, text
+
+
+# ---------------------------------------------------------------------------
+# geometric
+# ---------------------------------------------------------------------------
+def test_send_u_recv_all_reduce_ops():
+    x = paddle.to_tensor(np.array([[1., 2.], [3., 4.], [5., 6.]], "float32"))
+    src = paddle.to_tensor(np.array([0, 1, 2, 0], "int32"))
+    dst = paddle.to_tensor(np.array([1, 2, 1, 0], "int32"))
+    out = G.send_u_recv(x, src, dst, "sum")
+    want = np.zeros((3, 2), "float32")
+    for s, d in zip([0, 1, 2, 0], [1, 2, 1, 0]):
+        want[d] += x.numpy()[s]
+    np.testing.assert_allclose(out.numpy(), want)
+    out = G.send_u_recv(x, src, dst, "mean")
+    np.testing.assert_allclose(out.numpy()[1], (x.numpy()[0] + x.numpy()[2]) / 2)
+    out = G.send_u_recv(x, src, dst, "max")
+    np.testing.assert_allclose(out.numpy()[1], np.maximum(x.numpy()[0], x.numpy()[2]))
+    # empty destination bucket -> 0 under max (reference zero-fill)
+    dst2 = paddle.to_tensor(np.array([1, 1, 1, 1], "int32"))
+    out = G.send_u_recv(x, src, dst2, "max")
+    np.testing.assert_allclose(out.numpy()[0], np.zeros(2))
+
+
+def test_send_ue_recv_and_send_uv():
+    x = paddle.to_tensor(np.array([[1.], [2.]], "float32"))
+    e = paddle.to_tensor(np.array([[10.], [20.], [30.]], "float32"))
+    src = np.array([0, 1, 1], "int32")
+    dst = np.array([1, 0, 1], "int32")
+    out = G.send_ue_recv(x, e, paddle.to_tensor(src), paddle.to_tensor(dst),
+                         "mul", "sum")
+    want = np.zeros((2, 1), "float32")
+    for i, (s, d) in enumerate(zip(src, dst)):
+        want[d] += x.numpy()[s] * e.numpy()[i]
+    np.testing.assert_allclose(out.numpy(), want)
+
+    y = paddle.to_tensor(np.array([[5.], [7.]], "float32"))
+    uv = G.send_uv(x, y, paddle.to_tensor(src), paddle.to_tensor(dst), "add")
+    np.testing.assert_allclose(uv.numpy(),
+                               x.numpy()[src] + y.numpy()[dst])
+
+
+def test_send_u_recv_grad():
+    x = paddle.to_tensor(np.array([[1., 2.], [3., 4.]], "float32"),
+                         stop_gradient=False)
+    src = paddle.to_tensor(np.array([0, 0, 1], "int32"))
+    dst = paddle.to_tensor(np.array([0, 1, 1], "int32"))
+    out = G.send_u_recv(x, src, dst, "sum")
+    (out * out).sum().backward()
+    assert x.grad is not None
+    # node 0 contributes to dst 0 and 1: grad = 2*out[0] + 2*out[1]
+    want0 = 2 * out.numpy()[0] + 2 * out.numpy()[1]
+    np.testing.assert_allclose(x.grad.numpy()[0], want0, rtol=1e-5)
+
+
+def test_segment_ops():
+    x = paddle.to_tensor(np.array([[1.], [2.], [3.], [4.]], "float32"))
+    seg = paddle.to_tensor(np.array([0, 0, 1, 1], "int32"))
+    np.testing.assert_allclose(G.segment_sum(x, seg).numpy(), [[3.], [7.]])
+    np.testing.assert_allclose(G.segment_mean(x, seg).numpy(), [[1.5], [3.5]])
+    np.testing.assert_allclose(G.segment_max(x, seg).numpy(), [[2.], [4.]])
+    np.testing.assert_allclose(G.segment_min(x, seg).numpy(), [[1.], [3.]])
+
+
+def test_reindex_and_sample_neighbors():
+    x = np.array([10, 20], "int64")
+    neighbors = np.array([20, 30, 40, 10], "int64")
+    count = np.array([2, 2], "int32")
+    src, dst, nodes = G.reindex_graph(paddle.to_tensor(x),
+                                      paddle.to_tensor(neighbors),
+                                      paddle.to_tensor(count))
+    np.testing.assert_array_equal(nodes.numpy(), [10, 20, 30, 40])
+    np.testing.assert_array_equal(src.numpy(), [1, 2, 3, 0])
+    np.testing.assert_array_equal(dst.numpy(), [0, 0, 1, 1])
+
+    # CSC graph: node 0 has neighbors [1,2,3], node 1 has [0]
+    row = np.array([1, 2, 3, 0], "int64")
+    colptr = np.array([0, 3, 4, 4, 4], "int64")
+    paddle.seed(0)
+    nb, cnt = G.sample_neighbors(paddle.to_tensor(row), paddle.to_tensor(colptr),
+                                 paddle.to_tensor(np.array([0, 1], "int64")),
+                                 sample_size=2)
+    assert cnt.numpy().tolist() == [2, 1]
+    assert set(nb.numpy()[:2]).issubset({1, 2, 3})
+    assert nb.numpy()[2] == 0
+
+
+# ---------------------------------------------------------------------------
+# text
+# ---------------------------------------------------------------------------
+def test_viterbi_matches_brute_force():
+    rng = np.random.RandomState(0)
+    B, T, N = 3, 5, 4  # last two tags = BOS/EOS
+    pot = rng.randn(B, T, N).astype("float32")
+    trans = rng.randn(N, N).astype("float32")
+    lens = np.array([5, 3, 4], "int64")
+
+    scores, paths = text.viterbi_decode(
+        paddle.to_tensor(pot), paddle.to_tensor(trans),
+        paddle.to_tensor(lens), include_bos_eos_tag=True)
+
+    import itertools
+
+    for b in range(B):
+        L = int(lens[b])
+        best_score, best_path = -np.inf, None
+        for seq in itertools.product(range(N), repeat=L):
+            s = trans[N - 2, seq[0]] + pot[b, 0, seq[0]]
+            for t in range(1, L):
+                s += trans[seq[t - 1], seq[t]] + pot[b, t, seq[t]]
+            s += trans[seq[-1], N - 1]
+            if s > best_score:
+                best_score, best_path = s, seq
+        assert scores.numpy()[b] == pytest.approx(best_score, rel=1e-4)
+        np.testing.assert_array_equal(paths.numpy()[b, :L], best_path)
+        assert (paths.numpy()[b, L:] == 0).all()
+
+
+def test_viterbi_decoder_layer_and_no_bos():
+    rng = np.random.RandomState(1)
+    pot = rng.randn(2, 4, 3).astype("float32")
+    trans = rng.randn(3, 3).astype("float32")
+    lens = np.array([4, 4], "int64")
+    dec = text.ViterbiDecoder(paddle.to_tensor(trans), include_bos_eos_tag=False)
+    scores, paths = dec(paddle.to_tensor(pot), paddle.to_tensor(lens))
+    import itertools
+
+    for b in range(2):
+        best = max(
+            (pot[b, 0, s0] + sum(trans[seq[t - 1], seq[t]] + pot[b, t, seq[t]]
+                                 for t in range(1, 4))
+             for seq in itertools.product(range(3), repeat=4)
+             for s0 in [seq[0]] if True),
+            default=None)
+        assert scores.numpy()[b] == pytest.approx(best, rel=1e-4)
+
+
+def test_text_datasets():
+    ds = text.datasets.Imdb(mode="train")
+    doc, label = ds[0]
+    assert doc.dtype == np.int64 and label in (0, 1)
+    ds = text.datasets.UCIHousing(mode="test")
+    x, y = ds[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    ds = text.datasets.Imikolov(mode="train", window_size=5)
+    assert len(ds[0]) == 5
+
+
+# ---------------------------------------------------------------------------
+# audio
+# ---------------------------------------------------------------------------
+def test_mel_scale_roundtrip_and_fbank():
+    f = 440.0
+    assert audio.functional.mel_to_hz(audio.functional.hz_to_mel(f)) == pytest.approx(f, rel=1e-6)
+    assert audio.functional.mel_to_hz(
+        audio.functional.hz_to_mel(f, htk=True), htk=True) == pytest.approx(f, rel=1e-6)
+    fb = audio.functional.compute_fbank_matrix(16000, 512, n_mels=40)
+    assert fb.shape == (40, 257)
+    w = fb.numpy()
+    assert (w >= 0).all() and w.sum(1).min() > 0  # every filter nonempty
+
+
+def test_spectrogram_and_melspectrogram():
+    sr = 16000
+    t = np.arange(sr // 4) / sr
+    sig = np.sin(2 * math.pi * 1000 * t).astype("float32")  # 1 kHz tone
+    x = paddle.to_tensor(sig[None])
+    spec = audio.Spectrogram(n_fft=512, hop_length=256)(x)
+    assert spec.shape[1] == 257
+    peak_bin = int(np.argmax(spec.numpy()[0].mean(-1)))
+    assert abs(peak_bin - round(1000 / (sr / 512))) <= 1  # peak at ~1 kHz
+
+    mel = audio.MelSpectrogram(sr=sr, n_fft=512, hop_length=256, n_mels=40)(x)
+    assert mel.shape[1] == 40
+    logmel = audio.LogMelSpectrogram(sr=sr, n_fft=512, hop_length=256,
+                                     n_mels=40, top_db=80.0)(x)
+    assert np.isfinite(logmel.numpy()).all()
+
+    mfcc = audio.MFCC(sr=sr, n_mfcc=13, n_fft=512, hop_length=256, n_mels=40)(x)
+    assert mfcc.shape[1] == 13
+
+
+def test_windows():
+    for name in ["hann", "hamming", "blackman", "bartlett"]:
+        w = audio.functional.get_window(name, 64).numpy()
+        assert w.shape == (64,)
+        assert w.max() <= 1.0 + 1e-6
+    np.testing.assert_allclose(
+        audio.functional.get_window("hann", 16, fftbins=False).numpy(),
+        np.hanning(16), atol=1e-6)
