@@ -73,6 +73,10 @@ from . import geometric  # noqa: E402
 from . import text  # noqa: E402
 from . import audio  # noqa: E402
 from . import inference  # noqa: E402
+from . import hub  # noqa: E402
+from . import sysconfig  # noqa: E402
+from . import onnx  # noqa: E402
+from .cost_model import CostModel  # noqa: E402
 
 from .framework.io_ import save, load  # noqa: E402
 from .framework.core_ import (  # noqa: E402

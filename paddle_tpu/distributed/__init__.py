@@ -36,6 +36,7 @@ from . import sharding
 from .sharding import group_sharded_parallel, save_group_sharded_model
 from .launch_mod import spawn, launch
 from .store import TCPStore
+from . import auto_parallel
 
 __all__ = [
     "init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",

@@ -1,0 +1,210 @@
+"""Semi-automatic parallelism (reference:
+python/paddle/distributed/auto_parallel/ — ProcessMesh (process_mesh.py),
+shard_tensor annotation, Engine (engine.py:58, .fit:811, .prepare:1272)
+with Completer/Partitioner/Resharder pass pipeline).
+
+TPU-native design: the Completer/Partitioner/Resharder trio IS the XLA
+GSPMD partitioner — user annotations become jax shardings on a Mesh, the
+compiler propagates them through the whole program and inserts the
+collectives. The Engine here wires annotations + whole-graph jit + the
+training loop; no hand-written propagation passes are needed."""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .. import parallel as _P
+
+__all__ = ["ProcessMesh", "shard_tensor", "shard_op", "Engine", "Strategy"]
+
+
+class ProcessMesh:
+    """N-D logical mesh of processes/devices (reference:
+    auto_parallel/process_mesh.py). dim_names map onto the framework's
+    global device mesh axes."""
+
+    def __init__(self, mesh, dim_names: Optional[Sequence[str]] = None,
+                 process_ids=None):
+        arr = np.asarray(mesh)
+        self.shape = list(arr.shape)
+        self.process_ids = arr.reshape(-1).tolist()
+        self.dim_names = list(dim_names) if dim_names else [
+            f"d{i}" for i in range(arr.ndim)]
+        if len(self.dim_names) != arr.ndim:
+            raise ValueError("dim_names length must match mesh rank")
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self.dim_names})"
+
+    def _ensure_device_mesh(self):
+        """Materialize a jax Mesh with these axes (axes not named dp/mp/...
+        are mapped positionally onto a fresh mesh)."""
+        sizes = dict(zip(self.dim_names, self.shape))
+        kwargs = {}
+        for axis in ("dp", "mp", "pp", "sharding", "sp", "ep"):
+            if axis in sizes:
+                kwargs[axis] = sizes[axis]
+        if kwargs:
+            return _P.init_mesh(**kwargs)
+        # generic names: map first axis to dp, second to mp
+        defaults = ["dp", "mp", "pp", "sp"]
+        for name, size in zip(self.dim_names, self.shape):
+            kwargs[defaults[len(kwargs)]] = size
+        mesh = _P.init_mesh(**kwargs)
+        # remember the rename for shard_tensor
+        self._rename = dict(zip(self.dim_names, list(kwargs)))
+        return mesh
+
+    def _axis(self, name):
+        if name is None:
+            return None
+        return getattr(self, "_rename", {}).get(name, name)
+
+
+def shard_tensor(x, process_mesh: ProcessMesh = None, shard_spec=None,
+                 mesh=None, placements=None):
+    """Annotate a tensor/parameter with per-dim mesh axes (reference:
+    auto_parallel shard_tensor). shard_spec: list of axis names or None
+    per tensor dim."""
+    process_mesh = process_mesh or mesh
+    if process_mesh is not None:
+        process_mesh._ensure_device_mesh()
+        spec = [process_mesh._axis(a) for a in (shard_spec or [])]
+    else:
+        spec = list(shard_spec or [])
+    if hasattr(x, "_sharding_axes"):
+        x._sharding_axes = spec
+    return _P.shard_tensor(x, spec) if not hasattr(x, "trainable") else x
+
+
+def shard_op(op, process_mesh=None, in_shard_specs=None, out_shard_specs=None):
+    """Annotation shim: under GSPMD the compiler propagates op shardings
+    from operand shardings, so this only constrains inputs."""
+
+    def wrapped(*args, **kwargs):
+        if process_mesh is not None and in_shard_specs:
+            args = tuple(
+                shard_tensor(a, process_mesh, s) if s is not None else a
+                for a, s in zip(args, list(in_shard_specs) + [None] * len(args))
+            )
+        return op(*args, **kwargs)
+
+    return wrapped
+
+
+class Strategy:
+    """Auto-parallel strategy knobs (reference: auto_parallel/strategy.py);
+    the subset that changes behavior here: amp / recompute toggles."""
+
+    def __init__(self):
+        self.auto_mode = "semi"
+        self.amp = type("amp", (), {"enable": False, "dtype": "bfloat16"})()
+        self.recompute = type("rc", (), {"enable": False})()
+        self.gradient_merge = type("gm", (), {"enable": False, "k_steps": 1})()
+
+
+class Engine:
+    """Prepare/fit/evaluate/predict driver (reference:
+    auto_parallel/engine.py:58). The model's annotated parameters are
+    placed on the mesh; the train step is whole-graph jitted so GSPMD
+    completes the sharding plan and inserts collectives."""
+
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 cluster=None, strategy=None):
+        self._model = model
+        self._loss = loss
+        self._optimizer = optimizer
+        self._metrics = metrics or []
+        self._strategy = strategy or Strategy()
+        self._compiled = None
+
+    def prepare(self, inputs_spec=None, labels_spec=None, mode="train"):
+        from .. import jit
+
+        model, loss, opt = self._model, self._loss, self._optimizer
+        _P.place_model(model)
+
+        def step(*data):
+            n_lab = 1 if len(data) > 1 else 0
+            inputs, labels = data[:len(data) - n_lab], data[len(data) - n_lab:]
+            out = model(*inputs)
+            l = loss(out, *labels) if labels else loss(out)
+            l.backward()
+            opt.step()
+            opt.clear_grad()
+            return l
+
+        self._compiled = jit.compile(step, models=(model,), optimizers=(opt,))
+        return self._compiled
+
+    def fit(self, train_data, epochs=1, batch_size=1, steps_per_epoch=None,
+            log_freq=10, verbose=0, collate_fn=None):
+        from ..io import DataLoader, Dataset
+
+        if isinstance(train_data, Dataset):
+            loader = DataLoader(train_data, batch_size=batch_size,
+                                shuffle=True, drop_last=True,
+                                collate_fn=collate_fn)
+        else:
+            loader = train_data
+        if self._compiled is None:
+            self.prepare()
+        history = []
+        for epoch in range(epochs):
+            losses = []
+            for step_i, batch in enumerate(loader):
+                if steps_per_epoch and step_i >= steps_per_epoch:
+                    break
+                batch = list(batch) if isinstance(batch, (list, tuple)) else [batch]
+                l = self._compiled(*batch)
+                losses.append(float(l.item() if isinstance(l, Tensor) else l))
+                if verbose and step_i % log_freq == 0:
+                    print(f"epoch {epoch} step {step_i}: loss {losses[-1]:.4f}")
+            history.append(float(np.mean(losses)) if losses else float("nan"))
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, collate_fn=None):
+        from ..autograd import no_grad
+        from ..io import DataLoader, Dataset
+
+        loader = (DataLoader(eval_data, batch_size=batch_size, collate_fn=collate_fn)
+                  if isinstance(eval_data, Dataset) else eval_data)
+        losses = []
+        with no_grad():
+            for batch in loader:
+                batch = list(batch) if isinstance(batch, (list, tuple)) else [batch]
+                out = self._model(*batch[:-1])
+                losses.append(float(self._loss(out, batch[-1]).item()))
+        return {"loss": float(np.mean(losses))}
+
+    def predict(self, test_data, batch_size=1, collate_fn=None):
+        from ..autograd import no_grad
+        from ..io import DataLoader, Dataset
+
+        loader = (DataLoader(test_data, batch_size=batch_size, collate_fn=collate_fn)
+                  if isinstance(test_data, Dataset) else test_data)
+        outs = []
+        with no_grad():
+            for batch in loader:
+                batch = list(batch) if isinstance(batch, (list, tuple)) else [batch]
+                outs.append(self._model(*batch).numpy()
+                            if not isinstance(batch[0], list) else None)
+        return outs
+
+    def save(self, path, training=True):
+        from ..framework.io_ import save as _save
+
+        _save(self._model.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path):
+        from ..framework.io_ import load as _load
+
+        self._model.set_state_dict(_load(path + ".pdparams"))

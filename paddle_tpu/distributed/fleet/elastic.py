@@ -78,11 +78,13 @@ class ElasticManager:
         self._hb_thread = threading.Thread(target=self._hb_loop, daemon=True)
         self._hb_thread.start()
 
-    def _ensure_registered(self):
-        if self.node_id not in self._read_registry():
+    def _ensure_registered(self, known=None):
+        known = known if known is not None else self._read_registry()
+        if self.node_id not in known:
             idx = self.store.add(f"{self.PREFIX}/registry_count", 1) - 1
             self.store.set(f"{self.PREFIX}/registry/{idx}",
                            self.node_id.encode())
+            self._registry_slot = idx
 
     def _beat(self):
         self.store.set(f"{self.PREFIX}/node/{self.node_id}",
@@ -101,8 +103,10 @@ class ElasticManager:
         """Nodes whose heartbeat is within the TTL window. The registry is
         an atomic-counter-indexed append-only log (store.add allocates the
         slot, so concurrent registrations can't lose updates)."""
-        self._ensure_registered()
         known = self._read_registry()
+        self._ensure_registered(known)
+        if self.node_id not in known:
+            known = sorted(set(known + [self.node_id]))
         now = time.time()
         alive = []
         for nid in known:
@@ -118,6 +122,8 @@ class ElasticManager:
         return sorted(alive)
 
     def _read_registry(self) -> List[str]:
+        """Registry slots of exited nodes hold b'' (cleared by exit()) and
+        are skipped, so historical relaunches don't grow the scan."""
         try:
             count = self.store.add(f"{self.PREFIX}/registry_count", 0)
         except ConnectionError:
@@ -125,14 +131,19 @@ class ElasticManager:
         ids = []
         for i in range(count):
             try:
-                ids.append(self.store.get(f"{self.PREFIX}/registry/{i}",
-                                          timeout_ms=500).decode())
+                nid = self.store.get(f"{self.PREFIX}/registry/{i}",
+                                     timeout_ms=500).decode()
+                if nid:
+                    ids.append(nid)
             except TimeoutError:
                 continue
         return sorted(set(ids))
 
     def watch(self) -> str:
-        """One membership evaluation (reference: manager.py watch loop)."""
+        """One membership evaluation (reference: manager.py watch loop).
+        A membership change only becomes RESTART after it is observed on
+        two consecutive evaluations, so one slow store response can't
+        trigger a spurious cluster-wide relaunch."""
         if not self.enable:
             return ElasticStatus.COMPLETED
         alive = self.alive_nodes()
@@ -144,9 +155,16 @@ class ElasticManager:
         if n < self.np_min:
             return ElasticStatus.EXIT if self._below_min_since() else ElasticStatus.HOLD
         if alive != self._known and self.np_min <= n <= self.np_max:
-            self._known = alive
-            return ElasticStatus.RESTART
+            if alive == self._pending_change:
+                self._pending_change = None
+                self._known = alive
+                return ElasticStatus.RESTART
+            self._pending_change = alive
+            return ElasticStatus.HOLD
+        self._pending_change = None
         return ElasticStatus.HOLD
+
+    _pending_change = None
 
     _below_since = None
 
@@ -175,5 +193,9 @@ class ElasticManager:
         if self.enable:
             try:
                 self.store.delete_key(f"{self.PREFIX}/node/{self.node_id}")
+                # clear (don't delete) the registry slot so scans stay fast
+                slot = getattr(self, "_registry_slot", None)
+                if slot is not None:
+                    self.store.set(f"{self.PREFIX}/registry/{slot}", b"")
             except Exception:
                 pass

@@ -1,0 +1,51 @@
+"""Model hub (reference: python/paddle/hapi/hub.py — paddle.hub.list/help/
+load from github/gitee/local). Zero-egress environment: the local source is
+fully supported; remote sources raise with guidance."""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no {_HUBCONF} in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["hubconf"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _check_source(source):
+    if source not in ("local",):
+        raise ValueError(
+            f"source {source!r} unavailable in this environment (no network "
+            f"egress); use source='local' with a checked-out repo dir")
+
+
+def list(repo_dir, source="local", force_reload=False):
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return [name for name in dir(mod)
+            if callable(getattr(mod, name)) and not name.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    if not hasattr(mod, model):
+        raise ValueError(f"model {model!r} not in {repo_dir}/{_HUBCONF}")
+    return getattr(mod, model)(**kwargs)

@@ -2,5 +2,6 @@
 MoE, autograd functional; populated across rounds)."""
 from . import nn
 from . import autograd
+from . import asp
 
-__all__ = ["nn", "autograd"]
+__all__ = ["nn", "autograd", "asp"]

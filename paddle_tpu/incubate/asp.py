@@ -1,0 +1,106 @@
+"""Automatic SParsity — 2:4 structured sparsity (reference:
+python/paddle/incubate/asp/asp.py — prune_model:303, decorate:217,
+create_mask / check_sparsity in utils.py:516; ASPOptimizer wraps step to
+re-apply masks).
+
+TPU note: XLA has no sparse-tensor-core path, so 2:4 here preserves the
+*capability semantics* (mask creation, pruned training, mask persistence
+through optimizer steps); dense masked matmuls still use the MXU."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+__all__ = [
+    "calculate_density", "create_mask", "check_sparsity", "prune_model",
+    "decorate", "reset_excluded_layers", "set_excluded_layers",
+]
+
+_excluded_layers = set()
+_masks = {}  # param name -> jnp mask
+
+
+def calculate_density(x) -> float:
+    arr = np.asarray(x._data) if isinstance(x, Tensor) else np.asarray(x)
+    return float(np.count_nonzero(arr)) / max(arr.size, 1)
+
+
+def _mask_1d_nm(flat, n, m):
+    """Keep the n largest-|.| of every m consecutive values."""
+    pad = (-len(flat)) % m
+    v = np.abs(np.concatenate([flat, np.zeros(pad, flat.dtype)]))
+    groups = v.reshape(-1, m)
+    idx = np.argsort(-groups, axis=1)[:, :n]
+    mask = np.zeros_like(groups)
+    np.put_along_axis(mask, idx, 1.0, axis=1)
+    return mask.reshape(-1)[: len(flat)]
+
+
+def create_mask(tensor, func_name="mask_1d", n=2, m=4):
+    """n:m mask along the last axis (reference: utils.py create_mask;
+    mask_1d/mask_2d_greedy/mask_2d_best all reduce to n-of-m selection —
+    the 2d variants differ only in tie-breaking)."""
+    arr = np.asarray(tensor._data) if isinstance(tensor, Tensor) else np.asarray(tensor)
+    flat = arr.reshape(-1, arr.shape[-1])
+    mask = np.stack([_mask_1d_nm(row, n, m) for row in flat])
+    return mask.reshape(arr.shape).astype(arr.dtype)
+
+
+def check_sparsity(tensor, n=2, m=4) -> bool:
+    arr = np.asarray(tensor._data) if isinstance(tensor, Tensor) else np.asarray(tensor)
+    flat = arr.reshape(-1)
+    pad = (-len(flat)) % m
+    v = np.concatenate([flat, np.zeros(pad, arr.dtype)]).reshape(-1, m)
+    return bool((np.count_nonzero(v, axis=1) <= n).all())
+
+
+def set_excluded_layers(param_names, main_program=None):
+    _excluded_layers.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded_layers.clear()
+
+
+def _supported_params(model: Layer):
+    for name, p in model.named_parameters():
+        if p is None or p.ndim < 2:
+            continue
+        if name in _excluded_layers:
+            continue
+        # prune matmul-style weights only (reference supports fc/conv)
+        if p.shape[-1] % 4 != 0:
+            continue
+        yield name, p
+
+
+def prune_model(model: Layer, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Apply n:m masks to supported parameters and remember them so
+    `decorate`d optimizers keep re-applying after each step."""
+    pruned = {}
+    for name, p in _supported_params(model):
+        mask = create_mask(p, mask_algo, n, m)
+        p._data = p._data * jnp.asarray(mask)
+        if with_mask:
+            _masks[name] = (p, jnp.asarray(mask))
+        pruned[name] = calculate_density(p)
+    return pruned
+
+
+def decorate(optimizer):
+    """Wrap optimizer.step to re-apply the recorded masks (reference:
+    asp.py decorate → OptimizerWithSparsityGuarantee)."""
+    orig_step = optimizer.step
+
+    def step_with_masks(*args, **kwargs):
+        out = orig_step(*args, **kwargs)
+        for name, (p, mask) in _masks.items():
+            p._data = p._data * mask
+        return out
+
+    optimizer.step = step_with_masks
+    optimizer._asp_decorated = True
+    return optimizer

@@ -9,9 +9,10 @@ import jax.numpy as jnp
 from ...nn.layer import Layer
 from ...nn.common import Linear, Dropout
 from ...nn.norm import LayerNorm
+from ...nn import container as nn_container
 from ...nn import functional as F
 
-__all__ = ["FusedMultiHeadAttention", "FusedFeedForward"]
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward", "FusedMultiTransformer"]
 
 
 class FusedMultiHeadAttention(Layer):
@@ -79,4 +80,58 @@ class FusedFeedForward(Layer):
         x = residual + self.dropout2(x)
         if not self.normalize_before:
             x = self.ln(x)
+        return x
+
+
+class FusedMultiTransformer(Layer):
+    """Stacked fused transformer decoder layers (reference:
+    python/paddle/incubate/nn/layer/fused_transformer.py
+    FusedMultiTransformer over fused_multi_transformer_op.cu): pre-LN
+    attention + FFN per layer, all heavy math in flash attention (Pallas)
+    and XLA-fused matmuls."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward, dropout_rate=0.0,
+                 activation="gelu", normalize_before=True, num_layers=1,
+                 epsilon=1e-5, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        if not normalize_before:
+            raise ValueError("FusedMultiTransformer is pre-LN (reference contract)")
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.activation = activation
+        layers = []
+        for _ in range(num_layers):
+            layers.append(nn_container.LayerDict({
+                "ln1": LayerNorm(embed_dim, epsilon=epsilon),
+                "qkv": Linear(embed_dim, 3 * embed_dim),
+                "out": Linear(embed_dim, embed_dim),
+                "ln2": LayerNorm(embed_dim, epsilon=epsilon),
+                "ffn1": Linear(embed_dim, dim_feedforward),
+                "ffn2": Linear(dim_feedforward, embed_dim),
+            }))
+        self.layers = nn_container.LayerList(layers)
+        self.dropout = Dropout(dropout_rate)
+
+    def forward(self, src, attn_mask=None, caches=None, time_step=None):
+        from ...ops.pallas_ops import flash_attention
+
+        if caches is not None or time_step is not None:
+            raise NotImplementedError(
+                "FusedMultiTransformer: KV-cache incremental decoding is not "
+                "implemented yet — run full-sequence forward instead")
+
+        x = src
+        B = None
+        for blk in self.layers:
+            h = blk["ln1"](x)
+            qkv = blk["qkv"](h)
+            if B is None:
+                B, S, _ = qkv.shape
+            q, k, v = qkv.reshape([B, S, 3, self.num_heads, self.head_dim]).unbind(axis=2)
+            attn = flash_attention(q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None)
+            x = x + self.dropout(blk["out"](attn.reshape([B, S, -1])))
+            h = blk["ln2"](x)
+            act = F.gelu if self.activation == "gelu" else F.relu
+            x = x + self.dropout(blk["ffn2"](act(blk["ffn1"](h))))
         return x

@@ -37,7 +37,17 @@ def save_inference_model(path_prefix: str, layer: Layer, input_spec=None,
     """Trace `layer.forward` on the given specs and serialize:
     <prefix>.pdmodel = serialized StableHLO (jax.export), <prefix>.pdiparams
     = weights (reference: paddle.static.save_inference_model / jit.save)."""
+    was_training = layer.training
     layer.eval()
+    try:
+        return _save_inference_model(path_prefix, layer, input_spec,
+                                     example_inputs)
+    finally:
+        if was_training:
+            layer.train()
+
+
+def _save_inference_model(path_prefix, layer, input_spec, example_inputs):
     params, buffers = layer.state_arrays()
 
     if example_inputs is not None:
@@ -115,11 +125,13 @@ class Config:
 
     def __init__(self, model_dir=None, prog_file=None, params_file=None):
         if model_dir and not prog_file:
-            # directory layout: <dir>/inference.pdmodel etc.
+            # directory layout: <dir>/inference.pdmodel etc.; an explicitly
+            # passed params_file always wins over the convention
             for name in ("inference", "model", "__model__"):
                 if os.path.exists(os.path.join(model_dir, name + _MODEL_SUFFIX)):
                     prog_file = os.path.join(model_dir, name + _MODEL_SUFFIX)
-                    params_file = os.path.join(model_dir, name + _PARAMS_SUFFIX)
+                    if params_file is None:
+                        params_file = os.path.join(model_dir, name + _PARAMS_SUFFIX)
                     break
         self._prefix = None
         self._params_file = params_file

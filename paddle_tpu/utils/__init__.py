@@ -5,7 +5,9 @@ import functools
 import importlib
 import warnings
 
-__all__ = ["deprecated", "try_import", "run_check", "unique_name"]
+__all__ = ["deprecated", "try_import", "run_check", "unique_name", "cpp_extension"]
+
+from . import cpp_extension  # noqa: E402
 
 
 def deprecated(update_to="", since="", reason="", level=0):

@@ -1,0 +1,91 @@
+"""Custom C++ op extensions (reference: python/paddle/utils/cpp_extension/ —
+JIT-compiles user C++/CUDA ops against paddle/extension.h and registers
+them; fluid/framework/custom_operator.cc).
+
+TPU-native shape: a user C++ kernel is built into a shared library (same
+lazy-make flow as the framework's own csrc/) and invoked as a host
+callback inside the XLA program via jax.pure_callback — the custom-call
+extension point. Device-side custom kernels are written in Pallas instead
+(pure Python, no toolchain needed)."""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = ["load", "CppExtension", "get_build_directory", "custom_host_op"]
+
+_BUILD_ROOT = os.path.join(tempfile.gettempdir(), "paddle_tpu_extensions")
+
+
+def get_build_directory():
+    os.makedirs(_BUILD_ROOT, exist_ok=True)
+    return _BUILD_ROOT
+
+
+class CppExtension:
+    """Declarative extension spec (sources + flags), mirroring the
+    reference's setuptools Extension shim."""
+
+    def __init__(self, sources, extra_compile_args=None, extra_link_args=None,
+                 include_dirs=None):
+        self.sources = list(sources)
+        self.extra_compile_args = list(extra_compile_args or [])
+        self.extra_link_args = list(extra_link_args or [])
+        self.include_dirs = list(include_dirs or [])
+
+
+def load(name, sources, extra_cxx_cflags=None, extra_include_paths=None,
+         build_directory=None, verbose=False, **kwargs):
+    """Compile `sources` into lib<name>.so and return the ctypes handle
+    (reference: cpp_extension.load JIT path)."""
+    build_dir = build_directory or get_build_directory()
+    os.makedirs(build_dir, exist_ok=True)
+    tag = hashlib.sha1(
+        ("".join(sorted(sources)) + str(extra_cxx_cflags)).encode()).hexdigest()[:10]
+    out = os.path.join(build_dir, f"lib{name}_{tag}.so")
+    if not os.path.exists(out):
+        cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-o", out]
+        for inc in extra_include_paths or []:
+            cmd += ["-I", inc]
+        cmd += list(sources)
+        cmd += extra_cxx_cflags or []
+        if verbose:
+            print("building:", " ".join(cmd))
+        subprocess.run(cmd, check=True, capture_output=not verbose)
+    return ctypes.CDLL(out)
+
+
+def custom_host_op(fn, out_shape_fn=None, name=None):
+    """Wrap a host function (numpy in/out — e.g. a ctypes call into a
+    compiled extension) as a framework op usable inside jitted programs
+    via XLA custom-call (jax.pure_callback).
+
+    fn: (*numpy arrays) -> numpy array (or tuple)
+    out_shape_fn: (*ShapeDtypeStruct-like inputs) -> jax.ShapeDtypeStruct
+        or list thereof; defaults to same-shape-as-first-input.
+    """
+
+    def op(*tensors, **attrs):
+        def jfn(*arrays):
+            if out_shape_fn is not None:
+                result_shape = out_shape_fn(*arrays)
+            else:
+                result_shape = jax.ShapeDtypeStruct(arrays[0].shape,
+                                                    arrays[0].dtype)
+            call = lambda *a: fn(*[np.asarray(x) for x in a], **attrs)
+            return jax.pure_callback(call, result_shape, *arrays,
+                                     vmap_method="sequential")
+
+        return apply(jfn, *tensors, name=name or getattr(fn, "__name__", "custom_op"))
+
+    return op

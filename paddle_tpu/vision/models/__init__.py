@@ -3,11 +3,32 @@ from .lenet import LeNet
 from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152, BasicBlock, BottleneckBlock
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
 from .mobilenet import MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2
+from .mobilenetv3 import (
+    MobileNetV3Small, MobileNetV3Large, mobilenet_v3_small, mobilenet_v3_large,
+)
 from .alexnet import AlexNet, alexnet
+from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1
+from .densenet import (
+    DenseNet, densenet121, densenet161, densenet169, densenet201, densenet264,
+)
+from .shufflenetv2 import (
+    ShuffleNetV2, shufflenet_v2_x0_25, shufflenet_v2_x0_33, shufflenet_v2_x0_5,
+    shufflenet_v2_x1_0, shufflenet_v2_x1_5, shufflenet_v2_x2_0,
+)
+from .googlenet import GoogLeNet, googlenet
+from .inceptionv3 import InceptionV3, inception_v3
 
 __all__ = [
     "LeNet", "ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
     "resnet152", "BasicBlock", "BottleneckBlock", "VGG", "vgg11", "vgg13",
     "vgg16", "vgg19", "MobileNetV1", "MobileNetV2", "mobilenet_v1",
-    "mobilenet_v2", "AlexNet", "alexnet",
+    "mobilenet_v2", "MobileNetV3Small", "MobileNetV3Large",
+    "mobilenet_v3_small", "mobilenet_v3_large", "AlexNet", "alexnet",
+    "SqueezeNet", "squeezenet1_0", "squeezenet1_1",
+    "DenseNet", "densenet121", "densenet161", "densenet169", "densenet201",
+    "densenet264",
+    "ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+    "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+    "shufflenet_v2_x2_0",
+    "GoogLeNet", "googlenet", "InceptionV3", "inception_v3",
 ]

@@ -1,0 +1,84 @@
+"""GoogLeNet / Inception v1 (reference:
+python/paddle/vision/models/googlenet.py)."""
+from ... import nn
+
+__all__ = ["GoogLeNet", "googlenet"]
+
+
+class _ConvBN(nn.Layer):
+    def __init__(self, in_ch, out_ch, k, stride=1, padding=0):
+        super().__init__()
+        self.conv = nn.Conv2D(in_ch, out_ch, k, stride=stride,
+                              padding=padding, bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_ch)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))
+
+
+class _Inception(nn.Layer):
+    def __init__(self, in_ch, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = _ConvBN(in_ch, c1, 1)
+        self.b2 = nn.Sequential(_ConvBN(in_ch, c3r, 1), _ConvBN(c3r, c3, 3, padding=1))
+        self.b3 = nn.Sequential(_ConvBN(in_ch, c5r, 1), _ConvBN(c5r, c5, 5, padding=2))
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, stride=1, padding=1),
+                                _ConvBN(in_ch, proj, 1))
+
+    def forward(self, x):
+        from ... import concat
+
+        return concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)], axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    """Returns (main_out, aux1, aux2) in train mode like the reference;
+    aux heads are identity-pooled classifiers."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = nn.Sequential(
+            _ConvBN(3, 64, 7, stride=2, padding=3),
+            nn.MaxPool2D(3, stride=2, padding=1),
+            _ConvBN(64, 64, 1),
+            _ConvBN(64, 192, 3, padding=1),
+            nn.MaxPool2D(3, stride=2, padding=1),
+        )
+        self.inc3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.inc3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.inc4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.inc4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.inc4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.inc4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.inc4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.inc5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.inc5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.dropout = nn.Dropout(0.4)
+        self.fc = nn.Linear(1024, num_classes)
+        # aux classifiers (train-mode extra outputs, reference contract)
+        self.aux1 = nn.Sequential(nn.AdaptiveAvgPool2D(4), nn.Flatten(),
+                                  nn.Linear(512 * 16, num_classes))
+        self.aux2 = nn.Sequential(nn.AdaptiveAvgPool2D(4), nn.Flatten(),
+                                  nn.Linear(528 * 16, num_classes))
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.inc3b(self.inc3a(x)))
+        x = self.inc4a(x)
+        a1 = self.aux1(x) if self.training else None
+        x = self.inc4d(self.inc4c(self.inc4b(x)))
+        a2 = self.aux2(x) if self.training else None
+        x = self.pool4(self.inc4e(x))
+        x = self.inc5b(self.inc5a(x))
+        out = self.fc(self.dropout(self.pool(x)).flatten(start_axis=1))
+        if self.training:
+            return out, a1, a2
+        return out
+
+
+def googlenet(pretrained=False, **kwargs):
+    return GoogLeNet(**kwargs)

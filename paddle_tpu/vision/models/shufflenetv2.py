@@ -1,0 +1,117 @@
+"""ShuffleNetV2 (reference: python/paddle/vision/models/shufflenetv2.py)."""
+from ... import nn
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+           "shufflenet_v2_x2_0"]
+
+_STAGE_OUT = {
+    0.25: (24, 24, 48, 96, 512),
+    0.33: (24, 32, 64, 128, 512),
+    0.5: (24, 48, 96, 192, 1024),
+    1.0: (24, 116, 232, 464, 1024),
+    1.5: (24, 176, 352, 704, 1024),
+    2.0: (24, 244, 488, 976, 2048),
+}
+
+
+def _channel_shuffle(x, groups):
+    import paddle_tpu.nn.functional as F
+
+    return F.channel_shuffle(x, groups)
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, in_ch, out_ch, stride):
+        super().__init__()
+        self.stride = stride
+        branch = out_ch // 2
+        if stride == 1:
+            self.branch2 = nn.Sequential(
+                nn.Conv2D(in_ch // 2, branch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch), nn.ReLU(),
+                nn.Conv2D(branch, branch, 3, stride=1, padding=1,
+                          groups=branch, bias_attr=False),
+                nn.BatchNorm2D(branch),
+                nn.Conv2D(branch, branch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch), nn.ReLU(),
+            )
+            self.branch1 = None
+        else:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(in_ch, in_ch, 3, stride=stride, padding=1,
+                          groups=in_ch, bias_attr=False),
+                nn.BatchNorm2D(in_ch),
+                nn.Conv2D(in_ch, branch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch), nn.ReLU(),
+            )
+            self.branch2 = nn.Sequential(
+                nn.Conv2D(in_ch, branch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch), nn.ReLU(),
+                nn.Conv2D(branch, branch, 3, stride=stride, padding=1,
+                          groups=branch, bias_attr=False),
+                nn.BatchNorm2D(branch),
+                nn.Conv2D(branch, branch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch), nn.ReLU(),
+            )
+
+    def forward(self, x):
+        from ... import chunk, concat
+
+        if self.stride == 1:
+            x1, x2 = chunk(x, 2, axis=1)
+            out = concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000, with_pool=True):
+        super().__init__()
+        if scale not in _STAGE_OUT:
+            raise ValueError(f"scale must be one of {sorted(_STAGE_OUT)}")
+        chs = _STAGE_OUT[scale]
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, chs[0], 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(chs[0]), nn.ReLU(),
+        )
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        in_ch = chs[0]
+        for out_ch, repeats in zip(chs[1:4], (4, 8, 4)):
+            stages.append(_InvertedResidual(in_ch, out_ch, 2))
+            for _ in range(repeats - 1):
+                stages.append(_InvertedResidual(out_ch, out_ch, 1))
+            in_ch = out_ch
+        self.stages = nn.Sequential(*stages)
+        self.conv_last = nn.Sequential(
+            nn.Conv2D(in_ch, chs[4], 1, bias_attr=False),
+            nn.BatchNorm2D(chs[4]), nn.ReLU(),
+        )
+        self.pool = nn.AdaptiveAvgPool2D(1) if with_pool else None
+        self.fc = nn.Linear(chs[4], num_classes) if num_classes > 0 else None
+
+    def forward(self, x):
+        x = self.conv_last(self.stages(self.maxpool(self.conv1(x))))
+        if self.pool is not None:
+            x = self.pool(x)
+        if self.fc is not None:
+            x = self.fc(x.flatten(start_axis=1))
+        return x
+
+
+def _make(scale, name):
+    def builder(pretrained=False, **kwargs):
+        return ShuffleNetV2(scale=scale, **kwargs)
+
+    builder.__name__ = name
+    return builder
+
+
+shufflenet_v2_x0_25 = _make(0.25, "shufflenet_v2_x0_25")
+shufflenet_v2_x0_33 = _make(0.33, "shufflenet_v2_x0_33")
+shufflenet_v2_x0_5 = _make(0.5, "shufflenet_v2_x0_5")
+shufflenet_v2_x1_0 = _make(1.0, "shufflenet_v2_x1_0")
+shufflenet_v2_x1_5 = _make(1.5, "shufflenet_v2_x1_5")
+shufflenet_v2_x2_0 = _make(2.0, "shufflenet_v2_x2_0")

@@ -1,0 +1,82 @@
+"""incubate: ASP 2:4 sparsity + fused transformer stack (reference:
+python/paddle/incubate/asp/asp.py, incubate/nn/layer/fused_transformer.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.incubate import asp
+from paddle_tpu.incubate.nn import FusedMultiTransformer
+
+
+def test_create_mask_and_check():
+    r = np.random.RandomState(0)
+    w = r.randn(8, 16).astype("float32")
+    mask = asp.create_mask(paddle.to_tensor(w))
+    assert mask.shape == w.shape
+    # every group of 4 has exactly 2 survivors
+    g = mask.reshape(-1, 4)
+    np.testing.assert_array_equal(g.sum(1), np.full(len(g), 2.0))
+    # the survivors are the 2 largest |w| in each group
+    wg = np.abs(w.reshape(-1, 4))
+    for i in range(len(g)):
+        kept = set(np.nonzero(g[i])[0])
+        top2 = set(np.argsort(-wg[i])[:2])
+        assert kept == top2
+    assert asp.check_sparsity(paddle.to_tensor(w * mask))
+    assert not asp.check_sparsity(paddle.to_tensor(w + 1.0))
+
+
+def test_prune_model_and_decorated_training_keeps_sparsity():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    densities = asp.prune_model(net)
+    assert densities  # something was pruned
+    for _, p in net.named_parameters():
+        if p.ndim >= 2:
+            assert abs(asp.calculate_density(p) - 0.5) < 1e-6
+
+    optim = asp.decorate(opt.Adam(1e-2, parameters=net.parameters()))
+    r = np.random.RandomState(1)
+    x = paddle.to_tensor(r.randn(32, 16).astype("float32"))
+    y = paddle.to_tensor(r.randint(0, 4, 32).astype("int64"))
+    for _ in range(5):
+        loss = nn.functional.cross_entropy(net(x), y)
+        loss.backward()
+        optim.step()
+        optim.clear_grad()
+    # sparsity survives optimizer updates
+    for _, p in net.named_parameters():
+        if p.ndim >= 2:
+            assert asp.check_sparsity(p), "mask lost after step"
+    asp.reset_excluded_layers()
+    asp._masks.clear()
+
+
+def test_excluded_layers():
+    paddle.seed(1)
+    net = nn.Sequential(nn.Linear(8, 8))
+    name = next(n for n, _ in net.named_parameters() if "w" in n or True)
+    asp.set_excluded_layers([name])
+    pruned = asp.prune_model(net)
+    assert name not in pruned
+    asp.reset_excluded_layers()
+    asp._masks.clear()
+
+
+def test_fused_multi_transformer_trains():
+    paddle.seed(2)
+    m = FusedMultiTransformer(32, 4, 64, num_layers=2)
+    optim = opt.Adam(1e-3, parameters=m.parameters())
+    r = np.random.RandomState(2)
+    x = paddle.to_tensor(r.randn(2, 8, 32).astype("float32"))
+    target = paddle.to_tensor(r.randn(2, 8, 32).astype("float32"))
+    losses = []
+    for _ in range(8):
+        loss = ((m(x) - target) ** 2).mean()
+        loss.backward()
+        optim.step()
+        optim.clear_grad()
+        losses.append(float(loss.item()))
+    assert losses[-1] < losses[0]

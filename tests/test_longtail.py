@@ -1,0 +1,99 @@
+"""Long-tail API surface: hub, sysconfig, cost model, cpp_extension custom
+ops (reference: hapi/hub.py, sysconfig.py, cost_model/, utils/cpp_extension)."""
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils import cpp_extension
+
+
+def test_hub_local(tmp_path):
+    (tmp_path / "hubconf.py").write_text(textwrap.dedent("""
+        def toy_model(width=4):
+            '''A toy model builder.'''
+            import paddle_tpu.nn as nn
+            return nn.Linear(width, 2)
+    """))
+    names = paddle.hub.list(str(tmp_path), source="local")
+    assert "toy_model" in names
+    assert "toy" in paddle.hub.help(str(tmp_path), "toy_model")
+    m = paddle.hub.load(str(tmp_path), "toy_model", width=8)
+    assert m.weight.shape == (8, 2)
+    with pytest.raises(ValueError):
+        paddle.hub.list("user/repo", source="github")
+
+
+def test_sysconfig_paths():
+    inc = paddle.sysconfig.get_include()
+    lib = paddle.sysconfig.get_lib()
+    assert os.path.isdir(inc)
+    assert os.path.exists(os.path.join(inc, "tcp_store.cc"))
+    assert inc.endswith("csrc") and lib.endswith("build")
+
+
+def test_cost_model_profile():
+    cm = paddle.CostModel()
+
+    def fn(x, y):
+        return paddle.matmul(x, y).sum()
+
+    r = np.random.RandomState(0)
+    res = cm.profile_measure(fn, r.randn(64, 64).astype("float32"),
+                             r.randn(64, 64).astype("float32"))
+    assert res["wall_time_s"] > 0
+    if "flops" in res:
+        assert res["flops"] > 0
+
+
+def test_onnx_export_guidance():
+    import paddle_tpu.nn as nn
+
+    with pytest.raises((RuntimeError, NotImplementedError)):
+        paddle.onnx.export(nn.Linear(2, 2), "/tmp/x")
+
+
+def test_cpp_extension_custom_op(tmp_path):
+    """Build a real C++ kernel, wrap it as a framework op via host
+    callback, use it inside a jitted computation."""
+    src = tmp_path / "scale_op.cc"
+    src.write_text(textwrap.dedent("""
+        extern "C" void scale_add(const float* x, float* out, long n,
+                                  float scale, float bias) {
+          for (long i = 0; i < n; ++i) out[i] = x[i] * scale + bias;
+        }
+    """))
+    lib = cpp_extension.load("scale_ext", [str(src)])
+
+    import ctypes
+
+    lib.scale_add.argtypes = [
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+        ctypes.c_long, ctypes.c_float, ctypes.c_float]
+
+    def scale_add_np(x, scale=2.0, bias=1.0):
+        x = np.ascontiguousarray(x, np.float32)
+        out = np.empty_like(x)
+        lib.scale_add(x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                      out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                      x.size, scale, bias)
+        return out
+
+    op = cpp_extension.custom_host_op(scale_add_np, name="scale_add")
+    x = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3))
+    out = op(x, scale=3.0, bias=0.5)
+    np.testing.assert_allclose(out.numpy(), x.numpy() * 3.0 + 0.5)
+
+    # inside jit
+    import jax
+
+    def jitted(a):
+        return op(paddle.to_tensor(a) if not hasattr(a, "_data") else a)
+
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda a: op(paddle.Tensor(a))._data)
+    np.testing.assert_allclose(np.asarray(f(jnp.asarray(x.numpy()))),
+                               x.numpy() * 2.0 + 1.0)
