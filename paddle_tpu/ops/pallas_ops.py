@@ -63,7 +63,8 @@ def mha_reference(q, k, v, mask=None, is_causal=False, scale=None):
 # Pallas flash forward
 # ---------------------------------------------------------------------------
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, seq_k, scale, causal, block_q):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, seq_k,
+                      scale, causal, block_q):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(2)
@@ -97,7 +98,81 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, seq_k, scale, caus
     else:
         last_kb = num_kb
     m, l, acc = jax.lax.fori_loop(0, last_kb, body, (m, l, acc))
-    o_ref[0, :, :] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0, :, :] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    # logsumexp per row — the backward kernels rebuild p = exp(s - lse).
+    # lse lives as [BH, 1, S]; each program writes its q-block slice.
+    lse_ref[0, 0, pl.dslice(qi * block_q, block_q)] = m + jnp.log(l_safe)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, block_k, seq_k, scale, causal, block_q):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(2)
+    q = q_ref[0, :, :].astype(jnp.float32)        # [bq, d]
+    do = do_ref[0, :, :].astype(jnp.float32)      # [bq, d]
+    lse = lse_ref[0, 0, pl.dslice(qi * block_q, block_q)]   # [bq]
+    delta = delta_ref[0, 0, pl.dslice(qi * block_q, block_q)]
+    num_kb = seq_k // block_k
+
+    def body(kb, dq):
+        k = k_ref[0, pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
+        s = (q * scale) @ k.T
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = do @ v.T
+        ds = p * (dp - delta[:, None])
+        return dq + ds @ k
+
+    if causal:
+        last_kb = jnp.minimum(((qi + 1) * block_q + block_k - 1) // block_k, num_kb)
+    else:
+        last_kb = num_kb
+    dq = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+    dq = jax.lax.fori_loop(0, last_kb, body, dq)
+    dq_ref[0, :, :] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, block_q, seq_q, scale, causal,
+                          block_k):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(2)
+    k = k_ref[0, :, :].astype(jnp.float32)        # [bk, d]
+    v = v_ref[0, :, :].astype(jnp.float32)
+    num_qb = seq_q // block_q
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.dslice(qb * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.dslice(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.dslice(qb * block_q, block_q)]
+        delta = delta_ref[0, 0, pl.dslice(qb * block_q, block_q)]
+        s = (q * scale) @ k.T                     # [bq, bk]
+        if causal:
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dv = dv + p.T @ do
+        dp = do @ v.T
+        ds = p * (dp - delta[:, None])
+        dk = dk + ds.T @ q
+        return dk, dv
+
+    # causal: only q blocks at/after this k block's diagonal contribute
+    first_qb = (ki * block_k) // block_q if causal else 0
+    dk = jnp.zeros((k.shape[0], k.shape[1]), jnp.float32)
+    dv = jnp.zeros_like(dk)
+    dk, dv = jax.lax.fori_loop(first_qb, num_qb, body, (dk, dv))
+    dk_ref[0, :, :] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0, :, :] = dv.astype(dv_ref.dtype)
 
 
 def _largest_dividing_block(n, preferred=256, minimum=128):
@@ -108,7 +183,7 @@ def _largest_dividing_block(n, preferred=256, minimum=128):
 
 
 def _flash_fwd(q, k, v, is_causal, scale, block_q=256, block_k=256):
-    """q,k,v: [BH, S, D] (heads folded into batch)."""
+    """q,k,v: [BH, S, D] (heads folded into batch) → (out, lse)."""
     from jax.experimental import pallas as pl
 
     bh, sq, d = q.shape
@@ -136,9 +211,70 @@ def _flash_fwd(q, k, v, is_causal, scale, block_q=256, block_k=256):
             pl.BlockSpec((1, sk, d), lambda b, h, i: (b, 0, 0)),
             pl.BlockSpec((1, sk, d), lambda b, h, i: (b, 0, 0)),
         ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, h, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, sq), lambda b, h, i: (b, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
+        ],
+    )(q, k, v)
+
+
+def _flash_bwd(q, k, v, out, lse, do, is_causal, scale,
+               block_q=256, block_k=256):
+    """Blockwise flash backward: recomputes p per tile from (q,k,lse) —
+    no S^2 materialization in HBM. Returns (dq, dk, dv), all [BH, S, D]."""
+    from jax.experimental import pallas as pl
+
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    block_q = _largest_dividing_block(sq, block_q)
+    block_k = _largest_dividing_block(sk, block_k)
+    assert block_q is not None and block_k is not None
+
+    do32 = do.astype(jnp.float32)
+    delta = jnp.sum(do32 * out.astype(jnp.float32), axis=-1)[:, None, :]  # [bh,1,sq]
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_k=block_k, seq_k=sk,
+                          scale=scale, causal=is_causal, block_q=block_q),
+        grid=(bh, 1, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, h, i: (b, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, h, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, h, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, h, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, sq), lambda b, h, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, sq), lambda b, h, i: (b, 0, 0)),
+        ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, h, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-    )(q, k, v)
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q, seq_q=sq,
+                          scale=scale, causal=is_causal, block_k=block_k),
+        grid=(bh, 1, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, sq, d), lambda b, h, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, h, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, h, i: (b, i, 0)),
+            pl.BlockSpec((1, sq, d), lambda b, h, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, sq), lambda b, h, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, sq), lambda b, h, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, h, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, h, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
 
 
 def _pallas_ok(q, k, is_causal, mask) -> bool:
@@ -153,27 +289,45 @@ def _pallas_ok(q, k, is_causal, mask) -> bool:
     return sq == sk
 
 
+def _fold_heads(x):
+    b, s, h, d = x.shape
+    return jnp.moveaxis(x, 2, 1).reshape(b * h, s, d)
+
+
+def _unfold_heads(x, b, h):
+    bh, s, d = x.shape
+    return jnp.moveaxis(x.reshape(b, h, s, d), 1, 2)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _flash_attn_core(q, k, v, is_causal, scale, use_pallas):
     if use_pallas:
         b, s, h, d = q.shape
-        qf = jnp.moveaxis(q, 2, 1).reshape(b * h, s, d)
-        kf = jnp.moveaxis(k, 2, 1).reshape(b * h, k.shape[1], d)
-        vf = jnp.moveaxis(v, 2, 1).reshape(b * h, v.shape[1], d)
-        of = _flash_fwd(qf, kf, vf, is_causal, scale)
-        return jnp.moveaxis(of.reshape(b, h, s, d), 1, 2)
+        of, _ = _flash_fwd(_fold_heads(q), _fold_heads(k), _fold_heads(v),
+                           is_causal, scale)
+        return _unfold_heads(of, b, h)
     return mha_reference(q, k, v, None, is_causal, scale)
 
 
 def _flash_attn_fwd(q, k, v, is_causal, scale, use_pallas):
-    out = _flash_attn_core(q, k, v, is_causal, scale, use_pallas)
-    return out, (q, k, v)
+    if use_pallas:
+        b, s, h, d = q.shape
+        qf, kf, vf = _fold_heads(q), _fold_heads(k), _fold_heads(v)
+        of, lse = _flash_fwd(qf, kf, vf, is_causal, scale)
+        return _unfold_heads(of, b, h), (qf, kf, vf, of, lse, (b, h))
+    out = mha_reference(q, k, v, None, is_causal, scale)
+    return out, (q, k, v, None, None, None)
 
 
 def _flash_attn_bwd(is_causal, scale, use_pallas, res, g):
-    q, k, v = res
-    # Recompute-based backward through the reference formulation (XLA fuses
-    # this well; a dedicated Pallas bwd kernel is a later-round optimization).
+    q, k, v, out, lse, bh_shape = res
+    if use_pallas:
+        b, h = bh_shape
+        dq, dk, dv = _flash_bwd(q, k, v, out, lse, _fold_heads(g),
+                                is_causal, scale)
+        return (_unfold_heads(dq, b, h), _unfold_heads(dk, b, h),
+                _unfold_heads(dv, b, h))
+    # XLA fallback: recompute-based backward through the reference
     _, vjp_fn = jax.vjp(lambda a, b, c: mha_reference(a, b, c, None, is_causal, scale), q, k, v)
     return vjp_fn(g)
 
