@@ -46,12 +46,15 @@ struct Header {
   uint64_t head;       // read offset into data
   uint64_t tail;       // write offset into data
   uint64_t count;      // messages in flight
+  uint64_t abandoned;  // a peer died holding mu: state may be torn
   pthread_mutex_t mu;
   pthread_cond_t not_empty;
   pthread_cond_t not_full;
 };
 
-constexpr uint64_t kMagic = 0x70617474726e6721ull;
+// v2: Header gained `abandoned` before the mutex — the magic doubles as a
+// layout version so an old-layout binary can't attach a new-layout segment.
+constexpr uint64_t kMagic = 0x70617474726e6722ull;
 
 struct Ring {
   Header* hdr;
@@ -85,14 +88,41 @@ timespec deadline_after(int timeout_ms) {
 
 // Wait until signaled or the (absolute) deadline passes. The caller loops
 // on its predicate, so a spurious/late wakeup is re-checked there — the
-// deadline bounds the TOTAL wait, not each wakeup.
-bool timed_wait(pthread_cond_t* cv, pthread_mutex_t* mu, int timeout_ms,
-                const timespec* deadline) {
-  if (timeout_ms <= 0) {
-    pthread_cond_wait(cv, mu);
-    return true;
+// deadline bounds the TOTAL wait, not each wakeup. Returns 0, ETIMEDOUT,
+// or EOWNERDEAD (robust mutex: the owner died while we waited).
+int timed_wait(pthread_cond_t* cv, pthread_mutex_t* mu, int timeout_ms,
+               const timespec* deadline) {
+  if (timeout_ms <= 0) return pthread_cond_wait(cv, mu);
+  return pthread_cond_timedwait(cv, mu, deadline);
+}
+
+// -4: a peer died holding the ring lock (or the ring was already marked
+// abandoned) — head/tail/count may be torn mid-update, so fail fast
+// instead of resuming on corrupt state; ShmQueue surfaces this distinctly.
+constexpr int kErrAbandoned = -4;
+
+// Poison the ring after an EOWNERDEAD observation: make the mutex usable
+// again (required before unlock), flag the segment, wake every waiter so
+// they observe the flag, and release.  Caller must currently own mu.
+int poison_ring(Header* hd) {
+  pthread_mutex_consistent(&hd->mu);
+  hd->abandoned = 1;
+  pthread_cond_broadcast(&hd->not_empty);
+  pthread_cond_broadcast(&hd->not_full);
+  pthread_mutex_unlock(&hd->mu);
+  return kErrAbandoned;
+}
+
+// Robust lock: maps EOWNERDEAD to the poisoned-ring error.
+int lock_ring(Header* hd) {
+  int rc = pthread_mutex_lock(&hd->mu);
+  if (rc == EOWNERDEAD) return poison_ring(hd);
+  if (rc != 0) return kErrAbandoned;
+  if (hd->abandoned) {
+    pthread_mutex_unlock(&hd->mu);
+    return kErrAbandoned;
   }
-  return pthread_cond_timedwait(cv, mu, deadline) != ETIMEDOUT;
+  return 0;
 }
 
 int64_t register_ring(Ring* r) {
@@ -135,7 +165,9 @@ int64_t shm_ring_create(const char* name, int64_t capacity) {
   pthread_mutexattr_t ma;
   pthread_mutexattr_init(&ma);
   pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
-#ifdef PTHREAD_MUTEX_ROBUST
+#ifdef __linux__
+  // PTHREAD_MUTEX_ROBUST is an enum on glibc (not a macro), so feature-test
+  // on the platform rather than `#ifdef PTHREAD_MUTEX_ROBUST`.
   pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
 #endif
   pthread_mutex_init(&hdr->mu, &ma);
@@ -194,7 +226,7 @@ int shm_ring_push(int64_t h, const uint8_t* data, int64_t len, int timeout_ms) {
   if (need + 8 >= hd->capacity) return -3;  // message can never fit
   timespec deadline = deadline_after(timeout_ms);
   bool timed_out = false;
-  pthread_mutex_lock(&hd->mu);
+  if (int rc = lock_ring(hd)) return rc;
   while (true) {
     // empty ring: rewind to offset 0 so a large message never deadlocks on
     // wasted wrap space (the tail skip counts against capacity otherwise)
@@ -224,7 +256,13 @@ int shm_ring_push(int64_t h, const uint8_t* data, int64_t len, int timeout_ms) {
       pthread_mutex_unlock(&hd->mu);
       return -1;
     }
-    timed_out = !timed_wait(&hd->not_full, &hd->mu, timeout_ms, &deadline);
+    int wrc = timed_wait(&hd->not_full, &hd->mu, timeout_ms, &deadline);
+    if (wrc == EOWNERDEAD) return poison_ring(hd);
+    if (hd->abandoned) {  // woken by poison_ring's broadcast
+      pthread_mutex_unlock(&hd->mu);
+      return kErrAbandoned;
+    }
+    timed_out = wrc == ETIMEDOUT;
   }
 }
 
@@ -246,13 +284,19 @@ int64_t shm_ring_pop_len(int64_t h, int timeout_ms) {
   Header* hd = r->hdr;
   timespec deadline = deadline_after(timeout_ms);
   bool timed_out = false;
-  pthread_mutex_lock(&hd->mu);
+  if (int rc = lock_ring(hd)) return rc;
   while (hd->count == 0) {
     if (timed_out) {
       pthread_mutex_unlock(&hd->mu);
       return -1;
     }
-    timed_out = !timed_wait(&hd->not_empty, &hd->mu, timeout_ms, &deadline);
+    int wrc = timed_wait(&hd->not_empty, &hd->mu, timeout_ms, &deadline);
+    if (wrc == EOWNERDEAD) return static_cast<int64_t>(poison_ring(hd));
+    if (hd->abandoned) {  // woken by poison_ring's broadcast
+      pthread_mutex_unlock(&hd->mu);
+      return kErrAbandoned;
+    }
+    timed_out = wrc == ETIMEDOUT;
   }
   skip_wrap(r);
   uint64_t n;
@@ -265,7 +309,7 @@ int64_t shm_ring_pop(int64_t h, uint8_t* buf, int64_t cap) {
   Ring* r = get(h);
   if (!r) return -2;
   Header* hd = r->hdr;
-  pthread_mutex_lock(&hd->mu);
+  if (int rc = lock_ring(hd)) return rc;
   if (hd->count == 0) {
     pthread_mutex_unlock(&hd->mu);
     return -1;

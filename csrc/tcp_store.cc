@@ -72,9 +72,15 @@ bool write_blob(int fd, const std::string& s) {
          (s.empty() || write_full(fd, s.data(), s.size()));
 }
 
+// Rendezvous values are small (ranks, endpoints, pickled metadata); an
+// unauthenticated peer must not be able to make the server resize() up to
+// 4 GiB per request, so oversized frames drop the connection.
+constexpr uint32_t kMaxBlobLen = 64u << 20;  // 64 MiB
+
 bool read_blob(int fd, std::string* s) {
   uint32_t n;
   if (!read_u32(fd, &n)) return false;
+  if (n > kMaxBlobLen) return false;
   s->resize(n);
   return n == 0 || read_full(fd, &(*s)[0], n);
 }

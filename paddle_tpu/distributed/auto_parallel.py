@@ -143,16 +143,24 @@ class Engine:
         self._compiled = jit.compile(step, models=(model,), optimizers=(opt,))
         return self._compiled
 
+    @staticmethod
+    def _as_loader(data, batch_size, collate_fn, **kw):
+        """Wrap map-style data (``__getitem__``/``__len__`` without
+        ``__iter__``) in a DataLoader — whether or not it subclasses
+        io.Dataset. A bare map-style object iterated directly would hit
+        Python's legacy ``__getitem__`` iteration, which never terminates
+        when indexing past the end doesn't raise IndexError."""
+        from ..io import DataLoader
+
+        if hasattr(data, "__iter__"):
+            return data
+        return DataLoader(data, batch_size=batch_size, collate_fn=collate_fn,
+                          **kw)
+
     def fit(self, train_data, epochs=1, batch_size=1, steps_per_epoch=None,
             log_freq=10, verbose=0, collate_fn=None):
-        from ..io import DataLoader, Dataset
-
-        if isinstance(train_data, Dataset):
-            loader = DataLoader(train_data, batch_size=batch_size,
-                                shuffle=True, drop_last=True,
-                                collate_fn=collate_fn)
-        else:
-            loader = train_data
+        loader = self._as_loader(train_data, batch_size, collate_fn,
+                                 shuffle=True, drop_last=True)
         if self._compiled is None:
             self.prepare()
         history = []
@@ -171,10 +179,8 @@ class Engine:
 
     def evaluate(self, eval_data, batch_size=1, collate_fn=None):
         from ..autograd import no_grad
-        from ..io import DataLoader, Dataset
 
-        loader = (DataLoader(eval_data, batch_size=batch_size, collate_fn=collate_fn)
-                  if isinstance(eval_data, Dataset) else eval_data)
+        loader = self._as_loader(eval_data, batch_size, collate_fn)
         losses = []
         with no_grad():
             for batch in loader:
@@ -185,16 +191,18 @@ class Engine:
 
     def predict(self, test_data, batch_size=1, collate_fn=None):
         from ..autograd import no_grad
-        from ..io import DataLoader, Dataset
 
-        loader = (DataLoader(test_data, batch_size=batch_size, collate_fn=collate_fn)
-                  if isinstance(test_data, Dataset) else test_data)
+        loader = self._as_loader(test_data, batch_size, collate_fn)
         outs = []
         with no_grad():
             for batch in loader:
                 batch = list(batch) if isinstance(batch, (list, tuple)) else [batch]
-                outs.append(self._model(*batch).numpy()
-                            if not isinstance(batch[0], list) else None)
+                # Datasets yield (input, label) pairs for prediction too:
+                # feed only the inputs (hapi Model._split_batch semantics —
+                # with a loss configured the last element is the label).
+                inputs = batch[:-1] if self._loss is not None and len(batch) > 1 \
+                    else batch
+                outs.append(self._model(*inputs).numpy())
         return outs
 
     def save(self, path, training=True):

@@ -45,8 +45,12 @@ class _PyHandler(socketserver.BaseRequestHandler):
             buf += chunk
         return buf
 
+    MAX_BLOB = 64 << 20  # mirror csrc/tcp_store.cc kMaxBlobLen
+
     def _read_blob(self):
         (n,) = struct.unpack("<I", self._read(4))
+        if n > self.MAX_BLOB:
+            raise ConnectionError(f"oversized frame ({n} bytes)")
         return self._read(n) if n else b""
 
     def _write_blob(self, b):
@@ -184,7 +188,7 @@ class TCPStore:
         self.port = port
         self.is_master = is_master
         self.world_size = world_size  # default participant count for barrier()
-        self._barrier_rounds = {}
+        self._barrier_added = {}      # name -> round this client counted in
         self._native = native.load()
         self._srv = None
         self._py_srv = None
@@ -276,11 +280,29 @@ class TCPStore:
         is a new round (locally tracked round id keys the counter), and the
         release check is >= so a stray over-count can't hang everyone."""
         world_size = world_size if world_size is not None else self.world_size
-        rnd = self._barrier_rounds.get(name, 0)
-        self._barrier_rounds[name] = rnd + 1
+        # Round id lives in the store (add(.., 0) reads the counter), not in
+        # this client object: a participant that reconnects with a fresh
+        # TCPStore (elastic rejoin) must join the *current* round, not
+        # replay round 0 whose done key still exists.
+        rkey = f"__barrier__/{name}/round"
+        rnd = self.add(rkey, 0)
         key = f"__barrier__/{name}/{rnd}"
-        arrived = self.add(key, 1)
+        # A client whose wait() timed out and retries the same round must
+        # not count itself twice (it would release a later round early).
+        # Note the barrier is anonymous counting — like the reference's —
+        # so a NON-participant calling barrier() still breaks it; rounds in
+        # the store only guarantee that legitimate reconnects (elastic
+        # rejoin) land on the current round.
+        if self._barrier_added.get(name) == rnd:
+            arrived = self.add(key, 0)
+        else:
+            arrived = self.add(key, 1)
+            self._barrier_added[name] = rnd
         if arrived >= world_size:
+            # Advance the round before releasing waiters, so every client's
+            # next barrier() (ordered after wait() below) reads rnd+1.
+            # set() is idempotent under the >= over-count race.
+            self.set(rkey, struct.pack("<q", rnd + 1))
             self.set(f"{key}/done", b"1")
         self.wait(f"{key}/done", timeout_ms)
 

@@ -363,10 +363,24 @@ class DataLoader:
     def _dataset_yields_tensors(self):
         """Forked workers must not touch device arrays (XLA runtime state is
         not fork-safe) — datasets returning framework Tensors stay on the
-        thread-prefetch path."""
+        thread-prefetch path. The `dataset[0]` probe is cached (it may be
+        expensive or side-effecting) and only indexing-type errors fall
+        back to the fork path."""
+        cached = getattr(self, "_yields_tensors_cache", None)
+        if cached is not None:
+            return cached
         try:
             sample = self.dataset[0]
-        except Exception:
+        except (IndexError, KeyError, TypeError):
+            self._yields_tensors_cache = False
+            return False
+        except Exception as e:  # unexpected probe failure: warn, use fork path
+            import warnings
+
+            warnings.warn(
+                f"dataset[0] probe raised {type(e).__name__}: {e}; assuming "
+                "the dataset does not yield framework Tensors")
+            self._yields_tensors_cache = False
             return False
 
         def has_tensor(x):
@@ -378,7 +392,8 @@ class DataLoader:
                 return any(has_tensor(v) for v in x.values())
             return False
 
-        return has_tensor(sample)
+        self._yields_tensors_cache = has_tensor(sample)
+        return self._yields_tensors_cache
 
     def _iter_multiprocess(self):
         """True multiprocess workers over the native shm ring transport

@@ -115,6 +115,9 @@ class ShmQueue:
             raise ValueError(
                 f"batch of {len(data)} bytes exceeds ring capacity; raise "
                 f"DataLoader(shm_capacity=...)")
+        if rc == -4:
+            raise BrokenPipeError(
+                "shm ring abandoned: a peer died holding the ring lock")
         if rc != 0:
             raise OSError(f"shm push failed ({rc})")
 
@@ -122,10 +125,16 @@ class ShmQueue:
         n = self._lib.shm_ring_pop_len(self._h, timeout_ms)
         if n == -1:
             raise TimeoutError("shm pop timed out")
+        if n == -4:
+            raise BrokenPipeError(
+                "shm ring abandoned: a peer died holding the ring lock")
         if n < 0:
             raise OSError(f"shm pop failed ({n})")
         buf = ctypes.create_string_buffer(int(n))
         got = self._lib.shm_ring_pop(self._h, buf, n)
+        if got == -4:
+            raise BrokenPipeError(
+                "shm ring abandoned: a peer died holding the ring lock")
         if got < 0:
             raise OSError(f"shm pop failed ({got})")
         return _unpack(memoryview(buf)[:got])
