@@ -113,25 +113,71 @@ class FusedMultiTransformer(Layer):
         self.layers = nn_container.LayerList(layers)
         self.dropout = Dropout(dropout_rate)
 
+    @staticmethod
+    def _cached_attn(q, k, v, cache, t, mask=None):
+        """Array-level CacheKV attention. cache: [2, B, H, S_max, D]
+        (reference layout, fused_multi_transformer_op.cu:90); q/k/v:
+        [B, S, H, D]; t = real current length of the cache (the chunk is
+        written starting at t); mask broadcastable to [B, H, S, S_max].
+        Returns (out, new_cache)."""
+        from ...ops.pallas_ops import cached_attention_arrays
+
+        kc = jnp.moveaxis(cache[0], 1, 2)        # -> [B, S_max, H, D]
+        vc = jnp.moveaxis(cache[1], 1, 2)
+        o, kc, vc = cached_attention_arrays(q, k, v, kc, vc, t, mask=mask)
+        new_cache = jnp.stack(
+            [jnp.moveaxis(kc, 2, 1), jnp.moveaxis(vc, 2, 1)])
+        return o, new_cache
+
+    def gen_cache(self, batch_size, max_length, dtype="float32"):
+        """Allocate per-layer CacheKV tensors, reference layout
+        [2, bsz, num_head, max_seq_len, head_dim]."""
+        from ...core.tensor import Tensor
+
+        shape = (2, batch_size, self.num_heads, max_length, self.head_dim)
+        return [Tensor(jnp.zeros(shape, dtype)) for _ in range(self.num_layers)]
+
     def forward(self, src, attn_mask=None, caches=None, time_step=None):
+        from ...core.dispatch import apply
         from ...ops.pallas_ops import flash_attention
 
-        if caches is not None or time_step is not None:
-            raise NotImplementedError(
-                "FusedMultiTransformer: KV-cache incremental decoding is not "
-                "implemented yet — run full-sequence forward instead")
+        if caches is not None and len(caches) != self.num_layers:
+            raise ValueError(
+                f"caches must have one [2,B,H,S,D] tensor per layer "
+                f"({self.num_layers}), got {len(caches)}")
 
         x = src
         B = None
-        for blk in self.layers:
+        new_caches = []
+        act = F.gelu if self.activation == "gelu" else F.relu
+        for li, blk in enumerate(self.layers):
             h = blk["ln1"](x)
             qkv = blk["qkv"](h)
             if B is None:
                 B, S, _ = qkv.shape
             q, k, v = qkv.reshape([B, S, 3, self.num_heads, self.head_dim]).unbind(axis=2)
-            attn = flash_attention(q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None)
+            if caches is not None:
+                t = 0 if time_step is None else time_step
+                if attn_mask is not None:
+                    # mask applies over cache positions: [B, H|1, S, S_max]
+                    attn, new_cache = apply(
+                        self._cached_attn, q, k, v, caches[li], t, attn_mask,
+                        name="fused_cached_attention")
+                else:
+                    attn, new_cache = apply(
+                        self._cached_attn, q, k, v, caches[li], t,
+                        name="fused_cached_attention")
+                # reference CacheKV is written in place by the fused op;
+                # mirror that for eager callers while also returning the
+                # updated caches for functional (traced) use
+                caches[li]._data = new_cache._data
+                new_caches.append(new_cache)
+            else:
+                attn = flash_attention(q, k, v, attn_mask=attn_mask,
+                                       is_causal=attn_mask is None)
             x = x + self.dropout(blk["out"](attn.reshape([B, S, -1])))
             h = blk["ln2"](x)
-            act = F.gelu if self.activation == "gelu" else F.relu
             x = x + self.dropout(blk["ffn2"](act(blk["ffn1"](h))))
+        if caches is not None:
+            return x, new_caches
         return x

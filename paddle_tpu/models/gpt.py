@@ -21,6 +21,7 @@ TPU-native design:
 from __future__ import annotations
 
 import dataclasses
+from functools import partial as functools_partial
 from typing import Optional
 
 import jax
@@ -33,7 +34,7 @@ from ..nn import functional as F
 from ..nn.initializer import Normal, Constant
 from ..nn.norm import LayerNorm
 from ..nn.common import Linear, Dropout, Embedding
-from ..ops.pallas_ops import flash_attention
+from ..ops.pallas_ops import cached_attention_arrays, flash_attention
 from ..parallel import (
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
     ParallelCrossEntropy, constraint, shard_parameter,
@@ -69,6 +70,7 @@ class GPTConfig:
     moe_every_n: int = 0
     moe_num_experts: int = 0
     moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
     # stacked blocks: one [L, ...] weight per tensor, scan/pipeline executed
     # (enables pp>1; also O(1)-in-depth compile time)
     stacked_blocks: bool = False
@@ -171,13 +173,28 @@ class GPTAttention(Layer):
                 stacklevel=3,
             )
 
-    def forward(self, x):
+    def forward(self, x, cache=None, time_step=None):
         from ..parallel.mesh import axis_size
         from ..parallel.ring import ring_attention
 
         b, s, h = x.shape
         qkv = self.qkv_proj(x)                       # [b, s, 3h] mp-sharded last dim
         qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
+        if cache is not None:
+            # KV-cache prefill/decode (reference CacheKV semantics:
+            # fused_multi_transformer_op.cu:90): write this chunk at
+            # position `time_step`, attend causally over the cache.
+            qkv = constraint(qkv, ["dp", None, None, "mp", None])
+            q, k, v = qkv.unbind(axis=2)
+            k_cache, v_cache = cache
+            o, kc, vc = apply(
+                cached_attention_arrays, q, k, v, k_cache, v_cache,
+                0 if time_step is None else time_step,
+                name="cached_attention",
+            )
+            o = constraint(o, ["dp", None, "mp", None])
+            o = o.reshape([b, s, h])
+            return self.out_proj(o), (kc, vc)
         use_ring = (
             self.cfg.context_parallel
             and axis_size("sp") > 1
@@ -219,18 +236,22 @@ class GPTMLP(Layer):
 class GPTMoEMLP(Layer):
     """Mixture-of-experts FFN (reference:
     incubate/distributed/models/moe/moe_layer.py:260 — gate -> global_scatter
-    alltoall -> experts -> global_gather).
+    alltoall -> experts -> global_gather; collective ops
+    global_scatter_op.cu.cc / global_gather_op.cu.cc).
 
-    TPU-native: experts live in ONE stacked weight with the expert dim
-    annotated 'ep'; token dispatch is a dense einsum against the gate's
-    one-hot combine weights, and GSPMD derives the all-to-all from the
-    (tokens sharded over dp/sp) x (experts sharded over ep) contraction.
+    TPU-native: top-k capacity-factor routing with one-hot dispatch/combine
+    einsums; under ep>1 the token batch is sharded over 'ep' in shard_map
+    and the dispatch/return are ONE lax.all_to_all each (parallel/moe.py).
+    Per-token expert FLOPs are k*cf*H*M — independent of num_experts.
+    The GShard load-balance aux loss of the last forward is exposed as
+    `self.aux_loss`.
     """
 
     def __init__(self, cfg: GPTConfig):
         super().__init__()
         self.num_experts = cfg.moe_num_experts
         self.top_k = cfg.moe_top_k
+        self.capacity_factor = cfg.moe_capacity_factor
         h, m = cfg.hidden_size, cfg.intermediate_size
         self.gate = Linear(h, self.num_experts)
         self.w_in = self.create_parameter(
@@ -243,30 +264,43 @@ class GPTMoEMLP(Layer):
         )
         shard_parameter(self.w_in, ("ep", None, "mp"))
         shard_parameter(self.w_out, ("ep", "mp", None))
+        self.aux_loss = None
 
     def forward(self, x):
-        b, s, h = x.shape
+        from ..parallel.moe import moe_mlp_arrays
+
         logits = self.gate(x)                        # [b, s, E]
+        out, aux = apply(
+            functools_partial(moe_mlp_arrays, top_k=self.top_k,
+                              capacity_factor=self.capacity_factor),
+            x, logits, self.w_in, self.w_out, name="moe_mlp",
+        )
+        self.aux_loss = aux
+        return out
 
-        def moe(xa, gl, w_in, w_out):
-            probs = jax.nn.softmax(gl.astype(jnp.float32), axis=-1)
-            topv, topi = jax.lax.top_k(probs, self.top_k)
-            topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
-            # dense combine weights [b, s, E]
-            comb = jnp.sum(
-                jax.nn.one_hot(topi, self.num_experts, dtype=probs.dtype)
-                * topv[..., None], axis=-2,
-            )
-            # dispatch: every expert sees all tokens, weighted (dense MoE —
-            # compile-friendly; capacity-based sparse dispatch is a Pallas
-            # follow-up). einsum contracts derive ep all-to-alls under GSPMD.
-            hidden = jnp.einsum("bsh,ehm->ebsm", xa, w_in)
-            hidden = jax.nn.gelu(hidden)
-            out = jnp.einsum("ebsm,emh->ebsh", hidden, w_out)
-            out = jnp.einsum("ebsh,bse->bsh", out, comb.astype(out.dtype))
-            return out
 
-        return apply(moe, x, logits, self.w_in, self.w_out, name="moe_mlp")
+def _stacked_ln(h, w, b, eps):
+    """fp32-accumulated LayerNorm on stacked-block activations."""
+    h32 = h.astype(jnp.float32)
+    mu = h32.mean(-1, keepdims=True)
+    var = ((h32 - mu) ** 2).mean(-1, keepdims=True)
+    return ((h32 - mu) * jax.lax.rsqrt(var + eps)).astype(h.dtype) * w + b
+
+
+def _stacked_block_body(p, h, attn_fn, nh, hd, eps):
+    """One pre-LN transformer block over a stacked-weight slice `p`.
+    attn_fn: (q, k, v) [B,S,nh,hd] -> (o, extra); `extra` threads cache
+    state for the decode path (None in training). Single source of truth
+    for the block arithmetic of both GPTStackedBlocks.forward and
+    .forward_cached."""
+    mb, s, H = h.shape
+    hn = _stacked_ln(h, p["ln1_w"], p["ln1_b"], eps)
+    qkv = (hn @ p["qkv_w"] + p["qkv_b"]).reshape(mb, s, 3, nh, hd)
+    o, extra = attn_fn(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
+    h = h + o.reshape(mb, s, H) @ p["out_w"] + p["out_b"]
+    hn = _stacked_ln(h, p["ln2_w"], p["ln2_b"], eps)
+    m = jax.nn.gelu(hn @ p["fc_in_w"] + p["fc_in_b"], approximate=True)
+    return h + m @ p["fc_out_w"] + p["fc_out_b"], extra
 
 
 class GPTStackedBlocks(Layer):
@@ -334,23 +368,13 @@ class GPTStackedBlocks(Layer):
             cfg.context_parallel and axis_size("sp") > 1 and axis_size("pp") <= 1
         )
 
-        def ln(h, w, b):
-            h32 = h.astype(jnp.float32)
-            mu = h32.mean(-1, keepdims=True)
-            var = ((h32 - mu) ** 2).mean(-1, keepdims=True)
-            return ((h32 - mu) * jax.lax.rsqrt(var + eps)).astype(h.dtype) * w + b
+        attn = ring_attention_arrays if use_ring else flash_attention_arrays
 
         def block(p, h):
-            mb, s, H = h.shape
-            hn = ln(h, p["ln1_w"], p["ln1_b"])
-            qkv = hn @ p["qkv_w"] + p["qkv_b"]
-            qkv = qkv.reshape(mb, s, 3, nh, hd)
-            attn = ring_attention_arrays if use_ring else flash_attention_arrays
-            o = attn(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], is_causal=True)
-            h = h + o.reshape(mb, s, H) @ p["out_w"] + p["out_b"]
-            hn = ln(h, p["ln2_w"], p["ln2_b"])
-            m = jax.nn.gelu(hn @ p["fc_in_w"] + p["fc_in_b"], approximate=True)
-            return h + m @ p["fc_out_w"] + p["fc_out_b"]
+            out, _ = _stacked_block_body(
+                p, h, lambda q, k, v: (attn(q, k, v, is_causal=True), None),
+                nh, hd, eps)
+            return out
 
         def fn(a, *flat):
             params = dict(zip(names, flat))
@@ -358,6 +382,40 @@ class GPTStackedBlocks(Layer):
 
         tensors = [getattr(self, n) for n in names]
         return apply(fn, x, *tensors, name="gpt_stacked_blocks")
+
+    def forward_cached(self, x, caches, time_step=None):
+        """KV-cache prefill/decode over the stacked weights: lax.scan over
+        the layer dim with per-layer cache slices threaded as scan xs/ys
+        (one executable regardless of depth). caches = (k [L,B,Smax,H,D],
+        v [L,B,Smax,H,D])."""
+        cfg = self.cfg
+        nh = cfg.num_attention_heads
+        hd = cfg.hidden_size // nh
+        eps = cfg.layer_norm_epsilon
+        names = self._names
+        k_caches, v_caches = caches
+
+        def fn(a, kcs, vcs, t, *flat):
+            params = dict(zip(names, flat))
+
+            def body(h, xs):
+                p, kc, vc = xs
+
+                def attn_fn(q, k, v):
+                    o, kc2, vc2 = cached_attention_arrays(q, k, v, kc, vc, t)
+                    return o, (kc2, vc2)
+
+                h, (kc, vc) = _stacked_block_body(p, h, attn_fn, nh, hd, eps)
+                return h, (kc, vc)
+
+            h, (kcs, vcs) = jax.lax.scan(body, a, (params, kcs, vcs))
+            return h, kcs, vcs
+
+        tensors = [getattr(self, n) for n in names]
+        t = 0 if time_step is None else time_step
+        h, kcs, vcs = apply(fn, x, k_caches, v_caches, t, *tensors,
+                            name="gpt_stacked_blocks_cached")
+        return h, (kcs, vcs)
 
 
 class GPTBlock(Layer):
@@ -375,8 +433,14 @@ class GPTBlock(Layer):
         self.mlp = GPTMoEMLP(cfg) if use_moe else GPTMLP(cfg)
         self.dropout = Dropout(cfg.hidden_dropout_prob)
 
-    def forward(self, x):
+    def forward(self, x, cache=None, time_step=None):
         spec = _act_spec(self.cfg)
+        if cache is not None:
+            a, new_cache = self.attn(
+                self.ln_1(constraint(x, spec)), cache=cache, time_step=time_step)
+            x = x + self.dropout(a)
+            x = x + self.dropout(self.mlp(self.ln_2(constraint(x, spec))))
+            return constraint(x, spec), new_cache
         x = x + self.dropout(self.attn(self.ln_1(constraint(x, spec))))
         x = x + self.dropout(self.mlp(self.ln_2(constraint(x, spec))))
         return constraint(x, spec)
@@ -396,14 +460,53 @@ class GPTModel(Layer):
                 self.add_sublayer(f"h_{i}", blk)
         self.ln_f = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
 
-    def forward(self, input_ids, position_ids=None):
+    def forward(self, input_ids, position_ids=None, caches=None,
+                time_step=None):
+        if caches is not None and position_ids is None:
+            # decode positions are absolute: time_step + [0, s)
+            s = input_ids.shape[-1]
+            t = 0 if time_step is None else time_step
+            base = t._data if isinstance(t, Tensor) else jnp.asarray(t, jnp.int32)
+            position_ids = Tensor(base + jnp.arange(s, dtype=jnp.int32))
         x = self.embeddings(input_ids, position_ids)
+        if caches is not None:
+            if self.cfg.stacked_blocks:
+                x, new_caches = self.blocks.forward_cached(x, caches, time_step)
+            else:
+                new_caches = []
+                for blk, cache in zip(self.h, caches):
+                    x, c = blk(x, cache=cache, time_step=time_step)
+                    new_caches.append(c)
+            return self.ln_f(x), new_caches
         if self.cfg.stacked_blocks:
             x = self.blocks(x)
         else:
             for blk in self.h:
                 x = blk(x)
         return self.ln_f(x)
+
+
+def _sample_next(logits, key, do_sample, temperature, top_k, top_p):
+    """Next-token selection on [B, V] fp32 logits: greedy argmax, or
+    temperature / top-k / nucleus (top-p) sampling."""
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / max(float(temperature), 1e-6)
+    if top_k and top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -int(top_k)][:, None]
+        logits = jnp.where(logits < kth, _NEG_INF, logits)
+    if top_p is not None and top_p < 1.0:
+        sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = cum - probs <= top_p        # first token always kept
+        thresh = jnp.min(jnp.where(keep, sorted_desc, jnp.inf), axis=-1,
+                         keepdims=True)
+        logits = jnp.where(logits < thresh, _NEG_INF, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+_NEG_INF = -1e30
 
 
 class GPTForCausalLM(Layer):
@@ -413,16 +516,132 @@ class GPTForCausalLM(Layer):
         super().__init__()
         self.cfg = cfg
         self.gpt = GPTModel(cfg)
+        self._gen_step = None       # (shapes key, jitted fn) decode cache
 
-    def forward(self, input_ids, position_ids=None):
-        x = self.gpt(input_ids, position_ids)
+    def forward(self, input_ids, position_ids=None, caches=None,
+                time_step=None):
+        if caches is not None:
+            x, new_caches = self.gpt(input_ids, position_ids, caches=caches,
+                                     time_step=time_step)
+        else:
+            x = self.gpt(input_ids, position_ids)
         w = self.gpt.embeddings.word_embeddings.weight
         logits = apply(
             lambda a, wt: jnp.einsum("bsh,vh->bsv", a, wt), x, w,
             name="lm_head",
         )
         # logits vocab dim carries the mp shard (parallel cross-entropy eats it)
-        return constraint(logits, ["dp", "sp" if self.cfg.sequence_parallel else None, "mp"])
+        logits = constraint(
+            logits, ["dp", "sp" if self.cfg.sequence_parallel else None, "mp"])
+        if caches is not None:
+            return logits, new_caches
+        return logits
+
+    # -- autoregressive decoding -------------------------------------------
+    def init_caches(self, batch_size, max_length, dtype=None):
+        """Allocate static-shape KV caches (reference CacheKV:
+        fused_multi_transformer_op.cu:90 — [2, B, H, S_max, D] per layer;
+        here [B, S_max, H, D] matching the flash-attention layout)."""
+        cfg = self.cfg
+        nh = cfg.num_attention_heads
+        hd = cfg.hidden_size // nh
+        if dtype is None:
+            dtype = self.gpt.embeddings.word_embeddings.weight.dtype
+        shape = (batch_size, max_length, nh, hd)
+        if cfg.stacked_blocks:
+            full = (cfg.num_hidden_layers,) + shape
+            return (Tensor(jnp.zeros(full, dtype)), Tensor(jnp.zeros(full, dtype)))
+        return [
+            (Tensor(jnp.zeros(shape, dtype)), Tensor(jnp.zeros(shape, dtype)))
+            for _ in range(cfg.num_hidden_layers)
+        ]
+
+    def generate(self, input_ids, max_new_tokens=32, do_sample=False,
+                 temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
+                 seed=None):
+        """KV-cache autoregressive decoding: one compiled prefill program +
+        ONE compiled decode program reused for every position (static cache
+        shapes; lax.dynamic_update_slice ring writes). Greedy by default;
+        temperature / top-k / top-p sampling with do_sample=True.
+
+        Returns [B, prompt + generated] int32 ids (generation stops early
+        when every row has emitted eos_token_id).
+        """
+        from ..autograd import tape as _tape
+        from ..core import random as _rng
+
+        cfg = self.cfg
+        ids = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
+        ids = ids.astype(jnp.int32)
+        if ids.ndim == 1:
+            ids = ids[None]
+        B, P = ids.shape
+        total = P + max_new_tokens
+        if total > cfg.max_position_embeddings:
+            raise ValueError(
+                f"prompt ({P}) + max_new_tokens ({max_new_tokens}) exceeds "
+                f"max_position_embeddings ({cfg.max_position_embeddings})")
+
+        model = self
+        was_training = self.training
+        self.eval()
+
+        def run(params, bufs, chunk, caches, t):
+            backup = model.state_arrays()
+            try:
+                model.load_state_arrays(params, bufs)
+                with _tape.no_grad():
+                    logits, new_caches = model(
+                        Tensor(chunk),
+                        caches=jax.tree.map(Tensor, caches),
+                        time_step=Tensor(t),
+                    )
+                last = logits._data[:, -1].astype(jnp.float32)
+                return last, jax.tree.map(lambda c: c._data, new_caches,
+                                          is_leaf=lambda c: isinstance(c, Tensor))
+            finally:
+                model.load_state_arrays(*backup)
+
+        key_shape = (B, P, total, cfg.stacked_blocks)
+        if self._gen_step is None or self._gen_step[0] != key_shape:
+            self._gen_step = (key_shape, jax.jit(run, donate_argnums=(3,)))
+        step = self._gen_step[1]
+
+        params, bufs = self.state_arrays()
+        caches = self.init_caches(B, total)
+        cache_arrs = jax.tree.map(
+            lambda c: c._data, caches, is_leaf=lambda c: isinstance(c, Tensor))
+
+        key = (jax.random.PRNGKey(seed) if seed is not None
+               else _rng.next_key()) if do_sample else None
+
+        logits, cache_arrs = step(params, bufs, ids, cache_arrs,
+                                  jnp.asarray(0, jnp.int32))
+        out_tokens = []
+        finished = jnp.zeros((B,), bool)
+        next_tok = None
+        for i in range(max_new_tokens):
+            if do_sample:
+                key, sub = jax.random.split(key)
+            else:
+                sub = None
+            next_tok = _sample_next(logits, sub, do_sample, temperature,
+                                    top_k, top_p)
+            if eos_token_id is not None:
+                next_tok = jnp.where(finished, eos_token_id, next_tok)
+                finished = finished | (next_tok == eos_token_id)
+            out_tokens.append(next_tok)
+            if eos_token_id is not None and bool(finished.all()):
+                break
+            if i + 1 < max_new_tokens:
+                logits, cache_arrs = step(
+                    params, bufs, next_tok[:, None], cache_arrs,
+                    jnp.asarray(P + i, jnp.int32))
+
+        if was_training:
+            self.train()
+        return Tensor(jnp.concatenate(
+            [ids, jnp.stack(out_tokens, axis=1)], axis=1))
 
 
 class GPTPretrainingCriterion(Layer):

@@ -23,7 +23,10 @@ from ..core.tensor import Tensor
 from ..core.dispatch import apply
 from ..core import random as _rng
 
-__all__ = ["flash_attention", "flash_attention_arrays", "mha_reference"]
+__all__ = [
+    "flash_attention", "flash_attention_arrays", "mha_reference",
+    "cached_attention_arrays",
+]
 
 _NEG_INF = -1e30
 
@@ -342,6 +345,53 @@ def flash_attention_arrays(q, k, v, attn_mask=None, is_causal=False, scale=None)
     if _pallas_ok(q, k, is_causal, attn_mask):
         return _flash_attn_core(q, k, v, is_causal, scale, True)
     return mha_reference(q, k, v, attn_mask, is_causal, scale)
+
+
+def cached_attention_arrays(q, k, v, k_cache, v_cache, t, scale=None,
+                            mask=None):
+    """KV-cache attention for autoregressive decoding (reference CacheKV
+    semantics: fused_multi_transformer_op.cu:90 — the fused op's cache_kv
+    holds past keys/values and the new token is written at `time_step`).
+
+    q, k, v:            [B, S, H, D] — the current chunk (S = prompt length
+                        at prefill, 1 per decode step)
+    k_cache, v_cache:   [B, S_max, H, D] — static-shape rings; static shapes
+                        mean ONE XLA executable serves every decode position
+                        (dynamic start index via lax.dynamic_update_slice)
+    t:                  int32 scalar — write position of the chunk's first
+                        token (0 at prefill, current length during decode)
+    mask:               optional extra mask over cache positions,
+                        broadcastable to [B, H, S, S_max] — bool (True =
+                        attend) or additive float; combined with the causal
+                        mask (use for padded-prompt batches)
+
+    Returns (out [B,S,H,D], new_k_cache, new_v_cache). Attention is causal
+    over cache positions <= each query's absolute position; the O(S_max)
+    masked-softmax XLA path is bandwidth-bound (MXU irrelevant at S_q=1),
+    so no Pallas kernel is needed for correctness-first decode.
+    """
+    b, s, h, d = q.shape
+    s_max = k_cache.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    t = jnp.asarray(t, jnp.int32)
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, k.astype(k_cache.dtype), (0, t, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, v.astype(v_cache.dtype), (0, t, 0, 0))
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
+                        preferred_element_type=jnp.float32) * scale
+    q_pos = t + jnp.arange(s, dtype=jnp.int32)          # absolute positions
+    k_pos = jnp.arange(s_max, dtype=jnp.int32)
+    causal = k_pos[None, :] <= q_pos[:, None]           # [S, S_max] causal
+    logits = jnp.where(causal[None, None], logits, _NEG_INF)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, _NEG_INF)
+        else:
+            logits = logits + mask.astype(logits.dtype)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v_cache.dtype), v_cache)
+    return out.astype(q.dtype), k_cache, v_cache
 
 
 def flash_attention(
