@@ -1,0 +1,96 @@
+"""Expert-parallel MoE dispatch (VERDICT r1 item 4; reference:
+incubate/distributed/models/moe/moe_layer.py:260 global_scatter/global_gather
+dispatch, paddle/fluid/operators/collective/global_scatter_op.cu.cc)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import parallel
+from paddle_tpu.parallel.moe import moe_mlp_arrays, moe_capacity
+
+
+def _rand_moe(seed, B=2, S=8, H=16, M=32, E=4):
+    rng = np.random.RandomState(seed)
+    x = jnp.asarray(rng.randn(B, S, H).astype(np.float32))
+    gl = jnp.asarray(rng.randn(B, S, E).astype(np.float32))
+    w_in = jnp.asarray(rng.randn(E, H, M).astype(np.float32) * 0.05)
+    w_out = jnp.asarray(rng.randn(E, M, H).astype(np.float32) * 0.05)
+    return x, gl, w_in, w_out
+
+
+def _naive_topk(x, gl, w_in, w_out, k):
+    """Dense oracle: every token runs its top-k experts, no capacity."""
+    probs = jax.nn.softmax(gl, -1)
+    topv, topi = jax.lax.top_k(probs, k)
+    topv = topv / topv.sum(-1, keepdims=True)
+    B, S, H = x.shape
+    out = np.zeros((B, S, H), np.float32)
+    for b in range(B):
+        for s in range(S):
+            for j in range(k):
+                e = int(topi[b, s, j])
+                hid = jax.nn.gelu(x[b, s] @ w_in[e], approximate=True)
+                out[b, s] += float(topv[b, s, j]) * np.asarray(hid @ w_out[e])
+    return out
+
+
+def test_moe_matches_dense_oracle_with_ample_capacity():
+    x, gl, w_in, w_out = _rand_moe(0)
+    y, aux = moe_mlp_arrays(x, gl, w_in, w_out, top_k=2, capacity_factor=4.0)
+    np.testing.assert_allclose(np.asarray(y), _naive_topk(x, gl, w_in, w_out, 2),
+                               rtol=1e-4, atol=1e-4)
+    assert float(aux) > 0.0  # load-balance loss populated
+
+
+def test_moe_capacity_drops_overflow_tokens():
+    x, gl, w_in, w_out = _rand_moe(1)
+    # capacity 1 per expert: most tokens dropped, output far from oracle but
+    # finite, and dropped tokens contribute exactly zero
+    y, _ = moe_mlp_arrays(x, gl, w_in, w_out, top_k=2, capacity_factor=0.125)
+    assert moe_capacity(16, 4, 2, 0.125) == 1
+    assert np.isfinite(np.asarray(y)).all()
+    full, _ = moe_mlp_arrays(x, gl, w_in, w_out, top_k=2, capacity_factor=4.0)
+    assert float(jnp.abs(y).sum()) < float(jnp.abs(full).sum())
+
+
+def test_moe_expert_parallel_matches_single_device():
+    x, gl, w_in, w_out = _rand_moe(2)
+    y1, _ = moe_mlp_arrays(x, gl, w_in, w_out, top_k=2, capacity_factor=4.0)
+    parallel.init_mesh(dp=2, ep=2, mp=2)
+    y2, _ = moe_mlp_arrays(x, gl, w_in, w_out, top_k=2, capacity_factor=4.0)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_moe_flops_independent_of_num_experts():
+    """Per-token expert FLOPs must not scale with E (the r1 dense MoE was
+    O(E) per token). Compare compiled FLOPs at E=4 vs E=16 with fixed k:
+    anything > ~1.5x means dense-dispatch asymptotics crept back."""
+    def build(E):
+        x, gl, w_in, w_out = _rand_moe(3, E=E)
+        f = jax.jit(lambda *a: moe_mlp_arrays(*a, top_k=2,
+                                              capacity_factor=1.0)[0])
+        return f.lower(x, gl, w_in, w_out).compile().cost_analysis()
+
+    c4, c16 = build(4), build(16)
+    if not c4 or "flops" not in c4:
+        pytest.skip("cost_analysis unavailable on this backend")
+    assert c16["flops"] < 1.5 * c4["flops"], (c4["flops"], c16["flops"])
+
+
+def test_gpt_moe_aux_loss_exposed():
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.models import GPTForCausalLM, gpt_test_config
+
+    paddle.seed(0)
+    cfg = gpt_test_config(moe_every_n=2, moe_num_experts=4,
+                          sequence_parallel=False)
+    model = GPTForCausalLM(cfg)
+    ids = Tensor(jnp.asarray(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (2, 8)), jnp.int32))
+    _ = model(ids)
+    moe_blocks = [blk for blk in model.gpt.h
+                  if type(blk.mlp).__name__ == "GPTMoEMLP"]
+    assert moe_blocks and all(b.mlp.aux_loss is not None for b in moe_blocks)
