@@ -829,26 +829,47 @@ def cross_entropy(
     lbl = label._data if isinstance(label, Tensor) else jnp.asarray(label)
 
     def fn(logits, *w):
-        if use_softmax:
-            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
-        else:
-            logp = jnp.log(jnp.maximum(logits.astype(jnp.float32), 1e-30))
         nclass = logits.shape[axis]
         if soft_label:
+            if use_softmax:
+                logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
+            else:
+                logp = jnp.log(jnp.maximum(logits.astype(jnp.float32), 1e-30))
             tgt = lbl.astype(jnp.float32)
             loss = -jnp.sum(tgt * logp, axis=axis)
         else:
+            # Hard labels: loss = logsumexp(logits) - logits[label]. The fp32
+            # cast feeds straight into reductions/gathers, so XLA never
+            # materializes an fp32 [.., V] log-prob or one-hot tensor — on a
+            # 50k vocab that is GBs of HBM traffic per step (the bench's
+            # single largest non-matmul cost before this formulation).
             li = lbl
-            if li.ndim == logp.ndim:
+            if li.ndim == logits.ndim:
                 li = jnp.squeeze(li, axis=axis)
             li_clipped = jnp.clip(li, 0, nclass - 1)
-            oh = jax.nn.one_hot(li_clipped, nclass, axis=axis, dtype=logp.dtype)
-            if label_smoothing > 0.0:
-                oh = oh * (1 - label_smoothing) + label_smoothing / nclass
-            picked = jnp.sum(oh * logp, axis=axis)
-            loss = -picked
+            picked = jnp.squeeze(
+                jnp.take_along_axis(
+                    logits, jnp.expand_dims(li_clipped, axis), axis=axis),
+                axis).astype(jnp.float32)
+            if use_softmax:
+                lse = jax.scipy.special.logsumexp(
+                    logits.astype(jnp.float32), axis=axis)
+                nll = lse - picked
+                if label_smoothing > 0.0:
+                    mean_logit = jnp.mean(
+                        logits.astype(jnp.float32), axis=axis)
+                    smooth = lse - mean_logit
+                    nll = (1.0 - label_smoothing) * nll + label_smoothing * smooth
+            else:
+                logpicked = jnp.log(jnp.maximum(picked, 1e-30))
+                nll = -logpicked
+                if label_smoothing > 0.0:
+                    logp_all = jnp.log(
+                        jnp.maximum(logits.astype(jnp.float32), 1e-30))
+                    smooth = -jnp.mean(logp_all, axis=axis)
+                    nll = (1.0 - label_smoothing) * nll + label_smoothing * smooth
             valid = li != ignore_index
-            loss = jnp.where(valid, loss, 0.0)
+            loss = jnp.where(valid, nll, 0.0)
             if w:
                 wt = jnp.take(w[0], li_clipped)
                 loss = loss * wt

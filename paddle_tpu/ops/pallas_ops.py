@@ -66,12 +66,24 @@ def mha_reference(q, k, v, mask=None, is_causal=False, scale=None):
 # Pallas flash forward
 # ---------------------------------------------------------------------------
 
+def _dot_f32(a, b, transpose_b=False):
+    """Matmul keeping operand dtype with fp32 accumulation. bf16 operands
+    ride the MXU's fast path (fp32 operands would run ~8x slower on v5e);
+    fp32 operands pin HIGHEST precision so the correctness dtype doesn't
+    silently truncate to bf16 inside the kernel."""
+    dims = (((1,), (1 if transpose_b else 0,)), ((), ()))
+    prec = (jax.lax.Precision.HIGHEST
+            if a.dtype == jnp.float32 else jax.lax.Precision.DEFAULT)
+    return jax.lax.dot_general(a, b, dims, preferred_element_type=jnp.float32,
+                               precision=prec)
+
+
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, seq_k,
                       scale, causal, block_q):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(2)
-    q = q_ref[0, :, :].astype(jnp.float32) * scale  # [block_q, d]
+    q = q_ref[0, :, :]                              # [block_q, d], input dtype
 
     m = jnp.full((block_q,), _NEG_INF, jnp.float32)
     l = jnp.zeros((block_q,), jnp.float32)
@@ -81,9 +93,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, seq_k,
 
     def body(kb, carry):
         m, l, acc = carry
-        k = k_ref[0, pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
-        s = q @ k.T  # [bq, bk]
+        k = k_ref[0, pl.dslice(kb * block_k, block_k), :]
+        v = v_ref[0, pl.dslice(kb * block_k, block_k), :]
+        s = _dot_f32(q, k, transpose_b=True) * scale   # [bq, bk] fp32
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -92,7 +104,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, seq_k,
         p = jnp.exp(s - m_new[:, None])
         alpha = jnp.exp(m - m_new)
         l_new = alpha * l + jnp.sum(p, axis=-1)
-        acc_new = acc * alpha[:, None] + p @ v
+        acc_new = acc * alpha[:, None] + _dot_f32(p.astype(v.dtype), v)
         return m_new, l_new, acc_new
 
     if causal:
@@ -113,24 +125,24 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(2)
-    q = q_ref[0, :, :].astype(jnp.float32)        # [bq, d]
-    do = do_ref[0, :, :].astype(jnp.float32)      # [bq, d]
+    q = q_ref[0, :, :]                            # [bq, d]
+    do = do_ref[0, :, :]                          # [bq, d]
     lse = lse_ref[0, 0, pl.dslice(qi * block_q, block_q)]   # [bq]
     delta = delta_ref[0, 0, pl.dslice(qi * block_q, block_q)]
     num_kb = seq_k // block_k
 
     def body(kb, dq):
-        k = k_ref[0, pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
-        s = (q * scale) @ k.T
+        k = k_ref[0, pl.dslice(kb * block_k, block_k), :]
+        v = v_ref[0, pl.dslice(kb * block_k, block_k), :]
+        s = _dot_f32(q, k, transpose_b=True) * scale
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
         p = jnp.exp(s - lse[:, None])
-        dp = do @ v.T
+        dp = _dot_f32(do, v, transpose_b=True)
         ds = p * (dp - delta[:, None])
-        return dq + ds @ k
+        return dq + _dot_f32(ds.astype(k.dtype), k)
 
     if causal:
         last_kb = jnp.minimum(((qi + 1) * block_q + block_k - 1) // block_k, num_kb)
@@ -147,26 +159,27 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     from jax.experimental import pallas as pl
 
     ki = pl.program_id(2)
-    k = k_ref[0, :, :].astype(jnp.float32)        # [bk, d]
-    v = v_ref[0, :, :].astype(jnp.float32)
+    k = k_ref[0, :, :]                            # [bk, d]
+    v = v_ref[0, :, :]
     num_qb = seq_q // block_q
 
     def body(qb, carry):
         dk, dv = carry
-        q = q_ref[0, pl.dslice(qb * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.dslice(qb * block_q, block_q), :].astype(jnp.float32)
+        q = q_ref[0, pl.dslice(qb * block_q, block_q), :]
+        do = do_ref[0, pl.dslice(qb * block_q, block_q), :]
         lse = lse_ref[0, 0, pl.dslice(qb * block_q, block_q)]
         delta = delta_ref[0, 0, pl.dslice(qb * block_q, block_q)]
-        s = (q * scale) @ k.T                     # [bq, bk]
+        s = _dot_f32(q, k, transpose_b=True) * scale   # [bq, bk]
         if causal:
             q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
         p = jnp.exp(s - lse[:, None])
-        dv = dv + p.T @ do
-        dp = do @ v.T
-        ds = p * (dp - delta[:, None])
-        dk = dk + ds.T @ q
+        pb = p.astype(do.dtype)
+        dv = dv + _dot_f32(pb.T, do)
+        dp = _dot_f32(do, v, transpose_b=True)
+        ds = (p * (dp - delta[:, None])).astype(q.dtype)
+        dk = dk + _dot_f32(ds.T, q)
         return dk, dv
 
     # causal: only q blocks at/after this k block's diagonal contribute
