@@ -75,6 +75,12 @@ class GPTConfig:
     # (enables pp>1; also O(1)-in-depth compile time)
     stacked_blocks: bool = False
     pp_num_microbatches: int = 0  # 0 -> pp degree
+    # pipeline schedule under pp>1: "gpipe" (autodiff-transparent forward,
+    # parallel/pipeline.py:pipeline_apply) or "1f1b" (fused fwd+bwd with
+    # bounded activation stashes, pipeline_1f1b — reference
+    # meta_parallel/pipeline_parallel.py:230). "1f1b" takes effect in
+    # pretrain_loss(); plain forward() always uses gpipe.
+    pp_schedule: str = "gpipe"
 
 
 def gpt_test_config(**kw):
@@ -350,17 +356,16 @@ class GPTStackedBlocks(Layer):
             setattr(self, name, p)
         self._names = list(shapes)
 
-    def forward(self, x):
+    def block_closure(self):
+        """Array-level single-block function `block(params_slice, h) -> h`
+        shared by the gpipe forward, the 1F1B fused loss, and dryruns."""
         from ..parallel.mesh import axis_size
-        from ..parallel.pipeline import pipeline_apply
         from ..parallel.ring import ring_attention_arrays
         from ..ops.pallas_ops import flash_attention_arrays
 
         cfg = self.cfg
         nh, hd = cfg.num_attention_heads, cfg.hidden_size // cfg.num_attention_heads
         eps = cfg.layer_norm_epsilon
-        names = self._names
-        n_micro = cfg.pp_num_microbatches or None
         # ring attention composes with the pp shard_map only when pp is
         # degenerate (nested manual axes); pipeline stages fall back to
         # full-sequence flash attention.
@@ -375,6 +380,15 @@ class GPTStackedBlocks(Layer):
                 p, h, lambda q, k, v: (attn(q, k, v, is_causal=True), None),
                 nh, hd, eps)
             return out
+
+        return block
+
+    def forward(self, x):
+        from ..parallel.pipeline import pipeline_apply
+
+        names = self._names
+        n_micro = self.cfg.pp_num_microbatches or None
+        block = self.block_closure()
 
         def fn(a, *flat):
             params = dict(zip(names, flat))
@@ -536,6 +550,64 @@ class GPTForCausalLM(Layer):
         if caches is not None:
             return logits, new_caches
         return logits
+
+    def pretrain_loss(self, input_ids, labels, loss_mask=None):
+        """Causal-LM training loss honoring cfg.pp_schedule.
+
+        Under pp>1 with pp_schedule="1f1b" the blocks, final norm, LM head,
+        and cross entropy all run inside the fused 1F1B pipeline
+        (parallel/pipeline.py:pipeline_1f1b) so in-flight activations are
+        bounded by pp depth — the reference train_batch path
+        (meta_parallel/pipeline_parallel.py:230). Otherwise equivalent to
+        GPTPretrainingCriterion()(self(input_ids), labels, loss_mask).
+        """
+        from ..parallel.mesh import axis_size
+        from ..parallel.pipeline import pipeline_1f1b
+
+        cfg = self.cfg
+        if not (cfg.stacked_blocks and cfg.pp_schedule == "1f1b"
+                and axis_size("pp") > 1):
+            crit = GPTPretrainingCriterion(cfg)
+            return crit(self(input_ids), labels, loss_mask)
+
+        blocks = self.gpt.blocks
+        names = blocks._names
+        block = blocks.block_closure()
+        n_micro = cfg.pp_num_microbatches or None
+        eps = cfg.layer_norm_epsilon
+        x = self.gpt.embeddings(input_ids)
+        wte = self.gpt.embeddings.word_embeddings.weight
+        lnw, lnb = self.gpt.ln_f.weight, self.gpt.ln_f.bias
+        has_mask = loss_mask is not None
+
+        def loss_fn(tail, h, ymb):
+            y_mb, mask_mb = ymb
+            hn = _stacked_ln(h, tail["ln_w"], tail["ln_b"], eps)
+            logits = jnp.einsum("bsh,vh->bsv", hn, tail["wte"])
+            # hard-label CE as logsumexp - picked (no [.., V] log-prob
+            # materialization — see nn/functional cross_entropy)
+            lse = jax.scipy.special.logsumexp(
+                logits.astype(jnp.float32), axis=-1)
+            picked = jnp.take_along_axis(
+                logits, y_mb[..., None].astype(jnp.int32), axis=-1
+            )[..., 0].astype(jnp.float32)
+            per_tok = lse - picked
+            if has_mask:
+                m = mask_mb.astype(jnp.float32)
+                return jnp.sum(per_tok * m) / jnp.clip(jnp.sum(m), 1.0)
+            return jnp.mean(per_tok)
+
+        mask_arg = loss_mask if has_mask else labels  # placeholder leaf
+
+        def fn(a, y, mask, wte_, lnw_, lnb_, *flat):
+            params = dict(zip(names, flat))
+            tail = {"wte": wte_, "ln_w": lnw_, "ln_b": lnb_}
+            return pipeline_1f1b(block, loss_fn, params, tail, a, (y, mask),
+                                 n_microbatches=n_micro)
+
+        tensors = [getattr(blocks, n) for n in names]
+        return apply(fn, x, labels, mask_arg, wte, lnw, lnb, *tensors,
+                     name="gpt_1f1b_loss")
 
     # -- autoregressive decoding -------------------------------------------
     def init_caches(self, batch_size, max_length, dtype=None):

@@ -1,0 +1,140 @@
+"""Runtime kernel autotuning.
+
+Reference analog: paddle/phi/kernels/autotune/ (cache.h AutoTuneCache keyed
+by kernel signature, auto_tune_base.h measured candidate selection, enabled
+via FLAGS_use_autotune) and python/paddle/incubate/autotune.py set_config.
+
+TPU-native re-design: the tunable surface is Pallas grid/block geometry
+(the analog of the reference's cuDNN algo / transpose-variant choice). A
+candidate sweep runs the REAL kernel on zero-filled inputs of the actual
+shapes — legal while tracing an outer jit, because dispatching concrete
+ops from Python during trace just runs them — and the winner is memoized
+by (kernel, static key). The cache can persist to JSON across processes
+(the analog of autotune cache serialization) via PTPU_AUTOTUNE_CACHE.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable, Dict, Iterable, Sequence, Tuple
+
+import jax
+
+from ..framework.core_ import get_flag
+
+__all__ = ["AutoTuneCache", "autotune", "cache", "set_config"]
+
+
+class AutoTuneCache:
+    """Shape-keyed best-config store with hit/miss stats (cache.h analog)."""
+
+    def __init__(self):
+        self._store: Dict[str, Dict[str, Any]] = {}
+        self.hits = 0
+        self.misses = 0
+        path = os.environ.get("PTPU_AUTOTUNE_CACHE")
+        if path and os.path.exists(path):
+            try:
+                with open(path) as f:
+                    self._store = json.load(f)
+            except (OSError, ValueError):
+                pass
+
+    @staticmethod
+    def _key(kernel: str, key: Tuple) -> str:
+        return kernel + "|" + repr(key)
+
+    def get(self, kernel: str, key: Tuple):
+        k = self._key(kernel, key)
+        if k in self._store:
+            self.hits += 1
+            return self._store[k]
+        self.misses += 1
+        return None
+
+    def put(self, kernel: str, key: Tuple, config: Any):
+        self._store[self._key(kernel, key)] = config
+
+    def clear(self):
+        self._store.clear()
+        self.hits = self.misses = 0
+
+    def cache_hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+    def save(self, path: str | None = None):
+        path = path or os.environ.get("PTPU_AUTOTUNE_CACHE")
+        if path:
+            with open(path, "w") as f:
+                json.dump(self._store, f)
+
+
+cache = AutoTuneCache()
+
+_config = {"kernel": {"enable": True, "tuning_range": [1, 10]}}
+
+
+def set_config(config: dict | str | None = None):
+    """paddle.incubate.autotune.set_config parity: accepts a dict or a path
+    to a JSON file with {"kernel": {"enable": bool}} (layout/dataloader
+    sections are accepted and ignored — XLA owns layouts on TPU)."""
+    global _config
+    if config is None:
+        _config = {"kernel": {"enable": True}}
+        return
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    for section in ("kernel", "layout", "dataloader"):
+        if section in config:
+            _config.setdefault(section, {}).update(config[section])
+
+
+def _enabled() -> bool:
+    return bool(get_flag("FLAGS_use_autotune", True)) and _config.get(
+        "kernel", {}).get("enable", True)
+
+
+def _measure(fn: Callable[[], Any], iters: int = 3) -> float:
+    jax.block_until_ready(fn())  # compile + warm
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def autotune(
+    kernel: str,
+    key: Tuple,
+    candidates: Sequence[Any],
+    runner: Callable[[Any], Callable[[], Any]] | None = None,
+) -> Any:
+    """Pick the best of `candidates` for `kernel` at static `key`.
+
+    runner(cfg) -> zero-arg callable running the real kernel with cfg on
+    representative inputs. When tuning is disabled, the runner fails, or
+    only one candidate exists, the first candidate (the heuristic default)
+    wins. Results are memoized in the process-wide cache.
+    """
+    candidates = list(candidates)
+    if not candidates:
+        raise ValueError("autotune needs at least one candidate")
+    got = cache.get(kernel, key)
+    if got is not None:
+        return got
+    choice = candidates[0]
+    if len(candidates) > 1 and runner is not None and _enabled():
+        best_t = float("inf")
+        for cand in candidates:
+            try:
+                t = _measure(runner(cand))
+            except Exception:
+                continue
+            if t < best_t:
+                best_t, choice = t, cand
+    cache.put(kernel, key, choice)
+    return choice

@@ -198,12 +198,105 @@ def _largest_dividing_block(n, preferred=256, minimum=128):
     return None
 
 
-def _flash_fwd(q, k, v, is_causal, scale, block_q=256, block_k=256):
+def _block_candidates(sq, sk):
+    """Dividing (block_q, block_k) candidates, measured-best first.
+
+    (512, 512) leads: on v5e at seq 1024 / d 64 it beat 256/256 by 15%
+    and both XLA attention and the shipped jax flash kernel by ~2x (see
+    BENCH_NOTES.md sweep); smaller geometries serve shorter sequences.
+    """
+    cands = []
+    for bq, bk in ((512, 512), (1024, 1024), (512, 256), (256, 256),
+                   (256, 128), (128, 128)):
+        if sq % bq == 0 and sk % bk == 0 and (bq, bk) not in cands:
+            cands.append((bq, bk))
+    return cands or [(_largest_dividing_block(sq),
+                      _largest_dividing_block(sk))]
+
+
+# candidates are timed as an 8-deep chained jit so per-dispatch overhead
+# (significant through a remote-chip tunnel) amortizes out of the signal
+_TUNE_CHAIN = 8
+
+
+def _run_fwd_candidate(bh, sq, sk, d, dtype, is_causal, scale, bq, bk):
+    k = jnp.zeros((bh, sk, d), dtype)
+    v = jnp.zeros((bh, sk, d), dtype)
+
+    @jax.jit
+    def chain(q):
+        def body(q, _):
+            o, _lse = _flash_fwd(q, k, v, is_causal, scale,
+                                 block_q=bq, block_k=bk)
+            return o, None
+        out, _ = jax.lax.scan(body, q, length=_TUNE_CHAIN)
+        return out
+
+    return chain(jnp.zeros((bh, sq, d), dtype))
+
+
+def _run_bwd_candidate(bh, sq, sk, d, dtype, is_causal, scale, bq, bk):
+    k = jnp.zeros((bh, sk, d), dtype)
+    v = jnp.zeros((bh, sk, d), dtype)
+    out = jnp.zeros((bh, sq, d), dtype)
+    lse = jnp.zeros((bh, 1, sq), jnp.float32)
+    do = jnp.zeros((bh, sq, d), dtype)
+
+    @jax.jit
+    def chain(q):
+        def body(q, _):
+            dq, _dk, _dv = _flash_bwd(q, k, v, out, lse, do, is_causal,
+                                      scale, block_q=bq, block_k=bk)
+            return dq, None
+        dq, _ = jax.lax.scan(body, q, length=_TUNE_CHAIN)
+        return dq
+
+    return chain(jnp.zeros((bh, sq, d), dtype))
+
+
+_FLASH_RUNNERS = {"flash_fwd": _run_fwd_candidate,
+                  "flash_bwd": _run_bwd_candidate}
+
+
+def _tuned_blocks(kernel, sq, sk, d, bh, dtype, is_causal, scale):
+    """Consult the autotune cache (ops/autotune.py) for block geometry.
+
+    Default policy is the heuristic table in _block_candidates (seeded by
+    the END-TO-END sweep in BENCH_NOTES.md): isolated kernel timing
+    mispicks here — it measured 128/128 fastest in isolation while the
+    full train step is 43% slower with it than with 512/512, because the
+    surrounding XLA schedule (fusions and DMA overlap across the custom
+    call boundary) dominates the isolated delta. Set PTPU_AUTOTUNE_SWEEP=1
+    to measure anyway (useful on new chip generations to re-seed the
+    table; phi autotune/auto_tune_base.h analog)."""
+    import os
+
+    from . import autotune as at
+
+    key = (bh, sq, sk, d, str(dtype), bool(is_causal))
+    cands = _block_candidates(sq, sk)
+    runner = None
+    if os.environ.get("PTPU_AUTOTUNE_SWEEP") == "1":
+        def runner(cfg):
+            bq, bk = cfg
+
+            def go():
+                return _FLASH_RUNNERS[kernel](bh, sq, sk, d, dtype,
+                                              is_causal, scale, bq, bk)
+            return go
+
+    return at.autotune("pallas_" + kernel, key, cands, runner)
+
+
+def _flash_fwd(q, k, v, is_causal, scale, block_q=None, block_k=None):
     """q,k,v: [BH, S, D] (heads folded into batch) → (out, lse)."""
     from jax.experimental import pallas as pl
 
     bh, sq, d = q.shape
     sk = k.shape[1]
+    if block_q is None or block_k is None:
+        block_q, block_k = _tuned_blocks(
+            "flash_fwd", sq, sk, d, bh, q.dtype, is_causal, scale)
     # blocks must tile the sequence exactly — remainder blocks would leave
     # output rows unwritten (gated by _pallas_ok, asserted here)
     block_q = _largest_dividing_block(sq, block_q)
@@ -239,13 +332,16 @@ def _flash_fwd(q, k, v, is_causal, scale, block_q=256, block_k=256):
 
 
 def _flash_bwd(q, k, v, out, lse, do, is_causal, scale,
-               block_q=256, block_k=256):
+               block_q=None, block_k=None):
     """Blockwise flash backward: recomputes p per tile from (q,k,lse) —
     no S^2 materialization in HBM. Returns (dq, dk, dv), all [BH, S, D]."""
     from jax.experimental import pallas as pl
 
     bh, sq, d = q.shape
     sk = k.shape[1]
+    if block_q is None or block_k is None:
+        block_q, block_k = _tuned_blocks(
+            "flash_bwd", sq, sk, d, bh, q.dtype, is_causal, scale)
     block_q = _largest_dividing_block(sq, block_q)
     block_k = _largest_dividing_block(sk, block_k)
     assert block_q is not None and block_k is not None

@@ -31,11 +31,12 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from .mesh import get_mesh, axis_size
 
-__all__ = ["pipeline_apply", "scan_blocks"]
+__all__ = ["pipeline_apply", "pipeline_1f1b", "scan_blocks"]
 
 
 def scan_blocks(block_fn: Callable, stacked_params: Any, x, unroll: int = 1):
@@ -134,3 +135,240 @@ def pipeline_apply(
     )
     out = run(staged, xs)
     return out.reshape((B,) + x.shape[1:])
+
+
+def _label_cotangent(y):
+    """Zero cotangent for a (possibly integer) label pytree leaf."""
+    if jnp.issubdtype(jnp.result_type(y), jnp.inexact):
+        return jnp.zeros_like(y)
+    return np.zeros(jnp.shape(y), dtype=jax.dtypes.float0)
+
+
+def pipeline_1f1b(
+    block_fn: Callable,
+    loss_fn: Callable,
+    stacked_params: Any,
+    tail_params: Any,
+    x,
+    y,
+    n_microbatches: int | None = None,
+    axis: str = "pp",
+):
+    """1F1B (PipeDream-flush) pipelined training loss in ONE XLA program.
+
+    Reference analog: fleet/meta_parallel/pipeline_parallel.py:230 — the
+    1F1B steady state where each stage alternates one forward and one
+    backward micro-batch so at most `pp - stage` activation stashes are
+    live, vs GPipe's M. The reference drives this schedule from the host
+    with NCCL p2p; here the whole schedule is a `lax.scan` over global
+    "slots" inside one `shard_map`:
+
+    - slot clock: stage s runs forward of micro-batch f at slot `s + 2f`
+      and backward of micro-batch b at slot `2*pp - 1 - s + 2b`. The two
+      are parity-disjoint, so each slot is one `lax.cond` per stage; in
+      steady state every stage computes every slot (no idle beyond the
+      pp-1 warmup/drain bubble — the same bubble the reference has).
+    - stages stash only their micro-batch INPUT in a pp-deep ring and
+      recompute the stage forward under `jax.vjp` at the backward slot
+      (activation recompute, the standard large-model 1F1B pairing).
+      In-flight memory is O(pp * microbatch), not O(M * activations).
+    - hops ride `lax.ppermute` both directions each slot (activations
+      s->s+1, cotangents s->s-1) — the p2p_communication.py:298 analog.
+
+    The function is autodiff-transparent: a `jax.custom_vjp` whose primal
+    computes loss AND grads in the fused schedule, saving the grads as
+    residuals; the outer `jax.grad` then just scales them. `loss_fn`
+    consumes `tail_params` on the LAST stage (final norm / lm head /
+    criterion), so head grads flow too:
+
+        loss_fn(tail_params, h_out, y_microbatch) -> scalar mean loss
+
+    Returns the scalar mean loss over micro-batches. Grads flow to
+    `stacked_params`, `tail_params`, and `x`.
+    """
+    mesh = get_mesh()
+    pp = axis_size(axis)
+    if pp == 1:
+        # Degenerate pipeline: plain differentiable compute (outer autodiff
+        # handles grads; no schedule needed).
+        out = scan_blocks(block_fn, stacked_params, x)
+        return loss_fn(tail_params, out, y)
+    return _pipeline_1f1b_vjp(
+        block_fn, loss_fn, n_microbatches, axis, stacked_params,
+        tail_params, x, y,
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _pipeline_1f1b_vjp(block_fn, loss_fn, n_microbatches, axis,
+                       stacked_params, tail_params, x, y):
+    loss, _ = _pipeline_1f1b_impl(
+        block_fn, loss_fn, n_microbatches, axis, stacked_params,
+        tail_params, x, y,
+    )
+    return loss
+
+
+def _pipeline_1f1b_fwd(block_fn, loss_fn, n_microbatches, axis,
+                       stacked_params, tail_params, x, y):
+    loss, grads = _pipeline_1f1b_impl(
+        block_fn, loss_fn, n_microbatches, axis, stacked_params,
+        tail_params, x, y,
+    )
+    return loss, (grads, y)
+
+
+def _pipeline_1f1b_bwd(block_fn, loss_fn, n_microbatches, axis, res, gbar):
+    (dparams, dtail, dx), y = res
+    # keep each cotangent's dtype: a bare `a * gbar` would promote bf16
+    # leaves to f32 and fail custom_vjp's aval check on bf16 models
+    scale = lambda t: jax.tree_util.tree_map(
+        lambda a: (a * gbar).astype(a.dtype), t)
+    dy = jax.tree_util.tree_map(_label_cotangent, y)
+    return scale(dparams), scale(dtail), (dx * gbar).astype(dx.dtype), dy
+
+
+_pipeline_1f1b_vjp.defvjp(_pipeline_1f1b_fwd, _pipeline_1f1b_bwd)
+
+
+def _pipeline_1f1b_impl(block_fn, loss_fn, n_microbatches, axis,
+                        stacked_params, tail_params, x, y):
+    """Fused forward+backward 1F1B schedule. Returns
+    (mean_loss, (d_stacked_params, d_tail_params, dx))."""
+    mesh = get_mesh()
+    pp = axis_size(axis)
+    B = x.shape[0]
+    M = n_microbatches or pp
+    if B % M != 0:
+        raise ValueError(f"batch {B} not divisible into {M} micro-batches")
+    leaves = jax.tree_util.tree_leaves(stacked_params)
+    L = leaves[0].shape[0]
+    if L % pp != 0:
+        raise ValueError(f"{L} blocks not divisible by pp={pp}")
+    R = min(pp, M)                       # stash ring depth (1F1B bound)
+    U = 2 * M + 2 * pp - 2               # total schedule slots
+
+    xs = x.reshape((M, B // M) + x.shape[1:])
+    ys = jax.tree_util.tree_map(
+        lambda a: a.reshape((M, a.shape[0] // M) + a.shape[1:]), y)
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(axis), P(), P(), P()),
+        out_specs=(P(), (P(axis), P(), P())),
+        axis_names=frozenset({axis}),
+        check_vma=False,
+    )
+    def run(params, tail, xs, ys):
+        params = jax.tree_util.tree_map(lambda a: a[0], params)
+        stage = jax.lax.axis_index(axis)
+        is_last = stage == pp - 1
+        fwd_perm = [(i, i + 1) for i in range(pp - 1)]
+        bwd_perm = [(i + 1, i) for i in range(pp - 1)]
+
+        def stage_full(p, tl, h, ymb):
+            out = scan_blocks(block_fn, p, h)
+            loss = jax.lax.cond(
+                is_last,
+                lambda: loss_fn(tl, out, ymb).astype(jnp.float32),
+                lambda: jnp.float32(0.0),
+            )
+            return out, loss
+
+        mb_shape = xs.shape[1:]
+        f32 = lambda t: jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape, jnp.float32), t)
+
+        carry0 = dict(
+            h_recv=jnp.zeros(mb_shape, x.dtype),
+            g_recv=jnp.zeros(mb_shape, jnp.float32),
+            stash=jnp.zeros((R,) + mb_shape, x.dtype),
+            gacc=f32(params),
+            tacc=f32(tail),
+            dxs=jnp.zeros((M,) + mb_shape, jnp.float32),
+            loss_sum=jnp.float32(0.0),
+        )
+
+        def slot(carry, u):
+            rel_f = u - stage
+            do_f = (rel_f >= 0) & (rel_f % 2 == 0) & (rel_f < 2 * M)
+            f = jnp.clip(rel_f // 2, 0, M - 1)
+            rel_b = u - (2 * pp - 1 - stage)
+            do_b = (rel_b >= 0) & (rel_b % 2 == 0) & (rel_b < 2 * M)
+            b = jnp.clip(rel_b // 2, 0, M - 1)
+
+            y_f = jax.tree_util.tree_map(lambda a: a[f], ys)
+            y_b = jax.tree_util.tree_map(lambda a: a[b], ys)
+            h_in = jnp.where(stage == 0, xs[f], carry["h_recv"])
+
+            def fwd_slot(c):
+                out, loss = stage_full(params, tail, h_in, y_f)
+                return dict(
+                    c,
+                    stash=jax.lax.dynamic_update_index_in_dim(
+                        c["stash"], h_in, f % R, 0),
+                    loss_sum=c["loss_sum"] + loss,
+                ), out, jnp.zeros(mb_shape, jnp.float32)
+
+            def bwd_slot(c):
+                h_stash = c["stash"][b % R]
+                g_out = jnp.where(
+                    is_last, jnp.zeros(mb_shape, jnp.float32),
+                    c["g_recv"]).astype(h_stash.dtype)
+                g_loss = jnp.where(is_last, jnp.float32(1.0 / M),
+                                   jnp.float32(0.0))
+                _, vjp_fn = jax.vjp(
+                    lambda p, tl, h: stage_full(p, tl, h, y_b),
+                    params, tail, h_stash)
+                dp, dtl, dh = vjp_fn((g_out, g_loss))
+                add = lambda acc, g: jax.tree_util.tree_map(
+                    lambda a, b_: a + b_.astype(jnp.float32), acc, g)
+                dh32 = dh.astype(jnp.float32)
+                dxs = jnp.where(
+                    stage == 0,
+                    jax.lax.dynamic_update_index_in_dim(c["dxs"], dh32, b, 0),
+                    c["dxs"])
+                return dict(
+                    c,
+                    gacc=add(c["gacc"], dp),
+                    tacc=add(c["tacc"], dtl),
+                    dxs=dxs,
+                ), jnp.zeros(mb_shape, x.dtype), dh32
+
+            def idle(c):
+                return c, jnp.zeros(mb_shape, x.dtype), \
+                    jnp.zeros(mb_shape, jnp.float32)
+
+            c, send_h, send_g = jax.lax.cond(
+                do_f, fwd_slot,
+                lambda c: jax.lax.cond(do_b, bwd_slot, idle, c),
+                carry)
+            c = dict(
+                c,
+                h_recv=jax.lax.ppermute(send_h, axis, fwd_perm),
+                g_recv=jax.lax.ppermute(send_g, axis, bwd_perm),
+            )
+            return c, None
+
+        carry, _ = jax.lax.scan(slot, carry0, jnp.arange(U))
+
+        loss = jax.lax.psum(carry["loss_sum"], axis) / M
+        # tail/dx live on one stage (zeros elsewhere) — psum broadcasts.
+        tacc = jax.tree_util.tree_map(
+            lambda a: jax.lax.psum(a, axis), carry["tacc"])
+        dxs = jax.lax.psum(carry["dxs"], axis)
+        gacc = jax.tree_util.tree_map(lambda a: a[None], carry["gacc"])
+        return loss, (gacc, tacc, dxs)
+
+    staged = jax.tree_util.tree_map(
+        lambda a: a.reshape((pp, L // pp) + a.shape[1:]), stacked_params
+    )
+    loss, (gacc, tacc, dxs) = run(staged, tail_params, xs, ys)
+    dparams = jax.tree_util.tree_map(
+        lambda g, p: g.reshape((L,) + g.shape[2:]).astype(p.dtype),
+        gacc, stacked_params)
+    dtail = jax.tree_util.tree_map(
+        lambda g, p: g.astype(p.dtype), tacc, tail_params)
+    dx = dxs.reshape((B,) + x.shape[1:]).astype(x.dtype)
+    return loss, (dparams, dtail, dx)

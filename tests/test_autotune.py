@@ -1,0 +1,80 @@
+"""Autotune cache + config tests (reference: paddle/phi/kernels/autotune/
+cache_test.cc semantics — keyed store, hit-rate stats, flag gating)."""
+import json
+
+import paddle_tpu as paddle
+from paddle_tpu.ops import autotune as at
+from paddle_tpu.ops.pallas_ops import _block_candidates
+
+
+def test_cache_put_get_and_hit_rate():
+    c = at.AutoTuneCache()
+    assert c.get("k", (1, 2)) is None
+    c.put("k", (1, 2), (512, 512))
+    assert c.get("k", (1, 2)) == (512, 512)
+    assert 0.0 < c.cache_hit_rate() < 1.0
+    c.clear()
+    assert c.get("k", (1, 2)) is None
+
+
+def test_autotune_memoizes_choice():
+    at.cache.clear()
+    calls = []
+
+    def runner(cfg):
+        def go():
+            calls.append(cfg)
+            return cfg
+        return go
+
+    got = at.autotune("toy", (8,), [(2, 2), (1, 1)], runner)
+    assert got in [(2, 2), (1, 1)]
+    n = len(calls)
+    assert n > 0
+    again = at.autotune("toy", (8,), [(2, 2), (1, 1)], runner)
+    assert again == got and len(calls) == n  # memoized, no re-measure
+
+
+def test_flag_disables_measurement():
+    at.cache.clear()
+    paddle.set_flags({"FLAGS_use_autotune": False})
+    try:
+        calls = []
+
+        def runner(cfg):
+            def go():
+                calls.append(cfg)
+            return go
+
+        got = at.autotune("toy2", (1,), [(4, 4), (8, 8)], runner)
+        assert got == (4, 4)  # heuristic first candidate
+        assert calls == []
+    finally:
+        paddle.set_flags({"FLAGS_use_autotune": True})
+
+
+def test_set_config_accepts_dict_and_file(tmp_path):
+    at.set_config({"kernel": {"enable": False}})
+    assert not at._enabled()
+    p = tmp_path / "tune.json"
+    p.write_text(json.dumps({"kernel": {"enable": True},
+                             "layout": {"enable": True}}))
+    at.set_config(str(p))
+    assert at._enabled()
+    at.set_config(None)
+
+
+def test_block_candidates_divide_sequence():
+    for sq, sk in ((1024, 1024), (2048, 2048), (256, 256), (384, 384)):
+        for bq, bk in _block_candidates(sq, sk):
+            assert sq % bq == 0 and sk % bk == 0
+
+
+def test_cache_persists_roundtrip(tmp_path, monkeypatch):
+    path = str(tmp_path / "cache.json")
+    monkeypatch.setenv("PTPU_AUTOTUNE_CACHE", path)
+    c = at.AutoTuneCache()
+    c.put("flash_fwd", (96, 1024, 1024, 64, "bfloat16", True), [512, 512])
+    c.save()
+    c2 = at.AutoTuneCache()
+    assert c2.get("flash_fwd", (96, 1024, 1024, 64, "bfloat16", True)) == [512, 512]

@@ -50,16 +50,20 @@ def test_pipeline_matches_serial_fwd_and_grad():
         np.testing.assert_allclose(np.asarray(g1[k]), np.asarray(g2[k]), atol=1e-4)
 
 
-def _stacked_losses(mesh_kwargs, steps=5):
+def _stacked_losses(mesh_kwargs, steps=5, schedule="gpipe"):
     paddle.seed(42)
     parallel.init_mesh(**mesh_kwargs)
-    cfg = gpt_test_config(num_hidden_layers=4, stacked_blocks=True)
+    cfg = gpt_test_config(num_hidden_layers=4, stacked_blocks=True,
+                          pp_schedule=schedule)
     model = parallel.place_model(GPTForCausalLM(cfg))
     crit = GPTPretrainingCriterion(cfg)
     opt = optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
 
     def step(x, y):
-        loss = crit(model(x), y)
+        if schedule == "1f1b":
+            loss = model.pretrain_loss(x, y)
+        else:
+            loss = crit(model(x), y)
         loss.backward()
         opt.step()
         opt.clear_grad()
@@ -72,8 +76,127 @@ def _stacked_losses(mesh_kwargs, steps=5):
     return [float(compiled(ids, lab)) for _ in range(steps)]
 
 
+def _toy_loss(tail, h, y):
+    # tail-owned head: project then squared error against labels
+    out = h @ tail["head"]
+    return jnp.mean((out - y) ** 2)
+
+
+def test_1f1b_matches_serial_loss_and_grads():
+    from paddle_tpu.parallel.pipeline import pipeline_1f1b
+
+    parallel.init_mesh(pp=4)
+    mesh = parallel.get_mesh()
+    rng = np.random.RandomState(1)
+    L, H, B, M = 8, 16, 8, 4
+    params = {
+        "w": jnp.asarray(rng.randn(L, H, H), jnp.float32) * 0.3,
+        "b": jnp.asarray(rng.randn(L, H), jnp.float32) * 0.1,
+    }
+    tail = {"head": jnp.asarray(rng.randn(H, 4), jnp.float32) * 0.3}
+    x = jnp.asarray(rng.randn(B, H), jnp.float32)
+    y = jnp.asarray(rng.randn(B, 4), jnp.float32)
+
+    def loss_pipe(p, tl, a):
+        return pipeline_1f1b(_block, _toy_loss, p, tl, a, y, n_microbatches=M)
+
+    def loss_ser(p, tl, a):
+        # serial reference: mean over the same micro-batch split
+        losses = []
+        for m in range(M):
+            am, ym = a[m * B // M:(m + 1) * B // M], y[m * B // M:(m + 1) * B // M]
+            losses.append(_toy_loss(tl, scan_blocks(_block, p, am), ym))
+        return jnp.mean(jnp.stack(losses))
+
+    sharded = {
+        "w": jax.device_put(params["w"], NamedSharding(mesh, P("pp"))),
+        "b": jax.device_put(params["b"], NamedSharding(mesh, P("pp"))),
+    }
+    l1 = jax.jit(loss_pipe)(sharded, tail, x)
+    l2 = jax.jit(loss_ser)(params, tail, x)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+
+    g1 = jax.jit(jax.grad(loss_pipe, argnums=(0, 1, 2)))(sharded, tail, x)
+    g2 = jax.jit(jax.grad(loss_ser, argnums=(0, 1, 2)))(params, tail, x)
+    for t1, t2 in zip(jax.tree_util.tree_leaves(g1),
+                      jax.tree_util.tree_leaves(g2)):
+        np.testing.assert_allclose(np.asarray(t1), np.asarray(t2),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_1f1b_bf16_grads_keep_dtype():
+    """bf16 params/activations: grads must come back bf16 (the bench's
+    precision recipe) — guards the custom_vjp cotangent dtype contract."""
+    from paddle_tpu.parallel.pipeline import pipeline_1f1b
+
+    parallel.init_mesh(pp=2)
+    mesh = parallel.get_mesh()
+    rng = np.random.RandomState(3)
+    L, H, B = 4, 16, 4
+    params = {"w": jnp.asarray(rng.randn(L, H, H), jnp.bfloat16) * 0.3,
+              "b": jnp.zeros((L, H), jnp.bfloat16)}
+    tail = {"head": jnp.asarray(rng.randn(H, 4), jnp.bfloat16)}
+    x = jnp.asarray(rng.randn(B, H), jnp.bfloat16)
+    y = jnp.asarray(rng.randn(B, 4), jnp.float32)
+    sharded = {k: jax.device_put(v, NamedSharding(mesh, P("pp")))
+               for k, v in params.items()}
+
+    def f(p, tl, a):
+        return pipeline_1f1b(_block, _toy_loss, p, tl, a, y,
+                             n_microbatches=2)
+
+    gp, gt, gx = jax.jit(jax.grad(f, argnums=(0, 1, 2)))(sharded, tail, x)
+    assert gp["w"].dtype == jnp.bfloat16
+    assert gt["head"].dtype == jnp.bfloat16
+    assert gx.dtype == jnp.bfloat16
+    assert float(jnp.sum(jnp.abs(gp["w"].astype(jnp.float32)))) > 0
+
+
+def test_1f1b_bounds_activation_memory():
+    """The 1F1B schedule's compiled temp footprint must not grow with M
+    (GPipe's does — that is the entire point of the schedule)."""
+    from paddle_tpu.parallel.pipeline import pipeline_1f1b
+
+    parallel.init_mesh(pp=4)
+    mesh = parallel.get_mesh()
+    rng = np.random.RandomState(2)
+    L, H, B = 4, 64, 64
+    params = {"w": jnp.asarray(rng.randn(L, H, H), jnp.float32) * 0.1,
+              "b": jnp.zeros((L, H), jnp.float32)}
+    tail = {"head": jnp.asarray(rng.randn(H, 4), jnp.float32)}
+    x = jnp.asarray(rng.randn(B, H), jnp.float32)
+    y = jnp.asarray(rng.randn(B, 4), jnp.float32)
+    sharded = {k: jax.device_put(v, NamedSharding(mesh, P("pp")))
+               for k, v in params.items()}
+
+    def temp_bytes(M):
+        def f(p, tl, a):
+            return pipeline_1f1b(_block, _toy_loss, p, tl, a, y,
+                                 n_microbatches=M)
+        lowered = jax.jit(jax.grad(f, argnums=(0, 1, 2))).lower(
+            sharded, tail, x)
+        ma = lowered.compile().memory_analysis()
+        if ma is None:  # backend without memory analysis: vacuous pass
+            return None
+        return ma.temp_size_in_bytes
+
+    t4, t16 = temp_bytes(4), temp_bytes(16)
+    if t4 is not None and t16 is not None and t4 > 0:
+        # stash ring depth stays pp regardless of M; allow slack for
+        # per-microbatch bookkeeping buffers (dxs is O(B) total, fixed).
+        assert t16 <= t4 * 1.5, (t4, t16)
+
+
 def test_gpt_3d_parallel_parity():
     """dp2 x pp2 x mp2 pipelined GPT matches the single-device loss curve."""
     base = _stacked_losses(dict())
     hybrid = _stacked_losses(dict(dp=2, pp=2, mp=2))
     np.testing.assert_allclose(base, hybrid, rtol=2e-2, atol=2e-3)
+
+
+def test_gpt_1f1b_schedule_parity():
+    """pretrain_loss under pp=2 1F1B matches the single-device loss curve
+    (reference hybrid_parallel_pp_alexnet-style schedule parity)."""
+    base = _stacked_losses(dict())
+    f1b = _stacked_losses(dict(pp=2), schedule="1f1b")
+    np.testing.assert_allclose(base, f1b, rtol=2e-2, atol=2e-3)
