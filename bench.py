@@ -1,50 +1,72 @@
-"""Headline benchmark: GPT pretrain step throughput on one chip.
+"""Benchmarks on one chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Default run (what the driver invokes): the HEADLINE metric — GPT-2 124M
+pretrain step throughput — printed as ONE JSON line
+{"metric", "value", "unit", "vs_baseline"}.
 
-The reference publishes no in-tree numbers (BASELINE.md), so vs_baseline
-normalizes against a 40%-MFU run of the same model on this chip's peak —
-40% MFU is what a well-tuned A100+NCCL GPT config typically sustains, i.e.
-vs_baseline >= 1.0 means "at or above A100-class utilization" on the
-north-star metric (tokens/sec/chip at fixed model).
+`python bench.py --config <name>` runs one BASELINE.md ladder config and
+prints its line.  `python bench.py --ladder` runs every ladder config in a
+fresh subprocess (isolated HBM) and writes BENCH_LADDER.json; the driver's
+default invocation stays headline-only so its timeout budget is untouched.
+
+vs_baseline normalizes tokens/sec (or images/sec) against a 40%-MFU run of
+the same model on this chip's bf16 peak — the reference publishes no
+absolute numbers (BASELINE.md), and 40% MFU is what a well-tuned
+A100+NCCL job typically sustains, i.e. vs_baseline >= 1.0 means "at or
+above A100-class utilization".
 """
 import json
+import subprocess
+import sys
 import time
 
 import numpy as np
 
+PEAK_BF16 = 197e12  # v5e
 
-def main():
+
+def _on_tpu():
     import jax
 
-    on_tpu = any(d.platform == "tpu" for d in jax.devices()) or any(
-        "axon" in str(d).lower() or "tpu" in str(d).lower() for d in jax.devices()
-    )
+    return any(d.platform in ("tpu", "axon") or "tpu" in str(d).lower()
+               for d in jax.devices())
 
+
+def _emit(metric, value, unit, baseline):
+    line = {
+        "metric": metric,
+        "value": round(value, 2),
+        "unit": unit,
+        "vs_baseline": round(value / baseline, 4) if baseline else 0.0,
+    }
+    print(json.dumps(line))
+    return line
+
+
+def _time_steps(compiled, args, steps, warmup):
+    for _ in range(warmup):
+        out = compiled(*args)
+    _ = float(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = compiled(*args)
+    _ = float(out)
+    return (time.perf_counter() - t0) / steps
+
+
+def _gpt_step(cfg, batch, seq, lr=1e-4, multi_precision=True):
     import paddle_tpu as paddle
     from paddle_tpu import jit, optimizer, parallel
-    from paddle_tpu.models import (
-        GPTForCausalLM, GPTPretrainingCriterion, gpt2_124m_config,
-        gpt_test_config,
-    )
-
-    if on_tpu:
-        cfg = gpt2_124m_config(stacked_blocks=True, max_position_embeddings=1024)
-        batch, seq, steps, warmup = 8, 1024, 10, 3
-    else:  # CPU smoke fallback so the bench always emits a line
-        cfg = gpt_test_config(num_hidden_layers=2, stacked_blocks=True)
-        batch, seq, steps, warmup = 4, 32, 3, 1
+    from paddle_tpu.models import GPTForCausalLM, GPTPretrainingCriterion
 
     paddle.seed(0)
     parallel.init_mesh()
     model = parallel.place_model(GPTForCausalLM(cfg))
-    if on_tpu:
-        # bf16 params/compute with fp32 master weights in AdamW — the
-        # north-star precision recipe (SURVEY §8.12); +34% tokens/sec vs
-        # fp32 on v5e at loss parity
+    if _on_tpu():
         model.bfloat16()
     crit = GPTPretrainingCriterion(cfg)
-    opt = optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters())
+    opt = optimizer.AdamW(learning_rate=lr, parameters=model.parameters(),
+                          multi_precision=multi_precision)
 
     def step(x, y):
         loss = crit(model(x), y)
@@ -57,30 +79,173 @@ def main():
     rng = np.random.RandomState(0)
     ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)).astype("int32"))
     lab = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)).astype("int32"))
-
-    for _ in range(warmup):
-        loss = compiled(ids, lab)
-    _ = float(loss)  # sync
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = compiled(ids, lab)
-    _ = float(loss)  # sync
-    dt = time.perf_counter() - t0
-
-    tokens_per_sec = batch * seq * steps / dt
-
-    # 40%-MFU baseline on this chip for this model (6*N FLOPs/token fwd+bwd)
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
-    flops_per_token = 6.0 * n_params
-    peak_flops = 197e12 if on_tpu else 5e9  # v5e bf16 peak; nominal CPU
-    baseline_tokens_per_sec = 0.40 * peak_flops / flops_per_token
-    print(json.dumps({
-        "metric": "gpt_124m_pretrain_tokens_per_sec_per_chip" if on_tpu
+    return compiled, (ids, lab), n_params
+
+
+def bench_gpt124m():
+    """Headline: north-star metric at 124M scale (BASELINE.md config 4's
+    little sibling, runnable fast every round)."""
+    from paddle_tpu.models import gpt2_124m_config, gpt_test_config
+
+    on_tpu = _on_tpu()
+    if on_tpu:
+        cfg = gpt2_124m_config(stacked_blocks=True, max_position_embeddings=1024)
+        batch, seq, steps, warmup = 8, 1024, 10, 3
+    else:  # CPU smoke fallback so the bench always emits a line
+        cfg = gpt_test_config(num_hidden_layers=2, stacked_blocks=True)
+        batch, seq, steps, warmup = 4, 32, 3, 1
+
+    # bf16 params/compute with fp32 master weights in AdamW — the
+    # north-star precision recipe (SURVEY §8.12)
+    compiled, args, n_params = _gpt_step(cfg, batch, seq)
+    dt = _time_steps(compiled, args, steps, warmup)
+    tokens_per_sec = batch * seq / dt
+    peak = PEAK_BF16 if on_tpu else 5e9
+    baseline = 0.40 * peak / (6.0 * n_params)
+    return _emit(
+        "gpt_124m_pretrain_tokens_per_sec_per_chip" if on_tpu
         else "gpt_tiny_pretrain_tokens_per_sec_cpu_smoke",
-        "value": round(tokens_per_sec, 2),
-        "unit": "tokens/sec",
-        "vs_baseline": round(tokens_per_sec / baseline_tokens_per_sec, 4),
-    }))
+        tokens_per_sec, "tokens/sec", baseline)
+
+
+def bench_gpt3_1p3b():
+    """BASELINE.md config 4 at single-chip scale: 1.3B params, seq 2048.
+    bf16 AdamW moments (multi_precision=False) so states fit one chip's
+    HBM; the fleet DP version of this config is the v5e-16 north star."""
+    from paddle_tpu.models import gpt3_1p3b_config
+
+    if not _on_tpu():
+        return _emit("gpt3_1p3b_skipped_cpu", 0.0, "tokens/sec", 0.0)
+    cfg = gpt3_1p3b_config(stacked_blocks=True)
+    batch, seq = 2, 2048
+    compiled, args, n_params = _gpt_step(cfg, batch, seq,
+                                         multi_precision=False)
+    dt = _time_steps(compiled, args, steps=5, warmup=2)
+    baseline = 0.40 * PEAK_BF16 / (6.0 * n_params)
+    return _emit("gpt3_1p3b_pretrain_tokens_per_sec_per_chip",
+                 batch * seq / dt, "tokens/sec", baseline)
+
+
+def bench_bert_base():
+    """BASELINE.md config 3: BERT-base fine-tune step (cls head)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import jit, optimizer, parallel
+    from paddle_tpu.models import BertForSequenceClassification, bert_base_config
+
+    on_tpu = _on_tpu()
+    drop = dict(hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    cfg = (bert_base_config(**drop) if on_tpu else bert_base_config(
+        hidden_size=64, num_hidden_layers=2, num_attention_heads=4,
+        intermediate_size=128, vocab_size=512, **drop))
+    batch, seq = (32, 128) if on_tpu else (2, 16)
+    paddle.seed(0)
+    parallel.init_mesh()
+    model = parallel.place_model(BertForSequenceClassification(cfg, num_classes=2))
+    if on_tpu:
+        model.bfloat16()
+    opt = optimizer.AdamW(learning_rate=2e-5, parameters=model.parameters())
+
+    def step(ids, labels):
+        logits = model(ids)
+        loss = paddle.nn.functional.cross_entropy(logits, labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    compiled = jit.compile(step, models=[model], optimizers=[opt])
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)).astype("int32"))
+    lab = paddle.to_tensor(rng.randint(0, 2, (batch,)).astype("int64"))
+    dt = _time_steps(compiled, (ids, lab), steps=10 if on_tpu else 2,
+                     warmup=3 if on_tpu else 1)
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    peak = PEAK_BF16 if on_tpu else 5e9
+    baseline = 0.40 * peak / (6.0 * n_params)
+    return _emit("bert_base_finetune_tokens_per_sec_per_chip",
+                 batch * seq / dt, "tokens/sec", baseline)
+
+
+def bench_resnet50():
+    """BASELINE.md config 2: ResNet-50 train step (the conv/BN/pool path),
+    compiled whole-step — the Executor static-graph analog."""
+    import paddle_tpu as paddle
+    from paddle_tpu import jit, optimizer, parallel
+    from paddle_tpu.vision.models import resnet50
+
+    on_tpu = _on_tpu()
+    batch = 64 if on_tpu else 2
+    size = 224 if on_tpu else 32
+    paddle.seed(0)
+    parallel.init_mesh()
+    model = parallel.place_model(resnet50(num_classes=1000))
+    if on_tpu:
+        model.bfloat16()
+    opt = optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                             parameters=model.parameters())
+
+    def step(x, y):
+        loss = paddle.nn.functional.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    compiled = jit.compile(step, models=[model], optimizers=[opt])
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(batch, 3, size, size).astype("float32"))
+    y = paddle.to_tensor(rng.randint(0, 1000, (batch,)).astype("int64"))
+    dt = _time_steps(compiled, (x, y), steps=10 if on_tpu else 2,
+                     warmup=3 if on_tpu else 1)
+    # ResNet-50 fwd ~4.1 GFLOP/image at 224^2; train ~3x fwd
+    flops_per_image = 3 * 4.1e9 * (size / 224) ** 2
+    peak = PEAK_BF16 if on_tpu else 5e9
+    baseline = 0.40 * peak / flops_per_image
+    return _emit("resnet50_train_images_per_sec_per_chip",
+                 batch / dt, "images/sec", baseline)
+
+
+LADDER = {
+    "gpt124m": bench_gpt124m,
+    "resnet50": bench_resnet50,
+    "bert_base": bench_bert_base,
+    "gpt3_1p3b": bench_gpt3_1p3b,
+}
+
+
+def main():
+    argv = sys.argv[1:]
+    if argv and argv[0] == "--config":
+        LADDER[argv[1]]()
+        return
+    if argv and argv[0] == "--ladder":
+        results = []
+        for name in LADDER:
+            entry = None
+            try:
+                proc = subprocess.run(
+                    [sys.executable, __file__, "--config", name],
+                    capture_output=True, text=True, timeout=1200)
+                for ln in proc.stdout.splitlines():
+                    try:
+                        entry = json.loads(ln)
+                    except ValueError:
+                        continue
+                if entry is None:  # crashed / OOM: record the failure
+                    entry = {"metric": name, "error":
+                             f"rc={proc.returncode}",
+                             "tail": proc.stderr.strip()[-400:]}
+            except subprocess.TimeoutExpired:
+                entry = {"metric": name, "error": "timeout"}
+            results.append(entry)
+            with open("BENCH_LADDER.json", "w") as f:  # survive later crashes
+                json.dump(results, f, indent=1)
+        for r in results:
+            print(json.dumps(r))
+        return
+    # driver path: headline only, ONE line
+    bench_gpt124m()
 
 
 if __name__ == "__main__":

@@ -18,6 +18,16 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+import os as _os
+
+if _os.environ.get("PTPU_FORCE_PLATFORM"):
+    # launcher/spawn children must pin the backend BEFORE first jax use;
+    # a bare JAX_PLATFORMS env var is overridden by site customizations
+    # on tunneled-TPU hosts, so the launcher sets this and we apply it.
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", _os.environ["PTPU_FORCE_PLATFORM"])
+
 from .core.tensor import Tensor, to_tensor
 from .core.dtype import (
     bool_,

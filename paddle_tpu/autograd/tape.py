@@ -96,25 +96,33 @@ class Node:
         "out_avals",
         "n_outs",
         "name",
+        "fwd_fn",
         "__weakref__",
     )
 
-    def __init__(self, vjp_fn, inputs, input_needs_grad, out_avals, name=""):
+    def __init__(self, vjp_fn, inputs, input_needs_grad, out_avals, name="",
+                 fwd_fn=None):
         self.vjp_fn = vjp_fn
         self.inputs = inputs  # list of input Tensors (kept alive for leaf accumulation)
         self.input_needs_grad = input_needs_grad
         self.out_avals = out_avals  # list of (shape, dtype) for each output
         self.n_outs = len(out_avals)
         self.name = name
+        # pure forward (arrays -> arrays), kept for create_graph=True: the
+        # recorded backward re-runs jax.vjp(fwd_fn, *primals) so the pullback
+        # is differentiable wrt BOTH cotangents and primals (the reference's
+        # double-grad GradNodes from backward.yaml play this role).
+        self.fwd_fn = fwd_fn
 
     def __repr__(self):
         return f"<GradNode {self.name} n_outs={self.n_outs}>"
 
 
-def record(vjp_fn, inputs, input_needs_grad, outputs, name=""):
+def record(vjp_fn, inputs, input_needs_grad, outputs, name="", fwd_fn=None):
     """Attach a Node to `outputs` (Tensors) produced from `inputs` (Tensors)."""
     out_avals = [(o.shape, o.dtype) for o in outputs]
-    node = Node(vjp_fn, list(inputs), list(input_needs_grad), out_avals, name)
+    node = Node(vjp_fn, list(inputs), list(input_needs_grad), out_avals, name,
+                fwd_fn=fwd_fn)
     for i, o in enumerate(outputs):
         o._grad_node = node
         o._out_index = i
@@ -148,7 +156,47 @@ def _accum(slot, value):
     return value if slot is None else slot + value
 
 
-def backward(tensors, grad_tensors=None, retain_graph=False, capture=None):
+def _node_backward_recorded(node, ct_tensors):
+    """Run one node's pullback THROUGH the dispatch layer so the backward
+    computation is itself taped (create_graph=True; reference analog: the
+    double/triple-grad GradNodes generated from backward.yaml).
+
+    The recorded op is `jax.vjp(fwd_fn, *primals) pullback(cts)` — a pure
+    function of (cotangents, primal inputs), so second-order cotangents
+    flow to both. Returns input cotangents (Tensors) for the needs-grad
+    inputs, positionally aligned with node.inputs (None where not needed).
+    """
+    from ..core.dispatch import apply
+
+    if node.fwd_fn is None:
+        raise RuntimeError(
+            f"create_graph=True through node {node.name!r} which recorded no "
+            "replayable forward (PyLayer nodes do not support double "
+            "backward; use autograd.functional transforms)"
+        )
+    m = node.n_outs
+    needs = list(node.input_needs_grad)
+
+    def bwd_fn(*args):
+        cts, prims = args[:m], args[m:]
+        _, pull = jax.vjp(node.fwd_fn, *prims)
+        out = pull(tuple(cts) if m > 1 else cts[0])
+        kept = tuple(o for o, n in zip(out, needs) if n)
+        # a 1-tuple output would desync apply's multi-output bookkeeping
+        # from the tape's n_outs cotangent call convention
+        return kept[0] if len(kept) == 1 else kept
+
+    res = apply(bwd_fn, *ct_tensors, *node.inputs,
+                name=(node.name or "op") + "_grad")
+    res = list(res) if isinstance(res, tuple) else [res]
+    full = []
+    for n in needs:
+        full.append(res.pop(0) if n else None)
+    return full
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False, capture=None,
+             create_graph=False):
     """Reverse-mode walk accumulating `.grad` on leaf tensors.
 
     Mirrors egr::Backward (reference backward.cc:383): seed cotangents on the
@@ -159,9 +207,16 @@ def backward(tensors, grad_tensors=None, retain_graph=False, capture=None):
     paddle.grad). When given, cotangents arriving at captured tensors (leaf
     OR intermediate) are collected into the returned dict and leaf `.grad`
     fields are NOT touched.
+
+    `create_graph`: run every pullback through the dispatch layer so the
+    produced gradients carry their own grad graph (higher-order autograd
+    from the eager API; implies retain_graph). Cotangents are then Tensors
+    and leaf `.grad` accumulation is a recorded add (gradient hooks are
+    bypassed on this path).
     """
     from ..core.tensor import Tensor
 
+    retain_graph = retain_graph or create_graph
     captured = {} if capture is not None else None
 
     def _take(t, ct):
@@ -175,7 +230,8 @@ def backward(tensors, grad_tensors=None, retain_graph=False, capture=None):
     elif not isinstance(grad_tensors, (list, tuple)):
         grad_tensors = [grad_tensors]
 
-    # cotangents[(id(node), out_idx)] = accumulated cotangent array
+    # cotangents[(id(node), out_idx)] = accumulated cotangent
+    # (jnp arrays normally; Tensors under create_graph so sums are taped)
     cotangents = {}
     roots = []
     for t, g in zip(tensors, grad_tensors):
@@ -190,6 +246,8 @@ def backward(tensors, grad_tensors=None, retain_graph=False, capture=None):
             seed = jnp.ones(t.shape, t.dtype)
         else:
             seed = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+        if create_graph:
+            seed = g if isinstance(g, Tensor) else Tensor(seed)
         node = getattr(t, "_grad_node", None)
         if capture is not None and id(t) in capture:
             _take(t, seed)
@@ -198,7 +256,10 @@ def backward(tensors, grad_tensors=None, retain_graph=False, capture=None):
         elif node is None:
             # Root is itself a leaf.
             if capture is None:
-                t._accumulate_grad(seed)
+                if create_graph:
+                    _accumulate_grad_recorded(t, seed)
+                else:
+                    t._accumulate_grad(seed)
             continue
         key = (id(node), t._out_index)
         cotangents[key] = _accum(cotangents.get(key), seed)
@@ -213,13 +274,17 @@ def backward(tensors, grad_tensors=None, retain_graph=False, capture=None):
         for i, (shape, dtype) in enumerate(node.out_avals):
             ct = cotangents.pop((id(node), i), None)
             if ct is None:
-                ct = jnp.zeros(shape, dtype)
+                zero = jnp.zeros(shape, dtype)
+                ct = Tensor(zero) if create_graph else zero
             else:
                 any_ct = True
             cts.append(ct)
         if not any_ct:
             continue
-        in_cts = node.vjp_fn(tuple(cts) if node.n_outs > 1 else cts[0])
+        if create_graph:
+            in_cts = _node_backward_recorded(node, cts)
+        else:
+            in_cts = node.vjp_fn(tuple(cts) if node.n_outs > 1 else cts[0])
         for t, needs, ct in zip(node.inputs, node.input_needs_grad, in_cts):
             if not needs or ct is None:
                 continue
@@ -230,7 +295,10 @@ def backward(tensors, grad_tensors=None, retain_graph=False, capture=None):
                 key = (id(producer), t._out_index)
                 cotangents[key] = _accum(cotangents.get(key), ct)
             elif producer is None and not t.stop_gradient and capture is None:
-                t._accumulate_grad(ct)
+                if create_graph:
+                    _accumulate_grad_recorded(t, ct)
+                else:
+                    t._accumulate_grad(ct)
         if not retain_graph:
             node.vjp_fn = _used_up
 
@@ -239,6 +307,11 @@ def backward(tensors, grad_tensors=None, retain_graph=False, capture=None):
         for t in tensors:
             _release_graph(t)
     return captured
+
+
+def _accumulate_grad_recorded(t, ct):
+    """Leaf .grad accumulation keeping ct's grad graph (create_graph path)."""
+    t.grad = ct if t.grad is None else t.grad + ct
 
 
 def _used_up(*_):
@@ -276,10 +349,11 @@ def grad(
     """paddle.grad equivalent (reference: egr::GeneralGrad, general_grad.h).
 
     Computes grads of `outputs` w.r.t. `inputs` without touching `.grad`
-    fields. create_graph=True (higher-order) is supported by re-running the
-    forward functionally under jax.grad — see autograd/functional.py; here we
-    implement the common first-order case via a capture-based accumulation
-    pass that never touches `.grad` fields.
+    fields. create_graph=True runs every pullback back through the dispatch
+    layer (tape-recorded backward — the analog of the 58+74 double/triple
+    grad entries in paddle/phi/api/yaml/backward.yaml being themselves
+    differentiable ops), so the returned grads can be differentiated again
+    with another paddle.grad / .backward call.
     """
     from ..core.tensor import Tensor  # noqa: F401 (used for wrapping results)
 
@@ -287,16 +361,12 @@ def grad(
         outputs = [outputs]
     if not isinstance(inputs, (list, tuple)):
         inputs = [inputs]
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True: use paddle_tpu.autograd.functional.vjp/jacobian "
-            "(functional transforms are the TPU-native higher-order path)"
-        )
 
     # GeneralGrad mode: cotangents are captured for exactly `inputs` (leaf or
     # intermediate); no tensor's `.grad` field is touched.
     capture = {id(t): t for t in inputs}
-    captured = backward(outputs, grad_outputs, retain_graph=retain_graph, capture=capture)
+    captured = backward(outputs, grad_outputs, retain_graph=retain_graph,
+                        capture=capture, create_graph=create_graph)
     results = []
     for t in inputs:
         ct = captured.get(id(t))
@@ -306,5 +376,8 @@ def grad(
                 "been used in the graph. Set allow_unused=True if this is "
                 "the desired behavior."
             )
-        results.append(None if ct is None else Tensor(ct))
+        if ct is None:
+            results.append(None)
+        else:
+            results.append(ct if isinstance(ct, Tensor) else Tensor(ct))
     return results

@@ -61,7 +61,8 @@ def apply(fn: Callable, *tensor_args, n_outs=None, name=None, **static_kwargs):
     out_ts = [Tensor(o) for o in outs]
 
     if trace_grad:
-        tape.record(vjp_fn, ts, needs, out_ts, name=name or getattr(fn, "__name__", "op"))
+        tape.record(vjp_fn, ts, needs, out_ts,
+                    name=name or getattr(fn, "__name__", "op"), fwd_fn=fn_c)
 
     if _nan_check_enabled():
         _check_nan_inf(outs, name or getattr(fn, "__name__", "op"))

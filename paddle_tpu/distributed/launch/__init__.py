@@ -1,0 +1,24 @@
+"""Distributed job launcher.
+
+Reference analog: python/paddle/distributed/launch/ (main.py:18 CLI,
+controllers/collective.py controller + pod/container model, job/ context,
+utils KVServer, watcher threads writing per-rank logs).
+
+TPU-native re-design: on TPU one process drives all of a host's chips, so
+the unit of launch is the HOST process (not per-GPU containers). The
+controller here:
+
+- resolves the node's rank against the master KV (the native TCPStore from
+  distributed/store.py — the KVServer analog) or --node_rank,
+- spawns `nproc_per_node` local worker processes with the PADDLE_* env
+  contract consumed by init_parallel_env (parallel_env.py) — global ranks
+  are node_rank * nproc_per_node + local_rank,
+- streams each worker to `<log_dir>/workerlog.<rank>` (reference log
+  layout) and mirrors rank 0 to stdout,
+- watches children: fail-fast (first failure tears the pod down) or, with
+  --max_restarts > 0, elastic restart of the whole pod (the reference
+  elastic controller's whole-job restart semantics).
+"""
+from .controller import Controller, LaunchConfig, launch_job
+
+__all__ = ["Controller", "LaunchConfig", "launch_job"]

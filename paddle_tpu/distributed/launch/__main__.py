@@ -1,0 +1,50 @@
+"""`python -m paddle_tpu.distributed.launch` CLI (reference: launch/main.py:18).
+
+Usage:
+    python -m paddle_tpu.distributed.launch \
+        [--nnodes N] [--nproc_per_node P] [--master host:port] \
+        [--node_rank R] [--job_id ID] [--log_dir DIR] [--max_restarts K] \
+        [--m | --module] script.py [script args...]
+"""
+import argparse
+import sys
+
+from .controller import LaunchConfig, launch_job
+
+
+def _parser():
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch", add_help=True)
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--nproc_per_node", type=int, default=1)
+    p.add_argument("--master", type=str, default=None,
+                   help="KV master host:port (required for nnodes>1)")
+    p.add_argument("--node_rank", type=int, default=None)
+    p.add_argument("--job_id", type=str, default="default")
+    p.add_argument("--log_dir", type=str, default="log")
+    p.add_argument("--max_restarts", type=int, default=0)
+    p.add_argument("--module", "--m", action="store_true", dest="module")
+    p.add_argument("script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p
+
+
+def main(argv=None) -> int:
+    args = _parser().parse_args(argv)
+    cfg = LaunchConfig(
+        script=args.script,
+        script_args=args.script_args,
+        nnodes=args.nnodes,
+        nproc_per_node=args.nproc_per_node,
+        master=args.master,
+        node_rank=args.node_rank,
+        job_id=args.job_id,
+        log_dir=args.log_dir,
+        max_restarts=args.max_restarts,
+        module=args.module,
+    )
+    return launch_job(cfg)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

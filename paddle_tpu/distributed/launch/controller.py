@@ -1,0 +1,181 @@
+"""Launcher controller: rendezvous, worker pod, watcher.
+
+Reference analog: launch/controllers/collective.py (CollectiveController
+.build_pod + watch), launch/job/pod.py (Container process wrapper),
+launch/utils/kv_server.py (master KV) — re-designed around host-level
+worker processes and the native TCPStore.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Sequence
+
+
+@dataclasses.dataclass
+class LaunchConfig:
+    script: str = ""
+    script_args: Sequence[str] = ()
+    nnodes: int = 1
+    nproc_per_node: int = 1
+    master: Optional[str] = None          # "host:port" KV master / coordinator
+    node_rank: Optional[int] = None       # None -> rendezvous via master KV
+    job_id: str = "default"
+    log_dir: str = "log"
+    max_restarts: int = 0                 # >0 enables elastic pod restarts
+    rendezvous_timeout: float = 120.0
+    envs: Dict[str, str] = dataclasses.field(default_factory=dict)
+    module: bool = False                  # python -m script
+
+
+class Controller:
+    def __init__(self, cfg: LaunchConfig):
+        self.cfg = cfg
+        self.procs: List[subprocess.Popen] = []
+        self.logs: List = []
+        self._store = None
+        self._server = None
+
+    # -- rendezvous --------------------------------------------------------
+    def _resolve_node_rank(self) -> int:
+        cfg = self.cfg
+        if cfg.nnodes <= 1:
+            return 0
+        if cfg.node_rank is not None:
+            return int(cfg.node_rank)
+        if not cfg.master:
+            raise ValueError("--master host:port is required when nnodes > 1")
+        from ..store import TCPStore
+
+        host, port = cfg.master.rsplit(":", 1)
+        # the lowest-rank candidate hosts the KV (reference: launch master
+        # auto-elected by who binds the port first)
+        try:
+            self._server = TCPStore(host, int(port), is_master=True,
+                                    timeout=cfg.rendezvous_timeout)
+            self._store = self._server
+        except (OSError, RuntimeError):
+            self._store = TCPStore(host, int(port), is_master=False,
+                                   timeout=cfg.rendezvous_timeout)
+        key = f"{cfg.job_id}/node_rank"
+        rank = int(self._store.add(key, 1)) - 1
+        if rank >= cfg.nnodes:
+            raise RuntimeError(
+                f"more nodes joined job {cfg.job_id!r} than nnodes={cfg.nnodes}")
+        return rank
+
+    # -- pod lifecycle -----------------------------------------------------
+    def _worker_env(self, node_rank: int, local_rank: int) -> Dict[str, str]:
+        cfg = self.cfg
+        world = cfg.nnodes * cfg.nproc_per_node
+        rank = node_rank * cfg.nproc_per_node + local_rank
+        env = dict(os.environ)
+        env.update(cfg.envs)
+        env.update(
+            PADDLE_TRAINER_ID=str(rank),
+            PADDLE_TRAINERS_NUM=str(world),
+            PADDLE_LOCAL_RANK=str(local_rank),
+            PADDLE_NNODES=str(cfg.nnodes),
+            PADDLE_JOB_ID=cfg.job_id,
+        )
+        if cfg.master:
+            # jax.distributed coordinator rides the port after the KV port
+            host, port = cfg.master.rsplit(":", 1)
+            env["PADDLE_MASTER"] = f"{host}:{int(port) + 1}"
+        return env
+
+    def build_pod(self, node_rank: int):
+        cfg = self.cfg
+        os.makedirs(cfg.log_dir, exist_ok=True)
+        for lr in range(cfg.nproc_per_node):
+            rank = node_rank * cfg.nproc_per_node + lr
+            logf = open(os.path.join(cfg.log_dir, f"workerlog.{rank}"), "ab")
+            cmd = [sys.executable]
+            if cfg.module:
+                cmd += ["-m", cfg.script]
+            else:
+                cmd += [cfg.script]
+            cmd += list(cfg.script_args)
+            p = subprocess.Popen(
+                cmd, env=self._worker_env(node_rank, lr),
+                stdout=logf, stderr=subprocess.STDOUT,
+                start_new_session=True,
+            )
+            self.procs.append(p)
+            self.logs.append(logf)
+
+    def _tail_rank0(self, pos: int) -> int:
+        """Mirror new rank-0 log bytes to our stdout (reference watcher
+        tails container 0)."""
+        try:
+            path = self.logs[0].name
+            with open(path, "rb") as f:
+                f.seek(pos)
+                data = f.read()
+            if data:
+                sys.stdout.buffer.write(data)
+                sys.stdout.flush()
+            return pos + len(data)
+        except (IndexError, OSError):
+            return pos
+
+    def stop_pod(self, sig=signal.SIGTERM):
+        for p in self.procs:
+            if p.poll() is None:
+                try:
+                    os.killpg(p.pid, sig)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        deadline = time.time() + 10
+        for p in self.procs:
+            try:
+                p.wait(max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        for f in self.logs:
+            try:
+                f.close()
+            except OSError:
+                pass
+        self.procs, self.logs = [], []
+
+    def watch(self) -> int:
+        """Poll children until all succeed or one fails (fail-fast)."""
+        pos = 0
+        while True:
+            pos = self._tail_rank0(pos)
+            codes = [p.poll() for p in self.procs]
+            if any(c not in (None, 0) for c in codes):
+                bad = next(i for i, c in enumerate(codes)
+                           if c not in (None, 0))
+                rc = codes[bad]
+                self.stop_pod()
+                return rc
+            if all(c == 0 for c in codes):
+                self._tail_rank0(pos)
+                return 0
+            time.sleep(0.2)
+
+    def run(self) -> int:
+        cfg = self.cfg
+        node_rank = self._resolve_node_rank()
+        restarts = 0
+        while True:
+            self.build_pod(node_rank)
+            rc = self.watch()
+            if rc == 0 or restarts >= cfg.max_restarts:
+                return rc
+            restarts += 1
+            print(f"[launch] pod failed rc={rc}; elastic restart "
+                  f"{restarts}/{cfg.max_restarts}", flush=True)
+
+
+def launch_job(cfg: LaunchConfig) -> int:
+    return Controller(cfg).run()

@@ -18,21 +18,81 @@ import sys
 __all__ = ["launch", "spawn", "run_commandline"]
 
 
-def spawn(func, args=(), nprocs=1, join=True, daemon=False, **options):
-    """Reference API parity. On TPU a single process owns every local chip,
-    so nprocs>1 local spawn is a CPU-emulation/debug path: we run
-    sequentially with PADDLE_TRAINER_ID set (parity tests use world_size 1
-    semantics; real scale-out is multi-host `launch`)."""
+def _spawn_target(func, args, rank, nprocs, backend):
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    os.environ["PADDLE_LOCAL_RANK"] = str(rank)
+    if backend:
+        # belt and braces with the parent-side env (set before p.start()):
+        # paddle_tpu/jax are already imported by the unpickle of this
+        # target, so re-pin directly too (legal until a backend initializes)
+        os.environ["PTPU_FORCE_PLATFORM"] = backend
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", backend)
+        except Exception:
+            pass
+    func(*args)
+
+
+def spawn(func, args=(), nprocs=1, join=True, daemon=False, backend=None,
+          **options):
+    """Reference API parity (launch/spawn.py). On TPU a single process owns
+    every local chip, so nprocs>1 is the CPU-emulation/debug path: children
+    run under multiprocessing "spawn" with the PADDLE_* env contract and
+    (by default) the CPU backend pinned via PTPU_FORCE_PLATFORM — one real
+    chip cannot be shared by several local processes.
+
+    `func` must be picklable (module-level). Returns the multiprocessing
+    context with `.processes` when join=False (reference return shape).
+    """
     if nprocs in (1, -1, None):
         os.environ.setdefault("PADDLE_TRAINER_ID", "0")
         os.environ.setdefault("PADDLE_TRAINERS_NUM", "1")
         func(*args)
-        return
-    raise NotImplementedError(
-        "local multi-process spawn has no TPU analog (one controller drives "
-        "all chips); use the Mesh APIs (paddle_tpu.parallel) for multi-chip "
-        "and distributed.launch for multi-host"
-    )
+        return None
+
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    backend = backend or "cpu"
+    procs = []
+    # children snapshot os.environ at start(): export the platform pin so
+    # the paddle_tpu import hook fires BEFORE any jax state exists in the
+    # child (the in-target re-pin alone runs after paddle_tpu imports)
+    prev = os.environ.get("PTPU_FORCE_PLATFORM")
+    os.environ["PTPU_FORCE_PLATFORM"] = backend
+    try:
+        for rank in range(nprocs):
+            p = ctx.Process(
+                target=_spawn_target, args=(func, args, rank, nprocs, backend),
+                daemon=daemon,
+            )
+            p.start()
+            procs.append(p)
+    finally:
+        if prev is None:
+            os.environ.pop("PTPU_FORCE_PLATFORM", None)
+        else:
+            os.environ["PTPU_FORCE_PLATFORM"] = prev
+
+    class _SpawnContext:
+        processes = procs
+
+        def join(self, timeout=None):
+            for proc in procs:
+                proc.join(timeout)
+            bad = [(i, proc.exitcode) for i, proc in enumerate(procs)
+                   if proc.exitcode not in (0, None)]
+            if bad:
+                raise RuntimeError(f"spawned process(es) failed: {bad}")
+            return all(proc.exitcode == 0 for proc in procs)
+
+    sc = _SpawnContext()
+    if join:
+        sc.join()
+    return sc
 
 
 def launch(training_script, args=(), hosts=None, nproc_per_node=1, master=None):

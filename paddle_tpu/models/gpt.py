@@ -581,7 +581,7 @@ class GPTForCausalLM(Layer):
         has_mask = loss_mask is not None
 
         def loss_fn(tail, h, ymb):
-            y_mb, mask_mb = ymb
+            y_mb, mask_mb, scale_mb = ymb
             hn = _stacked_ln(h, tail["ln_w"], tail["ln_b"], eps)
             logits = jnp.einsum("bsh,vh->bsv", hn, tail["wte"])
             # hard-label CE as logsumexp - picked (no [.., V] log-prob
@@ -593,8 +593,12 @@ class GPTForCausalLM(Layer):
             )[..., 0].astype(jnp.float32)
             per_tok = lse - picked
             if has_mask:
+                # scale_mb carries M/total_mask_count so the pipeline's
+                # mean over micro-batches reproduces the criterion's GLOBAL
+                # sum(loss*mask)/sum(mask) even when live-token counts
+                # differ across micro-batches
                 m = mask_mb.astype(jnp.float32)
-                return jnp.sum(per_tok * m) / jnp.clip(jnp.sum(m), 1.0)
+                return jnp.sum(per_tok * m) * scale_mb[0]
             return jnp.mean(per_tok)
 
         mask_arg = loss_mask if has_mask else labels  # placeholder leaf
@@ -602,7 +606,16 @@ class GPTForCausalLM(Layer):
         def fn(a, y, mask, wte_, lnw_, lnb_, *flat):
             params = dict(zip(names, flat))
             tail = {"wte": wte_, "ln_w": lnw_, "ln_b": lnb_}
-            return pipeline_1f1b(block, loss_fn, params, tail, a, (y, mask),
+            M = n_micro or axis_size("pp")
+            if has_mask:
+                total = jnp.clip(jnp.sum(mask.astype(jnp.float32)), 1.0)
+            else:
+                total = jnp.float32(1.0)
+            # per-microbatch [B/M] replica of the global scale (pipeline
+            # reshapes every y leaf along the batch dim)
+            scale = jnp.full((a.shape[0],), M / total, jnp.float32)
+            return pipeline_1f1b(block, loss_fn, params, tail, a,
+                                 (y, mask, jax.lax.stop_gradient(scale)),
                                  n_microbatches=n_micro)
 
         tensors = [getattr(blocks, n) for n in names]

@@ -1223,6 +1223,26 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=F
                 idx = jnp.floor(jnp.arange(outs) * (ins / outs)).astype(jnp.int32)
                 out = jnp.take(out, idx, axis=ax)
             return out
+        if meth == "linear" and align_corners:
+            # jax.image.resize is half-pixel (align_corners=False) only;
+            # corner-aligned sampling is a separable per-axis gather+lerp
+            # at positions i*(in-1)/(out-1) (interpolate_op align semantics)
+            out = a
+            for i, (ins, outs) in enumerate(zip(in_spatial, out_spatial)):
+                ax = 2 + i
+                if ins == 1 or outs == 1:
+                    out = jnp.take(out, jnp.zeros((outs,), jnp.int32), axis=ax)
+                    continue
+                pos = jnp.arange(outs) * ((ins - 1) / (outs - 1))
+                lo = jnp.floor(pos).astype(jnp.int32)
+                hi = jnp.minimum(lo + 1, ins - 1)
+                w = (pos - lo).astype(a.dtype)
+                shape = [1] * a.ndim
+                shape[ax] = outs
+                wb = w.reshape(shape)
+                out = (jnp.take(out, lo, axis=ax) * (1 - wb)
+                       + jnp.take(out, hi, axis=ax) * wb)
+            return out
         return jax.image.resize(a, (n, c) + out_spatial, method=meth)
 
     return apply(fn, x, name="interpolate")

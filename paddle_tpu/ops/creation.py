@@ -106,7 +106,9 @@ def eye(num_rows, num_columns=None, dtype="float32"):
 
 
 def diag(x, offset=0):
-    return Tensor(jnp.diag(x._data, k=offset))
+    from ..core.dispatch import apply
+
+    return apply(lambda a: jnp.diag(a, k=offset), x, name="diag")
 
 
 def tril(x, diagonal=0):

@@ -359,11 +359,15 @@ def nonzero(x, as_tuple=False):
 
 
 def masked_select(x, mask, name=None):
-    # Data-dependent output shape: host-side op (not jittable) — reference has
-    # the same dynamic-shape property (masked_select kernel).
-    arr = np.asarray(x._data)
+    # Data-dependent output shape: the mask must be concretized host-side
+    # (not jittable — reference masked_select kernel has the same dynamic-
+    # shape property), but the GATHER itself runs through `apply` with the
+    # now-static bool mask so gradients flow (masked_select_grad analog).
+    from ..core.dispatch import apply
+
     m = np.asarray(mask._data if isinstance(mask, Tensor) else mask)
-    return Tensor(jnp.asarray(arr[np.broadcast_to(m, arr.shape)]))
+    m = np.broadcast_to(m, x.shape)
+    return apply(lambda a: a[m], x, name="masked_select")
 
 
 def masked_fill(x, mask, value, name=None):

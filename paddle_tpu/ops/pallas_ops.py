@@ -78,10 +78,14 @@ def _dot_f32(a, b, transpose_b=False):
                                precision=prec)
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, seq_k,
-                      scale, causal, block_q):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, *refs, block_k, seq_k,
+                      scale, causal, block_q, has_mask):
     from jax.experimental import pallas as pl
 
+    if has_mask:
+        mask_ref, o_ref, lse_ref = refs
+    else:
+        o_ref, lse_ref = refs
     qi = pl.program_id(2)
     q = q_ref[0, :, :]                              # [block_q, d], input dtype
 
@@ -96,6 +100,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, seq_k,
         k = k_ref[0, pl.dslice(kb * block_k, block_k), :]
         v = v_ref[0, pl.dslice(kb * block_k, block_k), :]
         s = _dot_f32(q, k, transpose_b=True) * scale   # [bq, bk] fp32
+        if has_mask:
+            s = s + mask_ref[0, 0, :, pl.dslice(kb * block_k, block_k)
+                             ].astype(jnp.float32)
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -121,9 +128,14 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, seq_k,
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, *, block_k, seq_k, scale, causal, block_q):
+                         *refs, block_k, seq_k, scale, causal, block_q,
+                         has_mask):
     from jax.experimental import pallas as pl
 
+    if has_mask:
+        mask_ref, dq_ref = refs
+    else:
+        (dq_ref,) = refs
     qi = pl.program_id(2)
     q = q_ref[0, :, :]                            # [bq, d]
     do = do_ref[0, :, :]                          # [bq, d]
@@ -135,6 +147,9 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0, pl.dslice(kb * block_k, block_k), :]
         v = v_ref[0, pl.dslice(kb * block_k, block_k), :]
         s = _dot_f32(q, k, transpose_b=True) * scale
+        if has_mask:
+            s = s + mask_ref[0, 0, :, pl.dslice(kb * block_k, block_k)
+                             ].astype(jnp.float32)
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -154,10 +169,14 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, *, block_q, seq_q, scale, causal,
-                          block_k):
+                          *refs, block_q, seq_q, scale, causal, block_k,
+                          has_mask):
     from jax.experimental import pallas as pl
 
+    if has_mask:
+        mask_ref, dk_ref, dv_ref = refs
+    else:
+        dk_ref, dv_ref = refs
     ki = pl.program_id(2)
     k = k_ref[0, :, :]                            # [bk, d]
     v = v_ref[0, :, :]
@@ -170,6 +189,10 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lse = lse_ref[0, 0, pl.dslice(qb * block_q, block_q)]
         delta = delta_ref[0, 0, pl.dslice(qb * block_q, block_q)]
         s = _dot_f32(q, k, transpose_b=True) * scale   # [bq, bk]
+        if has_mask:
+            # mask block: [sq, block_k] column slice, sliced by q rows
+            s = s + mask_ref[0, 0, pl.dslice(qb * block_q, block_q), :
+                             ].astype(jnp.float32)
         if causal:
             q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -288,8 +311,22 @@ def _tuned_blocks(kernel, sq, sk, d, bh, dtype, is_causal, scale):
     return at.autotune("pallas_" + kernel, key, cands, runner)
 
 
-def _flash_fwd(q, k, v, is_causal, scale, block_q=None, block_k=None):
-    """q,k,v: [BH, S, D] (heads folded into batch) → (out, lse)."""
+def _interpret() -> bool:
+    # PTPU_PALLAS_INTERPRET=1 runs the kernels in pallas interpret mode so
+    # the CPU test mesh can exercise them (parity tests without a chip)
+    import os
+
+    return os.environ.get("PTPU_PALLAS_INTERPRET") == "1"
+
+
+def _flash_fwd(q, k, v, is_causal, scale, block_q=None, block_k=None,
+               n_heads=1, mask=None):
+    """q,k,v: [BH, S, D] (heads folded into batch) → (out, lse).
+
+    mask: optional additive [B, Hm, Sq, Sk] with Hm in {1, n_heads} —
+    loaded blockwise via its own BlockSpec, so a per-batch mask (Hm=1) is
+    never broadcast-materialized per head in HBM (the reference fuses the
+    same way: fused_softmax_mask_op reads the unexpanded mask)."""
     from jax.experimental import pallas as pl
 
     bh, sq, d = q.shape
@@ -303,6 +340,8 @@ def _flash_fwd(q, k, v, is_causal, scale, block_q=None, block_k=None):
     block_k = _largest_dividing_block(sk, block_k)
     assert block_q is not None and block_k is not None
 
+    H = n_heads
+    has_mask = mask is not None
     kernel = functools.partial(
         _flash_fwd_kernel,
         block_k=block_k,
@@ -310,29 +349,39 @@ def _flash_fwd(q, k, v, is_causal, scale, block_q=None, block_k=None):
         scale=scale,
         causal=is_causal,
         block_q=block_q,
+        has_mask=has_mask,
     )
-    grid = (bh, 1, sq // block_q)
+    grid = (bh // H, H, sq // block_q)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, h, i: (b * H + h, i, 0)),
+        pl.BlockSpec((1, sk, d), lambda b, h, i: (b * H + h, 0, 0)),
+        pl.BlockSpec((1, sk, d), lambda b, h, i: (b * H + h, 0, 0)),
+    ]
+    args = [q, k, v]
+    if has_mask:
+        bm, hm = mask.shape[0], mask.shape[1]
+        in_specs.append(pl.BlockSpec(
+            (1, 1, block_q, sk),
+            lambda b, h, i: (b if bm > 1 else 0, h if hm > 1 else 0, i, 0)))
+        args.append(mask)
     return pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, h, i: (b, i, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, h, i: (b, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, h, i: (b, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, h, i: (b, i, 0)),
-            pl.BlockSpec((1, 1, sq), lambda b, h, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, h, i: (b * H + h, i, 0)),
+            pl.BlockSpec((1, 1, sq), lambda b, h, i: (b * H + h, 0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
             jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
         ],
-    )(q, k, v)
+        interpret=_interpret(),
+    )(*args)
 
 
 def _flash_bwd(q, k, v, out, lse, do, is_causal, scale,
-               block_q=None, block_k=None):
+               block_q=None, block_k=None, n_heads=1, mask=None):
     """Blockwise flash backward: recomputes p per tile from (q,k,lse) —
     no S^2 materialization in HBM. Returns (dq, dk, dv), all [BH, S, D]."""
     from jax.experimental import pallas as pl
@@ -346,51 +395,88 @@ def _flash_bwd(q, k, v, out, lse, do, is_causal, scale,
     block_k = _largest_dividing_block(sk, block_k)
     assert block_q is not None and block_k is not None
 
+    H = n_heads
+    has_mask = mask is not None
+    bm = mask.shape[0] if has_mask else 1
+    hm = mask.shape[1] if has_mask else 1
+    interp = _interpret()
+
     do32 = do.astype(jnp.float32)
     delta = jnp.sum(do32 * out.astype(jnp.float32), axis=-1)[:, None, :]  # [bh,1,sq]
 
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, h, i: (b * H + h, i, 0)),
+        pl.BlockSpec((1, sk, d), lambda b, h, i: (b * H + h, 0, 0)),
+        pl.BlockSpec((1, sk, d), lambda b, h, i: (b * H + h, 0, 0)),
+        pl.BlockSpec((1, block_q, d), lambda b, h, i: (b * H + h, i, 0)),
+        pl.BlockSpec((1, 1, sq), lambda b, h, i: (b * H + h, 0, 0)),
+        pl.BlockSpec((1, 1, sq), lambda b, h, i: (b * H + h, 0, 0)),
+    ]
+    args = [q, k, v, do, lse, delta]
+    if has_mask:
+        in_specs.append(pl.BlockSpec(
+            (1, 1, block_q, sk),
+            lambda b, h, i: (b if bm > 1 else 0, h if hm > 1 else 0, i, 0)))
+        args.append(mask)
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_k=block_k, seq_k=sk,
-                          scale=scale, causal=is_causal, block_q=block_q),
-        grid=(bh, 1, sq // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, h, i: (b, i, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, h, i: (b, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, h, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, h, i: (b, i, 0)),
-            pl.BlockSpec((1, 1, sq), lambda b, h, i: (b, 0, 0)),
-            pl.BlockSpec((1, 1, sq), lambda b, h, i: (b, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, h, i: (b, i, 0)),
+                          scale=scale, causal=is_causal, block_q=block_q,
+                          has_mask=has_mask),
+        grid=(bh // H, H, sq // block_q),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda b, h, i: (b * H + h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-    )(q, k, v, do, lse, delta)
+        interpret=interp,
+    )(*args)
 
+    in_specs = [
+        pl.BlockSpec((1, sq, d), lambda b, h, i: (b * H + h, 0, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, h, i: (b * H + h, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, h, i: (b * H + h, i, 0)),
+        pl.BlockSpec((1, sq, d), lambda b, h, i: (b * H + h, 0, 0)),
+        pl.BlockSpec((1, 1, sq), lambda b, h, i: (b * H + h, 0, 0)),
+        pl.BlockSpec((1, 1, sq), lambda b, h, i: (b * H + h, 0, 0)),
+    ]
+    args = [q, k, v, do, lse, delta]
+    if has_mask:
+        in_specs.append(pl.BlockSpec(
+            (1, 1, sq, block_k),
+            lambda b, h, i: (b if bm > 1 else 0, h if hm > 1 else 0, 0, i)))
+        args.append(mask)
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, block_q=block_q, seq_q=sq,
-                          scale=scale, causal=is_causal, block_k=block_k),
-        grid=(bh, 1, sk // block_k),
-        in_specs=[
-            pl.BlockSpec((1, sq, d), lambda b, h, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, h, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, h, i: (b, i, 0)),
-            pl.BlockSpec((1, sq, d), lambda b, h, i: (b, 0, 0)),
-            pl.BlockSpec((1, 1, sq), lambda b, h, i: (b, 0, 0)),
-            pl.BlockSpec((1, 1, sq), lambda b, h, i: (b, 0, 0)),
-        ],
+                          scale=scale, causal=is_causal, block_k=block_k,
+                          has_mask=has_mask),
+        grid=(bh // H, H, sk // block_k),
+        in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, h, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, h, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, h, i: (b * H + h, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, h, i: (b * H + h, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
             jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
         ],
-    )(q, k, v, do, lse, delta)
+        interpret=interp,
+    )(*args)
     return dq, dk, dv
 
 
+def _mask_shape_ok(mask, B, H, sq, sk) -> bool:
+    shp = mask.shape
+    if len(shp) == 2:
+        shp = (1, 1) + shp
+    elif len(shp) == 3:
+        shp = (shp[0], 1) + shp[1:]
+    if len(shp) != 4:
+        return False
+    bm, hm, mq, mk = shp
+    return (mq, mk) == (sq, sk) and bm in (1, B) and hm in (1, H)
+
+
 def _pallas_ok(q, k, is_causal, mask) -> bool:
-    if mask is not None or not _on_tpu():
+    if not (_on_tpu() or _interpret()):
         return False
     b, sq, h, d = q.shape
     sk = k.shape[1]
@@ -398,7 +484,11 @@ def _pallas_ok(q, k, is_causal, mask) -> bool:
         return False
     if _largest_dividing_block(sq) is None or _largest_dividing_block(sk) is None:
         return False
-    return sq == sk
+    if mask is not None and not _mask_shape_ok(mask, b, h, sq, sk):
+        return False
+    # causal tiling assumes the diagonal lines up; cross-attention
+    # (sq != sk) takes the kernel path only unmasked-causal-free
+    return sq == sk or not is_causal
 
 
 def _fold_heads(x):
@@ -411,48 +501,81 @@ def _unfold_heads(x, b, h):
     return jnp.moveaxis(x.reshape(b, h, s, d), 1, 2)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_attn_core(q, k, v, is_causal, scale, use_pallas):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_attn_core(q, k, v, mask, is_causal, scale, use_pallas):
     if use_pallas:
         b, s, h, d = q.shape
         of, _ = _flash_fwd(_fold_heads(q), _fold_heads(k), _fold_heads(v),
-                           is_causal, scale)
+                           is_causal, scale, n_heads=h, mask=mask)
         return _unfold_heads(of, b, h)
-    return mha_reference(q, k, v, None, is_causal, scale)
+    return mha_reference(q, k, v, mask, is_causal, scale)
 
 
-def _flash_attn_fwd(q, k, v, is_causal, scale, use_pallas):
+def _flash_attn_fwd(q, k, v, mask, is_causal, scale, use_pallas):
     if use_pallas:
         b, s, h, d = q.shape
         qf, kf, vf = _fold_heads(q), _fold_heads(k), _fold_heads(v)
-        of, lse = _flash_fwd(qf, kf, vf, is_causal, scale)
-        return _unfold_heads(of, b, h), (qf, kf, vf, of, lse, (b, h))
-    out = mha_reference(q, k, v, None, is_causal, scale)
-    return out, (q, k, v, None, None, None)
+        of, lse = _flash_fwd(qf, kf, vf, is_causal, scale, n_heads=h,
+                             mask=mask)
+        return _unfold_heads(of, b, h), (qf, kf, vf, of, lse, mask, (b, h))
+    out = mha_reference(q, k, v, mask, is_causal, scale)
+    return out, (q, k, v, None, None, mask, None)
 
 
 def _flash_attn_bwd(is_causal, scale, use_pallas, res, g):
-    q, k, v, out, lse, bh_shape = res
+    q, k, v, out, lse, mask, bh_shape = res
+    # mask is additive: its cotangent exists but no caller consumes it
+    dmask = None if mask is None else jnp.zeros_like(mask)
     if use_pallas:
         b, h = bh_shape
         dq, dk, dv = _flash_bwd(q, k, v, out, lse, _fold_heads(g),
-                                is_causal, scale)
+                                is_causal, scale, n_heads=h, mask=mask)
         return (_unfold_heads(dq, b, h), _unfold_heads(dk, b, h),
-                _unfold_heads(dv, b, h))
+                _unfold_heads(dv, b, h), dmask)
     # XLA fallback: recompute-based backward through the reference
-    _, vjp_fn = jax.vjp(lambda a, b, c: mha_reference(a, b, c, None, is_causal, scale), q, k, v)
-    return vjp_fn(g)
+    _, vjp_fn = jax.vjp(
+        lambda a, b, c: mha_reference(a, b, c, mask, is_causal, scale),
+        q, k, v)
+    return vjp_fn(g) + (dmask,)
 
 
 _flash_attn_core.defvjp(_flash_attn_fwd, _flash_attn_bwd)
 
 
+def _normalize_mask(attn_mask):
+    """Bring a (shape-validated) user mask to additive [Bm, Hm, Sq, Sk]
+    without broadcasting it out in HBM."""
+    m = attn_mask
+    if m.ndim == 2:
+        m = m[None, None]
+    elif m.ndim == 3:
+        m = m[:, None]
+    if m.dtype == jnp.bool_:
+        m = jnp.where(m, jnp.float32(0), jnp.float32(_NEG_INF_MASK))
+    return m
+
+
+_NEG_INF_MASK = -1e30
+
+
 def flash_attention_arrays(q, k, v, attn_mask=None, is_causal=False, scale=None):
-    """Array-level entry (used inside compiled training steps)."""
+    """Array-level entry (used inside compiled training steps).
+
+    attn_mask on the KERNEL path is treated as a CONSTANT (stop_gradient):
+    a flash kernel never materializes the [Sq, Sk] probability tile in HBM,
+    so a mask cotangent would cost the O(S^2) write the kernel exists to
+    avoid — the same contract as the reference's fused attention
+    (fused_gate_attention does not emit a mask grad). Learned additive
+    biases that need gradients should use `mha_reference` (or shapes that
+    fall back to it), where the full vjp applies.
+    """
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     if _pallas_ok(q, k, is_causal, attn_mask):
-        return _flash_attn_core(q, k, v, is_causal, scale, True)
+        mask = None
+        if attn_mask is not None:
+            mask = jax.lax.stop_gradient(_normalize_mask(attn_mask))
+        return _flash_attn_core(q, k, v, mask, is_causal, scale, True)
     return mha_reference(q, k, v, attn_mask, is_causal, scale)
 
 

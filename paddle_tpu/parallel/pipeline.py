@@ -133,7 +133,10 @@ def pipeline_apply(
     staged = jax.tree_util.tree_map(
         lambda a: a.reshape((pp, L // pp) + a.shape[1:]), stacked_params
     )
-    out = run(staged, xs)
+    # partial-manual shard_map validates specs only under jit; eager calls
+    # (plain apply without jit.compile) need the wrapper — it inlines when
+    # already inside a trace
+    out = jax.jit(run)(staged, xs)
     return out.reshape((B,) + x.shape[1:])
 
 
@@ -364,7 +367,8 @@ def _pipeline_1f1b_impl(block_fn, loss_fn, n_microbatches, axis,
     staged = jax.tree_util.tree_map(
         lambda a: a.reshape((pp, L // pp) + a.shape[1:]), stacked_params
     )
-    loss, (gacc, tacc, dxs) = run(staged, tail_params, xs, ys)
+    # see pipeline_apply: jit makes eager invocation legal (inlines in-trace)
+    loss, (gacc, tacc, dxs) = jax.jit(run)(staged, tail_params, xs, ys)
     dparams = jax.tree_util.tree_map(
         lambda g, p: g.reshape((L,) + g.shape[2:]).astype(p.dtype),
         gacc, stacked_params)

@@ -13,11 +13,12 @@ import numpy as np
 
 from ..core.tensor import Tensor
 from ..core.dtype import convert_dtype
+from . import nn
 
 __all__ = [
     "InputSpec", "enable_static", "disable_static", "in_dynamic_mode",
     "Program", "program_guard", "default_main_program", "default_startup_program",
-    "Executor", "data", "name_scope", "gradients",
+    "Executor", "data", "name_scope", "gradients", "nn",
 ]
 
 _static_mode = False

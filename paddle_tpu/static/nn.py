@@ -1,0 +1,195 @@
+"""Control-flow ops (reference: python/paddle/static/nn/control_flow.py —
+while_loop :: While/while_op.cc:86, cond :: ConditionalBlock, case /
+switch_case; plus fluid.layers select semantics).
+
+TPU-native re-design — dual mode, matching the trace-the-eager-engine
+architecture (SURVEY §7):
+
+- EAGER (concrete predicate): plain Python branching/looping over taped
+  Tensor ops. Fully differentiable, arbitrary data-dependent trip counts —
+  what the reference's host-driven While scopes provide, for free.
+- TRACED (predicate is an XLA tracer, i.e. inside paddle_tpu.jit):
+  * cond / case / switch_case evaluate ALL branches and select outputs
+    with `where` keyed on the predicate. Gradients flow through every
+    branch (masked — mathematically the correct cond vjp), and closure-
+    captured parameters keep their gradients, which a lax.cond-via-apply
+    wrapping could not provide. XLA's own cond lowering frequently
+    speculates both branches on TPU anyway; branch bodies must be
+    side-effect-free under trace (they are traced — same rule as jit).
+  * while_loop lowers to lax.while_loop (dynamic trip count in ONE XLA
+    program — StableHLO while, SURVEY §8.10). Reverse-mode gradients
+    through a dynamic-trip-count while are impossible to stage statically
+    (XLA has no unbounded stash); use the eager path or bound the loop
+    with a scan for training-time loops. Matches jax's own contract.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply, unwrap
+from ..autograd import tape
+
+__all__ = ["while_loop", "cond", "case", "switch_case"]
+
+
+def _is_concrete(x) -> bool:
+    a = unwrap(x)
+    return not isinstance(a, jax.core.Tracer)
+
+
+def _wrap_tree(tree):
+    return jax.tree_util.tree_map(
+        lambda a: a if isinstance(a, Tensor) else Tensor(a), tree,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _select_trees(pred, true_tree, false_tree, name):
+    """Element-wise select between two identically-structured Tensor trees;
+    one taped op per leaf pair so gradients mask correctly."""
+    t_leaves, t_def = jax.tree_util.tree_flatten(
+        true_tree, is_leaf=lambda x: isinstance(x, Tensor))
+    f_leaves, f_def = jax.tree_util.tree_flatten(
+        false_tree, is_leaf=lambda x: isinstance(x, Tensor))
+    if t_def != f_def:
+        raise ValueError(
+            f"{name}: true_fn and false_fn must return the same structure; "
+            f"got {t_def} vs {f_def}")
+    out = []
+    for t, f in zip(t_leaves, f_leaves):
+        out.append(apply(
+            lambda p, a, b: jnp.where(p, a, b), pred, t, f,
+            name=name + "_select"))
+    return jax.tree_util.tree_unflatten(t_def, out)
+
+
+def cond(pred, true_fn: Callable = None, false_fn: Callable = None,
+         name: str = None, return_names=None):
+    """paddle.static.nn.cond parity (control_flow.py:874). true_fn/false_fn
+    take no arguments and close over outer tensors."""
+    if not callable(true_fn) or not callable(false_fn):
+        raise TypeError("cond requires callable true_fn and false_fn")
+    if _is_concrete(pred):
+        branch = true_fn if bool(unwrap(pred)) else false_fn
+        return _wrap_tree(branch())
+    t_out = _wrap_tree(true_fn())
+    f_out = _wrap_tree(false_fn())
+    return _select_trees(pred, t_out, f_out, name or "cond")
+
+
+def case(pred_fn_pairs: Sequence[Tuple[Any, Callable]],
+         default: Callable = None, name: str = None):
+    """First pred that is True selects its fn (control_flow.py:565); the
+    final fn doubles as default when none given (reference semantics)."""
+    pairs = list(pred_fn_pairs)
+    if not pairs:
+        raise ValueError("case needs at least one (pred, fn) pair")
+    for p, f in pairs:
+        if not callable(f):
+            raise TypeError("case fn must be callable")
+    if default is None:
+        pairs, (_, default) = pairs[:-1], pairs[-1]
+        if not pairs:
+            return _wrap_tree(default())
+    if all(_is_concrete(p) for p, _ in pairs):
+        for p, f in pairs:
+            if bool(unwrap(p)):
+                return _wrap_tree(f())
+        return _wrap_tree(default())
+    # traced: right-fold selects so the FIRST true pred wins
+    out = _wrap_tree(default())
+    for p, f in reversed(pairs):
+        out = _select_trees(p, _wrap_tree(f()), out, name or "case")
+    return out
+
+
+def switch_case(branch_index, branch_fns, default: Callable = None,
+                name: str = None):
+    """Dispatch on an int scalar (control_flow.py:698). branch_fns: dict
+    {int: fn} or sequence of (int, fn) or plain sequence of fns."""
+    if isinstance(branch_fns, dict):
+        keyed = sorted(branch_fns.items(), key=lambda kv: kv[0])
+    elif branch_fns and isinstance(branch_fns[0], (tuple, list)):
+        keyed = sorted(((int(k), f) for k, f in branch_fns),
+                       key=lambda kv: kv[0])
+    else:
+        keyed = list(enumerate(branch_fns))
+    keys = [k for k, _ in keyed]
+    if len(set(keys)) != len(keys):
+        raise ValueError(f"switch_case branch keys must be unique; got {keys}")
+    if default is None:
+        default = keyed[-1][1]  # reference: highest key is the default
+    if _is_concrete(branch_index):
+        idx = int(unwrap(branch_index))
+        for k, f in keyed:
+            if k == idx:
+                return _wrap_tree(f())
+        return _wrap_tree(default())
+    out = _wrap_tree(default())
+    for k, f in keyed:
+        pred = apply(lambda i, _k=k: unwrap(i) == _k, branch_index,
+                     name="switch_case_eq")
+        out = _select_trees(pred, _wrap_tree(f()), out,
+                            name or "switch_case")
+    return out
+
+
+def while_loop(cond: Callable, body: Callable, loop_vars,
+               is_test: bool = False, name: str = None):
+    """paddle.static.nn.while_loop parity (control_flow.py:1088; while_op.cc:86).
+
+    cond(*loop_vars) -> scalar bool Tensor; body(*loop_vars) -> updated
+    loop_vars (same structure). Returns the final loop_vars.
+    """
+    if not callable(cond) or not callable(body):
+        raise TypeError("while_loop requires callable cond and body")
+    if not isinstance(loop_vars, (list, tuple)) or not loop_vars:
+        raise ValueError("loop_vars must be a non-empty list/tuple")
+    loop_vars = list(_wrap_tree(list(loop_vars)))
+
+    pred0 = cond(*loop_vars)
+    if _is_concrete(pred0) and all(
+            _is_concrete(l) for l in jax.tree_util.tree_leaves(
+                loop_vars, is_leaf=lambda x: isinstance(x, Tensor))):
+        # eager: taped Python loop — differentiable, dynamic trip count
+        n_vars = len(loop_vars)
+        p = bool(unwrap(pred0))
+        while p:
+            out = body(*loop_vars)
+            if not isinstance(out, (list, tuple)):
+                out = [out]
+            if len(out) != n_vars:
+                raise ValueError("body must return as many values as loop_vars")
+            loop_vars = list(_wrap_tree(list(out)))
+            p = bool(unwrap(cond(*loop_vars)))
+        return loop_vars
+
+    # traced: one StableHLO while. Forward-only (see module docstring);
+    # run under no_grad so per-op vjp recording is skipped inside the body.
+    flat, treedef = jax.tree_util.tree_flatten(
+        loop_vars, is_leaf=lambda x: isinstance(x, Tensor))
+
+    def loop_fn(*arrays):
+        def c(carry):
+            vars_ = [Tensor(a) for a in carry]
+            with tape.no_grad():
+                return jnp.asarray(unwrap(cond(*jax.tree_util.tree_unflatten(
+                    treedef, vars_)))).reshape(())
+        def b(carry):
+            vars_ = [Tensor(a) for a in carry]
+            with tape.no_grad():
+                out = body(*jax.tree_util.tree_unflatten(treedef, vars_))
+            if not isinstance(out, (list, tuple)):
+                out = [out]
+            leaves = jax.tree_util.tree_leaves(
+                list(out), is_leaf=lambda x: isinstance(x, Tensor))
+            return tuple(unwrap(l) for l in leaves)
+        return jax.lax.while_loop(c, b, tuple(arrays))
+
+    with tape.no_grad():
+        out = apply(loop_fn, *flat, name=name or "while_loop")
+    out = out if isinstance(out, tuple) else (out,)
+    return list(jax.tree_util.tree_unflatten(treedef, list(out)))

@@ -10,6 +10,11 @@ import os
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 ).strip()
+# Child processes spawned by tests (DataLoader workers, store rendezvous,
+# launcher pods) import paddle_tpu WITHOUT this conftest; the env var makes
+# paddle_tpu/__init__ pin their backend to CPU too — otherwise a wedged
+# real-chip tunnel hangs every cross-process test.
+os.environ["PTPU_FORCE_PLATFORM"] = "cpu"
 
 import jax
 

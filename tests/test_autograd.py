@@ -185,3 +185,80 @@ def test_conv_grad_numeric():
         return F.conv2d(x, w, padding=1)
 
     check_grad(op, x_np, max_rel_err=1e-2)
+
+
+def test_grad_create_graph_double_backward():
+    """paddle.grad(create_graph=True): grads carry their own graph
+    (reference: egr::GeneralGrad + backward.yaml double-grad entries)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = paddle.to_tensor([0.3, -1.2, 2.0], stop_gradient=False)
+    y = (paddle.tanh(x) ** 2).sum()
+    (g1,) = paddle.grad(y, [x], create_graph=True)
+    g2 = paddle.grad(g1.sum(), [x])[0]
+
+    ref = jax.grad(lambda a: jnp.sum(jax.grad(
+        lambda b: jnp.sum(jnp.tanh(b) ** 2))(a)))(jnp.asarray([0.3, -1.2, 2.0]))
+    np.testing.assert_allclose(g2.numpy(), np.asarray(ref), rtol=1e-5)
+
+
+def test_grad_create_graph_matmul_chain():
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    a_np = rng.randn(3, 3).astype(np.float32)
+    x_np = rng.randn(3).astype(np.float32)
+    a = paddle.to_tensor(a_np, stop_gradient=False)
+    x = paddle.to_tensor(x_np, stop_gradient=False)
+
+    y = (paddle.matmul(a, x.reshape([3, 1])).squeeze() ** 3).sum()
+    (gx,) = paddle.grad(y, [x], create_graph=True)
+    gxx = paddle.grad((gx ** 2).sum(), [x])[0]
+
+    def f(xa):
+        return jnp.sum((a_np @ xa) ** 3)
+
+    ref = jax.grad(lambda v: jnp.sum(jax.grad(f)(v) ** 2))(jnp.asarray(x_np))
+    np.testing.assert_allclose(gxx.numpy(), np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+
+def test_grad_create_graph_triple():
+    """Third-order grads through the taped backward."""
+    import jax
+    import jax.numpy as jnp
+
+    x = paddle.to_tensor([0.7], stop_gradient=False)
+    y = (x ** 4).sum()
+    (g1,) = paddle.grad(y, [x], create_graph=True)
+    (g2,) = paddle.grad(g1.sum(), [x], create_graph=True)
+    (g3,) = paddle.grad(g2.sum(), [x])
+    np.testing.assert_allclose(g3.numpy(), [24 * 0.7], rtol=1e-5)
+
+
+def test_backward_create_graph_grad_field():
+    """x.grad from a create_graph backward is itself differentiable."""
+    from paddle_tpu.autograd import tape
+
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = (x ** 3).sum()
+    tape.backward([y], create_graph=True)
+    g = x.grad                      # 3x^2 = 12, carries graph
+    assert abs(g.item() - 12.0) < 1e-5
+    (gg,) = paddle.grad(g.sum(), [x])
+    np.testing.assert_allclose(gg.numpy(), [12.0], rtol=1e-5)  # 6x
+
+
+def test_norm_layer_double_backward():
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(1)
+    x_np = rng.randn(4, 8).astype(np.float32)
+    x = paddle.to_tensor(x_np, stop_gradient=False)
+    y = F.layer_norm(x, normalized_shape=[8]).sum()
+    (g1,) = paddle.grad(y, [x], create_graph=True)
+    g2 = paddle.grad((g1 ** 2).sum(), [x])[0]
+    assert g2.shape == x.shape
+    assert np.isfinite(g2.numpy()).all()

@@ -1,0 +1,142 @@
+"""Launcher tests (reference: test_launch_coverage.py / test_run.py —
+controller spawns workers with the env contract, per-rank logs, fail-fast).
+
+Worker scripts avoid importing jax so the tests exercise pure process
+orchestration quickly.
+"""
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from paddle_tpu.distributed.launch import LaunchConfig, launch_job
+from paddle_tpu.distributed.launch_mod import spawn
+
+
+def _write(tmp_path, name, body):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+def test_single_node_multi_proc_env_and_logs(tmp_path):
+    script = _write(tmp_path, "worker.py", """
+        import os, sys
+        rank = os.environ["PADDLE_TRAINER_ID"]
+        world = os.environ["PADDLE_TRAINERS_NUM"]
+        local = os.environ["PADDLE_LOCAL_RANK"]
+        print(f"rank={rank} world={world} local={local}", flush=True)
+    """)
+    log_dir = str(tmp_path / "logs")
+    rc = launch_job(LaunchConfig(
+        script=script, nproc_per_node=3, log_dir=log_dir))
+    assert rc == 0
+    seen = set()
+    for r in range(3):
+        text = open(os.path.join(log_dir, f"workerlog.{r}")).read()
+        assert f"rank={r} world=3 local={r}" in text
+        seen.add(r)
+    assert seen == {0, 1, 2}
+
+
+def test_fail_fast_kills_pod(tmp_path):
+    script = _write(tmp_path, "worker.py", """
+        import os, sys, time
+        if os.environ["PADDLE_TRAINER_ID"] == "1":
+            sys.exit(3)
+        time.sleep(60)   # must be torn down by the watcher, not wait 60s
+    """)
+    import time
+    t0 = time.time()
+    rc = launch_job(LaunchConfig(
+        script=script, nproc_per_node=2, log_dir=str(tmp_path / "logs")))
+    assert rc == 3
+    assert time.time() - t0 < 30
+
+
+def test_elastic_restart_retries(tmp_path):
+    marker = tmp_path / "attempts"
+    script = _write(tmp_path, "worker.py", f"""
+        import os, sys
+        path = {str(marker)!r}
+        n = int(open(path).read()) if os.path.exists(path) else 0
+        open(path, "w").write(str(n + 1))
+        sys.exit(0 if n >= 1 else 7)   # fail first attempt, succeed second
+    """)
+    rc = launch_job(LaunchConfig(
+        script=script, nproc_per_node=1, max_restarts=2,
+        log_dir=str(tmp_path / "logs")))
+    assert rc == 0
+    assert int(marker.read_text()) == 2
+
+
+def test_two_node_rendezvous_assigns_distinct_ranks(tmp_path):
+    """Two controller processes on one box rendezvous through the KV master
+    and carve out disjoint global ranks."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    worker = _write(tmp_path, "worker.py", """
+        import os, pathlib
+        out = pathlib.Path(os.environ["OUT_DIR"])
+        out.mkdir(exist_ok=True)
+        (out / f"rank_{os.environ['PADDLE_TRAINER_ID']}").write_text(
+            os.environ["PADDLE_TRAINERS_NUM"])
+    """)
+    driver = _write(tmp_path, "driver.py", f"""
+        import sys
+        sys.path.insert(0, {str(os.getcwd())!r})
+        from paddle_tpu.distributed.launch import LaunchConfig, launch_job
+        sys.exit(launch_job(LaunchConfig(
+            script={worker!r}, nnodes=2, nproc_per_node=2,
+            master="127.0.0.1:{port}", job_id="t2n",
+            log_dir=sys.argv[1])))
+    """)
+    env = dict(os.environ, OUT_DIR=str(tmp_path / "out"),
+               PTPU_FORCE_PLATFORM="cpu")  # don't touch a real backend
+    p1 = subprocess.Popen([sys.executable, driver, str(tmp_path / "l1")], env=env)
+    p2 = subprocess.Popen([sys.executable, driver, str(tmp_path / "l2")], env=env)
+    assert p1.wait(120) == 0
+    assert p2.wait(120) == 0
+    ranks = sorted(p.name for p in (tmp_path / "out").iterdir())
+    assert ranks == ["rank_0", "rank_1", "rank_2", "rank_3"]
+    for p in (tmp_path / "out").iterdir():
+        assert p.read_text() == "4"
+
+
+def _spawn_worker(out_dir):
+    import pathlib
+    rank = os.environ["PADDLE_TRAINER_ID"]
+    pathlib.Path(out_dir, f"spawn_{rank}").write_text(
+        os.environ["PADDLE_TRAINERS_NUM"])
+
+
+def test_spawn_multiprocess(tmp_path):
+    spawn(_spawn_worker, args=(str(tmp_path),), nprocs=2)
+    assert sorted(p.name for p in tmp_path.iterdir()) == ["spawn_0", "spawn_1"]
+    for p in tmp_path.iterdir():
+        assert p.read_text() == "2"
+
+
+def test_spawn_propagates_failure(tmp_path):
+    with pytest.raises(RuntimeError):
+        spawn(_spawn_fail, nprocs=2)
+
+
+def _spawn_fail():
+    raise SystemExit(5)
+
+
+def test_cli_parser_roundtrip(tmp_path):
+    from paddle_tpu.distributed.launch.__main__ import _parser
+
+    args = _parser().parse_args([
+        "--nnodes", "2", "--nproc_per_node", "4", "--master", "h:123",
+        "--node_rank", "1", "--log_dir", "L", "train.py", "--lr", "0.1"])
+    assert args.nnodes == 2 and args.nproc_per_node == 4
+    assert args.master == "h:123" and args.node_rank == 1
+    assert args.script == "train.py"
+    assert args.script_args == ["--lr", "0.1"]

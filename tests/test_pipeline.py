@@ -200,3 +200,28 @@ def test_gpt_1f1b_schedule_parity():
     base = _stacked_losses(dict())
     f1b = _stacked_losses(dict(pp=2), schedule="1f1b")
     np.testing.assert_allclose(base, f1b, rtol=2e-2, atol=2e-3)
+
+
+def test_gpt_1f1b_loss_mask_global_mean():
+    """With a loss_mask whose live-token counts differ per micro-batch, the
+    1F1B loss must equal the criterion's GLOBAL sum(loss*mask)/sum(mask) —
+    not a mean of per-micro-batch means."""
+    paddle.seed(7)
+    parallel.init_mesh(pp=2)
+    cfg = gpt_test_config(num_hidden_layers=4, stacked_blocks=True,
+                          pp_schedule="1f1b", pp_num_microbatches=4)
+    model = parallel.place_model(GPTForCausalLM(cfg))
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, 128, (8, 16)).astype("int32"))
+    lab = paddle.to_tensor(rng.randint(0, 128, (8, 16)).astype("int32"))
+    # wildly uneven counts: first micro-batch rows nearly all live, last
+    # nearly all masked
+    mask_np = (rng.rand(8, 16) < np.linspace(0.95, 0.1, 8)[:, None]
+               ).astype("float32")
+    mask_np[0, 0] = 1.0  # at least one live token
+    mask = paddle.to_tensor(mask_np)
+
+    f1b = float(model.pretrain_loss(ids, lab, loss_mask=mask))
+    crit = GPTPretrainingCriterion(cfg)
+    ref = float(crit(model(ids), lab, mask))
+    np.testing.assert_allclose(f1b, ref, rtol=1e-4)
