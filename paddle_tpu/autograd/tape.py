@@ -97,6 +97,7 @@ class Node:
         "n_outs",
         "name",
         "fwd_fn",
+        "input_versions",
         "__weakref__",
     )
 
@@ -113,6 +114,34 @@ class Node:
         # is differentiable wrt BOTH cotangents and primals (the reference's
         # double-grad GradNodes from backward.yaml play this role).
         self.fwd_fn = fwd_fn
+        # inplace-version snapshot (reference tensor_wrapper.h): backward
+        # errors if a saved input was mutated after this forward recorded.
+        self.input_versions = [getattr(t, "_version", 0) for t in inputs]
+
+    def check_versions(self):
+        for t, v in zip(self.inputs, self.input_versions):
+            cur = getattr(t, "_version", 0)
+            if cur != v:
+                raise RuntimeError(
+                    f"one of the tensors needed for the backward of "
+                    f"{self.name!r} was modified in place after the forward "
+                    f"ran (saved version {v}, current {cur}); gradients "
+                    f"would be wrong. Clone the tensor before mutating it, "
+                    f"or re-run the forward."
+                )
+
+    def ensure_vjp(self):
+        """Materialize the pullback lazily (dispatch.apply records only the
+        pure forward — see the eager-overhead note there). Valid because
+        check_versions has confirmed the saved inputs are unmutated."""
+        if self.vjp_fn is None:
+            if self.fwd_fn is None:
+                raise RuntimeError(
+                    f"node {self.name!r} has neither a pullback nor a "
+                    "replayable forward")
+            _, self.vjp_fn = jax.vjp(self.fwd_fn,
+                                     *[t._data for t in self.inputs])
+        return self.vjp_fn
 
     def __repr__(self):
         return f"<GradNode {self.name} n_outs={self.n_outs}>"
@@ -281,10 +310,12 @@ def backward(tensors, grad_tensors=None, retain_graph=False, capture=None,
             cts.append(ct)
         if not any_ct:
             continue
+        node.check_versions()
         if create_graph:
             in_cts = _node_backward_recorded(node, cts)
         else:
-            in_cts = node.vjp_fn(tuple(cts) if node.n_outs > 1 else cts[0])
+            vjp_fn = node.ensure_vjp()
+            in_cts = vjp_fn(tuple(cts) if node.n_outs > 1 else cts[0])
         for t, needs, ct in zip(node.inputs, node.input_needs_grad, in_cts):
             if not needs or ct is None:
                 continue

@@ -51,23 +51,42 @@ def apply(fn: Callable, *tensor_args, n_outs=None, name=None, **static_kwargs):
     ]
     trace_grad = tape.is_grad_enabled() and any(needs)
 
-    if trace_grad:
-        out, vjp_fn = jax.vjp(fn_c, *arrays)
-    else:
-        out = fn_c(*arrays)
+    # Forward runs WITHOUT jax.vjp: linearization tracing costs ~5x the op
+    # itself on eager dispatch (measured 4295us vs 776us for a 256^2
+    # matmul chain on CPU), so the tape stores the pure forward and
+    # materializes the pullback lazily at backward time (tape.Node
+    # .ensure_vjp) — forwards that never reach a backward (eval loops
+    # without no_grad, the SURVEY §7 "eager overhead" hard part) no
+    # longer pay for gradients. Under jit tracing the recomputed forward
+    # dedups via XLA CSE.
+    out = fn_c(*arrays)
 
     multi = isinstance(out, (tuple, list))
     outs = list(out) if multi else [out]
     out_ts = [Tensor(o) for o in outs]
 
     if trace_grad:
-        tape.record(vjp_fn, ts, needs, out_ts,
+        tape.record(None, ts, needs, out_ts,
                     name=name or getattr(fn, "__name__", "op"), fwd_fn=fn_c)
+
+    prog = _static_recording()
+    if prog is not None:
+        prog._record_op(fn_c, ts, out_ts,
+                        name=name or getattr(fn, "__name__", "op"),
+                        attrs=static_kwargs)
 
     if _nan_check_enabled():
         _check_nan_inf(outs, name or getattr(fn, "__name__", "op"))
 
     return tuple(out_ts) if multi else out_ts[0]
+
+
+def _static_recording():
+    """Program under construction when enable_static() + program building
+    is active (static/__init__.py) — the append_op hook."""
+    from ..static import _recording_program
+
+    return _recording_program()
 
 
 def _nan_check_enabled():

@@ -24,7 +24,7 @@ __all__ = ["Tensor", "to_tensor"]
 
 class Tensor:
     __slots__ = (
-        "_data",
+        "_data_",
         "stop_gradient",
         "grad",
         "_grad_node",
@@ -32,10 +32,19 @@ class Tensor:
         "name",
         "persistable",
         "_hooks",
+        "_version",
         "__weakref__",
     )
 
     def __init__(self, data, stop_gradient=True, name=None):
+        # inplace-version counter (reference: eager/tensor_wrapper.h
+        # inplace_version check): the _data setter bumps it on EVERY
+        # rebind, so no mutation path can forget; the tape snapshots it at
+        # record time and errors on backward if a saved input was mutated
+        # after the forward ran (backward replays the forward lazily —
+        # dispatch.apply — so a missed bump would mean silently wrong
+        # gradients, not just a stale-aliasing nicety).
+        self._version = 0
         self._data = data  # jax.Array (or tracer under jit)
         self.stop_gradient = stop_gradient
         self.grad = None
@@ -44,6 +53,18 @@ class Tensor:
         self.name = name
         self.persistable = False
         self._hooks = None
+
+    @property
+    def _data(self):
+        return self._data_
+
+    @_data.setter
+    def _data(self, value):
+        self._data_ = value
+        self._version += 1
+
+    def _bump_version(self):
+        self._version += 1
 
     # -- basic metadata ----------------------------------------------------
     @property
@@ -161,7 +182,7 @@ class Tensor:
 
     # -- mutation (eager-only; used by optimizers / Layer.to) --------------
     def _set_data(self, arr):
-        self._data = arr
+        self._data = arr   # property setter bumps the inplace version
 
     def set_value(self, value):
         if isinstance(value, Tensor):

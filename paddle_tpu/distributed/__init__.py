@@ -37,6 +37,9 @@ from .sharding import group_sharded_parallel, save_group_sharded_model
 from .launch_mod import spawn, launch
 from .store import TCPStore
 from . import auto_parallel
+from . import rpc
+from . import tuner
+from .tuner import OptimizationTuner
 
 __all__ = [
     "init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",

@@ -124,6 +124,23 @@ class Engine:
         self._strategy = strategy or Strategy()
         self._compiled = None
 
+    def tune(self, global_batch, cluster=None, top_k=5, measure=False):
+        """Search parallel plans for this engine's model (reference:
+        tuner/optimization_tuner.py via Engine _tune). Returns ranked
+        Plans; apply one with paddle.parallel.init_mesh(**plan.mesh_kwargs())."""
+        from .tuner import ClusterSpec, ModelSpec, OptimizationTuner
+
+        cfg = getattr(self._model, "cfg", None) or getattr(
+            getattr(self._model, "gpt", None), "cfg", None)
+        if cfg is None or not hasattr(cfg, "hidden_size"):
+            raise ValueError(
+                "Engine.tune needs a transformer-shaped model config "
+                "(hidden_size/num_hidden_layers); construct a "
+                "distributed.tuner.ModelSpec manually for other models")
+        spec = ModelSpec.from_gpt_config(cfg, global_batch)
+        return OptimizationTuner(spec, cluster or ClusterSpec()).tune(
+            top_k=top_k, measure=measure)
+
     def prepare(self, inputs_spec=None, labels_spec=None, mode="train"):
         from .. import jit
 

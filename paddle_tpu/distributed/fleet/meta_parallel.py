@@ -50,13 +50,44 @@ class MetaParallelBase(Layer):
 
 
 class TensorParallel(MetaParallelBase):
-    """mp wrapper (reference meta_parallel/tensor_parallel.py:27 broadcasts
-    params within the mp group at init; on a mesh, placement of annotated
-    params happens at compile/device_put time — nothing to broadcast)."""
+    """mp wrapper (reference meta_parallel/tensor_parallel.py:27). The
+    reference broadcasts params within the mp group at init; on a mesh the
+    equivalent guarantee is that every parameter is PLACED with its
+    annotated sharding — so wrapping eagerly device_puts the model
+    (parallel.place_model) and verifies an mp axis actually exists, the
+    failure the reference's broadcast would have surfaced."""
+
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__(layers, hcg, strategy)
+        from ...parallel import place_model
+        from ...parallel.mesh import axis_size
+
+        if axis_size("mp") <= 1:
+            import warnings
+
+            warnings.warn(
+                "TensorParallel wrapper with mp mesh axis of size 1 — "
+                "init_mesh(mp=...) first for tensor parallelism to apply")
+        place_model(layers)
 
 
 class ShardingParallel(MetaParallelBase):
-    pass
+    """ZeRO wrapper (reference meta_parallel/sharding_parallel.py): state
+    sharding itself lives in the optimizer (distributed/sharding.py group
+    sharded stages); the wrapper places the model and validates the axis."""
+
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__(layers, hcg, strategy)
+        from ...parallel import place_model
+        from ...parallel.mesh import axis_size
+
+        if axis_size("sharding") <= 1:
+            import warnings
+
+            warnings.warn(
+                "ShardingParallel wrapper with sharding mesh axis of size 1 "
+                "— init_mesh(sharding=...) first for ZeRO to apply")
+        place_model(layers)
 
 
 class LayerDesc:

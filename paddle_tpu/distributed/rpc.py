@@ -1,0 +1,213 @@
+"""distributed.rpc (reference: python/paddle/distributed/rpc/rpc.py:73 —
+init_rpc / rpc_sync / rpc_async / get_worker_info / shutdown over a
+master-rendezvous'd worker registry).
+
+TPU-native re-design: the reference rides brpc+protobuf; here each worker
+runs a small threaded TCP server executing pickled python callables, and
+worker discovery rides the native TCPStore (distributed/store.py) — the
+same rendezvous fabric the launcher and elastic manager use. RPC in this
+framework is control-plane machinery (parameter-server-style coordination,
+metrics aggregation); the data plane is XLA collectives, never RPC.
+
+Trust model matches the reference: callables are pickled, so only run
+inside a trusted cluster network (the reference's brpc endpoints are
+likewise unauthenticated within the job).
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import dataclasses
+import os
+import pickle
+import socket
+import struct
+import threading
+from typing import Any, Dict, Optional
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "get_worker_info",
+           "get_all_worker_infos", "get_current_worker_info", "shutdown",
+           "WorkerInfo"]
+
+_MAX_FRAME = 64 << 20
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+class _State:
+    def __init__(self):
+        self.store = None
+        self.me: Optional[WorkerInfo] = None
+        self.world_size = 0
+        self.server: Optional[socket.socket] = None
+        self.server_thread = None
+        self.pool = None
+        self.workers: Dict[str, WorkerInfo] = {}
+        self.stopping = False
+
+
+_state = _State()
+
+
+def _send_frame(sock, payload: bytes):
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_frame(sock) -> bytes:
+    hdr = _recv_exact(sock, 8)
+    (n,) = struct.unpack("<Q", hdr)
+    if n > _MAX_FRAME:
+        raise RuntimeError(f"rpc frame too large: {n}")
+    return _recv_exact(sock, n)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        buf += chunk
+    return buf
+
+
+def _serve(server):
+    while not _state.stopping:
+        try:
+            conn, _ = server.accept()
+        except OSError:
+            return
+        threading.Thread(target=_handle, args=(conn,), daemon=True).start()
+
+
+def _handle(conn):
+    try:
+        with conn:
+            fn, args, kwargs = pickle.loads(_recv_frame(conn))
+            try:
+                result = (True, fn(*args, **kwargs))
+            except Exception as e:  # ship the failure back to the caller
+                result = (False, e)
+            _send_frame(conn, pickle.dumps(result))
+    except (ConnectionError, OSError):
+        pass
+
+
+def init_rpc(name: str, rank: int = None, world_size: int = None,
+             master_endpoint: str = None):
+    """Register this worker and wait for the full world (rpc.py:73).
+    rank 0 hosts the rendezvous store at master_endpoint."""
+    from .store import TCPStore
+
+    if _state.me is not None:
+        raise RuntimeError("init_rpc already called")
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0) if rank is None else rank)
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)
+                    if world_size is None else world_size)
+    master_endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER_ENDPOINT", "127.0.0.1:8711")
+    host, port = master_endpoint.rsplit(":", 1)
+
+    server = socket.create_server(("0.0.0.0", 0))
+    my_port = server.getsockname()[1]
+    _state.server = server
+    _state.stopping = False
+    _state.server_thread = threading.Thread(
+        target=_serve, args=(server,), daemon=True)
+    _state.server_thread.start()
+    _state.pool = concurrent.futures.ThreadPoolExecutor(max_workers=8)
+
+    store = TCPStore(host, int(port), is_master=(rank == 0),
+                     world_size=world_size)
+    _state.store = store
+    my_ip = "127.0.0.1" if host in ("127.0.0.1", "localhost") else \
+        socket.gethostbyname(socket.gethostname())
+    me = WorkerInfo(name, rank, my_ip, my_port)
+    store.set(f"rpc/worker/{name}",
+              pickle.dumps(dataclasses.astuple(me)))
+    store.set(f"rpc/rank/{rank}", name.encode())
+    store.add("rpc/joined", 1)
+    store.barrier("rpc_init", world_size)
+    _state.me = me
+    _state.world_size = world_size
+    return me
+
+
+def get_current_worker_info() -> WorkerInfo:
+    _check_init()
+    return _state.me
+
+
+def get_worker_info(name: str) -> WorkerInfo:
+    _check_init()
+    if name not in _state.workers:
+        raw = _state.store.get(f"rpc/worker/{name}")
+        _state.workers[name] = WorkerInfo(*pickle.loads(raw))
+    return _state.workers[name]
+
+
+def get_all_worker_infos():
+    _check_init()
+    out = []
+    for r in range(_state.world_size):
+        name = _state.store.get(f"rpc/rank/{r}").decode()
+        out.append(get_worker_info(name))
+    return out
+
+
+def rpc_sync(to: str, fn, args=None, kwargs=None, timeout: float = 60.0):
+    """Run fn(*args, **kwargs) on worker `to`, blocking for the result."""
+    return _call(to, fn, args or (), kwargs or {}, timeout)
+
+
+def rpc_async(to: str, fn, args=None, kwargs=None, timeout: float = 60.0):
+    """Like rpc_sync but returns a Future (reference returns a FutureWrapper
+    with .wait(); concurrent.futures.Future has the same .result surface)."""
+    _check_init()
+    fut = _state.pool.submit(_call, to, fn, args or (), kwargs or {}, timeout)
+    fut.wait = fut.result  # reference API spells it .wait()
+    return fut
+
+
+def _call(to, fn, args, kwargs, timeout):
+    _check_init()
+    info = get_worker_info(to)
+    with socket.create_connection((info.ip, info.port), timeout=timeout) as s:
+        s.settimeout(timeout)
+        _send_frame(s, pickle.dumps((fn, args, kwargs)))
+        ok, payload = pickle.loads(_recv_frame(s))
+    if not ok:
+        raise payload
+    return payload
+
+
+def shutdown():
+    """Graceful stop: barrier so no peer is mid-call, then close."""
+    if _state.me is None:
+        return
+    try:
+        _state.store.barrier("rpc_shutdown", _state.world_size)
+    except Exception:
+        pass
+    _state.stopping = True
+    try:
+        _state.server.close()
+    except OSError:
+        pass
+    if _state.pool is not None:
+        _state.pool.shutdown(wait=False)
+    try:
+        _state.store.close()
+    except Exception:
+        pass
+    _state.__init__()
+
+
+def _check_init():
+    if _state.me is None:
+        raise RuntimeError("call paddle.distributed.rpc.init_rpc first")

@@ -1,8 +1,135 @@
-"""incubate.autograd (reference: python/paddle/incubate/autograd — prim
-vjp/jvp API). TPU-native: jax transforms ARE the primitive system."""
+"""incubate.autograd (reference: python/paddle/incubate/autograd —
+functional.py Jacobian/Hessian lazy matrices, primapi.forward_grad/grad,
+primx enable_prim mode).
+
+TPU-native position: the reference lowers programs to a hand-maintained
+primitive op set (primops.py) so linearize/transpose rules can run as
+program passes; here jax's jaxpr IS that primitive IR and jvp/vjp ARE the
+linearize/transpose passes. What this module adds over re-exports:
+
+- Jacobian / Hessian: lazy matrix views with reference indexing semantics
+  (rows computed on demand via one vjp per requested row, not the dense
+  jacobian up front).
+- forward_grad / grad_: the primapi surface (forward- and reverse-mode
+  grads of a function at concrete inputs).
+- enable_prim / disable_prim / prim_enabled: mode flag kept for API
+  parity; both modes execute the same jax transforms (there is no
+  separate non-primitive path to fall back to).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
 from ..autograd.functional import vjp, jvp, jacobian, hessian
+from ..core.tensor import Tensor
 
-Jacobian = jacobian
-Hessian = hessian
+__all__ = ["vjp", "jvp", "jacobian", "hessian", "Jacobian", "Hessian",
+           "forward_grad", "grad_", "enable_prim", "disable_prim",
+           "prim_enabled"]
 
-__all__ = ["vjp", "jvp", "jacobian", "hessian", "Jacobian", "Hessian"]
+_prim = False
+
+
+def enable_prim():
+    global _prim
+    _prim = True
+
+
+def disable_prim():
+    global _prim
+    _prim = False
+
+
+def prim_enabled():
+    return _prim
+
+
+def _unwrap(xs):
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    return [x._data if isinstance(x, Tensor) else jnp.asarray(x) for x in xs]
+
+
+def _wrap_fn(func):
+    def fn(*arrays):
+        out = func(*[Tensor(a) for a in arrays])
+        if isinstance(out, (list, tuple)):
+            return tuple(o._data if isinstance(o, Tensor) else o for o in out)
+        return out._data if isinstance(out, Tensor) else out
+    return fn
+
+
+class Jacobian:
+    """Lazy Jacobian matrix J[i, j] = d out_i / d in_j (reference
+    functional.py Jacobian: 2-D view over flattened out/in, rows computed
+    on demand)."""
+
+    def __init__(self, func, xs, is_batched=False):
+        self._arrays = _unwrap(xs)
+        self._fn = _wrap_fn(func)
+        out, self._pull = jax.vjp(self._fn, *self._arrays)
+        if isinstance(out, tuple):
+            raise ValueError("Jacobian expects a single-output function")
+        self._out = out
+        self._rows = int(out.size)
+        self._cols = int(sum(a.size for a in self._arrays))
+        self._cache = {}
+
+    @property
+    def shape(self):
+        return (self._rows, self._cols)
+
+    def _row(self, i):
+        if i not in self._cache:
+            seed = jnp.zeros(self._out.shape, self._out.dtype
+                             ).reshape(-1).at[i].set(1.0).reshape(self._out.shape)
+            cts = self._pull(seed)
+            self._cache[i] = jnp.concatenate([c.reshape(-1) for c in cts])
+        return self._cache[i]
+
+    def __getitem__(self, idx):
+        if isinstance(idx, tuple):
+            r, c = idx
+        else:
+            r, c = idx, slice(None)
+        rows = range(*r.indices(self._rows)) if isinstance(r, slice) else [r]
+        mat = jnp.stack([self._row(i) for i in rows])
+        out = mat[:, c]
+        if not isinstance(r, slice):
+            out = out[0]
+        return Tensor(out)
+
+    def numpy(self):
+        import numpy as np
+
+        return np.asarray(self[:, :]._data)
+
+
+class Hessian(Jacobian):
+    """Lazy Hessian of a scalar function (reference functional.py Hessian =
+    Jacobian of the gradient)."""
+
+    def __init__(self, func, xs, is_batched=False):
+        arrays = _unwrap(xs)
+        fn = _wrap_fn(func)
+
+        def grad_vec(*ts):
+            arrs = [t._data for t in ts]
+            g = jax.grad(lambda *a: jnp.sum(fn(*a)),
+                         argnums=tuple(range(len(arrs))))(*arrs)
+            return Tensor(jnp.concatenate([x.reshape(-1) for x in g]))
+
+        super().__init__(grad_vec, xs)
+
+
+def forward_grad(func, xs, v=None):
+    """Forward-mode grads (reference primapi.forward_grad): jvp of func at
+    xs with tangent v (defaults to ones)."""
+    _, tangents = jvp(func, xs, v)
+    return tangents
+
+
+def grad_(func, xs, v=None):
+    """Reverse-mode grads (reference primapi.grad)."""
+    _, cts = vjp(func, xs, v)
+    return cts

@@ -784,16 +784,16 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
 # ---------------------------------------------------------------------------
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
-    idx = x._data if isinstance(x, Tensor) else jnp.asarray(x)
-
-    def fn(w):
+    # indices ride as a real op input (not a closure constant) so graph
+    # recordings — static Program replay, onnx export — see the data edge
+    def fn(idx, w):
         out = jnp.take(w, idx, axis=0)
         if padding_idx is not None:
             mask = (idx == padding_idx)[..., None]
             out = jnp.where(mask, 0.0, out)
         return out
 
-    return apply(fn, weight, name="embedding")
+    return apply(fn, x, weight, name="embedding")
 
 
 def one_hot(x, num_classes, name=None):

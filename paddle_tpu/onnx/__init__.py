@@ -1,24 +1,295 @@
-"""ONNX export (reference: python/paddle/onnx/export.py — delegates to the
-external paddle2onnx package).
+"""ONNX export (reference: paddle2onnx — `paddle.onnx.export(layer,
+path, input_spec)`; the reference delegates to the external paddle2onnx
+package, unavailable here, so serialization is in-tree: proto.py writes
+the ModelProto wire format directly).
 
-This build's deployment format is serialized StableHLO
-(paddle_tpu.inference.save_inference_model) — the portable-IR role ONNX
-plays for the reference. `export` converts when an onnx toolchain is
-importable and otherwise raises with that guidance."""
+Pipeline: the layer runs once on placeholder inputs under the static
+recorder (static/__init__.py Program — the op-graph the Executor also
+replays), then each recorded op is emitted as ONNX node(s). Op attributes
+live in the recorded pure-fn closures; emitters recover them by freevar
+name (we own both sides of that contract). Parameters become initializers
+(bf16 cast to fp32 for portability). Unknown ops raise under
+``strict=True`` (default) listing the supported set, or are emitted into
+the ``paddle_tpu`` custom domain with ``strict=False``.
+"""
 from __future__ import annotations
 
-__all__ = ["export"]
+import math
+from typing import Any, Dict, List
+
+import numpy as np
+
+from . import proto
+from ..core.tensor import Tensor
+
+__all__ = ["export", "proto"]
 
 
-def export(layer, path, input_spec=None, opset_version=9, **configs):
+def _free(fn, name, default=None):
+    """Recover a closure variable of a recorded op fn by name."""
+    fn = getattr(fn, "func", fn)
+    code = getattr(fn, "__code__", None)
+    if code and name in code.co_freevars and fn.__closure__:
+        return fn.__closure__[code.co_freevars.index(name)].cell_contents
+    return default
+
+
+class _Ctx:
+    def __init__(self, strict):
+        self.nodes: List[bytes] = []
+        self.extra_inits: List[bytes] = []
+        self.names: Dict[int, str] = {}   # id(Tensor) -> value name
+        self.counter = 0
+        self.strict = strict
+        self.custom = False
+
+    def name_of(self, t) -> str:
+        return self.names[id(t)]
+
+    def fresh(self, prefix="t") -> str:
+        self.counter += 1
+        return f"{prefix}_{self.counter}"
+
+    def const_i64(self, values) -> str:
+        nm = self.fresh("const")
+        arr = np.asarray(values, np.int64)
+        self.extra_inits.append(proto.tensor_proto(
+            nm, arr.shape, "int64", arr.tobytes()))
+        return nm
+
+    def const_f32(self, values) -> str:
+        nm = self.fresh("constf")
+        arr = np.asarray(values, np.float32)
+        self.extra_inits.append(proto.tensor_proto(
+            nm, arr.shape, "float32", arr.tobytes()))
+        return nm
+
+    def emit(self, op_type, ins, outs, attrs=None, domain=""):
+        self.nodes.append(proto.node(
+            op_type, ins, outs, name=self.fresh(op_type.lower()),
+            attrs=attrs, domain=domain))
+
+
+_UNARY = {
+    "relu": "Relu", "tanh": "Tanh", "sigmoid": "Sigmoid", "exp": "Exp",
+    "log": "Log", "sqrt": "Sqrt", "erf": "Erf", "abs": "Abs", "neg": "Neg",
+    "floor": "Floor", "ceil": "Ceil", "identity": "Identity",
+    "assign": "Identity",
+}
+_BINARY = {"add": "Add", "subtract": "Sub", "multiply": "Mul",
+           "divide": "Div", "pow": "Pow", "maximum": "Max",
+           "minimum": "Min"}
+
+
+def _emit_op(ctx: _Ctx, op):
+    name = op.name
+    ins = [ctx.name_of(t) for t in op.inputs]
+    outs = [ctx.names.setdefault(id(t), ctx.fresh()) for t in op.outputs]
+
+    if name in _UNARY:
+        ctx.emit(_UNARY[name], ins, outs)
+    elif name in _BINARY:
+        ctx.emit(_BINARY[name], ins, outs)
+    elif name == "linear":
+        tmp = ctx.fresh()
+        ctx.emit("MatMul", ins[:2], [tmp if len(ins) > 2 else outs[0]])
+        if len(ins) > 2:
+            ctx.emit("Add", [tmp, ins[2]], outs)
+    elif name == "matmul":
+        tx = bool(_free(op.fn, "transpose_x", False))
+        ty = bool(_free(op.fn, "transpose_y", False))
+        a, b = ins[0], ins[1]
+        for flag, pos, nd in ((tx, 0, op.inputs[0].ndim),
+                              (ty, 1, op.inputs[1].ndim)):
+            if flag:
+                perm = list(range(nd))
+                perm[-1], perm[-2] = perm[-2], perm[-1]
+                t = ctx.fresh()
+                ctx.emit("Transpose", [ins[pos]], [t], {"perm": perm})
+                if pos == 0:
+                    a = t
+                else:
+                    b = t
+        ctx.emit("MatMul", [a, b], outs)
+    elif name in ("softmax", "log_softmax"):
+        axis = int(_free(op.fn, "axis", -1) or -1)
+        ctx.emit("Softmax" if name == "softmax" else "LogSoftmax",
+                 ins, outs, {"axis": axis})
+    elif name == "reshape":
+        shape = list(_free(op.fn, "shape", op.outputs[0].shape))
+        ctx.emit("Reshape", ins + [ctx.const_i64(shape)], outs)
+    elif name == "transpose":
+        perm = list(_free(op.fn, "perm", range(op.inputs[0].ndim)))
+        ctx.emit("Transpose", ins, outs, {"perm": [int(p) for p in perm]})
+    elif name == "flatten":
+        s = _free(op.fn, "s", None)
+        e = _free(op.fn, "e", None)
+        if s is not None and e == op.inputs[0].ndim - 1:
+            ctx.emit("Flatten", ins, outs, {"axis": int(s)})
+        else:
+            ctx.emit("Reshape",
+                     ins + [ctx.const_i64(op.outputs[0].shape)], outs)
+    elif name == "layer_norm":
+        eps = float(_free(op.fn, "epsilon", 1e-5))
+        naxes = _free(op.fn, "naxes", (-1,))
+        ctx.emit("LayerNormalization", ins, outs,
+                 {"axis": int(naxes[0]), "epsilon": eps})
+    elif name == "embedding":
+        # jnp.take(w, idx, axis=0): ONNX Gather(data=w, indices=idx)
+        pad = _free(op.fn, "padding_idx", None)
+        if pad is None:
+            ctx.emit("Gather", [ins[1], ins[0]], outs, {"axis": 0})
+        else:
+            # zero out pad rows: Where(Equal(ids, pad)[..., None], 0, g)
+            g = ctx.fresh()
+            ctx.emit("Gather", [ins[1], ins[0]], [g], {"axis": 0})
+            eq = ctx.fresh()
+            ctx.emit("Equal", [ins[0], ctx.const_i64(int(pad))], [eq])
+            un = ctx.fresh()
+            ctx.emit("Unsqueeze", [eq, ctx.const_i64([-1])], [un])
+            ctx.emit("Where", [un, ctx.const_f32(0.0), g], outs)
+    elif name == "mean":
+        axis = _free(op.fn, "axis", None)
+        attrs = {"keepdims": int(bool(_free(op.fn, "keepdim", False)))}
+        if axis is not None:
+            ax = axis if isinstance(axis, (list, tuple)) else [axis]
+            attrs["axes"] = [int(a) for a in ax]
+        ctx.emit("ReduceMean", ins, outs, attrs)
+    elif name == "gelu":
+        # exact gelu: 0.5 * x * (1 + erf(x / sqrt(2)))
+        d = ctx.fresh()
+        ctx.emit("Div", [ins[0], ctx.const_f32(math.sqrt(2.0))], [d])
+        e = ctx.fresh()
+        ctx.emit("Erf", [d], [e])
+        one = ctx.fresh()
+        ctx.emit("Add", [e, ctx.const_f32(1.0)], [one])
+        half = ctx.fresh()
+        ctx.emit("Mul", [ins[0], ctx.const_f32(0.5)], [half])
+        ctx.emit("Mul", [half, one], outs)
+    elif name in ("conv", "conv2d", "conv1d", "conv3d"):
+        if _free(op.fn, "transpose", False):
+            return _unknown(ctx, op, ins, outs)
+        strides = [int(s) for s in _free(op.fn, "strides", ())]
+        dils = [int(d) for d in _free(op.fn, "dils", ())]
+        pad = _free(op.fn, "pad", None)
+        attrs = {"strides": strides, "dilations": dils,
+                 "group": int(_free(op.fn, "groups", 1) or 1)}
+        if isinstance(pad, str):
+            attrs["auto_pad"] = "SAME_UPPER" if pad == "SAME" else "VALID"
+        elif pad is not None:
+            attrs["pads"] = [int(p[0]) for p in pad] + [int(p[1]) for p in pad]
+        ctx.emit("Conv", ins, outs, attrs)
+    elif name in ("max_pool2d", "avg_pool2d", "max_pool1d", "avg_pool1d",
+                  "max_pool3d", "avg_pool3d", "pool"):
+        window = _free(op.fn, "window", None)
+        strides = _free(op.fn, "strides", None)
+        pads = _free(op.fn, "pads", None)
+        kind = _free(op.fn, "op", "max")
+        if window is None:
+            return _unknown(ctx, op, ins, outs)
+        ks = [int(k) for k in window[2:]]
+        st = [int(s) for s in strides[2:]]
+        attrs = {"kernel_shape": ks, "strides": st}
+        if pads is not None and not isinstance(pads, str):
+            sp = pads[2:]
+            attrs["pads"] = [int(p[0]) for p in sp] + [int(p[1]) for p in sp]
+        ctx.emit("MaxPool" if kind == "max" else "AveragePool",
+                 ins, outs, attrs)
+    else:
+        _unknown(ctx, op, ins, outs)
+
+
+def _unknown(ctx, op, ins, outs):
+    if ctx.strict:
+        raise NotImplementedError(
+            f"op {op.name!r} has no ONNX emitter; supported: "
+            f"{sorted(set(_UNARY) | set(_BINARY) | _SPECIAL)}. "
+            "Pass strict=False to place unknown ops in the 'paddle_tpu' "
+            "custom domain.")
+    ctx.custom = True
+    ctx.emit(op.name, ins, outs, domain="paddle_tpu")
+
+
+_SPECIAL = {"linear", "matmul", "softmax", "log_softmax", "reshape",
+            "transpose", "flatten", "layer_norm", "embedding", "mean",
+            "gelu", "conv2d", "max_pool2d", "avg_pool2d"}
+
+
+def export(layer, path, input_spec=None, opset_version=17, strict=True,
+           **configs):
+    """Export `layer` to an ONNX file (reference: paddle.onnx.export).
+
+    input_spec: list of static.InputSpec (or Tensors used as shape/dtype
+    templates). Returns the path written.
+    """
+    from .. import static as static_mod
+    from ..static import InputSpec, Program, program_guard, data
+
+    if input_spec is None:
+        raise ValueError("export requires input_spec (shapes drive tracing)")
+
+    was_static = static_mod.in_static_mode()
+    static_mod.enable_static()
+    prog = Program()
     try:
-        import onnx  # noqa: F401
-    except ImportError as e:
-        raise RuntimeError(
-            "ONNX export requires the 'onnx' package, which is not part of "
-            "this environment. Use paddle_tpu.inference.save_inference_model "
-            "for a portable serialized-StableHLO deployment artifact."
-        ) from e
-    raise NotImplementedError(
-        "StableHLO->ONNX conversion is not implemented; deploy via "
-        "paddle_tpu.inference.save_inference_model")
+        with program_guard(prog):
+            feeds = []
+            for i, spec in enumerate(input_spec):
+                if isinstance(spec, Tensor):
+                    spec = InputSpec(spec.shape, str(spec.dtype),
+                                     name=spec.name)
+                nm = spec.name or f"x{i}"
+                shape = [None if s in (None, -1) else int(s)
+                         for s in spec.shape]
+                feeds.append(data(nm, shape, str(spec.dtype)))
+            was_training = getattr(layer, "training", False)
+            if hasattr(layer, "eval"):
+                layer.eval()
+            outputs = layer(*feeds)
+            if hasattr(layer, "train") and was_training:
+                layer.train()
+    finally:
+        if not was_static:
+            static_mod.disable_static()
+
+    if not isinstance(outputs, (list, tuple)):
+        outputs = [outputs]
+
+    ctx = _Ctx(strict)
+    graph_inputs = []
+    for nm, t in prog._feeds.items():
+        ctx.names[id(t)] = nm
+        graph_inputs.append(proto.value_info(
+            nm, str(t.dtype), ["N"] + list(t.shape[1:])))
+
+    inits = []
+    for i, p in enumerate(prog._captured_params()):
+        nm = getattr(p, "name", None) or f"p{i}"
+        if nm in prog._feeds:
+            nm = f"p{i}_{nm}"
+        ctx.names[id(p)] = nm
+        arr = np.asarray(p._data)
+        if str(p.dtype) == "bfloat16":
+            arr = np.asarray(p._data, np.float32)
+        inits.append(proto.tensor_proto(
+            nm, arr.shape, str(arr.dtype), arr.tobytes()))
+
+    for op in prog._ops:
+        _emit_op(ctx, op)
+
+    graph_outputs = []
+    for t in outputs:
+        if id(t) not in ctx.names:
+            raise ValueError("layer output was not produced by recorded ops")
+        graph_outputs.append(proto.value_info(
+            ctx.names[id(t)], str(t.dtype), ["N"] + list(t.shape[1:])))
+
+    g = proto.graph(ctx.nodes, "model", inits + ctx.extra_inits,
+                    graph_inputs, graph_outputs)
+    blob = proto.model(g, opset=opset_version,
+                       custom_domains=("paddle_tpu",) if ctx.custom else ())
+    if not str(path).endswith(".onnx"):
+        path = str(path) + ".onnx"
+    with open(path, "wb") as f:
+        f.write(blob)
+    return str(path)

@@ -1,0 +1,312 @@
+"""Minimal ONNX protobuf writer/reader.
+
+The environment ships no `onnx` python package, so export.py serializes
+ModelProto directly in protobuf wire format (the schema field numbers are
+from the public onnx.proto3). Only the subset the exporter emits is
+implemented; `parse_model` decodes the same subset so tests can round-trip
+and structurally validate what was written.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Tuple
+
+# -- wire-format primitives --------------------------------------------------
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def f_varint(field: int, value: int) -> bytes:
+    return _tag(field, 0) + _varint(int(value))
+
+
+def f_bytes(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def f_string(field: int, s: str) -> bytes:
+    return f_bytes(field, s.encode("utf-8"))
+
+
+def f_float(field: int, v: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", v)
+
+
+# -- ONNX messages (field numbers: onnx.proto3) ------------------------------
+
+# TensorProto.DataType
+FLOAT, INT32, INT64, BOOL = 1, 6, 7, 9
+FLOAT16, DOUBLE, BFLOAT16 = 10, 11, 16
+
+_NP2ONNX = {
+    "float32": FLOAT, "int32": INT32, "int64": INT64, "bool": BOOL,
+    "float16": FLOAT16, "float64": DOUBLE, "bfloat16": BFLOAT16,
+}
+
+
+def tensor_proto(name: str, dims, dtype: str, raw: bytes) -> bytes:
+    out = b""
+    for d in dims:
+        out += f_varint(1, d)                 # dims
+    out += f_varint(2, _NP2ONNX[dtype])       # data_type
+    out += f_string(8, name)                  # name
+    out += f_bytes(9, raw)                    # raw_data
+    return out
+
+
+def _tensor_shape(dims) -> bytes:
+    out = b""
+    for d in dims:
+        if isinstance(d, str):
+            dim = f_string(2, d)              # dim_param (symbolic)
+        else:
+            dim = f_varint(1, int(d))         # dim_value
+        out += f_bytes(1, dim)
+    return out
+
+
+def value_info(name: str, dtype: str, dims) -> bytes:
+    tensor_type = f_varint(1, _NP2ONNX[dtype]) + f_bytes(2, _tensor_shape(dims))
+    type_proto = f_bytes(1, tensor_type)
+    return f_string(1, name) + f_bytes(2, type_proto)
+
+
+# AttributeProto.AttributeType
+AT_FLOAT, AT_INT, AT_STRING, AT_FLOATS, AT_INTS = 1, 2, 3, 6, 7
+
+
+def attribute(name: str, value) -> bytes:
+    out = f_string(1, name)
+    if isinstance(value, bool):
+        out += f_varint(3, int(value)) + f_varint(20, AT_INT)
+    elif isinstance(value, int):
+        out += f_varint(3, value) + f_varint(20, AT_INT)
+    elif isinstance(value, float):
+        out += f_float(2, value) + f_varint(20, AT_FLOAT)
+    elif isinstance(value, str):
+        out += f_bytes(4, value.encode()) + f_varint(20, AT_STRING)
+    elif isinstance(value, (list, tuple)) and all(
+            isinstance(v, int) for v in value):
+        for v in value:
+            out += f_varint(8, v)
+        out += f_varint(20, AT_INTS)
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            out += f_float(7, float(v))
+        out += f_varint(20, AT_FLOATS)
+    else:
+        raise TypeError(f"unsupported attribute {name}={value!r}")
+    return out
+
+
+def node(op_type: str, inputs, outputs, name: str = "",
+         attrs: Dict[str, Any] = None, domain: str = "") -> bytes:
+    out = b""
+    for i in inputs:
+        out += f_string(1, i)
+    for o in outputs:
+        out += f_string(2, o)
+    if name:
+        out += f_string(3, name)
+    out += f_string(4, op_type)
+    for k, v in (attrs or {}).items():
+        out += f_bytes(5, attribute(k, v))
+    if domain:
+        out += f_string(7, domain)
+    return out
+
+
+def graph(nodes: List[bytes], name: str, initializers: List[bytes],
+          inputs: List[bytes], outputs: List[bytes]) -> bytes:
+    out = b""
+    for n in nodes:
+        out += f_bytes(1, n)
+    out += f_string(2, name)
+    for t in initializers:
+        out += f_bytes(5, t)
+    for i in inputs:
+        out += f_bytes(11, i)
+    for o in outputs:
+        out += f_bytes(12, o)
+    return out
+
+
+def model(graph_bytes: bytes, opset: int = 17, producer: str = "paddle_tpu",
+          custom_domains: Tuple[str, ...] = ()) -> bytes:
+    out = f_varint(1, 8)                      # ir_version
+    out += f_string(2, producer)
+    out += f_bytes(7, graph_bytes)
+    out += f_bytes(8, f_string(1, "") + f_varint(2, opset))
+    for dom in custom_domains:
+        out += f_bytes(8, f_string(1, dom) + f_varint(2, 1))
+    return out
+
+
+# -- decoder (same subset; for round-trip tests) -----------------------------
+
+
+def _read_varint(buf, pos):
+    n = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, pos
+        shift += 7
+
+
+def _fields(buf):
+    pos = 0
+    while pos < len(buf):
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            val, pos = _read_varint(buf, pos)
+        elif wire == 2:
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:
+            val = struct.unpack("<f", buf[pos:pos + 4])[0]
+            pos += 4
+        else:
+            raise ValueError(f"wire type {wire}")
+        yield field, val
+
+
+def parse_model(buf: bytes) -> Dict[str, Any]:
+    out = {"opset_imports": []}
+    for field, val in _fields(buf):
+        if field == 1:
+            out["ir_version"] = val
+        elif field == 2:
+            out["producer"] = val.decode()
+        elif field == 7:
+            out["graph"] = _parse_graph(val)
+        elif field == 8:
+            o = {"domain": "", "version": 0}
+            for f2, v2 in _fields(val):
+                if f2 == 1:
+                    o["domain"] = v2.decode()
+                elif f2 == 2:
+                    o["version"] = v2
+            out["opset_imports"].append(o)
+    return out
+
+
+def _parse_graph(buf):
+    g = {"nodes": [], "initializers": [], "inputs": [], "outputs": []}
+    for field, val in _fields(buf):
+        if field == 1:
+            g["nodes"].append(_parse_node(val))
+        elif field == 2:
+            g["name"] = val.decode()
+        elif field == 5:
+            g["initializers"].append(_parse_tensor(val))
+        elif field == 11:
+            g["inputs"].append(_parse_value_info(val))
+        elif field == 12:
+            g["outputs"].append(_parse_value_info(val))
+    return g
+
+
+def _parse_node(buf):
+    n = {"inputs": [], "outputs": [], "attrs": {}, "domain": "", "name": ""}
+    for field, val in _fields(buf):
+        if field == 1:
+            n["inputs"].append(val.decode())
+        elif field == 2:
+            n["outputs"].append(val.decode())
+        elif field == 3:
+            n["name"] = val.decode()
+        elif field == 4:
+            n["op_type"] = val.decode()
+        elif field == 5:
+            a = _parse_attr(val)
+            n["attrs"][a[0]] = a[1]
+        elif field == 7:
+            n["domain"] = val.decode()
+    return n
+
+
+def _signed(v):
+    """Protobuf int64 negatives arrive as 64-bit two's complement."""
+    if isinstance(v, int) and v >= 1 << 63:
+        return v - (1 << 64)
+    return v
+
+
+def _parse_attr(buf):
+    name, ints, floats, single = "", [], [], None
+    for field, val in _fields(buf):
+        if field == 1:
+            name = val.decode()
+        elif field == 2:
+            single = val
+        elif field == 3:
+            single = _signed(val)
+        elif field == 4:
+            single = val.decode()
+        elif field == 7:
+            floats.append(val)
+        elif field == 8:
+            ints.append(_signed(val))
+    if ints:
+        return name, ints
+    if floats:
+        return name, floats
+    return name, single
+
+
+def _parse_tensor(buf):
+    t = {"dims": [], "name": "", "raw": b""}
+    for field, val in _fields(buf):
+        if field == 1:
+            t["dims"].append(val)
+        elif field == 2:
+            t["data_type"] = val
+        elif field == 8:
+            t["name"] = val.decode()
+        elif field == 9:
+            t["raw"] = val
+    return t
+
+
+def _parse_value_info(buf):
+    v = {"name": "", "dims": []}
+    for field, val in _fields(buf):
+        if field == 1:
+            v["name"] = val.decode()
+        elif field == 2:
+            for f2, v2 in _fields(val):
+                if f2 == 1:  # tensor_type
+                    for f3, v3 in _fields(v2):
+                        if f3 == 1:
+                            v["elem_type"] = v3
+                        elif f3 == 2:
+                            for f4, v4 in _fields(v3):
+                                if f4 == 1:
+                                    dim = {"value": None}
+                                    for f5, v5 in _fields(v4):
+                                        if f5 == 1:
+                                            dim["value"] = v5
+                                        elif f5 == 2:
+                                            dim["value"] = v5.decode()
+                                    v["dims"].append(dim["value"])
+    return v

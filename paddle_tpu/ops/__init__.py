@@ -16,14 +16,16 @@ from .creation import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
+from .array import *  # noqa: F401,F403
 
-from . import creation, math, manipulation, logic
+from . import array, creation, math, manipulation, logic
 
 __all__ = (
     list(creation.__all__)
     + list(math.__all__)
     + list(manipulation.__all__)
     + list(logic.__all__)
+    + list(array.__all__)
 )
 
 

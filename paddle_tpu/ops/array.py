@@ -1,0 +1,65 @@
+"""TensorArray API (reference: python/paddle/tensor/array.py — the
+LoDTensorArray used by dynamic models and control flow).
+
+TPU-native position: in eager mode a TensorArray is a plain Python list of
+Tensors (the reference dygraph mode does exactly this — array.py:24 "In
+dynamic mode, a list of Tensor"); under jit, code that needs an
+append-per-iteration pattern should use lax.scan-shaped ops (stacked
+Tensors), which is what the model zoo does. These functions provide the
+reference's surface: create_array / array_write / array_read /
+array_length, with write-past-end zero-padding semantics.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["create_array", "array_write", "array_read", "array_length"]
+
+
+def _index(i) -> int:
+    if isinstance(i, Tensor):
+        return int(i.numpy())
+    return int(i)
+
+
+def create_array(dtype="float32", initialized_list=None):
+    """New TensorArray (a Python list in the TPU eager design)."""
+    out = []
+    if initialized_list is not None:
+        for t in initialized_list:
+            if not isinstance(t, Tensor):
+                t = Tensor(jnp.asarray(t))
+            out.append(t)
+    return out
+
+
+def array_write(x, i, array=None):
+    """Write x at index i; growing writes pad intermediate slots with
+    zeros_like(x) (reference fills with empty tensors; zeros keeps reads
+    well-defined on TPU where empty tensors have no meaning)."""
+    if not isinstance(x, Tensor):
+        x = Tensor(jnp.asarray(x))
+    i = _index(i)
+    if i < 0:
+        raise ValueError(f"array_write index must be >= 0, got {i}")
+    if array is None:
+        array = []
+    while len(array) < i:
+        array.append(Tensor(jnp.zeros_like(x._data)))
+    if len(array) == i:
+        array.append(x)
+    else:
+        array[i] = x
+    return array
+
+def array_read(array, i):
+    i = _index(i)
+    if not 0 <= i < len(array):
+        raise IndexError(f"array_read index {i} out of range [0, {len(array)})")
+    return array[i]
+
+
+def array_length(array):
+    return Tensor(jnp.asarray(len(array), jnp.int64))

@@ -165,7 +165,7 @@ class Optimizer:
             p_arrays, grads, states, masters, lr, step, extras
         )
         for p, np_, ns, nm in zip(params, new_p, new_s, new_m):
-            p._data = np_
+            p._set_data(np_)   # bumps the inplace version (tape guard)
             self._states[id(p)] = ns
             if nm is not None:
                 self._master_weights[id(p)] = nm
@@ -177,6 +177,16 @@ class Optimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..static import in_static_mode, default_main_program
+
+        if in_static_mode():
+            # static graph: mark the program trainable — Executor.run
+            # computes grads inside the compiled replay and applies this
+            # optimizer (reference: append_backward + optimizer ops)
+            prog = default_main_program()
+            prog._train = (loss, self)
+            prog._cache.clear()  # eval-compiled steps are no longer valid
+            return None, None
         loss.backward()
         self.step()
         return None, None

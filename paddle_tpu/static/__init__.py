@@ -62,18 +62,76 @@ class InputSpec:
 
 
 class Program:
-    """Deferred-execution program: a recorded python callable + feed/fetch
-    names (the ProgramDesc analog; ops are jax-traced at Executor.run)."""
+    """Recorded op-graph (the ProgramDesc analog).
+
+    Building: under `enable_static()` + `program_guard(main)`, every eager
+    op dispatched through `core.dispatch.apply` appends an entry
+    (pure_fn, input Tensors, output Tensors) here while still executing on
+    placeholder values so shapes/dtypes propagate through user code — the
+    TPU-native replacement for the reference's per-op OpDesc append
+    (framework.py append_op).
+
+    Running: Executor.run replays the op list as ONE pure jax function of
+    (feeds, captured parameters) and jit-compiles it per feed-shape —
+    InterpreterCore's role is played by XLA (SURVEY §2.5: the blessed
+    static engine IS whole-graph compilation).
+    """
 
     def __init__(self):
-        self._build_fns = []  # list of (fn producing fetch dict)
+        self._build_fns = []  # legacy: callables usable via Executor.run
+        self._ops = []        # [(fn, [in Tensors], [out Tensors])]
+        self._feeds = {}      # name -> placeholder Tensor
+        self._train = None    # (loss Tensor, optimizer) from minimize()
+        self._cache = {}      # feed-shape key -> jitted replay
         self.random_seed = 0
+
+    # -- build-time recording ---------------------------------------------
+    def _record_op(self, fn, inputs, outputs, name="", attrs=None):
+        self._ops.append(_OpDesc(fn, list(inputs), list(outputs),
+                                 name or getattr(fn, "__name__", "op"),
+                                 dict(attrs or {})))
+        self._cache.clear()
+
+    def _add_feed(self, name, placeholder):
+        self._feeds[name] = placeholder
+        self._cache.clear()
+
+    def _captured_params(self):
+        """Input Tensors that are neither feeds nor produced in-program:
+        parameters/buffers. Read at run time so optimizer updates apply."""
+        produced = set()
+        feed_ids = {id(t) for t in self._feeds.values()}
+        captured, seen = [], set()
+        for op in self._ops:
+            ins, outs = op.inputs, op.outputs
+            for t in ins:
+                if (id(t) not in produced and id(t) not in feed_ids
+                        and id(t) not in seen):
+                    seen.add(id(t))
+                    captured.append(t)
+            produced.update(id(t) for t in outs)
+        return captured
 
     def global_block(self):
         return self
 
     def clone(self, for_test=False):
         return self
+
+
+import dataclasses as _dc
+from typing import Any as _Any, Dict as _Dict, List as _List
+
+
+@_dc.dataclass
+class _OpDesc:
+    """Recorded op entry (the OpDesc analog): pure fn + tensor refs +
+    the dispatch name / static attrs (consumed by the onnx exporter)."""
+    fn: _Any
+    inputs: _List[_Any]
+    outputs: _List[_Any]
+    name: str = "op"
+    attrs: _Dict[str, _Any] = _dc.field(default_factory=dict)
 
 
 _main_program = Program()
@@ -105,9 +163,29 @@ class program_guard:
 
 
 def data(name, shape, dtype="float32", lod_level=0):
-    """Static placeholder — in the TPU design this is just an InputSpec the
-    Executor matches feeds against."""
-    return InputSpec(shape, dtype, name)
+    """Static placeholder. In static mode this is a zero-filled Tensor of
+    the declared shape (None -> 1) registered as a feed of the program
+    under construction — ops on it execute on the placeholder values so
+    shapes propagate, while the recording (Program._record_op) captures
+    the graph for replay with real feeds. Outside static mode it stays an
+    InputSpec (jit.compile signature use)."""
+    if not _static_mode:
+        return InputSpec(shape, dtype, name)
+    import jax.numpy as jnp
+
+    concrete = tuple(1 if (s is None or int(s) < 0) else int(s)
+                     for s in shape)
+    t = Tensor(jnp.zeros(concrete, convert_dtype(dtype)), name=name,
+               stop_gradient=True)
+    default_main_program()._add_feed(name, t)
+    return t
+
+
+def _recording_program():
+    """The program to record ops into, or None (hook for dispatch.apply)."""
+    if not _static_mode or _recording_suspended:
+        return None
+    return _main_program
 
 
 class name_scope:
@@ -129,26 +207,141 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     return _tape.grad(ts, xs, grad_outputs=target_gradients, retain_graph=True, allow_unused=True)
 
 
+_recording_suspended = False
+
+
+class _suspend_recording:
+    def __enter__(self):
+        global _recording_suspended
+        self._prev = _recording_suspended
+        _recording_suspended = True
+
+    def __exit__(self, *exc):
+        global _recording_suspended
+        _recording_suspended = self._prev
+        return False
+
+
 class Executor:
-    """Executor API shim (reference: python/paddle/fluid/executor.py:898).
-    run(feed=..., fetch_list=...) executes python-recorded programs; with the
-    jit path being the blessed one, this exists for API-parity scripts."""
+    """Executor (reference: python/paddle/fluid/executor.py:898).
+
+    run(program, feed={name: ndarray}, fetch_list=[vars]) replays the
+    recorded op graph as one jit-compiled pure function (recompiled per
+    feed shape). A program with a `minimize`d loss also computes parameter
+    grads inside the same compiled call (jax.value_and_grad over the
+    replay) and applies the recorded optimizer — the InterpreterCore +
+    backward-pass-ops analog with XLA as the engine.
+    """
 
     def __init__(self, place=None):
         self.place = place
 
     def run(self, program=None, feed=None, fetch_list=None, return_numpy=True):
-        if callable(program):
+        if callable(program) and not isinstance(program, Program):
             out = program(**(feed or {}))
         elif fetch_list and all(callable(f) for f in fetch_list):
             out = [f(**(feed or {})) for f in fetch_list]
+        elif isinstance(program, Program) or program is None:
+            program = program if program is not None else _main_program
+            out = self._run_program(program, feed or {}, fetch_list or [])
         else:
-            raise NotImplementedError(
-                "Graph-building static mode is provided via paddle_tpu.jit "
-                "(compile your step function); Executor.run accepts callables."
-            )
+            raise TypeError(f"cannot run program of type {type(program)}")
         if not isinstance(out, (list, tuple)):
             out = [out]
         if return_numpy:
-            return [np.asarray(o._data) if isinstance(o, Tensor) else o for o in out]
+            return [np.asarray(o._data) if isinstance(o, Tensor) else
+                    np.asarray(o) for o in out]
         return list(out)
+
+    def _run_program(self, program: Program, feed: dict, fetch_list):
+        import jax
+        import jax.numpy as jnp
+
+        if not program._ops:
+            return []  # startup program: params initialize at Layer ctor
+        for name in feed:
+            if name not in program._feeds:
+                raise KeyError(
+                    f"feed {name!r} is not a static.data of this program "
+                    f"(have {sorted(program._feeds)})")
+        missing = set(program._feeds) - set(feed)
+        if missing:
+            raise KeyError(
+                f"missing feed(s) {sorted(missing)}: every static.data of "
+                "the program must be fed (the placeholder zeros are build-"
+                "time artifacts, not defaults)")
+        feed_names = sorted(program._feeds)
+        feed_ts = [program._feeds[n] for n in feed_names]
+        feed_arrays = [jnp.asarray(feed[n]) for n in feed_names]
+        params = program._captured_params()
+        train = program._train
+        fetch_ids = [id(f) for f in fetch_list]
+
+        key = (tuple(id(f) for f in fetch_list), train is not None,
+               tuple((a.shape, str(a.dtype)) for a in feed_arrays))
+        compiled = program._cache.get(key)
+        if compiled is None:
+            ops = list(program._ops)
+            loss_id = id(train[0]) if train else None
+
+            def replay(feeds_, params_):
+                env = {}
+                for t, a in zip(feed_ts, feeds_):
+                    env[id(t)] = a
+                for t, a in zip(params, params_):
+                    env[id(t)] = a
+                for op in ops:
+                    fn, ins, outs = op.fn, op.inputs, op.outputs
+                    arrs = [env.get(id(t), t._data) for t in ins]
+                    res = fn(*arrs)
+                    if not isinstance(res, (tuple, list)):
+                        res = [res]
+                    for o, r in zip(outs, res):
+                        env[id(o)] = r
+                return env
+
+            def fetches_of(env):
+                out = []
+                for f, fid in zip(fetch_list, fetch_ids):
+                    out.append(env.get(fid, f._data if isinstance(f, Tensor)
+                                       else f))
+                return out
+
+            if train:
+                # differentiate only trainable float captures — int/bool
+                # constants and stop_gradient buffers ride along as-is
+                diff_idx = [i for i, p in enumerate(params)
+                            if not p.stop_gradient
+                            and jnp.issubdtype(p.dtype, jnp.inexact)]
+
+                def step(feeds_, params_):
+                    def loss_fn(diff_):
+                        full = list(params_)
+                        for j, i in enumerate(diff_idx):
+                            full[i] = diff_[j]
+                        env = replay(feeds_, full)
+                        return env[loss_id].sum(), fetches_of(env)
+
+                    (loss_v, fv), grads = jax.value_and_grad(
+                        loss_fn, has_aux=True)(
+                        [params_[i] for i in diff_idx])
+                    return fv, grads
+            else:
+                def step(feeds_, params_):
+                    return fetches_of(replay(feeds_, params_)), None
+
+            compiled = jax.jit(step)
+            program._cache[key] = compiled
+
+        param_arrays = [p._data for p in params]
+        fetch_vals, grads = compiled(feed_arrays, param_arrays)
+        if train is not None and grads is not None:
+            _, opt = train
+            diff = [p for p in params if not p.stop_gradient
+                    and jnp.issubdtype(p.dtype, jnp.inexact)]
+            with _suspend_recording():
+                for p, g in zip(diff, grads):
+                    p.grad = Tensor(g)
+                opt.step()
+                opt.clear_grad()
+        return list(fetch_vals)

@@ -14,7 +14,12 @@ from ..core.tensor import Tensor
 from ..core.dispatch import apply, unwrap
 from ..nn.layer import Layer
 
-__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets"]
+from .tokenizer import (
+    BasicTokenizer, FasterTokenizer, WordpieceTokenizer, load_vocab)
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets",
+           "FasterTokenizer", "BasicTokenizer", "WordpieceTokenizer",
+           "load_vocab"]
 
 
 def viterbi_decode(potentials, transition_params, lengths,

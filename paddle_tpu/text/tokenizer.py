@@ -1,0 +1,200 @@
+"""Tokenization (reference: paddle/fluid/operators/string/
+faster_tokenizer_op.cc — the in-graph BERT wordpiece tokenizer producing
+input_ids / token_type_ids).
+
+TPU-native position: tokenization is host-side string work; XLA consumes
+the resulting int arrays. So the op is a host "kernel" on the Layer
+surface (matching the reference's CPU-only op that feeds device tensors):
+FasterTokenizer(vocab)(text, text_pair) -> (input_ids, token_type_ids)
+as int64 device Tensors, with the reference op's padding / truncation /
+special-token semantics.
+"""
+from __future__ import annotations
+
+import unicodedata
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn import Layer
+
+__all__ = ["BasicTokenizer", "WordpieceTokenizer", "FasterTokenizer",
+           "load_vocab"]
+
+
+def load_vocab(path: str) -> Dict[str, int]:
+    """One token per line (BERT vocab.txt layout)."""
+    vocab = {}
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f):
+            tok = line.rstrip("\n")
+            if tok:
+                vocab[tok] = i
+    return vocab
+
+
+def _is_whitespace(ch):
+    return ch in " \t\n\r" or unicodedata.category(ch) == "Zs"
+
+
+def _is_control(ch):
+    if ch in ("\t", "\n", "\r"):
+        return False
+    return unicodedata.category(ch).startswith("C")
+
+
+def _is_punctuation(ch):
+    cp = ord(ch)
+    if (33 <= cp <= 47) or (58 <= cp <= 64) or (91 <= cp <= 96) or (123 <= cp <= 126):
+        return True
+    return unicodedata.category(ch).startswith("P")
+
+
+def _is_cjk(cp):
+    return (0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF
+            or 0x20000 <= cp <= 0x2A6DF or 0xF900 <= cp <= 0xFAFF)
+
+
+class BasicTokenizer:
+    """Whitespace / punctuation / CJK splitting with optional lowercasing
+    (faster_tokenizer_op.cc BasicTokenizer)."""
+
+    def __init__(self, do_lower_case: bool = True):
+        self.do_lower_case = do_lower_case
+
+    def tokenize(self, text: str) -> List[str]:
+        out = []
+        for ch in text:
+            cp = ord(ch)
+            if cp == 0 or cp == 0xFFFD or _is_control(ch):
+                continue
+            if _is_cjk(cp):
+                out.append(" ")
+                out.append(ch)
+                out.append(" ")
+            else:
+                out.append(ch)
+        text = "".join(out)
+
+        tokens = []
+        for tok in text.split():
+            if self.do_lower_case:
+                tok = tok.lower()
+                tok = "".join(c for c in unicodedata.normalize("NFD", tok)
+                              if unicodedata.category(c) != "Mn")
+            cur = []
+            for ch in tok:
+                if _is_punctuation(ch):
+                    if cur:
+                        tokens.append("".join(cur))
+                        cur = []
+                    tokens.append(ch)
+                else:
+                    cur.append(ch)
+            if cur:
+                tokens.append("".join(cur))
+        return tokens
+
+
+class WordpieceTokenizer:
+    """Greedy longest-match-first subword split (##-continuations)."""
+
+    def __init__(self, vocab: Dict[str, int], unk_token: str = "[UNK]",
+                 max_input_chars_per_word: int = 100):
+        self.vocab = vocab
+        self.unk_token = unk_token
+        self.max_input_chars_per_word = max_input_chars_per_word
+
+    def tokenize(self, token: str) -> List[str]:
+        if len(token) > self.max_input_chars_per_word:
+            return [self.unk_token]
+        out, start = [], 0
+        while start < len(token):
+            end = len(token)
+            cur = None
+            while start < end:
+                sub = token[start:end]
+                if start > 0:
+                    sub = "##" + sub
+                if sub in self.vocab:
+                    cur = sub
+                    break
+                end -= 1
+            if cur is None:
+                return [self.unk_token]
+            out.append(cur)
+            start = end
+        return out
+
+
+class FasterTokenizer(Layer):
+    """BERT-style tokenizer layer (reference faster_tokenizer_op.cc): text
+    (and optional text_pair) -> (input_ids, token_type_ids) int64 Tensors."""
+
+    def __init__(self, vocab: Union[Dict[str, int], str],
+                 do_lower_case: bool = True, is_split_into_words: bool = False):
+        super().__init__()
+        if isinstance(vocab, str):
+            vocab = load_vocab(vocab)
+        self.vocab = dict(vocab)
+        self.do_lower_case = do_lower_case
+        self.is_split_into_words = is_split_into_words
+        self.basic = BasicTokenizer(do_lower_case)
+        self.wordpiece = WordpieceTokenizer(self.vocab)
+        self.cls_id = self.vocab.get("[CLS]", 0)
+        self.sep_id = self.vocab.get("[SEP]", 0)
+        self.pad_id = self.vocab.get("[PAD]", 0)
+
+    # -- string -> subword ids ----------------------------------------------
+    def _encode_one(self, text: str) -> List[int]:
+        if self.is_split_into_words:
+            words = list(text) if isinstance(text, str) else list(text)
+        else:
+            words = self.basic.tokenize(text)
+        ids = []
+        for w in words:
+            for sub in self.wordpiece.tokenize(w):
+                ids.append(self.vocab.get(sub, self.wordpiece.vocab.get(
+                    self.wordpiece.unk_token, 0)))
+        return ids
+
+    def forward(self, text, text_pair=None, max_seq_len: int = 0,
+                pad_to_max_seq_len: bool = False):
+        if isinstance(text, str):
+            text = [text]
+        if isinstance(text_pair, str):
+            text_pair = [text_pair]
+        if text_pair is not None and len(text_pair) != len(text):
+            raise ValueError("text and text_pair batch sizes differ")
+
+        rows, types = [], []
+        for i, t in enumerate(text):
+            a = self._encode_one(t)
+            b = self._encode_one(text_pair[i]) if text_pair is not None else []
+            if max_seq_len > 0:
+                # longest-first truncation over the pair (reference
+                # RunSegmentMean... truncation strategy)
+                budget = max_seq_len - 2 - (1 if b else 0)
+                while len(a) + len(b) > max(budget, 0):
+                    if len(a) >= len(b) and a:
+                        a.pop()
+                    elif b:
+                        b.pop()
+                    else:
+                        break
+            ids = [self.cls_id] + a + [self.sep_id]
+            tt = [0] * len(ids)
+            if b:
+                ids += b + [self.sep_id]
+                tt += [1] * (len(b) + 1)
+            rows.append(ids)
+            types.append(tt)
+
+        width = max(len(r) for r in rows) if rows else 0
+        if max_seq_len > 0 and (pad_to_max_seq_len or width > max_seq_len):
+            width = max_seq_len
+        out_ids = [r[:width] + [self.pad_id] * (width - len(r)) for r in rows]
+        out_tt = [t[:width] + [0] * (width - len(t)) for t in types]
+        return (Tensor(jnp.asarray(out_ids, jnp.int64)),
+                Tensor(jnp.asarray(out_tt, jnp.int64)))

@@ -4,6 +4,8 @@ annotations; Completer/Partitioner role played by XLA's partitioner)."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
 import paddle_tpu.optimizer as opt

@@ -262,3 +262,42 @@ def test_norm_layer_double_backward():
     g2 = paddle.grad((g1 ** 2).sum(), [x])[0]
     assert g2.shape == x.shape
     assert np.isfinite(g2.numpy()).all()
+
+
+def test_inplace_mutation_after_forward_raises():
+    """Reference tensor_wrapper.h inplace-version check: mutating a tensor
+    consumed by a recorded forward invalidates its pending backward."""
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = (x * x).sum()
+    x.set_value(paddle.to_tensor([5.0, 6.0]))
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="modified in place"):
+        y.backward()
+
+
+def test_inplace_version_allows_normal_train_loop():
+    """The guard must not fire on the canonical fwd/bwd/step loop."""
+    from paddle_tpu import optimizer
+
+    lin = paddle.nn.Linear(3, 3)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=lin.parameters())
+    x = paddle.to_tensor(np.ones((2, 3), np.float32))
+    for _ in range(3):
+        loss = (lin(x) ** 2).sum()
+        loss.backward()
+        opt.step()       # mutates params AFTER their backward ran
+        opt.clear_grad()
+
+
+def test_setitem_mutation_after_forward_raises():
+    """__setitem__ goes through the _data property, so the version guard
+    catches it — critical under lazy-vjp backward (which replays the
+    forward from current input data)."""
+    import pytest as _pytest
+
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = (x * x).sum()
+    x[0] = 5.0
+    with _pytest.raises(RuntimeError, match="modified in place"):
+        y.backward()

@@ -5,6 +5,8 @@ import time
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 from paddle_tpu.core import native
 from paddle_tpu.distributed.fleet import ElasticManager, ElasticStatus

@@ -5,6 +5,8 @@ import numpy as np
 import jax.numpy as jnp
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.models import GPTForCausalLM, gpt_test_config

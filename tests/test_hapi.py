@@ -5,6 +5,8 @@ import os
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
 import paddle_tpu.optimizer as opt

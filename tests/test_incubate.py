@@ -80,3 +80,57 @@ def test_fused_multi_transformer_trains():
         optim.clear_grad()
         losses.append(float(loss.item()))
     assert losses[-1] < losses[0]
+
+
+def test_incubate_lazy_jacobian_hessian():
+    import numpy as np
+    from paddle_tpu.incubate.autograd import Jacobian, Hessian
+
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+
+    def f(a):
+        return a * a
+
+    J = Jacobian(f, x)
+    assert J.shape == (3, 3)
+    np.testing.assert_allclose(J[1, 1].numpy(), 4.0, rtol=1e-6)
+    np.testing.assert_allclose(J.numpy(), np.diag([2.0, 4.0, 6.0]), rtol=1e-6)
+
+    def g(a):
+        return (a ** 3).sum()
+
+    H = Hessian(g, x)
+    np.testing.assert_allclose(H.numpy(), np.diag([6.0, 12.0, 18.0]),
+                               rtol=1e-5)
+
+
+def test_incubate_prim_flags_and_modes():
+    from paddle_tpu.incubate import autograd as ia
+
+    assert not ia.prim_enabled()
+    ia.enable_prim()
+    assert ia.prim_enabled()
+    ia.disable_prim()
+    assert not ia.prim_enabled()
+
+    import numpy as np
+    x = paddle.to_tensor(np.array([0.5, 1.5], np.float32))
+    t = ia.forward_grad(lambda a: a * a, x)
+    r = ia.grad_(lambda a: (a * a).sum(), x)
+    np.testing.assert_allclose(np.asarray(t._data), [1.0, 3.0], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(r[0]._data if isinstance(r, (list, tuple)) else r._data),
+                               [1.0, 3.0], rtol=1e-6)
+
+
+def test_meta_parallel_wrappers_place_model():
+    from paddle_tpu import nn, parallel
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        ShardingParallel, TensorParallel)
+
+    parallel.init_mesh(mp=2, sharding=2, dp=2)
+    lin = nn.Linear(8, 8)
+    tp = TensorParallel(lin)
+    assert tp.parameters()
+    sp = ShardingParallel(nn.Linear(4, 4))
+    out = sp(paddle.to_tensor(np.ones((2, 4), np.float32)))
+    assert out.shape == (2, 4)

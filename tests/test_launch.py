@@ -12,6 +12,8 @@ import textwrap
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from paddle_tpu.distributed.launch import LaunchConfig, launch_job
 from paddle_tpu.distributed.launch_mod import spawn
 

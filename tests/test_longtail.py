@@ -48,11 +48,13 @@ def test_cost_model_profile():
         assert res["flops"] > 0
 
 
-def test_onnx_export_guidance():
+def test_onnx_export_requires_input_spec(tmp_path):
     import paddle_tpu.nn as nn
 
-    with pytest.raises((RuntimeError, NotImplementedError)):
-        paddle.onnx.export(nn.Linear(2, 2), "/tmp/x")
+    # full exporter coverage lives in test_onnx.py; here: the reference
+    # API error when called without input_spec
+    with pytest.raises(ValueError, match="input_spec"):
+        paddle.onnx.export(nn.Linear(2, 2), str(tmp_path / "x"))
 
 
 def test_cpp_extension_custom_op(tmp_path):

@@ -4,6 +4,8 @@ trivial-mesh parity on the 8-device CPU mesh)."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 from paddle_tpu import jit, optimizer, parallel
 from paddle_tpu.models import (

@@ -6,6 +6,8 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 from paddle_tpu import parallel
 from paddle_tpu.parallel.moe import moe_mlp_arrays, moe_capacity

@@ -10,6 +10,10 @@ from paddle_tpu import jit, optimizer, parallel
 from paddle_tpu.parallel.pipeline import pipeline_apply, scan_blocks
 from paddle_tpu.models import GPTForCausalLM, GPTPretrainingCriterion, gpt_test_config
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 
 def _block(p, h):
     return jnp.tanh(h @ p["w"] + p["b"])

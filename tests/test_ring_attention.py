@@ -5,6 +5,8 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 from paddle_tpu import parallel
 from paddle_tpu.parallel.ring import ring_attention_arrays

@@ -1,0 +1,121 @@
+"""distributed.rpc + TensorArray tests (reference: rpc/test_rpc_*.py and
+test_array_read_write_op.py)."""
+import multiprocessing as mp
+import os
+import socket
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+import paddle_tpu as paddle
+
+
+# -- TensorArray -------------------------------------------------------------
+
+def test_array_write_read_length():
+    arr = paddle.create_array("float32")
+    x = paddle.to_tensor([1.0, 2.0])
+    arr = paddle.array_write(x, 0, arr)
+    arr = paddle.array_write(x * 2, paddle.to_tensor(1), arr)
+    assert int(paddle.array_length(arr)) == 2
+    np.testing.assert_allclose(paddle.array_read(arr, 1).numpy(), [2.0, 4.0])
+
+
+def test_array_write_grows_with_zero_padding():
+    x = paddle.to_tensor([3.0])
+    arr = paddle.array_write(x, 2)
+    assert int(paddle.array_length(arr)) == 3
+    np.testing.assert_allclose(paddle.array_read(arr, 0).numpy(), [0.0])
+    np.testing.assert_allclose(paddle.array_read(arr, 2).numpy(), [3.0])
+    with pytest.raises(IndexError):
+        paddle.array_read(arr, 5)
+
+
+def test_create_array_initialized():
+    arr = paddle.create_array("float32", initialized_list=[np.ones(2, np.float32)])
+    assert int(paddle.array_length(arr)) == 1
+
+
+# -- rpc ---------------------------------------------------------------------
+
+def _square(x):
+    return x * x
+
+
+def _whoami():
+    from paddle_tpu.distributed import rpc
+
+    return rpc.get_current_worker_info().name
+
+
+def _rpc_worker(rank, port, q):
+    os.environ["PTPU_FORCE_PLATFORM"] = "cpu"
+    from paddle_tpu.distributed import rpc
+
+    rpc.init_rpc(f"worker{rank}", rank=rank, world_size=2,
+                 master_endpoint=f"127.0.0.1:{port}")
+    peer = f"worker{1 - rank}"
+    # each worker calls into the other
+    assert rpc.rpc_sync(peer, _square, args=(3 + rank,)) == (3 + rank) ** 2
+    assert rpc.rpc_sync(peer, _whoami) == peer
+    fut = rpc.rpc_async(peer, _square, args=(5,))
+    assert fut.wait() == 25
+    infos = rpc.get_all_worker_infos()
+    q.put((rank, sorted(i.name for i in infos)))
+    rpc.shutdown()
+
+
+def test_rpc_two_workers_cross_call():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_rpc_worker, args=(r, port, q))
+             for r in range(2)]
+    for p in procs:
+        p.start()
+    results = [q.get(timeout=90) for _ in range(2)]
+    for p in procs:
+        p.join(timeout=30)
+        assert p.exitcode == 0
+    assert all(names == ["worker0", "worker1"] for _, names in results)
+
+
+def _boom():
+    raise ValueError("remote boom")
+
+
+def _rpc_error_worker(rank, port, q):
+    os.environ["PTPU_FORCE_PLATFORM"] = "cpu"
+    from paddle_tpu.distributed import rpc
+
+    rpc.init_rpc(f"w{rank}", rank=rank, world_size=2,
+                 master_endpoint=f"127.0.0.1:{port}")
+    if rank == 0:
+        try:
+            rpc.rpc_sync("w1", _boom)
+            q.put((0, "no-error"))
+        except ValueError as e:
+            q.put((0, str(e)))
+    else:
+        q.put((1, "served"))
+    rpc.shutdown()
+
+
+def test_rpc_remote_exception_propagates():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_rpc_error_worker, args=(r, port, q))
+             for r in range(2)]
+    for p in procs:
+        p.start()
+    results = dict(q.get(timeout=90) for _ in range(2))
+    for p in procs:
+        p.join(timeout=30)
+    assert results[0] == "remote boom"

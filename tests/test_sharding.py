@@ -4,6 +4,8 @@ assert sharded runs match the unsharded run)."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 from paddle_tpu import nn, optimizer, jit, parallel
 import paddle_tpu.nn.functional as F

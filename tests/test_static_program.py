@@ -1,0 +1,141 @@
+"""Static-graph Program/Executor tests (reference: the enable_static()
+Program + program_guard + Executor.run(feed/fetch) training workflow,
+executor.py:898 / framework.py append_op)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import optimizer, static
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+def test_program_records_and_executor_replays():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4], "float32")
+        w = paddle.to_tensor(np.eye(4, dtype=np.float32) * 2.0)
+        y = paddle.matmul(x, w) + 1.0
+    exe = static.Executor()
+    feed_x = np.arange(8, dtype=np.float32).reshape(2, 4)
+    (out,) = exe.run(main, feed={"x": feed_x}, fetch_list=[y])
+    np.testing.assert_allclose(out, feed_x * 2.0 + 1.0)
+    # different batch size than the placeholder: recompiles, same graph
+    feed_x3 = np.ones((3, 4), np.float32)
+    (out3,) = exe.run(main, feed={"x": feed_x3}, fetch_list=[y])
+    np.testing.assert_allclose(out3, feed_x3 * 2.0 + 1.0)
+
+
+def test_static_training_loop_converges():
+    """The canonical migration target: program_guard graph build,
+    opt.minimize(loss), exe.run(startup), feed/fetch training steps."""
+    from paddle_tpu import nn
+
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 8], "float32")
+        label = static.data("label", [None, 1], "float32")
+        lin1 = nn.Linear(8, 16)
+        lin2 = nn.Linear(16, 1)
+        pred = lin2(F.tanh(lin1(x)))
+        loss = F.mse_loss(pred, label)
+        opt = optimizer.SGD(learning_rate=0.5,
+                            parameters=lin1.parameters() + lin2.parameters())
+        opt.minimize(loss)
+
+    exe = static.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    xs = rng.randn(32, 8).astype(np.float32)
+    ys = (xs[:, :1] * 0.7 - xs[:, 1:2] * 0.3).astype(np.float32)
+    losses = []
+    for _ in range(40):
+        (lv,) = exe.run(main, feed={"x": xs, "label": ys}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
+
+
+def test_executor_rejects_unknown_feed():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 2], "float32")
+        y = x * 2.0
+    with pytest.raises(KeyError):
+        static.Executor().run(main, feed={"bogus": np.ones((1, 2), np.float32)},
+                              fetch_list=[y])
+
+
+def test_gradients_api_inside_program():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 2], "float32")
+        w = paddle.to_tensor(np.ones((2, 2), np.float32), stop_gradient=False)
+        out = (paddle.matmul(x, w) ** 2).sum()
+    # dygraph-style gradients() still works on the placeholder values
+    (g,) = static.gradients(out, [w])
+    assert g is not None and g.shape == (2, 2)
+
+
+def test_missing_feed_raises():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 2], "float32")
+        y = static.data("y", [None, 2], "float32")
+        out = x + y
+    with pytest.raises(KeyError, match="missing feed"):
+        static.Executor().run(main, feed={"x": np.ones((1, 2), np.float32)},
+                              fetch_list=[out])
+
+
+def test_minimize_after_eval_run_invalidates_cache():
+    """An eval-compiled step must not be reused after minimize() marks the
+    program trainable — training would silently never update params."""
+    from paddle_tpu import nn
+
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4], "float32")
+        y = static.data("y", [None, 1], "float32")
+        lin = nn.Linear(4, 1)
+        loss = F.mse_loss(lin(x), y)
+    exe = static.Executor()
+    xs = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+    ys = xs[:, :1].copy()
+    (l0,) = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+    # now make it trainable and run with the SAME shapes
+    opt = optimizer.SGD(learning_rate=0.1, parameters=lin.parameters())
+    with static.program_guard(main):
+        opt.minimize(loss)
+    for _ in range(25):
+        (lv,) = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+    assert float(lv) < float(l0) * 0.5, (float(l0), float(lv))
+
+
+def test_int_constant_capture_in_train_program():
+    """int tensors captured by the graph must not break value_and_grad."""
+    from paddle_tpu import nn
+
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 3, 4], "float32")
+        y = static.data("y", [None, 2], "float32")
+        idx = paddle.to_tensor(np.array([[0], [2]], np.int64))
+        lin = nn.Linear(4, 2)
+        picked = paddle.take_along_axis(x, paddle.tile(idx[None], [1, 1, 4]),
+                                        axis=1)
+        loss = F.mse_loss(lin(picked.mean(axis=1) if hasattr(picked, "mean")
+                              else picked), y)
+        opt = optimizer.SGD(learning_rate=0.1, parameters=lin.parameters())
+        opt.minimize(loss)
+    exe = static.Executor()
+    xs = np.random.RandomState(1).randn(4, 3, 4).astype(np.float32)
+    ys = np.random.RandomState(2).randn(4, 2).astype(np.float32)
+    (lv,) = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+    assert np.isfinite(lv).all()

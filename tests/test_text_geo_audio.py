@@ -197,3 +197,62 @@ def test_windows():
     np.testing.assert_allclose(
         audio.functional.get_window("hann", 16, fftbins=False).numpy(),
         np.hanning(16), atol=1e-6)
+
+
+# -- tokenizer (reference: test_faster_tokenizer_op.py) ----------------------
+
+def _bert_vocab():
+    toks = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "the", "quick", "brown",
+            "fox", "jump", "##ed", "##s", "over", "lazy", "dog", ",", "!",
+            "un", "##aff", "##able"]
+    return {t: i for i, t in enumerate(toks)}
+
+
+def test_tokenizer_basic_sentence():
+    from paddle_tpu.text import FasterTokenizer
+
+    tok = FasterTokenizer(_bert_vocab())
+    ids, tt = tok("The quick brown fox jumped over the lazy dog!")
+    v = _bert_vocab()
+    expect = [v["[CLS]"], v["the"], v["quick"], v["brown"], v["fox"],
+              v["jump"], v["##ed"], v["over"], v["the"], v["lazy"],
+              v["dog"], v["!"], v["[SEP]"]]
+    assert ids.numpy().tolist()[0] == expect
+    assert tt.numpy().tolist()[0] == [0] * len(expect)
+
+
+def test_tokenizer_wordpiece_and_unk():
+    from paddle_tpu.text import FasterTokenizer
+
+    v = _bert_vocab()
+    tok = FasterTokenizer(v)
+    ids, _ = tok("unaffable zzz")
+    row = ids.numpy().tolist()[0]
+    assert row == [v["[CLS]"], v["un"], v["##aff"], v["##able"], v["[UNK]"],
+                   v["[SEP]"]]
+
+
+def test_tokenizer_pair_padding_truncation():
+    from paddle_tpu.text import FasterTokenizer
+
+    v = _bert_vocab()
+    tok = FasterTokenizer(v)
+    ids, tt = tok(["the quick fox", "dog"],
+                  text_pair=["lazy dog", "the fox"],
+                  max_seq_len=8, pad_to_max_seq_len=True)
+    assert ids.shape == (2, 8)
+    assert tt.shape == (2, 8)
+    r0, t0 = ids.numpy()[0].tolist(), tt.numpy()[0].tolist()
+    assert r0[0] == v["[CLS]"] and v["[SEP]"] in r0
+    assert 1 in t0  # pair segment present
+    # rows padded with [PAD]
+    assert ids.numpy()[1].tolist().count(v["[PAD]"]) >= 1
+
+
+def test_tokenizer_batched_shapes_consistent():
+    from paddle_tpu.text import FasterTokenizer
+
+    tok = FasterTokenizer(_bert_vocab())
+    ids, tt = tok(["the dog", "the quick quick quick fox"])
+    assert ids.shape == tt.shape
+    assert ids.shape[0] == 2

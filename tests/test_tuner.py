@@ -1,0 +1,88 @@
+"""Auto-parallel tuner tests (reference: test_optimization_tuner /
+auto_parallel cost tests — plan enumeration, pruning, ranking)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.tuner import (
+    ClusterSpec, ModelSpec, OptimizationTuner, Plan)
+from paddle_tpu.models import gpt2_124m_config, gpt3_1p3b_config, gpt_test_config
+
+
+def _tuner(cfg=None, batch=32, **cluster_kw):
+    cfg = cfg or gpt2_124m_config()
+    spec = ModelSpec.from_gpt_config(cfg, batch)
+    return OptimizationTuner(spec, ClusterSpec(**cluster_kw))
+
+
+def test_candidates_cover_factorizations():
+    t = _tuner(n_devices=8)
+    cands = t.candidates()
+    shapes = {(p.dp, p.sharding, p.pp, p.mp) for p in cands}
+    # every enumerated mesh multiplies to 8
+    assert all(a * b * c * d == 8 for a, b, c, d in shapes)
+    assert (8, 1, 1, 1) in shapes and (1, 1, 1, 8) in shapes
+    assert (2, 2, 2, 1) not in {s for s in shapes if np.prod(s) != 8}
+
+
+def test_estimate_prunes_indivisible():
+    t = _tuner(gpt_test_config())  # 2 layers, 4 heads
+    bad_pp = t.estimate(Plan(dp=1, sharding=1, pp=8, mp=1, microbatches=8))
+    assert not bad_pp.feasible and "pp" in bad_pp.reason
+    bad_mp = t.estimate(Plan(dp=1, sharding=1, pp=1, mp=8, microbatches=1))
+    assert not bad_mp.feasible
+
+
+def test_tune_returns_feasible_ranked():
+    t = _tuner(n_devices=8)
+    plans = t.tune(top_k=5)
+    assert plans, "no feasible plan for 124M on 8 devices?"
+    times = [p.est_step_time for p in plans]
+    assert times == sorted(times)
+    for p in plans:
+        assert p.feasible
+        assert p.dp * p.sharding * p.pp * p.mp == 8
+        assert p.est_memory <= 0.9 * 16e9
+        assert set(p.breakdown) >= {"t_compute", "t_grad_comm", "t_mp_comm"}
+
+
+def test_memory_pressure_forces_state_sharding_or_pp():
+    """1.3B on tiny-HBM chips: pure DP must be infeasible; the chosen plan
+    must shard weights/state somehow (sharding/pp/mp > 1)."""
+    t = _tuner(gpt3_1p3b_config(), batch=64, n_devices=8, hbm_bytes=8e9)
+    pure_dp = t.estimate(Plan(dp=8, sharding=1, pp=1, mp=1, microbatches=1))
+    assert not pure_dp.feasible and pure_dp.reason == "exceeds HBM"
+    best = t.best()
+    assert best.sharding * best.pp * best.mp > 1
+
+
+def test_mp_cost_scales_with_axis():
+    """More mp ways => more activation all-reduce time charged."""
+    t = _tuner(n_devices=8, hbm_bytes=64e9)
+    p2 = t.estimate(Plan(dp=4, sharding=1, pp=1, mp=2, microbatches=1))
+    p4 = t.estimate(Plan(dp=2, sharding=1, pp=1, mp=4, microbatches=1))
+    assert p4.breakdown["t_mp_comm"] > p2.breakdown["t_mp_comm"]
+
+
+def test_pp_bubble_shrinks_with_microbatches():
+    t = _tuner(n_devices=8, hbm_bytes=64e9)
+    few = t.estimate(Plan(dp=2, sharding=1, pp=4, mp=1, microbatches=4))
+    many = t.estimate(Plan(dp=2, sharding=1, pp=4, mp=1, microbatches=16))
+    assert many.breakdown["pp_bubble"] < few.breakdown["pp_bubble"]
+
+
+def test_engine_tune_entry():
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.auto_parallel import Engine
+    from paddle_tpu.models import GPTForCausalLM
+
+    model = GPTForCausalLM(gpt_test_config())
+    plans = Engine(model=model).tune(global_batch=16)
+    assert plans and all(p.feasible for p in plans)
+
+
+def test_measured_refinement_runs_on_virtual_mesh():
+    t = _tuner(gpt_test_config(), batch=16, n_devices=8, hbm_bytes=64e9)
+    plans = t.tune(top_k=2, measure=True)
+    assert plans
+    assert any("measured_s" in p.breakdown or "measure_error" in p.breakdown
+               for p in plans)

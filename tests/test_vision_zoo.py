@@ -4,6 +4,8 @@ check the logit shape)."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 from paddle_tpu.vision import models as M
 
