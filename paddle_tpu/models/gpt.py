@@ -81,6 +81,10 @@ class GPTConfig:
     # meta_parallel/pipeline_parallel.py:230). "1f1b" takes effect in
     # pretrain_loss(); plain forward() always uses gpipe.
     pp_schedule: str = "gpipe"
+    # virtual chunks per pipeline stage (>1 = interleaved schedule,
+    # reference PipelineParallelWithInterleave :461; shrinks the bubble
+    # v-fold). Applies to the gpipe forward path.
+    pp_num_chunks: int = 1
 
 
 def gpt_test_config(**kw):
@@ -388,11 +392,13 @@ class GPTStackedBlocks(Layer):
 
         names = self._names
         n_micro = self.cfg.pp_num_microbatches or None
+        chunks = max(1, self.cfg.pp_num_chunks)
         block = self.block_closure()
 
         def fn(a, *flat):
             params = dict(zip(names, flat))
-            return pipeline_apply(block, params, a, n_microbatches=n_micro)
+            return pipeline_apply(block, params, a, n_microbatches=n_micro,
+                                  num_chunks=chunks)
 
         tensors = [getattr(self, n) for n in names]
         return apply(fn, x, *tensors, name="gpt_stacked_blocks")

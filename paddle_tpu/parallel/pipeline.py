@@ -57,6 +57,7 @@ def pipeline_apply(
     x,
     n_microbatches: int | None = None,
     axis: str = "pp",
+    num_chunks: int = 1,
 ):
     """Run x through a pp-stage GPipe pipeline inside one XLA program.
 
@@ -64,11 +65,21 @@ def pipeline_apply(
     stacked_params: pytree, every leaf [L, ...] with L = total blocks,
         L % pp == 0; leading dim sharded on 'pp' outside this call.
     x: [B, ...] activations; split into M micro-batches along dim 0.
+
+    num_chunks > 1 selects the INTERLEAVED schedule (reference
+    meta_parallel/pipeline_parallel.py:461 PipelineParallelWithInterleave):
+    each device hosts `num_chunks` non-adjacent layer chunks (virtual
+    stage vs hosts layers [vs*k, (vs+1)*k) on device vs % pp), shrinking
+    the warm-up/drain bubble from (pp-1)/(M+pp-1) of the step to
+    (pp-1)/(M*v+pp-1). See _pipeline_interleaved for the SPMD slot clock.
     """
     mesh = get_mesh()
     pp = axis_size(axis)
     if pp == 1:
         return scan_blocks(block_fn, stacked_params, x)
+    if num_chunks > 1:
+        return _pipeline_interleaved(block_fn, stacked_params, x,
+                                     n_microbatches, axis, num_chunks)
 
     B = x.shape[0]
     M = n_microbatches or pp
@@ -136,6 +147,106 @@ def pipeline_apply(
     # partial-manual shard_map validates specs only under jit; eager calls
     # (plain apply without jit.compile) need the wrapper — it inlines when
     # already inside a trace
+    out = jax.jit(run)(staged, xs)
+    return out.reshape((B,) + x.shape[1:])
+
+
+def _pipeline_interleaved(block_fn, stacked_params, x, n_microbatches,
+                          axis, v):
+    """Interleaved (virtual-stage) pipeline forward in one XLA program.
+
+    The reference drives interleave from the host with a per-rank unit
+    ordering (pipeline_parallel.py:461); the SPMD re-derivation used here:
+    enumerate per-device work units k = g*(pp*v) + c*pp + j — group g of
+    pp micro-batches, chunk c, member j — and run unit k on device s at
+    slot u = k + s. Then every dependency arrives exactly one slot early:
+    within a chunk, producer (same k, device s-1) finished at u-1; across
+    the chunk boundary, device pp-1's unit for chunk c-1 finished at
+    (k-pp) + (pp-1) = u-1 and the SAME wraparound ppermute
+    [(i, (i+1) % pp)] delivers it. One uniform hop per slot, no
+    double-booked devices, bubble = pp-1 slots out of M*v + pp - 1.
+
+    Autodiff-transparent: XLA derives the mirrored backward schedule by
+    transposing the scan (activations for all M*v units stay live through
+    backward — the memory/bubble trade vs pipeline_1f1b, whose stash ring
+    is bounded; the reference's interleave has the same appetite).
+
+    Deliberately NOT merged with the gpipe scan above even though v=1
+    degenerates to it: the gpipe body indexes this stage's params
+    statically, while this schedule selects the chunk with a traced
+    per-slot index — folding gpipe into the v=1 case would put a dynamic
+    gather on the hot path of every pp>1 model for no benefit. Fixes to
+    either scan body should be mirrored in the other.
+    """
+    mesh = get_mesh()
+    pp = axis_size(axis)
+    B = x.shape[0]
+    M = n_microbatches or pp
+    if B % M != 0:
+        raise ValueError(f"batch {B} not divisible into {M} micro-batches")
+    if M % pp != 0:
+        raise ValueError(
+            f"interleaved schedule needs micro-batches ({M}) divisible by "
+            f"pp ({pp}) — units advance in groups of pp")
+    leaves = jax.tree_util.tree_leaves(stacked_params)
+    L = leaves[0].shape[0]
+    V = pp * v
+    if L % V != 0:
+        raise ValueError(f"{L} blocks not divisible by pp*num_chunks={V}")
+    k_layers = L // V
+    units = M * v
+    U = units + pp - 1
+
+    xs = x.reshape((M, B // M) + x.shape[1:])
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=P(),
+        axis_names=frozenset({axis}),
+        check_vma=False,
+    )
+    def run(params, xs):
+        # leaf [1, v, k, ...] -> [v, k, ...]: this device's v chunks
+        params = jax.tree_util.tree_map(lambda a: a[0], params)
+        stage = jax.lax.axis_index(axis)
+        wrap_perm = [(i, (i + 1) % pp) for i in range(pp)]
+        mb_shape = xs.shape[1:]
+
+        def tick(carry, u):
+            h_recv, outs = carry
+            ku = jnp.clip(u - stage, 0, units - 1)
+            c = (ku % (pp * v)) // pp
+            f = (ku % pp) + pp * (ku // (pp * v))
+            chunk_params = jax.tree_util.tree_map(lambda a: a[c], params)
+            first = (stage == 0) & (c == 0)
+            h_in = jnp.where(first, xs[f], h_recv)
+            out = scan_blocks(block_fn, chunk_params, h_in)
+            retire = (stage == pp - 1) & (c == v - 1) & (u - stage >= 0) \
+                & (u - stage < units)
+            outs = jnp.where(
+                retire,
+                jax.lax.dynamic_update_index_in_dim(outs, out, f, 0),
+                outs)
+            h_recv = jax.lax.ppermute(out, axis, wrap_perm)
+            return (h_recv, outs), None
+
+        carry0 = (jnp.zeros(mb_shape, x.dtype),
+                  jnp.zeros((M,) + mb_shape, x.dtype))
+        (h, outs), _ = jax.lax.scan(tick, carry0, jnp.arange(U))
+        outs = jnp.where(stage == pp - 1, outs, jnp.zeros_like(outs))
+        return jax.lax.psum(outs, axis)
+
+    # layer l lives on virtual stage l // k_layers = c*pp + s: reshape
+    # [L,...] -> [V, k, ...] -> [v, pp, k, ...] -> device-major
+    # [pp, v, k, ...]
+    def stage_major(a):
+        rest = a.shape[1:]
+        return a.reshape((v, pp, k_layers) + rest).transpose(
+            (1, 0, 2) + tuple(range(3, 3 + len(rest))))
+
+    staged = jax.tree_util.tree_map(stage_major, stacked_params)
     out = jax.jit(run)(staged, xs)
     return out.reshape((B,) + x.shape[1:])
 

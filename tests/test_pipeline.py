@@ -54,11 +54,59 @@ def test_pipeline_matches_serial_fwd_and_grad():
         np.testing.assert_allclose(np.asarray(g1[k]), np.asarray(g2[k]), atol=1e-4)
 
 
-def _stacked_losses(mesh_kwargs, steps=5, schedule="gpipe"):
+def test_interleaved_pipeline_matches_serial():
+    """num_chunks>1 virtual-stage schedule: forward and grads must match
+    the serial stack (reference PipelineParallelWithInterleave parity)."""
+    parallel.init_mesh(pp=2)
+    mesh = parallel.get_mesh()
+    rng = np.random.RandomState(5)
+    L, H, B, M, V = 8, 16, 8, 4, 2
+    params = {
+        "w": jnp.asarray(rng.randn(L, H, H), jnp.float32) * 0.3,
+        "b": jnp.asarray(rng.randn(L, H), jnp.float32) * 0.1,
+    }
+    x = jnp.asarray(rng.randn(B, H), jnp.float32)
+    sharded = {k: jax.device_put(v, NamedSharding(mesh, P("pp")))
+               for k, v in params.items()}
+
+    out = jax.jit(lambda p, a: pipeline_apply(
+        _block, p, a, n_microbatches=M, num_chunks=V))(sharded, x)
+    ref = x
+    for i in range(L):
+        ref = _block({"w": params["w"][i], "b": params["b"][i]}, ref)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def loss_int(p, a):
+        return jnp.sum(pipeline_apply(_block, p, a, n_microbatches=M,
+                                      num_chunks=V) ** 2)
+
+    def loss_ser(p, a):
+        return jnp.sum(scan_blocks(_block, p, a) ** 2)
+
+    g1 = jax.jit(jax.grad(loss_int))(sharded, x)
+    g2 = jax.jit(jax.grad(loss_ser))(params, x)
+    for k in g1:
+        np.testing.assert_allclose(np.asarray(g1[k]), np.asarray(g2[k]),
+                                   atol=1e-4)
+
+
+def test_interleaved_validates_divisibility():
+    parallel.init_mesh(pp=2)
+    params = {"w": jnp.zeros((8, 4, 4)), "b": jnp.zeros((8, 4))}
+    x = jnp.zeros((6, 4))
+    with pytest.raises(ValueError, match="divisible by"):
+        # M=3 not divisible by pp=2
+        pipeline_apply(_block, params, x, n_microbatches=3, num_chunks=2)
+    with pytest.raises(ValueError, match="pp\\*num_chunks"):
+        pipeline_apply(_block, params, x, n_microbatches=2, num_chunks=3)
+
+
+def _stacked_losses(mesh_kwargs, steps=5, schedule="gpipe", chunks=1):
     paddle.seed(42)
     parallel.init_mesh(**mesh_kwargs)
     cfg = gpt_test_config(num_hidden_layers=4, stacked_blocks=True,
-                          pp_schedule=schedule)
+                          pp_schedule=schedule, pp_num_chunks=chunks,
+                          pp_num_microbatches=2 if chunks > 1 else 0)
     model = parallel.place_model(GPTForCausalLM(cfg))
     crit = GPTPretrainingCriterion(cfg)
     opt = optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
@@ -196,6 +244,14 @@ def test_gpt_3d_parallel_parity():
     base = _stacked_losses(dict())
     hybrid = _stacked_losses(dict(dp=2, pp=2, mp=2))
     np.testing.assert_allclose(base, hybrid, rtol=2e-2, atol=2e-3)
+
+
+def test_gpt_interleaved_schedule_parity():
+    """pp=2 with 2 virtual chunks per stage matches the single-device
+    loss curve through full training steps."""
+    base = _stacked_losses(dict())
+    inter = _stacked_losses(dict(pp=2), chunks=2)
+    np.testing.assert_allclose(base, inter, rtol=2e-2, atol=2e-3)
 
 
 def test_gpt_1f1b_schedule_parity():
