@@ -29,6 +29,7 @@ if _os.environ.get("PTPU_FORCE_PLATFORM"):
     _jax.config.update("jax_platforms", _os.environ["PTPU_FORCE_PLATFORM"])
 
 from .core.tensor import Tensor, to_tensor
+from .core.containers import SelectedRows, StringTensor
 from .core.dtype import (
     bool_,
     uint8,

@@ -1,0 +1,96 @@
+"""Auxiliary tensor containers (reference: phi/core/selected_rows.h and
+phi/core/string_tensor.h — the non-dense tensor types in the phi type
+system; SURVEY §2.1).
+
+TPU-native positions:
+
+- SelectedRows is the reference's sparse-row gradient container (embedding
+  grads touch few vocab rows). XLA consumes dense arrays, so here it is a
+  host-side accumulation structure: rows+values pairs that merge cheaply
+  (the lookup_table_grad "merge duplicate rows" step) and densify once at
+  the optimizer boundary — O(touched rows) memory until the update.
+- StringTensor is host-side by definition (strings never reach the MXU);
+  it wraps a numpy object array with tensor-shaped indexing so string
+  pipelines (tokenizer feeds) have the reference's container surface.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import numpy as np
+import jax.numpy as jnp
+
+from .tensor import Tensor
+
+__all__ = ["SelectedRows", "StringTensor"]
+
+
+class SelectedRows:
+    """Sparse row set over a [height, ...row_shape] dense space."""
+
+    def __init__(self, rows, values, height: int):
+        rows = np.asarray(rows, np.int64).reshape(-1)
+        vals = values._data if isinstance(values, Tensor) else jnp.asarray(values)
+        if vals.shape[0] != rows.shape[0]:
+            raise ValueError(
+                f"rows ({rows.shape[0]}) and values ({vals.shape[0]}) differ")
+        if rows.size and (rows.min() < 0 or rows.max() >= height):
+            raise ValueError(f"row ids out of range [0, {height})")
+        self.rows = rows
+        self.values = vals
+        self.height = int(height)
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.values.shape[1:])
+
+    def merge(self) -> "SelectedRows":
+        """Sum duplicate rows (reference MergeAdd functor)."""
+        uniq, inv = np.unique(self.rows, return_inverse=True)
+        merged = jnp.zeros((len(uniq),) + self.values.shape[1:],
+                           self.values.dtype)
+        merged = merged.at[jnp.asarray(inv)].add(self.values)
+        return SelectedRows(uniq, merged, self.height)
+
+    def to_dense(self) -> Tensor:
+        out = jnp.zeros(self.shape, self.values.dtype)
+        out = out.at[jnp.asarray(self.rows)].add(self.values)
+        return Tensor(out)
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, "
+                f"nnz_rows={len(self.rows)}, row_shape={self.values.shape[1:]})")
+
+
+class StringTensor:
+    """Host-side string array with tensor-shaped metadata."""
+
+    def __init__(self, data: Union[Sequence, np.ndarray], name: str = None):
+        self._data = np.asarray(data, dtype=object)
+        self.name = name
+
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    def numpy(self):
+        return self._data
+
+    def tolist(self):
+        return self._data.tolist()
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        if isinstance(out, np.ndarray):
+            return StringTensor(out)
+        return out
+
+    def __len__(self):
+        return self._data.shape[0] if self._data.ndim else 1
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, {self._data!r})"

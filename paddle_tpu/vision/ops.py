@@ -1,6 +1,10 @@
 """Vision ops (reference: python/paddle/vision/ops.py — roi_align, nms,
-deform_conv, yolo helpers; SURVEY §8.11). Round-1 scope: the geometry ops
-used by detection heads; specialized CUDA kernels (deform_conv) land later."""
+deform_conv2d, yolo_loss, box helpers; SURVEY §8.11).
+
+TPU-native stance: the reference's hand-written CUDA kernels
+(deformable_conv_op.cu, yolov3_loss_op) become vectorized gather/einsum
+formulations that XLA fuses — bilinear sampling is four gathers and a
+lerp, the im2col contraction is one einsum on the MXU."""
 from __future__ import annotations
 
 import numpy as np
@@ -10,7 +14,8 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor
 from ..core.dispatch import apply
 
-__all__ = ["nms", "box_iou", "roi_align", "roi_pool", "box_coder", "prior_box"]
+__all__ = ["nms", "box_iou", "roi_align", "roi_pool", "box_coder",
+           "prior_box", "deform_conv2d", "yolo_loss"]
 
 
 def box_iou(boxes1, boxes2):
@@ -191,3 +196,249 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
         arr = np.clip(arr, 0, 1)
     var = np.broadcast_to(np.asarray(variance, np.float32), arr.shape).copy()
     return Tensor(jnp.asarray(arr)), Tensor(jnp.asarray(var))
+
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else (int(v), int(v))
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1/v2 (reference: vision/ops.py deform_conv2d
+    over deformable_conv_op.cu). mask=None is v1; a [N, dg*Hf*Wf, Ho, Wo]
+    mask modulates samples (v2).
+
+    x:      [N, Cin, H, W]
+    offset: [N, 2*dg*Hf*Wf, Ho, Wo] — per-tap (dy, dx) displacements
+    weight: [Cout, Cin//groups, Hf, Wf]
+    """
+    sh, sw = _pair(stride)
+    ph, pw = _pair(padding)
+    dh, dw = _pair(dilation)
+    dg = int(deformable_groups)
+    g = int(groups)
+
+    def fn(xa, off, w, *rest):
+        maybe_mask = rest[0] if (mask is not None) else None
+        maybe_bias = rest[-1] if (bias is not None) else None
+        N, Cin, H, W = xa.shape
+        Cout, Cpg, Hf, Wf = w.shape
+        K = Hf * Wf
+        Ho = (H + 2 * ph - (dh * (Hf - 1) + 1)) // sh + 1
+        Wo = (W + 2 * pw - (dw * (Wf - 1) + 1)) // sw + 1
+
+        # base sampling positions per output cell and kernel tap
+        ho = jnp.arange(Ho)
+        wo = jnp.arange(Wo)
+        ki = jnp.arange(Hf)
+        kj = jnp.arange(Wf)
+        base_y = (ho[:, None] * sh - ph) + ki[None, :] * dh      # [Ho, Hf]
+        base_x = (wo[:, None] * sw - pw) + kj[None, :] * dw      # [Wo, Wf]
+        # -> [K, Ho, Wo]
+        by = jnp.broadcast_to(
+            base_y.T[:, None, :, None], (Hf, Wf, Ho, Wo)).reshape(K, Ho, Wo)
+        bx = jnp.broadcast_to(
+            base_x.T[None, :, None, :], (Hf, Wf, Ho, Wo)).reshape(K, Ho, Wo)
+
+        off = off.reshape(N, dg, K, 2, Ho, Wo)
+        sy = by[None, None] + off[:, :, :, 0]                    # [N,dg,K,Ho,Wo]
+        sx = bx[None, None] + off[:, :, :, 1]
+
+        # bilinear sample with zero padding outside the image
+        y0 = jnp.floor(sy)
+        x0 = jnp.floor(sx)
+        wy = (sy - y0).astype(xa.dtype)
+        wx = (sx - x0).astype(xa.dtype)
+        xg = xa.reshape(N, dg, Cin // dg, H * W)
+
+        def corner(yc, xc, wgt):
+            inb = ((yc >= 0) & (yc <= H - 1) & (xc >= 0) & (xc <= W - 1))
+            yi = jnp.clip(yc, 0, H - 1).astype(jnp.int32)
+            xi = jnp.clip(xc, 0, W - 1).astype(jnp.int32)
+            flat = (yi * W + xi).reshape(N, dg, 1, -1)           # [N,dg,1,K*Ho*Wo]
+            got = jnp.take_along_axis(
+                xg, jnp.broadcast_to(flat, (N, dg, Cin // dg, flat.shape[-1])),
+                axis=-1)
+            got = got.reshape(N, dg, Cin // dg, K, Ho, Wo)
+            w_ = (wgt * inb.astype(xa.dtype))[:, :, None]        # [N,dg,1,K,Ho,Wo]
+            return got * w_
+
+        sampled = (corner(y0, x0, (1 - wy) * (1 - wx))
+                   + corner(y0, x0 + 1, (1 - wy) * wx)
+                   + corner(y0 + 1, x0, wy * (1 - wx))
+                   + corner(y0 + 1, x0 + 1, wy * wx))            # [N,dg,Cpd,K,Ho,Wo]
+        if maybe_mask is not None:
+            m = maybe_mask.reshape(N, dg, 1, K, Ho, Wo).astype(xa.dtype)
+            sampled = sampled * m
+        col = sampled.reshape(N, Cin, K, Ho, Wo)
+
+        # grouped contraction: out[n,co,ho,wo] = sum_{ci,k} w * col
+        colg = col.reshape(N, g, Cin // g, K, Ho, Wo)
+        wg = w.reshape(g, Cout // g, Cpg, Hf * Wf)
+        out = jnp.einsum("ngckhw,gock->ngohw", colg, wg,
+                         preferred_element_type=jnp.float32)
+        out = out.reshape(N, Cout, Ho, Wo).astype(xa.dtype)
+        if maybe_bias is not None:
+            out = out + maybe_bias.reshape(1, Cout, 1, 1)
+        return out
+
+    args = [x, offset, weight]
+    if mask is not None:
+        args.append(mask)
+    if bias is not None:
+        args.append(bias)
+    return apply(fn, *args, name="deform_conv2d")
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 loss (reference: vision/ops.py yolo_loss over
+    yolov3_loss_op.h): sigmoid-CE on x/y/objectness/class, L1 on w/h,
+    best-anchor target assignment, IoU>thresh ignore mask. Returns the
+    per-sample loss [N].
+
+    x:        [N, S*(5+class_num), H, W] head output for this scale
+    gt_box:   [N, B, 4] (cx, cy, w, h) normalized to [0, 1]
+    gt_label: [N, B] int class ids; zero-area boxes are padding
+    anchors:  flat list [a0w, a0h, a1w, ...] in input-image pixels
+    anchor_mask: indices of this scale's anchors within `anchors`
+    """
+    anchors_np = np.asarray(anchors, np.float32).reshape(-1, 2)
+    amask = np.asarray(anchor_mask, np.int32)
+    S = len(amask)
+    C = int(class_num)
+    # reference smoothing (yolov3_loss_op.h): delta = min(1/C, 1/40),
+    # positive target 1-delta, negative target delta
+    smooth = min(1.0 / max(C, 1), 1.0 / 40.0) if use_label_smooth else 0.0
+
+    def bce(logit, label):
+        return jnp.maximum(logit, 0) - logit * label + jnp.log1p(
+            jnp.exp(-jnp.abs(logit)))
+
+    def fn(xa, gb, gl, *maybe_score):
+        N = xa.shape[0]
+        H, W = xa.shape[2], xa.shape[3]
+        in_h = H * downsample_ratio
+        in_w = W * downsample_ratio
+        p = xa.reshape(N, S, 5 + C, H, W)
+        tx, ty, tw, th, tobj = p[:, :, 0], p[:, :, 1], p[:, :, 2], p[:, :, 3], p[:, :, 4]
+        tcls = p[:, :, 5:]                                    # [N,S,C,H,W]
+        B = gb.shape[1]
+        if B == 0:
+            # no ground truth at all: pure negative-objectness loss
+            return jnp.sum(bce(tobj, jnp.zeros_like(tobj)), axis=(1, 2, 3))
+        score = (maybe_score[0] if maybe_score
+                 else jnp.ones((N, B), xa.dtype))
+
+        valid = (gb[:, :, 2] > 0) & (gb[:, :, 3] > 0)         # [N,B]
+
+        # -- target assignment: best IoU over ALL anchors, origin-aligned
+        gw = gb[:, :, 2] * in_w                               # pixels
+        gh = gb[:, :, 3] * in_h
+        aw = anchors_np[:, 0][None, None]                     # [1,1,A]
+        ah = anchors_np[:, 1][None, None]
+        inter = jnp.minimum(gw[..., None], aw) * jnp.minimum(gh[..., None], ah)
+        union = gw[..., None] * gh[..., None] + aw * ah - inter
+        best = jnp.argmax(inter / jnp.maximum(union, 1e-9), -1)  # [N,B]
+        # position of the responsible cell
+        gi = jnp.clip((gb[:, :, 0] * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gb[:, :, 1] * H).astype(jnp.int32), 0, H - 1)
+
+        # per-scale slot of the best anchor (or -1)
+        slot = jnp.full_like(best, -1)
+        for s_idx, a_idx in enumerate(amask):
+            slot = jnp.where(best == int(a_idx), s_idx, slot)
+        take = valid & (slot >= 0)                            # [N,B]
+        sl = jnp.clip(slot, 0, S - 1)
+
+        # gather predictions at assigned cells: [N,B]
+        def at_cells(t):                                      # t: [N,S,H,W]
+            flat = t.reshape(N, S * H * W)
+            idx = sl * (H * W) + gj * W + gi
+            return jnp.take_along_axis(flat, idx, axis=1)
+
+        box_scale = (2.0 - gb[:, :, 2] * gb[:, :, 3])         # small boxes up-weighted
+        wgt = take.astype(xa.dtype) * score * box_scale
+
+        # x/y: sigmoid CE against the sub-cell offset
+        txy_lab_x = gb[:, :, 0] * W - gi.astype(xa.dtype)
+        txy_lab_y = gb[:, :, 1] * H - gj.astype(xa.dtype)
+        loss_xy = (bce(at_cells(tx), txy_lab_x) + bce(at_cells(ty), txy_lab_y)) * wgt
+
+        # w/h: L1 on log-space targets
+        aw_sel = jnp.asarray(anchors_np[:, 0])[amask][sl]
+        ah_sel = jnp.asarray(anchors_np[:, 1])[amask][sl]
+        tw_lab = jnp.log(jnp.maximum(gw / jnp.maximum(aw_sel, 1e-9), 1e-9))
+        th_lab = jnp.log(jnp.maximum(gh / jnp.maximum(ah_sel, 1e-9), 1e-9))
+        loss_wh = (jnp.abs(at_cells(tw) - tw_lab)
+                   + jnp.abs(at_cells(th) - th_lab)) * wgt
+
+        # objectness: positives at assigned cells; negatives elsewhere
+        # unless the predicted box IoU with any gt exceeds ignore_thresh
+        grid_x = jnp.arange(W, dtype=xa.dtype)[None, None, None, :]
+        grid_y = jnp.arange(H, dtype=xa.dtype)[None, None, :, None]
+        a_w = jnp.asarray(anchors_np[:, 0])[amask][None, :, None, None]
+        a_h = jnp.asarray(anchors_np[:, 1])[amask][None, :, None, None]
+        px = (jax.nn.sigmoid(tx) * scale_x_y - 0.5 * (scale_x_y - 1.0)
+              + grid_x) / W
+        py = (jax.nn.sigmoid(ty) * scale_x_y - 0.5 * (scale_x_y - 1.0)
+              + grid_y) / H
+        pw = jnp.exp(jnp.clip(tw, -10, 10)) * a_w / in_w
+        ph = jnp.exp(jnp.clip(th, -10, 10)) * a_h / in_h
+
+        def pairwise_iou(bx, by, bw, bh):                     # vs all gts
+            px1, px2 = bx - bw / 2, bx + bw / 2
+            py1, py2 = by - bh / 2, by + bh / 2
+            gx1 = (gb[:, :, 0] - gb[:, :, 2] / 2)[:, :, None, None, None]
+            gx2 = (gb[:, :, 0] + gb[:, :, 2] / 2)[:, :, None, None, None]
+            gy1 = (gb[:, :, 1] - gb[:, :, 3] / 2)[:, :, None, None, None]
+            gy2 = (gb[:, :, 1] + gb[:, :, 3] / 2)[:, :, None, None, None]
+            iw = jnp.maximum(
+                jnp.minimum(px2[:, None], gx2) - jnp.maximum(px1[:, None], gx1), 0)
+            ih = jnp.maximum(
+                jnp.minimum(py2[:, None], gy2) - jnp.maximum(py1[:, None], gy1), 0)
+            inter = iw * ih
+            union = (bw * bh)[:, None] + (
+                gb[:, :, 2] * gb[:, :, 3])[:, :, None, None, None] - inter
+            return inter / jnp.maximum(union, 1e-9)           # [N,B,S,H,W]
+
+        iou = pairwise_iou(px, py, pw, ph)
+        iou = jnp.where(valid[:, :, None, None, None], iou, 0.0)
+        ignore = (jnp.max(iou, axis=1) > ignore_thresh)       # [N,S,H,W]
+
+        # reference semantics: positives target 1.0 with WEIGHT gt_score
+        # (mixup), negatives target 0.0 unless IoU-ignored
+        idx = sl * (H * W) + gj * W + gi
+        score_map = _scatter_max(jnp.zeros((N, S * H * W), xa.dtype), idx,
+                                 take.astype(xa.dtype) * score)
+        score_map = score_map.reshape(N, S, H, W)
+        pos = score_map > 0
+        obj_target = pos.astype(xa.dtype)
+        obj_w = jnp.where(pos, score_map, jnp.where(~ignore, 1.0, 0.0))
+        loss_obj = bce(tobj, obj_target) * obj_w
+
+        # classification at assigned cells
+        cls_lab = jax.nn.one_hot(jnp.clip(gl, 0, C - 1), C, dtype=xa.dtype)
+        cls_lab = cls_lab * (1.0 - 2.0 * smooth) + smooth  # pos 1-d, neg d
+        flat_cls = tcls.transpose(0, 1, 3, 4, 2).reshape(N, S * H * W, C)
+        pred_cls = jnp.take_along_axis(
+            flat_cls, idx[..., None].astype(jnp.int32), axis=1)  # [N,B,C]
+        loss_cls = jnp.sum(bce(pred_cls, cls_lab), -1) * take.astype(
+            xa.dtype) * score
+
+        per_n = (jnp.sum(loss_xy + loss_wh + loss_cls, axis=1)
+                 + jnp.sum(loss_obj, axis=(1, 2, 3)))
+        return per_n
+
+    args = [x, gt_box, gt_label]
+    if gt_score is not None:
+        args.append(gt_score)
+    return apply(fn, *args, name="yolo_loss")
+
+
+def _scatter_max(flat, idx, val):
+    """flat [N, M], idx/val [N, B] -> max-scatter (duplicate cells keep the
+    strongest target)."""
+    return jax.vmap(lambda f, i, v: f.at[i].max(v))(flat, idx, val)

@@ -169,3 +169,34 @@ def test_save_load_bf16(tmp_path):
     np.testing.assert_allclose(
         loaded["x"].astype("float32").numpy(), obj.astype("float32").numpy()
     )
+
+
+def test_selected_rows_merge_and_densify():
+    import jax.numpy as jnp
+    from paddle_tpu import SelectedRows
+
+    sr = SelectedRows(rows=[2, 0, 2], values=np.array(
+        [[1.0, 1.0], [2.0, 2.0], [3.0, 3.0]], np.float32), height=4)
+    assert sr.shape == (4, 2)
+    merged = sr.merge()
+    assert sorted(merged.rows.tolist()) == [0, 2]
+    dense = sr.to_dense().numpy()
+    np.testing.assert_allclose(dense, [[2, 2], [0, 0], [4, 4], [0, 0]])
+    with pytest.raises(ValueError):
+        SelectedRows(rows=[5], values=np.zeros((1, 2), np.float32), height=4)
+
+
+def test_string_tensor_indexing():
+    from paddle_tpu import StringTensor
+
+    st = StringTensor([["a", "bb"], ["ccc", "d"]])
+    assert st.shape == (2, 2)
+    assert st[0, 1] == "bb"
+    assert st[1].tolist() == ["ccc", "d"]
+    assert len(st) == 2
+    # feeds the tokenizer directly
+    from paddle_tpu.text import FasterTokenizer
+
+    v = {t: i for i, t in enumerate(["[PAD]", "[UNK]", "[CLS]", "[SEP]", "a", "bb"])}
+    ids, _ = FasterTokenizer(v)(StringTensor(["a bb"]).tolist())
+    assert ids.numpy().tolist()[0] == [2, 4, 5, 3]

@@ -1,0 +1,215 @@
+"""deform_conv2d / yolo_loss tests (reference: test_deform_conv2d.py,
+test_yolov3_loss_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.vision.ops import deform_conv2d, yolo_loss
+
+
+def _rs(seed=0):
+    return np.random.RandomState(seed)
+
+
+def test_deform_conv2d_zero_offset_equals_conv2d():
+    """Zero offsets and unit mask reduce exactly to a plain convolution —
+    the strongest oracle available without a CUDA reference."""
+    r = _rs(1)
+    x = paddle.to_tensor(r.randn(2, 4, 8, 8).astype("float32"))
+    w = paddle.to_tensor(r.randn(6, 4, 3, 3).astype("float32"))
+    b = paddle.to_tensor(r.randn(6).astype("float32"))
+    off = paddle.to_tensor(np.zeros((2, 2 * 9, 8, 8), np.float32))
+    got = deform_conv2d(x, off, w, bias=b, padding=1)
+    want = F.conv2d(x, w, bias=b, padding=1)
+    np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_deform_conv2d_integer_offset_shifts_sampling():
+    """An integer (+1, +1) offset equals convolving the shifted image."""
+    r = _rs(2)
+    x_np = r.randn(1, 1, 6, 6).astype("float32")
+    w = paddle.to_tensor(r.randn(1, 1, 1, 1).astype("float32"))
+    off = np.zeros((1, 2, 6, 6), np.float32)
+    off[:, 0] = 1.0  # dy = +1
+    got = deform_conv2d(paddle.to_tensor(x_np), paddle.to_tensor(off), w)
+    # sampling y+1 with zero padding at the bottom edge
+    shifted = np.zeros_like(x_np)
+    shifted[:, :, :-1] = x_np[:, :, 1:]
+    want = shifted * w.numpy().reshape(())
+    np.testing.assert_allclose(got.numpy(), want, rtol=1e-5, atol=1e-5)
+
+
+def test_deform_conv2d_fractional_offset_numpy_ref():
+    """Fractional offsets vs an independent loop-based bilinear reference."""
+    r = _rs(3)
+    N, C, H, W, Co, K = 1, 2, 5, 5, 3, 3
+    x_np = r.randn(N, C, H, W).astype("float32")
+    w_np = r.randn(Co, C, K, K).astype("float32")
+    off_np = (r.rand(N, 2 * K * K, H, W).astype("float32") - 0.5)
+
+    got = deform_conv2d(paddle.to_tensor(x_np), paddle.to_tensor(off_np),
+                        paddle.to_tensor(w_np), padding=1).numpy()
+
+    def sample(img, y, x):
+        if y <= -1 or y >= H or x <= -1 or x >= W:
+            return 0.0
+        y0, x0 = int(np.floor(y)), int(np.floor(x))
+        wy, wx = y - y0, x - x0
+        val = 0.0
+        for (yy, xx, ww) in ((y0, x0, (1 - wy) * (1 - wx)),
+                             (y0, x0 + 1, (1 - wy) * wx),
+                             (y0 + 1, x0, wy * (1 - wx)),
+                             (y0 + 1, x0 + 1, wy * wx)):
+            if 0 <= yy < H and 0 <= xx < W:
+                val += img[yy, xx] * ww
+        return val
+
+    want = np.zeros((N, Co, H, W), np.float32)
+    for n in range(N):
+        for co in range(Co):
+            for ho in range(H):
+                for wo in range(W):
+                    acc = 0.0
+                    for c in range(C):
+                        for ki in range(K):
+                            for kj in range(K):
+                                k = ki * K + kj
+                                dy = off_np[n, 2 * k, ho, wo]
+                                dx = off_np[n, 2 * k + 1, ho, wo]
+                                y = ho - 1 + ki + dy
+                                x = wo - 1 + kj + dx
+                                acc += w_np[co, c, ki, kj] * sample(
+                                    x_np[n, c], y, x)
+                    want[n, co, ho, wo] = acc
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+def test_deform_conv2d_mask_and_grads():
+    r = _rs(4)
+    x = paddle.to_tensor(r.randn(1, 2, 6, 6).astype("float32"),
+                         stop_gradient=False)
+    w = paddle.to_tensor(r.randn(2, 2, 3, 3).astype("float32"),
+                         stop_gradient=False)
+    off = paddle.to_tensor(
+        (r.rand(1, 18, 6, 6).astype("float32") - 0.5), stop_gradient=False)
+    mask = paddle.to_tensor(r.rand(1, 9, 6, 6).astype("float32"))
+    out = deform_conv2d(x, off, w, padding=1, mask=mask)
+    out.sum().backward()
+    for t in (x, w, off):
+        assert t.grad is not None
+        assert np.isfinite(t.grad.numpy()).all()
+    # half mask halves the output
+    out2 = deform_conv2d(x, off, w, padding=1,
+                         mask=paddle.to_tensor(mask.numpy() * 0.5))
+    np.testing.assert_allclose(out2.numpy(), out.numpy() * 0.5,
+                               rtol=1e-4, atol=1e-5)
+
+
+# -- yolo_loss ---------------------------------------------------------------
+
+_ANCHORS = [10, 13, 16, 30, 33, 23]
+_MASK = [0, 1, 2]
+
+
+def _head(seed, N=2, S=3, C=4, H=4, W=4):
+    return _rs(seed).randn(N, S * (5 + C), H, W).astype("float32") * 0.1
+
+
+def test_yolo_loss_shape_and_finite():
+    x = paddle.to_tensor(_head(5))
+    gt = paddle.to_tensor(np.array(
+        [[[0.3, 0.3, 0.2, 0.2], [0.7, 0.6, 0.4, 0.3]],
+         [[0.5, 0.5, 0.1, 0.1], [0.0, 0.0, 0.0, 0.0]]], np.float32))
+    lab = paddle.to_tensor(np.array([[1, 3], [0, 0]], np.int32))
+    loss = yolo_loss(x, gt, lab, _ANCHORS, _MASK, class_num=4,
+                     ignore_thresh=0.7, downsample_ratio=32)
+    assert loss.shape == (2,)
+    assert np.isfinite(loss.numpy()).all()
+    assert (loss.numpy() > 0).all()
+
+
+def test_yolo_loss_empty_gt_only_objectness():
+    """No ground truth: the only loss left is negative objectness."""
+    x_np = _head(6)
+    x = paddle.to_tensor(x_np)
+    gt = paddle.to_tensor(np.zeros((2, 3, 4), np.float32))
+    lab = paddle.to_tensor(np.zeros((2, 3), np.int32))
+    loss = yolo_loss(x, gt, lab, _ANCHORS, _MASK, class_num=4,
+                     ignore_thresh=0.7, downsample_ratio=32)
+    # analytic: sum of BCE(obj_logit, 0) over the grid
+    S, C, H, W = 3, 4, 4, 4
+    obj = x_np.reshape(2, S, 5 + C, H, W)[:, :, 4]
+    want = np.sum(np.maximum(obj, 0) - obj * 0 + np.log1p(np.exp(-np.abs(obj))),
+                  axis=(1, 2, 3))
+    np.testing.assert_allclose(loss.numpy(), want, rtol=1e-4)
+
+
+def test_yolo_loss_trains():
+    """Gradient steps on the head must reduce the loss (end-to-end sanity
+    in place of a CUDA-kernel oracle)."""
+    from paddle_tpu import optimizer
+
+    head = paddle.to_tensor(_head(7, N=1), stop_gradient=False)
+    gt = paddle.to_tensor(np.array([[[0.4, 0.4, 0.3, 0.3]]], np.float32))
+    lab = paddle.to_tensor(np.array([[2]], np.int32))
+
+    first = None
+    for i in range(60):
+        loss = yolo_loss(head, gt, lab, _ANCHORS, _MASK, class_num=4,
+                         ignore_thresh=0.7, downsample_ratio=32).sum()
+        if first is None:
+            first = float(loss)
+        loss.backward()
+        head.set_value(paddle.to_tensor(head.numpy() - 0.1 * head.grad.numpy()))
+        head.clear_grad()
+        head.stop_gradient = False
+    assert float(loss) < first * 0.5, (first, float(loss))
+
+
+def test_yolo_loss_gt_score_weights():
+    """gt_score scales the positive terms (mixup support)."""
+    x = paddle.to_tensor(_head(8, N=1))
+    gt = paddle.to_tensor(np.array([[[0.4, 0.4, 0.3, 0.3]]], np.float32))
+    lab = paddle.to_tensor(np.array([[2]], np.int32))
+    kw = dict(anchors=_ANCHORS, anchor_mask=_MASK, class_num=4,
+              ignore_thresh=0.7, downsample_ratio=32)
+    l_full = float(yolo_loss(x, gt, lab, gt_score=paddle.to_tensor(
+        np.ones((1, 1), np.float32)), **kw).sum())
+    l_half = float(yolo_loss(x, gt, lab, gt_score=paddle.to_tensor(
+        np.full((1, 1), 0.5, np.float32)), **kw).sum())
+    assert l_half < l_full
+
+
+def test_yolo_loss_zero_length_gt_dim():
+    """B=0 gt tensors must not crash (review regression)."""
+    x_np = _head(9)
+    loss = yolo_loss(paddle.to_tensor(x_np),
+                     paddle.to_tensor(np.zeros((2, 0, 4), np.float32)),
+                     paddle.to_tensor(np.zeros((2, 0), np.int32)),
+                     _ANCHORS, _MASK, class_num=4, ignore_thresh=0.7,
+                     downsample_ratio=32)
+    obj = x_np.reshape(2, 3, 9, 4, 4)[:, :, 4]
+    want = np.sum(np.maximum(obj, 0) + np.log1p(np.exp(-np.abs(obj))),
+                  axis=(1, 2, 3))
+    np.testing.assert_allclose(loss.numpy(), want, rtol=1e-4)
+
+
+def test_yolo_loss_mixup_objectness_targets_one():
+    """gt_score weights the positive objectness term; the target stays 1.0
+    (minimizing with score=0.5 still drives the logit UP, review finding)."""
+    head = paddle.to_tensor(_head(10, N=1), stop_gradient=False)
+    gt = paddle.to_tensor(np.array([[[0.4, 0.4, 0.3, 0.3]]], np.float32))
+    lab = paddle.to_tensor(np.array([[2]], np.int32))
+    sc = paddle.to_tensor(np.full((1, 1), 0.5, np.float32))
+    for _ in range(80):
+        loss = yolo_loss(head, gt, lab, _ANCHORS, _MASK, class_num=4,
+                         ignore_thresh=0.7, downsample_ratio=32,
+                         gt_score=sc).sum()
+        loss.backward()
+        head.set_value(paddle.to_tensor(head.numpy() - 0.2 * head.grad.numpy()))
+        head.clear_grad()
+        head.stop_gradient = False
+    # the assigned cell's objectness logit must end up clearly positive
+    obj = head.numpy().reshape(1, 3, 9, 4, 4)[:, :, 4]
+    assert obj.max() > 1.0, obj.max()
