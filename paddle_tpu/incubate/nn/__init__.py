@@ -12,7 +12,8 @@ from ...nn.norm import LayerNorm
 from ...nn import container as nn_container
 from ...nn import functional as F
 
-__all__ = ["FusedMultiHeadAttention", "FusedFeedForward", "FusedMultiTransformer"]
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedMultiTransformer", "FusedMultiTransformerInt8"]
 
 
 class FusedMultiHeadAttention(Layer):
@@ -100,6 +101,8 @@ class FusedMultiTransformer(Layer):
         self.num_heads = num_heads
         self.head_dim = embed_dim // num_heads
         self.activation = activation
+        self.epsilon = epsilon
+        self.dropout_rate = dropout_rate
         layers = []
         for _ in range(num_layers):
             layers.append(nn_container.LayerDict({
@@ -137,6 +140,11 @@ class FusedMultiTransformer(Layer):
         shape = (2, batch_size, self.num_heads, max_length, self.head_dim)
         return [Tensor(jnp.zeros(shape, dtype)) for _ in range(self.num_layers)]
 
+    def _proj(self, li, name, x):
+        """One of the four heavy matmuls of layer li ('qkv', 'out',
+        'ffn1', 'ffn2') — the quantized subclass reroutes exactly this."""
+        return self.layers[li][name](x)
+
     def forward(self, src, attn_mask=None, caches=None, time_step=None):
         from ...core.dispatch import apply
         from ...ops.pallas_ops import flash_attention
@@ -152,7 +160,7 @@ class FusedMultiTransformer(Layer):
         act = F.gelu if self.activation == "gelu" else F.relu
         for li, blk in enumerate(self.layers):
             h = blk["ln1"](x)
-            qkv = blk["qkv"](h)
+            qkv = self._proj(li, "qkv", h)
             if B is None:
                 B, S, _ = qkv.shape
             q, k, v = qkv.reshape([B, S, 3, self.num_heads, self.head_dim]).unbind(axis=2)
@@ -175,9 +183,138 @@ class FusedMultiTransformer(Layer):
             else:
                 attn = flash_attention(q, k, v, attn_mask=attn_mask,
                                        is_causal=attn_mask is None)
-            x = x + self.dropout(blk["out"](attn.reshape([B, S, -1])))
+            x = x + self.dropout(self._proj(li, "out", attn.reshape([B, S, -1])))
             h = blk["ln2"](x)
-            x = x + self.dropout(blk["ffn2"](act(blk["ffn1"](h))))
+            x = x + self.dropout(
+                self._proj(li, "ffn2", act(self._proj(li, "ffn1", h))))
         if caches is not None:
             return x, new_caches
         return x
+
+
+class FusedMultiTransformerInt8(FusedMultiTransformer):
+    """Int8 stacked transformer (reference:
+    fused_multi_transformer_int8_op.cu + attn_gemm_int8.h — per-layer
+    int8 GEMMs with dequant rescale; inference-only, like the reference op).
+
+    TPU-native quantization recipe:
+    - weights are stored int8 with per-output-channel fp32 scales
+      (halves/quarters weight HBM, the dominant decode-time traffic),
+    - act_quant="dynamic" (default) also quantizes activations per tensor
+      at runtime and runs int8 x int8 -> int32 dot_general — the MXU has a
+      native int8 path — then dequantizes by act_scale * w_scale,
+    - act_quant="none" is weight-only: dequantize weights into the
+      activation dtype on the fly (robust to outlier activations).
+
+    Build one with `FusedMultiTransformerInt8.from_float(fmt)` to quantize
+    an existing FusedMultiTransformer, or construct directly and call
+    load-state on the float twin before `quantize_()`.
+    """
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu", normalize_before=True,
+                 num_layers=1, epsilon=1e-5, nranks=1, ring_id=-1,
+                 act_quant="dynamic", name=None):
+        super().__init__(embed_dim, num_heads, dim_feedforward,
+                         dropout_rate, activation, normalize_before,
+                         num_layers, epsilon, nranks, ring_id, name)
+        if act_quant not in ("dynamic", "none"):
+            raise ValueError("act_quant must be 'dynamic' or 'none'")
+        self.act_quant = act_quant
+        self._qweights = None   # [{name: (int8 w, f32 scale)}] per layer
+
+    _QNAMES = ("qkv", "out", "ffn1", "ffn2")
+
+    def quantize_(self, free_float=True):
+        """Quantize the current float weights (per-out-channel symmetric
+        int8, reference round-to-nearest with 127 bound). free_float=True
+        (default) releases the float weight buffers so the advertised
+        weight-HBM saving is real; state_dict() then materializes
+        dequantized weights on demand."""
+        qw = []
+        for blk in self.layers:
+            entry = {}
+            for nm in self._QNAMES:
+                w = blk[nm].weight._data.astype(jnp.float32)   # [in, out]
+                scale = jnp.max(jnp.abs(w), axis=0) / 127.0
+                scale = jnp.maximum(scale, 1e-8)
+                wi8 = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+                entry[nm] = (wi8, scale, tuple(w.shape), blk[nm].weight.dtype)
+                if free_float:
+                    blk[nm].weight._data = jnp.zeros((), blk[nm].weight.dtype)
+            qw.append(entry)
+        self._qweights = qw
+        return self
+
+    def state_dict(self, *a, **k):
+        """Materialize dequantized weights for the freed float params so
+        checkpoints of a quantized module stay loadable by the float
+        twin (values carry the quantization error, as expected). The
+        entries are FRESH tensors — the module's own freed buffers stay
+        freed."""
+        from ...core.tensor import Tensor as _T
+
+        out = super().state_dict(*a, **k)
+        if self._qweights is None:
+            return out
+        freed = {}
+        for blk, entry in zip(self.layers, self._qweights):
+            for nm, (wi8, scale, shape, dt) in entry.items():
+                freed[id(blk[nm].weight)] = (wi8, scale, dt)
+        for key, t in list(out.items()):
+            hit = freed.get(id(t))
+            if hit is not None:
+                wi8, scale, dt = hit
+                out[key] = _T((wi8.astype(jnp.float32) * scale).astype(dt))
+        return out
+
+    @classmethod
+    def from_float(cls, fmt: "FusedMultiTransformer", act_quant="dynamic"):
+        embed = fmt.num_heads * fmt.head_dim
+        ffn = fmt.layers[0]["ffn1"].weight.shape[1]
+        q = cls(embed, fmt.num_heads, ffn, dropout_rate=fmt.dropout_rate,
+                activation=fmt.activation, num_layers=fmt.num_layers,
+                epsilon=fmt.epsilon, act_quant=act_quant)
+        q.set_state_dict(fmt.state_dict())
+        return q.quantize_()
+
+    def _proj(self, li, nm, x):
+        """Reroute the parent's four heavy matmuls through int8."""
+        if self._qweights is None:
+            raise RuntimeError(
+                "FusedMultiTransformerInt8 weights are not quantized yet — "
+                "call quantize_() (or build via from_float)")
+        return self._q_linear(x, li, nm)
+
+    def _q_linear(self, x, li, nm):
+        """x @ W through the int8 path (+ float bias)."""
+        from ...core.dispatch import apply
+
+        wi8, scale = self._qweights[li][nm][:2]
+        bias = self.layers[li][nm].bias
+        dynamic = self.act_quant == "dynamic"
+
+        def fn(a, w, s, *maybe_b):
+            import jax
+
+            if dynamic:
+                amax = jnp.maximum(jnp.max(jnp.abs(a)), 1e-8)
+                s_a = (amax / 127.0).astype(jnp.float32)
+                ai8 = jnp.clip(jnp.round(a / s_a.astype(a.dtype)),
+                               -127, 127).astype(jnp.int8)
+                acc = jax.lax.dot_general(
+                    ai8, w, (((a.ndim - 1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32)
+                out = acc.astype(jnp.float32) * (s_a * s)
+            else:
+                out = a @ (w.astype(a.dtype) * s.astype(a.dtype))
+            out = out.astype(a.dtype)
+            if maybe_b:
+                out = out + maybe_b[0]
+            return out
+
+        args = [x, wi8, scale]
+        if bias is not None:
+            args.append(bias)
+        return apply(fn, *args, name=f"int8_{nm}")
+

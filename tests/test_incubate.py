@@ -134,3 +134,79 @@ def test_meta_parallel_wrappers_place_model():
     sp = ShardingParallel(nn.Linear(4, 4))
     out = sp(paddle.to_tensor(np.ones((2, 4), np.float32)))
     assert out.shape == (2, 4)
+
+
+def test_fused_multi_transformer_int8_parity():
+    """Int8 (A8W8 dynamic and weight-only) tracks the float layer within
+    quantization tolerance (reference test_fused_multi_transformer_int8_op
+    parity bound)."""
+    from paddle_tpu.incubate.nn import (
+        FusedMultiTransformer, FusedMultiTransformerInt8)
+
+    paddle.seed(11)
+    fmt = FusedMultiTransformer(32, 4, 64, num_layers=2)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 6, 32)
+                         .astype("float32") * 0.5)
+    ref = fmt(x).numpy()
+
+    for mode in ("dynamic", "none"):
+        q = FusedMultiTransformerInt8.from_float(fmt, act_quant=mode)
+        got = q(x).numpy()
+        err = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-6)
+        assert err < 0.08, (mode, err)
+        # int8 weights really are int8; float weight buffers are freed
+        wi8, scale = q._qweights[0]["qkv"][:2]
+        assert wi8.dtype == np.int8 and scale.dtype == np.float32
+        assert q.layers[0]["qkv"].weight._data.ndim == 0
+        # state_dict still materializes loadable dequantized weights
+        sd = q.state_dict()
+        wkey = next(k for k in sd if "qkv" in k and "weight" in k)
+        assert sd[wkey].shape == fmt.state_dict()[wkey].shape
+
+
+def test_fused_multi_transformer_int8_cache_decode():
+    from paddle_tpu.incubate.nn import (
+        FusedMultiTransformer, FusedMultiTransformerInt8)
+
+    paddle.seed(12)
+    fmt = FusedMultiTransformer(16, 2, 32, num_layers=1)
+    q = FusedMultiTransformerInt8.from_float(fmt)
+    x = paddle.to_tensor(np.random.RandomState(1).randn(1, 4, 16)
+                         .astype("float32") * 0.5)
+    caches = q.gen_cache(1, 8)
+    full, _ = q(x, caches=caches, time_step=0)
+    # decode one more token against the warm cache
+    nxt = paddle.to_tensor(np.random.RandomState(2).randn(1, 1, 16)
+                           .astype("float32") * 0.5)
+    out, _ = q(nxt, caches=caches, time_step=4)
+    assert out.shape == (1, 1, 16)
+    assert np.isfinite(out.numpy()).all()
+
+
+def test_fused_multi_transformer_int8_requires_quantize():
+    from paddle_tpu.incubate.nn import FusedMultiTransformerInt8
+
+    q = FusedMultiTransformerInt8(16, 2, 32)
+    with pytest.raises(RuntimeError, match="quantize"):
+        q(paddle.to_tensor(np.zeros((1, 2, 16), np.float32)))
+
+
+def test_fused_multi_transformer_int8_propagates_epsilon():
+    from paddle_tpu.incubate.nn import (
+        FusedMultiTransformer, FusedMultiTransformerInt8)
+
+    fmt = FusedMultiTransformer(16, 2, 32, epsilon=1e-3, dropout_rate=0.2)
+    q = FusedMultiTransformerInt8.from_float(fmt)
+    assert q.epsilon == 1e-3
+    assert q.dropout_rate == 0.2
+
+
+def test_fused_multi_transformer_int8_cache_len_validated():
+    from paddle_tpu.incubate.nn import (
+        FusedMultiTransformer, FusedMultiTransformerInt8)
+
+    fmt = FusedMultiTransformer(16, 2, 32, num_layers=2)
+    q = FusedMultiTransformerInt8.from_float(fmt)
+    x = paddle.to_tensor(np.zeros((1, 2, 16), np.float32))
+    with pytest.raises(ValueError, match="caches"):
+        q(x, caches=q.gen_cache(1, 8)[:1], time_step=0)
