@@ -60,6 +60,8 @@ def _ensure_backend():
     if os.environ.get("PTPU_BENCH_PROBED") == "1":
         return
     os.environ["PTPU_BENCH_PROBED"] = "1"
+    if os.environ.get("PTPU_FORCE_PLATFORM"):
+        return  # caller already pinned the backend; nothing to probe
     if not _backend_alive():
         # --ladder children inherit the decision through the paddle_tpu
         # import hook (bare JAX_PLATFORMS is overridden by site customize)

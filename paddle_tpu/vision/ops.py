@@ -13,9 +13,10 @@ import jax.numpy as jnp
 
 from ..core.tensor import Tensor
 from ..core.dispatch import apply
+from ..nn.layer import Layer as _Layer
 
 __all__ = ["nms", "box_iou", "roi_align", "roi_pool", "box_coder",
-           "prior_box", "deform_conv2d", "yolo_loss"]
+           "prior_box", "deform_conv2d", "yolo_loss", "DeformConv2D"]
 
 
 def box_iou(boxes1, boxes2):
@@ -442,3 +443,36 @@ def _scatter_max(flat, idx, val):
     """flat [N, M], idx/val [N, B] -> max-scatter (duplicate cells keep the
     strongest target)."""
     return jax.vmap(lambda f, i, v: f.at[i].max(v))(flat, idx, val)
+
+
+class DeformConv2D(_Layer):
+    """Layer form of deform_conv2d (reference: vision/ops.py DeformConv2D).
+    forward(x, offset, mask=None) -> feature map."""
+
+    def __init__(self, in_channels, out_channels, kernel_size,
+                 stride=1, padding=0, dilation=1,
+                 deformable_groups=1, groups=1, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        from ..nn.initializer import Normal, Constant
+
+        ks = _pair(kernel_size)
+        self._attrs = dict(stride=stride, padding=padding,
+                           dilation=dilation,
+                           deformable_groups=deformable_groups,
+                           groups=groups)
+        fan = in_channels * ks[0] * ks[1]
+        self.weight = self.create_parameter(
+            shape=[out_channels, in_channels // groups, ks[0], ks[1]],
+            attr=weight_attr,
+            default_initializer=Normal(std=(2.0 / fan) ** 0.5))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                shape=[out_channels], attr=bias_attr,
+                default_initializer=Constant(0.0))
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, bias=self.bias,
+                             mask=mask, **self._attrs)

@@ -213,3 +213,31 @@ def test_yolo_loss_mixup_objectness_targets_one():
     # the assigned cell's objectness logit must end up clearly positive
     obj = head.numpy().reshape(1, 3, 9, 4, 4)[:, :, 4]
     assert obj.max() > 1.0, obj.max()
+
+
+def test_deform_conv2d_layer():
+    from paddle_tpu.vision.ops import DeformConv2D
+
+    layer = DeformConv2D(3, 6, 3, padding=1)
+    x = paddle.to_tensor(_rs(20).randn(1, 3, 6, 6).astype("float32"))
+    off = paddle.to_tensor(np.zeros((1, 18, 6, 6), np.float32))
+    out = layer(x, off)
+    assert out.shape == (1, 6, 6, 6)
+    want = F.conv2d(x, layer.weight, bias=layer.bias, padding=1)
+    np.testing.assert_allclose(out.numpy(), want.numpy(), rtol=1e-4, atol=1e-4)
+    assert len(layer.parameters()) == 2
+
+
+def test_deform_conv2d_layer_is_real_class():
+    """Review regression: DeformConv2D must be a plain Layer subclass
+    (isinstance, pickling, subclassing all work)."""
+    import pickle
+    from paddle_tpu.nn.layer import Layer
+    from paddle_tpu.vision.ops import DeformConv2D
+
+    layer = DeformConv2D(2, 2, 3)
+    assert isinstance(layer, DeformConv2D)
+    assert isinstance(layer, Layer)
+    assert type(DeformConv2D(2, 2, 3)) is type(layer)
+    blob = pickle.dumps(layer.state_dict())
+    assert pickle.loads(blob)
