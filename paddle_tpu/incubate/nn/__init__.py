@@ -13,7 +13,8 @@ from ...nn import container as nn_container
 from ...nn import functional as F
 
 __all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
-           "FusedMultiTransformer", "FusedMultiTransformerInt8"]
+           "FusedMultiTransformer", "FusedMultiTransformerInt8",
+           "FusedEcMoe", "fused_ec_moe"]
 
 
 class FusedMultiHeadAttention(Layer):
@@ -318,3 +319,79 @@ class FusedMultiTransformerInt8(FusedMultiTransformer):
             args.append(bias)
         return apply(fn, *args, name=f"int8_{nm}")
 
+
+
+def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
+                 act_type="gelu"):
+    """Expert-choice MoE (reference: incubate/nn/functional/fused_ec_moe
+    over fused_moe_kernel.cu): each expert selects its top seq_len/16
+    tokens by gate logit, applies its FFN as one batched einsum over the
+    expert dim (MXU-friendly — no host-side grouping), and the outputs
+    scatter back weighted by the softmax gate probability, residual-added.
+
+    x [B,S,D]; gate [B,S,E]; bmm0_weight [E,D,F]; bmm0_bias [E,1,F];
+    bmm1_weight [E,F,D]; bmm1_bias [E,1,D].
+    """
+    from ...core.dispatch import apply
+    import jax
+
+    if act_type not in ("gelu", "relu"):
+        raise ValueError("act_type must be 'gelu' or 'relu'")
+
+    def fn(xa, g, w0, b0, w1, b1):
+        B, S, D = xa.shape
+        E = g.shape[-1]
+        cap = max(S // 16, 1)           # reference capacity rule
+        probs = jax.nn.softmax(g, axis=-1)            # [B,S,E]
+        logits_e = jnp.swapaxes(g, 1, 2)              # [B,E,S]
+        _, idx = jax.lax.top_k(logits_e, cap)         # [B,E,cap]
+        sel = jnp.take_along_axis(
+            xa[:, None], idx[..., None], axis=2)      # [B,E,cap,D]
+        h = jnp.einsum("becd,edf->becf", sel, w0,
+                       preferred_element_type=jnp.float32).astype(xa.dtype)
+        h = h + b0                # [E,1,F] broadcasts over [B,E,cap,F]
+        h = jax.nn.gelu(h, approximate=True) if act_type == "gelu" \
+            else jax.nn.relu(h)
+        o = jnp.einsum("becf,efd->becd", h, w1,
+                       preferred_element_type=jnp.float32).astype(xa.dtype)
+        o = o + b1                # [E,1,D] broadcasts over [B,E,cap,D]
+        p = jnp.take_along_axis(jnp.swapaxes(probs, 1, 2), idx, axis=2)
+        o = o * p[..., None]                          # [B,E,cap,D]
+        out = jnp.zeros_like(xa)
+        b_ix = jnp.broadcast_to(jnp.arange(B)[:, None, None], idx.shape)
+        out = out.at[b_ix.reshape(-1), idx.reshape(-1)].add(
+            o.reshape(-1, D))
+        return xa + out
+
+    return apply(fn, x, gate, bmm0_weight, bmm0_bias, bmm1_weight,
+                 bmm1_bias, name="fused_ec_moe")
+
+
+class FusedEcMoe(Layer):
+    """Layer form (reference: incubate/nn/layer/fused_ec_moe.py
+    FusedEcMoe). forward(x, gate) -> [B, S, D]."""
+
+    def __init__(self, hidden_size, inter_size, num_expert, act_type="gelu",
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ...nn.initializer import XavierNormal, Constant
+
+        if act_type not in ("gelu", "relu"):   # fail at construction
+            raise ValueError("act_type must be 'gelu' or 'relu'")
+        self.act_type = act_type
+        self.bmm_weight0 = self.create_parameter(
+            shape=[num_expert, hidden_size, inter_size], attr=weight_attr,
+            default_initializer=XavierNormal())
+        self.bmm_bias0 = self.create_parameter(
+            shape=[num_expert, 1, inter_size], attr=bias_attr,
+            default_initializer=Constant(0.0))
+        self.bmm_weight1 = self.create_parameter(
+            shape=[num_expert, inter_size, hidden_size], attr=weight_attr,
+            default_initializer=XavierNormal())
+        self.bmm_bias1 = self.create_parameter(
+            shape=[num_expert, 1, hidden_size], attr=bias_attr,
+            default_initializer=Constant(0.0))
+
+    def forward(self, x, gate):
+        return fused_ec_moe(x, gate, self.bmm_weight0, self.bmm_bias0,
+                            self.bmm_weight1, self.bmm_bias1, self.act_type)

@@ -210,3 +210,97 @@ def test_fused_multi_transformer_int8_cache_len_validated():
     x = paddle.to_tensor(np.zeros((1, 2, 16), np.float32))
     with pytest.raises(ValueError, match="caches"):
         q(x, caches=q.gen_cache(1, 8)[:1], time_step=0)
+
+
+def _np_ec_moe_ref(x, gate, w0, b0, w1, b1):
+    """Independent numpy implementation of the reference expert-choice
+    algorithm (test_fused_ec_moe_op.py GetBaselineOut)."""
+    B, S, D = x.shape
+    E = gate.shape[-1]
+    cap = max(S // 16, 1)
+    e_logits = np.exp(gate - gate.max(-1, keepdims=True))
+    probs = e_logits / e_logits.sum(-1, keepdims=True)
+    out = np.zeros_like(x)
+    for b in range(B):
+        for e in range(E):
+            top = np.argsort(-gate[b, :, e], kind="stable")[:cap]
+            sel = x[b, top]                              # [cap, D]
+            h = sel @ w0[e] + b0[e, 0]
+            h = 0.5 * h * (1.0 + np.tanh(
+                np.sqrt(2.0 / np.pi) * (h + 0.044715 * h ** 3)))
+            o = h @ w1[e] + b1[e, 0]
+            out[b, top] += o * probs[b, top, e][:, None]
+    return x + out
+
+
+def test_fused_ec_moe_matches_reference_algorithm():
+    from paddle_tpu.incubate.nn import fused_ec_moe
+
+    r = np.random.RandomState(3)
+    B, S, D, F_, E = 2, 32, 8, 16, 4
+    x = r.randn(B, S, D).astype("float32") * 0.5
+    gate = r.randn(B, S, E).astype("float32")
+    w0 = r.randn(E, D, F_).astype("float32") * 0.1
+    b0 = r.randn(E, 1, F_).astype("float32") * 0.1
+    w1 = r.randn(E, F_, D).astype("float32") * 0.1
+    b1 = r.randn(E, 1, D).astype("float32") * 0.1
+
+    got = fused_ec_moe(paddle.to_tensor(x), paddle.to_tensor(gate),
+                       paddle.to_tensor(w0), paddle.to_tensor(b0),
+                       paddle.to_tensor(w1), paddle.to_tensor(b1)).numpy()
+    want = _np_ec_moe_ref(x, gate, w0, b0, w1, b1)
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_fused_ec_moe_layer_trains():
+    from paddle_tpu.incubate.nn import FusedEcMoe
+
+    paddle.seed(5)
+    moe = FusedEcMoe(8, 16, 4)
+    optim = opt.Adam(5e-3, parameters=moe.parameters())
+    r = np.random.RandomState(4)
+    x = paddle.to_tensor(r.randn(2, 32, 8).astype("float32"))
+    gate = paddle.to_tensor(r.randn(2, 32, 4).astype("float32"))
+    tgt = paddle.to_tensor(r.randn(2, 32, 8).astype("float32"))
+    losses = []
+    for _ in range(10):
+        loss = ((moe(x, gate) - tgt) ** 2).mean()
+        loss.backward()
+        optim.step()
+        optim.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    assert len(moe.parameters()) == 4
+
+
+def test_fused_ec_moe_relu_and_bad_act():
+    from paddle_tpu.incubate.nn import FusedEcMoe, fused_ec_moe
+
+    with pytest.raises(ValueError, match="act_type"):
+        FusedEcMoe(8, 16, 2, act_type="swish")
+
+    # relu branch vs reference algorithm with relu
+    r = np.random.RandomState(6)
+    B, S, D, F_, E = 1, 32, 4, 8, 2
+    x = r.randn(B, S, D).astype("float32") * 0.5
+    gate = r.randn(B, S, E).astype("float32")
+    w0 = r.randn(E, D, F_).astype("float32") * 0.1
+    b0 = r.randn(E, 1, F_).astype("float32") * 0.1
+    w1 = r.randn(E, F_, D).astype("float32") * 0.1
+    b1 = r.randn(E, 1, D).astype("float32") * 0.1
+    got = fused_ec_moe(paddle.to_tensor(x), paddle.to_tensor(gate),
+                       paddle.to_tensor(w0), paddle.to_tensor(b0),
+                       paddle.to_tensor(w1), paddle.to_tensor(b1),
+                       act_type="relu").numpy()
+    # reference loop with relu
+    cap = max(S // 16, 1)
+    e_logits = np.exp(gate - gate.max(-1, keepdims=True))
+    probs = e_logits / e_logits.sum(-1, keepdims=True)
+    want = x.copy()
+    for b in range(B):
+        for e in range(E):
+            top = np.argsort(-gate[b, :, e], kind="stable")[:cap]
+            h = np.maximum(x[b, top] @ w0[e] + b0[e, 0], 0.0)
+            o = h @ w1[e] + b1[e, 0]
+            want[b, top] += o * probs[b, top, e][:, None]
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
