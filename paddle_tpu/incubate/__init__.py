@@ -3,5 +3,6 @@ MoE, autograd functional; populated across rounds)."""
 from . import nn
 from . import autograd
 from . import asp
+from . import optimizer
 
-__all__ = ["nn", "autograd", "asp"]
+__all__ = ["nn", "autograd", "asp", "optimizer"]

@@ -1,0 +1,206 @@
+"""incubate.optimizer (reference: python/paddle/incubate/optimizer/
+lbfgs.py — closure-driven L-BFGS with optional strong-Wolfe line search).
+
+TPU-native notes: the two-loop recursion is a handful of dot products on
+one flattened parameter vector — pure jnp, negligible next to the
+closure's forward/backward, so no custom kernel is warranted. The
+closure re-runs the whole model; with jit.compile-wrapped closures each
+line-search probe is one XLA executable call.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["LBFGS"]
+
+
+class LBFGS:
+    """L-BFGS (history-based quasi-Newton). step(closure) semantics match
+    the reference: `closure` clears grads, computes the loss, calls
+    backward, and returns the loss tensor."""
+
+    def __init__(self, learning_rate: float = 1.0, max_iter: int = 20,
+                 max_eval: Optional[int] = None, tolerance_grad: float = 1e-7,
+                 tolerance_change: float = 1e-9, history_size: int = 100,
+                 line_search_fn: Optional[str] = None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        if parameters is None:
+            raise ValueError("LBFGS requires an explicit parameter list")
+        if line_search_fn not in (None, "strong_wolfe"):
+            raise ValueError("line_search_fn must be None or 'strong_wolfe'")
+        self._params: List[Tensor] = list(parameters)
+        self.lr = float(learning_rate)
+        self.max_iter = max_iter
+        self.max_eval = max_eval if max_eval is not None else max_iter * 5 // 4
+        self.tol_grad = tolerance_grad
+        self.tol_change = tolerance_change
+        self.history_size = history_size
+        self.line_search_fn = line_search_fn
+        self._wd = float(weight_decay) if weight_decay else 0.0
+        self._grad_clip = grad_clip
+        self._s, self._y, self._rho = [], [], []
+        self._n_evals = 0
+
+    # -- flat helpers ------------------------------------------------------
+    def _gather_flat_grad(self):
+        grads = [(p.grad._data if p.grad is not None
+                  else jnp.zeros(p.shape, p.dtype)) for p in self._params]
+        if self._grad_clip is not None:
+            grads = self._grad_clip.apply(grads)
+        if self._wd:
+            grads = [g + self._wd * p._data
+                     for g, p in zip(grads, self._params)]
+        return jnp.concatenate([g.reshape(-1) for g in grads])
+
+    def _gather_flat_params(self):
+        return jnp.concatenate([p._data.reshape(-1) for p in self._params])
+
+    def _set_flat_params(self, flat):
+        off = 0
+        for p in self._params:
+            n = int(jnp.size(p._data))
+            p._set_data(flat[off:off + n].reshape(p.shape).astype(p.dtype))
+            off += n
+
+    def clear_grad(self):
+        for p in self._params:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    # -- direction ---------------------------------------------------------
+    def _two_loop(self, grad):
+        q = grad
+        alphas = []
+        for s, y, rho in zip(reversed(self._s), reversed(self._y),
+                             reversed(self._rho)):
+            a = rho * jnp.dot(s, q)
+            alphas.append(a)
+            q = q - a * y
+        if self._s:
+            gamma = jnp.dot(self._s[-1], self._y[-1]) / jnp.maximum(
+                jnp.dot(self._y[-1], self._y[-1]), 1e-10)
+            q = q * gamma
+        for (s, y, rho), a in zip(zip(self._s, self._y, self._rho),
+                                  reversed(alphas)):
+            b = rho * jnp.dot(y, q)
+            q = q + s * (a - b)
+        return -q
+
+    # -- line search -------------------------------------------------------
+    def _eval(self, closure, flat_x):
+        self._set_flat_params(flat_x)
+        loss = closure()
+        self._n_evals += 1
+        return float(loss), self._gather_flat_grad()
+
+    def _budget_left(self):
+        return self._n_evals < self.max_eval
+
+    def _strong_wolfe(self, closure, x0, d, f0, g0, t0,
+                      c1=1e-4, c2=0.9, max_ls=25):
+        """Bracket + bisection-zoom strong-Wolfe search along d from x0.
+        Returns (t, f_t, grad_at_t) with params LEFT AT x0 + t*d, so the
+        caller never re-evaluates. Honors the global max_eval budget."""
+        gtd0 = float(jnp.dot(g0, d))
+        t_prev, f_prev, g_prev = 0.0, f0, gtd0
+        t = t0
+        f_t, g_flat = self._eval(closure, x0 + t * d)
+        bracket = None
+        for _ in range(max_ls):
+            gtd = float(jnp.dot(g_flat, d))
+            if f_t > f0 + c1 * t * gtd0 or f_t >= f_prev:
+                bracket = (t_prev, f_prev, t)
+                break
+            if abs(gtd) <= -c2 * gtd0 or not self._budget_left():
+                return t, f_t, g_flat
+            if gtd >= 0:
+                bracket = (t_prev, f_prev, t)
+                break
+            t_prev, f_prev, g_prev = t, f_t, gtd
+            t = t * 2.0
+            f_t, g_flat = self._eval(closure, x0 + t * d)
+        if bracket is None:
+            return t, f_t, g_flat
+        lo_t, lo_f, hi_t = bracket
+        best = (t, f_t, g_flat)
+        for _ in range(max_ls):
+            if not self._budget_left():
+                break
+            t = 0.5 * (lo_t + hi_t)   # bisection zoom (robust)
+            f_t, g_flat = self._eval(closure, x0 + t * d)
+            gtd = float(jnp.dot(g_flat, d))
+            if f_t <= best[1]:
+                best = (t, f_t, g_flat)
+            if f_t > f0 + c1 * t * gtd0 or f_t >= lo_f:
+                hi_t = t
+            else:
+                if abs(gtd) <= -c2 * gtd0:
+                    return t, f_t, g_flat
+                lo_t, lo_f = t, f_t
+            if abs(hi_t - lo_t) < 1e-10:
+                break
+        t, f_t, g_flat = best
+        self._set_flat_params(x0 + t * d)   # leave params at the winner
+        return t, f_t, g_flat
+
+    # -- main --------------------------------------------------------------
+    def step(self, closure: Callable):
+        loss = closure()
+        self._n_evals = 1
+        f = float(loss)
+        flat_grad = self._gather_flat_grad()
+        if float(jnp.max(jnp.abs(flat_grad))) <= self.tol_grad:
+            return loss
+
+        for _ in range(self.max_iter):
+            d = self._two_loop(flat_grad)
+            if not self._s:
+                d = d / jnp.maximum(jnp.sum(jnp.abs(flat_grad)), 1.0)
+            x0 = self._gather_flat_params()
+            gtd = float(jnp.dot(flat_grad, d))
+            if gtd > -self.tol_change:
+                break
+
+            if self.line_search_fn == "strong_wolfe":
+                t, new_f, new_grad = self._strong_wolfe(
+                    closure, x0, d, f, flat_grad, t0=self.lr)
+            else:
+                t = self.lr
+                new_f, new_grad = self._eval(closure, x0 + t * d)
+
+            s = t * d
+            y = new_grad - flat_grad
+            ys = float(jnp.dot(y, s))
+            if ys > 1e-10:
+                if len(self._s) >= self.history_size:
+                    self._s.pop(0)
+                    self._y.pop(0)
+                    self._rho.pop(0)
+                self._s.append(s)
+                self._y.append(y)
+                self._rho.append(1.0 / ys)
+
+            delta = abs(new_f - f)
+            f, flat_grad = new_f, new_grad
+            if float(jnp.max(jnp.abs(flat_grad))) <= self.tol_grad:
+                break
+            if delta < self.tol_change:
+                break
+            if self._n_evals >= self.max_eval:
+                break
+        return Tensor(jnp.asarray(f, jnp.float32))
+
+    def state_dict(self):
+        return {"s": [Tensor(a) for a in self._s],
+                "y": [Tensor(a) for a in self._y],
+                "rho": list(self._rho)}
+
+    def set_state_dict(self, state):
+        self._s = [t._data for t in state.get("s", [])]
+        self._y = [t._data for t in state.get("y", [])]
+        self._rho = list(state.get("rho", []))
