@@ -14,7 +14,7 @@ from ...nn import functional as F
 
 __all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
            "FusedMultiTransformer", "FusedMultiTransformerInt8",
-           "FusedEcMoe", "fused_ec_moe"]
+           "FusedEcMoe", "fused_ec_moe", "functional"]
 
 
 class FusedMultiHeadAttention(Layer):
@@ -395,3 +395,6 @@ class FusedEcMoe(Layer):
     def forward(self, x, gate):
         return fused_ec_moe(x, gate, self.bmm_weight0, self.bmm_bias0,
                             self.bmm_weight1, self.bmm_bias1, self.act_type)
+
+
+from . import functional  # noqa: E402  (needs fused_ec_moe above)

@@ -422,3 +422,41 @@ def test_lbfgs_respects_eval_budget():
     optim.step(closure)
     # bracketing may overshoot by at most one probe per phase
     assert calls["n"] <= 8 + 3, calls["n"]
+
+
+def test_incubate_nn_functional_surface():
+    from paddle_tpu.incubate.nn import functional as IF
+
+    r = np.random.RandomState(7)
+    x = paddle.to_tensor(r.randn(2, 4, 8).astype("float32"))
+    w = paddle.to_tensor(r.randn(8, 8).astype("float32"))
+    b = paddle.to_tensor(r.randn(8).astype("float32"))
+
+    out = IF.fused_linear(x, w, b)
+    np.testing.assert_allclose(out.numpy(), x.numpy() @ w.numpy() + b.numpy(),
+                               rtol=1e-5, atol=1e-5)
+    out_t = IF.fused_matmul_bias(x, w, b, transpose_y=True)
+    np.testing.assert_allclose(out_t.numpy(),
+                               x.numpy() @ w.numpy().T + b.numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+    res = paddle.to_tensor(r.randn(2, 4, 8).astype("float32"))
+    ln = IF.fused_bias_dropout_residual_layer_norm(
+        x, res, dropout_rate=0.0, training=False)
+    # matches manual compose
+    want = nn.functional.layer_norm(x + res, normalized_shape=[8])
+    np.testing.assert_allclose(ln.numpy(), want.numpy(), rtol=1e-4, atol=1e-4)
+
+    w1 = paddle.to_tensor(r.randn(8, 16).astype("float32"))
+    w2 = paddle.to_tensor(r.randn(16, 8).astype("float32"))
+    ff = IF.fused_feedforward(x, w1, w2, dropout1_rate=0.0, dropout2_rate=0.0,
+                              training=False)
+    assert ff.shape == (2, 4, 8)
+
+    qkv_w = paddle.to_tensor(r.randn(8, 24).astype("float32"))
+    lin_w = paddle.to_tensor(r.randn(8, 8).astype("float32"))
+    at = IF.fused_multi_head_attention(
+        x, qkv_w, lin_w, num_heads=2, dropout_rate=0.0,
+        attn_dropout_rate=0.0, training=False)
+    assert at.shape == (2, 4, 8)
+    assert np.isfinite(at.numpy()).all()
