@@ -51,22 +51,28 @@ def apply(fn: Callable, *tensor_args, n_outs=None, name=None, **static_kwargs):
     ]
     trace_grad = tape.is_grad_enabled() and any(needs)
 
-    # Forward runs WITHOUT jax.vjp: linearization tracing costs ~5x the op
-    # itself on eager dispatch (measured 4295us vs 776us for a 256^2
+    # Eager forward runs WITHOUT jax.vjp: linearization tracing costs ~5x
+    # the op itself on eager dispatch (measured 4295us vs 776us for a 256^2
     # matmul chain on CPU), so the tape stores the pure forward and
     # materializes the pullback lazily at backward time (tape.Node
     # .ensure_vjp) — forwards that never reach a backward (eval loops
     # without no_grad, the SURVEY §7 "eager overhead" hard part) no
-    # longer pay for gradients. Under jit tracing the recomputed forward
-    # dedups via XLA CSE.
-    out = fn_c(*arrays)
+    # longer pay for gradients. UNDER A JIT TRACE the pullback is taken
+    # up front instead: the lazy path would re-trace the forward into the
+    # same jaxpr a second time, and XLA does not reliably CSE the
+    # duplicate across Pallas custom-call boundaries (measured -23%
+    # tokens/sec on the GPT-2 bench when the flash forward ran twice).
+    if trace_grad and any(isinstance(a, jax.core.Tracer) for a in arrays):
+        out, vjp_fn = jax.vjp(fn_c, *arrays)
+    else:
+        out, vjp_fn = fn_c(*arrays), None
 
     multi = isinstance(out, (tuple, list))
     outs = list(out) if multi else [out]
     out_ts = [Tensor(o) for o in outs]
 
     if trace_grad:
-        tape.record(None, ts, needs, out_ts,
+        tape.record(vjp_fn, ts, needs, out_ts,
                     name=name or getattr(fn, "__name__", "op"), fwd_fn=fn_c)
 
     prog = _static_recording()
