@@ -27,6 +27,7 @@ in models (GPTStackedBlocks) and meta_parallel.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any, Callable
 
 import jax
@@ -39,15 +40,37 @@ from .mesh import get_mesh, axis_size
 __all__ = ["pipeline_apply", "pipeline_1f1b", "scan_blocks"]
 
 
-def scan_blocks(block_fn: Callable, stacked_params: Any, x, unroll: int = 1):
+def scan_blocks(block_fn: Callable, stacked_params: Any, x, unroll: int | None = None):
     """Apply L stacked blocks sequentially via lax.scan (single-stage path;
     compile time O(1) in depth — the TPU answer to the reference's per-layer
-    Program ops)."""
+    Program ops).
+
+    Default unroll policy (override with PTPU_SCAN_UNROLL=<n>, 0 = full):
+    FULLY unroll when depth <= 32, else keep the rolled scan. Measured on
+    v5e (GPT-2 124M, batch 8 x seq 1024): full unroll 108.3k tokens/sec vs
+    92k rolled (+18%) — XLA schedules DMA prefetch and fusion across block
+    boundaries that a scan body boundary forbids. PARTIAL unroll is a trap
+    (unroll=2: 65k, unroll=4: 60k — worse than rolled) and is never chosen
+    automatically. Deep stacks keep O(1)-in-depth compile time. Pipeline
+    stage bodies pass an explicit unroll=1: they already sit inside the
+    scanned pipeline tick loop, where replicating the stage body would
+    multiply the pipeline program's size per tick (unmeasured, and the
+    bench above only covers the single-stage path)."""
+
+    def _depth():
+        return jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+
+    if unroll is None:
+        env = os.environ.get("PTPU_SCAN_UNROLL")
+        unroll = int(env) if env is not None else (
+            _depth() if _depth() <= 32 else 1)
+    if unroll <= 0:
+        unroll = _depth()
 
     def body(h, p):
         return block_fn(p, h), None
 
-    out, _ = jax.lax.scan(body, x, stacked_params, unroll=unroll)
+    out, _ = jax.lax.scan(body, x, stacked_params, unroll=max(1, unroll))
     return out
 
 
@@ -93,8 +116,10 @@ def pipeline_apply(
     xs = x.reshape((M, B // M) + x.shape[1:])
 
     def stage_fn(params, h):
-        # params leaves: [k, ...] — this stage's k blocks, scanned.
-        return scan_blocks(block_fn, params, h)
+        # params leaves: [k, ...] — this stage's k blocks, scanned rolled:
+        # this body repeats inside the pipeline tick loop, so unrolling it
+        # would multiply program size per tick.
+        return scan_blocks(block_fn, params, h, unroll=1)
 
     @functools.partial(
         jax.shard_map,
@@ -222,7 +247,7 @@ def _pipeline_interleaved(block_fn, stacked_params, x, n_microbatches,
             chunk_params = jax.tree_util.tree_map(lambda a: a[c], params)
             first = (stage == 0) & (c == 0)
             h_in = jnp.where(first, xs[f], h_recv)
-            out = scan_blocks(block_fn, chunk_params, h_in)
+            out = scan_blocks(block_fn, chunk_params, h_in, unroll=1)
             retire = (stage == pp - 1) & (c == v - 1) & (u - stage >= 0) \
                 & (u - stage < units)
             outs = jnp.where(
@@ -382,7 +407,7 @@ def _pipeline_1f1b_impl(block_fn, loss_fn, n_microbatches, axis,
         bwd_perm = [(i + 1, i) for i in range(pp - 1)]
 
         def stage_full(p, tl, h, ymb):
-            out = scan_blocks(block_fn, p, h)
+            out = scan_blocks(block_fn, p, h, unroll=1)
             loss = jax.lax.cond(
                 is_last,
                 lambda: loss_fn(tl, out, ymb).astype(jnp.float32),
