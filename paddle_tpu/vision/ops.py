@@ -16,7 +16,10 @@ from ..core.dispatch import apply
 from ..nn.layer import Layer as _Layer
 
 __all__ = ["nms", "box_iou", "roi_align", "roi_pool", "box_coder",
-           "prior_box", "deform_conv2d", "yolo_loss", "DeformConv2D"]
+           "prior_box", "deform_conv2d", "yolo_loss", "DeformConv2D",
+           "yolo_box", "generate_proposals", "distribute_fpn_proposals",
+           "matrix_nms", "psroi_pool", "PSRoIPool", "RoIPool", "RoIAlign",
+           "ConvNormActivation", "read_file", "decode_jpeg"]
 
 
 def box_iou(boxes1, boxes2):
@@ -476,3 +479,443 @@ class DeformConv2D(_Layer):
     def forward(self, x, offset, mask=None):
         return deform_conv2d(x, offset, self.weight, bias=self.bias,
                              mask=mask, **self._attrs)
+
+
+# ---------------------------------------------------------------------------
+# YOLO box decoding (reference: vision/ops.py yolo_box / yolo_box_op.h)
+# ---------------------------------------------------------------------------
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, name=None, scale_x_y=1.0, iou_aware=False,
+             iou_aware_factor=0.5):
+    """Decode the raw YOLOv3 head [N, na*(5+class_num), H, W] into boxes and
+    class scores (reference: python/paddle/vision/ops.py:261 yolo_box,
+    yolo_box_op kernels). Pure jnp — one fused elementwise+gather program,
+    no per-cell loops.
+
+    Returns (boxes [N, H*W*na, 4] xyxy in image pixels, scores
+    [N, H*W*na, class_num]); predictions whose objectness confidence is
+    below `conf_thresh` are zeroed, matching the reference contract.
+    """
+    na = len(anchors) // 2
+
+    def fn(xa, img):
+        n, c, h, w = xa.shape
+        if iou_aware:
+            iou_pred = xa[:, :na]            # [N, na, H, W]
+            xa = xa[:, na:]
+        xa = xa.reshape(n, na, 5 + class_num, h, w)
+        grid_x = jnp.arange(w, dtype=jnp.float32)[None, :]
+        grid_y = jnp.arange(h, dtype=jnp.float32)[:, None]
+        anc = jnp.asarray(np.asarray(anchors, np.float32).reshape(na, 2))
+        in_w = float(downsample_ratio * w)
+        in_h = float(downsample_ratio * h)
+        sig = jax.nn.sigmoid
+        # centers: scale_x_y stretches the sigmoid around 0.5 (YOLOv4 trick)
+        cx = (sig(xa[:, :, 0]) * scale_x_y - 0.5 * (scale_x_y - 1) + grid_x) / w
+        cy = (sig(xa[:, :, 1]) * scale_x_y - 0.5 * (scale_x_y - 1) + grid_y) / h
+        bw = jnp.exp(xa[:, :, 2]) * anc[None, :, 0, None, None] / in_w
+        bh = jnp.exp(xa[:, :, 3]) * anc[None, :, 1, None, None] / in_h
+        conf = sig(xa[:, :, 4])
+        if iou_aware:
+            conf = conf ** (1.0 - iou_aware_factor) * \
+                sig(iou_pred) ** iou_aware_factor
+        cls = sig(xa[:, :, 5:]) * conf[:, :, None]          # [N,na,C,H,W]
+        keep = conf >= conf_thresh
+        img_h = img[:, 0].astype(jnp.float32)[:, None, None, None]
+        img_w = img[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (cx - bw / 2) * img_w
+        y1 = (cy - bh / 2) * img_h
+        x2 = (cx + bw / 2) * img_w
+        y2 = (cy + bh / 2) * img_h
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, img_w - 1)
+            y1 = jnp.clip(y1, 0, img_h - 1)
+            x2 = jnp.clip(x2, 0, img_w - 1)
+            y2 = jnp.clip(y2, 0, img_h - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], -1) * keep[..., None]
+        scores = cls * keep[:, :, None]
+        # [N, na, H, W, 4] -> [N, na*H*W, 4]; scores -> [N, na*H*W, class_num]
+        boxes = boxes.reshape(n, na * h * w, 4)
+        scores = jnp.moveaxis(scores, 2, -1).reshape(n, na * h * w, class_num)
+        return boxes, scores
+
+    return apply(fn, x, img_size, name="yolo_box")
+
+
+# ---------------------------------------------------------------------------
+# Proposal-stage ops (reference: vision/ops.py generate_proposals /
+# distribute_fpn_proposals / matrix_nms — CUDA ops generate_proposals_v2_op,
+# distribute_fpn_proposals_op, matrix_nms_op)
+# ---------------------------------------------------------------------------
+
+def _np_nms(boxes, scores, thresh):
+    order = np.argsort(-scores)
+    areas = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+    keep, suppressed = [], np.zeros(len(boxes), bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        xx1 = np.maximum(boxes[i, 0], boxes[:, 0])
+        yy1 = np.maximum(boxes[i, 1], boxes[:, 1])
+        xx2 = np.minimum(boxes[i, 2], boxes[:, 2])
+        yy2 = np.minimum(boxes[i, 3], boxes[:, 3])
+        inter = np.clip(xx2 - xx1, 0, None) * np.clip(yy2 - yy1, 0, None)
+        iou = inter / (areas[i] + areas - inter + 1e-10)
+        suppressed |= iou > thresh
+        suppressed[i] = True
+    return np.asarray(keep, np.int64)
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False, name=None):
+    """RPN proposal generation (reference: vision/ops.py:2020
+    generate_proposals → generate_proposals_v2 op). Host-side: the output
+    roster is dynamically sized and NMS is order-sequential, exactly like
+    the reference CPU/CUDA op's host-visible contract.
+
+    scores [N, A, H, W], bbox_deltas [N, 4A, H, W], anchors/variances
+    [H, W, A, 4] (or [H*W*A, 4]). Returns (rpn_rois [R,4], rpn_roi_probs
+    [R,1]) plus rois_num per image when return_rois_num=True.
+    """
+    sc = np.asarray(scores._data if isinstance(scores, Tensor) else scores)
+    dl = np.asarray(bbox_deltas._data if isinstance(bbox_deltas, Tensor)
+                    else bbox_deltas)
+    im = np.asarray(img_size._data if isinstance(img_size, Tensor) else img_size)
+    an = np.asarray(anchors._data if isinstance(anchors, Tensor)
+                    else anchors).reshape(-1, 4)
+    va = np.asarray(variances._data if isinstance(variances, Tensor)
+                    else variances).reshape(-1, 4)
+    n, a, h, w = sc.shape
+    off = 1.0 if pixel_offset else 0.0
+    all_rois, all_probs, rois_num = [], [], []
+    for i in range(n):
+        s = sc[i].transpose(1, 2, 0).reshape(-1)            # [H*W*A]
+        d = dl[i].reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, anc, var = s[order], d[order], an[order], va[order]
+        aw = anc[:, 2] - anc[:, 0] + off
+        ah = anc[:, 3] - anc[:, 1] + off
+        acx = anc[:, 0] + aw / 2
+        acy = anc[:, 1] + ah / 2
+        cx = var[:, 0] * d[:, 0] * aw + acx
+        cy = var[:, 1] * d[:, 1] * ah + acy
+        bw = np.exp(np.minimum(var[:, 2] * d[:, 2], 10.0)) * aw
+        bh = np.exp(np.minimum(var[:, 3] * d[:, 3], 10.0)) * ah
+        boxes = np.stack([cx - bw / 2, cy - bh / 2,
+                          cx + bw / 2 - off, cy + bh / 2 - off], 1)
+        ih, iw = im[i, 0], im[i, 1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, iw - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih - off)
+        # FilterBoxes parity (bbox_util.h:199): min_size clamps to >= 1,
+        # and with pixel_offset the box center must lie inside the image
+        msz = max(min_size, 1.0)
+        ws = boxes[:, 2] - boxes[:, 0] + off
+        hs = boxes[:, 3] - boxes[:, 1] + off
+        ok = (ws >= msz) & (hs >= msz)
+        if pixel_offset:
+            ok &= ((boxes[:, 0] + ws / 2 <= iw) &
+                   (boxes[:, 1] + hs / 2 <= ih))
+        boxes, s = boxes[ok], s[ok]
+        if eta < 1.0:
+            # adaptive NMS (reference generate_proposals eta): re-run with a
+            # decaying threshold while it stays above 0.5
+            keep, thresh = [], nms_thresh
+            cand_b, cand_s = boxes, s
+            remaining = np.arange(len(cand_b))
+            while len(keep) < post_nms_top_n and len(remaining):
+                kp = _np_nms(cand_b[remaining], cand_s[remaining], thresh)
+                keep.extend(remaining[kp])
+                kept = set(remaining[kp])
+                remaining = np.asarray([r for r in remaining if r not in kept],
+                                       np.int64)
+                if thresh * eta <= 0.5:
+                    break
+                thresh *= eta
+            keep = np.asarray(keep[:post_nms_top_n], np.int64)
+        else:
+            keep = _np_nms(boxes, s, nms_thresh)[:post_nms_top_n]
+        all_rois.append(boxes[keep])
+        all_probs.append(s[keep, None])
+        rois_num.append(len(keep))
+    rois = Tensor(jnp.asarray(np.concatenate(all_rois, 0), jnp.float32))
+    probs = Tensor(jnp.asarray(np.concatenate(all_probs, 0), jnp.float32))
+    if return_rois_num:
+        return rois, probs, Tensor(jnp.asarray(rois_num, jnp.int32))
+    return rois, probs
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """Assign RoIs to FPN levels by scale (reference: vision/ops.py:1150,
+    distribute_fpn_proposals_op). level = floor(refer_level +
+    log2(sqrt(area) / refer_scale)), clipped to [min_level, max_level].
+
+    Returns (multi_rois list low→high level, restore_ind [R,1]) and, when
+    rois_num is given, the per-level per-image roi counts.
+    """
+    r = np.asarray(fpn_rois._data if isinstance(fpn_rois, Tensor) else fpn_rois)
+    off = 1.0 if pixel_offset else 0.0
+    scale = np.sqrt(np.clip((r[:, 2] - r[:, 0] + off) *
+                            (r[:, 3] - r[:, 1] + off), 0, None))
+    lvl = np.floor(refer_level + np.log2(scale / refer_scale + 1e-8))
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    multi_rois, order, nums_per_level = [], [], []
+    if rois_num is not None:
+        bn = np.asarray(rois_num._data if isinstance(rois_num, Tensor)
+                        else rois_num)
+        img_of = np.repeat(np.arange(len(bn)), bn)
+    for l in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == l)[0]
+        multi_rois.append(Tensor(jnp.asarray(r[idx], jnp.float32)))
+        order.append(idx)
+        if rois_num is not None:
+            nums_per_level.append(Tensor(jnp.asarray(
+                np.bincount(img_of[idx], minlength=len(bn)), jnp.int32)))
+    order = np.concatenate(order) if order else np.zeros(0, np.int64)
+    restore = np.empty_like(order)
+    restore[order] = np.arange(len(order))
+    restore_ind = Tensor(jnp.asarray(restore[:, None], jnp.int32))
+    if rois_num is not None:
+        return multi_rois, restore_ind, nums_per_level
+    return multi_rois, restore_ind
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (reference: vision/ops.py:2187, matrix_nms_op — SOLOv2).
+    Decay is computed from the full pairwise IoU matrix in one shot — the
+    parallel-friendly NMS variant (no sequential suppression), matching the
+    reference kernel's min-over-higher-scored formulation.
+
+    bboxes [N, M, 4], scores [N, C, M]. Returns Out [R, 6]
+    (label, score, x1, y1, x2, y2) + optional index and per-image counts.
+    """
+    bb = np.asarray(bboxes._data if isinstance(bboxes, Tensor) else bboxes)
+    sc = np.asarray(scores._data if isinstance(scores, Tensor) else scores)
+    n, c, m = sc.shape
+    outs, inds, nums = [], [], []
+    for i in range(n):
+        per_img = []
+        for cls in range(c):
+            if cls == background_label:
+                continue
+            s = sc[i, cls]
+            sel = np.nonzero(s > score_threshold)[0]
+            if len(sel) == 0:
+                continue
+            order = sel[np.argsort(-s[sel])][:nms_top_k]
+            b, s2 = bb[i][order], s[order]
+            noff = 0.0 if normalized else 1.0     # reference: +1 when pixel coords
+            area = (b[:, 2] - b[:, 0] + noff) * (b[:, 3] - b[:, 1] + noff)
+            lt = np.maximum(b[:, None, :2], b[None, :, :2])
+            rb = np.minimum(b[:, None, 2:], b[None, :, 2:])
+            wh = np.clip(rb - lt + noff, 0, None)
+            inter = wh[..., 0] * wh[..., 1]
+            iou = inter / (area[:, None] + area[None, :] - inter + 1e-10)
+            iou = np.triu(iou, 1)                  # iou[j, k], j higher-scored
+            comp = iou.max(0)                      # comp[j]: j's own worst overlap
+            # decay[j, k] = f(iou_jk) / f(comp_j): how much suppressor j
+            # (discounted by its own compensation) decays k (SOLOv2 eq. 4)
+            if use_gaussian:
+                # reference oracle: exp((comp^2 - iou^2) * sigma)
+                decay = np.exp((comp[:, None] ** 2 - iou ** 2) * gaussian_sigma)
+            else:
+                decay = (1 - iou) / (1 - comp[:, None] + 1e-10)
+            decay = np.where(np.triu(np.ones_like(iou), 1) > 0, decay, np.inf)
+            decay = decay.min(0)
+            decay = np.where(np.isinf(decay), 1.0, decay)
+            s3 = s2 * decay
+            ok = s3 > post_threshold
+            for j in np.nonzero(ok)[0]:
+                per_img.append((cls, s3[j], *b[j], order[j] + i * m))
+        per_img.sort(key=lambda t: -t[1])
+        if keep_top_k > 0:
+            per_img = per_img[:keep_top_k]
+        nums.append(len(per_img))
+        for t in per_img:
+            outs.append(t[:6])
+            inds.append(t[6])
+    out = Tensor(jnp.asarray(np.asarray(outs, np.float32).reshape(-1, 6)))
+    res = [out]
+    if return_index:
+        res.append(Tensor(jnp.asarray(np.asarray(inds, np.int64)[:, None])))
+    if return_rois_num:
+        res.append(Tensor(jnp.asarray(nums, jnp.int32)))
+    return tuple(res) if len(res) > 1 else out
+
+
+# ---------------------------------------------------------------------------
+# Position-sensitive RoI pooling + layer wrappers + image IO
+# (reference: vision/ops.py psroi_pool:1383, RoIPool:1578, RoIAlign:1745,
+#  ConvNormActivation:1793, read_file:1288, decode_jpeg:1333)
+# ---------------------------------------------------------------------------
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Position-sensitive RoI average pooling (R-FCN; reference
+    psroi_pool_op). Input channels C must equal out_c * oh * ow; output
+    channel (co, i, j) averages input channel co*oh*ow + i*ow + j over the
+    (i, j) bin of each RoI.
+
+    TPU-native formulation: a 2-D summed-area table (cumsum twice) turns
+    every bin average into 4 gathers — no dynamic-extent slicing, static
+    shapes [R, out_c, oh, ow] for XLA.
+    """
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    bn = np.asarray(boxes_num._data if isinstance(boxes_num, Tensor)
+                    else boxes_num)
+    batch_idx = jnp.asarray(np.repeat(np.arange(len(bn)), bn), jnp.int32)
+
+    def fn(xa, bx):
+        n, c, hh, ww = xa.shape
+        out_c = c // (oh * ow)
+        assert out_c * oh * ow == c, (
+            f"psroi_pool needs channels divisible by {oh}*{ow}, got {c}")
+        # summed-area table with a leading zero row/col: sat[., y, x] =
+        # sum of xa[., :y, :x]
+        sat = jnp.cumsum(jnp.cumsum(xa, axis=2), axis=3)
+        sat = jnp.pad(sat, ((0, 0), (0, 0), (1, 0), (1, 0)))
+
+        def one_roi(b, img_i):
+            x1, y1, x2, y2 = b * spatial_scale
+            rw = jnp.maximum(x2 - x1, 0.1)
+            rh = jnp.maximum(y2 - y1, 0.1)
+            bw, bh = rw / ow, rh / oh
+            ii = jnp.arange(oh, dtype=jnp.float32)
+            jj = jnp.arange(ow, dtype=jnp.float32)
+            hs = jnp.clip(jnp.floor(y1 + ii * bh), 0, hh).astype(jnp.int32)
+            he = jnp.clip(jnp.ceil(y1 + (ii + 1) * bh), 0, hh).astype(jnp.int32)
+            ws = jnp.clip(jnp.floor(x1 + jj * bw), 0, ww).astype(jnp.int32)
+            we = jnp.clip(jnp.ceil(x1 + (jj + 1) * bw), 0, ww).astype(jnp.int32)
+            feat = sat[img_i]                       # [C, H+1, W+1]
+            # position-sensitive channel for (co, i, j)
+            co = jnp.arange(out_c)[:, None, None]
+            ci = (co * oh * ow + ii.astype(jnp.int32)[None, :, None] * ow
+                  + jj.astype(jnp.int32)[None, None, :])   # [out_c, oh, ow]
+            hs_, he_ = hs[None, :, None], he[None, :, None]
+            ws_, we_ = ws[None, None, :], we[None, None, :]
+            ssum = (feat[ci, he_, we_] - feat[ci, hs_, we_]
+                    - feat[ci, he_, ws_] + feat[ci, hs_, ws_])
+            cnt = jnp.maximum((he_ - hs_) * (we_ - ws_), 1)
+            empty = (he_ <= hs_) | (we_ <= ws_)
+            return jnp.where(empty, 0.0, ssum / cnt)
+
+        return jax.vmap(one_roi)(bx, batch_idx)
+
+    return apply(fn, x, boxes, name="psroi_pool")
+
+
+class PSRoIPool(_Layer):
+    """Layer form of psroi_pool (reference vision/ops.py:1456)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+class RoIPool(_Layer):
+    """Layer form of roi_pool (reference vision/ops.py:1578)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+class RoIAlign(_Layer):
+    """Layer form of roi_align (reference vision/ops.py:1745)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale, aligned=aligned)
+
+
+def _conv_norm_activation():
+    """Deferred import body for ConvNormActivation (avoids importing nn at
+    module import time — vision.ops loads before nn in __init__)."""
+    from ..nn import Conv2D, BatchNorm2D, ReLU, Sequential
+
+    class ConvNormActivation(Sequential):
+        """Conv2D + norm + activation block (reference vision/ops.py:1793;
+        torchvision-style). norm_layer/activation_layer are classes, not
+        instances; None skips the slot."""
+
+        def __init__(self, in_channels, out_channels, kernel_size=3, stride=1,
+                     padding=None, groups=1, norm_layer=BatchNorm2D,
+                     activation_layer=ReLU, dilation=1, bias=None):
+            if padding is None:
+                padding = (kernel_size - 1) // 2 * dilation
+            if bias is None:
+                bias = norm_layer is None
+            layers = [Conv2D(in_channels, out_channels, kernel_size, stride,
+                             padding, dilation=dilation, groups=groups,
+                             bias_attr=None if bias else False)]
+            if norm_layer is not None:
+                layers.append(norm_layer(out_channels))
+            if activation_layer is not None:
+                layers.append(activation_layer())
+            super().__init__(*layers)
+
+    return ConvNormActivation
+
+
+def __getattr__(name):
+    if name == "ConvNormActivation":
+        cls = _conv_norm_activation()
+        globals()["ConvNormActivation"] = cls
+        return cls
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def read_file(filename, name=None):
+    """Read a file's bytes as a 1-D uint8 Tensor (reference
+    vision/ops.py:1288 read_file — host-side IO, no device involvement)."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    return Tensor(jnp.asarray(np.frombuffer(data, np.uint8)))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode JPEG bytes (1-D uint8 Tensor) to a CHW uint8 image tensor
+    (reference vision/ops.py:1333 decode_jpeg — host-side; the reference
+    uses nvjpeg on GPU, here PIL decodes on host and the array moves to
+    device like any other input)."""
+    import io as _io
+
+    from PIL import Image
+
+    raw = bytes(np.asarray(x._data if isinstance(x, Tensor) else x,
+                           np.uint8).tobytes())
+    img = Image.open(_io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img, np.uint8)
+    if arr.ndim == 2:
+        arr = arr[None]                       # [1, H, W]
+    else:
+        arr = arr.transpose(2, 0, 1)          # [C, H, W]
+    return Tensor(jnp.asarray(arr))

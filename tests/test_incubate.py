@@ -65,6 +65,7 @@ def test_excluded_layers():
     asp._masks.clear()
 
 
+@pytest.mark.slow
 def test_fused_multi_transformer_trains():
     paddle.seed(2)
     m = FusedMultiTransformer(32, 4, 64, num_layers=2)
@@ -164,6 +165,7 @@ def test_fused_multi_transformer_int8_parity():
         assert sd[wkey].shape == fmt.state_dict()[wkey].shape
 
 
+@pytest.mark.slow
 def test_fused_multi_transformer_int8_cache_decode():
     from paddle_tpu.incubate.nn import (
         FusedMultiTransformer, FusedMultiTransformerInt8)
@@ -252,6 +254,7 @@ def test_fused_ec_moe_matches_reference_algorithm():
     np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.slow
 def test_fused_ec_moe_layer_trains():
     from paddle_tpu.incubate.nn import FusedEcMoe
 

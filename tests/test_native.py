@@ -67,6 +67,7 @@ def _store_worker(port, rank, results_q):
 
 
 @pytest.mark.skipif(not NATIVE, reason="needs native lib")
+@pytest.mark.slow
 def test_store_multiprocess_rendezvous():
     """3 processes rendezvous: unique ranks + barrier + peer discovery."""
     port = _free_port()

@@ -41,6 +41,7 @@ def test_quantize_dequantize_roundtrip():
     np.testing.assert_allclose(dq.numpy(), x.numpy(), atol=0.9 / 127 + 1e-6)
 
 
+@pytest.mark.slow
 def test_qat_quantize_swaps_and_trains():
     paddle.seed(0)
     model = _net()

@@ -80,6 +80,7 @@ def test_engine_tune_entry():
     assert plans and all(p.feasible for p in plans)
 
 
+@pytest.mark.slow
 def test_measured_refinement_runs_on_virtual_mesh():
     t = _tuner(gpt_test_config(), batch=16, n_devices=8, hbm_bytes=64e9)
     plans = t.tune(top_k=2, measure=True)

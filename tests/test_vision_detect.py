@@ -145,6 +145,7 @@ def test_yolo_loss_empty_gt_only_objectness():
     np.testing.assert_allclose(loss.numpy(), want, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_yolo_loss_trains():
     """Gradient steps on the head must reduce the loss (end-to-end sanity
     in place of a CUDA-kernel oracle)."""
@@ -195,6 +196,7 @@ def test_yolo_loss_zero_length_gt_dim():
     np.testing.assert_allclose(loss.numpy(), want, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_yolo_loss_mixup_objectness_targets_one():
     """gt_score weights the positive objectness term; the target stays 1.0
     (minimizing with score=0.5 still drives the logit UP, review finding)."""

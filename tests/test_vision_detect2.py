@@ -1,0 +1,251 @@
+"""Detection long-tail ops: yolo_box, generate_proposals,
+distribute_fpn_proposals, matrix_nms, psroi_pool, layer wrappers, image IO.
+
+Reference test model: unittests/test_yolo_box_op.py,
+test_generate_proposals_v2_op.py, test_distribute_fpn_proposals_op.py,
+test_matrix_nms_op.py, test_psroi_pool_op.py — numpy oracles on small
+shapes.
+"""
+import io
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops
+
+
+def _rs(seed=0):
+    return np.random.RandomState(seed)
+
+
+def test_yolo_box_matches_numpy_oracle():
+    rs = _rs(1)
+    n, na, cls, h, w = 2, 2, 3, 4, 4
+    anchors = [10, 13, 16, 30]
+    down = 32
+    x = rs.randn(n, na * (5 + cls), h, w).astype("float32")
+    img = np.array([[128, 160], [256, 256]], np.int32)
+    boxes, scores = ops.yolo_box(
+        paddle.to_tensor(x), paddle.to_tensor(img), anchors, cls,
+        conf_thresh=0.01, downsample_ratio=down)
+    assert boxes.shape == (n, na * h * w, 4)
+    assert scores.shape == (n, na * h * w, cls)
+
+    # numpy oracle for one cell
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+
+    xa = x.reshape(n, na, 5 + cls, h, w)
+    i, a, gy, gx = 1, 1, 2, 3
+    cx = (sig(xa[i, a, 0, gy, gx]) + gx) / w * img[i, 1]
+    cy = (sig(xa[i, a, 1, gy, gx]) + gy) / h * img[i, 0]
+    bw = np.exp(xa[i, a, 2, gy, gx]) * anchors[2] / (down * w) * img[i, 1]
+    bh = np.exp(xa[i, a, 3, gy, gx]) * anchors[3] / (down * h) * img[i, 0]
+    conf = sig(xa[i, a, 4, gy, gx])
+    exp = np.array([
+        max(cx - bw / 2, 0), max(cy - bh / 2, 0),
+        min(cx + bw / 2, img[i, 1] - 1), min(cy + bh / 2, img[i, 0] - 1)])
+    if conf < 0.01:
+        exp = exp * 0
+    got = boxes.numpy()[i, a * h * w + gy * w + gx]
+    np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-4)
+    exp_s = sig(xa[i, a, 5:, gy, gx]) * conf * (conf >= 0.01)
+    np.testing.assert_allclose(
+        scores.numpy()[i, a * h * w + gy * w + gx], exp_s, rtol=1e-4, atol=1e-5)
+
+
+def test_yolo_box_conf_thresh_zeroes():
+    rs = _rs(2)
+    x = rs.randn(1, 2 * 6, 2, 2).astype("float32")
+    img = np.array([[64, 64]], np.int32)
+    boxes, scores = ops.yolo_box(
+        paddle.to_tensor(x), paddle.to_tensor(img), [8, 8, 16, 16], 1,
+        conf_thresh=0.999, downsample_ratio=32)
+    assert np.allclose(boxes.numpy(), 0)
+    assert np.allclose(scores.numpy(), 0)
+
+
+def test_generate_proposals_shapes_and_ordering():
+    rs = _rs(3)
+    n, a, h, w = 2, 3, 4, 4
+    scores = rs.rand(n, a, h, w).astype("float32")
+    deltas = (rs.randn(n, 4 * a, h, w) * 0.1).astype("float32")
+    anchors = np.zeros((h, w, a, 4), np.float32)
+    for gy in range(h):
+        for gx in range(w):
+            for k in range(a):
+                sz = 8 * (k + 1)
+                anchors[gy, gx, k] = [gx * 8, gy * 8, gx * 8 + sz, gy * 8 + sz]
+    var = np.ones((h, w, a, 4), np.float32)
+    img = np.array([[64, 64], [64, 64]], np.float32)
+    rois, probs, num = ops.generate_proposals(
+        paddle.to_tensor(scores), paddle.to_tensor(deltas),
+        paddle.to_tensor(img), paddle.to_tensor(anchors),
+        paddle.to_tensor(var), pre_nms_top_n=20, post_nms_top_n=5,
+        nms_thresh=0.7, min_size=1.0, return_rois_num=True)
+    counts = num.numpy()
+    assert rois.shape[0] == counts.sum() and rois.shape[1] == 4
+    assert probs.shape == (counts.sum(), 1)
+    assert (counts <= 5).all() and (counts > 0).all()
+    r = rois.numpy()
+    assert (r[:, 0] >= 0).all() and (r[:, 2] <= 64).all()
+    assert (r[:, 2] >= r[:, 0]).all() and (r[:, 3] >= r[:, 1]).all()
+    # per-image probs sorted descending (NMS keeps score order)
+    p = probs.numpy().ravel()
+    c0 = counts[0]
+    assert (np.diff(p[:c0]) <= 1e-6).all()
+    assert (np.diff(p[c0:]) <= 1e-6).all()
+
+
+def test_distribute_fpn_proposals_levels_and_restore():
+    rois = np.array([
+        [0, 0, 10, 10],      # area 100  -> low level
+        [0, 0, 224, 224],    # refer scale -> refer level
+        [0, 0, 500, 500],    # big -> high level
+        [0, 0, 30, 30],
+    ], np.float32)
+    multi, restore = ops.distribute_fpn_proposals(
+        paddle.to_tensor(rois), 2, 5, 4, 224)
+    assert len(multi) == 4
+    total = sum(m.shape[0] for m in multi)
+    assert total == 4
+    # restore index maps concat(multi) rows back to original order
+    cat = np.concatenate([m.numpy() for m in multi], 0)
+    ri = restore.numpy().ravel()
+    np.testing.assert_allclose(cat[ri], rois)
+    # the 224-box sits at refer level 4 (index 2), the 500-box at level 5
+    assert any((m.numpy() == rois[1]).all(1).any() for m in multi[2:3])
+    assert any((m.numpy() == rois[2]).all(1).any() for m in multi[3:4])
+
+    # with rois_num: per-level per-image counts
+    multi, restore, nums = ops.distribute_fpn_proposals(
+        paddle.to_tensor(rois), 2, 5, 4, 224,
+        rois_num=paddle.to_tensor(np.array([2, 2], np.int32)))
+    assert sum(int(v.numpy().sum()) for v in nums) == 4
+
+
+def test_matrix_nms_suppresses_duplicates():
+    # two near-identical high-score boxes + one distinct
+    boxes = np.array([[[0, 0, 10, 10], [0, 0, 10, 9.5], [20, 20, 30, 30]]],
+                     np.float32)
+    scores = np.array([[[0.9, 0.85, 0.6],     # class 1 (0 is background)
+                        [0.0, 0.0, 0.0]]], np.float32)
+    scores = np.concatenate([np.zeros_like(scores[:, :1]), scores], 1)
+    out, idx, num = ops.matrix_nms(
+        paddle.to_tensor(boxes), paddle.to_tensor(scores),
+        score_threshold=0.1, post_threshold=0.3, nms_top_k=10, keep_top_k=10,
+        return_index=True)
+    o = out.numpy()
+    assert int(num.numpy()[0]) == o.shape[0]
+    assert o.shape[1] == 6
+    # top box survives untouched; duplicate decays below its raw score
+    assert np.isclose(o[0, 1], 0.9, atol=1e-5)
+    dup_rows = o[np.isclose(o[:, 2:], [0, 0, 10, 9.5], atol=1e-4).all(1)]
+    if len(dup_rows):
+        assert dup_rows[0, 1] < 0.85 * 0.7
+    else:
+        # near-duplicate decayed below post_threshold entirely
+        assert int(num.numpy()[0]) == 2
+    # distinct box not suppressed
+    assert (np.isclose(o[:, 2:], [20, 20, 30, 30], atol=1e-4).all(1)).any()
+
+
+def test_matrix_nms_gaussian_keeps_more_score():
+    boxes = np.array([[[0, 0, 10, 10], [0, 0, 10, 9.0]]], np.float32)
+    sc = np.array([[[0, 0], [0.9, 0.8]]], np.float32)
+    o_lin, _ = ops.matrix_nms(paddle.to_tensor(boxes), paddle.to_tensor(sc),
+                              0.1, 0.0, 10, 10, background_label=0)
+    o_g, _ = ops.matrix_nms(paddle.to_tensor(boxes), paddle.to_tensor(sc),
+                            0.1, 0.0, 10, 10, use_gaussian=True,
+                            gaussian_sigma=2.0, background_label=0)
+    assert o_lin.shape[0] == o_g.shape[0] == 2
+
+
+def test_psroi_pool_uniform_input_averages_exactly():
+    oh = ow = 2
+    out_c = 3
+    c = out_c * oh * ow
+    # constant per-channel value: every bin average equals that value
+    x = np.arange(c, dtype=np.float32)[None, :, None, None] * np.ones(
+        (1, c, 8, 8), np.float32)
+    boxes = np.array([[0, 0, 8, 8]], np.float32)
+    out = ops.psroi_pool(
+        paddle.to_tensor(x), paddle.to_tensor(boxes),
+        paddle.to_tensor(np.array([1], np.int32)), (oh, ow))
+    assert out.shape == (1, out_c, oh, ow)
+    got = out.numpy()
+    for co in range(out_c):
+        for i in range(oh):
+            for j in range(ow):
+                assert np.isclose(got[0, co, i, j], co * oh * ow + i * ow + j)
+
+
+def test_psroi_pool_matches_manual_bin_average():
+    rs = _rs(5)
+    oh = ow = 2
+    x = rs.randn(1, 4, 6, 6).astype("float32")
+    boxes = np.array([[1, 1, 5, 5]], np.float32)
+    out = ops.psroi_pool(
+        paddle.to_tensor(x), paddle.to_tensor(boxes),
+        paddle.to_tensor(np.array([1], np.int32)), (oh, ow))
+    # manual: bin (i,j) covers rows [1+2i, 1+2(i+1)), cols [1+2j, ...)
+    for i in range(oh):
+        for j in range(ow):
+            ci = 0 * oh * ow + i * ow + j
+            ref = x[0, ci, 1 + 2 * i:1 + 2 * (i + 1),
+                    1 + 2 * j:1 + 2 * (j + 1)].mean()
+            np.testing.assert_allclose(out.numpy()[0, 0, i, j], ref,
+                                       rtol=1e-5, atol=1e-5)
+
+
+def test_roi_layer_wrappers_match_functions():
+    rs = _rs(6)
+    x = rs.randn(1, 4, 8, 8).astype("float32")
+    boxes = np.array([[0, 0, 6, 6], [2, 2, 8, 8]], np.float32)
+    bn = np.array([2], np.int32)
+    xt, bt, bnt = (paddle.to_tensor(x), paddle.to_tensor(boxes),
+                   paddle.to_tensor(bn))
+    np.testing.assert_allclose(
+        ops.RoIAlign(3)(xt, bt, bnt).numpy(),
+        ops.roi_align(xt, bt, bnt, 3).numpy())
+    np.testing.assert_allclose(
+        ops.RoIPool(3)(xt, bt, bnt).numpy(),
+        ops.roi_pool(xt, bt, bnt, 3).numpy())
+    x2 = rs.randn(1, 4 * 2 * 2, 8, 8).astype("float32")
+    np.testing.assert_allclose(
+        ops.PSRoIPool(2)(paddle.to_tensor(x2), bt, bnt).numpy(),
+        ops.psroi_pool(paddle.to_tensor(x2), bt, bnt, 2).numpy())
+
+
+def test_conv_norm_activation_block():
+    rs = _rs(7)
+    block = ops.ConvNormActivation(3, 8, kernel_size=3, stride=2)
+    x = paddle.to_tensor(rs.randn(2, 3, 16, 16).astype("float32"))
+    y = block(x)
+    assert y.shape == (2, 8, 8, 8)
+    assert float((y.numpy() >= 0).mean()) == 1.0  # ReLU output
+    # norm_layer=None -> conv gets a bias and no BN
+    b2 = ops.ConvNormActivation(3, 4, norm_layer=None, activation_layer=None)
+    assert b2(x).shape == (2, 4, 16, 16)
+
+
+def test_read_file_and_decode_jpeg_roundtrip(tmp_path):
+    from PIL import Image
+
+    rs = _rs(8)
+    # smooth gradient: JPEG is near-lossless on it (noise is not)
+    gy = np.linspace(0, 255, 10)[:, None]
+    gx = np.linspace(0, 255, 12)[None, :]
+    arr = np.stack([gy + 0 * gx, 0 * gy + gx, (gy + gx) / 2], -1).astype("uint8")
+    p = tmp_path / "img.jpg"
+    Image.fromarray(arr).save(p, quality=95)
+    raw = ops.read_file(str(p))
+    assert raw.dtype == paddle.uint8 and raw.ndim == 1
+    img = ops.decode_jpeg(raw)
+    assert img.shape == (3, 10, 12)
+    # lossy but close
+    assert np.abs(img.numpy().transpose(1, 2, 0).astype(int) -
+                  arr.astype(int)).mean() < 8
+    gray = ops.decode_jpeg(raw, mode="gray")
+    assert gray.shape == (1, 10, 12)
