@@ -85,6 +85,9 @@ from . import text  # noqa: E402
 from . import audio  # noqa: E402
 from . import inference  # noqa: E402
 from . import hub  # noqa: E402
+from . import reader  # noqa: E402
+from . import dataset  # noqa: E402
+from .reader import batch  # noqa: E402
 from . import sysconfig  # noqa: E402
 from . import onnx  # noqa: E402
 from .cost_model import CostModel  # noqa: E402

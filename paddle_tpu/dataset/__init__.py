@@ -1,0 +1,24 @@
+"""Legacy `paddle.dataset` reader-style datasets (reference:
+python/paddle/dataset/ — uci_housing, mnist, imdb, imikolov, cifar,
+movielens, conll05, wmt14/16 as creator functions returning sample
+GENERATORS, consumed through paddle.batch / paddle.reader decorators).
+
+The modern path is paddle.io.Dataset + DataLoader (and the map-style
+classes under vision.datasets / text.datasets); this module keeps the
+legacy reader-function surface alive so reference scripts like
+
+    train_reader = paddle.batch(
+        paddle.reader.shuffle(paddle.dataset.uci_housing.train(), 500),
+        batch_size=32)
+
+run unchanged. Zero-egress environment: every creator yields a
+deterministic synthetic sample stream with the reference's schema (the
+map-style dataset classes these wrap carry a `.synthetic` flag).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import uci_housing, mnist, imdb, imikolov, cifar, movielens  # noqa: F401
+
+__all__ = ["uci_housing", "mnist", "imdb", "imikolov", "cifar", "movielens"]

@@ -1,0 +1,37 @@
+"""paddle.dataset.cifar (reference: python/paddle/dataset/cifar.py —
+train10/test10/train100/test100 yielding (image[3072] float32, label))."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..vision.datasets import Cifar10 as _Cifar10, Cifar100 as _Cifar100
+
+
+def _reader(cls, mode):
+    ds = cls(mode=mode)
+
+    def rd():
+        for i in range(len(ds)):
+            img, label = ds[i]
+            img = np.asarray(img, np.float32).reshape(-1)
+            if img.max() > 1.0:
+                img = img / 255.0
+            yield img, int(np.asarray(label).ravel()[0])
+
+    return rd
+
+
+def train10():
+    return _reader(_Cifar10, "train")
+
+
+def test10():
+    return _reader(_Cifar10, "test")
+
+
+def train100():
+    return _reader(_Cifar100, "train")
+
+
+def test100():
+    return _reader(_Cifar100, "test")

@@ -1,0 +1,30 @@
+"""paddle.dataset.imdb (reference: python/paddle/dataset/imdb.py —
+word_dict() + train(word_dict)/test(word_dict) yielding (ids, label))."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..text.datasets import Imdb as _Imdb
+
+
+def word_dict():
+    return _Imdb(mode="train").word_idx
+
+
+def _reader(mode, w=None):
+    ds = _Imdb(mode=mode)
+
+    def rd():
+        for i in range(len(ds)):
+            ids, label = ds[i]
+            yield np.asarray(ids, np.int64), int(label)
+
+    return rd
+
+
+def train(word_idx=None):
+    return _reader("train", word_idx)
+
+
+def test(word_idx=None):
+    return _reader("test", word_idx)

@@ -1,0 +1,30 @@
+"""paddle.dataset.imikolov (reference: python/paddle/dataset/imikolov.py —
+n-gram LM tuples)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..text.datasets import Imikolov as _Imikolov
+
+
+def build_dict(min_word_freq=50):
+    ds = _Imikolov(mode="train")
+    return getattr(ds, "word_idx", {f"w{i}": i for i in range(2000)})
+
+
+def _reader(mode, n):
+    ds = _Imikolov(mode=mode, window_size=n)
+
+    def rd():
+        for i in range(len(ds)):
+            yield tuple(int(v) for v in np.asarray(ds[i]).ravel())
+
+    return rd
+
+
+def train(word_idx=None, n=5):
+    return _reader("train", n)
+
+
+def test(word_idx=None, n=5):
+    return _reader("test", n)

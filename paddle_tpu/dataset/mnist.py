@@ -1,0 +1,30 @@
+"""paddle.dataset.mnist (reference: python/paddle/dataset/mnist.py —
+train()/test() yielding (image[784] float32 in [-1, 1], label int))."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..vision.datasets import MNIST as _MNIST
+
+
+def _reader(mode):
+    ds = _MNIST(mode=mode)
+
+    def rd():
+        for i in range(len(ds)):
+            img, label = ds[i]
+            img = np.asarray(img, np.float32).reshape(-1)
+            # reference normalizes to [-1, 1]
+            if img.max() > 1.0:
+                img = img / 127.5 - 1.0
+            yield img, int(np.asarray(label).ravel()[0])
+
+    return rd
+
+
+def train():
+    return _reader("train")
+
+
+def test():
+    return _reader("test")

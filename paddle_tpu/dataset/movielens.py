@@ -1,0 +1,34 @@
+"""paddle.dataset.movielens (reference: python/paddle/dataset/movielens.py —
+rating tuples for recommender examples)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..text.datasets import Movielens as _Movielens
+
+
+def _reader(mode):
+    ds = _Movielens(mode=mode)
+
+    def rd():
+        for i in range(len(ds)):
+            yield tuple(np.asarray(v).ravel()[0] if np.asarray(v).size == 1
+                        else np.asarray(v) for v in ds[i])
+
+    return rd
+
+
+def train():
+    return _reader("train")
+
+
+def test():
+    return _reader("test")
+
+
+def max_user_id():
+    return getattr(_Movielens(mode="train"), "max_user_id", 944)
+
+
+def max_movie_id():
+    return getattr(_Movielens(mode="train"), "max_movie_id", 1683)

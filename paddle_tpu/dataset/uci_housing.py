@@ -1,0 +1,31 @@
+"""paddle.dataset.uci_housing (reference: python/paddle/dataset/uci_housing.py
+train()/test() reader creators yielding (feature[13] float32, price[1]))."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..text.datasets import UCIHousing as _UCIHousing
+
+feature_names = [
+    "CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE", "DIS", "RAD", "TAX",
+    "PTRATIO", "B", "LSTAT",
+]
+
+
+def _reader(mode):
+    ds = _UCIHousing(mode=mode)
+
+    def rd():
+        for i in range(len(ds)):
+            x, y = ds[i]
+            yield np.asarray(x, np.float32), np.asarray(y, np.float32)
+
+    return rd
+
+
+def train():
+    return _reader("train")
+
+
+def test():
+    return _reader("test")

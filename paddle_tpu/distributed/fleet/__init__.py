@@ -15,6 +15,10 @@ from .fleet_api import (
 )
 from . import utils
 from . import elastic
+from . import meta_optimizers
+from .meta_optimizers import (
+    GradientMergeOptimizer, LocalSGDOptimizer, DGCMomentumOptimizer,
+)
 from .elastic import ElasticManager, ElasticStatus
 from .meta_parallel import (
     TensorParallel, PipelineParallel, ShardingParallel, PipelineLayer, LayerDesc,

@@ -63,7 +63,12 @@ def distributed_model(model):
 
 
 def distributed_optimizer(optimizer, strategy=None):
-    return HybridParallelOptimizer(optimizer, _fleet_state["hcg"], strategy or _strategy())
+    strategy = strategy or _strategy()
+    # meta-optimizer composition off strategy flags (StrategyCompiler analog)
+    from .meta_optimizers import apply_strategy
+
+    optimizer = apply_strategy(optimizer, strategy)
+    return HybridParallelOptimizer(optimizer, _fleet_state["hcg"], strategy)
 
 
 def worker_index():

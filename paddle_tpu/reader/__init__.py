@@ -1,0 +1,254 @@
+"""Legacy reader decorators (reference: python/paddle/reader/decorator.py —
+cache/shuffle/chain/compose/buffered/firstn/map_readers/xmap_readers/
+multiprocess_reader, plus python/paddle/batch.py `paddle.batch`).
+
+These are host-side generator combinators; nothing device-specific. The
+modern path is paddle.io.DataLoader — this module exists so reference
+training scripts using reader pipelines run unchanged.
+"""
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import random as _random
+import threading
+
+__all__ = ["cache", "map_readers", "shuffle", "chain", "compose", "buffered",
+           "firstn", "xmap_readers", "multiprocess_reader", "batch"]
+
+
+def cache(reader):
+    """Cache the reader's full output in memory on first pass
+    (decorator.py:45)."""
+    all_data = []
+    filled = [False]
+
+    def rd():
+        if not filled[0]:
+            for item in reader():
+                all_data.append(item)
+                yield item
+            filled[0] = True
+        else:
+            yield from all_data
+
+    return rd
+
+
+def map_readers(func, *readers):
+    """Yield func(*items) zipped across readers (decorator.py:85)."""
+
+    def rd():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+
+    return rd
+
+
+def shuffle(reader, buf_size):
+    """Buffered shuffle (decorator.py:127): fill a buf_size window,
+    shuffle it, drain."""
+
+    def rd():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return rd
+
+
+def chain(*readers):
+    """Concatenate readers back to back (decorator.py:176)."""
+
+    def rd():
+        for r in readers:
+            yield from r()
+
+    return rd
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into flattened tuples (decorator.py:241).
+    check_alignment=True (default) raises if lengths mismatch."""
+    check_alignment = kwargs.pop("check_alignment", True)
+    if kwargs:
+        raise TypeError(f"unexpected kwargs {sorted(kwargs)}")
+
+    def _flatten(item):
+        if isinstance(item, tuple):
+            return item
+        return (item,)
+
+    def rd():
+        its = [r() for r in readers]
+        if check_alignment:
+            for items in zip(*its):
+                yield sum((_flatten(i) for i in items), ())
+            for it in its:
+                try:
+                    next(it)
+                except StopIteration:
+                    continue
+                raise ValueError("readers have different lengths "
+                                 "(check_alignment=True)")
+        else:
+            for items in itertools.zip_longest(*its):
+                yield sum((_flatten(i) for i in items if i is not None), ())
+
+    return rd
+
+
+def buffered(reader, size):
+    """Prefetch up to `size` items on a background thread
+    (decorator.py:299)."""
+
+    def rd():
+        q = _queue.Queue(maxsize=size)
+        end = object()
+        err = []
+
+        def fill():
+            try:
+                for item in reader():
+                    q.put(item)
+            except BaseException as e:  # surfaced in the consumer
+                err.append(e)
+            finally:
+                q.put(end)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is end:
+                if err:
+                    raise err[0]
+                return
+            yield item
+
+    return rd
+
+
+def firstn(reader, n):
+    """First n items (decorator.py:361)."""
+
+    def rd():
+        for i, item in enumerate(reader()):
+            if i >= n:
+                return
+            yield item
+
+    return rd
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over a reader with worker THREADS (decorator.py:406 uses
+    threads too — the GIL is released in IO/numpy mappers). order=True
+    preserves input order."""
+
+    def rd():
+        in_q = _queue.Queue(buffer_size)
+        out_q = _queue.Queue(buffer_size)
+        end = object()
+        err = []
+
+        def feed():
+            for i, item in enumerate(reader()):
+                in_q.put((i, item))
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def work():
+            try:
+                while True:
+                    got = in_q.get()
+                    if got is end:
+                        break
+                    i, item = got
+                    out_q.put((i, mapper(item)))
+            except BaseException as e:
+                err.append(e)
+            finally:
+                out_q.put(end)
+
+        threading.Thread(target=feed, daemon=True).start()
+        workers = [threading.Thread(target=work, daemon=True)
+                   for _ in range(process_num)]
+        for w in workers:
+            w.start()
+        done = 0
+        hold = {}
+        want = 0
+        while done < process_num:
+            got = out_q.get()
+            if got is end:
+                done += 1
+                continue
+            i, item = got
+            if not order:
+                yield item
+            else:
+                hold[i] = item
+                while want in hold:
+                    yield hold.pop(want)
+                    want += 1
+        if err:
+            raise err[0]
+        if order:
+            for i in sorted(hold):
+                yield hold[i]
+
+    return rd
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Interleave multiple readers from worker threads (decorator.py:502;
+    fork-based processes don't mix with an initialized XLA runtime, so the
+    TPU build uses threads — same API, same interleaving semantics)."""
+
+    def rd():
+        q = _queue.Queue(queue_size)
+        end = object()
+
+        def run(r):
+            try:
+                for item in r():
+                    q.put(item)
+            finally:
+                q.put(end)
+
+        for r in readers:
+            threading.Thread(target=run, args=(r,), daemon=True).start()
+        done = 0
+        while done < len(readers):
+            item = q.get()
+            if item is end:
+                done += 1
+                continue
+            yield item
+
+    return rd
+
+
+def batch(reader, batch_size, drop_last=False):
+    """paddle.batch (reference python/paddle/batch.py:18): group a sample
+    reader into lists of batch_size samples."""
+
+    def rd():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return rd

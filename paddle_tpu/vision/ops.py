@@ -39,25 +39,7 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None, categories=No
     """Host-side NMS (dynamic output shape — same as reference nms_op CPU)."""
     b = np.asarray(boxes._data)
     s = np.asarray(scores._data) if scores is not None else np.arange(len(b))[::-1].astype(np.float32)
-    order = np.argsort(-s)
-    keep = []
-    suppressed = np.zeros(len(b), bool)
-    areas = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
-    for _i in order:
-        if suppressed[_i]:
-            continue
-        keep.append(_i)
-        xx1 = np.maximum(b[_i, 0], b[:, 0])
-        yy1 = np.maximum(b[_i, 1], b[:, 1])
-        xx2 = np.minimum(b[_i, 2], b[:, 2])
-        yy2 = np.minimum(b[_i, 3], b[:, 3])
-        w = np.clip(xx2 - xx1, 0, None)
-        h = np.clip(yy2 - yy1, 0, None)
-        inter = w * h
-        iou = inter / (areas[_i] + areas - inter + 1e-10)
-        suppressed |= iou > iou_threshold
-        suppressed[_i] = True
-    keep = np.asarray(keep, np.int64)
+    keep = _np_nms(b, s, iou_threshold)
     if top_k is not None:
         keep = keep[:top_k]
     return Tensor(jnp.asarray(keep))
@@ -549,10 +531,16 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
 # distribute_fpn_proposals_op, matrix_nms_op)
 # ---------------------------------------------------------------------------
 
-def _np_nms(boxes, scores, thresh):
+def _np_nms(boxes, scores, thresh, eta=1.0):
+    """Greedy NMS core shared by nms() and generate_proposals(). eta < 1 is
+    the reference's ADAPTIVE mode (locality_aware_nms_op.cc:229 /
+    nms_util.h): after each kept box the threshold decays (thresh *= eta
+    while > 0.5), so suppression gets progressively stricter within the
+    pass — it never re-admits a suppressed box."""
     order = np.argsort(-scores)
     areas = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
     keep, suppressed = [], np.zeros(len(boxes), bool)
+    thresh = float(thresh)
     for i in order:
         if suppressed[i]:
             continue
@@ -565,6 +553,8 @@ def _np_nms(boxes, scores, thresh):
         iou = inter / (areas[i] + areas - inter + 1e-10)
         suppressed |= iou > thresh
         suppressed[i] = True
+        if eta < 1.0 and thresh > 0.5:
+            thresh *= eta
     return np.asarray(keep, np.int64)
 
 
@@ -620,24 +610,7 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
             ok &= ((boxes[:, 0] + ws / 2 <= iw) &
                    (boxes[:, 1] + hs / 2 <= ih))
         boxes, s = boxes[ok], s[ok]
-        if eta < 1.0:
-            # adaptive NMS (reference generate_proposals eta): re-run with a
-            # decaying threshold while it stays above 0.5
-            keep, thresh = [], nms_thresh
-            cand_b, cand_s = boxes, s
-            remaining = np.arange(len(cand_b))
-            while len(keep) < post_nms_top_n and len(remaining):
-                kp = _np_nms(cand_b[remaining], cand_s[remaining], thresh)
-                keep.extend(remaining[kp])
-                kept = set(remaining[kp])
-                remaining = np.asarray([r for r in remaining if r not in kept],
-                                       np.int64)
-                if thresh * eta <= 0.5:
-                    break
-                thresh *= eta
-            keep = np.asarray(keep[:post_nms_top_n], np.int64)
-        else:
-            keep = _np_nms(boxes, s, nms_thresh)[:post_nms_top_n]
+        keep = _np_nms(boxes, s, nms_thresh, eta=eta)[:post_nms_top_n]
         all_rois.append(boxes[keep])
         all_probs.append(s[keep, None])
         rois_num.append(len(keep))
@@ -743,12 +716,13 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
             outs.append(t[:6])
             inds.append(t[6])
     out = Tensor(jnp.asarray(np.asarray(outs, np.float32).reshape(-1, 6)))
-    res = [out]
-    if return_index:
-        res.append(Tensor(jnp.asarray(np.asarray(inds, np.int64)[:, None])))
-    if return_rois_num:
-        res.append(Tensor(jnp.asarray(nums, jnp.int32)))
-    return tuple(res) if len(res) > 1 else out
+    # reference contract (vision/ops.py:2332): ALWAYS (out, rois_num, index)
+    # with None placeholders for the outputs not requested
+    rois_num = (Tensor(jnp.asarray(nums, jnp.int32))
+                if return_rois_num else None)
+    index = (Tensor(jnp.asarray(np.asarray(inds, np.int64)[:, None]))
+             if return_index else None)
+    return out, rois_num, index
 
 
 # ---------------------------------------------------------------------------
@@ -852,41 +826,30 @@ class RoIAlign(_Layer):
                          self.spatial_scale, aligned=aligned)
 
 
-def _conv_norm_activation():
-    """Deferred import body for ConvNormActivation (avoids importing nn at
-    module import time — vision.ops loads before nn in __init__)."""
-    from ..nn import Conv2D, BatchNorm2D, ReLU, Sequential
-
-    class ConvNormActivation(Sequential):
-        """Conv2D + norm + activation block (reference vision/ops.py:1793;
-        torchvision-style). norm_layer/activation_layer are classes, not
-        instances; None skips the slot."""
-
-        def __init__(self, in_channels, out_channels, kernel_size=3, stride=1,
-                     padding=None, groups=1, norm_layer=BatchNorm2D,
-                     activation_layer=ReLU, dilation=1, bias=None):
-            if padding is None:
-                padding = (kernel_size - 1) // 2 * dilation
-            if bias is None:
-                bias = norm_layer is None
-            layers = [Conv2D(in_channels, out_channels, kernel_size, stride,
-                             padding, dilation=dilation, groups=groups,
-                             bias_attr=None if bias else False)]
-            if norm_layer is not None:
-                layers.append(norm_layer(out_channels))
-            if activation_layer is not None:
-                layers.append(activation_layer())
-            super().__init__(*layers)
-
-    return ConvNormActivation
+from ..nn import BatchNorm2D as _BatchNorm2D, Conv2D as _Conv2D, \
+    ReLU as _ReLU, Sequential as _Sequential  # noqa: E402
 
 
-def __getattr__(name):
-    if name == "ConvNormActivation":
-        cls = _conv_norm_activation()
-        globals()["ConvNormActivation"] = cls
-        return cls
-    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+class ConvNormActivation(_Sequential):
+    """Conv2D + norm + activation block (reference vision/ops.py:1793;
+    torchvision-style). norm_layer/activation_layer are classes, not
+    instances; None skips the slot."""
+
+    def __init__(self, in_channels, out_channels, kernel_size=3, stride=1,
+                 padding=None, groups=1, norm_layer=_BatchNorm2D,
+                 activation_layer=_ReLU, dilation=1, bias=None):
+        if padding is None:
+            padding = (kernel_size - 1) // 2 * dilation
+        if bias is None:
+            bias = norm_layer is None
+        layers = [_Conv2D(in_channels, out_channels, kernel_size, stride,
+                          padding, dilation=dilation, groups=groups,
+                          bias_attr=None if bias else False)]
+        if norm_layer is not None:
+            layers.append(norm_layer(out_channels))
+        if activation_layer is not None:
+            layers.append(activation_layer())
+        super().__init__(*layers)
 
 
 def read_file(filename, name=None):

@@ -1,0 +1,172 @@
+"""Fleet meta-optimizers: gradient merge / LocalSGD / DGC
+(reference: fleet/meta_optimizers/gradient_merge_optimizer.py,
+localsgd_optimizer.py, dgc_optimizer.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import jit, optimizer
+from paddle_tpu.distributed.fleet import (
+    DGCMomentumOptimizer, GradientMergeOptimizer, LocalSGDOptimizer,
+)
+from paddle_tpu.distributed import fleet
+
+
+def _model_and_data(seed=0):
+    paddle.seed(seed)
+    m = paddle.nn.Linear(8, 4)
+    rs = np.random.RandomState(3)
+    x = paddle.to_tensor(rs.randn(16, 8).astype("float32"))
+    y = paddle.to_tensor(rs.randn(16, 4).astype("float32"))
+    return m, x, y
+
+
+def _loss(m, x, y):
+    return ((m(x) - y) ** 2).mean()
+
+
+def test_gradient_merge_matches_large_batch_sgd():
+    # k micro-steps on k equal chunks == one step on the full batch (SGD)
+    k = 4
+    m1, x, y = _model_and_data()
+    m2, _, _ = _model_and_data()
+    np.testing.assert_allclose(m1.weight.numpy(), m2.weight.numpy())
+
+    opt1 = optimizer.SGD(learning_rate=0.1, parameters=m1.parameters())
+    opt2 = GradientMergeOptimizer(
+        optimizer.SGD(learning_rate=0.1, parameters=m2.parameters()), k_steps=k)
+
+    loss = _loss(m1, x, y)
+    loss.backward()
+    opt1.step()
+    opt1.clear_grad()
+
+    xs = np.split(x.numpy(), k)
+    ys = np.split(y.numpy(), k)
+    for xi, yi in zip(xs, ys):
+        li = _loss(m2, paddle.to_tensor(xi), paddle.to_tensor(yi))
+        li.backward()
+        opt2.step()
+        opt2.clear_grad()
+    np.testing.assert_allclose(m1.weight.numpy(), m2.weight.numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_gradient_merge_holds_params_between_boundaries():
+    m, x, y = _model_and_data()
+    opt = GradientMergeOptimizer(
+        optimizer.Adam(learning_rate=1e-2, parameters=m.parameters()), k_steps=3)
+    w0 = m.weight.numpy().copy()
+    for i in range(2):           # two non-boundary micro-steps
+        loss = _loss(m, x, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        np.testing.assert_allclose(m.weight.numpy(), w0)
+    loss = _loss(m, x, y)
+    loss.backward()
+    opt.step()                   # third: applies
+    opt.clear_grad()
+    assert not np.allclose(m.weight.numpy(), w0)
+
+
+def test_gradient_merge_under_jit_compile():
+    m, x, y = _model_and_data()
+    opt = GradientMergeOptimizer(
+        optimizer.SGD(learning_rate=0.1, parameters=m.parameters()), k_steps=2)
+
+    def step(xb, yb):
+        loss = _loss(m, xb, yb)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    compiled = jit.compile(step, models=[m], optimizers=[opt])
+    w0 = m.weight.numpy().copy()
+    compiled(x, y)
+    np.testing.assert_allclose(m.weight.numpy(), w0)   # held
+    compiled(x, y)
+    assert not np.allclose(m.weight.numpy(), w0)        # applied at k=2
+
+    # parity with eager merge on the same schedule
+    m2, _, _ = _model_and_data()
+    opt2 = GradientMergeOptimizer(
+        optimizer.SGD(learning_rate=0.1, parameters=m2.parameters()), k_steps=2)
+    for _ in range(2):
+        l2 = _loss(m2, x, y)
+        l2.backward()
+        opt2.step()
+        opt2.clear_grad()
+    np.testing.assert_allclose(m.weight.numpy(), m2.weight.numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_dgc_momentum_trains_and_sparsifies():
+    m, x, y = _model_and_data()
+    opt = DGCMomentumOptimizer(
+        optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                           parameters=m.parameters()),
+        momentum=0.9, rampup_begin_step=0, sparsity=(0.75,))
+    losses = []
+    for _ in range(12):
+        loss = _loss(m, x, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    # error-feedback buffers exist and are mostly non-zero where masked out
+    slot = opt._inner._states[id(m.weight)]
+    assert "dgc_u" in slot and "dgc_v" in slot
+
+
+def test_dgc_before_rampup_is_dense_momentum():
+    m1, x, y = _model_and_data()
+    m2, _, _ = _model_and_data()
+    inner1 = optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                parameters=m1.parameters())
+    opt2 = DGCMomentumOptimizer(
+        optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                           parameters=m2.parameters()),
+        rampup_begin_step=100, sparsity=(0.99,))
+    for _ in range(3):
+        l1 = _loss(m1, x, y)
+        l1.backward(); inner1.step(); inner1.clear_grad()
+        l2 = _loss(m2, x, y)
+        l2.backward(); opt2.step(); opt2.clear_grad()
+    np.testing.assert_allclose(m1.weight.numpy(), m2.weight.numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_localsgd_noop_under_gspmd():
+    m, x, y = _model_and_data()
+    ref, _, _ = _model_and_data()
+    inner_ref = optimizer.SGD(learning_rate=0.1, parameters=ref.parameters())
+    opt = LocalSGDOptimizer(
+        optimizer.SGD(learning_rate=0.1, parameters=m.parameters()), k_steps=2)
+    for _ in range(3):
+        l1 = _loss(ref, x, y); l1.backward(); inner_ref.step(); inner_ref.clear_grad()
+        l2 = _loss(m, x, y); l2.backward(); opt.step(); opt.clear_grad()
+    np.testing.assert_allclose(ref.weight.numpy(), m.weight.numpy(),
+                               rtol=1e-6)
+
+
+def test_strategy_composition():
+    strat = fleet.DistributedStrategy()
+    strat.gradient_merge = True
+    strat.gradient_merge_configs = {"k_steps": 2, "avg": True}
+    fleet.init(strategy=strat)
+    m, x, y = _model_and_data()
+    opt = fleet.distributed_optimizer(
+        optimizer.SGD(learning_rate=0.1, parameters=m.parameters()))
+    w0 = m.weight.numpy().copy()
+    loss = _loss(m, x, y)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    np.testing.assert_allclose(m.weight.numpy(), w0)    # held at micro-step 1
+    loss = _loss(m, x, y)
+    loss.backward()
+    opt.step()
+    assert not np.allclose(m.weight.numpy(), w0)

@@ -1,0 +1,117 @@
+"""Legacy paddle.batch / paddle.reader / paddle.dataset surface
+(reference: python/paddle/batch.py, python/paddle/reader/decorator.py,
+python/paddle/dataset/)."""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def _count(reader):
+    return sum(1 for _ in reader())
+
+
+def test_batch_and_drop_last():
+    rd = paddle.dataset.uci_housing.train()
+    n = _count(rd)
+    batched = paddle.batch(rd, batch_size=32)
+    sizes = [len(b) for b in batched()]
+    assert sum(sizes) == n and all(s == 32 for s in sizes[:-1])
+    dropped = paddle.batch(rd, batch_size=32, drop_last=True)
+    assert all(len(b) == 32 for b in dropped())
+
+
+def test_uci_housing_schema():
+    x, y = next(paddle.dataset.uci_housing.train()())
+    assert x.shape == (13,) and x.dtype == np.float32
+    assert len(paddle.dataset.uci_housing.feature_names) == 13
+
+
+def test_mnist_normalized_to_pm1():
+    img, label = next(paddle.dataset.mnist.train()())
+    assert img.shape == (784,)
+    assert -1.0 <= img.min() and img.max() <= 1.0
+    assert isinstance(label, int)
+
+
+def test_cifar_and_imdb_and_imikolov():
+    img, label = next(paddle.dataset.cifar.train10()())
+    assert img.shape == (3072,)
+    ids, lab = next(paddle.dataset.imdb.train(paddle.dataset.imdb.word_dict())())
+    assert ids.ndim == 1 and lab in (0, 1)
+    gram = next(paddle.dataset.imikolov.train(n=5)())
+    assert len(gram) == 5
+
+
+def test_shuffle_preserves_multiset():
+    rd = paddle.reader.firstn(paddle.dataset.mnist.train(), 50)
+    labels = sorted(s[1] for s in rd())
+    shuffled = paddle.reader.shuffle(rd, buf_size=16)
+    assert sorted(s[1] for s in shuffled()) == labels
+
+
+def test_chain_compose_cache_firstn_map():
+    r5 = paddle.reader.firstn(paddle.dataset.mnist.train(), 5)
+    assert _count(paddle.reader.chain(r5, r5)) == 10
+    comp = paddle.reader.compose(r5, r5)
+    assert all(len(t) == 4 for t in comp())
+    cached = paddle.reader.cache(r5)
+    assert _count(cached) == 5 and _count(cached) == 5
+    mapped = paddle.reader.map_readers(lambda a, b: a[1] + b[1], r5, r5)
+    assert _count(mapped) == 5
+
+
+def test_compose_alignment_check():
+    import pytest
+
+    r3 = paddle.reader.firstn(paddle.dataset.mnist.train(), 3)
+    r5 = paddle.reader.firstn(paddle.dataset.mnist.train(), 5)
+    with pytest.raises(ValueError):
+        list(paddle.reader.compose(r3, r5)())
+    assert _count(paddle.reader.compose(r3, r5, check_alignment=False)) == 5
+
+
+def test_buffered_and_xmap_and_multiprocess():
+    r = paddle.reader.firstn(paddle.dataset.mnist.train(), 20)
+    assert _count(paddle.reader.buffered(r, 4)) == 20
+    ordered = list(paddle.reader.xmap_readers(
+        lambda s: s[1], r, process_num=4, buffer_size=8, order=True)())
+    assert ordered == [s[1] for s in r()]
+    unordered = list(paddle.reader.xmap_readers(
+        lambda s: s[1], r, process_num=4, buffer_size=8)())
+    assert sorted(unordered) == sorted(ordered)
+    inter = paddle.reader.multiprocess_reader([r, r])
+    assert _count(inter) == 40
+
+
+def test_xmap_propagates_mapper_error():
+    import pytest
+
+    def bad(s):
+        raise ValueError("boom")
+
+    r = paddle.reader.firstn(paddle.dataset.mnist.train(), 4)
+    with pytest.raises(ValueError):
+        list(paddle.reader.xmap_readers(bad, r, 2, 4)())
+
+
+def test_legacy_pipeline_trains_linear_regression():
+    # the canonical reference example: uci_housing + fc + SGD
+    paddle.seed(0)
+    m = paddle.nn.Linear(13, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.01, parameters=m.parameters())
+    train_reader = paddle.batch(
+        paddle.reader.shuffle(paddle.dataset.uci_housing.train(), 500),
+        batch_size=64)
+    first = last = None
+    for epoch in range(3):
+        for batch in train_reader():
+            x = paddle.to_tensor(np.stack([s[0] for s in batch]))
+            y = paddle.to_tensor(np.stack([s[1] for s in batch]))
+            loss = ((m(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if first is None:
+                first = float(loss)
+            last = float(loss)
+    assert last < first

@@ -132,10 +132,11 @@ def test_matrix_nms_suppresses_duplicates():
     scores = np.array([[[0.9, 0.85, 0.6],     # class 1 (0 is background)
                         [0.0, 0.0, 0.0]]], np.float32)
     scores = np.concatenate([np.zeros_like(scores[:, :1]), scores], 1)
-    out, idx, num = ops.matrix_nms(
+    out, num, idx = ops.matrix_nms(
         paddle.to_tensor(boxes), paddle.to_tensor(scores),
         score_threshold=0.1, post_threshold=0.3, nms_top_k=10, keep_top_k=10,
         return_index=True)
+    assert idx is not None
     o = out.numpy()
     assert int(num.numpy()[0]) == o.shape[0]
     assert o.shape[1] == 6
@@ -154,11 +155,13 @@ def test_matrix_nms_suppresses_duplicates():
 def test_matrix_nms_gaussian_keeps_more_score():
     boxes = np.array([[[0, 0, 10, 10], [0, 0, 10, 9.0]]], np.float32)
     sc = np.array([[[0, 0], [0.9, 0.8]]], np.float32)
-    o_lin, _ = ops.matrix_nms(paddle.to_tensor(boxes), paddle.to_tensor(sc),
-                              0.1, 0.0, 10, 10, background_label=0)
-    o_g, _ = ops.matrix_nms(paddle.to_tensor(boxes), paddle.to_tensor(sc),
-                            0.1, 0.0, 10, 10, use_gaussian=True,
-                            gaussian_sigma=2.0, background_label=0)
+    o_lin, _, idx_none = ops.matrix_nms(
+        paddle.to_tensor(boxes), paddle.to_tensor(sc),
+        0.1, 0.0, 10, 10, background_label=0)
+    assert idx_none is None
+    o_g, _, _ = ops.matrix_nms(paddle.to_tensor(boxes), paddle.to_tensor(sc),
+                               0.1, 0.0, 10, 10, use_gaussian=True,
+                               gaussian_sigma=2.0, background_label=0)
     assert o_lin.shape[0] == o_g.shape[0] == 2
 
 
