@@ -610,6 +610,10 @@ def cached_attention_arrays(q, k, v, k_cache, v_cache, t, scale=None,
         k_cache, k.astype(k_cache.dtype), (0, t, 0, 0))
     v_cache = jax.lax.dynamic_update_slice(
         v_cache, v.astype(v_cache.dtype), (0, t, 0, 0))
+    if mask is None and _decode_ok(q, k_cache, v_cache):
+        # S_q=1 decode: Pallas kernel reads only the valid cache prefix
+        out = flash_decode_arrays(q, k_cache, v_cache, t + 1, scale=scale)
+        return out.astype(q.dtype), k_cache, v_cache
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
                         preferred_element_type=jnp.float32) * scale
     q_pos = t + jnp.arange(s, dtype=jnp.int32)          # absolute positions
@@ -644,3 +648,114 @@ def flash_attention(
         return out
 
     return apply(fn, query, key, value, name="flash_attention")
+
+
+# ---------------------------------------------------------------------------
+# Flash-decode kernel: single-token attention against a KV cache
+# ---------------------------------------------------------------------------
+
+def _decode_kernel(len_ref, q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf, sem,
+                   *, block_k, d, scale):
+    """One (batch, head) program: q [1, d] against the valid prefix of the
+    cache [S_max, d] living in ANY/HBM memory. The valid length arrives via
+    scalar prefetch (len_ref), so only ceil(len / block_k) cache blocks are
+    ever DMA'd into VMEM — the XLA fallback reads (and masks) all S_max
+    positions. Online softmax over blocks, fp32 accumulation."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b = pl.program_id(0)
+    h = pl.program_id(2)
+    length = len_ref[0]
+    num_kb = (length + block_k - 1) // block_k
+    q = q_ref[0, 0, 0, :].reshape(1, d)
+
+    def body(kb, carry):
+        m, l, acc = carry
+        start = kb * block_k
+        kd = pltpu.make_async_copy(
+            k_hbm.at[b, pl.ds(start, block_k), h, :], k_buf, sem.at[0])
+        vd = pltpu.make_async_copy(
+            v_hbm.at[b, pl.ds(start, block_k), h, :], v_buf, sem.at[1])
+        kd.start()
+        vd.start()
+        kd.wait()
+        s = _dot_f32(q, k_buf[...], transpose_b=True) * scale   # [1, bk]
+        pos = start + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+        s = jnp.where(pos < length, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s))
+        p = jnp.exp(s - m_new)                                  # [1, bk]
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p)
+        vd.wait()
+        acc_new = acc * alpha + _dot_f32(p.astype(v_buf.dtype), v_buf[...])
+        return m_new, l_new, acc_new
+
+    m0 = jnp.float32(_NEG_INF)
+    l0 = jnp.float32(0.0)
+    acc0 = jnp.zeros((1, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
+    o_ref[0, 0, 0, :] = (acc / jnp.maximum(l, 1e-30))[0].astype(o_ref.dtype)
+
+
+def flash_decode_arrays(q, k_cache, v_cache, length, scale=None,
+                        block_k=256):
+    """Decode-attention against the first `length` cache positions.
+
+    q [B, 1, H, D]; k_cache/v_cache [B, S_max, H, D]; length: int32 scalar
+    (t + 1 during decode). Returns [B, 1, H, D]. The TPU answer to the
+    reference's masked full-cache attention inside
+    fused_multi_transformer_op.cu's decode branch: at S_q = 1 the MXU is
+    idle and HBM bandwidth on cache reads is everything, so the kernel
+    reads only the valid cache prefix (blockwise DMA, online softmax)
+    instead of all S_max rows."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, s, h, d = q.shape
+    s_max = k_cache.shape[1]
+    assert s == 1, "flash_decode_arrays is the S_q=1 path"
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    # blocks must tile s_max exactly: the DMA loop reads whole blocks, and a
+    # ragged final block would read past the cache rows
+    block_k = min(block_k, s_max)
+    while s_max % block_k:
+        block_k //= 2
+    assert block_k >= 1
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, 1, h),
+        in_specs=[
+            pl.BlockSpec((1, 1, 1, d), lambda i, j, k, len_ref: (i, 0, k, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, 1, 1, d),
+                               lambda i, j, k, len_ref: (i, 0, k, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), k_cache.dtype),
+            pltpu.VMEM((block_k, d), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    kernel = functools.partial(_decode_kernel, block_k=block_k, d=d,
+                               scale=scale)
+    lengths = jnp.asarray(length, jnp.int32).reshape(1)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, 1, h, d), q.dtype),
+        interpret=_interpret(),
+    )(lengths, q, k_cache, v_cache)
+
+
+def _decode_ok(q, k_cache, v_cache) -> bool:
+    if not (_on_tpu() or _interpret()):
+        return False
+    b, s, h, d = q.shape
+    s_max = k_cache.shape[1]
+    # same-dtype: the kernel's lax.dot_general needs matching operands (the
+    # XLA fallback einsum would promote mixed fp32-q/bf16-cache instead)
+    return (s == 1 and d in (64, 128, 256) and s_max % 128 == 0
+            and q.dtype == k_cache.dtype == v_cache.dtype)
