@@ -98,8 +98,40 @@ from .framework.core_ import (  # noqa: E402
     get_default_dtype,
     set_flags,
     get_flags,
+    get_rng_state,
+    set_rng_state,
+)
+from .framework.compat import (  # noqa: E402
+    CPUPlace, CUDAPlace, CUDAPinnedPlace, NPUPlace, XPUPlace, CustomPlace,
+    iinfo, finfo, set_printoptions, disable_signal_handler, LazyGuard, flops,
 )
 from .device import set_device, get_device  # noqa: E402
+from .nn.layer import ParamAttr  # noqa: E402
+from .distributed import DataParallel  # noqa: E402
+from .core.dtype import bool_ as bool  # noqa: E402,A001  (reference exports `paddle.bool`)
+
+import numpy as _np  # noqa: E402
+dtype = _np.dtype  # paddle.dtype: the dtype class (np.dtype on XLA)
+# rng-state aliases: one counter-based PRNG serves every backend (the
+# reference separates host and CUDA generator stacks; XLA has one)
+get_cuda_rng_state = get_rng_state
+set_cuda_rng_state = set_rng_state
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Free-function parameter creation (reference
+    python/paddle/tensor/creation.py:create_parameter)."""
+    from .nn.layer import Layer, ParamAttr
+
+    if name is not None:
+        attr = ParamAttr._to_attr(attr)
+        if attr is not False and attr.name is None:
+            attr.name = name
+    holder = Layer()
+    return holder.create_parameter(shape, attr=attr, dtype=dtype,
+                                   is_bias=is_bias,
+                                   default_initializer=default_initializer)
 
 disable_static = static.disable_static
 enable_static = static.enable_static

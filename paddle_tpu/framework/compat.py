@@ -1,0 +1,261 @@
+"""API-compat surface: Places, dtype info, printoptions, lazy init, flops
+(reference: paddle/fluid/framework.py Place classes + python/paddle/
+framework/__init__.py exports + hapi/dynamic_flops.py).
+
+TPU-native stance: Places are descriptors only — XLA/PJRT owns physical
+placement, and on this backend every dense computation lands on the TPU
+(or the pinned CPU backend under tests). The classes exist so reference
+scripts passing `place=paddle.CPUPlace()` keep working.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "CPUPlace", "CUDAPlace", "CUDAPinnedPlace", "NPUPlace", "XPUPlace",
+    "CustomPlace", "iinfo", "finfo", "set_printoptions",
+    "disable_signal_handler", "LazyGuard", "flops",
+]
+
+
+class _Place:
+    """Device descriptor (reference phi::Place). Equality is by kind+id."""
+
+    kind = "undefined"
+
+    def __init__(self, device_id: int = 0):
+        self._id = int(device_id)
+
+    def get_device_id(self):
+        return self._id
+
+    def __eq__(self, other):
+        return (isinstance(other, _Place) and self.kind == other.kind
+                and self._id == other._id)
+
+    def __hash__(self):
+        return hash((self.kind, self._id))
+
+    def __repr__(self):
+        return f"Place({self.kind}:{self._id})" if self.kind != "cpu" \
+            else "Place(cpu)"
+
+
+class CPUPlace(_Place):
+    kind = "cpu"
+
+    def __init__(self):
+        super().__init__(0)
+
+
+class CUDAPlace(_Place):
+    """Accepted for script compat; computation still routes to the active
+    XLA backend (there is no CUDA here)."""
+
+    kind = "gpu"
+
+
+class CUDAPinnedPlace(_Place):
+    kind = "gpu_pinned"
+
+    def __init__(self):
+        super().__init__(0)
+
+
+class NPUPlace(_Place):
+    kind = "npu"
+
+
+class XPUPlace(_Place):
+    kind = "xpu"
+
+
+class CustomPlace(_Place):
+    kind = "custom"
+
+    def __init__(self, dev_type="tpu", device_id=0):
+        super().__init__(device_id)
+        self.device_type = dev_type
+
+
+class _DTypeInfo:
+    def __init__(self, info, dtype_name):
+        self.min = info.min.item() if hasattr(info.min, "item") else info.min
+        self.max = info.max.item() if hasattr(info.max, "item") else info.max
+        self.bits = info.bits
+        self.dtype = dtype_name
+        if hasattr(info, "eps"):
+            self.eps = float(info.eps)
+            self.tiny = float(info.tiny)
+            self.smallest_normal = float(info.tiny)
+            self.resolution = float(getattr(info, "resolution", info.eps))
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(min={self.min}, max={self.max}, "
+                f"bits={self.bits}, dtype={self.dtype})")
+
+
+def iinfo(dtype):
+    """paddle.iinfo: integer dtype limits."""
+    from ..core.dtype import convert_dtype
+
+    d = convert_dtype(dtype)
+    return _DTypeInfo(jnp.iinfo(d), str(np.dtype(d)))
+
+
+def finfo(dtype):
+    """paddle.finfo: floating dtype limits (bf16-aware via ml_dtypes)."""
+    from ..core.dtype import convert_dtype
+
+    d = convert_dtype(dtype)
+    return _DTypeInfo(jnp.finfo(d), str(d))
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Tensor repr formatting (reference tensor/to_string.py). Tensors print
+    through numpy, so this forwards to numpy's printoptions."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def disable_signal_handler():
+    """Reference disables its C++ fault handlers for interop with other
+    frameworks' handlers; this build installs none, so this is a no-op."""
+
+
+class LazyGuard:
+    """Defer parameter materialization while constructing a Layer
+    (reference: fluid/lazy_init.py LazyGuard/LazyInitHelper — param init
+    programs recorded, replayed on demand).
+
+    Inside the guard, `create_parameter` allocates the (cheap, XLA-lazy)
+    zero buffer and records the real initializer on the parameter as
+    `_lazy_init`; `materialize(layer)` (or the first `set_state_dict`,
+    which overwrites values anyway) runs the recorded initializers.
+    """
+
+    _active = False
+
+    def __enter__(self):
+        LazyGuard._active = True
+        return self
+
+    def __exit__(self, *exc):
+        LazyGuard._active = False
+        return False
+
+    @staticmethod
+    def materialize(layer):
+        """Run every deferred initializer recorded under the guard."""
+        for p in layer.parameters():
+            init = getattr(p, "_lazy_init", None)
+            if init is not None:
+                init(p)
+                p._lazy_init = None
+
+
+_FLOP_RULES = {}
+
+
+def _register_flops(cls_name):
+    def deco(fn):
+        _FLOP_RULES[cls_name] = fn
+        return fn
+
+    return deco
+
+
+@_register_flops("Linear")
+def _fl_linear(layer, in_shape, out_shape):
+    w = layer.weight.shape
+    batch = int(np.prod(out_shape[:-1]))
+    return 2 * batch * int(np.prod(w))
+
+
+@_register_flops("Conv2D")
+def _fl_conv2d(layer, in_shape, out_shape):
+    w = layer.weight.shape            # [out_c, in_c/groups, kh, kw]
+    out_elems = int(np.prod(out_shape))
+    return 2 * out_elems * int(np.prod(w[1:]))
+
+
+@_register_flops("Conv2DTranspose")
+def _fl_conv2dt(layer, in_shape, out_shape):
+    w = layer.weight.shape
+    in_elems = int(np.prod(in_shape))
+    return 2 * in_elems * int(np.prod(w[1:]))
+
+
+def _fl_norm(layer, in_shape, out_shape):
+    return 2 * int(np.prod(in_shape))
+
+
+for _n in ("BatchNorm2D", "BatchNorm1D", "BatchNorm3D", "LayerNorm",
+           "GroupNorm", "InstanceNorm2D", "SyncBatchNorm", "BatchNorm"):
+    _FLOP_RULES[_n] = _fl_norm
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """paddle.flops (reference hapi/dynamic_flops.py:flops): run one forward
+    with per-layer hooks, sum multiply-add FLOPs by layer type.
+    custom_ops: {LayerClass: fn(layer, input, output) -> flops}."""
+    from ..core.tensor import Tensor
+    from ..autograd import tape
+
+    custom_ops = custom_ops or {}
+    records = []
+    handles = []
+
+    def make_hook(layer):
+        def hook(lyr, inputs, output):
+            in_shape = tuple(inputs[0].shape) if inputs else ()
+            out_shape = tuple(output.shape) if isinstance(output, Tensor) \
+                else tuple(output[0].shape)
+            fn = None
+            for cls, cfn in custom_ops.items():
+                if isinstance(lyr, cls):
+                    fn = lambda l, i, o: cfn(l, inputs, output)  # noqa: E731
+                    break
+            if fn is None:
+                fn = _FLOP_RULES.get(type(lyr).__name__)
+                if fn is None:
+                    return
+            records.append((type(lyr).__name__, in_shape, out_shape,
+                            int(fn(lyr, in_shape, out_shape))))
+
+        return hook
+
+    for sub in net.sublayers(include_self=True):
+        if type(sub).__name__ in _FLOP_RULES or any(
+                isinstance(sub, c) for c in custom_ops):
+            handles.append(sub.register_forward_post_hook(make_hook(sub)))
+    try:
+        x = Tensor(jnp.zeros(tuple(input_size), jnp.float32))
+        was_training = net.training
+        net.eval()
+        with tape.no_grad():
+            net(x)
+        if was_training:
+            net.train()
+    finally:
+        for h in handles:
+            h.remove()
+    total = sum(r[3] for r in records)
+    if print_detail:
+        for name, i, o, f in records:
+            print(f"{name:<18} in={i} out={o} flops={f:,}")
+        print(f"Total FLOPs: {total:,}")
+    return total

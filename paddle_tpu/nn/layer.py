@@ -24,7 +24,7 @@ __all__ = ["Layer", "Parameter", "ParamAttr"]
 
 
 class Parameter(Tensor):
-    __slots__ = ("trainable", "optimize_attr", "regularizer", "is_distributed", "_sharding_axes")
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "is_distributed", "_sharding_axes", "_lazy_init")
 
     def __init__(self, data, trainable=True, name=None):
         super().__init__(data, stop_gradient=not trainable, name=name)
@@ -35,6 +35,8 @@ class Parameter(Tensor):
         self.is_distributed = False
         # Per-axis logical mesh axes for SPMD placement (parallel/ fills this).
         self._sharding_axes = None
+        # deferred initializer recorded under LazyGuard (framework/compat.py)
+        self._lazy_init = None
 
     def __repr__(self):
         return (
@@ -127,8 +129,19 @@ class Layer:
         init = attr.initializer or default_initializer or (
             Constant(0.0) if is_bias else XavierNormal()
         )
-        data = init(tuple(int(s) for s in shape), dtype)
+        from ..framework.compat import LazyGuard
+
+        shape_t = tuple(int(s) for s in shape)
+        if LazyGuard._active:
+            # deferred init (reference lazy_init.py): cheap zeros now, the
+            # real initializer recorded for LazyGuard.materialize
+            data = jnp.zeros(shape_t, dtype)
+        else:
+            data = init(shape_t, dtype)
         p = Parameter(data, trainable=attr.trainable, name=attr.name or _unique_name(self._full_name + ".w"))
+        if LazyGuard._active:
+            p._lazy_init = lambda param, _i=init, _s=shape_t, _d=dtype: (
+                param._set_data(_i(_s, _d)))
         p.optimize_attr["learning_rate"] = attr.learning_rate
         p.regularizer = attr.regularizer
         return p
@@ -310,6 +323,10 @@ class Layer:
                 # donate this model's state arrays; aliasing would invalidate
                 # the checkpoint donor's tensors)
                 t._data = jnp.array(arr, dtype=t.dtype, copy=True)
+                # loaded values supersede any LazyGuard-deferred initializer
+                # (materialize() after load must NOT re-randomize weights)
+                if getattr(t, "_lazy_init", None) is not None:
+                    t._lazy_init = None
             else:
                 missing.append(name)
         for k in state_dict:

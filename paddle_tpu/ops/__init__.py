@@ -17,8 +17,9 @@ from .math import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
 from .array import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
 
-from . import array, creation, math, manipulation, logic
+from . import array, creation, math, manipulation, logic, extras
 
 __all__ = (
     list(creation.__all__)
@@ -26,6 +27,7 @@ __all__ = (
     + list(manipulation.__all__)
     + list(logic.__all__)
     + list(array.__all__)
+    + list(extras.__all__)
 )
 
 
@@ -110,19 +112,44 @@ def _getitem(self, item):
 
 def _setitem(self, item, value):
     idx = _prep_index(item)
+    src = _autograd_snapshot(self)
     if isinstance(value, Tensor):
         out = apply(
-            lambda a, v: a.at[idx].set(v.astype(a.dtype)), self, value, name="setitem"
+            lambda a, v: a.at[idx].set(v.astype(a.dtype)), src, value, name="setitem"
         )
     else:
-        out = apply(lambda a: a.at[idx].set(value), self, name="setitem")
+        out = apply(lambda a: a.at[idx].set(value), src, name="setitem")
     # In-place rebind (reference: __setitem__ is an inplace op on the eager
-    # tensor; autograd-wise the tensor now points at the new producing node).
-    self._data = out._data
-    self._grad_node = out._grad_node
-    self._out_index = out._out_index
+    # tensor; autograd-wise the tensor now points at the new producing node,
+    # whose recorded input is the frozen snapshot).
+    _inplace_rebind(self, out)
+
+
+def _autograd_snapshot(x):
+    """Frozen pre-mutation view for recording an inplace op: the node must
+    hold a Tensor whose _data/_version never change afterwards (the lazy
+    pullback re-reads input _data at backward; the version guard enforces
+    it). Mirrors the reference contract: inplace on a grad-requiring LEAF
+    is an error (eager_method.cc inplace checks / torch semantics)."""
+    from ..autograd import tape
+
+    if (tape.is_grad_enabled() and not x.stop_gradient
+            and getattr(x, "_grad_node", None) is None):
+        raise RuntimeError(
+            "a leaf Tensor that requires grad is being used in an in-place "
+            "operation; operate on a computed value or use no_grad()")
+    snap = Tensor(x._data, stop_gradient=x.stop_gradient)
+    snap._grad_node = getattr(x, "_grad_node", None)
+    snap._out_index = getattr(x, "_out_index", 0)
+    return snap
+
+
+def _inplace_rebind(x, out):
+    x._data = out._data            # bumps the inplace version
+    x._grad_node = out._grad_node
+    x._out_index = out._out_index
     if not out.stop_gradient:
-        self.stop_gradient = False
+        x.stop_gradient = False
 
 
 _METHODS = {}
@@ -131,7 +158,7 @@ _METHODS = {}
 def _install_methods():
     import types
 
-    namespaces = [creation, math, manipulation, logic]
+    namespaces = [creation, math, manipulation, logic, extras]
     skip = {"zeros", "ones", "full", "empty", "arange", "linspace", "eye",
             "rand", "randn", "randint", "uniform", "normal", "randperm",
             "meshgrid", "assign"}
@@ -146,7 +173,9 @@ def _install_methods():
     # aliases matching paddle.Tensor surface
     Tensor.astype = lambda self, dtype: manipulation.cast(self, dtype)
     Tensor.cast = lambda self, dtype: manipulation.cast(self, dtype)
-    Tensor.reshape_ = Tensor.reshape
+    # reshape_/squeeze_/unsqueeze_/tanh_/scatter_ methods come from
+    # ops.extras via the namespace loop above (single source of truth,
+    # with full autograd rebinding — see extras._inplace_variant)
     Tensor.t = lambda self: manipulation.transpose(self, list(range(self.ndim))[::-1])
     Tensor.__getitem__ = _getitem
     Tensor.__setitem__ = _setitem

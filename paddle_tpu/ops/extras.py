@@ -1,0 +1,529 @@
+"""Long-tail tensor-op surface (reference: python/paddle/tensor/math.py /
+stat.py / search.py / manipulation.py entries not covered by the core op
+modules — each a pure jnp formulation XLA fuses; no phi kernel registry
+needed).
+
+Includes the reference's inplace-variant methods (reshape_/squeeze_/...),
+which on immutable XLA arrays are "replace my _data and bump the inplace
+version" (the tape's version counter then guards stale-backward use, same
+contract as the reference's inplace version check).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply
+
+__all__ = [
+    "add_n", "broadcast_shape", "broadcast_tensors", "bucketize", "complex",
+    "count_nonzero", "crop", "diagflat", "diff", "dist", "floor_mod",
+    "frexp", "heaviside", "histogram", "index_add", "kthvalue", "logit",
+    "logspace", "median", "mode", "multiplex", "mv", "nanmean", "nanmedian",
+    "nanquantile", "nansum", "poisson", "quantile", "randint_like", "rank",
+    "renorm", "reverse", "scatter_nd", "sgn", "shape", "standard_normal",
+    "std", "t", "take", "tril_indices", "triu_indices", "unique_consecutive",
+    "unstack", "var", "vsplit", "is_tensor", "is_complex",
+    "is_floating_point", "is_integer", "tolist",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+# -- type predicates (reference: tensor/attribute.py) -----------------------
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_complex(x):
+    return jnp.issubdtype(_arr(x).dtype, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(_arr(x).dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(_arr(x).dtype, jnp.integer)
+
+
+def rank(x):
+    return Tensor(jnp.asarray(_arr(x).ndim, jnp.int32))
+
+
+def shape(x):
+    """paddle.shape: runtime shape as an int32 tensor (static under XLA)."""
+    return Tensor(jnp.asarray(_arr(x).shape, jnp.int32))
+
+
+def tolist(x):
+    return np.asarray(_arr(x)).tolist()
+
+
+# -- elementwise / math -----------------------------------------------------
+
+def add_n(inputs, name=None):
+    ts = [inputs] if isinstance(inputs, Tensor) else list(inputs)
+    return apply(lambda *a: sum(a[1:], a[0]), *ts, name="add_n")
+
+
+def floor_mod(x, y, name=None):
+    return apply(lambda a, b: jnp.mod(a, b), _t(x), _t(y), name="floor_mod")
+
+
+def heaviside(x, y, name=None):
+    return apply(lambda a, b: jnp.heaviside(a, b).astype(a.dtype),
+                 _t(x), _t(y), name="heaviside")
+
+
+def logit(x, eps=None, name=None):
+    def fn(a):
+        a32 = a.astype(jnp.float32)
+        if eps is not None:
+            a32 = jnp.clip(a32, eps, 1.0 - eps)
+        out = jnp.log(a32 / (1.0 - a32))
+        if eps is None:
+            out = jnp.where((a32 < 0) | (a32 > 1), jnp.nan, out)
+        return out.astype(a.dtype)
+
+    return apply(fn, _t(x), name="logit")
+
+
+def sgn(x, name=None):
+    """sign for real dtypes; unit-modulus complex for complex dtypes."""
+
+    def fn(a):
+        if jnp.issubdtype(a.dtype, jnp.complexfloating):
+            mag = jnp.abs(a)
+            return jnp.where(mag == 0, 0, a / jnp.maximum(mag, 1e-38))
+        return jnp.sign(a)
+
+    return apply(fn, _t(x), name="sgn")
+
+
+def frexp(x, name=None):
+    return apply(lambda a: tuple(jnp.frexp(a)), _t(x), name="frexp")
+
+
+def complex(real, imag, name=None):
+    return apply(lambda r, i: jax.lax.complex(r, i), _t(real), _t(imag),
+                 name="complex")
+
+
+def mv(x, vec, name=None):
+    return apply(lambda a, b: a @ b, _t(x), _t(vec), name="mv")
+
+
+def dist(x, y, p=2, name=None):
+    def fn(a, b):
+        d = (a - b).reshape(-1).astype(jnp.float32)
+        if p == float("inf"):
+            out = jnp.max(jnp.abs(d))
+        elif p == 0:
+            out = jnp.sum(d != 0).astype(jnp.float32)
+        else:
+            out = jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+        return out.astype(a.dtype)
+
+    return apply(fn, _t(x), _t(y), name="dist")
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Per-slice p-norm clamp along `axis` (reference renorm_op)."""
+
+    def fn(a):
+        red = tuple(i for i in range(a.ndim) if i != axis % a.ndim)
+        norms = jnp.sum(jnp.abs(a.astype(jnp.float32)) ** p,
+                        axis=red, keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return (a * factor).astype(a.dtype)
+
+    return apply(fn, _t(x), name="renorm")
+
+
+def multiplex(inputs, index, name=None):
+    """Row-wise select among candidate tensors (reference multiplex_op):
+    out[i] = inputs[index[i]][i]."""
+    ts = list(inputs)
+
+    def fn(idx, *cands):
+        stacked = jnp.stack(cands, axis=0)            # [C, B, ...]
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[idx.reshape(-1).astype(jnp.int32), rows]
+
+    return apply(fn, _t(index), *[_t(c) for c in ts], name="multiplex")
+
+
+# -- reductions / statistics ------------------------------------------------
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.count_nonzero(a, axis=axis, keepdims=keepdim
+                                             ).astype(jnp.int64),
+                 _t(x), name="count_nonzero")
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.nansum(a, axis=axis, keepdims=keepdim),
+                 _t(x), name="nansum")
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.nanmean(a, axis=axis, keepdims=keepdim),
+                 _t(x), name="nanmean")
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(lambda a: jnp.std(a, axis=axis, ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), _t(x), name="std")
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(lambda a: jnp.var(a, axis=axis, ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), _t(x), name="var")
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def fn(a):
+        if mode == "avg":
+            return jnp.median(a, axis=axis, keepdims=keepdim)
+        # mode="min": lower of the two middle values (reference contract)
+        n = a.shape[axis] if axis is not None else a.size
+        k = (n - 1) // 2
+        srt = jnp.sort(a.reshape(-1) if axis is None else a, axis=-1 if axis is None else axis)
+        out = jnp.take(srt, k, axis=-1 if axis is None else axis)
+        return jnp.expand_dims(out, axis) if (keepdim and axis is not None) else out
+
+    return apply(fn, _t(x), name="median")
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.nanmedian(a, axis=axis, keepdims=keepdim),
+                 _t(x), name="nanmedian")
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    return apply(lambda a: jnp.quantile(a.astype(jnp.float32), jnp.asarray(q),
+                                        axis=axis, keepdims=keepdim,
+                                        method=interpolation),
+                 _t(x), name="quantile")
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.nanquantile(a.astype(jnp.float32),
+                                           jnp.asarray(q), axis=axis,
+                                           keepdims=keepdim),
+                 _t(x), name="nanquantile")
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    def fn(a):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (None, None)
+        if lo is None:
+            lo = jnp.min(a).astype(jnp.float32)
+            hi = jnp.max(a).astype(jnp.float32)
+        counts, _ = jnp.histogram(a.astype(jnp.float32).reshape(-1),
+                                  bins=bins, range=(lo, hi))
+        return counts.astype(jnp.int64)
+
+    return apply(fn, _t(input), name="histogram")
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def fn(a):
+        srt = jnp.sort(a, axis=axis)
+        idx = jnp.argsort(a, axis=axis)
+        val = jnp.take(srt, k - 1, axis=axis)
+        ind = jnp.take(idx, k - 1, axis=axis).astype(jnp.int64)
+        if keepdim:
+            val = jnp.expand_dims(val, axis)
+            ind = jnp.expand_dims(ind, axis)
+        return val, ind
+
+    return apply(fn, _t(x), name="kthvalue")
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    """Most frequent value along axis (ties: the largest, matching the
+    reference's last-in-sorted-order pick)."""
+
+    def fn(a):
+        srt = jnp.sort(a, axis=axis)
+
+        def most_freq(row):
+            # counts via comparing each element against the sorted row
+            eq = row[:, None] == row[None, :]
+            counts = eq.sum(-1)
+            best = jnp.argmax(counts + jnp.arange(row.shape[0]) * 1e-9)
+            return row[best]
+
+        moved = jnp.moveaxis(srt, axis, -1)
+        lead = moved.shape[:-1]
+        flat = moved.reshape(-1, moved.shape[-1])
+        vals_flat = jax.vmap(most_freq)(flat)               # [rows]
+        orig = jnp.moveaxis(a, axis, -1)
+        flat_orig = orig.reshape(-1, orig.shape[-1])
+        idx_flat = jax.vmap(lambda r, v: jnp.argmax(r == v))(flat_orig,
+                                                             vals_flat)
+        vals_f = vals_flat.reshape(lead)
+        idx = idx_flat.reshape(lead).astype(jnp.int64)
+        if keepdim:
+            vals_f = jnp.expand_dims(vals_f, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return vals_f, idx
+
+    return apply(fn, _t(x), name="mode")
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    args = [_t(x)]
+    has_pre = prepend is not None
+    has_app = append is not None
+    if has_pre:
+        args.append(_t(prepend))
+    if has_app:
+        args.append(_t(append))
+
+    def fn(a, *rest):
+        pre = rest[0] if has_pre else None
+        app = rest[1 if has_pre else 0] if has_app else None
+        return jnp.diff(a, n=n, axis=axis, prepend=pre, append=app)
+
+    return apply(fn, *args, name="diff")
+
+
+# -- shape / indexing -------------------------------------------------------
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def broadcast_tensors(inputs, name=None):
+    ts = list(inputs)
+
+    def fn(*arrs):
+        shape = np.broadcast_shapes(*[a.shape for a in arrs])
+        return tuple(jnp.broadcast_to(a, shape) for a in arrs)
+
+    return apply(fn, *[_t(c) for c in ts], name="broadcast_tensors")
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    def fn(a, seq):
+        side = "right" if right else "left"
+        out = jnp.searchsorted(seq, a, side=side)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+    return apply(fn, _t(x), _t(sorted_sequence), name="bucketize")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    def fn(a):
+        shp = [a.shape[i] if (shape is None or shape[i] == -1) else shape[i]
+               for i in range(a.ndim)]
+        off = [0] * a.ndim if offsets is None else list(offsets)
+        return jax.lax.dynamic_slice(a, off, shp)
+
+    return apply(fn, _t(x), name="crop")
+
+
+def diagflat(x, offset=0, name=None):
+    return apply(lambda a: jnp.diagflat(a, k=offset), _t(x), name="diagflat")
+
+
+def index_add(x, index, axis, value, name=None):
+    def fn(a, idx, val):
+        return a.at[(slice(None),) * (axis % a.ndim)
+                    + (idx.astype(jnp.int32),)].add(val)
+
+    return apply(fn, _t(x), _t(index), _t(value), name="index_add")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    """out[index[i]] += updates[i] over an all-zeros tensor of `shape`
+    (reference scatter_nd_op: additive for duplicate indices)."""
+
+    def fn(idx, upd):
+        out = jnp.zeros(tuple(shape), upd.dtype)
+        k = idx.shape[-1]
+        flat_idx = idx.reshape(-1, k).astype(jnp.int32)
+        upd_flat = upd.reshape((flat_idx.shape[0],) + tuple(shape[k:]))
+        return out.at[tuple(flat_idx[:, i] for i in range(k))].add(upd_flat)
+
+    return apply(fn, _t(index), _t(updates), name="scatter_nd")
+
+
+def reverse(x, axis, name=None):
+    ax = [axis] if isinstance(axis, int) else list(axis)
+    return apply(lambda a: jnp.flip(a, axis=ax), _t(x), name="reverse")
+
+
+def take(x, index, mode="raise", name=None):
+    def fn(a, idx):
+        flat = a.reshape(-1)
+        n = flat.shape[0]
+        ii = idx.astype(jnp.int32)
+        if mode == "wrap":
+            ii = jnp.mod(ii, n)
+        elif mode == "clip":
+            ii = jnp.clip(ii, -n, n - 1)
+        ii = jnp.where(ii < 0, ii + n, ii)
+        return flat[ii]
+
+    return apply(fn, _t(x), _t(index), name="take")
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), jnp.int64))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), jnp.int64))
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    """Deduplicate consecutive runs (host-side: dynamic output shape, same
+    as the reference CPU kernel's contract)."""
+    a = np.asarray(_arr(x))
+    if axis is None:
+        a = a.reshape(-1)
+        change = np.ones(len(a), bool)
+        change[1:] = a[1:] != a[:-1]
+        out = a[change]
+        inv = np.cumsum(change) - 1
+        counts = np.diff(np.append(np.nonzero(change)[0], len(a)))
+    else:
+        moved = np.moveaxis(a, axis, 0)
+        change = np.ones(moved.shape[0], bool)
+        change[1:] = (moved[1:] != moved[:-1]).reshape(moved.shape[0] - 1, -1).any(1)
+        out = np.moveaxis(moved[change], 0, axis)
+        inv = np.cumsum(change) - 1
+        counts = np.diff(np.append(np.nonzero(change)[0], moved.shape[0]))
+    res = [Tensor(jnp.asarray(out))]
+    if return_inverse:
+        res.append(Tensor(jnp.asarray(inv.astype(np.int64))))
+    if return_counts:
+        res.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+    return tuple(res) if len(res) > 1 else res[0]
+
+
+def unstack(x, axis=0, num=None, name=None):
+    n = _arr(x).shape[axis] if num is None else num
+    out = apply(lambda a: tuple(jnp.moveaxis(a, axis, 0)[i] for i in range(n)),
+                _t(x), name="unstack")
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def vsplit(x, num_or_indices, name=None):
+    def fn(a):
+        return tuple(jnp.split(a, num_or_indices, axis=0))
+
+    out = apply(fn, _t(x), name="vsplit")
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def t(x, name=None):
+    def fn(a):
+        assert a.ndim <= 2, "paddle.t expects a 0/1/2-D tensor"
+        return a.T
+
+    return apply(fn, _t(x), name="t")
+
+
+# -- creation / random ------------------------------------------------------
+
+def logspace(start, stop, num, base=10.0, dtype="float32", name=None):
+    from ..core.dtype import convert_dtype
+
+    return Tensor(jnp.logspace(float(start), float(stop), int(num),
+                               base=float(base),
+                               dtype=convert_dtype(dtype)))
+
+
+def standard_normal(shape, dtype="float32", name=None):
+    from ..core import random as _rng
+    from ..core.dtype import convert_dtype
+
+    key = _rng.next_key()
+    return Tensor(jax.random.normal(key, tuple(shape), convert_dtype(dtype)))
+
+
+def poisson(x, name=None):
+    from ..core import random as _rng
+
+    key = _rng.next_key()
+    return apply(lambda a: jax.random.poisson(key, a.astype(jnp.float32)
+                                              ).astype(a.dtype),
+                 _t(x), name="poisson")
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    from ..core import random as _rng
+
+    a = _arr(x)
+    lo, hi = (0, low) if high is None else (low, high)
+    key = _rng.next_key()
+    out_dtype = a.dtype if dtype is None else dtype
+    from ..core.dtype import convert_dtype
+
+    return Tensor(jax.random.randint(key, a.shape, int(lo), int(hi)
+                                     ).astype(convert_dtype(out_dtype)))
+
+
+# -- inplace free functions + shape check -----------------------------------
+
+def _inplace_variant(meth_name):
+    """Inplace rebind, same contract as __setitem__ (ops/__init__._setitem):
+    besides swapping _data (which bumps the inplace version for the tape
+    guard), the tensor must adopt the producing op's grad node — otherwise
+    the op silently drops out of the autograd graph and backward uses the
+    OLD producer's pullback (wrong gradients, no error)."""
+
+    def op(x, *a, **k):
+        from . import _autograd_snapshot, _inplace_rebind
+
+        snap = _autograd_snapshot(x)
+        out = getattr(snap, meth_name)(*a, **k)
+        _inplace_rebind(x, out)
+        return x
+
+    op.__name__ = meth_name + "_"
+    return op
+
+
+reshape_ = _inplace_variant("reshape")
+squeeze_ = _inplace_variant("squeeze")
+unsqueeze_ = _inplace_variant("unsqueeze")
+tanh_ = _inplace_variant("tanh")
+scatter_ = _inplace_variant("scatter")
+
+
+def check_shape(shape):
+    """Validate a shape argument (reference fluid/layers/utils.py
+    check_shape: ints or a 1-D int tensor; -1 allowed once)."""
+    if isinstance(shape, Tensor):
+        shape = tolist(shape)
+    shape = list(shape)
+    for s in shape:
+        if not isinstance(s, (int, np.integer)):
+            raise TypeError(f"shape entries must be int, got {type(s)}")
+    if sum(1 for s in shape if s == -1) > 1:
+        raise ValueError("only one dimension may be -1 in a shape")
+    return shape
+
+
+__all__ += ["reshape_", "squeeze_", "unsqueeze_", "tanh_", "scatter_",
+            "check_shape"]

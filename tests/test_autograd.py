@@ -291,13 +291,20 @@ def test_inplace_version_allows_normal_train_loop():
 
 
 def test_setitem_mutation_after_forward_raises():
-    """__setitem__ goes through the _data property, so the version guard
-    catches it — critical under lazy-vjp backward (which replays the
-    forward from current input data)."""
+    """Mutating a tensor ANOTHER node already saved still trips the version
+    guard — critical under lazy-vjp backward (which replays the forward
+    from current input data). Mutating a grad-requiring LEAF is rejected
+    up front (reference/torch inplace-on-leaf contract)."""
     import pytest as _pytest
 
-    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
-    y = (x * x).sum()
-    x[0] = 5.0
+    w = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    a = w * 1.0
+    y = (a * a).sum()       # this node saved `a`
+    a[0] = 5.0              # allowed (non-leaf), but invalidates y's node
     with _pytest.raises(RuntimeError, match="modified in place"):
         y.backward()
+
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    (x * x).sum()
+    with _pytest.raises(RuntimeError, match="leaf"):
+        x[0] = 5.0          # leaf mutation rejected at the op
