@@ -15,8 +15,18 @@ from .utils_ import clip_grad_norm_, clip_grad_value_, parameters_to_vector, vec
 
 from . import common, conv, norm, activation, pooling, container, loss, transformer, rnn
 
+from .extras import *  # noqa: F401,F403
+from . import extras as _extras
+from .rnn import RNNCellBase  # noqa: F401
+from ..optimizer.clip import (  # noqa: F401  (reference exports these in nn)
+    ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
+)
+
 __all__ = (
-    ["Layer", "Parameter", "ParamAttr", "functional", "initializer"]
+    ["Layer", "Parameter", "ParamAttr", "functional", "initializer",
+     "RNNCellBase", "ClipGradByGlobalNorm", "ClipGradByNorm",
+     "ClipGradByValue"]
+    + list(_extras.__all__)
     + list(common.__all__)
     + list(conv.__all__)
     + list(norm.__all__)

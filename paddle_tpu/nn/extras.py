@@ -1,0 +1,318 @@
+"""nn layer long tail (reference: python/paddle/nn/layer/ — pooling
+AdaptiveMaxPool1D/3D + MaxUnPool*, vision PixelShuffle/Unshuffle/
+ChannelShuffle, padding ZeroPad2D, distance PairwiseDistance, common
+Bilinear, activation Softmax2D, loss {Soft,MultiLabelSoft,Multi}Margin /
+TripletMarginWithDistance / HSigmoid / RNNT, and the seq2seq decoding pair
+BeamSearchDecoder + dynamic_decode from nn/decode.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from . import functional as F
+from .layer import Layer
+from .initializer import XavierNormal, Constant
+
+__all__ = [
+    "AdaptiveMaxPool1D", "AdaptiveMaxPool3D", "MaxUnPool1D", "MaxUnPool2D",
+    "MaxUnPool3D", "PixelShuffle", "PixelUnshuffle", "ChannelShuffle",
+    "ZeroPad2D", "PairwiseDistance", "Bilinear", "Softmax2D",
+    "SoftMarginLoss", "MultiLabelSoftMarginLoss", "MultiMarginLoss",
+    "TripletMarginWithDistanceLoss", "HSigmoidLoss", "RNNTLoss",
+    "BeamSearchDecoder", "dynamic_decode",
+]
+
+
+class AdaptiveMaxPool1D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self._args = (output_size, return_mask)
+
+    def forward(self, x):
+        return F.adaptive_max_pool1d(x, *self._args)
+
+
+class AdaptiveMaxPool3D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self._args = (output_size, return_mask)
+
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, *self._args)
+
+
+class _MaxUnPool(Layer):
+    _fn = None
+
+    def __init__(self, kernel_size, stride=None, padding=0, data_format=None,
+                 output_size=None, name=None):
+        super().__init__()
+        self._kw = dict(kernel_size=kernel_size, stride=stride,
+                        padding=padding, output_size=output_size)
+
+    def forward(self, x, indices):
+        return type(self)._fn(x, indices, **self._kw)
+
+
+class MaxUnPool1D(_MaxUnPool):
+    _fn = staticmethod(F.max_unpool1d)
+
+
+class MaxUnPool2D(_MaxUnPool):
+    _fn = staticmethod(F.max_unpool2d)
+
+
+class MaxUnPool3D(_MaxUnPool):
+    _fn = staticmethod(F.max_unpool3d)
+
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self._f = upscale_factor
+        self._df = data_format
+
+    def forward(self, x):
+        return F.pixel_shuffle(x, self._f, self._df)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self._f = downscale_factor
+        self._df = data_format
+
+    def forward(self, x):
+        return F.pixel_unshuffle(x, self._f, self._df)
+
+
+class ChannelShuffle(Layer):
+    """Interleave channel groups (ShuffleNet; reference
+    nn/layer/vision.py ChannelShuffle)."""
+
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self._g = groups
+        self._df = data_format
+
+    def forward(self, x):
+        from ..core.dispatch import apply
+
+        g = self._g
+        ch_axis = 1 if self._df == "NCHW" else -1
+
+        def fn(a):
+            shp = list(a.shape)
+            c = shp[ch_axis]
+            if ch_axis == 1:
+                r = a.reshape(shp[0], g, c // g, *shp[2:])
+                r = jnp.swapaxes(r, 1, 2)
+                return r.reshape(a.shape)
+            r = a.reshape(*shp[:-1], g, c // g)
+            r = jnp.swapaxes(r, -1, -2)
+            return r.reshape(a.shape)
+
+        return apply(fn, x, name="channel_shuffle")
+
+
+class ZeroPad2D(Layer):
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__()
+        self._p = padding
+        self._df = data_format
+
+    def forward(self, x):
+        return F.zeropad2d(x, self._p, self._df)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self._kw = dict(p=p, epsilon=epsilon, keepdim=keepdim)
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, **self._kw)
+
+
+class Bilinear(Layer):
+    def __init__(self, in1_features, in2_features, out_features,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [out_features, in1_features, in2_features], attr=weight_attr,
+            default_initializer=XavierNormal())
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [out_features], attr=bias_attr, is_bias=True,
+            default_initializer=Constant(0.0)))
+
+    def forward(self, x1, x2):
+        return F.bilinear(x1, x2, self.weight, self.bias)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW (or unbatched CHW) input
+    (reference nn/layer/activation.py Softmax2D)."""
+
+    def forward(self, x):
+        assert x.ndim in (3, 4), "Softmax2D expects NCHW or CHW input"
+        return F.softmax(x, axis=-3)
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self._r = reduction
+
+    def forward(self, input, label):
+        return F.soft_margin_loss(input, label, reduction=self._r)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self._w = weight
+        self._r = reduction
+
+    def forward(self, input, label):
+        return F.multi_label_soft_margin_loss(input, label, weight=self._w,
+                                              reduction=self._r)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._kw = dict(p=p, margin=margin, weight=weight, reduction=reduction)
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(input, label, **self._kw)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self._kw = dict(distance_function=distance_function, margin=margin,
+                        swap=swap, reduction=reduction)
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_with_distance_loss(input, positive, negative,
+                                                   **self._kw)
+
+
+class HSigmoidLoss(Layer):
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        self._num_classes = num_classes
+        rows = num_classes if is_custom else num_classes - 1
+        self.weight = self.create_parameter(
+            [rows, feature_size], attr=weight_attr,
+            default_initializer=XavierNormal())
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [rows], attr=bias_attr, is_bias=True,
+            default_initializer=Constant(0.0)))
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self._num_classes, self.weight,
+                               self.bias, path_table=path_table,
+                               path_code=path_code)
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank=0, fastemit_lambda=0.0, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._kw = dict(blank=blank, fastemit_lambda=fastemit_lambda,
+                        reduction=reduction)
+
+    def forward(self, logits, labels, logit_lengths, label_lengths):
+        return F.rnnt_loss(logits, labels, logit_lengths, label_lengths,
+                           **self._kw)
+
+
+class BeamSearchDecoder:
+    """Beam-search decoder over an RNN cell (reference nn/decode.py
+    BeamSearchDecoder). Host-stepped: each step embeds the previous token,
+    advances the cell, and keeps the top-`beam_size` cumulative-log-prob
+    continuations; finished beams are held at EOS. Used with
+    dynamic_decode (the reference's driver loop)."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def _logits(self, tok, states):
+        emb = self.embedding_fn(tok) if self.embedding_fn is not None else tok
+        out, states = self.cell(emb, states)
+        if self.output_fn is not None:
+            out = self.output_fn(out)
+        return out, states
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=32, **kwargs):
+    """Greedy/beam decoding driver (reference nn/decode.py dynamic_decode).
+    Returns (ids [B, W, T], scores [B, W]) for a BeamSearchDecoder."""
+    import jax
+
+    bsd = decoder
+    W = bsd.beam_size
+    cell_states = inits
+
+    def _rep(tree, w):
+        return jax.tree_util.tree_map(
+            lambda a: jnp.repeat(a._data if isinstance(a, Tensor) else a,
+                                 w, axis=0), tree)
+
+    # infer batch from the init state
+    leaves = jax.tree_util.tree_leaves(cell_states)
+    B = int(leaves[0].shape[0]) if leaves else 1
+    states = _rep(cell_states, W)                      # [B*W, ...]
+    tok = np.full((B, W), bsd.start_token, np.int64)
+    scores = np.full((B, W), -1e9, np.float32)
+    scores[:, 0] = 0.0                                 # one live beam at t=0
+    finished = np.zeros((B, W), bool)
+    ids_hist = []
+
+    for _ in range(max_step_num):
+        t_in = Tensor(jnp.asarray(tok.reshape(-1)))
+        logits, states = bsd._logits(t_in, states)
+        lp = jax.nn.log_softmax(
+            logits._data if isinstance(logits, Tensor) else logits, -1)
+        lp = np.asarray(lp).reshape(B, W, -1)
+        V = lp.shape[-1]
+        # finished beams only extend with EOS at 0 cost
+        lp_fin = np.full((B, W, V), -np.inf, np.float32)
+        lp_fin[:, :, bsd.end_token] = 0.0
+        lp = np.where(finished[:, :, None], lp_fin, lp)
+        total = scores[:, :, None] + lp                # [B, W, V]
+        flat = total.reshape(B, -1)
+        top = np.argsort(-flat, axis=1)[:, :W]
+        scores = np.take_along_axis(flat, top, 1)
+        parent = top // V
+        tok = (top % V).astype(np.int64)
+        finished = np.take_along_axis(finished, parent, 1) | (
+            tok == bsd.end_token)
+        # reorder states by parent beam
+        idx = (np.arange(B)[:, None] * W + parent).reshape(-1)
+        states = jax.tree_util.tree_map(
+            lambda a: (a._data if isinstance(a, Tensor) else a)[idx], states)
+        ids_hist.append((tok.copy(), parent.copy()))
+        if finished.all():
+            break
+
+    # backtrack through parents
+    T = len(ids_hist)
+    out = np.zeros((B, W, T), np.int64)
+    beam = np.tile(np.arange(W), (B, 1))
+    for t in range(T - 1, -1, -1):
+        tok_t, par_t = ids_hist[t]
+        out[:, :, t] = np.take_along_axis(tok_t, beam, 1)
+        beam = np.take_along_axis(par_t, beam, 1)
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(scores))

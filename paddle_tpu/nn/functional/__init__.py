@@ -1412,3 +1412,9 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name
         return out[:, :, pd[0] : pd[0] + os[0], pd[1] : pd[1] + os[1]]
 
     return apply(fn, x, name="fold")
+
+
+from .extras import *  # noqa: E402,F401,F403
+from .extras import __all__ as _extras_all  # noqa: E402
+
+__all__ += list(_extras_all)

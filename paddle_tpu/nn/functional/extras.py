@@ -1,0 +1,546 @@
+"""nn.functional long tail (reference: python/paddle/nn/functional/ —
+pooling.py adaptive/unpool variants, loss.py margin losses + rnnt,
+common.py unfold/bilinear/class_center_sample, input.py,
+extension ops gather_tree / sparse_attention / diag_embed).
+
+TPU-native formulations throughout: unpool is a flat scatter, unfold is
+XLA's conv_general_dilated_patches, RNN-T loss is an anti-diagonal-free
+two-scan DP in log space, sparse_attention gathers the CSR column set per
+query row (O(S*nnz), MXU-batched)."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...core.dispatch import apply
+from ...core import random as _rng
+
+__all__ = [
+    "adaptive_max_pool1d", "adaptive_max_pool3d", "bilinear",
+    "class_center_sample", "diag_embed", "dice_loss", "elu_", "gather_tree",
+    "hsigmoid_loss", "margin_cross_entropy", "max_unpool1d", "max_unpool2d",
+    "max_unpool3d", "multi_label_soft_margin_loss", "multi_margin_loss",
+    "pairwise_distance", "relu_", "rnnt_loss", "soft_margin_loss",
+    "softmax_", "sparse_attention", "tanh_",
+    "triplet_margin_with_distance_loss", "unfold", "zeropad2d",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _reduce(val, reduction):
+    if reduction == "mean":
+        return val.mean()
+    if reduction == "sum":
+        return val.sum()
+    return val
+
+
+# -- pooling ----------------------------------------------------------------
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    from . import _adaptive_pool
+
+    return _adaptive_pool(x, output_size, 1, "max", "NCL")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    from . import _adaptive_pool
+
+    return _adaptive_pool(x, output_size, 3, "max", "NCDHW")
+
+
+def _max_unpool(x, indices, nd, kernel_size, stride, padding, output_size,
+                data_format):
+    """Shared unpool core: indices are flat positions into the pooled
+    input's spatial volume (the return_mask convention of max_poolNd)."""
+    stride = stride or kernel_size
+
+    def _tup(v):
+        return (v,) * nd if isinstance(v, int) else tuple(v)
+
+    ks, st, pd = _tup(kernel_size), _tup(stride), _tup(padding)
+
+    def fn(a, idx):
+        lead = a.shape[:2]
+        in_sp = a.shape[2:]
+        if output_size is not None:
+            out_sp = tuple(output_size[-nd:])
+        else:
+            out_sp = tuple((in_sp[i] - 1) * st[i] - 2 * pd[i] + ks[i]
+                           for i in range(nd))
+        vol = int(np.prod(out_sp))
+        flat = jnp.zeros(lead + (vol,), a.dtype)
+        a_flat = a.reshape(lead + (-1,))
+        i_flat = idx.reshape(lead + (-1,)).astype(jnp.int32)
+        b = jnp.arange(lead[0])[:, None, None]
+        c = jnp.arange(lead[1])[None, :, None]
+        flat = flat.at[b, c, i_flat].set(a_flat)
+        return flat.reshape(lead + out_sp)
+
+    return apply(fn, _t(x), _t(indices), name=f"max_unpool{nd}d")
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool(x, indices, 1, kernel_size, stride, padding,
+                       output_size, data_format)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 2, kernel_size, stride, padding,
+                       output_size, data_format)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 3, kernel_size, stride, padding,
+                       output_size, data_format)
+
+
+# -- shape / common ---------------------------------------------------------
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference unfold / im2col op): [N, C, H, W] ->
+    [N, C*kh*kw, L]. One XLA patch-extraction op — the contraction partner
+    rides the MXU."""
+
+    def _tup(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    kh, kw = _tup(kernel_sizes)
+    sh, sw = _tup(strides)
+    dh, dw = _tup(dilations)
+    p = paddings
+    if isinstance(p, int):
+        pads = ((p, p), (p, p))
+    elif len(p) == 2:
+        pads = ((p[0], p[0]), (p[1], p[1]))
+    else:
+        pads = ((p[0], p[2]), (p[1], p[3]))
+
+    def fn(a):
+        patches = jax.lax.conv_general_dilated_patches(
+            a, (kh, kw), (sh, sw), pads, rhs_dilation=(dh, dw),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        n, ckk, oh, ow = patches.shape
+        return patches.reshape(n, ckk, oh * ow)
+
+    return apply(fn, _t(x), name="unfold")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    p = (padding,) * 4 if isinstance(padding, int) else tuple(padding)
+
+    def fn(a):
+        # padding order (reference): [left, right, top, bottom]
+        if data_format == "NCHW":
+            cfg = ((0, 0), (0, 0), (p[2], p[3]), (p[0], p[1]))
+        else:
+            cfg = ((0, 0), (p[2], p[3]), (p[0], p[1]), (0, 0))
+        return jnp.pad(a, cfg)
+
+    return apply(fn, _t(x), name="zeropad2d")
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    def fn(a):
+        n = a.shape[-1]
+        size = n + abs(offset)
+        out = jnp.zeros(a.shape[:-1] + (size, size), a.dtype)
+        r = jnp.arange(n) + max(-offset, 0)
+        c = jnp.arange(n) + max(offset, 0)
+        out = out.at[..., r, c].set(a)
+        nd = out.ndim
+        d1, d2 = dim1 % nd, dim2 % nd
+        # the ROW axis of the embedded matrix goes to dim1, the COLUMN axis
+        # to dim2 — so swapped dims transpose the result
+        order = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+        first, second = ((d1, nd - 2), (d2, nd - 1)) if d1 < d2 else \
+            ((d2, nd - 1), (d1, nd - 2))
+        order.insert(first[0], first[1])
+        order.insert(second[0], second[1])
+        return jnp.transpose(out, order)
+
+    return apply(fn, _t(input), name="diag_embed")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """out[b, o] = x1[b, :] @ W[o] @ x2[b, :] + bias (reference
+    bilinear_tensor_product op) — one einsum on the MXU."""
+    args = [_t(x1), _t(x2), _t(weight)]
+    if bias is not None:
+        args.append(_t(bias))
+
+    def fn(a, b, w, *rest):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+
+    return apply(fn, *args, name="bilinear")
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def fn(a, b):
+        d = a - b + epsilon
+        return jnp.linalg.norm(d, ord=p, axis=-1, keepdims=keepdim)
+
+    return apply(fn, _t(x), _t(y), name="pairwise_distance")
+
+
+# -- inplace activations ----------------------------------------------------
+
+def _inplace_act(fn_name):
+    from ...ops._inplace import make_inplace
+
+    def call(snap, *a, **k):
+        import paddle_tpu.nn.functional as _F
+
+        return getattr(_F, fn_name)(snap, *a, **k)
+
+    return make_inplace(call, name=fn_name + "_")
+
+
+relu_ = _inplace_act("relu")
+elu_ = _inplace_act("elu")
+tanh_ = _inplace_act("tanh")
+softmax_ = _inplace_act("softmax")
+
+
+# -- losses -----------------------------------------------------------------
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    out = apply(lambda x, y: jnp.log1p(jnp.exp(-y * x)), _t(input), _t(label),
+                name="soft_margin_loss")
+    return _reduce(out, reduction)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    args = [_t(input), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+
+    def fn(x, y, *w):
+        per = -(y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x))
+        if w:
+            per = per * w[0]
+        return per.mean(-1)
+
+    return _reduce(apply(fn, *args, name="multi_label_soft_margin_loss"),
+                   reduction)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    args = [_t(input), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+
+    def fn(x, y, *w):
+        n, c = x.shape
+        correct = jnp.take_along_axis(x, y[:, None].astype(jnp.int32), 1)
+        m = jnp.maximum(0.0, margin - correct + x) ** p
+        if w:
+            m = m * w[0][y.astype(jnp.int32)][:, None]
+        mask = jax.nn.one_hot(y.astype(jnp.int32), c, dtype=x.dtype)
+        return ((1 - mask) * m).sum(-1) / c
+
+    return _reduce(apply(fn, *args, name="multi_margin_loss"), reduction)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    dfn = distance_function or (lambda a, b: pairwise_distance(a, b))
+    dp = dfn(_t(input), _t(positive))
+    dn = dfn(_t(input), _t(negative))
+    if swap:
+        dpn = dfn(_t(positive), _t(negative))
+        dn = apply(lambda a, b: jnp.minimum(a, b), dn, dpn, name="min_swap")
+    out = apply(lambda a, b: jnp.maximum(a - b + margin, 0.0), dp, dn,
+                name="triplet_margin_with_distance_loss")
+    return _reduce(out, reduction)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """1 - 2|X∩Y| / (|X|+|Y|) over the prob of the labeled class
+    (reference nn/functional/loss.py dice_loss)."""
+
+    def fn(x, y):
+        yi = y.astype(jnp.int32)
+        if yi.ndim == x.ndim:
+            yi = yi[..., 0]
+        onehot = jax.nn.one_hot(yi, x.shape[-1], dtype=x.dtype)
+        reduce_dims = tuple(range(1, x.ndim))
+        inter = jnp.sum(x * onehot, axis=reduce_dims)
+        union = jnp.sum(x, axis=reduce_dims) + jnp.sum(onehot, axis=reduce_dims)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+
+    return apply(fn, _t(input), _t(label), name="dice_loss")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False, name=None):
+    """Hierarchical sigmoid loss (reference hsigmoid_loss /
+    hierarchical_sigmoid op). Default complete-binary-tree coding; custom
+    trees via path_table/path_code (padded with -1)."""
+    if path_table is None:
+        # complete binary tree over num_classes leaves: internal nodes
+        # 0..num_classes-2; leaf c maps to tree node c + num_classes - 1
+        depth = max(1, int(math.ceil(math.log2(max(2, num_classes)))))
+        tables, codes = [], []
+        for c in range(num_classes):
+            node = c + num_classes - 1
+            tab, code = [], []
+            while node > 0:
+                parent = (node - 1) // 2
+                tab.append(parent)
+                code.append(node == 2 * parent + 2)  # right child -> 1
+                node = parent
+            tab = tab[::-1][:depth] + [-1] * (depth - len(tab))
+            code = code[::-1][:depth] + [False] * (depth - len(code))
+            tables.append(tab)
+            codes.append([int(v) for v in code])
+        path_table = jnp.asarray(tables, jnp.int32)
+        path_code = jnp.asarray(codes, jnp.int32)
+    else:
+        path_table = jnp.asarray(
+            path_table._data if isinstance(path_table, Tensor) else path_table,
+            jnp.int32)
+        path_code = jnp.asarray(
+            path_code._data if isinstance(path_code, Tensor) else path_code,
+            jnp.int32)
+
+    args = [_t(input), _t(label), _t(weight)]
+    if bias is not None:
+        args.append(_t(bias))
+
+    def fn(x, y, w, *b):
+        yi = y.reshape(-1).astype(jnp.int32)
+        tab = path_table[yi]                     # [B, D]
+        code = path_code[yi].astype(x.dtype)     # [B, D]
+        valid = (tab >= 0).astype(x.dtype)
+        tab = jnp.maximum(tab, 0)
+        wv = w[tab]                              # [B, D, F]
+        logits = jnp.einsum("bdf,bf->bd", wv, x)
+        if b:
+            logits = logits + b[0].reshape(-1)[tab]
+        # BCE with code as target, only over valid path entries
+        per = -(code * jax.nn.log_sigmoid(logits)
+                + (1 - code) * jax.nn.log_sigmoid(-logits))
+        return (per * valid).sum(-1, keepdims=True)
+
+    return apply(fn, *args, name="hsigmoid_loss")
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean", name=None):
+    """ArcFace/CosFace-style margin softmax CE (reference
+    margin_cross_entropy op): cos(m1*θ + m2) - m3 on the target logit,
+    then scaled softmax CE. logits must be cosine similarities."""
+
+    def fn(x, y):
+        yi = y.reshape(-1).astype(jnp.int32)
+        x32 = x.astype(jnp.float32)
+        target = jnp.take_along_axis(x32, yi[:, None], 1)[:, 0]
+        theta = jnp.arccos(jnp.clip(target, -1.0, 1.0))
+        m_target = jnp.cos(margin1 * theta + margin2) - margin3
+        onehot = jax.nn.one_hot(yi, x.shape[-1], dtype=x32.dtype)
+        adj = x32 * (1 - onehot) + m_target[:, None] * onehot
+        adj = adj * scale
+        lse = jax.nn.logsumexp(adj, axis=-1)
+        loss = lse - jnp.take_along_axis(adj, yi[:, None], 1)[:, 0]
+        sm = jax.nn.softmax(adj, axis=-1)
+        return loss[:, None], sm
+
+    loss, sm = apply(fn, _t(logits), _t(label), name="margin_cross_entropy")
+    loss = _reduce(loss, reduction)
+    return (loss, sm) if return_softmax else loss
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample negative class centers (PartialFC; reference
+    class_center_sample op): keep all positive classes plus uniform
+    negatives up to num_samples; remap labels into the sampled index
+    space. Host-side (dynamic unique set), like the reference CPU path."""
+    lab = np.asarray(label._data if isinstance(label, Tensor) else label
+                     ).reshape(-1).astype(np.int64)
+    pos = np.unique(lab)
+    n_extra = max(0, num_samples - len(pos))
+    rest = np.setdiff1d(np.arange(num_classes, dtype=np.int64), pos,
+                        assume_unique=True)
+    seed = int(np.asarray(_rng.next_key())[-1]) % (2 ** 31)
+    rng = np.random.RandomState(seed)
+    extra = rng.choice(rest, size=min(n_extra, len(rest)), replace=False) \
+        if n_extra and len(rest) else np.zeros((0,), np.int64)
+    sampled = np.concatenate([pos, np.sort(extra)])
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return (Tensor(jnp.asarray(remap[lab])),
+            Tensor(jnp.asarray(sampled)))
+
+
+# -- beam search / sequence -------------------------------------------------
+
+def gather_tree(ids, parents):
+    """Reconstruct full beam paths from per-step parent pointers
+    (reference gather_tree op): walk ancestry backward with one lax.scan.
+    ids/parents: [T, B, W] -> [T, B, W]."""
+
+    def fn(idv, par):
+        T = idv.shape[0]
+
+        def step(beam, t):
+            # beam: [B, W] current beam slot per output position
+            tok = jnp.take_along_axis(idv[t], beam, axis=-1)
+            nxt = jnp.take_along_axis(par[t], beam, axis=-1)
+            return nxt.astype(beam.dtype), tok
+
+        w = idv.shape[-1]
+        init = jnp.broadcast_to(jnp.arange(w, dtype=idv.dtype),
+                                idv.shape[1:])
+        _, toks = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+        return toks[::-1]
+
+    return apply(fn, _t(ids), _t(parents), name="gather_tree")
+
+
+# -- RNN-T loss -------------------------------------------------------------
+
+def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.0, reduction="mean", name=None):
+    """RNN-Transducer loss (reference warprnnt / rnnt_loss op). Log-space
+    forward DP over the (T, U) lattice: alpha computed by a lax.scan over
+    T with a nested associative scan-free row update over U — static
+    shapes, masked for per-sample lengths.
+
+    logits: [B, T, U+1, V]; labels: [B, U] int32.
+    """
+
+    def fn(lg, lab, t_len, u_len):
+        b, T, U1, V = lg.shape
+        U = U1 - 1
+        logp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
+        blank_lp = logp[..., blank]                       # [B, T, U+1]
+        lab_i = lab.astype(jnp.int32)
+        # emit log-prob at (t, u): P(label_u | t, u), u in [0, U)
+        emit_lp = jnp.take_along_axis(
+            logp[:, :, :U, :], lab_i[:, None, :, None], axis=-1)[..., 0]
+        if fastemit_lambda > 0.0:
+            # FastEmit (arXiv:2010.11148): scale the EMISSION-arc gradient
+            # by (1 + lambda) — identity forward, so the reported loss is
+            # the plain RNN-T nll, but training pushes emissions earlier.
+            @jax.custom_vjp
+            def _scale_grad(v):
+                return v
+
+            _scale_grad.defvjp(lambda v: (v, None),
+                               lambda _, g: ((1.0 + fastemit_lambda) * g,))
+            emit_lp = _scale_grad(emit_lp)
+        neg = jnp.float32(-1e30)
+
+        def time_step(alpha_prev, t):
+            # alpha_prev: [B, U+1] at time t-1 (or init); returns alpha at t
+            from_left = alpha_prev + blank_lp[:, t - 1, :]
+
+            def u_step(carry, u):
+                # carry: alpha[t, u-1]; emit from (t, u-1) -> (t, u)
+                val = jnp.where(
+                    u == 0, from_left[:, 0],
+                    jnp.logaddexp(
+                        from_left[jnp.arange(b), jnp.minimum(u, U1 - 1)],
+                        carry + jnp.where(
+                            u > 0,
+                            emit_lp[jnp.arange(b), t,
+                                    jnp.maximum(u - 1, 0)], neg)))
+                return val, val
+
+            _, cols = jax.lax.scan(u_step, jnp.full((b,), neg),
+                                   jnp.arange(U1))
+            return cols.T, None                            # [B, U+1]
+
+        # t = 0 row: only emissions along u
+        def u0_step(carry, u):
+            val = jnp.where(u == 0, 0.0,
+                            carry + emit_lp[jnp.arange(b), 0,
+                                            jnp.maximum(u - 1, 0)])
+            return val, val
+
+        _, row0 = jax.lax.scan(u0_step, jnp.zeros((b,)), jnp.arange(U1))
+        alpha0 = row0.T
+
+        def scan_t(alpha, t):
+            nxt, _ = time_step(alpha, t)
+            return nxt, nxt
+
+        alpha_T, rows = jax.lax.scan(scan_t, alpha0, jnp.arange(1, T))
+        all_rows = jnp.concatenate([alpha0[None], rows], 0)  # [T, B, U+1]
+        t_idx = (t_len - 1).astype(jnp.int32)
+        u_idx = u_len.astype(jnp.int32)
+        final = all_rows[t_idx, jnp.arange(b), u_idx]
+        final_blank = blank_lp[jnp.arange(b), t_idx, u_idx]
+        nll = -(final + final_blank)
+        return nll
+
+    out = apply(fn, _t(logits), _t(labels), _t(logit_lengths),
+                _t(label_lengths), name="rnnt_loss")
+    return _reduce(out, reduction)
+
+
+# -- sparse attention -------------------------------------------------------
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Block-sparse attention with a CSR pattern (reference
+    sparse_attention op, CUDA-only there). TPU-native: pad each query
+    row's column set to the max row degree and GATHER the K/V rows —
+    O(S * max_nnz) compute/memory, batched on the MXU.
+
+    query/key/value: [B, H, S, D]; offset: [B, H, S+1]; columns:
+    [B, H, nnz] (both int32).
+    """
+    off = np.asarray(sparse_csr_offset._data if isinstance(
+        sparse_csr_offset, Tensor) else sparse_csr_offset)
+    col = np.asarray(sparse_csr_columns._data if isinstance(
+        sparse_csr_columns, Tensor) else sparse_csr_columns)
+    b, h, s1 = off.shape
+    s = s1 - 1
+    deg = off[..., 1:] - off[..., :-1]                 # [B, H, S]
+    max_deg = int(deg.max()) if deg.size else 1
+    # padded per-row column index + validity mask (host-side: the CSR
+    # pattern is static metadata, same stance as the reference op's host
+    # descriptor)
+    cols_pad = np.zeros((b, h, s, max_deg), np.int32)
+    mask_pad = np.zeros((b, h, s, max_deg), bool)
+    for bi in range(b):
+        for hi in range(h):
+            for si in range(s):
+                lo, hi_ = off[bi, hi, si], off[bi, hi, si + 1]
+                n = hi_ - lo
+                cols_pad[bi, hi, si, :n] = col[bi, hi, lo:hi_]
+                mask_pad[bi, hi, si, :n] = True
+    cols_j = jnp.asarray(cols_pad)
+    mask_j = jnp.asarray(mask_pad)
+
+    def fn(q, k, v):
+        d = q.shape[-1]
+        kg = jnp.take_along_axis(k[:, :, None], cols_j[..., None], axis=3)
+        vg = jnp.take_along_axis(v[:, :, None], cols_j[..., None], axis=3)
+        logits = jnp.einsum("bhsd,bhsnd->bhsn", q, kg,
+                            preferred_element_type=jnp.float32)
+        logits = logits / math.sqrt(d)
+        logits = jnp.where(mask_j, logits, -1e30)
+        p = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("bhsn,bhsnd->bhsd", p.astype(v.dtype), vg)
+
+    return apply(fn, _t(query), _t(key), _t(value), name="sparse_attention")

@@ -11,6 +11,7 @@ import jax.numpy as jnp
 
 from ..core.tensor import Tensor
 from ..core.dispatch import apply
+from ._inplace import _autograd_snapshot, _inplace_rebind, make_inplace
 
 from .creation import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403
@@ -123,33 +124,6 @@ def _setitem(self, item, value):
     # tensor; autograd-wise the tensor now points at the new producing node,
     # whose recorded input is the frozen snapshot).
     _inplace_rebind(self, out)
-
-
-def _autograd_snapshot(x):
-    """Frozen pre-mutation view for recording an inplace op: the node must
-    hold a Tensor whose _data/_version never change afterwards (the lazy
-    pullback re-reads input _data at backward; the version guard enforces
-    it). Mirrors the reference contract: inplace on a grad-requiring LEAF
-    is an error (eager_method.cc inplace checks / torch semantics)."""
-    from ..autograd import tape
-
-    if (tape.is_grad_enabled() and not x.stop_gradient
-            and getattr(x, "_grad_node", None) is None):
-        raise RuntimeError(
-            "a leaf Tensor that requires grad is being used in an in-place "
-            "operation; operate on a computed value or use no_grad()")
-    snap = Tensor(x._data, stop_gradient=x.stop_gradient)
-    snap._grad_node = getattr(x, "_grad_node", None)
-    snap._out_index = getattr(x, "_out_index", 0)
-    return snap
-
-
-def _inplace_rebind(x, out):
-    x._data = out._data            # bumps the inplace version
-    x._grad_node = out._grad_node
-    x._out_index = out._out_index
-    if not out.stop_gradient:
-        x.stop_gradient = False
 
 
 _METHODS = {}

@@ -1,0 +1,50 @@
+"""Inplace-op contract shared by Tensor-method variants (ops.extras),
+the functional variants (nn.functional.extras), and __setitem__
+(ops/__init__): record the op against a FROZEN pre-mutation snapshot,
+then rebind the mutated tensor to the producing node. Split into its own
+module so extras can import it during the ops package's own import."""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+
+__all__ = ["_autograd_snapshot", "_inplace_rebind", "make_inplace"]
+
+
+def _autograd_snapshot(x):
+    """Frozen pre-mutation view for recording an inplace op: the node must
+    hold a Tensor whose _data/_version never change afterwards (the lazy
+    pullback re-reads input _data at backward; the version guard enforces
+    it). Mirrors the reference contract: inplace on a grad-requiring LEAF
+    is an error (eager_method.cc inplace checks / torch semantics)."""
+    from ..autograd import tape
+
+    if (tape.is_grad_enabled() and not x.stop_gradient
+            and getattr(x, "_grad_node", None) is None):
+        raise RuntimeError(
+            "a leaf Tensor that requires grad is being used in an in-place "
+            "operation; operate on a computed value or use no_grad()")
+    snap = Tensor(x._data, stop_gradient=x.stop_gradient)
+    snap._grad_node = getattr(x, "_grad_node", None)
+    snap._out_index = getattr(x, "_out_index", 0)
+    return snap
+
+
+def _inplace_rebind(x, out):
+    x._data = out._data            # bumps the inplace version
+    x._grad_node = out._grad_node
+    x._out_index = out._out_index
+    if not out.stop_gradient:
+        x.stop_gradient = False
+
+
+def make_inplace(fn, name=None):
+    """fn(snapshot, *args, **kwargs) -> Tensor; returns the inplace op."""
+
+    def op(x, *a, **k):
+        snap = _autograd_snapshot(x)
+        out = fn(snap, *a, **k)
+        _inplace_rebind(x, out)
+        return x
+
+    op.__name__ = name or getattr(fn, "__name__", "op") + "_"
+    return op

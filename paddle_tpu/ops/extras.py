@@ -172,7 +172,11 @@ def count_nonzero(x, axis=None, keepdim=False, name=None):
 
 
 def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
-    return apply(lambda a: jnp.nansum(a, axis=axis, keepdims=keepdim),
+    from ..core.dtype import convert_dtype
+
+    d = convert_dtype(dtype) if dtype is not None else None
+    return apply(lambda a: jnp.nansum(a if d is None else a.astype(d),
+                                      axis=axis, keepdims=keepdim),
                  _t(x), name="nansum")
 
 
@@ -367,18 +371,31 @@ def reverse(x, axis, name=None):
 
 
 def take(x, index, mode="raise", name=None):
+    xt, it = _t(x), _t(index)
+    n_total = int(np.prod(xt.shape)) if xt.ndim else 1
+    if mode == "raise" and not isinstance(it._data, jax.core.Tracer):
+        # reference CPU contract: out-of-range raises. Under a jit trace
+        # values are unknown; indices clamp (XLA gather semantics), same
+        # as the reference GPU kernel which cannot raise either.
+        inp = np.asarray(it._data)
+        if inp.size and (int(inp.min()) < -n_total or
+                         int(inp.max()) >= n_total):
+            raise ValueError(
+                f"take index out of range for tensor of {n_total} elements "
+                f"(got [{int(inp.min())}, {int(inp.max())}])")
+
     def fn(a, idx):
         flat = a.reshape(-1)
         n = flat.shape[0]
         ii = idx.astype(jnp.int32)
         if mode == "wrap":
             ii = jnp.mod(ii, n)
-        elif mode == "clip":
+        else:
             ii = jnp.clip(ii, -n, n - 1)
         ii = jnp.where(ii < 0, ii + n, ii)
         return flat[ii]
 
-    return apply(fn, _t(x), _t(index), name="take")
+    return apply(fn, xt, it, name="take")
 
 
 def tril_indices(row, col=None, offset=0, dtype="int64"):
@@ -492,16 +509,10 @@ def _inplace_variant(meth_name):
     the op silently drops out of the autograd graph and backward uses the
     OLD producer's pullback (wrong gradients, no error)."""
 
-    def op(x, *a, **k):
-        from . import _autograd_snapshot, _inplace_rebind
+    from ._inplace import make_inplace
 
-        snap = _autograd_snapshot(x)
-        out = getattr(snap, meth_name)(*a, **k)
-        _inplace_rebind(x, out)
-        return x
-
-    op.__name__ = meth_name + "_"
-    return op
+    return make_inplace(lambda snap, *a, **k: getattr(snap, meth_name)(*a, **k),
+                        name=meth_name + "_")
 
 
 reshape_ = _inplace_variant("reshape")

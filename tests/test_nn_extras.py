@@ -1,0 +1,317 @@
+"""nn/nn.functional long-tail surface (reference: python/paddle/nn/
+functional pooling/loss/common extension ops + nn/decode.py)."""
+import re
+import pathlib
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def test_reference_nn_namespaces_covered():
+    for mod, ref in [(nn, "/root/reference/python/paddle/nn/__init__.py"),
+                     (F, "/root/reference/python/paddle/nn/functional/__init__.py")]:
+        p = pathlib.Path(ref)
+        if not p.exists():
+            pytest.skip("reference tree not available")
+        names = set(re.findall(r"^\s+'([A-Za-z_0-9]+)',", p.read_text(), re.M))
+        missing = sorted(n for n in names if not hasattr(mod, n))
+        assert missing == [], missing
+
+
+def test_max_unpool2d_inverts_max_pool2d():
+    rs = np.random.RandomState(0)
+    # positive values: the zero-filled background must not beat any max
+    # when re-pooling the unpooled map
+    x = np.abs(rs.randn(2, 3, 8, 8)).astype("float32") + 0.1
+    pooled, mask = F.max_pool2d(_t(x), 2, stride=2, return_mask=True)
+    un = F.max_unpool2d(pooled, mask, 2, stride=2)
+    assert un.shape == (2, 3, 8, 8)
+    # every pooled max value lands back at its argmax position
+    got = un.numpy()
+    assert np.allclose(np.sort(got[got != 0]), np.sort(pooled.numpy().ravel()))
+    re_pooled = F.max_pool2d(un, 2, stride=2)
+    np.testing.assert_allclose(re_pooled.numpy(), pooled.numpy())
+
+
+def test_adaptive_max_pool_1d_3d():
+    rs = np.random.RandomState(1)
+    a = rs.randn(2, 3, 12).astype("float32")
+    o = F.adaptive_max_pool1d(_t(a), 4)
+    np.testing.assert_allclose(o.numpy(), a.reshape(2, 3, 4, 3).max(-1))
+    b = rs.randn(1, 2, 4, 4, 4).astype("float32")
+    o3 = F.adaptive_max_pool3d(_t(b), 2)
+    assert o3.shape == (1, 2, 2, 2, 2)
+
+
+def test_unfold_matches_manual_patches():
+    rs = np.random.RandomState(2)
+    x = rs.randn(1, 2, 4, 4).astype("float32")
+    out = F.unfold(_t(x), 2, strides=2)
+    assert out.shape == (1, 2 * 2 * 2, 4)
+    # first patch, first channel
+    np.testing.assert_allclose(out.numpy()[0, :4, 0],
+                               x[0, 0, :2, :2].ravel(), rtol=1e-6)
+
+
+def test_zeropad2d_and_layer():
+    x = _t(np.ones((1, 1, 2, 2), np.float32))
+    y = F.zeropad2d(x, [1, 2, 3, 4])
+    assert y.shape == (1, 1, 2 + 3 + 4, 2 + 1 + 2)
+    assert float(y.numpy().sum()) == 4.0
+    assert nn.ZeroPad2D(1)(x).shape == (1, 1, 4, 4)
+
+
+def test_diag_embed():
+    v = _t(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    d = F.diag_embed(v)
+    assert d.shape == (2, 2, 2)
+    np.testing.assert_allclose(d.numpy()[0], np.diag([1.0, 2.0]))
+    d2 = F.diag_embed(v, offset=1)
+    assert d2.shape == (2, 3, 3)
+    np.testing.assert_allclose(d2.numpy()[1], np.diag([3.0, 4.0], k=1))
+
+
+def test_bilinear_layer_and_functional():
+    rs = np.random.RandomState(3)
+    x1 = rs.randn(4, 3).astype("float32")
+    x2 = rs.randn(4, 5).astype("float32")
+    w = rs.randn(2, 3, 5).astype("float32")
+    b = rs.randn(2).astype("float32")
+    out = F.bilinear(_t(x1), _t(x2), _t(w), _t(b))
+    ref = np.einsum("bi,oij,bj->bo", x1, w, x2) + b
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4)
+    layer = nn.Bilinear(3, 5, 2)
+    assert layer(_t(x1), _t(x2)).shape == (4, 2)
+
+
+def test_pairwise_distance():
+    a = np.array([[0.0, 0.0], [1.0, 1.0]], np.float32)
+    b = np.array([[3.0, 4.0], [1.0, 1.0]], np.float32)
+    d = F.pairwise_distance(_t(a), _t(b))
+    np.testing.assert_allclose(d.numpy(), [5.0, np.sqrt(2) * 1e-6], atol=1e-4)
+    assert nn.PairwiseDistance()(_t(a), _t(b)).shape == (2,)
+
+
+def test_margin_losses_match_formulas():
+    rs = np.random.RandomState(4)
+    x = rs.randn(6).astype("float32")
+    y = np.sign(rs.randn(6)).astype("float32")
+    got = F.soft_margin_loss(_t(x), _t(y))
+    np.testing.assert_allclose(got.numpy(), np.log1p(np.exp(-y * x)).mean(),
+                               rtol=1e-5)
+    logits = rs.randn(4, 5).astype("float32")
+    multi_y = (rs.rand(4, 5) > 0.5).astype("float32")
+    got = F.multi_label_soft_margin_loss(_t(logits), _t(multi_y))
+    sig = 1 / (1 + np.exp(-logits))
+    ref = -(multi_y * np.log(sig) + (1 - multi_y) * np.log(1 - sig))
+    np.testing.assert_allclose(got.numpy(), ref.mean(-1).mean(), rtol=1e-4)
+    lab = rs.randint(0, 5, 4).astype("int64")
+    got = F.multi_margin_loss(_t(logits), _t(lab))
+    correct = logits[np.arange(4), lab][:, None]
+    m = np.maximum(0, 1 - correct + logits)
+    m[np.arange(4), lab] = 0
+    np.testing.assert_allclose(got.numpy(), (m.sum(-1) / 5).mean(), rtol=1e-4)
+
+
+def test_triplet_and_dice():
+    rs = np.random.RandomState(5)
+    a, p, n = [rs.randn(3, 4).astype("float32") for _ in range(3)]
+    loss = F.triplet_margin_with_distance_loss(_t(a), _t(p), _t(n))
+    dp = np.linalg.norm(a - p + 1e-6, axis=-1)
+    dn = np.linalg.norm(a - n + 1e-6, axis=-1)
+    np.testing.assert_allclose(loss.numpy(), np.maximum(dp - dn + 1, 0).mean(),
+                               rtol=1e-4)
+    probs = np.abs(rs.rand(2, 6, 3)).astype("float32")
+    probs /= probs.sum(-1, keepdims=True)
+    lab = rs.randint(0, 3, (2, 6)).astype("int64")
+    d = F.dice_loss(_t(probs), _t(lab))
+    assert 0.0 <= float(d) <= 1.0
+
+
+def test_hsigmoid_loss_trains():
+    paddle.seed(0)
+    layer = nn.HSigmoidLoss(8, 10)
+    rs = np.random.RandomState(6)
+    x = _t(rs.randn(16, 8).astype("float32"))
+    y = _t(rs.randint(0, 10, (16, 1)).astype("int64"))
+    opt = paddle.optimizer.Adam(learning_rate=0.1,
+                                parameters=layer.parameters())
+    losses = []
+    for _ in range(8):
+        loss = layer(x, y).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_margin_cross_entropy_reduces_to_ce_at_zero_margin():
+    rs = np.random.RandomState(7)
+    cos = np.tanh(rs.randn(4, 6)).astype("float32")  # in [-1, 1]
+    lab = rs.randint(0, 6, (4,)).astype("int64")
+    loss, sm = F.margin_cross_entropy(_t(cos), _t(lab), margin1=1.0,
+                                      margin2=0.0, margin3=0.0, scale=10.0,
+                                      return_softmax=True)
+    z = cos * 10.0
+    lse = np.log(np.exp(z).sum(-1))
+    ref = (lse - z[np.arange(4), lab]).mean()
+    np.testing.assert_allclose(float(loss), ref, rtol=1e-4)
+    np.testing.assert_allclose(sm.numpy().sum(-1), 1.0, rtol=1e-5)
+
+
+def test_class_center_sample():
+    paddle.seed(3)
+    lab = _t(np.array([2, 9, 2, 31], np.int64))
+    remapped, sampled = F.class_center_sample(lab, 40, 8)
+    s = sampled.numpy()
+    assert set([2, 9, 31]).issubset(set(s.tolist()))
+    assert len(s) == 8 and len(set(s.tolist())) == 8
+    r = remapped.numpy()
+    np.testing.assert_array_equal(s[r], lab.numpy())
+
+
+def test_gather_tree():
+    # T=3, B=1, W=2 beams
+    ids = np.array([[[1, 2]], [[3, 4]], [[5, 6]]], np.int32)
+    parents = np.array([[[0, 0]], [[0, 0]], [[1, 0]]], np.int32)
+    out = F.gather_tree(_t(ids), _t(parents)).numpy()
+    # beam 0 at t=2 came from parent 1: path ids (1->4->5)... verify chain
+    np.testing.assert_array_equal(out[:, 0, 0], [1, 4, 5])
+    np.testing.assert_array_equal(out[:, 0, 1], [1, 3, 6])
+
+
+def test_rnnt_loss_two_frame_oracle():
+    # T=2, U=1, V=2 (blank=0, one label=1): enumerate the two paths
+    logp = np.log(np.array([
+        # t=0: u=0 [blank, emit], u=1 [blank, emit]
+        [[0.6, 0.4], [0.5, 0.5]],
+        # t=1
+        [[0.7, 0.3], [0.8, 0.2]],
+    ], np.float32))
+    logits = logp[None]                   # [1, T, U+1, V] (already log-probs)
+    labels = np.array([[1]], np.int32)
+    loss = F.rnnt_loss(_t(logits), _t(labels), _t(np.array([2], np.int32)),
+                       _t(np.array([1], np.int32)), reduction="none")
+    # paths: emit@t0->blank@t1(u=1)->final blank ; blank@t0->emit@t1->final
+    p1 = 0.4 * 0.8
+    p2 = 0.6 * 0.3
+    # final blank consumed at (t=T-1, u=U) once reached: path1 ends at
+    # (t1,u1) then blank(0.8)... enumerate exactly:
+    #   emit(t0,u0)=0.4 -> at (t0,u1); blank(t0,u1)=0.5 -> t1,u1; final blank(t1,u1)=0.8
+    #   emit(t0)=0.4 -> blank 0.5 -> 0.8: 0.16
+    #   blank(t0,u0)=0.6 -> emit(t1,u0)=0.3 -> final blank(t1,u1)=0.8: 0.144
+    total = 0.4 * 0.5 * 0.8 + 0.6 * 0.3 * 0.8
+    np.testing.assert_allclose(float(loss), -np.log(total), rtol=1e-4)
+
+
+def test_sparse_attention_matches_masked_dense():
+    rs = np.random.RandomState(8)
+    b, h, s, d = 1, 1, 4, 8
+    q, k, v = [rs.randn(b, h, s, d).astype("float32") for _ in range(3)]
+    # causal CSR pattern
+    offset = np.array([[[0, 1, 3, 6, 10]]], np.int32)
+    cols = np.array([[[0, 0, 1, 0, 1, 2, 0, 1, 2, 3]]], np.int32)
+    out = F.sparse_attention(_t(q), _t(k), _t(v), _t(offset), _t(cols))
+    logits = (q @ k.transpose(0, 1, 3, 2)) / np.sqrt(d)
+    mask = np.tril(np.ones((s, s), bool))
+    logits = np.where(mask, logits, -1e30)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = p @ v
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_inplace_activations():
+    w = _t(np.array([-1.0, 2.0], np.float32))
+    a = w * 1.0
+    F.relu_(a)
+    np.testing.assert_allclose(a.numpy(), [0.0, 2.0])
+    b = _t(np.array([0.3, 0.7], np.float32)) * 1.0
+    F.softmax_(b)
+    np.testing.assert_allclose(b.numpy().sum(), 1.0, rtol=1e-6)
+
+
+def test_channel_pixel_shuffle_layers():
+    rs = np.random.RandomState(9)
+    x = _t(rs.randn(1, 4, 2, 2).astype("float32"))
+    ps = nn.PixelShuffle(2)(x)
+    assert ps.shape == (1, 1, 4, 4)
+    pu = nn.PixelUnshuffle(2)(ps)
+    np.testing.assert_allclose(pu.numpy(), x.numpy())
+    cs = nn.ChannelShuffle(2)(x)
+    np.testing.assert_allclose(cs.numpy()[0, 1], x.numpy()[0, 2])
+    s2d = nn.Softmax2D()(x)
+    np.testing.assert_allclose(s2d.numpy().sum(1), 1.0, rtol=1e-5)
+
+
+def test_beam_search_decoder_dynamic_decode():
+    """Greedy-dominant logits: beam search must recover the argmax chain."""
+    paddle.seed(0)
+    V, H = 7, 8
+    cell = nn.SimpleRNNCell(H, H)
+    emb = nn.Embedding(V, H)
+    proj = nn.Linear(H, V)
+
+    bsd = nn.BeamSearchDecoder(
+        cell, start_token=1, end_token=2, beam_size=3,
+        embedding_fn=emb, output_fn=proj)
+    states = cell.get_initial_states(2, H)
+    ids, scores = nn.dynamic_decode(bsd, inits=states, max_step_num=5)
+    assert ids.shape[0] == 2 and ids.shape[1] == 3
+    assert scores.shape == (2, 3)
+    s = scores.numpy()
+    assert (np.diff(s, axis=1) <= 1e-5).all()   # beams sorted by score
+
+
+def test_diag_embed_swapped_dims_transpose():
+    v = _t(np.array([[1.0, 2.0]], np.float32))
+    d_default = F.diag_embed(v, offset=1).numpy()
+    d_swapped = F.diag_embed(v, offset=1, dim1=-1, dim2=-2).numpy()
+    np.testing.assert_allclose(d_swapped, d_default.swapaxes(-1, -2))
+    assert not np.allclose(d_swapped, d_default)
+
+
+def test_rnnt_fastemit_scales_emission_grad():
+    rs = np.random.RandomState(11)
+    logits = rs.randn(1, 3, 2, 4).astype("float32")
+    labels = np.array([[1]], np.int32)
+    tl, ul = np.array([3], np.int32), np.array([1], np.int32)
+
+    def grad_of(lmbda):
+        lt = _t(logits)
+        lt.stop_gradient = False
+        loss = F.rnnt_loss(lt, _t(labels), _t(tl), _t(ul),
+                           fastemit_lambda=lmbda)
+        loss.backward()
+        return float(loss), lt.grad.numpy()
+
+    l0, g0 = grad_of(0.0)
+    l1, g1 = grad_of(0.5)
+    np.testing.assert_allclose(l0, l1, rtol=1e-6)   # identity forward
+    assert not np.allclose(g0, g1)                  # regularized backward
+
+
+def test_softmax2d_chw():
+    x = _t(np.random.RandomState(12).randn(3, 4, 4).astype("float32"))
+    out = nn.Softmax2D()(x)
+    np.testing.assert_allclose(out.numpy().sum(0), 1.0, rtol=1e-5)
+
+
+def test_take_raise_and_nansum_dtype():
+    a = _t(np.arange(4, dtype=np.float32))
+    with pytest.raises(ValueError, match="out of range"):
+        paddle.take(a, _t(np.array([4])))
+    big = _t((np.ones(70000) * 300).astype("float16"))
+    exact = paddle.nansum(big, dtype="float32")
+    assert abs(float(exact) - 300.0 * 70000) / (300.0 * 70000) < 1e-3
