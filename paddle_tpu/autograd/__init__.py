@@ -1,6 +1,7 @@
 """Autograd public API (reference: python/paddle/autograd/)."""
 from .tape import (
     backward,
+    saved_tensors_hooks,
     grad,
     no_grad,
     enable_grad,
@@ -12,6 +13,7 @@ from . import functional
 
 __all__ = [
     "backward",
+    "saved_tensors_hooks",
     "grad",
     "no_grad",
     "enable_grad",

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 
 __all__ = [
     "Node",
+    "saved_tensors_hooks",
     "no_grad",
     "enable_grad",
     "is_grad_enabled",
@@ -35,6 +36,7 @@ __all__ = [
 class _GradState(threading.local):
     def __init__(self):
         self.enabled = True
+        self.saved_tensors_hooks = None   # (pack, unpack) or None
 
 
 _state = _GradState()
@@ -98,6 +100,8 @@ class Node:
         "name",
         "fwd_fn",
         "input_versions",
+        "saved_packed",
+        "unpack_hook",
         "__weakref__",
     )
 
@@ -117,6 +121,17 @@ class Node:
         # inplace-version snapshot (reference tensor_wrapper.h): backward
         # errors if a saved input was mutated after this forward recorded.
         self.input_versions = [getattr(t, "_version", 0) for t in inputs]
+        # saved_tensors_hooks (reference autograd/saved_tensors_hooks):
+        # pack() transforms what the node saves, unpack() restores it at
+        # backward — the pullback replays from unpack(packed) instead of
+        # the live tensor's data.
+        hooks = _state.saved_tensors_hooks
+        if hooks is not None:
+            pack, self.unpack_hook = hooks
+            self.saved_packed = [pack(t) for t in inputs]
+        else:
+            self.saved_packed = None
+            self.unpack_hook = None
 
     def check_versions(self):
         for t, v in zip(self.inputs, self.input_versions):
@@ -139,12 +154,55 @@ class Node:
                 raise RuntimeError(
                     f"node {self.name!r} has neither a pullback nor a "
                     "replayable forward")
-            _, self.vjp_fn = jax.vjp(self.fwd_fn,
-                                     *[t._data for t in self.inputs])
+            _, self.vjp_fn = jax.vjp(self.fwd_fn, *self.saved_data())
         return self.vjp_fn
+
+    def saved_data(self):
+        """Primal input arrays for the pullback: unpacked through the
+        saved_tensors_hooks when the node recorded under one."""
+        if self.saved_packed is not None:
+            import jax.numpy as _jnp
+
+            def _arr(v):
+                from ..core.tensor import Tensor as _T
+
+                return v._data if isinstance(v, _T) else _jnp.asarray(v)
+
+            return [_arr(self.unpack_hook(p)) for p in self.saved_packed]
+        return [t._data for t in self.inputs]
 
     def __repr__(self):
         return f"<GradNode {self.name} n_outs={self.n_outs}>"
+
+
+class saved_tensors_hooks:
+    """Context manager installing (pack, unpack) hooks on tensors saved for
+    backward (reference python/paddle/autograd/saved_tensors_hooks.py).
+    pack(tensor) -> object runs at record time; unpack(object) -> tensor/
+    array runs when the node's pullback materializes, and the pullback
+    replays from the UNPACKED data (both plain and create_graph backward).
+
+    Memory note: the graph also keeps the input Tensor handles for
+    topology/accumulation, so a pack hook reduces device memory only for
+    buffers the hook itself releases (e.g. by re-materializing on unpack);
+    it always controls WHAT data first-order backward sees —
+    quantize/dequantize or recompute-from-cheap-state hooks work as in
+    the reference. create_graph=True backward replays from the LIVE saved
+    tensors instead (a host unpack is opaque to second-order tracing);
+    value-identical for round-tripping hooks like host offload.
+    """
+
+    def __init__(self, pack_hook, unpack_hook):
+        self._hooks = (pack_hook, unpack_hook)
+
+    def __enter__(self):
+        self._prev = _state.saved_tensors_hooks
+        _state.saved_tensors_hooks = self._hooks
+        return self
+
+    def __exit__(self, *exc):
+        _state.saved_tensors_hooks = self._prev
+        return False
 
 
 def record(vjp_fn, inputs, input_needs_grad, outputs, name="", fwd_fn=None):

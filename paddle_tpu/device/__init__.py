@@ -125,3 +125,68 @@ def _mem_stat(key):
         return int(stats.get(key, 0)) if stats else 0
     except Exception:
         return 0
+
+
+def get_all_device_type():
+    """Device types visible to the runtime (reference
+    device.get_all_device_type)."""
+    import jax
+
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_all_custom_device_type():
+    return [t for t in get_all_device_type() if t not in ("cpu", "gpu")]
+
+
+def get_available_device():
+    import jax
+
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return [d for d in get_available_device()
+            if not d.startswith(("cpu", "gpu"))]
+
+
+def get_cudnn_version():
+    """No cuDNN on this backend (reference returns None when not compiled
+    with CUDA)."""
+    return None
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_mlu():
+    return False
+
+
+def is_compiled_with_npu():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+from ..framework.compat import XPUPlace, CustomPlace as _CustomPlace  # noqa: E402
+
+
+class IPUPlace(_CustomPlace):
+    def __init__(self, device_id=0):
+        super().__init__("ipu", device_id)
+
+
+class MLUPlace(_CustomPlace):
+    def __init__(self, device_id=0):
+        super().__init__("mlu", device_id)
+
+
+

@@ -108,3 +108,74 @@ def fftshift(x, axes=None, name=None):
 
 def ifftshift(x, axes=None, name=None):
     return apply(lambda a: jnp.fft.ifftshift(a, axes=axes), x, name="ifftshift")
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """Hermitian-input 2-D FFT (reference paddle.fft.hfft2): hfft along the
+    last named axis after fft along the first — matches numpy's hfft over
+    the last axis of an ifftshift'd spectrum composition."""
+    _check_norm(norm)
+
+    def fn(a):
+        n_last = s[-1] if s is not None else None
+        out = jnp.fft.fft(a, n=(s[0] if s is not None else None),
+                          axis=axes[0], norm=norm)
+        return jnp.fft.hfft(out, n=n_last, axis=axes[-1], norm=norm)
+
+    return apply(fn, x, name="hfft2")
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    _check_norm(norm)
+
+    def fn(a):
+        out = jnp.fft.ihfft(a, n=(s[-1] if s is not None else None),
+                            axis=axes[-1], norm=norm)
+        return jnp.fft.ifft(out, n=(s[0] if s is not None else None),
+                            axis=axes[0], norm=norm)
+
+    return apply(fn, x, name="ihfft2")
+
+
+def _nd_axes_sizes(a, s, axes):
+    """numpy convention: axes default to all dims (or the last len(s) dims
+    when only s is given); s maps positionally onto those axes."""
+    if axes is not None:
+        ax = [int(v) for v in axes]
+    elif s is not None:
+        ax = list(range(a.ndim - len(s), a.ndim))
+    else:
+        ax = list(range(a.ndim))
+    sizes = list(s) if s is not None else [None] * len(ax)
+    if len(sizes) != len(ax):
+        raise ValueError("s and axes must have the same length")
+    return ax, sizes
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    _check_norm(norm)
+
+    def fn(a):
+        ax, sizes = _nd_axes_sizes(a, s, axes)
+        out = a
+        for i, axis in enumerate(ax[:-1]):
+            out = jnp.fft.fft(out, n=sizes[i], axis=axis, norm=norm)
+        return jnp.fft.hfft(out, n=sizes[-1], axis=ax[-1], norm=norm)
+
+    return apply(fn, x, name="hfftn")
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    _check_norm(norm)
+
+    def fn(a):
+        ax, sizes = _nd_axes_sizes(a, s, axes)
+        out = jnp.fft.ihfft(a, n=sizes[-1], axis=ax[-1], norm=norm)
+        for i, axis in enumerate(ax[:-1]):
+            out = jnp.fft.ifft(out, n=sizes[i], axis=axis, norm=norm)
+        return out
+
+    return apply(fn, x, name="ihfftn")
+
+
+__all__ += ["hfft2", "ihfft2", "hfftn", "ihfftn"]

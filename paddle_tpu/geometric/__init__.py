@@ -189,3 +189,36 @@ def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
     if return_eids and eids_np is not None:
         res += (Tensor(jnp.asarray(np.concatenate(out_eids))),)
     return res
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous-graph reindex (reference geometric/reindex.py
+    reindex_heter_graph): like reindex_graph but with PER-EDGE-TYPE
+    neighbor/count lists sharing ONE node remapping; returns the
+    concatenated reindexed edges and the unified out_nodes."""
+    x_np = np.asarray(unwrap(x))
+    nb_list = [np.asarray(unwrap(n)) for n in neighbors]
+    cnt_list = [np.asarray(unwrap(c)) for c in count]
+    seen = dict.fromkeys(x_np.tolist())
+    for nb in nb_list:
+        for v in nb.tolist():
+            seen.setdefault(v, None)
+    out_nodes = np.fromiter(seen.keys(), np.int64)
+    remap = {int(v): i for i, v in enumerate(out_nodes)}
+    # x seeds `seen` first, so its local ids are 0..len(x)-1 — hoisted out
+    # of the per-edge-type loop. int32 matches reindex_graph's edge dtype.
+    x_local = np.arange(len(x_np), dtype=np.int32)
+    srcs, dsts = [], []
+    for nb, cnt in zip(nb_list, cnt_list):
+        srcs.append(np.asarray([remap[int(v)] for v in nb], np.int32))
+        dsts.append(np.repeat(x_local, cnt))
+    from ..core.tensor import Tensor as _T
+    import jax.numpy as _jnp
+
+    return (_T(_jnp.asarray(np.concatenate(srcs))),
+            _T(_jnp.asarray(np.concatenate(dsts))),
+            _T(_jnp.asarray(out_nodes)))
+
+
+__all__ += ["reindex_heter_graph"]

@@ -231,6 +231,10 @@ class StaticFunction:
         return [self._layer] if self._layer is not None else []
 
     def __call__(self, *args, **kwargs):
+        if not _to_static_enabled:
+            # ProgramTranslator.enable(False) parity: run the original
+            # eager python (debuggable path)
+            return self._fn(*args, **kwargs)
         if self._compiled is None:
             self._compiled = CompiledFunction(
                 self._fn, models=self._models(), optimizers=(),
@@ -342,3 +346,34 @@ def load(path, **config):
         blob = pickle.load(f)
     exported = jax_export.deserialize(blob["stablehlo"])
     return TranslatedLayer(exported, blob["params"], blob["buffers"])
+
+
+def enable_to_static(enable=True):
+    """Globally toggle @to_static conversion (reference
+    ProgramTranslator.enable). When disabled, to_static-wrapped callables
+    run their original eager python."""
+    global _to_static_enabled
+    _to_static_enabled = bool(enable)
+
+
+_to_static_enabled = True
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Dy2static transformed-code logging level (reference
+    jit/set_code_level). This engine traces the eager tape instead of
+    rewriting AST — there is no transformed code to print; the level is
+    recorded for API parity."""
+    global _code_level
+    _code_level = level
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    global _verbosity
+    _verbosity = level
+
+
+_code_level = 0
+_verbosity = 0
+
+__all__ += ["enable_to_static", "set_code_level", "set_verbosity"]

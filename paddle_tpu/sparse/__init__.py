@@ -440,3 +440,69 @@ def sum(x, axis=None, dtype=None, keepdim=False, name=None):
 
 
 from . import nn  # noqa: E402  (depends on the ops above)
+
+
+# zero-preserving unary long tail (reference sparse_ops.yaml unary entries:
+# value-wise ops that keep the sparsity pattern)
+asin = _unary("asin", jnp.arcsin)
+asinh = _unary("asinh", jnp.arcsinh)
+atan = _unary("atan", jnp.arctan)
+atanh = _unary("atanh", jnp.arctanh)
+sinh = _unary("sinh", jnp.sinh)
+tan = _unary("tan", jnp.tan)
+expm1 = _unary("expm1", jnp.expm1)
+log1p = _unary("log1p", jnp.log1p)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+
+
+def is_same_shape(x, y):
+    """Shape equality across sparse/dense operands (reference
+    sparse.is_same_shape)."""
+    return tuple(x.shape) == tuple(y.shape)
+
+
+def reshape(x, shape, name=None):
+    """Sparse reshape (reference sparse.reshape): COO indices remapped
+    through the flat index. CSR input round-trips through COO and comes
+    back as CSR (format-preserving, per the reference API)."""
+    new_shape = tuple(int(s) for s in shape)
+    if -1 in new_shape:
+        known = int(np.prod([s for s in new_shape if s != -1]))
+        total = int(np.prod(x.shape))
+        new_shape = tuple(total // known if s == -1 else s for s in new_shape)
+    if is_sparse_csr(x):
+        out = reshape(x.to_sparse_coo(), new_shape)
+        return out.to_sparse_csr() if len(new_shape) == 2 else out
+    idx = unwrap(x.indices())            # [ndim, nnz]
+    strides = np.cumprod([1] + list(x.shape[::-1]))[:-1][::-1]
+    flat = (idx * jnp.asarray(strides.copy())[:, None]).sum(0)
+    new_strides = np.cumprod([1] + list(new_shape[::-1]))[:-1][::-1]
+    new_idx = []
+    rem = flat
+    for st in new_strides:
+        new_idx.append(rem // st)
+        rem = rem % st
+    return SparseCooTensor(Tensor(jnp.stack(new_idx).astype(idx.dtype)),
+                           x.values(), new_shape, True)
+
+
+def mv(x, vec, name=None):
+    """Sparse @ dense vector (reference sparse.mv): lift to [N, 1],
+    matmul, squeeze."""
+    col = apply(lambda v: v[:, None], vec, name="unsqueeze")
+    out = matmul(x, col)
+    return apply(lambda a: a[:, 0], out, name="squeeze")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta * input + alpha * (x @ y) with sparse x (reference
+    sparse.addmm)."""
+    prod = matmul(x, y)
+    return apply(lambda i, p: beta * i + alpha * p, input, prod,
+                 name="sparse_addmm")
+
+
+__all__ += ["asin", "asinh", "atan", "atanh", "sinh", "tan", "expm1",
+            "log1p", "deg2rad", "rad2deg", "is_same_shape", "reshape",
+            "mv", "addmm"]

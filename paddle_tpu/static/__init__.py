@@ -237,6 +237,12 @@ class Executor:
         self.place = place
 
     def run(self, program=None, feed=None, fetch_list=None, return_numpy=True):
+        from .compat import CompiledProgram
+
+        if isinstance(program, CompiledProgram):
+            # unwrap: XLA compiles per feed-shape regardless (the marker
+            # carries only the recorded BuildStrategy)
+            program = program._program
         if callable(program) and not isinstance(program, Program):
             out = program(**(feed or {}))
         elif fetch_list and all(callable(f) for f in fetch_list):
@@ -345,3 +351,12 @@ class Executor:
                 opt.step()
                 opt.clear_grad()
         return list(fetch_vals)
+
+
+from .compat import *  # noqa: E402,F401,F403
+from .compat import __all__ as _compat_all  # noqa: E402
+
+if "__all__" in globals():
+    __all__ += list(_compat_all)  # noqa: F405
+else:
+    __all__ = list(_compat_all)

