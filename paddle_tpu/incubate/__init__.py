@@ -6,3 +6,136 @@ from . import asp
 from . import optimizer
 
 __all__ = ["nn", "autograd", "asp", "optimizer"]
+
+# graph ops (reference incubate.graph_* — earlier homes of what became
+# paddle.geometric; SURVEY §8.11) re-exported over the geometric kernels
+from ..geometric import (  # noqa: E402
+    segment_sum, segment_mean, segment_max, segment_min,
+    reindex_graph as graph_reindex,
+    sample_neighbors as graph_sample_neighbors,
+)
+from ..geometric import send_u_recv as _send_u_recv  # noqa: E402
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """incubate.graph_send_recv (became geometric.send_u_recv)."""
+    return _send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                        out_size=out_size)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighbor sampling over CSC (row, colptr) (reference
+    incubate/operators/graph_khop_sampler.py:173). Host-side (the
+    reference CPU kernel's contract; sampling is data-dependent).
+
+    Returns (edge_src, edge_dst, sample_index, reindex_x) — edges in
+    LOCAL (reindexed) ids, sample_index the unique node set (input nodes
+    first), reindex_x the inputs' local ids — plus edge_eids when
+    return_eids=True (requires sorted_eids, as in the reference)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+    from ..core import random as _rng
+
+    if return_eids and sorted_eids is None:
+        raise ValueError("return_eids=True requires sorted_eids "
+                         "(reference contract)")
+
+    def _np(v):
+        return np.asarray(v._data if isinstance(v, Tensor) else v)
+
+    row_np, col_np = _np(row), _np(colptr)
+    eid_np = _np(sorted_eids) if sorted_eids is not None else None
+    x_np = _np(input_nodes).reshape(-1)
+    seed = int(np.asarray(_rng.next_key())[-1]) % (2 ** 31)
+    rng = np.random.RandomState(seed)
+
+    seen = dict.fromkeys(x_np.tolist())
+    frontier = x_np
+    srcs, dsts, eids = [], [], []
+    for size in sample_sizes:
+        hop_new = dict()
+        for n in frontier.tolist():
+            lo, hi = int(col_np[n]), int(col_np[n + 1])
+            pos = np.arange(lo, hi)
+            if 0 <= size < len(pos):
+                pos = rng.choice(pos, size=size, replace=False)
+            nb = row_np[pos]
+            srcs.append(nb)
+            dsts.append(np.full(len(pos), n, row_np.dtype))
+            if eid_np is not None:
+                eids.append(eid_np[pos])
+            for v in nb.tolist():
+                if v not in seen:
+                    hop_new[v] = None
+        seen.update(hop_new)
+        frontier = np.fromiter(hop_new.keys(), row_np.dtype)             if hop_new else np.zeros(0, row_np.dtype)
+        if not len(frontier):
+            break
+    sample_index = np.fromiter(seen.keys(), np.int64)
+    remap = {int(v): i for i, v in enumerate(sample_index)}
+    cat = (lambda parts: np.concatenate(parts) if parts
+           else np.zeros(0, np.int64))
+    edge_src = np.asarray([remap[int(v)] for v in cat(srcs)], np.int64)
+    edge_dst = np.asarray([remap[int(v)] for v in cat(dsts)], np.int64)
+    reindex_x = np.asarray([remap[int(v)] for v in x_np], np.int64)
+    out = (Tensor(jnp.asarray(edge_src)), Tensor(jnp.asarray(edge_dst)),
+           Tensor(jnp.asarray(sample_index)), Tensor(jnp.asarray(reindex_x)))
+    if return_eids:
+        out = out + (Tensor(jnp.asarray(cat(eids))),)
+    return out
+
+
+def identity_loss(x, reduction="none"):
+    """Mark a tensor as a loss (reference incubate.identity_loss — IPU
+    pipeline marker; here it is the stated reduction). Integer codes per
+    the reference: 0=sum, 1=mean, 2=none."""
+    if reduction in ("none", 2):
+        return x
+    if reduction in ("sum", 0):
+        return x.sum()
+    if reduction in ("mean", 1):
+        return x.mean()
+    raise ValueError(f"unknown reduction {reduction!r}")
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """Fused softmax(x + mask) (reference incubate.softmax_mask_fuse /
+    fused_softmax_mask_op.cu): one jnp expression XLA fuses — the mask is
+    never broadcast-materialized."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply
+
+    return apply(lambda a, m: jax.nn.softmax(a + m.astype(a.dtype), axis=-1),
+                 x, mask, name="softmax_mask_fuse")
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """softmax with the causal (upper-triangle masked) pattern fused
+    (reference fused_softmax_mask_upper_triangle_op.cu)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply
+
+    def fn(a):
+        sq, sk = a.shape[-2], a.shape[-1]
+        causal = jnp.tril(jnp.ones((sq, sk), bool))
+        return jax.nn.softmax(jnp.where(causal, a, -1e30), axis=-1)
+
+    return apply(fn, x, name="softmax_mask_fuse_upper_triangle")
+
+
+from .optimizer import LookAhead, ModelAverage  # noqa: E402
+
+__all__ += [
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "graph_reindex", "graph_sample_neighbors", "graph_send_recv",
+    "graph_khop_sampler", "identity_loss", "softmax_mask_fuse",
+    "softmax_mask_fuse_upper_triangle", "LookAhead", "ModelAverage",
+]

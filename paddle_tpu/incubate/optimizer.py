@@ -204,3 +204,111 @@ class LBFGS:
         self._s = [t._data for t in state.get("s", [])]
         self._y = [t._data for t in state.get("y", [])]
         self._rho = list(state.get("rho", []))
+
+
+class LookAhead:
+    """Lookahead optimizer wrapper (reference incubate/optimizer/lookahead.py
+    LookAhead): every k steps the SLOW weights move alpha of the way toward
+    the fast (inner-optimizer) weights, and the fast weights reset to the
+    slow ones."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step = 0
+        self._slow = {}
+
+    @property
+    def _parameter_list(self):
+        return self.inner_optimizer._parameter_list
+
+    def step(self):
+        import jax.numpy as jnp
+
+        if self._step == 0:
+            # anchor the slow weights at the INITIAL params (reference
+            # lookahead.py step-0 init) — lazily creating them at the
+            # first sync would make that sync a no-op
+            for p in self._parameter_list:
+                if p.trainable:
+                    self._slow[id(p)] = jnp.array(p._data, copy=True)
+        self.inner_optimizer.step()
+        self._step += 1
+        if self._step % self.k:
+            return
+        for p in self._parameter_list:
+            if not p.trainable:
+                continue
+            slow = self._slow.get(id(p))
+            if slow is None:      # param added after construction
+                slow = jnp.array(p._data, copy=True)
+            slow = slow + self.alpha * (p._data - slow)
+            self._slow[id(p)] = slow
+            # the param buffer must NOT alias the stored slow copy: the
+            # inner optimizer's fused update donates its param inputs, and
+            # astype on a same-dtype array returns the SAME buffer
+            p._set_data(jnp.array(slow, copy=True).astype(p._data.dtype))
+
+    def clear_grad(self, *a, **k):
+        return self.inner_optimizer.clear_grad(*a, **k)
+
+    def state_dict(self):
+        return self.inner_optimizer.state_dict()
+
+    def set_state_dict(self, state):
+        return self.inner_optimizer.set_state_dict(state)
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def __getattr__(self, name):
+        return getattr(self.inner_optimizer, name)
+
+
+class ModelAverage:
+    """Running average of parameters for evaluation (reference
+    incubate/optimizer/modelaverage.py): accumulates sums of param values;
+    apply() swaps the averages in, restore() swaps back."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._params = list(parameters or [])
+        self._sum = {}
+        self._cnt = {}
+        self._backup = {}
+
+    def step(self):
+        import jax.numpy as jnp
+
+        for p in self._params:
+            self._sum[id(p)] = self._sum.get(id(p), 0) + p._data.astype(
+                jnp.float32)
+            self._cnt[id(p)] = self._cnt.get(id(p), 0) + 1
+
+    def apply(self, executor=None, need_restore=True):
+        ma = self
+
+        class _Ctx:
+            def __enter__(self):
+                for p in ma._params:
+                    if ma._cnt.get(id(p)):
+                        ma._backup[id(p)] = p._data
+                        avg = ma._sum[id(p)] / ma._cnt[id(p)]
+                        p._set_data(avg.astype(p._data.dtype))
+                return self
+
+            def __exit__(self, *exc):
+                if need_restore:
+                    ma.restore()
+                return False
+
+        return _Ctx()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._set_data(self._backup.pop(id(p)))

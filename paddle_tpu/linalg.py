@@ -184,3 +184,54 @@ def householder_product(x, tau, name=None):
         return q[:, :n]
 
     return apply(fn, x, tau, name="householder_product")
+
+
+def inverse(x, name=None):
+    """Matrix inverse (reference paddle.inverse / linalg.inv alias)."""
+    return inv(x, name=name)
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack lu() results into (P, L, U) (reference lu_unpack op).
+    x: packed LU [.., N, N]; y: 1-based pivot rows from lu()."""
+
+    def fn(lu_, piv):
+        n = lu_.shape[-1]
+        l = jnp.tril(lu_, -1) + jnp.eye(n, dtype=lu_.dtype)
+        u = jnp.triu(lu_)
+        # pivots (1-based sequential row swaps) -> permutation matrix
+        perm = jnp.arange(n)
+        piv0 = piv.astype(jnp.int32) - 1
+
+        def swap(p, i):
+            pi = piv0[i]
+            a, b = p[i], p[pi]
+            p = p.at[i].set(b)
+            return p.at[pi].set(a), None
+
+        perm, _ = jax.lax.scan(swap, perm, jnp.arange(piv0.shape[-1]))
+        p_mat = jnp.eye(n, dtype=lu_.dtype)[perm].T
+        return p_mat, l, u
+
+    from .core.dispatch import apply as _apply
+
+    def dispatch(lu_, piv):
+        if lu_.ndim > 2:
+            batch = lu_.shape[:-2]
+            f = fn
+            for _ in batch:
+                f = jax.vmap(f)
+            return f(lu_, piv)
+        return fn(lu_, piv)
+
+    p_m, l_m, u_m = _apply(dispatch, x, y, name="lu_unpack")
+    out = []
+    out.append(p_m if unpack_pivots else None)
+    if unpack_ludata:
+        out += [l_m, u_m]
+    else:
+        out += [None, None]
+    return tuple(out)
+
+
+__all__ += ["inverse", "lu_unpack"]

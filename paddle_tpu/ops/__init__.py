@@ -180,5 +180,7 @@ def increment(x, value=1.0):
 
 _install_operators()
 _install_methods()
+# linalg/inplace/random Tensor methods build ON the methods installed above
+extras._attach_tensor_methods()
 
 __all__ += ["scale", "increment"]

@@ -538,3 +538,66 @@ def check_shape(shape):
 
 __all__ += ["reshape_", "squeeze_", "unsqueeze_", "tanh_", "scatter_",
             "check_shape"]
+
+
+# -- Tensor-method surface completion (reference tensor/__init__.py method
+# registration: linalg methods, inplace arithmetic variants, random fills) --
+
+def _attach_tensor_methods():
+    from .. import linalg as _la
+    from ._inplace import make_inplace
+    from ..core import random as _rng
+
+    # linalg functions as methods (reference: Tensor.cholesky etc.)
+    for _n in ("cholesky", "cholesky_solve", "cond", "corrcoef", "cov",
+               "eig", "eigvals", "eigvalsh", "inverse", "lstsq", "lu",
+               "lu_unpack", "matrix_power", "multi_dot", "norm", "qr",
+               "solve", "triangular_solve"):
+        if not hasattr(Tensor, _n) and hasattr(_la, _n):
+            setattr(Tensor, _n, getattr(_la, _n))
+
+    # inplace arithmetic/rounding variants over existing methods
+    for _n in ("add", "subtract", "remainder", "clip", "ceil", "floor",
+               "round", "exp", "sqrt", "rsqrt", "reciprocal", "erfinv",
+               "lerp", "scale", "flatten", "put_along_axis"):
+        meth = getattr(Tensor, _n, None)
+        if meth is not None and not hasattr(Tensor, _n + "_"):
+            setattr(Tensor, _n + "_",
+                    make_inplace(meth, name=_n + "_"))
+
+    def uniform_(self, min=-1.0, max=1.0, seed=0, name=None):
+        """In-place uniform refill (reference Tensor.uniform_): a data
+        swap, not a taped op (matches the reference's non-differentiable
+        random fill)."""
+        key = _rng.next_key()
+        self._data = jax.random.uniform(
+            key, self.shape, self._data.dtype, minval=min, maxval=max)
+        return self
+
+    def exponential_(self, lam=1.0, name=None):
+        key = _rng.next_key()
+        self._data = (jax.random.exponential(key, self.shape)
+                      / lam).astype(self._data.dtype)
+        return self
+
+    Tensor.uniform_ = uniform_
+    Tensor.exponential_ = exponential_
+
+    def create_tensor(self, dtype=None, name=None):
+        return Tensor(jnp.zeros((), dtype or self._data.dtype))
+
+    def create_parameter(self, shape, dtype=None, **kw):
+        import paddle_tpu as _p
+
+        return _p.create_parameter(shape, dtype or str(self._data.dtype),
+                                   **kw)
+
+    def increment(self, value=1.0):
+        from . import increment as _inc
+
+        return _inc(self, value)
+
+    Tensor.create_tensor = create_tensor
+    Tensor.create_parameter = create_parameter
+    Tensor.increment = increment
+

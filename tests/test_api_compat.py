@@ -223,3 +223,172 @@ def test_dtype_class_and_named_parameter():
         isinstance(np.dtype("float32"), paddle.dtype)
     p = paddle.create_parameter([2, 2], "float32", name="my_w")
     assert p.name == "my_w"
+
+
+def test_reference_tensor_method_surface_covered():
+    src = pathlib.Path("/root/reference/python/paddle/tensor/__init__.py")
+    if not src.exists():
+        pytest.skip("reference tree not available")
+    meths = set(re.findall(r"^\s+'([a-z_0-9]+)',", src.read_text(), re.M))
+    from paddle_tpu.core.tensor import Tensor
+
+    missing = sorted(m for m in meths if not hasattr(Tensor, m))
+    assert missing == [], missing
+
+
+def test_tensor_linalg_methods_and_inplace_arith():
+    a = np.array([[4.0, 0.0], [0.0, 9.0]], np.float32)
+    x = _t(a)
+    np.testing.assert_allclose(x.cholesky().numpy(), np.linalg.cholesky(a))
+    assert x.norm().shape == ()
+    b = _t(np.array([1.0, 2.0], np.float32)) * 1.0
+    b.add_(_t(np.array([1.0, 1.0], np.float32)))
+    np.testing.assert_allclose(b.numpy(), [2.0, 3.0])
+    b.subtract_(_t(np.ones(2, np.float32)))
+    np.testing.assert_allclose(b.numpy(), [1.0, 2.0])
+    b.clip_(0.0, 1.5)
+    np.testing.assert_allclose(b.numpy(), [1.0, 1.5])
+    # inplace variant keeps the autograd chain (non-leaf)
+    w = _t(np.array([0.5], np.float32))
+    w.stop_gradient = False
+    z = w * 1.0
+    z.exp_()
+    z.sum().backward()
+    np.testing.assert_allclose(w.grad.numpy(), np.exp([0.5]), rtol=1e-6)
+
+
+def test_tensor_random_fills():
+    paddle.seed(11)
+    u = _t(np.zeros(2000, np.float32))
+    u.uniform_(0.0, 2.0)
+    assert 0.8 < float(u.numpy().mean()) < 1.2
+    e = _t(np.zeros(2000, np.float32))
+    e.exponential_(lam=2.0)
+    assert 0.35 < float(e.numpy().mean()) < 0.7
+
+
+def test_incubate_surface():
+    src = pathlib.Path("/root/reference/python/paddle/incubate/__init__.py")
+    if not src.exists():
+        pytest.skip("reference tree not available")
+    names = set(re.findall(r"^\s+'([A-Za-z_0-9]+)',", src.read_text(), re.M))
+    missing = sorted(n for n in names if not hasattr(paddle.incubate, n))
+    assert missing == [], missing
+
+
+def test_incubate_fused_softmax_and_segment():
+    rs = np.random.RandomState(0)
+    x = _t(rs.randn(2, 3, 4, 4).astype("float32"))
+    m = _t((rs.rand(2, 1, 4, 4) > 0.5).astype("float32") * -1e9)
+    out = paddle.incubate.softmax_mask_fuse(x, m)
+    np.testing.assert_allclose(out.numpy().sum(-1), 1.0, rtol=1e-5)
+    tri = paddle.incubate.softmax_mask_fuse_upper_triangle(x)
+    got = tri.numpy()
+    assert np.allclose(got[..., 0, 1:], 0.0)     # causal row 0 sees only col 0
+    seg = paddle.incubate.segment_sum(
+        _t(np.array([[1.0], [2.0], [3.0]], np.float32)),
+        _t(np.array([0, 0, 1], np.int32)))
+    np.testing.assert_allclose(seg.numpy(), [[3.0], [3.0]])
+
+
+def test_lookahead_and_model_average():
+    paddle.seed(0)
+    m = paddle.nn.Linear(4, 4)
+    inner = paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    opt = paddle.incubate.LookAhead(inner, alpha=0.5, k=2)
+    rs = np.random.RandomState(1)
+    x = _t(rs.randn(8, 4).astype("float32"))
+    y = _t(rs.randn(8, 4).astype("float32"))
+    w0 = m.weight.numpy().copy()
+    for _ in range(4):
+        loss = ((m(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert not np.allclose(m.weight.numpy(), w0)
+
+    ma = paddle.incubate.ModelAverage(parameters=m.parameters())
+    snap1 = m.weight.numpy().copy()
+    ma.step()
+    loss = ((m(x) - y) ** 2).mean()
+    loss.backward(); inner.step(); inner.clear_grad()
+    ma.step()
+    cur = m.weight.numpy().copy()
+    with ma.apply():
+        avg = m.weight.numpy()
+        np.testing.assert_allclose(avg, (snap1 + cur) / 2, rtol=1e-5)
+    np.testing.assert_allclose(m.weight.numpy(), cur)
+
+
+def test_graph_khop_sampler_contract():
+    # CSC graph: node n's neighbors = row[colptr[n]:colptr[n+1]]
+    colptr = np.array([0, 2, 3, 3, 4], np.int64)
+    row = np.array([1, 2, 3, 1], np.int64)
+    eids = np.arange(4, dtype=np.int64)
+    src, dst, sample_index, reindex_x = paddle.incubate.graph_khop_sampler(
+        _t(row), _t(colptr), _t(np.array([0], np.int64)), [2, 2])
+    s_np = sample_index.numpy()
+    assert s_np[0] == 0 and len(set(s_np.tolist())) == len(s_np)
+    # edges are in local ids, decodable through sample_index
+    assert (src.numpy() < len(s_np)).all() and (dst.numpy() < len(s_np)).all()
+    np.testing.assert_array_equal(reindex_x.numpy(), [0])
+    out5 = paddle.incubate.graph_khop_sampler(
+        _t(row), _t(colptr), _t(np.array([0], np.int64)), [2],
+        sorted_eids=_t(eids), return_eids=True)
+    assert len(out5) == 5
+    with pytest.raises(ValueError):
+        paddle.incubate.graph_khop_sampler(
+            _t(row), _t(colptr), _t(np.array([0], np.int64)), [2],
+            return_eids=True)
+
+
+def test_identity_loss_integer_codes():
+    x = _t(np.array([1.0, 3.0], np.float32))
+    np.testing.assert_allclose(float(paddle.incubate.identity_loss(x, 0)), 4.0)
+    np.testing.assert_allclose(float(paddle.incubate.identity_loss(x, 1)), 2.0)
+    np.testing.assert_allclose(paddle.incubate.identity_loss(x, 2).numpy(),
+                               [1.0, 3.0])
+
+
+def test_lu_unpack_batched():
+    rs = np.random.RandomState(0)
+    a = rs.randn(3, 4, 4).astype("float32") + 4 * np.eye(4, dtype=np.float32)
+    lu_packed, piv = paddle.linalg.lu(_t(a))
+    P, L, U = paddle.linalg.lu_unpack(lu_packed, piv)
+    recon = P.numpy() @ L.numpy() @ U.numpy()
+    np.testing.assert_allclose(recon, a, rtol=1e-3, atol=1e-3)
+
+
+def test_lookahead_first_sync_pulls_toward_init():
+    paddle.seed(0)
+    m = paddle.nn.Linear(2, 2)
+    w0 = m.weight.numpy().copy()
+    opt = paddle.incubate.LookAhead(
+        paddle.optimizer.SGD(learning_rate=0.5, parameters=m.parameters()),
+        alpha=0.5, k=2)
+    x = _t(np.ones((4, 2), np.float32))
+    y = _t(np.zeros((4, 2), np.float32))
+    fast = None
+    for i in range(2):
+        loss = ((m(x) - y) ** 2).mean()
+        loss.backward()
+        if i == 1:
+            # capture fast weights just before the sync step applies
+            loss2 = None
+        opt.step()
+        opt.clear_grad()
+    w_after = m.weight.numpy()
+    # after the k=2 sync, weights are strictly between w0 and the fast
+    # weights — NOT equal to the fast weights (the no-op failure mode)
+    inner_only = paddle.nn.Linear(2, 2)
+    inner_only.weight.set_value(w0)
+    inner_only.bias.set_value(np.zeros_like(inner_only.bias.numpy()))
+    o2 = paddle.optimizer.SGD(learning_rate=0.5,
+                              parameters=inner_only.parameters())
+    for _ in range(2):
+        l2 = ((inner_only(x) - y) ** 2).mean()
+        l2.backward(); o2.step(); o2.clear_grad()
+    fast_w = inner_only.weight.numpy()
+    assert not np.allclose(w_after, fast_w)
+    np.testing.assert_allclose(w_after, (w0 + fast_w) / 2, rtol=1e-4,
+                               atol=1e-5)
