@@ -11,3 +11,9 @@ from .features import Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC
 
 __all__ = ["functional", "Spectrogram", "MelSpectrogram",
            "LogMelSpectrogram", "MFCC"]
+
+from . import backends  # noqa: E402
+from . import datasets  # noqa: E402
+from .backends import info, load, save  # noqa: E402
+
+__all__ += ["backends", "datasets", "info", "load", "save"]

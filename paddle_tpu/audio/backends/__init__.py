@@ -1,0 +1,9 @@
+"""Audio IO backends (reference: python/paddle/audio/backends/ —
+wave_backend.py load/save/info over the stdlib wave module, plus the
+backend registry init_backend.py)."""
+from .wave_backend import info, load, save
+from .init_backend import (get_current_backend, list_available_backends,
+                           set_backend)
+
+__all__ = ["info", "load", "save", "get_current_backend",
+           "list_available_backends", "set_backend"]

@@ -1,0 +1,32 @@
+"""Backend registry (reference audio/backends/init_backend.py). The wave
+backend is always available; soundfile registers when the optional
+package exists (it is not baked into this image)."""
+from __future__ import annotations
+
+_current = "wave"
+
+__all__ = ["get_current_backend", "list_available_backends", "set_backend"]
+
+
+def list_available_backends():
+    out = ["wave"]
+    try:
+        import soundfile  # noqa: F401
+
+        out.append("soundfile")
+    except ImportError:
+        pass
+    return out
+
+
+def get_current_backend():
+    return _current
+
+
+def set_backend(backend_name):
+    global _current
+    if backend_name not in list_available_backends():
+        raise NotImplementedError(
+            f"backend {backend_name!r} not available (have: "
+            f"{list_available_backends()})")
+    _current = backend_name

@@ -1,0 +1,70 @@
+"""WAV IO over the stdlib wave module (reference
+audio/backends/wave_backend.py — the dependency-free default backend)."""
+from __future__ import annotations
+
+import wave as _wave
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+__all__ = ["AudioInfo", "info", "load", "save"]
+
+
+class AudioInfo:
+    def __init__(self, sample_rate, num_samples, num_channels,
+                 bits_per_sample, encoding="PCM_S"):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+    def __repr__(self):
+        return (f"AudioInfo(sample_rate={self.sample_rate}, "
+                f"num_samples={self.num_samples}, "
+                f"num_channels={self.num_channels}, "
+                f"bits_per_sample={self.bits_per_sample})")
+
+
+def info(filepath):
+    with _wave.open(filepath, "rb") as f:
+        return AudioInfo(f.getframerate(), f.getnframes(), f.getnchannels(),
+                         f.getsampwidth() * 8)
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """Returns (waveform Tensor [C, T] (or [T, C]), sample_rate)."""
+    with _wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        nch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(frame_offset)
+        n = num_frames if num_frames >= 0 else f.getnframes() - frame_offset
+        raw = f.readframes(n)
+    dt = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
+    data = np.frombuffer(raw, dt).reshape(-1, nch)
+    if width == 1:
+        data = data.astype(np.int16) - 128
+    if normalize:
+        data = data.astype(np.float32) / float(2 ** (8 * width - 1))
+    arr = data.T if channels_first else data
+    return Tensor(jnp.asarray(np.ascontiguousarray(arr))), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         bits_per_sample=16):
+    arr = np.asarray(src._data if isinstance(src, Tensor) else src)
+    if channels_first:
+        arr = arr.T                      # -> [T, C]
+    if arr.dtype.kind == "f":
+        arr = np.clip(arr, -1.0, 1.0)
+        arr = (arr * (2 ** (bits_per_sample - 1) - 1)).astype(
+            {8: np.int16, 16: np.int16, 32: np.int32}[bits_per_sample])
+    with _wave.open(filepath, "wb") as f:
+        f.setnchannels(arr.shape[1] if arr.ndim > 1 else 1)
+        f.setsampwidth(bits_per_sample // 8)
+        f.setframerate(int(sample_rate))
+        f.writeframes(np.ascontiguousarray(arr).tobytes())

@@ -1,0 +1,47 @@
+"""Audio datasets (reference: python/paddle/audio/datasets/ — TESS, ESC50).
+Zero-egress: deterministic synthetic waveforms with the right label
+spaces (`.synthetic` flags it), same stance as vision/text datasets."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["TESS", "ESC50"]
+
+
+def _tone(sr, seconds, freq, seed):
+    rng = np.random.RandomState(seed)
+    t = np.arange(int(sr * seconds), dtype=np.float32) / sr
+    wav = 0.4 * np.sin(2 * np.pi * freq * t)
+    return (wav + 0.02 * rng.randn(len(t))).astype(np.float32)
+
+
+class TESS(Dataset):
+    """Toronto emotional speech set (7 emotion classes)."""
+
+    n_class = 7
+    sample_rate = 16000
+
+    def __init__(self, mode="train", n_folds=5, split=1, feat_type="raw",
+                 archive=None, **kwargs):
+        self.mode = mode
+        self.synthetic = True
+        n = 128 if mode == "train" else 32
+        rng = np.random.RandomState(3 if mode == "train" else 5)
+        self.labels = rng.randint(0, self.n_class, n).astype(np.int64)
+        self.freqs = 120 + 40 * self.labels + rng.randint(0, 20, n)
+
+    def __getitem__(self, idx):
+        wav = _tone(self.sample_rate, 0.2, float(self.freqs[idx]), idx)
+        return wav, int(self.labels[idx])
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class ESC50(TESS):
+    """Environmental sound classification (50 classes)."""
+
+    n_class = 50
+    sample_rate = 16000

@@ -95,3 +95,19 @@ class DataParallel:
 
     def apply_collective_grads(self):
         pass
+
+from .compat import (  # noqa: E402
+    ParallelMode, CountFilterEntry, ProbabilityEntry, ShowClickEntry,
+    InMemoryDataset, QueueDataset, broadcast_object_list,
+    scatter_object_list, gloo_init_parallel_env, gloo_barrier, gloo_release,
+    is_available, isend, irecv, split,
+)
+from .collective import get_backend  # noqa: E402
+from . import io  # noqa: E402
+
+__all__ += [
+    "ParallelMode", "CountFilterEntry", "ProbabilityEntry", "ShowClickEntry",
+    "InMemoryDataset", "QueueDataset", "broadcast_object_list",
+    "scatter_object_list", "gloo_init_parallel_env", "gloo_barrier",
+    "gloo_release", "is_available", "isend", "irecv", "get_backend", "io", "split",
+]

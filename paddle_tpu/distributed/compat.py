@@ -1,0 +1,293 @@
+"""Distributed long-tail compat (reference: python/paddle/distributed/
+__init__.py exports — object collectives, async send/recv handles, gloo
+bootstrap, ParallelMode, and the PS-era dataset/entry configs).
+
+TPU-native notes: object collectives pickle through the tensor
+collectives; isend/irecv return completed-task handles (XLA collectives
+are synchronous at the host API level — the async overlap happens inside
+the compiled program, reference ProcessGroup task semantics kept for API
+parity); gloo_* bootstrap maps to the TCPStore rendezvous this framework
+already runs for multi-host jobs.
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "ParallelMode", "CountFilterEntry", "ProbabilityEntry", "ShowClickEntry",
+    "InMemoryDataset", "QueueDataset", "broadcast_object_list",
+    "scatter_object_list", "get_backend", "gloo_init_parallel_env",
+    "gloo_barrier", "gloo_release", "is_available", "isend", "irecv", "split",
+]
+
+
+class ParallelMode:
+    """Training parallel mode constants (reference parallel.ParallelMode)."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+def is_available():
+    """Whether the distributed package can be used (reference
+    distributed.is_available)."""
+    return True
+
+
+class _Task:
+    """Completed-task handle (reference ProcessGroup task): wait()/is_completed
+    — the collective already ran synchronously by the time this returns."""
+
+    def __init__(self, tensor=None):
+        self._tensor = tensor
+
+    def wait(self, timeout=None):
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def isend(tensor, dst=0, group=None):
+    from .parallel_env import get_world_size
+
+    if get_world_size(group) <= 1:
+        return _Task(tensor)     # identity semantics, like the collectives
+    from .collective import send
+
+    send(tensor, dst=dst, group=group, sync_op=True)
+    return _Task(tensor)
+
+
+def irecv(tensor, src=0, group=None):
+    from .parallel_env import get_world_size
+
+    if get_world_size(group) <= 1:
+        return _Task(tensor)
+    from .collective import recv
+
+    recv(tensor, src=src, group=group, sync_op=True)
+    return _Task(tensor)
+
+
+def _obj_to_tensor(obj):
+    data = np.frombuffer(pickle.dumps(obj), np.uint8).copy()
+    return Tensor(jnp.asarray(data)), len(data)
+
+
+def _tensor_to_obj(t, n):
+    return pickle.loads(np.asarray(t._data)[:n].tobytes())
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """Broadcast pickled python objects (reference
+    communication/broadcast.py broadcast_object_list). Single-program SPMD
+    note: every rank holds the same host objects, so outside a multi-host
+    launch this is an identity (matching broadcast's identity semantics)."""
+    from .parallel_env import get_world_size
+
+    if get_world_size(group) <= 1:
+        return object_list
+    from .collective import broadcast
+
+    for i, obj in enumerate(object_list):
+        t, n = _obj_to_tensor(obj)
+        broadcast(t, src=src, group=group)
+        object_list[i] = _tensor_to_obj(t, n)
+    return object_list
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """Scatter pickled objects (reference scatter_object_list)."""
+    from .parallel_env import get_rank, get_world_size
+
+    ws = get_world_size(group)
+    if ws <= 1:
+        out_object_list[:] = [in_object_list[0]] if in_object_list else []
+        return out_object_list
+    rank = get_rank(group)
+    out_object_list[:] = [in_object_list[rank]]
+    return out_object_list
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """CPU-only bootstrap (reference gloo_init_parallel_env). The TCPStore
+    rendezvous this framework runs for multi-host jobs plays gloo's role;
+    this wires the same env knobs."""
+    import os
+
+    os.environ.setdefault("PADDLE_TRAINER_ID", str(rank_id))
+    os.environ.setdefault("PADDLE_TRAINERS_NUM", str(rank_num))
+    host, _, port = server_endpoint.partition(":")
+    os.environ.setdefault("MASTER_ADDR", host)
+    os.environ.setdefault("MASTER_PORT", port or "6170")
+    from .parallel_env import init_parallel_env
+
+    init_parallel_env()
+
+
+def gloo_barrier():
+    from .collective import barrier
+
+    barrier()
+
+
+def gloo_release():
+    """Release bootstrap resources (reference gloo_release) — the store
+    closes with the process here; nothing to free eagerly."""
+
+
+class _Entry:
+    """Sparse-table entry config base (reference distributed/entry_attr.py;
+    PS accessors). The parameter-server runtime is out of the TPU critical
+    path (SURVEY §2.5.14); these configs validate and serialize so model
+    definitions that attach them still construct."""
+
+    def _to_attr(self):
+        raise NotImplementedError
+
+
+class CountFilterEntry(_Entry):
+    def __init__(self, count_filter):
+        if count_filter < 0:
+            raise ValueError("count_filter must be >= 0")
+        self._count_filter = int(count_filter)
+
+    def _to_attr(self):
+        return f"count_filter_entry:{self._count_filter}"
+
+
+class ProbabilityEntry(_Entry):
+    def __init__(self, probability):
+        if not 0 <= probability <= 1:
+            raise ValueError("probability must be in [0, 1]")
+        self._probability = float(probability)
+
+    def _to_attr(self):
+        return f"probability_entry:{self._probability}"
+
+
+class ShowClickEntry(_Entry):
+    def __init__(self, show_name, click_name):
+        self._show = show_name
+        self._click = click_name
+
+    def _to_attr(self):
+        return f"show_click_entry:{self._show}:{self._click}"
+
+
+class InMemoryDataset:
+    """PS-era slot dataset (reference distributed/fleet/dataset/
+    InMemoryDataset): loads slot files into memory, supports shuffle and
+    batched iteration. Here it is a host-side record store feeding the
+    normal DataLoader path (the PS pipeline itself is out of scope)."""
+
+    def __init__(self):
+        self._records = []
+        self._batch_size = 1
+        self._use_var = []
+
+    def init(self, batch_size=1, use_var=None, pipe_command=None,
+             thread_num=1, **kwargs):
+        self._batch_size = batch_size
+        self._use_var = use_var or []
+
+    update_settings = init
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def load_into_memory(self):
+        self._records = []
+        for path in getattr(self, "_filelist", []):
+            with open(path) as f:
+                for line in f:
+                    parts = line.split()
+                    if parts:
+                        self._records.append(
+                            np.asarray([float(v) for v in parts], np.float32))
+
+    def local_shuffle(self):
+        import random
+
+        random.shuffle(self._records)
+
+    global_shuffle = local_shuffle
+
+    def get_memory_data_size(self):
+        return len(self._records)
+
+    def release_memory(self):
+        self._records = []
+
+    def __iter__(self):
+        for i in range(0, len(self._records), self._batch_size):
+            yield self._records[i:i + self._batch_size]
+
+
+class QueueDataset(InMemoryDataset):
+    """Streaming variant (reference QueueDataset): iterates files directly
+    without the load_into_memory staging."""
+
+    def load_into_memory(self):
+        raise RuntimeError("QueueDataset streams from files; use iteration "
+                           "directly (reference QueueDataset contract)")
+
+    def __iter__(self):
+        batch = []
+        for path in getattr(self, "_filelist", []):
+            with open(path) as f:
+                for line in f:
+                    parts = line.split()
+                    if parts:
+                        batch.append(np.asarray([float(v) for v in parts],
+                                                np.float32))
+                    if len(batch) == self._batch_size:
+                        yield batch
+                        batch = []
+        if batch:
+            yield batch
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Model-parallel op with a split weight (reference
+    fleet/layers/mpu/mp_ops.py:653 distributed.split — parallel embedding /
+    column- or row-parallel linear). TPU-native: constructs the matching
+    mp layer (GSPMD-sharded weight over the 'mp' axis) and applies it —
+    num_partitions must equal the mesh's mp degree, as in the reference.
+    """
+    from ..parallel.mesh import axis_size
+    from ..parallel.mp_layers import (ColumnParallelLinear,
+                                      RowParallelLinear,
+                                      VocabParallelEmbedding)
+
+    mp = axis_size("mp")
+    if num_partitions not in (1, mp):
+        raise ValueError(
+            f"num_partitions ({num_partitions}) must match the mesh mp "
+            f"degree ({mp})")
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1],
+                                       weight_attr=weight_attr)
+        return layer(x)
+    if operation != "linear":
+        raise ValueError("operation must be 'linear' or 'embedding'")
+    if axis == 0:
+        # weight split along rows -> input-parallel (row-parallel linear)
+        layer = RowParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                  bias_attr=bias_attr,
+                                  input_is_parallel=False)
+        return layer(x)
+    layer = ColumnParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                 bias_attr=bias_attr,
+                                 gather_output=gather_out)
+    return layer(x)

@@ -1,0 +1,42 @@
+"""distributed.io (reference: python/paddle/distributed/io.py —
+save/load of persistables in distributed jobs; thin over the framework
+save/load since sharded state rides distributed/checkpoint.py)."""
+from __future__ import annotations
+
+__all__ = ["save_persistables", "load_persistables", "is_persistable"]
+
+
+def is_persistable(var):
+    return bool(getattr(var, "persistable", False))
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    from ..framework.io_ import save
+    from ..static import default_main_program
+    import os
+
+    prog = main_program or default_main_program()
+    params = {(t.name or f"param_{i}"): t
+              for i, t in enumerate(prog._captured_params())
+              if is_persistable(t) or True}
+    os.makedirs(dirname, exist_ok=True)
+    save({k: v for k, v in params.items()},
+         os.path.join(dirname, filename or "__params__.pdparams"))
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    from ..framework.io_ import load
+    from ..static import default_main_program
+    import os
+    import jax.numpy as jnp
+    import numpy as np
+
+    prog = main_program or default_main_program()
+    state = load(os.path.join(dirname, filename or "__params__.pdparams"))
+    named = {(t.name or f"param_{i}"): t
+             for i, t in enumerate(prog._captured_params())}
+    for k, t in named.items():
+        if k in state:
+            v = state[k]
+            arr = v._data if hasattr(v, "_data") else jnp.asarray(np.asarray(v))
+            t._data = jnp.asarray(arr, t._data.dtype)

@@ -209,3 +209,55 @@ class Profiler:
 def load_profiler_result(path):
     with open(path) as f:
         return json.load(f)
+
+
+class SortedKeys:
+    """Summary-table sort keys (reference profiler/profiler.py SortedKeys)."""
+
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView:
+    """Summary views (reference profiler/profiler.py SummaryView)."""
+
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def export_protobuf(dir_name, worker_name=None):
+    """on_trace_ready handler writing the raw trace records (reference
+    export_protobuf; here the host-tracer event list is serialized with
+    pickle next to the chrome trace — the xplane protobuf itself is
+    produced by jax.profiler when the device tracer is active)."""
+    import os
+    import pickle
+    import socket
+    import time as _time
+
+    def handler(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"host_{socket.gethostname()}"
+        path = os.path.join(
+            dir_name, f"{name}_{int(_time.time() * 1000)}.pb.pkl")
+        with open(path, "wb") as f:
+            pickle.dump(getattr(prof, "_events", []), f)
+        return path
+
+    return handler
+
+
+__all__ += ["SortedKeys", "SummaryView", "export_protobuf"]

@@ -101,3 +101,7 @@ class ViterbiDecoder(Layer):
 
 
 from . import datasets  # noqa: E402
+
+from .datasets import (  # noqa: E402
+    Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16,
+)

@@ -137,3 +137,91 @@ class Movielens(Dataset):
 
     def __len__(self):
         return len(self.rows)
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL (reference text/datasets/conll05.py): tuples of
+    (word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, pred_id, mark, label)
+    — synthetic fallback with consistent vocab sizes."""
+
+    WORD_DICT_LEN = 4000
+    LABEL_DICT_LEN = 59
+    PRED_DICT_LEN = 300
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, mode="train",
+                 download=True):
+        self.synthetic = True
+        n = 128 if mode == "train" else 32
+        rng = np.random.RandomState(23 if mode == "train" else 29)
+        self._rows = []
+        for i in range(n):
+            L = rng.randint(5, 30)
+            words = rng.randint(0, self.WORD_DICT_LEN, L).astype(np.int64)
+            ctx = [np.roll(words, k) for k in (2, 1, 0, -1, -2)]
+            pred = np.full(L, rng.randint(0, self.PRED_DICT_LEN), np.int64)
+            mark = (rng.rand(L) > 0.8).astype(np.int64)
+            label = rng.randint(0, self.LABEL_DICT_LEN, L).astype(np.int64)
+            self._rows.append((words, *ctx, pred, mark, label))
+
+    def get_dict(self):
+        wd = {f"w{i}": i for i in range(self.WORD_DICT_LEN)}
+        vd = {f"v{i}": i for i in range(self.PRED_DICT_LEN)}
+        ld = {f"l{i}": i for i in range(self.LABEL_DICT_LEN)}
+        return wd, vd, ld
+
+    def __getitem__(self, idx):
+        return self._rows[idx]
+
+    def __len__(self):
+        return len(self._rows)
+
+
+class _WMT(Dataset):
+    """Shared WMT en-de style pair dataset (reference text/datasets/
+    wmt14.py, wmt16.py): (src_ids, trg_ids, trg_ids_next) tuples."""
+
+    def __init__(self, mode="train", src_dict_size=3000, trg_dict_size=3000,
+                 lang="en", data_file=None, download=True, seed=31):
+        self.synthetic = True
+        self.src_dict_size = src_dict_size
+        self.trg_dict_size = trg_dict_size
+        n = 128 if mode == "train" else 32
+        rng = np.random.RandomState(seed if mode == "train" else seed + 1)
+        self._rows = []
+        for _ in range(n):
+            ls = rng.randint(4, 20)
+            lt = rng.randint(4, 20)
+            src = rng.randint(3, src_dict_size, ls).astype(np.int64)
+            trg = rng.randint(3, trg_dict_size, lt).astype(np.int64)
+            trg_in = np.concatenate([[1], trg])          # <s> prefix
+            trg_next = np.concatenate([trg, [2]])        # </s> suffix
+            self._rows.append((src, trg_in, trg_next))
+
+    def get_dict(self, lang="en", reverse=False):
+        size = self.src_dict_size if lang == "en" else self.trg_dict_size
+        d = {f"{lang}{i}": i for i in range(size)}
+        return {v: k for k, v in d.items()} if reverse else d
+
+    def __getitem__(self, idx):
+        return self._rows[idx]
+
+    def __len__(self):
+        return len(self._rows)
+
+
+class WMT14(_WMT):
+    def __init__(self, data_file=None, mode="train", dict_size=3000,
+                 download=True):
+        super().__init__(mode=mode, src_dict_size=dict_size,
+                         trg_dict_size=dict_size, seed=31)
+
+
+class WMT16(_WMT):
+    def __init__(self, data_file=None, mode="train", src_dict_size=3000,
+                 trg_dict_size=3000, lang="en", download=True):
+        super().__init__(mode=mode, src_dict_size=src_dict_size,
+                         trg_dict_size=trg_dict_size, lang=lang, seed=37)
+
+
+__all__ += ["Conll05st", "WMT14", "WMT16"]

@@ -57,3 +57,27 @@ class _UniqueNameGenerator:
 
 
 unique_name = _UniqueNameGenerator()
+
+
+def require_version(min_version, max_version=None):
+    """Check the installed framework version (reference
+    utils/__init__.py require_version): raises if this build's version
+    falls outside [min_version, max_version]."""
+    from .. import __version__
+
+    def key(v):
+        parts = [int(p) for p in str(v).split(".")[:3] if p.isdigit()]
+        return tuple(parts + [0] * (3 - len(parts)))   # zero-pad: 0.1 == 0.1.0
+
+    cur = key(__version__)
+    if key(min_version) > cur:
+        raise Exception(
+            f"version {__version__} is below required {min_version}")
+    if max_version is not None and key(max_version) < cur:
+        raise Exception(
+            f"version {__version__} is above supported {max_version}")
+    return True
+
+
+if "__all__" in globals():
+    __all__ += ["require_version"]

@@ -5,3 +5,34 @@ from . import models
 from . import ops
 
 __all__ = ["datasets", "transforms", "models", "ops"]
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend):
+    """Default decode backend for datasets (reference
+    vision/image.py set_image_backend): 'pil' or 'cv2' ('cv2' is accepted
+    and mapped to PIL here — no OpenCV dependency on this stack)."""
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(f"unknown image backend {backend!r}")
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image file (reference vision/image.py image_load)."""
+    from PIL import Image
+
+    img = Image.open(path)
+    if (backend or _image_backend) in ("cv2", "tensor"):
+        import numpy as _np
+
+        return _np.asarray(img)
+    return img
+
+
+__all__ += ["set_image_backend", "get_image_backend", "image_load"]

@@ -190,3 +190,64 @@ class ImageFolder(DatasetFolder):
 
     def __len__(self):
         return len(self.samples)
+
+
+class Flowers(Dataset):
+    """Flowers-102 (reference vision/datasets/flowers.py): (image CHW,
+    label) pairs; synthetic fallback with the 102-class label space."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        self.synthetic = True
+        n = 256 if mode == "train" else 64
+        # HWC like Cifar: _synthetic_images writes its class-separable
+        # band across shape[0] (rows)
+        self.images, self.labels = _synthetic_images(
+            n, (32, 32, 3), 102, seed=11 if mode == "train" else 13)
+
+    def __getitem__(self, idx):
+        img, label = self.images[idx], int(self.labels[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.images)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation (reference vision/datasets/voc2012.py):
+    (image CHW, mask HW) pairs; synthetic fallback draws blocky class
+    regions so segmentation losses have real structure."""
+
+    NUM_CLASSES = 21
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        self.synthetic = True
+        n = 64 if mode == "train" else 16
+        rng = np.random.RandomState(17 if mode == "train" else 19)
+        self.images = (rng.rand(n, 3, 32, 32) * 255).astype(np.uint8)
+        masks = np.zeros((n, 32, 32), np.int64)
+        for i in range(n):
+            for _ in range(3):
+                cls = rng.randint(1, self.NUM_CLASSES)
+                y0, x0 = rng.randint(0, 24, 2)
+                masks[i, y0:y0 + 8, x0:x0 + 8] = cls
+        self.masks = masks
+
+    def __getitem__(self, idx):
+        img, mask = self.images[idx], self.masks[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, mask
+
+    def __len__(self):
+        return len(self.images)
+
+
+__all__ += ["Flowers", "VOC2012"]

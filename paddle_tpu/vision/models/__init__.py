@@ -1,6 +1,11 @@
 """Vision model zoo (reference: python/paddle/vision/models/)."""
 from .lenet import LeNet
-from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152, BasicBlock, BottleneckBlock
+from .resnet import (
+    ResNet, resnet18, resnet34, resnet50, resnet101, resnet152, BasicBlock,
+    BottleneckBlock, wide_resnet50_2, wide_resnet101_2, resnext50_32x4d,
+    resnext50_64x4d, resnext101_32x4d, resnext101_64x4d, resnext152_32x4d,
+    resnext152_64x4d,
+)
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
 from .mobilenet import MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2
 from .mobilenetv3 import (
@@ -12,6 +17,7 @@ from .densenet import (
     DenseNet, densenet121, densenet161, densenet169, densenet201, densenet264,
 )
 from .shufflenetv2 import (
+    shufflenet_v2_swish,
     ShuffleNetV2, shufflenet_v2_x0_25, shufflenet_v2_x0_33, shufflenet_v2_x0_5,
     shufflenet_v2_x1_0, shufflenet_v2_x1_5, shufflenet_v2_x2_0,
 )
@@ -29,6 +35,9 @@ __all__ = [
     "densenet264",
     "ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
     "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
-    "shufflenet_v2_x2_0",
+    "shufflenet_v2_x2_0", "shufflenet_v2_swish",
+    "wide_resnet50_2", "wide_resnet101_2", "resnext50_32x4d",
+    "resnext50_64x4d", "resnext101_32x4d", "resnext101_64x4d",
+    "resnext152_32x4d", "resnext152_64x4d",
     "GoogLeNet", "googlenet", "InceptionV3", "inception_v3",
 ]

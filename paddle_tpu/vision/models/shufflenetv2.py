@@ -21,20 +21,24 @@ def _channel_shuffle(x, groups):
     return F.channel_shuffle(x, groups)
 
 
+def _act_layer(act):
+    return nn.Swish() if act == "swish" else nn.ReLU()
+
+
 class _InvertedResidual(nn.Layer):
-    def __init__(self, in_ch, out_ch, stride):
+    def __init__(self, in_ch, out_ch, stride, act="relu"):
         super().__init__()
         self.stride = stride
         branch = out_ch // 2
         if stride == 1:
             self.branch2 = nn.Sequential(
                 nn.Conv2D(in_ch // 2, branch, 1, bias_attr=False),
-                nn.BatchNorm2D(branch), nn.ReLU(),
+                nn.BatchNorm2D(branch), _act_layer(act),
                 nn.Conv2D(branch, branch, 3, stride=1, padding=1,
                           groups=branch, bias_attr=False),
                 nn.BatchNorm2D(branch),
                 nn.Conv2D(branch, branch, 1, bias_attr=False),
-                nn.BatchNorm2D(branch), nn.ReLU(),
+                nn.BatchNorm2D(branch), _act_layer(act),
             )
             self.branch1 = None
         else:
@@ -43,16 +47,16 @@ class _InvertedResidual(nn.Layer):
                           groups=in_ch, bias_attr=False),
                 nn.BatchNorm2D(in_ch),
                 nn.Conv2D(in_ch, branch, 1, bias_attr=False),
-                nn.BatchNorm2D(branch), nn.ReLU(),
+                nn.BatchNorm2D(branch), _act_layer(act),
             )
             self.branch2 = nn.Sequential(
                 nn.Conv2D(in_ch, branch, 1, bias_attr=False),
-                nn.BatchNorm2D(branch), nn.ReLU(),
+                nn.BatchNorm2D(branch), _act_layer(act),
                 nn.Conv2D(branch, branch, 3, stride=stride, padding=1,
                           groups=branch, bias_attr=False),
                 nn.BatchNorm2D(branch),
                 nn.Conv2D(branch, branch, 1, bias_attr=False),
-                nn.BatchNorm2D(branch), nn.ReLU(),
+                nn.BatchNorm2D(branch), _act_layer(act),
             )
 
     def forward(self, x):
@@ -74,20 +78,20 @@ class ShuffleNetV2(nn.Layer):
         chs = _STAGE_OUT[scale]
         self.conv1 = nn.Sequential(
             nn.Conv2D(3, chs[0], 3, stride=2, padding=1, bias_attr=False),
-            nn.BatchNorm2D(chs[0]), nn.ReLU(),
+            nn.BatchNorm2D(chs[0]), _act_layer(act),
         )
         self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
         stages = []
         in_ch = chs[0]
         for out_ch, repeats in zip(chs[1:4], (4, 8, 4)):
-            stages.append(_InvertedResidual(in_ch, out_ch, 2))
+            stages.append(_InvertedResidual(in_ch, out_ch, 2, act))
             for _ in range(repeats - 1):
-                stages.append(_InvertedResidual(out_ch, out_ch, 1))
+                stages.append(_InvertedResidual(out_ch, out_ch, 1, act))
             in_ch = out_ch
         self.stages = nn.Sequential(*stages)
         self.conv_last = nn.Sequential(
             nn.Conv2D(in_ch, chs[4], 1, bias_attr=False),
-            nn.BatchNorm2D(chs[4]), nn.ReLU(),
+            nn.BatchNorm2D(chs[4]), _act_layer(act),
         )
         self.pool = nn.AdaptiveAvgPool2D(1) if with_pool else None
         self.fc = nn.Linear(chs[4], num_classes) if num_classes > 0 else None
@@ -115,3 +119,12 @@ shufflenet_v2_x0_5 = _make(0.5, "shufflenet_v2_x0_5")
 shufflenet_v2_x1_0 = _make(1.0, "shufflenet_v2_x1_0")
 shufflenet_v2_x1_5 = _make(1.5, "shufflenet_v2_x1_5")
 shufflenet_v2_x2_0 = _make(2.0, "shufflenet_v2_x2_0")
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    """ShuffleNetV2 with swish activation (reference
+    shufflenet_v2_swish)."""
+    return ShuffleNetV2(scale=1.0, act="swish", **kwargs)
+
+
+__all__ += ["shufflenet_v2_swish"]

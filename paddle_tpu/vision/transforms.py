@@ -14,6 +14,12 @@ __all__ = [
     "RandomHorizontalFlip", "RandomVerticalFlip", "Transpose", "Pad",
     "RandomRotation", "BrightnessTransform", "ContrastTransform",
     "to_tensor", "normalize", "resize", "hflip", "vflip",
+    "BaseTransform", "Grayscale", "ColorJitter", "HueTransform",
+    "SaturationTransform", "RandomAffine", "RandomErasing",
+    "RandomPerspective", "RandomResizedCrop", "adjust_brightness",
+    "adjust_contrast", "adjust_hue", "adjust_saturation", "affine",
+    "center_crop", "crop", "erase", "pad", "perspective", "rotate",
+    "to_grayscale",
 ]
 
 
@@ -228,3 +234,421 @@ class ContrastTransform:
         f = 1 + pyrandom.uniform(-self.value, self.value)
         mean = arr.mean()
         return (arr - mean) * f + mean
+
+
+# ---------------------------------------------------------------------------
+# Long-tail transforms (reference: vision/transforms/transforms.py +
+# functional.py — color jitter family, geometric warps, erasing).
+# Host-side numpy on CHW arrays, like the rest of this module: transforms
+# run in DataLoader workers; the device sees the collated batch.
+# ---------------------------------------------------------------------------
+
+class BaseTransform:
+    """Transform base with the reference's keys-dispatch contract
+    (transforms.py BaseTransform): subclasses implement _apply_image
+    (and optionally _apply_{boxes,mask,...}); __call__ routes inputs by
+    self.keys."""
+
+    def __init__(self, keys=None):
+        self.keys = keys or ("image",)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+    def __call__(self, inputs):
+        if not isinstance(inputs, (list, tuple)):
+            return self._apply_image(inputs)
+        outs = []
+        for i, data in enumerate(inputs):
+            # inputs beyond len(keys) pass through unchanged (reference
+            # BaseTransform contract — labels survive image-only keys)
+            key = self.keys[i] if i < len(self.keys) else None
+            fn = getattr(self, f"_apply_{key}", None) if key else None
+            outs.append(fn(data) if fn else data)
+        return tuple(outs)
+
+
+def crop(img, top, left, height, width):
+    return _chw(np.asarray(img))[:, top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    size = ((output_size, output_size) if isinstance(output_size, int)
+            else tuple(output_size))
+    return CenterCrop(size)(img)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    p = [padding] * 4 if isinstance(padding, int) else list(padding)
+    if len(p) == 2:
+        p = [p[0], p[1], p[0], p[1]]
+    arr = _chw(np.asarray(img))
+    # reference convention: (left, top, right, bottom)
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if padding_mode == "constant" else {}
+    return np.pad(arr, ((0, 0), (p[1], p[3]), (p[0], p[2])), mode=mode, **kw)
+
+
+def adjust_brightness(img, brightness_factor):
+    arr = _chw(np.asarray(img)).astype(np.float32)
+    hi = 255.0 if arr.max() > 1.5 else 1.0
+    return np.clip(arr * brightness_factor, 0, hi).astype(
+        np.asarray(img).dtype if np.asarray(img).dtype == np.uint8 else np.float32)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = _chw(np.asarray(img)).astype(np.float32)
+    hi = 255.0 if arr.max() > 1.5 else 1.0
+    mean = arr.mean()
+    return np.clip(mean + contrast_factor * (arr - mean), 0, hi).astype(np.float32)
+
+
+def _rgb_to_hsv(arr):
+    r, g, b = arr[0], arr[1], arr[2]
+    maxc = np.max(arr[:3], 0)
+    minc = np.min(arr[:3], 0)
+    v = maxc
+    d = maxc - minc
+    s = np.where(maxc > 0, d / np.maximum(maxc, 1e-8), 0)
+    rc = (maxc - r) / np.maximum(d, 1e-8)
+    gc = (maxc - g) / np.maximum(d, 1e-8)
+    bc = (maxc - b) / np.maximum(d, 1e-8)
+    h = np.where(maxc == r, bc - gc,
+                 np.where(maxc == g, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = np.where(d == 0, 0.0, h)
+    return (h / 6.0) % 1.0, s, v
+
+
+def _hsv_to_rgb(h, s, v):
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - s * f)
+    t = v * (1 - s * (1 - f))
+    i = i.astype(np.int32) % 6
+    r = np.choose(i, [v, q, p, p, t, v])
+    g = np.choose(i, [t, v, v, q, p, p])
+    b = np.choose(i, [p, p, t, v, v, q])
+    return np.stack([r, g, b])
+
+
+def adjust_hue(img, hue_factor):
+    assert -0.5 <= hue_factor <= 0.5, "hue_factor must be in [-0.5, 0.5]"
+    arr = _chw(np.asarray(img)).astype(np.float32)
+    scale = 255.0 if arr.max() > 1.5 else 1.0
+    h, s, v = _rgb_to_hsv(arr / scale)
+    h = (h + hue_factor) % 1.0
+    return (_hsv_to_rgb(h, s, v) * scale).astype(np.float32)
+
+
+def adjust_saturation(img, saturation_factor):
+    arr = _chw(np.asarray(img)).astype(np.float32)
+    gray = arr[:3].mean(0, keepdims=True)
+    hi = 255.0 if arr.max() > 1.5 else 1.0
+    return np.clip(gray + saturation_factor * (arr - gray), 0, hi).astype(np.float32)
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = _chw(np.asarray(img)).astype(np.float32)
+    w = np.array([0.299, 0.587, 0.114], np.float32).reshape(3, 1, 1)
+    gray = (arr[:3] * w).sum(0, keepdims=True)
+    return np.repeat(gray, num_output_channels, 0)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    arr = _chw(np.asarray(img))
+    out = arr if inplace else arr.copy()
+    out[:, i:i + h, j:j + w] = v
+    return out
+
+
+def _affine_grid_sample(arr, matrix, out_shape=None, fill=0):
+    """Inverse-warp sampling with bilinear interpolation: out(y, x) =
+    in(M @ [x, y, 1]). matrix: [2, 3] inverse affine map; out-of-bounds
+    samples take `fill`."""
+    c, h, w = arr.shape
+    oh, ow = out_shape or (h, w)
+    ys, xs = np.meshgrid(np.arange(oh, dtype=np.float32),
+                         np.arange(ow, dtype=np.float32), indexing="ij")
+    sx = matrix[0, 0] * xs + matrix[0, 1] * ys + matrix[0, 2]
+    sy = matrix[1, 0] * xs + matrix[1, 1] * ys + matrix[1, 2]
+    x0 = np.floor(sx).astype(np.int32)
+    y0 = np.floor(sy).astype(np.int32)
+    wx = sx - x0
+    wy = sy - y0
+    valid = (sx > -1) & (sx < w) & (sy > -1) & (sy < h)
+
+    def at(yy, xx):
+        yc = np.clip(yy, 0, h - 1)
+        xc = np.clip(xx, 0, w - 1)
+        return arr[:, yc, xc]
+
+    out = (at(y0, x0) * (1 - wy) * (1 - wx) + at(y0, x0 + 1) * (1 - wy) * wx
+           + at(y0 + 1, x0) * wy * (1 - wx) + at(y0 + 1, x0 + 1) * wy * wx)
+    return np.where(valid, out, np.float32(fill)).astype(np.float32)
+
+
+def _affine_matrix(angle, translate, scale, shear, center):
+    a = np.deg2rad(angle)
+    sx, sy = np.deg2rad(shear[0]), np.deg2rad(shear[1])
+    cx, cy = center
+    tx, ty = translate
+    # forward map: T(center) R(angle) Shear Scale T(-center) + translate
+    rot = np.array([[np.cos(a + sy), -np.sin(a + sx)],
+                    [np.sin(a + sy), np.cos(a + sx)]]) * scale
+    m = np.eye(3)
+    m[:2, :2] = rot
+    m[0, 2] = cx + tx - rot[0, 0] * cx - rot[0, 1] * cy
+    m[1, 2] = cy + ty - rot[1, 0] * cx - rot[1, 1] * cy
+    return np.linalg.inv(m)[:2]
+
+
+def affine(img, angle, translate, scale, shear, interpolation="bilinear",
+           fill=0, center=None):
+    arr = _chw(np.asarray(img)).astype(np.float32)
+    c, h, w = arr.shape
+    if isinstance(shear, (int, float)):
+        shear = (shear, 0.0)
+    center = center or ((w - 1) / 2, (h - 1) / 2)
+    return _affine_grid_sample(arr, _affine_matrix(angle, translate, scale,
+                                                   shear, center), fill=fill)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    arr = _chw(np.asarray(img)).astype(np.float32)
+    c, h, w = arr.shape
+    if not expand:
+        return affine(arr, angle, (0, 0), 1.0, (0.0, 0.0), center=center,
+                      fill=fill)
+    # expand: output canvas holds the whole rotated image (reference
+    # functional rotate expand=True)
+    a = np.deg2rad(angle)
+    ow = int(np.ceil(abs(w * np.cos(a)) + abs(h * np.sin(a))))
+    oh = int(np.ceil(abs(w * np.sin(a)) + abs(h * np.cos(a))))
+    cin = ((w - 1) / 2, (h - 1) / 2)
+    m = _affine_matrix(angle, (0, 0), 1.0, (0.0, 0.0), cin)
+    # shift output coords so the output center maps to the input center
+    shift = np.eye(3)
+    shift[0, 2] = (w - ow) / 2
+    shift[1, 2] = (h - oh) / 2
+    m3 = np.vstack([m, [0, 0, 1]]) @ shift
+    return _affine_grid_sample(arr, m3[:2], out_shape=(oh, ow), fill=fill)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest", fill=0):
+    """Projective warp from 4 point pairs (reference functional
+    perspective): solve the homography, inverse-sample."""
+    arr = _chw(np.asarray(img)).astype(np.float32)
+    A, b = [], []
+    # solve forward homography end -> start (inverse sampling map)
+    for (xs, ys), (xd, yd) in zip(startpoints, endpoints):
+        A.append([xd, yd, 1, 0, 0, 0, -xs * xd, -xs * yd])
+        A.append([0, 0, 0, xd, yd, 1, -ys * xd, -ys * yd])
+        b += [xs, ys]
+    hvec = np.linalg.lstsq(np.asarray(A, np.float64),
+                           np.asarray(b, np.float64), rcond=None)[0]
+    Hm = np.append(hvec, 1.0).reshape(3, 3)
+    c, h, w = arr.shape
+    ys, xs = np.meshgrid(np.arange(h, dtype=np.float32),
+                         np.arange(w, dtype=np.float32), indexing="ij")
+    denom = Hm[2, 0] * xs + Hm[2, 1] * ys + Hm[2, 2]
+    sx = (Hm[0, 0] * xs + Hm[0, 1] * ys + Hm[0, 2]) / denom
+    sy = (Hm[1, 0] * xs + Hm[1, 1] * ys + Hm[1, 2]) / denom
+    x0 = np.floor(sx).astype(np.int32)
+    y0 = np.floor(sy).astype(np.int32)
+    wx, wy = sx - x0, sy - y0
+    valid = (sx > -1) & (sx < w) & (sy > -1) & (sy < h)
+
+    def at(yy, xx):
+        return arr[:, np.clip(yy, 0, h - 1), np.clip(xx, 0, w - 1)]
+
+    out = (at(y0, x0) * (1 - wy) * (1 - wx) + at(y0, x0 + 1) * (1 - wy) * wx
+           + at(y0 + 1, x0) * wy * (1 - wx) + at(y0 + 1, x0 + 1) * wy * wx)
+    return np.where(valid, out, np.float32(fill)).astype(np.float32)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _chw(np.asarray(img))
+        f = pyrandom.uniform(-self.value, self.value)
+        return adjust_hue(img, f)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _chw(np.asarray(img))
+        f = pyrandom.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_saturation(img, f)
+
+
+class ColorJitter(BaseTransform):
+    """Random brightness/contrast/saturation/hue in random order
+    (reference transforms.py ColorJitter)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.brightness = brightness
+        self.contrast = contrast
+        self.saturation = saturation
+        self.hue = hue
+
+    def _apply_image(self, img):
+        ops = []
+        if self.brightness:
+            f = pyrandom.uniform(max(0, 1 - self.brightness), 1 + self.brightness)
+            ops.append(lambda a: adjust_brightness(a, f))
+        if self.contrast:
+            g = pyrandom.uniform(max(0, 1 - self.contrast), 1 + self.contrast)
+            ops.append(lambda a: adjust_contrast(a, g))
+        if self.saturation:
+            s = pyrandom.uniform(max(0, 1 - self.saturation), 1 + self.saturation)
+            ops.append(lambda a: adjust_saturation(a, s))
+        if self.hue:
+            hf = pyrandom.uniform(-self.hue, self.hue)
+            ops.append(lambda a: adjust_hue(a, hf))
+        pyrandom.shuffle(ops)
+        out = _chw(np.asarray(img))
+        for op in ops:
+            out = op(out)
+        return out
+
+
+class RandomResizedCrop(BaseTransform):
+    """Random area/aspect crop then resize (reference RandomResizedCrop)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        arr = _chw(np.asarray(img))
+        c, h, w = arr.shape
+        area = h * w
+        for _ in range(10):
+            target = area * pyrandom.uniform(*self.scale)
+            ar = np.exp(pyrandom.uniform(np.log(self.ratio[0]),
+                                         np.log(self.ratio[1])))
+            tw = int(round(np.sqrt(target * ar)))
+            th = int(round(np.sqrt(target / ar)))
+            if 0 < tw <= w and 0 < th <= h:
+                i = pyrandom.randint(0, h - th)
+                j = pyrandom.randint(0, w - tw)
+                patch = arr[:, i:i + th, j:j + tw]
+                return resize(patch, self.size, self.interpolation)
+        return resize(CenterCrop(min(h, w))(arr), self.size,
+                      self.interpolation)
+
+
+class RandomErasing(BaseTransform):
+    """Random rectangle erasing (reference RandomErasing / arXiv
+    1708.04896)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+
+    def _apply_image(self, img):
+        arr = _chw(np.asarray(img))
+        if pyrandom.random() > self.prob:
+            return arr
+        c, h, w = arr.shape
+        area = h * w
+        for _ in range(10):
+            target = area * pyrandom.uniform(*self.scale)
+            ar = pyrandom.uniform(*self.ratio)
+            eh = int(round(np.sqrt(target / ar)))
+            ew = int(round(np.sqrt(target * ar)))
+            if eh < h and ew < w:
+                i = pyrandom.randint(0, h - eh)
+                j = pyrandom.randint(0, w - ew)
+                if self.value == "random":
+                    # per-pixel noise in the image's value range
+                    hi = 255.0 if arr.max() > 1.5 else 1.0
+                    v = (np.random.rand(c, eh, ew) * hi).astype(arr.dtype)
+                else:
+                    v = self.value
+                return erase(arr, i, j, eh, ew, v, self.inplace)
+        return arr
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        self.degrees = ((-degrees, degrees) if isinstance(degrees, (int, float))
+                        else tuple(degrees))
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.center = center
+
+    def _apply_image(self, img):
+        arr = _chw(np.asarray(img))
+        c, h, w = arr.shape
+        angle = pyrandom.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate:
+            tx = pyrandom.uniform(-self.translate[0], self.translate[0]) * w
+            ty = pyrandom.uniform(-self.translate[1], self.translate[1]) * h
+        sc = pyrandom.uniform(*self.scale) if self.scale else 1.0
+        if isinstance(self.shear, (list, tuple)):
+            sh = pyrandom.uniform(self.shear[0], self.shear[1])
+        elif self.shear:
+            sh = pyrandom.uniform(-self.shear, self.shear)
+        else:
+            sh = 0.0
+        return affine(arr, angle, (tx, ty), sc, (sh, 0.0), center=self.center)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+
+    def _apply_image(self, img):
+        arr = _chw(np.asarray(img))
+        if pyrandom.random() > self.prob:
+            return arr
+        c, h, w = arr.shape
+        d = self.distortion_scale
+        dx = int(d * w / 2)
+        dy = int(d * h / 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [(pyrandom.randint(0, dx), pyrandom.randint(0, dy)),
+               (w - 1 - pyrandom.randint(0, dx), pyrandom.randint(0, dy)),
+               (w - 1 - pyrandom.randint(0, dx), h - 1 - pyrandom.randint(0, dy)),
+               (pyrandom.randint(0, dx), h - 1 - pyrandom.randint(0, dy))]
+        return perspective(arr, start, end)

@@ -1,0 +1,235 @@
+"""Full namespace-parity sweep (AST-parsed reference __all__ lists,
+including += aug-assigns) + behavior checks for the final long-tail batch:
+vision transforms/models/datasets, audio IO, distributed compat, text
+datasets, profiler enums."""
+import ast
+import pathlib
+import importlib
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _ref_all(rel):
+    p = pathlib.Path("/root/reference") / rel
+    if not p.exists():
+        return None
+    names = []
+    tree = ast.parse(p.read_text())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__":
+                    try:
+                        names += [ast.literal_eval(e) for e in node.value.elts]
+                    except Exception:
+                        pass
+        elif isinstance(node, ast.AugAssign):
+            if isinstance(node.target, ast.Name) and node.target.id == "__all__":
+                try:
+                    names += [ast.literal_eval(e) for e in node.value.elts]
+                except Exception:
+                    pass
+    return names
+
+
+SWEEP = [
+    ("distributed", "python/paddle/distributed/__init__.py"),
+    ("vision", "python/paddle/vision/__init__.py"),
+    ("vision.transforms", "python/paddle/vision/transforms/__init__.py"),
+    ("vision.models", "python/paddle/vision/models/__init__.py"),
+    ("vision.datasets", "python/paddle/vision/datasets/__init__.py"),
+    ("audio", "python/paddle/audio/__init__.py"),
+    ("utils", "python/paddle/utils/__init__.py"),
+    ("text", "python/paddle/text/__init__.py"),
+    ("profiler", "python/paddle/profiler/__init__.py"),
+    ("amp", "python/paddle/amp/__init__.py"),
+    ("distribution", "python/paddle/distribution/__init__.py"),
+]
+
+
+@pytest.mark.parametrize("name,rel", SWEEP, ids=[m for m, _ in SWEEP])
+def test_namespace_covered(name, rel):
+    names = _ref_all(rel)
+    if names is None:
+        pytest.skip("reference tree not available")
+    target = importlib.import_module("paddle_tpu." + name)
+    missing = sorted(n for n in set(names) if not hasattr(target, n))
+    assert missing == [], missing
+
+
+def test_transform_color_and_geometry():
+    from paddle_tpu.vision import transforms as T
+
+    rs = np.random.RandomState(0)
+    img = (rs.rand(3, 16, 16) * 255).astype(np.uint8)
+    np.testing.assert_allclose(T.adjust_brightness(img, 1.0),
+                               img.astype(np.float32), atol=1e-4)
+    dark = T.adjust_brightness(img, 0.5)
+    assert dark.mean() < img.mean()
+    g = T.to_grayscale(img, 3)
+    assert g.shape == (3, 16, 16) and np.allclose(g[0], g[1])
+    h = T.adjust_hue(img, 0.25)
+    assert h.shape == img.shape
+    # identity affine returns the image
+    ident = T.affine(img.astype(np.float32), 0, (0, 0), 1.0, (0, 0))
+    np.testing.assert_allclose(ident, img.astype(np.float32), atol=1e-3)
+    rot = T.rotate(img.astype(np.float32), 90)
+    assert rot.shape == img.shape
+    er = T.erase(img, 2, 2, 4, 4, 0)
+    assert (er[:, 2:6, 2:6] == 0).all()
+    out = T.RandomResizedCrop(8)(img)
+    assert out.shape == (3, 8, 8)
+    out = T.RandomErasing(prob=1.0)(img.astype(np.float32))
+    assert out.shape == img.shape
+    out = T.ColorJitter(0.4, 0.4, 0.4, 0.2)(img)
+    assert out.shape == img.shape
+    out = T.RandomPerspective(prob=1.0)(img)
+    assert out.shape == img.shape
+    # (left, top, right, bottom) per the reference convention
+    pads = T.pad(img, [1, 2, 3, 4])
+    assert pads.shape == (3, 16 + 2 + 4, 16 + 1 + 3)
+    # identity perspective
+    pts = [(0, 0), (15, 0), (15, 15), (0, 15)]
+    np.testing.assert_allclose(T.perspective(img.astype(np.float32), pts, pts),
+                               img.astype(np.float32), atol=1e-2)
+
+
+def test_resnext_groups_actually_differ():
+    paddle.seed(0)
+    a = paddle.vision.models.resnext50_32x4d(num_classes=4)
+    b = paddle.vision.models.resnet50(num_classes=4)
+    # grouped conv weight shapes differ from vanilla bottleneck
+    wa = {n: tuple(p.shape) for n, p in a.named_parameters()}
+    wb = {n: tuple(p.shape) for n, p in b.named_parameters()}
+    assert wa != wb
+    x = paddle.to_tensor(np.random.RandomState(0).randn(1, 3, 32, 32)
+                         .astype("float32"))
+    assert a(x).shape == (1, 4)
+    assert paddle.vision.models.wide_resnet50_2(num_classes=4)(x).shape == (1, 4)
+
+
+def test_flowers_voc_datasets():
+    f = paddle.vision.datasets.Flowers(mode="test")
+    img, lab = f[0]
+    assert img.shape == (32, 32, 3) and 0 <= lab < 102
+    v = paddle.vision.datasets.VOC2012()
+    img, mask = v[3]
+    assert mask.shape == (32, 32) and mask.max() < 21
+
+
+def test_audio_roundtrip_and_datasets(tmp_path):
+    sr = 8000
+    t = np.arange(sr, dtype=np.float32) / sr
+    wav = paddle.to_tensor((0.25 * np.sin(2 * np.pi * 440 * t))[None])
+    p = str(tmp_path / "a.wav")
+    paddle.audio.save(p, wav, sr)
+    inf = paddle.audio.info(p)
+    assert inf.sample_rate == sr and inf.num_channels == 1
+    w2, sr2 = paddle.audio.load(p)
+    assert sr2 == sr
+    np.testing.assert_allclose(w2.numpy(), wav.numpy(), atol=2e-4)
+    # offset/num_frames window
+    w3, _ = paddle.audio.load(p, frame_offset=100, num_frames=50)
+    assert w3.shape == (1, 50)
+    assert "wave" in paddle.audio.backends.list_available_backends()
+    ds = paddle.audio.datasets.ESC50(mode="test")
+    w, lab = ds[0]
+    assert 0 <= lab < 50 and w.dtype == np.float32
+
+
+def test_distributed_compat_surface():
+    d = paddle.distributed
+    assert d.is_available()
+    assert d.ParallelMode.DATA_PARALLEL == 0
+    t = paddle.to_tensor(np.ones(2, np.float32))
+    task = d.isend(t, dst=0)
+    assert task.wait() and task.is_completed()
+    objs = [{"a": 1}, "txt"]
+    out = d.broadcast_object_list(objs, src=0)
+    assert out[0] == {"a": 1}
+    got = []
+    d.scatter_object_list(got, [[1, 2]], src=0)
+    assert got == [[1, 2]]
+    with pytest.raises(ValueError):
+        d.CountFilterEntry(-1)
+    assert d.ProbabilityEntry(0.5)._to_attr().startswith("probability")
+    assert d.ShowClickEntry("s", "c")._to_attr() == "show_click_entry:s:c"
+
+
+def test_inmemory_and_queue_dataset(tmp_path):
+    p = tmp_path / "slots.txt"
+    p.write_text("1 2 3\n4 5 6\n7 8 9\n")
+    ds = paddle.distributed.InMemoryDataset()
+    ds.init(batch_size=2)
+    ds.set_filelist([str(p)])
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 3
+    batches = list(ds)
+    assert len(batches) == 2 and len(batches[0]) == 2
+    q = paddle.distributed.QueueDataset()
+    q.init(batch_size=2)
+    q.set_filelist([str(p)])
+    with pytest.raises(RuntimeError):
+        q.load_into_memory()
+    assert sum(len(b) for b in q) == 3
+
+
+def test_text_dataset_exports():
+    for cls in (paddle.text.Imdb, paddle.text.UCIHousing):
+        assert cls is not None
+    c = paddle.text.Conll05st(mode="test")
+    assert len(c[0]) == 9
+    w = paddle.text.WMT14(mode="test")
+    src, ti, tn = w[0]
+    assert ti[0] == 1 and tn[-1] == 2
+
+
+def test_profiler_enums_and_protobuf(tmp_path):
+    from paddle_tpu import profiler as prof
+
+    assert prof.SortedKeys.CPUTotal == 0
+    assert hasattr(prof.SummaryView, "KernelView")
+    handler = prof.export_protobuf(str(tmp_path))
+
+    class _P:
+        _events = [("op", 1.0)]
+
+    out = handler(_P())
+    assert pathlib.Path(out).exists()
+
+
+def test_transform_review_fixes():
+    from paddle_tpu.vision import transforms as T
+    from paddle_tpu.vision.models import shufflenet_v2_swish
+    from paddle_tpu import nn
+
+    # swish actually wired through the activations
+    m = shufflenet_v2_swish(num_classes=2)
+    acts = [type(l).__name__.lower() for l in m.sublayers()]
+    assert "swish" in acts and "relu" not in acts
+    # BaseTransform passes labels through
+    img = (np.random.RandomState(0).rand(3, 8, 8) * 255).astype(np.uint8)
+    gray, label = T.Grayscale()((img, 7))
+    assert label == 7 and gray.shape[0] == 1
+    # fill honored on rotate; expand grows the canvas
+    white = T.rotate(np.ones((3, 8, 8), np.float32), 45, fill=5.0)
+    assert white.max() == 5.0
+    big = T.rotate(np.ones((3, 8, 8), np.float32), 45, expand=True)
+    assert big.shape[1] > 8 and big.shape[2] > 8
+    # sequence shear accepted
+    out = T.RandomAffine(degrees=0, shear=[10, 10])(img.astype(np.float32))
+    assert out.shape == img.shape
+    # random-value erasing writes per-pixel noise on uint8
+    np.random.seed(0)
+    er = T.RandomErasing(prob=1.0, value="random")(img)
+    assert er.shape == img.shape
+
+
+def test_require_version():
+    assert paddle.utils.require_version("0.0.1")
+    assert paddle.utils.require_version("0.1", max_version="0.1")
+    with pytest.raises(Exception):
+        paddle.utils.require_version("99.0.0")
