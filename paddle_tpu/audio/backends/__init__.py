@@ -1,9 +1,36 @@
 """Audio IO backends (reference: python/paddle/audio/backends/ —
 wave_backend.py load/save/info over the stdlib wave module, plus the
-backend registry init_backend.py)."""
-from .wave_backend import info, load, save
+backend registry init_backend.py). load/save/info dispatch through the
+CURRENTLY SELECTED backend (set_backend), like the reference."""
+from . import wave_backend
 from .init_backend import (get_current_backend, list_available_backends,
                            set_backend)
 
 __all__ = ["info", "load", "save", "get_current_backend",
            "list_available_backends", "set_backend"]
+
+
+def _backend():
+    if get_current_backend() == "soundfile":
+        import soundfile  # noqa: F401  (module itself acts via sf API)
+
+        from . import soundfile_backend
+
+        return soundfile_backend
+    return wave_backend
+
+
+def info(filepath):
+    return _backend().info(filepath)
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    return _backend().load(filepath, frame_offset, num_frames, normalize,
+                           channels_first)
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         bits_per_sample=16):
+    return _backend().save(filepath, src, sample_rate, channels_first,
+                           bits_per_sample)

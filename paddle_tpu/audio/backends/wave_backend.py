@@ -61,8 +61,12 @@ def save(filepath, src, sample_rate, channels_first=True,
         arr = arr.T                      # -> [T, C]
     if arr.dtype.kind == "f":
         arr = np.clip(arr, -1.0, 1.0)
-        arr = (arr * (2 ** (bits_per_sample - 1) - 1)).astype(
-            {8: np.int16, 16: np.int16, 32: np.int32}[bits_per_sample])
+        if bits_per_sample == 8:
+            # 8-bit WAV is UNSIGNED, one byte per sample
+            arr = ((arr * 127) + 128).astype(np.uint8)
+        else:
+            arr = (arr * (2 ** (bits_per_sample - 1) - 1)).astype(
+                {16: np.int16, 32: np.int32}[bits_per_sample])
     with _wave.open(filepath, "wb") as f:
         f.setnchannels(arr.shape[1] if arr.ndim > 1 else 1)
         f.setsampwidth(bits_per_sample // 8)

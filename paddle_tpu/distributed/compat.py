@@ -21,7 +21,7 @@ from ..core.tensor import Tensor
 __all__ = [
     "ParallelMode", "CountFilterEntry", "ProbabilityEntry", "ShowClickEntry",
     "InMemoryDataset", "QueueDataset", "broadcast_object_list",
-    "scatter_object_list", "get_backend", "gloo_init_parallel_env",
+    "scatter_object_list", "gloo_init_parallel_env",
     "gloo_barrier", "gloo_release", "is_available", "isend", "irecv", "split",
 ]
 

@@ -18,10 +18,9 @@ def save_persistables(executor, dirname, main_program=None, filename=None):
     prog = main_program or default_main_program()
     params = {(t.name or f"param_{i}"): t
               for i, t in enumerate(prog._captured_params())
-              if is_persistable(t) or True}
+              if is_persistable(t)}
     os.makedirs(dirname, exist_ok=True)
-    save({k: v for k, v in params.items()},
-         os.path.join(dirname, filename or "__params__.pdparams"))
+    save(params, os.path.join(dirname, filename or "__params__.pdparams"))
 
 
 def load_persistables(executor, dirname, main_program=None, filename=None):

@@ -9,6 +9,7 @@ import jax.numpy as jnp
 from ...nn.layer import Layer
 from ...nn.common import Linear, Dropout
 from ...nn.norm import LayerNorm
+from ...nn.initializer import Constant
 from ...nn import container as nn_container
 from ...nn import functional as F
 
@@ -398,3 +399,87 @@ class FusedEcMoe(Layer):
 
 
 from . import functional  # noqa: E402  (needs fused_ec_moe above)
+
+
+class FusedLinear(Layer):
+    """Linear whose matmul+bias ride one fused XLA kernel (reference
+    incubate/nn/layer/fc.py FusedLinear over fused_gemm_epilogue)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self._transpose = transpose_weight
+        shape = ([out_features, in_features] if transpose_weight
+                 else [in_features, out_features])
+        self.weight = self.create_parameter(shape, attr=weight_attr)
+        self.bias = self.create_parameter([out_features], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x):
+        from .functional import fused_linear
+
+        return fused_linear(x, self.weight, self.bias,
+                            transpose_weight=self._transpose)
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """out = LayerNorm(residual + dropout(x + bias)) in one fused region
+    (reference incubate/nn/layer/fused_transformer.py
+    FusedBiasDropoutResidualLayerNorm)."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+        self.linear_bias = self.create_parameter([embed_dim], attr=bias_attr,
+                                                 is_bias=True)
+        self.ln_scale = self.create_parameter(
+            [embed_dim], attr=weight_attr,
+            default_initializer=Constant(1.0))
+        self.ln_bias = self.create_parameter([embed_dim], is_bias=True)
+
+    def forward(self, x, residual):
+        from .functional import fused_bias_dropout_residual_layer_norm
+
+        return fused_bias_dropout_residual_layer_norm(
+            x, residual, bias=self.linear_bias, ln_scale=self.ln_scale,
+            ln_bias=self.ln_bias, dropout_rate=self.dropout_rate,
+            ln_epsilon=self.epsilon, training=self.training)
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """Encoder layer over the fused attention + FFN ops (reference
+    incubate/nn/layer/fused_transformer.py FusedTransformerEncoderLayer)."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        # None defaults to dropout_rate (reference fused_transformer.py)
+        attn_dropout_rate = (dropout_rate if attn_dropout_rate is None
+                             else attn_dropout_rate)
+        act_dropout_rate = (dropout_rate if act_dropout_rate is None
+                            else act_dropout_rate)
+        self.attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate,
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        out = self.attn(src, attn_mask=src_mask, cache=cache)
+        if isinstance(out, tuple):
+            out, cache_out = out
+            return self.ffn(out), cache_out
+        return self.ffn(out)
+
+
+__all__ += ["FusedLinear", "FusedBiasDropoutResidualLayerNorm",
+            "FusedTransformerEncoderLayer"]

@@ -130,3 +130,86 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
         out = F.layer_norm(out, normalized_shape=shape, weight=ln_scale,
                            bias=ln_bias, epsilon=ln_epsilon)
     return out
+
+
+def fused_multi_transformer(
+        x, ln_scales, ln_biases, qkv_weights, qkv_biases, linear_weights,
+        linear_biases, ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+        ffn1_biases, ffn2_weights, ffn2_biases, pre_layer_norm=True,
+        epsilon=1e-5, cache_kvs=None, time_step=None, attn_mask=None,
+        dropout_rate=0.0, activation="gelu", training=False,
+        mode="upscale_in_train", trans_qkvw=True, ring_id=-1, name=None):
+    """Functional fused multi-transformer (reference
+    incubate/nn/functional/fused_transformer.py fused_multi_transformer ->
+    fused_multi_transformer_op.cu). Builds the FusedMultiTransformer layer
+    over the given per-layer weights and runs it once, threading CacheKV.
+
+    qkv_weights accepts the reference 4-D layout ([3, num_heads, head_dim,
+    embed_dim] when trans_qkvw else [embed_dim, 3, num_heads, head_dim])
+    or plain Linear-shaped [embed_dim, 3*embed_dim]."""
+    import jax.numpy as jnp
+
+    from ...core.tensor import Tensor
+    from .. import nn as _inc_nn
+
+    def arr(t):
+        return t._data if isinstance(t, Tensor) else jnp.asarray(t)
+
+    e = x.shape[-1]
+    num_layers = len(qkv_weights)
+    q0 = arr(qkv_weights[0])
+    if q0.ndim == 4:
+        nh = q0.shape[1] if trans_qkvw else q0.shape[2]
+    elif cache_kvs is not None:
+        nh = arr(cache_kvs[0]).shape[2]
+    else:
+        raise ValueError(
+            "2-D qkv weights need cache_kvs to infer num_heads "
+            "(or pass the reference 4-D qkv layout)")
+    f = arr(ffn1_weights[0]).shape[-1]
+    if not pre_layer_norm:
+        raise ValueError(
+            "fused_multi_transformer on this backend is pre-LN only "
+            "(FusedMultiTransformer contract; reference's post-LN variant "
+            "is unsupported)")
+
+    from ...framework.compat import LazyGuard
+
+    with LazyGuard():
+        # zeros-init under the guard: every parameter is overwritten below,
+        # so skip the (per-call) random initializer work
+        layer = _inc_nn.FusedMultiTransformer(
+            embed_dim=e, num_heads=nh, dim_feedforward=f,
+            dropout_rate=dropout_rate, activation=activation,
+            normalize_before=pre_layer_norm, num_layers=num_layers,
+            epsilon=epsilon)
+
+    def qkv_2d(w):
+        w = arr(w)
+        if w.ndim == 4:
+            if trans_qkvw:                 # [3, H, D, E] -> [E, 3HD]
+                return w.reshape(-1, e).T
+            return w.reshape(e, -1)        # [E, 3, H, D] -> [E, 3HD]
+        return w
+
+    for i in range(num_layers):
+        blk = layer.layers[i]
+        blk["ln1"].weight._set_data(arr(ln_scales[i]))
+        blk["ln1"].bias._set_data(arr(ln_biases[i]))
+        blk["qkv"].weight._set_data(qkv_2d(qkv_weights[i]))
+        blk["qkv"].bias._set_data(arr(qkv_biases[i]).reshape(-1))
+        blk["out"].weight._set_data(arr(linear_weights[i]))
+        blk["out"].bias._set_data(arr(linear_biases[i]))
+        blk["ln2"].weight._set_data(arr(ffn_ln_scales[i]))
+        blk["ln2"].bias._set_data(arr(ffn_ln_biases[i]))
+        blk["ffn1"].weight._set_data(arr(ffn1_weights[i]))
+        blk["ffn1"].bias._set_data(arr(ffn1_biases[i]))
+        blk["ffn2"].weight._set_data(arr(ffn2_weights[i]))
+        blk["ffn2"].bias._set_data(arr(ffn2_biases[i]))
+    if not training:
+        layer.eval()
+    return layer(x, attn_mask=attn_mask, caches=cache_kvs,
+                 time_step=time_step)
+
+
+__all__ += ["fused_multi_transformer"]

@@ -12,6 +12,7 @@ from .rnn import *  # noqa: F401,F403
 from . import functional
 from . import initializer
 from .utils_ import clip_grad_norm_, clip_grad_value_, parameters_to_vector, vector_to_parameters
+from . import utils
 
 from . import common, conv, norm, activation, pooling, container, loss, transformer, rnn
 

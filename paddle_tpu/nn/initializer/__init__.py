@@ -195,3 +195,49 @@ class Dirac(Initializer):
             idx = (i, i % ic) + tuple(centers)
             out[idx] = 1.0
         return jnp.asarray(out).astype(convert_dtype(dtype))
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsample kernel init for transposed conv (reference
+    nn/initializer/Bilinear): weight[c_out, c_in, kh, kw] filled with the
+    separable triangle kernel."""
+
+    def __call__(self, shape, dtype):
+        import numpy as np
+
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer expects a 4-D conv weight")
+        kh, kw = shape[2], shape[3]
+
+        def tri(k):
+            f = (k + 1) // 2
+            c = f - 1 if k % 2 == 1 else f - 0.5
+            return (1 - abs((np.arange(k) - c) / f))
+
+        kern = np.outer(tri(kh), tri(kw)).astype("float32")
+        w = np.zeros(shape, "float32")
+        for i in range(shape[0]):
+            for j in range(shape[1]):
+                w[i, j] = kern
+        import jax.numpy as jnp
+
+        return jnp.asarray(w, dtype)
+
+
+_global_initializer = [None]
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Default initializers for subsequently created parameters (reference
+    nn/initializer/set_global_initializer). Pass None to reset."""
+    _global_initializer[0] = (weight_init, bias_init)
+
+
+def _global_init_for(is_bias):
+    g = _global_initializer[0]
+    if g is None:
+        return None
+    return g[1] if is_bias else g[0]
+
+
+__all__ += ["Bilinear", "set_global_initializer"]

@@ -126,9 +126,15 @@ class Layer:
         if attr is False:
             return None
         dtype = convert_dtype(dtype) or self._dtype
-        init = attr.initializer or default_initializer or (
-            Constant(0.0) if is_bias else XavierNormal()
-        )
+        from .initializer import _global_init_for
+
+        # priority (reference layer_helper_base.py:374-384): an explicit
+        # ParamAttr initializer wins; otherwise a set GLOBAL initializer
+        # REPLACES the layer-supplied default (yes, including norm scales
+        # — the reference behaves the same; its docs warn about it)
+        init = (attr.initializer or _global_init_for(is_bias)
+                or default_initializer
+                or (Constant(0.0) if is_bias else XavierNormal()))
         from ..framework.compat import LazyGuard
 
         shape_t = tuple(int(s) for s in shape)

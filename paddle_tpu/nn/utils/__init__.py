@@ -1,0 +1,115 @@
+"""nn.utils (reference: python/paddle/nn/utils/ — weight_norm /
+remove_weight_norm / spectral_norm hooks + parameter flattening).
+
+weight_norm reparametrizes w = g * v / ||v|| with (g, v) as the trainable
+parameters, recomputed in a forward-pre-hook — the dygraph formulation of
+the reference's WeightNormParamAttr static rewrite. spectral_norm divides
+the weight by its leading singular value via power iteration."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..utils_ import (  # noqa: F401
+    clip_grad_norm_, clip_grad_value_, parameters_to_vector,
+    vector_to_parameters,
+)
+from ..layer import Parameter
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters",
+           "clip_grad_norm_", "clip_grad_value_"]
+
+
+def _norm_except(v, dim):
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return (v * v).sum(axis=axes, keepdim=True).sqrt()
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Apply weight normalization to `layer.name` (reference
+    nn/utils/weight_norm_hook.py): replaces the parameter with
+    (name_g, name_v); every forward recomputes w = g * v/||v||."""
+    w = getattr(layer, name)
+    if dim is None:
+        dim = -1
+    if dim < 0:
+        dim += w.ndim if dim != -1 else 1   # dim=None semantics: whole-tensor
+    g = Parameter(_norm_except(w, dim)._data)
+    v = Parameter(jnp.array(w._data, copy=True))
+    del layer._parameters[name]
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+
+    def _recompute(lyr, inputs):
+        gv = getattr(lyr, name + "_g")
+        vv = getattr(lyr, name + "_v")
+        w_new = vv * (gv / _norm_except(vv, dim))
+        object.__setattr__(lyr, name, w_new)
+        return inputs
+
+    handle = layer.register_forward_pre_hook(_recompute)
+    layer._weight_norm_hook = (handle, name, dim)
+    _recompute(layer, ())
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Fold (g, v) back into a single parameter and drop the hook."""
+    handle, pname, dim = layer._weight_norm_hook
+    handle.remove()
+    g = getattr(layer, pname + "_g")
+    v = getattr(layer, pname + "_v")
+    w = v * (g / _norm_except(v, dim))
+    del layer._parameters[pname + "_g"]
+    del layer._parameters[pname + "_v"]
+    layer.add_parameter(pname, Parameter(w._data))
+    del layer._weight_norm_hook
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=0):
+    """Spectral normalization (reference nn/utils/spectral_norm_hook.py):
+    w_sn = w / sigma_max(w), sigma estimated by power iteration on the
+    [dim, -1] reshaped weight; u persists as a buffer across steps."""
+    from ...core.tensor import Tensor
+
+    w = getattr(layer, name)
+    mat = np.asarray(w._data)
+    if dim != 0:
+        order = [dim] + [i for i in range(mat.ndim) if i != dim]
+        mat = mat.transpose(order)
+    h = mat.shape[0]
+    rng = np.random.RandomState(0)
+    u0 = rng.randn(h).astype(np.float32)
+    layer.register_buffer(name + "_u",
+                          Tensor(jnp.asarray(u0 / np.linalg.norm(u0))))
+
+    def _recompute(lyr, inputs):
+        wt = getattr(lyr, name + "_orig")
+        arr = wt._data
+        if dim != 0:
+            order = [dim] + [i for i in range(arr.ndim) if i != dim]
+            arr2 = jnp.transpose(arr, order)
+        else:
+            arr2 = arr
+        m = arr2.reshape(arr2.shape[0], -1)
+        u = getattr(lyr, name + "_u")._data
+        for _ in range(n_power_iterations):
+            v = m.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+            u = m @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        sigma = u @ m @ v
+        lyr._buffers[name + "_u"]._data = u
+        object.__setattr__(lyr, name, Tensor(arr / sigma,
+                                             stop_gradient=wt.stop_gradient))
+        return inputs
+
+    orig = Parameter(jnp.array(w._data, copy=True))
+    del layer._parameters[name]
+    layer.add_parameter(name + "_orig", orig)
+    layer.register_forward_pre_hook(_recompute)
+    _recompute(layer, ())
+    return layer

@@ -481,6 +481,8 @@ class Grayscale(BaseTransform):
 class HueTransform(BaseTransform):
     def __init__(self, value, keys=None):
         super().__init__(keys)
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
         self.value = value
 
     def _apply_image(self, img):
@@ -509,6 +511,8 @@ class ColorJitter(BaseTransform):
     def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
                  keys=None):
         super().__init__(keys)
+        if not 0 <= hue <= 0.5:
+            raise ValueError("hue must be in [0, 0.5]")
         self.brightness = brightness
         self.contrast = contrast
         self.saturation = saturation

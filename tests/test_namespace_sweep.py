@@ -233,3 +233,89 @@ def test_require_version():
     assert paddle.utils.require_version("0.1", max_version="0.1")
     with pytest.raises(Exception):
         paddle.utils.require_version("99.0.0")
+
+
+def test_incubate_nn_and_initializer_namespaces():
+    for name, rel in [
+            ("incubate.nn", "python/paddle/incubate/nn/__init__.py"),
+            ("incubate.nn.functional",
+             "python/paddle/incubate/nn/functional/__init__.py"),
+            ("nn.initializer", "python/paddle/nn/initializer/__init__.py"),
+            ("nn.utils", "python/paddle/nn/utils/__init__.py")]:
+        names = _ref_all(rel)
+        if names is None:
+            pytest.skip("reference tree not available")
+        target = importlib.import_module("paddle_tpu." + name)
+        missing = sorted(n for n in set(names) if not hasattr(target, n))
+        assert missing == [], f"{name}: {missing}"
+
+
+def test_weight_and_spectral_norm():
+    from paddle_tpu import nn
+
+    lin = nn.Linear(4, 6)
+    w_before = lin.weight.numpy().copy()
+    nn.utils.weight_norm(lin, dim=1)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 4).astype("float32"))
+    y1 = lin(x)
+    # reparam preserves the function at init
+    np.testing.assert_allclose(np.asarray(lin.weight._data), w_before,
+                               rtol=1e-5)
+    nn.utils.remove_weight_norm(lin)
+    np.testing.assert_allclose(lin(x).numpy(), y1.numpy(), rtol=1e-5)
+    assert "weight_g" not in dict(lin.named_parameters())
+
+    sn = nn.Linear(4, 4)
+    nn.utils.spectral_norm(sn, n_power_iterations=4)
+    _ = sn(x)
+    s_max = np.linalg.svd(np.asarray(sn.weight._data),
+                          compute_uv=False)[0]
+    assert s_max < 1.2
+
+
+def test_bilinear_init_and_global_initializer():
+    from paddle_tpu import nn
+
+    init = paddle.nn.initializer.Bilinear()
+    w = init((2, 2, 4, 4), "float32")
+    # bilinear kernel is symmetric with peak at center
+    k = np.asarray(w)[0, 0]
+    assert np.allclose(k, k[::-1]) and np.allclose(k, k[:, ::-1])
+    paddle.nn.initializer.set_global_initializer(
+        paddle.nn.initializer.Constant(0.25))
+    try:
+        l = nn.Linear(3, 3)
+        assert np.allclose(l.weight.numpy(), 0.25)
+    finally:
+        paddle.nn.initializer.set_global_initializer(None)
+
+
+def test_fused_layer_classes_and_functional_fmt():
+    inc = paddle.incubate.nn
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 4).astype("float32"))
+    assert inc.FusedLinear(4, 8)(x).shape == (2, 8)
+    fb = inc.FusedBiasDropoutResidualLayerNorm(4, dropout_rate=0.0)
+    out = fb(x, x)
+    np.testing.assert_allclose(out.numpy().mean(-1), 0.0, atol=1e-5)
+    enc = inc.FusedTransformerEncoderLayer(8, 2, 16, dropout_rate=0.0)
+    seq = paddle.to_tensor(np.random.RandomState(1).randn(2, 5, 8)
+                           .astype("float32"))
+    assert enc(seq).shape == (2, 5, 8)
+
+    L, E, F_ = 2, 8, 16
+    ones = lambda n: paddle.to_tensor(np.ones(n, np.float32))  # noqa: E731
+    zeros = lambda n: paddle.to_tensor(np.zeros(n, np.float32))  # noqa: E731
+    mk = lambda *s: paddle.to_tensor(  # noqa: E731
+        np.random.RandomState(sum(s)).randn(*s).astype("float32") * 0.05)
+    src = paddle.to_tensor(np.random.RandomState(2).randn(1, 4, E)
+                           .astype("float32"))
+    out = paddle.incubate.nn.functional.fused_multi_transformer(
+        src, [ones(E)] * L, [zeros(E)] * L, [mk(E, 3 * E)] * L,
+        [zeros(3 * E)] * L, [mk(E, E)] * L, [zeros(E)] * L, [ones(E)] * L,
+        [zeros(E)] * L, [mk(E, F_)] * L, [zeros(F_)] * L, [mk(F_, E)] * L,
+        [zeros(E)] * L,
+        cache_kvs=[paddle.to_tensor(np.zeros((2, 1, 2, 16, 4), np.float32))
+                   for _ in range(L)],
+        time_step=0)
+    o = out[0] if isinstance(out, tuple) else out
+    assert o.shape == (1, 4, E) and np.isfinite(o.numpy()).all()
