@@ -1,0 +1,46 @@
+"""Optional soundfile backend (reference audio/backends dispatch target):
+used when the `soundfile` package is installed and selected via
+set_backend('soundfile') — handles FLAC/OGG/etc. beyond the wave module."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from .wave_backend import AudioInfo
+
+__all__ = ["info", "load", "save"]
+
+
+def info(filepath):
+    import soundfile as sf
+
+    i = sf.info(filepath)
+    bits = {"PCM_16": 16, "PCM_24": 24, "PCM_32": 32, "PCM_U8": 8,
+            "FLOAT": 32, "DOUBLE": 64}.get(i.subtype, 16)
+    return AudioInfo(i.samplerate, i.frames, i.channels, bits,
+                     encoding=i.subtype)
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    import soundfile as sf
+
+    stop = None if num_frames < 0 else frame_offset + num_frames
+    data, sr = sf.read(filepath, start=frame_offset, stop=stop,
+                       dtype="float32" if normalize else "int16",
+                       always_2d=True)
+    arr = data.T if channels_first else data
+    return Tensor(jnp.asarray(np.ascontiguousarray(arr))), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         bits_per_sample=16):
+    import soundfile as sf
+
+    arr = np.asarray(src._data if isinstance(src, Tensor) else src)
+    if channels_first:
+        arr = arr.T
+    subtype = {8: "PCM_U8", 16: "PCM_16", 24: "PCM_24", 32: "PCM_32"}[
+        bits_per_sample]
+    sf.write(filepath, arr, int(sample_rate), subtype=subtype)

@@ -474,11 +474,12 @@ class FusedTransformerEncoderLayer(Layer):
             normalize_before=normalize_before)
 
     def forward(self, src, src_mask=None, cache=None):
-        out = self.attn(src, attn_mask=src_mask, cache=cache)
-        if isinstance(out, tuple):
-            out, cache_out = out
-            return self.ffn(out), cache_out
-        return self.ffn(out)
+        if cache is not None:
+            raise NotImplementedError(
+                "FusedTransformerEncoderLayer incremental cache is not "
+                "wired; use FusedMultiTransformer's CacheKV decode path "
+                "(gen_cache + time_step) for autoregressive decoding")
+        return self.ffn(self.attn(src, attn_mask=src_mask))
 
 
 __all__ += ["FusedLinear", "FusedBiasDropoutResidualLayerNorm",

@@ -175,14 +175,20 @@ def fused_multi_transformer(
 
     from ...framework.compat import LazyGuard
 
-    with LazyGuard():
-        # zeros-init under the guard: every parameter is overwritten below,
-        # so skip the (per-call) random initializer work
-        layer = _inc_nn.FusedMultiTransformer(
-            embed_dim=e, num_heads=nh, dim_feedforward=f,
-            dropout_rate=dropout_rate, activation=activation,
-            normalize_before=pre_layer_norm, num_layers=num_layers,
-            epsilon=epsilon)
+    key = (e, nh, f, num_layers, epsilon, dropout_rate, activation)
+    layer = _FMT_CACHE.get(key)
+    if layer is None:
+        with LazyGuard():
+            # zeros-init under the guard: every parameter is overwritten
+            # below, so skip the random initializer work; the layer shell
+            # is memoized per geometry — per-decode-step calls only pay
+            # the weight rebinds
+            layer = _inc_nn.FusedMultiTransformer(
+                embed_dim=e, num_heads=nh, dim_feedforward=f,
+                dropout_rate=dropout_rate, activation=activation,
+                normalize_before=pre_layer_norm, num_layers=num_layers,
+                epsilon=epsilon)
+        _FMT_CACHE[key] = layer
 
     def qkv_2d(w):
         w = arr(w)
@@ -211,5 +217,7 @@ def fused_multi_transformer(
     return layer(x, attn_mask=attn_mask, caches=cache_kvs,
                  time_step=time_step)
 
+
+_FMT_CACHE = {}
 
 __all__ += ["fused_multi_transformer"]

@@ -22,7 +22,12 @@ __all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
 
 
 def _norm_except(v, dim):
-    axes = tuple(i for i in range(v.ndim) if i != dim)
+    """||v|| reduced over every axis except `dim`; dim=None reduces over
+    ALL axes (whole-tensor norm — the reference's -1 sentinel)."""
+    if dim is None:
+        axes = tuple(range(v.ndim))
+    else:
+        axes = tuple(i for i in range(v.ndim) if i != dim)
     return (v * v).sum(axis=axes, keepdim=True).sqrt()
 
 
@@ -31,10 +36,8 @@ def weight_norm(layer, name="weight", dim=0):
     nn/utils/weight_norm_hook.py): replaces the parameter with
     (name_g, name_v); every forward recomputes w = g * v/||v||."""
     w = getattr(layer, name)
-    if dim is None:
-        dim = -1
-    if dim < 0:
-        dim += w.ndim if dim != -1 else 1   # dim=None semantics: whole-tensor
+    if dim is not None and dim < 0:
+        dim += w.ndim                        # -1 = last axis, like numpy
     g = Parameter(_norm_except(w, dim)._data)
     v = Parameter(jnp.array(w._data, copy=True))
     del layer._parameters[name]
@@ -63,7 +66,12 @@ def remove_weight_norm(layer, name="weight"):
     w = v * (g / _norm_except(v, dim))
     del layer._parameters[pname + "_g"]
     del layer._parameters[pname + "_v"]
-    layer.add_parameter(pname, Parameter(w._data))
+    restored = Parameter(w._data)
+    layer.add_parameter(pname, restored)
+    # the hook wrote a plain Tensor into the instance __dict__, which
+    # shadows _parameters on attribute lookup — rebind it to the restored
+    # Parameter or training silently stops affecting the forward
+    object.__setattr__(layer, pname, restored)
     del layer._weight_norm_hook
     return layer
 
@@ -96,7 +104,9 @@ def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
             arr2 = arr
         m = arr2.reshape(arr2.shape[0], -1)
         u = getattr(lyr, name + "_u")._data
-        for _ in range(n_power_iterations):
+        # at least one iteration: v is derived from u, not persisted
+        # (n_power_iterations=0 callers reuse u but still need a v)
+        for _ in range(max(1, n_power_iterations)):
             v = m.T @ u
             v = v / jnp.maximum(jnp.linalg.norm(v), eps)
             u = m @ v

@@ -319,3 +319,44 @@ def test_fused_layer_classes_and_functional_fmt():
         time_step=0)
     o = out[0] if isinstance(out, tuple) else out
     assert o.shape == (1, 4, E) and np.isfinite(o.numpy()).all()
+
+
+def test_remove_weight_norm_keeps_training_live():
+    from paddle_tpu import nn
+
+    lin = nn.Linear(3, 3)
+    nn.utils.weight_norm(lin)
+    x = paddle.to_tensor(np.ones((2, 3), np.float32))
+    _ = lin(x)
+    nn.utils.remove_weight_norm(lin)
+    opt = paddle.optimizer.SGD(learning_rate=0.5,
+                               parameters=lin.parameters())
+    y0 = lin(x).numpy().copy()
+    loss = (lin(x) ** 2).mean()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    # the update must be visible through the layer's forward (the hook's
+    # stale instance attribute used to shadow the restored Parameter)
+    assert not np.allclose(lin(x).numpy(), y0)
+
+
+def test_weight_norm_dim_none_scalar_g():
+    from paddle_tpu import nn
+
+    lin = nn.Linear(4, 6)
+    w = np.asarray(lin.weight._data)
+    nn.utils.weight_norm(lin, dim=None)
+    g = np.asarray(lin.weight_g._data)
+    assert g.size == 1
+    np.testing.assert_allclose(float(g.ravel()[0]), np.linalg.norm(w),
+                               rtol=1e-5)
+
+
+def test_spectral_norm_zero_iterations():
+    from paddle_tpu import nn
+
+    sn = nn.Linear(4, 4)
+    nn.utils.spectral_norm(sn, n_power_iterations=0)
+    x = paddle.to_tensor(np.ones((1, 4), np.float32))
+    assert np.isfinite(sn(x).numpy()).all()

@@ -1,0 +1,196 @@
+"""Op-surface coverage, part 4: the round-2 long-tail additions —
+ops/extras (stat/search/manipulation/math) and nn.functional/extras
+losses — with output + finite-difference grad checks through the shared
+OpTest harness.
+
+Documented exclusions (no OpTest by design):
+- host-side / integer-output ops (bucketize, count_nonzero, histogram,
+  tril/triu_indices, unique_consecutive, broadcast_shape, mode/kthvalue
+  indices, take): no meaningful gradient; values asserted in
+  test_api_compat.py.
+- random fills (poisson, standard_normal, randint_like, uniform_,
+  exponential_): nondeterministic; statistics asserted in
+  test_api_compat.py.
+- class_center_sample / graph_khop_sampler: dynamic output shapes,
+  covered in test_api_compat.py.
+- rnnt_loss: validated against a path-enumeration oracle in
+  test_nn_extras.py (FD through the log-lattice is numerically unstable).
+- sparse_attention / gather_tree: integer-pattern driven; parity tests in
+  test_nn_extras.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+from test_ops_suite2 import make_op_test, _rs, _f32
+
+
+def _reg(*cases):
+    for c in cases:
+        cls = make_op_test(**c)
+        globals()[cls.__name__] = cls
+
+
+def _pos(seed, *shape):
+    def go():
+        return (_rs(seed).rand(*shape) * 0.8 + 0.1).astype("float32")
+    return go
+
+
+_SIGNS = np.sign(_rs(100).randn(8)).astype("float32")
+_MLAB = (_rs(101).rand(4, 5) > 0.5).astype("float32")
+_DLAB = _rs(102).randint(0, 3, (2, 6)).astype("int64")
+
+
+def _index_add_ref(x, v):
+    out = x.copy()
+    out[0] += v[0]
+    out[2] += v[1]
+    return out
+
+
+def _softmax_np(x):
+    e = np.exp(x - x.max(-1, keepdims=True))
+    return e / e.sum(-1, keepdims=True)
+
+
+def _dice_ref(x):
+    p = _softmax_np(x)
+    onehot = np.eye(3, dtype=np.float32)[_DLAB]
+    inter = (p * onehot).sum(axis=(1, 2))
+    union = p.sum(axis=(1, 2)) + onehot.sum(axis=(1, 2))
+    return np.mean(1 - (2 * inter + 1e-5) / (union + 1e-5))
+
+
+def _unfold_ref(x):
+    n, c, h, w = x.shape
+    cols = []
+    for i in range(0, h, 2):
+        for j in range(0, w, 2):
+            cols.append(x[:, :, i:i + 2, j:j + 2].reshape(n, -1))
+    return np.stack(cols, -1)
+
+
+# -- stat / reduction extras -------------------------------------------------
+_reg(
+    dict(name="Std", op=lambda x: paddle.std(x),
+         ref=lambda x: np.std(x, ddof=1),
+         inputs_fn=lambda: {"x": _f32(1, 4, 5)()}),
+    dict(name="Var", op=lambda x: paddle.var(x, axis=1),
+         ref=lambda x: np.var(x, axis=1, ddof=1),
+         inputs_fn=lambda: {"x": _f32(2, 4, 5)()}),
+    dict(name="NanSum", op=lambda x: paddle.nansum(x, axis=0),
+         ref=lambda x: np.nansum(x, axis=0),
+         inputs_fn=lambda: {"x": _f32(3, 3, 4)()}),
+    dict(name="NanMean", op=lambda x: paddle.nanmean(x),
+         ref=lambda x: np.nanmean(x),
+         inputs_fn=lambda: {"x": _f32(4, 6)()}),
+    dict(name="Quantile", op=lambda x: paddle.quantile(x, 0.5, axis=1),
+         ref=lambda x: np.quantile(x, 0.5, axis=1),
+         inputs_fn=lambda: {"x": _f32(5, 3, 7)()}),
+    dict(name="Median", op=lambda x: paddle.median(x, axis=1),
+         ref=lambda x: np.median(x, axis=1),
+         inputs_fn=lambda: {"x": _f32(6, 3, 7)()}),
+)
+
+# -- math extras -------------------------------------------------------------
+_reg(
+    dict(name="Logit", op=lambda x: paddle.logit(x),
+         ref=lambda x: np.log(x / (1 - x)),
+         inputs_fn=lambda: {"x": _pos(7, 3, 4)()}),
+    dict(name="Heaviside", op=lambda x, y: paddle.heaviside(x, y),
+         ref=lambda x, y: np.heaviside(x, y),
+         inputs_fn=lambda: {"x": _f32(8, 3, 4, offset=0.3)(),
+                            "y": _f32(9, 3, 4)()},
+         grad=False),    # a.e.-zero gradient; FD at the step is undefined
+    dict(name="Sgn", op=lambda x: paddle.sgn(x) * x,
+         ref=lambda x: np.sign(x) * x,
+         inputs_fn=lambda: {"x": _f32(10, 3, 4, offset=0.4)()}),
+    dict(name="Dist", op=lambda x, y: paddle.dist(x, y, p=2),
+         ref=lambda x, y: np.sqrt(((x - y) ** 2).sum()),
+         inputs_fn=lambda: {"x": _f32(11, 3, 4)(), "y": _f32(12, 3, 4)()}),
+    dict(name="Renorm", op=lambda x: paddle.renorm(x, 2.0, 0, 1.0),
+         ref=lambda x: x * np.minimum(
+             1.0, 1.0 / (np.sqrt((x ** 2).sum(axis=(1, 2), keepdims=True))
+                         + 1e-7)),
+         inputs_fn=lambda: {"x": _f32(13, 2, 3, 4, scale=2.0)()},
+         rtol=1e-3, atol=1e-4, tol=2e-2),
+    dict(name="Mv", op=lambda x, y: paddle.mv(x, y),
+         ref=lambda x, y: x @ y,
+         inputs_fn=lambda: {"x": _f32(14, 4, 5)(), "y": _f32(15, 5)()}),
+    dict(name="AddN", op=lambda x, y: paddle.add_n([x, y]),
+         ref=lambda x, y: x + y,
+         inputs_fn=lambda: {"x": _f32(16, 3, 4)(), "y": _f32(17, 3, 4)()}),
+    dict(name="Diff", op=lambda x: paddle.diff(x, axis=1),
+         ref=lambda x: np.diff(x, axis=1),
+         inputs_fn=lambda: {"x": _f32(18, 3, 6)()}),
+    dict(name="Reverse", op=lambda x: paddle.reverse(x, axis=1),
+         ref=lambda x: x[:, ::-1],
+         inputs_fn=lambda: {"x": _f32(19, 3, 4)()}),
+    dict(name="DiagEmbed", op=lambda x: F.diag_embed(x),
+         ref=lambda x: np.stack([np.diag(r) for r in x]),
+         inputs_fn=lambda: {"x": _f32(20, 3, 4)()}),
+    dict(name="IndexAdd",
+         op=lambda x, v: paddle.index_add(
+             x, paddle.to_tensor(np.array([0, 2], np.int64)), 0, v),
+         ref=_index_add_ref,
+         inputs_fn=lambda: {"x": _f32(21, 3, 4)(), "v": _f32(22, 2, 4)()}),
+    dict(name="Crop", op=lambda x: paddle.crop(x, shape=[2, 2],
+                                               offsets=[1, 1]),
+         ref=lambda x: x[1:3, 1:3],
+         inputs_fn=lambda: {"x": _f32(23, 4, 5)()}),
+    dict(name="Multiplex",
+         op=lambda a, b: paddle.multiplex(
+             [a, b], paddle.to_tensor(np.array([[0], [1], [0]], np.int32))),
+         ref=lambda a, b: np.stack([a[0], b[1], a[2]]),
+         inputs_fn=lambda: {"a": _f32(24, 3, 4)(), "b": _f32(25, 3, 4)()}),
+)
+
+# -- nn.functional extras losses --------------------------------------------
+_reg(
+    dict(name="SoftMarginLoss",
+         op=lambda x: F.soft_margin_loss(
+             x, paddle.to_tensor(_SIGNS), reduction="mean"),
+         ref=lambda x: np.log1p(np.exp(-_SIGNS * x)).mean(),
+         inputs_fn=lambda: {"x": _f32(26, 8)()}),
+    dict(name="MultiLabelSoftMargin",
+         op=lambda x: F.multi_label_soft_margin_loss(
+             x, paddle.to_tensor(_MLAB), reduction="mean"),
+         ref=lambda x: (-(_MLAB * np.log(1 / (1 + np.exp(-x)))
+                          + (1 - _MLAB) * np.log(1 - 1 / (1 + np.exp(-x))))
+                        ).mean(-1).mean(),
+         inputs_fn=lambda: {"x": _f32(27, 4, 5)()}),
+    dict(name="PairwiseDistance",
+         op=lambda x, y: F.pairwise_distance(x, y),
+         ref=lambda x, y: np.linalg.norm(x - y + 1e-6, axis=-1),
+         inputs_fn=lambda: {"x": _f32(28, 3, 4)(), "y": _f32(29, 3, 4)()}),
+    dict(name="BilinearFn",
+         op=lambda x, y, w: F.bilinear(x, y, w),
+         ref=lambda x, y, w: np.einsum("bi,oij,bj->bo", x, w, y),
+         inputs_fn=lambda: {"x": _f32(30, 4, 3)(), "y": _f32(31, 4, 5)(),
+                            "w": _f32(32, 2, 3, 5)()}),
+    dict(name="Unfold",
+         op=lambda x: F.unfold(x, 2, strides=2),
+         ref=_unfold_ref,
+         inputs_fn=lambda: {"x": _f32(33, 1, 2, 4, 4)()}),
+    dict(name="ZeroPad2DFn",
+         op=lambda x: F.zeropad2d(x, [1, 1, 1, 1]),
+         ref=lambda x: np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1))),
+         inputs_fn=lambda: {"x": _f32(34, 1, 2, 3, 3)()}),
+    dict(name="DiceLoss",
+         op=lambda x: F.dice_loss(F.softmax(x, axis=-1),
+                                  paddle.to_tensor(_DLAB)),
+         ref=_dice_ref,
+         inputs_fn=lambda: {"x": _f32(35, 2, 6, 3)()},
+         rtol=1e-4, atol=1e-5, tol=2e-2),
+    dict(name="SoftmaxMaskFuse",
+         op=lambda x: paddle.incubate.softmax_mask_fuse(
+             x, paddle.to_tensor(_FMASK)),
+         ref=lambda x: _softmax_np(x + _FMASK),
+         inputs_fn=lambda: {"x": _f32(36, 2, 2, 4, 4)()},
+         tol=2e-2),
+)
+
+_FMASK = ((_rs(103).rand(2, 1, 4, 4) > 0.7) * -1e4).astype("float32")
