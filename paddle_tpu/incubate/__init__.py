@@ -1,11 +1,12 @@
 """paddle_tpu.incubate (reference: python/paddle/incubate/ — fused layers,
 MoE, autograd functional; populated across rounds)."""
 from . import nn
+from . import distributed
 from . import autograd
 from . import asp
 from . import optimizer
 
-__all__ = ["nn", "autograd", "asp", "optimizer"]
+__all__ = ["nn", "autograd", "asp", "optimizer", "distributed"]
 
 # graph ops (reference incubate.graph_* — earlier homes of what became
 # paddle.geometric; SURVEY §8.11) re-exported over the geometric kernels

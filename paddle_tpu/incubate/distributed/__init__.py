@@ -1,0 +1,5 @@
+"""incubate.distributed (reference: python/paddle/incubate/distributed/ —
+MoE models; the fleet/PS pieces live under paddle.distributed here)."""
+from . import models  # noqa: F401
+
+__all__ = ["models"]

@@ -1,0 +1,15 @@
+"""Mixture-of-Experts layer + gates (reference:
+python/paddle/incubate/distributed/models/moe/ — moe_layer.py:260 MoELayer
+with gate/{naive,gshard,switch}_gate.py, dispatched via
+global_scatter/global_gather all-to-alls).
+
+TPU-native: routing/dispatch ride the same capacity-factor machinery as
+parallel/moe.py (one lax.all_to_all each way on the 'ep' mesh axis under
+shard_map; dense one-hot dispatch/combine einsums locally). Experts are
+arbitrary Layers: each expert runs on its [capacity, d_model] slice, so
+per-token FLOPs are k * cf * expert_cost — independent of num_experts.
+"""
+from .gate import BaseGate, NaiveGate, GShardGate, SwitchGate
+from .moe_layer import MoELayer
+
+__all__ = ["MoELayer", "BaseGate", "NaiveGate", "GShardGate", "SwitchGate"]

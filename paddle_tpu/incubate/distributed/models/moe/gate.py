@@ -1,0 +1,55 @@
+"""MoE gates (reference: incubate/distributed/models/moe/gate/*.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .....nn.layer import Layer
+from .....nn.common import Linear
+
+__all__ = ["BaseGate", "NaiveGate", "GShardGate", "SwitchGate"]
+
+
+class BaseGate(Layer):
+    """Gate contract (base_gate.py): maps [T, d_model] -> routing logits
+    [T, num_expert * world_size]; top_k set by subclass."""
+
+    def __init__(self, d_model, num_expert, world_size=1, top_k=2):
+        super().__init__()
+        self.d_model = d_model
+        self.tot_expert = num_expert * world_size
+        self.top_k = top_k
+        self.loss = None
+
+    def forward(self, x):
+        raise NotImplementedError
+
+
+class NaiveGate(BaseGate):
+    """Plain linear top-k gate (naive_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, top_k=2):
+        super().__init__(d_model, num_expert, world_size, top_k)
+        self.gate = Linear(d_model, self.tot_expert)
+
+    def forward(self, x):
+        return self.gate(x)
+
+
+class GShardGate(NaiveGate):
+    """Naive gate + GShard load-balance auxiliary loss (gshard_gate.py);
+    the aux loss of the last forward lands in `self.loss`."""
+
+    def __init__(self, d_model, num_expert, world_size=1, top_k=2,
+                 capacity=(1.2, 2.4), random_routing=True, group=None):
+        super().__init__(d_model, num_expert, world_size, top_k)
+        self.capacity = capacity
+
+
+class SwitchGate(NaiveGate):
+    """Top-1 switch routing (switch_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, top_k=1,
+                 switch_eps=0.1, capacity=(1.2, 2.4), group=None):
+        super().__init__(d_model, num_expert, world_size, top_k=1)
+        self.switch_eps = switch_eps

@@ -1,0 +1,106 @@
+"""MoELayer (reference moe_layer.py:260): gate -> capacity dispatch ->
+experts -> combine. See package docstring for the TPU-native dispatch."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .....core.tensor import Tensor
+from .....core.dispatch import apply
+from .....nn.layer import Layer
+from .....nn import container as nn_container
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
+
+__all__ = ["MoELayer"]
+
+_GATES = {"naive": NaiveGate, "gshard": GShardGate, "switch": SwitchGate}
+
+
+class MoELayer(Layer):
+    """gate -> dispatch -> experts -> combine (reference MoELayer).
+
+    experts: list/LayerList of expert Layers, each [*, d_model] ->
+    [*, d_model]. gate: name ('naive' | 'gshard' | 'switch'), a BaseGate
+    instance, or a dict {"type": name, ...kwargs}. The GShard aux loss of
+    the last forward is exposed as `self.l_aux` (and on the gate's
+    `.loss`), matching the reference training recipe.
+    """
+
+    def __init__(self, d_model, experts, gate=None, moe_group=None,
+                 mp_group=None, recompute_interval=0, top_k=2, **kwargs):
+        super().__init__()
+        self.d_model = d_model
+        if isinstance(experts, (list, tuple)):
+            experts = nn_container.LayerList(list(experts))
+        self.experts = experts
+        num_expert = len(experts)
+        if gate is None:
+            gate = "gshard"
+        if isinstance(gate, dict):
+            cfg = dict(gate)
+            gate = cfg.pop("type", "gshard")
+            kwargs.update(cfg)
+        if isinstance(gate, str):
+            cls = _GATES[gate]
+            gate = cls(d_model, num_expert,
+                       top_k=(1 if cls is SwitchGate else top_k))
+        if not isinstance(gate, BaseGate):
+            raise TypeError(f"gate must be a name or BaseGate, got {gate!r}")
+        self.gate = gate
+        self.top_k = gate.top_k
+        self.l_aux = None
+
+    def forward(self, inp):
+        orig_shape = inp.shape
+        x = inp.reshape([-1, self.d_model]) if len(orig_shape) != 2 else inp
+        logits = self.gate(x)                       # [T, E]
+        E = len(self.experts)
+        T = x.shape[0]
+        capacity = max(1, int(2.0 * T * self.top_k / E))
+        top_k = self.top_k
+
+        def route(lg):
+            probs = jax.nn.softmax(lg, -1)
+            vals, idx = jax.lax.top_k(probs, top_k)        # [T, k]
+            disp = jnp.zeros((T, E, capacity), probs.dtype)
+            combine = jnp.zeros((T, E, capacity), probs.dtype)
+            # running per-expert slot counter ACROSS the k passes — a token
+            # routed to expert e at k=1 must not collide with slots the
+            # k=0 pass already filled
+            base = jnp.zeros((E,), probs.dtype)
+            for k in range(top_k):
+                e_k = idx[:, k]
+                onehot = jax.nn.one_hot(e_k, E, dtype=probs.dtype)  # [T, E]
+                # position of each token within its expert's capacity
+                pos = (base[None, :] + jnp.cumsum(onehot, 0)
+                       - onehot) * onehot                           # [T, E]
+                in_cap = (pos < capacity)
+                sel = onehot * in_cap
+                p = jnp.clip(pos.astype(jnp.int32), 0, capacity - 1)
+                disp_k = sel[:, :, None] * jax.nn.one_hot(
+                    p, capacity, dtype=probs.dtype)
+                disp = disp + disp_k
+                combine = combine + disp_k * vals[:, k][:, None, None]
+                base = base + onehot.sum(0)
+            # GShard aux loss: E * mean(fraction) . mean(prob) per expert
+            frac = jnp.mean(jax.nn.one_hot(idx[:, 0], E, dtype=probs.dtype),
+                            axis=0)
+            mean_p = probs.mean(0)
+            aux = E * jnp.sum(frac * mean_p)
+            return disp, combine, aux
+
+        disp_t, comb_t, aux_t = apply(route, logits, name="moe_route")
+        # dispatch: [T,E,C] x [T,H] -> per-expert slices [E, C, H]
+        expert_in = apply(lambda d, a: jnp.einsum("tec,th->ech", d, a),
+                          disp_t, x, name="moe_dispatch")
+        outs = []
+        for e, expert in enumerate(self.experts):
+            outs.append(expert(expert_in[e]))
+        stacked = apply(lambda *os: jnp.stack(os), *outs, name="moe_stack")
+        y = apply(lambda c, s: jnp.einsum("tec,ech->th", c, s),
+                  comb_t, stacked, name="moe_combine")
+        self.l_aux = aux_t
+        self.gate.loss = aux_t
+        if len(orig_shape) != 2:
+            y = y.reshape(list(orig_shape))
+        return y

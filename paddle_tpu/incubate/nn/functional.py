@@ -178,6 +178,8 @@ def fused_multi_transformer(
     key = (e, nh, f, num_layers, epsilon, dropout_rate, activation)
     layer = _FMT_CACHE.get(key)
     if layer is None:
+        _FMT_CACHE.clear()   # size-1 cache: decode loops reuse ONE geometry;
+        #                      don't pin weight sets for stale geometries
         with LazyGuard():
             # zeros-init under the guard: every parameter is overwritten
             # below, so skip the random initializer work; the layer shell
@@ -212,8 +214,9 @@ def fused_multi_transformer(
         blk["ffn1"].bias._set_data(arr(ffn1_biases[i]))
         blk["ffn2"].weight._set_data(arr(ffn2_weights[i]))
         blk["ffn2"].bias._set_data(arr(ffn2_biases[i]))
-    if not training:
-        layer.eval()
+    # set the mode EVERY call: the memoized shell would otherwise keep a
+    # previous call's eval() sticky and silently disable training dropout
+    layer.train() if training else layer.eval()
     return layer(x, attn_mask=attn_mask, caches=cache_kvs,
                  time_step=time_step)
 

@@ -36,8 +36,10 @@ def weight_norm(layer, name="weight", dim=0):
     nn/utils/weight_norm_hook.py): replaces the parameter with
     (name_g, name_v); every forward recomputes w = g * v/||v||."""
     w = getattr(layer, name)
-    if dim is not None and dim < 0:
-        dim += w.ndim                        # -1 = last axis, like numpy
+    if dim == -1:
+        dim = None       # reference norm_except_dim sentinel: whole-tensor
+    elif dim is not None and dim < 0:
+        dim += w.ndim
     g = Parameter(_norm_except(w, dim)._data)
     v = Parameter(jnp.array(w._data, copy=True))
     del layer._parameters[name]
@@ -104,15 +106,19 @@ def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
             arr2 = arr
         m = arr2.reshape(arr2.shape[0], -1)
         u = getattr(lyr, name + "_u")._data
-        # at least one iteration: v is derived from u, not persisted
-        # (n_power_iterations=0 callers reuse u but still need a v)
-        for _ in range(max(1, n_power_iterations)):
+        for _ in range(n_power_iterations):
             v = m.T @ u
             v = v / jnp.maximum(jnp.linalg.norm(v), eps)
             u = m @ v
             u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        if n_power_iterations <= 0:
+            # frozen-u mode (reference n_power_iterations=0): derive v from
+            # the persisted u WITHOUT advancing or persisting it
+            v = m.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+        else:
+            lyr._buffers[name + "_u"]._data = u
         sigma = u @ m @ v
-        lyr._buffers[name + "_u"]._data = u
         object.__setattr__(lyr, name, Tensor(arr / sigma,
                                              stop_gradient=wt.stop_gradient))
         return inputs

@@ -96,3 +96,49 @@ def test_gpt_moe_aux_loss_exposed():
     moe_blocks = [blk for blk in model.gpt.h
                   if type(blk.mlp).__name__ == "GPTMoEMLP"]
     assert moe_blocks and all(b.mlp.aux_loss is not None for b in moe_blocks)
+
+
+def test_incubate_moe_layer_capacity_and_parity():
+    """incubate MoELayer (reference moe_layer.py:260): with generous
+    capacity and top_k=E, the combine reproduces the dense prob-weighted
+    mixture of experts."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+
+    paddle.seed(1)
+    d, E = 8, 2
+    experts = [paddle.nn.Linear(d, d) for _ in range(E)]
+    moe = MoELayer(d_model=d, experts=experts, gate="naive", top_k=E)
+    x = paddle.to_tensor(np.random.RandomState(2).randn(4, d)
+                         .astype("float32"))
+    y = moe(x).numpy()
+    # dense reference: softmax(gate) weighted sum of all experts
+    import jax
+    logits = moe.gate(x).numpy()
+    probs = np.asarray(jax.nn.softmax(logits, -1))
+    dense = sum(probs[:, e:e + 1] * experts[e](x).numpy() for e in range(E))
+    np.testing.assert_allclose(y, dense, rtol=1e-4, atol=1e-5)
+
+
+def test_incubate_moe_gates_and_aux():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.distributed.models.moe import (
+        GShardGate, MoELayer, NaiveGate, SwitchGate)
+
+    paddle.seed(0)
+    d = 8
+    experts = [paddle.nn.Linear(d, d) for _ in range(4)]
+    x = paddle.to_tensor(np.random.RandomState(3).randn(3, 5, d)
+                         .astype("float32"))
+    for gate in ("naive", "gshard", "switch",
+                 GShardGate(d, 4), {"type": "switch"}):
+        moe = MoELayer(d_model=d, experts=experts, gate=gate)
+        out = moe(x)
+        assert out.shape == (3, 5, d)
+        assert np.isfinite(float(moe.l_aux))
+    import pytest as _pytest
+
+    with _pytest.raises(TypeError):
+        MoELayer(d_model=d, experts=experts, gate=123)

@@ -360,3 +360,56 @@ def test_spectral_norm_zero_iterations():
     nn.utils.spectral_norm(sn, n_power_iterations=0)
     x = paddle.to_tensor(np.ones((1, 4), np.float32))
     assert np.isfinite(sn(x).numpy()).all()
+
+
+def test_spectral_norm_frozen_u_is_deterministic():
+    from paddle_tpu import nn
+
+    sn = nn.Linear(4, 4)
+    nn.utils.spectral_norm(sn, n_power_iterations=0)
+    x = paddle.to_tensor(np.ones((1, 4), np.float32))
+    y1 = sn(x).numpy()
+    y2 = sn(x).numpy()
+    np.testing.assert_allclose(y1, y2)   # u must not drift per forward
+
+
+def test_weight_norm_dim_minus_one_is_whole_tensor():
+    from paddle_tpu import nn
+
+    lin = nn.Linear(4, 6)
+    w = np.asarray(lin.weight._data)
+    nn.utils.weight_norm(lin, dim=-1)
+    assert np.asarray(lin.weight_g._data).size == 1
+    np.testing.assert_allclose(
+        float(np.asarray(lin.weight_g._data).ravel()[0]),
+        np.linalg.norm(w), rtol=1e-5)
+
+
+def test_fused_multi_transformer_mode_not_sticky():
+    import paddle_tpu.incubate.nn.functional as incF
+
+    L, E, F_ = 1, 8, 16
+    ones = lambda n: paddle.to_tensor(np.ones(n, np.float32))  # noqa: E731
+    zeros = lambda n: paddle.to_tensor(np.zeros(n, np.float32))  # noqa: E731
+    mk = lambda *s: paddle.to_tensor(  # noqa: E731
+        np.random.RandomState(sum(s)).randn(*s).astype("float32") * 0.05)
+    src = paddle.to_tensor(np.random.RandomState(2).randn(1, 4, E)
+                           .astype("float32"))
+    args = ([ones(E)] * L, [zeros(E)] * L, [mk(E, 3 * E)] * L,
+            [zeros(3 * E)] * L, [mk(E, E)] * L, [zeros(E)] * L,
+            [ones(E)] * L, [zeros(E)] * L, [mk(E, F_)] * L, [zeros(F_)] * L,
+            [mk(F_, E)] * L, [zeros(E)] * L)
+    kw = dict(cache_kvs=[paddle.to_tensor(
+        np.zeros((2, 1, 2, 16, 4), np.float32))], time_step=0)
+    # eval call first, then a training call with dropout: outputs must
+    # DIFFER across training calls (dropout live, mode not sticky)
+    incF.fused_multi_transformer(src, *args, dropout_rate=0.5,
+                                 training=False, **kw)
+    paddle.seed(7)
+    o1 = incF.fused_multi_transformer(src, *args, dropout_rate=0.5,
+                                      training=True, **kw)
+    o2 = incF.fused_multi_transformer(src, *args, dropout_rate=0.5,
+                                      training=True, **kw)
+    a1 = (o1[0] if isinstance(o1, tuple) else o1).numpy()
+    a2 = (o2[0] if isinstance(o2, tuple) else o2).numpy()
+    assert not np.allclose(a1, a2)
