@@ -1,7 +1,9 @@
 """fleet.utils (reference: fleet/utils/ — recompute, hybrid_parallel_util)."""
 from .recompute import recompute, recompute_sequential
+from . import fs
+from .fs import LocalFS, HDFSClient
 
-__all__ = ["recompute", "recompute_sequential", "fused_allreduce_gradients"]
+__all__ = ["recompute", "recompute_sequential", "fused_allreduce_gradients", "fs", "LocalFS", "HDFSClient"]
 
 
 def fused_allreduce_gradients(parameter_list, hcg=None):

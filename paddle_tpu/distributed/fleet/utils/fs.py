@@ -1,0 +1,199 @@
+"""Filesystem clients (reference: fleet/utils/fs.py — FS base, LocalFS,
+HDFSClient over the hadoop CLI). LocalFS is fully native; HDFSClient
+shells out to `hadoop fs` exactly like the reference (and raises with
+guidance when no hadoop binary exists on the host)."""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+
+__all__ = ["FS", "LocalFS", "HDFSClient", "ExecuteError"]
+
+
+class ExecuteError(Exception):
+    """A hadoop CLI invocation failed (reference fs.py ExecuteError)."""
+
+
+class FS:
+    """Abstract FS contract (reference fs.py:49)."""
+
+    def ls_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_file(self, fs_path):
+        raise NotImplementedError
+
+    def is_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_exist(self, fs_path):
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path):
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path):
+        raise NotImplementedError
+
+    def mkdirs(self, fs_path):
+        raise NotImplementedError
+
+    def delete(self, fs_path):
+        raise NotImplementedError
+
+    def need_upload_download(self):
+        raise NotImplementedError
+
+    def rename(self, fs_src_path, fs_dst_path):
+        raise NotImplementedError
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False,
+           test_exists=False):
+        raise NotImplementedError
+
+    def list_dirs(self, fs_path):
+        raise NotImplementedError
+
+    def touch(self, fs_path, exist_ok=True):
+        raise NotImplementedError
+
+    def cat(self, fs_path=None):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """Local filesystem client (reference fs.py:113)."""
+
+    def ls_dir(self, fs_path):
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for e in os.listdir(fs_path):
+            (dirs if os.path.isdir(os.path.join(fs_path, e))
+             else files).append(e)
+        return dirs, files
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def delete(self, fs_path):
+        if os.path.isdir(fs_path):
+            shutil.rmtree(fs_path)
+        elif os.path.exists(fs_path):
+            os.remove(fs_path)
+
+    def need_upload_download(self):
+        return False
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
+        if test_exists:
+            if not self.is_exist(src_path):
+                raise FileNotFoundError(src_path)
+            if not overwrite and self.is_exist(dst_path):
+                raise FileExistsError(dst_path)
+        if overwrite and self.is_exist(dst_path):
+            self.delete(dst_path)
+        shutil.move(src_path, dst_path)
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if not exist_ok:
+                raise FileExistsError(fs_path)
+            return
+        with open(fs_path, "a"):
+            pass
+
+    def cat(self, fs_path=None):
+        with open(fs_path) as f:
+            return f.read()
+
+
+class HDFSClient(FS):
+    """HDFS client over the hadoop CLI (reference fs.py:383 HDFSClient:
+    every op is `hadoop fs -<cmd>` with configs passed through)."""
+
+    def __init__(self, hadoop_home, configs=None, time_out=5 * 60 * 1000,
+                 sleep_inter=1000):
+        self._timeout_s = max(1.0, time_out / 1000.0)
+        self._base = [os.path.join(hadoop_home, "bin", "hadoop"), "fs"]
+        if configs:
+            for k, v in configs.items():
+                self._base += ["-D", f"{k}={v}"]
+        if not os.path.exists(self._base[0]):
+            raise RuntimeError(
+                f"hadoop CLI not found at {self._base[0]} — HDFSClient "
+                "drives the hadoop binary (reference behavior); install "
+                "hadoop or use LocalFS")
+
+    def _run(self, *args, check=False):
+        proc = subprocess.run([*self._base, *args], capture_output=True,
+                              text=True, timeout=self._timeout_s)
+        if check and proc.returncode != 0:
+            raise ExecuteError(
+                f"hadoop fs {' '.join(args)} failed (rc={proc.returncode}): "
+                f"{proc.stderr.strip()[-500:]}")
+        return proc.returncode, proc.stdout
+
+    def ls_dir(self, fs_path):
+        rc, out = self._run("-ls", fs_path)
+        dirs, files = [], []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = os.path.basename(parts[-1])
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def is_exist(self, fs_path):
+        return self._run("-test", "-e", fs_path)[0] == 0
+
+    def is_file(self, fs_path):
+        return self._run("-test", "-f", fs_path)[0] == 0
+
+    def is_dir(self, fs_path):
+        return self._run("-test", "-d", fs_path)[0] == 0
+
+    def upload(self, local_path, fs_path):
+        self._run("-put", local_path, fs_path, check=True)
+
+    def download(self, fs_path, local_path):
+        self._run("-get", fs_path, local_path, check=True)
+
+    def mkdirs(self, fs_path):
+        self._run("-mkdir", "-p", fs_path, check=True)
+
+    def delete(self, fs_path):
+        self._run("-rm", "-r", fs_path, check=True)
+
+    def need_upload_download(self):
+        return True
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False,
+           test_exists=False):
+        self._run("-mv", fs_src_path, fs_dst_path, check=True)
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+    def touch(self, fs_path, exist_ok=True):
+        self._run("-touchz", fs_path, check=True)
+
+    def cat(self, fs_path=None):
+        return self._run("-cat", fs_path)[1]

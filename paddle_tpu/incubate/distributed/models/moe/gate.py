@@ -1,9 +1,6 @@
 """MoE gates (reference: incubate/distributed/models/moe/gate/*.py)."""
 from __future__ import annotations
 
-import jax
-import jax.numpy as jnp
-
 from .....nn.layer import Layer
 from .....nn.common import Linear
 
@@ -47,9 +44,34 @@ class GShardGate(NaiveGate):
 
 
 class SwitchGate(NaiveGate):
-    """Top-1 switch routing (switch_gate.py)."""
+    """Top-1 switch routing (switch_gate.py): logits get uniform noise of
+    width switch_eps during training (load-balancing jitter); top_k is
+    always 1 (the Switch contract — an explicit larger value errors)."""
 
     def __init__(self, d_model, num_expert, world_size=1, top_k=1,
                  switch_eps=0.1, capacity=(1.2, 2.4), group=None):
+        if top_k != 1:
+            raise ValueError("SwitchGate is top-1 routing by definition")
         super().__init__(d_model, num_expert, world_size, top_k=1)
         self.switch_eps = switch_eps
+        self.capacity = capacity
+
+    def forward(self, x):
+        out = self.gate(x)
+        if self.training and self.switch_eps:
+            from .....core import random as _rng
+            from .....core.dispatch import apply
+            import jax
+            import jax.numpy as jnp
+
+            key = _rng.next_key()
+
+            def jitter(lg):
+                noise = jax.random.uniform(
+                    key, lg.shape, lg.dtype,
+                    minval=1.0 - self.switch_eps,
+                    maxval=1.0 + self.switch_eps)
+                return lg * noise
+
+            out = apply(jitter, out, name="switch_jitter")
+        return out

@@ -1,14 +1,15 @@
 """MoELayer (reference moe_layer.py:260): gate -> capacity dispatch ->
-experts -> combine. See package docstring for the TPU-native dispatch."""
+experts -> combine. Dispatch/combine/aux come from the SAME routing core
+as parallel/moe.py (_routing: choice-major capacity assignment, GShard
+aux, normalized top-k combine) so the two MoE paths cannot drift."""
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 
-from .....core.tensor import Tensor
 from .....core.dispatch import apply
 from .....nn.layer import Layer
 from .....nn import container as nn_container
+from .....parallel.moe import _routing, moe_capacity
 from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
 
 __all__ = ["MoELayer"]
@@ -21,9 +22,9 @@ class MoELayer(Layer):
 
     experts: list/LayerList of expert Layers, each [*, d_model] ->
     [*, d_model]. gate: name ('naive' | 'gshard' | 'switch'), a BaseGate
-    instance, or a dict {"type": name, ...kwargs}. The GShard aux loss of
-    the last forward is exposed as `self.l_aux` (and on the gate's
-    `.loss`), matching the reference training recipe.
+    instance, or a dict {"type": name, ...gate kwargs} (forwarded to the
+    gate constructor). The GShard aux loss of the last forward is exposed
+    as `self.l_aux` (and on the gate's `.loss`).
     """
 
     def __init__(self, d_model, experts, gate=None, moe_group=None,
@@ -36,16 +37,21 @@ class MoELayer(Layer):
         num_expert = len(experts)
         if gate is None:
             gate = "gshard"
+        gate_kwargs = {}
         if isinstance(gate, dict):
-            cfg = dict(gate)
-            gate = cfg.pop("type", "gshard")
-            kwargs.update(cfg)
+            gate_kwargs = dict(gate)
+            gate = gate_kwargs.pop("type", "gshard")
         if isinstance(gate, str):
             cls = _GATES[gate]
-            gate = cls(d_model, num_expert,
-                       top_k=(1 if cls is SwitchGate else top_k))
+            gate_kwargs.setdefault(
+                "top_k", 1 if cls is SwitchGate else top_k)
+            gate = cls(d_model, num_expert, **gate_kwargs)
         if not isinstance(gate, BaseGate):
             raise TypeError(f"gate must be a name or BaseGate, got {gate!r}")
+        if gate.top_k > num_expert:
+            raise ValueError(
+                f"top_k ({gate.top_k}) cannot exceed the number of experts "
+                f"({num_expert})")
         self.gate = gate
         self.top_k = gate.top_k
         self.l_aux = None
@@ -56,38 +62,15 @@ class MoELayer(Layer):
         logits = self.gate(x)                       # [T, E]
         E = len(self.experts)
         T = x.shape[0]
-        capacity = max(1, int(2.0 * T * self.top_k / E))
+        # gate-configured capacity factor when present (GShard/Switch
+        # capacity=(train_cf, eval_cf)); reference default otherwise
+        cf = getattr(self.gate, "capacity", None)
+        factor = (cf[0] if self.training else cf[1]) if cf else 2.0
+        capacity = moe_capacity(T, E, self.top_k, factor / self.top_k)
         top_k = self.top_k
 
         def route(lg):
-            probs = jax.nn.softmax(lg, -1)
-            vals, idx = jax.lax.top_k(probs, top_k)        # [T, k]
-            disp = jnp.zeros((T, E, capacity), probs.dtype)
-            combine = jnp.zeros((T, E, capacity), probs.dtype)
-            # running per-expert slot counter ACROSS the k passes — a token
-            # routed to expert e at k=1 must not collide with slots the
-            # k=0 pass already filled
-            base = jnp.zeros((E,), probs.dtype)
-            for k in range(top_k):
-                e_k = idx[:, k]
-                onehot = jax.nn.one_hot(e_k, E, dtype=probs.dtype)  # [T, E]
-                # position of each token within its expert's capacity
-                pos = (base[None, :] + jnp.cumsum(onehot, 0)
-                       - onehot) * onehot                           # [T, E]
-                in_cap = (pos < capacity)
-                sel = onehot * in_cap
-                p = jnp.clip(pos.astype(jnp.int32), 0, capacity - 1)
-                disp_k = sel[:, :, None] * jax.nn.one_hot(
-                    p, capacity, dtype=probs.dtype)
-                disp = disp + disp_k
-                combine = combine + disp_k * vals[:, k][:, None, None]
-                base = base + onehot.sum(0)
-            # GShard aux loss: E * mean(fraction) . mean(prob) per expert
-            frac = jnp.mean(jax.nn.one_hot(idx[:, 0], E, dtype=probs.dtype),
-                            axis=0)
-            mean_p = probs.mean(0)
-            aux = E * jnp.sum(frac * mean_p)
-            return disp, combine, aux
+            return _routing(lg, E, top_k, capacity)
 
         disp_t, comb_t, aux_t = apply(route, logits, name="moe_route")
         # dispatch: [T,E,C] x [T,H] -> per-expert slices [E, C, H]

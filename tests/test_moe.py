@@ -142,3 +142,24 @@ def test_incubate_moe_gates_and_aux():
 
     with _pytest.raises(TypeError):
         MoELayer(d_model=d, experts=experts, gate=123)
+
+
+def test_incubate_moe_gate_config_honored():
+    import numpy as np
+    import pytest as _pytest
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer, SwitchGate
+
+    d = 8
+    experts = [paddle.nn.Linear(d, d) for _ in range(2)]
+    moe = MoELayer(d_model=d, experts=experts,
+                   gate={"type": "switch", "switch_eps": 0.3})
+    assert moe.gate.switch_eps == 0.3 and moe.top_k == 1
+    with _pytest.raises(ValueError):
+        SwitchGate(d, 2, top_k=2)
+    with _pytest.raises(ValueError):
+        MoELayer(d_model=d, experts=[paddle.nn.Linear(d, d)], top_k=2)
+    # training jitter changes routing-noise determinism only in train mode
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, d).astype("float32"))
+    moe.eval()
+    np.testing.assert_allclose(moe(x).numpy(), moe(x).numpy())

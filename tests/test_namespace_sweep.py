@@ -413,3 +413,30 @@ def test_fused_multi_transformer_mode_not_sticky():
     a1 = (o1[0] if isinstance(o1, tuple) else o1).numpy()
     a2 = (o2[0] if isinstance(o2, tuple) else o2).numpy()
     assert not np.allclose(a1, a2)
+
+
+def test_local_fs_client(tmp_path):
+    from paddle_tpu.distributed.fleet.utils import LocalFS
+
+    fs = LocalFS()
+    d = str(tmp_path / "a" / "b")
+    fs.mkdirs(d)
+    assert fs.is_dir(d) and fs.is_exist(d)
+    f = str(tmp_path / "a" / "x.txt")
+    fs.touch(f)
+    assert fs.is_file(f)
+    with open(f, "w") as fh:
+        fh.write("hello")
+    assert fs.cat(f) == "hello"
+    dirs, files = fs.ls_dir(str(tmp_path / "a"))
+    assert dirs == ["b"] and files == ["x.txt"]
+    fs.mv(f, str(tmp_path / "a" / "y.txt"))
+    assert fs.is_file(str(tmp_path / "a" / "y.txt"))
+    assert fs.list_dirs(str(tmp_path / "a")) == ["b"]
+    fs.delete(str(tmp_path / "a"))
+    assert not fs.is_exist(str(tmp_path / "a"))
+    assert not fs.need_upload_download()
+
+    from paddle_tpu.distributed.fleet.utils import HDFSClient
+    with pytest.raises(RuntimeError, match="hadoop"):
+        HDFSClient("/nonexistent/hadoop_home")
