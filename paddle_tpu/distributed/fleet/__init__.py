@@ -7,7 +7,8 @@ train_batch/step compile to ONE SPMD program over that mesh.
 """
 from .base import (
     DistributedStrategy, HybridCommunicateGroup, PaddleCloudRoleMaker,
-    UserDefinedRoleMaker,
+    UserDefinedRoleMaker, Role, UtilBase, CommunicateTopology,
+    MultiSlotDataGenerator, MultiSlotStringDataGenerator,
 )
 from .fleet_api import (
     init, distributed_model, distributed_optimizer, get_hybrid_communicate_group,
@@ -25,7 +26,29 @@ from .meta_parallel import (
     SharedLayerDesc,
 )
 
+class Fleet:
+    """Instance API over the module-level fleet functions (reference
+    fleet/fleet.py:101 — the `paddle.distributed.fleet` singleton's
+    class)."""
+
+    def __init__(self):
+        self.util = UtilBase()
+
+    def init(self, role_maker=None, is_collective=True, strategy=None,
+             log_level="INFO"):
+        from . import fleet_api
+
+        return fleet_api.init(role_maker, is_collective, strategy, log_level)
+
+    def __getattr__(self, name):
+        from . import fleet_api
+
+        return getattr(fleet_api, name)
+
+
 __all__ = [
+    "Fleet", "Role", "UtilBase", "CommunicateTopology",
+    "MultiSlotDataGenerator", "MultiSlotStringDataGenerator",
     "init", "distributed_model", "distributed_optimizer",
     "get_hybrid_communicate_group", "DistributedStrategy",
     "HybridCommunicateGroup", "worker_index", "worker_num", "is_first_worker",

@@ -172,3 +172,156 @@ class PaddleCloudRoleMaker:
 
 class UserDefinedRoleMaker(PaddleCloudRoleMaker):
     pass
+
+
+class Role:
+    """Role constants (reference base/role_maker.py:31)."""
+
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class CommunicateTopology:
+    """Cartesian rank topology (reference base/topology.py:53): maps a
+    global rank to a coordinate over the hybrid axes and back."""
+
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding",
+                                           "model"), dims=(1, 1, 1, 1)):
+        import collections
+        import itertools
+
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = collections.namedtuple("Coordinate",
+                                                 self._parallel_names)
+        self._coord2rank = {
+            self.coordinate(*c): i
+            for i, c in enumerate(itertools.product(
+                *(range(d) for d in self._dims)))
+        }
+        self._rank2coord = {v: k for k, v in self._coord2rank.items()}
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        import numpy as _np
+
+        return int(_np.prod(self._dims))
+
+    def get_rank(self, **kwargs):
+        return self._coord2rank[self.coordinate(**kwargs)]
+
+    def get_coord(self, rank):
+        return self._rank2coord[rank]
+
+    def get_axis_list(self, axis_name, index):
+        axis = self._parallel_names.index(axis_name)
+        return sorted(r for c, r in self._coord2rank.items()
+                      if c[axis] == index)
+
+    def get_comm_list(self, axis_name):
+        axis = self._parallel_names.index(axis_name)
+        others = [i for i in range(len(self._dims)) if i != axis]
+        groups = {}
+        for c, r in self._coord2rank.items():
+            key = tuple(c[i] for i in others)
+            groups.setdefault(key, []).append(r)
+        return [sorted(v) for _, v in sorted(groups.items())]
+
+
+class UtilBase:
+    """Cross-worker utilities (reference base/util_factory.py:47)."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np
+
+        from .fleet_api import worker_num
+
+        arr = np.asarray(input)
+        if worker_num() <= 1:
+            return arr            # identity at world size 1
+        # host-side cross-worker reduction needs a side channel; the
+        # single-XLA-program SPMD path has no per-worker host values to
+        # combine, and guessing (e.g. value * nranks) is wrong whenever
+        # workers hold different values — be explicit instead
+        raise RuntimeError(
+            "UtilBase.all_reduce of host values across workers requires "
+            "the multi-process launch path; inside an SPMD program use "
+            "paddle_tpu.distributed.all_reduce on tensors instead")
+
+    def barrier(self, comm_world="worker"):
+        from ..collective import barrier
+
+        barrier()
+
+    def get_file_shard(self, files):
+        from .fleet_api import worker_index, worker_num
+
+        n, i = worker_num(), worker_index()
+        blocks = len(files) // n
+        rem = len(files) % n
+        start = blocks * i + min(i, rem)
+        end = start + blocks + (1 if i < rem else 0)
+        return list(files[start:end])
+
+    def print_on_rank(self, message, rank_id=0):
+        from .fleet_api import worker_index
+
+        if worker_index() == rank_id:
+            print(message)
+
+
+class MultiSlotStringDataGenerator:
+    """PS-era line-protocol data generator (reference
+    fleet/data_generator/data_generator.py): subclass implements
+    generate_sample(line) -> iterator of (slot_name, [string values]);
+    run_from_stdin/run_from_memory emit the slot:count:values protocol."""
+
+    def __init__(self):
+        self._proto_info = None
+        self.batch_size_ = 32
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "implement generate_sample(line) returning an iterator of "
+            "[(slot_name, [values]), ...]")
+
+    def _gen_str(self, userdef):
+        out = []
+        for name, values in userdef:
+            out.append(str(len(values)))
+            out.extend(str(v) for v in values)
+        return " ".join(out) + "\n"
+
+    def run_from_memory(self, samples):
+        outs = []
+        for s in samples:
+            it = self.generate_sample(s)
+            for rec in (it() if callable(it) else it):
+                outs.append(self._gen_str(rec))
+        return outs
+
+    def run_from_stdin(self):
+        import sys
+
+        for line in sys.stdin:
+            it = self.generate_sample(line)
+            for rec in (it() if callable(it) else it):
+                sys.stdout.write(self._gen_str(rec))
+
+
+class MultiSlotDataGenerator(MultiSlotStringDataGenerator):
+    """Typed alias (reference keeps a separate class; the line protocol —
+    `count v1 .. vN` per slot — is identical, values stringified)."""

@@ -131,6 +131,7 @@ class HDFSClient(FS):
     def __init__(self, hadoop_home, configs=None, time_out=5 * 60 * 1000,
                  sleep_inter=1000):
         self._timeout_s = max(1.0, time_out / 1000.0)
+        self._sleep_s = max(0.0, sleep_inter / 1000.0)
         self._base = [os.path.join(hadoop_home, "bin", "hadoop"), "fs"]
         if configs:
             for k, v in configs.items():
@@ -142,13 +143,30 @@ class HDFSClient(FS):
                 "hadoop or use LocalFS")
 
     def _run(self, *args, check=False):
-        proc = subprocess.run([*self._base, *args], capture_output=True,
-                              text=True, timeout=self._timeout_s)
-        if check and proc.returncode != 0:
-            raise ExecuteError(
+        import time as _time
+
+        last = None
+        for attempt in (0, 1):        # one retry after sleep_inter
+            try:
+                proc = subprocess.run([*self._base, *args],
+                                      capture_output=True, text=True,
+                                      timeout=self._timeout_s)
+            except subprocess.TimeoutExpired as e:
+                last = ExecuteError(
+                    f"hadoop fs {' '.join(args)} timed out after "
+                    f"{self._timeout_s:.0f}s")
+                if attempt == 0:
+                    _time.sleep(self._sleep_s)
+                    continue
+                raise last from e
+            if proc.returncode == 0 or not check:
+                return proc.returncode, proc.stdout
+            last = ExecuteError(
                 f"hadoop fs {' '.join(args)} failed (rc={proc.returncode}): "
                 f"{proc.stderr.strip()[-500:]}")
-        return proc.returncode, proc.stdout
+            if attempt == 0:
+                _time.sleep(self._sleep_s)
+        raise last
 
     def ls_dir(self, fs_path):
         rc, out = self._run("-ls", fs_path)
@@ -187,12 +205,23 @@ class HDFSClient(FS):
 
     def mv(self, fs_src_path, fs_dst_path, overwrite=False,
            test_exists=False):
+        if test_exists and not self.is_exist(fs_src_path):
+            raise FileNotFoundError(fs_src_path)
+        if self.is_exist(fs_dst_path):
+            if overwrite:
+                self.delete(fs_dst_path)
+            elif test_exists:
+                raise FileExistsError(fs_dst_path)
         self._run("-mv", fs_src_path, fs_dst_path, check=True)
 
     def list_dirs(self, fs_path):
         return self.ls_dir(fs_path)[0]
 
     def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if not exist_ok:
+                raise FileExistsError(fs_path)
+            return                  # no-op on existing files (FS contract)
         self._run("-touchz", fs_path, check=True)
 
     def cat(self, fs_path=None):

@@ -1,0 +1,15 @@
+"""distributed.utils (reference: python/paddle/distributed/utils/ —
+launch_utils helpers; empty public __all__ there too). Hosts the helper
+shims launch tooling imports."""
+from __future__ import annotations
+
+__all__ = []
+
+
+def get_cluster_from_args(args=None):
+    """Single-host cluster descriptor from env (launch_utils analog)."""
+    import os
+
+    ranks = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    return {"nranks": ranks,
+            "endpoints": os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")}

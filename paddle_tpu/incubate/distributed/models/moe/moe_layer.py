@@ -64,9 +64,11 @@ class MoELayer(Layer):
         T = x.shape[0]
         # gate-configured capacity factor when present (GShard/Switch
         # capacity=(train_cf, eval_cf)); reference default otherwise
+        # capacity_factor convention matches parallel/moe.moe_capacity:
+        # capacity = ceil(cf * top_k * T / E)
         cf = getattr(self.gate, "capacity", None)
         factor = (cf[0] if self.training else cf[1]) if cf else 2.0
-        capacity = moe_capacity(T, E, self.top_k, factor / self.top_k)
+        capacity = moe_capacity(T, E, self.top_k, factor)
         top_k = self.top_k
 
         def route(lg):

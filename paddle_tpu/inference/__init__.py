@@ -210,6 +210,24 @@ class Predictor:
             config._prefix, config._params_file)
         self._params = jax.tree.map(jnp.asarray, params)
         self._buffers = jax.tree.map(jnp.asarray, buffers)
+        # the serialized StableHLO is compiled for fixed input dtypes; a
+        # weights file stored in reduced precision (convert_to_mixed_
+        # precision artifacts) casts back to the module's expected avals
+        # at load — halved storage, unchanged executable
+        try:
+            avals = list(self._exported.in_avals)
+            p_flat, p_tree = jax.tree_util.tree_flatten(self._params)
+            b_flat, b_tree = jax.tree_util.tree_flatten(self._buffers)
+            n_state = len(p_flat) + len(b_flat)
+            exp = avals[:n_state]
+            cast = [a.astype(e.dtype) if a.dtype != e.dtype else a
+                    for a, e in zip(p_flat + b_flat, exp)]
+            self._params = jax.tree_util.tree_unflatten(
+                p_tree, cast[:len(p_flat)])
+            self._buffers = jax.tree_util.tree_unflatten(
+                b_tree, cast[len(p_flat):])
+        except Exception:
+            pass   # aval introspection is best-effort; call() validates
         self._n_inputs = n_inputs
         self._inputs = [_IOHandle() for _ in range(n_inputs)]
         self._outputs = []
@@ -244,3 +262,129 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+class DataType:
+    """Predictor IO dtypes (reference paddle_infer_declare.h PD_DataType)."""
+
+    FLOAT32 = 0
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+    FLOAT16 = 5
+    BFLOAT16 = 6
+
+
+class PlaceType:
+    """Predictor placement (reference PD_PlaceType). On this backend every
+    accelerator place routes to the active XLA device."""
+
+    UNK = -1
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    NPU = 3
+    CUSTOM = 4
+
+
+class PrecisionType:
+    """Analysis-config precision (reference AnalysisConfig::Precision)."""
+
+    Float32 = 0
+    Half = 1
+    Int8 = 2
+    Bfloat16 = 3
+
+
+def get_version():
+    from .. import __version__
+
+    return f"version: {__version__}"
+
+
+def get_num_bytes_of_data_type(dtype):
+    sizes = {DataType.FLOAT32: 4, DataType.INT64: 8, DataType.INT32: 4,
+             DataType.UINT8: 1, DataType.INT8: 1, DataType.FLOAT16: 2,
+             DataType.BFLOAT16: 2}
+    return sizes[dtype]
+
+
+def get_trt_compile_version():
+    """No TensorRT on this stack — XLA is the compiled-inference engine
+    (SURVEY §2.5.15); reference returns (0, 0, 0) when built without TRT."""
+    return (0, 0, 0)
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def _get_phi_kernel_name(op_name):
+    """Op -> kernel-name mapping hook (reference pybind helper). Kernel
+    naming is 1:1 here (no fluid-op alias table to consult)."""
+    return op_name
+
+
+class PredictorPool:
+    """Thread-serving predictor pool (reference PredictorPool): ONE model
+    load; the size-1 clones share the main predictor's weight arrays
+    (jax arrays are immutable, so sharing is safe)."""
+
+    def __init__(self, config, size=1):
+        main = Predictor(config)
+        self._predictors = [main]
+        for _ in range(max(1, size) - 1):
+            clone = object.__new__(Predictor)
+            clone.__dict__.update(main.__dict__)   # shares _params/_buffers
+            # ...but NOT the IO handles: each pool slot serves its own
+            # thread with independent input/output bindings
+            clone._inputs = [_IOHandle() for _ in range(main._n_inputs)]
+            clone._outputs = []
+            self._predictors.append(clone)
+
+    def retrieve(self, idx):
+        return self._predictors[idx]
+
+
+def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
+                               mixed_params_file, mixed_precision=None,
+                               backend=None, keep_io_types=True,
+                               black_list=None, **kwargs):
+    """Cast a saved inference model's weights to bf16/fp16 (reference
+    convert_to_mixed_precision pass). Loads the exported artifact's
+    params, casts floating weights, re-saves alongside the model file."""
+    import pickle
+    import shutil
+
+    import numpy as np
+
+    prec = mixed_precision if mixed_precision is not None else PrecisionType.Half
+    target = {PrecisionType.Half: np.float16,
+              PrecisionType.Bfloat16: "bfloat16",
+              PrecisionType.Float32: np.float32}[prec]
+    with open(params_file, "rb") as f:
+        blob = pickle.load(f)
+
+    import ml_dtypes
+
+    tgt = ml_dtypes.bfloat16 if target == "bfloat16" else target
+
+    def cast_tree(v):
+        # recurse: save_inference_model writes {"params": {...},
+        # "buffers": {...}, "n_inputs": int}; flat dicts also accepted
+        if isinstance(v, dict):
+            return {k: cast_tree(x) for k, x in v.items()}
+        a = np.asarray(v)
+        return a.astype(tgt) if a.dtype.kind == "f" else v
+
+    with open(mixed_params_file, "wb") as f:
+        pickle.dump(cast_tree(blob), f)
+    if model_file != mixed_model_file:
+        shutil.copy(model_file, mixed_model_file)
+
+
+__all__ += ["DataType", "PlaceType", "PrecisionType", "PredictorPool",
+            "get_version", "get_num_bytes_of_data_type",
+            "get_trt_compile_version", "get_trt_runtime_version",
+            "_get_phi_kernel_name", "convert_to_mixed_precision"]

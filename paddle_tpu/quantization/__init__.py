@@ -361,3 +361,37 @@ class _FixedQDQ(Layer):
 
     def forward(self, x):
         return self.inner(_fake_quant_ste(x, self._scale, self._bits))
+
+
+def quanter(class_name):
+    """Factory-declaration decorator (reference quantization/factory.py:73
+    @quanter): registers `class_name` in paddle_tpu.quantization as a
+    factory whose instances carry the constructor args and materialize the
+    decorated quanter layer via _instance(layer)."""
+
+    def wrapper(target_class):
+        class _Factory:
+            def __init__(self, *args, **kwargs):
+                self._args = args
+                self._kwargs = kwargs
+
+            def _get_class(self):
+                return target_class
+
+            def _instance(self, layer=None):
+                if layer is not None:
+                    return target_class(layer, *self._args, **self._kwargs)
+                return target_class(*self._args, **self._kwargs)
+
+        _Factory.__name__ = class_name
+        import sys
+
+        setattr(sys.modules[__name__], class_name, _Factory)
+        if class_name not in __all__:
+            __all__.append(class_name)
+        return target_class
+
+    return wrapper
+
+
+__all__ += ["quanter"]

@@ -78,3 +78,56 @@ def test_config_toggles_accepted(tmp_path):
     cfg.disable_glog_info()
     with pytest.raises(ValueError):
         inference.create_predictor(cfg)  # no model bound
+
+
+def test_convert_to_mixed_precision_roundtrip(tmp_path):
+    """bf16-converted artifact (reference convert_to_mixed_precision) still
+    loads: Predictor casts stored weights back to the serialized module's
+    avals, so storage halves and outputs stay within bf16 tolerance."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import inference
+    from paddle_tpu.static import InputSpec
+
+    paddle.seed(0)
+    m = paddle.nn.Linear(4, 2)
+    prefix = str(tmp_path / "model")
+    inference.save_inference_model(prefix, m, input_spec=[InputSpec([1, 4])])
+    pf, mf = prefix + ".pdiparams", prefix + ".pdmodel"
+    x = np.ones((1, 4), np.float32)
+    ref = inference.Predictor(inference.Config(prog_file=mf, params_file=pf))
+    ref_out = np.asarray(ref.run([paddle.to_tensor(x)])[0])
+    inference.convert_to_mixed_precision(
+        mf, pf, mf, pf, mixed_precision=inference.PrecisionType.Bfloat16)
+    import pickle
+
+    blob = pickle.load(open(pf, "rb"))
+    assert all(str(np.asarray(v).dtype) == "bfloat16"
+               for v in blob["params"].values())
+    pred = inference.Predictor(inference.Config(prog_file=mf, params_file=pf))
+    out = np.asarray(pred.run([paddle.to_tensor(x)])[0])
+    np.testing.assert_allclose(out, ref_out, rtol=2e-2, atol=2e-2)
+
+
+def test_predictor_pool_shares_weights(tmp_path):
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import inference
+    from paddle_tpu.static import InputSpec
+
+    paddle.seed(0)
+    m = paddle.nn.Linear(4, 2)
+    prefix = str(tmp_path / "model")
+    inference.save_inference_model(prefix, m, input_spec=[InputSpec([1, 4])])
+    pool = inference.PredictorPool(
+        inference.Config(prog_file=prefix + ".pdmodel"), size=3)
+    p0, p2 = pool.retrieve(0), pool.retrieve(2)
+    # clones share the SAME weight arrays (no duplicate loads)
+    import jax
+
+    l0 = jax.tree_util.tree_leaves(p0._params)
+    l2 = jax.tree_util.tree_leaves(p2._params)
+    assert all(a is b for a, b in zip(l0, l2))
+    x = np.ones((1, 4), np.float32)
+    np.testing.assert_allclose(np.asarray(p0.run([paddle.to_tensor(x)])[0]),
+                               np.asarray(p2.run([paddle.to_tensor(x)])[0]))

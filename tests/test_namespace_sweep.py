@@ -440,3 +440,72 @@ def test_local_fs_client(tmp_path):
     from paddle_tpu.distributed.fleet.utils import HDFSClient
     with pytest.raises(RuntimeError, match="hadoop"):
         HDFSClient("/nonexistent/hadoop_home")
+
+
+def test_fleet_inference_quant_namespaces():
+    for name, rel in [
+            ("distributed.fleet",
+             "python/paddle/distributed/fleet/__init__.py"),
+            ("inference", "python/paddle/inference/__init__.py"),
+            ("quantization", "python/paddle/quantization/__init__.py")]:
+        names = _ref_all(rel)
+        if names is None:
+            pytest.skip("reference tree not available")
+        target = importlib.import_module("paddle_tpu." + name)
+        missing = sorted(n for n in set(names) if not hasattr(target, n))
+        assert missing == [], f"{name}: {missing}"
+
+
+def test_fleet_topology_and_util():
+    from paddle_tpu.distributed import fleet
+
+    t = fleet.CommunicateTopology(dims=[2, 1, 1, 2])
+    assert t.world_size() == 4
+    assert t.get_rank(data=1, pipe=0, sharding=0, model=0) == 2
+    assert t.get_coord(3).model == 1
+    assert t.get_axis_list("data", 0) == [0, 1]
+    assert [sorted(g) for g in t.get_comm_list("model")] == [[0, 1], [2, 3]]
+    u = fleet.UtilBase()
+    assert u.get_file_shard(["a", "b", "c"]) == ["a", "b", "c"]
+    f = fleet.Fleet()
+    f.init()
+    assert f.worker_num() >= 1 and f.util is not None
+
+    class Gen(fleet.MultiSlotStringDataGenerator):
+        def generate_sample(self, line):
+            def it():
+                yield [("w", line.split()), ("y", ["1"])]
+            return it
+
+    assert Gen().run_from_memory(["a b"]) == ["2 a b 1 1\n"]
+
+
+def test_inference_helpers_and_quanter(tmp_path):
+    import pickle
+    from paddle_tpu import inference, quantization
+
+    assert inference.get_num_bytes_of_data_type(
+        inference.DataType.FLOAT16) == 2
+    assert inference.get_trt_runtime_version() == (0, 0, 0)
+    # mixed-precision conversion of a params blob
+    params = {"w": np.ones((4, 4), np.float32), "step": np.int32(3)}
+    pf = str(tmp_path / "m.pdiparams")
+    mf = str(tmp_path / "m.pdmodel")
+    with open(pf, "wb") as f:
+        pickle.dump(params, f)
+    with open(mf, "wb") as f:
+        f.write(b"model")
+    inference.convert_to_mixed_precision(
+        mf, pf, str(tmp_path / "mm.pdmodel"), str(tmp_path / "mm.pdiparams"),
+        mixed_precision=inference.PrecisionType.Bfloat16)
+    with open(tmp_path / "mm.pdiparams", "rb") as f:
+        out = pickle.load(f)
+    assert str(out["w"].dtype) == "bfloat16" and out["step"].dtype.kind == "i"
+
+    @quantization.quanter("SweepQuanter")
+    class SweepQuanterLayer:
+        def __init__(self, bits=8):
+            self.bits = bits
+
+    fac = quantization.SweepQuanter(bits=4)
+    assert fac._instance().bits == 4
