@@ -1,0 +1,64 @@
+"""Detection post-processing: YOLO head decode -> matrix NMS, and an
+RPN -> FPN pipeline (generate_proposals -> distribute_fpn_proposals
+-> per-level RoIAlign -> restore to original RoI order)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("PTPU_FORCE_PLATFORM", "cpu")   # drop on a TPU host
+import jax
+
+if os.environ.get("PTPU_FORCE_PLATFORM") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops
+
+# User-style detection post-processing pipeline: YOLO head -> yolo_box ->
+# matrix_nms, then an FPN RoI path: generate_proposals ->
+# distribute_fpn_proposals -> RoIAlign per level -> restore order.
+rs = np.random.RandomState(0)
+head = paddle.to_tensor(rs.randn(2, 3 * (5 + 4), 8, 8).astype("float32"))
+img = paddle.to_tensor(np.array([[256, 256], [320, 320]], np.int32))
+boxes, scores = ops.yolo_box(head, img, [10, 13, 16, 30, 33, 23], 4,
+                             conf_thresh=0.05, downsample_ratio=32)
+out, num, _ = ops.matrix_nms(boxes, paddle.transpose(scores, [0, 2, 1]),
+                          score_threshold=0.05, post_threshold=0.1,
+                          nms_top_k=50, keep_top_k=20, background_label=-1)
+print("yolo det:", out.shape, "per-image:", num.numpy().tolist())
+assert out.shape[1] == 6 and int(num.numpy().sum()) == out.shape[0]
+
+sc = paddle.to_tensor(rs.rand(2, 3, 8, 8).astype("float32"))
+dl = paddle.to_tensor((rs.randn(2, 12, 8, 8) * 0.1).astype("float32"))
+anch = np.zeros((8, 8, 3, 4), np.float32)
+for gy in range(8):
+    for gx in range(8):
+        for k in range(3):
+            s = 16 * (k + 1)
+            anch[gy, gx, k] = [gx * 16, gy * 16, gx * 16 + s, gy * 16 + s]
+rois, probs, rn = ops.generate_proposals(
+    sc, dl, paddle.to_tensor(np.array([[128, 128], [128, 128]], np.float32)),
+    paddle.to_tensor(anch), paddle.to_tensor(np.ones_like(anch)),
+    pre_nms_top_n=30, post_nms_top_n=8, return_rois_num=True)
+print("proposals:", rois.shape, rn.numpy().tolist())
+multi, restore = ops.distribute_fpn_proposals(rois, 2, 4, 3, 56)
+feat = paddle.to_tensor(rs.randn(2, 4, 16, 16).astype("float32"))
+align = ops.RoIAlign(output_size=2, spatial_scale=16 / 128)
+pooled = []
+for lvl_rois in multi:
+    if lvl_rois.shape[0] == 0:
+        continue
+    # per-level boxes_num: assign all to image 0 for the smoke (restore checks order)
+    bn = paddle.to_tensor(np.array([lvl_rois.shape[0], 0], np.int32))
+    pooled.append(align(feat, lvl_rois, bn))
+cat = paddle.concat(pooled, axis=0)
+# restore per-level concat order back to the ORIGINAL RoI order
+ordered = cat[restore.reshape([-1])]
+print("pooled:", ordered.shape, "(restored to original RoI order)")
+assert ordered.shape[0] == rois.shape[0]
+
+raw = ops.read_file(os.path.join(os.path.dirname(__file__), "..", "README.md"))
+assert raw.ndim == 1 and raw.dtype == paddle.uint8
+print("DRIVE3 OK")

@@ -1,0 +1,48 @@
+"""Hybrid-parallel GPT pretraining: dp x mp x pp mesh, whole step (forward
++ backward + AdamW) compiled into ONE XLA program.
+
+On a TPU pod slice, drop the CPU pin below and raise the config size —
+the same code scales via the mesh axes (SURVEY north-star recipe)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("PTPU_FORCE_PLATFORM", "cpu")   # drop on a TPU host
+import jax
+
+if os.environ.get("PTPU_FORCE_PLATFORM") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import jit, optimizer, parallel
+from paddle_tpu.models import (GPTForCausalLM, GPTPretrainingCriterion,
+                               gpt_test_config)
+
+paddle.seed(0)
+parallel.init_mesh(dp=2, mp=2, pp=2)
+cfg = gpt_test_config(num_hidden_layers=4, stacked_blocks=True)
+model = parallel.place_model(GPTForCausalLM(cfg))
+crit = GPTPretrainingCriterion(cfg)
+opt = optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
+
+
+def step(ids, labels):
+    loss = crit(model(ids), labels)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    return loss
+
+
+train_step = jit.compile(step, models=[model], optimizers=[opt])
+
+rng = np.random.RandomState(0)
+ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (8, 32)).astype("int32"))
+lab = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (8, 32)).astype("int32"))
+losses = [float(train_step(ids, lab)) for _ in range(8)]
+print("losses:", [round(v, 4) for v in losses])
+assert losses[-1] < losses[0]
+print("OK — dp2 x mp2 x pp2 training step compiled and converging")
