@@ -1,0 +1,44 @@
+"""examples/ stay runnable: each script is executed as a user would run
+it (`python examples/<name>.py`, no PYTHONPATH, no env) and must exit 0.
+The heavyweight ones are slow-tier; two cheap ones guard the fast tier."""
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+_EXAMPLES = pathlib.Path(__file__).resolve().parent.parent / "examples"
+
+
+def _run(name, timeout=560):
+    # strip everything the conftest injects: the examples must provide
+    # their OWN path shim and XLA device-count flags (that is what this
+    # test guards)
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PYTHONPATH", "XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PTPU_FORCE_PLATFORM"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, str(_EXAMPLES / name)], env=env,
+        capture_output=True, text=True, timeout=timeout)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    return proc.stdout
+
+
+def test_example_detection_postprocess():
+    out = _run("detection_postprocess.py")
+    assert "OK" in out
+
+
+def test_example_legacy_reader_pipeline():
+    out = _run("legacy_reader_pipeline.py")
+    assert "OK" in out
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", [
+    "train_lenet_mnist.py", "train_gpt_hybrid.py", "generate_gpt.py",
+    "train_moe.py", "static_graph_training.py",
+])
+def test_example_heavy(name):
+    assert "OK" in _run(name)
