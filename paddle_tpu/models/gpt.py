@@ -194,14 +194,30 @@ class GPTAttention(Layer):
             # KV-cache prefill/decode (reference CacheKV semantics:
             # fused_multi_transformer_op.cu:90): write this chunk at
             # position `time_step`, attend causally over the cache.
+            # time_step None is STATIC prefill-at-0: causal attention over
+            # the chunk (flash path) + cache write, no S_max-wide mask.
             qkv = constraint(qkv, ["dp", None, None, "mp", None])
             q, k, v = qkv.unbind(axis=2)
             k_cache, v_cache = cache
-            o, kc, vc = apply(
-                cached_attention_arrays, q, k, v, k_cache, v_cache,
-                0 if time_step is None else time_step,
-                name="cached_attention",
-            )
+            if time_step is None:
+                from ..ops.pallas_ops import flash_attention_arrays
+
+                def prefill_fn(qa, ka, va, kca, vca):
+                    kc2 = jax.lax.dynamic_update_slice(
+                        kca, ka.astype(kca.dtype), (0, 0, 0, 0))
+                    vc2 = jax.lax.dynamic_update_slice(
+                        vca, va.astype(vca.dtype), (0, 0, 0, 0))
+                    return (flash_attention_arrays(qa, ka, va,
+                                                   is_causal=True),
+                            kc2, vc2)
+
+                o, kc, vc = apply(prefill_fn, q, k, v, k_cache, v_cache,
+                                  name="cached_attention_prefill")
+            else:
+                o, kc, vc = apply(
+                    cached_attention_arrays, q, k, v, k_cache, v_cache,
+                    time_step, name="cached_attention",
+                )
             o = constraint(o, ["dp", None, "mp", None])
             o = o.reshape([b, s, h])
             return self.out_proj(o), (kc, vc)
@@ -415,6 +431,12 @@ class GPTStackedBlocks(Layer):
         names = self._names
         k_caches, v_caches = caches
 
+        # time_step is None STATICALLY means prefill at position 0: the
+        # cache beyond the chunk is empty, so causal flash attention over
+        # the chunk equals cached attention — skip the O(S * S_max)
+        # masked path and just write the cache (flash kernel on TPU)
+        prefill = time_step is None
+
         def fn(a, kcs, vcs, t, *flat):
             params = dict(zip(names, flat))
 
@@ -422,6 +444,15 @@ class GPTStackedBlocks(Layer):
                 p, kc, vc = xs
 
                 def attn_fn(q, k, v):
+                    if prefill:
+                        from ..ops.pallas_ops import flash_attention_arrays
+
+                        kc2 = jax.lax.dynamic_update_slice(
+                            kc, k.astype(kc.dtype), (0, 0, 0, 0))
+                        vc2 = jax.lax.dynamic_update_slice(
+                            vc, v.astype(vc.dtype), (0, 0, 0, 0))
+                        o = flash_attention_arrays(q, k, v, is_causal=True)
+                        return o, (kc2, vc2)
                     o, kc2, vc2 = cached_attention_arrays(q, k, v, kc, vc, t)
                     return o, (kc2, vc2)
 
