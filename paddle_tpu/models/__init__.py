@@ -14,11 +14,12 @@ from .gpt import (
     gpt3_1p3b_config,
     gpt3_6p7b_config,
 )
-from .bert import BertConfig, BertModel, BertForSequenceClassification
+from .bert import BertConfig, BertModel, BertForSequenceClassification, bert_base_config
 
 __all__ = [
     "GPTConfig", "GPTModel", "GPTForCausalLM", "GPTPretrainingCriterion",
     "gpt_test_config", "gpt2_124m_config", "gpt3_1p3b_config",
     "gpt3_6p7b_config",
     "BertConfig", "BertModel", "BertForSequenceClassification",
+    "bert_base_config",
 ]

@@ -200,16 +200,8 @@ class GPTAttention(Layer):
             q, k, v = qkv.unbind(axis=2)
             k_cache, v_cache = cache
             if time_step is None:
-                from ..ops.pallas_ops import flash_attention_arrays
-
                 def prefill_fn(qa, ka, va, kca, vca):
-                    kc2 = jax.lax.dynamic_update_slice(
-                        kca, ka.astype(kca.dtype), (0, 0, 0, 0))
-                    vc2 = jax.lax.dynamic_update_slice(
-                        vca, va.astype(vca.dtype), (0, 0, 0, 0))
-                    return (flash_attention_arrays(qa, ka, va,
-                                                   is_causal=True),
-                            kc2, vc2)
+                    return _cached_attn_arrays(qa, ka, va, kca, vca, 0, True)
 
                 o, kc, vc = apply(prefill_fn, q, k, v, k_cache, v_cache,
                                   name="cached_attention_prefill")
@@ -303,6 +295,26 @@ class GPTMoEMLP(Layer):
         )
         self.aux_loss = aux
         return out
+
+
+def _cached_attn_arrays(q, k, v, kc, vc, t, prefill):
+    """Array-level prefill/decode cached-attention dispatch — the single
+    source of truth for every cached forward path (per-layer GPTAttention,
+    the stacked scan, and the unrolled decode). At STATIC prefill
+    (time_step is None → position 0) the cache beyond the chunk is empty,
+    so causal flash attention over the chunk plus the cache write is exact
+    and skips the O(S * S_max) masked path; decode defers to
+    cached_attention_arrays (reference CacheKV semantics:
+    fused_multi_transformer_op.cu:90)."""
+    if prefill:
+        from ..ops.pallas_ops import flash_attention_arrays
+
+        kc2 = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype),
+                                           (0, 0, 0, 0))
+        vc2 = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype),
+                                           (0, 0, 0, 0))
+        return flash_attention_arrays(q, k, v, is_causal=True), kc2, vc2
+    return cached_attention_arrays(q, k, v, kc, vc, t)
 
 
 def _stacked_ln(h, w, b, eps):
@@ -420,10 +432,25 @@ class GPTStackedBlocks(Layer):
         return apply(fn, x, *tensors, name="gpt_stacked_blocks")
 
     def forward_cached(self, x, caches, time_step=None):
-        """KV-cache prefill/decode over the stacked weights: lax.scan over
-        the layer dim with per-layer cache slices threaded as scan xs/ys
-        (one executable regardless of depth). caches = (k [L,B,Smax,H,D],
-        v [L,B,Smax,H,D])."""
+        """KV-cache prefill/decode over the stacked weights.
+
+        Two cache formats select two execution strategies:
+        - list of per-layer (k, v) pairs ([B,Smax,H,D] each) → UNROLLED
+          python loop with static weight slices. This is the fast decode
+          path: caches stay separate buffers in the caller's while-loop
+          carry so each step's update is an in-place one-row
+          dynamic_update_slice, and static `w[l]` slices fuse into their
+          matmuls. The scan form instead re-materializes every layer's
+          cache slice per step (profiled at ~4x the whole weight-stream
+          cost per decode step on v5e).
+        - stacked (k [L,B,Smax,H,D], v [L,...]) → lax.scan over the layer
+          dim with cache slices as scan xs/ys (one executable regardless
+          of depth; the right trade for very deep models).
+        """
+        stacked_format = (len(caches) == 2 and hasattr(caches[0], "shape")
+                          and len(caches[0].shape) == 5)
+        if not stacked_format:
+            return self._forward_cached_unrolled(x, caches, time_step)
         cfg = self.cfg
         nh = cfg.num_attention_heads
         hd = cfg.hidden_size // nh
@@ -444,16 +471,8 @@ class GPTStackedBlocks(Layer):
                 p, kc, vc = xs
 
                 def attn_fn(q, k, v):
-                    if prefill:
-                        from ..ops.pallas_ops import flash_attention_arrays
-
-                        kc2 = jax.lax.dynamic_update_slice(
-                            kc, k.astype(kc.dtype), (0, 0, 0, 0))
-                        vc2 = jax.lax.dynamic_update_slice(
-                            vc, v.astype(vc.dtype), (0, 0, 0, 0))
-                        o = flash_attention_arrays(q, k, v, is_causal=True)
-                        return o, (kc2, vc2)
-                    o, kc2, vc2 = cached_attention_arrays(q, k, v, kc, vc, t)
+                    o, kc2, vc2 = _cached_attn_arrays(q, k, v, kc, vc, t,
+                                                      prefill)
                     return o, (kc2, vc2)
 
                 h, (kc, vc) = _stacked_block_body(p, h, attn_fn, nh, hd, eps)
@@ -467,6 +486,43 @@ class GPTStackedBlocks(Layer):
         h, kcs, vcs = apply(fn, x, k_caches, v_caches, t, *tensors,
                             name="gpt_stacked_blocks_cached")
         return h, (kcs, vcs)
+
+    def _forward_cached_unrolled(self, x, caches, time_step=None):
+        """Unrolled cached forward over per-layer (k, v) cache pairs —
+        see forward_cached for why this beats the scan at decode."""
+        cfg = self.cfg
+        nh = cfg.num_attention_heads
+        hd = cfg.hidden_size // nh
+        eps = cfg.layer_norm_epsilon
+        names = self._names
+        L = cfg.num_hidden_layers
+        prefill = time_step is None
+
+        def fn(a, t, *flat):
+            cache_flat, params_flat = flat[:2 * L], flat[2 * L:]
+            params = dict(zip(names, params_flat))
+            h = a
+            outs = []
+            for l in range(L):
+                kc, vc = cache_flat[2 * l], cache_flat[2 * l + 1]
+                p = {n: params[n][l] for n in names}
+
+                def attn_fn(q, k, v, kc=kc, vc=vc):
+                    o, kc2, vc2 = _cached_attn_arrays(q, k, v, kc, vc, t,
+                                                      prefill)
+                    return o, (kc2, vc2)
+
+                h, (kc2, vc2) = _stacked_block_body(p, h, attn_fn, nh, hd, eps)
+                outs += [kc2, vc2]
+            return (h, *outs)
+
+        flat_caches = [arr for (kc, vc) in caches for arr in (kc, vc)]
+        tensors = [getattr(self, n) for n in names]
+        t = 0 if time_step is None else time_step
+        res = apply(fn, x, t, *flat_caches, *tensors,
+                    name="gpt_stacked_blocks_cached_unrolled")
+        h, rest = res[0], res[1:]
+        return h, [(rest[2 * l], rest[2 * l + 1]) for l in range(L)]
 
 
 class GPTBlock(Layer):
@@ -670,7 +726,12 @@ class GPTForCausalLM(Layer):
         if dtype is None:
             dtype = self.gpt.embeddings.word_embeddings.weight.dtype
         shape = (batch_size, max_length, nh, hd)
-        if cfg.stacked_blocks:
+        import os
+        unroll_env = os.environ.get("PTPU_DECODE_UNROLL")
+        unroll = (cfg.num_hidden_layers <= 32 if unroll_env is None
+                  else unroll_env != "0")
+        if cfg.stacked_blocks and not unroll:
+            # very deep models: stacked [L, ...] caches → layer-scan decode
             full = (cfg.num_hidden_layers,) + shape
             return (Tensor(jnp.zeros(full, dtype)), Tensor(jnp.zeros(full, dtype)))
         return [
@@ -708,7 +769,7 @@ class GPTForCausalLM(Layer):
         was_training = self.training
         self.eval()
 
-        def run(params, bufs, chunk, caches, t):
+        def run_fwd(params, bufs, chunk, caches, t):
             backup = model.state_arrays()
             try:
                 model.load_state_arrays(params, bufs)
@@ -724,46 +785,83 @@ class GPTForCausalLM(Layer):
             finally:
                 model.load_state_arrays(*backup)
 
-        key_shape = (B, P, total, cfg.stacked_blocks)
-        if self._gen_step is None or self._gen_step[0] != key_shape:
-            self._gen_step = (key_shape, jax.jit(run, donate_argnums=(3,)))
-        step = self._gen_step[1]
+        def decode_all(params, bufs, logits, caches, key):
+            """The WHOLE decode loop as one on-device while_loop: a
+            host-driven token loop pays a dispatch round-trip per step
+            (ruinous through a network-tunneled chip), while one program
+            keeps every step on-device. Early EOS exit survives as the
+            loop condition; the emitted count comes back so the host can
+            trim to the host-loop-identical length."""
+            finished0 = jnp.zeros((B,), bool)
+            toks0 = jnp.zeros((B, max_new_tokens), jnp.int32)
+
+            def cond_fn(st):
+                i, _logits, _caches, _key, finished, _toks = st
+                live = i < max_new_tokens
+                if eos_token_id is not None:
+                    live = live & ~jnp.all(finished)
+                return live
+
+            def body_fn(st):
+                i, logits, caches, key, finished, toks = st
+                if do_sample:
+                    key, sub = jax.random.split(key)
+                else:
+                    sub = None
+                tok = _sample_next(logits, sub, do_sample, temperature,
+                                   top_k, top_p)
+                if eos_token_id is not None:
+                    tok = jnp.where(finished, eos_token_id, tok)
+                    finished = finished | (tok == eos_token_id)
+                toks = jax.lax.dynamic_update_slice(
+                    toks, tok[:, None].astype(jnp.int32), (0, i))
+                # skip the forward after the final token (its logits are
+                # never sampled) — matches the host loop's `i+1 < max_new`
+                # guard and its break-before-forward on all-rows-EOS
+                more = i + 1 < max_new_tokens
+                if eos_token_id is not None:
+                    more = more & ~jnp.all(finished)
+                logits, caches = jax.lax.cond(
+                    more,
+                    lambda c: run_fwd(params, bufs, tok[:, None], c, P + i),
+                    lambda c: (logits, c),
+                    caches)
+                return (i + 1, logits, caches, key, finished, toks)
+
+            i0 = jnp.asarray(0, jnp.int32)
+            i, _, _, _, _, toks = jax.lax.while_loop(
+                cond_fn, body_fn,
+                (i0, logits, caches, key, finished0, toks0))
+            return i, toks
+
+        # executable cache: sampling params are baked into the decode trace
+        gen_key = (B, P, total, cfg.stacked_blocks, do_sample, temperature,
+                   top_k, top_p, eos_token_id)
+        if self._gen_step is None or self._gen_step[0] != gen_key:
+            self._gen_step = (
+                gen_key,
+                jax.jit(run_fwd, donate_argnums=(3,)),
+                jax.jit(decode_all, donate_argnums=(3,)),
+            )
+        prefill_step, decode_step = self._gen_step[1], self._gen_step[2]
 
         params, bufs = self.state_arrays()
         caches = self.init_caches(B, total)
         cache_arrs = jax.tree.map(
             lambda c: c._data, caches, is_leaf=lambda c: isinstance(c, Tensor))
 
-        key = (jax.random.PRNGKey(seed) if seed is not None
-               else _rng.next_key()) if do_sample else None
+        key = ((jax.random.PRNGKey(seed) if seed is not None
+                else _rng.next_key()) if do_sample
+               else jax.random.PRNGKey(0))
 
-        logits, cache_arrs = step(params, bufs, ids, cache_arrs,
-                                  jnp.asarray(0, jnp.int32))
-        out_tokens = []
-        finished = jnp.zeros((B,), bool)
-        next_tok = None
-        for i in range(max_new_tokens):
-            if do_sample:
-                key, sub = jax.random.split(key)
-            else:
-                sub = None
-            next_tok = _sample_next(logits, sub, do_sample, temperature,
-                                    top_k, top_p)
-            if eos_token_id is not None:
-                next_tok = jnp.where(finished, eos_token_id, next_tok)
-                finished = finished | (next_tok == eos_token_id)
-            out_tokens.append(next_tok)
-            if eos_token_id is not None and bool(finished.all()):
-                break
-            if i + 1 < max_new_tokens:
-                logits, cache_arrs = step(
-                    params, bufs, next_tok[:, None], cache_arrs,
-                    jnp.asarray(P + i, jnp.int32))
+        logits, cache_arrs = prefill_step(params, bufs, ids, cache_arrs,
+                                          jnp.asarray(0, jnp.int32))
+        n, toks = decode_step(params, bufs, logits, cache_arrs, key)
+        n = int(n)
 
         if was_training:
             self.train()
-        return Tensor(jnp.concatenate(
-            [ids, jnp.stack(out_tokens, axis=1)], axis=1))
+        return Tensor(jnp.concatenate([ids, toks[:, :n]], axis=1))
 
 
 class GPTPretrainingCriterion(Layer):

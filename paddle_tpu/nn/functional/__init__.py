@@ -297,6 +297,11 @@ def _conv_nd(x, weight, bias, stride, padding, dilation, groups, nd, data_format
     dn = jax.lax.conv_dimension_numbers((1,) * (nd + 2), (1,) * (nd + 2), (spec_in, spec_k, spec_out))
 
     def fn(a, w, *maybe_b):
+        # AMP convention: the weight dtype defines compute precision, so a
+        # fp32 input meeting bf16 params (model.bfloat16()) rides the MXU in
+        # bf16 instead of erroring in lax.conv_general_dilated.
+        if a.dtype != w.dtype and jnp.issubdtype(w.dtype, jnp.floating):
+            a = a.astype(w.dtype)
         if transpose:
             opad = _tuplize(output_padding, nd)
             if isinstance(pad, str):

@@ -655,47 +655,67 @@ def flash_attention(
 # ---------------------------------------------------------------------------
 
 def _decode_kernel(len_ref, q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf, sem,
-                   *, block_k, d, scale):
-    """One (batch, head) program: q [1, d] against the valid prefix of the
-    cache [S_max, d] living in ANY/HBM memory. The valid length arrives via
+                   *, block_k, h, d, scale):
+    """One program per batch element: q [1, H*D] against the valid prefix
+    of the cache [S_max, H*D] living in HBM. The valid length arrives via
     scalar prefetch (len_ref), so only ceil(len / block_k) cache blocks are
     ever DMA'd into VMEM — the XLA fallback reads (and masks) all S_max
-    positions. Online softmax over blocks, fp32 accumulation."""
+    positions. Heads live flattened in the lane dim: Mosaic's (8,128)
+    tiling forbids slicing H or D when they aren't tile multiples, so
+    per-head logits come from one MXU matmul against a block-diagonal
+    projection of q (s = K @ Q_blockdiag, [bk,H*D] @ [H*D,H]) and the
+    per-head softmax weights are expanded back to lanes the same way
+    (p @ E, [bk,H] @ [H,H*D]). Online softmax over blocks, fp32
+    accumulation."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     b = pl.program_id(0)
-    h = pl.program_id(2)
     length = len_ref[0]
     num_kb = (length + block_k - 1) // block_k
-    q = q_ref[0, 0, 0, :].reshape(1, d)
+    hd = h * d
+    qf = q_ref[0].astype(jnp.float32)                            # [1, hd]
+    # seg[i, j] = (lane i belongs to head j); expand is the same predicate
+    # with the axes swapped — both built straight from 2D iotas because
+    # Mosaic cannot legalize transposes of these skinny shapes
+    seg = (jax.lax.broadcasted_iota(jnp.int32, (hd, h), 0) // d
+           == jax.lax.broadcasted_iota(jnp.int32, (hd, h), 1)
+           ).astype(jnp.float32)                                 # [hd, h]
+    expand = (jax.lax.broadcasted_iota(jnp.int32, (h, hd), 0)
+              == jax.lax.broadcasted_iota(jnp.int32, (h, hd), 1) // d
+              ).astype(jnp.float32)                              # [h, hd]
 
     def body(kb, carry):
-        m, l, acc = carry
+        m, l, acc = carry                # m,l: [1,H]; acc: [1,H*D] fp32
         start = kb * block_k
         kd = pltpu.make_async_copy(
-            k_hbm.at[b, pl.ds(start, block_k), h, :], k_buf, sem.at[0])
+            k_hbm.at[b, pl.ds(start, block_k)], k_buf, sem.at[0])
         vd = pltpu.make_async_copy(
-            v_hbm.at[b, pl.ds(start, block_k), h, :], v_buf, sem.at[1])
+            v_hbm.at[b, pl.ds(start, block_k)], v_buf, sem.at[1])
         kd.start()
         vd.start()
         kd.wait()
-        s = _dot_f32(q, k_buf[...], transpose_b=True) * scale   # [1, bk]
-        pos = start + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+        kf = k_buf[...].astype(jnp.float32)                      # [bk, hd]
+        s = _dot_f32(kf * qf, seg) * scale                       # [bk, H]
+        pos = start + jax.lax.broadcasted_iota(jnp.int32, (block_k, h), 0)
         s = jnp.where(pos < length, s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s))
-        p = jnp.exp(s - m_new)                                  # [1, bk]
-        alpha = jnp.exp(m - m_new)
-        l_new = alpha * l + jnp.sum(p)
+        m_new = jnp.maximum(m, jnp.max(s, axis=0, keepdims=True))  # [1,H]
+        p = jnp.exp(s - m_new)                                   # [bk, H]
+        alpha = jnp.exp(m - m_new)                               # [1, H]
+        l_new = alpha * l + jnp.sum(p, axis=0, keepdims=True)
         vd.wait()
-        acc_new = acc * alpha + _dot_f32(p.astype(v_buf.dtype), v_buf[...])
+        vf = v_buf[...].astype(jnp.float32)                      # [bk, hd]
+        pexp = _dot_f32(p, expand)                               # [bk, hd]
+        pv = jnp.sum(pexp * vf, axis=0, keepdims=True)           # [1, hd]
+        acc_new = acc * _dot_f32(alpha, expand) + pv
         return m_new, l_new, acc_new
 
-    m0 = jnp.float32(_NEG_INF)
-    l0 = jnp.float32(0.0)
-    acc0 = jnp.zeros((1, d), jnp.float32)
+    m0 = jnp.full((1, h), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((1, h), jnp.float32)
+    acc0 = jnp.zeros((1, hd), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
-    o_ref[0, 0, 0, :] = (acc / jnp.maximum(l, 1e-30))[0].astype(o_ref.dtype)
+    l_exp = _dot_f32(l, expand)                                  # [1, hd]
+    o_ref[0] = (acc / jnp.maximum(l_exp, 1e-30)).astype(o_ref.dtype)
 
 
 def flash_decode_arrays(q, k_cache, v_cache, length, scale=None,
@@ -721,41 +741,61 @@ def flash_decode_arrays(q, k_cache, v_cache, length, scale=None,
     block_k = min(block_k, s_max)
     while s_max % block_k:
         block_k //= 2
-    assert block_k >= 1
+    # cap the two [block_k, H*D] slabs to ~4 MiB of VMEM combined; keep
+    # block_k a sublane multiple so the seq-slice DMA stays tile-aligned
+    itemsize = jnp.dtype(k_cache.dtype).itemsize
+    while block_k > 8 and 2 * block_k * h * d * itemsize > 4 * 2**20:
+        block_k //= 2
+    assert block_k % 8 == 0 or block_k == s_max
 
+    # One program per batch element. Heads are flattened into the lane dim
+    # ([B, S, H*D] views — free reshapes of trailing contiguous dims): the
+    # cache DMA then slices only untiled/aligned dims, and q/o blocks'
+    # last two dims (1, H*D) equal the array dims — Mosaic requires
+    # blocks' last two dims be (8,128)-divisible OR full, and forbids
+    # slicing H or D when they aren't tile multiples (interpret mode
+    # never checks this).
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(b, 1, h),
+        grid=(b,),
         in_specs=[
-            pl.BlockSpec((1, 1, 1, d), lambda i, j, k, len_ref: (i, 0, k, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec((1, 1, h * d), lambda i, len_ref: (i, 0, 0)),
+            # pin caches to HBM: under ANY, Mosaic may place them in VMEM
+            # and the kernel's whole point is NOT streaming them there
+            pl.BlockSpec(memory_space=pltpu.HBM),
+            pl.BlockSpec(memory_space=pltpu.HBM),
         ],
-        out_specs=pl.BlockSpec((1, 1, 1, d),
-                               lambda i, j, k, len_ref: (i, 0, k, 0)),
+        out_specs=pl.BlockSpec((1, 1, h * d), lambda i, len_ref: (i, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((block_k, d), k_cache.dtype),
-            pltpu.VMEM((block_k, d), v_cache.dtype),
+            pltpu.VMEM((block_k, h * d), k_cache.dtype),
+            pltpu.VMEM((block_k, h * d), v_cache.dtype),
             pltpu.SemaphoreType.DMA((2,)),
         ],
     )
-    kernel = functools.partial(_decode_kernel, block_k=block_k, d=d,
+    kernel = functools.partial(_decode_kernel, block_k=block_k, h=h, d=d,
                                scale=scale)
     lengths = jnp.asarray(length, jnp.int32).reshape(1)
-    return pl.pallas_call(
+    out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, 1, h, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, 1, h * d), q.dtype),
         interpret=_interpret(),
-    )(lengths, q, k_cache, v_cache)
+    )(lengths, q.reshape(b, 1, h * d),
+      k_cache.reshape(b, s_max, h * d), v_cache.reshape(b, s_max, h * d))
+    return out.reshape(b, 1, h, d)
 
 
 def _decode_ok(q, k_cache, v_cache) -> bool:
+    import os
+    if os.environ.get("PTPU_FLASH_DECODE") == "0":
+        return False
     if not (_on_tpu() or _interpret()):
         return False
     b, s, h, d = q.shape
     s_max = k_cache.shape[1]
     # same-dtype: the kernel's lax.dot_general needs matching operands (the
-    # XLA fallback einsum would promote mixed fp32-q/bf16-cache instead)
-    return (s == 1 and d in (64, 128, 256) and s_max % 128 == 0
+    # XLA fallback einsum would promote mixed fp32-q/bf16-cache instead);
+    # h*d must fill whole lane tiles for the flattened-head cache view
+    return (s == 1 and d in (64, 128, 256) and (h * d) % 128 == 0
+            and s_max % 128 == 0
             and q.dtype == k_cache.dtype == v_cache.dtype)

@@ -1,0 +1,152 @@
+"""Bisect the decode-step critical path on the real chip: time while_loops
+whose bodies contain increasing subsets of the decode step.
+
+Usage: python scripts/decode_bisect.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+B, S_MAX, L, H, NH, HD, V, I = 8, 256, 12, 768, 12, 64, 50304, 3072
+STEPS = 128
+
+
+def timeit(name, fn, *args):
+    out = jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(fn(*args))
+    dt = time.perf_counter() - t0
+    print(f"{name:40s} {dt*1e3/STEPS:8.3f} ms/step  ({dt*1e3:.1f} ms total)")
+    return out
+
+
+def main():
+    rng = np.random.RandomState(0)
+    key = jax.random.PRNGKey(0)
+
+    wte = jnp.asarray(rng.randn(V, H) * 0.02, jnp.bfloat16)
+    qkv_w = jnp.asarray(rng.randn(L, H, 3 * H) * 0.02, jnp.bfloat16)
+    out_w = jnp.asarray(rng.randn(L, H, H) * 0.02, jnp.bfloat16)
+    fc_in = jnp.asarray(rng.randn(L, H, I) * 0.02, jnp.bfloat16)
+    fc_out = jnp.asarray(rng.randn(L, I, H) * 0.02, jnp.bfloat16)
+    biases = {
+        "qkv_b": jnp.zeros((L, 3 * H), jnp.bfloat16),
+        "out_b": jnp.zeros((L, H), jnp.bfloat16),
+        "fc_in_b": jnp.zeros((L, I), jnp.bfloat16),
+        "fc_out_b": jnp.zeros((L, H), jnp.bfloat16),
+        "ln1_w": jnp.ones((L, H), jnp.bfloat16),
+        "ln1_b": jnp.zeros((L, H), jnp.bfloat16),
+        "ln2_w": jnp.ones((L, H), jnp.bfloat16),
+        "ln2_b": jnp.zeros((L, H), jnp.bfloat16),
+    }
+    kc = [jnp.zeros((B, S_MAX, NH, HD), jnp.bfloat16) for _ in range(L)]
+    vc = [jnp.zeros((B, S_MAX, NH, HD), jnp.bfloat16) for _ in range(L)]
+    tok0 = jnp.zeros((B,), jnp.int32)
+
+    def ln(x, w, b):
+        x32 = x.astype(jnp.float32)
+        mu = x32.mean(-1, keepdims=True)
+        var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+        return ((x32 - mu) * jax.lax.rsqrt(var + 1e-5)).astype(x.dtype) * w + b
+
+    # 1. loop + embed + lm_head + argmax only
+    @jax.jit
+    def loop_vocab(tok):
+        def body(st):
+            i, tok = st
+            x = wte[tok]                                # [B, H] gather
+            logits = (x @ wte.T).astype(jnp.float32)    # [B, V]
+            return i + 1, jnp.argmax(logits, -1).astype(jnp.int32)
+        return jax.lax.while_loop(lambda st: st[0] < STEPS, body, (0, tok))
+
+    # 2. + MLP-only transformer (no attention, no caches)
+    @jax.jit
+    def loop_mlp(tok):
+        def body(st):
+            i, tok = st
+            x = wte[tok][:, None]                       # [B, 1, H]
+            for l in range(L):
+                hn = ln(x, biases["ln2_w"][l], biases["ln2_b"][l])
+                m = jax.nn.gelu(hn @ fc_in[l] + biases["fc_in_b"][l],
+                                approximate=True)
+                x = x + m @ fc_out[l] + biases["fc_out_b"][l]
+            logits = (x[:, 0] @ wte.T).astype(jnp.float32)
+            return i + 1, jnp.argmax(logits, -1).astype(jnp.int32)
+        return jax.lax.while_loop(lambda st: st[0] < STEPS, body, (0, tok))
+
+    # 3. + qkv/out matmuls, no cache/attention
+    @jax.jit
+    def loop_noattn(tok):
+        def body(st):
+            i, tok = st
+            x = wte[tok][:, None]
+            for l in range(L):
+                hn = ln(x, biases["ln1_w"][l], biases["ln1_b"][l])
+                qkv = (hn @ qkv_w[l] + biases["qkv_b"][l]).reshape(B, 1, 3, NH, HD)
+                o = qkv[:, :, 0]                        # pretend attention
+                x = x + o.reshape(B, 1, H) @ out_w[l] + biases["out_b"][l]
+                hn = ln(x, biases["ln2_w"][l], biases["ln2_b"][l])
+                m = jax.nn.gelu(hn @ fc_in[l] + biases["fc_in_b"][l],
+                                approximate=True)
+                x = x + m @ fc_out[l] + biases["fc_out_b"][l]
+            logits = (x[:, 0] @ wte.T).astype(jnp.float32)
+            return i + 1, jnp.argmax(logits, -1).astype(jnp.int32)
+        return jax.lax.while_loop(lambda st: st[0] < STEPS, body, (0, tok))
+
+    # 4. + cache DUS + XLA masked attention (full step, XLA attention)
+    def make_full(attn_kind):
+        from paddle_tpu.ops.pallas_ops import (cached_attention_arrays,
+                                               flash_decode_arrays)
+
+        @jax.jit
+        def loop_full(tok, kcs, vcs):
+            def body(st):
+                i, tok, kcs, vcs = st
+                t = 128 + i        # pretend prompt 128
+                x = wte[tok][:, None]
+                nk, nv = [], []
+                for l in range(L):
+                    hn = ln(x, biases["ln1_w"][l], biases["ln1_b"][l])
+                    qkv = (hn @ qkv_w[l] + biases["qkv_b"][l]).reshape(
+                        B, 1, 3, NH, HD)
+                    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+                    if attn_kind == "pallas":
+                        kc2 = jax.lax.dynamic_update_slice(
+                            kcs[l], k, (0, t, 0, 0))
+                        vc2 = jax.lax.dynamic_update_slice(
+                            vcs[l], v, (0, t, 0, 0))
+                        o = flash_decode_arrays(q, kc2, vc2, t + 1)
+                    else:
+                        o, kc2, vc2 = cached_attention_arrays(
+                            q, k, v, kcs[l], vcs[l], t)
+                    nk.append(kc2)
+                    nv.append(vc2)
+                    x = x + o.reshape(B, 1, H) @ out_w[l] + biases["out_b"][l]
+                    hn = ln(x, biases["ln2_w"][l], biases["ln2_b"][l])
+                    m = jax.nn.gelu(hn @ fc_in[l] + biases["fc_in_b"][l],
+                                    approximate=True)
+                    x = x + m @ fc_out[l] + biases["fc_out_b"][l]
+                logits = (x[:, 0] @ wte.T).astype(jnp.float32)
+                return (i + 1, jnp.argmax(logits, -1).astype(jnp.int32),
+                        nk, nv)
+            return jax.lax.while_loop(lambda st: st[0] < STEPS, body,
+                                      (0, tok, kcs, vcs))
+        return loop_full
+
+    timeit("vocab only (embed+lm_head+argmax)", loop_vocab, tok0)
+    timeit("+ 12-layer MLP", loop_mlp, tok0)
+    timeit("+ qkv/out matmuls (no attn)", loop_noattn, tok0)
+    os.environ["PTPU_FLASH_DECODE"] = "0"
+    timeit("full step, XLA attention", make_full("xla"), tok0, kc, vc)
+    os.environ["PTPU_FLASH_DECODE"] = "1"
+    timeit("full step, pallas decode kernel", make_full("pallas"), tok0, kc, vc)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+"""Profile the fused decode loop on the real chip and print the device-op
+time breakdown (jax.profiler.ProfileData — no tensorboard needed).
+
+Usage: python scripts/profile_decode.py [train|decode]
+"""
+import glob
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from collections import defaultdict
+
+import numpy as np
+
+
+def run_decode():
+    import paddle_tpu as paddle
+    from paddle_tpu import parallel
+    from paddle_tpu.models import GPTForCausalLM, gpt2_124m_config
+
+    cfg = gpt2_124m_config(stacked_blocks=True)
+    batch, prompt, new = 8, 128, 128
+    paddle.seed(0)
+    parallel.init_mesh()
+    model = parallel.place_model(GPTForCausalLM(cfg))
+    model.bfloat16()
+    model.eval()
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, prompt)).astype("int32"))
+    model.generate(ids, max_new_tokens=new)  # compile warmup
+    return lambda: model.generate(ids, max_new_tokens=new)
+
+
+def main():
+    import jax
+
+    fn = run_decode()
+    tmp = tempfile.mkdtemp(prefix="ptpu_prof_")
+    with jax.profiler.trace(tmp):
+        out = fn()
+        jax.block_until_ready(getattr(out, "_data", out))
+
+    paths = glob.glob(os.path.join(tmp, "**", "*.xplane.pb"), recursive=True)
+    print("trace:", paths)
+    pd = jax.profiler.ProfileData.from_file(paths[0])
+    for plane in pd.planes:
+        if "TPU" not in plane.name and "tpu" not in plane.name:
+            continue
+        print("== plane:", plane.name)
+        agg = defaultdict(float)
+        cnt = defaultdict(int)
+        for line in plane.lines:
+            for ev in line.events:
+                name = ev.name
+                agg[name] += ev.duration_ns / 1e6
+                cnt[name] += 1
+        for name, ms in sorted(agg.items(), key=lambda kv: -kv[1])[:40]:
+            print(f"{ms:10.3f} ms  x{cnt[name]:<6d} {name[:110]}")
+
+
+if __name__ == "__main__":
+    main()

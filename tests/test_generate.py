@@ -139,3 +139,32 @@ def test_fused_multi_transformer_cache_parity():
                                rtol=2e-4, atol=2e-4)
     np.testing.assert_allclose(np.asarray(out_d._data), full[:, S - 1:],
                                rtol=2e-4, atol=2e-4)
+
+
+def test_stacked_scan_decode_matches_unrolled(monkeypatch):
+    """The stacked [L,...] cache format (layer-scan decode — the only path
+    for >32-layer models) must match the unrolled per-layer path."""
+    monkeypatch.setenv("PTPU_DECODE_UNROLL", "0")
+    cfg = gpt_test_config(stacked_blocks=True, sequence_parallel=False)
+    paddle.seed(3)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+
+    rng = np.random.RandomState(3)
+    B, P, NEW = 2, 5, 4
+    prompt = rng.randint(0, cfg.vocab_size, (B, P)).astype(np.int32)
+
+    caches = model.init_caches(B, P + NEW)
+    assert isinstance(caches, tuple) and len(caches) == 2  # stacked format
+    assert len(caches[0].shape) == 5
+
+    out_scan = np.asarray(
+        model.generate(Tensor(jnp.asarray(prompt)), max_new_tokens=NEW)._data)
+
+    monkeypatch.setenv("PTPU_DECODE_UNROLL", "1")
+    model._gen_step = None          # drop the cached executables
+    caches = model.init_caches(B, P + NEW)
+    assert isinstance(caches, list)  # per-layer format
+    out_unrolled = np.asarray(
+        model.generate(Tensor(jnp.asarray(prompt)), max_new_tokens=NEW)._data)
+    np.testing.assert_array_equal(out_scan, out_unrolled)
