@@ -309,10 +309,14 @@ def _cached_attn_arrays(q, k, v, kc, vc, t, prefill):
     if prefill:
         from ..ops.pallas_ops import flash_attention_arrays
 
-        kc2 = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype),
-                                           (0, 0, 0, 0))
-        vc2 = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype),
-                                           (0, 0, 0, 0))
+        kw, vw = k, v
+        if kc.ndim == 3:                # flat [B, Smax, H*D] cache ring
+            b, s = k.shape[0], k.shape[1]
+            kw = k.reshape(b, s, -1)
+            vw = v.reshape(b, s, -1)
+        origin = (0,) * kc.ndim
+        kc2 = jax.lax.dynamic_update_slice(kc, kw.astype(kc.dtype), origin)
+        vc2 = jax.lax.dynamic_update_slice(vc, vw.astype(vc.dtype), origin)
         return flash_attention_arrays(q, k, v, is_causal=True), kc2, vc2
     return cached_attention_arrays(q, k, v, kc, vc, t)
 
@@ -435,7 +439,7 @@ class GPTStackedBlocks(Layer):
         """KV-cache prefill/decode over the stacked weights.
 
         Two cache formats select two execution strategies:
-        - list of per-layer (k, v) pairs ([B,Smax,H,D] each) → UNROLLED
+        - list of per-layer (k, v) pairs (flat [B,Smax,H*D] each) → UNROLLED
           python loop with static weight slices. This is the fast decode
           path: caches stay separate buffers in the caller's while-loop
           carry so each step's update is an in-place one-row
@@ -443,12 +447,12 @@ class GPTStackedBlocks(Layer):
           matmuls. The scan form instead re-materializes every layer's
           cache slice per step (profiled at ~4x the whole weight-stream
           cost per decode step on v5e).
-        - stacked (k [L,B,Smax,H,D], v [L,...]) → lax.scan over the layer
+        - stacked (k [L,B,Smax,H*D], v [L,...]) → lax.scan over the layer
           dim with cache slices as scan xs/ys (one executable regardless
           of depth; the right trade for very deep models).
         """
         stacked_format = (len(caches) == 2 and hasattr(caches[0], "shape")
-                          and len(caches[0].shape) == 5)
+                          and len(caches[0].shape) in (4, 5))
         if not stacked_format:
             return self._forward_cached_unrolled(x, caches, time_step)
         cfg = self.cfg
@@ -719,13 +723,16 @@ class GPTForCausalLM(Layer):
     def init_caches(self, batch_size, max_length, dtype=None):
         """Allocate static-shape KV caches (reference CacheKV:
         fused_multi_transformer_op.cu:90 — [2, B, H, S_max, D] per layer;
-        here [B, S_max, H, D] matching the flash-attention layout)."""
+        here flat [B, S_max, H*D] rings — see cached_attention_arrays)."""
         cfg = self.cfg
         nh = cfg.num_attention_heads
         hd = cfg.hidden_size // nh
         if dtype is None:
             dtype = self.gpt.embeddings.word_embeddings.weight.dtype
-        shape = (batch_size, max_length, nh, hd)
+        # flat [B, Smax, H*D] rings: the (H, D) split never reaches a
+        # buffer, so XLA keeps a row-contiguous cache layout (no relayout
+        # copies around the decode kernel, contiguous one-row writes)
+        shape = (batch_size, max_length, nh * hd)
         import os
         unroll_env = os.environ.get("PTPU_DECODE_UNROLL")
         unroll = (cfg.num_hidden_layers <= 32 if unroll_env is None
@@ -769,7 +776,10 @@ class GPTForCausalLM(Layer):
         was_training = self.training
         self.eval()
 
-        def run_fwd(params, bufs, chunk, caches, t):
+        def run_fwd(params, bufs, chunk, caches, t, static_prefill=False):
+            # static_prefill (t == 0 STATICALLY) selects the flash-prefill
+            # branch: causal flash over the chunk + cache write, instead
+            # of the O(S * S_max) masked path a traced t forces
             backup = model.state_arrays()
             try:
                 model.load_state_arrays(params, bufs)
@@ -777,7 +787,7 @@ class GPTForCausalLM(Layer):
                     logits, new_caches = model(
                         Tensor(chunk),
                         caches=jax.tree.map(Tensor, caches),
-                        time_step=Tensor(t),
+                        time_step=None if static_prefill else Tensor(t),
                     )
                 last = logits._data[:, -1].astype(jnp.float32)
                 return last, jax.tree.map(lambda c: c._data, new_caches,
@@ -785,13 +795,18 @@ class GPTForCausalLM(Layer):
             finally:
                 model.load_state_arrays(*backup)
 
-        def decode_all(params, bufs, logits, caches, key):
-            """The WHOLE decode loop as one on-device while_loop: a
+        def generate_all(params, bufs, ids_in, caches, key):
+            """Prefill + the WHOLE decode loop as ONE program: a
             host-driven token loop pays a dispatch round-trip per step
-            (ruinous through a network-tunneled chip), while one program
-            keeps every step on-device. Early EOS exit survives as the
-            loop condition; the emitted count comes back so the host can
-            trim to the host-loop-identical length."""
+            (ruinous through a network-tunneled chip) and even a separate
+            prefill dispatch doubles the fixed per-call cost, so both
+            live in one jitted call with the loop as an on-device
+            while_loop. Early EOS exit survives as the loop condition;
+            the emitted count comes back so the host can trim to the
+            host-loop-identical length."""
+            logits, caches = run_fwd(params, bufs, ids_in, caches,
+                                     jnp.asarray(0, jnp.int32),
+                                     static_prefill=True)
             finished0 = jnp.zeros((B,), bool)
             toks0 = jnp.zeros((B, max_new_tokens), jnp.int32)
 
@@ -838,12 +853,9 @@ class GPTForCausalLM(Layer):
         gen_key = (B, P, total, cfg.stacked_blocks, do_sample, temperature,
                    top_k, top_p, eos_token_id)
         if self._gen_step is None or self._gen_step[0] != gen_key:
-            self._gen_step = (
-                gen_key,
-                jax.jit(run_fwd, donate_argnums=(3,)),
-                jax.jit(decode_all, donate_argnums=(3,)),
-            )
-        prefill_step, decode_step = self._gen_step[1], self._gen_step[2]
+            self._gen_step = (gen_key,
+                              jax.jit(generate_all, donate_argnums=(3,)))
+        gen_step = self._gen_step[1]
 
         params, bufs = self.state_arrays()
         caches = self.init_caches(B, total)
@@ -854,9 +866,7 @@ class GPTForCausalLM(Layer):
                 else _rng.next_key()) if do_sample
                else jax.random.PRNGKey(0))
 
-        logits, cache_arrs = prefill_step(params, bufs, ids, cache_arrs,
-                                          jnp.asarray(0, jnp.int32))
-        n, toks = decode_step(params, bufs, logits, cache_arrs, key)
+        n, toks = gen_step(params, bufs, ids, cache_arrs, key)
         n = int(n)
 
         if was_training:

@@ -606,15 +606,30 @@ def cached_attention_arrays(q, k, v, k_cache, v_cache, t, scale=None,
     s_max = k_cache.shape[1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     t = jnp.asarray(t, jnp.int32)
-    k_cache = jax.lax.dynamic_update_slice(
-        k_cache, k.astype(k_cache.dtype), (0, t, 0, 0))
-    v_cache = jax.lax.dynamic_update_slice(
-        v_cache, v.astype(v_cache.dtype), (0, t, 0, 0))
+    # caches may be [B, Smax, H, D] or flattened [B, Smax, H*D]. The flat
+    # form is what decode wants: the (H, D) split never reaches any
+    # buffer, so XLA has no reason to pick an (H, D)-tiled cache layout
+    # that would force per-step relayout copies around the Pallas kernel
+    # (whose view is flat anyway), and the one-row DUS write stays
+    # contiguous.
+    flat = k_cache.ndim == 3
+    if flat:
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.reshape(b, s, h * d).astype(k_cache.dtype), (0, t, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.reshape(b, s, h * d).astype(v_cache.dtype), (0, t, 0))
+    else:
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, t, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, t, 0, 0))
     if mask is None and _decode_ok(q, k_cache, v_cache):
         # S_q=1 decode: Pallas kernel reads only the valid cache prefix
         out = flash_decode_arrays(q, k_cache, v_cache, t + 1, scale=scale)
         return out.astype(q.dtype), k_cache, v_cache
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
+    kc4 = k_cache.reshape(b, s_max, h, d) if flat else k_cache
+    vc4 = v_cache.reshape(b, s_max, h, d) if flat else v_cache
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, kc4,
                         preferred_element_type=jnp.float32) * scale
     q_pos = t + jnp.arange(s, dtype=jnp.int32)          # absolute positions
     k_pos = jnp.arange(s_max, dtype=jnp.int32)
@@ -626,7 +641,7 @@ def cached_attention_arrays(q, k, v, k_cache, v_cache, t, scale=None,
         else:
             logits = logits + mask.astype(logits.dtype)
     probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v_cache.dtype), v_cache)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(vc4.dtype), vc4)
     return out.astype(q.dtype), k_cache, v_cache
 
 
@@ -655,67 +670,99 @@ def flash_attention(
 # ---------------------------------------------------------------------------
 
 def _decode_kernel(len_ref, q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf, sem,
-                   *, block_k, h, d, scale):
-    """One program per batch element: q [1, H*D] against the valid prefix
-    of the cache [S_max, H*D] living in HBM. The valid length arrives via
-    scalar prefetch (len_ref), so only ceil(len / block_k) cache blocks are
-    ever DMA'd into VMEM — the XLA fallback reads (and masks) all S_max
-    positions. Heads live flattened in the lane dim: Mosaic's (8,128)
-    tiling forbids slicing H or D when they aren't tile multiples, so
-    per-head logits come from one MXU matmul against a block-diagonal
-    projection of q (s = K @ Q_blockdiag, [bk,H*D] @ [H*D,H]) and the
-    per-head softmax weights are expanded back to lanes the same way
-    (p @ E, [bk,H] @ [H,H*D]). Online softmax over blocks, fp32
-    accumulation."""
+                   *, block_b, block_k, h, d, scale):
+    """One program per batch slab: q [bb, 1, H*D] against the valid prefix
+    of the caches [B, S_max, H*D] living in HBM. The valid length arrives
+    via scalar prefetch (len_ref), so only ceil(len / block_k) cache
+    blocks are ever DMA'd into VMEM — the XLA fallback reads (and masks)
+    all S_max positions — and consecutive blocks are double-buffered so
+    the next slab's DMA overlaps the current block's math. Heads live
+    flattened in the lane dim: Mosaic's (8,128) tiling forbids slicing H
+    or D when they aren't tile multiples, so per-head logits come from one
+    MXU matmul against the segment indicator (s = (K ∘ q) @ seg,
+    [bb*bk, H*D] @ [H*D, H]) and the per-head softmax weights are expanded
+    back to lanes with its swapped twin (p @ E, [bb*bk, H] @ [H, H*D]).
+    Online softmax over blocks, fp32 accumulation."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    b = pl.program_id(0)
+    ib = pl.program_id(0)
     length = len_ref[0]
     num_kb = (length + block_k - 1) // block_k
-    hd = h * d
-    qf = q_ref[0].astype(jnp.float32)                            # [1, hd]
+    bb, hd = block_b, h * d
+    qf = q_ref[...].astype(jnp.float32)                          # [bb,1,hd]
+    # _dot_f32 contract: bf16 caches ride the MXU's fast path (flash-
+    # standard), fp32 caches keep fp32-HIGHEST correctness
+    fast = jnp.bfloat16 if k_buf.dtype == jnp.bfloat16 else jnp.float32
     # seg[i, j] = (lane i belongs to head j); expand is the same predicate
     # with the axes swapped — both built straight from 2D iotas because
     # Mosaic cannot legalize transposes of these skinny shapes
     seg = (jax.lax.broadcasted_iota(jnp.int32, (hd, h), 0) // d
            == jax.lax.broadcasted_iota(jnp.int32, (hd, h), 1)
-           ).astype(jnp.float32)                                 # [hd, h]
+           ).astype(fast)                                        # [hd, h]
     expand = (jax.lax.broadcasted_iota(jnp.int32, (h, hd), 0)
               == jax.lax.broadcasted_iota(jnp.int32, (h, hd), 1) // d
-              ).astype(jnp.float32)                              # [h, hd]
+              ).astype(fast)                                     # [h, hd]
+
+    def seg_dot(a3, mat, exact=False):
+        """[bb, bk, X] @ [X, Y] -> [bb, bk, Y] via a free row-merge
+        reshape. Default: operands in the cache's compute dtype (bf16
+        caches → MXU fast path with fp32 accum, flash-standard for the
+        big K/p products). exact=True keeps fp32 operands (HIGHEST) —
+        required for the alpha/l rescale expansions, where low-precision
+        rounding would compound across blocks."""
+        rows = a3.shape[0] * a3.shape[1]
+        a2 = a3.reshape(rows, a3.shape[2])
+        if exact:
+            out = _dot_f32(a2, mat.astype(jnp.float32))
+        else:
+            out = _dot_f32(a2.astype(fast), mat)
+        return out.reshape(a3.shape[0], a3.shape[1], mat.shape[1])
+
+    def copies(slot, kb):
+        start = kb * block_k
+        src_k = k_hbm.at[pl.ds(ib * bb, bb), pl.ds(start, block_k)]
+        src_v = v_hbm.at[pl.ds(ib * bb, bb), pl.ds(start, block_k)]
+        return (pltpu.make_async_copy(src_k, k_buf.at[slot], sem.at[slot, 0]),
+                pltpu.make_async_copy(src_v, v_buf.at[slot], sem.at[slot, 1]))
+
+    for c in copies(0, 0):
+        c.start()
 
     def body(kb, carry):
-        m, l, acc = carry                # m,l: [1,H]; acc: [1,H*D] fp32
+        m, l, acc = carry          # m,l: [bb,1,H]; acc: [bb,1,H*D] fp32
+        slot = jax.lax.rem(kb, 2)
         start = kb * block_k
-        kd = pltpu.make_async_copy(
-            k_hbm.at[b, pl.ds(start, block_k)], k_buf, sem.at[0])
-        vd = pltpu.make_async_copy(
-            v_hbm.at[b, pl.ds(start, block_k)], v_buf, sem.at[1])
-        kd.start()
-        vd.start()
+
+        @pl.when(kb + 1 < num_kb)
+        def _prefetch():
+            for c in copies(1 - slot, kb + 1):
+                c.start()
+
+        kd, vd = copies(slot, kb)
         kd.wait()
-        kf = k_buf[...].astype(jnp.float32)                      # [bk, hd]
-        s = _dot_f32(kf * qf, seg) * scale                       # [bk, H]
-        pos = start + jax.lax.broadcasted_iota(jnp.int32, (block_k, h), 0)
+        kf = k_buf[slot].astype(jnp.float32)                     # [bb,bk,hd]
+        s = seg_dot(kf * qf, seg) * scale                        # [bb,bk,H]
+        pos = start + jax.lax.broadcasted_iota(
+            jnp.int32, (bb, block_k, h), 1)
         s = jnp.where(pos < length, s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=0, keepdims=True))  # [1,H]
-        p = jnp.exp(s - m_new)                                   # [bk, H]
-        alpha = jnp.exp(m - m_new)                               # [1, H]
-        l_new = alpha * l + jnp.sum(p, axis=0, keepdims=True)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)                                   # [bb,bk,H]
+        alpha = jnp.exp(m - m_new)                               # [bb,1,H]
+        l_new = alpha * l + jnp.sum(p, axis=1, keepdims=True)
         vd.wait()
-        vf = v_buf[...].astype(jnp.float32)                      # [bk, hd]
-        pexp = _dot_f32(p, expand)                               # [bk, hd]
-        pv = jnp.sum(pexp * vf, axis=0, keepdims=True)           # [1, hd]
-        acc_new = acc * _dot_f32(alpha, expand) + pv
+        vf = v_buf[slot].astype(jnp.float32)                     # [bb,bk,hd]
+        pexp = seg_dot(p, expand)                                # [bb,bk,hd]
+        pv = jnp.sum(pexp * vf, axis=1, keepdims=True)           # [bb,1,hd]
+        acc_new = acc * seg_dot(alpha, expand, exact=True) + pv
         return m_new, l_new, acc_new
 
-    m0 = jnp.full((1, h), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((1, h), jnp.float32)
-    acc0 = jnp.zeros((1, hd), jnp.float32)
+    m0 = jnp.full((bb, 1, h), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bb, 1, h), jnp.float32)
+    acc0 = jnp.zeros((bb, 1, hd), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
-    l_exp = _dot_f32(l, expand)                                  # [1, hd]
-    o_ref[0] = (acc / jnp.maximum(l_exp, 1e-30)).astype(o_ref.dtype)
+    l_exp = seg_dot(l, expand, exact=True)                       # [bb,1,hd]
+    o_ref[...] = (acc / jnp.maximum(l_exp, 1e-30)).astype(o_ref.dtype)
 
 
 def flash_decode_arrays(q, k_cache, v_cache, length, scale=None,
@@ -735,20 +782,33 @@ def flash_decode_arrays(q, k_cache, v_cache, length, scale=None,
     b, s, h, d = q.shape
     s_max = k_cache.shape[1]
     assert s == 1, "flash_decode_arrays is the S_q=1 path"
+    if k_cache.ndim == 4:               # [B, Smax, H, D] → flat lane view
+        k_cache = k_cache.reshape(b, s_max, h * d)
+        v_cache = v_cache.reshape(b, s_max, h * d)
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     # blocks must tile s_max exactly: the DMA loop reads whole blocks, and a
     # ragged final block would read past the cache rows
     block_k = min(block_k, s_max)
     while s_max % block_k:
         block_k //= 2
-    # cap the two [block_k, H*D] slabs to ~4 MiB of VMEM combined; keep
+    # prefer >= 2 seq blocks so the double-buffered DMA actually overlaps
+    if s_max // block_k < 2 and block_k >= 16 and s_max % (block_k // 2) == 0:
+        block_k //= 2
+    # batch slab: largest divisor of B whose double-buffered k+v slabs
+    # ([2, bb, block_k, H*D] each) stay within ~8 MiB of VMEM; keep
     # block_k a sublane multiple so the seq-slice DMA stays tile-aligned
     itemsize = jnp.dtype(k_cache.dtype).itemsize
-    while block_k > 8 and 2 * block_k * h * d * itemsize > 4 * 2**20:
+    block_b = b
+    while block_b > 1 and (b % block_b
+                           or 4 * block_b * block_k * h * d * itemsize
+                           > 8 * 2**20):
+        block_b -= 1
+    while (block_k > 8
+           and 4 * block_b * block_k * h * d * itemsize > 8 * 2**20):
         block_k //= 2
     assert block_k % 8 == 0 or block_k == s_max
 
-    # One program per batch element. Heads are flattened into the lane dim
+    # One program per batch slab. Heads are flattened into the lane dim
     # ([B, S, H*D] views — free reshapes of trailing contiguous dims): the
     # cache DMA then slices only untiled/aligned dims, and q/o blocks'
     # last two dims (1, H*D) equal the array dims — Mosaic requires
@@ -757,31 +817,31 @@ def flash_decode_arrays(q, k_cache, v_cache, length, scale=None,
     # never checks this).
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(b,),
+        grid=(b // block_b,),
         in_specs=[
-            pl.BlockSpec((1, 1, h * d), lambda i, len_ref: (i, 0, 0)),
+            pl.BlockSpec((block_b, 1, h * d), lambda i, len_ref: (i, 0, 0)),
             # pin caches to HBM: under ANY, Mosaic may place them in VMEM
             # and the kernel's whole point is NOT streaming them there
             pl.BlockSpec(memory_space=pltpu.HBM),
             pl.BlockSpec(memory_space=pltpu.HBM),
         ],
-        out_specs=pl.BlockSpec((1, 1, h * d), lambda i, len_ref: (i, 0, 0)),
+        out_specs=pl.BlockSpec((block_b, 1, h * d),
+                               lambda i, len_ref: (i, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((block_k, h * d), k_cache.dtype),
-            pltpu.VMEM((block_k, h * d), v_cache.dtype),
-            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.VMEM((2, block_b, block_k, h * d), k_cache.dtype),
+            pltpu.VMEM((2, block_b, block_k, h * d), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
         ],
     )
-    kernel = functools.partial(_decode_kernel, block_k=block_k, h=h, d=d,
-                               scale=scale)
+    kernel = functools.partial(_decode_kernel, block_b=block_b,
+                               block_k=block_k, h=h, d=d, scale=scale)
     lengths = jnp.asarray(length, jnp.int32).reshape(1)
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, 1, h * d), q.dtype),
         interpret=_interpret(),
-    )(lengths, q.reshape(b, 1, h * d),
-      k_cache.reshape(b, s_max, h * d), v_cache.reshape(b, s_max, h * d))
+    )(lengths, q.reshape(b, 1, h * d), k_cache, v_cache)
     return out.reshape(b, 1, h, d)
 
 

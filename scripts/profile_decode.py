@@ -1,7 +1,7 @@
 """Profile the fused decode loop on the real chip and print the device-op
 time breakdown (jax.profiler.ProfileData — no tensorboard needed).
 
-Usage: python scripts/profile_decode.py [train|decode]
+Usage: python scripts/profile_decode.py
 """
 import glob
 import os

@@ -153,3 +153,25 @@ def test_cached_attention_routes_to_decode_kernel():
     got = jnp.concatenate(outs, axis=1)
     np.testing.assert_allclose(np.asarray(got), np.asarray(full),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_flash_decode_kernel_vs_reference_shapes():
+    """Numeric check of the flash-decode kernel (interpret mode) against
+    masked full attention over the valid cache prefix, across batch-slab /
+    block_k boundary shapes (ragged final block, single-block, tiny len)."""
+    from paddle_tpu.ops.pallas_ops import flash_decode_arrays, mha_reference
+
+    rng = np.random.RandomState(0)
+    for (B, S_MAX, H, D, length) in [(2, 128, 4, 64, 37),
+                                     (4, 256, 12, 64, 200),
+                                     (2, 128, 2, 64, 128),
+                                     (3, 384, 4, 32, 5)]:
+        q = jnp.asarray(rng.randn(B, 1, H, D), jnp.float32)
+        kc = jnp.asarray(rng.randn(B, S_MAX, H * D), jnp.float32)
+        vc = jnp.asarray(rng.randn(B, S_MAX, H * D), jnp.float32)
+        out = flash_decode_arrays(q, kc, vc, jnp.int32(length))
+        ref = mha_reference(q, kc[:, :length].reshape(B, length, H, D),
+                            vc[:, :length].reshape(B, length, H, D))
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(ref, np.float32),
+                                   rtol=1e-4, atol=1e-4)

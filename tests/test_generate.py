@@ -156,7 +156,7 @@ def test_stacked_scan_decode_matches_unrolled(monkeypatch):
 
     caches = model.init_caches(B, P + NEW)
     assert isinstance(caches, tuple) and len(caches) == 2  # stacked format
-    assert len(caches[0].shape) == 5
+    assert len(caches[0].shape) == 4  # [L, B, Smax, H*D]
 
     out_scan = np.asarray(
         model.generate(Tensor(jnp.asarray(prompt)), max_new_tokens=NEW)._data)

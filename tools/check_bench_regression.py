@@ -1,0 +1,74 @@
+"""Bench regression gate (reference capability:
+tools/check_op_benchmark_result.py + tools/ci_op_benchmark.sh — relative
+regression checks against a prior run, no absolute thresholds).
+
+Compares the current bench artifacts against a baseline run:
+
+    python tools/check_bench_regression.py BENCH_r01.json BENCH_r02.json
+    python tools/check_bench_regression.py --ladder OLD_LADDER.json BENCH_LADDER.json
+
+Exit 0 = no metric regressed more than --tolerance (default 7%, chosen
+above the observed ~±5% tunnel run-to-run variance); exit 1 otherwise.
+CPU-smoke fallback lines (tunnel outage) are reported but never gate.
+"""
+import argparse
+import json
+import sys
+
+
+def _entries(path):
+    """Yield {metric, value, ...} dicts from either artifact shape:
+    driver BENCH_r*.json ({"parsed": {...}}) or BENCH_LADDER.json lists."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict):
+        doc = [doc.get("parsed", doc)]
+    for entry in doc:
+        if entry and "metric" in entry:
+            yield entry
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("baseline")
+    ap.add_argument("current")
+    ap.add_argument("--ladder", action="store_true",
+                    help="compat no-op; both artifact shapes auto-detected")
+    ap.add_argument("--tolerance", type=float, default=0.07,
+                    help="allowed fractional drop per metric (default 7%%)")
+    args = ap.parse_args(argv)
+
+    base = {e["metric"]: e for e in _entries(args.baseline)}
+    cur = {e["metric"]: e for e in _entries(args.current)}
+
+    failures = []
+    for name, b in sorted(base.items()):
+        c = cur.get(name)
+        if "error" in b or b.get("value", 0) <= 0:
+            continue                    # baseline itself failed: nothing to gate
+        if "smoke" in name:
+            continue                    # CPU fallback line: outage, not perf
+        if c is None or "error" in c:
+            msg = c.get("error", "missing") if c else "missing"
+            print(f"FAIL {name}: current run has no number ({msg})")
+            failures.append(name)
+            continue
+        ratio = c["value"] / b["value"]
+        status = "ok" if ratio >= 1.0 - args.tolerance else "FAIL"
+        print(f"{status:4s} {name}: {b['value']:.2f} -> {c['value']:.2f} "
+              f"({(ratio - 1) * 100:+.1f}%)")
+        if status == "FAIL":
+            failures.append(name)
+    for name in sorted(set(cur) - set(base)):
+        print(f"new  {name}: {cur[name].get('value', cur[name].get('error'))}")
+
+    if failures:
+        print(f"\n{len(failures)} regression(s) beyond "
+              f"{args.tolerance:.0%}: {', '.join(failures)}")
+        return 1
+    print("\nno regressions")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

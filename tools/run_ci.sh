@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# CI entry point (reference capability: paddle_build.sh test stages +
+# tools/gen_ut_cmakelists.py tier metadata — here: pytest tiers + the
+# driver-shaped gates).
+#
+#   tools/run_ci.sh fast    — "not slow" tier on the virtual 8-device CPU mesh
+#   tools/run_ci.sh full    — everything incl. subprocess/example suites
+#   tools/run_ci.sh gates   — driver gates: compile-check entry() + the
+#                             8-device multichip dryrun + CPU bench smoke
+#   tools/run_ci.sh bench-check OLD.json NEW.json — perf regression gate
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export PTPU_FORCE_PLATFORM="${PTPU_FORCE_PLATFORM:-cpu}"
+export XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}"
+
+case "${1:-fast}" in
+  fast)
+    python -m pytest tests/ -m "not slow" -q --ignore=tests/test_examples.py
+    ;;
+  full)
+    python -m pytest tests/ -q
+    ;;
+  gates)
+    python - <<'EOF'
+import __graft_entry__ as g
+fn, args = g.entry()
+import jax
+print("entry() abstract eval:", jax.eval_shape(fn, *args))
+g.dryrun_multichip(8)
+print("gates OK")
+EOF
+    python bench.py
+    ;;
+  bench-check)
+    shift
+    python tools/check_bench_regression.py "$@"
+    ;;
+  *)
+    echo "usage: $0 {fast|full|gates|bench-check OLD NEW}" >&2
+    exit 2
+    ;;
+esac
