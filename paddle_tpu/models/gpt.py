@@ -749,10 +749,12 @@ class GPTForCausalLM(Layer):
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
                  seed=None):
-        """KV-cache autoregressive decoding: one compiled prefill program +
-        ONE compiled decode program reused for every position (static cache
-        shapes; lax.dynamic_update_slice ring writes). Greedy by default;
-        temperature / top-k / top-p sampling with do_sample=True.
+        """KV-cache autoregressive decoding: prefill and the whole decode
+        loop run as ONE compiled program per (shapes, sampling) key — the
+        loop is an on-device while_loop over static cache shapes
+        (lax.dynamic_update_slice ring writes), so a generate() call costs
+        a single dispatch. Greedy by default; temperature / top-k / top-p
+        sampling with do_sample=True.
 
         Returns [B, prompt + generated] int32 ids (generation stops early
         when every row has emitted eos_token_id).

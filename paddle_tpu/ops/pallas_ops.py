@@ -587,9 +587,11 @@ def cached_attention_arrays(q, k, v, k_cache, v_cache, t, scale=None,
 
     q, k, v:            [B, S, H, D] — the current chunk (S = prompt length
                         at prefill, 1 per decode step)
-    k_cache, v_cache:   [B, S_max, H, D] — static-shape rings; static shapes
-                        mean ONE XLA executable serves every decode position
-                        (dynamic start index via lax.dynamic_update_slice)
+    k_cache, v_cache:   flat [B, S_max, H*D] rings (preferred — see the
+                        layout note in the body) or legacy [B, S_max, H, D];
+                        static shapes mean ONE XLA executable serves every
+                        decode position (dynamic start index via
+                        lax.dynamic_update_slice)
     t:                  int32 scalar — write position of the chunk's first
                         token (0 at prefill, current length during decode)
     mask:               optional extra mask over cache positions,
@@ -688,7 +690,13 @@ def _decode_kernel(len_ref, q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf, sem,
 
     ib = pl.program_id(0)
     length = len_ref[0]
-    num_kb = (length + block_k - 1) // block_k
+    # clamp to >= 1 block: the pre-loop prefetch below starts a DMA
+    # unconditionally, and a zero-trip loop would never wait on it
+    # (unbalanced semaphore at kernel exit); length 0 just reads garbage
+    # that the position mask then fully excludes... except nothing is
+    # valid — callers pass t+1 >= 1, and the mask yields uniform weights
+    # over block 0 in the degenerate case rather than a fault.
+    num_kb = jnp.maximum((length + block_k - 1) // block_k, 1)
     bb, hd = block_b, h * d
     qf = q_ref[...].astype(jnp.float32)                          # [bb,1,hd]
     # _dot_f32 contract: bf16 caches ride the MXU's fast path (flash-
@@ -782,6 +790,9 @@ def flash_decode_arrays(q, k_cache, v_cache, length, scale=None,
     b, s, h, d = q.shape
     s_max = k_cache.shape[1]
     assert s == 1, "flash_decode_arrays is the S_q=1 path"
+    # length is traced, so the >=1 contract can't be asserted here; the
+    # kernel clamps num_kb to 1 block instead — an unmatched pre-loop DMA
+    # start (never waited) would leave a non-zero semaphore at kernel exit
     if k_cache.ndim == 4:               # [B, Smax, H, D] → flat lane view
         k_cache = k_cache.reshape(b, s_max, h * d)
         v_cache = v_cache.reshape(b, s_max, h * d)

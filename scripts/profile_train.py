@@ -1,0 +1,55 @@
+"""Profile the headline training step (GPT-2 124M, bench.py shapes) on the
+real chip and print the device-op time breakdown.
+
+Usage: python scripts/profile_train.py [steps]
+"""
+import glob
+import os
+import sys
+import tempfile
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import numpy as np
+
+    steps = int(sys.argv[1]) if len(sys.argv) > 1 else 3
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+
+    from paddle_tpu.models import gpt2_124m_config
+
+    cfg = gpt2_124m_config(stacked_blocks=True, max_position_embeddings=1024)
+    compiled, args, n_params = bench._gpt_step(cfg, 8, 1024)
+    out = compiled(*args)                     # compile + warm
+    jax.block_until_ready(getattr(out, "_data", out))
+
+    tmp = tempfile.mkdtemp(prefix="ptpu_prof_train_")
+    with jax.profiler.trace(tmp):
+        for _ in range(steps):
+            out = compiled(*args)
+        jax.block_until_ready(getattr(out, "_data", out))
+
+    paths = glob.glob(os.path.join(tmp, "**", "*.xplane.pb"), recursive=True)
+    pd = jax.profiler.ProfileData.from_file(paths[0])
+    for plane in pd.planes:
+        if "TPU" not in plane.name:
+            continue
+        print("== plane:", plane.name, f"({steps} steps)")
+        agg, cnt = defaultdict(float), defaultdict(int)
+        for line in plane.lines:
+            for ev in line.events:
+                agg[ev.name] += ev.duration_ns / 1e6
+                cnt[ev.name] += 1
+        for name, ms in sorted(agg.items(), key=lambda kv: -kv[1])[:35]:
+            print(f"{ms/steps:10.3f} ms/step  x{cnt[name]//steps:<5d} "
+                  f"{name[:105]}")
+
+
+if __name__ == "__main__":
+    main()
