@@ -292,12 +292,76 @@ def bench_decode():
                  batch * new / dt, "tokens/sec", baseline)
 
 
+def bench_6p7b_memfit():
+    """BASELINE.md config 5 capacity check (GPT-3 6.7B, dp2 x sharding2 x
+    pp2 x mp2 = 16 devices): compile the FULL-SHAPE hybrid 1F1B training
+    step on a 16-virtual-device CPU mesh and report XLA's per-device
+    memory analysis against the v5e's 16 GiB HBM. Chip-free (compile
+    only, never executed): vs_baseline >= 1.0 means the partitioned
+    program fits a v5e-16 slice with headroom. bf16 AdamW moments
+    (multi_precision=False) per the 1.3B single-chip recipe."""
+    if os.environ.get("PTPU_MEMFIT_CHILD") != "1":
+        # full-shape compile needs a 16-device CPU mesh pinned BEFORE any
+        # jax import — re-exec with the env forced
+        env = dict(os.environ)
+        env.update(PTPU_MEMFIT_CHILD="1", PTPU_FORCE_PLATFORM="cpu",
+                   PTPU_BENCH_PROBED="1")
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=16"
+                            ).strip()
+        env.pop("JAX_PLATFORMS", None)
+        proc = subprocess.run(
+            [sys.executable, __file__, "--config", "gpt3_6p7b_memfit"],
+            env=env, capture_output=True, text=True, timeout=2900)
+        sys.stdout.write(proc.stdout)
+        if proc.returncode != 0:
+            raise RuntimeError(proc.stderr[-1500:])
+        return
+    import paddle_tpu as paddle
+    from paddle_tpu import jit, optimizer, parallel
+    from paddle_tpu.models import (GPTForCausalLM, GPTPretrainingCriterion,
+                                   gpt3_6p7b_config)
+
+    cfg = gpt3_6p7b_config(stacked_blocks=True, pp_schedule="1f1b",
+                           pp_num_microbatches=4)
+    paddle.seed(0)
+    parallel.init_mesh(dp=2, sharding=2, pp=2, mp=2)
+    model = parallel.place_model(GPTForCausalLM(cfg))
+    model.bfloat16()
+    opt = optimizer.AdamW(learning_rate=1e-4,
+                          parameters=model.parameters(),
+                          multi_precision=False)
+
+    def step(x, y):
+        loss = model.pretrain_loss(x, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    compiled = jit.compile(step, models=[model], optimizers=[opt])
+    batch, seq = 8, 2048
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype("int32"))
+    lab = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype("int32"))
+    mem = compiled.lower(ids, lab).compile().memory_analysis()
+    per_dev_gb = (mem.argument_size_in_bytes + mem.temp_size_in_bytes
+                  - mem.alias_size_in_bytes) / 2**30
+    hbm_gb = 16.0
+    return _emit("gpt3_6p7b_hybrid16_hbm_headroom",
+                 round(hbm_gb / max(per_dev_gb, 1e-9), 4), "x (16GiB/use)",
+                 1.0)
+
+
 LADDER = {
     "gpt124m": bench_gpt124m,
     "resnet50": bench_resnet50,
     "bert_base": bench_bert_base,
     "gpt3_1p3b": bench_gpt3_1p3b,
     "gpt124m_decode": bench_decode,
+    "gpt3_6p7b_memfit": bench_6p7b_memfit,
 }
 
 
@@ -314,7 +378,8 @@ def main():
             try:
                 proc = subprocess.run(
                     [sys.executable, __file__, "--config", name],
-                    capture_output=True, text=True, timeout=1200)
+                    capture_output=True, text=True,
+                    timeout=3000 if name.endswith("memfit") else 1200)
                 for ln in proc.stdout.splitlines():
                     try:
                         entry = json.loads(ln)

@@ -21,6 +21,7 @@ TPU-native design:
 from __future__ import annotations
 
 import dataclasses
+import os
 from functools import partial as functools_partial
 from typing import Optional
 
@@ -733,7 +734,6 @@ class GPTForCausalLM(Layer):
         # buffer, so XLA keeps a row-contiguous cache layout (no relayout
         # copies around the decode kernel, contiguous one-row writes)
         shape = (batch_size, max_length, nh * hd)
-        import os
         unroll_env = os.environ.get("PTPU_DECODE_UNROLL")
         unroll = (cfg.num_hidden_layers <= 32 if unroll_env is None
                   else unroll_env != "0")
@@ -819,7 +819,7 @@ class GPTForCausalLM(Layer):
                     live = live & ~jnp.all(finished)
                 return live
 
-            def body_fn(st):
+            def one_step(st):
                 i, logits, caches, key, finished, toks = st
                 if do_sample:
                     key, sub = jax.random.split(key)
@@ -845,15 +845,35 @@ class GPTForCausalLM(Layer):
                     caches)
                 return (i + 1, logits, caches, key, finished, toks)
 
+            unroll = max(1, int(os.environ.get(
+                "PTPU_DECODE_STEP_UNROLL", "1")))
+
+            if unroll == 1:
+                body_fn = one_step
+            else:
+                # U token steps inside one while trip: trip boundaries are
+                # scheduling barriers, so unrolling lets XLA overlap step
+                # i+1's weight streams with step i's tail. Overshoot
+                # substeps (final trip, or after all rows hit EOS) are
+                # identity via the cond guard; every trip the outer cond
+                # admits advances i by >= 1, so termination is unchanged.
+                def body_fn(st):
+                    for _ in range(unroll):
+                        st = jax.lax.cond(cond_fn(st), one_step,
+                                          lambda s: s, st)
+                    return st
+
             i0 = jnp.asarray(0, jnp.int32)
             i, _, _, _, _, toks = jax.lax.while_loop(
                 cond_fn, body_fn,
                 (i0, logits, caches, key, finished0, toks0))
             return i, toks
 
-        # executable cache: sampling params are baked into the decode trace
+        # executable cache: sampling params AND the step-unroll factor are
+        # baked into the decode trace
         gen_key = (B, P, total, cfg.stacked_blocks, do_sample, temperature,
-                   top_k, top_p, eos_token_id)
+                   top_k, top_p, eos_token_id,
+                   os.environ.get("PTPU_DECODE_STEP_UNROLL", "1"))
         if self._gen_step is None or self._gen_step[0] != gen_key:
             self._gen_step = (gen_key,
                               jax.jit(generate_all, donate_argnums=(3,)))

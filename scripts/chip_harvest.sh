@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Serialized on-chip measurement harvest: poll for the tunnel, then run
+# every hardware job back-to-back (the chip is single-tenant — concurrent
+# users clobber each other). Logs land in /tmp/harvest/.
+#
+#   nohup scripts/chip_harvest.sh > /tmp/harvest/driver.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p /tmp/harvest
+
+probe() {
+  timeout 60 python -c "import jax; assert jax.devices()[0].platform in ('tpu','axon')" >/dev/null 2>&1
+}
+
+echo "$(date -u) waiting for chip..."
+until probe; do
+  sleep 240
+done
+echo "$(date -u) chip is up — harvesting"
+# single-core box: a concurrent CPU-heavy compile (6.7B memfit) would
+# distort timings (~20%); wait for it to clear first
+while pgrep -f "gpt3_6p7b_memfit" >/dev/null; do sleep 60; done
+
+run() {  # run <name> <timeout-seconds> <cmd...>
+  local name="$1" to="$2"; shift 2
+  echo "$(date -u) == $name"
+  timeout "$to" "$@" > "/tmp/harvest/$name.log" 2>&1
+  echo "$(date -u) == $name rc=$?"
+}
+
+run headline       600 python bench.py
+run onchip_checks  900 python scripts/onchip_checks.py
+run decode_bench   900 python bench.py --config gpt124m_decode
+run decode_bisect  3000 python scripts/decode_bisect.py
+run ladder         3600 python bench.py --ladder
+run profile_train  900 python scripts/profile_train.py
+echo "$(date -u) harvest complete"

@@ -45,8 +45,9 @@ def main():
         "ln2_w": jnp.ones((L, H), jnp.bfloat16),
         "ln2_b": jnp.zeros((L, H), jnp.bfloat16),
     }
-    kc = [jnp.zeros((B, S_MAX, NH, HD), jnp.bfloat16) for _ in range(L)]
-    vc = [jnp.zeros((B, S_MAX, NH, HD), jnp.bfloat16) for _ in range(L)]
+    # flat [B, Smax, H*D] rings — the production cache format
+    kc = [jnp.zeros((B, S_MAX, NH * HD), jnp.bfloat16) for _ in range(L)]
+    vc = [jnp.zeros((B, S_MAX, NH * HD), jnp.bfloat16) for _ in range(L)]
     tok0 = jnp.zeros((B,), jnp.int32)
 
     def ln(x, w, b):
@@ -99,10 +100,13 @@ def main():
             return i + 1, jnp.argmax(logits, -1).astype(jnp.int32)
         return jax.lax.while_loop(lambda st: st[0] < STEPS, body, (0, tok))
 
-    # 4. + cache DUS + XLA masked attention (full step, XLA attention)
+    # 4. + cache DUS + attention (full step; kernel vs XLA fallback chosen
+    #    by PTPU_FLASH_DECODE, exactly as production dispatches)
     def make_full(attn_kind):
-        from paddle_tpu.ops.pallas_ops import (cached_attention_arrays,
-                                               flash_decode_arrays)
+        # attn_kind only labels the run; the env var is the real switch —
+        # pin it here so label and path can never diverge
+        os.environ["PTPU_FLASH_DECODE"] = "1" if attn_kind == "pallas" else "0"
+        from paddle_tpu.ops.pallas_ops import cached_attention_arrays
 
         @jax.jit
         def loop_full(tok, kcs, vcs):
@@ -116,15 +120,8 @@ def main():
                     qkv = (hn @ qkv_w[l] + biases["qkv_b"][l]).reshape(
                         B, 1, 3, NH, HD)
                     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-                    if attn_kind == "pallas":
-                        kc2 = jax.lax.dynamic_update_slice(
-                            kcs[l], k, (0, t, 0, 0))
-                        vc2 = jax.lax.dynamic_update_slice(
-                            vcs[l], v, (0, t, 0, 0))
-                        o = flash_decode_arrays(q, kc2, vc2, t + 1)
-                    else:
-                        o, kc2, vc2 = cached_attention_arrays(
-                            q, k, v, kcs[l], vcs[l], t)
+                    o, kc2, vc2 = cached_attention_arrays(
+                        q, k, v, kcs[l], vcs[l], t)
                     nk.append(kc2)
                     nv.append(vc2)
                     x = x + o.reshape(B, 1, H) @ out_w[l] + biases["out_b"][l]

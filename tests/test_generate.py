@@ -168,3 +168,31 @@ def test_stacked_scan_decode_matches_unrolled(monkeypatch):
     out_unrolled = np.asarray(
         model.generate(Tensor(jnp.asarray(prompt)), max_new_tokens=NEW)._data)
     np.testing.assert_array_equal(out_scan, out_unrolled)
+
+
+def test_decode_step_unroll_parity(monkeypatch):
+    """PTPU_DECODE_STEP_UNROLL places U token steps per while trip (a
+    scheduling-overlap lever on hardware); outputs must be identical,
+    including EOS early-stop on a non-multiple boundary."""
+    cfg = gpt_test_config(stacked_blocks=True, sequence_parallel=False)
+    paddle.seed(5)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(5)
+    prompt = Tensor(jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 6)),
+                                jnp.int32))
+
+    monkeypatch.setenv("PTPU_DECODE_STEP_UNROLL", "1")
+    base = np.asarray(model.generate(prompt, max_new_tokens=7)._data)
+    eos = int(base[0, 7])
+    base_eos = np.asarray(model.generate(prompt, max_new_tokens=7,
+                                         eos_token_id=eos)._data)
+
+    monkeypatch.setenv("PTPU_DECODE_STEP_UNROLL", "4")
+    model._gen_step = None
+    got = np.asarray(model.generate(prompt, max_new_tokens=7)._data)
+    np.testing.assert_array_equal(base, got)
+    model._gen_step = None
+    got_eos = np.asarray(model.generate(prompt, max_new_tokens=7,
+                                        eos_token_id=eos)._data)
+    np.testing.assert_array_equal(base_eos, got_eos)
