@@ -47,6 +47,23 @@ def load_state_dict(path, shardings=None, process_group=None):
     return {k: Tensor(v) for k, v in restored.items()}
 
 
+def _opt_param_names(model, optimizer):
+    """id(param) → checkpoint key for optimizer slots.
+
+    STRUCTURAL model names (named_parameters paths), not Tensor autonames:
+    autonames come from global per-class counters, so any difference in
+    construction history between the saving and loading process shifts
+    them — and slots saved under shifted names would be silently skipped
+    on restore. Optimizer-only params (not in the model) fall back to
+    their autoname."""
+    names = {id(p): f"__extra__.{p.name or f'param_{i}'}"
+             for i, p in enumerate(optimizer._parameter_list)}
+    for name, p in model.named_parameters():
+        if id(p) in names:
+            names[id(p)] = name
+    return names
+
+
 def save_sharded(model, optimizer, path, extra=None):
     state = {}
     for name, p in model.named_parameters():
@@ -54,7 +71,7 @@ def save_sharded(model, optimizer, path, extra=None):
     for name, b in model.named_buffers():
         state[f"buffer.{name}"] = b._data
     if optimizer is not None:
-        names = optimizer._param_names()
+        names = _opt_param_names(model, optimizer)
         for key, slots in optimizer._states.items():
             for sname, arr in slots.items():
                 state[f"opt.{names[key]}.{sname}"] = arr
@@ -67,20 +84,53 @@ def load_sharded(model, optimizer, path):
     restored = load_state_dict(path)
     pmap = dict(model.named_parameters())
     bmap = dict(model.named_buffers())
-    opt_names = {} if optimizer is None else {v: k for k, v in optimizer._param_names().items()}
+    opt_names = ({} if optimizer is None
+                 else {v: k for k, v in
+                       _opt_param_names(model, optimizer).items()})
+    def _reshard(arr, like):
+        """Place a restored global array onto the DESTINATION's sharding
+        (the reference converter.py mesh-reshard: the checkpoint may have
+        been written from a different mesh, and the restored array carries
+        the saved placement)."""
+        if like is None:
+            return arr
+        try:
+            return jax.device_put(arr, like.sharding)
+        except (ValueError, TypeError):
+            return arr
+
+    skipped = []
     for k, v in restored.items():
         arr = v._data
         if k.startswith("model."):
-            pmap[k[len("model."):]]._data = arr
+            p = pmap.get(k[len("model."):])
+            if p is None:
+                skipped.append(k)
+                continue
+            p._data = _reshard(arr, p._data)
         elif k.startswith("buffer."):
-            bmap[k[len("buffer."):]]._data = arr
+            b = bmap.get(k[len("buffer."):])
+            if b is None:
+                skipped.append(k)
+                continue
+            b._data = _reshard(arr, b._data)
         elif k.startswith("opt.") and optimizer is not None:
             body = k[len("opt."):]
             pname, sname = body.rsplit(".", 1)
             key = opt_names.get(pname)
             if key is None:
+                skipped.append(k)
                 continue
             if sname == "master":
-                optimizer._master_weights[key] = arr
+                like = optimizer._master_weights.get(key)
+                optimizer._master_weights[key] = _reshard(arr, like)
             else:
-                optimizer._states.setdefault(key, {})[sname] = arr
+                slots = optimizer._states.setdefault(key, {})
+                slots[sname] = _reshard(arr, slots.get(sname))
+    if skipped:
+        import warnings
+
+        warnings.warn(
+            f"load_sharded: {len(skipped)} checkpoint entr(ies) had no "
+            f"matching destination and were skipped (first: {skipped[0]}) "
+            "— the checkpoint was written for a different parameter set")

@@ -86,6 +86,11 @@ class GPTConfig:
     # reference PipelineParallelWithInterleave :461; shrinks the bubble
     # v-fold). Applies to the gpipe forward path.
     pp_num_chunks: int = 1
+    # activation recompute per block (reference fleet/recompute; here
+    # jax.checkpoint around the stacked block body, so backward re-runs
+    # each block's forward instead of stashing its internals — the
+    # standard memory/FLOPs trade for pipeline/large configs)
+    recompute: bool = False
 
 
 def gpt_test_config(**kw):
@@ -418,6 +423,11 @@ class GPTStackedBlocks(Layer):
                 nh, hd, eps)
             return out
 
+        if cfg.recompute:
+            # reference fleet/recompute capability on the stacked path:
+            # backward re-runs each block instead of stashing internals,
+            # bounding activation memory at O(L x residual)
+            block = jax.checkpoint(block)
         return block
 
     def forward(self, x):

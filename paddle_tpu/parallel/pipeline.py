@@ -74,6 +74,51 @@ def scan_blocks(block_fn: Callable, stacked_params: Any, x, unroll: int | None =
     return out
 
 
+_LOW_FLOAT = ("bfloat16", "float16")
+
+
+def _cpu_lowp() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+def _widen_boundary(tree):
+    """CPU-only workaround for the partial-manual bf16 psum bug (see
+    _psum_safe): REPLICATED (P()) low-precision inputs to a partial-manual
+    shard_map get a JAX-inserted psum over the manual axis on their
+    cotangent in the backward pass — in the input dtype, which is the
+    crashing construct. Feed such inputs through the boundary as f32 and
+    narrow back to the original dtype inside the region (returned as the
+    second element, a dtype tree for _narrow_boundary). No-op off-CPU."""
+    dtypes = jax.tree_util.tree_map(lambda a: a.dtype, tree)
+    if not _cpu_lowp():
+        return tree, dtypes
+    widened = jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.float32) if str(a.dtype) in _LOW_FLOAT else a,
+        tree)
+    return widened, dtypes
+
+
+def _narrow_boundary(tree, dtypes):
+    return jax.tree_util.tree_map(
+        lambda a, dt: a.astype(dt) if a.dtype != dt else a, tree, dtypes)
+
+
+def _psum_safe(x, axis):
+    """psum that survives XLA-CPU's float-normalization bug: a bf16/f16
+    all-reduce inside a PARTIAL-manual shard_map region (axis_names a
+    strict subset of the mesh) hits `Invalid binary instruction opcode
+    copy` (fatal) on the CPU backend — minimal repro in
+    tests/test_pipeline.py::test_partial_manual_bf16_psum. On CPU the
+    reduce runs in f32 and casts back (also the numerically safer
+    reduction); TPU keeps the native dtype on the wire (half the ICI
+    bytes)."""
+    dt = getattr(x, "dtype", None)
+    if (jax.default_backend() == "cpu" and dt is not None
+            and dt in (jnp.bfloat16, jnp.float16)):
+        return jax.lax.psum(x.astype(jnp.float32), axis).astype(dt)
+    return jax.lax.psum(x, axis)
+
+
 def pipeline_apply(
     block_fn: Callable,
     stacked_params: Any,
@@ -132,6 +177,7 @@ def pipeline_apply(
     def run(params, xs):
         # each shard sees leaf [1, k, ...] — drop the stage dim
         params = jax.tree_util.tree_map(lambda a: a[0], params)
+        xs = _narrow_boundary(xs, xs_dtype)
         stage = jax.lax.axis_index(axis)
         mb = jnp.zeros_like(xs[0])
         outs = jnp.zeros_like(xs)
@@ -162,13 +208,14 @@ def pipeline_apply(
         # outs is populated only on the last stage; all-reduce over the pp
         # axis broadcasts it (zeros elsewhere).
         outs = jnp.where(stage == pp - 1, outs, jnp.zeros_like(outs))
-        return jax.lax.psum(outs, axis)
+        return _psum_safe(outs, axis)
 
     # params arrive stage-major: leaf [L, ...] -> [pp, k, ...] so the shard_map
     # slice along dim 0 hands each stage its k blocks.
     staged = jax.tree_util.tree_map(
         lambda a: a.reshape((pp, L // pp) + a.shape[1:]), stacked_params
     )
+    xs, xs_dtype = _widen_boundary(xs)
     # partial-manual shard_map validates specs only under jit; eager calls
     # (plain apply without jit.compile) need the wrapper — it inlines when
     # already inside a trace
@@ -235,6 +282,7 @@ def _pipeline_interleaved(block_fn, stacked_params, x, n_microbatches,
     def run(params, xs):
         # leaf [1, v, k, ...] -> [v, k, ...]: this device's v chunks
         params = jax.tree_util.tree_map(lambda a: a[0], params)
+        xs = _narrow_boundary(xs, xs_dtype)
         stage = jax.lax.axis_index(axis)
         wrap_perm = [(i, (i + 1) % pp) for i in range(pp)]
         mb_shape = xs.shape[1:]
@@ -261,7 +309,7 @@ def _pipeline_interleaved(block_fn, stacked_params, x, n_microbatches,
                   jnp.zeros((M,) + mb_shape, x.dtype))
         (h, outs), _ = jax.lax.scan(tick, carry0, jnp.arange(U))
         outs = jnp.where(stage == pp - 1, outs, jnp.zeros_like(outs))
-        return jax.lax.psum(outs, axis)
+        return _psum_safe(outs, axis)
 
     # layer l lives on virtual stage l // k_layers = c*pp + s: reshape
     # [L,...] -> [V, k, ...] -> [v, pp, k, ...] -> device-major
@@ -272,6 +320,7 @@ def _pipeline_interleaved(block_fn, stacked_params, x, n_microbatches,
             (1, 0, 2) + tuple(range(3, 3 + len(rest))))
 
     staged = jax.tree_util.tree_map(stage_major, stacked_params)
+    xs, xs_dtype = _widen_boundary(xs)
     out = jax.jit(run)(staged, xs)
     return out.reshape((B,) + x.shape[1:])
 
@@ -401,6 +450,8 @@ def _pipeline_1f1b_impl(block_fn, loss_fn, n_microbatches, axis,
     )
     def run(params, tail, xs, ys):
         params = jax.tree_util.tree_map(lambda a: a[0], params)
+        tail = _narrow_boundary(tail, tail_dtype)
+        xs = _narrow_boundary(xs, xs_dtype)
         stage = jax.lax.axis_index(axis)
         is_last = stage == pp - 1
         fwd_perm = [(i, i + 1) for i in range(pp - 1)]
@@ -492,23 +543,25 @@ def _pipeline_1f1b_impl(block_fn, loss_fn, n_microbatches, axis,
 
         carry, _ = jax.lax.scan(slot, carry0, jnp.arange(U))
 
-        loss = jax.lax.psum(carry["loss_sum"], axis) / M
+        loss = jax.lax.psum(carry["loss_sum"], axis) / M  # f32 scalar
         # tail/dx live on one stage (zeros elsewhere) — psum broadcasts.
         tacc = jax.tree_util.tree_map(
-            lambda a: jax.lax.psum(a, axis), carry["tacc"])
-        dxs = jax.lax.psum(carry["dxs"], axis)
+            lambda a: _psum_safe(a, axis), carry["tacc"])
+        dxs = _psum_safe(carry["dxs"], axis)
         gacc = jax.tree_util.tree_map(lambda a: a[None], carry["gacc"])
         return loss, (gacc, tacc, dxs)
 
     staged = jax.tree_util.tree_map(
         lambda a: a.reshape((pp, L // pp) + a.shape[1:]), stacked_params
     )
+    tail_params, tail_dtype = _widen_boundary(tail_params)
+    xs, xs_dtype = _widen_boundary(xs)
     # see pipeline_apply: jit makes eager invocation legal (inlines in-trace)
     loss, (gacc, tacc, dxs) = jax.jit(run)(staged, tail_params, xs, ys)
     dparams = jax.tree_util.tree_map(
         lambda g, p: g.reshape((L,) + g.shape[2:]).astype(p.dtype),
         gacc, stacked_params)
     dtail = jax.tree_util.tree_map(
-        lambda g, p: g.astype(p.dtype), tacc, tail_params)
+        lambda g, dt: g.astype(dt), tacc, tail_dtype)
     dx = dxs.reshape((B,) + x.shape[1:]).astype(x.dtype)
     return loss, (dparams, dtail, dx)

@@ -19,7 +19,7 @@ done
 echo "$(date -u) chip is up — harvesting"
 # single-core box: a concurrent CPU-heavy compile (6.7B memfit) would
 # distort timings (~20%); wait for it to clear first
-while pgrep -f "gpt3_6p7b_memfit" >/dev/null; do sleep 60; done
+while pgrep -f "memfit" >/dev/null; do sleep 60; done
 
 run() {  # run <name> <timeout-seconds> <cmd...>
   local name="$1" to="$2"; shift 2
@@ -32,6 +32,6 @@ run headline       600 python bench.py
 run onchip_checks  900 python scripts/onchip_checks.py
 run decode_bench   900 python bench.py --config gpt124m_decode
 run decode_bisect  3000 python scripts/decode_bisect.py
-run ladder         3600 python bench.py --ladder
+run ladder         7200 python bench.py --ladder
 run profile_train  900 python scripts/profile_train.py
 echo "$(date -u) harvest complete"

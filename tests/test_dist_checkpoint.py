@@ -1,0 +1,90 @@
+"""Distributed checkpoint: sharded save + any-mesh restore
+(reference: auto_parallel dist_saver.py + converter.py mesh-reshard;
+SURVEY §5.4). The claim under test: a checkpoint written from one mesh
+layout restores onto a DIFFERENT mesh with identical values, resharded
+from the on-disk global view."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+pytestmark = pytest.mark.slow
+
+import paddle_tpu as paddle
+from paddle_tpu import optimizer, parallel
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed import checkpoint as dckpt
+from paddle_tpu.models import GPTForCausalLM, gpt_test_config
+
+
+def _step_once(model, opt, seed=0):
+    rng = np.random.RandomState(seed)
+    cfg = model.cfg
+    ids = Tensor(jnp.asarray(rng.randint(0, cfg.vocab_size, (4, 8)), jnp.int32))
+    lab = Tensor(jnp.asarray(rng.randint(0, cfg.vocab_size, (4, 8)), jnp.int32))
+    logits = model(ids)
+    loss = paddle.nn.functional.cross_entropy(
+        logits.reshape([-1, cfg.vocab_size]), lab.reshape([-1]))
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    return float(loss)
+
+
+def _params_numpy(model):
+    return {n: np.asarray(p._data, np.float32)
+            for n, p in model.named_parameters()}
+
+
+def test_sharded_save_restore_across_meshes(tmp_path):
+    """Save on a dp4xmp2 placement, restore onto dp2xmp2 (different dp
+    extent => different array shardings): values must match exactly, and
+    optimizer slots must come back."""
+    cfg = gpt_test_config(sequence_parallel=False)
+
+    paddle.seed(7)
+    parallel.init_mesh(dp=4, mp=2)
+    model = parallel.place_model(GPTForCausalLM(cfg))
+    opt = optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    _step_once(model, opt)            # populate optimizer slots
+    want = _params_numpy(model)
+    names = dckpt._opt_param_names(model, opt)
+    want_m1 = {names[k]: np.asarray(v["moment1"], np.float32)
+               for k, v in opt._states.items() if "moment1" in v}
+    path = str(tmp_path / "ckpt_a")
+    dckpt.save_sharded(model, opt, path)
+
+    # fresh model on a DIFFERENT mesh, different init
+    paddle.seed(99)
+    parallel.init_mesh(dp=2, mp=2)
+    model2 = parallel.place_model(GPTForCausalLM(cfg))
+    opt2 = optimizer.AdamW(learning_rate=1e-3, parameters=model2.parameters())
+    _step_once(model2, opt2, seed=1)  # diverge slots too
+    before = _params_numpy(model2)
+    assert any(not np.allclose(before[k], want[k]) for k in want)
+
+    dckpt.load_sharded(model2, opt2, path)
+    got = _params_numpy(model2)
+    for k in want:
+        np.testing.assert_array_equal(got[k], want[k], err_msg=k)
+    names2 = dckpt._opt_param_names(model2, opt2)
+    got_m1 = {names2[k]: np.asarray(v["moment1"], np.float32)
+              for k, v in opt2._states.items() if "moment1" in v}
+    assert len(got_m1) == len(want_m1) and len(got_m1) > 0
+    for k in want_m1:
+        np.testing.assert_array_equal(got_m1[k], want_m1[k])
+
+    # restored state trains on the new mesh
+    loss = _step_once(model2, opt2, seed=2)
+    assert np.isfinite(loss)
+
+
+def test_state_dict_roundtrip_plain(tmp_path):
+    """save_state_dict/load_state_dict on unsharded tensors."""
+    path = str(tmp_path / "ckpt_plain")
+    state = {"w": Tensor(jnp.arange(12, dtype=jnp.float32).reshape(3, 4)),
+             "b": Tensor(jnp.ones((4,), jnp.bfloat16))}
+    dckpt.save_state_dict(state, path)
+    back = dckpt.load_state_dict(path)
+    np.testing.assert_array_equal(np.asarray(back["w"]._data),
+                                  np.asarray(state["w"]._data))
+    assert back["b"]._data.dtype == jnp.bfloat16

@@ -285,3 +285,71 @@ def test_gpt_1f1b_loss_mask_global_mean():
     crit = GPTPretrainingCriterion(cfg)
     ref = float(crit(model(ids), lab, mask))
     np.testing.assert_allclose(f1b, ref, rtol=1e-4)
+
+
+def test_partial_manual_bf16_psum():
+    """Tracking test for an XLA-CPU bug: psum of bf16 inside a
+    PARTIAL-manual shard_map region (axis_names a strict subset of the
+    mesh axes) used to die fatally with `Invalid binary instruction
+    opcode copy` in the CPU float-normalization pass. The pipeline
+    broadcasts its outputs with exactly that construct, so bf16 pipeline
+    models crashed on the CPU test mesh; _psum_safe upcasts the reduce to
+    f32 on CPU. This exercises the bf16 pipeline end to end."""
+    import jax.numpy as jnp
+    from paddle_tpu import parallel
+    from paddle_tpu.parallel.pipeline import pipeline_apply
+
+    parallel.init_mesh(pp=2)
+    L, H = 4, 32
+    rng = np.random.RandomState(0)
+    params = {"w": jnp.asarray(rng.randn(L, H, H) * 0.1, jnp.bfloat16)}
+
+    def block(p, h):
+        return jnp.tanh(h @ p["w"])
+
+    x = jnp.asarray(rng.randn(4, 8, H), jnp.bfloat16)
+    out = jax.jit(lambda a, p: pipeline_apply(block, p, a,
+                                              n_microbatches=2))(x, params)
+
+    # oracle: plain sequential blocks, no pipeline
+    ref = x
+    for l in range(L):
+        ref = jnp.tanh(ref @ params["w"][l])
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=5e-2, atol=5e-2)
+
+
+def test_stacked_recompute_parity():
+    """cfg.recompute (jax.checkpoint around each stacked block) must not
+    change the training step's loss or gradients."""
+    import paddle_tpu as paddle
+    from paddle_tpu import jit, optimizer
+
+    losses = {}
+    for rc in (False, True):
+        parallel.init_mesh(pp=2)
+        cfg = gpt_test_config(num_hidden_layers=4, stacked_blocks=True,
+                              recompute=rc)
+        paddle.seed(11)
+        model = parallel.place_model(GPTForCausalLM(cfg))
+        crit = GPTPretrainingCriterion(cfg)
+        opt = optimizer.AdamW(learning_rate=1e-3,
+                              parameters=model.parameters())
+
+        def step(x, y):
+            loss = crit(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        compiled = jit.compile(step, models=[model], optimizers=[opt])
+        rng = np.random.RandomState(4)
+        ids = paddle.to_tensor(
+            rng.randint(0, cfg.vocab_size, (4, 16)).astype("int32"))
+        lab = paddle.to_tensor(
+            rng.randint(0, cfg.vocab_size, (4, 16)).astype("int32"))
+        losses[rc] = [float(compiled(ids, lab)) for _ in range(2)]
+    np.testing.assert_allclose(losses[False], losses[True],
+                               rtol=1e-5, atol=1e-6)
