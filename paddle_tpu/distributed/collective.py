@@ -133,16 +133,40 @@ def _world(group):
     return group.nranks if group is not None else get_world_size()
 
 
+def _reduce_safe(fn, a, axis):
+    """Run an all-reduce in f32 for low-precision floats on the CPU
+    backend: bf16/f16 all-reduce inside a partial-manual shard_map region
+    fatally crashes XLA-CPU's float-normalization pass ('Invalid binary
+    instruction opcode copy') — minimal repro in
+    tests/test_pipeline.py::test_partial_manual_bf16_psum;
+    parallel/pipeline.py:_psum_safe delegates here. TPU keeps the native
+    dtype on the wire."""
+    dt = getattr(a, "dtype", None)
+    if (jax.default_backend() == "cpu"
+            and str(dt) in ("bfloat16", "float16")):
+        return fn(a.astype(jnp.float32), axis).astype(dt)
+    return fn(a, axis)
+
+
+def _prod_reduce(a, axis):
+    # no lax.pprod: gather then product over the gathered dim
+    return jnp.prod(jax.lax.all_gather(a, axis, tiled=False), axis=0)
+
+
+_REDUCE_FNS = {
+    ReduceOp.SUM: jax.lax.psum,
+    ReduceOp.MAX: jax.lax.pmax,
+    ReduceOp.MIN: jax.lax.pmin,
+    ReduceOp.AVG: jax.lax.pmean,
+    ReduceOp.PROD: _prod_reduce,
+}
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     axis = _axis_for(group)
     if axis is not None:
-        fns = {
-            ReduceOp.SUM: jax.lax.psum,
-            ReduceOp.MAX: jax.lax.pmax,
-            ReduceOp.MIN: jax.lax.pmin,
-            ReduceOp.AVG: jax.lax.pmean,
-        }
-        out = apply(lambda a: fns[op](a, axis), tensor, name="all_reduce")
+        out = apply(lambda a: _reduce_safe(_REDUCE_FNS[op], a, axis), tensor,
+                    name="all_reduce")
         tensor._data = out._data
         tensor._grad_node = out._grad_node
         tensor._out_index = out._out_index
@@ -237,8 +261,21 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None, sync_o
 
         inp = concat(tensor_list, 0) if tensor_list else tensor
 
-        def fn(a):
-            return jax.lax.psum_scatter(a, ax, scatter_dimension=0, tiled=True)
+        if op == ReduceOp.SUM:
+            def fn(a):
+                return _reduce_safe(
+                    lambda b, x: jax.lax.psum_scatter(
+                        b, x, scatter_dimension=0, tiled=True), a, ax)
+        else:
+            # non-SUM: reduce fully, then keep this member's chunk
+            # (reduce-then-scatter semantics; SUM keeps the fused
+            # psum_scatter fast path above)
+            def fn(a):
+                full = _reduce_safe(_REDUCE_FNS[op], a, ax)
+                members = jax.lax.psum(1, ax)   # static axis size in-region
+                n = full.shape[0] // members
+                idx = jax.lax.axis_index(ax)
+                return jax.lax.dynamic_slice_in_dim(full, idx * n, n, 0)
 
         out = apply(fn, inp, name="reduce_scatter")
         tensor._data = out._data

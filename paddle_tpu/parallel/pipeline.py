@@ -108,15 +108,12 @@ def _psum_safe(x, axis):
     all-reduce inside a PARTIAL-manual shard_map region (axis_names a
     strict subset of the mesh) hits `Invalid binary instruction opcode
     copy` (fatal) on the CPU backend — minimal repro in
-    tests/test_pipeline.py::test_partial_manual_bf16_psum. On CPU the
-    reduce runs in f32 and casts back (also the numerically safer
-    reduction); TPU keeps the native dtype on the wire (half the ICI
-    bytes)."""
-    dt = getattr(x, "dtype", None)
-    if (jax.default_backend() == "cpu" and dt is not None
-            and dt in (jnp.bfloat16, jnp.float16)):
-        return jax.lax.psum(x.astype(jnp.float32), axis).astype(dt)
-    return jax.lax.psum(x, axis)
+    tests/test_pipeline.py::test_partial_manual_bf16_psum. Shared
+    implementation: distributed.collective._reduce_safe (f32 reduce on
+    CPU; TPU keeps the native dtype on the wire, half the ICI bytes)."""
+    from ..distributed.collective import _reduce_safe
+
+    return _reduce_safe(jax.lax.psum, x, axis)
 
 
 def pipeline_apply(
