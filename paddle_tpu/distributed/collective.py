@@ -273,6 +273,11 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None, sync_o
             def fn(a):
                 full = _reduce_safe(_REDUCE_FNS[op], a, ax)
                 members = jax.lax.psum(1, ax)   # static axis size in-region
+                if full.shape[0] % members:
+                    # match the SUM path (psum_scatter tiled=True errors)
+                    raise ValueError(
+                        f"reduce_scatter: first dim {full.shape[0]} not "
+                        f"divisible by group size {members}")
                 n = full.shape[0] // members
                 idx = jax.lax.axis_index(ax)
                 return jax.lax.dynamic_slice_in_dim(full, idx * n, n, 0)

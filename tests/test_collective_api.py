@@ -56,7 +56,7 @@ def test_all_reduce_sum_parity(dtype):
                                rtol=2e-2, atol=2e-2)
 
 
-def test_all_reduce_max_min(monkeypatch):
+def test_all_reduce_max_min():
     parallel.init_mesh(dp=4)
     rng = np.random.RandomState(1)
     x = rng.randn(4, 2, 8).astype(np.float32)
@@ -104,3 +104,52 @@ def test_all_reduce_prod_and_reduce_scatter_max():
         jnp.asarray(y))
     full = np.maximum(y[:4], y[4:])                # [4, 8] reduced
     np.testing.assert_allclose(out, full, rtol=1e-6)
+
+
+def test_broadcast_allgather_alltoall():
+    import functools
+    from paddle_tpu.parallel.mesh import get_mesh
+
+    parallel.init_mesh(dp=4)
+    mesh = get_mesh()
+    group = dist.new_group(axis_name="dp")
+    rng = np.random.RandomState(3)
+    x = rng.randn(4, 2, 8).astype(np.float32)
+
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+                       out_specs=P("dp"), axis_names=frozenset({"dp"}),
+                       check_vma=False)
+    def bcast(a):
+        return dist.broadcast(Tensor(a), src=2, group=group)._data
+
+    out = np.asarray(jax.jit(bcast)(jnp.asarray(x)), np.float32)
+    np.testing.assert_allclose(out, np.repeat(x[2:3], 4, 0), rtol=1e-6)
+
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+                       out_specs=P("dp"), axis_names=frozenset({"dp"}),
+                       check_vma=False)
+    def gathered_sum(a):
+        parts = dist.all_gather([], Tensor(a), group=group)
+        acc = parts[0]
+        for p in parts[1:]:
+            acc = acc + p
+        return acc._data
+
+    out = np.asarray(jax.jit(gathered_sum)(jnp.asarray(x)), np.float32)
+    np.testing.assert_allclose(out, np.repeat(x.sum(0, keepdims=True), 4, 0),
+                               rtol=1e-5)
+
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+                       out_specs=P("dp"), axis_names=frozenset({"dp"}),
+                       check_vma=False)
+    def a2a(a):
+        # member i sends chunk j to member j: with every member holding
+        # [4, 8] (4 chunks of [1, 8]), alltoall transposes chunk ownership
+        ins = [Tensor(a[0, j:j + 1]) for j in range(4)]
+        outs = dist.alltoall(ins, group=group)
+        return jnp.stack([o._data for o in outs])[None]
+
+    y = rng.randn(4, 4, 1, 8).astype(np.float32)
+    out = np.asarray(jax.jit(a2a)(jnp.asarray(y)), np.float32)
+    want = y.transpose(1, 0, 2, 3)       # chunk ownership transposed
+    np.testing.assert_allclose(out.reshape(want.shape), want, rtol=1e-6)
