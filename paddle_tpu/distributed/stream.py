@@ -1,0 +1,127 @@
+"""paddle.distributed.stream — stream-variant collective API.
+
+Reference analog: python/paddle/distributed/communication/stream/
+(all_reduce.py:73 etc.) — the same collectives with `sync_op` /
+`use_calc_stream` controlling which CUDA stream carries the
+communication and whether the caller must wait on the returned task.
+
+TPU-native stance: there are no user-visible streams — every collective
+is an HLO op inside a compiled program, and XLA's latency-hiding
+scheduler decides the overlap the reference manages by hand with
+comm/calc streams. The API shape is preserved (fleet code ports
+unchanged): results land in the in-place/out arguments exactly like the
+reference, and every call returns a Task whose wait()/is_completed()
+succeed immediately — under XLA the communication is part of the
+program, so the task is born done.
+"""
+from __future__ import annotations
+
+from . import collective as _c
+from .collective import ReduceOp
+
+__all__ = [
+    "all_gather", "all_reduce", "alltoall", "alltoall_single", "broadcast",
+    "reduce", "reduce_scatter", "recv", "scatter", "send",
+]
+
+
+class _DoneTask:
+    """Completed-communication handle (reference: ProcessGroup task)."""
+
+    def is_completed(self):
+        return True
+
+    def wait(self):
+        return True
+
+    def synchronize(self):
+        return True
+
+
+def _write_out(out, tensors):
+    """Reference stream calls accept a pre-allocated out tensor OR a
+    tensor list; fill whichever was given so the result stays reachable
+    behind the task-only return."""
+    if out is None:
+        return
+    if isinstance(out, list):
+        out.clear()
+        out.extend(tensors)
+        return
+    from ..ops.manipulation import concat
+
+    out._data = concat(list(tensors), 0)._data
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
+               use_calc_stream=False):
+    _c.all_reduce(tensor, op=op, group=group)
+    return _DoneTask()
+
+
+def all_gather(tensor_or_tensor_list, tensor, group=None, sync_op=True,
+               use_calc_stream=False):
+    parts = _c.all_gather(
+        tensor_or_tensor_list if isinstance(tensor_or_tensor_list, list)
+        else [], tensor, group=group)
+    if not isinstance(tensor_or_tensor_list, list):
+        _write_out(tensor_or_tensor_list, parts)
+    return _DoneTask()
+
+
+def alltoall(out_tensor_or_tensor_list, in_tensor_or_tensor_list,
+             group=None, sync_op=True, use_calc_stream=False):
+    outs = _c.alltoall(in_tensor_or_tensor_list, group=group)
+    _write_out(out_tensor_or_tensor_list, outs)
+    return _DoneTask()
+
+
+def alltoall_single(out_tensor, in_tensor, out_split_sizes=None,
+                    in_split_sizes=None, group=None, sync_op=True,
+                    use_calc_stream=False):
+    if out_tensor is None:
+        raise ValueError(
+            "stream.alltoall_single requires a pre-allocated out_tensor "
+            "(the task-only return leaves no other way to the result)")
+    _c.alltoall_single(in_tensor, out_tensor=out_tensor,
+                       in_split_sizes=in_split_sizes,
+                       out_split_sizes=out_split_sizes, group=group)
+    return _DoneTask()
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True,
+              use_calc_stream=False):
+    _c.broadcast(tensor, src=src, group=group)
+    return _DoneTask()
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True,
+           use_calc_stream=False):
+    _c.reduce(tensor, dst=dst, op=op, group=group)
+    return _DoneTask()
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list=None, op=ReduceOp.SUM,
+                   group=None, sync_op=True, use_calc_stream=False):
+    _c.reduce_scatter(tensor, tensor_list=(
+        tensor_or_tensor_list if isinstance(tensor_or_tensor_list, list)
+        else None), op=op, group=group)
+    return _DoneTask()
+
+
+def scatter(tensor, tensor_or_tensor_list=None, src=0, group=None,
+            sync_op=True, use_calc_stream=False):
+    _c.scatter(tensor, tensor_list=(
+        tensor_or_tensor_list if isinstance(tensor_or_tensor_list, list)
+        else None), src=src, group=group)
+    return _DoneTask()
+
+
+def send(tensor, dst=0, group=None, sync_op=True, use_calc_stream=False):
+    _c.send(tensor, dst=dst, group=group)
+    return _DoneTask()
+
+
+def recv(tensor, src=0, group=None, sync_op=True, use_calc_stream=False):
+    _c.recv(tensor, src=src, group=group)
+    return _DoneTask()

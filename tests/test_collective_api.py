@@ -153,3 +153,41 @@ def test_broadcast_allgather_alltoall():
     out = np.asarray(jax.jit(a2a)(jnp.asarray(y)), np.float32)
     want = y.transpose(1, 0, 2, 3)       # chunk ownership transposed
     np.testing.assert_allclose(out.reshape(want.shape), want, rtol=1e-6)
+
+
+def test_stream_variants():
+    """paddle.distributed.stream.* (reference communication/stream/):
+    same collectives; sync_op=False returns a born-done task handle (XLA
+    owns the overlap the reference managed with comm/calc streams)."""
+    import functools
+    from paddle_tpu.parallel.mesh import get_mesh
+    from paddle_tpu.distributed import stream as dstream
+
+    parallel.init_mesh(dp=4)
+    mesh = get_mesh()
+    group = dist.new_group(axis_name="dp")
+    rng = np.random.RandomState(5)
+    x = rng.randn(4, 2, 8).astype(np.float32)
+
+    captured = {}
+
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=P("dp"),
+                       out_specs=P("dp"), axis_names=frozenset({"dp"}),
+                       check_vma=False)
+    def body(a):
+        t = Tensor(a)
+        # reference idiom: task returned for BOTH sync modes; wait() is
+        # immediate under XLA
+        task = dstream.all_reduce(t, group=group)
+        captured["task"] = task
+        task2 = dstream.all_reduce(t, sync_op=False, group=group,
+                                   use_calc_stream=True)
+        captured["task2"] = task2
+        return t._data
+
+    out = np.asarray(jax.jit(body)(jnp.asarray(x)), np.float32)
+    # two all-reduces: sum over axis, then sum of the (replicated) sums x4
+    want = np.repeat(x.sum(0, keepdims=True), 4, 0) * 4
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+    assert captured["task"].is_completed() and captured["task"].wait()
+    assert captured["task2"].is_completed() and captured["task2"].wait()
