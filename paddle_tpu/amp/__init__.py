@@ -46,21 +46,33 @@ def get_amp_dtype():
     return _state.dtype
 
 
-def maybe_cast_in(name, arrays):
-    """Called by the dispatch layer for white-listed ops under O1."""
+def cast_plan(name, arrays):
+    """Resolve the autocast decision for one op NOW: per-input target dtype
+    (or None). The dispatch layer bakes this frozen plan into the op
+    closure — the tape's lazy vjp re-runs forwards at backward time, when
+    the auto_cast context may have exited, so reading thread-local state
+    from inside the op function would silently change the op's dtypes
+    between record and replay (observed: fp32 re-trace of a bf16-recorded
+    matmul → cotangent dtype mismatch)."""
     if not _state.enabled:
-        return arrays
-    if _state.level == "O2" or name in WHITE_LIST:
-        return [
-            a.astype(_state.dtype) if hasattr(a, "dtype") and a.dtype == jnp.float32 else a
-            for a in arrays
-        ]
+        return None
+    # black list wins over O2: the reference's pure-fp16/bf16 mode still
+    # keeps numerically-sensitive ops (softmax, norms, cross entropy) in
+    # fp32 — checking O2 first would make the black list unreachable
     if name in BLACK_LIST:
-        return [
-            a.astype(jnp.float32) if hasattr(a, "dtype") and a.dtype in (jnp.bfloat16, jnp.float16) else a
-            for a in arrays
-        ]
-    return arrays
+        plan = tuple(
+            jnp.float32
+            if hasattr(a, "dtype") and a.dtype in (jnp.bfloat16, jnp.float16)
+            else None
+            for a in arrays)
+    elif _state.level == "O2" or name in WHITE_LIST:
+        plan = tuple(
+            _state.dtype if hasattr(a, "dtype") and a.dtype == jnp.float32
+            else None
+            for a in arrays)
+    else:
+        return None
+    return plan if any(p is not None for p in plan) else None
 
 
 @contextmanager

@@ -45,6 +45,22 @@ def apply(fn: Callable, *tensor_args, n_outs=None, name=None, **static_kwargs):
     else:
         fn_c = fn
 
+    # AMP O1/O2 autocast (reference: eager_amp_auto_cast.h applied inside
+    # every generated *_ad_func). The cast lives INSIDE the op function so
+    # (a) jax.vjp differentiates through it — cotangents arrive back in the
+    # params' own dtype, exactly like the reference's recorded cast op —
+    # and (b) under a jit trace the autocast state is captured at trace
+    # time, the analog of amp attrs baked into a static program.
+    amp_state = _amp_state if _amp_state is not None else _bind_amp()
+    if amp_state.enabled:
+        plan = _amp_plan(name or getattr(fn, "__name__", "op"), arrays)
+        if plan is not None:
+            inner_fn = fn_c
+
+            def fn_c(*arrs, __inner=inner_fn, __plan=plan):
+                return __inner(*[a.astype(d) if d is not None else a
+                                 for a, d in zip(arrs, __plan)])
+
     needs = [
         (not t.stop_gradient) and jnp.issubdtype(t._data.dtype, jnp.inexact)
         for t in ts
@@ -85,6 +101,21 @@ def apply(fn: Callable, *tensor_args, n_outs=None, name=None, **static_kwargs):
         _check_nan_inf(outs, name or getattr(fn, "__name__", "op"))
 
     return tuple(out_ts) if multi else out_ts[0]
+
+
+_amp_state = None
+_amp_plan = None
+
+
+def _bind_amp():
+    """Lazy one-time bind of the amp thread-local (amp imports after core
+    during package init; a module-top import would cycle)."""
+    global _amp_state, _amp_plan
+    from .. import amp as _amp_mod
+
+    _amp_state = _amp_mod._state
+    _amp_plan = _amp_mod.cast_plan
+    return _amp_state
 
 
 def _static_recording():

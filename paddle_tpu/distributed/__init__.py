@@ -31,6 +31,7 @@ from .parallel_env import (
     destroy_process_group, parallel_mode,
 )
 from . import fleet
+from . import metric
 from . import stream
 from . import checkpoint
 from . import sharding

@@ -1,0 +1,3 @@
+from .metrics import init_metric, print_metric, print_auc, DistributedAuc
+
+__all__ = ["init_metric", "print_metric", "print_auc", "DistributedAuc"]

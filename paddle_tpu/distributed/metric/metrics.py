@@ -1,0 +1,131 @@
+"""Distributed metric aggregation (reference:
+python/paddle/distributed/metric/metrics.py — yaml-configured MetricMsg
+calculators living inside the parameter-server fleet_wrapper, with
+`init_metric` / `print_metric` / `print_auc`).
+
+TPU-native re-design: the PS runtime is out of scope (SURVEY §2.5.14), so
+the capability — a metric whose state is accumulated per worker and merged
+across the job before reporting — is provided directly over the collective
+API: each metric holds numpy state, `_merge()` all-reduces it over the
+'dp' world, and the reference entry points drive a registry of named
+metrics instead of a fleet_wrapper pointer.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["init_metric", "print_metric", "print_auc", "DistributedAuc"]
+
+
+class DistributedAuc:
+    """Streaming AUC over prediction/label pairs whose histogram state
+    merges across workers (the reference's AucCalculator / BucketError
+    family, paddle/fluid/framework/fleet/metrics.py style).
+    """
+
+    def __init__(self, name="auc", label="label", target="prob",
+                 bucket_size=1_000_000, input_type="auto"):
+        if input_type not in ("auto", "prob", "logits"):
+            raise ValueError("input_type must be auto/prob/logits")
+        self.name = name
+        self.label_var = label
+        self.target_var = target
+        self.bucket_size = int(bucket_size)
+        self.input_type = input_type
+        self._pos = np.zeros(self.bucket_size, np.int64)
+        self._neg = np.zeros(self.bucket_size, np.int64)
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds, np.float64).reshape(-1)
+        if self.input_type == "auto" and preds.size:
+            # latch the scale ONCE from the first batch: any value outside
+            # [0, 1] means logits. A per-batch guess would merge sigmoid-
+            # squashed and raw batches into one histogram (and all-negative
+            # logit batches would clip into bucket 0).
+            self.input_type = ("logits" if preds.min() < 0.0
+                               or preds.max() > 1.0 else "prob")
+        if self.input_type == "logits":
+            preds = 1.0 / (1.0 + np.exp(-preds))
+        labels = np.asarray(labels).reshape(-1)
+        idx = np.clip((preds * self.bucket_size).astype(np.int64), 0,
+                      self.bucket_size - 1)
+        np.add.at(self._pos, idx[labels > 0], 1)
+        np.add.at(self._neg, idx[labels <= 0], 1)
+
+    def _merged_state(self):
+        """All-reduce histograms across the default group. Single-process /
+        no-mesh is decided UP FRONT (world_size check); a failing collective
+        in a real multi-worker job propagates — silently falling back to
+        the local histogram would report a plausible but wrong job-wide
+        AUC on every rank."""
+        from .. import get_world_size, all_reduce
+
+        if get_world_size() <= 1:
+            return self._pos, self._neg
+        import paddle_tpu as paddle
+
+        pos = paddle.to_tensor(self._pos)
+        neg = paddle.to_tensor(self._neg)
+        all_reduce(pos)
+        all_reduce(neg)
+        return np.asarray(pos.numpy()), np.asarray(neg.numpy())
+
+    def eval(self):
+        pos, neg = self._merged_state()
+        # walk buckets from high score to low: AUC via trapezoids
+        tp = np.cumsum(pos[::-1]).astype(np.float64)
+        fp = np.cumsum(neg[::-1]).astype(np.float64)
+        total_pos, total_neg = tp[-1], fp[-1]
+        if total_pos == 0 or total_neg == 0:
+            return 0.5
+        area = np.trapezoid(tp, fp) if hasattr(np, "trapezoid") else np.trapz(tp, fp)
+        return float(area / (total_pos * total_neg))
+
+    def clear(self):
+        self._pos[:] = 0
+        self._neg[:] = 0
+
+
+_REGISTRY: dict[str, DistributedAuc] = {}
+
+
+def init_metric(metric_ptr=None, metric_yaml_path=None, cmatch_rank_var="",
+                mask_var="", uid_var="", phase=-1, cmatch_rank_group="",
+                ignore_rank=False, bucket_size=1_000_000):
+    """Reference signature kept. `metric_yaml_path` lists monitors:
+      monitors: [{name, method: AucCalculator, label, target, phase}].
+    Returns the registry of created metrics (instead of mutating a
+    fleet_wrapper pointer)."""
+    monitors = []
+    if metric_yaml_path is not None:
+        import yaml
+        with open(metric_yaml_path) as f:
+            content = yaml.safe_load(f)
+        monitors = content.get("monitors") or []
+    for m in monitors:
+        if m.get("method") in ("AucCalculator", "WuAucCalculator", None):
+            _REGISTRY[m["name"]] = DistributedAuc(
+                name=m["name"], label=m.get("label", "label"),
+                target=m.get("target", "prob"), bucket_size=bucket_size)
+    return _REGISTRY
+
+
+def get_metric(name):
+    return _REGISTRY[name]
+
+
+def print_metric(metric_ptr=None, name=None):
+    """Reference: prints the named metric's current (job-wide) value."""
+    m = _REGISTRY[name]
+    val = m.eval()
+    msg = f"{name}: AUC={val:.6f}"
+    print(msg)
+    return msg
+
+
+def print_auc(metric_ptr=None, is_day=False, phase="all", name=None):
+    """Reference print_auc. Without PS phases, reports every registered
+    AUC metric (or just `name`)."""
+    names = [name] if name else list(_REGISTRY)
+    out = [print_metric(metric_ptr, n) for n in names]
+    return "\n".join(out)

@@ -4,9 +4,11 @@ from . import nn
 from . import distributed
 from . import autograd
 from . import asp
+from . import autotune
+from . import multiprocessing
 from . import optimizer
 
-__all__ = ["nn", "autograd", "asp", "optimizer", "distributed"]
+__all__ = ["nn", "autograd", "asp", "autotune", "multiprocessing", "optimizer", "distributed"]
 
 # graph ops (reference incubate.graph_* — earlier homes of what became
 # paddle.geometric; SURVEY §8.11) re-exported over the geometric kernels

@@ -1,0 +1,11 @@
+"""paddle.incubate.autotune parity (reference:
+python/paddle/incubate/autotune.py — `set_config` switching kernel /
+layout / dataloader tuning).
+
+The real machinery lives in paddle_tpu.ops.autotune (Pallas block-geometry
+sweeps, the TPU analog of the reference's cuDNN-algo search); this module
+is the user-facing configuration surface at the reference's import path.
+"""
+from ..ops.autotune import AutoTuneCache, autotune, cache, set_config
+
+__all__ = ["set_config"]

@@ -1,0 +1,13 @@
+"""paddle.incubate.multiprocessing parity (reference:
+python/paddle/incubate/multiprocessing/__init__.py — the stdlib
+multiprocessing namespace with paddle-Tensor-aware ForkingPickler
+reductions installed).
+"""
+from multiprocessing import *  # noqa: F401,F403
+import multiprocessing
+
+from .reductions import init_reductions
+
+__all__ = list(multiprocessing.__all__)  # type: ignore[attr-defined]
+
+init_reductions()

@@ -1,0 +1,90 @@
+"""Tensor reductions for multiprocessing (reference:
+python/paddle/incubate/multiprocessing/reductions.py — ForkingPickler
+reductions that move LoDTensor payloads through shared memory / CUDA IPC
+instead of pickling bytes).
+
+TPU-native re-design: device buffers are not IPC-shareable across host
+processes (single controller owns the chip), so the zero-copy path is
+host-side: tensors above a small threshold are staged into POSIX shared
+memory (`multiprocessing.shared_memory`) and rebuilt as host tensors in
+the consumer; small tensors pickle by value.
+
+Lifetime: the PRODUCER owns every segment it created and unlinks them all
+at interpreter exit (the reference's file_system-strategy shape).
+Consumers only close their mapping — a payload can therefore be
+deserialized any number of times (fan-out to N workers, redelivery after
+a crash); the cost is that segments live until the producer exits.
+"""
+from __future__ import annotations
+
+import atexit
+from multiprocessing.reduction import ForkingPickler
+
+import numpy as np
+
+_SHM_MIN_BYTES = 1 << 16  # below this, copying beats shm setup
+
+# segments this process created, unlinked at exit (producer-owned cleanup)
+_PRODUCED: dict[str, object] = {}
+
+
+def _cleanup_produced():
+    for shm in _PRODUCED.values():
+        try:
+            shm.close()
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+        except OSError:
+            pass
+    _PRODUCED.clear()
+
+
+atexit.register(_cleanup_produced)
+
+
+def _rebuild_from_shm(shm_name, shape, dtype_name):
+    from multiprocessing import shared_memory
+
+    from ...core.tensor import Tensor
+
+    shm = shared_memory.SharedMemory(name=shm_name)
+    try:
+        arr = np.ndarray(shape, dtype=np.dtype(dtype_name), buffer=shm.buf)
+        out = Tensor(np.array(arr))  # own the data before the shm closes
+    finally:
+        shm.close()  # close only: the producer unlinks at its exit
+    return out
+
+
+def _rebuild_small(payload, shape, dtype_name):
+    from ...core.tensor import Tensor
+
+    return Tensor(np.frombuffer(payload, dtype=np.dtype(dtype_name)
+                                ).reshape(shape).copy())
+
+
+def _reduce_tensor(tensor):
+    """Stage the host view in shm (large) or by value (small). Dtypes
+    travel by NAME (ml_dtypes registers bfloat16 with numpy, so
+    np.dtype("bfloat16") round-trips; the .str code would rebuild as
+    void)."""
+    arr = np.asarray(tensor.numpy())
+    if arr.nbytes >= _SHM_MIN_BYTES:
+        from multiprocessing import shared_memory
+
+        shm = shared_memory.SharedMemory(create=True, size=arr.nbytes)
+        dst = np.ndarray(arr.shape, dtype=arr.dtype, buffer=shm.buf)
+        dst[...] = arr
+        _PRODUCED[shm.name] = shm  # keep mapping alive until atexit unlink
+        return _rebuild_from_shm, (shm.name, arr.shape, arr.dtype.name)
+    return _rebuild_small, (arr.tobytes(), arr.shape, arr.dtype.name)
+
+
+def init_reductions():
+    from ...core.tensor import Tensor
+
+    ForkingPickler.register(Tensor, _reduce_tensor)
+    from ...nn.layer import Parameter
+
+    ForkingPickler.register(Parameter, _reduce_tensor)

@@ -21,7 +21,8 @@ from ...core import random as _rng
 
 __all__ = [
     "adaptive_max_pool1d", "adaptive_max_pool3d", "bilinear",
-    "class_center_sample", "diag_embed", "dice_loss", "elu_", "gather_tree",
+    "class_center_sample", "diag_embed", "dice_loss", "edit_distance",
+    "elu_", "gather_tree",
     "hsigmoid_loss", "margin_cross_entropy", "max_unpool1d", "max_unpool2d",
     "max_unpool3d", "multi_label_soft_margin_loss", "multi_margin_loss",
     "pairwise_distance", "relu_", "rnnt_loss", "soft_margin_loss",
@@ -32,6 +33,10 @@ __all__ = [
 
 def _t(x):
     return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
 
 
 def _reduce(val, reduction):
@@ -544,3 +549,82 @@ def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
         return jnp.einsum("bhsn,bhsnd->bhsd", p.astype(v.dtype), vg)
 
     return apply(fn, _t(query), _t(key), _t(value), name="sparse_attention")
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None, name=None):
+    """Batch Levenshtein distance (reference nn/functional/loss.py:451 —
+    phi edit_distance kernel over LoD or padded int sequences).
+
+    input [B, T1] / label [B, T2] int token ids, optional per-row lengths
+    [B]. TPU-native DP: one lax.scan over hypothesis positions whose body
+    scans the reference row with a carried left-cell — static [B, T2+1]
+    state, variable lengths handled by capturing the row the moment
+    i == input_length (per batch row), never by dynamic shapes.
+
+    Returns (distance [B, 1] float32, sequence_num [1] int64-like).
+    Non-differentiable (integer op), matching the reference.
+    """
+    hyp = _arr(input).astype(jnp.int32)
+    ref = _arr(label).astype(jnp.int32)
+    if hyp.ndim == 1:
+        hyp = hyp[None]
+    if ref.ndim == 1:
+        ref = ref[None]
+    b, t1 = hyp.shape
+    t2 = ref.shape[1]
+    len1 = (jnp.full((b,), t1, jnp.int32) if input_length is None
+            else _arr(input_length).astype(jnp.int32).reshape(b))
+    len2 = (jnp.full((b,), t2, jnp.int32) if label_length is None
+            else _arr(label_length).astype(jnp.int32).reshape(b))
+
+    if ignored_tokens:
+        ign = jnp.asarray(list(ignored_tokens), jnp.int32)
+
+        def compact(seq, length):
+            pos = jnp.arange(seq.shape[1], dtype=jnp.int32)[None, :]
+            valid = (pos < length[:, None]) & ~jnp.isin(seq, ign)
+            order = jnp.argsort(~valid, axis=1, stable=True)
+            return (jnp.take_along_axis(seq, order, axis=1),
+                    valid.sum(axis=1).astype(jnp.int32))
+
+        hyp, len1 = compact(hyp, len1)
+        ref, len2 = compact(ref, len2)
+
+    def fn(hyp, ref, len1, len2):
+        row0 = jnp.broadcast_to(jnp.arange(t2 + 1, dtype=jnp.float32),
+                                (b, t2 + 1))
+
+        def outer(carry, i):
+            prev, result = carry  # prev: [B, T2+1] row i-1; result: [B]
+            hc = jnp.take_along_axis(hyp, (i - 1)[None, None].repeat(b, 0),
+                                     axis=1)[:, 0]          # hyp[:, i-1]
+
+            def inner(left, js):
+                up, diag, rc = js                            # [B] each
+                cost = (hc != rc).astype(jnp.float32)
+                val = jnp.minimum(jnp.minimum(up + 1.0, left + 1.0),
+                                  diag + cost)
+                return val, val
+
+            left0 = i.astype(jnp.float32) * jnp.ones((b,), jnp.float32)
+            _, cols = jax.lax.scan(
+                inner, left0,
+                (prev[:, 1:].T, prev[:, :-1].T, ref.T))
+            row = jnp.concatenate([left0[:, None], cols.T], axis=1)
+            # capture D[len1, len2] the iteration the row index hits len1
+            at_end = jnp.take_along_axis(row, len2[:, None], axis=1)[:, 0]
+            result = jnp.where(len1 == i, at_end, result)
+            return (row, result), None
+
+        # len1 == 0 rows: distance is len2
+        result0 = len2.astype(jnp.float32)
+        (_, result), _ = jax.lax.scan(
+            outer, (row0, result0), jnp.arange(1, t1 + 1, dtype=jnp.int32))
+        if normalized:
+            result = result / jnp.maximum(len2.astype(jnp.float32), 1.0)
+        return result[:, None]
+
+    dist = Tensor(fn(hyp, ref, len1, len2))
+    dist.stop_gradient = True
+    return dist, Tensor(jnp.asarray([b], jnp.int32))

@@ -522,6 +522,79 @@ tanh_ = _inplace_variant("tanh")
 scatter_ = _inplace_variant("scatter")
 
 
+# -- diagonal fills (reference tensor/manipulation.py:913 fill_diagonal_,
+#    :975 fill_diagonal_tensor_ — phi kernels fill_diagonal /
+#    fill_diagonal_tensor) -------------------------------------------------
+
+def _diag_mask_2d(n, m, offset, wrap):
+    """Boolean [n, m] mask of the filled diagonal. Flat-stride formulation
+    (the reference kernel iterates flat indices with stride m+1; numpy
+    fill_diagonal(wrap=True) semantics for tall matrices)."""
+    flat = np.zeros(n * m, bool)
+    start = offset if offset >= 0 else -offset * m
+    if wrap:
+        idx = np.arange(start, n * m, m + 1)
+    else:
+        cnt = min(n - max(-offset, 0), m - max(offset, 0))
+        idx = start + np.arange(max(cnt, 0)) * (m + 1)
+    flat[idx[idx < n * m]] = True
+    return jnp.asarray(flat.reshape(n, m))
+
+
+def fill_diagonal(x, value, offset=0, wrap=False, name=None):
+    """Out-of-place fill_diagonal (the inplace method wraps this). 2-D:
+    offset/wrap supported; N-D: all dims equal, main diagonal only
+    (reference FillDiagonalKernel contract)."""
+    x = _t(x)
+    shp = x.shape
+    if len(shp) < 2:
+        raise ValueError("fill_diagonal needs at least a 2-D tensor")
+    if len(shp) == 2:
+        mask = _diag_mask_2d(shp[0], shp[1], int(offset), bool(wrap))
+    else:
+        if len(set(shp)) != 1:
+            raise ValueError(
+                "fill_diagonal on >2-D tensors requires equal dims")
+        if offset:
+            raise ValueError("offset must be 0 for >2-D fill_diagonal")
+        n, nd = shp[0], len(shp)
+        mask = jnp.zeros(shp, bool).at[(jnp.arange(n),) * nd].set(True)
+    return apply(lambda a: jnp.where(mask, jnp.asarray(value, a.dtype), a),
+                 x, name="fill_diagonal")
+
+
+fill_diagonal_ = _inplace_variant("fill_diagonal")
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    """Fill the (dim1, dim2)-plane diagonals of x with tensor y
+    (reference tensor/manipulation.py:1009; y's trailing dim is the
+    diagonal length, leading dims are x's remaining dims)."""
+    x, y = _t(x), _t(y)
+    nd = x.ndim
+    d1, d2 = dim1 % nd, dim2 % nd
+    if d1 == d2:
+        raise ValueError("dim1 and dim2 must differ")
+    perm = [i for i in range(nd) if i not in (d1, d2)] + [d1, d2]
+    inv = np.argsort(perm)
+    n, m = x.shape[d1], x.shape[d2]
+    dlen = min(n - max(-offset, 0), m - max(offset, 0))
+    if dlen <= 0:
+        raise ValueError("offset leaves an empty diagonal")
+    rows = jnp.arange(dlen) + max(-offset, 0)
+    cols = jnp.arange(dlen) + max(offset, 0)
+
+    def fill(a, yv):
+        moved = jnp.transpose(a, perm)
+        filled = moved.at[..., rows, cols].set(yv.astype(a.dtype))
+        return jnp.transpose(filled, inv)
+
+    return apply(fill, x, y, name="fill_diagonal_tensor")
+
+
+fill_diagonal_tensor_ = _inplace_variant("fill_diagonal_tensor")
+
+
 def check_shape(shape):
     """Validate a shape argument (reference fluid/layers/utils.py
     check_shape: ints or a 1-D int tensor; -1 allowed once)."""
@@ -537,7 +610,8 @@ def check_shape(shape):
 
 
 __all__ += ["reshape_", "squeeze_", "unsqueeze_", "tanh_", "scatter_",
-            "check_shape"]
+            "check_shape", "fill_diagonal", "fill_diagonal_",
+            "fill_diagonal_tensor", "fill_diagonal_tensor_"]
 
 
 # -- Tensor-method surface completion (reference tensor/__init__.py method

@@ -360,3 +360,7 @@ if "__all__" in globals():
     __all__ += list(_compat_all)  # noqa: F405
 else:
     __all__ = list(_compat_all)
+
+from . import amp  # noqa: E402
+from . import quantization  # noqa: E402
+from . import sparsity  # noqa: E402

@@ -1,0 +1,180 @@
+"""Static-graph AMP surface (reference: python/paddle/static/amp/__init__.py —
+`decorate`, `AutoMixedPrecisionLists`/`CustomOpLists`, `fp16_guard`,
+`cast_model_to_fp16`, `cast_parameters_to_fp16`, `bf16.bf16_guard`).
+
+TPU-native re-design: the reference rewrites a ProgramDesc (inserting cast
+ops around white/black-listed ops and wrapping the optimizer in
+OptimizerWithMixedPrecision). Here a "static program" is a traced callable
+compiled by XLA, so mixed precision is the SAME dynamic-mode machinery —
+`amp.auto_cast` applied while the program builds/traces, and the loss-scaled
+optimizer wrapper from `amp.GradScaler` — exposed at the reference's import
+path so static-graph training scripts migrate unchanged. bf16 is the
+TPU-preferred dtype (MXU-native); fp16 requests run as bf16-compatible
+autocasting with the same op lists.
+"""
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+from .. import amp as _amp
+from ..core.tensor import Tensor
+
+__all__ = [
+    "decorate", "AutoMixedPrecisionLists", "CustomOpLists", "fp16_guard",
+    "bf16_guard", "cast_model_to_fp16", "cast_parameters_to_fp16",
+]
+
+
+class AutoMixedPrecisionLists:
+    """White/black op-name lists (reference static/amp/fp16_lists.py:30).
+
+    Ops in `custom_white_list` run in low precision, `custom_black_list`
+    stay fp32; `custom_black_varnames` is accepted for API parity (var-name
+    granularity has no analog when XLA owns the graph — values, not named
+    vars, flow between ops) and ignored.
+    """
+
+    def __init__(self, custom_white_list: Optional[Iterable[str]] = None,
+                 custom_black_list: Optional[Iterable[str]] = None,
+                 custom_black_varnames: Optional[Iterable[str]] = None):
+        # the custom additions travel separately: auto_cast() removes
+        # whatever custom lists it was handed when the region exits, so
+        # passing the merged view would strip the BUILTIN entries too
+        self.custom_white = set(custom_white_list or ())
+        self.custom_black = set(custom_black_list or ())
+        self.white_list = set(_amp.WHITE_LIST) | self.custom_white
+        self.black_list = set(_amp.BLACK_LIST) | self.custom_black
+        self.black_varnames = set(custom_black_varnames or ())
+
+
+# Reference alias (fp16_lists.CustomOpLists = AutoMixedPrecisionLists)
+CustomOpLists = AutoMixedPrecisionLists
+
+
+class OptimizerWithMixedPrecision:
+    """The `decorate(...)` return type (reference static/amp/decorator.py:37):
+    wraps an optimizer with dynamic loss scaling and exposes the reference's
+    minimize/backward/apply_gradients/amp_init methods over the dynamic-mode
+    GradScaler + auto_cast machinery."""
+
+    def __init__(self, optimizer, amp_lists=None, level="O1",
+                 dtype="float16", init_loss_scaling=2.0 ** 15,
+                 incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+                 incr_ratio=2.0, decr_ratio=0.8,
+                 use_dynamic_loss_scaling=True, use_amp_guard=False):
+        self._optimizer = optimizer
+        self._amp_lists = amp_lists or AutoMixedPrecisionLists()
+        self._level = level
+        self._dtype = dtype
+        self._use_guard = use_amp_guard
+        # bf16 on TPU needs no loss scaling (same exponent range as fp32):
+        # the scaler still runs when asked, matching reference numerics knobs
+        self._scaler = _amp.GradScaler(
+            enable=True,
+            init_loss_scaling=init_loss_scaling,
+            incr_ratio=incr_ratio, decr_ratio=decr_ratio,
+            incr_every_n_steps=incr_every_n_steps,
+            decr_every_n_nan_or_inf=decr_every_n_nan_or_inf,
+            use_dynamic_loss_scaling=use_dynamic_loss_scaling)
+
+    def _autocast(self):
+        return _amp.auto_cast(
+            enable=True,
+            custom_white_list=self._amp_lists.custom_white,
+            custom_black_list=self._amp_lists.custom_black,
+            level=self._level, dtype=self._dtype)
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        scaled = self._scaler.scale(loss)
+        scaled.backward()
+        return []
+
+    def apply_gradients(self, params_grads=None):
+        self._scaler.step(self._optimizer)
+        self._scaler.update()
+        return []
+
+    # reference signature: returns (optimize_ops, params_grads)
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        self.backward(loss, startup_program, parameter_list, no_grad_set)
+        self.apply_gradients()
+        self._optimizer.clear_grad()
+        return [], []
+
+    def amp_init(self, place=None, scope=None, test_program=None,
+                 use_fp16_test=False):
+        """Reference: casts fp32 weights to fp16 for pure-fp16 (O2) runs.
+        Params here live as jax arrays; O2 casting happens per-op at trace
+        time, so only master-weight bookkeeping is needed — a no-op."""
+        return None
+
+    def get_loss_scaling(self):
+        return self._scaler._scale
+
+    def __getattr__(self, name):
+        return getattr(self._optimizer, name)
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=2.0 ** 15,
+             incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+             incr_ratio=2.0, decr_ratio=0.8, use_dynamic_loss_scaling=True,
+             use_pure_fp16=False, use_fp16_guard=None, use_bf16=False,
+             level=None, dtype=None, master_weight=None):
+    """Reference static/amp/decorator.py:decorate — wrap `optimizer` for
+    mixed-precision training of a (traced) static program."""
+    level = level or ("O2" if use_pure_fp16 else "O1")
+    dtype = dtype or ("bfloat16" if use_bf16 else "float16")
+    return OptimizerWithMixedPrecision(
+        optimizer, amp_lists=amp_lists, level=level, dtype=dtype,
+        init_loss_scaling=init_loss_scaling,
+        incr_every_n_steps=incr_every_n_steps,
+        decr_every_n_nan_or_inf=decr_every_n_nan_or_inf,
+        incr_ratio=incr_ratio, decr_ratio=decr_ratio,
+        use_dynamic_loss_scaling=use_dynamic_loss_scaling,
+        use_amp_guard=bool(use_fp16_guard))
+
+
+def fp16_guard():
+    """Reference fp16_utils.fp16_guard: region marker inside which ops may
+    run fp16 when decorate(use_fp16_guard=True). Maps to auto_cast."""
+    return _amp.auto_cast(enable=True, level="O1", dtype="float16")
+
+
+def bf16_guard():
+    """Reference static/amp/bf16/amp_utils.bf16_guard."""
+    return _amp.auto_cast(enable=True, level="O1", dtype="bfloat16")
+
+
+def cast_model_to_fp16(program_or_layer, amp_lists=None, use_fp16_guard=True,
+                       dest_type="float16"):
+    """Reference fp16_utils.cast_model_to_fp16 — cast a model's compute to
+    fp16. For a Layer: cast its parameters (bf16 preferred on TPU); traced
+    programs pick the dtype up from the params."""
+    from ..nn import Layer
+
+    if isinstance(program_or_layer, Layer):
+        program_or_layer.to(dtype=dest_type)
+    return program_or_layer
+
+
+def cast_parameters_to_fp16(place=None, program=None, scope=None,
+                            to_fp16_var_names=None, dest_type="float16"):
+    """Reference fp16_utils.cast_parameters_to_fp16. Var-name driven weight
+    casting has no named-var analog here; cast via `cast_model_to_fp16`
+    (Layer) instead. Kept for import parity."""
+    return None
+
+
+class bf16:
+    """Namespace parity for `paddle.static.amp.bf16.*`."""
+
+    bf16_guard = staticmethod(bf16_guard)
+
+    @staticmethod
+    def decorate_bf16(optimizer, amp_lists=None, use_bf16_guard=None,
+                      use_pure_bf16=False):
+        return decorate(optimizer, amp_lists=amp_lists, use_bf16=True,
+                        use_pure_fp16=use_pure_bf16,
+                        use_fp16_guard=use_bf16_guard)

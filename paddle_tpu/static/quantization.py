@@ -1,0 +1,209 @@
+"""Static-graph quantization surface (reference:
+python/paddle/static/quantization/__init__.py — IrGraph passes
+`QuantizationTransformPass`/`AddQuantDequantPass`/`QuantizationFreezePass`…
+plus `PostTrainingQuantization` and `WeightQuantization`).
+
+TPU-native re-design: the reference's passes rewrite a ProgramDesc graph,
+inserting fake_quantize/fake_dequantize ops. Here a "program" is a traced
+Layer, so the pass surface maps onto the dynamic quantization machinery
+(`paddle_tpu.quantization` QAT/PTQ layer swapping). Each pass class keeps
+the reference's constructor shape and `apply(graph_or_layer)` verb; mkldnn-
+specific passes are intentionally absent (no oneDNN on TPU).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..quantization import (
+    AbsmaxObserver, FakeQuanterWithAbsMaxObserver, PTQ, QAT, QuantConfig,
+    WeightAbsMaxQuanter, quantize_linear, dequantize_linear,
+)
+
+__all__ = [
+    "QuantizationTransformPass", "QuantizationTransformPassV2",
+    "AddQuantDequantPass", "AddQuantDequantPassV2",
+    "QuantizationFreezePass", "ConvertToInt8Pass",
+    "OutScaleForTrainingPass", "OutScaleForInferencePass",
+    "TransformForMobilePass", "AddQuantDequantForInferencePass",
+    "ReplaceFakeQuantDequantPass", "QuantWeightPass",
+    "PostTrainingQuantization", "PostTrainingQuantizationProgram",
+    "WeightQuantization", "quant_config",
+]
+
+
+class _LayerPass:
+    """Common shape: reference passes take scope/place + bit widths and
+    rewrite a graph in `apply`; here `apply` swaps quantable sublayers."""
+
+    def __init__(self, scope=None, place=None, weight_bits=8,
+                 activation_bits=8, **kwargs):
+        self._weight_bits = weight_bits
+        self._activation_bits = activation_bits
+        self._kwargs = kwargs
+
+    def _engine(self):
+        raise NotImplementedError
+
+    def apply(self, graph):
+        """`graph` is a Layer (the traced-program analog of IrGraph)."""
+        return self._engine().quantize(graph, inplace=True)
+
+
+class QuantizationTransformPass(_LayerPass):
+    """Insert trainable fake-quant on weights+activations of matmul/conv
+    (reference quantization_pass.py:QuantizationTransformPass)."""
+
+    def _engine(self):
+        return QAT(QuantConfig(activation=FakeQuanterWithAbsMaxObserver,
+                               weight=WeightAbsMaxQuanter))
+
+
+class QuantizationTransformPassV2(QuantizationTransformPass):
+    pass
+
+
+class AddQuantDequantPass(_LayerPass):
+    """Observer-style quant-dequant on activations (reference: adds
+    fake_quantize_dequantize around non-weight ops)."""
+
+    def _engine(self):
+        return PTQ(QuantConfig(activation=AbsmaxObserver, weight=None))
+
+
+class AddQuantDequantPassV2(AddQuantDequantPass):
+    pass
+
+
+class _ConvertPass:
+    """Freeze/convert passes: after calibration or QAT, bake observed
+    scales into fixed qdq (PTQ.convert analog)."""
+
+    def __init__(self, scope=None, place=None, **kwargs):
+        pass
+
+    def apply(self, graph):
+        PTQ().convert(graph, inplace=True)
+        return graph
+
+
+class QuantizationFreezePass(_ConvertPass):
+    pass
+
+
+class ConvertToInt8Pass(_ConvertPass):
+    pass
+
+
+class ReplaceFakeQuantDequantPass(_ConvertPass):
+    pass
+
+
+class QuantWeightPass(_ConvertPass):
+    pass
+
+
+class AddQuantDequantForInferencePass(_ConvertPass):
+    pass
+
+
+class TransformForMobilePass:
+    """Reference: rewrites fake-quant ops into mobile-runtime ops. No
+    mobile runtime target on TPU; apply is the identity."""
+
+    def __init__(self, *a, **k):
+        pass
+
+    def apply(self, graph):
+        return graph
+
+
+class OutScaleForTrainingPass(AddQuantDequantPass):
+    """Track output scales during training (observer insertion)."""
+
+
+class OutScaleForInferencePass(_ConvertPass):
+    """Bake tracked output scales for inference."""
+
+
+class PostTrainingQuantization:
+    """Reference post_training_quantization.py:PostTrainingQuantization —
+    calibrate a model over sample data, then emit the quantized model.
+
+    Here: `model` is a Layer (or a zero-arg factory returning one);
+    `data_loader` yields calibration batches; `quantize()` runs PTQ
+    observe+convert and returns the quantized Layer; `save_quantized_model`
+    jit-saves it.
+    """
+
+    def __init__(self, executor=None, model_dir=None, model=None,
+                 data_loader=None, batch_size=10, batch_nums=None,
+                 algo="abs_max", quantizable_op_type=None, scope=None,
+                 **kwargs):
+        if model is None and model_dir is not None:
+            from ..jit import load as jit_load
+            model = jit_load(model_dir)
+        self._model = model() if callable(model) and not hasattr(
+            model, "state_dict") else model
+        self._loader = data_loader
+        self._batch_nums = batch_nums
+        self._quantized = None
+
+    def quantize(self):
+        ptq = PTQ()
+        model = ptq.quantize(self._model, inplace=False)
+        if self._loader is not None:
+            for i, batch in enumerate(self._loader):
+                if self._batch_nums is not None and i >= self._batch_nums:
+                    break
+                data = batch[0] if isinstance(batch, (list, tuple)) else batch
+                model(data)
+        ptq.convert(model, inplace=True)
+        self._quantized = model
+        return model
+
+    def save_quantized_model(self, save_model_path, model_filename=None,
+                             params_filename=None):
+        from ..jit import save as jit_save
+        if self._quantized is None:
+            raise RuntimeError("call quantize() before save_quantized_model")
+        jit_save(self._quantized, save_model_path)
+        return save_model_path
+
+
+class PostTrainingQuantizationProgram(PostTrainingQuantization):
+    pass
+
+
+class WeightQuantization:
+    """Reference post_training_quantization.py:WeightQuantization —
+    weight-only quantization of a saved model (abs_max or channel_wise)."""
+
+    def __init__(self, model_dir, model_filename=None, params_filename=None):
+        self._model_dir = model_dir
+
+    def quantize_weight_to_int(self, save_model_dir, save_model_filename=None,
+                               save_params_filename=None, quantizable_op_type=None,
+                               weight_bits=8, weight_quantize_type="abs_max",
+                               generate_test_model=False, threshold_rate=0.0):
+        from ..jit import load as jit_load, save as jit_save
+        from ..nn import Layer
+
+        model = jit_load(self._model_dir)
+        bound = float(2 ** (weight_bits - 1) - 1)
+        for layer in model.sublayers(include_self=True):
+            if not isinstance(layer, Layer):
+                continue
+            for name, p in list(layer._parameters.items()):
+                if p is None or p.ndim < 2:
+                    continue
+                arr = np.asarray(p.numpy(), np.float32)
+                scale = np.maximum(np.abs(arr).max(), 1e-8) / bound
+                q = np.clip(np.round(arr / scale), -bound - 1, bound)
+                p.set_value((q * scale).astype(arr.dtype))
+        jit_save(model, save_model_dir)
+        return save_model_dir
+
+
+def quant_config(**kwargs):
+    """Convenience factory mirroring quant_config helpers."""
+    return QuantConfig(**kwargs)

@@ -1,0 +1,15 @@
+"""paddle.static.sparsity parity — the static-graph entry to ASP 2:4
+structured sparsity (reference: python/paddle/incubate/asp/asp.py:217,303,
+516; exposed for static programs as paddle.static.sparsity in the v2.x
+line). The machinery is paddle_tpu.incubate.asp: mask generation +
+mask-preserving optimizer wrap work identically for traced programs.
+"""
+from ..incubate.asp import (
+    calculate_density, check_sparsity, create_mask, decorate, prune_model,
+    reset_excluded_layers, set_excluded_layers,
+)
+
+__all__ = [
+    "calculate_density", "decorate", "prune_model",
+    "set_excluded_layers", "reset_excluded_layers",
+]

@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Post-ladder decode investigation: XLA-vs-Pallas attention on the full
+# step, then the step-unroll sweep. Serial — single-tenant chip.
+# Run AFTER the harvest's ladder finishes:
+#   nohup scripts/decode_experiments.sh > /tmp/harvest/decode_exp.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p /tmp/harvest
+
+run() {  # run <name> <timeout-seconds> <cmd...>
+  local name="$1" to="$2"; shift 2
+  echo "$(date -u) == $name"
+  timeout "$to" "$@" > "/tmp/harvest/$name.log" 2>&1
+  echo "$(date -u) == $name rc=$?"
+}
+
+# the bisect's last two cases are the decisive measurement; retry once on
+# tunnel hiccups (remote_compile body closed)
+for attempt in 1 2; do
+  run "bisect_try$attempt" 1800 python scripts/decode_bisect.py
+  if grep -q "pallas decode kernel" "/tmp/harvest/bisect_try$attempt.log"; then
+    break
+  fi
+  echo "$(date -u) bisect attempt $attempt incomplete (tunnel?), retrying"
+  sleep 120
+done
+
+# decode bench: kernel vs XLA fallback at the bench's S_max=256.
+# env goes through `env` (a VAR=x prefix on a *function* call can persist
+# after it returns in some bash modes — it would invert the comparison)
+run decode_xla 900 env PTPU_FLASH_DECODE=0 python bench.py --config gpt124m_decode
+run decode_pallas 900 env PTPU_FLASH_DECODE=1 python bench.py --config gpt124m_decode
+
+# step-unroll sweep (cross-step weight-stream overlap)
+for u in 2 4; do
+  run "decode_unroll$u" 900 env PTPU_DECODE_STEP_UNROLL="$u" \
+    python bench.py --config gpt124m_decode
+done
+echo "$(date -u) decode experiments complete"

@@ -1,0 +1,81 @@
+"""Profile the ResNet-50 train step (bench.py config 2 shapes) on the real
+chip and print the device-op time breakdown — the ladder's resnet50 line
+ran at ~10% MFU (0.24 vs_baseline) on first hardware contact and this
+attributes the step cost.
+
+Usage: python scripts/profile_resnet.py [steps] [batch]
+"""
+import glob
+import os
+import sys
+import tempfile
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import numpy as np
+
+    steps = int(sys.argv[1]) if len(sys.argv) > 1 else 3
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+
+    import paddle_tpu as paddle
+    from paddle_tpu import jit, optimizer, parallel
+    from paddle_tpu.vision.models import resnet50
+
+    paddle.seed(0)
+    parallel.init_mesh()
+    model = parallel.place_model(resnet50(num_classes=1000))
+    model.bfloat16()
+    opt = optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                             parameters=model.parameters())
+
+    def step(x, y):
+        loss = paddle.nn.functional.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    compiled = jit.compile(step, models=[model], optimizers=[opt])
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(batch, 3, 224, 224).astype("float32"))
+    y = paddle.to_tensor(rng.randint(0, 1000, (batch,)).astype("int64"))
+
+    out = compiled(x, y)
+    jax.block_until_ready(getattr(out, "_data", out))
+
+    import time
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = compiled(x, y)
+    jax.block_until_ready(getattr(out, "_data", out))
+    dt = (time.perf_counter() - t0) / steps
+    print(f"step {dt*1e3:.2f} ms  ({batch/dt:.0f} img/s)")
+
+    tmp = tempfile.mkdtemp(prefix="ptpu_prof_resnet_")
+    with jax.profiler.trace(tmp):
+        for _ in range(steps):
+            out = compiled(x, y)
+        jax.block_until_ready(getattr(out, "_data", out))
+
+    paths = glob.glob(os.path.join(tmp, "**", "*.xplane.pb"), recursive=True)
+    pd = jax.profiler.ProfileData.from_file(paths[0])
+    for plane in pd.planes:
+        if "TPU" not in plane.name:
+            continue
+        print("== plane:", plane.name, f"({steps} steps)")
+        agg, cnt = defaultdict(float), defaultdict(int)
+        for line in plane.lines:
+            for ev in line.events:
+                agg[ev.name] += ev.duration_ns / 1e6
+                cnt[ev.name] += 1
+        for name, ms in sorted(agg.items(), key=lambda kv: -kv[1])[:40]:
+            print(f"{ms/steps:10.3f} ms/step  x{cnt[name]//steps:<5d} "
+                  f"{name[:105]}")
+
+
+if __name__ == "__main__":
+    main()

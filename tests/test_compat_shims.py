@@ -1,0 +1,130 @@
+"""Static amp/quantization/sparsity shims + distributed.metric +
+incubate.multiprocessing/autotune — the round-2 namespace-gap closers.
+Reference analogs: python/paddle/static/amp, static/quantization,
+distributed/metric/metrics.py, incubate/multiprocessing.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def test_static_amp_decorate_minimize():
+    from paddle_tpu.static import amp as samp
+
+    layer = nn.Linear(8, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=layer.parameters())
+    mp_opt = samp.decorate(opt, use_bf16=True,
+                           amp_lists=samp.CustomOpLists(
+                               custom_black_list=["softmax"]))
+    x = paddle.to_tensor(np.random.randn(2, 8).astype("float32"))
+    with mp_opt._autocast():
+        loss = layer(x).mean()
+    before = layer.weight.numpy().copy()
+    mp_opt.minimize(loss)
+    assert not np.allclose(before, layer.weight.numpy())
+    assert mp_opt.get_loss_scaling() > 0
+
+
+def test_static_amp_guards_and_cast():
+    from paddle_tpu.static import amp as samp
+
+    with samp.bf16_guard():
+        y = paddle.to_tensor(np.ones((2, 2), "float32")) @ paddle.to_tensor(
+            np.ones((2, 2), "float32"))
+        assert y.dtype in ("bfloat16", paddle.bfloat16)
+    layer = nn.Linear(4, 4)
+    samp.cast_model_to_fp16(layer, dest_type="bfloat16")
+    assert "bfloat16" in str(layer.weight.dtype)
+
+
+def test_static_quantization_ptq_roundtrip():
+    from paddle_tpu.static.quantization import PostTrainingQuantization
+
+    layer = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 2))
+    data = [paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+            for _ in range(3)]
+    ptq = PostTrainingQuantization(model=layer,
+                                   data_loader=[(d,) for d in data])
+    q = ptq.quantize()
+    ref = layer(data[0]).numpy()
+    out = q(data[0]).numpy()
+    assert out.shape == ref.shape
+    assert np.mean(np.abs(out - ref)) < 0.25 * (np.abs(ref).mean() + 1e-6)
+
+
+def test_static_quantization_transform_pass():
+    from paddle_tpu.static.quantization import (
+        QuantizationTransformPass, QuantizationFreezePass)
+
+    layer = nn.Linear(6, 3)
+    QuantizationTransformPass().apply(layer)
+    x = paddle.to_tensor(np.random.randn(2, 6).astype("float32"))
+    layer(x)  # observe
+    QuantizationFreezePass().apply(layer)
+    y = layer(x)
+    assert tuple(y.shape) == (2, 3)
+
+
+def test_static_sparsity_prune():
+    from paddle_tpu.static import sparsity
+
+    layer = nn.Linear(16, 16)
+    sparsity.prune_model(layer, n=2, m=4)
+    w = layer.weight.numpy()
+    assert sparsity.check_sparsity(w, n=2, m=4)
+    assert abs(sparsity.calculate_density(w) - 0.5) < 1e-6
+
+
+def test_distributed_auc_merges_and_scores(tmp_path):
+    from paddle_tpu.distributed import metric
+
+    yaml_path = tmp_path / "metric.yaml"
+    yaml_path.write_text(
+        "monitors:\n  - name: join_auc\n    method: AucCalculator\n"
+        "    label: label\n    target: prob\n    phase: JOINING\n")
+    reg = metric.init_metric(metric_yaml_path=str(yaml_path))
+    assert "join_auc" in reg
+    m = reg["join_auc"]
+    rng = np.random.RandomState(0)
+    labels = (rng.rand(512) > 0.5).astype(np.int64)
+    preds = np.clip(labels * 0.6 + rng.rand(512) * 0.4, 0, 1)
+    m.update(preds, labels)
+    auc = m.eval()
+    assert 0.8 < auc <= 1.0
+    out = metric.print_auc(name="join_auc")
+    assert "join_auc" in out
+    m.clear()
+    assert m.eval() == 0.5  # degenerate: no samples
+
+
+def test_multiprocessing_tensor_reduction_roundtrip():
+    """Tensor through a mp queue rebuilds identically (shm path for the
+    big one, by-value for the small one)."""
+    from multiprocessing.reduction import ForkingPickler
+    import pickle
+
+    import paddle_tpu.incubate.multiprocessing as pmp  # installs reductions
+
+    for shape in ((4,), (128, 256)):
+        t = paddle.to_tensor(
+            np.arange(np.prod(shape)).reshape(shape).astype("float32"))
+        payload = bytes(ForkingPickler.dumps(t))
+        back = pickle.loads(payload)
+        np.testing.assert_array_equal(back.numpy(), t.numpy())
+
+    bf = paddle.to_tensor(np.ones((64, 64), "float32")).astype("bfloat16")
+    back = pickle.loads(bytes(ForkingPickler.dumps(bf)))
+    assert "bfloat16" in str(back.dtype)
+
+
+def test_incubate_autotune_set_config(tmp_path):
+    from paddle_tpu.incubate import autotune
+
+    autotune.set_config({"kernel": {"enable": True},
+                         "layout": {"enable": False}})
+    cfg = tmp_path / "tune.json"
+    cfg.write_text('{"kernel": {"enable": true}}')
+    autotune.set_config(str(cfg))

@@ -99,3 +99,81 @@ def test_cpp_extension_custom_op(tmp_path):
     f = jax.jit(lambda a: op(paddle.Tensor(a))._data)
     np.testing.assert_allclose(np.asarray(f(jnp.asarray(x.numpy()))),
                                x.numpy() * 2.0 + 1.0)
+
+
+def test_fill_diagonal_variants():
+    """fill_diagonal / fill_diagonal_tensor (reference
+    tensor/manipulation.py:913,1009) — 2D offset/wrap vs numpy, ND, and
+    the inplace rebinding."""
+    x = paddle.ones((4, 3)) * 2
+    ref = np.ones((4, 3)) * 2
+    np.fill_diagonal(ref, 1.0)
+    np.testing.assert_array_equal(x.fill_diagonal(1.0).numpy(), ref)
+    x.fill_diagonal_(1.0)
+    np.testing.assert_array_equal(x.numpy(), ref)
+
+    tall = paddle.ones((7, 3))
+    ref = np.ones((7, 3))
+    np.fill_diagonal(ref, 9.0, wrap=True)
+    np.testing.assert_array_equal(tall.fill_diagonal(9.0, wrap=True).numpy(),
+                                  ref)
+
+    off = paddle.zeros((4, 4)).fill_diagonal(5.0, offset=1).numpy()
+    assert off[0, 1] == 5 and off[2, 3] == 5 and off[0, 0] == 0
+    neg = paddle.zeros((4, 4)).fill_diagonal(5.0, offset=-1).numpy()
+    assert neg[1, 0] == 5 and neg[3, 2] == 5 and neg[0, 0] == 0
+
+    cube = paddle.zeros((3, 3, 3)).fill_diagonal(7.0).numpy()
+    assert cube[1, 1, 1] == 7 and cube[0, 1, 1] == 0
+
+    x = paddle.zeros((2, 3, 3))
+    y = paddle.to_tensor(np.arange(6).reshape(2, 3).astype("float32"))
+    out = x.fill_diagonal_tensor(y, dim1=1, dim2=2).numpy()
+    assert out[1, 2, 2] == 5 and out[0, 1, 1] == 1 and out[0, 0, 1] == 0
+
+    # gradient: only off-diagonal positions pass through
+    a = paddle.ones((3, 3))
+    a.stop_gradient = False
+    a.fill_diagonal(0.0).sum().backward()
+    g = a.grad.numpy()
+    assert g[0, 0] == 0 and g[0, 1] == 1
+
+
+def test_edit_distance_levenshtein():
+    """edit_distance (reference nn/functional/loss.py:451): kitten->sitting
+    = 3, normalization by label length, ignored_tokens compaction."""
+    import paddle_tpu.nn.functional as F
+
+    def ids(s, t):
+        return [ord(c) for c in s] + [0] * (t - len(s))
+
+    hyp = paddle.to_tensor(np.array([ids("kitten", 8), ids("abc", 8)],
+                                    np.int32))
+    lab = paddle.to_tensor(np.array([ids("sitting", 9), ids("abc", 9)],
+                                    np.int32))
+    hl = paddle.to_tensor(np.array([6, 3], np.int32))
+    ll = paddle.to_tensor(np.array([7, 3], np.int32))
+    d, n = F.edit_distance(hyp, lab, normalized=False,
+                           input_length=hl, label_length=ll)
+    np.testing.assert_allclose(d.numpy().ravel(), [3.0, 0.0])
+    assert int(n.numpy()[0]) == 2
+    dn, _ = F.edit_distance(hyp, lab, normalized=True,
+                            input_length=hl, label_length=ll)
+    np.testing.assert_allclose(dn.numpy().ravel(), [3 / 7, 0.0])
+
+    h2 = paddle.to_tensor(np.array([ids("kxitten", 8)], np.int32))
+    l2 = paddle.to_tensor(np.array([ids("sitting", 8)], np.int32))
+    d2, _ = F.edit_distance(
+        h2, l2, normalized=False, ignored_tokens=[ord("x")],
+        input_length=paddle.to_tensor(np.array([7], np.int32)),
+        label_length=paddle.to_tensor(np.array([7], np.int32)))
+    np.testing.assert_allclose(d2.numpy().ravel(), [3.0])
+
+    # empty hypothesis: distance = label length
+    d3, _ = F.edit_distance(
+        paddle.to_tensor(np.zeros((1, 4), np.int32)),
+        paddle.to_tensor(np.array([ids("abc", 4)], np.int32)),
+        normalized=False,
+        input_length=paddle.to_tensor(np.array([0], np.int32)),
+        label_length=paddle.to_tensor(np.array([3], np.int32)))
+    np.testing.assert_allclose(d3.numpy().ravel(), [3.0])
