@@ -71,15 +71,10 @@ class DistributedAuc:
         return np.asarray(pos.numpy()), np.asarray(neg.numpy())
 
     def eval(self):
+        from ...metric import _histogram_auc
+
         pos, neg = self._merged_state()
-        # walk buckets from high score to low: AUC via trapezoids
-        tp = np.cumsum(pos[::-1]).astype(np.float64)
-        fp = np.cumsum(neg[::-1]).astype(np.float64)
-        total_pos, total_neg = tp[-1], fp[-1]
-        if total_pos == 0 or total_neg == 0:
-            return 0.5
-        area = np.trapezoid(tp, fp) if hasattr(np, "trapezoid") else np.trapz(tp, fp)
-        return float(area / (total_pos * total_neg))
+        return _histogram_auc(pos, neg, empty=0.5)
 
     def clear(self):
         self._pos[:] = 0

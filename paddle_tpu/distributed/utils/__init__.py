@@ -13,3 +13,120 @@ def get_cluster_from_args(args=None):
     ranks = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
     return {"nranks": ranks,
             "endpoints": os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")}
+
+
+# -- MoE ragged collectives (reference: distributed/utils/moe_utils.py
+#    global_scatter/global_gather over global_scatter_op.cu.cc) -------------
+
+def _concrete_counts(t):
+    import numpy as np
+
+    try:
+        arr = t.numpy() if hasattr(t, "numpy") else t
+        import jax
+
+        if isinstance(getattr(t, "_data", t), jax.core.Tracer):
+            return None
+        return np.asarray(arr).astype(np.int64).reshape(-1)
+    except Exception:
+        return None
+
+
+def _moe_world(group):
+    from ..collective import _world  # noqa: the dual-mode world helper
+
+    return _world(group)
+
+
+def global_scatter(x, local_count, global_count, group=None,
+                   use_calc_stream=True):
+    """Dispatch rows of `x` to (card, expert) destinations
+    (reference distributed/utils/moe_utils.py:20 — a ragged NCCL
+    all-to-all where local_count[i] rows go to expert i % n_expert of
+    card i // n_expert, and global_count[i] rows arrive likewise).
+
+    TPU-native contract: XLA collectives are static-shaped, so the ragged
+    wire format cannot be expressed directly. Three supported regimes:
+
+    - world == 1 (the reference's own test regime): pure reorder — counts
+      describe the same i-ordering on both sides, data passes through
+      unchanged (gradient flows; backward of scatter is gather, which is
+      also identity at world 1).
+    - uniform counts (fixed capacity per (card, expert)) inside an SPMD
+      region: one `lax.all_to_all` over the group axis — exactly
+      `parallel.moe`'s dispatch. Counts must be concrete and equal.
+    - anything else raises: use `paddle_tpu.parallel.moe.MoELayer`
+      (capacity-factor dispatch) — the TPU answer to ragged expert
+      routing, matching reference MoELayer end-to-end.
+    """
+    from ...core.dispatch import apply
+    from ..collective import _axis_for
+
+    ax = _axis_for(group)
+    world = _moe_world(group) if ax is None else None
+    if ax is None and world == 1:
+        # outside any SPMD region, single process: pure reorder
+        return apply(lambda a: a, x, name="global_scatter")
+    lc = _concrete_counts(local_count)
+    if ax is not None and lc is not None and len(set(lc.tolist())) == 1:
+        import jax
+
+        from ...parallel.mesh import get_mesh
+
+        n_ways = int(dict(get_mesh().shape).get(ax, 1))
+        cap = int(lc[0])
+        n_groups = max(len(lc) // n_ways, 1)  # n_expert
+
+        def fn(a):
+            d = a.shape[-1]
+            blocks = a.reshape(n_ways, n_groups * cap, d)
+            out = jax.lax.all_to_all(blocks, ax, split_axis=0,
+                                     concat_axis=0, tiled=True)
+            return out.reshape(-1, d)
+
+        return apply(fn, x, name="global_scatter")
+    raise RuntimeError(
+        "global_scatter with ragged per-expert counts has no static-shape "
+        "XLA lowering; use paddle_tpu.parallel.moe.MoELayer (capacity-"
+        "factor dispatch) or pad counts to a uniform capacity")
+
+
+def global_gather(x, local_count, global_count, group=None,
+                  use_calc_stream=True):
+    """Inverse of global_scatter (reference moe_utils.py:137): return the
+    expert outputs to the cards that sent them. Same TPU contract; at
+    world 1 it is the identity, and with uniform capacity it is the
+    reverse all_to_all."""
+    from ...core.dispatch import apply
+    from ..collective import _axis_for
+
+    ax = _axis_for(group)
+    world = _moe_world(group) if ax is None else None
+    if ax is None and world == 1:
+        # outside any SPMD region, single process: pure reorder
+        return apply(lambda a: a, x, name="global_gather")
+    gc = _concrete_counts(global_count)
+    if ax is not None and gc is not None and len(set(gc.tolist())) == 1:
+        import jax
+
+        from ...parallel.mesh import get_mesh
+
+        n_ways = int(dict(get_mesh().shape).get(ax, 1))
+        cap = int(gc[0])
+        n_groups = max(len(gc) // n_ways, 1)
+
+        def fn(a):
+            d = a.shape[-1]
+            blocks = a.reshape(n_ways, n_groups * cap, d)
+            out = jax.lax.all_to_all(blocks, ax, split_axis=0,
+                                     concat_axis=0, tiled=True)
+            return out.reshape(-1, d)
+
+        return apply(fn, x, name="global_gather")
+    raise RuntimeError(
+        "global_gather with ragged per-expert counts has no static-shape "
+        "XLA lowering; use paddle_tpu.parallel.moe.MoELayer or pad counts "
+        "to a uniform capacity")
+
+
+__all__ += ["global_scatter", "global_gather"]

@@ -5,10 +5,24 @@ from . import distributed
 from . import autograd
 from . import asp
 from . import autotune
-from . import multiprocessing
 from . import optimizer
 
 __all__ = ["nn", "autograd", "asp", "autotune", "multiprocessing", "optimizer", "distributed"]
+
+
+def __getattr__(name):
+    # incubate.multiprocessing loads LAZILY: importing it registers
+    # Tensor ForkingPickler reductions (a process-global side effect the
+    # reference also gates behind an explicit `import
+    # paddle.incubate.multiprocessing`), so a plain `import paddle_tpu`
+    # must not install them.
+    if name == "multiprocessing":
+        import importlib
+
+        mod = importlib.import_module(__name__ + ".multiprocessing")
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 # graph ops (reference incubate.graph_* — earlier homes of what became
 # paddle.geometric; SURVEY §8.11) re-exported over the geometric kernels

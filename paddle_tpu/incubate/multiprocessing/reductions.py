@@ -9,23 +9,32 @@ host-side: tensors above a small threshold are staged into POSIX shared
 memory (`multiprocessing.shared_memory`) and rebuilt as host tensors in
 the consumer; small tensors pickle by value.
 
-Lifetime: the PRODUCER owns every segment it created and unlinks them all
-at interpreter exit (the reference's file_system-strategy shape).
-Consumers only close their mapping — a payload can therefore be
-deserialized any number of times (fan-out to N workers, redelivery after
-a crash); the cost is that segments live until the producer exits.
+Lifetime: the PRODUCER owns every segment it created; consumers only
+close their mapping, so a payload can be deserialized any number of
+times (fan-out to N workers, redelivery after a crash). Producer-side
+segments are bounded by an LRU of PTPU_SHM_CACHE_SEGMENTS (default 64):
+beyond that the oldest segment is unlinked — by then its payload has
+long been consumed in any draining queue — and everything left is
+unlinked at interpreter exit (the reference's file_system-strategy
+shape, same staleness tradeoff).
 """
 from __future__ import annotations
 
 import atexit
+import os
+from collections import OrderedDict
 from multiprocessing.reduction import ForkingPickler
 
 import numpy as np
 
 _SHM_MIN_BYTES = 1 << 16  # below this, copying beats shm setup
 
-# segments this process created, unlinked at exit (producer-owned cleanup)
-_PRODUCED: dict[str, object] = {}
+# segments this process created, oldest-first (producer-owned cleanup)
+_PRODUCED: "OrderedDict[str, object]" = OrderedDict()
+
+
+def _max_segments():
+    return int(os.environ.get("PTPU_SHM_CACHE_SEGMENTS", "64"))
 
 
 def _cleanup_produced():
@@ -76,7 +85,14 @@ def _reduce_tensor(tensor):
         shm = shared_memory.SharedMemory(create=True, size=arr.nbytes)
         dst = np.ndarray(arr.shape, dtype=arr.dtype, buffer=shm.buf)
         dst[...] = arr
-        _PRODUCED[shm.name] = shm  # keep mapping alive until atexit unlink
+        _PRODUCED[shm.name] = shm  # alive until LRU eviction/atexit unlink
+        while len(_PRODUCED) > _max_segments():
+            _, old = _PRODUCED.popitem(last=False)
+            try:
+                old.close()
+                old.unlink()
+            except (FileNotFoundError, OSError):
+                pass
         return _rebuild_from_shm, (shm.name, arr.shape, arr.dtype.name)
     return _rebuild_small, (arr.tobytes(), arr.shape, arr.dtype.name)
 

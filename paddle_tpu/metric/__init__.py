@@ -114,6 +114,23 @@ class Recall(Metric):
         return self._name
 
 
+def _histogram_auc(pos, neg, empty=0.0):
+    """AUC from score-bucket histograms: sweep buckets high-score-first and
+    integrate TP against FP, INCLUDING the ROC origin — without a leading
+    (0, 0) point, mass in the top bucket loses its trapezoid half-credit
+    (a constant predictor scored 0.0 instead of 0.5). Shared by metric.Auc
+    and distributed.metric.DistributedAuc."""
+    pos = np.asarray(pos, np.float64)
+    neg = np.asarray(neg, np.float64)
+    tot_pos, tot_neg = pos.sum(), neg.sum()
+    if tot_pos == 0 or tot_neg == 0:
+        return float(empty)
+    tp = np.concatenate([[0.0], np.cumsum(pos[::-1])])
+    fp = np.concatenate([[0.0], np.cumsum(neg[::-1])])
+    trap = np.trapezoid if hasattr(np, "trapezoid") else np.trapz
+    return float(trap(tp, fp) / (tot_pos * tot_neg))
+
+
 class Auc(Metric):
     def __init__(self, curve="ROC", num_thresholds=4095, name=None):
         self._name = name or "auc"
@@ -137,16 +154,7 @@ class Auc(Metric):
                 self._stat_neg[i] += 1
 
     def accumulate(self):
-        tot_pos = self._stat_pos.sum()
-        tot_neg = self._stat_neg.sum()
-        if tot_pos == 0 or tot_neg == 0:
-            return 0.0
-        # trapezoid over thresholds from high to low
-        tp = np.cumsum(self._stat_pos[::-1])
-        fp = np.cumsum(self._stat_neg[::-1])
-        tpr = tp / tot_pos
-        fpr = fp / tot_neg
-        return float(np.trapz(tpr, fpr))
+        return _histogram_auc(self._stat_pos, self._stat_neg, empty=0.0)
 
     def name(self):
         return self._name

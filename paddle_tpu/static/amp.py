@@ -5,12 +5,14 @@
 TPU-native re-design: the reference rewrites a ProgramDesc (inserting cast
 ops around white/black-listed ops and wrapping the optimizer in
 OptimizerWithMixedPrecision). Here a "static program" is a traced callable
-compiled by XLA, so mixed precision is the SAME dynamic-mode machinery —
-`amp.auto_cast` applied while the program builds/traces, and the loss-scaled
-optimizer wrapper from `amp.GradScaler` — exposed at the reference's import
-path so static-graph training scripts migrate unchanged. bf16 is the
-TPU-preferred dtype (MXU-native); fp16 requests run as bf16-compatible
-autocasting with the same op lists.
+compiled by XLA — ops cast when they RUN, so there is no after-the-fact
+program rewrite: the one migration change a reference script needs is
+wrapping its forward in `decorated_opt.autocast()` (the auto_cast region
+carrying the decorate()-time lists/level/dtype). minimize() warns if the
+loss was built with no autocast region ever entered — the silent
+alternative would be full-fp32 training while the user believes bf16 is
+on. bf16 is the TPU-preferred dtype (MXU-native); fp16 requests run as
+bf16-compatible autocasting with the same op lists.
 """
 from __future__ import annotations
 
@@ -76,16 +78,33 @@ class OptimizerWithMixedPrecision:
             incr_every_n_steps=incr_every_n_steps,
             decr_every_n_nan_or_inf=decr_every_n_nan_or_inf,
             use_dynamic_loss_scaling=use_dynamic_loss_scaling)
+        self._autocast_entered = False
 
-    def _autocast(self):
+    def autocast(self):
+        """The mixed-precision region for the forward pass: the program-
+        rewrite analog. Reference scripts add exactly this around their
+        forward/loss build."""
+        self._autocast_entered = True
         return _amp.auto_cast(
             enable=True,
             custom_white_list=self._amp_lists.custom_white,
             custom_black_list=self._amp_lists.custom_black,
             level=self._level, dtype=self._dtype)
 
+    # pre-rename alias
+    _autocast = autocast
+
     def backward(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None, callbacks=None):
+        if not self._autocast_entered and not _amp.is_auto_cast_enabled():
+            import warnings
+
+            warnings.warn(
+                "static.amp.decorate(): minimize/backward called but no "
+                "autocast region was ever entered — ops cast when they run "
+                "on traced programs (there is no after-the-fact program "
+                "rewrite), so this trained in full fp32. Wrap the forward "
+                "in `decorated_opt.autocast()`.", stacklevel=3)
         scaled = self._scaler.scale(loss)
         scaled.backward()
         return []

@@ -20,7 +20,7 @@ def test_static_amp_decorate_minimize():
                            amp_lists=samp.CustomOpLists(
                                custom_black_list=["softmax"]))
     x = paddle.to_tensor(np.random.randn(2, 8).astype("float32"))
-    with mp_opt._autocast():
+    with mp_opt.autocast():
         loss = layer(x).mean()
     before = layer.weight.numpy().copy()
     mp_opt.minimize(loss)
