@@ -130,6 +130,7 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        self._warned_traced = False
 
     def scale(self, var):
         if not self._enable:
@@ -139,13 +140,35 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable:
             return
+        import jax
+
         inv = 1.0 / self._scale
         found = False
         for p in optimizer._parameter_list:
             if p.grad is None:
                 continue
             g = p.grad._data.astype(jnp.float32) * inv
-            finite = bool(jnp.all(jnp.isfinite(g)))
+            if isinstance(g, jax.core.Tracer):
+                # under a jit trace the finite check is a traced bool —
+                # branching on it would need lax.cond over the whole
+                # optimizer update. TPU stance: bf16 training (the blessed
+                # dtype) never overflows the exponent, so compiled steps
+                # unscale mathematically and skip the inf-skip behavior;
+                # eager fp16 keeps the full dynamic-scaling protocol.
+                if self._dynamic and not self._warned_traced:
+                    import warnings
+
+                    warnings.warn(
+                        "GradScaler inside a jit-compiled step: the "
+                        "inf/NaN skip of dynamic loss scaling is NOT "
+                        "applied under trace (an overflowed fp16 step "
+                        "would update with non-finite grads). bf16 "
+                        "training does not need loss scaling; for fp16, "
+                        "keep the scaler step eager.", stacklevel=3)
+                    self._warned_traced = True
+                finite = True
+            else:
+                finite = bool(jnp.all(jnp.isfinite(g)))
             if not finite:
                 found = True
             p.grad._data = g

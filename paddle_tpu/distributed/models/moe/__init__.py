@@ -1,0 +1,7 @@
+"""paddle.distributed.models.moe (reference:
+python/paddle/distributed/models/moe/ — the routing-op utils; the
+MoELayer itself lives at incubate.distributed.models.moe, same as the
+reference)."""
+from . import utils
+
+__all__ = ["utils"]

@@ -1,0 +1,11 @@
+"""Alias of the MoE routing utils at the reference's second import path
+(python/paddle/distributed/models/moe/utils.py)."""
+from ....incubate.distributed.models.moe.utils import (
+    _assign_pos, _limit_by_capacity, _number_count, _prune_gate_by_capacity,
+    _random_routing,
+)
+
+__all__ = [
+    "_number_count", "_assign_pos", "_random_routing",
+    "_limit_by_capacity", "_prune_gate_by_capacity",
+]

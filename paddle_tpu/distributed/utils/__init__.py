@@ -19,23 +19,73 @@ def get_cluster_from_args(args=None):
 #    global_scatter/global_gather over global_scatter_op.cu.cc) -------------
 
 def _concrete_counts(t):
+    """Host-visible int64 counts, or None when traced. Tracer check comes
+    FIRST (t.numpy() on a tracer raises, and a broad except would also
+    hide real bugs); the conversion itself is then allowed to fail only
+    with the concretization error."""
+    import jax
     import numpy as np
 
-    try:
-        arr = t.numpy() if hasattr(t, "numpy") else t
-        import jax
-
-        if isinstance(getattr(t, "_data", t), jax.core.Tracer):
-            return None
-        return np.asarray(arr).astype(np.int64).reshape(-1)
-    except Exception:
+    if isinstance(getattr(t, "_data", t), jax.core.Tracer):
         return None
+    arr = t.numpy() if hasattr(t, "numpy") else t
+    return np.asarray(arr).astype(np.int64).reshape(-1)
 
 
 def _moe_world(group):
     from ..collective import _world  # noqa: the dual-mode world helper
 
     return _world(group)
+
+
+def _uniform_all_to_all(x, counts, ax, name):
+    """Shared uniform-capacity exchange: card-major blocks through ONE
+    lax.all_to_all over the `ax` mesh axis. gather is the same exchange
+    run in reverse — all_to_all is its own inverse for this layout."""
+    import jax
+
+    from ...core.dispatch import apply
+    from ...parallel.mesh import get_mesh
+
+    n_ways = int(dict(get_mesh().shape).get(ax, 1))
+    cap = int(counts[0])
+    n_groups = max(len(counts) // n_ways, 1)  # n_expert
+
+    def fn(a):
+        d = a.shape[-1]
+        blocks = a.reshape(n_ways, n_groups * cap, d)
+        out = jax.lax.all_to_all(blocks, ax, split_axis=0,
+                                 concat_axis=0, tiled=True)
+        return out.reshape(-1, d)
+
+    return apply(fn, x, name=name)
+
+
+def _moe_exchange(x, counts_t, group, name):
+    """Regime dispatch shared by global_scatter/global_gather."""
+    from ...core.dispatch import apply
+    from ..collective import _axis_for
+
+    ax = _axis_for(group)
+    if ax is None:
+        world = _moe_world(group)
+        if world == 1:
+            # outside any SPMD region, single process: pure reorder
+            return apply(lambda a: a, x, name=name)
+        raise RuntimeError(
+            f"{name} outside an SPMD region with world={world}: eager "
+            "multi-process ragged all-to-all has no XLA lowering — run "
+            "inside a mesh/shard region (where uniform-capacity counts "
+            "lower to one lax.all_to_all) or use "
+            "paddle_tpu.parallel.moe.MoELayer")
+    counts = _concrete_counts(counts_t)
+    if counts is not None and len(set(counts.tolist())) == 1:
+        return _uniform_all_to_all(x, counts, ax, name)
+    raise RuntimeError(
+        f"{name} with ragged or traced per-expert counts has no "
+        "static-shape XLA lowering; pad counts to a uniform capacity "
+        "(pass them as concrete host values) or use "
+        "paddle_tpu.parallel.moe.MoELayer (capacity-factor dispatch)")
 
 
 def global_scatter(x, local_count, global_count, group=None,
@@ -46,87 +96,43 @@ def global_scatter(x, local_count, global_count, group=None,
     card i // n_expert, and global_count[i] rows arrive likewise).
 
     TPU-native contract: XLA collectives are static-shaped, so the ragged
-    wire format cannot be expressed directly. Three supported regimes:
+    wire format cannot be expressed directly. Three regimes:
 
     - world == 1 (the reference's own test regime): pure reorder — counts
       describe the same i-ordering on both sides, data passes through
       unchanged (gradient flows; backward of scatter is gather, which is
       also identity at world 1).
-    - uniform counts (fixed capacity per (card, expert)) inside an SPMD
-      region: one `lax.all_to_all` over the group axis — exactly
-      `parallel.moe`'s dispatch. Counts must be concrete and equal.
-    - anything else raises: use `paddle_tpu.parallel.moe.MoELayer`
-      (capacity-factor dispatch) — the TPU answer to ragged expert
-      routing, matching reference MoELayer end-to-end.
+    - uniform concrete counts (fixed capacity per (card, expert)) inside
+      an SPMD region: one `lax.all_to_all` over the group axis — exactly
+      `parallel.moe`'s dispatch.
+    - anything else raises with the regime named: use
+      `paddle_tpu.parallel.moe.MoELayer` (capacity-factor dispatch) — the
+      TPU answer to ragged expert routing.
     """
-    from ...core.dispatch import apply
-    from ..collective import _axis_for
-
-    ax = _axis_for(group)
-    world = _moe_world(group) if ax is None else None
-    if ax is None and world == 1:
-        # outside any SPMD region, single process: pure reorder
-        return apply(lambda a: a, x, name="global_scatter")
-    lc = _concrete_counts(local_count)
-    if ax is not None and lc is not None and len(set(lc.tolist())) == 1:
-        import jax
-
-        from ...parallel.mesh import get_mesh
-
-        n_ways = int(dict(get_mesh().shape).get(ax, 1))
-        cap = int(lc[0])
-        n_groups = max(len(lc) // n_ways, 1)  # n_expert
-
-        def fn(a):
-            d = a.shape[-1]
-            blocks = a.reshape(n_ways, n_groups * cap, d)
-            out = jax.lax.all_to_all(blocks, ax, split_axis=0,
-                                     concat_axis=0, tiled=True)
-            return out.reshape(-1, d)
-
-        return apply(fn, x, name="global_scatter")
-    raise RuntimeError(
-        "global_scatter with ragged per-expert counts has no static-shape "
-        "XLA lowering; use paddle_tpu.parallel.moe.MoELayer (capacity-"
-        "factor dispatch) or pad counts to a uniform capacity")
+    return _moe_exchange(x, local_count, group, "global_scatter")
 
 
 def global_gather(x, local_count, global_count, group=None,
                   use_calc_stream=True):
     """Inverse of global_scatter (reference moe_utils.py:137): return the
     expert outputs to the cards that sent them. Same TPU contract; at
-    world 1 it is the identity, and with uniform capacity it is the
-    reverse all_to_all."""
-    from ...core.dispatch import apply
-    from ..collective import _axis_for
-
-    ax = _axis_for(group)
-    world = _moe_world(group) if ax is None else None
-    if ax is None and world == 1:
-        # outside any SPMD region, single process: pure reorder
-        return apply(lambda a: a, x, name="global_gather")
-    gc = _concrete_counts(global_count)
-    if ax is not None and gc is not None and len(set(gc.tolist())) == 1:
-        import jax
-
-        from ...parallel.mesh import get_mesh
-
-        n_ways = int(dict(get_mesh().shape).get(ax, 1))
-        cap = int(gc[0])
-        n_groups = max(len(gc) // n_ways, 1)
-
-        def fn(a):
-            d = a.shape[-1]
-            blocks = a.reshape(n_ways, n_groups * cap, d)
-            out = jax.lax.all_to_all(blocks, ax, split_axis=0,
-                                     concat_axis=0, tiled=True)
-            return out.reshape(-1, d)
-
-        return apply(fn, x, name="global_gather")
-    raise RuntimeError(
-        "global_gather with ragged per-expert counts has no static-shape "
-        "XLA lowering; use paddle_tpu.parallel.moe.MoELayer or pad counts "
-        "to a uniform capacity")
+    world 1 it is the identity, and with uniform capacity the same
+    card-major all_to_all (its own inverse for this layout)."""
+    return _moe_exchange(x, global_count, group, "global_gather")
 
 
 __all__ += ["global_scatter", "global_gather"]
+
+
+# public (non-underscore) aliases at the import path the reference
+# docstrings use: paddle.distributed.utils.number_count etc.
+from ...incubate.distributed.models.moe.utils import (  # noqa: E402
+    _assign_pos as assign_pos,
+    _limit_by_capacity as limit_by_capacity,
+    _number_count as number_count,
+    _prune_gate_by_capacity as prune_gate_by_capacity,
+    _random_routing as random_routing,
+)
+
+__all__ += ["number_count", "assign_pos", "limit_by_capacity",
+            "prune_gate_by_capacity", "random_routing"]

@@ -11,5 +11,6 @@ per-token FLOPs are k * cf * expert_cost — independent of num_experts.
 """
 from .gate import BaseGate, NaiveGate, GShardGate, SwitchGate
 from .moe_layer import MoELayer
+from . import utils
 
 __all__ = ["MoELayer", "BaseGate", "NaiveGate", "GShardGate", "SwitchGate"]

@@ -1,0 +1,128 @@
+"""MoE routing utilities (reference:
+python/paddle/distributed/models/moe/utils.py — `_number_count`,
+`_assign_pos`, `_random_routing`, `_limit_by_capacity`,
+`_prune_gate_by_capacity` over the CUDA ops number_count / assign_pos /
+limit_by_capacity / prune_gate_by_capacity / random_routing).
+
+TPU-native formulations: every op is a static-shape jnp scatter/cumsum
+(jit-safe), replacing the reference's hand-CUDA counters. Also exported
+without the underscore at `paddle_tpu.distributed.utils` (the import path
+the reference docstrings use).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .....core.dispatch import apply, unwrap
+from .....core.tensor import Tensor
+
+__all__ = [
+    "_number_count", "_assign_pos", "_random_routing",
+    "_limit_by_capacity", "_prune_gate_by_capacity",
+]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _occurrence_rank(flat):
+    """occ[i] = how many earlier positions hold the same value (the
+    reference kernels' atomic-counter arrival order). O(N log N) via a
+    stable sort: ties keep arrival order, so within each equal-value run
+    the k-th element is the k-th arrival — its rank is its offset from
+    the run's start (searchsorted of the sorted values against
+    themselves). An N x N one-hot formulation would be 4 GB at 64k
+    tokens; this is jit-static and linear in memory."""
+    n = flat.shape[0]
+    order = jnp.argsort(flat, stable=True)                  # [N]
+    sorted_vals = flat[order]
+    run_start = jnp.searchsorted(sorted_vals, sorted_vals, side="left")
+    occ_sorted = jnp.arange(n, dtype=run_start.dtype) - run_start
+    return jnp.zeros((n,), occ_sorted.dtype).at[order].set(occ_sorted)
+
+
+def _number_count(numbers, upper_range):
+    """Per-expert token counts from gate indices (number_count op):
+    out[e] = how many entries of `numbers` equal e, length upper_range."""
+    def fn(nums):
+        flat = nums.reshape(-1)
+        valid = (flat >= 0) & (flat < upper_range)
+        idx = jnp.where(valid, flat, 0)
+        ones = valid.astype(nums.dtype)
+        return jnp.zeros((upper_range,), nums.dtype).at[idx].add(ones)
+
+    out = apply(fn, numbers, name="number_count")
+    out.stop_gradient = True
+    return out
+
+
+def _assign_pos(x, cum_count):
+    """Token indices gathered into expert-sorted slot order (assign_pos
+    op). cum_count is the INCLUSIVE per-expert cumsum of counts; matching
+    the reference kernel, each token is placed by decrementing its
+    expert's cumulative counter, so tokens appear in reverse arrival
+    order within an expert's segment."""
+    def fn(nums, cum):
+        flat = nums.reshape(-1)
+        occ = _occurrence_rank(flat)
+        slots = cum[flat] - 1 - occ
+        total = flat.shape[0]
+        out = jnp.zeros((total,), cum.dtype)
+        return out.at[slots].set(jnp.arange(total, dtype=cum.dtype))
+
+    out = apply(fn, x, cum_count, name="assign_pos")
+    out.stop_gradient = True
+    return out
+
+
+def _random_routing(topk_idx, topk_value, prob, topk=2):
+    """Drop the 2nd expert when its gate weight is too small vs a random
+    draw (random_routing op): out[i][1] = -1 where 2*value[i][1] < prob[i].
+    Only topk=2 exists in the reference."""
+    if topk != 2:
+        raise RuntimeError("only topk=2 is supported now")
+
+    def fn(idx, val, p):
+        drop = topk * val[:, topk - 1] < p
+        col = jnp.where(drop, jnp.asarray(-1, idx.dtype), idx[:, topk - 1])
+        return idx.at[:, topk - 1].set(col)
+
+    out = apply(fn, topk_idx, topk_value, prob, name="random_routing")
+    out.stop_gradient = True
+    return out
+
+
+def _limit_by_capacity(expert_count, capacity, n_worker):
+    """Clamp per-(worker, expert) counts so each expert's TOTAL across
+    workers fits `capacity` (limit_by_capacity op): capacity is consumed
+    greedily in worker order — worker w keeps
+    min(count, capacity_left_after_workers_<w)."""
+    def fn(ec, cap):
+        grid = ec.reshape(n_worker, -1)                     # [W, E]
+        cum = jnp.cumsum(grid, axis=0)
+        allowed = jnp.minimum(cum, cap[None, :].astype(cum.dtype))
+        prev = jnp.concatenate(
+            [jnp.zeros_like(allowed[:1]), allowed[:-1]], axis=0)
+        return (allowed - prev).astype(ec.dtype).reshape(ec.shape)
+
+    out = apply(fn, expert_count, capacity, name="limit_by_capacity")
+    out.stop_gradient = True
+    return out
+
+
+def _prune_gate_by_capacity(gate_idx, expert_count, n_expert, n_worker):
+    """Invalidate (set to -1) gate assignments beyond each expert's
+    remaining budget (prune_gate_by_capacity op): tokens consume
+    expert_count[gate] in arrival order."""
+    def fn(gate, ec):
+        flat = gate.reshape(-1)
+        occ = _occurrence_rank(flat)
+        keep = occ < ec[flat]
+        return jnp.where(keep, flat,
+                         jnp.asarray(-1, gate.dtype)).reshape(gate.shape)
+
+    out = apply(fn, gate_idx, expert_count, name="prune_gate_by_capacity")
+    out.stop_gradient = True
+    return out

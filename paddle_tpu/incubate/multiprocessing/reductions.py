@@ -13,10 +13,12 @@ Lifetime: the PRODUCER owns every segment it created; consumers only
 close their mapping, so a payload can be deserialized any number of
 times (fan-out to N workers, redelivery after a crash). Producer-side
 segments are bounded by an LRU of PTPU_SHM_CACHE_SEGMENTS (default 64):
-beyond that the oldest segment is unlinked — by then its payload has
-long been consumed in any draining queue — and everything left is
-unlinked at interpreter exit (the reference's file_system-strategy
-shape, same staleness tradeoff).
+beyond that the oldest segment is unlinked. A payload older than the
+window that was never delivered therefore fails to rebuild
+(FileNotFoundError) — raise the env var for deep prefetch queues; the
+window never evicts the segment just created. Everything left unlinks at
+interpreter exit (the reference's file_system-strategy shape, same
+staleness tradeoff).
 """
 from __future__ import annotations
 
@@ -34,7 +36,9 @@ _PRODUCED: "OrderedDict[str, object]" = OrderedDict()
 
 
 def _max_segments():
-    return int(os.environ.get("PTPU_SHM_CACHE_SEGMENTS", "64"))
+    # clamp to >= 1: eviction must never reclaim the segment just created
+    # for the payload being serialized
+    return max(1, int(os.environ.get("PTPU_SHM_CACHE_SEGMENTS", "64")))
 
 
 def _cleanup_produced():
@@ -87,7 +91,10 @@ def _reduce_tensor(tensor):
         dst[...] = arr
         _PRODUCED[shm.name] = shm  # alive until LRU eviction/atexit unlink
         while len(_PRODUCED) > _max_segments():
-            _, old = _PRODUCED.popitem(last=False)
+            name, old = next(iter(_PRODUCED.items()))
+            if name == shm.name:       # never evict the payload being built
+                break
+            _PRODUCED.pop(name)
             try:
                 old.close()
                 old.unlink()

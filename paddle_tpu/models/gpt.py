@@ -874,10 +874,14 @@ class GPTForCausalLM(Layer):
                     return st
 
             i0 = jnp.asarray(0, jnp.int32)
-            i, _, _, _, _, toks = jax.lax.while_loop(
+            i, _, caches, _, _, toks = jax.lax.while_loop(
                 cond_fn, body_fn,
                 (i0, logits, caches, key, finished0, toks0))
-            return i, toks
+            # caches ride out as outputs ONLY so donate_argnums=(3,) has
+            # something to alias: unmatched donations are "not usable"
+            # (jax warns) and XLA then copies every cache at entry instead
+            # of mutating the donated buffers in place
+            return i, toks, caches
 
         # executable cache: sampling params AND the step-unroll factor are
         # baked into the decode trace
@@ -898,7 +902,7 @@ class GPTForCausalLM(Layer):
                 else _rng.next_key()) if do_sample
                else jax.random.PRNGKey(0))
 
-        n, toks = gen_step(params, bufs, ids, cache_arrs, key)
+        n, toks, _ = gen_step(params, bufs, ids, cache_arrs, key)
         n = int(n)
 
         if was_training:

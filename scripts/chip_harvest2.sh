@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Second-wave harvest: what the first harvest could not finish before the
+# tunnel wedged (04:14 UTC) — decode XLA-vs-Pallas + unroll sweep, the
+# resnet50 profile (ladder showed 0.24 vs_baseline), the train profile,
+# and the 1.3B line that died on a remote_compile hiccup.
+#   nohup scripts/chip_harvest2.sh > /tmp/harvest/driver2.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p /tmp/harvest
+
+probe() {
+  timeout 90 python -c "import jax, jax.numpy as jnp; assert jax.devices()[0].platform in ('tpu','axon'); jnp.ones(8).sum().block_until_ready()" >/dev/null 2>&1
+}
+
+echo "$(date -u) waiting for chip..."
+until probe; do
+  sleep 240
+done
+echo "$(date -u) chip is up — harvesting (wave 2)"
+
+run() {  # run <name> <timeout-seconds> <cmd...>
+  local name="$1" to="$2"; shift 2
+  echo "$(date -u) == $name"
+  timeout "$to" "$@" > "/tmp/harvest/$name.log" 2>&1
+  echo "$(date -u) == $name rc=$?"
+}
+
+run gpt3_1p3b      1800 python bench.py --config gpt3_1p3b
+bash scripts/decode_experiments.sh
+run profile_resnet 1200 python scripts/profile_resnet.py
+run profile_train2 1200 python scripts/profile_train.py
+echo "$(date -u) wave-2 harvest complete"

@@ -38,7 +38,7 @@ def test_example_legacy_reader_pipeline():
 @pytest.mark.slow
 @pytest.mark.parametrize("name", [
     "train_lenet_mnist.py", "train_gpt_hybrid.py", "generate_gpt.py",
-    "train_moe.py", "static_graph_training.py",
+    "train_moe.py", "static_graph_training.py", "amp_training.py",
 ])
 def test_example_heavy(name):
     assert "OK" in _run(name)
