@@ -18,31 +18,42 @@ __all__ = ["seed", "next_key", "get_state", "set_state", "key_scope"]
 
 class _RngState(threading.local):
     def __init__(self):
-        self.stack = [jax.random.PRNGKey(0)]
+        # created on FIRST USE, not at import: PRNGKey(0) materializes a
+        # device array, which initializes the XLA backend — and
+        # jax.distributed.initialize (multi-host bring-up) must run before
+        # any backend init. `import paddle_tpu` has to stay backend-free.
+        self.stack = None
 
 
 _state = _RngState()
 
 
+def _stack():
+    if _state.stack is None:
+        _state.stack = [jax.random.PRNGKey(0)]
+    return _state.stack
+
+
 def seed(s: int):
     """paddle.seed equivalent: reset the root key."""
-    _state.stack[-1] = jax.random.PRNGKey(int(s))
+    _stack()[-1] = jax.random.PRNGKey(int(s))
     return s
 
 
 def next_key():
-    cur = _state.stack[-1]
+    st = _stack()
+    cur = st[-1]
     new, sub = jax.random.split(cur)
-    _state.stack[-1] = new
+    st[-1] = new
     return sub
 
 
 def get_state():
-    return _state.stack[-1]
+    return _stack()[-1]
 
 
 def set_state(key):
-    _state.stack[-1] = key
+    _stack()[-1] = key
 
 
 class key_scope:
@@ -53,9 +64,9 @@ class key_scope:
         self._key = key
 
     def __enter__(self):
-        _state.stack.append(self._key)
+        _stack().append(self._key)
         return self
 
     def __exit__(self, *exc):
-        _state.stack.pop()
+        _stack().pop()
         return False

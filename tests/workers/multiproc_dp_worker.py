@@ -1,0 +1,57 @@
+"""Worker for test_multiprocess_dp: one PROCESS per mesh slot (the
+multi-host DCN shape — reference analog: test_dist_base.py trainer
+subprocesses over NCCL; here jax.distributed + gloo over localhost).
+
+Run with PADDLE_MASTER / PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ID set;
+prints per-step losses and the final weight checksum for the runner to
+compare across ranks and against the single-process run.
+"""
+import os
+import sys
+
+os.environ["PTPU_FORCE_PLATFORM"] = "cpu"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import jit, nn, optimizer, parallel
+
+
+def main():
+    dist.init_parallel_env()
+    nproc = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    assert jax.device_count() == nproc
+
+    parallel.init_mesh(dp=nproc)
+    paddle.seed(0)
+    model = parallel.place_model(nn.Linear(8, 4))
+    opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+
+    def step(x, y):
+        loss = ((model(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    compiled = jit.compile(step, models=[model], optimizers=[opt])
+
+    rng = np.random.RandomState(0)      # same GLOBAL batch on every rank
+    X = rng.randn(16, 8).astype("float32")
+    Y = rng.randn(16, 4).astype("float32")
+    losses = [float(compiled(paddle.to_tensor(X),
+                             paddle.to_tensor(Y)).numpy())
+              for _ in range(5)]
+    w = np.asarray(model.weight.numpy(), np.float64)
+    print("LOSSES", " ".join(f"{v:.8f}" for v in losses), flush=True)
+    print(f"WSUM {w.sum():.8f}", flush=True)
+    print("WORKER_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
