@@ -18,8 +18,9 @@ import pytest
 
 pytestmark = pytest.mark.slow
 
-_WORKER = pathlib.Path(__file__).resolve().parent / "workers" / \
-    "multiproc_dp_worker.py"
+_WORKERS = pathlib.Path(__file__).resolve().parent / "workers"
+_WORKER = _WORKERS / "multiproc_dp_worker.py"
+_HYBRID_WORKER = _WORKERS / "multiproc_hybrid_worker.py"
 
 
 def _free_port():
@@ -32,7 +33,7 @@ def _free_port():
     return port
 
 
-def _run_workers(nproc):
+def _run_workers(nproc, worker=None):
     port = _free_port()
     procs = []
     for rank in range(nproc):
@@ -43,7 +44,7 @@ def _run_workers(nproc):
                    PADDLE_TRAINER_ID=str(rank),
                    PTPU_FORCE_PLATFORM="cpu")
         procs.append(subprocess.Popen(
-            [sys.executable, str(_WORKER)], env=env,
+            [sys.executable, str(worker or _WORKER)], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
     outs = []
     try:
@@ -62,14 +63,20 @@ def _run_workers(nproc):
     return outs
 
 
-def _parse(out):
-    losses = wsum = None
+def _parse_losses(out):
     for line in out.splitlines():
         if line.startswith("LOSSES"):
-            losses = [float(v) for v in line.split()[1:]]
+            return [float(v) for v in line.split()[1:]]
+    raise AssertionError(out[-1500:])
+
+
+def _parse(out):
+    losses = _parse_losses(out)
+    wsum = None
+    for line in out.splitlines():
         if line.startswith("WSUM"):
             wsum = float(line.split()[1])
-    assert losses and wsum is not None, out[-1500:]
+    assert wsum is not None, out[-1500:]
     return losses, wsum
 
 
@@ -107,3 +114,21 @@ def test_import_does_not_init_backend():
                           cwd=str(_WORKER.parent.parent.parent))
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "IMPORT_CLEAN" in proc.stdout
+
+
+def test_two_process_hybrid_gpt():
+    """dp across the process boundary x mp=4 inside each process: the
+    multi-host hybrid topology. Loss trajectory must match (to collective
+    reduction-order noise) the same dp2xmp4 mesh on 8 single-process
+    devices — covered by tests/test_models.py parity suites."""
+    ranks = [_parse_losses(o) for o in _run_workers(2, worker=_HYBRID_WORKER)]
+    assert ranks[0] == ranks[1]
+    # monotone improvement on 3 steps of the tiny GPT
+    assert ranks[0][-1] < ranks[0][0]
+    # single-process baseline through the SAME runner (init_parallel_env
+    # skips jax.distributed at nproc=1): the worker pins 4 local devices,
+    # so this is dp1xmp4 — parity across a DIFFERENT dp split of the same
+    # global batch is the stronger check
+    base = _parse_losses(_run_workers(1, worker=_HYBRID_WORKER)[0])
+    for a, b in zip(ranks[0], base):
+        assert abs(a - b) < 1e-5, (ranks[0], base)

@@ -1,0 +1,57 @@
+"""Worker for test_multiprocess_dp::test_two_process_hybrid_gpt: dp over
+the PROCESS boundary (the DCN axis) x mp within each process's 4 virtual
+devices — the multi-host hybrid topology (reference analog: fleet
+hybrid-parallel over NCCL across hosts; here jax.distributed + gloo).
+"""
+import os
+import sys
+
+os.environ["PTPU_FORCE_PLATFORM"] = "cpu"
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import jit, optimizer, parallel
+from paddle_tpu.models import (GPTForCausalLM, GPTPretrainingCriterion,
+                               gpt_test_config)
+
+
+def main():
+    dist.init_parallel_env()
+    nproc = int(os.environ["PADDLE_TRAINERS_NUM"])
+    assert jax.device_count() == 4 * nproc
+
+    parallel.init_mesh(dp=nproc, mp=4)
+    paddle.seed(0)
+    cfg = gpt_test_config(num_hidden_layers=2, stacked_blocks=True)
+    model = parallel.place_model(GPTForCausalLM(cfg))
+    crit = GPTPretrainingCriterion(cfg)
+    opt = optimizer.AdamW(learning_rate=1e-3,
+                          parameters=model.parameters())
+
+    def step(x, y):
+        loss = crit(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    compiled = jit.compile(step, models=[model], optimizers=[opt])
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (8, 16)).astype("int32"))
+    lab = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (8, 16)).astype("int32"))
+    losses = [float(compiled(ids, lab).numpy()) for _ in range(3)]
+    print("LOSSES", " ".join(f"{v:.8f}" for v in losses), flush=True)
+    print("WORKER_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
