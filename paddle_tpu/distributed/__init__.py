@@ -33,6 +33,7 @@ from .parallel_env import (
 from . import fleet
 from . import metric
 from . import models
+from . import communication
 from . import stream
 from . import checkpoint
 from . import sharding

@@ -1,0 +1,40 @@
+"""paddle.distributed.communication parity (reference:
+python/paddle/distributed/communication/__init__.py — per-op modules,
+with the functions re-exported at package level in the same order).
+
+The implementations live in paddle_tpu.distributed.collective (dual-mode
+collectives: SPMD axis inside shard regions, process world outside),
+compat (eager object-list / p2p shims) and stream (task-returning
+variants); these modules are the reference's import layout over them.
+"""
+from .all_gather import all_gather, all_gather_object
+from .all_reduce import all_reduce
+from .broadcast import broadcast, broadcast_object_list
+from .reduce import reduce, ReduceOp
+from .send import send, isend
+from .recv import recv, irecv
+from .scatter import scatter, scatter_object_list
+from .batch_isend_irecv import batch_isend_irecv, P2POp
+from .reduce_scatter import reduce_scatter
+from .all_to_all import all_to_all, alltoall, alltoall_single
+from .group import (
+    is_initialized,
+    destroy_process_group,
+    get_group,
+    wait,
+    barrier,
+    get_backend,
+)
+from ..collective import new_group
+from . import group
+from .. import stream
+
+__all__ = [
+    "P2POp", "ReduceOp", "all_gather", "all_gather_object", "all_reduce",
+    "all_to_all", "alltoall", "alltoall_single", "barrier",
+    "batch_isend_irecv", "broadcast", "broadcast_object_list",
+    "destroy_process_group", "get_backend", "get_group", "group", "irecv",
+    "is_initialized", "isend", "new_group", "recv", "reduce",
+    "reduce_scatter", "scatter", "scatter_object_list", "send", "stream",
+    "wait",
+]

@@ -1,0 +1,4 @@
+"""communication.all_gather (reference layout)."""
+from ..collective import all_gather, all_gather_object
+
+__all__ = ["all_gather", "all_gather_object"]

@@ -1,0 +1,5 @@
+"""communication.all_reduce module layout (reference:
+python/paddle/distributed/communication/all_reduce.py)."""
+from ..collective import all_reduce
+
+__all__ = ["all_reduce"]

@@ -1,0 +1,5 @@
+"""communication.all_to_all module layout (reference:
+python/paddle/distributed/communication/all_to_all.py)."""
+from ..collective import all_to_all, alltoall, alltoall_single
+
+__all__ = ["all_to_all", "alltoall", "alltoall_single"]

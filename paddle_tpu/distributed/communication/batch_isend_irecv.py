@@ -1,0 +1,32 @@
+"""communication.batch_isend_irecv (reference:
+python/paddle/distributed/communication/batch_isend_irecv.py — P2POp
+descriptors executed as one batch).
+
+TPU-native: point-to-point pairs inside SPMD regions are ppermute
+patterns; outside they fall back to the eager send/recv compat shims.
+A P2POp batch executes its ops in order.
+"""
+from ..compat import irecv, isend
+
+__all__ = ["P2POp", "batch_isend_irecv"]
+
+
+class P2POp:
+    """One pending send/recv (reference signature: (op, tensor, peer,
+    group))."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        if op not in (isend, irecv) and getattr(op, "__name__", "") not in (
+                "isend", "irecv"):
+            raise ValueError("op must be paddle.distributed.isend/irecv")
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Run the batch; returns the per-op tasks (reference returns a list
+    of async tasks)."""
+    return [op.op(op.tensor, op.peer, group=op.group)
+            for op in p2p_op_list]

@@ -1,0 +1,5 @@
+"""communication.broadcast (reference layout)."""
+from ..collective import broadcast
+from ..compat import broadcast_object_list
+
+__all__ = ["broadcast", "broadcast_object_list"]

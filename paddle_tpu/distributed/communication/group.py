@@ -1,0 +1,9 @@
+"""communication.group module layout (reference:
+python/paddle/distributed/communication/group.py)."""
+from ..collective import Group, barrier, get_backend, get_group, new_group, wait
+from ..parallel_env import (destroy_process_group, get_rank,
+                            get_world_size, is_initialized)
+
+__all__ = ["Group", "barrier", "destroy_process_group", "get_backend", "get_group",
+           "get_rank", "get_world_size", "is_initialized", "new_group",
+           "wait"]

@@ -1,0 +1,5 @@
+"""communication.recv (reference layout)."""
+from ..collective import recv
+from ..compat import irecv
+
+__all__ = ["recv", "irecv"]

@@ -1,0 +1,4 @@
+"""communication.reduce (reference layout)."""
+from ..collective import ReduceOp, reduce
+
+__all__ = ["reduce", "ReduceOp"]

@@ -1,0 +1,5 @@
+"""communication.reduce_scatter module layout (reference:
+python/paddle/distributed/communication/reduce_scatter.py)."""
+from ..collective import reduce_scatter
+
+__all__ = ["reduce_scatter"]

@@ -1,0 +1,5 @@
+"""communication.scatter (reference layout)."""
+from ..collective import scatter
+from ..compat import scatter_object_list
+
+__all__ = ["scatter", "scatter_object_list"]

@@ -1,0 +1,5 @@
+"""communication.send (reference layout)."""
+from ..collective import send
+from ..compat import isend
+
+__all__ = ["send", "isend"]

@@ -128,3 +128,34 @@ def test_incubate_autotune_set_config(tmp_path):
     cfg = tmp_path / "tune.json"
     cfg.write_text('{"kernel": {"enable": true}}')
     autotune.set_config(str(cfg))
+
+
+def test_communication_package_layout():
+    """paddle.distributed.communication import layout (reference:
+    distributed/communication/__init__.py + per-op modules): both the
+    package-level functions and the reference's deep module imports
+    resolve."""
+    from paddle_tpu.distributed import communication as comm
+
+    for name in ("all_reduce", "all_gather", "broadcast", "reduce",
+                 "scatter", "send", "recv", "reduce_scatter", "alltoall",
+                 "batch_isend_irecv", "barrier", "wait"):
+        assert callable(getattr(comm, name)), name
+    from paddle_tpu.distributed.communication.group import (
+        Group, get_backend, is_initialized)
+    from paddle_tpu.distributed.communication.all_reduce import all_reduce
+    from paddle_tpu.distributed.communication.batch_isend_irecv import (
+        P2POp, batch_isend_irecv)
+    from paddle_tpu.distributed.communication.reduce import ReduceOp
+    assert callable(all_reduce) and callable(batch_isend_irecv)
+    assert hasattr(ReduceOp, "SUM")
+
+    # P2POp validates its op and batch executes in order (world-1: the
+    # compat isend/irecv identity semantics)
+    import paddle_tpu.distributed as dist
+
+    t = paddle.to_tensor(np.ones((2, 2), "float32"))
+    ops = [P2POp(dist.compat.isend, t, 0), P2POp(dist.compat.irecv, t, 0)]
+    batch_isend_irecv(ops)
+    with pytest.raises(ValueError):
+        P2POp(print, t, 0)
