@@ -222,7 +222,7 @@ def bench_resnet50():
     from paddle_tpu.vision.models import resnet50
 
     on_tpu = _on_tpu()
-    batch = 64 if on_tpu else 2
+    batch = int(os.environ.get("PTPU_RESNET_BENCH_BATCH", 64 if on_tpu else 2))
     size = 224 if on_tpu else 32
     paddle.seed(0)
     parallel.init_mesh()
@@ -268,6 +268,11 @@ def bench_decode():
            else gpt_test_config(num_hidden_layers=2, stacked_blocks=True,
                                 max_position_embeddings=64))
     batch, prompt, new = (8, 128, 128) if on_tpu else (2, 8, 8)
+    # long-context A/B knobs (decode_experiments.sh): prompt length sets
+    # S_max, where the prefix-reading Pallas kernel should separate from
+    # the XLA full-cache path
+    prompt = int(os.environ.get("PTPU_DECODE_BENCH_PROMPT", prompt))
+    new = int(os.environ.get("PTPU_DECODE_BENCH_NEW", new))
     paddle.seed(0)
     parallel.init_mesh()
     model = parallel.place_model(GPTForCausalLM(cfg))

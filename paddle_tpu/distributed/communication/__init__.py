@@ -27,7 +27,7 @@ from .group import (
 )
 from ..collective import new_group
 from . import group
-from .. import stream
+from . import stream
 
 __all__ = [
     "P2POp", "ReduceOp", "all_gather", "all_gather_object", "all_reduce",

@@ -1,0 +1,8 @@
+"""communication.stream module layout (reference:
+python/paddle/distributed/communication/stream/ — task-returning
+collective variants on a chosen stream). The implementation is
+paddle_tpu.distributed.stream; this module makes the deep import path
+`paddle.distributed.communication.stream` resolve.
+"""
+from ..stream import *  # noqa: F401,F403
+from ..stream import __all__  # noqa: F401

@@ -30,3 +30,10 @@ bash scripts/decode_experiments.sh
 run profile_resnet 1200 python scripts/profile_resnet.py
 run profile_train2 1200 python scripts/profile_train.py
 echo "$(date -u) wave-2 harvest complete"
+
+# resnet batch sweep: conv MFU vs batch (the 0.24 line used batch 64)
+for b in 128 256; do
+  run "resnet_b$b" 1200 env PTPU_RESNET_BENCH_BATCH="$b" \
+    python bench.py --config resnet50
+done
+echo "$(date -u) resnet sweep complete"

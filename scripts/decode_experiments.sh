@@ -36,4 +36,12 @@ for u in 2 4; do
   run "decode_unroll$u" 900 env PTPU_DECODE_STEP_UNROLL="$u" \
     python bench.py --config gpt124m_decode
 done
+
+# long context (S_max 1024+128): the Pallas kernel reads only the valid
+# prefix while the XLA path masks all S_max rows — the regime where the
+# kernel should win even if XLA leads at S_max=256
+run decode_long_xla 900 env PTPU_FLASH_DECODE=0 PTPU_DECODE_BENCH_PROMPT=896 \
+  python bench.py --config gpt124m_decode
+run decode_long_pallas 900 env PTPU_FLASH_DECODE=1 PTPU_DECODE_BENCH_PROMPT=896 \
+  python bench.py --config gpt124m_decode
 echo "$(date -u) decode experiments complete"
