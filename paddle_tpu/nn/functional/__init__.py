@@ -313,7 +313,17 @@ def _conv_nd(x, weight, bias, stride, padding, dilation, groups, nd, data_format
                     (k_eff[i] - 1 - pad[i][0], k_eff[i] - 1 - pad[i][1] + opad[i])
                     for i in range(nd)
                 ]
-            wt = jnp.swapaxes(w, 0, 1)  # I O ... for transpose
+            if groups > 1:
+                # w is [cin, cout/g, k...]; the equivalent forward conv
+                # needs [cout, cin/g, k...] with the swap done PER GROUP
+                # (a plain swapaxes mixes channels across groups and
+                # trips conv_general_dilated's feature-count check)
+                ci, cog = w.shape[0], w.shape[1]
+                wt = w.reshape((groups, ci // groups, cog) + w.shape[2:])
+                wt = jnp.swapaxes(wt, 1, 2).reshape(
+                    (groups * cog, ci // groups) + w.shape[2:])
+            else:
+                wt = jnp.swapaxes(w, 0, 1)  # I O ... for transpose
             wt = jnp.flip(wt, axis=tuple(range(2, 2 + nd)))
             out = jax.lax.conv_general_dilated(
                 a,
@@ -381,7 +391,17 @@ def _pool_nd(x, kernel, stride, padding, nd, op, data_format, ceil_mode=False, e
     pad = _conv_padding(padding, nd)
     channel_last = data_format in ("NHWC", "NLC", "NDHWC")
     if isinstance(pad, str):
-        pad = [(0, 0)] * nd if pad == "VALID" else pad
+        if pad == "VALID":
+            pad = [(0, 0)] * nd
+        else:  # "SAME": resolve numerically so every downstream branch
+               # (ceil extras, inclusive divisors) sees explicit pairs
+            spatial_d = x.shape[1:-1] if channel_last else x.shape[2:]
+            pad = []
+            for i in range(nd):
+                total = max((-(-spatial_d[i] // st[i]) - 1) * st[i]
+                            + ks[i] - spatial_d[i], 0)
+                pad.append((total // 2, total - total // 2))
+    pad_base = list(pad)  # pre-ceil pads
     if ceil_mode and not isinstance(pad, str):
         spatial = x.shape[1:-1] if channel_last else x.shape[2:]
         pad = [
@@ -391,11 +411,11 @@ def _pool_nd(x, kernel, stride, padding, nd, op, data_format, ceil_mode=False, e
     if channel_last:
         window = (1,) + ks + (1,)
         strides = (1,) + st + (1,)
-        pads = pad if isinstance(pad, str) else [(0, 0)] + list(pad) + [(0, 0)]
+        pads = [(0, 0)] + list(pad) + [(0, 0)]
     else:
         window = (1, 1) + ks
         strides = (1, 1) + st
-        pads = pad if isinstance(pad, str) else [(0, 0), (0, 0)] + list(pad)
+        pads = [(0, 0), (0, 0)] + list(pad)
 
     def fn(a):
         if op == "max":
@@ -404,10 +424,35 @@ def _pool_nd(x, kernel, stride, padding, nd, op, data_format, ceil_mode=False, e
         # avg
         s = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, strides, pads)
         if not exclusive and not ceil_mode:
+            # every window's padded extent is exactly k (PoolOutputSize
+            # guarantees hstart+k <= H+pad for floor-mode windows)
             return s / float(np.prod(ks))
-        ones = jnp.ones_like(a)
-        cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
-        return s / cnt
+        if exclusive:
+            ones = jnp.ones_like(a)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                        strides, pads)
+            # a ceil window fully inside padding has zero valid elements;
+            # the reference divides 0 by a non-positive extent yielding
+            # +-0 — clamp to keep the same finite value without the NaN
+            return s / jnp.maximum(cnt, 1.0)
+        # inclusive + ceil: reference pooling.cc:84 — the divisor is the
+        # window clipped to input + ORIGINAL pad on the high side (left
+        # pad rows count, the ceil extra does not). Static per-axis
+        # extents broadcast-multiplied.
+        spatial = a.shape[1:-1] if channel_last else a.shape[2:]
+        div = None
+        for i in range(nd):
+            lo, hi = pad[i]
+            hi0 = pad_base[i][1]              # pre-ceil high pad
+            out_i = (spatial[i] + lo + hi - ks[i]) // st[i] + 1
+            starts = np.arange(out_i) * st[i] - lo
+            ends = np.minimum(starts + ks[i], spatial[i] + hi0)
+            ext = np.maximum((ends - starts).astype(np.float32), 1.0)
+            shape = [1] * a.ndim
+            shape[(1 if channel_last else 2) + i] = out_i
+            e = jnp.asarray(ext).reshape(shape)
+            div = e if div is None else div * e
+        return s / div
 
     return apply(fn, x, name=f"{op}_pool{nd}d")
 
@@ -482,37 +527,40 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusiv
     return _pool_nd(x, kernel_size, stride, padding, 3, "avg", data_format, ceil_mode=ceil_mode, exclusive=exclusive)
 
 
+def _adaptive_pool_core(a, out_sizes, op, spatial_start=2):
+    """Pure-array adaptive pooling (shared by the adaptive_*_pool ops and
+    interpolate's 'area' mode): per-axis reshape-reduce when divisible,
+    else explicit [floor(j*n/os), ceil((j+1)*n/os)) window gather."""
+    out = a
+    for i, os in enumerate(out_sizes):
+        ax = spatial_start + i
+        n = out.shape[ax]
+        if os is None:
+            continue
+        if n % os == 0:
+            k = n // os
+            new_shape = out.shape[:ax] + (os, k) + out.shape[ax + 1:]
+            r = out.reshape(new_shape)
+            out = jnp.max(r, axis=ax + 1) if op == "max" else jnp.mean(r, axis=ax + 1)
+        else:
+            idx = [
+                (int(math.floor(j * n / os)), int(math.ceil((j + 1) * n / os)))
+                for j in range(os)
+            ]
+            slices = []
+            for lo, hi in idx:
+                sl = jax.lax.slice_in_dim(out, lo, hi, axis=ax)
+                red = jnp.max(sl, axis=ax, keepdims=True) if op == "max" else jnp.mean(sl, axis=ax, keepdims=True)
+                slices.append(red)
+            out = jnp.concatenate(slices, axis=ax)
+    return out
+
+
 def _adaptive_pool(x, output_size, nd, op, data_format):
     out_sizes = _tuplize(output_size, nd)
 
     def fn(a):
-        spatial_start = 2
-        out = a
-        # successive per-axis adaptive pooling via reshape-mean/max when divisible,
-        # else explicit window gather
-        for i, os in enumerate(out_sizes):
-            ax = spatial_start + i
-            n = out.shape[ax]
-            if os is None:
-                continue
-            if n % os == 0:
-                k = n // os
-                new_shape = out.shape[:ax] + (os, k) + out.shape[ax + 1:]
-                r = out.reshape(new_shape)
-                out = jnp.max(r, axis=ax + 1) if op == "max" else jnp.mean(r, axis=ax + 1)
-            else:
-                # general case: average over [floor(i*n/os), ceil((i+1)*n/os))
-                idx = [
-                    (int(math.floor(j * n / os)), int(math.ceil((j + 1) * n / os)))
-                    for j in range(os)
-                ]
-                slices = []
-                for lo, hi in idx:
-                    sl = jax.lax.slice_in_dim(out, lo, hi, axis=ax)
-                    red = jnp.max(sl, axis=ax, keepdims=True) if op == "max" else jnp.mean(sl, axis=ax, keepdims=True)
-                    slices.append(red)
-                out = jnp.concatenate(slices, axis=ax)
-        return out
+        return _adaptive_pool_core(a, out_sizes, op)
 
     return apply(fn, x, name=f"adaptive_{op}_pool{nd}d")
 
@@ -1210,45 +1258,95 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
     return apply(fn, x, name="pad")
 
 
+def _resize_positions(ins, outs, align_corners, align_mode):
+    """Source sampling positions per output index (reference
+    interp_kernels' coordinate transforms): corner-aligned
+    i*(in-1)/(out-1); else align_mode 0 = half-pixel (i+0.5)*scale-0.5,
+    align_mode 1 = i*scale."""
+    if align_corners:
+        if outs == 1:
+            return jnp.zeros((1,), jnp.float32)
+        return jnp.arange(outs, dtype=jnp.float32) * ((ins - 1) / (outs - 1))
+    scale = ins / outs
+    if align_mode == 1:
+        return jnp.arange(outs, dtype=jnp.float32) * scale
+    pos = (jnp.arange(outs, dtype=jnp.float32) + 0.5) * scale - 0.5
+    return jnp.maximum(pos, 0.0)
+
+
+def _resize_axis_linear(a, ax, outs, align_corners, align_mode):
+    ins = a.shape[ax]
+    pos = _resize_positions(ins, outs, align_corners, align_mode)
+    lo = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, ins - 1)
+    hi = jnp.minimum(lo + 1, ins - 1)
+    w = (pos - lo.astype(jnp.float32)).astype(a.dtype)
+    shape = [1] * a.ndim
+    shape[ax] = outs
+    wb = w.reshape(shape)
+    return (jnp.take(a, lo, axis=ax) * (1 - wb)
+            + jnp.take(a, hi, axis=ax) * wb)
+
+
+def _resize_axis_cubic(a, ax, outs, align_corners):
+    """4-tap Keys cubic, A=-0.75 (reference bicubic_interp kernel — the
+    same coefficient as the CUDA `cubic_convolution` helpers), taps
+    edge-clamped, NO antialiasing on downscale (jax.image.resize's cubic
+    antialiases, which the reference op does not)."""
+    ins = a.shape[ax]
+    pos = _resize_positions(ins, outs, align_corners, 0)
+    if not align_corners:
+        # cubic keeps the raw half-pixel position (may be < 0 at i=0)
+        pos = (jnp.arange(outs, dtype=jnp.float32) + 0.5) * (ins / outs) - 0.5
+    i0 = jnp.floor(pos).astype(jnp.int32)
+    t = (pos - i0.astype(jnp.float32))
+    A = -0.75
+
+    def k1(tt):     # |t| <= 1
+        return ((A + 2.0) * tt - (A + 3.0)) * tt * tt + 1.0
+
+    def k2(tt):     # 1 < |t| < 2
+        return ((A * tt - 5.0 * A) * tt + 8.0 * A) * tt - 4.0 * A
+
+    weights = [k2(t + 1.0), k1(t), k1(1.0 - t), k2(2.0 - t)]
+    shape = [1] * a.ndim
+    shape[ax] = outs
+    out = None
+    for off, w in zip((-1, 0, 1, 2), weights):
+        idx = jnp.clip(i0 + off, 0, ins - 1)
+        term = jnp.take(a, idx, axis=ax) * w.reshape(shape).astype(a.dtype)
+        out = term if out is None else out + term
+    return out
+
+
 def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    channel_last = data_format in ("NHWC", "NWC", "NLC", "NDHWC")
+    ax0 = 1 if channel_last else 2           # first spatial axis
+
     def fn(a):
-        n, c = a.shape[0], a.shape[1]
-        in_spatial = a.shape[2:]
+        in_spatial = (a.shape[1:-1] if channel_last else a.shape[2:])
         if size is not None:
             out_spatial = tuple(int(s) for s in (size if isinstance(size, (list, tuple)) else [size]))
         else:
             sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * len(in_spatial)
             out_spatial = tuple(int(s * f) for s, f in zip(in_spatial, sf))
-        meth = {"nearest": "nearest", "bilinear": "linear", "trilinear": "linear", "linear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
-        if meth == "nearest":
-            # index-based nearest (matches paddle's floor behavior)
-            out = a
-            for i, (ins, outs) in enumerate(zip(in_spatial, out_spatial)):
-                ax = 2 + i
+        if mode == "area":
+            # reference: 'area' is adaptive average pooling — the shared
+            # pure core (spatial axes start at 1 for channel-last)
+            return _adaptive_pool_core(a, out_spatial, "avg",
+                                       spatial_start=ax0)
+        out = a
+        for i, (ins, outs) in enumerate(zip(in_spatial, out_spatial)):
+            ax = ax0 + i
+            if mode == "nearest":
+                # index-based nearest (paddle's floor behavior)
                 idx = jnp.floor(jnp.arange(outs) * (ins / outs)).astype(jnp.int32)
                 out = jnp.take(out, idx, axis=ax)
-            return out
-        if meth == "linear" and align_corners:
-            # jax.image.resize is half-pixel (align_corners=False) only;
-            # corner-aligned sampling is a separable per-axis gather+lerp
-            # at positions i*(in-1)/(out-1) (interpolate_op align semantics)
-            out = a
-            for i, (ins, outs) in enumerate(zip(in_spatial, out_spatial)):
-                ax = 2 + i
-                if ins == 1 or outs == 1:
-                    out = jnp.take(out, jnp.zeros((outs,), jnp.int32), axis=ax)
-                    continue
-                pos = jnp.arange(outs) * ((ins - 1) / (outs - 1))
-                lo = jnp.floor(pos).astype(jnp.int32)
-                hi = jnp.minimum(lo + 1, ins - 1)
-                w = (pos - lo).astype(a.dtype)
-                shape = [1] * a.ndim
-                shape[ax] = outs
-                wb = w.reshape(shape)
-                out = (jnp.take(out, lo, axis=ax) * (1 - wb)
-                       + jnp.take(out, hi, axis=ax) * wb)
-            return out
-        return jax.image.resize(a, (n, c) + out_spatial, method=meth)
+            elif mode == "bicubic":
+                out = _resize_axis_cubic(out, ax, outs, align_corners)
+            else:  # linear / bilinear / trilinear
+                out = _resize_axis_linear(out, ax, outs, align_corners,
+                                          align_mode)
+        return out
 
     return apply(fn, x, name="interpolate")
 

@@ -1,0 +1,232 @@
+"""Differential fuzzing vs torch-CPU as a second oracle (the reference's
+OpTest strategy — numpy oracles + FD grad checks — extended with an
+independent framework oracle for the geometry-heavy ops where a hand
+-written numpy reference is itself the likeliest thing to be wrong:
+conv/conv_transpose padding/dilation/groups, pooling ceil/exclusive
+modes, interpolate align semantics, grid_sample corners).
+
+Fixed seeds, bounded case counts; forward parity everywhere plus
+gradient parity on the conv cases (torch autograd vs our tape).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as tF  # noqa: E402
+
+pytestmark = pytest.mark.slow
+
+
+def _t(a):
+    return paddle.to_tensor(np.ascontiguousarray(a))
+
+
+def _close(ours, theirs, rtol=2e-4, atol=2e-5, tag=""):
+    np.testing.assert_allclose(
+        np.asarray(ours.numpy() if hasattr(ours, "numpy") else ours,
+                   np.float32),
+        theirs.detach().numpy(), rtol=rtol, atol=atol, err_msg=tag)
+
+
+def test_conv2d_fuzz_vs_torch():
+    rng = np.random.RandomState(0)
+    for case in range(12):
+        cin = int(rng.choice([1, 3, 4, 8]))
+        groups = int(rng.choice([g for g in (1, 2, 4) if cin % g == 0]))
+        cout = groups * int(rng.randint(1, 4))
+        k = int(rng.choice([1, 2, 3, 5]))
+        stride = int(rng.randint(1, 3))
+        pad = int(rng.randint(0, k))
+        dil = int(rng.choice([1, 2]))
+        h = int(rng.randint(k * dil + 1, 14))
+        x = rng.randn(2, cin, h, h).astype("float32")
+        w = rng.randn(cout, cin // groups, k, k).astype("float32")
+        b = rng.randn(cout).astype("float32")
+        tag = f"case{case}: cin{cin} g{groups} k{k} s{stride} p{pad} d{dil}"
+
+        xt = torch.tensor(x, requires_grad=True)
+        wt = torch.tensor(w, requires_grad=True)
+        ref = tF.conv2d(xt, wt, torch.tensor(b), stride=stride,
+                        padding=pad, dilation=dil, groups=groups)
+        xp, wp = _t(x), _t(w)
+        xp.stop_gradient = False
+        wp.stop_gradient = False
+        out = F.conv2d(xp, wp, _t(b), stride=stride, padding=pad,
+                       dilation=dil, groups=groups)
+        _close(out, ref, tag=tag)
+
+        # gradient parity through both autograds
+        ref.sum().backward()
+        out.sum().backward()
+        _close(xp.grad, xt.grad, rtol=1e-3, atol=1e-4, tag=tag + " dx")
+        _close(wp.grad, wt.grad, rtol=1e-3, atol=1e-4, tag=tag + " dw")
+
+
+def test_conv2d_transpose_fuzz_vs_torch():
+    rng = np.random.RandomState(1)
+    for case in range(10):
+        cin = int(rng.choice([2, 4]))
+        groups = int(rng.choice([1, 2]))
+        cout_pg = int(rng.randint(1, 4))
+        k = int(rng.choice([2, 3, 4]))
+        stride = int(rng.randint(1, 4))
+        pad = int(rng.randint(0, k))
+        opad = int(rng.randint(0, max(stride, 1)))
+        if opad >= stride:
+            opad = stride - 1
+        h = int(rng.randint(4, 10))
+        x = rng.randn(2, cin, h, h).astype("float32")
+        w = rng.randn(cin, cout_pg, k, k).astype("float32")
+        tag = f"case{case}: cin{cin} g{groups} k{k} s{stride} p{pad} op{opad}"
+
+        ref = tF.conv_transpose2d(torch.tensor(x), torch.tensor(w),
+                                  stride=stride, padding=pad,
+                                  output_padding=opad, groups=groups)
+        out = F.conv2d_transpose(_t(x), _t(w), stride=stride, padding=pad,
+                                 output_padding=opad, groups=groups)
+        _close(out, ref, tag=tag)
+
+
+def _paddle_ref_pool(x, k, s, p, ceil, kind, exclusive=True):
+    """Reference pooling semantics in numpy (PoolOutputSize pooling.h:368
+    — ceil WITHOUT torch's drop-last-window rule — plus the kernels'
+    window clamping; avg divisor: valid elements when exclusive else
+    k*k). The authority where torch's spec differs."""
+    N, C, H, W = x.shape
+
+    def osz(inp):
+        if ceil:
+            return (inp - k + 2 * p + s - 1) // s + 1
+        return (inp - k + 2 * p) // s + 1
+
+    OH, OW = osz(H), osz(W)
+    out = np.zeros((N, C, OH, OW), np.float32)
+    for i in range(OH):
+        hs0 = i * s - p                       # may be negative (left pad)
+        he0 = min(hs0 + k, H + p)             # clipped to input+pad only
+        hs, he = max(hs0, 0), min(he0, H)
+        for j in range(OW):
+            ws0 = j * s - p
+            we0 = min(ws0 + k, W + p)
+            ws, we = max(ws0, 0), min(we0, W)
+            win = x[:, :, hs:he, ws:we]
+            if kind == "max":
+                out[:, :, i, j] = (win.max(axis=(2, 3)) if win.size
+                                   else -np.inf)
+            else:
+                # reference pooling.cc:84: inclusive divisor is the
+                # window clipped to input+pad (left pad counted, right
+                # clipped); exclusive: valid elements only
+                div = ((he - hs) * (we - ws) if exclusive
+                       else (he0 - hs0) * (we0 - ws0))
+                out[:, :, i, j] = win.sum(axis=(2, 3)) / max(div, 1)
+    return out
+
+
+def test_pool2d_fuzz():
+    """Non-ceil configs check against torch (specs coincide); ceil
+    configs check against the paddle-reference numpy oracle (paddle keeps
+    the extra ceil window that torch's start-inside rule drops)."""
+    rng = np.random.RandomState(2)
+    for case in range(12):
+        k = int(rng.choice([2, 3]))
+        stride = int(rng.randint(1, 4))
+        pad = int(rng.randint(0, k // 2 + 1))
+        ceil = bool(rng.randint(0, 2))
+        h = int(rng.randint(6, 15))
+        x = rng.randn(2, 3, h, h).astype("float32")
+        tag = f"case{case}: k{k} s{stride} p{pad} ceil{ceil}"
+
+        out = F.max_pool2d(_t(x), k, stride=stride, padding=pad,
+                           ceil_mode=ceil)
+        exc = F.avg_pool2d(_t(x), k, stride=stride, padding=pad,
+                           ceil_mode=ceil, exclusive=True)
+        inc = F.avg_pool2d(_t(x), k, stride=stride, padding=pad,
+                           ceil_mode=ceil, exclusive=False)
+        if ceil:
+            np.testing.assert_allclose(
+                out.numpy(), _paddle_ref_pool(x, k, stride, pad, ceil, "max"),
+                rtol=2e-4, atol=2e-5, err_msg="max " + tag)
+            np.testing.assert_allclose(
+                exc.numpy(),
+                _paddle_ref_pool(x, k, stride, pad, ceil, "avg", True),
+                rtol=2e-4, atol=2e-5, err_msg="avg-excl " + tag)
+            np.testing.assert_allclose(
+                inc.numpy(),
+                _paddle_ref_pool(x, k, stride, pad, ceil, "avg", False),
+                rtol=2e-4, atol=2e-5, err_msg="avg-incl " + tag)
+        else:
+            _close(out, tF.max_pool2d(torch.tensor(x), k, stride=stride,
+                                      padding=pad), tag="max " + tag)
+            _close(exc, tF.avg_pool2d(torch.tensor(x), k, stride=stride,
+                                      padding=pad,
+                                      count_include_pad=False),
+                   tag="avg-excl " + tag)
+            _close(inc, tF.avg_pool2d(torch.tensor(x), k, stride=stride,
+                                      padding=pad, count_include_pad=True),
+                   tag="avg-incl " + tag)
+
+
+def test_interpolate_fuzz_vs_torch():
+    rng = np.random.RandomState(3)
+    for case in range(10):
+        h = int(rng.randint(3, 9))
+        oh = int(rng.randint(2, 14))
+        x = rng.randn(2, 3, h, h + 1).astype("float32")
+        mode = ["nearest", "bilinear", "bicubic"][case % 3]
+        align = bool(rng.randint(0, 2)) and mode != "nearest"
+        tag = f"case{case}: {mode} {h}->{oh} align{align}"
+
+        kwargs = {} if mode == "nearest" else {"align_corners": align}
+        ref = tF.interpolate(torch.tensor(x), size=(oh, oh + 2), mode=mode,
+                             **kwargs)
+        out = F.interpolate(_t(x), size=(oh, oh + 2), mode=mode,
+                            align_corners=align)
+        # bicubic kernels differ slightly at borders between frameworks
+        tol = dict(rtol=2e-2, atol=2e-2) if mode == "bicubic" else {}
+        _close(out, ref, tag=tag, **tol)
+
+
+def test_grid_sample_fuzz_vs_torch():
+    rng = np.random.RandomState(4)
+    for case in range(6):
+        h, w = int(rng.randint(4, 9)), int(rng.randint(4, 9))
+        x = rng.randn(2, 3, h, w).astype("float32")
+        grid = (rng.rand(2, 5, 7, 2).astype("float32") * 2.2 - 1.1)
+        align = bool(rng.randint(0, 2))
+        tag = f"case{case}: {h}x{w} align{align}"
+
+        ref = tF.grid_sample(torch.tensor(x), torch.tensor(grid),
+                             mode="bilinear", padding_mode="zeros",
+                             align_corners=align)
+        out = F.grid_sample(_t(x), _t(grid), mode="bilinear",
+                            padding_mode="zeros", align_corners=align)
+        _close(out, ref, tag=tag)
+
+
+def test_norm_layers_vs_torch():
+    rng = np.random.RandomState(5)
+    x = rng.randn(4, 6, 5, 5).astype("float32")
+    w = rng.rand(6).astype("float32") + 0.5
+    b = rng.randn(6).astype("float32")
+    rm = rng.randn(6).astype("float32")
+    rv = rng.rand(6).astype("float32") + 0.5
+
+    ref = tF.batch_norm(torch.tensor(x), torch.tensor(rm), torch.tensor(rv),
+                        torch.tensor(w), torch.tensor(b), training=False,
+                        eps=1e-5)
+    out = F.batch_norm(_t(x), _t(rm), _t(rv), _t(w), _t(b), training=False,
+                       epsilon=1e-5)
+    _close(out, ref, tag="bn-eval")
+
+    ref = tF.layer_norm(torch.tensor(x), x.shape[1:], eps=1e-5)
+    out = F.layer_norm(_t(x), list(x.shape[1:]), epsilon=1e-5)
+    _close(out, ref, tag="ln")
+
+    ref = tF.group_norm(torch.tensor(x), 3, torch.tensor(w),
+                        torch.tensor(b), eps=1e-5)
+    out = F.group_norm(_t(x), 3, weight=_t(w), bias=_t(b), epsilon=1e-5)
+    _close(out, ref, tag="gn")
