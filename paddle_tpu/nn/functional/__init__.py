@@ -402,7 +402,7 @@ def _pool_nd(x, kernel, stride, padding, nd, op, data_format, ceil_mode=False, e
                             + ks[i] - spatial_d[i], 0)
                 pad.append((total // 2, total - total // 2))
     pad_base = list(pad)  # pre-ceil pads
-    if ceil_mode and not isinstance(pad, str):
+    if ceil_mode:
         spatial = x.shape[1:-1] if channel_last else x.shape[2:]
         pad = [
             (lo, hi + _ceil_extra(spatial[i], ks[i], st[i], lo + hi))
@@ -1319,6 +1319,9 @@ def _resize_axis_cubic(a, ax, outs, align_corners):
 
 
 def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    if mode not in ("nearest", "linear", "bilinear", "trilinear", "bicubic",
+                    "area"):
+        raise ValueError(f"unsupported interpolate mode {mode!r}")
     channel_last = data_format in ("NHWC", "NWC", "NLC", "NDHWC")
     ax0 = 1 if channel_last else 2           # first spatial axis
 
@@ -1338,9 +1341,15 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=F
         for i, (ins, outs) in enumerate(zip(in_spatial, out_spatial)):
             ax = ax0 + i
             if mode == "nearest":
-                # index-based nearest (paddle's floor behavior)
-                idx = jnp.floor(jnp.arange(outs) * (ins / outs)).astype(jnp.int32)
-                out = jnp.take(out, idx, axis=ax)
+                # reference NearestNeighborInterpolate: floor(ratio*i)
+                # with ratio in/out, or round(ratio*i) with corner-
+                # aligned ratio (in-1)/(out-1) (interpolate_kernel.cc:210)
+                if align_corners and outs > 1:
+                    r = (ins - 1) / (outs - 1)
+                    idx = jnp.floor(jnp.arange(outs) * r + 0.5).astype(jnp.int32)
+                else:
+                    idx = jnp.floor(jnp.arange(outs) * (ins / outs)).astype(jnp.int32)
+                out = jnp.take(out, jnp.clip(idx, 0, ins - 1), axis=ax)
             elif mode == "bicubic":
                 out = _resize_axis_cubic(out, ax, outs, align_corners)
             else:  # linear / bilinear / trilinear
