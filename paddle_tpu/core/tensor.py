@@ -130,6 +130,13 @@ class Tensor:
         return self.shape[0]
 
     # -- autograd ----------------------------------------------------------
+    @property
+    def trainable(self):
+        """Plain Tensors act as parameters when stop_gradient=False (the
+        reference optimizers accept them); Parameter overrides this with
+        its own slot."""
+        return not self.stop_gradient
+
     def backward(self, grad_tensor=None, retain_graph=False):
         from ..autograd.tape import backward as _backward
 

@@ -163,3 +163,78 @@ def test_multi_precision_master_weights():
     assert mw.dtype == jnp.float32
     err = np.abs(w.astype("float32").numpy() - target.astype("float32").numpy()).max()
     assert err < 0.1
+
+
+# -- trajectory parity vs torch-CPU (fast tier; update-rule bugs produce
+#    plausible-but-wrong numbers that convergence tests cannot catch) ----
+import pytest as _pytest
+
+torch = _pytest.importorskip("torch")
+
+
+
+def _train_pair(make_ours, make_theirs, steps=25, tag=""):
+    """Run identical quadratic-loss trajectories through our optimizer
+    and torch's; weights must track each other step for step."""
+    rng = np.random.RandomState(7)
+    w0 = rng.randn(6, 4).astype("float32")
+    A = rng.randn(6, 4).astype("float32")
+
+    wp = paddle.to_tensor(w0.copy())
+    wp.stop_gradient = False
+    opt_ours = make_ours([wp])
+
+    wt = torch.tensor(w0.copy(), requires_grad=True)
+    opt_theirs = make_theirs([wt])
+
+    for i in range(steps):
+        loss_p = ((wp - paddle.to_tensor(A)) ** 2).sum()
+        loss_p.backward()
+        opt_ours.step()
+        opt_ours.clear_grad()
+
+        opt_theirs.zero_grad()
+        loss_t = ((wt - torch.tensor(A)) ** 2).sum()
+        loss_t.backward()
+        opt_theirs.step()
+
+    np.testing.assert_allclose(wp.numpy(), wt.detach().numpy(),
+                               rtol=2e-5, atol=2e-6, err_msg=tag)
+
+
+def test_sgd_trajectory_vs_torch():
+    _train_pair(
+        lambda ps: paddle.optimizer.SGD(learning_rate=0.05, parameters=ps),
+        lambda ts: torch.optim.SGD(ts, lr=0.05), tag="sgd")
+
+
+def test_momentum_trajectory_vs_torch():
+    _train_pair(
+        lambda ps: paddle.optimizer.Momentum(learning_rate=0.05,
+                                             momentum=0.9, parameters=ps),
+        lambda ts: torch.optim.SGD(ts, lr=0.05, momentum=0.9),
+        tag="momentum")
+
+
+def test_adam_trajectory_vs_torch():
+    _train_pair(
+        lambda ps: paddle.optimizer.Adam(learning_rate=0.01, parameters=ps),
+        lambda ts: torch.optim.Adam(ts, lr=0.01), tag="adam")
+
+
+def test_adamw_trajectory_vs_torch():
+    """Decoupled weight decay: paddle AdamW coeff == torch weight_decay
+    (both apply p -= lr*coeff*p before/with the adam update)."""
+    _train_pair(
+        lambda ps: paddle.optimizer.AdamW(learning_rate=0.01,
+                                          weight_decay=0.1, parameters=ps),
+        lambda ts: torch.optim.AdamW(ts, lr=0.01, weight_decay=0.1),
+        tag="adamw")
+
+
+def test_rmsprop_trajectory_vs_torch():
+    _train_pair(
+        lambda ps: paddle.optimizer.RMSProp(learning_rate=0.01, rho=0.99,
+                                            epsilon=1e-8, parameters=ps),
+        lambda ts: torch.optim.RMSprop(ts, lr=0.01, alpha=0.99, eps=1e-8),
+        tag="rmsprop")

@@ -161,3 +161,4 @@ def test_embedding_and_nll_vs_torch():
     ours = F.nll_loss(paddle.to_tensor(np.asarray(logp.numpy())),
                       paddle.to_tensor(labels))
     _close(ours, ref, tag="nll")
+
