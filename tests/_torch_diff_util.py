@@ -1,0 +1,9 @@
+"""Shared comparator for the torch-differential suites."""
+import numpy as np
+
+
+def torch_close(ours, theirs, rtol=5e-5, atol=5e-6, tag=""):
+    np.testing.assert_allclose(
+        np.asarray(ours.numpy() if hasattr(ours, "numpy") else ours,
+                   np.float32),
+        theirs.detach().numpy(), rtol=rtol, atol=atol, err_msg=tag)

@@ -24,11 +24,11 @@ def _t(a):
     return paddle.to_tensor(np.ascontiguousarray(a))
 
 
+from _torch_diff_util import torch_close
+
+
 def _close(ours, theirs, rtol=2e-4, atol=2e-5, tag=""):
-    np.testing.assert_allclose(
-        np.asarray(ours.numpy() if hasattr(ours, "numpy") else ours,
-                   np.float32),
-        theirs.detach().numpy(), rtol=rtol, atol=atol, err_msg=tag)
+    torch_close(ours, theirs, rtol=rtol, atol=atol, tag=tag)
 
 
 def test_conv2d_fuzz_vs_torch():

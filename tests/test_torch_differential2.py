@@ -19,11 +19,11 @@ import torch.nn.functional as tF  # noqa: E402
 pytestmark = pytest.mark.slow
 
 
+from _torch_diff_util import torch_close
+
+
 def _close(ours, theirs, rtol=5e-4, atol=5e-5, tag=""):
-    np.testing.assert_allclose(
-        np.asarray(ours.numpy() if hasattr(ours, "numpy") else ours,
-                   np.float32),
-        theirs.detach().numpy(), rtol=rtol, atol=atol, err_msg=tag)
+    torch_close(ours, theirs, rtol=rtol, atol=atol, tag=tag)
 
 
 def _copy_rnn_weights(ours, theirs):

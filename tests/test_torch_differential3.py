@@ -1,0 +1,177 @@
+"""Differential suite part 3: activations, padding modes, pixel/channel
+shuffles, normalization helpers, and the loss family vs torch-CPU —
+broad formula-parity coverage where paddle and torch share specs (each
+known divergence is called out inline with the paddle rule used
+instead).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as tF  # noqa: E402
+
+pytestmark = pytest.mark.slow
+
+
+from _torch_diff_util import torch_close
+
+
+def _close(ours, theirs, rtol=5e-5, atol=5e-6, tag=""):
+    torch_close(ours, theirs, rtol=rtol, atol=atol, tag=tag)
+
+
+_X = np.linspace(-4, 4, 97).astype("float32").reshape(1, 97)
+
+
+def test_activations_vs_torch():
+    x = paddle.to_tensor(_X)
+    xt = torch.tensor(_X)
+    pairs = [
+        ("relu", F.relu(x), tF.relu(xt)),
+        ("relu6", F.relu6(x), tF.relu6(xt)),
+        ("elu", F.elu(x, alpha=0.7), tF.elu(xt, alpha=0.7)),
+        ("celu", F.celu(x, alpha=0.9), tF.celu(xt, alpha=0.9)),
+        ("selu", F.selu(x), tF.selu(xt)),
+        ("silu", F.silu(x), tF.silu(xt)),
+        ("mish", F.mish(x), tF.mish(xt)),
+        ("gelu-exact", F.gelu(x), tF.gelu(xt)),
+        ("gelu-tanh", F.gelu(x, approximate=True),
+         tF.gelu(xt, approximate="tanh")),
+        ("softplus", F.softplus(x, beta=2.0, threshold=10.0),
+         tF.softplus(xt, beta=2.0, threshold=10.0)),
+        ("log_sigmoid", F.log_sigmoid(x), tF.logsigmoid(xt)),
+        ("tanhshrink", F.tanhshrink(x), tF.tanhshrink(xt)),
+        ("hardshrink", F.hardshrink(x, threshold=0.6),
+         tF.hardshrink(xt, lambd=0.6)),
+        ("softshrink", F.softshrink(x, threshold=0.3),
+         tF.softshrink(xt, lambd=0.3)),
+        ("hardtanh", F.hardtanh(x, min=-1.2, max=0.8),
+         tF.hardtanh(xt, min_val=-1.2, max_val=0.8)),
+        ("leaky_relu", F.leaky_relu(x, negative_slope=0.15),
+         tF.leaky_relu(xt, negative_slope=0.15)),
+        ("hardsigmoid", F.hardsigmoid(x), tF.hardsigmoid(xt)),
+        ("hardswish", F.hardswish(x), tF.hardswish(xt)),
+        ("logsoftmax", F.log_softmax(x, axis=-1),
+         tF.log_softmax(xt, dim=-1)),
+        ("glu", F.glu(paddle.to_tensor(_X[:, :96]), axis=-1),
+         tF.glu(torch.tensor(_X[:, :96]), dim=-1)),
+    ]
+    for tag, ours, ref in pairs:
+        _close(ours, ref, tag=tag)
+
+    w = np.array([0.2], np.float32)
+    _close(F.prelu(x, paddle.to_tensor(w)),
+           tF.prelu(xt, torch.tensor(w)), tag="prelu")
+
+
+def test_pad_modes_vs_torch():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 5, 6).astype("float32")
+    for mode, tmode in (("reflect", "reflect"), ("replicate", "replicate"),
+                        ("circular", "circular"), ("constant", "constant")):
+        # 4-D pads [left, right, top, bottom]: the same order in both
+        # frameworks (torch's last-dim-first tuple == paddle's list here)
+        pads = [1, 2, 2, 1]
+        ref = tF.pad(torch.tensor(x), pads, mode=tmode)
+        ours = F.pad(paddle.to_tensor(x), pads, mode=mode,
+                     data_format="NCHW")
+        _close(ours, ref, tag=f"pad-{mode}")
+
+
+def test_shuffles_vs_torch():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 12, 4, 5).astype("float32")
+    _close(F.pixel_shuffle(paddle.to_tensor(x), 2),
+           tF.pixel_shuffle(torch.tensor(x), 2), tag="pixel_shuffle")
+    y = rng.randn(2, 3, 8, 10).astype("float32")
+    _close(F.pixel_unshuffle(paddle.to_tensor(y), 2),
+           tF.pixel_unshuffle(torch.tensor(y), 2), tag="pixel_unshuffle")
+    _close(F.channel_shuffle(paddle.to_tensor(x), 3),
+           torch.channel_shuffle(torch.tensor(x), 3),
+           tag="channel_shuffle")
+
+
+def test_normalize_cosine_vs_torch():
+    rng = np.random.RandomState(2)
+    a = rng.randn(4, 7).astype("float32")
+    b = rng.randn(4, 7).astype("float32")
+    _close(F.normalize(paddle.to_tensor(a), p=2, axis=1),
+           tF.normalize(torch.tensor(a), p=2, dim=1), tag="normalize-l2")
+    _close(F.normalize(paddle.to_tensor(a), p=1, axis=0),
+           tF.normalize(torch.tensor(a), p=1, dim=0), tag="normalize-l1")
+    _close(F.cosine_similarity(paddle.to_tensor(a), paddle.to_tensor(b),
+                               axis=1),
+           tF.cosine_similarity(torch.tensor(a), torch.tensor(b), dim=1),
+           tag="cosine")
+
+
+def test_losses_vs_torch():
+    rng = np.random.RandomState(3)
+    a = rng.randn(8, 5).astype("float32")
+    b = rng.randn(8, 5).astype("float32")
+    ap, bp = paddle.to_tensor(a), paddle.to_tensor(b)
+    at, bt = torch.tensor(a), torch.tensor(b)
+
+    _close(F.mse_loss(ap, bp), tF.mse_loss(at, bt), tag="mse")
+    _close(F.l1_loss(ap, bp), tF.l1_loss(at, bt), tag="l1")
+    _close(F.smooth_l1_loss(ap, bp), tF.smooth_l1_loss(at, bt),
+           tag="smooth_l1")
+
+    probs = 1 / (1 + np.exp(-a))
+    lbl = (rng.rand(8, 5) > 0.5).astype("float32")
+    _close(F.binary_cross_entropy(paddle.to_tensor(probs),
+                                  paddle.to_tensor(lbl)),
+           tF.binary_cross_entropy(torch.tensor(probs), torch.tensor(lbl)),
+           tag="bce")
+    pw = (rng.rand(5) + 0.5).astype("float32")
+    _close(F.binary_cross_entropy_with_logits(ap, paddle.to_tensor(lbl),
+                                              pos_weight=paddle.to_tensor(pw)),
+           tF.binary_cross_entropy_with_logits(at, torch.tensor(lbl),
+                                               pos_weight=torch.tensor(pw)),
+           tag="bce_logits+pos_weight")
+
+    # kl_div: both frameworks take LOG-probability inputs
+    logp = np.log(probs / probs.sum(-1, keepdims=True))
+    q = rng.rand(8, 5).astype("float32")
+    q /= q.sum(-1, keepdims=True)
+    _close(F.kl_div(paddle.to_tensor(logp), paddle.to_tensor(q),
+                    reduction="batchmean"),
+           tF.kl_div(torch.tensor(logp), torch.tensor(q),
+                     reduction="batchmean"), tag="kl_div")
+
+    y = np.sign(rng.randn(8).astype("float32"))
+    _close(F.margin_ranking_loss(paddle.to_tensor(a[:, 0]),
+                                 paddle.to_tensor(b[:, 0]),
+                                 paddle.to_tensor(y), margin=0.3),
+           tF.margin_ranking_loss(at[:, 0], bt[:, 0], torch.tensor(y),
+                                  margin=0.3), tag="margin_ranking")
+
+    anc = rng.randn(6, 9).astype("float32")
+    pos = rng.randn(6, 9).astype("float32")
+    neg = rng.randn(6, 9).astype("float32")
+    _close(F.triplet_margin_loss(paddle.to_tensor(anc),
+                                 paddle.to_tensor(pos),
+                                 paddle.to_tensor(neg), margin=0.7),
+           tF.triplet_margin_loss(torch.tensor(anc), torch.tensor(pos),
+                                  torch.tensor(neg), margin=0.7),
+           tag="triplet")
+
+    y2 = np.sign(rng.randn(8).astype("float32")).astype("float32")
+    y2[y2 == 0] = 1.0
+    _close(F.cosine_embedding_loss(ap, bp, paddle.to_tensor(y2),
+                                   margin=0.2),
+           tF.cosine_embedding_loss(at, bt, torch.tensor(y2), margin=0.2),
+           tag="cosine_embedding")
+
+
+def test_one_hot_and_diag_vs_torch():
+    idx = np.array([[0, 3], [2, 1]], np.int64)
+    _close(F.one_hot(paddle.to_tensor(idx), num_classes=5),
+           tF.one_hot(torch.tensor(idx), num_classes=5).float(),
+           tag="one_hot")
+    v = np.arange(4, dtype="float32")
+    _close(F.diag_embed(paddle.to_tensor(v), offset=1),
+           torch.diag_embed(torch.tensor(v), offset=1), tag="diag_embed")
