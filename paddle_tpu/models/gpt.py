@@ -67,6 +67,13 @@ class GPTConfig:
     # attention via ring attention (parallel/ring.py) instead of gathering
     # to full-sequence flash attention. The long-context path.
     context_parallel: bool = False
+    # "zigzag" load-balances the causal ring: the MODEL permutes the token
+    # stream once after the embedding (zigzag_sequence_perm) and
+    # un-permutes before the final LN, so every sp rank does identical
+    # attention work (the contiguous ring leaves rank n-1 computing n full
+    # blocks while rank 0 masks all but one). Needs attention_dropout 0,
+    # pp degenerate, and seq % (2*sp) == 0.
+    cp_layout: str = "contiguous"
     # MoE: replace the dense FFN with a mixture of experts every n blocks
     moe_every_n: int = 0
     moe_num_experts: int = 0
@@ -228,7 +235,8 @@ class GPTAttention(Layer):
             # context parallel: seq stays sharded over sp through attention
             qkv = constraint(qkv, ["dp", "sp", None, "mp", None])
             q, k, v = qkv.unbind(axis=2)
-            o = ring_attention(q, k, v, is_causal=True)
+            layout = "zigzag_pre" if _zigzag_active(self.cfg) else "contiguous"
+            o = ring_attention(q, k, v, is_causal=True, layout=layout)
             o = constraint(o, ["dp", "sp", "mp", None])
         else:
             # heads carry the mp shard; seq gathers (sp -> heads layout switch)
@@ -415,7 +423,14 @@ class GPTStackedBlocks(Layer):
             cfg.context_parallel and axis_size("sp") > 1 and axis_size("pp") <= 1
         )
 
-        attn = ring_attention_arrays if use_ring else flash_attention_arrays
+        if use_ring and _zigzag_active(cfg):
+            from functools import partial as _partial
+
+            attn = _partial(ring_attention_arrays, layout="zigzag_pre")
+        elif use_ring:
+            attn = ring_attention_arrays
+        else:
+            attn = flash_attention_arrays
 
         def block(p, h):
             out, _ = _stacked_block_body(
@@ -600,12 +615,44 @@ class GPTModel(Layer):
                     x, c = blk(x, cache=cache, time_step=time_step)
                     new_caches.append(c)
             return self.ln_f(x), new_caches
+        zig = _zigzag_active(self.cfg)
+        if zig:
+            from ..parallel.mesh import axis_size
+            from ..parallel.ring import zigzag_sequence_perm
+
+            n = axis_size("sp")
+            s_len = x.shape[1]
+            if s_len % (2 * n) != 0:
+                raise ValueError(
+                    f"cp_layout='zigzag' needs seq len ({s_len}) divisible "
+                    f"by 2*sp ({2 * n}); pad the sequence or use "
+                    "cp_layout='contiguous'")
+            perm, inv = zigzag_sequence_perm(s_len, n)
+            # ONE gather in, one out per step — per-token layers (LN, MLP,
+            # residual) are permutation-invariant; attention runs the
+            # zigzag_pre kernel whose position bookkeeping matches this
+            # exact ordering
+            x = apply(lambda a: jnp.take(a, jnp.asarray(perm), axis=1), x,
+                      name="zigzag_permute")
         if self.cfg.stacked_blocks:
             x = self.blocks(x)
         else:
             for blk in self.h:
                 x = blk(x)
+        if zig:
+            x = apply(lambda a: jnp.take(a, jnp.asarray(inv), axis=1), x,
+                      name="zigzag_unpermute")
         return self.ln_f(x)
+
+
+def _zigzag_active(cfg):
+    """True when the model-level zigzag context-parallel layout applies
+    (mesh/config only; the caller validates seq divisibility)."""
+    from ..parallel.mesh import axis_size
+
+    return (cfg.context_parallel and cfg.cp_layout == "zigzag"
+            and axis_size("sp") > 1 and axis_size("pp") <= 1
+            and not cfg.attention_dropout_prob)
 
 
 def _sample_next(logits, key, do_sample, temperature, top_k, top_p):

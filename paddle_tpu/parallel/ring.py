@@ -25,7 +25,26 @@ from jax.sharding import PartitionSpec as P
 from ..core.dispatch import apply
 from .mesh import get_mesh, axis_size
 
-__all__ = ["ring_attention", "ring_attention_arrays"]
+__all__ = ["ring_attention", "ring_attention_arrays", "zigzag_sequence_perm"]
+
+
+def _online_block_update(carry, q_scaled, qpos, k_blk, v_blk, kpos):
+    """One flash-style online-softmax accumulation of a K/V block against
+    scaled queries (shared by the contiguous and zigzag ring bodies — the
+    numerically delicate part lives exactly once). kpos=None means no
+    causal mask for this block."""
+    o, m, l = carry
+    s = jnp.einsum("bqhd,bkhd->bhqk", q_scaled, k_blk.astype(jnp.float32))
+    if kpos is not None:
+        s = jnp.where(kpos[None, None, None, :]
+                      > qpos[None, None, :, None], -jnp.inf, s)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    p = jnp.exp(s - m_new[..., None])
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    o_new = o * corr[..., None] + jnp.einsum(
+        "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
+    return o_new, m_new, l_new
 
 
 def _ring_attn_local(q, k, v, *, axis_name, causal, scale):
@@ -38,26 +57,12 @@ def _ring_attn_local(q, k, v, *, axis_name, causal, scale):
     qf = q.astype(jnp.float32) * scale
     perm = [(j, (j + 1) % n) for j in range(n)]
 
-    # TODO(perf): causal masking leaves blocks from src > my fully masked;
-    # a zig-zag layout (device holds chunks i and 2n-1-i) would balance the
-    # ring and recover ~2x attention throughput at large n.
     def attend(o, m, l, k_blk, v_blk, i):
-        """Online-softmax accumulate the block that originated at ring
-        position (my - i) % n."""
+        """Accumulate the block that originated at ring position
+        (my - i) % n."""
         src = (my - i) % n
-        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32))
-        if causal:
-            kpos = src * sq + jnp.arange(sq)
-            s = jnp.where(kpos[None, None, None, :] > qpos[None, None, :, None],
-                          -jnp.inf, s)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[..., None])
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=-1)
-        o_new = o * corr[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32)
-        )
-        return o_new, m_new, l_new
+        kpos = (src * sq + jnp.arange(sq)) if causal else None
+        return _online_block_update((o, m, l), qf, qpos, k_blk, v_blk, kpos)
 
     o0 = jnp.zeros((b, h, sq, d), jnp.float32)
     # step 0 visits the device's own (diagonal) block, which under a causal
@@ -81,9 +86,106 @@ def _ring_attn_local(q, k, v, *, axis_name, causal, scale):
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 
 
-def ring_attention_arrays(q, k, v, is_causal=True, scale=None, axis="sp"):
+def _ring_attn_zigzag(q, k, v, *, axis_name, scale):
+    """Causal ring attention over the ZIGZAG layout: the local sequence
+    rows are half-chunks (j, 2n-1-j) of the 2n global half-chunks, so
+    every device owns an equal mix of early and late positions. Each ring
+    step considers 4 (q-half, k-half) pairs and computes a pair ONLY when
+    its k-chunk index <= its q-chunk index (lax.cond on a per-device
+    scalar — pure compute, no collectives inside the branch, so
+    non-uniform branching across the ring is legal). Per-device work is
+    exactly 2n+1 half-pairs for every rank — the balanced version of the
+    contiguous ring where rank n-1 computes n full blocks while rank 0
+    masks away all but one (the TODO this replaces); ~2x causal
+    throughput at large n."""
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    hsq = sq // 2
+    cl, ch = my, 2 * n - 1 - my              # local half-chunk indices
+    qf = q.astype(jnp.float32) * scale
+    q_halves = (qf[:, :hsq], qf[:, hsq:])
+    q_chunks = (cl, ch)
+    qpos = tuple(c * hsq + jnp.arange(hsq) for c in q_chunks)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def attend_pair(carry, k_half, v_half, qh_idx, kc):
+        kpos = kc * hsq + jnp.arange(hsq)
+        return _online_block_update(carry, q_halves[qh_idx], qpos[qh_idx],
+                                    k_half, v_half, kpos)
+
+    def visit(carries, k_blk, v_blk, src):
+        """Process both k-halves of the block that originated at `src`
+        against both local q-halves, skipping fully-masked pairs."""
+        k_halves = (k_blk[:, :hsq], k_blk[:, hsq:])
+        v_halves = (v_blk[:, :hsq], v_blk[:, hsq:])
+        k_chunks = (src, 2 * n - 1 - src)
+        new = []
+        for qh in range(2):
+            carry = carries[qh]
+            for kh in range(2):
+                kc = k_chunks[kh]
+                carry = jax.lax.cond(
+                    kc <= q_chunks[qh],
+                    lambda c, kh=kh, qh=qh, kc=kc: attend_pair(
+                        c, k_halves[kh], v_halves[kh], qh, kc),
+                    lambda c: c,
+                    carry)
+            new.append(carry)
+        return tuple(new)
+
+    def init_carry():
+        return (jnp.zeros((b, h, hsq, d), jnp.float32),
+                jnp.full((b, h, hsq), -jnp.inf, jnp.float32),
+                jnp.zeros((b, h, hsq), jnp.float32))
+
+    carries = (init_carry(), init_carry())
+    carries = visit(carries, k, v, my)       # own block first (diagonal)
+    if n > 1:
+        def step(state, i):
+            carries, k_blk, v_blk = state
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+            carries = visit(carries, k_blk, v_blk, (my - i) % n)
+            return (carries, k_blk, v_blk), None
+
+        (carries, _, _), _ = jax.lax.scan(
+            step, (carries, k, v), jnp.arange(1, n))
+
+    outs = []
+    for o, m, l in carries:
+        outs.append(jnp.transpose(o / jnp.maximum(l, 1e-30)[..., None],
+                                  (0, 2, 1, 3)))
+    return jnp.concatenate(outs, axis=1).astype(q.dtype)
+
+
+def zigzag_sequence_perm(s, n):
+    """Global permutation natural -> zigzag (device j holds half-chunks
+    j and 2n-1-j); returns (perm, inverse). Public: models that permute
+    the token stream ONCE (embedding output in, logits out) pay one
+    gather each way per STEP instead of four per attention layer — pair
+    with layout="zigzag_pre"."""
+    import numpy as np
+
+    hsq = s // (2 * n)
+    order = []
+    for j in range(n):
+        order.extend(range(j * hsq, (j + 1) * hsq))
+        order.extend(range((2 * n - 1 - j) * hsq, (2 * n - j) * hsq))
+    perm = np.asarray(order)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(s)
+    return perm, inv
+
+
+def ring_attention_arrays(q, k, v, is_causal=True, scale=None, axis="sp",
+                          layout="contiguous"):
     """Array-level ring attention: [B,S,H,D] with S sharded over `axis`.
 
+    layout="zigzag" (causal only) rebalances the ring: the sequence is
+    permuted so each device holds an early+late half-chunk pair, every
+    rank does identical work, and fully-masked pairs are skipped —
+    ~2x causal throughput at large axis sizes for one gather each way.
     Falls back to the single-shard flash path when the axis is degenerate.
     """
     from ..ops.pallas_ops import flash_attention_arrays
@@ -105,6 +207,32 @@ def ring_attention_arrays(q, k, v, is_causal=True, scale=None, axis="sp"):
     # Only 'sp' is manual; batch/head dims stay in GSPMD-auto mode so dp/mp
     # sharding (and an enclosing pp pipeline) keep composing.
     spec = P(None, axis, None, None)
+    zig_ok = is_causal and q.shape[1] % (2 * n) == 0 and n > 1
+    if layout in ("zigzag", "zigzag_pre") and not zig_ok:
+        warnings.warn(
+            "ring_attention: zigzag layout needs causal attention and seq "
+            "divisible by 2*axis_size; using the contiguous ring instead.")
+        layout = "contiguous"
+    if layout == "zigzag_pre":
+        # caller already permuted the sequence into zigzag order (one
+        # model-level gather instead of per-layer ones)
+        body = partial(_ring_attn_zigzag, axis_name=axis, scale=scale)
+        fn = jax.shard_map(
+            body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            axis_names=frozenset({axis}), check_vma=False,
+        )
+        return fn(q, k, v)
+    if layout == "zigzag":
+        perm, inv = zigzag_sequence_perm(q.shape[1], n)
+        qz, kz, vz = (jnp.take(t, jnp.asarray(perm), axis=1)
+                      for t in (q, k, v))
+        body = partial(_ring_attn_zigzag, axis_name=axis, scale=scale)
+        fn = jax.shard_map(
+            body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            axis_names=frozenset({axis}), check_vma=False,
+        )
+        out = fn(qz, kz, vz)
+        return jnp.take(out, jnp.asarray(inv), axis=1)
     body = partial(_ring_attn_local, axis_name=axis, causal=is_causal, scale=scale)
     fn = jax.shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
@@ -113,11 +241,14 @@ def ring_attention_arrays(q, k, v, is_causal=True, scale=None, axis="sp"):
     return fn(q, k, v)
 
 
-def ring_attention(query, key, value, is_causal=True, scale=None, axis="sp", name=None):
+def ring_attention(query, key, value, is_causal=True, scale=None, axis="sp",
+                   layout="contiguous", name=None):
     """Tensor-level context-parallel attention (the long-context answer:
-    seq stays sharded over 'sp' end to end — no all-gather of activations)."""
+    seq stays sharded over 'sp' end to end — no all-gather of
+    activations). layout="zigzag" load-balances the causal ring."""
 
     def fn(q, k, v):
-        return ring_attention_arrays(q, k, v, is_causal, scale, axis)
+        return ring_attention_arrays(q, k, v, is_causal, scale, axis,
+                                     layout=layout)
 
     return apply(fn, query, key, value, name=name or "ring_attention")
