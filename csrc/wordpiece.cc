@@ -1,0 +1,154 @@
+// Native wordpiece encoder (reference analog:
+// paddle/fluid/operators/string/faster_tokenizer_op.cc — the C++ BERT
+// tokenizer; that one leans on utf8proc for full-unicode lowercase/NFD,
+// this one implements the exact BasicTokenizer+WordpieceTokenizer rules
+// for ASCII input and lets the Python layer gate dispatch with
+// text.isascii(), the same exact-parity gating the Pallas paths use).
+//
+// C ABI (ctypes): a vocab handle built once, then batch-free encode
+// calls writing int32 ids into a caller buffer.
+#include <cctype>
+#include <cstdint>
+#include <cstring>
+#include <climits>
+#include <map>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct WpVocab {
+  std::unordered_map<std::string, int32_t> vocab;
+  int32_t unk_id = 0;
+  int32_t max_chars_per_word = 100;
+};
+
+std::mutex g_mu;
+std::map<int64_t, WpVocab*> g_vocabs;
+int64_t g_next_id = 1;
+
+WpVocab* get(int64_t h) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_vocabs.find(h);
+  return it == g_vocabs.end() ? nullptr : it->second;
+}
+
+inline bool is_ascii_punct(unsigned char c) {
+  return (c >= 33 && c <= 47) || (c >= 58 && c <= 64) ||
+         (c >= 91 && c <= 96) || (c >= 123 && c <= 126);
+}
+
+// Greedy longest-match-first wordpiece of one word [begin, end).
+void wordpiece(const WpVocab& v, const std::string& word,
+               std::vector<int32_t>* out) {
+  if ((int32_t)word.size() > v.max_chars_per_word) {
+    out->push_back(v.unk_id);
+    return;
+  }
+  size_t start = 0;
+  std::vector<int32_t> pieces;
+  std::string probe;
+  while (start < word.size()) {
+    size_t end = word.size();
+    int32_t cur = -1;
+    while (start < end) {
+      probe.clear();
+      if (start > 0) probe = "##";
+      probe.append(word, start, end - start);
+      auto it = v.vocab.find(probe);
+      if (it != v.vocab.end()) {
+        cur = it->second;
+        break;
+      }
+      --end;
+    }
+    if (cur < 0) {
+      out->push_back(v.unk_id);
+      return;
+    }
+    pieces.push_back(cur);
+    start = end;
+  }
+  out->insert(out->end(), pieces.begin(), pieces.end());
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t wp_vocab_new(int32_t unk_id, int32_t max_chars_per_word) {
+  auto* v = new WpVocab;
+  v->unk_id = unk_id;
+  if (max_chars_per_word > 0) v->max_chars_per_word = max_chars_per_word;
+  std::lock_guard<std::mutex> lk(g_mu);
+  int64_t h = g_next_id++;
+  g_vocabs[h] = v;
+  return h;
+}
+
+int wp_vocab_add(int64_t h, const char* token, int32_t id) {
+  WpVocab* v = get(h);
+  if (!v || !token) return -1;
+  v->vocab.emplace(token, id);
+  return 0;
+}
+
+void wp_vocab_free(int64_t h) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_vocabs.find(h);
+  if (it != g_vocabs.end()) {
+    delete it->second;
+    g_vocabs.erase(it);
+  }
+}
+
+// BasicTokenizer (ASCII rules) + wordpiece in one pass:
+// strip control chars, split on whitespace and punctuation (punct chars
+// are their own tokens), optional lowercase, then greedy wordpiece.
+// Returns the number of ids written (<= cap), or -(needed) when the
+// buffer is too small (needed >= 1), or INT32_MIN on a bad handle /
+// null argument (so -(needed) can never collide with the error code).
+int32_t wp_encode(int64_t h, const char* text, int32_t do_lower,
+                  int32_t* out, int32_t cap) {
+  WpVocab* v = get(h);
+  if (!v || !text || !out) return INT32_MIN;
+  std::vector<int32_t> ids;
+  std::string word;
+  auto flush_word = [&]() {
+    if (!word.empty()) {
+      wordpiece(*v, word, &ids);
+      word.clear();
+    }
+  };
+  for (const unsigned char* p = (const unsigned char*)text; *p; ++p) {
+    unsigned char c = *p;
+    if (c == 0xEF && p[1] == 0xBF && p[2] == 0xBD) {  // U+FFFD
+      p += 2;
+      continue;
+    }
+    if (c < 0x80 && std::iscntrl(c) && c != '\t' && c != '\n' && c != '\r')
+      continue;
+    if (c == ' ' || c == '\t' || c == '\n' || c == '\r') {
+      flush_word();
+      continue;
+    }
+    if (c < 0x80 && is_ascii_punct(c)) {
+      flush_word();
+      word.push_back((char)c);
+      flush_word();
+      continue;
+    }
+    // branchless ASCII lowering — std::tolower is locale-dependent (a
+    // tr_TR locale maps 'I' outside ASCII) while Python's str.lower is not
+    word.push_back(do_lower && c >= 'A' && c <= 'Z' ? (char)(c + 32)
+                                                    : (char)c);
+  }
+  flush_word();
+  if ((int32_t)ids.size() > cap) return -(int32_t)ids.size();
+  std::memcpy(out, ids.data(), ids.size() * sizeof(int32_t));
+  return (int32_t)ids.size();
+}
+
+}  // extern "C"

@@ -46,47 +46,77 @@ def load():
                     return None
                 _build()
             lib = ctypes.CDLL(_LIB_PATH)
+            if not hasattr(lib, "wp_encode"):
+                # stale prebuilt .so from before a source addition: rebuild
+                # once (make compares timestamps) and reload
+                _build()
+                lib = ctypes.CDLL(_LIB_PATH)
         except (OSError, subprocess.SubprocessError):
             return None
 
-        # -- tcp store --
-        lib.pts_server_start.restype = ctypes.c_int64
-        lib.pts_server_start.argtypes = [ctypes.c_char_p, ctypes.c_int]
-        lib.pts_server_stop.argtypes = [ctypes.c_int64]
-        lib.pts_connect.restype = ctypes.c_int64
-        lib.pts_connect.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
-        lib.pts_close.argtypes = [ctypes.c_int64]
-        lib.pts_set.restype = ctypes.c_int
-        lib.pts_set.argtypes = [ctypes.c_int64, ctypes.c_char_p,
-                                ctypes.c_char_p, ctypes.c_int64]
-        lib.pts_get.restype = ctypes.c_int64
-        lib.pts_get.argtypes = [ctypes.c_int64, ctypes.c_char_p,
-                                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int]
-        lib.pts_add.restype = ctypes.c_int
-        lib.pts_add.argtypes = [ctypes.c_int64, ctypes.c_char_p,
-                                ctypes.c_int64,
-                                ctypes.POINTER(ctypes.c_int64)]
-        lib.pts_wait.restype = ctypes.c_int
-        lib.pts_wait.argtypes = [ctypes.c_int64, ctypes.c_char_p, ctypes.c_int]
-        lib.pts_delete_key.restype = ctypes.c_int
-        lib.pts_delete_key.argtypes = [ctypes.c_int64, ctypes.c_char_p]
-
-        # -- shm ring --
-        lib.shm_ring_create.restype = ctypes.c_int64
-        lib.shm_ring_create.argtypes = [ctypes.c_char_p, ctypes.c_int64]
-        lib.shm_ring_attach.restype = ctypes.c_int64
-        lib.shm_ring_attach.argtypes = [ctypes.c_char_p]
-        lib.shm_ring_close.argtypes = [ctypes.c_int64, ctypes.c_int]
-        lib.shm_ring_push.restype = ctypes.c_int
-        lib.shm_ring_push.argtypes = [ctypes.c_int64, ctypes.c_char_p,
-                                      ctypes.c_int64, ctypes.c_int]
-        lib.shm_ring_pop_len.restype = ctypes.c_int64
-        lib.shm_ring_pop_len.argtypes = [ctypes.c_int64, ctypes.c_int]
-        lib.shm_ring_pop.restype = ctypes.c_int64
-        lib.shm_ring_pop.argtypes = [ctypes.c_int64, ctypes.c_void_p,
-                                     ctypes.c_int64]
+        # -- bindings: a missing symbol (stale .so that make could not
+        # refresh) must degrade to the pure-Python fallbacks, not crash
+        # every native consumer --
+        try:
+            _bind(lib)
+        except AttributeError:
+            return None
         _lib = lib
         return _lib
+
+
+def _bind(lib):
+    import ctypes
+
+    # -- tcp store --
+    lib.pts_server_start.restype = ctypes.c_int64
+    lib.pts_server_start.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.pts_server_stop.argtypes = [ctypes.c_int64]
+    lib.pts_connect.restype = ctypes.c_int64
+    lib.pts_connect.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+    lib.pts_close.argtypes = [ctypes.c_int64]
+    lib.pts_set.restype = ctypes.c_int
+    lib.pts_set.argtypes = [ctypes.c_int64, ctypes.c_char_p,
+                                ctypes.c_char_p, ctypes.c_int64]
+    lib.pts_get.restype = ctypes.c_int64
+    lib.pts_get.argtypes = [ctypes.c_int64, ctypes.c_char_p,
+                                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int]
+    lib.pts_add.restype = ctypes.c_int
+    lib.pts_add.argtypes = [ctypes.c_int64, ctypes.c_char_p,
+                                ctypes.c_int64,
+                                ctypes.POINTER(ctypes.c_int64)]
+    lib.pts_wait.restype = ctypes.c_int
+    lib.pts_wait.argtypes = [ctypes.c_int64, ctypes.c_char_p, ctypes.c_int]
+    lib.pts_delete_key.restype = ctypes.c_int
+    lib.pts_delete_key.argtypes = [ctypes.c_int64, ctypes.c_char_p]
+
+    # -- shm ring --
+    lib.shm_ring_create.restype = ctypes.c_int64
+    lib.shm_ring_create.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.shm_ring_attach.restype = ctypes.c_int64
+    lib.shm_ring_attach.argtypes = [ctypes.c_char_p]
+    lib.shm_ring_close.argtypes = [ctypes.c_int64, ctypes.c_int]
+    lib.shm_ring_push.restype = ctypes.c_int
+    lib.shm_ring_push.argtypes = [ctypes.c_int64, ctypes.c_char_p,
+                                  ctypes.c_int64, ctypes.c_int]
+    lib.shm_ring_pop_len.restype = ctypes.c_int64
+    lib.shm_ring_pop_len.argtypes = [ctypes.c_int64, ctypes.c_int]
+    lib.shm_ring_pop.restype = ctypes.c_int64
+    lib.shm_ring_pop.argtypes = [ctypes.c_int64, ctypes.c_void_p,
+                                 ctypes.c_int64]
+
+    # -- wordpiece tokenizer --
+    lib.wp_vocab_new.restype = ctypes.c_int64
+    lib.wp_vocab_new.argtypes = [ctypes.c_int32, ctypes.c_int32]
+    lib.wp_vocab_add.restype = ctypes.c_int
+    lib.wp_vocab_add.argtypes = [ctypes.c_int64, ctypes.c_char_p,
+                                 ctypes.c_int32]
+    lib.wp_vocab_free.argtypes = [ctypes.c_int64]
+    lib.wp_encode.restype = ctypes.c_int32
+    lib.wp_encode.argtypes = [ctypes.c_int64, ctypes.c_char_p,
+                              ctypes.c_int32,
+                              ctypes.POINTER(ctypes.c_int32),
+                              ctypes.c_int32]
 
 
 def available():

@@ -128,9 +128,56 @@ class WordpieceTokenizer:
         return out
 
 
+class _NativeWordpiece:
+    """ctypes front for csrc/wordpiece.cc (the faster_tokenizer_op.cc
+    analog's native core). Exact-parity gating: the C++ encoder
+    implements the ASCII BasicTokenizer rules, so the Layer dispatches
+    here only for `text.isascii()` inputs (full-unicode lowercase/NFD
+    stays in Python — the reference leans on utf8proc for that)."""
+
+    def __init__(self, vocab: Dict[str, int], unk_id: int):
+        from ..core import native as _native
+
+        self._lib = _native.load()
+        self._handle = None
+        if self._lib is None:
+            return
+        h = self._lib.wp_vocab_new(unk_id, 100)
+        for tok, i in vocab.items():
+            self._lib.wp_vocab_add(h, tok.encode("utf-8"), int(i))
+        self._handle = h
+
+    @property
+    def ok(self):
+        return self._handle is not None
+
+    def encode(self, text: str, do_lower: bool) -> List[int]:
+        import ctypes
+
+        cap = max(64, 2 * len(text) + 8)
+        while True:
+            buf = (ctypes.c_int32 * cap)()
+            n = self._lib.wp_encode(self._handle, text.encode("utf-8"),
+                                    1 if do_lower else 0, buf, cap)
+            if n >= 0:
+                return list(buf[:n])
+            if n == -(2 ** 31):
+                raise RuntimeError("native wordpiece: bad vocab handle")
+            cap = -n  # buffer was too small: retry with the exact size
+
+    def __del__(self):
+        try:
+            if self._handle is not None and self._lib is not None:
+                self._lib.wp_vocab_free(self._handle)
+        except Exception:
+            pass
+
+
 class FasterTokenizer(Layer):
     """BERT-style tokenizer layer (reference faster_tokenizer_op.cc): text
-    (and optional text_pair) -> (input_ids, token_type_ids) int64 Tensors."""
+    (and optional text_pair) -> (input_ids, token_type_ids) int64 Tensors.
+    ASCII inputs encode through the native C++ core (csrc/wordpiece.cc);
+    anything needing unicode lowercase/NFD takes the Python path."""
 
     def __init__(self, vocab: Union[Dict[str, int], str],
                  do_lower_case: bool = True, is_split_into_words: bool = False):
@@ -145,11 +192,18 @@ class FasterTokenizer(Layer):
         self.cls_id = self.vocab.get("[CLS]", 0)
         self.sep_id = self.vocab.get("[SEP]", 0)
         self.pad_id = self.vocab.get("[PAD]", 0)
+        unk_id = self.vocab.get(self.wordpiece.unk_token, 0)
+        self._native = _NativeWordpiece(self.vocab, unk_id)
 
     # -- string -> subword ids ----------------------------------------------
     def _encode_one(self, text: str) -> List[int]:
         if self.is_split_into_words:
             words = list(text) if isinstance(text, str) else list(text)
+        elif (self._native.ok and isinstance(text, str)
+                and text.isascii() and "\x00" not in text):
+            # NUL would pass isascii() but truncate the C string; the
+            # Python path skips NULs and keeps encoding
+            return self._native.encode(text, self.do_lower_case)
         else:
             words = self.basic.tokenize(text)
         ids = []

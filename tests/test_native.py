@@ -182,3 +182,63 @@ def test_dataloader_multiprocess_shm():
         assert x.shape == (4, 8)
         seen.extend(int(v) for v in y.numpy())
     assert seen == list(range(40))
+
+
+def test_native_wordpiece_parity_fuzz():
+    """csrc/wordpiece.cc vs the pure-Python BasicTokenizer+Wordpiece on
+    randomized ASCII corpora (the native path's exact-parity gate), plus
+    buffer regrowth and unicode fallback."""
+    import random
+
+    from paddle_tpu.text.tokenizer import (BasicTokenizer, FasterTokenizer,
+                                           WordpieceTokenizer)
+
+    vocab = {"[PAD]": 0, "[UNK]": 1, "[CLS]": 2, "[SEP]": 3}
+    words = ["the", "fox", "jump", "dog", "run", "over", "a", "un", "word"]
+    subs = ["##s", "##ed", "##ing", "##er", "##x", "##un"]
+    for w in words + subs + [",", ".", "!", "'"]:
+        vocab.setdefault(w, len(vocab))
+    tok = FasterTokenizer(vocab)
+    if not tok._native.ok:
+        pytest.skip("native toolchain unavailable")
+
+    basic = BasicTokenizer(True)
+    wp = WordpieceTokenizer(vocab)
+
+    def py_encode(t):
+        return [vocab.get(s, vocab["[UNK]"])
+                for w in basic.tokenize(t) for s in wp.tokenize(w)]
+
+    rng = random.Random(0)
+    pieces = words + [w[2:] for w in subs] + [",", ".", "!", "'", "ZZZ",
+                                             "Mixed", "    ", "\t", "\n"]
+    for case in range(60):
+        text = "".join(rng.choice(pieces + [" "])
+                       for _ in range(rng.randrange(0, 60)))
+        assert tok._native.encode(text, True) == py_encode(text), repr(text)
+
+    long_text = " ".join(rng.choice(words) for _ in range(500))
+    assert tok._native.encode(long_text, True) == py_encode(long_text)
+
+    # the buffer-too-small protocol, exercised directly with a tiny cap
+    import ctypes
+
+    lib = tok._native._lib
+    tiny = (ctypes.c_int32 * 2)()
+    n = lib.wp_encode(tok._native._handle, b"the fox jumps", 1, tiny, 2)
+    assert n < 0 and n != -(2 ** 31)
+    need = -n
+    buf = (ctypes.c_int32 * need)()
+    n2 = lib.wp_encode(tok._native._handle, b"the fox jumps", 1, buf, need)
+    assert n2 == need
+    assert list(buf[:n2]) == py_encode("the fox jumps")
+    # bad handle reports the sentinel, not a fake size
+    assert lib.wp_encode(999999, b"x", 1, tiny, 2) == -(2 ** 31)
+
+    # NUL bytes bypass the native gate (C strings truncate at NUL)
+    nul_text = "the\x00fox"
+    assert tok._encode_one(nul_text) == py_encode(nul_text)
+
+    # unicode input routes through the python path and still encodes
+    ids, _ = tok(["café the fox"])
+    assert ids.shape[0] == 1
