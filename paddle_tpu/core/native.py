@@ -22,6 +22,17 @@ _lib = None
 _tried = False
 
 
+def _needs_build():
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    for f in os.listdir(_CSRC):
+        if f.endswith((".cc", ".h")) and os.path.getmtime(
+                os.path.join(_CSRC, f)) > lib_mtime:
+            return True
+    return False
+
+
 def _build():
     subprocess.run(
         ["make", "-s", "-C", _CSRC],
@@ -41,16 +52,14 @@ def load():
             return _lib
         _tried = True
         try:
-            if not os.path.exists(_LIB_PATH):
-                if not os.path.isdir(_CSRC):
-                    return None
+            if not os.path.isdir(_CSRC):
+                return None
+            # decide staleness BEFORE the first dlopen: reloading after a
+            # rebuild cannot work in-process (dlopen dedupes by pathname
+            # and ctypes never dlcloses), so a stale handle would stick
+            if _needs_build():
                 _build()
             lib = ctypes.CDLL(_LIB_PATH)
-            if not hasattr(lib, "wp_encode"):
-                # stale prebuilt .so from before a source addition: rebuild
-                # once (make compares timestamps) and reload
-                _build()
-                lib = ctypes.CDLL(_LIB_PATH)
         except (OSError, subprocess.SubprocessError):
             return None
 
@@ -66,8 +75,6 @@ def load():
 
 
 def _bind(lib):
-    import ctypes
-
     # -- tcp store --
     lib.pts_server_start.restype = ctypes.c_int64
     lib.pts_server_start.argtypes = [ctypes.c_char_p, ctypes.c_int]

@@ -192,8 +192,15 @@ class FasterTokenizer(Layer):
         self.cls_id = self.vocab.get("[CLS]", 0)
         self.sep_id = self.vocab.get("[SEP]", 0)
         self.pad_id = self.vocab.get("[PAD]", 0)
-        unk_id = self.vocab.get(self.wordpiece.unk_token, 0)
-        self._native = _NativeWordpiece(self.vocab, unk_id)
+        self._native_obj = None    # built lazily: construction may run
+                                   # the C++ build and ~|vocab| FFI adds
+
+    @property
+    def _native(self):
+        if self._native_obj is None:
+            unk_id = self.vocab.get(self.wordpiece.unk_token, 0)
+            self._native_obj = _NativeWordpiece(self.vocab, unk_id)
+        return self._native_obj
 
     # -- string -> subword ids ----------------------------------------------
     def _encode_one(self, text: str) -> List[int]:

@@ -1,0 +1,163 @@
+"""Autograd fuzzing: random op DAGs through the tape vs jax.grad of the
+same pure function (the reference's numeric-FD OpTest idea, upgraded to
+an exact analytical oracle). Exercises the composition corners targeted
+tests miss: shared subexpressions (fan-out accumulation), broadcasts,
+reductions, reshapes/slices, chained elementwise/matmul mixes, and the
+same graphs replayed under jit.compile's state threading.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+pytestmark = pytest.mark.slow
+
+
+# each entry: (name, tensor_fn, pure_fn)
+_BINARY = [
+    ("add", lambda a, b: a + b, lambda a, b: a + b),
+    ("mul", lambda a, b: a * b, lambda a, b: a * b),
+    ("sub", lambda a, b: a - b, lambda a, b: a - b),
+    ("max", lambda a, b: paddle.maximum(a, b), jnp.maximum),
+]
+_UNARY = [
+    ("tanh", lambda a: a.tanh(), jnp.tanh),
+    ("exp", lambda a: (a * 0.3).exp(), lambda a: jnp.exp(a * 0.3)),
+    ("relu", lambda a: paddle.nn.functional.relu(a), jax.nn.relu),
+    ("square", lambda a: a * a, lambda a: a * a),
+    ("neg", lambda a: -a, lambda a: -a),
+    ("sigmoid", lambda a: paddle.nn.functional.sigmoid(a), jax.nn.sigmoid),
+    ("transpose", lambda a: a.t(), lambda a: a.T),
+    ("slice", lambda a: a[1:, :], lambda a: a[1:, :]),
+    ("reshape", lambda a: a.reshape([-1, a.shape[0]]),
+     lambda a: a.reshape(-1, a.shape[0])),
+]
+
+
+def _random_graph(rng, n_inputs, n_ops):
+    """A reproducible random DAG program: list of (kind, op_idx, srcs)."""
+    prog = []
+    avail = n_inputs
+    for _ in range(n_ops):
+        if rng.rand() < 0.45:
+            prog.append(("u", rng.randint(len(_UNARY)), (rng.randint(avail),)))
+        else:
+            prog.append(("b", rng.randint(len(_BINARY)),
+                         (rng.randint(avail), rng.randint(avail))))
+        avail += 1
+    return prog
+
+
+def _run(prog, vals, tensor_mode):
+    nodes = list(vals)
+    for kind, op_idx, srcs in prog:
+        if kind == "u":
+            name, t_fn, p_fn = _UNARY[op_idx]
+            fn = t_fn if tensor_mode else p_fn
+            out = fn(nodes[srcs[0]])
+        else:
+            name, t_fn, p_fn = _BINARY[op_idx]
+            a, b = nodes[srcs[0]], nodes[srcs[1]]
+            ashape = tuple(a.shape)
+            bshape = tuple(b.shape)
+            if ashape != bshape:
+                # shapes diverged (transpose/slice/reshape): fall back to
+                # an elementwise op on the first operand only
+                out = a * 0.5
+            else:
+                fn = t_fn if tensor_mode else p_fn
+                out = fn(a, b)
+        nodes.append(out)
+    # loss touches EVERY node so every path contributes gradient
+    if tensor_mode:
+        total = None
+        for nd in nodes:
+            term = (nd * nd).sum()
+            total = term if total is None else total + term
+        return total
+    total = 0.0
+    for nd in nodes:
+        total = total + jnp.sum(nd * nd)
+    return total
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_tape_grads_match_jax_grad(seed):
+    rng = np.random.RandomState(seed)
+    n_inputs = rng.randint(2, 4)
+    shape = (4, 4)
+    arrays = [rng.randn(*shape).astype("float32") * 0.5
+              for _ in range(n_inputs)]
+    prog = _random_graph(rng, n_inputs, rng.randint(4, 9))
+
+    # tape path
+    tensors = [paddle.to_tensor(a.copy()) for a in arrays]
+    for t in tensors:
+        t.stop_gradient = False
+    loss = _run(prog, tensors, tensor_mode=True)
+    loss.backward()
+    tape_grads = [t.grad.numpy() for t in tensors]
+
+    # analytical oracle
+    def pure(*xs):
+        return _run(prog, list(xs), tensor_mode=False)
+
+    ref_grads = jax.grad(pure, argnums=tuple(range(n_inputs)))(
+        *[jnp.asarray(a) for a in arrays])
+    ref_loss = pure(*[jnp.asarray(a) for a in arrays])
+    np.testing.assert_allclose(float(loss.numpy()), float(ref_loss),
+                               rtol=2e-4, err_msg=f"loss seed={seed}")
+    for i, (tg, rg) in enumerate(zip(tape_grads, ref_grads)):
+        np.testing.assert_allclose(tg, np.asarray(rg), rtol=2e-4, atol=2e-5,
+                                   err_msg=f"grad[{i}] seed={seed}")
+
+
+@pytest.mark.parametrize("seed", [0, 3, 7])
+def test_jit_compiled_graph_matches_eager(seed):
+    """The same random graph as a jit.compile'd 'train step' (parameters
+    threaded as state) must produce identical losses and updates."""
+    from paddle_tpu import jit, optimizer
+    from paddle_tpu import nn
+
+    rng = np.random.RandomState(seed)
+    prog = _random_graph(rng, 2, rng.randint(4, 8))
+
+    def build():
+        paddle.seed(seed)
+        layer = nn.Linear(4, 4)
+        opt = optimizer.SGD(learning_rate=0.05,
+                            parameters=layer.parameters())
+        return layer, opt
+
+    x_np = rng.randn(4, 4).astype("float32") * 0.5
+
+    def make_step(layer, opt):
+        def step(x):
+            h = layer(x)
+            loss = _run(prog, [h, x], tensor_mode=True)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+        return step
+
+    layer_e, opt_e = build()
+    step_e = make_step(layer_e, opt_e)
+    eager_losses = [float(step_e(paddle.to_tensor(x_np)).numpy())
+                    for _ in range(3)]
+
+    layer_j, opt_j = build()
+    step_j = jit.compile(make_step(layer_j, opt_j), models=[layer_j],
+                         optimizers=[opt_j])
+    jit_losses = [float(step_j(paddle.to_tensor(x_np)).numpy())
+                  for _ in range(3)]
+
+    np.testing.assert_allclose(eager_losses, jit_losses, rtol=2e-4,
+                               err_msg=f"seed={seed}")
+    np.testing.assert_allclose(layer_e.weight.numpy(),
+                               layer_j.weight.numpy(), rtol=2e-4,
+                               atol=2e-5)
