@@ -161,3 +161,49 @@ def test_jit_compiled_graph_matches_eager(seed):
     np.testing.assert_allclose(layer_e.weight.numpy(),
                                layer_j.weight.numpy(), rtol=2e-4,
                                atol=2e-5)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_double_backward_fuzz(seed):
+    """grad-of-grad on random smooth DAGs: paddle.grad(create_graph=True)
+    then a second backward, vs jax.grad(jax.grad) of the pure function
+    (the GeneralGrad analog under arbitrary composition)."""
+    # sample from the GLOBAL tables restricted to smooth (twice-
+    # differentiable, shape-preserving) ops, so _run serves unchanged
+    smooth_u = [i for i, u in enumerate(_UNARY)
+                if u[0] in ("tanh", "exp", "square", "neg", "sigmoid")]
+    smooth_b = [i for i, b in enumerate(_BINARY)
+                if b[0] in ("add", "mul", "sub")]
+
+    rng = np.random.RandomState(100 + seed)
+    prog = []
+    avail = 1
+    for _ in range(rng.randint(3, 6)):
+        if rng.rand() < 0.5:
+            prog.append(("u", smooth_u[rng.randint(len(smooth_u))],
+                         (rng.randint(avail),)))
+        else:
+            prog.append(("b", smooth_b[rng.randint(len(smooth_b))],
+                         (rng.randint(avail), rng.randint(avail))))
+        avail += 1
+
+    x_np = (np.random.RandomState(seed).randn(3, 3) * 0.4).astype("float32")
+
+    # tape: first grad with create_graph, then backward of its norm
+    x = paddle.to_tensor(x_np.copy())
+    x.stop_gradient = False
+    loss = _run(prog, [x], tensor_mode=True)
+    (g1,) = paddle.grad([loss], [x], create_graph=True)
+    (g1 * g1).sum().backward()
+    tape_gg = x.grad.numpy()
+
+    # oracle: d/dx ||grad f(x)||^2
+    def pure(xa):
+        return _run(prog, [xa], tensor_mode=False)
+
+    def gnorm(xa):
+        return jnp.sum(jax.grad(pure)(xa) ** 2)
+
+    ref_gg = jax.grad(gnorm)(jnp.asarray(x_np))
+    np.testing.assert_allclose(tape_gg, np.asarray(ref_gg), rtol=5e-4,
+                               atol=5e-5, err_msg=f"seed={seed}")
