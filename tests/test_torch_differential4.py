@@ -1,0 +1,140 @@
+"""Differential suite part 4: einsum over randomized specs, matmul
+broadcasting/transpose-flag combinations, and the dense linalg family —
+contraction machinery where a silent axis-order bug produces
+right-shaped wrong numbers. Oracles: numpy for einsum (exact spec
+semantics), torch for matmul/linalg.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+torch = pytest.importorskip("torch")
+
+from _torch_diff_util import torch_close  # noqa: E402
+
+pytestmark = pytest.mark.slow
+
+
+def test_einsum_random_specs():
+    """Random contraction specs built from a shared index pool: build the
+    operands to match the spec, compare against np.einsum, and check the
+    gradient of the sum against jax's (via the tape)."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    dims = {"a": 2, "b": 3, "c": 4, "d": 2, "e": 3, "f": 2}
+    letters = list(dims)
+
+    for case in range(25):
+        n_ops = rng.randint(1, 3 + 1)
+        subs = []
+        for _ in range(n_ops):
+            k = rng.randint(1, 5)
+            subs.append("".join(rng.choice(letters, size=k, replace=False)))
+        # output: subset of the appearing indices, unique, random order
+        appearing = sorted(set("".join(subs)))
+        n_out = rng.randint(0, len(appearing) + 1)
+        out_idx = list(rng.permutation(appearing)[:n_out])
+        spec = ",".join(subs) + "->" + "".join(out_idx)
+        ops_np = [rng.randn(*[dims[ch] for ch in s]).astype("float32")
+                  for s in subs]
+
+        ref = np.einsum(spec, *ops_np)
+        got = paddle.einsum(spec, *[paddle.to_tensor(o) for o in ops_np])
+        np.testing.assert_allclose(np.asarray(got.numpy(), np.float32), ref,
+                                   rtol=1e-4, atol=1e-5, err_msg=spec)
+
+        # gradient of sum(out) w.r.t. the first operand
+        ts = [paddle.to_tensor(o.copy()) for o in ops_np]
+        ts[0].stop_gradient = False
+        paddle.einsum(spec, *ts).sum().backward()
+
+        def pure(x0):
+            return jnp.einsum(spec, x0,
+                              *[jnp.asarray(o) for o in ops_np[1:]]).sum()
+
+        ref_g = jax.grad(pure)(jnp.asarray(ops_np[0]))
+        np.testing.assert_allclose(ts[0].grad.numpy(), np.asarray(ref_g),
+                                   rtol=1e-4, atol=1e-5,
+                                   err_msg=spec + " grad")
+
+
+def test_matmul_broadcast_and_flags_vs_torch():
+    rng = np.random.RandomState(1)
+    cases = [
+        ((4, 5), (5, 3), False, False),
+        ((5, 4), (5, 3), True, False),
+        ((4, 5), (3, 5), False, True),
+        ((5, 4), (3, 5), True, True),
+        ((2, 4, 5), (2, 5, 3), False, False),
+        ((2, 3, 4, 5), (2, 3, 5, 6), False, False),
+        ((1, 4, 5), (7, 5, 3), False, False),     # batch broadcast
+        ((2, 1, 4, 5), (1, 3, 5, 6), False, False),
+        ((5,), (5,), False, False),               # vec·vec
+        ((4, 5), (5,), False, False),             # mat·vec
+        ((5,), (5, 3), False, False),             # vec·mat
+    ]
+    for ashape, bshape, tx, ty in cases:
+        a = rng.randn(*ashape).astype("float32")
+        b = rng.randn(*bshape).astype("float32")
+        at = torch.tensor(a).transpose(-1, -2) if tx else torch.tensor(a)
+        bt = torch.tensor(b).transpose(-1, -2) if ty else torch.tensor(b)
+        ref = torch.matmul(at, bt)
+        got = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b),
+                            transpose_x=tx, transpose_y=ty)
+        torch_close(got, ref, rtol=1e-4, atol=1e-5,
+                    tag=f"{ashape}x{bshape} tx={tx} ty={ty}")
+
+
+def test_linalg_vs_torch():
+    rng = np.random.RandomState(2)
+    a = rng.randn(5, 5).astype("float32")
+    spd = (a @ a.T + 5 * np.eye(5)).astype("float32")
+    b = rng.randn(5, 3).astype("float32")
+
+    torch_close(paddle.linalg.solve(paddle.to_tensor(spd),
+                                    paddle.to_tensor(b)),
+                torch.linalg.solve(torch.tensor(spd), torch.tensor(b)),
+                rtol=1e-3, atol=1e-4, tag="solve")
+    torch_close(paddle.linalg.cholesky(paddle.to_tensor(spd)),
+                torch.linalg.cholesky(torch.tensor(spd)),
+                rtol=1e-3, atol=1e-4, tag="cholesky")
+    torch_close(paddle.linalg.inv(paddle.to_tensor(spd)),
+                torch.linalg.inv(torch.tensor(spd)),
+                rtol=1e-3, atol=1e-4, tag="inv")
+    tri = np.tril(a) + 5 * np.eye(5, dtype="float32")
+    torch_close(
+        paddle.linalg.triangular_solve(paddle.to_tensor(tri),
+                                       paddle.to_tensor(b), upper=False),
+        torch.linalg.solve_triangular(torch.tensor(tri), torch.tensor(b),
+                                      upper=False),
+        rtol=1e-3, atol=1e-4, tag="triangular_solve")
+    torch_close(paddle.linalg.matrix_power(paddle.to_tensor(spd), 3),
+                torch.linalg.matrix_power(torch.tensor(spd), 3),
+                rtol=1e-2, atol=1e-2, tag="matrix_power")
+    # slogdet: sign + log|det|
+    ours = paddle.linalg.slogdet(paddle.to_tensor(spd))
+    sign, logdet = torch.linalg.slogdet(torch.tensor(spd))
+    got = np.asarray(ours.numpy() if hasattr(ours, "numpy")
+                     else [o.numpy() for o in ours], np.float32).reshape(-1)
+    np.testing.assert_allclose(got, [float(sign), float(logdet)],
+                               rtol=1e-4, atol=1e-5, err_msg="slogdet")
+
+
+def test_outer_kron_trace_vs_torch():
+    rng = np.random.RandomState(3)
+    a = rng.randn(4).astype("float32")
+    b = rng.randn(6).astype("float32")
+    m = rng.randn(3, 4).astype("float32")
+    n = rng.randn(2, 2).astype("float32")
+    torch_close(paddle.outer(paddle.to_tensor(a), paddle.to_tensor(b)),
+                torch.outer(torch.tensor(a), torch.tensor(b)), tag="outer")
+    torch_close(paddle.kron(paddle.to_tensor(m), paddle.to_tensor(n)),
+                torch.kron(torch.tensor(m), torch.tensor(n)), tag="kron")
+    sq = rng.randn(5, 5).astype("float32")
+    torch_close(paddle.trace(paddle.to_tensor(sq)),
+                torch.trace(torch.tensor(sq)), tag="trace")
+    torch_close(paddle.trace(paddle.to_tensor(sq), offset=1),
+                torch.tensor(np.trace(sq, offset=1)), tag="trace-offset")
