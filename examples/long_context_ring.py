@@ -1,0 +1,70 @@
+"""Long-context training with context parallelism: the sequence stays
+sharded over the 'sp' mesh axis straight through attention (ring
+attention, parallel/ring.py), with the zigzag layout load-balancing the
+causal ring — the capability the reference snapshot lacks entirely
+(SURVEY §5.7) and the long-context answer of this framework.
+
+Runs on the 8-virtual-device CPU mesh out of the box; on a TPU pod the
+same code spans real chips.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("PTPU_FORCE_PLATFORM", "cpu")   # drop on a TPU host
+import jax
+
+if os.environ.get("PTPU_FORCE_PLATFORM") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu import jit, optimizer, parallel
+from paddle_tpu.models import (GPTForCausalLM, GPTPretrainingCriterion,
+                               gpt_test_config)
+
+SEQ = 512                       # 8x the per-device slice
+parallel.init_mesh(sp=8)        # all 8 ways go to sequence
+paddle.seed(0)
+
+cfg = gpt_test_config(
+    num_hidden_layers=2,
+    max_position_embeddings=SEQ,
+    context_parallel=True,      # seq sharded THROUGH attention
+    cp_layout="zigzag",         # balanced causal ring
+)
+model = parallel.place_model(GPTForCausalLM(cfg))
+crit = GPTPretrainingCriterion(cfg)
+opt = optimizer.AdamW(learning_rate=3e-4, parameters=model.parameters())
+
+
+def step(ids, labels):
+    loss = crit(model(ids), labels)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    return loss
+
+
+train_step = jit.compile(step, models=[model], optimizers=[opt])
+
+rng = np.random.RandomState(0)
+ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (2, SEQ)).astype("int32"))
+labels = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (2, SEQ)).astype("int32"))
+
+losses = [float(train_step(ids, labels).numpy()) for _ in range(6)]
+print("losses:", " ".join(f"{v:.4f}" for v in losses))
+assert losses[-1] < losses[0]
+
+# parity spot-check: the contiguous ring gives the same first loss
+paddle.seed(0)
+cfg2 = gpt_test_config(num_hidden_layers=2, max_position_embeddings=SEQ,
+                       context_parallel=True, cp_layout="contiguous")
+model2 = parallel.place_model(GPTForCausalLM(cfg2))
+crit2 = GPTPretrainingCriterion(cfg2)
+first = float(jit.compile(lambda a, b: crit2(model2(a), b),
+                          models=[model2])(ids, labels).numpy())
+assert abs(first - losses[0]) < 2e-4, (first, losses[0])
+print(f"zigzag first loss {losses[0]:.4f} == contiguous {first:.4f}")
+print("OK — long-context training over the sp ring (zigzag balanced)")
