@@ -1,0 +1,74 @@
+"""Serialization round-trip fuzzing: random layer stacks through
+jit.save -> jit.load (TranslatedLayer analog) and
+save_inference_model -> Predictor (AnalysisPredictor analog), asserting
+output parity with the live model — the composition coverage the
+targeted save/load tests don't reach (conv/BN/pool/activation mixes,
+multiple dtypes of input, eval-mode buffers).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import jit, nn
+
+pytestmark = pytest.mark.slow
+
+
+def _random_stack(rng):
+    """A random eval-mode model: conv trunk then MLP head."""
+    layers = []
+    c = 3
+    for _ in range(rng.randint(1, 3)):
+        c_out = int(rng.choice([4, 8]))
+        layers.append(nn.Conv2D(c, c_out, 3, padding=1))
+        if rng.rand() < 0.5:
+            layers.append(nn.BatchNorm2D(c_out))
+        layers.append([nn.ReLU(), nn.GELU(), nn.Sigmoid()][rng.randint(3)])
+        if rng.rand() < 0.5:
+            layers.append(nn.MaxPool2D(2, 2))
+        c = c_out
+    layers.append(nn.AdaptiveAvgPool2D(1))
+    layers.append(nn.Flatten())
+    layers.append(nn.Linear(c, int(rng.choice([2, 5]))))
+    return nn.Sequential(*layers)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_jit_save_load_roundtrip_fuzz(seed, tmp_path):
+    rng = np.random.RandomState(seed)
+    paddle.seed(seed)
+    model = _random_stack(rng)
+    model.eval()
+    x = paddle.to_tensor(rng.randn(2, 3, 16, 16).astype("float32"))
+    ref = model(x).numpy()
+
+    path = str(tmp_path / f"m{seed}")
+    jit.save(model, path, input_spec=[x])
+    loaded = jit.load(path)
+    out = loaded(x)
+    out = out[0] if isinstance(out, (list, tuple)) else out
+    np.testing.assert_allclose(out.numpy(), ref, rtol=2e-5, atol=2e-6)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_inference_predictor_roundtrip_fuzz(seed, tmp_path):
+    from paddle_tpu import inference
+
+    rng = np.random.RandomState(10 + seed)
+    paddle.seed(seed)
+    model = _random_stack(rng)
+    model.eval()
+    x = rng.randn(2, 3, 16, 16).astype("float32")
+    ref = model(paddle.to_tensor(x)).numpy()
+
+    path = str(tmp_path / f"p{seed}")
+    inference.save_inference_model(path, model,
+                                   example_inputs=[paddle.to_tensor(x)])
+    cfg = inference.Config(prog_file=path)
+    pred = inference.create_predictor(cfg)
+    in_names = pred.get_input_names()
+    h = pred.get_input_handle(in_names[0])
+    h.copy_from_cpu(x)
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5, atol=2e-6)
