@@ -230,3 +230,42 @@ def test_norm_layers_vs_torch():
                         torch.tensor(b), eps=1e-5)
     out = F.group_norm(_t(x), 3, weight=_t(w), bias=_t(b), epsilon=1e-5)
     _close(out, ref, tag="gn")
+
+
+def test_conv3d_pool3d_vs_torch():
+    """conv3d / conv1d / avg_pool3d / max_pool1d parity (the N-d variants
+    share _conv_nd/_pool_nd with the fuzzed 2-D paths; this pins the
+    dimension plumbing)."""
+    rng = np.random.RandomState(5)
+    x3 = rng.randn(2, 3, 5, 6, 7).astype("float32")
+    w3 = rng.randn(4, 3, 2, 3, 3).astype("float32")
+    ref = tF.conv3d(torch.tensor(x3), torch.tensor(w3), stride=2, padding=1)
+    got = F.conv3d(_t(x3), _t(w3), stride=2, padding=1)
+    _close(got, ref, tag="conv3d")
+
+    x1 = rng.randn(2, 4, 19).astype("float32")
+    w1 = rng.randn(6, 2, 3).astype("float32")
+    ref = tF.conv1d(torch.tensor(x1), torch.tensor(w1), stride=2, padding=2,
+                    dilation=2, groups=2)
+    got = F.conv1d(_t(x1), _t(w1), stride=2, padding=2, dilation=2, groups=2)
+    _close(got, ref, tag="conv1d-grouped-dilated")
+
+    ref = tF.avg_pool3d(torch.tensor(x3), 2, stride=2,
+                        count_include_pad=False)
+    got = F.avg_pool3d(_t(x3), 2, stride=2, exclusive=True)
+    _close(got, ref, tag="avg_pool3d")
+
+    ref = tF.max_pool1d(torch.tensor(x1), 3, stride=2, padding=1)
+    got = F.max_pool1d(_t(x1), 3, stride=2, padding=1)
+    _close(got, ref, tag="max_pool1d")
+
+    # conv3d gradient parity
+    xt = torch.tensor(x3, requires_grad=True)
+    wt = torch.tensor(w3, requires_grad=True)
+    tF.conv3d(xt, wt, stride=1, padding=1).sum().backward()
+    xp, wp = _t(x3), _t(w3)
+    xp.stop_gradient = False
+    wp.stop_gradient = False
+    F.conv3d(xp, wp, stride=1, padding=1).sum().backward()
+    _close(xp.grad, xt.grad, rtol=1e-3, atol=1e-4, tag="conv3d dx")
+    _close(wp.grad, wt.grad, rtol=1e-3, atol=1e-4, tag="conv3d dw")
