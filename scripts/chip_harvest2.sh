@@ -37,3 +37,26 @@ for b in 128 256; do
     python bench.py --config resnet50
 done
 echo "$(date -u) resnet sweep complete"
+
+# persist results into the repo: the driver commits uncommitted work at
+# round end, so a summary file survives even if the session is out of
+# turns when the tunnel finally returns
+{
+  echo "# Wave-2 harvest results ($(date -u))"
+  echo
+  for f in /tmp/harvest/gpt3_1p3b.log /tmp/harvest/bisect_try1.log \
+           /tmp/harvest/bisect_try2.log /tmp/harvest/decode_xla.log \
+           /tmp/harvest/decode_pallas.log /tmp/harvest/decode_unroll2.log \
+           /tmp/harvest/decode_unroll4.log /tmp/harvest/decode_long_xla.log \
+           /tmp/harvest/decode_long_pallas.log /tmp/harvest/profile_resnet.log \
+           /tmp/harvest/profile_train2.log /tmp/harvest/resnet_b128.log \
+           /tmp/harvest/resnet_b256.log; do
+    [ -f "$f" ] || continue
+    echo "## $(basename "$f")"
+    echo '```'
+    grep -v "WARNING" "$f" | tail -40
+    echo '```'
+    echo
+  done
+} > "$(dirname "$0")/../HARVEST2_RESULTS.md"
+echo "$(date -u) results persisted to HARVEST2_RESULTS.md"
