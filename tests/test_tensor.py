@@ -200,3 +200,54 @@ def test_string_tensor_indexing():
     v = {t: i for i, t in enumerate(["[PAD]", "[UNK]", "[CLS]", "[SEP]", "a", "bb"])}
     ids, _ = FasterTokenizer(v)(StringTensor(["a bb"]).tolist())
     assert ids.numpy().tolist()[0] == [2, 4, 5, 3]
+
+
+def test_indexing_parity_vs_numpy():
+    """__getitem__/__setitem__ across the numpy indexing forms (int/neg/
+    slice/step/neg-step/ellipsis/newaxis/fancy/bool-mask/mixed): shapes
+    and values must match numpy exactly."""
+    rng = np.random.RandomState(0)
+    base = rng.randn(4, 5, 6).astype("float32")
+    t = paddle.to_tensor(base)
+
+    cases = [
+        (lambda a: a[1], "int"),
+        (lambda a: a[-1], "neg int"),
+        (lambda a: a[1:3], "slice"),
+        (lambda a: a[::2], "step"),
+        (lambda a: a[::-1], "neg step"),
+        (lambda a: a[1, 2:4], "mixed"),
+        (lambda a: a[..., 1], "ellipsis"),
+        (lambda a: a[:, None, :, 2], "newaxis"),
+        (lambda a: a[[0, 2, 3]], "int list"),
+        (lambda a: a[np.array([0, 2])], "int array"),
+        (lambda a: a[[0, 1], [1, 2]], "paired fancy"),
+        (lambda a: a[a[:, 0, 0] > 0], "bool mask rows"),
+        (lambda a: a[1:, [0, 2]], "slice+fancy"),
+    ]
+    for fn, name in cases:
+        ref = fn(base)
+        got = fn(t)
+        got_np = got.numpy() if hasattr(got, "numpy") else np.asarray(got)
+        assert got_np.shape == ref.shape, name
+        np.testing.assert_allclose(got_np, ref, err_msg=name)
+
+    s = base.copy()
+    s[1:3, 2] = 7.0
+    ts = paddle.to_tensor(base.copy())
+    ts[1:3, 2] = 7.0
+    np.testing.assert_allclose(ts.numpy(), s)
+
+    s2 = base.copy()
+    s2[s2 > 0] = 0.0
+    ts2 = paddle.to_tensor(base.copy())
+    ts2[ts2 > 0] = 0.0
+    np.testing.assert_allclose(ts2.numpy(), s2)
+
+    # gradient flows through indexing reads
+    g = paddle.to_tensor(base.copy())
+    g.stop_gradient = False
+    g[1:3, ::2].sum().backward()
+    mask = np.zeros_like(base)
+    mask[1:3, ::2] = 1.0
+    np.testing.assert_allclose(g.grad.numpy(), mask)
