@@ -138,3 +138,45 @@ def test_outer_kron_trace_vs_torch():
                 torch.trace(torch.tensor(sq)), tag="trace")
     torch_close(paddle.trace(paddle.to_tensor(sq), offset=1),
                 torch.tensor(np.trace(sq, offset=1)), tag="trace-offset")
+
+
+def test_cumulative_and_sorting_vs_torch():
+    """cumsum/cumprod/logcumsumexp, sort/argsort/topk/kthvalue,
+    searchsorted, median (even-count averaging), mode — tie and prefix
+    semantics checked against torch."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 7).astype("float32")
+    xt = torch.tensor(x)
+    xp = paddle.to_tensor(x)
+
+    torch_close(paddle.cumsum(xp, axis=1), torch.cumsum(xt, 1),
+                tag="cumsum")
+    torch_close(paddle.cumprod(xp, dim=1), torch.cumprod(xt, 1),
+                tag="cumprod")
+    torch_close(paddle.logcumsumexp(xp, axis=1), torch.logcumsumexp(xt, 1),
+                tag="logcumsumexp")
+    torch_close(paddle.sort(xp, axis=1), torch.sort(xt, 1).values,
+                tag="sort")
+    np.testing.assert_array_equal(
+        np.asarray(paddle.argsort(xp, axis=1).numpy()),
+        torch.argsort(xt, 1).numpy(), err_msg="argsort")
+    tv, ti = torch.topk(xt, 3, dim=1)
+    pv, pi = paddle.topk(xp, 3, axis=1)
+    torch_close(pv, tv, tag="topk.v")
+    np.testing.assert_array_equal(np.asarray(pi.numpy()), ti.numpy(),
+                                  err_msg="topk.i")
+    kv, _ = paddle.kthvalue(xp, 2, axis=1)
+    tkv, _ = torch.kthvalue(xt, 2, dim=1)
+    torch_close(kv, tkv, tag="kthvalue")
+    sortedx = np.sort(x[0])
+    np.testing.assert_array_equal(
+        np.asarray(paddle.searchsorted(paddle.to_tensor(sortedx),
+                                       paddle.to_tensor(x[1])).numpy()),
+        torch.searchsorted(torch.tensor(sortedx),
+                           torch.tensor(x[1])).numpy(),
+        err_msg="searchsorted")
+    torch_close(paddle.median(xp, axis=1), torch.quantile(xt, 0.5, dim=1),
+                tag="median-even-avg")
+    mv, _ = paddle.mode(xp, axis=1)
+    tmv, _ = torch.mode(xt, 1)
+    torch_close(mv, tmv, tag="mode")
