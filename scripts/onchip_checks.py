@@ -18,7 +18,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
-def _mha_ref(q, k, v, causal):
+def _mha_ref(q, k, v, causal, mask=None):
     import jax
     import jax.numpy as jnp
 
@@ -27,8 +27,10 @@ def _mha_ref(q, k, v, causal):
     logits = logits / np.sqrt(q.shape[-1])
     if causal:
         sq, sk = logits.shape[-2], logits.shape[-1]
-        mask = np.tril(np.ones((sq, sk), bool))
-        logits = jnp.where(jnp.asarray(mask), logits, -1e30)
+        cmask = np.tril(np.ones((sq, sk), bool))
+        logits = jnp.where(jnp.asarray(cmask), logits, -1e30)
+    if mask is not None:
+        logits = logits + mask.astype(logits.dtype)
     probs = jax.nn.softmax(logits, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
 
@@ -91,6 +93,38 @@ def check_flash_decode():
     print("OK flash_decode")
 
 
+def check_flash_masked():
+    """Masked + cross-attention flash variants on real Mosaic (interpret
+    mode never checks the tiling rules these paths exercise)."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas_ops import flash_attention_arrays
+
+    rng = np.random.RandomState(1)
+    b, s, h, d = 2, 256, 4, 64
+    q = jnp.asarray(rng.randn(b, s, h, d), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(b, s, h, d), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(b, s, h, d), jnp.bfloat16)
+    # additive mask: block out a band of keys
+    mask = jnp.where(
+        (jnp.arange(s)[None, :] > 64) & (jnp.arange(s)[None, :] < 128),
+        -1e30, 0.0)[None, None].astype(jnp.float32)
+    mask = jnp.broadcast_to(mask, (b, 1, s, s))
+    out = flash_attention_arrays(q, k, v, mask, False)
+    ref = _mha_ref(q, k, v, causal=False, mask=mask)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=5e-2, atol=5e-2)
+    # cross attention: sk != sq
+    k2 = jnp.asarray(rng.randn(b, 128, h, d), jnp.bfloat16)
+    v2 = jnp.asarray(rng.randn(b, 128, h, d), jnp.bfloat16)
+    out2 = flash_attention_arrays(q, k2, v2, None, False)
+    ref2 = _mha_ref(q, k2, v2, causal=False)
+    np.testing.assert_allclose(np.asarray(out2, np.float32),
+                               np.asarray(ref2, np.float32),
+                               rtol=5e-2, atol=5e-2)
+    print("OK flash_masked_cross")
+
+
 def check_generate():
     import jax.numpy as jnp
     import paddle_tpu as paddle
@@ -119,6 +153,7 @@ def main():
     check_flash_fwd()
     check_flash_bwd()
     check_flash_decode()
+    check_flash_masked()
     check_generate()
     print("ALL ONCHIP CHECKS OK")
 
