@@ -21,6 +21,7 @@ pytestmark = pytest.mark.slow
 _WORKERS = pathlib.Path(__file__).resolve().parent / "workers"
 _WORKER = _WORKERS / "multiproc_dp_worker.py"
 _HYBRID_WORKER = _WORKERS / "multiproc_hybrid_worker.py"
+_SP_WORKER = _WORKERS / "multiproc_sp_worker.py"
 
 
 def _free_port():
@@ -130,5 +131,23 @@ def test_two_process_hybrid_gpt():
     # so this is dp1xmp4 — parity across a DIFFERENT dp split of the same
     # global batch is the stronger check
     base = _parse_losses(_run_workers(1, worker=_HYBRID_WORKER)[0])
+    for a, b in zip(ranks[0], base):
+        assert abs(a - b) < 1e-5, (ranks[0], base)
+
+
+def test_two_process_ring_sp():
+    """The zigzag sp ring crossing the process boundary (ppermute over
+    the inter-process link): both ranks agree, the trajectory improves,
+    and it matches the sp4 single-process run of the same global batch
+    to collective reduction noise."""
+    os.environ["CP_LAYOUT"] = "zigzag"
+    try:
+        ranks = [_parse_losses(o)
+                 for o in _run_workers(2, worker=_SP_WORKER)]
+        base = _parse_losses(_run_workers(1, worker=_SP_WORKER)[0])
+    finally:
+        os.environ.pop("CP_LAYOUT", None)
+    assert ranks[0] == ranks[1]
+    assert ranks[0][-1] < ranks[0][0]
     for a, b in zip(ranks[0], base):
         assert abs(a - b) < 1e-5, (ranks[0], base)

@@ -1,0 +1,45 @@
+"""Worker for test_multiprocess_dp::test_two_process_ring_sp: context
+parallelism with the sp RING crossing the process boundary — ppermute
+hops ride the inter-process (gloo/DCN-analog) link while intra-process
+hops stay local. CP_LAYOUT selects the contiguous or zigzag ring.
+"""
+import os
+import sys
+
+os.environ["PTPU_FORCE_PLATFORM"] = "cpu"
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import jit, optimizer, parallel
+from paddle_tpu.models import GPTForCausalLM, GPTPretrainingCriterion, gpt_test_config
+
+dist.init_parallel_env()
+nproc = int(os.environ["PADDLE_TRAINERS_NUM"])
+parallel.init_mesh(sp=4 * nproc)
+paddle.seed(0)
+layout = os.environ.get("CP_LAYOUT", "contiguous")
+cfg = gpt_test_config(num_hidden_layers=2, context_parallel=True,
+                      cp_layout=layout, max_position_embeddings=64)
+model = parallel.place_model(GPTForCausalLM(cfg))
+crit = GPTPretrainingCriterion(cfg)
+opt = optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
+
+def step(x, y):
+    loss = crit(model(x), y)
+    loss.backward(); opt.step(); opt.clear_grad()
+    return loss
+
+compiled = jit.compile(step, models=[model], optimizers=[opt])
+rng = np.random.RandomState(0)
+ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (4, 64)).astype("int32"))
+lab = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (4, 64)).astype("int32"))
+losses = [float(compiled(ids, lab).numpy()) for _ in range(3)]
+print("LOSSES", " ".join(f"{v:.8f}" for v in losses), flush=True)
+print("WORKER_OK", flush=True)
