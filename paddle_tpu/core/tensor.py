@@ -103,7 +103,23 @@ class Tensor:
 
     # -- host transfer -----------------------------------------------------
     def numpy(self):
-        return np.asarray(self._data)
+        a = self._data
+        if (hasattr(a, "is_fully_addressable")
+                and not a.is_fully_addressable
+                and (not getattr(a, "is_fully_replicated", False)
+                     or not len(a.addressable_shards))):
+            # multi-process mesh and this process cannot read the value:
+            # either genuinely sharded onto other processes, or committed
+            # to a sub-mesh this rank does not touch (e.g. a mesh smaller
+            # than the job). jax's np.asarray handles the replicated-with-
+            # local-copy case itself (with caching) — this branch only
+            # upgrades the error for the unreadable ones.
+            raise RuntimeError(
+                "Tensor.numpy() on a multi-process array whose shards "
+                "live on other processes; use "
+                "paddle.distributed.all_gather (or read "
+                "._data.addressable_shards for the local part)")
+        return np.asarray(a)
 
     def item(self):
         return self.numpy().item()

@@ -22,6 +22,7 @@ _WORKERS = pathlib.Path(__file__).resolve().parent / "workers"
 _WORKER = _WORKERS / "multiproc_dp_worker.py"
 _HYBRID_WORKER = _WORKERS / "multiproc_hybrid_worker.py"
 _SP_WORKER = _WORKERS / "multiproc_sp_worker.py"
+_PP_WORKER = _WORKERS / "multiproc_pp_worker.py"
 
 
 def _free_port():
@@ -147,6 +148,19 @@ def test_two_process_ring_sp():
         base = _parse_losses(_run_workers(1, worker=_SP_WORKER)[0])
     finally:
         os.environ.pop("CP_LAYOUT", None)
+    assert ranks[0] == ranks[1]
+    assert ranks[0][-1] < ranks[0][0]
+    for a, b in zip(ranks[0], base):
+        assert abs(a - b) < 1e-5, (ranks[0], base)
+
+
+def test_two_process_pipeline():
+    """pp spanning the process boundary (pp2 x mp4 over 2 procs): both
+    ranks read the SAME replicated loss, and the trajectory matches the
+    single-process pp2 x mp2 run of the same global batch — parity
+    across both the process split and a different mp width."""
+    ranks = [_parse_losses(o) for o in _run_workers(2, worker=_PP_WORKER)]
+    base = _parse_losses(_run_workers(1, worker=_PP_WORKER)[0])
     assert ranks[0] == ranks[1]
     assert ranks[0][-1] < ranks[0][0]
     for a, b in zip(ranks[0], base):

@@ -1,0 +1,63 @@
+"""Worker for test_multiprocess_dp::test_two_process_pipeline: with the
+(dp, sharding, pp, ep, sp, mp) axis order, pp=2 x mp=4 places stage 0 on
+process 0 and stage 1 on process 1 — so every stage-boundary
+collective-permute hop (micro-batch handoff, forward and backward)
+crosses the inter-process link, the pp-over-DCN shape. GSPMD replicates
+the final loss over the WHOLE mesh, so both ranks read the same value
+(the reference broadcasts the pp loss explicitly for the same reason,
+pipeline_parallel._broadcast_final_loss).
+"""
+import os
+import sys
+
+os.environ["PTPU_FORCE_PLATFORM"] = "cpu"
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import jit, optimizer, parallel
+from paddle_tpu.models import (GPTForCausalLM, GPTPretrainingCriterion,
+                               gpt_test_config)
+
+
+def main():
+    dist.init_parallel_env()
+    nproc = int(os.environ["PADDLE_TRAINERS_NUM"])
+    # pp always 2; mp soaks up the rest. 2-proc: stage0 = proc0's four
+    # devices, stage1 = proc1's — the stage hops cross the process
+    # boundary; 1-proc baseline is pp2 x mp2
+    parallel.init_mesh(pp=2, mp=2 * nproc)
+    paddle.seed(0)
+    cfg = gpt_test_config(num_hidden_layers=2, stacked_blocks=True,
+                          max_position_embeddings=64)
+    model = parallel.place_model(GPTForCausalLM(cfg))
+    crit = GPTPretrainingCriterion(cfg)
+    opt = optimizer.AdamW(learning_rate=1e-3,
+                          parameters=model.parameters())
+
+    def step(x, y):
+        loss = crit(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    compiled = jit.compile(step, models=[model], optimizers=[opt])
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (4, 64)).astype("int32"))
+    lab = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (4, 64)).astype("int32"))
+    losses = [float(compiled(ids, lab).numpy()) for _ in range(3)]
+    print("LOSSES", " ".join(f"{v:.8f}" for v in losses), flush=True)
+    print("WORKER_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
