@@ -53,7 +53,10 @@ with paddle.amp.auto_cast():
     assert "bfloat16" in str(z.dtype)
 
 # ---- O1 under jit.compile (the blessed training path) --------------------
-compiled = jit.compile(o1_step, models=[model], optimizers=[opt])
+# the scaler must be registered so dynamic loss scaling's scale/counters
+# thread through the compiled program (in-graph check_finite_and_unscale)
+compiled = jit.compile(o1_step, models=[model], optimizers=[opt],
+                       scalers=[scaler])
 jl = [float(compiled(paddle.to_tensor(X[rng.randint(0, 512, 64)]),
                      paddle.to_tensor(Y[rng.randint(0, 512, 64)])).numpy())
       for _ in range(20)]

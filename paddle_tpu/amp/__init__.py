@@ -112,10 +112,34 @@ def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
     return (models if single else model_list), optimizers
 
 
+def _is_tracer(x):
+    import jax
+
+    return isinstance(x, jax.core.Tracer)
+
+
 class GradScaler:
     """Dynamic loss scaling (reference: python/paddle/amp/grad_scaler.py:38 —
     check_finite_and_unscale + update_loss_scaling ops fused here into the
-    unscale step)."""
+    unscale step).
+
+    Works BOTH eagerly and inside a jit-compiled step. Under trace the
+    full reference semantics run in-graph (matching the static AMP path's
+    check_finite_and_unscale + update_loss_scaling ops): found_inf is a
+    traced all-isfinite reduction, the optimizer update is masked with
+    jnp.where so an overflowed fp16 step leaves params/slots untouched,
+    and the scale/counters update through the traced flag. Dynamic
+    scaling's state (scale, good/bad step counters) must then be threaded
+    through the compiled program — register the scaler:
+
+        step = jit.compile(train_step, models=[m], optimizers=[o],
+                           scalers=[scaler])
+
+    An unregistered dynamic scaler inside a trace raises (the state
+    update would silently vanish when the trace ends); a static-scale
+    scaler (use_dynamic_loss_scaling=False) needs no registration — its
+    inf-skip masking is stateless per step.
+    """
 
     def __init__(self, enable=True, init_loss_scaling=2.0**15,
                  incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
@@ -130,7 +154,9 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
-        self._warned_traced = False
+        self._unscaled = False
+        # set by jit.CompiledFunction while tracing a registered scaler
+        self._in_compiled_step = False
 
     def scale(self, var):
         if not self._enable:
@@ -140,72 +166,128 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable:
             return
-        import jax
-
+        grads = [p.grad for p in optimizer._parameter_list
+                 if p.grad is not None]
+        # registration check BEFORE any mutation: raising after writing
+        # tracers into p.grad (or setting _unscaled) would leave the
+        # scaler/grads poisoned for a caller that catches and retries
+        # eagerly
+        if (self._dynamic and not self._in_compiled_step
+                and any(_is_tracer(g._data) for g in grads)):
+            raise RuntimeError(
+                "GradScaler with dynamic loss scaling inside a "
+                "jit-compiled step: the scale/counter updates are "
+                "traced state and must be threaded through the "
+                "program — pass the scaler to the compile call: "
+                "jit.compile(step, models=..., optimizers=..., "
+                "scalers=[scaler]). (bf16 training does not need "
+                "loss scaling at all; or set "
+                "use_dynamic_loss_scaling=False for a fixed scale, "
+                "which needs no registration.)")
         inv = 1.0 / self._scale
-        found = False
-        for p in optimizer._parameter_list:
-            if p.grad is None:
-                continue
-            g = p.grad._data.astype(jnp.float32) * inv
-            if isinstance(g, jax.core.Tracer):
-                # under a jit trace the finite check is a traced bool —
-                # branching on it would need lax.cond over the whole
-                # optimizer update. TPU stance: bf16 training (the blessed
-                # dtype) never overflows the exponent, so compiled steps
-                # unscale mathematically and skip the inf-skip behavior;
-                # eager fp16 keeps the full dynamic-scaling protocol.
-                if self._dynamic and not self._warned_traced:
-                    import warnings
+        found = None
+        traced = False
+        for g_t in grads:
+            g = g_t._data.astype(jnp.float32) * inv
+            bad = ~jnp.all(jnp.isfinite(g))
+            traced = traced or _is_tracer(bad)
+            found = bad if found is None else jnp.logical_or(found, bad)
+            g_t._data = g
+        self._unscaled = True
+        if found is None:
+            self._found_inf = False
+        elif traced:
+            self._found_inf = found
+        else:
+            self._found_inf = bool(found)
 
-                    warnings.warn(
-                        "GradScaler inside a jit-compiled step: the "
-                        "inf/NaN skip of dynamic loss scaling is NOT "
-                        "applied under trace (an overflowed fp16 step "
-                        "would update with non-finite grads). bf16 "
-                        "training does not need loss scaling; for fp16, "
-                        "keep the scaler step eager.", stacklevel=3)
-                    self._warned_traced = True
-                finite = True
-            else:
-                finite = bool(jnp.all(jnp.isfinite(g)))
-            if not finite:
-                found = True
-            p.grad._data = g
-        self._found_inf = found
+    def _masked_step(self, optimizer, found):
+        """Run optimizer.step() then select the pre-step value for every
+        param/slot/master when found_inf — the in-graph analog of the
+        reference's per-op skip in check_finite_and_unscale."""
+        params = optimizer._parameter_list
+        # materialize lazily-created slots/master weights BEFORE the
+        # snapshot: otherwise a first-step overflow creates them from
+        # inf-scaled grads inside step() and the masking below skips
+        # them (inf moments poison every later step)
+        for p in params:
+            optimizer._ensure_state(p)
+        snap_p = [p._data for p in params]
+        snap_states = {k: dict(v) for k, v in optimizer._states.items()}
+        snap_mw = dict(optimizer._master_weights)
+        optimizer.step()
+        for p, old in zip(params, snap_p):
+            p._data = jnp.where(found, old, p._data)
+        for key, slot_dict in optimizer._states.items():
+            old_slots = snap_states.get(key, {})
+            for sname, new in slot_dict.items():
+                if sname in old_slots:
+                    slot_dict[sname] = jnp.where(found, old_slots[sname], new)
+        for key, new in optimizer._master_weights.items():
+            if key in snap_mw:
+                optimizer._master_weights[key] = jnp.where(
+                    found, snap_mw[key], new)
 
     def step(self, optimizer):
         if not self._enable:
             optimizer.step()
             return
-        if not self._found_inf:
+        if not self._unscaled:
             self.unscale_(optimizer)
-        if not self._found_inf:
+        found = self._found_inf
+        if _is_tracer(found):
+            self._masked_step(optimizer, found)
+        elif not found:
             optimizer.step()
         self.update()
 
     def minimize(self, optimizer, scaled_loss):
         scaled_loss.backward()
-        self.unscale_(optimizer)
-        if not self._found_inf:
+        if not self._unscaled:
+            self.unscale_(optimizer)
+        found = self._found_inf
+        if _is_tracer(found):
+            self._masked_step(optimizer, found)
+        elif not found:
             optimizer.step()
         self.update()
 
     def update(self):
+        self._unscaled = False
         if not (self._enable and self._dynamic):
             self._found_inf = False
             return
-        if self._found_inf:
-            self._bad_steps += 1
+        found = self._found_inf
+        if _is_tracer(found) or _is_tracer(self._scale):
+            # traced update_loss_scaling: same recurrence as the eager
+            # branch below, expressed with jnp.where over threaded state
+            scale = jnp.asarray(self._scale, jnp.float32)
+            good = jnp.asarray(self._good_steps, jnp.int32)
+            bad = jnp.asarray(self._bad_steps, jnp.int32)
+            found = jnp.asarray(found, bool)
+            bad = jnp.where(found, bad + 1, 0)
+            good = jnp.where(found, 0, good + 1)
+            decr = found & (bad >= self._decr_every)
+            scale = jnp.where(
+                decr, jnp.maximum(scale * self._decr_ratio, 1.0), scale)
+            bad = jnp.where(decr, 0, bad)
+            incr = (~found) & (good >= self._incr_every)
+            scale = jnp.where(incr, scale * self._incr_ratio, scale)
+            good = jnp.where(incr, 0, good)
+            self._scale, self._good_steps, self._bad_steps = scale, good, bad
+            self._found_inf = False
+            return
+        if found:
+            self._bad_steps = int(self._bad_steps) + 1
             self._good_steps = 0
             if self._bad_steps >= self._decr_every:
-                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._scale = max(float(self._scale) * self._decr_ratio, 1.0)
                 self._bad_steps = 0
         else:
-            self._good_steps += 1
+            self._good_steps = int(self._good_steps) + 1
             self._bad_steps = 0
             if self._good_steps >= self._incr_every:
-                self._scale *= self._incr_ratio
+                self._scale = float(self._scale) * self._incr_ratio
                 self._good_steps = 0
         self._found_inf = False
 
@@ -216,17 +298,19 @@ class GradScaler:
         return self._dynamic
 
     def get_loss_scaling(self):
-        from ..ops.creation import full
+        if isinstance(self._scale, (int, float)):
+            from ..ops.creation import full
 
-        return full([1], self._scale)
+            return full([1], self._scale)
+        return Tensor(jnp.asarray(self._scale, jnp.float32).reshape(1))
 
     def state_dict(self):
         return {
-            "scale": self._scale,
+            "scale": float(self._scale),
             "incr_ratio": self._incr_ratio,
             "decr_ratio": self._decr_ratio,
-            "incr_count": self._good_steps,
-            "decr_count": self._bad_steps,
+            "incr_count": int(self._good_steps),
+            "decr_count": int(self._bad_steps),
         }
 
     def load_state_dict(self, state_dict):

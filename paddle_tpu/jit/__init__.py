@@ -40,9 +40,15 @@ class _StateSpec:
     """All mutable framework state a compiled program threads through
     (the analog of the reference Program's persistable vars)."""
 
-    def __init__(self, models=(), optimizers=()):
+    # (scaler attr name, threaded dtype) — the GradScaler state that the
+    # in-graph dynamic-loss-scaling protocol updates through the step
+    SCALER_ATTRS = (("_scale", jnp.float32), ("_good_steps", jnp.int32),
+                    ("_bad_steps", jnp.int32))
+
+    def __init__(self, models=(), optimizers=(), scalers=()):
         self.models = list(models)
         self.optimizers = list(optimizers)
+        self.scalers = list(scalers)
 
     def slots(self):
         """list of (name, get_fn, set_fn) for every mutable array slot."""
@@ -61,6 +67,9 @@ class _StateSpec:
                     out.append((f"o{oi}.{key}.{sname}", (opt, key, sname)))
             for key in opt._master_weights:
                 out.append((f"o{oi}.{key}.master", (opt, key, "__master__")))
+        for si, sc in enumerate(self.scalers):
+            for attr, _ in self.SCALER_ATTRS:
+                out.append((f"sc{si}.{attr}", (sc, attr, "__scaler__")))
         return out
 
     def read(self):
@@ -70,7 +79,10 @@ class _StateSpec:
                 vals.append(slot._data)
             else:
                 opt, key, sname = slot
-                if sname == "__master__":
+                if sname == "__scaler__":
+                    dt = dict(self.SCALER_ATTRS)[key]
+                    vals.append(jnp.asarray(getattr(opt, key), dt))
+                elif sname == "__master__":
                     vals.append(opt._master_weights[key])
                 else:
                     vals.append(opt._states[key][sname])
@@ -82,7 +94,9 @@ class _StateSpec:
                 slot._data = v
             else:
                 opt, key, sname = slot
-                if sname == "__master__":
+                if sname == "__scaler__":
+                    setattr(opt, key, v)
+                elif sname == "__master__":
                     opt._master_weights[key] = v
                 else:
                     opt._states[key][sname] = v
@@ -123,9 +137,10 @@ class CompiledFunction:
     """
 
     def __init__(self, fn, models=(), optimizers=(), donate=True,
-                 train=True, sharding_fn=None, static_argnums=()):
+                 train=True, sharding_fn=None, static_argnums=(),
+                 scalers=()):
         self._fn = fn
-        self._spec = _StateSpec(models, optimizers)
+        self._spec = _StateSpec(models, optimizers, scalers)
         self._donate = donate
         self._train = train
         self._sharding_fn = sharding_fn
@@ -146,6 +161,8 @@ class CompiledFunction:
                     opt._lr_override = host_vals[2 * oi]
                     opt._step_override = host_vals[2 * oi + 1]
                     overrides.append(opt)
+                for sc in spec.scalers:
+                    sc._in_compiled_step = True
                 with _rng.key_scope(key):
                     with tape.enable_grad() if train else tape.no_grad():
                         t_args = _wrap_inputs(args)
@@ -158,6 +175,10 @@ class CompiledFunction:
                 for opt in overrides:
                     opt._lr_override = None
                     opt._step_override = None
+                for sc in spec.scalers:
+                    sc._in_compiled_step = False
+                    sc._found_inf = False  # never leak a tracer past trace
+                    sc._unscaled = False
                 spec.write(spec_slots_backup)
 
         donate = (0,) if self._donate else ()
@@ -198,18 +219,24 @@ class CompiledFunction:
         )
 
 
-def compile(fn=None, models=(), optimizers=(), donate=True, train=True):
+def compile(fn=None, models=(), optimizers=(), donate=True, train=True,
+            scalers=()):
     """Compile a whole train/eval step. The blessed TPU path:
 
         step = paddle_tpu.jit.compile(train_step, models=[model], optimizers=[opt])
         loss = step(x, y)          # ONE XLA program: fwd+bwd+optimizer
+
+    A GradScaler used inside the step (dynamic fp16 loss scaling) must be
+    registered via scalers=[scaler] so its scale/counters thread through
+    the compiled program (in-graph check_finite_and_unscale semantics).
     """
     if fn is None:
         return functools.partial(compile, models=models, optimizers=optimizers,
-                                 donate=donate, train=train)
+                                 donate=donate, train=train, scalers=scalers)
     if isinstance(models, Layer):
         models = [models]
-    return CompiledFunction(fn, models, optimizers, donate, train)
+    return CompiledFunction(fn, models, optimizers, donate, train,
+                            scalers=scalers)
 
 
 class StaticFunction:

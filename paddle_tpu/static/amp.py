@@ -110,8 +110,9 @@ class OptimizerWithMixedPrecision:
         return []
 
     def apply_gradients(self, params_grads=None):
+        # scaler.step runs the full protocol including update_loss_scaling;
+        # calling update() again here would count a phantom good step
         self._scaler.step(self._optimizer)
-        self._scaler.update()
         return []
 
     # reference signature: returns (optimize_ops, params_grads)

@@ -1,0 +1,187 @@
+"""GradScaler dynamic loss scaling inside jit-compiled steps.
+
+Reference semantics (python/paddle/amp/grad_scaler.py + static AMP's
+check_finite_and_unscale / update_loss_scaling ops): an overflowed step
+must NOT touch params or optimizer slots, must reset the good-step
+counter, and must shrink the scale after decr_every_n_nan_or_inf bad
+steps — including when the whole step is one compiled program.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import amp, jit, optimizer
+
+
+def _one_param_model(value=1.0):
+    class M(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.w = self.create_parameter(
+                [4], default_initializer=paddle.nn.initializer.Constant(value))
+
+        def forward(self, x):
+            return (self.w * x).sum()
+
+    return M()
+
+
+def _scaler(**kw):
+    kw.setdefault("init_loss_scaling", 2.0 ** 15)
+    kw.setdefault("decr_every_n_nan_or_inf", 1)
+    kw.setdefault("incr_every_n_steps", 2)
+    return amp.GradScaler(**kw)
+
+
+def _step_fn(model, opt, scaler):
+    def step(x):
+        loss = model(x)
+        scaler.scale(loss).backward()
+        scaler.step(opt)
+        opt.clear_grad()
+        return loss
+
+    return step
+
+
+class TestEagerScaler:
+    def test_overflow_skips_and_halves_scale(self):
+        model = _one_param_model()
+        opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+        scaler = _scaler()
+        w0 = model.w.numpy().copy()
+        # grad = x; 2e38 * 32768 overflows fp32 during scaling
+        step = _step_fn(model, opt, scaler)
+        step(paddle.to_tensor(np.full(4, 2e38, np.float32)))
+        np.testing.assert_array_equal(model.w.numpy(), w0)
+        assert float(scaler._scale) == pytest.approx(2.0 ** 14)
+        # a finite step updates and counts toward incr
+        step(paddle.to_tensor(np.ones(4, np.float32)))
+        assert not np.array_equal(model.w.numpy(), w0)
+        assert int(scaler._good_steps) == 1
+
+
+class TestCompiledScaler:
+    def test_overflow_step_masked_in_graph(self):
+        model = _one_param_model()
+        opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+        scaler = _scaler()
+        step = jit.compile(_step_fn(model, opt, scaler), models=[model],
+                           optimizers=[opt], scalers=[scaler])
+        w0 = model.w.numpy().copy()
+        step(paddle.to_tensor(np.full(4, 2e38, np.float32)))
+        np.testing.assert_array_equal(model.w.numpy(), w0)
+        assert float(scaler._scale) == pytest.approx(2.0 ** 14)
+        assert int(scaler._bad_steps) == 0  # decr fired and reset
+
+    def test_finite_steps_update_and_grow_scale(self):
+        model = _one_param_model()
+        opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+        scaler = _scaler(init_loss_scaling=1024.0)
+        step = jit.compile(_step_fn(model, opt, scaler), models=[model],
+                           optimizers=[opt], scalers=[scaler])
+        w0 = model.w.numpy().copy()
+        x = paddle.to_tensor(np.ones(4, np.float32))
+        step(x)
+        w1 = model.w.numpy().copy()
+        # grad of (w*x).sum() wrt w is x=1; SGD lr .1 → w -= .1
+        np.testing.assert_allclose(w1, w0 - 0.1, rtol=1e-5)
+        assert int(scaler._good_steps) == 1
+        step(x)
+        # incr_every=2: scale doubles after the second good step
+        assert float(scaler._scale) == pytest.approx(2048.0)
+        assert int(scaler._good_steps) == 0
+
+    def test_compiled_matches_eager_trajectory(self):
+        xs = [np.full(4, 2e38, np.float32), np.ones(4, np.float32),
+              np.full(4, 2e38, np.float32), np.full(4, 0.5, np.float32),
+              np.ones(4, np.float32)]
+
+        def run(compiled):
+            paddle.seed(0)
+            model = _one_param_model()
+            opt = optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                     parameters=model.parameters())
+            scaler = _scaler()
+            fn = _step_fn(model, opt, scaler)
+            if compiled:
+                fn = jit.compile(fn, models=[model], optimizers=[opt],
+                                 scalers=[scaler])
+            for x in xs:
+                fn(paddle.to_tensor(x))
+            return (model.w.numpy(), float(scaler._scale),
+                    int(scaler._good_steps), int(scaler._bad_steps))
+
+        w_e, s_e, g_e, b_e = run(False)
+        w_c, s_c, g_c, b_c = run(True)
+        np.testing.assert_allclose(w_c, w_e, rtol=1e-5)
+        assert (s_c, g_c, b_c) == (s_e, g_e, b_e)
+
+    def test_unregistered_dynamic_scaler_raises(self):
+        model = _one_param_model()
+        opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+        scaler = _scaler()
+        step = jit.compile(_step_fn(model, opt, scaler), models=[model],
+                           optimizers=[opt])  # scaler NOT registered
+        with pytest.raises(RuntimeError, match="scalers=\\[scaler\\]"):
+            step(paddle.to_tensor(np.ones(4, np.float32)))
+
+    def test_static_scale_needs_no_registration(self):
+        model = _one_param_model()
+        opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+        scaler = _scaler(use_dynamic_loss_scaling=False)
+        step = jit.compile(_step_fn(model, opt, scaler), models=[model],
+                           optimizers=[opt])
+        w0 = model.w.numpy().copy()
+        # a baked constant scale lets XLA fold scale*(1/scale) away, so a
+        # magnitude overflow can vanish in compilation — use a hard inf
+        # (real fp16 overflows surface in the data/activations themselves)
+        step(paddle.to_tensor(np.full(4, np.inf, np.float32)))
+        np.testing.assert_array_equal(model.w.numpy(), w0)
+        step(paddle.to_tensor(np.ones(4, np.float32)))
+        np.testing.assert_allclose(model.w.numpy(), w0 - 0.1, rtol=1e-5)
+        assert float(scaler._scale) == pytest.approx(2.0 ** 15)
+
+    def test_first_step_overflow_does_not_poison_lazy_state(self):
+        """The very first step overflowing (the normal fp16 start) must
+        not bake inf into lazily-created moments/master weights."""
+        model = _one_param_model()
+        opt = optimizer.AdamW(learning_rate=0.1,
+                              parameters=model.parameters(),
+                              multi_precision=True)
+        scaler = _scaler()
+        # eager: lazy state creation happens inside the masked step
+        fn = _step_fn(model, opt, scaler)
+        w0 = model.w.numpy().copy()
+        fn(paddle.to_tensor(np.full(4, np.inf, np.float32)))
+        np.testing.assert_array_equal(model.w.numpy(), w0)
+        for k, d in opt._states.items():
+            for s, v in d.items():
+                assert np.isfinite(np.asarray(v, np.float32)).all(), (k, s)
+        for k, v in opt._master_weights.items():
+            assert np.isfinite(np.asarray(v, np.float32)).all()
+        # and training proceeds normally afterwards
+        fn(paddle.to_tensor(np.ones(4, np.float32)))
+        assert not np.array_equal(model.w.numpy(), w0)
+        assert np.isfinite(model.w.numpy()).all()
+
+    def test_adamw_master_weights_masked(self):
+        """Masking must cover optimizer slots and master weights too: a
+        skipped step may not advance Adam moments."""
+        model = _one_param_model()
+        opt = optimizer.AdamW(learning_rate=0.1,
+                              parameters=model.parameters(),
+                              multi_precision=True)
+        scaler = _scaler()
+        step = jit.compile(_step_fn(model, opt, scaler), models=[model],
+                           optimizers=[opt], scalers=[scaler])
+        x = paddle.to_tensor(np.ones(4, np.float32))
+        step(x)  # one good step so moments exist and are nonzero
+        m_before = {k: {s: np.asarray(v).copy() for s, v in d.items()}
+                    for k, d in opt._states.items()}
+        w_before = model.w.numpy().copy()
+        step(paddle.to_tensor(np.full(4, 2e38, np.float32)))  # overflow
+        np.testing.assert_array_equal(model.w.numpy(), w_before)
+        for k, d in opt._states.items():
+            for s, v in d.items():
+                np.testing.assert_array_equal(np.asarray(v), m_before[k][s])
