@@ -58,9 +58,9 @@ def _backend_alive_with_retry() -> bool:
     """Retry the probe with backoff before declaring the chip gone: a
     wedged tunnel is often transient, and a single failed probe turning
     the official bench artifact into a CPU-smoke line conflates outage
-    with regression.  Defaults: 5 attempts, 60s probe timeout, waits of
-    30/60/90/120s between attempts (~10 min worst case, inside the
-    driver budget).  Tunable via PTPU_BENCH_PROBE_{ATTEMPTS,TIMEOUT}."""
+    with regression.  Defaults: 5 attempts, 90s probe timeout, waits of
+    30/60/90/120s between attempts (~12.5 min worst case).  Tunable via
+    PTPU_BENCH_PROBE_{ATTEMPTS,TIMEOUT}."""
     attempts = int(os.environ.get("PTPU_BENCH_PROBE_ATTEMPTS", "5"))
     # keep the original 90s per-attempt window: a cold tunnel can take
     # 60-90s to answer while still being healthy

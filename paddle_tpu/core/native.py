@@ -27,7 +27,10 @@ def _needs_build():
         return True
     lib_mtime = os.path.getmtime(_LIB_PATH)
     for f in os.listdir(_CSRC):
-        if f.endswith((".cc", ".h")) and os.path.getmtime(
+        # the Makefile counts: CXXFLAGS / source-list edits must trigger a
+        # rebuild too, or a stale library is dlopened and the missing-symbol
+        # fallback silently disables every native path
+        if (f.endswith((".cc", ".h")) or f == "Makefile") and os.path.getmtime(
                 os.path.join(_CSRC, f)) > lib_mtime:
             return True
     return False

@@ -34,6 +34,7 @@ class DistributedAuc:
         self.input_type = input_type
         self._pos = np.zeros(self.bucket_size, np.int64)
         self._neg = np.zeros(self.bucket_size, np.int64)
+        self._auto_latched = False
 
     def update(self, preds, labels):
         preds = np.asarray(preds, np.float64).reshape(-1)
@@ -44,6 +45,17 @@ class DistributedAuc:
             # logit batches would clip into bucket 0).
             self.input_type = ("logits" if preds.min() < 0.0
                                or preds.max() > 1.0 else "prob")
+            self._auto_latched = True
+        if (self._auto_latched and self.input_type == "prob" and preds.size
+                and (preds.min() < 0.0 or preds.max() > 1.0)):
+            # the first batch happened to land in [0,1] (common early in
+            # training) but this one proves the stream is logits: refuse to
+            # keep bucketing two scales into one histogram
+            raise ValueError(
+                f"DistributedAuc('{self.name}'): input_type was auto-"
+                "detected as 'prob' from the first batch, but a later "
+                "batch contains values outside [0, 1]. Construct with an "
+                "explicit input_type='logits' (or 'prob').")
         if self.input_type == "logits":
             preds = 1.0 / (1.0 + np.exp(-preds))
         labels = np.asarray(labels).reshape(-1)
@@ -64,11 +76,21 @@ class DistributedAuc:
             return self._pos, self._neg
         import paddle_tpu as paddle
 
-        pos = paddle.to_tensor(self._pos)
-        neg = paddle.to_tensor(self._neg)
-        all_reduce(pos)
-        all_reduce(neg)
-        return np.asarray(pos.numpy()), np.asarray(neg.numpy())
+        # with x64 disabled, to_tensor(int64) truncates to int32 and both
+        # f64→f32 (exact only to 2^24) and raw int32 (2^31) overflow
+        # production-scale counts. Reduce base-2^16 digits instead: each
+        # digit sums to < world * 2^16 (int32-safe for any realistic job)
+        # and the int64 recombination on host is exact.
+        merged = []
+        for arr in (self._pos, self._neg):
+            total = np.zeros_like(arr)
+            for d in range(4):
+                digit = ((arr >> (16 * d)) & 0xFFFF).astype(np.int32)
+                t = paddle.to_tensor(digit)
+                all_reduce(t)
+                total += np.asarray(t.numpy()).astype(np.int64) << (16 * d)
+            merged.append(total)
+        return merged[0], merged[1]
 
     def eval(self):
         from ...metric import _histogram_auc

@@ -16,20 +16,28 @@ segments are bounded by an LRU of PTPU_SHM_CACHE_SEGMENTS (default 64):
 beyond that the oldest segment is unlinked. A payload older than the
 window that was never delivered therefore fails to rebuild
 (FileNotFoundError) — raise the env var for deep prefetch queues; the
-window never evicts the segment just created. Everything left unlinks at
-interpreter exit (the reference's file_system-strategy shape, same
-staleness tradeoff).
+window never evicts the segment just created.
+
+Producer exit uses a refcounted handshake so the common
+"short-lived producer queues a tensor and exits" pattern cannot race
+delivery: each consumer leaves a 1-byte ack segment after a successful
+rebuild; exit cleanup reaps acked segments immediately and lingers up
+to PTPU_SHM_LINGER seconds (default 2.0, 0 disables) for in-flight
+unacked ones before unlinking them too (the reference's
+file_system-strategy shape with a bounded grace window).
 """
 from __future__ import annotations
 
 import atexit
 import os
+import time
 from collections import OrderedDict
 from multiprocessing.reduction import ForkingPickler
 
 import numpy as np
 
 _SHM_MIN_BYTES = 1 << 16  # below this, copying beats shm setup
+_ACK_SUFFIX = "_ack"
 
 # segments this process created, oldest-first (producer-owned cleanup)
 _PRODUCED: "OrderedDict[str, object]" = OrderedDict()
@@ -41,16 +49,67 @@ def _max_segments():
     return max(1, int(os.environ.get("PTPU_SHM_CACHE_SEGMENTS", "64")))
 
 
+def _unlink_quiet(shm):
+    try:
+        shm.close()
+        shm.unlink()
+    except (FileNotFoundError, OSError):
+        pass
+
+
+def _untrack(shm):
+    """CPython <= 3.12 registers attached segments with the resource
+    tracker too; without unregistering, the tracker re-unlinks (and
+    warns about) segments this process merely peeked at."""
+    try:
+        from multiprocessing import resource_tracker
+
+        resource_tracker.unregister(shm._name, "shared_memory")
+    except Exception:
+        pass
+
+
+def _unlink_by_name(name):
+    from multiprocessing import shared_memory
+
+    try:
+        seg = shared_memory.SharedMemory(name=name)
+    except (FileNotFoundError, OSError):
+        return
+    _untrack(seg)
+    _unlink_quiet(seg)
+
+
+def _acked(name):
+    from multiprocessing import shared_memory
+
+    try:
+        m = shared_memory.SharedMemory(name=name + _ACK_SUFFIX)
+    except (FileNotFoundError, OSError):
+        return False
+    _untrack(m)
+    m.close()
+    return True
+
+
 def _cleanup_produced():
-    for shm in _PRODUCED.values():
-        try:
-            shm.close()
-            shm.unlink()
-        except FileNotFoundError:
-            pass
-        except OSError:
-            pass
+    linger = float(os.environ.get("PTPU_SHM_LINGER", "2.0"))
+    deadline = time.monotonic() + linger
+    pending = dict(_PRODUCED)
     _PRODUCED.clear()
+    # reap acked segments first (no wait); linger only while some payload
+    # is still in flight — a consumer that rebuilds during the grace
+    # window acks and releases us early
+    while pending:
+        for name in [n for n in pending if _acked(n)]:
+            _unlink_quiet(pending.pop(name))
+            _unlink_by_name(name + _ACK_SUFFIX)
+        if not pending or time.monotonic() >= deadline:
+            break
+        time.sleep(0.05)
+    for name, shm in pending.items():
+        _unlink_quiet(shm)
+        _unlink_by_name(name + _ACK_SUFFIX)
 
 
 atexit.register(_cleanup_produced)
@@ -67,6 +126,24 @@ def _rebuild_from_shm(shm_name, shape, dtype_name):
         out = Tensor(np.array(arr))  # own the data before the shm closes
     finally:
         shm.close()  # close only: the producer unlinks at its exit
+    # delivery ack: lets the producer's exit cleanup reap this segment
+    # without waiting out the linger window
+    try:
+        m = shared_memory.SharedMemory(name=shm_name + _ACK_SUFFIX,
+                                       create=True, size=1)
+        try:
+            from multiprocessing import resource_tracker
+
+            # the producer owns the marker's unlink; without this, the
+            # consumer's resource tracker reclaims it at consumer exit
+            resource_tracker.unregister(m._name, "shared_memory")
+        except Exception:
+            pass
+        m.close()
+    except FileExistsError:
+        pass  # fan-out: an earlier consumer already acked
+    except OSError:
+        pass
     return out
 
 
@@ -95,11 +172,8 @@ def _reduce_tensor(tensor):
             if name == shm.name:       # never evict the payload being built
                 break
             _PRODUCED.pop(name)
-            try:
-                old.close()
-                old.unlink()
-            except (FileNotFoundError, OSError):
-                pass
+            _unlink_quiet(old)
+            _unlink_by_name(name + _ACK_SUFFIX)
         return _rebuild_from_shm, (shm.name, arr.shape, arr.dtype.name)
     return _rebuild_small, (arr.tobytes(), arr.shape, arr.dtype.name)
 

