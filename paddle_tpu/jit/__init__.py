@@ -150,6 +150,15 @@ class CompiledFunction:
     def _build(self):
         spec = self._spec
         fn = self._fn
+        import os as _os
+
+        if _to_static_enabled and _os.environ.get("PTPU_DY2STATIC", "1") != "0":
+            # dy2static: rewrite python if/while/for over tensor values
+            # into staged control flow (no-op for functions without any,
+            # and python-valued predicates keep python semantics)
+            from .dy2static import convert_to_static
+
+            fn = convert_to_static(fn)
         train = self._train
 
         def pure(state_vals, host_vals, key, args, kwargs):
@@ -205,6 +214,23 @@ class CompiledFunction:
         return _tree_to_tensors(out_arrays)
 
     # -- introspection/AOT -------------------------------------------------
+    def memory_analysis(self, *args, **kwargs):
+        """XLA's compile-time memory analysis for this step at the given
+        example inputs: dict with argument/output/temp/alias bytes and
+        the derived peak live estimate. Chip-free (works on the CPU test
+        mesh) — the per-device HBM complement to
+        device.max_memory_allocated()'s runtime peak."""
+        mem = self.lower(*args, **kwargs).compile().memory_analysis()
+        out = {k: int(getattr(mem, k)) for k in (
+            "argument_size_in_bytes", "output_size_in_bytes",
+            "temp_size_in_bytes", "alias_size_in_bytes",
+            "generated_code_size_in_bytes") if hasattr(mem, k)}
+        out["peak_bytes_estimate"] = (
+            out.get("argument_size_in_bytes", 0)
+            + out.get("temp_size_in_bytes", 0)
+            - out.get("alias_size_in_bytes", 0))
+        return out
+
     def lower(self, *args, **kwargs):
         if self._compiled is None:
             self._build()

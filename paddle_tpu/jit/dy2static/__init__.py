@@ -1,0 +1,26 @@
+"""dy2static — automatic conversion of dygraph Python control flow into
+staged (lax) control flow (reference: python/paddle/jit/dy2static/ —
+ProgramTranslator at program_translator.py:1145, the *_transformer.py AST
+passes, and convert_operators.py).
+
+The conversion is applied automatically inside `paddle_tpu.jit.compile`
+and `@to_static`: Python `if`/`while`/`for range()` over traced tensors
+become one staged cond/while in the compiled program, while the same code
+keeps bit-identical Python behavior eagerly. See transformer.py for the
+convertible-region rules and convert_operators.py for runtime dispatch.
+"""
+from .convert_operators import (
+    Dy2StaticError, UNDEFINED, convert_call, convert_ifelse,
+    convert_while, convert_for_range, convert_logical_and,
+    convert_logical_or, convert_logical_not, py_cond_guard)
+from .transformer import convert_to_static
+
+# Reference alias (dy2static.error / Dygraph2StaticException)
+Dygraph2StaticException = Dy2StaticError
+
+__all__ = [
+    "convert_to_static", "convert_call", "Dy2StaticError",
+    "Dygraph2StaticException", "convert_ifelse", "convert_while",
+    "convert_for_range", "convert_logical_and", "convert_logical_or",
+    "convert_logical_not", "UNDEFINED", "py_cond_guard",
+]

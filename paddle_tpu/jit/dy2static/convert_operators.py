@@ -1,0 +1,335 @@
+"""Runtime control-flow converters (reference:
+python/paddle/jit/dy2static/convert_operators.py — convert_ifelse,
+convert_while_loop, convert_logical_and/or/not, convert_call).
+
+TPU-native re-design: the transformer (transformer.py) rewrites Python
+`if`/`while`/`for range()` statements into calls to these helpers, which
+dispatch AT RUNTIME on the predicate:
+
+- Python / concrete value  -> plain Python control flow, bit-identical to
+  the untransformed program (including short-circuiting);
+- traced tensor (inside jit) -> staged control flow: `if` lowers to the
+  masked-select cond of static/nn.py (gradients flow through both
+  branches), `while`/`for` lower to one StableHLO while via
+  static.nn.while_loop.
+
+Constructs that cannot be staged (early return/break/continue inside a
+tensor-dependent body, attribute/subscript mutation under a traced
+branch) keep their Python form and raise a Dy2StaticError with the source
+line when the predicate turns out to be traced — a loud, actionable
+failure instead of a silently-baked branch.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...core.dispatch import apply, unwrap
+
+__all__ = [
+    "Dy2StaticError", "UNDEFINED", "ld", "convert_ifelse", "convert_while",
+    "convert_for_range", "convert_logical_and", "convert_logical_or",
+    "convert_logical_not", "py_cond_guard", "convert_call",
+]
+
+
+class Dy2StaticError(Exception):
+    """A Python construct that cannot be converted to staged control flow
+    (reference: Dygraph2StaticException)."""
+
+
+class _Undefined:
+    """Placeholder for a name with no binding yet at the start of a
+    converted region (reference: dy2static UndefinedVar)."""
+
+    _MSG = ("variable '{}' is undefined here: it was only assigned inside "
+            "one branch/loop body of converted control flow that did not "
+            "execute (or did not run any iteration)")
+
+    def __init__(self, name="<unknown>"):
+        self.name = name
+
+    def __repr__(self):
+        return f"UNDEFINED({self.name})"
+
+    def _raise(self):
+        raise Dy2StaticError(self._MSG.format(self.name))
+
+    def __bool__(self):
+        self._raise()
+
+    def __getattr__(self, item):
+        if item.startswith("__") and item.endswith("__"):
+            raise AttributeError(item)
+        self._raise()
+
+    def __iter__(self):
+        self._raise()
+
+    def __call__(self, *a, **k):
+        self._raise()
+
+
+UNDEFINED = _Undefined()
+
+
+def ld(thunk, name="<unknown>"):
+    """Load a possibly-unbound local for threading into a converted
+    region; unbound names become UNDEFINED placeholders."""
+    try:
+        return thunk()
+    except (NameError, UnboundLocalError):
+        return _Undefined(name)
+
+
+def _is_tracer_val(v):
+    a = unwrap(v) if isinstance(v, Tensor) else v
+    return isinstance(a, jax.core.Tracer)
+
+
+def _is_tensorish(v):
+    return isinstance(v, (Tensor, jnp.ndarray, jax.Array)) or _is_tracer_val(v)
+
+
+def _truthy(pred):
+    """Python truthiness for a concrete predicate (Tensor or value)."""
+    if isinstance(pred, Tensor):
+        return bool(unwrap(pred))
+    return bool(pred)
+
+
+def _select_pair(pred, t, f, name):
+    """Select one leaf pair under a traced predicate."""
+    t_und = isinstance(t, _Undefined)
+    f_und = isinstance(f, _Undefined)
+    if t_und and f_und:
+        return t
+    if t_und or f_und:
+        which = (t if t_und else f)
+        raise Dy2StaticError(
+            f"variable '{name}' is assigned in only one branch of a "
+            f"tensor-dependent if and undefined in the other "
+            f"({which!r}); initialize it before the if so both branches "
+            "produce a value")
+    t_tensor = _is_tensorish(t)
+    f_tensor = _is_tensorish(f)
+    if t_tensor or f_tensor:
+        tt = t if isinstance(t, Tensor) else Tensor(jnp.asarray(unwrap(t)))
+        ff = f if isinstance(f, Tensor) else Tensor(jnp.asarray(unwrap(f)))
+        return apply(lambda p, a, b: jnp.where(p, a, b), pred, tt, ff,
+                     name="ifelse_select")
+    # two python values: only a branch-invariant value can survive a
+    # traced predicate
+    if t is f or t == f:
+        return t
+    raise Dy2StaticError(
+        f"variable '{name}' takes different non-tensor Python values in "
+        f"the branches of a tensor-dependent if ({t!r} vs {f!r}); make it "
+        "a Tensor or restructure the branches")
+
+
+def convert_ifelse(pred, true_fn, false_fn, init_vals, names):
+    """if/else over `names` (the variables either branch assigns).
+    true_fn/false_fn: vals-tuple -> vals-tuple."""
+    if not _is_tracer_val(pred):
+        return true_fn(init_vals) if _truthy(pred) else false_fn(init_vals)
+    t_out = true_fn(init_vals)
+    f_out = false_fn(init_vals)
+    return tuple(
+        _select_pair(pred, t, f, n)
+        for t, f, n in zip(t_out, f_out, names))
+
+
+def _check_defined(vals, names, what):
+    for v, n in zip(vals, names):
+        if isinstance(v, _Undefined):
+            raise Dy2StaticError(
+                f"loop variable '{n}' is undefined before a "
+                f"tensor-dependent {what}; initialize it first")
+
+
+def convert_while(cond_fn, body_fn, init_vals, names):
+    """while over loop vars `names`. cond_fn: vals -> bool-ish;
+    body_fn: vals -> vals."""
+    pred0 = cond_fn(init_vals)
+    if not _is_tracer_val(pred0):
+        vals = init_vals
+        while _truthy(pred0):
+            vals = body_fn(vals)
+            pred0 = cond_fn(vals)
+        return vals
+    _check_defined(init_vals, names, "while")
+    from ...static.nn import while_loop
+
+    # canonicalize python numerics so the carry structure is loop-stable
+    vals = tuple(
+        v if isinstance(v, Tensor) or not isinstance(v, (int, float, bool))
+        else Tensor(jnp.asarray(v))
+        for v in init_vals)
+    try:
+        out = while_loop(lambda *vs: cond_fn(tuple(vs)),
+                         lambda *vs: tuple(body_fn(tuple(vs))),
+                         list(vals))
+    except TypeError as e:
+        raise Dy2StaticError(
+            f"tensor-dependent while over {names}: the loop body must "
+            f"keep every loop variable's shape/dtype stable across "
+            f"iterations ({e})") from e
+    return tuple(out)
+
+
+def convert_for_range(start, stop, step, body_fn, init_vals, names,
+                      target_name=None):
+    """for <target> in range(start, stop, step) over assigned vars
+    `names` (including the loop target, which stays bound after the
+    loop). body_fn: (index, vals) -> vals."""
+    traced = any(_is_tracer_val(v) for v in (start, stop, step))
+    if not traced:
+        vals = init_vals
+        for i in range(int(unwrap(start)) if isinstance(start, Tensor) else int(start),
+                       int(unwrap(stop)) if isinstance(stop, Tensor) else int(stop),
+                       int(unwrap(step)) if isinstance(step, Tensor) else int(step)):
+            vals = body_fn(i, vals)
+        return vals
+    i0 = start if isinstance(start, Tensor) else Tensor(jnp.asarray(start))
+    if target_name is not None and target_name in names:
+        # the target is (re)bound from the index before each body run; an
+        # unbound pre-loop value is legitimate — seed the carry with the
+        # start index so the staged loop has a concrete slot for it
+        ti = names.index(target_name)
+        if isinstance(init_vals[ti], _Undefined):
+            init_vals = (init_vals[:ti] + (i0,) + init_vals[ti + 1:])
+    _check_defined(init_vals, names, "for")
+
+    def cond_fn(vals):
+        i = vals[0]
+        if _is_tracer_val(step) or int(unwrap(step) if isinstance(step, Tensor) else step) > 0:
+            lo = apply(lambda a, b: jnp.asarray(a) < jnp.asarray(b), i,
+                       stop if isinstance(stop, Tensor) else Tensor(jnp.asarray(stop)),
+                       name="for_lt")
+            if not _is_tracer_val(step):
+                return lo
+            hi = apply(lambda a, b: jnp.asarray(a) > jnp.asarray(b), i,
+                       stop if isinstance(stop, Tensor) else Tensor(jnp.asarray(stop)),
+                       name="for_gt")
+            pos = apply(lambda s: jnp.asarray(s) > 0,
+                        step if isinstance(step, Tensor) else Tensor(jnp.asarray(step)),
+                        name="for_sgn")
+            return apply(lambda p, a, b: jnp.where(p, a, b), pos, lo, hi,
+                         name="for_dir")
+        return apply(lambda a, b: jnp.asarray(a) > jnp.asarray(b), i,
+                     stop if isinstance(stop, Tensor) else Tensor(jnp.asarray(stop)),
+                     name="for_gt")
+
+    def body(vals):
+        i, rest = vals[0], tuple(vals[1:])
+        new = body_fn(i, rest)
+        nxt = apply(lambda a, s: jnp.asarray(a) + jnp.asarray(s), i,
+                    step if isinstance(step, Tensor) else Tensor(jnp.asarray(step)),
+                    name="for_inc")
+        return (nxt,) + tuple(new)
+
+    out = convert_while(cond_fn, body, (i0,) + tuple(init_vals),
+                        ("<for-index>",) + tuple(names))
+    return tuple(out[1:])
+
+
+def _bool_tensor(v):
+    return apply(lambda a: jnp.asarray(a).astype(bool), v, name="to_bool")
+
+
+def convert_logical_and(lhs_thunk, rhs_thunk):
+    l = lhs_thunk()
+    if not _is_tracer_val(l):
+        if not _truthy(l):
+            return l        # python short-circuit, value semantics kept
+        return rhs_thunk()
+    r = rhs_thunk()
+    return apply(lambda a, b: jnp.logical_and(jnp.asarray(a).astype(bool),
+                                              jnp.asarray(b).astype(bool)),
+                 _bool_tensor(l), r if isinstance(r, Tensor) else Tensor(jnp.asarray(r)),
+                 name="logical_and")
+
+
+def convert_logical_or(lhs_thunk, rhs_thunk):
+    l = lhs_thunk()
+    if not _is_tracer_val(l):
+        if _truthy(l):
+            return l
+        return rhs_thunk()
+    r = rhs_thunk()
+    return apply(lambda a, b: jnp.logical_or(jnp.asarray(a).astype(bool),
+                                             jnp.asarray(b).astype(bool)),
+                 _bool_tensor(l), r if isinstance(r, Tensor) else Tensor(jnp.asarray(r)),
+                 name="logical_or")
+
+
+def convert_logical_not(v):
+    if not _is_tracer_val(v):
+        return not _truthy(v)
+    return apply(lambda a: jnp.logical_not(jnp.asarray(a).astype(bool)), v,
+                 name="logical_not")
+
+
+def py_cond_guard(pred, lineno, construct, reason):
+    """Guard for control flow left in Python form: fine for Python
+    predicates, loud error when the predicate is traced."""
+    if _is_tracer_val(pred):
+        raise Dy2StaticError(
+            f"line {lineno}: `{construct}` over a traced tensor cannot be "
+            f"converted to staged control flow because {reason}. Rewrite "
+            "the body (no early return/break/continue, no attribute/"
+            "subscript mutation), or use static.nn.cond/while_loop "
+            "explicitly.")
+    return pred
+
+
+# --------------------------------------------------------------------------
+# convert_call: recursive conversion of user callees (reference
+# convert_call in dy2static/convert_call_func.py)
+# --------------------------------------------------------------------------
+
+_SKIP_MODULE_PREFIXES = (
+    "paddle_tpu", "jax", "numpy", "builtins", "functools", "itertools",
+    "operator", "math", "typing", "collections",
+)
+
+
+def convert_call(f):
+    import types
+
+    from .transformer import convert_to_static
+
+    if f is None or isinstance(f, _Undefined):
+        return f
+    if getattr(f, "_not_to_static", False):
+        return f
+    from ...nn.layer import Layer
+
+    if isinstance(f, Layer):
+        # convert the instance's forward in place (idempotent — the
+        # converted fn is runtime-dispatching, so eager behavior is
+        # unchanged); __call__ hooks keep running as usual
+        fwd = f.forward
+        fwd_fn = fwd.__func__ if isinstance(fwd, types.MethodType) else fwd
+        if isinstance(fwd_fn, types.FunctionType) and not getattr(
+                fwd_fn, "__ptpu_converted__", False):
+            mod = getattr(fwd_fn, "__module__", None) or ""
+            if mod.split(".")[0] not in _SKIP_MODULE_PREFIXES and mod:
+                converted = convert_to_static(fwd_fn)
+                if converted is not fwd_fn:
+                    f.forward = types.MethodType(converted, f)
+        return f
+    fn = f.__func__ if isinstance(f, types.MethodType) else f
+    if not isinstance(fn, types.FunctionType):
+        return f   # builtins, classes, other callables: left as-is
+    mod = getattr(fn, "__module__", None) or ""
+    if mod.split(".")[0] in _SKIP_MODULE_PREFIXES or mod == "":
+        return f
+    converted = convert_to_static(fn)
+    if converted is fn:
+        return f
+    if isinstance(f, types.MethodType):
+        return types.MethodType(converted, f.__self__)
+    return converted

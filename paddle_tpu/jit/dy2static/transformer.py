@@ -1,0 +1,476 @@
+"""AST conversion of dygraph Python into runtime-dispatched control flow
+(reference: python/paddle/jit/dy2static/program_translator.py:1145 and the
+~20 *_transformer.py passes — IfElseTransformer, LoopTransformer,
+LogicalTransformer, CallTransformer).
+
+One pass instead of twenty: the reference must lift Python into a
+ProgramDesc, so every construct needs its own graph-building transform.
+Here the eager engine is already traceable — the ONLY constructs that
+break under a jax trace are Python branches/loops whose predicate is a
+traced tensor, plus `and`/`or`/`not` over tensors in their tests. So the
+transform rewrites exactly those into convert_* helper calls
+(convert_operators.py) that keep bit-identical Python semantics for
+Python predicates and stage lax control flow for traced ones.
+
+Convertible region rule: an `if`/`while`/`for range()` whose body binds
+only names (no early return/break/continue, no attribute/subscript
+stores, no global/nonlocal/del/try/with/yield) is rewritten. Anything
+else keeps its Python form with the predicate wrapped in py_cond_guard —
+working unchanged for Python predicates, raising a source-located
+Dy2StaticError for traced ones.
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import linecache
+import textwrap
+import types
+import weakref
+
+__all__ = ["convert_to_static", "UnsupportedSourceError"]
+
+_HELPER = "_ptpu_dy2st"
+_CACHE: "weakref.WeakKeyDictionary[types.FunctionType, types.FunctionType]" = (
+    weakref.WeakKeyDictionary())
+
+
+class UnsupportedSourceError(Exception):
+    pass
+
+
+def _assigned_names(nodes):
+    """Names BOUND by a list of statements (this scope only — nested
+    function/class bodies bind in their own scope)."""
+    names: set[str] = set()
+
+    def collect_target(t):
+        if isinstance(t, ast.Name):
+            names.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                collect_target(e)
+        elif isinstance(t, ast.Starred):
+            collect_target(t.value)
+        # Attribute/Subscript targets bind no name
+
+    class V(ast.NodeVisitor):
+        def visit_Assign(self, node):
+            for t in node.targets:
+                collect_target(t)
+            self.generic_visit(node)
+
+        def visit_AugAssign(self, node):
+            collect_target(node.target)
+            self.generic_visit(node)
+
+        def visit_AnnAssign(self, node):
+            if node.value is not None:
+                collect_target(node.target)
+            self.generic_visit(node)
+
+        def visit_For(self, node):
+            collect_target(node.target)
+            self.generic_visit(node)
+
+        def visit_NamedExpr(self, node):
+            collect_target(node.target)
+            self.generic_visit(node)
+
+        def visit_With(self, node):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    collect_target(item.optional_vars)
+            self.generic_visit(node)
+
+        def visit_FunctionDef(self, node):
+            names.add(node.name)   # binds the name; do not descend
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_ClassDef(self, node):
+            names.add(node.name)
+
+        def visit_Lambda(self, node):
+            pass
+
+    v = V()
+    for n in nodes:
+        v.visit(n)
+    # generated temporaries (__ptpu_pred_N, branch fns…) are consumed
+    # entirely within their own region — threading them through an
+    # enclosing converted construct would select over function objects
+    return {n for n in names if not n.startswith("__ptpu_")}
+
+
+_BLOCKERS = (ast.Return, ast.Break, ast.Continue, ast.Try, ast.With,
+             ast.Raise, ast.Global, ast.Nonlocal, ast.Delete, ast.Yield,
+             ast.YieldFrom, ast.Import, ast.ImportFrom, ast.Match)
+
+
+def _walk_scope(node):
+    """ast.walk that does not descend into nested function/class bodies
+    (a `return` inside a nested def is that def's business)."""
+    yield node
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                         ast.ClassDef)):
+        return
+    for child in ast.iter_child_nodes(node):
+        yield from _walk_scope(child)
+
+
+def _conversion_blocker(nodes):
+    """Why this statement list cannot become a staged region (None = it
+    can)."""
+    for n in nodes:
+        for sub in _walk_scope(n):
+            if sub is not n and isinstance(
+                    sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+                continue
+            if isinstance(sub, _BLOCKERS):
+                kind = type(sub).__name__.lower()
+                return f"the body contains `{kind}` (line {getattr(sub, 'lineno', '?')})"
+            if isinstance(sub, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (sub.targets if isinstance(sub, ast.Assign)
+                           else [sub.target])
+                for t in targets:
+                    for leaf in ast.walk(t):
+                        if isinstance(leaf, (ast.Attribute, ast.Subscript)):
+                            return ("the body stores into an attribute/"
+                                    f"subscript (line {sub.lineno}), which "
+                                    "cannot be staged functionally")
+    return None
+
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _helper(attr):
+    return ast.Attribute(value=_name(_HELPER), attr=attr, ctx=ast.Load())
+
+
+def _call(fn_attr, args):
+    return ast.Call(func=_helper(fn_attr), args=args, keywords=[])
+
+
+def _const(v):
+    return ast.Constant(value=v)
+
+
+def _ld_tuple(names):
+    """(ld(lambda: a, 'a'), ld(lambda: b, 'b'), ...)"""
+    return ast.Tuple(
+        elts=[_call("ld", [ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                               kw_defaults=[], defaults=[]),
+            body=_name(n)), _const(n)]) for n in names],
+        ctx=ast.Load())
+
+
+def _unpack_stmt(names, value):
+    """a, b, ... = <value>  (single name still via tuple for uniformity)"""
+    target = ast.Tuple(elts=[_name(n, ast.Store()) for n in names],
+                       ctx=ast.Store())
+    return ast.Assign(targets=[target], value=value)
+
+
+def _branch_fn(fname, names, body):
+    """def <fname>(__ptpu_vals): (a, b,) = __ptpu_vals; <body>; return (a, b,)"""
+    stmts = []
+    if names:
+        stmts.append(_unpack_stmt(names, _name("__ptpu_vals")))
+    stmts.extend(body if body else [])
+    if not stmts:
+        stmts.append(ast.Pass())
+    stmts.append(ast.Return(value=ast.Tuple(
+        elts=[_name(n) for n in names], ctx=ast.Load())))
+    return ast.FunctionDef(
+        name=fname,
+        args=ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg="__ptpu_vals")],
+            kwonlyargs=[], kw_defaults=[], defaults=[]),
+        body=stmts, decorator_list=[], returns=None, type_params=[])
+
+
+class _Dy2StaticTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.counter = 0
+        self.depth = 0
+
+    # -- helpers ------------------------------------------------------------
+
+    def _next(self):
+        self.counter += 1
+        return self.counter
+
+    def _xform_test(self, test):
+        """Convert and/or/not over tensors inside a predicate expression."""
+        tr = self
+
+        class T(ast.NodeTransformer):
+            def visit_BoolOp(self, node):
+                self.generic_visit(node)
+                thunks = [ast.Lambda(
+                    args=ast.arguments(posonlyargs=[], args=[],
+                                       kwonlyargs=[], kw_defaults=[],
+                                       defaults=[]),
+                    body=v) for v in node.values]
+                fn = ("convert_logical_and" if isinstance(node.op, ast.And)
+                      else "convert_logical_or")
+                out = thunks[0].body
+                # left-fold; keep laziness by re-wrapping the accumulated
+                # expression in a fresh thunk each fold
+                for nxt in thunks[1:]:
+                    out = _call(fn, [ast.Lambda(
+                        args=ast.arguments(posonlyargs=[], args=[],
+                                           kwonlyargs=[], kw_defaults=[],
+                                           defaults=[]),
+                        body=out), nxt])
+                return out
+
+            def visit_UnaryOp(self, node):
+                self.generic_visit(node)
+                if isinstance(node.op, ast.Not):
+                    return _call("convert_logical_not", [node.operand])
+                return node
+
+            def visit_Lambda(self, node):
+                return node   # opaque
+
+        return T().visit(test)
+
+    def _guarded(self, node, reason, construct):
+        """Leave the construct in Python form, with a loud traced-pred guard."""
+        node.test = _call("py_cond_guard", [
+            self._xform_test(node.test), _const(node.lineno),
+            _const(construct), _const(reason)])
+        return node
+
+    # -- statements ---------------------------------------------------------
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        blocker = (_conversion_blocker(node.body)
+                   or _conversion_blocker(node.orelse))
+        if blocker:
+            return self._guarded(node, blocker, "if")
+        n = self._next()
+        names = sorted(_assigned_names(node.body)
+                       | _assigned_names(node.orelse))
+        test_var = f"__ptpu_pred_{n}"
+        true_fn = _branch_fn(f"__ptpu_true_{n}", names, node.body)
+        false_fn = _branch_fn(f"__ptpu_false_{n}", names, node.orelse)
+        call = _call("convert_ifelse", [
+            _name(test_var), _name(true_fn.name), _name(false_fn.name),
+            _ld_tuple(names),
+            ast.Tuple(elts=[_const(s) for s in names], ctx=ast.Load())])
+        out = [
+            ast.Assign(targets=[_name(test_var, ast.Store())],
+                       value=self._xform_test(node.test)),
+            true_fn, false_fn,
+        ]
+        if names:
+            out.append(_unpack_stmt(names, call))
+        else:
+            out.append(ast.Expr(value=call))
+        return out
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse:
+            return self._guarded(node, "the loop has an `else` clause",
+                                 "while")
+        blocker = _conversion_blocker(node.body)
+        if blocker:
+            return self._guarded(node, blocker, "while")
+        names = sorted(_assigned_names(node.body))
+        if not names:
+            return self._guarded(
+                node, "the loop body binds no variables (nothing to "
+                "carry through a staged loop)", "while")
+        n = self._next()
+        cond_body = [ast.Return(value=self._xform_test(node.test))]
+        if names:
+            cond_body.insert(0, _unpack_stmt(names, _name("__ptpu_vals")))
+        cond_fn = ast.FunctionDef(
+            name=f"__ptpu_cond_{n}",
+            args=ast.arguments(posonlyargs=[],
+                               args=[ast.arg(arg="__ptpu_vals")],
+                               kwonlyargs=[], kw_defaults=[], defaults=[]),
+            body=cond_body, decorator_list=[], returns=None, type_params=[])
+        body_fn = _branch_fn(f"__ptpu_body_{n}", names, node.body)
+        call = _call("convert_while", [
+            _name(cond_fn.name), _name(body_fn.name), _ld_tuple(names),
+            ast.Tuple(elts=[_const(s) for s in names], ctx=ast.Load())])
+        out = [cond_fn, body_fn]
+        if names:
+            out.append(_unpack_stmt(names, call))
+        else:
+            out.append(ast.Expr(value=call))
+        return out
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        is_range = (isinstance(node.iter, ast.Call)
+                    and isinstance(node.iter.func, ast.Name)
+                    and node.iter.func.id == "range"
+                    and not node.iter.keywords
+                    and 1 <= len(node.iter.args) <= 3
+                    and not any(isinstance(a, ast.Starred)
+                                for a in node.iter.args)
+                    and isinstance(node.target, ast.Name))
+        if not is_range or node.orelse:
+            return node   # python for: unrolls under trace, fine as-is
+        blocker = _conversion_blocker(node.body)
+        if blocker:
+            # range() loop we cannot stage: keep python; range() itself
+            # raises on tracer args, so no silent mis-trace is possible
+            return node
+        n = self._next()
+        # the loop target stays bound after the loop (python semantics),
+        # so it threads through the converted region like any assignment
+        names = sorted(_assigned_names(node.body) | {node.target.id})
+        args = list(node.iter.args)
+        if len(args) == 1:
+            start, stop, step = _const(0), args[0], _const(1)
+        elif len(args) == 2:
+            start, stop, step = args[0], args[1], _const(1)
+        else:
+            start, stop, step = args
+        body_fn = _branch_fn(f"__ptpu_fbody_{n}", names, node.body)
+        # bind the loop target from the index argument
+        body_fn.args.args.insert(0, ast.arg(arg="__ptpu_i"))
+        body_fn.body.insert(
+            1 if names else 0,
+            ast.Assign(targets=[node.target],
+                       value=_name("__ptpu_i")))
+        call = _call("convert_for_range", [
+            start, stop, step,
+            _name(body_fn.name), _ld_tuple(names),
+            ast.Tuple(elts=[_const(s) for s in names], ctx=ast.Load()),
+            _const(node.target.id)])
+        out = [body_fn]
+        if names:
+            out.append(_unpack_stmt(names, call))
+        else:
+            out.append(ast.Expr(value=call))
+        return out
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        # wrap the callee so user functions convert recursively; literal
+        # helper calls and super() stay untouched
+        if isinstance(node.func, ast.Name) and node.func.id in (
+                "super", "range", "len", "isinstance", "print", _HELPER):
+            return node
+        if (isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == _HELPER):
+            return node
+        node.func = _call("convert_call", [node.func])
+        return node
+
+    def visit_FunctionDef(self, node):
+        if self.depth > 0:
+            return node   # nested defs keep their own (python) semantics
+        self.depth += 1
+        node.decorator_list = []   # avoid re-applying @to_static on exec
+        self.generic_visit(node)
+        self.depth -= 1
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        return node
+
+
+def _get_source(fn):
+    try:
+        src = inspect.getsource(fn)
+    except (OSError, TypeError) as e:
+        raise UnsupportedSourceError(str(e)) from e
+    return textwrap.dedent(src)
+
+
+def convert_to_static(fn):
+    """AST-convert one function (cached). Returns the original function
+    when its source is unavailable or it opted out via @not_to_static."""
+    if isinstance(fn, types.MethodType):
+        converted = convert_to_static(fn.__func__)
+        if converted is fn.__func__:
+            return fn
+        return types.MethodType(converted, fn.__self__)
+    if not isinstance(fn, types.FunctionType):
+        return fn
+    if getattr(fn, "_not_to_static", False) or getattr(
+            fn, "__ptpu_converted__", False):
+        return fn
+    cached = _CACHE.get(fn)
+    if cached is not None:
+        return cached
+    try:
+        src = _get_source(fn)
+        tree = ast.parse(src)
+    except (UnsupportedSourceError, SyntaxError):
+        _CACHE[fn] = fn
+        return fn
+    if any(isinstance(n, (ast.Yield, ast.YieldFrom))
+           for n in ast.walk(tree)):
+        _CACHE[fn] = fn   # generators cannot be converted
+        return fn
+    tree = _Dy2StaticTransformer().visit(tree)
+    ast.fix_missing_locations(tree)
+
+    from . import convert_operators as _ops
+
+    # Execute via a factory that takes the original freevars as
+    # parameters, exec'd INTO fn.__globals__: module-global loads in the
+    # converted function stay LIVE (later monkeypatching/rebinding is
+    # seen, same as the original function), while closure variables
+    # resolve through the factory's scope. Only two reserved names touch
+    # the user module: the helper and the transient factory binding.
+    freevars = list(fn.__code__.co_freevars)
+    fn_def = tree.body[0]
+    if not isinstance(fn_def, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        _CACHE[fn] = fn   # lambda / assignment-wrapped source: leave as-is
+        return fn
+    factory_name = "__ptpu_dy2st_factory"
+    factory = ast.FunctionDef(
+        name=factory_name,
+        args=ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=v) for v in freevars],
+            kwonlyargs=[], kw_defaults=[], defaults=[]),
+        body=[fn_def, ast.Return(value=_name(fn_def.name))],
+        decorator_list=[], returns=None, type_params=[])
+    tree.body = [factory]
+    ast.fix_missing_locations(tree)
+    filename = f"<dy2static {fn.__module__}.{fn.__qualname__}>"
+    try:
+        code = compile(tree, filename=filename, mode="exec")
+        globalns = fn.__globals__
+        globalns.setdefault(_HELPER, _ops)
+        exec(code, globalns)
+        factory_fn = globalns.pop(factory_name)
+        cell_vals = []
+        for cell in (fn.__closure__ or ()):
+            try:
+                cell_vals.append(cell.cell_contents)
+            except ValueError:   # empty cell (recursive def)
+                cell_vals.append(_ops.UNDEFINED)
+        new_fn = factory_fn(*cell_vals)
+    except Exception:
+        _CACHE[fn] = fn
+        return fn
+    # make the generated source visible in tracebacks
+    linecache.cache[filename] = (
+        len(ast.unparse(tree)), None,
+        [l + "\n" for l in ast.unparse(tree).splitlines()], filename)
+    new_fn.__ptpu_converted__ = True
+    new_fn.__wrapped__ = fn
+    new_fn.__defaults__ = fn.__defaults__
+    new_fn.__kwdefaults__ = fn.__kwdefaults__
+    _CACHE[fn] = new_fn
+    return new_fn
