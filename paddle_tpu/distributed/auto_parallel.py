@@ -124,10 +124,14 @@ class Engine:
         self._strategy = strategy or Strategy()
         self._compiled = None
 
-    def tune(self, global_batch, cluster=None, top_k=5, measure=False):
+    def tune(self, global_batch, cluster=None, top_k=5, measure=False,
+             measure_top_k=8, report_path=None):
         """Search parallel plans for this engine's model (reference:
-        tuner/optimization_tuner.py via Engine _tune). Returns ranked
-        Plans; apply one with paddle.parallel.init_mesh(**plan.mesh_kwargs())."""
+        tuner/optimization_tuner.py via Engine _tune). With measure=True
+        the top measure_top_k candidates are trial-run on the current
+        mesh and the choice is by measurement (roofline recalibrated from
+        the trials; report written to report_path). Returns ranked Plans;
+        apply one with paddle.parallel.init_mesh(**plan.mesh_kwargs())."""
         from .tuner import ClusterSpec, ModelSpec, OptimizationTuner
 
         cfg = getattr(self._model, "cfg", None) or getattr(
@@ -138,8 +142,10 @@ class Engine:
                 "(hidden_size/num_hidden_layers); construct a "
                 "distributed.tuner.ModelSpec manually for other models")
         spec = ModelSpec.from_gpt_config(cfg, global_batch)
-        return OptimizationTuner(spec, cluster or ClusterSpec()).tune(
-            top_k=top_k, measure=measure)
+        self._tuner = OptimizationTuner(spec, cluster or ClusterSpec())
+        return self._tuner.tune(top_k=top_k, measure=measure,
+                                measure_top_k=measure_top_k,
+                                report_path=report_path)
 
     def prepare(self, inputs_spec=None, labels_spec=None, mode="train"):
         from .. import jit

@@ -85,6 +85,10 @@ class OptimizationTuner:
     def __init__(self, model: ModelSpec, cluster: Optional[ClusterSpec] = None):
         self.model = model
         self.cluster = cluster or ClusterSpec()
+        # measured/estimated ratio fitted from trial runs (tune(measure=True));
+        # 1.0 = uncalibrated analytic roofline
+        self.calibration = 1.0
+        self.last_report: Optional[dict] = None
 
     # -- analytical roofline -------------------------------------------------
     def estimate(self, plan: Plan) -> Plan:
@@ -177,16 +181,49 @@ class OptimizationTuner:
                                         microbatches=max(1, mb)))
         return out
 
-    def tune(self, top_k: int = 5, measure: bool = False) -> List[Plan]:
-        """Rank candidate plans; optionally refine the top candidates by a
-        measured trial (requires enough local/virtual devices)."""
+    def tune(self, top_k: int = 5, measure: bool = False,
+             measure_top_k: int = 8, report_path: Optional[str] = None
+             ) -> List[Plan]:
+        """Rank candidate plans; with measure=True run a short compiled
+        trial for the top `measure_top_k` candidates on the current
+        (virtual or real) mesh, calibrate the roofline from the trials,
+        and choose by MEASUREMENT (reference: tuner/optimization_tuner.py
+        profile mode + tuner/profiler.py). A JSON tuning report is stored
+        on self.last_report (and written to report_path when given)."""
         plans = [self.estimate(p) for p in self.candidates()]
         ranked = sorted((p for p in plans if p.feasible),
                         key=lambda p: p.est_step_time)
-        ranked = ranked[:top_k]
+        trials: List[Plan] = []
         if measure and ranked:
-            ranked = self._measure(ranked)
-        return ranked
+            trials = self._measure(ranked[:max(measure_top_k, top_k)])
+            ratios = [p.breakdown["measured_s"] / p.breakdown["trial_est_s"]
+                      for p in trials
+                      if p.breakdown.get("measured_s")
+                      and p.breakdown.get("trial_est_s")]
+            if ratios:
+                self.calibration = sorted(ratios)[len(ratios) // 2]
+            # measured plans rank by wall clock; unmeasured keep their
+            # (calibrated) estimates behind every measured one
+            def key(p):
+                m = p.breakdown.get("measured_s")
+                return (0, m) if m else (1, p.est_step_time * self.calibration)
+            ranked = sorted(trials, key=key) + ranked[len(trials):]
+        self.last_report = {
+            "model": dataclasses.asdict(self.model),
+            "cluster": dataclasses.asdict(self.cluster),
+            "n_candidates": len(plans),
+            "n_feasible": sum(p.feasible for p in plans),
+            "calibration": self.calibration,
+            "trials": [dataclasses.asdict(p) for p in trials],
+            "chosen": dataclasses.asdict(ranked[0]) if ranked else None,
+            "ranked": [dataclasses.asdict(p) for p in ranked[:top_k]],
+        }
+        if report_path:
+            import json
+
+            with open(report_path, "w") as f:
+                json.dump(self.last_report, f, indent=1)
+        return ranked[:top_k]
 
     def best(self) -> Plan:
         ranked = self.tune(top_k=1)
@@ -246,8 +283,19 @@ class OptimizationTuner:
                     out = compiled(ids, lab)
                 float(out)
                 wall = (time.perf_counter() - t0) / 3
+                # roofline estimate of the TRIAL workload itself: the
+                # measured/estimated ratio calibrates the model constants
+                # for the mesh actually measured on
+                trial_spec = ModelSpec.from_gpt_config(cfg, B)
+                trial_spec = dataclasses.replace(trial_spec, seq_len=16)
+                trial_est = OptimizationTuner(trial_spec, self.cluster).estimate(
+                    dataclasses.replace(plan, breakdown={}))
                 measured.append(dataclasses.replace(
-                    plan, breakdown=dict(plan.breakdown, measured_s=wall)))
+                    plan, breakdown=dict(
+                        plan.breakdown, measured_s=wall,
+                        trial_est_s=(trial_est.est_step_time
+                                     if trial_est.est_step_time < float("inf")
+                                     else None))))
             except Exception as e:  # infeasible at runtime: keep estimate
                 measured.append(dataclasses.replace(
                     plan, breakdown=dict(plan.breakdown,
