@@ -104,6 +104,57 @@ def synchronize(device=None):
     jnp.zeros(()).block_until_ready()
 
 
+def _resolve_device(device=None):
+    if device is None:
+        return _current if _current is not None else jax.devices()[0]
+    if isinstance(device, int):
+        return jax.devices()[device]
+    if isinstance(device, str):
+        idx = int(device.split(":")[1]) if ":" in device else 0
+        return jax.devices()[idx]  # out-of-range raises, same as int form
+    return device  # already a jax device
+
+
+def memory_stats(device=None):
+    """Raw PJRT allocator statistics for one device (reference:
+    paddle/fluid/memory/stats.h surface). Keys include bytes_in_use,
+    peak_bytes_in_use, bytes_limit where the backend reports them; an
+    empty dict on backends without allocator stats (XLA-CPU)."""
+    try:
+        stats = _resolve_device(device).memory_stats()
+        return dict(stats) if stats else {}
+    except Exception:
+        return {}
+
+
+def _mem_stat(key, device=None):
+    return int(memory_stats(device).get(key, 0))
+
+
+def max_memory_allocated(device=None):
+    """Peak device-memory bytes in use (reference:
+    paddle.device.cuda.max_memory_allocated). On TPU this is the PJRT
+    allocator's peak_bytes_in_use — the per-step HBM high-water mark."""
+    return _mem_stat("peak_bytes_in_use", device)
+
+
+def memory_allocated(device=None):
+    """Current device-memory bytes in use (reference:
+    paddle.device.cuda.memory_allocated)."""
+    return _mem_stat("bytes_in_use", device)
+
+
+def max_memory_reserved(device=None):
+    """Reference max_memory_reserved: the allocator pool bound — PJRT
+    reports the backend's bytes_limit (0 when unreported)."""
+    return _mem_stat("bytes_limit", device)
+
+
+def memory_reserved(device=None):
+    return _mem_stat("bytes_reserved", device) or _mem_stat(
+        "bytes_in_use", device)
+
+
 cuda = type(
     "cuda_ns",
     (),
@@ -112,19 +163,13 @@ cuda = type(
         "Event": Event,
         "synchronize": staticmethod(synchronize),
         "device_count": staticmethod(device_count),
-        "max_memory_allocated": staticmethod(lambda device=None: _mem_stat("peak_bytes_in_use")),
-        "memory_allocated": staticmethod(lambda device=None: _mem_stat("bytes_in_use")),
+        "max_memory_allocated": staticmethod(max_memory_allocated),
+        "memory_allocated": staticmethod(memory_allocated),
+        "max_memory_reserved": staticmethod(max_memory_reserved),
+        "memory_reserved": staticmethod(memory_reserved),
         "empty_cache": staticmethod(lambda: None),
     },
 )()
-
-
-def _mem_stat(key):
-    try:
-        stats = jax.devices()[0].memory_stats()
-        return int(stats.get(key, 0)) if stats else 0
-    except Exception:
-        return 0
 
 
 def get_all_device_type():

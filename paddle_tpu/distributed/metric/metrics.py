@@ -80,15 +80,19 @@ class DistributedAuc:
         # f64→f32 (exact only to 2^24) and raw int32 (2^31) overflow
         # production-scale counts. Reduce base-2^16 digits instead: each
         # digit sums to < world * 2^16 (int32-safe for any realistic job)
-        # and the int64 recombination on host is exact.
+        # and the int64 recombination on host is exact. All 8 digit rows
+        # (4 digits x pos/neg) ride ONE stacked all_reduce.
+        stacked = np.stack([
+            ((arr >> (16 * d)) & 0xFFFF).astype(np.int32)
+            for arr in (self._pos, self._neg) for d in range(4)])
+        t = paddle.to_tensor(stacked)
+        all_reduce(t)
+        rows = np.asarray(t.numpy()).astype(np.int64)
         merged = []
-        for arr in (self._pos, self._neg):
-            total = np.zeros_like(arr)
+        for base in (0, 4):
+            total = np.zeros(self.bucket_size, np.int64)
             for d in range(4):
-                digit = ((arr >> (16 * d)) & 0xFFFF).astype(np.int32)
-                t = paddle.to_tensor(digit)
-                all_reduce(t)
-                total += np.asarray(t.numpy()).astype(np.int64) << (16 * d)
+                total += rows[base + d] << (16 * d)
             merged.append(total)
         return merged[0], merged[1]
 

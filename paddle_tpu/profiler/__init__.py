@@ -132,6 +132,8 @@ class Profiler:
         self._timer_only = timer_only
         self._step_times = []
         self._last_step_t = None
+        self._profile_memory = profile_memory
+        self._mem_samples = []  # (bytes_in_use, peak_bytes_in_use) per step
 
     def start(self):
         _tracer.enabled = True
@@ -165,6 +167,11 @@ class Profiler:
             self._step_times.append((now - self._last_step_t, num_samples))
         self._last_step_t = now
         self._step += 1
+        if self._profile_memory:
+            from .. import device as _device
+
+            self._mem_samples.append((_device.memory_allocated(),
+                                      _device.max_memory_allocated()))
 
     def step_info(self, unit="samples"):
         if not self._step_times:
@@ -188,6 +195,20 @@ class Profiler:
         lines = [f"{'name':40s} {'calls':>8s} {'total_ms':>12s}"]
         for name, (tot, n) in sorted(by_name.items(), key=lambda kv: -kv[1][0]):
             lines.append(f"{name[:40]:40s} {n:8d} {tot:12.3f}")
+        if self._mem_samples:
+            # device-memory statistics column (reference:
+            # profiler_statistic.py memory tables / memory/stats.h peaks)
+            cur = [c for c, _ in self._mem_samples]
+            peak = [p for _, p in self._mem_samples]
+            mb = 1 / 2**20
+            lines.append("")
+            lines.append(
+                f"{'device memory (MiB)':40s} {'current':>12s} {'peak':>12s}")
+            lines.append(
+                f"{'  last step':40s} {cur[-1]*mb:12.1f} {peak[-1]*mb:12.1f}")
+            lines.append(
+                f"{'  max over steps':40s} {max(cur)*mb:12.1f} "
+                f"{max(peak)*mb:12.1f}")
         return "\n".join(lines)
 
     def _export_chrome(self, fname):
