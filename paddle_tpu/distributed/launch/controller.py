@@ -30,6 +30,10 @@ class LaunchConfig:
     rendezvous_timeout: float = 120.0
     envs: Dict[str, str] = dataclasses.field(default_factory=dict)
     module: bool = False                  # python -m script
+    # a node slot whose controller heartbeat is older than this is
+    # considered dead and may be reclaimed by a replacement node
+    # (reference: ETCDMaster TTL registrations, launch/controllers/master.py)
+    stale_timeout: float = 30.0
 
 
 class Controller:
@@ -39,9 +43,52 @@ class Controller:
         self.logs: List = []
         self._store = None
         self._server = None
+        self._gen: Optional[int] = None   # claim-counter fencing token
+        self._no_hb_since: Dict[int, float] = {}
 
     # -- rendezvous --------------------------------------------------------
+    # (liveness protocol is intentionally self-contained; fleet/elastic.py's
+    # ElasticManager runs a similar TTL heartbeat for TRAINING-process
+    # membership — this one leases controller node slots, a different
+    # lifecycle. Cross-check both when changing either.)
+    def _hb_key(self, slot: int) -> str:
+        return f"{self.cfg.job_id}/hb/{slot}"
+
+    def _heartbeat(self, slot: int) -> bool:
+        """Renew the slot lease. Returns False when ownership was lost
+        (another node took the slot over) — the holder must fence."""
+        if self._store is None:
+            return True
+        try:
+            key = f"{self.cfg.job_id}/claim/{slot}"
+            if self._gen is not None and int(
+                    self._store.add(key, 0)) != self._gen:
+                return False   # usurped: a reclaimer bumped the counter
+            self._store.set(self._hb_key(slot),
+                            str(time.time()).encode())
+        except (OSError, RuntimeError, TimeoutError):
+            pass   # store unreachable: keep running, lease may expire
+        return True
+
+    def _slot_stale(self, slot: int) -> bool:
+        try:
+            raw = self._store.get(self._hb_key(slot), timeout_ms=200)
+            return time.time() - float(raw.decode()) > self.cfg.stale_timeout
+        except Exception:
+            # claimed but no heartbeat yet: live during a grace window
+            # (claimant writes its first beat right after claiming), stale
+            # if the beat never appears — a claimant that died immediately
+            # must not wedge the slot forever
+            first = self._no_hb_since.setdefault(slot, time.time())
+            return time.time() - first > self.cfg.stale_timeout
+
     def _resolve_node_rank(self) -> int:
+        """Claim a node slot through the KV master. Fresh slots are taken
+        first-come; a slot whose owner's heartbeat went stale (controller
+        died) is RECLAIMED by a replacement node — the elastic re-admit
+        path (reference: master.py:79 ETCD node registry with TTL +
+        watcher-driven re-admission). Latest claimant wins a contested
+        stale slot; heartbeats keep live owners uncontested."""
         cfg = self.cfg
         if cfg.nnodes <= 1:
             return 0
@@ -61,12 +108,36 @@ class Controller:
         except (OSError, RuntimeError):
             self._store = TCPStore(host, int(port), is_master=False,
                                    timeout=cfg.rendezvous_timeout)
-        key = f"{cfg.job_id}/node_rank"
-        rank = int(self._store.add(key, 1)) - 1
-        if rank >= cfg.nnodes:
-            raise RuntimeError(
-                f"more nodes joined job {cfg.job_id!r} than nnodes={cfg.nnodes}")
-        return rank
+        deadline = time.time() + cfg.rendezvous_timeout
+        while True:
+            for slot in range(cfg.nnodes):
+                key = f"{cfg.job_id}/claim/{slot}"
+                n = int(self._store.add(key, 0))
+                if n == 0:
+                    if int(self._store.add(key, 1)) == 1:
+                        self._gen = 1
+                        self._heartbeat(slot)
+                        return slot
+                    continue  # lost the race for this slot
+                if self._slot_stale(slot):
+                    # atomic takeover: the add counter is the fencing
+                    # token — only the reclaimer whose add lands first
+                    # (n -> n+1) wins; racers see a later count and move on
+                    won = int(self._store.add(key, 1))
+                    if won != n + 1:
+                        continue
+                    self._gen = won
+                    self._no_hb_since.pop(slot, None)
+                    self._heartbeat(slot)
+                    print(f"[launch] reclaimed stale node slot {slot} "
+                          f"of job {cfg.job_id!r} (generation {won})",
+                          flush=True)
+                    return slot
+            if time.time() >= deadline:
+                raise RuntimeError(
+                    f"no free node slot in job {cfg.job_id!r} "
+                    f"(nnodes={cfg.nnodes}, all slots held by live nodes)")
+            time.sleep(0.5)
 
     # -- pod lifecycle -----------------------------------------------------
     def _worker_env(self, node_rank: int, local_rank: int) -> Dict[str, str]:
@@ -146,10 +217,21 @@ class Controller:
                 pass
         self.procs, self.logs = [], []
 
-    def watch(self) -> int:
-        """Poll children until all succeed or one fails (fail-fast)."""
+    def watch(self, node_rank: int = 0) -> int:
+        """Poll children until all succeed or one fails (fail-fast);
+        heartbeats the node's slot so live nodes are never reclaimed."""
         pos = 0
+        last_hb = 0.0
         while True:
+            if time.time() - last_hb > max(self.cfg.stale_timeout / 3, 0.5):
+                if not self._heartbeat(node_rank):
+                    # fenced: lease lost to a replacement node — running on
+                    # would split-brain the slot (duplicate global ranks)
+                    print(f"[launch] node slot {node_rank} lease lost; "
+                          "fencing this pod", flush=True)
+                    self.stop_pod()
+                    return 102   # reference ELASTIC re-plan exit code
+                last_hb = time.time()
             pos = self._tail_rank0(pos)
             codes = [p.poll() for p in self.procs]
             if any(c not in (None, 0) for c in codes):
@@ -169,7 +251,7 @@ class Controller:
         restarts = 0
         while True:
             self.build_pod(node_rank)
-            rc = self.watch()
+            rc = self.watch(node_rank)
             if rc == 0 or restarts >= cfg.max_restarts:
                 return rc
             restarts += 1

@@ -109,6 +109,110 @@ def test_two_node_rendezvous_assigns_distinct_ranks(tmp_path):
         assert p.read_text() == "4"
 
 
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _node_driver(tmp_path, worker, port, job_id, nnodes=3, extra=""):
+    return _write(tmp_path, f"driver_{job_id}.py", f"""
+        import sys
+        sys.path.insert(0, {str(os.getcwd())!r})
+        from paddle_tpu.distributed.launch import LaunchConfig, launch_job
+        sys.exit(launch_job(LaunchConfig(
+            script={worker!r}, nnodes={nnodes}, nproc_per_node=2,
+            master="127.0.0.1:{port}", job_id={job_id!r},
+            {extra}
+            log_dir=sys.argv[1])))
+    """)
+
+
+def test_three_node_rendezvous_and_logs(tmp_path):
+    """VERDICT r3 item 9: >= 3-node rendezvous through the KV master —
+    disjoint global ranks 0..5 and per-rank logs on every node."""
+    port = _free_port()
+    worker = _write(tmp_path, "worker.py", """
+        import os, pathlib
+        out = pathlib.Path(os.environ["OUT_DIR"]); out.mkdir(exist_ok=True)
+        rank = os.environ['PADDLE_TRAINER_ID']
+        (out / f"rank_{rank}").write_text(os.environ["PADDLE_TRAINERS_NUM"])
+        print(f"hello from rank {rank}", flush=True)
+    """)
+    driver = _node_driver(tmp_path, worker, port, "t3n")
+    env = dict(os.environ, OUT_DIR=str(tmp_path / "out"),
+               PTPU_FORCE_PLATFORM="cpu")
+    procs = [subprocess.Popen([sys.executable, driver,
+                               str(tmp_path / f"log{i}")], env=env)
+             for i in range(3)]
+    for p in procs:
+        assert p.wait(120) == 0
+    ranks = sorted(p.name for p in (tmp_path / "out").iterdir())
+    assert ranks == [f"rank_{r}" for r in range(6)]
+    for p in (tmp_path / "out").iterdir():
+        assert p.read_text() == "6"
+    # per-rank logs: each node dir holds its two ranks' logs with content
+    all_logged = set()
+    for i in range(3):
+        logdir = tmp_path / f"log{i}"
+        for f in logdir.iterdir():
+            assert f.name.startswith("workerlog.")
+            r = int(f.name.split(".")[1])
+            assert f"hello from rank {r}" in f.read_text()
+            all_logged.add(r)
+    assert all_logged == set(range(6))
+
+
+def test_elastic_dead_node_slot_reclaimed(tmp_path):
+    """A node whose controller died leaves a stale heartbeat; a
+    replacement node re-admits into its slot and the 3-node job
+    completes (reference: master.py ETCD TTL registry re-admission)."""
+    import time
+
+    port = _free_port()
+    quick = _write(tmp_path, "quick.py", """
+        import os
+        print("dead-node worker ran", flush=True)
+    """)
+    # phase 1: a lone controller claims slot 0 of the 3-node job, runs
+    # its (trivially exiting) pod, and exits — leaving claim 0 held with
+    # an aging heartbeat, like a node that crashed after registering
+    d1 = _node_driver(tmp_path, quick, port, "t3e",
+                      extra="stale_timeout=2.0,")
+    env = dict(os.environ, OUT_DIR=str(tmp_path / "out"),
+               PTPU_FORCE_PLATFORM="cpu")
+    # the phase-1 controller must NOT own the KV master (it would die with
+    # it): host a standalone master for the whole test
+    master = subprocess.Popen([sys.executable, "-c", (
+        "import sys; sys.path.insert(0, %r);"
+        "from paddle_tpu.distributed.store import TCPStore; import time;"
+        "s = TCPStore('127.0.0.1', %d, is_master=True, timeout=120);"
+        "time.sleep(90)") % (str(os.getcwd()), port)], env=env)
+    try:
+        time.sleep(1.0)  # let the master bind
+        p1 = subprocess.Popen([sys.executable, d1, str(tmp_path / "logA")],
+                              env=env)
+        assert p1.wait(60) == 0
+        time.sleep(2.5)  # age slot 0's heartbeat past stale_timeout
+        worker = _write(tmp_path, "worker.py", """
+            import os, pathlib
+            out = pathlib.Path(os.environ["OUT_DIR"]); out.mkdir(exist_ok=True)
+            (out / f"rank_{os.environ['PADDLE_TRAINER_ID']}").write_text("ok")
+        """)
+        d2 = _node_driver(tmp_path, worker, port, "t3e",
+                          extra="stale_timeout=2.0,")
+        procs = [subprocess.Popen([sys.executable, d2,
+                                   str(tmp_path / f"logB{i}")], env=env)
+                 for i in range(3)]
+        for p in procs:
+            assert p.wait(120) == 0
+        ranks = sorted(p.name for p in (tmp_path / "out").iterdir())
+        assert ranks == [f"rank_{r}" for r in range(6)]
+    finally:
+        master.kill()
+        master.wait(10)
+
+
 def _spawn_worker(out_dir):
     import pathlib
     rank = os.environ["PADDLE_TRAINER_ID"]
