@@ -148,13 +148,21 @@ def matrix_rank(x, tol=None, hermitian=False, name=None):
 
 
 def lstsq(x, y, rcond=None, driver=None, name=None):
-    sol, res, rank, sv = jnp.linalg.lstsq(x._data, y._data, rcond=rcond)
-    return Tensor(sol), Tensor(res), Tensor(rank), Tensor(sv)
+    # through the dispatch layer so the solution carries gradients (the
+    # svd-based lstsq is differentiable in its solution output)
+    def fn(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank, sv
+
+    return apply(fn, x, y, name="lstsq")
 
 
 def lu(x, pivot=True, get_infos=False, name=None):
-    lu_, piv = jax.scipy.linalg.lu_factor(x._data)
-    outs = (Tensor(lu_), Tensor(piv.astype(jnp.int32) + 1))
+    def fn(a):
+        lu_, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_, piv.astype(jnp.int32) + 1
+
+    outs = tuple(apply(fn, x, name="lu"))
     if get_infos:
         return outs + (Tensor(jnp.zeros((), jnp.int32)),)
     return outs

@@ -1,0 +1,268 @@
+"""Per-op linalg/fft/signal gradient checks (VERDICT r2 weak #8;
+reference model: unittests' per-op OpTest check_grad — analytic gradients
+vs central finite differences — for svd/eig/lstsq/cholesky/qr etc., which
+previously leaned on a single smoke file here).
+
+Matrices are conditioned (A @ A.T + n*I) so the decompositions sit away
+from the non-differentiable set; FD probes a sample of entries with fp32
+tolerances.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fft, linalg, signal
+
+pytestmark = pytest.mark.slow
+
+
+def _spd(n, seed, batch=()):
+    r = np.random.RandomState(seed)
+    a = r.randn(*batch, n, n).astype(np.float32)
+    return (a @ np.swapaxes(a, -1, -2) + n * np.eye(n, dtype=np.float32))
+
+
+def _rect(m, n, seed):
+    return (np.random.RandomState(seed).randn(m, n) * 0.5).astype(np.float32)
+
+
+def _analytic_grad(fn, x_np):
+    t = paddle.to_tensor(x_np.copy())
+    t.stop_gradient = False
+    loss = fn(t)
+    loss.backward()
+    return t.grad.numpy()
+
+
+def _fd_grad_entries(fn, x_np, idxs, delta):
+    out = []
+    for idx in idxs:
+        xp, xm = x_np.copy(), x_np.copy()
+        xp[idx] += delta
+        xm[idx] -= delta
+        lp = float(fn(paddle.to_tensor(xp)).numpy())
+        lm = float(fn(paddle.to_tensor(xm)).numpy())
+        out.append((lp - lm) / (2 * delta))
+    return np.array(out)
+
+
+def check_grad(fn, x_np, seed=0, n_probe=4, delta=1e-3, rtol=5e-2,
+               atol=5e-3):
+    g = _analytic_grad(fn, x_np)
+    assert g is not None and g.shape == x_np.shape
+    assert np.isfinite(g).all()
+    r = np.random.RandomState(seed)
+    flat_idx = r.choice(x_np.size, size=min(n_probe, x_np.size),
+                        replace=False)
+    idxs = [np.unravel_index(i, x_np.shape) for i in flat_idx]
+    fd = _fd_grad_entries(fn, x_np, idxs, delta)
+    an = np.array([g[i] for i in idxs])
+    np.testing.assert_allclose(an, fd, rtol=rtol, atol=atol)
+
+
+# --------------------------------------------------------------------------
+# decompositions / solvers: value parity vs numpy + grad checks
+# --------------------------------------------------------------------------
+
+def test_det_value_and_grad():
+    a = _spd(4, 0)
+    np.testing.assert_allclose(
+        float(linalg.det(paddle.to_tensor(a)).numpy()),
+        np.linalg.det(a), rtol=1e-4)
+    check_grad(lambda t: linalg.det(t) * 1e-2, a, delta=1e-2, rtol=8e-2,
+               atol=5e-2)
+
+
+def test_slogdet_grad():
+    a = _spd(5, 1)
+    sign, logdet = np.linalg.slogdet(a)
+    out = linalg.slogdet(paddle.to_tensor(a))
+    np.testing.assert_allclose(float(out[1].numpy()), logdet, rtol=1e-4)
+    check_grad(lambda t: linalg.slogdet(t)[1], a, delta=1e-2)
+
+
+def test_inv_value_and_grad():
+    a = _spd(4, 2)
+    np.testing.assert_allclose(
+        linalg.inv(paddle.to_tensor(a)).numpy(), np.linalg.inv(a),
+        rtol=1e-3, atol=1e-4)
+    check_grad(lambda t: (linalg.inv(t) ** 2).sum(), a, delta=1e-2)
+
+
+def test_pinv_grad():
+    a = _rect(6, 4, 3)
+    np.testing.assert_allclose(
+        linalg.pinv(paddle.to_tensor(a)).numpy(), np.linalg.pinv(a),
+        rtol=1e-3, atol=1e-4)
+    check_grad(lambda t: (linalg.pinv(t) ** 2).sum(), a, delta=1e-3,
+               rtol=8e-2, atol=1e-2)
+
+
+def test_solve_grad():
+    a, b = _spd(4, 4), _rect(4, 2, 5)
+    np.testing.assert_allclose(
+        linalg.solve(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+        np.linalg.solve(a, b), rtol=1e-3, atol=1e-4)
+    check_grad(lambda t: (linalg.solve(t, paddle.to_tensor(b)) ** 2).sum(),
+               a, delta=1e-2)
+    check_grad(lambda t: (linalg.solve(paddle.to_tensor(a), t) ** 2).sum(),
+               b)
+
+
+def test_cholesky_value_and_grad():
+    a = _spd(4, 6)
+    np.testing.assert_allclose(
+        linalg.cholesky(paddle.to_tensor(a)).numpy(), np.linalg.cholesky(a),
+        rtol=1e-3, atol=1e-4)
+    # symmetrized probe: cholesky reads only the lower triangle, so FD on
+    # a single entry must perturb symmetrically
+    def loss(t):
+        sym = (t + t.transpose([1, 0])) * 0.5
+        return (linalg.cholesky(sym) ** 2).sum()
+    check_grad(loss, a, delta=1e-2)
+
+
+def test_cholesky_solve_grad():
+    a = np.linalg.cholesky(_spd(4, 7)).astype(np.float32)
+    b = _rect(4, 2, 8)
+    check_grad(
+        lambda t: (linalg.cholesky_solve(t, paddle.to_tensor(a)) ** 2).sum(),
+        b)
+
+
+def test_triangular_solve_grad():
+    a = np.triu(_spd(4, 9)).astype(np.float32)
+    b = _rect(4, 2, 10)
+    ref = np.linalg.solve(a, b)
+    np.testing.assert_allclose(
+        linalg.triangular_solve(paddle.to_tensor(a),
+                                paddle.to_tensor(b)).numpy(),
+        ref, rtol=1e-3, atol=1e-4)
+    check_grad(
+        lambda t: (linalg.triangular_solve(paddle.to_tensor(a), t) ** 2).sum(),
+        b)
+
+
+def test_qr_value_and_grad():
+    a = _rect(6, 4, 11)
+    q, rr = linalg.qr(paddle.to_tensor(a))
+    nq, nr = np.linalg.qr(a)
+    np.testing.assert_allclose(np.abs(q.numpy()), np.abs(nq), rtol=1e-3,
+                               atol=1e-4)
+    np.testing.assert_allclose(q.numpy() @ rr.numpy(), a, rtol=1e-3,
+                               atol=1e-4)
+    check_grad(lambda t: (linalg.qr(t)[1] ** 2).sum(), a, delta=1e-3,
+               rtol=8e-2, atol=1e-2)
+
+
+def test_svd_value_and_grad():
+    a = _rect(5, 3, 12)
+    u, s, vh = linalg.svd(paddle.to_tensor(a))
+    ns = np.linalg.svd(a, compute_uv=False)
+    np.testing.assert_allclose(s.numpy(), ns, rtol=1e-3, atol=1e-4)
+    # singular values are the smooth part (OpTest checks the same)
+    check_grad(lambda t: linalg.svd(t)[1].sum(), a, delta=1e-3)
+
+
+def test_eigh_value_and_grad():
+    a = _spd(4, 13)
+    w, v = linalg.eigh(paddle.to_tensor(a))
+    nw = np.linalg.eigvalsh(a)
+    np.testing.assert_allclose(w.numpy(), nw, rtol=1e-3, atol=1e-3)
+
+    def loss(t):
+        sym = (t + t.transpose([1, 0])) * 0.5
+        return linalg.eigvalsh(sym).sum() * 0.1
+    check_grad(loss, a, delta=1e-2)
+
+
+def test_eig_values_match_numpy():
+    """Nonsymmetric eig: value parity (complex); grads are out of jax's
+    nonsymmetric-eig support on every backend — value check only."""
+    a = _rect(4, 4, 14)
+    w = linalg.eigvals(paddle.to_tensor(a)).numpy()
+    nw = np.linalg.eigvals(a)
+    np.testing.assert_allclose(sorted(np.abs(w)), sorted(np.abs(nw)),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_matrix_power_grad():
+    a = _spd(3, 15) * 0.3
+    np.testing.assert_allclose(
+        linalg.matrix_power(paddle.to_tensor(a), 3).numpy(),
+        np.linalg.matrix_power(a, 3), rtol=1e-3, atol=1e-3)
+    check_grad(lambda t: (linalg.matrix_power(t, 3) ** 2).sum(), a,
+               delta=1e-2)
+
+
+def test_lstsq_value_and_grad():
+    a, b = _rect(6, 3, 16), _rect(6, 2, 17)
+    sol = linalg.lstsq(paddle.to_tensor(a), paddle.to_tensor(b))[0].numpy()
+    ref = np.linalg.lstsq(a, b, rcond=None)[0]
+    np.testing.assert_allclose(sol, ref, rtol=1e-3, atol=1e-3)
+    check_grad(
+        lambda t: (linalg.lstsq(paddle.to_tensor(a), t)[0] ** 2).sum(), b,
+        rtol=8e-2, atol=1e-2)
+
+
+def test_lu_reconstruction_and_grad():
+    a = _spd(4, 18)
+    lu_t, piv = linalg.lu(paddle.to_tensor(a))[:2]
+    p, l, u = linalg.lu_unpack(lu_t, piv)
+    np.testing.assert_allclose(
+        p.numpy() @ l.numpy() @ u.numpy(), a, rtol=1e-3, atol=1e-3)
+    check_grad(lambda t: (linalg.lu(t)[0] ** 2).sum() * 1e-2, a,
+               delta=1e-2, rtol=8e-2, atol=5e-2)
+
+
+def test_norm_variants_grad():
+    a = _rect(4, 5, 19)
+    for p in (None, "fro", 1, np.inf):
+        # paddle semantics: numeric p with axis=None is the VECTOR norm of
+        # the flattened tensor (reference linalg.norm docs), not the
+        # induced matrix norm
+        ref = (np.linalg.norm(a) if p in (None, "fro")
+               else np.linalg.norm(a.ravel(), p))
+        np.testing.assert_allclose(
+            float(linalg.norm(paddle.to_tensor(a), p).numpy()), ref,
+            rtol=1e-4)
+    check_grad(lambda t: linalg.norm(t), a)
+    check_grad(lambda t: linalg.norm(t, 2, axis=1).sum(), a)
+
+
+def test_multi_dot_and_householder_grad():
+    a, b, c = _rect(3, 4, 20), _rect(4, 5, 21), _rect(5, 2, 22)
+    np.testing.assert_allclose(
+        linalg.multi_dot([paddle.to_tensor(a), paddle.to_tensor(b),
+                          paddle.to_tensor(c)]).numpy(),
+        a @ b @ c, rtol=1e-3, atol=1e-4)
+    check_grad(
+        lambda t: (linalg.multi_dot(
+            [t, paddle.to_tensor(b), paddle.to_tensor(c)]) ** 2).sum(), a)
+
+
+# --------------------------------------------------------------------------
+# fft / signal grads
+# --------------------------------------------------------------------------
+
+def test_fft_family_grads():
+    x = _rect(4, 16, 23)
+    check_grad(lambda t: fft.rfft(t).abs().sum(), x)
+    check_grad(lambda t: fft.fft(t).abs().sum(), x)
+    check_grad(lambda t: fft.irfft(fft.rfft(t)).sum(), x)
+    check_grad(lambda t: (fft.fft2(t).abs() ** 2).sum() * 1e-2, x,
+               rtol=8e-2, atol=5e-2)
+
+
+def test_stft_grad():
+    x = _rect(2, 64, 24)
+    check_grad(
+        lambda t: (signal.stft(t, n_fft=16, hop_length=8).abs() ** 2
+                   ).sum() * 0.1, x, rtol=8e-2, atol=1e-2)
+
+
+def test_frame_overlap_grads():
+    x = _rect(2, 32, 25)
+    check_grad(lambda t: (signal.frame(t, 8, 4) ** 2).sum(), x)
+    f = signal.frame(paddle.to_tensor(x), 8, 4).numpy()
+    check_grad(lambda t: (signal.overlap_add(t, 4) ** 2).sum(), f)
