@@ -244,17 +244,25 @@ def bench_bert_base():
 
 def bench_resnet50():
     """BASELINE.md config 2: ResNet-50 train step (the conv/BN/pool path),
-    compiled whole-step — the Executor static-graph analog."""
+    compiled whole-step — the Executor static-graph analog.
+
+    On TPU the network is built channels-last (NHWC) with bf16 inputs:
+    channels ride the lane dimension of the (8,128) vector tiling, so
+    convs hit the MXU without compiler-inserted relayouts (the cuDNN
+    autotuned-layout analog, VERDICT r2 weak #2). A/B knobs:
+    PTPU_RESNET_BENCH_FORMAT=NCHW, PTPU_RESNET_BENCH_BATCH=N."""
     import paddle_tpu as paddle
     from paddle_tpu import jit, optimizer, parallel
     from paddle_tpu.vision.models import resnet50
 
     on_tpu = _on_tpu()
     batch = int(os.environ.get("PTPU_RESNET_BENCH_BATCH", 64 if on_tpu else 2))
+    fmt = os.environ.get("PTPU_RESNET_BENCH_FORMAT",
+                         "NHWC" if on_tpu else "NCHW")
     size = 224 if on_tpu else 32
     paddle.seed(0)
     parallel.init_mesh()
-    model = parallel.place_model(resnet50(num_classes=1000))
+    model = parallel.place_model(resnet50(num_classes=1000, data_format=fmt))
     if on_tpu:
         model.bfloat16()
     opt = optimizer.Momentum(learning_rate=0.1, momentum=0.9,
@@ -269,7 +277,12 @@ def bench_resnet50():
 
     compiled = jit.compile(step, models=[model], optimizers=[opt])
     rng = np.random.RandomState(0)
-    x = paddle.to_tensor(rng.randn(batch, 3, size, size).astype("float32"))
+    shape = ((batch, 3, size, size) if fmt == "NCHW"
+             else (batch, size, size, 3))
+    x_np = rng.randn(*shape).astype("float32")
+    x = paddle.to_tensor(x_np)
+    if on_tpu:
+        x = x.astype("bfloat16")  # bf16 images: conv inputs stay MXU-native
     y = paddle.to_tensor(rng.randint(0, 1000, (batch,)).astype("int64"))
     dt = _time_steps(compiled, (x, y), steps=10 if on_tpu else 2,
                      warmup=3 if on_tpu else 1)
@@ -296,9 +309,10 @@ def bench_decode():
            else gpt_test_config(num_hidden_layers=2, stacked_blocks=True,
                                 max_position_embeddings=64))
     batch, prompt, new = (8, 128, 128) if on_tpu else (2, 8, 8)
-    # long-context A/B knobs (decode_experiments.sh): prompt length sets
-    # S_max, where the prefix-reading Pallas kernel should separate from
-    # the XLA full-cache path
+    # A/B knobs (decode_experiments.sh): prompt length sets S_max (where
+    # the prefix-reading Pallas kernel separates from the XLA full-cache
+    # path); batch amortizes per-step fixed costs across sequences
+    batch = int(os.environ.get("PTPU_DECODE_BENCH_BATCH", batch))
     prompt = int(os.environ.get("PTPU_DECODE_BENCH_PROMPT", prompt))
     new = int(os.environ.get("PTPU_DECODE_BENCH_NEW", new))
     paddle.seed(0)
@@ -320,6 +334,10 @@ def bench_decode():
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     hbm_bw = 819e9 if on_tpu else 50e9
     baseline = batch * hbm_bw / (2.0 * n_params)   # bf16 weight stream/step
+    if os.environ.get("PTPU_ATTN_DEBUG") == "1":
+        from paddle_tpu.ops.pallas_ops import attention_path_counts
+
+        print(f"attn paths: {attention_path_counts()}", file=sys.stderr)
     return _emit("gpt_124m_decode_tokens_per_sec" if on_tpu
                  else "gpt_tiny_decode_tokens_per_sec_cpu_smoke",
                  batch * new / dt, "tokens/sec", baseline)

@@ -558,15 +558,18 @@ def _adaptive_pool_core(a, out_sizes, op, spatial_start=2):
 
 def _adaptive_pool(x, output_size, nd, op, data_format):
     out_sizes = _tuplize(output_size, nd)
+    # channel-last: spatial axes start right after batch
+    start = 1 if data_format in ("NHWC", "NLC", "NDHWC") else 2
 
     def fn(a):
-        return _adaptive_pool_core(a, out_sizes, op)
+        return _adaptive_pool_core(a, out_sizes, op, spatial_start=start)
 
     return apply(fn, x, name=f"adaptive_{op}_pool{nd}d")
 
 
-def adaptive_avg_pool1d(x, output_size, name=None):
-    return _adaptive_pool(x, output_size, 1, "avg", "NCH")
+def adaptive_avg_pool1d(x, output_size, data_format="NCL", name=None):
+    return _adaptive_pool(x, output_size, 1, "avg",
+                          "NLC" if data_format == "NLC" else "NCH")
 
 
 def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
@@ -577,8 +580,9 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
     return _adaptive_pool(x, output_size, 3, "avg", data_format)
 
 
-def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
-    return _adaptive_pool(x, output_size, 2, "max", "NCHW")
+def adaptive_max_pool2d(x, output_size, return_mask=False,
+                        data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, "max", data_format)
 
 
 # ---------------------------------------------------------------------------

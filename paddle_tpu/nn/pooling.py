@@ -14,14 +14,21 @@ __all__ = [
 class _Pool(Layer):
     _fn = None
 
-    def __init__(self, kernel_size, stride=None, padding=0, **kwargs):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 data_format=None, **kwargs):
         super().__init__()
         self.kernel_size = kernel_size
         self.stride = stride
         self.padding = padding
+        self.ceil_mode = ceil_mode
+        self.data_format = data_format
 
     def forward(self, x):
-        return getattr(F, self._fn)(x, self.kernel_size, self.stride, self.padding)
+        kw = {"ceil_mode": self.ceil_mode}
+        if self.data_format is not None:
+            kw["data_format"] = self.data_format
+        return getattr(F, self._fn)(x, self.kernel_size, self.stride,
+                                    self.padding, **kw)
 
 
 class MaxPool1D(_Pool):
@@ -51,11 +58,15 @@ class AvgPool3D(_Pool):
 class _AdaptivePool(Layer):
     _fn = None
 
-    def __init__(self, output_size, **kwargs):
+    def __init__(self, output_size, data_format=None, **kwargs):
         super().__init__()
         self.output_size = output_size
+        self.data_format = data_format
 
     def forward(self, x):
+        if self.data_format is not None:
+            return getattr(F, self._fn)(x, self.output_size,
+                                        data_format=self.data_format)
         return getattr(F, self._fn)(x, self.output_size)
 
 

@@ -1,5 +1,14 @@
 """ResNet family (reference: python/paddle/vision/models/resnet.py) —
-BASELINE config 2 model."""
+BASELINE config 2 model.
+
+TPU note: pass data_format="NHWC" to build the whole network
+channels-last — the layout the TPU's (8,128) vector tiling natively
+prefers for convolutions (channels ride the lane dimension), avoiding
+compiler-inserted relayouts around every conv. Weights stay OIHW either
+way, so state_dicts are interchangeable between layouts.
+"""
+import functools
+
 from ... import nn
 
 __all__ = ["ResNet", "BasicBlock", "BottleneckBlock", "resnet18", "resnet34",
@@ -10,13 +19,17 @@ class BasicBlock(nn.Layer):
     expansion = 1
 
     def __init__(self, inplanes, planes, stride=1, downsample=None, groups=1,
-                 base_width=64, dilation=1, norm_layer=None):
+                 base_width=64, dilation=1, norm_layer=None,
+                 data_format="NCHW"):
         super().__init__()
-        norm_layer = norm_layer or nn.BatchNorm2D
-        self.conv1 = nn.Conv2D(inplanes, planes, 3, stride=stride, padding=1, bias_attr=False)
+        norm_layer = norm_layer or functools.partial(
+            nn.BatchNorm2D, data_format=data_format)
+        self.conv1 = nn.Conv2D(inplanes, planes, 3, stride=stride, padding=1,
+                               bias_attr=False, data_format=data_format)
         self.bn1 = norm_layer(planes)
         self.relu = nn.ReLU()
-        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, bias_attr=False)
+        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, bias_attr=False,
+                               data_format=data_format)
         self.bn2 = norm_layer(planes)
         self.downsample = downsample
         self.stride = stride
@@ -34,16 +47,21 @@ class BottleneckBlock(nn.Layer):
     expansion = 4
 
     def __init__(self, inplanes, planes, stride=1, downsample=None, groups=1,
-                 base_width=64, dilation=1, norm_layer=None):
+                 base_width=64, dilation=1, norm_layer=None,
+                 data_format="NCHW"):
         super().__init__()
-        norm_layer = norm_layer or nn.BatchNorm2D
+        norm_layer = norm_layer or functools.partial(
+            nn.BatchNorm2D, data_format=data_format)
         width = int(planes * (base_width / 64.0)) * groups
-        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False)
+        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False,
+                               data_format=data_format)
         self.bn1 = norm_layer(width)
         self.conv2 = nn.Conv2D(width, width, 3, stride=stride, padding=dilation,
-                               groups=groups, dilation=dilation, bias_attr=False)
+                               groups=groups, dilation=dilation,
+                               bias_attr=False, data_format=data_format)
         self.bn2 = norm_layer(width)
-        self.conv3 = nn.Conv2D(width, planes * self.expansion, 1, bias_attr=False)
+        self.conv3 = nn.Conv2D(width, planes * self.expansion, 1,
+                               bias_attr=False, data_format=data_format)
         self.bn3 = norm_layer(planes * self.expansion)
         self.relu = nn.ReLU()
         self.downsample = downsample
@@ -59,7 +77,8 @@ class BottleneckBlock(nn.Layer):
 
 
 class ResNet(nn.Layer):
-    def __init__(self, block, depth=50, width=64, num_classes=1000, with_pool=True, groups=1):
+    def __init__(self, block, depth=50, width=64, num_classes=1000,
+                 with_pool=True, groups=1, data_format="NCHW"):
         super().__init__()
         layer_cfg = {
             18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
@@ -72,30 +91,37 @@ class ResNet(nn.Layer):
         self.with_pool = with_pool
         self.inplanes = 64
         self.dilation = 1
-        self.conv1 = nn.Conv2D(3, self.inplanes, 7, stride=2, padding=3, bias_attr=False)
-        self.bn1 = nn.BatchNorm2D(self.inplanes)
+        self.data_format = data_format
+        self.conv1 = nn.Conv2D(3, self.inplanes, 7, stride=2, padding=3,
+                               bias_attr=False, data_format=data_format)
+        self.bn1 = nn.BatchNorm2D(self.inplanes, data_format=data_format)
         self.relu = nn.ReLU()
-        self.maxpool = nn.MaxPool2D(3, 2, 1)
+        self.maxpool = nn.MaxPool2D(3, 2, 1, data_format=data_format)
         self.layer1 = self._make_layer(block, 64, layers[0])
         self.layer2 = self._make_layer(block, 128, layers[1], stride=2)
         self.layer3 = self._make_layer(block, 256, layers[2], stride=2)
         self.layer4 = self._make_layer(block, 512, layers[3], stride=2)
         if with_pool:
-            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1),
+                                                data_format=data_format)
         if num_classes > 0:
             self.fc = nn.Linear(512 * block.expansion, num_classes)
 
     def _make_layer(self, block, planes, blocks, stride=1):
+        df = self.data_format
         downsample = None
         if stride != 1 or self.inplanes != planes * block.expansion:
             downsample = nn.Sequential(
-                nn.Conv2D(self.inplanes, planes * block.expansion, 1, stride=stride, bias_attr=False),
-                nn.BatchNorm2D(planes * block.expansion),
+                nn.Conv2D(self.inplanes, planes * block.expansion, 1,
+                          stride=stride, bias_attr=False, data_format=df),
+                nn.BatchNorm2D(planes * block.expansion, data_format=df),
             )
-        layers = [block(self.inplanes, planes, stride, downsample, self.groups, self.base_width)]
+        layers = [block(self.inplanes, planes, stride, downsample,
+                        self.groups, self.base_width, data_format=df)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
-            layers.append(block(self.inplanes, planes, groups=self.groups, base_width=self.base_width))
+            layers.append(block(self.inplanes, planes, groups=self.groups,
+                                base_width=self.base_width, data_format=df))
         return nn.Sequential(*layers)
 
     def forward(self, x):

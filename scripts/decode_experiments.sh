@@ -32,10 +32,21 @@ run decode_xla 900 env PTPU_FLASH_DECODE=0 python bench.py --config gpt124m_deco
 run decode_pallas 900 env PTPU_FLASH_DECODE=1 python bench.py --config gpt124m_decode
 
 # step-unroll sweep (cross-step weight-stream overlap)
-for u in 2 4; do
+for u in 2 4 8; do
   run "decode_unroll$u" 900 env PTPU_DECODE_STEP_UNROLL="$u" \
     python bench.py --config gpt124m_decode
 done
+
+# batch sweep: per-step fixed costs (loop bookkeeping, sampling, cache
+# DUS writes) amortize across sequences; vs_baseline normalizes by batch
+# so a rising ratio isolates the fixed-cost share
+for b in 16 32; do
+  run "decode_batch$b" 900 env PTPU_DECODE_BENCH_BATCH="$b" \
+    python bench.py --config gpt124m_decode
+done
+
+# gate visibility: which attention/decode path each compile actually took
+run decode_paths 900 env PTPU_ATTN_DEBUG=1 python bench.py --config gpt124m_decode
 
 # long context (S_max 1024+128): the Pallas kernel reads only the valid
 # prefix while the XLA path masks all S_max rows — the regime where the
