@@ -24,7 +24,7 @@ from ..nn.layer import Layer
 from ..static import InputSpec
 
 __all__ = [
-    "Config", "Predictor", "create_predictor",
+    "Config", "Predictor", "create_predictor", "DistConfig",
     "save_inference_model", "load_inference_model",
 ]
 
@@ -119,6 +119,53 @@ def load_inference_model(path_prefix: str, params_file: str = None):
     return exported, blob["params"], blob["buffers"], blob["n_inputs"]
 
 
+class DistConfig:
+    """Distributed-serving config (reference: paddle_infer DistConfig
+    feeding DistModel on fleet_executor,
+    paddle/fluid/distributed/fleet_executor/dist_model.cc).
+
+    TPU-native re-design: the reference shards one model across ranks and
+    runs a carrier/interceptor runtime between them; here the sharded
+    model is ONE SPMD executable over a device mesh — ranks/endpoints
+    become mesh axes, the message bus becomes XLA collectives. Configure
+    the mesh (e.g. set_mesh(dp=2, mp=4)); inputs shard over the batch
+    axis ('dp'), parameters shard per `set_param_shard_fn(fn)` where
+    fn(name, array) returns a PartitionSpec-compatible tuple (e.g.
+    (None, 'mp') to column-split a weight) or None to replicate."""
+
+    def __init__(self):
+        self._enable = True
+        self._mesh_axes = {}
+        self._shard_fn = None
+        self._batch_axis = "dp"
+        # accepted for reference API parity (no multi-process bootstrap
+        # is needed for single-controller SPMD serving)
+        self._nranks, self._rank = 1, 0
+        self._endpoints, self._current_endpoint = [], ""
+
+    def enable_dist_model(self, flag=True):
+        self._enable = bool(flag)
+
+    def set_mesh(self, **axes):
+        self._mesh_axes = {k: int(v) for k, v in axes.items() if int(v) > 1}
+
+    def set_param_shard_fn(self, fn):
+        self._shard_fn = fn
+
+    def set_batch_axis(self, axis):
+        self._batch_axis = axis
+
+    def set_ranks(self, nranks, rank):
+        self._nranks, self._rank = int(nranks), int(rank)
+
+    def set_endpoints(self, endpoints, current_endpoint):
+        self._endpoints = list(endpoints)
+        self._current_endpoint = current_endpoint
+
+    def set_comm_init_config(self, path):
+        self._comm_init_config = path
+
+
 class Config:
     """AnalysisConfig analog (subset: model paths + device + toggles that
     map to XLA; unknown toggles are accepted and recorded)."""
@@ -141,6 +188,12 @@ class Config:
         self._memory_pool_init_size_mb = 0
         self._enable_log = True
         self._flags = {}
+        self._dist = None
+
+    def set_dist_config(self, dist_config: "DistConfig"):
+        """Serve the model sharded over a device mesh (reference:
+        Config.set_dist_config routing to DistModel)."""
+        self._dist = dist_config
 
     def set_prog_file(self, path):
         self._prefix = path[: -len(_MODEL_SUFFIX)] if path.endswith(_MODEL_SUFFIX) else path
@@ -231,6 +284,52 @@ class Predictor:
         self._n_inputs = n_inputs
         self._inputs = [_IOHandle() for _ in range(n_inputs)]
         self._outputs = []
+        self._mesh = None
+        self._batch_sharding = None
+        self._call = None
+        dist = getattr(config, "_dist", None)
+        if dist is not None and dist._enable and dist._mesh_axes:
+            self._init_dist(dist)
+
+    def _init_dist(self, dist: DistConfig):
+        """Shard the loaded weights over a mesh and compile the exported
+        module as one SPMD program (the DistModel capability: a TP/DP-
+        sharded model served with a host loop; dist_model.cc analog)."""
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        # a LOCAL mesh: serving must not clobber the process-global
+        # training mesh (parallel.init_mesh), and axis names are free-form
+        axes = dict(dist._mesh_axes)
+        need = int(np.prod(list(axes.values()))) if axes else 1
+        devs = jax.devices()
+        if need > len(devs):
+            raise ValueError(
+                f"DistConfig mesh {axes} needs {need} devices, "
+                f"{len(devs)} available")
+        mesh = Mesh(
+            np.array(devs[:need]).reshape(tuple(axes.values())),
+            tuple(axes.keys()))
+        self._mesh = mesh
+        shard_fn = dist._shard_fn
+
+        def place(tree):
+            out = {}
+            for name, arr in tree.items():
+                spec = shard_fn(name, arr) if shard_fn is not None else None
+                spec = P(*spec) if spec is not None else P()
+                out[name] = jax.device_put(arr, NamedSharding(mesh, spec))
+            return out
+
+        self._params = place(self._params)
+        self._buffers = place(self._buffers)
+        if dist._batch_axis in mesh.axis_names:
+            self._batch_sharding = NamedSharding(
+                mesh, P(dist._batch_axis))
+        exported = self._exported
+        # jit around the exported module: XLA propagates the param/input
+        # shardings through the inlined StableHLO and inserts collectives
+        self._call = jax.jit(
+            lambda p, b, *xs: exported.call(p, b, *xs))
 
     def get_input_names(self):
         return [f"input_{i}" for i in range(self._n_inputs)]
@@ -245,7 +344,20 @@ class Predictor:
             for h, a in zip(self._inputs, inputs):
                 h.copy_from_cpu(np.asarray(a._data) if isinstance(a, Tensor) else a)
         args = [h._value for h in self._inputs]
-        out = self._exported.call(self._params, self._buffers, *args)
+        if self._call is not None:   # distributed (mesh-sharded) serving
+            if self._batch_sharding is not None:
+                n = self._batch_sharding.mesh.shape[
+                    self._batch_sharding.spec[0]]
+                placed = []
+                for a in args:
+                    if a.ndim >= 1 and a.shape[0] % n == 0:
+                        placed.append(jax.device_put(a, self._batch_sharding))
+                    else:
+                        placed.append(a)   # indivisible batch: replicate
+                args = placed
+            out = self._call(self._params, self._buffers, *args)
+        else:
+            out = self._exported.call(self._params, self._buffers, *args)
         outs = list(out) if isinstance(out, (tuple, list)) else [out]
         self._outputs = outs
         return [np.asarray(o) for o in outs]

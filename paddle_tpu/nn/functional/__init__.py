@@ -491,7 +491,9 @@ def _max_pool_mask(x, ks, st, pads_2d):
 
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_mask=False, data_format="NCL", name=None):
-    return _pool_nd(x, kernel_size, stride, padding, 1, "max", "NCH", ceil_mode=ceil_mode)
+    return _pool_nd(x, kernel_size, stride, padding, 1, "max",
+                    "NLC" if data_format == "NLC" else "NCH",
+                    ceil_mode=ceil_mode)
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_mask=False, data_format="NCHW", name=None):
@@ -511,8 +513,10 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_m
     return _pool_nd(x, kernel_size, stride, padding, 3, "max", data_format, ceil_mode=ceil_mode)
 
 
-def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, name=None):
-    return _pool_nd(x, kernel_size, stride, padding, 1, "avg", "NCH", ceil_mode=ceil_mode, exclusive=exclusive)
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, data_format="NCL", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 1, "avg",
+                    "NLC" if data_format == "NLC" else "NCH",
+                    ceil_mode=ceil_mode, exclusive=exclusive)
 
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCHW", name=None):
@@ -582,7 +586,42 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
 
 def adaptive_max_pool2d(x, output_size, return_mask=False,
                         data_format="NCHW", name=None):
-    return _adaptive_pool(x, output_size, 2, "max", data_format)
+    if not return_mask:
+        return _adaptive_pool(x, output_size, 2, "max", data_format)
+    # mask = flat H*W index of each window's argmax (reference
+    # max_pool_with_index semantics)
+    out_sizes = _tuplize(output_size, 2)
+    channel_last = data_format == "NHWC"
+
+    def fn(a):
+        if channel_last:
+            a = jnp.moveaxis(a, -1, 1)   # NHWC -> NCHW internally
+        N, C, H, W = a.shape
+        oh = out_sizes[0] if out_sizes[0] is not None else H
+        ow = out_sizes[1] if out_sizes[1] is not None else W
+        out_rows, idx_rows = [], []
+        for i in range(oh):
+            h0, h1 = (i * H) // oh, -((-(i + 1) * H) // oh)
+            out_cols, idx_cols = [], []
+            for j in range(ow):
+                w0, w1 = (j * W) // ow, -((-(j + 1) * W) // ow)
+                win = a[:, :, h0:h1, w0:w1]
+                kh, kw = h1 - h0, w1 - w0
+                flat = win.reshape(N, C, kh * kw)
+                out_cols.append(jnp.max(flat, axis=-1))
+                am = jnp.argmax(flat, axis=-1)
+                gidx = (h0 + am // kw) * W + (w0 + am % kw)
+                idx_cols.append(gidx)
+            out_rows.append(jnp.stack(out_cols, axis=-1))
+            idx_rows.append(jnp.stack(idx_cols, axis=-1))
+        out = jnp.stack(out_rows, axis=-2)               # [N, C, oh, ow]
+        idx = jnp.stack(idx_rows, axis=-2).astype(jnp.int32)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+            idx = jnp.moveaxis(idx, 1, -1)
+        return out, idx
+
+    return apply(fn, x, name="adaptive_max_pool2d")
 
 
 # ---------------------------------------------------------------------------
