@@ -28,9 +28,10 @@ from ...core.tensor import Tensor
 from ...core.dispatch import apply, unwrap
 
 __all__ = [
-    "Dy2StaticError", "UNDEFINED", "ld", "convert_ifelse", "convert_while",
-    "convert_for_range", "convert_logical_and", "convert_logical_or",
-    "convert_logical_not", "py_cond_guard", "convert_call",
+    "Dy2StaticError", "UNDEFINED", "ld", "convert_ifelse",
+    "convert_ifelse_ret", "convert_while", "convert_for_range",
+    "convert_logical_and", "convert_logical_or", "convert_logical_not",
+    "py_cond_guard", "convert_call",
 ]
 
 
@@ -127,6 +128,31 @@ def _select_pair(pred, t, f, name):
         f"variable '{name}' takes different non-tensor Python values in "
         f"the branches of a tensor-dependent if ({t!r} vs {f!r}); make it "
         "a Tensor or restructure the branches")
+
+
+def convert_ifelse_ret(pred, true_fn, false_fn, init_vals, lineno):
+    """Early-return if: both branches RETURN their value (the statement
+    tail was folded into the false branch by the transformer, reference
+    ReturnTransformer semantics). init_vals threads the enclosing locals
+    each branch (re)assigns. Python predicate -> run one branch; traced
+    -> run both and select the returned pytrees leaf-wise."""
+    if not _is_tracer_val(pred):
+        return true_fn(init_vals) if _truthy(pred) else false_fn(init_vals)
+    t_out = true_fn(init_vals)
+    f_out = false_fn(init_vals)
+    t_leaves, t_def = jax.tree_util.tree_flatten(
+        t_out, is_leaf=lambda v: isinstance(v, (Tensor, _Undefined)))
+    f_leaves, f_def = jax.tree_util.tree_flatten(
+        f_out, is_leaf=lambda v: isinstance(v, (Tensor, _Undefined)))
+    if t_def != f_def:
+        raise Dy2StaticError(
+            f"line {lineno}: the early-return branches of a "
+            f"tensor-dependent if return different structures "
+            f"({t_def} vs {f_def}); both paths must return the same "
+            "shape of result")
+    out = [_select_pair(pred, t, f, f"<return@{lineno}>")
+           for t, f in zip(t_leaves, f_leaves)]
+    return jax.tree_util.tree_unflatten(t_def, out)
 
 
 def convert_ifelse(pred, true_fn, false_fn, init_vals, names):

@@ -119,14 +119,17 @@ def _walk_scope(node):
         yield from _walk_scope(child)
 
 
-def _conversion_blocker(nodes):
+def _conversion_blocker(nodes, allow_returns=False):
     """Why this statement list cannot become a staged region (None = it
-    can)."""
+    can). allow_returns: Return statements are fine (early-return fold —
+    they become closure returns)."""
     for n in nodes:
         for sub in _walk_scope(n):
             if sub is not n and isinstance(
                     sub, (ast.FunctionDef, ast.AsyncFunctionDef,
                           ast.Lambda, ast.ClassDef)):
+                continue
+            if allow_returns and isinstance(sub, ast.Return):
                 continue
             if isinstance(sub, _BLOCKERS):
                 kind = type(sub).__name__.lower()
@@ -141,6 +144,10 @@ def _conversion_blocker(nodes):
                                     f"subscript (line {sub.lineno}), which "
                                     "cannot be staged functionally")
     return None
+
+
+def _conversion_blocker_ignoring_returns(nodes):
+    return _conversion_blocker(nodes, allow_returns=True)
 
 
 def _name(id_, ctx=None):
@@ -368,15 +375,85 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
         if (isinstance(node.func, ast.Attribute)
                 and isinstance(node.func.value, ast.Name)
                 and node.func.value.id == _HELPER):
+            if node.func.attr == "convert_ifelse_ret":
+                # fold emitted the raw test; now that callees inside it
+                # are converted, stage its and/or/not over tensors
+                node.args[0] = self._xform_test(node.args[0])
             return node
         node.func = _call("convert_call", [node.func])
         return node
 
+    # -- early returns (reference ReturnTransformer, folded) ----------------
+
+    _RETBRANCH = "__ptpu_retbranch_"
+
+    def _ends_in_return(self, stmts):
+        return bool(stmts) and isinstance(stmts[-1], ast.Return)
+
+    def _fold_early_returns(self, stmts):
+        """Rewrite `if c: ... return A` followed by more statements into
+        two value-returning branch closures + one staged-select return —
+        the common early-return pattern becomes convertible instead of
+        guarded. The false branch is `orelse + rest` folded together (an
+        elif chain's fall-through continues into the tail), so this only
+        runs on statement lists whose continuation is function exit: the
+        function body and (recursively) the generated branch closures."""
+        out = []
+        for idx, st in enumerate(stmts):
+            if isinstance(st, ast.If) and self._ends_in_return(st.body):
+                rest = list(stmts[idx + 1:])
+                true_body = self._fold_early_returns(list(st.body))
+                false_body = self._fold_early_returns(
+                    list(st.orelse) + rest)
+                if not false_body:
+                    false_body = [ast.Return(value=_const(None))]
+                # only fold when BOTH paths can run under a traced pred
+                blocker = (_conversion_blocker_ignoring_returns(true_body)
+                           or _conversion_blocker_ignoring_returns(false_body))
+                if blocker is None:
+                    n = self._next()
+                    # thread outer locals that either branch (re)assigns —
+                    # a closure that reads-then-assigns an enclosing local
+                    # would otherwise hit UnboundLocalError
+                    names = sorted(_assigned_names(true_body)
+                                   | _assigned_names(false_body))
+                    t_fn = self._ret_branch_fn(
+                        f"{self._RETBRANCH}T{n}", names, true_body)
+                    f_fn = self._ret_branch_fn(
+                        f"{self._RETBRANCH}F{n}", names, false_body)
+                    # the RAW test goes in the call: visit_Call converts
+                    # its callees first, then applies _xform_test (doing
+                    # it here would bury calls in opaque lambdas)
+                    out.extend([t_fn, f_fn, ast.Return(value=_call(
+                        "convert_ifelse_ret",
+                        [st.test, _name(t_fn.name), _name(f_fn.name),
+                         _ld_tuple(names), _const(st.lineno)]))])
+                    return out
+            out.append(st)
+        return out
+
+    @staticmethod
+    def _ret_branch_fn(fname, names, body):
+        """def <fname>(__ptpu_vals): (a, b,) = __ptpu_vals; <body>
+        (the body carries its own return statements)."""
+        stmts = []
+        if names:
+            stmts.append(_unpack_stmt(names, _name("__ptpu_vals")))
+        stmts.extend(body)
+        return ast.FunctionDef(
+            name=fname,
+            args=ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg="__ptpu_vals")],
+                kwonlyargs=[], kw_defaults=[], defaults=[]),
+            body=stmts, decorator_list=[], returns=None, type_params=[])
+
     def visit_FunctionDef(self, node):
-        if self.depth > 0:
+        if self.depth > 0 and not node.name.startswith(self._RETBRANCH):
             return node   # nested defs keep their own (python) semantics
         self.depth += 1
-        node.decorator_list = []   # avoid re-applying @to_static on exec
+        if self.depth == 1:
+            node.decorator_list = []   # avoid re-applying @to_static on exec
+            node.body = self._fold_early_returns(node.body)
         self.generic_visit(node)
         self.depth -= 1
         return node

@@ -108,15 +108,102 @@ class TestIfConversion:
         with pytest.raises(Dy2StaticError, match="only one branch"):
             c(_t([1.0]))
 
-    def test_early_return_raises_clear_error(self):
+    def test_early_return_converts(self):
+        """`if cond: return A` + tail return — the reference
+        ReturnTransformer pattern — folds into a staged select."""
         def f(x):
             if x.sum() > 0:
                 return x * 2.0
+            return x - 1.0
+
+        c = jit.compile(f, train=False)
+        for v in ([1.0, 2.0], [-5.0, 1.0]):
+            np.testing.assert_allclose(c(_t(v)).numpy(), f(_t(v)).numpy())
+
+    def test_early_return_elif_chain(self):
+        def f(x):
+            if x.sum() > 5.0:
+                return x * 3.0
+            elif x.sum() > 0:
+                y = x + 1.0
+                return y * 2.0
+            return -x
+
+        c = jit.compile(f, train=False)
+        for v in ([10.0], [1.0], [-4.0]):
+            np.testing.assert_allclose(c(_t(v)).numpy(), f(_t(v)).numpy())
+
+    def test_early_return_with_tail_computation(self):
+        def f(x):
+            if x.max() > 10.0:
+                return x * 0.0
+            y = x + 1.0
+            z = y * y
+            return z.sum()
+
+        c = jit.compile(f, train=False)
+        for v in ([20.0, 1.0], [1.0, 2.0]):
+            np.testing.assert_allclose(c(_t(v)).numpy(), f(_t(v)).numpy(),
+                                       rtol=1e-6)
+
+    def test_early_return_tail_rebinds_outer_local(self):
+        """The folded tail may read-then-assign a variable bound before
+        the if (threaded through the branch closure, not UnboundLocal)."""
+        def f(x):
+            y = x * 2.0
+            if x.sum() > 0:
+                return y
+            y = y + 1.0
+            return y
+
+        g = convert_to_static(f)
+        for v in ([1.0], [-1.0]):
+            np.testing.assert_allclose(g(_t(v)).numpy(), f(_t(v)).numpy())
+        c = jit.compile(f, train=False)
+        for v in ([1.0], [-1.0]):
+            np.testing.assert_allclose(c(_t(v)).numpy(), f(_t(v)).numpy())
+
+    def test_early_return_test_callees_converted(self):
+        """Callees inside a folded test get convert_call (so their own
+        tensor control flow stages instead of raw-tracing)."""
+        def gate(h):
+            if h.sum() > 0:
+                flag = h.sum() * 0 + 1.0
+            else:
+                flag = h.sum() * 0
+            return flag > 0.5
+
+        def f(x):
+            if gate(x):
+                return x * 2.0
+            return -x
+
+        c = jit.compile(f, train=False)
+        for v in ([1.0, 2.0], [-3.0]):
+            np.testing.assert_allclose(c(_t(v)).numpy(), f(_t(v)).numpy())
+
+    def test_early_return_structure_mismatch_raises(self):
+        def f(x):
+            if x.sum() > 0:
+                return x, x * 2.0
             return x
 
         c = jit.compile(f, train=False)
-        with pytest.raises(Dy2StaticError, match="return"):
+        with pytest.raises(Dy2StaticError, match="different structures"):
             c(_t([1.0]))
+
+    def test_return_inside_tensor_loop_still_guarded(self):
+        def f(x):
+            s = x.sum()
+            while s > 1.0:
+                if s < 2.0:
+                    return s
+                s = s / 2.0
+            return s
+
+        c = jit.compile(f, train=False)
+        with pytest.raises(Dy2StaticError):
+            c(_t([8.0]))
 
     def test_attribute_store_raises_clear_error(self):
         class Box:
