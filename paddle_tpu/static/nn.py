@@ -138,11 +138,27 @@ def switch_case(branch_index, branch_fns, default: Callable = None,
 
 
 def while_loop(cond: Callable, body: Callable, loop_vars,
-               is_test: bool = False, name: str = None):
+               is_test: bool = False, name: str = None,
+               maximum_trip_count: int = None):
     """paddle.static.nn.while_loop parity (control_flow.py:1088; while_op.cc:86).
 
     cond(*loop_vars) -> scalar bool Tensor; body(*loop_vars) -> updated
     loop_vars (same structure). Returns the final loop_vars.
+
+    maximum_trip_count: when given, the TRACED lowering is an UNROLLED
+    masked loop (`maximum_trip_count` copies of cond+body in the program
+    — keep the bound modest) and is REVERSE-DIFFERENTIABLE, including
+    into closure-captured parameters (the reference's While op records
+    per-iteration scopes for its grad, while_op.cc grad variant; XLA
+    cannot stash an unbounded while, so the bound is the price of
+    gradients on TPU). Iterations after cond goes false are value-masked
+    no-ops, but the body still EXECUTES on the final (stale) values:
+    a body that turns non-finite on its own fixpoint (e.g. dividing by
+    a counter the loop drives to zero) poisons gradients with NaN
+    through the masked select — keep bodies finite on their final
+    values. A loop still live after the bound is truncated. The eager
+    path ignores the bound (exact dynamic trip count, differentiable
+    as always).
     """
     if not callable(cond) or not callable(body):
         raise TypeError("while_loop requires callable cond and body")
@@ -167,10 +183,42 @@ def while_loop(cond: Callable, body: Callable, loop_vars,
             p = bool(unwrap(cond(*loop_vars)))
         return loop_vars
 
-    # traced: one StableHLO while. Forward-only (see module docstring);
-    # run under no_grad so per-op vjp recording is skipped inside the body.
     flat, treedef = jax.tree_util.tree_flatten(
         loop_vars, is_leaf=lambda x: isinstance(x, Tensor))
+
+    if maximum_trip_count is not None:
+        # bounded differentiable lowering: an UNROLLED masked loop at the
+        # tape level — every cond/body op dispatches normally, so
+        # closure-captured parameters (the training case: layers called
+        # inside body) record gradients, which a rolled lax.scan wrapping
+        # could not provide (same reason cond selects per leaf instead of
+        # lax.cond). Compile size grows with the bound; keep it modest.
+        n = int(maximum_trip_count)
+        if n < 1:
+            raise ValueError(
+                f"maximum_trip_count must be >= 1, got {n} (pass None "
+                "for the unbounded forward-only lowering)")
+        n_vars = len(loop_vars)
+        vals = list(jax.tree_util.tree_unflatten(treedef, list(flat)))
+        active = _wrap_tree(jnp.asarray(True))
+        for _ in range(n):
+            pred = cond(*vals)
+            run = apply(
+                lambda a, p: jnp.logical_and(
+                    jnp.asarray(a).reshape(()), jnp.asarray(p).reshape(())),
+                active, pred, name="while_active")
+            out = body(*vals)
+            if not isinstance(out, (list, tuple)):
+                out = [out]
+            if len(out) != n_vars:
+                raise ValueError("body must return as many values as loop_vars")
+            vals = list(_select_trees(run, _wrap_tree(list(out)), vals,
+                                      name or "while_bounded"))
+            active = run
+        return vals
+
+    # traced: one StableHLO while. Forward-only (see module docstring);
+    # run under no_grad so per-op vjp recording is skipped inside the body.
 
     def loop_fn(*arrays):
         def c(carry):
