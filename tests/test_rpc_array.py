@@ -77,9 +77,9 @@ def test_rpc_two_workers_cross_call():
              for r in range(2)]
     for p in procs:
         p.start()
-    results = [q.get(timeout=90) for _ in range(2)]
+    results = [q.get(timeout=240) for _ in range(2)]
     for p in procs:
-        p.join(timeout=30)
+        p.join(timeout=120)
         assert p.exitcode == 0
     assert all(names == ["worker0", "worker1"] for _, names in results)
 
@@ -115,7 +115,7 @@ def test_rpc_remote_exception_propagates():
              for r in range(2)]
     for p in procs:
         p.start()
-    results = dict(q.get(timeout=90) for _ in range(2))
+    results = dict(q.get(timeout=240) for _ in range(2))
     for p in procs:
-        p.join(timeout=30)
+        p.join(timeout=120)
     assert results[0] == "remote boom"
