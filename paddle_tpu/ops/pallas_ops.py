@@ -996,3 +996,148 @@ def _decode_ok(q, k_cache, v_cache) -> bool:
         return False
     _count_path("decode_kernel")
     return True
+
+
+# ---------------------------------------------------------------------------
+# Fused layernorm (SURVEY §7 phase 7; reference fused op family:
+# paddle/fluid/operators/fused/fused_bias_dropout_residual_layer_norm —
+# single-pass row statistics + affine, fp32 accumulation, one kernel
+# instead of the mean/var/normalize/scale chain)
+# ---------------------------------------------------------------------------
+
+def _ln_fwd_kernel(x_ref, w_ref, b_ref, y_ref, mu_ref, rs_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)                    # [bm, H]
+    mu = jnp.mean(x, axis=-1)
+    xc = x - mu[:, None]
+    var = jnp.mean(xc * xc, axis=-1)
+    rs = jax.lax.rsqrt(var + eps)
+    y = xc * rs[:, None] * w_ref[...].astype(jnp.float32)[None, :] \
+        + b_ref[...].astype(jnp.float32)[None, :]
+    y_ref[...] = y.astype(y_ref.dtype)
+    mu_ref[...] = mu[:, None]
+    rs_ref[...] = rs[:, None]
+
+
+def _ln_bwd_kernel(x_ref, w_ref, mu_ref, rs_ref, dy_ref, dx_ref, dwp_ref,
+                   dbp_ref):
+    x = x_ref[...].astype(jnp.float32)
+    w = w_ref[...].astype(jnp.float32)[None, :]
+    mu = mu_ref[...]                                      # [bm, 1]
+    rs = rs_ref[...]
+    dy = dy_ref[...].astype(jnp.float32)
+    xhat = (x - mu) * rs
+    g = dy * w
+    h = x.shape[-1]
+    m1 = jnp.sum(g, axis=-1, keepdims=True) / h
+    m2 = jnp.sum(g * xhat, axis=-1, keepdims=True) / h
+    dx = rs * (g - m1 - xhat * m2)
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+    dwp_ref[...] = jnp.sum(dy * xhat, axis=0)[None, :]
+    dbp_ref[...] = jnp.sum(dy, axis=0)[None, :]
+
+
+def _ln_block_rows(n):
+    for bm in (256, 128, 8):
+        if n % bm == 0:
+            return bm
+    return None
+
+
+def ln_geometry_ok(n, h):
+    """Gate for the fused layernorm kernel: whole lane tiles in H,
+    divisible row blocks, a live TPU (or interpret mode)."""
+    if not (_on_tpu() or _interpret()):
+        _count_path("ln_fallback:off_tpu")
+        return False
+    if h % 128 != 0 or _ln_block_rows(n) is None:
+        _count_path("ln_fallback:geometry")
+        return False
+    _count_path("ln_kernel")
+    return True
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def fused_layernorm_2d(x2, w, b, eps):
+    y, _, _ = _ln_fwd(x2, w, b, eps)
+    return y
+
+
+def _ln_fwd(x2, w, b, eps):
+    from jax.experimental import pallas as pl
+
+    n, h = x2.shape
+    bm = _ln_block_rows(n)
+    # match the XLA path's promotion: bf16 x with fp32 norm params (the
+    # keep-norm-params-fp32 recipe) produces fp32 output on both paths
+    out_dt = jnp.promote_types(jnp.promote_types(x2.dtype, w.dtype), b.dtype)
+    return pl.pallas_call(
+        functools.partial(_ln_fwd_kernel, eps=eps),
+        grid=(n // bm,),
+        in_specs=[
+            pl.BlockSpec((bm, h), lambda i: (i, 0)),
+            pl.BlockSpec((h,), lambda i: (0,)),
+            pl.BlockSpec((h,), lambda i: (0,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, h), lambda i: (i, 0)),
+            pl.BlockSpec((bm, 1), lambda i: (i, 0)),
+            pl.BlockSpec((bm, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, h), out_dt),
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(x2, w, b)
+
+
+def _ln_vjp_fwd(x2, w, b, eps):
+    y, mu, rs = _ln_fwd(x2, w, b, eps)
+    return y, (x2, w, b, mu, rs)
+
+
+def _ln_vjp_bwd(eps, res, dy):
+    from jax.experimental import pallas as pl
+
+    x2, w, b, mu, rs = res
+    n, h = x2.shape
+    bm = _ln_block_rows(n)
+    grid = n // bm
+    dx, dwp, dbp = pl.pallas_call(
+        _ln_bwd_kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((bm, h), lambda i: (i, 0)),
+            pl.BlockSpec((h,), lambda i: (0,)),
+            pl.BlockSpec((bm, 1), lambda i: (i, 0)),
+            pl.BlockSpec((bm, 1), lambda i: (i, 0)),
+            pl.BlockSpec((bm, h), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, h), lambda i: (i, 0)),
+            pl.BlockSpec((1, h), lambda i: (i, 0)),
+            pl.BlockSpec((1, h), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, h), x2.dtype),
+            jax.ShapeDtypeStruct((grid, h), jnp.float32),
+            jax.ShapeDtypeStruct((grid, h), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(x2, w, mu, rs, dy)
+    dw = jnp.sum(dwp, axis=0).astype(w.dtype)
+    db = jnp.sum(dbp, axis=0).astype(b.dtype)
+    return dx, dw, db
+
+
+fused_layernorm_2d.defvjp(_ln_vjp_fwd, _ln_vjp_bwd)
+
+
+def fused_layernorm_arrays(x, w, b, eps=1e-5):
+    """LayerNorm over the LAST axis with the Pallas kernel. Callers gate
+    on ln_geometry_ok first (PTPU_ATTN_DEBUG counts the decisions)."""
+    h = x.shape[-1]
+    x2 = x.reshape(-1, h)
+    y = fused_layernorm_2d(x2, w, b, float(eps))
+    return y.reshape(x.shape)

@@ -233,3 +233,90 @@ def test_flash_decode_kernel_vs_reference_shapes():
         np.testing.assert_allclose(np.asarray(out, np.float32),
                                    np.asarray(ref, np.float32),
                                    rtol=1e-4, atol=1e-4)
+
+
+def test_fused_layernorm_parity(monkeypatch):
+    """Fused Pallas layernorm (interpret mode): values and grads vs the
+    XLA path, fp32 and bf16, through the public F.layer_norm gate."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.RandomState(50)
+    for dtype in ("float32", "bfloat16"):
+        x_np = rng.randn(16, 256).astype(np.float32)
+        w_np = (1.0 + 0.1 * rng.randn(256)).astype(np.float32)
+        b_np = (0.1 * rng.randn(256)).astype(np.float32)
+
+        def run(use_pallas):
+            if use_pallas:
+                monkeypatch.setenv("PTPU_PALLAS_LN", "1")
+            else:
+                monkeypatch.delenv("PTPU_PALLAS_LN", raising=False)
+            x = paddle.to_tensor(x_np).astype(dtype)
+            w = paddle.to_tensor(w_np).astype(dtype)
+            b = paddle.to_tensor(b_np).astype(dtype)
+            for t in (x, w, b):
+                t.stop_gradient = False
+            y = F.layer_norm(x, 256, weight=w, bias=b)
+            (y.astype("float32") ** 2).sum().backward()
+            return (np.asarray(y.astype("float32").numpy()),
+                    np.asarray(x.grad.astype("float32").numpy()),
+                    np.asarray(w.grad.astype("float32").numpy()),
+                    np.asarray(b.grad.astype("float32").numpy()))
+
+        ref = run(False)
+        got = run(True)
+        # bf16: the XLA path rounds xhat to bf16 before the affine while
+        # the kernel stays fp32 end-to-end — grads can differ by a few
+        # bf16 ulps (~0.06 at |x|≈2) on a fraction of elements
+        tol = 2e-5 if dtype == "float32" else 3e-2
+        atol = 2e-5 if dtype == "float32" else 0.13
+        for r, g in zip(ref, got):
+            np.testing.assert_allclose(g, r, rtol=tol, atol=atol)
+
+
+def test_fused_layernorm_mixed_dtype(monkeypatch):
+    """bf16 activations with fp32 norm params (keep-norm-params-fp32):
+    output dtype and grads must match the XLA path, including the fp32
+    promotion."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.RandomState(60)
+    x_np = rng.randn(16, 256).astype(np.float32)
+    w_np = (1.0 + 0.1 * rng.randn(256)).astype(np.float32)
+    b_np = (0.1 * rng.randn(256)).astype(np.float32)
+
+    def run(flag):
+        if flag:
+            monkeypatch.setenv("PTPU_PALLAS_LN", "1")
+        else:
+            monkeypatch.delenv("PTPU_PALLAS_LN", raising=False)
+        x = paddle.to_tensor(x_np).astype("bfloat16")
+        w = paddle.to_tensor(w_np)   # fp32
+        b = paddle.to_tensor(b_np)   # fp32
+        for t in (x, w, b):
+            t.stop_gradient = False
+        y = F.layer_norm(x, 256, weight=w, bias=b)
+        (y.astype("float32") ** 2).sum().backward()
+        return y, b.grad
+    y_ref, db_ref = run(False)
+    y_got, db_got = run(True)
+    assert str(y_got.dtype) == str(y_ref.dtype), (y_got.dtype, y_ref.dtype)
+    assert str(db_got.dtype) == str(db_ref.dtype)
+    np.testing.assert_allclose(np.asarray(y_got.astype("float32").numpy()),
+                               np.asarray(y_ref.astype("float32").numpy()),
+                               rtol=3e-2, atol=0.13)
+
+
+def test_fused_layernorm_gate(monkeypatch):
+    from paddle_tpu.ops import pallas_ops as po2
+
+    monkeypatch.setenv("PTPU_ATTN_DEBUG", "1")
+    po2.reset_attention_path_counts()
+    assert po2.ln_geometry_ok(16, 256)      # interpret-mode fixture active
+    assert not po2.ln_geometry_ok(16, 100)  # lanes not tiled
+    assert not po2.ln_geometry_ok(13, 256)  # rows not divisible
+    counts = po2.attention_path_counts()
+    assert counts.get("ln_kernel") == 1
+    assert counts.get("ln_fallback:geometry") == 2
