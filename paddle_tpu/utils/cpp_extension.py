@@ -22,7 +22,8 @@ import jax.numpy as jnp
 from ..core.dispatch import apply
 from ..core.tensor import Tensor
 
-__all__ = ["load", "CppExtension", "get_build_directory", "custom_host_op"]
+__all__ = ["load", "CppExtension", "get_build_directory", "custom_host_op",
+           "register_custom_op", "get_custom_op"]
 
 _BUILD_ROOT = os.path.join(tempfile.gettempdir(), "paddle_tpu_extensions")
 
@@ -89,3 +90,69 @@ def custom_host_op(fn, out_shape_fn=None, name=None):
         return apply(jfn, *tensors, name=name or getattr(fn, "__name__", "custom_op"))
 
     return op
+
+
+# ---------------------------------------------------------------------------
+# Device-side custom ops (reference: custom_operator.cc PD_BUILD_OP —
+# user kernels registered as first-class framework ops with autograd)
+# ---------------------------------------------------------------------------
+
+_CUSTOM_OPS = {}
+
+
+def register_custom_op(name, fn, backward=None, override=False):
+    """Register a DEVICE-side custom op: `fn` is any jax-traceable
+    function over arrays (jnp code or a Pallas kernel — the TPU-native
+    analog of the reference's PD_BUILD_OP C++/CUDA kernels). Returns a
+    Tensor-level op that runs eagerly and inside jit.compile, with
+    autograd:
+
+    - backward=None: differentiated by jax autodiff through `fn`.
+    - backward=(fn): custom gradient (the PD_BUILD_GRAD_OP analog) —
+      called as backward(*forward_inputs, out_cotangent, **attrs) with
+      whatever keyword attrs the op call carried, returning one
+      cotangent per forward INPUT (attrs get none).
+
+    Duplicate names raise (reference PD_BUILD_OP rejects re-registration)
+    unless override=True. The op is retrievable via get_custom_op(name).
+    """
+    if name in _CUSTOM_OPS and not override:
+        raise ValueError(
+            f"custom op {name!r} is already registered; pass "
+            "override=True to replace it")
+
+    def op(*tensors, **attrs):
+        # attrs bind BEFORE custom_vjp so they are compile-time config,
+        # not primals — the backward contract stays one-cotangent-per-
+        # tensor-input regardless of attrs
+        if backward is not None:
+            core = jax.custom_vjp(lambda *arrays: fn(*arrays, **attrs))
+
+            def _fwd(*args):
+                return fn(*args, **attrs), args
+
+            def _bwd(res, ct):
+                out = backward(*res, ct, **attrs)
+                return (tuple(out) if isinstance(out, (list, tuple))
+                        else (out,))
+
+            core.defvjp(_fwd, _bwd)
+        else:
+            core = lambda *arrays: fn(*arrays, **attrs)
+        return apply(core, *tensors, name=name)
+
+    op.__name__ = name
+    _CUSTOM_OPS[name] = op
+    return op
+
+
+def get_custom_op(name):
+    """Look up a previously registered custom op (reference: custom ops
+    appearing under paddle.* after load)."""
+    try:
+        return _CUSTOM_OPS[name]
+    except KeyError:
+        raise KeyError(
+            f"no custom op named {name!r} is registered — call "
+            "register_custom_op first (registered: "
+            f"{sorted(_CUSTOM_OPS)})") from None
