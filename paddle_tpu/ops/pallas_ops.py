@@ -972,7 +972,8 @@ def flash_decode_arrays(q, k_cache, v_cache, length, scale=None,
 
 def _decode_ok(q, k_cache, v_cache) -> bool:
     import os
-    if os.environ.get("PTPU_FLASH_DECODE") == "0":
+    forced = os.environ.get("PTPU_FLASH_DECODE")
+    if forced == "0":
         _count_path("decode_fallback:disabled")
         return False
     if not (_on_tpu() or _interpret()):
@@ -994,6 +995,21 @@ def _decode_ok(q, k_cache, v_cache) -> bool:
     if not (q.dtype == k_cache.dtype == v_cache.dtype):
         _count_path("decode_fallback:dtype_mix")
         return False
+    if forced != "1":
+        # auto policy (checked LAST so counter attribution stays honest):
+        # at short caches the kernel's fixed costs (launch, DMA double-
+        # buffer priming) dominate the tiny prefix read and the XLA
+        # masked full-cache path wins (round-2 bisect: ~0.23 ms/layer at
+        # S_max=256 vs a ~0.02 ms bound); prefix-skipping pays off once
+        # the cache is long. PTPU_FLASH_DECODE=1/0 forces either way.
+        try:
+            min_smax = int(
+                os.environ.get("PTPU_FLASH_DECODE_MIN_SMAX", "1024"))
+        except ValueError:
+            min_smax = 1024
+        if s_max < min_smax:
+            _count_path("decode_fallback:small_smax")
+            return False
     _count_path("decode_kernel")
     return True
 

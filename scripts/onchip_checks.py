@@ -131,6 +131,10 @@ def check_generate():
     from paddle_tpu.core.tensor import Tensor
     from paddle_tpu.models import GPTForCausalLM, gpt_test_config
 
+    # force the decode kernel: S_max=256 is below the auto policy's
+    # threshold, and this is the one on-chip integration check of the
+    # kernel-inside-generate routing
+    os.environ["PTPU_FLASH_DECODE"] = "1"
     cfg = gpt_test_config(stacked_blocks=True, sequence_parallel=False,
                           max_position_embeddings=256)
     paddle.seed(0)

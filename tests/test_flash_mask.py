@@ -163,9 +163,11 @@ def test_path_counters(monkeypatch):
     assert po.attention_path_counts() == {}
 
 
-def test_flash_decode_matches_masked_reference():
+def test_flash_decode_matches_masked_reference(monkeypatch):
     """Pallas decode kernel (valid-prefix DMA reads + online softmax) vs the
-    full-cache masked-softmax XLA path."""
+    full-cache masked-softmax XLA path. Forced on: the auto policy keeps
+    short caches on the XLA path (kernel fixed costs dominate there)."""
+    monkeypatch.setenv("PTPU_FLASH_DECODE", "1")
     from paddle_tpu.ops.pallas_ops import (cached_attention_arrays,
                                            flash_decode_arrays)
 
@@ -188,9 +190,11 @@ def test_flash_decode_matches_masked_reference():
                                    rtol=2e-5, atol=2e-5, err_msg=f"t={t}")
 
 
-def test_cached_attention_routes_to_decode_kernel():
-    """cached_attention_arrays S_q=1 path uses the kernel and still returns
-    the updated caches; parity against the XLA path shapes/values."""
+def test_cached_attention_routes_to_decode_kernel(monkeypatch):
+    """cached_attention_arrays S_q=1 path uses the kernel (forced — auto
+    policy keeps short caches on XLA) and still returns the updated
+    caches; parity against the XLA path shapes/values."""
+    monkeypatch.setenv("PTPU_FLASH_DECODE", "1")
     from paddle_tpu.ops import pallas_ops as po
 
     rs = np.random.RandomState(12)
@@ -320,3 +324,28 @@ def test_fused_layernorm_gate(monkeypatch):
     counts = po2.attention_path_counts()
     assert counts.get("ln_kernel") == 1
     assert counts.get("ln_fallback:geometry") == 2
+
+
+def test_decode_auto_policy_smax_threshold(monkeypatch):
+    """Auto path selection: short caches stay on XLA (fixed-cost regime),
+    long caches take the prefix-skipping kernel; env forces override."""
+    from paddle_tpu.ops import pallas_ops as po2
+
+    rs = np.random.RandomState(13)
+    q = jnp.asarray(rs.randn(1, 1, 2, 64), jnp.float32)
+
+    def caches(smax):
+        return (jnp.zeros((1, smax, 128), jnp.float32),
+                jnp.zeros((1, smax, 128), jnp.float32))
+
+    monkeypatch.delenv("PTPU_FLASH_DECODE", raising=False)
+    kc, vc = caches(256)
+    assert not po2._decode_ok(q, kc, vc)          # short: XLA
+    kc, vc = caches(2048)
+    assert po2._decode_ok(q, kc, vc)              # long: kernel
+    monkeypatch.setenv("PTPU_FLASH_DECODE", "1")
+    kc, vc = caches(256)
+    assert po2._decode_ok(q, kc, vc)              # forced on
+    monkeypatch.setenv("PTPU_FLASH_DECODE", "0")
+    kc, vc = caches(2048)
+    assert not po2._decode_ok(q, kc, vc)          # forced off
