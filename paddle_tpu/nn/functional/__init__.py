@@ -406,7 +406,7 @@ def _pool_nd(x, kernel, stride, padding, nd, op, data_format, ceil_mode=False, e
     if ceil_mode:
         spatial = x.shape[1:-1] if channel_last else x.shape[2:]
         pad = [
-            (lo, hi + _ceil_extra(spatial[i], ks[i], st[i], lo + hi))
+            (lo, hi + _ceil_extra(spatial[i], ks[i], st[i], lo, hi))
             for i, (lo, hi) in enumerate(pad)
         ]
     if channel_last:
@@ -422,8 +422,10 @@ def _pool_nd(x, kernel, stride, padding, nd, op, data_format, ceil_mode=False, e
         if op == "max":
             init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
             return jax.lax.reduce_window(a, init, jax.lax.max, window, strides, pads)
-        # avg
+        # avg / sum
         s = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, strides, pads)
+        if op == "sum":
+            return s   # divisor_override applies its own divisor
         if not exclusive and not ceil_mode:
             # every window's padded extent is exactly k (PoolOutputSize
             # guarantees hstart+k <= H+pad for floor-mode windows)
@@ -458,12 +460,17 @@ def _pool_nd(x, kernel, stride, padding, nd, op, data_format, ceil_mode=False, e
     return apply(fn, x, name=f"{op}_pool{nd}d")
 
 
-def _ceil_extra(size, k, s, total_pad):
-    """Extra high-side padding so the output size matches ceil division."""
+def _ceil_extra(size, k, s, lo, hi):
+    """Extra high-side padding so the output size matches ceil division.
+    A ceil window that would START inside the right padding is dropped
+    (torch/paddle contract: the last window must begin within the input
+    or left padding)."""
     import math as _m
 
-    floor_out = (size + total_pad - k) // s + 1
-    ceil_out = _m.ceil((size + total_pad - k) / s) + 1
+    floor_out = (size + lo + hi - k) // s + 1
+    ceil_out = _m.ceil((size + lo + hi - k) / s) + 1
+    if ceil_out > floor_out and (ceil_out - 1) * s >= size + lo:
+        ceil_out -= 1
     return (ceil_out - floor_out) * s
 
 
@@ -478,10 +485,21 @@ def _max_pool_mask(x, ks, st, pads_2d):
         )  # [N, C*kh*kw, OH, OW]
         oh, ow = patches.shape[2], patches.shape[3]
         patches = patches.reshape(n, c, ks[0] * ks[1], oh, ow)
+        # padded cells (patches zero-fills them) must not win the argmax
+        starts_i = jnp.arange(oh) * st[0] - pads_2d[0][0]
+        starts_j = jnp.arange(ow) * st[1] - pads_2d[1][0]
+        ri = starts_i[:, None] + jnp.arange(ks[0])[None, :]      # [oh, kh]
+        rj = starts_j[:, None] + jnp.arange(ks[1])[None, :]      # [ow, kw]
+        vi = (ri >= 0) & (ri < h)
+        vj = (rj >= 0) & (rj < w)
+        valid = vi[:, None, :, None] & vj[None, :, None, :]      # [oh,ow,kh,kw]
+        valid = valid.transpose(2, 3, 0, 1).reshape(
+            1, 1, ks[0] * ks[1], oh, ow)
+        patches = jnp.where(valid, patches, -jnp.inf)
         arg = jnp.argmax(patches, axis=2)  # in-window flat idx
         # convert to global flat H*W index
-        base_i = (jnp.arange(oh) * st[0] - pads_2d[0][0])[None, None, :, None]
-        base_j = (jnp.arange(ow) * st[1] - pads_2d[1][0])[None, None, None, :]
+        base_i = starts_i[None, None, :, None]
+        base_j = starts_j[None, None, None, :]
         di = arg // ks[1]
         dj = arg % ks[1]
         gi = jnp.clip(base_i + di, 0, h - 1)
@@ -505,7 +523,17 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_m
         pad = _conv_padding(padding, 2)
         if isinstance(pad, str):
             pad = [(0, 0), (0, 0)]
-        mask = _max_pool_mask(x, ks, st, pad)
+        channel_last = data_format == "NHWC"
+        xm = x.transpose([0, 3, 1, 2]) if channel_last else x
+        if ceil_mode:
+            # the mask must cover the same (possibly ceil-extended)
+            # window grid as the pooled output
+            spatial = xm.shape[2:]
+            pad = [(lo, hi + _ceil_extra(spatial[i], ks[i], st[i], lo, hi))
+                   for i, (lo, hi) in enumerate(pad)]
+        mask = _max_pool_mask(xm, ks, st, pad)
+        if channel_last:
+            mask = mask.transpose([0, 2, 3, 1])
         return out, mask
     return out
 
@@ -522,9 +550,12 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCHW", name=None):
     if divisor_override:
-        s = _pool_nd(x, kernel_size, stride, padding, 2, "avg", data_format, ceil_mode=ceil_mode, exclusive=False)
-        ks = _tuplize(kernel_size, 2)
-        return s * (float(np.prod(ks)) / float(divisor_override))
+        # window SUM / divisor: rescaling an inclusive average is wrong
+        # whenever ceil_mode clips a window (its inclusive divisor is the
+        # clipped extent, not k^2)
+        s = _pool_nd(x, kernel_size, stride, padding, 2, "sum", data_format,
+                     ceil_mode=ceil_mode)
+        return s * (1.0 / float(divisor_override))
     return _pool_nd(x, kernel_size, stride, padding, 2, "avg", data_format, ceil_mode=ceil_mode, exclusive=exclusive)
 
 

@@ -175,3 +175,61 @@ def test_one_hot_and_diag_vs_torch():
     v = np.arange(4, dtype="float32")
     _close(F.diag_embed(paddle.to_tensor(v), offset=1),
            torch.diag_embed(torch.tensor(v), offset=1), tag="diag_embed")
+
+
+def test_pool_grid_vs_torch():
+    """max/avg_pool2d across a (kernel, stride, padding, ceil_mode,
+    exclusive) grid vs torch (ceil_mode recently started flowing through
+    the layer classes; exclusive maps to count_include_pad=False)."""
+    r = np.random.RandomState(7)
+    x_np = r.randn(2, 3, 11, 13).astype(np.float32)
+    x = paddle.to_tensor(x_np)
+    tx = torch.tensor(x_np)
+    for k, s, p in ((2, 2, 0), (3, 2, 1), (3, 1, 1), (2, 3, 1)):
+        for ceil_mode in (False, True):
+            ours = F.max_pool2d(x, k, s, p, ceil_mode=ceil_mode)
+            ref = tF.max_pool2d(tx, k, s, p, ceil_mode=ceil_mode)
+            torch_close(ours, ref, tag=f"max k{k}s{s}p{p}ceil{ceil_mode}")
+            for exclusive in (True, False):
+                ours = F.avg_pool2d(x, k, s, p, ceil_mode=ceil_mode,
+                                    exclusive=exclusive)
+                ref = tF.avg_pool2d(tx, k, s, p, ceil_mode=ceil_mode,
+                                    count_include_pad=not exclusive)
+                torch_close(ours, ref,
+                            tag=f"avg k{k}s{s}p{p}c{ceil_mode}e{exclusive}")
+            # divisor_override: window SUM / divisor, ceil windows included
+            ours = F.avg_pool2d(x, k, s, p, ceil_mode=ceil_mode,
+                                divisor_override=4)
+            ref = tF.avg_pool2d(tx, k, s, p, ceil_mode=ceil_mode,
+                                divisor_override=4)
+            torch_close(ours, ref, tag=f"avg-div k{k}s{s}p{p}c{ceil_mode}")
+            # return_mask: indices must track the same (ceil) window grid
+            o2, idx = F.max_pool2d(x, k, s, p, ceil_mode=ceil_mode,
+                                   return_mask=True)
+            r2, tidx = tF.max_pool2d(tx, k, s, p, ceil_mode=ceil_mode,
+                                     return_indices=True)
+            torch_close(o2, r2, tag=f"maxm k{k}s{s}p{p}c{ceil_mode}")
+            np.testing.assert_array_equal(
+                idx.numpy(), tidx.numpy(),
+                err_msg=f"mask k{k}s{s}p{p}c{ceil_mode}")
+
+
+def test_adaptive_pool_vs_torch():
+    """adaptive_{avg,max}_pool2d incl. the return_mask indices and 1d
+    variants vs torch."""
+    r = np.random.RandomState(8)
+    x_np = r.randn(2, 3, 9, 7).astype(np.float32)
+    x = paddle.to_tensor(x_np)
+    tx = torch.tensor(x_np)
+    for out in ((3, 3), (2, 5), (1, 1), (9, 7)):
+        torch_close(F.adaptive_avg_pool2d(x, out),
+                    tF.adaptive_avg_pool2d(tx, out), tag=f"aavg {out}")
+        ours, idx = F.adaptive_max_pool2d(x, out, return_mask=True)
+        ref, tidx = tF.adaptive_max_pool2d(tx, out, return_indices=True)
+        torch_close(ours, ref, tag=f"amax {out}")
+        np.testing.assert_array_equal(idx.numpy(),
+                                      tidx.numpy(), err_msg=f"idx {out}")
+    x1 = paddle.to_tensor(x_np[:, :, :, 0])
+    t1 = torch.tensor(x_np[:, :, :, 0])
+    torch_close(F.adaptive_avg_pool1d(x1, 4),
+                tF.adaptive_avg_pool1d(t1, 4), tag="aavg1d")
