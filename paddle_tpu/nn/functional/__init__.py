@@ -462,15 +462,14 @@ def _pool_nd(x, kernel, stride, padding, nd, op, data_format, ceil_mode=False, e
 
 def _ceil_extra(size, k, s, lo, hi):
     """Extra high-side padding so the output size matches ceil division.
-    A ceil window that would START inside the right padding is dropped
-    (torch/paddle contract: the last window must begin within the input
-    or left padding)."""
-    import math as _m
 
+    PADDLE semantics (the parity contract): plain ceil division —
+    reference PoolOutputSize (phi/kernels/funcs/pooling.h:368) KEEPS a
+    window that starts inside the right padding. torch drops it; the
+    torch-differential tests restrict ceil comparisons to shapes where
+    the two agree."""
     floor_out = (size + lo + hi - k) // s + 1
-    ceil_out = _m.ceil((size + lo + hi - k) / s) + 1
-    if ceil_out > floor_out and (ceil_out - 1) * s >= size + lo:
-        ceil_out -= 1
+    ceil_out = -((size + lo + hi - k) // -s) + 1
     return (ceil_out - floor_out) * s
 
 

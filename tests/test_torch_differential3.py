@@ -181,12 +181,25 @@ def test_pool_grid_vs_torch():
     """max/avg_pool2d across a (kernel, stride, padding, ceil_mode,
     exclusive) grid vs torch (ceil_mode recently started flowing through
     the layer classes; exclusive maps to count_include_pad=False)."""
+    def _torch_agrees(size, k, s, p, ceil_mode):
+        # paddle KEEPS the ceil window that starts in right padding
+        # (PoolOutputSize, pooling.h:368); torch drops it — only compare
+        # where the grids coincide
+        import math
+        if not ceil_mode:
+            return True
+        ceil_out = math.ceil((size + 2 * p - k) / s) + 1
+        return (ceil_out - 1) * s < size + p
+
     r = np.random.RandomState(7)
     x_np = r.randn(2, 3, 11, 13).astype(np.float32)
     x = paddle.to_tensor(x_np)
     tx = torch.tensor(x_np)
     for k, s, p in ((2, 2, 0), (3, 2, 1), (3, 1, 1), (2, 3, 1)):
         for ceil_mode in (False, True):
+            if not (_torch_agrees(11, k, s, p, ceil_mode)
+                    and _torch_agrees(13, k, s, p, ceil_mode)):
+                continue
             ours = F.max_pool2d(x, k, s, p, ceil_mode=ceil_mode)
             ref = tF.max_pool2d(tx, k, s, p, ceil_mode=ceil_mode)
             torch_close(ours, ref, tag=f"max k{k}s{s}p{p}ceil{ceil_mode}")
@@ -233,3 +246,27 @@ def test_adaptive_pool_vs_torch():
     t1 = torch.tensor(x_np[:, :, :, 0])
     torch_close(F.adaptive_avg_pool1d(x1, 4),
                 tF.adaptive_avg_pool1d(t1, 4), tag="aavg1d")
+
+
+def test_ceil_kept_window_mask_and_divisor():
+    """The torch-divergent kept window (paddle PoolOutputSize semantics:
+    a ceil window starting in right padding survives) must stay
+    self-consistent: mask shape tracks the output grid with in-range
+    indices, and divisor_override divides the (zero) window sum."""
+    r = np.random.RandomState(9)
+    x_np = r.randn(1, 2, 11, 11).astype(np.float32)
+    x = paddle.to_tensor(x_np)
+    # k2 s3 p1 ceil: ceil_out 5, last window starts at padded index 12
+    out, mask = F.max_pool2d(x, 2, 3, 1, ceil_mode=True, return_mask=True)
+    assert out.shape == (1, 2, 5, 5) and mask.shape == out.shape
+    m = mask.numpy()
+    assert ((m >= 0) & (m < 11 * 11)).all()
+    # interior windows carry torch-identical indices
+    import torch
+    _, tidx = tF.max_pool2d(torch.tensor(x_np), 2, 3, 1, ceil_mode=True,
+                            return_indices=True)
+    np.testing.assert_array_equal(m[:, :, :4, :4], tidx.numpy()[:, :, :4, :4])
+    # divisor_override: kept window sums zero valid cells -> exactly 0
+    avg = F.avg_pool2d(x, 2, 3, 1, ceil_mode=True, divisor_override=4)
+    assert avg.shape == (1, 2, 5, 5)
+    np.testing.assert_allclose(avg.numpy()[:, :, 4, 4], 0.0, atol=1e-7)
