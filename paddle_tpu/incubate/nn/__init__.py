@@ -76,10 +76,49 @@ class FusedFeedForward(Layer):
         self.dropout2 = Dropout(dropout_rate)
         self.activation = activation
 
+    def _ffn(self, x):
+        """act(x @ W1 + b1) @ W2 + b2 — via the row-blocked Pallas kernel
+        (PTPU_PALLAS_FFN=1; the [tokens, I] intermediate never round-trips
+        HBM in the forward) when geometry allows, else XLA."""
+        import os as _os
+
+        if (_os.environ.get("PTPU_PALLAS_FFN") == "1"
+                and self.activation in ("gelu", "relu")
+                # dropout inactive: p == 0 or eval mode (identity)
+                and (self.dropout1.p == 0.0 or not self.training)
+                # kernel contract: both biases present, uniform dtype
+                # (mixed master-weight setups fall back to XLA's
+                # promoting matmuls)
+                and self.linear1.bias is not None
+                and self.linear2.bias is not None
+                and x.dtype == self.linear1.weight.dtype
+                == self.linear2.weight.dtype):
+            from ...core.dispatch import apply as _apply
+            from ...ops.pallas_ops import ffn_geometry_ok, fused_ffn_arrays
+
+            h = int(x.shape[-1])
+            i = int(self.linear1.weight.shape[-1])
+            h2 = int(self.linear2.weight.shape[-1])
+            n_rows = 1
+            for d in x.shape[:-1]:
+                n_rows *= int(d)
+            if ffn_geometry_ok(n_rows, h, i, h2):
+                # dispatch as 'linear' so AMP's white list treats the
+                # fused path exactly like the fallback's matmuls —
+                # flipping the A/B flag must not change autocast
+                out = _apply(
+                    lambda a, w1, b1, w2: fused_ffn_arrays(
+                        a, w1, b1, w2, act=self.activation),
+                    x, self.linear1.weight, self.linear1.bias,
+                    self.linear2.weight, name="linear")
+                return out + self.linear2.bias
+        return self.linear2(
+            self.dropout1(getattr(F, self.activation)(self.linear1(x))))
+
     def forward(self, src, cache=None):
         residual = src
         x = self.ln(src) if self.normalize_before else src
-        x = self.linear2(self.dropout1(getattr(F, self.activation)(self.linear1(x))))
+        x = self._ffn(x)
         x = residual + self.dropout2(x)
         if not self.normalize_before:
             x = self.ln(x)

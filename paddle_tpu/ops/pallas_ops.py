@@ -1157,3 +1157,107 @@ def fused_layernorm_arrays(x, w, b, eps=1e-5):
     x2 = x.reshape(-1, h)
     y = fused_layernorm_2d(x2, w, b, float(eps))
     return y.reshape(x.shape)
+
+
+# ---------------------------------------------------------------------------
+# Fused FFN (SURVEY §7 phase 7; reference: fused_feedforward_op.cu) —
+# y = act(x @ W1 + b1) @ W2 (+ caller's bias): row-blocked with the
+# intermediate accumulated per block, so the [tokens, I] activation never
+# round-trips HBM in the forward. Backward recomputes it in XLA (the
+# remat trade the kernel exists to make).
+# ---------------------------------------------------------------------------
+
+def _ffn_act(u, act):
+    if act == "gelu":
+        # erf-exact: matches F.gelu's default (approximate=False)
+        return jax.nn.gelu(u, approximate=False)
+    if act == "relu":
+        return jnp.maximum(u, 0.0)
+    raise ValueError(f"fused_ffn: unsupported activation {act!r}")
+
+
+def _ffn_fwd_kernel(x_ref, w1_ref, b1_ref, w2_ref, y_ref, *, block_i, act):
+    x = x_ref[...]                                    # [bm, H]
+    n_ib = w1_ref.shape[1] // block_i
+    acc = jnp.zeros((x.shape[0], w2_ref.shape[1]), jnp.float32)
+
+    def body(ib, acc):
+        from jax.experimental import pallas as pl
+
+        w1 = w1_ref[:, pl.dslice(ib * block_i, block_i)]     # [H, bi]
+        b1 = b1_ref[pl.dslice(ib * block_i, block_i)]        # [bi]
+        w2 = w2_ref[pl.dslice(ib * block_i, block_i), :]     # [bi, H2]
+        u = _dot_f32(x, w1) + b1[None, :].astype(jnp.float32)
+        h = _ffn_act(u, act).astype(x.dtype)
+        return acc + _dot_f32(h, w2)
+
+    acc = jax.lax.fori_loop(0, n_ib, body, acc)
+    y_ref[...] = acc.astype(y_ref.dtype)
+
+
+def ffn_geometry_ok(n_rows, h, i, h2):
+    if not (_on_tpu() or _interpret()):
+        _count_path("ffn_fallback:off_tpu")
+        return False
+    if (h % 128 or i % 128 or h2 % 128
+            or _ln_block_rows(n_rows) is None):
+        _count_path("ffn_fallback:geometry")
+        return False
+    _count_path("ffn_kernel")
+    return True
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def fused_ffn_2d(x2, w1, b1, w2, act):
+    from jax.experimental import pallas as pl
+
+    n, h = x2.shape
+    i = w1.shape[1]
+    h2 = w2.shape[1]
+    bm = _ln_block_rows(n)
+    block_i = 512 if i % 512 == 0 else 128
+    return pl.pallas_call(
+        functools.partial(_ffn_fwd_kernel, block_i=block_i, act=act),
+        grid=(n // bm,),
+        in_specs=[
+            pl.BlockSpec((bm, h), lambda r: (r, 0)),
+            pl.BlockSpec((h, i), lambda r: (0, 0)),
+            pl.BlockSpec((i,), lambda r: (0,)),
+            pl.BlockSpec((i, h2), lambda r: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((bm, h2), lambda r: (r, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, h2), x2.dtype),
+        interpret=_interpret(),
+    )(x2, w1, b1, w2)
+
+
+def _ffn_vjp_fwd(x2, w1, b1, w2, act):
+    return fused_ffn_2d(x2, w1, b1, w2, act), (x2, w1, b1, w2)
+
+
+def _ffn_vjp_bwd(act, res, dy):
+    # recompute-based backward in plain XLA: materializes [n, I] here
+    # (standard remat trade; the fwd saved that HBM round-trip)
+    x2, w1, b1, w2 = res
+
+    def ref(x2, w1, b1, w2):
+        u = (x2.astype(jnp.float32) @ w1.astype(jnp.float32)
+             + b1.astype(jnp.float32)[None, :])
+        h = _ffn_act(u, act).astype(x2.dtype)
+        return (h @ w2).astype(x2.dtype)
+
+    _, vjp = jax.vjp(ref, x2, w1, b1, w2)
+    return vjp(dy)
+
+
+fused_ffn_2d.defvjp(_ffn_vjp_fwd, _ffn_vjp_bwd)
+
+
+def fused_ffn_arrays(x, w1, b1, w2, act="gelu"):
+    """Row-blocked fused FFN over the last axis. Callers gate on
+    ffn_geometry_ok first. Returns act(x @ w1 + b1) @ w2 (caller adds
+    the second bias / dropout / residual)."""
+    h = x.shape[-1]
+    x2 = x.reshape(-1, h)
+    y = fused_ffn_2d(x2, w1, b1, w2, act)
+    return y.reshape(x.shape[:-1] + (w2.shape[1],))

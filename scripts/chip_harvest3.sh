@@ -52,9 +52,11 @@ bash scripts/decode_experiments.sh
 run gpt3_1p3b 1800 python bench.py --config gpt3_1p3b
 run memfit67b 2400 python scripts/memfit67b_tpu.py
 
-# 5. fused-layernorm A/B on the headline step (flag-gated kernel —
-# promote to default only if this wins)
+# 5. fused-kernel A/Bs on the headline step (flag-gated kernels —
+# promote to default only where these win)
 run headline_pallas_ln 1800 env PTPU_PALLAS_LN=1 python bench.py
+run headline_pallas_ffn 1800 env PTPU_PALLAS_FFN=1 python bench.py
+run headline_pallas_both 1800 env PTPU_PALLAS_LN=1 PTPU_PALLAS_FFN=1 python bench.py
 
 # summary into the repo (driver commits uncommitted work at round end)
 {

@@ -349,3 +349,53 @@ def test_decode_auto_policy_smax_threshold(monkeypatch):
     monkeypatch.setenv("PTPU_FLASH_DECODE", "0")
     kc, vc = caches(2048)
     assert not po2._decode_ok(q, kc, vc)          # forced off
+
+
+def test_fused_ffn_parity(monkeypatch):
+    """Row-blocked fused FFN kernel (interpret mode): values + grads vs
+    the XLA path through the public FusedFeedForward gate."""
+    monkeypatch.setenv("PTPU_PALLAS_FFN", "1")
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn import FusedFeedForward
+
+    rng = np.random.RandomState(70)
+    x_np = rng.randn(4, 8, 128).astype(np.float32) * 0.5
+
+    def run(flag):
+        if flag:
+            monkeypatch.setenv("PTPU_PALLAS_FFN", "1")
+        else:
+            monkeypatch.delenv("PTPU_PALLAS_FFN", raising=False)
+        paddle.seed(3)
+        ffn = FusedFeedForward(128, 256, dropout_rate=0.0,
+                               act_dropout_rate=0.0, activation="gelu",
+                               normalize_before=True)
+        x = paddle.to_tensor(x_np)
+        x.stop_gradient = False
+        y = ffn(x)
+        (y ** 2).sum().backward()
+        grads = {n: p.grad.numpy().copy()
+                 for n, p in ffn.named_parameters() if p.grad is not None}
+        return y.numpy(), x.grad.numpy(), grads
+
+    y_ref, dx_ref, g_ref = run(False)
+    y_got, dx_got, g_got = run(True)
+    np.testing.assert_allclose(y_got, y_ref, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(dx_got, dx_ref, rtol=5e-3, atol=5e-4)
+    assert set(g_got) == set(g_ref)
+    for n in g_ref:
+        np.testing.assert_allclose(g_got[n], g_ref[n], rtol=5e-3,
+                                   atol=5e-4, err_msg=n)
+
+
+def test_fused_ffn_gate(monkeypatch):
+    from paddle_tpu.ops import pallas_ops as po3
+
+    monkeypatch.setenv("PTPU_ATTN_DEBUG", "1")
+    po3.reset_attention_path_counts()
+    assert po3.ffn_geometry_ok(16, 128, 256, 128)
+    assert not po3.ffn_geometry_ok(16, 100, 256, 128)
+    assert not po3.ffn_geometry_ok(13, 128, 256, 128)
+    counts = po3.attention_path_counts()
+    assert counts.get("ffn_kernel") == 1
+    assert counts.get("ffn_fallback:geometry") == 2
