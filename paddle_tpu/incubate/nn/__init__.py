@@ -80,38 +80,17 @@ class FusedFeedForward(Layer):
         """act(x @ W1 + b1) @ W2 + b2 — via the row-blocked Pallas kernel
         (PTPU_PALLAS_FFN=1; the [tokens, I] intermediate never round-trips
         HBM in the forward) when geometry allows, else XLA."""
-        import os as _os
 
-        if (_os.environ.get("PTPU_PALLAS_FFN") == "1"
-                and self.activation in ("gelu", "relu")
+        if (self.activation in ("gelu", "relu")
                 # dropout inactive: p == 0 or eval mode (identity)
                 and (self.dropout1.p == 0.0 or not self.training)
-                # kernel contract: both biases present, uniform dtype
-                # (mixed master-weight setups fall back to XLA's
-                # promoting matmuls)
-                and self.linear1.bias is not None
-                and self.linear2.bias is not None
-                and x.dtype == self.linear1.weight.dtype
-                == self.linear2.weight.dtype):
-            from ...core.dispatch import apply as _apply
-            from ...ops.pallas_ops import ffn_geometry_ok, fused_ffn_arrays
+                and self.linear2.bias is not None):
+            from ...ops.pallas_ops import maybe_fused_ffn
 
-            h = int(x.shape[-1])
-            i = int(self.linear1.weight.shape[-1])
-            h2 = int(self.linear2.weight.shape[-1])
-            n_rows = 1
-            for d in x.shape[:-1]:
-                n_rows *= int(d)
-            if ffn_geometry_ok(n_rows, h, i, h2):
-                # dispatch as 'linear' so AMP's white list treats the
-                # fused path exactly like the fallback's matmuls —
-                # flipping the A/B flag must not change autocast
-                out = _apply(
-                    lambda a, w1, b1, w2: fused_ffn_arrays(
-                        a, w1, b1, w2, act=self.activation),
-                    x, self.linear1.weight, self.linear1.bias,
-                    self.linear2.weight, name="linear")
-                return out + self.linear2.bias
+            y = maybe_fused_ffn(x, self.linear1.weight, self.linear1.bias,
+                                self.linear2.weight, self.activation)
+            if y is not None:
+                return y + self.linear2.bias
         return self.linear2(
             self.dropout1(getattr(F, self.activation)(self.linear1(x))))
 

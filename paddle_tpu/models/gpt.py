@@ -262,6 +262,18 @@ class GPTMLP(Layer):
         )
 
     def forward(self, x):
+        from ..ops.pallas_ops import maybe_fused_ffn
+        from ..parallel.mesh import axis_size as _axis_size
+
+        # single-shard fast path: the row-blocked fused kernel keeps the
+        # [tokens, I] intermediate out of HBM; TP-sharded weights (mp>1)
+        # stay on the GSPMD matmul path
+        b2 = self.fc_out.bias
+        if _axis_size("mp") == 1 and b2 is not None:
+            y = maybe_fused_ffn(x, self.fc_in.weight, self.fc_in.bias,
+                                self.fc_out.weight, "gelu_tanh")
+            if y is not None:
+                return y + b2
         return self.fc_out(F.gelu(self.fc_in(x), approximate=True))
 
 

@@ -1171,6 +1171,8 @@ def _ffn_act(u, act):
     if act == "gelu":
         # erf-exact: matches F.gelu's default (approximate=False)
         return jax.nn.gelu(u, approximate=False)
+    if act == "gelu_tanh":
+        return jax.nn.gelu(u, approximate=True)
     if act == "relu":
         return jnp.maximum(u, 0.0)
     raise ValueError(f"fused_ffn: unsupported activation {act!r}")
@@ -1261,3 +1263,27 @@ def fused_ffn_arrays(x, w1, b1, w2, act="gelu"):
     x2 = x.reshape(-1, h)
     y = fused_ffn_2d(x2, w1, b1, w2, act)
     return y.reshape(x.shape[:-1] + (w2.shape[1],))
+
+
+def maybe_fused_ffn(x, w1, b1, w2, act):
+    """Shared gate + dispatch for Tensor-level callers (GPTMLP,
+    incubate.FusedFeedForward): returns act(x@w1+b1)@w2 through the
+    kernel when the flag/bias/dtype/geometry contract holds, else None —
+    the caller then runs its own XLA formulation. Dispatches under
+    'linear' so AMP treats both paths identically."""
+    if _os.environ.get("PTPU_PALLAS_FFN") != "1":
+        return None
+    if b1 is None:
+        return None
+    if not (x.dtype == w1.dtype == w2.dtype):
+        _count_path("ffn_fallback:dtype_mix")
+        return None
+    n_rows = 1
+    for d in x.shape[:-1]:
+        n_rows *= int(d)
+    if not ffn_geometry_ok(n_rows, int(x.shape[-1]), int(w1.shape[-1]),
+                           int(w2.shape[-1])):
+        return None
+    return apply(
+        lambda a, wa, ba, wb: fused_ffn_arrays(a, wa, ba, wb, act=act),
+        x, w1, b1, w2, name="linear")

@@ -399,3 +399,37 @@ def test_fused_ffn_gate(monkeypatch):
     counts = po3.attention_path_counts()
     assert counts.get("ffn_kernel") == 1
     assert counts.get("ffn_fallback:geometry") == 2
+
+
+def test_gpt_mlp_fused_ffn_parity(monkeypatch):
+    """The GPT MLP (headline-bench path) rides the fused kernel under
+    the flag at mp=1; logits match the XLA path; TP (mp>1) stays GSPMD."""
+    import paddle_tpu as paddle
+    from paddle_tpu import parallel
+    from paddle_tpu.models import GPTForCausalLM, gpt_test_config
+
+    x_ids = np.random.RandomState(80).randint(0, 256, (2, 8)).astype("int32")
+
+    def run(flag):
+        if flag:
+            monkeypatch.setenv("PTPU_PALLAS_FFN", "1")
+        else:
+            monkeypatch.delenv("PTPU_PALLAS_FFN", raising=False)
+        paddle.seed(5)
+        parallel.init_mesh()
+        # hidden/intermediate must tile 128 lanes or the gate (rightly)
+        # falls back and the test would compare XLA to itself
+        cfg = gpt_test_config(num_hidden_layers=2, stacked_blocks=False,
+                              hidden_size=128, intermediate_size=256,
+                              num_attention_heads=2)
+        m = GPTForCausalLM(cfg)
+        m.eval()
+        return m(paddle.to_tensor(x_ids)).numpy()
+
+    monkeypatch.setenv("PTPU_ATTN_DEBUG", "1")
+    ref = run(False)
+    po.reset_attention_path_counts()
+    got = run(True)
+    assert po.attention_path_counts().get("ffn_kernel", 0) >= 1, \
+        po.attention_path_counts()   # the kernel actually ran
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
