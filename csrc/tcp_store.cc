@@ -16,6 +16,10 @@
 //   pts_add(h, key, amount, out)      -> 0 / -1   (atomic counter)
 //   pts_wait(h, key, timeout_ms)      -> 0 / -1
 //   pts_delete_key(h, key)            -> 1 deleted, 0 missing, -1 error
+//   pts_cas(h, key, exp, elen, des, dlen, buf, cap)
+//                                     -> post-op value len, -2 error,
+//                                        -3 buf too small (CAS: set iff
+//                                        current==exp; missing matches "")
 
 #include <arpa/inet.h>
 #include <netdb.h>
@@ -39,7 +43,9 @@
 
 namespace {
 
-enum class Cmd : uint8_t { SET = 0, GET = 1, ADD = 2, WAIT = 3, DEL = 4, PING = 5 };
+enum class Cmd : uint8_t {
+  SET = 0, GET = 1, ADD = 2, WAIT = 3, DEL = 4, PING = 5, CAS = 6
+};
 
 // -- framing helpers --------------------------------------------------------
 bool read_full(int fd, void* buf, size_t n) {
@@ -233,6 +239,36 @@ class StoreServer {
           if (!write_u32(fd, 0xA11CE)) return;
           break;
         }
+        case Cmd::CAS: {
+          // compare-and-set: store desired iff current == expected, where a
+          // missing key matches an empty expected. Replies with the post-op
+          // value, so the caller learns both outcome and current owner in
+          // one round trip. This is the claim primitive launch rendezvous
+          // uses — losers must observe the winner WITHOUT mutating anything
+          // (an add-based claim lets losers corrupt the winner's fencing
+          // token; see distributed/launch/controller.py).
+          std::string expected, desired;
+          if (!read_blob(fd, &expected) || !read_blob(fd, &desired)) return;
+          std::string result;
+          {
+            std::lock_guard<std::mutex> g(mu_);
+            auto it = data_.find(key);
+            if (it == data_.end()) {
+              if (expected.empty()) {
+                data_[key] = desired;
+                result = desired;
+              }  // else: missing key, non-empty expected -> no-op, reply ""
+            } else if (it->second == expected) {
+              it->second = desired;
+              result = desired;
+            } else {
+              result = it->second;
+            }
+          }
+          cv_.notify_all();
+          if (!write_blob(fd, result)) return;
+          break;
+        }
       }
     }
   }
@@ -423,6 +459,29 @@ int pts_wait(int64_t h, const char* key, int timeout_ms) {
       !read_u32(c->fd, &found))
     return -1;
   return found ? 0 : -1;
+}
+
+int64_t pts_cas(int64_t h, const char* key, const uint8_t* expected,
+                int64_t elen, const uint8_t* desired, int64_t dlen,
+                uint8_t* buf, int64_t cap) {
+  Client* c = GetClient(h);
+  if (!c) return -2;
+  std::lock_guard<std::mutex> g(c->mu);
+  uint8_t cmd = static_cast<uint8_t>(Cmd::CAS);
+  std::string k(key);
+  std::string e(reinterpret_cast<const char*>(expected),
+                static_cast<size_t>(elen));
+  std::string d(reinterpret_cast<const char*>(desired),
+                static_cast<size_t>(dlen));
+  if (!write_full(c->fd, &cmd, 1) || !write_blob(c->fd, k) ||
+      !write_blob(c->fd, e) || !write_blob(c->fd, d))
+    return -2;
+  std::string v;
+  if (!read_blob(c->fd, &v)) return -2;
+  int64_t n = static_cast<int64_t>(v.size());
+  if (n > cap) return -3;
+  std::memcpy(buf, v.data(), v.size());
+  return n;
 }
 
 int pts_delete_key(int64_t h, const char* key) {

@@ -99,6 +99,11 @@ def _bind(lib):
     lib.pts_wait.argtypes = [ctypes.c_int64, ctypes.c_char_p, ctypes.c_int]
     lib.pts_delete_key.restype = ctypes.c_int
     lib.pts_delete_key.argtypes = [ctypes.c_int64, ctypes.c_char_p]
+    lib.pts_cas.restype = ctypes.c_int64
+    lib.pts_cas.argtypes = [ctypes.c_int64, ctypes.c_char_p,
+                            ctypes.c_char_p, ctypes.c_int64,
+                            ctypes.c_char_p, ctypes.c_int64,
+                            ctypes.c_void_p, ctypes.c_int64]
 
     # -- shm ring --
     lib.shm_ring_create.restype = ctypes.c_int64

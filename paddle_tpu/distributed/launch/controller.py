@@ -43,7 +43,7 @@ class Controller:
         self.logs: List = []
         self._store = None
         self._server = None
-        self._gen: Optional[int] = None   # claim-counter fencing token
+        self._token: Optional[bytes] = None   # slot-ownership fencing token
         self._no_hb_since: Dict[int, float] = {}
 
     # -- rendezvous --------------------------------------------------------
@@ -54,25 +54,39 @@ class Controller:
     def _hb_key(self, slot: int) -> str:
         return f"{self.cfg.job_id}/hb/{slot}"
 
+    def _owner_key(self, slot: int) -> str:
+        return f"{self.cfg.job_id}/owner/{slot}"
+
     def _heartbeat(self, slot: int) -> bool:
         """Renew the slot lease. Returns False when ownership was lost
-        (another node took the slot over) — the holder must fence."""
+        (another node took the slot over) — the holder must fence.
+        Ownership is a token in the owner key that ONLY an actual takeover
+        (compare_set) changes; claim losers never mutate it, so a contested
+        startup can't spuriously fence the winner."""
         if self._store is None:
             return True
         try:
-            key = f"{self.cfg.job_id}/claim/{slot}"
-            if self._gen is not None and int(
-                    self._store.add(key, 0)) != self._gen:
-                return False   # usurped: a reclaimer bumped the counter
+            if (self._token is not None and
+                    self._store.get(self._owner_key(slot), timeout_ms=2000)
+                    != self._token):
+                return False   # usurped: a reclaimer swapped the owner token
             self._store.set(self._hb_key(slot),
                             str(time.time()).encode())
         except (OSError, RuntimeError, TimeoutError):
             pass   # store unreachable: keep running, lease may expire
         return True
 
-    def _slot_stale(self, slot: int) -> bool:
+    def _slot_stale(self, slot: int, max_wait_ms: Optional[int] = None) -> bool:
+        # a slow/loaded master must not masquerade as a dead owner: give the
+        # heartbeat read real headroom (not a 200 ms hair-trigger) before
+        # starting the no-heartbeat grace clock
+        get_timeout_ms = max(2000, int(self.cfg.stale_timeout * 1000 / 3))
+        if max_wait_ms is not None:
+            get_timeout_ms = max(200, min(get_timeout_ms, max_wait_ms))
         try:
-            raw = self._store.get(self._hb_key(slot), timeout_ms=200)
+            raw = self._store.get(self._hb_key(slot),
+                                  timeout_ms=get_timeout_ms)
+            self._no_hb_since.pop(slot, None)
             return time.time() - float(raw.decode()) > self.cfg.stale_timeout
         except Exception:
             # claimed but no heartbeat yet: live during a grace window
@@ -108,29 +122,43 @@ class Controller:
         except (OSError, RuntimeError):
             self._store = TCPStore(host, int(port), is_master=False,
                                    timeout=cfg.rendezvous_timeout)
+        # Unique per-controller token (the add-counter is only a sequence
+        # dispenser here — nobody compares its value, so concurrent bumps
+        # are harmless, unlike the old add-based claim).
+        uid = self._store.add(f"{cfg.job_id}/token_seq", 1)
+        token = f"{os.getpid()}:{uid}".encode()
         deadline = time.time() + cfg.rendezvous_timeout
         while True:
             for slot in range(cfg.nnodes):
-                key = f"{cfg.job_id}/claim/{slot}"
-                n = int(self._store.add(key, 0))
-                if n == 0:
-                    if int(self._store.add(key, 1)) == 1:
-                        self._gen = 1
-                        self._heartbeat(slot)
-                        return slot
-                    continue  # lost the race for this slot
-                if self._slot_stale(slot):
-                    # atomic takeover: the add counter is the fencing
-                    # token — only the reclaimer whose add lands first
-                    # (n -> n+1) wins; racers see a later count and move on
-                    won = int(self._store.add(key, 1))
-                    if won != n + 1:
+                # heartbeat reads on claimed-but-silent slots block; bound
+                # them by the remaining budget so a sweep over several dead
+                # claimants cannot overshoot rendezvous_timeout by minutes
+                remaining_ms = int((deadline - time.time()) * 1000)
+                if remaining_ms <= 0:
+                    break
+                okey = self._owner_key(slot)
+                # fresh claim: empty expected matches a missing owner key;
+                # exactly one racer's compare_set returns its own token,
+                # losers just observe the winner's token (no mutation)
+                cur = self._store.compare_set(okey, b"", token)
+                if cur == token:
+                    self._token = token
+                    self._no_hb_since.pop(slot, None)
+                    self._heartbeat(slot)
+                    return slot
+                if self._slot_stale(slot, max_wait_ms=remaining_ms):
+                    # atomic takeover: swap the owner token from the stale
+                    # holder's to ours; only the reclaimer whose compare_set
+                    # lands first wins, and the old owner's next heartbeat
+                    # sees the foreign token and fences
+                    won = self._store.compare_set(okey, cur, token)
+                    if won != token:
                         continue
-                    self._gen = won
+                    self._token = token
                     self._no_hb_since.pop(slot, None)
                     self._heartbeat(slot)
                     print(f"[launch] reclaimed stale node slot {slot} "
-                          f"of job {cfg.job_id!r} (generation {won})",
+                          f"of job {cfg.job_id!r} (token {token.decode()})",
                           flush=True)
                     return slot
             if time.time() >= deadline:

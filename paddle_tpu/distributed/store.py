@@ -96,6 +96,19 @@ class _PyHandler(socketserver.BaseRequestHandler):
                     self.request.sendall(struct.pack("<I", n))
                 elif cmd == 5:  # PING
                     self.request.sendall(struct.pack("<I", 0xA11CE))
+                elif cmd == 6:  # CAS (set iff current == expected;
+                    # missing key matches empty expected; reply = post-op value)
+                    expected = self._read_blob()
+                    desired = self._read_blob()
+                    with st.cv:
+                        cur = st.data.get(key)
+                        if (cur is None and expected == b"") or cur == expected:
+                            st.data[key] = desired
+                            out = desired
+                        else:
+                            out = cur if cur is not None else b""
+                        st.cv.notify_all()
+                    self._write_blob(out)
         except (ConnectionError, OSError):
             return
 
@@ -151,6 +164,14 @@ class _PyClient:
         with self.lock:
             self._req(2, key, struct.pack("<q", amount))
             return struct.unpack("<q", self._read(8))[0]
+
+    def compare_set(self, key, expected, desired):
+        with self.lock:
+            self._req(6, key,
+                      struct.pack("<I", len(expected)) + expected +
+                      struct.pack("<I", len(desired)) + desired)
+            (n,) = struct.unpack("<I", self._read(4))
+            return self._read(n) if n else b""
 
     def wait_key(self, key, timeout_ms):
         with self.lock:
@@ -225,6 +246,27 @@ class TCPStore:
             if rc != 0:
                 raise ConnectionError("store set failed")
 
+    @staticmethod
+    def _native_read(fn, on_status=None, initial_cap=1 << 20):
+        """Run a native call returning a value length into a caller buffer,
+        growing the buffer on -3 (too small). `fn(buf, cap) -> n`;
+        `on_status` maps a negative status to an exception (else
+        ConnectionError). NOTE: -3 re-issues the request — callers of
+        non-idempotent commands must size initial_cap so their own
+        successful result always fits (see compare_set)."""
+        cap = initial_cap
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = fn(buf, cap)
+            if n == -3:
+                cap *= 16
+                continue
+            if n < 0:
+                if on_status is not None:
+                    on_status(n)
+                raise ConnectionError("store request failed")
+            return buf.raw[:n]
+
     def get(self, key: str, timeout_ms: Optional[int] = None) -> bytes:
         timeout_ms = self.GET_TIMEOUT_MS if timeout_ms is None else timeout_ms
         if self._py_cli is not None:
@@ -232,18 +274,15 @@ class TCPStore:
             if out is None:
                 raise TimeoutError(f"store get({key!r}) timed out")
             return out
-        cap = 1 << 20
-        while True:
-            buf = ctypes.create_string_buffer(cap)
-            n = self._native.pts_get(self._cli, key.encode(), buf, cap, timeout_ms)
-            if n == -3:
-                cap *= 16
-                continue
+
+        def on_status(n):
             if n == -1:
                 raise TimeoutError(f"store get({key!r}) timed out")
-            if n < 0:
-                raise ConnectionError("store get failed")
-            return buf.raw[:n]
+
+        return self._native_read(
+            lambda buf, cap: self._native.pts_get(
+                self._cli, key.encode(), buf, cap, timeout_ms),
+            on_status)
 
     def get_obj(self, key: str, timeout_ms: Optional[int] = None):
         return pickle.loads(self.get(key, timeout_ms))
@@ -256,6 +295,33 @@ class TCPStore:
         if rc != 0:
             raise ConnectionError("store add failed")
         return out.value
+
+    def compare_set(self, key: str, expected, desired) -> bytes:
+        """Atomic compare-and-set (reference analog: torch-style
+        TCPStore.compare_set). Stores `desired` iff the current value equals
+        `expected`; a missing key matches an empty `expected`. Returns the
+        post-op value — equal to `desired` exactly when the caller won,
+        PROVIDED desired values are unique per caller (embed a token, e.g.
+        from `add` on a sequence key): if the current value already equals
+        `desired`, a losing no-op also returns `desired`. Losers observe
+        the current value WITHOUT mutating anything, which is what makes
+        this safe as a claim/fencing primitive (an add-based claim lets
+        losers corrupt the winner's token)."""
+        exp = expected if isinstance(expected, (bytes, bytearray)) else str(expected).encode()
+        des = desired if isinstance(desired, (bytes, bytearray)) else str(desired).encode()
+        if self._py_cli is not None:
+            return self._py_cli.compare_set(key, bytes(exp), bytes(des))
+        # initial_cap >= len(desired): a WINNING CAS always fits the buffer,
+        # so the -3 grow-and-retry path can only re-run a LOSING attempt
+        # (oversized foreign current value). A retried attempt that then
+        # wins is a legitimate late linearization of this call; a won-but-
+        # truncated first attempt being re-applied after an intervening
+        # foreign write would not be, which is why the cap matters.
+        return self._native_read(
+            lambda buf, cap: self._native.pts_cas(
+                self._cli, key.encode(), bytes(exp), len(exp),
+                bytes(des), len(des), buf, cap),
+            initial_cap=max(1 << 20, len(des)))
 
     def wait(self, keys, timeout_ms: Optional[int] = None) -> None:
         timeout_ms = self.GET_TIMEOUT_MS if timeout_ms is None else timeout_ms

@@ -130,6 +130,60 @@ def _select_pair(pred, t, f, name):
         "a Tensor or restructure the branches")
 
 
+def _snapshot_mutables(vals):
+    """Shallow snapshots of the mutable Python containers threaded into
+    staged branches. Both branches of a traced if RUN, sharing the same
+    container objects — an in-place mutation (`acc += [v]`, `d[k] = v`
+    through an alias, `n = lst.pop()`) leaks into the not-taken branch and
+    then dedupes on identity in the select, silently diverging from eager.
+    The static blocker catches `.append(...)`-style statements; this
+    runtime check catches everything else."""
+    return [(i, v, v.copy())
+            for i, v in enumerate(vals)
+            if isinstance(v, (list, dict, set, bytearray))]
+
+
+def _shallow_mutated(obj, snap):
+    """Did `obj` change since `snap`? Elements may be Tensors/ndarrays whose
+    `==` is elementwise (bool() of it raises), so list/dict compare by
+    length/keys + element IDENTITY — conservative (replacing an element
+    with an equal twin still counts as mutation, which is fine: loud beats
+    silent) and never invokes element `__eq__`."""
+    if isinstance(obj, list):
+        return len(obj) != len(snap) or any(
+            a is not b for a, b in zip(obj, snap))
+    if isinstance(obj, dict):
+        return obj.keys() != snap.keys() or any(
+            obj[k] is not snap[k] for k in snap)
+    try:  # set (unhashable tensors can't be members) / bytearray
+        return obj != snap
+    except Exception:
+        return True
+
+
+def _safe_repr(v, limit=120):
+    """repr that cannot raise — container elements may be traced Tensors
+    whose repr concretizes (and so throws) under trace."""
+    try:
+        r = repr(v)
+        return r if len(r) <= limit else r[:limit] + "…"
+    except Exception:
+        return f"<{type(v).__name__} of {len(v)} items>"
+
+
+def _check_mutations(snaps, names, where):
+    for i, obj, snap in snaps:
+        if _shallow_mutated(obj, snap):
+            name = names[i] if names and i < len(names) else f"<var {i}>"
+            raise Dy2StaticError(
+                f"{where}: the branch body of a tensor-dependent if mutated "
+                f"the Python container '{name}' in place "
+                f"({_safe_repr(snap)} -> {_safe_repr(obj)}); staged "
+                "branches run BOTH sides, so the side effect would leak "
+                "into the not-taken branch — use a Tensor, or restructure "
+                "so the container is rebuilt, not mutated")
+
+
 def convert_ifelse_ret(pred, true_fn, false_fn, init_vals, lineno):
     """Early-return if: both branches RETURN their value (the statement
     tail was folded into the false branch by the transformer, reference
@@ -138,8 +192,11 @@ def convert_ifelse_ret(pred, true_fn, false_fn, init_vals, lineno):
     -> run both and select the returned pytrees leaf-wise."""
     if not _is_tracer_val(pred):
         return true_fn(init_vals) if _truthy(pred) else false_fn(init_vals)
+    snaps = _snapshot_mutables(init_vals)
     t_out = true_fn(init_vals)
+    _check_mutations(snaps, None, f"line {lineno}")
     f_out = false_fn(init_vals)
+    _check_mutations(snaps, None, f"line {lineno}")
     t_leaves, t_def = jax.tree_util.tree_flatten(
         t_out, is_leaf=lambda v: isinstance(v, (Tensor, _Undefined)))
     f_leaves, f_def = jax.tree_util.tree_flatten(
@@ -160,8 +217,11 @@ def convert_ifelse(pred, true_fn, false_fn, init_vals, names):
     true_fn/false_fn: vals-tuple -> vals-tuple."""
     if not _is_tracer_val(pred):
         return true_fn(init_vals) if _truthy(pred) else false_fn(init_vals)
+    snaps = _snapshot_mutables(init_vals)
     t_out = true_fn(init_vals)
+    _check_mutations(snaps, names, "if")
     f_out = false_fn(init_vals)
+    _check_mutations(snaps, names, "if")
     return tuple(
         _select_pair(pred, t, f, n)
         for t, f, n in zip(t_out, f_out, names))

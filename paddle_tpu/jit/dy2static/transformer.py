@@ -107,6 +107,21 @@ _BLOCKERS = (ast.Return, ast.Break, ast.Continue, ast.Try, ast.With,
              ast.Raise, ast.Global, ast.Nonlocal, ast.Delete, ast.Yield,
              ast.YieldFrom, ast.Import, ast.ImportFrom, ast.Match)
 
+# Statement-position calls of these methods mutate their receiver
+# (lst.append(x), d.update(...), s.add(...)): under a traced predicate
+# convert_ifelse runs BOTH branches, so such side effects would execute
+# twice / in the not-taken branch. Blocking them keeps the guarded Python
+# form (correct for Python predicates, loud error for traced ones).
+# Value-position mutators (`n = lst.pop()`) still slip through — receiver
+# types are unknowable statically and tensor methods shadow several of
+# these names (Tensor.add, Tensor.sort are pure) — so only bare-statement
+# calls, the overwhelmingly common mutation shape, are blocked.
+_MUTATING_METHODS = frozenset({
+    "append", "extend", "insert", "remove", "clear", "sort", "reverse",
+    "update", "add", "discard", "setdefault", "popitem", "write",
+    "appendleft", "popleft", "pop",
+})
+
 
 def _walk_scope(node):
     """ast.walk that does not descend into nested function/class bodies
@@ -143,6 +158,29 @@ def _conversion_blocker(nodes, allow_returns=False):
                             return ("the body stores into an attribute/"
                                     f"subscript (line {sub.lineno}), which "
                                     "cannot be staged functionally")
+            if isinstance(sub, ast.Expr) and isinstance(sub.value, ast.Call):
+                attr = _method_call_name(sub.value)
+                if attr in _MUTATING_METHODS:
+                    return (f"the body calls the mutating method "
+                            f"`.{attr}(...)` as a statement "
+                            f"(line {sub.lineno}); staged branches run both "
+                            "sides, which would duplicate the side effect")
+    return None
+
+
+def _method_call_name(call):
+    """Method name of `obj.meth(...)` — in raw form or after visit_Call
+    rewrote it to `_ptpu_dy2st.convert_call(obj.meth)(...)` (blockers run
+    after generic_visit, so both shapes occur)."""
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if (isinstance(func, ast.Call) and isinstance(func.func, ast.Attribute)
+            and isinstance(func.func.value, ast.Name)
+            and func.func.value.id == _HELPER
+            and func.func.attr == "convert_call"
+            and func.args and isinstance(func.args[0], ast.Attribute)):
+        return func.args[0].attr
     return None
 
 
@@ -503,12 +541,13 @@ def convert_to_static(fn):
 
     from . import convert_operators as _ops
 
-    # Execute via a factory that takes the original freevars as
-    # parameters, exec'd INTO fn.__globals__: module-global loads in the
-    # converted function stay LIVE (later monkeypatching/rebinding is
-    # seen, same as the original function), while closure variables
-    # resolve through the factory's scope. Only two reserved names touch
-    # the user module: the helper and the transient factory binding.
+    # Compile inside a factory whose parameters are the original freevars,
+    # exec'd INTO fn.__globals__: module-global loads in the converted
+    # function stay LIVE (later monkeypatching/rebinding is seen, same as
+    # the original function), and the inner code object gets real freevars
+    # that are then bound to the ORIGINAL closure cells below. Only two
+    # reserved names touch the user module: the helper and the transient
+    # factory binding.
     freevars = list(fn.__code__.co_freevars)
     fn_def = tree.body[0]
     if not isinstance(fn_def, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -531,13 +570,19 @@ def convert_to_static(fn):
         globalns.setdefault(_HELPER, _ops)
         exec(code, globalns)
         factory_fn = globalns.pop(factory_name)
-        cell_vals = []
-        for cell in (fn.__closure__ or ()):
-            try:
-                cell_vals.append(cell.cell_contents)
-            except ValueError:   # empty cell (recursive def)
-                cell_vals.append(_ops.UNDEFINED)
-        new_fn = factory_fn(*cell_vals)
+        # Bind the converted function to the ORIGINAL closure cells, not a
+        # snapshot of their values: later nonlocal rebinding must stay
+        # visible (eager and converted must see the same cell), and a
+        # recursive def's initially-empty cell fills in once the outer
+        # assignment lands. The factory only exists so compilation gives
+        # the inner code object real freevars; its body is never called.
+        inner_code = next(
+            c for c in factory_fn.__code__.co_consts
+            if isinstance(c, types.CodeType) and c.co_name == fn_def.name)
+        cellmap = dict(zip(fn.__code__.co_freevars, fn.__closure__ or ()))
+        closure = tuple(cellmap[nm] for nm in inner_code.co_freevars)
+        new_fn = types.FunctionType(
+            inner_code, globalns, fn_def.name, fn.__defaults__, closure)
     except Exception:
         _CACHE[fn] = fn
         return fn
@@ -547,7 +592,6 @@ def convert_to_static(fn):
         [l + "\n" for l in ast.unparse(tree).splitlines()], filename)
     new_fn.__ptpu_converted__ = True
     new_fn.__wrapped__ = fn
-    new_fn.__defaults__ = fn.__defaults__
-    new_fn.__kwdefaults__ = fn.__kwdefaults__
+    new_fn.__kwdefaults__ = fn.__kwdefaults__   # defaults set at construction
     _CACHE[fn] = new_fn
     return new_fn

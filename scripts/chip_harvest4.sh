@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Round-4 harvest daemon: waits for the chip tunnel to heal, then runs
+# scripts/harvest4_battery.sh (read fresh at chip-up, so the battery can
+# grow during the round without restarting this daemon).
+#   setsid nohup scripts/chip_harvest4.sh > /tmp/harvest4/driver.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p /tmp/harvest4
+
+probe() {
+  timeout 90 python -c "import jax, jax.numpy as jnp; assert jax.devices()[0].platform in ('tpu','axon'); jnp.ones(8).sum().block_until_ready()" >/dev/null 2>&1
+}
+
+echo "$(date -u) waiting for chip..."
+until probe; do
+  sleep 180
+done
+echo "$(date -u) chip is up — running round-4 battery"
+bash scripts/harvest4_battery.sh
+echo "$(date -u) round-4 harvest complete"

@@ -1,0 +1,69 @@
+#!/usr/bin/env bash
+# Round-4 on-chip measurement battery (VERDICT r3 "Next round" items 1-5, 7).
+# Invoked by chip_harvest4.sh the moment the tunnel heals; safe to re-run
+# manually. Priority order: official record first, then diagnostics.
+# Optional stages are gated on script existence so the battery can be
+# extended mid-round.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p /tmp/harvest4
+
+run() {  # run <name> <timeout-seconds> <cmd...>
+  local name="$1" to="$2"; shift 2
+  echo "$(date -u) == $name"
+  timeout "$to" "$@" > "/tmp/harvest4/$name.log" 2>&1
+  echo "$(date -u) == $name rc=$?"
+}
+
+# 1. official record first: headline then the whole ladder
+run headline 1800 python bench.py
+run ladder 7200 python bench.py --ladder
+cp -f BENCH_LADDER.json /tmp/harvest4/BENCH_LADDER.json 2>/dev/null || true
+
+# 2. resnet: layout A/B at default batch, then batch sweep over both layouts
+run resnet_nhwc 1200 env PTPU_RESNET_BENCH_FORMAT=NHWC python bench.py --config resnet50
+run resnet_nchw 1200 env PTPU_RESNET_BENCH_FORMAT=NCHW python bench.py --config resnet50
+for b in 128 256; do
+  for fmt in NHWC NCHW; do
+    run "resnet_${fmt,,}_b$b" 1200 env PTPU_RESNET_BENCH_BATCH="$b" \
+      PTPU_RESNET_BENCH_FORMAT="$fmt" python bench.py --config resnet50
+  done
+done
+run profile_resnet 1200 python scripts/profile_resnet.py
+
+# 3. decode battery (XLA/Pallas, unroll, batch, path counters) + the new
+# fused per-layer decode step A/B when it exists
+bash scripts/decode_experiments.sh
+[ -f scripts/decode_fused_ab.sh ] && bash scripts/decode_fused_ab.sh
+
+# 4. big configs: durable 1.3B line + 6.7B TPU-target memory fit
+run gpt3_1p3b 1800 python bench.py --config gpt3_1p3b
+run memfit67b 2400 python scripts/memfit67b_tpu.py
+
+# 5. fused-kernel A/Bs on the headline step (flag-gated kernels —
+# promote to default only where these win; delete if they lose)
+run headline_pallas_ln 1800 env PTPU_PALLAS_LN=1 python bench.py
+run headline_pallas_ffn 1800 env PTPU_PALLAS_FFN=1 python bench.py
+run headline_pallas_both 1800 env PTPU_PALLAS_LN=1 PTPU_PALLAS_FFN=1 python bench.py
+
+# 6. tuner TPU calibration (VERDICT next #7): measured trials on chip,
+# persisted roofline constants
+[ -f scripts/tuner_calibrate_tpu.py ] && run tuner_calibrate 2400 python scripts/tuner_calibrate_tpu.py
+
+# 7. packed-sequence (segment-id) flash bench line when it exists
+[ -f scripts/bench_packed_attn.py ] && run packed_attn 1200 python scripts/bench_packed_attn.py
+
+# summary into the repo (driver commits uncommitted work at round end)
+{
+  echo "# Round-4 on-chip harvest ($(date -u))"
+  echo
+  for f in /tmp/harvest4/*.log /tmp/harvest/decode_*.log /tmp/harvest/bisect_*.log; do
+    [ -f "$f" ] || continue
+    echo "## $(basename "$f")"
+    echo '```'
+    grep -v "WARNING" "$f" | tail -30
+    echo '```'
+    echo
+  done
+} > HARVEST_R4.md
+echo "$(date -u) HARVEST_R4.md written"

@@ -466,6 +466,33 @@ class TestScoping:
         c = jit.compile(f, train=False)
         np.testing.assert_allclose(c(_t([2.0])).numpy(), [6.0])
 
+    def test_closure_rebinding_stays_live(self):
+        """Advisor r3: the converted function must share the ORIGINAL
+        closure cells — rebinding a captured variable after conversion is
+        visible to eager and converted alike, not silently snapshotted."""
+        scale = 2.0
+
+        def f(x):
+            return x * scale
+
+        g = convert_to_static(f)
+        np.testing.assert_allclose(g(_t([1.0])).numpy(), [2.0])
+        scale = 5.0
+        np.testing.assert_allclose(g(_t([1.0])).numpy(), [5.0])
+        np.testing.assert_allclose(g(_t([1.0])).numpy(), f(_t([1.0])).numpy())
+
+    def test_recursive_closure_converts(self):
+        """A recursive local def has an empty cell at conversion time; the
+        converted function must read the cell at call time (filled by
+        then), not bake in UNDEFINED."""
+        def step(x, n):
+            if n <= 0:
+                return x
+            return step(x + 1.0, n - 1)
+
+        g = convert_to_static(step)
+        np.testing.assert_allclose(g(_t([0.0]), 3).numpy(), [3.0])
+
 
 class TestFallbacks:
     def test_sourceless_function_passes_through(self):
@@ -486,3 +513,68 @@ class TestFallbacks:
             yield x
 
         assert convert_to_static(gen) is gen
+
+    def test_mutating_method_statement_not_staged(self):
+        """Advisor r3: `lst.append(x)` as a statement inside a branch must
+        NOT be staged (both branches would run, duplicating the side
+        effect). Python predicates keep exact Python semantics; traced
+        predicates raise instead of silently diverging."""
+        def f(x):
+            acc = []
+            if x.sum() > 0:
+                acc.append(1.0)
+                y = x * 2.0
+            else:
+                y = x
+            return y, len(acc)
+
+        g = convert_to_static(f)
+        y, n = g(_t([1.0]))
+        assert n == 1           # side effect ran exactly once
+        np.testing.assert_allclose(y.numpy(), [2.0])
+        y, n = g(_t([-1.0]))
+        assert n == 0           # and never in the not-taken branch
+
+        c = jit.compile(f, train=False)
+        with pytest.raises(Dy2StaticError, match="mutating"):
+            c(_t([1.0]))
+
+    def test_inplace_augassign_container_raises_not_diverges(self):
+        """`acc += [v]` mutates the threaded list IN PLACE, so both staged
+        branches share the mutation and the select dedupes on identity —
+        before the runtime mutation check this silently returned the
+        true-branch count on the false branch. Must raise, source-located."""
+        def f(x):
+            acc = []
+            if x.sum() > 0:
+                acc += [1.0]
+                y = x * 2.0
+            else:
+                y = x
+            return y, len(acc)
+
+        # python predicate: exact semantics
+        g = convert_to_static(f)
+        assert g(_t([1.0]))[1] == 1
+        assert g(_t([-1.0]))[1] == 0
+        # traced predicate: loud error, not silent divergence
+        c = jit.compile(f, train=False)
+        with pytest.raises(Dy2StaticError, match="mutated"):
+            c(_t([-1.0]))
+
+    def test_inplace_augassign_tensor_elements_still_sourcelocated(self):
+        """Container elements may be traced Tensors whose repr concretizes;
+        the mutation error must still be the source-located Dy2StaticError,
+        not an opaque tracer error from formatting the message."""
+        def f(x):
+            acc = []
+            if x.sum() > 0:
+                acc += [x * 2.0]
+                y = x * 2.0
+            else:
+                y = x
+            return y, len(acc)
+
+        c = jit.compile(f, train=False)
+        with pytest.raises(Dy2StaticError, match="mutated"):
+            c(_t([-1.0]))

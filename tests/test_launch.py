@@ -215,6 +215,51 @@ def test_elastic_dead_node_slot_reclaimed(tmp_path):
         master.wait(10)
 
 
+def test_contested_claim_does_not_fence_winners():
+    """Advisor r3 (medium): simultaneous claimants all probe slot 0 first;
+    with the old add-counter claim, losers bumped the counter past the
+    winner's fencing token and the winner's next heartbeat self-fenced
+    (exit 102) on a healthy pod. Owner-token compare_set claims must leave
+    every winner's heartbeat green."""
+    import threading
+
+    from paddle_tpu.distributed.launch.controller import Controller
+    from paddle_tpu.distributed.store import TCPStore
+
+    port = _free_port()
+    master = TCPStore("127.0.0.1", port, is_master=True)
+    try:
+        cfg = lambda: LaunchConfig(  # noqa: E731
+            script="x", nnodes=3, master=f"127.0.0.1:{port}",
+            job_id="race", rendezvous_timeout=60.0)
+        ctrls = [Controller(cfg()) for _ in range(3)]
+        slots, errs = [None] * 3, [None] * 3
+        barrier = threading.Barrier(3)
+
+        def claim(i):
+            try:
+                barrier.wait()          # maximize claim contention
+                slots[i] = ctrls[i]._resolve_node_rank()
+            except Exception as e:      # pragma: no cover - surfaced below
+                errs[i] = e
+
+        threads = [threading.Thread(target=claim, args=(i,)) for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(90)
+        assert errs == [None] * 3
+        assert sorted(slots) == [0, 1, 2]
+        # every winner still owns its slot: no spurious fencing
+        for c, s in zip(ctrls, slots):
+            assert c._heartbeat(s) is True
+        for c in ctrls:
+            if c._store is not None and c._store is not c._server:
+                c._store.close()
+    finally:
+        master.close()
+
+
 def _spawn_worker(out_dir):
     import pathlib
     rank = os.environ["PADDLE_TRAINER_ID"]

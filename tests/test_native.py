@@ -49,6 +49,14 @@ def test_store_set_get_add_wait(force_py, monkeypatch):
             client.get("missing", timeout_ms=200)
         master.set("late", b"x")
         client.wait(["alpha", "late"], timeout_ms=2000)
+        # compare_set: missing key matches empty expected; losers observe
+        # the current value without mutating it (fencing-token contract)
+        assert client.compare_set("owner", b"", b"tokA") == b"tokA"
+        assert master.compare_set("owner", b"", b"tokB") == b"tokA"   # lost
+        assert client.get("owner") == b"tokA"                         # unchanged
+        assert master.compare_set("owner", b"tokA", b"tokB") == b"tokB"
+        assert client.compare_set("owner", b"tokA", b"tokC") == b"tokB"
+        assert client.compare_set("nokey", b"xx", b"y") == b""        # no-op
         assert client.delete_key("alpha") is True
         assert client.delete_key("alpha") is False
     finally:
