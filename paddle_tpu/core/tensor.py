@@ -145,6 +145,16 @@ class Tensor:
             raise TypeError("len() of a 0-d tensor")
         return self.shape[0]
 
+    def __iter__(self):
+        """Iterate over the leading axis (reference: eager Tensor __iter__
+        yields rows). Without this, Python's legacy __getitem__ iteration
+        protocol never terminates: jnp indexing clamps out-of-range
+        indices instead of raising IndexError."""
+        if self.ndim == 0:
+            raise TypeError("iteration over a 0-d tensor")
+        for i in range(self.shape[0]):
+            yield self[i]
+
     # -- autograd ----------------------------------------------------------
     @property
     def trainable(self):

