@@ -71,9 +71,11 @@ def _on_tpu() -> bool:
 # ---------------------------------------------------------------------------
 
 def mha_reference(q, k, v, mask=None, is_causal=False, scale=None,
-                  kv_lens=None):
+                  kv_lens=None, segment_ids=None):
     """q,k,v: [B,S,H,D] → [B,S,H,D]. Computed in fp32 accumulation.
-    kv_lens: optional [B] int32 valid key lengths (right-padded batch)."""
+    kv_lens: optional [B] int32 valid key lengths (right-padded batch).
+    segment_ids: optional [B, S] int32 packed-sequence ids (self-attention
+    only): position pairs attend iff their ids match."""
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
@@ -87,6 +89,10 @@ def mha_reference(q, k, v, mask=None, is_causal=False, scale=None,
         valid = k_pos[None, None, None, :] < jnp.asarray(
             kv_lens, jnp.int32)[:, None, None, None]
         logits = jnp.where(valid, logits, _NEG_INF)
+    if segment_ids is not None:
+        ids = jnp.asarray(segment_ids, jnp.int32)
+        same = ids[:, None, :, None] == ids[:, None, None, :]   # [B,1,Sq,Sk]
+        logits = jnp.where(same, logits, _NEG_INF)
     if mask is not None:
         if mask.dtype == jnp.bool_:
             logits = jnp.where(mask, logits, _NEG_INF)
@@ -113,18 +119,34 @@ def _dot_f32(a, b, transpose_b=False):
                                precision=prec)
 
 
+def _seg_kb_bounds(seg_vec, lo, hi, seq_len, block):
+    """Block range [first, last) of positions in `seg_vec` ([seq_len]
+    int32) whose id lies in [lo, hi] — packed-segment block skipping.
+    Conservative-correct for ANY id layout: every exact match is inside
+    the min/max positional envelope; non-matching positions inside it are
+    killed by the in-tile equality mask."""
+    iota = jax.lax.broadcasted_iota(jnp.int32, (1, seq_len), 1)[0]
+    valid = (seg_vec >= lo) & (seg_vec <= hi)
+    first_pos = jnp.min(jnp.where(valid, iota, seq_len))
+    last_pos = jnp.max(jnp.where(valid, iota, -1))
+    return first_pos // block, (last_pos // block) + 1
+
+
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, *refs, block_k, seq_k,
                       scale, causal, block_q, has_mask, has_lens,
-                      causal_offset=0):
+                      has_segs=False, causal_offset=0):
     from jax.experimental import pallas as pl
 
     refs = list(refs)
     lens_ref = refs.pop(0) if has_lens else None
     mask_ref = refs.pop(0) if has_mask else None
+    qseg_ref = refs.pop(0) if has_segs else None
+    kseg_ref = refs.pop(0) if has_segs else None
     o_ref, lse_ref = refs
     qi = pl.program_id(2)
     q = q_ref[0, :, :]                              # [block_q, d], input dtype
     kv_len = lens_ref[0, 0] if has_lens else None
+    q_seg = qseg_ref[0, :] if has_segs else None    # [block_q] int32
 
     m = jnp.full((block_q,), _NEG_INF, jnp.float32)
     l = jnp.zeros((block_q,), jnp.float32)
@@ -149,6 +171,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, *refs, block_k, seq_k,
             s = jnp.where(q_pos + causal_offset >= k_pos, s, _NEG_INF)
         if has_lens:
             s = jnp.where(k_pos < kv_len, s, _NEG_INF)
+        if has_segs:
+            k_seg = kseg_ref[0, pl.dslice(kb * block_k, block_k)]
+            s = jnp.where(q_seg[:, None] == k_seg[None, :], s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
         alpha = jnp.exp(m - m_new)
@@ -156,6 +181,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, *refs, block_k, seq_k,
         acc_new = acc * alpha[:, None] + _dot_f32(p.astype(v.dtype), v)
         return m_new, l_new, acc_new
 
+    first_kb = 0
     if causal:
         # only key blocks up to (and including) the diagonal contribute
         last_kb = jnp.minimum(
@@ -166,7 +192,14 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, *refs, block_k, seq_k,
     if has_lens:
         # padded keys past kv_len never contribute — skip their blocks
         last_kb = jnp.minimum(last_kb, (kv_len + block_k - 1) // block_k)
-    m, l, acc = jax.lax.fori_loop(0, last_kb, body, (m, l, acc))
+    if has_segs:
+        # packed segments: only key blocks overlapping this q block's
+        # segment-id envelope contribute
+        seg_first, seg_last = _seg_kb_bounds(
+            kseg_ref[0, :], jnp.min(q_seg), jnp.max(q_seg), seq_k, block_k)
+        first_kb = jnp.maximum(first_kb, seg_first)
+        last_kb = jnp.minimum(last_kb, seg_last)
+    m, l, acc = jax.lax.fori_loop(first_kb, last_kb, body, (m, l, acc))
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0, :, :] = (acc / l_safe[:, None]).astype(o_ref.dtype)
     # logsumexp per row — the backward kernels rebuild p = exp(s - lse).
@@ -176,12 +209,15 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, *refs, block_k, seq_k,
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          *refs, block_k, seq_k, scale, causal, block_q,
-                         has_mask, has_lens, causal_offset=0):
+                         has_mask, has_lens, has_segs=False,
+                         causal_offset=0):
     from jax.experimental import pallas as pl
 
     refs = list(refs)
     lens_ref = refs.pop(0) if has_lens else None
     mask_ref = refs.pop(0) if has_mask else None
+    qseg_ref = refs.pop(0) if has_segs else None
+    kseg_ref = refs.pop(0) if has_segs else None
     (dq_ref,) = refs
     qi = pl.program_id(2)
     q = q_ref[0, :, :]                            # [bq, d]
@@ -189,6 +225,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     lse = lse_ref[0, 0, pl.dslice(qi * block_q, block_q)]   # [bq]
     delta = delta_ref[0, 0, pl.dslice(qi * block_q, block_q)]
     kv_len = lens_ref[0, 0] if has_lens else None
+    q_seg = qseg_ref[0, :] if has_segs else None
     num_kb = seq_k // block_k
 
     def body(kb, dq):
@@ -205,11 +242,15 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jnp.where(q_pos + causal_offset >= k_pos, s, _NEG_INF)
         if has_lens:
             s = jnp.where(k_pos < kv_len, s, _NEG_INF)
+        if has_segs:
+            k_seg = kseg_ref[0, pl.dslice(kb * block_k, block_k)]
+            s = jnp.where(q_seg[:, None] == k_seg[None, :], s, _NEG_INF)
         p = jnp.exp(s - lse[:, None])
         dp = _dot_f32(do, v, transpose_b=True)
         ds = p * (dp - delta[:, None])
         return dq + _dot_f32(ds.astype(k.dtype), k)
 
+    first_kb = 0
     if causal:
         last_kb = jnp.minimum(
             ((qi + 1) * block_q + causal_offset + block_k - 1) // block_k,
@@ -218,24 +259,33 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         last_kb = num_kb
     if has_lens:
         last_kb = jnp.minimum(last_kb, (kv_len + block_k - 1) // block_k)
+    if has_segs:
+        seg_first, seg_last = _seg_kb_bounds(
+            kseg_ref[0, :], jnp.min(q_seg), jnp.max(q_seg), seq_k, block_k)
+        first_kb = jnp.maximum(first_kb, seg_first)
+        last_kb = jnp.minimum(last_kb, seg_last)
     dq = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
-    dq = jax.lax.fori_loop(0, last_kb, body, dq)
+    dq = jax.lax.fori_loop(first_kb, last_kb, body, dq)
     dq_ref[0, :, :] = (dq * scale).astype(dq_ref.dtype)
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           *refs, block_q, seq_q, scale, causal, block_k,
-                          has_mask, has_lens, causal_offset=0):
+                          has_mask, has_lens, has_segs=False,
+                          causal_offset=0):
     from jax.experimental import pallas as pl
 
     refs = list(refs)
     lens_ref = refs.pop(0) if has_lens else None
     mask_ref = refs.pop(0) if has_mask else None
+    qseg_ref = refs.pop(0) if has_segs else None   # [1, sq] full row
+    kseg_ref = refs.pop(0) if has_segs else None   # [1, block_k] block
     dk_ref, dv_ref = refs
     ki = pl.program_id(2)
     k = k_ref[0, :, :]                            # [bk, d]
     v = v_ref[0, :, :]
     kv_len = lens_ref[0, 0] if has_lens else None
+    k_seg = kseg_ref[0, :] if has_segs else None  # [bk]
     num_qb = seq_q // block_q
 
     def body(qb, carry):
@@ -256,6 +306,9 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jnp.where(q_pos + causal_offset >= k_pos, s, _NEG_INF)
         if has_lens:
             s = jnp.where(k_pos < kv_len, s, _NEG_INF)
+        if has_segs:
+            q_seg = qseg_ref[0, pl.dslice(qb * block_q, block_q)]
+            s = jnp.where(q_seg[:, None] == k_seg[None, :], s, _NEG_INF)
         p = jnp.exp(s - lse[:, None])
         pb = p.astype(do.dtype)
         dv = dv + _dot_f32(pb.T, do)
@@ -269,9 +322,15 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         first_qb = jnp.maximum(ki * block_k - causal_offset, 0) // block_q
     else:
         first_qb = 0
+    last_qb = num_qb
+    if has_segs:
+        seg_first, seg_last = _seg_kb_bounds(
+            qseg_ref[0, :], jnp.min(k_seg), jnp.max(k_seg), seq_q, block_q)
+        first_qb = jnp.maximum(first_qb, seg_first)
+        last_qb = jnp.minimum(last_qb, seg_last)
     dk = jnp.zeros((k.shape[0], k.shape[1]), jnp.float32)
     dv = jnp.zeros_like(dk)
-    dk, dv = jax.lax.fori_loop(first_qb, num_qb, body, (dk, dv))
+    dk, dv = jax.lax.fori_loop(first_qb, last_qb, body, (dk, dv))
     dk_ref[0, :, :] = (dk * scale).astype(dk_ref.dtype)
     dv_ref[0, :, :] = dv.astype(dv_ref.dtype)
 
@@ -382,7 +441,7 @@ def _interpret() -> bool:
 
 
 def _flash_fwd(q, k, v, is_causal, scale, block_q=None, block_k=None,
-               n_heads=1, mask=None, kv_lens=None):
+               n_heads=1, mask=None, kv_lens=None, segments=None):
     """q,k,v: [BH, S, D] (heads folded into batch) → (out, lse).
 
     mask: optional additive [B, Hm, Sq, Sk] with Hm in {1, n_heads} —
@@ -408,6 +467,7 @@ def _flash_fwd(q, k, v, is_causal, scale, block_q=None, block_k=None,
     H = n_heads
     has_mask = mask is not None
     has_lens = kv_lens is not None
+    has_segs = segments is not None
     kernel = functools.partial(
         _flash_fwd_kernel,
         block_k=block_k,
@@ -417,6 +477,7 @@ def _flash_fwd(q, k, v, is_causal, scale, block_q=None, block_k=None,
         block_q=block_q,
         has_mask=has_mask,
         has_lens=has_lens,
+        has_segs=has_segs,
         causal_offset=sk - sq,
     )
     grid = (bh // H, H, sq // block_q)
@@ -435,6 +496,13 @@ def _flash_fwd(q, k, v, is_causal, scale, block_q=None, block_k=None,
             (1, 1, block_q, sk),
             lambda b, h, i: (b if bm > 1 else 0, h if hm > 1 else 0, i, 0)))
         args.append(mask)
+    if has_segs:
+        # segments: [B, S] int32 shared by q and k (packed self-attention)
+        in_specs.append(pl.BlockSpec((1, block_q),
+                                     lambda b, h, i: (b, i)))       # q block
+        in_specs.append(pl.BlockSpec((1, sk),
+                                     lambda b, h, i: (b, 0)))       # k row
+        args.extend([segments, segments])
     return pl.pallas_call(
         kernel,
         grid=grid,
@@ -453,7 +521,7 @@ def _flash_fwd(q, k, v, is_causal, scale, block_q=None, block_k=None,
 
 def _flash_bwd(q, k, v, out, lse, do, is_causal, scale,
                block_q=None, block_k=None, n_heads=1, mask=None,
-               kv_lens=None):
+               kv_lens=None, segments=None):
     """Blockwise flash backward: recomputes p per tile from (q,k,lse) —
     no S^2 materialization in HBM. Returns (dq, dk, dv), all [BH, S, D]."""
     from jax.experimental import pallas as pl
@@ -470,6 +538,7 @@ def _flash_bwd(q, k, v, out, lse, do, is_causal, scale,
     H = n_heads
     has_mask = mask is not None
     has_lens = kv_lens is not None
+    has_segs = segments is not None
     bm = mask.shape[0] if has_mask else 1
     hm = mask.shape[1] if has_mask else 1
     interp = _interpret()
@@ -494,10 +563,15 @@ def _flash_bwd(q, k, v, out, lse, do, is_causal, scale,
             (1, 1, block_q, sk),
             lambda b, h, i: (b if bm > 1 else 0, h if hm > 1 else 0, i, 0)))
         args.append(mask)
+    if has_segs:
+        in_specs.append(pl.BlockSpec((1, block_q), lambda b, h, i: (b, i)))
+        in_specs.append(pl.BlockSpec((1, sk), lambda b, h, i: (b, 0)))
+        args.extend([segments, segments])
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_k=block_k, seq_k=sk,
                           scale=scale, causal=is_causal, block_q=block_q,
                           has_mask=has_mask, has_lens=has_lens,
+                          has_segs=has_segs,
                           causal_offset=sk - sq),
         grid=(bh // H, H, sq // block_q),
         in_specs=in_specs,
@@ -524,10 +598,15 @@ def _flash_bwd(q, k, v, out, lse, do, is_causal, scale,
             (1, 1, sq, block_k),
             lambda b, h, i: (b if bm > 1 else 0, h if hm > 1 else 0, 0, i)))
         args.append(mask)
+    if has_segs:
+        in_specs.append(pl.BlockSpec((1, sq), lambda b, h, i: (b, 0)))
+        in_specs.append(pl.BlockSpec((1, block_k), lambda b, h, i: (b, i)))
+        args.extend([segments, segments])
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, block_q=block_q, seq_q=sq,
                           scale=scale, causal=is_causal, block_k=block_k,
                           has_mask=has_mask, has_lens=has_lens,
+                          has_segs=has_segs,
                           causal_offset=sk - sq),
         grid=(bh // H, H, sk // block_k),
         in_specs=in_specs,
@@ -556,7 +635,7 @@ def _mask_shape_ok(mask, B, H, sq, sk) -> bool:
     return (mq, mk) == (sq, sk) and bm in (1, B) and hm in (1, H)
 
 
-def _pallas_ok(q, k, is_causal, mask, kv_lens=None) -> bool:
+def _pallas_ok(q, k, is_causal, mask, kv_lens=None, segment_ids=None) -> bool:
     if not (_on_tpu() or _interpret()):
         _count_path("attn_fallback:off_tpu")
         return False
@@ -574,6 +653,9 @@ def _pallas_ok(q, k, is_causal, mask, kv_lens=None) -> bool:
     if kv_lens is not None and tuple(kv_lens.shape) != (b,):
         _count_path("attn_fallback:kv_lens_shape")
         return False
+    # (segment_ids shape is validated with a raise at the public entry —
+    # flash_attention_arrays — since no dense fallback can serve a bad
+    # shape either; no check here)
     if is_causal and sk - sq < 0:
         # causal with more queries than keys has no standard alignment
         _count_path("attn_fallback:causal_sq_gt_sk")
@@ -591,51 +673,58 @@ def _unfold_heads(x, b, h):
     return jnp.moveaxis(x.reshape(b, h, s, d), 1, 2)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
-def _flash_attn_core(q, k, v, mask, kv_lens, is_causal, scale, use_pallas):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8))
+def _flash_attn_core(q, k, v, mask, kv_lens, segs, is_causal, scale,
+                     use_pallas):
     if use_pallas:
         b, s, h, d = q.shape
         of, _ = _flash_fwd(_fold_heads(q), _fold_heads(k), _fold_heads(v),
                            is_causal, scale, n_heads=h, mask=mask,
-                           kv_lens=kv_lens)
+                           kv_lens=kv_lens, segments=segs)
         return _unfold_heads(of, b, h)
     return mha_reference(q, k, v, mask, is_causal, scale,
-                         kv_lens=None if kv_lens is None else kv_lens[:, 0])
+                         kv_lens=None if kv_lens is None else kv_lens[:, 0],
+                         segment_ids=segs)
 
 
-def _flash_attn_fwd(q, k, v, mask, kv_lens, is_causal, scale, use_pallas):
+def _flash_attn_fwd(q, k, v, mask, kv_lens, segs, is_causal, scale,
+                    use_pallas):
     if use_pallas:
         b, s, h, d = q.shape
         qf, kf, vf = _fold_heads(q), _fold_heads(k), _fold_heads(v)
         of, lse = _flash_fwd(qf, kf, vf, is_causal, scale, n_heads=h,
-                             mask=mask, kv_lens=kv_lens)
+                             mask=mask, kv_lens=kv_lens, segments=segs)
         return _unfold_heads(of, b, h), (qf, kf, vf, of, lse, mask,
-                                         kv_lens, (b, h))
+                                         kv_lens, segs, (b, h))
     out = mha_reference(q, k, v, mask, is_causal, scale,
-                        kv_lens=None if kv_lens is None else kv_lens[:, 0])
-    return out, (q, k, v, None, None, mask, kv_lens, None)
+                        kv_lens=None if kv_lens is None else kv_lens[:, 0],
+                        segment_ids=segs)
+    return out, (q, k, v, None, None, mask, kv_lens, segs, None)
 
 
 def _flash_attn_bwd(is_causal, scale, use_pallas, res, g):
-    q, k, v, out, lse, mask, kv_lens, bh_shape = res
+    q, k, v, out, lse, mask, kv_lens, segs, bh_shape = res
     # mask is additive: its cotangent exists but no caller consumes it
     dmask = None if mask is None else jnp.zeros_like(mask)
     dlens = (None if kv_lens is None
              else np.zeros(kv_lens.shape, jax.dtypes.float0))
+    dsegs = (None if segs is None
+             else np.zeros(segs.shape, jax.dtypes.float0))
     if use_pallas:
         b, h = bh_shape
         dq, dk, dv = _flash_bwd(q, k, v, out, lse, _fold_heads(g),
                                 is_causal, scale, n_heads=h, mask=mask,
-                                kv_lens=kv_lens)
+                                kv_lens=kv_lens, segments=segs)
         return (_unfold_heads(dq, b, h), _unfold_heads(dk, b, h),
-                _unfold_heads(dv, b, h), dmask, dlens)
+                _unfold_heads(dv, b, h), dmask, dlens, dsegs)
     # XLA fallback: recompute-based backward through the reference
     _, vjp_fn = jax.vjp(
         lambda a, b, c: mha_reference(
             a, b, c, mask, is_causal, scale,
-            kv_lens=None if kv_lens is None else kv_lens[:, 0]),
+            kv_lens=None if kv_lens is None else kv_lens[:, 0],
+            segment_ids=segs),
         q, k, v)
-    return vjp_fn(g) + (dmask, dlens)
+    return vjp_fn(g) + (dmask, dlens, dsegs)
 
 
 _flash_attn_core.defvjp(_flash_attn_fwd, _flash_attn_bwd)
@@ -658,7 +747,7 @@ _NEG_INF_MASK = -1e30
 
 
 def flash_attention_arrays(q, k, v, attn_mask=None, is_causal=False,
-                           scale=None, kv_lens=None):
+                           scale=None, kv_lens=None, segment_ids=None):
     """Array-level entry (used inside compiled training steps).
 
     attn_mask on the KERNEL path is treated as a CONSTANT (stop_gradient):
@@ -673,6 +762,17 @@ def flash_attention_arrays(q, k, v, attn_mask=None, is_causal=False,
     right-padded variable-length batches — keeps the kernel path with NO
     [B,H,S,S] mask in HBM (the padded key blocks are never even DMA'd).
     Composable with is_causal and attn_mask.
+
+    segment_ids: optional [B, S] int32 packed-sequence ids (the standard
+    TPU pretraining input: multiple documents per row) — self-attention
+    only; positions attend iff ids match, composed with is_causal. The
+    kernel masks in-tile and SKIPS key blocks outside each q block's
+    segment envelope, so packed batches keep flash cost with no [S, S]
+    mask in HBM. Rows with an id that appears nowhere else (e.g. padding)
+    produce unspecified output at those positions — ignore them, as with
+    any padded attention. (SURVEY declares this capability class native —
+    the reference has no flash kernels at all; analog masking semantics:
+    praxis/flax segment_ids.)
     """
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
@@ -680,17 +780,31 @@ def flash_attention_arrays(q, k, v, attn_mask=None, is_causal=False,
     if kv_lens is not None:
         lens = jax.lax.stop_gradient(
             jnp.asarray(kv_lens, jnp.int32).reshape(-1, 1))
+    segs = None
+    if segment_ids is not None:
+        segs = jax.lax.stop_gradient(jnp.asarray(segment_ids, jnp.int32))
+        b, sq, sk = q.shape[0], q.shape[1], k.shape[1]
+        if sq != sk or tuple(segs.shape) != (b, sq):
+            # no dense fallback exists either (segment attention is
+            # self-attention with one [B, S] id array) — user error
+            raise ValueError(
+                f"segment_ids must be [batch, seq] = [{b}, {sq}] for "
+                f"self-attention (got shape {tuple(segs.shape)}, "
+                f"key length {sk})")
     if _pallas_ok(q, k, is_causal, attn_mask,
-                  None if lens is None else lens[:, 0]):
+                  None if lens is None else lens[:, 0], segs):
         _count_path("attn_kernel" + (":kv_lens" if lens is not None else "")
+                    + (":segs" if segs is not None else "")
                     + (":causal_cross" if is_causal
                        and q.shape[1] != k.shape[1] else ""))
         mask = None
         if attn_mask is not None:
             mask = jax.lax.stop_gradient(_normalize_mask(attn_mask))
-        return _flash_attn_core(q, k, v, mask, lens, is_causal, scale, True)
+        return _flash_attn_core(q, k, v, mask, lens, segs, is_causal, scale,
+                                True)
     return mha_reference(q, k, v, attn_mask, is_causal, scale,
-                         kv_lens=None if lens is None else lens[:, 0])
+                         kv_lens=None if lens is None else lens[:, 0],
+                         segment_ids=segs)
 
 
 def cached_attention_arrays(q, k, v, k_cache, v_cache, t, scale=None,
@@ -762,17 +876,25 @@ def cached_attention_arrays(q, k, v, k_cache, v_cache, t, scale=None,
 
 
 def flash_attention(
-    query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False, training=True, name=None
+    query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False,
+    training=True, name=None, segment_ids=None
 ):
-    """Tensor-level fused attention (nn.functional.scaled_dot_product_attention)."""
+    """Tensor-level fused attention (nn.functional.scaled_dot_product_attention).
+    segment_ids: optional [B, S] int ids for packed-sequence batches (see
+    flash_attention_arrays)."""
     mask_arr = None
     if attn_mask is not None:
         mask_arr = attn_mask._data if isinstance(attn_mask, Tensor) else jnp.asarray(attn_mask)
+    seg_arr = None
+    if segment_ids is not None:
+        seg_arr = (segment_ids._data if isinstance(segment_ids, Tensor)
+                   else jnp.asarray(segment_ids))
 
     drop_key = _rng.next_key() if (dropout_p > 0.0 and training) else None
 
     def fn(q, k, v):
-        out = flash_attention_arrays(q, k, v, mask_arr, is_causal)
+        out = flash_attention_arrays(q, k, v, mask_arr, is_causal,
+                                     segment_ids=seg_arr)
         if drop_key is not None:
             keep = jax.random.bernoulli(drop_key, 1.0 - dropout_p, out.shape)
             out = jnp.where(keep, out / (1.0 - dropout_p), 0.0).astype(out.dtype)

@@ -23,21 +23,25 @@ def _rand(shape, seed):
 
 
 def _parity(q, k, v, mask=None, is_causal=False, rtol=2e-4, atol=2e-4,
-            kv_lens=None):
-    assert po._pallas_ok(q, k, is_causal, mask, kv_lens)
+            kv_lens=None, segment_ids=None):
+    assert po._pallas_ok(q, k, is_causal, mask, kv_lens, segment_ids)
     out = po.flash_attention_arrays(q, k, v, mask, is_causal,
-                                    kv_lens=kv_lens)
-    ref = po.mha_reference(q, k, v, mask, is_causal, kv_lens=kv_lens)
+                                    kv_lens=kv_lens,
+                                    segment_ids=segment_ids)
+    ref = po.mha_reference(q, k, v, mask, is_causal, kv_lens=kv_lens,
+                           segment_ids=segment_ids)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=rtol, atol=atol)
 
     def loss_flash(q, k, v):
         return jnp.sum(po.flash_attention_arrays(
-            q, k, v, mask, is_causal, kv_lens=kv_lens) ** 2)
+            q, k, v, mask, is_causal, kv_lens=kv_lens,
+            segment_ids=segment_ids) ** 2)
 
     def loss_ref(q, k, v):
         return jnp.sum(po.mha_reference(
-            q, k, v, mask, is_causal, kv_lens=kv_lens) ** 2)
+            q, k, v, mask, is_causal, kv_lens=kv_lens,
+            segment_ids=segment_ids) ** 2)
 
     g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
@@ -433,3 +437,82 @@ def test_gpt_mlp_fused_ffn_parity(monkeypatch):
     assert po.attention_path_counts().get("ffn_kernel", 0) >= 1, \
         po.attention_path_counts()   # the kernel actually ran
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# Packed-sequence (segment-id) attention — VERDICT r3 item 8
+# ---------------------------------------------------------------------------
+
+def _seg_ids(lengths, S):
+    """Packed segment ids: e.g. [3, 2] with S=8 -> [0,0,0,1,1,2,2,2]
+    (the remainder is one final segment)."""
+    ids = np.zeros(S, np.int32)
+    pos = 0
+    for i, ln in enumerate(lengths):
+        ids[pos:pos + ln] = i
+        pos += ln
+    ids[pos:] = len(lengths)
+    return ids
+
+
+def _seg_parity(q, k, v, segs, is_causal, rtol=2e-4, atol=2e-4):
+    _parity(q, k, v, None, is_causal, rtol, atol, segment_ids=segs)
+
+
+def test_segment_ids_packed_parity():
+    """Multiple documents per row (the packed pretraining input format):
+    kernel matches the dense segment-masked reference, fwd + grads."""
+    B, S, H, D = 2, 256, 2, 64
+    q, k, v = _rand((B, S, H, D), 10), _rand((B, S, H, D), 11), _rand((B, S, H, D), 12)
+    segs = jnp.asarray(np.stack([_seg_ids([100, 80], S),
+                                 _seg_ids([256], S)[:S]]), jnp.int32)
+    _seg_parity(q, k, v, segs, is_causal=False)
+
+
+def test_segment_ids_with_causal():
+    B, S, H, D = 2, 256, 2, 64
+    q, k, v = _rand((B, S, H, D), 13), _rand((B, S, H, D), 14), _rand((B, S, H, D), 15)
+    segs = jnp.asarray(np.stack([_seg_ids([60, 60, 70], S),
+                                 _seg_ids([128, 64], S)]), jnp.int32)
+    _seg_parity(q, k, v, segs, is_causal=True)
+
+
+def test_segment_ids_many_short_docs():
+    """Segment boundaries landing inside and across kernel blocks."""
+    B, S, H, D = 1, 384, 2, 64
+    q, k, v = _rand((B, S, H, D), 16), _rand((B, S, H, D), 17), _rand((B, S, H, D), 18)
+    segs = jnp.asarray(_seg_ids([50, 30, 77, 100, 64], S)[None], jnp.int32)
+    _seg_parity(q, k, v, segs, is_causal=True)
+
+
+def test_segment_path_counter_and_fallback(monkeypatch):
+    monkeypatch.setenv("PTPU_ATTN_DEBUG", "1")
+    po.reset_attention_path_counts()
+    B, S, H, D = 1, 256, 2, 64
+    q, k, v = _rand((B, S, H, D), 19), _rand((B, S, H, D), 20), _rand((B, S, H, D), 21)
+    segs = jnp.asarray(_seg_ids([128, 128], S)[None], jnp.int32)
+    po.flash_attention_arrays(q, k, v, None, True, segment_ids=segs)
+    assert po.attention_path_counts().get("attn_kernel:segs") == 1
+    # wrong shape raises clearly (no dense fallback can serve it either)
+    bad = segs[:, :128]
+    with pytest.raises(ValueError, match="segment_ids must be"):
+        po.flash_attention_arrays(q, k, v, None, False, segment_ids=bad)
+
+
+def test_segment_ids_compose_with_kv_lens():
+    """Padding expressed as kv_lens composes with in-row packing: the
+    kernel result on valid rows matches the dense reference."""
+    B, S, H, D = 2, 256, 2, 64
+    q, k, v = _rand((B, S, H, D), 22), _rand((B, S, H, D), 23), _rand((B, S, H, D), 24)
+    segs = jnp.asarray(np.stack([_seg_ids([100, 100], S),
+                                 _seg_ids([200], S)]), jnp.int32)
+    lens = jnp.asarray([200, 256], jnp.int32)
+    out = po.flash_attention_arrays(q, k, v, None, True, kv_lens=lens,
+                                    segment_ids=segs)
+    ref = po.mha_reference(q, k, v, None, True, kv_lens=lens,
+                           segment_ids=segs)
+    # compare only rows before each kv_len (padded-q rows are unspecified)
+    for b, ln in enumerate([200, 256]):
+        np.testing.assert_allclose(np.asarray(out)[b, :ln],
+                                   np.asarray(ref)[b, :ln],
+                                   rtol=2e-4, atol=2e-4)
