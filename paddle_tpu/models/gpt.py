@@ -355,6 +355,15 @@ def _stacked_ln(h, w, b, eps):
     return ((h32 - mu) * jax.lax.rsqrt(var + eps)).astype(h.dtype) * w + b
 
 
+def _stacked_mlp(p, h, eps):
+    """The MLP half of a stacked block (ln2 -> gelu(fc_in) -> fc_out ->
+    residual) — shared by _stacked_block_body and the fused-decode path,
+    which replaces only the attention half with one Pallas call."""
+    hn = _stacked_ln(h, p["ln2_w"], p["ln2_b"], eps)
+    m = jax.nn.gelu(hn @ p["fc_in_w"] + p["fc_in_b"], approximate=True)
+    return h + m @ p["fc_out_w"] + p["fc_out_b"]
+
+
 def _stacked_block_body(p, h, attn_fn, nh, hd, eps):
     """One pre-LN transformer block over a stacked-weight slice `p`.
     attn_fn: (q, k, v) [B,S,nh,hd] -> (o, extra); `extra` threads cache
@@ -366,9 +375,7 @@ def _stacked_block_body(p, h, attn_fn, nh, hd, eps):
     qkv = (hn @ p["qkv_w"] + p["qkv_b"]).reshape(mb, s, 3, nh, hd)
     o, extra = attn_fn(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
     h = h + o.reshape(mb, s, H) @ p["out_w"] + p["out_b"]
-    hn = _stacked_ln(h, p["ln2_w"], p["ln2_b"], eps)
-    m = jax.nn.gelu(hn @ p["fc_in_w"] + p["fc_in_b"], approximate=True)
-    return h + m @ p["fc_out_w"] + p["fc_out_b"], extra
+    return _stacked_mlp(p, h, eps), extra
 
 
 class GPTStackedBlocks(Layer):
@@ -541,13 +548,34 @@ class GPTStackedBlocks(Layer):
         prefill = time_step is None
 
         def fn(a, t, *flat):
+            from ..ops.pallas_ops import (_fused_decode_layer_ok,
+                                          fused_decode_layer_arrays)
+
             cache_flat, params_flat = flat[:2 * L], flat[2 * L:]
             params = dict(zip(names, params_flat))
             h = a
+            # fused per-layer decode (reference fused_multi_transformer
+            # decode branch): LN1 -> qkv -> cache write -> attention ->
+            # out-proj in ONE Pallas call per layer, attacking the
+            # kernel-launch count the decode bisect isolated. Gate is
+            # static per trace (shapes/dtypes identical across layers).
+            fused = (not prefill and h.shape[1] == 1
+                     and _fused_decode_layer_ok(
+                         h[:, 0, :], params["qkv_w"][0], cache_flat[0],
+                         cache_flat[1], nh))
             outs = []
             for l in range(L):
                 kc, vc = cache_flat[2 * l], cache_flat[2 * l + 1]
                 p = {n: params[n][l] for n in names}
+                if fused:
+                    mb, _, H = h.shape
+                    y, kc2, vc2 = fused_decode_layer_arrays(
+                        h.reshape(mb, H), p["ln1_w"], p["ln1_b"],
+                        p["qkv_w"], p["qkv_b"], p["out_w"], p["out_b"],
+                        kc, vc, t, nh, eps)
+                    h = _stacked_mlp(p, y.reshape(mb, 1, H), eps)
+                    outs += [kc2, vc2]
+                    continue
 
                 def attn_fn(q, k, v, kc=kc, vc=vc):
                     o, kc2, vc2 = _cached_attn_arrays(q, k, v, kc, vc, t,
@@ -801,7 +829,11 @@ class GPTForCausalLM(Layer):
             dtype = self.gpt.embeddings.word_embeddings.weight.dtype
         # flat [B, Smax, H*D] rings: the (H, D) split never reaches a
         # buffer, so XLA keeps a row-contiguous cache layout (no relayout
-        # copies around the decode kernel, contiguous one-row writes)
+        # copies around the decode kernel, contiguous one-row writes).
+        # Ring length rounds up to 128 so the decode kernels' tile-aligned
+        # cache DMA gates pass at any requested length (only the valid
+        # prefix is ever read; the extra rows are never touched).
+        max_length = -(-max_length // 128) * 128
         shape = (batch_size, max_length, nh * hd)
         unroll_env = os.environ.get("PTPU_DECODE_UNROLL")
         unroll = (cfg.num_hidden_layers <= 32 if unroll_env is None

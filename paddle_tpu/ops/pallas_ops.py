@@ -907,46 +907,21 @@ def flash_attention(
 # Flash-decode kernel: single-token attention against a KV cache
 # ---------------------------------------------------------------------------
 
-def _decode_kernel(len_ref, q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf, sem,
-                   *, block_b, block_k, h, d, scale):
-    """One program per batch slab: q [bb, 1, H*D] against the valid prefix
-    of the caches [B, S_max, H*D] living in HBM. The valid length arrives
-    via scalar prefetch (len_ref), so only ceil(len / block_k) cache
-    blocks are ever DMA'd into VMEM — the XLA fallback reads (and masks)
-    all S_max positions — and consecutive blocks are double-buffered so
-    the next slab's DMA overlaps the current block's math. Heads live
-    flattened in the lane dim: Mosaic's (8,128) tiling forbids slicing H
-    or D when they aren't tile multiples, so per-head logits come from one
-    MXU matmul against the segment indicator (s = (K ∘ q) @ seg,
-    [bb*bk, H*D] @ [H*D, H]) and the per-head softmax weights are expanded
-    back to lanes with its swapped twin (p @ E, [bb*bk, H] @ [H, H*D]).
-    Online softmax over blocks, fp32 accumulation."""
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    ib = pl.program_id(0)
-    length = len_ref[0]
-    # clamp to >= 1 block: the pre-loop prefetch below starts a DMA
-    # unconditionally, and a zero-trip loop would never wait on it
-    # (unbalanced semaphore at kernel exit); length 0 just reads garbage
-    # that the position mask then fully excludes... except nothing is
-    # valid — callers pass t+1 >= 1, and the mask yields uniform weights
-    # over block 0 in the degenerate case rather than a fault.
-    num_kb = jnp.maximum((length + block_k - 1) // block_k, 1)
-    bb, hd = block_b, h * d
-    qf = q_ref[...].astype(jnp.float32)                          # [bb,1,hd]
-    # _dot_f32 contract: bf16 caches ride the MXU's fast path (flash-
-    # standard), fp32 caches keep fp32-HIGHEST correctness
-    fast = jnp.bfloat16 if k_buf.dtype == jnp.bfloat16 else jnp.float32
-    # seg[i, j] = (lane i belongs to head j); expand is the same predicate
-    # with the axes swapped — both built straight from 2D iotas because
-    # Mosaic cannot legalize transposes of these skinny shapes
+def _decode_seg_helpers(h, d, fast):
+    """Head-segmented matmul machinery shared by the decode kernels:
+    Mosaic's (8,128) tiling forbids slicing H or D when they aren't tile
+    multiples, so per-head logits come from one MXU matmul against the
+    segment indicator (s = (K ∘ q) @ seg, [rows, H*D] @ [H*D, H]) and
+    per-head weights expand back to lanes with its swapped twin. Both are
+    built straight from 2D iotas (Mosaic cannot legalize transposes of
+    these skinny shapes)."""
+    hd = h * d
     seg = (jax.lax.broadcasted_iota(jnp.int32, (hd, h), 0) // d
            == jax.lax.broadcasted_iota(jnp.int32, (hd, h), 1)
-           ).astype(fast)                                        # [hd, h]
+           ).astype(fast)                                       # [hd, h]
     expand = (jax.lax.broadcasted_iota(jnp.int32, (h, hd), 0)
               == jax.lax.broadcasted_iota(jnp.int32, (h, hd), 1) // d
-              ).astype(fast)                                     # [h, hd]
+              ).astype(fast)                                    # [h, hd]
 
     def seg_dot(a3, mat, exact=False):
         """[bb, bk, X] @ [X, Y] -> [bb, bk, Y] via a free row-merge
@@ -963,10 +938,25 @@ def _decode_kernel(len_ref, q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf, sem,
             out = _dot_f32(a2.astype(fast), mat)
         return out.reshape(a3.shape[0], a3.shape[1], mat.shape[1])
 
+    return seg, expand, seg_dot
+
+
+def _prefix_attn_loop(qf, length, num_kb, row0, k_hbm, v_hbm, k_buf, v_buf,
+                      sem, seg, expand, seg_dot, *, bb, block_k, h, scale):
+    """Double-buffered online-softmax attention of qf [bb, 1, H*D] (fp32)
+    against cache rows [row0:row0+bb, 0:length) streamed from HBM —
+    the shared core of _decode_kernel and _fused_decode_layer_kernel.
+    Returns the running (m, l, acc) softmax state ([bb,1,H] / [bb,1,H*D]
+    fp32) so callers can fold in further terms before normalizing."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    hd = qf.shape[-1]
+
     def copies(slot, kb):
         start = kb * block_k
-        src_k = k_hbm.at[pl.ds(ib * bb, bb), pl.ds(start, block_k)]
-        src_v = v_hbm.at[pl.ds(ib * bb, bb), pl.ds(start, block_k)]
+        src_k = k_hbm.at[pl.ds(row0, bb), pl.ds(start, block_k)]
+        src_v = v_hbm.at[pl.ds(row0, bb), pl.ds(start, block_k)]
         return (pltpu.make_async_copy(src_k, k_buf.at[slot], sem.at[slot, 0]),
                 pltpu.make_async_copy(src_v, v_buf.at[slot], sem.at[slot, 1]))
 
@@ -1004,7 +994,44 @@ def _decode_kernel(len_ref, q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf, sem,
     m0 = jnp.full((bb, 1, h), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((bb, 1, h), jnp.float32)
     acc0 = jnp.zeros((bb, 1, hd), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
+    return jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
+
+
+def _decode_kernel(len_ref, q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf, sem,
+                   *, block_b, block_k, h, d, scale):
+    """One program per batch slab: q [bb, 1, H*D] against the valid prefix
+    of the caches [B, S_max, H*D] living in HBM. The valid length arrives
+    via scalar prefetch (len_ref), so only ceil(len / block_k) cache
+    blocks are ever DMA'd into VMEM — the XLA fallback reads (and masks)
+    all S_max positions — and consecutive blocks are double-buffered so
+    the next slab's DMA overlaps the current block's math. Heads live
+    flattened in the lane dim: Mosaic's (8,128) tiling forbids slicing H
+    or D when they aren't tile multiples, so per-head logits come from one
+    MXU matmul against the segment indicator (s = (K ∘ q) @ seg,
+    [bb*bk, H*D] @ [H*D, H]) and the per-head softmax weights are expanded
+    back to lanes with its swapped twin (p @ E, [bb*bk, H] @ [H, H*D]).
+    Online softmax over blocks, fp32 accumulation."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    ib = pl.program_id(0)
+    length = len_ref[0]
+    # clamp to >= 1 block: the pre-loop prefetch below starts a DMA
+    # unconditionally, and a zero-trip loop would never wait on it
+    # (unbalanced semaphore at kernel exit); length 0 just reads garbage
+    # that the position mask then fully excludes... except nothing is
+    # valid — callers pass t+1 >= 1, and the mask yields uniform weights
+    # over block 0 in the degenerate case rather than a fault.
+    num_kb = jnp.maximum((length + block_k - 1) // block_k, 1)
+    bb = block_b
+    qf = q_ref[...].astype(jnp.float32)                          # [bb,1,hd]
+    # _dot_f32 contract: bf16 caches ride the MXU's fast path (flash-
+    # standard), fp32 caches keep fp32-HIGHEST correctness
+    fast = jnp.bfloat16 if k_buf.dtype == jnp.bfloat16 else jnp.float32
+    seg, expand, seg_dot = _decode_seg_helpers(h, d, fast)
+    m, l, acc = _prefix_attn_loop(
+        qf, length, num_kb, ib * bb, k_hbm, v_hbm, k_buf, v_buf, sem,
+        seg, expand, seg_dot, bb=bb, block_k=block_k, h=h, scale=scale)
     l_exp = seg_dot(l, expand, exact=True)                       # [bb,1,hd]
     o_ref[...] = (acc / jnp.maximum(l_exp, 1e-30)).astype(o_ref.dtype)
 
@@ -1133,6 +1160,195 @@ def _decode_ok(q, k_cache, v_cache) -> bool:
             _count_path("decode_fallback:small_smax")
             return False
     _count_path("decode_kernel")
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Fused per-layer decode step (reference:
+# fused_multi_transformer_op.cu:90 — one CUDA op runs a whole layer's
+# decode: LN, qkv, cache write, attention, out-proj. The round-2 bisect
+# attributed the decode gap to kernel-LAUNCH count (~100-200 kernels/token
+# step at 124M ≈ 1-3 ms of fixed cost), so the TPU answer is the same
+# shape: ONE Pallas program per layer per token step.)
+# ---------------------------------------------------------------------------
+
+def _fused_decode_layer_kernel(len_ref, x_ref, lnw_ref, lnb_ref,
+                               wqkv_ref, bqkv_ref, wo_ref, bo_ref,
+                               k_in, v_in,
+                               y_ref, k_out, v_out,
+                               kv_stage, k_buf, v_buf, sem, wsem,
+                               *, block_k, h, d, eps, scale):
+    """Single program: x [B, H*D] residual stream in, y = x + attn_out
+    out; the new token's k/v are written in place into the HBM cache rings
+    (k_out/v_out alias k_in/v_in). Prefix length t arrives via scalar
+    prefetch; the current token's k/v never round-trip through HBM — the
+    self-attention term folds into the online softmax from registers.
+    Requires t >= 1 (decode always follows a prefill)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    t = len_ref[0]                          # prefix length == write row
+    bb = x_ref.shape[0]
+    hd = h * d
+
+    # LN1 (fp32 row stats)
+    x32 = x_ref[...].astype(jnp.float32)                     # [B, hd]
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    xc = x32 - mu
+    rs = jax.lax.rsqrt(jnp.mean(xc * xc, axis=-1, keepdims=True) + eps)
+    xn = (xc * rs * lnw_ref[...].astype(jnp.float32)[None, :]
+          + lnb_ref[...].astype(jnp.float32)[None, :])
+
+    fast = jnp.bfloat16 if k_buf.dtype == jnp.bfloat16 else jnp.float32
+    qkv = _dot_f32(xn.astype(fast), wqkv_ref[...]) \
+        + bqkv_ref[...].astype(jnp.float32)[None, :]         # [B, 3hd] f32
+    q = qkv[:, :hd]
+    k_new = qkv[:, hd:2 * hd]
+    v_new = qkv[:, 2 * hd:]
+    qf = q[:, None, :]                                       # [B, 1, hd]
+
+    seg, expand, seg_dot = _decode_seg_helpers(h, d, fast)
+    num_kb = jnp.maximum((t + block_k - 1) // block_k, 1)
+    m, l, acc = _prefix_attn_loop(
+        qf, t, num_kb, 0, k_in, v_in, k_buf, v_buf, sem,
+        seg, expand, seg_dot, bb=bb, block_k=block_k, h=h, scale=scale)
+
+    # current token's self-attention term, straight from registers
+    s_self = seg_dot(k_new[:, None, :] * qf, seg) * scale    # [B, 1, h]
+    m2 = jnp.maximum(m, s_self)
+    p_self = jnp.exp(s_self - m2)
+    alpha = jnp.exp(m - m2)
+    l = alpha * l + p_self
+    acc = (acc * seg_dot(alpha, expand, exact=True)
+           + seg_dot(p_self, expand) * v_new[:, None, :])
+
+    # cache write AFTER the prefix loop (no read/write overlap on the
+    # aliased ring) — the tiny one-row DMAs overlap the out-proj matmul
+    kv_stage[0] = k_new[:, None, :].astype(kv_stage.dtype)
+    kv_stage[1] = v_new[:, None, :].astype(kv_stage.dtype)
+    wk = pltpu.make_async_copy(
+        kv_stage.at[0], k_out.at[pl.ds(0, bb), pl.ds(t, 1)], wsem.at[0])
+    wv = pltpu.make_async_copy(
+        kv_stage.at[1], v_out.at[pl.ds(0, bb), pl.ds(t, 1)], wsem.at[1])
+    wk.start()
+    wv.start()
+
+    l_exp = seg_dot(l, expand, exact=True)                   # [B, 1, hd]
+    attn = (acc / jnp.maximum(l_exp, 1e-30))[:, 0, :]        # [B, hd] f32
+    proj = _dot_f32(attn.astype(fast), wo_ref[...]) \
+        + bo_ref[...].astype(jnp.float32)[None, :]
+    y_ref[...] = (x32 + proj).astype(y_ref.dtype)
+    wk.wait()
+    wv.wait()
+
+
+def fused_decode_layer_arrays(x, ln_w, ln_b, wqkv, bqkv, wo, bo,
+                              k_cache, v_cache, t, n_heads, eps=1e-5,
+                              scale=None, block_k=256):
+    """One transformer layer's decode step (S_q = 1) in ONE Pallas call:
+    LN -> qkv -> ring cache write (in place, aliased) -> online-softmax
+    attention over the valid prefix + the current token -> out-proj ->
+    residual add. x: [B, H*D]; caches: flat [B, S_max, H*D] rings;
+    t: int32 scalar prefix length (>= 1). Returns (y, k_cache, v_cache)
+    with the caches updated in place (buffers donated)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, hd = x.shape
+    h = n_heads
+    d = hd // h
+    s_max = k_cache.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    block_k = min(block_k, s_max)
+    while s_max % block_k:
+        block_k //= 2
+    itemsize = jnp.dtype(k_cache.dtype).itemsize
+    # shrink the streamed cache blocks until the double-buffered slabs
+    # plus resident weights fit the VMEM budget
+    weights_bytes = (hd * 3 * hd + hd * hd) * jnp.dtype(wqkv.dtype).itemsize
+    while (block_k > 8
+           and 4 * b * block_k * hd * itemsize > 10 * 2**20 - weights_bytes):
+        block_k //= 2
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec((b, hd), lambda i, len_ref: (0, 0)),          # x
+            pl.BlockSpec((hd,), lambda i, len_ref: (0,)),              # ln_w
+            pl.BlockSpec((hd,), lambda i, len_ref: (0,)),              # ln_b
+            pl.BlockSpec((hd, 3 * hd), lambda i, len_ref: (0, 0)),     # wqkv
+            pl.BlockSpec((3 * hd,), lambda i, len_ref: (0,)),          # bqkv
+            pl.BlockSpec((hd, hd), lambda i, len_ref: (0, 0)),         # wo
+            pl.BlockSpec((hd,), lambda i, len_ref: (0,)),              # bo
+            pl.BlockSpec(memory_space=pltpu.ANY),                      # k_in
+            pl.BlockSpec(memory_space=pltpu.ANY),                      # v_in
+        ],
+        out_specs=[
+            pl.BlockSpec((b, hd), lambda i, len_ref: (0, 0)),          # y
+            pl.BlockSpec(memory_space=pltpu.ANY),                      # k_out
+            pl.BlockSpec(memory_space=pltpu.ANY),                      # v_out
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, b, 1, hd), k_cache.dtype),                  # stage
+            pltpu.VMEM((2, b, block_k, hd), k_cache.dtype),
+            pltpu.VMEM((2, b, block_k, hd), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    kernel = functools.partial(_fused_decode_layer_kernel, block_k=block_k,
+                               h=h, d=d, eps=float(eps), scale=scale)
+    lengths = jnp.asarray(t, jnp.int32).reshape(1)
+    # aliasing: inputs are indexed INCLUDING the scalar-prefetch arg
+    # (lengths=0, x=1, ..., k_in=8, v_in=9); outputs (y=0, k=1, v=2)
+    y, k2, v2 = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hd), x.dtype),
+            jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
+            jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
+        ],
+        input_output_aliases={8: 1, 9: 2},
+        interpret=_interpret(),
+    )(lengths, x, ln_w, ln_b, wqkv, bqkv, wo, bo, k_cache, v_cache)
+    return y, k2, v2
+
+
+def _fused_decode_layer_ok(x, wqkv, k_cache, v_cache, n_heads) -> bool:
+    """Geometry/flag gate for the fused per-layer decode kernel.
+    PTPU_FUSED_DECODE=1 enables (default off until the on-chip A/B
+    promotes it); =0 hard-off."""
+    import os
+
+    if os.environ.get("PTPU_FUSED_DECODE") != "1":
+        return False
+    if not (_on_tpu() or _interpret()):
+        _count_path("fused_decode_fallback:off_tpu")
+        return False
+    b, hd = x.shape[0], x.shape[-1]
+    d = hd // n_heads
+    if d not in (64, 128, 256) or hd % 128 != 0:
+        _count_path("fused_decode_fallback:head_geometry")
+        return False
+    if k_cache.ndim != 3 or k_cache.shape[1] % 128 != 0:
+        _count_path("fused_decode_fallback:cache_shape")
+        return False
+    if not (x.dtype == wqkv.dtype == k_cache.dtype == v_cache.dtype):
+        _count_path("fused_decode_fallback:dtype_mix")
+        return False
+    if x.dtype not in (jnp.bfloat16, jnp.float32):
+        # the kernel's compute-dtype pick only handles bf16/f32; a uniform
+        # f16 model would hand _dot_f32 mixed f32xf16 operands
+        _count_path("fused_decode_fallback:dtype_unsupported")
+        return False
+    # resident weights must leave room for double-buffered cache slabs
+    wbytes = (hd * 3 * hd + hd * hd) * jnp.dtype(wqkv.dtype).itemsize
+    if wbytes > 8 * 2**20:
+        _count_path("fused_decode_fallback:weights_vmem")
+        return False
+    _count_path("fused_decode_kernel")
     return True
 
 

@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# On-chip A/B for the fused per-layer decode kernel (VERDICT r4 item 3):
+# gpt124m decode bench with the fused layer step off/on, plus the
+# existing flash-decode forcing knobs for attribution.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p /tmp/harvest4
+
+run() {
+  local name="$1"; shift
+  echo "$(date -u) == $name"
+  timeout 1800 "$@" > "/tmp/harvest4/$name.log" 2>&1
+  echo "$(date -u) == $name rc=$?"
+}
+
+run decode_base           python bench.py --config gpt124m_decode
+run decode_fused          env PTPU_FUSED_DECODE=1 python bench.py --config gpt124m_decode
+run decode_fused_long     env PTPU_FUSED_DECODE=1 PTPU_DECODE_BENCH_PROMPT=1024 \
+                              PTPU_DECODE_BENCH_NEW=256 python bench.py --config gpt124m_decode
+run decode_base_long      env PTPU_DECODE_BENCH_PROMPT=1024 \
+                              PTPU_DECODE_BENCH_NEW=256 python bench.py --config gpt124m_decode
