@@ -137,16 +137,31 @@ class Controller:
                 if remaining_ms <= 0:
                     break
                 okey = self._owner_key(slot)
-                # fresh claim: empty expected matches a missing owner key;
-                # exactly one racer's compare_set returns its own token,
-                # losers just observe the winner's token (no mutation)
-                cur = self._store.compare_set(okey, b"", token)
-                if cur == token:
-                    self._token = token
-                    self._no_hb_since.pop(slot, None)
-                    self._heartbeat(slot)
-                    return slot
+                # non-mutating owner probe: our token never matches a
+                # foreign owner, so this compare_set is a pure read
+                # (returns b"" for an unclaimed slot)
+                cur = self._store.compare_set(okey, token, token)
+                if cur == b"":
+                    # PRE-BEAT before the claim: a claimant descheduled
+                    # between winning the claim and its first heartbeat
+                    # write would otherwise look stale under load and get
+                    # hijacked (observed under 7-way CI contention).
+                    # Refreshing the beat of a slot another racer is
+                    # simultaneously claiming is benign — that racer is
+                    # alive by definition.
+                    self._store.set(self._hb_key(slot),
+                                    str(time.time()).encode())
+                    if self._store.compare_set(okey, b"", token) == token:
+                        self._token = token
+                        self._no_hb_since.pop(slot, None)
+                        return slot
+                    continue  # lost the race for this slot
                 if self._slot_stale(slot, max_wait_ms=remaining_ms):
+                    # PRE-BEAT for the takeover, same reasoning: without
+                    # it a second reclaimer can hijack the first before
+                    # its first beat lands, fencing a healthy winner
+                    self._store.set(self._hb_key(slot),
+                                    str(time.time()).encode())
                     # atomic takeover: swap the owner token from the stale
                     # holder's to ours; only the reclaimer whose compare_set
                     # lands first wins, and the old owner's next heartbeat
@@ -156,7 +171,6 @@ class Controller:
                         continue
                     self._token = token
                     self._no_hb_since.pop(slot, None)
-                    self._heartbeat(slot)
                     print(f"[launch] reclaimed stale node slot {slot} "
                           f"of job {cfg.job_id!r} (token {token.decode()})",
                           flush=True)

@@ -16,10 +16,23 @@ refines the top candidates by compiling + running them on the current
 from __future__ import annotations
 
 import dataclasses
+import json
 import math
+import os
+import time
 from typing import List, Optional
 
-__all__ = ["ClusterSpec", "ModelSpec", "Plan", "OptimizationTuner"]
+__all__ = ["ClusterSpec", "ModelSpec", "Plan", "OptimizationTuner",
+           "DEFAULT_CALIBRATION_PATH"]
+
+# On-target calibration artifact (written by scripts/tuner_calibrate_tpu.py
+# during an on-chip harvest window; committed so every later session's
+# estimates are grounded in measured hardware ratios rather than the
+# analytic roofline alone — reference: tuner/profiler.py profiles
+# candidate configs on the actual device).
+DEFAULT_CALIBRATION_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "calibration", "tuner_tpu.json")
 
 
 @dataclasses.dataclass
@@ -85,9 +98,18 @@ class OptimizationTuner:
     def __init__(self, model: ModelSpec, cluster: Optional[ClusterSpec] = None):
         self.model = model
         self.cluster = cluster or ClusterSpec()
-        # measured/estimated ratio fitted from trial runs (tune(measure=True));
-        # 1.0 = uncalibrated analytic roofline
+        # measured/estimated ratios fitted from trial runs
+        # (tune(measure=True)); 1.0 = uncalibrated analytic roofline.
+        # calibration: global median (reporting/back-compat);
+        # calib_compute/calib_comm: split factors — a single global factor
+        # rescales every estimate identically and can never change the
+        # RANKING, so re-ranking power comes from calibrating the compute
+        # and communication terms separately.
         self.calibration = 1.0
+        self.calib_compute = 1.0
+        self.calib_comm = 1.0
+        self.comm_fitted = False   # True only when comm-heavy trials
+        #                            independently pinned calib_comm
         self.last_report: Optional[dict] = None
 
     # -- analytical roofline -------------------------------------------------
@@ -147,7 +169,8 @@ class OptimizationTuner:
 
         # pp bubble stretches the whole step
         bubble = (pp - 1) / (M + pp - 1) if pp > 1 else 0.0
-        step = (t_comp + t_mp) / (1 - bubble) + t_dp
+        step = (self.calib_compute * t_comp + self.calib_comm * t_mp) \
+            / (1 - bubble) + self.calib_comm * t_dp
 
         # memory: params + grads (bf16) over pp*mp; optimizer state
         # additionally over 'sharding' (ZeRO); activations with remat,
@@ -196,12 +219,7 @@ class OptimizationTuner:
         trials: List[Plan] = []
         if measure and ranked:
             trials = self._measure(ranked[:max(measure_top_k, top_k)])
-            ratios = [p.breakdown["measured_s"] / p.breakdown["trial_est_s"]
-                      for p in trials
-                      if p.breakdown.get("measured_s")
-                      and p.breakdown.get("trial_est_s")]
-            if ratios:
-                self.calibration = sorted(ratios)[len(ratios) // 2]
+            self._fit_calibration(trials)
             # measured plans rank by wall clock; unmeasured keep their
             # (calibrated) estimates behind every measured one
             def key(p):
@@ -224,6 +242,110 @@ class OptimizationTuner:
             with open(report_path, "w") as f:
                 json.dump(self.last_report, f, indent=1)
         return ranked[:top_k]
+
+    def _fit_calibration(self, trials: List[Plan]) -> None:
+        """Fit (calib_compute, calib_comm) from trial runs: trials whose
+        estimated comm share is small pin the compute factor; comm-heavy
+        trials then pin the comm factor given that fit. The global median
+        ratio is kept for reporting. When only one term is separable
+        (single-chip trial sets), BOTH factors degrade to the global
+        ratio — magnitude calibrated, analytic ranking preserved — and
+        comm_fitted stays False so the artifact records that the comm
+        factor is not a measured fit."""
+        pts = []
+        for p in trials:
+            ms = p.breakdown.get("measured_s")
+            te = p.breakdown.get("trial_est_s")
+            tb = p.breakdown.get("trial_breakdown")
+            if not ms or not te or not tb:
+                continue
+            bubble = tb.get("pp_bubble", 0.0)
+            comp = tb.get("t_compute", 0.0) / max(1 - bubble, 1e-9)
+            comm = max(te - comp, 0.0)
+            pts.append((ms, comp, comm))
+        if not pts:
+            return
+        ratios = sorted(ms / (c + m) for ms, c, m in pts if c + m > 0)
+        if ratios:
+            self.calibration = ratios[len(ratios) // 2]
+        comp_pts = [x for x in pts if x[2] <= 0.2 * (x[1] + x[2])]
+        comm_pts = [x for x in pts if x[2] > 0.2 * (x[1] + x[2])]
+        fit_comp = fit_comm = None
+        if comp_pts:
+            rs = sorted(ms / c for ms, c, _ in comp_pts if c > 0)
+            if rs:
+                fit_comp = rs[len(rs) // 2]
+        if comm_pts:
+            rs = sorted((ms - (fit_comp or 1.0) * c) / m
+                        for ms, c, m in comm_pts if m > 0)
+            rs = [r for r in rs if r > 0]
+            if rs:
+                fit_comm = rs[len(rs) // 2]
+        if fit_comp is not None and fit_comm is not None:
+            self.calib_compute, self.calib_comm = fit_comp, fit_comm
+            self.comm_fitted = True
+        else:
+            # only one term separable (e.g. every trial comm-heavy, or a
+            # single-chip trial set): a lone split factor DISTORTS the
+            # ranking (observed: a CPU-mesh fit pushed calib_comm to ~3e5
+            # while compute stayed 1.0, re-ranking garbage); degrade to
+            # the uniform global ratio, which calibrates magnitude and
+            # preserves the analytic ranking
+            self.calib_compute = self.calib_comm = self.calibration
+
+    # -- on-target calibration persistence -----------------------------------
+    def save_calibration(self, path: str = None) -> str:
+        """Persist the measured/estimated ratio (plus the cluster model it
+        was fitted against and the platform it was measured on) so later
+        sessions can ground their estimates without re-measuring."""
+        path = path or DEFAULT_CALIBRATION_PATH
+        if os.path.dirname(path):
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+        platform = "unknown"
+        try:
+            import jax
+
+            platform = jax.devices()[0].platform
+        except Exception:
+            pass
+        payload = {
+            "calibration": self.calibration,
+            "calib_compute": self.calib_compute,
+            "calib_comm": self.calib_comm,
+            "comm_fitted": self.comm_fitted,
+            "platform": platform,
+            "cluster": dataclasses.asdict(self.cluster),
+            "fitted_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "model": dataclasses.asdict(self.model),
+        }
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=1)
+        return path
+
+    def load_calibration(self, path: str = None,
+                         require_platform: str = None) -> bool:
+        """Apply a persisted calibration. Returns False (leaving the
+        analytic 1.0) when the file is absent or was fitted on a different
+        platform than `require_platform`."""
+        path = path or DEFAULT_CALIBRATION_PATH
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            return False
+        if (require_platform is not None
+                and payload.get("platform") != require_platform):
+            return False
+        self.calibration = float(payload["calibration"])
+        # both split keys default to the GLOBAL ratio: mixing a calibrated
+        # compute factor with an uncalibrated comm one is exactly the
+        # lone-split-factor distortion _fit_calibration degrades to avoid
+        self.calib_compute = float(payload.get("calib_compute",
+                                               payload["calibration"]))
+        self.calib_comm = float(payload.get("calib_comm",
+                                            payload["calibration"]))
+        self.comm_fitted = bool(payload.get("comm_fitted", False))
+        return True
 
     def best(self) -> Plan:
         ranked = self.tune(top_k=1)
@@ -295,7 +417,8 @@ class OptimizationTuner:
                         plan.breakdown, measured_s=wall,
                         trial_est_s=(trial_est.est_step_time
                                      if trial_est.est_step_time < float("inf")
-                                     else None))))
+                                     else None),
+                        trial_breakdown=trial_est.breakdown)))
             except Exception as e:  # infeasible at runtime: keep estimate
                 measured.append(dataclasses.replace(
                     plan, breakdown=dict(plan.breakdown,

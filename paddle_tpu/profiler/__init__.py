@@ -134,6 +134,7 @@ class Profiler:
         self._last_step_t = None
         self._profile_memory = profile_memory
         self._mem_samples = []  # (bytes_in_use, peak_bytes_in_use) per step
+        self._last_trace_dir = None  # xplane dir of the finished capture
 
     def start(self):
         _tracer.enabled = True
@@ -155,6 +156,7 @@ class Profiler:
                 import jax.profiler
 
                 jax.profiler.stop_trace()
+                self._last_trace_dir = self._xla_dir
             except Exception:
                 pass
             self._xla_dir = None
@@ -209,7 +211,36 @@ class Profiler:
             lines.append(
                 f"{'  max over steps':40s} {max(cur)*mb:12.1f} "
                 f"{max(peak)*mb:12.1f}")
+        if op_detail:
+            dev = self.device_op_summary(time_unit=time_unit)
+            if dev:
+                lines += ["", dev]
         return "\n".join(lines)
+
+    def device_op_summary(self, top=30, time_unit="ms"):
+        """Per-op device-time attribution table parsed from the xplane
+        capture (reference: profiler_statistic.py operator/kernel
+        statistics fed from the CUPTI event tree; here the jax.profiler
+        xplane protobuf, decoded without a tensorflow dependency — see
+        profiler/xplane.py). Empty string when no device trace exists
+        (timer_only mode, or capture failed)."""
+        if self._last_trace_dir is None:
+            return ""
+        from . import xplane
+
+        files = xplane.find_xplane_files(self._last_trace_dir)
+        if not files:
+            return ""
+        planes = []
+        for f in files:
+            try:
+                planes.extend(xplane.parse_xspace(f))
+            except (OSError, ValueError, IndexError):
+                continue   # truncated/corrupt capture: skip that file
+        stats = xplane.op_stats(planes) if planes else {}
+        if not stats:
+            return ""
+        return xplane.format_op_table(stats, top=top, time_unit=time_unit)
 
     def _export_chrome(self, fname):
         with open(fname, "w") as f:

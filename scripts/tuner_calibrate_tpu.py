@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""On-chip tuner calibration (VERDICT r4 item 7; reference:
+auto_parallel/tuner/profiler.py — profile candidate configs on the actual
+device). Runs the tuner's measured trials for a few transformer shapes on
+the real chip, fits the compute/comm calibration factors, and commits the
+artifact to calibration/tuner_tpu.json so every later session's estimates
+are hardware-grounded.
+
+With one physical chip only the COMPUTE factor is separable (all 1-chip
+plans are comm-free); both split factors then degrade to the global
+measured/estimated ratio and the artifact records comm_fitted=false —
+a multi-chip window is needed before calib_comm is a measured fit.
+"""
+import dataclasses
+import json
+
+import jax
+
+from paddle_tpu.distributed.tuner import (ClusterSpec, ModelSpec,
+                                          OptimizationTuner,
+                                          DEFAULT_CALIBRATION_PATH)
+
+n = len(jax.devices())
+print(f"devices: {n} x {jax.devices()[0].platform}")
+
+specs = {
+    "gpt124m": ModelSpec(n_params=124_000_000, n_layers=12, hidden=768,
+                         seq_len=1024, global_batch=8, heads=12),
+    "gpt350m": ModelSpec(n_params=350_000_000, n_layers=24, hidden=1024,
+                         seq_len=1024, global_batch=8, heads=16),
+}
+
+fits = {}
+tuner = None
+for name, spec in specs.items():
+    tuner = OptimizationTuner(spec, ClusterSpec(n_devices=n))
+    ranked = tuner.tune(measure=True, measure_top_k=4)
+    fits[name] = {
+        "calibration": tuner.calibration,
+        "calib_compute": tuner.calib_compute,
+        "calib_comm": tuner.calib_comm,
+        "chosen": dataclasses.asdict(ranked[0]) if ranked else None,
+    }
+    print(name, json.dumps(fits[name]["chosen"] and {
+        k: fits[name][k] for k in
+        ("calibration", "calib_compute", "calib_comm")}))
+
+if tuner is not None:
+    path = tuner.save_calibration(DEFAULT_CALIBRATION_PATH)
+    print("calibration written:", path)
+    print(json.dumps(fits, indent=1, default=str))

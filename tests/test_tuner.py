@@ -130,3 +130,103 @@ def test_engine_tune_measured_entry(tmp_path):
                      report_path=str(tmp_path / "rep.json"))
     assert plans and plans[0].breakdown.get("measured_s") is not None
     assert (tmp_path / "rep.json").exists()
+
+
+class TestCalibration:
+    """Split compute/comm calibration + persistence (VERDICT r4 item 7;
+    reference: tuner/profiler.py on-device profiling)."""
+
+    def _mk(self, n=8):
+        from paddle_tpu.distributed.tuner import (ClusterSpec, ModelSpec,
+                                                  OptimizationTuner)
+        spec = ModelSpec(n_params=124_000_000, n_layers=12, hidden=768,
+                         seq_len=1024, global_batch=64, heads=12)
+        return OptimizationTuner(spec, ClusterSpec(n_devices=n))
+
+    def _fake_trials(self, tuner, a, b):
+        """Synthesize trials whose wall times follow measured =
+        a*compute + b*comm of the trial estimates."""
+        import dataclasses
+        trials = []
+        for plan in tuner.tune(top_k=6):
+            est = tuner.estimate(dataclasses.replace(plan, breakdown={}))
+            bd = est.breakdown
+            comp = bd["t_compute"] / max(1 - bd["pp_bubble"], 1e-9)
+            comm = max(est.est_step_time - comp, 0.0)
+            trials.append(dataclasses.replace(plan, breakdown=dict(
+                measured_s=a * comp + b * comm,
+                trial_est_s=est.est_step_time,
+                trial_breakdown=bd)))
+        return trials
+
+    def test_fit_recovers_split_factors(self):
+        tuner = self._mk()
+        trials = self._fake_trials(tuner, a=2.0, b=5.0)
+        tuner._fit_calibration(trials)
+        assert abs(tuner.calib_compute - 2.0) < 0.4
+        # comm factor only fits when comm-heavy trials exist
+        if any(t.breakdown["trial_breakdown"]["t_mp_comm"] > 0
+               for t in trials):
+            assert tuner.calib_comm > 1.5
+
+    def test_calibration_changes_ranking(self):
+        """A comm factor >> 1 must push comm-heavy plans down the ranking
+        — the re-ranking power a single global factor cannot have."""
+        import dataclasses
+        tuner = self._mk()
+        base = {(p.dp, p.sharding, p.pp, p.mp): p.est_step_time
+                for p in (tuner.estimate(dataclasses.replace(p, breakdown={}))
+                          for p in tuner.candidates()) if p.feasible}
+        tuner.calib_comm = 50.0
+        after = {(p.dp, p.sharding, p.pp, p.mp): p.est_step_time
+                 for p in (tuner.estimate(dataclasses.replace(p, breakdown={}))
+                           for p in tuner.candidates()) if p.feasible}
+        # pure-dp plans (no mp comm) unchanged in relative cost; mp plans
+        # inflate
+        key_dp = (8, 1, 1, 1)
+        key_mp = next(k for k in base if k[3] > 1)
+        assert after[key_mp] / after[key_dp] > base[key_mp] / base[key_dp]
+
+    def test_save_load_roundtrip(self, tmp_path):
+        import json
+        tuner = self._mk()
+        tuner.calibration, tuner.calib_compute, tuner.calib_comm = 1.7, 2.1, 3.3
+        tuner.comm_fitted = True
+        path = str(tmp_path / "cal.json")
+        tuner.save_calibration(path)
+        fresh = self._mk()
+        assert fresh.load_calibration(path)
+        assert (fresh.calibration, fresh.calib_compute,
+                fresh.calib_comm) == (1.7, 2.1, 3.3)
+        assert fresh.comm_fitted
+        assert not fresh.load_calibration(str(tmp_path / "missing.json"))
+        # platform gating, both directions, with an explicit payload
+        payload = json.load(open(path))
+        payload["platform"] = "tpu"
+        gated = str(tmp_path / "cal_tpu.json")
+        json.dump(payload, open(gated, "w"))
+        assert not self._mk().load_calibration(gated, require_platform="cpu")
+        assert self._mk().load_calibration(gated, require_platform="tpu")
+        # split keys absent -> BOTH factors default to the global ratio
+        # (a lone split factor would distort rankings)
+        del payload["calib_compute"], payload["calib_comm"]
+        legacy = str(tmp_path / "cal_legacy.json")
+        json.dump(payload, open(legacy, "w"))
+        old = self._mk()
+        assert old.load_calibration(legacy)
+        assert old.calib_compute == old.calib_comm == old.calibration
+
+    def test_committed_tpu_calibration_ranks_headline_config_first(self):
+        """Gated on the on-chip artifact (written by
+        scripts/tuner_calibrate_tpu.py during a harvest window): with TPU
+        calibration loaded, the 124M/8-chip search must rank the
+        known-good pure-DP headline config first."""
+        import os
+        import pytest
+        from paddle_tpu.distributed.tuner import DEFAULT_CALIBRATION_PATH
+        if not os.path.exists(DEFAULT_CALIBRATION_PATH):
+            pytest.skip("no on-chip calibration artifact yet")
+        tuner = self._mk()
+        assert tuner.load_calibration()
+        best = tuner.tune(top_k=1)[0]
+        assert (best.dp, best.pp, best.mp) == (8, 1, 1)
