@@ -364,6 +364,38 @@ def _stacked_mlp(p, h, eps):
     return h + m @ p["fc_out_w"] + p["fc_out_b"]
 
 
+def _stacked_mlp_fused_decode(p, h, eps):
+    """Decode-step MLP through the fused LN + FFN kernels (2 launches
+    instead of the ~8-op XLA chain) — the remaining half of the
+    fused_multi_transformer decode analog. Same arithmetic as
+    _stacked_mlp (gelu_tanh matches its approximate=True); returns None
+    when kernel geometry doesn't hold and the caller falls back."""
+    from ..ops.pallas_ops import (_ln_block_rows, ffn_geometry_ok,
+                                  fused_ffn_arrays, fused_layernorm_arrays,
+                                  ln_geometry_ok)
+
+    # the FFN kernel keeps its own opt-in: composing flags must not make
+    # PTPU_FUSED_DECODE silently enable the unpromoted MLP kernels
+    if os.environ.get("PTPU_PALLAS_FFN") != "1":
+        return None
+    mb, s, H = h.shape
+    I = int(p["fc_in_w"].shape[-1])
+    rows = mb * s
+    # cheap prechecks first so the gate counters only fire when BOTH
+    # kernels will actually run (a lone ln_kernel count with a vetoing
+    # ffn geometry would corrupt the path diagnostics)
+    if not (h.dtype == p["fc_in_w"].dtype == p["fc_out_w"].dtype
+            and H % 128 == 0 and I % 128 == 0
+            and _ln_block_rows(rows) is not None):
+        return None
+    if not (ln_geometry_ok(rows, H) and ffn_geometry_ok(rows, H, I, H)):
+        return None
+    hn = fused_layernorm_arrays(h, p["ln2_w"], p["ln2_b"], eps)
+    m = fused_ffn_arrays(hn, p["fc_in_w"], p["fc_in_b"], p["fc_out_w"],
+                         act="gelu_tanh")
+    return h + m + p["fc_out_b"]
+
+
 def _stacked_block_body(p, h, attn_fn, nh, hd, eps):
     """One pre-LN transformer block over a stacked-weight slice `p`.
     attn_fn: (q, k, v) [B,S,nh,hd] -> (o, extra); `extra` threads cache
@@ -425,9 +457,12 @@ class GPTStackedBlocks(Layer):
             setattr(self, name, p)
         self._names = list(shapes)
 
-    def block_closure(self):
+    def block_closure(self, segment_ids=None):
         """Array-level single-block function `block(params_slice, h) -> h`
-        shared by the gpipe forward, the 1F1B fused loss, and dryruns."""
+        shared by the gpipe forward, the 1F1B fused loss, and dryruns.
+        segment_ids: optional [B, S] packed-sequence ids (array, traced
+        alongside h) — documents attend only within their own segment
+        (flash kernel path; see ops/pallas_ops.flash_attention_arrays)."""
         from ..parallel.mesh import axis_size
         from ..parallel.ring import ring_attention_arrays
         from ..ops.pallas_ops import flash_attention_arrays
@@ -442,6 +477,11 @@ class GPTStackedBlocks(Layer):
             cfg.context_parallel and axis_size("sp") > 1 and axis_size("pp") <= 1
         )
 
+        if use_ring and segment_ids is not None:
+            raise NotImplementedError(
+                "packed segment_ids are not supported with ring "
+                "context-parallel attention yet; run packed batches with "
+                "sp=1 (full-sequence flash)")
         if use_ring and _zigzag_active(cfg):
             from functools import partial as _partial
 
@@ -452,6 +492,13 @@ class GPTStackedBlocks(Layer):
             attn = flash_attention_arrays
 
         def block(p, h):
+            if segment_ids is not None:
+                out, _ = _stacked_block_body(
+                    p, h, lambda q, k, v: (attn(
+                        q, k, v, is_causal=True,
+                        segment_ids=segment_ids), None),
+                    nh, hd, eps)
+                return out
             out, _ = _stacked_block_body(
                 p, h, lambda q, k, v: (attn(q, k, v, is_causal=True), None),
                 nh, hd, eps)
@@ -464,12 +511,35 @@ class GPTStackedBlocks(Layer):
             block = jax.checkpoint(block)
         return block
 
-    def forward(self, x):
+    def forward(self, x, segment_ids=None):
         from ..parallel.pipeline import pipeline_apply
 
         names = self._names
         n_micro = self.cfg.pp_num_microbatches or None
         chunks = max(1, self.cfg.pp_num_chunks)
+
+        if segment_ids is not None:
+            from ..parallel.mesh import axis_size
+
+            if axis_size("pp") > 1:
+                # pipeline microbatching would have to split the id rows
+                # with the activations; not wired yet — loud over wrong
+                raise NotImplementedError(
+                    "packed segment_ids with pp>1 pipeline parallelism is "
+                    "not supported yet; use dp/mp/sharding axes")
+
+            # segs trace alongside x so the block closure sees an array
+            def fn(a, segs, *flat):
+                params = dict(zip(names, flat))
+                block = self.block_closure(segment_ids=segs)
+                return pipeline_apply(block, params, a,
+                                      n_microbatches=n_micro,
+                                      num_chunks=chunks)
+
+            tensors = [getattr(self, n) for n in names]
+            return apply(fn, x, segment_ids, *tensors,
+                         name="gpt_stacked_blocks")
+
         block = self.block_closure()
 
         def fn(a, *flat):
@@ -573,7 +643,10 @@ class GPTStackedBlocks(Layer):
                         h.reshape(mb, H), p["ln1_w"], p["ln1_b"],
                         p["qkv_w"], p["qkv_b"], p["out_w"], p["out_b"],
                         kc, vc, t, nh, eps)
-                    h = _stacked_mlp(p, y.reshape(mb, 1, H), eps)
+                    y3 = y.reshape(mb, 1, H)
+                    h = _stacked_mlp_fused_decode(p, y3, eps)
+                    if h is None:
+                        h = _stacked_mlp(p, y3, eps)
                     outs += [kc2, vc2]
                     continue
 
@@ -638,7 +711,17 @@ class GPTModel(Layer):
         self.ln_f = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
 
     def forward(self, input_ids, position_ids=None, caches=None,
-                time_step=None):
+                time_step=None, segment_ids=None):
+        """segment_ids: optional [B, S] packed-sequence ids (stacked-blocks
+        training path; see GPTStackedBlocks.block_closure). For packed
+        batches also pass position_ids that restart at each document
+        boundary — the standard packed pretraining format."""
+        if segment_ids is not None and (caches is not None
+                                        or not self.cfg.stacked_blocks):
+            raise NotImplementedError(
+                "segment_ids are supported on the stacked-blocks training "
+                "path (no KV-cache decode); packed decoding is not a "
+                "standard inference shape")
         if caches is not None and position_ids is None:
             # decode positions are absolute: time_step + [0, s)
             s = input_ids.shape[-1]
@@ -675,7 +758,12 @@ class GPTModel(Layer):
             x = apply(lambda a: jnp.take(a, jnp.asarray(perm), axis=1), x,
                       name="zigzag_permute")
         if self.cfg.stacked_blocks:
-            x = self.blocks(x)
+            seg_arr = None
+            if segment_ids is not None:
+                seg_arr = (segment_ids._data if isinstance(segment_ids, Tensor)
+                           else jnp.asarray(segment_ids))
+                seg_arr = jnp.asarray(seg_arr, jnp.int32)
+            x = self.blocks(x, segment_ids=seg_arr)
         else:
             for blk in self.h:
                 x = blk(x)
@@ -728,12 +816,15 @@ class GPTForCausalLM(Layer):
         self._gen_step = None       # (shapes key, jitted fn) decode cache
 
     def forward(self, input_ids, position_ids=None, caches=None,
-                time_step=None):
+                time_step=None, segment_ids=None):
         if caches is not None:
+            # segment_ids forwarded so GPTModel's loud guard fires instead
+            # of silently decoding across document boundaries
             x, new_caches = self.gpt(input_ids, position_ids, caches=caches,
-                                     time_step=time_step)
+                                     time_step=time_step,
+                                     segment_ids=segment_ids)
         else:
-            x = self.gpt(input_ids, position_ids)
+            x = self.gpt(input_ids, position_ids, segment_ids=segment_ids)
         w = self.gpt.embeddings.word_embeddings.weight
         logits = apply(
             lambda a, wt: jnp.einsum("bsh,vh->bsv", a, wt), x, w,
@@ -746,7 +837,8 @@ class GPTForCausalLM(Layer):
             return logits, new_caches
         return logits
 
-    def pretrain_loss(self, input_ids, labels, loss_mask=None):
+    def pretrain_loss(self, input_ids, labels, loss_mask=None,
+                      segment_ids=None, position_ids=None):
         """Causal-LM training loss honoring cfg.pp_schedule.
 
         Under pp>1 with pp_schedule="1f1b" the blocks, final norm, LM head,
@@ -763,7 +855,13 @@ class GPTForCausalLM(Layer):
         if not (cfg.stacked_blocks and cfg.pp_schedule == "1f1b"
                 and axis_size("pp") > 1):
             crit = GPTPretrainingCriterion(cfg)
-            return crit(self(input_ids), labels, loss_mask)
+            return crit(self(input_ids, position_ids,
+                             segment_ids=segment_ids), labels, loss_mask)
+        if segment_ids is not None or position_ids is not None:
+            raise NotImplementedError(
+                "packed segment_ids / custom position_ids with the fused "
+                "1F1B pipeline are not supported yet; use dp/mp/sharding "
+                "axes")
 
         blocks = self.gpt.blocks
         names = blocks._names

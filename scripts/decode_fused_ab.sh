@@ -15,6 +15,7 @@ run() {
 
 run decode_base           python bench.py --config gpt124m_decode
 run decode_fused          env PTPU_FUSED_DECODE=1 python bench.py --config gpt124m_decode
+run decode_fused_mlp      env PTPU_FUSED_DECODE=1 PTPU_PALLAS_FFN=1 PTPU_PALLAS_LN=1 python bench.py --config gpt124m_decode
 run decode_fused_long     env PTPU_FUSED_DECODE=1 PTPU_DECODE_BENCH_PROMPT=1024 \
                               PTPU_DECODE_BENCH_NEW=256 python bench.py --config gpt124m_decode
 run decode_base_long      env PTPU_DECODE_BENCH_PROMPT=1024 \

@@ -104,6 +104,41 @@ def test_fused_decode_gate_counts(monkeypatch):
                                          a["vc"], H)   # default off
 
 
+def test_generate_parity_fused_with_mlp_kernels(monkeypatch):
+    """B=8 decode rides the fused attention layer AND the fused LN/FFN
+    MLP half (rows%8==0 geometry); tokens must still match the default
+    path exactly."""
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt_test_config
+
+    def run(fused):
+        if fused:
+            monkeypatch.setenv("PTPU_FUSED_DECODE", "1")
+            monkeypatch.setenv("PTPU_PALLAS_FFN", "1")
+        else:
+            monkeypatch.delenv("PTPU_FUSED_DECODE", raising=False)
+            monkeypatch.delenv("PTPU_PALLAS_FFN", raising=False)
+        paddle.seed(9)
+        cfg = gpt_test_config(num_hidden_layers=2, stacked_blocks=True,
+                              hidden_size=256, intermediate_size=512,
+                              num_attention_heads=4,
+                              max_position_embeddings=512)
+        m = GPTForCausalLM(cfg)
+        m.eval()
+        ids = paddle.to_tensor(
+            np.tile(np.arange(1, 6, dtype=np.int32), (8, 1)) +
+            np.arange(8, dtype=np.int32)[:, None])
+        return m.generate(ids, max_new_tokens=5).numpy()
+
+    monkeypatch.setenv("PTPU_ATTN_DEBUG", "1")
+    ref = run(False)
+    po.reset_attention_path_counts()
+    got = run(True)
+    counts = po.attention_path_counts()
+    assert counts.get("fused_decode_kernel", 0) >= 1
+    assert counts.get("ffn_kernel", 0) >= 1, counts   # MLP half engaged
+    np.testing.assert_array_equal(got, ref)
+
+
 def test_generate_parity_fused_vs_default(monkeypatch):
     from paddle_tpu.models.gpt import GPTForCausalLM, gpt_test_config
 
