@@ -12,12 +12,17 @@ transform rewrites exactly those into convert_* helper calls
 (convert_operators.py) that keep bit-identical Python semantics for
 Python predicates and stage lax control flow for traced ones.
 
-Convertible region rule: an `if`/`while`/`for range()` whose body binds
-only names (no early return/break/continue, no attribute/subscript
-stores, no global/nonlocal/del/try/with/yield) is rewritten. Anything
-else keeps its Python form with the predicate wrapped in py_cond_guard —
-working unchanged for Python predicates, raising a source-located
-Dy2StaticError for traced ones.
+Convertible region rule: an `if`/`while`/`for` whose body binds only
+names (no early return, no attribute/subscript stores, no
+global/nonlocal/del/try/with/yield, no statement-position mutating
+method calls) is rewritten. Loop `break`/`continue` lower to carried
+early-exit flags (a for-range with break becomes an index-carrying
+while); `for` over tensors/arrays/numeric sequences — plain, enumerate,
+or zip — rewrites to a runtime dual form (indexed loop when indexable,
+original Python loop otherwise). Anything else keeps its Python form
+with the predicate wrapped in py_cond_guard — working unchanged for
+Python predicates, raising a source-located Dy2StaticError for traced
+ones.
 """
 from __future__ import annotations
 
@@ -397,7 +402,8 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
     def _lower_loop_flags(self, node):
         """Lower this loop's break/continue into early-exit flags (body and,
         for break, the while test are rewritten in place). Returns the flag
-        initializer statements to emit before the loop."""
+        initializer statements to emit before the loop and the break flag
+        name (None when the loop has no break)."""
         has_brk, has_cnt = _loop_bc_kinds(node.body)
         n = self._next()
         # single leading underscore on purpose: unlike __ptpu_ temporaries,
@@ -416,23 +422,67 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
             node.test = ast.BoolOp(op=ast.And(), values=[
                 ast.UnaryOp(op=ast.Not(), operand=_name(brk)), node.test])
             inits.append(_assign_name(brk, _const(False)))
-        return inits
+        return inits, (brk if has_brk else None)
+
+    def _detach_orelse(self, node):
+        """Take a convertible loop `else` off the node: python runs it iff
+        the loop exits WITHOUT break, which the lowered break flag
+        expresses directly as a post-loop `if not brk:` (no flag -> the
+        else always runs). Returns the statements or None (unconvertible
+        else: caller keeps the guarded python form)."""
+        if not node.orelse:
+            return []
+        if _conversion_blocker(node.orelse) is not None:
+            return None
+        stmts = node.orelse
+        node.orelse = []
+        return stmts
+
+    def _emit_orelse(self, orelse_stmts, brk):
+        """Post-loop else statements (visited), guarded by the break flag
+        when one exists."""
+        if not orelse_stmts:
+            return []
+        if brk is not None:
+            stmt = ast.If(test=ast.UnaryOp(op=ast.Not(), operand=_name(brk)),
+                          body=orelse_stmts, orelse=[])
+            out = self.visit(stmt)
+        else:
+            out = [self.visit(s) for s in orelse_stmts]
+        flat = []
+        for o in (out if isinstance(out, list) else [out]):
+            flat.extend(o if isinstance(o, list) else [o])
+        return flat
+
+    def _reattach_orelse(self, node, orelse_stmts, brk):
+        """Put a detached else back onto a loop that stays python-form
+        (visited; flag-guarded when a break was lowered away)."""
+        if orelse_stmts:
+            node.orelse = self._emit_orelse(orelse_stmts, brk)
 
     def visit_While(self, node):
-        inits = []
-        if (not node.orelse and any(_loop_bc_kinds(node.body))
-                and _conversion_blocker(node.body, allow_bc=True) is None):
-            inits = self._lower_loop_flags(node)
+        inits, brk = [], None
+        lowerable = _conversion_blocker(node.body, allow_bc=True) is None
+        orelse_stmts = self._detach_orelse(node) if lowerable else None
+        # an UNCONVERTIBLE else (detach -> None) keeps the whole loop
+        # python-form: do NOT lower break/continue then — the python else
+        # must still see the real break, and the guarded form would
+        # reference flag names whose initializers are never emitted
+        if (lowerable and orelse_stmts is not None
+                and any(_loop_bc_kinds(node.body))):
+            inits, brk = self._lower_loop_flags(node)
         self.generic_visit(node)
-        if node.orelse:
+        if node.orelse:   # unconvertible (or un-detached) else: python form
             return self._guarded(node, "the loop has an `else` clause",
                                  "while")
         blocker = _conversion_blocker(node.body)
         if blocker:
+            self._reattach_orelse(node, orelse_stmts, brk)
             guarded = self._guarded(node, blocker, "while")
             return inits + [guarded] if inits else guarded
         names = sorted(_assigned_names(node.body))
         if not names:
+            self._reattach_orelse(node, orelse_stmts, brk)
             return self._guarded(
                 node, "the loop body binds no variables (nothing to "
                 "carry through a staged loop)", "while")
@@ -458,7 +508,7 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
             out.append(_unpack_stmt(names, call))
         else:
             out.append(ast.Expr(value=call))
-        return inits + out
+        return inits + out + self._emit_orelse(orelse_stmts, brk)
 
     def visit_For(self, node):
         if getattr(node, "_ptpu_python", False):
@@ -476,19 +526,30 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
                     and isinstance(node.target, ast.Name))
         if not is_range:
             return self._convert_iterable_for(node)
-        if (not node.orelse and any(_loop_bc_kinds(node.body))
-                and _conversion_blocker(node.body, allow_bc=True) is None):
+        if (any(_loop_bc_kinds(node.body))
+                and _conversion_blocker(node.body, allow_bc=True) is None
+                and (not node.orelse
+                     or _conversion_blocker(node.orelse) is None)):
             # break/continue need an early-exit cond: rewrite the range
-            # loop as an index-carrying while, whose flag lowering and
-            # staging the while machinery already handles
+            # loop as an index-carrying while, whose flag lowering,
+            # staging, and else handling the while machinery provides
             return self._for_range_as_while(node)
         self.generic_visit(node)
+        orelse_stmts = []
         if node.orelse:
-            return node   # python for: unrolls under trace, fine as-is
+            if (_conversion_blocker(node.orelse) is not None
+                    or _conversion_blocker(node.body) is not None):
+                return node   # python for: unrolls under trace, fine as-is
+            # no break in the body (that took the while path), so the
+            # else ALWAYS runs — plain statements after the loop
+            # (children already visited by generic_visit above)
+            orelse_stmts = node.orelse
+            node.orelse = []
         blocker = _conversion_blocker(node.body)
         if blocker:
             # range() loop we cannot stage: keep python; range() itself
             # raises on tracer args, so no silent mis-trace is possible
+            node.orelse = orelse_stmts
             return node
         n = self._next()
         # the loop target stays bound after the loop (python semantics),
@@ -518,7 +579,7 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
             out.append(_unpack_stmt(names, call))
         else:
             out.append(ast.Expr(value=call))
-        return out
+        return out + orelse_stmts
 
     def _for_range_as_while(self, node):
         """`for t in range(a, b, c)` containing break/continue ->
@@ -571,7 +632,8 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
             _assign_name(it, ast.BinOp(left=_name(it), op=ast.Add(),
                                        right=_name(stp))),
         ] + node.body
-        wl = ast.While(test=test, body=body, orelse=[])
+        wl = ast.While(test=test, body=body, orelse=node.orelse)
+        ast.copy_location(wl, node)     # guards read .lineno
         wl._ptpu_bound_name = bnd
         out = self.visit_While(wl)
         return inits + (out if isinstance(out, list) else [out])
@@ -585,8 +647,9 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
         (generators, dicts, files keep exact Python semantics).
         Reference analog: loop_transformer.py tensor iteration +
         convert_operators convert_len/convert_zip/convert_enumerate."""
-        if (node.orelse
-                or _conversion_blocker(node.body, allow_bc=True) is not None
+        if (_conversion_blocker(node.body, allow_bc=True) is not None
+                or (node.orelse
+                    and _conversion_blocker(node.orelse) is not None)
                 # each dual form emits the body twice (python + indexed), so
                 # unbounded nesting would grow generated code 2^depth; past
                 # the cap, inner iterable loops stay python (they unroll
@@ -648,15 +711,19 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
         # python branch keeps the ORIGINAL body (deep-copied before the
         # indexed branch shares the nodes)
         self.dual_depth += 1
+        # python fallback keeps the natural for/else; the indexed branch
+        # threads the else through the range/while machinery (flag-guarded
+        # after a lowered break)
         fallback = ast.For(target=copy.deepcopy(node.target), iter=fb_iter,
-                           body=copy.deepcopy(node.body), orelse=[])
+                           body=copy.deepcopy(node.body),
+                           orelse=copy.deepcopy(node.orelse))
         fallback._ptpu_python = True
         fallback = self.visit_For(fallback)
         indexed = ast.For(
             target=_name(i_name, ast.Store()),
             iter=ast.Call(func=_name("range"), args=[length], keywords=[]),
             body=[ast.Assign(targets=[node.target], value=elem)] + node.body,
-            orelse=[])
+            orelse=node.orelse)
         conv = self.visit_For(indexed)
         conv = conv if isinstance(conv, list) else [conv]
         self.dual_depth -= 1

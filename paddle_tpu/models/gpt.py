@@ -857,18 +857,18 @@ class GPTForCausalLM(Layer):
             crit = GPTPretrainingCriterion(cfg)
             return crit(self(input_ids, position_ids,
                              segment_ids=segment_ids), labels, loss_mask)
-        if segment_ids is not None or position_ids is not None:
+        if segment_ids is not None:
             raise NotImplementedError(
-                "packed segment_ids / custom position_ids with the fused "
-                "1F1B pipeline are not supported yet; use dp/mp/sharding "
-                "axes")
+                "packed segment_ids with the fused 1F1B pipeline are not "
+                "supported yet (the id rows would need to split with the "
+                "activation microbatches); use dp/mp/sharding axes")
 
         blocks = self.gpt.blocks
         names = blocks._names
         block = blocks.block_closure()
         n_micro = cfg.pp_num_microbatches or None
         eps = cfg.layer_norm_epsilon
-        x = self.gpt.embeddings(input_ids)
+        x = self.gpt.embeddings(input_ids, position_ids)
         wte = self.gpt.embeddings.word_embeddings.weight
         lnw, lnb = self.gpt.ln_f.weight, self.gpt.ln_f.bias
         has_mask = loss_mask is not None

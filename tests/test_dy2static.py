@@ -407,6 +407,84 @@ class TestBreakContinue:
         for v in ([1.0], [0.1]):
             np.testing.assert_allclose(c(_t(v)).numpy(), f(_t(v)).numpy())
 
+    def test_loop_else_runs_iff_no_break(self):
+        """while/for `else` converts: runs exactly when the loop exits
+        without break (the lowered flag expresses it directly)."""
+        def f(x, thresh):
+            y = x
+            found = x * 0.0
+            for i in range(6):
+                y = y * 2.0
+                if y.sum() > thresh:
+                    found = found + 1.0
+                    break
+            else:
+                found = found - 1.0      # only when no break fired
+            return y, found
+
+        c = jit.compile(f, train=False)
+        for v, th in (([1.0], 5.0), ([1.0], 1e6)):
+            a = c(_t(v), th)
+            b = f(_t(v), th)
+            np.testing.assert_allclose(a[0].numpy(), b[0].numpy())
+            np.testing.assert_allclose(a[1].numpy(), b[1].numpy())
+
+    def test_while_else_no_break(self):
+        def f(x):
+            s = x.sum()
+            while s > 1.0:
+                s = s / 2.0
+            else:
+                s = s + 100.0            # always runs (no break)
+            return s
+
+        c = jit.compile(f, train=False)
+        for v in ([8.0], [0.5]):
+            np.testing.assert_allclose(c(_t(v)).numpy(), f(_t(v)).numpy())
+
+    def test_unconvertible_else_keeps_python_semantics(self):
+        """A loop whose `else` cannot stage (attribute store) keeps the
+        FULL python form — the break must remain a real break and the
+        else must still run iff no break (regression: the flag lowering
+        once ran anyway, emitting unbound flag references)."""
+        class Box:
+            val = 0.0
+
+        box = Box()
+
+        def f(x, n):
+            s = 0.0
+            for i in range(5):
+                if i >= n:          # python predicate: stays python
+                    break
+                s = s + 1.0
+            else:
+                box.val = box.val + 1.0
+            return x + s
+
+        g = convert_to_static(f)
+        np.testing.assert_allclose(g(_t([0.0]), 3).numpy(), [3.0])
+        assert box.val == 0.0       # break fired: else skipped
+        np.testing.assert_allclose(g(_t([0.0]), 99).numpy(), [5.0])
+        assert box.val == 1.0       # no break: else ran once
+
+        # while + unconvertible else, same contract
+        def h(x, lim):
+            s = 0.0
+            while s < 4.0:
+                if s >= lim:
+                    break
+                s = s + 1.0
+            else:
+                box.val = box.val + 10.0
+            return x + s
+
+        gh = convert_to_static(h)
+        np.testing.assert_allclose(gh(_t([0.0]), 2.0).numpy(), [2.0])
+        assert box.val == 1.0       # break fired: else skipped
+        np.testing.assert_allclose(gh(_t([0.0]), 99.0).numpy(), [4.0])
+        assert box.val == 11.0
+
     def test_sampling_loop_break_on_eos(self):
         """The GPT-style sampling shape: append-free greedy loop with a
         traced break on EOS compiles and matches eager."""
@@ -503,6 +581,25 @@ class TestIterableFor:
 
         gh = convert_to_static(h)
         np.testing.assert_allclose(gh(_t([2.0])).numpy(), h(_t([2.0])).numpy())
+
+    def test_tensor_iteration_search_with_else(self):
+        """The classic search loop: enumerate over a tensor, break on hit,
+        for/else marks not-found — the full composition stages."""
+        def f(xs, limit):
+            hit = xs[0] * 0.0 - 1.0
+            for i, v in enumerate(xs):
+                if v.sum() > limit:
+                    hit = v.sum()
+                    break
+            else:
+                hit = hit - 99.0
+            return hit
+
+        c = jit.compile(f, train=False)
+        xs = _t([[1.0], [5.0], [9.0]])
+        for lim in (4.0, 100.0):
+            np.testing.assert_allclose(c(xs, lim).numpy(),
+                                       f(xs, lim).numpy())
 
     def test_tensor_iteration_with_break(self):
         def f(x):
