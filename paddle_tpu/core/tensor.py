@@ -19,7 +19,13 @@ import jax.numpy as jnp
 
 from .dtype import convert_dtype, is_floating_point
 
-__all__ = ["Tensor", "to_tensor"]
+__all__ = ["Tensor", "to_tensor", "TracedValueError"]
+
+
+class TracedValueError(TypeError):
+    """A traced tensor was used where a concrete Python value is required
+    (float()/int()/bool()/.item() under jit). Subclasses TypeError so
+    generic numeric-coercion handlers keep working."""
 
 
 class Tensor:
@@ -104,6 +110,16 @@ class Tensor:
     # -- host transfer -----------------------------------------------------
     def numpy(self):
         a = self._data
+        if isinstance(a, jax.core.Tracer):
+            raise TracedValueError(
+                "this Tensor is a TRACED value (inside jit / staged "
+                "control flow), so a concrete host value is not "
+                "available to numpy()/item()/float()/int()/bool()/"
+                "tolist(). Values carried out of staged loops or "
+                "branches (e.g. a loop index after a converted `break` "
+                "loop) are tensors — keep them in tensor arithmetic, or "
+                "restructure so the concrete use happens outside the "
+                "traced region.")
         if (hasattr(a, "is_fully_addressable")
                 and not a.is_fully_addressable
                 and (not getattr(a, "is_fully_replicated", False)
@@ -128,7 +144,7 @@ class Tensor:
         return self.numpy().tolist()
 
     def __array__(self, dtype=None):
-        arr = np.asarray(self._data)
+        arr = self.numpy()
         return arr.astype(dtype) if dtype is not None else arr
 
     def __float__(self):

@@ -26,7 +26,7 @@ import os
 import jax
 import jax.numpy as jnp
 
-from ...core.tensor import Tensor
+from ...core.tensor import Tensor, TracedValueError
 from ...core.dispatch import apply, unwrap
 
 __all__ = [
@@ -319,7 +319,8 @@ def convert_while(cond_fn, body_fn, init_vals, names, bound=None):
         out = while_loop(lambda *vs: cond_fn(tuple(vs)),
                          lambda *vs: tuple(body_fn(tuple(vs))),
                          list(vals), maximum_trip_count=max_trip)
-    except (jax.errors.ConcretizationTypeError,
+    except (TracedValueError,
+            jax.errors.ConcretizationTypeError,
             jax.errors.TracerArrayConversionError,
             jax.errors.TracerBoolConversionError,
             jax.errors.TracerIntegerConversionError) as e:

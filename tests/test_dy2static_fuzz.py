@@ -88,19 +88,46 @@ class _Gen:
                 self.block(depth + 1, self.r.randint(1, 3)))
         return out
 
+    def _maybe_bc(self, body):
+        """Randomly inject a conditional break/continue (the round-4
+        lowering surface). The energy decrement always precedes it, so
+        `continue` cannot make a while spin."""
+        if self.r.rand() >= 0.4:
+            return False
+        thresh = round(float(self.r.uniform(0.0, 1.0)), 3)
+        kw = "break" if self.r.rand() < 0.6 else "continue"
+        body.append(f"if {self.var()}.mean().abs() > {thresh}:")
+        body.append(f"    {kw}")
+        body.append(self.stmt())   # skipped by continue / dead after break
+        return True
+
+    def _maybe_else(self, out, depth):
+        if self.r.rand() < 0.3:
+            out += ["else:"] + self._indent(self.block(depth + 1, 1))
+
     def while_block(self, depth):
         # strictly-decreasing energy guarantees termination; the energy
         # var is protected so nested statements cannot reassign it
         w = self.target()
         self.protected.add(w)
         body = [f"{w} = {w} * 0.5"] + self.block(depth + 1, 1)
-        return [f"while ({w} * {w}).sum() > 0.3:"] + self._indent(body)
+        self._maybe_bc(body)
+        out = [f"while ({w} * {w}).sum() > 0.3:"] + self._indent(body)
+        self._maybe_else(out, depth)
+        return out
 
     def for_block(self, depth):
         i_used = self.target()
         body = self.block(depth + 1, self.r.randint(1, 3))
-        body.append(f"{i_used} = {i_used} + float(i) * 0.1")
-        return [f"for i in range({self.r.randint(1, 4)}):"] + self._indent(body)
+        if self._maybe_bc(body):
+            # a break stages the loop, making `i` a traced carry: the
+            # increment must not need a concrete python int
+            body.append(f"{i_used} = {i_used} + 0.1")
+        else:
+            body.append(f"{i_used} = {i_used} + float(i) * 0.1")
+        out = [f"for i in range({self.r.randint(1, 4)}):"] + self._indent(body)
+        self._maybe_else(out, depth)
+        return out
 
     def program(self):
         self.n_vars = 0
@@ -136,6 +163,7 @@ def test_random_program_parity(seed):
     exec(compile(textwrap.dedent(src), fname, "exec"), ns)  # noqa: S102
     f = ns["f"]
     compiled = jit.compile(f, train=False)
+    from paddle_tpu.core.tensor import TracedValueError
     from paddle_tpu.jit.dy2static import Dy2StaticError
 
     for input_seed in (0, 1, 2):
@@ -144,9 +172,10 @@ def test_random_program_parity(seed):
         want = f(paddle.to_tensor(x_np))
         try:
             got = compiled(paddle.to_tensor(x_np))
-        except Dy2StaticError:
-            # legitimately unconvertible draw (e.g. return inside a
-            # tensor loop): the loud error IS the contract
+        except (Dy2StaticError, TracedValueError):
+            # legitimately unconvertible draw (return inside a tensor
+            # loop; float(i) on an index a staged sibling loop turned
+            # into a tensor): the loud, typed error IS the contract
             return
         np.testing.assert_allclose(
             np.asarray(got.numpy(), np.float32),
