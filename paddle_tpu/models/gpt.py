@@ -477,14 +477,11 @@ class GPTStackedBlocks(Layer):
             cfg.context_parallel and axis_size("sp") > 1 and axis_size("pp") <= 1
         )
 
-        if use_ring and segment_ids is not None:
-            raise NotImplementedError(
-                "packed segment_ids are not supported with ring "
-                "context-parallel attention yet; run packed batches with "
-                "sp=1 (full-sequence flash)")
         if use_ring and _zigzag_active(cfg):
             from functools import partial as _partial
 
+            # segment ids arrive already zigzag-permuted with the token
+            # stream (GPTModel.forward permutes both with one gather)
             attn = _partial(ring_attention_arrays, layout="zigzag_pre")
         elif use_ring:
             attn = ring_attention_arrays
@@ -763,6 +760,10 @@ class GPTModel(Layer):
                 seg_arr = (segment_ids._data if isinstance(segment_ids, Tensor)
                            else jnp.asarray(segment_ids))
                 seg_arr = jnp.asarray(seg_arr, jnp.int32)
+                if zig:
+                    # ids follow the token stream into zigzag order (the
+                    # zigzag_pre ring expects them pre-permuted)
+                    seg_arr = jnp.take(seg_arr, jnp.asarray(perm), axis=1)
             x = self.blocks(x, segment_ids=seg_arr)
         else:
             for blk in self.h:
