@@ -78,7 +78,9 @@ class Plan:
     sharding: int = 1
     pp: int = 1
     mp: int = 1
+    sp: int = 1                  # context parallel (ring attention)
     microbatches: int = 1
+    recompute: bool = True       # per-block activation remat
     est_step_time: float = float("inf")
     est_memory: float = float("inf")
     breakdown: dict = dataclasses.field(default_factory=dict)
@@ -87,7 +89,7 @@ class Plan:
 
     def mesh_kwargs(self):
         return dict(dp=self.dp, sharding=self.sharding, pp=self.pp,
-                    mp=self.mp)
+                    mp=self.mp, sp=self.sp)
 
 
 def _divisors(n):
@@ -115,9 +117,10 @@ class OptimizationTuner:
     # -- analytical roofline -------------------------------------------------
     def estimate(self, plan: Plan) -> Plan:
         m, c = self.model, self.cluster
-        dp, sh, pp, mp = plan.dp, plan.sharding, plan.pp, plan.mp
+        dp, sh, pp, mp, sp = (plan.dp, plan.sharding, plan.pp, plan.mp,
+                              plan.sp)
         M = plan.microbatches
-        n_dev = dp * sh * pp * mp
+        n_dev = dp * sh * pp * mp * sp
 
         # divisibility pruning
         if n_dev != c.n_devices:
@@ -129,6 +132,11 @@ class OptimizationTuner:
         if m.hidden % mp or (m.heads and m.heads % mp):
             return dataclasses.replace(plan, feasible=False,
                                        reason="hidden/heads % mp")
+        if sp > 1 and (m.seq_len % (2 * sp) or pp > 1):
+            # ring attention shards the sequence (zigzag wants 2*sp
+            # divisibility); it does not compose with pp stages
+            return dataclasses.replace(plan, feasible=False,
+                                       reason="seq % 2*sp or sp with pp")
         repl = dp * sh  # data-consuming ways
         if m.global_batch % (repl * M):
             return dataclasses.replace(plan, feasible=False,
@@ -138,10 +146,12 @@ class OptimizationTuner:
         P = m.n_params
         B = m.dtype_bytes
 
-        # compute: 6N dense + attention quadratic term, fwd+bwd
-        flops = 6.0 * P * tokens
-        flops += (12.0 * m.n_layers * m.seq_len * m.hidden
-                  * tokens)  # QK^T + PV fwd+bwd
+        # compute: 6N dense + attention quadratic term, fwd+bwd; remat
+        # re-runs the forward inside the backward (8N instead of 6N)
+        dense = (8.0 if plan.recompute else 6.0) * P * tokens
+        attn_q = ((16.0 if plan.recompute else 12.0)
+                  * m.n_layers * m.seq_len * m.hidden * tokens)
+        flops = dense + attn_q
         t_comp = flops / (n_dev * c.peak_flops * c.target_mfu)
 
         # per-device parameter shard (mp and pp partition the weights;
@@ -152,19 +162,31 @@ class OptimizationTuner:
         # DCN when it is the outermost multi-host axis, sharding rides ICI
         t_dp = 0.0
         if dp > 1:
-            bw = c.dcn_bandwidth if dp * sh * pp * mp > 8 else c.ici_bandwidth
+            bw = c.dcn_bandwidth if n_dev > 8 else c.ici_bandwidth
             t_dp = 2 * (dp - 1) / dp * p_shard * B / bw
         if sh > 1:
             # reduce-scatter grads + all-gather updated params
             t_dp += 2 * (sh - 1) / sh * p_shard * B / c.ici_bandwidth
+        if sp > 1:
+            # sp ranks hold FULL weight grads (only the sequence is
+            # sharded), so gradients also all-reduce across sp
+            t_dp += 2 * (sp - 1) / sp * p_shard * B / c.ici_bandwidth
         t_dp *= 0.3  # most of it overlaps the backward (XLA LHS)
 
         # mp axis: 4 activation all-reduces per layer (2 fwd + 2 bwd),
         # activation tensor is the per-device micro-batch slice
         t_mp = 0.0
+        act_loc = (m.global_batch / repl / M) * (m.seq_len / sp) \
+            * m.hidden * B
         if mp > 1:
-            act = (m.global_batch / repl / M) * m.seq_len * m.hidden * B
-            t_mp = (m.n_layers / pp) * 4 * 2 * (mp - 1) / mp * act \
+            t_mp = (m.n_layers / pp) * 4 * 2 * (mp - 1) / mp * act_loc \
+                / c.ici_bandwidth * M
+        if sp > 1:
+            # ring attention: per layer the local K and V shards make
+            # (sp-1) ICI hops each (fwd + bwd ~2x). The hopped shards are
+            # heads/mp wide — unlike the mp all-reduce (full hidden), the
+            # ring moves only this device's K/V slice
+            t_mp += (m.n_layers / pp) * 2 * 2 * (sp - 1) * (act_loc / mp) \
                 / c.ici_bandwidth * M
 
         # pp bubble stretches the whole step
@@ -173,16 +195,17 @@ class OptimizationTuner:
             / (1 - bubble) + self.calib_comm * t_dp
 
         # memory: params + grads (bf16) over pp*mp; optimizer state
-        # additionally over 'sharding' (ZeRO); activations with remat,
+        # additionally over 'sharding' (ZeRO); activations (seq sharded
+        # over sp; ~6 live tensors/layer with remat, ~14 without);
         # 1F1B keeps <= pp micro-batches in flight
         mem = p_shard * B                      # params
         mem += p_shard * B                     # grads
         mem += p_shard * m.optimizer_state_bytes / sh
-        act_layer = (m.global_batch / repl / M) * m.seq_len * m.hidden \
-            * B * 6  # remat checkpoints: ~6 tensors/layer live
+        act_layer = act_loc * (6 if plan.recompute else 14)
         live_mb = min(pp, M) if pp > 1 else 1
         mem += act_layer * (m.n_layers / pp) * live_mb / mp
-        mem += (m.global_batch / repl / M) * m.seq_len * m.vocab * B / mp
+        mem += (m.global_batch / repl / M) * (m.seq_len / sp) \
+            * m.vocab * B / mp
 
         feasible = mem <= 0.9 * c.hbm_bytes
         return dataclasses.replace(
@@ -197,11 +220,18 @@ class OptimizationTuner:
         out = []
         for mp in _divisors(n):
             for pp in _divisors(n // mp):
-                for sh in _divisors(n // (mp * pp)):
-                    dp = n // (mp * pp * sh)
-                    for mb in {1, pp, 2 * pp, 4 * pp} - {0}:
-                        out.append(Plan(dp=dp, sharding=sh, pp=pp, mp=mp,
-                                        microbatches=max(1, mb)))
+                for sp in _divisors(n // (mp * pp)):
+                    if sp > 1 and (pp > 1
+                                   or self.model.seq_len % (2 * sp)):
+                        continue   # pruned in estimate anyway; skip early
+                    for sh in _divisors(n // (mp * pp * sp)):
+                        dp = n // (mp * pp * sp * sh)
+                        for mb in {1, pp, 2 * pp, 4 * pp} - {0}:
+                            for rc in (True, False):
+                                out.append(Plan(
+                                    dp=dp, sharding=sh, pp=pp, mp=mp,
+                                    sp=sp, microbatches=max(1, mb),
+                                    recompute=rc))
         return out
 
     def tune(self, top_k: int = 5, measure: bool = False,
@@ -373,14 +403,17 @@ class OptimizationTuner:
         prior_mesh = get_mesh()  # restored after trials — tune() must not
         measured = []            # leave the user's mesh on a trial config
         for plan in plans:
-            if plan.dp * plan.sharding * plan.pp * plan.mp > len(jax.devices()):
+            if (plan.dp * plan.sharding * plan.pp * plan.mp * plan.sp
+                    > len(jax.devices())):
                 measured.append(plan)
                 continue
             try:
                 init_mesh(**plan.mesh_kwargs())
                 cfg = gpt_test_config(
                     num_hidden_layers=max(2, plan.pp), stacked_blocks=True,
-                    pp_num_microbatches=plan.microbatches)
+                    pp_num_microbatches=plan.microbatches,
+                    context_parallel=plan.sp > 1,
+                    recompute=plan.recompute)
                 model = place_model(GPTForCausalLM(cfg))
                 crit = GPTPretrainingCriterion(cfg)
                 opt = AdamW(learning_rate=1e-4, parameters=model.parameters())

