@@ -164,3 +164,42 @@ def test_generate_parity_fused_vs_default(monkeypatch):
     got = run(True)
     assert po.attention_path_counts().get("fused_decode_kernel", 0) >= 1
     np.testing.assert_array_equal(got, ref)
+
+
+@pytest.mark.parametrize("layout", ["reference", "flat"])
+def test_fused_multi_transformer_decode_parity(monkeypatch, layout):
+    """FusedMultiTransformer (the reference fused_multi_transformer_op
+    analog) routes its decode steps through the fused per-layer kernel
+    under the flag; prefill + 3 decode steps match the default path, in
+    both the reference cache layout and the TPU-native flat rings (which
+    skip the per-step relayout and donate buffers in place)."""
+    from paddle_tpu.incubate.nn import FusedMultiTransformer
+
+    def run(fused):
+        if fused:
+            monkeypatch.setenv("PTPU_FUSED_DECODE", "1")
+        else:
+            monkeypatch.delenv("PTPU_FUSED_DECODE", raising=False)
+        paddle.seed(8)
+        m = FusedMultiTransformer(256, 4, 512, num_layers=2)
+        m.eval()
+        B, Smax = 4, 256
+        caches = m.gen_cache(B, Smax, layout=layout)
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(B, 5, 256).astype("float32") * 0.3)
+        _, caches = m(x, caches=caches, time_step=None)
+        outs, t = [], 5
+        for _ in range(3):
+            step = paddle.to_tensor(rs.randn(B, 1, 256).astype("float32") * 0.3)
+            y, caches = m(step, caches=caches,
+                          time_step=paddle.to_tensor(np.int32(t)))
+            outs.append(y.numpy())
+            t += 1
+        return np.stack(outs)
+
+    monkeypatch.setenv("PTPU_ATTN_DEBUG", "1")
+    ref = run(False)
+    po.reset_attention_path_counts()
+    got = run(True)
+    assert po.attention_path_counts().get("fused_decode_kernel", 0) >= 1
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
