@@ -323,7 +323,7 @@ class GPTMoEMLP(Layer):
         return out
 
 
-def _cached_attn_arrays(q, k, v, kc, vc, t, prefill):
+def _cached_attn_arrays(q, k, v, kc, vc, t, prefill, cache_mask=None):
     """Array-level prefill/decode cached-attention dispatch — the single
     source of truth for every cached forward path (per-layer GPTAttention,
     the stacked scan, and the unrolled decode). At STATIC prefill
@@ -331,7 +331,11 @@ def _cached_attn_arrays(q, k, v, kc, vc, t, prefill):
     so causal flash attention over the chunk plus the cache write is exact
     and skips the O(S * S_max) masked path; decode defers to
     cached_attention_arrays (reference CacheKV semantics:
-    fused_multi_transformer_op.cu:90)."""
+    fused_multi_transformer_op.cu:90).
+
+    cache_mask: optional additive [B, 1, 1, S_max] over CACHE positions
+    (padded-prompt batches: -inf at a row's pad slots) — applied at
+    prefill over the chunk's keys and at every decode step."""
     if prefill:
         from ..ops.pallas_ops import flash_attention_arrays
 
@@ -343,8 +347,15 @@ def _cached_attn_arrays(q, k, v, kc, vc, t, prefill):
         origin = (0,) * kc.ndim
         kc2 = jax.lax.dynamic_update_slice(kc, kw.astype(kc.dtype), origin)
         vc2 = jax.lax.dynamic_update_slice(vc, vw.astype(vc.dtype), origin)
-        return flash_attention_arrays(q, k, v, is_causal=True), kc2, vc2
-    return cached_attention_arrays(q, k, v, kc, vc, t)
+        m = None
+        if cache_mask is not None:
+            sq = q.shape[1]
+            # broadcast the key-validity row over queries so the flash
+            # kernel's [B, 1, Sq, Sk] mask shape contract holds
+            m = jnp.broadcast_to(cache_mask[:, :, :, :sq],
+                                 (q.shape[0], 1, sq, sq))
+        return flash_attention_arrays(q, k, v, m, is_causal=True), kc2, vc2
+    return cached_attention_arrays(q, k, v, kc, vc, t, mask=cache_mask)
 
 
 def _stacked_ln(h, w, b, eps):
@@ -547,7 +558,7 @@ class GPTStackedBlocks(Layer):
         tensors = [getattr(self, n) for n in names]
         return apply(fn, x, *tensors, name="gpt_stacked_blocks")
 
-    def forward_cached(self, x, caches, time_step=None):
+    def forward_cached(self, x, caches, time_step=None, cache_mask=None):
         """KV-cache prefill/decode over the stacked weights.
 
         Two cache formats select two execution strategies:
@@ -566,7 +577,13 @@ class GPTStackedBlocks(Layer):
         stacked_format = (len(caches) == 2 and hasattr(caches[0], "shape")
                           and len(caches[0].shape) in (4, 5))
         if not stacked_format:
-            return self._forward_cached_unrolled(x, caches, time_step)
+            return self._forward_cached_unrolled(x, caches, time_step,
+                                                 cache_mask)
+        if cache_mask is not None:
+            raise NotImplementedError(
+                "padded-prompt cache_mask on the stacked layer-scan decode "
+                "path is not wired yet; use the unrolled per-layer caches "
+                "(the default for <= 32 layers)")
         cfg = self.cfg
         nh = cfg.num_attention_heads
         hd = cfg.hidden_size // nh
@@ -603,7 +620,8 @@ class GPTStackedBlocks(Layer):
                             name="gpt_stacked_blocks_cached")
         return h, (kcs, vcs)
 
-    def _forward_cached_unrolled(self, x, caches, time_step=None):
+    def _forward_cached_unrolled(self, x, caches, time_step=None,
+                                 cache_mask=None):
         """Unrolled cached forward over per-layer (k, v) cache pairs —
         see forward_cached for why this beats the scan at decode."""
         cfg = self.cfg
@@ -613,11 +631,16 @@ class GPTStackedBlocks(Layer):
         names = self._names
         L = cfg.num_hidden_layers
         prefill = time_step is None
+        has_cm = cache_mask is not None
 
         def fn(a, t, *flat):
             from ..ops.pallas_ops import (_fused_decode_layer_ok,
                                           fused_decode_layer_arrays)
 
+            if has_cm:
+                cm, flat = flat[0], flat[1:]
+            else:
+                cm = None
             cache_flat, params_flat = flat[:2 * L], flat[2 * L:]
             params = dict(zip(names, params_flat))
             h = a
@@ -625,8 +648,10 @@ class GPTStackedBlocks(Layer):
             # decode branch): LN1 -> qkv -> cache write -> attention ->
             # out-proj in ONE Pallas call per layer, attacking the
             # kernel-launch count the decode bisect isolated. Gate is
-            # static per trace (shapes/dtypes identical across layers).
-            fused = (not prefill and h.shape[1] == 1
+            # static per trace (shapes/dtypes identical across layers);
+            # the fused kernel has no cache-mask support, so padded
+            # batches keep the masked XLA path.
+            fused = (not prefill and h.shape[1] == 1 and not has_cm
                      and _fused_decode_layer_ok(
                          h[:, 0, :], params["qkv_w"][0], cache_flat[0],
                          cache_flat[1], nh))
@@ -649,7 +674,8 @@ class GPTStackedBlocks(Layer):
 
                 def attn_fn(q, k, v, kc=kc, vc=vc):
                     o, kc2, vc2 = _cached_attn_arrays(q, k, v, kc, vc, t,
-                                                      prefill)
+                                                      prefill,
+                                                      cache_mask=cm)
                     return o, (kc2, vc2)
 
                 h, (kc2, vc2) = _stacked_block_body(p, h, attn_fn, nh, hd, eps)
@@ -659,7 +685,8 @@ class GPTStackedBlocks(Layer):
         flat_caches = [arr for (kc, vc) in caches for arr in (kc, vc)]
         tensors = [getattr(self, n) for n in names]
         t = 0 if time_step is None else time_step
-        res = apply(fn, x, t, *flat_caches, *tensors,
+        mask_args = [cache_mask] if has_cm else []
+        res = apply(fn, x, t, *mask_args, *flat_caches, *tensors,
                     name="gpt_stacked_blocks_cached_unrolled")
         h, rest = res[0], res[1:]
         return h, [(rest[2 * l], rest[2 * l + 1]) for l in range(L)]
@@ -708,11 +735,13 @@ class GPTModel(Layer):
         self.ln_f = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
 
     def forward(self, input_ids, position_ids=None, caches=None,
-                time_step=None, segment_ids=None):
+                time_step=None, segment_ids=None, cache_mask=None):
         """segment_ids: optional [B, S] packed-sequence ids (stacked-blocks
         training path; see GPTStackedBlocks.block_closure). For packed
         batches also pass position_ids that restart at each document
-        boundary — the standard packed pretraining format."""
+        boundary — the standard packed pretraining format.
+        cache_mask: optional additive [B, 1, 1, S_max] over cache
+        positions for padded-prompt decoding (see generate(pad_token_id))."""
         if segment_ids is not None and (caches is not None
                                         or not self.cfg.stacked_blocks):
             raise NotImplementedError(
@@ -728,8 +757,13 @@ class GPTModel(Layer):
         x = self.embeddings(input_ids, position_ids)
         if caches is not None:
             if self.cfg.stacked_blocks:
-                x, new_caches = self.blocks.forward_cached(x, caches, time_step)
+                x, new_caches = self.blocks.forward_cached(
+                    x, caches, time_step, cache_mask=cache_mask)
             else:
+                if cache_mask is not None:
+                    raise NotImplementedError(
+                        "padded-prompt cache_mask is wired on the "
+                        "stacked-blocks path; use stacked_blocks=True")
                 new_caches = []
                 for blk, cache in zip(self.h, caches):
                     x, c = blk(x, cache=cache, time_step=time_step)
@@ -817,13 +851,14 @@ class GPTForCausalLM(Layer):
         self._gen_step = None       # (shapes key, jitted fn) decode cache
 
     def forward(self, input_ids, position_ids=None, caches=None,
-                time_step=None, segment_ids=None):
+                time_step=None, segment_ids=None, cache_mask=None):
         if caches is not None:
             # segment_ids forwarded so GPTModel's loud guard fires instead
             # of silently decoding across document boundaries
             x, new_caches = self.gpt(input_ids, position_ids, caches=caches,
                                      time_step=time_step,
-                                     segment_ids=segment_ids)
+                                     segment_ids=segment_ids,
+                                     cache_mask=cache_mask)
         else:
             x = self.gpt(input_ids, position_ids, segment_ids=segment_ids)
         w = self.gpt.embeddings.word_embeddings.weight
@@ -948,13 +983,21 @@ class GPTForCausalLM(Layer):
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
-                 seed=None):
+                 seed=None, pad_token_id=None):
         """KV-cache autoregressive decoding: prefill and the whole decode
         loop run as ONE compiled program per (shapes, sampling) key — the
         loop is an on-device while_loop over static cache shapes
         (lax.dynamic_update_slice ring writes), so a generate() call costs
         a single dispatch. Greedy by default; temperature / top-k / top-p
         sampling with do_sample=True.
+
+        pad_token_id: enables RAGGED prompt batches — rows padded with
+        this id (left- or right-padded; interior pads unsupported) are
+        canonicalized to left-padding internally, pad positions are
+        masked out of attention, and per-row positions restart after each
+        row's real prompt (the reference generate's attention_mask
+        semantics). The returned buffer is left-aligned: [pads | prompt |
+        generated] per row.
 
         Returns [B, prompt + generated] int32 ids (generation stops early
         when every row has emitted eos_token_id).
@@ -978,7 +1021,10 @@ class GPTForCausalLM(Layer):
         was_training = self.training
         self.eval()
 
-        def run_fwd(params, bufs, chunk, caches, t, static_prefill=False):
+        padded = pad_token_id is not None
+
+        def run_fwd(params, bufs, chunk, caches, t, static_prefill=False,
+                    position_ids=None, cache_mask=None):
             # static_prefill (t == 0 STATICALLY) selects the flash-prefill
             # branch: causal flash over the chunk + cache write, instead
             # of the O(S * S_max) masked path a traced t forces
@@ -988,8 +1034,12 @@ class GPTForCausalLM(Layer):
                 with _tape.no_grad():
                     logits, new_caches = model(
                         Tensor(chunk),
+                        position_ids=(None if position_ids is None
+                                      else Tensor(position_ids)),
                         caches=jax.tree.map(Tensor, caches),
                         time_step=None if static_prefill else Tensor(t),
+                        cache_mask=(None if cache_mask is None
+                                    else Tensor(cache_mask)),
                     )
                 last = logits._data[:, -1].astype(jnp.float32)
                 return last, jax.tree.map(lambda c: c._data, new_caches,
@@ -1006,9 +1056,39 @@ class GPTForCausalLM(Layer):
             while_loop. Early EOS exit survives as the loop condition;
             the emitted count comes back so the host can trim to the
             host-loop-identical length."""
+            shift = None
+            cache_mask = None
+            pos_prefill = None
+            if padded:
+                # canonicalize ragged rows to LEFT padding: roll each row
+                # so its real tokens end at column P-1 — decode then
+                # writes uniform cache rows while positions/attention
+                # stay per-row exact. TWO distinct quantities: the roll
+                # amount comes from the LAST valid index (0 for already
+                # left-padded rows), while masks/positions need the PAD
+                # COUNT (nonzero for left-padded rows too — deriving both
+                # from the roll silently unmasked left-pads).
+                valid = ids_in != pad_token_id
+                last1 = jnp.max(jnp.where(
+                    valid, jnp.arange(1, P + 1)[None, :], 0), axis=1)
+                roll = (P - last1).astype(jnp.int32)             # [B]
+                shift = (P - jnp.sum(valid, axis=1)).astype(jnp.int32)
+                cols = jnp.arange(P, dtype=jnp.int32)[None, :]
+                idx = (cols - roll[:, None]) % P
+                ids_in = jnp.take_along_axis(ids_in, idx, axis=1)
+                ids_in = jnp.where(cols >= shift[:, None], ids_in,
+                                   pad_token_id)
+                pos_prefill = jnp.maximum(cols - shift[:, None], 0)
+                s_max = jax.tree_util.tree_leaves(caches)[0].shape[-2]
+                j = jnp.arange(s_max, dtype=jnp.int32)[None, :]
+                invalid = (j < shift[:, None]) & (j < P)
+                cache_mask = jnp.where(invalid, jnp.float32(_NEG_INF),
+                                       0.0)[:, None, None, :]
             logits, caches = run_fwd(params, bufs, ids_in, caches,
                                      jnp.asarray(0, jnp.int32),
-                                     static_prefill=True)
+                                     static_prefill=True,
+                                     position_ids=pos_prefill,
+                                     cache_mask=cache_mask)
             finished0 = jnp.zeros((B,), bool)
             toks0 = jnp.zeros((B, max_new_tokens), jnp.int32)
 
@@ -1038,11 +1118,17 @@ class GPTForCausalLM(Layer):
                 more = i + 1 < max_new_tokens
                 if eos_token_id is not None:
                     more = more & ~jnp.all(finished)
+                def fwd(c):
+                    pos = None
+                    if padded:
+                        # per-row position: row length + generated count
+                        pos = (P + i - shift)[:, None]
+                    return run_fwd(params, bufs, tok[:, None], c, P + i,
+                                   position_ids=pos,
+                                   cache_mask=cache_mask)
+
                 logits, caches = jax.lax.cond(
-                    more,
-                    lambda c: run_fwd(params, bufs, tok[:, None], c, P + i),
-                    lambda c: (logits, c),
-                    caches)
+                    more, fwd, lambda c: (logits, c), caches)
                 return (i + 1, logits, caches, key, finished, toks)
 
             unroll = max(1, int(os.environ.get(
@@ -1070,13 +1156,15 @@ class GPTForCausalLM(Layer):
             # caches ride out as outputs ONLY so donate_argnums=(3,) has
             # something to alias: unmatched donations are "not usable"
             # (jax warns) and XLA then copies every cache at entry instead
-            # of mutating the donated buffers in place
-            return i, toks, caches
+            # of mutating the donated buffers in place. ids_in rides out
+            # so padded batches return the canonicalized (left-aligned)
+            # prompt the generated tokens actually continue.
+            return i, toks, ids_in, caches
 
         # executable cache: sampling params AND the step-unroll factor are
         # baked into the decode trace
         gen_key = (B, P, total, cfg.stacked_blocks, do_sample, temperature,
-                   top_k, top_p, eos_token_id,
+                   top_k, top_p, eos_token_id, pad_token_id,
                    os.environ.get("PTPU_DECODE_STEP_UNROLL", "1"))
         if self._gen_step is None or self._gen_step[0] != gen_key:
             self._gen_step = (gen_key,
@@ -1092,12 +1180,12 @@ class GPTForCausalLM(Layer):
                 else _rng.next_key()) if do_sample
                else jax.random.PRNGKey(0))
 
-        n, toks, _ = gen_step(params, bufs, ids, cache_arrs, key)
+        n, toks, ids_out, _ = gen_step(params, bufs, ids, cache_arrs, key)
         n = int(n)
 
         if was_training:
             self.train()
-        return Tensor(jnp.concatenate([ids, toks[:, :n]], axis=1))
+        return Tensor(jnp.concatenate([ids_out, toks[:, :n]], axis=1))
 
 
 class GPTPretrainingCriterion(Layer):
