@@ -649,9 +649,8 @@ class GPTStackedBlocks(Layer):
             # out-proj in ONE Pallas call per layer, attacking the
             # kernel-launch count the decode bisect isolated. Gate is
             # static per trace (shapes/dtypes identical across layers);
-            # the fused kernel has no cache-mask support, so padded
-            # batches keep the masked XLA path.
-            fused = (not prefill and h.shape[1] == 1 and not has_cm
+            # padded batches pass their cache mask into the kernel.
+            fused = (not prefill and h.shape[1] == 1
                      and _fused_decode_layer_ok(
                          h[:, 0, :], params["qkv_w"][0], cache_flat[0],
                          cache_flat[1], nh))
@@ -664,7 +663,7 @@ class GPTStackedBlocks(Layer):
                     y, kc2, vc2 = fused_decode_layer_arrays(
                         h.reshape(mb, H), p["ln1_w"], p["ln1_b"],
                         p["qkv_w"], p["qkv_b"], p["out_w"], p["out_b"],
-                        kc, vc, t, nh, eps)
+                        kc, vc, t, nh, eps, cache_mask=cm)
                     y3 = y.reshape(mb, 1, H)
                     h = _stacked_mlp_fused_decode(p, y3, eps)
                     if h is None:

@@ -942,7 +942,8 @@ def _decode_seg_helpers(h, d, fast):
 
 
 def _prefix_attn_loop(qf, length, num_kb, row0, k_hbm, v_hbm, k_buf, v_buf,
-                      sem, seg, expand, seg_dot, *, bb, block_k, h, scale):
+                      sem, seg, expand, seg_dot, *, bb, block_k, h, scale,
+                      mask_all=None):
     """Double-buffered online-softmax attention of qf [bb, 1, H*D] (fp32)
     against cache rows [row0:row0+bb, 0:length) streamed from HBM —
     the shared core of _decode_kernel and _fused_decode_layer_kernel.
@@ -977,6 +978,11 @@ def _prefix_attn_loop(qf, length, num_kb, row0, k_hbm, v_hbm, k_buf, v_buf,
         kd.wait()
         kf = k_buf[slot].astype(jnp.float32)                     # [bb,bk,hd]
         s = seg_dot(kf * qf, seg) * scale                        # [bb,bk,H]
+        if mask_all is not None:
+            # additive row mask over cache positions (padded batches);
+            # rows address the caller's batch slab, like the cache DMAs
+            s = s + jax.lax.dynamic_slice(
+                mask_all, (row0, start), (bb, block_k))[:, :, None]
         pos = start + jax.lax.broadcasted_iota(
             jnp.int32, (bb, block_k, h), 1)
         s = jnp.where(pos < length, s, _NEG_INF)
@@ -1174,19 +1180,22 @@ def _decode_ok(q, k_cache, v_cache) -> bool:
 
 def _fused_decode_layer_kernel(len_ref, x_ref, lnw_ref, lnb_ref,
                                wqkv_ref, bqkv_ref, wo_ref, bo_ref,
-                               k_in, v_in,
-                               y_ref, k_out, v_out,
-                               kv_stage, k_buf, v_buf, sem, wsem,
-                               *, block_k, h, d, eps, scale):
+                               k_in, v_in, *refs,
+                               block_k, h, d, eps, scale, has_mask):
     """Single program: x [B, H*D] residual stream in, y = x + attn_out
     out; the new token's k/v are written in place into the HBM cache rings
     (k_out/v_out alias k_in/v_in). Prefix length t arrives via scalar
     prefetch; the current token's k/v never round-trip through HBM — the
     self-attention term folds into the online softmax from registers.
-    Requires t >= 1 (decode always follows a prefill)."""
+    Requires t >= 1 (decode always follows a prefill). has_mask adds an
+    additive [B, S_max] row mask over prefix positions (padded-prompt
+    batches: -inf at pad slots; the current token is always valid)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    refs = list(refs)
+    mask_ref = refs.pop(0) if has_mask else None
+    y_ref, k_out, v_out, kv_stage, k_buf, v_buf, sem, wsem = refs
     t = len_ref[0]                          # prefix length == write row
     bb = x_ref.shape[0]
     hd = h * d
@@ -1209,9 +1218,11 @@ def _fused_decode_layer_kernel(len_ref, x_ref, lnw_ref, lnb_ref,
 
     seg, expand, seg_dot = _decode_seg_helpers(h, d, fast)
     num_kb = jnp.maximum((t + block_k - 1) // block_k, 1)
+    mask_all = mask_ref[...].astype(jnp.float32) if has_mask else None
     m, l, acc = _prefix_attn_loop(
         qf, t, num_kb, 0, k_in, v_in, k_buf, v_buf, sem,
-        seg, expand, seg_dot, bb=bb, block_k=block_k, h=h, scale=scale)
+        seg, expand, seg_dot, bb=bb, block_k=block_k, h=h, scale=scale,
+        mask_all=mask_all)
 
     # current token's self-attention term, straight from registers
     s_self = seg_dot(k_new[:, None, :] * qf, seg) * scale    # [B, 1, h]
@@ -1244,13 +1255,16 @@ def _fused_decode_layer_kernel(len_ref, x_ref, lnw_ref, lnb_ref,
 
 def fused_decode_layer_arrays(x, ln_w, ln_b, wqkv, bqkv, wo, bo,
                               k_cache, v_cache, t, n_heads, eps=1e-5,
-                              scale=None, block_k=256):
+                              scale=None, block_k=256, cache_mask=None):
     """One transformer layer's decode step (S_q = 1) in ONE Pallas call:
     LN -> qkv -> ring cache write (in place, aliased) -> online-softmax
     attention over the valid prefix + the current token -> out-proj ->
     residual add. x: [B, H*D]; caches: flat [B, S_max, H*D] rings;
-    t: int32 scalar prefix length (>= 1). Returns (y, k_cache, v_cache)
-    with the caches updated in place (buffers donated)."""
+    t: int32 scalar prefix length (>= 1). cache_mask: optional additive
+    [B, S_max] (or [B, 1, 1, S_max]) row mask over cache positions —
+    padded-prompt batches keep the fused path. Returns
+    (y, k_cache, v_cache) with the caches updated in place (buffers
+    donated)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -1266,6 +1280,8 @@ def fused_decode_layer_arrays(x, ln_w, ln_b, wqkv, bqkv, wo, bo,
     # shrink the streamed cache blocks until the double-buffered slabs
     # plus resident weights fit the VMEM budget
     weights_bytes = (hd * 3 * hd + hd * hd) * jnp.dtype(wqkv.dtype).itemsize
+    if cache_mask is not None:
+        weights_bytes += b * s_max * 4      # resident fp32 row mask block
     while (block_k > 8
            and 4 * b * block_k * hd * itemsize > 10 * 2**20 - weights_bytes):
         block_k //= 2
@@ -1283,7 +1299,8 @@ def fused_decode_layer_arrays(x, ln_w, ln_b, wqkv, bqkv, wo, bo,
             pl.BlockSpec((hd,), lambda i, len_ref: (0,)),              # bo
             pl.BlockSpec(memory_space=pltpu.ANY),                      # k_in
             pl.BlockSpec(memory_space=pltpu.ANY),                      # v_in
-        ],
+        ] + ([pl.BlockSpec((b, s_max), lambda i, len_ref: (0, 0))]
+             if cache_mask is not None else []),                       # mask
         out_specs=[
             pl.BlockSpec((b, hd), lambda i, len_ref: (0, 0)),          # y
             pl.BlockSpec(memory_space=pltpu.ANY),                      # k_out
@@ -1298,10 +1315,16 @@ def fused_decode_layer_arrays(x, ln_w, ln_b, wqkv, bqkv, wo, bo,
         ],
     )
     kernel = functools.partial(_fused_decode_layer_kernel, block_k=block_k,
-                               h=h, d=d, eps=float(eps), scale=scale)
+                               h=h, d=d, eps=float(eps), scale=scale,
+                               has_mask=cache_mask is not None)
     lengths = jnp.asarray(t, jnp.int32).reshape(1)
+    mask_args = []
+    if cache_mask is not None:
+        mask_args = [jnp.asarray(cache_mask, jnp.float32
+                                 ).reshape(b, s_max)]
     # aliasing: inputs are indexed INCLUDING the scalar-prefetch arg
-    # (lengths=0, x=1, ..., k_in=8, v_in=9); outputs (y=0, k=1, v=2)
+    # (lengths=0, x=1, ..., k_in=8, v_in=9; mask, when present, is 10);
+    # outputs (y=0, k=1, v=2)
     y, k2, v2 = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -1312,7 +1335,8 @@ def fused_decode_layer_arrays(x, ln_w, ln_b, wqkv, bqkv, wo, bo,
         ],
         input_output_aliases={8: 1, 9: 2},
         interpret=_interpret(),
-    )(lengths, x, ln_w, ln_b, wqkv, bqkv, wo, bo, k_cache, v_cache)
+    )(lengths, x, ln_w, ln_b, wqkv, bqkv, wo, bo, k_cache, v_cache,
+      *mask_args)
     return y, k2, v2
 
 

@@ -203,3 +203,37 @@ def test_fused_multi_transformer_decode_parity(monkeypatch, layout):
     got = run(True)
     assert po.attention_path_counts().get("fused_decode_kernel", 0) >= 1
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_fused_decode_padded_batches(monkeypatch):
+    """Padded-prompt generate keeps the fused kernel: the additive cache
+    mask rides into the kernel and tokens match the unfused masked path
+    exactly (informative model draw asserted)."""
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt_test_config
+
+    def run(fused):
+        if fused:
+            monkeypatch.setenv("PTPU_FUSED_DECODE", "1")
+        else:
+            monkeypatch.delenv("PTPU_FUSED_DECODE", raising=False)
+        paddle.seed(21)
+        cfg = gpt_test_config(stacked_blocks=True, num_hidden_layers=2,
+                              hidden_size=256, intermediate_size=512,
+                              num_attention_heads=4,
+                              max_position_embeddings=64)
+        m = GPTForCausalLM(cfg)
+        m.eval()
+        rs = np.random.RandomState(3)
+        batch = np.zeros((2, 7), np.int32)
+        batch[0, :7] = rs.randint(1, 90, 7)
+        batch[1, :4] = rs.randint(1, 90, 4)
+        return m.generate(paddle.to_tensor(batch), max_new_tokens=6,
+                          pad_token_id=0).numpy()
+
+    monkeypatch.setenv("PTPU_ATTN_DEBUG", "1")
+    ref = run(False)
+    po.reset_attention_path_counts()
+    got = run(True)
+    assert po.attention_path_counts().get("fused_decode_kernel", 0) >= 1
+    np.testing.assert_array_equal(got, ref)
+    assert not (ref[0] == ref[0][0]).all()   # informative draw
