@@ -28,7 +28,7 @@ if _os.environ.get("PTPU_FORCE_PLATFORM"):
 
     _jax.config.update("jax_platforms", _os.environ["PTPU_FORCE_PLATFORM"])
 
-from .core.tensor import Tensor, to_tensor
+from .core.tensor import Tensor, TracedValueError, to_tensor
 from .core.containers import SelectedRows, StringTensor
 from .core.dtype import (
     bool_,
@@ -54,7 +54,8 @@ from .autograd import no_grad, enable_grad, grad, set_grad_enabled, is_grad_enab
 from . import autograd
 from . import ops
 
-__all__ = ["Tensor", "to_tensor", "seed", "no_grad", "grad"] + list(_ops_all)
+__all__ = ["Tensor", "TracedValueError", "to_tensor", "seed", "no_grad",
+           "grad"] + list(_ops_all)
 
 # Subsystems (populated progressively; import order matters — nn/optimizer
 # build on ops).
