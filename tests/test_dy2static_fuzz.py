@@ -149,7 +149,7 @@ class _Gen:
         return "\n".join(src)
 
 
-@pytest.mark.parametrize("seed", range(60))
+@pytest.mark.parametrize("seed", range(90))
 def test_random_program_parity(seed):
     import linecache
 
