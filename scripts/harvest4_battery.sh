@@ -1,28 +1,64 @@
 #!/usr/bin/env bash
-# Round-4 on-chip measurement battery (VERDICT r3 "Next round" items 1-5, 7).
-# Invoked by chip_harvest4.sh the moment the tunnel heals; safe to re-run
-# manually. Priority order: official record first, then diagnostics.
-# Optional stages are gated on script existence so the battery can be
-# extended mid-round.
+# Round-5 on-chip measurement battery (VERDICT r4 "Next round" items 1-4, 7).
+# Invoked by chip_harvest4.sh the moment the tunnel heals (the daemon
+# re-reads this file at chip-up); safe to re-run manually.
+#
+# PRIORITY ORDER FOR FLAKY WINDOWS (VERDICT r4 item 1): the first ~10
+# minutes of a healthy window must capture the headline, the resnet
+# layout A/B, and the decode fused A/B BEFORE the 2h ladder.  The
+# summary file is rewritten after EVERY stage so a window that dies
+# mid-battery still leaves a committed record.
 set -u
 cd "$(dirname "$0")/.."
-mkdir -p /tmp/harvest4
+mkdir -p /tmp/harvest5
+
+summarize() {  # rewrite HARVEST_R5.md from whatever logs exist so far
+  {
+    echo "# Round-5 on-chip harvest (updated $(date -u))"
+    echo
+    for f in /tmp/harvest5/*.log /tmp/harvest4/*.log /tmp/harvest/decode_*.log /tmp/harvest/bisect_*.log; do
+      [ -f "$f" ] || continue
+      echo "## $(basename "$f")"
+      echo '```'
+      grep -v "WARNING" "$f" | tail -30
+      echo '```'
+      echo
+    done
+  } > HARVEST_R5.md
+}
 
 run() {  # run <name> <timeout-seconds> <cmd...>
   local name="$1" to="$2"; shift 2
   echo "$(date -u) == $name"
-  timeout "$to" "$@" > "/tmp/harvest4/$name.log" 2>&1
+  timeout "$to" "$@" > "/tmp/harvest5/$name.log" 2>&1
   echo "$(date -u) == $name rc=$?"
+  summarize
 }
 
-# 1. official record first: headline then the whole ladder
-run headline 1800 python bench.py
-run ladder 7200 python bench.py --ladder
-cp -f BENCH_LADDER.json /tmp/harvest4/BENCH_LADDER.json 2>/dev/null || true
+# ---- TIER 1 (critical ~10 min): official headline + the two A/Bs whose
+# kernels have waited three rounds for a number ------------------------
+run headline 900 python bench.py
+run decode_base 600 python bench.py --config gpt124m_decode
+run decode_fused 600 env PTPU_FUSED_DECODE=1 python bench.py --config gpt124m_decode
+run resnet_nhwc 900 env PTPU_RESNET_BENCH_FORMAT=NHWC python bench.py --config resnet50
+run resnet_nchw 900 env PTPU_RESNET_BENCH_FORMAT=NCHW python bench.py --config resnet50
 
-# 2. resnet: layout A/B at default batch, then batch sweep over both layouts
-run resnet_nhwc 1200 env PTPU_RESNET_BENCH_FORMAT=NHWC python bench.py --config resnet50
-run resnet_nchw 1200 env PTPU_RESNET_BENCH_FORMAT=NCHW python bench.py --config resnet50
+# ---- TIER 2 (next ~30 min): LN/FFN A/Bs on the headline + fused decode
+# with the MLP kernels + durable 1.3B line ----------------------------
+run headline_pallas_ln 900 env PTPU_PALLAS_LN=1 python bench.py
+run headline_pallas_ffn 900 env PTPU_PALLAS_FFN=1 python bench.py
+run headline_pallas_both 900 env PTPU_PALLAS_LN=1 PTPU_PALLAS_FFN=1 python bench.py
+run decode_fused_mlp 600 env PTPU_FUSED_DECODE=1 PTPU_PALLAS_FFN=1 PTPU_PALLAS_LN=1 \
+  python bench.py --config gpt124m_decode
+run gpt3_1p3b 1800 python bench.py --config gpt3_1p3b
+
+# ---- TIER 3 (the 2h ladder: full official record) --------------------
+run ladder 7200 python bench.py --ladder
+cp -f BENCH_LADDER.json /tmp/harvest5/BENCH_LADDER.json 2>/dev/null || true
+summarize
+
+# ---- TIER 4 (diagnostics + long-tail) --------------------------------
+run memfit67b 2400 python scripts/memfit67b_tpu.py
 for b in 128 256; do
   for fmt in NHWC NCHW; do
     run "resnet_${fmt,,}_b$b" 1200 env PTPU_RESNET_BENCH_BATCH="$b" \
@@ -30,40 +66,16 @@ for b in 128 256; do
   done
 done
 run profile_resnet 1200 python scripts/profile_resnet.py
-
-# 3. decode battery (XLA/Pallas, unroll, batch, path counters) + the new
-# fused per-layer decode step A/B when it exists
+run decode_fused_long 900 env PTPU_FUSED_DECODE=1 PTPU_DECODE_BENCH_PROMPT=1024 \
+  PTPU_DECODE_BENCH_NEW=256 python bench.py --config gpt124m_decode
+run decode_base_long 900 env PTPU_DECODE_BENCH_PROMPT=1024 \
+  PTPU_DECODE_BENCH_NEW=256 python bench.py --config gpt124m_decode
 bash scripts/decode_experiments.sh
-[ -f scripts/decode_fused_ab.sh ] && bash scripts/decode_fused_ab.sh
+summarize
 
-# 4. big configs: durable 1.3B line + 6.7B TPU-target memory fit
-run gpt3_1p3b 1800 python bench.py --config gpt3_1p3b
-run memfit67b 2400 python scripts/memfit67b_tpu.py
-
-# 5. fused-kernel A/Bs on the headline step (flag-gated kernels —
-# promote to default only where these win; delete if they lose)
-run headline_pallas_ln 1800 env PTPU_PALLAS_LN=1 python bench.py
-run headline_pallas_ffn 1800 env PTPU_PALLAS_FFN=1 python bench.py
-run headline_pallas_both 1800 env PTPU_PALLAS_LN=1 PTPU_PALLAS_FFN=1 python bench.py
-
-# 6. tuner TPU calibration (VERDICT next #7): measured trials on chip,
-# persisted roofline constants
+# ---- TIER 5: tuner TPU calibration + packed-attention bench ----------
 [ -f scripts/tuner_calibrate_tpu.py ] && run tuner_calibrate 2400 python scripts/tuner_calibrate_tpu.py
-
-# 7. packed-sequence (segment-id) flash bench line when it exists
 [ -f scripts/bench_packed_attn.py ] && run packed_attn 1200 python scripts/bench_packed_attn.py
 
-# summary into the repo (driver commits uncommitted work at round end)
-{
-  echo "# Round-4 on-chip harvest ($(date -u))"
-  echo
-  for f in /tmp/harvest4/*.log /tmp/harvest/decode_*.log /tmp/harvest/bisect_*.log; do
-    [ -f "$f" ] || continue
-    echo "## $(basename "$f")"
-    echo '```'
-    grep -v "WARNING" "$f" | tail -30
-    echo '```'
-    echo
-  done
-} > HARVEST_R4.md
-echo "$(date -u) HARVEST_R4.md written"
+summarize
+echo "$(date -u) HARVEST_R5.md written"

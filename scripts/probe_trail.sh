@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Probe-trail logger (VERDICT r4 item 1 escalation evidence): one
+# timestamped line per tunnel probe, independent of the harvest daemon
+# (which logs only the first wait and the success).
+#   setsid nohup scripts/probe_trail.sh > /dev/null 2>&1 &
+set -u
+mkdir -p /tmp/harvest5
+while true; do
+  if timeout 90 python -c "import jax; assert jax.devices()[0].platform in ('tpu','axon')" >/dev/null 2>&1; then
+    echo "$(date -u '+%Y-%m-%d %H:%M:%S') UP" >> /tmp/harvest5/probes.log
+  else
+    echo "$(date -u '+%Y-%m-%d %H:%M:%S') DOWN" >> /tmp/harvest5/probes.log
+  fi
+  sleep 300
+done
