@@ -468,12 +468,16 @@ class GPTStackedBlocks(Layer):
             setattr(self, name, p)
         self._names = list(shapes)
 
-    def block_closure(self, segment_ids=None):
+    def block_closure(self, seg_as_arg=False):
         """Array-level single-block function `block(params_slice, h) -> h`
         shared by the gpipe forward, the 1F1B fused loss, and dryruns.
-        segment_ids: optional [B, S] packed-sequence ids (array, traced
-        alongside h) — documents attend only within their own segment
-        (flash kernel path; see ops/pallas_ops.flash_attention_arrays)."""
+        seg_as_arg=True instead returns `block(params_slice, h, seg) -> h`
+        taking packed-sequence segment-id rows as a third argument —
+        documents attend only within their own segment (flash kernel
+        path; ops/pallas_ops.flash_attention_arrays) and the pipeline
+        schedules feed the ids through as per-micro-batch metadata (the
+        rows split with the activation micro-batches;
+        parallel/pipeline.py `aux`)."""
         from ..parallel.mesh import axis_size
         from ..parallel.ring import ring_attention_arrays
         from ..ops.pallas_ops import flash_attention_arrays
@@ -499,18 +503,20 @@ class GPTStackedBlocks(Layer):
         else:
             attn = flash_attention_arrays
 
-        def block(p, h):
-            if segment_ids is not None:
+        if seg_as_arg:
+            def block(p, h, seg):
                 out, _ = _stacked_block_body(
                     p, h, lambda q, k, v: (attn(
-                        q, k, v, is_causal=True,
-                        segment_ids=segment_ids), None),
+                        q, k, v, is_causal=True, segment_ids=seg), None),
                     nh, hd, eps)
                 return out
-            out, _ = _stacked_block_body(
-                p, h, lambda q, k, v: (attn(q, k, v, is_causal=True), None),
-                nh, hd, eps)
-            return out
+        else:
+            def block(p, h):
+                out, _ = _stacked_block_body(
+                    p, h,
+                    lambda q, k, v: (attn(q, k, v, is_causal=True), None),
+                    nh, hd, eps)
+                return out
 
         if cfg.recompute:
             # reference fleet/recompute capability on the stacked path:
@@ -527,22 +533,17 @@ class GPTStackedBlocks(Layer):
         chunks = max(1, self.cfg.pp_num_chunks)
 
         if segment_ids is not None:
-            from ..parallel.mesh import axis_size
+            # ids ride the pipeline as per-micro-batch aux metadata: they
+            # split with the activations and every stage reads the rows of
+            # the micro-batch it is computing (parallel/pipeline.py aux) —
+            # works across gpipe, interleave, and pp=1 scan uniformly
+            block = self.block_closure(seg_as_arg=True)
 
-            if axis_size("pp") > 1:
-                # pipeline microbatching would have to split the id rows
-                # with the activations; not wired yet — loud over wrong
-                raise NotImplementedError(
-                    "packed segment_ids with pp>1 pipeline parallelism is "
-                    "not supported yet; use dp/mp/sharding axes")
-
-            # segs trace alongside x so the block closure sees an array
             def fn(a, segs, *flat):
                 params = dict(zip(names, flat))
-                block = self.block_closure(segment_ids=segs)
                 return pipeline_apply(block, params, a,
                                       n_microbatches=n_micro,
-                                      num_chunks=chunks)
+                                      num_chunks=chunks, aux=segs)
 
             tensors = [getattr(self, n) for n in names]
             return apply(fn, x, segment_ids, *tensors,
@@ -892,15 +893,10 @@ class GPTForCausalLM(Layer):
             crit = GPTPretrainingCriterion(cfg)
             return crit(self(input_ids, position_ids,
                              segment_ids=segment_ids), labels, loss_mask)
-        if segment_ids is not None:
-            raise NotImplementedError(
-                "packed segment_ids with the fused 1F1B pipeline are not "
-                "supported yet (the id rows would need to split with the "
-                "activation microbatches); use dp/mp/sharding axes")
-
         blocks = self.gpt.blocks
         names = blocks._names
-        block = blocks.block_closure()
+        has_segs = segment_ids is not None
+        block = blocks.block_closure(seg_as_arg=has_segs)
         n_micro = cfg.pp_num_microbatches or None
         eps = cfg.layer_norm_epsilon
         x = self.gpt.embeddings(input_ids, position_ids)
@@ -930,8 +926,9 @@ class GPTForCausalLM(Layer):
             return jnp.mean(per_tok)
 
         mask_arg = loss_mask if has_mask else labels  # placeholder leaf
+        seg_arg = segment_ids if has_segs else labels  # placeholder leaf
 
-        def fn(a, y, mask, wte_, lnw_, lnb_, *flat):
+        def fn(a, y, mask, segs, wte_, lnw_, lnb_, *flat):
             params = dict(zip(names, flat))
             tail = {"wte": wte_, "ln_w": lnw_, "ln_b": lnb_}
             M = n_micro or axis_size("pp")
@@ -944,11 +941,13 @@ class GPTForCausalLM(Layer):
             scale = jnp.full((a.shape[0],), M / total, jnp.float32)
             return pipeline_1f1b(block, loss_fn, params, tail, a,
                                  (y, mask, jax.lax.stop_gradient(scale)),
-                                 n_microbatches=n_micro)
+                                 n_microbatches=n_micro,
+                                 aux=(jnp.asarray(segs, jnp.int32)
+                                      if has_segs else None))
 
         tensors = [getattr(blocks, n) for n in names]
-        return apply(fn, x, labels, mask_arg, wte, lnw, lnb, *tensors,
-                     name="gpt_1f1b_loss")
+        return apply(fn, x, labels, mask_arg, seg_arg, wte, lnw, lnb,
+                     *tensors, name="gpt_1f1b_loss")
 
     # -- autoregressive decoding -------------------------------------------
     def init_caches(self, batch_size, max_length, dtype=None):

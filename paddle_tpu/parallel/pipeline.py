@@ -40,10 +40,16 @@ from .mesh import get_mesh, axis_size
 __all__ = ["pipeline_apply", "pipeline_1f1b", "scan_blocks"]
 
 
-def scan_blocks(block_fn: Callable, stacked_params: Any, x, unroll: int | None = None):
+def scan_blocks(block_fn: Callable, stacked_params: Any, x,
+                unroll: int | None = None, aux: Any = None):
     """Apply L stacked blocks sequentially via lax.scan (single-stage path;
     compile time O(1) in depth — the TPU answer to the reference's per-layer
     Program ops).
+
+    aux: optional pytree of per-token metadata (e.g. packed-sequence
+    segment ids) passed unchanged to every block as a third argument:
+    block_fn(params_slice, h, aux). Constant across layers, so it rides
+    the scan closure, not the carry.
 
     Default unroll policy (override with PTPU_SCAN_UNROLL=<n>, 0 = full):
     FULLY unroll when depth <= 32, else keep the rolled scan. Measured on
@@ -67,8 +73,12 @@ def scan_blocks(block_fn: Callable, stacked_params: Any, x, unroll: int | None =
     if unroll <= 0:
         unroll = _depth()
 
-    def body(h, p):
-        return block_fn(p, h), None
+    if aux is None:
+        def body(h, p):
+            return block_fn(p, h), None
+    else:
+        def body(h, p):
+            return block_fn(p, h, aux), None
 
     out, _ = jax.lax.scan(body, x, stacked_params, unroll=max(1, unroll))
     return out
@@ -123,6 +133,7 @@ def pipeline_apply(
     n_microbatches: int | None = None,
     axis: str = "pp",
     num_chunks: int = 1,
+    aux: Any = None,
 ):
     """Run x through a pp-stage GPipe pipeline inside one XLA program.
 
@@ -130,6 +141,16 @@ def pipeline_apply(
     stacked_params: pytree, every leaf [L, ...] with L = total blocks,
         L % pp == 0; leading dim sharded on 'pp' outside this call.
     x: [B, ...] activations; split into M micro-batches along dim 0.
+    aux: optional pytree of PER-TOKEN metadata (packed-sequence segment
+        ids, [B, S]-leading leaves) split into the same M micro-batches as
+        x. Unlike activations, aux does NOT hop stages over ICI: every
+        stage holds the replicated [M, B/M, ...] table and indexes the
+        micro-batch it is currently computing (stage s works on
+        micro-batch t - s at tick t), so the id rows stay paired with
+        their activations through the whole schedule. When given,
+        block_fn is called as block_fn(params, h, aux_mb). This is the
+        TPU answer to the reference's p2p meta handshake carrying
+        attention masks with activations (pp_utils/p2p_communication.py).
 
     num_chunks > 1 selects the INTERLEAVED schedule (reference
     meta_parallel/pipeline_parallel.py:461 PipelineParallelWithInterleave):
@@ -141,10 +162,11 @@ def pipeline_apply(
     mesh = get_mesh()
     pp = axis_size(axis)
     if pp == 1:
-        return scan_blocks(block_fn, stacked_params, x)
+        return scan_blocks(block_fn, stacked_params, x, aux=aux)
     if num_chunks > 1:
         return _pipeline_interleaved(block_fn, stacked_params, x,
-                                     n_microbatches, axis, num_chunks)
+                                     n_microbatches, axis, num_chunks,
+                                     aux=aux)
 
     B = x.shape[0]
     M = n_microbatches or pp
@@ -156,22 +178,25 @@ def pipeline_apply(
         raise ValueError(f"{L} blocks not divisible by pp={pp}")
 
     xs = x.reshape((M, B // M) + x.shape[1:])
+    has_aux = aux is not None
+    aux_xs = _split_aux(aux, M) if has_aux else ()
 
-    def stage_fn(params, h):
+    def stage_fn(params, h, amb):
         # params leaves: [k, ...] — this stage's k blocks, scanned rolled:
         # this body repeats inside the pipeline tick loop, so unrolling it
         # would multiply program size per tick.
-        return scan_blocks(block_fn, params, h, unroll=1)
+        return scan_blocks(block_fn, params, h, unroll=1,
+                           aux=amb if has_aux else None)
 
     @functools.partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(P(axis), P()),
+        in_specs=(P(axis), P(), P()),
         out_specs=P(),
         axis_names=frozenset({axis}),
         check_vma=False,
     )
-    def run(params, xs):
+    def run(params, xs, axs):
         # each shard sees leaf [1, k, ...] — drop the stage dim
         params = jax.tree_util.tree_map(lambda a: a[0], params)
         xs = _narrow_boundary(xs, xs_dtype)
@@ -184,7 +209,11 @@ def pipeline_apply(
             mb, outs = carry
             # stage 0 ingests micro-batch t (clipped when draining)
             inp = jnp.where(stage == 0, xs[jnp.clip(t, 0, M - 1)], mb)
-            out = stage_fn(params, inp)
+            # stage s computes micro-batch t - s: its metadata rows come
+            # from the replicated table, not the ICI hop
+            cur = jnp.clip(t - stage, 0, M - 1)
+            amb = jax.tree_util.tree_map(lambda a: a[cur], axs)
+            out = stage_fn(params, inp, amb)
             # last stage retires micro-batch t-(pp-1)
             j = t - (pp - 1)
             write = (stage == pp - 1) & (j >= 0)
@@ -216,12 +245,25 @@ def pipeline_apply(
     # partial-manual shard_map validates specs only under jit; eager calls
     # (plain apply without jit.compile) need the wrapper — it inlines when
     # already inside a trace
-    out = jax.jit(run)(staged, xs)
+    out = jax.jit(run)(staged, xs, aux_xs)
     return out.reshape((B,) + x.shape[1:])
 
 
+def _split_aux(aux, M):
+    """Reshape every aux leaf [B, ...] -> [M, B/M, ...] (the same
+    micro-batch split as the activations)."""
+    def split(a):
+        if a.shape[0] % M != 0:
+            raise ValueError(
+                f"aux leading dim {a.shape[0]} not divisible into {M} "
+                "micro-batches (must match the activation batch)")
+        return a.reshape((M, a.shape[0] // M) + a.shape[1:])
+
+    return jax.tree_util.tree_map(split, aux)
+
+
 def _pipeline_interleaved(block_fn, stacked_params, x, n_microbatches,
-                          axis, v):
+                          axis, v, aux: Any = None):
     """Interleaved (virtual-stage) pipeline forward in one XLA program.
 
     The reference drives interleave from the host with a per-rank unit
@@ -267,16 +309,18 @@ def _pipeline_interleaved(block_fn, stacked_params, x, n_microbatches,
     U = units + pp - 1
 
     xs = x.reshape((M, B // M) + x.shape[1:])
+    has_aux = aux is not None
+    aux_xs = _split_aux(aux, M) if has_aux else ()
 
     @functools.partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(P(axis), P()),
+        in_specs=(P(axis), P(), P()),
         out_specs=P(),
         axis_names=frozenset({axis}),
         check_vma=False,
     )
-    def run(params, xs):
+    def run(params, xs, axs):
         # leaf [1, v, k, ...] -> [v, k, ...]: this device's v chunks
         params = jax.tree_util.tree_map(lambda a: a[0], params)
         xs = _narrow_boundary(xs, xs_dtype)
@@ -292,7 +336,11 @@ def _pipeline_interleaved(block_fn, stacked_params, x, n_microbatches,
             chunk_params = jax.tree_util.tree_map(lambda a: a[c], params)
             first = (stage == 0) & (c == 0)
             h_in = jnp.where(first, xs[f], h_recv)
-            out = scan_blocks(block_fn, chunk_params, h_in, unroll=1)
+            # metadata for micro-batch f from the replicated table (ids do
+            # not hop the ring; the unit->micro-batch map is exact)
+            amb = jax.tree_util.tree_map(lambda a: a[f], axs)
+            out = scan_blocks(block_fn, chunk_params, h_in, unroll=1,
+                              aux=amb if has_aux else None)
             retire = (stage == pp - 1) & (c == v - 1) & (u - stage >= 0) \
                 & (u - stage < units)
             outs = jnp.where(
@@ -318,7 +366,7 @@ def _pipeline_interleaved(block_fn, stacked_params, x, n_microbatches,
 
     staged = jax.tree_util.tree_map(stage_major, stacked_params)
     xs, xs_dtype = _widen_boundary(xs)
-    out = jax.jit(run)(staged, xs)
+    out = jax.jit(run)(staged, xs, aux_xs)
     return out.reshape((B,) + x.shape[1:])
 
 
@@ -338,6 +386,7 @@ def pipeline_1f1b(
     y,
     n_microbatches: int | None = None,
     axis: str = "pp",
+    aux: Any = None,
 ):
     """1F1B (PipeDream-flush) pipelined training loss in ONE XLA program.
 
@@ -370,54 +419,61 @@ def pipeline_1f1b(
 
     Returns the scalar mean loss over micro-batches. Grads flow to
     `stacked_params`, `tail_params`, and `x`.
+
+    aux: optional per-token metadata pytree ([B, ...]-leading leaves, e.g.
+    packed segment ids) split with the activation micro-batches; when
+    given, block_fn is called as block_fn(params, h, aux_mb) — both the
+    forward slot (micro-batch f) and the recompute-backward slot
+    (micro-batch b) read the right id rows from the replicated table.
     """
     mesh = get_mesh()
     pp = axis_size(axis)
     if pp == 1:
         # Degenerate pipeline: plain differentiable compute (outer autodiff
         # handles grads; no schedule needed).
-        out = scan_blocks(block_fn, stacked_params, x)
+        out = scan_blocks(block_fn, stacked_params, x, aux=aux)
         return loss_fn(tail_params, out, y)
     return _pipeline_1f1b_vjp(
         block_fn, loss_fn, n_microbatches, axis, stacked_params,
-        tail_params, x, y,
+        tail_params, x, y, aux,
     )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
 def _pipeline_1f1b_vjp(block_fn, loss_fn, n_microbatches, axis,
-                       stacked_params, tail_params, x, y):
+                       stacked_params, tail_params, x, y, aux):
     loss, _ = _pipeline_1f1b_impl(
         block_fn, loss_fn, n_microbatches, axis, stacked_params,
-        tail_params, x, y,
+        tail_params, x, y, aux,
     )
     return loss
 
 
 def _pipeline_1f1b_fwd(block_fn, loss_fn, n_microbatches, axis,
-                       stacked_params, tail_params, x, y):
+                       stacked_params, tail_params, x, y, aux):
     loss, grads = _pipeline_1f1b_impl(
         block_fn, loss_fn, n_microbatches, axis, stacked_params,
-        tail_params, x, y,
+        tail_params, x, y, aux,
     )
-    return loss, (grads, y)
+    return loss, (grads, y, aux)
 
 
 def _pipeline_1f1b_bwd(block_fn, loss_fn, n_microbatches, axis, res, gbar):
-    (dparams, dtail, dx), y = res
+    (dparams, dtail, dx), y, aux = res
     # keep each cotangent's dtype: a bare `a * gbar` would promote bf16
     # leaves to f32 and fail custom_vjp's aval check on bf16 models
     scale = lambda t: jax.tree_util.tree_map(
         lambda a: (a * gbar).astype(a.dtype), t)
     dy = jax.tree_util.tree_map(_label_cotangent, y)
-    return scale(dparams), scale(dtail), (dx * gbar).astype(dx.dtype), dy
+    daux = jax.tree_util.tree_map(_label_cotangent, aux)
+    return scale(dparams), scale(dtail), (dx * gbar).astype(dx.dtype), dy, daux
 
 
 _pipeline_1f1b_vjp.defvjp(_pipeline_1f1b_fwd, _pipeline_1f1b_bwd)
 
 
 def _pipeline_1f1b_impl(block_fn, loss_fn, n_microbatches, axis,
-                        stacked_params, tail_params, x, y):
+                        stacked_params, tail_params, x, y, aux=None):
     """Fused forward+backward 1F1B schedule. Returns
     (mean_loss, (d_stacked_params, d_tail_params, dx))."""
     mesh = get_mesh()
@@ -436,16 +492,18 @@ def _pipeline_1f1b_impl(block_fn, loss_fn, n_microbatches, axis,
     xs = x.reshape((M, B // M) + x.shape[1:])
     ys = jax.tree_util.tree_map(
         lambda a: a.reshape((M, a.shape[0] // M) + a.shape[1:]), y)
+    has_aux = aux is not None
+    aux_xs = _split_aux(aux, M) if has_aux else ()
 
     @functools.partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(P(axis), P(), P(), P()),
+        in_specs=(P(axis), P(), P(), P(), P()),
         out_specs=(P(), (P(axis), P(), P())),
         axis_names=frozenset({axis}),
         check_vma=False,
     )
-    def run(params, tail, xs, ys):
+    def run(params, tail, xs, ys, axs):
         params = jax.tree_util.tree_map(lambda a: a[0], params)
         tail = _narrow_boundary(tail, tail_dtype)
         xs = _narrow_boundary(xs, xs_dtype)
@@ -454,8 +512,9 @@ def _pipeline_1f1b_impl(block_fn, loss_fn, n_microbatches, axis,
         fwd_perm = [(i, i + 1) for i in range(pp - 1)]
         bwd_perm = [(i + 1, i) for i in range(pp - 1)]
 
-        def stage_full(p, tl, h, ymb):
-            out = scan_blocks(block_fn, p, h, unroll=1)
+        def stage_full(p, tl, h, ymb, amb):
+            out = scan_blocks(block_fn, p, h, unroll=1,
+                              aux=amb if has_aux else None)
             loss = jax.lax.cond(
                 is_last,
                 lambda: loss_fn(tl, out, ymb).astype(jnp.float32),
@@ -487,10 +546,12 @@ def _pipeline_1f1b_impl(block_fn, loss_fn, n_microbatches, axis,
 
             y_f = jax.tree_util.tree_map(lambda a: a[f], ys)
             y_b = jax.tree_util.tree_map(lambda a: a[b], ys)
+            aux_f = jax.tree_util.tree_map(lambda a: a[f], axs)
+            aux_b = jax.tree_util.tree_map(lambda a: a[b], axs)
             h_in = jnp.where(stage == 0, xs[f], carry["h_recv"])
 
             def fwd_slot(c):
-                out, loss = stage_full(params, tail, h_in, y_f)
+                out, loss = stage_full(params, tail, h_in, y_f, aux_f)
                 return dict(
                     c,
                     stash=jax.lax.dynamic_update_index_in_dim(
@@ -506,7 +567,7 @@ def _pipeline_1f1b_impl(block_fn, loss_fn, n_microbatches, axis,
                 g_loss = jnp.where(is_last, jnp.float32(1.0 / M),
                                    jnp.float32(0.0))
                 _, vjp_fn = jax.vjp(
-                    lambda p, tl, h: stage_full(p, tl, h, y_b),
+                    lambda p, tl, h: stage_full(p, tl, h, y_b, aux_b),
                     params, tail, h_stash)
                 dp, dtl, dh = vjp_fn((g_out, g_loss))
                 add = lambda acc, g: jax.tree_util.tree_map(
@@ -554,7 +615,7 @@ def _pipeline_1f1b_impl(block_fn, loss_fn, n_microbatches, axis,
     tail_params, tail_dtype = _widen_boundary(tail_params)
     xs, xs_dtype = _widen_boundary(xs)
     # see pipeline_apply: jit makes eager invocation legal (inlines in-trace)
-    loss, (gacc, tacc, dxs) = jax.jit(run)(staged, tail_params, xs, ys)
+    loss, (gacc, tacc, dxs) = jax.jit(run)(staged, tail_params, xs, ys, aux_xs)
     dparams = jax.tree_util.tree_map(
         lambda g, p: g.reshape((L,) + g.shape[2:]).astype(p.dtype),
         gacc, stacked_params)

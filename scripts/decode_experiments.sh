@@ -2,15 +2,15 @@
 # Post-ladder decode investigation: XLA-vs-Pallas attention on the full
 # step, then the step-unroll sweep. Serial — single-tenant chip.
 # Run AFTER the harvest's ladder finishes:
-#   nohup scripts/decode_experiments.sh > /tmp/harvest/decode_exp.log 2>&1 &
+#   nohup scripts/decode_experiments.sh > /tmp/harvest5/decode_exp.log 2>&1 &
 set -u
 cd "$(dirname "$0")/.."
-mkdir -p /tmp/harvest
+mkdir -p /tmp/harvest5
 
 run() {  # run <name> <timeout-seconds> <cmd...>
   local name="$1" to="$2"; shift 2
   echo "$(date -u) == $name"
-  timeout "$to" "$@" > "/tmp/harvest/$name.log" 2>&1
+  timeout "$to" "$@" > "/tmp/harvest5/$name.log" 2>&1
   echo "$(date -u) == $name rc=$?"
 }
 
@@ -18,7 +18,7 @@ run() {  # run <name> <timeout-seconds> <cmd...>
 # tunnel hiccups (remote_compile body closed)
 for attempt in 1 2; do
   run "bisect_try$attempt" 1800 python scripts/decode_bisect.py
-  if grep -q "pallas decode kernel" "/tmp/harvest/bisect_try$attempt.log"; then
+  if grep -q "pallas decode kernel" "/tmp/harvest5/bisect_try$attempt.log"; then
     break
   fi
   echo "$(date -u) bisect attempt $attempt incomplete (tunnel?), retrying"

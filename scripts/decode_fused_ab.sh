@@ -4,12 +4,12 @@
 # existing flash-decode forcing knobs for attribution.
 set -u
 cd "$(dirname "$0")/.."
-mkdir -p /tmp/harvest4
+mkdir -p /tmp/harvest5
 
 run() {
   local name="$1"; shift
   echo "$(date -u) == $name"
-  timeout 1800 "$@" > "/tmp/harvest4/$name.log" 2>&1
+  timeout 1800 "$@" > "/tmp/harvest5/$name.log" 2>&1
   echo "$(date -u) == $name rc=$?"
 }
 

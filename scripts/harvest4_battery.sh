@@ -13,10 +13,13 @@ cd "$(dirname "$0")/.."
 mkdir -p /tmp/harvest5
 
 summarize() {  # rewrite HARVEST_R5.md from whatever logs exist so far
+  # glob ONLY /tmp/harvest5: round-4 logs in /tmp/harvest4 and round-2/3
+  # logs in /tmp/harvest share basenames and would silently mix stale
+  # numbers into the round-5 record
   {
     echo "# Round-5 on-chip harvest (updated $(date -u))"
     echo
-    for f in /tmp/harvest5/*.log /tmp/harvest4/*.log /tmp/harvest/decode_*.log /tmp/harvest/bisect_*.log; do
+    for f in /tmp/harvest5/*.log; do
       [ -f "$f" ] || continue
       echo "## $(basename "$f")"
       echo '```'

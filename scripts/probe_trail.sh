@@ -3,13 +3,22 @@
 # timestamped line per tunnel probe, independent of the harvest daemon
 # (which logs only the first wait and the success).
 #   setsid nohup scripts/probe_trail.sh > /dev/null 2>&1 &
+#
+# The probe takes the exclusive TPU lock, so this logger must never
+# fight the harvest battery for the chip: it skips whole cycles while
+# the battery runs, and EXITS after logging the first UP (from then on
+# the daemon/battery logs are the evidence; an outage trail is only
+# needed while the chip is down).
 set -u
 mkdir -p /tmp/harvest5
 while true; do
-  if timeout 90 python -c "import jax; assert jax.devices()[0].platform in ('tpu','axon')" >/dev/null 2>&1; then
-    echo "$(date -u '+%Y-%m-%d %H:%M:%S') UP" >> /tmp/harvest5/probes.log
+  if pgrep -f harvest4_battery.sh >/dev/null 2>&1; then
+    echo "$(date -u '+%Y-%m-%d %H:%M:%S') BATTERY-RUNNING (probe skipped)" >> /tmp/harvest5/probes.log
+  elif timeout 60 python -c "import jax; assert jax.devices()[0].platform in ('tpu','axon')" >/dev/null 2>&1; then
+    echo "$(date -u '+%Y-%m-%d %H:%M:%S') UP — handing the chip to the harvest daemon; probe trail ends" >> /tmp/harvest5/probes.log
+    exit 0
   else
     echo "$(date -u '+%Y-%m-%d %H:%M:%S') DOWN" >> /tmp/harvest5/probes.log
   fi
-  sleep 300
+  sleep 900
 done

@@ -1,0 +1,173 @@
+"""Packed-sequence (segment-id) inputs through the pipeline axis.
+
+Reference capability class: packed pretraining batches are the standard
+TPU input format (SURVEY §5.7); the reference carries attention metadata
+with activations through its p2p pipeline (pp_utils/p2p_communication.py
+meta handshake). Here the id rows ride `parallel/pipeline.py`'s aux
+threading: split with the activation micro-batches, replicated across
+stages, indexed by the in-flight micro-batch — through gpipe, the
+interleaved schedule, and the fused 1F1B loss.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu import jit, optimizer, parallel
+from paddle_tpu.parallel.pipeline import pipeline_apply, scan_blocks
+from paddle_tpu.models import GPTForCausalLM, gpt_test_config
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+
+def _block_aux(p, h, aux):
+    # aux enters the block so wrong micro-batch pairing shows up as a
+    # numeric mismatch, not a silent no-op
+    return jnp.tanh(h @ p["w"] + p["b"]) + 0.1 * aux
+
+
+def _toy_setup(seed=0, L=8, H=16, B=8):
+    rng = np.random.RandomState(seed)
+    params = {
+        "w": jnp.asarray(rng.randn(L, H, H), jnp.float32) * 0.3,
+        "b": jnp.asarray(rng.randn(L, H), jnp.float32) * 0.1,
+    }
+    x = jnp.asarray(rng.randn(B, H), jnp.float32)
+    aux = jnp.asarray(rng.randn(B, H), jnp.float32)
+    return params, x, aux
+
+
+def test_gpipe_aux_matches_serial():
+    """Every stage must read the aux rows of the micro-batch it is
+    computing (stage s at tick t works on micro-batch t-s)."""
+    parallel.init_mesh(pp=4)
+    mesh = parallel.get_mesh()
+    params, x, aux = _toy_setup()
+    sharded = {k: jax.device_put(v, NamedSharding(mesh, P("pp")))
+               for k, v in params.items()}
+
+    out = jax.jit(lambda p, a, s: pipeline_apply(
+        _block_aux, p, a, n_microbatches=4, aux=s))(sharded, x, aux)
+    ref = jax.jit(lambda p, a, s: scan_blocks(
+        _block_aux, p, a, aux=s))(params, x, aux)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    # grads through the aux-fed pipeline still match serial
+    def loss_pipe(p, a, s):
+        return jnp.sum(pipeline_apply(_block_aux, p, a,
+                                      n_microbatches=4, aux=s) ** 2)
+
+    def loss_ser(p, a, s):
+        return jnp.sum(scan_blocks(_block_aux, p, a, aux=s) ** 2)
+
+    g1 = jax.jit(jax.grad(loss_pipe))(sharded, x, aux)
+    g2 = jax.jit(jax.grad(loss_ser))(params, x, aux)
+    for k in g1:
+        np.testing.assert_allclose(np.asarray(g1[k]), np.asarray(g2[k]),
+                                   atol=1e-4)
+
+
+def test_interleaved_aux_matches_serial():
+    """Virtual-stage schedule: unit k on device s at slot u=k+s must look
+    up micro-batch f(k)'s aux rows."""
+    parallel.init_mesh(pp=2)
+    mesh = parallel.get_mesh()
+    params, x, aux = _toy_setup(seed=3)
+    sharded = {k: jax.device_put(v, NamedSharding(mesh, P("pp")))
+               for k, v in params.items()}
+    out = jax.jit(lambda p, a, s: pipeline_apply(
+        _block_aux, p, a, n_microbatches=4, num_chunks=2, aux=s))(
+            sharded, x, aux)
+    ref = jax.jit(lambda p, a, s: scan_blocks(
+        _block_aux, p, a, aux=s))(params, x, aux)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def _packed_batch(batch=8, seq=32, vocab=128, seed=9):
+    """Every row packs two documents with a random boundary; positions
+    restart at the boundary (the standard packed pretraining triple)."""
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(1, vocab, (batch, seq)).astype("int32")
+    cut = rng.randint(seq // 4, 3 * seq // 4, size=(batch,))
+    ar = np.arange(seq)[None, :]
+    seg = (ar >= cut[:, None]).astype(np.int32)
+    pos = np.where(seg == 0, ar, ar - cut[:, None]).astype(np.int32)
+    labels = np.roll(ids, -1, axis=1).astype("int32")
+    return ids, labels, seg, pos
+
+
+def _packed_losses(mesh_kwargs, schedule="gpipe", chunks=1, steps=3):
+    paddle.seed(42)
+    parallel.init_mesh(**mesh_kwargs)
+    cfg = gpt_test_config(num_hidden_layers=4, stacked_blocks=True,
+                          pp_schedule=schedule, pp_num_chunks=chunks,
+                          pp_num_microbatches=2 if chunks > 1 else 0)
+    model = parallel.place_model(GPTForCausalLM(cfg))
+    opt = optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
+
+    def step(x, y, seg, pos):
+        loss = model.pretrain_loss(x, y, segment_ids=seg, position_ids=pos)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    compiled = jit.compile(step, models=[model], optimizers=[opt])
+    ids, labels, seg, pos = _packed_batch(vocab=128)
+    args = [paddle.to_tensor(a) for a in (ids, labels, seg, pos)]
+    return [float(compiled(*args)) for _ in range(steps)]
+
+
+def test_packed_gpipe_pp2_matches_pp1():
+    """VERDICT r4 item 5 bar: packed pp2 parity vs pp1 (gpipe forward —
+    ids ride pipeline_apply aux)."""
+    ref = _packed_losses(dict())
+    pp2 = _packed_losses(dict(pp=2))
+    np.testing.assert_allclose(pp2, ref, rtol=2e-4)
+
+
+def test_packed_interleave_matches_pp1():
+    ref = _packed_losses(dict(), chunks=1)
+    il = _packed_losses(dict(pp=2), chunks=2)
+    np.testing.assert_allclose(il, ref, rtol=2e-4)
+
+
+def test_packed_1f1b_matches_pp1():
+    """Fused 1F1B loss with packed ids: forward slot f and the
+    recompute-backward slot b both read their own id rows."""
+    ref = _packed_losses(dict(), schedule="1f1b")
+    pp2 = _packed_losses(dict(pp=2), schedule="1f1b")
+    np.testing.assert_allclose(pp2, ref, rtol=2e-4)
+
+
+def test_packed_pp_attention_isolation():
+    """The loss-level parity above could in principle hide a mask bug that
+    cancels in the mean; check logits directly: a packed pp2 forward must
+    equal running each document alone (no cross-document attention
+    through the pipeline)."""
+    paddle.seed(11)
+    parallel.init_mesh(pp=2)
+    cfg = gpt_test_config(num_hidden_layers=4, stacked_blocks=True,
+                          hidden_size=128, intermediate_size=256,
+                          num_attention_heads=2,
+                          max_position_embeddings=64)
+    m = parallel.place_model(GPTForCausalLM(cfg))
+    m.eval()
+    rs = np.random.RandomState(5)
+    la, lb, B = 10, 6, 4
+    doc_a = rs.randint(1, 100, (B, la)).astype("int32")
+    doc_b = rs.randint(1, 100, (B, lb)).astype("int32")
+    packed = np.concatenate([doc_a, doc_b], axis=1)
+    seg = np.tile(np.array([[0] * la + [1] * lb], np.int32), (B, 1))
+    pos = np.tile(np.array([list(range(la)) + list(range(lb))], np.int32),
+                  (B, 1))
+
+    out = m(paddle.to_tensor(packed), position_ids=paddle.to_tensor(pos),
+            segment_ids=paddle.to_tensor(seg)).numpy()
+    out_a = m(paddle.to_tensor(doc_a)).numpy()
+    out_b = m(paddle.to_tensor(doc_b)).numpy()
+    np.testing.assert_allclose(out[:, :la], out_a, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(out[:, la:], out_b, rtol=2e-4, atol=2e-4)
