@@ -430,3 +430,11 @@ _code_level = 0
 _verbosity = 0
 
 __all__ += ["enable_to_static", "set_code_level", "set_verbosity"]
+
+# Staged lists: value-semantics fixed-capacity lists for code that appends
+# under converted (tensor-dependent) control flow — see
+# dy2static/staged_array.py (reference convert_operators.py:117
+# maybe_to_tensor_array).
+from .dy2static import StagedArray, staged_list  # noqa: E402
+
+__all__ += ["StagedArray", "staged_list"]

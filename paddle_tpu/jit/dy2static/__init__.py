@@ -13,6 +13,7 @@ from .convert_operators import (
     Dy2StaticError, UNDEFINED, convert_call, convert_ifelse,
     convert_while, convert_for_range, convert_logical_and,
     convert_logical_or, convert_logical_not, py_cond_guard)
+from .staged_array import StagedArray, staged_list
 from .transformer import convert_to_static
 
 # Reference alias (dy2static.error / Dygraph2StaticException)
@@ -23,4 +24,5 @@ __all__ = [
     "Dygraph2StaticException", "convert_ifelse", "convert_while",
     "convert_for_range", "convert_logical_and", "convert_logical_or",
     "convert_logical_not", "UNDEFINED", "py_cond_guard",
+    "StagedArray", "staged_list",
 ]

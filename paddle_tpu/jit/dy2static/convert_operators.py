@@ -28,6 +28,8 @@ import jax.numpy as jnp
 
 from ...core.tensor import Tensor, TracedValueError
 from ...core.dispatch import apply, unwrap
+from .staged_array import (StagedArray, StagedArrayError,
+                           default_list_capacity, _pending_discards)
 
 __all__ = [
     "Dy2StaticError", "UNDEFINED", "ld", "convert_ifelse",
@@ -35,6 +37,8 @@ __all__ = [
     "convert_logical_and", "convert_logical_or", "convert_logical_not",
     "py_cond_guard", "convert_call", "convert_indexable", "convert_len",
     "convert_zip_len", "check_range_step", "range_trip_bound",
+    "convert_append", "convert_extend", "convert_pop_stmt",
+    "convert_clear", "convert_setitem",
 ]
 
 
@@ -122,6 +126,8 @@ def _select_pair(pred, t, f, name):
             f"tensor-dependent if and undefined in the other "
             f"({which!r}); initialize it before the if so both branches "
             "produce a value")
+    if isinstance(t, StagedArray) or isinstance(f, StagedArray):
+        return _select_staged_pair(pred, t, f, name)
     t_tensor = _is_tensorish(t)
     f_tensor = _is_tensorish(f)
     if t_tensor or f_tensor:
@@ -144,6 +150,46 @@ def _select_pair(pred, t, f, name):
         f"variable '{name}' takes different non-tensor Python values in "
         f"the branches of a tensor-dependent if ({t!r} vs {f!r}); make it "
         "a Tensor or restructure the branches")
+
+
+def _select_staged_pair(pred, t, f, name):
+    """Select between the two branches' versions of a staged list: a
+    plain-list side coerces (a branch that never appended), buffers pad
+    to the larger capacity, then data/length select leaf-wise."""
+    def coerce(v, other):
+        if isinstance(v, StagedArray):
+            return v
+        if isinstance(v, list):
+            if not _tensor_list_stageable(v):
+                raise Dy2StaticError(
+                    f"variable '{name}': one branch of a tensor-dependent "
+                    "if staged this list, but the other holds non-tensor "
+                    f"elements ({_safe_repr(v)})")
+            try:
+                return StagedArray.from_list(
+                    v, elem_like=None if v else other.data[0])
+            except StagedArrayError as e:
+                raise Dy2StaticError(f"variable '{name}': {e}") from e
+        raise Dy2StaticError(
+            f"variable '{name}' is a staged list in one branch of a "
+            f"tensor-dependent if but {type(v).__name__} in the other; "
+            "both branches must treat it as a list")
+
+    ts = coerce(t, f if isinstance(f, StagedArray) else None)
+    fs = coerce(f, ts)
+    if ts.elem_shape != fs.elem_shape or ts.dtype != fs.dtype:
+        raise Dy2StaticError(
+            f"variable '{name}': the branches of a tensor-dependent if "
+            f"append different element shapes/dtypes to this list "
+            f"({ts.elem_shape}/{ts.dtype} vs {fs.elem_shape}/{fs.dtype})")
+    cap = max(ts.capacity, fs.capacity)
+    ts, fs = ts.reserve(cap - ts.capacity), fs.reserve(cap - fs.capacity)
+    data = apply(lambda p, a, b: jnp.where(p, a, b), pred, ts.data, fs.data,
+                 name="ifelse_select")
+    length = apply(lambda p, a, b: jnp.where(p, a, b), pred, ts.length,
+                   fs.length, name="ifelse_select")
+    return StagedArray(data, length,
+                       loop_fixed=ts._loop_fixed or fs._loop_fixed)
 
 
 def _snapshot_mutables(vals):
@@ -209,14 +255,14 @@ def convert_ifelse_ret(pred, true_fn, false_fn, init_vals, lineno):
     if not _is_tracer_val(pred):
         return true_fn(init_vals) if _truthy(pred) else false_fn(init_vals)
     snaps = _snapshot_mutables(init_vals)
-    t_out = true_fn(init_vals)
-    _check_mutations(snaps, None, f"line {lineno}")
-    f_out = false_fn(init_vals)
-    _check_mutations(snaps, None, f"line {lineno}")
-    t_leaves, t_def = jax.tree_util.tree_flatten(
-        t_out, is_leaf=lambda v: isinstance(v, (Tensor, _Undefined)))
-    f_leaves, f_def = jax.tree_util.tree_flatten(
-        f_out, is_leaf=lambda v: isinstance(v, (Tensor, _Undefined)))
+    with _staging_region():
+        t_out = true_fn(init_vals)
+        _check_mutations(snaps, None, f"line {lineno}")
+        f_out = false_fn(init_vals)
+        _check_mutations(snaps, None, f"line {lineno}")
+    is_leaf = lambda v: isinstance(v, (Tensor, _Undefined, StagedArray))
+    t_leaves, t_def = jax.tree_util.tree_flatten(t_out, is_leaf=is_leaf)
+    f_leaves, f_def = jax.tree_util.tree_flatten(f_out, is_leaf=is_leaf)
     if t_def != f_def:
         raise Dy2StaticError(
             f"line {lineno}: the early-return branches of a "
@@ -234,10 +280,22 @@ def convert_ifelse(pred, true_fn, false_fn, init_vals, names):
     if not _is_tracer_val(pred):
         return true_fn(init_vals) if _truthy(pred) else false_fn(init_vals)
     snaps = _snapshot_mutables(init_vals)
-    t_out = true_fn(init_vals)
-    _check_mutations(snaps, names, "if")
-    f_out = false_fn(init_vals)
-    _check_mutations(snaps, names, "if")
+    pre = [(v, v._superseded) for v in init_vals
+           if isinstance(v, StagedArray)]
+    pre_auto = set(_AUTO_STAGED)
+    with _staging_region():
+        t_out = true_fn(init_vals)
+        _check_mutations(snaps, names, "if")
+        _check_superseded(t_out, names, "if (true branch)")
+        # marks made by the true branch are its own: the false branch
+        # legitimately returns the unmutated input objects
+        for v, flag in pre:
+            v._superseded = flag
+        for k in [k for k in _AUTO_STAGED if k not in pre_auto]:
+            del _AUTO_STAGED[k]
+        f_out = false_fn(init_vals)
+        _check_mutations(snaps, names, "if")
+        _check_superseded(f_out, names, "if (false branch)")
     return tuple(
         _select_pair(pred, t, f, n)
         for t, f, n in zip(t_out, f_out, names))
@@ -274,7 +332,8 @@ def range_trip_bound(start, stop, step):
 _BOUND_UNROLL_LIMIT = int(os.environ.get("PTPU_DY2STATIC_BOUND_UNROLL", "64"))
 
 
-def convert_while(cond_fn, body_fn, init_vals, names, bound=None):
+def convert_while(cond_fn, body_fn, init_vals, names, bound=None,
+                  mutated=()):
     """while over loop vars `names`. cond_fn: vals -> bool-ish;
     body_fn: vals -> vals. `bound`: statically-known max trip count (from
     a rewritten for-range) — when present and modest, the staged lowering
@@ -303,6 +362,14 @@ def convert_while(cond_fn, body_fn, init_vals, names, bound=None):
         v if isinstance(v, Tensor) or not isinstance(v, (int, float, bool))
         else Tensor(jnp.asarray(v))
         for v in init_vals)
+    # lists the body mutates become loop_fixed StagedArrays (the carry
+    # structure of a staged while cannot change per iteration)
+    vals = _stage_loop_lists(vals, names, frozenset(mutated), bound)
+
+    def body_checked(vs):
+        out = tuple(body_fn(vs))
+        _check_superseded(out, names, "while body")
+        return out
     max_trip = (int(bound) if bound is not None
                 and int(bound) <= _BOUND_UNROLL_LIMIT else None)
     if bound is not None and max_trip is None:
@@ -316,9 +383,10 @@ def convert_while(cond_fn, body_fn, init_vals, names, bound=None):
             "the bounded differentiable (unrolled) lowering.",
             stacklevel=2)
     try:
-        out = while_loop(lambda *vs: cond_fn(tuple(vs)),
-                         lambda *vs: tuple(body_fn(tuple(vs))),
-                         list(vals), maximum_trip_count=max_trip)
+        with _staging_region():
+            out = while_loop(lambda *vs: cond_fn(tuple(vs)),
+                             lambda *vs: body_checked(tuple(vs)),
+                             list(vals), maximum_trip_count=max_trip)
     except (TracedValueError,
             jax.errors.ConcretizationTypeError,
             jax.errors.TracerArrayConversionError,
@@ -336,11 +404,11 @@ def convert_while(cond_fn, body_fn, init_vals, names, bound=None):
             f"tensor-dependent while over {names}: the loop body must "
             f"keep every loop variable's shape/dtype stable across "
             f"iterations ({e})") from e
-    return tuple(out)
+    return _unfix_loop_lists(tuple(out))
 
 
 def convert_for_range(start, stop, step, body_fn, init_vals, names,
-                      target_name=None):
+                      target_name=None, mutated=()):
     """for <target> in range(start, stop, step) over assigned vars
     `names` (including the loop target, which stays bound after the
     loop). body_fn: (index, vals) -> vals."""
@@ -391,7 +459,7 @@ def convert_for_range(start, stop, step, body_fn, init_vals, names,
         return (nxt,) + tuple(new)
 
     out = convert_while(cond_fn, body, (i0,) + tuple(init_vals),
-                        ("<for-index>",) + tuple(names))
+                        ("<for-index>",) + tuple(names), mutated=mutated)
     return tuple(out[1:])
 
 
@@ -506,6 +574,238 @@ def check_range_step(step):
         if int(unwrap(step)) == 0:
             raise ValueError("range() arg 3 must not be zero")
     return step
+
+
+# --------------------------------------------------------------------------
+# staged list mutation (reference convert_operators.py:117
+# maybe_to_tensor_array + loop_transformer.py list push/pop machinery;
+# TPU re-design in staged_array.py). The transformer rewrites
+# statement-position `name.append(x)` / `.extend` / `.pop()` / `.clear()`
+# and `name[i] = v` on function-local names into
+# `name = _ptpu_dy2st.convert_append(name, x)`-style REBINDING assignments.
+# At runtime these keep exact in-place Python semantics for ordinary
+# objects (mutate, return the same object — aliases still see the change)
+# and switch to pure StagedArray updates inside staged control flow.
+# --------------------------------------------------------------------------
+
+# >0 while tracing the branches/body of a tensor-dependent if/while: the
+# signal that an in-place Python container mutation would leak into the
+# not-taken branch and must become a staged (pure) update instead.
+_STAGING_DEPTH = 0
+
+# plain Python lists auto-staged during the current staged region, keyed
+# by id (strong refs keep ids stable). If such a list re-surfaces as a
+# carried/branch-output value, the pure StagedArray replacing it was
+# DISCARDED (mutation through a helper that did not return the list) —
+# loud error instead of silently dropping the append.
+_AUTO_STAGED: dict = {}
+
+
+class _staging_region:
+    def __enter__(self):
+        global _STAGING_DEPTH
+        _STAGING_DEPTH += 1
+
+    def __exit__(self, *exc):
+        global _STAGING_DEPTH
+        _STAGING_DEPTH -= 1
+        if _STAGING_DEPTH == 0:
+            _AUTO_STAGED.clear()
+
+
+def _tensor_list_stageable(lst):
+    """Can this plain Python list become a StagedArray? Every element a
+    Tensor/array/number (uniformity of shape/dtype is checked by
+    from_list, which raises the actionable error)."""
+    import numbers
+
+    import numpy as np
+
+    return all(isinstance(e, (Tensor, jnp.ndarray, jax.Array, np.ndarray,
+                              numbers.Number, bool)) for e in lst)
+
+
+def _auto_stage_list(lst, name="<list>"):
+    """Plain list -> growing StagedArray at the point a staged region
+    first mutates it (if-branch case: append count is a trace-time
+    constant, so the buffer grows statically — no headroom needed)."""
+    _AUTO_STAGED[id(lst)] = lst
+    if not _tensor_list_stageable(lst):
+        raise Dy2StaticError(
+            f"the list '{name}' is mutated under tensor-dependent control "
+            "flow but holds non-tensor elements "
+            f"({_safe_repr(lst)}); only lists of same-shape tensors/"
+            "numbers can be staged")
+    try:
+        return StagedArray.from_list(lst)
+    except StagedArrayError as e:
+        raise Dy2StaticError(f"list '{name}': {e}") from e
+
+
+def _staged_mutation_guard(obj, what):
+    """At staging depth, an in-place mutation of anything but a list (a
+    dict/set/deque/user object) cannot be made pure — loud error."""
+    raise Dy2StaticError(
+        f"{what} on a {type(obj).__name__} under tensor-dependent "
+        "control flow mutates shared state (staged branches run BOTH "
+        "sides); only lists of same-shape tensors stage automatically — "
+        "restructure the mutation")
+
+
+def convert_append(obj, x):
+    if isinstance(obj, StagedArray):
+        return obj.append(x)
+    if isinstance(obj, _Undefined):
+        obj._raise()
+    if _STAGING_DEPTH > 0:
+        if isinstance(obj, list):
+            return _auto_stage_list(obj).append(x)
+        _staged_mutation_guard(obj, ".append(...)")
+    obj.append(x)
+    return obj
+
+
+def convert_extend(obj, it):
+    if isinstance(obj, StagedArray):
+        return obj + list(it)
+    if isinstance(obj, _Undefined):
+        obj._raise()
+    if _STAGING_DEPTH > 0:
+        if isinstance(obj, list):
+            return _auto_stage_list(obj) + list(it)
+        _staged_mutation_guard(obj, ".extend(...)")
+    obj.extend(it)
+    return obj
+
+
+def convert_pop_stmt(obj, *args):
+    """Statement-position `.pop(...)` (the popped value is discarded)."""
+    if isinstance(obj, StagedArray):
+        if args:
+            raise Dy2StaticError(
+                "pop(index) on a staged list is not supported (a staged "
+                "pop can only drop the LAST element); restructure, or "
+                "keep the loop predicate a Python value")
+        _, rest = obj.pop()
+        return rest
+    if isinstance(obj, _Undefined):
+        obj._raise()
+    if _STAGING_DEPTH > 0:
+        if isinstance(obj, list):
+            if args:
+                raise Dy2StaticError(
+                    "pop(index) under tensor-dependent control flow is "
+                    "not stageable; only pop() of the last element is")
+            _, rest = _auto_stage_list(obj).pop()
+            return rest
+        _staged_mutation_guard(obj, ".pop(...)")
+    obj.pop(*args)
+    return obj
+
+
+def convert_clear(obj):
+    if isinstance(obj, StagedArray):
+        return StagedArray(obj.data,
+                           Tensor(jnp.asarray(0, jnp.int32)),
+                           loop_fixed=obj._loop_fixed)
+    if isinstance(obj, _Undefined):
+        obj._raise()
+    if _STAGING_DEPTH > 0:
+        if isinstance(obj, list) and obj:
+            cleared = _auto_stage_list(obj)
+            return StagedArray(cleared.data,
+                               Tensor(jnp.asarray(0, jnp.int32)),
+                               loop_fixed=False)
+        if isinstance(obj, list):
+            return obj   # clearing an empty list: no-op either way
+        _staged_mutation_guard(obj, ".clear()")
+    obj.clear()
+    return obj
+
+
+def convert_setitem(obj, key, val):
+    """`name[key] = val` rewritten as a rebinding assignment. Ordinary
+    objects mutate in place (exact Python semantics, same object
+    returned); a StagedArray takes a pure indexed write; in-place
+    container/tensor writes inside a STAGED region are loud errors (both
+    branches of a staged if run — the write would leak)."""
+    if isinstance(obj, StagedArray):
+        if isinstance(key, slice):
+            raise Dy2StaticError(
+                "slice assignment on a staged list is not supported")
+        return obj.set(key, val)
+    if isinstance(obj, _Undefined):
+        obj._raise()
+    if _STAGING_DEPTH > 0:
+        if isinstance(obj, list) and not isinstance(key, slice):
+            return _auto_stage_list(obj).set(key, val)
+        raise Dy2StaticError(
+            f"indexed write into a {type(obj).__name__} under "
+            "tensor-dependent control flow mutates shared state (staged "
+            "branches run BOTH sides); use a list of tensors (staged "
+            "automatically) or restructure the write")
+    obj[key] = val
+    return obj
+
+
+def _stage_loop_lists(vals, names, mutated, bound):
+    """At the point a while stages: convert the plain-Python lists the
+    loop body MUTATES (statically detected by the transformer) into
+    loop_fixed StagedArrays. Capacity = current length + the static trip
+    bound when known (one append per iteration — more overflows loudly at
+    materialization), else PTPU_DY2STATIC_LIST_CAPACITY. Lists the body
+    does NOT mutate stay plain (they are loop-invariant pytrees, and
+    converting them would needlessly trace their reads)."""
+    if not mutated:
+        return vals
+    head = (int(bound) if bound is not None else default_list_capacity())
+    out = list(vals)
+    for i, (v, n) in enumerate(zip(vals, names)):
+        if n not in mutated:
+            continue
+        if isinstance(v, list):
+            if not _tensor_list_stageable(v):
+                raise Dy2StaticError(
+                    f"the list '{n}' is mutated inside a tensor-dependent "
+                    "loop but holds non-tensor elements; only lists of "
+                    "same-shape tensors/numbers can be staged")
+            try:
+                out[i] = StagedArray.from_list(
+                    v, headroom=head, loop_fixed=True)
+            except StagedArrayError as e:
+                raise Dy2StaticError(f"list '{n}': {e}") from e
+        elif isinstance(v, StagedArray):
+            if not v._loop_fixed:
+                out[i] = v.reserve(head).with_loop_fixed(True)
+    return tuple(out)
+
+
+def _unfix_loop_lists(vals):
+    """Post-loop: drop the loop_fixed flag so later appends grow again."""
+    return tuple(
+        v.with_loop_fixed(False) if isinstance(v, StagedArray) else v
+        for v in vals)
+
+
+def _check_superseded(vals, names, where):
+    if _pending_discards:
+        msg = _pending_discards[0]
+        _pending_discards.clear()
+        raise Dy2StaticError(f"{where}: {msg}")
+    for v, n in zip(vals, names):
+        if isinstance(v, StagedArray):
+            try:
+                v.check_not_superseded(n)
+            except StagedArrayError as e:
+                raise Dy2StaticError(f"{where}: {e}") from e
+        elif isinstance(v, list) and id(v) in _AUTO_STAGED:
+            raise Dy2StaticError(
+                f"{where}: the list '{n}' was mutated under tensor-"
+                "dependent control flow through a helper function whose "
+                "result was discarded — staged lists have VALUE "
+                "semantics, so the mutation was lost. Return the list "
+                "from the helper and rebind it (`lst = helper(lst, x)`), "
+                "or mutate it directly in the converted function body.")
 
 
 # --------------------------------------------------------------------------

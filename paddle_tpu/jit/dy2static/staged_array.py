@@ -1,0 +1,423 @@
+"""Staged, fixed-capacity list for converted control flow (reference:
+python/paddle/jit/dy2static/convert_operators.py:117 `maybe_to_tensor_array`
+and the LoDTensorArray push/pop machinery in loop_transformer.py).
+
+TPU-native re-design: the reference converts lists mutated under converted
+control flow into LoDTensorArray — a dynamically-sized runtime container
+its executor can grow per iteration. XLA has no dynamically-sized values,
+so the staged form here is a **value-semantics ring of static shape**:
+
+    data   : Tensor [capacity, *elem_shape]   (rows >= length are padding)
+    length : Tensor int32 scalar              (concrete or traced)
+
+Every mutation returns a NEW StagedArray (pure — required so the staged
+while/if machinery can carry and select it leaf-wise).  Two regimes:
+
+- **growing** (``loop_fixed=False``): each `append` statically widens the
+  buffer by one row (shapes are static per program point, so this is free
+  under trace).  This is the regime inside staged `if` branches, where
+  the number of appends is a trace-time constant.
+- **loop-fixed** (``loop_fixed=True``): inside a `lax.while_loop` carry
+  the buffer shape must be loop-invariant, so `append` writes in place at
+  `length` via a dynamic update and only bumps `length`.  Appends beyond
+  `capacity` clamp the write and push `length` past `capacity`; the
+  overflow is detected loudly at the first materialization (`__len__`,
+  `stack`, indexing with a concrete length) rather than silently
+  truncating.
+
+Aliasing: plain-Python ``lst.append`` mutates in place, so aliases see
+the change; a StagedArray has VALUE semantics — only the rebound name
+sees the append.  Mutating a staged list through a helper function that
+does not return it therefore silently drops the mutation; appends mark
+the superseded value so the staging machinery can detect that shape and
+raise (see `mark_superseded` / `check_not_superseded`).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...core.dispatch import apply, unwrap
+
+__all__ = ["StagedArray", "staged_list", "default_list_capacity"]
+
+
+def default_list_capacity():
+    """Headroom for staged lists in loops with no static trip bound."""
+    return int(os.environ.get("PTPU_DY2STATIC_LIST_CAPACITY", "4096"))
+
+
+def _is_tracer(v):
+    a = unwrap(v) if isinstance(v, Tensor) else v
+    return isinstance(a, jax.core.Tracer)
+
+
+def _as_tensor(v):
+    return v if isinstance(v, Tensor) else Tensor(jnp.asarray(v))
+
+
+class StagedArrayError(Exception):
+    pass
+
+
+# Discard-detection (see convert_operators convert_append): an
+# auto-staged list's StagedArray must eventually be CONSUMED — carried,
+# selected, read, or fed to another mutation. One that dies unconsumed
+# means a helper mutated a list and dropped the pure result (the append
+# would silently vanish); its __del__ records the fact here and the
+# staging machinery raises at the region boundary. CPython refcounting
+# makes the __del__ fire deterministically at helper-frame exit.
+_pending_discards: list = []
+
+
+class StagedArray:
+    """See module docstring.  Construct via `from_list` / `staged_list`."""
+
+    def __init__(self, data: Tensor, length: Tensor, loop_fixed: bool = False):
+        self._data = data
+        self._length = length
+        self._loop_fixed = bool(loop_fixed)
+        self._superseded = False
+        self._must_consume = False
+        self._consumed = False
+
+    def __del__(self):
+        try:
+            if self._must_consume and not self._consumed:
+                _pending_discards.append(
+                    "a staged list was mutated through a helper function "
+                    "whose result was discarded — staged lists have VALUE "
+                    "semantics, so the mutation was lost. Return the list "
+                    "from the helper and rebind it "
+                    "(`lst = helper(lst, x)`), or mutate it directly in "
+                    "the converted function body.")
+        except Exception:
+            pass
+
+    def _touch(self):
+        self._consumed = True
+
+    def _derive(self, out: "StagedArray") -> "StagedArray":
+        """Mutation result inherits the must-consume obligation; the
+        source fed a chain, which counts as consumption."""
+        self._consumed = True
+        out._must_consume = self._must_consume
+        return out
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def from_list(cls, elems, headroom=0, loop_fixed=False,
+                  elem_like=None):
+        """Stack `elems` (Tensors / numerics) into a staged buffer with
+        `headroom` extra rows.  Empty `elems` needs `elem_like` (a Tensor
+        or array giving the element shape/dtype)."""
+        if not elems and elem_like is None:
+            raise StagedArrayError(
+                "cannot stage an empty list without an element example: "
+                "seed the list with its first element before the loop, or "
+                "pre-size it with paddle_tpu.jit.staged_list(capacity, "
+                "example)")
+        if elems:
+            rows = [_as_tensor(e) for e in elems]
+            try:
+                data = apply(lambda *rs: jnp.stack([jnp.asarray(r)
+                                                    for r in rs]),
+                             *rows, name="staged_list_init")
+            except (ValueError, TypeError) as e:
+                raise StagedArrayError(
+                    "a list mutated under converted control flow must hold "
+                    f"same-shape, same-dtype elements to be staged ({e})"
+                ) from e
+        else:
+            ex = _as_tensor(elem_like)
+            data = apply(lambda x: jnp.zeros((0,) + jnp.asarray(x).shape,
+                                             jnp.asarray(x).dtype),
+                         ex, name="staged_list_init")
+        n = int(headroom)
+        if n > 0:
+            data = apply(
+                lambda d: jnp.concatenate(
+                    [jnp.asarray(d),
+                     jnp.zeros((n,) + jnp.asarray(d).shape[1:],
+                               jnp.asarray(d).dtype)]),
+                data, name="staged_list_reserve")
+        length = Tensor(jnp.asarray(len(elems), jnp.int32))
+        return cls(data, length, loop_fixed=loop_fixed)
+
+    # -- static facts -------------------------------------------------------
+
+    @property
+    def capacity(self) -> int:
+        return int(self._data.shape[0])
+
+    @property
+    def elem_shape(self):
+        return tuple(self._data.shape[1:])
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def length(self) -> Tensor:
+        """Current element count as a (possibly traced) int32 Tensor."""
+        self._touch()
+        return self._length
+
+    @property
+    def data(self) -> Tensor:
+        """The raw [capacity, *elem] buffer; rows >= length are padding."""
+        self._touch()
+        return self._data
+
+    def with_loop_fixed(self, flag: bool) -> "StagedArray":
+        return self._derive(
+            StagedArray(self._data, self._length, loop_fixed=flag))
+
+    def reserve(self, headroom: int) -> "StagedArray":
+        """Widen the buffer by `headroom` zero rows (static grow)."""
+        n = int(headroom)
+        if n <= 0:
+            return self
+        data = apply(
+            lambda d: jnp.concatenate(
+                [jnp.asarray(d),
+                 jnp.zeros((n,) + jnp.asarray(d).shape[1:],
+                           jnp.asarray(d).dtype)]),
+            self._data, name="staged_list_reserve")
+        return self._derive(
+            StagedArray(data, self._length, loop_fixed=self._loop_fixed))
+
+    # -- concretization guards ---------------------------------------------
+
+    def _concrete_length(self, what):
+        self._touch()
+        if _is_tracer(self._length):
+            raise StagedArrayError(
+                f"{what} needs the CONCRETE length of a staged list, but "
+                "the length is a traced tensor here (it depends on staged "
+                "control flow). Use `.length` (a Tensor), `.stack(...)` "
+                "(padded to capacity), or index with a Tensor instead.")
+        n = int(unwrap(self._length))
+        if n > self.capacity:
+            raise StagedArrayError(
+                f"staged list overflowed: {n} appends landed in a buffer "
+                f"of capacity {self.capacity} inside a loop with no static "
+                "trip bound. Raise PTPU_DY2STATIC_LIST_CAPACITY, give the "
+                "loop a static bound, or pre-size the list with "
+                "paddle_tpu.jit.staged_list(capacity, example).")
+        if n < 0:
+            raise StagedArrayError(
+                "staged list underflowed: more pops than elements")
+        return n
+
+    # -- mutation (pure) ----------------------------------------------------
+
+    def _check_elem(self, x: Tensor):
+        if tuple(x.shape) != self.elem_shape:
+            raise StagedArrayError(
+                f"staged list of elements {self.elem_shape} cannot hold an "
+                f"element of shape {tuple(x.shape)}: every element of a "
+                "list mutated under converted control flow must keep one "
+                "static shape")
+
+    def append(self, x) -> "StagedArray":
+        x = _as_tensor(x)
+        self._check_elem(x)
+        data, length = self._data, self._length
+        if not self._loop_fixed:
+            data = apply(
+                lambda d: jnp.concatenate(
+                    [jnp.asarray(d),
+                     jnp.zeros((1,) + jnp.asarray(d).shape[1:],
+                               jnp.asarray(d).dtype)]),
+                data, name="staged_list_grow")
+        cap = int(data.shape[0])
+        new_data = apply(
+            lambda d, v, n: jax.lax.dynamic_update_index_in_dim(
+                jnp.asarray(d),
+                jnp.asarray(v).astype(jnp.asarray(d).dtype),
+                jnp.clip(jnp.asarray(n), 0, max(cap - 1, 0)), 0),
+            data, x, length, name="staged_list_append")
+        new_len = apply(lambda n: jnp.asarray(n) + 1, length,
+                        name="staged_list_len")
+        self._superseded = True
+        return self._derive(
+            StagedArray(new_data, new_len, loop_fixed=self._loop_fixed))
+
+    def pop(self):
+        """(last element, rest) — pure; pop-at-index is not stageable."""
+        cap = max(self.capacity - 1, 0)
+        if not _is_tracer(self._length):
+            n = self._concrete_length("pop() on a staged list")
+            if n == 0:
+                raise IndexError("pop from empty staged list")
+        elem = apply(
+            lambda d, n: jnp.asarray(d)[
+                jnp.clip(jnp.asarray(n) - 1, 0, cap)],
+            self._data, self._length, name="staged_list_pop")
+        new_len = apply(lambda n: jnp.asarray(n) - 1, self._length,
+                        name="staged_list_len")
+        self._superseded = True
+        return elem, self._derive(
+            StagedArray(self._data, new_len, loop_fixed=self._loop_fixed))
+
+    def set(self, i, v) -> "StagedArray":
+        v = _as_tensor(v)
+        self._check_elem(v)
+        cap = max(self.capacity - 1, 0)
+        if not _is_tracer(i) and not _is_tracer(self._length):
+            n = self._concrete_length("indexed write on a staged list")
+            ii = int(unwrap(i)) if isinstance(i, Tensor) else int(i)
+            if not -n <= ii < n:
+                raise IndexError(
+                    f"staged list assignment index {ii} out of range "
+                    f"for length {n}")
+        idx = apply(
+            lambda i_, n: jnp.clip(
+                jnp.where(jnp.asarray(i_) < 0,
+                          jnp.asarray(i_) + jnp.asarray(n),
+                          jnp.asarray(i_)), 0, cap),
+            _as_tensor(i), self._length, name="staged_list_idx")
+        new_data = apply(
+            lambda d, v_, i_: jax.lax.dynamic_update_index_in_dim(
+                jnp.asarray(d),
+                jnp.asarray(v_).astype(jnp.asarray(d).dtype),
+                jnp.asarray(i_), 0),
+            self._data, v, idx, name="staged_list_set")
+        self._superseded = True
+        return self._derive(
+            StagedArray(new_data, self._length,
+                        loop_fixed=self._loop_fixed))
+
+    # -- reads --------------------------------------------------------------
+
+    def __getitem__(self, i):
+        self._touch()
+        if isinstance(i, slice):
+            n = self._concrete_length("slicing a staged list")
+            return [self[j] for j in range(*i.indices(n))]
+        cap = max(self.capacity - 1, 0)
+        if not _is_tracer(i) and not _is_tracer(self._length):
+            n = self._concrete_length("indexing a staged list")
+            ii = int(unwrap(i)) if isinstance(i, Tensor) else int(i)
+            if not -n <= ii < n:
+                raise IndexError(
+                    f"staged list index {ii} out of range for length {n}")
+        idx = apply(
+            lambda i_, n: jnp.clip(
+                jnp.where(jnp.asarray(i_) < 0,
+                          jnp.asarray(i_) + jnp.asarray(n),
+                          jnp.asarray(i_)), 0, cap),
+            _as_tensor(i), self._length, name="staged_list_idx")
+        return apply(lambda d, i_: jnp.asarray(d)[jnp.asarray(i_)],
+                     self._data, idx, name="staged_list_get")
+
+    def __len__(self):
+        return self._concrete_length("len() on a staged list")
+
+    def __iter__(self):
+        n = self._concrete_length("iterating a staged list")
+        return iter(self[j] for j in range(n))
+
+    def __add__(self, other):
+        out = self
+        for e in list(other):
+            out = out.append(e)
+        return out
+
+    def __bool__(self):
+        if _is_tracer(self._length):
+            raise StagedArrayError(
+                "truth value of a staged list with traced length; compare "
+                "`.length` against 0 instead")
+        return self._concrete_length("bool() on a staged list") > 0
+
+    def stack(self, pad_value=None) -> Tensor:
+        """The elements as one Tensor.  Concrete length -> exactly
+        [length, *elem].  Traced length -> the FULL [capacity, *elem]
+        buffer with rows >= length set to `pad_value` (required then:
+        XLA shapes are static, so a traced-length result cannot be
+        sliced to size)."""
+        self._touch()
+        if not _is_tracer(self._length):
+            n = self._concrete_length("stack() on a staged list")
+            return apply(lambda d: jnp.asarray(d)[:n], self._data,
+                         name="staged_list_stack")
+        if pad_value is None:
+            raise StagedArrayError(
+                "stack() on a staged list whose length is traced: pass "
+                "pad_value= to get the full capacity-padded buffer (rows "
+                ">= .length are set to pad_value), e.g. "
+                "tokens.stack(pad_value=0)")
+        return apply(
+            lambda d, n: jnp.where(
+                (jnp.arange(jnp.asarray(d).shape[0])
+                 < jnp.asarray(n)).reshape(
+                     (-1,) + (1,) * (jnp.asarray(d).ndim - 1)),
+                jnp.asarray(d),
+                jnp.asarray(pad_value).astype(jnp.asarray(d).dtype)),
+            self._data, self._length, name="staged_list_stack")
+
+    def to_list(self):
+        n = self._concrete_length("to_list() on a staged list")
+        return [self[j] for j in range(n)]
+
+    def __repr__(self):
+        ln = ("?" if _is_tracer(self._length)
+              else str(int(unwrap(self._length))))
+        return (f"StagedArray(len={ln}, capacity={self.capacity}, "
+                f"elem={self.elem_shape}, dtype={self.dtype}, "
+                f"loop_fixed={self._loop_fixed})")
+
+    # -- supersession check (see module docstring) --------------------------
+
+    def check_not_superseded(self, name="<list>"):
+        if self._superseded:
+            raise StagedArrayError(
+                f"the staged list '{name}' was appended/popped through an "
+                "alias or helper function whose result was discarded — "
+                "staged lists have VALUE semantics, so the mutation was "
+                "lost. Return the list from the helper and rebind it "
+                "(`lst = helper(lst, x)`), or mutate it directly in the "
+                "converted function body.")
+
+
+def _staged_flatten(sa: StagedArray):
+    # children flatten to RAW arrays so a StagedArray crosses jax.jit /
+    # lax control-flow boundaries natively (Tensor is deliberately not a
+    # registered pytree); unflatten re-wraps. Being flattened = being
+    # carried/selected/returned, which consumes the value.
+    sa._consumed = True
+    return ((unwrap(sa._data), unwrap(sa._length)), (sa._loop_fixed,))
+
+
+def _staged_unflatten(aux, children):
+    data, length = children
+    data = data if isinstance(data, Tensor) else Tensor(jnp.asarray(data))
+    length = (length if isinstance(length, Tensor)
+              else Tensor(jnp.asarray(length)))
+    return StagedArray(data, length, loop_fixed=aux[0])
+
+
+jax.tree_util.register_pytree_node(
+    StagedArray, _staged_flatten, _staged_unflatten)
+
+
+def staged_list(capacity, example=None, values=()):
+    """Pre-sized staged list for converted control flow (public API,
+    exported as paddle_tpu.jit.staged_list).
+
+    `example`: a Tensor/array giving the element shape+dtype (required
+    when `values` is empty).  `values`: initial elements."""
+    vals = list(values)
+    head = int(capacity) - len(vals)
+    if head < 0:
+        raise ValueError(
+            f"staged_list capacity {capacity} is smaller than the "
+            f"{len(vals)} initial values")
+    return StagedArray.from_list(vals, headroom=head, elem_like=example)

@@ -268,6 +268,148 @@ def _name(id_, ctx=None):
     return ast.Name(id=id_, ctx=ctx or ast.Load())
 
 
+# -- list-mutation pre-pass (reference convert_operators.py:117
+# maybe_to_tensor_array + loop_transformer.py list push/pop name machinery,
+# re-designed as runtime dispatch): statement-position mutations of
+# FUNCTION-LOCAL names rewrite into REBINDING assignments through
+# convert_* helpers that keep exact in-place Python semantics for ordinary
+# objects and switch to pure StagedArray updates under staged control
+# flow. Rebinding makes the name an assigned loop/branch variable, so the
+# ordinary carry machinery threads the staged list with no extra cases. --
+
+_REWRITE_METHODS = {
+    "append": "convert_append",
+    "extend": "convert_extend",
+    "pop": "convert_pop_stmt",
+    "clear": "convert_clear",
+}
+
+_LIST_MUTATORS = frozenset(
+    list(_REWRITE_METHODS.values()) + ["convert_setitem"])
+
+
+class _MutationRewriter(ast.NodeTransformer):
+    """Apply to ONE function scope (never descends into nested defs —
+    they get their own pre-pass when convert_call converts them)."""
+
+    def __init__(self, local_names):
+        self.locals = local_names
+
+    def visit_FunctionDef(self, node):
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        return node
+
+    def visit_ClassDef(self, node):
+        return node
+
+    def visit_Expr(self, node):
+        self.generic_visit(node)
+        c = node.value
+        if not (isinstance(c, ast.Call) and isinstance(c.func, ast.Attribute)
+                and isinstance(c.func.value, ast.Name)
+                and c.func.value.id in self.locals
+                and c.func.attr in _REWRITE_METHODS
+                and not c.keywords
+                and not any(isinstance(a, ast.Starred) for a in c.args)):
+            return node
+        meth, nargs = c.func.attr, len(c.args)
+        if ((meth in ("append", "extend") and nargs != 1)
+                or (meth == "clear" and nargs != 0)
+                or (meth == "pop" and nargs > 1)):
+            return node
+        n = c.func.value.id
+        new = ast.Assign(
+            targets=[_name(n, ast.Store())],
+            value=_call(_REWRITE_METHODS[meth], [_name(n)] + list(c.args)))
+        return ast.copy_location(new, node)
+
+    def visit_Assign(self, node):
+        self.generic_visit(node)
+        if not (len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Subscript)
+                and isinstance(node.targets[0].value, ast.Name)
+                and node.targets[0].value.id in self.locals):
+            return node
+        key = self._key_expr(node.targets[0].slice)
+        if key is None:
+            return node
+        n = node.targets[0].value.id
+        new = ast.Assign(
+            targets=[_name(n, ast.Store())],
+            value=_call("convert_setitem", [_name(n), key, node.value]))
+        return ast.copy_location(new, node)
+
+    @staticmethod
+    def _key_expr(sl):
+        if isinstance(sl, ast.Slice):
+            return ast.Call(
+                func=_name("slice"),
+                args=[x if x is not None else _const(None)
+                      for x in (sl.lower, sl.upper, sl.step)],
+                keywords=[])
+        if isinstance(sl, ast.Tuple) and any(
+                isinstance(e, ast.Slice) for e in sl.elts):
+            return None   # multi-axis slice store: keep the blocked form
+        return sl
+
+
+def _rewrite_mutations(fn_def):
+    """Run the pre-pass over one function def's own scope."""
+    a = fn_def.args
+    locals_ = {p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)}
+    for va in (a.vararg, a.kwarg):
+        if va is not None:
+            locals_.add(va.arg)
+    locals_ |= _assigned_names(fn_def.body)
+    for st in fn_def.body:
+        for sub in _walk_scope(st):
+            if isinstance(sub, (ast.Global, ast.Nonlocal)):
+                locals_ -= set(sub.names)
+    rw = _MutationRewriter(locals_)
+    fn_def.body = [rw.visit(s) for s in fn_def.body]
+
+
+def _mutated_list_names(body):
+    """Names this (converted) loop body mutates through the rewritten
+    helpers — read off the `name = _ptpu_dy2st.convert_append(name, ...)`
+    assignments, plus the `mutated` keyword of already-converted nested
+    loops (their bodies live inside generated defs that _walk_scope does
+    not enter)."""
+    out = set()
+    for st in body:
+        for sub in _walk_scope(st):
+            if not (isinstance(sub, ast.Assign)
+                    and isinstance(sub.value, ast.Call)
+                    and isinstance(sub.value.func, ast.Attribute)
+                    and isinstance(sub.value.func.value, ast.Name)
+                    and sub.value.func.value.id == _HELPER):
+                continue
+            attr = sub.value.func.attr
+            if (attr in _LIST_MUTATORS and len(sub.targets) == 1
+                    and isinstance(sub.targets[0], ast.Name)):
+                out.add(sub.targets[0].id)
+            elif attr in ("convert_while", "convert_for_range"):
+                kw = next((k for k in sub.value.keywords
+                           if k.arg == "mutated"), None)
+                if kw is not None and isinstance(kw.value, ast.Tuple):
+                    out |= {e.value for e in kw.value.elts
+                            if isinstance(e, ast.Constant)}
+    return out
+
+
+def _add_mutated_kw(call, muts):
+    if muts:
+        call.keywords.append(ast.keyword(
+            arg="mutated",
+            value=ast.Tuple(elts=[_const(m) for m in sorted(muts)],
+                            ctx=ast.Load())))
+    return call
+
+
 def _helper(attr):
     return ast.Attribute(value=_name(_HELPER), attr=attr, ctx=ast.Load())
 
@@ -502,7 +644,8 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
             ast.Tuple(elts=[_const(s) for s in names], ctx=ast.Load())]
         if getattr(node, "_ptpu_bound_name", None):
             call_args.append(_name(node._ptpu_bound_name))
-        call = _call("convert_while", call_args)
+        call = _add_mutated_kw(_call("convert_while", call_args),
+                               _mutated_list_names(node.body))
         out = [cond_fn, body_fn]
         if names:
             out.append(_unpack_stmt(names, call))
@@ -569,11 +712,13 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
             1 if names else 0,
             ast.Assign(targets=[node.target],
                        value=_name("__ptpu_i")))
-        call = _call("convert_for_range", [
-            start, stop, step,
-            _name(body_fn.name), _ld_tuple(names),
-            ast.Tuple(elts=[_const(s) for s in names], ctx=ast.Load()),
-            _const(node.target.id)])
+        call = _add_mutated_kw(
+            _call("convert_for_range", [
+                start, stop, step,
+                _name(body_fn.name), _ld_tuple(names),
+                ast.Tuple(elts=[_const(s) for s in names], ctx=ast.Load()),
+                _const(node.target.id)]),
+            _mutated_list_names(node.body))
         out = [body_fn]
         if names:
             out.append(_unpack_stmt(names, call))
@@ -867,6 +1012,9 @@ def convert_to_static(fn):
            for n in ast.walk(tree)):
         _CACHE[fn] = fn   # generators cannot be converted
         return fn
+    if tree.body and isinstance(tree.body[0],
+                                (ast.FunctionDef, ast.AsyncFunctionDef)):
+        _rewrite_mutations(tree.body[0])
     tree = _Dy2StaticTransformer().visit(tree)
     ast.fix_missing_locations(tree)
 
