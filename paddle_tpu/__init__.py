@@ -58,7 +58,9 @@ __all__ = ["Tensor", "TracedValueError", "to_tensor", "seed", "no_grad",
            "grad"] + list(_ops_all)
 
 # Subsystems (populated progressively; import order matters — nn/optimizer
-# build on ops).
+# build on ops; monitor first — it is stdlib-only and the others report
+# telemetry through it).
+from . import monitor  # noqa: E402
 from . import framework  # noqa: E402
 from . import device  # noqa: E402
 from . import nn  # noqa: E402

@@ -131,17 +131,59 @@ def _mem_stat(key, device=None):
     return int(memory_stats(device).get(key, 0))
 
 
+_live_peak = 0  # host-tracked watermark for backends without allocator stats
+_live_cache = (0.0, 0)          # (monotonic stamp, bytes) of the last sweep
+_LIVE_TTL = 0.05                # paired bytes_in_use/peak queries share a sweep
+_live_lock = __import__("threading").Lock()
+
+
+def _live_bytes():
+    """Sum of live jax.Array buffer bytes — the fallback 'bytes in use'
+    measure on backends whose PJRT client reports no allocator stats
+    (XLA-CPU, i.e. the test mesh). PROCESS-WIDE across local devices
+    (sharded arrays report their global nbytes; per-device attribution
+    needs real allocator stats). Also advances the host-side peak
+    watermark so max_memory_allocated stays meaningful there. The O(live
+    arrays) sweep is memoized for _LIVE_TTL so the usual paired
+    current+peak query costs one sweep, and watermark updates are locked
+    (profiler sampling and monitor export run from different threads)."""
+    import time as _time
+
+    global _live_peak, _live_cache
+    with _live_lock:
+        stamp, cached = _live_cache
+        now = _time.monotonic()
+        if now - stamp < _LIVE_TTL:
+            return cached
+        try:
+            n = sum(int(a.nbytes) for a in jax.live_arrays())
+        except Exception:
+            n = 0
+        _live_cache = (now, n)
+        if n > _live_peak:
+            _live_peak = n
+        return n
+
+
 def max_memory_allocated(device=None):
     """Peak device-memory bytes in use (reference:
     paddle.device.cuda.max_memory_allocated). On TPU this is the PJRT
-    allocator's peak_bytes_in_use — the per-step HBM high-water mark."""
-    return _mem_stat("peak_bytes_in_use", device)
+    allocator's peak_bytes_in_use — the per-step HBM high-water mark; on
+    stat-less backends, the high-water mark of observed live-array bytes."""
+    stats = memory_stats(device)
+    if "peak_bytes_in_use" in stats:   # key presence, not truthiness: a
+        return int(stats["peak_bytes_in_use"])  # real allocator may say 0
+    _live_bytes()
+    return _live_peak
 
 
 def memory_allocated(device=None):
     """Current device-memory bytes in use (reference:
     paddle.device.cuda.memory_allocated)."""
-    return _mem_stat("bytes_in_use", device)
+    stats = memory_stats(device)
+    if "bytes_in_use" in stats:
+        return int(stats["bytes_in_use"])
+    return _live_bytes()
 
 
 def max_memory_reserved(device=None):
@@ -220,6 +262,21 @@ def is_compiled_with_npu():
 def is_compiled_with_xpu():
     return False
 
+
+from .. import monitor as _monitor  # noqa: E402
+
+# Always-on memory watermark series (reference STAT_INT memory gauges fed
+# from memory/stats.h). Callback gauges: sampled only at snapshot/export,
+# zero steady-state cost.
+_monitor.gauge("device/peak_bytes",
+               help="peak device memory bytes in use",
+               fn=max_memory_allocated)
+_monitor.gauge("device/bytes_in_use",
+               help="current device memory bytes in use",
+               fn=memory_allocated)
+_monitor.gauge("device/bytes_limit",
+               help="allocator pool bound (0 when unreported)",
+               fn=max_memory_reserved)
 
 from ..framework.compat import XPUPlace, CustomPlace as _CustomPlace  # noqa: E402
 

@@ -26,6 +26,27 @@ import jax.numpy as jnp
 
 from ..core.tensor import Tensor
 from ..core.dispatch import apply
+from .. import monitor
+
+
+def _count_collective(kind, *tensors):
+    """Bytes-moved telemetry, labeled by collective kind. Sizes come from
+    shape/dtype metadata, so this works on tracers too — under jit each
+    collective is counted once per TRACE (per compiled program), on the
+    eager path once per call. Payload bytes are the per-participant input
+    size (the ICI injection volume, not the algorithm's wire total)."""
+    if not monitor.enabled():
+        return
+    n = 0
+    for t in tensors:
+        try:
+            shape = t.shape
+            itemsize = np.dtype(t.dtype).itemsize
+        except (TypeError, AttributeError):
+            continue
+        n += int(np.prod(shape)) * itemsize if shape else itemsize
+    monitor.counter("collective/bytes").labels(kind=kind).add(n)
+    monitor.counter("collective/calls").labels(kind=kind).inc()
 
 __all__ = [
     "ReduceOp", "Group", "new_group", "get_group", "all_reduce", "all_gather",
@@ -165,6 +186,7 @@ _REDUCE_FNS = {
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     axis = _axis_for(group)
     if axis is not None:
+        _count_collective("all_reduce", tensor)
         out = apply(lambda a: _reduce_safe(_REDUCE_FNS[op], a, axis), tensor,
                     name="all_reduce")
         tensor._data = out._data
@@ -183,6 +205,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
 def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
     ax = _axis_for(group)
     if ax is not None:
+        _count_collective("all_gather", tensor)
         out = apply(
             lambda a: jax.lax.all_gather(a, ax, tiled=False), tensor, name="all_gather"
         )
@@ -219,6 +242,8 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 def broadcast(tensor, src=0, group=None, sync_op=True):
     ax = _axis_for(group)
     if ax is not None:
+        _count_collective("broadcast", tensor)
+
         def fn(a):
             # select src's value on every member: gather then index (XLA
             # lowers this to a broadcast from src over the axis)
@@ -243,6 +268,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
         from ..ops.manipulation import stack
 
         stacked = stack(tensor_list, 0)
+        _count_collective("scatter", stacked)
 
         def fn(a):
             idx = jax.lax.axis_index(ax)
@@ -260,6 +286,7 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None, sync_o
         from ..ops.manipulation import concat
 
         inp = concat(tensor_list, 0) if tensor_list else tensor
+        _count_collective("reduce_scatter", inp)
 
         if op == ReduceOp.SUM:
             def fn(a):
@@ -298,6 +325,7 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
         from ..ops.manipulation import stack, unbind
 
         stacked = stack(in_tensor_list, 0)
+        _count_collective("alltoall", stacked)
         out = apply(
             lambda a: jax.lax.all_to_all(a, ax, split_axis=0, concat_axis=0, tiled=True),
             stacked,
@@ -320,6 +348,7 @@ def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
                     out_split_sizes=None, group=None, sync_op=True):
     ax = _axis_for(group)
     if ax is not None:
+        _count_collective("alltoall", in_tensor)
         out = apply(
             lambda a: jax.lax.all_to_all(a, ax, split_axis=0, concat_axis=0, tiled=True),
             in_tensor,
@@ -348,6 +377,7 @@ def p2p_permute(tensor, perm, group=None):
     ax = _axis_for(group)
     if ax is None:
         raise RuntimeError("p2p_permute requires an SPMD region (mesh axis)")
+    _count_collective("p2p_permute", tensor)
     return apply(
         lambda a: jax.lax.ppermute(a, ax, [(int(s), int(d)) for s, d in perm]),
         tensor,

@@ -24,6 +24,7 @@ from ..core.tensor import Tensor
 from ..core import random as _rng
 from ..autograd import tape
 from ..nn.layer import Layer
+from .. import monitor
 
 __all__ = ["to_static", "compile", "CompiledFunction", "save", "load", "TranslatedLayer", "not_to_static", "ignore_module"]
 
@@ -206,6 +207,13 @@ class CompiledFunction:
         a_args = _tree_to_arrays(args)
         a_kwargs = _tree_to_arrays(kwargs)
         out_arrays, new_state = self._compiled(state_vals, host_vals, key, a_args, a_kwargs)
+        if self._spec.optimizers and monitor.enabled():
+            # the compiled program embeds the optimizer update; count the
+            # dispatch here (optimizer.step only counts eager steps).
+            # host_vals[0] is this step's lr, already computed above —
+            # stored lazily, coerced at monitor export.
+            monitor.counter("optimizer/steps").inc(len(self._spec.optimizers))
+            monitor.gauge("optimizer/lr").set(host_vals[0])
         self._spec.write(new_state)
         # clear stale grads: the compiled step owns the whole update
         for opt in self._spec.optimizers:

@@ -22,6 +22,8 @@ from typing import Any, Callable, Dict, Iterable, Sequence, Tuple
 import jax
 
 from ..framework.core_ import get_flag
+from .. import monitor
+from ..profiler import RecordEvent
 
 __all__ = ["AutoTuneCache", "autotune", "cache", "set_config"]
 
@@ -49,8 +51,10 @@ class AutoTuneCache:
         k = self._key(kernel, key)
         if k in self._store:
             self.hits += 1
+            monitor.counter("autotune/hits").inc()
             return self._store[k]
         self.misses += 1
+        monitor.counter("autotune/misses").inc()
         return None
 
     def put(self, kernel: str, key: Tuple, config: Any):
@@ -72,6 +76,9 @@ class AutoTuneCache:
 
 
 cache = AutoTuneCache()
+
+# live hit-rate of the process-wide cache, sampled at monitor export time
+monitor.gauge("autotune/hit_rate", fn=cache.cache_hit_rate)
 
 _config = {"kernel": {"enable": True, "tuning_range": [1, 10]}}
 
@@ -129,12 +136,14 @@ def autotune(
     choice = candidates[0]
     if len(candidates) > 1 and runner is not None and _enabled():
         best_t = float("inf")
-        for cand in candidates:
-            try:
-                t = _measure(runner(cand))
-            except Exception:
-                continue
-            if t < best_t:
-                best_t, choice = t, cand
+        with RecordEvent("autotune/sweep"), \
+                monitor.timer("autotune/sweep_time", kernel=kernel):
+            for cand in candidates:
+                try:
+                    t = _measure(runner(cand))
+                except Exception:
+                    continue
+                if t < best_t:
+                    best_t, choice = t, cand
     cache.put(kernel, key, choice)
     return choice

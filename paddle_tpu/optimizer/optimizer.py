@@ -17,11 +17,18 @@ from ..core.tensor import Tensor
 from ..nn.layer import Parameter
 from .lr import LRScheduler
 from .clip import ClipGradBase
+from .. import monitor
+from ..profiler import RecordEvent
 
 __all__ = [
     "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad",
     "Adadelta", "RMSProp", "Lamb", "Lars",
 ]
+
+import os as _os
+
+# eager grad-norm telemetry sampling stride (1 = every step)
+_GRADNORM_EVERY = max(1, int(_os.environ.get("PTPU_GRADNORM_EVERY", "10")))
 
 
 class Optimizer:
@@ -139,6 +146,15 @@ class Optimizer:
 
     # -- public API --------------------------------------------------------
     def step(self):
+        with RecordEvent("optimizer/step"):
+            self._step_impl()
+        if self._step_override is None:
+            # eager step; compiled dispatches are counted once per call by
+            # jit.CompiledStep.__call__ (the trace itself must not count)
+            monitor.counter("optimizer/steps").inc()
+            monitor.gauge("optimizer/lr").set(self.get_lr())
+
+    def _step_impl(self):
         if self._step_override is None:
             # under jit tracing the harness owns the host-side counter
             self._step_count += 1
@@ -155,6 +171,18 @@ class Optimizer:
             grads = [self._shard_grads(g, p) for g, p in zip(grads, params)]
         if self._grad_clip is not None:
             grads = self._grad_clip.apply(grads)
+        if (monitor.enabled()
+                and self._step_count % _GRADNORM_EVERY == 1 % _GRADNORM_EVERY
+                and not any(isinstance(g, jax.core.Tracer) for g in grads)):
+            # post-clip global grad norm; stored lazily (async device
+            # scalar, forced to float only at monitor snapshot/export).
+            # Sampled every _GRADNORM_EVERY eager steps (the reduction
+            # dispatches O(params) eager ops and the gauge keeps only the
+            # last value anyway); PTPU_GRADNORM_EVERY=1 for every step.
+            sq = functools.reduce(
+                lambda a, g: a + jnp.sum(jnp.square(g.astype(jnp.float32))),
+                grads, jnp.float32(0.0))
+            monitor.gauge("optimizer/grad_norm").set(jnp.sqrt(sq))
         states = [self._ensure_state(p) for p in params]
         masters = [self._master_weights.get(id(p)) for p in params]
         p_arrays = [p._data for p in params]

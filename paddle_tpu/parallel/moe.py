@@ -25,9 +25,33 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from .mesh import get_mesh, axis_size
+from .mesh import get_mesh, axis_size, shard_map_compat
+from .. import monitor
+from ..profiler import RecordEvent
 
 __all__ = ["moe_mlp_arrays", "moe_capacity"]
+
+
+def _maybe_record_routing(dispatch, n_tokens, top_k):
+    """Expert-routing telemetry from the concrete dispatch tensor [N,E,C].
+    Only observable on the eager path (tracers carry no values); under jit
+    the aux load-balance loss remains the in-graph signal. Forces the
+    dispatch computation, which the eager caller pays anyway."""
+    if not monitor.enabled() or isinstance(dispatch, jax.core.Tracer):
+        return
+    import numpy as np
+
+    tokens_per_expert = np.asarray(jnp.sum(dispatch, axis=(0, 2)))  # [E]
+    hist = monitor.histogram("moe/tokens_per_expert")
+    for c in tokens_per_expert:
+        hist.observe(float(c))
+    kept = float(tokens_per_expert.sum())
+    monitor.counter("moe/dropped_tokens").add(
+        max(0.0, n_tokens * top_k - kept))
+    mean = float(tokens_per_expert.mean())
+    if mean > 0:
+        monitor.gauge("moe/imbalance").set(
+            float(tokens_per_expert.max()) / mean)
 
 
 def moe_capacity(num_tokens, num_experts, top_k, capacity_factor):
@@ -81,6 +105,7 @@ def _moe_single(x, logits, w_in, w_out, *, top_k, capacity_factor):
     xf = x.reshape(b * s, h)
     cap = moe_capacity(b * s, e, top_k, capacity_factor)
     dispatch, combine, aux = _routing(logits.reshape(b * s, e), e, top_k, cap)
+    _maybe_record_routing(dispatch, b * s, top_k)
     expert_in = jnp.einsum("nec,nh->ech", dispatch.astype(x.dtype), xf)
     out = _expert_ffn(expert_in, w_in, w_out)
     y = jnp.einsum("nec,ech->nh", combine.astype(out.dtype), out)
@@ -90,7 +115,9 @@ def _moe_single(x, logits, w_in, w_out, *, top_k, capacity_factor):
 def _moe_sharded(x, logits, w_in, w_out, *, axis_name, top_k, capacity_factor):
     """Per-shard body (inside shard_map over 'ep'): x/logits hold the local
     token slice [B_l, S, H]; w_in/w_out hold the local experts [E_l, H, M].
-    The two all_to_alls are the reference's global_scatter / global_gather."""
+    The two all_to_alls are the reference's global_scatter / global_gather.
+    NOTE: the eager telemetry replay in _moe_mlp_dispatch mirrors this
+    body's token slicing and capacity — keep the two in lockstep."""
     ep = jax.lax.psum(1, axis_name)
     b_l, s, h = x.shape
     e = w_in.shape[0] * ep                          # global expert count
@@ -123,6 +150,13 @@ def moe_mlp_arrays(x, gate_logits, w_in, w_out, top_k=2, capacity_factor=1.25,
     With axis size > 1, tokens (batch dim) are sharded over 'ep' and experts
     dispatched via all_to_all; otherwise everything is local.
     """
+    with RecordEvent("moe/ffn"):
+        return _moe_mlp_dispatch(x, gate_logits, w_in, w_out, top_k,
+                                 capacity_factor, axis)
+
+
+def _moe_mlp_dispatch(x, gate_logits, w_in, w_out, top_k, capacity_factor,
+                      axis):
     ep = axis_size(axis)
     if ep > 1 and x.shape[0] % ep != 0:
         # loud fallback: every shard gets every expert's weights and no
@@ -139,10 +173,26 @@ def moe_mlp_arrays(x, gate_logits, w_in, w_out, top_k=2, capacity_factor=1.25,
     if ep <= 1 or x.shape[0] % ep != 0:
         return _moe_single(x, gate_logits, w_in, w_out,
                            top_k=top_k, capacity_factor=capacity_factor)
+    if monitor.enabled() and not isinstance(gate_logits, jax.core.Tracer):
+        # The sharded dispatch below is opaque to host telemetry (the
+        # dispatch tensor only exists inside shard_map, as a tracer).
+        # On the eager path, replay ONE shard's routing — same _routing,
+        # same local token slice and capacity as _moe_sharded — purely to
+        # record tokens_per_expert/dropped/imbalance as a per-shard
+        # SAMPLE. One extra routing pass (not ep), eager-only and
+        # monitor-gated; compiled runs skip entirely.
+        b, s, _ = x.shape
+        e = w_in.shape[0]
+        b_l = b // ep
+        cap = moe_capacity(b_l * s, e, top_k, capacity_factor)
+        d_0, _, _ = _routing(
+            jnp.asarray(gate_logits[:b_l]).reshape(b_l * s, e),
+            e, top_k, cap)
+        _maybe_record_routing(d_0, b_l * s, top_k)
     mesh = get_mesh()
     body = partial(_moe_sharded, axis_name=axis, top_k=top_k,
                    capacity_factor=capacity_factor)
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         body, mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P(axis)),
         out_specs=(P(axis), P()),

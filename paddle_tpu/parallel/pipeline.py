@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import functools
 import os
+import time
 from typing import Any, Callable
 
 import jax
@@ -35,7 +36,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from .mesh import get_mesh, axis_size
+from .mesh import get_mesh, axis_size, shard_map_compat
+from .. import monitor
+from ..profiler import RecordEvent
 
 __all__ = ["pipeline_apply", "pipeline_1f1b", "scan_blocks"]
 
@@ -82,6 +85,32 @@ def scan_blocks(block_fn: Callable, stacked_params: Any, x,
 
     out, _ = jax.lax.scan(body, x, stacked_params, unroll=max(1, unroll))
     return out
+
+
+def _pipeline_telemetry(schedule, pp, M, v, ticks, t0, sample):
+    """Host-side schedule telemetry. `sample` is any array flowing through
+    the schedule: when it is a tracer the call sits inside an outer jit
+    trace, where wall-clock numbers would measure tracing, not execution —
+    skip. On the recorded (eager) path the timed window spans trace +
+    compile + run of the fused XLA program — each eager call builds a
+    fresh closure, so compile dominates and the series is a smoke/debug
+    signal, not a perf ruler; production per-step numbers come from the
+    profiler's xplane capture, and bubble_fraction (analytic) is exact
+    everywhere."""
+    if not monitor.enabled() or isinstance(sample, jax.core.Tracer):
+        return
+    jax.block_until_ready(sample)   # time the run, not just the dispatch
+    dt = time.perf_counter() - t0
+    lab = {"schedule": schedule}
+    # per-tick time ~ per-stage per-microbatch slot time
+    monitor.histogram("pipeline/stage_time").labels(**lab).observe(
+        dt / max(1, ticks))
+    # warm-up/drain bubble of the schedule: pp-1 idle slots out of
+    # M*v + pp - 1 total (v = virtual stages per device; 1F1B has the
+    # same fraction over its doubled fwd+bwd slot count)
+    monitor.gauge("pipeline/bubble_fraction").labels(**lab).set(
+        (pp - 1) / (M * v + pp - 1))
+    monitor.counter("pipeline/microbatches").labels(**lab).add(M)
 
 
 _LOW_FLOAT = ("bfloat16", "float16")
@@ -189,7 +218,7 @@ def pipeline_apply(
                            aux=amb if has_aux else None)
 
     @functools.partial(
-        jax.shard_map,
+        shard_map_compat,
         mesh=mesh,
         in_specs=(P(axis), P(), P()),
         out_specs=P(),
@@ -245,7 +274,10 @@ def pipeline_apply(
     # partial-manual shard_map validates specs only under jit; eager calls
     # (plain apply without jit.compile) need the wrapper — it inlines when
     # already inside a trace
-    out = jax.jit(run)(staged, xs, aux_xs)
+    t0 = time.perf_counter()
+    with RecordEvent("pipeline/gpipe"):
+        out = jax.jit(run)(staged, xs, aux_xs)
+    _pipeline_telemetry("gpipe", pp, M, 1, M + pp - 1, t0, out)
     return out.reshape((B,) + x.shape[1:])
 
 
@@ -313,7 +345,7 @@ def _pipeline_interleaved(block_fn, stacked_params, x, n_microbatches,
     aux_xs = _split_aux(aux, M) if has_aux else ()
 
     @functools.partial(
-        jax.shard_map,
+        shard_map_compat,
         mesh=mesh,
         in_specs=(P(axis), P(), P()),
         out_specs=P(),
@@ -366,7 +398,10 @@ def _pipeline_interleaved(block_fn, stacked_params, x, n_microbatches,
 
     staged = jax.tree_util.tree_map(stage_major, stacked_params)
     xs, xs_dtype = _widen_boundary(xs)
-    out = jax.jit(run)(staged, xs, aux_xs)
+    t0 = time.perf_counter()
+    with RecordEvent("pipeline/interleave"):
+        out = jax.jit(run)(staged, xs, aux_xs)
+    _pipeline_telemetry("interleave", pp, M, v, U, t0, out)
     return out.reshape((B,) + x.shape[1:])
 
 
@@ -496,7 +531,7 @@ def _pipeline_1f1b_impl(block_fn, loss_fn, n_microbatches, axis,
     aux_xs = _split_aux(aux, M) if has_aux else ()
 
     @functools.partial(
-        jax.shard_map,
+        shard_map_compat,
         mesh=mesh,
         in_specs=(P(axis), P(), P(), P(), P()),
         out_specs=(P(), (P(axis), P(), P())),
@@ -615,7 +650,11 @@ def _pipeline_1f1b_impl(block_fn, loss_fn, n_microbatches, axis,
     tail_params, tail_dtype = _widen_boundary(tail_params)
     xs, xs_dtype = _widen_boundary(xs)
     # see pipeline_apply: jit makes eager invocation legal (inlines in-trace)
-    loss, (gacc, tacc, dxs) = jax.jit(run)(staged, tail_params, xs, ys, aux_xs)
+    t0 = time.perf_counter()
+    with RecordEvent("pipeline/1f1b"):
+        loss, (gacc, tacc, dxs) = jax.jit(run)(
+            staged, tail_params, xs, ys, aux_xs)
+    _pipeline_telemetry("1f1b", pp, M, 1, U, t0, loss)
     dparams = jax.tree_util.tree_map(
         lambda g, p: g.reshape((L,) + g.shape[2:]).astype(p.dtype),
         gacc, stacked_params)

@@ -175,6 +175,13 @@ class Profiler:
             self._mem_samples.append((_device.memory_allocated(),
                                       _device.max_memory_allocated()))
 
+    def _ips_samples(self):
+        """Per-step ips for exactly the steps that reported num_samples —
+        each sample paired with ITS OWN step duration (a positional
+        times[-len(samples):] pairing mismatches whenever only some steps
+        pass num_samples)."""
+        return [n / t for t, n in self._step_times if n and t > 0]
+
     def step_info(self, unit="samples"):
         if not self._step_times:
             return ""
@@ -182,10 +189,9 @@ class Profiler:
 
         times = np.array([t for t, _ in self._step_times])
         msg = f"avg step {times.mean()*1000:.2f} ms"
-        samples = [n for _, n in self._step_times if n]
-        if samples:
-            ips = np.array(samples) / times[-len(samples):]
-            msg += f", ips {ips.mean():.1f} {unit}/s"
+        ips = self._ips_samples()
+        if ips:
+            msg += f", ips {np.mean(ips):.1f} {unit}/s"
         return msg
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False, time_unit="ms"):
@@ -215,6 +221,14 @@ class Profiler:
             dev = self.device_op_summary(time_unit=time_unit)
             if dev:
                 lines += ["", dev]
+        # always-on stats layer (paddle_tpu.monitor): counters/gauges/
+        # histograms recorded by the train/pipeline/MoE/autotune hot paths
+        # share names with the RecordEvent spans above.
+        from .. import monitor
+
+        mon = monitor.render()
+        if mon:
+            lines += ["", mon]
         return "\n".join(lines)
 
     def device_op_summary(self, top=30, time_unit="ms"):
@@ -259,6 +273,13 @@ class Profiler:
 
 
 def load_profiler_result(path):
+    """Load an exported trace: chrome-trace JSON, or the pickled raw host
+    event list written by export_protobuf (.pkl)."""
+    if path.endswith((".pkl", ".pb.pkl")):
+        import pickle
+
+        with open(path, "rb") as f:
+            return pickle.load(f)
     with open(path) as f:
         return json.load(f)
 
@@ -305,8 +326,10 @@ def export_protobuf(dir_name, worker_name=None):
         name = worker_name or f"host_{socket.gethostname()}"
         path = os.path.join(
             dir_name, f"{name}_{int(_time.time() * 1000)}.pb.pkl")
+        # the raw records live on the module host tracer, not the Profiler
+        # (a prior version pickled a nonexistent prof._events — always [])
         with open(path, "wb") as f:
-            pickle.dump(getattr(prof, "_events", []), f)
+            pickle.dump(list(_tracer.events), f)
         return path
 
     return handler

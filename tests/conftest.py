@@ -32,8 +32,15 @@ warnings.filterwarnings(
 
 @pytest.fixture(autouse=True)
 def _seed():
+    import random
+
     import paddle_tpu as paddle
 
     paddle.seed(1234)
     np.random.seed(1234)
+    # the legacy reader decorators (paddle.reader.shuffle) draw from the
+    # global `random` module; unseeded, their batch order depends on
+    # whatever ran earlier in the session and the loss-decrease asserts in
+    # test_reader_dataset/test_examples become order-flaky
+    random.seed(1234)
     yield

@@ -1,0 +1,176 @@
+"""Profiler core tests (ISSUE 1 satellites; reference:
+python/paddle/profiler/profiler.py make_scheduler/export handlers +
+test_profiler.py scheduler-state parity).
+
+Covers: make_scheduler state sequences (skip_first / repeat /
+RECORD_AND_RETURN edges), chrome-trace export schema +
+load_profiler_result round-trip, the export_protobuf regression (it used
+to pickle a nonexistent attribute — always an empty list), and the
+step_info sample/time pairing fix.
+"""
+import json
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler
+from paddle_tpu.profiler import (
+    Profiler, ProfilerState, RecordEvent, export_protobuf,
+    load_profiler_result, make_scheduler,
+)
+
+
+# -- make_scheduler state machine -----------------------------------------
+
+def _states(sched, n):
+    return [sched(i) for i in range(n)]
+
+
+def test_scheduler_basic_cycle():
+    sched = make_scheduler(closed=1, ready=1, record=2, repeat=0)
+    # cycle = 4: CLOSED, READY, RECORD, RECORD_AND_RETURN, repeating
+    assert _states(sched, 8) == [
+        ProfilerState.CLOSED, ProfilerState.READY,
+        ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN,
+    ] * 2
+
+
+def test_scheduler_skip_first():
+    sched = make_scheduler(closed=0, ready=1, record=1, skip_first=3)
+    assert _states(sched, 5) == [
+        ProfilerState.CLOSED, ProfilerState.CLOSED, ProfilerState.CLOSED,
+        ProfilerState.READY, ProfilerState.RECORD_AND_RETURN,
+    ]
+
+
+def test_scheduler_repeat_caps_cycles():
+    sched = make_scheduler(closed=1, ready=0, record=1, repeat=2)
+    # two cycles of (CLOSED, RECORD_AND_RETURN), then closed forever
+    assert _states(sched, 6) == [
+        ProfilerState.CLOSED, ProfilerState.RECORD_AND_RETURN,
+        ProfilerState.CLOSED, ProfilerState.RECORD_AND_RETURN,
+        ProfilerState.CLOSED, ProfilerState.CLOSED,
+    ]
+
+
+def test_scheduler_record_and_return_only_on_last_record():
+    sched = make_scheduler(closed=0, ready=0, record=3)
+    assert _states(sched, 3) == [
+        ProfilerState.RECORD, ProfilerState.RECORD,
+        ProfilerState.RECORD_AND_RETURN,
+    ]
+
+
+def test_profiler_tuple_scheduler_form():
+    # scheduler=(lo, hi): record steps [lo, hi), one repeat
+    prof = Profiler(scheduler=(1, 3), timer_only=True)
+    assert prof._scheduler(0) == ProfilerState.CLOSED
+    assert prof._scheduler(1) == ProfilerState.RECORD
+    assert prof._scheduler(2) == ProfilerState.RECORD_AND_RETURN
+    assert prof._scheduler(3) == ProfilerState.CLOSED
+
+
+# -- chrome trace export + round trip -------------------------------------
+
+def test_chrome_trace_schema_and_round_trip(tmp_path):
+    path = str(tmp_path / "trace.json")
+    with Profiler(timer_only=True) as prof:
+        with RecordEvent("span/outer"):
+            with RecordEvent("span/inner"):
+                pass
+        prof.step()
+    prof.export(path)
+    data = load_profiler_result(path)
+    events = data["traceEvents"]
+    names = {e["name"] for e in events}
+    assert {"span/outer", "span/inner"} <= names
+    for e in events:
+        # chrome trace "complete" events: X phase with µs ts/dur
+        assert e["ph"] == "X"
+        assert e["dur"] >= 0 and e["ts"] > 0
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+    # inner span nests inside outer
+    outer = next(e for e in events if e["name"] == "span/outer")
+    inner = next(e for e in events if e["name"] == "span/inner")
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+
+
+def test_export_chrome_tracing_handler(tmp_path):
+    d = str(tmp_path / "traces")
+    prof = Profiler(timer_only=True,
+                    on_trace_ready=profiler.export_chrome_tracing(d, "w0"))
+    with prof:
+        with RecordEvent("h/span"):
+            pass
+    files = list(__import__("pathlib").Path(d).glob("w0_*.json"))
+    assert len(files) == 1
+    data = json.load(open(files[0]))
+    assert any(e["name"] == "h/span" for e in data["traceEvents"])
+
+
+def test_export_protobuf_round_trips_host_events(tmp_path):
+    """Regression (ISSUE 1 satellite): the handler pickled a nonexistent
+    prof._events — every file held an empty list. It must serialize the
+    host tracer's events and round-trip through load_profiler_result."""
+    d = str(tmp_path / "pb")
+    prof = Profiler(timer_only=True, on_trace_ready=export_protobuf(d, "w0"))
+    with prof:
+        with RecordEvent("pb/span"):
+            time.sleep(0.001)
+        prof.step()
+    files = list(__import__("pathlib").Path(d).glob("w0_*.pb.pkl"))
+    assert len(files) == 1
+    events = load_profiler_result(str(files[0]))
+    assert events, "exported event list must not be empty"
+    span = next(e for e in events if e["name"] == "pb/span")
+    assert span["dur"] > 0 and span["ph"] == "X"
+
+
+# -- step_info sample/time pairing ----------------------------------------
+
+def test_step_info_pairs_samples_with_their_own_steps(monkeypatch):
+    """Satellite fix: with num_samples passed on only SOME steps, each ips
+    sample must divide by its own step duration (the old positional
+    times[-len(samples):] pairing used the wrong durations)."""
+    clock = iter([0.0, 1.0, 2.0, 6.0])   # durations: 1s, 1s, 4s
+    monkeypatch.setattr(time, "perf_counter", lambda: next(clock))
+    prof = Profiler(timer_only=True)
+    prof._last_step_t = time.perf_counter()       # t=0
+    prof.step(num_samples=10)                     # 1s step -> 10 ips
+    prof.step()                                   # 1s step, no samples
+    prof.step()                                   # 4s step, no samples
+    assert prof._ips_samples() == [10.0]
+    msg = prof.step_info()
+    assert "ips 10.0 samples/s" in msg
+    # buggy pairing would have divided 10 by the LAST step's 4s -> 2.5
+    assert "2.5" not in msg
+
+
+def test_step_info_all_steps_sampled(monkeypatch):
+    clock = iter([0.0, 2.0, 6.0])                 # durations: 2s, 4s
+    monkeypatch.setattr(time, "perf_counter", lambda: next(clock))
+    prof = Profiler(timer_only=True)
+    prof._last_step_t = time.perf_counter()
+    prof.step(num_samples=8)                      # 4 ips
+    prof.step(num_samples=8)                      # 2 ips
+    assert prof._ips_samples() == [4.0, 2.0]
+    assert "ips 3.0" in prof.step_info()
+
+
+def test_summary_includes_monitor_section():
+    from paddle_tpu import monitor
+
+    monitor.reset()
+    monitor.counter("demo/metric").inc(7)
+    with Profiler(timer_only=True) as prof:
+        with RecordEvent("sum/span"):
+            pass
+        prof.step()
+    text = prof.summary()
+    assert "sum/span" in text
+    assert "runtime monitor" in text
+    assert "demo/metric" in text
+    monitor.reset()
