@@ -114,7 +114,11 @@ def _select_tree(cond, a, b):
     key present on only one side takes that side's value."""
     if isinstance(a, dict):
         out = {}
-        for k in set(a) | set(b):
+        # a's insertion order first, then b-only keys: set() iteration is
+        # hash-seed-dependent, and the jit state threading reads slot dicts
+        # positionally — a hash-ordered rebuild would permute the threaded
+        # state between calls of one compiled program
+        for k in list(a) + [k for k in b if k not in a]:
             if k not in a:
                 out[k] = b[k]
             elif k not in b:

@@ -500,3 +500,22 @@ __all__ += ["DataType", "PlaceType", "PrecisionType", "PredictorPool",
             "get_version", "get_num_bytes_of_data_type",
             "get_trt_compile_version", "get_trt_runtime_version",
             "_get_phi_kernel_name", "convert_to_mixed_precision"]
+
+
+# -- generative serving entry point (paddle_tpu.serving) --------------------
+# The continuous-batching engine is the generative-model counterpart of the
+# Predictor above.  Re-exported lazily (PEP 562): `import paddle_tpu` pulls
+# this module at package init, and the engine's model-side imports must not
+# tax every non-serving process.
+_SERVING_EXPORTS = ("LLMEngine", "EngineConfig", "SamplingParams",
+                    "BlockKVCache", "Scheduler", "Request")
+__all__ += list(_SERVING_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _SERVING_EXPORTS:
+        from .. import serving
+
+        return getattr(serving, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

@@ -64,7 +64,12 @@ class _StateSpec:
             for p in opt._parameter_list:
                 opt._ensure_state(p)
             for key, slot_dict in opt._states.items():
-                for sname in slot_dict:
+                # sorted: slot dicts may be REBUILT by meta-optimizers
+                # (GradientMerge's select replaces the dict each step), so
+                # insertion order is not stable between trace time and
+                # later calls — a canonical order keeps the threaded
+                # positions fixed no matter how the dict was assembled
+                for sname in sorted(slot_dict):
                     out.append((f"o{oi}.{key}.{sname}", (opt, key, sname)))
             for key in opt._master_weights:
                 out.append((f"o{oi}.{key}.master", (opt, key, "__master__")))
@@ -110,6 +115,13 @@ def _tree_to_arrays(obj):
         return type(obj)(_tree_to_arrays(o) for o in obj)
     if isinstance(obj, dict):
         return {k: _tree_to_arrays(v) for k, v in obj.items()}
+    from .dy2static.convert_operators import _Undefined
+
+    if isinstance(obj, _Undefined):
+        # a name that converted control flow left possibly-unbound is
+        # being RETURNED — surface its actionable error instead of a
+        # jax invalid-output-type failure
+        obj._raise()
     return obj
 
 

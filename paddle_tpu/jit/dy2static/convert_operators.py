@@ -79,7 +79,42 @@ class _Undefined:
         self._raise()
 
 
+def _undef_use(name):
+    def op(self, *a, **k):
+        self._raise()
+
+    op.__name__ = name
+    return op
+
+
+# any expression-level USE of an unbound value raises the actionable
+# message (python's UnboundLocalError analog) instead of a bare
+# TypeError from a missing operator hook
+for _dunder in ("__add__", "__radd__", "__sub__", "__rsub__", "__mul__",
+                "__rmul__", "__truediv__", "__rtruediv__", "__floordiv__",
+                "__rfloordiv__", "__mod__", "__rmod__", "__pow__",
+                "__rpow__", "__matmul__", "__rmatmul__", "__neg__",
+                "__pos__", "__abs__", "__lt__", "__le__", "__gt__",
+                "__ge__", "__eq__", "__ne__", "__len__", "__getitem__",
+                "__setitem__", "__contains__", "__float__", "__int__",
+                "__index__", "__hash__"):
+    setattr(_Undefined, _dunder, _undef_use(_dunder))
+del _dunder
+
+
 UNDEFINED = _Undefined()
+
+
+class _BranchUndefined(_Undefined):
+    """A name assigned in only one branch of a tensor-dependent if.
+    Python itself leaves such a name possibly-unbound after the if, so the
+    select carries this poison value instead of failing eagerly — code
+    that never reads the name (e.g. a for-loop target that lives in one
+    branch) works, while any USE raises the actionable error."""
+
+    _MSG = ("variable '{}' is assigned in only one branch of a "
+            "tensor-dependent if and undefined in the other; initialize "
+            "it before the if so both branches produce a value")
 
 
 def ld(thunk, name="<unknown>"):
@@ -120,12 +155,13 @@ def _select_pair(pred, t, f, name):
             # temps) of a loop that lives in only one branch: dead after
             # its construct, any defined value threads through harmlessly
             return f if t_und else t
-        which = (t if t_und else f)
-        raise Dy2StaticError(
-            f"variable '{name}' is assigned in only one branch of a "
-            f"tensor-dependent if and undefined in the other "
-            f"({which!r}); initialize it before the if so both branches "
-            "produce a value")
+        # python leaves the name possibly-unbound after the if; carry a
+        # poison that raises only on USE (so an unused one-branch loop
+        # target is fine, while reading it stays loud)
+        defined = f if t_und else t
+        if isinstance(defined, StagedArray):
+            defined._consumed = True   # dies here by design, not discarded
+        return _BranchUndefined(name)
     if isinstance(t, StagedArray) or isinstance(f, StagedArray):
         return _select_staged_pair(pred, t, f, name)
     t_tensor = _is_tensorish(t)
@@ -189,7 +225,8 @@ def _select_staged_pair(pred, t, f, name):
     length = apply(lambda p, a, b: jnp.where(p, a, b), pred, ts.length,
                    fs.length, name="ifelse_select")
     return StagedArray(data, length,
-                       loop_fixed=ts._loop_fixed or fs._loop_fixed)
+                       loop_fixed=ts._loop_fixed or fs._loop_fixed,
+                       user_sized=ts._user_sized or fs._user_sized)
 
 
 def _snapshot_mutables(vals):
@@ -362,9 +399,17 @@ def convert_while(cond_fn, body_fn, init_vals, names, bound=None,
         v if isinstance(v, Tensor) or not isinstance(v, (int, float, bool))
         else Tensor(jnp.asarray(v))
         for v in init_vals)
+    # `ys = []` accumulators: an empty list carries no element spec, so
+    # trace the body once (dead code) to learn what gets appended
+    elem_specs = None
+    if any(isinstance(v, list) and not v and n in mutated
+           for v, n in zip(vals, names)):
+        elem_specs = _probe_empty_list_elems(body_fn, vals, names,
+                                             frozenset(mutated))
     # lists the body mutates become loop_fixed StagedArrays (the carry
     # structure of a staged while cannot change per iteration)
-    vals = _stage_loop_lists(vals, names, frozenset(mutated), bound)
+    vals = _stage_loop_lists(vals, names, frozenset(mutated), bound,
+                             elem_specs)
 
     def body_checked(vs):
         out = tuple(body_fn(vs))
@@ -625,10 +670,13 @@ def _tensor_list_stageable(lst):
                               numbers.Number, bool)) for e in lst)
 
 
-def _auto_stage_list(lst, name="<list>"):
+def _auto_stage_list(lst, name="<list>", elem_like=None):
     """Plain list -> growing StagedArray at the point a staged region
     first mutates it (if-branch case: append count is a trace-time
-    constant, so the buffer grows statically — no headroom needed)."""
+    constant, so the buffer grows statically — no headroom needed).
+    elem_like: the element about to be appended — lets the ubiquitous
+    `ys = []` accumulator stage without manual seeding (an empty list
+    alone carries no element shape/dtype)."""
     _AUTO_STAGED[id(lst)] = lst
     if not _tensor_list_stageable(lst):
         raise Dy2StaticError(
@@ -637,9 +685,16 @@ def _auto_stage_list(lst, name="<list>"):
             f"({_safe_repr(lst)}); only lists of same-shape tensors/"
             "numbers can be staged")
     try:
-        return StagedArray.from_list(lst)
+        sa = StagedArray.from_list(
+            lst, elem_like=None if lst else elem_like)
     except StagedArrayError as e:
         raise Dy2StaticError(f"list '{name}': {e}") from e
+    # the staged replacement MUST be consumed (carried/selected/read):
+    # one that just dies means a helper mutated the list and dropped the
+    # pure result — its __del__ records the discard so the region
+    # boundary can raise instead of silently losing the append
+    sa._must_consume = True
+    return sa
 
 
 def _staged_mutation_guard(obj, what):
@@ -659,7 +714,7 @@ def convert_append(obj, x):
         obj._raise()
     if _STAGING_DEPTH > 0:
         if isinstance(obj, list):
-            return _auto_stage_list(obj).append(x)
+            return _auto_stage_list(obj, elem_like=x).append(x)
         _staged_mutation_guard(obj, ".append(...)")
     obj.append(x)
     return obj
@@ -672,7 +727,11 @@ def convert_extend(obj, it):
         obj._raise()
     if _STAGING_DEPTH > 0:
         if isinstance(obj, list):
-            return _auto_stage_list(obj) + list(it)
+            items = list(it)
+            if not obj and not items:
+                return obj            # extend([]) on empty: no-op
+            return _auto_stage_list(
+                obj, elem_like=items[0] if items else None) + items
         _staged_mutation_guard(obj, ".extend(...)")
     obj.extend(it)
     return obj
@@ -707,7 +766,8 @@ def convert_clear(obj):
     if isinstance(obj, StagedArray):
         return StagedArray(obj.data,
                            Tensor(jnp.asarray(0, jnp.int32)),
-                           loop_fixed=obj._loop_fixed)
+                           loop_fixed=obj._loop_fixed,
+                           user_sized=obj._user_sized)
     if isinstance(obj, _Undefined):
         obj._raise()
     if _STAGING_DEPTH > 0:
@@ -748,18 +808,72 @@ def convert_setitem(obj, key, val):
     return obj
 
 
-def _stage_loop_lists(vals, names, mutated, bound):
+def _probe_empty_list_elems(body_fn, vals, names, mutated):
+    """Trace the loop body ONCE with the pre-staging values to learn the
+    element shape/dtype appended to lists that are still EMPTY when the
+    loop stages — this is what makes the ubiquitous
+    `ys = []; for ...: ys.append(x)` accumulator work without manual
+    `jit.staged_list(capacity, example)` seeding. The probe's outputs are
+    discarded (dead code under the ambient trace, DCE'd by XLA); staged
+    regions already run not-taken branches, so the body being traced an
+    extra time is within the established side-effect contract. Any probe
+    failure falls back to the loud seed-the-list error at staging time."""
+    from ...core import random as _rng
+
+    pre = [(v, v._superseded) for v in vals if isinstance(v, StagedArray)]
+    pre_auto = set(_AUTO_STAGED)
+    pre_pending = list(_pending_discards)
+    pre_rng = _rng.get_state()
+    specs = {}
+    try:
+        with _staging_region():
+            out = list(body_fn(tuple(vals)))
+        for i, (v, n) in enumerate(zip(vals, names)):
+            if (n in mutated and isinstance(v, list) and not v
+                    and isinstance(out[i], StagedArray)):
+                specs[n] = (out[i].elem_shape, out[i].dtype)
+                out[i]._consumed = True
+        # drop probe outputs NOW — no loose loop-variable binding may
+        # outlive this (a surviving ref would fire its discard-detection
+        # __del__ only AFTER the restore below, raising spuriously later)
+        del out
+    except Exception:
+        specs = {}
+    finally:
+        # the probe is invisible: restore supersession marks, drop the
+        # lists it auto-staged, and RESTORE (not clear) the discard
+        # records — records that predate the probe are real lost-append
+        # errors the region boundary must still raise
+        for v, flag in pre:
+            v._superseded = flag
+        for k in [k for k in _AUTO_STAGED if k not in pre_auto]:
+            del _AUTO_STAGED[k]
+        _pending_discards[:] = pre_pending
+        # the probe must not shift the host RNG stream either (a body
+        # with dropout consumes keys at trace time; the real trace must
+        # see the same keys as an un-probed program)
+        _rng.set_state(pre_rng)
+    return specs
+
+
+def _stage_loop_lists(vals, names, mutated, bound, elem_specs=None):
     """At the point a while stages: convert the plain-Python lists the
     loop body MUTATES (statically detected by the transformer) into
     loop_fixed StagedArrays. Capacity = current length + the static trip
     bound when known (one append per iteration — more overflows loudly at
-    materialization), else PTPU_DY2STATIC_LIST_CAPACITY. Lists the body
-    does NOT mutate stay plain (they are loop-invariant pytrees, and
-    converting them would needlessly trace their reads)."""
+    materialization), else PTPU_DY2STATIC_LIST_CAPACITY (a warning points
+    at that fallback: for large elements — KV cache rows, per-step
+    logits — the default 4096-row buffer is the wrong size in both
+    directions, so pre-size with `jit.staged_list(capacity, example)`).
+    Empty lists take their element spec from `elem_specs` (probed from
+    the body; see _probe_empty_list_elems). Lists the body does NOT
+    mutate stay plain (they are loop-invariant pytrees, and converting
+    them would needlessly trace their reads)."""
     if not mutated:
         return vals
     head = (int(bound) if bound is not None else default_list_capacity())
     out = list(vals)
+    defaulted = []
     for i, (v, n) in enumerate(zip(vals, names)):
         if n not in mutated:
             continue
@@ -769,14 +883,44 @@ def _stage_loop_lists(vals, names, mutated, bound):
                     f"the list '{n}' is mutated inside a tensor-dependent "
                     "loop but holds non-tensor elements; only lists of "
                     "same-shape tensors/numbers can be staged")
+            elem_like = None
+            if not v and elem_specs and n in elem_specs:
+                shape, dtype = elem_specs[n]
+                elem_like = Tensor(jnp.zeros(shape, dtype))
             try:
                 out[i] = StagedArray.from_list(
-                    v, headroom=head, loop_fixed=True)
+                    v, headroom=head, loop_fixed=True, elem_like=elem_like)
             except StagedArrayError as e:
                 raise Dy2StaticError(f"list '{n}': {e}") from e
+            if bound is None:
+                defaulted.append(n)
         elif isinstance(v, StagedArray):
             if not v._loop_fixed:
-                out[i] = v.reserve(head).with_loop_fixed(True)
+                if v._user_sized:
+                    # jit.staged_list(capacity, ...): the capacity is the
+                    # user's explicit choice — don't inflate it with the
+                    # default, and don't warn the user to do what they
+                    # already did; overflow stays loudly detected
+                    out[i] = v.with_loop_fixed(True)
+                else:
+                    # auto-staged earlier (an if-branch select, a prior
+                    # loop): give it headroom like a plain list
+                    out[i] = v.reserve(head).with_loop_fixed(True)
+                    if bound is None:
+                        defaulted.append(n)
+    if defaulted:
+        import warnings
+
+        warnings.warn(
+            f"staged list(s) {sorted(defaulted)} in a tensor-dependent "
+            f"loop with no static trip bound: falling back to the default "
+            f"capacity of {head} rows (PTPU_DY2STATIC_LIST_CAPACITY). "
+            "More appends than that overflow loudly at materialization, "
+            "and for large elements (KV cache rows, per-step logits) the "
+            f"compiled program carries a [{head}, ...] buffer — pre-size "
+            "the list with paddle_tpu.jit.staged_list(capacity, example) "
+            "to pick the right capacity.",
+            stacklevel=3)
     return tuple(out)
 
 

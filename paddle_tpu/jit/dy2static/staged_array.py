@@ -76,10 +76,16 @@ _pending_discards: list = []
 class StagedArray:
     """See module docstring.  Construct via `from_list` / `staged_list`."""
 
-    def __init__(self, data: Tensor, length: Tensor, loop_fixed: bool = False):
+    def __init__(self, data: Tensor, length: Tensor, loop_fixed: bool = False,
+                 user_sized: bool = False):
         self._data = data
         self._length = length
         self._loop_fixed = bool(loop_fixed)
+        # True for buffers whose capacity the USER chose via
+        # jit.staged_list(capacity, ...): the loop-staging machinery then
+        # treats the capacity as authoritative instead of adding default
+        # headroom (and does not warn about the default fallback)
+        self._user_sized = bool(user_sized)
         self._superseded = False
         self._must_consume = False
         self._consumed = False
@@ -101,10 +107,12 @@ class StagedArray:
         self._consumed = True
 
     def _derive(self, out: "StagedArray") -> "StagedArray":
-        """Mutation result inherits the must-consume obligation; the
-        source fed a chain, which counts as consumption."""
+        """Mutation result inherits the must-consume obligation (the
+        source fed a chain, which counts as consumption) and the
+        user-sized mark."""
         self._consumed = True
         out._must_consume = self._must_consume
+        out._user_sized = out._user_sized or self._user_sized
         return out
 
     # -- construction -------------------------------------------------------
@@ -393,7 +401,8 @@ def _staged_flatten(sa: StagedArray):
     # registered pytree); unflatten re-wraps. Being flattened = being
     # carried/selected/returned, which consumes the value.
     sa._consumed = True
-    return ((unwrap(sa._data), unwrap(sa._length)), (sa._loop_fixed,))
+    return ((unwrap(sa._data), unwrap(sa._length)),
+            (sa._loop_fixed, sa._user_sized))
 
 
 def _staged_unflatten(aux, children):
@@ -401,7 +410,8 @@ def _staged_unflatten(aux, children):
     data = data if isinstance(data, Tensor) else Tensor(jnp.asarray(data))
     length = (length if isinstance(length, Tensor)
               else Tensor(jnp.asarray(length)))
-    return StagedArray(data, length, loop_fixed=aux[0])
+    return StagedArray(data, length, loop_fixed=aux[0],
+                       user_sized=aux[1] if len(aux) > 1 else False)
 
 
 jax.tree_util.register_pytree_node(
@@ -420,4 +430,7 @@ def staged_list(capacity, example=None, values=()):
         raise ValueError(
             f"staged_list capacity {capacity} is smaller than the "
             f"{len(vals)} initial values")
-    return StagedArray.from_list(vals, headroom=head, elem_like=example)
+    sa = StagedArray.from_list(vals, headroom=head, elem_like=example)
+    sa._user_sized = True    # the capacity is the user's choice: loop
+    #                          staging must not inflate it with defaults
+    return sa

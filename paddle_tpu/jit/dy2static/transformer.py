@@ -378,10 +378,19 @@ def _mutated_list_names(body):
     helpers — read off the `name = _ptpu_dy2st.convert_append(name, ...)`
     assignments, plus the `mutated` keyword of already-converted nested
     loops (their bodies live inside generated defs that _walk_scope does
-    not enter)."""
+    not enter), plus the bodies of convert_ifelse's generated
+    `__ptpu_true_/__ptpu_false_` branch closures — a
+    `if cond: acc.append(x)` inside this loop moved its mutation into
+    those FunctionDefs, and missing it would leave `acc` un-staged in the
+    loop carry (surfacing as a misleading shape/dtype-stability error)."""
     out = set()
     for st in body:
         for sub in _walk_scope(st):
+            if (isinstance(sub, ast.FunctionDef)
+                    and sub.name.startswith(("__ptpu_true_",
+                                             "__ptpu_false_"))):
+                out |= _mutated_list_names(sub.body)
+                continue
             if not (isinstance(sub, ast.Assign)
                     and isinstance(sub.value, ast.Call)
                     and isinstance(sub.value.func, ast.Attribute)

@@ -1008,6 +1008,10 @@ class GPTForCausalLM(Layer):
         ids = ids.astype(jnp.int32)
         if ids.ndim == 1:
             ids = ids[None]
+        if max_new_tokens <= 0:
+            # nothing to generate: the decode trace cannot even be built
+            # (its token buffer would be [B, 0])
+            return Tensor(ids)
         B, P = ids.shape
         total = P + max_new_tokens
         if total > cfg.max_position_embeddings:

@@ -659,6 +659,23 @@ def adaptive_max_pool2d(x, output_size, return_mask=False,
 # normalization
 # ---------------------------------------------------------------------------
 
+def layer_norm_arrays(a, w, b, naxes=(-1,), epsilon=1e-5):
+    """Array-level LayerNorm body — THE normalization arithmetic of
+    F.layer_norm (fp32 stats via jnp.mean/jnp.var).  Exposed so compiled
+    paths that must match Layer-based models bitwise (the serving
+    engine's final LN vs `GPTModel.ln_f`) share this exact op sequence
+    instead of hand-copying it."""
+    mu = jnp.mean(a.astype(jnp.float32), axis=naxes, keepdims=True)
+    var = jnp.var(a.astype(jnp.float32), axis=naxes, keepdims=True)
+    out = (a.astype(jnp.float32) - mu) * jax.lax.rsqrt(var + epsilon)
+    out = out.astype(a.dtype)
+    if w is not None:
+        out = out * w
+    if b is not None:
+        out = out + b
+    return out
+
+
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
     if isinstance(normalized_shape, int):
         normalized_shape = (normalized_shape,)
@@ -682,17 +699,14 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=N
                 x, weight, bias, name="layer_norm")
 
     def fn(a, *wb):
-        mu = jnp.mean(a.astype(jnp.float32), axis=naxes, keepdims=True)
-        var = jnp.var(a.astype(jnp.float32), axis=naxes, keepdims=True)
-        out = (a.astype(jnp.float32) - mu) * jax.lax.rsqrt(var + epsilon)
-        out = out.astype(a.dtype)
         i = 0
+        w = b = None
         if weight is not None:
-            out = out * wb[i]
+            w = wb[i]
             i += 1
         if bias is not None:
-            out = out + wb[i]
-        return out
+            b = wb[i]
+        return layer_norm_arrays(a, w, b, naxes, epsilon)
 
     args = [x]
     if weight is not None:

@@ -21,6 +21,10 @@ from .array import *  # noqa: F401,F403
 from .extras import *  # noqa: F401,F403
 
 from . import array, creation, math, manipulation, logic, extras
+# serving-side paged-KV attention: importable as ops.paged_attention —
+# array-level only, deliberately NOT star-exported into the top-level
+# paddle namespace (it is an engine primitive, not a user tensor op)
+from . import paged_attention  # noqa: F401
 
 __all__ = (
     list(creation.__all__)

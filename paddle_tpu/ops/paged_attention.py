@@ -1,0 +1,130 @@
+"""Paged-KV-cache attention (array level) — the serving-side primitive of
+`paddle_tpu.serving` (Ragged Paged Attention, PAPERS.md: block-paged KV
+caches + ragged batch decoding are the TPU-side key to high-throughput LLM
+serving).
+
+Layout: K/V live in fixed-size physical blocks
+
+    k_blocks, v_blocks : [num_blocks, block_size, num_heads, head_dim]
+
+and each sequence owns a *block table* row mapping its logical blocks to
+physical ones.  Token `p` of a sequence lives at physical slot
+``table[p // block_size] * block_size + p % block_size``.
+
+Numerics contract: `paged_attention_arrays` reproduces the masked-softmax
+decode path of `cached_attention_arrays` (models/gpt.py:326 is the
+numerical reference) EXACTLY — same einsum contraction (fp32
+accumulation), same additive -1e30 causal mask, same softmax and
+probs-cast — so paged decode is token-for-token identical to the dense
+`[B, S_max]` ring decode: gathered block rows land at the same logical
+key positions, and padding rows beyond a row's context are masked to an
+exact 0 probability (exp underflows to 0.0), contributing exactly nothing
+to the reductions.  tests/test_serving.py pins this parity against
+`GPTModel.generate()`.
+
+No Pallas kernel here yet: at S_q = 1 the op is bandwidth-bound (MXU
+irrelevant), matching the dense decode path's design note; a fused
+gather+attention kernel is the obvious follow-up once serving shapes are
+profiled on chip.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["paged_attention_arrays", "paged_cache_update_arrays",
+           "paged_gather_kv_arrays", "slot_mapping"]
+
+_NEG_INF = -1e30
+
+
+def slot_mapping(block_table, positions, block_size, num_slots,
+                 valid=None):
+    """Physical slot of each (row, position): ``[B, S]`` int32.
+
+    block_table: [B, max_blocks] int32 physical block ids (rows may be
+    padded arbitrarily past the blocks a sequence owns — positions only
+    index into the table through ``positions // block_size``).
+    positions:   [B, S] int32 absolute token positions.
+    valid:       optional [B, S] bool; invalid entries map to `num_slots`
+    (one past the last slot) so a scatter with mode='drop' discards them.
+    """
+    block_table = jnp.asarray(block_table, jnp.int32)
+    positions = jnp.asarray(positions, jnp.int32)
+    bs = int(block_size)
+    logical = positions // bs
+    maxb = block_table.shape[1]
+    phys = jnp.take_along_axis(
+        block_table, jnp.clip(logical, 0, maxb - 1), axis=1)
+    slots = phys * bs + positions % bs
+    if valid is not None:
+        slots = jnp.where(valid, slots, jnp.int32(num_slots))
+    return slots
+
+
+def paged_cache_update_arrays(blocks, rows, slots):
+    """Scatter new K (or V) rows into the paged pool.
+
+    blocks: [num_blocks, block_size, H, D] (or [.., H*D])
+    rows:   [B, S, H, D] (or [B, S, H*D]) new keys/values
+    slots:  [B, S] int32 physical slots (from `slot_mapping`); out-of-range
+            entries (padding / inactive rows) are DROPPED, never clamped —
+            a clamp would silently corrupt the last block.
+    Returns the updated pool (same shape/dtype as `blocks`).
+    """
+    nb, bs = blocks.shape[0], blocks.shape[1]
+    feat = blocks.shape[2:]
+    flat = blocks.reshape((nb * bs,) + tuple(feat))
+    r = rows.reshape((-1,) + tuple(feat)).astype(blocks.dtype)
+    flat = flat.at[slots.reshape(-1)].set(r, mode="drop")
+    return flat.reshape(blocks.shape)
+
+
+def paged_gather_kv_arrays(blocks, block_table):
+    """Gather one sequence-major view of the pool: [B, max_blocks *
+    block_size, H, D].  Rows past a sequence's context hold garbage (stale
+    or zero blocks) — callers mask them; table entries are clipped into
+    range (padding entries gather *some* block, masked the same way)."""
+    nb, bs = blocks.shape[0], blocks.shape[1]
+    feat = blocks.shape[2:]
+    tbl = jnp.clip(jnp.asarray(block_table, jnp.int32), 0, nb - 1)
+    g = jnp.take(blocks, tbl, axis=0)          # [B, maxb, bs, *feat]
+    b, maxb = tbl.shape
+    return g.reshape((b, maxb * bs) + tuple(feat))
+
+
+def paged_attention_arrays(q, k_blocks, v_blocks, block_table, pos0,
+                           scale=None):
+    """Causal attention of a (ragged) batch against its paged KV cache.
+
+    q:            [B, S, H, D] — S=1 at decode, >1 for a prefill chunk
+    k_blocks/v_blocks: [num_blocks, block_size, H, D] physical pools
+                  (the current chunk's K/V must already be written —
+                  write-then-attend, like the dense cache path)
+    block_table:  [B, max_blocks] int32 per-row logical→physical map
+    pos0:         [B] int32 absolute position of each row's FIRST query
+                  (== that row's context length before this chunk)
+    Returns [B, S, H, D] in q's dtype.
+
+    Each query at absolute position p attends keys with k_pos <= p —
+    the same additive -1e30 mask + fp32-softmax arithmetic as
+    `cached_attention_arrays`, with a per-ROW position instead of its
+    scalar `t` (that is the whole ragged-batch generalization).
+    """
+    b, s, h, d = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    kg = paged_gather_kv_arrays(k_blocks, block_table)     # [B, S_pad, H, D]
+    vg = paged_gather_kv_arrays(v_blocks, block_table)
+    s_pad = kg.shape[1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, kg,
+                        preferred_element_type=jnp.float32) * scale
+    q_pos = jnp.asarray(pos0, jnp.int32)[:, None] + jnp.arange(
+        s, dtype=jnp.int32)[None, :]                       # [B, S]
+    k_pos = jnp.arange(s_pad, dtype=jnp.int32)
+    causal = k_pos[None, None, :] <= q_pos[:, :, None]     # [B, S, S_pad]
+    logits = jnp.where(causal[:, None], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(vg.dtype), vg)
+    return out.astype(q.dtype)

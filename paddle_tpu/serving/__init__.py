@@ -1,0 +1,34 @@
+"""paddle_tpu.serving — continuous-batching LLM inference on a paged KV
+cache (Ragged Paged Attention + MPK-style runtime scheduling; PAPERS.md).
+
+Quickstart::
+
+    from paddle_tpu.serving import LLMEngine, EngineConfig, SamplingParams
+    from paddle_tpu.models import GPTForCausalLM, gpt_test_config
+
+    model = GPTForCausalLM(gpt_test_config(stacked_blocks=True))
+    engine = LLMEngine(model, EngineConfig(block_size=16))
+    outs = engine.generate([prompt_a, prompt_b],
+                           SamplingParams(max_new_tokens=32))
+
+Layers (each its own module, each independently testable):
+
+- `kv_cache.BlockKVCache` — block pool + free-list allocator, per-request
+  block tables, copy-on-fork, bit-exact eviction swap.
+- `scheduler.Scheduler`  — waiting queue, token-budget admission,
+  preemption-by-eviction; `SamplingParams` / `Request` state machines.
+- `engine.LLMEngine`     — jitted prefill/decode/sample step programs over
+  `ops.paged_attention`, token-for-token equal to the dense
+  `GPTForCausalLM.generate` (tests/test_serving.py pins it).
+
+The user-facing entry point also hangs off `paddle_tpu.inference`
+(`inference.LLMEngine` etc.), next to the Predictor serving surface.
+"""
+from .kv_cache import BlockAllocatorError, BlockKVCache
+from .scheduler import Request, SamplingParams, Scheduler, SchedulerOutput
+from .engine import EngineConfig, LLMEngine
+
+__all__ = [
+    "BlockAllocatorError", "BlockKVCache", "EngineConfig", "LLMEngine",
+    "Request", "SamplingParams", "Scheduler", "SchedulerOutput",
+]

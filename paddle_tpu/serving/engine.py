@@ -1,0 +1,512 @@
+"""`LLMEngine` — continuous-batching inference over a paged KV cache.
+
+The dense path (`GPTForCausalLM.generate`) runs ONE fixed batch to
+completion: no admission, no batching across arrivals, O(S_max) cache per
+request.  This engine serves an ever-changing request mix through a small
+set of jitted step programs of fixed padded shape (XLA recompiles only
+per bucket), with the scheduler — waiting queue, token-budget admission,
+preemption — living OUTSIDE the compiled step (the MPK structure from
+PAPERS.md: runtime scheduling around static tensor programs).
+
+Step programs (all array-level, weights threaded as inputs):
+
+- ``prefill(P)``   — one request, exact prompt length, causal flash
+  attention within the chunk + paged K/V writes.  Exact length (not
+  bucketed) on purpose: it makes the prefill arithmetic *identical* to
+  the dense path's flash prefill, which is what turns "paged decode
+  matches dense generate" from a tolerance into token-for-token equality
+  (tests/test_serving.py).  One compile per distinct prompt length — the
+  prefill-compile price of exactness; decode, the steady-state loop, is
+  fully bucketed.
+- ``chunk(B, C)``  — ragged batch of C tokens per row against the paged
+  pool via `ops.paged_attention` (C=1 is the decode workhorse; C>1
+  serves chunked-prefill continuations).  Batch is padded to
+  power-of-two buckets; padding rows scatter to a dropped slot and their
+  outputs are ignored.
+- ``sample(B)``    — per-row replication of the dense `_sample_next`
+  (greedy argmax / temperature / top-k / top-p + per-request PRNG key
+  threading), vmapped so every request reproduces the sampling stream of
+  its own solo `generate(seed=...)` call bit-for-bit.
+
+Numerics contract: every op here mirrors the dense path's arithmetic
+(same embedding takes, `_stacked_block_body` blocks, `F.layer_norm`
+float32 stats, same LM-head einsum, -1e30 masks) so a mixed-length
+continuous batch returns exactly the tokens of per-request solo runs.
+Scope of the bit-exactness guarantee: it is pinned against the dense
+path's masked-softmax DECODE REFERENCE (`cached_attention_arrays`' XLA
+branch — the only decode path off-TPU, where the parity tests run).  On
+a TPU host the dense oracle may route through the Pallas flash-decode
+kernel, whose online-softmax reduction order differs in the last ulp —
+there the two paths are mathematically identical but argmax ties can in
+principle resolve differently; parity against the reference branch is
+the invariant this module maintains.  Assumes AMP autocast is off
+(serving is eval-mode; the dense generate path makes the same
+assumption).
+
+Monitor wiring (PR-1 StatRegistry): `serving/queue_depth`,
+`serving/running`, `serving/waiting`, `serving/blocks_in_use`,
+`serving/block_utilization`, `serving/prefill_tokens`,
+`serving/decode_tokens`, `serving/prefill_tps`, `serving/decode_tps`,
+`serving/preemptions`, `serving/requests_finished`, plus
+`serving/step_time` histograms labeled by phase.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import monitor
+from ..ops.paged_attention import (paged_attention_arrays,
+                                   paged_cache_update_arrays)
+from .kv_cache import BlockKVCache
+from .scheduler import Request, SamplingParams, Scheduler
+
+__all__ = ["EngineConfig", "LLMEngine"]
+
+_NEG_INF = -1e30
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    block_size: int = 16
+    num_blocks: Optional[int] = None       # default: dense-equivalent pool
+    max_num_seqs: int = 8
+    # prefill token budget per step; None = whole prompt in one chunk
+    # (the exact-parity path — chunked prefill is mathematically equal
+    # but reassociates float reductions)
+    max_num_batched_tokens: Optional[int] = None
+    max_model_len: Optional[int] = None    # default: max_position_embeddings
+
+
+class LLMEngine:
+    """add_request() / step() / generate() over a stacked-blocks GPT."""
+
+    def __init__(self, model, config: Optional[EngineConfig] = None):
+        cfg = model.cfg
+        if not cfg.stacked_blocks:
+            raise ValueError(
+                "LLMEngine serves the stacked-blocks GPT form "
+                "(GPTConfig(stacked_blocks=True)) — per-layer Layer "
+                "modules would re-trace one program per layer")
+        self.model = model
+        model.eval()
+        self.cfg = cfg
+        self.config = config or EngineConfig()
+        c = self.config
+        self.max_model_len = int(c.max_model_len
+                                 or cfg.max_position_embeddings)
+        # gathered view width mirrors the dense ring rounding
+        # (init_caches: length rounds up to 128) so the decode softmax
+        # reduces over the SAME padded extent as the dense oracle
+        ring = -(-self.max_model_len // 128) * 128
+        self.blocks_per_seq = -(-ring // c.block_size)
+        nh = cfg.num_attention_heads
+        hd = cfg.hidden_size // nh
+        num_blocks = (c.num_blocks if c.num_blocks is not None
+                      else c.max_num_seqs * self.blocks_per_seq)
+        wdtype = model.gpt.embeddings.word_embeddings.weight.dtype
+        self.cache = BlockKVCache(
+            cfg.num_hidden_layers, num_blocks, c.block_size, nh, hd,
+            dtype=wdtype)
+        self.scheduler = Scheduler(
+            self.cache, max_num_seqs=c.max_num_seqs,
+            max_num_batched_tokens=(c.max_num_batched_tokens
+                                    or self.max_model_len))
+        self._requests: dict = {}
+        self._next_id = 0
+        self._jit_cache: dict = {}
+        self._stack_names = list(model.gpt.blocks._names)
+        # monitor handles (cheap no-ops when PTPU_MONITOR=0)
+        m = monitor
+        self._m_queue = m.gauge("serving/queue_depth",
+                                "requests waiting for admission")
+        self._m_running = m.gauge("serving/running", "requests decoding")
+        self._m_waiting = m.gauge("serving/waiting",
+                                  "waiting incl. preempted")
+        self._m_blocks = m.gauge("serving/blocks_in_use", "KV blocks held")
+        self._m_util = m.gauge("serving/block_utilization",
+                               "blocks_in_use / num_blocks")
+        self._m_pre_toks = m.counter("serving/prefill_tokens")
+        self._m_dec_toks = m.counter("serving/decode_tokens")
+        self._m_pre_tps = m.gauge("serving/prefill_tps")
+        self._m_dec_tps = m.gauge("serving/decode_tps")
+        self._m_preempt = m.counter("serving/preemptions")
+        self._m_done = m.counter("serving/requests_finished")
+        self._m_step = m.histogram("serving/step_time")
+
+    # -- request API --------------------------------------------------------
+
+    def add_request(self, prompt_ids, sampling_params=None) -> int:
+        params = sampling_params or SamplingParams()
+        prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
+        if not prompt:
+            raise ValueError("empty prompt")
+        total = len(prompt) + params.max_new_tokens
+        if total > self.max_model_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({params.max_new_tokens}) exceeds max_model_len "
+                f"({self.max_model_len})")
+        req = Request(self._next_id, prompt, params)
+        self._next_id += 1
+        req.key = self._init_key(params)
+        self._requests[req.req_id] = req
+        self.scheduler.add(req)
+        return req.req_id
+
+    def fork_request(self, parent_id, sampling_params=None) -> int:
+        """Copy-on-fork: a new request continuing the parent's current
+        text, SHARING the parent's KV blocks (refcounted; first divergent
+        write copies only the shared partial block).  The shared-prompt
+        serving shape: N samplings of one prompt pay its prefill once."""
+        parent = self._requests[parent_id]
+        if parent.state not in (Request.RUNNING,) or not parent.prefill_done:
+            raise ValueError(
+                "fork requires a running, fully-prefilled parent")
+        params = sampling_params or parent.params
+        prompt = parent.prompt_ids + parent.output_ids
+        total = len(prompt) + params.max_new_tokens
+        if total > self.max_model_len:
+            raise ValueError("forked request exceeds max_model_len")
+        req = Request(self._next_id, prompt, params)
+        self._next_id += 1
+        req.key = self._init_key(params)
+        # parent has written total_len-1 positions (the last sampled token
+        # is fed next step); the child re-feeds it as its final "prompt"
+        # token through its own prefill continuation
+        req.num_computed = parent.total_len - 1
+        self.cache.fork(parent_id, req.req_id)
+        # that re-feed WRITE lands at position total_len-1, which lives in
+        # the (shared) last block — privatize it now so the child's
+        # recomputation can never perturb the parent's cache
+        self.cache.privatize_last_block(req.req_id)
+        self._requests[req.req_id] = req
+        self.scheduler.add(req)
+        return req.req_id
+
+    @staticmethod
+    def _init_key(params: SamplingParams):
+        from ..core import random as _rng
+
+        if params.do_sample:
+            if params.seed is not None:
+                return jax.random.PRNGKey(params.seed)
+            return _rng.next_key()
+        return jax.random.PRNGKey(0)    # greedy never consumes it
+
+    def request_output(self, req_id) -> np.ndarray:
+        """[prompt + generated] int32 ids (dense generate's row shape)."""
+        req = self._requests[req_id]
+        return np.asarray(req.prompt_ids + req.output_ids, np.int32)
+
+    def release_request(self, req_id) -> None:
+        """Drop a request's host state (and abort it if unfinished).
+        Callers of the add_request/step API must release requests after
+        reading their output — a server that never releases retains every
+        prompt/output token list forever.  `generate()` releases its own
+        requests."""
+        req = self._requests.pop(req_id, None)
+        if req is None or req.finished:
+            return
+        sched = self.scheduler
+        if req in sched.running:
+            sched.running.remove(req)
+            self.cache.free(req_id)
+        elif req in sched.waiting:
+            sched.waiting.remove(req)
+            if req.req_id in self.cache._tables:   # forked child prefix
+                self.cache.free(req_id)
+        req.swap = None
+        req.state = Request.FINISHED
+
+    def has_unfinished(self) -> bool:
+        return self.scheduler.has_work()
+
+    # -- the loop -----------------------------------------------------------
+
+    def generate(self, prompts, sampling_params=None):
+        """Run `prompts` (list of id sequences) to completion; returns a
+        list of [prompt + generated] int32 arrays in submission order."""
+        if sampling_params is None or isinstance(sampling_params,
+                                                 SamplingParams):
+            params = [sampling_params] * len(prompts)
+        else:
+            params = list(sampling_params)
+            if len(params) != len(prompts):
+                raise ValueError("one SamplingParams per prompt (or one "
+                                 "shared instance)")
+        ids = [self.add_request(p, sp) for p, sp in zip(prompts, params)]
+        try:
+            while self.scheduler.has_work():
+                self.step()
+            return [self.request_output(i) for i in ids]
+        finally:
+            # also on error (e.g. a too-small pool raising mid-loop):
+            # abandoning admitted requests would leak their KV blocks and
+            # poison the next generate() call's work loop
+            for i in ids:
+                self.release_request(i)
+
+    def step(self) -> list:
+        """One scheduler decision + one jitted exec.  Returns the requests
+        that FINISHED this step."""
+        t0 = time.perf_counter()
+        out = self.scheduler.schedule()
+        if out.preempted:
+            self._m_preempt.inc(len(out.preempted))
+        if out.kind == "prefill":
+            self._step_prefill(out)
+            phase, toks = "prefill", out.chunk_len
+        elif out.kind == "decode":
+            self._step_decode(out)
+            phase, toks = "decode", len(out.decode_requests)
+        else:
+            phase, toks = "idle", 0
+        done = self.scheduler.retire_finished()
+        for req in done:
+            self._m_done.inc()
+        dt = time.perf_counter() - t0
+        if monitor.enabled():
+            self._m_step.labels(phase=phase).observe(dt)
+            if phase == "prefill":
+                self._m_pre_toks.inc(toks)
+                self._m_pre_tps.set(toks / max(dt, 1e-9))
+            elif phase == "decode":
+                self._m_dec_toks.inc(toks)
+                self._m_dec_tps.set(toks / max(dt, 1e-9))
+            sched = self.scheduler
+            # queue_depth: admission backlog (never-started requests);
+            # waiting: everything not running, preempted included
+            self._m_queue.set(sum(1 for r in sched.waiting
+                                  if r.state == Request.WAITING))
+            self._m_running.set(len(sched.running))
+            self._m_waiting.set(len(sched.waiting))
+            self._m_blocks.set(self.cache.blocks_in_use)
+            self._m_util.set(self.cache.blocks_in_use
+                             / max(self.cache.num_blocks, 1))
+        return list(done)
+
+    # -- step bodies --------------------------------------------------------
+
+    def _step_prefill(self, out):
+        req = out.prefill_request
+        start, chunk = out.chunk_start, out.chunk_len
+        ids = np.asarray([req.prompt_ids[start:start + chunk]], np.int32)
+        positions = np.arange(start, start + chunk, dtype=np.int64)
+        slots = np.asarray(
+            [[self.cache.slot(req.req_id, int(p)) for p in positions]],
+            np.int32)
+        kv = self._kv_flat()
+        if start == 0 and chunk == req.prompt_len:
+            # whole prompt in one chunk: flash within the chunk, the
+            # dense prefill's exact arithmetic
+            fn = self._get_prefill_exec(chunk)
+            logits, kv_out = fn(self._param_arrays(), kv, jnp.asarray(ids),
+                                jnp.asarray(slots))
+        else:
+            fn = self._get_chunk_exec(1, chunk)
+            tables = jnp.asarray(
+                [self.cache.padded_table(req.req_id, self.blocks_per_seq)],
+                jnp.int32)
+            logits, kv_out = fn(self._param_arrays(), kv, jnp.asarray(ids),
+                                jnp.asarray([start], jnp.int32), tables,
+                                jnp.asarray(slots))
+        self._store_kv(kv_out)
+        req.num_computed = start + chunk
+        if req.prefill_done:
+            if req.params.max_new_tokens <= 0:
+                # dense generate(max_new_tokens=0) emits nothing
+                req.state = Request.FINISHED
+            else:
+                self._sample_rows([req], logits)
+
+    def _step_decode(self, out):
+        rows = list(out.decode_requests)
+        n = len(rows)
+        bb = 1
+        while bb < n:
+            bb *= 2
+        bb = min(max(bb, 1), self.scheduler.max_num_seqs)
+        num_slots = self.cache.num_blocks * self.cache.block_size
+        toks = np.zeros((bb, 1), np.int32)
+        pos0 = np.zeros((bb,), np.int32)
+        tables = np.full((bb, self.blocks_per_seq), self.cache.num_blocks,
+                         np.int32)
+        slots = np.full((bb, 1), num_slots, np.int32)
+        for i, req in enumerate(rows):
+            toks[i, 0] = req.output_ids[-1] if req.output_ids \
+                else req.prompt_ids[-1]
+            p = req.total_len - 1
+            pos0[i] = p
+            tables[i] = self.cache.padded_table(req.req_id,
+                                                self.blocks_per_seq)
+            slots[i, 0] = self.cache.slot(req.req_id, p)
+        fn = self._get_chunk_exec(bb, 1)
+        logits, kv_out = fn(self._param_arrays(), self._kv_flat(),
+                            jnp.asarray(toks), jnp.asarray(pos0),
+                            jnp.asarray(tables), jnp.asarray(slots))
+        self._store_kv(kv_out)
+        self._sample_rows(rows, logits)
+
+    def _sample_rows(self, rows, logits):
+        """Sample one token per live row from [B, V] fp32 logits (B may
+        exceed len(rows) by padding)."""
+        bb = int(logits.shape[0])
+        keys = np.zeros((bb, 2), np.uint32)
+        ds = np.zeros((bb,), bool)
+        temp = np.ones((bb,), np.float32)
+        topk = np.zeros((bb,), np.int32)
+        topp = np.ones((bb,), np.float32)
+        for i, req in enumerate(rows):
+            p = req.params
+            keys[i] = np.asarray(req.key, np.uint32)
+            ds[i] = p.do_sample
+            temp[i] = p.temperature
+            topk[i] = p.top_k
+            topp[i] = p.top_p
+        fn = self._get_sample_exec(bb)
+        toks, new_keys = fn(logits, jnp.asarray(keys), jnp.asarray(ds),
+                            jnp.asarray(temp), jnp.asarray(topk),
+                            jnp.asarray(topp))
+        toks = np.asarray(toks)
+        new_keys = np.asarray(new_keys)
+        for i, req in enumerate(rows):
+            req.key = jnp.asarray(new_keys[i], jnp.uint32)
+            req.record_token(int(toks[i]))
+
+    # -- array plumbing -----------------------------------------------------
+
+    def _param_arrays(self):
+        gpt = self.model.gpt
+        params = {n: getattr(gpt.blocks, n)._data for n in self._stack_names}
+        params["wte"] = gpt.embeddings.word_embeddings.weight._data
+        params["wpe"] = gpt.embeddings.position_embeddings.weight._data
+        params["lnf_w"] = gpt.ln_f.weight._data
+        params["lnf_b"] = gpt.ln_f.bias._data
+        return params
+
+    def _kv_flat(self):
+        return tuple(a for pair in zip(self.cache.k_blocks,
+                                       self.cache.v_blocks) for a in pair)
+
+    def _store_kv(self, kv_out):
+        L = self.cfg.num_hidden_layers
+        self.cache.k_blocks = [kv_out[2 * l] for l in range(L)]
+        self.cache.v_blocks = [kv_out[2 * l + 1] for l in range(L)]
+
+    # -- jitted step programs ----------------------------------------------
+
+    def _model_tail(self, params, h):
+        """Final LN + tied LM head — the dense path's ln_f arithmetic
+        (`F.layer_norm`, NOT the block `_stacked_ln`) and lm_head einsum,
+        shared at array level so parity tracks the oracle by
+        construction."""
+        from ..nn.functional import layer_norm_arrays
+
+        hn = layer_norm_arrays(h, params["lnf_w"], params["lnf_b"],
+                               epsilon=self.cfg.layer_norm_epsilon)
+        logits = jnp.einsum("bsh,vh->bsv", hn, params["wte"])
+        return logits[:, -1].astype(jnp.float32)
+
+    def _run_blocks(self, params, kv_flat, x, attn_builder):
+        from ..models.gpt import _stacked_block_body
+
+        cfg = self.cfg
+        nh = cfg.num_attention_heads
+        hd = cfg.hidden_size // nh
+        eps = cfg.layer_norm_epsilon
+        h = x
+        outs = []
+        for l in range(cfg.num_hidden_layers):
+            kc, vc = kv_flat[2 * l], kv_flat[2 * l + 1]
+            p = {n: params[n][l] for n in self._stack_names}
+            attn_fn = attn_builder(kc, vc)
+            h, (kc2, vc2) = _stacked_block_body(p, h, attn_fn, nh, hd, eps)
+            outs += [kc2, vc2]
+        return h, tuple(outs)
+
+    def _get_prefill_exec(self, p_len):
+        key = ("prefill", p_len)
+        if key not in self._jit_cache:
+            def fn(params, kv_flat, ids, slots):
+                from ..ops.pallas_ops import flash_attention_arrays
+
+                pos = jnp.arange(ids.shape[1], dtype=jnp.int32)
+                x = jnp.take(params["wte"], ids, axis=0) \
+                    + jnp.take(params["wpe"], pos, axis=0)
+
+                def builder(kc, vc):
+                    def attn_fn(q, k, v, kc=kc, vc=vc):
+                        kc2 = paged_cache_update_arrays(kc, k, slots)
+                        vc2 = paged_cache_update_arrays(vc, v, slots)
+                        o = flash_attention_arrays(q, k, v, is_causal=True)
+                        return o, (kc2, vc2)
+                    return attn_fn
+
+                h, kv_out = self._run_blocks(params, kv_flat, x, builder)
+                return self._model_tail(params, h), kv_out
+
+            self._jit_cache[key] = jax.jit(fn, donate_argnums=(1,))
+        return self._jit_cache[key]
+
+    def _get_chunk_exec(self, b, c):
+        key = ("chunk", b, c)
+        if key not in self._jit_cache:
+            def fn(params, kv_flat, ids, pos0, tables, slots):
+                pos = pos0[:, None] + jnp.arange(c, dtype=jnp.int32)[None]
+                x = jnp.take(params["wte"], ids, axis=0) \
+                    + jnp.take(params["wpe"], pos, axis=0)
+
+                def builder(kc, vc):
+                    def attn_fn(q, k, v, kc=kc, vc=vc):
+                        # write-then-attend, the dense cache ordering
+                        kc2 = paged_cache_update_arrays(kc, k, slots)
+                        vc2 = paged_cache_update_arrays(vc, v, slots)
+                        o = paged_attention_arrays(q, kc2, vc2, tables,
+                                                   pos0)
+                        return o, (kc2, vc2)
+                    return attn_fn
+
+                h, kv_out = self._run_blocks(params, kv_flat, x, builder)
+                return self._model_tail(params, h), kv_out
+
+            self._jit_cache[key] = jax.jit(fn, donate_argnums=(1,))
+        return self._jit_cache[key]
+
+    def _get_sample_exec(self, b):
+        key = ("sample", b)
+        if key not in self._jit_cache:
+            def row(l, key_, ds, t, k, p):
+                # replicates models.gpt._sample_next on a [1, V] row so a
+                # request reproduces its solo generate() stream exactly
+                l1 = l[None, :]
+                greedy = jnp.argmax(l1, axis=-1).astype(jnp.int32)[0]
+                ks = jax.random.split(key_)
+                new_key, sub = ks[0], ks[1]
+                ll = l1 / jnp.maximum(t, jnp.float32(1e-6))
+                v = ll.shape[-1]
+                asc = jnp.sort(ll, axis=-1)
+                kth = jnp.take_along_axis(
+                    asc, jnp.clip(v - k, 0, v - 1)[None, None], axis=-1)
+                ll = jnp.where(k > 0, jnp.where(ll < kth, _NEG_INF, ll), ll)
+                desc = jnp.sort(ll, axis=-1)[:, ::-1]
+                probs = jax.nn.softmax(desc, axis=-1)
+                cum = jnp.cumsum(probs, axis=-1)
+                keep = cum - probs <= p
+                thresh = jnp.min(jnp.where(keep, desc, jnp.inf), axis=-1,
+                                 keepdims=True)
+                ll = jnp.where(p < 1.0,
+                               jnp.where(ll < thresh, _NEG_INF, ll), ll)
+                samp = jax.random.categorical(sub, ll, axis=-1).astype(
+                    jnp.int32)[0]
+                tok = jnp.where(ds, samp, greedy)
+                out_key = jnp.where(ds, new_key, key_)
+                return tok, out_key
+
+            self._jit_cache[key] = jax.jit(jax.vmap(row))
+        return self._jit_cache[key]

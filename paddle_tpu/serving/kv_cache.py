@@ -1,0 +1,241 @@
+"""Block-paged KV cache with a free-list allocator (the vLLM/Ragged-Paged-
+Attention memory model, re-grown for this stack; PAPERS.md).
+
+The dense decode path (`GPTForCausalLM.init_caches`) allocates a
+``[B, S_max, H*D]`` ring per request — O(S_max) HBM per request no matter
+how short the request actually is.  `BlockKVCache` instead pools K/V in
+fixed-size physical blocks
+
+    k_blocks[l], v_blocks[l] : [num_blocks, block_size, H, D]   per layer
+
+and gives each sequence a *block table* (list of physical block ids), so
+a request holds exactly ``ceil(len / block_size)`` blocks and frees them
+the moment it finishes.  The device arrays are plain jax buffers owned by
+this object; the engine's jitted step takes them donated and returns the
+updated pool.
+
+Allocator design (host-side, O(1) per op):
+
+- **free list** — LIFO stack of physical ids; `Block` objects carry a
+  refcount.
+- **copy-on-fork** — `fork(parent, child)` shares every parent block by
+  bumping refcounts (shared-prompt serving: N continuations of one prompt
+  pay its KV once).  The first append into a SHARED last block triggers
+  copy-on-write: a fresh block is allocated and the shared content copied
+  device-side (`_copy_block`).
+- **preemption by eviction** — `swap_out(seq)` snapshots the sequence's
+  block contents to host numpy and frees the blocks; `swap_in(seq)`
+  restores them bit-exactly into freshly allocated blocks.  Bit-exact
+  restore is what makes "preempted requests resume with identical
+  output" a guarantee instead of a tolerance (a recompute-from-prompt
+  resume would re-run prefill over a different chunk length and shift
+  last-ulp floats).
+
+Every transition asserts the refcount/free-list invariants — the
+allocator can never hand out a block that is still referenced
+(tests/test_serving.py fuzzes this).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = ["BlockKVCache", "BlockAllocatorError"]
+
+
+class BlockAllocatorError(RuntimeError):
+    pass
+
+
+class _Block:
+    __slots__ = ("idx", "ref")
+
+    def __init__(self, idx):
+        self.idx = idx
+        self.ref = 0
+
+
+class BlockKVCache:
+    def __init__(self, num_layers, num_blocks, block_size, num_heads,
+                 head_dim, dtype=jnp.float32):
+        self.num_layers = int(num_layers)
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.dtype = dtype
+        shape = (self.num_blocks, self.block_size, self.num_heads,
+                 self.head_dim)
+        self.k_blocks = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
+        self.v_blocks = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
+        self._blocks = [_Block(i) for i in range(self.num_blocks)]
+        self._free = list(range(self.num_blocks - 1, -1, -1))  # LIFO
+        self._tables: dict = {}        # seq_id -> [physical ids]
+        self._lengths: dict = {}       # seq_id -> token count covered
+        self.peak_blocks_in_use = 0
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def num_free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def blocks_in_use(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    def block_table(self, seq_id):
+        return list(self._tables[seq_id])
+
+    def padded_table(self, seq_id, width):
+        """Block table padded to `width` entries with num_blocks (an
+        out-of-range id — `paged_gather` clips it, masks cover it)."""
+        t = self._tables[seq_id]
+        if len(t) > width:
+            raise BlockAllocatorError(
+                f"sequence {seq_id} spans {len(t)} blocks > table width "
+                f"{width}")
+        return t + [self.num_blocks] * (width - len(t))
+
+    def slot(self, seq_id, position) -> int:
+        """Physical slot of an (allocated) token position."""
+        t = self._tables[seq_id]
+        return t[position // self.block_size] * self.block_size \
+            + position % self.block_size
+
+    def blocks_needed(self, num_tokens) -> int:
+        return -(-int(num_tokens) // self.block_size)
+
+    # -- allocate / grow / free --------------------------------------------
+
+    def _take(self) -> int:
+        if not self._free:
+            raise BlockAllocatorError("out of KV blocks")
+        i = self._free.pop()
+        blk = self._blocks[i]
+        assert blk.ref == 0, f"free list handed out a referenced block {i}"
+        blk.ref = 1
+        self.peak_blocks_in_use = max(self.peak_blocks_in_use,
+                                      self.blocks_in_use)
+        return i
+
+    def _release(self, idx):
+        blk = self._blocks[idx]
+        assert blk.ref > 0, f"double free of block {idx}"
+        blk.ref -= 1
+        if blk.ref == 0:
+            self._free.append(idx)
+
+    def _needs_cow(self, seq_id, num_tokens) -> bool:
+        """Will growing to `num_tokens` write into a SHARED partially-
+        filled last block?  (A full shared block is never written again —
+        new tokens land in fresh blocks — so it can stay shared.)"""
+        t = self._tables.get(seq_id)
+        old = self._lengths.get(seq_id, 0)
+        return bool(t) and num_tokens > old \
+            and old % self.block_size != 0 \
+            and self._blocks[t[-1]].ref > 1
+
+    def can_grow_to(self, seq_id, num_tokens) -> bool:
+        """Enough free blocks (plus a possible copy-on-write block) to
+        cover `num_tokens` for this sequence?"""
+        have = len(self._tables.get(seq_id, ()))
+        need = self.blocks_needed(num_tokens) - have
+        if self._needs_cow(seq_id, num_tokens):
+            need += 1              # CoW of the shared last block
+        return need <= len(self._free)
+
+    def allocate(self, seq_id, num_tokens):
+        """Register `seq_id` and give it blocks covering `num_tokens`."""
+        if seq_id in self._tables:
+            raise BlockAllocatorError(f"sequence {seq_id} already allocated")
+        need = self.blocks_needed(num_tokens)
+        if need > len(self._free):
+            raise BlockAllocatorError("out of KV blocks")
+        self._tables[seq_id] = [self._take() for _ in range(need)]
+        self._lengths[seq_id] = int(num_tokens)
+
+    def grow_to(self, seq_id, num_tokens):
+        """Extend a sequence's table to cover `num_tokens` tokens,
+        copy-on-writing a shared partially-filled last block first (the
+        append target must be privately owned — forked siblings keep
+        reading the original)."""
+        t = self._tables[seq_id]
+        if self._needs_cow(seq_id, num_tokens):
+            self._cow_last_block(seq_id)
+        while len(t) < self.blocks_needed(num_tokens):
+            t.append(self._take())
+        self._lengths[seq_id] = max(self._lengths[seq_id], int(num_tokens))
+
+    def free(self, seq_id):
+        for idx in self._tables.pop(seq_id):
+            self._release(idx)
+        self._lengths.pop(seq_id, None)
+
+    # -- copy-on-fork -------------------------------------------------------
+
+    def fork(self, parent_id, child_id):
+        """Share the parent's blocks with a new sequence (refcount bump —
+        no copy until one of them appends into the shared last block)."""
+        if child_id in self._tables:
+            raise BlockAllocatorError(f"sequence {child_id} already exists")
+        t = self._tables[parent_id]
+        for idx in t:
+            self._blocks[idx].ref += 1
+        self._tables[child_id] = list(t)
+        self._lengths[child_id] = self._lengths[parent_id]
+
+    def _copy_block(self, src, dst):
+        for l in range(self.num_layers):
+            self.k_blocks[l] = self.k_blocks[l].at[dst].set(
+                self.k_blocks[l][src])
+            self.v_blocks[l] = self.v_blocks[l].at[dst].set(
+                self.v_blocks[l][src])
+
+    def _cow_last_block(self, seq_id):
+        t = self._tables[seq_id]
+        src = t[-1]
+        dst = self._take()
+        self._copy_block(src, dst)
+        t[-1] = dst
+        self._release(src)
+
+    def privatize_last_block(self, seq_id):
+        """Copy the sequence's last block now if it is shared.  A forked
+        child RE-WRITES its final inherited position (it re-feeds the
+        parent's last sampled token through its own prefill), and that
+        slot must never land in a block the parent still reads — two
+        jitted programs recomputing the same K/V may differ in the last
+        ulp."""
+        t = self._tables[seq_id]
+        if t and self._blocks[t[-1]].ref > 1:
+            self._cow_last_block(seq_id)
+
+    # -- preemption swap ----------------------------------------------------
+
+    def swap_out(self, seq_id):
+        """Evict: host-snapshot the sequence's block contents and free its
+        blocks.  Returns the opaque saved state for `swap_in`."""
+        t = self._tables[seq_id]
+        idx = np.asarray(t, np.int32)
+        saved = {
+            "len": self._lengths[seq_id],
+            "k": [np.asarray(k[idx]) for k in self.k_blocks],
+            "v": [np.asarray(v[idx]) for v in self.v_blocks],
+        }
+        self.free(seq_id)
+        return saved
+
+    def swap_in(self, seq_id, saved):
+        """Restore an evicted sequence bit-exactly into fresh blocks."""
+        n = len(saved["k"][0])
+        if n > len(self._free):
+            raise BlockAllocatorError("out of KV blocks")
+        self._tables[seq_id] = [self._take() for _ in range(n)]
+        self._lengths[seq_id] = saved["len"]
+        idx = jnp.asarray(self._tables[seq_id], jnp.int32)
+        for l in range(self.num_layers):
+            self.k_blocks[l] = self.k_blocks[l].at[idx].set(
+                jnp.asarray(saved["k"][l]))
+            self.v_blocks[l] = self.v_blocks[l].at[idx].set(
+                jnp.asarray(saved["v"][l]))

@@ -1,0 +1,295 @@
+"""Continuous-batching scheduler (the MPK lesson from PAPERS.md applied to
+serving: scheduling lives OUTSIDE the compiled step, so one jitted decode
+program serves an ever-changing request mix).
+
+Per engine step the scheduler picks ONE of:
+
+- a **prefill** for the head of the waiting queue (prefill-priority, the
+  classic continuous-batching policy: new requests join the decode batch
+  at the earliest step), chunked to the token budget
+  (`max_num_batched_tokens`), admitted only when the KV pool can hold the
+  chunk;
+- a **decode** over every RUNNING request, after reserving each row's next
+  slot — reservation failures trigger **preemption by eviction**: the
+  youngest running request is swapped out (host snapshot, blocks freed,
+  re-queued at the FRONT of the waiting queue so arrival order is
+  preserved) until the rest fit.  Evicting the youngest minimizes wasted
+  work — the oldest requests are closest to finishing.
+
+The scheduler owns request state machines and the block accounting calls;
+it never touches device math — that is `engine.LLMEngine`'s half.
+"""
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Optional
+
+__all__ = ["SamplingParams", "Request", "Scheduler", "SchedulerOutput"]
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    """Per-request sampling controls — field-for-field the knobs of
+    `GPTForCausalLM.generate` (the parity oracle)."""
+
+    max_new_tokens: int = 16
+    do_sample: bool = False
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    eos_token_id: Optional[int] = None
+    seed: Optional[int] = None
+
+
+class Request:
+    """One in-flight generation: prompt, sampling state, and progress."""
+
+    WAITING, RUNNING, PREEMPTED, FINISHED = range(4)
+
+    def __init__(self, req_id, prompt_ids, params: SamplingParams):
+        self.req_id = req_id
+        self.prompt_ids = list(int(t) for t in prompt_ids)
+        self.params = params
+        self.state = Request.WAITING
+        self.output_ids: list = []         # generated tokens (incl. eos)
+        self.num_computed = 0              # prompt tokens prefilled so far
+        self.key = None                    # per-request PRNG key (engine)
+        self.swap = None                   # host KV snapshot while evicted
+        self.arrival = None                # admission tiebreak (set by add)
+
+    # -- derived ------------------------------------------------------------
+
+    @property
+    def prompt_len(self) -> int:
+        return len(self.prompt_ids)
+
+    @property
+    def total_len(self) -> int:
+        return self.prompt_len + len(self.output_ids)
+
+    @property
+    def prefill_done(self) -> bool:
+        return self.num_computed >= self.prompt_len
+
+    @property
+    def finished(self) -> bool:
+        return self.state == Request.FINISHED
+
+    def record_token(self, tok: int) -> None:
+        self.output_ids.append(int(tok))
+        p = self.params
+        if len(self.output_ids) >= p.max_new_tokens or (
+                p.eos_token_id is not None and int(tok) == p.eos_token_id):
+            self.state = Request.FINISHED
+
+    def __repr__(self):
+        names = {0: "WAITING", 1: "RUNNING", 2: "PREEMPTED", 3: "FINISHED"}
+        return (f"Request({self.req_id}, state={names[self.state]}, "
+                f"prompt={self.prompt_len}, out={len(self.output_ids)})")
+
+
+@dataclasses.dataclass
+class SchedulerOutput:
+    """What the engine must run this step."""
+
+    kind: str                      # "prefill" | "decode" | "idle"
+    prefill_request: Optional[Request] = None
+    chunk_start: int = 0           # prefill: first prompt position of chunk
+    chunk_len: int = 0
+    decode_requests: tuple = ()    # decode: rows of the batch
+    preempted: tuple = ()          # requests evicted while scheduling
+
+
+class Scheduler:
+    def __init__(self, cache, max_num_seqs=8, max_num_batched_tokens=2048):
+        self.cache = cache
+        self.max_num_seqs = int(max_num_seqs)
+        self.max_num_batched_tokens = int(max_num_batched_tokens)
+        self.waiting: deque = deque()
+        self.running: list = []
+        self._arrival = 0
+
+    # -- request lifecycle --------------------------------------------------
+
+    def add(self, req: Request) -> None:
+        req.arrival = self._arrival
+        self._arrival += 1
+        self.waiting.append(req)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    # -- the policy ---------------------------------------------------------
+
+    def schedule(self) -> SchedulerOutput:
+        preempted = []
+        # 1) continue a partially-prefilled running request (chunked
+        #    prefill spans several steps; it must finish before decoding)
+        part = next((r for r in self.running if not r.prefill_done), None)
+        if part is not None:
+            if self._ensure_blocks(
+                    part, min(part.prompt_len,
+                              part.num_computed
+                              + self.max_num_batched_tokens),
+                    preempted, protect=part):
+                return self._emit_prefill(part, preempted)
+            return SchedulerOutput(kind="idle", preempted=tuple(preempted))
+        # 2) admit / resume from the waiting queue (no eviction on behalf
+        #    of admission — preemption exists to keep RUNNING work
+        #    progressing, not to thrash between queued requests).  FIFO
+        #    head first; when the head is blocked and NOTHING is running,
+        #    any other schedulable entry (e.g. a forked child already
+        #    holding shared blocks whose completion will free them) is
+        #    tried before declaring the pool too small.
+        if self.waiting and len(self.running) < self.max_num_seqs:
+            got = self._admit_or_resume(self.waiting[0], preempted)
+            if isinstance(got, SchedulerOutput):
+                return got
+            if got is None and not self.running:
+                for req in list(self.waiting)[1:]:
+                    got = self._admit_or_resume(req, preempted)
+                    if isinstance(got, SchedulerOutput):
+                        return got
+                    if got:
+                        break
+                else:
+                    head = self.waiting[0]
+                    if head.swap is not None:
+                        raise RuntimeError(
+                            "KV cache too small: an evicted request can "
+                            "never be restored "
+                            f"(free={self.cache.num_free_blocks} blocks, "
+                            f"needs {len(head.swap['k'][0])})")
+                    raise RuntimeError(
+                        "KV cache too small: cannot hold a single request "
+                        f"(free={self.cache.num_free_blocks} blocks, "
+                        "prompt chunk needs "
+                        f"{self.cache.blocks_needed(min(head.prompt_len, self.max_num_batched_tokens))})")
+            # got is True: a swap-resume landed in running with no step to
+            # emit (mid-prefill resumes continue via branch 1 next call)
+        # 3) decode every running request, reserving one slot per row
+        if self.running:
+            rows = []
+            for req in list(self.running):   # oldest first
+                if req.state != Request.RUNNING or not req.prefill_done:
+                    continue                 # evicted mid-loop / mid-prefill
+                # this step writes position total_len - 1 (the last
+                # sampled token's K/V) — coverage of total_len tokens is
+                # exactly enough; one more would take a block a step early
+                if not self._ensure_blocks(req, req.total_len, preempted,
+                                           protect=req):
+                    continue                 # req itself was evicted
+                self.cache.grow_to(req.req_id, req.total_len)
+                rows.append(req)
+            # a LATER row's reservation may have evicted an EARLIER row
+            # that already made it into the batch — a preempted row's
+            # table is gone, so it must not reach the engine
+            rows = [r for r in rows if r.state == Request.RUNNING]
+            if rows:
+                return SchedulerOutput(kind="decode",
+                                       decode_requests=tuple(rows),
+                                       preempted=tuple(preempted))
+        return SchedulerOutput(kind="idle", preempted=tuple(preempted))
+
+    def _admit_or_resume(self, req, preempted):
+        """Try to start `req`: returns a SchedulerOutput to emit (a
+        prefill step), True when a swap-resume landed in `running` with
+        no step to emit, or None when it cannot start right now."""
+        if req.swap is not None:
+            if not self._can_swap_in(req):
+                return None
+            self.waiting.remove(req)
+            self.cache.swap_in(req.req_id, req.swap)
+            req.swap = None
+            req.state = Request.RUNNING
+            self.running.append(req)
+            return True
+        start = req.num_computed    # >0 only for forked children, which
+        #                             already hold (shared) prefix blocks
+        chunk = min(req.prompt_len - start, self.max_num_batched_tokens)
+        target = start + chunk
+        forked = req.req_id in self.cache._tables
+        fits = (self.cache.can_grow_to(req.req_id, target) if forked
+                else self.cache.blocks_needed(target)
+                <= self.cache.num_free_blocks)
+        if not fits:
+            return None
+        self.waiting.remove(req)
+        if forked:
+            self.cache.grow_to(req.req_id, target)
+        else:
+            self.cache.allocate(req.req_id, target)
+        req.state = Request.RUNNING
+        self.running.append(req)
+        return SchedulerOutput(kind="prefill", prefill_request=req,
+                               chunk_start=start, chunk_len=chunk,
+                               preempted=tuple(preempted))
+
+    def _emit_prefill(self, req, preempted) -> SchedulerOutput:
+        start = req.num_computed
+        chunk = min(req.prompt_len - start, self.max_num_batched_tokens)
+        self.cache.grow_to(req.req_id, start + chunk)
+        return SchedulerOutput(
+            kind="prefill", prefill_request=req, chunk_start=start,
+            chunk_len=chunk, preempted=tuple(preempted))
+
+    # -- eviction -----------------------------------------------------------
+
+    def _can_swap_in(self, req) -> bool:
+        return len(req.swap["k"][0]) <= self.cache.num_free_blocks
+
+    def _ensure_blocks(self, req, target_len, preempted, protect=None) -> bool:
+        """Make the pool able to cover `target_len` for `req`, evicting
+        youngest-first as needed.  Returns False if `req` itself had to be
+        evicted (nothing younger was left to take)."""
+        while not self.cache.can_grow_to(req.req_id, target_len):
+            victim = self._pick_victim(exclude=protect)
+            if victim is None:
+                # self-eviction only helps when someone ELSE still holds
+                # blocks (e.g. forked children in the waiting queue); a
+                # request that cannot fit in the EMPTY pool would evict
+                # itself, swap back in, and livelock forever — raise
+                need = self.cache.blocks_needed(target_len) + (
+                    1 if self.cache._needs_cow(req.req_id, target_len)
+                    else 0)
+                if need > self.cache.num_blocks:
+                    raise RuntimeError(
+                        "KV cache too small: request needs "
+                        f"{self.cache.blocks_needed(target_len)} blocks "
+                        f"for {target_len} tokens but the pool holds only "
+                        f"{self.cache.num_blocks}; raise "
+                        "EngineConfig.num_blocks or lower max_new_tokens")
+                if protect is not None and protect in self.running:
+                    self._evict(protect, preempted)
+                    return False
+                raise RuntimeError(
+                    "KV cache too small: cannot hold a single request "
+                    f"(free={self.cache.num_free_blocks} blocks, request "
+                    f"needs {self.cache.blocks_needed(target_len)})")
+            self._evict(victim, preempted)
+        return True
+
+    def _pick_victim(self, exclude=None):
+        # youngest ARRIVAL, not list position: swap-ins re-append resumed
+        # (older) requests at the tail, so list order is not age order
+        victims = [r for r in self.running if r is not exclude]
+        if not victims:
+            return None
+        return max(victims, key=lambda r: r.arrival)
+
+    def _evict(self, req, preempted) -> None:
+        req.swap = self.cache.swap_out(req.req_id)
+        req.state = Request.PREEMPTED
+        self.running.remove(req)
+        self.waiting.appendleft(req)             # keeps arrival order
+        preempted.append(req)
+
+    # -- completion ---------------------------------------------------------
+
+    def retire_finished(self) -> tuple:
+        done = tuple(r for r in self.running if r.finished)
+        for req in done:
+            self.cache.free(req.req_id)
+            self.running.remove(req)
+        return done

@@ -1,0 +1,65 @@
+"""Serving smoke: boot `LLMEngine` on a tiny GPT, run a mixed-length batch,
+assert throughput > 0 tokens/s, and print the serving/* monitor metrics.
+
+Runnable anywhere (CPU included):
+
+    JAX_PLATFORMS=cpu PTPU_MONITOR=1 python scripts/serve_smoke.py
+
+tests/test_serving.py runs this as a subprocess (fast tier), so it is the
+"does the engine boot outside the test harness" guard.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault("PTPU_FORCE_PLATFORM", "cpu")   # drop on a TPU host
+os.environ.setdefault("PTPU_MONITOR", "1")
+
+import jax
+
+if os.environ.get("PTPU_FORCE_PLATFORM") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor
+from paddle_tpu.models import GPTForCausalLM, gpt_test_config
+from paddle_tpu.serving import EngineConfig, LLMEngine, SamplingParams
+
+
+def main():
+    monitor.refresh()
+    paddle.seed(0)
+    cfg = gpt_test_config(stacked_blocks=True, sequence_parallel=False)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    engine = LLMEngine(model, EngineConfig(block_size=16, max_num_seqs=4))
+
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in (4, 6, 4)]
+    params = SamplingParams(max_new_tokens=6)
+
+    t0 = time.perf_counter()
+    outs = engine.generate(prompts, params)
+    dt = time.perf_counter() - t0
+    new_tokens = sum(len(o) - len(p) for o, p in zip(outs, prompts))
+    tps = new_tokens / max(dt, 1e-9)
+
+    assert new_tokens == 6 * len(prompts), (new_tokens, outs)
+    assert tps > 0.0, tps
+    assert engine.cache.blocks_in_use == 0, "finished requests must free"
+
+    snap = monitor.snapshot()
+    served = sorted(k for k in snap if k.startswith("serving/"))
+    assert "serving/decode_tokens" in served, served
+    print(f"generated {new_tokens} tokens in {dt:.2f}s "
+          f"({tps:.1f} tokens/s, includes compiles)")
+    print("serving metrics:", ", ".join(served))
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

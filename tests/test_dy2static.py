@@ -901,11 +901,14 @@ class TestFallbacks:
 
         assert convert_to_static(gen) is gen
 
-    def test_mutating_method_statement_not_staged(self):
-        """Advisor r3: `lst.append(x)` as a statement inside a branch must
-        NOT be staged (both branches would run, duplicating the side
-        effect). Python predicates keep exact Python semantics; traced
-        predicates raise instead of silently diverging."""
+    def test_mutating_method_statement_semantics(self):
+        """Advisor r3, updated for the StagedArray machinery: a
+        statement-position `lst.append(x)` on a function-LOCAL list stages
+        as a pure value-semantics update (the not-taken branch's append is
+        selected away), Python predicates keep exact in-place semantics,
+        and mutations through non-local receivers (attributes, aliases the
+        rewriter cannot prove local) still raise loudly under a traced
+        predicate instead of silently running both branches."""
         def f(x):
             acc = []
             if x.sum() > 0:
@@ -913,18 +916,38 @@ class TestFallbacks:
                 y = x * 2.0
             else:
                 y = x
-            return y, len(acc)
+            return y, acc
 
         g = convert_to_static(f)
-        y, n = g(_t([1.0]))
-        assert n == 1           # side effect ran exactly once
+        y, acc = g(_t([1.0]))
+        assert len(acc) == 1    # side effect ran exactly once
         np.testing.assert_allclose(y.numpy(), [2.0])
-        y, n = g(_t([-1.0]))
-        assert n == 0           # and never in the not-taken branch
+        y, acc = g(_t([-1.0]))
+        assert len(acc) == 0    # and never in the not-taken branch
 
         c = jit.compile(f, train=False)
+        y, acc = c(_t([1.0]))
+        np.testing.assert_allclose(y.numpy(), [2.0])
+        assert len(acc) == 1    # concrete again outside the trace
+        y, acc = c(_t([-1.0]))
+        np.testing.assert_allclose(y.numpy(), [-1.0])
+        assert len(acc) == 0
+
+        class Holder:
+            pass
+
+        ho = Holder()
+        ho.items = []
+
+        def a(x):
+            if x.sum() > 0:
+                ho.items.append(1.0)
+            return x
+
+        c2 = jit.compile(a, train=False)
         with pytest.raises(Dy2StaticError, match="mutating"):
-            c(_t([1.0]))
+            c2(_t([1.0]))
+        assert ho.items == []   # the guarded form never half-ran
 
     def test_inplace_augassign_container_raises_not_diverges(self):
         """`acc += [v]` mutates the threaded list IN PLACE, so both staged

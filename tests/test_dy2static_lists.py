@@ -232,12 +232,160 @@ class TestStagedArrayUnit:
             StagedArray.from_list([])
 
 
-class TestLoudErrors:
-    def test_empty_list_in_traced_loop_guides(self):
+class TestEmptyListAutoStaging:
+    """`ys = []` accumulators stage without manual staged_list seeding:
+    the element spec comes from the appended element (if-branch case) or
+    a one-shot body probe (loop case)."""
+
+    def test_empty_list_in_traced_loop_works(self):
         def f(x, n):
             ys = []
             for _ in range(n):
+                ys.append(x + 1.0)
+            return ys[-1]
+
+        c = jit.compile(f, train=False)
+        got = c(_t([1.0]), paddle.to_tensor(np.int32(3)))
+        np.testing.assert_allclose(got.numpy(), [2.0])
+
+    def test_empty_list_accumulator_collects_all(self):
+        def g(x, n):
+            ys = []
+            v = x
+            for _ in range(n):
+                ys.append(v)
+                v = v * 2.0
+            return ys
+
+        c = jit.compile(g, train=False)
+        out = c(_t([1.0]), paddle.to_tensor(np.int32(4)))
+        assert isinstance(out, StagedArray)
+        np.testing.assert_allclose(out.stack().numpy().ravel(),
+                                   [1.0, 2.0, 4.0, 8.0])
+
+    def test_empty_list_append_under_traced_if(self):
+        def f(x):
+            ys = []
+            if x.sum() > 0:
+                ys.append(x * 2.0)
+            else:
+                ys.append(x - 1.0)
+            return ys[-1]
+
+        c = jit.compile(f, train=False)
+        for v in ([2.0], [-2.0]):
+            np.testing.assert_allclose(c(_t(v)).numpy(), f(_t(v)).numpy())
+
+    def test_presized_staged_list_capacity_respected(self):
+        """A user who followed the warning's advice (jit.staged_list with
+        an explicit capacity) must neither be re-warned nor have the
+        buffer inflated by the default headroom."""
+        import warnings
+
+        def f(x, n):
+            ys = staged_list(8, example=x)
+            i = paddle.to_tensor(np.int32(0))
+            while i < n:
                 ys.append(x)
+                i = i + 1
+            return ys
+
+        c = jit.compile(f, train=False)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            out = c(_t([1.0]), paddle.to_tensor(np.int32(3)))
+        assert not any("capacity" in str(x.message) for x in w), \
+            [str(x.message) for x in w]
+        assert out.capacity == 8
+        np.testing.assert_allclose(out.stack(pad_value=0.0).numpy()[:3, 0],
+                                   [1.0, 1.0, 1.0])
+
+    def test_if_staged_list_entering_loop_keeps_headroom(self):
+        """A list staged by a traced IF (traced length, tight capacity)
+        that then enters a traced loop must still receive the default
+        headroom — only user-pre-sized buffers are authoritative."""
+        def f(x, n):
+            ys = []
+            if x.sum() > 0:
+                ys.append(x)
+            else:
+                ys.append(x - 1.0)
+            i = paddle.to_tensor(np.int32(0))
+            while i < n:
+                ys.append(ys[-1] + 1.0)
+                i = i + 1
+            return ys
+
+        c = jit.compile(f, train=False)
+        out = c(_t([1.0]), paddle.to_tensor(np.int32(3)))
+        np.testing.assert_allclose(out.stack(pad_value=0.0).numpy()[:4, 0],
+                                   [1.0, 2.0, 3.0, 4.0])
+
+    def test_helper_discard_survives_probe(self):
+        """A lost-append record created BEFORE an empty-list loop probe
+        must still raise at the region boundary (the probe restores, not
+        clears, the pending-discard records)."""
+        def helper(lst, v):
+            lst.append(v)
+
+        def f(x, n):
+            acc = [x]
+            ys = []
+            if x.sum() > 0:
+                helper(acc, x * 3.0)      # discarded → must stay loud
+                i = paddle.to_tensor(np.int32(0))
+                while i < n:
+                    ys.append(x)
+                    i = i + 1
+            return acc[-1]
+
+        c = jit.compile(f, train=False)
+        with pytest.raises(Exception, match="VALUE semantics|helper"):
+            c(_t([1.0]), paddle.to_tensor(np.int32(2)))
+
+    def test_probe_with_multiple_lists_no_spurious_discard(self):
+        """An empty accumulator next to a NON-empty mutated list: the
+        probe's outputs must not leak past its cleanup (a surviving ref
+        once fired discard-detection after the restore, failing valid
+        code with the helper-discard error)."""
+        def f(x, n):
+            ys = []
+            zs = [x]
+            i = paddle.to_tensor(np.int32(0))
+            while i < n:
+                ys.append(x)
+                zs.append(zs[-1] + 1.0)
+                i = i + 1
+            return ys, zs
+
+        c = jit.compile(f, train=False)
+        ys, zs = c(_t([1.0]), paddle.to_tensor(np.int32(2)))
+        np.testing.assert_allclose(zs.stack(pad_value=0.0).numpy()[:3, 0],
+                                   [1.0, 2.0, 3.0])
+
+    def test_default_capacity_fallback_warns(self):
+        def f(x, n):
+            ys = [x]
+            i = paddle.to_tensor(np.int32(0))
+            while i < n:
+                ys.append(ys[-1] + 1.0)
+                i = i + 1
+            return ys[-1]
+
+        c = jit.compile(f, train=False)
+        with pytest.warns(UserWarning, match="staged_list"):
+            got = c(_t([0.0]), paddle.to_tensor(np.int32(3)))
+        np.testing.assert_allclose(got.numpy(), [3.0])
+
+
+class TestLoudErrors:
+    def test_empty_list_unprobeable_still_guides(self):
+        # the body READS the empty list before appending — the probe
+        # cannot learn an element spec, so the actionable error stays
+        def f(x, n):
+            ys = []
+            for _ in range(n):
+                ys.append(ys[-1] + x)
             return ys[-1]
 
         c = jit.compile(f, train=False)
@@ -309,6 +457,44 @@ class TestNesting:
             got = c(_t([v]), paddle.to_tensor(np.int32(steps)))
             want = v + steps if v > 0 else v
             np.testing.assert_allclose(got.numpy(), [want])
+
+    def test_conditional_append_inside_traced_loop(self):
+        """`if cond: acc.append(x)` inside a tensor loop: the mutation
+        lives in convert_ifelse's generated branch closures, which the
+        loop's `mutated` harvest must still see — previously this raised
+        the misleading shape/dtype-stability error."""
+        def f(x, n):
+            acc = [x]
+            i = paddle.to_tensor(np.int32(0))
+            while i < n:
+                if x.sum() > 0:
+                    acc.append(acc[-1] + 1.0)
+                i = i + 1
+            return acc[-1]
+
+        c = jit.compile(f, train=False)
+        for v, n, want in ((2.0, 3, 5.0), (-2.0, 3, -2.0)):
+            got = c(_t([v]), paddle.to_tensor(np.int32(n)))
+            np.testing.assert_allclose(got.numpy(), [want])
+
+    def test_conditional_append_empty_list_in_loop(self):
+        """The sampling-loop idiom end to end: empty accumulator +
+        conditional append under a traced predicate inside a traced
+        loop (satellites compose)."""
+        def g(x, n):
+            toks = []
+            i = paddle.to_tensor(np.int32(0))
+            while i < n:
+                if x.sum() > 0:
+                    toks.append(x * 2.0)
+                i = i + 1
+            return toks
+
+        c = jit.compile(g, train=False)
+        out = c(_t([3.0]), paddle.to_tensor(np.int32(2)))
+        assert isinstance(out, StagedArray)
+        np.testing.assert_allclose(
+            out.stack(pad_value=0.0).numpy()[:2].ravel(), [6.0, 6.0])
 
     def test_outer_loop_carries_inner_mutations(self):
         def f(x, n):

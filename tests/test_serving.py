@@ -1,0 +1,516 @@
+"""paddle_tpu.serving — continuous batching over a paged KV cache.
+
+The bar (ISSUE 2 acceptance): `LLMEngine.generate()` over a mixed-length
+batch returns EXACTLY the tokens of independent dense
+`GPTModel.generate()` calls — greedy and fixed-seed sampling — while the
+paged pool peaks below the dense `[B, S_max]` equivalent; preempted
+requests resume bit-identically; the block allocator never double-books;
+the `serving/*` metrics land in the monitor snapshot.
+"""
+import os
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.models import GPTForCausalLM, gpt_test_config
+from paddle_tpu.serving import (BlockAllocatorError, BlockKVCache,
+                                EngineConfig, LLMEngine, SamplingParams)
+
+NEW = 5
+LENS = [3, 5, 7, 3, 5, 7, 4, 4]        # 8 prompts, 4 distinct lengths
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = gpt_test_config(stacked_blocks=True, sequence_parallel=False)
+    paddle.seed(0)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def prompts(model):
+    rng = np.random.RandomState(0)
+    return [rng.randint(0, model.cfg.vocab_size, (n,)).astype(np.int32)
+            for n in LENS]
+
+
+@pytest.fixture(scope="module")
+def engine(model):
+    # ONE engine for the parity tests: its jitted step programs are cached
+    # per bucket, which is exactly the serving deployment shape
+    return LLMEngine(model, EngineConfig(block_size=16, max_num_seqs=8))
+
+
+def _dense_solo(model, prompt, **kw):
+    out = model.generate(Tensor(jnp.asarray(prompt[None])),
+                         max_new_tokens=NEW, **kw)
+    return np.asarray(out._data)[0]
+
+
+def _dense_all(model, prompts, kw_fn):
+    """Solo dense runs grouped by (length, sampling key) so the dense
+    path's single-slot executable cache is reused."""
+    order = sorted(range(len(prompts)), key=lambda i: len(prompts[i]))
+    outs = [None] * len(prompts)
+    for i in order:
+        outs[i] = _dense_solo(model, prompts[i], **kw_fn(i))
+    return outs
+
+
+class TestDenseParity:
+    def test_greedy_mixed_length_batch(self, model, prompts, engine):
+        dense = _dense_all(model, prompts, lambda i: {})
+        outs = engine.generate(prompts, SamplingParams(max_new_tokens=NEW))
+        for i, (d, e) in enumerate(zip(dense, outs)):
+            np.testing.assert_array_equal(d, e, err_msg=f"request {i}")
+        # every finished request freed its blocks...
+        assert engine.cache.blocks_in_use == 0
+        # ...and the paged peak stayed below the dense [B, S_max] pool:
+        # dense allocates ceil(round128(P+NEW)/block) blocks per request
+        dense_blocks = sum(
+            -(-(-(-(len(p) + NEW) // 128) * 128) // 16) for p in prompts)
+        assert engine.cache.peak_blocks_in_use < dense_blocks
+
+    def test_seeded_sampling_mixed_length_batch(self, model, prompts,
+                                                engine):
+        kw = dict(do_sample=True, temperature=0.8, top_k=20, top_p=0.9)
+        dense = _dense_all(model, prompts,
+                           lambda i: dict(kw, seed=7 + i))
+        sps = [SamplingParams(max_new_tokens=NEW, do_sample=True,
+                              temperature=0.8, top_k=20, top_p=0.9,
+                              seed=7 + i) for i in range(len(prompts))]
+        outs = engine.generate(prompts, sps)
+        for i, (d, e) in enumerate(zip(dense, outs)):
+            np.testing.assert_array_equal(d, e, err_msg=f"request {i}")
+
+    def test_staggered_arrivals_match_solo(self, model, prompts, engine):
+        """Continuous batching proper: requests joining MID-FLIGHT still
+        produce their solo outputs (the batch composition around a row
+        must not leak into it)."""
+        dense = _dense_all(model, prompts, lambda i: {})
+        first = [engine.add_request(p, SamplingParams(max_new_tokens=NEW))
+                 for p in prompts[:4]]
+        for _ in range(3):
+            engine.step()
+        late = [engine.add_request(p, SamplingParams(max_new_tokens=NEW))
+                for p in prompts[4:]]
+        while engine.has_unfinished():
+            engine.step()
+        for i, rid in enumerate(first + late):
+            np.testing.assert_array_equal(
+                dense[i], engine.request_output(rid),
+                err_msg=f"request {i}")
+
+    def test_eos_early_stop_matches_dense(self, model, engine):
+        rng = np.random.RandomState(3)
+        prompt = rng.randint(0, model.cfg.vocab_size, (4,)).astype(np.int32)
+        probe = _dense_solo(model, prompt)
+        eos = int(probe[len(prompt) + 1])     # the 2nd greedy token
+        dense = _dense_solo(model, prompt, eos_token_id=eos)
+        [out] = engine.generate(
+            [prompt], SamplingParams(max_new_tokens=NEW, eos_token_id=eos))
+        np.testing.assert_array_equal(dense, out)
+        assert out[-1] == eos and len(out) < len(prompt) + NEW
+
+
+class TestPreemption:
+    def test_preempted_requests_resume_identical(self, model):
+        """A pool too small for both requests forces eviction; the host
+        swap restores KV bit-exactly, so outputs equal solo dense runs
+        (greedy AND a seeded-sampling row exercising PRNG-key state)."""
+        rng = np.random.RandomState(1)
+        pa = rng.randint(0, model.cfg.vocab_size, (14,)).astype(np.int32)
+        pb = rng.randint(0, model.cfg.vocab_size, (15,)).astype(np.int32)
+        da = _dense_solo(model, pa)
+        db = _dense_solo(model, pb, do_sample=True, temperature=0.9,
+                         top_k=16, seed=11)
+        # 14+NEW and 15+NEW tokens → 2 blocks each; 3 physical blocks
+        # cannot hold both past the 16-token boundary
+        eng = LLMEngine(model, EngineConfig(block_size=16, num_blocks=3,
+                                            max_num_seqs=2))
+        outs = eng.generate(
+            [pa, pb],
+            [SamplingParams(max_new_tokens=NEW),
+             SamplingParams(max_new_tokens=NEW, do_sample=True,
+                            temperature=0.9, top_k=16, seed=11)])
+        assert monitor  # keep import referenced even when disabled
+        np.testing.assert_array_equal(da, outs[0])
+        np.testing.assert_array_equal(db, outs[1])
+        assert eng._m_preempt.value >= 1, "pool was sized to force eviction"
+
+
+class TestSchedulerEdges:
+    def test_eviction_churn_never_decodes_a_preempted_row(self, model):
+        """A later decode row's block reservation may evict an earlier
+        row ALREADY in the batch; the preempted row must be dropped from
+        the step (previously: KeyError on its freed block table) and
+        outputs still match dense solos through the churn."""
+        rng = np.random.RandomState(7)
+        pa = rng.randint(0, model.cfg.vocab_size, (2,)).astype(np.int32)
+        pb = rng.randint(0, model.cfg.vocab_size, (4,)).astype(np.int32)
+        da = _dense_solo(model, pa)
+        db = _dense_solo(model, pb)
+        # pool of 3 (B alone needs all 3 at its final length) → constant
+        # eviction churn while both are live
+        eng = LLMEngine(model, EngineConfig(block_size=4, num_blocks=3,
+                                            max_num_seqs=2))
+        outs = eng.generate([pa, pb], SamplingParams(max_new_tokens=NEW))
+        np.testing.assert_array_equal(da, outs[0])
+        np.testing.assert_array_equal(db, outs[1])
+
+    def test_request_larger_than_pool_raises_not_hangs(self, model):
+        """A request whose KV footprint exceeds the whole pool must raise
+        'KV cache too small' (previously: perpetual self-evict/swap-in
+        livelock under chunked prefill)."""
+        rng = np.random.RandomState(8)
+        prompt = rng.randint(0, model.cfg.vocab_size, (16,)).astype(np.int32)
+        eng = LLMEngine(model, EngineConfig(
+            block_size=4, num_blocks=2, max_num_seqs=1,
+            max_num_batched_tokens=4))
+        with pytest.raises(RuntimeError, match="KV cache too small"):
+            eng.generate([prompt], SamplingParams(max_new_tokens=2))
+
+    def test_generate_releases_requests_on_error(self, model):
+        """A mid-loop 'KV cache too small' must not leak the other
+        admitted requests' blocks or poison the next generate() call."""
+        rng = np.random.RandomState(10)
+        small = rng.randint(0, model.cfg.vocab_size, (3,)).astype(np.int32)
+        big = rng.randint(0, model.cfg.vocab_size, (16,)).astype(np.int32)
+        eng = LLMEngine(model, EngineConfig(
+            block_size=4, num_blocks=2, max_num_seqs=2,
+            max_num_batched_tokens=4))
+        with pytest.raises(RuntimeError, match="KV cache too small"):
+            eng.generate([small, big], SamplingParams(max_new_tokens=2))
+        assert not eng._requests
+        assert eng.cache.blocks_in_use == 0
+        assert not eng.has_unfinished()
+        # the engine is still serviceable
+        [out] = eng.generate([small], SamplingParams(max_new_tokens=2))
+        d = _dense_solo(model, small)[:5]
+        np.testing.assert_array_equal(d, out)
+
+    def test_max_new_tokens_zero_matches_dense(self, model, engine):
+        rng = np.random.RandomState(11)
+        prompt = rng.randint(0, model.cfg.vocab_size, (4,)).astype(np.int32)
+        from paddle_tpu.core.tensor import Tensor as _T
+        import jax.numpy as _jnp
+
+        d = model.generate(_T(_jnp.asarray(prompt[None])), max_new_tokens=0)
+        [out] = engine.generate([prompt], SamplingParams(max_new_tokens=0))
+        np.testing.assert_array_equal(np.asarray(d._data)[0], out)
+        assert len(out) == len(prompt)
+
+    def test_blocked_swap_head_does_not_starve_admissible_child(self):
+        """Queue head: an evicted request whose snapshot cannot fit; a
+        forked-style child (already holding blocks) behind it; nothing
+        running.  The scheduler must admit the child (whose completion
+        frees blocks) instead of raising 'KV cache too small'."""
+        from paddle_tpu.serving import Request, Scheduler
+
+        cache = BlockKVCache(num_layers=1, num_blocks=3, block_size=4,
+                             num_heads=1, head_dim=2)
+        sched = Scheduler(cache, max_num_seqs=2)
+        r = Request("r", list(range(9)), SamplingParams(max_new_tokens=1))
+        r.arrival = 0
+        cache.allocate("r", 9)                 # 3 blocks
+        r.num_computed = 9
+        r.output_ids = [1]
+        r.swap = cache.swap_out("r")           # evicted: snapshot 3 blocks
+        r.state = Request.PREEMPTED
+        sched.waiting.append(r)
+        child = Request("c", list(range(6)), SamplingParams(max_new_tokens=1))
+        child.arrival = 1
+        cache.allocate("c", 4)                 # holds its shared prefix
+        child.num_computed = 4
+        sched.waiting.append(child)
+        # head r needs 3 blocks, free is 2 → blocked; child is admissible
+        out = sched.schedule()
+        assert out.kind == "prefill" and out.prefill_request is child
+        assert sched.waiting[0] is r           # FIFO position kept
+
+    def test_release_request_drops_host_state(self, model):
+        rng = np.random.RandomState(9)
+        prompt = rng.randint(0, model.cfg.vocab_size, (4,)).astype(np.int32)
+        eng = LLMEngine(model, EngineConfig(block_size=16, max_num_seqs=2))
+        # generate() releases its own requests
+        eng.generate([prompt], SamplingParams(max_new_tokens=2))
+        assert not eng._requests
+        # aborting an unfinished request frees its blocks too
+        rid = eng.add_request(prompt, SamplingParams(max_new_tokens=4))
+        eng.step()                        # prefill: blocks now held
+        assert eng.cache.blocks_in_use > 0
+        eng.release_request(rid)
+        assert not eng._requests and eng.cache.blocks_in_use == 0
+        assert not eng.has_unfinished()
+
+
+class TestForkCoW:
+    def test_engine_fork_shares_prefix_blocks(self, model):
+        rng = np.random.RandomState(2)
+        prompt = rng.randint(0, model.cfg.vocab_size, (20,)).astype(np.int32)
+        # unforked baseline
+        base = LLMEngine(model, EngineConfig(block_size=16, max_num_seqs=2))
+        [solo] = base.generate([prompt], SamplingParams(max_new_tokens=NEW))
+
+        eng = LLMEngine(model, EngineConfig(block_size=16, max_num_seqs=2))
+        parent = eng.add_request(prompt, SamplingParams(max_new_tokens=NEW))
+        eng.step()                      # prefill + first token
+        child = eng.fork_request(
+            parent, SamplingParams(max_new_tokens=NEW, do_sample=True,
+                                   temperature=0.7, seed=5))
+        # the full prefix block stays SHARED (refcount bump, no copy);
+        # the partial last block is privatized at fork because the child
+        # re-writes its final inherited position through its own prefill
+        assert eng.cache.blocks_in_use == 3
+        while eng.has_unfinished():
+            eng.step()
+        # forking must not perturb the parent's stream
+        np.testing.assert_array_equal(solo, eng.request_output(parent))
+        child_out = eng.request_output(child)
+        assert len(child_out) == 21 + NEW      # prompt+tok0 then NEW more
+        # one shared full block + two private partial blocks — strictly
+        # below two private copies of everything (4)
+        assert eng.cache.peak_blocks_in_use <= 4
+
+    def test_kv_cache_copy_on_fork_unit(self):
+        cache = BlockKVCache(num_layers=1, num_blocks=8, block_size=4,
+                             num_heads=1, head_dim=2)
+        cache.allocate("a", 6)                 # blocks 0..1, 6 tokens
+        ka = cache.k_blocks[0].at[:].add(0)    # snapshot
+        # paint A's content so copies are observable
+        cache.k_blocks[0] = ka.at[cache._tables["a"][0]].set(1.0)
+        cache.k_blocks[0] = cache.k_blocks[0].at[
+            cache._tables["a"][1]].set(2.0)
+        cache.fork("a", "b")
+        assert cache.block_table("a") == cache.block_table("b")
+        assert cache.blocks_in_use == 2        # shared, no copy yet
+        # B appends into the shared PARTIAL last block → CoW
+        cache.grow_to("b", 7)
+        ta, tb = cache.block_table("a"), cache.block_table("b")
+        assert ta[0] == tb[0] and ta[1] != tb[1]
+        assert cache.blocks_in_use == 3
+        # the copy carried the content
+        np.testing.assert_array_equal(
+            np.asarray(cache.k_blocks[0][ta[1]]),
+            np.asarray(cache.k_blocks[0][tb[1]]))
+        # A keeps writing its own block; B's copy is private
+        cache.free("a")
+        assert cache.blocks_in_use == 2        # b0 (shared) + b's copy
+        cache.free("b")
+        assert cache.blocks_in_use == 0
+
+
+class TestAllocator:
+    def test_free_list_never_double_allocates(self):
+        rng = np.random.RandomState(0)
+        cache = BlockKVCache(num_layers=1, num_blocks=16, block_size=4,
+                             num_heads=1, head_dim=2)
+        live = {}
+        for step in range(300):
+            op = rng.randint(4)
+            if op == 0 and len(live) < 6:
+                sid = f"s{step}"
+                n = int(rng.randint(1, 13))
+                if cache.blocks_needed(n) <= cache.num_free_blocks:
+                    cache.allocate(sid, n)
+                    live[sid] = n
+            elif op == 1 and live:
+                sid = rng.choice(sorted(live))
+                n = live[sid] + int(rng.randint(1, 5))
+                if cache.can_grow_to(sid, n):
+                    cache.grow_to(sid, n)
+                    live[sid] = n
+            elif op == 2 and live:
+                sid = rng.choice(sorted(live))
+                cache.free(sid)
+                del live[sid]
+            elif op == 3 and live and len(live) < 6:
+                src = rng.choice(sorted(live))
+                sid = f"f{step}"
+                cache.fork(src, sid)
+                live[sid] = live[src]
+            # INVARIANT: every live table references distinct slots unless
+            # explicitly shared, and free blocks have refcount 0
+            held = [b for t in cache._tables.values() for b in t]
+            for b in set(held):
+                assert cache._blocks[b].ref == held.count(b), (step, b)
+            for b in cache._free:
+                assert cache._blocks[b].ref == 0, (step, b)
+            assert len(set(cache._free)) == len(cache._free)
+        for sid in list(live):
+            cache.free(sid)
+        assert cache.num_free_blocks == 16
+
+    def test_out_of_blocks_is_loud(self):
+        cache = BlockKVCache(num_layers=1, num_blocks=2, block_size=4,
+                             num_heads=1, head_dim=2)
+        cache.allocate("a", 8)
+        with pytest.raises(BlockAllocatorError, match="out of KV blocks"):
+            cache.allocate("b", 4)
+
+    def test_swap_roundtrip_bit_exact(self):
+        cache = BlockKVCache(num_layers=2, num_blocks=6, block_size=4,
+                             num_heads=2, head_dim=3)
+        cache.allocate("a", 7)
+        rng = np.random.RandomState(5)
+        for l in range(2):
+            cache.k_blocks[l] = jnp.asarray(
+                rng.randn(*cache.k_blocks[l].shape), jnp.float32)
+            cache.v_blocks[l] = jnp.asarray(
+                rng.randn(*cache.v_blocks[l].shape), jnp.float32)
+        t0 = cache.block_table("a")
+        want_k = [np.asarray(cache.k_blocks[l][np.asarray(t0)])
+                  for l in range(2)]
+        saved = cache.swap_out("a")
+        assert cache.blocks_in_use == 0
+        cache.allocate("x", 9)                 # churn the pool
+        cache.free("x")
+        cache.swap_in("a", saved)
+        t1 = cache.block_table("a")
+        for l in range(2):
+            np.testing.assert_array_equal(
+                np.asarray(cache.k_blocks[l][np.asarray(t1)]), want_k[l])
+
+
+class TestChunkedPrefill:
+    def test_chunked_prefill_matches_unchunked_engine(self, model):
+        """Chunked prefill (token-budget admission) is mathematically the
+        same program with reassociated float reductions; on this machine
+        the greedy stream is deterministic either way, and the two engine
+        configurations must agree."""
+        rng = np.random.RandomState(4)
+        prompt = rng.randint(0, model.cfg.vocab_size, (13,)).astype(np.int32)
+        whole = LLMEngine(model, EngineConfig(block_size=16, max_num_seqs=1))
+        [a] = whole.generate([prompt], SamplingParams(max_new_tokens=NEW))
+        chunked = LLMEngine(model, EngineConfig(
+            block_size=16, max_num_seqs=1, max_num_batched_tokens=5))
+        [b] = chunked.generate([prompt], SamplingParams(max_new_tokens=NEW))
+        np.testing.assert_array_equal(a, b)
+
+
+class TestMonitorAndSmoke:
+    def test_serving_metrics_in_snapshot(self, model, prompts):
+        monitor.enable(True)
+        try:
+            eng = LLMEngine(model, EngineConfig(block_size=16,
+                                                max_num_seqs=4))
+            eng.generate(prompts[:2], SamplingParams(max_new_tokens=2))
+            snap = monitor.snapshot()
+        finally:
+            monitor.refresh()
+        for name in ("serving/queue_depth", "serving/running",
+                     "serving/blocks_in_use", "serving/block_utilization",
+                     "serving/prefill_tokens", "serving/decode_tokens",
+                     "serving/prefill_tps", "serving/decode_tps",
+                     "serving/requests_finished", "serving/step_time"):
+            assert name in snap, sorted(k for k in snap
+                                        if k.startswith("serving/"))
+        assert snap["serving/decode_tokens"] >= 2
+        assert snap["serving/blocks_in_use"] == 0   # all freed at the end
+
+    def test_serve_smoke_script(self):
+        script = (pathlib.Path(__file__).resolve().parent.parent
+                  / "scripts" / "serve_smoke.py")
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("PYTHONPATH", "XLA_FLAGS")}
+        env["PTPU_FORCE_PLATFORM"] = "cpu"
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PTPU_MONITOR"] = "1"
+        proc = subprocess.run([sys.executable, str(script)], env=env,
+                              capture_output=True, text=True, timeout=560)
+        assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+        assert "OK" in proc.stdout
+        assert "tokens/s" in proc.stdout
+
+
+class TestPagedAttentionOp:
+    def test_matches_cached_attention_reference(self):
+        """ops.paged_attention vs the dense-ring decode oracle
+        (`cached_attention_arrays`, models/gpt.py:326): same tokens in
+        blocks ⇒ bitwise-identical output."""
+        from paddle_tpu.ops.pallas_ops import cached_attention_arrays
+        from paddle_tpu.ops.paged_attention import (
+            paged_attention_arrays, paged_cache_update_arrays,
+            slot_mapping)
+
+        rng = np.random.RandomState(0)
+        B, H, D, BS, NB = 2, 2, 4, 4, 12
+        s_max = 16
+        lens = np.asarray([6, 9], np.int32)     # context BEFORE the token
+        # dense oracle: contiguous [B, S_max, H*D] rings
+        kd = rng.randn(B, s_max, H * D).astype(np.float32)
+        vd = rng.randn(B, s_max, H * D).astype(np.float32)
+        kd[0, lens[0]:] = 0.0
+        vd[0, lens[0]:] = 0.0
+        kd[1, lens[1]:] = 0.0
+        vd[1, lens[1]:] = 0.0
+        q = rng.randn(B, 1, H, D).astype(np.float32)
+        k_new = rng.randn(B, 1, H, D).astype(np.float32)
+        v_new = rng.randn(B, 1, H, D).astype(np.float32)
+        # paged pool holding the same tokens at scattered physical blocks
+        tables = np.asarray([[7, 2, 5, 9], [1, 8, 3, 0]], np.int32)
+        kb = np.zeros((NB, BS, H, D), np.float32)
+        vb = np.zeros((NB, BS, H, D), np.float32)
+        for b in range(B):
+            for p in range(int(lens[b])):
+                kb[tables[b][p // BS], p % BS] = kd[b, p].reshape(H, D)
+                vb[tables[b][p // BS], p % BS] = vd[b, p].reshape(H, D)
+        # oracle: per-row dense decode at its own scalar t
+        want = []
+        for b in range(B):
+            o, _, _ = cached_attention_arrays(
+                jnp.asarray(q[b:b + 1]), jnp.asarray(k_new[b:b + 1]),
+                jnp.asarray(v_new[b:b + 1]), jnp.asarray(kd[b:b + 1]),
+                jnp.asarray(vd[b:b + 1]), int(lens[b]))
+            want.append(np.asarray(o))
+        # paged: write-then-attend over the ragged pair in ONE call
+        slots = slot_mapping(tables, lens[:, None], BS, NB * BS)
+        kb2 = paged_cache_update_arrays(jnp.asarray(kb),
+                                        jnp.asarray(k_new), slots)
+        vb2 = paged_cache_update_arrays(jnp.asarray(vb),
+                                        jnp.asarray(v_new), slots)
+        got = paged_attention_arrays(jnp.asarray(q), kb2, vb2,
+                                     jnp.asarray(tables),
+                                     jnp.asarray(lens))
+        for b in range(B):
+            np.testing.assert_array_equal(np.asarray(got[b:b + 1]),
+                                          want[b])
+
+    def test_oob_slots_are_dropped_not_clamped(self):
+        from paddle_tpu.ops.paged_attention import paged_cache_update_arrays
+
+        kb = jnp.zeros((2, 2, 1, 1), jnp.float32)
+        rows = jnp.ones((1, 1, 1, 1), jnp.float32)
+        out = paged_cache_update_arrays(kb, rows,
+                                        jnp.asarray([[4]], jnp.int32))
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(kb))
+
+
+class TestEngineGuards:
+    def test_inference_namespace_entry_point(self):
+        from paddle_tpu import inference
+
+        assert inference.LLMEngine is LLMEngine
+        assert inference.SamplingParams is SamplingParams
+        assert inference.BlockKVCache is BlockKVCache
+
+    def test_requires_stacked_blocks(self):
+        cfg = gpt_test_config(stacked_blocks=False, sequence_parallel=False)
+        paddle.seed(0)
+        m = GPTForCausalLM(cfg)
+        with pytest.raises(ValueError, match="stacked_blocks"):
+            LLMEngine(m)
+
+    def test_rejects_overlong_request(self, model, engine):
+        with pytest.raises(ValueError, match="max_model_len"):
+            engine.add_request(list(range(60)),
+                               SamplingParams(max_new_tokens=60))
