@@ -72,6 +72,7 @@ from . import static  # noqa: E402
 from . import metric  # noqa: E402
 from . import vision  # noqa: E402
 from . import distributed  # noqa: E402
+from . import resilience  # noqa: E402
 from . import incubate  # noqa: E402
 from . import utils  # noqa: E402
 from . import profiler  # noqa: E402

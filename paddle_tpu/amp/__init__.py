@@ -278,11 +278,7 @@ class GradScaler:
             self._found_inf = False
             return
         if found:
-            self._bad_steps = int(self._bad_steps) + 1
-            self._good_steps = 0
-            if self._bad_steps >= self._decr_every:
-                self._scale = max(float(self._scale) * self._decr_ratio, 1.0)
-                self._bad_steps = 0
+            self._apply_backoff()
         else:
             self._good_steps = int(self._good_steps) + 1
             self._bad_steps = 0
@@ -290,6 +286,26 @@ class GradScaler:
                 self._scale = float(self._scale) * self._incr_ratio
                 self._good_steps = 0
         self._found_inf = False
+
+    def _apply_backoff(self):
+        """The host-side found-inf decrement recurrence (shared by
+        update()'s eager branch and the external backoff() hook)."""
+        self._bad_steps = int(self._bad_steps) + 1
+        self._good_steps = 0
+        if self._bad_steps >= self._decr_every:
+            self._scale = max(float(self._scale) * self._decr_ratio, 1.0)
+            self._bad_steps = 0
+
+    def backoff(self):
+        """Apply the found-inf decrement recurrence once from OUTSIDE the
+        scaler's own unscale path — the hook `resilience.StepGuard` calls
+        when ITS health check (post-update param isfinite) catches a
+        non-finite step the scaler never saw.  Host-side only: the guard
+        runs between steps, never under trace (a traced scale would mean
+        the scaler is registered and doing its own in-graph skip)."""
+        if not (self._enable and self._dynamic) or _is_tracer(self._scale):
+            return
+        self._apply_backoff()
 
     def is_enable(self):
         return self._enable

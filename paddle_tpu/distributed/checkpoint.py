@@ -9,6 +9,7 @@ capability the reference implements by hand in converter.py.
 from __future__ import annotations
 
 import os
+import shutil
 
 import numpy as np
 import jax
@@ -24,17 +25,98 @@ def _ckptr():
     return ocp
 
 
-def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0):
+def _swap_siblings(path):
+    """All ``<path>.tmp-*`` / ``<path>.old-*`` staging dirs, any pid."""
+    d, base = os.path.split(path)
+    tmps, olds = [], []
+    try:
+        names = os.listdir(d or ".")
+    except OSError:
+        return tmps, olds
+    for n in names:
+        if n.startswith(base + ".tmp-"):
+            tmps.append(os.path.join(d, n))
+        elif n.startswith(base + ".old-"):
+            olds.append(os.path.join(d, n))
+    return tmps, olds
+
+
+def _recover_interrupted_swap(path):
+    """Complete or roll back a swap a dead process left half-done, and
+    sweep its staging remnants.  The protocol is unambiguous:
+
+    - `path` exists          → every tmp/old sibling is garbage (the swap
+      either finished or never began); remove them.
+    - `path` missing, tmp+old → the crash hit BETWEEN the two renames,
+      which only happens after tmp was fully written and fsynced —
+      finish the swap (tmp → path), drop old.
+    - `path` missing, old only → cannot arise from one crash (tmp is
+      still present whenever old is), but if e.g. an earlier partial
+      cleanup removed tmp, old is the survivor — roll it back
+      (old → path).
+    - `path` missing, tmp only → the crash hit mid-payload-write: tmp is
+      suspect, but with no alternative it is better than nothing — leave
+      it for manual inspection, restore nothing.
+    """
+    tmps, olds = _swap_siblings(path)
+    if os.path.exists(path):
+        for p in tmps + olds:
+            shutil.rmtree(p, ignore_errors=True)
+        return
+    if tmps and olds:
+        newest = max(tmps, key=os.path.getmtime)
+        os.rename(newest, path)
+        for p in olds + [t for t in tmps if t != newest]:
+            shutil.rmtree(p, ignore_errors=True)
+    elif olds:
+        newest = max(olds, key=os.path.getmtime)
+        os.rename(newest, path)
+        for p in [o for o in olds if o != newest]:
+            shutil.rmtree(p, ignore_errors=True)
+
+
+def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
+                    _atomic=True):
     """Save a (possibly sharded-array) state dict; jax.Array shardings are
-    recorded so any-mesh restore works."""
+    recorded so any-mesh restore works.
+
+    Crash-safe by default: the payload is written to a sibling
+    ``<path>.tmp-<pid>`` directory and swapped in only once complete, so
+    a save interrupted at ANY point can never clobber a previous good
+    checkpoint (the old `force=True` overwrote in place).  A swap a dead
+    process left half-done (crash between the two renames) is completed
+    by the next save/load at the same path via
+    `_recover_interrupted_swap`.  `resilience.CheckpointManager` passes
+    ``_atomic=False`` because it owns a whole-checkpoint rename one
+    level up — double-staging would just double the IO."""
     ocp = _ckptr()
     path = os.path.abspath(path)
     arrays = {
         k: (v._data if isinstance(v, Tensor) else v) for k, v in state_dict.items()
     }
     ckpt = ocp.StandardCheckpointer()
-    ckpt.save(path, arrays, force=True)
+    if not _atomic:
+        ckpt.save(path, arrays, force=True)
+        ckpt.wait_until_finished()
+        return
+    _recover_interrupted_swap(path)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    old = f"{path}.old-{os.getpid()}"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    ckpt.save(tmp, arrays, force=True)
     ckpt.wait_until_finished()
+    from ..resilience import faults as _faults
+
+    # injection point: payload written, previous checkpoint still intact
+    _faults.maybe_crash(site="save_state_dict")
+    # the swap: two renames — at every intermediate crash point an intact
+    # checkpoint survives (under `path`, or under `tmp`/`old` where the
+    # recovery above finds it); a partial write is never visible at `path`
+    if os.path.exists(path):
+        os.rename(path, old)
+    os.rename(tmp, path)
+    shutil.rmtree(old, ignore_errors=True)
 
 
 def load_state_dict(path, shardings=None, process_group=None):
@@ -42,6 +124,10 @@ def load_state_dict(path, shardings=None, process_group=None):
     ShapeDtypeStruct) to place arrays directly onto a (new) mesh."""
     ocp = _ckptr()
     path = os.path.abspath(path)
+    if not os.path.exists(path):
+        # a dead process may have left the swap half-done — recover the
+        # intact payload from its staging siblings before restoring
+        _recover_interrupted_swap(path)
     ckpt = ocp.StandardCheckpointer()
     restored = ckpt.restore(path, target=shardings) if shardings is not None else ckpt.restore(path)
     return {k: Tensor(v) for k, v in restored.items()}

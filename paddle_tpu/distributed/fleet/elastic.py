@@ -14,6 +14,8 @@ import threading
 import time
 from typing import List, Optional
 
+from ... import monitor
+from ...resilience.retry import retry as _retry
 from ..store import TCPStore
 
 __all__ = ["ElasticStatus", "ElasticManager", "ELASTIC_EXIT_CODE"]
@@ -87,15 +89,26 @@ class ElasticManager:
             self._registry_slot = idx
 
     def _beat(self):
-        self.store.set(f"{self.PREFIX}/node/{self.node_id}",
-                       repr(time.time()).encode())
+        # bounded retry WITHIN one beat: a transient store hiccup must not
+        # cost a whole TTL window (missing `ttl/interval` beats in a row
+        # reads as node death and triggers a cluster-wide relaunch)
+        _retry(lambda: self.store.set(
+            f"{self.PREFIX}/node/{self.node_id}",
+            repr(time.time()).encode()),
+            retries=2, backoff=0.05, max_backoff=0.5,
+            site="elastic.heartbeat")()
 
     def _hb_loop(self):
+        missed = monitor.counter("resilience/heartbeat_failures",
+                                 "elastic heartbeats that failed after "
+                                 "retries")
         while not self._stop.is_set():
             try:
                 self._beat()
-            except Exception:
-                pass
+            except (ConnectionError, OSError, TimeoutError):
+                # a COUNTED miss, not a silent one: the loop must survive
+                # (the next beat may land) but operators see the gap
+                missed.inc()
             self._stop.wait(self.heartbeat_interval)
 
     # -- membership ---------------------------------------------------------
@@ -197,5 +210,7 @@ class ElasticManager:
                 slot = getattr(self, "_registry_slot", None)
                 if slot is not None:
                     self.store.set(f"{self.PREFIX}/registry/{slot}", b"")
-            except Exception:
-                pass
+            except (ConnectionError, OSError, TimeoutError):
+                pass   # justified: deregistration is cosmetic — the TTL
+                # expiry removes a dead node anyway, and exit() must not
+                # raise when the master is already gone

@@ -176,8 +176,31 @@ def rpc_async(to: str, fn, args=None, kwargs=None, timeout: float = 60.0):
 
 def _call(to, fn, args, kwargs, timeout):
     _check_init()
+    from ..resilience import faults as _faults
+    from ..resilience.retry import Deadline, retry as _retry
+
     info = get_worker_info(to)
-    with socket.create_connection((info.ip, info.port), timeout=timeout) as s:
+    deadline = Deadline(timeout)
+
+    def dial():
+        # retry ONLY the dial: once the frame is sent the call may have
+        # executed on the peer, and blind re-issue would double-run a
+        # non-idempotent fn — a dial failure is provably side-effect-free
+        _faults.maybe_raise("conn_error", site="rpc.dial")
+        remaining = deadline.remaining()
+        # explicit None check: remaining() == 0.0 is falsy but means "out
+        # of budget", not "use the full timeout again"
+        return socket.create_connection(
+            (info.ip, info.port),
+            timeout=timeout if remaining is None else max(remaining, 1e-3))
+
+    # retryable=(OSError,) covers the whole dial-failure family —
+    # ConnectionError/ConnectionRefusedError/ConnectionResetError/
+    # socket.timeout are all OSError subclasses; the deadline bounds total
+    # time and a dial failure is always side-effect-free
+    with _retry(dial, retries=3, backoff=0.05, max_backoff=1.0,
+                deadline=deadline, site="rpc.dial",
+                retryable=(OSError,))() as s:
         s.settimeout(timeout)
         _send_frame(s, pickle.dumps((fn, args, kwargs)))
         ok, payload = pickle.loads(_recv_frame(s))
@@ -192,8 +215,9 @@ def shutdown():
         return
     try:
         _state.store.barrier("rpc_shutdown", _state.world_size)
-    except Exception:
-        pass
+    except (ConnectionError, OSError, TimeoutError):
+        pass   # justified: best-effort drain barrier — a peer that died
+        # uncleanly must not wedge every surviving worker's shutdown
     _state.stopping = True
     try:
         _state.server.close()
@@ -203,8 +227,8 @@ def shutdown():
         _state.pool.shutdown(wait=False)
     try:
         _state.store.close()
-    except Exception:
-        pass
+    except (ConnectionError, OSError):
+        pass   # justified: socket already dead — shutdown must finish
     _state.__init__()
 
 

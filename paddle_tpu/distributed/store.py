@@ -22,6 +22,8 @@ import time
 from typing import Optional
 
 from ..core import native
+from ..resilience import faults as _faults
+from ..resilience.retry import Deadline, retry as _retry
 
 __all__ = ["TCPStore"]
 
@@ -120,18 +122,47 @@ class _PyServer(socketserver.ThreadingTCPServer):
 
 class _PyClient:
     def __init__(self, host, port, timeout_s):
-        deadline = time.time() + timeout_s
-        while True:
-            try:
-                self.sock = socket.create_connection((host, port), timeout=5)
-                self.sock.settimeout(None)
-                self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                break
-            except OSError:
-                if time.time() > deadline:
-                    raise TimeoutError(f"cannot reach store at {host}:{port}")
-                time.sleep(0.1)
+        self._addr = (host, port)
+        self._connect(timeout_s)
         self.lock = threading.Lock()
+
+    def _connect(self, timeout_s):
+        """Bounded exponential-backoff dial (resilience.retry): a worker
+        that starts BEFORE the master has bound its port keeps knocking
+        until `timeout_s` instead of raising ConnectionRefusedError."""
+        host, port = self._addr
+        deadline = Deadline(timeout_s)
+
+        def dial():
+            _faults.maybe_raise("conn_error", site="store.connect",
+                                exc=ConnectionRefusedError)
+            sock = socket.create_connection((host, port), timeout=5)
+            sock.settimeout(None)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            return sock
+
+        try:
+            # retries sized so backoff doubling spans the whole deadline
+            self.sock = _retry(dial, retries=10_000, backoff=0.05,
+                               max_backoff=1.0, deadline=deadline,
+                               site="store.connect",
+                               retryable=(OSError,))()
+        except OSError as e:
+            raise TimeoutError(
+                f"cannot reach store at {host}:{port} "
+                f"within {timeout_s}s") from e
+
+    def reconnect(self, timeout_s=5.0):
+        # under the client lock: another thread may be blocked in _read()
+        # on this socket (it holds the lock for its whole op) — closing it
+        # out from under them would cascade teardown and desync the
+        # request/response framing
+        with self.lock:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            self._connect(timeout_s)
 
     def _read(self, n):
         buf = b""
@@ -202,6 +233,7 @@ class TCPStore:
     """
 
     GET_TIMEOUT_MS = 120_000
+    OP_RETRIES = 3   # transient-ConnectionError retries per get/set
 
     def __init__(self, host: str, port: int, is_master: bool = False,
                  world_size: int = 1, timeout: float = 30.0):
@@ -236,11 +268,40 @@ class TCPStore:
             self._py_cli = _PyClient(host or "127.0.0.1", port, timeout)
             self._cli = None
 
+    def _py_op(self, site, op, deadline=None):
+        """Run a py-client op with transient-failure retry: a
+        ConnectionError (peer reset, half-open socket after a master
+        restart) reconnects and re-issues; a TimeoutError is a semantic
+        result and propagates untouched.  Safe because every store op is
+        idempotent (SET is last-writer-wins, GET/WAIT read-only; ADD/CAS
+        deliberately do NOT route through here).  `deadline` bounds the
+        TOTAL time across re-attempts (get threads its timeout through it
+        so retries never multiply the caller's bound)."""
+
+        def attempt():
+            _faults.maybe_raise("conn_error", site=site)
+            return op()
+
+        def reconnect(attempt_no, exc, delay):
+            # a failed reconnect raises TimeoutError("cannot reach store")
+            # out of the retry loop — the accurate error, instead of the
+            # EBADF the next attempt would hit on the closed socket
+            self._py_cli.reconnect()
+
+        # OSError included: an attempt on a socket a failed reconnect
+        # closed raises EBADF (plain OSError).  TimeoutError cannot arise
+        # inside op() — the py-client sockets are blocking and the store
+        # GET/WAIT timeout is a protocol reply (None), not an exception.
+        return _retry(attempt, retries=self.OP_RETRIES, backoff=0.05,
+                      max_backoff=1.0, retryable=(ConnectionError, OSError),
+                      site=site, on_retry=reconnect, deadline=deadline)()
+
     # -- raw bytes API ------------------------------------------------------
     def set(self, key: str, value) -> None:
         data = value if isinstance(value, (bytes, bytearray)) else pickle.dumps(value)
         if self._py_cli is not None:
-            self._py_cli.set(key, bytes(data))
+            self._py_op("store.set",
+                        lambda: self._py_cli.set(key, bytes(data)))
         else:
             rc = self._native.pts_set(self._cli, key.encode(), bytes(data), len(data))
             if rc != 0:
@@ -270,7 +331,16 @@ class TCPStore:
     def get(self, key: str, timeout_ms: Optional[int] = None) -> bytes:
         timeout_ms = self.GET_TIMEOUT_MS if timeout_ms is None else timeout_ms
         if self._py_cli is not None:
-            out = self._py_cli.get(key, timeout_ms)
+            # ONE deadline across re-attempts: a reconnect-retry re-issues
+            # with the REMAINING budget, not the full timeout again
+            # (timeout_ms=0 is the protocol's "wait forever")
+            dl = Deadline(timeout_ms / 1e3 if timeout_ms else None)
+
+            def issue():
+                rm = dl.remaining_ms()
+                return self._py_cli.get(key, 0 if rm is None else max(rm, 1))
+
+            out = self._py_op("store.get", issue, deadline=dl)
             if out is None:
                 raise TimeoutError(f"store get({key!r}) timed out")
             return out
@@ -388,5 +458,7 @@ class TCPStore:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # justified: interpreter teardown — modules the
+            # close path touches may already be torn down; raising in
+            # __del__ only prints noise
             pass

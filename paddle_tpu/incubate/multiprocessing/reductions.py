@@ -65,7 +65,8 @@ def _untrack(shm):
         from multiprocessing import resource_tracker
 
         resource_tracker.unregister(shm._name, "shared_memory")
-    except Exception:
+    except Exception:  # justified: resource_tracker internals differ across
+        # py versions — unregister is a cosmetic leak-warning fix
         pass
 
 
@@ -137,7 +138,8 @@ def _rebuild_from_shm(shm_name, shape, dtype_name):
             # the producer owns the marker's unlink; without this, the
             # consumer's resource tracker reclaims it at consumer exit
             resource_tracker.unregister(m._name, "shared_memory")
-        except Exception:
+        except Exception:  # justified: same resource_tracker best-effort as
+            # above
             pass
         m.close()
     except FileExistsError:

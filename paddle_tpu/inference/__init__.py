@@ -279,7 +279,8 @@ class Predictor:
                 p_tree, cast[:len(p_flat)])
             self._buffers = jax.tree_util.tree_unflatten(
                 b_tree, cast[len(p_flat):])
-        except Exception:
+        except Exception:  # justified: aval introspection is best-effort;
+            # call() validates
             pass   # aval introspection is best-effort; call() validates
         self._n_inputs = n_inputs
         self._inputs = [_IOHandle() for _ in range(n_inputs)]

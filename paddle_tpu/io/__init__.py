@@ -321,7 +321,8 @@ def _worker_loop(dataset, collate_fn, my_batches, ring_name, worker_id,
 
         try:
             q.put(("__PTPU_ERR__", traceback.format_exc()), timeout_ms=5000)
-        except Exception:
+        except Exception:  # justified: the error channel itself failed — the
+            # finally-close below is the only thing left to do
             pass
     finally:
         q.close(unlink=False)

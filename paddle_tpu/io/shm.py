@@ -154,5 +154,6 @@ class ShmQueue:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # justified: interpreter teardown — close()
+            # touches modules that may already be gone
             pass

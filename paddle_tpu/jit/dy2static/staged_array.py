@@ -100,7 +100,8 @@ class StagedArray:
                     "from the helper and rebind it "
                     "(`lst = helper(lst, x)`), or mutate it directly in "
                     "the converted function body.")
-        except Exception:
+        except Exception:  # justified: __del__-time diagnostic — raising in
+            # a finalizer only prints noise over the real error
             pass
 
     def _touch(self):

@@ -1,0 +1,34 @@
+"""`paddle_tpu.resilience` — fault-tolerant training/serving runtime.
+
+Four independently-testable layers (ISSUE 3; reference capabilities:
+fleet/elastic/manager.py relaunch + FLAGS_check_nan_inf detection,
+completed here with the *recovery* half):
+
+- :mod:`.checkpoint_manager` — atomic auto-resume checkpoints (tmp dir +
+  fsynced checksummed manifest + rename), rotation, async save, and
+  `restore_latest()` falling back to the newest intact checkpoint;
+- :mod:`.guard` — `StepGuard`: NaN/Inf-guarded train steps that skip the
+  bad update, retry or roll back to the last good snapshot, and back off
+  an attached `amp.GradScaler`;
+- :mod:`.retry` — `retry()` backoff policy, shared `Deadline` budget, and
+  the SIGTERM/SIGINT `PreemptionHandler` (checkpoint at the next step
+  boundary, exit clean);
+- :mod:`.faults` — the `PTPU_FAULTS` deterministic fault-injection plan
+  the tests use to prove every recovery path.
+
+All recovery events land in the PR-1 monitor as ``resilience/*`` series.
+"""
+from . import checkpoint_manager, faults, guard
+from .checkpoint_manager import CheckpointError, CheckpointManager
+from .faults import FaultPlan, InjectedCrash, InjectedFault
+from .guard import GuardedStepInfo, StepGuard
+# NOTE: binds the package attribute `retry` to the FUNCTION (shadowing the
+# module of the same name); import the module explicitly as
+# `paddle_tpu.resilience.retry` when needed.
+from .retry import Deadline, PreemptionHandler, retry
+
+__all__ = [
+    "CheckpointManager", "CheckpointError", "StepGuard", "GuardedStepInfo",
+    "retry", "Deadline", "PreemptionHandler", "FaultPlan", "InjectedCrash",
+    "InjectedFault", "faults", "guard", "checkpoint_manager",
+]

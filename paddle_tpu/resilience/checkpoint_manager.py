@@ -1,0 +1,345 @@
+"""Atomic, auto-resume checkpointing (reference capability:
+fleet checkpoint auto-save + elastic relaunch resume; the array IO rides
+`distributed/checkpoint.py`'s orbax path — this layer adds the crash
+contract on top).
+
+Layout under ``directory``::
+
+    step_00000010/            # one intact checkpoint
+        arrays/               # orbax payload (save_state_dict)
+        manifest.json         # step + per-array {shape, dtype, crc32}
+    step_00000020/
+    .tmp_step_00000030-<pid>/ # an in-flight (or crashed) save
+
+Crash contract: a checkpoint becomes visible ONLY via the final
+``os.rename(tmp, step_N)`` — a process killed at any earlier point (the
+``kill -9`` acceptance test) leaves a ``.tmp_*`` remnant and the
+previous intact checkpoints untouched.  The manifest is fsynced before
+the rename and carries a crc32 per array, so `restore_latest()` can
+verify a candidate end-to-end and fall back to the newest *intact* one
+when the latest is truncated or bit-rotted.
+
+Monitor: ``resilience/saves``, ``resilience/restores``,
+``resilience/corrupt_ckpts_skipped``, gauge ``resilience/last_saved_step``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import queue
+import shutil
+import threading
+import zlib
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .. import monitor
+from . import faults
+
+__all__ = ["CheckpointManager", "CheckpointError"]
+
+_MANIFEST = "manifest.json"
+_ARRAYS = "arrays"
+_STEP_PREFIX = "step_"
+_TMP_PREFIX = ".tmp_"
+_OLD_PREFIX = ".old_"
+MANIFEST_FORMAT = 1
+
+
+class CheckpointError(RuntimeError):
+    """No intact checkpoint could be restored."""
+
+
+def _fsync_path(path: str) -> None:
+    """fsync a file or directory so the rename that follows is durable."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:   # some filesystems reject dir fsync; rename still atomic
+        pass
+    finally:
+        os.close(fd)
+
+
+def _to_numpy(v) -> np.ndarray:
+    data = getattr(v, "_data", v)          # Tensor → jax.Array
+    return np.asarray(data)
+
+
+def _crc32(arr: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
+
+
+class CheckpointManager:
+    """Atomic save / verified restore / rotation over a flat state dict.
+
+    `state_dict` values may be paddle Tensors, jax arrays, or numpy
+    arrays; restore returns paddle Tensors (whatever
+    `distributed.checkpoint.load_state_dict` yields).
+    """
+
+    def __init__(self, directory: str, keep_last_n: int = 3,
+                 async_save: bool = False):
+        self.directory = os.path.abspath(directory)
+        self.keep_last_n = int(keep_last_n)
+        os.makedirs(self.directory, exist_ok=True)
+        self._m_saves = monitor.counter("resilience/saves",
+                                        "checkpoints committed")
+        self._m_restores = monitor.counter("resilience/restores",
+                                           "checkpoints restored")
+        self._m_corrupt = monitor.counter(
+            "resilience/corrupt_ckpts_skipped",
+            "checkpoints rejected by verification during restore")
+        self._m_last = monitor.gauge("resilience/last_saved_step")
+        self._async = bool(async_save)
+        self._worker = None
+        self._q: "queue.Queue" = queue.Queue(maxsize=1)
+        self._async_error: Optional[BaseException] = None
+        # pending-save accounting under one condition variable (NOT an
+        # event toggled from the drain thread: empty()-then-set races a
+        # producer that enqueues between the check and the set, making
+        # wait_until_finished() return with a save still pending)
+        self._pending = 0
+        self._cv = threading.Condition()
+        self._clean_stale_tmp()
+
+    # -- save ---------------------------------------------------------------
+
+    def save(self, step: int, state_dict: Dict, wait: bool = True) -> str:
+        """Commit `state_dict` as checkpoint `step`.  With
+        ``async_save=True`` and ``wait=False`` the arrays are snapshotted
+        to host memory NOW and written by a background thread; any
+        background failure re-raises on the next save()/wait call."""
+        step = int(step)
+        if self._async and not wait:
+            self._raise_async_error()
+            host = {k: _to_numpy(v) for k, v in state_dict.items()}
+            self._ensure_worker()
+            with self._cv:
+                self._pending += 1
+            self._q.put((step, host))
+            return self._final_dir(step)
+        self.wait_until_finished()
+        return self._save_sync(step, state_dict)
+
+    def _save_sync(self, step: int, state_dict: Dict) -> str:
+        from ..distributed import checkpoint as dckpt
+
+        final = self._final_dir(step)
+        tmp = os.path.join(self.directory,
+                           f"{_TMP_PREFIX}{_STEP_PREFIX}{step:08d}-{os.getpid()}")
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        # a failure anywhere below leaves the tmp dir behind (swept by the
+        # next manager's _clean_stale_tmp) and the previous checkpoints
+        # untouched — the commit is the os.rename at the end, nothing else
+        arrays = {k: _to_numpy(v) for k, v in state_dict.items()}
+        dckpt.save_state_dict(arrays, os.path.join(tmp, _ARRAYS),
+                              _atomic=False)
+        manifest = {
+            "format": MANIFEST_FORMAT,
+            "step": step,
+            "arrays": {
+                k: {"shape": list(a.shape), "dtype": str(a.dtype),
+                    "crc32": _crc32(a)}
+                for k, a in arrays.items()
+            },
+        }
+        # the worst-moment injection point: data written, nothing
+        # committed (hard=1 SIGKILLs right here — the kill -9 test)
+        faults.maybe_crash(site="CheckpointManager.save", step=step)
+        mpath = os.path.join(tmp, _MANIFEST)
+        with open(mpath, "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        _fsync_path(tmp)
+        if os.path.exists(final):
+            # re-save of the same step: two-rename swap, never rmtree the
+            # committed dir before its replacement is in place (a kill in
+            # between would lose BOTH — the old via rmtree, the new via
+            # the next manager's tmp sweep); _clean_stale_tmp rolls an
+            # orphaned .old_ back when the final is missing
+            old = os.path.join(
+                self.directory,
+                f"{_OLD_PREFIX}{_STEP_PREFIX}{step:08d}-{os.getpid()}")
+            if os.path.exists(old):
+                shutil.rmtree(old)
+            os.rename(final, old)
+            os.rename(tmp, final)   # the commit point
+            shutil.rmtree(old, ignore_errors=True)
+        else:
+            os.rename(tmp, final)   # the commit point
+        _fsync_path(self.directory)
+        self._m_saves.inc()
+        self._m_last.set(step)
+        self._rotate()
+        return final
+
+    # -- restore ------------------------------------------------------------
+
+    def restore_latest(self, strict_checksums: bool = True
+                       ) -> Optional[Tuple[int, Dict]]:
+        """Newest checkpoint that passes verification, as
+        ``(step, state_dict)``; None when the directory holds none.
+        A candidate failing ANY check (missing/unreadable manifest,
+        orbax restore error, shape/dtype/crc mismatch) is skipped with
+        ``resilience/corrupt_ckpts_skipped += 1`` and the next newest is
+        tried — the auto-resume path after an unclean death."""
+        for step in sorted(self.all_steps(), reverse=True):
+            state = self._try_restore(step, strict_checksums)
+            if state is not None:
+                self._m_restores.inc()
+                return step, state
+        return None
+
+    def restore(self, step: int, strict_checksums: bool = True) -> Dict:
+        state = self._try_restore(int(step), strict_checksums)
+        if state is None:
+            raise CheckpointError(
+                f"checkpoint step {step} in {self.directory} is missing or "
+                "failed verification")
+        self._m_restores.inc()
+        return state
+
+    def _try_restore(self, step: int, strict: bool) -> Optional[Dict]:
+        from ..distributed import checkpoint as dckpt
+
+        path = self._final_dir(step)
+        try:
+            with open(os.path.join(path, _MANIFEST)) as f:
+                manifest = json.load(f)
+            expected = manifest["arrays"]
+            state = dckpt.load_state_dict(os.path.join(path, _ARRAYS))
+            if set(state) != set(expected):
+                raise CheckpointError(
+                    f"array set mismatch: manifest has {len(expected)}, "
+                    f"payload has {len(state)}")
+            for k, meta in expected.items():
+                a = _to_numpy(state[k])
+                if list(a.shape) != list(meta["shape"]) or \
+                        str(a.dtype) != meta["dtype"]:
+                    raise CheckpointError(
+                        f"{k}: shape/dtype mismatch "
+                        f"({a.shape}/{a.dtype} vs manifest)")
+                if strict and _crc32(a) != meta["crc32"]:
+                    raise CheckpointError(f"{k}: crc32 mismatch")
+            return state
+        except Exception as e:  # justified: orbax raises backend-specific
+            # errors for truncated/corrupt payloads; ANY failure here means
+            # "this candidate is not intact", which is exactly the event
+            # restore_latest() recovers from (counted, warned, skipped)
+            import warnings
+
+            self._m_corrupt.inc()
+            warnings.warn(
+                f"checkpoint step {step} at {path} failed verification and "
+                f"was skipped: {type(e).__name__}: {e}")
+            return None
+
+    # -- introspection ------------------------------------------------------
+
+    def all_steps(self):
+        steps = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return steps
+        for n in names:
+            if n.startswith(_STEP_PREFIX):
+                try:
+                    steps.append(int(n[len(_STEP_PREFIX):]))
+                except ValueError:
+                    continue
+        return sorted(steps)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def _final_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f"{_STEP_PREFIX}{step:08d}")
+
+    # -- housekeeping -------------------------------------------------------
+
+    def _rotate(self) -> None:
+        if self.keep_last_n <= 0:
+            return
+        steps = self.all_steps()
+        for step in steps[:-self.keep_last_n]:
+            shutil.rmtree(self._final_dir(step), ignore_errors=True)
+
+    def _clean_stale_tmp(self) -> None:
+        """Sweep crash remnants.  A ``.old_step_N`` whose ``step_N`` is
+        MISSING marks a re-save killed between its two swap renames —
+        roll the old one back before sweeping, so an intact checkpoint
+        always survives.  Everything else (.tmp_*, leftover .old_* with
+        a live final) was never/no-longer committed and is garbage by
+        construction (the crash contract above)."""
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return
+        for n in names:
+            if not n.startswith(_OLD_PREFIX):
+                continue
+            # ".old_step_NNNNNNNN-pid" → "step_NNNNNNNN"
+            stem = n[len(_OLD_PREFIX):].rsplit("-", 1)[0]
+            final = os.path.join(self.directory, stem)
+            path = os.path.join(self.directory, n)
+            if stem.startswith(_STEP_PREFIX) and not os.path.exists(final):
+                os.rename(path, final)
+            else:
+                shutil.rmtree(path, ignore_errors=True)
+        for n in names:
+            if n.startswith(_TMP_PREFIX):
+                shutil.rmtree(os.path.join(self.directory, n),
+                              ignore_errors=True)
+
+    # -- async worker -------------------------------------------------------
+
+    def _ensure_worker(self):
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(target=self._drain, daemon=True)
+            self._worker.start()
+
+    def _drain(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            step, host = item
+            try:
+                self._save_sync(step, host)
+            except BaseException as e:  # justified: surfaced to the caller
+                # on the next save()/wait_until_finished() — an async save
+                # failure must not die silently on a daemon thread
+                self._async_error = e
+            finally:
+                with self._cv:
+                    self._pending -= 1
+                    self._cv.notify_all()
+                self._q.task_done()
+
+    def wait_until_finished(self, timeout: Optional[float] = None) -> None:
+        """Block until every queued/in-flight async save committed;
+        re-raise its failure if it crashed.  Raises TimeoutError when
+        `timeout` expires with saves still pending — returning silently
+        there would let a shutdown path exit believing the checkpoint
+        committed while the daemon worker dies mid-write."""
+        with self._cv:
+            done = self._cv.wait_for(lambda: self._pending == 0, timeout)
+        self._raise_async_error()
+        if not done:
+            raise TimeoutError(
+                f"async checkpoint save still pending after {timeout}s")
+
+    def _raise_async_error(self):
+        if self._async_error is not None:
+            e, self._async_error = self._async_error, None
+            raise e

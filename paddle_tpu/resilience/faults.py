@@ -1,0 +1,194 @@
+"""Deterministic fault injection (`PTPU_FAULTS`) so recovery paths are
+*testable*, not just written.  The reference framework proves its NaN
+trap with FLAGS_check_nan_inf unit fixtures; here every resilience layer
+(atomic checkpoints, NaN rollback, retry) gets a switchable failure.
+
+Syntax — semicolon-separated fault specs, each ``kind@key=value,...``::
+
+    PTPU_FAULTS="ckpt_crash@step=4;conn_error@site=store.connect,times=2"
+    PTPU_FAULTS="nan_grad@step=5"
+    PTPU_FAULTS="ckpt_crash@step=4,hard=1"     # SIGKILL mid-save (kill -9)
+
+Keys:
+
+- ``step``  — fire only when the call site reports this step number.
+- ``site``  — fire only at this named injection site (e.g. ``store.get``).
+- ``times`` — how many firings before the fault burns out (default 1;
+  ``times=0`` means unlimited).
+- ``hard``  — for ``ckpt_crash``: 1 = kill the process with SIGKILL
+  (uncatchable, the true "power loss mid-write"), 0 = raise
+  :class:`InjectedCrash` (catchable, for in-process tests).
+
+Kinds wired into the framework:
+
+- ``ckpt_crash`` — consulted by `CheckpointManager.save` and
+  `distributed.checkpoint.save_state_dict` AFTER array data is written
+  but BEFORE the atomic rename, i.e. the worst moment.
+- ``conn_error`` — consulted by TCPStore connect/get and rpc dial; fires
+  as a transient ``ConnectionError``.
+- ``nan_grad``   — consulted by `StepGuard` right after the wrapped step:
+  the updated params are poisoned with NaN, simulating an optimizer
+  update driven by non-finite gradients.
+
+Everything is inert (one None check) when ``PTPU_FAULTS`` is unset.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import threading
+from typing import Optional
+
+from .. import monitor
+
+__all__ = ["FaultPlan", "InjectedCrash", "InjectedFault", "get_plan",
+           "set_plan", "should_fire", "maybe_raise", "maybe_crash"]
+
+
+class InjectedFault(Exception):
+    """Base for injected failures (never raised by real code paths)."""
+
+
+class InjectedCrash(InjectedFault):
+    """A simulated process death during a checkpoint write."""
+
+
+class _Fault:
+    __slots__ = ("kind", "step", "site", "times", "hard", "fired")
+
+    def __init__(self, kind, step=None, site=None, times=1, hard=0):
+        self.kind = kind
+        self.step = step
+        self.site = site
+        self.times = times      # 0 = unlimited
+        self.hard = hard
+        self.fired = 0
+
+    def matches(self, kind, site, step):
+        if kind != self.kind:
+            return False
+        if self.times and self.fired >= self.times:
+            return False
+        if self.site is not None and site != self.site:
+            return False
+        if self.step is not None and (step is None or int(step) != self.step):
+            return False
+        return True
+
+    def __repr__(self):
+        return (f"_Fault({self.kind}, step={self.step}, site={self.site}, "
+                f"times={self.times}, hard={self.hard}, fired={self.fired})")
+
+
+class FaultPlan:
+    """A parsed PTPU_FAULTS spec with per-fault firing budgets."""
+
+    def __init__(self, spec: str = ""):
+        self.spec = spec or ""
+        self._lock = threading.Lock()
+        self._faults = []
+        for part in self.spec.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            kind, _, opts = part.partition("@")
+            kw = {}
+            for item in filter(None, (o.strip() for o in opts.split(","))):
+                k, _, v = item.partition("=")
+                if k in ("step", "times", "hard"):
+                    kw[k] = int(v)
+                elif k == "site":
+                    kw[k] = v
+                else:
+                    raise ValueError(
+                        f"PTPU_FAULTS: unknown key {k!r} in {part!r} "
+                        "(known: step, site, times, hard)")
+            self._faults.append(_Fault(kind.strip(), **kw))
+        self._ctr = monitor.counter("resilience/faults_injected",
+                                    "deterministic injected failures")
+
+    @classmethod
+    def from_env(cls) -> "FaultPlan":
+        return cls(os.environ.get("PTPU_FAULTS", ""))
+
+    def __bool__(self):
+        return bool(self._faults)
+
+    def should_fire(self, kind: str, site: str = None, step=None) -> bool:
+        """True (and consumes one firing) when a fault matches."""
+        with self._lock:
+            for f in self._faults:
+                if f.matches(kind, site, step):
+                    f.fired += 1
+                    self._ctr.labels(kind=kind).inc()
+                    return True
+        return False
+
+    def _find(self, kind, site=None, step=None) -> Optional[_Fault]:
+        with self._lock:
+            for f in self._faults:
+                if f.matches(kind, site, step):
+                    return f
+        return None
+
+    def maybe_raise(self, kind: str, site: str = None, step=None,
+                    exc=ConnectionError, msg: str = None) -> None:
+        """Raise `exc` when a matching fault fires (transient failures)."""
+        if self.should_fire(kind, site=site, step=step):
+            raise exc(msg or f"injected {kind} at {site or step}")
+
+    def maybe_crash(self, site: str = "checkpoint", step=None) -> None:
+        """ckpt_crash: die mid-write.  hard=1 SIGKILLs the process (the
+        kill -9 test); soft raises InjectedCrash.  A spec with ``site=``
+        matches only the named injection site (``CheckpointManager.save``
+        or ``save_state_dict``); without it, any site fires."""
+        f = self._find("ckpt_crash", site=site, step=step)
+        if f is None:
+            return
+        with self._lock:
+            f.fired += 1
+        self._ctr.labels(kind="ckpt_crash").inc()
+        if f.hard:
+            os.kill(os.getpid(), signal.SIGKILL)
+        raise InjectedCrash(f"injected checkpoint crash in {site} "
+                            f"(step={step})")
+
+
+# -- process-wide plan ------------------------------------------------------
+_plan: Optional[FaultPlan] = None
+_plan_lock = threading.Lock()
+
+
+def get_plan() -> Optional[FaultPlan]:
+    """The active plan, or None when PTPU_FAULTS is unset/empty (the
+    common case: one global read, no parsing)."""
+    global _plan
+    if _plan is None and os.environ.get("PTPU_FAULTS"):
+        with _plan_lock:
+            if _plan is None:
+                _plan = FaultPlan.from_env()
+    return _plan
+
+
+def set_plan(plan: Optional[FaultPlan]) -> None:
+    """Install a plan programmatically (tests); None clears."""
+    global _plan
+    _plan = plan
+
+
+# -- call-site helpers (inert one-liner when no plan) ----------------------
+def should_fire(kind, site=None, step=None) -> bool:
+    p = get_plan()
+    return False if p is None else p.should_fire(kind, site=site, step=step)
+
+
+def maybe_raise(kind, site=None, step=None, exc=ConnectionError, msg=None):
+    p = get_plan()
+    if p is not None:
+        p.maybe_raise(kind, site=site, step=step, exc=exc, msg=msg)
+
+
+def maybe_crash(site="checkpoint", step=None):
+    p = get_plan()
+    if p is not None:
+        p.maybe_crash(site=site, step=step)

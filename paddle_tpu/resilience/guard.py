@@ -1,0 +1,218 @@
+"""`StepGuard` — NaN/Inf-guarded training steps with rollback (reference:
+FLAGS_check_nan_inf at operator.cc:1608 *detects*; this layer *recovers*).
+
+The guard wraps an arbitrary train step — eager or `jit.compile`d — that
+updates `model`/`optimizer` in place and returns the loss.  Per step:
+
+1. snapshot params / optimizer slots / master weights / step counter.
+   The snapshot COPIES every array (one device-side copy of model+opt
+   state per step): the optimizer's jitted update donates its input
+   buffers, so a reference-only snapshot would hold deleted buffers the
+   moment the step runs.  On-device copy rides HBM bandwidth — cheap
+   next to the step itself — and is the entire price of rollback;
+2. run the step;
+3. health check: one fused device-side reduction
+   ``isfinite(loss) & all(isfinite(param) for params)`` — a single
+   boolean crosses to the host, there is no per-array sync.  Checking
+   the *post-update params* (not just the loss) is what catches a
+   NaN-gradient update whose loss was still finite;
+4. on a bad step: restore the pre-step snapshot INCLUDING any attached
+   `amp.GradScaler`'s scale/counters (the update is skipped), optionally
+   re-run the same step (`max_retries_per_step` — a transient fault
+   retried from truly identical pre-state, scaler included, reproduces
+   the unfaulted trajectory bit-for-bit), back off the scaler only once
+   the step is finally given up on, and after `rollback_after`
+   CONSECUTIVE bad steps restore the last *good snapshot* (taken every
+   `snapshot_every` good steps), covering slow corruption the per-step
+   skip can't.
+
+Monitor: ``resilience/skipped_steps``, ``resilience/rollbacks``,
+``resilience/bad_step_streak`` (gauge).
+
+Scope: rollback restores params, optimizer slots, master weights, the
+optimizer step counter, and GradScaler scale/counters.  Host-side state
+the step mutates itself (dataloader position, python RNG) is the
+caller's to manage — with `max_retries_per_step > 0` the retried step
+re-runs with the SAME arguments, so feed the batch in as arguments
+rather than pulling it inside the step.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .. import monitor
+from . import faults
+
+__all__ = ["StepGuard", "GuardedStepInfo"]
+
+
+class GuardedStepInfo:
+    """What happened to one guarded step.  `loss` holds the extracted
+    loss ARRAY (first element of a tuple-returning step, unwrapped from
+    Tensor), not the step's raw return value."""
+
+    __slots__ = ("ok", "loss", "retries", "skipped", "rolled_back")
+
+    def __init__(self, ok, loss, retries=0, skipped=False, rolled_back=False):
+        self.ok = ok
+        self.loss = loss
+        self.retries = retries
+        self.skipped = skipped
+        self.rolled_back = rolled_back
+
+    def __repr__(self):
+        return (f"GuardedStepInfo(ok={self.ok}, retries={self.retries}, "
+                f"skipped={self.skipped}, rolled_back={self.rolled_back})")
+
+
+def _loss_array(result):
+    """Extract the loss array from a step's return value (Tensor, array,
+    or a tuple whose first element is the loss)."""
+    if isinstance(result, (tuple, list)) and result:
+        result = result[0]
+    return getattr(result, "_data", result)
+
+
+class StepGuard:
+    def __init__(self, model=None, optimizer=None, scaler=None, *,
+                 params=None, rollback_after: int = 3,
+                 snapshot_every: int = 1, max_retries_per_step: int = 0,
+                 check_params: bool = True):
+        if params is not None:
+            self._params = list(params)
+        elif model is not None:
+            self._params = list(model.parameters())
+        elif optimizer is not None:
+            self._params = list(optimizer._parameter_list)
+        else:
+            raise ValueError("StepGuard needs a model, optimizer, or "
+                             "an explicit params list")
+        self._opt = optimizer
+        self._scaler = scaler
+        self.rollback_after = int(rollback_after)
+        self.snapshot_every = max(1, int(snapshot_every))
+        self.max_retries_per_step = int(max_retries_per_step)
+        self.check_params = bool(check_params)
+        self._step_index = 0
+        self._bad_streak = 0
+        self._good_steps = 0
+        self._good_snap = None
+        self._m_skipped = monitor.counter("resilience/skipped_steps",
+                                          "non-finite steps skipped")
+        self._m_rollbacks = monitor.counter(
+            "resilience/rollbacks",
+            "rollbacks to the last good snapshot")
+        self._m_streak = monitor.gauge("resilience/bad_step_streak")
+
+    # -- snapshot / restore -------------------------------------------------
+
+    @staticmethod
+    def _copy(a):
+        """A buffer the optimizer's donating update can't invalidate."""
+        return jnp.array(a, copy=True)
+
+    def _capture(self):
+        snap = {
+            "params": [self._copy(p._data) for p in self._params],
+        }
+        if self._opt is not None:
+            snap["states"] = {k: {s: self._copy(a) for s, a in v.items()}
+                              for k, v in self._opt._states.items()}
+            snap["masters"] = {k: self._copy(a) for k, a in
+                               self._opt._master_weights.items()}
+            snap["step_count"] = self._opt._step_count
+        if self._scaler is not None:
+            snap["scaler"] = self._scaler.state_dict()
+        return snap
+
+    def _restore(self, snap, restore_scaler=False):
+        # copies on the way OUT as well: the next step will donate what we
+        # install here, and the same snapshot (the good snapshot) may be
+        # restored again later
+        for p, data in zip(self._params, snap["params"]):
+            p._data = self._copy(data)
+        if self._opt is not None:
+            self._opt._states = {k: {s: self._copy(a) for s, a in v.items()}
+                                 for k, v in snap["states"].items()}
+            self._opt._master_weights = {k: self._copy(a) for k, a in
+                                         snap["masters"].items()}
+            self._opt._step_count = snap["step_count"]
+        if restore_scaler and self._scaler is not None \
+                and "scaler" in snap:
+            self._scaler.load_state_dict(snap["scaler"])
+
+    # -- health -------------------------------------------------------------
+
+    def _healthy(self, loss_arr) -> bool:
+        """One device-side AND-reduction over loss (and params); a single
+        bool() sync at the end."""
+        ok = jnp.all(jnp.isfinite(jnp.asarray(loss_arr, jnp.float32)))
+        if self.check_params:
+            for p in self._params:
+                d = p._data
+                if jnp.issubdtype(d.dtype, jnp.floating):
+                    ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(d)))
+        return bool(ok)
+
+    # -- the guarded step ---------------------------------------------------
+
+    def step(self, step_fn, *args, **kwargs):
+        """Run ``step_fn(*args, **kwargs)`` under the guard.  Returns
+        ``(result, info)`` where `result` is the step's return value (the
+        last attempt's, even when skipped) and `info` a
+        :class:`GuardedStepInfo`."""
+        self._step_index += 1
+        step = self._step_index
+        retries = 0
+        # ONE pre-step snapshot, reused across retries: _restore installs
+        # fresh copies, so `pre` itself stays valid for another restore —
+        # re-capturing after a restore would just copy the same state again
+        pre = self._capture()
+        while True:
+            result = step_fn(*args, **kwargs)
+            # injected "optimizer update from NaN gradients": poison the
+            # updated params so the health check sees what a real
+            # non-finite gradient step produces
+            if faults.should_fire("nan_grad", step=step):
+                p0 = self._params[0]
+                p0._data = p0._data * jnp.float32(jnp.nan)
+            if self._healthy(_loss_array(result)):
+                self._bad_streak = 0
+                self._m_streak.set(0)
+                self._good_steps += 1
+                if self._good_steps % self.snapshot_every == 0:
+                    # post-step state of a verified-healthy step
+                    self._good_snap = self._capture()
+                return result, GuardedStepInfo(True, _loss_array(result),
+                                               retries=retries)
+            # -- bad step ---------------------------------------------------
+            self._m_skipped.inc()
+            # skip the update entirely — scaler included, so a retried
+            # step runs from EXACTLY the unfaulted pre-state (the
+            # bit-for-bit parity property)
+            self._restore(pre, restore_scaler=True)
+            if retries < self.max_retries_per_step:
+                retries += 1
+                continue
+            # the step is given up on: NOW the scaler backs off (a
+            # transient fault that retried clean never touches it)
+            if self._scaler is not None:
+                self._scaler.backoff()
+            self._bad_streak += 1
+            self._m_streak.set(self._bad_streak)
+            rolled = False
+            if self.rollback_after > 0 and \
+                    self._bad_streak >= self.rollback_after and \
+                    self._good_snap is not None:
+                self._restore(self._good_snap, restore_scaler=True)
+                self._m_rollbacks.inc()
+                self._bad_streak = 0
+                self._m_streak.set(0)
+                rolled = True
+            return result, GuardedStepInfo(False, _loss_array(result),
+                                           retries=retries, skipped=True,
+                                           rolled_back=rolled)
+
+    __call__ = step

@@ -1,0 +1,198 @@
+"""Retry/backoff, deadlines, and preemption handling (reference analog:
+fleet/elastic/manager.py restarts ranks on transient failures; the etcd
+client retries leases — here transient-failure policy is one shared
+primitive instead of ad-hoc loops at each call site).
+
+Design: stdlib-only (importable from the store/rpc bootstrap path before
+jax exists), monitor-instrumented (`resilience/retries` counter labeled
+by site), and deterministic enough to test (the sleeper is injectable
+and jitter is a bounded multiplier, not an unbounded resample).
+"""
+from __future__ import annotations
+
+import os
+import random
+import signal
+import threading
+import time
+from typing import Callable, Optional, Tuple, Type
+
+from .. import monitor
+
+__all__ = ["retry", "Deadline", "PreemptionHandler", "DEFAULT_RETRYABLE"]
+
+DEFAULT_RETRYABLE: Tuple[Type[BaseException], ...] = (
+    ConnectionError, TimeoutError, OSError)
+
+# Private RNG for jitter: drawing from the process-global `random` module
+# would perturb seeded streams the framework depends on for determinism
+# (reader.shuffle order, dy2static probes) every time a background retry
+# fires mid-training.
+_jitter_rng = random.Random(0x5EED)
+
+
+class Deadline:
+    """A wall-clock budget that several operations can share.
+
+    `Deadline(None)` never expires — call sites can thread an optional
+    deadline without branching.  Monotonic clock: a host NTP step during
+    a long rendezvous must not spuriously expire every worker at once.
+    """
+
+    __slots__ = ("seconds", "_expires")
+
+    def __init__(self, seconds: Optional[float]):
+        self.seconds = seconds
+        self._expires = None if seconds is None else time.monotonic() + seconds
+
+    @classmethod
+    def after(cls, seconds: Optional[float]) -> "Deadline":
+        return cls(seconds)
+
+    @property
+    def expired(self) -> bool:
+        return self._expires is not None and time.monotonic() >= self._expires
+
+    def remaining(self) -> Optional[float]:
+        """Seconds left (>= 0), or None for an infinite deadline."""
+        if self._expires is None:
+            return None
+        return max(0.0, self._expires - time.monotonic())
+
+    def remaining_ms(self, cap: int = 2**31 - 1) -> Optional[int]:
+        r = self.remaining()
+        return None if r is None else min(cap, max(0, int(r * 1000)))
+
+    def check(self, what: str = "operation") -> None:
+        if self.expired:
+            raise TimeoutError(f"deadline exceeded ({self.seconds}s) in {what}")
+
+    def __repr__(self):
+        return f"Deadline(remaining={self.remaining()})"
+
+
+def retry(fn: Callable = None, *, retries: int = 5, backoff: float = 0.05,
+          max_backoff: float = 5.0, jitter: float = 0.1,
+          retryable: Tuple[Type[BaseException], ...] = DEFAULT_RETRYABLE,
+          deadline: Optional[Deadline] = None, site: str = "",
+          on_retry: Callable = None, sleep: Callable = time.sleep):
+    """Exponential-backoff retry wrapper.
+
+    Two spellings::
+
+        result = retry(lambda: store.get(k), retries=3, site="store.get")()
+        @retry(retries=3)
+        def connect(): ...
+
+    Policy: attempt `fn`; on a `retryable` exception sleep
+    ``backoff * 2**i`` (capped at `max_backoff`, stretched by up to
+    ``+jitter`` fractionally so a fleet of workers doesn't thunder-herd
+    the master) and re-attempt, up to `retries` extra attempts or until
+    `deadline` expires — whichever is first.  The LAST underlying
+    exception is re-raised unwrapped, so call sites keep their existing
+    except clauses.  Each re-attempt increments
+    ``resilience/retries{site=...}``.
+    """
+    if fn is None:
+        def deco(f):
+            return retry(f, retries=retries, backoff=backoff,
+                         max_backoff=max_backoff, jitter=jitter,
+                         retryable=retryable, deadline=deadline,
+                         site=site or getattr(f, "__name__", ""),
+                         on_retry=on_retry, sleep=sleep)
+        return deco
+
+    ctr = monitor.counter("resilience/retries",
+                          "transient-failure re-attempts")
+
+    def wrapped(*args, **kwargs):
+        attempt = 0
+        while True:
+            try:
+                return fn(*args, **kwargs)
+            except retryable as e:
+                remaining = None if deadline is None else deadline.remaining()
+                out_of_time = remaining is not None and remaining <= 0
+                if attempt >= retries or out_of_time:
+                    raise
+                # exponent clamped: long deadline-governed loops (retries
+                # in the thousands) must not hit float overflow at 2**1024
+                delay = min(backoff * (2.0 ** min(attempt, 62)), max_backoff)
+                if jitter:
+                    delay *= 1.0 + _jitter_rng.uniform(0.0, jitter)
+                if remaining is not None:
+                    delay = min(delay, remaining)
+                attempt += 1
+                ctr.labels(site=site or getattr(fn, "__name__", "?")).inc()
+                if on_retry is not None:
+                    on_retry(attempt, e, delay)
+                sleep(delay)
+
+    wrapped.__name__ = getattr(fn, "__name__", "retry_wrapped")
+    return wrapped
+
+
+class PreemptionHandler:
+    """SIGTERM/SIGINT → "checkpoint at the next step boundary, then exit".
+
+    The training loop polls `triggered` once per step; when set it saves
+    through its CheckpointManager and exits cleanly (the pattern of the
+    reference's elastic relaunch: the *loop* decides when state is
+    consistent, the signal only requests it).  A second SIGINT falls
+    through to the previous handler so an interactive ^C ^C still kills
+    a wedged run.
+    """
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self._signals = tuple(signals)
+        self._event = threading.Event()
+        self._prev = {}
+        self._installed = False
+        self._ctr = monitor.counter("resilience/preemptions",
+                                    "preemption signals received")
+
+    def install(self) -> "PreemptionHandler":
+        if self._installed:
+            return self
+        if threading.current_thread() is not threading.main_thread():
+            raise RuntimeError(
+                "PreemptionHandler.install() must run on the main thread "
+                "(signal module restriction)")
+        for sig in self._signals:
+            self._prev[sig] = signal.signal(sig, self._on_signal)
+        self._installed = True
+        return self
+
+    def _on_signal(self, signum, frame):
+        if self._event.is_set():
+            # second signal: restore + re-deliver so a stuck loop dies
+            self.uninstall()
+            os.kill(os.getpid(), signum)
+            return
+        self._ctr.inc()
+        self._event.set()
+
+    @property
+    def triggered(self) -> bool:
+        return self._event.is_set()
+
+    def reset(self) -> None:
+        self._event.clear()
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        for sig, prev in self._prev.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, TypeError):   # non-main thread teardown
+                pass
+        self._prev.clear()
+        self._installed = False
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False

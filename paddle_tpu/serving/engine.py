@@ -61,6 +61,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import monitor
+from ..resilience.retry import Deadline
 from ..ops.paged_attention import (paged_attention_arrays,
                                    paged_cache_update_arrays)
 from .kv_cache import BlockKVCache
@@ -137,6 +138,8 @@ class LLMEngine:
         self._m_dec_tps = m.gauge("serving/decode_tps")
         self._m_preempt = m.counter("serving/preemptions")
         self._m_done = m.counter("serving/requests_finished")
+        self._m_expired = m.counter("serving/deadline_expired",
+                                    "requests aborted past deadline_s")
         self._m_step = m.histogram("serving/step_time")
 
     # -- request API --------------------------------------------------------
@@ -155,6 +158,8 @@ class LLMEngine:
         req = Request(self._next_id, prompt, params)
         self._next_id += 1
         req.key = self._init_key(params)
+        if params.deadline_s is not None:
+            req.deadline = Deadline(params.deadline_s)
         self._requests[req.req_id] = req
         self.scheduler.add(req)
         return req.req_id
@@ -176,6 +181,8 @@ class LLMEngine:
         req = Request(self._next_id, prompt, params)
         self._next_id += 1
         req.key = self._init_key(params)
+        if params.deadline_s is not None:
+            req.deadline = Deadline(params.deadline_s)
         # parent has written total_len-1 positions (the last sampled token
         # is fed next step); the child re-feeds it as its final "prompt"
         # token through its own prefill continuation
@@ -231,7 +238,9 @@ class LLMEngine:
 
     def generate(self, prompts, sampling_params=None):
         """Run `prompts` (list of id sequences) to completion; returns a
-        list of [prompt + generated] int32 arrays in submission order."""
+        list of [prompt + generated] int32 arrays in submission order.
+        A request aborted by its `SamplingParams.deadline_s` yields None
+        in its slot (deadline abort is a cancel, not a truncation)."""
         if sampling_params is None or isinstance(sampling_params,
                                                  SamplingParams):
             params = [sampling_params] * len(prompts)
@@ -244,7 +253,12 @@ class LLMEngine:
         try:
             while self.scheduler.has_work():
                 self.step()
-            return [self.request_output(i) for i in ids]
+            # a deadline-expired request was aborted and released
+            # mid-loop: its row comes back as None (partial output is
+            # dropped with the request — deadline abort is a cancel, not
+            # a truncation)
+            return [self.request_output(i) if i in self._requests else None
+                    for i in ids]
         finally:
             # also on error (e.g. a too-small pool raising mid-loop):
             # abandoning admitted requests would leak their KV blocks and
@@ -252,10 +266,23 @@ class LLMEngine:
             for i in ids:
                 self.release_request(i)
 
+    def _expire_deadlines(self) -> list:
+        """Abort every unfinished request whose deadline has passed, via
+        the release_request() path (frees its KV blocks / swap snapshot /
+        host state — nothing can leak).  Returns the expired ids."""
+        expired = [r.req_id for r in self._requests.values()
+                   if r.deadline is not None and not r.finished
+                   and r.deadline.expired]
+        for rid in expired:
+            self.release_request(rid)
+            self._m_expired.inc()
+        return expired
+
     def step(self) -> list:
         """One scheduler decision + one jitted exec.  Returns the requests
         that FINISHED this step."""
         t0 = time.perf_counter()
+        self._expire_deadlines()
         out = self.scheduler.schedule()
         if out.preempted:
             self._m_preempt.inc(len(out.preempted))

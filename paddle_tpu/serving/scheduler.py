@@ -40,6 +40,11 @@ class SamplingParams:
     top_p: float = 1.0
     eos_token_id: Optional[int] = None
     seed: Optional[int] = None
+    # wall-clock budget from admission; an expired request is aborted at
+    # the next engine step via release_request() (resilience.Deadline —
+    # None = no deadline).  Not a sampling knob, so absent from the dense
+    # generate() oracle surface.
+    deadline_s: Optional[float] = None
 
 
 class Request:
@@ -57,6 +62,7 @@ class Request:
         self.key = None                    # per-request PRNG key (engine)
         self.swap = None                   # host KV snapshot while evicted
         self.arrival = None                # admission tiebreak (set by add)
+        self.deadline = None               # resilience.Deadline (engine)
 
     # -- derived ------------------------------------------------------------
 

@@ -41,6 +41,7 @@ def test_example_legacy_reader_pipeline():
     "train_moe.py", "static_graph_training.py", "amp_training.py",
     "long_context_ring.py", "dynamic_control_flow.py",
     "distributed_serving.py", "packed_pretraining.py",
+    "resilient_training.py",
 ])
 def test_example_heavy(name):
     assert "OK" in _run(name)

@@ -1,0 +1,688 @@
+"""paddle_tpu.resilience — fault-tolerant training/serving runtime.
+
+The bar (ISSUE 3 acceptance): kill -9 during a checkpoint save, then
+`restore_latest()` resumes from the previous intact checkpoint with
+verified checksums; an injected NaN-gradient step is skipped/rolled back
+and training matches the loss trajectory of an unfaulted run; transient
+store/RPC failures are retried with backoff; SIGTERM checkpoints at the
+next step boundary and exits clean; every recovery event lands in
+`monitor.snapshot()` as a ``resilience/*`` series.  All CPU-runnable,
+fast tier.
+"""
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor, nn, optimizer
+from paddle_tpu.resilience import (CheckpointManager, Deadline, FaultPlan,
+                                   InjectedCrash, PreemptionHandler,
+                                   StepGuard, faults, retry)
+
+_WORKER = pathlib.Path(__file__).resolve().parent / "workers" / \
+    "resilience_train_worker.py"
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.set_plan(None)
+    yield
+    faults.set_plan(None)
+
+
+# ---------------------------------------------------------------------------
+# retry / Deadline
+# ---------------------------------------------------------------------------
+
+def test_retry_backoff_sequence():
+    sleeps, calls = [], [0]
+
+    def flaky():
+        calls[0] += 1
+        if calls[0] < 4:
+            raise ConnectionError("transient")
+        return "ok"
+
+    out = retry(flaky, retries=5, backoff=0.1, max_backoff=10.0,
+                jitter=0.25, sleep=sleeps.append)()
+    assert out == "ok" and calls[0] == 4
+    assert len(sleeps) == 3
+    for i, s in enumerate(sleeps):          # 0.1 * 2^i, stretched <= +25%
+        base = 0.1 * 2 ** i
+        assert base <= s <= base * 1.25 + 1e-9, (i, s)
+
+
+def test_retry_exhaustion_reraises_last():
+    def always():
+        raise ConnectionRefusedError("down")
+
+    with pytest.raises(ConnectionRefusedError):
+        retry(always, retries=2, backoff=0.0, sleep=lambda s: None)()
+    # the counter saw both re-attempts
+    snap = monitor.snapshot()
+    assert snap["resilience/retries"]["site=always"] >= 2
+
+
+def test_retry_non_retryable_propagates_immediately():
+    calls = [0]
+
+    def boom():
+        calls[0] += 1
+        raise ValueError("logic bug")
+
+    with pytest.raises(ValueError):
+        retry(boom, retries=5, backoff=0.0, sleep=lambda s: None)()
+    assert calls[0] == 1
+
+
+def test_retry_respects_deadline():
+    calls = [0]
+
+    def flaky():
+        calls[0] += 1
+        raise TimeoutError("slow")
+
+    d = Deadline(0.0)          # already expired: no re-attempts at all
+    with pytest.raises(TimeoutError):
+        retry(flaky, retries=100, backoff=0.0, deadline=d,
+              sleep=lambda s: None)()
+    assert calls[0] == 1
+
+
+def test_deadline_basics():
+    assert not Deadline(None).expired
+    assert Deadline(None).remaining() is None
+    d = Deadline(0.05)
+    assert not d.expired and 0 < d.remaining() <= 0.05
+    assert d.remaining_ms() <= 50
+    time.sleep(0.06)
+    assert d.expired and d.remaining() == 0.0
+    with pytest.raises(TimeoutError):
+        d.check("unit test")
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_parse_and_budget():
+    p = FaultPlan("conn_error@site=store.get,times=2;nan_grad@step=5;"
+                  "ckpt_crash@step=4,hard=1")
+    assert p.should_fire("conn_error", site="store.get")
+    assert p.should_fire("conn_error", site="store.get")
+    assert not p.should_fire("conn_error", site="store.get")   # burned out
+    assert not p.should_fire("conn_error", site="store.set")   # wrong site
+    assert not p.should_fire("nan_grad", step=4)               # wrong step
+    assert p.should_fire("nan_grad", step=5)
+    assert p._find("ckpt_crash", step=4).hard == 1
+    with pytest.raises(ValueError, match="unknown key"):
+        FaultPlan("conn_error@bogus=1")
+    assert not FaultPlan("")    # empty plan is falsy / inert
+
+
+def test_fault_plan_inert_when_unset(monkeypatch):
+    monkeypatch.delenv("PTPU_FAULTS", raising=False)
+    faults.set_plan(None)
+    assert faults.get_plan() is None
+    assert not faults.should_fire("conn_error", site="x")
+    faults.maybe_raise("conn_error", site="x")     # no-op
+    faults.maybe_crash()                           # no-op
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager: atomic save, rotation, corrupt fallback
+# ---------------------------------------------------------------------------
+
+def _state(v0: float):
+    return {"w": paddle.to_tensor(np.arange(6, dtype="float32")
+                                  .reshape(2, 3) + v0),
+            "b": paddle.to_tensor(np.full((4,), v0, "float32"))}
+
+
+def _restored_w0(state):
+    return float(np.asarray(state["w"]._data).ravel()[0])
+
+
+def test_checkpoint_atomic_layout_and_rotation(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=2)
+    for step in (1, 2, 3):
+        path = mgr.save(step, _state(float(step)))
+        assert os.path.isdir(path)
+        assert os.path.exists(os.path.join(path, "manifest.json"))
+    # rotation kept the last 2 only
+    assert mgr.all_steps() == [2, 3]
+    assert mgr.latest_step() == 3
+    # manifest carries per-array checksums
+    import json
+
+    with open(os.path.join(mgr._final_dir(3), "manifest.json")) as f:
+        man = json.load(f)
+    assert man["step"] == 3
+    assert set(man["arrays"]) == {"w", "b"}
+    assert all("crc32" in m and "shape" in m and "dtype" in m
+               for m in man["arrays"].values())
+    # no stale tmp dirs after clean saves
+    assert not [n for n in os.listdir(tmp_path) if n.startswith(".tmp_")]
+    step, state = mgr.restore_latest()
+    assert step == 3 and _restored_w0(state) == 3.0
+
+
+def test_checkpoint_corrupt_latest_falls_back(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=5)
+    for step in (1, 2, 3):
+        mgr.save(step, _state(float(step)))
+    before = monitor.counter("resilience/corrupt_ckpts_skipped").value
+    # truncate the largest payload file of the newest checkpoint
+    p3 = pathlib.Path(mgr._final_dir(3))
+    payload = [f for f in p3.rglob("*")
+               if f.is_file() and f.name != "manifest.json"]
+    big = max(payload, key=lambda f: f.stat().st_size)
+    with open(big, "r+b") as f:
+        f.truncate(max(1, big.stat().st_size // 2))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        step, state = mgr.restore_latest()
+    assert step == 2 and _restored_w0(state) == 2.0
+    assert monitor.counter("resilience/corrupt_ckpts_skipped").value > before
+
+
+def test_checkpoint_missing_manifest_falls_back(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=5)
+    mgr.save(1, _state(1.0))
+    mgr.save(2, _state(2.0))
+    os.unlink(os.path.join(mgr._final_dir(2), "manifest.json"))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        step, state = mgr.restore_latest()
+    assert step == 1 and _restored_w0(state) == 1.0
+
+
+def test_checkpoint_crash_mid_save_leaves_previous_intact(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=5)
+    mgr.save(2, _state(2.0))
+    faults.set_plan(FaultPlan("ckpt_crash@step=4"))
+    with pytest.raises(InjectedCrash):
+        mgr.save(4, _state(4.0))
+    faults.set_plan(None)
+    # nothing committed for step 4; the tmp remnant is visible ...
+    assert mgr.all_steps() == [2]
+    assert [n for n in os.listdir(tmp_path) if n.startswith(".tmp_")]
+    step, state = mgr.restore_latest()
+    assert step == 2 and _restored_w0(state) == 2.0
+    # ... and a fresh manager (the relaunched process) sweeps it
+    mgr2 = CheckpointManager(str(tmp_path), keep_last_n=5)
+    assert not [n for n in os.listdir(tmp_path) if n.startswith(".tmp_")]
+    assert mgr2.latest_step() == 2
+
+
+def test_checkpoint_resave_same_step_crash_safe(tmp_path):
+    """Re-saving an existing step must never hold a window where the
+    committed checkpoint is gone: a kill between the two swap renames
+    leaves an .old_ sibling the next manager rolls back."""
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=5)
+    mgr.save(2, _state(2.0))
+    mgr.save(2, _state(7.0))                     # clean re-save: swap path
+    step, state = mgr.restore_latest()
+    assert step == 2 and _restored_w0(state) == 7.0
+    # hand-build the mid-swap crash state of a dead pid: final renamed to
+    # .old_, replacement still in .tmp_
+    final = mgr._final_dir(2)
+    os.rename(final, os.path.join(str(tmp_path), ".old_step_00000002-999"))
+    os.makedirs(os.path.join(str(tmp_path), ".tmp_step_00000002-999"))
+    mgr2 = CheckpointManager(str(tmp_path), keep_last_n=5)
+    assert mgr2.all_steps() == [2]               # rolled back, tmp swept
+    step, state = mgr2.restore_latest()
+    assert step == 2 and _restored_w0(state) == 7.0
+
+
+def test_checkpoint_async_save(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=3, async_save=True)
+    mgr.save(1, _state(1.0), wait=False)
+    mgr.wait_until_finished()
+    step, state = mgr.restore_latest()
+    assert step == 1 and _restored_w0(state) == 1.0
+
+
+def test_save_state_dict_crash_safe_standalone(tmp_path):
+    """The satellite: an interrupted distributed.checkpoint.save_state_dict
+    can never clobber the previous good checkpoint at the same path."""
+    from paddle_tpu.distributed import checkpoint as dckpt
+
+    path = str(tmp_path / "ckpt")
+    dckpt.save_state_dict(_state(1.0), path)
+    back = dckpt.load_state_dict(path)
+    assert _restored_w0(back) == 1.0
+    # crash AFTER the new payload is written, BEFORE the swap
+    faults.set_plan(FaultPlan("ckpt_crash"))
+    with pytest.raises(InjectedCrash):
+        dckpt.save_state_dict(_state(9.0), path)
+    faults.set_plan(None)
+    back = dckpt.load_state_dict(path)     # old data still intact
+    assert _restored_w0(back) == 1.0
+    # a clean save still replaces it
+    dckpt.save_state_dict(_state(5.0), path)
+    assert _restored_w0(dckpt.load_state_dict(path)) == 5.0
+
+
+def test_save_state_dict_recovers_half_done_swap(tmp_path):
+    """A crash BETWEEN the two swap renames leaves no dir at `path`; the
+    next load (or save) at the same path must complete the swap from the
+    fully-written tmp sibling."""
+    from paddle_tpu.distributed import checkpoint as dckpt
+
+    path = str(tmp_path / "ckpt")
+    dckpt.save_state_dict(_state(1.0), path)
+    dckpt.save_state_dict(_state(2.0), path)     # exercises the swap path
+    assert _restored_w0(dckpt.load_state_dict(path)) == 2.0
+    # hand-build the crash-between-renames state of a dead pid 99999:
+    # new payload fully staged at .tmp-*, previous moved to .old-*,
+    # nothing at `path`
+    os.rename(path, path + ".tmp-99999")
+    os.makedirs(path + ".old-99999")
+    back = dckpt.load_state_dict(path)           # recovery commits the tmp
+    assert _restored_w0(back) == 2.0
+    assert os.path.isdir(path)
+    assert not os.path.exists(path + ".tmp-99999")
+    assert not os.path.exists(path + ".old-99999")
+    # path present again → a later save sweeps any stale siblings
+    os.makedirs(path + ".tmp-55555")
+    dckpt.save_state_dict(_state(3.0), path)
+    assert not os.path.exists(path + ".tmp-55555")
+    assert _restored_w0(dckpt.load_state_dict(path)) == 3.0
+
+
+# ---------------------------------------------------------------------------
+# StepGuard: NaN skip / retry parity / rollback
+# ---------------------------------------------------------------------------
+
+def _mlp_and_data():
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 8).astype("float32")
+    Y = rng.randn(64, 1).astype("float32")
+    paddle.seed(7)
+    m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    o = optimizer.Adam(learning_rate=1e-2, parameters=m.parameters())
+    return m, o, X, Y
+
+
+def _run_guarded(plan, steps=12, **guard_kw):
+    m, o, X, Y = _mlp_and_data()
+    guard = StepGuard(model=m, optimizer=o, **guard_kw)
+    faults.set_plan(FaultPlan(plan) if plan else None)
+    losses, infos = [], []
+    for i in range(steps):
+        lo = (i * 8) % 56
+        xb, yb = paddle.to_tensor(X[lo:lo + 8]), paddle.to_tensor(Y[lo:lo + 8])
+
+        def step():
+            loss = ((m(xb) - yb) ** 2).mean()
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            return loss
+
+        res, info = guard.step(step)
+        losses.append(float(res.numpy()))
+        infos.append(info)
+    faults.set_plan(None)
+    params = [np.asarray(p._data) for p in m.parameters()]
+    return losses, params, infos, guard
+
+
+def test_nan_step_retry_matches_unfaulted_run():
+    """A transient NaN-gradient step, rolled back and retried from the
+    identical pre-state, reproduces the unfaulted trajectory
+    BIT-FOR-BIT — the acceptance parity pin."""
+    la, pa, _, _ = _run_guarded(None, max_retries_per_step=1)
+    lb, pb, infos, _ = _run_guarded("nan_grad@step=5",
+                                    max_retries_per_step=1)
+    assert la == lb                          # exact float equality
+    for x, y in zip(pa, pb):
+        np.testing.assert_array_equal(x, y)
+    assert infos[4].ok and infos[4].retries == 1
+    assert all(np.isfinite(lb))
+
+
+def test_nan_step_skip_keeps_params_finite():
+    before = monitor.counter("resilience/skipped_steps").value
+    losses, params, infos, _ = _run_guarded("nan_grad@step=5",
+                                            max_retries_per_step=0)
+    assert not infos[4].ok and infos[4].skipped
+    assert all(np.isfinite(losses))
+    assert all(np.isfinite(p).all() for p in params)
+    assert monitor.counter("resilience/skipped_steps").value > before
+
+
+def test_consecutive_nan_steps_roll_back_to_good_snapshot():
+    before = monitor.counter("resilience/rollbacks").value
+    # three consecutive poisoned steps, rollback after 2
+    losses, params, infos, guard = _run_guarded(
+        "nan_grad@step=5;nan_grad@step=6;nan_grad@step=7",
+        max_retries_per_step=0, rollback_after=2)
+    assert monitor.counter("resilience/rollbacks").value > before
+    assert any(i.rolled_back for i in infos)
+    assert all(np.isfinite(p).all() for p in params)
+    # training continued past the fault window
+    assert infos[-1].ok
+
+
+def test_guard_backs_off_gradscaler():
+    m, o, X, Y = _mlp_and_data()
+    scaler = paddle.amp.GradScaler(init_loss_scaling=1024,
+                                   decr_every_n_nan_or_inf=1)
+    guard = StepGuard(model=m, optimizer=o, scaler=scaler,
+                      max_retries_per_step=0)
+    faults.set_plan(FaultPlan("nan_grad@step=1"))
+
+    def step():
+        loss = ((m(paddle.to_tensor(X[:8]))
+                 - paddle.to_tensor(Y[:8])) ** 2).mean()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        return loss
+
+    _, info = guard.step(step)
+    faults.set_plan(None)
+    assert not info.ok
+    assert float(scaler._scale) == 512.0     # one backoff applied
+
+
+def test_guard_clean_retry_leaves_scaler_untouched():
+    """A transient fault that retries clean must not perturb the scaler —
+    otherwise the retried step runs at a different loss scale and the
+    bit-for-bit parity property breaks."""
+    m, o, X, Y = _mlp_and_data()
+    scaler = paddle.amp.GradScaler(init_loss_scaling=1024,
+                                   decr_every_n_nan_or_inf=1)
+    guard = StepGuard(model=m, optimizer=o, scaler=scaler,
+                      max_retries_per_step=1)
+    faults.set_plan(FaultPlan("nan_grad@step=1"))
+
+    def step():
+        loss = ((m(paddle.to_tensor(X[:8]))
+                 - paddle.to_tensor(Y[:8])) ** 2).mean()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        return loss
+
+    _, info = guard.step(step)
+    faults.set_plan(None)
+    assert info.ok and info.retries == 1
+    assert float(scaler._scale) == 1024.0    # no backoff on a clean retry
+    assert scaler._bad_steps == 0
+
+
+def test_guard_rejects_empty_construction():
+    with pytest.raises(ValueError):
+        StepGuard()
+
+
+# ---------------------------------------------------------------------------
+# TCPStore: connect-before-master + transient get retry
+# ---------------------------------------------------------------------------
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture()
+def _py_store(monkeypatch):
+    """Force the pure-python store path (the native client has its own
+    connect loop; the retry-wired path under test is the python one)."""
+    from paddle_tpu.core import native
+
+    monkeypatch.setattr(native, "load", lambda: None)
+
+
+def test_store_client_before_master_joins_cleanly(_py_store):
+    import threading
+
+    from paddle_tpu.distributed.store import TCPStore
+
+    port = _free_port()
+    boxes = {}
+
+    def late_master():
+        time.sleep(0.5)
+        boxes["master"] = TCPStore("127.0.0.1", port, is_master=True)
+
+    t = threading.Thread(target=late_master)
+    t.start()
+    try:
+        # starts knocking ~0.5s before the master binds its port
+        client = TCPStore("127.0.0.1", port, timeout=10)
+        client.set("k", b"v")
+        assert client.get("k") == b"v"
+        client.close()
+    finally:
+        t.join()
+        boxes["master"].close()
+    snap = monitor.snapshot()
+    assert snap["resilience/retries"]["site=store.connect"] >= 1
+
+
+def test_store_connect_timeout_still_raises(_py_store):
+    from paddle_tpu.distributed.store import TCPStore
+
+    port = _free_port()            # nothing ever listens here
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        TCPStore("127.0.0.1", port, timeout=0.5)
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_store_get_retries_transient_conn_error(_py_store):
+    from paddle_tpu.distributed.store import TCPStore
+
+    port = _free_port()
+    master = TCPStore("127.0.0.1", port, is_master=True)
+    try:
+        client = TCPStore("127.0.0.1", port, timeout=5)
+        client.set("k", b"v1")
+        faults.set_plan(FaultPlan("conn_error@site=store.get,times=2"))
+        assert client.get("k") == b"v1"     # retried through 2 injections
+        faults.set_plan(None)
+        client.close()
+    finally:
+        master.close()
+
+
+# ---------------------------------------------------------------------------
+# serving: per-request deadline
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def _engine():
+    from paddle_tpu.models import GPTForCausalLM, gpt_test_config
+    from paddle_tpu.serving import EngineConfig, LLMEngine
+
+    cfg = gpt_test_config(stacked_blocks=True, sequence_parallel=False)
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    return LLMEngine(model, EngineConfig(block_size=16, max_num_seqs=4))
+
+
+def test_serving_deadline_expired_releases_blocks(_engine):
+    from paddle_tpu.serving import SamplingParams
+
+    eng = _engine
+    before = monitor.counter("serving/deadline_expired").value
+    rng = np.random.RandomState(3)
+    prompt = rng.randint(0, eng.cfg.vocab_size, (5,)).astype(np.int32)
+    # generous deadline: finishes normally
+    ok_id = eng.add_request(prompt, SamplingParams(max_new_tokens=3,
+                                                   deadline_s=60.0))
+    # already-expired deadline: aborted at the first step
+    bad_id = eng.add_request(prompt, SamplingParams(max_new_tokens=3,
+                                                    deadline_s=0.0))
+    while eng.has_unfinished():
+        eng.step()
+    assert bad_id not in eng._requests            # released, host state gone
+    out = eng.request_output(ok_id)
+    assert out.shape == (8,)
+    eng.release_request(ok_id)
+    assert eng.cache.blocks_in_use == 0           # no leaked KV blocks
+    assert not eng.scheduler.has_work()
+    assert monitor.counter("serving/deadline_expired").value == before + 1
+
+
+def test_serving_deadline_mid_decode_no_leak(_engine):
+    """Expiry of a RUNNING request (blocks allocated, some tokens done)
+    must free its blocks through release_request."""
+    from paddle_tpu.serving import SamplingParams
+
+    eng = _engine
+    rng = np.random.RandomState(4)
+    prompt = rng.randint(0, eng.cfg.vocab_size, (4,)).astype(np.int32)
+    rid = eng.add_request(prompt, SamplingParams(max_new_tokens=32,
+                                                 deadline_s=0.35))
+    t0 = time.monotonic()
+    while eng.has_unfinished() and time.monotonic() - t0 < 30:
+        eng.step()
+    assert rid not in eng._requests
+    assert eng.cache.blocks_in_use == 0
+    assert not eng.scheduler.has_work()
+
+
+def test_serving_generate_returns_none_for_expired(_engine):
+    from paddle_tpu.serving import SamplingParams
+
+    eng = _engine
+    rng = np.random.RandomState(5)
+    prompts = [rng.randint(0, eng.cfg.vocab_size, (4,)).astype(np.int32)
+               for _ in range(2)]
+    outs = eng.generate(prompts, [
+        SamplingParams(max_new_tokens=2),
+        SamplingParams(max_new_tokens=2, deadline_s=0.0),
+    ])
+    assert outs[0] is not None and outs[0].shape == (6,)
+    assert outs[1] is None
+    assert eng.cache.blocks_in_use == 0
+
+
+# ---------------------------------------------------------------------------
+# preemption + subprocess acceptance tests
+# ---------------------------------------------------------------------------
+
+def _worker_env(**extra):
+    env = {k: v for k, v in os.environ.items() if k != "PTPU_FAULTS"}
+    env["PTPU_FORCE_PLATFORM"] = "cpu"
+    env.update(extra)
+    return env
+
+
+def test_preemption_handler_in_process():
+    h = PreemptionHandler(signals=(signal.SIGTERM,))
+    with h:
+        assert not h.triggered
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.monotonic() + 2
+        while not h.triggered and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert h.triggered
+        h.reset()
+        assert not h.triggered
+
+
+def test_kill9_during_save_then_resume(tmp_path):
+    """The headline acceptance: SIGKILL mid-checkpoint-write, then
+    restore_latest() resumes from the previous intact checkpoint with
+    verified checksums."""
+    ckpt = str(tmp_path / "ckpt")
+    # saves at steps 2,4,...; the step-4 save is SIGKILLed after the
+    # payload write, before the atomic rename
+    proc = subprocess.run(
+        [sys.executable, str(_WORKER), ckpt, "6"],
+        env=_worker_env(PTPU_FAULTS="ckpt_crash@step=4,hard=1"),
+        capture_output=True, text=True, timeout=240)
+    assert proc.returncode == -signal.SIGKILL, proc.stdout + proc.stderr
+    assert "STEP 4" in proc.stdout           # died saving, not training
+    # the crash left a tmp remnant and intact step_2
+    names = os.listdir(ckpt)
+    assert any(n.startswith(".tmp_") for n in names), names
+    assert "step_00000002" in names and "step_00000004" not in names
+    # in-process verified restore: checksums pass on the intact checkpoint
+    mgr = CheckpointManager(ckpt)
+    step, state = mgr.restore_latest()
+    assert step == 2 and any(k.startswith("model.") for k in state)
+    # relaunch WITHOUT the fault: resumes from step 2 and completes
+    proc2 = subprocess.run(
+        [sys.executable, str(_WORKER), ckpt, "6"],
+        env=_worker_env(), capture_output=True, text=True, timeout=240)
+    assert proc2.returncode == 0, proc2.stdout + proc2.stderr
+    assert "RESUMED 2" in proc2.stdout
+    assert "DONE 6" in proc2.stdout
+    final_loss = float(proc2.stdout.strip().splitlines()[-1].split()[-1])
+    assert np.isfinite(final_loss)
+
+
+def test_sigterm_checkpoints_and_resumes(tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    proc = subprocess.Popen(
+        [sys.executable, str(_WORKER), ckpt, "0", "--run-forever",
+         "--step-sleep", "0.05", "--save-every", "1000"],
+        env=_worker_env(), stdout=subprocess.PIPE, text=True)
+    saved_step = None
+    try:
+        # wait until it is mid-training, then preempt
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if line.startswith("STEP 2"):
+                break
+        else:
+            pytest.fail("worker never reached step 2")
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=120)
+        assert proc.returncode == 0
+        for line in out.splitlines():
+            if line.startswith("PREEMPT_SAVED"):
+                saved_step = int(line.split()[1])
+        assert saved_step is not None and saved_step >= 2
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    # resume run picks up exactly the preemption checkpoint
+    total = saved_step + 3
+    proc2 = subprocess.run(
+        [sys.executable, str(_WORKER), ckpt, str(total)],
+        env=_worker_env(), capture_output=True, text=True, timeout=240)
+    assert proc2.returncode == 0, proc2.stdout + proc2.stderr
+    assert f"RESUMED {saved_step}" in proc2.stdout
+    assert f"DONE {total}" in proc2.stdout
+
+
+# ---------------------------------------------------------------------------
+# monitor integration
+# ---------------------------------------------------------------------------
+
+def test_resilience_counters_in_monitor_snapshot(tmp_path):
+    """The acceptance pin: recovery events are OBSERVABLE — the
+    resilience/* series land in monitor.snapshot()."""
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=2)
+    mgr.save(1, _state(1.0))
+    mgr.restore_latest()
+    _run_guarded("nan_grad@step=2", steps=3, max_retries_per_step=1)
+    snap = monitor.snapshot()
+    for key in ("resilience/saves", "resilience/restores",
+                "resilience/skipped_steps", "resilience/retries",
+                "resilience/faults_injected"):
+        assert key in snap, f"missing {key} in monitor snapshot"
+    assert snap["resilience/saves"] >= 1
+    assert snap["resilience/restores"] >= 1

@@ -1,0 +1,129 @@
+"""Subprocess worker for tests/test_resilience.py: a tiny deterministic
+train loop with auto-resume checkpoints, the NaN StepGuard, and the
+SIGTERM preemption handler.
+
+Usage:
+    python resilience_train_worker.py CKPT_DIR MAX_STEPS [--save-every N]
+        [--step-sleep S] [--run-forever]
+
+Protocol (stdout lines the parent parses):
+    STEP <i> <loss>          — after every completed step
+    RESUMED <step>           — when a checkpoint was restored at startup
+    PREEMPT_SAVED <step>     — SIGTERM/SIGINT handled: saved + exiting 0
+    DONE <step> <loss>       — MAX_STEPS reached
+
+Fault injection rides PTPU_FAULTS from the parent's env (e.g.
+``ckpt_crash@step=4,hard=1`` SIGKILLs this process mid-save — the
+kill -9 acceptance test).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+os.environ.setdefault("PTPU_FORCE_PLATFORM", "cpu")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.resilience import (CheckpointManager, PreemptionHandler,
+                                   StepGuard)
+
+
+def build():
+    paddle.seed(1234)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    opt = optimizer.Adam(learning_rate=1e-2, parameters=model.parameters())
+    return model, opt
+
+
+def state_of(model, opt):
+    state = {f"model.{n}": p for n, p in model.named_parameters()}
+    for k, v in opt.state_dict().items():
+        if k in ("LR_Scheduler",):
+            continue
+        if k == "@step":
+            state["opt.@step"] = np.asarray([int(v)], np.int64)
+        else:
+            state[f"opt.{k}"] = v
+    return state
+
+
+def load_into(state, model, opt):
+    pmap = dict(model.named_parameters())
+    opt_state = {}
+    for k, v in state.items():
+        if k.startswith("model."):
+            pmap[k[len("model."):]]._data = v._data
+        elif k == "opt.@step":
+            opt_state["@step"] = int(np.asarray(v._data).ravel()[0])
+        elif k.startswith("opt."):
+            opt_state[k[len("opt."):]] = v
+    opt.set_state_dict(opt_state)
+
+
+def main():
+    ckpt_dir = sys.argv[1]
+    max_steps = int(sys.argv[2])
+    args = sys.argv[3:]
+
+    def opt_arg(name, default):
+        return type(default)(args[args.index(name) + 1]) \
+            if name in args else default
+
+    save_every = opt_arg("--save-every", 2)
+    step_sleep = opt_arg("--step-sleep", 0.0)
+    run_forever = "--run-forever" in args
+
+    model, opt = build()
+    mgr = CheckpointManager(ckpt_dir, keep_last_n=3)
+    handler = PreemptionHandler().install()
+    guard = StepGuard(model=model, optimizer=opt, max_retries_per_step=1)
+
+    start = 0
+    got = mgr.restore_latest()
+    if got is not None:
+        step0, state = got
+        load_into(state, model, opt)
+        start = step0
+        print(f"RESUMED {step0}", flush=True)
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 8).astype("float32")
+    Y = rng.randn(64, 1).astype("float32")
+
+    i = start
+    loss_val = float("nan")
+    while run_forever or i < max_steps:
+        i += 1
+        lo = (i * 8) % 56
+        xb, yb = paddle.to_tensor(X[lo:lo + 8]), paddle.to_tensor(Y[lo:lo + 8])
+
+        def step():
+            loss = ((model(xb) - yb) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        res, info = guard.step(step)
+        loss_val = float(res.numpy())
+        print(f"STEP {i} {loss_val:.6f}", flush=True)
+        if handler.triggered:
+            mgr.save(i, state_of(model, opt))
+            print(f"PREEMPT_SAVED {i}", flush=True)
+            sys.exit(0)
+        if i % save_every == 0:
+            mgr.save(i, state_of(model, opt))
+        if step_sleep:
+            import time
+
+            time.sleep(step_sleep)
+    print(f"DONE {i} {loss_val:.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
