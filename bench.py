@@ -343,6 +343,52 @@ def bench_decode():
                  batch * new / dt, "tokens/sec", baseline)
 
 
+def bench_lowbit_kv_decode():
+    """paddle_tpu.lowbit KV wing: paged-serving decode throughput with an
+    int8-quantized KV cache vs the fp pool, plus the capacity win
+    (blocks-per-pool at the same byte budget — the quantized pool must
+    hold ≥1.9× the blocks).  Baseline for the headline tokens/s metric is
+    the SAME engine with full-precision KV, so vs_baseline ≈ 1.0 means
+    quantized decode is free and the capacity win is pure profit."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM, gpt_test_config, \
+        gpt2_124m_config
+    from paddle_tpu.serving import EngineConfig, LLMEngine, SamplingParams
+
+    on_tpu = _on_tpu()
+    cfg = (gpt2_124m_config(stacked_blocks=True) if on_tpu
+           else gpt_test_config(stacked_blocks=True,
+                                sequence_parallel=False))
+    batch, prompt, new = (8, 128, 128) if on_tpu else (4, 8, 16)
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    if on_tpu:
+        model.bfloat16()
+    model.eval()
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, cfg.vocab_size, (prompt,)).astype("int32")
+               for _ in range(batch)]
+    sp = SamplingParams(max_new_tokens=new)
+
+    def tps(kv_dtype):
+        eng = LLMEngine(model, EngineConfig(
+            block_size=16, max_num_seqs=batch, kv_cache_dtype=kv_dtype))
+        eng.generate(prompts, sp)          # warmup: compiles every bucket
+        t0 = time.perf_counter()
+        eng.generate(prompts, sp)
+        dt = time.perf_counter() - t0
+        return batch * new / dt, eng.cache
+
+    fp_tps, fp_cache = tps(None)
+    q_tps, q_cache = tps("int8")
+    _emit("serving_kv_int8_blocks_per_pool",
+          q_cache.num_blocks / fp_cache.num_blocks, "x blocks (same bytes)",
+          1.0)
+    suffix = "" if on_tpu else "_cpu_smoke"
+    return _emit(f"serving_kv_int8_decode_tokens_per_sec{suffix}",
+                 q_tps, "tokens/sec", fp_tps)
+
+
 def bench_hybrid8_memfit():
     """BASELINE.md config 5 AXIS-MIX capacity check (sharding2 x pp2 x
     mp2 = 8 devices) at GPT-3 1.3B shapes: compile the full-shape hybrid
@@ -456,6 +502,7 @@ LADDER = {
     "bert_base": bench_bert_base,
     "gpt3_1p3b": bench_gpt3_1p3b,
     "gpt124m_decode": bench_decode,
+    "lowbit_kv_decode": bench_lowbit_kv_decode,
     "hybrid8_memfit": bench_hybrid8_memfit,
 }
 

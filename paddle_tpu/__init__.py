@@ -84,6 +84,7 @@ from . import fft  # noqa: E402
 from . import signal  # noqa: E402
 from . import sparse  # noqa: E402
 from . import quantization  # noqa: E402
+from . import lowbit  # noqa: E402
 from . import geometric  # noqa: E402
 from . import text  # noqa: E402
 from . import audio  # noqa: E402

@@ -183,7 +183,17 @@ _REDUCE_FNS = {
 }
 
 
-def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
+               compress=None, error_feedback=None):
+    """compress="int8": EQuARX-style quantized all-reduce (lowbit.comm) —
+    int8 codes + shared per-chunk scales on the wire, int32 reduction,
+    SUM/AVG only.  `error_feedback`: optional same-shape Tensor buffer
+    whose contents are added pre-quantization and replaced with the new
+    local rounding residual (thread it across steps and the quantization
+    noise becomes delayed instead of lost)."""
+    if compress is not None:
+        return _all_reduce_compressed(tensor, op, group, compress,
+                                      error_feedback)
     axis = _axis_for(group)
     if axis is not None:
         _count_collective("all_reduce", tensor)
@@ -202,9 +212,68 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     )
 
 
-def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+def _all_reduce_compressed(tensor, op, group, compress, error_feedback):
+    from ..lowbit.comm import quantized_all_reduce_arrays
+
+    if compress != "int8":
+        raise ValueError(f'compress must be None or "int8", got {compress!r}')
+    if op not in (ReduceOp.SUM, ReduceOp.AVG):
+        raise ValueError(
+            "compressed all_reduce supports SUM/AVG only (MAX/MIN/PROD "
+            "are not linear in the codes)")
+    axis = _axis_for(group)
+    if axis is None:
+        if _world(group) == 1:
+            return tensor          # trivial group: identity, nothing on
+        #                            the wire to compress
+        raise RuntimeError(
+            "eager cross-host all_reduce outside an SPMD region is not "
+            "supported on TPU — run inside paddle_tpu.parallel or a "
+            "compiled step")
+    _count_collective("all_reduce", tensor)
+    res_in = error_feedback._data if error_feedback is not None else None
+
+    def fn(a):
+        out, new_res = quantized_all_reduce_arrays(
+            a, axis, residual=res_in, average=(op == ReduceOp.AVG))
+        return out if new_res is None else (out, new_res)
+
+    if error_feedback is not None:
+        out, new_res = apply(fn, tensor, n_outs=2,
+                             name="all_reduce_int8")
+        error_feedback._data = new_res._data
+    else:
+        out = apply(fn, tensor, name="all_reduce_int8")
+    tensor._data = out._data
+    tensor._grad_node = out._grad_node
+    tensor._out_index = out._out_index
+    tensor.stop_gradient = tensor.stop_gradient and out.stop_gradient
+    return tensor
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0,
+               compress=None):
+    # validate BEFORE the axis check — a bad compress value must be loud
+    # in single-process runs too, not only once a mesh is live
+    if compress not in (None, "int8"):
+        raise ValueError(
+            f'compress must be None or "int8", got {compress!r}')
     ax = _axis_for(group)
     if ax is not None:
+        if compress is not None:
+            from ..lowbit.comm import quantized_all_gather_arrays
+
+            _count_collective("all_gather", tensor)
+            out = apply(
+                lambda a: quantized_all_gather_arrays(a, ax), tensor,
+                name="all_gather_int8")
+            from ..ops.manipulation import unbind
+
+            parts = unbind(out, 0)
+            if isinstance(tensor_list, list):
+                tensor_list.clear()
+                tensor_list.extend(parts)
+            return parts
         _count_collective("all_gather", tensor)
         out = apply(
             lambda a: jax.lax.all_gather(a, ax, tiled=False), tensor, name="all_gather"

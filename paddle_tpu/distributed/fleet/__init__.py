@@ -19,6 +19,7 @@ from . import elastic
 from . import meta_optimizers
 from .meta_optimizers import (
     GradientMergeOptimizer, LocalSGDOptimizer, DGCMomentumOptimizer,
+    QuantAllReduceOptimizer,
 )
 from .elastic import ElasticManager, ElasticStatus
 from .meta_parallel import (

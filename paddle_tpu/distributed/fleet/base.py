@@ -40,6 +40,11 @@ class DistributedStrategy:
         self.lars = False
         self.dgc = False
         self.localsgd = False
+        # EQuARX-style int8 gradient all-reduce on the manual-DP sync path
+        # (paddle_tpu.lowbit.comm; meta_optimizers.QuantAllReduceOptimizer)
+        self.int8_allreduce = False
+        self.int8_allreduce_configs: Dict = {"error_feedback": True,
+                                             "chunk": 256}
         self.heter_ccl_mode = False
         self.find_unused_parameters = False
         self.fuse_grad_size_in_MB = 32

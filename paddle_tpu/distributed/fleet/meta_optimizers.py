@@ -31,7 +31,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["GradientMergeOptimizer", "LocalSGDOptimizer",
-           "DGCMomentumOptimizer", "apply_strategy"]
+           "DGCMomentumOptimizer", "QuantAllReduceOptimizer",
+           "apply_strategy"]
 
 _COUNTER_KEY = "@meta_counter"
 
@@ -292,6 +293,66 @@ class DGCMomentumOptimizer(_MetaOptimizer):
             p.grad = g
 
 
+class QuantAllReduceOptimizer(_MetaOptimizer):
+    """EQuARX-style int8 gradient all-reduce (paddle_tpu.lowbit.comm) on
+    the manual-DP sync path: inside a live mesh axis (axis_scope /
+    shard_map over 'dp') each parameter's gradient is quantized to int8
+    with shared per-chunk scales, pmean-reduced exactly in int32, and
+    dequantized before the inner optimizer's update — 4× less gradient
+    traffic on the wire.  The per-chunk rounding residual lives in an
+    error-feedback slot (``qar_residual``) that re-enters the next step's
+    quantization, so the noise is delayed, not lost (same convergence
+    argument as DGC's V buffer).
+
+    Under single-program GSPMD data parallelism (no manual axis) the
+    gradients are already globally averaged by XLA — the wrapper is an
+    exact no-op there, like LocalSGDOptimizer."""
+
+    def __init__(self, inner, error_feedback: bool = True,
+                 chunk: int = 256, bits: int = 8):
+        super().__init__(inner)
+        self._meta_ef = bool(error_feedback)
+        self._meta_chunk = int(chunk)
+        self._meta_bits = int(bits)
+
+    def _meta_slots_for(self, slot, p):
+        if self._meta_ef and "qar_residual" not in slot:
+            slot["qar_residual"] = jnp.zeros(p.shape, jnp.float32)
+
+    def step(self):
+        from ..collective import _current_axis
+        from ...lowbit.comm import quantized_all_reduce_arrays
+
+        inner = self._inner
+        axis = _current_axis()
+        if axis is None:
+            # GSPMD single-program DP: grads arrive pre-averaged
+            inner.step()
+            return
+        from ...core.tensor import Tensor
+
+        params = [p for p in inner._parameter_list
+                  if p.grad is not None and p.trainable]
+        saved = [p.grad for p in params]
+        feedback = []
+        for p in params:
+            slot = self._ensure_state(p)
+            res = slot.get("qar_residual")
+            g, new_res = quantized_all_reduce_arrays(
+                p.grad._data, axis, bits=self._meta_bits,
+                chunk=self._meta_chunk, residual=res, average=True)
+            if res is not None:
+                slot["qar_residual"] = new_res
+            feedback.append(slot.get("qar_residual"))
+            p.grad = Tensor(g)
+        inner.step()
+        # inner.step may rebuild slot dicts — re-attach the EF buffers
+        for p, g, res in zip(params, saved, feedback):
+            if res is not None:
+                inner._states[id(p)]["qar_residual"] = res
+            p.grad = g
+
+
 def apply_strategy(optimizer, strategy):
     """Wrap `optimizer` per DistributedStrategy flags — the TPU analog of
     the reference's StrategyCompiler meta-optimizer composition
@@ -315,4 +376,11 @@ def apply_strategy(optimizer, strategy):
         cfg = getattr(strategy, "localsgd_configs", {}) or {}
         optimizer = LocalSGDOptimizer(optimizer,
                                       k_steps=cfg.get("k_steps", 1))
+    if getattr(strategy, "int8_allreduce", False):
+        cfg = getattr(strategy, "int8_allreduce_configs", {}) or {}
+        # outermost: the quantized grad sync must run before any inner
+        # meta-optimizer consumes the (now globally averaged) gradients
+        optimizer = QuantAllReduceOptimizer(
+            optimizer, error_feedback=cfg.get("error_feedback", True),
+            chunk=cfg.get("chunk", 256))
     return optimizer

@@ -1,0 +1,193 @@
+"""Weight-only quantized inference: swap `nn.Linear` → `WeightOnlyLinear`.
+
+The quantization kit's QAT/PTQ paths are *fake*-quant — every tensor
+stays fp32, nothing shrinks.  This module is the real thing: weights are
+STORED as int8 (or int4, packed two-per-byte) with per-channel float32
+scales, and the matmul dequantizes in-kernel with fp32 accumulation
+(`ops.lowbit.quantized_matmul_arrays`).  Activations stay in the model
+dtype — weight-only is the serving sweet spot (decode is weight-bandwidth
+bound; halving/quartering weight bytes is a direct tokens/s and
+HBM-capacity win, PAPERS.md low-bit serving line).
+
+Accuracy: per-channel abs-max int8 is near-lossless on trained linears
+(each output channel gets its own dynamic range); int4 costs real
+precision and is for capacity emergencies — tests/test_lowbit.py pins
+both tolerance envelopes.
+"""
+from __future__ import annotations
+
+import copy
+import warnings
+
+import jax.numpy as jnp
+
+from .. import monitor
+from ..core.tensor import Tensor
+from ..core.dispatch import apply
+from ..nn.layer import Layer
+from ..nn.common import Linear
+from ..ops.lowbit import (pack_int4_arrays, qmax_for_bits,
+                          quantize_absmax_arrays, quantize_with_scale_arrays,
+                          quantized_bytes, quantized_matmul_arrays)
+
+__all__ = ["WeightOnlyLinear", "quantize_for_inference"]
+
+_BITS = {"int8": 8, "int4": 4}
+
+
+class WeightOnlyLinear(Layer):
+    """Inference-only Linear over packed low-bit weights.
+
+    Storage (registered buffers, so state_dict round-trips them):
+
+    - ``qweight`` — int8 [in, out] codes, or uint8 [ceil(in/2), out]
+      packed nibbles for int4;
+    - ``scale``  — float32 [out] per-channel (or scalar per-tensor);
+    - ``bias``   — the original bias, untouched.
+
+    Forward = ``(x @ q) * scale + b`` with fp32 accumulation; gradients
+    are not defined through the integer weight (inference only — wrap
+    QAT around the fp original if you need to train).
+    """
+
+    def __init__(self, in_features, out_features, weight_dtype="int8",
+                 per_channel=True):
+        super().__init__()
+        if weight_dtype not in _BITS:
+            raise ValueError(
+                f"weight_dtype must be one of {sorted(_BITS)}, got "
+                f"{weight_dtype!r}")
+        self.in_features = int(in_features)
+        self.out_features = int(out_features)
+        self.weight_dtype = weight_dtype
+        self.bits = _BITS[weight_dtype]
+        self.per_channel = bool(per_channel)
+        rows = (self.in_features + 1) // 2 if self.bits == 4 \
+            else self.in_features
+        cdtype = jnp.uint8 if self.bits == 4 else jnp.int8
+        self.register_buffer(
+            "qweight", Tensor(jnp.zeros((rows, self.out_features), cdtype)))
+        scale_shape = (self.out_features,) if per_channel else ()
+        self.register_buffer(
+            "scale", Tensor(jnp.zeros(scale_shape, jnp.float32)))
+        self.bias = None
+
+    @classmethod
+    def from_linear(cls, layer, weight_dtype="int8",
+                    per_channel=True, scale=None):
+        """Quantize a linear-shaped layer's live weight (anything holding
+        a [in, out] `weight` and optional `bias` — nn.Linear, or the mp
+        layers at degree 1).  `scale` overrides the abs-max-derived scale
+        (QAT/PTQ convert passes the calibrated quanter scale through
+        here — already in dequant-ready ``absmax/qmax`` form)."""
+        in_features, out_features = layer.weight.shape
+        m = cls(in_features, out_features,
+                weight_dtype=weight_dtype, per_channel=per_channel)
+        w = layer.weight._data
+        if scale is not None:
+            s = jnp.asarray(scale, jnp.float32)
+            q = quantize_with_scale_arrays(w, s, qmax_for_bits(m.bits))
+        else:
+            q, s = quantize_absmax_arrays(w, bits=m.bits,
+                                          axis=0 if per_channel else None)
+        if m.bits == 4:
+            q = pack_int4_arrays(q)
+        m.qweight._data = q
+        m.scale._data = jnp.broadcast_to(
+            s, m.scale.shape if m.per_channel else ()).astype(jnp.float32)
+        if layer.bias is not None:
+            m.bias = layer.bias
+        return m
+
+    def forward(self, x):
+        args = (x, self.qweight, self.scale)
+        if self.bias is not None:
+            return apply(
+                lambda a, q, s, b: quantized_matmul_arrays(
+                    a, q, s, bits=self.bits,
+                    in_features=self.in_features) + b,
+                *args, self.bias, name="weight_only_linear")
+        return apply(
+            lambda a, q, s: quantized_matmul_arrays(
+                a, q, s, bits=self.bits, in_features=self.in_features),
+            *args, name="weight_only_linear")
+
+    # -- accounting ---------------------------------------------------------
+
+    @property
+    def packed_bytes(self) -> int:
+        return quantized_bytes((self.in_features, self.out_features),
+                               self.bits, self.scale._data.size)
+
+    @property
+    def dense_bytes(self) -> int:
+        return self.in_features * self.out_features * 4
+
+    def extra_repr(self):
+        return (f"in_features={self.in_features}, "
+                f"out_features={self.out_features}, "
+                f"weight_dtype={self.weight_dtype}, "
+                f"per_channel={self.per_channel}")
+
+
+def quantize_for_inference(model, weight_dtype="int8", per_channel=True,
+                           inplace=False):
+    """Swap every `nn.Linear` in `model` for a `WeightOnlyLinear` holding
+    packed low-bit codes of its current weight.  Returns the (copied
+    unless `inplace`) model in eval mode.
+
+    Emits ``lowbit/bytes_saved{wing=weights}`` (fp32 bytes − packed
+    bytes) and ``lowbit/weight_layers`` to the monitor.
+    """
+    if weight_dtype not in _BITS:
+        raise ValueError(
+            f"weight_dtype must be one of {sorted(_BITS)}, got "
+            f"{weight_dtype!r}")
+    if not inplace:
+        model = copy.deepcopy(model)
+    saved = [0]
+    swapped = [0]
+
+    def _quantable(sub):
+        if isinstance(sub, Linear):
+            return True
+        # the tensor-parallel linears are plain y = xW (+ b) when the
+        # 'mp' axis has degree 1 (their sharding constraints are
+        # identities) — the common serving shape.  At real mp degree the
+        # sharded weight layout is NOT weight-only-quantizable here.
+        from ..parallel.mesh import axis_size
+        from ..parallel.mp_layers import (ColumnParallelLinear,
+                                          RowParallelLinear)
+
+        if isinstance(sub, (ColumnParallelLinear, RowParallelLinear)):
+            if axis_size("mp") == 1:
+                return True
+            warnings.warn(
+                f"quantize_for_inference: skipping {type(sub).__name__} — "
+                "weight-only quantization of mp-sharded weights is not "
+                "supported (mp degree > 1)")
+        return False
+
+    def _swap(layer):
+        for name, sub in list(layer._sub_layers.items()):
+            if _quantable(sub):
+                wol = WeightOnlyLinear.from_linear(
+                    sub, weight_dtype=weight_dtype, per_channel=per_channel)
+                # setattr, not a bare _sub_layers[name] write: Layer's
+                # __setattr__ mirrors sublayers into __dict__, and a
+                # forward that says `self.fc` reads THAT copy
+                setattr(layer, name, wol)
+                saved[0] += wol.dense_bytes - wol.packed_bytes
+                swapped[0] += 1
+            else:
+                _swap(sub)
+
+    _swap(model)
+    if monitor.enabled():
+        monitor.counter("lowbit/bytes_saved",
+                        "storage bytes removed by low-bit packing").labels(
+            wing="weights").add(saved[0])
+        monitor.counter("lowbit/weight_layers",
+                        "Linears swapped to WeightOnlyLinear").add(swapped[0])
+    model.eval()
+    return model

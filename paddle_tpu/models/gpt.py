@@ -267,9 +267,12 @@ class GPTMLP(Layer):
 
         # single-shard fast path: the row-blocked fused kernel keeps the
         # [tokens, I] intermediate out of HBM; TP-sharded weights (mp>1)
-        # stay on the GSPMD matmul path
+        # and quantized projections (lowbit WeightOnlyLinear carries
+        # packed codes, no fp `.weight`) stay on the layer-forward path
         b2 = self.fc_out.bias
-        if _axis_size("mp") == 1 and b2 is not None:
+        if _axis_size("mp") == 1 and b2 is not None \
+                and getattr(self.fc_in, "weight", None) is not None \
+                and getattr(self.fc_out, "weight", None) is not None:
             y = maybe_fused_ffn(x, self.fc_in.weight, self.fc_in.bias,
                                 self.fc_out.weight, "gelu_tanh")
             if y is not None:
@@ -849,6 +852,20 @@ class GPTForCausalLM(Layer):
         self.cfg = cfg
         self.gpt = GPTModel(cfg)
         self._gen_step = None       # (shapes key, jitted fn) decode cache
+
+    def __deepcopy__(self, memo):
+        # the decode cache's jitted closure captures SELF — a deepcopy
+        # carrying it would silently generate with the ORIGINAL model's
+        # weights/state names (bites every copy-then-modify flow:
+        # quantization swaps, lowbit packing, ensembling)
+        import copy as _copy
+
+        new = self.__class__.__new__(self.__class__)
+        memo[id(self)] = new
+        for k, v in self.__dict__.items():
+            new.__dict__[k] = None if k == "_gen_step" \
+                else _copy.deepcopy(v, memo)
+        return new
 
     def forward(self, input_ids, position_ids=None, caches=None,
                 time_step=None, segment_ids=None, cache_mask=None):

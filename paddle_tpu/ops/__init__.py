@@ -25,6 +25,9 @@ from . import array, creation, math, manipulation, logic, extras
 # array-level only, deliberately NOT star-exported into the top-level
 # paddle namespace (it is an engine primitive, not a user tensor op)
 from . import paged_attention  # noqa: F401
+# low-bit quantized storage/compute primitives (paddle_tpu.lowbit's op
+# layer) — array-level only, same non-export rationale as paged_attention
+from . import lowbit  # noqa: F401
 
 __all__ = (
     list(creation.__all__)

@@ -1,0 +1,158 @@
+"""Low-bit storage/compute primitives (array level) — the op layer under
+`paddle_tpu.lowbit` (EQuARX + low-bit KV serving, PAPERS.md: int8 is the
+"free" compression point on TPU — MXU-native matmuls, halved HBM/ICI
+bytes, negligible accuracy loss with abs-max scaling).
+
+Conventions (all functions are jnp-level, jit-safe, no Tensor wrapper):
+
+- **symmetric abs-max quantization**: ``q = clip(round(x / scale), -qmax,
+  qmax)`` with ``scale = absmax / qmax`` so ``dequant(q) = q * scale``.
+  (Note this differs from `paddle_tpu.quantization`'s fake-quant, which
+  keeps ``scale = absmax`` and divides by qmax at use — the lowbit layout
+  stores the *ready-to-multiply* scale because the scale tensor is
+  persistent runtime state, not a trace-transient.)
+- **int4 packing**: two 4-bit codes per int8 byte along the FIRST axis
+  (the reduction axis of a [in, out] weight), low nibble = even row.
+  Odd first dims are zero-padded; the unpack takes the true row count.
+- **fp32 accumulation**: `quantized_matmul_arrays` contracts in float32
+  (`preferred_element_type`) and applies the per-out-channel scale AFTER
+  the contraction — algebraically identical to dequantize-then-matmul
+  (scale is constant along the contraction), one multiply per output
+  instead of one per weight.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import monitor
+
+__all__ = [
+    "qmax_for_bits", "quantize_absmax_arrays", "quantize_with_scale_arrays",
+    "dequantize_arrays", "pack_int4_arrays", "unpack_int4_arrays",
+    "quantized_matmul_arrays",
+]
+
+
+def qmax_for_bits(bits: int) -> int:
+    if bits not in (4, 8):
+        raise ValueError(f"lowbit supports 4- and 8-bit codes, got {bits}")
+    return 2 ** (bits - 1) - 1
+
+
+def _count(name, **labels):
+    """Per-trace telemetry (shape metadata only — safe on tracers)."""
+    if monitor.enabled():
+        c = monitor.counter(f"lowbit/{name}")
+        (c.labels(**labels) if labels else c).inc()
+
+
+def quantize_with_scale_arrays(x, scale, qmax):
+    """``clip(round(x / scale), ±qmax)`` as int8 codes, with the shared
+    zero-scale guard: scale 0 (an all-zero input) yields all-zero codes,
+    so dequant is an exact 0 and callers only ever MULTIPLY by the stored
+    scale.  Single source of truth for the rounding convention — every
+    wing (weights, KV pool, collectives) quantizes through here."""
+    x = jnp.asarray(x)
+    scale = jnp.asarray(scale, jnp.float32)
+    q = jnp.where(scale > 0, jnp.round(x / jnp.where(scale > 0, scale, 1.0)),
+                  0.0)
+    return jnp.clip(q, -qmax, qmax).astype(jnp.int8)
+
+
+def quantize_absmax_arrays(x, bits=8, axis=None):
+    """Symmetric abs-max quantization → (codes int8, scale float32).
+
+    axis: reduction axis/axes of the abs-max — e.g. axis=0 on an
+    [in, out] weight gives one scale per OUTPUT channel (shape [out]).
+    axis=None → one scalar scale (per-tensor).
+    Zero inputs get scale 0 and all-zero codes (dequant is exact 0).
+    """
+    qmax = qmax_for_bits(bits)
+    x = jnp.asarray(x)
+    amax = jnp.max(jnp.abs(x), axis=axis, keepdims=axis is not None)
+    scale = amax.astype(jnp.float32) / qmax
+    q = quantize_with_scale_arrays(x, scale, qmax)
+    if axis is not None:
+        scale = jnp.squeeze(scale, axis=axis)
+    return q, scale
+
+
+def dequantize_arrays(q, scale, axis=None):
+    """``q * scale`` in float32.  `axis`: the axis the per-channel scale
+    runs along (so it broadcasts against q); None = scalar/pre-broadcast
+    scale."""
+    _count("dequant_calls", site="dequantize")
+    q = jnp.asarray(q).astype(jnp.float32)
+    scale = jnp.asarray(scale, jnp.float32)
+    if axis is not None and scale.ndim:
+        shape = [1] * q.ndim
+        shape[axis] = scale.shape[0]
+        scale = scale.reshape(shape)
+    return q * scale
+
+
+def pack_int4_arrays(q):
+    """Pack int8 codes in [-7, 7] two-per-byte along axis 0.
+
+    q: [n, ...] int8.  Returns uint8 [ceil(n/2), ...]: low nibble = row
+    2i, high nibble = row 2i+1 (two's-complement nibbles).  Odd n is
+    zero-padded — pass the true n to `unpack_int4_arrays`.
+    """
+    q = jnp.asarray(q, jnp.int8)
+    n = q.shape[0]
+    if n % 2:
+        pad = [(0, 1)] + [(0, 0)] * (q.ndim - 1)
+        q = jnp.pad(q, pad)
+    u = q.astype(jnp.uint8) & 0xF
+    return u[0::2] | (u[1::2] << 4)
+
+
+def unpack_int4_arrays(packed, rows):
+    """Inverse of `pack_int4_arrays`: uint8 [ceil(rows/2), ...] → int8
+    [rows, ...] with nibble sign-extension."""
+    packed = jnp.asarray(packed, jnp.uint8)
+    lo = (packed & 0xF).astype(jnp.int8)
+    hi = (packed >> 4).astype(jnp.int8)
+    # sign-extend 4-bit two's complement
+    lo = jnp.where(lo >= 8, lo - 16, lo)
+    hi = jnp.where(hi >= 8, hi - 16, hi)
+    inter = jnp.stack([lo, hi], axis=1)             # [n2, 2, ...]
+    out = inter.reshape((-1,) + tuple(packed.shape[1:]))
+    return out[:rows]
+
+
+def quantized_matmul_arrays(x, qweight, scale, bits=8, in_features=None):
+    """``x @ dequant(qweight)`` with in-kernel dequant and fp32 accumulate.
+
+    x:        [..., in] activations (any float dtype; contraction runs in
+              float32 via preferred_element_type).
+    qweight:  int8 [in, out] codes, or packed uint8 [ceil(in/2), out] when
+              bits=4 (pass `in_features`).
+    scale:    float32 [out] per-output-channel (or scalar per-tensor) —
+              applied AFTER the contraction: (x @ q) * scale ==
+              x @ (q * scale) exactly in real arithmetic because scale is
+              constant along the contracted axis.
+    Returns [..., out] in x's dtype.
+    """
+    _count("dequant_calls", site="matmul")
+    x = jnp.asarray(x)
+    if bits == 4:
+        rows = int(in_features if in_features is not None else x.shape[-1])
+        q = unpack_int4_arrays(qweight, rows)
+    elif bits == 8:
+        q = jnp.asarray(qweight, jnp.int8)
+    else:
+        raise ValueError(f"bits must be 4 or 8, got {bits}")
+    acc = jnp.matmul(x, q.astype(x.dtype),
+                     preferred_element_type=jnp.float32)
+    out = acc * jnp.asarray(scale, jnp.float32)
+    return out.astype(x.dtype)
+
+
+def quantized_bytes(shape, bits, scale_elems):
+    """Storage bytes of a quantized tensor: packed codes + f32 scales."""
+    n = int(np.prod(shape))
+    code_bytes = n if bits == 8 else (n + 1) // 2
+    return code_bytes + 4 * int(scale_elems)

@@ -35,7 +35,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["paged_attention_arrays", "paged_cache_update_arrays",
-           "paged_gather_kv_arrays", "slot_mapping"]
+           "paged_gather_kv_arrays", "slot_mapping",
+           "quantized_cache_update_arrays", "quantized_gather_kv_arrays"]
 
 _NEG_INF = -1e30
 
@@ -95,8 +96,81 @@ def paged_gather_kv_arrays(blocks, block_table):
     return g.reshape((b, maxb * bs) + tuple(feat))
 
 
+def quantized_cache_update_arrays(blocks, scales, rows, slots, qmax=127):
+    """Scatter new K (or V) rows into an int8 paged pool with
+    per-block-per-head abs-max scales (the `lowbit` KV wing).
+
+    blocks: int8 [num_blocks, block_size, H, D] codes
+    scales: f32  [num_blocks, H] — ``value = code * scale``
+    rows:   [B, S, H, D] float K/V rows to write
+    slots:  [B, S] int32 physical slots; out-of-range (padding) entries
+            are dropped exactly like `paged_cache_update_arrays`.
+
+    A block's scale only ever GROWS (amax of everything written since the
+    block was taken — the allocator resets scales on reallocation).  When
+    an incoming row raises a block's amax, that block's existing codes
+    are rescaled ``round(q · old/new)`` — one extra rounding, bounded by
+    half an int8 step at the new scale.  When the scale is unchanged the
+    rescale factor is exactly 1.0 and the codes pass through bit-stable
+    (int8→f32→round is exact), which is what keeps steady-state decode
+    deterministic.
+
+    Returns (blocks', scales').
+    """
+    nb, bs = blocks.shape[0], blocks.shape[1]
+    h = blocks.shape[2]
+    flat_slots = jnp.asarray(slots, jnp.int32).reshape(-1)
+    block_ids = flat_slots // bs                     # invalid slots → nb
+    rows_flat = rows.reshape(-1, h, blocks.shape[3])
+    # per-(block, head) abs-max of the incoming rows; the extra row nb
+    # swallows padding/invalid writes and is sliced off
+    row_amax = jnp.max(jnp.abs(rows_flat.astype(jnp.float32)), axis=-1)
+    cand = jnp.zeros((nb + 1, h), jnp.float32).at[
+        jnp.clip(block_ids, 0, nb)].max(row_amax)[:nb]
+    new_scales = jnp.maximum(scales, cand / qmax)
+    factor = jnp.where(new_scales > 0, scales / jnp.where(
+        new_scales > 0, new_scales, 1.0), 1.0)
+    # rescale ONLY the written blocks (the only ones whose scale can have
+    # changed): gather → rescale → scatter back at block granularity.
+    # Keeps the update O(written tokens), not O(pool) — the fp path's
+    # scatter shape — so XLA mutates the donated pool in place.
+    # Duplicate ids (a prefill chunk filling one block) scatter identical
+    # values; invalid ids (nb) gather clipped garbage that the
+    # mode="drop" scatter discards.
+    gid = jnp.clip(block_ids, 0, nb - 1)
+    gfactor = factor[gid]                            # [N, H]
+    rescaled = jnp.clip(
+        jnp.round(blocks[gid].astype(jnp.float32)
+                  * gfactor[:, None, :, None]),
+        -qmax, qmax).astype(jnp.int8)                # [N, bs, H, D]
+    q = blocks.at[block_ids].set(rescaled, mode="drop")
+    # quantize the incoming rows against their block's (new) scale
+    wsc = jnp.concatenate([new_scales,
+                           jnp.ones((1, h), jnp.float32)], axis=0)[
+        jnp.clip(block_ids, 0, nb)]                  # [(B*S), H]
+    wsc = jnp.where(wsc > 0, wsc, 1.0)[:, :, None]
+    q_rows = jnp.clip(jnp.round(rows_flat.astype(jnp.float32) / wsc),
+                      -qmax, qmax).astype(jnp.int8)
+    flat = q.reshape(nb * bs, h, blocks.shape[3])
+    flat = flat.at[flat_slots].set(q_rows, mode="drop")
+    return flat.reshape(blocks.shape), new_scales
+
+
+def quantized_gather_kv_arrays(blocks, scales, block_table):
+    """Dequantizing gather: the int8 analog of `paged_gather_kv_arrays`,
+    returning float32 [B, max_blocks * block_size, H, D] =
+    ``codes * per-block-per-head scale``."""
+    nb, bs = blocks.shape[0], blocks.shape[1]
+    tbl = jnp.clip(jnp.asarray(block_table, jnp.int32), 0, nb - 1)
+    g = jnp.take(blocks, tbl, axis=0)                # [B, maxb, bs, H, D]
+    s = jnp.take(scales, tbl, axis=0)                # [B, maxb, H]
+    deq = g.astype(jnp.float32) * s[:, :, None, :, None]
+    b, maxb = tbl.shape
+    return deq.reshape((b, maxb * bs) + tuple(blocks.shape[2:]))
+
+
 def paged_attention_arrays(q, k_blocks, v_blocks, block_table, pos0,
-                           scale=None):
+                           scale=None, k_scales=None, v_scales=None):
     """Causal attention of a (ragged) batch against its paged KV cache.
 
     q:            [B, S, H, D] — S=1 at decode, >1 for a prefill chunk
@@ -112,11 +186,21 @@ def paged_attention_arrays(q, k_blocks, v_blocks, block_table, pos0,
     the same additive -1e30 mask + fp32-softmax arithmetic as
     `cached_attention_arrays`, with a per-ROW position instead of its
     scalar `t` (that is the whole ragged-batch generalization).
+
+    k_scales/v_scales: pass the [num_blocks, H] per-block-per-head scale
+    pools to read int8-quantized K/V blocks (the lowbit KV wing) — the
+    gather dequantizes, the attention arithmetic is unchanged.
     """
     b, s, h, d = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
-    kg = paged_gather_kv_arrays(k_blocks, block_table)     # [B, S_pad, H, D]
-    vg = paged_gather_kv_arrays(v_blocks, block_table)
+    if k_scales is not None:
+        # lowbit path: int8 pools + per-block-per-head scales dequantize
+        # inside the gather; the attention arithmetic below is unchanged
+        kg = quantized_gather_kv_arrays(k_blocks, k_scales, block_table)
+        vg = quantized_gather_kv_arrays(v_blocks, v_scales, block_table)
+    else:
+        kg = paged_gather_kv_arrays(k_blocks, block_table)  # [B, S_pad, H, D]
+        vg = paged_gather_kv_arrays(v_blocks, block_table)
     s_pad = kg.shape[1]
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, kg,
                         preferred_element_type=jnp.float32) * scale

@@ -14,7 +14,6 @@ import copy
 import warnings
 
 import jax
-import numpy as np
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
@@ -36,19 +35,32 @@ __all__ = [
 # ---------------------------------------------------------------------------
 # low-level fake-quant ops
 # ---------------------------------------------------------------------------
+def _qdq_fn(a, s, qmax):
+    s = jnp.maximum(s, 1e-9)
+    q = jnp.clip(jnp.round(a / s * qmax), -qmax, qmax)
+    return q * s / qmax
+
+
 def _fake_quant_ste(x, scale, bit_length=8):
     """Quantize-dequantize with straight-through gradient:
     y = x + stop_grad(qdq(x) - x)."""
     qmax = float(2 ** (bit_length - 1) - 1)
 
     def fn(a, s):
-        s = jnp.maximum(s, 1e-9)
-        q = jnp.clip(jnp.round(a / s * qmax), -qmax, qmax)
-        dq = q * s / qmax
+        dq = _qdq_fn(a, s, qmax)
         # straight-through: forward dq, backward identity wrt a
         return a + jax.lax.stop_gradient(dq - a)
 
     return apply(fn, x, scale, name="fake_quant")
+
+
+def _qdq(x, scale, bit_length=8):
+    """Grad-free quantize-dequantize for pure-inference wrappers: the same
+    forward values as `_fake_quant_ste`, without dragging the STE's
+    identity-gradient machinery (an extra sub/add + stop_gradient node)
+    into models that will never be differentiated."""
+    qmax = float(2 ** (bit_length - 1) - 1)
+    return apply(lambda a, s: _qdq_fn(a, s, qmax), x, scale, name="qdq")
 
 
 def quantize_linear(x, scale, zero_point=0, bit_length=8, name=None):
@@ -139,8 +151,11 @@ class PassthroughWeightObserver(BaseQuanter):
         self.register_buffer("_scale", Tensor(jnp.ones((), jnp.float32)))
 
     def forward(self, w):
-        self._scale._data = jnp.asarray(
-            float(np.max(np.abs(np.asarray(w._data))) or 1e-9), jnp.float32)
+        # pure-jnp device-side update (no np.asarray round-trip: that was
+        # a device→host sync per calibration batch, and a tracer error
+        # under jit) — same buffer-update pattern as the QAT quanter
+        self._scale._data = jnp.maximum(
+            jnp.max(jnp.abs(w._data)).astype(jnp.float32), 1e-9)
         return w
 
     def scales(self):
@@ -157,8 +172,12 @@ class AbsmaxObserver(BaseQuanter):
         self.register_buffer("_max", Tensor(jnp.zeros((), jnp.float32)))
 
     def forward(self, x):
-        cur = float(np.max(np.abs(np.asarray(x._data))) or 0.0)
-        self._max._data = jnp.asarray(max(float(self._max._data), cur), jnp.float32)
+        # device-side running max — the old np.asarray(...) round-trip
+        # forced a host sync on every calibration batch and broke under
+        # a traced forward
+        self._max._data = jnp.maximum(
+            self._max._data,
+            jnp.max(jnp.abs(x._data)).astype(jnp.float32))
         return x  # observers pass activations through unchanged
 
     def scales(self):
@@ -250,7 +269,11 @@ def _swap_layers(model, make_wrapper):
     for name, sub in list(model._sub_layers.items()):
         wrapped = make_wrapper(sub)
         if wrapped is not None:
-            model._sub_layers[name] = wrapped
+            # setattr keeps Layer.__setattr__'s __dict__ mirror in sync —
+            # a bare _sub_layers[name] write leaves `self.<name>` (the
+            # attribute most forwards actually call) pointing at the
+            # UNWRAPPED layer
+            setattr(model, name, wrapped)
         else:
             _swap_layers(sub, make_wrapper)
     return model
@@ -259,6 +282,30 @@ def _swap_layers(model, make_wrapper):
 # ---------------------------------------------------------------------------
 # QAT / PTQ drivers
 # ---------------------------------------------------------------------------
+def _to_weight_only(layer, weight_dtype, per_channel):
+    """Materialize a QuantedLinear's inner Linear as a real low-bit
+    `lowbit.WeightOnlyLinear`, flowing the weight quanter/observer's
+    calibrated abs-max through as the quantization scale (per-tensor,
+    matching the fake-quant training numerics) unless `per_channel`
+    re-derives per-output-channel scales from the raw weight."""
+    from ..lowbit.weight_only import WeightOnlyLinear
+    from ..ops.lowbit import qmax_for_bits
+
+    inner = layer.inner
+    scale = None
+    if not per_channel:
+        bits = {"int8": 8, "int4": 4}[weight_dtype]
+        absmax = jnp.maximum(
+            jnp.max(jnp.abs(inner.weight._data)).astype(jnp.float32), 1e-9)
+        wq = layer.weight_quanter
+        if wq is not None and float(wq.scales()._data) > 0:
+            absmax = wq.scales()._data.astype(jnp.float32)
+        scale = absmax / qmax_for_bits(bits)
+    return WeightOnlyLinear.from_linear(
+        inner, weight_dtype=weight_dtype, per_channel=per_channel,
+        scale=scale)
+
+
 class QAT:
     """Quantization-aware training: swap quantable layers for fake-quant
     wrappers (reference: quantization/qat.py:22)."""
@@ -281,18 +328,31 @@ class QAT:
 
         return _swap_layers(model, wrapper)
 
-    def convert(self, model: Layer, inplace=False):
+    def convert(self, model: Layer, inplace=False, weight_only=None,
+                per_channel=False):
         """Fold fake quant into static scales for inference: weights are
         quantize-dequantized once with the final scales, activation
-        quanters become fixed-scale qdq."""
+        quanters become fixed-scale qdq.
+
+        weight_only="int8"|"int4" targets the REAL low-bit runtime
+        instead: QuantedLinear becomes `lowbit.WeightOnlyLinear` (packed
+        codes + scales, actually smaller) with the trained quanter scale
+        flowing through; the calibrated activation qdq wrapper is kept.
+        QuantedConv2D stays on the qdq-fold path (weight-only packing is
+        a Linear-shaped optimization).
+        """
         if not inplace:
             model = copy.deepcopy(model)
 
         def fold(layer):
             if isinstance(layer, (QuantedLinear, QuantedConv2D)):
-                inner = layer.inner
-                w = layer.weight_quanter(inner.weight)
-                inner.weight._data = jax_stop(w._data)
+                if weight_only is not None and isinstance(layer,
+                                                          QuantedLinear):
+                    inner = _to_weight_only(layer, weight_only, per_channel)
+                else:
+                    inner = layer.inner
+                    w = layer.weight_quanter(inner.weight)
+                    inner.weight._data = jax_stop(w._data)
                 # the learned activation scale becomes a fixed-scale qdq
                 aq = layer.activation_quanter
                 if aq is not None and float(aq.scales()._data) > 0:
@@ -328,17 +388,25 @@ class PTQ:
         model.eval()
         return model
 
-    def convert(self, model: Layer, inplace=False):
+    def convert(self, model: Layer, inplace=False, weight_only=None,
+                per_channel=False):
+        """weight_only="int8"|"int4": target `lowbit.WeightOnlyLinear`
+        with the observer-calibrated scales (see QAT.convert)."""
         if not inplace:
             model = copy.deepcopy(model)
 
         def fold(layer):
             if isinstance(layer, (QuantedLinear, QuantedConv2D)):
-                inner = layer.inner
-                # quantize-dequantize the weight once with the final scale
-                w = WeightAbsMaxQuanter(layer.weight_quanter.bit_length)(
-                    inner.weight)
-                inner.weight._data = jax_stop(w._data)
+                if weight_only is not None and isinstance(layer,
+                                                          QuantedLinear):
+                    inner = _to_weight_only(layer, weight_only, per_channel)
+                else:
+                    inner = layer.inner
+                    # quantize-dequantize the weight once with the final
+                    # scale
+                    w = WeightAbsMaxQuanter(layer.weight_quanter.bit_length)(
+                        inner.weight)
+                    inner.weight._data = jax_stop(w._data)
                 obs = layer.activation_quanter
                 if isinstance(obs, AbsmaxObserver) and float(obs.scales()._data) > 0:
                     scale = Tensor(obs.scales()._data)
@@ -360,7 +428,9 @@ class _FixedQDQ(Layer):
         self._bits = bits
 
     def forward(self, x):
-        return self.inner(_fake_quant_ste(x, self._scale, self._bits))
+        # grad-free qdq: identical forward numerics to _fake_quant_ste,
+        # no STE gradient plumbing in inference graphs
+        return self.inner(_qdq(x, self._scale, self._bits))
 
 
 def quanter(class_name):

@@ -63,7 +63,8 @@ import jax.numpy as jnp
 from .. import monitor
 from ..resilience.retry import Deadline
 from ..ops.paged_attention import (paged_attention_arrays,
-                                   paged_cache_update_arrays)
+                                   paged_cache_update_arrays,
+                                   quantized_cache_update_arrays)
 from .kv_cache import BlockKVCache
 from .scheduler import Request, SamplingParams, Scheduler
 
@@ -82,6 +83,11 @@ class EngineConfig:
     # but reassociates float reductions)
     max_num_batched_tokens: Optional[int] = None
     max_model_len: Optional[int] = None    # default: max_position_embeddings
+    # "int8" stores the KV pools as int8 codes + per-block-per-head
+    # scales (paddle_tpu.lowbit): same pool BYTES hold ~2× (bf16) / ~4×
+    # (fp32) the blocks, at a documented decode tolerance vs fp — see
+    # tests/test_lowbit.py.  None = full-precision pools (exact parity).
+    kv_cache_dtype: Optional[str] = None
 
 
 class LLMEngine:
@@ -108,12 +114,41 @@ class LLMEngine:
         self.blocks_per_seq = -(-ring // c.block_size)
         nh = cfg.num_attention_heads
         hd = cfg.hidden_size // nh
-        num_blocks = (c.num_blocks if c.num_blocks is not None
-                      else c.max_num_seqs * self.blocks_per_seq)
+        if c.kv_cache_dtype not in (None, "int8"):
+            raise ValueError(
+                f'kv_cache_dtype must be None or "int8", got '
+                f'{c.kv_cache_dtype!r}')
+        self._kv_quant = c.kv_cache_dtype
         wdtype = model.gpt.embeddings.word_embeddings.weight.dtype
+        fp_blocks = c.max_num_seqs * self.blocks_per_seq
+        if c.num_blocks is not None:
+            num_blocks = c.num_blocks
+        elif self._kv_quant:
+            # same BYTE budget as the full-precision default pool — the
+            # whole point: halved/quartered bytes/block ⇒ ~2–4× blocks,
+            # fewer preemptions under the same memory ceiling
+            budget = fp_blocks * BlockKVCache.block_bytes(
+                c.block_size, nh, hd, wdtype) * cfg.num_hidden_layers
+            num_blocks = budget // (BlockKVCache.block_bytes(
+                c.block_size, nh, hd, wdtype, self._kv_quant)
+                * cfg.num_hidden_layers)
+        else:
+            num_blocks = fp_blocks
         self.cache = BlockKVCache(
             cfg.num_hidden_layers, num_blocks, c.block_size, nh, hd,
-            dtype=wdtype)
+            dtype=wdtype, kv_quant=self._kv_quant)
+        if monitor.enabled():
+            monitor.gauge("lowbit/kv_blocks",
+                          "paged KV pool size in blocks").labels(
+                dtype=self._kv_quant or str(wdtype)).set(num_blocks)
+            if self._kv_quant:
+                # what THIS pool's block count would have cost at the
+                # model dtype, minus what the quantized pool costs
+                fp_cost = num_blocks * cfg.num_hidden_layers \
+                    * BlockKVCache.block_bytes(c.block_size, nh, hd, wdtype)
+                monitor.counter("lowbit/bytes_saved").labels(
+                    wing="kv_cache").add(max(0, fp_cost
+                                             - self.cache.pool_bytes))
         self.scheduler = Scheduler(
             self.cache, max_num_seqs=c.max_num_seqs,
             max_num_batched_tokens=(c.max_num_batched_tokens
@@ -418,13 +453,25 @@ class LLMEngine:
         return params
 
     def _kv_flat(self):
-        return tuple(a for pair in zip(self.cache.k_blocks,
-                                       self.cache.v_blocks) for a in pair)
+        c = self.cache
+        if self._kv_quant:
+            return tuple(a for quad in zip(c.k_blocks, c.v_blocks,
+                                           c.k_scales, c.v_scales)
+                         for a in quad)
+        return tuple(a for pair in zip(c.k_blocks, c.v_blocks)
+                     for a in pair)
 
     def _store_kv(self, kv_out):
         L = self.cfg.num_hidden_layers
-        self.cache.k_blocks = [kv_out[2 * l] for l in range(L)]
-        self.cache.v_blocks = [kv_out[2 * l + 1] for l in range(L)]
+        c = self.cache
+        if self._kv_quant:
+            c.k_blocks = [kv_out[4 * l] for l in range(L)]
+            c.v_blocks = [kv_out[4 * l + 1] for l in range(L)]
+            c.k_scales = [kv_out[4 * l + 2] for l in range(L)]
+            c.v_scales = [kv_out[4 * l + 3] for l in range(L)]
+        else:
+            c.k_blocks = [kv_out[2 * l] for l in range(L)]
+            c.v_blocks = [kv_out[2 * l + 1] for l in range(L)]
 
     # -- jitted step programs ----------------------------------------------
 
@@ -447,14 +494,15 @@ class LLMEngine:
         nh = cfg.num_attention_heads
         hd = cfg.hidden_size // nh
         eps = cfg.layer_norm_epsilon
+        stride = 4 if self._kv_quant else 2
         h = x
         outs = []
         for l in range(cfg.num_hidden_layers):
-            kc, vc = kv_flat[2 * l], kv_flat[2 * l + 1]
+            layer_kv = kv_flat[stride * l:stride * (l + 1)]
             p = {n: params[n][l] for n in self._stack_names}
-            attn_fn = attn_builder(kc, vc)
-            h, (kc2, vc2) = _stacked_block_body(p, h, attn_fn, nh, hd, eps)
-            outs += [kc2, vc2]
+            attn_fn = attn_builder(*layer_kv)
+            h, extra = _stacked_block_body(p, h, attn_fn, nh, hd, eps)
+            outs += list(extra)
         return h, tuple(outs)
 
     def _get_prefill_exec(self, p_len):
@@ -467,12 +515,22 @@ class LLMEngine:
                 x = jnp.take(params["wte"], ids, axis=0) \
                     + jnp.take(params["wpe"], pos, axis=0)
 
-                def builder(kc, vc):
-                    def attn_fn(q, k, v, kc=kc, vc=vc):
-                        kc2 = paged_cache_update_arrays(kc, k, slots)
-                        vc2 = paged_cache_update_arrays(vc, v, slots)
+                def builder(kc, vc, ksc=None, vsc=None):
+                    def attn_fn(q, k, v, kc=kc, vc=vc, ksc=ksc, vsc=vsc):
+                        # flash within the chunk reads the fp K/V it just
+                        # computed — only the STORED cache is quantized
+                        if ksc is None:
+                            kc2 = paged_cache_update_arrays(kc, k, slots)
+                            vc2 = paged_cache_update_arrays(vc, v, slots)
+                            extra = (kc2, vc2)
+                        else:
+                            kc2, ks2 = quantized_cache_update_arrays(
+                                kc, ksc, k, slots)
+                            vc2, vs2 = quantized_cache_update_arrays(
+                                vc, vsc, v, slots)
+                            extra = (kc2, vc2, ks2, vs2)
                         o = flash_attention_arrays(q, k, v, is_causal=True)
-                        return o, (kc2, vc2)
+                        return o, extra
                     return attn_fn
 
                 h, kv_out = self._run_blocks(params, kv_flat, x, builder)
@@ -489,14 +547,27 @@ class LLMEngine:
                 x = jnp.take(params["wte"], ids, axis=0) \
                     + jnp.take(params["wpe"], pos, axis=0)
 
-                def builder(kc, vc):
-                    def attn_fn(q, k, v, kc=kc, vc=vc):
+                def builder(kc, vc, ksc=None, vsc=None):
+                    def attn_fn(q, k, v, kc=kc, vc=vc, ksc=ksc, vsc=vsc):
                         # write-then-attend, the dense cache ordering
-                        kc2 = paged_cache_update_arrays(kc, k, slots)
-                        vc2 = paged_cache_update_arrays(vc, v, slots)
-                        o = paged_attention_arrays(q, kc2, vc2, tables,
-                                                   pos0)
-                        return o, (kc2, vc2)
+                        if ksc is None:
+                            kc2 = paged_cache_update_arrays(kc, k, slots)
+                            vc2 = paged_cache_update_arrays(vc, v, slots)
+                            o = paged_attention_arrays(q, kc2, vc2, tables,
+                                                       pos0)
+                            return o, (kc2, vc2)
+                        # lowbit KV: quantizing write, dequantizing
+                        # gather — the current chunk's own K/V round-trip
+                        # through int8 too (attend-from-pool, so every
+                        # position sees ONE consistent representation)
+                        kc2, ks2 = quantized_cache_update_arrays(
+                            kc, ksc, k, slots)
+                        vc2, vs2 = quantized_cache_update_arrays(
+                            vc, vsc, v, slots)
+                        o = paged_attention_arrays(
+                            q, kc2, vc2, tables, pos0,
+                            k_scales=ks2, v_scales=vs2)
+                        return o, (kc2, vc2, ks2, vs2)
                     return attn_fn
 
                 h, kv_out = self._run_blocks(params, kv_flat, x, builder)

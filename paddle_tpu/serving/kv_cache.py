@@ -34,6 +34,17 @@ Allocator design (host-side, O(1) per op):
 Every transition asserts the refcount/free-list invariants — the
 allocator can never hand out a block that is still referenced
 (tests/test_serving.py fuzzes this).
+
+**Quantized mode** (``kv_quant="int8"``, the `paddle_tpu.lowbit` KV
+wing): pools store int8 codes plus per-block-per-head float32 scales
+(``k_scales[l], v_scales[l] : [num_blocks, num_heads]``, value =
+code·scale).  A block costs ``block_size·H·D + 4·H`` bytes instead of
+``block_size·H·D·itemsize`` — ~¼ of fp32, ~½ of bf16 — so the same pool
+byte budget holds ~2–4× the blocks (`block_bytes` does the accounting;
+the engine sizes the default pool by BYTES, not block count).  Scales
+ride every block operation: copied on CoW, saved/restored through
+swap_out/swap_in (bit-stable in the quantized domain), and zeroed when a
+block is reallocated (`_reset_scales`).
 """
 from __future__ import annotations
 
@@ -57,17 +68,33 @@ class _Block:
 
 class BlockKVCache:
     def __init__(self, num_layers, num_blocks, block_size, num_heads,
-                 head_dim, dtype=jnp.float32):
+                 head_dim, dtype=jnp.float32, kv_quant=None):
+        if kv_quant not in (None, "int8"):
+            raise ValueError(
+                f'kv_quant must be None or "int8", got {kv_quant!r}')
         self.num_layers = int(num_layers)
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
         self.num_heads = int(num_heads)
         self.head_dim = int(head_dim)
         self.dtype = dtype
+        self.kv_quant = kv_quant
         shape = (self.num_blocks, self.block_size, self.num_heads,
                  self.head_dim)
-        self.k_blocks = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
-        self.v_blocks = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
+        pool_dtype = jnp.int8 if kv_quant else dtype
+        self.k_blocks = [jnp.zeros(shape, pool_dtype)
+                         for _ in range(num_layers)]
+        self.v_blocks = [jnp.zeros(shape, pool_dtype)
+                         for _ in range(num_layers)]
+        if kv_quant:
+            # per-block-per-head abs-max scales: value = code * scale
+            sshape = (self.num_blocks, self.num_heads)
+            self.k_scales = [jnp.zeros(sshape, jnp.float32)
+                             for _ in range(num_layers)]
+            self.v_scales = [jnp.zeros(sshape, jnp.float32)
+                             for _ in range(num_layers)]
+        else:
+            self.k_scales = self.v_scales = None
         self._blocks = [_Block(i) for i in range(self.num_blocks)]
         self._free = list(range(self.num_blocks - 1, -1, -1))  # LIFO
         self._tables: dict = {}        # seq_id -> [physical ids]
@@ -75,6 +102,27 @@ class BlockKVCache:
         self.peak_blocks_in_use = 0
 
     # -- introspection ------------------------------------------------------
+
+    @staticmethod
+    def block_bytes(block_size, num_heads, head_dim, dtype=jnp.float32,
+                    kv_quant=None) -> int:
+        """Bytes ONE physical block costs per layer (K + V pools, plus the
+        per-block-per-head f32 scales when quantized)."""
+        per_tok = int(num_heads) * int(head_dim)
+        if kv_quant == "int8":
+            return 2 * (int(block_size) * per_tok + 4 * int(num_heads))
+        return 2 * int(block_size) * per_tok * np.dtype(dtype).itemsize
+
+    @property
+    def bytes_per_block(self) -> int:
+        """Bytes one block costs across all layers."""
+        return self.num_layers * self.block_bytes(
+            self.block_size, self.num_heads, self.head_dim, self.dtype,
+            self.kv_quant)
+
+    @property
+    def pool_bytes(self) -> int:
+        return self.num_blocks * self.bytes_per_block
 
     @property
     def num_free_blocks(self) -> int:
@@ -152,8 +200,10 @@ class BlockKVCache:
         need = self.blocks_needed(num_tokens)
         if need > len(self._free):
             raise BlockAllocatorError("out of KV blocks")
-        self._tables[seq_id] = [self._take() for _ in range(need)]
+        ids = [self._take() for _ in range(need)]
+        self._tables[seq_id] = ids
         self._lengths[seq_id] = int(num_tokens)
+        self._reset_scales(ids)
 
     def grow_to(self, seq_id, num_tokens):
         """Extend a sequence's table to cover `num_tokens` tokens,
@@ -163,9 +213,12 @@ class BlockKVCache:
         t = self._tables[seq_id]
         if self._needs_cow(seq_id, num_tokens):
             self._cow_last_block(seq_id)
+        new_ids = []
         while len(t) < self.blocks_needed(num_tokens):
-            t.append(self._take())
+            new_ids.append(self._take())
+            t.append(new_ids[-1])
         self._lengths[seq_id] = max(self._lengths[seq_id], int(num_tokens))
+        self._reset_scales(new_ids)
 
     def free(self, seq_id):
         for idx in self._tables.pop(seq_id):
@@ -185,12 +238,28 @@ class BlockKVCache:
         self._tables[child_id] = list(t)
         self._lengths[child_id] = self._lengths[parent_id]
 
+    def _reset_scales(self, ids):
+        """Zero the quant scales of freshly (re)allocated blocks — a
+        block's scale only grows while it is owned, so a reallocated
+        block must not inherit the previous owner's dynamic range."""
+        if not self.kv_quant or not ids:
+            return
+        idx = jnp.asarray(ids, jnp.int32)
+        for l in range(self.num_layers):
+            self.k_scales[l] = self.k_scales[l].at[idx].set(0.0)
+            self.v_scales[l] = self.v_scales[l].at[idx].set(0.0)
+
     def _copy_block(self, src, dst):
         for l in range(self.num_layers):
             self.k_blocks[l] = self.k_blocks[l].at[dst].set(
                 self.k_blocks[l][src])
             self.v_blocks[l] = self.v_blocks[l].at[dst].set(
                 self.v_blocks[l][src])
+            if self.kv_quant:
+                self.k_scales[l] = self.k_scales[l].at[dst].set(
+                    self.k_scales[l][src])
+                self.v_scales[l] = self.v_scales[l].at[dst].set(
+                    self.v_scales[l][src])
 
     def _cow_last_block(self, seq_id):
         t = self._tables[seq_id]
@@ -223,6 +292,12 @@ class BlockKVCache:
             "k": [np.asarray(k[idx]) for k in self.k_blocks],
             "v": [np.asarray(v[idx]) for v in self.v_blocks],
         }
+        if self.kv_quant:
+            # codes alone are meaningless — the scales ARE the values'
+            # exponents; saving both is what keeps the quantized domain
+            # bit-stable across evict/restore
+            saved["ks"] = [np.asarray(s[idx]) for s in self.k_scales]
+            saved["vs"] = [np.asarray(s[idx]) for s in self.v_scales]
         self.free(seq_id)
         return saved
 
@@ -239,3 +314,8 @@ class BlockKVCache:
                 jnp.asarray(saved["k"][l]))
             self.v_blocks[l] = self.v_blocks[l].at[idx].set(
                 jnp.asarray(saved["v"][l]))
+            if self.kv_quant:
+                self.k_scales[l] = self.k_scales[l].at[idx].set(
+                    jnp.asarray(saved["ks"][l]))
+                self.v_scales[l] = self.v_scales[l].at[idx].set(
+                    jnp.asarray(saved["vs"][l]))

@@ -5,8 +5,17 @@ Runnable anywhere (CPU included):
 
     JAX_PLATFORMS=cpu PTPU_MONITOR=1 python scripts/serve_smoke.py
 
-tests/test_serving.py runs this as a subprocess (fast tier), so it is the
-"does the engine boot outside the test harness" guard.
+Low-bit mode (the paddle_tpu.lowbit runtime end-to-end):
+
+    python scripts/serve_smoke.py --quantize int8 --kv-cache-dtype int8
+
+--quantize swaps every Linear for a packed `WeightOnlyLinear`;
+--kv-cache-dtype int8 serves from a quantized KV pool (asserting it
+holds ≥1.9× the blocks of the fp pool for the same byte budget).
+
+tests/test_serving.py runs the plain mode, tests/test_lowbit.py the
+quantized one (both fast tier), so each is a "does the engine boot
+outside the test harness" guard.
 """
 import os
 import sys
@@ -22,6 +31,7 @@ if os.environ.get("PTPU_FORCE_PLATFORM") == "cpu":
     jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
+import jax.numpy as jnp
 
 import paddle_tpu as paddle
 from paddle_tpu import monitor
@@ -30,12 +40,60 @@ from paddle_tpu.serving import EngineConfig, LLMEngine, SamplingParams
 
 
 def main():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quantize", choices=["int8", "int4"], default=None,
+                    help="weight-only quantize the model (lowbit)")
+    ap.add_argument("--kv-cache-dtype", choices=["int8"], default=None,
+                    help="serve from a quantized KV pool (lowbit)")
+    args = ap.parse_args()
+
     monitor.refresh()
     paddle.seed(0)
     cfg = gpt_test_config(stacked_blocks=True, sequence_parallel=False)
     model = GPTForCausalLM(cfg)
     model.eval()
-    engine = LLMEngine(model, EngineConfig(block_size=16, max_num_seqs=4))
+    if args.quantize:
+        # weight-only lives at the LAYER level, so it demos on the
+        # per-layer twin of the same GPT (the stacked-blocks serving form
+        # threads raw weight arrays, no Linear modules to swap): greedy
+        # decode of the packed-int model must track fp within tolerance
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.lowbit import (WeightOnlyLinear,
+                                       quantize_for_inference)
+
+        paddle.seed(0)
+        dense = GPTForCausalLM(gpt_test_config(stacked_blocks=False,
+                                               sequence_parallel=False))
+        dense.eval()
+        drng = np.random.RandomState(0)
+        ids = Tensor(jnp.asarray(
+            drng.randint(0, cfg.vocab_size, (2, 6)).astype(np.int32)))
+        ref = np.asarray(dense.generate(ids, max_new_tokens=6)._data)
+        qdense = quantize_for_inference(dense, weight_dtype=args.quantize)
+        n_wol = sum(1 for l in qdense.sublayers()
+                    if isinstance(l, WeightOnlyLinear))
+        assert n_wol > 0, "no Linear was weight-only quantized"
+        out = np.asarray(qdense.generate(ids, max_new_tokens=6)._data)
+        agree = float((ref[:, 6:] == out[:, 6:]).mean())
+        floor = 0.9 if args.quantize == "int8" else 0.25
+        assert agree >= floor, (agree, floor)
+        print(f"weight-only {args.quantize}: {n_wol} linears packed, "
+              f"greedy agreement {agree:.2f} vs fp")
+        del dense, qdense
+    engine = LLMEngine(model, EngineConfig(
+        block_size=16, max_num_seqs=4, kv_cache_dtype=args.kv_cache_dtype))
+    if args.kv_cache_dtype:
+        fp = LLMEngine(model, EngineConfig(block_size=16, max_num_seqs=4))
+        ratio = engine.cache.num_blocks / fp.cache.num_blocks
+        assert engine.cache.pool_bytes <= fp.cache.pool_bytes, (
+            engine.cache.pool_bytes, fp.cache.pool_bytes)
+        assert ratio >= 1.9, f"quantized pool only {ratio:.2f}x blocks"
+        print(f"kv int8: {engine.cache.num_blocks} blocks vs "
+              f"{fp.cache.num_blocks} fp ({ratio:.2f}x) in "
+              f"{engine.cache.pool_bytes} bytes")
+        del fp
 
     rng = np.random.RandomState(0)
     prompts = [rng.randint(0, cfg.vocab_size, (n,)).astype(np.int32)
@@ -58,6 +116,10 @@ def main():
     print(f"generated {new_tokens} tokens in {dt:.2f}s "
           f"({tps:.1f} tokens/s, includes compiles)")
     print("serving metrics:", ", ".join(served))
+    if args.quantize or args.kv_cache_dtype:
+        low = sorted(k for k in snap if k.startswith("lowbit/"))
+        assert low, "lowbit mode must emit lowbit/* metrics"
+        print("lowbit metrics:", ", ".join(low))
     print("OK")
 
 
