@@ -13,6 +13,7 @@ standing in for its memory-reuse passes.
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -25,6 +26,36 @@ from ..core import random as _rng
 from ..autograd import tape
 from ..nn.layer import Layer
 from .. import monitor
+from ..monitor import trace as mtrace
+
+_NULL_CTX = contextlib.nullcontext()
+
+
+def _arg_signature(tree) -> str:
+    """Compact shape/dtype signature of a call's DATA arguments — the
+    part of jax.jit's cache key the caller controls.  A signature this
+    CompiledFunction has not seen before means jax is about to trace and
+    XLA-compile a fresh program; that event gets a `jit/recompile` span
+    carrying the missing signature plus a `jit/recompiles{fn}` count
+    (today's answer to "why did step 1047 take 90 seconds")."""
+    parts = []
+
+    def walk(o):
+        if isinstance(o, (list, tuple)):
+            for x in o:
+                walk(x)
+        elif isinstance(o, dict):
+            for k in sorted(o):
+                walk(o[k])
+        else:
+            shape = getattr(o, "shape", None)
+            if shape is not None:
+                parts.append(f"{tuple(shape)}:{getattr(o, 'dtype', '?')}")
+            else:   # static python leaf: value participates in the key
+                parts.append(repr(o)[:48])
+
+    walk(tree)
+    return ";".join(parts)
 
 __all__ = ["to_static", "compile", "CompiledFunction", "save", "load", "TranslatedLayer", "not_to_static", "ignore_module"]
 
@@ -159,6 +190,7 @@ class CompiledFunction:
         self._sharding_fn = sharding_fn
         self._compiled = None
         self._last_lowered = None
+        self._seen_sigs: set = set()
 
     def _build(self):
         spec = self._spec
@@ -218,7 +250,27 @@ class CompiledFunction:
         key = _rng.next_key()
         a_args = _tree_to_arrays(args)
         a_kwargs = _tree_to_arrays(kwargs)
-        out_arrays, new_state = self._compiled(state_vals, host_vals, key, a_args, a_kwargs)
+        # recompile visibility: a data-arg signature this function has
+        # not run before means jax.jit is about to trace+compile — time
+        # it as a span and count it, instead of it showing up as one
+        # mysteriously slow step.  (State arrays keep their shapes across
+        # steps, so the caller-visible args are the discriminating part;
+        # signature cost is a few string formats per call, skipped
+        # entirely when both telemetry layers are off.)
+        ctx = _NULL_CTX
+        if monitor.enabled() or mtrace.enabled():
+            sig = f"nstate={len(state_vals)};{_arg_signature((a_args, a_kwargs))}"
+            if sig not in self._seen_sigs:
+                self._seen_sigs.add(sig)
+                fname = getattr(self._fn, "__name__", "<step>")
+                monitor.counter(
+                    "jit/recompiles",
+                    "fresh trace+XLA-compile events per function").labels(
+                    fn=fname).inc()
+                ctx = mtrace.span("jit/recompile", fn=fname, signature=sig)
+        with ctx:
+            out_arrays, new_state = self._compiled(
+                state_vals, host_vals, key, a_args, a_kwargs)
         if self._spec.optimizers and monitor.enabled():
             # the compiled program embeds the optimizer update; count the
             # dispatch here (optimizer.step only counts eager steps).

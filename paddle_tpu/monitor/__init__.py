@@ -230,6 +230,13 @@ class Gauge(_Metric):
             try:
                 return _coerce(self._fn())
             except Exception:
+                # a broken callback (a device gauge probing a torn-down
+                # backend, say) must not take down snapshot()/render —
+                # count it so the breakage is visible, keep exporting
+                _default.counter(
+                    "monitor/gauge_errors",
+                    "gauge callbacks that raised at sample time",
+                ).labels(name=self.name).inc()
                 return 0.0
         return _coerce(self._value)
 
@@ -246,6 +253,32 @@ class Gauge(_Metric):
 # per-metric via histogram(name, buckets=...).
 DEFAULT_BUCKETS = tuple(
     float(f"{b}e{e}") for e in range(-6, 7) for b in (1, 3))
+
+
+def _interp_percentile(q, buckets, counts, count, mn, mx):
+    """q-th percentile (q in [0, 100]) linearly interpolated inside the
+    bucket holding the target rank; the observed min/max clamp the first
+    and last occupied buckets, so a single-bucket histogram still
+    returns a value inside the data's actual range."""
+    if not count:
+        return 0.0
+    q = min(max(float(q), 0.0), 100.0)
+    target = q / 100.0 * count
+    if target <= 0:
+        return mn
+    cum = 0
+    for i, c in enumerate(counts):
+        if not c:
+            continue
+        prev = cum
+        cum += c
+        if cum >= target:
+            lo = buckets[i - 1] if i > 0 else mn
+            hi = buckets[i] if i < len(buckets) else mx
+            lo = max(min(lo, mx), mn)
+            hi = max(min(hi, mx), lo)
+            return lo + (target - prev) / c * (hi - lo)
+    return mx
 
 
 class Histogram(_Metric):
@@ -283,17 +316,29 @@ class Histogram(_Metric):
     def sum(self):
         return self._sum
 
+    def percentile(self, q) -> float:
+        """q-th percentile (q in [0, 100]) interpolated from the bucket
+        counts — how `serving/ttft` p99 is read without storing samples."""
+        with self._lock:
+            return _interp_percentile(q, self._buckets, self._counts,
+                                      self._count, self._min, self._max)
+
     def _snapshot_value(self):
         with self._lock:   # consistent (count, sum, min, max) tuple
             if not self._count:
                 return {"count": 0, "sum": 0.0}
-            return {
+            out = {
                 "count": self._count,
                 "sum": self._sum,
                 "min": self._min,
                 "max": self._max,
                 "avg": self._sum / self._count,
             }
+            for q, key in ((50, "p50"), (95, "p95"), (99, "p99")):
+                out[key] = _interp_percentile(
+                    q, self._buckets, self._counts, self._count,
+                    self._min, self._max)
+            return out
 
     def _bucket_rows(self):
         """Consistent (buckets, per-bucket counts, count, sum) copy."""
@@ -456,8 +501,10 @@ class StatRegistry:
             if isinstance(v, dict) and "count" in v:
                 if not v["count"]:
                     return "n=0"
-                return (f"n={v['count']} avg={v['avg']:.4g} "
-                        f"max={v['max']:.4g}")
+                out = f"n={v['count']} avg={v['avg']:.4g}"
+                if "p50" in v:
+                    out += f" p50={v['p50']:.4g} p95={v['p95']:.4g}"
+                return out + f" max={v['max']:.4g}"
             return f"{_coerce(v):.6g}"
 
         for name, val in snap.items():
@@ -551,3 +598,18 @@ def STAT_SUB(name, value):
 
 def STAT_RESET(name):
     _default.gauge(name).set(0)
+
+
+# -- v2: tracing / flight recorder / live endpoint -------------------------
+# Guarded relative imports: tests load THIS file standalone (spec_from_
+# file_location, no package) to prove the core registry is jax-free; in
+# that mode the v2 submodules — equally stdlib-only — are simply absent.
+try:
+    from . import trace, flight, serve            # noqa: E402,F401
+    from .flight import watchdog                  # noqa: E402,F401
+    from .serve import start_server, stop_server  # noqa: E402,F401
+
+    __all__ += ["trace", "flight", "serve", "watchdog", "start_server",
+                "stop_server"]
+except ImportError:   # standalone module load — core registry only
+    pass

@@ -1,0 +1,129 @@
+"""Live observability endpoint — a stdlib ``http.server`` thread serving
+the process's metrics and traces while it runs:
+
+- ``GET /metrics``      — Prometheus text exposition (the PR-1 exporter),
+  scrapeable by any Prometheus/agent;
+- ``GET /healthz``      — JSON liveness: pid, uptime, seconds since the
+  last completed span/step (the watchdog's signal — a scraper can alert
+  on stalls without attaching a debugger);
+- ``GET /traces/<id>``  — one trace's finished spans as JSON (the ids
+  come from ``LLMEngine.request_trace`` / ``trace.trace_ids()``).
+
+Launch: ``monitor.start_server(port)`` (port 0 = ephemeral; the chosen
+port is on the returned server), or ``EngineConfig(metrics_port=...)``.
+The server runs on a daemon thread and binds 127.0.0.1 by default —
+exposing it wider is an explicit ``host=`` decision.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+__all__ = ["MonitorServer", "start_server", "stop_server"]
+
+_started_at = time.time()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "ptpu-monitor/2"
+
+    def _send(self, code: int, body: str, ctype: str):
+        data = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):   # noqa: N802 (http.server API)
+        from . import enabled, export_prometheus, trace
+
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        if path == "/metrics":
+            self._send(200, export_prometheus(),
+                       "text/plain; version=0.0.4; charset=utf-8")
+        elif path == "/healthz":
+            self._send(200, json.dumps({
+                "status": "ok",
+                "pid": os.getpid(),
+                "uptime_s": round(time.time() - _started_at, 3),
+                "last_activity_age_s": round(trace.last_activity_age(), 3),
+                "monitor_enabled": enabled(),
+                "trace_enabled": trace.enabled(),
+            }), "application/json")
+        elif path.startswith("/traces/"):
+            tid = path[len("/traces/"):]
+            spans = trace.get_trace(tid)
+            if not spans:
+                self._send(404, json.dumps(
+                    {"error": f"unknown trace {tid!r}"}), "application/json")
+            else:
+                self._send(200, json.dumps(spans), "application/json")
+        elif path == "/":
+            self._send(200, "paddle_tpu monitor: /metrics /healthz "
+                            "/traces/<id>\n", "text/plain; charset=utf-8")
+        else:
+            self._send(404, "not found\n", "text/plain; charset=utf-8")
+
+    def log_message(self, fmt, *args):
+        pass   # scrapes every few seconds must not spam stderr
+
+
+class MonitorServer:
+    """A running endpoint; ``.port`` is the bound port (useful with
+    port=0), ``.stop()`` shuts it down."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="ptpu-monitor-http",
+            daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    def __repr__(self):
+        return f"MonitorServer({self.url})"
+
+
+_server = None
+_server_lock = threading.Lock()
+
+
+def start_server(port: int = 0, host: str = "127.0.0.1") -> MonitorServer:
+    """Start (or return) the process-wide endpoint.  Asking for a
+    DIFFERENT explicit port while one is already bound warns instead of
+    silently handing back the old server — a scrape target configured
+    for the requested port would otherwise look down forever."""
+    global _server
+    with _server_lock:
+        if _server is None:
+            _server = MonitorServer(port, host)
+        elif port not in (0, _server.port):
+            import warnings
+
+            warnings.warn(
+                f"monitor.start_server({port}): endpoint already bound "
+                f"on port {_server.port}; returning the existing server "
+                "— stop_server() first to rebind")
+        return _server
+
+
+def stop_server() -> None:
+    global _server
+    with _server_lock:
+        if _server is not None:
+            _server.stop()
+            _server = None

@@ -1,0 +1,359 @@
+"""Structured span tracing — the *where did the time go* half of the
+monitor subsystem (the PR-1 StatRegistry is the *how much/how many* half).
+
+A span is one timed operation with identity: ``trace_id`` groups every
+span of one logical unit of work (a serving request, a guarded train
+step), ``span_id`` names the span, ``parent_id`` links it under its
+parent so a trace renders as a tree.  Producers:
+
+- ``with trace.span("serving/prefill", chunk_len=64):`` — context-manager
+  spans nest through a thread-local, so a span opened inside another
+  becomes its child automatically;
+- ``trace.start_span(name, parent=...)`` / ``Span.end()`` — manual spans
+  for operations that start and finish in different call frames (a
+  serving request lives across many engine steps);
+- ``trace.attach(span)`` — re-parent the thread-local context onto an
+  existing span from ANOTHER thread (DataLoader workers, async
+  checkpoint writers), so cross-thread work lands in the right trace.
+
+Design constraints (shared with the metrics layer):
+
+- **near-zero cost when disabled**: ``span()`` is one module-global read
+  and returns a no-op singleton; guarded by the same <1 µs test that
+  protects the PTPU_MONITOR gate (tests/test_trace.py).  Gate:
+  ``PTPU_TRACE=1`` (default OFF — tracing allocates per event, metrics
+  don't).
+- **stdlib-only, no jax**: importable headlessly; chrome-trace export
+  merges spans from `paddle_tpu.profiler`'s host tracer only when that
+  module is ALREADY loaded (``sys.modules`` probe — never triggers an
+  accelerator import from here).
+- **bounded memory**: finished spans land in (a) the flight-recorder
+  ring (`monitor.flight`) and (b) a per-trace store capped at
+  ``PTPU_TRACE_MAX_TRACES`` traces (oldest evicted), which backs
+  ``LLMEngine.request_trace(rid)`` and the ``/traces/<id>`` endpoint.
+
+Timestamps use ``time.perf_counter_ns`` — the same clock as the
+profiler's ``RecordEvent`` spans — so ``export_chrome_trace()`` puts
+framework spans and RecordEvent spans on ONE Perfetto timeline.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import sys
+import threading
+import time
+from collections import OrderedDict
+
+__all__ = [
+    "Span", "span", "start_span", "current_span", "attach", "get_trace",
+    "trace_ids", "chrome_events", "export_chrome_trace", "enabled",
+    "enable", "refresh", "reset", "heartbeat", "last_activity_age",
+]
+
+
+def _env_enabled() -> bool:
+    return os.environ.get("PTPU_TRACE", "0").strip().lower() not in (
+        "0", "false", "off", "")
+
+
+_enabled = _env_enabled()
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(on: bool = True):
+    """Flip span collection on/off at runtime (overrides PTPU_TRACE)."""
+    global _enabled
+    _enabled = bool(on)
+
+
+def refresh():
+    """Re-read PTPU_TRACE from the environment."""
+    global _enabled
+    _enabled = _env_enabled()
+
+
+# -- identity ---------------------------------------------------------------
+# ids are "<run>-<n>": unique within the process and cheap to mint (one
+# itertools.count() next, no urandom per span); the run prefix keeps ids
+# from colliding across processes in one flight dir.
+_RUN = f"{os.getpid():x}{time.time_ns() & 0xFFFFFF:06x}"
+_ids = itertools.count(1)
+
+
+def _next_id(prefix: str = "s") -> str:
+    return f"{prefix}{_RUN}-{next(_ids):x}"
+
+
+# -- liveness (the watchdog's signal) ---------------------------------------
+_last_beat = [time.monotonic()]
+
+
+def heartbeat() -> None:
+    """Mark forward progress.  Called on every span end and by step loops
+    directly (engine.step, StepGuard.step), so the watchdog sees progress
+    even with tracing disabled."""
+    _last_beat[0] = time.monotonic()
+
+
+def last_activity_age() -> float:
+    """Seconds since the last heartbeat (span end / step completion)."""
+    return time.monotonic() - _last_beat[0]
+
+
+# -- the span ---------------------------------------------------------------
+
+class Span:
+    """One timed operation.  Mutable until ``end()``; recorded (trace
+    store + flight ring) exactly once, at end."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "attrs",
+                 "_t0", "ts_us", "dur_us", "tid", "_done")
+
+    def __init__(self, name, trace_id, parent_id, attrs):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = _next_id()
+        self.parent_id = parent_id
+        self.attrs = attrs
+        self._t0 = time.perf_counter_ns()
+        self.ts_us = self._t0 / 1000.0   # RecordEvent's timebase
+        self.dur_us = None
+        self.tid = threading.get_ident() % 1_000_000
+        self._done = False
+
+    def end(self, **attrs) -> "Span":
+        """Close the span (idempotent) and record it.  Late attributes
+        (token counts, finish reason) merge into ``attrs`` here."""
+        if self._done:
+            return self
+        self._done = True
+        self.dur_us = (time.perf_counter_ns() - self._t0) / 1000.0
+        if attrs:
+            self.attrs.update(attrs)
+        _record(self)
+        heartbeat()
+        return self
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "ts_us": self.ts_us,
+            "dur_us": self.dur_us,
+            "tid": self.tid,
+            "attrs": dict(self.attrs),
+        }
+
+    def __repr__(self):
+        state = f"{self.dur_us:.1f}us" if self._done else "open"
+        return f"Span({self.name}, {self.span_id}, {state})"
+
+
+class _NullSpan:
+    """The disabled fast path: every producer API returns this singleton,
+    whose methods are no-ops (attribute constants keep reads safe)."""
+
+    __slots__ = ()
+    name = trace_id = span_id = parent_id = None
+    dur_us = ts_us = None
+
+    def end(self, **attrs):
+        return self
+
+    def to_dict(self):
+        return {}
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def __bool__(self):
+        return False   # `if req.trace:` guards stay cheap and correct
+
+
+_NULL = _NullSpan()
+
+
+# -- storage ----------------------------------------------------------------
+_MAX_TRACES = int(os.environ.get("PTPU_TRACE_MAX_TRACES", "256"))
+_traces: "OrderedDict[str, list]" = OrderedDict()
+_store_lock = threading.Lock()
+
+
+def _record(s: Span) -> None:
+    d = s.to_dict()
+    with _store_lock:
+        spans = _traces.get(s.trace_id)
+        if spans is None:
+            spans = _traces[s.trace_id] = []
+            while len(_traces) > _MAX_TRACES:
+                _traces.popitem(last=False)
+        spans.append(d)
+    from . import flight
+
+    flight.record_span(d)
+
+
+def get_trace(trace_id: str) -> list:
+    """Every finished span of one trace (start-ordered span dicts);
+    [] for an unknown/evicted id."""
+    with _store_lock:
+        spans = list(_traces.get(trace_id, ()))
+    return sorted(spans, key=lambda d: d["ts_us"])
+
+
+def trace_ids() -> list:
+    """Known trace ids, oldest first."""
+    with _store_lock:
+        return list(_traces)
+
+
+def reset() -> None:
+    """Drop every stored trace (tests)."""
+    with _store_lock:
+        _traces.clear()
+
+
+# -- context propagation ----------------------------------------------------
+
+class _Ctx(threading.local):
+    span = None
+
+
+_ctx = _Ctx()
+
+
+def current_span():
+    """The innermost open span() on THIS thread (None outside any)."""
+    return _ctx.span
+
+
+class attach:
+    """Adopt `parent` as this thread's current span::
+
+        ctx = trace.current_span()          # producer thread
+        ...
+        with trace.attach(ctx):             # worker thread
+            with trace.span("load_batch"):  # lands under ctx's trace
+                ...
+    """
+
+    __slots__ = ("_span", "_prev")
+
+    def __init__(self, span_):
+        self._span = span_ if isinstance(span_, Span) else None
+
+    def __enter__(self):
+        self._prev = _ctx.span
+        if self._span is not None:
+            _ctx.span = self._span
+        return self._span
+
+    def __exit__(self, *exc):
+        _ctx.span = self._prev
+        return False
+
+
+def start_span(name: str, parent=None, trace_id=None, **attrs):
+    """Manual span (caller owns ``end()``).  ``parent`` may be a Span;
+    with neither parent nor trace_id a NEW trace is opened (the span is
+    its root).  Returns the no-op singleton when tracing is disabled."""
+    if not _enabled:
+        return _NULL
+    parent_id = None
+    if isinstance(parent, Span):
+        parent_id = parent.span_id
+        trace_id = trace_id or parent.trace_id
+    if trace_id is None:
+        trace_id = _next_id("t")
+    return Span(name, trace_id, parent_id, attrs)
+
+
+class _Active:
+    """span()'s handle: installs the span as the thread-local current on
+    enter, restores the previous on exit, ends with error annotation."""
+
+    __slots__ = ("_span", "_prev")
+
+    def __init__(self, s):
+        self._span = s
+
+    def __enter__(self):
+        self._prev = _ctx.span
+        _ctx.span = self._span
+        return self._span
+
+    def __exit__(self, etype, evalue, tb):
+        _ctx.span = self._prev
+        if etype is not None:
+            self._span.end(error=etype.__name__)
+        else:
+            self._span.end()
+        return False
+
+
+def span(name: str, **attrs):
+    """Context-manager span, auto-parented under the thread's current
+    span (a new trace when there is none)::
+
+        with trace.span("resilience/ckpt_save", step=10):
+            ...
+    """
+    if not _enabled:
+        return _NULL
+    return _Active(start_span(name, parent=_ctx.span, **attrs))
+
+
+# -- chrome/Perfetto export -------------------------------------------------
+
+def chrome_events(trace_id=None) -> list:
+    """Finished spans as chrome ``trace_event`` dicts (phase "X").
+    Identity rides ``args`` so Perfetto's flow/query UI can group by
+    trace_id; ts/dur are in µs on the perf_counter timebase — the SAME
+    base as profiler.RecordEvent host events."""
+    pid = os.getpid()
+    with _store_lock:
+        if trace_id is not None:
+            groups = [list(_traces.get(trace_id, ()))]
+        else:
+            groups = [list(v) for v in _traces.values()]
+    out = []
+    for spans in groups:
+        for d in spans:
+            args = {"trace_id": d["trace_id"], "span_id": d["span_id"]}
+            if d["parent_id"]:
+                args["parent_id"] = d["parent_id"]
+            args.update(d["attrs"])
+            out.append({
+                "name": d["name"], "ph": "X", "ts": d["ts_us"],
+                "dur": d["dur_us"] or 0.0, "pid": pid, "tid": d["tid"],
+                "args": args,
+            })
+    return out
+
+
+def export_chrome_trace(path: str, include_host_tracer: bool = True) -> str:
+    """Write every stored span as a Chrome/Perfetto-loadable JSON file,
+    merged with the profiler host tracer's RecordEvent spans when that
+    module is loaded (``sys.modules`` probe — exporting a trace must
+    never be the thing that initializes jax)."""
+    events = chrome_events()
+    if include_host_tracer:
+        prof = sys.modules.get("paddle_tpu.profiler")
+        if prof is not None:
+            events = events + list(prof._tracer.events)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events}, f)
+    return path

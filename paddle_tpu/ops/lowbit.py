@@ -42,9 +42,12 @@ def qmax_for_bits(bits: int) -> int:
 
 
 def _count(name, **labels):
-    """Per-trace telemetry (shape metadata only — safe on tracers)."""
+    """Per-trace telemetry (shape metadata only — safe on tracers).
+    `name` is a full `subsystem/metric` literal at every call site
+    (tools/lint_metrics.py checks those; this helper is the one
+    documented dynamic registration)."""
     if monitor.enabled():
-        c = monitor.counter(f"lowbit/{name}")
+        c = monitor.counter(name)   # metric-ok: literal at call sites
         (c.labels(**labels) if labels else c).inc()
 
 
@@ -83,7 +86,7 @@ def dequantize_arrays(q, scale, axis=None):
     """``q * scale`` in float32.  `axis`: the axis the per-channel scale
     runs along (so it broadcasts against q); None = scalar/pre-broadcast
     scale."""
-    _count("dequant_calls", site="dequantize")
+    _count("lowbit/dequant_calls", site="dequantize")
     q = jnp.asarray(q).astype(jnp.float32)
     scale = jnp.asarray(scale, jnp.float32)
     if axis is not None and scale.ndim:
@@ -136,7 +139,7 @@ def quantized_matmul_arrays(x, qweight, scale, bits=8, in_features=None):
               constant along the contracted axis.
     Returns [..., out] in x's dtype.
     """
-    _count("dequant_calls", site="matmul")
+    _count("lowbit/dequant_calls", site="matmul")
     x = jnp.asarray(x)
     if bits == 4:
         rows = int(in_features if in_features is not None else x.shape[-1])

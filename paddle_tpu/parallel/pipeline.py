@@ -101,16 +101,15 @@ def _pipeline_telemetry(schedule, pp, M, v, ticks, t0, sample):
         return
     jax.block_until_ready(sample)   # time the run, not just the dispatch
     dt = time.perf_counter() - t0
-    lab = {"schedule": schedule}
     # per-tick time ~ per-stage per-microbatch slot time
-    monitor.histogram("pipeline/stage_time").labels(**lab).observe(
-        dt / max(1, ticks))
+    monitor.histogram("pipeline/stage_time").labels(
+        schedule=schedule).observe(dt / max(1, ticks))
     # warm-up/drain bubble of the schedule: pp-1 idle slots out of
     # M*v + pp - 1 total (v = virtual stages per device; 1F1B has the
     # same fraction over its doubled fwd+bwd slot count)
-    monitor.gauge("pipeline/bubble_fraction").labels(**lab).set(
+    monitor.gauge("pipeline/bubble_fraction").labels(schedule=schedule).set(
         (pp - 1) / (M * v + pp - 1))
-    monitor.counter("pipeline/microbatches").labels(**lab).add(M)
+    monitor.counter("pipeline/microbatches").labels(schedule=schedule).add(M)
 
 
 _LOW_FLOAT = ("bfloat16", "float16")

@@ -258,8 +258,14 @@ class Profiler:
         return xplane.format_op_table(stats, top=top, time_unit=time_unit)
 
     def _export_chrome(self, fname):
+        # one timeline: RecordEvent host spans + monitor.trace framework
+        # spans (same perf_counter_ns timebase, so Perfetto interleaves
+        # them correctly; trace spans carry trace_id/span_id in args)
+        from ..monitor import trace as _mtrace
+
+        events = list(_tracer.events) + _mtrace.chrome_events()
         with open(fname, "w") as f:
-            json.dump({"traceEvents": _tracer.events}, f)
+            json.dump({"traceEvents": events}, f)
 
     def export(self, path, format="json"):
         self._export_chrome(path)

@@ -35,6 +35,7 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from .. import monitor
+from ..monitor import trace as mtrace
 from . import faults
 
 __all__ = ["CheckpointManager", "CheckpointError"]
@@ -127,6 +128,11 @@ class CheckpointManager:
         return self._save_sync(step, state_dict)
 
     def _save_sync(self, step: int, state_dict: Dict) -> str:
+        with mtrace.span("resilience/ckpt_save", step=step,
+                         arrays=len(state_dict)):
+            return self._save_sync_body(step, state_dict)
+
+    def _save_sync_body(self, step: int, state_dict: Dict) -> str:
         from ..distributed import checkpoint as dckpt
 
         final = self._final_dir(step)
@@ -208,6 +214,10 @@ class CheckpointManager:
         return state
 
     def _try_restore(self, step: int, strict: bool) -> Optional[Dict]:
+        with mtrace.span("resilience/ckpt_restore", step=step):
+            return self._try_restore_body(step, strict)
+
+    def _try_restore_body(self, step: int, strict: bool) -> Optional[Dict]:
         from ..distributed import checkpoint as dckpt
 
         path = self._final_dir(step)

@@ -18,6 +18,8 @@ Keys:
 - ``hard``  — for ``ckpt_crash``: 1 = kill the process with SIGKILL
   (uncatchable, the true "power loss mid-write"), 0 = raise
   :class:`InjectedCrash` (catchable, for in-process tests).
+- ``secs``  — for ``stall``: how long the injected hang sleeps
+  (default 2.0).
 
 Kinds wired into the framework:
 
@@ -29,6 +31,10 @@ Kinds wired into the framework:
 - ``nan_grad``   — consulted by `StepGuard` right after the wrapped step:
   the updated params are poisoned with NaN, simulating an optimizer
   update driven by non-finite gradients.
+- ``stall``      — consulted by `LLMEngine.step` (site
+  ``engine.step``): the step blocks for ``secs`` without completing any
+  span, the deterministic "distributed hang" that
+  `monitor.watchdog` must catch (tests/test_trace.py).
 
 Everything is inert (one None check) when ``PTPU_FAULTS`` is unset.
 """
@@ -37,12 +43,14 @@ from __future__ import annotations
 import os
 import signal
 import threading
+import time
 from typing import Optional
 
 from .. import monitor
 
 __all__ = ["FaultPlan", "InjectedCrash", "InjectedFault", "get_plan",
-           "set_plan", "should_fire", "maybe_raise", "maybe_crash"]
+           "set_plan", "should_fire", "maybe_raise", "maybe_crash",
+           "maybe_stall"]
 
 
 class InjectedFault(Exception):
@@ -54,14 +62,16 @@ class InjectedCrash(InjectedFault):
 
 
 class _Fault:
-    __slots__ = ("kind", "step", "site", "times", "hard", "fired")
+    __slots__ = ("kind", "step", "site", "times", "hard", "secs", "fired")
 
-    def __init__(self, kind, step=None, site=None, times=1, hard=0):
+    def __init__(self, kind, step=None, site=None, times=1, hard=0,
+                 secs=2.0):
         self.kind = kind
         self.step = step
         self.site = site
         self.times = times      # 0 = unlimited
         self.hard = hard
+        self.secs = secs
         self.fired = 0
 
     def matches(self, kind, site, step):
@@ -97,12 +107,14 @@ class FaultPlan:
                 k, _, v = item.partition("=")
                 if k in ("step", "times", "hard"):
                     kw[k] = int(v)
+                elif k == "secs":
+                    kw[k] = float(v)
                 elif k == "site":
                     kw[k] = v
                 else:
                     raise ValueError(
                         f"PTPU_FAULTS: unknown key {k!r} in {part!r} "
-                        "(known: step, site, times, hard)")
+                        "(known: step, site, times, hard, secs)")
             self._faults.append(_Fault(kind.strip(), **kw))
         self._ctr = monitor.counter("resilience/faults_injected",
                                     "deterministic injected failures")
@@ -153,6 +165,18 @@ class FaultPlan:
         raise InjectedCrash(f"injected checkpoint crash in {site} "
                             f"(step={step})")
 
+    def maybe_stall(self, site: str = None, step=None) -> None:
+        """stall: block for the fault's ``secs`` without completing any
+        span/step — the deterministic distributed-hang the
+        `monitor.watchdog` post-mortem path is proven against."""
+        f = self._find("stall", site=site, step=step)
+        if f is None:
+            return
+        with self._lock:
+            f.fired += 1
+        self._ctr.labels(kind="stall").inc()
+        time.sleep(f.secs)
+
 
 # -- process-wide plan ------------------------------------------------------
 _plan: Optional[FaultPlan] = None
@@ -192,3 +216,9 @@ def maybe_crash(site="checkpoint", step=None):
     p = get_plan()
     if p is not None:
         p.maybe_crash(site=site, step=step)
+
+
+def maybe_stall(site=None, step=None):
+    p = get_plan()
+    if p is not None:
+        p.maybe_stall(site=site, step=step)

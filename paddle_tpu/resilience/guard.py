@@ -43,6 +43,7 @@ from typing import Optional
 import jax.numpy as jnp
 
 from .. import monitor
+from ..monitor import trace as mtrace
 from . import faults
 
 __all__ = ["StepGuard", "GuardedStepInfo"]
@@ -185,6 +186,7 @@ class StepGuard:
                 if self._good_steps % self.snapshot_every == 0:
                     # post-step state of a verified-healthy step
                     self._good_snap = self._capture()
+                mtrace.heartbeat()   # watchdog liveness: a step completed
                 return result, GuardedStepInfo(True, _loss_array(result),
                                                retries=retries)
             # -- bad step ---------------------------------------------------
@@ -192,7 +194,13 @@ class StepGuard:
             # skip the update entirely — scaler included, so a retried
             # step runs from EXACTLY the unfaulted pre-state (the
             # bit-for-bit parity property)
-            self._restore(pre, restore_scaler=True)
+            with mtrace.span("resilience/step_restore", step=step,
+                             attempt=retries):
+                self._restore(pre, restore_scaler=True)
+            # a bad step that restored IS forward progress — without this
+            # beat a NaN storm under a watchdog (tracing off, so no span
+            # ends fire) would read as a stall and spew false dumps
+            mtrace.heartbeat()
             if retries < self.max_retries_per_step:
                 retries += 1
                 continue
@@ -206,7 +214,9 @@ class StepGuard:
             if self.rollback_after > 0 and \
                     self._bad_streak >= self.rollback_after and \
                     self._good_snap is not None:
-                self._restore(self._good_snap, restore_scaler=True)
+                with mtrace.span("resilience/rollback", step=step,
+                                 bad_streak=self._bad_streak):
+                    self._restore(self._good_snap, restore_scaler=True)
                 self._m_rollbacks.inc()
                 self._bad_streak = 0
                 self._m_streak.set(0)

@@ -18,6 +18,7 @@ import time
 from typing import Callable, Optional, Tuple, Type
 
 from .. import monitor
+from ..monitor import flight as _flight
 
 __all__ = ["retry", "Deadline", "PreemptionHandler", "DEFAULT_RETRYABLE"]
 
@@ -171,6 +172,11 @@ class PreemptionHandler:
             return
         self._ctr.inc()
         self._event.set()
+        # post-mortem breadcrumb trail: with PTPU_FLIGHT_DIR set, the
+        # last spans/notes are on disk even if the grace period runs out
+        # before the step-boundary checkpoint lands (signal-safe form:
+        # helper thread + bounded join, never inline lock acquisition)
+        _flight.dump_from_signal("preemption", extra={"signal": int(signum)})
 
     @property
     def triggered(self) -> bool:

@@ -49,6 +49,16 @@ Monitor wiring (PR-1 StatRegistry): `serving/queue_depth`,
 `serving/decode_tokens`, `serving/prefill_tps`, `serving/decode_tps`,
 `serving/preemptions`, `serving/requests_finished`, plus
 `serving/step_time` histograms labeled by phase.
+
+Observability v2 (monitor.trace): with PTPU_TRACE=1 every request gets a
+trace — root `serving/request` span with `serving/queue_wait`,
+`serving/prefill` (one per chunk), and `serving/decode_step` children —
+readable via `request_trace(rid)`, `/traces/<id>` on the live endpoint
+(`EngineConfig(metrics_port=...)`), or `trace.export_chrome_trace()`.
+Per-request latency decomposes into `serving/ttft` (arrival → first
+token) and `serving/tpot` (inter-token) histograms, recorded whenever
+the monitor is on (tracing not required); `serving/compiles{kind}`
+counts step-program cache misses.
 """
 from __future__ import annotations
 
@@ -61,6 +71,8 @@ import jax
 import jax.numpy as jnp
 
 from .. import monitor
+from ..monitor import trace as mtrace
+from ..resilience import faults
 from ..resilience.retry import Deadline
 from ..ops.paged_attention import (paged_attention_arrays,
                                    paged_cache_update_arrays,
@@ -88,6 +100,10 @@ class EngineConfig:
     # (fp32) the blocks, at a documented decode tolerance vs fp — see
     # tests/test_lowbit.py.  None = full-precision pools (exact parity).
     kv_cache_dtype: Optional[str] = None
+    # launch monitor.serve's live endpoint (/metrics, /healthz,
+    # /traces/<id>) on this port when the engine boots; 0 = ephemeral
+    # (read it back from engine.metrics_server.port), None = no server.
+    metrics_port: Optional[int] = None
 
 
 class LLMEngine:
@@ -176,6 +192,25 @@ class LLMEngine:
         self._m_expired = m.counter("serving/deadline_expired",
                                     "requests aborted past deadline_s")
         self._m_step = m.histogram("serving/step_time")
+        self._m_ttft = m.histogram("serving/ttft",
+                                   "arrival to first token, seconds")
+        self._m_tpot = m.histogram("serving/tpot",
+                                   "inter-token latency after the first, "
+                                   "seconds")
+        self._m_compiles = m.counter("serving/compiles",
+                                     "step-program cache misses")
+        # rid -> trace_id survives release_request (the spans live in the
+        # bounded monitor.trace store, not on the request); bounded like
+        # that store — entries past it map to evicted traces anyway, and
+        # an unbounded dict would leak one entry per request served
+        from collections import OrderedDict
+
+        self._trace_ids: "OrderedDict" = OrderedDict()
+        self.metrics_server = None
+        if c.metrics_port is not None:
+            from ..monitor import serve as mserve
+
+            self.metrics_server = mserve.start_server(c.metrics_port)
 
     # -- request API --------------------------------------------------------
 
@@ -195,6 +230,7 @@ class LLMEngine:
         req.key = self._init_key(params)
         if params.deadline_s is not None:
             req.deadline = Deadline(params.deadline_s)
+        self._begin_trace(req)
         self._requests[req.req_id] = req
         self.scheduler.add(req)
         return req.req_id
@@ -227,9 +263,44 @@ class LLMEngine:
         # the (shared) last block — privatize it now so the child's
         # recomputation can never perturb the parent's cache
         self.cache.privatize_last_block(req.req_id)
+        self._begin_trace(req, forked_from=parent_id)
         self._requests[req.req_id] = req
         self.scheduler.add(req)
         return req.req_id
+
+    def _begin_trace(self, req, **attrs) -> None:
+        """Stamp arrival (TTFT's zero point) and, with tracing on, open
+        the request's root span + its queue-wait child."""
+        req.arrival_t = time.perf_counter()
+        if mtrace.enabled():
+            root = mtrace.start_span(
+                "serving/request", rid=req.req_id,
+                prompt_len=req.prompt_len,
+                max_new_tokens=req.params.max_new_tokens, **attrs)
+            req.trace = root
+            req.queue_span = mtrace.start_span("serving/queue_wait",
+                                               parent=root)
+            self._trace_ids[req.req_id] = root.trace_id
+            while len(self._trace_ids) > mtrace._MAX_TRACES:
+                self._trace_ids.popitem(last=False)
+
+    def _end_trace(self, req, finish: str) -> None:
+        """Close the request's open spans (idempotent — step() ends
+        finished requests, release_request() ends aborted ones)."""
+        if req.queue_span is not None:
+            req.queue_span.end(finish=finish)
+            req.queue_span = None
+        if req.trace is not None:
+            req.trace.end(finish=finish, tokens=len(req.output_ids))
+            req.trace = None
+
+    def request_trace(self, req_id) -> list:
+        """The request's finished spans (start-ordered dicts with
+        trace/span/parent ids, ts_us/dur_us, attrs) — valid after the
+        request is released; [] when it was never traced (PTPU_TRACE off
+        at add time) or its trace aged out of the bounded store."""
+        tid = self._trace_ids.get(req_id)
+        return [] if tid is None else mtrace.get_trace(tid)
 
     @staticmethod
     def _init_key(params: SamplingParams):
@@ -253,8 +324,12 @@ class LLMEngine:
         prompt/output token list forever.  `generate()` releases its own
         requests."""
         req = self._requests.pop(req_id, None)
-        if req is None or req.finished:
+        if req is None:
             return
+        if req.finished:
+            self._end_trace(req, "stop")
+            return
+        self._end_trace(req, "abort")
         sched = self.scheduler
         if req in sched.running:
             sched.running.remove(req)
@@ -317,6 +392,10 @@ class LLMEngine:
         """One scheduler decision + one jitted exec.  Returns the requests
         that FINISHED this step."""
         t0 = time.perf_counter()
+        # deterministic hang injection (PTPU_FAULTS="stall@site=engine.step,
+        # secs=..."): the step blocks here, completing no span, so the
+        # monitor.watchdog post-mortem path is provable in tests
+        faults.maybe_stall(site="engine.step")
         self._expire_deadlines()
         out = self.scheduler.schedule()
         if out.preempted:
@@ -332,7 +411,10 @@ class LLMEngine:
         done = self.scheduler.retire_finished()
         for req in done:
             self._m_done.inc()
+            self._end_trace(req, "stop")
         dt = time.perf_counter() - t0
+        mtrace.heartbeat()   # step completed — feed the watchdog even
+        #                      with tracing off (no span ends to beat)
         if monitor.enabled():
             self._m_step.labels(phase=phase).observe(dt)
             if phase == "prefill":
@@ -358,6 +440,20 @@ class LLMEngine:
     def _step_prefill(self, out):
         req = out.prefill_request
         start, chunk = out.chunk_start, out.chunk_len
+        if req.queue_span is not None:   # first compute: queue wait over
+            req.queue_span.end()
+            req.queue_span = None
+        sp = None
+        if req.trace is not None:
+            sp = mtrace.start_span("serving/prefill", parent=req.trace,
+                                   chunk_start=start, chunk_len=chunk)
+        try:
+            self._prefill_body(req, start, chunk)
+        finally:
+            if sp is not None:
+                sp.end()
+
+    def _prefill_body(self, req, start, chunk):
         ids = np.asarray([req.prompt_ids[start:start + chunk]], np.int32)
         positions = np.arange(start, start + chunk, dtype=np.int64)
         slots = np.asarray(
@@ -389,6 +485,16 @@ class LLMEngine:
 
     def _step_decode(self, out):
         rows = list(out.decode_requests)
+        spans = [mtrace.start_span("serving/decode_step", parent=r.trace,
+                                   pos=r.total_len - 1, batch=len(rows))
+                 for r in rows if r.trace is not None]
+        try:
+            self._decode_body(rows)
+        finally:
+            for sp in spans:
+                sp.end()
+
+    def _decode_body(self, rows):
         n = len(rows)
         bb = 1
         while bb < n:
@@ -437,9 +543,19 @@ class LLMEngine:
                             jnp.asarray(topp))
         toks = np.asarray(toks)
         new_keys = np.asarray(new_keys)
+        now = time.perf_counter()
         for i, req in enumerate(rows):
             req.key = jnp.asarray(new_keys[i], jnp.uint32)
             req.record_token(int(toks[i]))
+            # per-request latency attribution: TTFT from arrival, TPOT
+            # between consecutive tokens (the serving-paper decomposition)
+            if req.first_token_t is None:
+                req.first_token_t = now
+                if req.arrival_t is not None:
+                    self._m_ttft.observe(now - req.arrival_t)
+            else:
+                self._m_tpot.observe(now - req.last_token_t)
+            req.last_token_t = now
 
     # -- array plumbing -----------------------------------------------------
 
@@ -508,6 +624,8 @@ class LLMEngine:
     def _get_prefill_exec(self, p_len):
         key = ("prefill", p_len)
         if key not in self._jit_cache:
+            self._m_compiles.labels(kind="prefill").inc()
+
             def fn(params, kv_flat, ids, slots):
                 from ..ops.pallas_ops import flash_attention_arrays
 
@@ -542,6 +660,8 @@ class LLMEngine:
     def _get_chunk_exec(self, b, c):
         key = ("chunk", b, c)
         if key not in self._jit_cache:
+            self._m_compiles.labels(kind="chunk").inc()
+
             def fn(params, kv_flat, ids, pos0, tables, slots):
                 pos = pos0[:, None] + jnp.arange(c, dtype=jnp.int32)[None]
                 x = jnp.take(params["wte"], ids, axis=0) \
@@ -579,6 +699,8 @@ class LLMEngine:
     def _get_sample_exec(self, b):
         key = ("sample", b)
         if key not in self._jit_cache:
+            self._m_compiles.labels(kind="sample").inc()
+
             def row(l, key_, ds, t, k, p):
                 # replicates models.gpt._sample_next on a [1, V] row so a
                 # request reproduces its solo generate() stream exactly

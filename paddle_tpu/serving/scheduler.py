@@ -63,6 +63,12 @@ class Request:
         self.swap = None                   # host KV snapshot while evicted
         self.arrival = None                # admission tiebreak (set by add)
         self.deadline = None               # resilience.Deadline (engine)
+        # -- observability (engine-owned; monitor.trace v2) ----------------
+        self.trace = None                  # root Span, or None (trace off)
+        self.queue_span = None             # open queue-wait child Span
+        self.arrival_t = None              # perf_counter at add_request
+        self.first_token_t = None          # perf_counter of token 1 (TTFT)
+        self.last_token_t = None           # perf_counter of latest token
 
     # -- derived ------------------------------------------------------------
 

@@ -13,9 +13,21 @@ Low-bit mode (the paddle_tpu.lowbit runtime end-to-end):
 --kv-cache-dtype int8 serves from a quantized KV pool (asserting it
 holds ≥1.9× the blocks of the fp pool for the same byte budget).
 
+Trace mode (the monitor v2 observability layer end-to-end):
+
+    python scripts/serve_smoke.py --trace
+
+--trace enables span tracing, boots the /metrics //healthz //traces
+endpoint on an ephemeral port, and asserts the ISSUE-5 acceptance: the
+run must yield serving/ttft + serving/tpot histograms with nonzero
+counts and p50/p95, a Chrome/Perfetto-loadable trace JSON in which one
+request's queue/prefill/decode spans are parent-linked under a single
+trace_id, and live endpoint responses; it prints the TTFT/TPOT
+percentiles plus a sample request trace.
+
 tests/test_serving.py runs the plain mode, tests/test_lowbit.py the
-quantized one (both fast tier), so each is a "does the engine boot
-outside the test harness" guard.
+quantized one, tests/test_trace.py the trace one (all fast tier), so
+each is a "does the engine boot outside the test harness" guard.
 """
 import os
 import sys
@@ -47,9 +59,14 @@ def main():
                     help="weight-only quantize the model (lowbit)")
     ap.add_argument("--kv-cache-dtype", choices=["int8"], default=None,
                     help="serve from a quantized KV pool (lowbit)")
+    ap.add_argument("--trace", action="store_true",
+                    help="enable span tracing + the live endpoint and "
+                         "assert/print the v2 observability surface")
     args = ap.parse_args()
 
     monitor.refresh()
+    if args.trace:
+        monitor.trace.enable(True)
     paddle.seed(0)
     cfg = gpt_test_config(stacked_blocks=True, sequence_parallel=False)
     model = GPTForCausalLM(cfg)
@@ -83,7 +100,8 @@ def main():
               f"greedy agreement {agree:.2f} vs fp")
         del dense, qdense
     engine = LLMEngine(model, EngineConfig(
-        block_size=16, max_num_seqs=4, kv_cache_dtype=args.kv_cache_dtype))
+        block_size=16, max_num_seqs=4, kv_cache_dtype=args.kv_cache_dtype,
+        metrics_port=0 if args.trace else None))
     if args.kv_cache_dtype:
         fp = LLMEngine(model, EngineConfig(block_size=16, max_num_seqs=4))
         ratio = engine.cache.num_blocks / fp.cache.num_blocks
@@ -120,7 +138,75 @@ def main():
         low = sorted(k for k in snap if k.startswith("lowbit/"))
         assert low, "lowbit mode must emit lowbit/* metrics"
         print("lowbit metrics:", ", ".join(low))
+    if args.trace:
+        check_trace(engine, snap, len(prompts))
     print("OK")
+
+
+def check_trace(engine, snap, n_requests):
+    """ISSUE 5 acceptance (a)+(b) + endpoint: latency histograms with
+    percentiles, a parent-linked per-request trace, a loadable chrome
+    JSON, and live /metrics //healthz //traces responses."""
+    import json
+    import tempfile
+    import urllib.request
+
+    # (a) TTFT/TPOT histograms with nonzero counts and p50/p95
+    for name in ("serving/ttft", "serving/tpot"):
+        h = snap.get(name)
+        assert h and h["count"] > 0, (name, h)
+        assert "p50" in h and "p95" in h, (name, h)
+    ttft, tpot = snap["serving/ttft"], snap["serving/tpot"]
+    assert ttft["count"] == n_requests, ttft
+    print(f"ttft: n={ttft['count']} p50={ttft['p50']*1e3:.1f}ms "
+          f"p95={ttft['p95']*1e3:.1f}ms | tpot: n={tpot['count']} "
+          f"p50={tpot['p50']*1e3:.2f}ms p95={tpot['p95']*1e3:.2f}ms")
+
+    # (b) one request's spans, parent-linked under one trace_id
+    spans = engine.request_trace(0)
+    assert spans, "request 0 left no trace"
+    roots = [s for s in spans if s["parent_id"] is None]
+    assert len(roots) == 1 and roots[0]["name"] == "serving/request", spans
+    root = roots[0]
+    ids = {s["span_id"] for s in spans}
+    assert all(s["trace_id"] == root["trace_id"] for s in spans)
+    assert all(s["parent_id"] in ids for s in spans
+               if s["parent_id"] is not None)
+    names = [s["name"] for s in spans]
+    for needed in ("serving/queue_wait", "serving/prefill",
+                   "serving/decode_step"):
+        assert needed in names, names
+    print("request 0 trace:")
+    for s in spans:
+        indent = "  " if s["parent_id"] else ""
+        print(f"  {indent}{s['name']:24s} {s['dur_us']/1e3:9.2f} ms "
+              f"{s['attrs']}")
+
+    path = os.path.join(tempfile.gettempdir(),
+                        f"ptpu_serve_trace_{os.getpid()}.json")
+    monitor.trace.export_chrome_trace(path)
+    with open(path) as f:
+        events = json.load(f)["traceEvents"]
+    mine = [e for e in events
+            if e.get("args", {}).get("trace_id") == root["trace_id"]]
+    assert len(mine) == len(spans), (len(mine), len(spans))
+    assert all({"name", "ph", "ts", "dur", "pid", "tid"} <= set(e)
+               for e in mine)
+    print(f"chrome trace: {path} ({len(events)} events)")
+
+    # live endpoint
+    srv = engine.metrics_server
+    txt = urllib.request.urlopen(srv.url + "/metrics",
+                                 timeout=10).read().decode()
+    assert "serving_ttft_bucket" in txt and "serving_tpot_count" in txt
+    hz = json.loads(urllib.request.urlopen(srv.url + "/healthz",
+                                           timeout=10).read())
+    assert hz["status"] == "ok" and hz["trace_enabled"]
+    tr = json.loads(urllib.request.urlopen(
+        srv.url + "/traces/" + root["trace_id"], timeout=10).read())
+    assert len(tr) == len(spans)
+    print(f"endpoint {srv.url}: /metrics /healthz /traces ok")
+    monitor.stop_server()
 
 
 if __name__ == "__main__":

@@ -178,6 +178,65 @@ def test_thread_safety_concurrent_increments():
     assert monitor.snapshot()["t/mt_h"]["count"] == N * T
 
 
+def test_histogram_percentiles_interpolated():
+    """percentile(q) interpolates inside the bucket holding the rank and
+    snapshot() carries p50/p95/p99 (ISSUE 5 satellite)."""
+    h = monitor.histogram("t/pct", buckets=[1.0, 2.0, 4.0, 8.0])
+    for v in (0.5, 1.5, 1.5, 3.0, 3.0, 3.0, 7.0, 7.0, 7.0, 7.0):
+        h.observe(v)
+    snap = monitor.snapshot()["t/pct"]
+    assert snap["min"] == 0.5 and snap["max"] == 7.0
+    assert snap["min"] <= snap["p50"] <= snap["p95"] <= snap["p99"] \
+        <= snap["max"]
+    # rank 5 of 10 falls in the (2, 4] bucket (3 of its obs) → inside it
+    assert 2.0 <= snap["p50"] <= 4.0
+    # p99 (rank 9.9) is in the last occupied bucket, clamped by max
+    assert 4.0 <= snap["p99"] <= 7.0
+    assert h.percentile(50) == snap["p50"]
+    assert h.percentile(0) == 0.5            # clamps to observed min
+    assert h.percentile(100) == 7.0          # ... and max
+    assert monitor.histogram("t/pct_empty").percentile(95) == 0.0
+
+
+def test_histogram_percentile_single_bucket_stays_in_range():
+    h = monitor.histogram("t/pct1")
+    for _ in range(100):
+        h.observe(0.0123)
+    for q in (1, 50, 99):
+        assert h.percentile(q) == pytest.approx(0.0123)
+
+
+def test_percentiles_reach_profiler_summary():
+    from paddle_tpu import profiler
+
+    monitor.histogram("t/summ").observe(0.25)
+    with profiler.Profiler(timer_only=True) as prof:
+        prof.step()
+    text = prof.summary()
+    assert "t/summ" in text and "p50=" in text and "p95=" in text
+
+
+def test_gauge_callback_error_keeps_exporting():
+    """Regression (ISSUE 5 satellite): an exception inside a callback
+    gauge during snapshot/render must not take down the exporter — it is
+    counted in monitor/gauge_errors{name} and rendering continues."""
+    monitor.gauge("t/boom", fn=lambda: 1 / 0)
+    monitor.counter("t/alive").inc()
+
+    snap = monitor.snapshot()                 # must not raise
+    assert snap["t/boom"] == 0.0 and snap["t/alive"] == 1.0
+    text = monitor.export_prometheus()        # must not raise either
+    assert "t_alive 1" in text and "t_boom 0" in text
+    assert "t/alive" in monitor.render()      # render survives too
+    # the failure is visible, per failing gauge, and accumulates
+    errs = monitor.snapshot()["monitor/gauge_errors"]
+    assert errs["name=t/boom"] >= 2.0         # snapshot + prometheus
+    # a healthy callback gauge next to it still samples live
+    box = {"v": 5.0}
+    monitor.gauge("t/fine", fn=lambda: box["v"])
+    assert monitor.snapshot()["t/fine"] == 5.0
+
+
 # -- exporters ------------------------------------------------------------
 
 _PROM_LINE = re.compile(

@@ -418,18 +418,26 @@ class TestMonitorAndSmoke:
         assert snap["serving/blocks_in_use"] == 0   # all freed at the end
 
     def test_serve_smoke_script(self):
+        # --trace: the ISSUE-5 observability acceptance (ttft/tpot
+        # percentiles, parent-linked request trace, chrome export, live
+        # endpoint) asserts in-script ON TOP of the plain smoke checks,
+        # so one subprocess covers both (tests/test_trace.py leans on
+        # this invocation)
         script = (pathlib.Path(__file__).resolve().parent.parent
                   / "scripts" / "serve_smoke.py")
         env = {k: v for k, v in os.environ.items()
-               if k not in ("PYTHONPATH", "XLA_FLAGS")}
+               if k not in ("PYTHONPATH", "XLA_FLAGS", "PTPU_FAULTS")}
         env["PTPU_FORCE_PLATFORM"] = "cpu"
         env["JAX_PLATFORMS"] = "cpu"
         env["PTPU_MONITOR"] = "1"
-        proc = subprocess.run([sys.executable, str(script)], env=env,
-                              capture_output=True, text=True, timeout=560)
+        proc = subprocess.run([sys.executable, str(script), "--trace"],
+                              env=env, capture_output=True, text=True,
+                              timeout=560)
         assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
         assert "OK" in proc.stdout
         assert "tokens/s" in proc.stdout
+        assert "ttft:" in proc.stdout and "request 0 trace:" in proc.stdout
+        assert "chrome trace:" in proc.stdout
 
 
 class TestPagedAttentionOp:

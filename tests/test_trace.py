@@ -1,0 +1,461 @@
+"""paddle_tpu.monitor v2 — span tracing, flight recorder, watchdog, live
+endpoint (ISSUE 5 tentpole).
+
+The bar: a disabled span costs < 1 µs (mirroring the PR-1 metric guard);
+context propagates across threads; a traced serving request decomposes
+into queue-wait → prefill → per-step decode spans whose durations sum to
+(approximately) the request's wall time, with `serving/ttft` and
+`serving/tpot` histograms populated; a SIGTERM'd subprocess leaves a
+parseable flight-recorder dump holding its last spans; a
+PTPU_FAULTS-injected stall triggers the watchdog dump with all-thread
+py-stacks; and `/metrics` //healthz //traces serve live state.
+"""
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor
+from paddle_tpu.monitor import flight, trace
+from paddle_tpu.resilience import faults
+
+_WORKER = pathlib.Path(__file__).resolve().parent / "workers" / \
+    "flight_worker.py"
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    monitor.reset()
+    monitor.enable(True)
+    trace.enable(True)
+    trace.reset()
+    flight.get_recorder().clear()
+    faults.set_plan(None)
+    yield
+    faults.set_plan(None)
+    trace.enable(False)
+    trace.reset()
+    monitor.reset()
+    monitor.refresh()
+    trace.refresh()
+
+
+# ---------------------------------------------------------------------------
+# span API
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_and_identity():
+    with trace.span("t/outer", k=1) as outer:
+        assert trace.current_span() is outer
+        with trace.span("t/inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+            time.sleep(0.005)
+    assert trace.current_span() is None
+    spans = trace.get_trace(outer.trace_id)
+    assert [s["name"] for s in spans] == ["t/outer", "t/inner"]
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["t/outer"]["parent_id"] is None
+    assert by_name["t/outer"]["attrs"] == {"k": 1}
+    assert by_name["t/inner"]["dur_us"] >= 4000
+    # outer covers inner on the same timebase
+    assert by_name["t/outer"]["ts_us"] <= by_name["t/inner"]["ts_us"]
+    assert by_name["t/outer"]["dur_us"] >= by_name["t/inner"]["dur_us"]
+
+
+def test_span_error_annotation():
+    with pytest.raises(ValueError):
+        with trace.span("t/fails") as s:
+            raise ValueError("boom")
+    rec = trace.get_trace(s.trace_id)[0]
+    assert rec["attrs"]["error"] == "ValueError"
+
+
+def test_manual_span_and_separate_traces():
+    a = trace.start_span("t/a")
+    b = trace.start_span("t/b")
+    assert a.trace_id != b.trace_id       # no parent → distinct traces
+    child = trace.start_span("t/a_child", parent=a)
+    child.end()
+    a.end()
+    b.end(tokens=3)
+    assert {s["name"] for s in trace.get_trace(a.trace_id)} == \
+        {"t/a", "t/a_child"}
+    assert trace.get_trace(b.trace_id)[0]["attrs"] == {"tokens": 3}
+
+
+def test_end_is_idempotent():
+    s = trace.start_span("t/once")
+    s.end()
+    dur = s.dur_us
+    s.end(extra=1)                        # second end: no re-record
+    assert s.dur_us == dur
+    spans = trace.get_trace(s.trace_id)
+    assert len(spans) == 1 and "extra" not in spans[0]["attrs"]
+
+
+def test_context_propagation_across_threads():
+    root = trace.start_span("t/root")
+    seen = {}
+
+    def worker():
+        # worker thread starts with NO context of its own...
+        seen["before"] = trace.current_span()
+        with trace.attach(root):
+            with trace.span("t/thread_child") as c:
+                seen["child"] = c
+        seen["after"] = trace.current_span()
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    root.end()
+    assert seen["before"] is None and seen["after"] is None
+    assert seen["child"].trace_id == root.trace_id
+    assert seen["child"].parent_id == root.span_id
+    spans = trace.get_trace(root.trace_id)
+    assert {s["name"] for s in spans} == {"t/root", "t/thread_child"}
+
+
+def test_disabled_overhead_guard():
+    """A disabled span must stay < 1 µs amortized so PTPU_TRACE=0 can
+    never regress a hot path (the PR-1 guard, tracing edition)."""
+    trace.enable(False)
+    try:
+        n, per_call = 50_000, float("inf")
+        for _ in range(4):           # min-of-rounds: a loaded shared
+            t0 = time.perf_counter()  # host must not flake the bound
+            for i in range(n):
+                with trace.span("t/overhead", step=i):
+                    pass
+            per_call = min(per_call, (time.perf_counter() - t0) / n)
+    finally:
+        trace.enable(True)
+    assert per_call < 1e-6, f"disabled span costs {per_call*1e9:.0f} ns"
+    assert trace.get_trace("t/overhead") == []   # nothing recorded
+
+
+def test_disabled_records_nothing():
+    trace.enable(False)
+    s = trace.start_span("t/phantom")
+    with trace.span("t/phantom2"):
+        pass
+    s.end()
+    trace.enable(True)
+    assert not s                             # the null singleton is falsy
+    assert trace.trace_ids() == []
+
+
+def test_trace_store_is_bounded():
+    for i in range(trace._MAX_TRACES + 20):
+        trace.start_span("t/flood").end()
+    assert len(trace.trace_ids()) <= trace._MAX_TRACES
+
+
+def test_chrome_export_merges_profiler_events(tmp_path):
+    from paddle_tpu import profiler
+
+    with profiler.Profiler(timer_only=True):
+        with profiler.RecordEvent("host/op"):
+            pass
+        with trace.span("t/framework") as s:
+            pass
+        path = str(tmp_path / "merged.json")
+        prof_export = str(tmp_path / "prof.json")
+        trace.export_chrome_trace(path)
+    events = json.load(open(path))["traceEvents"]
+    names = [e["name"] for e in events]
+    assert "host/op" in names and "t/framework" in names
+    fw = [e for e in events if e["name"] == "t/framework"][0]
+    assert fw["args"]["trace_id"] == s.trace_id
+    assert {"ph", "ts", "dur", "pid", "tid"} <= set(fw)
+    # and the profiler's own chrome export picks up framework spans too
+    prof = profiler.Profiler(timer_only=True)
+    prof._export_chrome(prof_export)
+    names2 = [e["name"] for e in json.load(open(prof_export))["traceEvents"]]
+    assert "t/framework" in names2
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def test_ring_records_spans_and_notes_bounded():
+    rec = flight.get_recorder()
+    for i in range(rec.maxlen + 50):
+        trace.start_span("t/ring").end()
+    flight.note("checkpoint", step=7)
+    records = rec.records()
+    assert len(records) == rec.maxlen        # bounded
+    json.dumps(records)                      # ring is dump-serializable
+    assert records[-1]["kind"] == "note"
+    assert records[-1]["event"] == "checkpoint"
+    assert all(r["kind"] in ("span", "note") for r in records)
+
+
+def test_dump_is_parseable_and_complete(tmp_path):
+    monitor.counter("t/dumped").inc(3)
+    with trace.span("t/pre_dump"):
+        pass
+    path = flight.dump("unit", dir=str(tmp_path))
+    doc = json.load(open(path))
+    assert doc["reason"] == "unit" and doc["pid"] == os.getpid()
+    assert any(r.get("name") == "t/pre_dump" for r in doc["ring"])
+    assert doc["metrics"]["t/dumped"] == 3.0
+    assert any("test_dump_is_parseable" in "\n".join(frames)
+               for frames in doc["stacks"].values())
+
+
+def test_sigterm_subprocess_leaves_flight_dump(tmp_path):
+    """ISSUE 5 acceptance (c): kill -TERM → a parseable dump with the
+    last spans is on disk (the resilience workers' subprocess pattern)."""
+    env = dict(os.environ)
+    env.update(PTPU_FLIGHT_DIR=str(tmp_path), PTPU_TRACE="1",
+               PTPU_FORCE_PLATFORM="cpu", JAX_PLATFORMS="cpu")
+    env.pop("PTPU_FAULTS", None)
+    proc = subprocess.Popen([sys.executable, str(_WORKER)], env=env,
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        line = proc.stdout.readline().strip()
+        assert line == "READY", line
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert rc == -signal.SIGTERM             # chained default disposition
+    dumps = sorted(tmp_path.glob("flight_*_sigterm_*.json"))
+    assert dumps, list(tmp_path.iterdir())
+    doc = json.load(open(dumps[0]))
+    assert doc["reason"] == "sigterm"
+    span_names = [r["name"] for r in doc["ring"] if r["kind"] == "span"]
+    assert "worker/tick" in span_names
+    assert any(r.get("event") == "worker_ready" for r in doc["ring"]
+               if r["kind"] == "note")
+
+
+def test_watchdog_ignores_healthy_process(tmp_path):
+    w = monitor.watchdog(stall_s=0.5, dir=str(tmp_path), interval=0.05)
+    try:
+        for _ in range(6):
+            trace.heartbeat()
+            time.sleep(0.05)
+    finally:
+        w.stop()
+    assert w.dump_paths == [] and not list(tmp_path.iterdir())
+
+
+# ---------------------------------------------------------------------------
+# serving integration (tiny GPT on CPU)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def model():
+    from paddle_tpu.models import GPTForCausalLM, gpt_test_config
+
+    paddle.seed(0)
+    m = GPTForCausalLM(gpt_test_config(stacked_blocks=True,
+                                       sequence_parallel=False))
+    m.eval()
+    return m
+
+
+_PROMPT_LEN = 6      # every test below uses this length, so the module
+#                      shares ONE set of jitted step programs
+
+
+@pytest.fixture(scope="module")
+def eng(model):
+    """One engine, pre-warmed (compiles are the dominant cost on CPU);
+    the tests exercise tracing, which rides the warm step programs."""
+    from paddle_tpu.serving import EngineConfig, LLMEngine, SamplingParams
+
+    e = LLMEngine(model, EngineConfig(block_size=16, max_num_seqs=4))
+    rng = np.random.RandomState(9)
+    warm = rng.randint(0, model.cfg.vocab_size,
+                       (_PROMPT_LEN,)).astype(np.int32)
+    prev = trace.enabled()
+    trace.enable(False)
+    try:
+        e.generate([warm], SamplingParams(max_new_tokens=2))
+    finally:
+        trace.enable(prev)
+    return e
+
+
+def _prompt(model, seed):
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, model.cfg.vocab_size,
+                       (_PROMPT_LEN,)).astype(np.int32)
+
+
+def test_serving_request_trace_parity(model, eng):
+    """A SOLO traced request decomposes into queue_wait → prefill →
+    decode steps under one trace_id, parent-linked, and the child span
+    durations sum to ≈ the root's wall time (no large unattributed
+    gap).  TTFT/TPOT histograms come out nonzero with percentiles."""
+    from paddle_tpu.serving import SamplingParams
+
+    prompt = _prompt(model, 0)
+    new = 5
+    monitor.reset()
+    rid = eng.add_request(prompt, SamplingParams(max_new_tokens=new))
+    while eng.has_unfinished():
+        eng.step()
+    out = eng.request_output(rid)
+    eng.release_request(rid)
+    assert len(out) == _PROMPT_LEN + new
+
+    spans = eng.request_trace(rid)
+    roots = [s for s in spans if s["parent_id"] is None]
+    assert len(roots) == 1
+    root = roots[0]
+    assert root["name"] == "serving/request"
+    assert root["attrs"]["finish"] == "stop"
+    assert root["attrs"]["tokens"] == new
+    assert all(s["trace_id"] == root["trace_id"] for s in spans)
+    ids = {s["span_id"] for s in spans}
+    assert all(s["parent_id"] in ids for s in spans
+               if s["parent_id"] is not None)
+    names = [s["name"] for s in spans]
+    assert names.count("serving/queue_wait") == 1
+    assert names.count("serving/prefill") == 1
+    # first token samples at prefill end; the rest are decode steps
+    assert names.count("serving/decode_step") == new - 1
+
+    children_sum = sum(s["dur_us"] for s in spans if s["parent_id"])
+    assert children_sum <= root["dur_us"] * 1.05
+    assert children_sum >= root["dur_us"] * 0.5, (
+        f"unattributed gap: children {children_sum:.0f}us of "
+        f"root {root['dur_us']:.0f}us")
+
+    snap = monitor.snapshot()
+    assert snap["serving/ttft"]["count"] == 1
+    assert snap["serving/ttft"]["sum"] > 0
+    assert snap["serving/tpot"]["count"] == new - 1
+    assert "p50" in snap["serving/tpot"] and "p95" in snap["serving/tpot"]
+
+
+def test_request_trace_empty_when_tracing_off(model, eng):
+    from paddle_tpu.serving import SamplingParams
+
+    trace.enable(False)
+    try:
+        rid = eng.add_request(_prompt(model, 1),
+                              SamplingParams(max_new_tokens=2))
+        while eng.has_unfinished():
+            eng.step()
+        out = eng.request_output(rid)
+        eng.release_request(rid)
+    finally:
+        trace.enable(True)
+    assert len(out) == _PROMPT_LEN + 2 and eng.request_trace(rid) == []
+
+
+def test_aborted_request_trace_ends_with_abort(model, eng):
+    from paddle_tpu.serving import SamplingParams
+
+    rid = eng.add_request(_prompt(model, 2),
+                          SamplingParams(max_new_tokens=8))
+    eng.step()                       # prefill only
+    eng.release_request(rid)         # abort mid-flight
+    spans = eng.request_trace(rid)
+    root = [s for s in spans if s["name"] == "serving/request"][0]
+    assert root["attrs"]["finish"] == "abort"
+
+
+def test_watchdog_dumps_on_injected_stall(model, eng, tmp_path, monkeypatch):
+    """ISSUE 5 acceptance: a PTPU_FAULTS stall inside engine.step —
+    no span/step completes — trips the watchdog, which dumps ring +
+    all-thread py-stacks showing exactly where the process hangs."""
+    from paddle_tpu.serving import SamplingParams
+
+    monkeypatch.setenv("PTPU_FLIGHT_DIR", str(tmp_path))
+    prompt = _prompt(model, 3)
+    faults.set_plan(faults.FaultPlan("stall@site=engine.step,secs=1.0"))
+    w = monitor.watchdog(stall_s=0.25, interval=0.05)
+    try:
+        eng.generate([prompt], SamplingParams(max_new_tokens=2))
+    finally:
+        w.stop()
+        faults.set_plan(None)
+    assert w.dump_paths, "watchdog never fired during the injected stall"
+    doc = json.load(open(w.dump_paths[0]))
+    assert doc["reason"] == "stall"
+    assert doc["extra"]["stalled_for_s"] >= 0.25
+    all_frames = "\n".join(ln for frames in doc["stacks"].values()
+                           for ln in frames)
+    assert "maybe_stall" in all_frames, "stacks must show the hang site"
+    assert monitor.snapshot()["monitor/watchdog_dumps"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# live endpoint
+# ---------------------------------------------------------------------------
+
+def test_endpoint_metrics_healthz_traces():
+    from paddle_tpu.monitor import serve
+
+    monitor.counter("t/served").inc(2)
+    with trace.span("t/served_span") as s:
+        pass
+    srv = serve.MonitorServer(port=0)   # private instance: no global state
+    try:
+        txt = urllib.request.urlopen(srv.url + "/metrics",
+                                     timeout=10).read().decode()
+        assert "t_served 2" in txt
+        hz = json.loads(urllib.request.urlopen(srv.url + "/healthz",
+                                               timeout=10).read())
+        assert hz["status"] == "ok" and hz["pid"] == os.getpid()
+        assert hz["last_activity_age_s"] >= 0
+        spans = json.loads(urllib.request.urlopen(
+            srv.url + "/traces/" + s.trace_id, timeout=10).read())
+        assert spans[0]["name"] == "t/served_span"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(srv.url + "/traces/nope", timeout=10)
+        assert ei.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(srv.url + "/whatever", timeout=10)
+        assert ei.value.code == 404
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# CI surface: lint + smoke script
+# ---------------------------------------------------------------------------
+
+def test_lint_metrics_repo_clean_and_catches_violations(tmp_path):
+    tools = pathlib.Path(__file__).resolve().parent.parent / "tools"
+    proc = subprocess.run([sys.executable, str(tools / "lint_metrics.py")],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        'import monitor\n'
+        'monitor.counter("NoSlash").inc()\n'
+        'monitor.gauge(f"dyn/{x}").set(1)\n'
+        'monitor.counter("a/b").labels(**kw).inc()\n')
+    proc = subprocess.run(
+        [sys.executable, str(tools / "lint_metrics.py"), str(tmp_path)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    assert "convention" in proc.stdout
+    assert "dynamic metric name" in proc.stdout
+    assert "labels(**dict)" in proc.stdout
+
+
+# serve_smoke --trace (ISSUE 5 acceptance (a)+(b) end-to-end, asserted
+# in-script) is exercised by tests/test_serving.py::test_serve_smoke_script,
+# which runs the ONE fast-tier smoke subprocess in trace mode — trace mode
+# is a strict superset of the plain smoke assertions, and a second
+# engine-compiling subprocess here would double the suite's dominant cost.

@@ -1,0 +1,54 @@
+"""Subprocess worker for tests/test_trace.py: emit spans into the flight
+ring, arm the flight recorder's signal hooks, then spin until the parent
+kills it.
+
+Usage:
+    python flight_worker.py
+
+Env from the parent: PTPU_FLIGHT_DIR (dump target), PTPU_TRACE=1.
+
+Protocol (stdout lines the parent parses):
+    READY                — hooks installed, ring populated; safe to signal
+
+On SIGTERM the flight recorder dumps the ring to PTPU_FLIGHT_DIR and
+chains to the default disposition (process dies by signal) — the parent
+asserts the dump exists, parses, and holds the last spans.
+"""
+import os
+import sys
+import time
+import types
+
+REPO = os.path.join(os.path.dirname(__file__), "..", "..")
+sys.path.insert(0, REPO)
+os.environ.setdefault("PTPU_TRACE", "1")
+
+# Import ONLY the monitor package: a stub parent with the right __path__
+# lets `paddle_tpu.monitor` load without executing paddle_tpu/__init__
+# (which would pull jax — ~8 s of startup this stdlib-only worker does
+# not need, and a live proof that the v2 observability layer stays
+# importable headlessly).
+_pkg = types.ModuleType("paddle_tpu")
+_pkg.__path__ = [os.path.join(REPO, "paddle_tpu")]
+sys.modules["paddle_tpu"] = _pkg
+
+from paddle_tpu.monitor import flight, trace  # noqa: E402
+
+
+def main():
+    flight.install()
+    for i in range(8):
+        with trace.span("worker/tick", i=i):
+            time.sleep(0.002)
+    flight.note("worker_ready", pid=os.getpid())
+    print("READY", flush=True)
+    deadline = time.time() + 60
+    while time.time() < deadline:   # parent SIGTERMs us mid-loop
+        with trace.span("worker/spin"):
+            time.sleep(0.01)
+    print("TIMEOUT", flush=True)    # never reached in the test
+    sys.exit(3)
+
+
+if __name__ == "__main__":
+    main()

@@ -6,7 +6,8 @@
 #   tools/run_ci.sh fast    — "not slow" tier on the virtual 8-device CPU mesh
 #                             (includes the resilience suite + repo lints)
 #   tools/run_ci.sh full    — everything incl. subprocess/example suites
-#   tools/run_ci.sh lint    — repo lints only (no-silent-swallow except check)
+#   tools/run_ci.sh lint    — repo lints only (no-silent-swallow except
+#                             check + metric naming/label-cardinality check)
 #   tools/run_ci.sh gates   — driver gates: compile-check entry() + the
 #                             8-device multichip dryrun + CPU bench smoke
 #   tools/run_ci.sh bench-check OLD.json NEW.json — perf regression gate
@@ -19,14 +20,17 @@ export XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}"
 case "${1:-fast}" in
   fast)
     python tools/lint_excepts.py
+    python tools/lint_metrics.py
     python -m pytest tests/ -m "not slow" -q --ignore=tests/test_examples.py
     ;;
   full)
     python tools/lint_excepts.py
+    python tools/lint_metrics.py
     python -m pytest tests/ -q
     ;;
   lint)
     python tools/lint_excepts.py
+    python tools/lint_metrics.py
     ;;
   gates)
     python - <<'EOF'
