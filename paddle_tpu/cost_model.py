@@ -1,15 +1,18 @@
 """Cost model (reference: python/paddle/cost_model/cost_model.py — static
 cost model over profiler data; auto_parallel/cost/ op-level estimates).
 
-TPU-native: XLA's own compiler cost analysis (FLOPs, bytes accessed,
-estimated seconds) replaces the hand-maintained per-op cost tables."""
+TPU-native: XLA's own compiler cost analysis (FLOPs, bytes accessed)
+replaces the hand-maintained per-op cost tables.  The lower/compile/
+analyze/measure plumbing is `paddle_tpu.monitor.perf.measure` — ONE
+convention for analysis normalization (non-scalar entries are counted
+into `perf/cost_keys_dropped`, not silently dropped) shared with the
+jit perf hook and the serving decode breakdown."""
 from __future__ import annotations
 
 from typing import Callable, Dict
 
-import jax
-
 from .core.tensor import Tensor
+from .monitor import perf as _perf
 
 __all__ = ["CostModel"]
 
@@ -23,34 +26,28 @@ class CostModel:
     def profile_measure(self, fn: Callable, *example_args,
                         device="tpu", fetch_cost_list=("time",)) -> Dict:
         """Compile `fn` on example args and return XLA's cost analysis
-        (flops, bytes accessed, optimal_seconds when available) plus a
-        wall-clock measurement."""
-        import time
-
+        (flops, bytes accessed, roofline classification, MFU at the
+        measured time) plus a synced wall-clock measurement."""
         import jax.numpy as jnp
 
         def pure(*arrays):
             outs = fn(*[Tensor(a) for a in arrays])
             if isinstance(outs, (list, tuple)):
-                return tuple(o._data if isinstance(o, Tensor) else o for o in outs)
+                return tuple(o._data if isinstance(o, Tensor) else o
+                             for o in outs)
             return outs._data if isinstance(outs, Tensor) else outs
 
         arrays = [a._data if isinstance(a, Tensor) else jnp.asarray(a)
                   for a in example_args]
-        lowered = jax.jit(pure).lower(*arrays)
-        compiled = lowered.compile()
-        try:
-            analysis = compiled.cost_analysis() or {}
-        except Exception:
-            analysis = {}
-        # wall clock (executes once for warmup/compile, then measures)
-        compiled(*arrays)
-        t0 = time.perf_counter()
-        out = compiled(*arrays)
-        jax.tree.map(lambda x: x.block_until_ready(), out)
-        wall = time.perf_counter() - t0
-        result = {"wall_time_s": wall}
-        if isinstance(analysis, dict):
-            result.update({k: float(v) for k, v in analysis.items()
-                           if isinstance(v, (int, float))})
-        return result
+        res = _perf.measure(pure, *arrays,
+                            label=getattr(fn, "__name__", "profile"))
+        # compat shape: prior callers read the raw scalar analysis keys
+        # ("flops", "bytes accessed") at the top level next to wall time
+        rec = _perf.get(res["label"])
+        out = {"wall_time_s": res["wall_time_s"]}
+        if rec is not None:
+            out.update(rec.cost)
+        for k in ("bound", "mfu", "intensity", "achieved_vs_optimal",
+                  "optimal_s", "available"):
+            out[k] = res.get(k)
+        return out

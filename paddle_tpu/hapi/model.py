@@ -18,6 +18,7 @@ from ..core.tensor import Tensor
 from ..framework.io_ import save as _save, load as _load
 from ..io import DataLoader, Dataset
 from ..metric import Metric
+from ..monitor import perf as mperf
 from ..nn.layer import Layer
 from .callbacks import config_callbacks
 
@@ -108,11 +109,24 @@ class Model:
         return batch[:n_in], batch[n_in:]
 
     def _train_step(self, inputs, labels):
-        outputs = self.network(*inputs)
-        loss = self._compute_loss(outputs, labels)
-        loss.backward()
-        self._optimizer.step()
-        self._optimizer.clear_grad()
+        # perf mode (PTPU_PERF=1): the eager train step reports synced
+        # forward/backward/optimizer segments to the attribution table;
+        # with the gate off each `segment` is one module-global read.
+        perf_on = mperf.enabled()
+        with mperf.segment("train", "forward") as s:
+            outputs = self.network(*inputs)
+            loss = self._compute_loss(outputs, labels)
+            s.sync(loss)
+        with mperf.segment("train", "backward") as s:
+            loss.backward()
+            if perf_on:
+                s.sync([p.grad for p in self.network.parameters()
+                        if p.grad is not None])
+        with mperf.segment("train", "optimizer") as s:
+            self._optimizer.step()
+            if perf_on:
+                s.sync(list(self.network.parameters()))
+            self._optimizer.clear_grad()
         return loss, outputs, labels
 
     def train_batch(self, inputs, labels=None, update=True):

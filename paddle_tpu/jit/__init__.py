@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -27,6 +28,7 @@ from ..autograd import tape
 from ..nn.layer import Layer
 from .. import monitor
 from ..monitor import trace as mtrace
+from ..monitor import perf as mperf
 
 _NULL_CTX = contextlib.nullcontext()
 
@@ -191,6 +193,17 @@ class CompiledFunction:
         self._compiled = None
         self._last_lowered = None
         self._seen_sigs: set = set()
+        # per-signature AOT executables + captured XLA analyses: the perf
+        # hook routes fresh compiles through jax's AOT path (ONE compile,
+        # analyses read off the same executable), and memory_analysis()
+        # answers repeat calls from here instead of re-lowering
+        self._aot_cache: Dict[str, Any] = {}
+        self._analysis_cache: Dict[str, Dict[str, Any]] = {}
+        # sig -> perf-record label: a new input signature is a DIFFERENT
+        # compiled program, and perf.capture routes it to its own
+        # `name#N` record so its wall times never dilute another
+        # program's MFU — observe() must use the same routed label
+        self._perf_labels: Dict[str, str] = {}
 
     def _build(self):
         spec = self._spec
@@ -258,7 +271,9 @@ class CompiledFunction:
         # signature cost is a few string formats per call, skipped
         # entirely when both telemetry layers are off.)
         ctx = _NULL_CTX
-        if monitor.enabled() or mtrace.enabled():
+        perf_on = mperf.enabled()
+        exec_fn = self._compiled
+        if monitor.enabled() or mtrace.enabled() or perf_on:
             sig = f"nstate={len(state_vals)};{_arg_signature((a_args, a_kwargs))}"
             if sig not in self._seen_sigs:
                 self._seen_sigs.add(sig)
@@ -268,9 +283,25 @@ class CompiledFunction:
                     "fresh trace+XLA-compile events per function").labels(
                     fn=fname).inc()
                 ctx = mtrace.span("jit/recompile", fn=fname, signature=sig)
+        t0 = 0.0
         with ctx:
-            out_arrays, new_state = self._compiled(
+            if perf_on:
+                # perf accounting: dispatch through the per-signature AOT
+                # executable so XLA's cost/memory analyses come off the
+                # ONE compile this signature pays anyway — inside the
+                # recompile span, which exists to surface exactly this
+                # compile cost
+                exec_fn = self._aot_exec(
+                    sig, (state_vals, host_vals, key, a_args, a_kwargs))
+                t0 = time.perf_counter()
+            out_arrays, new_state = exec_fn(
                 state_vals, host_vals, key, a_args, a_kwargs)
+        if perf_on:
+            # perf mode is explicitly a synced diagnostic mode: MFU from
+            # an async dispatch time would be fiction
+            jax.block_until_ready((out_arrays, new_state))
+            mperf.observe(self._perf_labels.get(sig, self._perf_label()),
+                          time.perf_counter() - t0)
         if self._spec.optimizers and monitor.enabled():
             # the compiled program embeds the optimizer update; count the
             # dispatch here (optimizer.step only counts eager steps).
@@ -286,12 +317,61 @@ class CompiledFunction:
         return _tree_to_tensors(out_arrays)
 
     # -- introspection/AOT -------------------------------------------------
+    def _perf_label(self) -> str:
+        return getattr(self._fn, "__name__", "<step>")
+
+    def _aot_exec(self, sig, vals):
+        """The AOT executable for `sig`, compiling (and feeding the perf
+        registry XLA's cost/memory analyses) on first sight.  Any AOT
+        failure falls back to the normal jax.jit dispatch path — counted,
+        so perf mode can never make a previously-working step uncallable.
+        """
+        exec_fn = self._aot_cache.get(sig)
+        if exec_fn is None:
+            try:
+                lowered = self._compiled.lower(*vals)
+                exec_fn = lowered.compile()
+                rec = mperf.capture(self._perf_label(), lowered=lowered,
+                                    compiled=exec_fn)
+                self._perf_labels[sig] = rec.label
+                if rec.memory:
+                    # only a real analysis pre-fills the cache — a failed
+                    # probe must not serve another signature's bytes to a
+                    # memfit gate
+                    self._analysis_cache[sig] = dict(rec.memory)
+            except Exception:   # justified: AOT lowering support varies
+                # (exotic shardings/backends); dispatch path still works
+                monitor.counter(
+                    "perf/aot_fallbacks",
+                    "perf-mode AOT compiles that fell back to dispatch"
+                ).labels(fn=self._perf_label()).inc()
+                exec_fn = self._compiled
+                # a fallback sig has NO captured analysis: its wall times
+                # must land in their own analysis-less record, never the
+                # base record whose flops belong to a different program
+                self._perf_labels[sig] = f"{self._perf_label()}#fallback"
+            self._aot_cache[sig] = exec_fn
+        return exec_fn
+
     def memory_analysis(self, *args, **kwargs):
         """XLA's compile-time memory analysis for this step at the given
         example inputs: dict with argument/output/temp/alias bytes and
         the derived peak live estimate. Chip-free (works on the CPU test
         mesh) — the per-device HBM complement to
-        device.max_memory_allocated()'s runtime peak."""
+        device.max_memory_allocated()'s runtime peak.
+
+        Cached per input signature (and pre-filled by the perf hook's
+        capture), so repeated calls — a memfit gate polling every few
+        steps, say — pay the lower+compile exactly once."""
+        if self._compiled is None:
+            self._build()
+        a_args = _tree_to_arrays(args)
+        a_kwargs = _tree_to_arrays(kwargs)
+        sig = (f"nstate={len(self._spec.slots())};"
+               f"{_arg_signature((a_args, a_kwargs))}")
+        cached = self._analysis_cache.get(sig)
+        if cached is not None:
+            return dict(cached)
         mem = self.lower(*args, **kwargs).compile().memory_analysis()
         out = {k: int(getattr(mem, k)) for k in (
             "argument_size_in_bytes", "output_size_in_bytes",
@@ -301,7 +381,8 @@ class CompiledFunction:
             out.get("argument_size_in_bytes", 0)
             + out.get("temp_size_in_bytes", 0)
             - out.get("alias_size_in_bytes", 0))
-        return out
+        self._analysis_cache[sig] = out
+        return dict(out)
 
     def lower(self, *args, **kwargs):
         if self._compiled is None:

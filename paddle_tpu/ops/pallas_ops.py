@@ -1101,9 +1101,12 @@ def flash_decode_arrays(q, k_cache, v_cache, length, scale=None,
         in_specs=[
             pl.BlockSpec((block_b, 1, h * d), lambda i, len_ref: (i, 0, 0)),
             # pin caches to HBM: under ANY, Mosaic may place them in VMEM
-            # and the kernel's whole point is NOT streaming them there
-            pl.BlockSpec(memory_space=pltpu.HBM),
-            pl.BlockSpec(memory_space=pltpu.HBM),
+            # and the kernel's whole point is NOT streaming them there.
+            # (pltpu.HBM is a newer-jax name; 0.4.x only has ANY, where
+            # caches bigger than VMEM land in HBM regardless — and this
+            # host runs the kernel in interpret mode anyway)
+            pl.BlockSpec(memory_space=getattr(pltpu, "HBM", pltpu.ANY)),
+            pl.BlockSpec(memory_space=getattr(pltpu, "HBM", pltpu.ANY)),
         ],
         out_specs=pl.BlockSpec((block_b, 1, h * d),
                                lambda i, len_ref: (i, 0, 0)),

@@ -230,6 +230,18 @@ class Profiler:
         mon = monitor.render()
         if mon:
             lines += ["", mon]
+        # perf attribution (paddle_tpu.monitor.perf): ranked MFU/roofline
+        # table of every analyzed program and sub-step segment — the row
+        # with the worst achieved-vs-optimal ratio is the next kernel to
+        # optimize.  Empty unless PTPU_PERF accounting recorded anything.
+        try:
+            from ..monitor import perf as _mperf
+
+            pa = _mperf.report()
+        except ImportError:   # standalone monitor load — no perf module
+            pa = ""
+        if pa:
+            lines += ["", pa]
         return "\n".join(lines)
 
     def device_op_summary(self, top=30, time_unit="ms"):

@@ -72,6 +72,7 @@ import jax.numpy as jnp
 
 from .. import monitor
 from ..monitor import trace as mtrace
+from ..monitor import perf as mperf
 from ..resilience import faults
 from ..resilience.retry import Deadline
 from ..ops.paged_attention import (paged_attention_arrays,
@@ -495,6 +496,12 @@ class LLMEngine:
                 sp.end()
 
     def _decode_body(self, rows):
+        # perf mode (PTPU_PERF=1): the decode hot path reports named,
+        # properly-synced sub-step segments — host `prep`, the fused
+        # `model` program (gather+attention+cache update), and `sampler`
+        # (timed inside _sample_rows, whose np.asarray readback syncs it)
+        perf_on = mperf.enabled()
+        t0 = time.perf_counter() if perf_on else 0.0
         n = len(rows)
         bb = 1
         while bb < n:
@@ -515,15 +522,25 @@ class LLMEngine:
                                                 self.blocks_per_seq)
             slots[i, 0] = self.cache.slot(req.req_id, p)
         fn = self._get_chunk_exec(bb, 1)
+        if perf_on:
+            t1 = time.perf_counter()
+            mperf.observe_segment("decode", "prep", t1 - t0)
         logits, kv_out = fn(self._param_arrays(), self._kv_flat(),
                             jnp.asarray(toks), jnp.asarray(pos0),
                             jnp.asarray(tables), jnp.asarray(slots))
+        if perf_on:
+            jax.block_until_ready(logits)
+            mperf.observe_segment("decode", "model",
+                                  time.perf_counter() - t1)
         self._store_kv(kv_out)
         self._sample_rows(rows, logits)
 
     def _sample_rows(self, rows, logits):
         """Sample one token per live row from [B, V] fp32 logits (B may
         exceed len(rows) by padding)."""
+        perf_on = mperf.enabled()   # read once: flipping perf on between
+        # here and the observe below must not pair a real clock with t0=0
+        t0 = time.perf_counter() if perf_on else 0.0
         bb = int(logits.shape[0])
         keys = np.zeros((bb, 2), np.uint32)
         ds = np.zeros((bb,), bool)
@@ -544,6 +561,10 @@ class LLMEngine:
         toks = np.asarray(toks)
         new_keys = np.asarray(new_keys)
         now = time.perf_counter()
+        if perf_on:
+            # np.asarray above synced the sampler outputs: now - t0 is
+            # its true wall time (sampler is its own dispatch)
+            mperf.observe_segment("decode", "sampler", now - t0)
         for i, req in enumerate(rows):
             req.key = jnp.asarray(new_keys[i], jnp.uint32)
             req.record_token(int(toks[i]))
@@ -556,6 +577,152 @@ class LLMEngine:
             else:
                 self._m_tpot.observe(now - req.last_token_t)
             req.last_token_t = now
+
+    # -- perf attribution ---------------------------------------------------
+
+    def decode_breakdown(self, reps: int = 2) -> dict:
+        """Roofline attribution of the decode step at this engine's LIVE
+        shapes (ISSUE 6 / ROADMAP item 1's targeting data).
+
+        The production decode program fuses block gather, attention and
+        cache update into one XLA executable, so their split cannot be
+        observed in situ; this runs each named segment as its own
+        compiled program over the live KV pools — properly synced,
+        best-of-``reps`` — and attributes each against its own XLA
+        cost-analysis prediction via ``monitor.perf.measure``.  Also
+        measures the real fused step program (``decode:step``) so the
+        segment sum can be compared against what fusion actually buys.
+
+        Returns ``{segment: perf-record dict}`` plus ``"worst"``: the
+        segment with the lowest achieved-vs-optimal ratio — the next
+        kernel to rewrite.  Segment arithmetic mirrors
+        ``ops.paged_attention`` exactly; numbers are attribution
+        estimates (the fused program may never materialize the gather),
+        which is precisely their job.
+        """
+        cfg = self.cfg
+        L = cfg.num_hidden_layers
+        nh = cfg.num_attention_heads
+        hd = cfg.hidden_size // nh
+        bb = 1
+        while bb < self.scheduler.max_num_seqs:
+            bb *= 2
+        s_pad = self.blocks_per_seq * self.cache.block_size
+        num_slots = self.cache.num_blocks * self.cache.block_size
+        wdtype = self.model.gpt.embeddings.word_embeddings.weight.dtype
+        kv_flat = self._kv_flat()
+        tables = (jnp.arange(bb * self.blocks_per_seq, dtype=jnp.int32)
+                  % max(self.cache.num_blocks, 1)).reshape(
+            bb, self.blocks_per_seq)
+        pos0 = jnp.full((bb,), s_pad - 1, jnp.int32)
+        slots = (jnp.arange(bb, dtype=jnp.int32) * self.cache.block_size
+                 % num_slots).reshape(bb, 1)
+        q = jnp.zeros((bb, 1, nh, hd), wdtype)
+        rows = jnp.zeros((bb, 1, nh, hd), wdtype)
+        quant = bool(self._kv_quant)
+        stride = 4 if quant else 2
+
+        from ..ops.paged_attention import (paged_gather_kv_arrays,
+                                           quantized_gather_kv_arrays)
+
+        def gather_fn(kv, tbl):
+            acc = jnp.float32(0.0)
+            for l in range(L):
+                part = kv[stride * l:stride * (l + 1)]
+                if quant:
+                    kg = quantized_gather_kv_arrays(part[0], part[2], tbl)
+                    vg = quantized_gather_kv_arrays(part[1], part[3], tbl)
+                else:
+                    kg = paged_gather_kv_arrays(part[0], tbl)
+                    vg = paged_gather_kv_arrays(part[1], tbl)
+                acc += jnp.sum(kg.astype(jnp.float32)) \
+                    + jnp.sum(vg.astype(jnp.float32))
+            return acc
+
+        # one layer's gathered view feeds the attention segment for all L
+        # iterations (per-iteration q offsets defeat CSE, so every layer
+        # pays its reads/FLOPs in the cost model and on the device)
+        if quant:
+            kg0 = quantized_gather_kv_arrays(kv_flat[0], kv_flat[2], tables)
+            vg0 = quantized_gather_kv_arrays(kv_flat[1], kv_flat[3], tables)
+        else:
+            kg0 = paged_gather_kv_arrays(kv_flat[0], tables)
+            vg0 = paged_gather_kv_arrays(kv_flat[1], tables)
+
+        def attention_fn(q_, kg, vg, pos0_):
+            import math as _math
+
+            scale = 1.0 / _math.sqrt(hd)
+            acc = jnp.float32(0.0)
+            k_pos = jnp.arange(s_pad, dtype=jnp.int32)
+            for l in range(L):
+                ql = q_ + jnp.asarray(l, q_.dtype)
+                logits = jnp.einsum(
+                    "bqhd,bkhd->bhqk", ql, kg,
+                    preferred_element_type=jnp.float32) * scale
+                causal = k_pos[None, None, :] <= pos0_[:, None, None]
+                logits = jnp.where(causal[:, None], logits, _NEG_INF)
+                probs = jax.nn.softmax(logits, axis=-1)
+                o = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(vg.dtype),
+                               vg)
+                acc += jnp.sum(o.astype(jnp.float32))
+            return acc
+
+        def update_fn(kv, rows_, slots_):
+            out = list(kv)
+            for l in range(L):
+                if quant:
+                    k2, ks2 = quantized_cache_update_arrays(
+                        kv[4 * l], kv[4 * l + 2], rows_, slots_)
+                    v2, vs2 = quantized_cache_update_arrays(
+                        kv[4 * l + 1], kv[4 * l + 3], rows_, slots_)
+                    out[4 * l:4 * l + 4] = [k2, v2, ks2, vs2]
+                else:
+                    out[2 * l] = paged_cache_update_arrays(
+                        kv[2 * l], rows_, slots_)
+                    out[2 * l + 1] = paged_cache_update_arrays(
+                        kv[2 * l + 1], rows_, slots_)
+            return tuple(out)
+
+        kv_copy = tuple(jnp.array(a, copy=True) for a in kv_flat)
+        out = {
+            "block_gather": mperf.measure(
+                gather_fn, kv_flat, tables,
+                label="decode:block_gather", reps=reps),
+            "attention": mperf.measure(
+                attention_fn, q, kg0, vg0, pos0,
+                label="decode:attention", reps=reps),
+            "cache_update": mperf.measure(
+                update_fn, kv_copy, rows, slots,
+                label="decode:cache_update", reps=reps,
+                donate_argnums=(0,)),
+        }
+        # the real step programs, measured as compiled (donated pools
+        # ping-ponged through the output so the engine's live cache is
+        # never consumed)
+        toks = jnp.zeros((bb, 1), jnp.int32)
+        kv_copy2 = tuple(jnp.array(a, copy=True) for a in kv_flat)
+        out["step"] = mperf.measure(
+            self._get_chunk_exec(bb, 1),
+            self._param_arrays(), kv_copy2, toks, pos0, tables, slots,
+            label="decode:step", reps=reps,
+            rearm=lambda args, o: args[:1] + (o[1],) + args[2:])
+        logits = jnp.zeros((bb, cfg.vocab_size), jnp.float32)
+        out["sampler"] = mperf.measure(
+            self._get_sample_exec(bb),
+            logits, jnp.zeros((bb, 2), jnp.uint32),
+            jnp.zeros((bb,), bool), jnp.ones((bb,), jnp.float32),
+            jnp.zeros((bb,), jnp.int32), jnp.ones((bb,), jnp.float32),
+            label="decode:sampler_exec", reps=reps)
+        # NOT "decode:sampler": the in-situ segment record of that name
+        # has no cost analysis, so _match_record would merge this
+        # compiled program's flops into its host-loop-inflated walls
+        ranked = [(name, d["achieved_vs_optimal"])
+                  for name, d in out.items()
+                  if name != "step" and d.get("achieved_vs_optimal")]
+        out["worst"] = (min(ranked, key=lambda kv_: kv_[1])[0]
+                        if ranked else None)
+        return out
 
     # -- array plumbing -----------------------------------------------------
 

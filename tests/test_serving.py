@@ -420,9 +420,11 @@ class TestMonitorAndSmoke:
     def test_serve_smoke_script(self):
         # --trace: the ISSUE-5 observability acceptance (ttft/tpot
         # percentiles, parent-linked request trace, chrome export, live
-        # endpoint) asserts in-script ON TOP of the plain smoke checks,
-        # so one subprocess covers both (tests/test_trace.py leans on
-        # this invocation)
+        # endpoint) and --perf: the ISSUE-6 one (decode-segment
+        # breakdown populated, attribution table, perf/* gauges on the
+        # endpoint) assert in-script ON TOP of the plain smoke checks,
+        # so ONE subprocess covers all three (tests/test_trace.py and
+        # tests/test_perf.py lean on this invocation)
         script = (pathlib.Path(__file__).resolve().parent.parent
                   / "scripts" / "serve_smoke.py")
         env = {k: v for k, v in os.environ.items()
@@ -430,7 +432,8 @@ class TestMonitorAndSmoke:
         env["PTPU_FORCE_PLATFORM"] = "cpu"
         env["JAX_PLATFORMS"] = "cpu"
         env["PTPU_MONITOR"] = "1"
-        proc = subprocess.run([sys.executable, str(script), "--trace"],
+        proc = subprocess.run([sys.executable, str(script), "--trace",
+                               "--perf"],
                               env=env, capture_output=True, text=True,
                               timeout=560)
         assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
@@ -438,6 +441,9 @@ class TestMonitorAndSmoke:
         assert "tokens/s" in proc.stdout
         assert "ttft:" in proc.stdout and "request 0 trace:" in proc.stdout
         assert "chrome trace:" in proc.stdout
+        assert "decode breakdown:" in proc.stdout
+        assert "perf attribution" in proc.stdout
+        assert "perf/* gauges exported" in proc.stdout
 
 
 class TestPagedAttentionOp:

@@ -11,6 +11,8 @@
 #   tools/run_ci.sh gates   — driver gates: compile-check entry() + the
 #                             8-device multichip dryrun + CPU bench smoke
 #   tools/run_ci.sh bench-check OLD.json NEW.json — perf regression gate
+#   tools/run_ci.sh bench-history [args] — gate the newest BENCH_HISTORY
+#                             ledger entries against their trailing median
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -22,6 +24,15 @@ case "${1:-fast}" in
     python tools/lint_excepts.py
     python tools/lint_metrics.py
     python -m pytest tests/ -m "not slow" -q --ignore=tests/test_examples.py
+    # perf-history gate, CPU-smoke lane: the headline bench appends this
+    # host's run to BENCH_HISTORY.jsonl, then gates against the trailing
+    # median of SAME-host same-backend runs (a host change starts a fresh
+    # lane — reported, never failed).  Loose 50% tolerance: the CPU smoke
+    # config is tiny and shared-host noisy; it catches cliffs, the real
+    # lane in `gates` catches percent-level drift on chip hosts.
+    python bench.py
+    python tools/check_bench_regression.py --history BENCH_HISTORY.jsonl \
+      --gate-smoke --tolerance 0.50
     ;;
   full)
     python tools/lint_excepts.py
@@ -42,13 +53,21 @@ g.dryrun_multichip(8)
 print("gates OK")
 EOF
     python bench.py
+    # real-lane history gate: default 7% tolerance, smoke lines skipped
+    # (on a chip host the headline is the non-smoke metric and gates;
+    # after an outage fallback the smoke line is reported only)
+    python tools/check_bench_regression.py --history BENCH_HISTORY.jsonl
     ;;
   bench-check)
     shift
     python tools/check_bench_regression.py "$@"
     ;;
+  bench-history)
+    shift
+    python tools/check_bench_regression.py --history BENCH_HISTORY.jsonl "$@"
+    ;;
   *)
-    echo "usage: $0 {fast|full|lint|gates|bench-check OLD NEW}" >&2
+    echo "usage: $0 {fast|full|lint|gates|bench-check OLD NEW|bench-history}" >&2
     exit 2
     ;;
 esac
