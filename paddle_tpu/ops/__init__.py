@@ -28,6 +28,10 @@ from . import paged_attention  # noqa: F401
 # low-bit quantized storage/compute primitives (paddle_tpu.lowbit's op
 # layer) — array-level only, same non-export rationale as paged_attention
 from . import lowbit  # noqa: F401
+# fused ragged paged attention (the serving decode workhorse: one
+# fixed-shape program with in-program cache update + int8 dequant) —
+# array-level only, same non-export rationale as paged_attention
+from . import ragged_paged_attention  # noqa: F401
 
 __all__ = (
     list(creation.__all__)

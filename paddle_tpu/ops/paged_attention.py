@@ -159,7 +159,16 @@ def quantized_cache_update_arrays(blocks, scales, rows, slots, qmax=127):
 def quantized_gather_kv_arrays(blocks, scales, block_table):
     """Dequantizing gather: the int8 analog of `paged_gather_kv_arrays`,
     returning float32 [B, max_blocks * block_size, H, D] =
-    ``codes * per-block-per-head scale``."""
+    ``codes * per-block-per-head scale``.
+
+    This IS the separate dequant pass quantized serving pays on the
+    bucketed path (a 4-byte fp32 materialization of the 1-byte pool);
+    `ops.ragged_paged_attention` exists to not call it — the counter
+    below is how the bench/tests pin that (ISSUE 8 acceptance: no
+    ``site="paged_gather"`` increments on the ragged path)."""
+    from .lowbit import _count
+
+    _count("lowbit/dequant_calls", site="paged_gather")
     nb, bs = blocks.shape[0], blocks.shape[1]
     tbl = jnp.clip(jnp.asarray(block_table, jnp.int32), 0, nb - 1)
     g = jnp.take(blocks, tbl, axis=0)                # [B, maxb, bs, H, D]

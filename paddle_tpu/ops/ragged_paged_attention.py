@@ -1,0 +1,520 @@
+"""Ragged paged attention — ONE fixed-shape fused program for mixed-length
+prefill/decode rows over the block-paged KV pools ("Ragged Paged
+Attention", PAPERS.md), with the current tokens' cache update pulled into
+the same program (the MPK fuse-across-boundaries lever, PAPERS.md) and —
+for the int8 KV wing — the per-block-per-head dequant applied at the K/V
+block loads instead of as a separate gather-dequantize pass.
+
+This is the serving decode workhorse ISSUE 8 / ROADMAP item 1 calls for:
+the round-2 bisect pinned ~2.77 ms of the 3.34 ms decode step to the
+gather-blocks → masked-attention → cache-scatter triple, and the
+power-of-2 batch bucketing recompiles a fresh program every time the
+running-request count crosses a boundary.  Here the engine compiles ONE
+program at ``[max_num_seqs, 1]`` and every batch composition runs it.
+
+Two implementations behind one entry point, selected like
+``pallas_ops._pallas_ok`` (PTPU_ATTN_DEBUG=1 counts every gate decision):
+
+- **Pallas kernel** (TPU, or CPU under ``PTPU_PALLAS_INTERPRET=1``), the
+  decode (S_q = 1) shape: one program per row streams ONLY the row's
+  ``ceil(len / block_size)`` physical blocks from HBM (double-buffered
+  DMA, online softmax — the XLA fallback touches all ``max_blocks``
+  gathered rows), fuses the new token's quantize+scatter as a
+  read-modify-write of the row's last block BEFORE the stream (pools are
+  aliased in place), and dequantizes int8 blocks at load time — the int8
+  codes never exist as a dequantized [B, S_pad, H, D] float tensor
+  anywhere.
+
+- **XLA array-level fallback** (any backend, any chunk width C): the
+  cache update and attention of `ops.paged_attention` composed in one
+  function.  The full-precision path is BITWISE the reference
+  (`paged_cache_update_arrays` + `paged_attention_arrays`) — that is what
+  keeps mixed continuous batches token-identical to solo dense
+  ``generate()`` on the ragged engine path.  The int8 path reuses
+  `quantized_cache_update_arrays` bitwise but replaces the dequantizing
+  gather with a scale-FOLDED attention: it gathers int8 CODES (1 byte per
+  element instead of the 4-byte fp32 dequant materialization) plus the
+  tiny per-position scales, and applies ``k_scale`` to the logits and
+  ``v_scale`` to the probabilities — algebraically identical because the
+  scale is constant along the contracted head_dim axis, within a last-ulp
+  reassociation of the dequantize-then-einsum reference (int8 KV parity
+  is a documented tolerance, PR 4; all engine rows share one arithmetic
+  so engine-vs-engine invariants stay bitwise).  It never calls
+  `quantized_gather_kv_arrays`, so the ragged path makes no
+  ``lowbit/dequant_calls{site="paged_gather"}`` increments.
+
+Numerics contract of the fallback: same einsum contraction (fp32
+accumulation), same additive -1e30 causal mask over the SAME padded
+[B, max_blocks * block_size] extent, same softmax/probs-cast as
+`paged_attention_arrays` — positions past a row's true length underflow
+to an exact 0 probability.  The kernel's online softmax reorders the
+reductions (last-ulp, like flash decode vs the dense reference); it is
+gated off the CPU parity path and pinned against the fallback by
+tests/test_ragged_attention.py.
+"""
+from __future__ import annotations
+
+import functools
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+
+from .paged_attention import (paged_attention_arrays,
+                              paged_cache_update_arrays,
+                              quantized_cache_update_arrays)
+from .pallas_ops import (_NEG_INF, _count_path, _decode_seg_helpers,
+                         _interpret, _on_tpu)
+
+__all__ = ["ragged_paged_attention_arrays"]
+
+_QMAX = 127
+
+
+# ---------------------------------------------------------------------------
+# dispatch gate (the _pallas_ok idiom: every decision counted under
+# PTPU_ATTN_DEBUG=1 so serving shapes silently dropping to the fallback
+# are observable)
+# ---------------------------------------------------------------------------
+
+def _ragged_kernel_ok(q, k_blocks, c, quant) -> bool:
+    """Geometry/flag gate for the fused ragged kernel.  The kernel serves
+    the decode shape (C = 1) — chunked-prefill rows (C > 1) take the
+    fallback, which is the parity-exact program anyway.
+    PTPU_RAGGED_KERNEL=0 hard-disables."""
+    if os.environ.get("PTPU_RAGGED_KERNEL", "").lower() in ("0", "false",
+                                                            "off"):
+        _count_path("ragged_fallback:disabled")
+        return False
+    if not (_on_tpu() or _interpret()):
+        _count_path("ragged_fallback:off_tpu")
+        return False
+    if c != 1:
+        _count_path("ragged_fallback:chunk_gt_1")
+        return False
+    _, _, h, d = q.shape
+    bs = int(k_blocks.shape[1])
+    if d not in (64, 128, 256) or (h * d) % 128 != 0:
+        _count_path("ragged_fallback:head_geometry")
+        return False
+    # block DMAs slice [block_size, H*D] slabs: the sublane dim must be a
+    # tile multiple for the pool dtype ((8,128) f32 / (16,128) bf16 /
+    # (32,128) int8)
+    sub = 32 if quant else (16 if k_blocks.dtype == jnp.bfloat16 else 8)
+    if bs % sub != 0:
+        _count_path("ragged_fallback:block_size")
+        return False
+    if not quant and q.dtype != k_blocks.dtype:
+        # the kernel's matmuls want matching operand dtypes (the XLA
+        # fallback einsum promotes mixed q/pool dtypes instead)
+        _count_path("ragged_fallback:dtype_mix")
+        return False
+    _count_path("ragged_kernel")
+    return True
+
+
+# ---------------------------------------------------------------------------
+# the fused kernel (S_q = 1): cache update (read-modify-write of the
+# row's last block) then a double-buffered streamed attention over the
+# row's blocks, int8 dequant fused into the block loads
+# ---------------------------------------------------------------------------
+
+def _ragged_fused_kernel(len_ref, slot_ref, tbl_ref, q_ref, kn_ref, vn_ref,
+                         k_hbm, v_hbm, *refs, bs, h, d, nb, maxb, scale,
+                         quant):
+    """One program per batch row r:
+
+    1. DMA the row's TARGET block (the one its write slot lands in) into
+       VMEM, splice/quantize the new token's K/V row in (int8: grow the
+       block scale monotonically and rescale the existing codes exactly
+       like `quantized_cache_update_arrays`), DMA it back — pools and
+       scale tables are aliased in place, and blocks a row writes are
+       always privately owned (the engine privatizes shared last blocks
+       at fork), so programs never race.
+    2. Stream the row's ``ceil(len/bs)`` blocks from HBM (double-buffered
+       DMA through the row's block table in SMEM), dequantizing int8
+       codes at load via the per-block-per-head scales, with an online
+       softmax; the target block's contribution comes from the updated
+       VMEM copy, never re-read through the alias.
+
+    Rows whose write slot is out of range (batch padding / evicted rows)
+    skip the write and produce garbage output the engine ignores.  Heads
+    live flattened in the lane dim; per-head logits/weights go through
+    the segment-indicator matmuls of `_decode_seg_helpers` (Mosaic's
+    (8,128) tiling forbids slicing H or D when they are not tile
+    multiples)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    refs = list(refs)
+    if quant:
+        gks_ref = refs.pop(0)
+        gvs_ref = refs.pop(0)
+        refs.pop(0)             # k_scales input: aliased, read pre-gathered
+        refs.pop(0)             # v_scales input
+        o_ref, ko_hbm, vo_hbm, kso_hbm, vso_hbm = refs[:5]
+        kbuf, vbuf, sem, ublk, usem, sstage = refs[5:]
+    else:
+        o_ref, ko_hbm, vo_hbm = refs[:3]
+        kbuf, vbuf, sem, ublk, usem = refs[3:]
+    hd = h * d
+    r = pl.program_id(0)
+    length = jnp.maximum(len_ref[r], 0)
+    slot = slot_ref[r]
+    valid = (slot >= 0) & (slot < nb * bs)
+    blk = jnp.clip(slot // bs, 0, nb - 1)
+    off = jnp.where(valid, slot % bs, 0)
+    # the write slot is the row's LAST position (length - 1), so the
+    # target block is the last logical block the attention stream visits
+    tkb = jnp.where(valid, jnp.clip((length - 1) // bs, 0, maxb - 1), -1)
+
+    fast = (jnp.bfloat16 if (not quant and kbuf.dtype == jnp.bfloat16)
+            else jnp.float32)
+    seg, expand, seg_dot = _decode_seg_helpers(h, d, fast)
+
+    # -- 1. fused cache update ---------------------------------------------
+    rk = pltpu.make_async_copy(k_hbm.at[pl.ds(blk, 1)], ublk.at[0],
+                               usem.at[0])
+    rv = pltpu.make_async_copy(v_hbm.at[pl.ds(blk, 1)], ublk.at[1],
+                               usem.at[1])
+    rk.start()
+    rv.start()
+    rk.wait()
+    rv.wait()
+    off_mask = (jax.lax.broadcasted_iota(jnp.int32, (1, bs, 1), 1) == off)
+
+    if quant:
+        kn32 = kn_ref[...].astype(jnp.float32)          # [1, 1, hd]
+        vn32 = vn_ref[...].astype(jnp.float32)
+        lane_h = jax.lax.broadcasted_iota(jnp.int32, (1, h), 1)
+        head_of = jax.lax.broadcasted_iota(jnp.int32, (1, 1, hd), 2) // d
+
+        def _head_amax(x32):
+            # per-head abs-max of one [1, 1, hd] row as a lane-oriented
+            # [1, h] vector (static unroll: h is small, and a lane-space
+            # segmented max has no matmul form)
+            res = jnp.zeros((1, h), jnp.float32)
+            ax = jnp.abs(x32)
+            for j in range(h):
+                mj = jnp.max(jnp.where(head_of == j, ax, 0.0))
+                res = jnp.where(lane_h == j, mj, res)
+            return res
+
+        def _sel_row(g_ref, kb):
+            # row kb of the pre-gathered [1, maxb, h] scale view as
+            # [1, h] — masked sublane sum instead of a dynamic VMEM slice
+            rows = g_ref[...][0]                         # [maxb, h]
+            mask = (jax.lax.broadcasted_iota(jnp.int32, (maxb, 1), 0)
+                    == kb)
+            return jnp.sum(jnp.where(mask, rows, 0.0), axis=0,
+                           keepdims=True)
+
+        def _quant_update(xn32, old_s, blk_codes):
+            # mirrors quantized_cache_update_arrays for ONE incoming row:
+            # the scale only GROWS; existing codes rescale by old/new
+            # (exactly 1.0 when unchanged — bit-stable steady state); the
+            # row quantizes against the new scale
+            amax = _head_amax(xn32)                      # [1, h]
+            new_s = jnp.where(valid,
+                              jnp.maximum(old_s, amax / _QMAX), old_s)
+            factor = jnp.where(
+                new_s > 0, old_s / jnp.where(new_s > 0, new_s, 1.0), 1.0)
+            fac_hd = seg_dot(factor[:, None, :], expand, exact=True)
+            resc = jnp.clip(
+                jnp.round(blk_codes.astype(jnp.float32) * fac_hd),
+                -_QMAX, _QMAX)
+            s_hd = seg_dot(new_s[:, None, :], expand, exact=True)
+            safe = jnp.where(s_hd > 0, s_hd, 1.0)
+            qrow = jnp.clip(jnp.round(xn32 / safe), -_QMAX, _QMAX)
+            codes = jnp.where(off_mask & valid, qrow, resc)  # [1, bs, hd]
+            return codes, new_s, s_hd
+
+        old_ks = _sel_row(gks_ref, tkb)
+        old_vs = _sel_row(gvs_ref, tkb)
+        k_codes, new_ks, ks_hd = _quant_update(kn32, old_ks,
+                                               ublk[0])
+        v_codes, new_vs, vs_hd = _quant_update(vn32, old_vs,
+                                               ublk[1])
+        ublk[0] = k_codes.astype(jnp.int8)
+        ublk[1] = v_codes.astype(jnp.int8)
+        sstage[0] = new_ks
+        sstage[1] = new_vs
+        kup_f = k_codes * ks_hd          # dequantized local target block
+        vup_f = v_codes * vs_hd
+    else:
+        kup = jnp.where(off_mask & valid,
+                        kn_ref[...].astype(ublk.dtype), ublk[0])
+        vup = jnp.where(off_mask & valid,
+                        vn_ref[...].astype(ublk.dtype), ublk[1])
+        ublk[0] = kup
+        ublk[1] = vup
+        kup_f = kup.astype(jnp.float32)
+        vup_f = vup.astype(jnp.float32)
+
+    @pl.when(valid)
+    def _writeback():
+        wk = pltpu.make_async_copy(ublk.at[0], ko_hbm.at[pl.ds(blk, 1)],
+                                   usem.at[0])
+        wv = pltpu.make_async_copy(ublk.at[1], vo_hbm.at[pl.ds(blk, 1)],
+                                   usem.at[1])
+        wk.start()
+        wv.start()
+        if quant:
+            sk = pltpu.make_async_copy(sstage.at[0],
+                                       kso_hbm.at[pl.ds(blk, 1)],
+                                       usem.at[2])
+            sv = pltpu.make_async_copy(sstage.at[1],
+                                       vso_hbm.at[pl.ds(blk, 1)],
+                                       usem.at[3])
+            sk.start()
+            sv.start()
+            sk.wait()
+            sv.wait()
+        # writes must complete before the stream below may read the same
+        # HBM region (the target block's streamed copy is discarded, but
+        # an in-flight overlapping read/write would be undefined)
+        wk.wait()
+        wv.wait()
+
+    # -- 2. streamed attention over the row's valid blocks ------------------
+    qf = q_ref[...].astype(jnp.float32)                  # [1, 1, hd]
+    # clamp to >= 1 block: the pre-loop prefetch starts unconditionally
+    # and a zero-trip loop would leave its semaphore unbalanced (padding
+    # rows read one garbage block; their output is ignored)
+    num_kb = jnp.clip((length + bs - 1) // bs, 1, maxb)
+
+    def _copies(slot_i, kb):
+        b_kb = jnp.clip(tbl_ref[r, kb], 0, nb - 1)
+        return (pltpu.make_async_copy(k_hbm.at[pl.ds(b_kb, 1)],
+                                      kbuf.at[slot_i], sem.at[slot_i, 0]),
+                pltpu.make_async_copy(v_hbm.at[pl.ds(b_kb, 1)],
+                                      vbuf.at[slot_i], sem.at[slot_i, 1]))
+
+    for c_ in _copies(0, 0):
+        c_.start()
+
+    def body(kb, carry):
+        m, l, acc = carry            # m, l: [1,1,h]; acc: [1,1,hd] fp32
+        sl = jax.lax.rem(kb, 2)
+
+        @pl.when(kb + 1 < num_kb)
+        def _prefetch():
+            for c_ in _copies(1 - sl, kb + 1):
+                c_.start()
+
+        kd, vd = _copies(sl, kb)
+        kd.wait()
+        is_t = valid & (kb == tkb)
+        kf = kbuf[sl].astype(jnp.float32)                # [1, bs, hd]
+        if quant:
+            ksel = jnp.where(is_t, new_ks, _sel_row(gks_ref, kb))
+            kf = kf * seg_dot(ksel[:, None, :], expand, exact=True)
+        kf = jnp.where(is_t, kup_f, kf)
+        s = seg_dot(kf * qf, seg) * scale                # [1, bs, h]
+        pos = kb * bs + jax.lax.broadcasted_iota(jnp.int32, (1, bs, h), 1)
+        s = jnp.where(pos < length, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=1, keepdims=True)
+        vd.wait()
+        vf = vbuf[sl].astype(jnp.float32)
+        if quant:
+            vsel = jnp.where(is_t, new_vs, _sel_row(gvs_ref, kb))
+            vf = vf * seg_dot(vsel[:, None, :], expand, exact=True)
+        vf = jnp.where(is_t, vup_f, vf)
+        pexp = seg_dot(p, expand)                        # [1, bs, hd]
+        pv = jnp.sum(pexp * vf, axis=1, keepdims=True)
+        acc_new = acc * seg_dot(alpha, expand, exact=True) + pv
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((1, 1, h), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((1, 1, h), jnp.float32)
+    acc0 = jnp.zeros((1, 1, hd), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
+    l_exp = seg_dot(l, expand, exact=True)
+    o_ref[...] = (acc / jnp.maximum(l_exp, 1e-30)).astype(o_ref.dtype)
+
+
+def _ragged_kernel_call(q, k_new, v_new, k_blocks, v_blocks, block_table,
+                        pos0, kv_lens, slots, k_scales, v_scales, scale):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, c, h, d = q.shape
+    nb, bs = int(k_blocks.shape[0]), int(k_blocks.shape[1])
+    hd = h * d
+    quant = k_scales is not None
+    pool_dt = k_blocks.dtype
+    tbl = jnp.asarray(block_table, jnp.int32)
+    maxb = int(tbl.shape[1])
+    del pos0   # the kernel masks by kv_lens; pos0 == kv_lens - 1 at C=1
+    lens_i = jnp.asarray(kv_lens, jnp.int32).reshape(b)
+    slots_i = jnp.asarray(slots, jnp.int32).reshape(b)
+    anyspace = getattr(pltpu, "HBM", pltpu.ANY)   # 0.4.x: ANY (HBM is the
+    #                                               newer-jax name)
+    in_specs = [
+        pl.BlockSpec((1, 1, hd), lambda r, *pre: (r, 0, 0)),     # q
+        pl.BlockSpec((1, 1, hd), lambda r, *pre: (r, 0, 0)),     # k_new
+        pl.BlockSpec((1, 1, hd), lambda r, *pre: (r, 0, 0)),     # v_new
+        pl.BlockSpec(memory_space=anyspace),                     # k pool
+        pl.BlockSpec(memory_space=anyspace),                     # v pool
+    ]
+    args = [q.reshape(b, c, hd), k_new.reshape(b, c, hd),
+            v_new.reshape(b, c, hd), k_blocks.reshape(nb, bs, hd),
+            v_blocks.reshape(nb, bs, hd)]
+    out_shape = [jax.ShapeDtypeStruct((b, 1, hd), q.dtype),
+                 jax.ShapeDtypeStruct((nb, bs, hd), pool_dt),
+                 jax.ShapeDtypeStruct((nb, bs, hd), pool_dt)]
+    out_specs = [pl.BlockSpec((1, 1, hd), lambda r, *pre: (r, 0, 0)),
+                 pl.BlockSpec(memory_space=anyspace),
+                 pl.BlockSpec(memory_space=anyspace)]
+    scratch = [
+        pltpu.VMEM((2, 1, bs, hd), pool_dt),      # k stream double-buffer
+        pltpu.VMEM((2, 1, bs, hd), pool_dt),      # v stream double-buffer
+        pltpu.SemaphoreType.DMA((2, 2)),
+        pltpu.VMEM((2, 1, bs, hd), pool_dt),      # target block k/v
+        pltpu.SemaphoreType.DMA((4,)),
+    ]
+    # aliasing indices INCLUDE the scalar-prefetch args (lens=0, slots=1,
+    # tables=2, q=3, k_new=4, v_new=5, pools=6/7; int8 adds gathered
+    # scale views 8/9 and the scale tables 10/11)
+    aliases = {6: 1, 7: 2}
+    if quant:
+        safe_tbl = jnp.clip(tbl, 0, nb - 1)
+        in_specs += [
+            pl.BlockSpec((1, maxb, h), lambda r, *pre: (r, 0, 0)),
+            pl.BlockSpec((1, maxb, h), lambda r, *pre: (r, 0, 0)),
+            pl.BlockSpec(memory_space=anyspace),
+            pl.BlockSpec(memory_space=anyspace),
+        ]
+        args += [jnp.take(k_scales, safe_tbl, axis=0),
+                 jnp.take(v_scales, safe_tbl, axis=0),
+                 k_scales, v_scales]
+        out_shape += [jax.ShapeDtypeStruct((nb, h), jnp.float32),
+                      jax.ShapeDtypeStruct((nb, h), jnp.float32)]
+        out_specs += [pl.BlockSpec(memory_space=anyspace),
+                      pl.BlockSpec(memory_space=anyspace)]
+        aliases.update({10: 3, 11: 4})
+        scratch.append(pltpu.VMEM((2, 1, h), jnp.float32))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch,
+    )
+    kernel = functools.partial(_ragged_fused_kernel, bs=bs, h=h, d=d,
+                               nb=nb, maxb=maxb, scale=scale, quant=quant)
+    outs = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        input_output_aliases=aliases,
+        interpret=_interpret(),
+    )(lens_i, slots_i, tbl, *args)
+    o = outs[0].reshape(b, c, h, d)
+    k2 = outs[1].reshape(k_blocks.shape)
+    v2 = outs[2].reshape(v_blocks.shape)
+    if quant:
+        return o, k2, v2, outs[3], outs[4]
+    return o, k2, v2
+
+
+# ---------------------------------------------------------------------------
+# XLA array-level fallback pieces
+# ---------------------------------------------------------------------------
+
+def _folded_quant_attention(q, k_blocks, v_blocks, k_scales, v_scales,
+                            block_table, pos0, scale):
+    """int8 paged attention WITHOUT the dequantizing gather: int8 CODES
+    are gathered (¼ of the fp32 dequant materialization the bucketed
+    path's `quantized_gather_kv_arrays` pays) and the per-block-per-head
+    scales fold into the logits (K side) and probabilities (V side) —
+    exact in real arithmetic because the scale is constant along the
+    contracted head_dim axis."""
+    b, s, h, d = q.shape
+    nb, bs = k_blocks.shape[0], k_blocks.shape[1]
+    tbl = jnp.clip(jnp.asarray(block_table, jnp.int32), 0, nb - 1)
+    maxb = tbl.shape[1]
+    s_pad = maxb * bs
+    kg = jnp.take(k_blocks, tbl, axis=0).reshape(b, s_pad, h, d)
+    vg = jnp.take(v_blocks, tbl, axis=0).reshape(b, s_pad, h, d)
+    # per-position scales: [B, maxb, H] broadcast over the block rows —
+    # [B, S_pad, H] fp32, a D-th of the dequantized-KV footprint
+    ksg = jnp.broadcast_to(
+        jnp.take(k_scales, tbl, axis=0)[:, :, None, :],
+        (b, maxb, bs, h)).reshape(b, s_pad, h)
+    vsg = jnp.broadcast_to(
+        jnp.take(v_scales, tbl, axis=0)[:, :, None, :],
+        (b, maxb, bs, h)).reshape(b, s_pad, h)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, kg.astype(jnp.float32),
+                        preferred_element_type=jnp.float32) * scale
+    logits = logits * jnp.transpose(ksg, (0, 2, 1))[:, :, None, :]
+    q_pos = jnp.asarray(pos0, jnp.int32)[:, None] + jnp.arange(
+        s, dtype=jnp.int32)[None, :]
+    k_pos = jnp.arange(s_pad, dtype=jnp.int32)
+    causal = k_pos[None, None, :] <= q_pos[:, :, None]
+    logits = jnp.where(causal[:, None], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    pw = probs * jnp.transpose(vsg, (0, 2, 1))[:, :, None, :]
+    out = jnp.einsum("bhqk,bkhd->bqhd", pw, vg.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# public entry
+# ---------------------------------------------------------------------------
+
+def ragged_paged_attention_arrays(q, k_new, v_new, k_blocks, v_blocks,
+                                  block_table, pos0, kv_lens, slots,
+                                  k_scales=None, v_scales=None, scale=None):
+    """Fused cache-update + causal paged attention for a ragged batch in
+    ONE fixed-shape program.
+
+    q, k_new, v_new: [B, C, H, D] — the current tokens (C = 1 at decode;
+                     C > 1 for a prefill-continuation chunk).  Rows may
+                     sit at DIFFERENT absolute positions (mixed
+                     prefill/decode batches) and padding rows ride along
+                     with dropped slots + ignored outputs.
+    k_blocks/v_blocks: [num_blocks, block_size, H, D] physical pools
+                     (fp, or int8 codes with `k_scales`/`v_scales`
+                     [num_blocks, H] per-block-per-head scale pools).
+    block_table:     [B, max_blocks] int32 per-row logical→physical map.
+    pos0:            [B] int32 absolute position of each row's first
+                     query (== context length before this chunk).
+    kv_lens:         [B] int32 valid KEY count per row AFTER the write
+                     (pos0 + valid queries) — the kernel's block-loop
+                     bound; ignored by the masked fallback.
+    slots:           [B, C] int32 physical write slots; out-of-range
+                     entries (padding / evicted rows) are dropped.
+
+    Returns ``(out, k_blocks', v_blocks')`` — plus ``(k_scales',
+    v_scales')`` in quantized mode.  The new tokens' K/V are written to
+    their slots INSIDE the program (write-then-attend, the dense cache
+    ordering), so callers never run a separate cache-update pass.
+    """
+    b, c, h, d = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    quant = k_scales is not None
+    if quant != (v_scales is not None):
+        raise ValueError("pass both k_scales and v_scales, or neither")
+    if _ragged_kernel_ok(q, k_blocks, c, quant):
+        return _ragged_kernel_call(q, k_new, v_new, k_blocks, v_blocks,
+                                   block_table, pos0, kv_lens, slots,
+                                   k_scales, v_scales, scale)
+    if not quant:
+        # bitwise the reference composition — the fp parity contract
+        k2 = paged_cache_update_arrays(k_blocks, k_new, slots)
+        v2 = paged_cache_update_arrays(v_blocks, v_new, slots)
+        out = paged_attention_arrays(q, k2, v2, block_table, pos0,
+                                     scale=scale)
+        return out, k2, v2
+    k2, ks2 = quantized_cache_update_arrays(k_blocks, k_scales, k_new,
+                                            slots)
+    v2, vs2 = quantized_cache_update_arrays(v_blocks, v_scales, v_new,
+                                            slots)
+    out = _folded_quant_attention(q, k2, v2, ks2, vs2, block_table, pos0,
+                                  scale)
+    return out, k2, v2, ks2, vs2

@@ -18,7 +18,9 @@ Layers (each its own module, each independently testable):
 - `scheduler.Scheduler`  — waiting queue, token-budget admission,
   preemption-by-eviction; `SamplingParams` / `Request` state machines.
 - `engine.LLMEngine`     — jitted prefill/decode/sample step programs over
-  `ops.paged_attention`, token-for-token equal to the dense
+  `ops.ragged_paged_attention` (default: ONE fixed-shape fused
+  update+attend decode program; `ops.paged_attention` is the bucketed
+  fallback), token-for-token equal to the dense
   `GPTForCausalLM.generate` (tests/test_serving.py pins it).
 
 The user-facing entry point also hangs off `paddle_tpu.inference`

@@ -17,12 +17,21 @@ Step programs (all array-level, weights threaded as inputs):
   matches dense generate" from a tolerance into token-for-token equality
   (tests/test_serving.py).  One compile per distinct prompt length — the
   prefill-compile price of exactness; decode, the steady-state loop, is
-  fully bucketed.
-- ``chunk(B, C)``  — ragged batch of C tokens per row against the paged
-  pool via `ops.paged_attention` (C=1 is the decode workhorse; C>1
-  serves chunked-prefill continuations).  Batch is padded to
-  power-of-two buckets; padding rows scatter to a dropped slot and their
-  outputs are ignored.
+  ONE fixed-shape program (ragged) or bucketed (fallback).
+- ``ragged(B, 1)`` — the decode workhorse (default,
+  ``EngineConfig(attention_impl="ragged")`` / env ``PTPU_RAGGED``): per
+  layer ONE fused `ops.ragged_paged_attention` call writes the new
+  tokens' K/V to their slots and attends the ragged batch against the
+  paged pools (int8 dequant folded into the block loads — no separate
+  `quantized_gather_kv_arrays` pass).  B is pinned to ``max_num_seqs``,
+  so ONE compiled program serves every batch composition — no
+  power-of-2 bucket recompiles when the running-request count crosses a
+  boundary.  ``ragged(1, C)`` serves chunked-prefill continuations.
+- ``chunk(B, C)``  — the bucketed fallback
+  (``attention_impl="bucketed"``): gather-blocks + masked attention via
+  `ops.paged_attention` with the batch padded to power-of-two buckets
+  (the PR-2 dispatch).  Padding rows scatter to a dropped slot and
+  their outputs are ignored in both implementations.
 - ``sample(B)``    — per-row replication of the dense `_sample_next`
   (greedy argmax / temperature / top-k / top-p + per-request PRNG key
   threading), vmapped so every request reproduces the sampling stream of
@@ -63,6 +72,7 @@ counts step-program cache misses.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Optional
 
@@ -78,6 +88,7 @@ from ..resilience.retry import Deadline
 from ..ops.paged_attention import (paged_attention_arrays,
                                    paged_cache_update_arrays,
                                    quantized_cache_update_arrays)
+from ..ops.ragged_paged_attention import ragged_paged_attention_arrays
 from .kv_cache import BlockKVCache
 from .scheduler import Request, SamplingParams, Scheduler
 
@@ -105,6 +116,13 @@ class EngineConfig:
     # /traces/<id>) on this port when the engine boots; 0 = ephemeral
     # (read it back from engine.metrics_server.port), None = no server.
     metrics_port: Optional[int] = None
+    # decode attention program (ISSUE 8): "ragged" runs ONE fixed-shape
+    # fused program (ops.ragged_paged_attention — in-program cache update,
+    # int8 dequant folded in, batch padded to max_num_seqs once) for every
+    # batch composition; "bucketed" keeps the PR-2 power-of-2-bucketed
+    # gather+attend dispatch as the fallback.  None resolves from env
+    # PTPU_RAGGED ("0"/"false"/"off" -> bucketed); default ragged.
+    attention_impl: Optional[str] = None
 
 
 class LLMEngine:
@@ -136,6 +154,16 @@ class LLMEngine:
                 f'kv_cache_dtype must be None or "int8", got '
                 f'{c.kv_cache_dtype!r}')
         self._kv_quant = c.kv_cache_dtype
+        impl = c.attention_impl
+        if impl is None:
+            impl = ("bucketed"
+                    if os.environ.get("PTPU_RAGGED", "1").lower()
+                    in ("0", "false", "off") else "ragged")
+        if impl not in ("ragged", "bucketed"):
+            raise ValueError(
+                f'attention_impl must be "ragged" or "bucketed", got '
+                f'{impl!r}')
+        self.attention_impl = impl
         wdtype = model.gpt.embeddings.word_embeddings.weight.dtype
         fp_blocks = c.max_num_seqs * self.blocks_per_seq
         if c.num_blocks is not None:
@@ -200,6 +228,9 @@ class LLMEngine:
                                    "seconds")
         self._m_compiles = m.counter("serving/compiles",
                                      "step-program cache misses")
+        self._m_attn_impl = m.counter(
+            "serving/attention_impl",
+            "decode steps served, by attention path")
         # rid -> trace_id survives release_request (the spans live in the
         # bounded monitor.trace store, not on the request); bounded like
         # that store — entries past it map to evicted traces anyway, and
@@ -468,13 +499,22 @@ class LLMEngine:
             logits, kv_out = fn(self._param_arrays(), kv, jnp.asarray(ids),
                                 jnp.asarray(slots))
         else:
-            fn = self._get_chunk_exec(1, chunk)
             tables = jnp.asarray(
                 [self.cache.padded_table(req.req_id, self.blocks_per_seq)],
                 jnp.int32)
-            logits, kv_out = fn(self._param_arrays(), kv, jnp.asarray(ids),
-                                jnp.asarray([start], jnp.int32), tables,
-                                jnp.asarray(slots))
+            if self.attention_impl == "ragged":
+                fn = self._get_ragged_exec(1, chunk)
+                logits, kv_out = fn(
+                    self._param_arrays(), kv, jnp.asarray(ids),
+                    jnp.asarray([start], jnp.int32),
+                    jnp.asarray([start + chunk], jnp.int32), tables,
+                    jnp.asarray(slots))
+            else:
+                fn = self._get_chunk_exec(1, chunk)
+                logits, kv_out = fn(
+                    self._param_arrays(), kv, jnp.asarray(ids),
+                    jnp.asarray([start], jnp.int32), tables,
+                    jnp.asarray(slots))
         self._store_kv(kv_out)
         req.num_computed = start + chunk
         if req.prefill_done:
@@ -503,13 +543,16 @@ class LLMEngine:
         perf_on = mperf.enabled()
         t0 = time.perf_counter() if perf_on else 0.0
         n = len(rows)
-        bb = 1
-        while bb < n:
-            bb *= 2
-        bb = min(max(bb, 1), self.scheduler.max_num_seqs)
-        num_slots = self.cache.num_blocks * self.cache.block_size
+        ragged = self.attention_impl == "ragged"
+        # ragged: ONE fixed shape (max_num_seqs) serves every batch
+        # composition — no per-bucket recompiles when the running-request
+        # count crosses a power of 2
+        bb = (self.scheduler.max_num_seqs if ragged
+              else self._bucket_batch(n))
+        num_slots = self.cache.num_slots
         toks = np.zeros((bb, 1), np.int32)
         pos0 = np.zeros((bb,), np.int32)
+        lens = np.zeros((bb,), np.int32)
         tables = np.full((bb, self.blocks_per_seq), self.cache.num_blocks,
                          np.int32)
         slots = np.full((bb, 1), num_slots, np.int32)
@@ -518,16 +561,25 @@ class LLMEngine:
                 else req.prompt_ids[-1]
             p = req.total_len - 1
             pos0[i] = p
+            lens[i] = req.total_len
             tables[i] = self.cache.padded_table(req.req_id,
                                                 self.blocks_per_seq)
             slots[i, 0] = self.cache.slot(req.req_id, p)
-        fn = self._get_chunk_exec(bb, 1)
+        self._m_attn_impl.labels(kind=self.attention_impl).inc()
         if perf_on:
             t1 = time.perf_counter()
             mperf.observe_segment("decode", "prep", t1 - t0)
-        logits, kv_out = fn(self._param_arrays(), self._kv_flat(),
-                            jnp.asarray(toks), jnp.asarray(pos0),
-                            jnp.asarray(tables), jnp.asarray(slots))
+        if ragged:
+            fn = self._get_ragged_exec(bb, 1)
+            logits, kv_out = fn(self._param_arrays(), self._kv_flat(),
+                                jnp.asarray(toks), jnp.asarray(pos0),
+                                jnp.asarray(lens), jnp.asarray(tables),
+                                jnp.asarray(slots))
+        else:
+            fn = self._get_chunk_exec(bb, 1)
+            logits, kv_out = fn(self._param_arrays(), self._kv_flat(),
+                                jnp.asarray(toks), jnp.asarray(pos0),
+                                jnp.asarray(tables), jnp.asarray(slots))
         if perf_on:
             jax.block_until_ready(logits)
             mperf.observe_segment("decode", "model",
@@ -599,16 +651,25 @@ class LLMEngine:
         ``ops.paged_attention`` exactly; numbers are attribution
         estimates (the fused program may never materialize the gather),
         which is precisely their job.
+
+        On the ragged path (ISSUE 8) the dict additionally carries
+        ``"ragged_fused"`` — the fused update+attention program of
+        `ops.ragged_paged_attention` per layer — so the before-side trio
+        (block_gather/attention/cache_update) and the after-side fusion
+        sit in ONE report and the fusion win is readable as
+        ``ragged_fused.wall_time_s`` vs the trio's sum.
         """
         cfg = self.cfg
         L = cfg.num_hidden_layers
         nh = cfg.num_attention_heads
         hd = cfg.hidden_size // nh
-        bb = 1
-        while bb < self.scheduler.max_num_seqs:
-            bb *= 2
+        ragged = self.attention_impl == "ragged"
+        # the LIVE decode batch width: the ragged program runs at
+        # max_num_seqs, the bucketed fallback at its full-batch bucket
+        bb = (self.scheduler.max_num_seqs if ragged
+              else self._bucket_batch(self.scheduler.max_num_seqs))
         s_pad = self.blocks_per_seq * self.cache.block_size
-        num_slots = self.cache.num_blocks * self.cache.block_size
+        num_slots = self.cache.num_slots
         wdtype = self.model.gpt.embeddings.word_embeddings.weight.dtype
         kv_flat = self._kv_flat()
         tables = (jnp.arange(bb * self.blocks_per_seq, dtype=jnp.int32)
@@ -697,16 +758,55 @@ class LLMEngine:
                 label="decode:cache_update", reps=reps,
                 donate_argnums=(0,)),
         }
+        lens = jnp.full((bb,), s_pad, jnp.int32)
+        if ragged:
+            # the ISSUE-8 after-side: ONE fused program per layer doing
+            # update + attention (+ int8 dequant at the loads) — measured
+            # against the same roofline as the before-side trio above
+            def ragged_fn(kv, q_, rows_, slots_):
+                kvo = list(kv)
+                acc = jnp.float32(0.0)
+                for l in range(L):
+                    ql = q_ + jnp.asarray(l, q_.dtype)   # defeat CSE
+                    part = kv[stride * l:stride * (l + 1)]
+                    if quant:
+                        o, k2, v2, ks2, vs2 = ragged_paged_attention_arrays(
+                            ql, rows_, rows_, part[0], part[1], tables,
+                            pos0, lens, slots_,
+                            k_scales=part[2], v_scales=part[3])
+                        kvo[stride * l:stride * (l + 1)] = [k2, v2, ks2,
+                                                            vs2]
+                    else:
+                        o, k2, v2 = ragged_paged_attention_arrays(
+                            ql, rows_, rows_, part[0], part[1], tables,
+                            pos0, lens, slots_)
+                        kvo[stride * l:stride * (l + 1)] = [k2, v2]
+                    acc += jnp.sum(o.astype(jnp.float32))
+                return tuple(kvo), acc
+
+            kv_copy_r = tuple(jnp.array(a, copy=True) for a in kv_flat)
+            out["ragged_fused"] = mperf.measure(
+                ragged_fn, kv_copy_r, q, rows, slots,
+                label="decode:ragged_fused", reps=reps,
+                donate_argnums=(0,),
+                rearm=lambda args, o: (o[0],) + args[1:])
         # the real step programs, measured as compiled (donated pools
         # ping-ponged through the output so the engine's live cache is
         # never consumed)
         toks = jnp.zeros((bb, 1), jnp.int32)
         kv_copy2 = tuple(jnp.array(a, copy=True) for a in kv_flat)
-        out["step"] = mperf.measure(
-            self._get_chunk_exec(bb, 1),
-            self._param_arrays(), kv_copy2, toks, pos0, tables, slots,
-            label="decode:step", reps=reps,
-            rearm=lambda args, o: args[:1] + (o[1],) + args[2:])
+        if ragged:
+            out["step"] = mperf.measure(
+                self._get_ragged_exec(bb, 1),
+                self._param_arrays(), kv_copy2, toks, pos0, lens, tables,
+                slots, label="decode:step", reps=reps,
+                rearm=lambda args, o: args[:1] + (o[1],) + args[2:])
+        else:
+            out["step"] = mperf.measure(
+                self._get_chunk_exec(bb, 1),
+                self._param_arrays(), kv_copy2, toks, pos0, tables, slots,
+                label="decode:step", reps=reps,
+                rearm=lambda args, o: args[:1] + (o[1],) + args[2:])
         logits = jnp.zeros((bb, cfg.vocab_size), jnp.float32)
         out["sampler"] = mperf.measure(
             self._get_sample_exec(bb),
@@ -758,6 +858,28 @@ class LLMEngine:
 
     # -- jitted step programs ----------------------------------------------
 
+    def _bucket_batch(self, n: int) -> int:
+        """Power-of-2 decode bucket — the PR-2 dispatch, reachable only
+        through the "bucketed" fallback path (the ragged program always
+        runs at max_num_seqs, so batch-composition changes never
+        recompile)."""
+        bb = 1
+        while bb < n:
+            bb *= 2
+        return min(max(bb, 1), self.scheduler.max_num_seqs)
+
+    def _count_compile(self, kind: str) -> None:
+        """A step-program cache miss: counted as `serving/compiles{kind}`
+        AND into the framework-wide `jit/recompiles{fn}` attribution (the
+        engine drives jax.jit directly, bypassing jit.CompiledFunction's
+        counter — the bucket-crossing regression test reads this)."""
+        self._m_compiles.labels(kind=kind).inc()
+        if monitor.enabled():
+            monitor.counter(
+                "jit/recompiles",
+                "fresh trace+XLA-compile events per function").labels(
+                fn=f"serving:{kind}").inc()
+
     def _model_tail(self, params, h):
         """Final LN + tied LM head — the dense path's ln_f arithmetic
         (`F.layer_norm`, NOT the block `_stacked_ln`) and lm_head einsum,
@@ -791,7 +913,7 @@ class LLMEngine:
     def _get_prefill_exec(self, p_len):
         key = ("prefill", p_len)
         if key not in self._jit_cache:
-            self._m_compiles.labels(kind="prefill").inc()
+            self._count_compile("prefill")
 
             def fn(params, kv_flat, ids, slots):
                 from ..ops.pallas_ops import flash_attention_arrays
@@ -827,7 +949,7 @@ class LLMEngine:
     def _get_chunk_exec(self, b, c):
         key = ("chunk", b, c)
         if key not in self._jit_cache:
-            self._m_compiles.labels(kind="chunk").inc()
+            self._count_compile("chunk")
 
             def fn(params, kv_flat, ids, pos0, tables, slots):
                 pos = pos0[:, None] + jnp.arange(c, dtype=jnp.int32)[None]
@@ -863,10 +985,46 @@ class LLMEngine:
             self._jit_cache[key] = jax.jit(fn, donate_argnums=(1,))
         return self._jit_cache[key]
 
+    def _get_ragged_exec(self, b, c):
+        """The ISSUE-8 decode program: per layer, ONE fused
+        `ragged_paged_attention_arrays` call does cache write + attention
+        (+ int8 dequant at the block loads) — no separate
+        `block_gather/attention/cache_update` triple.  At (max_num_seqs,
+        1) this is the single compiled program every decode batch
+        composition runs."""
+        key = ("ragged", b, c)
+        if key not in self._jit_cache:
+            self._count_compile("ragged")
+
+            def fn(params, kv_flat, ids, pos0, lens, tables, slots):
+                pos = pos0[:, None] + jnp.arange(c, dtype=jnp.int32)[None]
+                x = jnp.take(params["wte"], ids, axis=0) \
+                    + jnp.take(params["wpe"], pos, axis=0)
+
+                def builder(kc, vc, ksc=None, vsc=None):
+                    def attn_fn(q, k, v, kc=kc, vc=vc, ksc=ksc, vsc=vsc):
+                        if ksc is None:
+                            o, kc2, vc2 = ragged_paged_attention_arrays(
+                                q, k, v, kc, vc, tables, pos0, lens,
+                                slots)
+                            return o, (kc2, vc2)
+                        o, kc2, vc2, ks2, vs2 = \
+                            ragged_paged_attention_arrays(
+                                q, k, v, kc, vc, tables, pos0, lens,
+                                slots, k_scales=ksc, v_scales=vsc)
+                        return o, (kc2, vc2, ks2, vs2)
+                    return attn_fn
+
+                h, kv_out = self._run_blocks(params, kv_flat, x, builder)
+                return self._model_tail(params, h), kv_out
+
+            self._jit_cache[key] = jax.jit(fn, donate_argnums=(1,))
+        return self._jit_cache[key]
+
     def _get_sample_exec(self, b):
         key = ("sample", b)
         if key not in self._jit_cache:
-            self._m_compiles.labels(kind="sample").inc()
+            self._count_compile("sample")
 
             def row(l, key_, ds, t, k, p):
                 # replicates models.gpt._sample_next on a [1, V] row so a

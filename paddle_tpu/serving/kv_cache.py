@@ -125,6 +125,16 @@ class BlockKVCache:
         return self.num_blocks * self.bytes_per_block
 
     @property
+    def num_slots(self) -> int:
+        """Total physical token slots — also the ragged kernel's
+        "dropped write" sentinel: a slot id >= num_slots marks a padding
+        / evicted row whose write must be discarded, never clamped.
+        (The per-row true lengths the kernel bounds its block stream by
+        come from the engine's Request state — `req.total_len` is the
+        authoritative value at decode time.)"""
+        return self.num_blocks * self.block_size
+
+    @property
     def num_free_blocks(self) -> int:
         return len(self._free)
 

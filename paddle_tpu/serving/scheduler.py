@@ -218,7 +218,12 @@ class Scheduler:
             self.running.append(req)
             return True
         start = req.num_computed    # >0 only for forked children, which
-        #                             already hold (shared) prefix blocks
+        #                             already hold (shared) prefix blocks.
+        # Admission budgets TOKENS and KV blocks only: the ragged decode
+        # program runs at a fixed max_num_seqs width, so an admitted row
+        # joins the batch directly — there is no per-bucket padding
+        # budget to respect (the bucketed fallback pads the batch up to
+        # the next power of 2 itself).
         chunk = min(req.prompt_len - start, self.max_num_batched_tokens)
         target = start + chunk
         forked = req.req_id in self.cache._tables

@@ -31,6 +31,9 @@ case "${1:-fast}" in
     # config is tiny and shared-host noisy; it catches cliffs, the real
     # lane in `gates` catches percent-level drift on chip hosts.
     python bench.py
+    # ragged-vs-bucketed decode A/B (ISSUE 8): its tokens/s lines join
+    # the same smoke-lane history gate below
+    python bench.py --config ragged_decode
     python tools/check_bench_regression.py --history BENCH_HISTORY.jsonl \
       --gate-smoke --tolerance 0.50
     ;;
