@@ -218,6 +218,9 @@ class InMemoryDataset:
     def local_shuffle(self):
         import random
 
+        # ptpu-check[determinism]: reference-API contract — paddle's
+        # InMemoryDataset shuffles on the global stream, seedable via
+        # random.seed() like the reference
         random.shuffle(self._records)
 
     global_shuffle = local_shuffle

@@ -128,6 +128,9 @@ class ElasticManager:
             try:
                 ts = float(self.store.get(f"{self.PREFIX}/node/{nid}",
                                           timeout_ms=200).decode())
+                # ptpu-check[wall-clock]: cross-process TTL — `ts` is
+                # another node's wall clock; monotonic doesn't travel
+                # between hosts, wall-vs-wall is the only comparison
                 if now - ts <= self.ttl:
                     alive.append(nid)
             except (TimeoutError, ValueError):
@@ -182,7 +185,9 @@ class ElasticManager:
     _below_since = None
 
     def _below_min_since(self, grace=30.0):
-        now = time.time()
+        # local grace window -> monotonic (an NTP step must not expire
+        # or stretch it)
+        now = time.monotonic()
         if self._below_since is None:
             self._below_since = now
             return False
@@ -211,6 +216,6 @@ class ElasticManager:
                 if slot is not None:
                     self.store.set(f"{self.PREFIX}/registry/{slot}", b"")
             except (ConnectionError, OSError, TimeoutError):
-                pass   # justified: deregistration is cosmetic — the TTL
+                pass   # ptpu-check[silent-except]: deregistration is cosmetic — the TTL
                 # expiry removes a dead node anyway, and exit() must not
                 # raise when the master is already gone

@@ -71,7 +71,7 @@ class HybridParallelOptimizer:
         for p in opt._parameter_list:
             try:
                 p._data = jax.device_put(p._data, param_sharding(p))
-            except Exception:  # justified: device_put onto a partial mesh
+            except Exception:  # ptpu-check[silent-except]: device_put onto a partial mesh
                 # can reject a shape; the array stays on its current placement
                 pass
             opt._ensure_state(p)
@@ -80,7 +80,7 @@ class HybridParallelOptimizer:
             for sname, arr in opt._states[key].items():
                 try:
                     opt._states[key][sname] = jax.device_put(arr, slot_sh)
-                except Exception:  # justified: same best-effort placement as
+                except Exception:  # ptpu-check[silent-except]: same best-effort placement as
                     # above
                     pass
             if key in opt._master_weights:
@@ -88,7 +88,7 @@ class HybridParallelOptimizer:
                     opt._master_weights[key] = jax.device_put(
                         opt._master_weights[key], slot_sh
                     )
-                except Exception:  # justified: same best-effort placement as
+                except Exception:  # ptpu-check[silent-except]: same best-effort placement as
                     # above
                     pass
         self._placed = True

@@ -87,14 +87,19 @@ class Controller:
             raw = self._store.get(self._hb_key(slot),
                                   timeout_ms=get_timeout_ms)
             self._no_hb_since.pop(slot, None)
+            # ptpu-check[wall-clock]: cross-process heartbeat — another
+            # node WROTE this wall-clock value; monotonic clocks don't
+            # travel between hosts, so wall-vs-wall is the only comparison
             return time.time() - float(raw.decode()) > self.cfg.stale_timeout
         except Exception:
             # claimed but no heartbeat yet: live during a grace window
             # (claimant writes its first beat right after claiming), stale
             # if the beat never appears — a claimant that died immediately
             # must not wedge the slot forever
-            first = self._no_hb_since.setdefault(slot, time.time())
-            return time.time() - first > self.cfg.stale_timeout
+            # grace window is LOCAL elapsed time -> monotonic (an NTP
+            # step must not instantly expire or stretch it)
+            first = self._no_hb_since.setdefault(slot, time.monotonic())
+            return time.monotonic() - first > self.cfg.stale_timeout
 
     def _resolve_node_rank(self) -> int:
         """Claim a node slot through the KV master. Fresh slots are taken
@@ -127,13 +132,13 @@ class Controller:
         # are harmless, unlike the old add-based claim).
         uid = self._store.add(f"{cfg.job_id}/token_seq", 1)
         token = f"{os.getpid()}:{uid}".encode()
-        deadline = time.time() + cfg.rendezvous_timeout
+        deadline = time.monotonic() + cfg.rendezvous_timeout
         while True:
             for slot in range(cfg.nnodes):
                 # heartbeat reads on claimed-but-silent slots block; bound
                 # them by the remaining budget so a sweep over several dead
                 # claimants cannot overshoot rendezvous_timeout by minutes
-                remaining_ms = int((deadline - time.time()) * 1000)
+                remaining_ms = int((deadline - time.monotonic()) * 1000)
                 if remaining_ms <= 0:
                     break
                 okey = self._owner_key(slot)
@@ -175,7 +180,7 @@ class Controller:
                           f"of job {cfg.job_id!r} (token {token.decode()})",
                           flush=True)
                     return slot
-            if time.time() >= deadline:
+            if time.monotonic() >= deadline:
                 raise RuntimeError(
                     f"no free node slot in job {cfg.job_id!r} "
                     f"(nnodes={cfg.nnodes}, all slots held by live nodes)")
@@ -243,10 +248,10 @@ class Controller:
                     os.killpg(p.pid, sig)
                 except (ProcessLookupError, PermissionError):
                     pass
-        deadline = time.time() + 10
+        deadline = time.monotonic() + 10
         for p in self.procs:
             try:
-                p.wait(max(0.1, deadline - time.time()))
+                p.wait(max(0.1, deadline - time.monotonic()))
             except subprocess.TimeoutExpired:
                 try:
                     os.killpg(p.pid, signal.SIGKILL)
@@ -265,7 +270,8 @@ class Controller:
         pos = 0
         last_hb = 0.0
         while True:
-            if time.time() - last_hb > max(self.cfg.stale_timeout / 3, 0.5):
+            if time.monotonic() - last_hb > max(self.cfg.stale_timeout / 3,
+                                                0.5):
                 if not self._heartbeat(node_rank):
                     # fenced: lease lost to a replacement node — running on
                     # would split-brain the slot (duplicate global ranks)
@@ -273,7 +279,7 @@ class Controller:
                           "fencing this pod", flush=True)
                     self.stop_pod()
                     return 102   # reference ELASTIC re-plan exit code
-                last_hb = time.time()
+                last_hb = time.monotonic()
             pos = self._tail_rank0(pos)
             codes = [p.poll() for p in self.procs]
             if any(c not in (None, 0) for c in codes):

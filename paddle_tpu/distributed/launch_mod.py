@@ -31,7 +31,7 @@ def _spawn_target(func, args, rank, nprocs, backend):
             import jax
 
             jax.config.update("jax_platforms", backend)
-        except Exception:  # justified: backend pin is advisory in the child
+        except Exception:  # ptpu-check[silent-except]: backend pin is advisory in the child
             # — PTPU_FORCE_PLATFORM already pinned it in __init__
             pass
     func(*args)

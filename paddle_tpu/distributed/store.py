@@ -72,10 +72,12 @@ class _PyHandler(socketserver.BaseRequestHandler):
                     self.request.sendall(struct.pack("<I", 0))
                 elif cmd in (1, 3):  # GET / WAIT
                     (timeout_ms,) = struct.unpack("<I", self._read(4))
-                    deadline = None if timeout_ms == 0 else time.time() + timeout_ms / 1e3
+                    # monotonic: a wall-clock (NTP) step mid-wait would
+                    # stretch or instantly expire the timeout
+                    deadline = None if timeout_ms == 0 else time.monotonic() + timeout_ms / 1e3
                     with st.cv:
                         while key not in st.data:
-                            remain = None if deadline is None else deadline - time.time()
+                            remain = None if deadline is None else deadline - time.monotonic()
                             if remain is not None and remain <= 0:
                                 break
                             st.cv.wait(remain if remain is not None else 0.2)
@@ -458,7 +460,7 @@ class TCPStore:
     def __del__(self):
         try:
             self.close()
-        except Exception:  # justified: interpreter teardown — modules the
+        except Exception:  # ptpu-check[silent-except]: interpreter teardown — modules the
             # close path touches may already be torn down; raising in
             # __del__ only prints noise
             pass

@@ -226,7 +226,10 @@ class OptimizationTuner:
                         continue   # pruned in estimate anyway; skip early
                     for sh in _divisors(n // (mp * pp * sp)):
                         dp = n // (mp * pp * sp * sh)
-                        for mb in {1, pp, 2 * pp, 4 * pp} - {0}:
+                        # sorted: set order is PYTHONHASHSEED-dependent
+                        # and this feeds Plan enumeration order (tie-break
+                        # selection must be stable across processes)
+                        for mb in sorted({1, pp, 2 * pp, 4 * pp} - {0}):
                             for rc in (True, False):
                                 out.append(Plan(
                                     dp=dp, sharding=sh, pp=pp, mp=mp,
@@ -336,7 +339,7 @@ class OptimizationTuner:
             import jax
 
             platform = jax.devices()[0].platform
-        except Exception:  # justified: platform tag on the calibration
+        except Exception:  # ptpu-check[silent-except]: platform tag on the calibration
             # payload is metadata only
             pass
         payload = {

@@ -12,7 +12,8 @@ class ProgressBar:
         self._width = width
         self._verbose = verbose
         self._stream = stream
-        self._start = time.time()
+        # per-step timing is elapsed math -> perf_counter, not wall clock
+        self._start = time.perf_counter()
         self._last_update = 0
 
     def _format_values(self, values):
@@ -29,7 +30,7 @@ class ProgressBar:
     def update(self, current_num, values=None):
         if self._verbose == 0:
             return
-        now = time.time()
+        now = time.perf_counter()
         msg = self._format_values(values or [])
         if self._num:
             prefix = f"step {current_num}/{self._num}"

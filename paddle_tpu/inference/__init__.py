@@ -279,7 +279,7 @@ class Predictor:
                 p_tree, cast[:len(p_flat)])
             self._buffers = jax.tree_util.tree_unflatten(
                 b_tree, cast[len(p_flat):])
-        except Exception:  # justified: aval introspection is best-effort;
+        except Exception:  # ptpu-check[silent-except]: aval introspection is best-effort;
             # call() validates
             pass   # aval introspection is best-effort; call() validates
         self._n_inputs = n_inputs

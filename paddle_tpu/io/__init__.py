@@ -118,6 +118,8 @@ class Subset(Dataset):
 def random_split(dataset, lengths, generator=None):
     if sum(lengths) != len(dataset):
         raise ValueError("sum of lengths must equal dataset length")
+    # ptpu-check[determinism]: reference-API contract — paddle samplers
+    # draw from numpy's global RNG, seedable via np.random.seed()
     perm = np.random.permutation(len(dataset))
     out, off = [], 0
     for n in lengths:
@@ -155,7 +157,10 @@ class RandomSampler(Sampler):
     def __iter__(self):
         n = len(self.data_source)
         if self.replacement:
+            # ptpu-check[determinism]: reference-API contract (see
+            # random_split) — global numpy stream, np.random.seed-able
             return iter(np.random.randint(0, n, self.num_samples).tolist())
+        # ptpu-check[determinism]: same contract as above
         return iter(np.random.permutation(n)[: self.num_samples].tolist())
 
     def __len__(self):
@@ -170,6 +175,7 @@ class WeightedRandomSampler(Sampler):
 
     def __iter__(self):
         p = self.weights / self.weights.sum()
+        # ptpu-check[determinism]: reference-API contract (see random_split)
         idx = np.random.choice(len(self.weights), self.num_samples, replace=self.replacement, p=p)
         return iter(idx.tolist())
 
@@ -321,7 +327,7 @@ def _worker_loop(dataset, collate_fn, my_batches, ring_name, worker_id,
 
         try:
             q.put(("__PTPU_ERR__", traceback.format_exc()), timeout_ms=5000)
-        except Exception:  # justified: the error channel itself failed — the
+        except Exception:  # ptpu-check[silent-except]: the error channel itself failed — the
             # finally-close below is the only thing left to do
             pass
     finally:

@@ -154,6 +154,6 @@ class ShmQueue:
     def __del__(self):
         try:
             self.close()
-        except Exception:  # justified: interpreter teardown — close()
+        except Exception:  # ptpu-check[silent-except]: interpreter teardown — close()
             # touches modules that may already be gone
             pass

@@ -339,7 +339,7 @@ class CompiledFunction:
                     # probe must not serve another signature's bytes to a
                     # memfit gate
                     self._analysis_cache[sig] = dict(rec.memory)
-            except Exception:   # justified: AOT lowering support varies
+            except Exception:   # ptpu-check[silent-except]: AOT lowering support varies
                 # (exotic shardings/backends); dispatch path still works
                 monitor.counter(
                     "perf/aot_fallbacks",

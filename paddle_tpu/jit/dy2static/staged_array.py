@@ -100,7 +100,7 @@ class StagedArray:
                     "from the helper and rebind it "
                     "(`lst = helper(lst, x)`), or mutate it directly in "
                     "the converted function body.")
-        except Exception:  # justified: __del__-time diagnostic — raising in
+        except Exception:  # ptpu-check[silent-except]: __del__-time diagnostic — raising in
             # a finalizer only prints noise over the real error
             pass
 

@@ -826,8 +826,11 @@ def _sample_next(logits, key, do_sample, temperature, top_k, top_p):
     temperature / top-k / nucleus (top-p) sampling."""
     if not do_sample:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    # ptpu-check[host-sync]: temperature/top_k are python-level sampling
+    # config, closed over statically at trace time — never traced operands
     logits = logits / max(float(temperature), 1e-6)
     if top_k and top_k > 0:
+        # ptpu-check[host-sync]: top_k is static python config (see above)
         kth = jnp.sort(logits, axis=-1)[:, -int(top_k)][:, None]
         logits = jnp.where(logits < kth, _NEG_INF, logits)
     if top_p is not None and top_p < 1.0:

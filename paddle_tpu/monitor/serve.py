@@ -24,7 +24,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 __all__ = ["MonitorServer", "start_server", "stop_server"]
 
-_started_at = time.time()
+# uptime is ELAPSED time: monotonic survives NTP steps/suspend, where a
+# wall-clock delta could report negative or hours-wrong uptime
+_started_at = time.monotonic()
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -49,7 +51,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, json.dumps({
                 "status": "ok",
                 "pid": os.getpid(),
-                "uptime_s": round(time.time() - _started_at, 3),
+                "uptime_s": round(time.monotonic() - _started_at, 3),
                 "last_activity_age_s": round(trace.last_activity_age(), 3),
                 "monitor_enabled": enabled(),
                 "trace_enabled": trace.enabled(),

@@ -417,6 +417,8 @@ def _tuned_blocks(kernel, sq, sk, d, bh, dtype, is_causal, scale):
 
     from . import autotune as at
 
+    # ptpu-check[host-sync]: autotune keys on static shape/dtype/flag
+    # config — these are trace-time constants, not traced values
     key = (bh, sq, sk, d, str(dtype), bool(is_causal))
     cands = _block_candidates(sq, sk)
     runner = None

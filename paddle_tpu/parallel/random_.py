@@ -52,6 +52,8 @@ def get_rng_state_tracker():
 def model_parallel_random_seed(seed=None):
     import random as pyrandom
 
+    # ptpu-check[determinism]: reference-parity default — fleet draws a
+    # random seed when none is given; deterministic runs pass seed=
     seed = seed or (1024 + pyrandom.randint(0, 100000))
     _TRACKER.reset()
     _TRACKER.add("global_seed", seed)

@@ -54,10 +54,14 @@ def shuffle(reader, buf_size):
         for item in reader():
             buf.append(item)
             if len(buf) >= buf_size:
+                # ptpu-check[determinism]: reference-API contract —
+                # decorator.py's shuffle uses the global stream; callers
+                # seed `random` for reproducible order (test_examples does)
                 _random.shuffle(buf)
                 yield from buf
                 buf = []
         if buf:
+            # ptpu-check[determinism]: same contract as above
             _random.shuffle(buf)
             yield from buf
 

@@ -240,7 +240,7 @@ class CheckpointManager:
                 if strict and _crc32(a) != meta["crc32"]:
                     raise CheckpointError(f"{k}: crc32 mismatch")
             return state
-        except Exception as e:  # justified: orbax raises backend-specific
+        except Exception as e:  # ptpu-check[silent-except]: orbax raises backend-specific
             # errors for truncated/corrupt payloads; ANY failure here means
             # "this candidate is not intact", which is exactly the event
             # restore_latest() recovers from (counted, warned, skipped)
@@ -326,7 +326,7 @@ class CheckpointManager:
             step, host = item
             try:
                 self._save_sync(step, host)
-            except BaseException as e:  # justified: surfaced to the caller
+            except BaseException as e:  # ptpu-check[silent-except]: surfaced to the caller
                 # on the next save()/wait_until_finished() — an async save
                 # failure must not die silently on a daemon thread
                 self._async_error = e

@@ -73,6 +73,8 @@ def cond(pred, true_fn: Callable = None, false_fn: Callable = None,
     if not callable(true_fn) or not callable(false_fn):
         raise TypeError("cond requires callable true_fn and false_fn")
     if _is_concrete(pred):
+        # ptpu-check[host-sync]: eager-only arm — the _is_concrete guard
+        # on the line above means pred is never a tracer here
         branch = true_fn if bool(unwrap(pred)) else false_fn
         return _wrap_tree(branch())
     t_out = _wrap_tree(true_fn())

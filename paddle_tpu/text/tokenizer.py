@@ -169,7 +169,7 @@ class _NativeWordpiece:
         try:
             if self._handle is not None and self._lib is not None:
                 self._lib.wp_vocab_free(self._handle)
-        except Exception:  # justified: interpreter teardown — the native lib
+        except Exception:  # ptpu-check[silent-except]: interpreter teardown — the native lib
             # may be unloaded before this __del__ runs
             pass
 

@@ -1,0 +1,2 @@
+# tools/ is a package so `python -m tools.ptpu_check` resolves; the
+# standalone scripts in here keep working when invoked by path.

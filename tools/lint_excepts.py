@@ -1,112 +1,36 @@
 #!/usr/bin/env python
-"""Repo lint: no silently-swallowed failures in paddle_tpu/.
+"""DEPRECATED shim — this lint is re-homed as the ``silent-except`` rule
+of the unified analyzer (``python -m tools.ptpu_check``; see README
+"Static analysis").
 
-Rejects two patterns (ISSUE 3 satellite — a resilience runtime is only
-trustworthy if failures can't vanish):
-
-1. a bare ``except:`` anywhere (catches SystemExit/KeyboardInterrupt —
-   it would even eat the preemption handler's exit);
-2. ``except Exception:`` / ``except BaseException:`` whose handler body
-   is ONLY ``pass``/``...`` — the classic silent swallow.
-
-A site that is genuinely justified (interpreter teardown, best-effort
-cosmetic cleanup) stays allowed by carrying the marker ``justified:``
-in a comment on the ``except`` line or inside the handler body, e.g.::
-
-    except Exception:  # justified: interpreter teardown — raising in
-        # __del__ only prints noise
-        pass
-
-The marker forces every swallow to document WHY it is safe; the lint
-turns an undocumented one into a CI failure.
-
-Usage: python tools/lint_excepts.py [root]      (default: paddle_tpu/)
-Exit code 0 = clean, 1 = violations (printed one per line).
+Kept so the historical CLI keeps working byte-for-byte in spirit:
+``python tools/lint_excepts.py [root]`` (default: paddle_tpu/), exit 0 =
+clean / 1 = violations, one ``path:line: message`` per violation.  Both the legacy ``justified:``
+marker and the unified ``ptpu-check[silent-except]:`` marker suppress.
 """
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-MARKER = "justified:"
-BROAD = ("Exception", "BaseException")
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))   # repo root
 
-
-def _is_broad(handler: ast.ExceptHandler) -> bool:
-    t = handler.type
-    if t is None:
-        return True
-    if isinstance(t, ast.Name) and t.id in BROAD:
-        return True
-    if isinstance(t, ast.Tuple):
-        return any(isinstance(e, ast.Name) and e.id in BROAD for e in t.elts)
-    return False
-
-
-def _swallows(handler: ast.ExceptHandler) -> bool:
-    """Body is only pass/... — the exception dies with no trace."""
-    for stmt in handler.body:
-        if isinstance(stmt, ast.Pass):
-            continue
-        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
-            continue   # docstring or `...`
-        return False
-    return True
-
-
-def _handler_lines(src_lines, handler: ast.ExceptHandler):
-    last = handler.lineno
-    for n in ast.walk(handler):
-        end = getattr(n, "end_lineno", None)
-        if end is not None:
-            last = max(last, end)
-    return src_lines[handler.lineno - 1:last]
-
-
-def check_file(path: str):
-    with open(path, encoding="utf-8") as f:
-        src = f.read()
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [(path, e.lineno or 0, f"syntax error: {e.msg}")]
-    lines = src.splitlines()
-    out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.ExceptHandler):
-            continue
-        justified = any(MARKER in ln for ln in _handler_lines(lines, node))
-        if node.type is None:
-            if not justified:
-                out.append((path, node.lineno,
-                            "bare `except:` (catches SystemExit/"
-                            "KeyboardInterrupt) — name the exceptions, or "
-                            f"document with `# {MARKER} ...`"))
-            continue
-        if _is_broad(node) and _swallows(node) and not justified:
-            out.append((path, node.lineno,
-                        "`except Exception: pass` silently swallows "
-                        "failures — narrow the types, handle it, or "
-                        f"document with `# {MARKER} ...`"))
-    return out
+from tools.ptpu_check.api import run_check   # noqa: E402
 
 
 def main(argv):
-    root = argv[1] if len(argv) > 1 else os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "..", "paddle_tpu")
+    root = argv[1] if len(argv) > 1 else os.path.join(_HERE, "..",
+                                                      "paddle_tpu")
     root = os.path.abspath(root)
-    violations = []
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for name in sorted(filenames):
-            if name.endswith(".py"):
-                violations.extend(check_file(os.path.join(dirpath, name)))
-    for path, lineno, msg in violations:
-        rel = os.path.relpath(path, os.path.dirname(root))
-        print(f"{rel}:{lineno}: {msg}")
-    if violations:
-        print(f"\nlint_excepts: {len(violations)} violation(s)")
+    report, _ = run_check(paths=[root], repo_root=os.path.dirname(root),
+                          rule_ids=["silent-except"], use_baseline=False)
+    bad = [f for f in report.errors if f.rule == "syntax-error"] + \
+        report.new
+    for f in bad:
+        print(f"{f.path}:{f.line}: {f.message}")
+    if bad:
+        print(f"\nlint_excepts: {len(bad)} violation(s)")
         return 1
     print("lint_excepts: clean")
     return 0

@@ -1,144 +1,36 @@
 #!/usr/bin/env python
-"""Repo lint: monitor metric hygiene in paddle_tpu/ (ISSUE 5 satellite).
+"""DEPRECATED shim — this lint is re-homed as the ``metric-hygiene``
+rule of the unified analyzer (``python -m tools.ptpu_check``; see README
+"Static analysis").
 
-A metrics layer rots in two ways: names drift off the `subsystem/metric`
-convention (so dashboards can't group by subsystem and the Prometheus
-mapping collides), and labels grow unbounded cardinality (every request
-id as a label value = one time series per request = an OOM'd scrape
-target).  This lint pins both at the AST level:
-
-1. every ``monitor.counter/gauge/histogram("name", ...)`` call site must
-   pass a LITERAL name matching ``subsystem/metric_name``
-   (``^[a-z][a-z0-9_]*(/[a-z][a-z0-9_]*)+$``).  Dynamic names hide from
-   grep and from this lint — a genuinely-parameterized registration
-   helper documents itself with a ``metric-ok:`` comment on (or right
-   above) the line;
-2. every ``.labels(...)`` call must use explicit keywords (no
-   positional args, no ``**kwargs`` expansion — static bound), at most
-   ``MAX_LABELS`` of them, each key matching ``^[a-z][a-z0-9_]*$``.
-   The keyword bound keeps the *dimensions* finite; value cardinality
-   is a review concern the explicit-keyword rule makes reviewable.
-
-Scope: paddle_tpu/, excluding monitor/__init__.py (the registry itself —
-its counter()/gauge()/histogram() signatures take the caller's name).
-
-Usage: python tools/lint_metrics.py [root]     (default: paddle_tpu/)
-Exit code 0 = clean, 1 = violations (printed one per line).
+Kept so the historical CLI keeps working: ``python tools/lint_metrics.py
+[root]`` (default: paddle_tpu/), exit 0 = clean / 1 = violations, one
+``path:line: message`` per violation.  Both the legacy ``metric-ok:``
+marker and the unified ``ptpu-check[metric-hygiene]:`` marker suppress.
 """
 from __future__ import annotations
 
-import ast
 import os
-import re
 import sys
 
-MARKER = "metric-ok:"
-NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(/[a-z][a-z0-9_]*)+$")
-LABEL_RE = re.compile(r"^[a-z][a-z0-9_]*$")
-MAX_LABELS = 3
-METRIC_METHODS = ("counter", "gauge", "histogram")
-REGISTRY_NAMES = ("monitor", "m", "_monitor")
-SKIP_FILES = (os.path.join("monitor", "__init__.py"),)
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))   # repo root
 
-
-def _is_metric_call(node: ast.Call) -> bool:
-    f = node.func
-    if not isinstance(f, ast.Attribute) or f.attr not in METRIC_METHODS:
-        return False
-    v = f.value
-    if isinstance(v, ast.Name) and v.id in REGISTRY_NAMES:
-        return True
-    if isinstance(v, ast.Attribute) and v.attr == "monitor":
-        return True
-    return False
-
-
-def _marked(lines, node) -> bool:
-    """metric-ok: on the node's first line or the line above it."""
-    i = node.lineno - 1
-    window = lines[max(0, i - 1):i + 1]
-    return any(MARKER in ln for ln in window)
-
-
-def check_file(path: str):
-    with open(path, encoding="utf-8") as f:
-        src = f.read()
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [(path, e.lineno or 0, f"syntax error: {e.msg}")]
-    lines = src.splitlines()
-    out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        f = node.func
-        if _is_metric_call(node):
-            if not node.args:
-                out.append((path, node.lineno,
-                            f"{f.attr}() without a metric name"))
-            else:
-                arg = node.args[0]
-                if isinstance(arg, ast.Constant) and isinstance(arg.value,
-                                                                str):
-                    if not NAME_RE.match(arg.value):
-                        out.append((
-                            path, node.lineno,
-                            f"metric name {arg.value!r} breaks the "
-                            "`subsystem/metric_name` convention "
-                            f"({NAME_RE.pattern})"))
-                elif not _marked(lines, node):
-                    out.append((
-                        path, node.lineno,
-                        f"dynamic metric name in {f.attr}() — pass a "
-                        "literal `subsystem/metric`, or document the "
-                        f"helper with `# {MARKER} ...`"))
-        elif isinstance(f, ast.Attribute) and f.attr == "labels":
-            if _marked(lines, node):
-                continue
-            if node.args:
-                out.append((path, node.lineno,
-                            ".labels() takes keywords only "
-                            "(labels(kind=...), not labels(value))"))
-            kws = node.keywords
-            if any(k.arg is None for k in kws):
-                out.append((path, node.lineno,
-                            ".labels(**dict) hides the label set — "
-                            "spell the keywords out, or document with "
-                            f"`# {MARKER} ...`"))
-            if len(kws) > MAX_LABELS:
-                out.append((path, node.lineno,
-                            f".labels() with {len(kws)} keys (> "
-                            f"{MAX_LABELS}): every key multiplies series "
-                            "cardinality"))
-            for k in kws:
-                if k.arg is not None and not LABEL_RE.match(k.arg):
-                    out.append((path, node.lineno,
-                                f"label key {k.arg!r} breaks "
-                                f"{LABEL_RE.pattern}"))
-    return out
+from tools.ptpu_check.api import run_check   # noqa: E402
 
 
 def main(argv):
-    root = argv[1] if len(argv) > 1 else os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "..", "paddle_tpu")
+    root = argv[1] if len(argv) > 1 else os.path.join(_HERE, "..",
+                                                      "paddle_tpu")
     root = os.path.abspath(root)
-    violations = []
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for name in sorted(filenames):
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, name)
-            rel = os.path.relpath(path, root)
-            if rel in SKIP_FILES:
-                continue
-            violations.extend(check_file(path))
-    for path, lineno, msg in violations:
-        rel = os.path.relpath(path, os.path.dirname(root))
-        print(f"{rel}:{lineno}: {msg}")
-    if violations:
-        print(f"\nlint_metrics: {len(violations)} violation(s)")
+    report, _ = run_check(paths=[root], repo_root=os.path.dirname(root),
+                          rule_ids=["metric-hygiene"], use_baseline=False)
+    bad = [f for f in report.errors if f.rule == "syntax-error"] + \
+        report.new
+    for f in bad:
+        print(f"{f.path}:{f.line}: {f.message}")
+    if bad:
+        print(f"\nlint_metrics: {len(bad)} violation(s)")
         return 1
     print("lint_metrics: clean")
     return 0

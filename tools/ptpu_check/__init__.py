@@ -1,0 +1,19 @@
+"""ptpu-check — the repo's unified whole-program static analyzer.
+
+Every rule in here mechanizes a bug class a review round actually fixed
+by hand (see CHANGES.md / README "Static analysis").  One shared
+``ast.parse`` per file, a cross-file call graph for reachability-based
+rules, per-rule inline suppressions, and a checked-in baseline for
+audited pre-existing sites.
+
+CLI::
+
+    python -m tools.ptpu_check [--json] [--json-out FILE] [paths...]
+
+Library::
+
+    from tools.ptpu_check.api import run_check
+"""
+from __future__ import annotations
+
+__version__ = "1.0"

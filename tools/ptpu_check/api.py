@@ -1,0 +1,113 @@
+"""Library entry point: run the analyzer over paths, partition against
+the baseline, and report — the CLI and the test suite both drive this.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+from . import rules as rules_pkg
+from .core import Baseline, Finding, Project, collect_files, load_context
+
+REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+DEFAULT_PATHS = ("paddle_tpu", "tools", "scripts")
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+
+
+class Report:
+    def __init__(self, new, baselined, errors, rules, paths, elapsed_s):
+        self.new = new                 # unsuppressed, non-baselined
+        self.baselined = baselined
+        self.errors = errors           # syntax errors etc.
+        self.rules = rules
+        self.paths = paths
+        self.elapsed_s = elapsed_s
+
+    @property
+    def clean(self):
+        return not self.new and not self.errors
+
+    def as_json(self) -> dict:
+        return {
+            "version": 1,
+            "tool": "ptpu_check",
+            "rules": [r.id for r in self.rules],
+            "paths": list(self.paths),
+            "counts": {"findings": len(self.new),
+                       "baselined": len(self.baselined),
+                       "errors": len(self.errors)},
+            "findings": [f.as_json() for f in self.new],
+            "baselined": [f.as_json() for f in self.baselined],
+            "errors": [f.as_json() for f in self.errors],
+        }
+
+
+def run_check(paths=None, repo_root=None, rule_ids=None,
+              baseline_path=DEFAULT_BASELINE, use_baseline=True):
+    """Analyze `paths` (default: paddle_tpu/ tools/ scripts/) and return
+    a Report.  One parse per file; rules share the parse and the lazily
+    built call graph."""
+    t0 = time.perf_counter()
+    repo_root = os.path.abspath(repo_root or REPO_ROOT)
+    if not paths:
+        paths = [os.path.join(repo_root, p) for p in DEFAULT_PATHS
+                 if os.path.isdir(os.path.join(repo_root, p))]
+    rule_classes = rules_pkg.ALL_RULES
+    if rule_ids:
+        unknown = set(rule_ids) - set(rules_pkg.RULES_BY_ID)
+        if unknown:
+            raise ValueError(
+                f"unknown rule id(s) {sorted(unknown)}; known: "
+                f"{sorted(rules_pkg.RULES_BY_ID)}")
+        rule_classes = [rules_pkg.RULES_BY_ID[r] for r in rule_ids]
+
+    contexts, errors = [], []
+    for fp, rel in collect_files(paths, repo_root):
+        ctx = load_context(fp, rel)
+        contexts.append(ctx)
+        if ctx.syntax_error is not None:
+            e = ctx.syntax_error
+            errors.append(Finding("syntax-error", ctx.rel, e.lineno or 0,
+                                  0, f"syntax error: {e.msg}"))
+    project = Project(contexts)
+
+    findings = []
+    for ctx in project.contexts:
+        if ctx.tree is None:
+            continue
+        for line in ctx.bare_markers():
+            errors.append(Finding(
+                "marker-hygiene", ctx.rel, line, 0,
+                "`# ptpu-check[...]` marker without a justification — "
+                "every suppression documents WHY"))
+        known = set(rules_pkg.RULES_BY_ID)
+        for line, ids in sorted(ctx.markers.items()):
+            bad = ids - known
+            if bad:
+                errors.append(Finding(
+                    "marker-hygiene", ctx.rel, line, 0,
+                    f"marker names unknown rule(s) {sorted(bad)}; known: "
+                    f"{sorted(known)}"))
+        for rule_cls in rule_classes:
+            findings.extend(rule_cls().check(ctx, project))
+
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    errors.sort(key=lambda f: (f.path, f.line, f.rule))
+    if use_baseline:
+        baseline = Baseline.load(baseline_path)
+        new, old = baseline.partition(findings, project.by_rel)
+    else:
+        new, old = findings, []
+    return Report(new, old, errors, rule_classes, paths,
+                  time.perf_counter() - t0), project
+
+
+def write_baseline(report, project, baseline_path=DEFAULT_BASELINE):
+    """Absorb every CURRENT finding (new + already-baselined) into the
+    baseline file — the audit workflow after reviewing them."""
+    bl = Baseline.from_findings(report.new + report.baselined,
+                                project.by_rel)
+    bl.save(baseline_path)
+    return bl

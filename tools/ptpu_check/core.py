@@ -1,0 +1,254 @@
+"""Framework core: file contexts (one parse per file), findings,
+suppression markers, the rule registry, and the baseline workflow.
+
+Suppression marker (unified scheme)::
+
+    # ptpu-check[<rule-id>]: <justification — required, non-empty>
+    # ptpu-check[<rule-a>,<rule-b>]: <one justification for both>
+
+placed on the flagged line or the line directly above it (for
+``silent-except`` the whole handler extent counts, matching the old
+``lint_excepts`` contract).  Legacy markers stay honored so old
+branches/backports don't break: the legacy ``justified:`` comment tag
+suppresses ``silent-except`` and ``metric-ok:`` suppresses
+``metric-hygiene`` with their original placement rules.
+
+Baseline: ``tools/ptpu_check/baseline.json`` holds audited pre-existing
+findings keyed by (rule, path, stripped source line text) with a count —
+stable across unrelated line moves.  ``--write-baseline`` regenerates
+it; a baselined site that gets FIXED simply stops matching (stale
+entries are harmless and pruned on the next ``--write-baseline``).
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+MARKER_RE = re.compile(r"#\s*ptpu-check\[([a-z0-9_,\- ]+)\]:\s*(\S.*)?")
+LEGACY_JUSTIFIED = "justified:"
+LEGACY_METRIC_OK = "metric-ok:"
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # repo-relative, '/'-separated
+    line: int
+    col: int
+    message: str
+
+    def fingerprint(self, ctx: "FileContext") -> tuple:
+        """(rule, path, stripped-line-text): survives line renumbering."""
+        text = ""
+        if 1 <= self.line <= len(ctx.lines):
+            text = ctx.lines[self.line - 1].strip()
+        return (self.rule, self.path, text)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def as_json(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message}
+
+
+class FileContext:
+    """One file, parsed once and shared by every rule."""
+
+    def __init__(self, path: str, rel: str, src: str):
+        self.path = path
+        self.rel = rel.replace(os.sep, "/")
+        self.src = src
+        self.lines = src.splitlines()
+        self.tree = None
+        self.syntax_error = None
+        try:
+            self.tree = ast.parse(src, filename=path)
+        except SyntaxError as e:
+            self.syntax_error = e
+        self._markers = None   # line(1-based) -> set of rule ids
+
+    # -- suppression -------------------------------------------------------
+
+    @property
+    def markers(self) -> dict:
+        if self._markers is None:
+            self._markers = {}
+            for i, ln in enumerate(self.lines, start=1):
+                m = MARKER_RE.search(ln)
+                if m:
+                    rules = {r.strip() for r in m.group(1).split(",")
+                             if r.strip()}
+                    if m.group(2):   # justification present
+                        self._markers[i] = rules
+                if LEGACY_JUSTIFIED in ln:
+                    self._markers.setdefault(i, set()).add("silent-except")
+                if LEGACY_METRIC_OK in ln:
+                    self._markers.setdefault(i, set()).add("metric-hygiene")
+        return self._markers
+
+    def bare_markers(self):
+        """Lines carrying a ptpu-check[...] marker WITHOUT justification
+        text — surfaced as findings so suppressions can't be silent."""
+        out = []
+        for i, ln in enumerate(self.lines, start=1):
+            m = MARKER_RE.search(ln)
+            if m and not m.group(2):
+                out.append(i)
+        return out
+
+    def suppressed(self, rule: str, line: int, extent_end: int = None) -> bool:
+        """Marker for `rule` on the flagged line, in the contiguous
+        comment block directly above it (multi-line justifications are
+        encouraged), on the single code line above (trailing marker), or
+        — when extent_end is given, e.g. an except handler — anywhere in
+        [line, extent_end]."""
+        last = extent_end if extent_end is not None else line
+        for i in range(line, last + 1):
+            if rule in self.markers.get(i, ()):
+                return True
+        i = line - 1
+        while i >= 1:
+            if rule in self.markers.get(i, ()):
+                return True
+            if not self.lines[i - 1].lstrip().startswith("#"):
+                break   # non-comment line above: checked, ends the walk
+            i -= 1
+        return False
+
+    def node_extent(self, node) -> int:
+        last = getattr(node, "lineno", 1)
+        for n in ast.walk(node):
+            end = getattr(n, "end_lineno", None)
+            if end is not None:
+                last = max(last, end)
+        return last
+
+
+class Rule:
+    """Subclass and register.  `check(ctx, project)` yields Findings for
+    one file; cross-file state comes from `project` (e.g. the call
+    graph), which is shared and built lazily."""
+
+    id: str = ""
+    doc: str = ""          # one-liner for --list-rules / README parity
+    descends_from: str = ""  # the historical bug this rule mechanizes
+
+    def check(self, ctx: FileContext, project: "Project"):
+        raise NotImplementedError
+
+    def finding(self, ctx: FileContext, node, message: str) -> Finding:
+        return Finding(self.id, ctx.rel, getattr(node, "lineno", 0),
+                       getattr(node, "col_offset", 0), message)
+
+
+class Project:
+    """All files under analysis + lazily-built cross-file artifacts."""
+
+    def __init__(self, contexts):
+        self.contexts = list(contexts)
+        self.by_rel = {c.rel: c for c in self.contexts}
+        self._callgraph = None
+
+    @property
+    def callgraph(self):
+        if self._callgraph is None:
+            from . import callgraph
+            self._callgraph = callgraph.CallGraph(self.contexts)
+        return self._callgraph
+
+
+# -- collection -------------------------------------------------------------
+
+SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "build", "dist"}
+
+
+def collect_files(paths, repo_root):
+    """Yield (abspath, relpath) for every .py under `paths` (files or
+    dirs), sorted for deterministic output."""
+    seen = set()
+    out = []
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p):
+            if p.endswith(".py") and p not in seen:
+                seen.add(p)
+                out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames if d not in SKIP_DIRS)
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    fp = os.path.join(dirpath, name)
+                    if fp not in seen:
+                        seen.add(fp)
+                        out.append(fp)
+    out.sort()
+    for fp in out:
+        rel = os.path.relpath(fp, repo_root)
+        yield fp, rel
+
+
+def load_context(path, rel):
+    with tokenize.open(path) as f:   # honors coding cookies
+        src = f.read()
+    return FileContext(path, rel, src)
+
+
+# -- baseline ---------------------------------------------------------------
+
+@dataclass
+class Baseline:
+    entries: dict = field(default_factory=dict)  # fingerprint -> count
+
+    @classmethod
+    def load(cls, path):
+        if not path or not os.path.exists(path):
+            return cls()
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        entries = {}
+        for e in doc.get("entries", []):
+            key = (e["rule"], e["path"], e["code"])
+            entries[key] = entries.get(key, 0) + int(e.get("count", 1))
+        return cls(entries)
+
+    @classmethod
+    def from_findings(cls, findings, contexts_by_rel):
+        entries = {}
+        for f in findings:
+            ctx = contexts_by_rel[f.path]
+            key = f.fingerprint(ctx)
+            entries[key] = entries.get(key, 0) + 1
+        return cls(entries)
+
+    def save(self, path):
+        rows = [{"rule": r, "path": p, "code": c, "count": n}
+                for (r, p, c), n in sorted(self.entries.items())]
+        doc = {"version": 1,
+               "comment": ("Audited pre-existing findings; regenerate with "
+                           "`python -m tools.ptpu_check --write-baseline`. "
+                           "New code must be clean or carry an inline "
+                           "`# ptpu-check[<rule>]: why` marker."),
+               "entries": rows}
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1, sort_keys=False)
+            f.write("\n")
+
+    def partition(self, findings, contexts_by_rel):
+        """Split findings into (new, baselined).  Each baseline entry
+        absorbs at most `count` findings with its fingerprint."""
+        budget = dict(self.entries)
+        new, old = [], []
+        for f in findings:
+            key = f.fingerprint(contexts_by_rel[f.path])
+            if budget.get(key, 0) > 0:
+                budget[key] -= 1
+                old.append(f)
+            else:
+                new.append(f)
+        return new, old

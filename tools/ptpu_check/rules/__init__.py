@@ -1,0 +1,18 @@
+"""Rule registry.  Each module registers one rule class; ALL_RULES is
+the ordered public list (order = report order, ids are stable API)."""
+from __future__ import annotations
+
+from . import (determinism, donation, excepts, host_sync, locks, metrics,
+               wallclock)
+
+ALL_RULES = [
+    excepts.SilentExceptRule,
+    metrics.MetricHygieneRule,
+    host_sync.HostSyncRule,
+    donation.DonationRule,
+    locks.LockDisciplineRule,
+    determinism.DeterminismRule,
+    wallclock.WallClockRule,
+]
+
+RULES_BY_ID = {r.id: r for r in ALL_RULES}

@@ -1,0 +1,154 @@
+"""determinism — hash-order iteration and global-RNG draws in library
+code.
+
+The bug class: PR 2's gradient-merge parity flake was PYTHONHASHSEED-
+dependent state-threading corruption — ``_select_tree`` merged slot
+dicts via ``set(a) | set(b)`` and iterated the union, so the compiled
+program's state order changed per process; and PR 3's retry jitter
+originally drew from the GLOBAL ``random`` stream, shifting every
+seeded ``reader.shuffle`` sequence that ran after a retry.
+
+Flagged:
+
+- iteration over a set-typed expression (``set(...)`` calls, set
+  literals/comprehensions, ``|``/``&``/``-``/``^`` unions of them,
+  ``.union(...)`` etc.) in a ``for``, a comprehension, or a
+  ``list()/tuple()/enumerate()/iter()/join()`` call — UNLESS wrapped in
+  ``sorted(...)``.  Local names bound to a set expression and then
+  iterated are tracked within the function;
+- calls on the process-global RNG streams — ``random.<draw>()`` /
+  ``np.random.<draw>()`` — in ``paddle_tpu/`` library code (instance
+  RNGs ``random.Random(seed)`` / ``np.random.default_rng`` /
+  ``RandomState`` are the fix and are not flagged).
+
+Suppress with ``# ptpu-check[determinism]: why`` (e.g. order provably
+does not reach program/signature construction, or global-stream
+semantics are the documented paddle-compat contract).
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule
+
+ITER_CALLS = {"list", "tuple", "enumerate", "iter", "next", "reversed"}
+SET_METHODS = {"union", "intersection", "difference",
+               "symmetric_difference"}
+RNG_SAFE = {"Random", "SystemRandom", "getstate", "setstate",
+            "default_rng", "RandomState", "Generator", "get_state",
+            "set_state", "seed"}
+SET_OPS = (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)
+
+
+def _is_set_expr(node, set_names) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in ("set", "frozenset"):
+            return True
+        if isinstance(f, ast.Attribute) and f.attr in SET_METHODS:
+            return _is_set_expr(f.value, set_names)
+        return False
+    if isinstance(node, ast.BinOp) and isinstance(node.op, SET_OPS):
+        return (_is_set_expr(node.left, set_names)
+                or _is_set_expr(node.right, set_names))
+    if isinstance(node, ast.Name):
+        return node.id in set_names
+    return False
+
+
+class DeterminismRule(Rule):
+    id = "determinism"
+    doc = ("no iteration over unordered sets feeding downstream state, "
+           "no global-RNG draws in library code")
+    descends_from = ("PR-2: `set(a) | set(b)` iteration made jit state "
+                     "threading PYTHONHASHSEED-dependent (compiled-vs-"
+                     "eager gradient-merge corruption); PR-3: retry "
+                     "jitter on the global `random` stream shifted "
+                     "seeded reader.shuffle sequences")
+
+    def check(self, ctx, project):
+        idx = project.callgraph.index_of(ctx.rel)
+        rng_aliases = set()
+        nprng_bases = set()
+        if idx is not None:
+            rng_aliases = {n for n, mod in idx.mod_alias.items()
+                           if mod == "random"}
+            nprng_bases = {n for n, mod in idx.mod_alias.items()
+                           if mod == "numpy"}
+            nprng_bases |= {n for n, mod in idx.mod_alias.items()
+                            if mod == "numpy.random"}
+
+        # ---- set-order iteration (function-scoped name tracking) --------
+        def scan_scope(body, set_names):
+            for stmt in body:
+                yield from visit(stmt, set_names)
+
+        def visit(node, set_names):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from scan_scope(node.body, set())
+                return
+            if isinstance(node, ast.Assign) and \
+                    len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                if _is_set_expr(node.value, set_names):
+                    set_names.add(node.targets[0].id)
+                else:
+                    set_names.discard(node.targets[0].id)
+            if isinstance(node, ast.For):
+                yield from self._check_iter(ctx, node.iter, set_names)
+            elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                   ast.DictComp, ast.GeneratorExp)):
+                for gen in node.generators:
+                    yield from self._check_iter(ctx, gen.iter, set_names)
+            elif isinstance(node, ast.Call):
+                f = node.func
+                name = f.id if isinstance(f, ast.Name) else (
+                    f.attr if isinstance(f, ast.Attribute) else None)
+                if name in ITER_CALLS or name == "join":
+                    for a in node.args[:1]:
+                        yield from self._check_iter(ctx, a, set_names)
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child, set_names)
+
+        yield from scan_scope(ctx.tree.body, set())
+
+        # ---- global-RNG draws in library code ---------------------------
+        if not ctx.rel.startswith("paddle_tpu/"):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or \
+                    not isinstance(node.func, ast.Attribute):
+                continue
+            f = node.func
+            if isinstance(f.value, ast.Name) and \
+                    f.value.id in rng_aliases and f.attr not in RNG_SAFE:
+                if not ctx.suppressed(self.id, node.lineno):
+                    yield self.finding(
+                        ctx, node,
+                        f"`{f.value.id}.{f.attr}()` draws from the "
+                        "process-global random stream — library code "
+                        "must use a private `random.Random(seed)` (the "
+                        "PR-3 retry-jitter bug shifted seeded "
+                        "reader.shuffle streams)")
+            elif isinstance(f.value, ast.Attribute) and \
+                    isinstance(f.value.value, ast.Name) and \
+                    f.value.value.id in nprng_bases and \
+                    f.value.attr == "random" and f.attr not in RNG_SAFE:
+                if not ctx.suppressed(self.id, node.lineno):
+                    yield self.finding(
+                        ctx, node,
+                        f"`np.random.{f.attr}()` draws from numpy's "
+                        "global RNG — use np.random.default_rng(seed) / "
+                        "a Generator owned by the caller")
+
+    def _check_iter(self, ctx, iter_expr, set_names):
+        if _is_set_expr(iter_expr, set_names):
+            if not ctx.suppressed(self.id, iter_expr.lineno):
+                yield self.finding(
+                    ctx, iter_expr,
+                    "iteration over an unordered set — order is "
+                    "PYTHONHASHSEED-dependent; `sorted(...)` it before "
+                    "it feeds state/program construction (the PR-2 "
+                    "`set(a) | set(b)` gradient-merge corruption)")

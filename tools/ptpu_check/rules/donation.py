@@ -1,0 +1,205 @@
+"""donation — reads of a buffer after it was donated to a jitted call.
+
+The bug class (PR 3): ``jax.jit(fn, donate_argnums=...)`` invalidates
+the caller's argument buffers — a later read of the same Python name
+sees a deleted/garbage array ("donated buffer" errors on TPU, silent
+stale data in some CPU paths).  StepGuard's pre-step snapshots had to
+COPY arrays for exactly this reason: the optimizer's donating jitted
+update invalidated reference-only snapshots.
+
+Local (per-function) dataflow, statements in source order:
+
+- a name bound to ``jax.jit(fn, donate_argnums=(...))`` (literal
+  positions) marks its donated call-arguments;
+- class methods decorated ``@partial(jax.jit, static_argnums=(0,),
+  donate_argnums=...)`` donate the corresponding caller positions of
+  ``self.method(...)`` calls (self-offset applied);
+- any later Load of a donated name in the same function flags; a Store
+  re-binding the name (the standard ``state = update(state, ...)``
+  shape) clears it.
+
+Suppress with ``# ptpu-check[donation]: why`` (e.g. the read is
+dead-code-eliminated under jit, or the call path copies first).
+"""
+from __future__ import annotations
+
+import ast
+
+from ..callgraph import dotted_name
+from ..core import Rule
+
+
+def _literal_positions(kw_value):
+    """donate_argnums=(1, 3) / [1] / 2 -> tuple of ints, else None."""
+    if isinstance(kw_value, ast.Constant) and isinstance(kw_value.value,
+                                                         int):
+        return (kw_value.value,)
+    if isinstance(kw_value, (ast.Tuple, ast.List)):
+        out = []
+        for e in kw_value.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def _donating_jit_call(node):
+    """Call expr `jax.jit(f, donate_argnums=...)` -> positions or None."""
+    if not isinstance(node, ast.Call):
+        return None
+    dn = dotted_name(node.func)
+    if dn is None or dn.rsplit(".", 1)[-1] not in ("jit", "pjit"):
+        return None
+    for kw in node.keywords:
+        if kw.arg == "donate_argnums":
+            return _literal_positions(kw.value)
+    return None
+
+
+def _method_donations(cls_node):
+    """{method name: donated positions (def-indexed, incl. self)} for
+    methods decorated with a donating jit/partial(jit, ...)."""
+    out = {}
+    for item in cls_node.body:
+        if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in item.decorator_list:
+            pos = _donating_jit_call(dec)
+            if pos is None and isinstance(dec, ast.Call):
+                # functools.partial(jax.jit, ..., donate_argnums=...)
+                dn = dotted_name(dec.func)
+                if dn and dn.rsplit(".", 1)[-1] == "partial" and dec.args:
+                    inner = dotted_name(dec.args[0])
+                    if inner and inner.rsplit(".", 1)[-1] in ("jit",
+                                                              "pjit"):
+                        for kw in dec.keywords:
+                            if kw.arg == "donate_argnums":
+                                pos = _literal_positions(kw.value)
+            if pos:
+                out[item.name] = pos
+    return out
+
+
+class _FuncScan:
+    """Source-order walk of ONE function body tracking donated names."""
+
+    def __init__(self, rule, ctx, method_donations):
+        self.rule = rule
+        self.ctx = ctx
+        self.method_donations = method_donations
+        self.jitted = {}     # local name -> donated positions
+        self.donated = {}    # name -> line it was donated at
+        self.findings = []
+
+    def run(self, func_node):
+        for stmt in func_node.body:
+            self.visit(stmt)
+        return self.findings
+
+    def visit(self, node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return   # nested defs are their own scope
+        if isinstance(node, ast.Assign):
+            self.visit(node.value)
+            pos = _donating_jit_call(node.value)
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    if pos:
+                        self.jitted[t.id] = pos
+                    else:
+                        self.jitted.pop(t.id, None)
+                    self.donated.pop(t.id, None)
+                else:
+                    self.visit(t)
+            return
+        if isinstance(node, ast.AugAssign):
+            if isinstance(node.target, ast.Name):
+                self._load(node.target)
+            self.visit(node.value)
+            if isinstance(node.target, ast.Name):
+                self.donated.pop(node.target.id, None)
+            return
+        if isinstance(node, ast.Call):
+            self.visit(node.func)
+            positions = self._call_donates(node)
+            for a in node.args:
+                self.visit(a)
+            for k in node.keywords:
+                self.visit(k.value)
+            if positions:
+                for p in positions:
+                    if 0 <= p < len(node.args) and \
+                            isinstance(node.args[p], ast.Name):
+                        self.donated[node.args[p].id] = node.lineno
+            return
+        if isinstance(node, ast.Name):
+            if isinstance(node.ctx, ast.Load):
+                self._load(node)
+            else:
+                self.donated.pop(node.id, None)
+                self.jitted.pop(node.id, None)
+            return
+        for child in ast.iter_child_nodes(node):
+            self.visit(child)
+
+    def _call_donates(self, node):
+        """Donated CALL-ARG indices for this call, or None."""
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in self.jitted:
+            return self.jitted[f.id]
+        direct = _donating_jit_call(f)   # jax.jit(g, donate...)(args)
+        if direct:
+            return direct
+        if isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Name) and f.value.id == "self" \
+                and f.attr in self.method_donations:
+            # def-indexed positions include self at 0 -> call index - 1
+            return tuple(p - 1 for p in self.method_donations[f.attr]
+                         if p >= 1)
+        return None
+
+    def _load(self, name_node):
+        line = self.donated.pop(name_node.id, None)
+        if line is not None and not self.ctx.suppressed(
+                self.rule.id, name_node.lineno):
+            self.findings.append(self.rule.finding(
+                self.ctx, name_node,
+                f"`{name_node.id}` is read after being donated to the "
+                f"jitted call on line {line} — the buffer is invalidated"
+                " by donation; copy before donating or re-bind the "
+                "result (the PR-3 snapshot bug)"))
+
+
+class DonationRule(Rule):
+    id = "donation"
+    doc = "no reads of a name after it was passed to a donating jit call"
+    descends_from = ("PR-3: StepGuard snapshots held references the "
+                     "optimizer's donate_argnums update invalidated — "
+                     "restore restored garbage until snapshots copied")
+
+    def check(self, ctx, project):
+        # class-level inventory of donating methods (per enclosing class)
+        class_methods = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                class_methods[node] = _method_donations(node)
+
+        def scan(owner_cls, func_node):
+            md = class_methods.get(owner_cls, {})
+            yield from _FuncScan(self, ctx, md).run(func_node)
+
+        def visit(node, owner_cls):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    yield from visit(child, child)
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    yield from scan(owner_cls, child)
+                    yield from visit(child, None)
+                else:
+                    yield from visit(child, owner_cls)
+
+        yield from visit(ctx.tree, None)

@@ -1,0 +1,71 @@
+"""silent-except — no silently-swallowed failures.
+
+Re-homed from ``tools/lint_excepts.py`` (PR 3): a resilience runtime is
+only trustworthy if failures can't vanish.  Rejects (1) bare
+``except:`` anywhere — it catches SystemExit/KeyboardInterrupt and
+would eat the preemption handler's exit — and (2) ``except Exception:``
+/ ``except BaseException:`` whose body is only ``pass``/``...``.
+
+Suppress with ``ptpu-check[silent-except]: why`` (or the legacy
+``justified:`` comment tag) anywhere in the handler's extent.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule
+
+BROAD = ("Exception", "BaseException")
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Name) and t.id in BROAD:
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name) and e.id in BROAD
+                   for e in t.elts)
+    return False
+
+
+def _swallows(handler: ast.ExceptHandler) -> bool:
+    """Body is only pass/... — the exception dies with no trace."""
+    for stmt in handler.body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value,
+                                                     ast.Constant):
+            continue   # docstring or `...`
+        return False
+    return True
+
+
+class SilentExceptRule(Rule):
+    id = "silent-except"
+    doc = ("bare `except:` and `except Exception: pass` swallows must "
+           "carry a justification")
+    descends_from = ("PR-3 resilience audit: 14 undocumented swallows, "
+                     "incl. ones that would have eaten the preemption "
+                     "handler's SystemExit")
+
+    def check(self, ctx, project):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            extent = ctx.node_extent(node)
+            if ctx.suppressed(self.id, node.lineno, extent_end=extent):
+                continue
+            if node.type is None:
+                yield self.finding(
+                    ctx, node,
+                    "bare `except:` (catches SystemExit/KeyboardInterrupt)"
+                    " — name the exceptions, or document with "
+                    "`# ptpu-check[silent-except]: ...`")
+            elif _is_broad(node) and _swallows(node):
+                yield self.finding(
+                    ctx, node,
+                    "`except Exception: pass` silently swallows failures "
+                    "— narrow the types, handle it, or document with "
+                    "`# ptpu-check[silent-except]: ...`")

@@ -1,0 +1,165 @@
+"""host-sync — host syncs hiding in traced/hot code.
+
+The bug class: inside a function that XLA traces (reachable from a
+``jax.jit``/``pjit``/``lax`` control-flow/``shard_map`` entry), calling
+``np.asarray``/``.item()``/``int()``/``float()``/``bool()`` on a traced
+value either errors at trace time or — worse — silently concretizes on
+every call, serializing the device stream (the PR-4 observer bug:
+``np.asarray`` round-tripped every calibration batch through the host
+and errored under jit; the serving engine's decode path had the same
+shape).
+
+Reachability comes from the cross-file call graph; each finding names
+the jit entry it is reachable from.  Flagged:
+
+- ``.item()`` / ``.tolist()`` / ``.numpy()`` / ``.block_until_ready()``
+  method calls;
+- ``np.asarray/np.array/...`` host materializations (``np`` = any alias
+  of ``numpy``);
+- ``jax.device_get(...)``;
+- ``int()/float()/bool()`` whose argument is a PARAMETER of the traced
+  function (parameters are exactly the traced values) and not an
+  obviously-static expression (``.shape``/``len()``/``.ndim``/dtypes);
+- ``if``/``while`` tests that CALL a ``jnp.*`` reduction — Python
+  branching on a traced value forces a device->host sync per step.
+
+Suppress with ``# ptpu-check[host-sync]: why`` — e.g. for functions
+that take the traced-entry path only under ``static_argnums`` configs.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..callgraph import dotted_name, iter_body_nodes
+from ..core import Rule
+
+HOST_METHODS = {"item", "tolist", "numpy", "block_until_ready"}
+NP_HOST_FNS = {"asarray", "array", "ascontiguousarray", "frombuffer",
+               "copyto", "save", "savez", "asnumpy"}
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "nbytes"}
+CASTS = {"int", "float", "bool", "complex"}
+# jnp helpers that act on dtypes/shapes — static at trace time, so
+# branching on them is fine
+STATIC_JNP_HELPERS = {"issubdtype", "result_type", "promote_types",
+                      "can_cast", "finfo", "iinfo", "dtype", "isdtype",
+                      "ndim", "isscalar"}
+
+
+def _looks_static(node) -> bool:
+    """Expressions whose value is known at trace time (shapes, dtypes,
+    literals) — casting THOSE is fine."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Attribute):
+        return node.attr in STATIC_ATTRS or _looks_static(node.value)
+    if isinstance(node, ast.Subscript):
+        return _looks_static(node.value)
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in {"len", "min", "max",
+                                                "abs", "round"} | CASTS:
+            return all(_looks_static(a) for a in node.args)
+        if isinstance(f, ast.Attribute) and f.attr in {"count", "index"}:
+            return True
+        return False
+    if isinstance(node, ast.BinOp):
+        return _looks_static(node.left) and _looks_static(node.right)
+    if isinstance(node, ast.UnaryOp):
+        return _looks_static(node.operand)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(_looks_static(e) for e in node.elts)
+    return False
+
+
+def _contains_param(node, params) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and n.id in params:
+            return True
+    return False
+
+
+class HostSyncRule(Rule):
+    id = "host-sync"
+    doc = ("no np.asarray/.item()/int()/jnp-branching on traced values "
+           "in functions reachable from jit entries")
+    descends_from = ("PR-4: AbsmaxObserver np.asarray host-synced every "
+                     "calibration batch and errored under jit; the "
+                     "serving engine's early decode path had int()-on-"
+                     "traced host syncs")
+
+    def check(self, ctx, project):
+        cg = project.callgraph
+        idx = cg.index_of(ctx.rel)
+        if idx is None:
+            return
+        jnp_aliases = {name for name, mod in idx.mod_alias.items()
+                       if mod == "jax.numpy"}
+        jnp_aliases |= {name for name, (mod, sym) in idx.sym_import.items()
+                        if (mod, sym) == ("jax", "numpy")}
+        np_aliases = {name for name, mod in idx.mod_alias.items()
+                      if mod == "numpy"}
+        for fi, origin in cg.traced_functions_in(ctx.rel):
+            params = {a.arg for a in (
+                fi.node.args.posonlyargs + fi.node.args.args
+                + fi.node.args.kwonlyargs)} - {"self", "cls"}
+            where = (f"`{fi.qualname}` is reachable from a trace entry "
+                     f"({origin})")
+            for n in iter_body_nodes(fi.node):
+                if isinstance(n, ast.Call):
+                    for found in self._check_call(ctx, n, params,
+                                                  np_aliases, where):
+                        yield found
+                elif isinstance(n, (ast.If, ast.While)):
+                    test = n.test
+                    for sub in ast.walk(test):
+                        if isinstance(sub, ast.Call):
+                            dn = dotted_name(sub.func)
+                            if dn and dn.split(".")[0] in jnp_aliases \
+                                    and dn.rsplit(".", 1)[-1] not in \
+                                    STATIC_JNP_HELPERS:
+                                if not ctx.suppressed(self.id, n.lineno):
+                                    yield self.finding(
+                                        ctx, n,
+                                        f"Python `{type(n).__name__.lower()}`"
+                                        f" branches on `{dn}(...)` — "
+                                        "concretizing a traced value forces "
+                                        "a device->host sync (or a trace "
+                                        f"error); {where}")
+                                break
+
+    def _check_call(self, ctx, n, params, np_aliases, where):
+        f = n.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in HOST_METHODS and not n.args:
+                if not ctx.suppressed(self.id, n.lineno):
+                    yield self.finding(
+                        ctx, n,
+                        f"`.{f.attr}()` in traced code materializes on "
+                        f"the host; {where}")
+                return
+            base = f.value
+            if isinstance(base, ast.Name) and base.id in np_aliases \
+                    and f.attr in NP_HOST_FNS:
+                if n.args and _looks_static(n.args[0]):
+                    return
+                if not ctx.suppressed(self.id, n.lineno):
+                    yield self.finding(
+                        ctx, n,
+                        f"`{base.id}.{f.attr}(...)` in traced code pulls "
+                        f"the value to the host; {where}")
+                return
+            dn = dotted_name(f)
+            if dn and dn.endswith("device_get"):
+                if not ctx.suppressed(self.id, n.lineno):
+                    yield self.finding(
+                        ctx, n,
+                        f"`{dn}(...)` in traced code; {where}")
+                return
+        elif isinstance(f, ast.Name) and f.id in CASTS:
+            if len(n.args) == 1 and not _looks_static(n.args[0]) \
+                    and _contains_param(n.args[0], params):
+                if not ctx.suppressed(self.id, n.lineno):
+                    yield self.finding(
+                        ctx, n,
+                        f"`{f.id}(...)` on a traced argument concretizes "
+                        f"it on the host; {where}")

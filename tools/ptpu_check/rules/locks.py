@@ -1,0 +1,229 @@
+"""lock-discipline — state written both under and outside its guard,
+and inconsistent two-lock acquisition order.
+
+The bug class, three times over: the TCPStore client reconnect mutated
+the shared socket outside the client lock (PR-3 review: concurrent
+heartbeat+get raced mutual socket teardown); ``perf._totals`` was
+incremented outside ``_rec_lock`` (PR-6 review: two perf-on threads
+lost updates and drifted the overall MFU gauge); the async-save
+completion event was set outside the condition guarding the pending
+count (PR-3 review: wait_until_finished returned with a save pending).
+
+Per class: inventory ``self.X = threading.Lock()/RLock()/Condition()``
+attributes; any ``self.Y`` attribute written somewhere under ``with
+self.X:`` and ALSO written with no lock held (outside ``__init__``)
+flags the unguarded write.  Per module: same for module-level locks
+guarding module globals.  Additionally, ``with A: with B:`` in one
+place and ``with B: with A:`` in another flags both (deadlock order).
+
+Suppress with ``# ptpu-check[lock-discipline]: why`` (e.g. the write
+happens before the object is published to other threads).
+"""
+from __future__ import annotations
+
+import ast
+
+from ..callgraph import dotted_name
+from ..core import Rule
+
+LOCK_TYPES = {"Lock", "RLock", "Condition", "Semaphore",
+              "BoundedSemaphore"}
+
+
+def _is_lock_ctor(node) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    dn = dotted_name(node.func)
+    return bool(dn) and dn.rsplit(".", 1)[-1] in LOCK_TYPES
+
+
+def _lock_id(expr):
+    """Stable id for a lock expression we track: `self.X` or a bare
+    module-level Name."""
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and expr.value.id == "self":
+        return f"self.{expr.attr}"
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+class _Write:
+    __slots__ = ("attr", "line", "locks", "method", "direct")
+
+    def __init__(self, attr, line, locks, method, direct):
+        self.attr = attr
+        self.line = line
+        self.locks = locks
+        self.method = method
+        self.direct = direct   # plain `name = ...` vs `name[k] = ...`
+
+
+def _scan_writes(func_node, lock_names, method_name, writes, pairs):
+    """Walk one function recording attribute/global writes with the set
+    of tracked locks held, plus nested lock-acquisition order pairs."""
+
+    def visit(node, held):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(node, ast.With):
+            new_held = held
+            for item in node.items:
+                lid = _lock_id(item.context_expr)
+                if lid is not None and lid in lock_names:
+                    for outer in new_held:
+                        pairs.append((outer, lid, node.lineno))
+                    new_held = new_held + (lid,)
+            for stmt in node.body:
+                visit(stmt, new_held)
+            return
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for t in targets:
+            _record_target(t, node, held)
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    def _record_target(t, node, held):
+        # self.Y = ... / self.Y[k] = ... / GLOBAL = ... / GLOBAL[k] = ...
+        direct = not isinstance(t, ast.Subscript)
+        base = t
+        while isinstance(base, ast.Subscript):
+            base = base.value
+        if isinstance(base, ast.Attribute) and \
+                isinstance(base.value, ast.Name) and \
+                base.value.id == "self":
+            writes.append(_Write(f"self.{base.attr}", node.lineno,
+                                 frozenset(held), method_name, direct))
+        elif isinstance(base, ast.Name):
+            writes.append(_Write(base.id, node.lineno, frozenset(held),
+                                 method_name, direct))
+
+    for stmt in func_node.body:
+        visit(stmt, ())
+    return writes
+
+
+class LockDisciplineRule(Rule):
+    id = "lock-discipline"
+    doc = ("attributes guarded by a lock somewhere must be guarded "
+           "everywhere; two-lock order must be consistent")
+    descends_from = ("PR-3/PR-6 reviews: store reconnect outside the "
+                     "client lock, perf._totals outside _rec_lock, the "
+                     "async-save event set outside its condition")
+
+    def check(self, ctx, project):
+        # ---- module-level locks guarding module globals -----------------
+        mod_locks, mod_globals = set(), set()
+        for node in ctx.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                name = node.targets[0].id
+                if _is_lock_ctor(node.value):
+                    mod_locks.add(name)
+                else:
+                    mod_globals.add(name)
+        mod_writes, pairs = [], []
+        top_funcs = [n for n in ast.walk(ctx.tree)
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))
+                     and n.col_offset == 0]
+        for fn in top_funcs:
+            writes = _scan_writes(fn, mod_locks, fn.name, [], pairs)
+            # a bare-name write is only a GLOBAL write when the function
+            # says `global name`; a `name[k] = ...` mutation counts when
+            # the function never binds `name` locally (no shadowing)
+            gdecl = {n for node in ast.walk(fn)
+                     if isinstance(node, ast.Global) for n in node.names}
+            local_binds = {w.attr for w in writes
+                           if w.direct and w.attr not in gdecl}
+            for w in writes:
+                if w.attr not in mod_globals:
+                    continue
+                if w.direct and w.attr not in gdecl:
+                    continue
+                if not w.direct and w.attr in local_binds:
+                    continue
+                mod_writes.append(w)
+        yield from self._flag_mixed(ctx, mod_writes, scope="module",
+                                    init_name=None)
+        yield from self._flag_order(ctx, pairs, scope=ctx.rel)
+
+        # ---- per-class locks guarding instance attributes ---------------
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            lock_attrs = set()
+            methods = [m for m in cls.body
+                       if isinstance(m, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]
+            for m in methods:
+                for n in ast.walk(m):
+                    if isinstance(n, ast.Assign) and \
+                            _is_lock_ctor(n.value):
+                        for t in n.targets:
+                            lid = _lock_id(t)
+                            if lid:
+                                lock_attrs.add(lid)
+            if not lock_attrs:
+                continue
+            writes, pairs = [], []
+            for m in methods:
+                _scan_writes(m, lock_attrs, m.name, writes, pairs)
+            attr_writes = [w for w in writes
+                           if w.attr.startswith("self.")
+                           and w.attr not in lock_attrs]
+            yield from self._flag_mixed(ctx, attr_writes, scope=cls.name,
+                                        init_name="__init__")
+            yield from self._flag_order(ctx, pairs,
+                                        scope=f"{ctx.rel}:{cls.name}")
+
+    def _flag_mixed(self, ctx, writes, scope, init_name):
+        by_attr = {}
+        for w in writes:
+            by_attr.setdefault(w.attr, []).append(w)
+        for attr, ws in sorted(by_attr.items()):
+            guards = {l for w in ws for l in w.locks}
+            if not guards:
+                continue
+            unguarded = [w for w in ws if not w.locks
+                         and w.method != init_name]
+            for w in sorted(unguarded, key=lambda w: w.line):
+                if ctx.suppressed(self.id, w.line):
+                    continue
+                yield self.finding(
+                    ctx, _At(w.line),
+                    f"`{attr}` is written under "
+                    f"`{'`/`'.join(sorted(guards))}` elsewhere but "
+                    f"written here (in `{w.method}`) with no lock held "
+                    "— racing writers lose updates (the perf._totals/"
+                    "store-reconnect class)")
+
+    def _flag_order(self, ctx, pairs, scope):
+        seen = {}
+        for outer, inner, line in pairs:
+            seen.setdefault((outer, inner), []).append(line)
+        for (a, b), lines in sorted(seen.items()):
+            if (b, a) in seen and a < b:
+                l1, l2 = lines[0], seen[(b, a)][0]
+                for line, first, second in ((l1, a, b), (l2, b, a)):
+                    if ctx.suppressed(self.id, line):
+                        continue
+                    yield self.finding(
+                        ctx, _At(line),
+                        f"`{first}` -> `{second}` here but the reverse "
+                        f"order is taken at line "
+                        f"{l2 if line == l1 else l1} — inconsistent "
+                        "two-lock order deadlocks under contention")
+
+
+class _At:
+    """Line-only anchor for findings not tied to one AST node."""
+
+    def __init__(self, line):
+        self.lineno = line
+        self.col_offset = 0

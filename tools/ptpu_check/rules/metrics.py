@@ -1,0 +1,100 @@
+"""metric-hygiene — monitor metric naming + label cardinality.
+
+Re-homed from ``tools/lint_metrics.py`` (PR 5).  Metric names must be
+LITERAL ``subsystem/metric_name`` strings (dynamic names hide from grep
+and from this lint); ``.labels()`` takes explicit keywords only, at
+most MAX_LABELS of them (every key multiplies series cardinality).
+
+Suppress with ``ptpu-check[metric-hygiene]: why`` (or the legacy
+``metric-ok:`` comment tag) on the line or the line above.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Rule
+
+NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(/[a-z][a-z0-9_]*)+$")
+LABEL_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+MAX_LABELS = 3
+METRIC_METHODS = ("counter", "gauge", "histogram")
+REGISTRY_NAMES = ("monitor", "m", "_monitor")
+SKIP_FILES = ("paddle_tpu/monitor/__init__.py",)   # the registry itself
+
+
+def _is_metric_call(node: ast.Call) -> bool:
+    f = node.func
+    if not isinstance(f, ast.Attribute) or f.attr not in METRIC_METHODS:
+        return False
+    v = f.value
+    if isinstance(v, ast.Name) and v.id in REGISTRY_NAMES:
+        return True
+    if isinstance(v, ast.Attribute) and v.attr == "monitor":
+        return True
+    return False
+
+
+class MetricHygieneRule(Rule):
+    id = "metric-hygiene"
+    doc = ("metric names are literal `subsystem/metric`; .labels() is "
+           "keyword-only and bounded")
+    descends_from = ("PR-5 audit: f-string metric names (ops/lowbit) and "
+                     "`.labels(**lab)` (pipeline) hid series from "
+                     "dashboards and unbounded their cardinality")
+
+    def check(self, ctx, project):
+        if ctx.rel in SKIP_FILES:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if _is_metric_call(node):
+                if ctx.suppressed(self.id, node.lineno):
+                    continue
+                if not node.args:
+                    yield self.finding(ctx, node,
+                                       f"{f.attr}() without a metric name")
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and isinstance(arg.value,
+                                                                str):
+                    if not NAME_RE.match(arg.value):
+                        yield self.finding(
+                            ctx, node,
+                            f"metric name {arg.value!r} breaks the "
+                            "`subsystem/metric_name` convention "
+                            f"({NAME_RE.pattern})")
+                else:
+                    yield self.finding(
+                        ctx, node,
+                        f"dynamic metric name in {f.attr}() — pass a "
+                        "literal `subsystem/metric`, or document the "
+                        "helper with `# ptpu-check[metric-hygiene]: ...`")
+            elif isinstance(f, ast.Attribute) and f.attr == "labels":
+                if ctx.suppressed(self.id, node.lineno):
+                    continue
+                if node.args:
+                    yield self.finding(
+                        ctx, node,
+                        ".labels() takes keywords only "
+                        "(labels(kind=...), not labels(value))")
+                kws = node.keywords
+                if any(k.arg is None for k in kws):
+                    yield self.finding(
+                        ctx, node,
+                        ".labels(**dict) hides the label set — spell the "
+                        "keywords out, or document with "
+                        "`# ptpu-check[metric-hygiene]: ...`")
+                if len(kws) > MAX_LABELS:
+                    yield self.finding(
+                        ctx, node,
+                        f".labels() with {len(kws)} keys (> {MAX_LABELS}):"
+                        " every key multiplies series cardinality")
+                for k in kws:
+                    if k.arg is not None and not LABEL_RE.match(k.arg):
+                        yield self.finding(
+                            ctx, node,
+                            f"label key {k.arg!r} breaks "
+                            f"{LABEL_RE.pattern}")

@@ -1,0 +1,122 @@
+"""wall-clock — ``time.time()`` arithmetic used for elapsed/deadline
+math.
+
+The bug class: wall clock steps under NTP slew, VM migration and
+suspend/resume — a duration computed as ``time.time() - t0`` can be
+negative or hours long, which turns watchdog/deadline/heartbeat logic
+into a false-trigger machine.  Durations and deadlines belong on
+``time.monotonic()`` / ``time.perf_counter()``; ``time.time()`` is
+ONLY for timestamps that get exported (logs, dump files, cross-process
+heartbeat values).
+
+Flagged: any ``+``/``-`` arithmetic where an operand is a direct
+``time.time()`` call, a local name bound to one, or a ``self.X``
+attribute bound to one anywhere in the same class.  Plain
+``{"ts": time.time()}`` exports are not flagged.
+
+Suppress with ``# ptpu-check[wall-clock]: why`` — the legitimate case
+is CROSS-PROCESS timestamp comparison (one process wrote the wall-clock
+value, another subtracts it; monotonic clocks don't travel between
+hosts).
+"""
+from __future__ import annotations
+
+import ast
+
+from ..callgraph import dotted_name
+from ..core import Rule
+
+
+def _is_walltime_call(node, time_aliases) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    dn = dotted_name(node.func)
+    if dn is None:
+        return False
+    parts = dn.split(".")
+    return len(parts) == 2 and parts[0] in time_aliases \
+        and parts[1] == "time"
+
+
+class WallClockRule(Rule):
+    id = "wall-clock"
+    doc = ("elapsed/deadline math uses monotonic()/perf_counter(), "
+           "never time.time() subtraction")
+    descends_from = ("9+ modules measured durations off the wall clock "
+                     "(store deadlines, elastic grace windows, hapi "
+                     "step timing); an NTP step would fire every one "
+                     "of them at once")
+
+    def check(self, ctx, project):
+        idx = project.callgraph.index_of(ctx.rel)
+        time_aliases = {"time"}
+        if idx is not None:
+            time_aliases = {n for n, mod in idx.mod_alias.items()
+                            if mod == "time"} or {"time"}
+
+        # class-level: self.X = time.time() anywhere in the class
+        class_attrs = {}   # ClassDef -> {attr names}
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            attrs = set()
+            for n in ast.walk(cls):
+                if isinstance(n, ast.Assign) and \
+                        _is_walltime_call(n.value, time_aliases):
+                    for t in n.targets:
+                        if isinstance(t, ast.Attribute) and \
+                                isinstance(t.value, ast.Name) and \
+                                t.value.id == "self":
+                            attrs.add(t.attr)
+            if attrs:
+                class_attrs[cls] = attrs
+
+        def scan_scope(body, names, self_attrs):
+            for stmt in body:
+                yield from visit(stmt, names, self_attrs)
+
+        def visit(node, names, self_attrs):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from scan_scope(node.body, set(), self_attrs)
+                return
+            if isinstance(node, ast.ClassDef):
+                yield from scan_scope(node.body, set(),
+                                      class_attrs.get(node, set()))
+                return
+            if isinstance(node, ast.Assign) and \
+                    len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                if _is_walltime_call(node.value, time_aliases):
+                    names.add(node.targets[0].id)
+                else:
+                    names.discard(node.targets[0].id)
+            if isinstance(node, ast.BinOp) and \
+                    isinstance(node.op, (ast.Add, ast.Sub)):
+                for side in (node.left, node.right):
+                    if self._is_wall(side, names, self_attrs,
+                                     time_aliases):
+                        if not ctx.suppressed(self.id, node.lineno):
+                            yield self.finding(
+                                ctx, node,
+                                "elapsed/deadline arithmetic on "
+                                "time.time() — the wall clock steps "
+                                "(NTP/suspend); use time.monotonic() or "
+                                "time.perf_counter(), keep time.time() "
+                                "only for exported timestamps")
+                        break
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child, names, self_attrs)
+
+        yield from scan_scope(ctx.tree.body, set(), set())
+
+    @staticmethod
+    def _is_wall(node, names, self_attrs, time_aliases):
+        if _is_walltime_call(node, time_aliases):
+            return True
+        if isinstance(node, ast.Name) and node.id in names:
+            return True
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self" and node.attr in self_attrs:
+            return True
+        return False

@@ -4,10 +4,13 @@
 # driver-shaped gates).
 #
 #   tools/run_ci.sh fast    — "not slow" tier on the virtual 8-device CPU mesh
-#                             (includes the resilience suite + repo lints)
+#                             (includes the resilience suite + ptpu_check)
 #   tools/run_ci.sh full    — everything incl. subprocess/example suites
-#   tools/run_ci.sh lint    — repo lints only (no-silent-swallow except
-#                             check + metric naming/label-cardinality check)
+#   tools/run_ci.sh lint    — unified static analyzer only (ptpu_check:
+#                             silent-except, metric-hygiene, host-sync,
+#                             donation, lock-discipline, determinism,
+#                             wall-clock over paddle_tpu/ tools/ scripts/;
+#                             JSON artifact at /tmp/ptpu_check_report.json)
 #   tools/run_ci.sh gates   — driver gates: compile-check entry() + the
 #                             8-device multichip dryrun + CPU bench smoke
 #   tools/run_ci.sh bench-check OLD.json NEW.json — perf regression gate
@@ -21,8 +24,9 @@ export XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}"
 
 case "${1:-fast}" in
   fast)
-    python tools/lint_excepts.py
-    python tools/lint_metrics.py
+    # unified static analyzer (was: lint_excepts + lint_metrics) — one
+    # shared parse per file, exits nonzero on any unsuppressed finding
+    python -m tools.ptpu_check --json-out /tmp/ptpu_check_report.json
     python -m pytest tests/ -m "not slow" -q --ignore=tests/test_examples.py
     # perf-history gate, CPU-smoke lane: the headline bench appends this
     # host's run to BENCH_HISTORY.jsonl, then gates against the trailing
@@ -38,13 +42,12 @@ case "${1:-fast}" in
       --gate-smoke --tolerance 0.50
     ;;
   full)
-    python tools/lint_excepts.py
-    python tools/lint_metrics.py
+    python -m tools.ptpu_check --json-out /tmp/ptpu_check_report.json
     python -m pytest tests/ -q
     ;;
   lint)
-    python tools/lint_excepts.py
-    python tools/lint_metrics.py
+    python -m tools.ptpu_check --json-out /tmp/ptpu_check_report.json
+    echo "ptpu_check: JSON artifact at /tmp/ptpu_check_report.json"
     ;;
   gates)
     python - <<'EOF'
