@@ -655,7 +655,9 @@ def bench_hybrid8_memfit():
 
 def bench_trace_overhead():
     """Observability tax gate (ISSUE 5, extended by ISSUE 6 to the perf
-    hooks): what the monitor+trace+perf layers add to a train step, off
+    hooks and ISSUE 11 to the cross-process trace-propagation hooks —
+    inject/extract and the rpc header attach share the disabled-path
+    budget): what the monitor+trace+perf layers add to a train step, off
     vs on, asserting disabled overhead < 1% and enabled overhead < 5% of
     the step.  "Enabled" means monitor+trace; PTPU_PERF stays off in both
     measurements — perf mode deliberately syncs every timed call (MFU
@@ -703,7 +705,12 @@ def bench_trace_overhead():
         # plus the ISSUE-6 perf hooks' gate reads: the jit dispatch
         # guard, the engine decode-segment guards, and the hapi train
         # path's three segment contexts (all dead branches with perf off)
+        # — plus the ISSUE-11 propagation hooks: the rpc client's header
+        # attach (inject) and the rpc server's header parse (extract),
+        # both one-global-read None paths when tracing is off
         with mtrace.span("bench/train_step", step=i):
+            hdr = mtrace.inject()           # rpc _call header attach
+            _ctx = mtrace.extract(hdr)      # rpc _handle header parse
             perf_on = mperf.enabled()
             if monitor.enabled() or mtrace.enabled() or perf_on:
                 sig = f"nstate=0;{pjit._arg_signature((a_args, {}))}"
@@ -720,7 +727,7 @@ def bench_trace_overhead():
                 pass
             with mperf.segment("bench", "optimizer"):
                 pass
-            del t0
+            del t0, _ctx
 
     def per_call(n):
         t0 = time.perf_counter()

@@ -22,6 +22,16 @@ def _spawn_target(func, args, rank, nprocs, backend):
     os.environ["PADDLE_TRAINER_ID"] = str(rank)
     os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
     os.environ["PADDLE_LOCAL_RANK"] = str(rank)
+    # fleet identity: /healthz + PTPU_FLEET_STORE registration label the
+    # replica by rank without out-of-band config (monitor.fleet).  Force,
+    # don't setdefault: an inherited PTPU_REPLICA_ID would give every
+    # rank the SAME name and discovery (newest-per-name) would collapse
+    # the fleet to one visible replica.  An inherited id becomes the
+    # PREFIX instead (launch sets r<host> per host; spawn under it
+    # yields r<host>.<rank> — unique across hosts, not just locally)
+    parent_rid = os.environ.get("PTPU_REPLICA_ID")
+    os.environ["PTPU_REPLICA_ID"] = \
+        f"{parent_rid}.{rank}" if parent_rid else f"r{rank}"
     if backend:
         # belt and braces with the parent-side env (set before p.start()):
         # paddle_tpu/jax are already imported by the unpickle of this
@@ -104,14 +114,31 @@ def launch(training_script, args=(), hosts=None, nproc_per_node=1, master=None):
     procs = []
     master = master or hosts[0]
     for i, h in enumerate(hosts):
-        env = dict(
-            os.environ,
-            PADDLE_TRAINER_ID=str(i),
-            PADDLE_TRAINERS_NUM=str(len(hosts)),
-            PADDLE_MASTER=master,
-        )
-        cmd = ["ssh", h, sys.executable, training_script, *args] if h != "localhost" else [sys.executable, training_script, *args]
-        procs.append(subprocess.Popen(cmd, env=env))
+        # per-host worker identity; PTPU_REPLICA_ID is forced per rank
+        # (an inherited id would name every host the same and fleet
+        # discovery keeps only the newest record per name), and
+        # PTPU_FLEET_STORE is forwarded when the launcher has one so
+        # every worker's monitor.start_server self-registers
+        worker_env = {
+            "PADDLE_TRAINER_ID": str(i),
+            "PADDLE_TRAINERS_NUM": str(len(hosts)),
+            "PADDLE_MASTER": master,
+            "PTPU_REPLICA_ID": f"r{i}",
+        }
+        if os.environ.get("PTPU_FLEET_STORE"):
+            worker_env["PTPU_FLEET_STORE"] = os.environ["PTPU_FLEET_STORE"]
+        if h != "localhost":
+            # Popen's env= only reaches the LOCAL ssh client — ssh does
+            # not forward arbitrary variables, so the worker env must
+            # ride the remote command line itself
+            cmd = ["ssh", h, "env",
+                   *[f"{k}={v}" for k, v in worker_env.items()],
+                   sys.executable, training_script, *args]
+            procs.append(subprocess.Popen(cmd))
+        else:
+            cmd = [sys.executable, training_script, *args]
+            procs.append(subprocess.Popen(
+                cmd, env=dict(os.environ, **worker_env)))
     rc = 0
     for p in procs:
         rc |= p.wait()

@@ -86,11 +86,24 @@ def _serve(server):
 
 
 def _handle(conn):
+    from ..monitor import trace as mtrace
+
     try:
         with conn:
-            fn, args, kwargs = pickle.loads(_recv_frame(conn))
+            msg = pickle.loads(_recv_frame(conn))
+            fn, args, kwargs = msg[:3]
+            # 4th element (when present): the caller's inject()-ed span
+            # context — run the callable under a child span so one
+            # trace_id spans both processes in export_chrome_trace()
+            ctx = mtrace.extract(msg[3]) if len(msg) > 3 else None
             try:
-                result = (True, fn(*args, **kwargs))
+                if ctx is not None:
+                    with mtrace.attach(ctx), mtrace.span(
+                            "rpc/serve",
+                            fn=getattr(fn, "__name__", repr(fn))):
+                        result = (True, fn(*args, **kwargs))
+                else:
+                    result = (True, fn(*args, **kwargs))
             except Exception as e:  # ship the failure back to the caller
                 result = (False, e)
             _send_frame(conn, pickle.dumps(result))
@@ -176,6 +189,7 @@ def rpc_async(to: str, fn, args=None, kwargs=None, timeout: float = 60.0):
 
 def _call(to, fn, args, kwargs, timeout):
     _check_init()
+    from ..monitor import trace as mtrace
     from ..resilience import faults as _faults
     from ..resilience.retry import Deadline, retry as _retry
 
@@ -198,12 +212,24 @@ def _call(to, fn, args, kwargs, timeout):
     # ConnectionError/ConnectionRefusedError/ConnectionResetError/
     # socket.timeout are all OSError subclasses; the deadline bounds total
     # time and a dial failure is always side-effect-free
-    with _retry(dial, retries=3, backoff=0.05, max_backoff=1.0,
-                deadline=deadline, site="rpc.dial",
-                retryable=(OSError,))() as s:
-        s.settimeout(timeout)
-        _send_frame(s, pickle.dumps((fn, args, kwargs)))
-        ok, payload = pickle.loads(_recv_frame(s))
+    with mtrace.span("rpc/call", to=to):
+        # the header parents the REMOTE rpc/serve span under this call
+        # span; with tracing off span() is the no-op singleton and
+        # inject() is one global read → None (trace_overhead-gated).
+        # No header → the LEGACY 3-tuple frame, so the DEFAULT
+        # (PTPU_TRACE off) path is wire-identical to older servers
+        # mid-deploy; a TRACED call sends the 4-tuple and therefore
+        # requires the receiving worker to run this version too —
+        # enable propagation only once the fleet is upgraded
+        hdr = mtrace.inject()
+        frame = (fn, args, kwargs) if hdr is None \
+            else (fn, args, kwargs, hdr)
+        with _retry(dial, retries=3, backoff=0.05, max_backoff=1.0,
+                    deadline=deadline, site="rpc.dial",
+                    retryable=(OSError,))() as s:
+            s.settimeout(timeout)
+            _send_frame(s, pickle.dumps(frame))
+            ok, payload = pickle.loads(_recv_frame(s))
     if not ok:
         raise payload
     return payload

@@ -345,6 +345,49 @@ class Histogram(_Metric):
         with self._lock:
             return self._buckets, list(self._counts), self._count, self._sum
 
+    def _merge_buckets(self, buckets, counts, count, sum_):
+        """Merge another histogram's raw bucket state into this one —
+        the fleet-federation path (counts parsed back from a replica's
+        exposition).  Bucket BOUNDS must match exactly: replicas run the
+        same code so they share bounds; a mismatch is a config bug and
+        raises rather than silently mis-binning.
+
+        min/max are reconstructed from the occupied bucket edges (the
+        exposition does not carry them), so percentiles recomputed from
+        a merged histogram interpolate inside edge-clamped buckets —
+        exact bucket/count/sum round-trip, approximate range clamp."""
+        buckets = tuple(buckets)
+        if buckets != self._buckets:
+            raise ValueError(
+                f"histogram {self.name!r}: merge with different bucket "
+                f"bounds ({len(buckets)} vs {len(self._buckets)} edges) "
+                "— replicas must share bucket bounds")
+        counts = list(counts)
+        if len(counts) != len(self._counts):
+            raise ValueError(
+                f"histogram {self.name!r}: merge with {len(counts)} "
+                f"bucket counts, expected {len(self._counts)}")
+        with self._lock:
+            occupied = [i for i, c in enumerate(counts) if c]
+            if occupied:
+                lo = buckets[occupied[0] - 1] if occupied[0] > 0 else 0.0
+                if occupied[-1] < len(buckets):
+                    hi = buckets[occupied[-1]]
+                else:   # overflow bucket: upper edge unknown — the mean
+                    # is the only bound the exposition still carries
+                    hi = max(buckets[-1], sum_ / max(count, 1))
+                if self._count == 0:
+                    self._min, self._max = lo, hi
+                else:
+                    self._min = min(self._min, lo)
+                    self._max = max(self._max, hi)
+            for i, c in enumerate(counts):
+                self._counts[i] += c
+            self._count += count
+            self._sum += sum_
+            self._touched = True
+        return self
+
     def _zero(self):
         self._counts = [0] * (len(self._buckets) + 1)
         self._count = 0
@@ -490,6 +533,69 @@ class StatRegistry:
             f.write(json.dumps(rec) + "\n")
         return rec
 
+    def merge_snapshot(self, parsed, labels=None) -> "StatRegistry":
+        """Merge a PARSED exposition (``fleet.parse_prometheus`` output:
+        {name: {"kind", "help", "series": {label_key: value}}}) into this
+        registry — the metrics-federation primitive:
+
+        - **counters sum**: each source series accumulates into the
+          series with its ORIGINAL labels, so merging N replicas leaves
+          the original series holding the fleet-wide total;
+        - **gauges keep per-source values**: only the ``labels``-tagged
+          copy is written (no meaningful way to sum a gauge);
+        - **histograms merge buckets**: per-bucket counts/count/sum add
+          into the original series (bounds must match), percentiles are
+          then recomputed from the merged buckets on read.
+
+        ``labels`` (e.g. ``{"replica": "r0"}``) additionally records
+        every source series under its original labels + these, so the
+        fleet exposition carries both the total and the per-replica
+        breakdown.  Mutations bypass the PTPU_MONITOR gate: this is
+        reconstruction of already-collected data, not hot-path
+        instrumentation."""
+        extra = dict(labels or {})
+
+        def _bump(metric, key, v):
+            tgt = metric if not key else metric.labels(**dict(key))
+            with tgt._lock:
+                tgt._value = tgt._value + v if metric.kind == "counter" \
+                    else v
+                tgt._touched = True
+
+        for name, pm in parsed.items():
+            kind = pm.get("kind", "gauge")
+            help_ = pm.get("help", "")
+            series = sorted(pm.get("series", {}).items())
+            if kind == "counter":
+                c = self.counter(name, help_)
+                for key, v in series:
+                    _bump(c, key, v)
+                    if extra:
+                        _bump(c, tuple(sorted(
+                            dict(key, **extra).items())), v)
+            elif kind == "histogram":
+                h = None
+                for key, hv in series:
+                    if h is None:
+                        h = self.histogram(name, help_,
+                                           buckets=hv["buckets"])
+                    tgt = h if not key else h.labels(**dict(key))
+                    tgt._merge_buckets(hv["buckets"], hv["counts"],
+                                       hv["count"], hv["sum"])
+                    if extra:
+                        h.labels(**dict(key, **extra))._merge_buckets(
+                            hv["buckets"], hv["counts"], hv["count"],
+                            hv["sum"])
+            else:   # gauge / untyped: per-source value only
+                g = self.gauge(name, help_)
+                for key, v in series:
+                    if extra:
+                        _bump(g, tuple(sorted(
+                            dict(key, **extra).items())), v)
+                    else:
+                        _bump(g, key, v)
+        return self
+
     def render(self) -> str:
         """Human-readable table of the snapshot (Profiler.summary section)."""
         snap = self.snapshot()
@@ -605,11 +711,11 @@ def STAT_RESET(name):
 # file_location, no package) to prove the core registry is jax-free; in
 # that mode the v2 submodules — equally stdlib-only — are simply absent.
 try:
-    from . import trace, flight, serve, perf      # noqa: E402,F401
+    from . import trace, flight, serve, perf, fleet  # noqa: E402,F401
     from .flight import watchdog                  # noqa: E402,F401
     from .serve import start_server, stop_server  # noqa: E402,F401
 
-    __all__ += ["trace", "flight", "serve", "perf", "watchdog",
+    __all__ += ["trace", "flight", "serve", "perf", "fleet", "watchdog",
                 "start_server", "stop_server"]
 except ImportError:   # standalone module load — core registry only
     pass

@@ -1,0 +1,845 @@
+"""Fleet observability plane — the multi-process half of the monitor
+story (ISSUE 11).  Monitor v1–v3 gave each *process* metrics, traces, a
+flight recorder and a live endpoint; this module federates N such
+processes into one view, the instrument panel the multi-replica serving
+tier (ROADMAP item 2) dispatches and fails over on:
+
+- :func:`parse_prometheus` — parse OUR OWN ``export_prometheus()``
+  exposition back into typed series, so ``StatRegistry.merge_snapshot``
+  can rebuild counters/gauges/histograms exactly (counters sum, gauges
+  keep per-replica values, histograms merge buckets — replicas run the
+  same code and therefore share bucket bounds);
+- :func:`register_replica` / :func:`discover` — endpoint discovery
+  through the native TCPStore: ``monitor.start_server()`` self-registers
+  under ``PTPU_FLEET_STORE=host:port`` (a minimal stdlib wire client —
+  this module must stay importable without jax, like the rest of
+  monitor), so ``launch``/elastic jobs are auto-discovered;
+- :class:`FleetAggregator` — scrapes every replica's ``/metrics`` +
+  ``/healthz`` on an interval, re-exports the merged registry with a
+  ``replica`` label on one fleet :class:`~.serve.MonitorServer`, rolls
+  replica health up to ``/fleet/healthz`` (healthy / stalled / down),
+  harvests a replica's newest flight dump (``/flight/latest``) the
+  moment it transitions to stalled or down — one directory of
+  post-mortems for a multi-process failure — and answers
+  :meth:`FleetAggregator.snapshot` with the per-replica structured
+  stats (queue depth, running/waiting, decode tokens/s, state) a
+  load-aware router consumes.
+
+Activation is opt-in end to end: replicas register only when
+``PTPU_FLEET_STORE`` is set, aggregation only runs inside an explicitly
+constructed FleetAggregator, and cross-process trace propagation rides
+the existing ``PTPU_TRACE`` gate — nothing here adds always-on cost.
+
+All elapsed-time math (scrape ages, stall thresholds, rate windows) is
+on ``time.monotonic()``; wall-clock appears only in exported harvest
+metadata.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import json
+import os
+import re
+import socket
+import struct
+import threading
+import time
+import urllib.request
+
+__all__ = [
+    "parse_prometheus", "register_replica", "discover", "FleetAggregator",
+    "REPLICA_KEY_PREFIX", "REPLICA_COUNT_KEY",
+]
+
+# -- discovery key layout ----------------------------------------------------
+# The TCPStore has no key listing, so registration is an append-only slot
+# log: ADD on the count key claims slot n, SET publishes the record at
+# fleet/replicas/<n>.  Readers ADD(0) the count and GET each slot; a
+# re-registered replica (restart) takes a new slot and the newest record
+# per name wins.
+REPLICA_COUNT_KEY = "fleet/replicas/next"
+REPLICA_KEY_PREFIX = "fleet/replicas/"
+
+ENV_STORE = "PTPU_FLEET_STORE"
+
+
+# ---------------------------------------------------------------------------
+# Minimal TCPStore wire client (stdlib-only).
+# ---------------------------------------------------------------------------
+# distributed/store.py's client would do, but importing it pulls the
+# paddle_tpu package (core.native, resilience) — this module, like the
+# rest of monitor, must stay importable headlessly.  The wire protocol is
+# the store's own (csrc/tcp_store.cc == _PyHandler): cmd byte, <I>-length
+# key, op payload.  Only SET/GET/ADD are needed here.
+class _StoreClient:
+    CMD_SET, CMD_GET, CMD_ADD = 0, 1, 2
+
+    def __init__(self, host: str, port: int, timeout_s: float = 10.0):
+        deadline = time.monotonic() + timeout_s
+        delay = 0.05
+        while True:
+            try:
+                self._sock = socket.create_connection((host, port),
+                                                      timeout=5)
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"cannot reach fleet store at {host}:{port} "
+                        f"within {timeout_s}s")
+                time.sleep(delay)
+                delay = min(delay * 2, 1.0)
+        # ops stay bounded too: a store that ACCEPTS but never answers
+        # (SIGSTOPped, black-holed) must not hang registration or the
+        # aggregator's poll thread forever — socket.timeout is an
+        # OSError, which every caller already contains
+        self._io_timeout = max(float(timeout_s), 5.0)
+        self._sock.settimeout(self._io_timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+
+    def _read(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("fleet store connection closed")
+            buf += chunk
+        return buf
+
+    def _req(self, cmd: int, key: str, payload: bytes = b""):
+        kb = key.encode()
+        self._sock.sendall(
+            bytes([cmd]) + struct.pack("<I", len(kb)) + kb + payload)
+
+    def set(self, key: str, value: bytes) -> None:
+        with self._lock:
+            self._req(self.CMD_SET, key,
+                      struct.pack("<I", len(value)) + value)
+            self._read(4)
+
+    def get(self, key: str, timeout_ms: int = 2000) -> "bytes | None":
+        """Value bytes, or None when the key doesn't appear within the
+        timeout (the store's WAIT-then-GET semantics)."""
+        with self._lock:
+            # the server legitimately holds the reply for up to
+            # timeout_ms while waiting on the key — the socket bound
+            # must sit ABOVE that, not race it (timeout_ms=0 means the
+            # server waits forever; keep the io bound as the backstop)
+            self._sock.settimeout(
+                self._io_timeout + (timeout_ms / 1e3 if timeout_ms
+                                    else self._io_timeout))
+            try:
+                self._req(self.CMD_GET, key,
+                          struct.pack("<I", timeout_ms))
+                (found,) = struct.unpack("<I", self._read(4))
+                if not found:
+                    return None
+                (n,) = struct.unpack("<I", self._read(4))
+                return self._read(n) if n else b""
+            finally:
+                self._sock.settimeout(self._io_timeout)
+
+    def add(self, key: str, amount: int = 1) -> int:
+        with self._lock:
+            self._req(self.CMD_ADD, key, struct.pack("<q", amount))
+            return struct.unpack("<q", self._read(8))[0]
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _split_addr(addr: str):
+    host, _, port = addr.rpartition(":")
+    if not host or not port.isdigit():
+        raise ValueError(
+            f"{ENV_STORE} must be host:port, got {addr!r}")
+    return host, int(port)
+
+
+def default_replica_name() -> str:
+    """PTPU_REPLICA_ID when set (the launch wiring exports it per spawned
+    rank), else host:pid — unique enough for one fleet."""
+    rid = os.environ.get("PTPU_REPLICA_ID")
+    if rid:
+        return rid
+    return f"{socket.gethostname()}:{os.getpid()}"
+
+
+def advertised_url(server) -> str:
+    """The URL a replica PUBLISHES for scraping.  A wildcard bind
+    (0.0.0.0/::) is unroutable as written — advertise the hostname
+    instead.  A loopback bind is advertised as-is: it is only reachable
+    by a colocated aggregator, which is the truth (the endpoint's
+    default 127.0.0.1 bind is a deliberate exposure decision; cross-host
+    fleets must start the server with ``host=`` wider — see README)."""
+    host = getattr(server, "host", None)
+    if host in ("0.0.0.0", "::"):   # a real bind always resolves "" to
+        # one of these, so the wildcard set is exactly two names
+        return f"http://{socket.gethostname()}:{server.port}"
+    return server.url
+
+
+def registration_record(url: str, name: str = None) -> dict:
+    """The JSON document a replica publishes: endpoint + identity.  The
+    "ts" field is a wall-clock EXPORT (cross-process registration age is
+    advisory only — monotonic clocks don't travel between hosts)."""
+    from . import serve
+
+    rec = {"name": name or default_replica_name(), "url": url,
+           "pid": os.getpid(), "ts": time.time()}
+    rec.update(serve.identity())
+    return rec
+
+
+def register_replica(server, store=None, name: str = None) -> dict:
+    """Publish `server`'s endpoint in the fleet store (PTPU_FLEET_STORE,
+    or an injected store-like object with .add/.set/.close).  Returns the
+    published record.  Called automatically by ``monitor.start_server``
+    when the env var is set."""
+    own = False
+    if store is None:
+        host, port = _split_addr(os.environ.get(ENV_STORE, ""))
+        store = _StoreClient(host, port)
+        own = True
+    try:
+        rec = registration_record(advertised_url(server), name=name)
+        slot = store.add(REPLICA_COUNT_KEY, 1)
+        store.set(f"{REPLICA_KEY_PREFIX}{slot}",
+                  json.dumps(rec).encode())
+    finally:
+        if own:
+            store.close()
+    return rec
+
+
+def discover(store_addr: str = None, timeout_ms: int = 5000,
+             store=None, connect_timeout_s: float = 10.0) -> "list[dict]":
+    """All currently registered replica records (newest wins per name)."""
+    own = False
+    if store is None:
+        host, port = _split_addr(store_addr
+                                 or os.environ.get(ENV_STORE, ""))
+        store = _StoreClient(host, port, timeout_s=connect_timeout_s)
+        own = True
+    try:
+        count = store.add(REPLICA_COUNT_KEY, 0)
+        by_name = {}
+        for slot in range(1, count + 1):
+            raw = store.get(f"{REPLICA_KEY_PREFIX}{slot}",
+                            timeout_ms=timeout_ms)
+            if raw is None:   # claimed slot whose SET hasn't landed yet
+                continue
+            try:
+                rec = json.loads(raw.decode())
+            except (ValueError, UnicodeDecodeError):
+                continue   # foreign/corrupt record: skip, don't poison
+            if isinstance(rec, dict) and rec.get("name") and \
+                    rec.get("url"):
+                by_name[rec["name"]] = rec   # later slot wins (restart)
+    finally:
+        if own:
+            store.close()
+    return [by_name[k] for k in sorted(by_name)]
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition parser (for OUR exporter's output)
+# ---------------------------------------------------------------------------
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s+(\S+)\s*$')
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+_ESC_RE = re.compile(r"\\(.)")
+_ESC_MAP = {"n": "\n", '"': '"', "\\": "\\"}
+
+
+def _unescape(v: str) -> str:
+    # ONE left-to-right pass: ordered str.replace would decode the 'n'
+    # of an escaped backslash ('C:\new' exports as 'C:\\new') into a
+    # newline and split the series key the replica published
+    return _ESC_RE.sub(
+        lambda m: _ESC_MAP.get(m.group(1), m.group(1)), v)
+
+
+def _parse_labels(block: "str | None"):
+    if not block:
+        return {}
+    return {k: _unescape(v) for k, v in _LABEL_RE.findall(block)}
+
+
+def parse_prometheus(text: str) -> "dict[str, dict]":
+    """Parse ``StatRegistry.export_prometheus()`` text back into
+    ``{name: {"kind", "help", "series": {label_key: value}}}`` — the
+    input shape of ``StatRegistry.merge_snapshot``.
+
+    Histogram series come back as ``{"buckets", "counts", "count",
+    "sum"}`` with per-bucket (non-cumulative) counts, reconstructed by
+    differencing the ``le``-labeled cumulative samples; ``repr``-ed
+    bucket bounds round-trip floats exactly, so merged replicas re-bin
+    identically.  Unknown/foreign lines are skipped, not fatal — the
+    fleet must keep scraping a replica that grew a new metric kind."""
+    kinds, helps = {}, {}
+    # histogram assembly: name -> {series_key: {"le": {bound: cum},
+    #                                           "sum": x, "count": n}}
+    hist_raw: dict = {}
+    out: dict = {}
+
+    def ensure(name):
+        if name not in out:
+            out[name] = {"kind": kinds.get(name, "gauge"),
+                         "help": helps.get(name, ""), "series": {}}
+        return out[name]
+
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] == "TYPE":
+                kinds[parts[2]] = parts[3] if len(parts) > 3 else "gauge"
+            elif len(parts) >= 3 and parts[1] == "HELP":
+                helps[parts[2]] = parts[3] if len(parts) > 3 else ""
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        name, label_block, value_s = m.groups()
+        labels = _parse_labels(label_block)
+        # histogram sample names wear _bucket/_sum/_count suffixes
+        base = None
+        for suffix in ("_bucket", "_sum", "_count"):
+            cand = name[:-len(suffix)] if name.endswith(suffix) else None
+            if cand and kinds.get(cand) == "histogram":
+                base = cand
+                break
+        if base is not None:
+            le = labels.pop("le", None)
+            key = tuple(sorted(labels.items()))
+            rec = hist_raw.setdefault(base, {}).setdefault(
+                key, {"le": {}, "sum": 0.0, "count": 0})
+            if name.endswith("_bucket"):
+                if le == "+Inf":
+                    rec["le"][float("inf")] = int(float(value_s))
+                elif le is not None:
+                    rec["le"][float(le)] = int(float(value_s))
+            elif name.endswith("_sum"):
+                rec["sum"] = float(value_s)
+            else:
+                rec["count"] = int(float(value_s))
+            continue
+        try:
+            value = float(value_s)
+        except ValueError:
+            continue
+        pm = ensure(name)
+        pm["series"][tuple(sorted(labels.items()))] = value
+
+    for base, by_key in hist_raw.items():
+        pm = ensure(base)
+        pm["kind"] = "histogram"
+        for key, rec in by_key.items():
+            bounds = sorted(b for b in rec["le"] if b != float("inf"))
+            counts, prev = [], 0
+            for b in bounds:
+                cum = rec["le"][b]
+                counts.append(cum - prev)
+                prev = cum
+            counts.append(rec["count"] - prev)   # overflow bucket
+            pm["series"][key] = {
+                "buckets": tuple(bounds), "counts": counts,
+                "count": rec["count"], "sum": rec["sum"],
+            }
+    return out
+
+
+def series_value(parsed: dict, name: str, default=None, **labels):
+    """Convenience read of one parsed series (prometheus-style name)."""
+    pm = parsed.get(name)
+    if pm is None:
+        return default
+    key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+    return pm["series"].get(key, default)
+
+
+# ---------------------------------------------------------------------------
+# The aggregator
+# ---------------------------------------------------------------------------
+STATE_HEALTHY = "healthy"
+STATE_STALLED = "stalled"
+STATE_DOWN = "down"
+STATE_UNKNOWN = "unknown"
+_STATES = (STATE_HEALTHY, STATE_STALLED, STATE_DOWN, STATE_UNKNOWN)
+
+
+class _Replica:
+    """Mutable per-replica scrape state (all mutation under the
+    aggregator's lock)."""
+
+    __slots__ = ("name", "url", "state", "fail_streak", "scrape_errors",
+                 "last_ok_mono", "last_err", "healthz", "parsed",
+                 "prev_counters", "rates", "harvested")
+
+    def __init__(self, name: str, url: str):
+        self.name = name
+        self.url = url
+        self.state = STATE_UNKNOWN
+        self.fail_streak = 0
+        self.scrape_errors = 0
+        self.last_ok_mono = None     # monotonic of last good scrape
+        self.last_err = None
+        self.healthz = {}
+        self.parsed = {}
+        self.prev_counters = {}      # name -> (monotonic_ts, value)
+        self.rates = {}              # name -> per-second rate
+        self.harvested = []          # harvest file paths, oldest first
+
+
+class FleetAggregator:
+    """Scrape N replica endpoints, federate their metrics, roll health
+    up, and harvest post-mortems.
+
+    Replicas come from an explicit ``endpoints`` list (urls or
+    registration records) and/or from ``store`` (a ``host:port`` TCPStore
+    address — default ``PTPU_FLEET_STORE`` — that ``start_server``-ed
+    replicas registered into; re-polled every cycle so late joiners
+    appear).  ``fetch`` is injectable for tests (url -> body text).
+
+    States: *healthy* (scrape ok, recent activity), *stalled* (scrape ok
+    but ``last_activity_age_s`` > ``stall_after_s`` — the process is up,
+    its work loop is not), *down* (``down_after`` consecutive scrape
+    failures), *unknown* (not successfully scraped yet, failure streak
+    still below the down threshold).  On the transition INTO stalled or
+    down the replica's ``/flight/latest`` is pulled and saved
+    replica-tagged into ``harvest_dir`` — a stalled replica still serves
+    it from the endpoint's daemon thread even while its main thread
+    hangs."""
+
+    RATE_COUNTERS = ("serving_decode_tokens", "serving_prefill_tokens")
+
+    def __init__(self, endpoints=None, store: str = None,
+                 interval: float = 2.0, stall_after_s: float = 10.0,
+                 down_after: int = 3, harvest_dir: str = None,
+                 scrape_timeout: float = 5.0, fetch=None):
+        self._lock = threading.Lock()
+        self._replicas: "dict[str, _Replica]" = {}
+        self.interval = float(interval)
+        self.stall_after_s = float(stall_after_s)
+        self.down_after = int(down_after)
+        self.scrape_timeout = float(scrape_timeout)
+        self.harvest_dir = harvest_dir
+        self._store_addr = store if store is not None \
+            else (os.environ.get(ENV_STORE) or None)
+        self._fetch = fetch or self._http_fetch
+        self._registry = None
+        self._server = None
+        self._thread = None
+        self._stop_evt = threading.Event()
+        self._cycles = 0
+        self._harvest_seq = 0
+        self._loop_errors = 0
+        self._last_loop_err = None
+        self._slot_cache = {}   # slot -> record dict | miss count
+        #                         (poll-thread-private, no lock needed)
+        self._pool = None       # lazy shared scrape executor
+        self._store_cli = None  # persistent discovery connection
+        for ep in endpoints or ():
+            if isinstance(ep, str):
+                name = ep.split("//", 1)[-1]
+                self._replicas[name] = _Replica(name, ep)
+            else:
+                self._replicas[ep["name"]] = _Replica(ep["name"],
+                                                      ep["url"])
+
+    # -- scraping ----------------------------------------------------------
+    def _http_fetch(self, url: str) -> str:
+        return urllib.request.urlopen(
+            url, timeout=self.scrape_timeout).read().decode()
+
+    _SLOT_GIVE_UP = 3   # misses before a slot is treated as a permanent
+    #                     hole (a registrant that died between ADD and SET)
+
+    def _refresh_endpoints(self):
+        """Incremental discovery with bounded blocking: a dead store
+        costs one SHORT connect attempt per cycle (never the
+        registration path's patient 10 s retry), resolved slots are
+        cached so only new registrations hit the store, and a hole slot
+        stops being polled after _SLOT_GIVE_UP misses."""
+        if not self._store_addr:
+            return
+        with self._lock:
+            cli = self._store_cli
+        if cli is None:
+            try:
+                host, port = _split_addr(self._store_addr)
+                cli = _StoreClient(host, port,
+                                   timeout_s=min(2.0,
+                                                 self.scrape_timeout))
+            except (OSError, ValueError):
+                return   # store unreachable: keep scraping what we know
+            with self._lock:
+                self._store_cli = cli   # ONE persistent connection, not
+                # a connect/teardown per cycle against the rendezvous
+                # store every rank depends on
+        recs = []
+        try:
+            count = cli.add(REPLICA_COUNT_KEY, 0)
+            for slot in range(1, count + 1):
+                cached = self._slot_cache.get(slot)
+                if isinstance(cached, dict):
+                    recs.append(cached)
+                    continue
+                if cached is not None and cached >= self._SLOT_GIVE_UP:
+                    continue
+                raw = cli.get(f"{REPLICA_KEY_PREFIX}{slot}",
+                              timeout_ms=300)
+                if raw is None:
+                    self._slot_cache[slot] = (cached or 0) + 1
+                    continue
+                try:
+                    rec = json.loads(raw.decode())
+                except (ValueError, UnicodeDecodeError):
+                    rec = None
+                if isinstance(rec, dict) and rec.get("name") and \
+                        rec.get("url"):
+                    self._slot_cache[slot] = rec
+                    recs.append(rec)
+                else:   # foreign/corrupt record: never poll it again
+                    self._slot_cache[slot] = self._SLOT_GIVE_UP
+        except OSError:
+            # store died (or an op timed out, desyncing the framing):
+            # drop the connection, next cycle redials from scratch
+            cli.close()
+            with self._lock:
+                if self._store_cli is cli:
+                    self._store_cli = None
+            return
+        by_name = {}
+        for rec in recs:   # slot order: the newest record per name wins
+            by_name[rec["name"]] = rec
+        with self._lock:
+            for rec in by_name.values():
+                r = self._replicas.get(rec["name"])
+                if r is None:
+                    self._replicas[rec["name"]] = _Replica(rec["name"],
+                                                           rec["url"])
+                elif r.url != rec["url"]:
+                    r.url = rec["url"]   # restarted on a new port
+
+    def poll_once(self) -> dict:
+        """One full scrape cycle (also the unit-test entry point):
+        refresh discovery, scrape every replica, update the rollup,
+        rebuild + swap the fleet registry.  Returns {name: state}."""
+        self._refresh_endpoints()
+        with self._lock:
+            targets = [(r.name, r.url) for r in
+                       self._replicas.values()]
+        targets.sort()   # deterministic merge order (float sums)
+
+        def scrape(url):
+            try:
+                mtext = self._fetch(url + "/metrics")
+                hz = json.loads(self._fetch(url + "/healthz"))
+                return (parse_prometheus(mtext), hz, None)
+            except Exception as e:   # any scrape failure counts toward
+                # the down streak — the cause rides last_err
+                return (None, None, e)
+
+        # concurrent, outside the lock: a serial walk would let ONE
+        # black-holed endpoint delay every other replica's scrape by
+        # scrape_timeout — slowest exactly during the multi-replica
+        # failures the rollup exists to catch.  One long-lived pool
+        # (workers spawn lazily), not a fresh executor per cycle
+        results = {}
+        if len(targets) <= 1:
+            for name, url in targets:
+                results[name] = scrape(url)
+        else:
+            with self._lock:
+                pool = self._pool
+                if pool is None:
+                    pool = self._pool = \
+                        concurrent.futures.ThreadPoolExecutor(
+                            max_workers=16,
+                            thread_name_prefix="ptpu-fleet-scrape")
+            futs = {name: pool.submit(scrape, url)
+                    for name, url in targets}
+            for name, fut in futs.items():
+                results[name] = fut.result()
+
+        harvests = []
+        now = time.monotonic()
+        with self._lock:
+            for name, (parsed, hz, err) in results.items():
+                r = self._replicas.get(name)
+                if r is None:   # removed between scrape and update
+                    continue
+                prev_state = r.state
+                if err is not None:
+                    r.fail_streak += 1
+                    r.scrape_errors += 1
+                    r.last_err = repr(err)
+                    if r.fail_streak >= self.down_after:
+                        r.state = STATE_DOWN
+                else:
+                    r.fail_streak = 0
+                    r.last_ok_mono = now
+                    r.healthz = hz
+                    r.parsed = parsed
+                    self._update_rates(r, now)
+                    age = hz.get("last_activity_age_s")
+                    r.state = STATE_STALLED if (
+                        age is not None and age > self.stall_after_s
+                    ) else STATE_HEALTHY
+                if r.state != prev_state and r.state in (STATE_STALLED,
+                                                         STATE_DOWN):
+                    self._harvest_seq += 1
+                    harvests.append((r.name, r.url, r.state,
+                                     self._harvest_seq))
+            self._cycles += 1
+            states = {r.name: r.state for r in self._replicas.values()}
+
+        for name, url, state, seq in harvests:   # I/O outside the lock
+            self._harvest(name, url, state, seq)
+
+        reg = self._build_registry()
+        with self._lock:
+            self._registry = reg
+            if self._server is not None:
+                self._server.registry = reg
+        return states
+
+    def _update_rates(self, r: _Replica, now: float):
+        for cname in self.RATE_COUNTERS:
+            v = series_value(r.parsed, cname)
+            if v is None:
+                continue
+            prev = r.prev_counters.get(cname)
+            if prev is not None:
+                t0, v0 = prev
+                dt = now - t0
+                if dt > 0 and v >= v0:
+                    r.rates[cname] = (v - v0) / dt
+            r.prev_counters[cname] = (now, v)
+
+    # -- harvesting --------------------------------------------------------
+    def _harvest(self, name: str, url: str, state: str, seq: int):
+        """Pull the replica's newest flight dump and save a
+        replica-tagged copy.  A down replica's endpoint is usually gone —
+        the attempt is still made (the http thread can outlive a wedged
+        main thread) and a failure is recorded, not raised."""
+        dir = self.harvest_dir or os.environ.get(
+            "PTPU_FLEET_HARVEST_DIR") or os.environ.get("PTPU_FLIGHT_DIR")
+        if not dir:
+            return
+        safe = re.sub(r"[^A-Za-z0-9_.-]", "_", name)
+        path = os.path.join(dir, f"harvest_{safe}_{state}_{seq:03d}.json")
+        try:
+            body = self._fetch(url + "/flight/latest")
+            os.makedirs(dir, exist_ok=True)
+            tmp = f"{path}.tmp"
+            with open(tmp, "w") as f:
+                f.write(body)
+            os.replace(tmp, path)   # readers never see a partial harvest
+        except Exception as e:
+            with self._lock:
+                r = self._replicas.get(name)
+                if r is not None:
+                    r.last_err = f"harvest: {e!r}"
+            return
+        with self._lock:
+            r = self._replicas.get(name)
+            if r is not None:
+                r.harvested.append(path)
+
+    # -- the merged registry ----------------------------------------------
+    def _build_registry(self):
+        from . import StatRegistry
+
+        reg = StatRegistry()
+        with self._lock:
+            snap = [(r.name, r.state, r.scrape_errors, r.last_ok_mono,
+                     r.parsed) for r in sorted(self._replicas.values(),
+                                               key=lambda x: x.name)]
+        now = time.monotonic()
+        counts = dict.fromkeys(_STATES, 0)
+        merge_errors = {}
+        for name, state, errors, last_ok, parsed in snap:
+            counts[state] += 1
+            if parsed:
+                try:
+                    reg.merge_snapshot(parsed, labels={"replica": name})
+                except Exception as e:
+                    # one replica's unmergeable exposition (bucket-bound
+                    # or kind mismatch — a version-skewed fleet) must not
+                    # keep the WHOLE fleet view stale: the others still
+                    # merge, and the failure is exported + recorded
+                    merge_errors[name] = repr(e)
+            g = reg.gauge("fleet/scrape_errors",
+                          "scrape failures per replica (cumulative)")
+            self._force_set(g.labels(replica=name), errors)
+            g = reg.gauge("fleet/scrape_age_s",
+                          "seconds since the last successful scrape")
+            self._force_set(
+                g.labels(replica=name),
+                -1.0 if last_ok is None else round(now - last_ok, 3))
+        g = reg.gauge("fleet/replicas",
+                      "replica count by rollup state")
+        for state in _STATES:
+            self._force_set(g.labels(state=state), counts[state])
+        g = reg.gauge("fleet/merge_errors",
+                      "replicas whose exposition failed to merge this "
+                      "cycle")
+        for name, err in merge_errors.items():
+            self._force_set(g.labels(replica=name), 1)
+        if merge_errors:
+            with self._lock:
+                for name, err in merge_errors.items():
+                    r = self._replicas.get(name)
+                    if r is not None:
+                        r.last_err = f"merge: {err}"
+        return reg
+
+    @staticmethod
+    def _force_set(gauge, v):
+        # bypass the PTPU_MONITOR gate: the fleet registry is
+        # reconstruction of scraped data, not hot-path instrumentation
+        with gauge._lock:
+            gauge._value = float(v)
+            gauge._touched = True
+
+    @property
+    def registry(self):
+        """The most recently merged fleet StatRegistry (None before the
+        first cycle)."""
+        with self._lock:
+            return self._registry
+
+    # -- rollup / router API ----------------------------------------------
+    def states(self) -> "dict[str, str]":
+        with self._lock:
+            return {r.name: r.state for r in self._replicas.values()}
+
+    def snapshot(self) -> "dict[str, dict]":
+        """Per-replica structured stats — the load-aware-routing feed
+        (ROADMAP item 2): queue depth, running/waiting, decode tokens/s,
+        last activity, rollup state."""
+        now = time.monotonic()
+        out = {}
+        with self._lock:
+            for r in sorted(self._replicas.values(),
+                            key=lambda x: x.name):
+                out[r.name] = {
+                    "url": r.url,
+                    "state": r.state,
+                    "host": r.healthz.get("host"),
+                    "pid": r.healthz.get("pid"),
+                    "queue_depth": series_value(
+                        r.parsed, "serving_queue_depth"),
+                    "running": series_value(r.parsed, "serving_running"),
+                    "waiting": series_value(r.parsed, "serving_waiting"),
+                    "decode_tokens_per_s": r.rates.get(
+                        "serving_decode_tokens"),
+                    "last_activity_age_s": r.healthz.get(
+                        "last_activity_age_s"),
+                    "scrape_age_s": None if r.last_ok_mono is None
+                    else round(now - r.last_ok_mono, 3),
+                    "scrape_errors": r.scrape_errors,
+                    "fail_streak": r.fail_streak,
+                    "last_err": r.last_err,
+                    "harvested": list(r.harvested),
+                }
+        return out
+
+    def healthz(self) -> dict:
+        """The /fleet/healthz document."""
+        snap = self.snapshot()
+        counts = dict.fromkeys(_STATES, 0)
+        for rec in snap.values():
+            counts[rec["state"]] += 1
+        if not snap:
+            status = "empty"
+        elif counts[STATE_HEALTHY] == len(snap):
+            status = "ok"
+        else:
+            status = "degraded"
+        with self._lock:
+            loop_errors, last_loop_err = (self._loop_errors,
+                                          self._last_loop_err)
+        return {"status": status, "schema_version": 1,
+                "stall_after_s": self.stall_after_s,
+                "down_after": self.down_after,
+                "loop_errors": loop_errors,
+                "last_loop_err": last_loop_err,
+                "counts": counts, "replicas": snap}
+
+    # -- lifecycle ---------------------------------------------------------
+    def serve(self, port: int = 0, host: str = "127.0.0.1"):
+        """Expose the merged view on a fleet MonitorServer: /metrics
+        serves the federated registry, /fleet/healthz the rollup."""
+        from .serve import MonitorServer
+
+        def route():
+            return 200, json.dumps(self.healthz()), "application/json"
+
+        with self._lock:
+            if self._server is None:
+                # before the first cycle the merged view is truthfully
+                # EMPTY — never the aggregator process's own metrics
+                # masquerading as fleet totals (registry=None would fall
+                # back to the module-global exporter)
+                reg = self._registry
+                if reg is None:
+                    from . import StatRegistry
+
+                    reg = StatRegistry()
+                self._server = MonitorServer(
+                    port, host, registry=reg,
+                    routes={"/fleet/healthz": route})
+            srv = self._server
+        return srv
+
+    def start(self):
+        """Run poll_once() every `interval` seconds on a daemon thread."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop_evt.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="ptpu-fleet-aggregator", daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop_evt.is_set():
+            try:
+                self.poll_once()
+            except Exception as e:
+                # one bad cycle (store hiccup, endpoint mid-restart) must
+                # not kill the scrape loop; per-replica scrape/merge
+                # failures are already contained + counted, so anything
+                # landing here is unexpected — record it where
+                # /fleet/healthz surfaces it
+                with self._lock:
+                    self._loop_errors += 1
+                    self._last_loop_err = repr(e)
+            self._stop_evt.wait(self.interval)
+
+    def stop(self, timeout: float = 5.0):
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        with self._lock:
+            srv, self._server = self._server, None
+            pool, self._pool = self._pool, None
+            cli, self._store_cli = self._store_cli, None
+        if pool is not None:
+            pool.shutdown(wait=False)
+        if cli is not None:
+            cli.close()
+        if srv is not None:
+            srv.stop()

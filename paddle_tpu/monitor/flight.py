@@ -36,7 +36,7 @@ from collections import deque
 __all__ = [
     "FlightRecorder", "get_recorder", "record_span", "note", "dump",
     "maybe_dump", "dump_from_signal", "install", "uninstall", "watchdog",
-    "Watchdog", "flight_dir",
+    "Watchdog", "flight_dir", "latest_dump",
 ]
 
 _DEFAULT_RING = 512
@@ -163,6 +163,32 @@ def dump(reason: str, dir: str = None, with_stacks: bool = True,
                           extra=extra)
 
 
+def latest_dump(dir: str = None) -> "str | None":
+    """Path of the newest flight dump in `dir` (default PTPU_FLIGHT_DIR),
+    or None when the dir is unset/missing/empty.  Backs the
+    ``/flight/latest`` endpoint the fleet aggregator harvests from —
+    newest by mtime, .tmp staging files excluded (the atomic-rename
+    commit means every visible flight_*.json is complete)."""
+    dir = dir or flight_dir()
+    if not dir:
+        return None
+    try:
+        names = [n for n in os.listdir(dir)
+                 if n.startswith("flight_") and n.endswith(".json")]
+    except OSError:
+        return None
+    best, best_m = None, None
+    for n in names:
+        p = os.path.join(dir, n)
+        try:
+            m = os.path.getmtime(p)
+        except OSError:   # raced a cleanup — skip, not fatal
+            continue
+        if best_m is None or m > best_m:
+            best, best_m = p, m
+    return best
+
+
 def maybe_dump(reason: str, extra: dict = None):
     """Dump only when PTPU_FLIGHT_DIR is configured — the opt-in form
     the automatic hooks use."""
@@ -271,9 +297,18 @@ class Watchdog(threading.Thread):
                       "flight dumps triggered by a detected stall")
         errs = counter("monitor/watchdog_errors",
                        "watchdog dump attempts that failed")
+        dumped_beat = None
         while not self._stop_evt.wait(self.interval):
             age = trace.last_activity_age()
             if age <= self.stall_s:
+                continue
+            # re-arm by remembering WHICH beat we dumped at (one dump per
+            # distinct stall), NOT by calling trace.heartbeat(): forging
+            # a beat would reset /healthz's last_activity_age_s and hide
+            # an ongoing stall from the fleet rollup (ISSUE 11 — the
+            # aggregator classifies `stalled` off exactly that field)
+            beat = trace._last_beat[0]
+            if beat == dumped_beat:
                 continue
             try:
                 path = _recorder.dump(
@@ -285,7 +320,7 @@ class Watchdog(threading.Thread):
                 # dir gone) must not kill the watchdog thread — the NEXT
                 # stall still deserves an attempt; failures are counted
                 errs.inc()
-            trace.heartbeat()   # re-arm: next dump needs a NEW stall
+            dumped_beat = beat   # next dump needs a NEW stall
 
     def stop(self, timeout: float = 5.0):
         self._stop_evt.set()

@@ -1,16 +1,25 @@
 """Live observability endpoint — a stdlib ``http.server`` thread serving
 the process's metrics and traces while it runs:
 
-- ``GET /metrics``      — Prometheus text exposition (the PR-1 exporter),
-  scrapeable by any Prometheus/agent;
-- ``GET /healthz``      — JSON liveness: pid, uptime, seconds since the
+- ``GET /metrics``       — Prometheus text exposition (the PR-1 exporter),
+  scrapeable by any Prometheus/agent (and by ``monitor.fleet``);
+- ``GET /healthz``       — JSON liveness: pid, uptime, seconds since the
   last completed span/step (the watchdog's signal — a scraper can alert
-  on stalls without attaching a debugger);
-- ``GET /traces/<id>``  — one trace's finished spans as JSON (the ids
-  come from ``LLMEngine.request_trace`` / ``trace.trace_ids()``).
+  on stalls without attaching a debugger), plus identity (host, rank /
+  replica_id when known) so a fleet rollup can label replicas without
+  out-of-band config;
+- ``GET /traces/<id>``   — one trace's finished spans as JSON (the ids
+  come from ``LLMEngine.request_trace`` / ``trace.trace_ids()``);
+- ``GET /flight/latest`` — the newest flight-recorder dump in
+  ``PTPU_FLIGHT_DIR`` (404 when none) — how the fleet aggregator
+  harvests a stalled replica's post-mortem while the main thread hangs
+  (this endpoint runs on the daemon http thread).
 
 Launch: ``monitor.start_server(port)`` (port 0 = ephemeral; the chosen
 port is on the returned server), or ``EngineConfig(metrics_port=...)``.
+When ``PTPU_FLEET_STORE=host:port`` names a TCPStore, ``start_server``
+also self-registers the endpoint there so a ``fleet.FleetAggregator``
+auto-discovers it (launch/elastic jobs get fleet scraping for free).
 The server runs on a daemon thread and binds 127.0.0.1 by default —
 exposing it wider is an explicit ``host=`` decision.
 """
@@ -18,15 +27,52 @@ from __future__ import annotations
 
 import json
 import os
+import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-__all__ = ["MonitorServer", "start_server", "stop_server"]
+__all__ = ["MonitorServer", "start_server", "stop_server",
+           "set_identity", "identity"]
 
 # uptime is ELAPSED time: monotonic survives NTP steps/suspend, where a
 # wall-clock delta could report negative or hours-wrong uptime
 _started_at = time.monotonic()
+
+# -- identity ---------------------------------------------------------------
+# /healthz schema: version bumped whenever keys are added (never removed/
+# renamed — the PR-5 endpoint consumers stay byte-compatible)
+SCHEMA_VERSION = 2
+
+_identity_override = {}
+
+
+def set_identity(replica_id=None, rank=None) -> None:
+    """Pin this process's fleet identity explicitly (overrides the
+    PTPU_REPLICA_ID / PADDLE_TRAINER_ID env defaults)."""
+    if replica_id is not None:
+        _identity_override["replica_id"] = str(replica_id)
+    if rank is not None:
+        _identity_override["rank"] = int(rank)
+
+
+def identity() -> dict:
+    """host + (when known) rank/replica_id — the fields a fleet rollup
+    labels replicas with.  rank comes from the launcher's
+    PADDLE_TRAINER_ID, replica_id from PTPU_REPLICA_ID (both overridable
+    via :func:`set_identity`); absent fields are omitted, not null."""
+    out = {"host": socket.gethostname(), "schema_version": SCHEMA_VERSION}
+    rank = _identity_override.get("rank")
+    if rank is None:
+        env = os.environ.get("PADDLE_TRAINER_ID")
+        rank = int(env) if env and env.isdigit() else None
+    if rank is not None:
+        out["rank"] = rank
+    rid = _identity_override.get("replica_id") \
+        or os.environ.get("PTPU_REPLICA_ID")
+    if rid:
+        out["replica_id"] = rid
+    return out
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -41,21 +87,50 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(data)
 
     def do_GET(self):   # noqa: N802 (http.server API)
-        from . import enabled, export_prometheus, trace
+        from . import enabled, export_prometheus, flight, trace
 
         path = self.path.split("?", 1)[0].rstrip("/") or "/"
-        if path == "/metrics":
-            self._send(200, export_prometheus(),
+        routes = getattr(self.server, "routes", None)
+        if routes and path in routes:
+            try:
+                code, body, ctype = routes[path]()
+            except Exception as e:   # a broken route must not kill the
+                # scrape endpoint — report it as a 500 body instead
+                code, body, ctype = 500, json.dumps(
+                    {"error": repr(e)}), "application/json"
+            self._send(code, body, ctype)
+        elif path == "/metrics":
+            reg = getattr(self.server, "registry", None)
+            text = export_prometheus() if reg is None \
+                else reg.export_prometheus()
+            self._send(200, text,
                        "text/plain; version=0.0.4; charset=utf-8")
         elif path == "/healthz":
-            self._send(200, json.dumps({
+            doc = {
                 "status": "ok",
                 "pid": os.getpid(),
                 "uptime_s": round(time.monotonic() - _started_at, 3),
                 "last_activity_age_s": round(trace.last_activity_age(), 3),
                 "monitor_enabled": enabled(),
                 "trace_enabled": trace.enabled(),
-            }), "application/json")
+            }
+            doc.update(identity())
+            self._send(200, json.dumps(doc), "application/json")
+        elif path == "/flight/latest":
+            p = flight.latest_dump()
+            if p is None:
+                self._send(404, json.dumps(
+                    {"error": "no flight dump (PTPU_FLIGHT_DIR unset or "
+                              "empty)"}), "application/json")
+            else:
+                try:
+                    with open(p) as f:
+                        body = f.read()
+                    self._send(200, body, "application/json")
+                except OSError as e:   # raced a cleanup between listdir
+                    # and open — a 404 is the truthful answer
+                    self._send(404, json.dumps({"error": repr(e)}),
+                               "application/json")
         elif path.startswith("/traces/"):
             tid = path[len("/traces/"):]
             spans = trace.get_trace(tid)
@@ -65,8 +140,10 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._send(200, json.dumps(spans), "application/json")
         elif path == "/":
+            extra = " ".join(sorted(routes)) + " " if routes else ""
             self._send(200, "paddle_tpu monitor: /metrics /healthz "
-                            "/traces/<id>\n", "text/plain; charset=utf-8")
+                            f"/traces/<id> /flight/latest {extra}\n",
+                       "text/plain; charset=utf-8")
         else:
             self._send(404, "not found\n", "text/plain; charset=utf-8")
 
@@ -76,16 +153,35 @@ class _Handler(BaseHTTPRequestHandler):
 
 class MonitorServer:
     """A running endpoint; ``.port`` is the bound port (useful with
-    port=0), ``.stop()`` shuts it down."""
+    port=0), ``.stop()`` shuts it down.
 
-    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+    ``registry``: an alternate StatRegistry whose exposition /metrics
+    serves instead of the process default — the fleet aggregator swaps a
+    freshly merged registry in per scrape cycle (assign
+    ``server.registry``; reads are atomic under the GIL).
+    ``routes``: extra exact-path GET handlers, each a zero-arg callable
+    returning ``(status, body_str, content_type)`` — how
+    ``/fleet/healthz`` rides the same server."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 registry=None, routes=None):
         self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
         self._httpd.daemon_threads = True
+        self._httpd.registry = registry
+        self._httpd.routes = dict(routes) if routes else None
         self.host, self.port = self._httpd.server_address[:2]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="ptpu-monitor-http",
             daemon=True)
         self._thread.start()
+
+    @property
+    def registry(self):
+        return self._httpd.registry
+
+    @registry.setter
+    def registry(self, reg):
+        self._httpd.registry = reg
 
     @property
     def url(self) -> str:
@@ -108,11 +204,31 @@ def start_server(port: int = 0, host: str = "127.0.0.1") -> MonitorServer:
     """Start (or return) the process-wide endpoint.  Asking for a
     DIFFERENT explicit port while one is already bound warns instead of
     silently handing back the old server — a scrape target configured
-    for the requested port would otherwise look down forever."""
+    for the requested port would otherwise look down forever.
+
+    With ``PTPU_FLEET_STORE=host:port`` set, a freshly started server
+    self-registers its endpoint in that TCPStore (best-effort: a dead
+    store warns, it never fails the process being monitored)."""
     global _server
     with _server_lock:
         if _server is None:
             _server = MonitorServer(port, host)
+            if os.environ.get("PTPU_FLEET_STORE"):
+                from . import fleet
+
+                try:
+                    fleet.register_replica(_server)
+                except Exception as e:
+                    # registration is advisory — the replica still serves
+                    # locally; an unreachable store must not take down
+                    # the process that merely wanted metrics
+                    import warnings
+
+                    warnings.warn(
+                        f"monitor.start_server: fleet registration at "
+                        f"PTPU_FLEET_STORE="
+                        f"{os.environ['PTPU_FLEET_STORE']!r} failed: "
+                        f"{e!r}")
         elif port not in (0, _server.port):
             import warnings
 

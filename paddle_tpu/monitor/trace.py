@@ -14,7 +14,13 @@ parent so a trace renders as a tree.  Producers:
   serving request lives across many engine steps);
 - ``trace.attach(span)`` — re-parent the thread-local context onto an
   existing span from ANOTHER thread (DataLoader workers, async
-  checkpoint writers), so cross-thread work lands in the right trace.
+  checkpoint writers), so cross-thread work lands in the right trace;
+- ``trace.inject()`` / ``trace.extract(header)`` — serialize the current
+  span's (trace_id, span_id) into a traceparent-style header and parse
+  it back into a :class:`SpanContext` in ANOTHER process, so an rpc-
+  issued request opens a *child* span on the remote worker and
+  ``export_chrome_trace()`` shows one trace_id spanning processes
+  (``distributed/rpc.py`` carries the header on every call).
 
 Design constraints (shared with the metrics layer):
 
@@ -47,7 +53,8 @@ import time
 from collections import OrderedDict
 
 __all__ = [
-    "Span", "span", "start_span", "current_span", "attach", "get_trace",
+    "Span", "SpanContext", "span", "start_span", "current_span", "attach",
+    "inject", "extract", "get_trace",
     "trace_ids", "chrome_events", "export_chrome_trace", "enabled",
     "enable", "refresh", "reset", "heartbeat", "last_activity_age",
 ]
@@ -225,6 +232,56 @@ def reset() -> None:
 
 # -- context propagation ----------------------------------------------------
 
+class SpanContext:
+    """Span *identity* without the span: what travels on a wire.  An
+    ``extract()``-ed context carries only (trace_id, span_id); it can be
+    adopted with :class:`attach` or passed as ``parent=`` so work in a
+    DIFFERENT process lands as a child in the originating trace.  It is
+    never recorded itself — only real spans are."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    def __repr__(self):
+        return f"SpanContext({self.trace_id}, {self.span_id})"
+
+
+# traceparent-style header: "<version>;<trace_id>;<span_id>".  ";" because
+# our ids themselves contain "-" (W3C traceparent's separator).
+_CTX_VERSION = "ptpu1"
+
+
+def inject(span_=None) -> "str | None":
+    """Serialize the current (or given) span's context for the wire —
+    the client half of cross-process propagation.  Returns None when
+    tracing is disabled or there is no open span, so a disabled caller
+    attaches nothing (allocation-free, same budget as a disabled
+    ``span()``; gated by bench.py --config trace_overhead)."""
+    if not _enabled:
+        return None
+    s = _ctx.span if span_ is None else span_
+    if s is None or s.trace_id is None:
+        return None
+    return f"{_CTX_VERSION};{s.trace_id};{s.span_id}"
+
+
+def extract(header) -> "SpanContext | None":
+    """Parse an :func:`inject`-ed header back into a SpanContext — the
+    server half.  None for a missing/foreign/malformed header, and when
+    tracing is disabled here (a receiver with PTPU_TRACE=0 must not pay
+    for a sender's tracing).  The no-header path is allocation-free."""
+    if not _enabled or not header:
+        return None
+    parts = header.split(";")
+    if len(parts) != 3 or parts[0] != _CTX_VERSION \
+            or not parts[1] or not parts[2]:
+        return None
+    return SpanContext(parts[1], parts[2])
+
+
 class _Ctx(threading.local):
     span = None
 
@@ -238,7 +295,8 @@ def current_span():
 
 
 class attach:
-    """Adopt `parent` as this thread's current span::
+    """Adopt `parent` — a Span from another thread, or a SpanContext
+    ``extract()``-ed from another process — as this thread's current::
 
         ctx = trace.current_span()          # producer thread
         ...
@@ -250,7 +308,8 @@ class attach:
     __slots__ = ("_span", "_prev")
 
     def __init__(self, span_):
-        self._span = span_ if isinstance(span_, Span) else None
+        self._span = span_ if isinstance(span_, (Span, SpanContext)) \
+            else None
 
     def __enter__(self):
         self._prev = _ctx.span
@@ -264,13 +323,14 @@ class attach:
 
 
 def start_span(name: str, parent=None, trace_id=None, **attrs):
-    """Manual span (caller owns ``end()``).  ``parent`` may be a Span;
-    with neither parent nor trace_id a NEW trace is opened (the span is
-    its root).  Returns the no-op singleton when tracing is disabled."""
+    """Manual span (caller owns ``end()``).  ``parent`` may be a Span or
+    a cross-process SpanContext; with neither parent nor trace_id a NEW
+    trace is opened (the span is its root).  Returns the no-op singleton
+    when tracing is disabled."""
     if not _enabled:
         return _NULL
     parent_id = None
-    if isinstance(parent, Span):
+    if isinstance(parent, (Span, SpanContext)):
         parent_id = parent.span_id
         trace_id = trace_id or parent.trace_id
     if trace_id is None:

@@ -1,0 +1,298 @@
+"""Fleet observability smoke (ISSUE 11 acceptance, end-to-end): TWO real
+replica processes — each a tiny `LLMEngine` with a live monitor endpoint
+self-registered into a shared TCPStore — plus a `FleetAggregator` in the
+parent, proving in one run:
+
+1. **metrics federation is exact**: the fleet `/metrics` counter for
+   `serving_decode_tokens` equals the SUM of the two replicas' scraped
+   counters, with `replica`-labeled series present for each;
+2. **trace propagation crosses processes**: an rpc call issued inside a
+   parent span opens a child `rpc/serve` span in the replica, and the
+   combined `export_chrome_trace()` output shows ONE trace_id spanning
+   both pids, parent-linked through the wire header;
+3. **health rollup + flight-dump harvesting**: a `PTPU_FAULTS`
+   stall-injected replica (its engine.step blocks, its watchdog dumps)
+   transitions to `stalled` on `/fleet/healthz`, and the aggregator
+   harvests its flight dump as a replica-tagged copy into the harvest
+   directory.
+
+Runnable anywhere (CPU included):
+
+    JAX_PLATFORMS=cpu python scripts/fleet_smoke.py
+
+Run by tests/test_fleet.py::test_fleet_smoke_script (slow tier — two
+engine-compiling subprocesses don't fit the fast-tier budget).
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault("PTPU_FORCE_PLATFORM", "cpu")   # drop on a TPU host
+os.environ.setdefault("PTPU_MONITOR", "1")
+os.environ.setdefault("PTPU_TRACE", "1")
+
+WORLD = 3            # aggregator (rank 0) + 2 replicas
+N_REPLICAS = 2
+STALL_REPLICA = "r1"
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# -- functions executed ON THE REPLICA via rpc (pickled by reference) -------
+
+def _remote_work(tag):
+    """Runs under the rpc/serve span the propagated header parents —
+    its child span lands in the CALLER's trace, in this process."""
+    from paddle_tpu.monitor import trace
+
+    with trace.span("fleet/remote_work", tag=tag):
+        time.sleep(0.01)
+    return os.getpid()
+
+
+def _remote_export(path):
+    """Export the replica's chrome trace (called AFTER _remote_work's
+    rpc completed, so that call's rpc/serve span is recorded)."""
+    from paddle_tpu.monitor import trace
+
+    return trace.export_chrome_trace(path)
+
+
+# ---------------------------------------------------------------------------
+# replica process
+# ---------------------------------------------------------------------------
+
+def replica_main(idx: int, store_addr: str, workdir: str):
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import monitor
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.models import GPTForCausalLM, gpt_test_config
+    from paddle_tpu.monitor import fleet, trace
+    from paddle_tpu.serving import EngineConfig, LLMEngine, SamplingParams
+
+    name = os.environ["PTPU_REPLICA_ID"]
+    paddle.seed(idx)
+    cfg = gpt_test_config(stacked_blocks=True, sequence_parallel=False)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    engine = LLMEngine(model, EngineConfig(block_size=16, max_num_seqs=4))
+
+    # the live endpoint self-registers under PTPU_FLEET_STORE
+    monitor.start_server(0)
+    host, port = store_addr.rsplit(":", 1)
+    rpc.init_rpc(f"replica{idx}", rank=idx + 1, world_size=WORLD,
+                 master_endpoint=store_addr)
+
+    # warmup traffic: real serving counters (and the step programs the
+    # stall command will reuse without recompiling)
+    rng = np.random.RandomState(idx)
+    prompts = [rng.randint(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in (4, 6)]
+    engine.generate(prompts, SamplingParams(max_new_tokens=3 + idx))
+
+    # armed AFTER the compile-heavy warmup: the watchdog is what writes
+    # the flight dump the aggregator harvests when the stall fires
+    monitor.watchdog(stall_s=1.0, interval=0.1)
+    cli = fleet._StoreClient(host, int(port))
+    cli.set(f"fleet/ready/{name}", b"1")
+    print(f"replica {name}: ready", flush=True)
+
+    while True:
+        cmd = cli.get(f"fleet/cmd/{name}", timeout_ms=200)
+        trace.heartbeat()   # an idle replica is healthy, not stalled
+        if cmd == b"stall":
+            # PTPU_FAULTS deterministic hang: engine.step blocks without
+            # completing a span → watchdog dumps → aggregator sees
+            # last_activity_age climb past its threshold
+            from paddle_tpu.resilience import faults
+
+            os.environ["PTPU_FAULTS"] = \
+                "stall@site=engine.step,secs=600"
+            faults.set_plan(faults.FaultPlan.from_env())
+            print(f"replica {name}: stalling", flush=True)
+            engine.generate(prompts[:1], SamplingParams(max_new_tokens=2))
+        elif cmd == b"exit":
+            return
+
+
+# ---------------------------------------------------------------------------
+# aggregator / driver process
+# ---------------------------------------------------------------------------
+
+def _deadline_wait(what, pred, deadline_s=420.0, poll_s=0.25):
+    t0 = time.monotonic()
+    while True:
+        out = pred()
+        if out:
+            return out
+        if time.monotonic() - t0 > deadline_s:
+            raise TimeoutError(f"timed out waiting for {what}")
+        time.sleep(poll_s)
+
+
+def main():
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.monitor import fleet, trace
+
+    workdir = tempfile.mkdtemp(prefix="ptpu_fleet_smoke_")
+    harvest_dir = os.path.join(workdir, "harvest")
+    store_port = _free_port()
+    store_addr = f"127.0.0.1:{store_port}"
+
+    procs = []
+    for idx in range(N_REPLICAS):
+        env = dict(os.environ,
+                   PTPU_REPLICA_ID=f"r{idx}",
+                   PTPU_FLEET_STORE=store_addr,
+                   PTPU_FLIGHT_DIR=os.path.join(workdir, f"flight_r{idx}"),
+                   PTPU_MONITOR="1", PTPU_TRACE="1")
+        env.pop("PTPU_FAULTS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--replica",
+             str(idx), "--store", store_addr, "--dir", workdir],
+            env=env))
+    try:
+        # rank 0 hosts the rendezvous store the replicas register into;
+        # init_rpc returns once all three processes joined
+        rpc.init_rpc("agg", rank=0, world_size=WORLD,
+                     master_endpoint=store_addr)
+        cli = fleet._StoreClient("127.0.0.1", store_port)
+        for idx in range(N_REPLICAS):
+            _deadline_wait(
+                f"replica r{idx} ready",
+                lambda i=idx: cli.get(f"fleet/ready/r{i}",
+                                      timeout_ms=500) == b"1")
+        print("replicas ready", flush=True)
+
+        agg = fleet.FleetAggregator(
+            store=store_addr, interval=0.25, stall_after_s=2.0,
+            down_after=8, harvest_dir=harvest_dir)
+        states = _deadline_wait(
+            "both replicas healthy", lambda: (
+                lambda s: s if sorted(s) == ["r0", "r1"] and
+                set(s.values()) == {"healthy"} else None
+            )(agg.poll_once()))
+        print("rollup:", states, flush=True)
+        srv = agg.serve(port=0)
+
+        # -- 1. exact counter federation --------------------------------
+        recs = {r["name"]: r for r in fleet.discover(store_addr)}
+        per_replica = {}
+        for name, rec in sorted(recs.items()):
+            parsed = fleet.parse_prometheus(
+                agg._http_fetch(rec["url"] + "/metrics"))
+            per_replica[name] = fleet.series_value(
+                parsed, "serving_decode_tokens")
+            assert per_replica[name] and per_replica[name] > 0, (
+                name, per_replica)
+        agg.poll_once()   # a scrape AFTER the direct reads (counters are
+        # quiescent between commands, so the sums must match exactly)
+        fleet_parsed = fleet.parse_prometheus(
+            agg._http_fetch(srv.url + "/metrics"))
+        total = fleet.series_value(fleet_parsed, "serving_decode_tokens")
+        assert total == sum(per_replica.values()), (
+            total, per_replica)
+        for name, v in per_replica.items():
+            got = fleet.series_value(fleet_parsed,
+                                     "serving_decode_tokens",
+                                     replica=name)
+            assert got == v, (name, got, v)
+        print(f"fleet counters exact: serving_decode_tokens {total} = "
+              f"{' + '.join(str(v) for v in per_replica.values())} "
+              f"(replica-labeled)", flush=True)
+
+        # -- 2. one trace_id across processes ----------------------------
+        trace.enable(True)
+        remote_chrome = os.path.join(workdir, "replica0_chrome.json")
+        with trace.span("fleet/parity") as root:
+            callee_pid = rpc.rpc_sync("replica0", _remote_work,
+                                      args=("smoke",), timeout=60)
+        rpc.rpc_sync("replica0", _remote_export, args=(remote_chrome,),
+                     timeout=60)
+        local_chrome = os.path.join(workdir, "agg_chrome.json")
+        trace.export_chrome_trace(local_chrome)
+        events = []
+        for p in (local_chrome, remote_chrome):
+            with open(p) as f:
+                events.extend(json.load(f)["traceEvents"])
+        mine = [e for e in events
+                if e.get("args", {}).get("trace_id") == root.trace_id]
+        pids = {e["pid"] for e in mine}
+        names = {e["name"] for e in mine}
+        assert os.getpid() in pids and callee_pid in pids, (
+            pids, os.getpid(), callee_pid)
+        assert {"fleet/parity", "rpc/call", "rpc/serve",
+                "fleet/remote_work"} <= names, names
+        by_id = {e["args"]["span_id"]: e for e in mine}
+        serve_ev = next(e for e in mine if e["name"] == "rpc/serve")
+        call_ev = by_id[serve_ev["args"]["parent_id"]]
+        assert call_ev["name"] == "rpc/call" and \
+            call_ev["pid"] == os.getpid() and \
+            serve_ev["pid"] == callee_pid
+        print(f"one trace_id ({root.trace_id}) spans pids "
+              f"{sorted(pids)}: {sorted(names)}", flush=True)
+
+        # -- 3. stall rollup + flight-dump harvest -----------------------
+        cli.set(f"fleet/cmd/{STALL_REPLICA}", b"stall")
+        _deadline_wait(
+            f"{STALL_REPLICA} rolled up as stalled", lambda: (
+                agg.poll_once().get(STALL_REPLICA) == "stalled"),
+            deadline_s=90.0)
+        hz = json.loads(agg._http_fetch(srv.url + "/fleet/healthz"))
+        assert hz["status"] == "degraded", hz
+        assert hz["replicas"][STALL_REPLICA]["state"] == "stalled", hz
+        assert hz["replicas"]["r0"]["state"] == "healthy", hz
+        harvested = _deadline_wait(
+            "harvested flight dump",
+            lambda: [f for f in (os.listdir(harvest_dir)
+                                 if os.path.isdir(harvest_dir) else [])
+                     if f.startswith(f"harvest_{STALL_REPLICA}_stalled")],
+            deadline_s=60.0)
+        with open(os.path.join(harvest_dir, harvested[0])) as f:
+            dump = json.load(f)
+        assert dump["reason"] == "stall", dump.get("reason")
+        stacks = "\n".join(ln for frames in dump["stacks"].values()
+                           for ln in frames)
+        assert "maybe_stall" in stacks, "harvested dump must show the hang"
+        print(f"stalled replica harvested: {harvested[0]} "
+              f"(reason={dump['reason']}, pid={dump['pid']})", flush=True)
+
+        snap = agg.snapshot()
+        print("fleet snapshot:", json.dumps(snap, indent=1), flush=True)
+        assert snap["r0"]["queue_depth"] is not None
+        assert snap[STALL_REPLICA]["state"] == "stalled"
+        agg.stop()
+        print("FLEET SMOKE OK", flush=True)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+if __name__ == "__main__":
+    if "--replica" in sys.argv:
+        argv = sys.argv[1:]
+        idx = int(argv[argv.index("--replica") + 1])
+        store = argv[argv.index("--store") + 1]
+        wd = argv[argv.index("--dir") + 1]
+        replica_main(idx, store, wd)
+    else:
+        main()

@@ -43,6 +43,10 @@ case "${1:-fast}" in
     ;;
   full)
     python -m tools.ptpu_check --json-out /tmp/ptpu_check_report.json
+    # includes the slow tier: tests/test_fleet.py::test_fleet_smoke_script
+    # runs scripts/fleet_smoke.py (ISSUE 11 acceptance — 2 engine
+    # replicas + aggregator; the fleet fast-tier unit tests ride the
+    # "not slow" selection above like every other suite)
     python -m pytest tests/ -q
     ;;
   lint)
