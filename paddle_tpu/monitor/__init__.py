@@ -711,11 +711,11 @@ def STAT_RESET(name):
 # file_location, no package) to prove the core registry is jax-free; in
 # that mode the v2 submodules — equally stdlib-only — are simply absent.
 try:
-    from . import trace, flight, serve, perf, fleet  # noqa: E402,F401
+    from . import trace, flight, serve, perf, fleet, hlo  # noqa: E402,F401
     from .flight import watchdog                  # noqa: E402,F401
     from .serve import start_server, stop_server  # noqa: E402,F401
 
-    __all__ += ["trace", "flight", "serve", "perf", "fleet", "watchdog",
-                "start_server", "stop_server"]
+    __all__ += ["trace", "flight", "serve", "perf", "fleet", "hlo",
+                "watchdog", "start_server", "stop_server"]
 except ImportError:   # standalone module load — core registry only
     pass

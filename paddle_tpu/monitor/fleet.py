@@ -745,6 +745,18 @@ class FleetAggregator:
                     "waiting": series_value(r.parsed, "serving_waiting"),
                     "decode_tokens_per_s": r.rates.get(
                         "serving_decode_tokens"),
+                    # ISSUE 12 goodput/padding + process identity: the
+                    # load-aware-dispatch signals (None when the replica
+                    # predates them — schema keys only ever accrete)
+                    "goodput_tokens_per_s": series_value(
+                        r.parsed, "serving_goodput_tokens_per_s"),
+                    "padding_waste_rows": series_value(
+                        r.parsed, "serving_padding_waste", kind="rows"),
+                    "kernels_per_step": series_value(
+                        r.parsed, "serving_kernels_per_step"),
+                    "rss_bytes": r.healthz.get("rss_bytes"),
+                    "open_fds": r.healthz.get("open_fds"),
+                    "uptime_s": r.healthz.get("uptime_s"),
                     "last_activity_age_s": r.healthz.get(
                         "last_activity_age_s"),
                     "scrape_age_s": None if r.last_ok_mono is None

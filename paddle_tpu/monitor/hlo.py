@@ -1,0 +1,570 @@
+"""HLO-level kernel attribution — the *inside a compiled program* half of
+perf attribution (ISSUE 12's "program microscope").
+
+``monitor.perf`` (PR 6) attributes wall time to whole compiled programs:
+MFU, roofline bound, achieved-vs-optimal.  The next perf arc — the
+mega-kernelized decode layer (ROADMAP item 4) — needs to see *inside*
+those programs: which fusions XLA actually emitted, what each must read
+and compute, and therefore which fusion is the next rewrite target.
+This module parses the optimized HLO text (``compiled.as_text()``,
+captured on the same one-per-signature AOT path the perf hook already
+pays) into a per-instruction table with flops/bytes estimated from the
+shape algebra, and ranks the entry computation's instructions — the
+units XLA dispatches as kernels/thunks — by their roofline-model time.
+
+Estimation model (attribution, not accounting):
+
+- **flops** from opcode + shapes: ``dot`` = 2·|out|·K (K = product of
+  the lhs contracting dims), elementwise = |out|, ``reduce`` = |inputs|,
+  ``convolution`` = 2·|out|·(kernel elements / output features),
+  ``fusion``/``call`` = the called computation's total.  ``while``/
+  ``conditional`` bodies have unknowable static trip counts and count 0
+  (flagged via ``estimated=False`` rows); ``custom-call`` likewise.
+- **bytes** = operand bytes + result bytes at the instruction boundary.
+  For a fusion that is exactly its HBM traffic (internals stay in
+  registers/VMEM) — the number the roofline wants.
+
+Dialect tolerance: jax 0.4.x prints ``%name = f32[8]{1,0} op(f32[8]
+%operand)``; newer jax/XLA drop the ``%`` sigils and the inline operand
+types.  The parser resolves operand shapes through a per-computation
+symbol table instead of trusting inline types, so both dialects (and
+mixtures) parse to the same numbers — pinned by golden-text fixtures in
+tests/test_hlo.py.  Anything unparseable degrades to 'unavailable'
+(``HloParseError`` at parse level, an unavailable record at capture
+level) — never garbage numbers, the PR-6 degradation contract.
+
+Gate/import contract (shared with the rest of monitor): stdlib-only,
+never imports jax; text arrives from callers that already hold the
+compiled object, and capture happens only on the PTPU_PERF AOT path.
+
+Exported metrics: ``perf/hlo_ops{fn}`` (entry instructions dispatched),
+``perf/fusions{fn}`` (fusion instructions in the entry computation).
+"""
+from __future__ import annotations
+
+import os
+import re
+import threading
+
+__all__ = [
+    "HloParseError", "HloInstr", "HloComputation", "HloProgram",
+    "parse_hlo", "analyze", "capture", "get", "labels", "report",
+    "reset",
+]
+
+
+UNAVAILABLE = "unavailable"
+
+
+class HloParseError(ValueError):
+    """The text is not HLO this parser understands (new dialect, MLIR
+    bytecode, garbage).  Callers degrade to 'unavailable'."""
+
+
+# -- shapes -----------------------------------------------------------------
+
+# bytes per element; sub-byte types keep fractional sizes (totals round)
+_DTYPE_BYTES = {
+    "pred": 1, "s2": 0.25, "u2": 0.25, "s4": 0.5, "u4": 0.5,
+    "s8": 1, "u8": 1, "s16": 2, "u16": 2, "s32": 4, "u32": 4,
+    "s64": 8, "u64": 8, "f16": 2, "bf16": 2, "f32": 4, "f64": 8,
+    "c64": 8, "c128": 16, "token": 0, "opaque": 0,
+}
+
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,\s]*)\](?:\{[^}]*\})?")
+
+
+def _dtype_bytes(dtype: str) -> float:
+    if dtype in _DTYPE_BYTES:
+        return _DTYPE_BYTES[dtype]
+    if dtype.startswith("f8"):      # f8e4m3fn / f8e5m2 / ...
+        return 1.0
+    return 0.0                      # unknown layout-only type
+
+
+class _Shape:
+    __slots__ = ("elems", "bytes")
+
+    def __init__(self, elems: float, nbytes: float):
+        self.elems = elems
+        self.bytes = nbytes
+
+
+def _parse_shape(text: str) -> "_Shape | None":
+    """One shape (`f32[8,16]{1,0}`) or a tuple of them; None when `text`
+    contains no shape syntax at all."""
+    total_e = total_b = 0.0
+    seen = False
+    for m in _SHAPE_RE.finditer(text):
+        seen = True
+        dims = [int(d) for d in m.group(2).replace(" ", "").split(",")
+                if d]
+        elems = 1.0
+        for d in dims:
+            elems *= d
+        total_e += elems
+        total_b += elems * _dtype_bytes(m.group(1))
+    return _Shape(total_e, total_b) if seen else None
+
+
+def _dims_of(text: str) -> tuple:
+    m = _SHAPE_RE.search(text)
+    if m is None:
+        return ()
+    return tuple(int(d) for d in m.group(2).replace(" ", "").split(",")
+                 if d)
+
+
+# -- instruction / computation model ----------------------------------------
+
+class HloInstr:
+    __slots__ = ("name", "opcode", "shape_text", "shape", "operands",
+                 "attrs", "op_name", "calls", "is_root")
+
+    def __init__(self, name, opcode, shape_text, operands, attrs,
+                 is_root):
+        self.name = name
+        self.opcode = opcode
+        self.shape_text = shape_text
+        self.shape = _parse_shape(shape_text) or _Shape(0.0, 0.0)
+        self.operands = operands          # resolved operand NAMES
+        self.attrs = attrs
+        self.is_root = is_root
+        m = re.search(r'op_name="([^"]*)"', attrs)
+        self.op_name = m.group(1) if m else None
+        m = re.search(r"(?:calls|to_apply)=%?([\w.\-]+)", attrs)
+        self.calls = m.group(1) if m else None
+
+
+class HloComputation:
+    __slots__ = ("name", "instrs", "is_entry", "symtab")
+
+    def __init__(self, name, is_entry):
+        self.name = name
+        self.is_entry = is_entry
+        self.instrs: list = []
+        self.symtab: dict = {}            # instr name -> HloInstr
+
+    def add(self, instr: HloInstr):
+        self.instrs.append(instr)
+        self.symtab[instr.name] = instr
+
+
+class HloProgram:
+    __slots__ = ("module", "computations", "entry")
+
+    def __init__(self, module):
+        self.module = module
+        self.computations: dict = {}      # name -> HloComputation
+        self.entry: "HloComputation | None" = None
+
+
+# one line: `[ROOT ]%name = <shape> opcode(<operands>)[, attrs]`
+_INSTR_HEAD_RE = re.compile(r"^\s*(ROOT\s+)?%?([\w.\-/]+)\s*=\s*(.*)$")
+# computation header: `[ENTRY ]%name [(params)] [-> shape] {`
+_COMP_RE = re.compile(
+    r"^\s*(ENTRY\s+)?%?([\w.\-]+)\s*(\(.*\))?\s*(->\s*[^{]*)?\{\s*$")
+_OPCODE_RE = re.compile(r"([\w\-]+)")
+
+
+def _scan_call(text: str):
+    """Split `opcode(operands)attrs` with paren-depth matching (operand
+    types may themselves contain tuple parens)."""
+    m = _OPCODE_RE.match(text)
+    if m is None:
+        return None
+    opcode = m.group(1)
+    rest = text[m.end():].lstrip()
+    if not rest.startswith("("):
+        return opcode, "", rest
+    depth = 0
+    for i, ch in enumerate(rest):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                return opcode, rest[1:i], rest[i + 1:]
+    raise HloParseError(f"unbalanced operand parens in {text[:60]!r}")
+
+
+def _operand_names(operands: str) -> list:
+    """Trailing identifier of each top-level comma segment — works for
+    `f32[8]{0} %x` (0.4.x) and bare `x` (newer) alike."""
+    out, depth, seg = [], 0, []
+    for ch in operands + ",":
+        if ch == "," and depth == 0:
+            s = "".join(seg).strip()
+            if s:
+                m = re.search(r"%?([\w.\-/]+)\s*$", s)
+                if m:
+                    out.append(m.group(1))
+            seg = []
+            continue
+        if ch in "({[":
+            depth += 1
+        elif ch in ")}]":
+            depth -= 1
+        seg.append(ch)
+    return out
+
+
+def parse_hlo(text: str) -> HloProgram:
+    """Parse optimized HLO text into an :class:`HloProgram`.  Raises
+    :class:`HloParseError` when the text has no recognizable module/
+    entry structure; individual odd lines inside a recognized module are
+    skipped (forward compatibility beats completeness here)."""
+    if not isinstance(text, str) or "HloModule" not in text:
+        raise HloParseError("no HloModule header")
+    prog = None
+    current = None
+    for raw in text.splitlines():
+        line = raw.rstrip()
+        if not line.strip() or line.lstrip().startswith("//"):
+            continue
+        if line.lstrip().startswith("HloModule"):
+            parts = line.split()
+            prog = HloProgram(parts[1].rstrip(",") if len(parts) > 1
+                              else "<unnamed>")
+            continue
+        if prog is None:
+            continue
+        stripped = line.strip()
+        if stripped == "}":
+            current = None
+            continue
+        if current is None:
+            cm = _COMP_RE.match(line)
+            if cm and "=" not in line.split("(", 1)[0]:
+                current = HloComputation(cm.group(2),
+                                         bool(cm.group(1)))
+                prog.computations[current.name] = current
+                if current.is_entry:
+                    prog.entry = current
+            continue
+        im = _INSTR_HEAD_RE.match(line)
+        if im is None:
+            continue
+        rhs = im.group(3)
+        # result shape: a tuple `( ... )` or a plain shape prefix
+        if rhs.startswith("("):
+            depth, end = 0, None
+            for i, ch in enumerate(rhs):
+                if ch == "(":
+                    depth += 1
+                elif ch == ")":
+                    depth -= 1
+                    if depth == 0:
+                        end = i + 1
+                        break
+            if end is None:
+                continue
+            shape_text, call_text = rhs[:end], rhs[end:].lstrip()
+        else:
+            sm = _SHAPE_RE.match(rhs)
+            if sm is None:
+                continue               # tolerated odd line
+            shape_text, call_text = sm.group(0), rhs[sm.end():].lstrip()
+        scanned = _scan_call(call_text)
+        if scanned is None:
+            continue
+        opcode, operands, attrs = scanned
+        current.add(HloInstr(im.group(2), opcode, shape_text,
+                             _operand_names(operands), attrs,
+                             bool(im.group(1))))
+    if prog is None or prog.entry is None or not prog.entry.instrs:
+        raise HloParseError("no ENTRY computation found")
+    return prog
+
+
+# -- flops / bytes algebra --------------------------------------------------
+
+_ZERO_FLOP = frozenset((
+    "parameter", "constant", "copy", "copy-start", "copy-done",
+    "reshape", "bitcast", "bitcast-convert", "transpose", "broadcast",
+    "slice", "dynamic-slice", "dynamic-update-slice", "concatenate",
+    "gather", "pad", "tuple", "get-tuple-element", "iota", "convert",
+    "reverse", "after-all", "partition-id", "replica-id", "rng",
+    "rng-bit-generator", "domain", "optimization-barrier",
+))
+_NO_BYTES = frozenset(("parameter", "constant", "tuple",
+                       "get-tuple-element", "bitcast", "after-all"))
+_UNKNOWN_COST = frozenset(("custom-call", "while", "conditional",
+                           "infeed", "outfeed", "send", "recv",
+                           "all-reduce", "all-gather", "reduce-scatter",
+                           "all-to-all", "collective-permute", "fft",
+                           "cholesky", "triangular-solve", "sort"))
+
+
+def _operand_shape(instr, comp, i) -> "_Shape | None":
+    if i >= len(instr.operands):
+        return None
+    dep = comp.symtab.get(instr.operands[i])
+    # 0.4.x inline types are a fallback when the name is out of scope
+    if dep is not None:
+        return dep.shape
+    return None
+
+
+def _contracting_elems(instr, comp) -> float:
+    m = re.search(r"lhs_contracting_dims={([0-9,\s]*)}", instr.attrs)
+    lhs = comp.symtab.get(instr.operands[0]) if instr.operands else None
+    if m is None or lhs is None:
+        return 0.0
+    dims = _dims_of(lhs.shape_text)
+    k = 1.0
+    for idx in (int(d) for d in m.group(1).replace(" ", "").split(",")
+                if d):
+        if idx < len(dims):
+            k *= dims[idx]
+    return k
+
+
+def _instr_flops(instr, comp, prog, comp_flops,
+                 _stack=None) -> "tuple[float, bool]":
+    """(flops, estimated) — estimated=False marks opcodes whose static
+    cost is unknowable (while bodies, custom calls): their 0 is a floor,
+    not a claim."""
+    op = instr.opcode
+    if op in _ZERO_FLOP:
+        return 0.0, True
+    if op in _UNKNOWN_COST:
+        return 0.0, False
+    if op in ("fusion", "call"):
+        if instr.calls and instr.calls in prog.computations:
+            return _computation_flops(prog, instr.calls, comp_flops,
+                                      _stack)
+        return 0.0, False
+    if op == "dot":
+        k = _contracting_elems(instr, comp)
+        if k <= 0:
+            return 2.0 * instr.shape.elems, False
+        return 2.0 * instr.shape.elems * k, True
+    if op == "convolution":
+        kern = _operand_shape(instr, comp, 1)
+        out_dims = _dims_of(instr.shape_text)
+        if kern is None or not out_dims:
+            return 0.0, False
+        # io convention: last kernel dim is output features — an
+        # estimate; dim_labels parsing is not worth its fragility here
+        feats = max(out_dims[-1], 1)
+        return 2.0 * instr.shape.elems * kern.elems / feats, True
+    if op.startswith("reduce"):
+        total = 0.0
+        n_in = max(1, len(instr.operands) // 2)   # inputs then inits
+        for i in range(n_in):
+            s = _operand_shape(instr, comp, i)
+            total += s.elems if s else 0.0
+        return (total, True) if total else (instr.shape.elems, True)
+    if op == "scatter":
+        upd = _operand_shape(instr, comp, 2)
+        return (upd.elems if upd else instr.shape.elems), True
+    # everything else: elementwise-ish, one flop per output element
+    return instr.shape.elems, True
+
+
+def _computation_flops(prog, name, memo, _stack=None):
+    if name in memo:
+        return memo[name]
+    if _stack is None:
+        _stack = set()
+    if name in _stack:      # defensive: a cyclic call graph (malformed
+        # text) must bail out, not blow the recursion limit — _stack
+        # threads through _instr_flops so nested calls share it
+        return 0.0, False
+    _stack.add(name)
+    comp = prog.computations[name]
+    total, est = 0.0, True
+    for instr in comp.instrs:
+        f, e = _instr_flops(instr, comp, prog, memo, _stack)
+        total += f
+        est = est and e
+    _stack.discard(name)
+    memo[name] = (total, est)
+    return total, est
+
+
+def _instr_bytes(instr, comp) -> float:
+    """Boundary traffic: operands + result.  Parameters/constants cost
+    nothing themselves — their bytes are charged to their consumers."""
+    if instr.opcode in _NO_BYTES:
+        return 0.0
+    total = instr.shape.bytes
+    for i in range(len(instr.operands)):
+        s = _operand_shape(instr, comp, i)
+        if s is not None:
+            total += s.bytes
+    return total
+
+
+# -- per-program analysis ---------------------------------------------------
+
+_SKIP_IN_OPS = frozenset(("parameter", "constant", "get-tuple-element",
+                          "tuple"))
+
+
+def analyze(text: str) -> dict:
+    """Parse + cost the entry computation.  Returns::
+
+        {"available": True, "module": ..., "ops": N, "fusions": N,
+         "computations": N, "flops": total, "bytes": total,
+         "table": [{"name", "opcode", "flops", "bytes", "estimated",
+                    "op_name"}, ...]}   # every entry instr, unranked
+
+    Raises :class:`HloParseError` for unparseable text — ``capture``
+    turns that into an unavailable record."""
+    prog = parse_hlo(text)
+    comp_flops: dict = {}
+    table = []
+    tot_f = tot_b = 0.0
+    fusions = 0
+    for instr in prog.entry.instrs:
+        if instr.opcode in _SKIP_IN_OPS:
+            continue
+        f, est = _instr_flops(instr, prog.entry, prog, comp_flops)
+        b = _instr_bytes(instr, prog.entry)
+        tot_f += f
+        tot_b += b
+        if instr.opcode == "fusion":
+            fusions += 1
+        table.append({
+            "name": instr.name,
+            "opcode": instr.opcode,
+            "flops": f,
+            "bytes": b,
+            "estimated": est,
+            "op_name": instr.op_name,
+        })
+    return {
+        "available": True,
+        "module": prog.module,
+        "ops": len(table),
+        "fusions": fusions,
+        "computations": len(prog.computations),
+        "flops": tot_f,
+        "bytes": tot_b,
+        "table": table,
+    }
+
+
+# -- capture / store --------------------------------------------------------
+
+def _registry():
+    from . import get_registry
+
+    return get_registry()
+
+
+_store: dict = {}
+_store_lock = threading.Lock()
+
+
+def _max_bytes() -> int:
+    try:
+        return int(os.environ.get("PTPU_HLO_MAX_BYTES",
+                                  str(16 * 2**20)))
+    except ValueError:
+        return 16 * 2**20
+
+
+def capture(label: str, text) -> dict:
+    """Analyze `text` for `label` and export the per-program gauges.
+    NEVER raises: unparseable/oversized text stores an unavailable
+    record (the PR-6 degradation contract) and counts a capture error.
+    Called from ``perf.capture`` on the one-per-signature AOT path."""
+    m = _registry()
+    if isinstance(text, str) and len(text) > _max_bytes():
+        result = {"available": False,
+                  "error": f"hlo text {len(text)} bytes > "
+                           f"PTPU_HLO_MAX_BYTES"}
+    else:
+        try:
+            result = analyze(text)
+        except Exception as e:   # HloParseError is the typed path, but
+            # the contract is NEVER raising: an unforeseen dialect that
+            # trips the parser some other way must degrade identically
+            # (perf.capture sits on the hot AOT path — a parser bug must
+            # not make a previously-working compile uncallable)
+            result = {"available": False,
+                      "error": f"{type(e).__name__}: {e}"}
+            m.counter("perf/capture_errors",
+                      "failed analysis/probe captures").labels(
+                site="hlo_parse").inc()
+    with _store_lock:
+        _store[label] = result
+    if result["available"]:
+        m.gauge("perf/hlo_ops",
+                "instructions in the entry computation (dispatched "
+                "kernels/thunks)").labels(fn=label).set(result["ops"])
+        m.gauge("perf/fusions",
+                "fusion instructions in the entry computation").labels(
+            fn=label).set(result["fusions"])
+    return result
+
+
+def get(label: str) -> "dict | None":
+    with _store_lock:
+        return _store.get(label)
+
+
+def labels() -> list:
+    with _store_lock:
+        return sorted(_store)
+
+
+def reset():
+    with _store_lock:
+        _store.clear()
+
+
+# -- report -----------------------------------------------------------------
+
+def _fmt_count(v) -> str:
+    for cut, suf in ((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "K")):
+        if v >= cut:
+            return f"{v / cut:.2f}{suf}"
+    return f"{v:.0f}"
+
+
+def report(label: str, top: int = 10) -> str:
+    """Ranked per-instruction table for one captured program: entry
+    instructions by roofline-model time (max of compute and bandwidth
+    bounds on the current chip_spec), top-k shown.  '' when the label
+    was never captured; an 'unavailable' line when its text did not
+    parse."""
+    rec = get(label)
+    if rec is None:
+        return ""
+    if not rec.get("available"):
+        return (f"hlo[{label}]: {UNAVAILABLE} "
+                f"({rec.get('error', 'no analysis')})")
+    from . import perf as _perf
+
+    chip = _perf.chip_spec()
+
+    def cost_s(row):
+        return max(row["flops"] / chip.peak_flops,
+                   row["bytes"] / chip.hbm_bw)
+
+    rows = sorted(rec["table"], key=lambda r: -cost_s(r))
+    total_s = sum(cost_s(r) for r in rows) or 1.0
+    lines = [
+        f"hlo[{label}] module={rec['module']}: {rec['ops']} ops, "
+        f"{rec['fusions']} fusions, {rec['computations']} computations, "
+        f"{_fmt_count(rec['flops'])}F {_fmt_count(rec['bytes'])}B",
+        f"  {'instruction':32s} {'opcode':20s} {'flops':>8s} "
+        f"{'bytes':>8s} {'est_us':>8s} {'share':>6s}",
+    ]
+    for r in rows[:top]:
+        t = cost_s(r)
+        name = r["name"][:32]
+        mark = "" if r["estimated"] else "?"
+        lines.append(
+            f"  {name:32s} {r['opcode'][:20]:20s} "
+            f"{_fmt_count(r['flops']):>8s} {_fmt_count(r['bytes']):>8s} "
+            f"{t * 1e6:8.2f} {t / total_s * 100:5.1f}%{mark}")
+        if r["op_name"]:
+            lines.append(f"      {r['op_name'][:72]}")
+    if len(rows) > top:
+        lines.append(f"  ... {len(rows) - top} more instructions")
+    return "\n".join(lines)

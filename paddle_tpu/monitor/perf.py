@@ -52,8 +52,8 @@ from collections import OrderedDict
 __all__ = [
     "enabled", "enable", "refresh", "chip_spec", "ChipSpec",
     "normalize_cost_analysis", "capture", "observe", "observe_segment",
-    "segment", "measure", "records", "get", "report", "reset",
-    "UNAVAILABLE",
+    "segment", "measure", "records", "get", "report", "hlo_report",
+    "reset", "UNAVAILABLE",
 ]
 
 UNAVAILABLE = "unavailable"
@@ -420,11 +420,15 @@ def get(label: str):
 
 
 def reset():
-    """Drop every record and zero the MFU totals (tests)."""
+    """Drop every record (incl. captured HLO analyses) and zero the MFU
+    totals (tests)."""
     with _rec_lock:
         _records.clear()
         _totals["flops"] = 0.0
         _totals["wall_s"] = 0.0
+    from . import hlo as _hlo
+
+    _hlo.reset()
 
 
 # -- capture / observe ------------------------------------------------------
@@ -501,6 +505,23 @@ def capture(label, lowered=None, compiled=None, cost=None, memory=None):
         m.gauge("perf/hbm_headroom",
                 "chip HBM / compile-time peak bytes").labels(
             fn=label).set(chip.hbm_bytes / pk)
+    # ISSUE 12: HLO-level kernel attribution off the SAME executable this
+    # signature's one AOT compile already produced — text only, parsed by
+    # the stdlib hlo module; any failure degrades to an unavailable
+    # record (counted), never a broken capture
+    if _enabled and compiled is not None:
+        from . import hlo as _hlo
+
+        text = None
+        try:
+            text = compiled.as_text()
+        except Exception:   # ptpu-check[silent-except]: as_text support varies by
+            # backend/jax version; the program-level analyses above stand
+            m.counter("perf/capture_errors",
+                      "failed analysis/probe captures").labels(
+                site="hlo_text").inc()
+        if text is not None:
+            _hlo.capture(label, text)
     _ensure_overall_gauge()
     return rec
 
@@ -726,3 +747,29 @@ def report(top: int = 30) -> str:
         lines.append(f"  worst achieved-vs-optimal: {worst[0]} "
                      f"({worst[1]:.3f} of roofline)")
     return "\n".join(lines)
+
+
+def hlo_report(fn=None, top: int = 10) -> str:
+    """The program microscope (ISSUE 12): per-instruction attribution of
+    a captured program's optimized HLO — top-k entry instructions (the
+    units XLA dispatches) ranked by roofline-model time, fusions called
+    out with their estimated flops/bytes.
+
+    ``fn`` may be a perf-record label string, a ``jit.CompiledFunction``
+    (its perf label is used), any callable (``__name__``), or None for
+    every captured program concatenated.  Programs are captured on the
+    same PTPU_PERF AOT path as the cost analyses; a program whose HLO
+    text failed to parse renders as 'unavailable' — never invented
+    numbers."""
+    from . import hlo as _hlo
+
+    if fn is None:
+        parts = [_hlo.report(lb, top=top) for lb in _hlo.labels()]
+        return "\n".join(p for p in parts if p)
+    if isinstance(fn, str):
+        label = fn
+    elif hasattr(fn, "_perf_label"):
+        label = fn._perf_label()
+    else:
+        label = getattr(fn, "__name__", str(fn))
+    return _hlo.report(label, top=top)

@@ -13,7 +13,15 @@ the process's metrics and traces while it runs:
 - ``GET /flight/latest`` — the newest flight-recorder dump in
   ``PTPU_FLIGHT_DIR`` (404 when none) — how the fleet aggregator
   harvests a stalled replica's post-mortem while the main thread hangs
-  (this endpoint runs on the daemon http thread).
+  (this endpoint runs on the daemon http thread);
+- ``GET /profile?secs=N`` — on-demand device profiling (ISSUE 12): runs
+  a ``jax.profiler`` trace capture for N seconds (default 1, clamped to
+  120) and returns the dump directory as a zip (perfetto/tensorboard-
+  loadable xplane protos).  Single-flight: a capture already in
+  progress answers a loud 409; a backend without profiler support
+  answers a clean 501 (warned once, never a crash).  Runs on the http
+  daemon thread, so a fleet aggregator can pull a trace from a slow
+  replica without restarting it.
 
 Launch: ``monitor.start_server(port)`` (port 0 = ephemeral; the chosen
 port is on the returned server), or ``EngineConfig(metrics_port=...)``.
@@ -41,8 +49,10 @@ _started_at = time.monotonic()
 
 # -- identity ---------------------------------------------------------------
 # /healthz schema: version bumped whenever keys are added (never removed/
-# renamed — the PR-5 endpoint consumers stay byte-compatible)
-SCHEMA_VERSION = 2
+# renamed — the PR-5 endpoint consumers stay byte-compatible).  v3 adds
+# the process-identity gauges (rss_bytes, open_fds) the fleet router's
+# load-aware dispatch wants.
+SCHEMA_VERSION = 3
 
 _identity_override = {}
 
@@ -75,21 +85,144 @@ def identity() -> dict:
     return out
 
 
+def _rss_bytes():
+    """Resident set size — /proc on linux, peak-RSS rusage fallback
+    elsewhere; None when neither answers (fields are omitted, not
+    null)."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+        import sys
+
+        # peak, not current — documented best-effort fallback.
+        # ru_maxrss units differ per platform: KiB on linux, BYTES on
+        # macOS — the one platform that always takes this branch
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return rss if sys.platform == "darwin" else rss * 1024
+    except (ImportError, OSError, ValueError):
+        return None
+
+
+def _open_fds():
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:
+        return None
+
+
+# -- on-demand device profiling (ISSUE 12) ----------------------------------
+
+class ProfilerUnavailable(RuntimeError):
+    """This process cannot capture a device profile (no jax, or the
+    backend's profiler refused) — the endpoint answers 501."""
+
+
+# single-flight: jax.profiler supports ONE trace session per process;
+# a second concurrent capture must 409, not corrupt the first
+_profile_flight = threading.Lock()
+
+
+def _capture_profile(secs: float) -> bytes:
+    """Run a ``jax.profiler`` trace capture for `secs` seconds and
+    return the dump directory zipped (xplane protos + any tool data —
+    the artifact perfetto/tensorboard load).  Raises
+    :class:`ProfilerUnavailable` where the profiler cannot run; the
+    caller owns the single-flight lock."""
+    import io
+    import shutil
+    import tempfile
+    import zipfile
+
+    try:
+        import jax
+    except Exception as e:   # headless monitor process: no jax at all
+        raise ProfilerUnavailable(f"jax unavailable: {e!r}")
+    d = tempfile.mkdtemp(prefix="ptpu_profile_")
+    try:
+        try:
+            jax.profiler.start_trace(d)
+        except Exception as e:
+            raise ProfilerUnavailable(f"start_trace failed: {e!r}")
+        try:
+            time.sleep(secs)
+        finally:
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:   # a torn session leaves no artifact
+                raise ProfilerUnavailable(f"stop_trace failed: {e!r}")
+        buf = io.BytesIO()
+        n = 0
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+            for root, _dirs, files in os.walk(d):
+                for fn in sorted(files):
+                    p = os.path.join(root, fn)
+                    z.write(p, os.path.relpath(p, d))
+                    n += 1
+        if n == 0:
+            raise ProfilerUnavailable("profiler produced no artifact")
+        return buf.getvalue()
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "ptpu-monitor/2"
 
-    def _send(self, code: int, body: str, ctype: str):
-        data = body.encode("utf-8")
+    def _send(self, code: int, body, ctype: str, extra_headers=()):
+        data = body if isinstance(body, bytes) else body.encode("utf-8")
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
+        for k, v in extra_headers:
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(data)
+
+    def _do_profile(self, query: str):
+        import urllib.parse
+        import warnings
+
+        qs = urllib.parse.parse_qs(query)
+        try:
+            secs = float(qs.get("secs", ["1"])[0])
+        except ValueError:
+            self._send(400, json.dumps(
+                {"error": "secs must be a number"}), "application/json")
+            return
+        secs = min(max(secs, 0.05), 120.0)
+        if not _profile_flight.acquire(blocking=False):
+            self._send(409, json.dumps(
+                {"error": "profile capture already in flight"}),
+                "application/json")
+            return
+        try:
+            body = _capture_profile(secs)
+        except ProfilerUnavailable as e:
+            warnings.warn(f"/profile: device profiling unavailable: {e}")
+            self._send(501, json.dumps(
+                {"error": str(e)}), "application/json")
+            return
+        except Exception as e:   # capture blew up mid-way: truthfully 500
+            self._send(500, json.dumps({"error": repr(e)}),
+                       "application/json")
+            return
+        finally:
+            _profile_flight.release()
+        self._send(200, body, "application/zip", extra_headers=(
+            ("Content-Disposition",
+             f'attachment; filename="ptpu_profile_{os.getpid()}.zip"'),))
 
     def do_GET(self):   # noqa: N802 (http.server API)
         from . import enabled, export_prometheus, flight, trace
 
-        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        raw = self.path
+        query = raw.split("?", 1)[1] if "?" in raw else ""
+        path = raw.split("?", 1)[0].rstrip("/") or "/"
         routes = getattr(self.server, "routes", None)
         if routes and path in routes:
             try:
@@ -114,8 +247,18 @@ class _Handler(BaseHTTPRequestHandler):
                 "monitor_enabled": enabled(),
                 "trace_enabled": trace.enabled(),
             }
+            # process-identity gauges (schema v3): what the fleet
+            # router's load-aware dispatch reads alongside queue depth
+            rss = _rss_bytes()
+            if rss is not None:
+                doc["rss_bytes"] = rss
+            fds = _open_fds()
+            if fds is not None:
+                doc["open_fds"] = fds
             doc.update(identity())
             self._send(200, json.dumps(doc), "application/json")
+        elif path == "/profile":
+            self._do_profile(query)
         elif path == "/flight/latest":
             p = flight.latest_dump()
             if p is None:
@@ -142,7 +285,8 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/":
             extra = " ".join(sorted(routes)) + " " if routes else ""
             self._send(200, "paddle_tpu monitor: /metrics /healthz "
-                            f"/traces/<id> /flight/latest {extra}\n",
+                            "/traces/<id> /flight/latest "
+                            f"/profile?secs=N {extra}\n",
                        "text/plain; charset=utf-8")
         else:
             self._send(404, "not found\n", "text/plain; charset=utf-8")

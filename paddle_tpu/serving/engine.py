@@ -57,7 +57,13 @@ Monitor wiring (PR-1 StatRegistry): `serving/queue_depth`,
 `serving/block_utilization`, `serving/prefill_tokens`,
 `serving/decode_tokens`, `serving/prefill_tps`, `serving/decode_tps`,
 `serving/preemptions`, `serving/requests_finished`, plus
-`serving/step_time` histograms labeled by phase.
+`serving/step_time` histograms labeled by phase.  ISSUE-12 goodput and
+launch accounting: `serving/kernels_per_step` (distinct compiled
+programs one decode step dispatches — the mega-kernel before/after
+number, flat across batch compositions on the ragged default),
+`serving/padding_waste{kind=rows|tokens}` (padded fraction of the
+fixed-shape decode program), `serving/goodput_tokens_per_s` (generated
+tokens over TOTAL engine step wall time, prefill/idle included).
 
 Observability v2 (monitor.trace): with PTPU_TRACE=1 every request gets a
 trace — root `serving/request` span with `serving/queue_wait`,
@@ -231,6 +237,26 @@ class LLMEngine:
         self._m_attn_impl = m.counter(
             "serving/attention_impl",
             "decode steps served, by attention path")
+        # ISSUE 12 goodput/launch accounting: how many separate compiled
+        # programs one decode step dispatches (the mega-kernel PR's
+        # before/after number — FLAT across batch compositions on the
+        # ragged default), and how much of the fixed-shape decode
+        # program is padding
+        self._m_kernels = m.gauge(
+            "serving/kernels_per_step",
+            "distinct compiled programs dispatched per decode step")
+        pad = m.gauge(
+            "serving/padding_waste",
+            "padded fraction of the fixed-shape decode program")
+        self._m_pad_rows = pad.labels(kind="rows")
+        self._m_pad_toks = pad.labels(kind="tokens")
+        self._m_goodput = m.gauge(
+            "serving/goodput_tokens_per_s",
+            "generated tokens per second of total engine step wall "
+            "time (prefill/idle/scheduling included)")
+        self._wall_s_total = 0.0
+        self._goodput_toks = 0
+        self._launches_this_step = None
         # rid -> trace_id survives release_request (the spans live in the
         # bounded monitor.trace store, not on the request); bounded like
         # that store — entries past it map to evicted traces anyway, and
@@ -449,12 +475,19 @@ class LLMEngine:
         #                      with tracing off (no span ends to beat)
         if monitor.enabled():
             self._m_step.labels(phase=phase).observe(dt)
+            # goodput: generated tokens over TOTAL engine wall time —
+            # decode_tps reads a single step, this reads the serving
+            # story (prefill, scheduling, idle steps all dilute it)
+            self._wall_s_total += dt
             if phase == "prefill":
                 self._m_pre_toks.inc(toks)
                 self._m_pre_tps.set(toks / max(dt, 1e-9))
             elif phase == "decode":
                 self._m_dec_toks.inc(toks)
                 self._m_dec_tps.set(toks / max(dt, 1e-9))
+                self._goodput_toks += toks
+            self._m_goodput.set(
+                self._goodput_toks / max(self._wall_s_total, 1e-9))
             sched = self.scheduler
             # queue_depth: admission backlog (never-started requests);
             # waiting: everything not running, preempted included
@@ -543,6 +576,11 @@ class LLMEngine:
         perf_on = mperf.enabled()
         t0 = time.perf_counter() if perf_on else 0.0
         n = len(rows)
+        mon = monitor.enabled()
+        # launch accounting (ISSUE 12): every jitted dispatch this step
+        # records its cache key; the gauge is the LIVE twin of the
+        # BENCH_NOTES round-2 hand count — len() only, never iterated
+        self._launches_this_step = set() if mon else None
         ragged = self.attention_impl == "ragged"
         # ragged: ONE fixed shape (max_num_seqs) serves every batch
         # composition — no per-bucket recompiles when the running-request
@@ -571,12 +609,16 @@ class LLMEngine:
             mperf.observe_segment("decode", "prep", t1 - t0)
         if ragged:
             fn = self._get_ragged_exec(bb, 1)
+            if mon:
+                self._launches_this_step.add(("ragged", bb, 1))
             logits, kv_out = fn(self._param_arrays(), self._kv_flat(),
                                 jnp.asarray(toks), jnp.asarray(pos0),
                                 jnp.asarray(lens), jnp.asarray(tables),
                                 jnp.asarray(slots))
         else:
             fn = self._get_chunk_exec(bb, 1)
+            if mon:
+                self._launches_this_step.add(("chunk", bb, 1))
             logits, kv_out = fn(self._param_arrays(), self._kv_flat(),
                                 jnp.asarray(toks), jnp.asarray(pos0),
                                 jnp.asarray(tables), jnp.asarray(slots))
@@ -586,6 +628,19 @@ class LLMEngine:
                                   time.perf_counter() - t1)
         self._store_kv(kv_out)
         self._sample_rows(rows, logits)
+        if mon:
+            # padding accounting: bb rows ran, n were real — the
+            # serving-goodput blind spot the ragged fixed-shape program
+            # introduced.  Decode runs C=1, so rows ARE tokens and the
+            # two series carry one value today; they diverge only if a
+            # multi-token decode (speculative verification, ROADMAP
+            # item 1) lands on this path — the schema reserves the
+            # distinction now so consumers never need a migration
+            waste = (bb - n) / max(bb, 1)
+            self._m_pad_rows.set(waste)
+            self._m_pad_toks.set(waste)
+            self._m_kernels.set(len(self._launches_this_step))
+            self._launches_this_step = None
 
     def _sample_rows(self, rows, logits):
         """Sample one token per live row from [B, V] fp32 logits (B may
@@ -607,6 +662,10 @@ class LLMEngine:
             topk[i] = p.top_k
             topp[i] = p.top_p
         fn = self._get_sample_exec(bb)
+        if self._launches_this_step is not None:   # decode-step launch
+            # accounting only; the prefill path samples too but is not
+            # the steady-state loop the kernel count instruments
+            self._launches_this_step.add(("sample", bb))
         toks, new_keys = fn(logits, jnp.asarray(keys), jnp.asarray(ds),
                             jnp.asarray(temp), jnp.asarray(topk),
                             jnp.asarray(topp))
@@ -868,17 +927,58 @@ class LLMEngine:
             bb *= 2
         return min(max(bb, 1), self.scheduler.max_num_seqs)
 
-    def _count_compile(self, kind: str) -> None:
+    # key-tuple field names per program kind — the engine's jit-cache key
+    # IS its compile signature, so the recompile explainer (ISSUE 12)
+    # diffs keys instead of arg signatures
+    _KEY_FIELDS = {"prefill": ("prompt_len",),
+                   "chunk": ("batch", "chunk_len"),
+                   "ragged": ("batch", "chunk_len"),
+                   "sample": ("batch",)}
+
+    def _count_compile(self, kind: str, key=None) -> None:
         """A step-program cache miss: counted as `serving/compiles{kind}`
         AND into the framework-wide `jit/recompiles{fn}` attribution (the
         engine drives jax.jit directly, bypassing jit.CompiledFunction's
-        counter — the bucket-crossing regression test reads this)."""
+        counter — the bucket-crossing regression test reads this).
+
+        With `key` (the jit-cache tuple, not yet inserted), the miss is
+        additionally EXPLAINED when a same-kind program already exists:
+        the first differing key field names the varying axis
+        (`jit/recompile_cause{fn,axis}`, e.g. the bucketed fallback's
+        "batch 4→8" at a bucket crossing), and a breadcrumb lands in the
+        flight ring so post-mortem dumps explain compile storms.  The
+        ragged decode program never varies by batch, so its cause series
+        stays empty across compositions — the regression-tested
+        invariant."""
         self._m_compiles.labels(kind=kind).inc()
-        if monitor.enabled():
-            monitor.counter(
-                "jit/recompiles",
-                "fresh trace+XLA-compile events per function").labels(
-                fn=f"serving:{kind}").inc()
+        if not monitor.enabled():
+            return
+        fname = f"serving:{kind}"
+        monitor.counter(
+            "jit/recompiles",
+            "fresh trace+XLA-compile events per function").labels(
+            fn=fname).inc()
+        if key is None:
+            return
+        prior = [k for k in self._jit_cache if k[0] == kind]
+        if not prior:
+            return   # first program of this kind: a compile, not a RE-compile
+        fields = self._KEY_FIELDS.get(kind, ())
+        best = max(prior, key=lambda k: sum(
+            a == b for a, b in zip(k[1:], key[1:])))
+        diffs = [i for i, (a, b) in enumerate(zip(best[1:], key[1:]))
+                 if a != b]
+        if not diffs:
+            return
+        i = diffs[0]
+        axis = fields[i] if i < len(fields) else f"field{i}"
+        detail = f"{axis} {best[1 + i]}→{key[1 + i]}"
+        monitor.counter(
+            "jit/recompile_cause",
+            "recompiles by the signature axis that varied").labels(
+            fn=fname, axis=axis).inc()
+        monitor.flight.note("jit/recompile", fn=fname, axis=axis,
+                            detail=detail)
 
     def _model_tail(self, params, h):
         """Final LN + tied LM head — the dense path's ln_f arithmetic
@@ -913,7 +1013,7 @@ class LLMEngine:
     def _get_prefill_exec(self, p_len):
         key = ("prefill", p_len)
         if key not in self._jit_cache:
-            self._count_compile("prefill")
+            self._count_compile("prefill", key)
 
             def fn(params, kv_flat, ids, slots):
                 from ..ops.pallas_ops import flash_attention_arrays
@@ -949,7 +1049,7 @@ class LLMEngine:
     def _get_chunk_exec(self, b, c):
         key = ("chunk", b, c)
         if key not in self._jit_cache:
-            self._count_compile("chunk")
+            self._count_compile("chunk", key)
 
             def fn(params, kv_flat, ids, pos0, tables, slots):
                 pos = pos0[:, None] + jnp.arange(c, dtype=jnp.int32)[None]
@@ -994,7 +1094,7 @@ class LLMEngine:
         composition runs."""
         key = ("ragged", b, c)
         if key not in self._jit_cache:
-            self._count_compile("ragged")
+            self._count_compile("ragged", key)
 
             def fn(params, kv_flat, ids, pos0, lens, tables, slots):
                 pos = pos0[:, None] + jnp.arange(c, dtype=jnp.int32)[None]
@@ -1024,7 +1124,7 @@ class LLMEngine:
     def _get_sample_exec(self, b):
         key = ("sample", b)
         if key not in self._jit_cache:
-            self._count_compile("sample")
+            self._count_compile("sample", key)
 
             def row(l, key_, ds, t, k, p):
                 # replicates models.gpt._sample_next on a [1, V] row so a

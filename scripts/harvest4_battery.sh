@@ -61,6 +61,14 @@ cp -f BENCH_LADDER.json /tmp/harvest5/BENCH_LADDER.json 2>/dev/null || true
 summarize
 
 # ---- TIER 4 (diagnostics + long-tail) --------------------------------
+# ISSUE 12 program microscope: on-demand device profiles of the two open
+# perf fronts pulled through the /profile endpoint (artifacts land in
+# /tmp/harvest5/profiles), plus the kernel-count/padding A/B lane
+run profile_endpoint_decode 900 python scripts/profile_capture.py \
+  --config gpt124m_decode --secs 5 --out /tmp/harvest5/profiles
+run profile_endpoint_resnet 1200 python scripts/profile_capture.py \
+  --config resnet50 --secs 5 --out /tmp/harvest5/profiles
+run kernel_count 900 python bench.py --config kernel_count
 run memfit67b 2400 python scripts/memfit67b_tpu.py
 for b in 128 256; do
   for fmt in NHWC NCHW; do

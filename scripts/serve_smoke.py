@@ -37,6 +37,15 @@ against their rooflines and names the worst one, and — combined with
 --trace's live endpoint — /metrics exposes perf_mfu, perf_hbm_headroom
 and per-fn flops/bytes; it prints the ranked attribution table.
 
+--perf additionally asserts the ISSUE-12 "program microscope" surface:
+`serving/kernels_per_step` is populated and stays FLAT across a 3→5
+batch crossing with zero fresh compiles and zero new
+`jit/recompile_cause{fn=serving:*}` entries (the ragged acceptance
+invariant), `serving/padding_waste` + `serving/goodput_tokens_per_s`
+are live, and `perf.hlo_report("decode:step")` names the compiled
+decode program's top fusions with flops/bytes (degrading to
+'unavailable' on backends without `as_text`, never garbage).
+
 tests/test_serving.py runs the plain mode, tests/test_lowbit.py the
 quantized one, tests/test_trace.py + test_perf.py lean on the combined
 --trace --perf invocation (all fast tier), so each is a "does the
@@ -117,11 +126,13 @@ def main():
         print(f"weight-only {args.quantize}: {n_wol} linears packed, "
               f"greedy agreement {agree:.2f} vs fp")
         del dense, qdense
+    # max_num_seqs=8: headroom for the --perf leg's 3→5 batch crossing
+    # (the ISSUE-12 kernels_per_step FLAT assertion needs 5 live rows)
     engine = LLMEngine(model, EngineConfig(
-        block_size=16, max_num_seqs=4, kv_cache_dtype=args.kv_cache_dtype,
+        block_size=16, max_num_seqs=8, kv_cache_dtype=args.kv_cache_dtype,
         metrics_port=0 if args.trace else None))
     if args.kv_cache_dtype:
-        fp = LLMEngine(model, EngineConfig(block_size=16, max_num_seqs=4))
+        fp = LLMEngine(model, EngineConfig(block_size=16, max_num_seqs=8))
         ratio = engine.cache.num_blocks / fp.cache.num_blocks
         assert engine.cache.pool_bytes <= fp.cache.pool_bytes, (
             engine.cache.pool_bytes, fp.cache.pool_bytes)
@@ -157,17 +168,19 @@ def main():
         assert low, "lowbit mode must emit lowbit/* metrics"
         print("lowbit metrics:", ", ".join(low))
     if args.perf:
-        check_perf(engine, snap)
+        check_perf(engine, snap, cfg)
     if args.trace:
         check_trace(engine, snap, len(prompts))
     print("OK")
 
 
-def check_perf(engine, snap):
+def check_perf(engine, snap, cfg):
     """ISSUE 6 acceptance: the decode-segment breakdown is populated, the
     fused-step attribution names a worst segment, and the perf/* surface
-    (segments histogram + per-fn accounting + MFU) is live."""
-    from paddle_tpu.monitor import perf
+    (segments histogram + per-fn accounting + MFU) is live.  Extended by
+    ISSUE 12 with the program-microscope surface (kernels_per_step FLAT
+    across a batch crossing, padding/goodput gauges, hlo_report)."""
+    from paddle_tpu.monitor import hlo, perf
 
     # in-situ decode segments: every decode step reported synced
     # prep/model/sampler times
@@ -208,6 +221,57 @@ def check_perf(engine, snap):
     table = perf.report()
     assert "perf attribution" in table and "decode:model" in table, table
     print(table)
+
+    # ISSUE 12 (a): the program microscope on the live decode program —
+    # decode_breakdown's measure() captured "decode:step" through the
+    # perf AOT path, so its optimized HLO is already parsed
+    an = hlo.get("decode:step")
+    assert an is not None, "decode:step HLO was not captured"
+    if an["available"]:
+        assert an["ops"] > 0 and an["flops"] > 0, an
+        rep = perf.hlo_report("decode:step", top=5)
+        assert "hlo[decode:step]" in rep, rep
+        if an["fusions"]:
+            assert "fusion" in rep, rep
+        print(rep)
+    else:   # backend without as_text: degraded, never garbage
+        assert "unavailable" in perf.hlo_report("decode:step")
+        print("hlo: decode:step analysis unavailable on this backend")
+
+    # ISSUE 12 (b): launch accounting populated by the main run...
+    snap = monitor.snapshot()
+    kern = snap.get("serving/kernels_per_step")
+    assert kern and kern > 0, kern
+    pad = snap.get("serving/padding_waste")
+    assert pad and "kind=rows" in pad and "kind=tokens" in pad, pad
+    good = snap.get("serving/goodput_tokens_per_s")
+    assert good and good > 0, good
+
+    # ...and FLAT across a 3→5 batch crossing: zero fresh compiles, zero
+    # new serving recompile causes, same kernels-per-step (the ragged
+    # fixed-shape invariant; prompt lengths reuse already-compiled
+    # prefill programs so the cause count isolates the decode path)
+    def serving_causes(s):
+        v = s.get("jit/recompile_cause") or {}
+        return sum(n for k, n in sorted(v.items()) if "serving:" in k)
+
+    compiles_before = sum(snap["serving/compiles"].values())
+    causes_before = serving_causes(snap)
+    rng = np.random.RandomState(1)
+    prompts5 = [rng.randint(0, cfg.vocab_size, (n,)).astype(np.int32)
+                for n in (4, 6, 4, 6, 4)]
+    engine.generate(prompts5, SamplingParams(max_new_tokens=4))
+    snap = monitor.snapshot()
+    assert snap.get("serving/kernels_per_step") == kern, (
+        kern, snap.get("serving/kernels_per_step"))
+    d_compiles = sum(snap["serving/compiles"].values()) - compiles_before
+    d_causes = serving_causes(snap) - causes_before
+    assert d_compiles == 0, f"{d_compiles} fresh compiles at the crossing"
+    assert d_causes == 0, f"{d_causes} new serving recompile causes"
+    print(f"kernels_per_step={kern:.0f} FLAT across 3→5 crossing "
+          f"(0 compiles, 0 causes); padding rows="
+          f"{snap['serving/padding_waste']['kind=rows']:.3f}, goodput="
+          f"{snap['serving/goodput_tokens_per_s']:.1f} tok/s")
 
     # live perf gauges ride the same endpoint as the rest of the monitor
     if getattr(engine, "metrics_server", None) is not None:

@@ -464,12 +464,20 @@ class _FakeFleet:
             "r0": "# TYPE serving_decode_tokens counter\n"
                   "serving_decode_tokens 5\n"
                   "# TYPE serving_queue_depth gauge\n"
-                  "serving_queue_depth 2\n",
+                  "serving_queue_depth 2\n"
+                  "# TYPE serving_goodput_tokens_per_s gauge\n"
+                  "serving_goodput_tokens_per_s 42.5\n"
+                  "# TYPE serving_padding_waste gauge\n"
+                  'serving_padding_waste{kind="rows"} 0.375\n'
+                  "# TYPE serving_kernels_per_step gauge\n"
+                  "serving_kernels_per_step 2\n",
             "r1": "# TYPE serving_decode_tokens counter\n"
                   "serving_decode_tokens 7\n",
         }
         self.healthz = {
-            "r0": {"last_activity_age_s": 0.1, "host": "hA", "pid": 11},
+            "r0": {"last_activity_age_s": 0.1, "host": "hA", "pid": 11,
+                   "rss_bytes": 123456, "open_fds": 17,
+                   "uptime_s": 9.5},
             "r1": {"last_activity_age_s": 0.2, "host": "hB", "pid": 22},
         }
         self.down = set()
@@ -582,6 +590,16 @@ def test_snapshot_is_the_router_feed(fake, tmp_path):
     assert snap["r0"]["state"] == "healthy"
     assert snap["r0"]["last_activity_age_s"] == 0.1
     assert snap["r1"]["decode_tokens_per_s"] == 0.0
+    # ISSUE 12: goodput/padding/launch + process-identity signals ride
+    # the router feed; a replica predating them reads None, never KeyError
+    assert snap["r0"]["goodput_tokens_per_s"] == 42.5
+    assert snap["r0"]["padding_waste_rows"] == 0.375
+    assert snap["r0"]["kernels_per_step"] == 2.0
+    assert snap["r0"]["rss_bytes"] == 123456
+    assert snap["r0"]["open_fds"] == 17 and snap["r0"]["uptime_s"] == 9.5
+    for k in ("goodput_tokens_per_s", "padding_waste_rows",
+              "kernels_per_step", "rss_bytes", "open_fds"):
+        assert snap["r1"][k] is None, (k, snap["r1"][k])
 
 
 def test_unmergeable_replica_does_not_stall_fleet_view(fake, tmp_path):

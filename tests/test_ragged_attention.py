@@ -406,10 +406,27 @@ class TestRecompileRegression:
         return (sum(snap.values()) if isinstance(snap, dict)
                 else float(snap))
 
+    @staticmethod
+    def _causes(kind):
+        """Total serving:<kind> recompile-cause increments, by axis."""
+        snap = monitor.counter("jit/recompile_cause").snapshot()
+        if not isinstance(snap, dict):
+            return {}
+        out = {}
+        for k, v in sorted(snap.items()):
+            if f"fn=serving:{kind}" in k and v:
+                axis = [p for p in k.split(",") if
+                        p.startswith("axis=")][0][len("axis="):]
+                out[axis] = out.get(axis, 0) + v
+        return out
+
     def _drive(self, model, prompts, impl):
         """Warm on a batch of 3 (bucketed: bucket 4), then cross the
         power-of-2 boundary with a batch of 5 (bucketed: bucket 8).
-        Returns (compiles during warm, compiles after the crossing)."""
+        Returns (compiles during warm, compiles after the crossing),
+        the jit/recompiles twins, the recompile-cause delta across the
+        crossing (ISSUE 12's explainer), and the kernels_per_step gauge
+        at both compositions."""
         monitor.enable(True)
         try:
             eng = LLMEngine(model, EngineConfig(
@@ -418,6 +435,7 @@ class TestRecompileRegression:
             kind = "ragged" if impl == "ragged" else "chunk"
             jit_child = monitor.counter("jit/recompiles").labels(
                 fn=f"serving:{kind}")
+            kern = monitor.gauge("serving/kernels_per_step")
             # two distinct prompt LENGTHS only, both phases: any compile
             # delta is the decode/sampler programs, not prefill
             warm3 = [prompts[0], prompts[3], prompts[1]]    # lens 3,3,5
@@ -425,25 +443,43 @@ class TestRecompileRegression:
             eng.generate(warm3, sp)
             warm = self._total(eng._m_compiles)
             jit_warm = jit_child.value
+            cause_warm = self._causes(kind)
+            k_warm = kern.value
             eng.generate(cross5, sp)
             after = self._total(eng._m_compiles)
             jit_after = jit_child.value
-            return warm, after, jit_warm, jit_after
+            cause_delta = {
+                a: v - cause_warm.get(a, 0)
+                for a, v in self._causes(kind).items()
+                if v != cause_warm.get(a, 0)}
+            return (warm, after, jit_warm, jit_after, cause_delta,
+                    k_warm, kern.value)
         finally:
             monitor.refresh()
 
     @pytest.mark.slow
     def test_bucket_crossing_flat_on_ragged(self, model, prompts):
-        """ISSUE 8 acceptance: ONE compiled decode program regardless of
-        batch composition.  Crossing a bucket boundary (3 → 5 running
-        rows) adds ZERO compiles on the ragged path — the bucketed path
-        pays fresh decode+sampler programs for the new bucket."""
-        w, a, jw, ja = self._drive(model, prompts, "ragged")
+        """ISSUE 8 acceptance, extended by ISSUE 12: ONE compiled decode
+        program regardless of batch composition.  Crossing a bucket
+        boundary (3 → 5 running rows) adds ZERO compiles on the ragged
+        path, leaves `jit/recompile_cause{fn=serving:*}` EMPTY, and
+        keeps `serving/kernels_per_step` FLAT — while the bucketed path
+        pays fresh decode+sampler programs for the new bucket AND the
+        explainer names the varying axis ("batch")."""
+        w, a, jw, ja, cause, k3, k5 = self._drive(model, prompts,
+                                                  "ragged")
         assert a == w, (w, a)
         assert ja == jw, (jw, ja)
-        w, a, jw, ja = self._drive(model, prompts, "bucketed")
+        assert cause == {}, cause           # nothing to explain
+        assert k3 == k5 == 2.0, (k3, k5)    # decode program + sampler
+        w, a, jw, ja, cause, k3, k5 = self._drive(model, prompts,
+                                                  "bucketed")
         assert a > w, (w, a)
         assert ja > jw, (jw, ja)
+        # the miss is EXPLAINED: the decode program recompiled because
+        # the batch bucket changed (4 → 8)
+        assert cause.get("batch", 0) >= 1, cause
+        assert k3 == k5 == 2.0, (k3, k5)    # count flat; IDENTITY varied
 
 
 class TestDequantPassEliminated:

@@ -63,6 +63,10 @@ g.dryrun_multichip(8)
 print("gates OK")
 EOF
     python bench.py
+    # ISSUE 12 launch-accounting lane: programs-per-decode-step +
+    # padding-waste, self-asserting the 3→5 crossing stays FLAT (lives
+    # here, NOT in fast — tier-1 room is scarce at ~790s of 870s)
+    python bench.py --config kernel_count
     # real-lane history gate: default 7% tolerance, smoke lines skipped
     # (on a chip host the headline is the non-smoke metric and gates;
     # after an outage fallback the smoke line is reported only)
