@@ -729,11 +729,18 @@ def bench_trace_overhead():
     """Observability tax gate (ISSUE 5, extended by ISSUE 6 to the perf
     hooks, ISSUE 11 to the cross-process trace-propagation hooks —
     inject/extract and the rpc header attach share the disabled-path
-    budget — and ISSUE 12 to the launch-accounting/goodput hooks: the
+    budget — ISSUE 12 to the launch-accounting/goodput hooks: the
     engine decode step's per-dispatch launch-set bookkeeping and the
     kernels/padding/goodput gauge writes, whose disabled cost is one
     monitor-gate read; the HLO capture and recompile explainer run only
-    at compile time and add nothing per step): what the
+    at compile time and add nothing per step — and ISSUE 13 to the
+    training-microscope per-step hooks: the StepGuard loss-spike EWMA
+    observe + step-time gauge, the hapi goodput meter's wait/step
+    accounting, the optimizer's lazy grad-norm cell store, and the
+    PTPU_TRAIN_STATS gate read guarding the sampled per-layer
+    reduction; the divergence forensics scan runs only on the bad-step
+    path and the per-layer reduction only on sampled opt-in steps, so
+    neither belongs in the per-step tax): what the
     monitor+trace+perf layers add to a train step, off vs on, asserting
     disabled overhead < 1% and enabled overhead < 5% of the step.  "Enabled" means monitor+trace; PTPU_PERF stays off in both
     measurements — perf mode deliberately syncs every timed call (MFU
@@ -778,6 +785,15 @@ def bench_trace_overhead():
     m_pad_r = m_pad.labels(kind="rows")
     m_pad_t = m_pad.labels(kind="tokens")
     m_good = monitor.gauge("bench/goodput_tokens_per_s")
+    # ISSUE 13 training-microscope per-step objects, constructed once
+    # like StepGuard/Model.fit construct theirs
+    mtrain = monitor.train
+    spike = mtrain.LossSpikeDetector()
+    meter = mtrain.GoodputMeter()
+    m_step_t = monitor.gauge("bench/step_time")
+    grad_cell = [None]
+    fake_grads = [a_args[0]]   # the lazy grad-norm CELL STORE (the
+    # reduction itself runs at scrape time, off the per-step path)
 
     def instr(i):
         # exactly what one instrumented step adds on top of the math:
@@ -789,7 +805,8 @@ def bench_trace_overhead():
         # path's three segment contexts (all dead branches with perf off)
         # — plus the ISSUE-11 propagation hooks: the rpc client's header
         # attach (inject) and the rpc server's header parse (extract),
-        # both one-global-read None paths when tracing is off
+        # both one-global-read None paths when tracing is off — plus
+        # the ISSUE-13 training hooks (see the docstring)
         with mtrace.span("bench/train_step", step=i):
             hdr = mtrace.inject()           # rpc _call header attach
             _ctx = mtrace.extract(hdr)      # rpc _handle header parse
@@ -798,6 +815,9 @@ def bench_trace_overhead():
                 sig = f"nstate=0;{pjit._arg_signature((a_args, {}))}"
                 if sig not in seen:
                     seen.add(sig)
+            # ISSUE 13: the sampled per-layer reduction's disabled path
+            # is exactly this one module-global read in the optimizer
+            _stats_on = mtrain.enabled()
             if monitor.enabled():
                 monitor.counter("optimizer/steps").inc()
                 monitor.gauge("optimizer/lr").set(1e-4)
@@ -811,6 +831,17 @@ def bench_trace_overhead():
                 m_pad_r.set(0.375)
                 m_pad_t.set(0.375)
                 m_good.set(1234.5)
+                # ISSUE 13 per-step training sequence: StepGuard's
+                # step-time gauge + EWMA loss-spike observe, the hapi
+                # goodput meter's wait/step accounting, and the lazy
+                # grad-norm cell store (every step here; the real
+                # optimizer samples it every _GRADNORM_EVERY steps)
+                t0s = time.perf_counter()
+                m_step_t.set(time.perf_counter() - t0s)
+                spike.observe(0.5 + i * 1e-9, step=i)
+                meter.wait(1e-7)
+                meter.step(1e-6, examples=8)
+                grad_cell[0] = list(fake_grads)
             t0 = time.perf_counter() if perf_on else 0.0   # jit hook
             _ = time.perf_counter() if perf_on else 0.0    # decode segs
             with mperf.segment("bench", "forward"):
@@ -819,7 +850,7 @@ def bench_trace_overhead():
                 pass
             with mperf.segment("bench", "optimizer"):
                 pass
-            del t0, _ctx
+            del t0, _ctx, _stats_on
 
     def per_call(n):
         t0 = time.perf_counter()

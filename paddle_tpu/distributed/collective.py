@@ -18,6 +18,7 @@ Groups are named mesh axes, not socket-bootstrapped NCCL communicators
 from __future__ import annotations
 
 import threading
+import time
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -468,9 +469,34 @@ def recv(tensor, src=0, group=None, sync_op=True):
     send(tensor, src, group)
 
 
+def _observe_collective_wall(kind, t0):
+    """Sync-on-exit wall histogram for the HOST-blocking collective
+    boundaries (ISSUE 13 wing d).  Only :func:`barrier` and :func:`wait`
+    qualify: every other collective here lowers to an XLA HLO op inside
+    a compiled program, where the host never blocks per-collective and
+    per-op time is the HLO microscope's job (``perf.hlo_report``) — a
+    host timer around a traced call would measure dispatch, not the
+    wire.  These two sites already block by definition, so timing them
+    adds two clock reads, no new sync."""
+    monitor.histogram(
+        "collective/time",
+        "host-blocked seconds at sync collective boundaries").labels(
+        kind=kind).observe(time.perf_counter() - t0)
+
+
 def barrier(group=None):
+    if monitor.enabled():
+        t0 = time.perf_counter()
+        jnp.zeros(()).block_until_ready()
+        _observe_collective_wall("barrier", t0)
+        return
     jnp.zeros(()).block_until_ready()
 
 
 def wait(tensor, group=None, use_calc_stream=True):
+    if monitor.enabled():
+        t0 = time.perf_counter()
+        tensor.block_until_ready()
+        _observe_collective_wall("wait", t0)
+        return
     tensor.block_until_ready()

@@ -9,6 +9,7 @@ performance path is whole-graph jit (`paddle_tpu.jit.compile`), which
 from __future__ import annotations
 
 import os
+import time
 import warnings
 from typing import List, Optional
 
@@ -18,7 +19,9 @@ from ..core.tensor import Tensor
 from ..framework.io_ import save as _save, load as _load
 from ..io import DataLoader, Dataset
 from ..metric import Metric
+from .. import monitor
 from ..monitor import perf as mperf
+from ..monitor import train as mtrain
 from ..nn.layer import Layer
 from .callbacks import config_callbacks
 
@@ -37,6 +40,20 @@ def _to_tensor(x):
     if isinstance(x, Tensor):
         return x
     return Tensor(np.asarray(x))
+
+
+def _batch_examples(ins) -> int:
+    """Leading-dim example count of a batch's first input — shape
+    metadata only, never a device transfer."""
+    if not ins:
+        return 0
+    shape = getattr(ins[0], "shape", None)
+    if shape is not None and len(shape):
+        return int(shape[0])
+    try:
+        return len(ins[0])
+    except TypeError:
+        return 0
 
 
 class Model:
@@ -223,16 +240,45 @@ class Model:
         self.stop_training = False
         cbks.on_train_begin()
         history = []
+        # input-pipeline goodput (ISSUE 13 wing c): time blocked on the
+        # reader vs in the train step — the training twin of
+        # serving/goodput_tokens_per_s.  With monitor off the loop runs
+        # exactly as before (no meter, no perf_counter calls).
+        meter = mtrain.GoodputMeter() if monitor.enabled() else None
         for epoch in range(epochs):
             cbks.on_epoch_begin(epoch)
             for m in self._metrics:
                 m.reset()
             logs = {}
-            for step, batch in enumerate(train_loader):
+            step = 0
+            it = iter(train_loader)
+            while True:
+                if meter is not None:
+                    t0 = time.perf_counter()
+                    try:
+                        batch = next(it)
+                    except StopIteration:
+                        break
+                    meter.wait(time.perf_counter() - t0)
+                else:
+                    try:
+                        batch = next(it)
+                    except StopIteration:
+                        break
+                t1 = time.perf_counter() if meter is not None else 0.0
                 cbks.on_train_batch_begin(step)
                 ins, labs = self._split_batch(batch)
                 logs = self.train_batch(ins, labs or None)
                 cbks.on_train_batch_end(step, logs)
+                if meter is not None:
+                    # the step bucket spans batch-acquired → loop bottom
+                    # (split, callbacks included), so wait + step really
+                    # is the TOTAL loop wall the goodput divides by; and
+                    # train_batch floats the loss, so the wall includes
+                    # the device step, not just its dispatch
+                    meter.step(time.perf_counter() - t1,
+                               examples=_batch_examples(ins))
+                step += 1
                 if self.stop_training:
                     break
             for m in self._metrics:

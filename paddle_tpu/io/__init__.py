@@ -20,6 +20,8 @@ import numpy as np
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
+from .. import monitor
+import time as _time
 
 __all__ = [
     "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
@@ -431,10 +433,18 @@ class DataLoader:
         # is detected by liveness instead of hanging the trainer
         deadline_ms = int(self.timeout * 1000) if self.timeout else None
         poll_ms = 2000
+        # reader-boundary wait accounting (ISSUE 13 wing c): seconds the
+        # trainer sat blocked on the worker ring per batch — the
+        # per-batch half of train/data_wait_frac
+        wait_h = monitor.histogram(
+            "reader/wait_time",
+            "seconds the consumer blocked on the reader per batch") \
+            if monitor.enabled() else None
         try:
             for i in range(len(batches)):
                 w = i % W
                 waited = 0
+                tw0 = _time.perf_counter() if wait_h is not None else 0.0
                 while True:
                     try:
                         item = queues[w].get(timeout_ms=poll_ms)
@@ -454,6 +464,8 @@ class DataLoader:
                                     f"(exitcode {procs[w].exitcode})") from None
                         if deadline_ms is not None and waited >= deadline_ms:
                             raise
+                if wait_h is not None:
+                    wait_h.observe(_time.perf_counter() - tw0)
                 if (isinstance(item, tuple) and len(item) == 2
                         and isinstance(item[0], str) and item[0] == "__PTPU_ERR__"):
                     raise RuntimeError(f"DataLoader worker {w} failed:\n{item[1]}")
@@ -507,8 +519,17 @@ class DataLoader:
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
+        wait_h = monitor.histogram(
+            "reader/wait_time",
+            "seconds the consumer blocked on the reader per batch") \
+            if monitor.enabled() else None
         while True:
-            item = q.get()
+            if wait_h is not None:
+                tw0 = _time.perf_counter()
+                item = q.get()
+                wait_h.observe(_time.perf_counter() - tw0)
+            else:
+                item = q.get()
             if item is sentinel:
                 if error:
                     raise error[0]

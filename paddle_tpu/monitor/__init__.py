@@ -706,16 +706,23 @@ def STAT_RESET(name):
     _default.gauge(name).set(0)
 
 
-# -- v2: tracing / flight recorder / live endpoint -------------------------
+# -- v2+: tracing / flight recorder / live endpoint / perf attribution /
+# fleet federation / HLO microscope / training microscope ------------------
+# Metric inventory by wing: serving/serving-perf series are documented in
+# perf.py and hlo.py, fleet federation in fleet.py, and the v6 training
+# wings (train/loss*, train/grad_norm{layer}, train/goodput_examples_per_s,
+# train/data_wait_frac, train/step_time, reader/wait_time,
+# collective/time{kind}, resilience/nonfinite{layer,which},
+# fleet/straggler*) in train.py's module docstring.
 # Guarded relative imports: tests load THIS file standalone (spec_from_
 # file_location, no package) to prove the core registry is jax-free; in
 # that mode the v2 submodules — equally stdlib-only — are simply absent.
 try:
-    from . import trace, flight, serve, perf, fleet, hlo  # noqa: E402,F401
+    from . import trace, flight, serve, perf, fleet, hlo, train  # noqa: E402,F401
     from .flight import watchdog                  # noqa: E402,F401
     from .serve import start_server, stop_server  # noqa: E402,F401
 
     __all__ += ["trace", "flight", "serve", "perf", "fleet", "hlo",
-                "watchdog", "start_server", "stop_server"]
+                "train", "watchdog", "start_server", "stop_server"]
 except ImportError:   # standalone module load — core registry only
     pass

@@ -22,8 +22,14 @@ tier (ROADMAP item 2) dispatches and fails over on:
   moment it transitions to stalled or down — one directory of
   post-mortems for a multi-process failure — and answers
   :meth:`FleetAggregator.snapshot` with the per-replica structured
-  stats (queue depth, running/waiting, decode tokens/s, state) a
-  load-aware router consumes.
+  stats (queue depth, running/waiting, decode tokens/s, and the ISSUE-13
+  training keys — step_time, goodput_examples_per_s, data_wait_frac,
+  straggler_skew — plus state) a load-aware router consumes;
+- :class:`StragglerRollup` — cross-rank straggler detection: per-replica
+  ``train/step_time`` ratioed against the fleet median, the slowest rank
+  flagged only after a consecutive-cycle streak
+  (``fleet/straggler_skew``, ``fleet/straggler{replica}``, and the
+  ``straggler`` block on ``/fleet/healthz``).
 
 Activation is opt-in end to end: replicas register only when
 ``PTPU_FLEET_STORE`` is set, aggregation only runs inside an explicitly
@@ -48,7 +54,7 @@ import urllib.request
 
 __all__ = [
     "parse_prometheus", "register_replica", "discover", "FleetAggregator",
-    "REPLICA_KEY_PREFIX", "REPLICA_COUNT_KEY",
+    "StragglerRollup", "REPLICA_KEY_PREFIX", "REPLICA_COUNT_KEY",
 ]
 
 # -- discovery key layout ----------------------------------------------------
@@ -400,6 +406,72 @@ class _Replica:
         self.harvested = []          # harvest file paths, oldest first
 
 
+class StragglerRollup:
+    """Cross-rank straggler detection off per-replica ``train/step_time``
+    gauges (ISSUE 13 wing d) — the signal the multi-replica training
+    tier (ROADMAP item 3's DP fleet) needs that per-process metrics
+    can't carry: *which rank* is dragging the synchronous step.
+
+    Per :meth:`update` of ``{replica: step_seconds}``:
+
+    - ``skews`` — every replica's step time over the fleet MEDIAN (the
+      robust baseline: one straggler can't drag the denominator the way
+      a mean or min-max would);
+    - ``slowest`` / ``skew`` — the worst replica and its ratio;
+    - ``flagged`` — set only after the SAME replica has been slowest
+      with skew above ``threshold`` for ``streak`` consecutive updates
+      (one GC pause or scrape-phase artifact must not nominate a
+      straggler); recovery (skew back under threshold, or a different
+      replica slowest) re-arms the streak.
+
+    Pure host math, mutated only under the owning aggregator's lock;
+    also usable standalone on any ``{rank: seconds}`` dict (tests drive
+    it directly)."""
+
+    __slots__ = ("threshold", "streak_needed", "skews", "slowest", "skew",
+                 "streak", "flagged")
+
+    def __init__(self, threshold: float = 1.5, streak: int = 3):
+        self.threshold = float(threshold)
+        self.streak_needed = max(1, int(streak))
+        self.skews: dict = {}
+        self.slowest = None
+        self.skew = None
+        self.streak = 0
+        self.flagged = None
+
+    def update(self, step_times: "dict[str, float]") -> dict:
+        valid = {k: float(v) for k, v in step_times.items()
+                 if v is not None and v > 0}
+        if len(valid) < 2:   # skew is meaningless without a peer
+            self.skews = {}
+            self.slowest, self.skew, self.streak, self.flagged = (
+                None, None, 0, None)
+            return self.as_dict()
+        vals = sorted(valid.values())
+        mid = len(vals) // 2
+        med = vals[mid] if len(vals) % 2 else \
+            (vals[mid - 1] + vals[mid]) / 2.0
+        self.skews = {k: valid[k] / med for k in sorted(valid)}
+        slowest = max(sorted(valid), key=lambda k: valid[k])
+        skew = self.skews[slowest]
+        if skew > self.threshold:
+            self.streak = self.streak + 1 if slowest == self.slowest \
+                else 1
+            self.flagged = slowest if self.streak >= self.streak_needed \
+                else None
+        else:
+            self.streak = 0
+            self.flagged = None
+        self.slowest, self.skew = slowest, skew
+        return self.as_dict()
+
+    def as_dict(self) -> dict:
+        return {"slowest": self.slowest, "skew": self.skew,
+                "streak": self.streak, "flagged": self.flagged,
+                "skews": dict(self.skews)}
+
+
 class FleetAggregator:
     """Scrape N replica endpoints, federate their metrics, roll health
     up, and harvest post-mortems.
@@ -425,9 +497,13 @@ class FleetAggregator:
     def __init__(self, endpoints=None, store: str = None,
                  interval: float = 2.0, stall_after_s: float = 10.0,
                  down_after: int = 3, harvest_dir: str = None,
-                 scrape_timeout: float = 5.0, fetch=None):
+                 scrape_timeout: float = 5.0, fetch=None,
+                 straggler_threshold: float = 1.5,
+                 straggler_streak: int = 3):
         self._lock = threading.Lock()
         self._replicas: "dict[str, _Replica]" = {}
+        self._straggler = StragglerRollup(threshold=straggler_threshold,
+                                          streak=straggler_streak)
         self.interval = float(interval)
         self.stall_after_s = float(stall_after_s)
         self.down_after = int(down_after)
@@ -601,6 +677,14 @@ class FleetAggregator:
                     self._harvest_seq += 1
                     harvests.append((r.name, r.url, r.state,
                                      self._harvest_seq))
+            # cross-rank straggler rollup (ISSUE 13 wing d): ratio every
+            # replica's train/step_time against the fleet median — only
+            # replicas scraped OK THIS cycle contribute (a dead rank's
+            # stale last reading must not keep it flagged forever)
+            self._straggler.update({
+                name: series_value(parsed, "train_step_time")
+                for name, (parsed, _hz, err) in results.items()
+                if err is None})
             self._cycles += 1
             states = {r.name: r.state for r in self._replicas.values()}
 
@@ -697,6 +781,18 @@ class FleetAggregator:
                       "cycle")
         for name, err in merge_errors.items():
             self._force_set(g.labels(replica=name), 1)
+        with self._lock:
+            strag = self._straggler.as_dict()
+        if strag["skew"] is not None:
+            self._force_set(
+                reg.gauge("fleet/straggler_skew",
+                          "slowest replica's step time over the fleet "
+                          "median"), strag["skew"])
+        if strag["flagged"] is not None:
+            self._force_set(
+                reg.gauge("fleet/straggler",
+                          "1 = replica flagged as the fleet straggler")
+                .labels(replica=strag["flagged"]), 1)
         if merge_errors:
             with self._lock:
                 for name, err in merge_errors.items():
@@ -754,6 +850,15 @@ class FleetAggregator:
                         r.parsed, "serving_padding_waste", kind="rows"),
                     "kernels_per_step": series_value(
                         r.parsed, "serving_kernels_per_step"),
+                    # ISSUE 13 training keys (same accrete-only contract:
+                    # a replica predating them reads None, never KeyError)
+                    "step_time": series_value(
+                        r.parsed, "train_step_time"),
+                    "goodput_examples_per_s": series_value(
+                        r.parsed, "train_goodput_examples_per_s"),
+                    "data_wait_frac": series_value(
+                        r.parsed, "train_data_wait_frac"),
+                    "straggler_skew": self._straggler.skews.get(r.name),
                     "rss_bytes": r.healthz.get("rss_bytes"),
                     "open_fds": r.healthz.get("open_fds"),
                     "uptime_s": r.healthz.get("uptime_s"),
@@ -783,11 +888,17 @@ class FleetAggregator:
         with self._lock:
             loop_errors, last_loop_err = (self._loop_errors,
                                           self._last_loop_err)
-        return {"status": status, "schema_version": 1,
+            strag = self._straggler.as_dict()
+        strag.pop("skews", None)   # per-replica skew rides each
+        #                            replica's snapshot entry
+        # schema v2 adds the "straggler" rollup (keys only ever accrete;
+        # v1 consumers ignore it)
+        return {"status": status, "schema_version": 2,
                 "stall_after_s": self.stall_after_s,
                 "down_after": self.down_after,
                 "loop_errors": loop_errors,
                 "last_loop_err": last_loop_err,
+                "straggler": strag,
                 "counts": counts, "replicas": snap}
 
     # -- lifecycle ---------------------------------------------------------

@@ -18,6 +18,7 @@ from ..nn.layer import Parameter
 from .lr import LRScheduler
 from .clip import ClipGradBase
 from .. import monitor
+from ..monitor import train as mtrain
 from ..profiler import RecordEvent
 
 __all__ = [
@@ -29,6 +30,34 @@ import os as _os
 
 # eager grad-norm telemetry sampling stride (1 = every step)
 _GRADNORM_EVERY = max(1, int(_os.environ.get("PTPU_GRADNORM_EVERY", "10")))
+
+
+# -- lazy grad-norm gauge (ISSUE 13 satellite) ------------------------------
+# The old per-step `gauge.set(jnp.sqrt(sq))` DISPATCHED O(params) eager
+# reduction ops inside the hot update path on every sampled step whenever
+# monitor was on.  The gauge is now a callback (the device-stats pattern):
+# the step only stores the sampled step's grad list in this cell — zero
+# device work in the update path — and the reduction runs at scrape/
+# snapshot time.  The callback then REPLACES the arrays with the computed
+# float, so the extra grad-buffer retention window ends at the first
+# scrape (or at the next sampled step, whichever comes first); with no
+# scraper attached the cell holds at most one grads-worth of buffers.
+_gradnorm_cell = [None]   # None | list[jax.Array] | float
+
+
+def _gradnorm_value():
+    held = _gradnorm_cell[0]
+    if held is None:
+        return 0.0
+    if isinstance(held, float):
+        return held
+    sq = functools.reduce(
+        lambda a, g: a + jnp.sum(jnp.square(g.astype(jnp.float32))),
+        held, jnp.float32(0.0))
+    val = float(jnp.sqrt(sq))
+    if _gradnorm_cell[0] is held:   # racing a newer sample: keep theirs
+        _gradnorm_cell[0] = val
+    return val
 
 
 class Optimizer:
@@ -171,24 +200,44 @@ class Optimizer:
             grads = [self._shard_grads(g, p) for g, p in zip(grads, params)]
         if self._grad_clip is not None:
             grads = self._grad_clip.apply(grads)
+        # the O(n_params) tracer scan only runs when some telemetry wing
+        # can use it — the fully-disabled update path stays global reads
+        eager_grads = (monitor.enabled() or mtrain.enabled()) and not any(
+            isinstance(g, jax.core.Tracer) for g in grads)
         if (monitor.enabled()
                 and self._step_count % _GRADNORM_EVERY == 1 % _GRADNORM_EVERY
-                and not any(isinstance(g, jax.core.Tracer) for g in grads)):
-            # post-clip global grad norm; stored lazily (async device
-            # scalar, forced to float only at monitor snapshot/export).
-            # Sampled every _GRADNORM_EVERY eager steps (the reduction
-            # dispatches O(params) eager ops and the gauge keeps only the
-            # last value anyway); PTPU_GRADNORM_EVERY=1 for every step.
-            sq = functools.reduce(
-                lambda a, g: a + jnp.sum(jnp.square(g.astype(jnp.float32))),
-                grads, jnp.float32(0.0))
-            monitor.gauge("optimizer/grad_norm").set(jnp.sqrt(sq))
+                and eager_grads):
+            # post-clip global grad norm, materialized at SCRAPE time:
+            # the hot path only stores the grad list (grads are not
+            # donated by _fused_update, so the buffers stay valid) and
+            # the callback gauge runs the reduction when something
+            # actually reads it.  Sampled every _GRADNORM_EVERY eager
+            # steps; PTPU_GRADNORM_EVERY=1 for every step.
+            _gradnorm_cell[0] = list(grads)
+            monitor.gauge("optimizer/grad_norm",
+                          "post-clip global gradient L2 norm (sampled, "
+                          "computed at scrape time)", fn=_gradnorm_value)
+        # ISSUE 13 wing (b): sampled per-layer grad/param/update norms —
+        # opt-in (PTPU_TRAIN_STATS), one fused device reduction + ONE
+        # host transfer per sampled step; disabled cost is this one
+        # module-global read
+        sample_stats = False
+        if mtrain.enabled() and eager_grads:
+            every = mtrain.sample_every()
+            sample_stats = self._step_count % every == 1 % every
         states = [self._ensure_state(p) for p in params]
         masters = [self._master_weights.get(id(p)) for p in params]
         p_arrays = [p._data for p in params]
         lr = self._lr_override if self._lr_override is not None else jnp.asarray(self.get_lr(), jnp.float32)
         step = self._step_override if self._step_override is not None else jnp.asarray(self._step_count, jnp.int32)
         extras = [self._extra_for(p) for p in params]
+        old_arrays = None
+        if sample_stats:
+            # pre-update copies: _fused_update DONATES the param buffers,
+            # so the update-ratio numerator needs its own copy of the
+            # pre-step params (sampled steps only — the same price
+            # StepGuard pays every step for its snapshot)
+            old_arrays = [jnp.array(a, copy=True) for a in p_arrays]
         new_p, new_s, new_m = self._fused_update(
             p_arrays, grads, states, masters, lr, step, extras
         )
@@ -197,6 +246,8 @@ class Optimizer:
             self._states[id(p)] = ns
             if nm is not None:
                 self._master_weights[id(p)] = nm
+        if sample_stats:
+            self._observe_layer_stats(params, old_arrays, grads)
 
     def clear_grad(self, set_to_zero=False):
         for p in self._parameter_list:
@@ -265,6 +316,30 @@ class Optimizer:
             id(p): (p.name or f"param_{i}")
             for i, p in enumerate(self._parameter_list)
         }
+
+    def _observe_layer_stats(self, params, old_arrays, grads):
+        """ISSUE 13 wing (b): per-layer grad-norm / param-norm /
+        update-norm, all reductions dispatched together and materialized
+        with ONE host transfer; ``monitor.train`` derives the update
+        ratio, exports the ``train/*{layer}`` gauges, and keeps the
+        ranked table ``Profiler.summary()`` renders.  Runs only on
+        PTPU_TRAIN_STATS sampled eager steps — the one sync per sampled
+        step is the documented price of the diagnostic, mirroring
+        PTPU_PERF's sync-every-call contract."""
+        rows = []
+        for p, old, g in zip(params, old_arrays, grads):
+            gf = g.astype(jnp.float32)
+            of = old.astype(jnp.float32)
+            nf = p._data.astype(jnp.float32)
+            rows.append(jnp.stack([
+                jnp.sum(gf * gf), jnp.sum(of * of),
+                jnp.sum((nf - of) * (nf - of))]))
+        stats = np.asarray(jnp.sqrt(jnp.stack(rows)))  # the ONE transfer
+        names = self._param_names()
+        mtrain.observe_layer_stats(
+            [(names.get(id(p), f"param_{i}"), stats[i, 0], stats[i, 1],
+              stats[i, 2]) for i, p in enumerate(params)],
+            step=self._step_count)
 
 
 class SGD(Optimizer):

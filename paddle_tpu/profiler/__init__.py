@@ -242,6 +242,17 @@ class Profiler:
             pa = ""
         if pa:
             lines += ["", pa]
+        # training microscope (paddle_tpu.monitor.train): ranked per-layer
+        # grad/param/update table from the PTPU_TRAIN_STATS sampled fused
+        # reduction — empty unless the optimizer recorded a sample.
+        try:
+            from ..monitor import train as _mtrain
+
+            ts = _mtrain.report()
+        except ImportError:   # standalone monitor load — no train module
+            ts = ""
+        if ts:
+            lines += ["", ts]
         return "\n".join(lines)
 
     def device_op_summary(self, top=30, time_unit="ms"):

@@ -9,7 +9,11 @@ completed here with the *recovery* half):
   `restore_latest()` falling back to the newest intact checkpoint;
 - :mod:`.guard` — `StepGuard`: NaN/Inf-guarded train steps that skip the
   bad update, retry or roll back to the last good snapshot, and back off
-  an attached `amp.GradScaler`;
+  an attached `amp.GradScaler`; bad steps run the :mod:`.forensics`
+  layer scan (ISSUE 13) so the flight dump NAMES the diverged layer;
+- :mod:`.forensics` — per-layer non-finite/abs-max scan of the
+  grad/param pytree in one batched device reduction (the "where did the
+  NaN come from" half of the NaN trap);
 - :mod:`.retry` — `retry()` backoff policy, shared `Deadline` budget, and
   the SIGTERM/SIGINT `PreemptionHandler` (checkpoint at the next step
   boundary, exit clean);
@@ -18,7 +22,7 @@ completed here with the *recovery* half):
 
 All recovery events land in the PR-1 monitor as ``resilience/*`` series.
 """
-from . import checkpoint_manager, faults, guard
+from . import checkpoint_manager, faults, forensics, guard
 from .checkpoint_manager import CheckpointError, CheckpointManager
 from .faults import FaultPlan, InjectedCrash, InjectedFault
 from .guard import GuardedStepInfo, StepGuard
@@ -30,5 +34,5 @@ from .retry import Deadline, PreemptionHandler, retry
 __all__ = [
     "CheckpointManager", "CheckpointError", "StepGuard", "GuardedStepInfo",
     "retry", "Deadline", "PreemptionHandler", "FaultPlan", "InjectedCrash",
-    "InjectedFault", "faults", "guard", "checkpoint_manager",
+    "InjectedFault", "faults", "forensics", "guard", "checkpoint_manager",
 ]

@@ -1,0 +1,112 @@
+"""Divergence forensics — the device-side half of the v6 training
+microscope (ISSUE 13 wing a).
+
+`StepGuard._healthy` answers *whether* a step went bad with one fused
+boolean; this module answers *where*: given the named grad/param pytree
+it computes, per layer, the non-finite element count and the absolute
+max — all in ONE batched device computation with a SINGLE host
+transfer (the same sync discipline as the health check itself: the
+reductions are dispatched together and one stacked array crosses to
+the host).  The result names the first-NaN layer path and ranks the
+finite-but-hot suspects, and is what StepGuard writes into the
+``resilience/nonfinite{layer,which}`` counters, the flight-ring
+breadcrumb, and the ``bad_step`` flight dump.
+
+This runs ONLY on the bad-step path (cold by definition — a bad step
+already pays a restore), so there is no gate here; the per-step hot
+path never reaches this module.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = ["layer_health", "nonfinite_report"]
+
+# layers listed in full in a report/dump; beyond this only the bad and
+# hottest layers are named (a 10k-layer model must not write a 10k-row
+# dump on every bad step)
+_MAX_SUSPECTS = 8
+
+
+def layer_health(named_arrays):
+    """One batched device scan of ``[(name, array), ...]``.
+
+    Returns ``[(name, nonfinite_count, absmax, size), ...]`` for every
+    float array (non-float entries are skipped — integers can't go
+    non-finite).  All per-layer reductions are dispatched together and
+    materialized with ONE host transfer; ``absmax`` is over the finite
+    elements only, so a single NaN doesn't mask which layer was
+    *growing* before it died."""
+    names, rows, sizes = [], [], []
+    for name, a in named_arrays:
+        if a is None or not jnp.issubdtype(a.dtype, jnp.floating) \
+                or a.size == 0:
+            continue
+        af = a.astype(jnp.float32)
+        finite = jnp.isfinite(af)
+        # integer reduction, cast AFTER: a float32 accumulator saturates
+        # at 2^24 and would report a fully-finite 200M-element embedding
+        # as non-finite (size - ~1.7e7 > 0)
+        n_bad = jnp.sum(jnp.logical_not(finite),
+                        dtype=jnp.int32).astype(jnp.float32)
+        amax = jnp.max(jnp.abs(jnp.where(finite, af, 0.0)))
+        names.append(name)
+        rows.append(jnp.stack([n_bad, amax]))
+        sizes.append(int(a.size))
+    if not rows:
+        return []
+    stats = np.asarray(jnp.stack(rows))   # the ONE host transfer
+    return [(name, int(stats[i, 0]), float(stats[i, 1]), sizes[i])
+            for i, name in enumerate(names)]
+
+
+def nonfinite_report(params=None, grads=None, loss=None) -> dict:
+    """The bad-step post-mortem document.
+
+    ``params`` / ``grads``: ``[(layer_path, array), ...]`` (grads may be
+    absent — a step that already ran ``clear_grad()`` only has params
+    to examine).  ``loss``: the step's loss array, checked alongside.
+
+    Returns::
+
+        {"checked": n_layers_scanned,
+         "first_bad": "layer (which)" | None,   # first in param order
+         "bad": [{"layer", "which", "nonfinite", "size", "frac",
+                  "absmax"}, ...],              # every non-finite layer
+         "suspects": [{"layer", "which", "absmax"}, ...],  # hottest
+         "loss_finite": bool | None}
+
+    ``suspects`` ranks the finite layers by abs-max — the "who was
+    about to blow up" list the loss-spike breadcrumbs pair with."""
+    entries = []
+    for which, named in (("param", params or ()), ("grad", grads or ())):
+        for name, a in named:
+            entries.append((which, name, a))
+    scanned = layer_health([(f"{which}\0{name}", a)
+                            for which, name, a in entries])
+    bad, finite_rows = [], []
+    for key, n_bad, amax, size in scanned:
+        which, name = key.split("\0", 1)
+        if n_bad:
+            bad.append({"layer": name, "which": which,
+                        "nonfinite": n_bad, "size": size,
+                        "frac": n_bad / size, "absmax": amax})
+        else:
+            finite_rows.append({"layer": name, "which": which,
+                                "absmax": amax})
+    finite_rows.sort(key=lambda r: -r["absmax"])
+    report = {
+        "checked": len(scanned),
+        "first_bad": (f"{bad[0]['layer']} ({bad[0]['which']})"
+                      if bad else None),
+        "bad": bad,
+        "suspects": finite_rows[:_MAX_SUSPECTS],
+    }
+    if loss is not None:
+        try:
+            report["loss_finite"] = bool(np.isfinite(
+                np.asarray(loss)).all())
+        except (TypeError, ValueError):
+            report["loss_finite"] = None
+    return report

@@ -27,7 +27,19 @@ updates `model`/`optimizer` in place and returns the loss.  Per step:
    skip can't.
 
 Monitor: ``resilience/skipped_steps``, ``resilience/rollbacks``,
-``resilience/bad_step_streak`` (gauge).
+``resilience/bad_step_streak`` (gauge), ``train/step_time`` (gauge, the
+per-rank straggler signal), plus the v6 divergence forensics below.
+
+Divergence forensics (ISSUE 13): a bad step no longer just *counts* —
+before the restore wipes the evidence, the grad/param pytree is scanned
+in one batched device reduction (``resilience.forensics``) and the
+offending layer paths are named in ``resilience/nonfinite{layer,which}``
+counters, a flight-ring breadcrumb, and — when ``PTPU_FLIGHT_DIR`` is
+set — a ``bad_step`` flight dump carrying per-layer non-finite counts
+and abs-max stats.  On healthy steps an EWMA loss-spike detector
+(``monitor.train.LossSpikeDetector``) drops pre-divergence warnings
+into the flight ring *before* the NaN lands, so the post-mortem shows
+the climb, not just the crater.
 
 Scope: rollback restores params, optimizer slots, master weights, the
 optimizer step counter, and GradScaler scale/counters.  Host-side state
@@ -38,13 +50,17 @@ rather than pulling it inside the step.
 """
 from __future__ import annotations
 
+import time
 from typing import Optional
 
+import numpy as np
 import jax.numpy as jnp
 
 from .. import monitor
+from ..monitor import flight as mflight
 from ..monitor import trace as mtrace
-from . import faults
+from ..monitor import train as mtrain
+from . import faults, forensics
 
 __all__ = ["StepGuard", "GuardedStepInfo"]
 
@@ -83,10 +99,19 @@ class StepGuard:
                  check_params: bool = True):
         if params is not None:
             self._params = list(params)
+            self._names = [getattr(p, "name", None) or f"param_{i}"
+                           for i, p in enumerate(self._params)]
         elif model is not None:
-            self._params = list(model.parameters())
+            # named_parameters gives the layer PATHS ("0.weight", ...) —
+            # what the forensics dump names; parameters() is derived from
+            # the same walk, so order matches
+            named = list(model.named_parameters())
+            self._params = [p for _, p in named]
+            self._names = [n for n, _ in named]
         elif optimizer is not None:
             self._params = list(optimizer._parameter_list)
+            self._names = [getattr(p, "name", None) or f"param_{i}"
+                           for i, p in enumerate(self._params)]
         else:
             raise ValueError("StepGuard needs a model, optimizer, or "
                              "an explicit params list")
@@ -106,6 +131,16 @@ class StepGuard:
             "resilience/rollbacks",
             "rollbacks to the last good snapshot")
         self._m_streak = monitor.gauge("resilience/bad_step_streak")
+        self._m_nonfinite = monitor.counter(
+            "resilience/nonfinite",
+            "layers found non-finite by bad-step forensics")
+        self._m_forensics_err = monitor.counter(
+            "resilience/forensics_errors",
+            "bad-step forensic scans that failed")
+        self._m_step_time = monitor.gauge(
+            "train/step_time",
+            "train step seconds — the per-rank straggler signal")
+        self._spike = mtrain.LossSpikeDetector()
 
     # -- snapshot / restore -------------------------------------------------
 
@@ -157,6 +192,42 @@ class StepGuard:
                     ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(d)))
         return bool(ok)
 
+    # -- divergence forensics (ISSUE 13 wing a) -----------------------------
+
+    def _observe_loss(self, result, step):
+        """Feed the healthy step's loss to the EWMA spike detector."""
+        try:
+            val = float(np.mean(np.asarray(_loss_array(result))))
+        except (TypeError, ValueError):
+            return
+        self._spike.observe(val, step=step)
+
+    def _forensics(self, step, result):
+        """Name the offending layers of a bad step: one batched device
+        scan of the grad/param pytree → counters, a flight breadcrumb,
+        and (when PTPU_FLIGHT_DIR is set) a ``bad_step`` dump with
+        per-layer non-finite counts and abs-max stats."""
+        try:
+            params = [(n, p._data)
+                      for n, p in zip(self._names, self._params)]
+            grads = [(n, p.grad._data)
+                     for n, p in zip(self._names, self._params)
+                     if getattr(p, "grad", None) is not None]
+            report = forensics.nonfinite_report(
+                params=params, grads=grads, loss=_loss_array(result))
+        except Exception:   # ptpu-check[silent-except]: forensics must never turn a
+            # recoverable bad step into a crash — failures are counted
+            self._m_forensics_err.inc()
+            return
+        report["step"] = step
+        for b in report["bad"]:
+            self._m_nonfinite.labels(layer=b["layer"],
+                                     which=b["which"]).inc()
+        mflight.note("resilience/nonfinite", step=step,
+                     first_bad=report["first_bad"],
+                     layers=[b["layer"] for b in report["bad"]][:16])
+        mflight.maybe_dump("bad_step", extra={"forensics": report})
+
     # -- the guarded step ---------------------------------------------------
 
     def step(self, step_fn, *args, **kwargs):
@@ -172,6 +243,7 @@ class StepGuard:
         # re-capturing after a restore would just copy the same state again
         pre = self._capture()
         while True:
+            t0 = time.perf_counter() if monitor.enabled() else 0.0
             result = step_fn(*args, **kwargs)
             # injected "optimizer update from NaN gradients": poison the
             # updated params so the health check sees what a real
@@ -186,11 +258,23 @@ class StepGuard:
                 if self._good_steps % self.snapshot_every == 0:
                     # post-step state of a verified-healthy step
                     self._good_snap = self._capture()
+                if monitor.enabled():
+                    # the health check just synced the step, so the wall
+                    # is real (not dispatch time) and the loss transfer
+                    # is a cheap ready-scalar read; the EWMA detector
+                    # files pre-divergence breadcrumbs off it
+                    self._m_step_time.set(time.perf_counter() - t0)
+                    self._observe_loss(result, step)
                 mtrace.heartbeat()   # watchdog liveness: a step completed
                 return result, GuardedStepInfo(True, _loss_array(result),
                                                retries=retries)
             # -- bad step ---------------------------------------------------
             self._m_skipped.inc()
+            if retries == 0:
+                # first bad attempt of this step: forensic scan BEFORE
+                # the restore wipes the evidence (cold path — a bad step
+                # already pays a full state restore)
+                self._forensics(step, result)
             # skip the update entirely — scaler included, so a retried
             # step runs from EXACTLY the unfaulted pre-state (the
             # bit-for-bit parity property)

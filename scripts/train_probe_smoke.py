@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Training-microscope smoke (ISSUE 13) — CPU-runnable, standalone.
+
+Drives every v6 training wing in one process and asserts the acceptance
+surface:
+
+1. per-layer telemetry: PTPU_TRAIN_STATS sampled fused reduction →
+   ``train/*{layer}`` gauges + the ranked table;
+2. input-pipeline goodput: a hapi ``fit`` over a throttled reader →
+   ``train/goodput_examples_per_s`` / ``train/data_wait_frac`` /
+   ``train/step_time`` + ``reader/wait_time``;
+3. divergence forensics: a ``PTPU_FAULTS nan_grad`` injection under
+   StepGuard → a ``bad_step`` flight dump NAMING the faulted layer path,
+   with the pre-divergence loss-spike breadcrumb machinery live.
+
+Not wired into tier-1 (the fast tier is at ~790 s of its 870 s budget
+at HEAD — these invariants are pinned subprocess-free in
+tests/test_train_stats.py and tests/test_resilience.py); run manually
+or from a chip-window battery:
+
+    python scripts/train_probe_smoke.py
+"""
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("PTPU_TRAIN_STATS", "1")
+os.environ.setdefault("PTPU_TRAIN_STATS_EVERY", "1")
+flight_dir = os.environ.setdefault(
+    "PTPU_FLIGHT_DIR", tempfile.mkdtemp(prefix="ptpu_train_probe_"))
+
+import numpy as np                                    # noqa: E402
+
+import paddle_tpu as paddle                           # noqa: E402
+from paddle_tpu import monitor, nn, optimizer        # noqa: E402
+from paddle_tpu.hapi import Model                    # noqa: E402
+from paddle_tpu.io import Dataset                    # noqa: E402
+from paddle_tpu.monitor import train as mtrain       # noqa: E402
+from paddle_tpu.resilience import (FaultPlan, StepGuard,  # noqa: E402
+                                   faults)
+
+
+class SlowDataset(Dataset):
+    """A reader with a visible stall, so data_wait_frac is nonzero."""
+
+    def __init__(self, n=32):
+        rng = np.random.RandomState(0)
+        self.x = rng.randn(n, 8).astype("float32")
+        self.y = rng.randn(n, 1).astype("float32")
+
+    def __getitem__(self, i):
+        time.sleep(0.002)
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def main():
+    paddle.seed(42)
+
+    # -- wings b + c: sampled layer stats + goodput through hapi fit ----
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    model = Model(net)
+    model.prepare(
+        optimizer=optimizer.Adam(learning_rate=1e-2,
+                                 parameters=net.parameters()),
+        loss=lambda out, lab: ((out - lab) ** 2).mean())
+    model.fit(SlowDataset(), batch_size=8, epochs=1, verbose=0,
+              num_workers=2)
+    snap = monitor.snapshot()
+    assert snap["train/goodput_examples_per_s"] > 0.0, snap
+    assert snap["train/data_wait_frac"] > 0.0
+    assert snap["train/step_time"] > 0.0
+    assert snap["reader/wait_time"]["count"] > 0
+    rows, step = mtrain.layer_stats()
+    assert rows, "sampled per-layer table is empty"
+    print(f"goodput {snap['train/goodput_examples_per_s']:.1f} ex/s, "
+          f"data_wait {snap['train/data_wait_frac']*100:.1f}%, "
+          f"step {snap['train/step_time']*1e3:.2f} ms")
+    print(mtrain.report())
+
+    # -- wing a: nan_grad injection → forensic dump ---------------------
+    guard = StepGuard(model=net,
+                      optimizer=model._optimizer, max_retries_per_step=1)
+    faults.set_plan(FaultPlan("nan_grad@step=3"))
+    X = np.random.RandomState(1).randn(8, 8).astype("float32")
+    Y = np.random.RandomState(2).randn(8, 1).astype("float32")
+    for _ in range(4):
+        def step():
+            loss = ((net(paddle.to_tensor(X))
+                     - paddle.to_tensor(Y)) ** 2).mean()
+            loss.backward()
+            model._optimizer.step()
+            model._optimizer.clear_grad()
+            return loss
+
+        guard.step(step)
+    faults.set_plan(None)
+    dumps = [f for f in os.listdir(flight_dir) if "_bad_step_" in f]
+    assert len(dumps) == 1, dumps
+    doc = json.load(open(os.path.join(flight_dir, dumps[0])))
+    fx = doc["extra"]["forensics"]
+    assert fx["first_bad"] and fx["bad"], fx
+    print(f"forensic dump {dumps[0]}: first_bad={fx['first_bad']}, "
+          f"{len(fx['bad'])} bad layer(s), "
+          f"{len(fx['suspects'])} suspect(s)")
+    print("train probe smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -470,7 +470,13 @@ class _FakeFleet:
                   "# TYPE serving_padding_waste gauge\n"
                   'serving_padding_waste{kind="rows"} 0.375\n'
                   "# TYPE serving_kernels_per_step gauge\n"
-                  "serving_kernels_per_step 2\n",
+                  "serving_kernels_per_step 2\n"
+                  "# TYPE train_step_time gauge\n"
+                  "train_step_time 0.25\n"
+                  "# TYPE train_goodput_examples_per_s gauge\n"
+                  "train_goodput_examples_per_s 64\n"
+                  "# TYPE train_data_wait_frac gauge\n"
+                  "train_data_wait_frac 0.125\n",
             "r1": "# TYPE serving_decode_tokens counter\n"
                   "serving_decode_tokens 7\n",
         }
@@ -597,8 +603,16 @@ def test_snapshot_is_the_router_feed(fake, tmp_path):
     assert snap["r0"]["kernels_per_step"] == 2.0
     assert snap["r0"]["rss_bytes"] == 123456
     assert snap["r0"]["open_fds"] == 17 and snap["r0"]["uptime_s"] == 9.5
+    # ISSUE 13: the training keys ride the same feed (straggler_skew is
+    # None here — r1 publishes no step time, so there is no fleet median
+    # to ratio against; the rollup itself is pinned in test_train_stats)
+    assert snap["r0"]["step_time"] == 0.25
+    assert snap["r0"]["goodput_examples_per_s"] == 64.0
+    assert snap["r0"]["data_wait_frac"] == 0.125
     for k in ("goodput_tokens_per_s", "padding_waste_rows",
-              "kernels_per_step", "rss_bytes", "open_fds"):
+              "kernels_per_step", "rss_bytes", "open_fds",
+              "step_time", "goodput_examples_per_s", "data_wait_frac",
+              "straggler_skew"):
         assert snap["r1"][k] is None, (k, snap["r1"][k])
 
 

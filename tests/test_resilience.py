@@ -373,6 +373,67 @@ def test_consecutive_nan_steps_roll_back_to_good_snapshot():
     assert infos[-1].ok
 
 
+def test_nan_grad_flight_dump_names_faulted_layer(tmp_path, monkeypatch):
+    """ISSUE 13 acceptance: a PTPU_FAULTS nan_grad injection produces a
+    StepGuard ``bad_step`` flight dump that NAMES the faulted layer path
+    with per-layer non-finite stats — the v6 divergence forensics."""
+    import json
+
+    monkeypatch.setenv("PTPU_FLIGHT_DIR", str(tmp_path))
+    before = monitor.counter("resilience/nonfinite").labels(
+        layer="0.weight", which="param").value
+    _run_guarded("nan_grad@step=5", max_retries_per_step=0)
+    files = [f for f in os.listdir(tmp_path) if "_bad_step_" in f]
+    assert len(files) == 1, files
+    doc = json.load(open(os.path.join(str(tmp_path), files[0])))
+    fx = doc["extra"]["forensics"]
+    # the injection poisons params[0] — named_parameters path "0.weight"
+    assert fx["first_bad"] == "0.weight (param)"
+    assert fx["step"] == 5
+    bad = {b["layer"]: b for b in fx["bad"]}
+    assert bad["0.weight"]["which"] == "param"
+    assert bad["0.weight"]["nonfinite"] > 0
+    assert bad["0.weight"]["frac"] == 1.0        # x*nan poisons every elt
+    assert "absmax" in bad["0.weight"] and "size" in bad["0.weight"]
+    # the finite layers are ranked as suspects, not mixed into `bad`
+    assert all(s["layer"] != "0.weight" or s["which"] != "param"
+               for s in fx["suspects"])
+    assert fx["loss_finite"] in (True, False)
+    # the breadcrumb landed in the ring the dump carries
+    assert any(r.get("kind") == "note"
+               and r.get("event") == "resilience/nonfinite"
+               and r.get("first_bad") == "0.weight (param)"
+               for r in doc["ring"])
+    # and the counter series names the layer too
+    assert monitor.counter("resilience/nonfinite").labels(
+        layer="0.weight", which="param").value > before
+
+
+def test_nan_grad_retry_dumps_once_per_step(tmp_path, monkeypatch):
+    """Retries re-run from the restored pre-state: the forensic scan and
+    dump happen on the FIRST bad attempt only (no dump storms), and the
+    retried step's bit-for-bit parity is untouched by the scan."""
+    monkeypatch.setenv("PTPU_FLIGHT_DIR", str(tmp_path))
+    la, pa, _, _ = _run_guarded(None, max_retries_per_step=1)
+    lb, pb, infos, _ = _run_guarded("nan_grad@step=5",
+                                    max_retries_per_step=1)
+    assert la == lb
+    for x, y in zip(pa, pb):
+        np.testing.assert_array_equal(x, y)
+    files = [f for f in os.listdir(tmp_path) if "_bad_step_" in f]
+    assert len(files) == 1, files
+
+
+def test_guard_healthy_steps_feed_spike_detector_and_step_time():
+    """Healthy steps feed the EWMA loss-spike detector and the per-rank
+    train/step_time straggler gauge (the ISSUE 13 wiring; the detector's
+    own state machine is pinned in tests/test_train_stats.py)."""
+    _, _, infos, guard = _run_guarded(None, steps=4)
+    assert all(i.ok for i in infos)
+    assert guard._spike._n == 4          # every healthy loss observed
+    assert monitor.gauge("train/step_time").value > 0.0
+
+
 def test_guard_backs_off_gradscaler():
     m, o, X, Y = _mlp_and_data()
     scaler = paddle.amp.GradScaler(init_loss_scaling=1024,

@@ -1,0 +1,421 @@
+"""paddle_tpu.monitor.train + resilience.forensics + fleet straggler —
+the v6 training microscope (ISSUE 13), fast tier.
+
+Everything here is subprocess-free and compiles at most one tiny fused
+optimizer update (tier-1 budget is scarce): the loss-spike EWMA, the
+goodput math, the straggler rollup state machine, and the forensic layer
+scan are pinned as pure units; the optimizer/hapi wiring rides the same
+tiny-MLP fixtures the resilience suite uses.
+"""
+import math
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor, nn, optimizer
+from paddle_tpu.monitor import fleet, flight
+from paddle_tpu.monitor import train as mtrain
+from paddle_tpu.resilience import forensics
+
+
+@pytest.fixture(autouse=True)
+def _reset_train_gate():
+    yield
+    mtrain.refresh()     # back to the env-derived PTPU_TRAIN_STATS
+    mtrain.reset()
+
+
+# ---------------------------------------------------------------------------
+# gate
+# ---------------------------------------------------------------------------
+
+def test_gate_default_off_and_runtime_toggle(monkeypatch):
+    monkeypatch.delenv("PTPU_TRAIN_STATS", raising=False)
+    mtrain.refresh()
+    assert not mtrain.enabled()
+    mtrain.enable(True)
+    assert mtrain.enabled()
+    mtrain.refresh()
+    assert not mtrain.enabled()
+    monkeypatch.setenv("PTPU_TRAIN_STATS_EVERY", "7")
+    assert mtrain.sample_every() == 7
+    monkeypatch.setenv("PTPU_TRAIN_STATS_EVERY", "garbage")
+    assert mtrain.sample_every() == 10   # parse failure → default
+
+
+# ---------------------------------------------------------------------------
+# loss-spike EWMA detector
+# ---------------------------------------------------------------------------
+
+def _warm(det, n=30, base=1.0, jitter=0.02, start=0):
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        out = det.observe(base + jitter * rng.randn(), step=start + i)
+        assert out is None
+    return start + n
+
+
+def test_spike_detector_quiet_on_stable_loss():
+    det = mtrain.LossSpikeDetector(warmup=10)
+    before = monitor.counter("train/loss_spikes").value
+    _warm(det, n=60)
+    assert monitor.counter("train/loss_spikes").value == before
+    assert det._mean == pytest.approx(1.0, abs=0.1)
+
+
+def test_spike_fires_and_notes_before_divergence():
+    det = mtrain.LossSpikeDetector(warmup=10, sigma=6.0)
+    step = _warm(det)
+    before = monitor.counter("train/loss_spikes").value
+    out = det.observe(50.0, step=step)
+    assert out is not None and out["kind"] == "spike"
+    assert out["sigma"] > 6.0
+    assert monitor.counter("train/loss_spikes").value == before + 1
+    # the pre-divergence breadcrumb is IN THE RING before any NaN lands
+    assert any(r.get("event") == "train/loss_spike"
+               and r.get("step") == step
+               for r in flight.get_recorder().records())
+    # a flagged loss must NOT drag its own baseline up
+    assert det._mean == pytest.approx(1.0, abs=0.1)
+
+
+def test_spike_nonfinite_fires_even_during_warmup():
+    det = mtrain.LossSpikeDetector(warmup=1000)
+    det.observe(1.0, step=0)
+    out = det.observe(float("nan"), step=1)
+    assert out is not None and out["kind"] == "nonfinite"
+
+
+def test_spike_cooldown_suppresses_repeat_fires():
+    det = mtrain.LossSpikeDetector(warmup=10, sigma=6.0, cooldown=10)
+    step = _warm(det)
+    assert det.observe(50.0, step=step) is not None
+    assert det.observe(60.0, step=step + 1) is None      # inside cooldown
+    assert det.observe(70.0, step=step + 11) is not None  # re-armed
+
+
+def test_spike_detector_ignores_unfloatable_loss():
+    det = mtrain.LossSpikeDetector()
+    assert det.observe(object()) is None
+    assert det._n == 0
+
+
+# ---------------------------------------------------------------------------
+# goodput meter math
+# ---------------------------------------------------------------------------
+
+def test_goodput_math_exact():
+    meter = mtrain.GoodputMeter(window=50)
+    meter.wait(1.0)
+    meter.step(3.0, examples=8)
+    assert meter.goodput == pytest.approx(8.0 / 4.0)
+    assert meter.data_wait_frac == pytest.approx(0.25)
+    assert monitor.gauge("train/goodput_examples_per_s").value == \
+        pytest.approx(2.0)
+    assert monitor.gauge("train/data_wait_frac").value == \
+        pytest.approx(0.25)
+    assert monitor.gauge("train/step_time").value == pytest.approx(3.0)
+
+
+def test_goodput_window_evicts_old_steps():
+    meter = mtrain.GoodputMeter(window=2)
+    meter.wait(10.0)
+    meter.step(10.0, examples=1)     # will be evicted
+    meter.wait(1.0)
+    meter.step(1.0, examples=4)
+    meter.wait(1.0)
+    meter.step(1.0, examples=4)
+    # only the last two steps survive: 8 examples over 4 seconds
+    assert meter.goodput == pytest.approx(2.0)
+    assert meter.data_wait_frac == pytest.approx(0.5)
+    assert monitor.gauge("train/step_time").value == pytest.approx(1.0)
+
+
+def test_goodput_accumulates_split_waits():
+    meter = mtrain.GoodputMeter()
+    meter.wait(0.5)
+    meter.wait(0.5)                  # two reader stalls before one step
+    meter.step(1.0, examples=2)
+    assert meter.data_wait_frac == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# per-layer stats store + ranked table
+# ---------------------------------------------------------------------------
+
+def test_observe_layer_stats_gauges_and_report():
+    mtrain.observe_layer_stats(
+        [("blk0.w", 4.0, 2.0, 0.5), ("blk1.w", 9.0, 3.0, 0.3)], step=17)
+    assert monitor.gauge("train/grad_norm").labels(
+        layer="blk1.w").value == 9.0
+    assert monitor.gauge("train/update_ratio").labels(
+        layer="blk0.w").value == pytest.approx(0.25)   # 0.5 / 2.0
+    rows, step = mtrain.layer_stats()
+    assert step == 17 and len(rows) == 2
+    rep = mtrain.report()
+    # ranked by grad norm: blk1 first
+    assert rep.index("blk1.w") < rep.index("blk0.w")
+    assert "@ step 17" in rep
+    mtrain.reset()
+    assert mtrain.report() == ""
+
+
+def test_zero_param_norm_reads_zero_ratio_not_inf():
+    mtrain.observe_layer_stats([("fresh.b", 1.0, 0.0, 0.01)])
+    assert monitor.gauge("train/update_ratio").labels(
+        layer="fresh.b").value == 0.0
+
+
+# ---------------------------------------------------------------------------
+# straggler rollup state machine
+# ---------------------------------------------------------------------------
+
+def test_straggler_needs_streak_then_flags_and_recovers():
+    r = fleet.StragglerRollup(threshold=1.5, streak=2)
+    out = r.update({"r0": 1.0, "r1": 1.0, "r2": 1.1})
+    assert out["flagged"] is None and out["skew"] == pytest.approx(1.1)
+    out = r.update({"r0": 3.0, "r1": 1.0, "r2": 1.1})
+    assert out["slowest"] == "r0" and out["streak"] == 1
+    assert out["flagged"] is None              # one slow cycle ≠ straggler
+    out = r.update({"r0": 3.0, "r1": 1.0, "r2": 1.1})
+    assert out["flagged"] == "r0" and out["streak"] == 2
+    assert out["skew"] == pytest.approx(3.0 / 1.1)
+    assert out["skews"]["r1"] == pytest.approx(1.0 / 1.1)
+    # recovery re-arms
+    out = r.update({"r0": 1.0, "r1": 1.0, "r2": 1.1})
+    assert out["flagged"] is None and out["streak"] == 0
+
+
+def test_straggler_streak_resets_when_slowest_changes():
+    r = fleet.StragglerRollup(threshold=1.5, streak=3)
+    r.update({"r0": 3.0, "r1": 1.0, "r2": 1.0})
+    r.update({"r0": 3.0, "r1": 1.0, "r2": 1.0})
+    out = r.update({"r0": 1.0, "r1": 3.0, "r2": 1.0})   # a DIFFERENT rank
+    assert out["streak"] == 1 and out["flagged"] is None
+
+
+def test_straggler_meaningless_without_two_ranks():
+    r = fleet.StragglerRollup()
+    assert r.update({})["slowest"] is None
+    assert r.update({"r0": 1.0})["skew"] is None
+    # None / non-positive values are filtered, not crashed on
+    out = r.update({"r0": 1.0, "r1": None, "r2": 0.0})
+    assert out["slowest"] is None and out["skews"] == {}
+
+
+def test_aggregator_exports_straggler_and_train_keys(tmp_path):
+    import json
+
+    metrics = {
+        "ra": "# TYPE train_step_time gauge\ntrain_step_time 3.0\n"
+              "# TYPE train_goodput_examples_per_s gauge\n"
+              "train_goodput_examples_per_s 120\n"
+              "# TYPE train_data_wait_frac gauge\n"
+              "train_data_wait_frac 0.05\n",
+        "rb": "# TYPE train_step_time gauge\ntrain_step_time 0.5\n",
+        "rc": "",   # an older replica: no train series at all
+    }
+    # two valid step times (rc contributes none): median (3.0+0.5)/2
+
+    down = set()
+
+    def fetch(url):
+        name = url.split("//", 1)[1].split("/", 1)[0]
+        if name in down:
+            raise ConnectionError("injected: replica gone")
+        if url.endswith("/metrics"):
+            return metrics[name]
+        if url.endswith("/healthz"):
+            return json.dumps({"last_activity_age_s": 0.1})
+        raise ValueError(url)
+
+    agg = fleet.FleetAggregator(
+        endpoints=[{"name": n, "url": f"http://{n}"} for n in metrics],
+        store=None, fetch=fetch, harvest_dir=str(tmp_path),
+        straggler_threshold=1.5, straggler_streak=2)
+    agg.poll_once()
+    snap = agg.snapshot()
+    # the router feed's ISSUE-13 train keys; None for the old replica
+    assert snap["ra"]["step_time"] == 3.0
+    assert snap["ra"]["goodput_examples_per_s"] == 120.0
+    assert snap["ra"]["data_wait_frac"] == 0.05
+    assert snap["ra"]["straggler_skew"] == pytest.approx(3.0 / 1.75)
+    assert snap["rb"]["straggler_skew"] == pytest.approx(0.5 / 1.75)
+    assert snap["rb"]["goodput_examples_per_s"] is None
+    for k in ("step_time", "goodput_examples_per_s", "data_wait_frac",
+              "straggler_skew"):
+        assert snap["rc"][k] is None, k
+    # first slow cycle: skew exported, nothing flagged yet
+    hz = agg.healthz()
+    assert hz["schema_version"] == 2
+    assert hz["straggler"]["slowest"] == "ra"
+    assert hz["straggler"]["flagged"] is None
+    txt = agg.registry.export_prometheus()
+    assert f"fleet_straggler_skew {3.0 / 1.75!r}" in txt
+    assert 'fleet_straggler{replica=' not in txt
+    # streak satisfied → flagged + gauge
+    agg.poll_once()
+    assert agg.healthz()["straggler"]["flagged"] == "ra"
+    assert 'fleet_straggler{replica="ra"} 1' in \
+        agg.registry.export_prometheus()
+    # a replica that stops answering must stop contributing: its STALE
+    # last step time cannot keep it flagged forever (one valid peer left
+    # → skew is meaningless → rollup clears)
+    down.add("ra")
+    agg.poll_once()
+    assert agg.healthz()["straggler"]["flagged"] is None
+    assert agg.snapshot()["ra"]["straggler_skew"] is None
+
+
+# ---------------------------------------------------------------------------
+# forensics (device-side scan)
+# ---------------------------------------------------------------------------
+
+def test_layer_health_counts_and_finite_absmax():
+    import jax.numpy as jnp
+
+    a = jnp.asarray(np.array([1.0, -5.0, np.nan, np.inf], np.float32))
+    b = jnp.asarray(np.array([[2.0, -3.0]], np.float32))
+    c = jnp.asarray(np.array([1, 2], np.int32))        # skipped: int
+    rows = forensics.layer_health([("a", a), ("b", b), ("c", c)])
+    assert [r[0] for r in rows] == ["a", "b"]
+    name, n_bad, amax, size = rows[0]
+    assert n_bad == 2 and size == 4
+    assert amax == 5.0        # abs-max over the FINITE elements only
+    assert rows[1][1] == 0 and rows[1][2] == 3.0
+
+
+def test_nonfinite_report_names_first_bad_and_ranks_suspects():
+    import jax.numpy as jnp
+
+    ok = jnp.ones((2, 2), jnp.float32)
+    hot = jnp.full((2,), 7.0, jnp.float32)
+    bad = jnp.asarray(np.array([1.0, np.nan], np.float32))
+    rep = forensics.nonfinite_report(
+        params=[("l0.w", ok), ("l1.w", bad)],
+        grads=[("l0.w", hot)],
+        loss=jnp.asarray(np.float32(np.nan)))
+    assert rep["first_bad"] == "l1.w (param)"
+    assert rep["checked"] == 3
+    assert rep["bad"][0]["nonfinite"] == 1
+    assert rep["bad"][0]["frac"] == 0.5
+    assert rep["loss_finite"] is False
+    # suspects: finite layers ranked by abs-max, the hot grad first
+    assert rep["suspects"][0] == {"layer": "l0.w", "which": "grad",
+                                  "absmax": 7.0}
+
+
+def test_nonfinite_report_empty_and_grad_only():
+    rep = forensics.nonfinite_report(params=[], grads=[])
+    assert rep["checked"] == 0 and rep["first_bad"] is None
+    import jax.numpy as jnp
+
+    rep = forensics.nonfinite_report(
+        grads=[("g", jnp.asarray(np.array([np.inf], np.float32)))])
+    assert rep["first_bad"] == "g (grad)"
+
+
+# ---------------------------------------------------------------------------
+# optimizer wiring: lazy grad-norm + sampled per-layer reduction
+# ---------------------------------------------------------------------------
+
+def _tiny_step(m, o, X, Y):
+    loss = ((m(paddle.to_tensor(X)) - paddle.to_tensor(Y)) ** 2).mean()
+    loss.backward()
+    o.step()
+    o.clear_grad()
+    return loss
+
+
+def test_lazy_grad_norm_materializes_at_scrape_time():
+    from paddle_tpu.optimizer import optimizer as opt_mod
+
+    paddle.seed(11)
+    m = nn.Linear(4, 2)
+    o = optimizer.Adam(learning_rate=1e-2, parameters=m.parameters())
+    X = np.random.RandomState(0).randn(4, 4).astype("float32")
+    Y = np.random.RandomState(1).randn(4, 2).astype("float32")
+    _tiny_step(m, o, X, Y)           # step 1: the sampled step
+    # the hot path stored the GRAD LIST — no reduction dispatched yet
+    assert isinstance(opt_mod._gradnorm_cell[0], list)
+    val = monitor.gauge("optimizer/grad_norm").value
+    assert val > 0.0
+    # the scrape computed AND released the arrays (retention window ends)
+    assert isinstance(opt_mod._gradnorm_cell[0], float)
+    assert opt_mod._gradnorm_cell[0] == pytest.approx(val)
+    # repeat reads answer from the cached float
+    assert monitor.gauge("optimizer/grad_norm").value == \
+        pytest.approx(val)
+
+
+def test_sampled_layer_stats_end_to_end():
+    mtrain.enable(True)
+    mtrain.reset()
+    paddle.seed(12)
+    m = nn.Linear(4, 2)
+    o = optimizer.SGD(learning_rate=1e-2, parameters=m.parameters())
+    X = np.random.RandomState(0).randn(4, 4).astype("float32")
+    Y = np.random.RandomState(1).randn(4, 2).astype("float32")
+    _tiny_step(m, o, X, Y)           # step 1 samples (every N, phase 1)
+    rows, step = mtrain.layer_stats()
+    assert step == 1 and len(rows) == 2      # weight + bias
+    by_layer = {r[0]: r for r in rows}
+    wname = m.weight.name
+    assert by_layer[wname][1] > 0.0          # grad norm
+    assert by_layer[wname][2] > 0.0          # param norm
+    # SGD: update = lr * grad exactly, so the sampled update ratio is
+    # lr * ||g|| / ||p|| — pins that the fused reduction measured the
+    # REAL delta, not a proxy
+    assert by_layer[wname][3] == pytest.approx(
+        1e-2 * by_layer[wname][1] / by_layer[wname][2], rel=1e-3)
+    assert mtrain.report().startswith("train layer stats")
+    # disabled: the next sampled-phase step records nothing new
+    mtrain.enable(False)
+    mtrain.reset()
+    for _ in range(10):
+        _tiny_step(m, o, X, Y)
+    assert mtrain.layer_stats() == ([], None)
+
+
+# ---------------------------------------------------------------------------
+# host-blocking collective boundaries
+# ---------------------------------------------------------------------------
+
+def test_collective_time_histogram_on_barrier_and_wait():
+    from paddle_tpu import distributed as dist
+
+    h = monitor.histogram("collective/time")
+    before_b = h.labels(kind="barrier").count
+    before_w = h.labels(kind="wait").count
+    dist.barrier()
+    dist.wait(paddle.to_tensor(np.ones(2, np.float32)))
+    assert h.labels(kind="barrier").count == before_b + 1
+    assert h.labels(kind="wait").count == before_w + 1
+
+
+# ---------------------------------------------------------------------------
+# hapi fit loop goodput (eager tiny model — no compiles)
+# ---------------------------------------------------------------------------
+
+def test_fit_loop_reports_goodput_and_step_time():
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.io import TensorDataset
+
+    paddle.seed(13)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 1))
+    model = Model(net)
+    X = np.random.RandomState(0).randn(16, 4).astype("float32")
+    Y = np.random.RandomState(1).randn(16, 1).astype("float32")
+    ds = TensorDataset([paddle.to_tensor(X), paddle.to_tensor(Y)])
+    model.prepare(
+        optimizer=optimizer.Adam(learning_rate=1e-2,
+                                 parameters=net.parameters()),
+        loss=lambda out, lab: ((out - lab) ** 2).mean())
+    model.fit(ds, batch_size=4, epochs=1, verbose=0)
+    snap = monitor.snapshot()
+    assert snap["train/goodput_examples_per_s"] > 0.0
+    assert 0.0 <= snap["train/data_wait_frac"] <= 1.0
+    assert snap["train/step_time"] > 0.0
+    assert monitor.counter("train/examples").value >= 16

@@ -25,8 +25,13 @@ export XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}"
 case "${1:-fast}" in
   fast)
     # unified static analyzer (was: lint_excepts + lint_metrics) — one
-    # shared parse per file, exits nonzero on any unsuppressed finding
+    # shared parse per file, exits nonzero on any unsuppressed finding;
+    # the default scope covers the ISSUE-13 training-microscope modules
+    # (monitor/train.py, resilience/forensics.py, scripts/
+    # train_probe_smoke.py) like everything else under paddle_tpu/
     python -m tools.ptpu_check --json-out /tmp/ptpu_check_report.json
+    # "not slow" includes tests/test_train_stats.py (ISSUE 13: loss-spike
+    # EWMA, goodput math, straggler rollup, forensics — subprocess-free)
     python -m pytest tests/ -m "not slow" -q --ignore=tests/test_examples.py
     # perf-history gate, CPU-smoke lane: the headline bench appends this
     # host's run to BENCH_HISTORY.jsonl, then gates against the trailing
