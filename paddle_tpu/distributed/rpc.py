@@ -87,15 +87,21 @@ def _serve(server):
 
 def _handle(conn):
     from ..monitor import trace as mtrace
+    from ..monitor.wire import RPC_FRAME_MIN
 
     try:
         with conn:
             msg = pickle.loads(_recv_frame(conn))
-            fn, args, kwargs = msg[:3]
-            # 4th element (when present): the caller's inject()-ed span
+            # frame arity is declared in monitor/wire.py (checked by
+            # ptpu-check wire-compat): the first RPC_FRAME_MIN fields
+            # are mandatory, everything beyond is optional — that slice
+            # is what keeps a legacy 3-tuple client working mid-deploy
+            fn, args, kwargs = msg[:RPC_FRAME_MIN]
+            # optional 4th element: the caller's inject()-ed span
             # context — run the callable under a child span so one
             # trace_id spans both processes in export_chrome_trace()
-            ctx = mtrace.extract(msg[3]) if len(msg) > 3 else None
+            ctx = mtrace.extract(msg[RPC_FRAME_MIN]) \
+                if len(msg) > RPC_FRAME_MIN else None
             try:
                 if ctx is not None:
                     with mtrace.attach(ctx), mtrace.span(

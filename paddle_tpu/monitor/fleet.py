@@ -52,6 +52,8 @@ import threading
 import time
 import urllib.request
 
+from .wire import FLEET_HEALTHZ_SCHEMA_VERSION, ROUTER_FEED_KEYS
+
 __all__ = [
     "parse_prometheus", "register_replica", "discover", "FleetAggregator",
     "StragglerRollup", "REPLICA_KEY_PREFIX", "REPLICA_COUNT_KEY",
@@ -493,6 +495,9 @@ class FleetAggregator:
     hangs."""
 
     RATE_COUNTERS = ("serving_decode_tokens", "serving_prefill_tokens")
+    # the snapshot() per-replica key set, for router introspection —
+    # declared in monitor/wire.py, checked by ptpu-check wire-compat
+    FEED_KEYS = ROUTER_FEED_KEYS
 
     def __init__(self, endpoints=None, store: str = None,
                  interval: float = 2.0, stall_after_s: float = 10.0,
@@ -523,6 +528,10 @@ class FleetAggregator:
         self._slot_cache = {}   # slot -> record dict | miss count
         #                         (poll-thread-private, no lock needed)
         self._pool = None       # lazy shared scrape executor
+        self._inflight = {}     # name -> future still RUNNING after its
+        #                         cycle budget expired (poll-thread-
+        #                         private): a wedged scrape must not get
+        #                         a second worker stacked on it
         self._store_cli = None  # persistent discovery connection
         for ep in endpoints or ():
             if isinstance(ep, str):
@@ -630,12 +639,11 @@ class FleetAggregator:
         # black-holed endpoint delay every other replica's scrape by
         # scrape_timeout — slowest exactly during the multi-replica
         # failures the rollup exists to catch.  One long-lived pool
-        # (workers spawn lazily), not a fresh executor per cycle
+        # (workers spawn lazily), not a fresh executor per cycle.  The
+        # single-replica case rides the pool too: an inline scrape
+        # would be unbounded against a wedged resolver.
         results = {}
-        if len(targets) <= 1:
-            for name, url in targets:
-                results[name] = scrape(url)
-        else:
+        if targets:
             with self._lock:
                 pool = self._pool
                 if pool is None:
@@ -643,10 +651,38 @@ class FleetAggregator:
                         concurrent.futures.ThreadPoolExecutor(
                             max_workers=16,
                             thread_name_prefix="ptpu-fleet-scrape")
-            futs = {name: pool.submit(scrape, url)
-                    for name, url in targets}
+            # bounded wait (ISSUE 14 blocking-in-handler): scrape()
+            # itself is fetch-timeout-bounded, but an injected fetch or
+            # a wedged RESOLVER isn't (urllib's timeout does not bound
+            # DNS) — an unbounded result() here would hang the
+            # aggregator's daemon loop forever.  An expiry counts
+            # toward the replica's down streak like any other scrape
+            # failure.  A future still RUNNING past its budget keeps
+            # its worker (threads can't be killed) but is remembered in
+            # _inflight so the NEXT cycle does not stack a second
+            # worker on the same black hole — one permanently wedged
+            # endpoint costs one pool worker total, not one per cycle.
+            futs = {}
+            for name, url in targets:
+                prev = self._inflight.get(name)
+                if prev is not None and not prev.done():
+                    results[name] = (None, None, TimeoutError(
+                        "scrape still wedged from a previous cycle"))
+                    continue
+                self._inflight.pop(name, None)
+                futs[name] = pool.submit(scrape, url)
+            deadline = time.monotonic() + 2.0 * self.scrape_timeout + 1.0
             for name, fut in futs.items():
-                results[name] = fut.result()
+                try:
+                    results[name] = fut.result(
+                        timeout=max(deadline - time.monotonic(), 0.01))
+                except concurrent.futures.TimeoutError:
+                    results[name] = (None, None, TimeoutError(
+                        "scrape exceeded the cycle budget"))
+                    # cancel() drops it if still queued; a running one
+                    # is remembered instead of duplicated next cycle
+                    if not fut.cancel():
+                        self._inflight[name] = fut
 
         harvests = []
         now = time.monotonic()
@@ -830,6 +866,7 @@ class FleetAggregator:
         with self._lock:
             for r in sorted(self._replicas.values(),
                             key=lambda x: x.name):
+                # ptpu-wire: router-feed
                 out[r.name] = {
                     "url": r.url,
                     "state": r.state,
@@ -892,8 +929,10 @@ class FleetAggregator:
         strag.pop("skews", None)   # per-replica skew rides each
         #                            replica's snapshot entry
         # schema v2 adds the "straggler" rollup (keys only ever accrete;
-        # v1 consumers ignore it)
-        return {"status": status, "schema_version": 2,
+        # v1 consumers ignore it); declared in monitor/wire.py so drift
+        # is a lint failure (ISSUE 14)
+        return {"status": status,
+                "schema_version": FLEET_HEALTHZ_SCHEMA_VERSION,
                 "stall_after_s": self.stall_after_s,
                 "down_after": self.down_after,
                 "loop_errors": loop_errors,

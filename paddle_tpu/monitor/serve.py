@@ -51,8 +51,9 @@ _started_at = time.monotonic()
 # /healthz schema: version bumped whenever keys are added (never removed/
 # renamed — the PR-5 endpoint consumers stay byte-compatible).  v3 adds
 # the process-identity gauges (rss_bytes, open_fds) the fleet router's
-# load-aware dispatch wants.
-SCHEMA_VERSION = 3
+# load-aware dispatch wants.  Declared in the ONE wire registry
+# (monitor/wire.py) so version-skew drift is a lint failure (ISSUE 14).
+from .wire import HEALTHZ_SCHEMA_VERSION as SCHEMA_VERSION  # noqa: E402
 
 _identity_override = {}
 

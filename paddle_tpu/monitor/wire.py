@@ -1,0 +1,77 @@
+"""The ONE declared wire-protocol registry (ISSUE 14).
+
+Every cross-process surface this framework speaks — the rpc frame, the
+``/healthz`` document, the fleet router feed — is versioned HERE, and
+``ptpu-check``'s ``wire-compat`` rule statically checks the implementing
+modules against these declarations.  The PR-9/PR-10 review rounds each
+fixed a version-skew hazard by hand (legacy 3-tuple rpc frames, healthz
+``schema_version`` bumps, accrete-only router-feed keys); with the
+registry, drifting one side without the other is a lint failure instead
+of a deploy incident.
+
+Rules of the road (enforced by convention + lint, in matching order):
+
+- **rpc frame**: a pickled tuple.  Arity must stay within
+  ``[RPC_FRAME_MIN, RPC_FRAME_MAX]`` — the receiver slices the first
+  ``RPC_FRAME_MIN`` mandatory fields and treats the rest as optional,
+  so an old server keeps accepting a new client's frame ONLY while the
+  new fields stay beyond the mandatory slice.  Growing the frame means
+  bumping ``RPC_FRAME_MAX`` here first.
+- **/healthz**: ``schema_version`` only ever INCREASES and keys only
+  ever accrete (PR-5 consumers stay byte-compatible).  The per-replica
+  document and the fleet rollup version independently.
+- **router feed**: the per-replica dict ``fleet.FleetAggregator
+  .snapshot()`` hands the load-aware router.  Keys only accrete; a
+  replica predating a key reads ``None``, never ``KeyError``.  The
+  canonical builder carries a ``# ptpu-wire: router-feed`` anchor and
+  must emit EXACTLY these keys.
+
+stdlib-only, import-light: both ``monitor`` (serve/fleet) and
+``distributed.rpc`` import this module at startup.
+"""
+from __future__ import annotations
+
+__all__ = ["RPC_FRAME_MIN", "RPC_FRAME_MAX", "HEALTHZ_SCHEMA_VERSION",
+           "FLEET_HEALTHZ_SCHEMA_VERSION", "ROUTER_FEED_KEYS"]
+
+# rpc wire frame: (fn, args, kwargs[, trace_hdr]) — the legacy 3-tuple
+# is still accepted by every server (PR-9's mid-deploy contract)
+RPC_FRAME_MIN = 3
+RPC_FRAME_MAX = 4
+
+# /healthz per-replica document (monitor/serve.py): v3 = PR-10's process
+# identity (rss_bytes, open_fds) on top of v2's host/rank/replica_id
+HEALTHZ_SCHEMA_VERSION = 3
+
+# /fleet/healthz rollup (monitor/fleet.py): v2 = PR-11's straggler block
+FLEET_HEALTHZ_SCHEMA_VERSION = 2
+
+# the load-aware-routing feed: FleetAggregator.snapshot()'s per-replica
+# keys, in emission order.  Accrete-only — removing or renaming one is a
+# wire break for every router built on the feed.
+ROUTER_FEED_KEYS = (
+    "url",
+    "state",
+    "host",
+    "pid",
+    "queue_depth",
+    "running",
+    "waiting",
+    "decode_tokens_per_s",
+    "goodput_tokens_per_s",
+    "padding_waste_rows",
+    "kernels_per_step",
+    "step_time",
+    "goodput_examples_per_s",
+    "data_wait_frac",
+    "straggler_skew",
+    "rss_bytes",
+    "open_fds",
+    "uptime_s",
+    "last_activity_age_s",
+    "scrape_age_s",
+    "scrape_errors",
+    "fail_streak",
+    "last_err",
+    "harvested",
+)

@@ -172,6 +172,10 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
         def work():
             try:
                 while True:
+                    # ptpu-check[blocking-in-handler]: sentinel-terminated
+                    # consumer — feed() always enqueues one `end` per
+                    # worker, so this get() is woken on every shutdown
+                    # path; a timeout would only add spurious wakeups
                     got = in_q.get()
                     if got is end:
                         break

@@ -320,6 +320,12 @@ class CheckpointManager:
 
     def _drain(self):
         while True:
+            # ptpu-check[blocking-in-handler]: idle-state block of a
+            # daemon consumer — the blocking get() IS the worker's
+            # parked state between saves (None would be a shutdown
+            # sentinel if one were ever sent; the thread is daemon and
+            # dies with the process).  A timeout would only add
+            # spurious wakeups between checkpoints.
             item = self._q.get()
             if item is None:
                 return

@@ -588,12 +588,17 @@ class LLMEngine:
         bb = (self.scheduler.max_num_seqs if ragged
               else self._bucket_batch(n))
         num_slots = self.cache.num_slots
-        toks = np.zeros((bb, 1), np.int32)
-        pos0 = np.zeros((bb,), np.int32)
-        lens = np.zeros((bb,), np.int32)
+        # recompile-hazard markers below: on the ragged DEFAULT bb is
+        # the FIXED max_num_seqs (zero hazard); only the bucketed
+        # fallback derives bb from len(rows), and there the pow-2
+        # bucketing bounds the program count at log2(max_num_seqs) BY
+        # DESIGN (pinned by the bucket-crossing recompile tests)
+        toks = np.zeros((bb, 1), np.int32)  # ptpu-check[recompile-hazard]: pow2-bounded, see above
+        pos0 = np.zeros((bb,), np.int32)  # ptpu-check[recompile-hazard]: pow2-bounded, see above
+        lens = np.zeros((bb,), np.int32)  # ptpu-check[recompile-hazard]: pow2-bounded, see above
         tables = np.full((bb, self.blocks_per_seq), self.cache.num_blocks,
-                         np.int32)
-        slots = np.full((bb, 1), num_slots, np.int32)
+                         np.int32)  # ptpu-check[recompile-hazard]: pow2-bounded, see above
+        slots = np.full((bb, 1), num_slots, np.int32)  # ptpu-check[recompile-hazard]: pow2-bounded, see above
         for i, req in enumerate(rows):
             toks[i, 0] = req.output_ids[-1] if req.output_ids \
                 else req.prompt_ids[-1]

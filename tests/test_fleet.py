@@ -558,6 +558,45 @@ def test_rollup_stall_transition_harvests_once(fake, tmp_path):
     assert len(snap["r0"]["harvested"]) == 2
 
 
+def test_wedged_scrape_is_bounded_and_not_restacked(fake, tmp_path):
+    """ISSUE 14: a black-holed endpoint (wedged resolver — urllib's
+    timeout does not bound DNS) must cost ONE bounded cycle budget and
+    ONE pool worker total, not hang poll_once or leak a worker per
+    cycle until the 16-slot pool is exhausted."""
+    import threading as _threading
+    gate = _threading.Event()
+    wedged_entries = []
+    real_fetch = fake.fetch
+
+    def fetch(url):
+        if "fake-r1" in url:
+            wedged_entries.append(url)
+            gate.wait(30)
+            raise ConnectionError("unwedged late")
+        return real_fetch(url)
+
+    agg = fleet.FleetAggregator(
+        endpoints=fake.endpoints(), store=None,
+        harvest_dir=str(tmp_path), fetch=fetch,
+        scrape_timeout=0.05, stall_after_s=1.0, down_after=99)
+    t0 = time.monotonic()
+    s1 = agg.poll_once()
+    s2 = agg.poll_once()
+    wall = time.monotonic() - t0
+    assert wall < 10.0, f"poll cycles not bounded: {wall:.1f}s"
+    # the healthy replica keeps scraping while r1 is wedged
+    assert s1["r0"] == "healthy" and s2["r0"] == "healthy"
+    # ONE worker total on the black hole — cycle 2 did not stack
+    assert len(wedged_entries) == 1
+    snap = agg.snapshot()
+    assert "wedged" in (snap["r1"]["last_err"] or "")
+    # unwedge: the orphaned worker finishes, the next cycle resubmits
+    gate.set()
+    time.sleep(0.2)
+    agg.poll_once()
+    assert len(wedged_entries) == 2
+
+
 def test_rollup_down_after_failure_streak(fake, tmp_path):
     agg = _agg(fake, tmp_path)
     agg.poll_once()

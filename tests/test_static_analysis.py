@@ -464,7 +464,9 @@ def test_cli_json_stable_and_exit_codes(tmp_path):
                         timeout=120)
     assert p1.returncode == 1 and p1.stdout == p2.stdout
     doc = json.loads(p1.stdout)
-    assert doc["version"] == 1 and doc["tool"] == "ptpu_check"
+    # schema v2 (ISSUE 14): adds `incremental` (null on whole-tree runs)
+    assert doc["version"] == 2 and doc["tool"] == "ptpu_check"
+    assert doc["incremental"] is None
     assert set(doc["counts"]) == {"findings", "baselined", "errors"}
     f = doc["findings"][0]
     assert set(f) == {"rule", "path", "line", "col", "message"}
@@ -497,6 +499,602 @@ def test_migrate_legacy_preserves_justification(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# resource-leak (v2) — PR-9's hung store registration + PR-2's leaked
+# `_requests`
+# ---------------------------------------------------------------------------
+
+# minimized PR-9 reproduction: the fleet store client dialed the
+# rendezvous store with no timeout; a store that accepted but never
+# answered hung registration inside start_server's lock forever
+STORE_REGISTRATION_FIXTURE = """\
+import socket
+
+
+def register(host, port, payload):
+    sock = socket.create_connection((host, port))
+    sock.sendall(payload)
+    return sock.recv(4)
+"""
+
+# minimized PR-2 reproduction: generate() allocated KV blocks, stepped
+# (which can raise), and released at the end OUTSIDE a finally —
+# `_requests` grew unboundedly on every error path until the release
+# moved into a finally
+LEAKED_REQUESTS_FIXTURE = """\
+class Engine:
+    def generate(self, rid, n):
+        self.cache.allocate(rid, n)
+        while self.step():
+            pass
+        self.cache.release_request(rid)
+
+    def generate_fixed(self, rid, n):
+        self.cache.allocate(rid, n)
+        try:
+            while self.step():
+                pass
+        finally:
+            self.cache.release_request(rid)
+
+    def add_request(self, rid, n):
+        self.cache.allocate(rid, n)     # acquire-only: ownership moves
+        self._requests[rid] = n
+"""
+
+
+def test_resource_leak_catches_pr9_hung_registration(tmp_path):
+    r = check(tmp_path, **{"store.py": STORE_REGISTRATION_FIXTURE})
+    l = [f for f in r.new if f.rule == "resource-leak"]
+    assert len(l) == 1 and "timeout" in l[0].message
+    assert "PR-9" in l[0].message
+
+
+def test_resource_leak_catches_pr2_leaked_requests(tmp_path):
+    r = check(tmp_path, **{"engine.py": LEAKED_REQUESTS_FIXTURE})
+    l = [f for f in r.new if f.rule == "resource-leak"]
+    # generate() flags; generate_fixed (finally) and add_request
+    # (ownership transfer) are clean
+    assert len(l) == 1 and l[0].line == 3
+    assert "finally" in l[0].message
+
+
+def test_resource_leak_thread_and_tmpdir(tmp_path):
+    r = check(tmp_path, **{"a.py": (
+        "import tempfile\n"
+        "import threading\n"
+        "def leak_thread():\n"
+        "    t = threading.Thread(target=work)\n"
+        "    t.start()\n"
+        "    t.join()\n"                     # unbounded join
+        "def ok_daemon():\n"
+        "    t = threading.Thread(target=work, daemon=True)\n"
+        "    t.start()\n"
+        "def leak_dir():\n"
+        "    d = tempfile.mkdtemp()\n"
+        "    build()\n"                      # may raise; d never freed
+        "    return None\n")})
+    l = [f for f in r.new if f.rule == "resource-leak"]
+    assert len(l) == 2
+    assert any("join" in f.message for f in l)
+    assert any("temp dir" in f.message for f in l)
+
+
+def test_resource_leak_with_socket_still_needs_timeout(tmp_path):
+    # rewriting the PR-9 bug with `with` guarantees the RELEASE, not
+    # the timeout — the hang class must stay visible
+    r = check(tmp_path, **{"a.py": (
+        "import socket\n"
+        "def reg(host, port):\n"
+        "    with socket.create_connection((host, port)) as s:\n"
+        "        s.sendall(b'x')\n"
+        "        return s.recv(4)\n")})
+    l = [f for f in r.new if f.rule == "resource-leak"]
+    assert len(l) == 1 and "timeout" in l[0].message
+    # with + timeout= is fully clean (release AND bound)
+    r = check(tmp_path, **{"b.py": (
+        "import socket\n"
+        "def reg(host, port):\n"
+        "    with socket.create_connection((host, port), timeout=5) as s:\n"
+        "        return s.recv(4)\n")})
+    assert "resource-leak" not in rules_of(r)
+
+
+def test_resource_leak_suppression_and_clean_shapes(tmp_path):
+    r = check(tmp_path, **{"a.py": (
+        "import socket\n"
+        "def probe(host, port):\n"
+        "    # ptpu-check[resource-leak]: deliberate no-timeout probe —\n"
+        "    # the caller runs this under its own watchdog\n"
+        "    s = socket.create_connection((host, port))\n"
+        "    return s\n")})
+    assert "resource-leak" not in rules_of(r)
+    r = check(tmp_path, **{"b.py": (
+        "import socket\n"
+        "def ok_with(host, port):\n"
+        "    with socket.create_connection((host, port), timeout=5) as s:\n"
+        "        s.sendall(b'x')\n"
+        "def ok_settimeout(host, port):\n"
+        "    s = socket.create_connection((host, port))\n"
+        "    s.settimeout(5.0)\n"
+        "    return s\n")})
+    assert "resource-leak" not in rules_of(r)
+
+
+# ---------------------------------------------------------------------------
+# blocking-in-handler (v2) — unbounded blocking reachable from
+# signal/http/daemon contexts, via the call graph
+# ---------------------------------------------------------------------------
+
+BLOCKING_FIXTURE = """\
+import signal
+import threading
+import time
+from http.server import BaseHTTPRequestHandler
+
+_lock = threading.Lock()
+
+
+def _helper():
+    _lock.acquire()            # unbounded, reached from the handler
+
+
+def on_term(signum, frame):
+    _helper()
+    time.sleep(1.0)            # sleeping in a signal context
+
+
+class H(BaseHTTPRequestHandler):
+    def do_GET(self):
+        self.worker.join()     # unbounded join in an http handler
+
+
+def loop():
+    q.get()                    # unbounded get in a daemon loop
+
+
+signal.signal(signal.SIGTERM, on_term)
+threading.Thread(target=loop, daemon=True).start()
+"""
+
+
+def test_blocking_in_handler_catches_all_contexts(tmp_path):
+    r = check(tmp_path, **{"handlers.py": BLOCKING_FIXTURE})
+    b = [f for f in r.new if f.rule == "blocking-in-handler"]
+    msgs = " ".join(f.message for f in b)
+    assert len(b) == 4
+    assert "acquire" in msgs and "sleep" in msgs and "join" in msgs \
+        and "get" in msgs
+    # each finding names its never-block entry
+    assert "signal handler" in msgs and "http handler" in msgs \
+        and "daemon-thread" in msgs
+
+
+def test_blocking_in_handler_unreachable_and_suppression(tmp_path):
+    # same blocking calls NOT reachable from any handler context: clean
+    r = check(tmp_path, **{"a.py": (
+        "import threading\n"
+        "_lock = threading.Lock()\n"
+        "def worker():\n"
+        "    _lock.acquire()\n"
+        "    q.get()\n")})
+    assert "blocking-in-handler" not in rules_of(r)
+    r = check(tmp_path, **{"b.py": (
+        "import signal\n"
+        "def on_term(signum, frame):\n"
+        "    # ptpu-check[blocking-in-handler]: sentinel-terminated —\n"
+        "    # shutdown always enqueues the wakeup\n"
+        "    q.get()\n"
+        "signal.signal(signal.SIGTERM, on_term)\n")})
+    assert "blocking-in-handler" not in rules_of(r)
+
+
+def test_blocking_bounded_calls_are_clean(tmp_path):
+    r = check(tmp_path, **{"a.py": (
+        "import signal\n"
+        "def on_term(signum, frame):\n"
+        "    ok = _lock.acquire(timeout=1.0)\n"
+        "    t.join(2.0)\n"
+        "    q.get(timeout=0.5)\n"
+        "signal.signal(signal.SIGTERM, on_term)\n")})
+    assert "blocking-in-handler" not in rules_of(r)
+
+
+# ---------------------------------------------------------------------------
+# recompile-hazard (v2) — the static twin of PR-10's runtime
+# jit/recompile_cause explainer
+# ---------------------------------------------------------------------------
+
+# minimized PR-10/PR-2 reproduction: the engine's host-side decode body
+# built device buffers from len(rows) and dispatched a jitted step —
+# every batch-size crossing compiled a fresh program (the recompile
+# storm the runtime explainer attributes to axis "batch")
+RECOMPILE_FIXTURE = """\
+import jax
+import numpy as np
+
+
+def _step(toks):
+    return toks
+
+
+_exec = jax.jit(_step)
+
+
+def decode_body(rows):
+    n = len(rows)
+    toks = np.zeros((n, 1), np.int32)
+    return _exec(toks)
+"""
+
+
+def test_recompile_hazard_catches_varying_shape(tmp_path):
+    r = check(tmp_path, **{"engine.py": RECOMPILE_FIXTURE})
+    h = [f for f in r.new if f.rule == "recompile-hazard"]
+    assert len(h) == 1 and "len(" in h[0].message
+    assert "fresh program" in h[0].message
+
+
+def test_recompile_hazard_catches_varying_static_position(tmp_path):
+    r = check(tmp_path, **{"a.py": (
+        "import jax\n"
+        "def step(x, bucket):\n"
+        "    return x\n"
+        "_exec = jax.jit(step, static_argnums=(1,))\n"
+        "def drive(x, rows):\n"
+        "    return _exec(x, len(rows))\n")})
+    h = [f for f in r.new if f.rule == "recompile-hazard"]
+    assert len(h) == 1 and "static position 1" in h[0].message
+
+
+def test_recompile_hazard_exemptions(tmp_path):
+    # .shape-derived shapes follow the input's existing specialization;
+    # len() of an ARRAY is shape-following too; traced functions are
+    # host-sync's domain — all clean
+    r = check(tmp_path, **{"a.py": (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "def traced(x):\n"
+        "    return jnp.zeros(x.shape[0])\n"
+        "g = jax.jit(traced)\n"
+        "def host(x, boxes_num):\n"
+        "    bn = np.asarray(boxes_num)\n"
+        "    idx = np.arange(len(bn))\n"     # len(array): shape-following
+        "    b = x.shape[0]\n"
+        "    buf = np.zeros((b, 4))\n"       # .shape-derived: no new axis
+        "    return g(buf)\n")})
+    assert "recompile-hazard" not in rules_of(r)
+
+
+def test_recompile_hazard_suppression(tmp_path):
+    r = check(tmp_path, **{"a.py": (
+        "import jax\n"
+        "import numpy as np\n"
+        "def step(t):\n"
+        "    return t\n"
+        "_exec = jax.jit(step)\n"
+        "def drive(rows):\n"
+        "    n = len(rows)\n"
+        "    # ptpu-check[recompile-hazard]: pow2-bucketed — program\n"
+        "    # count bounded at log2(max_num_seqs)\n"
+        "    toks = np.zeros((n, 1), np.int32)\n"
+        "    return _exec(toks)\n")})
+    assert "recompile-hazard" not in rules_of(r)
+
+
+# ---------------------------------------------------------------------------
+# wire-compat (v2) — drift against the declared registry
+# ---------------------------------------------------------------------------
+
+WIRE_REGISTRY_FIXTURE = """\
+RPC_FRAME_MIN = 3
+RPC_FRAME_MAX = 4
+HEALTHZ_SCHEMA_VERSION = 3
+ROUTER_FEED_KEYS = ("queue_depth", "state")
+"""
+
+
+def test_wire_compat_catches_drift(tmp_path):
+    r = check(tmp_path, **{
+        "wire.py": WIRE_REGISTRY_FIXTURE,
+        "rpc.py": ("def _send_frame(s, b):\n"
+                   "    pass\n"
+                   "def call(fn, args, kwargs, hdr, extra):\n"
+                   "    frame = (fn, args, kwargs, hdr, extra)\n"
+                   "    _send_frame(None, frame)\n"
+                   "def serve(msg):\n"
+                   "    fn, args, kwargs, hdr = msg[:4]\n"
+                   "    return fn\n"),
+        "serve.py": ("def healthz():\n"
+                     "    return {'schema_version': 7}\n"),
+        "fleet.py": ("def snapshot():\n"
+                     "    # ptpu-wire: router-feed\n"
+                     "    return {'queue_depth': 1, 'surprise': 2}\n")})
+    w = [f for f in r.new if f.rule == "wire-compat"]
+    msgs = " ".join(f.message for f in w)
+    assert len(w) == 4
+    assert "5 fields" in msgs            # frame grew past RPC_FRAME_MAX
+    assert "mandatory-field slice" in msgs   # msg[:4] vs MIN=3
+    assert "schema_version 7" in msgs
+    assert "undeclared ['surprise']" in msgs \
+        and "misses declared ['state']" in msgs
+
+
+def test_wire_compat_consistent_speakers_are_clean(tmp_path):
+    r = check(tmp_path, **{
+        "wire.py": WIRE_REGISTRY_FIXTURE,
+        "rpc.py": ("from wire import RPC_FRAME_MIN\n"
+                   "def _send_frame(s, b):\n"
+                   "    pass\n"
+                   "def call(fn, args, kwargs, hdr):\n"
+                   "    frame = (fn, args, kwargs) if hdr is None \\\n"
+                   "        else (fn, args, kwargs, hdr)\n"
+                   "    _send_frame(None, frame)\n"
+                   "def serve(msg):\n"
+                   "    fn, args, kwargs = msg[:RPC_FRAME_MIN]\n"
+                   "    extra = msg[3] if len(msg) > 3 else None\n"
+                   "    return fn, extra\n"),
+        "serve.py": ("from wire import HEALTHZ_SCHEMA_VERSION\n"
+                     "def healthz():\n"
+                     "    return {'schema_version': "
+                     "HEALTHZ_SCHEMA_VERSION}\n"),
+        "fleet.py": ("def snapshot():\n"
+                     "    # ptpu-wire: router-feed\n"
+                     "    return {'queue_depth': 1, 'state': 'ok'}\n")})
+    assert "wire-compat" not in rules_of(r)
+
+
+def test_wire_compat_suppression_and_no_registry_silence(tmp_path):
+    # no registry in scope -> the rule stays silent (partial-path runs)
+    r = check(tmp_path, **{"serve.py": (
+        "def healthz():\n"
+        "    return {'schema_version': 99}\n")})
+    assert "wire-compat" not in rules_of(r)
+    r = check(tmp_path, **{
+        "wire.py": WIRE_REGISTRY_FIXTURE,
+        "serve.py": ("def healthz():\n"
+                     "    # ptpu-check[wire-compat]: fixture speaking\n"
+                     "    # the OLD schema on purpose\n"
+                     "    return {'schema_version': 7}\n")})
+    assert "wire-compat" not in rules_of(r)
+
+
+# ---------------------------------------------------------------------------
+# env-flag-drift (v2) — README <-> code, both directions
+# ---------------------------------------------------------------------------
+
+def _env_fixture(tmp_path, readme, code):
+    (tmp_path / "README.md").write_text(readme)
+    # the package root gates the README->code direction (partial-path
+    # runs cannot see the readers)
+    return check(tmp_path, **{"paddle_tpu/__init__.py": "",
+                              "paddle_tpu/mod.py": code})
+
+
+def test_env_flag_drift_both_directions(tmp_path):
+    r = _env_fixture(
+        tmp_path,
+        readme="docs: `PTPU_DOCUMENTED` and `PTPU_PHANTOM` exist\n",
+        code=("import os\n"
+              "A = os.environ.get('PTPU_DOCUMENTED')\n"
+              "B = os.environ.get('PTPU_SECRET_KNOB')\n"))
+    e = [f for f in r.new if f.rule == "env-flag-drift"]
+    assert len(e) == 2
+    undocumented = [f for f in e if "PTPU_SECRET_KNOB" in f.message]
+    phantom = [f for f in e if "PTPU_PHANTOM" in f.message]
+    assert undocumented and undocumented[0].path == "paddle_tpu/mod.py"
+    assert phantom and phantom[0].path == "README.md"
+
+
+def test_env_flag_drift_suppression_and_in_sync(tmp_path):
+    r = _env_fixture(
+        tmp_path,
+        readme="`PTPU_KNOB` documented\n",
+        code=("import os\n"
+              "A = os.environ.get('PTPU_KNOB')\n"))
+    assert "env-flag-drift" not in rules_of(r)
+    r = _env_fixture(
+        tmp_path,
+        readme="nothing documented\n",
+        code=("import os\n"
+              "# ptpu-check[env-flag-drift]: internal debug knob, not\n"
+              "# operator surface\n"
+              "A = os.environ.get('PTPU_INTERNAL_DEBUG')\n"))
+    assert "env-flag-drift" not in rules_of(r)
+
+
+# ---------------------------------------------------------------------------
+# call-graph v2 fixes — aliased partial entries, self.<attr> = callable
+# edges (the v1 gaps that silently shrank host-sync reachability)
+# ---------------------------------------------------------------------------
+
+def test_callgraph_partial_alias_entry(tmp_path):
+    r = check(tmp_path, **{"a.py": (
+        "import jax\n"
+        "import numpy as np\n"
+        "from functools import partial as P\n"
+        "@P(jax.jit, static_argnums=(0,))\n"
+        "def f(n, x):\n"
+        "    return np.asarray(x)\n")})
+    hs = [f for f in r.new if f.rule == "host-sync"]
+    assert len(hs) == 1   # v1 dropped the aliased-partial entry
+
+
+def test_callgraph_self_attr_callable_edges(tmp_path):
+    r = check(tmp_path, **{"e.py": (
+        "import jax\n"
+        "class E:\n"
+        "    def __init__(self):\n"
+        "        self._fn = _impl\n"
+        "    def run(self, x):\n"
+        "        return self._fn(x)\n"
+        "def _impl(x):\n"
+        "    return x.item()\n"
+        "g = jax.jit(E.run)\n")})
+    hs = [f for f in r.new if f.rule == "host-sync"]
+    assert len(hs) == 1 and hs[0].line == 8   # the .item() in _impl
+
+
+# ---------------------------------------------------------------------------
+# donation v2 — module-level bindings, helper returns, jit aliases
+# ---------------------------------------------------------------------------
+
+def test_donation_module_level_binding(tmp_path):
+    r = check(tmp_path, **{"a.py": (
+        "import jax\n"
+        "def step(p, g):\n"
+        "    return p\n"
+        "_update = jax.jit(step, donate_argnums=(0,))\n"
+        "def train(p, g):\n"
+        "    new = _update(p, g)\n"
+        "    return new, p.sum()\n")})   # read after donate
+    d = [f for f in r.new if f.rule == "donation"]
+    assert len(d) == 1
+
+
+def test_donation_through_helper_return(tmp_path):
+    r = check(tmp_path, **{"a.py": (
+        "import jax\n"
+        "def step(p, g):\n"
+        "    return p\n"
+        "def make_update():\n"
+        "    return jax.jit(step, donate_argnums=(0,))\n"
+        "def train(p, g):\n"
+        "    update = make_update()\n"
+        "    new = update(p, g)\n"
+        "    return new, p.sum()\n")})
+    d = [f for f in r.new if f.rule == "donation"]
+    assert len(d) == 1
+
+
+def test_donation_jit_alias(tmp_path):
+    r = check(tmp_path, **{"a.py": (
+        "from jax import jit as J\n"
+        "def step(p, g):\n"
+        "    return p\n"
+        "def train(p, g):\n"
+        "    update = J(step, donate_argnums=(0,))\n"
+        "    new = update(p, g)\n"
+        "    return new, p.sum()\n")})
+    d = [f for f in r.new if f.rule == "donation"]
+    assert len(d) == 1
+
+
+# ---------------------------------------------------------------------------
+# --changed incremental mode
+# ---------------------------------------------------------------------------
+
+def _git(cwd, *args):
+    subprocess.run(["git", "-c", "user.name=t", "-c",
+                    "user.email=t@t", *args],
+                   cwd=cwd, check=True, capture_output=True, timeout=60)
+
+
+@pytest.fixture()
+def changed_repo(tmp_path):
+    """A committed fixture repo: helper.py (clean) <- caller.py, plus an
+    unrelated.py carrying a finding that incremental mode must SKIP."""
+    files = {
+        "helper.py": "def helper(x):\n    return x\n",
+        "caller.py": ("from helper import helper\n"
+                      "def entry(x):\n"
+                      "    return helper(x)\n"),
+        "unrelated.py": ("import time\n"
+                         "def f(t0):\n"
+                         "    return time.time() - t0\n"),
+    }
+    for name, src in files.items():
+        (tmp_path / name).write_text(src)
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", ".")
+    _git(tmp_path, "commit", "-qm", "base")
+    return tmp_path
+
+
+def test_changed_mode_analyzes_closure_only(changed_repo):
+    # mutate helper.py: it now host-syncs; caller.py (unchanged) gains
+    # a jit entry?  no — the ENTRY comes from changing caller.py.  Two
+    # phases: (1) change helper only: its new .item() is reported ONLY
+    # if some entry reaches it — none yet, clean, and unrelated.py's
+    # wall-clock finding is NOT reported (file outside the closure).
+    (changed_repo / "helper.py").write_text(
+        "def helper(x):\n    return x.item()\n")
+    report, _ = run_check(paths=[str(changed_repo)],
+                          repo_root=str(changed_repo),
+                          use_baseline=False, changed_ref="HEAD")
+    assert report.incremental is not None
+    assert report.incremental["changed"] == ["helper.py"]
+    assert "unrelated.py" not in report.incremental["analyzed"]
+    assert "wall-clock" not in [f.rule for f in report.new]
+    # (2) change caller.py to jit the chain: the finding lands in
+    # UNCHANGED helper.py — reachable only because the closure pulled
+    # the callee in
+    (changed_repo / "caller.py").write_text(
+        "import jax\n"
+        "from helper import helper\n"
+        "def entry(x):\n"
+        "    return helper(x)\n"
+        "g = jax.jit(entry)\n")
+    _git(changed_repo, "add", "helper.py")
+    _git(changed_repo, "commit", "-qm", "helper change")
+    report, _ = run_check(paths=[str(changed_repo)],
+                          repo_root=str(changed_repo),
+                          use_baseline=False, changed_ref="HEAD")
+    assert report.incremental["changed"] == ["caller.py"]
+    assert "helper.py" in report.incremental["analyzed"]
+    hs = [f for f in report.new if f.rule == "host-sync"]
+    assert len(hs) == 1 and hs[0].path == "helper.py"
+
+
+def test_changed_mode_rejects_write_baseline(changed_repo):
+    # --write-baseline under --changed would regenerate the baseline
+    # from only the closure's findings, wiping audited entries for
+    # every out-of-scope file — refused before any analysis runs
+    p = subprocess.run(
+        [sys.executable, "-m", "tools.ptpu_check", "--changed", "HEAD",
+         "--write-baseline"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert p.returncode == 2
+    assert "whole-tree" in p.stderr
+
+
+def test_changed_mode_bad_ref_falls_back_to_full(changed_repo):
+    (changed_repo / "unrelated.py").write_text(
+        "import time\n"
+        "def f(t0):\n"
+        "    return time.time() - t0\n"
+        "def g(t1):\n"
+        "    return time.time() - t1\n")
+    report, _ = run_check(paths=[str(changed_repo)],
+                          repo_root=str(changed_repo),
+                          use_baseline=False,
+                          changed_ref="no-such-ref")
+    # fell back to FULL analysis: incremental off, findings reported
+    assert report.incremental is None
+    assert [f.rule for f in report.new].count("wall-clock") == 2
+
+
+def test_changed_mode_five_file_diff_under_budget(changed_repo):
+    # a 5-file diff (plus closure) must stay under the 5 s incremental
+    # budget the fast CI lane rides on — the whole-tree parse+graph
+    # still runs, the per-file rule wall does not
+    for i in range(40):
+        (changed_repo / f"mod{i:02d}.py").write_text(
+            f"def fn{i}(x):\n    return x + {i}\n")
+    _git(changed_repo, "add", ".")
+    _git(changed_repo, "commit", "-qm", "forty modules")
+    for i in range(5):
+        (changed_repo / f"mod{i:02d}.py").write_text(
+            f"def fn{i}(x):\n    return x - {i}\n")
+    report, _ = run_check(paths=[str(changed_repo)],
+                          repo_root=str(changed_repo),
+                          use_baseline=False, changed_ref="HEAD")
+    assert len(report.incremental["changed"]) == 5
+    assert report.elapsed_s < 5.0
+    assert report.clean
+
+
+# ---------------------------------------------------------------------------
 # repo acceptance: the shipped tree is clean, fast, and fully covered
 # ---------------------------------------------------------------------------
 
@@ -521,9 +1119,14 @@ def test_all_rules_documented():
     ids = {r.id for r in ALL_RULES}
     assert ids == {"silent-except", "metric-hygiene", "host-sync",
                    "donation", "lock-discipline", "determinism",
-                   "wall-clock"}
+                   "wall-clock", "resource-leak", "blocking-in-handler",
+                   "recompile-hazard", "wire-compat", "env-flag-drift"}
+    assert len(ALL_RULES) == 12
     for r in ALL_RULES:
         assert r.doc and r.descends_from
     readme = (REPO / "README.md").read_text()
     for rid in ids:
         assert f"`{rid}`" in readme, f"README missing rule {rid}"
+    # the v2 additions are documented: --changed mode + schema v2
+    assert "--changed" in readme
+    assert '"version": 2' in readme or "schema v2" in readme

@@ -6,9 +6,18 @@ by hand (see CHANGES.md / README "Static analysis").  One shared
 rules, per-rule inline suppressions, and a checked-in baseline for
 audited pre-existing sites.
 
+v2 (ISSUE 14) extends the core interprocedurally for the multi-process
+era: acquire/release escape analysis (resource-leak), handler-context
+reachability (blocking-in-handler), the static twin of the runtime
+recompile explainer (recompile-hazard), a declared wire registry
+(wire-compat), README env-flag cross-checking (env-flag-drift), plus
+``--changed <git-ref>`` incremental mode (rules only on the changed
+files' call-graph closure) and the call-graph alias/self-attr fixes.
+
 CLI::
 
-    python -m tools.ptpu_check [--json] [--json-out FILE] [paths...]
+    python -m tools.ptpu_check [--json] [--json-out FILE]
+                               [--changed GIT_REF] [paths...]
 
 Library::
 
@@ -16,4 +25,4 @@ Library::
 """
 from __future__ import annotations
 
-__version__ = "1.0"
+__version__ = "2.0"

@@ -82,6 +82,12 @@ def main(argv=None):
     ap.add_argument("--write-baseline", action="store_true",
                     help="absorb ALL current findings into the baseline "
                          "(the audit workflow) and exit 0")
+    ap.add_argument("--changed", metavar="GIT_REF",
+                    help="incremental mode: run rules only on files "
+                         "changed vs GIT_REF (worktree diff + "
+                         "untracked) plus their call-graph closure; "
+                         "the whole tree is still parsed for "
+                         "reachability")
     ap.add_argument("--rules", metavar="ID[,ID...]",
                     help="run only these rules")
     ap.add_argument("--list-rules", action="store_true")
@@ -112,12 +118,23 @@ def main(argv=None):
         print(f"ptpu_check: migrated {len(changed)} file(s)")
         return 0
 
+    if args.write_baseline and args.changed:
+        # the baseline is regenerated from the CURRENT findings — under
+        # --changed that is only the incremental closure's findings, and
+        # writing it would silently wipe every audited entry for files
+        # outside the closure
+        print("ptpu_check: --write-baseline requires a whole-tree run; "
+              "drop --changed (the baseline must absorb ALL current "
+              "findings, not the incremental closure's)",
+              file=sys.stderr)
+        return 2
+
     rule_ids = [r.strip() for r in args.rules.split(",")] if args.rules \
         else None
     try:
         report, project = run_check(
             paths=paths, rule_ids=rule_ids, baseline_path=args.baseline,
-            use_baseline=not args.no_baseline)
+            use_baseline=not args.no_baseline, changed_ref=args.changed)
     except ValueError as e:
         print(f"ptpu_check: {e}", file=sys.stderr)
         return 2
@@ -144,6 +161,11 @@ def main(argv=None):
         status = "clean" if report.clean else \
             f"{n + len(report.errors)} violation(s)"
         extra = f", {b} baselined" if b else ""
+        if report.incremental is not None:
+            inc = report.incremental
+            extra += (f"; --changed {inc['ref']}: "
+                      f"{len(inc['changed'])} changed -> "
+                      f"{len(inc['analyzed'])} analyzed")
         print(f"ptpu_check: {status} ({len(project.contexts)} files, "
               f"{report.elapsed_s:.1f}s{extra})")
     return 0 if report.clean else 1

@@ -1,9 +1,20 @@
 """Library entry point: run the analyzer over paths, partition against
 the baseline, and report — the CLI and the test suite both drive this.
+
+Incremental mode (``--changed <git-ref>``): every file is still PARSED
+(the call graph needs the whole tree — reachability is global), but the
+RULES — the expensive 80% — run only on files changed vs the ref plus
+their call-graph closure (callers of changed functions, whose findings
+can appear/vanish when a callee changes, AND callees reached by changed
+functions, where a changed caller can put a new jit entry / handler
+context above unchanged code).  The fast CI lane pays ~2 s of
+parse+graph instead of the whole-tree rule wall.
 """
 from __future__ import annotations
 
 import os
+import subprocess
+import sys
 import time
 
 from . import rules as rules_pkg
@@ -17,24 +28,30 @@ DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 
 class Report:
-    def __init__(self, new, baselined, errors, rules, paths, elapsed_s):
+    def __init__(self, new, baselined, errors, rules, paths, elapsed_s,
+                 incremental=None):
         self.new = new                 # unsuppressed, non-baselined
         self.baselined = baselined
         self.errors = errors           # syntax errors etc.
         self.rules = rules
         self.paths = paths
         self.elapsed_s = elapsed_s
+        self.incremental = incremental  # {ref, changed, analyzed} or None
 
     @property
     def clean(self):
         return not self.new and not self.errors
 
     def as_json(self) -> dict:
+        # schema v2 (ISSUE 14): adds the `incremental` block (null on
+        # whole-tree runs).  v1 keys are byte-identical otherwise —
+        # consumers keying on `counts`/`findings` are unaffected.
         return {
-            "version": 1,
+            "version": 2,
             "tool": "ptpu_check",
             "rules": [r.id for r in self.rules],
             "paths": list(self.paths),
+            "incremental": self.incremental,
             "counts": {"findings": len(self.new),
                        "baselined": len(self.baselined),
                        "errors": len(self.errors)},
@@ -44,11 +61,34 @@ class Report:
         }
 
 
+def _git_changed(repo_root, ref):
+    """Repo-relative .py files changed vs `ref` (worktree diff +
+    untracked).  Raises RuntimeError when git cannot answer."""
+    def lines(args):
+        p = subprocess.run(["git", *args], cwd=repo_root,
+                           capture_output=True, text=True, timeout=30)
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"git {' '.join(args)} failed: {p.stderr.strip()}")
+        return [ln for ln in p.stdout.splitlines() if ln.endswith(".py")]
+
+    changed = set(lines(["diff", "--name-only", ref, "--", "*.py"]))
+    changed.update(lines(["ls-files", "--others", "--exclude-standard",
+                          "--", "*.py"]))
+    return changed
+
+
 def run_check(paths=None, repo_root=None, rule_ids=None,
-              baseline_path=DEFAULT_BASELINE, use_baseline=True):
+              baseline_path=DEFAULT_BASELINE, use_baseline=True,
+              changed_ref=None):
     """Analyze `paths` (default: paddle_tpu/ tools/ scripts/) and return
     a Report.  One parse per file; rules share the parse and the lazily
-    built call graph."""
+    built call graph.  `changed_ref` switches to incremental mode:
+    rules run only on files changed vs that git ref plus their
+    call-graph closure (the whole tree is still parsed for
+    reachability).  A git failure falls back to the full analysis with
+    a warning — incremental mode must never hide findings because the
+    ref was bad."""
     t0 = time.perf_counter()
     repo_root = os.path.abspath(repo_root or REPO_ROOT)
     if not paths:
@@ -71,11 +111,28 @@ def run_check(paths=None, repo_root=None, rule_ids=None,
             e = ctx.syntax_error
             errors.append(Finding("syntax-error", ctx.rel, e.lineno or 0,
                                   0, f"syntax error: {e.msg}"))
-    project = Project(contexts)
+    project = Project(contexts, repo_root=repo_root)
+
+    incremental = None
+    target_rels = None
+    if changed_ref:
+        try:
+            changed = _git_changed(repo_root, changed_ref)
+        except (RuntimeError, OSError) as e:
+            print(f"ptpu_check: --changed fell back to full analysis "
+                  f"({e})", file=sys.stderr)
+            changed = None
+        if changed is not None:
+            in_scope = sorted(changed & set(project.by_rel))
+            target_rels = project.callgraph.file_closure(in_scope)
+            incremental = {"ref": changed_ref, "changed": in_scope,
+                           "analyzed": sorted(target_rels)}
 
     findings = []
     for ctx in project.contexts:
         if ctx.tree is None:
+            continue
+        if target_rels is not None and ctx.rel not in target_rels:
             continue
         for line in ctx.bare_markers():
             errors.append(Finding(
@@ -101,7 +158,8 @@ def run_check(paths=None, repo_root=None, rule_ids=None,
     else:
         new, old = findings, []
     return Report(new, old, errors, rule_classes, paths,
-                  time.perf_counter() - t0), project
+                  time.perf_counter() - t0,
+                  incremental=incremental), project
 
 
 def write_baseline(report, project, baseline_path=DEFAULT_BASELINE):

@@ -3,11 +3,16 @@
 Scope and honesty: this is a LINT-grade graph, not a type checker.  It
 resolves (a) plain-name calls/references through the lexical chain
 (nested defs -> module top level -> imports), (b) ``self.method`` inside
-a class, and (c) ``alias.func`` where ``alias`` is an imported module
-that is part of the analyzed file set.  Dynamic dispatch, inheritance
-and higher-order returns are over/under-approximated; rules built on it
-(host-sync) pair with the baseline/suppression workflow for the
-residue.
+a class — including methods BOUND via ``self.<attr> = <callable>``
+assignments (the engine's ``self._fn = _impl`` pattern dropped edges in
+v1, silently shrinking host-sync reachability), (c) ``Class.method``
+references by class name, and (d) ``alias.func`` where ``alias`` is an
+imported module that is part of the analyzed file set — ``import x.y as
+z`` and ``from x import y as z`` forms included (``functools.partial``
+under an alias is resolved too).  Dynamic dispatch, inheritance and
+higher-order returns are over/under-approximated; rules built on it
+(host-sync, blocking-in-handler, recompile-hazard) pair with the
+baseline/suppression workflow for the residue.
 
 Trace entries — where XLA tracing starts and host syncs become hidden
 recompiles/transfers:
@@ -84,6 +89,8 @@ class ModuleIndex:
         self.dotted = dotted           # e.g. 'paddle_tpu.serving.engine'
         self.top = {}                  # name -> FuncInfo (module level)
         self.classes = {}              # class name -> {meth name -> FuncInfo}
+        self.class_attrs = {}          # class name -> {attr -> FuncInfo}
+        #                                (self.<attr> = <callable> bindings)
         self.mod_alias = {}            # local name -> dotted module
         self.sym_import = {}           # local name -> (dotted module, symbol)
 
@@ -104,6 +111,7 @@ def _module_dotted(rel):
 class CallGraph:
     def __init__(self, contexts):
         self.functions = {}            # key -> FuncInfo
+        self._by_node = {}             # id(ast node) -> FuncInfo
         self.indexes = {}              # rel -> ModuleIndex
         self._dotted_to_rel = {}
         self.entries = {}              # key -> reason str
@@ -116,10 +124,13 @@ class CallGraph:
             self._index_module(c)
         for c in ctxs:
             self._resolve_imports(c)
+        for c in ctxs:
+            self._index_class_attrs(c)
         self._edges = {}               # key -> set of keys
         for c in ctxs:
             self._collect_edges_and_entries(c)
         self._propagate()
+        self._redges = None            # reverse edges, built lazily
 
     # -- indexing ----------------------------------------------------------
 
@@ -135,6 +146,7 @@ class CallGraph:
                     fi = FuncInfo((ctx.rel, q), child, ctx.rel, q,
                                   class_name=class_name, parent=parent)
                     self.functions[fi.key] = fi
+                    self._by_node[id(child)] = fi
                     if parent is not None:
                         parent.locals_[child.name] = fi
                     elif class_name is not None:
@@ -180,6 +192,33 @@ class CallGraph:
                     else:
                         idx.sym_import[local] = (base, a.name)
 
+    def _index_class_attrs(self, ctx):
+        """``self.<attr> = <callable>`` bindings inside a class's methods
+        bind the attribute to that callable for every ``self.<attr>(...)``
+        call site in the class (v1 dropped these edges).  Runs AFTER
+        import resolution so the assigned value can be a module function,
+        an imported symbol, or a sibling method."""
+        idx = self.indexes[ctx.rel]
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            attrs = idx.class_attrs.setdefault(node.name, {})
+            for meth in node.body:
+                if not isinstance(meth, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                fi = self._by_node.get(id(meth))
+                for n in iter_body_nodes(meth):
+                    if not isinstance(n, ast.Assign):
+                        continue
+                    for t in n.targets:
+                        if (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"):
+                            tgt = self.resolve(n.value, idx, fi)
+                            if tgt is not None:
+                                attrs.setdefault(t.attr, tgt)
+
     # -- resolution --------------------------------------------------------
 
     def resolve(self, expr, idx, func=None):
@@ -208,7 +247,13 @@ class CallGraph:
                         f = f.parent
                         cls = f.class_name
                     if cls is not None:
-                        return idx.classes.get(cls, {}).get(expr.attr)
+                        hit = idx.classes.get(cls, {}).get(expr.attr)
+                        if hit is None:   # self.<attr> = <callable>
+                            hit = idx.class_attrs.get(cls,
+                                                      {}).get(expr.attr)
+                        return hit
+                if v.id in idx.classes:   # Class.method reference
+                    return idx.classes[v.id].get(expr.attr)
                 mod = self._local_module(v.id, idx)
                 if mod is not None:
                     rel = self._dotted_to_rel.get(mod)
@@ -240,12 +285,18 @@ class CallGraph:
         return False
 
     def _is_partial_of_jit(self, call, idx):
-        """functools.partial(jax.jit, ...) (decorator form)."""
+        """functools.partial(jax.jit, ...) (decorator form) — incl.
+        ``from functools import partial as P`` aliases (a v1 gap: the
+        aliased form dropped the entry, shrinking host-sync scope)."""
         dn = dotted_name(call.func)
         if dn is None:
             return False
         if dn.rsplit(".", 1)[-1] != "partial" and dn != "partial":
-            return False
+            # aliased symbol import: resolve the local name back to
+            # ('functools', 'partial')
+            if "." in dn or idx.sym_import.get(dn) != ("functools",
+                                                       "partial"):
+                return False
         return bool(call.args) and self.is_jit_entry_callable(call.args[0],
                                                               idx)
 
@@ -316,3 +367,47 @@ class CallGraph:
 
     def index_of(self, rel):
         return self.indexes.get(rel)
+
+    def reachable_from(self, seeds):
+        """{key: origin description} for every function reachable from
+        the seed set ({key: origin}) through call/reference edges —
+        the generic BFS the handler-context and --changed analyses ride
+        (the jit-entry propagation is the same walk with its own seeds)."""
+        out = dict(seeds)
+        work = list(seeds)
+        while work:
+            k = work.pop()
+            origin = out[k]
+            for tgt in self._edges.get(k, ()):
+                if tgt not in out:
+                    out[tgt] = origin
+                    work.append(tgt)
+        return out
+
+    def _reverse_edges(self):
+        if self._redges is None:
+            self._redges = {}
+            for src, tgts in self._edges.items():
+                for t in tgts:
+                    self._redges.setdefault(t, set()).add(src)
+        return self._redges
+
+    def file_closure(self, rels):
+        """Transitive file-level closure of `rels` in BOTH directions:
+        files whose functions call into `rels` (their findings may change
+        when a callee changes — e.g. a helper gaining a host sync) AND
+        files `rels`' functions reach (a changed caller can put a new
+        jit entry above an unchanged callee).  The --changed target set."""
+        want = set(rels)
+        seeds = [k for k in self.functions if k[0] in want]
+        for graph in (self._edges, self._reverse_edges()):
+            work = list(seeds)
+            seen = set(seeds)
+            while work:
+                k = work.pop()
+                want.add(k[0])
+                for nxt in graph.get(k, ()):
+                    if nxt not in seen:
+                        seen.add(nxt)
+                        work.append(nxt)
+        return want

@@ -147,11 +147,15 @@ class Rule:
 
 
 class Project:
-    """All files under analysis + lazily-built cross-file artifacts."""
+    """All files under analysis + lazily-built cross-file artifacts.
+    `repo_root` lets document-facing rules (env-flag-drift) read
+    non-Python sources like README.md without putting them through the
+    Python parse/marker machinery."""
 
-    def __init__(self, contexts):
+    def __init__(self, contexts, repo_root=None):
         self.contexts = list(contexts)
         self.by_rel = {c.rel: c for c in self.contexts}
+        self.repo_root = repo_root
         self._callgraph = None
 
     @property
@@ -217,12 +221,22 @@ class Baseline:
             entries[key] = entries.get(key, 0) + int(e.get("count", 1))
         return cls(entries)
 
+    @staticmethod
+    def _key(f, contexts_by_rel):
+        """Fingerprint via the source line when the finding lives in an
+        analyzed .py file; document findings (README.md) fall back to
+        (rule, path, message) — the message embeds the flag name, so the
+        key is as move-stable as a line fingerprint."""
+        ctx = contexts_by_rel.get(f.path)
+        if ctx is not None:
+            return f.fingerprint(ctx)
+        return (f.rule, f.path, f.message)
+
     @classmethod
     def from_findings(cls, findings, contexts_by_rel):
         entries = {}
         for f in findings:
-            ctx = contexts_by_rel[f.path]
-            key = f.fingerprint(ctx)
+            key = cls._key(f, contexts_by_rel)
             entries[key] = entries.get(key, 0) + 1
         return cls(entries)
 
@@ -245,7 +259,7 @@ class Baseline:
         budget = dict(self.entries)
         new, old = [], []
         for f in findings:
-            key = f.fingerprint(contexts_by_rel[f.path])
+            key = self._key(f, contexts_by_rel)
             if budget.get(key, 0) > 0:
                 budget[key] -= 1
                 old.append(f)

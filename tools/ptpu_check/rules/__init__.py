@@ -2,8 +2,9 @@
 the ordered public list (order = report order, ids are stable API)."""
 from __future__ import annotations
 
-from . import (determinism, donation, excepts, host_sync, locks, metrics,
-               wallclock)
+from . import (blocking, determinism, donation, env_flags, excepts,
+               host_sync, locks, metrics, recompile, resource_leak,
+               wallclock, wire_compat)
 
 ALL_RULES = [
     excepts.SilentExceptRule,
@@ -13,6 +14,11 @@ ALL_RULES = [
     locks.LockDisciplineRule,
     determinism.DeterminismRule,
     wallclock.WallClockRule,
+    resource_leak.ResourceLeakRule,
+    blocking.BlockingInHandlerRule,
+    recompile.RecompileHazardRule,
+    wire_compat.WireCompatRule,
+    env_flags.EnvFlagDriftRule,
 ]
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

@@ -1,0 +1,195 @@
+"""blocking-in-handler — unbounded blocking calls reachable from
+contexts that must never block: signal handlers, HTTP handler methods,
+daemon loop bodies.
+
+The bug class: PR-5 moved signal-path flight dumps onto a helper thread
+because an inline dump could self-deadlock on a lock the interrupted
+main thread held; PR-9 found store registration blocking inside
+``start_server``'s lock wedged every scrape; PR-10's ``/profile``
+endpoint needed single-flight because a second capture would block the
+http daemon thread behind the first.  Each was found by hand.  The
+multi-replica serving tier multiplies handler surface — this rule walks
+the call graph from every handler context and flags unbounded blocking
+primitives inside.
+
+Handler contexts (each finding names its entry, like ``host-sync``):
+
+- functions registered via ``signal.signal(sig, fn)`` — plus every
+  function they reach;
+- ``do_*`` methods on classes whose bases mention
+  ``BaseHTTPRequestHandler`` (the stdlib http handler surface);
+- functions passed as ``target=`` to a ``threading.Thread(...,
+  daemon=True)`` — daemon loop bodies: the process exits WITHOUT
+  joining them, so an unbounded block there dies holding whatever it
+  holds.
+
+Flagged primitives:
+
+- ``x.acquire()`` with neither a ``timeout=`` nor ``blocking=False`` —
+  an unbounded lock wait (``with lock:`` is not flagged: it is the
+  pervasive idiom and rewriting it everywhere is not the lesson;
+  explicit ``acquire()`` is where the hand-audits kept finding hangs);
+- zero-argument ``x.join()`` / ``x.wait()`` / ``x.result()`` /
+  ``x.get()`` — unbounded thread/event/future/queue waits;
+- ``time.sleep(...)`` in SIGNAL contexts only (a daemon loop's cadence
+  sleep is its design; a signal handler sleeping holds the interrupted
+  frame hostage).
+
+Suppress with ``# ptpu-check[blocking-in-handler]: why`` — e.g. a
+bounded-by-construction wait the analysis cannot see.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..callgraph import dotted_name, iter_body_nodes
+from ..core import Rule
+
+UNBOUNDED_ZERO_ARG = {"join": "thread join", "wait": "event/cond wait",
+                      "result": "future result", "get": "queue get"}
+
+
+def _handler_seeds(project):
+    """{func key: (context kind, origin description)} for every handler
+    entry in the analyzed set.  Cached on the project."""
+    cached = getattr(project, "_blocking_seeds", None)
+    if cached is not None:
+        return cached
+    cg = project.callgraph
+    seeds = {}
+    for ctx in project.contexts:
+        if ctx.tree is None:
+            continue
+        idx = cg.index_of(ctx.rel)
+        if idx is None:
+            continue
+        # signal.signal(sig, fn) registrations + daemon Thread targets
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = dotted_name(node.func) or ""
+            if dn.rsplit(".", 1)[-1] == "signal" \
+                    and ("signal" in dn.split(".", 1)[0]
+                         or dn == "signal"):
+                if len(node.args) >= 2:
+                    tgt = cg.resolve(node.args[1], idx,
+                                     _enclosing_func(cg, ctx, node))
+                    if tgt is not None:
+                        seeds.setdefault(tgt.key, (
+                            "signal",
+                            f"registered as a signal handler at "
+                            f"{ctx.rel}:{node.lineno}"))
+            if dn.rsplit(".", 1)[-1] == "Thread":
+                target, daemon = None, False
+                for k in node.keywords:
+                    if k.arg == "target":
+                        target = k.value
+                    if k.arg == "daemon" \
+                            and isinstance(k.value, ast.Constant) \
+                            and k.value.value:
+                        daemon = True
+                if daemon and target is not None:
+                    tgt = cg.resolve(target, idx,
+                                     _enclosing_func(cg, ctx, node))
+                    if tgt is not None:
+                        seeds.setdefault(tgt.key, (
+                            "daemon",
+                            f"daemon-thread loop body (Thread target "
+                            f"at {ctx.rel}:{node.lineno})"))
+        # do_* methods of http handler classes
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            base_names = [dotted_name(b) or getattr(b, "id", "")
+                          for b in node.bases]
+            if not any(b and "HTTPRequestHandler" in b
+                       for b in base_names):
+                continue
+            for meth in node.body:
+                if isinstance(meth, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                        and meth.name.startswith("do_"):
+                    fi = cg._by_node.get(id(meth))
+                    if fi is not None:
+                        seeds.setdefault(fi.key, (
+                            "http",
+                            f"http handler `{node.name}.{meth.name}` "
+                            f"({ctx.rel}:{meth.lineno})"))
+    # BFS: everything a handler context reaches inherits the context
+    reach = cg.reachable_from(seeds)
+    project._blocking_seeds = reach
+    return reach
+
+
+def _enclosing_func(cg, ctx, node):
+    """Best-effort FuncInfo whose body contains `node` (by line range);
+    used only to give resolve() a lexical scope."""
+    best = None
+    for fi in cg.functions.values():
+        if fi.rel != ctx.rel:
+            continue
+        lo = fi.node.lineno
+        hi = getattr(fi.node, "end_lineno", lo)
+        if lo <= getattr(node, "lineno", 0) <= hi:
+            if best is None or fi.node.lineno > best.node.lineno:
+                best = fi
+    return best
+
+
+class BlockingInHandlerRule(Rule):
+    id = "blocking-in-handler"
+    doc = ("no unbounded lock/join/wait/result/get (and no sleep in "
+           "signal contexts) reachable from signal handlers, http "
+           "handlers, or daemon loop bodies")
+    descends_from = ("PR-5: inline flight dumps in signal handlers "
+                     "could self-deadlock on the interrupted frame's "
+                     "locks; PR-9: store registration blocking inside "
+                     "start_server's lock wedged scrapes forever")
+
+    def check(self, ctx, project):
+        reach = _handler_seeds(project)
+        cg = project.callgraph
+        for key, (kind, origin) in sorted(reach.items()):
+            if key[0] != ctx.rel:
+                continue
+            fi = cg.functions[key]
+            where = (f"`{fi.qualname}` is reachable from a "
+                     f"never-block context ({origin})")
+            for n in iter_body_nodes(fi.node):
+                if not isinstance(n, ast.Call):
+                    continue
+                f = n.func
+                if isinstance(f, ast.Attribute):
+                    if f.attr == "acquire":
+                        bounded = any(
+                            k.arg in ("timeout", "blocking")
+                            for k in n.keywords) or n.args
+                        if not bounded and not ctx.suppressed(
+                                self.id, n.lineno):
+                            yield self.finding(
+                                ctx, n,
+                                f"unbounded `.acquire()` — a held "
+                                f"lock wedges this context forever; "
+                                f"acquire(timeout=...) and handle the "
+                                f"miss; {where}")
+                        continue
+                    if f.attr in UNBOUNDED_ZERO_ARG and not n.args \
+                            and not n.keywords:
+                        if not ctx.suppressed(self.id, n.lineno):
+                            yield self.finding(
+                                ctx, n,
+                                f"unbounded `.{f.attr}()` "
+                                f"({UNBOUNDED_ZERO_ARG[f.attr]}) — "
+                                f"give it a timeout and handle the "
+                                f"expiry; {where}")
+                        continue
+                dn = dotted_name(f)
+                if kind == "signal" and dn \
+                        and dn.rsplit(".", 1)[-1] == "sleep" \
+                        and dn.split(".", 1)[0] == "time":
+                    if not ctx.suppressed(self.id, n.lineno):
+                        yield self.finding(
+                            ctx, n,
+                            f"`time.sleep(...)` in a signal context "
+                            f"holds the interrupted frame hostage; "
+                            f"{where}")

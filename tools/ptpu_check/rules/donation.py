@@ -10,7 +10,13 @@ update invalidated reference-only snapshots.
 Local (per-function) dataflow, statements in source order:
 
 - a name bound to ``jax.jit(fn, donate_argnums=(...))`` (literal
-  positions) marks its donated call-arguments;
+  positions) marks its donated call-arguments — LOCAL bindings,
+  MODULE-LEVEL bindings (``_update = jax.jit(...)`` at top level, the
+  engine idiom), and bindings via a HELPER that returns a donating jit
+  call (``update = make_update()``; the helper resolves through the
+  call graph, cross-file included) all count;
+- ``from jax import jit as J`` aliases resolve (v1 only matched dotted
+  ``*.jit`` names);
 - class methods decorated ``@partial(jax.jit, static_argnums=(0,),
   donate_argnums=...)`` donate the corresponding caller positions of
   ``self.method(...)`` calls (self-offset applied);
@@ -45,17 +51,43 @@ def _literal_positions(kw_value):
     return None
 
 
-def _donating_jit_call(node):
-    """Call expr `jax.jit(f, donate_argnums=...)` -> positions or None."""
+def _donating_jit_call(node, idx=None):
+    """Call expr `jax.jit(f, donate_argnums=...)` -> positions or None.
+    With a ModuleIndex, `from jax import jit as J` aliases resolve."""
     if not isinstance(node, ast.Call):
         return None
     dn = dotted_name(node.func)
-    if dn is None or dn.rsplit(".", 1)[-1] not in ("jit", "pjit"):
+    if dn is None:
         return None
+    if dn.rsplit(".", 1)[-1] not in ("jit", "pjit"):
+        if idx is None or "." in dn \
+                or idx.sym_import.get(dn, ("",))[0] != "jax" \
+                or idx.sym_import[dn][1] not in ("jit", "pjit"):
+            return None
     for kw in node.keywords:
         if kw.arg == "donate_argnums":
             return _literal_positions(kw.value)
     return None
+
+
+def _donating_returns(project):
+    """{func key: positions} for functions whose return value is a
+    donating jit call — a caller binding that helper's result holds a
+    donating callable (`update = make_update()`).  Cached."""
+    cached = getattr(project, "_donation_returns", None)
+    if cached is not None:
+        return cached
+    cg = project.callgraph
+    out = {}
+    for fi in cg.functions.values():
+        idx = cg.index_of(fi.rel)
+        for n in ast.walk(fi.node):
+            if isinstance(n, ast.Return):
+                pos = _donating_jit_call(n.value, idx)
+                if pos:
+                    out[fi.key] = pos
+    project._donation_returns = out
+    return out
 
 
 def _method_donations(cls_node):
@@ -85,10 +117,14 @@ def _method_donations(cls_node):
 class _FuncScan:
     """Source-order walk of ONE function body tracking donated names."""
 
-    def __init__(self, rule, ctx, method_donations):
+    def __init__(self, rule, ctx, method_donations, module_jitted=None,
+                 resolver=None, idx=None):
         self.rule = rule
         self.ctx = ctx
         self.method_donations = method_donations
+        self.module_jitted = module_jitted or {}
+        self.resolver = resolver    # Call node -> positions (helpers)
+        self.idx = idx
         self.jitted = {}     # local name -> donated positions
         self.donated = {}    # name -> line it was donated at
         self.findings = []
@@ -104,7 +140,9 @@ class _FuncScan:
             return   # nested defs are their own scope
         if isinstance(node, ast.Assign):
             self.visit(node.value)
-            pos = _donating_jit_call(node.value)
+            pos = _donating_jit_call(node.value, self.idx)
+            if pos is None and self.resolver is not None:
+                pos = self.resolver(node.value)
             for t in node.targets:
                 if isinstance(t, ast.Name):
                     if pos:
@@ -148,9 +186,12 @@ class _FuncScan:
     def _call_donates(self, node):
         """Donated CALL-ARG indices for this call, or None."""
         f = node.func
-        if isinstance(f, ast.Name) and f.id in self.jitted:
-            return self.jitted[f.id]
-        direct = _donating_jit_call(f)   # jax.jit(g, donate...)(args)
+        if isinstance(f, ast.Name):
+            if f.id in self.jitted:
+                return self.jitted[f.id]
+            if f.id in self.module_jitted:   # top-level binding
+                return self.module_jitted[f.id]
+        direct = _donating_jit_call(f, self.idx)  # jax.jit(g, ...)()
         if direct:
             return direct
         if isinstance(f, ast.Attribute) and \
@@ -181,15 +222,39 @@ class DonationRule(Rule):
                      "restore restored garbage until snapshots copied")
 
     def check(self, ctx, project):
+        cg = project.callgraph
+        idx = cg.index_of(ctx.rel)
+        helper_returns = _donating_returns(project)
         # class-level inventory of donating methods (per enclosing class)
         class_methods = {}
         for node in ast.walk(ctx.tree):
             if isinstance(node, ast.ClassDef):
                 class_methods[node] = _method_donations(node)
+        # module-level donating bindings (`_update = jax.jit(f, ...)`)
+        module_jitted = {}
+        for node in ctx.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                pos = _donating_jit_call(node.value, idx)
+                if pos:
+                    module_jitted[node.targets[0].id] = pos
 
         def scan(owner_cls, func_node):
             md = class_methods.get(owner_cls, {})
-            yield from _FuncScan(self, ctx, md).run(func_node)
+            fi = cg._by_node.get(id(func_node)) if cg is not None \
+                else None
+
+            def resolver(call_node):
+                # `u = make_update()` — helper returning a donating jit
+                if not isinstance(call_node, ast.Call) or idx is None:
+                    return None
+                tgt = cg.resolve(call_node.func, idx, fi)
+                if tgt is not None:
+                    return helper_returns.get(tgt.key)
+                return None
+
+            yield from _FuncScan(self, ctx, md, module_jitted,
+                                 resolver, idx).run(func_node)
 
         def visit(node, owner_cls):
             for child in ast.iter_child_nodes(node):

@@ -1,0 +1,320 @@
+"""recompile-hazard — per-call-varying Python scalars flowing into
+shapes or jit static positions: the STATIC twin of the PR-10 runtime
+``jit/recompile_cause`` explainer.
+
+The bug class: PR-2's engine re-specialized its decode program on every
+batch-size crossing because host code built device arrays whose shapes
+came from ``len(rows)``; PR-7 killed that class at the engine level
+with ONE fixed-shape ragged program, and PR-10 landed the runtime
+explainer that names the varying axis AFTER the storm hits.  This rule
+names the hazard before merge instead:
+
+- **varying shape construction**: ``jnp.zeros(n, ...)`` /
+  ``np.empty((b, s))`` / ``full``/``ones``/``arange`` where the shape
+  expression derives from a per-call-varying PYTHON scalar —
+  ``len(...)`` of a non-constant container, or a local name bound from
+  one — inside a HOST function that drives tracing (contains a
+  jit-family call, calls a jitted callable, or transitively reaches a
+  function that does).  Every distinct value compiles a fresh program.
+- **varying static position**: a call of a name bound to ``jax.jit(f,
+  static_argnums=(...))`` (local or module-level binding, and
+  ``@partial(jax.jit, static_argnums=...)`` methods via
+  ``self.m(...)``) passing a ``len(...)``-derived or
+  ``.shape``-derived scalar in a static position — each distinct value
+  is a cache miss by definition.
+
+Deliberately NOT varying sources, to keep the signal honest:
+
+- a ``.shape`` read of an existing array, OUTSIDE static positions —
+  the array's shape already specializes every program it feeds, so a
+  ``jnp.zeros(x.shape[0])`` adds no recompile axis the input didn't;
+- anything inside a TRACED function (``cg.traced``): there ``len()``/
+  ``.shape`` are static at trace time by construction, and host
+  concretization inside traced code is ``host-sync``'s finding, not
+  this rule's.
+
+Deliberate bounded specialization (the engine's power-of-2 bucketing,
+pad-to-fixed shapes) is exactly what the suppression marker is for:
+``# ptpu-check[recompile-hazard]: bucketed — bounded program count``.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..callgraph import dotted_name, iter_body_nodes
+from ..core import Rule
+
+SHAPE_CTORS = {"zeros", "ones", "full", "empty", "arange"}
+ARRAY_MODULES = {"jax.numpy", "numpy"}
+
+
+def _trace_drivers(project):
+    """{func key: description} for functions that drive tracing —
+    contain a jit-family call / call a jitted binding — plus every
+    function that transitively reaches one (reverse closure).  Cached
+    on the project."""
+    cached = getattr(project, "_recompile_drivers", None)
+    if cached is not None:
+        return cached
+    cg = project.callgraph
+    seeds = {}
+    for ctx in project.contexts:
+        if ctx.tree is None:
+            continue
+        idx = cg.index_of(ctx.rel)
+        if idx is None:
+            continue
+        jit_bound = _jit_bound_names(ctx)
+        for fi in [f for f in cg.functions.values()
+                   if f.rel == ctx.rel]:
+            for n in iter_body_nodes(fi.node):
+                if not isinstance(n, ast.Call):
+                    continue
+                if cg.is_jit_entry_callable(n.func, idx):
+                    seeds.setdefault(
+                        fi.key, f"contains a "
+                        f"`{dotted_name(n.func)}` call at "
+                        f"{ctx.rel}:{n.lineno}")
+                    break
+                f = n.func
+                name = f.id if isinstance(f, ast.Name) else None
+                if name and name in jit_bound:
+                    seeds.setdefault(
+                        fi.key, f"dispatches the jitted "
+                        f"`{name}` at {ctx.rel}:{n.lineno}")
+                    break
+    # reverse closure: callers of drivers drive tracing too
+    redges = cg._reverse_edges()
+    out = dict(seeds)
+    work = list(seeds)
+    while work:
+        k = work.pop()
+        origin = out[k]
+        for caller in redges.get(k, ()):
+            if caller not in out:
+                out[caller] = origin
+                work.append(caller)
+    project._recompile_drivers = out
+    return out
+
+
+def _jit_bound_names(ctx):
+    """Module-level and local names bound to jit-family call results
+    (``_exec = jax.jit(f)``), plus their static_argnums when literal:
+    {name: tuple-or-None}."""
+    out = {}
+    for n in ast.walk(ctx.tree):
+        if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                and isinstance(n.targets[0], ast.Name) \
+                and isinstance(n.value, ast.Call):
+            dn = dotted_name(n.value.func)
+            if dn and dn.rsplit(".", 1)[-1] in ("jit", "pjit"):
+                static = None
+                for kw in n.value.keywords:
+                    if kw.arg == "static_argnums":
+                        static = _literal_ints(kw.value)
+                out[n.targets[0].id] = static
+    return out
+
+
+def _literal_ints(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                vals.append(e.value)
+            else:
+                return None
+        return tuple(vals)
+    return None
+
+
+def _method_statics(ctx):
+    """{method name: static positions} for @partial(jax.jit,
+    static_argnums=...) methods (def-indexed, incl. self)."""
+    out = {}
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for meth in node.body:
+            if not isinstance(meth, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            for dec in meth.decorator_list:
+                if not isinstance(dec, ast.Call):
+                    continue
+                dn = dotted_name(dec.func) or ""
+                if dn.rsplit(".", 1)[-1] != "partial" or not dec.args:
+                    continue
+                inner = dotted_name(dec.args[0]) or ""
+                if inner.rsplit(".", 1)[-1] not in ("jit", "pjit"):
+                    continue
+                for kw in dec.keywords:
+                    if kw.arg == "static_argnums":
+                        pos = _literal_ints(kw.value)
+                        if pos:
+                            out[meth.name] = pos
+    return out
+
+
+class _VaryTracker:
+    """Per-function: which local names hold per-call-varying scalars
+    (len() results, .shape-derived values)."""
+
+    def __init__(self, array_aliases=()):
+        self.varying = {}        # name -> short reason (len-derived)
+        self.shape_derived = {}  # name -> reason (.shape-derived)
+        self.arrays = set()      # names bound from np./jnp. calls —
+        #                          len(array) ≡ array.shape[0], which is
+        #                          shape-following, not a new axis
+        self.array_aliases = set(array_aliases)
+
+    def scan(self, func_node):
+        nodes = sorted(iter_body_nodes(func_node),
+                       key=lambda n: (getattr(n, "lineno", 0),
+                                      getattr(n, "col_offset", 0)))
+        for n in nodes:
+            if isinstance(n, ast.Assign):
+                if isinstance(n.value, ast.Call):
+                    dn = dotted_name(n.value.func) or ""
+                    if dn.split(".", 1)[0] in self.array_aliases:
+                        for t in n.targets:
+                            if isinstance(t, ast.Name):
+                                self.arrays.add(t.id)
+                why = self.vary_reason(n.value)
+                shape_why = why or self.vary_reason(n.value,
+                                                    with_shape=True)
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        if why:
+                            self.varying[t.id] = why
+                        else:
+                            self.varying.pop(t.id, None)
+                        if shape_why:
+                            self.shape_derived[t.id] = shape_why
+                        else:
+                            self.shape_derived.pop(t.id, None)
+                    elif isinstance(t, ast.Tuple) and _is_shape(
+                            n.value):
+                        for e in t.elts:
+                            if isinstance(e, ast.Name):
+                                self.shape_derived[e.id] = \
+                                    "unpacked from `.shape`"
+        return self
+
+    def vary_reason(self, expr, with_shape=False):
+        """Why `expr` varies per call, or None.  `.shape`-derived
+        scalars count only when `with_shape` (static positions): an
+        existing array's shape already specializes every program it
+        feeds, so deriving a SHAPE from it adds no recompile axis —
+        but feeding it into a STATIC position turns a would-be traced
+        axis into a compile key."""
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                    and n.func.id == "len" and n.args \
+                    and not isinstance(n.args[0], ast.Constant) \
+                    and not (isinstance(n.args[0], ast.Name)
+                             and n.args[0].id in self.arrays):
+                return "a `len(...)` of a per-call container"
+            if isinstance(n, ast.Name) and n.id in self.varying:
+                return self.varying[n.id]
+            if with_shape:
+                if isinstance(n, ast.Subscript) and _is_shape(n.value):
+                    return "a `.shape[...]` scalar"
+                if isinstance(n, ast.Name) and n.id in self.shape_derived:
+                    return self.shape_derived[n.id]
+        return None
+
+
+def _is_shape(node):
+    return isinstance(node, ast.Attribute) and node.attr == "shape"
+
+
+class RecompileHazardRule(Rule):
+    id = "recompile-hazard"
+    doc = ("no per-call-varying scalars (len()/unpacked .shape) into "
+           "shape constructors or jit static positions in "
+           "trace-driving code")
+    descends_from = ("PR-2: decode shapes from len(rows) recompiled "
+                     "every batch crossing until PR-7's fixed-shape "
+                     "ragged program; PR-10 built the runtime "
+                     "recompile_cause explainer this rule is the "
+                     "static twin of")
+
+    def check(self, ctx, project):
+        drivers = _trace_drivers(project)
+        if not any(k[0] == ctx.rel for k in drivers):
+            return
+        cg = project.callgraph
+        idx = cg.index_of(ctx.rel)
+        array_aliases = {name for name, mod in idx.mod_alias.items()
+                         if mod in ARRAY_MODULES}
+        array_aliases |= {name for name, (m, s) in
+                          idx.sym_import.items()
+                          if (m, s) == ("jax", "numpy")}
+        jit_bound = _jit_bound_names(ctx)
+        meth_statics = _method_statics(ctx)
+        for key, why_driver in sorted(drivers.items()):
+            if key[0] != ctx.rel:
+                continue
+            if key in cg.traced:
+                # inside traced code len()/.shape are static at trace
+                # time — concretization there is host-sync's finding
+                continue
+            fi = cg.functions[key]
+            tracker = _VaryTracker(array_aliases).scan(fi.node)
+            where = (f"`{fi.qualname}` drives tracing "
+                     f"({why_driver})")
+            for n in iter_body_nodes(fi.node):
+                if not isinstance(n, ast.Call):
+                    continue
+                dn = dotted_name(n.func) or ""
+                parts = dn.split(".")
+                if len(parts) >= 2 and parts[0] in array_aliases \
+                        and parts[-1] in SHAPE_CTORS and n.args:
+                    why = tracker.vary_reason(n.args[0])
+                    # extent: a trailing marker on ANY physical line of
+                    # a multi-line allocation counts
+                    if why and not ctx.suppressed(
+                            self.id, n.lineno,
+                            getattr(n, "end_lineno", n.lineno)):
+                        yield self.finding(
+                            ctx, n,
+                            f"`{dn}(...)` builds a shape from {why} "
+                            f"— every distinct value compiles a "
+                            f"fresh program (the PR-2 recompile-"
+                            f"storm class; pad to a fixed bucket or "
+                            f"justify the bounded specialization); "
+                            f"{where}")
+                        continue
+                static = None
+                f = n.func
+                if isinstance(f, ast.Name) and f.id in jit_bound:
+                    static = jit_bound[f.id]
+                    offset = 0
+                elif isinstance(f, ast.Attribute) \
+                        and isinstance(f.value, ast.Name) \
+                        and f.value.id == "self" \
+                        and f.attr in meth_statics:
+                    static = meth_statics[f.attr]
+                    offset = 1   # def positions include self
+                if static:
+                    for p in static:
+                        i = p - offset
+                        if 0 <= i < len(n.args):
+                            why = tracker.vary_reason(n.args[i],
+                                                      with_shape=True)
+                            if why and not ctx.suppressed(
+                                    self.id, n.lineno,
+                                    getattr(n, "end_lineno",
+                                            n.lineno)):
+                                yield self.finding(
+                                    ctx, n,
+                                    f"static position {p} of this "
+                                    f"jitted call receives {why} — "
+                                    f"each distinct value is a "
+                                    f"fresh compile by definition "
+                                    f"(the jit/recompile_cause "
+                                    f"static twin); {where}")

@@ -1,0 +1,409 @@
+"""resource-leak — acquire/release escape analysis for the resources
+the multi-process era leaks: sockets, KV allocations, temp dirs,
+threads.
+
+The bug classes, each fixed by hand in a past review round:
+
+- **socket without timeout** (PR-9): a ``socket.create_connection``
+  with no ``timeout=`` hung fleet registration inside ``start_server``'s
+  lock forever when the store accepted but never answered.  Flagged
+  unless the call passes ``timeout=`` or the bound name/attr gets a
+  ``settimeout(...)`` (anywhere in the same function, or — for
+  ``self.<attr>`` storage — anywhere in the file).
+- **leak on error path** (PR-2/PR-3): a locally-owned resource (socket,
+  ``tempfile.mkdtemp`` dir) acquired, then a raising-capable call
+  before the release — the exception skips the release and the fd/dir
+  leaks.  Ownership ESCAPES (returned, yielded, stored into
+  ``self``/a container, passed to another call) end the analysis: the
+  receiver owns cleanup.  A release inside a ``finally``/``except``
+  body is exception-guarded and clean; ``with`` acquisition is always
+  clean.
+- **acquire/release asymmetry** (PR-2's leaked ``_requests``): a
+  function that BOTH acquires and releases a keyed resource
+  (``.allocate(...)``/``.free(...)``, ``.add_request(...)``/
+  ``.release_request(...)`` — the pairs ``serving/kv_cache.py`` and the
+  engine define) but whose release is not exception-guarded while
+  raising-capable calls run in between.  A function that only acquires
+  transfers ownership (the ``add_request`` shape) and is clean.
+- **thread without bounded join** (PR-9/PR-11 rollups): a non-daemon
+  ``threading.Thread`` started locally and never ``join(timeout)``-ed
+  wedges interpreter shutdown on the thread's failure mode instead of
+  surfacing it.
+
+Honesty note: calls ON the resource itself (``sock.connect(...)``) are
+not counted as raising-capable — flagging every non-``with`` socket
+setup would bury the signal; the fix for those paths is ``with`` and
+the rule's message says so.
+
+Suppress with ``# ptpu-check[resource-leak]: why``.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..callgraph import dotted_name, iter_body_nodes
+from ..core import Rule
+
+# effectful keyed acquire -> its paired releases (seeded from the
+# repo's own lifecycle APIs: BlockKVCache.allocate/free,
+# LLMEngine.add_request/release_request)
+KEYED_PAIRS = {
+    "allocate": ("free", "release_request"),
+    "add_request": ("release_request",),
+}
+RELEASE_METHODS = {"close", "cleanup", "shutdown", "terminate",
+                   "release", "unlink", "stop"}
+RELEASE_FUNCS = {"rmtree"}   # shutil.rmtree(tmpdir)
+
+
+def _socket_root(dn, idx):
+    """True when dotted name `dn`'s root is the socket module."""
+    if not dn:
+        return False
+    root = dn.split(".", 1)[0]
+    if idx is not None:
+        mod = idx.mod_alias.get(root, root)
+        if mod == "socket":
+            return True
+        if dn in idx.sym_import and idx.sym_import[dn][0] == "socket":
+            return True
+    return root == "socket"
+
+
+def _acquire_kind(call, idx):
+    """('socket'|'socket_dial'|'tmpdir', needs_timeout) or None."""
+    dn = dotted_name(call.func)
+    if dn is None:
+        return None
+    last = dn.rsplit(".", 1)[-1]
+    if last == "create_connection" and _socket_root(dn, idx):
+        has_timeout = len(call.args) >= 2 or any(
+            k.arg == "timeout" for k in call.keywords)
+        return ("socket_dial", not has_timeout)
+    if last == "socket" and _socket_root(dn, idx):
+        return ("socket", False)
+    if last == "mkdtemp" and (dn.startswith("tempfile.")
+                              or (idx is not None
+                                  and idx.sym_import.get(dn, ("",))[0]
+                                  == "tempfile")):
+        return ("tmpdir", False)
+    return None
+
+
+def _guarded_ranges(func_node):
+    """Line ranges of finally/except bodies — releases there are
+    exception-guarded."""
+    ranges = []
+    for n in iter_body_nodes(func_node):
+        if isinstance(n, (ast.Try, getattr(ast, "TryStar", ast.Try))):
+            stmts = list(n.finalbody)
+            for h in n.handlers:
+                stmts.extend(h.body)
+            for stmt in stmts:
+                ranges.append((stmt.lineno,
+                               getattr(stmt, "end_lineno", stmt.lineno)))
+    return ranges
+
+
+def _in_ranges(line, ranges):
+    return any(lo <= line <= hi for lo, hi in ranges)
+
+
+class _Resource:
+    __slots__ = ("kind", "name", "node", "released_at", "guarded",
+                 "escaped", "has_settimeout", "needs_timeout",
+                 "started", "joined_bounded", "joined_unbounded",
+                 "daemon", "connects")
+
+    def __init__(self, kind, name, node, needs_timeout=False,
+                 daemon=False):
+        self.kind = kind
+        self.name = name
+        self.node = node
+        self.needs_timeout = needs_timeout
+        self.released_at = None
+        self.guarded = False
+        self.escaped = False
+        self.has_settimeout = False
+        self.started = False
+        self.joined_bounded = False
+        self.joined_unbounded = False
+        self.daemon = daemon
+        self.connects = False
+
+
+class ResourceLeakRule(Rule):
+    id = "resource-leak"
+    doc = ("sockets dialed without timeouts, locally-owned resources "
+           "leaked on exception paths, acquire/release asymmetry, "
+           "threads without bounded join")
+    descends_from = ("PR-9: a store that accepted but never answered "
+                     "hung registration forever (no socket timeout); "
+                     "PR-2: `_requests` grew unboundedly until "
+                     "generate() released in a finally")
+
+    TRIGGERS = ("socket", "mkdtemp", "Thread", ".allocate(",
+                ".add_request(")
+
+    def check(self, ctx, project):
+        # cheap pre-filter: a file mentioning none of the acquire
+        # surfaces has nothing for the per-function scans to find
+        if not any(t in ctx.src for t in self.TRIGGERS):
+            return
+        cg = project.callgraph
+        idx = cg.index_of(ctx.rel)
+        # file-wide: attributes that receive .settimeout anywhere
+        # (self._sock stored in __init__, settimeout'd in _connect)
+        attr_settimeout = set()
+        for n in ast.walk(ctx.tree):
+            if isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr == "settimeout" \
+                    and isinstance(n.func.value, ast.Attribute):
+                attr_settimeout.add(n.func.value.attr)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_function(ctx, idx, node,
+                                                attr_settimeout)
+
+    # -- per-function analysis --------------------------------------------
+
+    def _check_function(self, ctx, idx, func, attr_settimeout):
+        guarded = _guarded_ranges(func)
+        resources = {}    # local name -> _Resource
+        attr_dials = []   # (attr, node) create_connection w/o timeout
+        keyed = {}        # acquire attr -> list of call nodes
+        keyed_rel = {}    # release attr -> list of (node, guarded?)
+        calls_after = []  # (line, call node) raising-capable calls
+
+        # iter_body_nodes is stack-order; the scan below is
+        # order-sensitive (a resource must be registered before its
+        # method calls are classified), so sort into source order
+        nodes = sorted(iter_body_nodes(func),
+                       key=lambda n: (getattr(n, "lineno", 0),
+                                      getattr(n, "col_offset", 0)))
+        for n in nodes:
+            if isinstance(n, ast.withitem):
+                # `with <acquire>(...) as x` — the RELEASE is guaranteed
+                # by the context manager, but the TIMEOUT discipline is
+                # not: `with socket.create_connection((h, p)):` still
+                # hangs forever on a peer that accepts and never answers
+                # (rewriting the PR-9 bug with `with` must not hide it).
+                # Register the resource escaped (leak checks off) so the
+                # needs_timeout check — and an in-body settimeout — are
+                # still seen.
+                if isinstance(n.context_expr, ast.Call):
+                    kind = _acquire_kind(n.context_expr, idx)
+                    if kind is not None:
+                        name = n.optional_vars.id if isinstance(
+                            n.optional_vars, ast.Name) else None
+                        r = _Resource(kind[0], name, n.context_expr,
+                                      needs_timeout=kind[1])
+                        r.escaped = True
+                        resources[name or f"<with:{n.context_expr.lineno}>"] = r
+                    continue
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.value, ast.Call):
+                kind = _acquire_kind(n.value, idx)
+                tgt = n.targets[0]
+                if kind is not None:
+                    if isinstance(tgt, ast.Name):
+                        resources[tgt.id] = _Resource(
+                            kind[0], tgt.id, n.value,
+                            needs_timeout=kind[1])
+                        continue
+                    if isinstance(tgt, ast.Attribute) and kind[1]:
+                        # stored into self.<attr>: ownership escapes but
+                        # the timeout discipline is still checkable
+                        if tgt.attr not in attr_settimeout:
+                            attr_dials.append((tgt.attr, n.value))
+                        continue
+                thr = self._thread_ctor(n.value, idx)
+                if thr is not None and isinstance(tgt, ast.Name):
+                    resources[tgt.id] = _Resource(
+                        "thread", tgt.id, n.value, daemon=thr)
+                    continue
+            if isinstance(n, ast.Call):
+                dn = dotted_name(n.func)
+                if isinstance(n.func, ast.Attribute):
+                    base, attr = n.func.value, n.func.attr
+                    if isinstance(base, ast.Name) \
+                            and base.id in resources:
+                        r = resources[base.id]
+                        self._on_method(r, attr, n, guarded)
+                        continue   # calls ON the resource: not risky
+                    if attr in KEYED_PAIRS:
+                        keyed.setdefault(attr, []).append(n)
+                    for acq, rels in KEYED_PAIRS.items():
+                        if attr in rels:
+                            keyed_rel.setdefault(attr, []).append(
+                                (n, _in_ranges(n.lineno, guarded)))
+                    if dn and dn.rsplit(".", 1)[-1] in RELEASE_FUNCS:
+                        for a in n.args:
+                            if isinstance(a, ast.Name) \
+                                    and a.id in resources:
+                                r = resources[a.id]
+                                r.released_at = n.lineno
+                                r.guarded |= _in_ranges(n.lineno,
+                                                        guarded)
+                # a raising-capable call (unless it IS an acquire)
+                if _acquire_kind(n, idx) is None:
+                    calls_after.append((n.lineno, n))
+                # escapes: the resource passed onward as an argument
+                for a in list(n.args) + [k.value for k in n.keywords]:
+                    if isinstance(a, ast.Name) and a.id in resources \
+                            and not (dn and dn.rsplit(".", 1)[-1]
+                                     in RELEASE_FUNCS):
+                        resources[a.id].escaped = True
+            elif isinstance(n, (ast.Return, ast.Yield, ast.YieldFrom)):
+                v = getattr(n, "value", None)
+                if v is not None:
+                    for sub in ast.walk(v):
+                        if isinstance(sub, ast.Name) \
+                                and sub.id in resources:
+                            resources[sub.id].escaped = True
+            elif isinstance(n, ast.Assign):
+                # aliased or stored elsewhere -> ownership escapes
+                for sub in ast.walk(n.value):
+                    if isinstance(sub, ast.Name) \
+                            and sub.id in resources:
+                        resources[sub.id].escaped = True
+                for t in n.targets:
+                    if isinstance(t, (ast.Attribute, ast.Subscript)):
+                        for sub in ast.walk(t):
+                            if isinstance(sub, ast.Name) \
+                                    and sub.id in resources:
+                                resources[sub.id].escaped = True
+
+        yield from self._emit(ctx, func, resources, attr_dials, keyed,
+                              keyed_rel, calls_after)
+
+    def _on_method(self, r, attr, call, guarded):
+        if attr == "settimeout":
+            r.has_settimeout = True
+        elif attr == "connect":
+            r.connects = True
+        elif attr == "start":
+            r.started = True
+        elif attr == "join":
+            if call.args or call.keywords:
+                r.joined_bounded = True
+            else:
+                r.joined_unbounded = True
+        elif attr in RELEASE_METHODS:
+            r.released_at = call.lineno
+            r.guarded |= _in_ranges(call.lineno, guarded)
+
+    def _thread_ctor(self, call, idx):
+        """threading.Thread(...) -> daemon flag (True/False), else
+        None."""
+        dn = dotted_name(call.func)
+        if dn is None or dn.rsplit(".", 1)[-1] != "Thread":
+            return None
+        for k in call.keywords:
+            if k.arg == "daemon":
+                return bool(isinstance(k.value, ast.Constant)
+                            and k.value.value)
+        return False
+
+    def _emit(self, ctx, func, resources, attr_dials, keyed, keyed_rel,
+              calls_after):
+        for attr, node in attr_dials:
+            if not ctx.suppressed(self.id, node.lineno):
+                yield self.finding(
+                    ctx, node,
+                    f"socket dialed without a timeout into "
+                    f"`self.{attr}` — a peer that accepts but never "
+                    f"answers blocks forever (the PR-9 hung-"
+                    f"registration class); pass timeout= or "
+                    f"settimeout() before IO")
+        for r in resources.values():
+            line = r.node.lineno
+            risky_after = [c for ln, c in calls_after
+                           if ln > line
+                           and (r.released_at is None
+                                or ln <= r.released_at)]
+            if r.kind == "socket_dial" and r.needs_timeout \
+                    and not r.has_settimeout:
+                if not ctx.suppressed(self.id, line):
+                    yield self.finding(
+                        ctx, r.node,
+                        f"socket dialed without a timeout "
+                        f"(`{r.name}`) — a peer that accepts but "
+                        f"never answers blocks forever (the PR-9 "
+                        f"hung-registration class); pass timeout= or "
+                        f"settimeout() before IO")
+            if r.kind == "socket" and r.connects \
+                    and not r.has_settimeout:
+                if not ctx.suppressed(self.id, line):
+                    yield self.finding(
+                        ctx, r.node,
+                        f"`{r.name}.connect(...)` on a socket with no "
+                        f"settimeout() — the dial blocks unboundedly "
+                        f"on an unresponsive peer (PR-9 class)")
+            if r.kind == "thread":
+                if r.started and not r.daemon and not r.escaped \
+                        and not r.joined_bounded:
+                    if not ctx.suppressed(self.id, line):
+                        how = ("join() has no timeout"
+                               if r.joined_unbounded
+                               else "never joined")
+                        yield self.finding(
+                            ctx, r.node,
+                            f"non-daemon thread `{r.name}` started "
+                            f"but {how} — a wedged worker blocks "
+                            f"interpreter shutdown forever; "
+                            f"join(timeout) and handle the survivor, "
+                            f"or make it a daemon")
+                continue
+            if r.kind in ("socket", "socket_dial", "tmpdir") \
+                    and not r.escaped:
+                if r.released_at is None and risky_after:
+                    if not ctx.suppressed(self.id, line):
+                        noun = ("temp dir" if r.kind == "tmpdir"
+                                else "socket")
+                        yield self.finding(
+                            ctx, r.node,
+                            f"locally-owned {noun} `{r.name}` is "
+                            f"never released on this path — an "
+                            f"exception in the calls that follow "
+                            f"leaks it; use `with`, or release in a "
+                            f"finally")
+                elif r.released_at is not None and not r.guarded \
+                        and risky_after:
+                    if not ctx.suppressed(self.id, line):
+                        noun = ("temp dir" if r.kind == "tmpdir"
+                                else "socket")
+                        yield self.finding(
+                            ctx, r.node,
+                            f"{noun} `{r.name}` is released on line "
+                            f"{r.released_at} but a raising-capable "
+                            f"call runs before it — the exception "
+                            f"path leaks the {noun}; move the release "
+                            f"into a finally (the PR-2 "
+                            f"release-in-finally shape) or use `with`")
+        # keyed acquire/release asymmetry: the function manages the
+        # lifecycle locally but not exception-safely
+        for acq, nodes in keyed.items():
+            rel_names = KEYED_PAIRS[acq]
+            rels = [p for rn in rel_names
+                    for p in keyed_rel.get(rn, [])]
+            if not rels:
+                continue   # acquire-only: ownership transferred
+            if any(g for _, g in rels):
+                continue   # at least one exception-guarded release
+            first_rel = min(n.lineno for n, _ in rels)
+            for node in nodes:
+                risky = [c for ln, c in calls_after
+                         if node.lineno < ln <= first_rel
+                         and c is not node
+                         and all(c is not rn for rn, _ in rels)]
+                if risky and not ctx.suppressed(self.id, node.lineno):
+                    yield self.finding(
+                        ctx, node,
+                        f"`.{acq}(...)` is paired with "
+                        f"`.{'/'.join(rel_names)}` in this function "
+                        f"but the release is not exception-guarded — "
+                        f"a raise in between leaks the acquisition "
+                        f"(the PR-2 leaked-`_requests` class); "
+                        f"release in a finally")

@@ -1,0 +1,239 @@
+"""wire-compat — cross-process protocol surfaces checked against the ONE
+declared registry (``paddle_tpu/monitor/wire.py``).
+
+The bug class: the rpc frame, the ``/healthz`` ``schema_version`` and
+the fleet router-feed keys are spoken by version-skewed processes — an
+old aggregator scraping a new replica, a mid-deploy rpc client dialing
+an un-upgraded server.  PRs 9–11 each adjusted one of these surfaces by
+hand and leaned on review to keep the sides consistent; this rule makes
+the registry the single source of truth and flags drift statically.
+
+The registry is discovered INSIDE the analyzed file set: the module
+that declares at least two of ``RPC_FRAME_MIN``/``RPC_FRAME_MAX``/
+``HEALTHZ_SCHEMA_VERSION``/``FLEET_HEALTHZ_SCHEMA_VERSION``/
+``ROUTER_FEED_KEYS`` as module-level literals.  No registry in scope →
+the rule is silent (partial-path runs stay usable); TWO registries is
+itself a finding.
+
+Checks:
+
+- ``"schema_version": <int>`` dict keys: the literal must equal one of
+  the registry's declared ``*_SCHEMA_VERSION`` values (a Name/Attribute
+  reference to a ``*SCHEMA_VERSION`` constant is always fine — that IS
+  the registry);
+- ``# ptpu-wire: router-feed``-anchored dict literals: their string
+  keys must equal ``ROUTER_FEED_KEYS`` exactly, both directions — a key
+  added to the feed but not the registry breaks the accrete-only
+  contract silently, a registry key missing from the feed is a phantom
+  the router will read as absent forever;
+- rpc frame shapes in modules that speak the frame (reference
+  ``_send_frame``/``_recv_frame``): tuple literals whose first elements
+  are ``(fn, args, ...)`` must have arity within
+  ``[RPC_FRAME_MIN, RPC_FRAME_MAX]``; mandatory-field slices
+  ``msg[:k]`` must cut exactly ``RPC_FRAME_MIN``; optional-field probes
+  ``len(msg) > k`` must probe within the declared range.
+
+Suppress with ``# ptpu-check[wire-compat]: why`` (e.g. a fixture that
+deliberately speaks an old frame).
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule
+
+REGISTRY_NAMES = {"RPC_FRAME_MIN", "RPC_FRAME_MAX",
+                  "HEALTHZ_SCHEMA_VERSION",
+                  "FLEET_HEALTHZ_SCHEMA_VERSION", "ROUTER_FEED_KEYS"}
+ANCHOR = "ptpu-wire: router-feed"
+
+
+def _module_literals(ctx):
+    """{NAME: python value} for module-level constant assignments."""
+    out = {}
+    for node in ctx.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            if name not in REGISTRY_NAMES:
+                continue
+            try:
+                out[name] = ast.literal_eval(node.value)
+            except (ValueError, SyntaxError):
+                pass
+    return out
+
+
+def _find_registry(project):
+    """(rel, constants dict) for the one wire registry in scope, plus
+    every extra registry rel (a finding each)."""
+    if getattr(project, "_wire_registry", None) is not None:
+        return project._wire_registry
+    hits = []
+    for ctx in project.contexts:
+        if ctx.tree is None:
+            continue
+        consts = _module_literals(ctx)
+        if len(consts) >= 2:
+            hits.append((ctx.rel, consts))
+    primary = hits[0] if hits else (None, {})
+    project._wire_registry = (primary[0], primary[1],
+                              [rel for rel, _ in hits[1:]])
+    return project._wire_registry
+
+
+def _is_schema_name(expr) -> bool:
+    """Name/Attribute whose terminal segment is a *SCHEMA_VERSION
+    constant — a reference INTO the registry, fine by construction."""
+    if isinstance(expr, ast.Attribute):
+        return expr.attr.endswith("SCHEMA_VERSION")
+    if isinstance(expr, ast.Name):
+        return expr.id.endswith("SCHEMA_VERSION")
+    return False
+
+
+class WireCompatRule(Rule):
+    id = "wire-compat"
+    doc = ("rpc frame arity, /healthz schema_version, and router-feed "
+           "keys must match the declared wire registry (monitor/wire.py)")
+    descends_from = ("PR-9: the rpc 4-tuple frame vs legacy 3-tuple "
+                     "servers, /healthz schema bumps, and the accrete-"
+                     "only router feed were each kept consistent by hand "
+                     "across version-skewed fleets")
+
+    def check(self, ctx, project):
+        reg_rel, consts, extras = _find_registry(project)
+        if reg_rel is None:
+            return
+        if ctx.rel in extras:
+            node = ctx.tree.body[0] if ctx.tree.body else ctx.tree
+            yield self.finding(
+                ctx, node,
+                f"second wire registry (the one source of truth is "
+                f"{reg_rel}) — merge the declarations")
+        schema_versions = {v for k, v in consts.items()
+                           if k.endswith("SCHEMA_VERSION")
+                           and isinstance(v, int)}
+        frame_min = consts.get("RPC_FRAME_MIN")
+        frame_max = consts.get("RPC_FRAME_MAX")
+        feed_keys = consts.get("ROUTER_FEED_KEYS")
+        if ctx.rel == reg_rel:
+            return   # the registry itself is the truth, not a speaker
+
+        anchors = [i for i, ln in enumerate(ctx.lines, start=1)
+                   if ANCHOR in ln]
+        speaks_rpc = ("_send_frame" in ctx.src or "_recv_frame" in ctx.src)
+
+        for node in ast.walk(ctx.tree):
+            # -- /healthz schema_version ------------------------------
+            if isinstance(node, ast.Dict) and schema_versions:
+                for k, v in zip(node.keys, node.values):
+                    if isinstance(k, ast.Constant) \
+                            and k.value == "schema_version":
+                        if _is_schema_name(v):
+                            continue
+                        if isinstance(v, ast.Constant) \
+                                and isinstance(v.value, int) \
+                                and v.value not in schema_versions:
+                            if not ctx.suppressed(self.id, v.lineno):
+                                yield self.finding(
+                                    ctx, v,
+                                    f"schema_version {v.value} is not "
+                                    f"declared in the wire registry "
+                                    f"({reg_rel} declares "
+                                    f"{sorted(schema_versions)}) — bump "
+                                    f"the registry WITH the surface")
+            # -- router-feed anchored dicts ---------------------------
+            if isinstance(node, ast.Dict) and feed_keys is not None \
+                    and anchors:
+                lo = getattr(node, "lineno", 0)
+                if any(lo - 3 <= a <= lo for a in anchors):
+                    lits = [k.value for k in node.keys
+                            if isinstance(k, ast.Constant)
+                            and isinstance(k.value, str)]
+                    extra = sorted(set(lits) - set(feed_keys))
+                    missing = sorted(set(feed_keys) - set(lits))
+                    if (extra or missing) and not ctx.suppressed(
+                            self.id, node.lineno,
+                            ctx.node_extent(node)):
+                        detail = []
+                        if extra:
+                            detail.append(f"emits undeclared {extra}")
+                        if missing:
+                            detail.append(
+                                f"misses declared {missing}")
+                        yield self.finding(
+                            ctx, node,
+                            "router-feed keys drifted from "
+                            f"ROUTER_FEED_KEYS ({reg_rel}): "
+                            + "; ".join(detail)
+                            + " — the feed is accrete-only wire, "
+                              "register the change first")
+            # -- rpc frame shapes -------------------------------------
+            if not speaks_rpc or frame_min is None or frame_max is None:
+                continue
+            if isinstance(node, ast.Tuple) and len(node.elts) >= 2 \
+                    and isinstance(node.elts[0], ast.Name) \
+                    and node.elts[0].id == "fn" \
+                    and isinstance(node.elts[1], ast.Name) \
+                    and node.elts[1].id == "args":
+                n = len(node.elts)
+                if not (frame_min <= n <= frame_max) \
+                        and not ctx.suppressed(self.id, node.lineno):
+                    yield self.finding(
+                        ctx, node,
+                        f"rpc frame tuple has {n} fields; the registry "
+                        f"({reg_rel}) declares "
+                        f"[{frame_min}, {frame_max}] — growing the "
+                        f"frame means bumping RPC_FRAME_MAX first so "
+                        f"version skew stays a lint conversation")
+            if isinstance(node, ast.Subscript) \
+                    and isinstance(node.slice, ast.Slice) \
+                    and node.slice.lower is None \
+                    and node.slice.upper is not None \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == "msg":
+                k = self._int_of(node.slice.upper, consts)
+                if k is not None and k != frame_min \
+                        and not ctx.suppressed(self.id, node.lineno):
+                    yield self.finding(
+                        ctx, node,
+                        f"rpc frame mandatory-field slice cuts {k} "
+                        f"fields; RPC_FRAME_MIN is {frame_min} "
+                        f"({reg_rel}) — a wider mandatory slice "
+                        f"breaks every legacy client")
+            if isinstance(node, ast.Compare) and len(node.ops) == 1 \
+                    and isinstance(node.ops[0], (ast.Gt, ast.GtE)) \
+                    and isinstance(node.left, ast.Call) \
+                    and isinstance(node.left.func, ast.Name) \
+                    and node.left.func.id == "len" \
+                    and node.left.args \
+                    and isinstance(node.left.args[0], ast.Name) \
+                    and node.left.args[0].id == "msg":
+                k = self._int_of(node.comparators[0], consts)
+                thresh = k if isinstance(node.ops[0], ast.Gt) else \
+                    (None if k is None else k - 1)
+                if thresh is not None and not (
+                        frame_min <= thresh < frame_max) \
+                        and not ctx.suppressed(self.id, node.lineno):
+                    yield self.finding(
+                        ctx, node,
+                        f"optional-field probe reads past the declared "
+                        f"frame ([{frame_min}, {frame_max}] in "
+                        f"{reg_rel}) — the field it guards does not "
+                        f"exist on any registered frame")
+
+    @staticmethod
+    def _int_of(expr, consts):
+        """Int literal, or a Name/Attribute resolving into the registry
+        constants; None when neither."""
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, int):
+            return expr.value
+        name = None
+        if isinstance(expr, ast.Name):
+            name = expr.id
+        elif isinstance(expr, ast.Attribute):
+            name = expr.attr
+        if name in consts and isinstance(consts[name], int):
+            return consts[name]
+        return None

@@ -6,11 +6,14 @@
 #   tools/run_ci.sh fast    — "not slow" tier on the virtual 8-device CPU mesh
 #                             (includes the resilience suite + ptpu_check)
 #   tools/run_ci.sh full    — everything incl. subprocess/example suites
-#   tools/run_ci.sh lint    — unified static analyzer only (ptpu_check:
-#                             silent-except, metric-hygiene, host-sync,
-#                             donation, lock-discipline, determinism,
-#                             wall-clock over paddle_tpu/ tools/ scripts/;
-#                             JSON artifact at /tmp/ptpu_check_report.json)
+#   tools/run_ci.sh lint    — unified static analyzer only (ptpu_check,
+#                             all 12 rules: silent-except, metric-hygiene,
+#                             host-sync, donation, lock-discipline,
+#                             determinism, wall-clock, resource-leak,
+#                             blocking-in-handler, recompile-hazard,
+#                             wire-compat, env-flag-drift over
+#                             paddle_tpu/ tools/ scripts/; JSON artifact
+#                             at /tmp/ptpu_check_report.json)
 #   tools/run_ci.sh gates   — driver gates: compile-check entry() + the
 #                             8-device multichip dryrun + CPU bench smoke
 #   tools/run_ci.sh bench-check OLD.json NEW.json — perf regression gate
@@ -24,12 +27,14 @@ export XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}"
 
 case "${1:-fast}" in
   fast)
-    # unified static analyzer (was: lint_excepts + lint_metrics) — one
-    # shared parse per file, exits nonzero on any unsuppressed finding;
-    # the default scope covers the ISSUE-13 training-microscope modules
-    # (monitor/train.py, resilience/forensics.py, scripts/
-    # train_probe_smoke.py) like everything else under paddle_tpu/
-    python -m tools.ptpu_check --json-out /tmp/ptpu_check_report.json
+    # unified static analyzer, INCREMENTAL (ISSUE 14): rules run only
+    # on files changed vs ${PTPU_CHECK_BASE:-HEAD} plus their
+    # call-graph closure — the fast lane pays ~2 s of parse+graph for a
+    # clean tree and seconds for a working diff, instead of the
+    # whole-tree rule wall.  `full` and `lint` keep the whole-tree run
+    # (all 12 rules), so nothing lands unanalyzed.
+    python -m tools.ptpu_check --changed "${PTPU_CHECK_BASE:-HEAD}" \
+      --json-out /tmp/ptpu_check_report.json
     # "not slow" includes tests/test_train_stats.py (ISSUE 13: loss-spike
     # EWMA, goodput math, straggler rollup, forensics — subprocess-free)
     python -m pytest tests/ -m "not slow" -q --ignore=tests/test_examples.py
@@ -55,6 +60,9 @@ case "${1:-fast}" in
     python -m pytest tests/ -q
     ;;
   lint)
+    # whole-tree, all 12 rules (the 5 ISSUE-14 interprocedural rules —
+    # resource-leak, blocking-in-handler, recompile-hazard, wire-compat,
+    # env-flag-drift — ride the same one-parse-per-file core)
     python -m tools.ptpu_check --json-out /tmp/ptpu_check_report.json
     echo "ptpu_check: JSON artifact at /tmp/ptpu_check_report.json"
     ;;
