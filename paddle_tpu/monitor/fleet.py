@@ -907,6 +907,12 @@ class FleetAggregator:
                     "fail_streak": r.fail_streak,
                     "last_err": r.last_err,
                     "harvested": list(r.harvested),
+                    # ISSUE 15: speculative-decode acceptance + prefix-
+                    # cache heat (accrete-only; None for older replicas)
+                    "spec_accept_rate": series_value(
+                        r.parsed, "serving_spec_accept_rate"),
+                    "prefix_hit_tokens": series_value(
+                        r.parsed, "serving_prefix_hit_tokens"),
                 }
         return out
 

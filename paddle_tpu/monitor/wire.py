@@ -74,4 +74,10 @@ ROUTER_FEED_KEYS = (
     "fail_streak",
     "last_err",
     "harvested",
+    # ISSUE 15 serving-throughput signals (accrete-only, like the rest):
+    # cumulative draft acceptance ratio and prefix-cache-paid prompt
+    # tokens — the router's "is this replica's cache hot for this
+    # traffic" inputs.  None for replicas predating them.
+    "spec_accept_rate",
+    "prefix_hit_tokens",
 )

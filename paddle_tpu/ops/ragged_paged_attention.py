@@ -80,8 +80,10 @@ _QMAX = 127
 
 def _ragged_kernel_ok(q, k_blocks, c, quant) -> bool:
     """Geometry/flag gate for the fused ragged kernel.  The kernel serves
-    the decode shape (C = 1) — chunked-prefill rows (C > 1) take the
-    fallback, which is the parity-exact program anyway.
+    the decode shape (C = 1) — chunked-prefill and speculative-verify
+    rows (C > 1) take the fallback, which is the parity-exact program
+    anyway (a multi-token kernel variant is the natural follow-up once
+    the verify path earns its on-chip A/B).
     PTPU_RAGGED_KERNEL=0 hard-disables."""
     if os.environ.get("PTPU_RAGGED_KERNEL", "").lower() in ("0", "false",
                                                             "off"):
@@ -474,10 +476,22 @@ def ragged_paged_attention_arrays(q, k_new, v_new, k_blocks, v_blocks,
     ONE fixed-shape program.
 
     q, k_new, v_new: [B, C, H, D] — the current tokens (C = 1 at decode;
-                     C > 1 for a prefill-continuation chunk).  Rows may
-                     sit at DIFFERENT absolute positions (mixed
-                     prefill/decode batches) and padding rows ride along
-                     with dropped slots + ignored outputs.
+                     C > 1 for a prefill-continuation chunk, or a
+                     speculative-decode VERIFY batch: position 0 is the
+                     row's last real token and positions 1..k its draft
+                     tokens).  Rows may sit at DIFFERENT absolute
+                     positions (mixed prefill/decode batches) and
+                     padding rides along at BOTH granularities: whole
+                     padding rows AND, in a verify batch, a row's unused
+                     trailing draft positions — either way a dropped
+                     slot suppresses the write and the caller ignores
+                     the output.  Write-then-attend makes in-chunk
+                     causality the pool's own: draft j's query sees
+                     draft j-1's K/V because the update lands before the
+                     attention reads, under the same per-position causal
+                     mask as sequential decode — which is what lets the
+                     engine score all k+1 positions in ONE launch and
+                     stay token-identical to step-by-step greedy.
     k_blocks/v_blocks: [num_blocks, block_size, H, D] physical pools
                      (fp, or int8 codes with `k_scales`/`v_scales`
                      [num_blocks, H] per-block-per-head scale pools).

@@ -14,23 +14,33 @@ Quickstart::
 Layers (each its own module, each independently testable):
 
 - `kv_cache.BlockKVCache` — block pool + free-list allocator, per-request
-  block tables, copy-on-fork, bit-exact eviction swap.
-- `scheduler.Scheduler`  — waiting queue, token-budget admission,
-  preemption-by-eviction; `SamplingParams` / `Request` state machines.
+  block tables, copy-on-fork, bit-exact eviction swap, and the automatic
+  prefix-cache index (chained block keys, LRU-parked unreferenced
+  blocks; `prefix_block_keys`).
+- `scheduler.Scheduler`  — waiting queue, token-budget admission (with
+  longest-cached-prefix adoption), preemption-by-eviction;
+  `SamplingParams` / `Request` state machines.
+- `spec.propose_ngram`   — stdlib n-gram/prompt-lookup draft proposal
+  for speculative decoding (no second model).
 - `engine.LLMEngine`     — jitted prefill/decode/sample step programs over
   `ops.ragged_paged_attention` (default: ONE fixed-shape fused
   update+attend decode program; `ops.paged_attention` is the bucketed
   fallback), token-for-token equal to the dense
-  `GPTForCausalLM.generate` (tests/test_serving.py pins it).
+  `GPTForCausalLM.generate` (tests/test_serving.py pins it); with
+  `EngineConfig(speculative_tokens=k)` a fixed-shape multi-token verify
+  program emits several accepted tokens per decode step.
 
 The user-facing entry point also hangs off `paddle_tpu.inference`
 (`inference.LLMEngine` etc.), next to the Predictor serving surface.
 """
-from .kv_cache import BlockAllocatorError, BlockKVCache
+from .kv_cache import (BlockAllocatorError, BlockKVCache,
+                       prefix_block_keys)
 from .scheduler import Request, SamplingParams, Scheduler, SchedulerOutput
+from .spec import propose_ngram
 from .engine import EngineConfig, LLMEngine
 
 __all__ = [
     "BlockAllocatorError", "BlockKVCache", "EngineConfig", "LLMEngine",
     "Request", "SamplingParams", "Scheduler", "SchedulerOutput",
+    "prefix_block_keys", "propose_ngram",
 ]

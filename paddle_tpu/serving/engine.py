@@ -62,8 +62,14 @@ launch accounting: `serving/kernels_per_step` (distinct compiled
 programs one decode step dispatches — the mega-kernel before/after
 number, flat across batch compositions on the ragged default),
 `serving/padding_waste{kind=rows|tokens}` (padded fraction of the
-fixed-shape decode program), `serving/goodput_tokens_per_s` (generated
-tokens over TOTAL engine step wall time, prefill/idle included).
+fixed-shape decode program — rows and tokens diverge under speculative
+decoding, where a row carries 1+drafts query positions),
+`serving/goodput_tokens_per_s` (generated tokens over TOTAL engine step
+wall time, prefill/idle included).  ISSUE 15:
+`serving/prefix_hits`/`prefix_hit_tokens`/`prefix_evictions` (prefix
+caching, counted by the cache) and
+`serving/spec_proposed`/`spec_accepted`/`spec_accept_rate`
+(speculative decoding).
 
 Observability v2 (monitor.trace): with PTPU_TRACE=1 every request gets a
 trace — root `serving/request` span with `serving/queue_wait`,
@@ -95,8 +101,9 @@ from ..ops.paged_attention import (paged_attention_arrays,
                                    paged_cache_update_arrays,
                                    quantized_cache_update_arrays)
 from ..ops.ragged_paged_attention import ragged_paged_attention_arrays
-from .kv_cache import BlockKVCache
+from .kv_cache import BlockKVCache, prefix_block_keys
 from .scheduler import Request, SamplingParams, Scheduler
+from .spec import propose_ngram
 
 __all__ = ["EngineConfig", "LLMEngine"]
 
@@ -129,6 +136,29 @@ class EngineConfig:
     # gather+attend dispatch as the fallback.  None resolves from env
     # PTPU_RAGGED ("0"/"false"/"off" -> bucketed); default ragged.
     attention_impl: Optional[str] = None
+    # ISSUE 15 (a): automatic prefix caching — index full KV blocks by
+    # chained content keys as prefill fills them; new requests adopt
+    # their longest cached prefix by refcount bump and prefill only the
+    # uncached tail (N requests sharing a system prompt pay its prefill
+    # once).  Unreferenced prefix blocks park on an LRU and are
+    # reclaimed last.  None resolves from env PTPU_PREFIX_CACHE;
+    # default OFF (finished requests then pin pool blocks in the index,
+    # which changes the blocks_in_use==0-at-idle invariant suites pin).
+    enable_prefix_caching: Optional[bool] = None
+    # ISSUE 15 (b): speculative decoding — k n-gram/prompt-lookup draft
+    # tokens per greedy row, verified in ONE fixed-shape ragged
+    # (max_num_seqs, k+1) multi-token program; the longest matching
+    # greedy run (plus the correction token) is accepted per step.
+    # Token-identical to dense greedy generate(); sampling rows get no
+    # drafts (their PRNG stream is preserved exactly — documented
+    # scope).  0 = off.  None resolves from env PTPU_SPEC_TOKENS.
+    # Requires attention_impl="ragged".
+    speculative_tokens: Optional[int] = None
+    # n-gram proposer knobs: longest/shortest suffix n-gram tried, and
+    # how far back the per-row host scan looks
+    spec_ngram_max: int = 3
+    spec_ngram_min: int = 1
+    spec_lookup_window: int = 1024
 
 
 class LLMEngine:
@@ -185,6 +215,20 @@ class LLMEngine:
                 * cfg.num_hidden_layers)
         else:
             num_blocks = fp_blocks
+        pc = c.enable_prefix_caching
+        if pc is None:
+            pc = os.environ.get("PTPU_PREFIX_CACHE", "0").lower() in (
+                "1", "true", "on")
+        self.prefix_caching = bool(pc)
+        st = c.speculative_tokens
+        if st is None:
+            st = int(os.environ.get("PTPU_SPEC_TOKENS", "0") or 0)
+        self.spec_tokens = max(0, int(st))
+        if self.spec_tokens and self.attention_impl != "ragged":
+            raise ValueError(
+                "speculative decoding needs the ragged attention path "
+                "(the fixed-shape multi-token verify program); "
+                'attention_impl="bucketed" cannot serve it')
         self.cache = BlockKVCache(
             cfg.num_hidden_layers, num_blocks, c.block_size, nh, hd,
             dtype=wdtype, kv_quant=self._kv_quant)
@@ -203,7 +247,9 @@ class LLMEngine:
         self.scheduler = Scheduler(
             self.cache, max_num_seqs=c.max_num_seqs,
             max_num_batched_tokens=(c.max_num_batched_tokens
-                                    or self.max_model_len))
+                                    or self.max_model_len),
+            spec_tokens=self.spec_tokens,
+            max_model_len=self.max_model_len)
         self._requests: dict = {}
         self._next_id = 0
         self._jit_cache: dict = {}
@@ -254,6 +300,17 @@ class LLMEngine:
             "serving/goodput_tokens_per_s",
             "generated tokens per second of total engine step wall "
             "time (prefill/idle/scheduling included)")
+        # ISSUE 15 (b): speculative decoding observability — proposed vs
+        # accepted draft tokens, and their cumulative ratio
+        self._m_spec_prop = m.counter(
+            "serving/spec_proposed", "draft tokens proposed")
+        self._m_spec_acc = m.counter(
+            "serving/spec_accepted", "draft tokens accepted by verify")
+        self._m_spec_rate = m.gauge(
+            "serving/spec_accept_rate",
+            "cumulative accepted/proposed draft-token ratio")
+        self._spec_proposed_total = 0
+        self._spec_accepted_total = 0
         self._wall_s_total = 0.0
         self._goodput_toks = 0
         self._launches_this_step = None
@@ -288,6 +345,12 @@ class LLMEngine:
         req.key = self._init_key(params)
         if params.deadline_s is not None:
             req.deadline = Deadline(params.deadline_s)
+        if self.prefix_caching:
+            # chained content keys over the prompt's full blocks: the
+            # scheduler matches/adopts against them at admission and
+            # _prefill_body registers newly-filled blocks under them
+            req.prefix_keys = prefix_block_keys(prompt,
+                                                self.cache.block_size)
         self._begin_trace(req)
         self._requests[req.req_id] = req
         self.scheduler.add(req)
@@ -462,8 +525,10 @@ class LLMEngine:
             self._step_prefill(out)
             phase, toks = "prefill", out.chunk_len
         elif out.kind == "decode":
-            self._step_decode(out)
-            phase, toks = "decode", len(out.decode_requests)
+            # spec decoding can emit MORE tokens than rows in one step —
+            # the decode body reports the real emitted count
+            toks = self._step_decode(out)
+            phase = "decode"
         else:
             phase, toks = "idle", 0
         done = self.scheduler.retire_finished()
@@ -550,6 +615,11 @@ class LLMEngine:
                     jnp.asarray(slots))
         self._store_kv(kv_out)
         req.num_computed = start + chunk
+        if req.prefix_keys:
+            # index the blocks this chunk just filled (full prompt blocks
+            # only — their content is final while referenced)
+            self.cache.register_prefix(req.req_id, req.prefix_keys,
+                                       req.num_computed)
         if req.prefill_done:
             if req.params.max_new_tokens <= 0:
                 # dense generate(max_new_tokens=0) emits nothing
@@ -557,18 +627,36 @@ class LLMEngine:
             else:
                 self._sample_rows([req], logits)
 
-    def _step_decode(self, out):
+    def _step_decode(self, out) -> int:
         rows = list(out.decode_requests)
         spans = [mtrace.start_span("serving/decode_step", parent=r.trace,
                                    pos=r.total_len - 1, batch=len(rows))
                  for r in rows if r.trace is not None]
         try:
-            self._decode_body(rows)
+            return self._decode_body(rows)
         finally:
             for sp in spans:
                 sp.end()
 
-    def _decode_body(self, rows):
+    def _decode_body(self, rows) -> int:
+        if self.spec_tokens:
+            drafts = [self._propose(r) for r in rows]
+            if any(drafts):
+                return self._decode_body_spec(rows, drafts)
+            # zero drafts anywhere this step (cold history, sampling
+            # rows, n-gram misses): the plain (bb, 1) program is
+            # strictly cheaper — C=1 compute, kernel-eligible on TPU —
+            # than a verify launch whose k draft positions are all
+            # padding.  Both shapes compile once; steady state stays
+            # two launches either way.
+            n = self._decode_body_plain(rows)
+            for req in rows:
+                # release the scheduler's (clamped) draft reservation
+                self.cache.truncate_to(req.req_id, req.total_len)
+            return n
+        return self._decode_body_plain(rows)
+
+    def _decode_body_plain(self, rows) -> int:
         # perf mode (PTPU_PERF=1): the decode hot path reports named,
         # properly-synced sub-step segments — host `prep`, the fused
         # `model` program (gather+attention+cache update), and `sampler`
@@ -646,6 +734,185 @@ class LLMEngine:
             self._m_pad_toks.set(waste)
             self._m_kernels.set(len(self._launches_this_step))
             self._launches_this_step = None
+        return n
+
+    # -- speculative decoding (ISSUE 15 b) ----------------------------------
+
+    def _propose(self, req) -> list:
+        """Draft tokens for one row.  Sampling rows get none — their
+        per-request PRNG stream must advance exactly one draw per
+        emitted token, the documented scope of the seeded-sampling
+        parity guarantee.  The budget clamps so (emitted ≤ drafts+1)
+        never overshoots max_new_tokens and no draft position's write
+        ever reaches max_model_len."""
+        p = req.params
+        if p.do_sample:
+            return []
+        budget = min(self.spec_tokens,
+                     p.max_new_tokens - len(req.output_ids) - 1,
+                     self.max_model_len - req.total_len)
+        if budget <= 0:
+            return []
+        c = self.config
+        return propose_ngram(req.prompt_ids + req.output_ids, budget,
+                             ngram_max=c.spec_ngram_max,
+                             ngram_min=c.spec_ngram_min,
+                             window=c.spec_lookup_window)
+
+    def _decode_body_spec(self, rows, drafts) -> int:
+        """Speculative decode step: ONE fixed-shape ragged
+        (max_num_seqs, k+1) verify program scores the last real token
+        plus up to k n-gram drafts per row against the paged pools
+        (cache update in-program — write-then-attend puts the drafts'
+        K/V in the pool before their own queries run, so in-chunk
+        causality is the pool's), the longest greedy-matching draft run
+        plus the correction token is accepted, and the block table rolls
+        back to the accepted length.  Multiple tokens per step at the
+        same TWO program launches as plain decode."""
+        perf_on = mperf.enabled()
+        t0 = time.perf_counter() if perf_on else 0.0
+        n = len(rows)
+        mon = monitor.enabled()
+        self._launches_this_step = set() if mon else None
+        k = self.spec_tokens
+        cw = k + 1                     # verify chunk width, fixed
+        bb = self.scheduler.max_num_seqs
+        num_slots = self.cache.num_slots
+        # fixed [bb, k+1] shapes: bb is the engine-constant max_num_seqs
+        # and k the engine-constant draft budget — zero recompile hazard
+        toks = np.zeros((bb, cw), np.int32)
+        pos0 = np.zeros((bb,), np.int32)
+        lens = np.zeros((bb,), np.int32)
+        tables = np.full((bb, self.blocks_per_seq), self.cache.num_blocks,
+                         np.int32)
+        slots = np.full((bb, cw), num_slots, np.int32)
+        for i, req in enumerate(rows):
+            toks[i, 0] = req.output_ids[-1] if req.output_ids \
+                else req.prompt_ids[-1]
+            m = len(drafts[i])
+            if m:
+                toks[i, 1:1 + m] = drafts[i]
+            p = req.total_len - 1
+            pos0[i] = p
+            lens[i] = req.total_len + m
+            tables[i] = self.cache.padded_table(req.req_id,
+                                                self.blocks_per_seq)
+            for j in range(1 + m):
+                # draft positions past m keep the dropped-slot sentinel:
+                # no write, garbage logits the emission loop never reads
+                slots[i, j] = self.cache.slot(req.req_id, p + j)
+        self._m_attn_impl.labels(kind=self.attention_impl).inc()
+        if perf_on:
+            t1 = time.perf_counter()
+            mperf.observe_segment("decode", "prep", t1 - t0)
+        fn = self._get_verify_exec(bb, cw)
+        if mon:
+            self._launches_this_step.add(("verify", bb, cw))
+        logits0, greedy, kv_out = fn(
+            self._param_arrays(), self._kv_flat(), jnp.asarray(toks),
+            jnp.asarray(pos0), jnp.asarray(lens), jnp.asarray(tables),
+            jnp.asarray(slots))
+        if perf_on:
+            jax.block_until_ready(logits0)
+            mperf.observe_segment("decode", "model",
+                                  time.perf_counter() - t1)
+        self._store_kv(kv_out)
+        emitted = self._emit_spec(rows, drafts, logits0,
+                                  np.asarray(greedy))
+        # roll every table back to its accepted length (rejected-draft
+        # blocks return to the pool; finished rows are freed by
+        # retire_finished right after — truncating first keeps the
+        # shared-block refcounts exact either way)
+        for req in rows:
+            self.cache.truncate_to(req.req_id, req.total_len)
+        if mon:
+            real_q = n + sum(len(d) for d in drafts)
+            self._m_pad_rows.set((bb - n) / max(bb, 1))
+            self._m_pad_toks.set((bb * cw - real_q) / max(bb * cw, 1))
+            self._m_kernels.set(len(self._launches_this_step))
+            self._launches_this_step = None
+        return emitted
+
+    def _emit_spec(self, rows, drafts, logits0, greedy_h) -> int:
+        """Per-row acceptance + emission.  The position-0 logits run
+        through the SAME (\"sample\", bb) program as plain decode — key
+        threading and sampling rows' streams are bit-identical to
+        spec-off — and greedy rows then extend with their longest
+        verified draft run: draft j is accepted iff it equals the greedy
+        token at position j-1, which validates position j's logits,
+        whose greedy token is emitted (the correction/bonus token ends
+        the run)."""
+        perf_on = mperf.enabled()
+        t0 = time.perf_counter() if perf_on else 0.0
+        bb = int(logits0.shape[0])
+        keys = np.zeros((bb, 2), np.uint32)
+        ds = np.zeros((bb,), bool)
+        temp = np.ones((bb,), np.float32)
+        topk = np.zeros((bb,), np.int32)
+        topp = np.ones((bb,), np.float32)
+        for i, req in enumerate(rows):
+            p = req.params
+            keys[i] = np.asarray(req.key, np.uint32)
+            ds[i] = p.do_sample
+            temp[i] = p.temperature
+            topk[i] = p.top_k
+            topp[i] = p.top_p
+        fn = self._get_sample_exec(bb)
+        if self._launches_this_step is not None:
+            self._launches_this_step.add(("sample", bb))
+        toks, new_keys = fn(logits0, jnp.asarray(keys), jnp.asarray(ds),
+                            jnp.asarray(temp), jnp.asarray(topk),
+                            jnp.asarray(topp))
+        toks = np.asarray(toks)
+        new_keys = np.asarray(new_keys)
+        now = time.perf_counter()
+        if perf_on:
+            mperf.observe_segment("decode", "sampler", now - t0)
+        emitted = proposed = accepted = 0
+        for i, req in enumerate(rows):
+            req.key = jnp.asarray(new_keys[i], jnp.uint32)
+            out = [int(toks[i])]
+            m = len(drafts[i])
+            proposed += m
+            if not req.params.do_sample:
+                g = greedy_h[i]
+                # out[0] == g[0]: both argmax the same fp32 logits row
+                for j in range(1, m + 1):
+                    if int(drafts[i][j - 1]) != int(g[j - 1]):
+                        break
+                    out.append(int(g[j]))
+            row_emitted = 0
+            for tok in out:
+                req.record_token(tok)
+                row_emitted += 1
+                self._record_latency(req, now)
+                if req.finished:
+                    break          # eos inside the accepted run
+            emitted += row_emitted
+            accepted += row_emitted - 1
+        self._spec_proposed_total += proposed
+        self._spec_accepted_total += accepted
+        if monitor.enabled():
+            if proposed:
+                self._m_spec_prop.inc(proposed)
+            if accepted:
+                self._m_spec_acc.inc(accepted)
+            if self._spec_proposed_total:
+                self._m_spec_rate.set(self._spec_accepted_total
+                                      / self._spec_proposed_total)
+        return emitted
+
+    def _record_latency(self, req, now) -> None:
+        """Per-token TTFT/TPOT attribution (the serving-paper
+        decomposition); tokens accepted in one spec step share a
+        timestamp — their inter-token latency really is ~0."""
+        if req.first_token_t is None:
+            req.first_token_t = now
+            if req.arrival_t is not None:
+                self._m_ttft.observe(now - req.arrival_t)
+        else:
+            self._m_tpot.observe(now - req.last_token_t)
+        req.last_token_t = now
 
     def _sample_rows(self, rows, logits):
         """Sample one token per live row from [B, V] fp32 logits (B may
@@ -684,15 +951,7 @@ class LLMEngine:
         for i, req in enumerate(rows):
             req.key = jnp.asarray(new_keys[i], jnp.uint32)
             req.record_token(int(toks[i]))
-            # per-request latency attribution: TTFT from arrival, TPOT
-            # between consecutive tokens (the serving-paper decomposition)
-            if req.first_token_t is None:
-                req.first_token_t = now
-                if req.arrival_t is not None:
-                    self._m_ttft.observe(now - req.arrival_t)
-            else:
-                self._m_tpot.observe(now - req.last_token_t)
-            req.last_token_t = now
+            self._record_latency(req, now)
 
     # -- perf attribution ---------------------------------------------------
 
@@ -938,6 +1197,7 @@ class LLMEngine:
     _KEY_FIELDS = {"prefill": ("prompt_len",),
                    "chunk": ("batch", "chunk_len"),
                    "ragged": ("batch", "chunk_len"),
+                   "verify": ("batch", "chunk_len"),
                    "sample": ("batch",)}
 
     def _count_compile(self, kind: str, key=None) -> None:
@@ -985,17 +1245,23 @@ class LLMEngine:
         monitor.flight.note("jit/recompile", fn=fname, axis=axis,
                             detail=detail)
 
-    def _model_tail(self, params, h):
-        """Final LN + tied LM head — the dense path's ln_f arithmetic
-        (`F.layer_norm`, NOT the block `_stacked_ln`) and lm_head einsum,
-        shared at array level so parity tracks the oracle by
-        construction."""
+    def _model_logits(self, params, h):
+        """Final LN + tied LM head over EVERY position — the dense
+        path's ln_f arithmetic (`F.layer_norm`, NOT the block
+        `_stacked_ln`) and lm_head einsum, shared at array level so
+        parity tracks the oracle by construction.  ALL logits-producing
+        step programs (prefill/chunk/ragged tails AND the spec verify
+        program) go through here: a change to the oracle tail reaches
+        them all."""
         from ..nn.functional import layer_norm_arrays
 
         hn = layer_norm_arrays(h, params["lnf_w"], params["lnf_b"],
                                epsilon=self.cfg.layer_norm_epsilon)
-        logits = jnp.einsum("bsh,vh->bsv", hn, params["wte"])
-        return logits[:, -1].astype(jnp.float32)
+        return jnp.einsum("bsh,vh->bsv", hn, params["wte"])
+
+    def _model_tail(self, params, h):
+        """Last position's fp32 logits — the decode/prefill tail."""
+        return self._model_logits(params, h)[:, -1].astype(jnp.float32)
 
     def _run_blocks(self, params, kv_flat, x, attn_builder):
         from ..models.gpt import _stacked_block_body
@@ -1122,6 +1388,45 @@ class LLMEngine:
 
                 h, kv_out = self._run_blocks(params, kv_flat, x, builder)
                 return self._model_tail(params, h), kv_out
+
+            self._jit_cache[key] = jax.jit(fn, donate_argnums=(1,))
+        return self._jit_cache[key]
+
+    def _get_verify_exec(self, b, c):
+        """The ISSUE-15 multi-token scoring program: the ragged fused
+        update+attend body at [b, c] (identical to `_get_ragged_exec` up
+        to the tail), returning EVERY position's greedy argmax plus the
+        position-0 fp32 logits (the sampler's input).  ONE fixed shape
+        (max_num_seqs, spec_tokens+1) serves every batch composition and
+        every draft hit/miss mix — padded draft positions carry dropped
+        slots and their outputs are never read."""
+        key = ("verify", b, c)
+        if key not in self._jit_cache:
+            self._count_compile("verify", key)
+
+            def fn(params, kv_flat, ids, pos0, lens, tables, slots):
+                pos = pos0[:, None] + jnp.arange(c, dtype=jnp.int32)[None]
+                x = jnp.take(params["wte"], ids, axis=0) \
+                    + jnp.take(params["wpe"], pos, axis=0)
+
+                def builder(kc, vc, ksc=None, vsc=None):
+                    def attn_fn(q, k, v, kc=kc, vc=vc, ksc=ksc, vsc=vsc):
+                        if ksc is None:
+                            o, kc2, vc2 = ragged_paged_attention_arrays(
+                                q, k, v, kc, vc, tables, pos0, lens,
+                                slots)
+                            return o, (kc2, vc2)
+                        o, kc2, vc2, ks2, vs2 = \
+                            ragged_paged_attention_arrays(
+                                q, k, v, kc, vc, tables, pos0, lens,
+                                slots, k_scales=ksc, v_scales=vsc)
+                        return o, (kc2, vc2, ks2, vs2)
+                    return attn_fn
+
+                h, kv_out = self._run_blocks(params, kv_flat, x, builder)
+                logits = self._model_logits(params, h).astype(jnp.float32)
+                greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return logits[:, 0], greedy, kv_out
 
             self._jit_cache[key] = jax.jit(fn, donate_argnums=(1,))
         return self._jit_cache[key]

@@ -35,6 +35,41 @@ Every transition asserts the refcount/free-list invariants — the
 allocator can never hand out a block that is still referenced
 (tests/test_serving.py fuzzes this).
 
+**Automatic prefix caching** (ISSUE 15 — the engine opts in via
+``EngineConfig(enable_prefix_caching=True)``; with nobody registering,
+nothing below changes behaviour):
+
+- **prefix index** — a hash-keyed map over FULL blocks.  Keys are
+  *chained* content digests (`prefix_block_keys`): block j's key hashes
+  (key_{j-1}, tokens_of_block_j), so one key identifies an entire
+  block-aligned token prefix — the radix-trie-equivalent over block
+  hashes.  sha1 digests, not python ``hash()``: a collision would adopt
+  WRONG KV silently, and int-tuple hashes are also what PYTHONHASHSEED
+  reseeding taught PR 2 to distrust.
+- **adoption** — `match_prefix` walks the chain to the longest indexed
+  prefix; `adopt_prefix` builds a new sequence's table from those
+  physical blocks by refcount bump — N requests sharing a system prompt
+  pay its prefill ONCE.  Only FULL blocks are ever indexed/adopted (a
+  full block is never written again while referenced, so sharing needs
+  no CoW), and adoption is capped below the full prompt by the caller
+  (the last prompt token must be recomputed for its logits).
+- **LRU parking** — a block whose refcount drops to 0 while indexed is
+  PARKED on an LRU instead of the free list: its content stays adoptable
+  and it is reclaimed LAST (`_take` drains the free list first, then
+  evicts the least-recently-used parked block, dropping its index
+  entry).  Parked blocks count as allocatable capacity
+  (`num_free_blocks`) but NOT as free for the utilization gauges
+  (`blocks_in_use` includes them — they hold live, reusable bytes).
+- observability: `serving/prefix_hits` / `prefix_hit_tokens` /
+  `prefix_evictions` counters (monitor-gated no-ops when PTPU_MONITOR
+  is off) plus the plain-int twins on the instance.
+
+**Speculative-decode rollback** (`truncate_to`): the verify step
+reserves blocks for up to k draft positions; rejected drafts roll the
+table back by releasing the surplus blocks — slots inside kept blocks
+that held rejected K/V are re-written by later real tokens before any
+mask lets a query read them.
+
 **Quantized mode** (``kv_quant="int8"``, the `paddle_tpu.lowbit` KV
 wing): pools store int8 codes plus per-block-per-head float32 scales
 (``k_scales[l], v_scales[l] : [num_blocks, num_heads]``, value =
@@ -48,14 +83,40 @@ block is reallocated (`_reset_scales`).
 """
 from __future__ import annotations
 
+import hashlib
+import struct
+from collections import OrderedDict
+
 import numpy as np
 import jax.numpy as jnp
 
-__all__ = ["BlockKVCache", "BlockAllocatorError"]
+from .. import monitor
+
+__all__ = ["BlockKVCache", "BlockAllocatorError", "prefix_block_keys"]
 
 
 class BlockAllocatorError(RuntimeError):
     pass
+
+
+def prefix_block_keys(token_ids, block_size) -> list:
+    """Chained content keys for every FULL block of `token_ids`.
+
+    key_j = sha1(key_{j-1} || tokens[j*bs:(j+1)*bs]) — equal keys imply
+    equal block-aligned token prefixes, so a single dict lookup per block
+    walks the radix-trie-equivalent.  Deterministic across processes
+    (PYTHONHASHSEED-free) and collision-safe in practice (adopting on a
+    collision would serve another prompt's KV)."""
+    bs = int(block_size)
+    keys = []
+    prev = b""
+    for j in range(len(token_ids) // bs):
+        block = token_ids[j * bs:(j + 1) * bs]
+        prev = hashlib.sha1(
+            prev + struct.pack(f"<{bs}q", *[int(t) for t in block])
+        ).digest()
+        keys.append(prev)
+    return keys
 
 
 class _Block:
@@ -100,6 +161,22 @@ class BlockKVCache:
         self._tables: dict = {}        # seq_id -> [physical ids]
         self._lengths: dict = {}       # seq_id -> token count covered
         self.peak_blocks_in_use = 0
+        # -- prefix cache (ISSUE 15; inert until register_prefix) ----------
+        self._prefix_index: dict = {}  # chain key (bytes) -> physical id
+        self._block_key: dict = {}     # physical id -> chain key
+        self._lru: "OrderedDict" = OrderedDict()   # parked ids, LRU first
+        self.prefix_hits = 0
+        self.prefix_hit_tokens = 0
+        self.prefix_evictions = 0
+        self._m_hits = monitor.counter(
+            "serving/prefix_hits", "requests that adopted cached prefix "
+            "blocks at admission")
+        self._m_hit_toks = monitor.counter(
+            "serving/prefix_hit_tokens",
+            "prompt tokens whose prefill was paid by a cached prefix")
+        self._m_evict = monitor.counter(
+            "serving/prefix_evictions",
+            "parked prefix blocks reclaimed for fresh allocations")
 
     # -- introspection ------------------------------------------------------
 
@@ -136,10 +213,22 @@ class BlockKVCache:
 
     @property
     def num_free_blocks(self) -> int:
-        return len(self._free)
+        """ALLOCATABLE blocks: truly free plus LRU-parked prefix blocks
+        (parked blocks are reclaimed — last — by `_take`), the number
+        admission decisions budget against."""
+        return len(self._free) + len(self._lru)
+
+    @property
+    def num_parked_blocks(self) -> int:
+        """Unreferenced blocks held by the prefix index (adoptable AND
+        reclaimable)."""
+        return len(self._lru)
 
     @property
     def blocks_in_use(self) -> int:
+        """Blocks holding live bytes — referenced OR parked.  Parked
+        prefix blocks are deliberately counted in-use: the utilization
+        gauges must not report reusable-cache bytes as free capacity."""
         return self.num_blocks - len(self._free)
 
     def block_table(self, seq_id):
@@ -167,9 +256,17 @@ class BlockKVCache:
     # -- allocate / grow / free --------------------------------------------
 
     def _take(self) -> int:
-        if not self._free:
+        if self._free:
+            i = self._free.pop()
+        elif self._lru:
+            # reclaimed LAST, least-recently-used first: the parked block
+            # stops being adoptable the moment its bytes are handed out
+            i, _ = self._lru.popitem(last=False)
+            self._drop_index(i)
+            self.prefix_evictions += 1
+            self._m_evict.inc()
+        else:
             raise BlockAllocatorError("out of KV blocks")
-        i = self._free.pop()
         blk = self._blocks[i]
         assert blk.ref == 0, f"free list handed out a referenced block {i}"
         blk.ref = 1
@@ -182,7 +279,17 @@ class BlockKVCache:
         assert blk.ref > 0, f"double free of block {idx}"
         blk.ref -= 1
         if blk.ref == 0:
-            self._free.append(idx)
+            if idx in self._block_key:
+                # indexed prefix block: park (content stays adoptable)
+                self._lru[idx] = None
+                self._lru.move_to_end(idx)
+            else:
+                self._free.append(idx)
+
+    def _drop_index(self, idx) -> None:
+        key = self._block_key.pop(idx, None)
+        if key is not None:
+            self._prefix_index.pop(key, None)
 
     def _needs_cow(self, seq_id, num_tokens) -> bool:
         """Will growing to `num_tokens` write into a SHARED partially-
@@ -201,14 +308,14 @@ class BlockKVCache:
         need = self.blocks_needed(num_tokens) - have
         if self._needs_cow(seq_id, num_tokens):
             need += 1              # CoW of the shared last block
-        return need <= len(self._free)
+        return need <= self.num_free_blocks
 
     def allocate(self, seq_id, num_tokens):
         """Register `seq_id` and give it blocks covering `num_tokens`."""
         if seq_id in self._tables:
             raise BlockAllocatorError(f"sequence {seq_id} already allocated")
         need = self.blocks_needed(num_tokens)
-        if need > len(self._free):
+        if need > self.num_free_blocks:
             raise BlockAllocatorError("out of KV blocks")
         ids = [self._take() for _ in range(need)]
         self._tables[seq_id] = ids
@@ -234,6 +341,20 @@ class BlockKVCache:
         for idx in self._tables.pop(seq_id):
             self._release(idx)
         self._lengths.pop(seq_id, None)
+
+    def truncate_to(self, seq_id, num_tokens):
+        """Shrink a sequence's table to cover exactly `num_tokens` tokens
+        — the speculative-decode rollback: blocks reserved for rejected
+        draft positions are released (decref — a shared block survives
+        for its other holders).  Slots inside KEPT blocks that held
+        rejected K/V are overwritten by later real tokens before any
+        causal mask lets a query read them."""
+        t = self._tables[seq_id]
+        keep = self.blocks_needed(num_tokens)
+        while len(t) > keep:
+            self._release(t.pop())
+        self._lengths[seq_id] = min(self._lengths[seq_id],
+                                    int(num_tokens))
 
     # -- copy-on-fork -------------------------------------------------------
 
@@ -279,6 +400,80 @@ class BlockKVCache:
         t[-1] = dst
         self._release(src)
 
+    # -- automatic prefix caching (ISSUE 15) --------------------------------
+
+    def register_prefix(self, seq_id, keys, num_tokens) -> None:
+        """Index `seq_id`'s fully-written leading blocks under their
+        chain keys (`prefix_block_keys` of the prompt).  Only blocks
+        wholly inside the first `num_tokens` computed tokens are indexed
+        — a full block is never written again while referenced, so its
+        content is final.  First writer wins: an existing key keeps
+        pointing at the original block (dedup, not re-pointing)."""
+        t = self._tables[seq_id]
+        full = min(len(keys), int(num_tokens) // self.block_size, len(t))
+        for j in range(full):
+            key = keys[j]
+            if key in self._prefix_index:
+                continue
+            idx = t[j]
+            if idx in self._block_key:
+                continue   # already indexed under another chain
+            self._prefix_index[key] = idx
+            self._block_key[idx] = key
+
+    def match_prefix(self, keys, max_blocks=None) -> int:
+        """Longest indexed prefix of `keys`, in blocks.  Walks the chain
+        in order and stops at the first miss; refreshes the recency of
+        every parked block it matches."""
+        limit = len(keys) if max_blocks is None else min(len(keys),
+                                                        int(max_blocks))
+        n = 0
+        for j in range(limit):
+            idx = self._prefix_index.get(keys[j])
+            if idx is None:
+                break
+            if idx in self._lru:
+                self._lru.move_to_end(idx)
+            n += 1
+        return n
+
+    def adoptable_free_blocks(self, keys, n_blocks) -> int:
+        """`num_free_blocks` minus the first `n_blocks` matched blocks
+        that are currently PARKED — adopting those revives them, so an
+        admission check must not count them as reclaimable capacity
+        too (the double-count would admit a request that cannot fit)."""
+        parked = sum(1 for key in keys[:n_blocks]
+                     if self._prefix_index.get(key) in self._lru)
+        return self.num_free_blocks - parked
+
+    def adopt_prefix(self, seq_id, keys, n_blocks) -> int:
+        """Start `seq_id` from the cached chain: its table begins with
+        the `n_blocks` indexed physical blocks (refcount bump — parked
+        blocks are revived off the LRU; no bytes move).  Returns the
+        adopted token count, which the caller records as the sequence's
+        already-computed prefix."""
+        if seq_id in self._tables:
+            raise BlockAllocatorError(f"sequence {seq_id} already exists")
+        ids = []
+        for key in keys[:n_blocks]:
+            idx = self._prefix_index[key]
+            blk = self._blocks[idx]
+            if blk.ref == 0:
+                self._lru.pop(idx, None)
+            blk.ref += 1
+            ids.append(idx)
+        self._tables[seq_id] = ids
+        hit_tokens = len(ids) * self.block_size
+        self._lengths[seq_id] = hit_tokens
+        self.peak_blocks_in_use = max(self.peak_blocks_in_use,
+                                      self.blocks_in_use)
+        if ids:
+            self.prefix_hits += 1
+            self.prefix_hit_tokens += hit_tokens
+            self._m_hits.inc()
+            self._m_hit_toks.inc(hit_tokens)
+        return hit_tokens
+
     def privatize_last_block(self, seq_id):
         """Copy the sequence's last block now if it is shared.  A forked
         child RE-WRITES its final inherited position (it re-feeds the
@@ -314,7 +509,7 @@ class BlockKVCache:
     def swap_in(self, seq_id, saved):
         """Restore an evicted sequence bit-exactly into fresh blocks."""
         n = len(saved["k"][0])
-        if n > len(self._free):
+        if n > self.num_free_blocks:
             raise BlockAllocatorError("out of KV blocks")
         self._tables[seq_id] = [self._take() for _ in range(n)]
         self._lengths[seq_id] = saved["len"]

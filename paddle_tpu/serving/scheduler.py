@@ -61,6 +61,9 @@ class Request:
         self.num_computed = 0              # prompt tokens prefilled so far
         self.key = None                    # per-request PRNG key (engine)
         self.swap = None                   # host KV snapshot while evicted
+        self.prefix_keys = None            # chained block keys (engine;
+        #                                    set only with prefix caching)
+        self.prefix_hit_tokens = 0         # prompt tokens adopted cached
         self.arrival = None                # admission tiebreak (set by add)
         self.deadline = None               # resilience.Deadline (engine)
         # -- observability (engine-owned; monitor.trace v2) ----------------
@@ -114,13 +117,42 @@ class SchedulerOutput:
 
 
 class Scheduler:
-    def __init__(self, cache, max_num_seqs=8, max_num_batched_tokens=2048):
+    def __init__(self, cache, max_num_seqs=8, max_num_batched_tokens=2048,
+                 spec_tokens=0, max_model_len=None):
         self.cache = cache
         self.max_num_seqs = int(max_num_seqs)
         self.max_num_batched_tokens = int(max_num_batched_tokens)
+        # speculative decoding (ISSUE 15): a decode step may write up to
+        # `spec_tokens` draft positions past each row's last token, so
+        # the decode branch reserves blocks for that extent up front (the
+        # engine rolls the table back to the ACCEPTED length after the
+        # step).  Clamped per row so no write position ever reaches
+        # max_model_len.
+        self.spec_tokens = max(0, int(spec_tokens))
+        self.max_model_len = (None if max_model_len is None
+                              else int(max_model_len))
         self.waiting: deque = deque()
         self.running: list = []
         self._arrival = 0
+
+    def _decode_reserve_len(self, req) -> int:
+        """Token coverage the decode step needs for `req`: total_len (the
+        non-spec write of position total_len-1) plus the row's REAL draft
+        budget — the same clamp the engine's proposer applies, so rows
+        that can never carry drafts (sampling rows, rows within one token
+        of max_new_tokens or max_model_len) reserve nothing extra and
+        can't evict a neighbour for blocks nobody will write."""
+        extra = self.spec_tokens
+        if extra:
+            p = req.params
+            if p.do_sample:
+                extra = 0
+            else:
+                extra = min(extra,
+                            p.max_new_tokens - len(req.output_ids) - 1)
+                if self.max_model_len is not None:
+                    extra = min(extra, self.max_model_len - req.total_len)
+        return req.total_len + max(0, extra)
 
     # -- request lifecycle --------------------------------------------------
 
@@ -188,11 +220,15 @@ class Scheduler:
                     continue                 # evicted mid-loop / mid-prefill
                 # this step writes position total_len - 1 (the last
                 # sampled token's K/V) — coverage of total_len tokens is
-                # exactly enough; one more would take a block a step early
-                if not self._ensure_blocks(req, req.total_len, preempted,
+                # exactly enough (one more would take a block a step
+                # early) — plus the speculative draft extent when spec
+                # decoding is on (rolled back to the accepted length by
+                # the engine after the step)
+                reserve = self._decode_reserve_len(req)
+                if not self._ensure_blocks(req, reserve, preempted,
                                            protect=req):
                     continue                 # req itself was evicted
-                self.cache.grow_to(req.req_id, req.total_len)
+                self.cache.grow_to(req.req_id, reserve)
                 rows.append(req)
             # a LATER row's reservation may have evicted an EARLIER row
             # that already made it into the batch — a preempted row's
@@ -219,21 +255,47 @@ class Scheduler:
             return True
         start = req.num_computed    # >0 only for forked children, which
         #                             already hold (shared) prefix blocks.
-        # Admission budgets TOKENS and KV blocks only: the ragged decode
-        # program runs at a fixed max_num_seqs width, so an admitted row
-        # joins the batch directly — there is no per-bucket padding
-        # budget to respect (the bucketed fallback pads the batch up to
-        # the next power of 2 itself).
-        chunk = min(req.prompt_len - start, self.max_num_batched_tokens)
-        target = start + chunk
         forked = req.req_id in self.cache._tables
-        fits = (self.cache.can_grow_to(req.req_id, target) if forked
-                else self.cache.blocks_needed(target)
-                <= self.cache.num_free_blocks)
+        # Automatic prefix caching (ISSUE 15): a fresh request first
+        # matches its chained block keys against the prefix index and
+        # adopts the longest cached run by refcount bump — capped below
+        # the full prompt (the last prompt token must be recomputed for
+        # its logits) and block-aligned (only full, never-rewritten
+        # blocks are shared).  Adoption happens ONLY when the remaining
+        # chunk also fits, so a failed admission holds no blocks.
+        hit_blocks = 0
+        if (not forked and start == 0 and req.prefix_keys
+                and not req.prefix_hit_tokens):
+            hit_blocks = self.cache.match_prefix(
+                req.prefix_keys,
+                max_blocks=(req.prompt_len - 1) // self.cache.block_size)
+        # The prefill-chunking token budget counts only UNCACHED tokens:
+        # a prefix-hit request's chunk starts at the first uncached
+        # token, so a hot request admits its real remaining work instead
+        # of being under-batched by its (already-paid) cached prefix.
+        hit_tokens = hit_blocks * self.cache.block_size
+        chunk = min(req.prompt_len - start - hit_tokens,
+                    self.max_num_batched_tokens)
+        target = start + hit_tokens + chunk
+        if hit_blocks:
+            need = self.cache.blocks_needed(target) - hit_blocks
+            fits = need <= self.cache.adoptable_free_blocks(
+                req.prefix_keys, hit_blocks)
+        elif forked:
+            fits = self.cache.can_grow_to(req.req_id, target)
+        else:
+            fits = (self.cache.blocks_needed(target)
+                    <= self.cache.num_free_blocks)
         if not fits:
             return None
         self.waiting.remove(req)
-        if forked:
+        if hit_blocks:
+            req.prefix_hit_tokens = self.cache.adopt_prefix(
+                req.req_id, req.prefix_keys, hit_blocks)
+            req.num_computed = req.prefix_hit_tokens
+            start = req.num_computed
+            self.cache.grow_to(req.req_id, target)
+        elif forked:
             self.cache.grow_to(req.req_id, target)
         else:
             self.cache.allocate(req.req_id, target)

@@ -87,6 +87,14 @@ def main():
     ap.add_argument("--perf", action="store_true",
                     help="enable perf attribution and assert/print the "
                          "decode segment breakdown + roofline table")
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="assert the ISSUE-15 automatic-prefix-caching "
+                         "surface (hits, hit_tokens=(N-1)*prefix_len, "
+                         "flat compiles across hit/miss)")
+    ap.add_argument("--spec", action="store_true",
+                    help="assert the ISSUE-15 speculative-decoding "
+                         "surface (accept_rate>0, >1 token per decode "
+                         "step on a repetitive workload, flat compiles)")
     args = ap.parse_args()
 
     monitor.refresh()
@@ -171,6 +179,9 @@ def main():
         check_perf(engine, snap, cfg)
     if args.trace:
         check_trace(engine, snap, len(prompts))
+    if args.prefix_cache or args.spec:
+        check_prefix_spec(model, cfg, prefix=args.prefix_cache,
+                          spec=args.spec)
     print("OK")
 
 
@@ -286,6 +297,109 @@ def check_perf(engine, snap, cfg):
                 # then the unavailability marker must be exported instead
                 assert "perf_analysis_unavailable" in txt, want
         print("endpoint: perf/* gauges exported")
+
+
+def check_prefix_spec(model, cfg, prefix, spec):
+    """ISSUE 15 acceptance, measured on this host: N requests sharing a
+    prefix pay its prefill once (`serving/prefix_hit_tokens` ==
+    (N-1)*prefix_len), speculative decode emits >1 accepted token per
+    decode step on a repetitive workload (accept_rate > 0), and
+    `serving/compiles` + `jit/recompiles{fn=serving:*}` stay FLAT across
+    a second hit/miss round (all shapes fixed)."""
+    from paddle_tpu.serving import EngineConfig, LLMEngine, SamplingParams
+
+    k = 3 if spec else 0
+    eng = LLMEngine(model, EngineConfig(
+        block_size=16, max_num_seqs=4, enable_prefix_caching=prefix,
+        speculative_tokens=k))
+    rng = np.random.RandomState(5)
+    compiles = monitor.counter("serving/compiles")
+    recompiles = monitor.counter("jit/recompiles")
+
+    def count(c):
+        snap_ = c.snapshot()
+        if not isinstance(snap_, dict):
+            return float(snap_ or 0)
+        return sum(v for key, v in sorted(snap_.items())
+                   if "serving" in key or "kind=" in key)
+
+    if prefix:
+        # N=4 requests sharing a 32-token (2-block) prefix: request 0
+        # pays the prefill and populates the index; 1..3 adopt it
+        shared = rng.randint(0, cfg.vocab_size, (32,)).astype(np.int32)
+        tails = [rng.randint(0, cfg.vocab_size, (t,)).astype(np.int32)
+                 for t in (8, 8, 12)]
+        cold = np.concatenate([shared,
+                               rng.randint(0, cfg.vocab_size, (8,))
+                               .astype(np.int32)])
+        sp = SamplingParams(max_new_tokens=4)
+        eng.generate([cold], sp)
+        assert eng.cache.prefix_hits == 0, eng.cache.prefix_hits
+        eng.generate([np.concatenate([shared, t]) for t in tails], sp)
+        hit_toks = eng.cache.prefix_hit_tokens
+        assert eng.cache.prefix_hits == 3, eng.cache.prefix_hits
+        assert hit_toks == 3 * 32, hit_toks     # (N-1) * prefix_len
+        assert eng.cache.num_parked_blocks > 0
+        snap_ = monitor.snapshot()
+        assert snap_.get("serving/prefix_hits") == 3, snap_.get(
+            "serving/prefix_hits")
+        assert snap_.get("serving/prefix_hit_tokens") == hit_toks
+        print(f"prefix cache: hits=3 hit_tokens={hit_toks} "
+              f"(= (N-1)*prefix_len), parked="
+              f"{eng.cache.num_parked_blocks} blocks")
+        # flat compiles across a second hit/miss round: one more hit
+        # (cached prefix, fresh 8-token tail) and one full miss (fresh
+        # prefix, same prompt length) — every shape already compiled
+        c0, r0 = count(compiles), count(recompiles)
+        miss = rng.randint(0, cfg.vocab_size, (40,)).astype(np.int32)
+        eng.generate([np.concatenate([shared,
+                                      rng.randint(0, cfg.vocab_size, (8,))
+                                      .astype(np.int32)]), miss], sp)
+        dc, dr = count(compiles) - c0, count(recompiles) - r0
+        assert dc == 0 and dr == 0, (dc, dr)
+        assert eng.cache.prefix_hits == 4
+        print("compiles FLAT across hit/miss round (0 new compiles, "
+              "0 new serving recompiles)")
+
+    if spec:
+        # repetitive workload: the n-gram proposer reads the repeating
+        # pattern (and the cycle greedy decoding settles into) and the
+        # verify step accepts multi-token runs
+        pat = rng.randint(0, cfg.vocab_size, (4,)).astype(np.int32)
+        prompt = np.concatenate([pat] * 4)
+        sp = SamplingParams(max_new_tokens=24)
+        rid = eng.add_request(prompt, sp)
+        try:
+            decode_steps = toks_before = 0
+            while eng.has_unfinished():
+                was = len(eng._requests[rid].output_ids)
+                eng.step()
+                if len(eng._requests[rid].output_ids) > was:
+                    if was > 0:
+                        decode_steps += 1
+                        toks_before += (len(eng._requests[rid].output_ids)
+                                        - was)
+            out_len = len(eng._requests[rid].output_ids)
+        finally:
+            eng.release_request(rid)
+        assert out_len == 24, out_len
+        tps_step = toks_before / max(decode_steps, 1)
+        snap_ = monitor.snapshot()
+        proposed = snap_.get("serving/spec_proposed", 0)
+        accepted = snap_.get("serving/spec_accepted", 0)
+        rate = snap_.get("serving/spec_accept_rate", 0.0)
+        assert proposed > 0 and accepted > 0, (proposed, accepted)
+        assert rate > 0, rate
+        assert tps_step > 1.0, (
+            f"spec decode emitted only {tps_step:.2f} tokens/step")
+        print(f"spec decode: {tps_step:.2f} accepted tokens/decode-step, "
+              f"accept_rate={rate:.2f} ({accepted}/{proposed} drafts)")
+        # flat compiles on a further spec round (same shapes)
+        c0, r0 = count(compiles), count(recompiles)
+        eng.generate([prompt], SamplingParams(max_new_tokens=8))
+        dc, dr = count(compiles) - c0, count(recompiles) - r0
+        assert dc == 0 and dr == 0, (dc, dr)
+        print("compiles FLAT across spec round (0 new)")
 
 
 def check_trace(engine, snap, n_requests):

@@ -476,7 +476,11 @@ class _FakeFleet:
                   "# TYPE train_goodput_examples_per_s gauge\n"
                   "train_goodput_examples_per_s 64\n"
                   "# TYPE train_data_wait_frac gauge\n"
-                  "train_data_wait_frac 0.125\n",
+                  "train_data_wait_frac 0.125\n"
+                  "# TYPE serving_spec_accept_rate gauge\n"
+                  "serving_spec_accept_rate 0.75\n"
+                  "# TYPE serving_prefix_hit_tokens counter\n"
+                  "serving_prefix_hit_tokens 96\n",
             "r1": "# TYPE serving_decode_tokens counter\n"
                   "serving_decode_tokens 7\n",
         }
@@ -648,10 +652,13 @@ def test_snapshot_is_the_router_feed(fake, tmp_path):
     assert snap["r0"]["step_time"] == 0.25
     assert snap["r0"]["goodput_examples_per_s"] == 64.0
     assert snap["r0"]["data_wait_frac"] == 0.125
+    # ISSUE 15: spec-accept + prefix-cache heat ride the feed too
+    assert snap["r0"]["spec_accept_rate"] == 0.75
+    assert snap["r0"]["prefix_hit_tokens"] == 96.0
     for k in ("goodput_tokens_per_s", "padding_waste_rows",
               "kernels_per_step", "rss_bytes", "open_fds",
               "step_time", "goodput_examples_per_s", "data_wait_frac",
-              "straggler_skew"):
+              "straggler_skew", "spec_accept_rate", "prefix_hit_tokens"):
         assert snap["r1"][k] is None, (k, snap["r1"][k])
 
 

@@ -420,11 +420,16 @@ class TestMonitorAndSmoke:
     def test_serve_smoke_script(self):
         # --trace: the ISSUE-5 observability acceptance (ttft/tpot
         # percentiles, parent-linked request trace, chrome export, live
-        # endpoint) and --perf: the ISSUE-6 one (decode-segment
+        # endpoint), --perf: the ISSUE-6 one (decode-segment
         # breakdown populated, attribution table, perf/* gauges on the
-        # endpoint) assert in-script ON TOP of the plain smoke checks,
-        # so ONE subprocess covers all three (tests/test_trace.py and
-        # tests/test_perf.py lean on this invocation)
+        # endpoint), and --prefix-cache --spec: the ISSUE-15 one
+        # (hit_tokens == (N-1)*prefix_len, accept_rate > 0 with >1
+        # token per decode step, compiles FLAT across hit/miss and
+        # spec rounds) all assert in-script ON TOP of the plain smoke
+        # checks, so ONE subprocess covers every leg (tests/test_trace
+        # .py and tests/test_perf.py lean on this invocation; tier-1
+        # budget leaves no room for a second engine-compiling
+        # subprocess)
         script = (pathlib.Path(__file__).resolve().parent.parent
                   / "scripts" / "serve_smoke.py")
         env = {k: v for k, v in os.environ.items()
@@ -433,7 +438,7 @@ class TestMonitorAndSmoke:
         env["JAX_PLATFORMS"] = "cpu"
         env["PTPU_MONITOR"] = "1"
         proc = subprocess.run([sys.executable, str(script), "--trace",
-                               "--perf"],
+                               "--perf", "--prefix-cache", "--spec"],
                               env=env, capture_output=True, text=True,
                               timeout=560)
         assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
@@ -444,6 +449,10 @@ class TestMonitorAndSmoke:
         assert "decode breakdown:" in proc.stdout
         assert "perf attribution" in proc.stdout
         assert "perf/* gauges exported" in proc.stdout
+        assert "prefix cache: hits=3 hit_tokens=96" in proc.stdout
+        assert "compiles FLAT across hit/miss round" in proc.stdout
+        assert "accept_rate=" in proc.stdout
+        assert "compiles FLAT across spec round" in proc.stdout
 
 
 class TestPagedAttentionOp:

@@ -80,6 +80,12 @@ EOF
     # padding-waste, self-asserting the 3→5 crossing stays FLAT (lives
     # here, NOT in fast — tier-1 room is scarce at ~790s of 870s)
     python bench.py --config kernel_count
+    # ISSUE 15 serving-throughput lanes (same tier-placement logic):
+    # cold-vs-hot TTFT for a shared-prefix batch, and steady-state
+    # decode-step tokens/s spec-on vs spec-off (min/best-over-steps —
+    # whole-generate walls drift >50% on shared hosts)
+    python bench.py --config prefix_prefill
+    python bench.py --config spec_decode
     # real-lane history gate: default 7% tolerance, smoke lines skipped
     # (on a chip host the headline is the non-smoke metric and gates;
     # after an outage fallback the smoke line is reported only)
