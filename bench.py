@@ -888,7 +888,14 @@ def bench_trace_overhead():
     PTPU_TRAIN_STATS gate read guarding the sampled per-layer
     reduction; the divergence forensics scan runs only on the bad-step
     path and the per-layer reduction only on sampled opt-in steps, so
-    neither belongs in the per-step tax): what the
+    neither belongs in the per-step tax — and ISSUE 16 to the
+    request-plane hooks: the engine step's slo.maybe_tick + reqlog gate
+    reads (one module-global read each when off), the exemplar-stamping
+    observe(v, trace_id=) signature on the latency histograms, the
+    tail-sampling keep decision at root-span end, and — in the enabled
+    measurement, with reqlog + exemplars + a zero tail budget flipped
+    on — the wide-event build+emit charged EVERY step (conservative:
+    real traffic releases at most one request per step)): what the
     monitor+trace+perf layers add to a train step, off vs on, asserting
     disabled overhead < 1% and enabled overhead < 5% of the step.  "Enabled" means monitor+trace; PTPU_PERF stays off in both
     measurements — perf mode deliberately syncs every timed call (MFU
@@ -914,6 +921,8 @@ def bench_trace_overhead():
 
     mtrace = monitor.trace
     mperf = monitor.perf
+    mreqlog = monitor.reqlog
+    mslo = monitor.slo
     on_tpu = _on_tpu()
     cfg = gpt_test_config(num_hidden_layers=2, stacked_blocks=True)
     batch, seq = (8, 128) if on_tpu else (4, 32)
@@ -942,6 +951,9 @@ def bench_trace_overhead():
     grad_cell = [None]
     fake_grads = [a_args[0]]   # the lazy grad-norm CELL STORE (the
     # reduction itself runs at scrape time, off the per-step path)
+    # ISSUE 16: the engine's __init__-cached latency histogram, observed
+    # with the exemplar-stamping signature every step
+    m_lat = monitor.histogram("bench/ttft")
 
     def instr(i):
         # exactly what one instrumented step adds on top of the math:
@@ -990,6 +1002,18 @@ def bench_trace_overhead():
                 meter.wait(1e-7)
                 meter.step(1e-6, examples=8)
                 grad_cell[0] = list(fake_grads)
+                # ISSUE 16: exemplar-stamping observe (the engine's
+                # _record_latency signature; stamps only with
+                # PTPU_EXEMPLARS on, kwarg-pass + gate read otherwise)
+                m_lat.observe(1e-4, trace_id="bench-trace")
+            # ISSUE 16 engine-step hooks: slo tick + reqlog emit gate
+            # (one module-global read each when off); with reqlog on,
+            # the release-time wide-event build+emit charged every step
+            mslo.maybe_tick()
+            if mreqlog.enabled():
+                mreqlog.emit(mreqlog.event(
+                    i, trace_id="bench-trace", ttft_s=1e-4,
+                    generated_tokens=8))
             t0 = time.perf_counter() if perf_on else 0.0   # jit hook
             _ = time.perf_counter() if perf_on else 0.0    # decode segs
             with mperf.segment("bench", "forward"):
@@ -1008,19 +1032,34 @@ def bench_trace_overhead():
 
     prev_mon, prev_trace = monitor.enabled(), mtrace.enabled()
     prev_perf = mperf.enabled()
+    prev_rl, prev_ex = mreqlog.enabled(), monitor.exemplars_enabled()
+    prev_tail = mtrace.tail_budget()
     try:
         mperf.enable(False)   # perf is a synced diagnostic mode: its
         # disabled cost gates here, its enabled cost is the point of it
         monitor.enable(False)
         mtrace.enable(False)
+        mreqlog.enable(False)
+        monitor.enable_exemplars(False)
+        mtrace.set_tail_budget(None)
         c_off = min(per_call(20_000) for _ in range(3))
         monitor.enable(True)
         mtrace.enable(True)
+        # ISSUE 16 wings on: ring-only reqlog, exemplar stamping, and a
+        # zero tail budget (every boring root pays the keep decision AND
+        # the drop — the most expensive sampling path)
+        mreqlog.enable(True)
+        monitor.enable_exemplars(True)
+        mtrace.set_tail_budget(0)
         c_on = min(per_call(5_000) for _ in range(3))
     finally:
         monitor.enable(prev_mon)
         mtrace.enable(prev_trace)
         mperf.enable(prev_perf)
+        mreqlog.enable(prev_rl)
+        monitor.enable_exemplars(prev_ex)
+        mtrace.set_tail_budget(prev_tail)
+        mreqlog.reset()
     off_pct = c_off / t_step * 100.0
     on_pct = c_on / t_step * 100.0
     assert off_pct < 1.0, (

@@ -41,6 +41,7 @@ __all__ = [
     "counter", "gauge", "histogram", "snapshot", "export_prometheus",
     "export_jsonl", "render", "reset", "enabled", "enable", "refresh",
     "timer", "STAT_ADD", "STAT_SUB", "STAT_RESET",
+    "exemplars_enabled", "enable_exemplars",
 ]
 
 
@@ -65,9 +66,35 @@ def enable(on: bool = True):
 
 
 def refresh():
-    """Re-read PTPU_MONITOR from the environment."""
-    global _enabled
+    """Re-read PTPU_MONITOR (+ PTPU_EXEMPLARS) from the environment."""
+    global _enabled, _exemplars
     _enabled = _env_enabled()
+    _exemplars = _env_exemplars()
+
+
+# -- histogram exemplars (ISSUE 16) -----------------------------------------
+# Opt-in on top of PTPU_MONITOR: when on, Histogram.observe(v, trace_id=)
+# stamps the observation's trace id on the bucket it lands in, rendered
+# in OpenMetrics exemplar syntax on /metrics — the link from "p99 ttft
+# spiked" to the kept tail-sampled trace that caused it.  One slot per
+# bucket (newest wins): bounded, no per-observation allocation growth.
+
+def _env_exemplars() -> bool:
+    return os.environ.get("PTPU_EXEMPLARS", "0").strip().lower() not in (
+        "0", "false", "off", "")
+
+
+_exemplars = _env_exemplars()
+
+
+def exemplars_enabled() -> bool:
+    return _exemplars
+
+
+def enable_exemplars(on: bool = True):
+    """Flip exemplar capture on/off at runtime (overrides PTPU_EXEMPLARS)."""
+    global _exemplars
+    _exemplars = bool(on)
 
 
 def _coerce(v):
@@ -294,7 +321,7 @@ class Histogram(_Metric):
     def _make_child(self):
         return Histogram(self.name, self.help, self._buckets)
 
-    def observe(self, v):
+    def observe(self, v, trace_id=None):
         if not _enabled:
             return self
         v = float(v)
@@ -306,6 +333,10 @@ class Histogram(_Metric):
             self._min = v if self._count == 1 else min(self._min, v)
             self._max = v if self._count == 1 else max(self._max, v)
             self._touched = True
+            if _exemplars and trace_id:
+                if self._exm is None:
+                    self._exm = [None] * len(self._counts)
+                self._exm[i] = (str(trace_id), v, time.time())
         return self
 
     @property
@@ -341,11 +372,14 @@ class Histogram(_Metric):
             return out
 
     def _bucket_rows(self):
-        """Consistent (buckets, per-bucket counts, count, sum) copy."""
+        """Consistent (buckets, per-bucket counts, count, sum, exemplars)
+        copy — exemplars is None until one was ever stamped."""
         with self._lock:
-            return self._buckets, list(self._counts), self._count, self._sum
+            return (self._buckets, list(self._counts), self._count,
+                    self._sum,
+                    None if self._exm is None else list(self._exm))
 
-    def _merge_buckets(self, buckets, counts, count, sum_):
+    def _merge_buckets(self, buckets, counts, count, sum_, exemplars=None):
         """Merge another histogram's raw bucket state into this one —
         the fleet-federation path (counts parsed back from a replica's
         exposition).  Bucket BOUNDS must match exactly: replicas run the
@@ -385,6 +419,19 @@ class Histogram(_Metric):
                 self._counts[i] += c
             self._count += count
             self._sum += sum_
+            # exemplars survive federation: newest-by-timestamp wins per
+            # bucket (bypasses the PTPU_EXEMPLARS gate like every other
+            # merge write — this is reconstruction, not instrumentation)
+            if exemplars:
+                if self._exm is None:
+                    self._exm = [None] * len(self._counts)
+                for i, ex in enumerate(exemplars[:len(self._exm)]):
+                    if ex is None:
+                        continue
+                    cur = self._exm[i]
+                    if cur is None or ex[2] >= cur[2]:
+                        self._exm[i] = (str(ex[0]), float(ex[1]),
+                                        float(ex[2]))
             self._touched = True
         return self
 
@@ -394,6 +441,7 @@ class Histogram(_Metric):
         self._sum = 0.0
         self._min = 0.0
         self._max = 0.0
+        self._exm = None   # per-bucket (trace_id, value, ts), lazy
 
 
 _NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
@@ -424,6 +472,14 @@ def _prom_num(v) -> str:
     if v == int(v) and abs(v) < 1e15:
         return str(int(v))
     return repr(v)
+
+
+def _exemplar_suffix(ex) -> str:
+    """OpenMetrics exemplar rendering for one bucket line:
+    `` # {trace_id="..."} <value> <unix_ts>``."""
+    tid, v, ts = ex
+    return (f' # {{trace_id="{_prom_label_value(str(tid))}"}} '
+            f"{_prom_num(v)} {repr(float(ts))}")
 
 
 class StatRegistry:
@@ -503,16 +559,22 @@ class StatRegistry:
             lines.append(f"# TYPE {pname} {m.kind}")
             for key, s in series:
                 if isinstance(s, Histogram):
-                    buckets, counts, count, total = s._bucket_rows()
+                    buckets, counts, count, total, exm = s._bucket_rows()
                     cum = 0
-                    for le, c in zip(buckets, counts):
+                    for i, (le, c) in enumerate(zip(buckets, counts)):
                         cum += c
-                        lines.append(
-                            f"{pname}_bucket"
-                            f"{_prom_labels(key, [('le', repr(le))])} {cum}")
-                    lines.append(
-                        f"{pname}_bucket"
-                        f"{_prom_labels(key, [('le', '+Inf')])} {count}")
+                        line = (f"{pname}_bucket"
+                                f"{_prom_labels(key, [('le', repr(le))])}"
+                                f" {cum}")
+                        if exm is not None and exm[i] is not None:
+                            line += _exemplar_suffix(exm[i])
+                        lines.append(line)
+                    line = (f"{pname}_bucket"
+                            f"{_prom_labels(key, [('le', '+Inf')])}"
+                            f" {count}")
+                    if exm is not None and exm[len(buckets)] is not None:
+                        line += _exemplar_suffix(exm[len(buckets)])
+                    lines.append(line)
                     lines.append(
                         f"{pname}_sum{_prom_labels(key)} {_prom_num(total)}")
                     lines.append(
@@ -581,11 +643,12 @@ class StatRegistry:
                                            buckets=hv["buckets"])
                     tgt = h if not key else h.labels(**dict(key))
                     tgt._merge_buckets(hv["buckets"], hv["counts"],
-                                       hv["count"], hv["sum"])
+                                       hv["count"], hv["sum"],
+                                       exemplars=hv.get("exemplars"))
                     if extra:
                         h.labels(**dict(key, **extra))._merge_buckets(
                             hv["buckets"], hv["counts"], hv["count"],
-                            hv["sum"])
+                            hv["sum"], exemplars=hv.get("exemplars"))
             else:   # gauge / untyped: per-source value only
                 g = self.gauge(name, help_)
                 for key, v in series:
@@ -719,10 +782,12 @@ def STAT_RESET(name):
 # that mode the v2 submodules — equally stdlib-only — are simply absent.
 try:
     from . import trace, flight, serve, perf, fleet, hlo, train  # noqa: E402,F401
+    from . import reqlog, slo                     # noqa: E402,F401
     from .flight import watchdog                  # noqa: E402,F401
     from .serve import start_server, stop_server  # noqa: E402,F401
 
     __all__ += ["trace", "flight", "serve", "perf", "fleet", "hlo",
-                "train", "watchdog", "start_server", "stop_server"]
+                "train", "reqlog", "slo", "watchdog", "start_server",
+                "stop_server"]
 except ImportError:   # standalone module load — core registry only
     pass

@@ -260,6 +260,11 @@ def discover(store_addr: str = None, timeout_ms: int = 5000,
 _SAMPLE_RE = re.compile(
     r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s+(\S+)\s*$')
 _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+# OpenMetrics exemplar suffix on a bucket line (ISSUE 16):
+# `` # {trace_id="..."} <value> <ts>``.  Stripped BEFORE _SAMPLE_RE runs
+# — its greedy label group would otherwise swallow the exemplar braces
+# and silently drop every exemplar-carrying bucket sample.
+_EXEMPLAR_RE = re.compile(r"\s#\s+\{(.*?)\}\s+(\S+)(?:\s+(\S+))?\s*$")
 
 
 _ESC_RE = re.compile(r"\\(.)")
@@ -289,8 +294,12 @@ def parse_prometheus(text: str) -> "dict[str, dict]":
     "sum"}`` with per-bucket (non-cumulative) counts, reconstructed by
     differencing the ``le``-labeled cumulative samples; ``repr``-ed
     bucket bounds round-trip floats exactly, so merged replicas re-bin
-    identically.  Unknown/foreign lines are skipped, not fatal — the
-    fleet must keep scraping a replica that grew a new metric kind."""
+    identically.  OpenMetrics exemplar suffixes on bucket lines are
+    parsed into an ``"exemplars"`` list (aligned with ``counts``, the
+    last slot the +Inf/overflow bucket) so a replica's trace links
+    survive fleet federation.  Unknown/foreign lines are skipped, not
+    fatal — the fleet must keep scraping a replica that grew a new
+    metric kind."""
     kinds, helps = {}, {}
     # histogram assembly: name -> {series_key: {"le": {bound: cum},
     #                                           "sum": x, "count": n}}
@@ -314,6 +323,18 @@ def parse_prometheus(text: str) -> "dict[str, dict]":
             elif len(parts) >= 3 and parts[1] == "HELP":
                 helps[parts[2]] = parts[3] if len(parts) > 3 else ""
             continue
+        exemplar = None
+        em = _EXEMPLAR_RE.search(line)
+        if em is not None:
+            tid = dict(_LABEL_RE.findall(em.group(1))).get("trace_id")
+            try:
+                ev = float(em.group(2))
+                ets = float(em.group(3)) if em.group(3) else 0.0
+            except ValueError:
+                tid = None
+            if tid:
+                exemplar = (_unescape(tid), ev, ets)
+            line = line[:em.start()]
         m = _SAMPLE_RE.match(line)
         if not m:
             continue
@@ -332,10 +353,15 @@ def parse_prometheus(text: str) -> "dict[str, dict]":
             rec = hist_raw.setdefault(base, {}).setdefault(
                 key, {"le": {}, "sum": 0.0, "count": 0})
             if name.endswith("_bucket"):
+                bound = None
                 if le == "+Inf":
-                    rec["le"][float("inf")] = int(float(value_s))
+                    bound = float("inf")
                 elif le is not None:
-                    rec["le"][float(le)] = int(float(value_s))
+                    bound = float(le)
+                if bound is not None:
+                    rec["le"][bound] = int(float(value_s))
+                    if exemplar is not None:
+                        rec.setdefault("exm", {})[bound] = exemplar
             elif name.endswith("_sum"):
                 rec["sum"] = float(value_s)
             else:
@@ -359,10 +385,16 @@ def parse_prometheus(text: str) -> "dict[str, dict]":
                 counts.append(cum - prev)
                 prev = cum
             counts.append(rec["count"] - prev)   # overflow bucket
-            pm["series"][key] = {
+            series = {
                 "buckets": tuple(bounds), "counts": counts,
                 "count": rec["count"], "sum": rec["sum"],
             }
+            exm_map = rec.get("exm")
+            if exm_map:
+                exm = [exm_map.get(b) for b in bounds]
+                exm.append(exm_map.get(float("inf")))
+                series["exemplars"] = exm
+            pm["series"][key] = series
     return out
 
 
@@ -373,6 +405,18 @@ def series_value(parsed: dict, name: str, default=None, **labels):
         return default
     key = tuple(sorted((k, str(v)) for k, v in labels.items()))
     return pm["series"].get(key, default)
+
+
+def _series_extreme(parsed: dict, name: str, pick):
+    """min/max across EVERY series of one parsed metric (None when the
+    replica doesn't export it) — how the feed rolls a replica's worst
+    slo/burn_rate{objective,window} into one routing signal."""
+    pm = parsed.get(name)
+    if not pm:
+        return None
+    vals = [v for v in pm["series"].values()
+            if isinstance(v, (int, float))]
+    return pick(vals) if vals else None
 
 
 # ---------------------------------------------------------------------------
@@ -913,6 +957,14 @@ class FleetAggregator:
                         r.parsed, "serving_spec_accept_rate"),
                     "prefix_hit_tokens": series_value(
                         r.parsed, "serving_prefix_hit_tokens"),
+                    # ISSUE 16: worst SLO burn across every (objective,
+                    # window) series + smallest remaining budget — the
+                    # admission-shedding inputs (accrete-only; None with
+                    # PTPU_SLO unset or for replicas predating them)
+                    "slo_max_burn_rate": _series_extreme(
+                        r.parsed, "slo_burn_rate", max),
+                    "slo_min_budget_remaining": _series_extreme(
+                        r.parsed, "slo_budget_remaining", min),
                 }
         return out
 

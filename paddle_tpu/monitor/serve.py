@@ -14,6 +14,11 @@ the process's metrics and traces while it runs:
   ``PTPU_FLIGHT_DIR`` (404 when none) — how the fleet aggregator
   harvests a stalled replica's post-mortem while the main thread hangs
   (this endpoint runs on the daemon http thread);
+- ``GET /requests/recent[?n=K]`` — the wide-event request-log ring
+  (``monitor/reqlog.py``, ISSUE 16), newest first — one structured
+  event per finished serving request;
+- ``GET /slo``           — the SLO burn-rate report (``monitor/slo.py``):
+  per-objective fast/slow-window burn rates and remaining error budget;
 - ``GET /profile?secs=N`` — on-demand device profiling (ISSUE 12): runs
   a ``jax.profiler`` trace capture for N seconds (default 1, clamped to
   120) and returns the dump directory as a zip (perfetto/tensorboard-
@@ -283,10 +288,30 @@ class _Handler(BaseHTTPRequestHandler):
                     {"error": f"unknown trace {tid!r}"}), "application/json")
             else:
                 self._send(200, json.dumps(spans), "application/json")
+        elif path == "/requests/recent":
+            from . import reqlog
+
+            n = None
+            for part in query.split("&"):
+                if part.startswith("n="):
+                    try:
+                        n = int(part[2:])
+                    except ValueError:
+                        pass
+            self._send(200, json.dumps({
+                "enabled": reqlog.enabled(),
+                "schema_version": reqlog.REQLOG_SCHEMA_VERSION,
+                "events": reqlog.recent(n),
+            }), "application/json")
+        elif path == "/slo":
+            from . import slo
+
+            self._send(200, json.dumps(slo.report()), "application/json")
         elif path == "/":
             extra = " ".join(sorted(routes)) + " " if routes else ""
             self._send(200, "paddle_tpu monitor: /metrics /healthz "
                             "/traces/<id> /flight/latest "
+                            "/requests/recent /slo "
                             f"/profile?secs=N {extra}\n",
                        "text/plain; charset=utf-8")
         else:

@@ -37,6 +37,16 @@ Design constraints (shared with the metrics layer):
   ring (`monitor.flight`) and (b) a per-trace store capped at
   ``PTPU_TRACE_MAX_TRACES`` traces (oldest evicted), which backs
   ``LLMEngine.request_trace(rid)`` and the ``/traces/<id>`` endpoint.
+- **tail-based sampling** (ISSUE 16, opt-in via ``PTPU_TRACE_TAIL=<n>``):
+  the keep decision is deferred to ROOT-span end, when the whole trace
+  is known.  Interesting traces — any span errored, the root finished
+  abnormally (``finish`` attr other than ``"stop"``: abort/deadline/
+  released), or a producer stamped ``keep=True`` (the engine does for
+  SLO-violating requests) — are ALWAYS kept; boring fast-path traces
+  are kept only while the per-60s-window budget of ``n`` lasts, then
+  dropped from the store.  The flight ring still sees every span
+  (crash forensics wants the recent past, sampled or not).  Unset =
+  today's keep-everything behaviour; ``0`` = keep only interesting.
 
 Timestamps use ``time.perf_counter_ns`` — the same clock as the
 profiler's ``RecordEvent`` spans — so ``export_chrome_trace()`` puts
@@ -57,6 +67,7 @@ __all__ = [
     "inject", "extract", "get_trace",
     "trace_ids", "chrome_events", "export_chrome_trace", "enabled",
     "enable", "refresh", "reset", "heartbeat", "last_activity_age",
+    "tail_budget", "set_tail_budget",
 ]
 
 
@@ -79,9 +90,10 @@ def enable(on: bool = True):
 
 
 def refresh():
-    """Re-read PTPU_TRACE from the environment."""
-    global _enabled
+    """Re-read PTPU_TRACE (+ PTPU_TRACE_TAIL) from the environment."""
+    global _enabled, _tail_budget
     _enabled = _env_enabled()
+    _tail_budget = _env_tail()
 
 
 # -- identity ---------------------------------------------------------------
@@ -196,8 +208,69 @@ _traces: "OrderedDict[str, list]" = OrderedDict()
 _store_lock = threading.Lock()
 
 
+# -- tail-based sampling (ISSUE 16) -----------------------------------------
+
+def _env_tail() -> "int | None":
+    raw = os.environ.get("PTPU_TRACE_TAIL", "").strip()
+    if not raw or raw.lower() in ("off", "false"):
+        return None
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return None
+
+
+_tail_budget = _env_tail()      # None = sampling off (keep everything)
+_TAIL_WINDOW_S = 60.0
+# [window start (monotonic), boring traces kept this window]; mutated
+# only under _store_lock
+_tail_state = [0.0, 0]
+
+
+def tail_budget() -> "int | None":
+    """The boring-traces-kept-per-minute budget (None = sampling off)."""
+    return _tail_budget
+
+
+def set_tail_budget(budget: "int | None") -> None:
+    """Set/clear the tail-sampling budget at runtime (overrides
+    PTPU_TRACE_TAIL; None disables sampling, 0 keeps only interesting
+    traces)."""
+    global _tail_budget
+    _tail_budget = None if budget is None else max(0, int(budget))
+
+
+def _interesting(spans, root) -> bool:
+    """Always-keep predicate, evaluated with the FULL trace in hand."""
+    attrs = root["attrs"]
+    if attrs.get("error") or attrs.get("keep"):
+        return True
+    fin = attrs.get("finish")
+    if fin is not None and fin != "stop":
+        return True
+    for d in spans:
+        if d["attrs"].get("error"):
+            return True
+    return False
+
+
+def _tail_keep(spans, root) -> bool:
+    """Keep decision for one finished root (call under _store_lock)."""
+    if _interesting(spans, root):
+        return True
+    now = time.monotonic()
+    if now - _tail_state[0] >= _TAIL_WINDOW_S:
+        _tail_state[0] = now
+        _tail_state[1] = 0
+    if _tail_state[1] < _tail_budget:
+        _tail_state[1] += 1
+        return True
+    return False
+
+
 def _record(s: Span) -> None:
     d = s.to_dict()
+    dropped = False
     with _store_lock:
         spans = _traces.get(s.trace_id)
         if spans is None:
@@ -205,9 +278,24 @@ def _record(s: Span) -> None:
             while len(_traces) > _MAX_TRACES:
                 _traces.popitem(last=False)
         spans.append(d)
+        # root ended → the trace is complete; with sampling on, decide
+        # NOW whether the whole tree stays in the store
+        if _tail_budget is not None and s.parent_id is None:
+            if not _tail_keep(spans, d):
+                _traces.pop(s.trace_id, None)
+                dropped = True
     from . import flight
 
     flight.record_span(d)
+    if _tail_budget is not None and s.parent_id is None:
+        from . import counter
+
+        if dropped:
+            counter("trace/tail_dropped",
+                    "boring traces dropped by tail sampling").inc()
+        else:
+            counter("trace/tail_kept",
+                    "traces kept by tail sampling").inc()
 
 
 def get_trace(trace_id: str) -> list:

@@ -25,6 +25,9 @@ Rules of the road (enforced by convention + lint, in matching order):
   replica predating a key reads ``None``, never ``KeyError``.  The
   canonical builder carries a ``# ptpu-wire: router-feed`` anchor and
   must emit EXACTLY these keys.
+- **reqlog event**: the wide per-request event ``monitor/reqlog.py``
+  emits (ISSUE 16).  Same accrete-only contract as the feed; the
+  canonical builder carries a ``# ptpu-wire: reqlog-event`` anchor.
 
 stdlib-only, import-light: both ``monitor`` (serve/fleet) and
 ``distributed.rpc`` import this module at startup.
@@ -32,7 +35,8 @@ stdlib-only, import-light: both ``monitor`` (serve/fleet) and
 from __future__ import annotations
 
 __all__ = ["RPC_FRAME_MIN", "RPC_FRAME_MAX", "HEALTHZ_SCHEMA_VERSION",
-           "FLEET_HEALTHZ_SCHEMA_VERSION", "ROUTER_FEED_KEYS"]
+           "FLEET_HEALTHZ_SCHEMA_VERSION", "ROUTER_FEED_KEYS",
+           "REQLOG_SCHEMA_VERSION", "REQLOG_EVENT_KEYS"]
 
 # rpc wire frame: (fn, args, kwargs[, trace_hdr]) — the legacy 3-tuple
 # is still accepted by every server (PR-9's mid-deploy contract)
@@ -80,4 +84,41 @@ ROUTER_FEED_KEYS = (
     # traffic" inputs.  None for replicas predating them.
     "spec_accept_rate",
     "prefix_hit_tokens",
+    # ISSUE 16 SLO burn signals: the replica's worst burn rate across
+    # every (objective, window) series and its smallest remaining error
+    # budget — the exact inputs ROADMAP item 5's admission shedding
+    # reads.  None for replicas predating them (or with PTPU_SLO unset).
+    "slo_max_burn_rate",
+    "slo_min_budget_remaining",
+)
+
+# -- wide-event request log (ISSUE 16) --------------------------------------
+# One structured event per finished request (monitor/reqlog.py), served
+# at GET /requests/recent and optionally sunk to rotating JSONL
+# (PTPU_REQLOG).  Keys only ever accrete and schema_version only ever
+# increases — consumers (the cache-aware router's stickiness debugging,
+# log pipelines) key on both.  The canonical builder carries a
+# ``# ptpu-wire: reqlog-event`` anchor and must emit EXACTLY these keys.
+REQLOG_SCHEMA_VERSION = 1
+
+REQLOG_EVENT_KEYS = (
+    "schema_version",
+    "rid",
+    "trace_id",
+    "replica_id",
+    "ts",
+    "arrival_ts",
+    "prompt_tokens",
+    "generated_tokens",
+    "queue_wait_s",
+    "ttft_s",
+    "tpot_avg_s",
+    "tpot_max_s",
+    "prefill_chunks",
+    "prefix_hit_tokens",
+    "spec_proposed",
+    "spec_accepted",
+    "preemptions",
+    "peak_kv_blocks",
+    "finish_reason",
 )

@@ -80,6 +80,16 @@ Per-request latency decomposes into `serving/ttft` (arrival → first
 token) and `serving/tpot` (inter-token) histograms, recorded whenever
 the monitor is on (tracing not required); `serving/compiles{kind}`
 counts step-program cache misses.
+
+Request plane (ISSUE 16): `serving/queue_wait` (arrival → first
+compute, monitor-gated — visible with tracing off) and
+`serving/finish_reason{reason}` (stop/abort/deadline/released — the SLO
+error_rate numerator) land alongside ttft/tpot; at finish the engine
+emits ONE wide `monitor.reqlog` event per request (release time), ticks
+`monitor.slo`'s burn-rate engine each step, stamps the request's
+trace_id as a histogram exemplar on its ttft/tpot/queue_wait
+observations, and marks SLO-violating traces `keep=True` for
+tail-based sampling.  All default-off.
 """
 from __future__ import annotations
 
@@ -95,6 +105,8 @@ import jax.numpy as jnp
 from .. import monitor
 from ..monitor import trace as mtrace
 from ..monitor import perf as mperf
+from ..monitor import reqlog as mreqlog
+from ..monitor import slo as mslo
 from ..resilience import faults
 from ..resilience.retry import Deadline
 from ..ops.paged_attention import (paged_attention_arrays,
@@ -278,6 +290,16 @@ class LLMEngine:
         self._m_tpot = m.histogram("serving/tpot",
                                    "inter-token latency after the first, "
                                    "seconds")
+        # ISSUE 16 request plane: queue wait as a histogram (the PR-5
+        # queue_wait SPAN needs tracing on; this is visible with just
+        # the monitor), and the completion mix the slo error_rate reads
+        self._m_queue_wait = m.histogram(
+            "serving/queue_wait",
+            "arrival to first prefill compute, seconds")
+        self._m_finish = m.counter(
+            "serving/finish_reason",
+            "finished requests by outcome "
+            "(stop|abort|deadline|released)")
         self._m_compiles = m.counter("serving/compiles",
                                      "step-program cache misses")
         self._m_attn_impl = m.counter(
@@ -393,6 +415,7 @@ class LLMEngine:
         """Stamp arrival (TTFT's zero point) and, with tracing on, open
         the request's root span + its queue-wait child."""
         req.arrival_t = time.perf_counter()
+        req.arrival_ts = time.time()   # wall clock for the reqlog event
         if mtrace.enabled():
             root = mtrace.start_span(
                 "serving/request", rid=req.req_id,
@@ -405,15 +428,65 @@ class LLMEngine:
             while len(self._trace_ids) > mtrace._MAX_TRACES:
                 self._trace_ids.popitem(last=False)
 
-    def _end_trace(self, req, finish: str) -> None:
+    def _end_trace(self, req, finish: str, keep: bool = False) -> None:
         """Close the request's open spans (idempotent — step() ends
-        finished requests, release_request() ends aborted ones)."""
+        finished requests, release_request() ends aborted ones).
+        ``keep=True`` marks the root for tail sampling's always-keep
+        path (an SLO-violating but otherwise normal finish)."""
         if req.queue_span is not None:
             req.queue_span.end(finish=finish)
             req.queue_span = None
         if req.trace is not None:
-            req.trace.end(finish=finish, tokens=len(req.output_ids))
+            if keep:
+                req.trace.end(finish=finish,
+                              tokens=len(req.output_ids), keep=True)
+            else:
+                req.trace.end(finish=finish,
+                              tokens=len(req.output_ids))
             req.trace = None
+
+    def _finish_request(self, req, reason: str) -> None:
+        """The ONE request-finish choke point (idempotent): stamp the
+        reason, close spans (marking SLO violators kept for tail
+        sampling), count the outcome, and emit the wide reqlog event.
+        reasons: "stop" = natural finish, "deadline" = deadline expiry,
+        "abort" = released mid-flight, "released" = released while
+        still queued (never computed)."""
+        if req.finish_reason is not None:
+            return
+        req.finish_reason = reason
+        gen = len(req.output_ids)
+        ttft = None
+        tpot_avg = None
+        if req.first_token_t is not None and req.arrival_t is not None:
+            ttft = req.first_token_t - req.arrival_t
+        if gen >= 2 and req.first_token_t is not None \
+                and req.last_token_t is not None:
+            tpot_avg = (req.last_token_t - req.first_token_t) / (gen - 1)
+        keep = mslo.enabled() and mslo.violates(
+            ttft_s=ttft, tpot_avg_s=tpot_avg,
+            queue_wait_s=req.queue_wait_s)
+        self._end_trace(req, reason, keep=keep)
+        if monitor.enabled():
+            self._m_finish.labels(reason=reason).inc()
+        if mreqlog.enabled():
+            mreqlog.emit(mreqlog.event(
+                req.req_id,
+                trace_id=self._trace_ids.get(req.req_id),
+                arrival_ts=req.arrival_ts,
+                prompt_tokens=req.prompt_len,
+                generated_tokens=gen,
+                queue_wait_s=req.queue_wait_s,
+                ttft_s=ttft,
+                tpot_avg_s=tpot_avg,
+                tpot_max_s=req.tpot_max,
+                prefill_chunks=req.prefill_chunks,
+                prefix_hit_tokens=req.prefix_hit_tokens,
+                spec_proposed=req.spec_proposed,
+                spec_accepted=req.spec_accepted,
+                preemptions=req.num_preemptions,
+                peak_kv_blocks=req.peak_kv_blocks,
+                finish_reason=reason))
 
     def request_trace(self, req_id) -> list:
         """The request's finished spans (start-ordered dicts with
@@ -438,19 +511,24 @@ class LLMEngine:
         req = self._requests[req_id]
         return np.asarray(req.prompt_ids + req.output_ids, np.int32)
 
-    def release_request(self, req_id) -> None:
+    def release_request(self, req_id, reason: "str | None" = None) -> None:
         """Drop a request's host state (and abort it if unfinished).
         Callers of the add_request/step API must release requests after
         reading their output — a server that never releases retains every
         prompt/output token list forever.  `generate()` releases its own
-        requests."""
+        requests.  ``reason`` overrides the finish attribution (the
+        deadline sweep passes "deadline"); unfinished releases default
+        to "released" while still queued, "abort" mid-flight."""
         req = self._requests.pop(req_id, None)
         if req is None:
             return
         if req.finished:
-            self._end_trace(req, "stop")
+            self._finish_request(req, "stop")
             return
-        self._end_trace(req, "abort")
+        if reason is None:
+            reason = "released" if req.state == Request.WAITING \
+                else "abort"
+        self._finish_request(req, reason)
         sched = self.scheduler
         if req in sched.running:
             sched.running.remove(req)
@@ -505,7 +583,7 @@ class LLMEngine:
                    if r.deadline is not None and not r.finished
                    and r.deadline.expired]
         for rid in expired:
-            self.release_request(rid)
+            self.release_request(rid, reason="deadline")
             self._m_expired.inc()
         return expired
 
@@ -521,6 +599,8 @@ class LLMEngine:
         out = self.scheduler.schedule()
         if out.preempted:
             self._m_preempt.inc(len(out.preempted))
+            for r in out.preempted:
+                r.num_preemptions += 1
         if out.kind == "prefill":
             self._step_prefill(out)
             phase, toks = "prefill", out.chunk_len
@@ -531,10 +611,18 @@ class LLMEngine:
             phase = "decode"
         else:
             phase, toks = "idle", 0
+        if mreqlog.enabled():
+            # peak-KV high-water per request: only worth the O(running)
+            # walk when someone is collecting the wide events
+            for r in self.scheduler.running:
+                blocks = len(self.cache._tables.get(r.req_id, ()))
+                if blocks > r.peak_kv_blocks:
+                    r.peak_kv_blocks = blocks
         done = self.scheduler.retire_finished()
         for req in done:
             self._m_done.inc()
-            self._end_trace(req, "stop")
+            self._finish_request(req, "stop")
+        mslo.maybe_tick()   # one module-global read with PTPU_SLO unset
         dt = time.perf_counter() - t0
         mtrace.heartbeat()   # step completed — feed the watchdog even
         #                      with tracing off (no span ends to beat)
@@ -570,6 +658,15 @@ class LLMEngine:
     def _step_prefill(self, out):
         req = out.prefill_request
         start, chunk = out.chunk_start, out.chunk_len
+        req.prefill_chunks += 1
+        if req.queue_wait_s is None and req.arrival_t is not None:
+            # first compute: queue wait over — recorded as a histogram
+            # so it is visible with tracing off (ISSUE 16 satellite)
+            req.queue_wait_s = time.perf_counter() - req.arrival_t
+            self._m_queue_wait.observe(
+                req.queue_wait_s,
+                trace_id=req.trace.trace_id
+                if req.trace is not None else None)
         if req.queue_span is not None:   # first compute: queue wait over
             req.queue_span.end()
             req.queue_span = None
@@ -890,6 +987,8 @@ class LLMEngine:
                     break          # eos inside the accepted run
             emitted += row_emitted
             accepted += row_emitted - 1
+            req.spec_proposed += m
+            req.spec_accepted += row_emitted - 1
         self._spec_proposed_total += proposed
         self._spec_accepted_total += accepted
         if monitor.enabled():
@@ -905,13 +1004,19 @@ class LLMEngine:
     def _record_latency(self, req, now) -> None:
         """Per-token TTFT/TPOT attribution (the serving-paper
         decomposition); tokens accepted in one spec step share a
-        timestamp — their inter-token latency really is ~0."""
+        timestamp — their inter-token latency really is ~0.  Each
+        observation carries the request's trace_id so PTPU_EXEMPLARS can
+        link a bucket to its kept tail-sampled trace."""
+        tid = req.trace.trace_id if req.trace is not None else None
         if req.first_token_t is None:
             req.first_token_t = now
             if req.arrival_t is not None:
-                self._m_ttft.observe(now - req.arrival_t)
+                self._m_ttft.observe(now - req.arrival_t, trace_id=tid)
         else:
-            self._m_tpot.observe(now - req.last_token_t)
+            gap = now - req.last_token_t
+            self._m_tpot.observe(gap, trace_id=tid)
+            if req.tpot_max is None or gap > req.tpot_max:
+                req.tpot_max = gap
         req.last_token_t = now
 
     def _sample_rows(self, rows, logits):

@@ -72,6 +72,17 @@ class Request:
         self.arrival_t = None              # perf_counter at add_request
         self.first_token_t = None          # perf_counter of token 1 (TTFT)
         self.last_token_t = None           # perf_counter of latest token
+        # -- request-plane wide event (engine-owned; ISSUE 16) -------------
+        self.arrival_ts = None             # wall clock at add_request
+        self.queue_wait_s = None           # arrival to first compute
+        self.tpot_max = None               # worst inter-token gap, seconds
+        self.prefill_chunks = 0            # prefill passes this prompt took
+        self.num_preemptions = 0           # times evicted mid-flight
+        self.peak_kv_blocks = 0            # high-water KV blocks held
+        self.spec_proposed = 0             # draft tokens proposed (this req)
+        self.spec_accepted = 0             # draft tokens accepted (this req)
+        self.finish_reason = None          # stop|abort|deadline|released,
+        #                                    set exactly once at finish
 
     # -- derived ------------------------------------------------------------
 

@@ -95,6 +95,11 @@ def main():
                     help="assert the ISSUE-15 speculative-decoding "
                          "surface (accept_rate>0, >1 token per decode "
                          "step on a repetitive workload, flat compiles)")
+    ap.add_argument("--slo", action="store_true",
+                    help="assert the ISSUE-16 request-plane surface "
+                         "(deadline reqlog event, kept tail-sampled "
+                         "trace, ttft exemplar, live + fleet-merged "
+                         "slo/burn_rate)")
     args = ap.parse_args()
 
     monitor.refresh()
@@ -102,6 +107,21 @@ def main():
         monitor.trace.enable(True)
     if args.perf:
         monitor.perf.enable(True)
+    if args.slo:
+        # the full request plane, flipped on the way PTPU_TRACE /
+        # PTPU_REQLOG / PTPU_EXEMPLARS / PTPU_TRACE_TAIL / PTPU_SLO
+        # would: tracing + ring-only reqlog + exemplar stamping + keep-
+        # only-interesting tail sampling + two objectives (the tiny ttft
+        # threshold makes every real request a budget burner, so the
+        # burn gauges must go live)
+        from paddle_tpu.monitor import slo as mslo
+
+        monitor.trace.enable(True)
+        monitor.enable_exemplars(True)
+        monitor.reqlog.enable(True)
+        monitor.trace.set_tail_budget(0)
+        mslo.install(mslo.SloEngine("ttft_p95<0.0001;error_rate<0.05",
+                                    min_interval=0.0))
     paddle.seed(0)
     cfg = gpt_test_config(stacked_blocks=True, sequence_parallel=False)
     model = GPTForCausalLM(cfg)
@@ -138,7 +158,7 @@ def main():
     # (the ISSUE-12 kernels_per_step FLAT assertion needs 5 live rows)
     engine = LLMEngine(model, EngineConfig(
         block_size=16, max_num_seqs=8, kv_cache_dtype=args.kv_cache_dtype,
-        metrics_port=0 if args.trace else None))
+        metrics_port=0 if (args.trace or args.slo) else None))
     if args.kv_cache_dtype:
         fp = LLMEngine(model, EngineConfig(block_size=16, max_num_seqs=8))
         ratio = engine.cache.num_blocks / fp.cache.num_blocks
@@ -177,8 +197,12 @@ def main():
         print("lowbit metrics:", ", ".join(low))
     if args.perf:
         check_perf(engine, snap, cfg)
+    if args.slo:   # before check_trace: that leg stops the endpoint
+        check_slo(engine, cfg)
     if args.trace:
         check_trace(engine, snap, len(prompts))
+    elif args.slo:
+        monitor.stop_server()
     if args.prefix_cache or args.spec:
         check_prefix_spec(model, cfg, prefix=args.prefix_cache,
                           spec=args.spec)
@@ -400,6 +424,99 @@ def check_prefix_spec(model, cfg, prefix, spec):
         dc, dr = count(compiles) - c0, count(recompiles) - r0
         assert dc == 0 and dr == 0, (dc, dr)
         print("compiles FLAT across spec round (0 new)")
+
+
+def check_slo(engine, cfg):
+    """ISSUE 16 acceptance: one request's journey is traceable end to
+    end — a deadline-expired request yields a reqlog event with
+    finish_reason="deadline", a kept tail-sampled trace reachable from a
+    serving/ttft exemplar on /metrics, and a nonzero slo/burn_rate on
+    both the replica and the fleet-merged view."""
+    import json
+    import re
+    import urllib.request
+    from paddle_tpu.monitor import fleet, reqlog
+
+    # a deadline-expired request under load: run it to its first token
+    # (so it owns a TTFT observation + exemplar), let the deadline
+    # lapse, and step once — the expiry sweep releases it
+    rng = np.random.RandomState(7)
+    prompt = rng.randint(0, cfg.vocab_size, (6,)).astype(np.int32)
+    rid = engine.add_request(prompt, SamplingParams(
+        max_new_tokens=32, deadline_s=0.25))
+    while engine._requests[rid].first_token_t is None:
+        engine.step()
+    time.sleep(0.3)
+    engine.step()
+    assert rid not in engine._requests, "deadline request not expired"
+
+    # (a) the wide event, from the ring
+    evs = [e for e in reqlog.recent() if e["rid"] == rid]
+    assert evs, "no reqlog event for the deadline request"
+    ev = evs[0]
+    assert ev["finish_reason"] == "deadline", ev
+    assert ev["schema_version"] == reqlog.REQLOG_SCHEMA_VERSION, ev
+    assert ev["ttft_s"] and ev["ttft_s"] > 0, ev
+    assert ev["generated_tokens"] > 0 and ev["prompt_tokens"] == 6, ev
+    tid = ev["trace_id"]
+    assert tid, ev
+
+    # (b) its trace survived tail sampling (budget 0 = only interesting
+    # kept; a deadline finish is always interesting)
+    spans = monitor.trace.get_trace(tid)
+    assert spans, "deadline trace was not kept by tail sampling"
+    root = [s for s in spans if s["parent_id"] is None][0]
+    assert root["attrs"].get("finish") == "deadline", root
+    print(f"reqlog: rid={rid} finish=deadline ttft={ev['ttft_s']*1e3:.1f}ms "
+          f"trace {tid} kept ({len(spans)} spans)")
+
+    # (c) the live endpoint: a serving/ttft exemplar pointing at a kept
+    # trace, a populated /requests/recent, and a live burn-rate gauge
+    srv = engine.metrics_server
+    txt = urllib.request.urlopen(srv.url + "/metrics",
+                                 timeout=10).read().decode()
+    exm = re.findall(
+        r'serving_ttft_bucket\{[^}]*\} \d+ # \{trace_id="([^"]+)"\}', txt)
+    assert exm, "no exemplar on serving_ttft buckets"
+    ex_spans = json.loads(urllib.request.urlopen(
+        srv.url + "/traces/" + exm[-1], timeout=10).read())
+    assert ex_spans, "ttft exemplar points at an unknown trace"
+    burns = {}
+    for line in txt.splitlines():
+        if line.startswith("slo_burn_rate{"):
+            burns[line.rsplit(" ", 1)[0]] = float(line.rsplit(" ", 1)[1])
+    assert burns and max(burns.values()) > 0, burns
+    doc = json.loads(urllib.request.urlopen(
+        srv.url + "/requests/recent?n=50", timeout=10).read())
+    assert doc["enabled"] and doc["events"], doc
+    assert any(e["rid"] == rid and e["finish_reason"] == "deadline"
+               for e in doc["events"]), doc["events"]
+    rep = json.loads(urllib.request.urlopen(srv.url + "/slo",
+                                            timeout=10).read())
+    assert rep["enabled"] and rep["objectives"], rep
+    worst = max(o["burn_rate"]["fast"] for o in rep["objectives"])
+    assert worst > 0, rep
+    print(f"endpoint: ttft exemplar -> kept trace, /requests/recent "
+          f"n={len(doc['events'])}, /slo worst fast burn {worst:.1f}x")
+
+    # (d) the fleet-merged view: one poll of this replica must carry the
+    # burn gauges through parse/merge and roll them into the router feed
+    agg = fleet.FleetAggregator(endpoints=[srv.url])
+    agg.poll_once()
+    fleet_txt = agg.registry.export_prometheus()
+    fburn = [ln for ln in fleet_txt.splitlines()
+             if ln.startswith("slo_burn_rate{")
+             and float(ln.rsplit(" ", 1)[1]) > 0]
+    assert fburn, "no nonzero slo_burn_rate on the fleet-merged view"
+    feed = agg.snapshot()
+    rec = next(iter(feed.values()))
+    assert rec["slo_max_burn_rate"] and rec["slo_max_burn_rate"] > 0, rec
+    assert rec["slo_min_budget_remaining"] is not None, rec
+    assert "serving_ttft_bucket" in fleet_txt and "# {trace_id=" in \
+        fleet_txt, "exemplars must survive fleet federation"
+    print(f"fleet: slo_max_burn_rate={rec['slo_max_burn_rate']:.1f} "
+          f"budget_remaining={rec['slo_min_budget_remaining']:.2f} "
+          f"(feed), exemplars federated")
 
 
 def check_trace(engine, snap, n_requests):

@@ -1,0 +1,221 @@
+"""monitor v7 request plane, part 1 (ISSUE 16): the wide-event request
+log and tail-based trace sampling — subprocess-free fast tier.
+
+The bar: the event builder's keys are PINNED to the accrete-only wire
+registry (drifting the schema fails here before any consumer breaks);
+the ring is bounded and newest-first; the JSONL sink rotates at the
+configured size keeping exactly one predecessor and never raises into
+the release path; and the tail sampler keeps every interesting trace
+(error / abnormal finish / explicit keep / child error) while boring
+traces consume a per-minute budget.  The live end-to-end journey
+(deadline request -> reqlog event -> kept trace -> exemplar -> burn
+rate) is the serve_smoke --slo leg riding test_serving.py's subprocess.
+"""
+import json
+
+import pytest
+
+from paddle_tpu import monitor
+from paddle_tpu.monitor import reqlog, trace, wire
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    for k in ("PTPU_REQLOG", "PTPU_REQLOG_RING", "PTPU_REQLOG_ROTATE_MB",
+              "PTPU_TRACE_TAIL", "PTPU_REPLICA_ID"):
+        monkeypatch.delenv(k, raising=False)
+    monitor.reset()
+    monitor.enable(True)
+    trace.enable(True)
+    trace.reset()
+    trace._tail_state[:] = [0.0, 0]
+    reqlog.reset()
+    reqlog.refresh()
+    yield
+    reqlog.reset()
+    reqlog.refresh()
+    trace.set_tail_budget(None)
+    trace._tail_state[:] = [0.0, 0]
+    trace.enable(False)
+    trace.reset()
+    monitor.reset()
+    monitor.refresh()
+
+
+# ---------------------------------------------------------------------------
+# schema pin
+# ---------------------------------------------------------------------------
+
+def test_event_keys_pin_wire_registry():
+    """The canonical builder's key ORDER is the wire schema: any drift
+    (add/remove/reorder) must show up as an edit to wire.py, where the
+    accrete-only review rule lives."""
+    ev = reqlog.event("r0")
+    assert tuple(ev.keys()) == wire.REQLOG_EVENT_KEYS
+    assert ev["schema_version"] == wire.REQLOG_SCHEMA_VERSION
+    assert ev["finish_reason"] == "stop"
+    # unmeasured latencies stay None, never phantom zeros
+    assert ev["ttft_s"] is None and ev["queue_wait_s"] is None
+
+
+def test_event_carries_identity_and_replica(monkeypatch):
+    monkeypatch.setenv("PTPU_REPLICA_ID", "replica-3")
+    ev = reqlog.event(7, trace_id="t-abc", ttft_s=0.05,
+                      generated_tokens=12, finish_reason="deadline")
+    assert ev["rid"] == 7 and ev["trace_id"] == "t-abc"
+    assert ev["replica_id"] == "replica-3"
+    assert ev["generated_tokens"] == 12
+    assert ev["finish_reason"] == "deadline"
+    assert ev["ts"] > 0 and ev["ttft_s"] == 0.05
+
+
+# ---------------------------------------------------------------------------
+# ring
+# ---------------------------------------------------------------------------
+
+def test_disabled_is_a_noop():
+    reqlog.enable(False)
+    reqlog.emit(reqlog.event("r0"))
+    assert reqlog.recent() == []
+    assert not reqlog.enabled()
+
+
+def test_ring_bounded_and_newest_first(monkeypatch):
+    monkeypatch.setenv("PTPU_REQLOG", "1")
+    monkeypatch.setenv("PTPU_REQLOG_RING", "8")
+    reqlog.refresh()
+    assert reqlog.enabled() and reqlog.sink_path() is None
+    for i in range(20):
+        reqlog.emit(reqlog.event(i))
+    evs = reqlog.recent()
+    assert len(evs) == 8                          # bounded
+    assert [e["rid"] for e in evs] == list(range(19, 11, -1))
+    assert [e["rid"] for e in reqlog.recent(3)] == [19, 18, 17]
+    assert reqlog.recent(0) == []
+
+
+def test_enable_overrides_env():
+    assert not reqlog.enabled()       # PTPU_REQLOG scrubbed by fixture
+    reqlog.enable(True)
+    reqlog.emit(reqlog.event("r1"))
+    assert [e["rid"] for e in reqlog.recent()] == ["r1"]
+
+
+# ---------------------------------------------------------------------------
+# JSONL sink
+# ---------------------------------------------------------------------------
+
+def test_sink_writes_jsonl_and_rotates(tmp_path, monkeypatch):
+    """Rotation at the (floored-to-4096-byte) size bound keeps exactly
+    one `.1` predecessor — bounded disk, yesterday's tail greppable."""
+    sink = tmp_path / "logs" / "req.jsonl"
+    monkeypatch.setenv("PTPU_REQLOG_ROTATE_MB", "0.000001")   # -> 4096 B
+    reqlog.enable(True, sink=str(sink))
+    n = 0
+    while not (tmp_path / "logs" / "req.jsonl.1").exists():
+        reqlog.emit(reqlog.event(n))
+        n += 1
+        assert n < 500, "sink never rotated"
+    rotated = tmp_path / "logs" / "req.jsonl.1"
+    assert rotated.stat().st_size >= 4096
+    # every rotated line is one parseable event of the pinned schema
+    lines = rotated.read_text().splitlines()
+    assert len(lines) > 1
+    for ln in lines:
+        ev = json.loads(ln)
+        assert tuple(ev.keys()) == wire.REQLOG_EVENT_KEYS
+    # the ring kept everything regardless of rotation
+    assert len(reqlog.recent()) == min(n, 256)
+    # writes continue into a fresh live file after rotation
+    reqlog.emit(reqlog.event("after"))
+    assert any(json.loads(ln)["rid"] == "after"
+               for ln in sink.read_text().splitlines())
+
+
+def test_sink_failure_counted_never_raised(tmp_path):
+    """Losing a log line must not abort the request being released:
+    an unwritable sink increments reqlog/sink_errors and moves on."""
+    blocker = tmp_path / "not_a_dir"
+    blocker.write_text("file, not directory")
+    reqlog.enable(True, sink=str(blocker / "sub" / "req.jsonl"))
+    reqlog.emit(reqlog.event("r0"))               # must not raise
+    assert [e["rid"] for e in reqlog.recent()] == ["r0"]
+    snap = monitor.snapshot()
+    assert snap.get("reqlog/sink_errors", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# tail-based trace sampling
+# ---------------------------------------------------------------------------
+
+def _finish_trace(finish=None, root_error=None, keep=None,
+                  child_error=None):
+    """One root + one child span, ended with the given annotations;
+    returns the trace id."""
+    root = trace.start_span("serving/request")
+    child = trace.start_span("serving/prefill", parent=root)
+    child.end(**({"error": child_error} if child_error else {}))
+    attrs = {}
+    if finish is not None:
+        attrs["finish"] = finish
+    if root_error is not None:
+        attrs["error"] = root_error
+    if keep is not None:
+        attrs["keep"] = keep
+    root.end(**attrs)
+    return root.trace_id
+
+
+def test_tail_keep_matrix():
+    """Budget 0 = only interesting traces survive.  The keep predicate:
+    root error, explicit keep (how the engine marks SLO violators),
+    abnormal finish, or any child-span error."""
+    trace.set_tail_budget(0)
+    kept = {
+        "error": _finish_trace(finish="stop", root_error="Timeout"),
+        "keep": _finish_trace(finish="stop", keep=True),
+        "deadline": _finish_trace(finish="deadline"),
+        "abort": _finish_trace(finish="abort"),
+        "child": _finish_trace(finish="stop", child_error="OOM"),
+    }
+    dropped = _finish_trace(finish="stop")
+    for why, tid in kept.items():
+        spans = trace.get_trace(tid)
+        assert len(spans) == 2, f"{why} trace should have been kept"
+    assert trace.get_trace(dropped) == []
+    snap = monitor.snapshot()
+    assert snap["trace/tail_kept"] == 5
+    assert snap["trace/tail_dropped"] == 1
+
+
+def test_tail_budget_admits_n_boring_traces_per_window():
+    trace.set_tail_budget(2)
+    tids = [_finish_trace(finish="stop") for _ in range(4)]
+    fates = [bool(trace.get_trace(t)) for t in tids]
+    assert fates == [True, True, False, False]
+    # interesting traces don't consume the budget
+    assert trace.get_trace(_finish_trace(finish="deadline"))
+    assert monitor.snapshot()["trace/tail_dropped"] == 2
+
+
+def test_tail_off_keeps_everything():
+    trace.set_tail_budget(None)
+    tid = _finish_trace(finish="stop")
+    assert trace.get_trace(tid)
+    # no sampling counters when sampling is off
+    assert "trace/tail_kept" not in monitor.snapshot()
+
+
+def test_tail_env_parsing(monkeypatch):
+    monkeypatch.setenv("PTPU_TRACE_TAIL", "5")
+    trace.refresh()
+    assert trace.tail_budget() == 5
+    monkeypatch.setenv("PTPU_TRACE_TAIL", "off")
+    trace.refresh()
+    assert trace.tail_budget() is None
+    monkeypatch.setenv("PTPU_TRACE_TAIL", "not-a-number")
+    trace.refresh()
+    assert trace.tail_budget() is None
+    monkeypatch.setenv("PTPU_TRACE_TAIL", "-3")
+    trace.refresh()
+    assert trace.tail_budget() == 0

@@ -425,8 +425,10 @@ class TestMonitorAndSmoke:
         # endpoint), and --prefix-cache --spec: the ISSUE-15 one
         # (hit_tokens == (N-1)*prefix_len, accept_rate > 0 with >1
         # token per decode step, compiles FLAT across hit/miss and
-        # spec rounds) all assert in-script ON TOP of the plain smoke
-        # checks, so ONE subprocess covers every leg (tests/test_trace
+        # spec rounds), and --slo: the ISSUE-16 one (deadline request
+        # traceable reqlog -> kept trace -> exemplar -> burn rate on
+        # replica and fleet) all assert in-script ON TOP of the plain
+        # smoke checks, so ONE subprocess covers every leg (tests/test_trace
         # .py and tests/test_perf.py lean on this invocation; tier-1
         # budget leaves no room for a second engine-compiling
         # subprocess)
@@ -438,7 +440,8 @@ class TestMonitorAndSmoke:
         env["JAX_PLATFORMS"] = "cpu"
         env["PTPU_MONITOR"] = "1"
         proc = subprocess.run([sys.executable, str(script), "--trace",
-                               "--perf", "--prefix-cache", "--spec"],
+                               "--perf", "--prefix-cache", "--spec",
+                               "--slo"],
                               env=env, capture_output=True, text=True,
                               timeout=560)
         assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
@@ -453,6 +456,11 @@ class TestMonitorAndSmoke:
         assert "compiles FLAT across hit/miss round" in proc.stdout
         assert "accept_rate=" in proc.stdout
         assert "compiles FLAT across spec round" in proc.stdout
+        # ISSUE 16 --slo leg: deadline request -> reqlog event + kept
+        # trace, live + fleet-merged burn rate, federated exemplars
+        assert "finish=deadline" in proc.stdout
+        assert "worst fast burn" in proc.stdout
+        assert "exemplars federated" in proc.stdout
 
 
 class TestPagedAttentionOp:

@@ -1,0 +1,298 @@
+"""monitor v7 request plane, part 2 (ISSUE 16): the SLO burn-rate
+engine and histogram exemplars — subprocess-free fast tier.
+
+The bar: objective parsing rejects every malformed PTPU_SLO form with a
+pointed error (and the lazy builder downgrades a bad spec to a one-shot
+warning, never a dead serving process); bad/total accounting matches
+hand-counted bucket and finish-reason state; multi-window burn-rate
+math is exact under injected time (fast window recovers while the slow
+window still remembers); and an exemplar stamped at observe() survives
+the full federation loop: render -> parse_prometheus -> merge_snapshot
+-> re-render, newest-by-timestamp winning per bucket.
+"""
+import pytest
+
+from paddle_tpu import monitor
+from paddle_tpu.monitor import fleet, slo
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    for k in ("PTPU_SLO", "PTPU_SLO_WINDOWS", "PTPU_EXEMPLARS"):
+        monkeypatch.delenv(k, raising=False)
+    monitor.reset()
+    monitor.enable(True)
+    slo.install(None)
+    slo.refresh()
+    yield
+    slo.install(None)
+    slo.refresh()
+    monitor.enable_exemplars(False)
+    monitor.reset()
+    monitor.refresh()
+
+
+# ---------------------------------------------------------------------------
+# objective parsing
+# ---------------------------------------------------------------------------
+
+def test_parse_latency_objective():
+    o = slo.Objective("ttft_p95<0.5")
+    assert o.kind == "latency"
+    assert o.hist_name == "serving/ttft"
+    assert o.threshold == 0.5
+    assert o.budget == pytest.approx(0.05)
+    o99 = slo.Objective("tpot_p99<0.05")
+    assert o99.hist_name == "serving/tpot"
+    assert o99.budget == pytest.approx(0.01)
+    oq = slo.Objective("queue_wait_p90<1.0")
+    assert oq.hist_name == "serving/queue_wait"
+
+
+def test_parse_error_rate_objective():
+    o = slo.Objective("error_rate<0.01")
+    assert o.kind == "error_rate"
+    assert o.budget == 0.01
+    assert o.threshold is None
+
+
+def test_parse_spec_list_and_rejects():
+    objs = slo.parse_spec("ttft_p95<0.5; error_rate<0.01;")
+    assert [o.spec for o in objs] == ["ttft_p95<0.5", "error_rate<0.01"]
+    for bad in ("ttft_p95", "bogus_p95<0.5", "ttft_p95<fast",
+                "ttft_p0<0.5", "ttft_p100<0.5", "ttft_p95<0",
+                "error_rate<1.5", "error_rate<0"):
+        with pytest.raises(ValueError):
+            slo.Objective(bad)
+
+
+def test_bad_env_spec_warns_once_and_disables(monkeypatch):
+    monkeypatch.setenv("PTPU_SLO", "nonsense_p95<0.5")
+    slo.refresh()
+    assert slo.enabled()              # spec present -> tentatively on
+    with pytest.warns(UserWarning, match="PTPU_SLO ignored"):
+        assert slo.get_engine() is None
+    assert not slo.enabled()          # ...until the parse fails
+    assert slo.report() == {"enabled": False, "objectives": []}
+
+
+# ---------------------------------------------------------------------------
+# bad/total accounting
+# ---------------------------------------------------------------------------
+
+def _ttft_registry():
+    reg = monitor.StatRegistry()
+    h = reg.histogram("serving/ttft", "s", buckets=(0.1, 0.5, 1.0))
+    return reg, h
+
+
+def test_latency_totals_from_buckets():
+    reg, h = _ttft_registry()
+    for v in (0.05, 0.3, 0.5, 0.7, 2.0):
+        h.observe(v)
+    o = slo.Objective("ttft_p95<0.5")
+    # observations in the bucket containing the threshold count as good
+    # (0.05, 0.3, 0.5 land at/below the 0.5 bound; 0.7 and 2.0 exceed)
+    assert o.totals(reg) == (2.0, 5.0)
+    # missing histogram -> no traffic, not a crash
+    assert o.totals(monitor.StatRegistry()) == (0.0, 0.0)
+
+
+def test_error_rate_totals_from_finish_reasons():
+    reg = monitor.StatRegistry()
+    c = reg.counter("serving/finish_reason", "per-reason")
+    c.labels(reason="stop").inc(8)
+    c.labels(reason="deadline").inc(1)
+    c.labels(reason="abort").inc(1)
+    o = slo.Objective("error_rate<0.2")
+    assert o.totals(reg) == (2.0, 10.0)
+    assert o.totals(monitor.StatRegistry()) == (0.0, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# window math (injected time throughout)
+# ---------------------------------------------------------------------------
+
+def test_burn_rate_multi_window():
+    """The SRE shape: a burst of bad requests sends BOTH windows up;
+    once the burst ages past the fast window, fast burn recovers to 0
+    while the slow window still remembers."""
+    reg, h = _ttft_registry()
+    eng = slo.SloEngine("ttft_p95<0.5", registry=reg,
+                        windows=(60.0, 600.0), min_interval=0.0)
+    eng.evaluate(now=0.0)                       # baseline, no traffic
+    for _ in range(19):
+        h.observe(0.05)                         # 19 good
+    h.observe(0.7)                              # 1 bad
+    rep = eng.evaluate(now=20.0)
+    (obj,) = rep["objectives"]
+    # 1/20 bad over both windows, against a 5% budget -> burning at 1.0
+    assert obj["burn_rate"]["fast"] == pytest.approx(1.0)
+    assert obj["burn_rate"]["slow"] == pytest.approx(1.0)
+    assert obj["bad"] == 1.0 and obj["total"] == 20.0
+    # budget_remaining is lifetime: 1 - (1/20)/0.05 = 0
+    assert obj["budget_remaining"] == pytest.approx(0.0)
+    # 100 s later, no new traffic: the burst left the fast window (its
+    # base sample is now the t=20 snapshot) but not the slow one
+    rep2 = eng.evaluate(now=120.0)
+    (obj2,) = rep2["objectives"]
+    assert obj2["burn_rate"]["fast"] == 0.0
+    assert obj2["burn_rate"]["slow"] == pytest.approx(1.0)
+    # the gauges carry the same numbers through the exporter
+    parsed = fleet.parse_prometheus(reg.export_prometheus())
+    assert fleet.series_value(parsed, "slo_burn_rate",
+                              objective="ttft_p95<0.5",
+                              window="slow") == pytest.approx(1.0)
+    assert fleet.series_value(parsed, "slo_burn_rate",
+                              objective="ttft_p95<0.5",
+                              window="fast") == 0.0
+    assert fleet.series_value(
+        parsed, "slo_budget_remaining",
+        objective="ttft_p95<0.5") == pytest.approx(0.0)
+
+
+def test_budget_remaining_partial():
+    reg, h = _ttft_registry()
+    eng = slo.SloEngine("ttft_p95<0.5", registry=reg, windows=(60, 600),
+                        min_interval=0.0)
+    for _ in range(39):
+        h.observe(0.05)
+    h.observe(0.7)                              # 1/40 bad = half budget
+    (obj,) = eng.evaluate(now=0.0)["objectives"]
+    assert obj["budget_remaining"] == pytest.approx(0.5)
+
+
+def test_sample_ring_prunes_but_keeps_slow_baseline():
+    reg, h = _ttft_registry()
+    eng = slo.SloEngine("ttft_p95<0.5", registry=reg,
+                        windows=(60.0, 600.0), min_interval=0.0)
+    for t in range(0, 2000, 50):
+        h.observe(0.05)
+        eng.evaluate(now=float(t))
+    # bounded: ~slow_window/min_tick_spacing samples, not all 40
+    assert len(eng._samples) <= 600 / 50 + 2
+    # the oldest retained sample still spans the full slow window
+    assert eng._samples[0][0] <= 1950.0 - 600.0
+
+
+def test_tick_rate_limited():
+    reg, _ = _ttft_registry()
+    eng = slo.SloEngine("ttft_p95<0.5", registry=reg,
+                        windows=(60, 600), min_interval=1.0)
+    assert eng.tick(now=0.0) is not None
+    assert eng.tick(now=0.5) is None
+    assert eng.tick(now=1.5) is not None
+
+
+def test_violates_static_thresholds():
+    eng = slo.SloEngine("ttft_p95<0.5;tpot_p99<0.05;error_rate<0.01",
+                        registry=monitor.StatRegistry(),
+                        windows=(60, 600))
+    assert eng.violates(ttft_s=0.6)
+    assert not eng.violates(ttft_s=0.5)         # at threshold = within
+    assert eng.violates(tpot_avg_s=0.06)
+    assert not eng.violates(queue_wait_s=99.0)  # no queue_wait objective
+    assert not eng.violates()                   # nothing measured
+    # module level: disabled -> False regardless
+    assert not slo.violates(ttft_s=99.0)
+    slo.install(eng)
+    assert slo.violates(ttft_s=0.6)
+
+
+def test_module_report_and_maybe_tick():
+    assert slo.report() == {"enabled": False, "objectives": []}
+    slo.maybe_tick()                            # disabled: pure no-op
+    reg, h = _ttft_registry()
+    h.observe(0.05)
+    eng = slo.SloEngine("ttft_p95<0.5", registry=reg, windows=(60, 600),
+                        min_interval=0.0)
+    slo.install(eng)
+    slo.maybe_tick(now=0.0)
+    rep = slo.report()
+    assert rep["enabled"] and rep["windows"] == {"fast": 60.0,
+                                                 "slow": 600.0}
+    assert rep["objectives"][0]["total"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# histogram exemplars: render -> parse -> merge -> re-render
+# ---------------------------------------------------------------------------
+
+def test_exemplar_rendered_openmetrics_style():
+    monitor.enable_exemplars(True)
+    reg = monitor.StatRegistry()
+    h = reg.histogram("serving/ttft", "s", buckets=(0.1, 0.5))
+    h.observe(0.05, trace_id="t-fast")
+    h.observe(0.7, trace_id="t-slow")           # lands in +Inf overflow
+    h.observe(0.06)                             # no trace: no stamp
+    txt = reg.export_prometheus()
+    lines = [ln for ln in txt.splitlines() if "_bucket" in ln]
+    assert any('le="0.1"' in ln and '# {trace_id="t-fast"} 0.05' in ln
+               for ln in lines)
+    assert any('le="+Inf"' in ln and '# {trace_id="t-slow"} 0.7' in ln
+               for ln in lines)
+    # the un-stamped middle bucket renders without a suffix
+    assert any('le="0.5"' in ln and "#" not in ln for ln in lines)
+
+
+def test_exemplars_off_by_default():
+    reg = monitor.StatRegistry()
+    h = reg.histogram("serving/ttft", "s", buckets=(0.1, 0.5))
+    h.observe(0.05, trace_id="t-x")
+    assert "trace_id" not in reg.export_prometheus()
+
+
+def test_exemplar_fleet_round_trip():
+    """A replica's exemplar must survive federation: the aggregator
+    parses the replica's exposition, merges it, and re-exports with the
+    trace link intact — and a newer replica's stamp wins the bucket."""
+    monitor.enable_exemplars(True)
+    rep1 = monitor.StatRegistry()
+    h1 = rep1.histogram("serving/ttft", "s", buckets=(0.1, 0.5))
+    h1.observe(0.05, trace_id="t-old")
+    rep2 = monitor.StatRegistry()
+    h2 = rep2.histogram("serving/ttft", "s", buckets=(0.1, 0.5))
+    h2.observe(0.07, trace_id="t-new")          # same bucket, later ts
+    h2.observe(0.3, trace_id="t-mid")
+    p1 = fleet.parse_prometheus(rep1.export_prometheus())
+    ex1 = p1["serving_ttft"]["series"][()]["exemplars"]
+    assert ex1[0][0] == "t-old" and ex1[0][1] == 0.05 and ex1[0][2] > 0
+    assert ex1[1] is None and ex1[2] is None
+    p2 = fleet.parse_prometheus(rep2.export_prometheus())
+    merged = monitor.StatRegistry()
+    merged.merge_snapshot(p1, labels={"replica": "r0"})
+    merged.merge_snapshot(p2, labels={"replica": "r1"})
+    out = merged.export_prometheus()
+    # the fleet-total series (no replica label): newest-by-ts won its
+    # bucket; each replica-tagged breakdown series keeps its own stamp
+    totals = [ln for ln in out.splitlines()
+              if ln.startswith("serving_ttft_bucket{le=")]
+    assert any('# {trace_id="t-new"} 0.07' in ln for ln in totals)
+    assert not any("t-old" in ln for ln in totals)
+    assert any('# {trace_id="t-mid"} 0.3' in ln for ln in totals)
+    assert '# {trace_id="t-old"} 0.05' in out   # r0 breakdown keeps it
+    # merged counts stayed exact despite the exemplar suffixes (the
+    # parser must strip them BEFORE sample matching)
+    total = fleet.parse_prometheus(out)
+    hv = total["serving_ttft"]["series"][()]
+    assert hv["count"] == 3 and hv["counts"] == [2, 1, 0]
+
+
+def test_burn_gauge_extremes_for_router_feed():
+    """fleet.snapshot() rolls a replica's WORST burn / LOWEST remaining
+    budget into the router feed via _series_extreme."""
+    reg = monitor.StatRegistry()
+    g = reg.gauge("slo/burn_rate", "x")
+    g.labels(objective="ttft_p95<0.5", window="fast").set(2.5)
+    g.labels(objective="ttft_p95<0.5", window="slow").set(0.5)
+    g.labels(objective="error_rate<0.01", window="fast").set(14.4)
+    r = reg.gauge("slo/budget_remaining", "x")
+    r.labels(objective="ttft_p95<0.5").set(0.8)
+    r.labels(objective="error_rate<0.01").set(0.1)
+    parsed = fleet.parse_prometheus(reg.export_prometheus())
+    assert fleet._series_extreme(parsed, "slo_burn_rate", max) == 14.4
+    assert fleet._series_extreme(
+        parsed, "slo_budget_remaining", min) == 0.1
+    assert fleet._series_extreme(parsed, "slo_burn_rate", min) == 0.5
+    # a replica without SLOs contributes None, not a crash
+    assert fleet._series_extreme({}, "slo_burn_rate", max) is None

@@ -21,11 +21,12 @@ Checks:
   the registry's declared ``*_SCHEMA_VERSION`` values (a Name/Attribute
   reference to a ``*SCHEMA_VERSION`` constant is always fine — that IS
   the registry);
-- ``# ptpu-wire: router-feed``-anchored dict literals: their string
-  keys must equal ``ROUTER_FEED_KEYS`` exactly, both directions — a key
-  added to the feed but not the registry breaks the accrete-only
-  contract silently, a registry key missing from the feed is a phantom
-  the router will read as absent forever;
+- ``# ptpu-wire: router-feed`` / ``# ptpu-wire: reqlog-event``-anchored
+  dict literals: their string keys must equal ``ROUTER_FEED_KEYS`` /
+  ``REQLOG_EVENT_KEYS`` exactly, both directions — a key added to the
+  surface but not the registry breaks the accrete-only contract
+  silently, a registry key missing from the surface is a phantom its
+  consumers will read as absent forever;
 - rpc frame shapes in modules that speak the frame (reference
   ``_send_frame``/``_recv_frame``): tuple literals whose first elements
   are ``(fn, args, ...)`` must have arity within
@@ -44,8 +45,13 @@ from ..core import Rule
 
 REGISTRY_NAMES = {"RPC_FRAME_MIN", "RPC_FRAME_MAX",
                   "HEALTHZ_SCHEMA_VERSION",
-                  "FLEET_HEALTHZ_SCHEMA_VERSION", "ROUTER_FEED_KEYS"}
-ANCHOR = "ptpu-wire: router-feed"
+                  "FLEET_HEALTHZ_SCHEMA_VERSION", "ROUTER_FEED_KEYS",
+                  "REQLOG_SCHEMA_VERSION", "REQLOG_EVENT_KEYS"}
+# anchored dict literals: each anchor comment pins the dict's string
+# keys to one declared key tuple (ISSUE 16 added the reqlog event to
+# the router feed's original contract)
+ANCHORED_KEYS = {"ptpu-wire: router-feed": "ROUTER_FEED_KEYS",
+                 "ptpu-wire: reqlog-event": "REQLOG_EVENT_KEYS"}
 
 
 def _module_literals(ctx):
@@ -116,12 +122,20 @@ class WireCompatRule(Rule):
                            and isinstance(v, int)}
         frame_min = consts.get("RPC_FRAME_MIN")
         frame_max = consts.get("RPC_FRAME_MAX")
-        feed_keys = consts.get("ROUTER_FEED_KEYS")
         if ctx.rel == reg_rel:
             return   # the registry itself is the truth, not a speaker
 
-        anchors = [i for i, ln in enumerate(ctx.lines, start=1)
-                   if ANCHOR in ln]
+        # {keys-const-name: [anchor line numbers]} for every anchored
+        # surface this file speaks
+        anchors: dict = {}
+        for i, ln in enumerate(ctx.lines, start=1):
+            h = ln.find("#")
+            if h < 0:
+                continue   # anchors are COMMENTS: a string literal
+            #              # mentioning one (this table!) is not a pin
+            for text, const in ANCHORED_KEYS.items():
+                if text in ln[h:]:
+                    anchors.setdefault(const, []).append(i)
         speaks_rpc = ("_send_frame" in ctx.src or "_recv_frame" in ctx.src)
 
         for node in ast.walk(ctx.tree):
@@ -143,16 +157,19 @@ class WireCompatRule(Rule):
                                     f"({reg_rel} declares "
                                     f"{sorted(schema_versions)}) — bump "
                                     f"the registry WITH the surface")
-            # -- router-feed anchored dicts ---------------------------
-            if isinstance(node, ast.Dict) and feed_keys is not None \
-                    and anchors:
+            # -- anchored dicts (router feed, reqlog event) -----------
+            if isinstance(node, ast.Dict) and anchors:
                 lo = getattr(node, "lineno", 0)
-                if any(lo - 3 <= a <= lo for a in anchors):
+                for const, lines in sorted(anchors.items()):
+                    keys = consts.get(const)
+                    if keys is None \
+                            or not any(lo - 3 <= a <= lo for a in lines):
+                        continue
                     lits = [k.value for k in node.keys
                             if isinstance(k, ast.Constant)
                             and isinstance(k.value, str)]
-                    extra = sorted(set(lits) - set(feed_keys))
-                    missing = sorted(set(feed_keys) - set(lits))
+                    extra = sorted(set(lits) - set(keys))
+                    missing = sorted(set(keys) - set(lits))
                     if (extra or missing) and not ctx.suppressed(
                             self.id, node.lineno,
                             ctx.node_extent(node)):
@@ -164,10 +181,9 @@ class WireCompatRule(Rule):
                                 f"misses declared {missing}")
                         yield self.finding(
                             ctx, node,
-                            "router-feed keys drifted from "
-                            f"ROUTER_FEED_KEYS ({reg_rel}): "
-                            + "; ".join(detail)
-                            + " — the feed is accrete-only wire, "
+                            f"anchored keys drifted from {const} "
+                            f"({reg_rel}): " + "; ".join(detail)
+                            + " — the surface is accrete-only wire, "
                               "register the change first")
             # -- rpc frame shapes -------------------------------------
             if not speaks_rpc or frame_min is None or frame_max is None:

@@ -37,6 +37,10 @@ case "${1:-fast}" in
       --json-out /tmp/ptpu_check_report.json
     # "not slow" includes tests/test_train_stats.py (ISSUE 13: loss-spike
     # EWMA, goodput math, straggler rollup, forensics — subprocess-free)
+    # and the serve_smoke --slo leg (ISSUE 16: deadline request ->
+    # reqlog event -> kept trace -> exemplar -> fleet-merged burn rate),
+    # which rides the EXISTING test_serving.py smoke subprocess — no
+    # second engine-compiling process in the fast lane
     python -m pytest tests/ -m "not slow" -q --ignore=tests/test_examples.py
     # perf-history gate, CPU-smoke lane: the headline bench appends this
     # host's run to BENCH_HISTORY.jsonl, then gates against the trailing
