@@ -1075,6 +1075,92 @@ def bench_trace_overhead():
                  "% of step", 5.0)
 
 
+def bench_router_fanout():
+    """ISSUE 17: router dispatch/absorb throughput over fake in-process
+    replicas — the pure host-side cost of the multi-replica tier (sticky
+    signature hashing, affinity-LRU lookup, least-loaded scoring, frame
+    build, absorb) with the engine and rpc taken out of the loop.
+
+    Workload: 512 requests in 8 shared-prefix families (48-token prefix
+    + distinct 16-token tails) across 4 echo replicas that complete
+    everything on their next poll, so the wall is submit + two router
+    pump cycles.  Self-asserts in-lane that affinity actually routed
+    (every non-first family member is a sticky hit) — a throughput
+    number from a router that silently fell back to least-loaded would
+    gate the wrong thing.  Emits best-of-reps requests/s; the router is
+    backend-free, so the CPU lane is the real lane, but the metric keeps
+    the smoke suffix off-TPU so shared-host noise gates at the loose
+    fast-lane tolerance."""
+    import random
+
+    from paddle_tpu import monitor
+    from paddle_tpu.serving.router import (Router, RouterConfig,
+                                           poll_frame, result_frame)
+    from paddle_tpu.serving.scheduler import SamplingParams
+
+    BS, FAMILIES, REQS = 16, 8, 512
+
+    class _EchoReplica:
+        """Accepts every frame, completes it all on the next poll."""
+        role = "both"
+
+        def __init__(self, name):
+            self.name = name
+            self._pending = []
+
+        def submit(self, frame):
+            self._pending.append(frame)
+            return True
+
+        submit_handoff = submit
+
+        def poll(self):
+            done = [result_frame(f["rid"], self.name, ok=True,
+                                 token_ids=[0], finish_reason="stop")
+                    for f in self._pending]
+            self._pending = []
+            return poll_frame(self.name, False, done, [], [])
+
+    replicas = [_EchoReplica(f"r{i}") for i in range(4)]
+    snap = {r.name: {"state": "healthy"} for r in replicas}
+    rng = random.Random(0)
+    prefixes = [[rng.randrange(1, 128) for _ in range(48)]
+                for _ in range(FAMILIES)]
+    prompts = [prefixes[i % FAMILIES]
+               + [rng.randrange(1, 128) for _ in range(16)]
+               for i in range(REQS)]
+    params = SamplingParams(max_new_tokens=8)
+    cfg = RouterConfig(sticky=True, disaggregate=False, affinity_cap=4096,
+                       resubmit_limit=1, block_size=BS)
+
+    def run_once():
+        router = Router(replicas, lambda: snap, cfg)
+        t0 = time.perf_counter()
+        rids = [router.submit(p, params) for p in prompts]
+        while router.pending():
+            router.poll()
+        dt = time.perf_counter() - t0
+        for rid in rids:
+            router.release(rid)
+        return REQS / dt
+
+    prev_mon = monitor.enabled()
+    monitor.enable(True)             # the sticky self-assert reads counters
+    try:
+        run_once()                   # warmup (imports, counter creation)
+        hits0 = monitor.counter("router/sticky_hits").value
+        best = max(run_once() for _ in range(5))
+        hits = monitor.counter("router/sticky_hits").value - hits0
+        assert hits >= 5 * (REQS - FAMILIES), (
+            f"sticky routing fell back to least-loaded: {hits} affinity "
+            f"hits over 5 reps, expected >= {5 * (REQS - FAMILIES)}")
+    finally:
+        monitor.enable(prev_mon)
+    suffix = "" if _on_tpu() else "_cpu_smoke"
+    return _emit(f"router_fanout_requests_per_sec{suffix}", best,
+                 "requests/sec", 5000.0)
+
+
 LADDER = {
     "gpt124m": bench_gpt124m,
     "resnet50": bench_resnet50,
@@ -1086,6 +1172,7 @@ LADDER = {
     "prefix_prefill": bench_prefix_prefill,
     "spec_decode": bench_spec_decode,
     "kernel_count": bench_kernel_count,
+    "router_fanout": bench_router_fanout,
     "trace_overhead": bench_trace_overhead,
     "hybrid8_memfit": bench_hybrid8_memfit,
 }

@@ -16,7 +16,11 @@ Two objective forms:
 - ``error_rate<frac`` — at most ``frac`` of finished requests may end
   abnormally.  Numerator/denominator come from the
   ``serving/finish_reason{reason}`` counters; every reason other than
-  ``"stop"`` (abort/deadline/released) counts as an error.
+  ``"stop"`` or ``"migrated"`` (abort/deadline/released) counts as an
+  error — a request handed to another replica (drain requeue, failover
+  resubmission, prefill→decode disaggregation; ISSUE 17) finishes
+  elsewhere, and counting the successful migration as a failure would
+  page on every scale-down.
 
 Evaluation is SRE-style multi-window multi-burn-rate: each objective's
 *bad fraction* over a fast and a slow trailing window
@@ -62,7 +66,9 @@ _HIST_NAMES = {
     "queue_wait": "serving/queue_wait",
 }
 _FINISH_NAME = "serving/finish_reason"
-_GOOD_REASON = "stop"
+# reasons that are NOT errors: a natural finish, and a request migrated
+# to another replica (it finishes — and is judged — over there)
+_GOOD_REASONS = ("stop", "migrated")
 
 
 def _env_spec() -> str:
@@ -157,7 +163,7 @@ class Objective:
             for key, series in c._series():
                 v = series._snapshot_value()
                 total += v
-                if dict(key).get("reason") != _GOOD_REASON:
+                if dict(key).get("reason") not in _GOOD_REASONS:
                     bad += v
             return bad, total
         h = registry.get(self.hist_name)

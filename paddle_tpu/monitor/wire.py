@@ -28,6 +28,13 @@ Rules of the road (enforced by convention + lint, in matching order):
 - **reqlog event**: the wide per-request event ``monitor/reqlog.py``
   emits (ISSUE 16).  Same accrete-only contract as the feed; the
   canonical builder carries a ``# ptpu-wire: reqlog-event`` anchor.
+- **router protocol** (ISSUE 17): the request frames the multi-replica
+  router and its replica workers exchange over ``distributed/rpc.py``
+  (submit / result / KV handoff / poll), plus the router's exported
+  metric-name set.  Same accrete-only contract; the canonical builders
+  in ``serving/router.py`` carry ``# ptpu-wire: router-submit`` /
+  ``router-result`` / ``router-handoff`` / ``router-poll`` /
+  ``router-metrics`` anchors.
 
 stdlib-only, import-light: both ``monitor`` (serve/fleet) and
 ``distributed.rpc`` import this module at startup.
@@ -36,7 +43,10 @@ from __future__ import annotations
 
 __all__ = ["RPC_FRAME_MIN", "RPC_FRAME_MAX", "HEALTHZ_SCHEMA_VERSION",
            "FLEET_HEALTHZ_SCHEMA_VERSION", "ROUTER_FEED_KEYS",
-           "REQLOG_SCHEMA_VERSION", "REQLOG_EVENT_KEYS"]
+           "REQLOG_SCHEMA_VERSION", "REQLOG_EVENT_KEYS",
+           "ROUTER_SCHEMA_VERSION", "ROUTER_SUBMIT_KEYS",
+           "ROUTER_RESULT_KEYS", "ROUTER_HANDOFF_KEYS",
+           "ROUTER_POLL_KEYS", "ROUTER_METRIC_NAMES"]
 
 # rpc wire frame: (fn, args, kwargs[, trace_hdr]) — the legacy 3-tuple
 # is still accepted by every server (PR-9's mid-deploy contract)
@@ -120,5 +130,87 @@ REQLOG_EVENT_KEYS = (
     "spec_accepted",
     "preemptions",
     "peak_kv_blocks",
+    # reason vocabulary (accrete-only, like the keys): stop | abort |
+    # deadline | released | migrated — "migrated" (ISSUE 17) marks a
+    # request handed off to another replica (drain requeue, failover
+    # resubmission, prefill→decode disaggregation), NOT a failure;
+    # monitor/slo.py's error_rate counts it good.
     "finish_reason",
+)
+
+# -- multi-replica router protocol (ISSUE 17) --------------------------------
+# The request frames the serving router and its replica workers exchange
+# over distributed/rpc.py (which provides transport framing + the trace
+# header; these are the PAYLOAD dict schemas).  One version number
+# covers the protocol: it only ever increases, and every frame carries
+# it so a replica can reject a future router instead of mis-parsing it.
+# Keys accrete-only; canonical builders live in serving/router.py under
+# the matching ``# ptpu-wire: router-*`` anchors.
+ROUTER_SCHEMA_VERSION = 1
+
+# router -> replica: admit one request
+ROUTER_SUBMIT_KEYS = (
+    "schema_version",
+    "rid",              # the ROUTER's request id (replica ids are local)
+    "prompt_ids",       # list[int]
+    "params",           # SamplingParams as a plain dict (version-skew
+    #                     safe: unknown fields are dropped, not fatal)
+    "trace",            # monitor.trace inject() header, or None
+)
+
+# replica -> router: one finished (or failed) request
+ROUTER_RESULT_KEYS = (
+    "schema_version",
+    "rid",
+    "replica",          # reporting replica's name
+    "ok",               # bool; False => error is set, token_ids is None
+    "token_ids",        # [prompt + generated] ints, engine row shape
+    "finish_reason",    # stop | abort | deadline | released | migrated
+    "error",            # str | None
+)
+
+# prefill worker -> router -> decode worker: a mid-flight request with
+# its KV shipped block-for-block via the bit-exact swap_out/swap_in path
+ROUTER_HANDOFF_KEYS = (
+    "schema_version",
+    "rid",
+    "prompt_ids",
+    "output_ids",       # tokens emitted so far (>= 1: prefill samples
+    #                     the first token from its final logits)
+    "params",
+    "key",              # the row's evolved PRNG key (uint32[2]) — what
+    #                     keeps seeded sampling token-identical across
+    #                     the migration
+    "kv",               # BlockKVCache.swap_out() host snapshot
+    "trace",
+)
+
+# replica -> router: one poll response (drained by Router.poll() each
+# pump cycle — results, prefill handoffs, and drain-requeued submits
+# ride ONE rpc round trip)
+ROUTER_POLL_KEYS = (
+    "schema_version",
+    "replica",
+    "draining",         # True once PreemptionHandler fired: admission
+    #                     stopped, waiting requests come back requeued
+    "results",          # list of ROUTER_RESULT_KEYS frames
+    "handoffs",         # list of ROUTER_HANDOFF_KEYS frames
+    "requeued",         # list of ROUTER_SUBMIT_KEYS frames
+)
+
+# the router's exported metric names (the fleet scrape surface a
+# dashboard keys on — renaming one orphans its panels, so the set is
+# declared wire like the feed keys)
+ROUTER_METRIC_NAMES = (
+    "router/requests",
+    "router/dispatched",
+    "router/sticky_hits",
+    "router/deadline_rejected",
+    "router/failovers",
+    "router/requeued",
+    "router/handoffs",
+    "router/stale_results",
+    "router/errors",
+    "router/queue_depth",
+    "router/inflight",
 )

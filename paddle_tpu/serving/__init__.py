@@ -29,6 +29,10 @@ Layers (each its own module, each independently testable):
   `GPTForCausalLM.generate` (tests/test_serving.py pins it); with
   `EngineConfig(speculative_tokens=k)` a fixed-shape multi-token verify
   program emits several accepted tokens per decode step.
+- `router.Router`        — the multi-replica tier (ISSUE 17):
+  prefix-cache-aware sticky routing over N engine replicas, optional
+  disaggregated prefill/decode (bit-exact KV handoff), drain/failover;
+  `replica.ReplicaWorker` is the engine-owning worker half.
 
 The user-facing entry point also hangs off `paddle_tpu.inference`
 (`inference.LLMEngine` etc.), next to the Predictor serving surface.
@@ -38,9 +42,12 @@ from .kv_cache import (BlockAllocatorError, BlockKVCache,
 from .scheduler import Request, SamplingParams, Scheduler, SchedulerOutput
 from .spec import propose_ngram
 from .engine import EngineConfig, LLMEngine
+from .router import Router, RouterConfig, RpcReplicaClient
+from .replica import ReplicaWorker
 
 __all__ = [
     "BlockAllocatorError", "BlockKVCache", "EngineConfig", "LLMEngine",
-    "Request", "SamplingParams", "Scheduler", "SchedulerOutput",
+    "ReplicaWorker", "Request", "Router", "RouterConfig",
+    "RpcReplicaClient", "SamplingParams", "Scheduler", "SchedulerOutput",
     "prefix_block_keys", "propose_ngram",
 ]

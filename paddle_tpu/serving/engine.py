@@ -83,8 +83,9 @@ counts step-program cache misses.
 
 Request plane (ISSUE 16): `serving/queue_wait` (arrival → first
 compute, monitor-gated — visible with tracing off) and
-`serving/finish_reason{reason}` (stop/abort/deadline/released — the SLO
-error_rate numerator) land alongside ttft/tpot; at finish the engine
+`serving/finish_reason{reason}` (stop/abort/deadline/released/migrated
+— the SLO error_rate numerator; "migrated" = handed off to another
+replica, counted good) land alongside ttft/tpot; at finish the engine
 emits ONE wide `monitor.reqlog` event per request (release time), ticks
 `monitor.slo`'s burn-rate engine each step, stamps the request's
 trace_id as a histogram exemplar on its ttft/tpot/queue_wait
@@ -299,7 +300,7 @@ class LLMEngine:
         self._m_finish = m.counter(
             "serving/finish_reason",
             "finished requests by outcome "
-            "(stop|abort|deadline|released)")
+            "(stop|abort|deadline|released|migrated)")
         self._m_compiles = m.counter("serving/compiles",
                                      "step-program cache misses")
         self._m_attn_impl = m.counter(
@@ -411,6 +412,80 @@ class LLMEngine:
         self.scheduler.add(req)
         return req.req_id
 
+    def export_request(self, req_id) -> dict:
+        """Detach a RUNNING, fully-prefilled request for migration to
+        another engine (ISSUE 17 disaggregated prefill→decode): returns
+        its prompt, tokens emitted so far, the row's evolved PRNG key,
+        and the bit-exact host KV snapshot (`BlockKVCache.swap_out` —
+        the preemption swap path, so restore is bit-identical and the
+        local blocks are freed).  The local request finishes with
+        reason "migrated".  `adopt_request` on the receiving engine is
+        the inverse; the pair is token-identical to never migrating
+        (greedy and seeded sampling alike — the shipped key IS the
+        row's sampling stream)."""
+        req = self._requests[req_id]
+        if req.finished or not req.prefill_done or not req.output_ids:
+            raise ValueError(
+                "export_request needs an unfinished, fully-prefilled "
+                "request with at least one emitted token (prefill "
+                "samples the first token from its final logits)")
+        if req not in self.scheduler.running:
+            raise ValueError(
+                "export_request needs a RUNNING request (a preempted "
+                "one already carries its snapshot in req.swap)")
+        handoff = {
+            "prompt_ids": list(req.prompt_ids),
+            "output_ids": list(req.output_ids),
+            "params": req.params,
+            "key": np.asarray(req.key, np.uint32),
+            "kv": self.cache.swap_out(req_id),
+        }
+        self.scheduler.running.remove(req)
+        self._finish_request(req, "migrated")
+        req.state = Request.FINISHED
+        del self._requests[req_id]
+        return handoff
+
+    def adopt_request(self, prompt_ids, sampling_params, output_ids,
+                      key, kv) -> int:
+        """Admit a mid-flight request exported by another engine's
+        `export_request`: the KV snapshot rides the scheduler's
+        swap-resume path (restored bit-exactly at admission), decode
+        continues from the shipped PRNG key, and — the disaggregation
+        point — this engine never runs a prefill program for it: the
+        request enters decode-only, so a dedicated decode worker only
+        ever dispatches the one fixed-shape ragged(max_num_seqs, 1)
+        program."""
+        params = sampling_params or SamplingParams()
+        prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
+        out = [int(t) for t in output_ids]
+        if not prompt or not out:
+            raise ValueError("adopt_request needs a prompt and at least "
+                             "one emitted token")
+        if len(out) >= params.max_new_tokens:
+            raise ValueError("request already finished — ship a result, "
+                             "not a handoff")
+        if len(prompt) + params.max_new_tokens > self.max_model_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({params.max_new_tokens}) exceeds max_model_len "
+                f"({self.max_model_len})")
+        req = Request(self._next_id, prompt, params)
+        self._next_id += 1
+        req.output_ids = out
+        req.key = jnp.asarray(np.asarray(key, np.uint32))
+        if params.deadline_s is not None:
+            req.deadline = Deadline(params.deadline_s)
+        # the exporter's cache covered positions [0, total_len-1) — the
+        # last emitted token is fed (and its K/V written) by the next
+        # decode step, exactly as if it had been sampled here
+        req.num_computed = req.total_len - 1
+        req.swap = kv
+        self._begin_trace(req, adopted=True)
+        self._requests[req.req_id] = req
+        self.scheduler.add(req)
+        return req.req_id
+
     def _begin_trace(self, req, **attrs) -> None:
         """Stamp arrival (TTFT's zero point) and, with tracing on, open
         the request's root span + its queue-wait child."""
@@ -451,7 +526,9 @@ class LLMEngine:
         sampling), count the outcome, and emit the wide reqlog event.
         reasons: "stop" = natural finish, "deadline" = deadline expiry,
         "abort" = released mid-flight, "released" = released while
-        still queued (never computed)."""
+        still queued (never computed), "migrated" = handed off to
+        another replica (drain requeue / failover / disaggregated
+        prefill→decode handoff — a success elsewhere, not an error)."""
         if req.finish_reason is not None:
             return
         req.finish_reason = reason

@@ -1,0 +1,665 @@
+"""Multi-replica serving router (ISSUE 17) — the process that turns N
+single-process `LLMEngine` replicas into one serving tier.
+
+The router owns the fleet-facing request queue and fans requests across
+replica workers over `distributed/rpc.py` (the trace header already
+rides that wire).  Three policies, in decision order:
+
+- **prefix-cache-aware sticky routing** — each request's chained
+  `kv_cache.prefix_block_keys` signature is matched against a bounded
+  LRU map of *block key → replica that prefilled it*: the replica
+  already holding the longest run of the request's leading blocks
+  (parked on its prefix-cache LRU) gets the request, so N requests
+  sharing a system prompt pay its prefill ONCE on ONE replica instead
+  of once per replica the load balancer happened to spray them across
+  (fleet-scale preservation of PR 13's hot-TTFT win).
+- **least-loaded fallback** — no sticky match (or sticky replica
+  ineligible): pick by the live `FleetAggregator.snapshot()` router
+  feed, ordered by (router-tracked inflight + reported queue depth +
+  waiting, worst SLO burn rate, -goodput tokens/s).  Replicas whose
+  feed state is `stalled`/`down` are excluded and re-admitted the
+  moment the feed reports them healthy again.
+- **disaggregated prefill/decode** (`RouterConfig.disaggregate` /
+  `PTPU_ROUTER_DISAGG`) — fresh prompts go to prefill-role workers
+  (which absorb the compile-heavy long-prompt programs), and once a
+  request is prefilled + has its first token, the worker exports it
+  (`LLMEngine.export_request`: bit-exact `swap_out` KV snapshot + the
+  row's evolved PRNG key) as a handoff frame the router forwards to a
+  decode-role worker (`adopt_request`).  Decode workers therefore only
+  ever dispatch the one fixed-shape `ragged(max_num_seqs, 1)` program
+  — they never compile a prefill.  Token-identical to single-process
+  serving for greedy AND seeded sampling (the key ships with the KV).
+
+Lifecycle guarantees (drain / scale-down / failover):
+
+- a SIGTERM'd replica (riding `resilience.PreemptionHandler`) stops
+  admission, finishes its running requests, and returns its
+  never-computed waiting requests as requeued submit frames — the
+  router re-queues them at the FRONT in original arrival order;
+- a replica going `stalled`/`down` on the feed (the `/fleet/healthz`
+  state machine) triggers resubmission of its in-flight requests
+  from-prompt — token-identical for greedy/seeded rows — bounded by
+  `resubmit_limit`, beyond which the request errors cleanly.  Streams
+  complete or error; they never hang.
+- a request whose `SamplingParams.deadline_s` expires while still
+  queued AT THE ROUTER is rejected locally (counted, reqlog reason
+  "deadline") instead of being shipped to a replica that would only
+  expire it after paying admission; a shipped request carries its
+  REMAINING budget, so the clock does not restart on the replica.
+
+Every frame this module speaks is declared in `monitor/wire.py`
+(`ROUTER_SUBMIT_KEYS` / `ROUTER_RESULT_KEYS` / `ROUTER_HANDOFF_KEYS` /
+`ROUTER_POLL_KEYS`, one `ROUTER_SCHEMA_VERSION`) and built HERE under
+the matching ``# ptpu-wire: router-*`` anchors — drifting a frame
+without registering it is a `wire-compat` lint failure, not a deploy
+incident.  The router's metric names are pinned the same way
+(`ROUTER_METRIC_NAMES`).
+
+The `Router` itself is transport-agnostic and single-threaded by
+design: `poll()` is the one pump (collect → failover/drain → dispatch),
+driven by whoever owns the process loop.  Replica clients are
+duck-typed (`name`, `role`, `submit(frame)`, `submit_handoff(frame)`,
+`poll()`), so the fast-tier unit tests drive the full policy surface
+with in-memory stubs — `RpcReplicaClient` is the production transport
+(see `serving/replica.py` for the worker half and
+`scripts/router_smoke.py` for the end-to-end proof).
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from collections import OrderedDict, deque
+from typing import Optional
+
+from .. import monitor
+from ..monitor import reqlog as mreqlog
+from ..monitor import trace as mtrace
+from ..monitor.wire import (ROUTER_HANDOFF_KEYS, ROUTER_POLL_KEYS,
+                            ROUTER_RESULT_KEYS, ROUTER_SCHEMA_VERSION,
+                            ROUTER_SUBMIT_KEYS)
+from ..resilience.retry import Deadline
+from .kv_cache import prefix_block_keys
+from .scheduler import SamplingParams
+
+__all__ = ["Router", "RouterConfig", "RpcReplicaClient",
+           "submit_frame", "result_frame", "handoff_frame", "poll_frame",
+           "sticky_signature"]
+
+_PARAM_FIELDS = {f.name for f in dataclasses.fields(SamplingParams)}
+
+
+def params_to_wire(params: SamplingParams) -> dict:
+    """SamplingParams as a plain dict (the wire form: a replica running
+    an older SamplingParams drops unknown fields instead of failing to
+    unpickle a skewed class)."""
+    return dataclasses.asdict(params)
+
+
+def params_from_wire(d: dict) -> SamplingParams:
+    return SamplingParams(**{k: v for k, v in (d or {}).items()
+                             if k in _PARAM_FIELDS})
+
+
+# -- canonical frame builders (keys pinned by ptpu-check wire-compat) -------
+
+def submit_frame(rid, prompt_ids, params: dict, trace=None) -> dict:
+    # ptpu-wire: router-submit
+    return {
+        "schema_version": ROUTER_SCHEMA_VERSION,
+        "rid": int(rid),
+        "prompt_ids": [int(t) for t in prompt_ids],
+        "params": params,
+        "trace": trace,
+    }
+
+
+def result_frame(rid, replica, ok, token_ids=None, finish_reason="stop",
+                 error=None) -> dict:
+    # ptpu-wire: router-result
+    return {
+        "schema_version": ROUTER_SCHEMA_VERSION,
+        "rid": int(rid),
+        "replica": replica,
+        "ok": bool(ok),
+        "token_ids": None if token_ids is None
+        else [int(t) for t in token_ids],
+        "finish_reason": finish_reason,
+        "error": error,
+    }
+
+
+def handoff_frame(rid, prompt_ids, output_ids, params: dict, key, kv,
+                  trace=None) -> dict:
+    # ptpu-wire: router-handoff
+    return {
+        "schema_version": ROUTER_SCHEMA_VERSION,
+        "rid": int(rid),
+        "prompt_ids": [int(t) for t in prompt_ids],
+        "output_ids": [int(t) for t in output_ids],
+        "params": params,
+        "key": key,
+        "kv": kv,
+        "trace": trace,
+    }
+
+
+def poll_frame(replica, draining, results, handoffs, requeued) -> dict:
+    # ptpu-wire: router-poll
+    return {
+        "schema_version": ROUTER_SCHEMA_VERSION,
+        "replica": replica,
+        "draining": bool(draining),
+        "results": list(results),
+        "handoffs": list(handoffs),
+        "requeued": list(requeued),
+    }
+
+
+def _check_frame(frame: dict, keys) -> dict:
+    """Version + shape gate for a received frame: a FUTURE schema is
+    rejected loudly (mis-parsing it would be worse), missing keys read
+    None (accrete-only: an OLD peer's frame simply lacks the new
+    fields)."""
+    v = frame.get("schema_version")
+    if v is not None and v > ROUTER_SCHEMA_VERSION:
+        raise ValueError(
+            f"router frame schema_version {v} is newer than this "
+            f"process speaks ({ROUTER_SCHEMA_VERSION}) — upgrade me "
+            "before the sender")
+    del keys   # shape is advisory: accrete-only keys never hard-fail
+    return frame
+
+
+def sticky_signature(prompt_ids, block_size: int) -> tuple:
+    """The request's routing signature: the chained content keys of its
+    FULL prompt blocks (`kv_cache.prefix_block_keys` — sha1-chained, so
+    stable across processes/PYTHONHASHSEED and collision-safe).  Two
+    prompts share a leading signature run exactly when they share that
+    prompt prefix block-for-block — the same identity the replica-side
+    prefix cache indexes, which is what makes router-side stickiness
+    predict replica-side cache hits."""
+    return tuple(prefix_block_keys(list(prompt_ids), block_size))
+
+
+@dataclasses.dataclass
+class RouterConfig:
+    # prefix-cache-aware sticky routing; None resolves from env
+    # PTPU_ROUTER_STICKY ("0"/"false"/"off" disables), default ON
+    sticky: Optional[bool] = None
+    # disaggregated prefill/decode; None resolves from PTPU_ROUTER_DISAGG,
+    # default OFF (requires prefill-/decode-role replicas)
+    disaggregate: Optional[bool] = None
+    # KV block size the replicas run (sticky signatures must chunk
+    # prompts exactly like the replica prefix caches do)
+    block_size: int = 16
+    # sticky map capacity in block keys; None resolves from
+    # PTPU_ROUTER_AFFINITY_CAP, default 4096 — bounded so a long-lived
+    # router cannot grow an unbounded affinity map
+    affinity_cap: Optional[int] = None
+    # failover resubmissions per request before it errors cleanly; None
+    # resolves from PTPU_ROUTER_RESUBMIT_LIMIT, default 1
+    resubmit_limit: Optional[int] = None
+
+    def resolve(self) -> "RouterConfig":
+        sticky = self.sticky
+        if sticky is None:
+            sticky = os.environ.get("PTPU_ROUTER_STICKY", "1").lower() \
+                not in ("0", "false", "off")
+        disagg = self.disaggregate
+        if disagg is None:
+            disagg = os.environ.get("PTPU_ROUTER_DISAGG", "0").lower() \
+                in ("1", "true", "on")
+        cap = self.affinity_cap
+        if cap is None:
+            cap = int(os.environ.get("PTPU_ROUTER_AFFINITY_CAP", "4096")
+                      or 4096)
+        limit = self.resubmit_limit
+        if limit is None:
+            limit = int(os.environ.get("PTPU_ROUTER_RESUBMIT_LIMIT", "1")
+                        or 1)
+        return RouterConfig(sticky=bool(sticky), disaggregate=bool(disagg),
+                            block_size=int(self.block_size),
+                            affinity_cap=max(1, int(cap)),
+                            resubmit_limit=max(0, int(limit)))
+
+
+class _RouterRequest:
+    """Router-side request state (distinct from the replica Request)."""
+
+    __slots__ = ("rid", "prompt_ids", "params", "sig", "deadline",
+                 "kind", "state", "assigned", "resubmits", "result",
+                 "handoff", "trace_id")
+
+    QUEUED, INFLIGHT, DONE = "queued", "inflight", "done"
+
+    def __init__(self, rid, prompt_ids, params: SamplingParams, sig):
+        self.rid = rid
+        self.prompt_ids = prompt_ids
+        self.params = params
+        self.sig = sig
+        self.deadline = None if params.deadline_s is None \
+            else Deadline(params.deadline_s)
+        self.kind = "prompt"            # "prompt" | "handoff"
+        self.state = _RouterRequest.QUEUED
+        self.assigned = None            # replica name while INFLIGHT
+        self.resubmits = 0              # failover resubmissions so far
+        self.result = None              # ROUTER_RESULT_KEYS frame
+        self.handoff = None             # pending handoff frame (disagg)
+        self.trace_id = None
+
+
+class Router:
+    """submit() / poll() / result() over N replica clients.
+
+    `clients` is an iterable of replica-client objects (duck-typed —
+    see module docstring); `feed` is a zero-arg callable returning the
+    `FleetAggregator.snapshot()` dict (name → router-feed record).
+    Neither is owned: the caller runs the aggregator and the rpc
+    world."""
+
+    def __init__(self, clients, feed, config: Optional[RouterConfig] = None):
+        self.config = (config or RouterConfig()).resolve()
+        self._clients = OrderedDict((c.name, c) for c in clients)
+        self._feed = feed
+        self._reqs: "dict[int, _RouterRequest]" = {}
+        self._queue: deque = deque()          # rids awaiting dispatch
+        self._next_rid = 0
+        # block key -> replica that prefilled it (bounded LRU)
+        self._block_home: OrderedDict = OrderedDict()
+        self._draining: set = set()           # replicas mid-drain
+        self._inflight: "dict[str, int]" = {}  # replica -> inflight count
+        self.last_err = None                  # newest transport error
+        m = monitor
+        # ptpu-wire: router-metrics
+        self._m = {
+            "router/requests": m.counter(
+                "router/requests", "requests accepted by the router"),
+            "router/dispatched": m.counter(
+                "router/dispatched", "requests shipped to a replica"),
+            "router/sticky_hits": m.counter(
+                "router/sticky_hits",
+                "dispatches routed by prefix-cache affinity"),
+            "router/deadline_rejected": m.counter(
+                "router/deadline_rejected",
+                "requests expired in the router queue, never shipped"),
+            "router/failovers": m.counter(
+                "router/failovers",
+                "in-flight requests resubmitted off a stalled/down "
+                "replica"),
+            "router/requeued": m.counter(
+                "router/requeued",
+                "waiting requests returned by a draining replica"),
+            "router/handoffs": m.counter(
+                "router/handoffs",
+                "prefill->decode KV handoffs forwarded"),
+            "router/stale_results": m.counter(
+                "router/stale_results",
+                "results dropped from a replica no longer owning the "
+                "request"),
+            "router/errors": m.counter(
+                "router/errors", "replica transport errors"),
+            "router/queue_depth": m.gauge(
+                "router/queue_depth", "requests queued at the router"),
+            "router/inflight": m.gauge(
+                "router/inflight", "requests in flight on replicas"),
+        }
+
+    # -- request API --------------------------------------------------------
+
+    def submit(self, prompt_ids, sampling_params=None) -> int:
+        """Queue one request; returns the router-side request id.
+        Dispatch happens on the next `poll()`."""
+        params = sampling_params or SamplingParams()
+        prompt = [int(t) for t in prompt_ids]
+        if not prompt:
+            raise ValueError("empty prompt")
+        sig = sticky_signature(prompt, self.config.block_size) \
+            if self.config.sticky else ()
+        rreq = _RouterRequest(self._next_rid, prompt, params, sig)
+        self._next_rid += 1
+        if mtrace.enabled():
+            sp = mtrace.current_span()
+            rreq.trace_id = sp.trace_id if sp is not None else None
+        self._reqs[rreq.rid] = rreq
+        self._queue.append(rreq.rid)
+        self._m["router/requests"].inc()
+        return rreq.rid
+
+    def result(self, rid) -> "dict | None":
+        """The finished result frame, or None while pending."""
+        return self._reqs[rid].result
+
+    def release(self, rid) -> None:
+        """Drop a finished request's router state (callers release after
+        reading the result, like the engine's release_request)."""
+        self._reqs.pop(rid, None)
+
+    def wait(self, rid, timeout: float = 60.0,
+             poll_s: float = 0.005) -> dict:
+        """Pump poll() until `rid` finishes; TimeoutError past
+        `timeout` (a bound, not a hang — failover/drain keep requests
+        moving, so a healthy fleet finishes well inside it)."""
+        deadline = Deadline(timeout)
+        while True:
+            self.poll()
+            res = self._reqs[rid].result
+            if res is not None:
+                return res
+            if deadline.expired:
+                raise TimeoutError(f"router request {rid} not finished "
+                                   f"after {timeout}s")
+            time.sleep(poll_s)
+
+    def pending(self) -> int:
+        return sum(1 for r in self._reqs.values()
+                   if r.state != _RouterRequest.DONE)
+
+    # -- the pump -----------------------------------------------------------
+
+    def poll(self) -> None:
+        """One router cycle: feed-driven failover, replica poll
+        absorption (results / handoffs / drain requeues), queue expiry,
+        dispatch."""
+        snap = self._feed() or {}
+        unavailable = set()
+        for name in self._clients:
+            state = (snap.get(name) or {}).get("state", "unknown")
+            if state in ("stalled", "down"):
+                unavailable.add(name)
+                self._fail_over(name)
+        for name, client in self._clients.items():
+            if name in unavailable:
+                continue   # never rpc a peer the feed says is gone
+            try:
+                doc = _check_frame(client.poll(), ROUTER_POLL_KEYS)
+            except (OSError, ConnectionError, TimeoutError,
+                    RuntimeError) as e:
+                # transport error without a feed transition yet: counted
+                # and surfaced; the request-level decision (failover)
+                # stays with the /fleet/healthz state machine
+                self._m["router/errors"].inc()
+                self.last_err = f"{name}: {e}"
+                continue
+            self._absorb(name, doc)
+        self._expire_queue()
+        self._dispatch(snap, unavailable)
+        self._m["router/queue_depth"].set(len(self._queue))
+        self._m["router/inflight"].set(
+            sum(self._inflight.values()))
+
+    # -- absorption ---------------------------------------------------------
+
+    def _absorb(self, name: str, doc: dict) -> None:
+        if doc.get("draining"):
+            self._draining.add(name)
+        else:
+            self._draining.discard(name)
+        for res in doc.get("results") or ():
+            res = _check_frame(res, ROUTER_RESULT_KEYS)
+            rreq = self._reqs.get(res.get("rid"))
+            if rreq is None or rreq.state != _RouterRequest.INFLIGHT \
+                    or rreq.assigned != name:
+                # late completion from a replica we already failed away
+                # from (or a released request): first owner wins
+                self._m["router/stale_results"].inc()
+                continue
+            self._finish(rreq, res)
+        for hof in doc.get("handoffs") or ():
+            hof = _check_frame(hof, ROUTER_HANDOFF_KEYS)
+            rreq = self._reqs.get(hof.get("rid"))
+            if rreq is None or rreq.state != _RouterRequest.INFLIGHT \
+                    or rreq.assigned != name:
+                self._m["router/stale_results"].inc()
+                continue
+            # prefill half done: requeue as a decode handoff
+            self._unassign(rreq)
+            rreq.kind = "handoff"
+            rreq.handoff = hof
+            rreq.state = _RouterRequest.QUEUED
+            self._queue.appendleft(rreq.rid)
+            self._m["router/handoffs"].inc()
+        requeued = [_check_frame(f, ROUTER_SUBMIT_KEYS)
+                    for f in doc.get("requeued") or ()]
+        if requeued:
+            self._requeue_front(
+                [r for f in requeued
+                 if (r := self._reqs.get(f.get("rid"))) is not None
+                 and r.state == _RouterRequest.INFLIGHT
+                 and r.assigned == name],
+                counter="router/requeued")
+
+    def _finish(self, rreq: _RouterRequest, res: dict) -> None:
+        self._unassign(rreq)
+        rreq.state = _RouterRequest.DONE
+        rreq.result = res
+
+    def _unassign(self, rreq: _RouterRequest) -> None:
+        if rreq.assigned is not None:
+            n = self._inflight.get(rreq.assigned, 0) - 1
+            self._inflight[rreq.assigned] = max(0, n)
+            rreq.assigned = None
+
+    def _requeue_front(self, rreqs, counter: str) -> None:
+        """Put migrated requests back at the FRONT of the queue in
+        original submission order (they are by construction older than
+        anything still queued — dispatch preserved arrival order, so
+        front insertion restores it exactly)."""
+        for rreq in sorted(rreqs, key=lambda r: r.rid, reverse=True):
+            self._unassign(rreq)
+            rreq.kind = "prompt"      # any shipped KV died with the peer
+            rreq.handoff = None
+            rreq.state = _RouterRequest.QUEUED
+            self._queue.appendleft(rreq.rid)
+            self._m[counter].inc()
+
+    # -- failover -----------------------------------------------------------
+
+    def _fail_over(self, name: str) -> None:
+        """The feed rolled `name` up as stalled/down: resubmit its
+        in-flight requests from-prompt (token-identical for greedy and
+        seeded rows — generation is a pure function of prompt + params
+        + seed), bounded by resubmit_limit.  Idempotent: a request
+        migrated once is no longer assigned here, so repeated polls
+        while the replica stays down find nothing to do."""
+        victims = [r for r in self._reqs.values()
+                   if r.state == _RouterRequest.INFLIGHT
+                   and r.assigned == name]
+        if not victims:
+            return
+        retry, dead = [], []
+        for rreq in victims:
+            if rreq.resubmits < self.config.resubmit_limit:
+                rreq.resubmits += 1
+                retry.append(rreq)
+            else:
+                dead.append(rreq)
+        for rreq in retry:
+            # the first attempt's termination is a MIGRATION, not an
+            # abort: logged distinctly so SLO error_rate stays clean
+            self._emit_reqlog(rreq, "migrated")
+        self._requeue_front(retry, counter="router/failovers")
+        for rreq in dead:
+            self._finish(rreq, result_frame(
+                rreq.rid, name, ok=False, finish_reason="abort",
+                error=f"replica {name} lost; resubmit limit "
+                      f"({self.config.resubmit_limit}) reached"))
+            self._emit_reqlog(rreq, "abort")
+        # its parked prefix blocks died with it: forget the affinities
+        # so new traffic re-warms a live replica instead
+        for k in [k for k, v in self._block_home.items() if v == name]:
+            del self._block_home[k]
+
+    # -- dispatch -----------------------------------------------------------
+
+    def _expire_queue(self) -> None:
+        """Router-side deadline enforcement: reject queued requests
+        whose budget expired before they were ever shipped."""
+        expired = [rid for rid in self._queue
+                   if (r := self._reqs[rid]).deadline is not None
+                   and r.deadline.expired]
+        for rid in expired:
+            self._queue.remove(rid)
+            rreq = self._reqs[rid]
+            self._finish(rreq, result_frame(
+                rid, None, ok=False, finish_reason="deadline",
+                error="deadline_s expired in the router queue"))
+            self._m["router/deadline_rejected"].inc()
+            self._emit_reqlog(rreq, "deadline")
+
+    def _eligible(self, snap, unavailable, kind: str) -> list:
+        """Replica names a `kind` ("prompt"|"handoff") dispatch may
+        target right now: feed-healthy (or not yet scraped), not
+        draining, and — under disaggregation — role-matched."""
+        want = ("prefill", "both") if kind == "prompt" \
+            else ("decode", "both")
+        out = []
+        for name, client in self._clients.items():
+            if name in unavailable or name in self._draining:
+                continue
+            if self.config.disaggregate \
+                    and getattr(client, "role", "both") not in want:
+                continue
+            out.append(name)
+        return out
+
+    def _sticky_choice(self, sig, eligible) -> "tuple[str, int] | None":
+        """The replica holding the longest run of the request's leading
+        prefix blocks, or None.  One full block (>= block_size shared
+        tokens) is enough to beat a cold prefill."""
+        if not sig:
+            return None
+        home = self._block_home.get(sig[0])
+        if home is None:
+            return None
+        run = 1
+        for k in sig[1:]:
+            if self._block_home.get(k) != home:
+                break
+            run += 1
+        return (home, run) if home in eligible else None
+
+    def _load_score(self, name: str, snap: dict):
+        rec = snap.get(name) or {}
+        pending = (self._inflight.get(name, 0)
+                   + (rec.get("queue_depth") or 0)
+                   + (rec.get("waiting") or 0))
+        burn = rec.get("slo_max_burn_rate") or 0.0
+        goodput = rec.get("goodput_tokens_per_s") or 0.0
+        return (pending, burn, -goodput, name)
+
+    def _dispatch(self, snap: dict, unavailable: set) -> None:
+        stuck = []
+        while self._queue:
+            rid = self._queue.popleft()
+            if not self._dispatch_one(self._reqs[rid], snap,
+                                      unavailable):
+                stuck.append(rid)
+        # parked requests keep their relative order at the queue front
+        for rid in reversed(stuck):
+            self._queue.appendleft(rid)
+
+    def _dispatch_one(self, rreq: _RouterRequest, snap: dict,
+                      unavailable: set) -> bool:
+        while True:
+            eligible = self._eligible(snap, unavailable, rreq.kind)
+            if not eligible:
+                return False
+            sticky = None
+            if rreq.kind == "prompt":
+                sticky = self._sticky_choice(rreq.sig, eligible)
+            if sticky is not None:
+                name = sticky[0]
+            else:
+                name = min(eligible,
+                           key=lambda n: self._load_score(n, snap))
+            if self._ship(rreq, name):
+                if sticky is not None:
+                    self._m["router/sticky_hits"].inc()
+                for k in rreq.sig:
+                    self._block_home[k] = name
+                    self._block_home.move_to_end(k)
+                while len(self._block_home) > self.config.affinity_cap:
+                    self._block_home.popitem(last=False)
+                return True
+            # replica refused (drain race) or transport failed: exclude
+            # it for the rest of this cycle and try the others
+            unavailable.add(name)
+
+    def _ship(self, rreq: _RouterRequest, name: str) -> bool:
+        client = self._clients[name]
+        params = params_to_wire(rreq.params)
+        if rreq.deadline is not None:
+            # ship the REMAINING budget: the replica arms its own clock
+            # at admission, and restarting it would grant queue time back
+            params["deadline_s"] = max(1e-3, rreq.deadline.remaining())
+        try:
+            with mtrace.span("router/dispatch", rid=rreq.rid,
+                             replica=name, kind=rreq.kind):
+                hdr = mtrace.inject()
+                if rreq.kind == "handoff":
+                    frame = dict(rreq.handoff,
+                                 params=params, trace=hdr)
+                    ok = client.submit_handoff(frame)
+                else:
+                    frame = submit_frame(rreq.rid, rreq.prompt_ids,
+                                         params, trace=hdr)
+                    ok = client.submit(frame)
+        except (OSError, ConnectionError, TimeoutError,
+                RuntimeError) as e:
+            self._m["router/errors"].inc()
+            self.last_err = f"{name}: {e}"
+            return False
+        if not ok:
+            return False
+        rreq.state = _RouterRequest.INFLIGHT
+        rreq.assigned = name
+        self._inflight[name] = self._inflight.get(name, 0) + 1
+        self._m["router/dispatched"].inc()
+        return True
+
+    # -- accounting ---------------------------------------------------------
+
+    def _emit_reqlog(self, rreq: _RouterRequest, reason: str) -> None:
+        if mreqlog.enabled():
+            mreqlog.emit(mreqlog.event(
+                rreq.rid, trace_id=rreq.trace_id,
+                prompt_tokens=len(rreq.prompt_ids),
+                finish_reason=reason))
+
+
+class RpcReplicaClient:
+    """The production replica client: each call is one `rpc_sync` to
+    the worker process registered under `name` (see
+    `serving/replica.py` for the remote half).  rpc already retries the
+    dial and propagates the trace header; anything past the dial is
+    NOT retried here — the router's failover path owns redelivery,
+    keyed on the feed's health state, so a maybe-executed submit is
+    never blindly re-sent."""
+
+    def __init__(self, name: str, role: str = "both",
+                 timeout: float = 60.0):
+        self.name = name
+        self.role = role
+        self.timeout = timeout
+
+    def _call(self, fn, *args):
+        from ..distributed import rpc
+
+        return rpc.rpc_sync(self.name, fn, args=args,
+                            timeout=self.timeout)
+
+    def submit(self, frame) -> bool:
+        from . import replica
+
+        return self._call(replica._remote_submit, frame)
+
+    def submit_handoff(self, frame) -> bool:
+        from . import replica
+
+        return self._call(replica._remote_adopt, frame)
+
+    def poll(self) -> dict:
+        from . import replica
+
+        return self._call(replica._remote_poll)

@@ -52,6 +52,10 @@ case "${1:-fast}" in
     # ragged-vs-bucketed decode A/B (ISSUE 8): its tokens/s lines join
     # the same smoke-lane history gate below
     python bench.py --config ragged_decode
+    # router fan-out (ISSUE 17): host-side dispatch throughput over fake
+    # in-process replicas — backend-free, so the CPU lane IS the lane;
+    # self-asserts sticky routing actually engaged before emitting
+    python bench.py --config router_fanout
     python tools/check_bench_regression.py --history BENCH_HISTORY.jsonl \
       --gate-smoke --tolerance 0.50
     ;;
@@ -60,7 +64,11 @@ case "${1:-fast}" in
     # includes the slow tier: tests/test_fleet.py::test_fleet_smoke_script
     # runs scripts/fleet_smoke.py (ISSUE 11 acceptance — 2 engine
     # replicas + aggregator; the fleet fast-tier unit tests ride the
-    # "not slow" selection above like every other suite)
+    # "not slow" selection above like every other suite) and
+    # tests/test_router.py::test_router_smoke_script runs
+    # scripts/router_smoke.py (ISSUE 17 acceptance — router + 4 replica
+    # processes: sticky prefix routing, disaggregated prefill/decode
+    # handoff, mid-stream SIGKILL failover, all token-identical)
     python -m pytest tests/ -q
     ;;
   lint)
