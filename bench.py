@@ -895,7 +895,12 @@ def bench_trace_overhead():
     tail-sampling keep decision at root-span end, and — in the enabled
     measurement, with reqlog + exemplars + a zero tail budget flipped
     on — the wide-event build+emit charged EVERY step (conservative:
-    real traffic releases at most one request per step)): what the
+    real traffic releases at most one request per step) — and ISSUE 18
+    to the chaos choke points: the rpc transport consults the net-fault
+    plan at dial, send and recv on EVERY call, so all three
+    ``faults.net_fire`` probes ride the per-step sequence; with
+    PTPU_FAULTS unset each is one module-global read returning None):
+    what the
     monitor+trace+perf layers add to a train step, off vs on, asserting
     disabled overhead < 1% and enabled overhead < 5% of the step.  "Enabled" means monitor+trace; PTPU_PERF stays off in both
     measurements — perf mode deliberately syncs every timed call (MFU
@@ -918,6 +923,7 @@ def bench_trace_overhead():
     from paddle_tpu import jit as pjit
     from paddle_tpu import monitor
     from paddle_tpu.models import gpt_test_config
+    from paddle_tpu.resilience import faults as mfaults
 
     mtrace = monitor.trace
     mperf = monitor.perf
@@ -970,6 +976,14 @@ def bench_trace_overhead():
         with mtrace.span("bench/train_step", step=i):
             hdr = mtrace.inject()           # rpc _call header attach
             _ctx = mtrace.extract(hdr)      # rpc _handle header parse
+            # ISSUE 18: the rpc transport's chaos probes — dial, send,
+            # recv each consult the net-fault plan per call; disabled
+            # (no PTPU_FAULTS) each is one global read -> None
+            _f = mfaults.net_fire(site="rpc.dial", peer="bench",
+                                  kinds=("net_drop", "net_delay",
+                                         "net_partition"))
+            _f = mfaults.net_fire(site="rpc.send", peer="bench")
+            _f = mfaults.net_fire(site="rpc.recv", peer="bench")
             perf_on = mperf.enabled()
             if monitor.enabled() or mtrace.enabled() or perf_on:
                 sig = f"nstate=0;{pjit._arg_signature((a_args, {}))}"
@@ -1022,7 +1036,7 @@ def bench_trace_overhead():
                 pass
             with mperf.segment("bench", "optimizer"):
                 pass
-            del t0, _ctx, _stats_on
+            del t0, _ctx, _stats_on, _f
 
     def per_call(n):
         t0 = time.perf_counter()

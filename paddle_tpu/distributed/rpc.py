@@ -22,7 +22,10 @@ import pickle
 import socket
 import struct
 import threading
+import time
 from typing import Any, Dict, Optional
+
+from ..resilience import faults as _faults
 
 __all__ = ["init_rpc", "rpc_sync", "rpc_async", "get_worker_info",
            "get_all_worker_infos", "get_current_worker_info", "shutdown",
@@ -54,16 +57,69 @@ class _State:
 _state = _State()
 
 
-def _send_frame(sock, payload: bytes):
-    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+def _garble(payload: bytes) -> bytes:
+    """Deterministic frame corruption: flip the first byte and truncate
+    to half — guaranteed to fail unpickling, same bytes every run.  The
+    length header is built AFTER garbling so the frame stays
+    self-consistent: the receiver reads exactly these corrupt bytes and
+    fails at decode, not at the transport (the decode-rejection path is
+    what chaos must prove)."""
+    return bytes((payload[0] ^ 0xFF,)) + payload[1:max(1, len(payload) // 2)]
 
 
-def _recv_frame(sock) -> bytes:
+def _chaos(site, peer=None, kinds=_faults.NET_KINDS):
+    """Consult the seeded net-fault plan at a transport choke point.
+    Disabled path (PTPU_FAULTS unset): one global read inside
+    ``net_fire``.  Raises for drop/partition, sleeps for a non-send
+    delay, and returns the fired fault (or None) so the caller can act
+    on send-side delay trickling and garbling."""
+    f = _faults.net_fire(site=site, peer=peer, kinds=kinds)
+    if f is None:
+        return None
+    if f.kind == "net_drop":
+        exc = ConnectionRefusedError if site == "rpc.dial" \
+            else ConnectionResetError
+        raise exc(f"injected net_drop at {site} (peer={peer})")
+    if f.kind == "net_partition":
+        # one-directional blackhole: the caller learns nothing except
+        # its own timeout; secs bounds how long the blackhole blocks
+        # (tests should not pay real partition walls)
+        time.sleep(f.secs)
+        raise socket.timeout(f"injected net_partition at {site} "
+                             f"(peer={peer})")
+    if f.kind == "net_delay" and site != "rpc.send":
+        time.sleep(f.secs)
+    return f
+
+
+def _send_frame(sock, payload: bytes, site="rpc.send", peer=None):
+    f = _chaos(site, peer=peer)
+    if f is not None and f.kind == "net_garble":
+        payload = _garble(payload)
+    hdr = struct.pack("<Q", len(payload))
+    if f is not None and f.kind == "net_delay":
+        # slow byte trickle: the frame arrives intact but takes ~secs,
+        # spread over 8 chunks — exercises every partial-read path
+        chunks = 8
+        step = max(1, (len(payload) + chunks - 1) // chunks)
+        sock.sendall(hdr)
+        for i in range(0, len(payload), step):
+            sock.sendall(payload[i:i + step])
+            time.sleep(f.secs / chunks)
+        return
+    sock.sendall(hdr + payload)
+
+
+def _recv_frame(sock, site="rpc.recv", peer=None) -> bytes:
+    f = _chaos(site, peer=peer)
     hdr = _recv_exact(sock, 8)
     (n,) = struct.unpack("<Q", hdr)
     if n > _MAX_FRAME:
         raise RuntimeError(f"rpc frame too large: {n}")
-    return _recv_exact(sock, n)
+    buf = _recv_exact(sock, n)
+    if f is not None and f.kind == "net_garble":
+        buf = _garble(buf)
+    return buf
 
 
 def _recv_exact(sock, n):
@@ -91,17 +147,29 @@ def _handle(conn):
 
     try:
         with conn:
-            msg = pickle.loads(_recv_frame(conn))
-            # frame arity is declared in monitor/wire.py (checked by
-            # ptpu-check wire-compat): the first RPC_FRAME_MIN fields
-            # are mandatory, everything beyond is optional — that slice
-            # is what keeps a legacy 3-tuple client working mid-deploy
-            fn, args, kwargs = msg[:RPC_FRAME_MIN]
-            # optional 4th element: the caller's inject()-ed span
-            # context — run the callable under a child span so one
-            # trace_id spans both processes in export_chrome_trace()
-            ctx = mtrace.extract(msg[RPC_FRAME_MIN]) \
-                if len(msg) > RPC_FRAME_MIN else None
+            try:
+                msg = pickle.loads(_recv_frame(conn))
+                # frame arity is declared in monitor/wire.py (checked by
+                # ptpu-check wire-compat): the first RPC_FRAME_MIN fields
+                # are mandatory, everything beyond is optional — that
+                # slice keeps a legacy 3-tuple client working mid-deploy
+                fn, args, kwargs = msg[:RPC_FRAME_MIN]
+                # optional 4th element: the caller's inject()-ed span
+                # context — run the callable under a child span so one
+                # trace_id spans both processes in export_chrome_trace()
+                ctx = mtrace.extract(msg[RPC_FRAME_MIN]) \
+                    if len(msg) > RPC_FRAME_MIN else None
+            except (ConnectionError, OSError):
+                raise               # transport death: nobody to reply to
+            except Exception as e:
+                # a garbled/truncated frame must error THIS request with
+                # a structured reply, not kill the handler thread and
+                # leave the caller blocked until its timeout — corrupted
+                # pickles raise anything (UnpicklingError, EOFError,
+                # AttributeError, ...), so the decode guard is broad
+                _send_frame(conn, pickle.dumps(
+                    (False, RuntimeError(f"garbled rpc frame: {e!r}"))))
+                return
             try:
                 if ctx is not None:
                     with mtrace.attach(ctx), mtrace.span(
@@ -193,10 +261,18 @@ def rpc_async(to: str, fn, args=None, kwargs=None, timeout: float = 60.0):
     return fut
 
 
+def _budget(timeout, deadline):
+    """Per-socket-op bound: the Deadline's remaining budget, never the
+    full timeout re-armed after earlier ops consumed part of it.
+    Explicit None check: remaining() == 0.0 is falsy but means "out of
+    budget", not "use the full timeout again"."""
+    remaining = deadline.remaining()
+    return timeout if remaining is None else max(remaining, 1e-3)
+
+
 def _call(to, fn, args, kwargs, timeout):
     _check_init()
     from ..monitor import trace as mtrace
-    from ..resilience import faults as _faults
     from ..resilience.retry import Deadline, retry as _retry
 
     info = get_worker_info(to)
@@ -207,12 +283,10 @@ def _call(to, fn, args, kwargs, timeout):
         # executed on the peer, and blind re-issue would double-run a
         # non-idempotent fn — a dial failure is provably side-effect-free
         _faults.maybe_raise("conn_error", site="rpc.dial")
-        remaining = deadline.remaining()
-        # explicit None check: remaining() == 0.0 is falsy but means "out
-        # of budget", not "use the full timeout again"
+        _chaos("rpc.dial", peer=to,
+               kinds=("net_drop", "net_delay", "net_partition"))
         return socket.create_connection(
-            (info.ip, info.port),
-            timeout=timeout if remaining is None else max(remaining, 1e-3))
+            (info.ip, info.port), timeout=_budget(timeout, deadline))
 
     # retryable=(OSError,) covers the whole dial-failure family —
     # ConnectionError/ConnectionRefusedError/ConnectionResetError/
@@ -233,9 +307,20 @@ def _call(to, fn, args, kwargs, timeout):
         with _retry(dial, retries=3, backoff=0.05, max_backoff=1.0,
                     deadline=deadline, site="rpc.dial",
                     retryable=(OSError,))() as s:
-            s.settimeout(timeout)
-            _send_frame(s, pickle.dumps(frame))
-            ok, payload = pickle.loads(_recv_frame(s))
+            # send/recv are bounded by the REMAINING Deadline budget,
+            # not the full timeout re-armed — the dial (and its
+            # retries) already spent part of it
+            s.settimeout(_budget(timeout, deadline))
+            _send_frame(s, pickle.dumps(frame), peer=to)
+            raw = _recv_frame(s, peer=to)
+            try:
+                ok, payload = pickle.loads(raw)
+            except Exception as e:
+                # a garbled reply errors this one call — callers treat
+                # RuntimeError as a transport-class failure (reroute /
+                # resubmit), and the request is NOT blindly re-sent
+                raise RuntimeError(
+                    f"garbled rpc reply from {to!r}: {e!r}") from e
     if not ok:
         raise payload
     return payload

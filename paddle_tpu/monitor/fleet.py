@@ -965,6 +965,11 @@ class FleetAggregator:
                         r.parsed, "slo_burn_rate", max),
                     "slo_min_budget_remaining": _series_extreme(
                         r.parsed, "slo_budget_remaining", min),
+                    # ISSUE 18: circuit-breaker state is router-local —
+                    # Router.fleet_view() overlays the live values; the
+                    # aggregator can only declare the (accreted) keys
+                    "breaker_state": None,
+                    "breaker_trips": None,
                 }
         return out
 

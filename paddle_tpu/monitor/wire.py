@@ -100,6 +100,12 @@ ROUTER_FEED_KEYS = (
     # reads.  None for replicas predating them (or with PTPU_SLO unset).
     "slo_max_burn_rate",
     "slo_min_budget_remaining",
+    # ISSUE 18 circuit-breaker state: filled by Router.fleet_view()
+    # (the breaker lives in the router process, not the aggregator —
+    # the aggregator-side builder reports None for both), so dashboards
+    # reading the router feed see WHY a replica takes no traffic.
+    "breaker_state",
+    "breaker_trips",
 )
 
 # -- wide-event request log (ISSUE 16) --------------------------------------
@@ -213,4 +219,9 @@ ROUTER_METRIC_NAMES = (
     "router/errors",
     "router/queue_depth",
     "router/inflight",
+    # ISSUE 18 chaos hardening: breaker trips/open-count and the
+    # router-side in-flight deadline finalizer
+    "router/breaker_trips",
+    "router/breaker_open",
+    "router/deadline_inflight",
 )

@@ -1,25 +1,46 @@
 """Deterministic fault injection (`PTPU_FAULTS`) so recovery paths are
 *testable*, not just written.  The reference framework proves its NaN
 trap with FLAGS_check_nan_inf unit fixtures; here every resilience layer
-(atomic checkpoints, NaN rollback, retry) gets a switchable failure.
+(atomic checkpoints, NaN rollback, retry, the rpc transport) gets a
+switchable failure.
 
 Syntax — semicolon-separated fault specs, each ``kind@key=value,...``::
 
     PTPU_FAULTS="ckpt_crash@step=4;conn_error@site=store.connect,times=2"
     PTPU_FAULTS="nan_grad@step=5"
     PTPU_FAULTS="ckpt_crash@step=4,hard=1"     # SIGKILL mid-save (kill -9)
+    PTPU_FAULTS="net_drop@site=rpc.dial,peer=r0,times=0"
+    PTPU_FAULTS="net_delay@site=rpc.send,secs=0.2,p=0.5,seed=7"
 
-Keys:
+Keys (validated PER KIND at parse time — an unknown key, or a key that
+is not valid for its kind, raises ``ValueError`` instead of passing
+silently as a dead knob):
 
 - ``step``  — fire only when the call site reports this step number.
 - ``site``  — fire only at this named injection site (e.g. ``store.get``).
-- ``times`` — how many firings before the fault burns out (default 1;
-  ``times=0`` means unlimited).
+- ``times`` — how many firings before the fault burns out.  Default 1;
+  ``times=0`` is pinned as "never burns out — fire on EVERY match"
+  (tests/test_chaos.py), the spelling every long-lived partition uses.
 - ``hard``  — for ``ckpt_crash``: 1 = kill the process with SIGKILL
   (uncatchable, the true "power loss mid-write"), 0 = raise
   :class:`InjectedCrash` (catchable, for in-process tests).
 - ``secs``  — for ``stall``: how long the injected hang sleeps
-  (default 2.0).
+  (default 2.0).  For ``net_delay``: how long the byte trickle takes;
+  for ``net_partition``: how long the blackhole blocks before the
+  caller's injected timeout (default 0.05 — tests should not pay real
+  partition walls).
+- ``peer``  — ``net_*`` only: fire only when the transport names this
+  remote worker.  Caller-side rpc passes the dial target, so
+  ``net_partition@peer=r2`` is a ONE-directional blackhole: calls *to*
+  r2 die, calls *from* r2 are untouched.
+- ``p``     — ``net_*`` only: fire probabilistically with this chance,
+  drawn from the fault's own seeded RNG.  A draw is consumed on every
+  structural match (fired or not), so the same spec + seed + call
+  sequence replays the identical fire/no-fire pattern bit-for-bit.
+- ``seed``  — ``net_*`` only: RNG seed for ``p=`` rolls (default
+  ``PTPU_CHAOS_SEED`` env, else 0).  Each fault's stream is derived
+  arithmetically from (seed, spec position) — never ``hash()`` — so
+  replays are independent of PYTHONHASHSEED.
 
 Kinds wired into the framework:
 
@@ -35,22 +56,51 @@ Kinds wired into the framework:
   ``engine.step``): the step blocks for ``secs`` without completing any
   span, the deterministic "distributed hang" that
   `monitor.watchdog` must catch (tests/test_trace.py).
+- ``net_drop`` / ``net_delay`` / ``net_partition`` / ``net_garble`` —
+  the network-fault family, consulted by `distributed.rpc` at its three
+  choke points (sites ``rpc.dial`` / ``rpc.send`` / ``rpc.recv``) via
+  :meth:`FaultPlan.net_fire`.  drop = connection refused/reset, delay =
+  slow byte trickle, partition = one-directional blackhole (the caller
+  sees only a timeout), garble = truncated/corrupted frame.  What each
+  kind *does* lives in rpc.py; this module only decides *whether* it
+  fires, deterministically.
 
-Everything is inert (one None check) when ``PTPU_FAULTS`` is unset.
+Every fire increments ``resilience/faults_injected{kind}`` and drops a
+``fault/injected`` breadcrumb on the flight ring, so a chaos run's fire
+sequence is auditable post-mortem.  Everything is inert (one global
+read) when ``PTPU_FAULTS`` is unset.
 """
 from __future__ import annotations
 
 import os
+import random
 import signal
 import threading
 import time
 from typing import Optional
 
 from .. import monitor
+from ..monitor import flight as _flight
 
-__all__ = ["FaultPlan", "InjectedCrash", "InjectedFault", "get_plan",
-           "set_plan", "should_fire", "maybe_raise", "maybe_crash",
-           "maybe_stall"]
+__all__ = ["FaultPlan", "InjectedCrash", "InjectedFault", "NET_KINDS",
+           "get_plan", "set_plan", "should_fire", "maybe_raise",
+           "maybe_crash", "maybe_stall", "net_fire"]
+
+NET_KINDS = ("net_drop", "net_delay", "net_partition", "net_garble")
+
+# per-kind key vocabulary — parse-time contract, not a runtime filter
+_COMMON_KEYS = ("step", "site", "times")
+_NET_KEYS = _COMMON_KEYS + ("peer", "p", "seed")
+_KIND_KEYS = {
+    "ckpt_crash": _COMMON_KEYS + ("hard",),
+    "conn_error": _COMMON_KEYS,
+    "nan_grad": _COMMON_KEYS,
+    "stall": _COMMON_KEYS + ("secs",),
+    "net_drop": _NET_KEYS,
+    "net_delay": _NET_KEYS + ("secs",),
+    "net_partition": _NET_KEYS + ("secs",),
+    "net_garble": _NET_KEYS,
+}
 
 
 class InjectedFault(Exception):
@@ -62,32 +112,52 @@ class InjectedCrash(InjectedFault):
 
 
 class _Fault:
-    __slots__ = ("kind", "step", "site", "times", "hard", "secs", "fired")
+    __slots__ = ("kind", "step", "site", "peer", "times", "hard", "secs",
+                 "p", "fired", "_rng")
 
-    def __init__(self, kind, step=None, site=None, times=1, hard=0,
-                 secs=2.0):
+    def __init__(self, kind, index=0, step=None, site=None, peer=None,
+                 times=1, hard=0, secs=None, p=None, seed=None):
         self.kind = kind
         self.step = step
         self.site = site
-        self.times = times      # 0 = unlimited
+        self.peer = peer
+        self.times = times      # 0 = unlimited: fire on every match
         self.hard = hard
-        self.secs = secs
+        self.secs = (0.05 if kind == "net_partition" else 2.0) \
+            if secs is None else secs
+        self.p = p
         self.fired = 0
+        if seed is None:
+            seed = int(os.environ.get("PTPU_CHAOS_SEED", "0") or 0)
+        # arithmetic stream derivation (seed, spec position) — hash() of
+        # a tuple would make replays PYTHONHASHSEED-dependent
+        self._rng = random.Random(seed * 1000003 + index)
 
-    def matches(self, kind, site, step):
+    def matches(self, kind, site, step, peer=None):
         if kind != self.kind:
             return False
         if self.times and self.fired >= self.times:
             return False
         if self.site is not None and site != self.site:
             return False
+        if self.peer is not None and peer != self.peer:
+            return False
         if self.step is not None and (step is None or int(step) != self.step):
             return False
         return True
 
+    def roll(self) -> bool:
+        """One p= draw; always True when p is unset.  Call exactly once
+        per structural match so the stream position tracks the match
+        sequence, making fire/no-fire replay bit-identical."""
+        if self.p is None:
+            return True
+        return self._rng.random() < self.p
+
     def __repr__(self):
         return (f"_Fault({self.kind}, step={self.step}, site={self.site}, "
-                f"times={self.times}, hard={self.hard}, fired={self.fired})")
+                f"peer={self.peer}, times={self.times}, hard={self.hard}, "
+                f"p={self.p}, fired={self.fired})")
 
 
 class FaultPlan:
@@ -102,20 +172,30 @@ class FaultPlan:
             if not part:
                 continue
             kind, _, opts = part.partition("@")
+            kind = kind.strip()
+            valid = _KIND_KEYS.get(kind)
+            if valid is None:
+                raise ValueError(
+                    f"PTPU_FAULTS: unknown fault kind {kind!r} in {part!r} "
+                    f"(known: {', '.join(sorted(_KIND_KEYS))})")
             kw = {}
             for item in filter(None, (o.strip() for o in opts.split(","))):
                 k, _, v = item.partition("=")
+                if k not in valid:
+                    raise ValueError(
+                        f"PTPU_FAULTS: unknown key {k!r} for kind "
+                        f"{kind!r} in {part!r} "
+                        f"(valid: {', '.join(valid)})")
                 if k in ("step", "times", "hard"):
                     kw[k] = int(v)
-                elif k == "secs":
+                elif k == "seed":
+                    kw[k] = int(v)
+                elif k in ("secs", "p"):
                     kw[k] = float(v)
-                elif k == "site":
+                else:            # site / peer
                     kw[k] = v
-                else:
-                    raise ValueError(
-                        f"PTPU_FAULTS: unknown key {k!r} in {part!r} "
-                        "(known: step, site, times, hard, secs)")
-            self._faults.append(_Fault(kind.strip(), **kw))
+            self._faults.append(
+                _Fault(kind, index=len(self._faults), **kw))
         self._ctr = monitor.counter("resilience/faults_injected",
                                     "deterministic injected failures")
 
@@ -126,15 +206,46 @@ class FaultPlan:
     def __bool__(self):
         return bool(self._faults)
 
-    def should_fire(self, kind: str, site: str = None, step=None) -> bool:
+    def _record(self, f: _Fault, site, peer, step) -> None:
+        # caller holds self._lock; counter + flight ring are themselves
+        # thread-safe and never call back into faults
+        f.fired += 1
+        self._ctr.labels(kind=f.kind).inc()
+        _flight.note("fault/injected", fault=f.kind, site=site,
+                     peer=peer, step=step, fired=f.fired)
+
+    def should_fire(self, kind: str, site: str = None, step=None,
+                    peer=None) -> bool:
         """True (and consumes one firing) when a fault matches."""
         with self._lock:
             for f in self._faults:
-                if f.matches(kind, site, step):
-                    f.fired += 1
-                    self._ctr.labels(kind=kind).inc()
+                if f.matches(kind, site, step, peer):
+                    if not f.roll():
+                        continue    # draw consumed, fault held its fire
+                    self._record(f, site, peer, step)
                     return True
         return False
+
+    def net_fire(self, site: str = None, peer=None, step=None,
+                 kinds=NET_KINDS) -> Optional[_Fault]:
+        """First ``net_*`` fault that fires at this transport point, or
+        None.  Specs are consulted in plan order (the spec author sets
+        precedence); the returned fault carries ``kind`` and ``secs``
+        for the transport to act on.  ``kinds`` restricts the scan to
+        the kinds meaningful at this choke point (a garble spec can't
+        fire at dial — there is no payload to corrupt — and must not
+        burn budget there)."""
+        with self._lock:
+            for f in self._faults:
+                if f.kind not in kinds:
+                    continue
+                if not f.matches(f.kind, site, step, peer):
+                    continue
+                if not f.roll():
+                    continue
+                self._record(f, site, peer, step)
+                return f
+        return None
 
     def _find(self, kind, site=None, step=None) -> Optional[_Fault]:
         with self._lock:
@@ -158,8 +269,7 @@ class FaultPlan:
         if f is None:
             return
         with self._lock:
-            f.fired += 1
-        self._ctr.labels(kind="ckpt_crash").inc()
+            self._record(f, site, None, step)
         if f.hard:
             os.kill(os.getpid(), signal.SIGKILL)
         raise InjectedCrash(f"injected checkpoint crash in {site} "
@@ -173,37 +283,53 @@ class FaultPlan:
         if f is None:
             return
         with self._lock:
-            f.fired += 1
-        self._ctr.labels(kind="stall").inc()
+            self._record(f, site, None, step)
         time.sleep(f.secs)
 
 
 # -- process-wide plan ------------------------------------------------------
-_plan: Optional[FaultPlan] = None
+# The disabled hot path (every rpc send/recv, every engine step) must be
+# ONE global read: `_plan` holds the sentinel until the first get_plan()
+# resolves it from the env — to None when PTPU_FAULTS is unset — and
+# from then on the fast path never touches environ or the lock.
+# set_plan(None) restores the sentinel so tests that clear the plan and
+# then set PTPU_FAULTS see the new env (the pre-existing contract).
+_UNRESOLVED = object()
+_plan = _UNRESOLVED
 _plan_lock = threading.Lock()
 
 
 def get_plan() -> Optional[FaultPlan]:
     """The active plan, or None when PTPU_FAULTS is unset/empty (the
     common case: one global read, no parsing)."""
+    p = _plan
+    if p is _UNRESOLVED:
+        p = _resolve()
+    return p
+
+
+def _resolve() -> Optional[FaultPlan]:
     global _plan
-    if _plan is None and os.environ.get("PTPU_FAULTS"):
-        with _plan_lock:
-            if _plan is None:
-                _plan = FaultPlan.from_env()
-    return _plan
+    with _plan_lock:
+        if _plan is _UNRESOLVED:
+            spec = os.environ.get("PTPU_FAULTS", "")
+            _plan = FaultPlan(spec) if spec else None
+        return _plan
 
 
 def set_plan(plan: Optional[FaultPlan]) -> None:
-    """Install a plan programmatically (tests); None clears."""
+    """Install a plan programmatically (tests); None clears (and re-arms
+    env resolution on the next get_plan)."""
     global _plan
-    _plan = plan
+    with _plan_lock:
+        _plan = _UNRESOLVED if plan is None else plan
 
 
 # -- call-site helpers (inert one-liner when no plan) ----------------------
-def should_fire(kind, site=None, step=None) -> bool:
+def should_fire(kind, site=None, step=None, peer=None) -> bool:
     p = get_plan()
-    return False if p is None else p.should_fire(kind, site=site, step=step)
+    return False if p is None else p.should_fire(kind, site=site, step=step,
+                                                 peer=peer)
 
 
 def maybe_raise(kind, site=None, step=None, exc=ConnectionError, msg=None):
@@ -222,3 +348,13 @@ def maybe_stall(site=None, step=None):
     p = get_plan()
     if p is not None:
         p.maybe_stall(site=site, step=step)
+
+
+def net_fire(site=None, peer=None, step=None, kinds=NET_KINDS
+             ) -> Optional[_Fault]:
+    """Module-level transport hook: one global read when chaos is off."""
+    p = _plan
+    if p is _UNRESOLVED:
+        p = _resolve()
+    return None if p is None else p.net_fire(site=site, peer=peer, step=step,
+                                             kinds=kinds)

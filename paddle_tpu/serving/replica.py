@@ -75,9 +75,21 @@ class ReplicaWorker:
 
     # -- rpc-thread surface (lock-guarded, never touches the engine) --------
 
+    @staticmethod
+    def _frame_ok(frame) -> bool:
+        """Structural gate at the rpc boundary: a garbled frame that
+        survived unpickling by luck must be refused HERE (the router
+        re-routes on False), never enqueued where it would blow up
+        `pump()` and wedge the one engine thread."""
+        return (isinstance(frame, dict)
+                and isinstance(frame.get("rid"), int)
+                and isinstance(frame.get("prompt_ids"), (list, tuple)))
+
     def submit_local(self, frame) -> bool:
         """Accept a submit frame (False while draining — the router
         re-routes; no partial admission)."""
+        if not self._frame_ok(frame):
+            return False
         with self._lock:
             if self._draining:
                 return False
@@ -85,6 +97,8 @@ class ReplicaWorker:
             return True
 
     def adopt_local(self, frame) -> bool:
+        if not self._frame_ok(frame):
+            return False
         with self._lock:
             if self._draining:
                 return False
@@ -137,7 +151,6 @@ class ReplicaWorker:
             self._admit_one(kind, frame)
 
     def _admit_one(self, kind: str, frame: dict) -> None:
-        params = params_from_wire(frame.get("params"))
         # join the router's trace: the admit span carries the router-side
         # trace_id, so one trace spans router dispatch -> replica admit
         ctx = mtrace.extract(frame.get("trace"))
@@ -147,6 +160,10 @@ class ReplicaWorker:
                                    rid=frame.get("rid"), kind=kind,
                                    replica=self.name)
         try:
+            # params decode is INSIDE the guard: a structurally-valid
+            # frame with garbled params (wrong field types, non-dict)
+            # must error this one request, not kill the pump
+            params = params_from_wire(frame.get("params"))
             if kind == "adopt":
                 erid = self.engine.adopt_request(
                     frame["prompt_ids"], params, frame["output_ids"],
@@ -154,13 +171,13 @@ class ReplicaWorker:
             else:
                 erid = self.engine.add_request(frame["prompt_ids"],
                                                params)
-        except ValueError as e:
-            # malformed request (empty/over-long prompt, spent handoff):
-            # a clean error result, not a wedged stream
+        except (ValueError, KeyError, TypeError, AttributeError) as e:
+            # malformed request (empty/over-long prompt, spent handoff,
+            # garbled field): a clean error result, not a wedged stream
             with self._lock:
                 self._results.append(result_frame(
                     frame.get("rid"), self.name, ok=False,
-                    finish_reason="abort", error=str(e)))
+                    finish_reason="abort", error=repr(e)))
             return
         finally:
             if sp is not None:

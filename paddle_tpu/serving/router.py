@@ -200,6 +200,19 @@ class RouterConfig:
     # failover resubmissions per request before it errors cleanly; None
     # resolves from PTPU_ROUTER_RESUBMIT_LIMIT, default 1
     resubmit_limit: Optional[int] = None
+    # per-replica circuit breaker: consecutive transport failures before
+    # the breaker trips OPEN; None resolves from
+    # PTPU_ROUTER_BREAKER_THRESHOLD, default 3
+    breaker_threshold: Optional[int] = None
+    # seconds an OPEN breaker cools down before the half-open probe;
+    # doubles on every failed probe (capped 60s).  None resolves from
+    # PTPU_ROUTER_BREAKER_COOLDOWN_S, default 1.0
+    breaker_cooldown_s: Optional[float] = None
+    # grace the router grants an INFLIGHT request past its deadline for
+    # the replica's own deadline result to arrive before finishing it
+    # ok=False locally (the no-hang bound under a partition); None
+    # resolves from PTPU_ROUTER_DEADLINE_GRACE_S, default 0.25
+    deadline_grace_s: Optional[float] = None
 
     def resolve(self) -> "RouterConfig":
         sticky = self.sticky
@@ -218,10 +231,88 @@ class RouterConfig:
         if limit is None:
             limit = int(os.environ.get("PTPU_ROUTER_RESUBMIT_LIMIT", "1")
                         or 1)
+        thresh = self.breaker_threshold
+        if thresh is None:
+            thresh = int(os.environ.get("PTPU_ROUTER_BREAKER_THRESHOLD",
+                                        "3") or 3)
+        cooldown = self.breaker_cooldown_s
+        if cooldown is None:
+            cooldown = float(os.environ.get(
+                "PTPU_ROUTER_BREAKER_COOLDOWN_S", "1.0") or 1.0)
+        grace = self.deadline_grace_s
+        if grace is None:
+            grace = float(os.environ.get(
+                "PTPU_ROUTER_DEADLINE_GRACE_S", "0.25") or 0.25)
         return RouterConfig(sticky=bool(sticky), disaggregate=bool(disagg),
                             block_size=int(self.block_size),
                             affinity_cap=max(1, int(cap)),
-                            resubmit_limit=max(0, int(limit)))
+                            resubmit_limit=max(0, int(limit)),
+                            breaker_threshold=max(1, int(thresh)),
+                            breaker_cooldown_s=max(1e-3, float(cooldown)),
+                            deadline_grace_s=max(0.0, float(grace)))
+
+
+class _Breaker:
+    """Per-replica circuit breaker (single-threaded, pump-owned).
+
+    CLOSED → `threshold` consecutive transport failures → OPEN (the
+    replica is ejected from BOTH poll and dispatch, so a partitioned
+    peer does not cost the pump a timeout per cycle) → after the
+    cooldown, HALF_OPEN: the next `poll()` IS the probe — success
+    re-admits (CLOSED, backoff reset), failure re-trips with the
+    backoff doubled (capped).  The clock is injected so the state
+    machine unit-tests run on a fake clock."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+    MAX_BACKOFF_S = 60.0
+
+    __slots__ = ("threshold", "cooldown", "clock", "state", "fails",
+                 "trips", "opened_at", "backoff")
+
+    def __init__(self, threshold: int, cooldown: float, clock):
+        self.threshold = threshold
+        self.cooldown = cooldown
+        self.clock = clock
+        self.state = self.CLOSED
+        self.fails = 0          # consecutive transport failures
+        self.trips = 0          # lifetime trips (exported on the feed)
+        self.opened_at = 0.0
+        self.backoff = cooldown  # current cooldown; doubles per re-trip
+
+    def allow(self) -> bool:
+        """May the pump talk to this replica this cycle?  OPEN → False
+        until the cooldown elapses, then HALF_OPEN (probe granted)."""
+        if self.state == self.OPEN:
+            if self.clock() - self.opened_at < self.backoff:
+                return False
+            self.state = self.HALF_OPEN
+        return True
+
+    def record_success(self) -> None:
+        self.fails = 0
+        if self.state != self.CLOSED:
+            self.state = self.CLOSED
+            self.backoff = self.cooldown
+
+    def record_failure(self) -> bool:
+        """True when this failure TRIPS the breaker: threshold reached
+        while CLOSED, or a failed HALF_OPEN probe (re-trip, doubled
+        backoff)."""
+        self.fails += 1
+        if self.state == self.HALF_OPEN:
+            self.backoff = min(self.backoff * 2.0, self.MAX_BACKOFF_S)
+            self._open()
+            return True
+        if self.state == self.CLOSED and self.fails >= self.threshold:
+            self._open()
+            return True
+        return False
+
+    def _open(self) -> None:
+        self.state = self.OPEN
+        self.opened_at = self.clock()
+        self.trips += 1
+        self.fails = 0
 
 
 class _RouterRequest:
@@ -229,7 +320,7 @@ class _RouterRequest:
 
     __slots__ = ("rid", "prompt_ids", "params", "sig", "deadline",
                  "kind", "state", "assigned", "resubmits", "result",
-                 "handoff", "trace_id")
+                 "handoff", "trace_id", "expired_at")
 
     QUEUED, INFLIGHT, DONE = "queued", "inflight", "done"
 
@@ -247,6 +338,8 @@ class _RouterRequest:
         self.result = None              # ROUTER_RESULT_KEYS frame
         self.handoff = None             # pending handoff frame (disagg)
         self.trace_id = None
+        self.expired_at = None          # clock() when first seen expired
+        #                                 while INFLIGHT (grace window)
 
 
 class Router:
@@ -258,10 +351,16 @@ class Router:
     Neither is owned: the caller runs the aggregator and the rpc
     world."""
 
-    def __init__(self, clients, feed, config: Optional[RouterConfig] = None):
+    def __init__(self, clients, feed, config: Optional[RouterConfig] = None,
+                 clock=time.monotonic):
         self.config = (config or RouterConfig()).resolve()
         self._clients = OrderedDict((c.name, c) for c in clients)
         self._feed = feed
+        self._clock = clock
+        self._breakers = {
+            c.name: _Breaker(self.config.breaker_threshold,
+                             self.config.breaker_cooldown_s, clock)
+            for c in self._clients.values()}
         self._reqs: "dict[int, _RouterRequest]" = {}
         self._queue: deque = deque()          # rids awaiting dispatch
         self._next_rid = 0
@@ -303,6 +402,17 @@ class Router:
                 "router/queue_depth", "requests queued at the router"),
             "router/inflight": m.gauge(
                 "router/inflight", "requests in flight on replicas"),
+            "router/breaker_trips": m.counter(
+                "router/breaker_trips",
+                "circuit-breaker trips (threshold reached or a failed "
+                "half-open probe)"),
+            "router/breaker_open": m.gauge(
+                "router/breaker_open",
+                "replicas currently ejected by an open breaker"),
+            "router/deadline_inflight": m.counter(
+                "router/deadline_inflight",
+                "in-flight requests finished ok=False by the router "
+                "after their deadline (+grace) passed unanswered"),
         }
 
     # -- request API --------------------------------------------------------
@@ -358,9 +468,9 @@ class Router:
     # -- the pump -----------------------------------------------------------
 
     def poll(self) -> None:
-        """One router cycle: feed-driven failover, replica poll
-        absorption (results / handoffs / drain requeues), queue expiry,
-        dispatch."""
+        """One router cycle: feed-driven failover, breaker-gated replica
+        poll absorption (results / handoffs / drain requeues), queue +
+        in-flight expiry, dispatch."""
         snap = self._feed() or {}
         unavailable = set()
         for name in self._clients:
@@ -371,22 +481,60 @@ class Router:
         for name, client in self._clients.items():
             if name in unavailable:
                 continue   # never rpc a peer the feed says is gone
+            br = self._breakers[name]
+            if not br.allow():
+                # OPEN and still cooling: ejected without an rpc — a
+                # partitioned peer must not cost the pump one transport
+                # timeout per cycle.  allow() past the cooldown flips
+                # to HALF_OPEN and this poll IS the probe.
+                unavailable.add(name)
+                continue
             try:
                 doc = _check_frame(client.poll(), ROUTER_POLL_KEYS)
             except (OSError, ConnectionError, TimeoutError,
                     RuntimeError) as e:
-                # transport error without a feed transition yet: counted
-                # and surfaced; the request-level decision (failover)
-                # stays with the /fleet/healthz state machine
+                # transport error: counted, surfaced, and fed to the
+                # breaker — a trip ejects the replica and reroutes its
+                # in-flight requests within this same cycle (the feed's
+                # /fleet/healthz transition is the slower, authoritative
+                # path; the breaker is the fast local one)
                 self._m["router/errors"].inc()
                 self.last_err = f"{name}: {e}"
+                self._breaker_failure(name, unavailable)
                 continue
+            br.record_success()
             self._absorb(name, doc)
         self._expire_queue()
+        self._expire_inflight()
         self._dispatch(snap, unavailable)
         self._m["router/queue_depth"].set(len(self._queue))
         self._m["router/inflight"].set(
             sum(self._inflight.values()))
+        self._m["router/breaker_open"].set(
+            sum(1 for b in self._breakers.values()
+                if b.state == _Breaker.OPEN))
+
+    def _breaker_failure(self, name: str, unavailable: set) -> None:
+        """One transport failure against `name`: a resulting trip ejects
+        it for this cycle AND reroutes its in-flight requests now (they
+        re-dispatch in this cycle's _dispatch, sharing each request's
+        ONE Deadline and resubmit budget)."""
+        if self._breakers[name].record_failure():
+            self._m["router/breaker_trips"].inc()
+            unavailable.add(name)
+            self._fail_over(name)
+
+    def fleet_view(self) -> dict:
+        """The fleet router feed overlaid with router-local breaker
+        state — the aggregator cannot know it, so `ROUTER_FEED_KEYS`
+        accretes breaker_state/breaker_trips and the aggregator-side
+        builder reports them as None; this is where they get filled."""
+        snap = {k: dict(v or {}) for k, v in (self._feed() or {}).items()}
+        for name, br in self._breakers.items():
+            rec = snap.setdefault(name, {})
+            rec["breaker_state"] = br.state
+            rec["breaker_trips"] = br.trips
+        return snap
 
     # -- absorption ---------------------------------------------------------
 
@@ -507,6 +655,31 @@ class Router:
             self._m["router/deadline_rejected"].inc()
             self._emit_reqlog(rreq, "deadline")
 
+    def _expire_inflight(self) -> None:
+        """The no-hang bound for shipped requests: a replica that went
+        dark mid-request (partition, wedge) may never report back, and
+        the feed can lag.  A request seen expired while INFLIGHT gets
+        one grace window for the replica's own deadline result to
+        arrive, then the ROUTER finishes it ok=False — a stream never
+        outlives deadline + grace + one poll period."""
+        now = self._clock()
+        for rreq in list(self._reqs.values()):
+            if rreq.state != _RouterRequest.INFLIGHT \
+                    or rreq.deadline is None or not rreq.deadline.expired:
+                continue
+            if rreq.expired_at is None:
+                rreq.expired_at = now
+                continue
+            if now - rreq.expired_at < self.config.deadline_grace_s:
+                continue
+            name = rreq.assigned
+            self._finish(rreq, result_frame(
+                rreq.rid, name, ok=False, finish_reason="deadline",
+                error=f"deadline_s expired in flight on {name} "
+                      "(no result within grace)"))
+            self._m["router/deadline_inflight"].inc()
+            self._emit_reqlog(rreq, "deadline")
+
     def _eligible(self, snap, unavailable, kind: str) -> list:
         """Replica names a `kind` ("prompt"|"handoff") dispatch may
         target right now: feed-healthy (or not yet scraped), not
@@ -517,6 +690,8 @@ class Router:
         for name, client in self._clients.items():
             if name in unavailable or name in self._draining:
                 continue
+            if self._breakers[name].state == _Breaker.OPEN:
+                continue    # ejected: only the half-open probe may talk
             if self.config.disaggregate \
                     and getattr(client, "role", "both") not in want:
                 continue
@@ -573,7 +748,7 @@ class Router:
             else:
                 name = min(eligible,
                            key=lambda n: self._load_score(n, snap))
-            if self._ship(rreq, name):
+            if self._ship(rreq, name, unavailable):
                 if sticky is not None:
                     self._m["router/sticky_hits"].inc()
                 for k in rreq.sig:
@@ -586,7 +761,8 @@ class Router:
             # it for the rest of this cycle and try the others
             unavailable.add(name)
 
-    def _ship(self, rreq: _RouterRequest, name: str) -> bool:
+    def _ship(self, rreq: _RouterRequest, name: str,
+              unavailable: set) -> bool:
         client = self._clients[name]
         params = params_to_wire(rreq.params)
         if rreq.deadline is not None:
@@ -609,7 +785,11 @@ class Router:
                 RuntimeError) as e:
             self._m["router/errors"].inc()
             self.last_err = f"{name}: {e}"
+            self._breaker_failure(name, unavailable)
             return False
+        # the transport worked — an application-level refusal (ok=False,
+        # e.g. a drain race) is not a breaker failure
+        self._breakers[name].record_success()
         if not ok:
             return False
         rreq.state = _RouterRequest.INFLIGHT

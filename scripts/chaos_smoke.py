@@ -1,0 +1,523 @@
+"""Chaos smoke (ISSUE 18 acceptance, end-to-end): the multi-replica
+tier — `Router` + `FleetAggregator` in the parent, FOUR real replica
+worker processes — driven through a scripted, deterministic
+network-fault schedule covering every ``net_*`` kind plus an engine
+stall and a mid-stream SIGKILL, proving the three chaos invariants:
+
+1. **no stream ever hangs past its deadline** — every wait below is
+   deadline-bounded; a request shipped to a replica that wedges is
+   finished ok=False by the ROUTER inside deadline + grace (the
+   in-flight deadline bound), never abandoned to the wedge;
+2. **survivors are token-identical to a fault-free run** — greedy AND
+   seeded-sampling requests that live through drops, partitions,
+   failovers and a SIGKILL finish with EXACTLY the tokens of the
+   single-process reference engine;
+3. **zero KV blocks leak** — after the full schedule every surviving
+   replica's free-block count is back at its baseline and a follow-up
+   wave completes at full capacity.
+
+The schedule (the specs are deterministic; ``PTPU_CHAOS_SEED`` pins any
+``p=`` rolls — the bit-identical replay itself is unit-pinned in
+tests/test_chaos.py):
+
+  leg 1  net_drop@rpc.dial,peer=r0    breaker trips, wave reroutes off
+                                      r0; heal -> half-open probe
+                                      re-admits it
+  leg 2  net_delay@rpc.send,peer=r1   slow byte trickle; frames arrive
+                                      intact, no breaker trip
+  leg 3  net_partition@peer=r2        armed MID-FLIGHT: one-directional
+                                      blackhole -> breaker trip ->
+                                      same-cycle failover
+  leg 4  net_garble, both directions  router-side reply garble trips
+                                      r3; a replica-side frame garble
+                                      is answered with a structured
+                                      error — the serve thread survives
+  leg 5  stall@engine.step            a) a deadline'd request on the
+                                      wedged replica is finished by the
+                                      router inside deadline + grace
+                                      (NOT after the 8 s stall);
+                                      b) feed stall detection -> failover
+  leg 6  SIGKILL mid-stream           feed rolls r0 up as down ->
+                                      resubmit from prompt on survivors
+
+Runnable anywhere (CPU included):
+
+    JAX_PLATFORMS=cpu PTPU_CHAOS_SEED=7 python scripts/chaos_smoke.py
+
+Run by tests/test_chaos.py::test_chaos_smoke_script (slow tier —
+engine-compiling subprocesses don't fit the fast-tier budget).
+"""
+import os
+import socket
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault("PTPU_FORCE_PLATFORM", "cpu")   # drop on a TPU host
+os.environ.setdefault("PTPU_MONITOR", "1")
+
+REPLICAS = (("r0", "both"), ("r1", "both"),
+            ("r2", "both"), ("r3", "both"))
+WORLD = 1 + len(REPLICAS)     # router (rank 0) + replicas
+BS = 16
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _kv_probe():
+    """Free/parked KV block counts on the replica (rpc'd by reference:
+    both processes run THIS file, so __main__ resolves on the peer)."""
+    from paddle_tpu.serving import replica as replica_mod
+
+    kv = replica_mod.current_worker().engine.cache
+    return {"free": kv.num_free_blocks, "parked": kv.num_parked_blocks}
+
+
+# ---------------------------------------------------------------------------
+# replica process
+# ---------------------------------------------------------------------------
+
+def replica_main(idx: int, store_addr: str):
+    import paddle_tpu as paddle
+    from paddle_tpu import monitor
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.models import GPTForCausalLM, gpt_test_config
+    from paddle_tpu.monitor import fleet
+    from paddle_tpu.resilience import faults
+    from paddle_tpu.serving import EngineConfig, LLMEngine, ReplicaWorker
+    from paddle_tpu.serving import replica as replica_mod
+
+    name, role = REPLICAS[idx]
+    # ALL replicas share the parent's weights (seed 0): failover is only
+    # token-identical across replicas serving the same model
+    paddle.seed(0)
+    cfg = gpt_test_config(stacked_blocks=True, sequence_parallel=False)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    engine = LLMEngine(model, EngineConfig(
+        block_size=BS, max_num_seqs=4,
+        # prefix caching off: the leak check wants free == total at rest
+        enable_prefix_caching=False))
+    worker = replica_mod.install(ReplicaWorker(engine, name=name,
+                                               role=role))
+
+    monitor.start_server(0)   # self-registers under PTPU_FLEET_STORE
+    host, port = store_addr.rsplit(":", 1)
+    rpc.init_rpc(name, rank=idx + 1, world_size=WORLD,
+                 master_endpoint=store_addr)
+    cli = fleet._StoreClient(host, int(port))
+    cli.set(f"fleet/ready/{name}", b"1")
+    print(f"replica {name} ({role}): ready", flush=True)
+
+    applied = b""
+    while True:
+        busy = worker.pump()
+        # the command channel is checked EVERY pump (1 ms when busy) so
+        # a fault plan or arm_kill lands mid-stream, not at idle; the
+        # store key is not consumed on read, so only a CHANGED command
+        # is applied (re-applying a plan would reset its times= budget)
+        cmd = cli.get(f"fleet/cmd/{name}",
+                      timeout_ms=1 if busy else 100)
+        if cmd and cmd != applied:
+            applied = cmd
+            if cmd == b"exit":
+                return
+            if cmd == b"drain":
+                worker.start_drain()
+            elif cmd == b"arm_kill":
+                faults.set_plan(faults.FaultPlan(
+                    "ckpt_crash@site=replica.step,hard=1"))
+                print(f"replica {name}: kill armed", flush=True)
+            elif cmd.startswith(b"plan:"):
+                spec = cmd[len(b"plan:"):].decode()
+                faults.set_plan(faults.FaultPlan(spec) if spec else None)
+                print(f"replica {name}: plan {spec!r}", flush=True)
+            # ack AFTER applying (and before any armed kill can fire on
+            # the next pump) so the driver can barrier on delivery
+            cli.set(f"fleet/ack/{name}", cmd)
+        if not busy:
+            time.sleep(0.01)
+
+
+# ---------------------------------------------------------------------------
+# router / driver process
+# ---------------------------------------------------------------------------
+
+def _deadline_wait(what, pred, deadline_s=420.0, poll_s=0.25):
+    t0 = time.monotonic()
+    while True:
+        out = pred()
+        if out:
+            return out
+        if time.monotonic() - t0 > deadline_s:
+            raise TimeoutError(f"timed out waiting for {what}")
+        time.sleep(poll_s)
+
+
+def _pump_until(router, what, pred, deadline_s=120.0):
+    """Drive the router's pump until pred() is truthy (bounded)."""
+    t0 = time.monotonic()
+    while True:
+        router.poll()
+        out = pred()
+        if out:
+            return out
+        if time.monotonic() - t0 > deadline_s:
+            raise TimeoutError(f"timed out waiting for {what}")
+        time.sleep(0.02)
+
+
+def _run_wave(router, prompts, params_list, timeout=240.0):
+    rids = [router.submit(p, sp) for p, sp in zip(prompts, params_list)]
+    results = [router.wait(rid, timeout=timeout) for rid in rids]
+    for rid in rids:
+        router.release(rid)
+    return results
+
+
+def _send_cmd(cli, name, cmd: bytes, deadline_s=30.0):
+    """Deliver a command to a replica and barrier on its ack."""
+    cli.set(f"fleet/cmd/{name}", cmd)
+    _deadline_wait(f"{name} ack of {cmd!r}",
+                   lambda: cli.get(f"fleet/ack/{name}",
+                                   timeout_ms=200) == cmd,
+                   deadline_s=deadline_s, poll_s=0.05)
+
+
+def main():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.models import GPTForCausalLM, gpt_test_config
+    from paddle_tpu.monitor import fleet, flight
+    from paddle_tpu.resilience import faults
+    from paddle_tpu.serving import (EngineConfig, LLMEngine, Router,
+                                    RouterConfig, RpcReplicaClient,
+                                    SamplingParams)
+
+    store_port = _free_port()
+    store_addr = f"127.0.0.1:{store_port}"
+
+    procs = []
+    for idx, (name, _) in enumerate(REPLICAS):
+        env = dict(os.environ,
+                   PTPU_REPLICA_ID=name,
+                   PTPU_FLEET_STORE=store_addr,
+                   PTPU_MONITOR="1")
+        env.pop("PTPU_FAULTS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--replica",
+             str(idx), "--store", store_addr], env=env))
+    try:
+        rpc.init_rpc("router", rank=0, world_size=WORLD,
+                     master_endpoint=store_addr)
+        cli = fleet._StoreClient("127.0.0.1", store_port)
+        for name, _ in REPLICAS:
+            _deadline_wait(f"replica {name} ready",
+                           lambda n=name: cli.get(f"fleet/ready/{n}",
+                                                  timeout_ms=500) == b"1")
+        print("replicas ready", flush=True)
+
+        agg = fleet.FleetAggregator(store=store_addr, interval=0.25,
+                                    stall_after_s=5.0, down_after=4)
+        _deadline_wait("all replicas healthy", lambda: (
+            lambda s: set(s) == {n for n, _ in REPLICAS}
+            and set(s.values()) == {"healthy"})(agg.poll_once()))
+
+        cfg = gpt_test_config(stacked_blocks=True,
+                              sequence_parallel=False)
+
+        def prompt(n, seed):
+            r = np.random.RandomState(seed)
+            return r.randint(0, cfg.vocab_size, (n,)).astype(np.int32)
+
+        # the single-process reference: same weights (seed 0), same
+        # engine shape — every leg's tokens are pinned against it
+        paddle.seed(0)
+        ref_model = GPTForCausalLM(cfg)
+        ref_model.eval()
+        ref = LLMEngine(ref_model, EngineConfig(block_size=BS,
+                                                max_num_seqs=4))
+
+        clients = {n: RpcReplicaClient(n, role=role, timeout=5.0)
+                   for n, role in REPLICAS}
+        router = Router(
+            [clients[n] for n, _ in REPLICAS], agg.snapshot,
+            RouterConfig(sticky=False, block_size=BS,
+                         breaker_threshold=2, breaker_cooldown_s=0.5,
+                         deadline_grace_s=0.25))
+        m = router._m
+
+        def assert_identical(got, want):
+            for res, w in zip(got, want):
+                assert res["ok"], res
+                np.testing.assert_array_equal(res["token_ids"], w)
+
+        # -- leg 0: fault-free baseline ---------------------------------
+        # Warms every replica's compile cache BEFORE the background
+        # scrape loop starts, so a first-wave compile can never trip the
+        # 5 s stall detector; also pins the baseline KV watermark.
+        base_prompts = [prompt(10 + (i % 4), seed=100 + i)
+                        for i in range(8)]
+        base_params = [SamplingParams(max_new_tokens=12)] * 8
+        want0 = ref.generate(base_prompts, base_params)
+        got = _run_wave(router, base_prompts, base_params)
+        assert_identical(got, want0)
+        homes = {res["replica"] for res in got}
+        assert homes == {n for n, _ in REPLICAS}, (
+            f"baseline wave must warm every replica, got {homes}")
+        # warm the SAMPLING program everywhere too — it is a separate
+        # compile, and an unwarmed replica receiving leg 3's seeded
+        # request would wedge past the 5 s stall detector mid-leg
+        samp_params = [SamplingParams(max_new_tokens=12, do_sample=True,
+                                      temperature=0.8, seed=11)] * 8
+        want0s = ref.generate(base_prompts, samp_params)
+        got = _run_wave(router, base_prompts, samp_params)
+        assert_identical(got, want0s)
+        assert {res["replica"] for res in got} == homes
+        kv0 = {n: rpc.rpc_sync(n, _kv_probe, timeout=30)
+               for n, _ in REPLICAS}
+        assert all(v["parked"] == 0 for v in kv0.values()), kv0
+        # background scrape loop: failover legs need live health state
+        agg.start()
+        print(f"baseline: 8 streams across {sorted(homes)} "
+              f"token-identical; KV watermark {kv0['r0']['free']} free",
+              flush=True)
+
+        # -- leg 1: net_drop at dial -> breaker trip, heal -> re-admit --
+        trips0 = m["router/breaker_trips"].value
+        plan1 = faults.FaultPlan("net_drop@site=rpc.dial,peer=r0,times=0")
+        faults.set_plan(plan1)
+        w_prompts = [prompt(10 + i, seed=110 + i) for i in range(4)]
+        w_params = [SamplingParams(max_new_tokens=12)] * 4
+        want = ref.generate(w_prompts, w_params)
+        got = _run_wave(router, w_prompts, w_params)
+        assert_identical(got, want)
+        assert all(res["replica"] != "r0" for res in got), got
+        assert m["router/breaker_trips"].value > trips0
+        assert router.fleet_view()["r0"]["breaker_state"] == "open"
+        assert plan1._faults[0].fired >= 2, plan1._faults[0]
+        faults.set_plan(None)      # heal: next half-open probe succeeds
+        _pump_until(router, "r0 re-admitted (half-open probe)",
+                    lambda: router.fleet_view()["r0"]["breaker_state"]
+                    == "closed", deadline_s=120.0)
+        print("leg 1 net_drop: wave rerouted off r0 token-identical, "
+              "breaker tripped, half-open probe re-admitted it",
+              flush=True)
+
+        # -- leg 2: net_delay -> slow but intact, no trip ---------------
+        trips1 = m["router/breaker_trips"].value
+        plan2 = faults.FaultPlan(
+            "net_delay@site=rpc.send,peer=r1,secs=0.3,times=3")
+        faults.set_plan(plan2)
+        w_prompts = [prompt(10 + i, seed=120 + i) for i in range(4)]
+        want = ref.generate(w_prompts, w_params)
+        got = _run_wave(router, w_prompts, w_params)
+        assert_identical(got, want)
+        assert plan2._faults[0].fired >= 1, plan2._faults[0]
+        assert m["router/breaker_trips"].value == trips1, (
+            "a delay is slowness, not failure — no trip")
+        faults.set_plan(None)
+        print(f"leg 2 net_delay: {plan2._faults[0].fired} trickled "
+              "frames arrived intact, wave token-identical, no trip",
+              flush=True)
+
+        # -- leg 3: net_partition armed MID-FLIGHT -> failover ----------
+        fo0 = m["router/failovers"].value
+        # prompt lengths stay inside the baseline-warmed palette
+        # (10..13): prefill compiles PER DISTINCT PROMPT LENGTH, and a
+        # cold length mid-leg wedges a replica past the stall detector
+        w_prompts = [prompt(10 + i, seed=130 + i) for i in range(4)]
+        w3_params = [SamplingParams(max_new_tokens=24),
+                     SamplingParams(max_new_tokens=24, do_sample=True,
+                                    temperature=0.8, seed=11),
+                     SamplingParams(max_new_tokens=24),
+                     SamplingParams(max_new_tokens=24)]
+        want = ref.generate(w_prompts, w3_params)
+        rids = [router.submit(p, sp)
+                for p, sp in zip(w_prompts, w3_params)]
+        _pump_until(router, "streams in flight on r2",
+                    lambda: router._inflight.get("r2", 0) > 0,
+                    deadline_s=60.0)
+        plan3 = faults.FaultPlan("net_partition@peer=r2,times=0,secs=0.05")
+        faults.set_plan(plan3)     # one-directional blackhole, NOW
+        results = [router.wait(rid, timeout=240.0) for rid in rids]
+        for rid in rids:
+            router.release(rid)
+        assert_identical(results, want)
+        assert all(res["replica"] != "r2" for res in results), results
+        assert m["router/failovers"].value > fo0
+        assert router.fleet_view()["r2"]["breaker_state"] == "open"
+        faults.set_plan(None)
+        _pump_until(router, "r2 re-admitted after partition heal",
+                    lambda: router.fleet_view()["r2"]["breaker_state"]
+                    == "closed", deadline_s=120.0)
+        print("leg 3 net_partition: mid-flight blackhole of r2 tripped "
+              "the breaker, streams (greedy + seeded) failed over "
+              "same-cycle token-identical", flush=True)
+
+        # -- leg 4: net_garble, both directions -------------------------
+        trips3 = m["router/breaker_trips"].value
+        errs3 = m["router/errors"].value
+        plan4 = faults.FaultPlan("net_garble@site=rpc.recv,peer=r3,times=2")
+        faults.set_plan(plan4)     # router-side: r3's replies corrupt
+        # replica-side: r1's serve thread sees ONE corrupt request frame
+        _send_cmd(cli, "r1", b"plan:net_garble@site=rpc.recv,times=1")
+        w_prompts = [prompt(10 + i, seed=140 + i) for i in range(4)]
+        want = ref.generate(w_prompts, w_params)
+        got = _run_wave(router, w_prompts, w_params)
+        assert_identical(got, want)
+        assert plan4._faults[0].fired == 2, plan4._faults[0]
+        assert m["router/breaker_trips"].value > trips3, (
+            "two consecutive garbled replies from r3 must trip")
+        assert m["router/errors"].value >= errs3 + 3
+        # the replica-side garble answered with a structured error and
+        # the serve thread survived: r1 still serves rpc + never tripped
+        assert rpc.rpc_sync("r1", _kv_probe, timeout=30)["parked"] == 0
+        assert router.fleet_view()["r1"]["breaker_state"] == "closed"
+        faults.set_plan(None)
+        _send_cmd(cli, "r1", b"plan:")
+        _pump_until(router, "r3 re-admitted after garble burn-out",
+                    lambda: router.fleet_view()["r3"]["breaker_state"]
+                    == "closed", deadline_s=120.0)
+        print("leg 4 net_garble: garbled replies tripped r3's breaker, "
+              "garbled request frame got a structured error (serve "
+              "thread survived), wave token-identical", flush=True)
+
+        # -- leg 5a: stall + deadline -> the router finishes it ---------
+        dl0 = m["router/deadline_inflight"].value
+        stall_router = Router(
+            [clients["r3"]], agg.snapshot,
+            RouterConfig(sticky=False, block_size=BS,
+                         breaker_threshold=2, breaker_cooldown_s=0.5,
+                         deadline_grace_s=0.25))
+        _send_cmd(cli, "r3", b"plan:stall@site=engine.step,secs=8,times=1")
+        t0 = time.monotonic()
+        rid = stall_router.submit(
+            prompt(12, seed=150),
+            SamplingParams(max_new_tokens=12, deadline_s=2.0))
+        res = stall_router.wait(rid, timeout=60.0)
+        took = time.monotonic() - t0
+        stall_router.release(rid)
+        assert not res["ok"] and res["finish_reason"] == "deadline", res
+        assert took < 5.0, (
+            f"deadline bound must beat the 8 s wedge, took {took:.2f}s")
+        assert m["router/deadline_inflight"].value == dl0 + 1
+        # drain r3's post-wake result (stale: the router already
+        # finished the request) before any later wave polls it — the
+        # metric registry is process-global, so delta not absolute
+        stale_a = m["router/stale_results"].value
+        _pump_until(stall_router, "r3's stale post-stall result drained",
+                    lambda: m["router/stale_results"].value > stale_a,
+                    deadline_s=120.0)
+        _deadline_wait("r3 healthy after stall",
+                       lambda: agg.snapshot()["r3"]["state"] == "healthy",
+                       deadline_s=120.0)
+        print(f"leg 5a stall+deadline: wedged replica's stream finished "
+              f"ok=False by the ROUTER in {took:.2f}s "
+              "(deadline 2 s + grace), not after the 8 s stall",
+              flush=True)
+
+        # -- leg 5b: stall -> feed detection -> failover ----------------
+        fo5 = m["router/failovers"].value
+        stale5 = m["router/stale_results"].value
+        w_prompts = [prompt(10 + i, seed=160 + i) for i in range(4)]
+        w5_params = [SamplingParams(max_new_tokens=32)] * 4
+        want = ref.generate(w_prompts, w5_params)
+        rids = [router.submit(p, sp)
+                for p, sp in zip(w_prompts, w5_params)]
+        _pump_until(router, "streams in flight on r2",
+                    lambda: router._inflight.get("r2", 0) > 0,
+                    deadline_s=60.0)
+        _send_cmd(cli, "r2", b"plan:stall@site=engine.step,secs=8,times=1")
+        results = [router.wait(rid, timeout=240.0) for rid in rids]
+        for rid in rids:
+            router.release(rid)
+        assert_identical(results, want)
+        assert m["router/failovers"].value > fo5, (
+            "the feed's stall detection must have triggered failover")
+        _pump_until(router, "r2's stale post-stall result drained",
+                    lambda: m["router/stale_results"].value > stale5,
+                    deadline_s=120.0)
+        _deadline_wait("r2 healthy after stall",
+                       lambda: agg.snapshot()["r2"]["state"] == "healthy",
+                       deadline_s=120.0)
+        print("leg 5b stall+failover: feed marked r2 stalled, its "
+              "stream resubmitted from prompt and finished "
+              "token-identical elsewhere", flush=True)
+
+        # -- leg 6: SIGKILL mid-stream -> failover on survivors ---------
+        fo6 = m["router/failovers"].value
+        w_prompts = [prompt(10 + i, seed=170 + i) for i in range(4)]
+        w6_params = [SamplingParams(max_new_tokens=40)] * 4
+        want6 = ref.generate(w_prompts, w6_params)
+        rids = [router.submit(p, sp)
+                for p, sp in zip(w_prompts, w6_params)]
+        _pump_until(router, "streams in flight on r0",
+                    lambda: router._inflight.get("r0", 0) > 0,
+                    deadline_s=60.0)
+        _send_cmd(cli, "r0", b"arm_kill")    # SIGKILL mid-decode
+        results = [router.wait(rid, timeout=240.0) for rid in rids]
+        for rid in rids:
+            router.release(rid)
+        assert_identical(results, want6)
+        assert all(res["replica"] != "r0" for res in results), results
+        assert m["router/failovers"].value > fo6
+        assert procs[0].wait(timeout=30) == -9, "r0 must be SIGKILLed"
+        _deadline_wait("feed rolls r0 up as down",
+                       lambda: agg.snapshot()["r0"]["state"] == "down",
+                       deadline_s=60.0)
+        print("leg 6 SIGKILL: r0 died mid-stream, feed marked it down, "
+              "all 4 streams completed token-identical on survivors",
+              flush=True)
+
+        # -- invariant 3: zero KV-block leaks on every survivor ---------
+        survivors = [n for n, _ in REPLICAS[1:]]
+        got = _run_wave(router, w_prompts, w6_params)
+        assert_identical(got, want6)
+
+        def _kv_settled():
+            now = {n: rpc.rpc_sync(n, _kv_probe, timeout=30)
+                   for n in survivors}
+            return now if all(now[n] == kv0[n] for n in survivors) \
+                else None
+        kv_end = _deadline_wait("KV watermark back at baseline",
+                                _kv_settled, deadline_s=60.0, poll_s=0.5)
+        print(f"kv: survivors back at baseline {kv_end} — zero leaked "
+              "blocks; follow-up wave at full capacity", flush=True)
+
+        # every router-side fire left an auditable breadcrumb
+        inj = [r for r in flight.get_recorder().records()
+               if r.get("event") == "fault/injected"]
+        assert len(inj) >= 6, inj
+
+        for name in survivors:
+            cli.set(f"fleet/cmd/{name}", b"exit")
+        agg.stop()
+        print("CHAOS SMOKE OK", flush=True)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+if __name__ == "__main__":
+    if "--replica" in sys.argv:
+        argv = sys.argv[1:]
+        replica_main(int(argv[argv.index("--replica") + 1]),
+                     argv[argv.index("--store") + 1])
+    else:
+        main()

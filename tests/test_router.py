@@ -692,6 +692,182 @@ def test_export_adopt_token_identical(model):
 
 
 # ---------------------------------------------------------------------------
+# circuit breaker + in-flight deadline (ISSUE 18 chaos hardening)
+# ---------------------------------------------------------------------------
+
+class _FakeClock:
+    def __init__(self):
+        self.now = 100.0
+
+    def __call__(self):
+        return self.now
+
+
+def _breaker_router(replicas, feed, clock=None, **cfg):
+    cfg.setdefault("block_size", BS)
+    cfg.setdefault("sticky", False)
+    return Router(replicas, lambda: feed, RouterConfig(**cfg).resolve(),
+                  clock=clock or _FakeClock())
+
+
+def test_breaker_trips_and_reroutes_same_cycle():
+    """A partitioned peer (every rpc times out) trips the breaker at
+    threshold and its in-flight request reroutes within the SAME poll
+    cycle — one pump call, the request is on the healthy replica."""
+    a, b = FakeReplica("a"), FakeReplica("b")
+    r = _breaker_router([a, b], _feed(a="healthy", b="healthy"),
+                        breaker_threshold=1)
+    rid = r.submit(_prompt(4))
+    r.poll()
+    assert len(a.submitted) == 1           # least-loaded tie → "a"
+    a.fail = TimeoutError("injected net_partition at rpc.recv")
+    r.poll()                               # ONE cycle: trip + reroute
+    assert r._breakers["a"].state == "open"
+    assert len(b.submitted) == 1
+    assert b.submitted[0]["rid"] == rid
+    assert r._reqs[rid].assigned == "b"
+    assert r._reqs[rid].resubmits == 1
+    # OPEN means ejected from the pump entirely: no rpc per cycle
+    polls_before = a.poll_calls
+    r.poll()
+    assert a.poll_calls == polls_before
+
+
+def test_breaker_threshold_counts_consecutive_failures():
+    a, b = FakeReplica("a"), FakeReplica("b")
+    r = _breaker_router([a, b], _feed(a="healthy", b="healthy"),
+                        breaker_threshold=3)
+    a.fail = ConnectionError("boom")
+    r.poll()
+    assert r._breakers["a"].state == "closed"
+    # one clean poll resets the consecutive count
+    a.fail = None
+    r.poll()
+    assert r._breakers["a"].fails == 0
+    a.fail = ConnectionError("boom")
+    r.poll()
+    r.poll()
+    assert r._breakers["a"].state == "closed"
+    r.poll()
+    assert r._breakers["a"].state == "open"
+    assert r._m["router/breaker_trips"].value == 1
+
+
+def test_breaker_half_open_probe_readmits_or_retrips_with_backoff():
+    clock = _FakeClock()
+    a, b = FakeReplica("a"), FakeReplica("b")
+    r = _breaker_router([a, b], _feed(a="healthy", b="healthy"),
+                        clock=clock, breaker_threshold=1,
+                        breaker_cooldown_s=1.0)
+    a.fail = ConnectionError("boom")
+    r.poll()
+    br = r._breakers["a"]
+    assert br.state == "open" and br.trips == 1
+    # still cooling: no probe
+    clock.now += 0.5
+    polls = a.poll_calls
+    r.poll()
+    assert a.poll_calls == polls
+    # cooldown elapsed: the next poll IS the probe — it fails, so the
+    # breaker re-trips with the backoff DOUBLED
+    clock.now += 0.6
+    r.poll()
+    assert br.state == "open" and br.trips == 2
+    assert br.backoff == pytest.approx(2.0)
+    # 1.1s later (past the old cooldown) it is still ejected — no new
+    # probe happened (a probe against the still-broken peer would have
+    # re-tripped again), because the backoff grew
+    clock.now += 1.1
+    r.poll()
+    assert br.trips == 2
+    # past the doubled backoff, a HEALED peer is re-admitted and the
+    # backoff resets for the next incident
+    clock.now += 1.0
+    a.fail = None
+    r.poll()
+    assert br.state == "closed"
+    assert br.backoff == pytest.approx(1.0)
+    rid = r.submit(_prompt(4))
+    r.poll()
+    assert any(f["rid"] == rid for f in a.submitted + b.submitted)
+
+
+def test_breaker_resubmit_exhaustion_errors_cleanly():
+    """Breaker-driven failover shares the resubmit budget: past the
+    limit the request finishes ok=False — never hangs."""
+    a, b = FakeReplica("a"), FakeReplica("b")
+    r = _breaker_router([a, b], _feed(a="healthy", b="healthy"),
+                        breaker_threshold=1, resubmit_limit=0)
+    rid = r.submit(_prompt(4))
+    r.poll()
+    assert len(a.submitted) == 1
+    a.fail = ConnectionError("boom")
+    r.poll()
+    res = r.result(rid)
+    assert res is not None and not res["ok"]
+    assert res["finish_reason"] == "abort"
+    assert "resubmit limit" in res["error"]
+    assert len(b.submitted) == 0           # budget spent, not rerouted
+
+
+def test_inflight_deadline_finished_by_router():
+    """A request whose deadline passes while the owning replica never
+    answers is finished ok=False by the ROUTER after the grace window —
+    the no-hang bound under a blackhole."""
+    clock = _FakeClock()
+    a = FakeReplica("a")
+    r = _breaker_router([a], _feed(a="healthy"), clock=clock,
+                        deadline_grace_s=0.0)
+    rid = r.submit(_prompt(4), SamplingParams(deadline_s=0.01))
+    r.poll()
+    assert r._reqs[rid].state == "inflight"
+    time.sleep(0.02)                       # real Deadline expires
+    r.poll()                               # first sighting opens grace
+    clock.now += 1.0
+    r.poll()                               # grace over: finalized here
+    res = r.result(rid)
+    assert res is not None and not res["ok"]
+    assert res["finish_reason"] == "deadline"
+    assert r._m["router/deadline_inflight"].value == 1
+    assert sum(r._inflight.values()) == 0  # accounting released
+
+
+def test_fleet_view_overlays_breaker_state():
+    a, b = FakeReplica("a"), FakeReplica("b")
+    r = _breaker_router([a, b], _feed(a="healthy", b="healthy"),
+                        breaker_threshold=1)
+    a.fail = ConnectionError("boom")
+    r.poll()
+    view = r.fleet_view()
+    assert view["a"]["breaker_state"] == "open"
+    assert view["a"]["breaker_trips"] == 1
+    assert view["b"]["breaker_state"] == "closed"
+    # the overlay keys are declared, accrete-only, on the feed registry
+    assert "breaker_state" in wire.ROUTER_FEED_KEYS
+    assert "breaker_trips" in wire.ROUTER_FEED_KEYS
+
+
+def test_worker_rejects_garbled_frames():
+    """rpc-boundary hardening: structurally-bad frames are refused at
+    submit (router reroutes), and a valid-shaped frame with garbled
+    fields errors that ONE request instead of wedging the pump."""
+    eng = FakeEngine()
+    w = ReplicaWorker(eng, name="w0")
+    assert not w.submit_local("not a dict")
+    assert not w.submit_local({"rid": "seven", "prompt_ids": [1, 2]})
+    assert not w.submit_local({"rid": 7})
+    assert not w.adopt_local([1, 2, 3])
+    # valid shape, garbled params: admitted, then cleanly errored
+    assert w.submit_local({"rid": 7, "prompt_ids": [1, 2],
+                           "params": "garbage"})
+    w.pump()                               # must not raise
+    doc = w.poll_local()
+    (res,) = doc["results"]
+    assert not res["ok"] and res["finish_reason"] == "abort"
+    assert not eng._requests               # nothing admitted
+
+
+# ---------------------------------------------------------------------------
 # the cross-process acceptance (slow tier: router + replicas over rpc)
 # ---------------------------------------------------------------------------
 

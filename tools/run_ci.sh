@@ -14,6 +14,12 @@
 #                             wire-compat, env-flag-drift over
 #                             paddle_tpu/ tools/ scripts/; JSON artifact
 #                             at /tmp/ptpu_check_report.json)
+#   tools/run_ci.sh chaos   — the deterministic network-fault schedule
+#                             (ISSUE 18): scripts/chaos_smoke.py under a
+#                             fixed PTPU_CHAOS_SEED — router + 4 replica
+#                             processes through drop/delay/partition/
+#                             garble/stall/SIGKILL, asserting no-hang,
+#                             token-identity and zero KV leaks
 #   tools/run_ci.sh gates   — driver gates: compile-check entry() + the
 #                             8-device multichip dryrun + CPU bench smoke
 #   tools/run_ci.sh bench-check OLD.json NEW.json — perf regression gate
@@ -68,8 +74,18 @@ case "${1:-fast}" in
     # tests/test_router.py::test_router_smoke_script runs
     # scripts/router_smoke.py (ISSUE 17 acceptance — router + 4 replica
     # processes: sticky prefix routing, disaggregated prefill/decode
-    # handoff, mid-stream SIGKILL failover, all token-identical)
+    # handoff, mid-stream SIGKILL failover, all token-identical) and
+    # tests/test_chaos.py::test_chaos_smoke_script runs
+    # scripts/chaos_smoke.py (ISSUE 18 acceptance — the seeded
+    # network-fault schedule, same as the `chaos` lane below)
     python -m pytest tests/ -q
+    ;;
+  chaos)
+    # seed pinned so the fault schedule's p= rolls replay bit-identically
+    # run-to-run (the replay contract itself is unit-pinned in
+    # tests/test_chaos.py); override with PTPU_CHAOS_SEED=<n>
+    PTPU_CHAOS_SEED="${PTPU_CHAOS_SEED:-7}" JAX_PLATFORMS=cpu \
+      python scripts/chaos_smoke.py
     ;;
   lint)
     # whole-tree, all 12 rules (the 5 ISSUE-14 interprocedural rules —
@@ -112,7 +128,7 @@ EOF
     python tools/check_bench_regression.py --history BENCH_HISTORY.jsonl "$@"
     ;;
   *)
-    echo "usage: $0 {fast|full|lint|gates|bench-check OLD NEW|bench-history}" >&2
+    echo "usage: $0 {fast|full|lint|chaos|gates|bench-check OLD NEW|bench-history}" >&2
     exit 2
     ;;
 esac
