@@ -1175,6 +1175,124 @@ def bench_router_fanout():
                  "requests/sec", 5000.0)
 
 
+def bench_serving_load():
+    """ISSUE 19: the serving closed loop, measured through the REAL HTTP
+    front door — an ApiServer over a small engine, driven by seeded
+    OPEN-LOOP arrivals (the schedule never waits on completions, so
+    queueing shows up as TTFT, not as reduced offered load) at rising
+    QPS with mixed prompt lengths, every request SSE-streamed so TTFT is
+    first-chunk wall time off a live socket.
+
+    Emits goodput (requests' completed tokens per wall second —
+    higher-is-better, the gated lane) and TTFT p50/p95/p99 + TPOT p95
+    (named *_overhead_* so history mode gates them lower-is-better).
+    Self-asserts in-lane that every stream finished "stop" and none
+    errored — a latency number from a run that shed or hung streams
+    would gate the wrong thing."""
+    import json as _json
+    import threading
+    import urllib.request
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM, gpt_test_config
+    from paddle_tpu.serving import (ApiServer, EngineConfig, LLMEngine,
+                                    SamplingParams)
+
+    LENS = (4, 6, 8)
+    STAGES = ((4.0, 16), (8.0, 24), (16.0, 32))   # (qps, requests)
+    NEW = 8
+
+    paddle.seed(0)
+    cfg = gpt_test_config(stacked_blocks=True, sequence_parallel=False)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    engine = LLMEngine(model, EngineConfig(block_size=16, max_num_seqs=8))
+    rng = np.random.RandomState(0)
+    # warm every prompt-length's prefill program + the decode/sampler
+    # path BEFORE the clock runs: this lane measures serving, not XLA
+    engine.generate(
+        [rng.randint(0, cfg.vocab_size, (n,)).astype(np.int32)
+         for n in LENS], SamplingParams(max_new_tokens=2))
+    server = ApiServer(engine=engine, poll_s=0.002)
+
+    results, lock = [], threading.Lock()
+
+    def fire(ids):
+        body = _json.dumps({"prompt": ids, "max_tokens": NEW,
+                            "stream": True}).encode()
+        t_start = time.perf_counter()
+        try:
+            resp = urllib.request.urlopen(urllib.request.Request(
+                server.url + "/v1/completions", data=body,
+                headers={"Content-Type": "application/json"}),
+                timeout=120)
+            first = last = None
+            ntok, reason = 0, None
+            for raw in resp:
+                line = raw.decode("utf-8").strip()
+                if not line.startswith("data: ") or line == "data: [DONE]":
+                    continue
+                now = time.perf_counter()
+                choice = _json.loads(line[len("data: "):])["choices"][0]
+                k = len(choice.get("token_ids") or [])
+                if k:
+                    if first is None:
+                        first = now
+                    last = now
+                    ntok += k
+                reason = choice.get("finish_reason") or reason
+            rec = {"ttft": first - t_start, "ntok": ntok,
+                   "reason": reason,
+                   "tpot": ((last - first) / (ntok - 1)
+                            if ntok > 1 else None)}
+        except Exception as e:   # recorded, then failed loudly in-lane
+            rec = {"error": repr(e)}
+        with lock:
+            results.append(rec)
+
+    threads = []
+    t_wall = time.perf_counter()
+    t_next = t_wall
+    for qps, n in STAGES:
+        for _ in range(n):
+            t_next += float(rng.exponential(1.0 / qps))
+            ids = [int(t) for t in rng.randint(
+                0, cfg.vocab_size, (int(LENS[rng.randint(len(LENS))]),))]
+            wait = t_next - time.perf_counter()
+            if wait > 0:
+                time.sleep(wait)
+            th = threading.Thread(target=fire, args=(ids,), daemon=True)
+            th.start()
+            threads.append(th)
+    for th in threads:
+        th.join(timeout=240)
+    wall = time.perf_counter() - t_wall
+    server.stop()
+
+    total = sum(n for _, n in STAGES)
+    assert len(results) == total and all(
+        not th.is_alive() for th in threads), "streams hung"
+    errs = [r for r in results if "error" in r]
+    assert not errs, errs[:3]
+    assert all(r["reason"] == "stop" and r["ntok"] == NEW
+               for r in results), results[:3]
+    ttfts = np.array([r["ttft"] for r in results]) * 1e3
+    tpots = np.array([r["tpot"] for r in results
+                      if r["tpot"] is not None]) * 1e3
+    goodput = (NEW * total) / wall
+    suffix = "" if _on_tpu() else "_cpu_smoke"
+    _emit(f"serving_load_ttft_p50_overhead_ms{suffix}",
+          float(np.percentile(ttfts, 50)), "ms", 20.0)
+    _emit(f"serving_load_ttft_p95_overhead_ms{suffix}",
+          float(np.percentile(ttfts, 95)), "ms", 60.0)
+    _emit(f"serving_load_ttft_p99_overhead_ms{suffix}",
+          float(np.percentile(ttfts, 99)), "ms", 100.0)
+    _emit(f"serving_load_tpot_p95_overhead_ms{suffix}",
+          float(np.percentile(tpots, 95)), "ms", 10.0)
+    return _emit(f"serving_load_goodput_tokens_per_sec{suffix}",
+                 goodput, "tokens/sec", 200.0)
+
+
 LADDER = {
     "gpt124m": bench_gpt124m,
     "resnet50": bench_resnet50,
@@ -1187,6 +1305,7 @@ LADDER = {
     "spec_decode": bench_spec_decode,
     "kernel_count": bench_kernel_count,
     "router_fanout": bench_router_fanout,
+    "serving_load": bench_serving_load,
     "trace_overhead": bench_trace_overhead,
     "hybrid8_memfit": bench_hybrid8_memfit,
 }

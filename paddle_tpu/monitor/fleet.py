@@ -419,6 +419,27 @@ def _series_extreme(parsed: dict, name: str, pick):
     return pick(vals) if vals else None
 
 
+def _tenant_rollup(parsed: dict) -> dict:
+    """``{tenant: {"tokens", "admitted", "shed"}}`` from the replica's
+    ``serving_tenant_*`` labeled counters (ISSUE 19) — the feed's
+    per-tenant block.  Empty when no tenant-labeled traffic has hit the
+    replica (default-pool requests export no tenant series)."""
+    out: dict = {}
+    for metric, field in (("serving_tenant_tokens", "tokens"),
+                          ("serving_tenant_admitted", "admitted"),
+                          ("serving_tenant_shed", "shed")):
+        pm = parsed.get(metric)
+        if not pm:
+            continue
+        for key, val in pm["series"].items():
+            tenant = dict(key).get("tenant")
+            if tenant is None or not isinstance(val, (int, float)):
+                continue
+            out.setdefault(tenant, {"tokens": 0, "admitted": 0,
+                                    "shed": 0})[field] = val
+    return out
+
+
 # ---------------------------------------------------------------------------
 # The aggregator
 # ---------------------------------------------------------------------------
@@ -970,6 +991,10 @@ class FleetAggregator:
                     # aggregator can only declare the (accreted) keys
                     "breaker_state": None,
                     "breaker_trips": None,
+                    # ISSUE 19: per-tenant served/admitted/shed rollup
+                    # for weighted-fair-share dashboards and tenant-
+                    # aware dispatch (accrete-only, like every key)
+                    "tenants": _tenant_rollup(r.parsed),
                 }
         return out
 

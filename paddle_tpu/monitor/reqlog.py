@@ -140,7 +140,8 @@ def event(rid, trace_id=None, arrival_ts=None, prompt_tokens=0,
           generated_tokens=0, queue_wait_s=None, ttft_s=None,
           tpot_avg_s=None, tpot_max_s=None, prefill_chunks=0,
           prefix_hit_tokens=0, spec_proposed=0, spec_accepted=0,
-          preemptions=0, peak_kv_blocks=0, finish_reason="stop") -> dict:
+          preemptions=0, peak_kv_blocks=0, finish_reason="stop",
+          tenant=None, priority=None) -> dict:
     """Build one wide event.  THE canonical builder: its keys are pinned
     to ``wire.REQLOG_EVENT_KEYS`` by the wire-compat rule (and by
     tests/test_reqlog.py), so the schema cannot drift silently.
@@ -168,6 +169,8 @@ def event(rid, trace_id=None, arrival_ts=None, prompt_tokens=0,
         "preemptions": int(preemptions),
         "peak_kv_blocks": int(peak_kv_blocks),
         "finish_reason": finish_reason,
+        "tenant": tenant,
+        "priority": priority,
     }
 
 

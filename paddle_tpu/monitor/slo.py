@@ -66,9 +66,14 @@ _HIST_NAMES = {
     "queue_wait": "serving/queue_wait",
 }
 _FINISH_NAME = "serving/finish_reason"
-# reasons that are NOT errors: a natural finish, and a request migrated
-# to another replica (it finishes — and is judged — over there)
-_GOOD_REASONS = ("stop", "migrated")
+# reasons that are NOT errors: a natural finish; a request migrated to
+# another replica (it finishes — and is judged — over there); a
+# best-effort request deliberately shed by SLO-aware admission control
+# (ISSUE 19 — shedding is the SLO engine working, counting it as an
+# error would double-charge the budget that triggered it); and an
+# HTTP-level client rejection (auth/parse 4xx that never reached the
+# scheduler — the client's fault, not the server's)
+_GOOD_REASONS = ("stop", "migrated", "shed", "rejected")
 
 
 def _env_spec() -> str:

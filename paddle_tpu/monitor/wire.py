@@ -46,7 +46,8 @@ __all__ = ["RPC_FRAME_MIN", "RPC_FRAME_MAX", "HEALTHZ_SCHEMA_VERSION",
            "REQLOG_SCHEMA_VERSION", "REQLOG_EVENT_KEYS",
            "ROUTER_SCHEMA_VERSION", "ROUTER_SUBMIT_KEYS",
            "ROUTER_RESULT_KEYS", "ROUTER_HANDOFF_KEYS",
-           "ROUTER_POLL_KEYS", "ROUTER_METRIC_NAMES"]
+           "ROUTER_POLL_KEYS", "ROUTER_METRIC_NAMES",
+           "API_ERROR_KEYS"]
 
 # rpc wire frame: (fn, args, kwargs[, trace_hdr]) — the legacy 3-tuple
 # is still accepted by every server (PR-9's mid-deploy contract)
@@ -106,6 +107,11 @@ ROUTER_FEED_KEYS = (
     # reading the router feed see WHY a replica takes no traffic.
     "breaker_state",
     "breaker_trips",
+    # ISSUE 19 multi-tenant serving: per-tenant rollup parsed from the
+    # replica's serving/tenant_* labeled series — {tenant: {"tokens",
+    # "admitted", "shed"}}, empty dict when no tenant-labeled traffic
+    # has hit the replica, None for replicas predating the key.
+    "tenants",
 )
 
 # -- wide-event request log (ISSUE 16) --------------------------------------
@@ -115,7 +121,7 @@ ROUTER_FEED_KEYS = (
 # increases — consumers (the cache-aware router's stickiness debugging,
 # log pipelines) key on both.  The canonical builder carries a
 # ``# ptpu-wire: reqlog-event`` anchor and must emit EXACTLY these keys.
-REQLOG_SCHEMA_VERSION = 1
+REQLOG_SCHEMA_VERSION = 2        # v2 (ISSUE 19): + tenant, priority
 
 REQLOG_EVENT_KEYS = (
     "schema_version",
@@ -137,11 +143,18 @@ REQLOG_EVENT_KEYS = (
     "preemptions",
     "peak_kv_blocks",
     # reason vocabulary (accrete-only, like the keys): stop | abort |
-    # deadline | released | migrated — "migrated" (ISSUE 17) marks a
-    # request handed off to another replica (drain requeue, failover
-    # resubmission, prefill→decode disaggregation), NOT a failure;
-    # monitor/slo.py's error_rate counts it good.
+    # deadline | released | migrated | shed | rejected — "migrated"
+    # (ISSUE 17) marks a request handed off to another replica (drain
+    # requeue, failover resubmission, prefill→decode disaggregation),
+    # NOT a failure; "shed" (ISSUE 19) marks best-effort work dropped by
+    # SLO-aware admission control (HTTP 429) and "rejected" an HTTP-level
+    # client error (auth/parse) that never reached the scheduler;
+    # monitor/slo.py's error_rate counts all three good.
     "finish_reason",
+    # ISSUE 19 multi-tenant serving: fair-share tenant (None = default
+    # pool) and priority class (interactive | batch | best-effort).
+    "tenant",
+    "priority",
 )
 
 # -- multi-replica router protocol (ISSUE 17) --------------------------------
@@ -171,7 +184,8 @@ ROUTER_RESULT_KEYS = (
     "replica",          # reporting replica's name
     "ok",               # bool; False => error is set, token_ids is None
     "token_ids",        # [prompt + generated] ints, engine row shape
-    "finish_reason",    # stop | abort | deadline | released | migrated
+    "finish_reason",    # stop | abort | deadline | released | migrated |
+    #                     shed | rejected (ISSUE 19 vocab accretions)
     "error",            # str | None
 )
 
@@ -224,4 +238,19 @@ ROUTER_METRIC_NAMES = (
     "router/breaker_trips",
     "router/breaker_open",
     "router/deadline_inflight",
+)
+
+# -- HTTP API error body (ISSUE 19) ------------------------------------------
+# The ``{"error": {...}}`` inner object every non-2xx response from
+# serving/api.py carries — OpenAI-client-shaped, so off-the-shelf SDKs
+# surface `message`/`type`/`code` without translation.  Accrete-only;
+# the canonical builder in serving/api.py carries a
+# ``# ptpu-wire: api-error`` anchor and must emit EXACTLY these keys.
+API_ERROR_KEYS = (
+    "message",          # human-readable description
+    "type",             # invalid_request_error | authentication_error |
+    #                     not_found_error | rate_limit_error | api_error
+    "code",             # machine key: e.g. "shed" (SLO admission drop),
+    #                     "deadline", "model_not_found", None
+    "param",            # offending request field, or None
 )

@@ -33,6 +33,10 @@ Layers (each its own module, each independently testable):
   prefix-cache-aware sticky routing over N engine replicas, optional
   disaggregated prefill/decode (bit-exact KV handoff), drain/failover;
   `replica.ReplicaWorker` is the engine-owning worker half.
+- `api.ApiServer`        — the OpenAI-compatible HTTP front door
+  (ISSUE 19): /v1/completions + /v1/chat/completions with SSE token
+  streaming, API-key → tenant mapping, deadline propagation and
+  SLO-aware 429 shedding, over a local engine or the router.
 
 The user-facing entry point also hangs off `paddle_tpu.inference`
 (`inference.LLMEngine` etc.), next to the Predictor serving surface.
@@ -44,10 +48,11 @@ from .spec import propose_ngram
 from .engine import EngineConfig, LLMEngine
 from .router import Router, RouterConfig, RpcReplicaClient
 from .replica import ReplicaWorker
+from .api import ApiServer, start_api_server
 
 __all__ = [
-    "BlockAllocatorError", "BlockKVCache", "EngineConfig", "LLMEngine",
-    "ReplicaWorker", "Request", "Router", "RouterConfig",
+    "ApiServer", "BlockAllocatorError", "BlockKVCache", "EngineConfig",
+    "LLMEngine", "ReplicaWorker", "Request", "Router", "RouterConfig",
     "RpcReplicaClient", "SamplingParams", "Scheduler", "SchedulerOutput",
-    "prefix_block_keys", "propose_ngram",
+    "prefix_block_keys", "propose_ngram", "start_api_server",
 ]

@@ -83,9 +83,10 @@ counts step-program cache misses.
 
 Request plane (ISSUE 16): `serving/queue_wait` (arrival → first
 compute, monitor-gated — visible with tracing off) and
-`serving/finish_reason{reason}` (stop/abort/deadline/released/migrated
-— the SLO error_rate numerator; "migrated" = handed off to another
-replica, counted good) land alongside ttft/tpot; at finish the engine
+`serving/finish_reason{reason}` (stop/abort/deadline/released/migrated/
+shed — the SLO error_rate numerator; "migrated" = handed off to another
+replica and "shed" = dropped by SLO admission control, both counted
+good) land alongside ttft/tpot; at finish the engine
 emits ONE wide `monitor.reqlog` event per request (release time), ticks
 `monitor.slo`'s burn-rate engine each step, stamps the request's
 trace_id as a histogram exemplar on its ttft/tpot/queue_wait
@@ -115,7 +116,8 @@ from ..ops.paged_attention import (paged_attention_arrays,
                                    quantized_cache_update_arrays)
 from ..ops.ragged_paged_attention import ragged_paged_attention_arrays
 from .kv_cache import BlockKVCache, prefix_block_keys
-from .scheduler import Request, SamplingParams, Scheduler
+from .scheduler import (Request, SamplingParams, Scheduler, priority_rank,
+                        should_shed, worst_fast_burn)
 from .spec import propose_ngram
 
 __all__ = ["EngineConfig", "LLMEngine"]
@@ -300,7 +302,18 @@ class LLMEngine:
         self._m_finish = m.counter(
             "serving/finish_reason",
             "finished requests by outcome "
-            "(stop|abort|deadline|released|migrated)")
+            "(stop|abort|deadline|released|migrated|shed)")
+        # ISSUE 19 multi-tenant breakdowns: tenant-labeled counters.
+        # Label children materialize only for requests that CARRY a
+        # tenant — default-pool traffic exports zero new series.
+        self._m_tenant_tokens = m.counter(
+            "serving/tenant_tokens", "generated tokens by tenant")
+        self._m_tenant_admitted = m.counter(
+            "serving/tenant_admitted", "requests accepted by tenant")
+        self._m_tenant_shed = m.counter(
+            "serving/tenant_shed",
+            "best-effort requests shed by SLO admission control, "
+            "by tenant")
         self._m_compiles = m.counter("serving/compiles",
                                      "step-program cache misses")
         self._m_attn_impl = m.counter(
@@ -377,6 +390,8 @@ class LLMEngine:
         self._begin_trace(req)
         self._requests[req.req_id] = req
         self.scheduler.add(req)
+        if monitor.enabled() and params.tenant:
+            self._m_tenant_admitted.labels(tenant=params.tenant).inc()
         return req.req_id
 
     def fork_request(self, parent_id, sampling_params=None) -> int:
@@ -528,7 +543,10 @@ class LLMEngine:
         "abort" = released mid-flight, "released" = released while
         still queued (never computed), "migrated" = handed off to
         another replica (drain requeue / failover / disaggregated
-        prefill→decode handoff — a success elsewhere, not an error)."""
+        prefill→decode handoff — a success elsewhere, not an error),
+        "shed" = best-effort work dropped by SLO-aware admission
+        control (ISSUE 19 — deliberate, counted good by the SLO
+        error_rate)."""
         if req.finish_reason is not None:
             return
         req.finish_reason = reason
@@ -544,8 +562,11 @@ class LLMEngine:
             ttft_s=ttft, tpot_avg_s=tpot_avg,
             queue_wait_s=req.queue_wait_s)
         self._end_trace(req, reason, keep=keep)
+        tenant = getattr(req.params, "tenant", None)
         if monitor.enabled():
             self._m_finish.labels(reason=reason).inc()
+            if tenant and gen:
+                self._m_tenant_tokens.labels(tenant=tenant).inc(gen)
         if mreqlog.enabled():
             mreqlog.emit(mreqlog.event(
                 req.req_id,
@@ -563,7 +584,9 @@ class LLMEngine:
                 spec_accepted=req.spec_accepted,
                 preemptions=req.num_preemptions,
                 peak_kv_blocks=req.peak_kv_blocks,
-                finish_reason=reason))
+                finish_reason=reason,
+                tenant=tenant,
+                priority=getattr(req.params, "priority", None)))
 
     def request_trace(self, req_id) -> list:
         """The request's finished spans (start-ordered dicts with
@@ -664,6 +687,31 @@ class LLMEngine:
             self._m_expired.inc()
         return expired
 
+    def _shed_best_effort(self) -> list:
+        """SLO-aware load shedding (ISSUE 19): when the live fast-window
+        burn rate breaches `PTPU_SHED_BURN`, drop every still-WAITING
+        best-effort request with reason "shed" — bounded time instead of
+        queued to death, via the release_request() path so nothing
+        leaks.  Interactive/batch classes are never shed (they defer).
+        Returns the shed ids."""
+        floor = priority_rank("best-effort")
+        cand = [r for r in self._requests.values()
+                if r.state == Request.WAITING and not r.finished
+                and priority_rank(getattr(r.params, "priority", None))
+                >= floor]
+        if not cand or not mslo.enabled():
+            return []
+        burn = worst_fast_burn()
+        shed = [r for r in cand
+                if should_shed(getattr(r.params, "priority", None),
+                               burn=burn)]
+        for r in shed:
+            tenant = getattr(r.params, "tenant", None)
+            self.release_request(r.req_id, reason="shed")
+            if monitor.enabled() and tenant:
+                self._m_tenant_shed.labels(tenant=tenant).inc()
+        return [r.req_id for r in shed]
+
     def step(self) -> list:
         """One scheduler decision + one jitted exec.  Returns the requests
         that FINISHED this step."""
@@ -673,6 +721,7 @@ class LLMEngine:
         # monitor.watchdog post-mortem path is provable in tests
         faults.maybe_stall(site="engine.step")
         self._expire_deadlines()
+        self._shed_best_effort()
         out = self.scheduler.schedule()
         if out.preempted:
             self._m_preempt.inc(len(out.preempted))
@@ -1085,13 +1134,22 @@ class LLMEngine:
         observation carries the request's trace_id so PTPU_EXEMPLARS can
         link a bucket to its kept tail-sampled trace."""
         tid = req.trace.trace_id if req.trace is not None else None
+        # ISSUE 19: tenant-carrying requests ALSO observe into a
+        # tenant-labeled child series; the unlabeled parent observe
+        # stays — it is what slo.Objective's latency percentiles read
+        tenant = getattr(req.params, "tenant", None)
         if req.first_token_t is None:
             req.first_token_t = now
             if req.arrival_t is not None:
-                self._m_ttft.observe(now - req.arrival_t, trace_id=tid)
+                ttft = now - req.arrival_t
+                self._m_ttft.observe(ttft, trace_id=tid)
+                if tenant:
+                    self._m_ttft.labels(tenant=tenant).observe(ttft)
         else:
             gap = now - req.last_token_t
             self._m_tpot.observe(gap, trace_id=tid)
+            if tenant:
+                self._m_tpot.labels(tenant=tenant).observe(gap)
             if req.tpot_max is None or gap > req.tpot_max:
                 req.tpot_max = gap
         req.last_token_t = now

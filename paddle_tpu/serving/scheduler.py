@@ -16,16 +16,102 @@ Per engine step the scheduler picks ONE of:
   preserved) until the rest fit.  Evicting the youngest minimizes wasted
   work — the oldest requests are closest to finishing.
 
+Multi-tenant policy (ISSUE 19): requests carry a `tenant` and a
+`priority` class.  Admission candidates are ordered by (priority class,
+weighted tenant service, arrival) — a deficit-style fair share where every
+prefill chunk and decode slot charges `tokens / weight` against the
+tenant's running total, so a burst tenant's normalized service grows and
+its queued requests yield the admission head to under-served tenants.
+Preemption evicts lowest-priority-youngest first.  With default params
+(no tenant, one priority) every ordering degenerates to the original
+FIFO/youngest policy bit-for-bit.
+
 The scheduler owns request state machines and the block accounting calls;
 it never touches device math — that is `engine.LLMEngine`'s half.
 """
 from __future__ import annotations
 
 import dataclasses
+import os
 from collections import deque
 from typing import Optional
 
-__all__ = ["SamplingParams", "Request", "Scheduler", "SchedulerOutput"]
+__all__ = ["SamplingParams", "Request", "Scheduler", "SchedulerOutput",
+           "PRIORITIES", "priority_rank", "tenant_weights", "should_shed",
+           "worst_fast_burn"]
+
+# Priority classes, best first.  Admission prefers lower rank; eviction
+# victimizes higher rank.  Unknown strings rank with "best-effort" so a
+# typo'd class degrades service instead of jumping the queue.
+PRIORITIES = ("interactive", "batch", "best-effort")
+_PRIORITY_RANK = {name: i for i, name in enumerate(PRIORITIES)}
+
+# Shed threshold: best-effort traffic is shed once the worst fast-window
+# SLO burn rate reaches this multiple of budget burn (2.0 = burning error
+# budget at twice the sustainable rate).
+_SHED_DEFAULT_BURN = 2.0
+
+
+def priority_rank(priority) -> int:
+    """Rank of a priority class — lower is better; unknown ranks worst."""
+    return _PRIORITY_RANK.get(priority, len(PRIORITIES) - 1)
+
+
+def tenant_weights(spec: Optional[str] = None) -> dict:
+    """Parse a ``name:weight,name:weight`` spec (default: the
+    ``PTPU_TENANT_WEIGHTS`` env var).  Unlisted tenants weigh 1.0; zero,
+    negative, or malformed weights are dropped rather than raising — a
+    bad env var must not take the serving loop down."""
+    if spec is None:
+        spec = os.environ.get("PTPU_TENANT_WEIGHTS", "")
+    out: dict = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, raw = part.partition(":")
+        try:
+            weight = float(raw) if raw else 1.0
+        except ValueError:
+            continue
+        if name.strip() and weight > 0:
+            out[name.strip()] = weight
+    return out
+
+
+def worst_fast_burn(report=None) -> float:
+    """Worst fast-window burn rate across all SLO objectives, 0.0 when
+    the SLO engine is off (shedding never triggers without live SLOs)."""
+    if report is None:
+        from ..monitor import slo as mslo
+        report = mslo.report()
+    if not report or not report.get("enabled"):
+        return 0.0
+    worst = 0.0
+    for obj in report.get("objectives", ()):
+        rate = (obj.get("burn_rate") or {}).get("fast")
+        if rate is not None:
+            worst = max(worst, float(rate))
+    return worst
+
+
+def should_shed(priority, burn: Optional[float] = None) -> bool:
+    """SLO-aware admission control: shed `priority`-class work right now?
+
+    Only "best-effort" is ever shed — interactive and batch classes defer
+    (stay queued) rather than drop.  The decision input is the worst
+    fast-window burn rate from the live `monitor.slo` engine (injectable
+    via `burn` for tests), against the `PTPU_SHED_BURN` threshold."""
+    if priority_rank(priority) < priority_rank("best-effort"):
+        return False
+    if burn is None:
+        burn = worst_fast_burn()
+    try:
+        threshold = float(os.environ.get("PTPU_SHED_BURN",
+                                         _SHED_DEFAULT_BURN))
+    except ValueError:
+        threshold = _SHED_DEFAULT_BURN
+    return burn >= threshold
 
 
 @dataclasses.dataclass
@@ -45,6 +131,13 @@ class SamplingParams:
     # None = no deadline).  Not a sampling knob, so absent from the dense
     # generate() oracle surface.
     deadline_s: Optional[float] = None
+    # -- multi-tenant scheduling (ISSUE 19) --------------------------------
+    # Tenant for weighted fair-share accounting (None = the shared default
+    # pool) and priority class ("interactive" | "batch" | "best-effort").
+    # Router-wire-safe: params_from_wire drops fields older peers don't
+    # declare, so mixed-version fleets fall back to default-pool FIFO.
+    tenant: Optional[str] = None
+    priority: str = "interactive"
 
 
 class Request:
@@ -81,8 +174,8 @@ class Request:
         self.peak_kv_blocks = 0            # high-water KV blocks held
         self.spec_proposed = 0             # draft tokens proposed (this req)
         self.spec_accepted = 0             # draft tokens accepted (this req)
-        self.finish_reason = None          # stop|abort|deadline|released,
-        #                                    set exactly once at finish
+        self.finish_reason = None          # stop|abort|deadline|released|
+        #                                    shed, set exactly once at finish
 
     # -- derived ------------------------------------------------------------
 
@@ -129,10 +222,17 @@ class SchedulerOutput:
 
 class Scheduler:
     def __init__(self, cache, max_num_seqs=8, max_num_batched_tokens=2048,
-                 spec_tokens=0, max_model_len=None):
+                 spec_tokens=0, max_model_len=None, weights=None):
         self.cache = cache
         self.max_num_seqs = int(max_num_seqs)
         self.max_num_batched_tokens = int(max_num_batched_tokens)
+        # deficit-style weighted fair share (ISSUE 19): normalized service
+        # per tenant (tokens / weight), charged at prefill-chunk emission
+        # and per decode slot.  `weights` overrides the env knob for
+        # tests; None = PTPU_TENANT_WEIGHTS.
+        self.tenant_weights = (dict(weights) if weights is not None
+                               else tenant_weights())
+        self.tenant_served: dict = {}
         # speculative decoding (ISSUE 15): a decode step may write up to
         # `spec_tokens` draft positions past each row's last token, so
         # the decode branch reserves blocks for that extent up front (the
@@ -175,6 +275,42 @@ class Scheduler:
     def has_work(self) -> bool:
         return bool(self.waiting or self.running)
 
+    # -- multi-tenant fair share (ISSUE 19) --------------------------------
+
+    @staticmethod
+    def _tenant_of(req) -> str:
+        return getattr(req.params, "tenant", None) or "default"
+
+    def _charge(self, req, tokens: int) -> None:
+        """Charge `tokens` of service against the request's tenant,
+        normalized by its configured weight — a weight-3 tenant pays a
+        third of the fair-share price per token, so it sustains 3x the
+        throughput before yielding the admission head."""
+        if tokens <= 0:
+            return
+        tenant = self._tenant_of(req)
+        weight = self.tenant_weights.get(tenant, 1.0)
+        self.tenant_served[tenant] = (self._served_of(tenant)
+                                      + tokens / weight)
+
+    def _served_of(self, tenant) -> float:
+        got = self.tenant_served.get(tenant)
+        if got is None:
+            # a never-seen tenant starts at the current minimum, not 0 —
+            # starting from zero would let a late joiner monopolize
+            # admission until it "caught up" with incumbents' history
+            got = min(self.tenant_served.values(), default=0.0)
+        return got
+
+    def _admission_key(self, req):
+        """Candidate ordering for admission: priority class first, then
+        least normalized tenant service, then arrival.  With default
+        params every key collapses to (0, served, arrival) with `served`
+        shared by all — exact FIFO."""
+        return (priority_rank(getattr(req.params, "priority", None)),
+                self._served_of(self._tenant_of(req)),
+                req.arrival)
+
     # -- the policy ---------------------------------------------------------
 
     def schedule(self) -> SchedulerOutput:
@@ -192,17 +328,21 @@ class Scheduler:
             return SchedulerOutput(kind="idle", preempted=tuple(preempted))
         # 2) admit / resume from the waiting queue (no eviction on behalf
         #    of admission — preemption exists to keep RUNNING work
-        #    progressing, not to thrash between queued requests).  FIFO
-        #    head first; when the head is blocked and NOTHING is running,
-        #    any other schedulable entry (e.g. a forked child already
-        #    holding shared blocks whose completion will free them) is
-        #    tried before declaring the pool too small.
+        #    progressing, not to thrash between queued requests).  The
+        #    admission head is the best (priority, fair-share, arrival)
+        #    candidate — plain FIFO when every request carries defaults —
+        #    and the deque itself is never reordered; when the head is
+        #    blocked and NOTHING is running, any other schedulable entry
+        #    (e.g. a forked child already holding shared blocks whose
+        #    completion will free them) is tried before declaring the
+        #    pool too small.
         if self.waiting and len(self.running) < self.max_num_seqs:
-            got = self._admit_or_resume(self.waiting[0], preempted)
+            order = sorted(self.waiting, key=self._admission_key)
+            got = self._admit_or_resume(order[0], preempted)
             if isinstance(got, SchedulerOutput):
                 return got
             if got is None and not self.running:
-                for req in list(self.waiting)[1:]:
+                for req in order[1:]:
                     got = self._admit_or_resume(req, preempted)
                     if isinstance(got, SchedulerOutput):
                         return got
@@ -246,6 +386,8 @@ class Scheduler:
             # table is gone, so it must not reach the engine
             rows = [r for r in rows if r.state == Request.RUNNING]
             if rows:
+                for r in rows:       # one decode slot = one token served
+                    self._charge(r, 1)
                 return SchedulerOutput(kind="decode",
                                        decode_requests=tuple(rows),
                                        preempted=tuple(preempted))
@@ -312,6 +454,7 @@ class Scheduler:
             self.cache.allocate(req.req_id, target)
         req.state = Request.RUNNING
         self.running.append(req)
+        self._charge(req, chunk)
         return SchedulerOutput(kind="prefill", prefill_request=req,
                                chunk_start=start, chunk_len=chunk,
                                preempted=tuple(preempted))
@@ -320,6 +463,7 @@ class Scheduler:
         start = req.num_computed
         chunk = min(req.prompt_len - start, self.max_num_batched_tokens)
         self.cache.grow_to(req.req_id, start + chunk)
+        self._charge(req, chunk)
         return SchedulerOutput(
             kind="prefill", prefill_request=req, chunk_start=start,
             chunk_len=chunk, preempted=tuple(preempted))
@@ -361,12 +505,15 @@ class Scheduler:
         return True
 
     def _pick_victim(self, exclude=None):
-        # youngest ARRIVAL, not list position: swap-ins re-append resumed
-        # (older) requests at the tail, so list order is not age order
+        # lowest priority class first, then youngest ARRIVAL — not list
+        # position: swap-ins re-append resumed (older) requests at the
+        # tail, so list order is not age order.  One priority class in
+        # play reduces this to the original youngest-arrival pick.
         victims = [r for r in self.running if r is not exclude]
         if not victims:
             return None
-        return max(victims, key=lambda r: r.arrival)
+        return max(victims, key=lambda r: (
+            priority_rank(getattr(r.params, "priority", None)), r.arrival))
 
     def _evict(self, req, preempted) -> None:
         req.swap = self.cache.swap_out(req.req_id)

@@ -1,0 +1,325 @@
+"""API chaos smoke (ISSUE 19 acceptance, the fault half): the HTTP
+front door under injected faults, proving that every HTTP stream
+completes, errors cleanly, or fails over — never hangs.
+
+Two legs:
+
+  stall leg     ApiServer over a LOCAL engine; a
+                ``stall@site=engine.step,secs=8`` fault wedges the pump
+                mid-request.  A streamed request with ``deadline_s=1``
+                must be answered 504 (error code "deadline") inside the
+                deadline + grace budget — BEFORE the 8 s wedge ends —
+                and the server must serve normally again after the
+                stall burns out.
+
+  failover leg  ApiServer over a Router fronting TWO real replica
+                worker processes; the replica holding the stream is
+                SIGKILLed mid-decode (``ckpt_crash@site=replica.step``
+                armed over the fleet store, the chaos_smoke.py
+                pattern).  The HTTP stream must still COMPLETE, token-
+                identical to the single-process reference engine, via
+                the router's resubmit-from-prompt failover.
+
+Runnable anywhere (CPU included):
+
+    JAX_PLATFORMS=cpu PTPU_MONITOR=1 python scripts/api_smoke.py
+
+Run by tests/test_api.py::test_api_smoke_script (slow tier —
+engine-compiling subprocesses don't fit the fast-tier budget).
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault("PTPU_FORCE_PLATFORM", "cpu")   # drop on a TPU host
+os.environ.setdefault("PTPU_MONITOR", "1")
+
+REPLICAS = ("r0", "r1")
+WORLD = 1 + len(REPLICAS)     # router (rank 0) + replicas
+BS = 16
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _post(url, body, timeout=240):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def _sse_tokens(resp):
+    """Full-body SSE parse -> (token_ids, final finish_reason)."""
+    toks, reason = [], None
+    for event in resp.read().decode("utf-8").split("\n\n"):
+        if not event.startswith("data: ") or event == "data: [DONE]":
+            continue
+        choice = json.loads(event[len("data: "):])["choices"][0]
+        toks.extend(choice.get("token_ids") or [])
+        reason = choice.get("finish_reason") or reason
+    return toks, reason
+
+
+def _deadline_wait(what, pred, deadline_s=420.0, poll_s=0.05):
+    t0 = time.monotonic()
+    while True:
+        out = pred()
+        if out:
+            return out
+        if time.monotonic() - t0 > deadline_s:
+            raise TimeoutError(f"timed out waiting for {what}")
+        time.sleep(poll_s)
+
+
+# ---------------------------------------------------------------------------
+# replica process (the chaos_smoke.py worker, trimmed to arm_kill/exit)
+# ---------------------------------------------------------------------------
+
+def replica_main(idx: int, store_addr: str):
+    import paddle_tpu as paddle
+    from paddle_tpu import monitor
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.models import GPTForCausalLM, gpt_test_config
+    from paddle_tpu.monitor import fleet
+    from paddle_tpu.resilience import faults
+    from paddle_tpu.serving import EngineConfig, LLMEngine, ReplicaWorker
+    from paddle_tpu.serving import replica as replica_mod
+
+    name = REPLICAS[idx]
+    paddle.seed(0)   # same weights everywhere: failover is only
+    #                  token-identical across replicas of one model
+    cfg = gpt_test_config(stacked_blocks=True, sequence_parallel=False)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    engine = LLMEngine(model, EngineConfig(block_size=BS, max_num_seqs=4))
+    worker = replica_mod.install(ReplicaWorker(engine, name=name))
+
+    monitor.start_server(0)   # self-registers under PTPU_FLEET_STORE
+    host, port = store_addr.rsplit(":", 1)
+    rpc.init_rpc(name, rank=idx + 1, world_size=WORLD,
+                 master_endpoint=store_addr)
+    cli = fleet._StoreClient(host, int(port))
+    cli.set(f"fleet/ready/{name}", b"1")
+    print(f"replica {name}: ready", flush=True)
+
+    applied = b""
+    while True:
+        busy = worker.pump()
+        cmd = cli.get(f"fleet/cmd/{name}", timeout_ms=1 if busy else 100)
+        if cmd and cmd != applied:
+            applied = cmd
+            if cmd == b"exit":
+                return
+            if cmd == b"arm_kill":
+                faults.set_plan(faults.FaultPlan(
+                    "ckpt_crash@site=replica.step,hard=1"))
+                print(f"replica {name}: kill armed", flush=True)
+            cli.set(f"fleet/ack/{name}", cmd)
+        if not busy:
+            time.sleep(0.01)
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def check_stall_leg(model, cfg):
+    """A wedged pump must never wedge a client: deadline + grace bounds
+    the answer, and the server recovers once the stall burns out."""
+    import numpy as np
+
+    from paddle_tpu.resilience import faults
+    from paddle_tpu.serving import ApiServer, EngineConfig, LLMEngine
+
+    engine = LLMEngine(model, EngineConfig(block_size=BS, max_num_seqs=4))
+    server = ApiServer(engine=engine)
+    try:
+        rng = np.random.RandomState(3)
+        ids = [int(t) for t in rng.randint(0, cfg.vocab_size, (10,))]
+        # warm the compile cache through the pump (no deadline: the
+        # default budget absorbs CPU compile time)
+        warm = json.loads(_post(server.url + "/v1/completions",
+                                {"prompt": ids, "max_tokens": 8}).read())
+        assert warm["choices"][0]["finish_reason"] == "stop", warm
+
+        faults.set_plan(faults.FaultPlan(
+            "stall@site=engine.step,secs=8,times=1"))
+        t0 = time.monotonic()
+        try:
+            _post(server.url + "/v1/completions",
+                  {"prompt": ids, "max_tokens": 8, "deadline_s": 1.0,
+                   "stream": True}, timeout=60).read()
+            raise AssertionError("stalled stream must not complete")
+        except urllib.error.HTTPError as e:
+            took = time.monotonic() - t0
+            assert e.code == 504, e.code
+            doc = json.loads(e.read())
+            assert doc["error"]["code"] == "deadline", doc
+        assert took < 7.5, (
+            f"deadline bound must beat the 8 s wedge, took {took:.2f}s")
+        # recovery: the same server serves normally post-stall (this
+        # request queues behind the wedge and completes once it ends)
+        after = json.loads(_post(server.url + "/v1/completions",
+                                 {"prompt": ids, "max_tokens": 8},
+                                 timeout=60).read())
+        assert after["choices"][0]["finish_reason"] == "stop", after
+        print(f"stall leg: 8 s engine wedge -> 504 code=deadline in "
+              f"{took:.2f}s (deadline 1 s + grace), server recovered",
+              flush=True)
+    finally:
+        faults.set_plan(None)
+        server.stop()
+    return engine   # reused as the token-parity reference
+
+
+def main():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.models import GPTForCausalLM, gpt_test_config
+    from paddle_tpu.monitor import fleet
+    from paddle_tpu.serving import (ApiServer, Router, RouterConfig,
+                                    RpcReplicaClient, SamplingParams)
+
+    paddle.seed(0)
+    cfg = gpt_test_config(stacked_blocks=True, sequence_parallel=False)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+
+    # -- leg 1: stall behind a local-engine ApiServer -------------------
+    ref = check_stall_leg(model, cfg)
+
+    # -- leg 2: mid-stream SIGKILL behind a router-mode ApiServer -------
+    store_port = _free_port()
+    store_addr = f"127.0.0.1:{store_port}"
+    procs = []
+    for idx, name in enumerate(REPLICAS):
+        env = dict(os.environ, PTPU_REPLICA_ID=name,
+                   PTPU_FLEET_STORE=store_addr, PTPU_MONITOR="1")
+        env.pop("PTPU_FAULTS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--replica",
+             str(idx), "--store", store_addr], env=env))
+    server = None
+    try:
+        rpc.init_rpc("router", rank=0, world_size=WORLD,
+                     master_endpoint=store_addr)
+        cli = fleet._StoreClient("127.0.0.1", store_port)
+        for name in REPLICAS:
+            _deadline_wait(f"replica {name} ready",
+                           lambda n=name: cli.get(f"fleet/ready/{n}",
+                                                  timeout_ms=500) == b"1")
+        agg = fleet.FleetAggregator(store=store_addr, interval=0.25,
+                                    stall_after_s=5.0, down_after=4)
+        _deadline_wait("all replicas healthy", lambda: (
+            lambda s: set(s) == set(REPLICAS)
+            and set(s.values()) == {"healthy"})(agg.poll_once()))
+        router = Router(
+            [RpcReplicaClient(n, timeout=5.0) for n in REPLICAS],
+            agg.snapshot,
+            RouterConfig(sticky=False, block_size=BS,
+                         breaker_threshold=2, breaker_cooldown_s=0.5,
+                         deadline_grace_s=0.25))
+
+        def prompt(seed):
+            r = np.random.RandomState(seed)
+            return r.randint(0, cfg.vocab_size, (10,)).astype(np.int32)
+
+        # baseline wave DIRECTLY on the router (the pump doesn't own it
+        # yet): warms every replica's compile cache before the stall
+        # detector starts, and proves both replicas serve
+        base = [prompt(200 + i) for i in range(4)]
+        base_sp = [SamplingParams(max_new_tokens=8)] * 4
+        rids = [router.submit(p, sp) for p, sp in zip(base, base_sp)]
+        homes = set()
+        for rid in rids:
+            res = router.wait(rid, timeout=240.0)
+            assert res["ok"], res
+            homes.add(res["replica"])
+            router.release(rid)
+        assert homes == set(REPLICAS), (
+            f"baseline must warm every replica, got {homes}")
+        agg.start()
+
+        # the HTTP tier takes the router over; the driver only READS
+        # router state (inflight map, metrics) from here on
+        server = ApiServer(router=router, poll_s=0.01)
+        kill_prompt = prompt(210)
+        want = [int(t) for t in ref.generate(
+            [kill_prompt], [SamplingParams(max_new_tokens=48)])[0][10:]]
+
+        got = {}
+
+        def poster():
+            try:
+                got["toks"], got["reason"] = _sse_tokens(_post(
+                    server.url + "/v1/completions",
+                    {"prompt": [int(t) for t in kill_prompt],
+                     "max_tokens": 48, "stream": True}, timeout=240))
+            except Exception as e:                  # surfaced below
+                got["error"] = repr(e)
+
+        fo0 = router._m["router/failovers"].value
+        th = threading.Thread(target=poster, daemon=True)
+        th.start()
+        victim = _deadline_wait(
+            "stream in flight on a replica",
+            lambda: next((n for n in REPLICAS
+                          if router._inflight.get(n, 0) > 0), None),
+            deadline_s=60.0, poll_s=0.002)
+        cli.set(f"fleet/cmd/{victim}", b"arm_kill")   # SIGKILL mid-decode
+        th.join(timeout=240)
+        assert not th.is_alive(), "HTTP stream hung past the kill"
+        assert "error" not in got, got
+        assert got["reason"] == "stop" and got["toks"] == want, (
+            got, want)
+        vproc = procs[REPLICAS.index(victim)]
+        assert vproc.wait(timeout=30) == -9, f"{victim} must be SIGKILLed"
+        assert router._m["router/failovers"].value > fo0, (
+            "the stream must have failed over, not finished on the victim")
+        _deadline_wait(f"feed rolls {victim} up as down",
+                       lambda: agg.snapshot()[victim]["state"] == "down",
+                       deadline_s=60.0, poll_s=0.25)
+        print(f"failover leg: {victim} SIGKILLed mid-stream; the HTTP "
+              f"stream completed token-identical on the survivor "
+              f"(48 tokens, finish=stop)", flush=True)
+
+        for name in REPLICAS:
+            if name != victim:
+                cli.set(f"fleet/cmd/{name}", b"exit")
+        agg.stop()
+        print("API SMOKE OK", flush=True)
+    finally:
+        if server is not None:
+            server.stop()
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+if __name__ == "__main__":
+    if "--replica" in sys.argv:
+        argv = sys.argv[1:]
+        replica_main(int(argv[argv.index("--replica") + 1]),
+                     argv[argv.index("--store") + 1])
+    else:
+        main()

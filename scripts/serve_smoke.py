@@ -46,6 +46,18 @@ are live, and `perf.hlo_report("decode:step")` names the compiled
 decode program's top fusions with flops/bytes (degrading to
 'unavailable' on backends without `as_text`, never garbage).
 
+API mode (the ISSUE-19 OpenAI-compatible front door end-to-end):
+
+    python scripts/serve_smoke.py --api
+
+--api boots `serving.api.ApiServer` over the same engine and asserts
+the ISSUE-19 acceptance: a streamed /v1/completions over a real
+socket is token-identical to `engine.generate()` (greedy AND
+fixed-seed sampled), per-tenant `serving_tenant_*{tenant=...}` series
+ride the live /metrics endpoint, and under an injected SLO burn a
+best-effort request is refused with HTTP 429 + error code "shed"
+while an interactive one still completes.
+
 tests/test_serving.py runs the plain mode, tests/test_lowbit.py the
 quantized one, tests/test_trace.py + test_perf.py lean on the combined
 --trace --perf invocation (all fast tier), so each is a "does the
@@ -100,6 +112,10 @@ def main():
                          "(deadline reqlog event, kept tail-sampled "
                          "trace, ttft exemplar, live + fleet-merged "
                          "slo/burn_rate)")
+    ap.add_argument("--api", action="store_true",
+                    help="assert the ISSUE-19 API surface (streamed "
+                         "/v1/completions token-identical to generate(), "
+                         "tenant-labeled metrics, 429 shed under burn)")
     args = ap.parse_args()
 
     monitor.refresh()
@@ -158,7 +174,7 @@ def main():
     # (the ISSUE-12 kernels_per_step FLAT assertion needs 5 live rows)
     engine = LLMEngine(model, EngineConfig(
         block_size=16, max_num_seqs=8, kv_cache_dtype=args.kv_cache_dtype,
-        metrics_port=0 if (args.trace or args.slo) else None))
+        metrics_port=0 if (args.trace or args.slo or args.api) else None))
     if args.kv_cache_dtype:
         fp = LLMEngine(model, EngineConfig(block_size=16, max_num_seqs=8))
         ratio = engine.cache.num_blocks / fp.cache.num_blocks
@@ -199,9 +215,11 @@ def main():
         check_perf(engine, snap, cfg)
     if args.slo:   # before check_trace: that leg stops the endpoint
         check_slo(engine, cfg)
+    if args.api:   # ditto — needs the live /metrics endpoint
+        check_api(engine, cfg)
     if args.trace:
         check_trace(engine, snap, len(prompts))
-    elif args.slo:
+    elif args.slo or args.api:
         monitor.stop_server()
     if args.prefix_cache or args.spec:
         check_prefix_spec(model, cfg, prefix=args.prefix_cache,
@@ -517,6 +535,121 @@ def check_slo(engine, cfg):
     print(f"fleet: slo_max_burn_rate={rec['slo_max_burn_rate']:.1f} "
           f"budget_remaining={rec['slo_min_budget_remaining']:.2f} "
           f"(feed), exemplars federated")
+
+
+def check_api(engine, cfg):
+    """ISSUE 19 acceptance: a streamed /v1/completions over a real socket
+    is token-identical to `engine.generate()` (greedy AND fixed-seed
+    sampled), per-tenant serving_tenant_* series ride the live /metrics
+    endpoint, and under an injected SLO burn a best-effort request is
+    refused with HTTP 429 + error code "shed" while an interactive one
+    on the same socket still completes."""
+    import json
+    import urllib.error
+    import urllib.request
+    from paddle_tpu.monitor import slo as mslo
+    from paddle_tpu.serving import ApiServer
+
+    # references from the same engine, BEFORE the server owns it (the
+    # pump thread is the engine's only driver once it starts): prompt
+    # lengths reuse the main run's compiled prefill shapes
+    rng = np.random.RandomState(11)
+    p_greedy = rng.randint(0, cfg.vocab_size, (4,)).astype(np.int32)
+    p_seeded = rng.randint(0, cfg.vocab_size, (6,)).astype(np.int32)
+    ref_greedy = engine.generate([p_greedy],
+                                 SamplingParams(max_new_tokens=6))[0]
+    ref_seeded = engine.generate([p_seeded], SamplingParams(
+        max_new_tokens=6, do_sample=True, temperature=0.8, seed=123))[0]
+
+    # an SLO engine every real request burns (ttft threshold below any
+    # achievable first-token latency), primed pre-server for the same
+    # single-driver reason: once it reports fast burn >= PTPU_SHED_BURN
+    # the admission gate must shed best-effort and only best-effort
+    mslo.install(mslo.SloEngine("ttft_p95<0.0001", min_interval=0.0))
+    mslo.report()   # baseline sample: burn measures what comes next
+    engine.generate([p_greedy], SamplingParams(max_new_tokens=2))
+    from paddle_tpu.serving.scheduler import worst_fast_burn
+    burn = worst_fast_burn()
+    assert burn >= 2.0, f"injected burn did not register ({burn})"
+
+    server = ApiServer(engine=engine,
+                       api_keys={"sk-acme": ("acme", "interactive"),
+                                 "sk-free": ("free", "best-effort")})
+    try:
+        def post(body, key="sk-acme"):
+            req = urllib.request.Request(
+                server.url + "/v1/completions",
+                data=json.dumps(body).encode(),
+                headers={"Authorization": "Bearer " + key,
+                         "Content-Type": "application/json"})
+            return urllib.request.urlopen(req, timeout=60)
+
+        def sse_tokens(resp):
+            toks, reason = [], None
+            for raw in resp:
+                line = raw.decode("utf-8").strip()
+                if not line.startswith("data: "):
+                    continue
+                payload = line[len("data: "):]
+                if payload == "[DONE]":
+                    break
+                choice = json.loads(payload)["choices"][0]
+                toks.extend(choice.get("token_ids") or [])
+                reason = choice.get("finish_reason") or reason
+            return toks, reason
+
+        # (a) greedy streamed completion == generate(), token for token
+        toks, reason = sse_tokens(post(
+            {"prompt": [int(t) for t in p_greedy], "max_tokens": 6,
+             "stream": True}))
+        want = [int(t) for t in ref_greedy[len(p_greedy):]]
+        assert toks == want and reason == "stop", (toks, want, reason)
+        # (b) fixed-seed sampled streamed completion == generate()
+        toks2, reason2 = sse_tokens(post(
+            {"prompt": [int(t) for t in p_seeded], "max_tokens": 6,
+             "stream": True, "temperature": 0.8, "seed": 123}))
+        want2 = [int(t) for t in ref_seeded[len(p_seeded):]]
+        assert toks2 == want2 and reason2 == "stop", (toks2, want2, reason2)
+        print(f"api: streamed /v1/completions token-identical to "
+              f"generate() (greedy {toks}, seeded {toks2})")
+
+        # (c) the tenant dimension on the live /metrics endpoint
+        txt = urllib.request.urlopen(
+            engine.metrics_server.url + "/metrics", timeout=10
+        ).read().decode()
+        for want_line in ('serving_tenant_admitted{tenant="acme"}',
+                          'serving_tenant_tokens{tenant="acme"}',
+                          'serving_ttft_bucket{'):
+            assert want_line in txt, want_line
+        assert 'tenant="acme"' in "".join(
+            ln for ln in txt.splitlines()
+            if ln.startswith("serving_ttft_bucket{")), (
+            "no tenant-labeled ttft observation")
+        print("api: serving_tenant_* series live on /metrics "
+              "(tenant=acme admitted + tokens + labeled ttft)")
+
+        # (d) shed: best-effort under burn -> 429 + code "shed";
+        # interactive under the SAME burn -> 200 and completes
+        try:
+            post({"prompt": [int(t) for t in p_greedy], "max_tokens": 2},
+                 key="sk-free")
+            raise AssertionError("best-effort request was not shed")
+        except urllib.error.HTTPError as e:
+            assert e.code == 429, e.code
+            assert e.headers.get("Retry-After"), "429 must set Retry-After"
+            doc = json.loads(e.read())
+            assert doc["error"]["code"] == "shed", doc
+        ok = json.loads(post({"prompt": [int(t) for t in p_greedy],
+                              "max_tokens": 2}).read())
+        assert ok["choices"][0]["finish_reason"] == "stop", ok
+        shed_txt = urllib.request.urlopen(
+            engine.metrics_server.url + "/metrics", timeout=10
+        ).read().decode()
+        assert 'serving_tenant_shed{tenant="free"}' in shed_txt
+        print("api: best-effort shed with 429 code=shed under burn "
+              "(interactive still served)")
+    finally:
+        server.stop()
 
 
 def check_trace(engine, snap, n_requests):

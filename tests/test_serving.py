@@ -427,11 +427,13 @@ class TestMonitorAndSmoke:
         # token per decode step, compiles FLAT across hit/miss and
         # spec rounds), and --slo: the ISSUE-16 one (deadline request
         # traceable reqlog -> kept trace -> exemplar -> burn rate on
-        # replica and fleet) all assert in-script ON TOP of the plain
-        # smoke checks, so ONE subprocess covers every leg (tests/test_trace
-        # .py and tests/test_perf.py lean on this invocation; tier-1
-        # budget leaves no room for a second engine-compiling
-        # subprocess)
+        # replica and fleet), and --api: the ISSUE-19 one (socket-streamed
+        # /v1/completions token-identical to generate() greedy AND
+        # seeded, tenant-labeled metrics on /metrics, 429 shed under
+        # burn) all assert in-script ON TOP of the plain smoke checks,
+        # so ONE subprocess covers every leg (tests/test_trace.py and
+        # tests/test_perf.py lean on this invocation; tier-1 budget
+        # leaves no room for a second engine-compiling subprocess)
         script = (pathlib.Path(__file__).resolve().parent.parent
                   / "scripts" / "serve_smoke.py")
         env = {k: v for k, v in os.environ.items()
@@ -441,7 +443,7 @@ class TestMonitorAndSmoke:
         env["PTPU_MONITOR"] = "1"
         proc = subprocess.run([sys.executable, str(script), "--trace",
                                "--perf", "--prefix-cache", "--spec",
-                               "--slo"],
+                               "--slo", "--api"],
                               env=env, capture_output=True, text=True,
                               timeout=560)
         assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
@@ -461,6 +463,10 @@ class TestMonitorAndSmoke:
         assert "finish=deadline" in proc.stdout
         assert "worst fast burn" in proc.stdout
         assert "exemplars federated" in proc.stdout
+        # ISSUE 19 --api leg: streamed parity, tenant metrics, shed 429
+        assert "token-identical to generate()" in proc.stdout
+        assert "serving_tenant_* series live" in proc.stdout
+        assert "best-effort shed with 429 code=shed" in proc.stdout
 
 
 class TestPagedAttentionOp:

@@ -49,18 +49,21 @@ REGISTRY_NAMES = {"RPC_FRAME_MIN", "RPC_FRAME_MAX",
                   "REQLOG_SCHEMA_VERSION", "REQLOG_EVENT_KEYS",
                   "ROUTER_SCHEMA_VERSION", "ROUTER_SUBMIT_KEYS",
                   "ROUTER_RESULT_KEYS", "ROUTER_HANDOFF_KEYS",
-                  "ROUTER_POLL_KEYS", "ROUTER_METRIC_NAMES"}
+                  "ROUTER_POLL_KEYS", "ROUTER_METRIC_NAMES",
+                  "API_ERROR_KEYS"}
 # anchored dict literals: each anchor comment pins the dict's string
 # keys to one declared key tuple (ISSUE 16 added the reqlog event to
 # the router feed's original contract; ISSUE 17 the router↔replica
-# frames and the router metric-name set)
+# frames and the router metric-name set; ISSUE 19 the HTTP API error
+# body)
 ANCHORED_KEYS = {"ptpu-wire: router-feed": "ROUTER_FEED_KEYS",
                  "ptpu-wire: reqlog-event": "REQLOG_EVENT_KEYS",
                  "ptpu-wire: router-submit": "ROUTER_SUBMIT_KEYS",
                  "ptpu-wire: router-result": "ROUTER_RESULT_KEYS",
                  "ptpu-wire: router-handoff": "ROUTER_HANDOFF_KEYS",
                  "ptpu-wire: router-poll": "ROUTER_POLL_KEYS",
-                 "ptpu-wire: router-metrics": "ROUTER_METRIC_NAMES"}
+                 "ptpu-wire: router-metrics": "ROUTER_METRIC_NAMES",
+                 "ptpu-wire: api-error": "API_ERROR_KEYS"}
 
 
 def _module_literals(ctx):

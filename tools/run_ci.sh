@@ -77,7 +77,11 @@ case "${1:-fast}" in
     # handoff, mid-stream SIGKILL failover, all token-identical) and
     # tests/test_chaos.py::test_chaos_smoke_script runs
     # scripts/chaos_smoke.py (ISSUE 18 acceptance — the seeded
-    # network-fault schedule, same as the `chaos` lane below)
+    # network-fault schedule, same as the `chaos` lane below) and
+    # tests/test_api.py::test_api_smoke_script runs scripts/api_smoke.py
+    # (ISSUE 19 acceptance — replica stall behind the API -> 504 inside
+    # the deadline, and mid-stream SIGKILL -> failover with the stream
+    # finishing token-identical; streams never hang)
     python -m pytest tests/ -q
     ;;
   chaos)
@@ -114,6 +118,10 @@ EOF
     # whole-generate walls drift >50% on shared hosts)
     python bench.py --config prefix_prefill
     python bench.py --config spec_decode
+    # ISSUE 19 API front-door lane: seeded open-loop arrivals at rising
+    # QPS through a live ApiServer socket — goodput gates higher-is-
+    # better, the *_overhead_* TTFT/TPOT percentiles gate lower-is-better
+    python bench.py --config serving_load
     # real-lane history gate: default 7% tolerance, smoke lines skipped
     # (on a chip host the headline is the non-smoke metric and gates;
     # after an outage fallback the smoke line is reported only)
