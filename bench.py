@@ -899,7 +899,15 @@ def bench_trace_overhead():
     to the chaos choke points: the rpc transport consults the net-fault
     plan at dial, send and recv on EVERY call, so all three
     ``faults.net_fire`` probes ride the per-step sequence; with
-    PTPU_FAULTS unset each is one module-global read returning None):
+    PTPU_FAULTS unset each is one module-global read returning None —
+    and ISSUE 20 to the memory-microscope hooks: the KV block-lifecycle
+    counters ride the allocator hot paths unconditionally (disabled cost
+    = one module-global read per event), and with PTPU_MEMOBS on the
+    engine step adds one HBM/host timeline sample (TTL-cached RSS), the
+    eviction-storm EWMA observe, and the interval-limited /kv snapshot
+    publish fast path; the snapshot build itself runs at most 2Hz and
+    the pressure forensics only on the failure path, so neither belongs
+    in the per-step tax):
     what the
     monitor+trace+perf layers add to a train step, off vs on, asserting
     disabled overhead < 1% and enabled overhead < 5% of the step.  "Enabled" means monitor+trace; PTPU_PERF stays off in both
@@ -960,6 +968,16 @@ def bench_trace_overhead():
     # ISSUE 16: the engine's __init__-cached latency histogram, observed
     # with the exemplar-stamping signature every step
     m_lat = monitor.histogram("bench/ttft")
+    # ISSUE 20 memory-microscope per-step objects, constructed once like
+    # BlockKVCache/LLMEngine construct theirs: the lifecycle-event
+    # ledger, the storm detector, and a real (tiny) pool for the
+    # interval-amortized /kv snapshot build
+    mmem = monitor.memory
+    acct = mmem.KVAccounting()
+    storm = mmem.StormDetector()
+    kv_pool = __import__(
+        "paddle_tpu.serving.kv_cache", fromlist=["BlockKVCache"]
+    ).BlockKVCache(1, 8, 4, 1, 2)
 
     def instr(i):
         # exactly what one instrumented step adds on top of the math:
@@ -1020,6 +1038,27 @@ def bench_trace_overhead():
                 # _record_latency signature; stamps only with
                 # PTPU_EXEMPLARS on, kwarg-pass + gate read otherwise)
                 m_lat.observe(1e-4, trace_id="bench-trace")
+            # ISSUE 20 memory-microscope per-step sequence.  The block-
+            # lifecycle counters ride the cache hot paths unconditionally
+            # (the gate is inside KVAccounting.on), so their disabled
+            # cost — one module-global read each — belongs in BOTH
+            # bounds; a decode step touches the allocator at most a few
+            # times (one alloc per block boundary per row), so two
+            # events is the conservative per-step charge.  With
+            # PTPU_MEMOBS on, the engine additionally takes one timeline
+            # sample (host RSS is TTL-cached: a dict read most steps),
+            # feeds the eviction-storm EWMA, and offers the /kv snapshot
+            # publish (interval-limited to 2Hz: one monotonic read on
+            # the fast path; the O(num_blocks) build amortizes outside
+            # the per-step tax)
+            acct.on("alloc")
+            acct.on("free")
+            if mmem.enabled():
+                mmem.sample(hbm_peak=None, hbm_in_use=1 << 20,
+                            host_rss=mmem.host_rss_bytes())
+                storm.observe(0)
+                mmem.maybe_publish_kv(
+                    lambda: mmem.build_kv_snapshot(kv_pool, []))
             # ISSUE 16 engine-step hooks: slo tick + reqlog emit gate
             # (one module-global read each when off); with reqlog on,
             # the release-time wide-event build+emit charged every step
@@ -1048,6 +1087,7 @@ def bench_trace_overhead():
     prev_perf = mperf.enabled()
     prev_rl, prev_ex = mreqlog.enabled(), monitor.exemplars_enabled()
     prev_tail = mtrace.tail_budget()
+    prev_mem = mmem.enabled()
     try:
         mperf.enable(False)   # perf is a synced diagnostic mode: its
         # disabled cost gates here, its enabled cost is the point of it
@@ -1056,9 +1096,12 @@ def bench_trace_overhead():
         mreqlog.enable(False)
         monitor.enable_exemplars(False)
         mtrace.set_tail_budget(None)
+        mmem.enable(False)
         c_off = min(per_call(20_000) for _ in range(3))
         monitor.enable(True)
         mtrace.enable(True)
+        # ISSUE 20: the memory microscope rides the enabled measurement
+        mmem.enable(True)
         # ISSUE 16 wings on: ring-only reqlog, exemplar stamping, and a
         # zero tail budget (every boring root pays the keep decision AND
         # the drop — the most expensive sampling path)
@@ -1073,7 +1116,9 @@ def bench_trace_overhead():
         mreqlog.enable(prev_rl)
         monitor.enable_exemplars(prev_ex)
         mtrace.set_tail_budget(prev_tail)
+        mmem.enable(prev_mem)
         mreqlog.reset()
+        mmem.reset()
     off_pct = c_off / t_step * 100.0
     on_pct = c_on / t_step * 100.0
     assert off_pct < 1.0, (

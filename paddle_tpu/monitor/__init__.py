@@ -782,12 +782,12 @@ def STAT_RESET(name):
 # that mode the v2 submodules — equally stdlib-only — are simply absent.
 try:
     from . import trace, flight, serve, perf, fleet, hlo, train  # noqa: E402,F401
-    from . import reqlog, slo                     # noqa: E402,F401
+    from . import reqlog, slo, memory             # noqa: E402,F401
     from .flight import watchdog                  # noqa: E402,F401
     from .serve import start_server, stop_server  # noqa: E402,F401
 
     __all__ += ["trace", "flight", "serve", "perf", "fleet", "hlo",
-                "train", "reqlog", "slo", "watchdog", "start_server",
-                "stop_server"]
+                "train", "reqlog", "slo", "memory", "watchdog",
+                "start_server", "stop_server"]
 except ImportError:   # standalone module load — core registry only
     pass

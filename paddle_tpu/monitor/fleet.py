@@ -440,6 +440,24 @@ def _tenant_rollup(parsed: dict) -> dict:
     return out
 
 
+def _tenant_kv_rollup(parsed: dict) -> dict:
+    """``{tenant: kv_blocks_held}`` from the replica's
+    ``serving_kv_blocks_held`` labeled gauge (ISSUE 20 memory
+    microscope) — who holds the pool right now.  Empty when the replica
+    exports no tenant-labeled KV series (PTPU_MEMOBS off, or only
+    default-pool traffic)."""
+    out: dict = {}
+    pm = parsed.get("serving_kv_blocks_held")
+    if not pm:
+        return out
+    for key, val in pm["series"].items():
+        tenant = dict(key).get("tenant")
+        if tenant is None or not isinstance(val, (int, float)):
+            continue
+        out[tenant] = val
+    return out
+
+
 # ---------------------------------------------------------------------------
 # The aggregator
 # ---------------------------------------------------------------------------
@@ -995,6 +1013,16 @@ class FleetAggregator:
                     # for weighted-fair-share dashboards and tenant-
                     # aware dispatch (accrete-only, like every key)
                     "tenants": _tenant_rollup(r.parsed),
+                    # ISSUE 20 memory microscope: KV-pool pressure for
+                    # capacity-aware routing (accrete-only; None for
+                    # replicas predating them or with PTPU_MEMOBS off)
+                    "kv_blocks_in_use": series_value(
+                        r.parsed, "serving_blocks_in_use"),
+                    "kv_block_utilization": series_value(
+                        r.parsed, "serving_block_utilization"),
+                    "kv_pressure_dumps": series_value(
+                        r.parsed, "memory_pressure_dumps"),
+                    "tenant_kv_blocks": _tenant_kv_rollup(r.parsed),
                 }
         return out
 

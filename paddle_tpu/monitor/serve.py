@@ -19,6 +19,13 @@ the process's metrics and traces while it runs:
   event per finished serving request;
 - ``GET /slo``           — the SLO burn-rate report (``monitor/slo.py``):
   per-objective fast/slow-window burn rates and remaining error budget;
+- ``GET /kv``            — the memory microscope's KV pool map
+  (``monitor/memory.py``, ISSUE 20): block counts, fragmentation,
+  refcount histogram, lifecycle-event ledger and ranked holders.  The
+  handler reads the last snapshot the engine *published*, never live
+  engine state — no engine lock from this daemon thread;
+- ``GET /memory/timeline`` — the bounded HBM/host memory timeline ring
+  (monotonic ts, hbm_peak, hbm_in_use, host_rss per reading);
 - ``GET /profile?secs=N`` — on-demand device profiling (ISSUE 12): runs
   a ``jax.profiler`` trace capture for N seconds (default 1, clamped to
   120) and returns the dump directory as a zip (perfetto/tensorboard-
@@ -307,11 +314,24 @@ class _Handler(BaseHTTPRequestHandler):
             from . import slo
 
             self._send(200, json.dumps(slo.report()), "application/json")
+        elif path == "/kv":
+            # the memory microscope's pool map (ISSUE 20).  Reads the
+            # last PUBLISHED snapshot slot only — this daemon thread
+            # never touches the engine lock or walks live pool state
+            from . import memory
+
+            self._send(200, json.dumps(memory.kv_report()),
+                       "application/json")
+        elif path == "/memory/timeline":
+            from . import memory
+
+            self._send(200, json.dumps(memory.timeline_report()),
+                       "application/json")
         elif path == "/":
             extra = " ".join(sorted(routes)) + " " if routes else ""
             self._send(200, "paddle_tpu monitor: /metrics /healthz "
                             "/traces/<id> /flight/latest "
-                            "/requests/recent /slo "
+                            "/requests/recent /slo /kv /memory/timeline "
                             f"/profile?secs=N {extra}\n",
                        "text/plain; charset=utf-8")
         else:

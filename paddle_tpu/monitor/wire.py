@@ -112,6 +112,16 @@ ROUTER_FEED_KEYS = (
     # "admitted", "shed"}}, empty dict when no tenant-labeled traffic
     # has hit the replica, None for replicas predating the key.
     "tenants",
+    # ISSUE 20 memory microscope: KV-pool pressure signals for capacity-
+    # aware routing — live blocks in use, pool utilization (0..1),
+    # cumulative kv_pressure flight dumps written (a rising value means
+    # the replica is thrashing), and {tenant: blocks_held} parsed from
+    # the serving/kv_blocks_held labeled gauge.  None for replicas
+    # predating them (or running with PTPU_MEMOBS off).
+    "kv_blocks_in_use",
+    "kv_block_utilization",
+    "kv_pressure_dumps",
+    "tenant_kv_blocks",
 )
 
 # -- wide-event request log (ISSUE 16) --------------------------------------

@@ -109,6 +109,7 @@ from ..monitor import trace as mtrace
 from ..monitor import perf as mperf
 from ..monitor import reqlog as mreqlog
 from ..monitor import slo as mslo
+from ..monitor import memory as mmem
 from ..resilience import faults
 from ..resilience.retry import Deadline
 from ..ops.paged_attention import (paged_attention_arrays,
@@ -345,6 +346,26 @@ class LLMEngine:
         self._m_spec_rate = m.gauge(
             "serving/spec_accept_rate",
             "cumulative accepted/proposed draft-token ratio")
+        # ISSUE 20 memory microscope: free/parked gauges fed from the
+        # cache's ONE counts() source (satellite: blocks_in_use /
+        # block_utilization / the admission view can no longer drift),
+        # tenant-labeled capacity attribution (children materialize
+        # only for tenant-carrying requests, like the other tenant
+        # metrics), and the per-step memobs state (PTPU_MEMOBS-gated)
+        self._m_kv_free = m.gauge(
+            "serving/kv_free_blocks",
+            "truly free KV blocks (free list only, parked excluded)")
+        self._m_kv_parked = m.gauge(
+            "serving/kv_parked_blocks",
+            "LRU-parked prefix blocks (adoptable AND reclaimable)")
+        self._m_tenant_kv = m.gauge(
+            "serving/kv_blocks_held", "KV blocks held, by tenant")
+        self._m_tenant_kv_peak = m.gauge(
+            "serving/kv_blocks_peak_share",
+            "peak fraction of the KV pool held, by tenant")
+        self._tenant_kv_peak: dict = {}
+        self._storm = mmem.StormDetector()
+        self._memobs_prev = {"evict": 0, "swap_in": 0}
         self._spec_proposed_total = 0
         self._spec_accepted_total = 0
         self._wall_s_total = 0.0
@@ -722,7 +743,15 @@ class LLMEngine:
         faults.maybe_stall(site="engine.step")
         self._expire_deadlines()
         self._shed_best_effort()
-        out = self.scheduler.schedule()
+        try:
+            out = self.scheduler.schedule()
+        except RuntimeError as e:
+            # ISSUE 20 pressure forensics: an admission failure ("KV
+            # cache too small") leaves a kv_pressure flight dump naming
+            # who actually holds the pool, then propagates untouched
+            if "KV cache too small" in str(e):
+                self._kv_pressure("admission_failure", error=str(e))
+            raise
         if out.preempted:
             self._m_preempt.inc(len(out.preempted))
             for r in out.preempted:
@@ -774,10 +803,86 @@ class LLMEngine:
                                   if r.state == Request.WAITING))
             self._m_running.set(len(sched.running))
             self._m_waiting.set(len(sched.waiting))
-            self._m_blocks.set(self.cache.blocks_in_use)
-            self._m_util.set(self.cache.blocks_in_use
-                             / max(self.cache.num_blocks, 1))
+            # ISSUE 20: every capacity gauge reads the cache's ONE
+            # counts() source — utilization and the admission view
+            # (free+parked) can no longer be computed in two places
+            c = self.cache.counts()
+            self._m_blocks.set(c["in_use"])
+            self._m_util.set(c["in_use"] / max(c["total"], 1))
+            self._m_kv_free.set(c["free"])
+            self._m_kv_parked.set(c["parked"])
+        if mmem.enabled():
+            self._memobs_step(out)
         return list(done)
+
+    # -- memory microscope (ISSUE 20; PTPU_MEMOBS-gated) --------------------
+
+    def _memobs_step(self, out) -> None:
+        """Per-step memory-microscope sampling: one HBM/host timeline
+        reading, tenant-labeled capacity attribution, the eviction-
+        storm/swap-thrash detector, and the interval-limited /kv pool-
+        map publication.  Everything here is host-side dict walking —
+        the sequence is charged in bench.py --config trace_overhead
+        and must stay inside the <5%-enabled budget."""
+        cache = self.cache
+        c = cache.counts()
+        # (b) timeline: compiled-program HBM peak (perf capture; None
+        # with perf off), live KV-pool bytes, host RSS (TTL-cached)
+        peak = None
+        for rec in mperf.records():
+            pk = rec.peak_bytes
+            if pk and (peak is None or pk > peak):
+                peak = pk
+        mmem.sample(hbm_peak=peak,
+                    hbm_in_use=c["in_use"] * cache.bytes_per_block,
+                    host_rss=mmem.host_rss_bytes())
+        # (d) per-tenant capacity attribution (held now + peak share)
+        total = max(c["total"], 1)
+        for r in self._requests.values():
+            tenant = getattr(r.params, "tenant", None)
+            if not tenant:
+                continue
+            t = cache._tables.get(r.req_id)
+            if not t:
+                continue
+            held = self._tenant_kv_peak.setdefault(tenant, [0, 0.0])
+            held[0] += len(t)
+        for tenant, held in self._tenant_kv_peak.items():
+            blocks, peak_share = held
+            self._m_tenant_kv.labels(tenant=tenant).set(blocks)
+            share = blocks / total
+            if share > peak_share:
+                held[1] = share
+                self._m_tenant_kv_peak.labels(tenant=tenant).set(share)
+            held[0] = 0   # re-summed next step
+        # (c) storm / swap-thrash detector: preemptions this step plus
+        # parked-block evictions and swap-ins since the last step
+        ev = cache.acct.events
+        x = (len(out.preempted)
+             + (ev["evict"] - self._memobs_prev["evict"])
+             + (ev["swap_in"] - self._memobs_prev["swap_in"]))
+        self._memobs_prev["evict"] = ev["evict"]
+        self._memobs_prev["swap_in"] = ev["swap_in"]
+        fire = self._storm.observe(x)
+        if fire is not None:
+            self._kv_pressure("eviction_storm", **fire)
+        # (a) the /kv pool map — rebuilt at most every
+        # KV_PUBLISH_INTERVAL_S (the fast path is one monotonic read)
+        mmem.maybe_publish_kv(lambda: mmem.build_kv_snapshot(
+            cache, list(self._requests.values())))
+
+    def _kv_pressure(self, trigger: str, **info) -> "str | None":
+        """Write one rate-limited, replica-tagged ``kv_pressure``
+        flight dump naming the ranked pool holders, and refresh the
+        published /kv map so the endpoint agrees with the forensics."""
+        if not mmem.enabled():
+            return None
+        requests = list(self._requests.values())
+        mmem.publish_kv(mmem.build_kv_snapshot(self.cache, requests))
+        extra = {"holders": mmem.rank_holders(self.cache, requests),
+                 "counts": self.cache.counts()}
+        extra.update(info)
+        return mmem.reporter().maybe_dump(trigger, extra=extra)
 
     # -- step bodies --------------------------------------------------------
 

@@ -62,7 +62,15 @@ nothing below changes behaviour):
   (`blocks_in_use` includes them — they hold live, reusable bytes).
 - observability: `serving/prefix_hits` / `prefix_hit_tokens` /
   `prefix_evictions` counters (monitor-gated no-ops when PTPU_MONITOR
-  is off) plus the plain-int twins on the instance.
+  is off) plus the plain-int twins on the instance.  The memory
+  microscope (ISSUE 20) adds a per-pool lifecycle ledger
+  (``self.acct``, `monitor.memory.KVAccounting`): every transition —
+  alloc/free/fork/cow/park/adopt/evict/swap_out/swap_in — counts under
+  ``serving/kv_blocks{event}``, parked blocks carry their park
+  timestamp (the residency-age forensics), and every capacity view
+  (`num_free_blocks` / `num_parked_blocks` / `blocks_in_use` /
+  `utilization`) derives from the ONE `counts()` source so the
+  utilization gauge and the admission budget can never drift apart.
 
 **Speculative-decode rollback** (`truncate_to`): the verify step
 reserves blocks for up to k draft positions; rejected drafts roll the
@@ -85,12 +93,14 @@ from __future__ import annotations
 
 import hashlib
 import struct
+import time
 from collections import OrderedDict
 
 import numpy as np
 import jax.numpy as jnp
 
 from .. import monitor
+from ..monitor import memory as mmemory
 
 __all__ = ["BlockKVCache", "BlockAllocatorError", "prefix_block_keys"]
 
@@ -161,10 +171,21 @@ class BlockKVCache:
         self._tables: dict = {}        # seq_id -> [physical ids]
         self._lengths: dict = {}       # seq_id -> token count covered
         self.peak_blocks_in_use = 0
+        # ISSUE 20 memory microscope: per-pool lifecycle ledger
+        # (serving/kv_blocks{event} + parked-residency histogram) — one
+        # module-global check per hook when PTPU_MEMOBS is off
+        self.acct = mmemory.KVAccounting()
         # -- prefix cache (ISSUE 15; inert until register_prefix) ----------
         self._prefix_index: dict = {}  # chain key (bytes) -> physical id
         self._block_key: dict = {}     # physical id -> chain key
-        self._lru: "OrderedDict" = OrderedDict()   # parked ids, LRU first
+        self._chain_of: dict = {}      # physical id -> chain id (the
+        #                                register_prefix registration it
+        #                                was indexed under — groups the
+        #                                /kv "parked chains" view)
+        self._lru: "OrderedDict" = OrderedDict()   # parked id ->
+        #                                monotonic park timestamp, LRU
+        #                                first (the timestamp feeds the
+        #                                residency-age forensics)
         self.prefix_hits = 0
         self.prefix_hit_tokens = 0
         self.prefix_evictions = 0
@@ -211,25 +232,52 @@ class BlockKVCache:
         authoritative value at decode time.)"""
         return self.num_blocks * self.block_size
 
+    def counts(self) -> dict:
+        """The ONE accounting source every capacity view derives from
+        (ISSUE 20 satellite: the utilization gauge and the admission-
+        capacity view were computed in two places and could drift).
+        Invariants: ``free + in_use == total`` and
+        ``allocatable == free + parked`` — parked prefix blocks are
+        allocatable (reclaimed last by `_take`) but IN-USE for the
+        utilization view (they hold live, reusable bytes)."""
+        free = len(self._free)
+        parked = len(self._lru)
+        return {
+            "total": self.num_blocks,
+            "free": free,
+            "parked": parked,
+            "allocatable": free + parked,
+            "in_use": self.num_blocks - free,
+            "referenced": self.num_blocks - free - parked,
+            "peak_in_use": self.peak_blocks_in_use,
+        }
+
     @property
     def num_free_blocks(self) -> int:
         """ALLOCATABLE blocks: truly free plus LRU-parked prefix blocks
         (parked blocks are reclaimed — last — by `_take`), the number
         admission decisions budget against."""
-        return len(self._free) + len(self._lru)
+        return self.counts()["allocatable"]
 
     @property
     def num_parked_blocks(self) -> int:
         """Unreferenced blocks held by the prefix index (adoptable AND
         reclaimable)."""
-        return len(self._lru)
+        return self.counts()["parked"]
 
     @property
     def blocks_in_use(self) -> int:
         """Blocks holding live bytes — referenced OR parked.  Parked
         prefix blocks are deliberately counted in-use: the utilization
         gauges must not report reusable-cache bytes as free capacity."""
-        return self.num_blocks - len(self._free)
+        return self.counts()["in_use"]
+
+    @property
+    def utilization(self) -> float:
+        """`serving/block_utilization`'s value, derived from the same
+        `counts()` source as every other capacity view."""
+        c = self.counts()
+        return c["in_use"] / max(c["total"], 1)
 
     def block_table(self, seq_id):
         return list(self._tables[seq_id])
@@ -261,15 +309,20 @@ class BlockKVCache:
         elif self._lru:
             # reclaimed LAST, least-recently-used first: the parked block
             # stops being adoptable the moment its bytes are handed out
-            i, _ = self._lru.popitem(last=False)
+            i, parked_ts = self._lru.popitem(last=False)
             self._drop_index(i)
             self.prefix_evictions += 1
             self._m_evict.inc()
+            self.acct.on("evict")
+            if parked_ts is not None:
+                self.acct.observe_residency(
+                    max(0.0, time.monotonic() - parked_ts))
         else:
             raise BlockAllocatorError("out of KV blocks")
         blk = self._blocks[i]
         assert blk.ref == 0, f"free list handed out a referenced block {i}"
         blk.ref = 1
+        self.acct.on("alloc")
         self.peak_blocks_in_use = max(self.peak_blocks_in_use,
                                       self.blocks_in_use)
         return i
@@ -281,15 +334,18 @@ class BlockKVCache:
         if blk.ref == 0:
             if idx in self._block_key:
                 # indexed prefix block: park (content stays adoptable)
-                self._lru[idx] = None
+                self._lru[idx] = time.monotonic()
                 self._lru.move_to_end(idx)
+                self.acct.on("park")
             else:
                 self._free.append(idx)
+                self.acct.on("free")
 
     def _drop_index(self, idx) -> None:
         key = self._block_key.pop(idx, None)
         if key is not None:
             self._prefix_index.pop(key, None)
+        self._chain_of.pop(idx, None)
 
     def _needs_cow(self, seq_id, num_tokens) -> bool:
         """Will growing to `num_tokens` write into a SHARED partially-
@@ -368,6 +424,7 @@ class BlockKVCache:
             self._blocks[idx].ref += 1
         self._tables[child_id] = list(t)
         self._lengths[child_id] = self._lengths[parent_id]
+        self.acct.on("fork", len(t))
 
     def _reset_scales(self, ids):
         """Zero the quant scales of freshly (re)allocated blocks — a
@@ -399,6 +456,7 @@ class BlockKVCache:
         self._copy_block(src, dst)
         t[-1] = dst
         self._release(src)
+        self.acct.on("cow")
 
     # -- automatic prefix caching (ISSUE 15) --------------------------------
 
@@ -411,6 +469,11 @@ class BlockKVCache:
         pointing at the original block (dedup, not re-pointing)."""
         t = self._tables[seq_id]
         full = min(len(keys), int(num_tokens) // self.block_size, len(t))
+        # chain id: the chain's FIRST key names the whole registration
+        # (stable across re-registrations — first writer wins below), so
+        # the /kv pool map can group parked blocks back into the prompt
+        # chain they came from (ISSUE 20)
+        chain = keys[0].hex()[:12] if full else None
         for j in range(full):
             key = keys[j]
             if key in self._prefix_index:
@@ -420,6 +483,7 @@ class BlockKVCache:
                 continue   # already indexed under another chain
             self._prefix_index[key] = idx
             self._block_key[idx] = key
+            self._chain_of[idx] = chain
 
     def match_prefix(self, keys, max_blocks=None) -> int:
         """Longest indexed prefix of `keys`, in blocks.  Walks the chain
@@ -465,6 +529,7 @@ class BlockKVCache:
         self._tables[seq_id] = ids
         hit_tokens = len(ids) * self.block_size
         self._lengths[seq_id] = hit_tokens
+        self.acct.on("adopt", len(ids))
         self.peak_blocks_in_use = max(self.peak_blocks_in_use,
                                       self.blocks_in_use)
         if ids:
@@ -503,6 +568,7 @@ class BlockKVCache:
             # bit-stable across evict/restore
             saved["ks"] = [np.asarray(s[idx]) for s in self.k_scales]
             saved["vs"] = [np.asarray(s[idx]) for s in self.v_scales]
+        self.acct.on("swap_out", len(t))
         self.free(seq_id)
         return saved
 
@@ -511,6 +577,7 @@ class BlockKVCache:
         n = len(saved["k"][0])
         if n > self.num_free_blocks:
             raise BlockAllocatorError("out of KV blocks")
+        self.acct.on("swap_in", n)
         self._tables[seq_id] = [self._take() for _ in range(n)]
         self._lengths[seq_id] = saved["len"]
         idx = jnp.asarray(self._tables[seq_id], jnp.int32)

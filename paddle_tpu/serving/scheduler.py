@@ -245,6 +245,11 @@ class Scheduler:
         self.waiting: deque = deque()
         self.running: list = []
         self._arrival = 0
+        # ISSUE 20 memory microscope: plain-int pressure ledger the
+        # engine's eviction-storm detector reads per-step deltas of
+        # (always counted — two int adds per rare event, no gate)
+        self.num_evictions = 0
+        self.num_swap_ins = 0
 
     def _decode_reserve_len(self, req) -> int:
         """Token coverage the decode step needs for `req`: total_len (the
@@ -405,6 +410,7 @@ class Scheduler:
             req.swap = None
             req.state = Request.RUNNING
             self.running.append(req)
+            self.num_swap_ins += 1
             return True
         start = req.num_computed    # >0 only for forked children, which
         #                             already hold (shared) prefix blocks.
@@ -521,6 +527,7 @@ class Scheduler:
         self.running.remove(req)
         self.waiting.appendleft(req)             # keeps arrival order
         preempted.append(req)
+        self.num_evictions += 1
 
     # -- completion ---------------------------------------------------------
 

@@ -69,6 +69,15 @@ run profile_endpoint_decode 900 python scripts/profile_capture.py \
 run profile_endpoint_resnet 1200 python scripts/profile_capture.py \
   --config resnet50 --secs 5 --out /tmp/harvest5/profiles
 run kernel_count 900 python bench.py --config kernel_count
+# ISSUE 20 memory microscope: on-chip HBM/host timeline + /kv pool map
+# under real serving pressure.  PTPU_PERF makes the timeline's hbm_peak
+# column real (XLA memory_analysis per program) instead of null; the
+# smoke's --memobs leg logs the /kv ledger, timeline depth/rss, and the
+# storm-triggered kv_pressure dump summary, and re-charges the
+# enabled-path trace_overhead budget on TPU
+run memory_timeline 900 env PTPU_MEMOBS=1 python scripts/serve_smoke.py \
+  --perf --prefix-cache --memobs
+run memobs_overhead 900 python bench.py --config trace_overhead
 run memfit67b 2400 python scripts/memfit67b_tpu.py
 for b in 128 256; do
   for fmt in NHWC NCHW; do

@@ -58,6 +58,19 @@ ride the live /metrics endpoint, and under an injected SLO burn a
 best-effort request is refused with HTTP 429 + error code "shed"
 while an interactive one still completes.
 
+Memobs mode (the ISSUE-20 memory microscope end-to-end):
+
+    python scripts/serve_smoke.py --memobs
+
+--memobs enables PTPU_MEMOBS-style block-lifecycle accounting and
+asserts the ISSUE-20 acceptance: the /kv pool map and /memory/timeline
+ring answer on the live endpoint, a tiny-pool twin engine driven into
+an eviction storm produces EXACTLY ONE rate-limited kv_pressure flight
+dump whose ranked holders name the actual top block-holding
+request/tenant, an admission failure inside the cooldown is suppressed
+(never a second dump), and compiles + kernels_per_step stay FLAT under
+both pressure events.
+
 tests/test_serving.py runs the plain mode, tests/test_lowbit.py the
 quantized one, tests/test_trace.py + test_perf.py lean on the combined
 --trace --perf invocation (all fast tier), so each is a "does the
@@ -116,6 +129,11 @@ def main():
                     help="assert the ISSUE-19 API surface (streamed "
                          "/v1/completions token-identical to generate(), "
                          "tenant-labeled metrics, 429 shed under burn)")
+    ap.add_argument("--memobs", action="store_true",
+                    help="assert the ISSUE-20 memory-microscope surface "
+                         "(lifecycle ledger, /kv + /memory/timeline, one "
+                         "rate-limited kv_pressure dump naming the top "
+                         "holder, compiles FLAT under pressure)")
     args = ap.parse_args()
 
     monitor.refresh()
@@ -138,6 +156,14 @@ def main():
         monitor.trace.set_tail_budget(0)
         mslo.install(mslo.SloEngine("ttft_p95<0.0001;error_rate<0.05",
                                     min_interval=0.0))
+    if args.memobs:
+        # the memory microscope, flipped on the way PTPU_MEMOBS would,
+        # with a throwaway flight dir for the kv_pressure forensics
+        import tempfile
+
+        os.environ["PTPU_FLIGHT_DIR"] = tempfile.mkdtemp(
+            prefix="ptpu_memobs_flight_")
+        monitor.memory.enable(True)
     paddle.seed(0)
     cfg = gpt_test_config(stacked_blocks=True, sequence_parallel=False)
     model = GPTForCausalLM(cfg)
@@ -174,7 +200,8 @@ def main():
     # (the ISSUE-12 kernels_per_step FLAT assertion needs 5 live rows)
     engine = LLMEngine(model, EngineConfig(
         block_size=16, max_num_seqs=8, kv_cache_dtype=args.kv_cache_dtype,
-        metrics_port=0 if (args.trace or args.slo or args.api) else None))
+        metrics_port=0 if (args.trace or args.slo or args.api
+                           or args.memobs) else None))
     if args.kv_cache_dtype:
         fp = LLMEngine(model, EngineConfig(block_size=16, max_num_seqs=8))
         ratio = engine.cache.num_blocks / fp.cache.num_blocks
@@ -217,9 +244,11 @@ def main():
         check_slo(engine, cfg)
     if args.api:   # ditto — needs the live /metrics endpoint
         check_api(engine, cfg)
+    if args.memobs:   # ditto — needs /kv + /memory/timeline live
+        check_memobs(engine, model, cfg)
     if args.trace:
         check_trace(engine, snap, len(prompts))
-    elif args.slo or args.api:
+    elif args.slo or args.api or args.memobs:
         monitor.stop_server()
     if args.prefix_cache or args.spec:
         check_prefix_spec(model, cfg, prefix=args.prefix_cache,
@@ -650,6 +679,126 @@ def check_api(engine, cfg):
               "(interactive still served)")
     finally:
         server.stop()
+
+
+def check_memobs(engine, model, cfg):
+    """ISSUE 20 acceptance: the memory microscope end to end — the main
+    run populated the block-lifecycle ledger, the published /kv pool map
+    and the /memory/timeline ring on the live endpoint; then a tiny-pool
+    twin engine (same compiled shapes) is driven into an eviction storm
+    with live holders, which must produce EXACTLY ONE rate-limited
+    kv_pressure flight dump whose ranked holders name the actual top
+    block-holding request/tenant; an admission failure inside the
+    cooldown is a suppressed trigger, never a second dump — with zero
+    fresh compiles and kernels_per_step FLAT throughout (neither
+    pressure path reaches prefill on a new shape)."""
+    import glob
+    import json
+    import urllib.request
+    from paddle_tpu.serving import EngineConfig, LLMEngine, SamplingParams
+
+    # (a) the shared engine's main run already drove the microscope
+    ev = engine.cache.acct.events
+    assert ev["alloc"] > 0 and ev["free"] > 0, ev
+    srv = engine.metrics_server
+    kv = json.loads(urllib.request.urlopen(
+        srv.url + "/kv", timeout=10).read())
+    assert kv["enabled"] and kv["snapshot"], kv
+    pool = kv["snapshot"]
+    assert pool["free"] + pool["in_use"] == pool["num_blocks"], pool
+    assert pool["events"]["alloc"] > 0, pool
+    tl = json.loads(urllib.request.urlopen(
+        srv.url + "/memory/timeline", timeout=10).read())
+    assert tl["enabled"] and tl["n"] > 0, tl
+    last = tl["readings"][-1]
+    assert last["host_rss"] and last["host_rss"] > 0, last
+    assert last["ts"] >= tl["readings"][0]["ts"], tl["readings"]
+    print(f"memobs: /kv pool map live ({pool['num_blocks']} blocks, "
+          f"ledger alloc={pool['events']['alloc']}), /memory/timeline "
+          f"n={tl['n']} (rss={last['host_rss'] >> 20}MiB)")
+
+    # (b) pressure forensics on a tiny-pool twin (same block_size /
+    # max_num_seqs as the shared engine, so every program is already
+    # compiled).  Four same-length requests fill the 4-block pool one
+    # block each; ~12 quiet decode steps build the storm detector's
+    # zero baseline; then every row crosses into its second block on
+    # the SAME step — the pool can only re-home two, so two rows are
+    # preempted at once: an eviction storm.  The dump must name the
+    # oldest surviving holder (tenant acme).
+    eng = LLMEngine(model, EngineConfig(
+        block_size=16, num_blocks=4, max_num_seqs=8))
+    rng = np.random.RandomState(21)
+    prompts = [rng.randint(0, cfg.vocab_size, (4,)).astype(np.int32)
+               for _ in range(4)]
+    rids = [eng.add_request(p, SamplingParams(
+        max_new_tokens=16, tenant="acme" if i == 0 else "hog"))
+        for i, p in enumerate(prompts)]
+    try:
+        for _ in range(10):   # 4 prefills + quiet decode: the twin's
+            eng.step()        # own program cache compiles HERE, pre-
+            # baseline, and the storm detector banks >= 8 zero-eviction
+            # observations
+        snap_ = monitor.snapshot()
+        compiles0 = sum(snap_["serving/compiles"].values())
+        kern0 = snap_.get("serving/kernels_per_step")
+        steps = 10
+        while eng.has_unfinished() and steps < 300:
+            eng.step()
+            steps += 1
+        assert not eng.has_unfinished(), f"no drain in {steps}"
+    finally:
+        for r in rids:
+            eng.release_request(r)
+    snap_ = monitor.snapshot()
+    assert snap_.get("memory/eviction_storms", 0) >= 1, (
+        "block-boundary crossing did not register as a storm")
+    dumps = sorted(glob.glob(os.path.join(
+        os.environ["PTPU_FLIGHT_DIR"], "*kv_pressure*.json")))
+    assert len(dumps) == 1, f"want exactly one dump, got {dumps}"
+    with open(dumps[0]) as f:
+        extra = json.load(f)["extra"]
+    assert extra["trigger"] == "eviction_storm", extra
+    assert extra["replica"].get("host"), extra
+    top = extra["holders"]["requests"][0]
+    assert top["rid"] == rids[0] and top["tenant"] == "acme", (top, rids)
+    assert top["blocks"] >= 2, top   # just crossed into its 2nd block
+    tenants = extra["holders"]["tenants"]
+    assert tenants and tenants[0]["tenant"] in ("acme", "hog"), tenants
+    assert sum(t["blocks"] for t in tenants) <= 4, tenants
+
+    # the cooldown is GLOBAL: an admission failure right after the storm
+    # is a new trigger but must be suppressed, never a second dump.  A
+    # 2-block twin makes a 40-token prompt (3 blocks) unholdable, so it
+    # fails at schedule() — before prefill, hence before any compile
+    eng2 = LLMEngine(model, EngineConfig(
+        block_size=16, num_blocks=2, max_num_seqs=8))
+    big = rng.randint(0, cfg.vocab_size, (40,)).astype(np.int32)
+    bid = eng2.add_request(big, SamplingParams(max_new_tokens=2,
+                                               tenant="hog"))
+    try:
+        try:
+            eng2.step()
+            raise AssertionError("too-big admission did not fail")
+        except RuntimeError as e:
+            assert "KV cache too small" in str(e), e
+        dumps2 = glob.glob(os.path.join(
+            os.environ["PTPU_FLIGHT_DIR"], "*kv_pressure*.json"))
+        assert len(dumps2) == 1, f"rate limit leaked a dump: {dumps2}"
+        snap_ = monitor.snapshot()
+        assert snap_.get("memory/pressure_dumps") == 1, snap_.get(
+            "memory/pressure_dumps")
+        assert snap_.get("memory/pressure_suppressed", 0) >= 1, (
+            "admission failure inside the cooldown was not rate-limited")
+        d_compiles = sum(snap_["serving/compiles"].values()) - compiles0
+        assert d_compiles == 0, f"{d_compiles} compiles under pressure"
+        assert snap_.get("serving/kernels_per_step") == kern0, (
+            kern0, snap_.get("serving/kernels_per_step"))
+    finally:
+        eng2.release_request(bid)
+    print(f"memobs: eviction storm -> one kv_pressure dump, top holder "
+          f"rid={rids[0]} tenant=acme ({top['blocks']} blocks); "
+          f"admission failure inside cooldown suppressed; compiles + "
+          f"kernels_per_step FLAT under pressure")
 
 
 def check_trace(engine, snap, n_requests):

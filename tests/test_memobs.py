@@ -1,0 +1,335 @@
+"""Memory microscope (ISSUE 20, monitor v8) — fast-tier, subprocess-free.
+
+Covers the pieces the serve_smoke --memobs leg exercises end-to-end,
+at unit granularity:
+
+- KV block-lifecycle ledger exactness: every pool transition under
+  alloc / fork / grow-CoW / swap_out / swap_in / free and under
+  park / adopt / evict lands in `cache.acct.events` with the exact
+  documented overlap semantics (a CoW also counts its fresh block's
+  alloc; a swap_in also counts allocs; adopt bumps refcounts only).
+- Gauge single-source pin (satellite 1): every capacity view —
+  num_free_blocks / num_parked_blocks / blocks_in_use / utilization —
+  derives from ONE `counts()` source and its invariants hold across
+  alloc/park/adopt/evict cycles.
+- fragmentation() run analysis on hand-built free lists.
+- StormDetector fire / floor / cooldown / baseline-not-folded.
+- PressureReporter global rate limit + kv_pressure dump contents.
+- Router-feed wire keys accrete-only pin; fleet tenant-KV rollup
+  round-trip incl. older-replica (no-series) tolerance.
+- Timeline ring bounds (PTPU_MEMOBS_RING) and /kv publish interval.
+- build_kv_snapshot / rank_holders document shape and ranking.
+- The PTPU_MEMOBS off gate: no counting, no sampling.
+"""
+import json
+import types
+
+import pytest
+
+from paddle_tpu.monitor import fleet, memory as mmem, wire
+from paddle_tpu.serving.kv_cache import BlockKVCache, prefix_block_keys
+
+
+@pytest.fixture()
+def memobs():
+    """Enable the microscope for the test; restore + clear module state."""
+    prev = mmem.enabled()
+    mmem.enable(True)
+    yield
+    mmem.enable(prev)
+    mmem.reset()
+
+
+def _req(rid, arrival_t=None, tenant=None, priority=None):
+    return types.SimpleNamespace(
+        req_id=rid, arrival_t=arrival_t,
+        params=types.SimpleNamespace(tenant=tenant, priority=priority))
+
+
+def _pin_counts(cache):
+    """Satellite 1: every capacity view equals the ONE counts() source."""
+    c = cache.counts()
+    assert c["free"] + c["in_use"] == c["total"]
+    assert c["allocatable"] == c["free"] + c["parked"]
+    assert c["referenced"] == c["in_use"] - c["parked"]
+    assert cache.num_free_blocks == c["allocatable"]
+    assert cache.num_parked_blocks == c["parked"]
+    assert cache.blocks_in_use == c["in_use"]
+    assert cache.utilization == c["in_use"] / c["total"]
+    return c
+
+
+# -- (a) lifecycle ledger exactness ------------------------------------------
+
+def test_ledger_alloc_fork_cow_swap_exact(memobs):
+    cache = BlockKVCache(1, 8, 4, 1, 2)
+    cache.allocate("a", 6)              # 2 blocks        -> alloc 2
+    _pin_counts(cache)
+    cache.fork("a", "b")                # refs 2,2        -> fork 2
+    cache.grow_to("b", 7)               # shared partial last -> cow 1,
+    _pin_counts(cache)                  #   fresh block   -> alloc +1
+    saved = cache.swap_out("a")         # swap_out 2; one block still
+    _pin_counts(cache)                  #   shared with b -> free only 1
+    cache.swap_in("a", saved)           # swap_in 2 AND alloc +2
+    cache.free("a")                     # free +2
+    cache.free("b")                     # free +2
+    assert cache.acct.events == {
+        "alloc": 5, "free": 5, "fork": 2, "cow": 1,
+        "park": 0, "adopt": 0, "evict": 0,
+        "swap_out": 2, "swap_in": 2,
+    }
+    c = _pin_counts(cache)
+    assert c["free"] == 8 and c["in_use"] == 0
+    assert c["peak_in_use"] == 4        # after swap_in: b's 2 + a's 2
+
+
+def test_ledger_park_adopt_evict_exact(memobs):
+    cache = BlockKVCache(1, 8, 4, 1, 2)
+    keys = prefix_block_keys(list(range(8)), 4)     # 2 chain keys
+    cache.allocate("p", 8)              # alloc 2
+    cache.register_prefix("p", keys, 8)
+    cache.free("p")                     # indexed -> park 2 (free 0)
+    c = _pin_counts(cache)
+    assert c["parked"] == 2 and c["free"] == 6 and c["in_use"] == 2
+    got = cache.adopt_prefix("q", keys, 2)          # revive -> adopt 2
+    assert got == 8                     # adopted token count
+    c = _pin_counts(cache)
+    assert c["parked"] == 0 and c["referenced"] == 2
+    cache.free("q")                     # park again -> park +2
+    cache.allocate("r", 32)             # 8 blocks: 6 free (alloc 6),
+    _pin_counts(cache)                  #   then 2 LRU evictions
+    assert cache.acct.events == {
+        "alloc": 10, "free": 0, "fork": 0, "cow": 0,
+        "park": 4, "adopt": 2, "evict": 2,
+        "swap_out": 0, "swap_in": 0,
+    }
+    c = cache.counts()
+    assert c == {"total": 8, "free": 0, "parked": 0, "allocatable": 0,
+                 "in_use": 8, "referenced": 8, "peak_in_use": 8}
+
+
+def test_memobs_off_gate_counts_nothing():
+    prev = mmem.enabled()
+    mmem.enable(False)
+    try:
+        cache = BlockKVCache(1, 4, 4, 1, 2)
+        cache.allocate("a", 8)
+        cache.free("a")
+        assert cache.acct.events == dict.fromkeys(mmem.EVENTS, 0)
+        n0 = len(mmem.timeline_snapshot())
+        mmem.sample(hbm_in_use=123)
+        assert len(mmem.timeline_snapshot()) == n0
+        assert mmem.maybe_publish_kv(lambda: {"n": 1}) is False
+        # the accounting VIEWS stay correct regardless of the gate
+        _pin_counts(cache)
+    finally:
+        mmem.enable(prev)
+        mmem.reset()
+
+
+# -- fragmentation / refcount analysis ---------------------------------------
+
+def test_fragmentation_math():
+    assert mmem.fragmentation([], 8) == {
+        "free": 0, "total": 8, "runs": 0, "largest_run": 0, "frag": 0.0}
+    assert mmem.fragmentation([0, 1, 2, 3], 8) == {
+        "free": 4, "total": 8, "runs": 1, "largest_run": 4, "frag": 0.0}
+    shredded = mmem.fragmentation([0, 2, 4, 6], 8)
+    assert shredded["runs"] == 4 and shredded["largest_run"] == 1
+    assert shredded["frag"] == 0.75
+    # unsorted input; runs {0,1,2}, {5}, {7}
+    mixed = mmem.fragmentation([5, 0, 1, 7, 2], 8)
+    assert mixed["runs"] == 3 and mixed["largest_run"] == 3
+    assert mixed["frag"] == round(1.0 - 3 / 5, 6)
+
+
+def test_refcount_histogram():
+    blocks = [types.SimpleNamespace(ref=r) for r in (0, 0, 1, 1, 1, 3)]
+    assert mmem.refcount_histogram(blocks) == {0: 2, 1: 3, 3: 1}
+
+
+# -- (c) storm detector / pressure reporter ----------------------------------
+
+def test_storm_detector_fire_cooldown_and_baseline(memobs):
+    det = mmem.StormDetector(alpha=0.5, sigma=3.0, warmup=4,
+                             cooldown=4, floor=2.0)
+    for _ in range(6):
+        assert det.observe(0) is None   # quiet baseline
+    fire = det.observe(5)               # step 6: 5 >> mean 0 -> storm
+    assert fire is not None
+    assert fire["kind"] == "eviction_storm"
+    assert fire["events"] == 5.0 and fire["step"] == 6
+    # flagged steps are NOT folded into the baseline
+    assert det._mean == 0.0
+    assert det.observe(5) is None       # step 7: inside cooldown (1 < 4)
+    assert det.observe(0) is None       # steps 8..9 fold
+    assert det.observe(0) is None
+    fire2 = det.observe(5)              # step 10: 10 - 6 >= 4 -> fires
+    assert fire2 is not None and fire2["step"] == 10
+
+
+def test_storm_detector_floor_and_warmup(memobs):
+    det = mmem.StormDetector(alpha=0.5, sigma=0.0, warmup=0, floor=2.0)
+    assert det.observe(1.0) is None     # below the absolute floor
+    det2 = mmem.StormDetector(warmup=8)
+    assert det2.observe(50.0) is None   # warming up: never a storm
+    assert det2.observe("bogus") is None
+
+
+def test_pressure_reporter_rate_limit(memobs, tmp_path, monkeypatch):
+    monkeypatch.setenv("PTPU_FLIGHT_DIR", str(tmp_path))
+    rep = mmem.PressureReporter(cooldown_s=10.0)
+    p1 = rep.maybe_dump("admission_failure",
+                        extra={"holders": {"requests": []}}, now=100.0)
+    assert p1 is not None
+    doc = json.loads(open(p1).read())
+    assert doc["extra"]["trigger"] == "admission_failure"
+    assert "replica" in doc["extra"]    # fleet identity tag
+    assert doc["extra"]["holders"] == {"requests": []}
+    # the cooldown is GLOBAL across trigger kinds: one dump per window
+    assert rep.maybe_dump("eviction_storm", now=105.0) is None
+    assert rep.triggers == 2
+    p3 = rep.maybe_dump("eviction_storm", now=111.0)
+    assert p3 is not None and p3 != p1
+    assert len(list(tmp_path.glob("*kv_pressure*.json"))) == 2
+
+
+def test_pressure_reporter_no_flight_dir(memobs, monkeypatch):
+    monkeypatch.delenv("PTPU_FLIGHT_DIR", raising=False)
+    rep = mmem.PressureReporter(cooldown_s=0.0)
+    assert rep.maybe_dump("admission_failure", now=1.0) is None
+    assert rep.triggers == 1
+
+
+def test_reporter_singleton_and_cooldown_knob(memobs, monkeypatch):
+    assert mmem.reporter() is mmem.reporter()
+    mmem.reset()                        # clears the process singleton
+    monkeypatch.setenv("PTPU_MEMOBS_COOLDOWN_S", "7.5")
+    assert mmem.PressureReporter().cooldown_s == 7.5
+    monkeypatch.setenv("PTPU_MEMOBS_COOLDOWN_S", "not-a-number")
+    assert mmem.PressureReporter().cooldown_s == 30.0
+
+
+# -- (d) wire / fleet feed ----------------------------------------------------
+
+def test_router_feed_keys_accrete_only():
+    keys = list(wire.ROUTER_FEED_KEYS)
+    assert len(set(keys)) == len(keys)
+    # ISSUE 20 keys accreted at the END, after the ISSUE 19 tail
+    assert keys[-4:] == ["kv_blocks_in_use", "kv_block_utilization",
+                         "kv_pressure_dumps", "tenant_kv_blocks"]
+    assert keys.index("tenants") < keys.index("kv_blocks_in_use")
+
+
+def test_fleet_tenant_kv_rollup_round_trip():
+    text = ('# TYPE serving_kv_blocks_held gauge\n'
+            'serving_kv_blocks_held{tenant="acme"} 3\n'
+            'serving_kv_blocks_held{tenant="beta"} 1\n'
+            'serving_blocks_in_use 4\n'
+            'serving_block_utilization 0.5\n'
+            'memory_pressure_dumps 1\n')
+    parsed = fleet.parse_prometheus(text)
+    assert fleet._tenant_kv_rollup(parsed) == {"acme": 3.0, "beta": 1.0}
+    assert fleet.series_value(parsed, "serving_blocks_in_use") == 4.0
+    assert fleet.series_value(parsed, "memory_pressure_dumps") == 1.0
+
+
+def test_fleet_feed_tolerates_older_replica():
+    # a replica from before ISSUE 20 exports none of the new series:
+    # every feed read degrades to None / {} — never a KeyError
+    old = fleet.parse_prometheus("serving_queue_depth 0\n")
+    assert fleet.series_value(old, "serving_blocks_in_use") is None
+    assert fleet.series_value(old, "serving_block_utilization") is None
+    assert fleet.series_value(old, "memory_pressure_dumps") is None
+    assert fleet._tenant_kv_rollup(old) == {}
+
+
+# -- (b) timeline ring / publication ------------------------------------------
+
+def test_timeline_ring_bounds(memobs, monkeypatch):
+    monkeypatch.setenv("PTPU_MEMOBS", "1")
+    monkeypatch.setenv("PTPU_MEMOBS_RING", "8")
+    mmem.refresh()
+    try:
+        for i in range(20):
+            mmem.sample(hbm_in_use=i, host_rss=1, ts=float(i))
+        rep = mmem.timeline_report()
+        assert rep["enabled"] is True and rep["maxlen"] == 8
+        assert rep["n"] == 8
+        ts = [r["ts"] for r in rep["readings"]]
+        assert ts == sorted(ts) and ts[0] == 12.0 and ts[-1] == 19.0
+        assert rep["readings"][-1]["hbm_in_use"] == 19
+        assert rep["readings"][-1]["hbm_peak"] is None   # null field kept
+    finally:
+        monkeypatch.delenv("PTPU_MEMOBS_RING")
+        monkeypatch.delenv("PTPU_MEMOBS")
+        mmem.refresh()
+
+
+def test_ring_len_floor_and_bad_value(monkeypatch):
+    monkeypatch.setenv("PTPU_MEMOBS_RING", "2")
+    assert mmem._ring_len() == 8        # floor
+    monkeypatch.setenv("PTPU_MEMOBS_RING", "garbage")
+    assert mmem._ring_len() == 512
+
+
+def test_host_rss_bytes(memobs):
+    val = mmem.host_rss_bytes()
+    assert val is not None and val > 0
+    assert mmem.host_rss_bytes() == val     # TTL-cached read
+
+
+def test_maybe_publish_kv_interval(memobs):
+    mmem.reset()
+    assert mmem.latest_kv() is None
+    assert mmem.maybe_publish_kv(lambda: {"n": 1}, now=50.0) is True
+    assert mmem.latest_kv() == {"n": 1}     # first call is immediate
+    assert mmem.maybe_publish_kv(lambda: {"n": 2}, now=50.2) is False
+    assert mmem.latest_kv() == {"n": 1}     # inside the interval
+    assert mmem.maybe_publish_kv(lambda: {"n": 3}, now=50.6) is True
+    assert mmem.latest_kv() == {"n": 3}
+    rep = mmem.kv_report()
+    assert rep["enabled"] is True and rep["snapshot"] == {"n": 3}
+
+
+# -- /kv document + holder ranking --------------------------------------------
+
+def test_rank_holders_and_snapshot(memobs):
+    cache = BlockKVCache(1, 8, 4, 1, 2)
+    cache.allocate("r1", 8)             # 2 blocks
+    cache.allocate("r2", 4)             # 1 block
+    keys = prefix_block_keys(list(range(100, 104)), 4)
+    cache.allocate("p", 4)
+    cache.register_prefix("p", keys, 4)
+    cache.free("p")                     # 1 parked chain
+    reqs = [_req("r1", arrival_t=0.0, tenant="acme"),
+            _req("r2", arrival_t=9.0, tenant="beta"),
+            _req("zz", arrival_t=9.5)]          # no table: skipped
+    ranked = mmem.rank_holders(cache, reqs, now=10.0)
+    # long-held large holding outranks the fresh small one
+    assert [r["rid"] for r in ranked["requests"]] == ["r1", "r2"]
+    top = ranked["requests"][0]
+    assert top["blocks"] == 2 and top["tenant"] == "acme"
+    assert top["age_s"] == 10.0 and top["score"] == 22.0
+    assert ranked["tenants"][0] == {"tenant": "acme", "blocks": 2,
+                                    "share": 0.25}
+    assert len(ranked["parked_chains"]) == 1
+    chain = ranked["parked_chains"][0]
+    assert chain["blocks"] == 1
+    assert chain["chain"] == keys[0].hex()[:12]
+    assert chain["oldest_age_s"] >= 0.0
+
+    snap = mmem.build_kv_snapshot(cache, reqs, now=10.0)
+    assert snap["num_blocks"] == 8 and snap["block_size"] == 4
+    assert snap["free"] == 4 and snap["parked"] == 1
+    assert snap["in_use"] == 4 and snap["referenced"] == 3
+    assert snap["allocatable"] == 5
+    assert snap["utilization"] == 0.5
+    assert snap["bytes_per_block"] == cache.bytes_per_block
+    assert snap["fragmentation"]["free"] == 4
+    assert snap["fragmentation"]["frag"] == 0.0     # LIFO leaves 0..3
+    assert snap["refcounts"] == {"0": 5, "1": 3}
+    assert snap["requests"][0]["rid"] == "r1"
+    # the events block is a COPY — mutating it can't corrupt the ledger
+    snap["events"]["alloc"] = -1
+    assert cache.acct.events["alloc"] == 4

@@ -430,9 +430,13 @@ class TestMonitorAndSmoke:
         # replica and fleet), and --api: the ISSUE-19 one (socket-streamed
         # /v1/completions token-identical to generate() greedy AND
         # seeded, tenant-labeled metrics on /metrics, 429 shed under
-        # burn) all assert in-script ON TOP of the plain smoke checks,
-        # so ONE subprocess covers every leg (tests/test_trace.py and
-        # tests/test_perf.py lean on this invocation; tier-1 budget
+        # burn), and --memobs: the ISSUE-20 one (/kv + /memory/timeline
+        # live, an eviction storm yielding EXACTLY ONE rate-limited
+        # kv_pressure dump naming the actual top holder, a suppressed
+        # admission-failure trigger, compiles + kernels_per_step FLAT
+        # under pressure) all assert in-script ON TOP of the plain smoke
+        # checks, so ONE subprocess covers every leg (tests/test_trace.py
+        # and tests/test_perf.py lean on this invocation; tier-1 budget
         # leaves no room for a second engine-compiling subprocess)
         script = (pathlib.Path(__file__).resolve().parent.parent
                   / "scripts" / "serve_smoke.py")
@@ -443,7 +447,7 @@ class TestMonitorAndSmoke:
         env["PTPU_MONITOR"] = "1"
         proc = subprocess.run([sys.executable, str(script), "--trace",
                                "--perf", "--prefix-cache", "--spec",
-                               "--slo", "--api"],
+                               "--slo", "--api", "--memobs"],
                               env=env, capture_output=True, text=True,
                               timeout=560)
         assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
@@ -467,6 +471,15 @@ class TestMonitorAndSmoke:
         assert "token-identical to generate()" in proc.stdout
         assert "serving_tenant_* series live" in proc.stdout
         assert "best-effort shed with 429 code=shed" in proc.stdout
+        # ISSUE 20 --memobs leg: pool map + timeline live, one dump
+        # naming the top holder, rate-limited second trigger, FLAT
+        assert "memobs: /kv pool map live" in proc.stdout
+        assert "eviction storm -> one kv_pressure dump, top holder" \
+            in proc.stdout
+        assert "tenant=acme" in proc.stdout
+        assert "admission failure inside cooldown suppressed" \
+            in proc.stdout
+        assert "kernels_per_step FLAT under pressure" in proc.stdout
 
 
 class TestPagedAttentionOp:
